@@ -1,0 +1,76 @@
+"""Benchmark entry point: prints ONE JSON line with the headline metric.
+
+Round-1 scope: decode throughput of a Llama-3.2-1B-architecture model (random bf16
+weights) on one chip — the 8B flagship needs weight quantization to fit a single v5e
+chip's 16 GB HBM and moves here once that lands. ``vs_baseline`` is measured against the
+north-star target of 2000 decode tok/s/chip (BASELINE.md).
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+
+    from neuronx_distributed_inference_tpu.config import (
+        TpuConfig, load_pretrained_config)
+    from neuronx_distributed_inference_tpu.models.llama.modeling_llama import (
+        LlamaForCausalLM, LlamaInferenceConfig)
+    from neuronx_distributed_inference_tpu.ops.sampling import prepare_sampling_params
+
+    batch, prompt_len, decode_steps = 8, 128, 128
+    hf_cfg = {
+        "model_type": "llama",
+        "vocab_size": 128256,
+        "hidden_size": 2048,
+        "intermediate_size": 8192,
+        "num_hidden_layers": 16,
+        "num_attention_heads": 32,
+        "num_key_value_heads": 8,
+        "head_dim": 64,
+        "max_position_embeddings": 131072,
+        "rms_norm_eps": 1e-5,
+        "rope_theta": 500000.0,
+        "rope_scaling": {"rope_type": "llama3", "factor": 32.0, "low_freq_factor": 1.0,
+                         "high_freq_factor": 4.0,
+                         "original_max_position_embeddings": 8192},
+        "tie_word_embeddings": True,
+    }
+    tpu_cfg = TpuConfig(batch_size=batch, seq_len=512, max_context_length=256,
+                        dtype="bfloat16", tp_degree=1,
+                        context_encoding_buckets=[128, 256],
+                        token_generation_buckets=[256, 512])
+    config = LlamaInferenceConfig(tpu_cfg, load_config=load_pretrained_config(hf_cfg))
+    app = LlamaForCausalLM(None, config)
+    app.load_random(seed=0)
+
+    rng = np.random.default_rng(0)
+    input_ids = rng.integers(1, 128256, size=(batch, prompt_len)).astype(np.int32)
+    sp = prepare_sampling_params(batch)
+
+    # warm both graphs (compile), then measure
+    app.generate(input_ids, max_new_tokens=decode_steps)
+    out = app.generate(input_ids, max_new_tokens=decode_steps, collect_latency=True)
+    chunk_s = np.array([s for s, _ in out.decode_latencies_s])
+    chunk_toks = np.array([t for _, t in out.decode_latencies_s])
+    total_decode_s = float(chunk_s.sum())
+    n_decode_tokens = int(chunk_toks.sum())
+    decode_tok_s = batch * n_decode_tokens / total_decode_s
+    p50_step_ms = float(np.percentile(chunk_s / chunk_toks, 50) * 1e3)
+
+    print(json.dumps({
+        "metric": "llama3.2-1b-arch decode tokens/sec/chip (bs=8, bf16, tp=1)",
+        "value": round(decode_tok_s, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(decode_tok_s / 2000.0, 3),
+        "extra": {"p50_decode_step_ms": round(p50_step_ms, 2),
+                  "ttft_s": round(out.ttft_s, 3)},
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,17 @@
+"""TPU-native distributed LLM inference framework.
+
+A from-scratch JAX/XLA/Pallas framework with the capability surface of
+`neuronx-distributed-inference` (reference at /root/reference): bucket-compiled
+prefill/decode graphs, device-resident KV caches, tensor/sequence/context/expert
+parallelism over a `jax.sharding.Mesh`, Pallas kernels for the hot ops, on-device
+sampling, and a model hub. See SURVEY.md at the repo root for the capability map.
+"""
+
+__version__ = "0.1.0"
+
+from .config import (  # noqa: F401
+    InferenceConfig,
+    OnDeviceSamplingConfig,
+    TpuConfig,
+    load_pretrained_config,
+)

@@ -1,0 +1,383 @@
+"""Configuration system for the TPU inference framework.
+
+Capability parity with the reference NeuronConfig / InferenceConfig
+(`/root/reference/src/neuronx_distributed_inference/models/config.py:92-997`), redesigned
+as typed dataclasses instead of a kwargs bag:
+
+- ``TpuConfig``         ≈ NeuronConfig: runtime/feature flags (parallelism degrees,
+                          bucketing, dtypes, sampling, continuous batching, ...).
+- ``InferenceConfig``   : wraps the HF model config attributes + a TpuConfig, with JSON
+                          round-trip (save/load of ``tpu_config.json`` in a compiled dir).
+- Sub-configs           ≈ OnDeviceSamplingConfig, ChunkedPrefillConfig, etc.
+
+Validation mirrors the reference's config-time cross checks
+(`models/config.py:610-686`): invalid flag combinations fail at construction, not at
+trace time.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+
+_DTYPE_MAP = {
+    "bfloat16": jnp.bfloat16,
+    "float16": jnp.float16,
+    "float32": jnp.float32,
+    "int8": jnp.int8,
+    "float8_e4m3": jnp.float8_e4m3fn,
+}
+
+
+def to_jax_dtype(name) -> Any:
+    """Map a dtype name (or jnp dtype) to the jnp dtype object."""
+    if isinstance(name, str):
+        if name.startswith("torch."):  # tolerate HF configs that carry torch dtypes
+            name = name[len("torch."):]
+        if name == "float8_e4m3fn":
+            name = "float8_e4m3"
+        if name not in _DTYPE_MAP:
+            raise ValueError(f"unsupported dtype {name!r}; one of {sorted(_DTYPE_MAP)}")
+        return _DTYPE_MAP[name]
+    return name
+
+
+def dtype_name(dtype) -> str:
+    for k, v in _DTYPE_MAP.items():
+        if v == dtype:
+            return k
+    return str(dtype)
+
+
+@dataclass
+class OnDeviceSamplingConfig:
+    """On-device sampling knobs (≈ reference OnDeviceSamplingConfig,
+    `models/config.py:1000-1035`)."""
+
+    do_sample: bool = False          # False -> greedy argmax
+    top_k: int = 1
+    top_p: float = 1.0
+    temperature: float = 1.0
+    # Pre-filter to the global top-k before top-k/top-p masking, which bounds the
+    # sort/cumsum to a small constant width (reference default 256).
+    global_topk: int = 256
+    dynamic: bool = True             # accept per-request (B, 3) sampling params at runtime
+    deterministic: bool = False      # fixed PRNG seed stream for reproducible sampling
+    seed: int = 0
+
+    def validate(self) -> None:
+        if self.top_k < 1 and self.top_k != -1:
+            raise ValueError("top_k must be >= 1 (or -1 for 'all')")
+        if not (0.0 < self.top_p <= 1.0):
+            raise ValueError("top_p must be in (0, 1]")
+        if self.temperature <= 0.0:
+            raise ValueError("temperature must be > 0")
+        if self.global_topk < 1:
+            raise ValueError("global_topk must be >= 1")
+
+
+@dataclass
+class ChunkedPrefillConfig:
+    """Chunked-prefill knobs (≈ reference ChunkedPrefillConfig)."""
+
+    max_num_seqs: int = 8
+    chunk_size: int = 512
+    kernel_q_tile_size: int = 128
+    kernel_kv_tile_size: int = 512
+
+
+@dataclass
+class SpeculationConfig:
+    """Speculative-decoding knobs (draft/target; fused graph comes later rounds)."""
+
+    speculation_length: int = 0      # 0 = disabled
+    spec_batch_size: int = 1
+    draft_model_path: Optional[str] = None
+
+
+@dataclass
+class LoraServingConfig:
+    """Multi-LoRA serving knobs (≈ reference LoraServingConfig)."""
+
+    max_loras: int = 1
+    max_lora_rank: int = 16
+    lora_ckpt_paths: Optional[Dict[str, str]] = None
+
+
+@dataclass
+class QuantizationConfig:
+    """Weight/KV quantization knobs."""
+
+    quantize_weights: bool = False
+    weight_dtype: str = "int8"       # int8 | float8_e4m3
+    kv_cache_dtype: Optional[str] = None  # None = same as model dtype
+
+
+@dataclass
+class TpuConfig:
+    """Runtime/feature configuration (≈ reference NeuronConfig,
+    `models/config.py:92-608`).
+
+    Everything the host wrapper and the traced graphs need to know that is *not* part of
+    the model architecture: batch/sequence geometry, parallelism degrees, bucket ladders,
+    dtypes, sampling, serving features.
+    """
+
+    # --- geometry ---
+    batch_size: int = 1
+    max_batch_size: int = 0          # 0 -> batch_size
+    seq_len: int = 2048              # max total sequence length (context + generated)
+    max_context_length: int = 0      # 0 -> seq_len
+    max_new_tokens: int = 0          # informational; generate() takes an explicit arg
+    n_active_tokens: int = 1         # decode width (speculation_length when speculating)
+
+    # --- parallelism (world = dp * cp * tp * ep, pp carried for parity) ---
+    tp_degree: int = 1
+    dp_degree: int = 1
+    cp_degree: int = 1
+    ep_degree: int = 1
+    pp_degree: int = 1
+    sequence_parallel_enabled: bool = False
+    vocab_parallel: bool = True      # shard embed/lm_head on vocab dim
+    flash_decoding_enabled: bool = False
+
+    # --- dtypes ---
+    dtype: str = "bfloat16"
+    rpl_reduce_dtype: str = "float32"   # accumulation dtype for cross-rank reductions
+    logits_dtype: str = "float32"
+
+    # --- bucketing (≈ modules/autobucketing.py) ---
+    enable_bucketing: bool = True
+    context_encoding_buckets: Optional[List[int]] = None   # None -> auto ladder
+    token_generation_buckets: Optional[List[int]] = None
+    batch_buckets: Optional[List[int]] = None
+
+    # --- serving features ---
+    is_continuous_batching: bool = False
+    padding_side: str = "right"
+    # decode tokens generated per device call (lax.scan chunk); amortizes dispatch
+    # latency — the TPU-native answer to the reference's async double-buffering
+    decode_chunk_size: int = 32
+    attention_kernel_enabled: Optional[bool] = None  # None = auto (TPU yes, CPU no)
+    async_mode: bool = False
+    paged_attention_enabled: bool = False
+    pa_num_blocks: int = 0
+    pa_block_size: int = 128
+
+    # --- sub-configs ---
+    on_device_sampling_config: Optional[OnDeviceSamplingConfig] = None
+    chunked_prefill_config: Optional[ChunkedPrefillConfig] = None
+    speculation_config: Optional[SpeculationConfig] = None
+    lora_serving_config: Optional[LoraServingConfig] = None
+    quantization_config: Optional[QuantizationConfig] = None
+
+    def __post_init__(self) -> None:
+        if self.max_batch_size == 0:
+            self.max_batch_size = self.batch_size
+        if self.max_context_length == 0:
+            self.max_context_length = self.seq_len
+        self.validate()
+
+    # ≈ reference NeuronConfig validation `models/config.py:610-686`
+    def validate(self) -> None:
+        if self.padding_side not in ("right", "left"):
+            raise ValueError("padding_side must be 'right' or 'left'")
+        if self.seq_len < 1 or self.batch_size < 1:
+            raise ValueError("seq_len and batch_size must be >= 1")
+        if self.max_context_length > self.seq_len:
+            raise ValueError("max_context_length must be <= seq_len")
+        for deg_name in ("tp_degree", "dp_degree", "cp_degree", "ep_degree", "pp_degree"):
+            if getattr(self, deg_name) < 1:
+                raise ValueError(f"{deg_name} must be >= 1")
+        if self.sequence_parallel_enabled and self.seq_len % self.tp_degree != 0:
+            raise ValueError("sequence parallelism requires seq_len % tp_degree == 0")
+        if self.dp_degree > 1 and not self.is_continuous_batching:
+            raise ValueError("attention data parallelism requires continuous batching")
+        if self.paged_attention_enabled and self.pa_num_blocks < 1:
+            raise ValueError("paged attention requires pa_num_blocks >= 1")
+        if self.on_device_sampling_config is not None:
+            self.on_device_sampling_config.validate()
+        for cfg, bound, name in (
+                (self.context_encoding_buckets, self.max_context_length,
+                 "context_encoding_buckets"),
+                (self.token_generation_buckets, self.seq_len,
+                 "token_generation_buckets")):
+            if cfg is not None:
+                if len(cfg) == 0:
+                    raise ValueError(f"{name} must be non-empty (or None for auto)")
+                if sorted(cfg) != list(cfg) or len(set(cfg)) != len(cfg):
+                    raise ValueError(f"{name} must be strictly increasing")
+                if cfg[-1] > bound:
+                    raise ValueError(f"largest {name} bucket {cfg[-1]} exceeds {bound}")
+
+    @property
+    def world_size(self) -> int:
+        # orthogonal mesh axes (see parallel/mesh.py); pp carried for parity, degree 1
+        return (self.tp_degree * self.dp_degree * self.cp_degree * self.ep_degree
+                * self.pp_degree)
+
+    @property
+    def jax_dtype(self):
+        return to_jax_dtype(self.dtype)
+
+    @property
+    def kv_cache_jax_dtype(self):
+        q = self.quantization_config
+        if q is not None and q.kv_cache_dtype is not None:
+            return to_jax_dtype(q.kv_cache_dtype)
+        return self.jax_dtype
+
+
+# ---------------------------------------------------------------------------
+# JSON round-trip helpers
+# ---------------------------------------------------------------------------
+
+_SUBCONFIG_TYPES = {
+    "on_device_sampling_config": OnDeviceSamplingConfig,
+    "chunked_prefill_config": ChunkedPrefillConfig,
+    "speculation_config": SpeculationConfig,
+    "lora_serving_config": LoraServingConfig,
+    "quantization_config": QuantizationConfig,
+}
+
+
+def _tpu_config_to_dict(cfg: TpuConfig) -> Dict[str, Any]:
+    return dataclasses.asdict(cfg)
+
+
+def _tpu_config_from_dict(d: Dict[str, Any]) -> TpuConfig:
+    d = dict(d)
+    for key, typ in _SUBCONFIG_TYPES.items():
+        if d.get(key) is not None:
+            d[key] = typ(**d[key])
+    known = {f.name for f in dataclasses.fields(TpuConfig)}
+    unknown = set(d) - known
+    if unknown:
+        raise ValueError(f"unknown TpuConfig keys: {sorted(unknown)}")
+    return TpuConfig(**d)
+
+
+class InferenceConfig:
+    """Model-architecture config + TpuConfig, with JSON round-trip.
+
+    ≈ reference InferenceConfig (`models/config.py:886-997`): carries arbitrary HF config
+    attributes (hidden_size, num_attention_heads, ...) as plain attributes, plus
+    ``tpu_config``. ``save``/``load`` persist to ``tpu_config.json`` in a compiled
+    artifact directory.
+    """
+
+    CONFIG_FILE = "tpu_config.json"
+
+    # attrs most models need; subclasses may extend (≈ get_required_attributes)
+    REQUIRED_ATTRIBUTES: Tuple[str, ...] = ()
+
+    def __init__(self, tpu_config: TpuConfig, load_config=None, metadata=None, **kwargs):
+        self.tpu_config = tpu_config
+        self.metadata = metadata or {}
+        if load_config is not None:
+            load_config(self)   # callable that populates attributes (≈ load_pretrained_config)
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+        self.add_derived_config()
+        self.validate()
+
+    def add_derived_config(self) -> None:
+        """Hook for architecture subclasses to derive attributes."""
+
+    def validate(self) -> None:
+        missing = [a for a in self.get_required_attributes() if not hasattr(self, a)]
+        if missing:
+            raise ValueError(f"InferenceConfig missing required attributes: {missing}")
+
+    def get_required_attributes(self) -> Tuple[str, ...]:
+        return self.REQUIRED_ATTRIBUTES
+
+    # --- serialization -----------------------------------------------------
+    def to_json_dict(self) -> Dict[str, Any]:
+        d = {
+            k: v
+            for k, v in self.__dict__.items()
+            if k not in ("tpu_config",) and _is_jsonable(v)
+        }
+        d["tpu_config"] = _tpu_config_to_dict(self.tpu_config)
+        d["_config_class"] = f"{type(self).__module__}.{type(self).__qualname__}"
+        return d
+
+    def to_json_string(self) -> str:
+        return json.dumps(self.to_json_dict(), indent=2, sort_keys=True)
+
+    def save(self, directory: str) -> str:
+        os.makedirs(directory, exist_ok=True)
+        path = os.path.join(directory, self.CONFIG_FILE)
+        with open(path, "w") as f:
+            f.write(self.to_json_string())
+        return path
+
+    @classmethod
+    def from_json_dict(cls, d: Dict[str, Any]) -> "InferenceConfig":
+        d = dict(d)
+        cls_path = d.pop("_config_class", None)
+        config_cls = cls
+        if cls_path is not None:
+            # reflection-based reload, like the reference storing __module__/__name__
+            # (`models/config.py:915-997`)
+            mod_name, _, qualname = cls_path.rpartition(".")
+            import importlib
+
+            try:
+                mod = importlib.import_module(mod_name)
+                config_cls = getattr(mod, qualname)
+            except (ImportError, AttributeError):
+                config_cls = cls
+        tpu_config = _tpu_config_from_dict(d.pop("tpu_config"))
+        obj = config_cls.__new__(config_cls)
+        obj.tpu_config = tpu_config
+        obj.metadata = d.pop("metadata", {})
+        for k, v in d.items():
+            setattr(obj, k, v)
+        obj.add_derived_config()
+        obj.validate()
+        return obj
+
+    @classmethod
+    def load(cls, directory: str) -> "InferenceConfig":
+        path = os.path.join(directory, cls.CONFIG_FILE)
+        with open(path) as f:
+            return cls.from_json_dict(json.load(f))
+
+
+def _is_jsonable(v: Any) -> bool:
+    try:
+        json.dumps(v)
+        return True
+    except (TypeError, ValueError):
+        return False
+
+
+def load_pretrained_config(model_path_or_config) -> Any:
+    """Return a ``load_config`` callable populating an InferenceConfig from a HF model dir
+    (reads ``config.json``) or an in-memory dict / transformers config.
+
+    ≈ reference `utils/hf_adapter.py:36` (load_pretrained_config).
+    """
+
+    def _load(cfg: InferenceConfig) -> None:
+        src = model_path_or_config
+        if isinstance(src, str):
+            with open(os.path.join(src, "config.json")) as f:
+                d = json.load(f)
+        elif isinstance(src, dict):
+            d = dict(src)
+        else:  # transformers PretrainedConfig
+            d = src.to_dict()
+        d.pop("torch_dtype", None)
+        for k, v in d.items():
+            if not k.startswith("_"):
+                setattr(cfg, k, v)
+
+    return _load

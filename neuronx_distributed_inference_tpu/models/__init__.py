@@ -1,0 +1,22 @@
+"""Model hub registry (≈ reference `models/` + per-arch Neuron*ForCausalLM classes)."""
+
+from typing import Dict, Type
+
+_REGISTRY: Dict[str, str] = {
+    # hf model_type -> "module:class"
+    "llama": "neuronx_distributed_inference_tpu.models.llama.modeling_llama:LlamaForCausalLM",
+}
+
+
+def get_model_cls(model_type: str) -> Type:
+    if model_type not in _REGISTRY:
+        raise KeyError(f"unsupported model_type {model_type!r}; "
+                       f"have {sorted(_REGISTRY)}")
+    mod_path, _, cls_name = _REGISTRY[model_type].partition(":")
+    import importlib
+
+    return getattr(importlib.import_module(mod_path), cls_name)
+
+
+def register_model(model_type: str, path: str) -> None:
+    _REGISTRY[model_type] = path

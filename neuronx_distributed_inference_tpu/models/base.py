@@ -1,0 +1,317 @@
+"""Functional decoder-only transformer core.
+
+≈ reference `models/model_base.py` `NeuronBaseModel` (the single traced forward,
+:696-1074 / `get_model_output` :1249-1496), redesigned functionally for JAX:
+
+- One pure function per sub-model: `prefill_forward` (≈ context encoding) and
+  `decode_forward` (≈ token generation); `jax.jit` + static bucket args replace the
+  reference's per-bucket NEFF trace (`models/model_wrapper.py:34-39`).
+- Layers are *stacked* (leading L dim on every layer param) and executed with
+  `lax.scan`, which keeps compile time O(1) in depth; the KV cache (L, B, H, S, D) is
+  scanned alongside and re-stacked updated layers are the scan ys.
+- Sharding is expressed with logical-axis constraints (parallel/sharding.py); XLA GSPMD
+  inserts the tp all-reduces the reference's Row/ColumnParallel layers issue explicitly.
+- Last-token gather before lm_head (≈ `model_base.py:1004-1016`) so prefill pays vocab
+  matmul for one position per sequence.
+
+Weight layout: matmul weights are stored (in_features, out_features) so application is
+``x @ w`` (transposed relative to torch Linear).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..modules import kvcache
+from ..ops import rope as rope_ops
+from ..ops.attention import attend, causal_mask
+from ..ops.norms import rms_norm
+from ..parallel.sharding import constrain
+
+Params = Dict[str, Any]
+
+
+@dataclass(frozen=True)
+class ModelArchArgs:
+    """Static architecture description — hashable, closed over by jitted functions.
+
+    Derived from an InferenceConfig (HF attrs) by each model family's
+    ``arch_args_from_config`` (≈ the per-arch config classes under `models/<arch>/`).
+    """
+
+    vocab_size: int
+    hidden_size: int
+    num_layers: int
+    num_heads: int
+    num_kv_heads: int
+    head_dim: int
+    intermediate_size: int
+    rms_norm_eps: float = 1e-6
+    activation: str = "silu"
+    attention_bias: bool = False
+    mlp_bias: bool = False
+    qk_norm: bool = False                 # qwen3-style per-head RMSNorm on q/k
+    sliding_window: Optional[int] = None  # gemma/gpt-oss SWA (applied to all layers if set)
+    logits_soft_cap: Optional[float] = None
+    attention_scale: Optional[float] = None   # None -> 1/sqrt(head_dim)
+    embedding_multiplier: float = 1.0     # gemma scales embeddings by sqrt(hidden)
+    tie_word_embeddings: bool = False
+    rope_attention_scaling: float = 1.0   # HF rope_scaling attention_factor
+
+    @property
+    def q_size(self) -> int:
+        return self.num_heads * self.head_dim
+
+    @property
+    def kv_size(self) -> int:
+        return self.num_kv_heads * self.head_dim
+
+
+# logical sharding axes for each stacked layer param (see parallel/sharding.py)
+def param_logical_axes(args: ModelArchArgs) -> Params:
+    layer = {
+        "ln1": ("layers", None),
+        "wq": ("layers", "embed", "heads"),
+        "wk": ("layers", "embed", "kv_heads"),
+        "wv": ("layers", "embed", "kv_heads"),
+        "wo": ("layers", "heads", "embed"),
+        "ln2": ("layers", None),
+        "wg": ("layers", "embed", "mlp"),
+        "wu": ("layers", "embed", "mlp"),
+        "wd": ("layers", "mlp", "embed"),
+    }
+    if args.attention_bias:
+        layer.update({
+            "bq": ("layers", "heads"),
+            "bk": ("layers", "kv_heads"),
+            "bv": ("layers", "kv_heads"),
+        })
+    if args.qk_norm:
+        layer.update({"q_norm": ("layers", None), "k_norm": ("layers", None)})
+    out = {
+        "embed": ("vocab", "embed"),
+        "layers": layer,
+        "final_norm": (None,),
+        "rope_inv_freq": (None,),
+    }
+    if not args.tie_word_embeddings:
+        out["lm_head"] = ("embed", "vocab")
+    return out
+
+
+def init_params(args: ModelArchArgs, key: jax.Array, dtype=jnp.bfloat16,
+                inv_freq: Optional[np.ndarray] = None) -> Params:
+    """Random parameter pytree (tests / synthetic benchmarks; real weights come from
+    utils/checkpoint + the per-arch converter)."""
+    ks = jax.random.split(key, 10)
+    L, H, I = args.num_layers, args.hidden_size, args.intermediate_size
+
+    def w(k, shape, scale=0.02):
+        return (jax.random.normal(k, shape, dtype=jnp.float32) * scale).astype(dtype)
+
+    layers = {
+        "ln1": jnp.ones((L, H), dtype=dtype),
+        "wq": w(ks[0], (L, H, args.q_size)),
+        "wk": w(ks[1], (L, H, args.kv_size)),
+        "wv": w(ks[2], (L, H, args.kv_size)),
+        "wo": w(ks[3], (L, args.q_size, H)),
+        "ln2": jnp.ones((L, H), dtype=dtype),
+        "wg": w(ks[4], (L, H, I)),
+        "wu": w(ks[5], (L, H, I)),
+        "wd": w(ks[6], (L, I, H)),
+    }
+    if args.attention_bias:
+        layers.update({
+            "bq": jnp.zeros((L, args.q_size), dtype=dtype),
+            "bk": jnp.zeros((L, args.kv_size), dtype=dtype),
+            "bv": jnp.zeros((L, args.kv_size), dtype=dtype),
+        })
+    if args.qk_norm:
+        layers.update({
+            "q_norm": jnp.ones((L, args.head_dim), dtype=dtype),
+            "k_norm": jnp.ones((L, args.head_dim), dtype=dtype),
+        })
+    if inv_freq is None:
+        inv_freq = rope_ops.default_inv_freq(args.head_dim)
+    params = {
+        "embed": w(ks[7], (args.vocab_size, H)),
+        "layers": layers,
+        "final_norm": jnp.ones((H,), dtype=dtype),
+        "rope_inv_freq": jnp.asarray(inv_freq, dtype=jnp.float32),
+    }
+    if not args.tie_word_embeddings:
+        params["lm_head"] = w(ks[8], (H, args.vocab_size))
+    return params
+
+
+_ACTIVATIONS = {
+    "silu": jax.nn.silu,
+    "gelu": jax.nn.gelu,
+    "gelu_pytorch_tanh": lambda x: jax.nn.gelu(x, approximate=True),
+}
+
+
+def _project_qkv(lp: Params, args: ModelArchArgs, hn: jnp.ndarray):
+    """(B, S, H) -> q (B, nq, S, D), k/v (B, nkv, S, D)."""
+    b, s, _ = hn.shape
+    q = hn @ lp["wq"]
+    k = hn @ lp["wk"]
+    v = hn @ lp["wv"]
+    if args.attention_bias:
+        q = q + lp["bq"]
+        k = k + lp["bk"]
+        v = v + lp["bv"]
+    q = q.reshape(b, s, args.num_heads, args.head_dim).transpose(0, 2, 1, 3)
+    k = k.reshape(b, s, args.num_kv_heads, args.head_dim).transpose(0, 2, 1, 3)
+    v = v.reshape(b, s, args.num_kv_heads, args.head_dim).transpose(0, 2, 1, 3)
+    if args.qk_norm:
+        q = rms_norm(q, lp["q_norm"], args.rms_norm_eps)
+        k = rms_norm(k, lp["k_norm"], args.rms_norm_eps)
+    return q, k, v
+
+
+def _mlp(lp: Params, args: ModelArchArgs, hn: jnp.ndarray, mesh, rules) -> jnp.ndarray:
+    act = _ACTIVATIONS[args.activation]
+    gate = act(hn @ lp["wg"])
+    up = hn @ lp["wu"]
+    inter = constrain(gate * up, ("batch", None, "mlp"), rules, mesh=mesh)
+    return inter @ lp["wd"]
+
+
+def _decoder_layer(
+    lp: Params,
+    args: ModelArchArgs,
+    h: jnp.ndarray,              # (B, S, H)
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+    mask: jnp.ndarray,           # (B, 1, S, S_kv) True=attend
+    k_cache: jnp.ndarray,        # (B, H_kv, S_cache, D)
+    v_cache: jnp.ndarray,
+    positions: Optional[jnp.ndarray],  # (B,) decode write positions; None for prefill
+    decode_bucket: Optional[int],      # static; None for prefill (attend over fresh k/v)
+    mesh,
+    rules=None,
+    sinks: Optional[jnp.ndarray] = None,
+):
+    resid = h
+    hn = rms_norm(h, lp["ln1"], args.rms_norm_eps)
+    q, k, v = _project_qkv(lp, args, hn)
+    q = constrain(q, ("batch", "heads", None, None), rules, mesh=mesh)
+    k = constrain(k, ("batch", "kv_heads", None, None), rules, mesh=mesh)
+    v = constrain(v, ("batch", "kv_heads", None, None), rules, mesh=mesh)
+    q, k = rope_ops.apply_rotary(q, k, cos, sin)
+
+    if positions is None:
+        # prefill: cache write at [0, S), attend over the fresh (unpadded-bucket) k/v
+        k_cache = kvcache.write_prefill(k_cache, k)
+        v_cache = kvcache.write_prefill(v_cache, v)
+        k_att, v_att = k, v
+    else:
+        k_cache = kvcache.write_decode(k_cache, k, positions)
+        v_cache = kvcache.write_decode(v_cache, v, positions)
+        k_att = kvcache.read_bucket(k_cache, decode_bucket)
+        v_att = kvcache.read_bucket(v_cache, decode_bucket)
+
+    attn = attend(q, k_att, v_att, mask=mask, scale=args.attention_scale,
+                  logits_soft_cap=args.logits_soft_cap, sinks=sinks)
+    attn = attn.transpose(0, 2, 1, 3).reshape(h.shape[0], h.shape[1], args.q_size)
+    h = resid + constrain(attn @ lp["wo"], ("batch", None, None), rules, mesh=mesh)
+
+    resid = h
+    hn = rms_norm(h, lp["ln2"], args.rms_norm_eps)
+    h = resid + constrain(_mlp(lp, args, hn, mesh, rules), ("batch", None, None), rules,
+                          mesh=mesh)
+    return h, k_cache, v_cache
+
+
+def _run_stack(params: Params, args: ModelArchArgs, h, cos, sin, mask, cache,
+               positions, decode_bucket, mesh, rules):
+    """Scan the decoder layers, carrying hidden state, yielding updated cache."""
+
+    def body(carry_h, xs):
+        lp, kc, vc = xs
+        new_h, kc, vc = _decoder_layer(lp, args, carry_h, cos, sin, mask, kc, vc,
+                                       positions, decode_bucket, mesh, rules)
+        return new_h, (kc, vc)
+
+    h, (k_new, v_new) = jax.lax.scan(body, h, (params["layers"], cache["k"], cache["v"]))
+    return h, {"k": k_new, "v": v_new}
+
+
+def _embed(params: Params, args: ModelArchArgs, input_ids, mesh, rules):
+    h = jnp.take(params["embed"], input_ids, axis=0)
+    if args.embedding_multiplier != 1.0:
+        h = h * jnp.asarray(args.embedding_multiplier, h.dtype)
+    return constrain(h, ("batch", None, None), rules, mesh=mesh)
+
+
+def _lm_head(params: Params, args: ModelArchArgs, h, mesh, rules) -> jnp.ndarray:
+    w = params["embed"].T if args.tie_word_embeddings else params["lm_head"]
+    logits = (h @ w).astype(jnp.float32)
+    logical = ("batch", "vocab") if logits.ndim == 2 else ("batch", None, "vocab")
+    return constrain(logits, logical, rules, mesh=mesh)
+
+
+def prefill_forward(
+    params: Params,
+    args: ModelArchArgs,
+    input_ids: jnp.ndarray,       # (B, S) int32, right-padded to the bucket
+    position_ids: jnp.ndarray,    # (B, S) int32
+    last_token_idx: jnp.ndarray,  # (B,) index of last real token per sequence
+    cache: kvcache.KVCache,       # donated
+    mesh=None,
+    rules=None,
+) -> Tuple[jnp.ndarray, kvcache.KVCache]:
+    """Context encoding: returns (last-token logits (B, V) fp32, updated cache)."""
+    h = _embed(params, args, input_ids, mesh, rules)
+    cos, sin = rope_ops.compute_cos_sin(params["rope_inv_freq"], position_ids,
+                                        args.rope_attention_scaling)
+    s = input_ids.shape[1]
+    mask = (position_ids[:, None, :, None] >= position_ids[:, None, None, :])
+    mask = jnp.logical_and(mask, causal_mask(s, s)[None, None])
+    if args.sliding_window is not None:
+        kv_pos = position_ids[:, None, None, :]
+        q_pos = position_ids[:, None, :, None]
+        mask = jnp.logical_and(mask, kv_pos > q_pos - args.sliding_window)
+
+    h, cache = _run_stack(params, args, h, cos, sin, mask, cache,
+                          positions=None, decode_bucket=None, mesh=mesh, rules=rules)
+    h = rms_norm(h, params["final_norm"], args.rms_norm_eps)
+    h_last = jnp.take_along_axis(h, last_token_idx[:, None, None], axis=1)[:, 0]
+    logits = _lm_head(params, args, h_last, mesh, rules)
+    return logits, cache
+
+
+def decode_forward(
+    params: Params,
+    args: ModelArchArgs,
+    input_ids: jnp.ndarray,      # (B, T) int32 (T = 1, or speculation width)
+    position_ids: jnp.ndarray,   # (B,) int32 position of input_ids[:, 0]
+    cache: kvcache.KVCache,      # donated
+    decode_bucket: int,          # static: cache slice width for this compiled graph
+    mesh=None,
+    rules=None,
+) -> Tuple[jnp.ndarray, kvcache.KVCache]:
+    """Token generation: returns (logits (B, T, V) fp32, updated cache)."""
+    b, t = input_ids.shape
+    h = _embed(params, args, input_ids, mesh, rules)
+    pos_grid = position_ids[:, None] + jnp.arange(t)[None, :]      # (B, T)
+    cos, sin = rope_ops.compute_cos_sin(params["rope_inv_freq"], pos_grid,
+                                        args.rope_attention_scaling)
+    kv_pos = jnp.arange(decode_bucket)[None, None, None, :]
+    q_pos = pos_grid[:, None, :, None]
+    mask = kv_pos <= q_pos                                         # (B, 1, T, bucket)
+    if args.sliding_window is not None:
+        mask = jnp.logical_and(mask, kv_pos > q_pos - args.sliding_window)
+
+    h, cache = _run_stack(params, args, h, cos, sin, mask, cache,
+                          positions=position_ids, decode_bucket=decode_bucket,
+                          mesh=mesh, rules=rules)
+    h = rms_norm(h, params["final_norm"], args.rms_norm_eps)
+    logits = _lm_head(params, args, h, mesh, rules)
+    return logits, cache

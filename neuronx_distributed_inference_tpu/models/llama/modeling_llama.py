@@ -1,0 +1,122 @@
+"""Llama model family (Llama 2 / 3 / 3.1 / 3.2).
+
+≈ reference `models/llama/modeling_llama.py` (`NeuronLlamaForCausalLM`,
+`convert_hf_to_neuron_state_dict` :1454-1524). TPU design: the compute graph is the
+shared functional core in `models/base.py`; this module contributes (a) the architecture
+args derived from the HF config (including Llama-3.1 scaled RoPE), and (b) the HF →
+stacked-pytree weight conversion (with GQA kv-head replication when tp demands it).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from ...config import InferenceConfig
+from ...modules import gqa
+from ...ops import rope as rope_ops
+from ..base import ModelArchArgs
+from ...runtime.application import TpuModelForCausalLM
+
+
+class LlamaInferenceConfig(InferenceConfig):
+    REQUIRED_ATTRIBUTES = (
+        "hidden_size", "num_attention_heads", "num_hidden_layers",
+        "num_key_value_heads", "vocab_size", "intermediate_size",
+    )
+
+    def add_derived_config(self) -> None:
+        if not hasattr(self, "head_dim") or self.head_dim is None:
+            self.head_dim = self.hidden_size // self.num_attention_heads
+        for attr, default in (("rms_norm_eps", 1e-5), ("rope_theta", 10000.0),
+                              ("rope_scaling", None), ("tie_word_embeddings", False),
+                              ("attention_bias", False), ("hidden_act", "silu")):
+            if not hasattr(self, attr):
+                setattr(self, attr, default)
+
+
+class LlamaForCausalLM(TpuModelForCausalLM):
+    """≈ NeuronLlamaForCausalLM."""
+
+    @classmethod
+    def get_config_cls(cls):
+        return LlamaInferenceConfig
+
+    @classmethod
+    def arch_args_from_config(cls, config: LlamaInferenceConfig) -> ModelArchArgs:
+        tp = config.tpu_config.tp_degree
+        return ModelArchArgs(
+            vocab_size=config.vocab_size,
+            hidden_size=config.hidden_size,
+            num_layers=config.num_hidden_layers,
+            num_heads=config.num_attention_heads,
+            num_kv_heads=gqa.effective_kv_heads(tp, config.num_key_value_heads),
+            head_dim=config.head_dim,
+            intermediate_size=config.intermediate_size,
+            rms_norm_eps=config.rms_norm_eps,
+            activation=config.hidden_act,
+            attention_bias=config.attention_bias,
+            tie_word_embeddings=config.tie_word_embeddings,
+        )
+
+    @classmethod
+    def inv_freq_from_config(cls, config: LlamaInferenceConfig) -> np.ndarray:
+        return rope_ops.inv_freq_from_hf_config(
+            config.head_dim, config.rope_theta, config.rope_scaling)
+
+    @classmethod
+    def convert_hf_state_dict(cls, state_dict: Dict[str, np.ndarray],
+                              config: LlamaInferenceConfig) -> Dict:
+        """HF checkpoint names -> stacked functional pytree (numpy, host-side).
+
+        ≈ `convert_hf_to_neuron_state_dict` (`modeling_llama.py:1454-1524`); weights are
+        transposed to (in, out) and kv projections replicated per the GQA strategy.
+        """
+        L = config.num_hidden_layers
+        tp = config.tpu_config.tp_degree
+        n_kv = config.num_key_value_heads
+        d = config.head_dim
+        factor = gqa.replication_factor(tp, n_kv)
+
+        def get(name):
+            if name not in state_dict:
+                raise KeyError(f"missing weight {name}")
+            return state_dict[name]
+
+        def linear_t(name):
+            return np.ascontiguousarray(get(name).T)
+
+        layers = {"ln1": [], "wq": [], "wk": [], "wv": [], "wo": [],
+                  "ln2": [], "wg": [], "wu": [], "wd": []}
+        if config.attention_bias:
+            layers.update({"bq": [], "bk": [], "bv": []})
+        for i in range(L):
+            p = f"model.layers.{i}."
+            layers["ln1"].append(get(p + "input_layernorm.weight"))
+            layers["wq"].append(linear_t(p + "self_attn.q_proj.weight"))
+            layers["wk"].append(gqa.replicate_kv_weight(
+                linear_t(p + "self_attn.k_proj.weight"), n_kv, d, factor))
+            layers["wv"].append(gqa.replicate_kv_weight(
+                linear_t(p + "self_attn.v_proj.weight"), n_kv, d, factor))
+            layers["wo"].append(linear_t(p + "self_attn.o_proj.weight"))
+            layers["ln2"].append(get(p + "post_attention_layernorm.weight"))
+            layers["wg"].append(linear_t(p + "mlp.gate_proj.weight"))
+            layers["wu"].append(linear_t(p + "mlp.up_proj.weight"))
+            layers["wd"].append(linear_t(p + "mlp.down_proj.weight"))
+            if config.attention_bias:
+                layers["bq"].append(get(p + "self_attn.q_proj.bias"))
+                layers["bk"].append(gqa.replicate_kv_bias(
+                    get(p + "self_attn.k_proj.bias"), n_kv, d, factor))
+                layers["bv"].append(gqa.replicate_kv_bias(
+                    get(p + "self_attn.v_proj.bias"), n_kv, d, factor))
+
+        params = {
+            "embed": get("model.embed_tokens.weight"),
+            "layers": {k: np.stack(v) for k, v in layers.items()},
+            "final_norm": get("model.norm.weight"),
+            "rope_inv_freq": cls.inv_freq_from_config(config),
+        }
+        if not config.tie_word_embeddings:
+            params["lm_head"] = np.ascontiguousarray(get("lm_head.weight").T)
+        return params

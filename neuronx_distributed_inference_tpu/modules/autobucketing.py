@@ -1,0 +1,70 @@
+"""Bucket-ladder generation and selection.
+
+≈ reference `modules/autobucketing.py` (`generate_buckets` :8, `generate_buckets_for_cte`
+:149, `for_tkg` :226, 2D ladders :22-63). On TPU a "bucket" is simply a static shape that
+`jax.jit` compiles once and caches; the host wrapper pads inputs up to the chosen bucket
+(first-fit), exactly like the reference's NEFF-per-bucket selection
+(`models/model_wrapper.py:826-916`).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Sequence, Tuple
+
+
+def powers_of_two_ladder(min_len: int, max_len: int) -> List[int]:
+    """[min, 2*min, ..., max]; max is always included even if not a power-of-two step."""
+    if min_len < 1 or max_len < min_len:
+        raise ValueError(f"bad ladder bounds ({min_len}, {max_len})")
+    out = []
+    v = 1 << max(0, math.ceil(math.log2(min_len)))
+    while v < max_len:
+        out.append(v)
+        v *= 2
+    out.append(max_len)
+    return out
+
+
+def generate_buckets_for_cte(tpu_config) -> List[int]:
+    """Context-encoding (prefill) sequence buckets (≈ :149)."""
+    if not tpu_config.enable_bucketing:
+        return [tpu_config.max_context_length]
+    if tpu_config.context_encoding_buckets:
+        return list(tpu_config.context_encoding_buckets)
+    return powers_of_two_ladder(min(128, tpu_config.max_context_length),
+                                tpu_config.max_context_length)
+
+
+def generate_buckets_for_tkg(tpu_config) -> List[int]:
+    """Token-generation buckets over *total* sequence length (cache width) (≈ :226)."""
+    if not tpu_config.enable_bucketing:
+        return [tpu_config.seq_len]
+    if tpu_config.token_generation_buckets:
+        return list(tpu_config.token_generation_buckets)
+    return powers_of_two_ladder(min(128, tpu_config.seq_len), tpu_config.seq_len)
+
+
+def generate_batch_buckets(tpu_config) -> List[int]:
+    """Batch-dim buckets for continuous batching (≈ 2D batch x seq bucketing :22-63)."""
+    if not tpu_config.enable_bucketing or not tpu_config.is_continuous_batching:
+        return [tpu_config.max_batch_size]
+    if tpu_config.batch_buckets:
+        return list(tpu_config.batch_buckets)
+    return powers_of_two_ladder(1, tpu_config.max_batch_size)
+
+
+def select_bucket(buckets: Sequence[int], length: int) -> int:
+    """First-fit bucket selection (≈ `model_wrapper.py:826-916`)."""
+    for b in buckets:
+        if length <= b:
+            return b
+    raise ValueError(f"length {length} exceeds largest bucket {buckets[-1]}")
+
+
+def select_bucket_2d(prefill_buckets: Sequence[int], prefix_buckets: Sequence[int],
+                     prefill_len: int, prefix_len: int) -> Tuple[int, int]:
+    """2D (prefill x prefix) bucket pick for prefix caching (≈ :918-1142 of the
+    wrapper's 2D logic, simplified to independent first-fit per dim)."""
+    return select_bucket(prefill_buckets, prefill_len), select_bucket(prefix_buckets,
+                                                                      prefix_len)

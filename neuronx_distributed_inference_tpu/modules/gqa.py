@@ -1,0 +1,59 @@
+"""GQA head-sharding strategies.
+
+≈ reference `modules/attention/gqa.py` (`determine_sharding_strategy` :89,
+`get_shardable_head_counts` :105, replicate/pad helpers :164-271). On TPU the only case
+needing weight surgery is kv-head replication when tp_degree exceeds (or doesn't divide)
+the kv-head count: kv heads are repeat-interleaved at conversion time so the ``kv_heads``
+axis shards evenly; query heads keep their order because consecutive q-head groups map to
+consecutive replicated kv heads.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Tuple
+
+import numpy as np
+
+
+class GQASharding(enum.Enum):
+    NATIVE = "native"                       # kv_heads % tp == 0, no surgery
+    REPLICATE = "replicate-to-tp-degree"    # repeat kv heads so tp divides the count
+
+
+def determine_sharding_strategy(tp_degree: int, num_kv_heads: int) -> GQASharding:
+    if num_kv_heads % tp_degree == 0:
+        return GQASharding.NATIVE
+    if tp_degree % num_kv_heads == 0:
+        return GQASharding.REPLICATE
+    raise ValueError(
+        f"kv_heads={num_kv_heads} and tp={tp_degree} are incompatible: one must divide "
+        f"the other (reference supports the same constraint via pad/replicate)")
+
+
+def replication_factor(tp_degree: int, num_kv_heads: int) -> int:
+    strategy = determine_sharding_strategy(tp_degree, num_kv_heads)
+    return tp_degree // num_kv_heads if strategy is GQASharding.REPLICATE else 1
+
+
+def replicate_kv_weight(w: np.ndarray, num_kv_heads: int, head_dim: int,
+                        factor: int) -> np.ndarray:
+    """Repeat-interleave kv heads in a (hidden, kv_heads*head_dim) projection weight."""
+    if factor == 1:
+        return w
+    hidden = w.shape[0]
+    w = w.reshape(hidden, num_kv_heads, head_dim)
+    w = np.repeat(w, factor, axis=1)
+    return w.reshape(hidden, num_kv_heads * factor * head_dim)
+
+
+def replicate_kv_bias(b: np.ndarray, num_kv_heads: int, head_dim: int,
+                      factor: int) -> np.ndarray:
+    if factor == 1:
+        return b
+    b = b.reshape(num_kv_heads, head_dim)
+    return np.repeat(b, factor, axis=0).reshape(-1)
+
+
+def effective_kv_heads(tp_degree: int, num_kv_heads: int) -> int:
+    return num_kv_heads * replication_factor(tp_degree, num_kv_heads)

@@ -1,0 +1,103 @@
+"""Dense KV cache: allocation, bucketed reads, prefill/decode writes.
+
+≈ reference `modules/kvcache/kv_cache_manager.py` (`KVCacheManager` :107, `_init_kv_shape`
+:195-237, `get_cache` :349-372, `update_kv_by_layer_id` :436-592). TPU redesign:
+
+- The cache is a plain pytree ``{"k": (L, B, H_kv, S_max, D), "v": ...}`` of `jax.Array`s
+  *donated* into every jitted step — JAX buffer donation replaces the reference's
+  TorchScript input/output aliasing (`models/model_wrapper.py:1571-1612`); decode steps
+  mutate cache memory in place on device.
+- Layer-stacked layout (leading L dim) so the model's `lax.scan` over layers carries one
+  cache slice per step and re-stacks updates for free.
+- "Bucketed read": decode compiles one graph per token-generation bucket; the graph
+  statically slices ``cache[..., :bucket, :]`` so short sequences pay attention cost
+  proportional to their bucket, exactly like the reference's bucket-sliced `get_cache`.
+- Continuous batching writes scatter each sequence at its own position via a vmapped
+  `dynamic_update_slice` (the TPU analog of the reference's per-seq-id scatter,
+  `kv_cache_manager.py:493-497`).
+
+Sharding (see parallel/sharding.py): heads on tp, batch on dp — matching the
+reference's (B, H/tp, S, D) per-core layout (`kv_cache_manager.py:195-237`).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+KVCache = Dict[str, jnp.ndarray]
+
+# logical axes for sharding the stacked cache
+CACHE_LOGICAL = ("layers", "batch", "kv_heads", "kv_seq", None)
+
+
+@dataclass(frozen=True)
+class KVCacheSpec:
+    num_layers: int
+    batch_size: int
+    num_kv_heads: int
+    max_seq_len: int
+    head_dim: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @property
+    def shape(self) -> Tuple[int, int, int, int, int]:
+        return (self.num_layers, self.batch_size, self.num_kv_heads,
+                self.max_seq_len, self.head_dim)
+
+
+def init_cache(spec: KVCacheSpec) -> KVCache:
+    return {
+        "k": jnp.zeros(spec.shape, dtype=spec.dtype),
+        "v": jnp.zeros(spec.shape, dtype=spec.dtype),
+    }
+
+
+def cache_bytes(spec: KVCacheSpec) -> int:
+    import numpy as np
+
+    return 2 * int(np.prod(spec.shape)) * jnp.dtype(spec.dtype).itemsize
+
+
+def read_bucket(cache_layer: jnp.ndarray, bucket: int) -> jnp.ndarray:
+    """Static slice of the seq dim: (B, H, S_max, D) -> (B, H, bucket, D).
+
+    ``bucket`` must be a Python int (static per compiled graph), ≈ the reference's
+    bucket-sliced `get_cache` (`kv_cache_manager.py:349-372`).
+    """
+    return jax.lax.slice_in_dim(cache_layer, 0, bucket, axis=2)
+
+
+def write_prefill(cache_layer: jnp.ndarray, new_kv: jnp.ndarray,
+                  start: int = 0) -> jnp.ndarray:
+    """Write (B, H, S_new, D) into the cache at [start, start+S_new) along seq.
+
+    ≈ `fill_prefix` CTE write. ``start`` may be traced (chunked prefill resumes mid-way).
+    """
+    return jax.lax.dynamic_update_slice(
+        cache_layer, new_kv.astype(cache_layer.dtype), (0, 0, start, 0))
+
+
+def write_decode(cache_layer: jnp.ndarray, new_kv: jnp.ndarray,
+                 positions: jnp.ndarray) -> jnp.ndarray:
+    """Scatter (B, H, T, D) new tokens at per-sequence positions (B,) int32.
+
+    Each batch row b writes its T tokens at [positions[b], positions[b]+T) — positions
+    differ across rows under continuous batching (≈ scatter at position_ids,
+    `kv_cache_manager.py:436-592`).
+    """
+    def _one(row_cache, row_new, pos):
+        # row_cache (H, S, D), row_new (H, T, D)
+        return jax.lax.dynamic_update_slice(
+            row_cache, row_new.astype(row_cache.dtype), (0, pos, 0))
+
+    return jax.vmap(_one)(cache_layer, new_kv, positions)
+
+
+def batched_gather(cache: KVCache, seq_ids: jnp.ndarray) -> KVCache:
+    """Reorder the batch dim by seq_ids (continuous batching batch remap,
+    ≈ `model_wrapper.py:569-698` batch sorting)."""
+    return {k: jnp.take(v, seq_ids, axis=1) for k, v in cache.items()}

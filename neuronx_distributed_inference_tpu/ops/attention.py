@@ -1,0 +1,84 @@
+"""Attention reference ops (jnp; XLA-fused).
+
+≈ reference `modules/attention/attention_base.py` native paths: GQA scaled-dot-product
+with fp32 softmax, causal/padded masks, and the decode-time attention over a bucketed KV
+cache (the reference's prior/active softmax decomposition, `utils.py:252
+manual_softmax`, collapses on TPU to one masked softmax over the cache slice — XLA fuses
+it; a Pallas decode kernel replaces this on the hot path when profiling warrants).
+
+Shapes follow the JAX convention (B, heads, S, D). Pallas flash-attention kernels for
+the prefill hot path live in `ops/flash_attention.py`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+NEG_INF = -30000.0  # finite mask value, like the reference's -30k to avoid NaN rows
+
+
+def repeat_kv(x: jnp.ndarray, n_rep: int) -> jnp.ndarray:
+    """(B, n_kv, S, D) -> (B, n_kv * n_rep, S, D), GQA head replication."""
+    if n_rep == 1:
+        return x
+    b, n_kv, s, d = x.shape
+    x = jnp.broadcast_to(x[:, :, None], (b, n_kv, n_rep, s, d))
+    return x.reshape(b, n_kv * n_rep, s, d)
+
+
+def causal_mask(q_len: int, kv_len: int, q_offset=0) -> jnp.ndarray:
+    """Boolean (q_len, kv_len) mask; True = attend. ``q_offset`` is the absolute
+    position of query row 0 (scalar or traced), for decode/chunked prefill."""
+    q_pos = jnp.arange(q_len)[:, None] + q_offset
+    kv_pos = jnp.arange(kv_len)[None, :]
+    return kv_pos <= q_pos
+
+
+def sliding_window_mask(q_len: int, kv_len: int, window: int, q_offset=0) -> jnp.ndarray:
+    """Causal AND within-window mask (≈ SWA masks, `models/model_base.py:287-363`)."""
+    q_pos = jnp.arange(q_len)[:, None] + q_offset
+    kv_pos = jnp.arange(kv_len)[None, :]
+    return (kv_pos <= q_pos) & (kv_pos > q_pos - window)
+
+
+def attend(
+    q: jnp.ndarray,            # (B, n_q, S_q, D)
+    k: jnp.ndarray,            # (B, n_kv, S_kv, D)
+    v: jnp.ndarray,            # (B, n_kv, S_kv, D)
+    mask: Optional[jnp.ndarray] = None,   # broadcastable to (B, n_q, S_q, S_kv); True=keep
+    scale: Optional[float] = None,
+    logits_soft_cap: Optional[float] = None,
+    sinks: Optional[jnp.ndarray] = None,  # (n_q,) learned attention sinks (gpt-oss style)
+) -> jnp.ndarray:
+    """Masked GQA attention, softmax in fp32. Returns (B, n_q, S_q, D) in q.dtype."""
+    n_q, n_kv = q.shape[1], k.shape[1]
+    if n_q % n_kv != 0:
+        raise ValueError(f"n_q {n_q} not divisible by n_kv {n_kv}")
+    k = repeat_kv(k, n_q // n_kv)
+    v = repeat_kv(v, n_q // n_kv)
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if logits_soft_cap is not None:
+        scores = logits_soft_cap * jnp.tanh(scores / logits_soft_cap)
+    if mask is not None:
+        scores = jnp.where(mask, scores, NEG_INF)
+
+    if sinks is not None:
+        # learned sink logit per head participates in the softmax denominator only
+        sink = jnp.broadcast_to(sinks.astype(jnp.float32)[None, :, None, None],
+                                scores.shape[:3] + (1,))
+        scores = jnp.concatenate([scores, sink], axis=-1)
+        probs = jnp.exp(scores - jnp.max(scores, axis=-1, keepdims=True))
+        probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
+        probs = probs[..., :-1]
+    else:
+        probs = jnp.exp(scores - jnp.max(scores, axis=-1, keepdims=True))
+        probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
+
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(q.dtype), v)
+    return out

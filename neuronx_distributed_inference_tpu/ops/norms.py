@@ -1,0 +1,37 @@
+"""Normalization ops.
+
+≈ reference `modules/custom_calls.py` (CustomRMSNorm XLA custom op :15-45, NKI rmsnorm
+kernel :61-87). On TPU a plain jnp RMSNorm fuses into neighbouring ops under XLA, so no
+custom kernel is needed for the norm alone; fused norm+matmul Pallas kernels live in
+ops/ when profiling justifies them.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-6,
+             zero_centered: bool = False) -> jnp.ndarray:
+    """RMSNorm computed in float32, cast back to x.dtype.
+
+    ``zero_centered`` supports Gemma-style (1 + weight) scaling.
+    """
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    normed = x32 * jnp.reciprocal(jnp.sqrt(var + eps))
+    w = weight.astype(jnp.float32)
+    if zero_centered:
+        w = 1.0 + w
+    return (normed * w).astype(dtype)
+
+
+def layer_norm(x: jnp.ndarray, weight: jnp.ndarray, bias: jnp.ndarray,
+               eps: float = 1e-5) -> jnp.ndarray:
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    normed = (x32 - mean) * jnp.reciprocal(jnp.sqrt(var + eps))
+    return (normed * weight.astype(jnp.float32) + bias.astype(jnp.float32)).astype(dtype)

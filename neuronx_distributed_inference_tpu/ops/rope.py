@@ -1,0 +1,94 @@
+"""Rotary position embeddings.
+
+≈ reference RoPE classes in `modules/attention/utils.py:200-` (default RotaryEmbedding
+and Llama3 scaled variant used by `models/llama/modeling_llama.py`). Functional: the
+inverse-frequency vector is precomputed host-side (numpy) and carried in the param
+pytree; cos/sin are computed inside the jitted graph from position ids, so one compiled
+graph serves every position without a (seq_len, dim) table in HBM.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def default_inv_freq(head_dim: int, rope_theta: float = 10000.0) -> np.ndarray:
+    return 1.0 / (rope_theta ** (np.arange(0, head_dim, 2, dtype=np.float64)
+                                 / head_dim)).astype(np.float32)
+
+
+def llama3_scaled_inv_freq(
+    head_dim: int,
+    rope_theta: float,
+    factor: float = 8.0,
+    low_freq_factor: float = 1.0,
+    high_freq_factor: float = 4.0,
+    original_max_position_embeddings: int = 8192,
+) -> np.ndarray:
+    """Llama-3.1 frequency-dependent NTK scaling (matches HF `rope_type: llama3`)."""
+    inv_freq = default_inv_freq(head_dim, rope_theta).astype(np.float64)
+    low_freq_wavelen = original_max_position_embeddings / low_freq_factor
+    high_freq_wavelen = original_max_position_embeddings / high_freq_factor
+    wavelen = 2 * math.pi / inv_freq
+    scaled = np.where(wavelen > low_freq_wavelen, inv_freq / factor, inv_freq)
+    smooth = (original_max_position_embeddings / wavelen - low_freq_factor) / (
+        high_freq_factor - low_freq_factor)
+    smoothed = (1 - smooth) / factor * inv_freq + smooth * inv_freq
+    is_medium = (wavelen <= low_freq_wavelen) & (wavelen >= high_freq_wavelen)
+    return np.where(is_medium, smoothed, scaled).astype(np.float32)
+
+
+def inv_freq_from_hf_config(head_dim: int, rope_theta: float, rope_scaling) -> np.ndarray:
+    """Build inv_freq from HF config fields (``rope_scaling`` dict or None)."""
+    if rope_scaling is None:
+        return default_inv_freq(head_dim, rope_theta)
+    rtype = rope_scaling.get("rope_type", rope_scaling.get("type", "default"))
+    if rtype == "default":
+        return default_inv_freq(head_dim, rope_theta)
+    if rtype == "llama3":
+        return llama3_scaled_inv_freq(
+            head_dim,
+            rope_theta,
+            factor=rope_scaling.get("factor", 8.0),
+            low_freq_factor=rope_scaling.get("low_freq_factor", 1.0),
+            high_freq_factor=rope_scaling.get("high_freq_factor", 4.0),
+            original_max_position_embeddings=rope_scaling.get(
+                "original_max_position_embeddings", 8192),
+        )
+    if rtype == "linear":
+        return default_inv_freq(head_dim, rope_theta) / rope_scaling.get("factor", 1.0)
+    raise NotImplementedError(f"rope_type {rtype!r} not supported yet")
+
+
+def compute_cos_sin(inv_freq: jnp.ndarray, position_ids: jnp.ndarray,
+                    attention_scaling: float = 1.0):
+    """cos/sin of shape (..., seq, head_dim) from positions (..., seq).
+
+    Matches HF layout: freqs duplicated along the last dim (concat, not interleave).
+    """
+    freqs = position_ids[..., None].astype(jnp.float32) * inv_freq  # (..., S, D/2)
+    emb = jnp.concatenate([freqs, freqs], axis=-1)
+    return (jnp.cos(emb) * attention_scaling, jnp.sin(emb) * attention_scaling)
+
+
+def rotate_half(x: jnp.ndarray) -> jnp.ndarray:
+    half = x.shape[-1] // 2
+    return jnp.concatenate([-x[..., half:], x[..., :half]], axis=-1)
+
+
+def apply_rotary(q: jnp.ndarray, k: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray):
+    """Apply RoPE to q/k of shape (B, heads, S, D); cos/sin (B, S, D).
+
+    Computed in float32 and cast back to the input dtype, like the reference's
+    rotary application under `attention_base.py`.
+    """
+    cos = cos[:, None, :, :]
+    sin = sin[:, None, :, :]
+    out_dtype = q.dtype
+    q32, k32 = q.astype(jnp.float32), k.astype(jnp.float32)
+    q_rot = q32 * cos + rotate_half(q32) * sin
+    k_rot = k32 * cos + rotate_half(k32) * sin
+    return q_rot.astype(out_dtype), k_rot.astype(out_dtype)

@@ -1,0 +1,92 @@
+"""On-device sampling: greedy / top-k / top-p / temperature with per-request params.
+
+≈ reference `modules/generation/sampling.py` (`Sampler.forward` :437-468, `_top_k_masked`
+:303, `prepare/validate_sampling_params` :99-209). Design notes:
+
+- ``sampling_params`` is the reference's (B, 3) tensor [top_k, top_p, temperature]; each
+  request can use different values ("dynamic" sampling).
+- Like the reference, a *global* top-k prefilter (default 256, `global_topk`) bounds the
+  sort/cumsum cost to a constant width regardless of vocab size. Under a vocab-sharded
+  lm_head, `lax.top_k` over the sharded axis lets GSPMD do a per-shard top-k + gather
+  (the analog of the reference's staged `nxd_topk` collective, `sampling.py:303-328`).
+- Multinomial draws use Gumbel noise over the masked log-probs (TPU-friendly: no cumsum
+  search); deterministic mode threads a fixed key.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..config import OnDeviceSamplingConfig
+
+NEG_INF = -1e30
+
+
+def prepare_sampling_params(batch_size: int, top_k=1, top_p=1.0, temperature=1.0):
+    """Host-side helper: broadcast scalars/lists to a (B, 3) float32 array
+    (≈ `sampling.py:99-150`)."""
+    import numpy as np
+
+    def _col(v):
+        arr = np.asarray(v, dtype=np.float32).reshape(-1)
+        if arr.size == 1:
+            arr = np.full((batch_size,), arr[0], dtype=np.float32)
+        if arr.shape != (batch_size,):
+            raise ValueError(f"sampling param shape {arr.shape} != ({batch_size},)")
+        return arr
+
+    return np.stack([_col(top_k), _col(top_p), _col(temperature)], axis=1)
+
+
+def sample(
+    logits: jnp.ndarray,                  # (B, V) any float dtype
+    sampling_params: jnp.ndarray,         # (B, 3) [top_k, top_p, temperature]
+    key: Optional[jax.Array],
+    config: OnDeviceSamplingConfig,
+) -> jnp.ndarray:
+    """Return sampled token ids (B,) int32, entirely on device."""
+    logits = logits.astype(jnp.float32)
+    batch, vocab = logits.shape
+
+    if not config.do_sample and not config.dynamic:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    k_width = min(config.global_topk, vocab)
+    top_vals, top_idx = jax.lax.top_k(logits, k_width)   # (B, K) desc order
+
+    top_k = sampling_params[:, 0:1]                      # (B, 1) float
+    top_p = sampling_params[:, 1:2]
+    temperature = jnp.maximum(sampling_params[:, 2:3], 1e-6)
+
+    ranks = jnp.arange(k_width, dtype=jnp.float32)[None, :]
+    # top_k <= 0 means "all" (within the global prefilter window)
+    k_eff = jnp.where(top_k <= 0, float(k_width), top_k)
+    topk_mask = ranks < k_eff                            # (B, K)
+
+    scaled = top_vals / temperature
+    scaled = jnp.where(topk_mask, scaled, NEG_INF)
+
+    # top-p (nucleus): keep the smallest prefix whose prob mass >= top_p; the first
+    # token always survives (cumsum - p_i < top_p for i=0).
+    probs = jax.nn.softmax(scaled, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    topp_mask = (cum - probs) < top_p
+    masked = jnp.where(topp_mask, scaled, NEG_INF)
+
+    greedy_choice = jnp.zeros((batch,), dtype=jnp.int32)  # index 0 = argmax in sorted order
+    if key is None:
+        choice = greedy_choice
+    else:
+        gumbel = jax.random.gumbel(key, masked.shape, dtype=jnp.float32)
+        sampled_choice = jnp.argmax(masked + gumbel, axis=-1).astype(jnp.int32)
+        # greedy requests (top_k == 1) stay exact argmax regardless of noise
+        choice = jnp.where(top_k[:, 0] == 1, greedy_choice, sampled_choice)
+
+    return jnp.take_along_axis(top_idx, choice[:, None], axis=-1)[:, 0].astype(jnp.int32)
+
+
+def greedy(logits: jnp.ndarray) -> jnp.ndarray:
+    return jnp.argmax(logits.astype(jnp.float32), axis=-1).astype(jnp.int32)

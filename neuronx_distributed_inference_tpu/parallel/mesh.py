@@ -1,0 +1,90 @@
+"""Device mesh construction and axis conventions.
+
+Replaces the reference's `neuronx_distributed.parallel_state` world/group management
+(`models/model_base.py:161-166`, `modules/attention/attention_process_groups.py`) with a
+single `jax.sharding.Mesh` carrying named axes. Collectives are never issued against
+explicit process groups: shardings over these axes let XLA GSPMD place
+all-reduce/all-gather/reduce-scatter on ICI/DCN.
+
+Axis conventions — all four axes are always present (size 1 when unused) so sharding
+specs are stable across configurations; ``world = dp * cp * tp * ep``:
+
+- ``dp``: data parallel over batch (≈ attention DP groups,
+  `attention_process_groups.py:125-163`).
+- ``cp``: context parallel over sequence (≈ CP groups, `attention_process_groups.py:47-123`).
+- ``tp``: tensor parallel over heads / hidden / vocab (≈ tp_degree SPMD trace).
+- ``ep``: expert parallel over MoE experts (≈ `modules/moe_v2.py:135`).
+
+Unlike the reference (where cp divides tp and world = tp*pp*ep,
+`models/config.py:370-383`), axes here are orthogonal: dense layers shard their model
+dimension over the *combined* model axes ``(cp, tp, ep)`` (see sharding.MODEL_AXES), so a
+pure-TP config and a TP×CP config use the same parameter specs. Attention shards heads
+over ``tp``(+``ep``) and sequence over ``cp``; MoE shards experts over ``ep``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXIS_DP = "dp"
+AXIS_CP = "cp"
+AXIS_TP = "tp"
+AXIS_EP = "ep"
+MESH_AXES = (AXIS_DP, AXIS_CP, AXIS_TP, AXIS_EP)
+
+# Combined "model" axes: dense weight shards span all of these (size-1 axes are no-ops).
+MODEL_AXES = (AXIS_CP, AXIS_TP, AXIS_EP)
+
+
+def build_mesh(
+    tp_degree: int = 1,
+    dp_degree: int = 1,
+    cp_degree: int = 1,
+    ep_degree: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a (dp, cp, tp, ep) mesh; requires dp*cp*tp*ep devices.
+
+    Device order: ep fastest, then tp, then cp, then dp — so tp neighbours are adjacent
+    in the device list (on real hardware, adjacent along ICI), keeping the
+    latency-critical per-layer all-reduces on the tightest links.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = np.asarray(devices)
+    n_needed = dp_degree * cp_degree * tp_degree * ep_degree
+    if devices.size < n_needed:
+        raise ValueError(
+            f"need {n_needed} devices for dp={dp_degree} cp={cp_degree} "
+            f"tp={tp_degree} ep={ep_degree}, have {devices.size}"
+        )
+    grid = devices[:n_needed].reshape(dp_degree, cp_degree, tp_degree, ep_degree)
+    return Mesh(grid, MESH_AXES)
+
+
+def mesh_from_config(tpu_config, devices=None) -> Mesh:
+    return build_mesh(
+        tp_degree=tpu_config.tp_degree,
+        dp_degree=tpu_config.dp_degree,
+        cp_degree=tpu_config.cp_degree,
+        ep_degree=tpu_config.ep_degree,
+        devices=devices,
+    )
+
+
+def single_device_mesh(device: Optional[jax.Device] = None) -> Mesh:
+    dev = device if device is not None else jax.devices()[0]
+    return Mesh(np.asarray([dev]).reshape(1, 1, 1, 1), MESH_AXES)
+
+
+def mesh_axis_size(mesh: Mesh, axis: str) -> int:
+    return mesh.shape[axis]
+
+
+def model_parallel_size(mesh: Mesh) -> int:
+    """Total model-parallel width (cp*tp*ep) — the divisor for hidden-dim sharding."""
+    return mesh.shape[AXIS_CP] * mesh.shape[AXIS_TP] * mesh.shape[AXIS_EP]

@@ -1,0 +1,106 @@
+"""Logical-axis sharding rules.
+
+Replaces the reference's parallel-layer library (ColumnParallelLinear /
+RowParallelLinear / ParallelEmbedding from `neuronx_distributed`, used throughout
+`modules/attention/attention_base.py:210-218`, `modules/attention/gqa.py:375`) with the
+idiomatic JAX mechanism: every parameter and activation is annotated with *logical* axis
+names; a rule table maps logical axes to mesh axes; `NamedSharding`s are derived from the
+rules and handed to `jax.jit` / `jax.lax.with_sharding_constraint`. XLA GSPMD then
+inserts the same collectives the reference's parallel layers issue explicitly
+(all-reduce after row-parallel matmul, all-gather for sequence parallel, ...).
+
+Logical axes used by the model code:
+
+- ``vocab``     : embedding/lm_head vocab dim (sharded on tp — ≈ vocab_parallel,
+                  `models/config.py:142`)
+- ``embed``     : model hidden dim (replicated for weights whose other dim is sharded)
+- ``heads``     : attention query-head dim (column-parallel q/o, `attention_base.py:210`)
+- ``kv_heads``  : attention kv-head dim (GQA; may be replicated when heads < tp,
+                  ≈ `modules/attention/gqa.py:89-271`)
+- ``mlp``       : MLP intermediate dim (column-parallel gate/up, row-parallel down)
+- ``experts``   : MoE expert dim (expert parallel)
+- ``batch``     : batch dim of activations and KV caches (dp)
+- ``seq``       : sequence dim of activations (cp; sp when enabled)
+- ``kv_seq``    : sequence dim of KV caches (cp for flash-decoding-style sharding)
+- ``act_embed`` : hidden dim of activations (only sharded under sequence-parallel-off
+                  tensor layouts; normally None)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple, Union
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import AXIS_CP, AXIS_DP, AXIS_EP, AXIS_TP
+
+MeshAxes = Union[None, str, Tuple[str, ...]]
+
+# Default rule table: logical axis -> mesh axis (or tuple, or None = replicated).
+DEFAULT_RULES: Dict[str, MeshAxes] = {
+    "vocab": AXIS_TP,
+    "embed": None,
+    "heads": (AXIS_TP, AXIS_EP),
+    "kv_heads": (AXIS_TP, AXIS_EP),
+    "mlp": (AXIS_CP, AXIS_TP, AXIS_EP),
+    "experts": AXIS_EP,
+    "expert_mlp": AXIS_TP,
+    "batch": AXIS_DP,
+    "seq": AXIS_CP,
+    "kv_seq": None,
+    "act_embed": None,
+    "layers": None,
+}
+
+
+def logical_to_spec(logical: Sequence[Optional[str]],
+                    rules: Optional[Dict[str, MeshAxes]] = None) -> P:
+    """Map a tuple of logical axis names (None = replicated dim) to a PartitionSpec."""
+    rules = rules or DEFAULT_RULES
+    out = []
+    for name in logical:
+        if name is None:
+            out.append(None)
+        else:
+            if name not in rules:
+                raise KeyError(f"no sharding rule for logical axis {name!r}")
+            out.append(rules[name])
+    return P(*out)
+
+
+def named_sharding(mesh: Mesh, logical: Sequence[Optional[str]],
+                   rules: Optional[Dict[str, MeshAxes]] = None) -> NamedSharding:
+    return NamedSharding(mesh, logical_to_spec(logical, rules))
+
+
+def tree_shardings(mesh: Mesh, logical_tree: Any,
+                   rules: Optional[Dict[str, MeshAxes]] = None) -> Any:
+    """Map a pytree of logical-axis tuples to a pytree of NamedShardings."""
+    return jax.tree.map(
+        lambda logical: named_sharding(mesh, logical, rules),
+        logical_tree,
+        is_leaf=lambda x: isinstance(x, tuple) and all(
+            isinstance(e, str) or e is None for e in x),
+    )
+
+
+def constrain(x: jax.Array, logical: Sequence[Optional[str]],
+              rules: Optional[Dict[str, MeshAxes]] = None,
+              mesh: Optional[Mesh] = None) -> jax.Array:
+    """`with_sharding_constraint` by logical axes.
+
+    Pass ``mesh`` explicitly (model code threads it through) so the constraint works
+    without an ambient mesh context; with mesh=None this is a no-op passthrough, which
+    keeps single-device code paths mesh-free.
+    """
+    if mesh is None:
+        return x
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, logical_to_spec(logical, rules)))
+
+
+def shard_put(x, mesh: Mesh, logical: Sequence[Optional[str]],
+              rules: Optional[Dict[str, MeshAxes]] = None) -> jax.Array:
+    """Device-put a host array with the sharding derived from logical axes."""
+    return jax.device_put(x, named_sharding(mesh, logical, rules))

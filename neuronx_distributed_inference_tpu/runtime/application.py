@@ -1,0 +1,371 @@
+"""Application lifecycle: model construction, weight loading, compiled-step management,
+and the generation loop.
+
+≈ reference `models/application_base.py` (`NeuronApplicationBase`: compile :292, load
+:317, warmup :348) + the CausalLM orchestration half of `models/model_base.py`
+(`NeuronBaseForCausalLM` :3066: sub-model dispatch :3594-3780, preprocess :3255). TPU
+redesign:
+
+- "compile" = construct jitted prefill/decode step functions; per-bucket compilation
+  happens lazily on first call (or eagerly via `warmup()`, ≈ `application_base.py:348`),
+  cached by XLA's jit cache keyed on (shape, static bucket).
+- "load" = read HF checkpoint, convert to the stacked pytree, `jax.device_put` with the
+  sharding derived from logical axis rules over the config's mesh.
+- The KV cache lives as a `jax.Array` pytree owned by the application and *donated*
+  through every step (≈ aliased graph I/O, `model_wrapper.py:1571-1612`).
+- Sampling runs inside the same jitted step (on-device sampling,
+  ≈ `model_base.py:1041` `_sample_on_device`).
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import InferenceConfig, OnDeviceSamplingConfig, TpuConfig
+from ..modules import autobucketing, kvcache
+from ..models import base as model_base
+from ..ops import sampling as sampling_ops
+from ..parallel import mesh as mesh_lib
+from ..parallel.sharding import named_sharding, shard_put, tree_shardings
+from ..utils import checkpoint as ckpt_lib
+from . import model_wrapper
+
+logger = logging.getLogger("tpu-inference")
+
+
+def _mask_after_eos(tokens: np.ndarray, eos_token_id: int, pad_token_id: int
+                    ) -> np.ndarray:
+    """Replace everything after each row's first EOS with pad (chunked decode generates
+    past EOS; the trim mirrors HF stopping-criteria semantics host-side)."""
+    tokens = tokens.copy()
+    hit = tokens == eos_token_id
+    seen = np.cumsum(hit, axis=1) - hit.astype(int)   # strictly-after-first-eos count
+    tokens[seen > 0] = pad_token_id
+    return tokens
+
+
+@dataclass
+class GenerateOutput:
+    sequences: np.ndarray            # (B, prompt + generated) int32, right-trimmed pads
+    tokens: np.ndarray               # (B, generated) int32
+    logits: Optional[List[np.ndarray]] = None  # per-step (B, V) fp32 when requested
+    ttft_s: Optional[float] = None
+    # per decode chunk: (wall seconds, tokens generated in the chunk)
+    decode_latencies_s: Optional[List[Tuple[float, int]]] = None
+
+
+class TpuModelForCausalLM:
+    """Base application class; model families subclass and provide arch args + weight
+    conversion (see models/llama)."""
+
+    def __init__(self, model_path: Optional[str], config: InferenceConfig,
+                 mesh: Optional[mesh_lib.Mesh] = None):
+        self.model_path = model_path
+        self.config = config
+        self.tpu_config: TpuConfig = config.tpu_config
+        self.arch_args = self.arch_args_from_config(config)
+        self.mesh = mesh if mesh is not None else mesh_lib.mesh_from_config(
+            self.tpu_config)
+        self.sampling_config = (self.tpu_config.on_device_sampling_config
+                                or OnDeviceSamplingConfig())
+
+        self.cte_buckets = autobucketing.generate_buckets_for_cte(self.tpu_config)
+        self.tkg_buckets = autobucketing.generate_buckets_for_tkg(self.tpu_config)
+
+        from ..parallel.sharding import DEFAULT_RULES
+
+        self.sharding_rules = dict(DEFAULT_RULES)
+        if not self.tpu_config.vocab_parallel:
+            self.sharding_rules["vocab"] = None
+
+        self.params = None
+        self.kv_cache = None
+        self._build_steps()
+
+    # --- per-arch hooks (≈ get_config_cls / convert_hf_to_neuron_state_dict) ---------
+    @classmethod
+    def get_config_cls(cls):
+        raise NotImplementedError
+
+    @classmethod
+    def arch_args_from_config(cls, config: InferenceConfig) -> model_base.ModelArchArgs:
+        raise NotImplementedError
+
+    @classmethod
+    def convert_hf_state_dict(cls, state_dict, config) -> Dict:
+        raise NotImplementedError
+
+    @classmethod
+    def inv_freq_from_config(cls, config) -> np.ndarray:
+        from ..ops import rope as rope_ops
+
+        return rope_ops.default_inv_freq(config.head_dim,
+                                         getattr(config, "rope_theta", 10000.0))
+
+    # --- forward cores (overridable by arch, e.g. MoE) -------------------------------
+    def prefill_fn(self):
+        return model_base.prefill_forward
+
+    def decode_fn(self):
+        return model_base.decode_forward
+
+    # --- step construction ------------------------------------------------------------
+    def _build_steps(self) -> None:
+        args = self.arch_args
+        mesh = self.mesh
+        odsc = self.sampling_config
+        prefill_core = self.prefill_fn()
+        decode_core = self.decode_fn()
+
+        # fp32 runs (accuracy harness) need true-fp32 matmuls; bf16 runs keep the fast
+        # default so the MXU runs native bf16
+        precision = "highest" if self.tpu_config.dtype == "float32" else "default"
+
+        rules = self.sharding_rules
+
+        def _prefill(params, input_ids, position_ids, last_token_idx, cache,
+                     sampling_params, key):
+            with jax.default_matmul_precision(precision):
+                logits, cache = prefill_core(params, args, input_ids, position_ids,
+                                             last_token_idx, cache, mesh=mesh,
+                                             rules=rules)
+                tokens = sampling_ops.sample(logits, sampling_params, key, odsc)
+            return tokens, logits, cache
+
+        def _decode(params, tokens0, position_ids, cache, sampling_params, key,
+                    decode_bucket, num_steps, with_logits):
+            """Generate ``num_steps`` tokens in ONE device call via lax.scan.
+
+            Host-driven per-token loops pay a host<->device round trip per token; the
+            scan keeps the whole decode chunk on device (the TPU-native analog of the
+            reference's async double-buffered decode, `modules/async_execution.py`).
+            """
+            keys = jax.random.split(key, num_steps)
+
+            def body(carry, step_key):
+                tok, pos, cache = carry
+                with jax.default_matmul_precision(precision):
+                    logits, cache = decode_core(params, args, tok[:, None], pos, cache,
+                                                decode_bucket, mesh=mesh, rules=rules)
+                    last = logits[:, -1, :]
+                    nxt = sampling_ops.sample(last, sampling_params, step_key, odsc)
+                out = (nxt, last) if with_logits else (nxt, ())
+                return (nxt, pos + 1, cache), out
+
+            (_, positions, cache), (toks, step_logits) = jax.lax.scan(
+                body, (tokens0, position_ids, cache), keys)
+            toks = toks.T  # (num_steps, B) -> (B, num_steps)
+            return toks, step_logits, cache
+
+        self._prefill_step = jax.jit(_prefill, donate_argnums=(4,))
+        self._decode_step = jax.jit(
+            _decode, donate_argnums=(3,),
+            static_argnames=("decode_bucket", "num_steps", "with_logits"))
+
+    # --- weights ----------------------------------------------------------------------
+    def _param_shardings(self):
+        logical = model_base.param_logical_axes(self.arch_args)
+        return tree_shardings(self.mesh, logical, self.sharding_rules)
+
+    def load(self, model_path: Optional[str] = None) -> None:
+        """Load + convert + shard HF weights onto the mesh (≈ `application_base.py:317`)."""
+        path = model_path or self.model_path
+        if path is None:
+            raise ValueError("no model path to load from")
+        t0 = time.time()
+        state_dict = ckpt_lib.load_state_dict(path)
+        host_params = self.convert_hf_state_dict(state_dict, self.config)
+        self._put_params(host_params)
+        logger.info("loaded weights in %.1fs", time.time() - t0)
+
+    def load_random(self, seed: int = 0) -> None:
+        """Random weights at the configured shapes (tests / synthetic benchmarks)."""
+        host_params = model_base.init_params(
+            self.arch_args, jax.random.PRNGKey(seed),
+            dtype=self.tpu_config.jax_dtype,
+            inv_freq=self.inv_freq_from_config(self.config))
+        self._put_params(host_params)
+
+    def _put_params(self, host_params) -> None:
+        shardings = self._param_shardings()
+        dtype = self.tpu_config.jax_dtype
+
+        def _put(x, s):
+            arr = np.asarray(x)
+            if arr.dtype.kind == "f" or arr.dtype.name == "bfloat16":
+                arr = arr.astype(dtype) if arr.dtype != dtype else arr
+            return jax.device_put(arr, s)
+
+        rope = np.asarray(host_params["rope_inv_freq"], dtype=np.float32)
+        self.params = jax.tree.map(_put, host_params, shardings)
+        # rope_inv_freq stays fp32 regardless of model dtype
+        self.params["rope_inv_freq"] = jax.device_put(
+            rope, named_sharding(self.mesh, (None,)))
+
+    # --- cache ------------------------------------------------------------------------
+    def cache_spec(self) -> kvcache.KVCacheSpec:
+        a = self.arch_args
+        return kvcache.KVCacheSpec(
+            num_layers=a.num_layers,
+            batch_size=self.tpu_config.max_batch_size,
+            num_kv_heads=a.num_kv_heads,
+            max_seq_len=self.tpu_config.seq_len,
+            head_dim=a.head_dim,
+            dtype=self.tpu_config.kv_cache_jax_dtype,
+        )
+
+    def reset_cache(self) -> None:
+        spec = self.cache_spec()
+        sharding = named_sharding(self.mesh, kvcache.CACHE_LOGICAL)
+        self.kv_cache = jax.tree.map(
+            lambda x: jax.device_put(x, sharding), kvcache.init_cache(spec))
+
+    # --- warmup (≈ `application_base.py:348-372`) -------------------------------------
+    def warmup(self) -> None:
+        if self.params is None:
+            raise RuntimeError("load weights before warmup")
+        b = self.tpu_config.max_batch_size
+        sp = sampling_ops.prepare_sampling_params(b)
+        key = jax.random.PRNGKey(0)
+        for bucket in self.cte_buckets:
+            self.reset_cache()
+            ids = np.zeros((b, bucket), dtype=np.int32)
+            pos = np.broadcast_to(np.arange(bucket, dtype=np.int32), (b, bucket)).copy()
+            last = np.zeros((b,), dtype=np.int32)
+            tokens, _, self.kv_cache = self._prefill_step(
+                self.params, ids, pos, last, self.kv_cache, sp, key)
+            tokens.block_until_ready()
+        chunk = max(1, self.tpu_config.decode_chunk_size)
+        for bucket in self.tkg_buckets:
+            tok0 = jnp.zeros((b,), dtype=jnp.int32)
+            pos = np.zeros((b,), dtype=np.int32)
+            tokens, _, self.kv_cache = self._decode_step(
+                self.params, tok0, pos, self.kv_cache, sp, key,
+                decode_bucket=bucket, num_steps=min(chunk, bucket), with_logits=False)
+            tokens.block_until_ready()
+        self.reset_cache()
+        logger.info("warmup complete: %d CTE + %d TKG buckets",
+                    len(self.cte_buckets), len(self.tkg_buckets))
+
+    # --- generation (≈ HF adapter `_sample` loop, `utils/hf_adapter.py:139-257`) ------
+    def generate(
+        self,
+        input_ids: np.ndarray,
+        attention_mask: Optional[np.ndarray] = None,
+        max_new_tokens: int = 32,
+        sampling_params: Optional[np.ndarray] = None,
+        eos_token_id: Optional[int] = None,
+        pad_token_id: int = 0,
+        seed: int = 0,
+        return_logits: bool = False,
+        collect_latency: bool = False,
+    ) -> GenerateOutput:
+        if self.params is None:
+            raise RuntimeError("load weights before generate")
+        input_ids = model_wrapper.to_int32(input_ids)
+        b = input_ids.shape[0]
+        compiled_b = self.tpu_config.max_batch_size
+        if sampling_params is None:
+            sampling_params = sampling_ops.prepare_sampling_params(compiled_b)
+        elif sampling_params.shape[0] > compiled_b:
+            raise ValueError(f"sampling_params batch {sampling_params.shape[0]} exceeds "
+                             f"compiled batch size {compiled_b}")
+        elif sampling_params.shape[0] < compiled_b:
+            pad = np.ones((compiled_b - sampling_params.shape[0], 3), dtype=np.float32)
+            sampling_params = np.concatenate([sampling_params, pad], axis=0)
+        key = jax.random.PRNGKey(seed if not self.sampling_config.deterministic
+                                 else self.sampling_config.seed)
+
+        padded = model_wrapper.pad_prefill_inputs(
+            input_ids, attention_mask, self.cte_buckets, pad_token_id=pad_token_id,
+            batch_size=compiled_b)
+        self.reset_cache()
+
+        t_start = time.perf_counter()
+        key, sub = jax.random.split(key)
+        tokens_dev, logits_dev, self.kv_cache = self._prefill_step(
+            self.params, padded.input_ids, padded.position_ids, padded.last_token_idx,
+            self.kv_cache, sampling_params, sub)
+        tokens_dev.block_until_ready()
+        ttft = time.perf_counter() - t_start
+
+        all_logits = [np.asarray(logits_dev)[:b]] if return_logits else None
+        chunks = [np.asarray(tokens_dev)[:, None]]
+        decode_lat: List[float] = []
+        base_positions = padded.true_lengths.astype(np.int32)
+        chunk_size = max(1, self.tpu_config.decode_chunk_size)
+        last_tok = tokens_dev            # (B,) device-resident between chunks
+        n_done = 1
+
+        # decode runs in fixed-size on-device chunks (lax.scan); host only touches the
+        # boundary between chunks, so tunnel/dispatch latency amortizes over the chunk.
+        # Chunks always run the full chunk_size (trailing excess discarded host-side)
+        # so every chunk reuses one compiled graph per bucket — a variable remainder
+        # would recompile mid-stream.
+        while n_done < max_new_tokens:
+            max_pos = int(base_positions.max()) + (n_done - 1)
+            steps = min(chunk_size, self.tpu_config.seq_len - 1 - max_pos)
+            if steps <= 0:
+                logger.warning("hit seq_len %d during decode", self.tpu_config.seq_len)
+                break
+            bucket = autobucketing.select_bucket(self.tkg_buckets, max_pos + steps)
+            positions = base_positions + (n_done - 1)
+            key, sub = jax.random.split(key)
+            t0 = time.perf_counter()
+            toks_dev, logits_chunk, self.kv_cache = self._decode_step(
+                self.params, last_tok, positions, self.kv_cache, sampling_params, sub,
+                decode_bucket=bucket, num_steps=steps, with_logits=return_logits)
+            toks = np.asarray(toks_dev)           # (B, steps); syncs the chunk
+            if collect_latency:
+                decode_lat.append((time.perf_counter() - t0, steps))
+            chunks.append(toks)
+            if return_logits:
+                lc = np.asarray(logits_chunk)     # (steps, B, V)
+                all_logits.extend(lc[i][:b] for i in range(lc.shape[0]))
+            last_tok = toks_dev[:, -1]
+            n_done += steps
+            if eos_token_id is not None:
+                done_mask = (np.concatenate(chunks, axis=1)[:b] == eos_token_id).any(1)
+                if done_mask.all():
+                    break
+
+        gen = np.concatenate(chunks, axis=1)[:b, :max_new_tokens]   # (B, T)
+        if return_logits:
+            all_logits = all_logits[:max_new_tokens]
+        if eos_token_id is not None:
+            gen = _mask_after_eos(gen, eos_token_id, pad_token_id)
+        seqs = []
+        prompt_lens = padded.true_lengths[:b]
+        max_len = int(prompt_lens.max()) + gen.shape[1]
+        sequences = np.full((b, max_len), pad_token_id, dtype=np.int32)
+        for i in range(b):
+            pl = int(prompt_lens[i])
+            sequences[i, :pl] = padded.input_ids[i, :pl]
+            sequences[i, pl : pl + gen.shape[1]] = gen[i]
+        return GenerateOutput(
+            sequences=sequences, tokens=gen,
+            logits=all_logits, ttft_s=ttft,
+            decode_latencies_s=decode_lat if collect_latency else None)
+
+    # --- artifact save/load (compiled dir ≈ model.pt + neuron_config.json) ------------
+    def save_config(self, directory: str) -> str:
+        return self.config.save(directory)
+
+    @classmethod
+    def from_pretrained(cls, model_path: str, tpu_config: TpuConfig,
+                        mesh=None) -> "TpuModelForCausalLM":
+        from ..config import load_pretrained_config
+
+        cfg_cls = cls.get_config_cls()
+        config = cfg_cls(tpu_config, load_config=load_pretrained_config(model_path))
+        app = cls(model_path, config, mesh=mesh)
+        app.load()
+        return app

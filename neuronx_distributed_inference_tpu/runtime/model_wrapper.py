@@ -1,0 +1,82 @@
+"""Host-side input marshaling: bucket selection and padding.
+
+≈ reference `models/model_wrapper.py` (`pad_inputs` :725-824, `get_target_bucket`
+:826-916, int64→int32 :1334). On TPU the "compiled graph per bucket" is `jax.jit`'s
+shape-keyed cache plus an explicit static ``decode_bucket`` argument; this module keeps
+the same observable behavior: first-fit bucket choice, right-padding of inputs, batch
+padding up to the compiled batch size.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..modules import autobucketing
+
+
+@dataclass
+class PaddedPrefill:
+    input_ids: np.ndarray      # (B, S_bucket) int32
+    position_ids: np.ndarray   # (B, S_bucket) int32
+    last_token_idx: np.ndarray  # (B,) int32
+    true_lengths: np.ndarray   # (B,) int32
+    bucket: int
+
+
+def pad_prefill_inputs(
+    input_ids: np.ndarray,
+    attention_mask: Optional[np.ndarray],
+    buckets: Sequence[int],
+    pad_token_id: int = 0,
+    batch_size: Optional[int] = None,
+) -> PaddedPrefill:
+    """Right-pad (B, S) int inputs to the first-fit sequence bucket.
+
+    ``attention_mask`` (B, S) of 0/1 marks real tokens (right-padded). Inputs arriving
+    left-padded are normalized to right padding, like the reference's CTE path
+    (`model_wrapper.py:725-824`).
+    """
+    input_ids = np.asarray(input_ids)
+    if input_ids.ndim != 2:
+        raise ValueError("input_ids must be (batch, seq)")
+    b, s = input_ids.shape
+    if attention_mask is None:
+        attention_mask = np.ones((b, s), dtype=np.int32)
+    attention_mask = np.asarray(attention_mask).astype(np.int32)
+    true_lengths = attention_mask.sum(axis=1).astype(np.int32)
+    if np.any(true_lengths == 0):
+        raise ValueError("each sequence needs at least one real token")
+
+    bucket = autobucketing.select_bucket(buckets, int(true_lengths.max()))
+    out_b = batch_size or b
+    if b > out_b:
+        raise ValueError(f"batch {b} exceeds compiled batch size {out_b}")
+
+    ids = np.full((out_b, bucket), pad_token_id, dtype=np.int32)
+    for i in range(b):
+        row = input_ids[i][attention_mask[i].astype(bool)]
+        ids[i, : row.shape[0]] = row
+    # batch-pad rows replicate row 0 (harmless work, keeps shapes static
+    # ≈ `model_wrapper.py:569-698` batch padding)
+    for i in range(b, out_b):
+        ids[i] = ids[0]
+
+    positions = np.broadcast_to(np.arange(bucket, dtype=np.int32), (out_b, bucket)).copy()
+    lengths_padded = np.ones((out_b,), dtype=np.int32)
+    lengths_padded[:b] = true_lengths
+    last_idx = np.maximum(lengths_padded - 1, 0).astype(np.int32)
+    return PaddedPrefill(ids, positions, last_idx, lengths_padded, bucket)
+
+
+def decode_bucket_for_position(buckets: Sequence[int], max_position: int) -> int:
+    """Smallest token-generation bucket covering cache index ``max_position``."""
+    return autobucketing.select_bucket(buckets, max_position + 1)
+
+
+def to_int32(x: np.ndarray) -> np.ndarray:
+    """≈ convert_int64_to_int32 (`model_wrapper.py:1334`)."""
+    x = np.asarray(x)
+    return x.astype(np.int32) if x.dtype in (np.int64, np.uint64) else x

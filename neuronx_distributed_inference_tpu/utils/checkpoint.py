@@ -1,0 +1,147 @@
+"""Checkpoint I/O: HF checkpoint ingestion and per-rank-free sharded loading.
+
+≈ reference `modules/checkpoint.py` (`load_state_dict` :24, `create_n_layer_checkpoint`
+:202) and the weight-sharding half of `models/application_base.py:240-265`. Differences
+by design: TPU weights are not pre-sharded to per-rank files — we load the full
+state dict host-side (or memory-map safetensors) and `jax.device_put` with
+`NamedSharding`, letting the runtime slice each shard; multi-host sharded loading can
+use `jax.make_array_from_callback` later without changing this API.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable, Dict, Iterable, Optional
+
+import numpy as np
+
+SAFETENSORS_INDEX = "model.safetensors.index.json"
+SAFETENSORS_SINGLE = "model.safetensors"
+PT_BIN_INDEX = "pytorch_model.bin.index.json"
+PT_BIN_SINGLE = "pytorch_model.bin"
+
+
+def _from_torch(t) -> np.ndarray:
+    import torch
+
+    if t.dtype == torch.bfloat16:
+        # numpy has no bfloat16; round-trip through ml_dtypes
+        import ml_dtypes
+
+        return t.view(torch.uint16).numpy().view(ml_dtypes.bfloat16)
+    return t.numpy()
+
+
+def load_state_dict(model_dir: str, keys: Optional[Iterable[str]] = None
+                    ) -> Dict[str, np.ndarray]:
+    """Load a HF checkpoint directory into {name: np.ndarray}.
+
+    Handles sharded/unsharded safetensors and pytorch .bin, like the reference
+    `modules/checkpoint.py:24-120`. ``keys`` optionally restricts which tensors load
+    (used for per-modality / per-layer loading).
+    """
+    if os.path.exists(os.path.join(model_dir, SAFETENSORS_INDEX)):
+        with open(os.path.join(model_dir, SAFETENSORS_INDEX)) as f:
+            index = json.load(f)["weight_map"]
+        out: Dict[str, np.ndarray] = {}
+        by_file: Dict[str, list] = {}
+        for name, fname in index.items():
+            if keys is not None and name not in keys:
+                continue
+            by_file.setdefault(fname, []).append(name)
+        for fname, names in by_file.items():
+            out.update(_load_safetensors_file(os.path.join(model_dir, fname), names))
+        return out
+    if os.path.exists(os.path.join(model_dir, SAFETENSORS_SINGLE)):
+        return _load_safetensors_file(
+            os.path.join(model_dir, SAFETENSORS_SINGLE),
+            list(keys) if keys is not None else None)
+    if os.path.exists(os.path.join(model_dir, PT_BIN_INDEX)):
+        import torch
+
+        with open(os.path.join(model_dir, PT_BIN_INDEX)) as f:
+            index = json.load(f)["weight_map"]
+        out = {}
+        for fname in sorted(set(index.values())):
+            sd = torch.load(os.path.join(model_dir, fname), map_location="cpu",
+                            weights_only=True)
+            for k, v in sd.items():
+                if keys is None or k in keys:
+                    out[k] = _from_torch(v)
+        return out
+    if os.path.exists(os.path.join(model_dir, PT_BIN_SINGLE)):
+        import torch
+
+        sd = torch.load(os.path.join(model_dir, PT_BIN_SINGLE), map_location="cpu",
+                        weights_only=True)
+        return {k: _from_torch(v) for k, v in sd.items()
+                if keys is None or k in keys}
+    raise FileNotFoundError(f"no checkpoint found under {model_dir}")
+
+
+def _load_safetensors_file(path: str, names: Optional[list]) -> Dict[str, np.ndarray]:
+    from safetensors import safe_open
+
+    out: Dict[str, np.ndarray] = {}
+    with safe_open(path, framework="np") as f:
+        for name in (names if names is not None else f.keys()):
+            out[name] = f.get_tensor(name)
+    return out
+
+
+def checkpoint_tensor_names(model_dir: str) -> list:
+    """List tensor names without loading data."""
+    if os.path.exists(os.path.join(model_dir, SAFETENSORS_INDEX)):
+        with open(os.path.join(model_dir, SAFETENSORS_INDEX)) as f:
+            return sorted(json.load(f)["weight_map"].keys())
+    if os.path.exists(os.path.join(model_dir, SAFETENSORS_SINGLE)):
+        from safetensors import safe_open
+
+        with safe_open(os.path.join(model_dir, SAFETENSORS_SINGLE), framework="np") as f:
+            return sorted(f.keys())
+    return sorted(load_state_dict(model_dir).keys())
+
+
+def save_state_dict(state_dict: Dict[str, np.ndarray], model_dir: str,
+                    filename: str = SAFETENSORS_SINGLE) -> str:
+    """Save {name: array} as a single safetensors file (≈ `modules/checkpoint.py`
+    save path; pruning of None values included)."""
+    from safetensors.numpy import save_file
+
+    os.makedirs(model_dir, exist_ok=True)
+    path = os.path.join(model_dir, filename)
+    clean = {}
+    for k, v in state_dict.items():
+        if v is None:
+            continue
+        arr = np.asarray(v)
+        if arr.dtype.kind not in "fiub" and arr.dtype.name != "bfloat16":
+            raise ValueError(f"cannot serialize {k} with dtype {arr.dtype}")
+        clean[k] = np.ascontiguousarray(arr)
+    save_file(clean, path)
+    return path
+
+
+def create_n_layer_checkpoint(hf_config, n_layers: int, out_dir: str, seed: int = 0,
+                              config_overrides: Optional[Dict[str, Any]] = None) -> str:
+    """Create a truncated random-weight HF checkpoint for testing.
+
+    ≈ reference `modules/checkpoint.py:202` + `test/integration/utils/test_utils.py:16-49`:
+    instantiate the architecture from its config with ``num_hidden_layers=n_layers`` and
+    random weights, save config.json + safetensors.
+    """
+    import torch
+    import transformers
+
+    if isinstance(hf_config, dict):
+        hf_config = transformers.AutoConfig.for_model(**hf_config)
+    cfg = hf_config.__class__.from_dict(hf_config.to_dict())
+    cfg.num_hidden_layers = n_layers
+    for k, v in (config_overrides or {}).items():
+        setattr(cfg, k, v)
+    torch.manual_seed(seed)
+    model = transformers.AutoModelForCausalLM.from_config(cfg)
+    os.makedirs(out_dir, exist_ok=True)
+    model.save_pretrained(out_dir, safe_serialization=True)
+    return out_dir

@@ -1,0 +1,32 @@
+"""Bucket ladder tests (≈ reference `test/unit/.../autobucketing` coverage)."""
+
+import pytest
+
+from neuronx_distributed_inference_tpu.config import TpuConfig
+from neuronx_distributed_inference_tpu.modules import autobucketing as ab
+
+
+def test_powers_of_two_ladder():
+    assert ab.powers_of_two_ladder(128, 2048) == [128, 256, 512, 1024, 2048]
+    assert ab.powers_of_two_ladder(128, 3000) == [128, 256, 512, 1024, 2048, 3000]
+    assert ab.powers_of_two_ladder(1, 1) == [1]
+
+
+def test_cte_tkg_ladders():
+    cfg = TpuConfig(seq_len=1024, max_context_length=512)
+    assert ab.generate_buckets_for_cte(cfg) == [128, 256, 512]
+    assert ab.generate_buckets_for_tkg(cfg) == [128, 256, 512, 1024]
+    cfg2 = TpuConfig(seq_len=1024, enable_bucketing=False)
+    assert ab.generate_buckets_for_cte(cfg2) == [1024]
+    cfg3 = TpuConfig(seq_len=1024, token_generation_buckets=[256, 1024])
+    assert ab.generate_buckets_for_tkg(cfg3) == [256, 1024]
+
+
+def test_select_bucket_first_fit():
+    buckets = [128, 256, 512]
+    assert ab.select_bucket(buckets, 1) == 128
+    assert ab.select_bucket(buckets, 128) == 128
+    assert ab.select_bucket(buckets, 129) == 256
+    assert ab.select_bucket(buckets, 512) == 512
+    with pytest.raises(ValueError):
+        ab.select_bucket(buckets, 513)

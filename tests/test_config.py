@@ -1,0 +1,55 @@
+"""Config system tests (≈ reference config validation + JSON round-trip coverage)."""
+
+import pytest
+
+from neuronx_distributed_inference_tpu.config import (
+    InferenceConfig,
+    OnDeviceSamplingConfig,
+    TpuConfig,
+    load_pretrained_config,
+)
+
+
+def test_defaults_and_world_size():
+    cfg = TpuConfig(batch_size=2, seq_len=1024, tp_degree=8)
+    assert cfg.max_batch_size == 2
+    assert cfg.max_context_length == 1024
+    assert cfg.world_size == 8
+
+
+def test_validation_rejects_bad_combos():
+    with pytest.raises(ValueError):
+        TpuConfig(seq_len=128, max_context_length=256)
+    with pytest.raises(ValueError):
+        TpuConfig(padding_side="middle")
+    with pytest.raises(ValueError):
+        TpuConfig(dp_degree=2, is_continuous_batching=False)
+    with pytest.raises(ValueError):
+        TpuConfig(context_encoding_buckets=[256, 128], seq_len=512)
+    with pytest.raises(ValueError):
+        TpuConfig(context_encoding_buckets=[128, 1024], seq_len=512)
+    with pytest.raises(ValueError):
+        OnDeviceSamplingConfig(top_p=0.0).validate()
+
+
+def test_inference_config_json_roundtrip(tmp_path):
+    tpu_cfg = TpuConfig(
+        batch_size=4, seq_len=2048, tp_degree=8,
+        on_device_sampling_config=OnDeviceSamplingConfig(do_sample=True, top_k=50),
+    )
+    cfg = InferenceConfig(tpu_cfg, hidden_size=1024, vocab_size=32000,
+                          num_attention_heads=16)
+    cfg.save(str(tmp_path))
+    loaded = InferenceConfig.load(str(tmp_path))
+    assert loaded.hidden_size == 1024
+    assert loaded.tpu_config.tp_degree == 8
+    assert loaded.tpu_config.on_device_sampling_config.top_k == 50
+    assert isinstance(loaded.tpu_config.on_device_sampling_config,
+                      OnDeviceSamplingConfig)
+
+
+def test_load_pretrained_config_from_dict(tiny_llama_hf_config):
+    cfg = InferenceConfig(TpuConfig(),
+                          load_config=load_pretrained_config(tiny_llama_hf_config))
+    assert cfg.hidden_size == 64
+    assert cfg.num_key_value_heads == 2

@@ -1,0 +1,63 @@
+"""KV cache semantics tests (≈ reference `test/unit/modules/kvcache/test_kv_cache_manager.py`)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from neuronx_distributed_inference_tpu.modules import kvcache
+
+
+def _spec(**kw):
+    defaults = dict(num_layers=2, batch_size=2, num_kv_heads=2, max_seq_len=16,
+                    head_dim=4, dtype=jnp.float32)
+    defaults.update(kw)
+    return kvcache.KVCacheSpec(**defaults)
+
+
+def test_init_shapes_and_bytes():
+    spec = _spec()
+    cache = kvcache.init_cache(spec)
+    assert cache["k"].shape == (2, 2, 2, 16, 4)
+    assert kvcache.cache_bytes(spec) == 2 * 2 * 2 * 2 * 16 * 4 * 4
+
+
+def test_prefill_write_and_bucket_read():
+    spec = _spec()
+    cache = kvcache.init_cache(spec)
+    new = jnp.asarray(np.random.randn(2, 2, 8, 4).astype(np.float32))
+    layer = kvcache.write_prefill(cache["k"][0], new)
+    np.testing.assert_array_equal(np.asarray(layer[:, :, :8]), np.asarray(new))
+    np.testing.assert_array_equal(np.asarray(layer[:, :, 8:]), 0)
+    sliced = kvcache.read_bucket(layer, 8)
+    assert sliced.shape == (2, 2, 8, 4)
+
+
+def test_decode_write_per_sequence_positions():
+    spec = _spec()
+    layer = kvcache.init_cache(spec)["k"][0]
+    new = jnp.asarray(np.arange(2 * 2 * 1 * 4, dtype=np.float32).reshape(2, 2, 1, 4))
+    positions = jnp.asarray(np.array([3, 7], dtype=np.int32))
+    out = np.array(kvcache.write_decode(layer, new, positions))
+    np.testing.assert_array_equal(out[0, :, 3], np.asarray(new)[0, :, 0])
+    np.testing.assert_array_equal(out[1, :, 7], np.asarray(new)[1, :, 0])
+    out[0, :, 3] = 0
+    out[1, :, 7] = 0
+    np.testing.assert_array_equal(out, 0)
+
+
+def test_decode_write_multi_token():
+    spec = _spec()
+    layer = kvcache.init_cache(spec)["k"][0]
+    new = jnp.asarray(np.random.randn(2, 2, 3, 4).astype(np.float32))
+    positions = jnp.asarray(np.array([2, 5], dtype=np.int32))
+    out = np.asarray(kvcache.write_decode(layer, new, positions))
+    np.testing.assert_array_equal(out[0, :, 2:5], np.asarray(new)[0])
+    np.testing.assert_array_equal(out[1, :, 5:8], np.asarray(new)[1])
+
+
+def test_batched_gather_reorders_sequences():
+    spec = _spec()
+    cache = kvcache.init_cache(spec)
+    cache = {k: v.at[:, 0].set(1.0).at[:, 1].set(2.0) for k, v in cache.items()}
+    swapped = kvcache.batched_gather(cache, jnp.asarray([1, 0]))
+    np.testing.assert_array_equal(np.asarray(swapped["k"][:, 0]), 2.0)
+    np.testing.assert_array_equal(np.asarray(swapped["k"][:, 1]), 1.0)

@@ -1,0 +1,98 @@
+"""Numeric op tests vs torch/HF references (≈ reference kernel-vs-native parity tests,
+`utils/testing.py:67-120` pattern)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from neuronx_distributed_inference_tpu.ops import attention as attn_ops
+from neuronx_distributed_inference_tpu.ops import norms, rope
+
+
+def test_rms_norm_matches_torch():
+    x = np.random.randn(2, 5, 64).astype(np.float32)
+    w = np.random.randn(64).astype(np.float32)
+    got = norms.rms_norm(jnp.asarray(x), jnp.asarray(w), eps=1e-5)
+    xt = torch.tensor(x)
+    expected = xt * torch.rsqrt(xt.pow(2).mean(-1, keepdim=True) + 1e-5) * torch.tensor(w)
+    np.testing.assert_allclose(np.asarray(got), expected.numpy(), atol=1e-5)
+
+
+def test_rope_matches_hf():
+    from transformers.models.llama.modeling_llama import (
+        LlamaRotaryEmbedding, apply_rotary_pos_emb)
+    from transformers import LlamaConfig
+
+    head_dim, n_heads, b, s = 32, 4, 2, 6
+    cfg = LlamaConfig(hidden_size=head_dim * n_heads, num_attention_heads=n_heads,
+                      rope_theta=10000.0)
+    emb = LlamaRotaryEmbedding(config=cfg)
+    q = np.random.randn(b, n_heads, s, head_dim).astype(np.float32)
+    k = np.random.randn(b, n_heads, s, head_dim).astype(np.float32)
+    pos = np.tile(np.arange(s), (b, 1))
+
+    cos_t, sin_t = emb(torch.tensor(q), torch.tensor(pos))
+    q_hf, k_hf = apply_rotary_pos_emb(torch.tensor(q), torch.tensor(k), cos_t, sin_t)
+
+    inv_freq = rope.default_inv_freq(head_dim, 10000.0)
+    cos, sin = rope.compute_cos_sin(jnp.asarray(inv_freq), jnp.asarray(pos))
+    q_j, k_j = rope.apply_rotary(jnp.asarray(q), jnp.asarray(k), cos, sin)
+    np.testing.assert_allclose(np.asarray(q_j), q_hf.numpy(), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(k_j), k_hf.numpy(), atol=1e-5)
+
+
+def test_llama3_scaled_inv_freq_matches_hf():
+    from transformers import LlamaConfig
+    from transformers.modeling_rope_utils import ROPE_INIT_FUNCTIONS
+
+    rope_scaling = {
+        "rope_type": "llama3", "factor": 8.0, "low_freq_factor": 1.0,
+        "high_freq_factor": 4.0, "original_max_position_embeddings": 8192,
+    }
+    cfg = LlamaConfig(hidden_size=512, num_attention_heads=4, rope_theta=500000.0,
+                      rope_scaling=rope_scaling)
+    inv_hf, scale = ROPE_INIT_FUNCTIONS["llama3"](cfg, device="cpu")
+    ours = rope.inv_freq_from_hf_config(128, 500000.0, rope_scaling)
+    np.testing.assert_allclose(ours, inv_hf.numpy(), rtol=1e-6)
+    assert scale == 1.0
+
+
+def test_gqa_attention_matches_torch_sdpa():
+    b, nq, nkv, s, d = 2, 8, 2, 16, 32
+    q = np.random.randn(b, nq, s, d).astype(np.float32)
+    k = np.random.randn(b, nkv, s, d).astype(np.float32)
+    v = np.random.randn(b, nkv, s, d).astype(np.float32)
+    mask = np.asarray(attn_ops.causal_mask(s, s))[None, None]
+
+    with jax.default_matmul_precision("highest"):
+        got = attn_ops.attend(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                              mask=jnp.asarray(mask))
+    expected = torch.nn.functional.scaled_dot_product_attention(
+        torch.tensor(q), torch.tensor(k), torch.tensor(v),
+        is_causal=True, enable_gqa=True)
+    np.testing.assert_allclose(np.asarray(got), expected.numpy(), atol=2e-5)
+
+
+def test_attention_sinks_reduce_prob_mass():
+    b, nq, s, d = 1, 2, 8, 16
+    q = np.random.randn(b, nq, s, d).astype(np.float32)
+    k = np.random.randn(b, nq, s, d).astype(np.float32)
+    v = np.ones((b, nq, s, d), dtype=np.float32)
+    mask = np.asarray(attn_ops.causal_mask(s, s))[None, None]
+    with jax.default_matmul_precision("highest"):
+        no_sink = attn_ops.attend(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                                  mask=jnp.asarray(mask))
+        with_sink = attn_ops.attend(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                                    mask=jnp.asarray(mask),
+                                    sinks=jnp.full((nq,), 5.0))
+    # v is all-ones: output = prob mass on real tokens; sinks must strictly reduce it
+    assert np.all(np.asarray(with_sink) < np.asarray(no_sink) + 1e-6)
+    np.testing.assert_allclose(np.asarray(no_sink), 1.0, atol=1e-5)
+
+
+def test_sliding_window_mask():
+    m = np.asarray(attn_ops.sliding_window_mask(1, 8, window=3, q_offset=5))
+    # query at pos 5, window 3 -> attends kv pos 3, 4, 5
+    assert m[0].tolist() == [False, False, False, True, True, True, False, False]

@@ -1,0 +1,83 @@
+"""On-device sampling tests (≈ reference `test/unit/modules/generation/test_sampling.py`)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neuronx_distributed_inference_tpu.config import OnDeviceSamplingConfig
+from neuronx_distributed_inference_tpu.ops import sampling as S
+
+
+def _logits(batch=4, vocab=100):
+    return jnp.asarray(np.random.randn(batch, vocab).astype(np.float32) * 3)
+
+
+def test_prepare_sampling_params_broadcast():
+    p = S.prepare_sampling_params(3, top_k=1, top_p=0.9, temperature=[1.0, 0.5, 2.0])
+    assert p.shape == (3, 3)
+    np.testing.assert_allclose(p[:, 0], 1.0)
+    np.testing.assert_allclose(p[:, 2], [1.0, 0.5, 2.0])
+
+
+def test_greedy_matches_argmax():
+    logits = _logits()
+    cfg = OnDeviceSamplingConfig(dynamic=False)
+    tokens = S.sample(logits, jnp.asarray(S.prepare_sampling_params(4)), None, cfg)
+    np.testing.assert_array_equal(np.asarray(tokens), np.argmax(np.asarray(logits), -1))
+
+
+def test_dynamic_greedy_rows_exact_even_with_key():
+    logits = _logits()
+    cfg = OnDeviceSamplingConfig(dynamic=True, do_sample=True)
+    params = S.prepare_sampling_params(4, top_k=[1, 1, 50, 50], top_p=1.0,
+                                       temperature=1.0)
+    tokens = S.sample(logits, jnp.asarray(params), jax.random.PRNGKey(0), cfg)
+    argmax = np.argmax(np.asarray(logits), -1)
+    np.testing.assert_array_equal(np.asarray(tokens)[:2], argmax[:2])
+
+
+def test_top_k_restricts_support():
+    logits = _logits(batch=64, vocab=50)
+    cfg = OnDeviceSamplingConfig(dynamic=True, do_sample=True)
+    params = S.prepare_sampling_params(64, top_k=5, top_p=1.0, temperature=2.0)
+    top5 = np.argsort(-np.asarray(logits), axis=-1)[:, :5]
+    for seed in range(5):
+        tokens = np.asarray(S.sample(logits, jnp.asarray(params),
+                                     jax.random.PRNGKey(seed), cfg))
+        for b in range(64):
+            assert tokens[b] in top5[b]
+
+
+def test_top_p_restricts_support():
+    # peaked distribution: top-p=0.9 keeps only the high-prob head
+    base = np.full((8, 50), -10.0, dtype=np.float32)
+    base[:, 0] = 5.0
+    base[:, 1] = 4.0
+    cfg = OnDeviceSamplingConfig(dynamic=True, do_sample=True)
+    params = S.prepare_sampling_params(8, top_k=50, top_p=0.9, temperature=1.0)
+    for seed in range(5):
+        tokens = np.asarray(S.sample(jnp.asarray(base), jnp.asarray(params),
+                                     jax.random.PRNGKey(seed), cfg))
+        assert set(tokens.tolist()) <= {0, 1}
+
+
+def test_temperature_flattens_distribution():
+    base = np.zeros((512, 4), dtype=np.float32)
+    base[:, 0] = 2.0
+    cfg = OnDeviceSamplingConfig(dynamic=True, do_sample=True)
+    cold = S.prepare_sampling_params(512, top_k=4, top_p=1.0, temperature=0.25)
+    hot = S.prepare_sampling_params(512, top_k=4, top_p=1.0, temperature=4.0)
+    t_cold = np.asarray(S.sample(jnp.asarray(base), jnp.asarray(cold),
+                                 jax.random.PRNGKey(1), cfg))
+    t_hot = np.asarray(S.sample(jnp.asarray(base), jnp.asarray(hot),
+                                jax.random.PRNGKey(1), cfg))
+    assert (t_cold == 0).mean() > (t_hot == 0).mean()
+
+
+def test_deterministic_same_key_same_tokens():
+    logits = _logits()
+    cfg = OnDeviceSamplingConfig(dynamic=True, do_sample=True)
+    params = S.prepare_sampling_params(4, top_k=50, top_p=0.95, temperature=1.0)
+    a = S.sample(logits, jnp.asarray(params), jax.random.PRNGKey(7), cfg)
+    b = S.sample(logits, jnp.asarray(params), jax.random.PRNGKey(7), cfg)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
