@@ -1,0 +1,245 @@
+"""CLI demo: load → (accuracy check) → generate → benchmark.
+
+≈ reference `inference_demo.py` (arg parser :69-408, `run_inference` :493, console
+script `inference_demo` :782). Flags mirror the TpuConfig surface 1:1 the way the
+reference's flags mirror NeuronConfig.
+
+Usage:
+    python -m neuronx_distributed_inference_tpu.inference_demo \
+        --model-path /path/to/hf_ckpt --model-type llama \
+        --tp-degree 8 --batch-size 2 --seq-len 1024 --max-context-length 512 \
+        --prompt "I believe the meaning of life is" \
+        --check-accuracy-mode logit-matching --benchmark
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+from typing import List, Optional
+
+import numpy as np
+
+from .config import OnDeviceSamplingConfig, TpuConfig
+from .models import get_model_cls
+from .utils.benchmark import benchmark_sampling
+
+logger = logging.getLogger("tpu-inference")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="TPU inference demo")
+    p.add_argument("--model-path", required=True, help="HF checkpoint directory")
+    p.add_argument("--model-type", default=None,
+                   help="model family (default: read model_type from config.json)")
+    p.add_argument("--compiled-path", default=None,
+                   help="directory for saved config artifacts")
+
+    g = p.add_argument_group("geometry")
+    g.add_argument("--batch-size", type=int, default=1)
+    g.add_argument("--seq-len", type=int, default=2048)
+    g.add_argument("--max-context-length", type=int, default=0)
+    g.add_argument("--max-new-tokens", type=int, default=64)
+
+    g = p.add_argument_group("parallelism")
+    g.add_argument("--tp-degree", type=int, default=1)
+    g.add_argument("--dp-degree", type=int, default=1)
+    g.add_argument("--cp-degree", type=int, default=1)
+    g.add_argument("--ep-degree", type=int, default=1)
+
+    g = p.add_argument_group("execution")
+    g.add_argument("--dtype", default="bfloat16",
+                   choices=["bfloat16", "float16", "float32"])
+    g.add_argument("--enable-bucketing", action="store_true", default=True)
+    g.add_argument("--no-bucketing", dest="enable_bucketing", action="store_false")
+    g.add_argument("--context-encoding-buckets", type=int, nargs="*", default=None)
+    g.add_argument("--token-generation-buckets", type=int, nargs="*", default=None)
+    g.add_argument("--decode-chunk-size", type=int, default=32)
+    g.add_argument("--cpu", action="store_true",
+                   help="force the CPU backend (debug / no-accelerator runs)")
+
+    g = p.add_argument_group("sampling")
+    g.add_argument("--do-sample", action="store_true")
+    g.add_argument("--top-k", type=int, default=1)
+    g.add_argument("--top-p", type=float, default=1.0)
+    g.add_argument("--temperature", type=float, default=1.0)
+    g.add_argument("--global-topk", type=int, default=256)
+    g.add_argument("--seed", type=int, default=0)
+
+    g = p.add_argument_group("run modes")
+    g.add_argument("--prompt", action="append", default=None,
+                   help="repeatable; prompts to generate from")
+    g.add_argument("--check-accuracy-mode",
+                   choices=["skip", "token-matching", "logit-matching"],
+                   default="skip")
+    g.add_argument("--divergence-difference-tol", type=float, default=0.001)
+    g.add_argument("--benchmark", action="store_true")
+    g.add_argument("--benchmark-runs", type=int, default=5)
+    g.add_argument("--verbose", action="store_true")
+    return p
+
+
+def create_tpu_config(args: argparse.Namespace) -> TpuConfig:
+    """≈ reference `create_neuron_config` (`inference_demo.py:436-490`)."""
+    sampling = OnDeviceSamplingConfig(
+        do_sample=args.do_sample, top_k=args.top_k, top_p=args.top_p,
+        temperature=args.temperature, global_topk=args.global_topk)
+    return TpuConfig(
+        batch_size=args.batch_size,
+        seq_len=args.seq_len,
+        max_context_length=args.max_context_length,
+        max_new_tokens=args.max_new_tokens,
+        tp_degree=args.tp_degree,
+        dp_degree=args.dp_degree,
+        cp_degree=args.cp_degree,
+        ep_degree=args.ep_degree,
+        dtype=args.dtype,
+        enable_bucketing=args.enable_bucketing,
+        context_encoding_buckets=args.context_encoding_buckets,
+        token_generation_buckets=args.token_generation_buckets,
+        decode_chunk_size=args.decode_chunk_size,
+        on_device_sampling_config=sampling,
+    )
+
+
+def run_inference(args: argparse.Namespace) -> int:
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    model_type = args.model_type
+    if model_type is None:
+        with open(f"{args.model_path}/config.json") as f:
+            model_type = json.load(f).get("model_type", "llama")
+    model_cls = get_model_cls(model_type)
+
+    tpu_config = create_tpu_config(args)
+    logger.info("building %s (%s) tp=%d", model_cls.__name__, model_type,
+                tpu_config.tp_degree)
+    app = model_cls.from_pretrained(args.model_path, tpu_config)
+    if args.compiled_path:
+        app.save_config(args.compiled_path)
+
+    tokenizer = _try_load_tokenizer(args.model_path)
+
+    if args.check_accuracy_mode != "skip":
+        rc = _run_accuracy_check(args, app, tokenizer)
+        if rc != 0:
+            return rc
+
+    if args.prompt:
+        _run_generation(args, app, tokenizer)
+
+    if args.benchmark:
+        report = benchmark_sampling(app, max_new_tokens=args.max_new_tokens,
+                                    n_runs=args.benchmark_runs,
+                                    report_dir=args.compiled_path)
+        print(json.dumps(report.to_dict(), indent=2))
+    return 0
+
+
+def _try_load_tokenizer(model_path: str):
+    import os
+
+    if not any(os.path.exists(os.path.join(model_path, f))
+               for f in ("tokenizer.json", "tokenizer_config.json",
+                         "tokenizer.model")):
+        logger.info("no tokenizer files at %s; using raw token ids", model_path)
+        return None
+    try:
+        from transformers import AutoTokenizer
+
+        tok = AutoTokenizer.from_pretrained(model_path)
+        if tok.pad_token_id is None:
+            tok.pad_token = tok.eos_token
+        return tok
+    except Exception:
+        logger.info("no tokenizer found at %s; using raw token ids", model_path)
+        return None
+
+
+def _encode_prompts(args, tokenizer, vocab_size: int = 1000) -> tuple:
+    prompts: List[str] = args.prompt or ["I believe the meaning of life is"]
+    if tokenizer is None:
+        rng = np.random.default_rng(args.seed)
+        ids = rng.integers(1, min(1000, vocab_size),
+                           size=(args.batch_size, 16)).astype(np.int32)
+        return ids, None
+    if len(prompts) < args.batch_size:
+        prompts = (prompts * args.batch_size)[: args.batch_size]
+    enc = tokenizer(prompts, return_tensors="np", padding=True)
+    return enc["input_ids"].astype(np.int32), enc["attention_mask"].astype(np.int32)
+
+
+def _run_accuracy_check(args, app, tokenizer) -> int:
+    """≈ reference `run_accuracy_check` (`inference_demo.py:622`)."""
+    import transformers
+
+    from .utils.accuracy import check_accuracy_vs_hf, check_token_accuracy
+
+    logger.info("loading HF CPU golden model from %s", args.model_path)
+    hf_model = transformers.AutoModelForCausalLM.from_pretrained(
+        args.model_path, torch_dtype="float32").eval()
+    input_ids, attention_mask = _encode_prompts(args, tokenizer,
+                                                app.arch_args.vocab_size)
+
+    if args.check_accuracy_mode == "logit-matching":
+        report = check_accuracy_vs_hf(
+            app, hf_model, input_ids, args.max_new_tokens, attention_mask,
+            divergence_difference_tol=args.divergence_difference_tol)
+        print(f"logit matching: passed={report.passed} "
+              f"max_abs_err={report.max_abs_error:.5f} "
+              f"top1_match={report.top1_match_rate:.4f} "
+              f"divergence_index={report.divergence_index}")
+        return 0 if report.passed else 1
+
+    from .utils.accuracy import get_hf_expected_outputs
+
+    expected_tokens, _ = get_hf_expected_outputs(hf_model, input_ids,
+                                                 args.max_new_tokens, attention_mask)
+    out = app.generate(input_ids, attention_mask=attention_mask,
+                       max_new_tokens=args.max_new_tokens)
+    ok = check_token_accuracy(out.tokens, expected_tokens)
+    print(f"token matching: passed={ok}")
+    return 0 if ok else 1
+
+
+def _run_generation(args, app, tokenizer) -> None:
+    """≈ reference `run_generation` (`inference_demo.py:652`)."""
+    from .utils.hf_adapter import HuggingFaceGenerationAdapter
+
+    adapter = HuggingFaceGenerationAdapter(app, tokenizer)
+    prompts = list(args.prompt)
+    if len(prompts) > args.batch_size:
+        logger.warning("%d prompts exceed --batch-size %d; generating the first %d",
+                       len(prompts), args.batch_size, args.batch_size)
+        prompts = prompts[: args.batch_size]
+    if tokenizer is not None:
+        texts = adapter.generate_text(prompts, max_new_tokens=args.max_new_tokens,
+                                      do_sample=args.do_sample, top_k=args.top_k,
+                                      top_p=args.top_p, temperature=args.temperature,
+                                      seed=args.seed)
+        for prompt, text in zip(prompts, texts):
+            print(f"--- prompt: {prompt!r}\n{text}\n")
+    else:
+        input_ids, attention_mask = _encode_prompts(args, tokenizer,
+                                                    app.arch_args.vocab_size)
+        out = app.generate(input_ids, attention_mask=attention_mask,
+                           max_new_tokens=args.max_new_tokens)
+        print("generated token ids:")
+        print(out.tokens)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    return run_inference(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

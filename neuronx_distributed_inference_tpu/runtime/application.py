@@ -304,6 +304,9 @@ class TpuModelForCausalLM:
         chunk_size = max(1, self.tpu_config.decode_chunk_size)
         last_tok = tokens_dev            # (B,) device-resident between chunks
         n_done = 1
+        eos_done = np.zeros((b,), dtype=bool)
+        if eos_token_id is not None:
+            eos_done |= chunks[0][:b, 0] == eos_token_id
 
         # decode runs in fixed-size on-device chunks (lax.scan); host only touches the
         # boundary between chunks, so tunnel/dispatch latency amortizes over the chunk.
@@ -333,8 +336,8 @@ class TpuModelForCausalLM:
             last_tok = toks_dev[:, -1]
             n_done += steps
             if eos_token_id is not None:
-                done_mask = (np.concatenate(chunks, axis=1)[:b] == eos_token_id).any(1)
-                if done_mask.all():
+                eos_done |= (toks[:b] == eos_token_id).any(axis=1)
+                if eos_done.all():
                     break
 
         gen = np.concatenate(chunks, axis=1)[:b, :max_new_tokens]   # (B, T)

@@ -1,0 +1,157 @@
+"""Accuracy harness: token matching and logit matching against a CPU reference.
+
+≈ reference `utils/accuracy.py` (`check_accuracy` :240 token matching,
+`check_accuracy_logits` :474-697 logit matching with per-position tolerance maps and
+divergence-index reporting). The reference callable is anything producing HF-style
+outputs (typically a `transformers` model on CPU); ours is a TpuModelForCausalLM.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger("tpu-inference")
+
+
+@dataclass
+class LogitMatchReport:
+    passed: bool
+    divergence_index: int              # first generation step whose argmax disagrees
+    max_abs_error: float
+    top1_match_rate: float
+    per_step_max_err: List[float] = field(default_factory=list)
+
+
+def check_token_accuracy(
+    actual_tokens: np.ndarray,     # (B, T)
+    expected_tokens: np.ndarray,   # (B, T)
+    minimum_match_ratio: float = 1.0,
+) -> bool:
+    """Token-level match (≈ `check_accuracy` :240). Compares up to the first EOS/pad
+    divergence and reports the match ratio per sequence."""
+    actual = np.asarray(actual_tokens)
+    expected = np.asarray(expected_tokens)
+    t = min(actual.shape[1], expected.shape[1])
+    ok = True
+    for b in range(actual.shape[0]):
+        matches = actual[b, :t] == expected[b, :t]
+        ratio = float(matches.mean())
+        if ratio < minimum_match_ratio:
+            first_bad = int(np.argmin(matches))
+            logger.warning(
+                "seq %d: token match %.3f < %.3f (first divergence at step %d: "
+                "%d != %d)", b, ratio, minimum_match_ratio, first_bad,
+                actual[b, first_bad], expected[b, first_bad])
+            ok = False
+    return ok
+
+
+def check_logit_accuracy(
+    actual_logits: List[np.ndarray],    # per-step (B, V)
+    expected_logits: List[np.ndarray],  # per-step (B, V)
+    divergence_difference_tol: float = 0.001,
+    tol_map: Optional[Dict[int, Tuple[float, float]]] = None,
+) -> LogitMatchReport:
+    """Logit matching with divergence-index semantics (≈ `check_accuracy_logits`).
+
+    Steps are compared in order; the comparison for step i uses (rtol, atol) from the
+    ``tol_map`` entry with the largest key <= i (reference's per-position tol maps,
+    e.g. ``{0: (1e-5, 0.01), 50: (1e-5, 0.04)}``), defaulting to
+    (1e-5, divergence_difference_tol).
+    """
+    tol_map = dict(sorted((tol_map or {}).items()))
+    per_step_err: List[float] = []
+    divergence_index = -1
+    top1_hits = 0
+    top1_total = 0
+    passed = True
+
+    for i, (got, want) in enumerate(zip(actual_logits, expected_logits)):
+        got = np.asarray(got, dtype=np.float32)
+        want = np.asarray(want, dtype=np.float32)
+        rtol, atol = 1e-5, divergence_difference_tol
+        for k, (r, a) in tol_map.items():
+            if i >= k:
+                rtol, atol = r, a
+        err = float(np.max(np.abs(got - want)))
+        per_step_err.append(err)
+        top1 = np.argmax(got, axis=-1) == np.argmax(want, axis=-1)
+        top1_hits += int(top1.sum())
+        top1_total += top1.size
+        if not top1.all() and divergence_index < 0:
+            divergence_index = i
+        if not np.allclose(got, want, rtol=rtol, atol=atol):
+            passed = False
+            logger.warning("logit mismatch at step %d: max|err|=%.5f (atol=%.5f)",
+                           i, err, atol)
+
+    return LogitMatchReport(
+        passed=passed,
+        divergence_index=divergence_index,
+        max_abs_error=max(per_step_err) if per_step_err else 0.0,
+        top1_match_rate=top1_hits / max(top1_total, 1),
+        per_step_max_err=per_step_err,
+    )
+
+
+def get_hf_expected_outputs(hf_model, input_ids: np.ndarray, max_new_tokens: int,
+                            attention_mask: Optional[np.ndarray] = None):
+    """Greedy HF-CPU golden run returning (tokens (B,T), per-step logits list).
+
+    ≈ the reference generating goldens via HF generate with output_scores. Each row is
+    generated *unpadded* (HF's generate reads next-token logits from the last position,
+    which under right padding would be a pad token for shorter rows), then reassembled
+    into per-step (B, V) logits.
+    """
+    import torch
+
+    input_ids = np.asarray(input_ids)
+    b, s = input_ids.shape
+    if attention_mask is None:
+        lengths = np.full((b,), s, dtype=np.int64)
+    else:
+        lengths = np.asarray(attention_mask).sum(axis=1).astype(np.int64)
+
+    # disable EOS stopping so goldens cover all max_new_tokens steps; the TPU side is
+    # compared with eos disabled too (symmetric; EOS semantics are tested separately)
+    saved_eos = hf_model.generation_config.eos_token_id
+    hf_model.generation_config.eos_token_id = None
+    try:
+        rows_tokens = []
+        rows_scores = []
+        for i in range(b):
+            row = input_ids[i, : lengths[i]][None, :]
+            with torch.no_grad():
+                out = hf_model.generate(
+                    torch.tensor(row), max_new_tokens=max_new_tokens,
+                    do_sample=False, pad_token_id=0, output_scores=True,
+                    return_dict_in_generate=True)
+            rows_tokens.append(out.sequences[0, lengths[i]:].numpy())
+            rows_scores.append([sc[0].numpy() for sc in out.scores])
+    finally:
+        hf_model.generation_config.eos_token_id = saved_eos
+
+    tokens = np.stack(rows_tokens)
+    logits = [np.stack([rows_scores[i][t] for i in range(b)])
+              for t in range(max_new_tokens)]
+    return tokens, logits
+
+
+def check_accuracy_vs_hf(app, hf_model, input_ids: np.ndarray, max_new_tokens: int,
+                         attention_mask: Optional[np.ndarray] = None,
+                         divergence_difference_tol: float = 0.001,
+                         tol_map=None) -> LogitMatchReport:
+    """One-call harness: run both sides greedy, token-match and logit-match."""
+    expected_tokens, expected_logits = get_hf_expected_outputs(
+        hf_model, input_ids, max_new_tokens, attention_mask)
+    out = app.generate(np.asarray(input_ids), attention_mask=attention_mask,
+                       max_new_tokens=max_new_tokens, return_logits=True)
+    token_ok = check_token_accuracy(out.tokens, expected_tokens)
+    report = check_logit_accuracy(out.logits, expected_logits,
+                                  divergence_difference_tol, tol_map)
+    report.passed = report.passed and token_ok
+    return report

@@ -1,0 +1,133 @@
+"""Benchmark harness: latency percentiles, TTFT, throughput, JSON report.
+
+≈ reference `utils/benchmark.py` (`benchmark_sampling` :21-203, percentile report
+:479-494, `benchmark_report.json` :199-201). Metrics keep the reference's definitions:
+latency percentiles p50/p90/p95/p99/p100/avg over e2e generate calls; throughput =
+(n_runs * output_tokens * batch) / total_time. Adds TTFT and decode-only tok/s, which
+are the BASELINE.md headline metrics.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+BENCHMARK_REPORT_FILENAME = "benchmark_report.json"
+
+
+@dataclass
+class BenchmarkReport:
+    e2e_latency_ms: Dict[str, float]
+    ttft_ms: Dict[str, float]
+    decode_tok_s: float
+    throughput_tok_s: float
+    n_runs: int
+    batch_size: int
+    max_new_tokens: int
+    extra: Dict[str, float] = field(default_factory=dict)
+
+    def to_dict(self) -> Dict:
+        return {
+            "e2e_model": self.e2e_latency_ms,
+            "ttft_ms": self.ttft_ms,
+            "decode_tokens_per_second": self.decode_tok_s,
+            "throughput_tokens_per_second": self.throughput_tok_s,
+            "n_runs": self.n_runs,
+            "batch_size": self.batch_size,
+            "max_new_tokens": self.max_new_tokens,
+            **self.extra,
+        }
+
+    def save(self, directory: str) -> str:
+        os.makedirs(directory, exist_ok=True)
+        path = os.path.join(directory, BENCHMARK_REPORT_FILENAME)
+        with open(path, "w") as f:
+            json.dump(self.to_dict(), f, indent=2)
+        return path
+
+
+def percentiles(values_s: List[float]) -> Dict[str, float]:
+    """p50/p90/p95/p99/p100/avg in milliseconds (reference metric definitions)."""
+    arr = np.asarray(values_s, dtype=np.float64) * 1e3
+    return {
+        "latency_ms_p50": float(np.percentile(arr, 50)),
+        "latency_ms_p90": float(np.percentile(arr, 90)),
+        "latency_ms_p95": float(np.percentile(arr, 95)),
+        "latency_ms_p99": float(np.percentile(arr, 99)),
+        "latency_ms_p100": float(np.percentile(arr, 100)),
+        "latency_ms_avg": float(np.mean(arr)),
+    }
+
+
+def benchmark_sampling(
+    app,
+    input_ids: Optional[np.ndarray] = None,
+    max_new_tokens: int = 64,
+    n_runs: int = 5,
+    warmup_runs: int = 1,
+    report_dir: Optional[str] = None,
+) -> BenchmarkReport:
+    """Measure end-to-end generate latency/throughput (≈ `benchmark_sampling` :21)."""
+    cfg = app.tpu_config
+    if input_ids is None:
+        rng = np.random.default_rng(0)
+        prompt_len = max(8, cfg.max_context_length // 2)
+        input_ids = rng.integers(1, app.arch_args.vocab_size,
+                                 size=(cfg.batch_size, prompt_len)).astype(np.int32)
+
+    for _ in range(warmup_runs):
+        app.generate(input_ids, max_new_tokens=max_new_tokens)
+
+    e2e: List[float] = []
+    ttft: List[float] = []
+    decode_s = 0.0
+    decode_tokens = 0
+    total_t0 = time.perf_counter()
+    for _ in range(n_runs):
+        t0 = time.perf_counter()
+        out = app.generate(input_ids, max_new_tokens=max_new_tokens,
+                           collect_latency=True)
+        e2e.append(time.perf_counter() - t0)
+        ttft.append(out.ttft_s)
+        for s, toks in out.decode_latencies_s or []:
+            decode_s += s
+            decode_tokens += toks * input_ids.shape[0]
+    total_time = time.perf_counter() - total_t0
+
+    report = BenchmarkReport(
+        e2e_latency_ms=percentiles(e2e),
+        ttft_ms=percentiles(ttft),
+        decode_tok_s=decode_tokens / decode_s if decode_s else 0.0,
+        throughput_tok_s=(n_runs * max_new_tokens * input_ids.shape[0]) / total_time,
+        n_runs=n_runs,
+        batch_size=int(input_ids.shape[0]),
+        max_new_tokens=max_new_tokens,
+    )
+    if report_dir:
+        report.save(report_dir)
+    return report
+
+
+class LatencyCollector:
+    """Context-manager timer collecting wall-clock samples
+    (≈ reference `LatencyCollector` forward-hook timers, `utils/benchmark.py:432-477`;
+    functional JAX has no module hooks, so collection wraps call sites instead)."""
+
+    def __init__(self) -> None:
+        self.samples_s: List[float] = []
+        self._t0 = 0.0
+
+    def __enter__(self) -> "LatencyCollector":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.samples_s.append(time.perf_counter() - self._t0)
+
+    def report(self) -> Dict[str, float]:
+        return percentiles(self.samples_s)

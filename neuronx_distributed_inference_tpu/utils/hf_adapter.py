@@ -1,0 +1,100 @@
+"""HuggingFace-style generation adapter.
+
+≈ reference `utils/hf_adapter.py` (`HuggingFaceGenerationAdapter` :104, `_sample` loop
+:139-257). The TPU application's own `generate` already runs the on-device sampling
+loop; this adapter provides the familiar HF calling convention on top — torch/np tensor
+inputs, `GenerationConfig`-style kwargs, tokenizer round-trips — so reference users can
+swap in without changing their driver code.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..ops.sampling import prepare_sampling_params
+
+
+class HuggingFaceGenerationAdapter:
+    """Wraps a TpuModelForCausalLM with an HF-`generate`-shaped API."""
+
+    def __init__(self, app, tokenizer=None):
+        self.app = app
+        self.tokenizer = tokenizer
+        self.config = app.config
+
+    def generate(
+        self,
+        input_ids=None,
+        attention_mask=None,
+        max_new_tokens: int = 32,
+        max_length: Optional[int] = None,
+        do_sample: bool = False,
+        top_k: int = 50,
+        top_p: float = 1.0,
+        temperature: float = 1.0,
+        eos_token_id: Optional[int] = None,
+        pad_token_id: Optional[int] = None,
+        seed: int = 0,
+        **ignored,
+    ):
+        """HF-compatible subset: returns full sequences (prompt + generated) shaped like
+        `transformers` `generate` with right padding."""
+        is_torch = _is_torch(input_ids)
+        ids = _to_numpy(input_ids)
+        mask = _to_numpy(attention_mask) if attention_mask is not None else None
+        if max_length is not None:
+            max_new_tokens = max_length - ids.shape[1]
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+
+        if eos_token_id is None:
+            # default from tokenizer / model config, like HF generate
+            if self.tokenizer is not None:
+                eos_token_id = getattr(self.tokenizer, "eos_token_id", None)
+            if eos_token_id is None:
+                eos_token_id = getattr(self.config, "eos_token_id", None)
+            if isinstance(eos_token_id, (list, tuple)):
+                eos_token_id = eos_token_id[0] if eos_token_id else None
+
+        batch = ids.shape[0]
+        if do_sample:
+            sampling_params = prepare_sampling_params(
+                batch, top_k=top_k, top_p=top_p, temperature=temperature)
+        else:
+            sampling_params = prepare_sampling_params(batch)  # greedy
+
+        out = self.app.generate(
+            ids, attention_mask=mask, max_new_tokens=max_new_tokens,
+            sampling_params=sampling_params,
+            eos_token_id=eos_token_id, pad_token_id=pad_token_id or 0, seed=seed)
+        sequences = out.sequences
+        if is_torch:
+            import torch
+
+            sequences = torch.tensor(sequences, dtype=torch.long)
+        return sequences
+
+    def __call__(self, *args, **kwargs):
+        return self.generate(*args, **kwargs)
+
+    def generate_text(self, prompts, max_new_tokens: int = 64, **kwargs):
+        """Tokenizer-in, strings-out convenience."""
+        if self.tokenizer is None:
+            raise ValueError("construct the adapter with a tokenizer to use "
+                             "generate_text")
+        enc = self.tokenizer(list(prompts), return_tensors="np", padding=True)
+        seqs = self.generate(enc["input_ids"], attention_mask=enc["attention_mask"],
+                             max_new_tokens=max_new_tokens, **kwargs)
+        return self.tokenizer.batch_decode(np.asarray(seqs), skip_special_tokens=True)
+
+
+def _is_torch(x) -> bool:
+    return type(x).__module__.startswith("torch")
+
+
+def _to_numpy(x) -> np.ndarray:
+    if _is_torch(x):
+        return x.detach().cpu().numpy()
+    return np.asarray(x)

@@ -1,0 +1,147 @@
+"""Tests for the accuracy/benchmark harnesses, HF adapter, and CLI.
+
+≈ reference coverage of `utils/accuracy.py`, `utils/benchmark.py`, `utils/hf_adapter.py`
+and the `inference_demo` flow.
+"""
+
+import json
+
+import numpy as np
+import pytest
+import torch
+
+from neuronx_distributed_inference_tpu.utils import accuracy as acc
+from neuronx_distributed_inference_tpu.utils import benchmark as bench
+
+
+# --- accuracy -----------------------------------------------------------------------
+
+def test_token_accuracy_pass_and_fail():
+    a = np.array([[1, 2, 3], [4, 5, 6]])
+    assert acc.check_token_accuracy(a, a.copy())
+    b = a.copy()
+    b[1, 2] = 99
+    assert not acc.check_token_accuracy(a, b)
+    assert acc.check_token_accuracy(a, b, minimum_match_ratio=0.6)
+
+
+def test_logit_accuracy_divergence_index_and_tolmap():
+    want = [np.array([[0.0, 1.0, 0.5]]), np.array([[1.0, 0.0, 0.2]])]
+    got_ok = [w + 1e-6 for w in want]
+    r = acc.check_logit_accuracy(got_ok, want)
+    assert r.passed and r.divergence_index == -1 and r.top1_match_rate == 1.0
+
+    got_bad = [want[0].copy(), np.array([[0.0, 1.0, 0.2]])]  # argmax flips at step 1
+    r = acc.check_logit_accuracy(got_bad, want)
+    assert not r.passed and r.divergence_index == 1
+
+    # tol_map loosens step >= 1 enough to pass numerically
+    r = acc.check_logit_accuracy(got_bad, want, tol_map={1: (1.0, 2.0)})
+    assert r.passed and r.divergence_index == 1  # divergence still reported
+
+
+@pytest.fixture(scope="module")
+def tiny_ckpt(tmp_path_factory):
+    from transformers import LlamaConfig, LlamaForCausalLM as HFLlama
+
+    path = tmp_path_factory.mktemp("ckpt")
+    cfg = LlamaConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=8,
+                      num_key_value_heads=4, max_position_embeddings=512,
+                      rms_norm_eps=1e-5, rope_theta=10000.0,
+                      tie_word_embeddings=False)
+    torch.manual_seed(0)
+    HFLlama(cfg).eval().save_pretrained(str(path), safe_serialization=True)
+    return str(path)
+
+
+@pytest.fixture(scope="module")
+def tiny_app(tiny_ckpt):
+    from neuronx_distributed_inference_tpu.config import TpuConfig
+    from neuronx_distributed_inference_tpu.models.llama.modeling_llama import (
+        LlamaForCausalLM)
+
+    return LlamaForCausalLM.from_pretrained(
+        tiny_ckpt, TpuConfig(batch_size=2, seq_len=64, max_context_length=32,
+                             dtype="float32", context_encoding_buckets=[32],
+                             token_generation_buckets=[64]))
+
+
+def test_check_accuracy_vs_hf_end_to_end(tiny_app, tiny_ckpt):
+    import transformers
+
+    hf = transformers.AutoModelForCausalLM.from_pretrained(
+        tiny_ckpt, torch_dtype="float32").eval()
+    rng = np.random.default_rng(0)
+    input_ids = rng.integers(1, 256, size=(2, 10)).astype(np.int64)
+    report = acc.check_accuracy_vs_hf(tiny_app, hf, input_ids, max_new_tokens=6,
+                                      divergence_difference_tol=0.01)
+    assert report.passed, f"divergence at {report.divergence_index}: " \
+                          f"{report.per_step_max_err}"
+
+
+# --- benchmark ----------------------------------------------------------------------
+
+def test_percentiles_keys():
+    rep = bench.percentiles([0.1, 0.2, 0.3])
+    assert set(rep) == {"latency_ms_p50", "latency_ms_p90", "latency_ms_p95",
+                        "latency_ms_p99", "latency_ms_p100", "latency_ms_avg"}
+    assert rep["latency_ms_p50"] == pytest.approx(200.0)
+
+
+def test_benchmark_sampling_report(tiny_app, tmp_path):
+    report = bench.benchmark_sampling(tiny_app, max_new_tokens=8, n_runs=2,
+                                      warmup_runs=1, report_dir=str(tmp_path))
+    assert report.decode_tok_s > 0
+    assert report.throughput_tok_s > 0
+    saved = json.loads((tmp_path / bench.BENCHMARK_REPORT_FILENAME).read_text())
+    assert saved["n_runs"] == 2
+    assert "latency_ms_p50" in saved["e2e_model"]
+
+
+def test_latency_collector():
+    col = bench.LatencyCollector()
+    for _ in range(3):
+        with col:
+            pass
+    assert len(col.samples_s) == 3
+
+
+# --- HF adapter ---------------------------------------------------------------------
+
+def test_hf_adapter_torch_roundtrip(tiny_app, tiny_ckpt):
+    import transformers
+
+    from neuronx_distributed_inference_tpu.utils.hf_adapter import (
+        HuggingFaceGenerationAdapter)
+
+    hf = transformers.AutoModelForCausalLM.from_pretrained(
+        tiny_ckpt, torch_dtype="float32").eval()
+    adapter = HuggingFaceGenerationAdapter(tiny_app)
+    ids = torch.tensor([[5, 9, 42, 7, 101, 33]])
+    seqs = adapter.generate(ids, max_new_tokens=8, do_sample=False)
+    assert isinstance(seqs, torch.Tensor)
+    with torch.no_grad():
+        want = hf.generate(ids, max_new_tokens=8, do_sample=False, pad_token_id=0)
+    np.testing.assert_array_equal(seqs.numpy(), want.numpy())
+
+
+# --- CLI ----------------------------------------------------------------------------
+
+def test_inference_demo_cli(tiny_ckpt, capsys):
+    from neuronx_distributed_inference_tpu.inference_demo import main
+
+    rc = main([
+        "--model-path", tiny_ckpt,
+        "--batch-size", "2", "--seq-len", "64", "--max-context-length", "32",
+        "--dtype", "float32", "--max-new-tokens", "6",
+        "--context-encoding-buckets", "32",
+        "--token-generation-buckets", "64",
+        "--check-accuracy-mode", "logit-matching",
+        "--divergence-difference-tol", "0.01",
+        "--benchmark", "--benchmark-runs", "2",
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "logit matching: passed=True" in out
+    assert "decode_tokens_per_second" in out
