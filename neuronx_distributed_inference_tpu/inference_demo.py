@@ -168,6 +168,10 @@ def _encode_prompts(args, tokenizer, vocab_size: int = 1000) -> tuple:
         ids = rng.integers(1, min(1000, vocab_size),
                            size=(args.batch_size, 16)).astype(np.int32)
         return ids, None
+    if len(prompts) > args.batch_size:
+        logger.warning("%d prompts exceed --batch-size %d; using the first %d",
+                       len(prompts), args.batch_size, args.batch_size)
+        prompts = prompts[: args.batch_size]
     if len(prompts) < args.batch_size:
         prompts = (prompts * args.batch_size)[: args.batch_size]
     enc = tokenizer(prompts, return_tensors="np", padding=True)
@@ -225,10 +229,19 @@ def _run_generation(args, app, tokenizer) -> None:
         for prompt, text in zip(prompts, texts):
             print(f"--- prompt: {prompt!r}\n{text}\n")
     else:
+        from .ops.sampling import prepare_sampling_params
+
         input_ids, attention_mask = _encode_prompts(args, tokenizer,
                                                     app.arch_args.vocab_size)
+        if args.do_sample:
+            sp = prepare_sampling_params(input_ids.shape[0], top_k=args.top_k,
+                                         top_p=args.top_p,
+                                         temperature=args.temperature)
+        else:
+            sp = None
         out = app.generate(input_ids, attention_mask=attention_mask,
-                           max_new_tokens=args.max_new_tokens)
+                           max_new_tokens=args.max_new_tokens,
+                           sampling_params=sp, seed=args.seed)
         print("generated token ids:")
         print(out.tokens)
 

@@ -183,6 +183,35 @@ def _mlp(lp: Params, args: ModelArchArgs, hn: jnp.ndarray, mesh, rules) -> jnp.n
     return inter @ lp["wd"]
 
 
+def _sharded_flash_attention(q, k, v, args: ModelArchArgs, mesh, rules):
+    """Run the Pallas flash kernel with heads local per shard.
+
+    Pallas calls have no GSPMD partitioning rule, so under a mesh the kernel is wrapped
+    in `shard_map` over (batch->dp, heads->tp/ep): each shard runs the kernel on its
+    local heads — the same SPMD shape as the reference launching one NKI kernel per
+    core (`attention_base.py:121-125`).
+    """
+    shard_map = jax.shard_map
+
+    from ..ops.flash_attention import flash_attention
+    from ..parallel.sharding import DEFAULT_RULES, logical_to_spec
+
+    interpret = jax.default_backend() == "cpu"   # CPU runs (tests) interpret the kernel
+
+    def _local(q, k, v):
+        return flash_attention(q, k, v, causal=True, scale=args.attention_scale,
+                               window=args.sliding_window, interpret=interpret)
+
+    if mesh is None:
+        return _local(q, k, v)
+    r = rules or DEFAULT_RULES
+    q_spec = logical_to_spec(("batch", "heads", None, None), r)
+    kv_spec = logical_to_spec(("batch", "kv_heads", None, None), r)
+    fn = shard_map(_local, mesh=mesh, in_specs=(q_spec, kv_spec, kv_spec),
+                   out_specs=q_spec, check_vma=False)
+    return fn(q, k, v)
+
+
 def _decoder_layer(
     lp: Params,
     args: ModelArchArgs,
@@ -197,6 +226,7 @@ def _decoder_layer(
     mesh,
     rules=None,
     sinks: Optional[jnp.ndarray] = None,
+    use_flash: bool = False,
 ):
     resid = h
     hn = rms_norm(h, lp["ln1"], args.rms_norm_eps)
@@ -217,8 +247,11 @@ def _decoder_layer(
         k_att = kvcache.read_bucket(k_cache, decode_bucket)
         v_att = kvcache.read_bucket(v_cache, decode_bucket)
 
-    attn = attend(q, k_att, v_att, mask=mask, scale=args.attention_scale,
-                  logits_soft_cap=args.logits_soft_cap, sinks=sinks)
+    if use_flash and positions is None:
+        attn = _sharded_flash_attention(q, k_att, v_att, args, mesh, rules)
+    else:
+        attn = attend(q, k_att, v_att, mask=mask, scale=args.attention_scale,
+                      logits_soft_cap=args.logits_soft_cap, sinks=sinks)
     attn = attn.transpose(0, 2, 1, 3).reshape(h.shape[0], h.shape[1], args.q_size)
     h = resid + constrain(attn @ lp["wo"], ("batch", None, None), rules, mesh=mesh)
 
@@ -230,13 +263,14 @@ def _decoder_layer(
 
 
 def _run_stack(params: Params, args: ModelArchArgs, h, cos, sin, mask, cache,
-               positions, decode_bucket, mesh, rules):
+               positions, decode_bucket, mesh, rules, use_flash=False):
     """Scan the decoder layers, carrying hidden state, yielding updated cache."""
 
     def body(carry_h, xs):
         lp, kc, vc = xs
         new_h, kc, vc = _decoder_layer(lp, args, carry_h, cos, sin, mask, kc, vc,
-                                       positions, decode_bucket, mesh, rules)
+                                       positions, decode_bucket, mesh, rules,
+                                       use_flash=use_flash)
         return new_h, (kc, vc)
 
     h, (k_new, v_new) = jax.lax.scan(body, h, (params["layers"], cache["k"], cache["v"]))
@@ -266,6 +300,7 @@ def prefill_forward(
     cache: kvcache.KVCache,       # donated
     mesh=None,
     rules=None,
+    use_flash: bool = False,
 ) -> Tuple[jnp.ndarray, kvcache.KVCache]:
     """Context encoding: returns (last-token logits (B, V) fp32, updated cache)."""
     h = _embed(params, args, input_ids, mesh, rules)
@@ -280,7 +315,8 @@ def prefill_forward(
         mask = jnp.logical_and(mask, kv_pos > q_pos - args.sliding_window)
 
     h, cache = _run_stack(params, args, h, cos, sin, mask, cache,
-                          positions=None, decode_bucket=None, mesh=mesh, rules=rules)
+                          positions=None, decode_bucket=None, mesh=mesh, rules=rules,
+                          use_flash=use_flash)
     h = rms_norm(h, params["final_norm"], args.rms_norm_eps)
     h_last = jnp.take_along_axis(h, last_token_idx[:, None, None], axis=1)[:, 0]
     logits = _lm_head(params, args, h_last, mesh, rules)

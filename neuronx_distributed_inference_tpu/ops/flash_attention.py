@@ -1,0 +1,181 @@
+"""Pallas flash-attention prefill kernel (causal, GQA-native, CP-offset-aware).
+
+≈ reference NKI prefill kernels: `attention_isa_kernel`
+(`modules/attention/attention_base.py:51-53,122`), the newer
+`attention_nki_kernel_adapter` with native GQA + `cp_offset`/`global_cp_deg` args for
+context parallelism (`attention_base.py:88-121,684-713`), and the sliding-window
+`flash_fwd` (`modules/sliding_window/attention.py`). One kernel covers all three on TPU:
+
+- online-softmax flash attention over (block_q, block_k) tiles; fp32 accumulation,
+  bf16 MXU matmuls;
+- GQA without repeating KV: the kv head is selected in the BlockSpec index map
+  (``h // n_rep``), so KV tiles are fetched once per kv head;
+- ``q_offset`` shifts absolute query positions — the context-parallel rank offset
+  (reference `cp_offset`) and the chunked-prefill resume offset use the same mechanism;
+- optional ``sliding_window`` adds the in-window constraint (SWA prefill kernel);
+- causal tiles strictly above the diagonal are predicated off (`@pl.when`), skipping
+  their compute like the reference kernels' trapezoid scheduling.
+
+Grid: (batch, q_heads, q_blocks, kv_blocks); the innermost kv dimension iterates
+sequentially on-core, carrying running (max, sum, acc) in VMEM scratch.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+NEG_INF = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scratch, l_scratch, acc_scratch, *,
+                  scale: float, q_offset: int, block_q: int, block_k: int,
+                  num_kv_blocks: int, causal: bool, window: Optional[int],
+                  kv_len: int):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+
+    q_start = qi * block_q + q_offset        # absolute position of query row 0
+    k_start = ki * block_k                   # absolute position of kv col 0
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scratch[:] = jnp.full_like(m_scratch, NEG_INF)
+        l_scratch[:] = jnp.zeros_like(l_scratch)
+        acc_scratch[:] = jnp.zeros_like(acc_scratch)
+
+    # causal: the whole tile is masked iff its first kv position exceeds the last
+    # query position; predicate the body off to skip the compute entirely
+    run = k_start < kv_len                   # skip tiles entirely in kv padding
+    if causal:
+        run = jnp.logical_and(run, k_start <= q_start + block_q - 1)
+    if window is not None:
+        run = jnp.logical_and(run, k_start + block_k - 1 > q_start - window)
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0, 0]                      # (block_q, D)
+        k = k_ref[0, 0]                      # (block_k, D)
+        v = v_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # (block_q, block_k)
+
+        q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        kv_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        mask = kv_pos < kv_len               # hide zero-padded kv columns
+        if causal:
+            mask = jnp.logical_and(mask, kv_pos <= q_pos)
+        if window is not None:
+            mask = jnp.logical_and(mask, kv_pos > q_pos - window)
+        s = jnp.where(mask, s, NEG_INF)
+
+        # m/l scratches are (block_q, 128) with all lanes equal (TPU lane-width tiles)
+        m_prev = m_scratch[:, 0:1]           # (block_q, 1)
+        l_prev = l_scratch[:, 0:1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        # rows with no valid kv yet keep m = -inf; guard the exp against -inf - -inf
+        alpha = jnp.exp(jnp.minimum(m_prev - m_new, 0.0))
+        p = jnp.exp(s - m_new)               # (block_q, block_k) fp32
+        l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+
+        acc = acc_scratch[:] * alpha
+        acc = acc + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scratch[:] = jnp.broadcast_to(m_new, m_scratch.shape)
+        l_scratch[:] = jnp.broadcast_to(l_new, l_scratch.shape)
+        acc_scratch[:] = acc
+
+    @pl.when(ki == num_kv_blocks - 1)
+    def _finalize():
+        l = l_scratch[:, 0:1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)   # fully-masked rows -> zeros, not NaN
+        o_ref[0, 0] = (acc_scratch[:] / l_safe).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("causal", "scale", "q_offset", "window", "block_q", "block_k",
+                     "interpret"))
+def flash_attention(
+    q: jnp.ndarray,              # (B, Hq, Sq, D)
+    k: jnp.ndarray,              # (B, Hkv, Skv, D)
+    v: jnp.ndarray,              # (B, Hkv, Skv, D)
+    causal: bool = True,
+    scale: Optional[float] = None,
+    q_offset: int = 0,
+    window: Optional[int] = None,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Tiled causal flash attention; returns (B, Hq, Sq, D) in q.dtype.
+
+    Inputs need not be multiples of the block sizes — they are padded here and the
+    output sliced back (bucket ladders make the common shapes already aligned).
+    """
+    b, hq, sq, d = q.shape
+    _, hkv, skv, _ = k.shape
+    if hq % hkv != 0:
+        raise ValueError(f"q heads {hq} not divisible by kv heads {hkv}")
+    n_rep = hq // hkv
+    if scale is None:
+        scale = d ** -0.5
+
+    block_q = min(block_q, _round_up(sq, 8))
+    block_k = min(block_k, _round_up(skv, 8))
+    sq_p = _round_up(sq, block_q)
+    skv_p = _round_up(skv, block_k)
+    if sq_p != sq:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, sq_p - sq), (0, 0)))
+    if skv_p != skv:
+        # padded kv columns are masked in-kernel via kv_len
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, skv_p - skv), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, skv_p - skv), (0, 0)))
+
+    num_q_blocks = sq_p // block_q
+    num_kv_blocks = skv_p // block_k
+
+    kernel = functools.partial(
+        _flash_kernel, scale=scale, q_offset=q_offset, block_q=block_q,
+        block_k=block_k, num_kv_blocks=num_kv_blocks, causal=causal, window=window,
+        kv_len=skv)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(b, hq, num_q_blocks, num_kv_blocks),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, qi, ki, n_rep=n_rep: (bi, hi // n_rep, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, qi, ki, n_rep=n_rep: (bi, hi // n_rep, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, d),
+                               lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, hq, sq_p, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+
+    if sq_p != sq:
+        out = out[:, :, :sq, :]
+    return out
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m

@@ -130,13 +130,14 @@ class TpuModelForCausalLM:
         precision = "highest" if self.tpu_config.dtype == "float32" else "default"
 
         rules = self.sharding_rules
+        use_flash = self._use_flash_attention()
 
         def _prefill(params, input_ids, position_ids, last_token_idx, cache,
                      sampling_params, key):
             with jax.default_matmul_precision(precision):
                 logits, cache = prefill_core(params, args, input_ids, position_ids,
                                              last_token_idx, cache, mesh=mesh,
-                                             rules=rules)
+                                             rules=rules, use_flash=use_flash)
                 tokens = sampling_ops.sample(logits, sampling_params, key, odsc)
             return tokens, logits, cache
 
@@ -169,6 +170,21 @@ class TpuModelForCausalLM:
         self._decode_step = jax.jit(
             _decode, donate_argnums=(3,),
             static_argnames=("decode_bucket", "num_steps", "with_logits"))
+
+    def _use_flash_attention(self) -> bool:
+        """Auto-select the Pallas prefill kernel (≈ reference
+        `get_flash_attention_strategy`, `attention_base.py:1330`): explicit config wins;
+        otherwise on for TPU backends when the arch has no unsupported extras, off for
+        CPU (Pallas needs interpret mode there)."""
+        cfg = self.tpu_config.attention_kernel_enabled
+        if cfg is not None:
+            return cfg
+        a = self.arch_args
+        if a.logits_soft_cap is not None:
+            return False
+        if a.num_heads % (self.mesh.shape["tp"] * self.mesh.shape["ep"]) != 0:
+            return False
+        return jax.default_backend() not in ("cpu",)
 
     # --- weights ----------------------------------------------------------------------
     def _param_shardings(self):

@@ -87,6 +87,7 @@ def benchmark_sampling(
     ttft: List[float] = []
     decode_s = 0.0
     decode_tokens = 0
+    generated_tokens = 0
     total_t0 = time.perf_counter()
     for _ in range(n_runs):
         t0 = time.perf_counter()
@@ -94,6 +95,7 @@ def benchmark_sampling(
                            collect_latency=True)
         e2e.append(time.perf_counter() - t0)
         ttft.append(out.ttft_s)
+        generated_tokens += out.tokens.size
         for s, toks in out.decode_latencies_s or []:
             decode_s += s
             decode_tokens += toks * input_ids.shape[0]
@@ -103,7 +105,7 @@ def benchmark_sampling(
         e2e_latency_ms=percentiles(e2e),
         ttft_ms=percentiles(ttft),
         decode_tok_s=decode_tokens / decode_s if decode_s else 0.0,
-        throughput_tok_s=(n_runs * max_new_tokens * input_ids.shape[0]) / total_time,
+        throughput_tok_s=generated_tokens / total_time,
         n_runs=n_runs,
         batch_size=int(input_ids.shape[0]),
         max_new_tokens=max_new_tokens,

@@ -1,0 +1,84 @@
+"""Pallas flash-attention parity vs the jnp reference (interpret mode on CPU).
+
+≈ reference kernel-vs-native parity tests (`utils/testing.py:67-120` pattern applied to
+the NKI attention kernels).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from neuronx_distributed_inference_tpu.ops import attention as attn_ops
+from neuronx_distributed_inference_tpu.ops.flash_attention import flash_attention
+
+
+def _ref_attention(q, k, v, causal=True, q_offset=0, window=None, scale=None):
+    sq, skv = q.shape[2], k.shape[2]
+    if window is not None:
+        mask = attn_ops.sliding_window_mask(sq, skv, window, q_offset=q_offset)
+    else:
+        mask = attn_ops.causal_mask(sq, skv, q_offset=q_offset)
+    with jax.default_matmul_precision("highest"):
+        return attn_ops.attend(q, k, v, mask=mask[None, None], scale=scale)
+
+
+def _rand(shape, seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.standard_normal(shape, dtype=np.float32))
+
+
+@pytest.mark.parametrize("sq,skv,block", [(256, 256, 128), (128, 128, 64),
+                                          (384, 384, 128)])
+def test_flash_matches_reference_causal(sq, skv, block):
+    b, hq, hkv, d = 2, 4, 2, 64
+    q, k, v = _rand((b, hq, sq, d), 1), _rand((b, hkv, skv, d), 2), _rand(
+        (b, hkv, skv, d), 3)
+    got = flash_attention(q, k, v, causal=True, block_q=block, block_k=block,
+                          interpret=True)
+    want = _ref_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5, rtol=1e-4)
+
+
+def test_flash_unaligned_seq_padding():
+    b, hq, hkv, d = 1, 2, 1, 32
+    q, k, v = _rand((b, hq, 200, d), 4), _rand((b, hkv, 200, d), 5), _rand(
+        (b, hkv, 200, d), 6)
+    got = flash_attention(q, k, v, causal=True, block_q=128, block_k=128,
+                          interpret=True)
+    want = _ref_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5, rtol=1e-4)
+
+
+def test_flash_q_offset_cp_trapezoid():
+    """q rows are a CP shard starting at absolute position 128 over the full kv."""
+    b, hq, hkv, d = 1, 2, 2, 32
+    full_q = _rand((b, hq, 256, d), 7)
+    k, v = _rand((b, hkv, 256, d), 8), _rand((b, hkv, 256, d), 9)
+    shard_q = full_q[:, :, 128:, :]
+    got = flash_attention(shard_q, k, v, causal=True, q_offset=128, interpret=True)
+    want = _ref_attention(full_q, k, v, causal=True)[:, :, 128:, :]
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5, rtol=1e-4)
+
+
+def test_flash_sliding_window():
+    b, hq, hkv, d = 1, 2, 1, 32
+    q, k, v = _rand((b, hq, 256, d), 10), _rand((b, hkv, 256, d), 11), _rand(
+        (b, hkv, 256, d), 12)
+    got = flash_attention(q, k, v, causal=True, window=64, block_q=64, block_k=64,
+                          interpret=True)
+    want = _ref_attention(q, k, v, causal=True, window=64)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5, rtol=1e-4)
+
+
+def test_flash_bf16_reasonable():
+    b, hq, hkv, d = 1, 4, 2, 64
+    q = _rand((b, hq, 256, d), 13).astype(jnp.bfloat16)
+    k = _rand((b, hkv, 256, d), 14).astype(jnp.bfloat16)
+    v = _rand((b, hkv, 256, d), 15).astype(jnp.bfloat16)
+    got = flash_attention(q, k, v, causal=True, interpret=True)
+    assert got.dtype == jnp.bfloat16
+    want = _ref_attention(q.astype(jnp.float32), k.astype(jnp.float32),
+                          v.astype(jnp.float32), causal=True)
+    np.testing.assert_allclose(np.asarray(got, dtype=np.float32), np.asarray(want),
+                               atol=0.03, rtol=0.05)
