@@ -5,6 +5,12 @@ from typing import Dict, Type
 _REGISTRY: Dict[str, str] = {
     # hf model_type -> "module:class"
     "llama": "neuronx_distributed_inference_tpu.models.llama.modeling_llama:LlamaForCausalLM",
+    "qwen2": "neuronx_distributed_inference_tpu.models.qwen2.modeling_qwen2:Qwen2ForCausalLM",
+    "qwen3": "neuronx_distributed_inference_tpu.models.qwen3.modeling_qwen3:Qwen3ForCausalLM",
+    "gemma3": "neuronx_distributed_inference_tpu.models.gemma3.modeling_gemma3:Gemma3ForCausalLM",
+    "gemma3_text": "neuronx_distributed_inference_tpu.models.gemma3.modeling_gemma3:Gemma3ForCausalLM",
+    "mixtral": "neuronx_distributed_inference_tpu.models.mixtral.modeling_mixtral:MixtralForCausalLM",
+    "qwen3_moe": "neuronx_distributed_inference_tpu.models.qwen3_moe.modeling_qwen3_moe:Qwen3MoeForCausalLM",
 }
 
 

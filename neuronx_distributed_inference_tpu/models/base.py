@@ -30,6 +30,7 @@ import numpy as np
 from ..modules import kvcache
 from ..ops import rope as rope_ops
 from ..ops.attention import attend, causal_mask
+from ..ops.moe import MoEArgs, moe_block
 from ..ops.norms import rms_norm
 from ..parallel.sharding import constrain
 
@@ -57,11 +58,20 @@ class ModelArchArgs:
     mlp_bias: bool = False
     qk_norm: bool = False                 # qwen3-style per-head RMSNorm on q/k
     sliding_window: Optional[int] = None  # gemma/gpt-oss SWA (applied to all layers if set)
+    # per-layer attention kind, e.g. ("sliding", "sliding", ..., "full") — gemma3's
+    # alternating local/global pattern; None = every layer identical
+    layer_pattern: Optional[Tuple[str, ...]] = None
+    # separate RoPE theta for sliding layers under a layer_pattern (gemma3 local rope)
+    local_rope_theta: Optional[float] = None
+    sandwich_norms: bool = False          # gemma-style post-attn/post-mlp branch norms
+    zero_centered_norms: bool = False     # gemma-style (1 + weight) RMSNorm scaling
     logits_soft_cap: Optional[float] = None
     attention_scale: Optional[float] = None   # None -> 1/sqrt(head_dim)
     embedding_multiplier: float = 1.0     # gemma scales embeddings by sqrt(hidden)
     tie_word_embeddings: bool = False
     rope_attention_scaling: float = 1.0   # HF rope_scaling attention_factor
+    # MoE FFN (Mixtral/Qwen3-MoE/DBRX); None = dense MLP. See ops/moe.py.
+    moe: Optional["MoEArgs"] = None
 
     @property
     def q_size(self) -> int:
@@ -81,10 +91,27 @@ def param_logical_axes(args: ModelArchArgs) -> Params:
         "wv": ("layers", "embed", "kv_heads"),
         "wo": ("layers", "heads", "embed"),
         "ln2": ("layers", None),
-        "wg": ("layers", "embed", "mlp"),
-        "wu": ("layers", "embed", "mlp"),
-        "wd": ("layers", "mlp", "embed"),
     }
+    if args.moe is not None:
+        layer.update({
+            "router": ("layers", "embed", None),
+            "wg": ("layers", "experts", "embed", "expert_mlp"),
+            "wu": ("layers", "experts", "embed", "expert_mlp"),
+            "wd": ("layers", "experts", "expert_mlp", "embed"),
+        })
+        if args.moe.shared_expert_intermediate_size:
+            layer.update({
+                "shared_wg": ("layers", "embed", "mlp"),
+                "shared_wu": ("layers", "embed", "mlp"),
+                "shared_wd": ("layers", "mlp", "embed"),
+                "shared_gate": ("layers", "embed", None),
+            })
+    else:
+        layer.update({
+            "wg": ("layers", "embed", "mlp"),
+            "wu": ("layers", "embed", "mlp"),
+            "wd": ("layers", "mlp", "embed"),
+        })
     if args.attention_bias:
         layer.update({
             "bq": ("layers", "heads"),
@@ -93,12 +120,16 @@ def param_logical_axes(args: ModelArchArgs) -> Params:
         })
     if args.qk_norm:
         layer.update({"q_norm": ("layers", None), "k_norm": ("layers", None)})
+    if args.sandwich_norms:
+        layer.update({"ln1_post": ("layers", None), "ln2_post": ("layers", None)})
     out = {
         "embed": ("vocab", "embed"),
         "layers": layer,
         "final_norm": (None,),
         "rope_inv_freq": (None,),
     }
+    if args.local_rope_theta is not None:
+        out["rope_inv_freq_local"] = (None,)
     if not args.tie_word_embeddings:
         out["lm_head"] = ("embed", "vocab")
     return out
@@ -108,7 +139,7 @@ def init_params(args: ModelArchArgs, key: jax.Array, dtype=jnp.bfloat16,
                 inv_freq: Optional[np.ndarray] = None) -> Params:
     """Random parameter pytree (tests / synthetic benchmarks; real weights come from
     utils/checkpoint + the per-arch converter)."""
-    ks = jax.random.split(key, 10)
+    ks = jax.random.split(key, 14)
     L, H, I = args.num_layers, args.hidden_size, args.intermediate_size
 
     def w(k, shape, scale=0.02):
@@ -121,29 +152,61 @@ def init_params(args: ModelArchArgs, key: jax.Array, dtype=jnp.bfloat16,
         "wv": w(ks[2], (L, H, args.kv_size)),
         "wo": w(ks[3], (L, args.q_size, H)),
         "ln2": jnp.ones((L, H), dtype=dtype),
-        "wg": w(ks[4], (L, H, I)),
-        "wu": w(ks[5], (L, H, I)),
-        "wd": w(ks[6], (L, I, H)),
     }
+    if args.moe is not None:
+        E = args.moe.num_experts
+        layers.update({
+            "router": w(ks[9], (L, H, E)),
+            "wg": w(ks[4], (L, E, H, I)),
+            "wu": w(ks[5], (L, E, H, I)),
+            "wd": w(ks[6], (L, E, I, H)),
+        })
+        shared_i = args.moe.shared_expert_intermediate_size
+        if shared_i:
+            layers.update({
+                "shared_wg": w(ks[10], (L, H, shared_i)),
+                "shared_wu": w(ks[11], (L, H, shared_i)),
+                "shared_wd": w(ks[12], (L, shared_i, H)),
+                "shared_gate": w(ks[13], (L, H, 1)),
+            })
+    else:
+        layers.update({
+            "wg": w(ks[4], (L, H, I)),
+            "wu": w(ks[5], (L, H, I)),
+            "wd": w(ks[6], (L, I, H)),
+        })
     if args.attention_bias:
         layers.update({
             "bq": jnp.zeros((L, args.q_size), dtype=dtype),
             "bk": jnp.zeros((L, args.kv_size), dtype=dtype),
             "bv": jnp.zeros((L, args.kv_size), dtype=dtype),
         })
+    norm_fill = 0.0 if args.zero_centered_norms else 1.0
     if args.qk_norm:
         layers.update({
-            "q_norm": jnp.ones((L, args.head_dim), dtype=dtype),
-            "k_norm": jnp.ones((L, args.head_dim), dtype=dtype),
+            "q_norm": jnp.full((L, args.head_dim), norm_fill, dtype=dtype),
+            "k_norm": jnp.full((L, args.head_dim), norm_fill, dtype=dtype),
         })
+    if args.sandwich_norms:
+        layers.update({
+            "ln1_post": jnp.full((L, H), norm_fill, dtype=dtype),
+            "ln2_post": jnp.full((L, H), norm_fill, dtype=dtype),
+        })
+    if args.zero_centered_norms:
+        layers["ln1"] = jnp.zeros((L, H), dtype=dtype)
+        layers["ln2"] = jnp.zeros((L, H), dtype=dtype)
     if inv_freq is None:
         inv_freq = rope_ops.default_inv_freq(args.head_dim)
     params = {
         "embed": w(ks[7], (args.vocab_size, H)),
         "layers": layers,
-        "final_norm": jnp.ones((H,), dtype=dtype),
+        "final_norm": jnp.full((H,), norm_fill, dtype=dtype),
         "rope_inv_freq": jnp.asarray(inv_freq, dtype=jnp.float32),
     }
+    if args.local_rope_theta is not None:
+        params["rope_inv_freq_local"] = jnp.asarray(
+            rope_ops.default_inv_freq(args.head_dim, args.local_rope_theta),
+            dtype=jnp.float32)
     if not args.tie_word_embeddings:
         params["lm_head"] = w(ks[8], (H, args.vocab_size))
     return params
@@ -170,8 +233,9 @@ def _project_qkv(lp: Params, args: ModelArchArgs, hn: jnp.ndarray):
     k = k.reshape(b, s, args.num_kv_heads, args.head_dim).transpose(0, 2, 1, 3)
     v = v.reshape(b, s, args.num_kv_heads, args.head_dim).transpose(0, 2, 1, 3)
     if args.qk_norm:
-        q = rms_norm(q, lp["q_norm"], args.rms_norm_eps)
-        k = rms_norm(k, lp["k_norm"], args.rms_norm_eps)
+        zc = args.zero_centered_norms
+        q = rms_norm(q, lp["q_norm"], args.rms_norm_eps, zero_centered=zc)
+        k = rms_norm(k, lp["k_norm"], args.rms_norm_eps, zero_centered=zc)
     return q, k, v
 
 
@@ -187,7 +251,7 @@ def _sharded_flash_attention(q, k, v, args: ModelArchArgs, mesh, rules):
     """Run the Pallas flash kernel with heads local per shard.
 
     Pallas calls have no GSPMD partitioning rule, so under a mesh the kernel is wrapped
-    in `shard_map` over (batch->dp, heads->tp/ep): each shard runs the kernel on its
+    in `shard_map` over (batch->dp, heads->tp): each shard runs the kernel on its
     local heads — the same SPMD shape as the reference launching one NKI kernel per
     core (`attention_base.py:121-125`).
     """
@@ -228,8 +292,9 @@ def _decoder_layer(
     sinks: Optional[jnp.ndarray] = None,
     use_flash: bool = False,
 ):
+    zc = args.zero_centered_norms
     resid = h
-    hn = rms_norm(h, lp["ln1"], args.rms_norm_eps)
+    hn = rms_norm(h, lp["ln1"], args.rms_norm_eps, zero_centered=zc)
     q, k, v = _project_qkv(lp, args, hn)
     q = constrain(q, ("batch", "heads", None, None), rules, mesh=mesh)
     k = constrain(k, ("batch", "kv_heads", None, None), rules, mesh=mesh)
@@ -253,27 +318,59 @@ def _decoder_layer(
         attn = attend(q, k_att, v_att, mask=mask, scale=args.attention_scale,
                       logits_soft_cap=args.logits_soft_cap, sinks=sinks)
     attn = attn.transpose(0, 2, 1, 3).reshape(h.shape[0], h.shape[1], args.q_size)
-    h = resid + constrain(attn @ lp["wo"], ("batch", None, None), rules, mesh=mesh)
+    attn_out = constrain(attn @ lp["wo"], ("batch", None, None), rules, mesh=mesh)
+    if args.sandwich_norms:
+        attn_out = rms_norm(attn_out, lp["ln1_post"], args.rms_norm_eps,
+                            zero_centered=zc)
+    h = resid + attn_out
 
     resid = h
-    hn = rms_norm(h, lp["ln2"], args.rms_norm_eps)
-    h = resid + constrain(_mlp(lp, args, hn, mesh, rules), ("batch", None, None), rules,
-                          mesh=mesh)
+    hn = rms_norm(h, lp["ln2"], args.rms_norm_eps, zero_centered=zc)
+    if args.moe is not None:
+        ffn = moe_block(lp, args, hn, mesh, rules, _ACTIVATIONS[args.activation])
+    else:
+        ffn = _mlp(lp, args, hn, mesh, rules)
+    mlp_out = constrain(ffn, ("batch", None, None), rules, mesh=mesh)
+    if args.sandwich_norms:
+        mlp_out = rms_norm(mlp_out, lp["ln2_post"], args.rms_norm_eps,
+                           zero_centered=zc)
+    h = resid + mlp_out
     return h, k_cache, v_cache
 
 
 def _run_stack(params: Params, args: ModelArchArgs, h, cos, sin, mask, cache,
-               positions, decode_bucket, mesh, rules, use_flash=False):
-    """Scan the decoder layers, carrying hidden state, yielding updated cache."""
+               positions, decode_bucket, mesh, rules, use_flash=False,
+               local_rope_mask=None):
+    """Scan the decoder layers, carrying hidden state, yielding updated cache.
 
-    def body(carry_h, xs):
-        lp, kc, vc = xs
-        new_h, kc, vc = _decoder_layer(lp, args, carry_h, cos, sin, mask, kc, vc,
+    ``local_rope_mask`` (set when args.layer_pattern is not None) is a triple
+    (cos_local, sin_local, mask_local): sliding layers select it inside the scan body
+    via a per-layer boolean scanned alongside the stacked params, keeping the layer
+    computation uniform (scan-compatible) while gemma3-style local/global layers differ
+    in both RoPE theta and attention window.
+    """
+    xs = (params["layers"], cache["k"], cache["v"])
+    if local_rope_mask is not None:
+        cos_l, sin_l, mask_l = local_rope_mask
+        is_sliding = jnp.asarray(
+            [kind == "sliding" for kind in args.layer_pattern], dtype=bool)
+        xs = xs + (is_sliding,)
+
+    def body(carry_h, layer_xs):
+        if local_rope_mask is None:
+            lp, kc, vc = layer_xs
+            cos_i, sin_i, mask_i = cos, sin, mask
+        else:
+            lp, kc, vc, slide = layer_xs
+            cos_i = jnp.where(slide, cos_l, cos)
+            sin_i = jnp.where(slide, sin_l, sin)
+            mask_i = jnp.where(slide, mask_l, mask)
+        new_h, kc, vc = _decoder_layer(lp, args, carry_h, cos_i, sin_i, mask_i, kc, vc,
                                        positions, decode_bucket, mesh, rules,
                                        use_flash=use_flash)
         return new_h, (kc, vc)
 
-    h, (k_new, v_new) = jax.lax.scan(body, h, (params["layers"], cache["k"], cache["v"]))
+    h, (k_new, v_new) = jax.lax.scan(body, h, xs)
     return h, {"k": k_new, "v": v_new}
 
 
@@ -309,15 +406,23 @@ def prefill_forward(
     s = input_ids.shape[1]
     mask = (position_ids[:, None, :, None] >= position_ids[:, None, None, :])
     mask = jnp.logical_and(mask, causal_mask(s, s)[None, None])
-    if args.sliding_window is not None:
-        kv_pos = position_ids[:, None, None, :]
-        q_pos = position_ids[:, None, :, None]
-        mask = jnp.logical_and(mask, kv_pos > q_pos - args.sliding_window)
+    kv_pos = position_ids[:, None, None, :]
+    q_pos = position_ids[:, None, :, None]
+    sliding = (jnp.logical_and(mask, kv_pos > q_pos - args.sliding_window)
+               if args.sliding_window is not None else None)
+    local_rope_mask = None
+    if args.layer_pattern is not None:
+        inv_local = params.get("rope_inv_freq_local", params["rope_inv_freq"])
+        cos_l, sin_l = rope_ops.compute_cos_sin(inv_local, position_ids)
+        local_rope_mask = (cos_l, sin_l, sliding if sliding is not None else mask)
+    elif sliding is not None:
+        mask = sliding
 
     h, cache = _run_stack(params, args, h, cos, sin, mask, cache,
                           positions=None, decode_bucket=None, mesh=mesh, rules=rules,
-                          use_flash=use_flash)
-    h = rms_norm(h, params["final_norm"], args.rms_norm_eps)
+                          use_flash=use_flash, local_rope_mask=local_rope_mask)
+    h = rms_norm(h, params["final_norm"], args.rms_norm_eps,
+                 zero_centered=args.zero_centered_norms)
     h_last = jnp.take_along_axis(h, last_token_idx[:, None, None], axis=1)[:, 0]
     logits = _lm_head(params, args, h_last, mesh, rules)
     return logits, cache
@@ -342,12 +447,20 @@ def decode_forward(
     kv_pos = jnp.arange(decode_bucket)[None, None, None, :]
     q_pos = pos_grid[:, None, :, None]
     mask = kv_pos <= q_pos                                         # (B, 1, T, bucket)
-    if args.sliding_window is not None:
-        mask = jnp.logical_and(mask, kv_pos > q_pos - args.sliding_window)
+    sliding = (jnp.logical_and(mask, kv_pos > q_pos - args.sliding_window)
+               if args.sliding_window is not None else None)
+    local_rope_mask = None
+    if args.layer_pattern is not None:
+        inv_local = params.get("rope_inv_freq_local", params["rope_inv_freq"])
+        cos_l, sin_l = rope_ops.compute_cos_sin(inv_local, pos_grid)
+        local_rope_mask = (cos_l, sin_l, sliding if sliding is not None else mask)
+    elif sliding is not None:
+        mask = sliding
 
     h, cache = _run_stack(params, args, h, cos, sin, mask, cache,
                           positions=position_ids, decode_bucket=decode_bucket,
-                          mesh=mesh, rules=rules)
-    h = rms_norm(h, params["final_norm"], args.rms_norm_eps)
+                          mesh=mesh, rules=rules, local_rope_mask=local_rope_mask)
+    h = rms_norm(h, params["final_norm"], args.rms_norm_eps,
+                 zero_centered=args.zero_centered_norms)
     logits = _lm_head(params, args, h, mesh, rules)
     return logits, cache

@@ -1,0 +1,1 @@
+from .modeling_gemma3 import Gemma3ForCausalLM, Gemma3InferenceConfig  # noqa: F401

@@ -1,0 +1,142 @@
+"""Gemma 3 (text) model family.
+
+≈ reference `models/gemma3/modeling_gemma3.py` (361 LoC: NeuronGemma3ForCausalLM).
+Architecture deltas vs Llama, all expressed through ModelArchArgs so the shared
+functional core (`models/base.py`) runs them inside one `lax.scan`:
+
+- alternating local (sliding-window, RoPE theta 10k) / global (full-attention, RoPE
+  theta 1M with linear scaling) layers — ``layer_pattern`` + ``local_rope_theta``;
+- sandwich norms: post-attention and post-feedforward RMSNorms applied to the branch
+  output before the residual add;
+- zero-centered RMSNorm weights ((1 + w) scaling) everywhere, incl. per-head q/k norm;
+- embeddings scaled by sqrt(hidden_size); attention scale from query_pre_attn_scalar.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import numpy as np
+
+from ...modules import gqa
+from ...ops import rope as rope_ops
+from ..base import ModelArchArgs
+from ..llama.modeling_llama import LlamaForCausalLM, LlamaInferenceConfig
+
+
+class Gemma3InferenceConfig(LlamaInferenceConfig):
+    def add_derived_config(self) -> None:
+        if not hasattr(self, "head_dim") or self.head_dim is None:
+            self.head_dim = 256
+        for attr, default in (
+                ("rope_theta", 1_000_000.0),
+                ("rope_local_base_freq", 10_000.0),
+                ("query_pre_attn_scalar", 256.0),
+                ("sliding_window", 4096),
+                ("sliding_window_pattern", 6),
+                ("layer_types", None),
+                ("hidden_act", "gelu_pytorch_tanh"),
+                ("hidden_activation", None),
+                ("rms_norm_eps", 1e-6),
+                ("rope_scaling", None),
+                ("tie_word_embeddings", True),
+                ("attention_bias", False),
+        ):
+            if not hasattr(self, attr):
+                setattr(self, attr, default)
+        if self.hidden_activation:
+            self.hidden_act = self.hidden_activation
+
+    def layer_pattern(self) -> Tuple[str, ...]:
+        """Per-layer attention kind; prefers the explicit ``layer_types`` list (newer HF
+        configs), else derives from ``sliding_window_pattern`` (every Nth layer full)."""
+        if self.layer_types is not None:
+            return tuple("sliding" if t == "sliding_attention" else "full"
+                         for t in self.layer_types)
+        n = self.sliding_window_pattern
+        return tuple("full" if (i + 1) % n == 0 else "sliding"
+                     for i in range(self.num_hidden_layers))
+
+
+class Gemma3ForCausalLM(LlamaForCausalLM):
+    """≈ NeuronGemma3ForCausalLM."""
+
+    @classmethod
+    def get_config_cls(cls):
+        return Gemma3InferenceConfig
+
+    @classmethod
+    def arch_args_from_config(cls, config: Gemma3InferenceConfig) -> ModelArchArgs:
+        tp = config.tpu_config.tp_degree
+        return ModelArchArgs(
+            vocab_size=config.vocab_size,
+            hidden_size=config.hidden_size,
+            num_layers=config.num_hidden_layers,
+            num_heads=config.num_attention_heads,
+            num_kv_heads=gqa.effective_kv_heads(tp, config.num_key_value_heads),
+            head_dim=config.head_dim,
+            intermediate_size=config.intermediate_size,
+            rms_norm_eps=config.rms_norm_eps,
+            activation=config.hidden_act,
+            qk_norm=True,
+            sandwich_norms=True,
+            zero_centered_norms=True,
+            sliding_window=config.sliding_window,
+            layer_pattern=config.layer_pattern(),
+            local_rope_theta=config.rope_local_base_freq,
+            attention_scale=float(config.query_pre_attn_scalar) ** -0.5,
+            embedding_multiplier=float(config.hidden_size) ** 0.5,
+            tie_word_embeddings=config.tie_word_embeddings,
+        )
+
+    @classmethod
+    def convert_hf_state_dict(cls, state_dict: Dict[str, np.ndarray],
+                              config: Gemma3InferenceConfig) -> Dict:
+        """Adds gemma's extra per-layer norms on top of the Llama mapping: ln1/ln2 are
+        the *pre* norms (input / pre_feedforward), ln1_post/ln2_post the branch-output
+        norms (post_attention / post_feedforward)."""
+        args = cls.arch_args_from_config(config)
+        L = config.num_hidden_layers
+        n_kv = config.num_key_value_heads
+        d = config.head_dim
+        factor = args.num_kv_heads // n_kv
+
+        def get(name):
+            if name not in state_dict:
+                raise KeyError(f"missing weight {name}")
+            return state_dict[name]
+
+        def linear_t(name):
+            return np.ascontiguousarray(get(name).T)
+
+        layers = {k: [] for k in ("ln1", "ln1_post", "ln2", "ln2_post", "wq", "wk",
+                                  "wv", "wo", "wg", "wu", "wd", "q_norm", "k_norm")}
+        for i in range(L):
+            p = f"model.layers.{i}."
+            layers["ln1"].append(get(p + "input_layernorm.weight"))
+            layers["ln1_post"].append(get(p + "post_attention_layernorm.weight"))
+            layers["ln2"].append(get(p + "pre_feedforward_layernorm.weight"))
+            layers["ln2_post"].append(get(p + "post_feedforward_layernorm.weight"))
+            layers["wq"].append(linear_t(p + "self_attn.q_proj.weight"))
+            layers["wk"].append(gqa.replicate_kv_weight(
+                linear_t(p + "self_attn.k_proj.weight"), n_kv, d, factor))
+            layers["wv"].append(gqa.replicate_kv_weight(
+                linear_t(p + "self_attn.v_proj.weight"), n_kv, d, factor))
+            layers["wo"].append(linear_t(p + "self_attn.o_proj.weight"))
+            layers["q_norm"].append(get(p + "self_attn.q_norm.weight"))
+            layers["k_norm"].append(get(p + "self_attn.k_norm.weight"))
+            layers["wg"].append(linear_t(p + "mlp.gate_proj.weight"))
+            layers["wu"].append(linear_t(p + "mlp.up_proj.weight"))
+            layers["wd"].append(linear_t(p + "mlp.down_proj.weight"))
+
+        params = {
+            "embed": get("model.embed_tokens.weight"),
+            "layers": {k: np.stack(v) for k, v in layers.items()},
+            "final_norm": get("model.norm.weight"),
+            "rope_inv_freq": cls.inv_freq_from_config(config),
+            "rope_inv_freq_local": rope_ops.default_inv_freq(
+                config.head_dim, config.rope_local_base_freq),
+        }
+        if not args.tie_word_embeddings:
+            params["lm_head"] = np.ascontiguousarray(get("lm_head.weight").T)
+        return params

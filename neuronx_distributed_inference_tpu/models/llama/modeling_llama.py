@@ -73,11 +73,11 @@ class LlamaForCausalLM(TpuModelForCausalLM):
         ≈ `convert_hf_to_neuron_state_dict` (`modeling_llama.py:1454-1524`); weights are
         transposed to (in, out) and kv projections replicated per the GQA strategy.
         """
+        args = cls.arch_args_from_config(config)
         L = config.num_hidden_layers
-        tp = config.tpu_config.tp_degree
         n_kv = config.num_key_value_heads
         d = config.head_dim
-        factor = gqa.replication_factor(tp, n_kv)
+        factor = args.num_kv_heads // n_kv
 
         def get(name):
             if name not in state_dict:
@@ -89,8 +89,10 @@ class LlamaForCausalLM(TpuModelForCausalLM):
 
         layers = {"ln1": [], "wq": [], "wk": [], "wv": [], "wo": [],
                   "ln2": [], "wg": [], "wu": [], "wd": []}
-        if config.attention_bias:
+        if args.attention_bias:
             layers.update({"bq": [], "bk": [], "bv": []})
+        if args.qk_norm:
+            layers.update({"q_norm": [], "k_norm": []})
         for i in range(L):
             p = f"model.layers.{i}."
             layers["ln1"].append(get(p + "input_layernorm.weight"))
@@ -104,12 +106,15 @@ class LlamaForCausalLM(TpuModelForCausalLM):
             layers["wg"].append(linear_t(p + "mlp.gate_proj.weight"))
             layers["wu"].append(linear_t(p + "mlp.up_proj.weight"))
             layers["wd"].append(linear_t(p + "mlp.down_proj.weight"))
-            if config.attention_bias:
+            if args.attention_bias:
                 layers["bq"].append(get(p + "self_attn.q_proj.bias"))
                 layers["bk"].append(gqa.replicate_kv_bias(
                     get(p + "self_attn.k_proj.bias"), n_kv, d, factor))
                 layers["bv"].append(gqa.replicate_kv_bias(
                     get(p + "self_attn.v_proj.bias"), n_kv, d, factor))
+            if args.qk_norm:
+                layers["q_norm"].append(get(p + "self_attn.q_norm.weight"))
+                layers["k_norm"].append(get(p + "self_attn.k_norm.weight"))
 
         params = {
             "embed": get("model.embed_tokens.weight"),
@@ -117,6 +122,6 @@ class LlamaForCausalLM(TpuModelForCausalLM):
             "final_norm": get("model.norm.weight"),
             "rope_inv_freq": cls.inv_freq_from_config(config),
         }
-        if not config.tie_word_embeddings:
+        if not args.tie_word_embeddings:
             params["lm_head"] = np.ascontiguousarray(get("lm_head.weight").T)
         return params

@@ -1,0 +1,1 @@
+from .modeling_mixtral import MixtralForCausalLM, MixtralInferenceConfig  # noqa: F401

@@ -1,0 +1,106 @@
+"""Mixtral (sparse MoE) model family.
+
+≈ reference `models/mixtral/modeling_mixtral.py` (330 LoC: NeuronMixtralForCausalLM,
+built on NxD MoE modules via `modules/moe_v2.py`). Llama attention + an 8-expert top-2
+MoE FFN per layer (see ops/moe.py for the TPU MoE design and EP sharding).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from ...modules import gqa
+from ...ops.moe import MoEArgs
+from ..base import ModelArchArgs
+from ..llama.modeling_llama import LlamaForCausalLM, LlamaInferenceConfig
+
+
+class MixtralInferenceConfig(LlamaInferenceConfig):
+    REQUIRED_ATTRIBUTES = LlamaInferenceConfig.REQUIRED_ATTRIBUTES + (
+        "num_local_experts", "num_experts_per_tok")
+
+    def add_derived_config(self) -> None:
+        super().add_derived_config()
+        if not hasattr(self, "sliding_window"):
+            self.sliding_window = None
+
+
+class MixtralForCausalLM(LlamaForCausalLM):
+    """≈ NeuronMixtralForCausalLM."""
+
+    @classmethod
+    def get_config_cls(cls):
+        return MixtralInferenceConfig
+
+    @classmethod
+    def arch_args_from_config(cls, config: MixtralInferenceConfig) -> ModelArchArgs:
+        tp = config.tpu_config.tp_degree
+        return ModelArchArgs(
+            vocab_size=config.vocab_size,
+            hidden_size=config.hidden_size,
+            num_layers=config.num_hidden_layers,
+            num_heads=config.num_attention_heads,
+            num_kv_heads=gqa.effective_kv_heads(tp, config.num_key_value_heads),
+            head_dim=config.head_dim,
+            intermediate_size=config.intermediate_size,
+            rms_norm_eps=config.rms_norm_eps,
+            activation=config.hidden_act,
+            sliding_window=config.sliding_window,
+            tie_word_embeddings=config.tie_word_embeddings,
+            moe=MoEArgs(
+                num_experts=config.num_local_experts,
+                experts_per_tok=config.num_experts_per_tok,
+                norm_topk_prob=True,    # HF Mixtral renormalizes top-k weights
+            ),
+        )
+
+    @classmethod
+    def convert_hf_state_dict(cls, state_dict: Dict[str, np.ndarray],
+                              config: MixtralInferenceConfig) -> Dict:
+        args = cls.arch_args_from_config(config)
+        L, E = config.num_hidden_layers, config.num_local_experts
+        n_kv = config.num_key_value_heads
+        d = config.head_dim
+        factor = args.num_kv_heads // n_kv
+
+        def get(name):
+            if name not in state_dict:
+                raise KeyError(f"missing weight {name}")
+            return state_dict[name]
+
+        def linear_t(name):
+            return np.ascontiguousarray(get(name).T)
+
+        layers = {k: [] for k in ("ln1", "wq", "wk", "wv", "wo", "ln2",
+                                  "router", "wg", "wu", "wd")}
+        for i in range(L):
+            p = f"model.layers.{i}."
+            layers["ln1"].append(get(p + "input_layernorm.weight"))
+            layers["wq"].append(linear_t(p + "self_attn.q_proj.weight"))
+            layers["wk"].append(gqa.replicate_kv_weight(
+                linear_t(p + "self_attn.k_proj.weight"), n_kv, d, factor))
+            layers["wv"].append(gqa.replicate_kv_weight(
+                linear_t(p + "self_attn.v_proj.weight"), n_kv, d, factor))
+            layers["wo"].append(linear_t(p + "self_attn.o_proj.weight"))
+            layers["ln2"].append(get(p + "post_attention_layernorm.weight"))
+            m = p + "block_sparse_moe."
+            layers["router"].append(linear_t(m + "gate.weight"))
+            # experts: w1 = gate, w3 = up, w2 = down (HF Mixtral naming)
+            layers["wg"].append(np.stack(
+                [linear_t(m + f"experts.{e}.w1.weight") for e in range(E)]))
+            layers["wu"].append(np.stack(
+                [linear_t(m + f"experts.{e}.w3.weight") for e in range(E)]))
+            layers["wd"].append(np.stack(
+                [linear_t(m + f"experts.{e}.w2.weight") for e in range(E)]))
+
+        params = {
+            "embed": get("model.embed_tokens.weight"),
+            "layers": {k: np.stack(v) for k, v in layers.items()},
+            "final_norm": get("model.norm.weight"),
+            "rope_inv_freq": cls.inv_freq_from_config(config),
+        }
+        if not args.tie_word_embeddings:
+            params["lm_head"] = np.ascontiguousarray(get("lm_head.weight").T)
+        return params

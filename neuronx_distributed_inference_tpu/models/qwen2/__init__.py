@@ -1,0 +1,1 @@
+from .modeling_qwen2 import Qwen2ForCausalLM, Qwen2InferenceConfig  # noqa: F401

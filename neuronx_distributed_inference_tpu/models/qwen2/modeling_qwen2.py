@@ -1,0 +1,48 @@
+"""Qwen2 / Qwen2.5 model family.
+
+≈ reference `models/qwen2/modeling_qwen2.py` (283 LoC: NeuronQwen2ForCausalLM). The
+architecture is Llama with QKV projection biases (and no output-projection bias), so the
+implementation subclasses the Llama family and flips ``attention_bias``.
+"""
+
+from __future__ import annotations
+
+from ...modules import gqa
+from ..base import ModelArchArgs
+from ..llama.modeling_llama import LlamaForCausalLM, LlamaInferenceConfig
+
+
+class Qwen2InferenceConfig(LlamaInferenceConfig):
+    def add_derived_config(self) -> None:
+        # HF Qwen2Config has no attention_bias attribute: q/k/v biases are always
+        # present, o bias never is. Set before the Llama default (False) applies.
+        if not hasattr(self, "attention_bias"):
+            self.attention_bias = True
+        super().add_derived_config()
+        if not hasattr(self, "qkv_bias"):
+            self.qkv_bias = self.attention_bias
+
+
+class Qwen2ForCausalLM(LlamaForCausalLM):
+    """≈ NeuronQwen2ForCausalLM."""
+
+    @classmethod
+    def get_config_cls(cls):
+        return Qwen2InferenceConfig
+
+    @classmethod
+    def arch_args_from_config(cls, config: Qwen2InferenceConfig) -> ModelArchArgs:
+        tp = config.tpu_config.tp_degree
+        return ModelArchArgs(
+            vocab_size=config.vocab_size,
+            hidden_size=config.hidden_size,
+            num_layers=config.num_hidden_layers,
+            num_heads=config.num_attention_heads,
+            num_kv_heads=gqa.effective_kv_heads(tp, config.num_key_value_heads),
+            head_dim=config.head_dim,
+            intermediate_size=config.intermediate_size,
+            rms_norm_eps=config.rms_norm_eps,
+            activation=config.hidden_act,
+            attention_bias=bool(config.qkv_bias),
+            tie_word_embeddings=config.tie_word_embeddings,
+        )

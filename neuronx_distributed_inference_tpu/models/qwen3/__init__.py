@@ -1,0 +1,1 @@
+from .modeling_qwen3 import Qwen3ForCausalLM, Qwen3InferenceConfig  # noqa: F401

@@ -1,0 +1,44 @@
+"""Qwen3 model family.
+
+≈ reference `models/qwen3/modeling_qwen3.py` (241 LoC: NeuronQwen3ForCausalLM). Llama
+architecture plus per-head RMSNorm on q/k before RoPE (``qk_norm``) and an explicit
+``head_dim`` decoupled from hidden_size/num_heads.
+"""
+
+from __future__ import annotations
+
+from ...modules import gqa
+from ..base import ModelArchArgs
+from ..llama.modeling_llama import LlamaForCausalLM, LlamaInferenceConfig
+
+
+class Qwen3InferenceConfig(LlamaInferenceConfig):
+    def add_derived_config(self) -> None:
+        super().add_derived_config()
+        self.attention_bias = getattr(self, "attention_bias", False)
+
+
+class Qwen3ForCausalLM(LlamaForCausalLM):
+    """≈ NeuronQwen3ForCausalLM."""
+
+    @classmethod
+    def get_config_cls(cls):
+        return Qwen3InferenceConfig
+
+    @classmethod
+    def arch_args_from_config(cls, config: Qwen3InferenceConfig) -> ModelArchArgs:
+        tp = config.tpu_config.tp_degree
+        return ModelArchArgs(
+            vocab_size=config.vocab_size,
+            hidden_size=config.hidden_size,
+            num_layers=config.num_hidden_layers,
+            num_heads=config.num_attention_heads,
+            num_kv_heads=gqa.effective_kv_heads(tp, config.num_key_value_heads),
+            head_dim=config.head_dim,
+            intermediate_size=config.intermediate_size,
+            rms_norm_eps=config.rms_norm_eps,
+            activation=config.hidden_act,
+            attention_bias=config.attention_bias,
+            qk_norm=True,
+            tie_word_embeddings=config.tie_word_embeddings,
+        )

@@ -1,0 +1,2 @@
+from .modeling_qwen3_moe import (  # noqa: F401
+    Qwen3MoeForCausalLM, Qwen3MoeInferenceConfig)

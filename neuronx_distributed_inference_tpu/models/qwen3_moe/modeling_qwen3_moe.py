@@ -1,0 +1,117 @@
+"""Qwen3-MoE model family.
+
+≈ reference `models/qwen3_moe/modeling_qwen3_moe.py` (543 LoC: NeuronQwen3MoeForCausalLM).
+Qwen3 attention (qk-norm) + top-k MoE FFN with configurable gate renormalization
+(``norm_topk_prob``). All layers must be sparse (``mlp_only_layers`` empty,
+``decoder_sparse_step`` 1) — mixed dense/sparse stacks would break the uniform layer
+scan and are rejected at config time.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from ...modules import gqa
+from ...ops.moe import MoEArgs
+from ..base import ModelArchArgs
+from ..llama.modeling_llama import LlamaForCausalLM, LlamaInferenceConfig
+
+
+class Qwen3MoeInferenceConfig(LlamaInferenceConfig):
+    REQUIRED_ATTRIBUTES = LlamaInferenceConfig.REQUIRED_ATTRIBUTES + (
+        "num_experts", "num_experts_per_tok", "moe_intermediate_size")
+
+    def add_derived_config(self) -> None:
+        super().add_derived_config()
+        for attr, default in (("norm_topk_prob", True), ("mlp_only_layers", []),
+                              ("decoder_sparse_step", 1)):
+            if not hasattr(self, attr):
+                setattr(self, attr, default)
+
+    def validate(self) -> None:
+        super().validate()
+        if self.mlp_only_layers or self.decoder_sparse_step != 1:
+            raise ValueError(
+                "mixed dense/sparse layer stacks are not supported (all layers must "
+                "be MoE): mlp_only_layers must be empty and decoder_sparse_step == 1")
+
+
+class Qwen3MoeForCausalLM(LlamaForCausalLM):
+    """≈ NeuronQwen3MoeForCausalLM."""
+
+    @classmethod
+    def get_config_cls(cls):
+        return Qwen3MoeInferenceConfig
+
+    @classmethod
+    def arch_args_from_config(cls, config: Qwen3MoeInferenceConfig) -> ModelArchArgs:
+        tp = config.tpu_config.tp_degree
+        return ModelArchArgs(
+            vocab_size=config.vocab_size,
+            hidden_size=config.hidden_size,
+            num_layers=config.num_hidden_layers,
+            num_heads=config.num_attention_heads,
+            num_kv_heads=gqa.effective_kv_heads(tp, config.num_key_value_heads),
+            head_dim=config.head_dim,
+            intermediate_size=config.moe_intermediate_size,
+            rms_norm_eps=config.rms_norm_eps,
+            activation=config.hidden_act,
+            qk_norm=True,
+            tie_word_embeddings=config.tie_word_embeddings,
+            moe=MoEArgs(
+                num_experts=config.num_experts,
+                experts_per_tok=config.num_experts_per_tok,
+                norm_topk_prob=config.norm_topk_prob,
+            ),
+        )
+
+    @classmethod
+    def convert_hf_state_dict(cls, state_dict: Dict[str, np.ndarray],
+                              config: Qwen3MoeInferenceConfig) -> Dict:
+        args = cls.arch_args_from_config(config)
+        L, E = config.num_hidden_layers, config.num_experts
+        n_kv = config.num_key_value_heads
+        d = config.head_dim
+        factor = args.num_kv_heads // n_kv
+
+        def get(name):
+            if name not in state_dict:
+                raise KeyError(f"missing weight {name}")
+            return state_dict[name]
+
+        def linear_t(name):
+            return np.ascontiguousarray(get(name).T)
+
+        layers = {k: [] for k in ("ln1", "wq", "wk", "wv", "wo", "q_norm", "k_norm",
+                                  "ln2", "router", "wg", "wu", "wd")}
+        for i in range(L):
+            p = f"model.layers.{i}."
+            layers["ln1"].append(get(p + "input_layernorm.weight"))
+            layers["wq"].append(linear_t(p + "self_attn.q_proj.weight"))
+            layers["wk"].append(gqa.replicate_kv_weight(
+                linear_t(p + "self_attn.k_proj.weight"), n_kv, d, factor))
+            layers["wv"].append(gqa.replicate_kv_weight(
+                linear_t(p + "self_attn.v_proj.weight"), n_kv, d, factor))
+            layers["wo"].append(linear_t(p + "self_attn.o_proj.weight"))
+            layers["q_norm"].append(get(p + "self_attn.q_norm.weight"))
+            layers["k_norm"].append(get(p + "self_attn.k_norm.weight"))
+            layers["ln2"].append(get(p + "post_attention_layernorm.weight"))
+            layers["router"].append(linear_t(p + "mlp.gate.weight"))
+            layers["wg"].append(np.stack(
+                [linear_t(p + f"mlp.experts.{e}.gate_proj.weight") for e in range(E)]))
+            layers["wu"].append(np.stack(
+                [linear_t(p + f"mlp.experts.{e}.up_proj.weight") for e in range(E)]))
+            layers["wd"].append(np.stack(
+                [linear_t(p + f"mlp.experts.{e}.down_proj.weight") for e in range(E)]))
+
+        params = {
+            "embed": get("model.embed_tokens.weight"),
+            "layers": {k: np.stack(v) for k, v in layers.items()},
+            "final_norm": get("model.norm.weight"),
+            "rope_inv_freq": cls.inv_freq_from_config(config),
+        }
+        if not args.tie_word_embeddings:
+            params["lm_head"] = np.ascontiguousarray(get("lm_head.weight").T)
+        return params

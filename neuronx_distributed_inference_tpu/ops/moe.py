@@ -1,0 +1,91 @@
+"""Mixture-of-Experts block: top-k router + expert MLPs with expert-parallel sharding.
+
+≈ reference `modules/moe_v2.py` (`initialize_moe_module` :23-135: NxD `RouterTopK` +
+`ExpertMLPsV2`) and the decode-time all-experts kernel
+(`_pre_prod_kernels.moe_token_gen`, used via `experimental/functional/moe/tokengen_moe`).
+
+TPU design: experts are a leading dim on stacked weights (E, H, I); the block computes
+**all experts densely** and combines with the sparse router gates:
+
+- decode (few tokens): dense all-experts is the fast path on the MXU — exactly the shape
+  of the reference's `moe_token_gen_all_experts_kernel`; gathering per-expert token
+  subsets would serialize on dynamic shapes XLA can't tile.
+- prefill: dense all-experts costs E/top_k extra MLP FLOPs but keeps every matmul large,
+  static, and EP-shardable. A capacity-based dispatch/combine einsum (token dropping,
+  lower FLOPs) can be added behind MoEArgs later without touching callers.
+
+Expert parallelism: the ``experts`` logical axis shards E over the mesh's ``ep`` axis
+(`parallel/sharding.py` DEFAULT_RULES); the final gate-weighted combine contracts over
+E, so GSPMD inserts the EP all-reduce exactly where the reference places its MoE
+dispatch collectives (`ep_dispatch_cc_option`, `models/config.py:602`).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..parallel.sharding import constrain
+
+
+@dataclass(frozen=True)
+class MoEArgs:
+    """Static MoE architecture description (hashable, nested in ModelArchArgs)."""
+
+    num_experts: int
+    experts_per_tok: int
+    norm_topk_prob: bool = True          # renormalize top-k gates to sum to 1
+    # qwen-style shared expert running densely alongside the routed experts, with a
+    # sigmoid gate projected from the hidden state (0 = disabled)
+    shared_expert_intermediate_size: int = 0
+
+
+def route(router_w: jnp.ndarray, x: jnp.ndarray, moe: MoEArgs) -> jnp.ndarray:
+    """Top-k routing gates.
+
+    x: (N, H) tokens; router_w: (H, E). Returns dense gates (N, E) float32 with
+    exactly top-k nonzeros per row (softmax over all experts, then top-k, then
+    optional renormalization — matches HF Mixtral/Qwen3-MoE routing).
+    """
+    logits = x.astype(jnp.float32) @ router_w.astype(jnp.float32)   # (N, E)
+    probs = jax.nn.softmax(logits, axis=-1)
+    top_vals, top_idx = jax.lax.top_k(probs, moe.experts_per_tok)   # (N, k)
+    if moe.norm_topk_prob:
+        top_vals = top_vals / jnp.sum(top_vals, axis=-1, keepdims=True)
+    onehot = jax.nn.one_hot(top_idx, moe.num_experts, dtype=jnp.float32)  # (N, k, E)
+    return jnp.einsum("nk,nke->ne", top_vals, onehot)
+
+
+def moe_block(lp, args, hn: jnp.ndarray, mesh, rules,
+              activation) -> jnp.ndarray:
+    """(B, S, H) -> (B, S, H) through the MoE FFN.
+
+    ``lp`` carries this layer's stacked expert weights: ``router`` (H, E), ``wg``/``wu``
+    (E, H, I), ``wd`` (E, I, H), plus optional shared-expert weights.
+    """
+    moe: MoEArgs = args.moe
+    b, s, h = hn.shape
+    x = hn.reshape(b * s, h)
+    gates = route(lp["router"], x, moe)                             # (N, E) fp32
+
+    # dense all-experts MLP: (E, N, I) intermediates, EP-sharded on E, TP on I
+    gate_proj = jnp.einsum("nh,ehi->eni", x, lp["wg"])
+    up_proj = jnp.einsum("nh,ehi->eni", x, lp["wu"])
+    inter = activation(gate_proj) * up_proj
+    inter = constrain(inter, ("experts", None, "expert_mlp"), rules, mesh=mesh)
+    per_expert = jnp.einsum("eni,eih->enh", inter, lp["wd"])        # (E, N, H)
+    out = jnp.einsum("enh,ne->nh", per_expert,
+                     gates.astype(per_expert.dtype))                # sum over E: EP psum
+    out = constrain(out, ("batch", None), rules, mesh=mesh)
+
+    if moe.shared_expert_intermediate_size:
+        shared_inter = activation(x @ lp["shared_wg"]) * (x @ lp["shared_wu"])
+        shared = shared_inter @ lp["shared_wd"]
+        shared_gate = jax.nn.sigmoid(
+            (x.astype(jnp.float32) @ lp["shared_gate"].astype(jnp.float32)))  # (N, 1)
+        out = out + shared * shared_gate.astype(out.dtype)
+
+    return out.reshape(b, s, h).astype(hn.dtype)

@@ -38,12 +38,15 @@ from .mesh import AXIS_CP, AXIS_DP, AXIS_EP, AXIS_TP
 MeshAxes = Union[None, str, Tuple[str, ...]]
 
 # Default rule table: logical axis -> mesh axis (or tuple, or None = replicated).
+# Attention heads and dense MLP shard over tp only: the ep axis shards *experts*
+# (attention is replicated across ep ranks, matching the reference's TP-attention +
+# EP-MoE process-group split, `modules/moe_v2.py:135`).
 DEFAULT_RULES: Dict[str, MeshAxes] = {
     "vocab": AXIS_TP,
     "embed": None,
-    "heads": (AXIS_TP, AXIS_EP),
-    "kv_heads": (AXIS_TP, AXIS_EP),
-    "mlp": (AXIS_CP, AXIS_TP, AXIS_EP),
+    "heads": AXIS_TP,
+    "kv_heads": AXIS_TP,
+    "mlp": (AXIS_CP, AXIS_TP),
     "experts": AXIS_EP,
     "expert_mlp": AXIS_TP,
     "batch": AXIS_DP,

@@ -176,13 +176,24 @@ class TpuModelForCausalLM:
         `get_flash_attention_strategy`, `attention_base.py:1330`): explicit config wins;
         otherwise on for TPU backends when the arch has no unsupported extras, off for
         CPU (Pallas needs interpret mode there)."""
-        cfg = self.tpu_config.attention_kernel_enabled
-        if cfg is not None:
-            return cfg
         a = self.arch_args
+        cfg = self.tpu_config.attention_kernel_enabled
+        unsupported = None
         if a.logits_soft_cap is not None:
+            unsupported = "logits_soft_cap"
+        elif a.layer_pattern is not None:
+            # per-layer window/rope selection happens inside the scan; the Pallas
+            # kernel's window is static per call, so fall back to the jnp path
+            unsupported = "per-layer attention pattern (layer_pattern)"
+        if cfg is not None:
+            if cfg and unsupported is not None:
+                raise ValueError(
+                    f"attention_kernel_enabled=True but the flash kernel does not "
+                    f"support {unsupported} for this architecture")
+            return cfg
+        if unsupported is not None:
             return False
-        if a.num_heads % (self.mesh.shape["tp"] * self.mesh.shape["ep"]) != 0:
+        if a.num_heads % self.mesh.shape["tp"] != 0:
             return False
         return jax.default_backend() not in ("cpu",)
 
@@ -220,11 +231,13 @@ class TpuModelForCausalLM:
                 arr = arr.astype(dtype) if arr.dtype != dtype else arr
             return jax.device_put(arr, s)
 
-        rope = np.asarray(host_params["rope_inv_freq"], dtype=np.float32)
         self.params = jax.tree.map(_put, host_params, shardings)
-        # rope_inv_freq stays fp32 regardless of model dtype
-        self.params["rope_inv_freq"] = jax.device_put(
-            rope, named_sharding(self.mesh, (None,)))
+        # rope inv_freq tables stay fp32 regardless of model dtype
+        for key in host_params:
+            if key.startswith("rope_inv_freq"):
+                self.params[key] = jax.device_put(
+                    np.asarray(host_params[key], dtype=np.float32),
+                    named_sharding(self.mesh, (None,)))
 
     # --- cache ------------------------------------------------------------------------
     def cache_spec(self) -> kvcache.KVCacheSpec:

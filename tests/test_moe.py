@@ -1,0 +1,125 @@
+"""MoE: routing semantics, HF parity for Mixtral / Qwen3-MoE, EP sharding.
+
+≈ reference MoE tests (`test/integration/tiny_model/features/test_moe_ep.py`,
+`test/unit/models/*` state-dict conversions).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from neuronx_distributed_inference_tpu.config import TpuConfig, load_pretrained_config
+from neuronx_distributed_inference_tpu.ops.moe import MoEArgs, route
+
+
+def _tpu_cfg(**kw):
+    base = dict(batch_size=2, seq_len=64, max_context_length=32, dtype="float32",
+                context_encoding_buckets=[16, 32], token_generation_buckets=[32, 64])
+    base.update(kw)
+    return TpuConfig(**base)
+
+
+def test_route_topk_sparsity_and_renorm():
+    moe = MoEArgs(num_experts=8, experts_per_tok=2, norm_topk_prob=True)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(5, 16)).astype(np.float32))
+    w = jnp.asarray(rng.normal(size=(16, 8)).astype(np.float32))
+    gates = np.asarray(route(w, x, moe))
+    assert gates.shape == (5, 8)
+    assert ((gates > 0).sum(axis=1) == 2).all()
+    np.testing.assert_allclose(gates.sum(axis=1), 1.0, atol=1e-6)
+
+    moe_raw = MoEArgs(num_experts=8, experts_per_tok=2, norm_topk_prob=False)
+    gates_raw = np.asarray(route(w, x, moe_raw))
+    assert (gates_raw.sum(axis=1) < 1.0).all()   # softmax mass of just top-2
+
+
+def _mixtral_pair():
+    from transformers import MixtralConfig, MixtralForCausalLM as HFMixtral
+
+    from neuronx_distributed_inference_tpu.models.mixtral import MixtralForCausalLM
+
+    cfg = MixtralConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=96, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=512,
+        num_local_experts=4, num_experts_per_tok=2, rope_theta=10000.0,
+        tie_word_embeddings=False, sliding_window=None)
+    torch.manual_seed(0)
+    return MixtralForCausalLM, HFMixtral(cfg).eval(), cfg
+
+
+def _qwen3_moe_pair():
+    from transformers import Qwen3MoeConfig, Qwen3MoeForCausalLM as HFQwen3Moe
+
+    from neuronx_distributed_inference_tpu.models.qwen3_moe import Qwen3MoeForCausalLM
+
+    cfg = Qwen3MoeConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        moe_intermediate_size=48, num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, head_dim=16, max_position_embeddings=512,
+        num_experts=4, num_experts_per_tok=2, norm_topk_prob=True,
+        decoder_sparse_step=1, mlp_only_layers=[], rope_theta=10000.0,
+        tie_word_embeddings=False)
+    torch.manual_seed(0)
+    return Qwen3MoeForCausalLM, HFQwen3Moe(cfg).eval(), cfg
+
+
+def _load(app_cls, hf_model, hf_cfg, tpu_cfg):
+    config = app_cls.get_config_cls()(
+        tpu_cfg, load_config=load_pretrained_config(hf_cfg.to_dict()))
+    app = app_cls(None, config)
+    state = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
+    app._put_params(app.convert_hf_state_dict(state, app.config))
+    return app
+
+
+@pytest.mark.parametrize("pair_fn", [_mixtral_pair, _qwen3_moe_pair])
+def test_moe_parity_vs_hf(pair_fn):
+    app_cls, hf, cfg = pair_fn()
+    app = _load(app_cls, hf, cfg, _tpu_cfg())
+
+    rng = np.random.default_rng(0)
+    input_ids = rng.integers(1, 256, size=(2, 12)).astype(np.int64)
+    with torch.no_grad():
+        hf_logits = hf(torch.tensor(input_ids)).logits[:, -1].numpy()
+    out = app.generate(input_ids, max_new_tokens=1, return_logits=True)
+    np.testing.assert_allclose(out.logits[0], hf_logits, atol=5e-4, rtol=1e-3)
+
+    with torch.no_grad():
+        hf_out = hf.generate(torch.tensor(input_ids), max_new_tokens=8,
+                             do_sample=False, pad_token_id=0)
+    out = app.generate(input_ids, max_new_tokens=8)
+    np.testing.assert_array_equal(out.tokens, hf_out[:, 12:].numpy())
+
+
+def test_moe_expert_parallel_matches_single_device():
+    """ep=4 over the virtual CPU mesh must produce the same logits as ep=1
+    (≈ reference EP logit-matching, `test_moe_ep.py`)."""
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 virtual devices")
+    app_cls, hf, cfg = _mixtral_pair()
+    app1 = _load(app_cls, hf, cfg, _tpu_cfg())
+    app4 = _load(app_cls, hf, cfg, _tpu_cfg(ep_degree=4))
+
+    rng = np.random.default_rng(1)
+    input_ids = rng.integers(1, 256, size=(2, 10)).astype(np.int64)
+    out1 = app1.generate(input_ids, max_new_tokens=4, return_logits=True)
+    out4 = app4.generate(input_ids, max_new_tokens=4, return_logits=True)
+    np.testing.assert_array_equal(out1.tokens, out4.tokens)
+    np.testing.assert_allclose(out1.logits[0], out4.logits[0], atol=2e-4, rtol=1e-3)
+
+
+def test_moe_tensor_parallel_matches_single_device():
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 virtual devices")
+    app_cls, hf, cfg = _qwen3_moe_pair()
+    app1 = _load(app_cls, hf, cfg, _tpu_cfg())
+    app2 = _load(app_cls, hf, cfg, _tpu_cfg(tp_degree=2))
+
+    rng = np.random.default_rng(2)
+    input_ids = rng.integers(1, 256, size=(2, 10)).astype(np.int64)
+    out1 = app1.generate(input_ids, max_new_tokens=4)
+    out2 = app2.generate(input_ids, max_new_tokens=4)
+    np.testing.assert_array_equal(out1.tokens, out2.tokens)
