@@ -41,30 +41,29 @@ def prepare_sampling_params(batch_size: int, top_k=1, top_p=1.0, temperature=1.0
     return np.stack([_col(top_k), _col(top_p), _col(temperature)], axis=1)
 
 
-def sample(
-    logits: jnp.ndarray,                  # (B, V) any float dtype
-    sampling_params: jnp.ndarray,         # (B, 3) [top_k, top_p, temperature]
-    key: Optional[jax.Array],
+def _masked_window(
+    logits: jnp.ndarray,                  # (..., V) fp32
+    sampling_params: jnp.ndarray,         # (..., 3) broadcastable to logits[:-1]
     config: OnDeviceSamplingConfig,
-) -> jnp.ndarray:
-    """Return sampled token ids (B,) int32, entirely on device."""
+):
+    """Shared top-k/top-p/temperature masking over the global-topk window.
+
+    Returns ``(masked (..., K), top_idx (..., K))``: temperature-scaled logits in
+    descending order with rejected entries at NEG_INF, plus their vocab indices.
+    """
     logits = logits.astype(jnp.float32)
-    batch, vocab = logits.shape
-
-    if not config.do_sample and not config.dynamic:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-
+    vocab = logits.shape[-1]
     k_width = min(config.global_topk, vocab)
-    top_vals, top_idx = jax.lax.top_k(logits, k_width)   # (B, K) desc order
+    top_vals, top_idx = jax.lax.top_k(logits, k_width)   # (..., K) desc order
 
-    top_k = sampling_params[:, 0:1]                      # (B, 1) float
-    top_p = sampling_params[:, 1:2]
-    temperature = jnp.maximum(sampling_params[:, 2:3], 1e-6)
+    top_k = sampling_params[..., 0:1]                    # (..., 1) float
+    top_p = sampling_params[..., 1:2]
+    temperature = jnp.maximum(sampling_params[..., 2:3], 1e-6)
 
-    ranks = jnp.arange(k_width, dtype=jnp.float32)[None, :]
+    ranks = jnp.arange(k_width, dtype=jnp.float32)
     # top_k <= 0 means "all" (within the global prefilter window)
     k_eff = jnp.where(top_k <= 0, float(k_width), top_k)
-    topk_mask = ranks < k_eff                            # (B, K)
+    topk_mask = ranks < k_eff                            # (..., K)
 
     scaled = top_vals / temperature
     scaled = jnp.where(topk_mask, scaled, NEG_INF)
@@ -75,6 +74,23 @@ def sample(
     cum = jnp.cumsum(probs, axis=-1)
     topp_mask = (cum - probs) < top_p
     masked = jnp.where(topp_mask, scaled, NEG_INF)
+    return masked, top_idx
+
+
+def sample(
+    logits: jnp.ndarray,                  # (B, V) any float dtype
+    sampling_params: jnp.ndarray,         # (B, 3) [top_k, top_p, temperature]
+    key: Optional[jax.Array],
+    config: OnDeviceSamplingConfig,
+) -> jnp.ndarray:
+    """Return sampled token ids (B,) int32, entirely on device."""
+    logits = logits.astype(jnp.float32)
+    batch = logits.shape[0]
+
+    if not config.do_sample and not config.dynamic:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    masked, top_idx = _masked_window(logits, sampling_params, config)
 
     greedy_choice = jnp.zeros((batch,), dtype=jnp.int32)  # index 0 = argmax in sorted order
     if key is None:
@@ -83,9 +99,33 @@ def sample(
         gumbel = jax.random.gumbel(key, masked.shape, dtype=jnp.float32)
         sampled_choice = jnp.argmax(masked + gumbel, axis=-1).astype(jnp.int32)
         # greedy requests (top_k == 1) stay exact argmax regardless of noise
-        choice = jnp.where(top_k[:, 0] == 1, greedy_choice, sampled_choice)
+        choice = jnp.where(sampling_params[:, 0] == 1, greedy_choice, sampled_choice)
 
     return jnp.take_along_axis(top_idx, choice[:, None], axis=-1)[:, 0].astype(jnp.int32)
+
+
+def window_probs(
+    logits: jnp.ndarray,                  # (..., V)
+    sampling_params: jnp.ndarray,         # (..., 3)
+    config: OnDeviceSamplingConfig,
+):
+    """Post-mask probabilities over the global-topk window: ``(probs (..., K),
+    idx (..., K))``. Used by speculative acceptance, which needs the *distribution* a
+    token was (or would be) sampled from, not just a draw."""
+    masked, top_idx = _masked_window(logits, sampling_params, config)
+    return jax.nn.softmax(masked, axis=-1), top_idx
+
+
+def scatter_to_vocab(probs: jnp.ndarray, idx: jnp.ndarray, vocab: int) -> jnp.ndarray:
+    """Scatter window probabilities (..., K) at vocab indices (..., K) into a dense
+    (..., V) distribution (zeros elsewhere)."""
+    out = jnp.zeros(probs.shape[:-1] + (vocab,), dtype=probs.dtype)
+    flat_out = out.reshape(-1, out.shape[-1])
+    flat_idx = idx.reshape(-1, idx.shape[-1])
+    flat_probs = probs.reshape(-1, probs.shape[-1])
+    rows = jnp.arange(flat_out.shape[0])[:, None]
+    flat_out = flat_out.at[rows, flat_idx].set(flat_probs)
+    return flat_out.reshape(out.shape)
 
 
 def greedy(logits: jnp.ndarray) -> jnp.ndarray:

@@ -1,0 +1,298 @@
+"""Fused speculative decoding: draft + target in ONE jitted device step.
+
+≈ reference `NeuronFusedSpecModel` (`models/model_base.py:1641`): context encoding runs
+target then draft over the same prompt in one flow (`_context_encoding_forward` :1792);
+each decode step loops the draft model ``speculation_length - 1`` times then verifies all
+candidates with the target in a single wide call (`_token_gen_forward` :1854-1971);
+acceptance follows the standard speculative-sampling rules — exact token match for
+greedy, rejection sampling with the residual distribution ``norm(max(p_t - p_d, 0))``
+for multinomial (acceptance math ≈ `model_base.py:1706-1790`).
+
+TPU redesign:
+
+- The draft loop is a `lax.scan` *inside* the same jitted function as the target verify,
+  so one fused step = one device dispatch (the reference fuses draft+target into one
+  NEFF for the same reason).
+- Acceptance runs **on device** (the reference computes accepted length on CPU in
+  `utils/hf_adapter.py:494` `_fused_assisted_decoding`); the host only receives
+  ``(candidate_tokens (B, K), num_valid (B,))`` and appends — no logits ever leave HBM.
+- KV discipline: candidates are written into both caches at ``[pos, pos+K)``; after an
+  acceptance of ``n`` tokens the next step starts at ``pos + n + 1`` and its writes cover
+  the entire stale region before any read (decode masks are position-bounded), so
+  rejected-token cache entries never need rollback — same trick as the reference's
+  position-masked cache reads.
+
+Per step, the target emits between 1 and ``speculation_length`` committed tokens:
+``n`` accepted drafts plus one correction/bonus token.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import OnDeviceSamplingConfig
+from ..models import base as model_base
+from ..modules import autobucketing
+from ..ops import sampling as sampling_ops
+from . import model_wrapper
+
+
+@dataclass
+class SpecGenerateOutput:
+    sequences: np.ndarray             # (B, prompt + generated)
+    tokens: np.ndarray                # (B, generated) right-padded with pad_token_id
+    num_generated: np.ndarray         # (B,) actual generated count per row
+    acceptance_counts: np.ndarray     # histogram over tokens-emitted-per-step (len K)
+    steps: int = 0
+    ttft_s: Optional[float] = None
+
+
+class FusedSpeculativeModel:
+    """Owns a target and a draft `TpuModelForCausalLM` and runs fused spec decode.
+
+    Both apps must share vocab and tpu_config geometry; the draft is typically a much
+    smaller model of the same family (or any arch with the same tokenizer).
+    """
+
+    def __init__(self, target, draft, speculation_length: int, greedy: bool = True):
+        if speculation_length < 2:
+            raise ValueError("speculation_length must be >= 2 (1 draft + 1 verify)")
+        if target.arch_args.vocab_size != draft.arch_args.vocab_size:
+            raise ValueError("target and draft must share a vocabulary")
+        t_cfg, d_cfg = target.tpu_config, draft.tpu_config
+        for attr in ("seq_len", "max_batch_size", "max_context_length"):
+            if getattr(t_cfg, attr) != getattr(d_cfg, attr):
+                raise ValueError(
+                    f"target/draft tpu_config.{attr} mismatch: "
+                    f"{getattr(t_cfg, attr)} vs {getattr(d_cfg, attr)} — both caches "
+                    f"must cover the same positions (out-of-range draft writes would "
+                    f"clamp silently)")
+        if not greedy:
+            odsc = target.sampling_config
+            if not (odsc.do_sample or odsc.dynamic):
+                raise ValueError(
+                    "multinomial speculation (greedy=False) requires a sampling config "
+                    "with do_sample or dynamic params — with both off, sample() is a "
+                    "full-vocab argmax while acceptance uses windowed probabilities, "
+                    "which breaks the rejection-sampling guarantee")
+        self.target = target
+        self.draft = draft
+        self.k = speculation_length
+        self.greedy = greedy
+        self.sampling_config = target.sampling_config
+        self._build_step()
+
+    # ------------------------------------------------------------------ step
+    def _build_step(self) -> None:
+        t_args = self.target.arch_args
+        d_args = self.draft.arch_args
+        mesh, rules = self.target.mesh, self.target.sharding_rules
+        d_mesh, d_rules = self.draft.mesh, self.draft.sharding_rules
+        k = self.k
+        odsc = self.sampling_config
+        greedy = self.greedy
+        vocab = t_args.vocab_size
+        precision = ("highest" if self.target.tpu_config.dtype == "float32"
+                     else "default")
+
+        def _step(t_params, d_params, last_tok, positions, t_cache, d_cache,
+                  sampling_params, key, decode_bucket):
+            """One fused speculative step.
+
+            last_tok (B,) int32: last committed token (its KV not yet written).
+            positions (B,) int32: write position of last_tok.
+            Returns (out_tokens (B, K), num_valid (B,), t_cache, d_cache) where
+            out_tokens[:, :num_valid] are the newly committed tokens.
+            """
+            key_d, key_acc, key_res, key_bonus = jax.random.split(key, 4)
+            d_keys = jax.random.split(key_d, k)
+
+            # --- draft loop: k iterations proposing k-1 candidates (one dispatch).
+            # The k-th iteration's *proposal* is discarded; it runs so that d_{k-1}'s
+            # KV lands in the draft cache — on full acceptance the next step starts
+            # past it and would otherwise read a never-written slot (the reference
+            # loops the draft spec_len times for the same reason,
+            # `model_base.py:1881-1930`).
+            def draft_body(carry, key_j):
+                tok, pos, cache = carry
+                with jax.default_matmul_precision(precision):
+                    logits, cache = model_base.decode_forward(
+                        d_params, d_args, tok[:, None], pos, cache, decode_bucket,
+                        mesh=d_mesh, rules=d_rules)
+                last = logits[:, -1]
+                if greedy:
+                    nxt = jnp.argmax(last, axis=-1).astype(jnp.int32)
+                else:
+                    nxt = sampling_ops.sample(last, sampling_params, key_j, odsc)
+                return (nxt, pos + 1, cache), (nxt, last)
+
+            (_, _, d_cache), (draft_toks, draft_logits) = jax.lax.scan(
+                draft_body, (last_tok, positions, d_cache), d_keys)
+            draft_toks = draft_toks.T[:, : k - 1]                       # (B, K-1)
+            draft_logits = draft_logits.transpose(1, 0, 2)[:, : k - 1]  # (B, K-1, V)
+
+            # --- target verify: one wide decode over [last, d_1, ..., d_{k-1}] ------
+            target_in = jnp.concatenate([last_tok[:, None], draft_toks], axis=1)
+            with jax.default_matmul_precision(precision):
+                t_logits, t_cache = model_base.decode_forward(
+                    t_params, t_args, target_in, positions, t_cache, decode_bucket,
+                    mesh=mesh, rules=rules)              # (B, K, V)
+
+            if greedy:
+                t_toks = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)  # (B, K)
+                matches = draft_toks == t_toks[:, :-1]                    # (B, K-1)
+                n = jnp.cumprod(matches.astype(jnp.int32), axis=1).sum(axis=1)
+                out_toks = t_toks
+            else:
+                # rejection sampling: accept d_j with prob min(1, p_t(d_j)/p_d(d_j));
+                # on first rejection resample from norm(max(p_t - p_d, 0)).
+                sp = sampling_params[:, None, :]  # broadcast over the K-1 positions
+                pt_w, pt_idx = sampling_ops.window_probs(t_logits[:, :-1], sp, odsc)
+                pd_w, pd_idx = sampling_ops.window_probs(draft_logits, sp, odsc)
+                p_t = sampling_ops.scatter_to_vocab(pt_w, pt_idx, vocab)  # (B,K-1,V)
+                p_d = sampling_ops.scatter_to_vocab(pd_w, pd_idx, vocab)
+                d_sel = draft_toks[..., None]
+                pt_d = jnp.take_along_axis(p_t, d_sel, axis=-1)[..., 0]   # (B, K-1)
+                pd_d = jnp.take_along_axis(p_d, d_sel, axis=-1)[..., 0]
+                u = jax.random.uniform(key_acc, pt_d.shape, dtype=jnp.float32)
+                accept = u < jnp.minimum(1.0, pt_d / jnp.maximum(pd_d, 1e-20))
+                n = jnp.cumprod(accept.astype(jnp.int32), axis=1).sum(axis=1)
+
+                resid = jnp.maximum(p_t - p_d, 0.0)
+                resid_sum = resid.sum(axis=-1, keepdims=True)
+                # all-accepted positions may have a zero residual; fall back to p_t
+                resid = jnp.where(resid_sum > 1e-9, resid / jnp.maximum(resid_sum, 1e-20),
+                                  p_t)
+                resampled = jax.random.categorical(
+                    key_res, jnp.log(jnp.maximum(resid, 1e-20)), axis=-1
+                ).astype(jnp.int32)                                        # (B, K-1)
+                bonus = sampling_ops.sample(t_logits[:, -1], sampling_params,
+                                            key_bonus, odsc)               # (B,)
+                drafts_ext = jnp.concatenate([draft_toks, bonus[:, None]], axis=1)
+                correction = jnp.concatenate([resampled, bonus[:, None]], axis=1)
+                slot = jnp.arange(k)[None, :]
+                out_toks = jnp.where(slot < n[:, None], drafts_ext, correction)
+
+            return out_toks, n.astype(jnp.int32), t_cache, d_cache
+
+        self._spec_step = jax.jit(_step, donate_argnums=(4, 5),
+                                  static_argnames=("decode_bucket",))
+
+    # ------------------------------------------------------------------ generate
+    def generate(
+        self,
+        input_ids: np.ndarray,
+        attention_mask: Optional[np.ndarray] = None,
+        max_new_tokens: int = 32,
+        sampling_params: Optional[np.ndarray] = None,
+        eos_token_id: Optional[int] = None,
+        pad_token_id: int = 0,
+        seed: int = 0,
+    ) -> SpecGenerateOutput:
+        """Host orchestration loop (≈ `_fused_assisted_decoding`, `hf_adapter.py:494`).
+
+        Rows commit a variable 1..K tokens per step, so rows advance unevenly; finished
+        rows keep stepping (SPMD batch) with frozen positions and their outputs dropped.
+        """
+        target, draft = self.target, self.draft
+        cfg = target.tpu_config
+        if target.params is None or draft.params is None:
+            raise RuntimeError("load weights on both target and draft before generate")
+        input_ids = model_wrapper.to_int32(input_ids)
+        b = input_ids.shape[0]
+        compiled_b = cfg.max_batch_size
+        if sampling_params is None:
+            sampling_params = sampling_ops.prepare_sampling_params(compiled_b)
+        elif sampling_params.shape[0] > compiled_b:
+            raise ValueError(f"sampling_params batch {sampling_params.shape[0]} exceeds "
+                             f"compiled batch size {compiled_b}")
+        elif sampling_params.shape[0] < compiled_b:
+            pad = np.ones((compiled_b - sampling_params.shape[0], 3), dtype=np.float32)
+            sampling_params = np.concatenate([sampling_params, pad], axis=0)
+        key = jax.random.PRNGKey(seed if not self.sampling_config.deterministic
+                                 else self.sampling_config.seed)
+
+        padded = model_wrapper.pad_prefill_inputs(
+            input_ids, attention_mask, target.cte_buckets, pad_token_id=pad_token_id,
+            batch_size=compiled_b)
+        target.reset_cache()
+        draft.reset_cache()
+
+        # --- fused context encoding: target prefill (samples t0) + draft prefill ----
+        t_start = time.perf_counter()
+        key, sub = jax.random.split(key)
+        tok0_dev, _, target.kv_cache = target._prefill_step(
+            target.params, padded.input_ids, padded.position_ids,
+            padded.last_token_idx, target.kv_cache, sampling_params, sub)
+        _, _, draft.kv_cache = draft._prefill_step(
+            draft.params, padded.input_ids, padded.position_ids,
+            padded.last_token_idx, draft.kv_cache, sampling_params, sub)
+        tok0 = np.asarray(tok0_dev)
+        ttft = time.perf_counter() - t_start
+
+        committed: List[List[int]] = [[int(tok0[i])] for i in range(b)]
+        done = np.zeros((compiled_b,), dtype=bool)
+        done[b:] = True
+        if eos_token_id is not None:
+            done[:b] |= tok0[:b] == eos_token_id
+        positions = padded.true_lengths.astype(np.int32).copy()
+        last_tok = tok0.astype(np.int32)
+        accept_hist = np.zeros((self.k,), dtype=np.int64)
+        steps = 0
+
+        while not all(len(c) >= max_new_tokens or done[i] for i, c in enumerate(committed)):
+            max_pos = int(positions.max())
+            if max_pos + self.k >= cfg.seq_len:
+                break
+            bucket = autobucketing.select_bucket(target.tkg_buckets,
+                                                 max_pos + self.k)
+            key, sub = jax.random.split(key)
+            out_dev, n_dev, target.kv_cache, draft.kv_cache = self._spec_step(
+                target.params, draft.params, jnp.asarray(last_tok),
+                jnp.asarray(positions), target.kv_cache, draft.kv_cache,
+                sampling_params, sub, decode_bucket=bucket)
+            out = np.asarray(out_dev)    # (B, K)
+            n = np.asarray(n_dev)        # (B,)
+            steps += 1
+            for i in range(b):
+                if done[i]:
+                    continue
+                take = int(n[i]) + 1
+                accept_hist[take - 1] += 1
+                for j in range(take):
+                    if len(committed[i]) >= max_new_tokens:
+                        break
+                    t = int(out[i, j])
+                    committed[i].append(t)
+                    if eos_token_id is not None and t == eos_token_id:
+                        done[i] = True
+                        break
+                if not done[i] and len(committed[i]) >= max_new_tokens:
+                    done[i] = True
+                if not done[i]:
+                    positions[i] += take
+                    last_tok[i] = out[i, take - 1]
+            # frozen rows re-step harmlessly at their last position
+
+        num_gen = np.array([len(c) for c in committed], dtype=np.int32)
+        width = int(num_gen.max()) if b else 0
+        tokens = np.full((b, width), pad_token_id, dtype=np.int32)
+        for i in range(b):
+            tokens[i, : num_gen[i]] = committed[i]
+        prompt_lens = padded.true_lengths[:b]
+        max_len = int(prompt_lens.max()) + width
+        sequences = np.full((b, max_len), pad_token_id, dtype=np.int32)
+        for i in range(b):
+            pl = int(prompt_lens[i])
+            sequences[i, :pl] = padded.input_ids[i, :pl]
+            sequences[i, pl : pl + num_gen[i]] = committed[i]
+        return SpecGenerateOutput(sequences=sequences, tokens=tokens,
+                                  num_generated=num_gen,
+                                  acceptance_counts=accept_hist, steps=steps,
+                                  ttft_s=ttft)

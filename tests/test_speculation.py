@@ -1,0 +1,98 @@
+"""Fused speculative decoding tests.
+
+Key correctness property (≈ the reference's draft-logit matching harness,
+`utils/accuracy.py:1214`): with greedy acceptance, fused spec output must equal the
+target model's plain greedy decode *regardless of the draft model* — speculation is an
+exact acceleration, not an approximation.
+"""
+
+import numpy as np
+import pytest
+
+from neuronx_distributed_inference_tpu.config import (
+    OnDeviceSamplingConfig, TpuConfig, load_pretrained_config)
+from neuronx_distributed_inference_tpu.models.llama.modeling_llama import (
+    LlamaForCausalLM, LlamaInferenceConfig)
+from neuronx_distributed_inference_tpu.ops.sampling import prepare_sampling_params
+from neuronx_distributed_inference_tpu.runtime.speculation import FusedSpeculativeModel
+
+
+def _make_app(hf_cfg, seed, batch=2, do_sample=False):
+    tpu_cfg = TpuConfig(
+        batch_size=batch, seq_len=128, max_context_length=32, dtype="float32",
+        context_encoding_buckets=[16, 32], token_generation_buckets=[64, 128],
+        on_device_sampling_config=OnDeviceSamplingConfig(do_sample=do_sample),
+    )
+    config = LlamaInferenceConfig(tpu_cfg, load_config=load_pretrained_config(hf_cfg))
+    app = LlamaForCausalLM(None, config)
+    app.load_random(seed=seed)
+    return app
+
+
+@pytest.fixture(scope="module")
+def target_draft(tiny_llama_hf_config):
+    target = _make_app(tiny_llama_hf_config, seed=0)
+    draft_cfg = dict(tiny_llama_hf_config)
+    draft_cfg.update(hidden_size=32, intermediate_size=64, num_hidden_layers=1,
+                     num_attention_heads=2, num_key_value_heads=2)
+    draft = _make_app(draft_cfg, seed=1)
+    return target, draft
+
+
+def test_greedy_spec_matches_plain_decode(target_draft):
+    target, draft = target_draft
+    rng = np.random.default_rng(0)
+    input_ids = rng.integers(1, 256, size=(2, 12)).astype(np.int32)
+
+    ref = target.generate(input_ids, max_new_tokens=24)
+    spec = FusedSpeculativeModel(target, draft, speculation_length=4, greedy=True)
+    out = spec.generate(input_ids, max_new_tokens=24)
+
+    np.testing.assert_array_equal(out.tokens, ref.tokens)
+    assert out.num_generated.tolist() == [24, 24]
+    # histogram counts one entry per (active row, step)
+    assert out.acceptance_counts.sum() >= out.steps
+
+
+def test_self_draft_accepts_everything(tiny_llama_hf_config):
+    """Draft == target (same weights): every draft token matches the target argmax, so
+    each step emits the full speculation_length tokens."""
+    target = _make_app(tiny_llama_hf_config, seed=0)
+    draft = _make_app(tiny_llama_hf_config, seed=0)
+    spec = FusedSpeculativeModel(target, draft, speculation_length=4, greedy=True)
+    rng = np.random.default_rng(1)
+    input_ids = rng.integers(1, 256, size=(2, 8)).astype(np.int32)
+    out = spec.generate(input_ids, max_new_tokens=16)
+    ref = target.generate(input_ids, max_new_tokens=16)
+    np.testing.assert_array_equal(out.tokens, ref.tokens)
+    # all steps should emit k tokens (full acceptance)
+    assert out.acceptance_counts[:-1].sum() == 0
+    assert out.steps <= int(np.ceil(15 / 4)) + 1
+
+
+def test_multinomial_spec_runs_and_respects_eos(target_draft):
+    target, draft = target_draft
+    spec = FusedSpeculativeModel(target, draft, speculation_length=3, greedy=False)
+    rng = np.random.default_rng(2)
+    input_ids = rng.integers(1, 256, size=(2, 10)).astype(np.int32)
+    sp = prepare_sampling_params(2, top_k=20, top_p=0.9, temperature=0.8)
+    out = spec.generate(input_ids, max_new_tokens=12, sampling_params=sp, seed=3)
+    assert out.tokens.shape[0] == 2
+    assert (out.num_generated >= 1).all()
+    assert (out.tokens[:, 0] >= 0).all()
+    assert out.tokens.max() < 256
+
+
+def test_eos_stops_row(target_draft):
+    """Force an EOS by treating the first generated token id as the stop id for row 0."""
+    target, draft = target_draft
+    spec = FusedSpeculativeModel(target, draft, speculation_length=4, greedy=True)
+    rng = np.random.default_rng(4)
+    input_ids = rng.integers(1, 256, size=(2, 8)).astype(np.int32)
+    probe = spec.generate(input_ids, max_new_tokens=8)
+    eos = int(probe.tokens[0, 3])  # pick an id that appears mid-stream for row 0
+    out = spec.generate(input_ids, max_new_tokens=8, eos_token_id=eos, pad_token_id=0)
+    row = out.tokens[0, : out.num_generated[0]]
+    hits = np.nonzero(row == eos)[0]
+    if hits.size:  # stop must be at the row's end when EOS fires
+        assert hits[0] == out.num_generated[0] - 1
