@@ -27,7 +27,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..modules import kvcache
+from ..modules import block_kvcache, kvcache
 from ..ops import rope as rope_ops
 from ..ops.attention import attend, causal_mask
 from ..ops.moe import MoEArgs, moe_block
@@ -291,6 +291,8 @@ def _decoder_layer(
     rules=None,
     sinks: Optional[jnp.ndarray] = None,
     use_flash: bool = False,
+    paged: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,  # (block_table, slot_mapping)
+    cache_batch_start=0,
 ):
     zc = args.zero_centered_norms
     resid = h
@@ -301,10 +303,20 @@ def _decoder_layer(
     v = constrain(v, ("batch", "kv_heads", None, None), rules, mesh=mesh)
     q, k = rope_ops.apply_rotary(q, k, cos, sin)
 
-    if positions is None:
+    if paged is not None:
+        # paged cache: scatter at flat slots; reads gather through the block table
+        block_table, slot_mapping = paged
+        k_cache = block_kvcache.write_slots(k_cache, k, slot_mapping)
+        v_cache = block_kvcache.write_slots(v_cache, v, slot_mapping)
+        if positions is None:
+            k_att, v_att = k, v     # prefill attends over the fresh tokens only
+        else:
+            k_att = block_kvcache.read_seq(k_cache, block_table)
+            v_att = block_kvcache.read_seq(v_cache, block_table)
+    elif positions is None:
         # prefill: cache write at [0, S), attend over the fresh (unpadded-bucket) k/v
-        k_cache = kvcache.write_prefill(k_cache, k)
-        v_cache = kvcache.write_prefill(v_cache, v)
+        k_cache = kvcache.write_prefill(k_cache, k, batch_start=cache_batch_start)
+        v_cache = kvcache.write_prefill(v_cache, v, batch_start=cache_batch_start)
         k_att, v_att = k, v
     else:
         k_cache = kvcache.write_decode(k_cache, k, positions)
@@ -340,7 +352,7 @@ def _decoder_layer(
 
 def _run_stack(params: Params, args: ModelArchArgs, h, cos, sin, mask, cache,
                positions, decode_bucket, mesh, rules, use_flash=False,
-               local_rope_mask=None):
+               local_rope_mask=None, paged=None, cache_batch_start=0):
     """Scan the decoder layers, carrying hidden state, yielding updated cache.
 
     ``local_rope_mask`` (set when args.layer_pattern is not None) is a triple
@@ -367,7 +379,8 @@ def _run_stack(params: Params, args: ModelArchArgs, h, cos, sin, mask, cache,
             mask_i = jnp.where(slide, mask_l, mask)
         new_h, kc, vc = _decoder_layer(lp, args, carry_h, cos_i, sin_i, mask_i, kc, vc,
                                        positions, decode_bucket, mesh, rules,
-                                       use_flash=use_flash)
+                                       use_flash=use_flash, paged=paged,
+                                       cache_batch_start=cache_batch_start)
         return new_h, (kc, vc)
 
     h, (k_new, v_new) = jax.lax.scan(body, h, xs)
@@ -398,8 +411,14 @@ def prefill_forward(
     mesh=None,
     rules=None,
     use_flash: bool = False,
+    slot_mapping: Optional[jnp.ndarray] = None,  # (B, S) paged write slots (-1 = drop)
+    cache_batch_start=0,          # dense continuous batching: batch row to insert at
 ) -> Tuple[jnp.ndarray, kvcache.KVCache]:
-    """Context encoding: returns (last-token logits (B, V) fp32, updated cache)."""
+    """Context encoding: returns (last-token logits (B, V) fp32, updated cache).
+
+    With ``slot_mapping`` the cache is a paged pytree (see modules/block_kvcache) and
+    writes scatter to flat slots; with ``cache_batch_start`` the dense write lands at a
+    specific batch row (continuous-batching insert)."""
     h = _embed(params, args, input_ids, mesh, rules)
     cos, sin = rope_ops.compute_cos_sin(params["rope_inv_freq"], position_ids,
                                         args.rope_attention_scaling)
@@ -418,9 +437,13 @@ def prefill_forward(
     elif sliding is not None:
         mask = sliding
 
+    paged = None
+    if slot_mapping is not None:
+        paged = (jnp.zeros((input_ids.shape[0], 1), dtype=jnp.int32), slot_mapping)
     h, cache = _run_stack(params, args, h, cos, sin, mask, cache,
                           positions=None, decode_bucket=None, mesh=mesh, rules=rules,
-                          use_flash=use_flash, local_rope_mask=local_rope_mask)
+                          use_flash=use_flash, local_rope_mask=local_rope_mask,
+                          paged=paged, cache_batch_start=cache_batch_start)
     h = rms_norm(h, params["final_norm"], args.rms_norm_eps,
                  zero_centered=args.zero_centered_norms)
     h_last = jnp.take_along_axis(h, last_token_idx[:, None, None], axis=1)[:, 0]
@@ -434,11 +457,22 @@ def decode_forward(
     input_ids: jnp.ndarray,      # (B, T) int32 (T = 1, or speculation width)
     position_ids: jnp.ndarray,   # (B,) int32 position of input_ids[:, 0]
     cache: kvcache.KVCache,      # donated
-    decode_bucket: int,          # static: cache slice width for this compiled graph
+    decode_bucket: Optional[int],  # static: cache slice width (None for paged mode)
     mesh=None,
     rules=None,
+    block_table: Optional[jnp.ndarray] = None,   # (B, MB) paged: per-seq block ids
+    slot_mapping: Optional[jnp.ndarray] = None,  # (B, T) paged: flat write slots
 ) -> Tuple[jnp.ndarray, kvcache.KVCache]:
-    """Token generation: returns (logits (B, T, V) fp32, updated cache)."""
+    """Token generation: returns (logits (B, T, V) fp32, updated cache).
+
+    Dense mode slices the cache at the static ``decode_bucket``; paged mode
+    (``block_table``/``slot_mapping`` given) gathers each row's blocks instead, with the
+    attention width set by the table (MB * block_size)."""
+    paged = None
+    if block_table is not None:
+        paged = (block_table, slot_mapping)
+        block_size = cache["k"].shape[2]
+        decode_bucket = block_table.shape[1] * block_size
     b, t = input_ids.shape
     h = _embed(params, args, input_ids, mesh, rules)
     pos_grid = position_ids[:, None] + jnp.arange(t)[None, :]      # (B, T)
@@ -459,7 +493,8 @@ def decode_forward(
 
     h, cache = _run_stack(params, args, h, cos, sin, mask, cache,
                           positions=position_ids, decode_bucket=decode_bucket,
-                          mesh=mesh, rules=rules, local_rope_mask=local_rope_mask)
+                          mesh=mesh, rules=rules, local_rope_mask=local_rope_mask,
+                          paged=paged)
     h = rms_norm(h, params["final_norm"], args.rms_norm_eps,
                  zero_centered=args.zero_centered_norms)
     logits = _lm_head(params, args, h, mesh, rules)

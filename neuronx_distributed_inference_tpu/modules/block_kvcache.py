@@ -1,0 +1,217 @@
+"""Paged (block) KV cache: block tables, slot-mapped writes, gathered reads, and a
+host-side block allocator with prefix caching.
+
+≈ reference `modules/kvcache/block_kv_cache_manager.py` (`BlockKVCacheManager` :11-374:
+cache = (num_blocks, block_size, H, D), gather via active_block_table, write via
+slot_mapping) and `modules/kvcache/utils.py` (`get_active_block_table` :40-). TPU
+redesign:
+
+- Device layout is layer-stacked ``(L, num_blocks, block_size, H_kv, D)`` so the model's
+  `lax.scan` over layers carries one (NB, BS, H, D) slice per step, exactly like the
+  dense cache.
+- Writes flatten blocks to a (NB*BS, H, D) slot view and scatter rows at
+  ``slot = block_id * block_size + offset`` with out-of-bounds drop semantics — padding
+  rows use slot -1 and vanish, replacing the reference's garbage-position padding writes
+  (`kv_cache_manager.py:463-466`).
+- Reads gather each sequence's blocks through its block table row into a contiguous
+  (B, H, S_logical, D) view; logical order is preserved, so the dense position-based
+  causal masks apply unchanged.
+- The host `BlockAllocator` owns the free list and (optionally) a prefix cache: chained
+  content hashes map full blocks to physical ids with refcounts, so shared prompt
+  prefixes reuse blocks across sequences (the reference's prefix-caching 2D bucket flow,
+  `model_wrapper.py:918-1142`, redesigned as vLLM-style block reuse).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+PagedKVCache = Dict[str, jnp.ndarray]
+
+# logical axes for sharding the stacked paged cache (blocks stay unsharded — each
+# shard holds full blocks for its kv_heads slice)
+PAGED_CACHE_LOGICAL = ("layers", None, None, "kv_heads", None)
+
+
+@dataclass(frozen=True)
+class PagedKVCacheSpec:
+    num_layers: int
+    num_blocks: int
+    block_size: int
+    num_kv_heads: int
+    head_dim: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @property
+    def shape(self) -> Tuple[int, int, int, int, int]:
+        return (self.num_layers, self.num_blocks, self.block_size,
+                self.num_kv_heads, self.head_dim)
+
+    @property
+    def num_slots(self) -> int:
+        return self.num_blocks * self.block_size
+
+
+def init_paged_cache(spec: PagedKVCacheSpec) -> PagedKVCache:
+    return {
+        "k": jnp.zeros(spec.shape, dtype=spec.dtype),
+        "v": jnp.zeros(spec.shape, dtype=spec.dtype),
+    }
+
+
+def write_slots(cache_layer: jnp.ndarray, new_kv: jnp.ndarray,
+                slot_mapping: jnp.ndarray) -> jnp.ndarray:
+    """Scatter (B, H, T, D) new tokens at flat slots (B, T) int32.
+
+    ``slot = block_id * block_size + offset``; negative slots are dropped (padding).
+    ≈ the reference's index_put write strategy (`block_kv_cache_manager.py:268-374`).
+    """
+    nb, bs, h, d = cache_layer.shape
+    flat = cache_layer.reshape(nb * bs, h, d)
+    b, hh, t, dd = new_kv.shape
+    rows = new_kv.transpose(0, 2, 1, 3).reshape(b * t, hh, dd).astype(flat.dtype)
+    slots = slot_mapping.reshape(b * t)
+    # negative indices WRAP in jnp (NumPy semantics) — only indices >= size are dropped
+    # by mode="drop"; remap the -1 sentinel to an explicitly out-of-bounds slot, else
+    # every padding write would clobber the final slot of the final block.
+    slots = jnp.where(slots < 0, nb * bs, slots)
+    flat = flat.at[slots].set(rows, mode="drop")
+    return flat.reshape(nb, bs, h, d)
+
+
+def read_seq(cache_layer: jnp.ndarray, block_table: jnp.ndarray) -> jnp.ndarray:
+    """Gather (NB, BS, H, D) through block tables (B, MB) -> (B, H, MB*BS, D).
+
+    Unused table entries may be any valid block id (masking is positional downstream).
+    ≈ `get_active_block_table` + gather (`kvcache/utils.py:40-`).
+    """
+    gathered = jnp.take(cache_layer, block_table, axis=0)   # (B, MB, BS, H, D)
+    b, mb, bs, h, d = gathered.shape
+    return gathered.reshape(b, mb * bs, h, d).transpose(0, 2, 1, 3)
+
+
+def make_slot_mapping(block_table: np.ndarray, positions: np.ndarray,
+                      num_tokens: int, block_size: int,
+                      valid: Optional[np.ndarray] = None) -> np.ndarray:
+    """Host helper: flat slots (B, T) for tokens written at positions
+    ``positions[b] + t``. Rows with ``valid[b] == False`` (or positions beyond the
+    table) get slot -1 (dropped).
+
+    ≈ `generate_tokengen_slot_mapping` (`block_kv_cache_manager.py:376`).
+    """
+    b = block_table.shape[0]
+    pos = positions[:, None] + np.arange(num_tokens)[None, :]       # (B, T)
+    blk_idx = pos // block_size
+    offset = pos % block_size
+    in_range = blk_idx < block_table.shape[1]
+    blk_idx = np.minimum(blk_idx, block_table.shape[1] - 1)
+    phys = np.take_along_axis(block_table, blk_idx, axis=1)
+    slots = phys * block_size + offset
+    slots[~in_range] = -1
+    if valid is not None:
+        slots[~valid] = -1
+    return slots.astype(np.int32)
+
+
+# ---------------------------------------------------------------------------
+# Host-side block allocator with prefix caching
+# ---------------------------------------------------------------------------
+
+
+class BlockAllocator:
+    """Free-list block allocator with optional prefix-cache reuse.
+
+    Prefix caching: a *full* block holding tokens ``t[i*bs:(i+1)*bs]`` of some sequence
+    is keyed by ``hash(prev_block_hash, tokens)``; a new sequence sharing that prefix
+    maps its logical block to the same physical block (refcounted) and skips recomputing
+    it. Only full blocks are shared; the trailing partial block is always private.
+    """
+
+    def __init__(self, num_blocks: int, block_size: int, enable_prefix_caching: bool = False):
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self.enable_prefix_caching = enable_prefix_caching
+        self.free: List[int] = list(range(num_blocks - 1, -1, -1))   # pop() -> lowest last
+        self.refcount: Dict[int, int] = {}
+        self.hash_to_block: Dict[bytes, int] = {}
+        self.block_to_hash: Dict[int, bytes] = {}
+
+    @property
+    def num_free(self) -> int:
+        return len(self.free)
+
+    def _alloc_one(self) -> int:
+        if not self.free:
+            raise RuntimeError("out of KV blocks")
+        blk = self.free.pop()
+        self.refcount[blk] = 1
+        return blk
+
+    def _release_one(self, blk: int) -> None:
+        self.refcount[blk] -= 1
+        if self.refcount[blk] == 0:
+            del self.refcount[blk]
+            h = self.block_to_hash.pop(blk, None)
+            if h is not None:
+                self.hash_to_block.pop(h, None)
+            self.free.append(blk)
+
+    @staticmethod
+    def _chain_hash(prev: bytes, tokens: np.ndarray) -> bytes:
+        m = hashlib.sha256()
+        m.update(prev)
+        m.update(np.ascontiguousarray(tokens, dtype=np.int32).tobytes())
+        return m.digest()
+
+    def allocate_for_prompt(self, tokens: Sequence[int]
+                            ) -> Tuple[List[int], int]:
+        """Allocate blocks covering ``tokens`` (+ room for the next token).
+
+        Returns (block_ids, num_cached_tokens): with prefix caching on, leading full
+        blocks already resident are shared and counted in num_cached_tokens (the caller
+        may skip prefilling them).
+        """
+        tokens = np.asarray(tokens, dtype=np.int32)
+        n = len(tokens)
+        bs = self.block_size
+        n_full = n // bs
+        blocks: List[int] = []
+        num_cached = 0
+        prev = b""
+        reusing = self.enable_prefix_caching
+        for i in range(n_full):
+            chunk = tokens[i * bs : (i + 1) * bs]
+            h = self._chain_hash(prev, chunk)
+            prev = h
+            if reusing and h in self.hash_to_block:
+                blk = self.hash_to_block[h]
+                self.refcount[blk] += 1
+                blocks.append(blk)
+                num_cached += bs
+                continue
+            reusing = False   # first miss ends the shared prefix
+            blk = self._alloc_one()
+            if self.enable_prefix_caching:
+                self.hash_to_block[h] = blk
+                self.block_to_hash[blk] = h
+            blocks.append(blk)
+        # trailing partial block (or room for the next token) is always private
+        remaining = n - n_full * bs
+        if remaining > 0 or n_full == len(blocks):
+            blocks.append(self._alloc_one())
+        return blocks, num_cached
+
+    def extend(self, blocks: List[int], seq_len: int) -> None:
+        """Ensure ``blocks`` covers positions [0, seq_len); appends new blocks."""
+        while len(blocks) * self.block_size < seq_len:
+            blocks.append(self._alloc_one())
+
+    def free_sequence(self, blocks: Sequence[int]) -> None:
+        for blk in blocks:
+            self._release_one(blk)

@@ -72,13 +72,15 @@ def read_bucket(cache_layer: jnp.ndarray, bucket: int) -> jnp.ndarray:
 
 
 def write_prefill(cache_layer: jnp.ndarray, new_kv: jnp.ndarray,
-                  start: int = 0) -> jnp.ndarray:
-    """Write (B, H, S_new, D) into the cache at [start, start+S_new) along seq.
+                  start: int = 0, batch_start: int = 0) -> jnp.ndarray:
+    """Write (B, H, S_new, D) into the cache at [start, start+S_new) along seq,
+    batch rows [batch_start, batch_start+B).
 
-    ≈ `fill_prefix` CTE write. ``start`` may be traced (chunked prefill resumes mid-way).
+    ≈ `fill_prefix` CTE write. ``start``/``batch_start`` may be traced (chunked prefill
+    resumes mid-way; continuous batching inserts a fresh sequence at its batch slot).
     """
     return jax.lax.dynamic_update_slice(
-        cache_layer, new_kv.astype(cache_layer.dtype), (0, 0, start, 0))
+        cache_layer, new_kv.astype(cache_layer.dtype), (batch_start, 0, start, 0))
 
 
 def write_decode(cache_layer: jnp.ndarray, new_kv: jnp.ndarray,

@@ -1,0 +1,414 @@
+"""Continuous batching: slot-based serving over a shared batch, dense or paged KV.
+
+≈ reference continuous batching (`models/model_wrapper.py:569-698` batch pad/sort by
+seq_id, `modules/kvcache/data_parallel_kv_cache_manager.py`, block-KV slot mapping
+`block_kv_cache_manager.py:376-431`). TPU redesign:
+
+- The compiled batch is a fixed set of ``max_batch_size`` slots; requests are inserted
+  into free slots and all slots decode together (SPMD). Inactive slots keep stepping
+  with frozen positions and their KV writes dropped (paged: slot -1; dense: harmless
+  rewrites at a frozen position) — shapes never change, so no recompilation.
+- Insertion runs a batch-1 context encoding that writes straight into the shared cache:
+  dense mode lands at the slot's batch row (`write_prefill(batch_start=slot)`); paged
+  mode scatters into freshly allocated blocks.
+- Prefix caching (paged only): a prompt whose leading full blocks are already resident
+  (chained content hash, see modules/block_kvcache.BlockAllocator) prefills only the
+  suffix with a *prefix-prefill*: a wide `decode_forward` call whose queries are the
+  suffix tokens and whose KV view gathers prior blocks + fresh writes — the TPU analog
+  of the reference's `prefix_caching_attention_fwd_isa_kernel` path
+  (`attention_base.py:909`).
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models import base as model_base
+from ..modules import autobucketing, block_kvcache
+from ..ops import sampling as sampling_ops
+from ..parallel.sharding import named_sharding
+from . import model_wrapper
+
+logger = logging.getLogger("tpu-inference")
+
+
+@dataclass
+class Request:
+    request_id: int
+    prompt: np.ndarray                   # (S,) int32
+    max_new_tokens: int
+    eos_token_id: Optional[int] = None
+    generated: List[int] = field(default_factory=list)
+    slot: int = -1
+    blocks: List[int] = field(default_factory=list)
+    # KV write position of the *next fed token* == len(prompt) + len(generated) - 1
+    # (the newest generated token is the next input; its KV is not yet written)
+    position: int = 0
+    done: bool = False
+    truncated: bool = False              # force-finished out of cache room
+    placed_seq: int = -1                 # placement order; newest = preemption victim
+
+
+class ContinuousBatchingRunner:
+    """Slot-based continuous batching engine over a `TpuModelForCausalLM`."""
+
+    def __init__(self, app, decode_chunk: Optional[int] = None):
+        cfg = app.tpu_config
+        if not cfg.is_continuous_batching:
+            raise ValueError("tpu_config.is_continuous_batching must be enabled")
+        self.app = app
+        self.cfg = cfg
+        self.paged = cfg.paged_attention_enabled
+        self.num_slots = cfg.max_batch_size
+        self.decode_chunk = decode_chunk or min(8, max(1, cfg.decode_chunk_size))
+        self.sampling_config = app.sampling_config
+
+        self.queue: List[Request] = []
+        self.active: List[Optional[Request]] = [None] * self.num_slots
+        self.finished: Dict[int, Request] = {}
+        self._next_id = 0
+        self._place_counter = 0
+        self._key = jax.random.PRNGKey(0)
+
+        self.positions = np.zeros((self.num_slots,), dtype=np.int32)
+        self.last_tok = np.zeros((self.num_slots,), dtype=np.int32)
+
+        if self.paged:
+            bs = cfg.pa_block_size
+            self.block_size = bs
+            self.max_blocks_per_seq = -(-cfg.seq_len // bs)
+            self.spec = block_kvcache.PagedKVCacheSpec(
+                num_layers=app.arch_args.num_layers, num_blocks=cfg.pa_num_blocks,
+                block_size=bs, num_kv_heads=app.arch_args.num_kv_heads,
+                head_dim=app.arch_args.head_dim, dtype=cfg.kv_cache_jax_dtype)
+            self.allocator = block_kvcache.BlockAllocator(
+                cfg.pa_num_blocks, bs, enable_prefix_caching=True)
+            sharding = named_sharding(app.mesh, block_kvcache.PAGED_CACHE_LOGICAL,
+                                      app.sharding_rules)
+            self.cache = jax.tree.map(
+                lambda x: jax.device_put(x, sharding),
+                block_kvcache.init_paged_cache(self.spec))
+            self.block_table = np.zeros((self.num_slots, self.max_blocks_per_seq),
+                                        dtype=np.int32)
+        else:
+            app.reset_cache()
+            self.cache = app.kv_cache
+            app.kv_cache = None   # the runner owns the cache now
+
+        self._build_steps()
+
+    # ------------------------------------------------------------------ jitted steps
+    def _build_steps(self) -> None:
+        app = self.app
+        args, mesh, rules = app.arch_args, app.mesh, app.sharding_rules
+        odsc = self.sampling_config
+        precision = "highest" if self.cfg.dtype == "float32" else "default"
+
+        if self.paged:
+            def _insert(params, input_ids, position_ids, last_token_idx, cache,
+                        block_table_row, slot_mapping, sampling_params, key):
+                """Batch-1 (prefix-)prefill into paged blocks: a wide decode call whose
+                queries are the (suffix) tokens; prior blocks are visible through the
+                block table."""
+                with jax.default_matmul_precision(precision):
+                    logits, cache = model_base.decode_forward(
+                        params, args, input_ids, position_ids, cache, None,
+                        mesh=mesh, rules=rules, block_table=block_table_row,
+                        slot_mapping=slot_mapping)
+                last = jnp.take_along_axis(
+                    logits, last_token_idx[:, None, None], axis=1)[:, 0]
+                tok = sampling_ops.sample(last, sampling_params, key, odsc)
+                return tok, cache
+
+            def _decode(params, tok0, positions, cache, block_table, slot_chunk,
+                        sampling_params, key, num_steps):
+                keys = jax.random.split(key, num_steps)
+                slots_t = slot_chunk.T[:, :, None]          # (T, B, 1)
+
+                def body(carry, xs):
+                    tok, pos, cache = carry
+                    step_key, slots_j = xs
+                    with jax.default_matmul_precision(precision):
+                        logits, cache = model_base.decode_forward(
+                            params, args, tok[:, None], pos, cache, None,
+                            mesh=mesh, rules=rules, block_table=block_table,
+                            slot_mapping=slots_j)
+                        nxt = sampling_ops.sample(logits[:, -1], sampling_params,
+                                                  step_key, odsc)
+                    return (nxt, pos + 1, cache), nxt
+
+                (_, _, cache), toks = jax.lax.scan(
+                    body, (tok0, positions, cache), (keys, slots_t))
+                return toks.T, cache
+
+            self._insert_step = jax.jit(_insert, donate_argnums=(4,))
+            self._decode_step = jax.jit(_decode, donate_argnums=(3,),
+                                        static_argnames=("num_steps",))
+        else:
+            def _insert(params, input_ids, position_ids, last_token_idx, cache,
+                        slot, sampling_params, key):
+                with jax.default_matmul_precision(precision):
+                    logits, cache = model_base.prefill_forward(
+                        params, args, input_ids, position_ids, last_token_idx, cache,
+                        mesh=mesh, rules=rules, cache_batch_start=slot)
+                tok = sampling_ops.sample(logits, sampling_params, key, odsc)
+                return tok, cache
+
+            def _decode(params, tok0, positions, cache, sampling_params, key,
+                        decode_bucket, num_steps):
+                keys = jax.random.split(key, num_steps)
+
+                def body(carry, step_key):
+                    tok, pos, cache = carry
+                    with jax.default_matmul_precision(precision):
+                        logits, cache = model_base.decode_forward(
+                            params, args, tok[:, None], pos, cache, decode_bucket,
+                            mesh=mesh, rules=rules)
+                        nxt = sampling_ops.sample(logits[:, -1], sampling_params,
+                                                  step_key, odsc)
+                    return (nxt, pos + 1, cache), nxt
+
+                (_, _, cache), toks = jax.lax.scan(body, (tok0, positions, cache), keys)
+                return toks.T, cache
+
+            self._insert_step = jax.jit(_insert, donate_argnums=(4,))
+            self._decode_step = jax.jit(
+                _decode, donate_argnums=(3,),
+                static_argnames=("decode_bucket", "num_steps"))
+
+    # ------------------------------------------------------------------ API
+    def submit(self, prompt: np.ndarray, max_new_tokens: int = 32,
+               eos_token_id: Optional[int] = None) -> int:
+        prompt = np.asarray(prompt).astype(np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("empty prompt")
+        if prompt.size + max_new_tokens > self.cfg.seq_len:
+            raise ValueError(f"prompt ({prompt.size}) + max_new_tokens "
+                             f"({max_new_tokens}) exceeds seq_len {self.cfg.seq_len}")
+        if not self.paged and prompt.size > self.app.cte_buckets[-1]:
+            raise ValueError(
+                f"prompt ({prompt.size}) exceeds the largest context bucket "
+                f"({self.app.cte_buckets[-1]}); dense mode has no windowed prefill — "
+                f"enable paged_attention for chunked prefill")
+        req = Request(self._next_id, prompt, max_new_tokens, eos_token_id)
+        self._next_id += 1
+        self.queue.append(req)
+        return req.request_id
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self.queue) or any(r is not None for r in self.active)
+
+    def step(self, key: Optional[jax.Array] = None) -> Dict[int, List[int]]:
+        """Place queued requests into free slots, then run one decode chunk.
+
+        Returns {request_id: newly generated tokens} for this step.
+        """
+        if key is None:
+            self._key, key = jax.random.split(self._key)
+        emitted: Dict[int, List[int]] = {}
+
+        # --- placement (≈ CTE dispatch for new seq_ids) -------------------------
+        for slot in range(self.num_slots):
+            if not self.queue or self.active[slot] is not None:
+                continue
+            req = self.queue[0]
+            fed_len = len(req.prompt) + max(0, len(req.generated) - 1)
+            if self.paged:
+                # require room for the prompt plus one decode chunk, else a fresh
+                # insert can be preempted before generating a single token (thrash)
+                need = -(-(fed_len + 1 + self.decode_chunk) // self.block_size)
+                if self.allocator.num_free < need:
+                    break
+            self.queue.pop(0)
+            key, sub = jax.random.split(key)
+            resumed = bool(req.generated)   # preempted earlier; KV recomputed now
+            tok0 = self._insert(req, slot, sub)
+            req.slot = slot
+            req.position = fed_len
+            self._place_counter += 1
+            req.placed_seq = self._place_counter
+            if not resumed:
+                req.generated = [tok0]
+                emitted.setdefault(req.request_id, []).append(tok0)
+            self.active[slot] = req
+            self.positions[slot] = req.position
+            self.last_tok[slot] = req.generated[-1]
+            self._maybe_finish(req, emitted)
+
+        active_rows = [r for r in self.active if r is not None]
+        if not active_rows:
+            return emitted
+
+        # --- one decode chunk for every slot ------------------------------------
+        chunk = self.decode_chunk
+        max_pos = int(self.positions.max())
+        steps = min(chunk, self.cfg.seq_len - 1 - max_pos)
+        if steps <= 0:
+            # longest row is out of seq_len room; force-finish (truncate) it
+            victim = max(active_rows, key=lambda r: r.position)
+            victim.truncated = True
+            self._finish(victim)
+            return emitted
+        valid = np.array([r is not None and not r.done for r in self.active])
+        key, sub = jax.random.split(key)
+        sp = self._sampling_matrix()
+        if self.paged:
+            active_rows = self._grow_blocks(active_rows, steps)
+            if not active_rows:
+                return emitted
+            valid = np.array([r is not None and not r.done for r in self.active])
+            slot_chunk = block_kvcache.make_slot_mapping(
+                self.block_table, self.positions, steps, self.block_size, valid=valid)
+            toks_dev, self.cache = self._decode_step(
+                self.app.params, jnp.asarray(self.last_tok),
+                jnp.asarray(self.positions), self.cache,
+                jnp.asarray(self.block_table), jnp.asarray(slot_chunk), sp, sub,
+                num_steps=steps)
+        else:
+            bucket = autobucketing.select_bucket(self.app.tkg_buckets,
+                                                 max_pos + steps)
+            toks_dev, self.cache = self._decode_step(
+                self.app.params, jnp.asarray(self.last_tok),
+                jnp.asarray(self.positions), self.cache, sp, sub,
+                decode_bucket=bucket, num_steps=steps)
+        toks = np.asarray(toks_dev)                     # (slots, steps)
+
+        for slot, req in enumerate(self.active):
+            if req is None or req.done:
+                continue
+            for j in range(steps):
+                t = int(toks[slot, j])
+                req.generated.append(t)
+                req.position += 1
+                emitted.setdefault(req.request_id, []).append(t)
+                if ((req.eos_token_id is not None and t == req.eos_token_id)
+                        or len(req.generated) >= req.max_new_tokens):
+                    break
+            self.positions[slot] = req.position
+            self.last_tok[slot] = req.generated[-1]
+            self._maybe_finish(req, emitted)
+        return emitted
+
+    def run_to_completion(self, seed: int = 0) -> Dict[int, List[int]]:
+        """Drive step() until every submitted request finishes; returns all outputs."""
+        self._key = jax.random.PRNGKey(seed)
+        guard = 0
+        while self.has_work:
+            self.step()
+            guard += 1
+            if guard > 10000:
+                raise RuntimeError("continuous batching did not converge")
+        return {rid: req.generated for rid, req in self.finished.items()}
+
+    # --- paged block growth with preemption (≈ vLLM-style recompute preemption) ------
+    def _grow_blocks(self, active_rows: List[Request], steps: int) -> List[Request]:
+        """Extend every active row's blocks to cover the chunk; on exhaustion, preempt
+        the newest-placed *other* request (requeue, KV recomputed at next placement —
+        prefix caching recovers most of it) and retry. A lone request that still cannot
+        grow is truncated."""
+        while True:
+            try:
+                for req in active_rows:
+                    self.allocator.extend(req.blocks, req.position + steps + 1)
+                    self.block_table[req.slot, : len(req.blocks)] = req.blocks
+                return active_rows
+            except RuntimeError:
+                if len(active_rows) > 1:
+                    victim = max(active_rows, key=lambda r: r.placed_seq)
+                    self._preempt(victim)
+                else:
+                    active_rows[0].truncated = True
+                    self._finish(active_rows[0])
+                active_rows = [r for r in self.active if r is not None]
+                if not active_rows:
+                    return []
+
+    def _preempt(self, req: Request) -> None:
+        logger.info("preempting request %d (out of KV blocks)", req.request_id)
+        self.active[req.slot] = None
+        if self.paged:
+            self.allocator.free_sequence(req.blocks)
+            self.block_table[req.slot, :] = 0
+            req.blocks = []
+        req.slot = -1
+        self.queue.insert(0, req)   # resumes first; _insert refeeds prompt + generated
+
+    # ------------------------------------------------------------------ internals
+    def _sampling_matrix(self) -> np.ndarray:
+        return sampling_ops.prepare_sampling_params(
+            self.num_slots,
+            top_k=self.sampling_config.top_k, top_p=self.sampling_config.top_p,
+            temperature=self.sampling_config.temperature)
+
+    def _insert(self, req: Request, slot: int, key) -> int:
+        # resumed (preempted) requests refeed prompt + generated[:-1]; the newest
+        # generated token stays the next decode input (its KV is never written here)
+        fed = req.prompt
+        if req.generated:
+            fed = np.concatenate(
+                [req.prompt, np.asarray(req.generated[:-1], dtype=np.int32)])
+        cached_len = 0
+        if self.paged:
+            req.blocks, cached_len = self.allocator.allocate_for_prompt(fed)
+            # never skip the whole prompt: the last token's logits seed generation
+            cached_len = min(cached_len, len(fed) - 1)
+            self.block_table[slot, : len(req.blocks)] = req.blocks
+
+        sp_row = self._sampling_matrix()[slot : slot + 1]
+
+        if self.paged:
+            # windowed (chunked) prefill: feed CTE-bucket-size windows sequentially;
+            # each window's queries see the prior windows' KV through the block table
+            # (≈ windowed context encoding, reference `model_base.py:918-973`, and the
+            # chunked-prefill flow of `ChunkedPrefillConfig`).
+            max_window = self.app.cte_buckets[-1]
+            start = cached_len
+            tok_dev = None
+            while start < len(fed):
+                window = fed[start : min(start + max_window, len(fed))]
+                padded = model_wrapper.pad_prefill_inputs(
+                    window[None, :], None, self.app.cte_buckets, batch_size=1)
+                pos_row = np.array([start], dtype=np.int32)
+                valid = np.ones((1, padded.bucket), dtype=bool)
+                valid[0, len(window):] = False
+                slot_map = block_kvcache.make_slot_mapping(
+                    self.block_table[slot : slot + 1], pos_row, padded.bucket,
+                    self.block_size, valid=valid)
+                key, sub = jax.random.split(key)
+                tok_dev, self.cache = self._insert_step(
+                    self.app.params, padded.input_ids, pos_row,
+                    padded.last_token_idx, self.cache,
+                    jnp.asarray(self.block_table[slot : slot + 1]),
+                    jnp.asarray(slot_map), sp_row, sub)
+                start += len(window)
+        else:
+            padded = model_wrapper.pad_prefill_inputs(
+                fed[None, :], None, self.app.cte_buckets, batch_size=1)
+            tok_dev, self.cache = self._insert_step(
+                self.app.params, padded.input_ids, padded.position_ids,
+                padded.last_token_idx, self.cache, jnp.asarray(slot, dtype=jnp.int32),
+                sp_row, key)
+        return int(np.asarray(tok_dev)[0])
+
+    def _maybe_finish(self, req: Request, emitted) -> None:
+        if (len(req.generated) >= req.max_new_tokens
+                or (req.eos_token_id is not None
+                    and req.generated[-1] == req.eos_token_id)):
+            self._finish(req)
+
+    def _finish(self, req: Request) -> None:
+        req.done = True
+        self.finished[req.request_id] = req
+        if req.slot >= 0:
+            self.active[req.slot] = None
+            if self.paged:
+                self.allocator.free_sequence(req.blocks)
+                self.block_table[req.slot, :] = 0
+            req.slot = -1
