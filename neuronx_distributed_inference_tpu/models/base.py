@@ -32,6 +32,7 @@ from ..ops import rope as rope_ops
 from ..ops.attention import attend, causal_mask
 from ..ops.moe import MoEArgs, moe_block
 from ..ops.norms import rms_norm
+from ..ops.quantization import qapply
 from ..parallel.sharding import constrain
 
 Params = Dict[str, Any]
@@ -222,9 +223,9 @@ _ACTIVATIONS = {
 def _project_qkv(lp: Params, args: ModelArchArgs, hn: jnp.ndarray):
     """(B, S, H) -> q (B, nq, S, D), k/v (B, nkv, S, D)."""
     b, s, _ = hn.shape
-    q = hn @ lp["wq"]
-    k = hn @ lp["wk"]
-    v = hn @ lp["wv"]
+    q = qapply(hn, lp["wq"])
+    k = qapply(hn, lp["wk"])
+    v = qapply(hn, lp["wv"])
     if args.attention_bias:
         q = q + lp["bq"]
         k = k + lp["bk"]
@@ -241,10 +242,10 @@ def _project_qkv(lp: Params, args: ModelArchArgs, hn: jnp.ndarray):
 
 def _mlp(lp: Params, args: ModelArchArgs, hn: jnp.ndarray, mesh, rules) -> jnp.ndarray:
     act = _ACTIVATIONS[args.activation]
-    gate = act(hn @ lp["wg"])
-    up = hn @ lp["wu"]
+    gate = act(qapply(hn, lp["wg"]))
+    up = qapply(hn, lp["wu"])
     inter = constrain(gate * up, ("batch", None, "mlp"), rules, mesh=mesh)
-    return inter @ lp["wd"]
+    return qapply(inter, lp["wd"])
 
 
 def _sharded_flash_attention(q, k, v, args: ModelArchArgs, mesh, rules):
@@ -324,13 +325,17 @@ def _decoder_layer(
         k_att = kvcache.read_bucket(k_cache, decode_bucket)
         v_att = kvcache.read_bucket(v_cache, decode_bucket)
 
+    if k_att.dtype != q.dtype:
+        # fp8 KV cache (direct-cast mode): dequantize at read for the attention matmuls
+        k_att = k_att.astype(q.dtype)
+        v_att = v_att.astype(q.dtype)
     if use_flash and positions is None:
         attn = _sharded_flash_attention(q, k_att, v_att, args, mesh, rules)
     else:
         attn = attend(q, k_att, v_att, mask=mask, scale=args.attention_scale,
                       logits_soft_cap=args.logits_soft_cap, sinks=sinks)
     attn = attn.transpose(0, 2, 1, 3).reshape(h.shape[0], h.shape[1], args.q_size)
-    attn_out = constrain(attn @ lp["wo"], ("batch", None, None), rules, mesh=mesh)
+    attn_out = constrain(qapply(attn, lp["wo"]), ("batch", None, None), rules, mesh=mesh)
     if args.sandwich_norms:
         attn_out = rms_norm(attn_out, lp["ln1_post"], args.rms_norm_eps,
                             zero_centered=zc)
@@ -395,8 +400,10 @@ def _embed(params: Params, args: ModelArchArgs, input_ids, mesh, rules):
 
 
 def _lm_head(params: Params, args: ModelArchArgs, h, mesh, rules) -> jnp.ndarray:
-    w = params["embed"].T if args.tie_word_embeddings else params["lm_head"]
-    logits = (h @ w).astype(jnp.float32)
+    if args.tie_word_embeddings:
+        logits = (h @ params["embed"].T).astype(jnp.float32)
+    else:
+        logits = qapply(h, params["lm_head"]).astype(jnp.float32)
     logical = ("batch", "vocab") if logits.ndim == 2 else ("batch", None, "vocab")
     return constrain(logits, logical, rules, mesh=mesh)
 

@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 
 from ..parallel.sharding import constrain
+from .quantization import qapply, qeinsum
 
 
 @dataclass(frozen=True)
@@ -72,18 +73,19 @@ def moe_block(lp, args, hn: jnp.ndarray, mesh, rules,
     gates = route(lp["router"], x, moe)                             # (N, E) fp32
 
     # dense all-experts MLP: (E, N, I) intermediates, EP-sharded on E, TP on I
-    gate_proj = jnp.einsum("nh,ehi->eni", x, lp["wg"])
-    up_proj = jnp.einsum("nh,ehi->eni", x, lp["wu"])
+    gate_proj = qeinsum("nh,ehi->eni", x, lp["wg"])
+    up_proj = qeinsum("nh,ehi->eni", x, lp["wu"])
     inter = activation(gate_proj) * up_proj
     inter = constrain(inter, ("experts", None, "expert_mlp"), rules, mesh=mesh)
-    per_expert = jnp.einsum("eni,eih->enh", inter, lp["wd"])        # (E, N, H)
+    per_expert = qeinsum("eni,eih->enh", inter, lp["wd"])           # (E, N, H)
     out = jnp.einsum("enh,ne->nh", per_expert,
                      gates.astype(per_expert.dtype))                # sum over E: EP psum
     out = constrain(out, ("batch", None), rules, mesh=mesh)
 
     if moe.shared_expert_intermediate_size:
-        shared_inter = activation(x @ lp["shared_wg"]) * (x @ lp["shared_wu"])
-        shared = shared_inter @ lp["shared_wd"]
+        shared_inter = (activation(qapply(x, lp["shared_wg"]))
+                        * qapply(x, lp["shared_wu"]))
+        shared = qapply(shared_inter, lp["shared_wd"])
         shared_gate = jax.nn.sigmoid(
             (x.astype(jnp.float32) @ lp["shared_gate"].astype(jnp.float32)))  # (N, 1)
         out = out + shared * shared_gate.astype(out.dtype)

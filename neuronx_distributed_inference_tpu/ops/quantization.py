@@ -1,0 +1,124 @@
+"""Weight-only quantization (int8 / fp8) and fp8 KV-cache support.
+
+≈ reference quantization plumbing: NxD `quantize` configs imported at
+`models/model_wrapper.py:11-21`, quantized checkpoint generation
+(`models/application_base.py:744-797`), quantized MLP kernels
+(`models/llama/modeling_llama.py:626`), fp8 KV quantization (direct cast or static
+scales, `modules/kvcache/kv_cache_manager.py` fp8 paths). TPU redesign:
+
+- A quantized weight is a tiny pytree ``{"q": int8|fp8 (..., in, out), "s": f32
+  (..., 1, out)}`` with **per-output-channel symmetric scales** over the contraction
+  dim. Matmuls run as ``(x @ q.astype(x.dtype)) * s``: XLA fuses the dequant cast into
+  the matmul's operand read, so the weight lives in HBM at 1 byte/element — decode is
+  HBM-bandwidth-bound, so weight bytes are the decode speedup, exactly why the
+  reference quantizes.
+- KV fp8 is "direct cast" mode: the cache tensor dtype is float8_e4m3; writes cast in,
+  reads cast back to the compute dtype before attention.
+
+`quantize_params` walks a model param tree and converts the named projection weights;
+everything else (norms, router, embeddings, biases, rope tables) stays high precision,
+matching the reference's modules_to_not_convert behavior.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+# params converted by default: every large projection matmul
+DEFAULT_QUANTIZED_PARAMS = (
+    "wq", "wk", "wv", "wo", "wg", "wu", "wd",
+    "shared_wg", "shared_wu", "shared_wd", "lm_head",
+)
+
+_QMAX = {"int8": 127.0, "float8_e4m3": 448.0}
+
+
+def is_quantized(w) -> bool:
+    return isinstance(w, dict) and "q" in w and "s" in w
+
+
+def quantize_tensor(w, weight_dtype: str = "int8") -> Dict[str, Any]:
+    """Symmetric per-output-channel quantization, computed **on host in numpy** so a
+    model larger than one device's HBM never materializes unsharded on a device
+    (sharded device_put happens after conversion).
+
+    ``w`` is (..., in, out); the scale reduces over the contraction dim (axis -2) so
+    each output channel (and each stacked layer / expert) gets its own scale.
+    """
+    import ml_dtypes
+    import numpy as np
+
+    if weight_dtype not in _QMAX:
+        raise ValueError(f"weight_dtype must be one of {sorted(_QMAX)}")
+    w32 = np.asarray(jax.device_get(w) if isinstance(w, jax.Array) else w,
+                     dtype=np.float32)
+    absmax = np.max(np.abs(w32), axis=-2, keepdims=True)
+    scale = np.maximum(absmax / _QMAX[weight_dtype], 1e-12)
+    if weight_dtype == "int8":
+        q = np.clip(np.round(w32 / scale), -127, 127).astype(np.int8)
+    else:
+        q = (w32 / scale).astype(ml_dtypes.float8_e4m3fn)
+    return {"q": q, "s": scale.astype(np.float32)}
+
+
+def dequantize_tensor(qw: Dict[str, jnp.ndarray], dtype=jnp.float32) -> jnp.ndarray:
+    return (qw["q"].astype(jnp.float32) * qw["s"]).astype(dtype)
+
+
+def qapply(x: jnp.ndarray, w) -> jnp.ndarray:
+    """``x @ w`` for a dense or quantized weight (the model's single matmul hook)."""
+    if not is_quantized(w):
+        return x @ w
+    y = x @ w["q"].astype(x.dtype)
+    return y * w["s"].reshape(-1).astype(y.dtype)
+
+
+def qeinsum(spec: str, x: jnp.ndarray, w) -> jnp.ndarray:
+    """``einsum(spec, x, w)`` for a dense or quantized weight.
+
+    Supports the MoE patterns whose output ends with the weight's last (out) axis —
+    the per-channel scale then broadcasts onto the result's trailing dim.
+    """
+    if not is_quantized(w):
+        return jnp.einsum(spec, x, w)
+    y = jnp.einsum(spec, x, w["q"].astype(x.dtype))
+    out_scale = w["s"]                     # (..., 1, out); experts lead
+    # result layout for "nh,ehi->eni" / "eni,eih->enh": (E, N, out) — scale is
+    # (E, 1, out) which broadcasts directly
+    return y * out_scale.astype(y.dtype)
+
+
+def quantize_params(params: Dict[str, Any], weight_dtype: str = "int8",
+                    names: Sequence[str] = DEFAULT_QUANTIZED_PARAMS) -> Dict[str, Any]:
+    """Convert the named weights of a model param tree (top level + ``layers``)."""
+    out = dict(params)
+    if "lm_head" in out and "lm_head" in names:
+        out["lm_head"] = quantize_tensor(out["lm_head"], weight_dtype)
+    layers = dict(out["layers"])
+    for name in names:
+        if name in layers:
+            layers[name] = quantize_tensor(layers[name], weight_dtype)
+    out["layers"] = layers
+    return out
+
+
+def quantized_logical_axes(logical: Dict[str, Any], names: Sequence[str]
+                           ) -> Dict[str, Any]:
+    """Transform a logical-axes tree to match a quantized param tree: each quantized
+    leaf's axes apply to ``q``; the scale keeps the output axis, contraction replaced
+    by None."""
+    def _q_axes(axes):
+        return {"q": tuple(axes), "s": tuple(list(axes[:-2]) + [None, axes[-1]])}
+
+    out = dict(logical)
+    if "lm_head" in out and "lm_head" in names:
+        out["lm_head"] = _q_axes(out["lm_head"])
+    layers = dict(out["layers"])
+    for name in names:
+        if name in layers:
+            layers[name] = _q_axes(layers[name])
+    out["layers"] = layers
+    return out

@@ -198,8 +198,17 @@ class TpuModelForCausalLM:
         return jax.default_backend() not in ("cpu",)
 
     # --- weights ----------------------------------------------------------------------
+    def _quantization(self):
+        q = self.tpu_config.quantization_config
+        return q if (q is not None and q.quantize_weights) else None
+
     def _param_shardings(self):
+        from ..ops.quantization import (DEFAULT_QUANTIZED_PARAMS,
+                                        quantized_logical_axes)
+
         logical = model_base.param_logical_axes(self.arch_args)
+        if self._quantization() is not None:
+            logical = quantized_logical_axes(logical, DEFAULT_QUANTIZED_PARAMS)
         return tree_shardings(self.mesh, logical, self.sharding_rules)
 
     def load(self, model_path: Optional[str] = None) -> None:
@@ -222,22 +231,28 @@ class TpuModelForCausalLM:
         self._put_params(host_params)
 
     def _put_params(self, host_params) -> None:
+        qcfg = self._quantization()
+        if qcfg is not None:
+            from ..ops.quantization import quantize_params
+
+            host_params = quantize_params(host_params, qcfg.weight_dtype)
         shardings = self._param_shardings()
         dtype = self.tpu_config.jax_dtype
 
-        def _put(x, s):
+        def _put(path, x, s):
             arr = np.asarray(x)
-            if arr.dtype.kind == "f" or arr.dtype.name == "bfloat16":
+            last = getattr(path[-1], "key", None) if path else None
+            first = getattr(path[0], "key", "") if path else ""
+            if first.startswith("rope_inv_freq") or last == "s":
+                # rope tables and quantization scales stay fp32
+                arr = arr.astype(np.float32)
+            elif last == "q":
+                pass                      # int8/fp8 payloads keep their dtype
+            elif arr.dtype.kind == "f" or arr.dtype.name == "bfloat16":
                 arr = arr.astype(dtype) if arr.dtype != dtype else arr
             return jax.device_put(arr, s)
 
-        self.params = jax.tree.map(_put, host_params, shardings)
-        # rope inv_freq tables stay fp32 regardless of model dtype
-        for key in host_params:
-            if key.startswith("rope_inv_freq"):
-                self.params[key] = jax.device_put(
-                    np.asarray(host_params[key], dtype=np.float32),
-                    named_sharding(self.mesh, (None,)))
+        self.params = jax.tree_util.tree_map_with_path(_put, host_params, shardings)
 
     # --- cache ------------------------------------------------------------------------
     def cache_spec(self) -> kvcache.KVCacheSpec:
