@@ -28,6 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..modules import block_kvcache, kvcache
+from ..modules.lora import LoraSpec, apply_lora
 from ..ops import rope as rope_ops
 from ..ops.attention import attend, causal_mask
 from ..ops.moe import MoEArgs, moe_block
@@ -73,6 +74,8 @@ class ModelArchArgs:
     rope_attention_scaling: float = 1.0   # HF rope_scaling attention_factor
     # MoE FFN (Mixtral/Qwen3-MoE/DBRX); None = dense MLP. See ops/moe.py.
     moe: Optional["MoEArgs"] = None
+    # static multi-LoRA serving (see modules/lora.py); None = disabled
+    lora: Optional["LoraSpec"] = None
 
     @property
     def q_size(self) -> int:
@@ -123,6 +126,10 @@ def param_logical_axes(args: ModelArchArgs) -> Params:
         layer.update({"q_norm": ("layers", None), "k_norm": ("layers", None)})
     if args.sandwich_norms:
         layer.update({"ln1_post": ("layers", None), "ln2_post": ("layers", None)})
+    if args.lora is not None:
+        from ..modules.lora import lora_logical_axes
+
+        layer.update(lora_logical_axes(args, args.lora))
     out = {
         "embed": ("vocab", "embed"),
         "layers": layer,
@@ -182,6 +189,11 @@ def init_params(args: ModelArchArgs, key: jax.Array, dtype=jnp.bfloat16,
             "bk": jnp.zeros((L, args.kv_size), dtype=dtype),
             "bv": jnp.zeros((L, args.kv_size), dtype=dtype),
         })
+    if args.lora is not None:
+        from ..modules.lora import init_lora_params
+
+        layers.update({k: jnp.asarray(v, dtype=dtype)
+                       for k, v in init_lora_params(args, args.lora).items()})
     norm_fill = 0.0 if args.zero_centered_norms else 1.0
     if args.qk_norm:
         layers.update({
@@ -220,12 +232,18 @@ _ACTIVATIONS = {
 }
 
 
-def _project_qkv(lp: Params, args: ModelArchArgs, hn: jnp.ndarray):
+def _project_qkv(lp: Params, args: ModelArchArgs, hn: jnp.ndarray,
+                 adapter_ids=None):
     """(B, S, H) -> q (B, nq, S, D), k/v (B, nkv, S, D)."""
     b, s, _ = hn.shape
     q = qapply(hn, lp["wq"])
     k = qapply(hn, lp["wk"])
     v = qapply(hn, lp["wv"])
+    if args.lora is not None:
+        sc = args.lora.scaling
+        q = apply_lora(lp, "wq", hn, q, adapter_ids, sc)
+        k = apply_lora(lp, "wk", hn, k, adapter_ids, sc)
+        v = apply_lora(lp, "wv", hn, v, adapter_ids, sc)
     if args.attention_bias:
         q = q + lp["bq"]
         k = k + lp["bk"]
@@ -240,12 +258,21 @@ def _project_qkv(lp: Params, args: ModelArchArgs, hn: jnp.ndarray):
     return q, k, v
 
 
-def _mlp(lp: Params, args: ModelArchArgs, hn: jnp.ndarray, mesh, rules) -> jnp.ndarray:
+def _mlp(lp: Params, args: ModelArchArgs, hn: jnp.ndarray, mesh, rules,
+         adapter_ids=None) -> jnp.ndarray:
     act = _ACTIVATIONS[args.activation]
-    gate = act(qapply(hn, lp["wg"]))
+    gate = qapply(hn, lp["wg"])
     up = qapply(hn, lp["wu"])
+    if args.lora is not None:
+        sc = args.lora.scaling
+        gate = apply_lora(lp, "wg", hn, gate, adapter_ids, sc)
+        up = apply_lora(lp, "wu", hn, up, adapter_ids, sc)
+    gate = act(gate)
     inter = constrain(gate * up, ("batch", None, "mlp"), rules, mesh=mesh)
-    return qapply(inter, lp["wd"])
+    down = qapply(inter, lp["wd"])
+    if args.lora is not None:
+        down = apply_lora(lp, "wd", inter, down, adapter_ids, args.lora.scaling)
+    return down
 
 
 def _sharded_flash_attention(q, k, v, args: ModelArchArgs, mesh, rules):
@@ -294,11 +321,12 @@ def _decoder_layer(
     use_flash: bool = False,
     paged: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,  # (block_table, slot_mapping)
     cache_batch_start=0,
+    adapter_ids: Optional[jnp.ndarray] = None,   # (B,) multi-LoRA slots
 ):
     zc = args.zero_centered_norms
     resid = h
     hn = rms_norm(h, lp["ln1"], args.rms_norm_eps, zero_centered=zc)
-    q, k, v = _project_qkv(lp, args, hn)
+    q, k, v = _project_qkv(lp, args, hn, adapter_ids)
     q = constrain(q, ("batch", "heads", None, None), rules, mesh=mesh)
     k = constrain(k, ("batch", "kv_heads", None, None), rules, mesh=mesh)
     v = constrain(v, ("batch", "kv_heads", None, None), rules, mesh=mesh)
@@ -335,7 +363,10 @@ def _decoder_layer(
         attn = attend(q, k_att, v_att, mask=mask, scale=args.attention_scale,
                       logits_soft_cap=args.logits_soft_cap, sinks=sinks)
     attn = attn.transpose(0, 2, 1, 3).reshape(h.shape[0], h.shape[1], args.q_size)
-    attn_out = constrain(qapply(attn, lp["wo"]), ("batch", None, None), rules, mesh=mesh)
+    attn_out = qapply(attn, lp["wo"])
+    if args.lora is not None:
+        attn_out = apply_lora(lp, "wo", attn, attn_out, adapter_ids, args.lora.scaling)
+    attn_out = constrain(attn_out, ("batch", None, None), rules, mesh=mesh)
     if args.sandwich_norms:
         attn_out = rms_norm(attn_out, lp["ln1_post"], args.rms_norm_eps,
                             zero_centered=zc)
@@ -346,7 +377,7 @@ def _decoder_layer(
     if args.moe is not None:
         ffn = moe_block(lp, args, hn, mesh, rules, _ACTIVATIONS[args.activation])
     else:
-        ffn = _mlp(lp, args, hn, mesh, rules)
+        ffn = _mlp(lp, args, hn, mesh, rules, adapter_ids)
     mlp_out = constrain(ffn, ("batch", None, None), rules, mesh=mesh)
     if args.sandwich_norms:
         mlp_out = rms_norm(mlp_out, lp["ln2_post"], args.rms_norm_eps,
@@ -357,7 +388,8 @@ def _decoder_layer(
 
 def _run_stack(params: Params, args: ModelArchArgs, h, cos, sin, mask, cache,
                positions, decode_bucket, mesh, rules, use_flash=False,
-               local_rope_mask=None, paged=None, cache_batch_start=0):
+               local_rope_mask=None, paged=None, cache_batch_start=0,
+               adapter_ids=None):
     """Scan the decoder layers, carrying hidden state, yielding updated cache.
 
     ``local_rope_mask`` (set when args.layer_pattern is not None) is a triple
@@ -385,7 +417,8 @@ def _run_stack(params: Params, args: ModelArchArgs, h, cos, sin, mask, cache,
         new_h, kc, vc = _decoder_layer(lp, args, carry_h, cos_i, sin_i, mask_i, kc, vc,
                                        positions, decode_bucket, mesh, rules,
                                        use_flash=use_flash, paged=paged,
-                                       cache_batch_start=cache_batch_start)
+                                       cache_batch_start=cache_batch_start,
+                                       adapter_ids=adapter_ids)
         return new_h, (kc, vc)
 
     h, (k_new, v_new) = jax.lax.scan(body, h, xs)
@@ -420,6 +453,7 @@ def prefill_forward(
     use_flash: bool = False,
     slot_mapping: Optional[jnp.ndarray] = None,  # (B, S) paged write slots (-1 = drop)
     cache_batch_start=0,          # dense continuous batching: batch row to insert at
+    adapter_ids: Optional[jnp.ndarray] = None,   # (B,) multi-LoRA slots
 ) -> Tuple[jnp.ndarray, kvcache.KVCache]:
     """Context encoding: returns (last-token logits (B, V) fp32, updated cache).
 
@@ -450,7 +484,8 @@ def prefill_forward(
     h, cache = _run_stack(params, args, h, cos, sin, mask, cache,
                           positions=None, decode_bucket=None, mesh=mesh, rules=rules,
                           use_flash=use_flash, local_rope_mask=local_rope_mask,
-                          paged=paged, cache_batch_start=cache_batch_start)
+                          paged=paged, cache_batch_start=cache_batch_start,
+                          adapter_ids=adapter_ids)
     h = rms_norm(h, params["final_norm"], args.rms_norm_eps,
                  zero_centered=args.zero_centered_norms)
     h_last = jnp.take_along_axis(h, last_token_idx[:, None, None], axis=1)[:, 0]
@@ -469,6 +504,7 @@ def decode_forward(
     rules=None,
     block_table: Optional[jnp.ndarray] = None,   # (B, MB) paged: per-seq block ids
     slot_mapping: Optional[jnp.ndarray] = None,  # (B, T) paged: flat write slots
+    adapter_ids: Optional[jnp.ndarray] = None,   # (B,) multi-LoRA slots
 ) -> Tuple[jnp.ndarray, kvcache.KVCache]:
     """Token generation: returns (logits (B, T, V) fp32, updated cache).
 
@@ -501,7 +537,7 @@ def decode_forward(
     h, cache = _run_stack(params, args, h, cos, sin, mask, cache,
                           positions=position_ids, decode_bucket=decode_bucket,
                           mesh=mesh, rules=rules, local_rope_mask=local_rope_mask,
-                          paged=paged)
+                          paged=paged, adapter_ids=adapter_ids)
     h = rms_norm(h, params["final_norm"], args.rms_norm_eps,
                  zero_centered=args.zero_centered_norms)
     logits = _lm_head(params, args, h, mesh, rules)

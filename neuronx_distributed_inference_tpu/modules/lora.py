@@ -1,0 +1,198 @@
+"""Multi-LoRA serving: adapter-id-indexed batched low-rank deltas on the projections.
+
+≈ reference `modules/lora_serving/` (`wrap_model_with_lora` `lora_model.py:28`,
+`MultiLoraColumnParallelLinear`/... `lora_layer.py:10-353`: adapter weights stacked on a
+leading n_adapters dim, einsum against per-request adapter indices; checkpoint
+shard/load `lora_checkpoint.py:232-336`). TPU redesign:
+
+- Adapter weights live **inside the model param tree** as extra per-layer keys
+  (``wq_lora_a`` (L, N, in, r), ``wq_lora_b`` (L, N, r, out), ...), so the layer `scan`
+  carries them automatically and sharding rules apply per logical axis like any other
+  parameter (B matrices shard on the projection's output axis, matching the reference's
+  column/row-sharded multi-LoRA variants).
+- Per request, ``adapter_ids`` (B,) selects each row's adapter; the delta is two batched
+  einsums ``(x @ A[ids]) @ B[ids] * scaling`` fused by XLA into the surrounding matmuls.
+  Adapter slot 0 is the zero adapter ("no LoRA") by convention, so mixed batches of
+  base-model and adapter traffic need no masking.
+- "Static multi-LoRA": all adapters are resident in HBM and traced into the graph
+  (≈ the reference's static mode; dynamic host-side adapter swapping is a later round).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# projection name -> logical axis of its output dim (for B-matrix sharding)
+TARGET_OUT_AXIS = {
+    "wq": "heads", "wk": "kv_heads", "wv": "kv_heads", "wo": None,
+    "wg": "mlp", "wu": "mlp", "wd": None,
+}
+DEFAULT_TARGETS = ("wq", "wk", "wv", "wo", "wg", "wu", "wd")
+
+
+@dataclass(frozen=True)
+class LoraSpec:
+    """Static multi-LoRA description (hashable; nested in ModelArchArgs)."""
+
+    max_loras: int = 1                   # adapter slots EXCLUDING the zero adapter
+    rank: int = 16
+    alpha: float = 32.0
+    targets: Tuple[str, ...] = DEFAULT_TARGETS
+
+    @property
+    def num_slots(self) -> int:
+        return self.max_loras + 1        # slot 0 = zero adapter
+
+    @property
+    def scaling(self) -> float:
+        return self.alpha / self.rank
+
+
+def _target_dims(args, name: str) -> Tuple[int, int]:
+    H, I = args.hidden_size, args.intermediate_size
+    return {
+        "wq": (H, args.q_size), "wk": (H, args.kv_size), "wv": (H, args.kv_size),
+        "wo": (args.q_size, H), "wg": (H, I), "wu": (H, I), "wd": (I, H),
+    }[name]
+
+
+def lora_logical_axes(args, spec: LoraSpec) -> Dict[str, tuple]:
+    """Logical sharding axes for the per-layer LoRA keys (merged into the model's
+    ``layers`` axis tree)."""
+    out = {}
+    for name in spec.targets:
+        out[f"{name}_lora_a"] = ("layers", None, "embed", None)
+        out[f"{name}_lora_b"] = ("layers", None, None, TARGET_OUT_AXIS[name])
+    return out
+
+
+def init_lora_params(args, spec: LoraSpec, dtype=jnp.bfloat16) -> Dict[str, np.ndarray]:
+    """Zero-initialized adapter slots (host-side); real adapters land via
+    `convert_peft_state_dicts` or `set_adapter_`. Layout: A (L, N, in, r),
+    B (L, N, r, out)."""
+    L, N, r = args.num_layers, spec.num_slots, spec.rank
+    out = {}
+    for name in spec.targets:
+        d_in, d_out = _target_dims(args, name)
+        out[f"{name}_lora_a"] = np.zeros((L, N, d_in, r), dtype=np.float32)
+        out[f"{name}_lora_b"] = np.zeros((L, N, r, d_out), dtype=np.float32)
+    return out
+
+
+def lora_delta(x: jnp.ndarray, la: jnp.ndarray, lb: jnp.ndarray,
+               adapter_ids: jnp.ndarray, scaling: float) -> jnp.ndarray:
+    """Batched low-rank delta: x (B, S, in), la (N, in, r), lb (N, r, out),
+    adapter_ids (B,) -> (B, S, out)."""
+    a_sel = jnp.take(la, adapter_ids, axis=0).astype(x.dtype)   # (B, in, r)
+    b_sel = jnp.take(lb, adapter_ids, axis=0).astype(x.dtype)   # (B, r, out)
+    low = jnp.einsum("bsh,bhr->bsr", x, a_sel)
+    return jnp.einsum("bsr,bro->bso", low, b_sel) * jnp.asarray(scaling, x.dtype)
+
+
+def apply_lora(lp: Dict, name: str, x: jnp.ndarray, y: jnp.ndarray,
+               adapter_ids: Optional[jnp.ndarray], scaling: float) -> jnp.ndarray:
+    """Add the selected adapters' delta for projection ``name`` to base output ``y``
+    (no-op when the layer has no adapter keys or no ids are provided)."""
+    la = lp.get(f"{name}_lora_a")
+    if la is None or adapter_ids is None:
+        return y
+    return y + lora_delta(x, la, lp[f"{name}_lora_b"], adapter_ids, scaling)
+
+
+# ---------------------------------------------------------------------------
+# PEFT checkpoint conversion
+# ---------------------------------------------------------------------------
+
+_PEFT_NAME = {
+    "wq": "self_attn.q_proj", "wk": "self_attn.k_proj", "wv": "self_attn.v_proj",
+    "wo": "self_attn.o_proj", "wg": "mlp.gate_proj", "wu": "mlp.up_proj",
+    "wd": "mlp.down_proj",
+}
+
+
+def convert_peft_state_dicts(
+    adapter_state_dicts: Sequence[Dict[str, np.ndarray]],
+    args, spec: LoraSpec,
+    alphas: Optional[Sequence[Optional[float]]] = None,
+) -> Dict[str, np.ndarray]:
+    """Stack HF-PEFT adapter checkpoints into the multi-LoRA layout.
+
+    Adapter ``i`` (0-based) lands in slot ``i + 1`` (slot 0 stays the zero adapter).
+    PEFT stores ``...layers.{l}.{proj}.lora_A.weight`` as (r, in) and ``lora_B`` as
+    (out, r) (torch Linear layout); both are transposed into the x-@-w layout.
+
+    Each adapter's true ``lora_alpha / rank`` scaling (``alphas[i]``, from its
+    adapter_config.json; default = its own rank, i.e. scaling 1.0) is **folded into B**
+    so adapters with different alphas/ranks serve correctly side by side; the folded
+    value is divided by the runtime ``spec.scaling`` applied in `apply_lora`. Adapters
+    with rank < spec.rank are zero-padded (padded dims contribute nothing).
+    ≈ reference `lora_checkpoint.py:232-336`.
+    """
+    if len(adapter_state_dicts) > spec.max_loras:
+        raise ValueError(f"{len(adapter_state_dicts)} adapters exceed "
+                         f"max_loras={spec.max_loras}")
+    params = init_lora_params(args, spec)
+    for i, sd in enumerate(adapter_state_dicts):
+        slot = i + 1
+        stripped = {}
+        for k, v in sd.items():
+            k = k.replace("base_model.model.", "").replace("model.layers.", "layers.")
+            stripped[k] = np.asarray(v)
+        for name in spec.targets:
+            proj = _PEFT_NAME[name]
+            for layer in range(args.num_layers):
+                ka = f"layers.{layer}.{proj}.lora_A.weight"
+                kb = f"layers.{layer}.{proj}.lora_B.weight"
+                if ka not in stripped:
+                    continue   # adapter doesn't target this projection/layer
+                a = stripped[ka].T          # (in, r_i)
+                b = stripped[kb].T          # (r_i, out)
+                r_i = a.shape[1]
+                if r_i > spec.rank:
+                    raise ValueError(
+                        f"adapter {i} rank {r_i} exceeds configured max rank "
+                        f"{spec.rank}")
+                alpha_i = None if alphas is None else alphas[i]
+                true_scaling = (alpha_i / r_i) if alpha_i is not None else 1.0
+                b = b * (true_scaling / spec.scaling)
+                params[f"{name}_lora_a"][layer, slot, :, :r_i] = a
+                params[f"{name}_lora_b"][layer, slot, :r_i, :] = b
+    return params
+
+
+def load_peft_adapter(path: str):
+    """Read a PEFT adapter directory: returns (state_dict, lora_alpha, rank) from
+    adapter_model.safetensors (or .bin) + adapter_config.json."""
+    import json
+    import os
+
+    sd_path = os.path.join(path, "adapter_model.safetensors")
+    if os.path.exists(sd_path):
+        from safetensors.numpy import load_file
+
+        sd = load_file(sd_path)
+    else:
+        import torch
+
+        sd = {k: v.numpy() for k, v in
+              torch.load(os.path.join(path, "adapter_model.bin"),
+                         map_location="cpu").items()}
+    alpha, rank = None, None
+    cfg_path = os.path.join(path, "adapter_config.json")
+    if os.path.exists(cfg_path):
+        with open(cfg_path) as f:
+            cfg = json.load(f)
+        alpha, rank = cfg.get("lora_alpha"), cfg.get("r")
+    return sd, alpha, rank
+
+
+def merge_adapter(base_w: np.ndarray, la: np.ndarray, lb: np.ndarray,
+                  scaling: float) -> np.ndarray:
+    """Offline merge W' = W + scaling * A @ B (reference semantics; used by tests to
+    validate the runtime path)."""
+    return np.asarray(base_w) + scaling * (np.asarray(la) @ np.asarray(lb))

@@ -72,6 +72,27 @@ class TpuModelForCausalLM:
         self.config = config
         self.tpu_config: TpuConfig = config.tpu_config
         self.arch_args = self.arch_args_from_config(config)
+        lora_cfg = self.tpu_config.lora_serving_config
+        if lora_cfg is not None:
+            import dataclasses as _dc
+
+            from ..modules.lora import LoraSpec
+
+            targets = ("wq", "wk", "wv", "wo", "wg", "wu", "wd")
+            if self.arch_args.moe is not None:
+                # MoE FFNs route through moe_block, which has no LoRA hook yet;
+                # restrict adapters to the attention projections so nothing is
+                # silently inactive
+                targets = ("wq", "wk", "wv", "wo")
+                logger.info("MoE model: LoRA restricted to attention projections")
+            # alpha == rank -> runtime scaling 1.0; each adapter's true alpha/rank is
+            # folded into its B matrices at conversion (modules/lora.py)
+            self.arch_args = _dc.replace(
+                self.arch_args,
+                lora=LoraSpec(max_loras=lora_cfg.max_loras,
+                              rank=lora_cfg.max_lora_rank,
+                              alpha=float(lora_cfg.max_lora_rank),
+                              targets=targets))
         self.mesh = mesh if mesh is not None else mesh_lib.mesh_from_config(
             self.tpu_config)
         self.sampling_config = (self.tpu_config.on_device_sampling_config
@@ -133,16 +154,17 @@ class TpuModelForCausalLM:
         use_flash = self._use_flash_attention()
 
         def _prefill(params, input_ids, position_ids, last_token_idx, cache,
-                     sampling_params, key):
+                     sampling_params, key, adapter_ids=None):
             with jax.default_matmul_precision(precision):
                 logits, cache = prefill_core(params, args, input_ids, position_ids,
                                              last_token_idx, cache, mesh=mesh,
-                                             rules=rules, use_flash=use_flash)
+                                             rules=rules, use_flash=use_flash,
+                                             adapter_ids=adapter_ids)
                 tokens = sampling_ops.sample(logits, sampling_params, key, odsc)
             return tokens, logits, cache
 
         def _decode(params, tokens0, position_ids, cache, sampling_params, key,
-                    decode_bucket, num_steps, with_logits):
+                    decode_bucket, num_steps, with_logits, adapter_ids=None):
             """Generate ``num_steps`` tokens in ONE device call via lax.scan.
 
             Host-driven per-token loops pay a host<->device round trip per token; the
@@ -155,7 +177,8 @@ class TpuModelForCausalLM:
                 tok, pos, cache = carry
                 with jax.default_matmul_precision(precision):
                     logits, cache = decode_core(params, args, tok[:, None], pos, cache,
-                                                decode_bucket, mesh=mesh, rules=rules)
+                                                decode_bucket, mesh=mesh, rules=rules,
+                                                adapter_ids=adapter_ids)
                     last = logits[:, -1, :]
                     nxt = sampling_ops.sample(last, sampling_params, step_key, odsc)
                 out = (nxt, last) if with_logits else (nxt, ())
@@ -221,6 +244,18 @@ class TpuModelForCausalLM:
         host_params = self.convert_hf_state_dict(state_dict, self.config)
         self._put_params(host_params)
         logger.info("loaded weights in %.1fs", time.time() - t0)
+        lora_cfg = self.tpu_config.lora_serving_config
+        if lora_cfg is not None and lora_cfg.lora_ckpt_paths:
+            from ..modules.lora import load_peft_adapter
+
+            sds, alphas = [], []
+            for name, adir in lora_cfg.lora_ckpt_paths.items():
+                sd, alpha, _rank = load_peft_adapter(adir)
+                sds.append(sd)
+                alphas.append(alpha)
+                logger.info("loaded LoRA adapter %r from %s (alpha=%s)",
+                            name, adir, alpha)
+            self.set_lora_adapters(sds, alphas=alphas)
 
     def load_random(self, seed: int = 0) -> None:
         """Random weights at the configured shapes (tests / synthetic benchmarks)."""
@@ -230,7 +265,39 @@ class TpuModelForCausalLM:
             inv_freq=self.inv_freq_from_config(self.config))
         self._put_params(host_params)
 
+    def set_lora_adapters(self, adapter_state_dicts, alphas=None) -> None:
+        """Install PEFT adapter checkpoints into the resident multi-LoRA slots
+        (adapter i -> slot i+1; slot 0 stays the zero adapter). ``alphas[i]`` is the
+        adapter's lora_alpha from its adapter_config.json (None = scaling 1.0).
+        ≈ reference LoRA checkpoint shard/load (`lora_checkpoint.py:232-336`)."""
+        from ..modules.lora import convert_peft_state_dicts, lora_logical_axes
+
+        if self.arch_args.lora is None:
+            raise RuntimeError("construct with lora_serving_config to serve LoRA")
+        if self.params is None:
+            raise RuntimeError("load base weights before adapters")
+        host = convert_peft_state_dicts(adapter_state_dicts, self.arch_args,
+                                        self.arch_args.lora, alphas=alphas)
+        axes = lora_logical_axes(self.arch_args, self.arch_args.lora)
+        dtype = self.tpu_config.jax_dtype
+        for name, arr in host.items():
+            sharding = named_sharding(self.mesh, axes[name], self.sharding_rules)
+            self.params["layers"][name] = jax.device_put(
+                np.asarray(arr).astype(dtype), sharding)
+
     def _put_params(self, host_params) -> None:
+        if self.arch_args.lora is not None:
+            # HF checkpoints carry no adapter weights; materialize the zero slots so
+            # the param tree always matches the sharding tree (adapters land later
+            # via set_lora_adapters)
+            from ..modules.lora import init_lora_params
+
+            missing = {k: v for k, v in init_lora_params(
+                self.arch_args, self.arch_args.lora).items()
+                if k not in host_params["layers"]}
+            if missing:
+                host_params = dict(host_params)
+                host_params["layers"] = {**host_params["layers"], **missing}
         qcfg = self._quantization()
         if qcfg is not None:
             from ..ops.quantization import quantize_params
@@ -279,13 +346,17 @@ class TpuModelForCausalLM:
         b = self.tpu_config.max_batch_size
         sp = sampling_ops.prepare_sampling_params(b)
         key = jax.random.PRNGKey(0)
+        # warm the same pytree structure production uses: LoRA-enabled apps always
+        # pass an adapter array (None would be a different jit cache entry)
+        warm_adapters = (np.zeros((b,), dtype=np.int32)
+                         if self.arch_args.lora is not None else None)
         for bucket in self.cte_buckets:
             self.reset_cache()
             ids = np.zeros((b, bucket), dtype=np.int32)
             pos = np.broadcast_to(np.arange(bucket, dtype=np.int32), (b, bucket)).copy()
             last = np.zeros((b,), dtype=np.int32)
             tokens, _, self.kv_cache = self._prefill_step(
-                self.params, ids, pos, last, self.kv_cache, sp, key)
+                self.params, ids, pos, last, self.kv_cache, sp, key, warm_adapters)
             tokens.block_until_ready()
         chunk = max(1, self.tpu_config.decode_chunk_size)
         for bucket in self.tkg_buckets:
@@ -293,7 +364,8 @@ class TpuModelForCausalLM:
             pos = np.zeros((b,), dtype=np.int32)
             tokens, _, self.kv_cache = self._decode_step(
                 self.params, tok0, pos, self.kv_cache, sp, key,
-                decode_bucket=bucket, num_steps=min(chunk, bucket), with_logits=False)
+                decode_bucket=bucket, num_steps=min(chunk, bucket), with_logits=False,
+                adapter_ids=warm_adapters)
             tokens.block_until_ready()
         self.reset_cache()
         logger.info("warmup complete: %d CTE + %d TKG buckets",
@@ -311,12 +383,25 @@ class TpuModelForCausalLM:
         seed: int = 0,
         return_logits: bool = False,
         collect_latency: bool = False,
+        adapter_ids: Optional[np.ndarray] = None,   # (B,) multi-LoRA slots (0 = base)
     ) -> GenerateOutput:
         if self.params is None:
             raise RuntimeError("load weights before generate")
         input_ids = model_wrapper.to_int32(input_ids)
         b = input_ids.shape[0]
         compiled_b = self.tpu_config.max_batch_size
+        if adapter_ids is not None:
+            if self.arch_args.lora is None:
+                raise ValueError("adapter_ids given but lora_serving_config is not set")
+            ids_in = np.asarray(adapter_ids, dtype=np.int32)
+            n_slots = self.arch_args.lora.num_slots
+            if ids_in.min() < 0 or ids_in.max() >= n_slots:
+                # out-of-range gathers would silently produce NaN rows on device
+                raise ValueError(f"adapter_ids must be in [0, {n_slots}); "
+                                 f"got {ids_in.tolist()}")
+            ids_arr = np.zeros((compiled_b,), dtype=np.int32)
+            ids_arr[:b] = ids_in
+            adapter_ids = ids_arr
         if sampling_params is None:
             sampling_params = sampling_ops.prepare_sampling_params(compiled_b)
         elif sampling_params.shape[0] > compiled_b:
@@ -337,7 +422,7 @@ class TpuModelForCausalLM:
         key, sub = jax.random.split(key)
         tokens_dev, logits_dev, self.kv_cache = self._prefill_step(
             self.params, padded.input_ids, padded.position_ids, padded.last_token_idx,
-            self.kv_cache, sampling_params, sub)
+            self.kv_cache, sampling_params, sub, adapter_ids)
         tokens_dev.block_until_ready()
         ttft = time.perf_counter() - t_start
 
@@ -369,7 +454,8 @@ class TpuModelForCausalLM:
             t0 = time.perf_counter()
             toks_dev, logits_chunk, self.kv_cache = self._decode_step(
                 self.params, last_tok, positions, self.kv_cache, sampling_params, sub,
-                decode_bucket=bucket, num_steps=steps, with_logits=return_logits)
+                decode_bucket=bucket, num_steps=steps, with_logits=return_logits,
+                adapter_ids=adapter_ids)
             toks = np.asarray(toks_dev)           # (B, steps); syncs the chunk
             if collect_latency:
                 decode_lat.append((time.perf_counter() - t0, steps))

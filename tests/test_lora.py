@@ -1,0 +1,191 @@
+"""Multi-LoRA serving tests: the runtime adapter-indexed path must match offline
+weight merging (W' = W + scale * A @ B), per request row."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from neuronx_distributed_inference_tpu.config import (
+    LoraServingConfig, TpuConfig, load_pretrained_config)
+from neuronx_distributed_inference_tpu.models import base as model_base
+from neuronx_distributed_inference_tpu.models.llama.modeling_llama import (
+    LlamaForCausalLM, LlamaInferenceConfig)
+from neuronx_distributed_inference_tpu.modules.lora import (
+    LoraSpec, lora_delta, merge_adapter)
+
+RANK, ALPHA = 4, 8.0
+TARGETS = ("wq", "wv", "wg")
+_PEFT = {"wq": "self_attn.q_proj", "wv": "self_attn.v_proj", "wg": "mlp.gate_proj"}
+
+
+def test_lora_delta_matches_direct():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(2, 3, 8)).astype(np.float32)
+    la = rng.normal(size=(3, 8, RANK)).astype(np.float32)    # 3 adapter slots
+    lb = rng.normal(size=(3, RANK, 6)).astype(np.float32)
+    ids = np.array([2, 1], dtype=np.int32)
+    got = np.asarray(lora_delta(jnp.asarray(x), jnp.asarray(la), jnp.asarray(lb),
+                                jnp.asarray(ids), 0.5))
+    for b in range(2):
+        want = x[b] @ la[ids[b]] @ lb[ids[b]] * 0.5
+        np.testing.assert_allclose(got[b], want, rtol=1e-5, atol=1e-5)
+
+
+def _peft_state_dict(args, seed):
+    """Fake HF-PEFT adapter checkpoint in torch Linear layout."""
+    rng = np.random.default_rng(seed)
+    dims = {"wq": (args.hidden_size, args.q_size),
+            "wv": (args.hidden_size, args.kv_size),
+            "wg": (args.hidden_size, args.intermediate_size)}
+    sd = {}
+    for name in TARGETS:
+        d_in, d_out = dims[name]
+        for layer in range(args.num_layers):
+            sd[f"base_model.model.model.layers.{layer}.{_PEFT[name]}.lora_A.weight"] = (
+                rng.normal(size=(RANK, d_in)).astype(np.float32) * 0.05)
+            sd[f"base_model.model.model.layers.{layer}.{_PEFT[name]}.lora_B.weight"] = (
+                rng.normal(size=(d_out, RANK)).astype(np.float32) * 0.05)
+    return sd
+
+
+def _tpu_cfg(**kw):
+    return TpuConfig(batch_size=2, seq_len=64, max_context_length=32, dtype="float32",
+                     context_encoding_buckets=[16, 32],
+                     token_generation_buckets=[32, 64], **kw)
+
+
+def test_multi_lora_matches_merged_weights(tiny_llama_hf_config):
+    lora_cfg = LoraServingConfig(max_loras=2, max_lora_rank=RANK)
+    config = LlamaInferenceConfig(_tpu_cfg(lora_serving_config=lora_cfg),
+                                  load_config=load_pretrained_config(tiny_llama_hf_config))
+    app = LlamaForCausalLM(None, config)
+    # LoraSpec default alpha is 32; align the test spec with the app's
+    spec = app.arch_args.lora
+    app.load_random(seed=0)
+    adapters = [_peft_state_dict(app.arch_args, seed=s) for s in (1, 2)]
+    app.set_lora_adapters(adapters)
+
+    rng = np.random.default_rng(3)
+    ids = rng.integers(1, 256, size=(2, 10)).astype(np.int32)
+    out = app.generate(ids, max_new_tokens=8,
+                       adapter_ids=np.array([1, 2], dtype=np.int32))
+
+    # reference: per-adapter merged-weight apps, run row by row
+    for row, adapter_sd in enumerate(adapters):
+        plain_cfg = LlamaInferenceConfig(_tpu_cfg(),
+                                         load_config=load_pretrained_config(tiny_llama_hf_config))
+        plain = LlamaForCausalLM(None, plain_cfg)
+        base = model_base.init_params(plain.arch_args, jax.random.PRNGKey(0),
+                                      dtype=jnp.float32)
+        base = jax.tree.map(lambda x: np.array(x, copy=True), base)
+        for name in TARGETS:
+            for layer in range(plain.arch_args.num_layers):
+                a = adapter_sd[
+                    f"base_model.model.model.layers.{layer}.{_PEFT[name]}.lora_A.weight"].T
+                b = adapter_sd[
+                    f"base_model.model.model.layers.{layer}.{_PEFT[name]}.lora_B.weight"].T
+                base["layers"][name][layer] = merge_adapter(
+                    base["layers"][name][layer], a, b, spec.scaling)
+        plain._put_params(base)
+        want = plain.generate(ids[row : row + 1], max_new_tokens=8)
+        np.testing.assert_array_equal(out.tokens[row], want.tokens[0],
+                                      err_msg=f"adapter {row + 1} diverged")
+
+
+def test_adapter_zero_is_base_model(tiny_llama_hf_config):
+    lora_cfg = LoraServingConfig(max_loras=1, max_lora_rank=RANK)
+    config = LlamaInferenceConfig(_tpu_cfg(lora_serving_config=lora_cfg),
+                                  load_config=load_pretrained_config(tiny_llama_hf_config))
+    app = LlamaForCausalLM(None, config)
+    app.load_random(seed=0)
+    app.set_lora_adapters([_peft_state_dict(app.arch_args, seed=5)])
+
+    plain_cfg = LlamaInferenceConfig(_tpu_cfg(),
+                                     load_config=load_pretrained_config(tiny_llama_hf_config))
+    plain = LlamaForCausalLM(None, plain_cfg)
+    plain.load_random(seed=0)
+
+    rng = np.random.default_rng(4)
+    ids = rng.integers(1, 256, size=(2, 9)).astype(np.int32)
+    out = app.generate(ids, max_new_tokens=6,
+                       adapter_ids=np.array([0, 0], dtype=np.int32))
+    want = plain.generate(ids, max_new_tokens=6)
+    np.testing.assert_array_equal(out.tokens, want.tokens)
+
+
+def test_oversize_rank_rejected_small_rank_padded(tiny_llama_hf_config):
+    # adapter rank above the configured max is an error
+    lora_cfg = LoraServingConfig(max_loras=1, max_lora_rank=RANK - 2)
+    config = LlamaInferenceConfig(_tpu_cfg(lora_serving_config=lora_cfg),
+                                  load_config=load_pretrained_config(tiny_llama_hf_config))
+    app = LlamaForCausalLM(None, config)
+    app.load_random(seed=0)
+    with pytest.raises(ValueError, match="exceeds"):
+        app.set_lora_adapters([_peft_state_dict(app.arch_args, seed=6)])
+
+    # adapter rank below the max is zero-padded and must serve identically
+    big_cfg = LoraServingConfig(max_loras=1, max_lora_rank=RANK * 2)
+    config2 = LlamaInferenceConfig(_tpu_cfg(lora_serving_config=big_cfg),
+                                   load_config=load_pretrained_config(tiny_llama_hf_config))
+    app2 = LlamaForCausalLM(None, config2)
+    app2.load_random(seed=0)
+    exact_cfg = LoraServingConfig(max_loras=1, max_lora_rank=RANK)
+    config3 = LlamaInferenceConfig(_tpu_cfg(lora_serving_config=exact_cfg),
+                                   load_config=load_pretrained_config(tiny_llama_hf_config))
+    app3 = LlamaForCausalLM(None, config3)
+    app3.load_random(seed=0)
+    sd = _peft_state_dict(app2.arch_args, seed=6)
+    app2.set_lora_adapters([sd], alphas=[8.0])
+    app3.set_lora_adapters([sd], alphas=[8.0])
+    rng = np.random.default_rng(5)
+    ids = rng.integers(1, 256, size=(2, 8)).astype(np.int32)
+    one = np.array([1, 1], dtype=np.int32)
+    np.testing.assert_array_equal(
+        app2.generate(ids, max_new_tokens=6, adapter_ids=one).tokens,
+        app3.generate(ids, max_new_tokens=6, adapter_ids=one).tokens)
+
+
+def test_out_of_range_adapter_ids_rejected(tiny_llama_hf_config):
+    lora_cfg = LoraServingConfig(max_loras=1, max_lora_rank=RANK)
+    config = LlamaInferenceConfig(_tpu_cfg(lora_serving_config=lora_cfg),
+                                  load_config=load_pretrained_config(tiny_llama_hf_config))
+    app = LlamaForCausalLM(None, config)
+    app.load_random(seed=0)
+    ids = np.ones((2, 4), dtype=np.int32)
+    with pytest.raises(ValueError, match="adapter_ids"):
+        app.generate(ids, max_new_tokens=2, adapter_ids=np.array([0, 5]))
+    with pytest.raises(ValueError, match="adapter_ids"):
+        app.generate(ids, max_new_tokens=2, adapter_ids=np.array([-1, 0]))
+
+
+def test_alpha_folding_scales_delta(tiny_llama_hf_config):
+    """The same adapter installed with alpha=2r must produce exactly the delta of
+    merging with scaling 2.0."""
+    lora_cfg = LoraServingConfig(max_loras=1, max_lora_rank=RANK)
+    config = LlamaInferenceConfig(_tpu_cfg(lora_serving_config=lora_cfg),
+                                  load_config=load_pretrained_config(tiny_llama_hf_config))
+    app = LlamaForCausalLM(None, config)
+    app.load_random(seed=0)
+    sd = _peft_state_dict(app.arch_args, seed=7)
+    app.set_lora_adapters([sd], alphas=[2.0 * RANK])
+
+    plain_cfg = LlamaInferenceConfig(_tpu_cfg(),
+                                     load_config=load_pretrained_config(tiny_llama_hf_config))
+    plain = LlamaForCausalLM(None, plain_cfg)
+    base = model_base.init_params(plain.arch_args, jax.random.PRNGKey(0),
+                                  dtype=jnp.float32)
+    base = jax.tree.map(lambda x: np.array(x, copy=True), base)
+    for name in TARGETS:
+        for layer in range(plain.arch_args.num_layers):
+            a = sd[f"base_model.model.model.layers.{layer}.{_PEFT[name]}.lora_A.weight"].T
+            b = sd[f"base_model.model.model.layers.{layer}.{_PEFT[name]}.lora_B.weight"].T
+            base["layers"][name][layer] = merge_adapter(
+                base["layers"][name][layer], a, b, 2.0)
+    plain._put_params(base)
+
+    rng = np.random.default_rng(6)
+    ids = rng.integers(1, 256, size=(2, 8)).astype(np.int32)
+    out = app.generate(ids, max_new_tokens=6, adapter_ids=np.array([1, 1]))
+    want = plain.generate(ids, max_new_tokens=6)
+    np.testing.assert_array_equal(out.tokens, want.tokens)
