@@ -322,14 +322,18 @@ def _decoder_layer(
     paged: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,  # (block_table, slot_mapping)
     cache_batch_start=0,
     adapter_ids: Optional[jnp.ndarray] = None,   # (B,) multi-LoRA slots
+    ring_positions: Optional[jnp.ndarray] = None,  # (B, S) positions -> ring attention
 ):
     zc = args.zero_centered_norms
     resid = h
     hn = rms_norm(h, lp["ln1"], args.rms_norm_eps, zero_centered=zc)
     q, k, v = _project_qkv(lp, args, hn, adapter_ids)
-    q = constrain(q, ("batch", "heads", None, None), rules, mesh=mesh)
-    k = constrain(k, ("batch", "kv_heads", None, None), rules, mesh=mesh)
-    v = constrain(v, ("batch", "kv_heads", None, None), rules, mesh=mesh)
+    # prefill activations shard along seq over cp (sequence/context parallelism,
+    # ≈ SP reduce-scatter + CP seq shards, `model_base.py:1509-1560`); no-op at cp=1
+    seq_ax = "seq" if positions is None else None
+    q = constrain(q, ("batch", "heads", seq_ax, None), rules, mesh=mesh)
+    k = constrain(k, ("batch", "kv_heads", seq_ax, None), rules, mesh=mesh)
+    v = constrain(v, ("batch", "kv_heads", seq_ax, None), rules, mesh=mesh)
     q, k = rope_ops.apply_rotary(q, k, cos, sin)
 
     if paged is not None:
@@ -357,7 +361,13 @@ def _decoder_layer(
         # fp8 KV cache (direct-cast mode): dequantize at read for the attention matmuls
         k_att = k_att.astype(q.dtype)
         v_att = v_att.astype(q.dtype)
-    if use_flash and positions is None:
+    if ring_positions is not None and positions is None:
+        from ..ops.ring_attention import ring_attention
+
+        attn = ring_attention(q, k_att, v_att, ring_positions, ring_positions,
+                              mesh, rules, scale=args.attention_scale,
+                              window=args.sliding_window)
+    elif use_flash and positions is None:
         attn = _sharded_flash_attention(q, k_att, v_att, args, mesh, rules)
     else:
         attn = attend(q, k_att, v_att, mask=mask, scale=args.attention_scale,
@@ -389,7 +399,7 @@ def _decoder_layer(
 def _run_stack(params: Params, args: ModelArchArgs, h, cos, sin, mask, cache,
                positions, decode_bucket, mesh, rules, use_flash=False,
                local_rope_mask=None, paged=None, cache_batch_start=0,
-               adapter_ids=None):
+               adapter_ids=None, ring_positions=None):
     """Scan the decoder layers, carrying hidden state, yielding updated cache.
 
     ``local_rope_mask`` (set when args.layer_pattern is not None) is a triple
@@ -418,7 +428,8 @@ def _run_stack(params: Params, args: ModelArchArgs, h, cos, sin, mask, cache,
                                        positions, decode_bucket, mesh, rules,
                                        use_flash=use_flash, paged=paged,
                                        cache_batch_start=cache_batch_start,
-                                       adapter_ids=adapter_ids)
+                                       adapter_ids=adapter_ids,
+                                       ring_positions=ring_positions)
         return new_h, (kc, vc)
 
     h, (k_new, v_new) = jax.lax.scan(body, h, xs)
@@ -454,6 +465,7 @@ def prefill_forward(
     slot_mapping: Optional[jnp.ndarray] = None,  # (B, S) paged write slots (-1 = drop)
     cache_batch_start=0,          # dense continuous batching: batch row to insert at
     adapter_ids: Optional[jnp.ndarray] = None,   # (B,) multi-LoRA slots
+    use_ring: bool = False,       # context-parallel prefill via ring attention
 ) -> Tuple[jnp.ndarray, kvcache.KVCache]:
     """Context encoding: returns (last-token logits (B, V) fp32, updated cache).
 
@@ -481,11 +493,14 @@ def prefill_forward(
     paged = None
     if slot_mapping is not None:
         paged = (jnp.zeros((input_ids.shape[0], 1), dtype=jnp.int32), slot_mapping)
+    if use_ring:
+        h = constrain(h, ("batch", "seq", None), rules, mesh=mesh)
     h, cache = _run_stack(params, args, h, cos, sin, mask, cache,
                           positions=None, decode_bucket=None, mesh=mesh, rules=rules,
                           use_flash=use_flash, local_rope_mask=local_rope_mask,
                           paged=paged, cache_batch_start=cache_batch_start,
-                          adapter_ids=adapter_ids)
+                          adapter_ids=adapter_ids,
+                          ring_positions=position_ids if use_ring else None)
     h = rms_norm(h, params["final_norm"], args.rms_norm_eps,
                  zero_centered=args.zero_centered_norms)
     h_last = jnp.take_along_axis(h, last_token_idx[:, None, None], axis=1)[:, 0]

@@ -1,0 +1,120 @@
+"""Ring attention: context-parallel prefill over the mesh's ``cp`` axis.
+
+The reference scales long-context prefill with context parallelism — attention computed
+in reduced TP groups where each rank owns a sequence shard and the flash kernel gets a
+``cp_offset`` so it computes only its causal trapezoid
+(`modules/attention/attention_base.py:647-734`, process groups
+`attention_process_groups.py:47-123`). SURVEY §5 notes the idiomatic TPU form is ring
+attention, and that is what this is:
+
+- q/k/v are sharded along the sequence dim over ``cp``; each rank computes attention of
+  its query block against every KV block, with KV blocks **rotating around the ring**
+  via `lax.ppermute` (ICI neighbor exchange, bandwidth-optimal, overlappable with the
+  block compute by XLA).
+- Blocks combine with the online-softmax recurrence (running max ``m``, normalizer
+  ``l``, accumulator ``acc``) — the cross-device generalization of the flash-attention
+  update, so no rank ever materializes a full S×S score matrix or the full KV.
+- Causality is positional: each block carries its global kv positions; fully-masked
+  (future) blocks contribute zero. A load-balanced (strided/zigzag) layout
+  (≈ the reference's strided CP kernel variant, `models/model_base.py:890-898`) is a
+  later optimization — correctness here is layout-independent because masks follow the
+  carried position arrays, not rank indices.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..parallel.mesh import AXIS_CP
+from ..parallel.sharding import logical_to_spec
+from .attention import repeat_kv
+
+NEG_BIG = -1e30
+
+
+def _ring_local(q, k, v, q_pos, kv_pos, *, cp_size: int, scale: float, n_rep: int,
+                window: Optional[int]):
+    """Per-shard body (runs under shard_map). q (B, Hq, Sq, D); k/v (B, Hkv, Skv, D);
+    q_pos (B, Sq); kv_pos (B, Skv). Returns (B, Hq, Sq, D)."""
+    b, hq, sq, d = q.shape
+    q32 = q.astype(jnp.float32)
+    acc = jnp.zeros((b, hq, sq, d), dtype=jnp.float32)
+    m = jnp.full((b, hq, sq), NEG_BIG, dtype=jnp.float32)
+    l = jnp.zeros((b, hq, sq), dtype=jnp.float32)
+
+    k_blk, v_blk, kvp = k, v, kv_pos
+    perm = [(i, (i + 1) % cp_size) for i in range(cp_size)]
+    for step in range(cp_size):
+        kr = repeat_kv(k_blk, n_rep).astype(jnp.float32)
+        vr = repeat_kv(v_blk, n_rep).astype(jnp.float32)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q32, kr) * scale
+        mask = kvp[:, None, None, :] <= q_pos[:, None, :, None]
+        if window is not None:
+            mask = jnp.logical_and(
+                mask, kvp[:, None, None, :] > q_pos[:, None, :, None] - window)
+        scores = jnp.where(mask, scores, NEG_BIG)
+        m_new = jnp.maximum(m, scores.max(axis=-1))
+        # mask-multiply guards the all-masked case (exp(NEG_BIG - NEG_BIG) = 1)
+        p = jnp.exp(scores - m_new[..., None]) * mask
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + p.sum(axis=-1)
+        acc = acc * alpha[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, vr)
+        m = m_new
+        if step < cp_size - 1:
+            k_blk = jax.lax.ppermute(k_blk, AXIS_CP, perm)
+            v_blk = jax.lax.ppermute(v_blk, AXIS_CP, perm)
+            kvp = jax.lax.ppermute(kvp, AXIS_CP, perm)
+
+    out = acc / jnp.maximum(l, 1e-20)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention(
+    q: jnp.ndarray,            # (B, n_q, S, D), S sharded over cp
+    k: jnp.ndarray,            # (B, n_kv, S, D)
+    v: jnp.ndarray,
+    q_pos: jnp.ndarray,        # (B, S) global positions of the query tokens
+    kv_pos: jnp.ndarray,       # (B, S) global positions of the kv tokens
+    mesh,
+    rules=None,
+    scale: Optional[float] = None,
+    window: Optional[int] = None,
+) -> jnp.ndarray:
+    """Causal (optionally sliding-window) GQA ring attention over the cp mesh axis."""
+    cp_size = mesh.shape[AXIS_CP]
+    n_rep = q.shape[1] // k.shape[1]
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if q.shape[2] % cp_size != 0:
+        raise ValueError(f"seq {q.shape[2]} not divisible by cp={cp_size}")
+
+    # shard_map needs exact divisibility; a batch that doesn't divide the dp axis
+    # (e.g. batch-1 continuous-batching inserts) is replicated across dp instead —
+    # redundant compute on the idle dp shards, never wrong
+    batch_spec = logical_to_spec(("batch",), rules)[0]
+    if batch_spec is not None:
+        axes = (batch_spec,) if isinstance(batch_spec, str) else tuple(batch_spec)
+        dp_size = 1
+        for ax in axes:
+            dp_size *= mesh.shape[ax]
+        if q.shape[0] % dp_size != 0:
+            rules = dict(rules) if rules else {}
+            from ..parallel.sharding import DEFAULT_RULES
+
+            rules = {**DEFAULT_RULES, **rules, "batch": None}
+    q_spec = logical_to_spec(("batch", "heads", "seq", None), rules)
+    kv_spec = logical_to_spec(("batch", "kv_heads", "seq", None), rules)
+    pos_spec = logical_to_spec(("batch", "seq"), rules)
+    fn = jax.shard_map(
+        partial(_ring_local, cp_size=cp_size, scale=scale, n_rep=n_rep,
+                window=window),
+        mesh=mesh,
+        in_specs=(q_spec, kv_spec, kv_spec, pos_spec, pos_spec),
+        out_specs=q_spec,
+        check_vma=False,
+    )
+    return fn(q, k, v, q_pos, kv_pos)

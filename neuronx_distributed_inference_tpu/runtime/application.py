@@ -151,7 +151,8 @@ class TpuModelForCausalLM:
         precision = "highest" if self.tpu_config.dtype == "float32" else "default"
 
         rules = self.sharding_rules
-        use_flash = self._use_flash_attention()
+        use_ring = self._use_ring_attention()
+        use_flash = (not use_ring) and self._use_flash_attention()
 
         def _prefill(params, input_ids, position_ids, last_token_idx, cache,
                      sampling_params, key, adapter_ids=None):
@@ -159,7 +160,8 @@ class TpuModelForCausalLM:
                 logits, cache = prefill_core(params, args, input_ids, position_ids,
                                              last_token_idx, cache, mesh=mesh,
                                              rules=rules, use_flash=use_flash,
-                                             adapter_ids=adapter_ids)
+                                             adapter_ids=adapter_ids,
+                                             use_ring=use_ring)
                 tokens = sampling_ops.sample(logits, sampling_params, key, odsc)
             return tokens, logits, cache
 
@@ -193,6 +195,39 @@ class TpuModelForCausalLM:
         self._decode_step = jax.jit(
             _decode, donate_argnums=(3,),
             static_argnames=("decode_bucket", "num_steps", "with_logits"))
+
+    def _use_ring_attention(self) -> bool:
+        """Context-parallel (ring attention) prefill when the mesh has a cp axis.
+
+        ≈ the reference's CP strategy selection (`attention_base.py:647-734`): CP is a
+        prefill-time strategy; decode stays on the TP layout over the full cache (the
+        analog of the reference's CP-prefill -> TP-decode KV handover,
+        `kv_cache_manager.py:469-486` — here GSPMD reshards the cache write)."""
+        cp = self.mesh.shape["cp"]
+        if cp <= 1:
+            return False
+        if self.tpu_config.attention_kernel_enabled is True:
+            raise ValueError(
+                "attention_kernel_enabled=True conflicts with cp_degree > 1: "
+                "context-parallel prefill uses the ring-attention path, not the "
+                "single-shard Pallas kernel")
+        a = self.arch_args
+        unsupported = None
+        if a.layer_pattern is not None:
+            unsupported = "per-layer attention patterns"
+        elif a.logits_soft_cap is not None:
+            unsupported = "logits_soft_cap"
+        elif a.num_kv_heads % self.mesh.shape["tp"] != 0:
+            unsupported = "kv heads not divisible by tp"
+        if unsupported is not None:
+            raise ValueError(
+                f"cp_degree > 1 requires the ring-attention prefill path, which does "
+                f"not support {unsupported} for this architecture yet")
+        for bucket in self.cte_buckets:
+            if bucket % cp != 0:
+                raise ValueError(
+                    f"context bucket {bucket} not divisible by cp_degree {cp}")
+        return True
 
     def _use_flash_attention(self) -> bool:
         """Auto-select the Pallas prefill kernel (≈ reference

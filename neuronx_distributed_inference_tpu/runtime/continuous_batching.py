@@ -151,12 +151,18 @@ class ContinuousBatchingRunner:
             self._decode_step = jax.jit(_decode, donate_argnums=(3,),
                                         static_argnames=("num_steps",))
         else:
+            # thread the app's prefill strategy (ring for cp>1, Pallas flash, or
+            # dense attend) into insert-time context encoding
+            use_ring = app._use_ring_attention()
+            use_flash = (not use_ring) and app._use_flash_attention()
+
             def _insert(params, input_ids, position_ids, last_token_idx, cache,
                         slot, sampling_params, key):
                 with jax.default_matmul_precision(precision):
                     logits, cache = model_base.prefill_forward(
                         params, args, input_ids, position_ids, last_token_idx, cache,
-                        mesh=mesh, rules=rules, cache_batch_start=slot)
+                        mesh=mesh, rules=rules, cache_batch_start=slot,
+                        use_flash=use_flash, use_ring=use_ring)
                 tok = sampling_ops.sample(logits, sampling_params, key, odsc)
                 return tok, cache
 
