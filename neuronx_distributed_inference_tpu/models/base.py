@@ -466,6 +466,7 @@ def prefill_forward(
     cache_batch_start=0,          # dense continuous batching: batch row to insert at
     adapter_ids: Optional[jnp.ndarray] = None,   # (B,) multi-LoRA slots
     use_ring: bool = False,       # context-parallel prefill via ring attention
+    return_hidden: bool = False,  # also return the full normed hidden states (B, S, H)
 ) -> Tuple[jnp.ndarray, kvcache.KVCache]:
     """Context encoding: returns (last-token logits (B, V) fp32, updated cache).
 
@@ -505,6 +506,8 @@ def prefill_forward(
                  zero_centered=args.zero_centered_norms)
     h_last = jnp.take_along_axis(h, last_token_idx[:, None, None], axis=1)[:, 0]
     logits = _lm_head(params, args, h_last, mesh, rules)
+    if return_hidden:
+        return logits, cache, h
     return logits, cache
 
 
@@ -520,12 +523,22 @@ def decode_forward(
     block_table: Optional[jnp.ndarray] = None,   # (B, MB) paged: per-seq block ids
     slot_mapping: Optional[jnp.ndarray] = None,  # (B, T) paged: flat write slots
     adapter_ids: Optional[jnp.ndarray] = None,   # (B,) multi-LoRA slots
+    tree: Optional[Tuple[np.ndarray, np.ndarray]] = None,  # (depths (T,), ancestor (T,T))
+    return_hidden: bool = False,  # also return the final normed hidden states (B, T, H)
 ) -> Tuple[jnp.ndarray, kvcache.KVCache]:
     """Token generation: returns (logits (B, T, V) fp32, updated cache).
 
     Dense mode slices the cache at the static ``decode_bucket``; paged mode
     (``block_table``/``slot_mapping`` given) gathers each row's blocks instead, with the
-    attention width set by the table (MB * block_size)."""
+    attention width set by the table (MB * block_size).
+
+    ``tree`` switches the T input tokens from a left-to-right chain to a static token
+    tree (Medusa / EAGLE tree verify, ≈ reference tree decoding
+    `models/model_base.py:2136-2558`): token i's KV still lands at cache slot
+    ``position_ids + i`` (sequential slots), but its RoPE position is
+    ``position_ids + depths[i]`` and intra-window attention follows the ancestor mask
+    instead of the causal triangle. Cache slots below ``position_ids`` (committed
+    context) stay visible to every node."""
     paged = None
     if block_table is not None:
         paged = (block_table, slot_mapping)
@@ -533,12 +546,29 @@ def decode_forward(
         decode_bucket = block_table.shape[1] * block_size
     b, t = input_ids.shape
     h = _embed(params, args, input_ids, mesh, rules)
-    pos_grid = position_ids[:, None] + jnp.arange(t)[None, :]      # (B, T)
+    if tree is None:
+        pos_grid = position_ids[:, None] + jnp.arange(t)[None, :]  # (B, T)
+    else:
+        depths, ancestor = tree
+        pos_grid = position_ids[:, None] + jnp.asarray(depths, jnp.int32)[None, :]
     cos, sin = rope_ops.compute_cos_sin(params["rope_inv_freq"], pos_grid,
                                         args.rope_attention_scaling)
     kv_pos = jnp.arange(decode_bucket)[None, None, None, :]
     q_pos = pos_grid[:, None, :, None]
-    mask = kv_pos <= q_pos                                         # (B, 1, T, bucket)
+    if tree is None:
+        mask = kv_pos <= q_pos                                     # (B, 1, T, bucket)
+    else:
+        # committed-context slots are visible to all nodes; tree slots follow ancestry
+        write_start = position_ids[:, None, None, None]            # (B, 1, 1, 1)
+        committed = kv_pos < write_start
+        rel = kv_pos - write_start                                 # slot idx within tree
+        anc = jnp.asarray(ancestor, bool)                          # (T, T)
+        in_tree = jnp.logical_and(rel >= 0, rel < t)
+        rel_c = jnp.broadcast_to(jnp.clip(rel, 0, t - 1),
+                                 (b, 1, t, rel.shape[-1]))
+        tree_vis = jnp.take_along_axis(
+            jnp.broadcast_to(anc[None, None], (b, 1, t, t)), rel_c, axis=3)
+        mask = committed | (in_tree & tree_vis)
     sliding = (jnp.logical_and(mask, kv_pos > q_pos - args.sliding_window)
                if args.sliding_window is not None else None)
     local_rope_mask = None
@@ -556,4 +586,6 @@ def decode_forward(
     h = rms_norm(h, params["final_norm"], args.rms_norm_eps,
                  zero_centered=args.zero_centered_norms)
     logits = _lm_head(params, args, h, mesh, rules)
+    if return_hidden:
+        return logits, cache, h
     return logits, cache

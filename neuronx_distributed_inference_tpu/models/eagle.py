@@ -1,0 +1,155 @@
+"""EAGLE draft model: a shallow decoder conditioned on the target's hidden states.
+
+≈ reference `modules/eagle/` + the EAGLE fc / hidden-state plumbing in
+`models/model_base.py` (`_eagle_context_encoding_forward` :2075-2134, draft hidden
+processing :1569-1635): the draft has no embedding or lm_head of its own — it reuses the
+target's — and its layer-0 input is ``fc(concat(embed(token), cond_hidden))`` where
+``cond_hidden`` is the target's final hidden state at the *previous* position (during
+autoregressive drafting the draft substitutes its own output hidden, the standard
+EAGLE-1 approximation). The reference's `HiddenStateRollingBuffer`
+(`modules/eagle/hidden_state.py`) keys hidden states by (seq, pos) across host steps;
+here the fused step carries the (B, H) conditioning hidden as explicit jit state, so no
+buffer indexing is needed.
+
+The draft shares `ModelArchArgs` geometry with the target for hidden size / head_dim
+(vocab via the target's lm_head); layer count and head counts may differ.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..modules import kvcache
+from ..ops import rope as rope_ops
+from ..ops.attention import causal_mask
+from ..ops.norms import rms_norm
+from . import base as model_base
+from .base import ModelArchArgs, Params
+
+
+def init_eagle_params(args: ModelArchArgs, key: jax.Array, dtype=jnp.bfloat16,
+                      inv_freq: Optional[np.ndarray] = None) -> Params:
+    """Random draft params: fc (2H -> H) + the stacked decoder layers + final norm.
+
+    ``args`` describes the *draft* stack (usually 1 layer, target's hidden size).
+    """
+    k_fc, k_layers = jax.random.split(key)
+    full = model_base.init_params(args, k_layers, dtype=dtype, inv_freq=inv_freq)
+    h = args.hidden_size
+    return {
+        "fc": (jax.random.normal(k_fc, (2 * h, h), jnp.float32) * 0.02).astype(dtype),
+        "layers": full["layers"],
+        "final_norm": full["final_norm"],
+        "rope_inv_freq": full["rope_inv_freq"],
+    }
+
+
+def convert_eagle_state_dict(state_dict: Dict[str, np.ndarray],
+                             args: ModelArchArgs,
+                             inv_freq: np.ndarray) -> Params:
+    """EAGLE checkpoint (llama-style ``layers.{i}.*`` + ``fc.weight``) -> draft pytree."""
+    from ..modules import gqa
+
+    def linear_t(name):
+        return np.ascontiguousarray(state_dict[name].T)
+
+    L, d = args.num_layers, args.head_dim
+    # EAGLE checkpoints store raw kv head count; replicate as the args demand
+    layers = {"ln1": [], "wq": [], "wk": [], "wv": [], "wo": [],
+              "ln2": [], "wg": [], "wu": [], "wd": []}
+    for i in range(L):
+        p = f"layers.{i}."
+        if p + "input_layernorm.weight" in state_dict:
+            layers["ln1"].append(state_dict[p + "input_layernorm.weight"])
+        else:  # EAGLE-1 drops layer-0's input norm (fc output feeds attention raw)
+            layers["ln1"].append(np.ones_like(state_dict[p + "post_attention_layernorm.weight"]))
+        wk = linear_t(p + "self_attn.k_proj.weight")
+        wv = linear_t(p + "self_attn.v_proj.weight")
+        n_kv_ckpt = wk.shape[1] // d
+        factor = args.num_kv_heads // n_kv_ckpt
+        layers["wq"].append(linear_t(p + "self_attn.q_proj.weight"))
+        layers["wk"].append(gqa.replicate_kv_weight(wk, n_kv_ckpt, d, factor))
+        layers["wv"].append(gqa.replicate_kv_weight(wv, n_kv_ckpt, d, factor))
+        layers["wo"].append(linear_t(p + "self_attn.o_proj.weight"))
+        layers["ln2"].append(state_dict[p + "post_attention_layernorm.weight"])
+        layers["wg"].append(linear_t(p + "mlp.gate_proj.weight"))
+        layers["wu"].append(linear_t(p + "mlp.up_proj.weight"))
+        layers["wd"].append(linear_t(p + "mlp.down_proj.weight"))
+    params = {
+        "fc": linear_t("fc.weight"),
+        "layers": {k: np.stack(v) for k, v in layers.items()},
+        "rope_inv_freq": inv_freq,
+    }
+    params["final_norm"] = state_dict.get(
+        "norm.weight", np.ones((args.hidden_size,), dtype=np.float32))
+    return params
+
+
+def _fuse_input(d_params: Params, t_params: Params, args: ModelArchArgs,
+                input_ids: jnp.ndarray, cond_hidden: jnp.ndarray) -> jnp.ndarray:
+    e = jnp.take(t_params["embed"], input_ids, axis=0)       # (B, T, H)
+    x = jnp.concatenate([e, cond_hidden.astype(e.dtype)], axis=-1)
+    return x @ d_params["fc"]
+
+
+def eagle_prefill_forward(
+    d_params: Params,
+    t_params: Params,          # target params: embed + lm_head reused
+    args: ModelArchArgs,       # draft stack geometry (target vocab/hidden)
+    input_ids: jnp.ndarray,    # (B, S) prompt tokens
+    cond_hidden: jnp.ndarray,  # (B, S, H) target hiddens shifted right (row 0 = zeros)
+    position_ids: jnp.ndarray,
+    last_token_idx: jnp.ndarray,
+    cache: kvcache.KVCache,
+    mesh=None,
+    rules=None,
+) -> kvcache.KVCache:
+    """Draft context encoding: populates the draft KV cache and returns it.
+
+    (Prefill emits no draft proposal — the first fused step drafts from the target's
+    prefill hidden — so no lm_head runs here.)"""
+    del last_token_idx
+    h = _fuse_input(d_params, t_params, args, input_ids, cond_hidden)
+    cos, sin = rope_ops.compute_cos_sin(d_params["rope_inv_freq"], position_ids,
+                                        args.rope_attention_scaling)
+    s = input_ids.shape[1]
+    mask = (position_ids[:, None, :, None] >= position_ids[:, None, None, :])
+    mask = jnp.logical_and(mask, causal_mask(s, s)[None, None])
+    _, cache = model_base._run_stack(d_params, args, h, cos, sin, mask, cache,
+                                     positions=None, decode_bucket=None,
+                                     mesh=mesh, rules=rules)
+    return cache
+
+
+def eagle_decode_forward(
+    d_params: Params,
+    t_params: Params,
+    args: ModelArchArgs,
+    input_ids: jnp.ndarray,    # (B, T)
+    cond_hidden: jnp.ndarray,  # (B, T, H)
+    position_ids: jnp.ndarray, # (B,)
+    cache: kvcache.KVCache,
+    decode_bucket: int,
+    mesh=None,
+    rules=None,
+) -> Tuple[jnp.ndarray, jnp.ndarray, kvcache.KVCache]:
+    """Draft token generation. Returns (logits (B, T, V), draft hiddens (B, T, H),
+    cache)."""
+    b, t = input_ids.shape
+    h = _fuse_input(d_params, t_params, args, input_ids, cond_hidden)
+    pos_grid = position_ids[:, None] + jnp.arange(t)[None, :]
+    cos, sin = rope_ops.compute_cos_sin(d_params["rope_inv_freq"], pos_grid,
+                                        args.rope_attention_scaling)
+    kv_pos = jnp.arange(decode_bucket)[None, None, None, :]
+    mask = kv_pos <= pos_grid[:, None, :, None]
+    h, cache = model_base._run_stack(d_params, args, h, cos, sin, mask, cache,
+                                     positions=position_ids,
+                                     decode_bucket=decode_bucket,
+                                     mesh=mesh, rules=rules)
+    hn = rms_norm(h, d_params["final_norm"], args.rms_norm_eps)
+    logits = model_base._lm_head(t_params, args, hn, mesh, rules)
+    return logits, hn, cache

@@ -103,3 +103,30 @@ def batched_gather(cache: KVCache, seq_ids: jnp.ndarray) -> KVCache:
     """Reorder the batch dim by seq_ids (continuous batching batch remap,
     ≈ `model_wrapper.py:569-698` batch sorting)."""
     return {k: jnp.take(v, seq_ids, axis=1) for k, v in cache.items()}
+
+
+def compact_decode_slots(cache: KVCache, src_slots: jnp.ndarray,
+                         dst_start: jnp.ndarray) -> KVCache:
+    """Gather accepted tree-verify slots into contiguous positions.
+
+    After a tree verify writes N nodes at cache slots [p, p+N) (see
+    `models/base.decode_forward` tree mode), acceptance keeps a root-to-leaf path; the
+    kept nodes' KV entries move to [dst_start, dst_start+K) so the cache is again a
+    plain left-to-right sequence (≈ the reference's accepted-index KV compaction,
+    `modules/kvcache/kv_cache_manager.py:266-322`).
+
+    src_slots (B, K) int32: absolute cache slots to keep, in commit order. Rows that
+    accept fewer than K nodes may pad src_slots arbitrarily — padded slots copy garbage
+    that later decode writes overwrite before any read (decode masks are
+    position-bounded).
+    dst_start (B,) int32: first destination slot per row.
+    """
+    def _one_layer(cache_layer):
+        def _one_row(row_cache, row_src, row_dst):
+            # row_cache (H, S, D): gather K source slots then write them contiguously
+            kept = jnp.take(row_cache, row_src, axis=1)       # (H, K, D)
+            return jax.lax.dynamic_update_slice(row_cache, kept, (0, row_dst, 0))
+
+        return jax.vmap(_one_row)(cache_layer, src_slots, dst_start)
+
+    return {k: jax.vmap(_one_layer)(v) for k, v in cache.items()}

@@ -1,0 +1,126 @@
+"""Static token trees for tree-structured speculative decoding (Medusa / EAGLE tree).
+
+≈ reference `modules/eagle/token_tree.py` (`TokenTree` :8-60+): a tree is declared as a
+set of root-to-node paths; from it we precompute everything the traced verify step needs
+— per-node depth (RoPE position offset), the ancestor ("tree attention") mask, and
+parent/child tables for host-side acceptance walking. The reference additionally
+precomputes KV "cache scatter indices" for compacting accepted nodes
+(`token_tree.py` level masks / permute indices); here compaction is a gather over cache
+slots (see `modules/kvcache.compact_decode_slots`) driven by the accepted node indices.
+
+Nodes are numbered in path-declaration order with node 0 the implicit root (the last
+committed token). Paths use Medusa convention: path ``(a, b, c)`` means "take the
+``a``-th top-k candidate of head 0, then the ``b``-th of head 1, ...", so a node at
+depth d carries the candidate index ``path[-1]`` into draft head ``d-1``'s top-k list.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+# the default Medusa "sparse" tree used when none is configured: a chain of the top-1
+# candidates plus first-level alternatives — small but captures most acceptance mass
+DEFAULT_TREE_PATHS: Tuple[Tuple[int, ...], ...] = (
+    (0,), (1,), (2,), (3,),
+    (0, 0), (0, 1), (1, 0),
+    (0, 0, 0), (0, 0, 1),
+    (0, 0, 0, 0),
+)
+
+
+@dataclass(frozen=True)
+class TokenTree:
+    """Precomputed static tree structure. All arrays are host numpy; the jitted verify
+    step closes over `depths` / `ancestor_mask` as constants."""
+
+    paths: Tuple[Tuple[int, ...], ...]
+    num_nodes: int                      # including the root
+    depths: np.ndarray                  # (N,) int32, depth[0] = 0
+    parents: np.ndarray                 # (N,) int32, parent[0] = -1
+    branch: np.ndarray                  # (N,) int32 candidate index at the node's head
+    ancestor_mask: np.ndarray           # (N, N) bool: [i, j] = j is ancestor-of-or-is i
+    children: Tuple[Tuple[int, ...], ...] = field(repr=False, default=())
+
+    @property
+    def max_depth(self) -> int:
+        return int(self.depths.max())
+
+    @property
+    def max_branch(self) -> int:
+        """Top-k width each draft head must produce."""
+        return int(self.branch[1:].max()) + 1 if self.num_nodes > 1 else 1
+
+    @classmethod
+    def from_paths(cls, paths: Sequence[Sequence[int]]) -> "TokenTree":
+        # every path's prefix must also be a declared path (a node needs its parent)
+        canonical = [tuple(p) for p in paths]
+        if len(set(canonical)) != len(canonical):
+            raise ValueError("duplicate tree paths")
+        path_set = {(): 0}
+        ordered = sorted(canonical, key=lambda p: (len(p), p))
+        for p in ordered:
+            if not p:
+                raise ValueError("empty path: the root is implicit")
+            if tuple(p[:-1]) not in path_set:
+                raise ValueError(f"path {p} missing parent prefix {p[:-1]}")
+            path_set[p] = len(path_set)
+
+        n = len(path_set)
+        depths = np.zeros((n,), dtype=np.int32)
+        parents = np.full((n,), -1, dtype=np.int32)
+        branch = np.zeros((n,), dtype=np.int32)
+        ancestor = np.zeros((n, n), dtype=bool)
+        children: List[List[int]] = [[] for _ in range(n)]
+        for p, idx in path_set.items():
+            depths[idx] = len(p)
+            ancestor[idx, idx] = True
+            if p:
+                parent = path_set[tuple(p[:-1])]
+                parents[idx] = parent
+                branch[idx] = p[-1]
+                children[parent].append(idx)
+                ancestor[idx] |= ancestor[parent]
+        return cls(paths=tuple(ordered), num_nodes=n, depths=depths, parents=parents,
+                   branch=branch, ancestor_mask=ancestor,
+                   children=tuple(tuple(c) for c in children))
+
+    # ------------------------------------------------------------------ acceptance
+    def walk_accept(self, node_tokens: np.ndarray, target_tokens: np.ndarray
+                    ) -> Tuple[List[int], int]:
+        """Greedy tree acceptance for one row (host side, ≈ the reference's CPU-side
+        Medusa acceptance in `utils/hf_adapter.py:798-925`).
+
+        node_tokens (N,): the drafted token at each node (node 0 = committed root).
+        target_tokens (N,): the target's argmax emitted AT each node.
+
+        Returns (accepted_node_indices, bonus_token): the accepted nodes' drafted
+        tokens are committed in order, then ``bonus`` (the target's prediction at the
+        last accepted node) commits as the correction/bonus token.
+        """
+        cur = 0
+        accepted: List[int] = []
+        while True:
+            want = int(target_tokens[cur])
+            nxt = next((c for c in self.children[cur]
+                        if int(node_tokens[c]) == want), None)
+            if nxt is None:
+                return accepted, want
+            accepted.append(nxt)
+            cur = nxt
+
+    def assemble_tokens(self, root_token: np.ndarray,
+                        head_topk: np.ndarray) -> np.ndarray:
+        """Build the (B, N) node-token matrix for the next verify call.
+
+        head_topk (B, num_heads, K): per-draft-head top-k candidate ids at the
+        current root. Node at depth d takes head d-1's candidate `branch[node]`.
+        """
+        b = root_token.shape[0]
+        out = np.zeros((b, self.num_nodes), dtype=np.int32)
+        out[:, 0] = root_token
+        for i in range(1, self.num_nodes):
+            out[:, i] = head_topk[:, self.depths[i] - 1, self.branch[i]]
+        return out

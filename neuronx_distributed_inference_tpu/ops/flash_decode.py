@@ -63,8 +63,9 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, m_scratch, l_scratch,
 
         # row r of the tile is (kv-group rep, token) pair; its query position is
         # pos + (r % t) — reps of the same token share a position
+        # (padded rows r >= n_rep*t compute garbage that the caller slices off)
         row_idx = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-        q_pos = pos + jnp.where(row_idx % t < t, row_idx % t, 0)
+        q_pos = pos + row_idx % t
         kv_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         mask = kv_pos <= q_pos
         if window is not None:

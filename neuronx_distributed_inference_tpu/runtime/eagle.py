@@ -1,0 +1,222 @@
+"""EAGLE speculative decoding runtime: fused hidden-conditioned draft + target verify.
+
+≈ reference EAGLE flow (`NeuronFusedSpecModel._eagle_context_encoding_forward`
+`models/model_base.py:2075-2134`, `_eagle_token_gen_forward` :2559-2797): the draft is a
+shallow decoder whose layer-0 input fuses the token embedding with the target's hidden
+state at the previous position (see `models/eagle.py`). Per fused step the draft
+autoregressively proposes ``k-1`` candidates (substituting its own output hidden for the
+unavailable target hidden — the EAGLE-1 approximation), then the target verifies all
+candidates in one wide decode that also returns its hidden states; the hidden at the
+last accepted position becomes the next step's conditioning, replacing the reference's
+`HiddenStateRollingBuffer` (`modules/eagle/hidden_state.py`) with explicit jit-carried
+state.
+
+Greedy acceptance only (exact: output always equals the target's plain greedy decode).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models import base as model_base
+from ..models import eagle as eagle_lib
+from ..models.base import ModelArchArgs
+from ..modules import autobucketing, kvcache
+from . import model_wrapper
+from .speculation import SpecGenerateOutput, assemble_spec_output, commit_row
+
+
+def draft_args_from_target(target_args: ModelArchArgs, num_layers: int = 1,
+                           num_heads: Optional[int] = None,
+                           num_kv_heads: Optional[int] = None,
+                           intermediate_size: Optional[int] = None) -> ModelArchArgs:
+    """Draft geometry: target's hidden/vocab with a shallow stack."""
+    import dataclasses
+
+    return dataclasses.replace(
+        target_args,
+        num_layers=num_layers,
+        num_heads=num_heads or target_args.num_heads,
+        num_kv_heads=num_kv_heads or target_args.num_kv_heads,
+        intermediate_size=intermediate_size or target_args.intermediate_size,
+        moe=None, lora=None,
+    )
+
+
+class EagleSpeculativeModel:
+    """Owns a target `TpuModelForCausalLM` + EAGLE draft params; runs fused spec."""
+
+    def __init__(self, target, draft_args: ModelArchArgs, speculation_length: int):
+        if speculation_length < 2:
+            raise ValueError("speculation_length must be >= 2")
+        if draft_args.hidden_size != target.arch_args.hidden_size:
+            raise ValueError("EAGLE draft must share the target's hidden size")
+        self.target = target
+        self.draft_args = draft_args
+        self.k = speculation_length
+        self.draft_params = None
+        self.draft_cache = None
+        self._build_steps()
+
+    def load_random_draft(self, seed: int = 0) -> None:
+        self.draft_params = eagle_lib.init_eagle_params(
+            self.draft_args, jax.random.PRNGKey(seed),
+            dtype=self.target.tpu_config.jax_dtype,
+            inv_freq=self.target.inv_freq_from_config(self.target.config))
+
+    def load_draft(self, state_dict) -> None:
+        host = eagle_lib.convert_eagle_state_dict(
+            state_dict, self.draft_args,
+            self.target.inv_freq_from_config(self.target.config))
+        dtype = self.target.tpu_config.jax_dtype
+        self.draft_params = jax.tree.map(
+            lambda x: jnp.asarray(np.asarray(x)).astype(dtype)
+            if np.asarray(x).dtype.kind == "f" else jnp.asarray(x), host)
+        self.draft_params["rope_inv_freq"] = jnp.asarray(
+            np.asarray(host["rope_inv_freq"]), jnp.float32)
+
+    def _draft_cache_spec(self) -> kvcache.KVCacheSpec:
+        a = self.draft_args
+        cfg = self.target.tpu_config
+        return kvcache.KVCacheSpec(
+            num_layers=a.num_layers, batch_size=cfg.max_batch_size,
+            num_kv_heads=a.num_kv_heads, max_seq_len=cfg.seq_len,
+            head_dim=a.head_dim, dtype=cfg.kv_cache_jax_dtype)
+
+    # ------------------------------------------------------------------ device steps
+    def _build_steps(self) -> None:
+        t = self.target
+        t_args, d_args = t.arch_args, self.draft_args
+        mesh, rules = t.mesh, t.sharding_rules
+        k = self.k
+        precision = "highest" if t.tpu_config.dtype == "float32" else "default"
+
+        def _prefill(t_params, d_params, input_ids, position_ids, last_token_idx,
+                     t_cache, d_cache):
+            with jax.default_matmul_precision(precision):
+                logits, t_cache, h_full = model_base.prefill_forward(
+                    t_params, t_args, input_ids, position_ids, last_token_idx,
+                    t_cache, mesh=mesh, rules=rules, return_hidden=True)
+                tok0 = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                # draft conditioning: target hidden of the previous position
+                cond = jnp.concatenate(
+                    [jnp.zeros_like(h_full[:, :1]), h_full[:, :-1]], axis=1)
+                d_cache = eagle_lib.eagle_prefill_forward(
+                    d_params, t_params, d_args, input_ids, cond, position_ids,
+                    last_token_idx, d_cache, mesh=mesh, rules=rules)
+                h_last = jnp.take_along_axis(
+                    h_full, last_token_idx[:, None, None], axis=1)[:, 0]
+            return tok0, h_last, t_cache, d_cache
+
+        def _step(t_params, d_params, last_tok, h_cond, positions, t_cache, d_cache,
+                  decode_bucket):
+            """One fused EAGLE step: k-1 draft proposals + one target verify."""
+            def draft_body(carry, _):
+                tok, h, pos, cache = carry
+                with jax.default_matmul_precision(precision):
+                    logits, h_d, cache = eagle_lib.eagle_decode_forward(
+                        d_params, t_params, d_args, tok[:, None], h[:, None, :],
+                        pos, cache, decode_bucket, mesh=mesh, rules=rules)
+                nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+                return (nxt, h_d[:, -1], pos + 1, cache), nxt
+
+            (_, _, _, d_cache), draft_toks = jax.lax.scan(
+                draft_body, (last_tok, h_cond, positions, d_cache), None, length=k)
+            draft_toks = draft_toks.T[:, : k - 1]                    # (B, K-1)
+
+            target_in = jnp.concatenate([last_tok[:, None], draft_toks], axis=1)
+            with jax.default_matmul_precision(precision):
+                t_logits, t_cache, t_h = model_base.decode_forward(
+                    t_params, t_args, target_in, positions, t_cache, decode_bucket,
+                    mesh=mesh, rules=rules, return_hidden=True)   # (B, K, V/H)
+            t_toks = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)
+            matches = draft_toks == t_toks[:, :-1]
+            n = jnp.cumprod(matches.astype(jnp.int32), axis=1).sum(axis=1)
+            # conditioning hidden for the next step: target hidden at input slot n
+            h_next = jnp.take_along_axis(
+                t_h, n[:, None, None], axis=1)[:, 0]                 # (B, H)
+            return t_toks, n.astype(jnp.int32), h_next, t_cache, d_cache
+
+        self._prefill_step = jax.jit(_prefill, donate_argnums=(5, 6))
+        self._spec_step = jax.jit(_step, donate_argnums=(5, 6),
+                                  static_argnames=("decode_bucket",))
+
+    # ------------------------------------------------------------------ generate
+    def generate(
+        self,
+        input_ids: np.ndarray,
+        attention_mask: Optional[np.ndarray] = None,
+        max_new_tokens: int = 32,
+        eos_token_id: Optional[int] = None,
+        pad_token_id: int = 0,
+    ) -> SpecGenerateOutput:
+        target = self.target
+        cfg = target.tpu_config
+        if target.params is None or self.draft_params is None:
+            raise RuntimeError("load target weights and draft params before generate")
+        input_ids = model_wrapper.to_int32(input_ids)
+        b = input_ids.shape[0]
+        compiled_b = cfg.max_batch_size
+
+        padded = model_wrapper.pad_prefill_inputs(
+            input_ids, attention_mask, target.cte_buckets, pad_token_id=pad_token_id,
+            batch_size=compiled_b)
+        target.reset_cache()
+        from ..parallel.sharding import named_sharding
+
+        sharding = named_sharding(target.mesh, kvcache.CACHE_LOGICAL)
+        self.draft_cache = jax.tree.map(
+            lambda x: jax.device_put(x, sharding),
+            kvcache.init_cache(self._draft_cache_spec()))
+
+        t_start = time.perf_counter()
+        tok0_dev, h_dev, target.kv_cache, self.draft_cache = self._prefill_step(
+            target.params, self.draft_params, padded.input_ids, padded.position_ids,
+            padded.last_token_idx, target.kv_cache, self.draft_cache)
+        tok0 = np.asarray(tok0_dev)
+        ttft = time.perf_counter() - t_start
+
+        committed: List[List[int]] = [[int(tok0[i])] for i in range(b)]
+        done = np.zeros((compiled_b,), dtype=bool)
+        done[b:] = True
+        if eos_token_id is not None:
+            done[:b] |= tok0[:b] == eos_token_id
+        positions = padded.true_lengths.astype(np.int32).copy()
+        last_tok = tok0.astype(np.int32)
+        h_cond = h_dev                         # (B, H) stays device-resident
+        accept_hist = np.zeros((self.k,), dtype=np.int64)
+        steps = 0
+
+        while not all(len(c) >= max_new_tokens or done[i]
+                      for i, c in enumerate(committed)):
+            max_pos = int(positions.max())
+            if max_pos + self.k >= cfg.seq_len:
+                break
+            bucket = autobucketing.select_bucket(target.tkg_buckets,
+                                                 max_pos + self.k)
+            out_dev, n_dev, h_cond, target.kv_cache, self.draft_cache = \
+                self._spec_step(target.params, self.draft_params,
+                                jnp.asarray(last_tok), h_cond,
+                                jnp.asarray(positions), target.kv_cache,
+                                self.draft_cache, decode_bucket=bucket)
+            out = np.asarray(out_dev)
+            n = np.asarray(n_dev)
+            steps += 1
+            for i in range(b):
+                if done[i]:
+                    continue
+                take = int(n[i]) + 1
+                accept_hist[take - 1] += 1
+                done[i] = commit_row(committed[i], out[i, :take], eos_token_id,
+                                     max_new_tokens)
+                if not done[i]:
+                    positions[i] += take
+                    last_tok[i] = out[i, take - 1]
+
+        return assemble_spec_output(committed, padded, b, pad_token_id, accept_hist,
+                                    steps, ttft)

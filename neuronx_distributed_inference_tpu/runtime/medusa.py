@@ -1,0 +1,236 @@
+"""Medusa speculative decoding: multi-head drafting + tree-attention verification.
+
+≈ reference `_medusa_forward` (`models/model_base.py:433-548`) + the Medusa HF loop
+(`utils/hf_adapter.py:798-925`) + medusa head modules (`models/llama/modeling_llama.py:1304`
+ResBlock). TPU redesign:
+
+- Medusa heads are a stacked pytree ``{"w": (M, H, H), "b": (M, H), "out": (M, H, V)}``
+  applied as one batched einsum (ResBlock ``h + silu(h @ w + b)`` then the head's
+  lm_head) — M heads cost one fused matmul pair, not M module calls.
+- Each step is ONE verify dispatch: the candidate token tree (assembled host-side from
+  the previous step's per-head top-k) runs through `decode_forward` in tree mode
+  (ancestor mask + depth positions, `models/base.py`), which returns the target argmax
+  AND every node's medusa-head top-k in the same graph, so the next tree needs no extra
+  device call.
+- Acceptance walks the tree on the host (≈ the reference's CPU-side medusa acceptance)
+  and a second small dispatch compacts accepted KV slots
+  (`modules/kvcache.compact_decode_slots` ≈ accepted-index KV gather/scatter,
+  `kv_cache_manager.py:266-322`).
+
+Greedy-only, like the reference's medusa path. The exactness guarantee holds regardless
+of head quality: committed tokens are always the target's argmax in context, so output
+== the base model's plain greedy decode.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models import base as model_base
+from ..modules import autobucketing, kvcache
+from ..modules.token_tree import DEFAULT_TREE_PATHS, TokenTree
+from . import model_wrapper
+from .speculation import SpecGenerateOutput, assemble_spec_output, commit_row
+
+MedusaParams = Dict[str, jnp.ndarray]
+
+
+def init_medusa_params(num_heads: int, hidden: int, vocab: int, key: jax.Array,
+                       dtype=jnp.bfloat16) -> MedusaParams:
+    k1, k2 = jax.random.split(key)
+    scale = 0.02
+    return {
+        "w": (jax.random.normal(k1, (num_heads, hidden, hidden), jnp.float32)
+              * scale).astype(dtype),
+        "b": jnp.zeros((num_heads, hidden), dtype=dtype),
+        "out": (jax.random.normal(k2, (num_heads, hidden, vocab), jnp.float32)
+                * scale).astype(dtype),
+    }
+
+
+def convert_medusa_state_dict(state_dict: Dict[str, np.ndarray], num_heads: int
+                              ) -> Dict[str, np.ndarray]:
+    """HF medusa checkpoint (``medusa_head.{i}.0.linear.{weight,bias}`` ResBlock +
+    ``medusa_head.{i}.1.weight`` head) -> stacked pytree (weights transposed to
+    (in, out) per this repo's layout)."""
+    w, b, out = [], [], []
+    for i in range(num_heads):
+        w.append(np.ascontiguousarray(
+            state_dict[f"medusa_head.{i}.0.linear.weight"].T))
+        b.append(state_dict[f"medusa_head.{i}.0.linear.bias"])
+        out.append(np.ascontiguousarray(state_dict[f"medusa_head.{i}.1.weight"].T))
+    return {"w": np.stack(w), "b": np.stack(b), "out": np.stack(out)}
+
+
+def _head_topk(medusa_params: MedusaParams, h: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Per-head top-k candidate ids from hidden states.
+
+    h (..., H) -> (..., M, k) int32. ResBlock then head lm_head, batched over heads.
+    """
+    w, b, out = medusa_params["w"], medusa_params["b"], medusa_params["out"]
+    pre = jnp.einsum("...h,mhk->...mk", h, w) + b          # (..., M, H)
+    res = h[..., None, :] + jax.nn.silu(pre)
+    logits = jnp.einsum("...mh,mhv->...mv", res, out)      # (..., M, V)
+    _, idx = jax.lax.top_k(logits, k)
+    return idx.astype(jnp.int32)
+
+
+class MedusaModel:
+    """Owns a base `TpuModelForCausalLM` plus medusa heads and runs tree decoding."""
+
+    def __init__(self, app, num_medusa_heads: int = 4,
+                 tree: Optional[TokenTree] = None):
+        self.app = app
+        self.num_heads = num_medusa_heads
+        self.tree = tree if tree is not None else TokenTree.from_paths(
+            [p for p in DEFAULT_TREE_PATHS if len(p) <= num_medusa_heads])
+        if self.tree.max_depth > num_medusa_heads:
+            raise ValueError(f"tree depth {self.tree.max_depth} exceeds "
+                             f"{num_medusa_heads} medusa heads")
+        self.medusa_params: Optional[MedusaParams] = None
+        self._build_steps()
+
+    def load_random_heads(self, seed: int = 0) -> None:
+        a = self.app.arch_args
+        self.medusa_params = init_medusa_params(
+            self.num_heads, a.hidden_size, a.vocab_size, jax.random.PRNGKey(seed),
+            dtype=self.app.tpu_config.jax_dtype)
+
+    def load_heads(self, state_dict: Dict[str, np.ndarray]) -> None:
+        host = convert_medusa_state_dict(state_dict, self.num_heads)
+        dtype = self.app.tpu_config.jax_dtype
+        self.medusa_params = {k: jnp.asarray(v).astype(dtype)
+                              for k, v in host.items()}
+
+    # ------------------------------------------------------------------ device steps
+    def _build_steps(self) -> None:
+        app = self.app
+        args = app.arch_args
+        mesh, rules = app.mesh, app.sharding_rules
+        tree = self.tree
+        kb = tree.max_branch
+        precision = ("highest" if app.tpu_config.dtype == "float32" else "default")
+        depths = tree.depths
+        ancestor = tree.ancestor_mask
+
+        def _prefill(params, medusa_params, input_ids, position_ids, last_token_idx,
+                     cache):
+            with jax.default_matmul_precision(precision):
+                logits, cache, h = model_base.prefill_forward(
+                    params, args, input_ids, position_ids, last_token_idx, cache,
+                    mesh=mesh, rules=rules, return_hidden=True)
+                root = jnp.argmax(logits, axis=-1).astype(jnp.int32)       # (B,)
+                h_last = jnp.take_along_axis(
+                    h, last_token_idx[:, None, None], axis=1)[:, 0]        # (B, H)
+                topk = _head_topk(medusa_params, h_last, kb)               # (B, M, kb)
+            return root, topk, cache
+
+        def _verify(params, medusa_params, tree_tokens, positions, cache,
+                    decode_bucket):
+            with jax.default_matmul_precision(precision):
+                logits, cache, h = model_base.decode_forward(
+                    params, args, tree_tokens, positions, cache, decode_bucket,
+                    mesh=mesh, rules=rules, tree=(depths, ancestor),
+                    return_hidden=True)
+                target = jnp.argmax(logits, axis=-1).astype(jnp.int32)     # (B, N)
+                topk = _head_topk(medusa_params, h, kb)                    # (B,N,M,kb)
+            return target, topk, cache
+
+        self._prefill_step = jax.jit(_prefill, donate_argnums=(5,))
+        self._verify_step = jax.jit(_verify, donate_argnums=(4,),
+                                    static_argnames=("decode_bucket",))
+        self._compact_step = jax.jit(kvcache.compact_decode_slots,
+                                     donate_argnums=(0,))
+
+    # ------------------------------------------------------------------ generate
+    def generate(
+        self,
+        input_ids: np.ndarray,
+        attention_mask: Optional[np.ndarray] = None,
+        max_new_tokens: int = 32,
+        eos_token_id: Optional[int] = None,
+        pad_token_id: int = 0,
+    ) -> SpecGenerateOutput:
+        app, tree = self.app, self.tree
+        cfg = app.tpu_config
+        if app.params is None:
+            raise RuntimeError("load base weights before generate")
+        if self.medusa_params is None:
+            raise RuntimeError("load medusa heads before generate")
+        input_ids = model_wrapper.to_int32(input_ids)
+        b = input_ids.shape[0]
+        compiled_b = cfg.max_batch_size
+        n_nodes = tree.num_nodes
+        max_commit = tree.max_depth + 1      # accepted path + bonus per step
+
+        padded = model_wrapper.pad_prefill_inputs(
+            input_ids, attention_mask, app.cte_buckets, pad_token_id=pad_token_id,
+            batch_size=compiled_b)
+        app.reset_cache()
+
+        t_start = time.perf_counter()
+        root_dev, topk_dev, app.kv_cache = self._prefill_step(
+            app.params, self.medusa_params, padded.input_ids, padded.position_ids,
+            padded.last_token_idx, app.kv_cache)
+        root = np.asarray(root_dev).copy()   # (B,)
+        topk = np.asarray(topk_dev).copy()   # (B, M, kb)
+        ttft = time.perf_counter() - t_start
+
+        committed: List[List[int]] = [[int(root[i])] for i in range(b)]
+        done = np.zeros((compiled_b,), dtype=bool)
+        done[b:] = True
+        if eos_token_id is not None:
+            done[:b] |= root[:b] == eos_token_id
+        positions = padded.true_lengths.astype(np.int32).copy()
+        accept_hist = np.zeros((max_commit,), dtype=np.int64)
+        steps = 0
+
+        while not all(len(c) >= max_new_tokens or done[i]
+                      for i, c in enumerate(committed)):
+            max_pos = int(positions.max())
+            if max_pos + n_nodes >= cfg.seq_len:
+                break
+            tree_tokens = tree.assemble_tokens(root, topk)           # (B, N)
+            bucket = autobucketing.select_bucket(app.tkg_buckets, max_pos + n_nodes)
+            target_dev, topk_all_dev, app.kv_cache = self._verify_step(
+                app.params, self.medusa_params, jnp.asarray(tree_tokens),
+                jnp.asarray(positions), app.kv_cache, decode_bucket=bucket)
+            target = np.asarray(target_dev)          # (B, N)
+            topk_all = np.asarray(topk_all_dev)      # (B, N, M, kb)
+            steps += 1
+
+            # host-side tree walk + KV compaction indices; dst row j receives the
+            # j-th kept node (root stays at its slot, accepted nodes pack after it)
+            src_slots = np.zeros((compiled_b, max_commit), dtype=np.int32)
+            dst_start = positions.copy()             # pre-update root slot per row
+            for i in range(compiled_b):
+                if done[i]:
+                    src_slots[i, :] = positions[i]   # harmless self-copy
+                    continue
+                accepted, bonus = tree.walk_accept(tree_tokens[i], target[i])
+                take_nodes = [0] + accepted          # root stays in place
+                for j in range(max_commit):
+                    src_slots[i, j] = positions[i] + (
+                        take_nodes[j] if j < len(take_nodes) else take_nodes[-1])
+                n_acc = len(accepted)
+                if i < b:
+                    accept_hist[n_acc] += 1
+                    step_toks = [int(tree_tokens[i, a]) for a in accepted] + [bonus]
+                    done[i] = commit_row(committed[i], step_toks, eos_token_id,
+                                         max_new_tokens)
+                    if not done[i]:
+                        last_node = accepted[-1] if accepted else 0
+                        topk[i] = topk_all[i, last_node]
+                        root[i] = bonus
+                        positions[i] += n_acc + 1
+            app.kv_cache = self._compact_step(
+                app.kv_cache, jnp.asarray(src_slots), jnp.asarray(dst_start))
+
+        return assemble_spec_output(committed, padded, b, pad_token_id, accept_hist,
+                                    steps, ttft)

@@ -53,6 +53,44 @@ class SpecGenerateOutput:
     ttft_s: Optional[float] = None
 
 
+def commit_row(committed_i: List[int], toks, eos_token_id: Optional[int],
+               max_new_tokens: int) -> bool:
+    """Append a step's committed tokens to one row; True if the row is now done.
+
+    Shared by every speculative runtime (fused / EAGLE / Medusa): stops at
+    max_new_tokens or at the first EOS (which is kept as the row's last token).
+    """
+    for t in toks:
+        if len(committed_i) >= max_new_tokens:
+            return True
+        committed_i.append(int(t))
+        if eos_token_id is not None and int(t) == eos_token_id:
+            return True
+    return len(committed_i) >= max_new_tokens
+
+
+def assemble_spec_output(committed: List[List[int]], padded, b: int,
+                         pad_token_id: int, accept_hist: np.ndarray, steps: int,
+                         ttft: Optional[float]) -> SpecGenerateOutput:
+    """Pack per-row committed token lists into the SpecGenerateOutput arrays."""
+    num_gen = np.array([len(c) for c in committed], dtype=np.int32)
+    width = int(num_gen.max()) if b else 0
+    tokens = np.full((b, width), pad_token_id, dtype=np.int32)
+    for i in range(b):
+        tokens[i, : num_gen[i]] = committed[i]
+    prompt_lens = padded.true_lengths[:b]
+    max_len = (int(prompt_lens.max()) if b else 0) + width
+    sequences = np.full((b, max_len), pad_token_id, dtype=np.int32)
+    for i in range(b):
+        pl = int(prompt_lens[i])
+        sequences[i, :pl] = padded.input_ids[i, :pl]
+        sequences[i, pl : pl + num_gen[i]] = committed[i]
+    return SpecGenerateOutput(sequences=sequences, tokens=tokens,
+                              num_generated=num_gen,
+                              acceptance_counts=accept_hist, steps=steps,
+                              ttft_s=ttft)
+
+
 class FusedSpeculativeModel:
     """Owns a target and a draft `TpuModelForCausalLM` and runs fused spec decode.
 
@@ -265,34 +303,12 @@ class FusedSpeculativeModel:
                     continue
                 take = int(n[i]) + 1
                 accept_hist[take - 1] += 1
-                for j in range(take):
-                    if len(committed[i]) >= max_new_tokens:
-                        break
-                    t = int(out[i, j])
-                    committed[i].append(t)
-                    if eos_token_id is not None and t == eos_token_id:
-                        done[i] = True
-                        break
-                if not done[i] and len(committed[i]) >= max_new_tokens:
-                    done[i] = True
+                done[i] = commit_row(committed[i], out[i, :take], eos_token_id,
+                                     max_new_tokens)
                 if not done[i]:
                     positions[i] += take
                     last_tok[i] = out[i, take - 1]
             # frozen rows re-step harmlessly at their last position
 
-        num_gen = np.array([len(c) for c in committed], dtype=np.int32)
-        width = int(num_gen.max()) if b else 0
-        tokens = np.full((b, width), pad_token_id, dtype=np.int32)
-        for i in range(b):
-            tokens[i, : num_gen[i]] = committed[i]
-        prompt_lens = padded.true_lengths[:b]
-        max_len = int(prompt_lens.max()) + width
-        sequences = np.full((b, max_len), pad_token_id, dtype=np.int32)
-        for i in range(b):
-            pl = int(prompt_lens[i])
-            sequences[i, :pl] = padded.input_ids[i, :pl]
-            sequences[i, pl : pl + num_gen[i]] = committed[i]
-        return SpecGenerateOutput(sequences=sequences, tokens=tokens,
-                                  num_generated=num_gen,
-                                  acceptance_counts=accept_hist, steps=steps,
-                                  ttft_s=ttft)
+        return assemble_spec_output(committed, padded, b, pad_token_id, accept_hist,
+                                    steps, ttft)

@@ -1,0 +1,173 @@
+"""Medusa + EAGLE + token-tree tests.
+
+Exactness property (same as fused spec): greedy tree/chain speculation commits only
+tokens that are the target's argmax in context, so output must equal the base model's
+plain greedy decode regardless of head/draft quality.
+"""
+
+import numpy as np
+import pytest
+
+from neuronx_distributed_inference_tpu.config import (
+    OnDeviceSamplingConfig, TpuConfig, load_pretrained_config)
+from neuronx_distributed_inference_tpu.models.llama.modeling_llama import (
+    LlamaForCausalLM, LlamaInferenceConfig)
+from neuronx_distributed_inference_tpu.modules.token_tree import (
+    DEFAULT_TREE_PATHS, TokenTree)
+from neuronx_distributed_inference_tpu.runtime.eagle import (
+    EagleSpeculativeModel, draft_args_from_target)
+from neuronx_distributed_inference_tpu.runtime.medusa import MedusaModel
+
+
+def _make_app(hf_cfg, seed, batch=2):
+    tpu_cfg = TpuConfig(
+        batch_size=batch, seq_len=128, max_context_length=32, dtype="float32",
+        context_encoding_buckets=[16, 32], token_generation_buckets=[64, 128],
+        on_device_sampling_config=OnDeviceSamplingConfig(),
+    )
+    config = LlamaInferenceConfig(tpu_cfg, load_config=load_pretrained_config(hf_cfg))
+    app = LlamaForCausalLM(None, config)
+    app.load_random(seed=seed)
+    return app
+
+
+# ------------------------------------------------------------------ token tree
+class TestTokenTree:
+    def test_structure(self):
+        tree = TokenTree.from_paths(DEFAULT_TREE_PATHS)
+        assert tree.num_nodes == len(DEFAULT_TREE_PATHS) + 1
+        assert tree.depths[0] == 0 and tree.parents[0] == -1
+        assert tree.max_depth == 4
+        assert tree.max_branch == 4
+        # every node's ancestor closure includes the root and itself
+        assert tree.ancestor_mask[:, 0].all()
+        assert np.diag(tree.ancestor_mask).all()
+        # chain (0,0,0,0): depth-4 node has exactly 5 visible ancestors
+        deep = int(np.nonzero(tree.depths == 4)[0][0])
+        assert tree.ancestor_mask[deep].sum() == 5
+
+    def test_missing_parent_rejected(self):
+        with pytest.raises(ValueError, match="missing parent"):
+            TokenTree.from_paths([(0, 0)])
+
+    def test_walk_accept(self):
+        tree = TokenTree.from_paths([(0,), (1,), (0, 0)])
+        # nodes: 0=root, 1=(0,), 2=(1,), 3=(0,0)
+        node_tokens = np.array([7, 10, 11, 12])
+        # target at root says 10 -> accept node 1; at node 1 says 12 -> accept node 3;
+        # at node 3 says 99 -> bonus
+        target = np.array([10, 12, 55, 99])
+        accepted, bonus = tree.walk_accept(node_tokens, target)
+        assert accepted == [1, 3]
+        assert bonus == 99
+        # no match at root -> bonus only
+        accepted, bonus = tree.walk_accept(node_tokens, np.array([42, 0, 0, 0]))
+        assert accepted == [] and bonus == 42
+
+
+# ------------------------------------------------------------------ medusa
+class TestMedusa:
+    @pytest.fixture(scope="class")
+    def app(self, tiny_llama_hf_config):
+        return _make_app(tiny_llama_hf_config, seed=0)
+
+    def test_random_heads_match_plain_greedy(self, app):
+        medusa = MedusaModel(app, num_medusa_heads=4)
+        medusa.load_random_heads(seed=1)
+        rng = np.random.default_rng(0)
+        input_ids = rng.integers(1, 256, size=(2, 12)).astype(np.int32)
+        ref = app.generate(input_ids, max_new_tokens=20)
+        out = medusa.generate(input_ids, max_new_tokens=20)
+        np.testing.assert_array_equal(out.tokens, ref.tokens)
+        assert out.num_generated.tolist() == [20, 20]
+
+    def test_eos_stops(self, app):
+        medusa = MedusaModel(app, num_medusa_heads=4)
+        medusa.load_random_heads(seed=1)
+        rng = np.random.default_rng(3)
+        input_ids = rng.integers(1, 256, size=(2, 8)).astype(np.int32)
+        probe = medusa.generate(input_ids, max_new_tokens=8)
+        eos = int(probe.tokens[0, 3])
+        out = medusa.generate(input_ids, max_new_tokens=8, eos_token_id=eos)
+        row = out.tokens[0, : out.num_generated[0]]
+        hits = np.nonzero(row == eos)[0]
+        if hits.size:
+            assert hits[0] == out.num_generated[0] - 1
+
+    def test_head_conversion_roundtrip(self, app):
+        from neuronx_distributed_inference_tpu.runtime.medusa import (
+            convert_medusa_state_dict)
+
+        h, v = 64, 256
+        rng = np.random.default_rng(0)
+        sd = {}
+        for i in range(2):
+            sd[f"medusa_head.{i}.0.linear.weight"] = rng.normal(
+                size=(h, h)).astype(np.float32)
+            sd[f"medusa_head.{i}.0.linear.bias"] = rng.normal(
+                size=(h,)).astype(np.float32)
+            sd[f"medusa_head.{i}.1.weight"] = rng.normal(
+                size=(v, h)).astype(np.float32)
+        out = convert_medusa_state_dict(sd, 2)
+        assert out["w"].shape == (2, h, h)
+        assert out["out"].shape == (2, h, v)
+        np.testing.assert_allclose(
+            out["out"][1], sd["medusa_head.1.1.weight"].T)
+
+
+# ------------------------------------------------------------------ eagle
+class TestEagle:
+    @pytest.fixture(scope="class")
+    def target(self, tiny_llama_hf_config):
+        return _make_app(tiny_llama_hf_config, seed=0)
+
+    def test_random_draft_matches_plain_greedy(self, target):
+        d_args = draft_args_from_target(target.arch_args, num_layers=1)
+        spec = EagleSpeculativeModel(target, d_args, speculation_length=4)
+        spec.load_random_draft(seed=5)
+        rng = np.random.default_rng(1)
+        input_ids = rng.integers(1, 256, size=(2, 10)).astype(np.int32)
+        ref = target.generate(input_ids, max_new_tokens=20)
+        out = spec.generate(input_ids, max_new_tokens=20)
+        np.testing.assert_array_equal(out.tokens, ref.tokens)
+        assert out.acceptance_counts.sum() >= out.steps
+
+    def test_hidden_size_mismatch_rejected(self, target):
+        import dataclasses
+
+        d_args = dataclasses.replace(
+            draft_args_from_target(target.arch_args), hidden_size=32)
+        with pytest.raises(ValueError, match="hidden size"):
+            EagleSpeculativeModel(target, d_args, speculation_length=4)
+
+    def test_draft_conversion(self, target):
+        """llama-style EAGLE checkpoint converts to the draft pytree layout."""
+        from neuronx_distributed_inference_tpu.models.eagle import (
+            convert_eagle_state_dict)
+
+        cfg = target.config
+        h, inter, d = 64, 128, 16
+        n_q, n_kv = 4, 2
+        rng = np.random.default_rng(0)
+
+        def w(shape):
+            return rng.normal(size=shape).astype(np.float32)
+
+        sd = {
+            "fc.weight": w((h, 2 * h)),
+            "layers.0.post_attention_layernorm.weight": np.ones(h, np.float32),
+            "layers.0.self_attn.q_proj.weight": w((n_q * d, h)),
+            "layers.0.self_attn.k_proj.weight": w((n_kv * d, h)),
+            "layers.0.self_attn.v_proj.weight": w((n_kv * d, h)),
+            "layers.0.self_attn.o_proj.weight": w((h, n_q * d)),
+            "layers.0.mlp.gate_proj.weight": w((inter, h)),
+            "layers.0.mlp.up_proj.weight": w((inter, h)),
+            "layers.0.mlp.down_proj.weight": w((h, inter)),
+        }
+        d_args = draft_args_from_target(target.arch_args, num_layers=1)
+        params = convert_eagle_state_dict(
+            sd, d_args, target.inv_freq_from_config(cfg))
+        assert params["fc"].shape == (2 * h, h)
+        assert params["layers"]["wq"].shape == (1, h, n_q * d)
+        # missing input_layernorm -> identity norm
+        np.testing.assert_array_equal(params["layers"]["ln1"][0], np.ones(h))
