@@ -11,6 +11,7 @@ _REGISTRY: Dict[str, str] = {
     "gemma3_text": "neuronx_distributed_inference_tpu.models.gemma3.modeling_gemma3:Gemma3ForCausalLM",
     "mixtral": "neuronx_distributed_inference_tpu.models.mixtral.modeling_mixtral:MixtralForCausalLM",
     "qwen3_moe": "neuronx_distributed_inference_tpu.models.qwen3_moe.modeling_qwen3_moe:Qwen3MoeForCausalLM",
+    "gpt_oss": "neuronx_distributed_inference_tpu.models.gpt_oss.modeling_gpt_oss:GptOssForCausalLM",
 }
 
 

@@ -57,6 +57,8 @@ class ModelArchArgs:
     rms_norm_eps: float = 1e-6
     activation: str = "silu"
     attention_bias: bool = False
+    o_bias: bool = False                  # bias on the attention output projection
+    attn_sinks: bool = False              # gpt-oss learned per-head attention sinks
     mlp_bias: bool = False
     qk_norm: bool = False                 # qwen3-style per-head RMSNorm on q/k
     sliding_window: Optional[int] = None  # gemma/gpt-oss SWA (applied to all layers if set)
@@ -72,6 +74,9 @@ class ModelArchArgs:
     embedding_multiplier: float = 1.0     # gemma scales embeddings by sqrt(hidden)
     tie_word_embeddings: bool = False
     rope_attention_scaling: float = 1.0   # HF rope_scaling attention_factor
+    # cos/sin magnitude for sliding layers under a layer_pattern (gpt-oss shares the
+    # yarn factor across both layer kinds; gemma3's local rope is unscaled)
+    local_rope_attention_scaling: float = 1.0
     # MoE FFN (Mixtral/Qwen3-MoE/DBRX); None = dense MLP. See ops/moe.py.
     moe: Optional["MoEArgs"] = None
     # static multi-LoRA serving (see modules/lora.py); None = disabled
@@ -103,6 +108,14 @@ def param_logical_axes(args: ModelArchArgs) -> Params:
             "wu": ("layers", "experts", "embed", "expert_mlp"),
             "wd": ("layers", "experts", "expert_mlp", "embed"),
         })
+        if args.moe.router_bias:
+            layer["router_b"] = ("layers", None)
+        if args.moe.expert_bias:
+            layer.update({
+                "bg": ("layers", "experts", "expert_mlp"),
+                "bu": ("layers", "experts", "expert_mlp"),
+                "bd": ("layers", "experts", None),
+            })
         if args.moe.shared_expert_intermediate_size:
             layer.update({
                 "shared_wg": ("layers", "embed", "mlp"),
@@ -122,6 +135,10 @@ def param_logical_axes(args: ModelArchArgs) -> Params:
             "bk": ("layers", "kv_heads"),
             "bv": ("layers", "kv_heads"),
         })
+    if args.o_bias:
+        layer["bo"] = ("layers", None)
+    if args.attn_sinks:
+        layer["sinks"] = ("layers", "heads")
     if args.qk_norm:
         layer.update({"q_norm": ("layers", None), "k_norm": ("layers", None)})
     if args.sandwich_norms:
@@ -169,6 +186,14 @@ def init_params(args: ModelArchArgs, key: jax.Array, dtype=jnp.bfloat16,
             "wu": w(ks[5], (L, E, H, I)),
             "wd": w(ks[6], (L, E, I, H)),
         })
+        if args.moe.router_bias:
+            layers["router_b"] = jnp.zeros((L, E), dtype=dtype)
+        if args.moe.expert_bias:
+            layers.update({
+                "bg": jnp.zeros((L, E, I), dtype=dtype),
+                "bu": jnp.zeros((L, E, I), dtype=dtype),
+                "bd": jnp.zeros((L, E, H), dtype=dtype),
+            })
         shared_i = args.moe.shared_expert_intermediate_size
         if shared_i:
             layers.update({
@@ -189,6 +214,10 @@ def init_params(args: ModelArchArgs, key: jax.Array, dtype=jnp.bfloat16,
             "bk": jnp.zeros((L, args.kv_size), dtype=dtype),
             "bv": jnp.zeros((L, args.kv_size), dtype=dtype),
         })
+    if args.o_bias:
+        layers["bo"] = jnp.zeros((L, H), dtype=dtype)
+    if args.attn_sinks:
+        layers["sinks"] = jnp.zeros((L, args.num_heads), dtype=dtype)
     if args.lora is not None:
         from ..modules.lora import init_lora_params
 
@@ -317,7 +346,6 @@ def _decoder_layer(
     decode_bucket: Optional[int],      # static; None for prefill (attend over fresh k/v)
     mesh,
     rules=None,
-    sinks: Optional[jnp.ndarray] = None,
     use_flash: bool = False,
     paged: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,  # (block_table, slot_mapping)
     cache_batch_start=0,
@@ -371,11 +399,14 @@ def _decoder_layer(
         attn = _sharded_flash_attention(q, k_att, v_att, args, mesh, rules)
     else:
         attn = attend(q, k_att, v_att, mask=mask, scale=args.attention_scale,
-                      logits_soft_cap=args.logits_soft_cap, sinks=sinks)
+                      logits_soft_cap=args.logits_soft_cap,
+                      sinks=lp.get("sinks") if args.attn_sinks else None)
     attn = attn.transpose(0, 2, 1, 3).reshape(h.shape[0], h.shape[1], args.q_size)
     attn_out = qapply(attn, lp["wo"])
     if args.lora is not None:
         attn_out = apply_lora(lp, "wo", attn, attn_out, adapter_ids, args.lora.scaling)
+    if args.o_bias:
+        attn_out = attn_out + lp["bo"]
     attn_out = constrain(attn_out, ("batch", None, None), rules, mesh=mesh)
     if args.sandwich_norms:
         attn_out = rms_norm(attn_out, lp["ln1_post"], args.rms_norm_eps,
@@ -486,7 +517,8 @@ def prefill_forward(
     local_rope_mask = None
     if args.layer_pattern is not None:
         inv_local = params.get("rope_inv_freq_local", params["rope_inv_freq"])
-        cos_l, sin_l = rope_ops.compute_cos_sin(inv_local, position_ids)
+        cos_l, sin_l = rope_ops.compute_cos_sin(inv_local, position_ids,
+                                                args.local_rope_attention_scaling)
         local_rope_mask = (cos_l, sin_l, sliding if sliding is not None else mask)
     elif sliding is not None:
         mask = sliding
@@ -574,7 +606,8 @@ def decode_forward(
     local_rope_mask = None
     if args.layer_pattern is not None:
         inv_local = params.get("rope_inv_freq_local", params["rope_inv_freq"])
-        cos_l, sin_l = rope_ops.compute_cos_sin(inv_local, pos_grid)
+        cos_l, sin_l = rope_ops.compute_cos_sin(inv_local, pos_grid,
+                                                args.local_rope_attention_scaling)
         local_rope_mask = (cos_l, sin_l, sliding if sliding is not None else mask)
     elif sliding is not None:
         mask = sliding

@@ -1,0 +1,1 @@
+from .modeling_gpt_oss import GptOssForCausalLM, GptOssInferenceConfig  # noqa: F401

@@ -1,0 +1,167 @@
+"""GPT-OSS model family (OpenAI open-weight MoE).
+
+≈ reference `models/gpt_oss/modeling_gpt_oss.py` (1217 LoC) + its MXFP4 layout
+transform (767 LoC). Architecture deltas vs Llama, expressed through ModelArchArgs so
+the shared functional core (`models/base.py`) runs them in one `lax.scan`:
+
+- learned per-head **attention sinks**: an extra logit per head joins the softmax
+  denominator only (`ops/attention.attend` sinks path);
+- **alternating sliding/full attention layers** from HF ``layer_types`` (same RoPE for
+  both kinds — ``layer_pattern`` without a local theta);
+- biases on q/k/v/o projections, the router, and the expert MLPs;
+- MoE with **top-k-then-softmax routing** and the clamped-swiglu expert activation
+  (gate/up clipped at ±limit, act = gate·σ(1.702·gate), out = (up+1)·act);
+- YaRN RoPE with the attention magnitude factor applied to both layer kinds.
+
+Checkpoint ingest accepts both bf16 (``gate_up_proj``) and MXFP4 checkpoints
+(``gate_up_proj_blocks``/``_scales``, dequantized on host via
+`ops/quantization.dequant_mxfp4`); HF stores gate/up interleaved along the last dim.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from ...modules import gqa
+from ...ops.moe import MoEArgs
+from ...ops import rope as rope_ops
+from ...ops.quantization import dequant_mxfp4
+from ..base import ModelArchArgs
+from ..llama.modeling_llama import LlamaForCausalLM, LlamaInferenceConfig
+
+
+class GptOssInferenceConfig(LlamaInferenceConfig):
+    REQUIRED_ATTRIBUTES = LlamaInferenceConfig.REQUIRED_ATTRIBUTES + (
+        "num_local_experts", "num_experts_per_tok")
+
+    def add_derived_config(self) -> None:
+        super().add_derived_config()
+        for attr, default in (
+                ("sliding_window", 128),
+                ("layer_types", None),
+                ("attention_bias", True),
+                ("swiglu_limit", 7.0),
+        ):
+            if not hasattr(self, attr) or getattr(self, attr) is None:
+                setattr(self, attr, default)
+
+    def layer_pattern(self):
+        if self.layer_types is not None:
+            return tuple("sliding" if t == "sliding_attention" else "full"
+                         for t in self.layer_types)
+        # HF default: even layers sliding, odd layers full
+        return tuple("sliding" if i % 2 == 0 else "full"
+                     for i in range(self.num_hidden_layers))
+
+
+class GptOssForCausalLM(LlamaForCausalLM):
+    """≈ NeuronGptOssForCausalLM."""
+
+    @classmethod
+    def get_config_cls(cls):
+        return GptOssInferenceConfig
+
+    @classmethod
+    def arch_args_from_config(cls, config: GptOssInferenceConfig) -> ModelArchArgs:
+        tp = config.tpu_config.tp_degree
+        attention_scaling = rope_ops.attention_scaling_from_hf_config(
+            config.rope_scaling)
+        return ModelArchArgs(
+            vocab_size=config.vocab_size,
+            hidden_size=config.hidden_size,
+            num_layers=config.num_hidden_layers,
+            num_heads=config.num_attention_heads,
+            num_kv_heads=gqa.effective_kv_heads(tp, config.num_key_value_heads),
+            head_dim=config.head_dim,
+            intermediate_size=config.intermediate_size,
+            rms_norm_eps=config.rms_norm_eps,
+            attention_bias=config.attention_bias,
+            o_bias=config.attention_bias,
+            attn_sinks=True,
+            sliding_window=config.sliding_window,
+            layer_pattern=config.layer_pattern(),
+            rope_attention_scaling=attention_scaling,
+            local_rope_attention_scaling=attention_scaling,
+            tie_word_embeddings=config.tie_word_embeddings,
+            moe=MoEArgs(
+                num_experts=config.num_local_experts,
+                experts_per_tok=config.num_experts_per_tok,
+                router_mode="topk_softmax",
+                router_bias=True,
+                expert_bias=True,
+                swiglu_limit=config.swiglu_limit,
+            ),
+        )
+
+    @classmethod
+    def convert_hf_state_dict(cls, state_dict: Dict[str, np.ndarray],
+                              config: GptOssInferenceConfig) -> Dict:
+        args = cls.arch_args_from_config(config)
+        L = config.num_hidden_layers
+        n_kv = config.num_key_value_heads
+        d = config.head_dim
+        factor = args.num_kv_heads // n_kv
+
+        def get(name):
+            if name not in state_dict:
+                raise KeyError(f"missing weight {name}")
+            return np.asarray(state_dict[name])
+
+        def linear_t(name):
+            return np.ascontiguousarray(get(name).T)
+
+        def expert_weight(prefix):
+            """(E, in, out) expert tensor from bf16 or MXFP4-packed checkpoint keys.
+
+            MXFP4 stores (E, out, in/32, 16) blocks — dequant yields (E, out, in),
+            transposed here to the (E, in, out) matmul layout."""
+            if prefix in state_dict:
+                return get(prefix).astype(np.float32)
+            blocks, scales = get(prefix + "_blocks"), get(prefix + "_scales")
+            deq = dequant_mxfp4(blocks, scales)        # (E, out, in)
+            return np.ascontiguousarray(deq.transpose(0, 2, 1))
+
+        layers = {k: [] for k in ("ln1", "wq", "wk", "wv", "wo", "ln2",
+                                  "bq", "bk", "bv", "bo", "sinks",
+                                  "router", "router_b",
+                                  "wg", "wu", "wd", "bg", "bu", "bd")}
+        for i in range(L):
+            p = f"model.layers.{i}."
+            layers["ln1"].append(get(p + "input_layernorm.weight"))
+            layers["wq"].append(linear_t(p + "self_attn.q_proj.weight"))
+            layers["wk"].append(gqa.replicate_kv_weight(
+                linear_t(p + "self_attn.k_proj.weight"), n_kv, d, factor))
+            layers["wv"].append(gqa.replicate_kv_weight(
+                linear_t(p + "self_attn.v_proj.weight"), n_kv, d, factor))
+            layers["wo"].append(linear_t(p + "self_attn.o_proj.weight"))
+            layers["bq"].append(get(p + "self_attn.q_proj.bias"))
+            layers["bk"].append(gqa.replicate_kv_bias(
+                get(p + "self_attn.k_proj.bias"), n_kv, d, factor))
+            layers["bv"].append(gqa.replicate_kv_bias(
+                get(p + "self_attn.v_proj.bias"), n_kv, d, factor))
+            layers["bo"].append(get(p + "self_attn.o_proj.bias"))
+            layers["sinks"].append(get(p + "self_attn.sinks"))
+            layers["ln2"].append(get(p + "post_attention_layernorm.weight"))
+            m = p + "mlp."
+            layers["router"].append(linear_t(m + "router.weight"))
+            layers["router_b"].append(get(m + "router.bias"))
+            gate_up = expert_weight(m + "experts.gate_up_proj")        # (E, H, 2I)
+            layers["wg"].append(np.ascontiguousarray(gate_up[..., 0::2]))
+            layers["wu"].append(np.ascontiguousarray(gate_up[..., 1::2]))
+            gub = get(m + "experts.gate_up_proj_bias")                 # (E, 2I)
+            layers["bg"].append(np.ascontiguousarray(gub[..., 0::2]))
+            layers["bu"].append(np.ascontiguousarray(gub[..., 1::2]))
+            layers["wd"].append(expert_weight(m + "experts.down_proj"))
+            layers["bd"].append(get(m + "experts.down_proj_bias"))
+
+        params = {
+            "embed": get("model.embed_tokens.weight"),
+            "layers": {k: np.stack(v) for k, v in layers.items()},
+            "final_norm": get("model.norm.weight"),
+            "rope_inv_freq": cls.inv_freq_from_config(config),
+        }
+        if not args.tie_word_embeddings:
+            params["lm_head"] = np.ascontiguousarray(get("lm_head.weight").T)
+        return params

@@ -57,6 +57,8 @@ class LlamaForCausalLM(TpuModelForCausalLM):
             rms_norm_eps=config.rms_norm_eps,
             activation=config.hidden_act,
             attention_bias=config.attention_bias,
+            rope_attention_scaling=rope_ops.attention_scaling_from_hf_config(
+                config.rope_scaling),
             tie_word_embeddings=config.tie_word_embeddings,
         )
 

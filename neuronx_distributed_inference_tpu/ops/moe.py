@@ -42,20 +42,39 @@ class MoEArgs:
     # qwen-style shared expert running densely alongside the routed experts, with a
     # sigmoid gate projected from the hidden state (0 = disabled)
     shared_expert_intermediate_size: int = 0
+    # routing order: "softmax_topk" (Mixtral/Qwen: softmax over all experts, then
+    # top-k) or "topk_softmax" (gpt-oss: top-k of raw logits, softmax over the k)
+    router_mode: str = "softmax_topk"
+    router_bias: bool = False            # router logits get a learned bias (gpt-oss)
+    expert_bias: bool = False            # expert MLPs have biases (gpt-oss)
+    # gpt-oss clamped glu: gate/up clipped at ±limit, act = gate·σ(α·gate), out =
+    # (up+1)·act — replaces the standard activation(gate)·up when set
+    swiglu_limit: Optional[float] = None
+    swiglu_alpha: float = 1.702
 
 
-def route(router_w: jnp.ndarray, x: jnp.ndarray, moe: MoEArgs) -> jnp.ndarray:
+def route(router_w: jnp.ndarray, x: jnp.ndarray, moe: MoEArgs,
+          router_b: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """Top-k routing gates.
 
     x: (N, H) tokens; router_w: (H, E). Returns dense gates (N, E) float32 with
-    exactly top-k nonzeros per row (softmax over all experts, then top-k, then
-    optional renormalization — matches HF Mixtral/Qwen3-MoE routing).
+    exactly top-k nonzeros per row. ``softmax_topk`` matches HF Mixtral/Qwen3-MoE
+    (softmax over all experts, top-k, optional renorm); ``topk_softmax`` matches HF
+    gpt-oss (top-k of logits, softmax over the selected k).
     """
     logits = x.astype(jnp.float32) @ router_w.astype(jnp.float32)   # (N, E)
-    probs = jax.nn.softmax(logits, axis=-1)
-    top_vals, top_idx = jax.lax.top_k(probs, moe.experts_per_tok)   # (N, k)
-    if moe.norm_topk_prob:
-        top_vals = top_vals / jnp.sum(top_vals, axis=-1, keepdims=True)
+    if router_b is not None:
+        logits = logits + router_b.astype(jnp.float32)
+    if moe.router_mode == "topk_softmax":
+        top_vals, top_idx = jax.lax.top_k(logits, moe.experts_per_tok)
+        top_vals = jax.nn.softmax(top_vals, axis=-1)
+    elif moe.router_mode == "softmax_topk":
+        probs = jax.nn.softmax(logits, axis=-1)
+        top_vals, top_idx = jax.lax.top_k(probs, moe.experts_per_tok)   # (N, k)
+        if moe.norm_topk_prob:
+            top_vals = top_vals / jnp.sum(top_vals, axis=-1, keepdims=True)
+    else:
+        raise ValueError(f"unknown router_mode {moe.router_mode!r}")
     onehot = jax.nn.one_hot(top_idx, moe.num_experts, dtype=jnp.float32)  # (N, k, E)
     return jnp.einsum("nk,nke->ne", top_vals, onehot)
 
@@ -70,14 +89,27 @@ def moe_block(lp, args, hn: jnp.ndarray, mesh, rules,
     moe: MoEArgs = args.moe
     b, s, h = hn.shape
     x = hn.reshape(b * s, h)
-    gates = route(lp["router"], x, moe)                             # (N, E) fp32
+    gates = route(lp["router"], x, moe, lp.get("router_b"))         # (N, E) fp32
 
     # dense all-experts MLP: (E, N, I) intermediates, EP-sharded on E, TP on I
     gate_proj = qeinsum("nh,ehi->eni", x, lp["wg"])
     up_proj = qeinsum("nh,ehi->eni", x, lp["wu"])
-    inter = activation(gate_proj) * up_proj
+    if moe.expert_bias:
+        gate_proj = gate_proj + lp["bg"][:, None, :]
+        up_proj = up_proj + lp["bu"][:, None, :]
+    if moe.swiglu_limit is not None:
+        # gpt-oss clamped glu (`GptOssExperts.forward`): clamp, gate·σ(α·gate), (up+1)·
+        lim = jnp.asarray(moe.swiglu_limit, gate_proj.dtype)
+        gate_proj = jnp.minimum(gate_proj, lim)
+        up_proj = jnp.clip(up_proj, -lim, lim)
+        glu = gate_proj * jax.nn.sigmoid(moe.swiglu_alpha * gate_proj)
+        inter = (up_proj + 1.0) * glu
+    else:
+        inter = activation(gate_proj) * up_proj
     inter = constrain(inter, ("experts", None, "expert_mlp"), rules, mesh=mesh)
     per_expert = qeinsum("eni,eih->enh", inter, lp["wd"])           # (E, N, H)
+    if moe.expert_bias:
+        per_expert = per_expert + lp["bd"][:, None, :]
     out = jnp.einsum("enh,ne->nh", per_expert,
                      gates.astype(per_expert.dtype))                # sum over E: EP psum
     out = constrain(out, ("batch", None), rules, mesh=mesh)

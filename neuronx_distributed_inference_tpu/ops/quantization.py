@@ -105,6 +105,32 @@ def quantize_params(params: Dict[str, Any], weight_dtype: str = "int8",
     return out
 
 
+# OCP MXFP4 (e2m1) code points: 4-bit index -> value. Sign bit high, then 2-bit
+# exponent, 1-bit mantissa (≈ reference gpt_oss MXFP4 layout transform,
+# `models/gpt_oss/` 767 LoC; here a host-side numpy dequant at ingest).
+_MXFP4_VALUES = (0.0, 0.5, 1.0, 1.5, 2.0, 3.0, 4.0, 6.0,
+                 -0.0, -0.5, -1.0, -1.5, -2.0, -3.0, -4.0, -6.0)
+
+
+def dequant_mxfp4(blocks, scales):
+    """Dequantize an OCP MXFP4 tensor on host.
+
+    ``blocks``: uint8 (..., G, B/2) — each byte packs two fp4 values, low nibble
+    first; ``scales``: uint8 (..., G) — shared e8m0 exponent per 32-value block
+    (value = 2^(scale-127)). Returns float32 (..., G*B).
+    """
+    import numpy as np
+
+    blocks = np.asarray(blocks, dtype=np.uint8)
+    scales = np.asarray(scales, dtype=np.uint8)
+    lut = np.asarray(_MXFP4_VALUES, dtype=np.float32)
+    lo = lut[blocks & 0x0F]
+    hi = lut[blocks >> 4]
+    vals = np.stack([lo, hi], axis=-1).reshape(blocks.shape[:-1] + (-1,))
+    exp = np.ldexp(np.float32(1.0), scales.astype(np.int32) - 127)
+    return (vals * exp[..., None]).reshape(blocks.shape[:-2] + (-1,))
+
+
 def quantized_logical_axes(logical: Dict[str, Any], names: Sequence[str]
                            ) -> Dict[str, Any]:
     """Transform a logical-axes tree to match a quantized param tree: each quantized

@@ -41,6 +41,65 @@ def llama3_scaled_inv_freq(
     return np.where(is_medium, smoothed, scaled).astype(np.float32)
 
 
+def yarn_inv_freq(
+    head_dim: int,
+    rope_theta: float,
+    factor: float,
+    original_max_position_embeddings: int,
+    beta_fast: float = 32.0,
+    beta_slow: float = 1.0,
+    truncate: bool = True,
+) -> np.ndarray:
+    """YaRN NTK-by-parts frequency interpolation (matches HF `rope_type: yarn`;
+    used by gpt-oss and deepseek). Low frequencies are interpolated by ``factor``,
+    high frequencies extrapolated, with a linear ramp between the correction dims."""
+    dim = head_dim
+
+    def correction_dim(num_rotations: float) -> float:
+        return (dim * math.log(original_max_position_embeddings
+                               / (num_rotations * 2 * math.pi))) / (2 * math.log(rope_theta))
+
+    low = correction_dim(beta_fast)
+    high = correction_dim(beta_slow)
+    if truncate:
+        low, high = math.floor(low), math.ceil(high)
+    low, high = max(low, 0), min(high, dim - 1)
+    if low == high:
+        high += 0.001
+    pos_freqs = rope_theta ** (np.arange(0, dim, 2, dtype=np.float64) / dim)
+    extrapolation = 1.0 / pos_freqs
+    interpolation = 1.0 / (factor * pos_freqs)
+    ramp = np.clip((np.arange(dim // 2, dtype=np.float64) - low) / (high - low), 0, 1)
+    extrapolation_factor = 1 - ramp
+    return (interpolation * (1 - extrapolation_factor)
+            + extrapolation * extrapolation_factor).astype(np.float32)
+
+
+def yarn_mscale(scale: float, mscale: float = 1.0) -> float:
+    """YaRN attention magnitude scaling: 0.1·mscale·ln(s) + 1."""
+    if scale <= 1.0:
+        return 1.0
+    return 0.1 * mscale * math.log(scale) + 1.0
+
+
+def attention_scaling_from_hf_config(rope_scaling) -> float:
+    """The cos/sin magnitude factor HF applies for this rope type (yarn only)."""
+    if rope_scaling is None:
+        return 1.0
+    rtype = rope_scaling.get("rope_type", rope_scaling.get("type", "default"))
+    if rtype != "yarn":
+        return 1.0
+    attention_factor = rope_scaling.get("attention_factor")
+    if attention_factor is not None:
+        return float(attention_factor)
+    factor = rope_scaling.get("factor", 1.0)
+    mscale = rope_scaling.get("mscale")
+    mscale_all_dim = rope_scaling.get("mscale_all_dim")
+    if mscale and mscale_all_dim:
+        return float(yarn_mscale(factor, mscale) / yarn_mscale(factor, mscale_all_dim))
+    return float(yarn_mscale(factor))
+
+
 def inv_freq_from_hf_config(head_dim: int, rope_theta: float, rope_scaling) -> np.ndarray:
     """Build inv_freq from HF config fields (``rope_scaling`` dict or None)."""
     if rope_scaling is None:
@@ -60,6 +119,17 @@ def inv_freq_from_hf_config(head_dim: int, rope_theta: float, rope_scaling) -> n
         )
     if rtype == "linear":
         return default_inv_freq(head_dim, rope_theta) / rope_scaling.get("factor", 1.0)
+    if rtype == "yarn":
+        return yarn_inv_freq(
+            head_dim,
+            rope_theta,
+            factor=rope_scaling.get("factor", 1.0),
+            original_max_position_embeddings=rope_scaling.get(
+                "original_max_position_embeddings", 4096),
+            beta_fast=rope_scaling.get("beta_fast", 32.0),
+            beta_slow=rope_scaling.get("beta_slow", 1.0),
+            truncate=rope_scaling.get("truncate", True),
+        )
     raise NotImplementedError(f"rope_type {rtype!r} not supported yet")
 
 

@@ -239,6 +239,8 @@ class TpuModelForCausalLM:
         unsupported = None
         if a.logits_soft_cap is not None:
             unsupported = "logits_soft_cap"
+        elif a.attn_sinks:
+            unsupported = "attention sinks"
         elif a.layer_pattern is not None:
             # per-layer window/rope selection happens inside the scan; the Pallas
             # kernel's window is static per call, so fall back to the jnp path

@@ -35,7 +35,13 @@ def draft_args_from_target(target_args: ModelArchArgs, num_layers: int = 1,
                            num_heads: Optional[int] = None,
                            num_kv_heads: Optional[int] = None,
                            intermediate_size: Optional[int] = None) -> ModelArchArgs:
-    """Draft geometry: target's hidden/vocab with a shallow stack."""
+    """Draft geometry: target's hidden/vocab with a shallow stack.
+
+    Target-specific arch flags (biases, qk/sandwich norms, sinks, layer patterns)
+    are reset to the llama-style defaults the EAGLE draft checkpoints actually use
+    (`convert_eagle_state_dict` emits only llama-shaped keys); inheriting e.g. a
+    qwen2 target's attention_bias would make the fused step trace look up bias
+    params the draft pytree doesn't have."""
     import dataclasses
 
     return dataclasses.replace(
@@ -45,6 +51,9 @@ def draft_args_from_target(target_args: ModelArchArgs, num_layers: int = 1,
         num_kv_heads=num_kv_heads or target_args.num_kv_heads,
         intermediate_size=intermediate_size or target_args.intermediate_size,
         moe=None, lora=None,
+        attention_bias=False, o_bias=False, attn_sinks=False, qk_norm=False,
+        sandwich_norms=False, zero_centered_norms=False,
+        layer_pattern=None, local_rope_theta=None, sliding_window=None,
     )
 
 

@@ -104,8 +104,111 @@ def test_gemma3_parity():
     _run_parity(Gemma3ForCausalLM, hf, cfg, atol=5e-4)
 
 
+def test_gpt_oss_parity():
+    from transformers import GptOssConfig, GptOssForCausalLM as HFGptOss
+
+    from neuronx_distributed_inference_tpu.models.gpt_oss import GptOssForCausalLM
+
+    cfg = GptOssConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, head_dim=16,
+        num_local_experts=4, num_experts_per_tok=2, sliding_window=8,
+        layer_types=["sliding_attention", "full_attention"],
+        max_position_embeddings=512, rope_theta=150000.0,
+        rope_scaling={"rope_type": "yarn", "factor": 32.0, "beta_fast": 32.0,
+                      "beta_slow": 1.0, "original_max_position_embeddings": 128,
+                      "truncate": False},
+        attention_bias=True, tie_word_embeddings=False)
+    torch.manual_seed(0)
+    hf = HFGptOss(cfg).eval()
+    with torch.no_grad():
+        # randomize sinks and all the biases so their handling is exercised
+        for layer in hf.model.layers:
+            layer.self_attn.sinks.normal_(0, 1.0)
+            for proj in (layer.self_attn.q_proj, layer.self_attn.k_proj,
+                         layer.self_attn.v_proj, layer.self_attn.o_proj):
+                proj.bias.normal_(0, 0.02)
+            layer.mlp.router.bias.normal_(0, 0.1)
+            layer.mlp.experts.gate_up_proj_bias.normal_(0, 0.02)
+            layer.mlp.experts.down_proj_bias.normal_(0, 0.02)
+    _run_parity(GptOssForCausalLM, hf, cfg, atol=1e-3)
+
+
+def test_mxfp4_dequant_roundtrip():
+    """Packed MXFP4 values dequantize to the exact e2m1 grid × e8m0 scale."""
+    import numpy as np
+
+    from neuronx_distributed_inference_tpu.ops.quantization import dequant_mxfp4
+
+    # one block of 32 values: bytes pack (low, high) nibbles in interleaved order
+    codes = np.arange(16, dtype=np.uint8)
+    blocks = (codes[1::2] << 4 | codes[0::2]).reshape(1, 1, 8)
+    blocks = np.concatenate([blocks, blocks], axis=-1)          # (1, 1, 16) = 32 vals
+    scales = np.array([[128]], dtype=np.uint8)                  # 2^(128-127) = 2
+    out = dequant_mxfp4(blocks, scales)
+    grid = np.array([0.0, 0.5, 1.0, 1.5, 2.0, 3.0, 4.0, 6.0,
+                     -0.0, -0.5, -1.0, -1.5, -2.0, -3.0, -4.0, -6.0], np.float32)
+    np.testing.assert_array_equal(out.reshape(-1), np.tile(grid, 2) * 2.0)
+
+
+def test_gpt_oss_mxfp4_checkpoint_ingest():
+    """An MXFP4-packed checkpoint converts to the same pytree as its bf16 twin."""
+    import numpy as np
+
+    from transformers import GptOssConfig, GptOssForCausalLM as HFGptOss
+
+    from neuronx_distributed_inference_tpu.config import (
+        TpuConfig, load_pretrained_config)
+    from neuronx_distributed_inference_tpu.models.gpt_oss import GptOssForCausalLM
+
+    cfg = GptOssConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=32, num_hidden_layers=1,
+        num_attention_heads=2, num_key_value_heads=1, head_dim=16,
+        num_local_experts=2, num_experts_per_tok=1, sliding_window=8,
+        layer_types=["full_attention"], attention_bias=True,
+        tie_word_embeddings=False)
+    torch.manual_seed(0)
+    hf = HFGptOss(cfg).eval()
+    state = {k: v.detach().float().numpy() for k, v in hf.state_dict().items()}
+
+    grid = np.array([0.0, 0.5, 1.0, 1.5, 2.0, 3.0, 4.0, 6.0,
+                     -0.0, -0.5, -1.0, -1.5, -2.0, -3.0, -4.0, -6.0], np.float32)
+
+    def pack(w_out_in, scale_exp):
+        """float (E, out, in) on the grid×2^(scale_exp-127) -> HF blocks/scales."""
+        e, o, i = w_out_in.shape
+        vals = w_out_in.reshape(e, o, i // 32, 32) / 2.0 ** (scale_exp - 127)
+        codes = np.argmin(np.abs(vals[..., None] - grid), axis=-1).astype(np.uint8)
+        # the grid has duplicate 0.0/-0.0; exact values map to their first index
+        blocks = (codes[..., 1::2] << 4 | codes[..., 0::2]).astype(np.uint8)
+        scales = np.full((e, o, i // 32), scale_exp, dtype=np.uint8)
+        return blocks, scales
+
+    rng = np.random.default_rng(0)
+    conv = GptOssForCausalLM.convert_hf_state_dict
+    config = GptOssForCausalLM.get_config_cls()(
+        TpuConfig(batch_size=1, seq_len=32, max_context_length=16, dtype="float32"),
+        load_config=load_pretrained_config(cfg.to_dict()))
+    for key, out_dim in (("gate_up_proj", 64), ("down_proj", 32)):
+        full = f"model.layers.0.mlp.experts.{key}"
+        # (E, in, out) param -> grid values; HF packs the transposed (E, out, in)
+        w = grid[rng.integers(0, 16, size=(2, out_dim, 32))] * 4.0   # scale_exp 129
+        state[full] = np.ascontiguousarray(w.transpose(0, 2, 1))
+    params_bf16 = conv(dict(state), config)
+    for key in ("gate_up_proj", "down_proj"):
+        full = f"model.layers.0.mlp.experts.{key}"
+        blocks, scales = pack(np.ascontiguousarray(
+            state[full].transpose(0, 2, 1)), 129)
+        del state[full]
+        state[full + "_blocks"], state[full + "_scales"] = blocks, scales
+    params_mx = conv(state, config)
+    for name in ("wg", "wu", "wd"):
+        np.testing.assert_array_equal(params_mx["layers"][name],
+                                      params_bf16["layers"][name])
+
+
 def test_registry_resolves_new_models():
     from neuronx_distributed_inference_tpu.models import get_model_cls
 
-    for model_type in ("qwen2", "qwen3", "gemma3", "gemma3_text"):
+    for model_type in ("qwen2", "qwen3", "gemma3", "gemma3_text", "gpt_oss"):
         assert get_model_cls(model_type) is not None
