@@ -479,6 +479,28 @@ class TpuModelForCausalLM:
         # Chunks always run the full chunk_size (trailing excess discarded host-side)
         # so every chunk reuses one compiled graph per bucket — a variable remainder
         # would recompile mid-stream.
+        #
+        # async_mode pipelines the chunk boundary itself (≈ reference 2-deep async
+        # decode, `modules/async_execution.py:190-306`): chunk N+1 is dispatched from
+        # the device-resident last token of chunk N *before* chunk N is synced to host,
+        # so the device never idles waiting for the host to read results. The EOS check
+        # then lags one chunk (the reference likewise drops to sync at boundaries to
+        # keep state consistent); at most one surplus chunk runs and is trimmed here.
+        async_mode = self.tpu_config.async_mode
+        pending = None                   # (toks_dev, logits_dev, steps, t_dispatch)
+        gen_limit = max_new_tokens       # shrunk to the EOS-stop width on early break
+
+        def _sync_chunk(p):
+            toks_dev_p, logits_p, steps_p, t0_p = p
+            toks = np.asarray(toks_dev_p)          # (B, steps); blocks
+            if collect_latency:
+                decode_lat.append((time.perf_counter() - t0_p, steps_p))
+            chunks.append(toks)
+            if return_logits:
+                lc = np.asarray(logits_p)          # (steps, B, V)
+                all_logits.extend(lc[i][:b] for i in range(lc.shape[0]))
+            return toks
+
         while n_done < max_new_tokens:
             max_pos = int(base_positions.max()) + (n_done - 1)
             steps = min(chunk_size, self.tpu_config.seq_len - 1 - max_pos)
@@ -493,23 +515,26 @@ class TpuModelForCausalLM:
                 self.params, last_tok, positions, self.kv_cache, sampling_params, sub,
                 decode_bucket=bucket, num_steps=steps, with_logits=return_logits,
                 adapter_ids=adapter_ids)
-            toks = np.asarray(toks_dev)           # (B, steps); syncs the chunk
-            if collect_latency:
-                decode_lat.append((time.perf_counter() - t0, steps))
-            chunks.append(toks)
-            if return_logits:
-                lc = np.asarray(logits_chunk)     # (steps, B, V)
-                all_logits.extend(lc[i][:b] for i in range(lc.shape[0]))
-            last_tok = toks_dev[:, -1]
+            last_tok = toks_dev[:, -1]             # device-resident; no sync needed
             n_done += steps
-            if eos_token_id is not None:
+            if async_mode:
+                prior, pending = pending, (toks_dev, logits_chunk, steps, t0)
+                toks = _sync_chunk(prior) if prior is not None else None
+            else:
+                toks = _sync_chunk((toks_dev, logits_chunk, steps, t0))
+            if toks is not None and eos_token_id is not None:
                 eos_done |= (toks[:b] == eos_token_id).any(axis=1)
                 if eos_done.all():
+                    # async: the in-flight surplus chunk is synced below but its tokens
+                    # are dropped so both modes stop at the same width
+                    gen_limit = min(gen_limit, sum(c.shape[1] for c in chunks))
                     break
+        if pending is not None:
+            _sync_chunk(pending)
 
-        gen = np.concatenate(chunks, axis=1)[:b, :max_new_tokens]   # (B, T)
+        gen = np.concatenate(chunks, axis=1)[:b, :gen_limit]        # (B, T)
         if return_logits:
-            all_logits = all_logits[:max_new_tokens]
+            all_logits = all_logits[:gen_limit]
         if eos_token_id is not None:
             gen = _mask_after_eos(gen, eos_token_id, pad_token_id)
         seqs = []
