@@ -12,6 +12,8 @@ _REGISTRY: Dict[str, str] = {
     "mixtral": "neuronx_distributed_inference_tpu.models.mixtral.modeling_mixtral:MixtralForCausalLM",
     "qwen3_moe": "neuronx_distributed_inference_tpu.models.qwen3_moe.modeling_qwen3_moe:Qwen3MoeForCausalLM",
     "gpt_oss": "neuronx_distributed_inference_tpu.models.gpt_oss.modeling_gpt_oss:GptOssForCausalLM",
+    "dbrx": "neuronx_distributed_inference_tpu.models.dbrx.modeling_dbrx:DbrxForCausalLM",
+    "deepseek_v3": "neuronx_distributed_inference_tpu.models.deepseek.modeling_deepseek:DeepseekForCausalLM",
 }
 
 

@@ -32,7 +32,7 @@ from ..modules.lora import LoraSpec, apply_lora
 from ..ops import rope as rope_ops
 from ..ops.attention import attend, causal_mask
 from ..ops.moe import MoEArgs, moe_block
-from ..ops.norms import rms_norm
+from ..ops.norms import layer_norm, rms_norm
 from ..ops.quantization import qapply
 from ..parallel.sharding import constrain
 
@@ -56,6 +56,8 @@ class ModelArchArgs:
     intermediate_size: int
     rms_norm_eps: float = 1e-6
     activation: str = "silu"
+    norm_type: str = "rms"                # "rms" | "layer" (DBRX uses bias-free LayerNorm)
+    clip_qkv: Optional[float] = None      # DBRX clamps q/k/v to [-clip, clip]
     attention_bias: bool = False
     o_bias: bool = False                  # bias on the attention output projection
     attn_sinks: bool = False              # gpt-oss learned per-head attention sinks
@@ -110,6 +112,8 @@ def param_logical_axes(args: ModelArchArgs) -> Params:
         })
         if args.moe.router_bias:
             layer["router_b"] = ("layers", None)
+        if args.moe.score_correction_bias:
+            layer["router_cb"] = ("layers", None)
         if args.moe.expert_bias:
             layer.update({
                 "bg": ("layers", "experts", "expert_mlp"),
@@ -121,8 +125,9 @@ def param_logical_axes(args: ModelArchArgs) -> Params:
                 "shared_wg": ("layers", "embed", "mlp"),
                 "shared_wu": ("layers", "embed", "mlp"),
                 "shared_wd": ("layers", "mlp", "embed"),
-                "shared_gate": ("layers", "embed", None),
             })
+            if args.moe.shared_expert_gated:
+                layer["shared_gate"] = ("layers", "embed", None)
     else:
         layer.update({
             "wg": ("layers", "embed", "mlp"),
@@ -188,6 +193,8 @@ def init_params(args: ModelArchArgs, key: jax.Array, dtype=jnp.bfloat16,
         })
         if args.moe.router_bias:
             layers["router_b"] = jnp.zeros((L, E), dtype=dtype)
+        if args.moe.score_correction_bias:
+            layers["router_cb"] = jnp.zeros((L, E), dtype=dtype)
         if args.moe.expert_bias:
             layers.update({
                 "bg": jnp.zeros((L, E, I), dtype=dtype),
@@ -200,8 +207,9 @@ def init_params(args: ModelArchArgs, key: jax.Array, dtype=jnp.bfloat16,
                 "shared_wg": w(ks[10], (L, H, shared_i)),
                 "shared_wu": w(ks[11], (L, H, shared_i)),
                 "shared_wd": w(ks[12], (L, shared_i, H)),
-                "shared_gate": w(ks[13], (L, H, 1)),
             })
+            if args.moe.shared_expert_gated:
+                layers["shared_gate"] = w(ks[13], (L, H, 1))
     else:
         layers.update({
             "wg": w(ks[4], (L, H, I)),
@@ -261,6 +269,14 @@ _ACTIVATIONS = {
 }
 
 
+def _norm(x: jnp.ndarray, weight: jnp.ndarray, args: "ModelArchArgs") -> jnp.ndarray:
+    """Hidden-state norm: RMSNorm by default, bias-free LayerNorm for DBRX."""
+    if args.norm_type == "layer":
+        return layer_norm(x, weight, jnp.zeros_like(weight), eps=args.rms_norm_eps)
+    return rms_norm(x, weight, args.rms_norm_eps,
+                    zero_centered=args.zero_centered_norms)
+
+
 def _project_qkv(lp: Params, args: ModelArchArgs, hn: jnp.ndarray,
                  adapter_ids=None):
     """(B, S, H) -> q (B, nq, S, D), k/v (B, nkv, S, D)."""
@@ -277,6 +293,11 @@ def _project_qkv(lp: Params, args: ModelArchArgs, hn: jnp.ndarray,
         q = q + lp["bq"]
         k = k + lp["bk"]
         v = v + lp["bv"]
+    if args.clip_qkv is not None:
+        clip = jnp.asarray(args.clip_qkv, q.dtype)
+        q = jnp.clip(q, -clip, clip)
+        k = jnp.clip(k, -clip, clip)
+        v = jnp.clip(v, -clip, clip)
     q = q.reshape(b, s, args.num_heads, args.head_dim).transpose(0, 2, 1, 3)
     k = k.reshape(b, s, args.num_kv_heads, args.head_dim).transpose(0, 2, 1, 3)
     v = v.reshape(b, s, args.num_kv_heads, args.head_dim).transpose(0, 2, 1, 3)
@@ -352,9 +373,8 @@ def _decoder_layer(
     adapter_ids: Optional[jnp.ndarray] = None,   # (B,) multi-LoRA slots
     ring_positions: Optional[jnp.ndarray] = None,  # (B, S) positions -> ring attention
 ):
-    zc = args.zero_centered_norms
     resid = h
-    hn = rms_norm(h, lp["ln1"], args.rms_norm_eps, zero_centered=zc)
+    hn = _norm(h, lp["ln1"], args)
     q, k, v = _project_qkv(lp, args, hn, adapter_ids)
     # prefill activations shard along seq over cp (sequence/context parallelism,
     # ≈ SP reduce-scatter + CP seq shards, `model_base.py:1509-1560`); no-op at cp=1
@@ -409,20 +429,18 @@ def _decoder_layer(
         attn_out = attn_out + lp["bo"]
     attn_out = constrain(attn_out, ("batch", None, None), rules, mesh=mesh)
     if args.sandwich_norms:
-        attn_out = rms_norm(attn_out, lp["ln1_post"], args.rms_norm_eps,
-                            zero_centered=zc)
+        attn_out = _norm(attn_out, lp["ln1_post"], args)
     h = resid + attn_out
 
     resid = h
-    hn = rms_norm(h, lp["ln2"], args.rms_norm_eps, zero_centered=zc)
+    hn = _norm(h, lp["ln2"], args)
     if args.moe is not None:
         ffn = moe_block(lp, args, hn, mesh, rules, _ACTIVATIONS[args.activation])
     else:
         ffn = _mlp(lp, args, hn, mesh, rules, adapter_ids)
     mlp_out = constrain(ffn, ("batch", None, None), rules, mesh=mesh)
     if args.sandwich_norms:
-        mlp_out = rms_norm(mlp_out, lp["ln2_post"], args.rms_norm_eps,
-                           zero_centered=zc)
+        mlp_out = _norm(mlp_out, lp["ln2_post"], args)
     h = resid + mlp_out
     return h, k_cache, v_cache
 
@@ -534,8 +552,7 @@ def prefill_forward(
                           paged=paged, cache_batch_start=cache_batch_start,
                           adapter_ids=adapter_ids,
                           ring_positions=position_ids if use_ring else None)
-    h = rms_norm(h, params["final_norm"], args.rms_norm_eps,
-                 zero_centered=args.zero_centered_norms)
+    h = _norm(h, params["final_norm"], args)
     h_last = jnp.take_along_axis(h, last_token_idx[:, None, None], axis=1)[:, 0]
     logits = _lm_head(params, args, h_last, mesh, rules)
     if return_hidden:
@@ -616,8 +633,7 @@ def decode_forward(
                           positions=position_ids, decode_bucket=decode_bucket,
                           mesh=mesh, rules=rules, local_rope_mask=local_rope_mask,
                           paged=paged, adapter_ids=adapter_ids)
-    h = rms_norm(h, params["final_norm"], args.rms_norm_eps,
-                 zero_centered=args.zero_centered_norms)
+    h = _norm(h, params["final_norm"], args)
     logits = _lm_head(params, args, h, mesh, rules)
     if return_hidden:
         return logits, cache, h

@@ -1,0 +1,3 @@
+from .modeling_dbrx import DbrxForCausalLM, DbrxInferenceConfig
+
+__all__ = ["DbrxForCausalLM", "DbrxInferenceConfig"]

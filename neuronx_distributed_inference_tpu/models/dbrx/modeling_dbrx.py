@@ -1,0 +1,137 @@
+"""DBRX (MoE) model family.
+
+≈ reference `models/dbrx/modeling_dbrx.py` (308 LoC: NeuronDbrxForCausalLM; fused Wqkv
++ clip_qkv `:140-162`, 16-expert top-4 MoE ffn `:165-233`, state-dict conversion
+`:51-112`). DBRX specifics vs Llama:
+
+- bias-free **LayerNorm** (not RMSNorm) on every norm site (HF `DbrxNormAttentionNorm`),
+- fused ``Wqkv`` projection with ``clip_qkv`` clamping,
+- router = softmax over all experts then top-k with p-norm renormalization
+  (HF ``moe_normalize_expert_weights``, typically 1),
+- expert weights stored stacked as (E*I, H) blobs (w1/v1 transposed, w2 already (I, H)).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from ...modules import gqa
+from ...ops.moe import MoEArgs
+from ..base import ModelArchArgs
+from ..llama.modeling_llama import LlamaForCausalLM
+from ...config import InferenceConfig
+
+
+class DbrxInferenceConfig(InferenceConfig):
+    REQUIRED_ATTRIBUTES = ("d_model", "n_heads", "n_layers", "vocab_size",
+                           "attn_config", "ffn_config")
+
+    def add_derived_config(self) -> None:
+        # flatten the nested HF attn/ffn sub-configs into the attrs the base uses
+        attn = self.attn_config if isinstance(self.attn_config, dict) else \
+            self.attn_config.to_dict()
+        ffn = self.ffn_config if isinstance(self.ffn_config, dict) else \
+            self.ffn_config.to_dict()
+        self.hidden_size = self.d_model
+        self.num_attention_heads = self.n_heads
+        self.num_hidden_layers = self.n_layers
+        self.num_key_value_heads = attn["kv_n_heads"]
+        self.head_dim = self.d_model // self.n_heads
+        self.rope_theta = attn.get("rope_theta", 10000.0)
+        self.clip_qkv = attn.get("clip_qkv")
+        self.intermediate_size = ffn["ffn_hidden_size"]
+        self.moe_num_experts = ffn["moe_num_experts"]
+        self.moe_top_k = ffn["moe_top_k"]
+        self.moe_normalize_expert_weights = ffn.get("moe_normalize_expert_weights", 1)
+        act = ffn.get("ffn_act_fn") or {}
+        self.hidden_act = act.get("name", "silu")
+        self.tie_word_embeddings = getattr(self, "tie_word_embeddings", False)
+        self.rope_scaling = None
+
+
+class DbrxForCausalLM(LlamaForCausalLM):
+    """≈ NeuronDbrxForCausalLM (`models/dbrx/modeling_dbrx.py:280`)."""
+
+    @classmethod
+    def get_config_cls(cls):
+        return DbrxInferenceConfig
+
+    @classmethod
+    def arch_args_from_config(cls, config: DbrxInferenceConfig) -> ModelArchArgs:
+        tp = config.tpu_config.tp_degree
+        return ModelArchArgs(
+            vocab_size=config.vocab_size,
+            hidden_size=config.hidden_size,
+            num_layers=config.num_hidden_layers,
+            num_heads=config.num_attention_heads,
+            num_kv_heads=gqa.effective_kv_heads(tp, config.num_key_value_heads),
+            head_dim=config.head_dim,
+            intermediate_size=config.intermediate_size,
+            rms_norm_eps=1e-5,               # HF nn.LayerNorm default eps
+            norm_type="layer",
+            clip_qkv=config.clip_qkv,
+            activation=config.hidden_act,
+            tie_word_embeddings=config.tie_word_embeddings,
+            moe=MoEArgs(
+                num_experts=config.moe_num_experts,
+                experts_per_tok=config.moe_top_k,
+                norm_topk_p=(float(config.moe_normalize_expert_weights)
+                             if config.moe_normalize_expert_weights is not None
+                             else None),
+                norm_topk_prob=False,
+            ),
+        )
+
+    @classmethod
+    def convert_hf_state_dict(cls, state_dict: Dict[str, np.ndarray],
+                              config: DbrxInferenceConfig) -> Dict:
+        args = cls.arch_args_from_config(config)
+        L, E, I = (config.num_hidden_layers, config.moe_num_experts,
+                   config.intermediate_size)
+        H = config.hidden_size
+        n_kv, d = config.num_key_value_heads, config.head_dim
+        factor = args.num_kv_heads // n_kv
+        q_size = config.num_attention_heads * d
+
+        def get(name):
+            if name not in state_dict:
+                raise KeyError(f"missing weight {name}")
+            return state_dict[name]
+
+        layers = {k: [] for k in ("ln1", "wq", "wk", "wv", "wo", "ln2",
+                                  "router", "wg", "wu", "wd")}
+        for i in range(L):
+            p = f"transformer.blocks.{i}."
+            layers["ln1"].append(get(p + "norm_attn_norm.norm_1.weight"))
+            # fused Wqkv rows: [q (H); k (kv); v (kv)] (HF DbrxAttention.Wqkv)
+            wqkv = get(p + "norm_attn_norm.attn.Wqkv.weight")
+            wq, wk, wv = (wqkv[:q_size], wqkv[q_size:q_size + n_kv * d],
+                          wqkv[q_size + n_kv * d:])
+            layers["wq"].append(np.ascontiguousarray(wq.T))
+            layers["wk"].append(gqa.replicate_kv_weight(
+                np.ascontiguousarray(wk.T), n_kv, d, factor))
+            layers["wv"].append(gqa.replicate_kv_weight(
+                np.ascontiguousarray(wv.T), n_kv, d, factor))
+            layers["wo"].append(np.ascontiguousarray(
+                get(p + "norm_attn_norm.attn.out_proj.weight").T))
+            layers["ln2"].append(get(p + "norm_attn_norm.norm_2.weight"))
+            layers["router"].append(np.ascontiguousarray(
+                get(p + "ffn.router.layer.weight").T))
+            # w1/v1: (E*I, H) -> (E, H, I); w2: (E*I, H) -> (E, I, H) (already in->out)
+            layers["wg"].append(
+                get(p + "ffn.experts.mlp.w1").reshape(E, I, H).transpose(0, 2, 1))
+            layers["wu"].append(
+                get(p + "ffn.experts.mlp.v1").reshape(E, I, H).transpose(0, 2, 1))
+            layers["wd"].append(get(p + "ffn.experts.mlp.w2").reshape(E, I, H))
+
+        params = {
+            "embed": get("transformer.wte.weight"),
+            "layers": {k: np.stack(v) for k, v in layers.items()},
+            "final_norm": get("transformer.norm_f.weight"),
+            "rope_inv_freq": cls.inv_freq_from_config(config),
+        }
+        if not args.tie_word_embeddings:
+            params["lm_head"] = np.ascontiguousarray(get("lm_head.weight").T)
+        return params

@@ -1,0 +1,4 @@
+from .modeling_deepseek import (DeepseekArchArgs, DeepseekForCausalLM,
+                                DeepseekInferenceConfig)
+
+__all__ = ["DeepseekArchArgs", "DeepseekForCausalLM", "DeepseekInferenceConfig"]

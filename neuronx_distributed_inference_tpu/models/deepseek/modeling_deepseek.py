@@ -1,0 +1,565 @@
+"""DeepSeek-V3-family models (V3 / R1; V3-style sigmoid-group routing): Multi-head Latent Attention (MLA) + DeepSeek MoE.
+
+≈ reference `models/deepseek/modeling_deepseek.py` (`DeepseekV3Attention` :79-325:
+latent KV cache, weight-matrix absorption, yarn rope) and
+`models/deepseek/rope_util.py`. TPU redesign:
+
+- **Latent KV cache.** One cache tensor per layer of shape (B, 1, S, R + C) holding
+  ``[k_pe (rope dim R) | compressed_kv (kv_lora_rank C)]`` — the MQA-like latent the
+  reference caches (`modeling_deepseek.py:322` ``past_key_value = (k_pe, compressed_kv)``).
+  For V3 geometry (R=64, C=512) this is ~9x smaller than the materialized per-head
+  cache and is *replicated* across tp ranks (heads are sharded; the latent is shared),
+  the standard MLA TP layout.
+- **Absorbed matmuls.** ``q_nope`` is pre-multiplied by the K half of ``kv_b_proj`` and
+  the attention output by the V half (`modeling_deepseek.py:255-259,291-317`), so
+  attention runs entirely in the C-dim latent space; the per-head K/V are never
+  materialized. HF's unabsorbed reference implementation is numerically identical.
+- **Two-segment layer scan.** DeepSeek stacks ``first_k_dense_replace`` dense-MLP
+  layers then MoE layers; each segment is a `lax.scan` over its stacked params
+  (uniform shapes within a segment keep compile time O(1) in depth like models/base).
+- MoE routing (sigmoid scores + group-limited top-k + e_score_correction_bias +
+  ungated shared experts) lives in ops/moe.py (``router_mode="sigmoid_group"``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...config import InferenceConfig
+from ...modules import kvcache
+from ...ops import rope as rope_ops
+from ...ops.moe import MoEArgs, moe_block
+from ...ops.norms import rms_norm
+from ...parallel.sharding import constrain, named_sharding
+from ..base import (ModelArchArgs, Params, _ACTIVATIONS, _embed, _lm_head, _mlp,
+                    _norm)
+from ...runtime.application import TpuModelForCausalLM
+
+
+@dataclass(frozen=True)
+class DeepseekArchArgs(ModelArchArgs):
+    """MLA + DeepSeek-MoE architecture extension of ModelArchArgs.
+
+    ``intermediate_size`` is the routed-expert width (moe_intermediate_size);
+    ``dense_intermediate_size`` the width of the first-k dense layers' MLP."""
+
+    q_lora_rank: Optional[int] = None     # None -> full q projection (V2-Lite)
+    kv_lora_rank: int = 512
+    qk_rope_head_dim: int = 64
+    qk_nope_head_dim: int = 128
+    v_head_dim: int = 128
+    rope_interleave: bool = True
+    first_k_dense_replace: int = 0
+    dense_intermediate_size: int = 0
+
+    @property
+    def qk_head_dim(self) -> int:
+        return self.qk_nope_head_dim + self.qk_rope_head_dim
+
+    @property
+    def latent_dim(self) -> int:
+        return self.qk_rope_head_dim + self.kv_lora_rank
+
+
+# --- functional MLA layers ------------------------------------------------------------
+
+
+def _deinterleave(x: jnp.ndarray) -> jnp.ndarray:
+    """[x0, x1, x2, ...] -> [x0, x2, ..., x1, x3, ...] on the last dim.
+
+    DeepSeek checkpoints store rope dims interleaved (HF
+    `apply_rotary_pos_emb_interleave`); after this permutation the standard
+    rotate-half application matches."""
+    return jnp.concatenate([x[..., 0::2], x[..., 1::2]], axis=-1)
+
+
+def _mla_attention(lp: Params, args: DeepseekArchArgs, hn: jnp.ndarray,
+                   cos: jnp.ndarray, sin: jnp.ndarray, mask: jnp.ndarray,
+                   latent_cache: jnp.ndarray,
+                   positions: Optional[jnp.ndarray], decode_bucket: Optional[int],
+                   mesh, rules):
+    """MLA attention over the latent cache.
+
+    hn: (B, S, H) normed hidden states. latent_cache: (B, 1, S_max, R+C).
+    Returns (attn_out (B, S, heads*v_dim), updated latent_cache)."""
+    b, s, _ = hn.shape
+    R, C = args.qk_rope_head_dim, args.kv_lora_rank
+    nope = args.qk_nope_head_dim
+
+    if args.q_lora_rank is None:
+        q = hn @ lp["wq"]
+    else:
+        q_a = rms_norm(hn @ lp["q_a"], lp["q_a_norm"], args.rms_norm_eps)
+        q = q_a @ lp["q_b"]
+    q = q.reshape(b, s, args.num_heads, args.qk_head_dim).transpose(0, 2, 1, 3)
+    q = constrain(q, ("batch", "heads", None, None), rules, mesh=mesh)
+    q_nope, q_pe = q[..., :nope], q[..., nope:]
+
+    ckv = hn @ lp["kv_a"]                                   # (B, S, C + R)
+    c, k_pe = ckv[..., :C], ckv[..., C:]
+    c = rms_norm(c, lp["kv_a_norm"], args.rms_norm_eps)     # (B, S, C)
+    k_pe = k_pe[:, None, :, :]                              # (B, 1, S, R)
+
+    if args.rope_interleave:
+        q_pe = _deinterleave(q_pe)
+        k_pe = _deinterleave(k_pe)
+    q_pe, k_pe = rope_ops.apply_rotary(q_pe, k_pe, cos, sin)
+
+    # absorb the K half of kv_b into q_nope: (B, h, S, nope) x (h, nope, C)
+    q_c = jnp.einsum("bhsn,hnc->bhsc", q_nope, lp["k_absorb"])
+
+    latent_new = jnp.concatenate(
+        [k_pe, c[:, None, :, :]], axis=-1)                  # (B, 1, S, R+C)
+    if positions is None:
+        latent_cache = kvcache.write_prefill(latent_cache, latent_new)
+        latent_att = latent_new
+    else:
+        latent_cache = kvcache.write_decode(latent_cache, latent_new, positions)
+        latent_att = kvcache.read_bucket(latent_cache, decode_bucket)
+    k_pe_att = latent_att[:, 0, :, :R].astype(q_pe.dtype)   # (B, T, R)
+    c_att = latent_att[:, 0, :, R:].astype(q_pe.dtype)      # (B, T, C)
+
+    scale = (args.attention_scale if args.attention_scale is not None
+             else args.qk_head_dim ** -0.5)
+    scores = (jnp.einsum("bhsr,btr->bhst", q_pe, k_pe_att)
+              + jnp.einsum("bhsc,btc->bhst", q_c, c_att)) * scale
+    scores = jnp.where(mask, scores.astype(jnp.float32), jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q_pe.dtype)
+
+    x = jnp.einsum("bhst,btc->bhsc", probs, c_att)          # (B, h, S, C)
+    attn = jnp.einsum("bhsc,hvc->bhsv", x, lp["v_absorb"])  # (B, h, S, v_dim)
+    attn = constrain(attn, ("batch", "heads", None, None), rules, mesh=mesh)
+    attn = attn.transpose(0, 2, 1, 3).reshape(b, s, args.num_heads * args.v_head_dim)
+    return attn, latent_cache
+
+
+def _deepseek_layer(lp: Params, args: DeepseekArchArgs, h, cos, sin, mask,
+                    latent_cache, positions, decode_bucket, mesh, rules,
+                    is_moe: bool):
+    resid = h
+    hn = _norm(h, lp["ln1"], args)
+    attn, latent_cache = _mla_attention(lp, args, hn, cos, sin, mask, latent_cache,
+                                        positions, decode_bucket, mesh, rules)
+    attn_out = attn @ lp["wo"]
+    attn_out = constrain(attn_out, ("batch", None, None), rules, mesh=mesh)
+    h = resid + attn_out
+
+    resid = h
+    hn = _norm(h, lp["ln2"], args)
+    if is_moe:
+        ffn = moe_block(lp, args, hn, mesh, rules, _ACTIVATIONS[args.activation])
+    else:
+        ffn = _mlp(lp, args, hn, mesh, rules)
+    h = resid + constrain(ffn, ("batch", None, None), rules, mesh=mesh)
+    return h, latent_cache
+
+
+def _run_segments(params: Params, args: DeepseekArchArgs, h, cos, sin, mask, cache,
+                  positions, decode_bucket, mesh, rules):
+    """Scan the dense segment then the MoE segment, carrying hidden + latent cache."""
+    latents = cache["latent"]                       # (L, B, 1, S, R+C)
+    kd = args.first_k_dense_replace
+    new_latents = []
+
+    def _scan(stack, latent_stack, is_moe):
+        def body(carry_h, xs):
+            lp, lat = xs
+            new_h, lat = _deepseek_layer(lp, args, carry_h, cos, sin, mask, lat,
+                                         positions, decode_bucket, mesh, rules,
+                                         is_moe=is_moe)
+            return new_h, lat
+
+        return jax.lax.scan(body, h, (stack, latent_stack))
+
+    if kd > 0:
+        h, lat_dense = _scan(params["dense"], latents[:kd], is_moe=False)
+        new_latents.append(lat_dense)
+    if kd < args.num_layers:
+        h, lat_moe = _scan(params["moe"], latents[kd:], is_moe=True)
+        new_latents.append(lat_moe)
+    return h, {"latent": jnp.concatenate(new_latents, axis=0)}
+
+
+def prefill_forward(params: Params, args: DeepseekArchArgs, input_ids, position_ids,
+                    last_token_idx, cache, mesh=None, rules=None, use_flash=False,
+                    slot_mapping=None, cache_batch_start=0, adapter_ids=None,
+                    use_ring=False, return_hidden=False):
+    """Context encoding over the latent cache (signature-compatible with
+    models/base.prefill_forward; flash/ring/paged/LoRA are not supported for MLA yet)."""
+    h = _embed(params, args, input_ids, mesh, rules)
+    cos, sin = rope_ops.compute_cos_sin(params["rope_inv_freq"], position_ids,
+                                        args.rope_attention_scaling)
+    from ..base import causal_mask as _cm  # reuse base mask helpers
+
+    mask = (position_ids[:, None, :, None] >= position_ids[:, None, None, :])
+    mask = jnp.logical_and(mask, _cm(input_ids.shape[1], input_ids.shape[1])[None, None])
+    h, cache = _run_segments(params, args, h, cos, sin, mask, cache,
+                             positions=None, decode_bucket=None, mesh=mesh,
+                             rules=rules)
+    h = _norm(h, params["final_norm"], args)
+    h_last = jnp.take_along_axis(h, last_token_idx[:, None, None], axis=1)[:, 0]
+    logits = _lm_head(params, args, h_last, mesh, rules)
+    if return_hidden:
+        return logits, cache, h
+    return logits, cache
+
+
+def decode_forward(params: Params, args: DeepseekArchArgs, input_ids, position_ids,
+                   cache, decode_bucket, mesh=None, rules=None, block_table=None,
+                   slot_mapping=None, adapter_ids=None, tree=None,
+                   return_hidden=False):
+    """Token generation over the latent cache (dense bucketed mode)."""
+    b, t = input_ids.shape
+    h = _embed(params, args, input_ids, mesh, rules)
+    pos_grid = position_ids[:, None] + jnp.arange(t)[None, :]
+    cos, sin = rope_ops.compute_cos_sin(params["rope_inv_freq"], pos_grid,
+                                        args.rope_attention_scaling)
+    kv_pos = jnp.arange(decode_bucket)[None, None, None, :]
+    q_pos = pos_grid[:, None, :, None]
+    mask = kv_pos <= q_pos
+    h, cache = _run_segments(params, args, h, cos, sin, mask, cache,
+                             positions=position_ids, decode_bucket=decode_bucket,
+                             mesh=mesh, rules=rules)
+    h = _norm(h, params["final_norm"], args)
+    logits = _lm_head(params, args, h, mesh, rules)
+    if return_hidden:
+        return logits, cache, h
+    return logits, cache
+
+
+# --- config / application -------------------------------------------------------------
+
+
+class DeepseekInferenceConfig(InferenceConfig):
+    REQUIRED_ATTRIBUTES = (
+        "hidden_size", "num_attention_heads", "num_hidden_layers", "vocab_size",
+        "kv_lora_rank", "qk_rope_head_dim", "qk_nope_head_dim", "v_head_dim",
+    )
+
+    def add_derived_config(self) -> None:
+        # present-but-None attrs also get the default (for q_lora_rank/rope_scaling/
+        # n_routed_experts/moe_intermediate_size the default IS None, i.e. meaningful)
+        for attr, default in (
+                ("rms_norm_eps", 1e-6), ("rope_theta", 10000.0),
+                ("rope_scaling", None), ("rope_interleave", True),
+                ("tie_word_embeddings", False), ("hidden_act", "silu"),
+                ("q_lora_rank", None), ("first_k_dense_replace", 0),
+                ("n_routed_experts", None), ("num_experts_per_tok", 8),
+                ("n_group", 1), ("topk_group", 1), ("n_shared_experts", 0),
+                ("routed_scaling_factor", 1.0), ("norm_topk_prob", True),
+                ("moe_intermediate_size", None), ("intermediate_size", None)):
+            if not hasattr(self, attr) or getattr(self, attr) is None:
+                setattr(self, attr, default)
+
+
+class DeepseekForCausalLM(TpuModelForCausalLM):
+    """≈ the reference DeepSeek application built on `DeepseekV3Attention`."""
+
+    def __init__(self, model_path, config, mesh=None):
+        # these serving features assume the base "layers" param/cache layout; fail
+        # loudly rather than deep inside lax.scan tracing
+        tc = config.tpu_config
+        unsupported = [name for name, v in (
+            ("lora_serving_config", tc.lora_serving_config),
+            ("quantization_config", tc.quantization_config),
+            ("speculation_config", tc.speculation_config),
+        ) if v is not None]
+        if tc.paged_attention_enabled:
+            unsupported.append("paged_attention_enabled")
+        if tc.is_continuous_batching:
+            unsupported.append("is_continuous_batching")
+        if unsupported:
+            raise ValueError(f"{', '.join(unsupported)} not supported for the MLA "
+                             "(DeepSeek) family yet")
+        super().__init__(model_path, config, mesh=mesh)
+
+    @classmethod
+    def get_config_cls(cls):
+        return DeepseekInferenceConfig
+
+    @classmethod
+    def arch_args_from_config(cls, config) -> DeepseekArchArgs:
+        rope_scaling = config.rope_scaling
+        scale = (config.qk_nope_head_dim + config.qk_rope_head_dim) ** -0.5
+        if rope_scaling is not None and rope_scaling.get("mscale_all_dim"):
+            m = rope_ops.yarn_mscale(rope_scaling["factor"],
+                                     rope_scaling["mscale_all_dim"])
+            scale = scale * m * m
+        moe = None
+        if config.n_routed_experts:
+            moe = MoEArgs(
+                num_experts=config.n_routed_experts,
+                experts_per_tok=config.num_experts_per_tok,
+                norm_topk_prob=config.norm_topk_prob,
+                router_mode="sigmoid_group",
+                n_group=config.n_group,
+                topk_group=config.topk_group,
+                score_correction_bias=True,
+                routed_scaling_factor=config.routed_scaling_factor,
+                shared_expert_intermediate_size=(
+                    (config.n_shared_experts or 0)
+                    * (config.moe_intermediate_size or 0)),
+                shared_expert_gated=False,
+            )
+        return DeepseekArchArgs(
+            vocab_size=config.vocab_size,
+            hidden_size=config.hidden_size,
+            num_layers=config.num_hidden_layers,
+            num_heads=config.num_attention_heads,
+            num_kv_heads=1,                       # latent cache is MQA-like
+            head_dim=config.v_head_dim,
+            intermediate_size=(config.moe_intermediate_size
+                               or config.intermediate_size),
+            dense_intermediate_size=config.intermediate_size,
+            rms_norm_eps=config.rms_norm_eps,
+            activation=config.hidden_act,
+            attention_scale=scale,
+            rope_attention_scaling=rope_ops.attention_scaling_from_hf_config(
+                rope_scaling),
+            tie_word_embeddings=config.tie_word_embeddings,
+            q_lora_rank=config.q_lora_rank,
+            kv_lora_rank=config.kv_lora_rank,
+            qk_rope_head_dim=config.qk_rope_head_dim,
+            qk_nope_head_dim=config.qk_nope_head_dim,
+            v_head_dim=config.v_head_dim,
+            rope_interleave=config.rope_interleave,
+            first_k_dense_replace=(config.first_k_dense_replace
+                                   if config.n_routed_experts else
+                                   config.num_hidden_layers),
+            moe=moe,
+        )
+
+    @classmethod
+    def inv_freq_from_config(cls, config) -> np.ndarray:
+        return rope_ops.inv_freq_from_hf_config(
+            config.qk_rope_head_dim, config.rope_theta, config.rope_scaling)
+
+    # MLA has no flash/ring path yet; the jnp attention is the supported strategy
+    def _use_flash_attention(self) -> bool:
+        if self.tpu_config.attention_kernel_enabled is True:
+            raise ValueError("the Pallas flash kernel does not support MLA yet")
+        return False
+
+    def _use_ring_attention(self) -> bool:
+        if self.mesh.shape["cp"] > 1:
+            raise ValueError("context parallelism is not supported for MLA yet")
+        return False
+
+    # --- custom param layout ----------------------------------------------------------
+    def prefill_fn(self):
+        return prefill_forward
+
+    def decode_fn(self):
+        return decode_forward
+
+    def _attn_axes(self) -> Dict[str, Tuple]:
+        a = self.arch_args
+        axes = {
+            "ln1": ("layers", None),
+            "ln2": ("layers", None),
+            "kv_a": ("layers", "embed", None),
+            "kv_a_norm": ("layers", None),
+            "k_absorb": ("layers", "heads", None, None),
+            "v_absorb": ("layers", "heads", None, None),
+            "wo": ("layers", "heads", "embed"),
+        }
+        if a.q_lora_rank is None:
+            axes["wq"] = ("layers", "embed", "heads")
+        else:
+            axes.update({"q_a": ("layers", "embed", None),
+                         "q_a_norm": ("layers", None),
+                         "q_b": ("layers", None, "heads")})
+        return axes
+
+    def logical_axes(self) -> Dict:
+        a: DeepseekArchArgs = self.arch_args
+        out: Dict[str, Any] = {
+            "embed": ("vocab", "embed"),
+            "final_norm": (None,),
+            "rope_inv_freq": (None,),
+        }
+        if not a.tie_word_embeddings:
+            out["lm_head"] = ("embed", "vocab")
+        if a.first_k_dense_replace > 0:
+            dense = dict(self._attn_axes())
+            dense.update({"wg": ("layers", "embed", "mlp"),
+                          "wu": ("layers", "embed", "mlp"),
+                          "wd": ("layers", "mlp", "embed")})
+            out["dense"] = dense
+        if a.first_k_dense_replace < a.num_layers:
+            moe_axes = dict(self._attn_axes())
+            moe_axes.update({
+                "router": ("layers", "embed", None),
+                "router_cb": ("layers", None),
+                "wg": ("layers", "experts", "embed", "expert_mlp"),
+                "wu": ("layers", "experts", "embed", "expert_mlp"),
+                "wd": ("layers", "experts", "expert_mlp", "embed"),
+                "shared_wg": ("layers", "embed", "mlp"),
+                "shared_wu": ("layers", "embed", "mlp"),
+                "shared_wd": ("layers", "mlp", "embed"),
+            })
+            out["moe"] = moe_axes
+        return out
+
+    def init_random_params(self, key) -> Dict:
+        a: DeepseekArchArgs = self.arch_args
+        dtype = self.tpu_config.jax_dtype
+        H, nh = a.hidden_size, a.num_heads
+        ks = iter(jax.random.split(key, 40))
+
+        def w(shape, scale=0.02):
+            return (jax.random.normal(next(ks), shape, dtype=jnp.float32)
+                    * scale).astype(dtype)
+
+        def attn_stack(L):
+            C, R = a.kv_lora_rank, a.qk_rope_head_dim
+            p = {
+                "ln1": jnp.ones((L, H), dtype=dtype),
+                "ln2": jnp.ones((L, H), dtype=dtype),
+                "kv_a": w((L, H, C + R)),
+                "kv_a_norm": jnp.ones((L, C), dtype=dtype),
+                "k_absorb": w((L, nh, a.qk_nope_head_dim, C)),
+                "v_absorb": w((L, nh, a.v_head_dim, C)),
+                "wo": w((L, nh * a.v_head_dim, H)),
+            }
+            if a.q_lora_rank is None:
+                p["wq"] = w((L, H, nh * a.qk_head_dim))
+            else:
+                p.update({"q_a": w((L, H, a.q_lora_rank)),
+                          "q_a_norm": jnp.ones((L, a.q_lora_rank), dtype=dtype),
+                          "q_b": w((L, a.q_lora_rank, nh * a.qk_head_dim))})
+            return p
+
+        params: Dict[str, Any] = {
+            "embed": w((a.vocab_size, H)),
+            "final_norm": jnp.ones((H,), dtype=dtype),
+            "rope_inv_freq": jnp.asarray(self.inv_freq_from_config(self.config),
+                                         dtype=jnp.float32),
+        }
+        if not a.tie_word_embeddings:
+            params["lm_head"] = w((H, a.vocab_size))
+        kd = a.first_k_dense_replace
+        if kd > 0:
+            dense = attn_stack(kd)
+            I = a.dense_intermediate_size
+            dense.update({"wg": w((kd, H, I)), "wu": w((kd, H, I)),
+                          "wd": w((kd, I, H))})
+            params["dense"] = dense
+        L_moe = a.num_layers - kd
+        if L_moe > 0:
+            moe_p = attn_stack(L_moe)
+            E, I = a.moe.num_experts, a.intermediate_size
+            Ish = a.moe.shared_expert_intermediate_size
+            moe_p.update({
+                "router": w((L_moe, H, E)),
+                "router_cb": jnp.zeros((L_moe, E), dtype=dtype),
+                "wg": w((L_moe, E, H, I)),
+                "wu": w((L_moe, E, H, I)),
+                "wd": w((L_moe, E, I, H)),
+                "shared_wg": w((L_moe, H, Ish)),
+                "shared_wu": w((L_moe, H, Ish)),
+                "shared_wd": w((L_moe, Ish, H)),
+            })
+            params["moe"] = moe_p
+        return params
+
+    # --- latent cache -----------------------------------------------------------------
+    def reset_cache(self) -> None:
+        a: DeepseekArchArgs = self.arch_args
+        shape = (a.num_layers, self.tpu_config.max_batch_size, 1,
+                 self.tpu_config.seq_len, a.latent_dim)
+        # latent is replicated over tp (heads are sharded, the latent is shared);
+        # batch rides dp
+        sharding = named_sharding(self.mesh,
+                                  ("layers", "batch", None, None, None))
+        self.kv_cache = {"latent": jax.device_put(
+            jnp.zeros(shape, dtype=self.tpu_config.kv_cache_jax_dtype), sharding)}
+
+    @classmethod
+    def convert_hf_state_dict(cls, state_dict: Dict[str, np.ndarray],
+                              config) -> Dict:
+        args = cls.arch_args_from_config(config)
+        L, nh = config.num_hidden_layers, config.num_attention_heads
+        nope, v_dim, C = (config.qk_nope_head_dim, config.v_head_dim,
+                          config.kv_lora_rank)
+        kd = args.first_k_dense_replace
+
+        def get(name):
+            if name not in state_dict:
+                raise KeyError(f"missing weight {name}")
+            return state_dict[name]
+
+        def linear_t(name):
+            return np.ascontiguousarray(get(name).T)
+
+        def attn_params(i):
+            p = f"model.layers.{i}.self_attn."
+            wkv_b = get(p + "kv_b_proj.weight").reshape(nh, nope + v_dim, C)
+            out = {
+                "ln1": get(f"model.layers.{i}.input_layernorm.weight"),
+                "ln2": get(f"model.layers.{i}.post_attention_layernorm.weight"),
+                "kv_a": linear_t(p + "kv_a_proj_with_mqa.weight"),
+                "kv_a_norm": get(p + "kv_a_layernorm.weight"),
+                "k_absorb": wkv_b[:, :nope, :],
+                "v_absorb": wkv_b[:, nope:, :],
+                "wo": linear_t(p + "o_proj.weight"),
+            }
+            if args.q_lora_rank is None:
+                out["wq"] = linear_t(p + "q_proj.weight")
+            else:
+                out.update({"q_a": linear_t(p + "q_a_proj.weight"),
+                            "q_a_norm": get(p + "q_a_layernorm.weight"),
+                            "q_b": linear_t(p + "q_b_proj.weight")})
+            return out
+
+        def stack(dicts):
+            return {k: np.stack([d[k] for d in dicts]) for k in dicts[0]}
+
+        params: Dict[str, Any] = {
+            "embed": get("model.embed_tokens.weight"),
+            "final_norm": get("model.norm.weight"),
+            "rope_inv_freq": cls.inv_freq_from_config(config),
+        }
+        if not args.tie_word_embeddings:
+            params["lm_head"] = linear_t("lm_head.weight")
+
+        if kd > 0:
+            dense = []
+            for i in range(kd):
+                d = attn_params(i)
+                m = f"model.layers.{i}.mlp."
+                d.update({"wg": linear_t(m + "gate_proj.weight"),
+                          "wu": linear_t(m + "up_proj.weight"),
+                          "wd": linear_t(m + "down_proj.weight")})
+                dense.append(d)
+            params["dense"] = stack(dense)
+        if kd < L:
+            moe_layers = []
+            E = config.n_routed_experts
+            for i in range(kd, L):
+                d = attn_params(i)
+                m = f"model.layers.{i}.mlp."
+                d.update({
+                    "router": linear_t(m + "gate.weight"),
+                    "router_cb": get(m + "gate.e_score_correction_bias"),
+                    "wg": np.stack([linear_t(m + f"experts.{e}.gate_proj.weight")
+                                    for e in range(E)]),
+                    "wu": np.stack([linear_t(m + f"experts.{e}.up_proj.weight")
+                                    for e in range(E)]),
+                    "wd": np.stack([linear_t(m + f"experts.{e}.down_proj.weight")
+                                    for e in range(E)]),
+                })
+                if args.moe.shared_expert_intermediate_size:
+                    d.update({
+                        "shared_wg": linear_t(m + "shared_experts.gate_proj.weight"),
+                        "shared_wu": linear_t(m + "shared_experts.up_proj.weight"),
+                        "shared_wd": linear_t(m + "shared_experts.down_proj.weight"),
+                    })
+                moe_layers.append(d)
+            params["moe"] = stack(moe_layers)
+        return params

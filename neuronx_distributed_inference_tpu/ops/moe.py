@@ -39,12 +39,27 @@ class MoEArgs:
     num_experts: int
     experts_per_tok: int
     norm_topk_prob: bool = True          # renormalize top-k gates to sum to 1
+    # DBRX-style p-norm renormalization of the top-k gates (HF
+    # moe_normalize_expert_weights); overrides norm_topk_prob when set. p=1 over the
+    # positive softmax weights equals sum renormalization.
+    norm_topk_p: Optional[float] = None
     # qwen-style shared expert running densely alongside the routed experts, with a
     # sigmoid gate projected from the hidden state (0 = disabled)
     shared_expert_intermediate_size: int = 0
     # routing order: "softmax_topk" (Mixtral/Qwen: softmax over all experts, then
-    # top-k) or "topk_softmax" (gpt-oss: top-k of raw logits, softmax over the k)
+    # top-k), "topk_softmax" (gpt-oss: top-k of raw logits, softmax over the k), or
+    # "sigmoid_group" (DeepSeek-V3: sigmoid scores + e_score_correction_bias for
+    # *selection only*, group-limited top-k, gates from the raw sigmoid scores)
     router_mode: str = "softmax_topk"
+    # DeepSeek group-limited routing: experts partitioned into n_group groups; the
+    # topk_group best groups (by sum of each group's top-2 biased scores) stay eligible
+    n_group: int = 1
+    topk_group: int = 1
+    score_correction_bias: bool = False  # learned selection bias (router_cb param)
+    routed_scaling_factor: float = 1.0   # final gate multiplier (DeepSeek)
+    # qwen shared expert is sigmoid-gated from the hidden state; DeepSeek's shared
+    # experts are an ungated parallel MLP
+    shared_expert_gated: bool = True
     router_bias: bool = False            # router logits get a learned bias (gpt-oss)
     expert_bias: bool = False            # expert MLPs have biases (gpt-oss)
     # gpt-oss clamped glu: gate/up clipped at ±limit, act = gate·σ(α·gate), out =
@@ -54,24 +69,51 @@ class MoEArgs:
 
 
 def route(router_w: jnp.ndarray, x: jnp.ndarray, moe: MoEArgs,
-          router_b: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+          router_b: Optional[jnp.ndarray] = None,
+          router_cb: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """Top-k routing gates.
 
     x: (N, H) tokens; router_w: (H, E). Returns dense gates (N, E) float32 with
     exactly top-k nonzeros per row. ``softmax_topk`` matches HF Mixtral/Qwen3-MoE
     (softmax over all experts, top-k, optional renorm); ``topk_softmax`` matches HF
-    gpt-oss (top-k of logits, softmax over the selected k).
+    gpt-oss (top-k of logits, softmax over the selected k); ``sigmoid_group`` matches
+    HF DeepSeek-V3 (`DeepseekV3TopkRouter`: sigmoid scores, group-limited selection
+    with the correction bias ``router_cb`` applied to selection only, gates taken from
+    the *unbiased* scores, scaled by ``routed_scaling_factor``).
     """
     logits = x.astype(jnp.float32) @ router_w.astype(jnp.float32)   # (N, E)
     if router_b is not None:
         logits = logits + router_b.astype(jnp.float32)
-    if moe.router_mode == "topk_softmax":
+    if moe.router_mode == "sigmoid_group":
+        n, e = logits.shape
+        scores = jax.nn.sigmoid(logits)                             # (N, E)
+        choice = scores
+        if router_cb is not None:
+            choice = choice + router_cb.astype(jnp.float32)
+        group_sz = e // moe.n_group
+        grouped = choice.reshape(n, moe.n_group, group_sz)
+        group_scores = jnp.sum(jax.lax.top_k(grouped, 2)[0], axis=-1)   # (N, G)
+        _, gidx = jax.lax.top_k(group_scores, moe.topk_group)
+        gmask = jnp.sum(jax.nn.one_hot(gidx, moe.n_group, dtype=jnp.float32),
+                        axis=1)                                      # (N, G)
+        emask = jnp.repeat(gmask, group_sz, axis=-1)                 # (N, E)
+        masked_choice = jnp.where(emask > 0, choice, 0.0)
+        _, top_idx = jax.lax.top_k(masked_choice, moe.experts_per_tok)
+        top_vals = jnp.take_along_axis(scores, top_idx, axis=-1)     # unbiased scores
+        if moe.norm_topk_prob:
+            top_vals = top_vals / (jnp.sum(top_vals, axis=-1, keepdims=True) + 1e-20)
+        top_vals = top_vals * moe.routed_scaling_factor
+    elif moe.router_mode == "topk_softmax":
         top_vals, top_idx = jax.lax.top_k(logits, moe.experts_per_tok)
         top_vals = jax.nn.softmax(top_vals, axis=-1)
     elif moe.router_mode == "softmax_topk":
         probs = jax.nn.softmax(logits, axis=-1)
         top_vals, top_idx = jax.lax.top_k(probs, moe.experts_per_tok)   # (N, k)
-        if moe.norm_topk_prob:
+        if moe.norm_topk_p is not None:
+            scale = jnp.sum(jnp.abs(top_vals) ** moe.norm_topk_p,
+                            axis=-1, keepdims=True) ** (1.0 / moe.norm_topk_p)
+            top_vals = top_vals / scale
+        elif moe.norm_topk_prob:
             top_vals = top_vals / jnp.sum(top_vals, axis=-1, keepdims=True)
     else:
         raise ValueError(f"unknown router_mode {moe.router_mode!r}")
@@ -89,7 +131,8 @@ def moe_block(lp, args, hn: jnp.ndarray, mesh, rules,
     moe: MoEArgs = args.moe
     b, s, h = hn.shape
     x = hn.reshape(b * s, h)
-    gates = route(lp["router"], x, moe, lp.get("router_b"))         # (N, E) fp32
+    gates = route(lp["router"], x, moe, lp.get("router_b"),
+                  lp.get("router_cb"))                              # (N, E) fp32
 
     # dense all-experts MLP: (E, N, I) intermediates, EP-sharded on E, TP on I
     gate_proj = qeinsum("nh,ehi->eni", x, lp["wg"])
@@ -118,8 +161,11 @@ def moe_block(lp, args, hn: jnp.ndarray, mesh, rules,
         shared_inter = (activation(qapply(x, lp["shared_wg"]))
                         * qapply(x, lp["shared_wu"]))
         shared = qapply(shared_inter, lp["shared_wd"])
-        shared_gate = jax.nn.sigmoid(
-            (x.astype(jnp.float32) @ lp["shared_gate"].astype(jnp.float32)))  # (N, 1)
-        out = out + shared * shared_gate.astype(out.dtype)
+        if moe.shared_expert_gated:
+            shared_gate = jax.nn.sigmoid(
+                (x.astype(jnp.float32)
+                 @ lp["shared_gate"].astype(jnp.float32)))           # (N, 1)
+            shared = shared * shared_gate.astype(shared.dtype)
+        out = out + shared
 
     return out.reshape(b, s, h).astype(hn.dtype)

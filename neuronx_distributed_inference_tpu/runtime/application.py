@@ -138,6 +138,16 @@ class TpuModelForCausalLM:
     def decode_fn(self):
         return model_base.decode_forward
 
+    # --- param layout hooks (overridable by archs with non-standard params, e.g.
+    # DeepSeek MLA) --------------------------------------------------------------------
+    def logical_axes(self) -> Dict:
+        return model_base.param_logical_axes(self.arch_args)
+
+    def init_random_params(self, key) -> Dict:
+        return model_base.init_params(
+            self.arch_args, key, dtype=self.tpu_config.jax_dtype,
+            inv_freq=self.inv_freq_from_config(self.config))
+
     # --- step construction ------------------------------------------------------------
     def _build_steps(self) -> None:
         args = self.arch_args
@@ -266,7 +276,7 @@ class TpuModelForCausalLM:
         from ..ops.quantization import (DEFAULT_QUANTIZED_PARAMS,
                                         quantized_logical_axes)
 
-        logical = model_base.param_logical_axes(self.arch_args)
+        logical = self.logical_axes()
         if self._quantization() is not None:
             logical = quantized_logical_axes(logical, DEFAULT_QUANTIZED_PARAMS)
         return tree_shardings(self.mesh, logical, self.sharding_rules)
@@ -296,11 +306,7 @@ class TpuModelForCausalLM:
 
     def load_random(self, seed: int = 0) -> None:
         """Random weights at the configured shapes (tests / synthetic benchmarks)."""
-        host_params = model_base.init_params(
-            self.arch_args, jax.random.PRNGKey(seed),
-            dtype=self.tpu_config.jax_dtype,
-            inv_freq=self.inv_freq_from_config(self.config))
-        self._put_params(host_params)
+        self._put_params(self.init_random_params(jax.random.PRNGKey(seed)))
 
     def set_lora_adapters(self, adapter_state_dicts, alphas=None) -> None:
         """Install PEFT adapter checkpoints into the resident multi-LoRA slots

@@ -212,3 +212,72 @@ def test_registry_resolves_new_models():
 
     for model_type in ("qwen2", "qwen3", "gemma3", "gemma3_text", "gpt_oss"):
         assert get_model_cls(model_type) is not None
+
+
+def test_dbrx_parity():
+    from transformers import DbrxConfig, DbrxForCausalLM as HFDbrx
+
+    from neuronx_distributed_inference_tpu.models.dbrx import DbrxForCausalLM
+
+    cfg = DbrxConfig(
+        d_model=64, n_heads=4, n_layers=2, max_seq_len=512, vocab_size=256,
+        attn_config={"kv_n_heads": 2, "clip_qkv": 8.0, "rope_theta": 10000.0},
+        ffn_config={"ffn_hidden_size": 96, "moe_num_experts": 4, "moe_top_k": 2,
+                    "moe_normalize_expert_weights": 1.0},
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    hf = HFDbrx(cfg).eval()
+    # HF initializes DbrxExpertGLU params to torch.empty (uninitialized memory can be
+    # inf/nan); give them real values
+    with torch.no_grad():
+        for block in hf.transformer.blocks:
+            for p in (block.ffn.experts.mlp.w1, block.ffn.experts.mlp.v1,
+                      block.ffn.experts.mlp.w2):
+                p.normal_(0, 0.02)
+    _run_parity(DbrxForCausalLM, hf, cfg)
+
+
+def test_deepseek_v3_parity():
+    """MLA (absorbed latent attention) + DeepSeek MoE (sigmoid group routing, shared
+    experts, first-k dense layers) vs HF DeepseekV3 CPU."""
+    from transformers import DeepseekV3Config, DeepseekV3ForCausalLM as HFDeepseek
+
+    from neuronx_distributed_inference_tpu.models.deepseek import DeepseekForCausalLM
+
+    cfg = DeepseekV3Config(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        moe_intermediate_size=48, num_hidden_layers=3, num_attention_heads=4,
+        num_key_value_heads=4, n_shared_experts=1, n_routed_experts=8,
+        routed_scaling_factor=2.5, kv_lora_rank=32, q_lora_rank=48,
+        qk_rope_head_dim=16, v_head_dim=32, qk_nope_head_dim=32,
+        n_group=4, topk_group=2, num_experts_per_tok=3, first_k_dense_replace=1,
+        norm_topk_prob=True, max_position_embeddings=512, rope_theta=10000.0,
+        tie_word_embeddings=False, attention_bias=False,
+    )
+    torch.manual_seed(0)
+    hf = HFDeepseek(cfg).eval()
+    with torch.no_grad():
+        for layer in hf.model.layers[cfg.first_k_dense_replace:]:
+            layer.mlp.gate.weight.normal_(0, 0.05)
+            layer.mlp.gate.e_score_correction_bias.normal_(0, 0.05)
+    _run_parity(DeepseekForCausalLM, hf, cfg)
+
+
+def test_deepseek_no_qlora_parity():
+    """q_lora_rank=None path (full q projection, no q compression), all-dense layers."""
+    from transformers import DeepseekV3Config, DeepseekV3ForCausalLM as HFDeepseek
+
+    from neuronx_distributed_inference_tpu.models.deepseek import DeepseekForCausalLM
+
+    cfg = DeepseekV3Config(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
+        n_routed_experts=None, kv_lora_rank=32, q_lora_rank=None,
+        qk_rope_head_dim=16, v_head_dim=32, qk_nope_head_dim=32,
+        first_k_dense_replace=2, max_position_embeddings=512,
+        rope_theta=10000.0, tie_word_embeddings=False,
+    )
+    torch.manual_seed(1)
+    hf = HFDeepseek(cfg).eval()
+    _run_parity(DeepseekForCausalLM, hf, cfg)
