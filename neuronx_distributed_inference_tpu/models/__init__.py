@@ -14,6 +14,8 @@ _REGISTRY: Dict[str, str] = {
     "gpt_oss": "neuronx_distributed_inference_tpu.models.gpt_oss.modeling_gpt_oss:GptOssForCausalLM",
     "dbrx": "neuronx_distributed_inference_tpu.models.dbrx.modeling_dbrx:DbrxForCausalLM",
     "deepseek_v3": "neuronx_distributed_inference_tpu.models.deepseek.modeling_deepseek:DeepseekForCausalLM",
+    "llama4": "neuronx_distributed_inference_tpu.models.llama4.modeling_llama4:Llama4ForCausalLM",
+    "llama4_text": "neuronx_distributed_inference_tpu.models.llama4.modeling_llama4:Llama4ForCausalLM",
 }
 
 

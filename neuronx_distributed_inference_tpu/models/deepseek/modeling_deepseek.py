@@ -69,13 +69,7 @@ class DeepseekArchArgs(ModelArchArgs):
 # --- functional MLA layers ------------------------------------------------------------
 
 
-def _deinterleave(x: jnp.ndarray) -> jnp.ndarray:
-    """[x0, x1, x2, ...] -> [x0, x2, ..., x1, x3, ...] on the last dim.
-
-    DeepSeek checkpoints store rope dims interleaved (HF
-    `apply_rotary_pos_emb_interleave`); after this permutation the standard
-    rotate-half application matches."""
-    return jnp.concatenate([x[..., 0::2], x[..., 1::2]], axis=-1)
+_deinterleave = rope_ops.deinterleave
 
 
 def _mla_attention(lp: Params, args: DeepseekArchArgs, hn: jnp.ndarray,
@@ -261,21 +255,7 @@ class DeepseekForCausalLM(TpuModelForCausalLM):
     """≈ the reference DeepSeek application built on `DeepseekV3Attention`."""
 
     def __init__(self, model_path, config, mesh=None):
-        # these serving features assume the base "layers" param/cache layout; fail
-        # loudly rather than deep inside lax.scan tracing
-        tc = config.tpu_config
-        unsupported = [name for name, v in (
-            ("lora_serving_config", tc.lora_serving_config),
-            ("quantization_config", tc.quantization_config),
-            ("speculation_config", tc.speculation_config),
-        ) if v is not None]
-        if tc.paged_attention_enabled:
-            unsupported.append("paged_attention_enabled")
-        if tc.is_continuous_batching:
-            unsupported.append("is_continuous_batching")
-        if unsupported:
-            raise ValueError(f"{', '.join(unsupported)} not supported for the MLA "
-                             "(DeepSeek) family yet")
+        self._require_base_layout(config.tpu_config, "MLA (DeepSeek)")
         super().__init__(model_path, config, mesh=mesh)
 
     @classmethod

@@ -1,0 +1,4 @@
+from .modeling_llama4 import (Llama4ArchArgs, Llama4ForCausalLM,
+                              Llama4InferenceConfig)
+
+__all__ = ["Llama4ArchArgs", "Llama4ForCausalLM", "Llama4InferenceConfig"]

@@ -1,0 +1,507 @@
+"""Llama-4 text model family (Scout / Maverick).
+
+≈ reference `models/llama4/modeling_llama4_text.py` (770 LoC: chunked attention,
+interleaved NoPE layers, input-scaled top-1 MoE + shared expert). Llama4 specifics:
+
+- **Interleaved RoPE/NoPE layers** (`no_rope_layers`): rope layers use *chunked*
+  attention (block-diagonal causal within `attention_chunk_size`, ≈ reference chunked
+  masks `models/model_base.py:229-243`); NoPE layers attend globally with no rotary and
+  optional temperature tuning (q scaled by log1p(floor((pos+1)/floor_scale))·attn_scale
+  + 1).
+- **QK L2 norm** (weightless RMS) on rope layers when `use_qk_norm`.
+- **Interleaved rotary**: checkpoints store rope dims as complex pairs; q/k are
+  deinterleaved host-of-graph then rotated with the standard rotate-half (attention
+  scores are invariant to the shared permutation — same trick as DeepSeek).
+- **MoE**: router = top-k of logits then sigmoid; the expert *input* is scaled by the
+  gate (ops/moe.py `scale_expert_input`); an ungated shared expert always runs; every
+  `interleave_moe_layer_step`-th layer is MoE, others dense with
+  `intermediate_size_mlp`.
+- Layers scan in contiguous dense/MoE runs (per-run `lax.scan` over stacked params,
+  with per-layer use-rope booleans scanned alongside — same pattern as gemma3's
+  layer_pattern in models/base).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...config import InferenceConfig
+from ...modules import gqa, kvcache
+from ...ops import rope as rope_ops
+from ...ops.moe import MoEArgs, moe_block
+from ...parallel.sharding import constrain
+from ..base import (ModelArchArgs, Params, _ACTIVATIONS, _embed, _lm_head, _mlp,
+                    _norm, _project_qkv, causal_mask)
+from ...runtime.application import TpuModelForCausalLM
+
+
+@dataclass(frozen=True)
+class Llama4ArchArgs(ModelArchArgs):
+    """Llama4 extension: per-layer rope/moe interleaving + chunked attention."""
+
+    use_rope_layers: Tuple[bool, ...] = ()    # True = rope + chunked attention
+    moe_layer_flags: Tuple[bool, ...] = ()    # True = MoE FFN on that layer
+    attention_chunk_size: Optional[int] = None
+    attn_temperature_tuning: bool = False
+    floor_scale: float = 8192.0
+    attn_scale: float = 0.1
+    use_qk_norm: bool = False                 # L2 (weightless) qk norm on rope layers
+    dense_intermediate_size: int = 0          # intermediate_size_mlp
+
+
+_deinterleave = rope_ops.deinterleave
+
+
+def _l2_norm(x: jnp.ndarray, eps: float = 1e-6) -> jnp.ndarray:
+    x32 = x.astype(jnp.float32)
+    normed = x32 * jax.lax.rsqrt(jnp.mean(jnp.square(x32), axis=-1,
+                                          keepdims=True) + eps)
+    return normed.astype(x.dtype)
+
+
+def _llama4_layer(lp: Params, args: Llama4ArchArgs, h, rope_ctx, k_cache, v_cache,
+                  positions, decode_bucket, mesh, rules, is_moe: bool,
+                  use_rope: jnp.ndarray):
+    """One decoder layer; ``use_rope`` is a scanned boolean selecting rope+chunked vs
+    nope+global behaviour (cos/sin/masks for both kinds precomputed in rope_ctx)."""
+    cos, sin, mask_chunked, mask_global, temp_scales = rope_ctx
+    resid = h
+    hn = _norm(h, lp["ln1"], args)
+    q, k, v = _project_qkv(lp, args, hn)
+    # interleaved rotary: deinterleave q/k then standard rotate-half (see docstring);
+    # nope layers take identity cos/sin
+    cos_i = jnp.where(use_rope, cos, jnp.ones_like(cos))
+    sin_i = jnp.where(use_rope, sin, jnp.zeros_like(sin))
+    q_r, k_r = rope_ops.apply_rotary(_deinterleave(q), _deinterleave(k), cos_i, sin_i)
+    if args.use_qk_norm:
+        q_r = jnp.where(use_rope, _l2_norm(q_r), q_r)
+        k_r = jnp.where(use_rope, _l2_norm(k_r), k_r)
+    if args.attn_temperature_tuning:
+        # NoPE-layer temperature tuning (HF Llama4TextAttention.forward)
+        q_r = jnp.where(use_rope, q_r, q_r * temp_scales)
+    q, k = q_r, k_r
+
+    if positions is None:
+        k_cache = kvcache.write_prefill(k_cache, k)
+        v_cache = kvcache.write_prefill(v_cache, v)
+        k_att, v_att = k, v
+    else:
+        k_cache = kvcache.write_decode(k_cache, k, positions)
+        v_cache = kvcache.write_decode(v_cache, v, positions)
+        k_att = kvcache.read_bucket(k_cache, decode_bucket)
+        v_att = kvcache.read_bucket(v_cache, decode_bucket)
+
+    mask = jnp.where(use_rope, mask_chunked, mask_global)
+    from ..base import attend
+
+    attn = attend(q, k_att.astype(q.dtype), v_att.astype(q.dtype), mask=mask,
+                  scale=args.attention_scale)
+    attn = attn.transpose(0, 2, 1, 3).reshape(h.shape[0], h.shape[1], args.q_size)
+    attn_out = attn @ lp["wo"]
+    attn_out = constrain(attn_out, ("batch", None, None), rules, mesh=mesh)
+    h = resid + attn_out
+
+    resid = h
+    hn = _norm(h, lp["ln2"], args)
+    if is_moe:
+        ffn = moe_block(lp, args, hn, mesh, rules, _ACTIVATIONS[args.activation])
+    else:
+        ffn = _mlp(lp, args, hn, mesh, rules)
+    h = resid + constrain(ffn, ("batch", None, None), rules, mesh=mesh)
+    return h, k_cache, v_cache
+
+
+def _segment_runs(flags: Tuple[bool, ...]) -> List[Tuple[bool, int, int, int]]:
+    """Contiguous runs of equal flag: [(flag, global_start, length, kind_local_start)]."""
+    runs = []
+    counts = {True: 0, False: 0}
+    i = 0
+    while i < len(flags):
+        j = i
+        while j < len(flags) and flags[j] == flags[i]:
+            j += 1
+        runs.append((flags[i], i, j - i, counts[flags[i]]))
+        counts[flags[i]] += j - i
+        i = j
+    return runs
+
+
+def _run_layers(params: Params, args: Llama4ArchArgs, h, rope_ctx, cache,
+                positions, decode_bucket, mesh, rules):
+    """Scan contiguous dense/MoE runs.
+
+    All-MoE configs (Scout) get one scan; alternating configs (Maverick) degenerate to
+    length-1 runs, i.e. a fully unrolled trace — matching the reference, which traces
+    every model fully unrolled (`models/model_base.py:1376-1432`), so compile time is
+    bounded by its baseline; a padded-uniform single-scan layout can come later if
+    Maverick compile time warrants it."""
+    use_rope = jnp.asarray(args.use_rope_layers)
+    k_all, v_all = cache["k"], cache["v"]
+    new_k = [None] * len(args.moe_layer_flags)
+    new_v = [None] * len(args.moe_layer_flags)
+
+    for is_moe, g0, n, l0 in _segment_runs(args.moe_layer_flags):
+        stack = jax.tree.map(lambda x: x[l0:l0 + n],
+                             params["moe" if is_moe else "dense"])
+        xs = (stack, k_all[g0:g0 + n], v_all[g0:g0 + n], use_rope[g0:g0 + n])
+
+        def body(carry_h, layer_xs, _is_moe=is_moe):
+            lp, kc, vc, ur = layer_xs
+            nh, kc, vc = _llama4_layer(lp, args, carry_h, rope_ctx, kc, vc,
+                                       positions, decode_bucket, mesh, rules,
+                                       is_moe=_is_moe, use_rope=ur)
+            return nh, (kc, vc)
+
+        h, (ks, vs) = jax.lax.scan(body, h, xs)
+        for idx in range(n):
+            new_k[g0 + idx] = ks[idx:idx + 1]
+            new_v[g0 + idx] = vs[idx:idx + 1]
+    return h, {"k": jnp.concatenate(new_k, axis=0),
+               "v": jnp.concatenate(new_v, axis=0)}
+
+
+def _chunk_mask(q_pos: jnp.ndarray, kv_pos: jnp.ndarray, base: jnp.ndarray,
+                chunk: Optional[int]) -> jnp.ndarray:
+    """Restrict a causal mask to block-diagonal chunks (≈ reference block-diagonal
+    chunked-prefill masks, `models/model_base.py:229-243`)."""
+    if chunk is None:
+        return base
+    return jnp.logical_and(base, q_pos // chunk == kv_pos // chunk)
+
+
+def _temp_scales(args: Llama4ArchArgs, pos: jnp.ndarray) -> jnp.ndarray:
+    """(..., S) positions -> (..., 1, S, 1) q scale factors for NoPE layers."""
+    s = jnp.log1p(jnp.floor((pos.astype(jnp.float32) + 1.0) / args.floor_scale))
+    return (s * args.attn_scale + 1.0)[:, None, :, None]
+
+
+def prefill_forward(params: Params, args: Llama4ArchArgs, input_ids, position_ids,
+                    last_token_idx, cache, mesh=None, rules=None, use_flash=False,
+                    slot_mapping=None, cache_batch_start=0, adapter_ids=None,
+                    use_ring=False, return_hidden=False):
+    h = _embed(params, args, input_ids, mesh, rules)
+    cos, sin = rope_ops.compute_cos_sin(params["rope_inv_freq"], position_ids,
+                                        args.rope_attention_scaling)
+    s = input_ids.shape[1]
+    base = (position_ids[:, None, :, None] >= position_ids[:, None, None, :])
+    base = jnp.logical_and(base, causal_mask(s, s)[None, None])
+    q_pos = position_ids[:, None, :, None]
+    kv_pos = position_ids[:, None, None, :]
+    rope_ctx = (cos, sin, _chunk_mask(q_pos, kv_pos, base, args.attention_chunk_size),
+                base, _temp_scales(args, position_ids))
+    h, cache = _run_layers(params, args, h, rope_ctx, cache, positions=None,
+                           decode_bucket=None, mesh=mesh, rules=rules)
+    h = _norm(h, params["final_norm"], args)
+    h_last = jnp.take_along_axis(h, last_token_idx[:, None, None], axis=1)[:, 0]
+    logits = _lm_head(params, args, h_last, mesh, rules)
+    if return_hidden:
+        return logits, cache, h
+    return logits, cache
+
+
+def decode_forward(params: Params, args: Llama4ArchArgs, input_ids, position_ids,
+                   cache, decode_bucket, mesh=None, rules=None, block_table=None,
+                   slot_mapping=None, adapter_ids=None, tree=None,
+                   return_hidden=False):
+    b, t = input_ids.shape
+    h = _embed(params, args, input_ids, mesh, rules)
+    pos_grid = position_ids[:, None] + jnp.arange(t)[None, :]
+    cos, sin = rope_ops.compute_cos_sin(params["rope_inv_freq"], pos_grid,
+                                        args.rope_attention_scaling)
+    kv_pos = jnp.arange(decode_bucket)[None, None, None, :]
+    q_pos = pos_grid[:, None, :, None]
+    base = kv_pos <= q_pos
+    rope_ctx = (cos, sin, _chunk_mask(q_pos, kv_pos, base, args.attention_chunk_size),
+                base, _temp_scales(args, pos_grid))
+    h, cache = _run_layers(params, args, h, rope_ctx, cache, positions=position_ids,
+                           decode_bucket=decode_bucket, mesh=mesh, rules=rules)
+    h = _norm(h, params["final_norm"], args)
+    logits = _lm_head(params, args, h, mesh, rules)
+    if return_hidden:
+        return logits, cache, h
+    return logits, cache
+
+
+# --- config / application -------------------------------------------------------------
+
+
+class Llama4InferenceConfig(InferenceConfig):
+    REQUIRED_ATTRIBUTES = (
+        "hidden_size", "num_attention_heads", "num_hidden_layers",
+        "num_key_value_heads", "vocab_size", "intermediate_size",
+    )
+
+    def add_derived_config(self) -> None:
+        # accept either a full Llama4Config (text_config nested) or a bare text config
+        if hasattr(self, "text_config"):
+            tc = self.text_config
+            if not isinstance(tc, dict):
+                tc = tc.to_dict()
+            for k, v in tc.items():
+                if not k.startswith("_"):
+                    setattr(self, k, v)
+        n_layers = self.num_hidden_layers
+        for attr, default in (
+                ("rms_norm_eps", 1e-5), ("rope_theta", 500000.0),
+                ("rope_scaling", None), ("tie_word_embeddings", False),
+                ("attention_bias", False), ("hidden_act", "silu"),
+                ("head_dim", self.hidden_size // self.num_attention_heads),
+                ("attention_chunk_size", 8192),
+                ("attn_temperature_tuning", True),
+                ("floor_scale", 8192.0), ("attn_scale", 0.1),
+                ("use_qk_norm", True),
+                ("num_local_experts", None), ("num_experts_per_tok", 1),
+                ("interleave_moe_layer_step", 1),
+                ("intermediate_size_mlp", None), ("moe_layers", None),
+                ("no_rope_layers", None)):
+            if not hasattr(self, attr) or getattr(self, attr) is None:
+                setattr(self, attr, default)
+        if not self.no_rope_layers:
+            # HF default (also substituted for falsy [] like HF): every 4th is NoPE
+            self.no_rope_layers = [int((i + 1) % 4 != 0) for i in range(n_layers)]
+        if self.moe_layers is None and self.num_local_experts:
+            step = self.interleave_moe_layer_step
+            self.moe_layers = list(range(step - 1, n_layers, step))
+        if self.intermediate_size_mlp is None:
+            self.intermediate_size_mlp = self.intermediate_size
+
+
+class Llama4ForCausalLM(TpuModelForCausalLM):
+    """≈ NeuronLlama4ForCausalLM (text path)."""
+
+    def __init__(self, model_path, config, mesh=None):
+        self._require_base_layout(config.tpu_config, "Llama4")
+        super().__init__(model_path, config, mesh=mesh)
+
+    @classmethod
+    def get_config_cls(cls):
+        return Llama4InferenceConfig
+
+    @classmethod
+    def arch_args_from_config(cls, config) -> Llama4ArchArgs:
+        tp = config.tpu_config.tp_degree
+        n_layers = config.num_hidden_layers
+        moe_layers = set(config.moe_layers or [])
+        moe = None
+        if config.num_local_experts:
+            moe = MoEArgs(
+                num_experts=config.num_local_experts,
+                experts_per_tok=config.num_experts_per_tok,
+                router_mode="topk_sigmoid",
+                scale_expert_input=True,
+                norm_topk_prob=False,
+                shared_expert_intermediate_size=config.intermediate_size,
+                shared_expert_gated=False,
+            )
+        return Llama4ArchArgs(
+            vocab_size=config.vocab_size,
+            hidden_size=config.hidden_size,
+            num_layers=n_layers,
+            num_heads=config.num_attention_heads,
+            num_kv_heads=gqa.effective_kv_heads(tp, config.num_key_value_heads),
+            head_dim=config.head_dim,
+            intermediate_size=config.intermediate_size,
+            dense_intermediate_size=config.intermediate_size_mlp,
+            rms_norm_eps=config.rms_norm_eps,
+            activation=config.hidden_act,
+            attention_bias=config.attention_bias,
+            rope_attention_scaling=rope_ops.attention_scaling_from_hf_config(
+                config.rope_scaling),
+            tie_word_embeddings=config.tie_word_embeddings,
+            use_rope_layers=tuple(bool(x) for x in config.no_rope_layers),
+            moe_layer_flags=tuple(i in moe_layers for i in range(n_layers)),
+            attention_chunk_size=config.attention_chunk_size,
+            attn_temperature_tuning=bool(config.attn_temperature_tuning),
+            floor_scale=float(config.floor_scale),
+            attn_scale=float(config.attn_scale),
+            use_qk_norm=bool(config.use_qk_norm),
+            moe=moe,
+        )
+
+    @classmethod
+    def inv_freq_from_config(cls, config) -> np.ndarray:
+        return rope_ops.inv_freq_from_hf_config(
+            config.head_dim, config.rope_theta, config.rope_scaling)
+
+    def _use_flash_attention(self) -> bool:
+        if self.tpu_config.attention_kernel_enabled is True:
+            raise ValueError("the Pallas flash kernel does not support llama4's "
+                             "per-layer chunked/NoPE attention yet")
+        return False
+
+    def _use_ring_attention(self) -> bool:
+        if self.mesh.shape["cp"] > 1:
+            raise ValueError("context parallelism is not supported for llama4 yet")
+        return False
+
+    def prefill_fn(self):
+        return prefill_forward
+
+    def decode_fn(self):
+        return decode_forward
+
+    # --- param layout -----------------------------------------------------------------
+    def _attn_axes(self) -> Dict[str, Tuple]:
+        return {
+            "ln1": ("layers", None),
+            "ln2": ("layers", None),
+            "wq": ("layers", "embed", "heads"),
+            "wk": ("layers", "embed", "kv_heads"),
+            "wv": ("layers", "embed", "kv_heads"),
+            "wo": ("layers", "heads", "embed"),
+        }
+
+    def logical_axes(self) -> Dict:
+        a: Llama4ArchArgs = self.arch_args
+        out: Dict[str, Any] = {
+            "embed": ("vocab", "embed"),
+            "final_norm": (None,),
+            "rope_inv_freq": (None,),
+        }
+        if not a.tie_word_embeddings:
+            out["lm_head"] = ("embed", "vocab")
+        if not all(a.moe_layer_flags):
+            dense = dict(self._attn_axes())
+            dense.update({"wg": ("layers", "embed", "mlp"),
+                          "wu": ("layers", "embed", "mlp"),
+                          "wd": ("layers", "mlp", "embed")})
+            out["dense"] = dense
+        if any(a.moe_layer_flags):
+            moe_axes = dict(self._attn_axes())
+            moe_axes.update({
+                "router": ("layers", "embed", None),
+                "wg": ("layers", "experts", "embed", "expert_mlp"),
+                "wu": ("layers", "experts", "embed", "expert_mlp"),
+                "wd": ("layers", "experts", "expert_mlp", "embed"),
+                "shared_wg": ("layers", "embed", "mlp"),
+                "shared_wu": ("layers", "embed", "mlp"),
+                "shared_wd": ("layers", "mlp", "embed"),
+            })
+            out["moe"] = moe_axes
+        return out
+
+    def init_random_params(self, key) -> Dict:
+        a: Llama4ArchArgs = self.arch_args
+        dtype = self.tpu_config.jax_dtype
+        H, nh = a.hidden_size, a.num_heads
+        ks = iter(jax.random.split(key, 40))
+
+        def w(shape, scale=0.02):
+            return (jax.random.normal(next(ks), shape, dtype=jnp.float32)
+                    * scale).astype(dtype)
+
+        def attn_stack(L):
+            return {
+                "ln1": jnp.ones((L, H), dtype=dtype),
+                "ln2": jnp.ones((L, H), dtype=dtype),
+                "wq": w((L, H, a.q_size)),
+                "wk": w((L, H, a.kv_size)),
+                "wv": w((L, H, a.kv_size)),
+                "wo": w((L, a.q_size, H)),
+            }
+
+        params: Dict[str, Any] = {
+            "embed": w((a.vocab_size, H)),
+            "final_norm": jnp.ones((H,), dtype=dtype),
+            "rope_inv_freq": jnp.asarray(self.inv_freq_from_config(self.config),
+                                         dtype=jnp.float32),
+        }
+        if not a.tie_word_embeddings:
+            params["lm_head"] = w((H, a.vocab_size))
+        n_dense = sum(1 for f in a.moe_layer_flags if not f)
+        n_moe = len(a.moe_layer_flags) - n_dense
+        if n_dense:
+            dense = attn_stack(n_dense)
+            I = a.dense_intermediate_size
+            dense.update({"wg": w((n_dense, H, I)), "wu": w((n_dense, H, I)),
+                          "wd": w((n_dense, I, H))})
+            params["dense"] = dense
+        if n_moe:
+            moe_p = attn_stack(n_moe)
+            E, I = a.moe.num_experts, a.intermediate_size
+            moe_p.update({
+                "router": w((n_moe, H, E)),
+                "wg": w((n_moe, E, H, I)),
+                "wu": w((n_moe, E, H, I)),
+                "wd": w((n_moe, E, I, H)),
+                "shared_wg": w((n_moe, H, I)),
+                "shared_wu": w((n_moe, H, I)),
+                "shared_wd": w((n_moe, I, H)),
+            })
+            params["moe"] = moe_p
+        return params
+
+    @classmethod
+    def convert_hf_state_dict(cls, state_dict: Dict[str, np.ndarray],
+                              config) -> Dict:
+        args = cls.arch_args_from_config(config)
+        L = config.num_hidden_layers
+        n_kv, d = config.num_key_value_heads, config.head_dim
+        factor = args.num_kv_heads // n_kv
+        I = config.intermediate_size
+
+        def get(name):
+            if name not in state_dict:
+                raise KeyError(f"missing weight {name}")
+            return state_dict[name]
+
+        def linear_t(name):
+            return np.ascontiguousarray(get(name).T)
+
+        def attn_params(i):
+            p = f"model.layers.{i}."
+            return {
+                "ln1": get(p + "input_layernorm.weight"),
+                "ln2": get(p + "post_attention_layernorm.weight"),
+                "wq": linear_t(p + "self_attn.q_proj.weight"),
+                "wk": gqa.replicate_kv_weight(
+                    linear_t(p + "self_attn.k_proj.weight"), n_kv, d, factor),
+                "wv": gqa.replicate_kv_weight(
+                    linear_t(p + "self_attn.v_proj.weight"), n_kv, d, factor),
+                "wo": linear_t(p + "self_attn.o_proj.weight"),
+            }
+
+        def stack(dicts):
+            return {k: np.stack([x[k] for x in dicts]) for k in dicts[0]}
+
+        dense_layers, moe_layers = [], []
+        for i in range(L):
+            entry = attn_params(i)
+            f = f"model.layers.{i}.feed_forward."
+            if args.moe_layer_flags[i]:
+                gu = get(f + "experts.gate_up_proj")        # (E, H, 2I), (in, out)
+                entry.update({
+                    "router": linear_t(f + "router.weight"),
+                    "wg": gu[..., :I],
+                    "wu": gu[..., I:],
+                    "wd": get(f + "experts.down_proj"),     # (E, I, H)
+                    "shared_wg": linear_t(f + "shared_expert.gate_proj.weight"),
+                    "shared_wu": linear_t(f + "shared_expert.up_proj.weight"),
+                    "shared_wd": linear_t(f + "shared_expert.down_proj.weight"),
+                })
+                moe_layers.append(entry)
+            else:
+                entry.update({
+                    "wg": linear_t(f + "gate_proj.weight"),
+                    "wu": linear_t(f + "up_proj.weight"),
+                    "wd": linear_t(f + "down_proj.weight"),
+                })
+                dense_layers.append(entry)
+
+        params: Dict[str, Any] = {
+            "embed": get("model.embed_tokens.weight"),
+            "final_norm": get("model.norm.weight"),
+            "rope_inv_freq": cls.inv_freq_from_config(config),
+        }
+        if not args.tie_word_embeddings:
+            params["lm_head"] = linear_t("lm_head.weight")
+        if dense_layers:
+            params["dense"] = stack(dense_layers)
+        if moe_layers:
+            params["moe"] = stack(moe_layers)
+        return params

@@ -47,10 +47,14 @@ class MoEArgs:
     # sigmoid gate projected from the hidden state (0 = disabled)
     shared_expert_intermediate_size: int = 0
     # routing order: "softmax_topk" (Mixtral/Qwen: softmax over all experts, then
-    # top-k), "topk_softmax" (gpt-oss: top-k of raw logits, softmax over the k), or
+    # top-k), "topk_softmax" (gpt-oss: top-k of raw logits, softmax over the k),
     # "sigmoid_group" (DeepSeek-V3: sigmoid scores + e_score_correction_bias for
-    # *selection only*, group-limited top-k, gates from the raw sigmoid scores)
+    # *selection only*, group-limited top-k, gates from the raw sigmoid scores), or
+    # "topk_sigmoid" (Llama4: top-k of logits, sigmoid of the selected values)
     router_mode: str = "softmax_topk"
+    # Llama4 scales the expert *input* by the gate (x·g into the expert MLP) instead
+    # of weighting the expert output
+    scale_expert_input: bool = False
     # DeepSeek group-limited routing: experts partitioned into n_group groups; the
     # topk_group best groups (by sum of each group's top-2 biased scores) stay eligible
     n_group: int = 1
@@ -103,6 +107,9 @@ def route(router_w: jnp.ndarray, x: jnp.ndarray, moe: MoEArgs,
         if moe.norm_topk_prob:
             top_vals = top_vals / (jnp.sum(top_vals, axis=-1, keepdims=True) + 1e-20)
         top_vals = top_vals * moe.routed_scaling_factor
+    elif moe.router_mode == "topk_sigmoid":
+        top_vals, top_idx = jax.lax.top_k(logits, moe.experts_per_tok)
+        top_vals = jax.nn.sigmoid(top_vals)
     elif moe.router_mode == "topk_softmax":
         top_vals, top_idx = jax.lax.top_k(logits, moe.experts_per_tok)
         top_vals = jax.nn.softmax(top_vals, axis=-1)
@@ -129,14 +136,26 @@ def moe_block(lp, args, hn: jnp.ndarray, mesh, rules,
     (E, H, I), ``wd`` (E, I, H), plus optional shared-expert weights.
     """
     moe: MoEArgs = args.moe
+    if moe.scale_expert_input and moe.expert_bias:
+        # unselected experts see zero input but nonzero bias; the unweighted sum
+        # would add bias-derived garbage from every expert
+        raise ValueError("scale_expert_input requires bias-free expert MLPs")
     b, s, h = hn.shape
     x = hn.reshape(b * s, h)
     gates = route(lp["router"], x, moe, lp.get("router_b"),
                   lp.get("router_cb"))                              # (N, E) fp32
 
     # dense all-experts MLP: (E, N, I) intermediates, EP-sharded on E, TP on I
-    gate_proj = qeinsum("nh,ehi->eni", x, lp["wg"])
-    up_proj = qeinsum("nh,ehi->eni", x, lp["wu"])
+    if moe.scale_expert_input:
+        # Llama4: expert input pre-scaled by its gate (unselected experts see zeros,
+        # which the bias-free glu maps back to zero); combine is then an unweighted sum
+        xe = gates.astype(x.dtype).T[:, :, None] * x[None, :, :]    # (E, N, H)
+        xe = constrain(xe, ("experts", "batch", None), rules, mesh=mesh)
+        gate_proj = qeinsum("enh,ehi->eni", xe, lp["wg"])
+        up_proj = qeinsum("enh,ehi->eni", xe, lp["wu"])
+    else:
+        gate_proj = qeinsum("nh,ehi->eni", x, lp["wg"])
+        up_proj = qeinsum("nh,ehi->eni", x, lp["wu"])
     if moe.expert_bias:
         gate_proj = gate_proj + lp["bg"][:, None, :]
         up_proj = up_proj + lp["bu"][:, None, :]
@@ -153,8 +172,11 @@ def moe_block(lp, args, hn: jnp.ndarray, mesh, rules,
     per_expert = qeinsum("eni,eih->enh", inter, lp["wd"])           # (E, N, H)
     if moe.expert_bias:
         per_expert = per_expert + lp["bd"][:, None, :]
-    out = jnp.einsum("enh,ne->nh", per_expert,
-                     gates.astype(per_expert.dtype))                # sum over E: EP psum
+    if moe.scale_expert_input:
+        out = jnp.sum(per_expert, axis=0)                           # sum over E: EP psum
+    else:
+        out = jnp.einsum("enh,ne->nh", per_expert,
+                         gates.astype(per_expert.dtype))            # sum over E: EP psum
     out = constrain(out, ("batch", None), rules, mesh=mesh)
 
     if moe.shared_expert_intermediate_size:

@@ -144,6 +144,16 @@ def compute_cos_sin(inv_freq: jnp.ndarray, position_ids: jnp.ndarray,
     return (jnp.cos(emb) * attention_scaling, jnp.sin(emb) * attention_scaling)
 
 
+def deinterleave(x: jnp.ndarray) -> jnp.ndarray:
+    """[x0, x1, x2, ...] -> [x0, x2, ..., x1, x3, ...] on the last dim.
+
+    DeepSeek/Llama4 checkpoints store rope dims as interleaved complex pairs; after
+    this shared permutation of q AND k the standard rotate-half application yields
+    identical attention scores (scores are invariant to a permutation applied to both
+    operands of the q.k contraction)."""
+    return jnp.concatenate([x[..., 0::2], x[..., 1::2]], axis=-1)
+
+
 def rotate_half(x: jnp.ndarray) -> jnp.ndarray:
     half = x.shape[-1] // 2
     return jnp.concatenate([-x[..., half:], x[..., :half]], axis=-1)

@@ -111,6 +111,24 @@ class TpuModelForCausalLM:
         self.kv_cache = None
         self._build_steps()
 
+    @staticmethod
+    def _require_base_layout(tc: TpuConfig, family: str) -> None:
+        """Reject serving features that assume the base "layers" param/cache layout
+        (used by families with custom layouts, e.g. MLA/Llama4) — fail loudly at
+        construction rather than deep inside lax.scan tracing."""
+        unsupported = [name for name, v in (
+            ("lora_serving_config", tc.lora_serving_config),
+            ("quantization_config", tc.quantization_config),
+            ("speculation_config", tc.speculation_config),
+        ) if v is not None]
+        if tc.paged_attention_enabled:
+            unsupported.append("paged_attention_enabled")
+        if tc.is_continuous_batching:
+            unsupported.append("is_continuous_batching")
+        if unsupported:
+            raise ValueError(f"{', '.join(unsupported)} not supported for the "
+                             f"{family} family yet")
+
     # --- per-arch hooks (≈ get_config_cls / convert_hf_to_neuron_state_dict) ---------
     @classmethod
     def get_config_cls(cls):

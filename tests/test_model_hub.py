@@ -281,3 +281,25 @@ def test_deepseek_no_qlora_parity():
     torch.manual_seed(1)
     hf = HFDeepseek(cfg).eval()
     _run_parity(DeepseekForCausalLM, hf, cfg)
+
+
+def test_llama4_text_parity():
+    """Chunked/NoPE interleaved attention, qk L2 norm, temperature tuning, and
+    input-scaled top-1 MoE + shared expert vs HF Llama4 text CPU."""
+    from transformers import Llama4TextConfig
+    from transformers.models.llama4.modeling_llama4 import Llama4ForCausalLM as HFL4
+
+    from neuronx_distributed_inference_tpu.models.llama4 import Llama4ForCausalLM
+
+    cfg = Llama4TextConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=96,
+        intermediate_size_mlp=128, num_hidden_layers=4, num_attention_heads=4,
+        num_key_value_heads=2, head_dim=16, num_local_experts=4,
+        num_experts_per_tok=2, interleave_moe_layer_step=2,
+        attention_chunk_size=8, attn_temperature_tuning=True, floor_scale=4,
+        attn_scale=0.1, use_qk_norm=True, max_position_embeddings=512,
+        rope_theta=10000.0, tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    hf = HFL4(cfg).eval()
+    _run_parity(Llama4ForCausalLM, hf, cfg)
