@@ -16,6 +16,9 @@ _REGISTRY: Dict[str, str] = {
     "deepseek_v3": "neuronx_distributed_inference_tpu.models.deepseek.modeling_deepseek:DeepseekForCausalLM",
     "llama4": "neuronx_distributed_inference_tpu.models.llama4.modeling_llama4:Llama4ForCausalLM",
     "llama4_text": "neuronx_distributed_inference_tpu.models.llama4.modeling_llama4:Llama4ForCausalLM",
+    "mistral": "neuronx_distributed_inference_tpu.models.mistral.modeling_mistral:MistralForCausalLM",
+    "llava": "neuronx_distributed_inference_tpu.models.pixtral.modeling_pixtral:PixtralForConditionalGeneration",
+    "pixtral": "neuronx_distributed_inference_tpu.models.pixtral.modeling_pixtral:PixtralForConditionalGeneration",
 }
 
 

@@ -516,6 +516,11 @@ def prefill_forward(
     adapter_ids: Optional[jnp.ndarray] = None,   # (B,) multi-LoRA slots
     use_ring: bool = False,       # context-parallel prefill via ring attention
     return_hidden: bool = False,  # also return the full normed hidden states (B, S, H)
+    # multimodal embed merge: (mask (B, S, 1) bool, override (B, S, H)) — positions
+    # where mask is True take the override row (image embeds scattered at image-token
+    # positions, ≈ reference image-to-text pipelined vision→CTE merge,
+    # `models/image_to_text_model_base.py`)
+    merge_embeds: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
 ) -> Tuple[jnp.ndarray, kvcache.KVCache]:
     """Context encoding: returns (last-token logits (B, V) fp32, updated cache).
 
@@ -523,6 +528,9 @@ def prefill_forward(
     writes scatter to flat slots; with ``cache_batch_start`` the dense write lands at a
     specific batch row (continuous-batching insert)."""
     h = _embed(params, args, input_ids, mesh, rules)
+    if merge_embeds is not None:
+        mm_mask, mm_override = merge_embeds
+        h = jnp.where(mm_mask, mm_override.astype(h.dtype), h)
     cos, sin = rope_ops.compute_cos_sin(params["rope_inv_freq"], position_ids,
                                         args.rope_attention_scaling)
     s = input_ids.shape[1]

@@ -1,0 +1,3 @@
+from .modeling_mistral import MistralForCausalLM, MistralInferenceConfig
+
+__all__ = ["MistralForCausalLM", "MistralInferenceConfig"]

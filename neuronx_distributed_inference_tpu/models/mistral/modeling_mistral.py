@@ -1,0 +1,29 @@
+"""Mistral model family (the text side of Pixtral; llama-compatible + sliding window).
+
+≈ reference contrib mistral port; checkpoint layout is identical to llama
+(`models/llama/modeling_llama.py` conversion applies unchanged)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+from ..base import ModelArchArgs
+from ..llama.modeling_llama import LlamaForCausalLM, LlamaInferenceConfig
+
+
+class MistralInferenceConfig(LlamaInferenceConfig):
+    def add_derived_config(self) -> None:
+        super().add_derived_config()
+        if not hasattr(self, "sliding_window"):
+            self.sliding_window = None
+
+
+class MistralForCausalLM(LlamaForCausalLM):
+    @classmethod
+    def get_config_cls(cls):
+        return MistralInferenceConfig
+
+    @classmethod
+    def arch_args_from_config(cls, config) -> ModelArchArgs:
+        args = super().arch_args_from_config(config)
+        return dataclasses.replace(args, sliding_window=config.sliding_window)

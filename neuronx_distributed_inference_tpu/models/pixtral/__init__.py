@@ -1,0 +1,4 @@
+from .modeling_pixtral import (PixtralForConditionalGeneration,
+                               PixtralInferenceConfig)
+
+__all__ = ["PixtralForConditionalGeneration", "PixtralInferenceConfig"]

@@ -1,0 +1,221 @@
+"""Pixtral (Llava-architecture) image-to-text family.
+
+≈ reference `models/pixtral/` (423 + 614 LoC: PixtralVisionModel port + conditional
+generation). Components:
+
+- **Vision tower** (HF `PixtralVisionModel`): patchify-conv (done as a patch matmul —
+  MXU-friendly, identical math), RMS ln_pre, N attention layers with 2D rotary
+  (per-patch (h, w) frequency table), bias-free projections, gated-silu MLP, full
+  (non-causal) attention. Images are batched along the leading dim: HF concatenates
+  all images into one sequence under a block-diagonal mask, which is exactly
+  independent per-image attention.
+- **Projector** (HF `LlavaMultiModalProjector`): linear → act → linear into the text
+  hidden size.
+- **Text model**: Mistral via the shared functional core; image features replace the
+  token embeddings at image-token positions (runtime/image_to_text.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops.norms import rms_norm
+from ...runtime.image_to_text import (ImageToTextInferenceConfig,
+                                      TpuModelForImageToText)
+from ..mistral.modeling_mistral import MistralForCausalLM
+
+_VISION_ACTS = {
+    "gelu": jax.nn.gelu,
+    "silu": jax.nn.silu,
+    "gelu_pytorch_tanh": lambda x: jax.nn.gelu(x, approximate=True),
+}
+
+
+def pixtral_rope_table(head_dim: int, rope_theta: float, max_side: int) -> np.ndarray:
+    """(max_side^2, head_dim) per-position frequency table (HF PixtralRotaryEmbedding):
+    even head dims carry the row (h) frequencies, odd dims the column (w)."""
+    freqs = 1.0 / (rope_theta ** (np.arange(0, head_dim, 2, dtype=np.float64)
+                                  / head_dim))
+    h = np.arange(max_side, dtype=np.float64)
+    w = np.arange(max_side, dtype=np.float64)
+    freqs_h = np.outer(h, freqs[0::2])
+    freqs_w = np.outer(w, freqs[1::2])
+    table = np.concatenate([
+        np.repeat(freqs_h[:, None, :], max_side, axis=1),
+        np.repeat(freqs_w[None, :, :], max_side, axis=0),
+    ], axis=-1).reshape(max_side * max_side, head_dim // 2)
+    return np.concatenate([table, table], axis=-1).astype(np.float32)
+
+
+def _rotate_half(x):
+    half = x.shape[-1] // 2
+    return jnp.concatenate([-x[..., half:], x[..., :half]], axis=-1)
+
+
+def vision_encode(vp: Dict[str, Any], pixel_values: jnp.ndarray,
+                  *, patch_size: int, num_heads: int, eps: float = 1e-5,
+                  act: str = "gelu", projector_act: str = "gelu") -> jnp.ndarray:
+    """(N, C, H, W) -> (N, patches, H_text) image features.
+
+    Pure function closed over static geometry; jitted by the application."""
+    n, c, hh, ww = pixel_values.shape
+    p = patch_size
+    gh, gw = hh // p, ww // p
+    # patchify matmul == stride-p conv: (N, C, gh, p, gw, p) -> (N, gh*gw, C*p*p)
+    x = pixel_values.reshape(n, c, gh, p, gw, p).transpose(0, 2, 4, 1, 3, 5)
+    x = x.reshape(n, gh * gw, c * p * p)
+    h = x @ vp["patch_w"]                                   # (N, P, hidden)
+    h = rms_norm(h, vp["ln_pre"], eps)
+
+    # 2D rope: position id of patch (r, c) = r * max_side + c
+    max_side = int(np.sqrt(vp["rope_table"].shape[0]))
+    pos = (jnp.arange(gh)[:, None] * max_side + jnp.arange(gw)[None, :]).reshape(-1)
+    freqs = jnp.take(vp["rope_table"], pos, axis=0)         # (P, D)
+    cos, sin = jnp.cos(freqs), jnp.sin(freqs)
+
+    d = h.shape[-1] // num_heads
+    act_fn = _VISION_ACTS[act]
+
+    def layer(carry, lp):
+        hid = carry
+        hn = rms_norm(hid, lp["ln1"], eps)
+        q = (hn @ lp["wq"]).reshape(n, -1, num_heads, d).transpose(0, 2, 1, 3)
+        k = (hn @ lp["wk"]).reshape(n, -1, num_heads, d).transpose(0, 2, 1, 3)
+        v = (hn @ lp["wv"]).reshape(n, -1, num_heads, d).transpose(0, 2, 1, 3)
+        q = q * cos + _rotate_half(q) * sin
+        k = k * cos + _rotate_half(k) * sin
+        scores = jnp.einsum("nhqd,nhkd->nhqk", q, k) * (d ** -0.5)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+        attn = jnp.einsum("nhqk,nhkd->nhqd", probs, v)
+        attn = attn.transpose(0, 2, 1, 3).reshape(n, -1, num_heads * d)
+        hid = hid + attn @ lp["wo"]
+        hn = rms_norm(hid, lp["ln2"], eps)
+        hid = hid + (act_fn(hn @ lp["wg"]) * (hn @ lp["wu"])) @ lp["wd"]
+        return hid, None
+
+    h, _ = jax.lax.scan(layer, h, vp["layers"])
+
+    # multimodal projector into the text hidden size
+    proj_act = _VISION_ACTS[projector_act]
+    feats = proj_act(h @ vp["proj_w1"] + vp["proj_b1"])
+    return feats @ vp["proj_w2"] + vp["proj_b2"]
+
+
+from ..mistral.modeling_mistral import MistralInferenceConfig
+
+
+class PixtralInferenceConfig(ImageToTextInferenceConfig, MistralInferenceConfig):
+    REQUIRED_ATTRIBUTES = ("vision_config", "image_token_index")
+
+    def add_derived_config(self) -> None:
+        # flatten text_config, then fill the llama/mistral text defaults from the
+        # existing config classes (no duplicated default tables)
+        ImageToTextInferenceConfig.add_derived_config(self)
+        MistralInferenceConfig.add_derived_config(self)
+        for attr, default in (("projector_hidden_act", "gelu"),
+                              ("multimodal_projector_bias", True)):
+            if not hasattr(self, attr):
+                setattr(self, attr, default)
+        tower = self.vision_config.get("model_type", "pixtral")
+        if tower not in ("pixtral",):
+            raise ValueError(
+                f"only Pixtral vision towers are supported for the llava "
+                f"architecture yet (got vision tower {tower!r})")
+
+
+def _normalize_llava_keys(state_dict: Dict[str, Any]) -> Dict[str, Any]:
+    """Map HF's on-disk legacy Llava layout (``language_model.model.*``, bare
+    ``vision_tower.*``) onto the in-memory layout (``model.language_model.*`` etc.);
+    in-memory keys pass through unchanged."""
+    out = {}
+    for k, v in state_dict.items():
+        if k.startswith("language_model.model."):
+            k = "model.language_model." + k[len("language_model.model."):]
+        elif k == "language_model.lm_head.weight":
+            k = "lm_head.weight"
+        elif k.startswith("vision_tower.") or k.startswith("multi_modal_projector."):
+            k = "model." + k
+        out[k] = v
+    return out
+
+
+class PixtralForConditionalGeneration(TpuModelForImageToText, MistralForCausalLM):
+    """≈ reference pixtral conditional generation (HF Llava + PixtralVisionModel)."""
+
+    @classmethod
+    def get_config_cls(cls):
+        return PixtralInferenceConfig
+
+    def vision_encode_fn(self):
+        vc = self.config.vision_config
+        import functools
+
+        return functools.partial(
+            vision_encode,
+            patch_size=vc["patch_size"],
+            num_heads=vc["num_attention_heads"],
+            act=vc.get("hidden_act", "gelu"),
+            projector_act=self.config.projector_hidden_act,
+        )
+
+    @classmethod
+    def convert_hf_state_dict(cls, state_dict: Dict[str, np.ndarray], config) -> Dict:
+        # text side: strip the Llava prefix and reuse the llama/mistral converter
+        state_dict = _normalize_llava_keys(state_dict)
+        text_sd = {}
+        for k, v in state_dict.items():
+            if k.startswith("model.language_model."):
+                text_sd["model." + k[len("model.language_model."):]] = v
+            elif k == "lm_head.weight":
+                text_sd[k] = v
+        return super().convert_hf_state_dict(text_sd, config)
+
+    @classmethod
+    def convert_hf_vision_state_dict(cls, state_dict: Dict[str, np.ndarray],
+                                     config) -> Dict:
+        state_dict = _normalize_llava_keys(state_dict)
+        vc = config.vision_config
+        L = vc["num_hidden_layers"]
+        hidden = vc["hidden_size"]
+
+        def get(name):
+            if name not in state_dict:
+                raise KeyError(f"missing weight {name}")
+            return state_dict[name]
+
+        def linear_t(name):
+            return np.ascontiguousarray(get(name).T)
+
+        layers = {k: [] for k in ("ln1", "wq", "wk", "wv", "wo", "ln2",
+                                  "wg", "wu", "wd")}
+        for i in range(L):
+            p = f"model.vision_tower.transformer.layers.{i}."
+            layers["ln1"].append(get(p + "attention_norm.weight"))
+            layers["wq"].append(linear_t(p + "attention.q_proj.weight"))
+            layers["wk"].append(linear_t(p + "attention.k_proj.weight"))
+            layers["wv"].append(linear_t(p + "attention.v_proj.weight"))
+            layers["wo"].append(linear_t(p + "attention.o_proj.weight"))
+            layers["ln2"].append(get(p + "ffn_norm.weight"))
+            layers["wg"].append(linear_t(p + "feed_forward.gate_proj.weight"))
+            layers["wu"].append(linear_t(p + "feed_forward.up_proj.weight"))
+            layers["wd"].append(linear_t(p + "feed_forward.down_proj.weight"))
+
+        conv = get("model.vision_tower.patch_conv.weight")   # (hidden, C, p, p)
+        return {
+            "patch_w": np.ascontiguousarray(
+                conv.reshape(hidden, -1).T),                 # (C*p*p, hidden)
+            "ln_pre": get("model.vision_tower.ln_pre.weight"),
+            "layers": {k: np.stack(v) for k, v in layers.items()},
+            "rope_table": pixtral_rope_table(
+                hidden // vc["num_attention_heads"],
+                vc.get("rope_theta", 10000.0),
+                vc["image_size"] // vc["patch_size"]),
+            "proj_w1": linear_t("model.multi_modal_projector.linear_1.weight"),
+            "proj_b1": get("model.multi_modal_projector.linear_1.bias"),
+            "proj_w2": linear_t("model.multi_modal_projector.linear_2.weight"),
+            "proj_b2": get("model.multi_modal_projector.linear_2.bias"),
+        }

@@ -308,6 +308,7 @@ class TpuModelForCausalLM:
         state_dict = ckpt_lib.load_state_dict(path)
         host_params = self.convert_hf_state_dict(state_dict, self.config)
         self._put_params(host_params)
+        self._post_load_state_dict(state_dict)
         logger.info("loaded weights in %.1fs", time.time() - t0)
         lora_cfg = self.tpu_config.lora_serving_config
         if lora_cfg is not None and lora_cfg.lora_ckpt_paths:
@@ -321,6 +322,10 @@ class TpuModelForCausalLM:
                 logger.info("loaded LoRA adapter %r from %s (alpha=%s)",
                             name, adir, alpha)
             self.set_lora_adapters(sds, alphas=alphas)
+
+    def _post_load_state_dict(self, state_dict) -> None:
+        """Hook: called by load() with the already-read checkpoint (multimodal
+        subclasses convert their vision weights here without a second disk pass)."""
 
     def load_random(self, seed: int = 0) -> None:
         """Random weights at the configured shapes (tests / synthetic benchmarks)."""
@@ -432,6 +437,16 @@ class TpuModelForCausalLM:
         logger.info("warmup complete: %d CTE + %d TKG buckets",
                     len(self.cte_buckets), len(self.tkg_buckets))
 
+    def _run_prefill(self, padded, sampling_params, key, adapter_ids, mm=None):
+        """Dispatch the context-encoding graph (multimodal subclasses override to run
+        the embed-merge variant when image features are present)."""
+        if mm is not None:
+            raise ValueError("image features given but this application has no "
+                             "vision encoder (use an image-to-text family)")
+        return self._prefill_step(
+            self.params, padded.input_ids, padded.position_ids, padded.last_token_idx,
+            self.kv_cache, sampling_params, key, adapter_ids)
+
     # --- generation (≈ HF adapter `_sample` loop, `utils/hf_adapter.py:139-257`) ------
     def generate(
         self,
@@ -445,6 +460,7 @@ class TpuModelForCausalLM:
         return_logits: bool = False,
         collect_latency: bool = False,
         adapter_ids: Optional[np.ndarray] = None,   # (B,) multi-LoRA slots (0 = base)
+        _mm_embeds=None,   # (mask, override) from TpuModelForImageToText.generate
     ) -> GenerateOutput:
         if self.params is None:
             raise RuntimeError("load weights before generate")
@@ -481,9 +497,8 @@ class TpuModelForCausalLM:
 
         t_start = time.perf_counter()
         key, sub = jax.random.split(key)
-        tokens_dev, logits_dev, self.kv_cache = self._prefill_step(
-            self.params, padded.input_ids, padded.position_ids, padded.last_token_idx,
-            self.kv_cache, sampling_params, sub, adapter_ids)
+        tokens_dev, logits_dev, self.kv_cache = self._run_prefill(
+            padded, sampling_params, sub, adapter_ids, mm=_mm_embeds)
         tokens_dev.block_until_ready()
         ttft = time.perf_counter() - t_start
 

@@ -1,0 +1,209 @@
+"""Image-to-text application base: vision-encoder sub-model + embed-merge prefill.
+
+≈ reference `models/image_to_text_model_base.py` (`ImageToTextInferenceConfig` :34,
+`NeuronBaseForImageToText`: separate text/vision ModelBuilders, vision-encoder
+ModelWrapper pipelined into the text CTE) and `models/encoder_base.py`. TPU redesign:
+
+- The vision encoder is its own jitted function over its own param pytree (≈ a separate
+  ModelWrapper/NEFF); the text model is the unchanged causal-LM stack.
+- Image features are merged by *embedding override*: the text prefill takes an optional
+  (mask, override) pair and replaces token-embedding rows at image-token positions
+  (≈ HF `masked_scatter` merge, which the reference's pipelined execution reproduces
+  on device).
+- `generate(pixel_values=...)` encodes all images in one batched vision call (images
+  attend only within themselves, so batching the vision encoder over images is exactly
+  the reference's block-diagonal mask over a concatenated sequence), scatters features
+  into the *padded* prompt (so bucket padding / row compaction cannot misalign them),
+  and runs the multimodal prefill graph.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..config import InferenceConfig
+from .application import GenerateOutput, TpuModelForCausalLM
+
+__all__ = ["ImageToTextInferenceConfig", "TpuModelForImageToText"]
+
+
+class ImageToTextInferenceConfig(InferenceConfig):
+    """Text + vision config pair (≈ reference ImageToTextInferenceConfig).
+
+    HF multimodal configs nest ``text_config``/``vision_config``; the text attributes
+    are flattened onto this object (the causal-LM base reads them) while the vision
+    dict stays available as ``vision_config``.
+    """
+
+    REQUIRED_ATTRIBUTES = ("vision_config",)
+
+    def add_derived_config(self) -> None:
+        if hasattr(self, "text_config"):
+            tc = self.text_config
+            if not isinstance(tc, dict):
+                tc = tc.to_dict()
+            # text attrs are authoritative for the LM: the OUTER HF config serializes
+            # top-level defaults (e.g. tie_word_embeddings=True) that must not shadow
+            # the text model's values
+            for k, v in tc.items():
+                if not k.startswith("_"):
+                    setattr(self, k, v)
+        if hasattr(self, "vision_config") and not isinstance(self.vision_config, dict):
+            self.vision_config = self.vision_config.to_dict()
+
+
+class TpuModelForImageToText(TpuModelForCausalLM):
+    """Causal LM + vision encoder sub-model (≈ NeuronBaseForImageToText).
+
+    Families implement ``vision_encode_fn`` (pure: (vision_params, pixel_values) ->
+    (N_images, tokens_per_image, text_hidden)) and
+    ``convert_hf_vision_state_dict``; the text side is inherited unchanged.
+    """
+
+    def __init__(self, model_path, config, mesh=None):
+        super().__init__(model_path, config, mesh=mesh)
+        self.vision_params = None
+        self._encode_step = jax.jit(self.vision_encode_fn())
+        self._mm_prefill_step = self._build_mm_prefill()
+
+    # --- per-family hooks -------------------------------------------------------------
+    def vision_encode_fn(self):
+        """Return the pure vision-encoder function (vision_params, pixel_values) ->
+        (N, T_img, H_text) image features (already projected to text hidden size)."""
+        raise NotImplementedError
+
+    @classmethod
+    def convert_hf_vision_state_dict(cls, state_dict, config) -> Dict:
+        raise NotImplementedError
+
+    @property
+    def image_token_index(self) -> int:
+        return self.config.image_token_index
+
+    # --- weights ----------------------------------------------------------------------
+    # vision params are replicated (vision towers are small relative to the LM;
+    # shard via a vision logical-axes hook later if profiling justifies)
+
+    def _post_load_state_dict(self, state_dict) -> None:
+        # hook from TpuModelForCausalLM.load: reuse the already-read checkpoint
+        # instead of a second multi-GB disk pass
+        self.load_vision_from_state_dict(state_dict)
+
+    def load_vision_from_state_dict(self, state_dict) -> None:
+        host = self.convert_hf_vision_state_dict(state_dict, self.config)
+        self._put_vision_params(host)
+
+    def _put_vision_params(self, host: Dict) -> None:
+        dtype = self.tpu_config.jax_dtype
+
+        def _put(x):
+            arr = np.asarray(x)
+            if arr.dtype.kind == "f" or arr.dtype.name == "bfloat16":
+                arr = arr.astype(dtype)
+            return jax.device_put(arr)
+
+        self.vision_params = jax.tree.map(_put, host)
+
+    # --- multimodal prefill graph -----------------------------------------------------
+    def _build_mm_prefill(self):
+        args = self.arch_args
+        mesh = self.mesh
+        rules = self.sharding_rules
+        odsc = self.sampling_config
+        prefill_core = self.prefill_fn()
+        from ..ops import sampling as sampling_ops
+
+        precision = ("highest" if self.tpu_config.dtype == "float32" else "default")
+        # mirror _build_steps' strategy selection exactly (ring excludes flash)
+        use_ring = self._use_ring_attention()
+        use_flash = (not use_ring) and self._use_flash_attention()
+
+        def _prefill_mm(params, input_ids, position_ids, last_token_idx, cache,
+                        sampling_params, key, mm_mask, mm_override, adapter_ids=None):
+            with jax.default_matmul_precision(precision):
+                logits, cache = prefill_core(
+                    params, args, input_ids, position_ids, last_token_idx, cache,
+                    mesh=mesh, rules=rules, use_flash=use_flash, use_ring=use_ring,
+                    adapter_ids=adapter_ids,
+                    merge_embeds=(mm_mask, mm_override))
+                tokens = sampling_ops.sample(logits, sampling_params, key, odsc)
+            return tokens, logits, cache
+
+        return jax.jit(_prefill_mm, donate_argnums=(4,))
+
+    def encode_images(self, pixel_values: np.ndarray) -> np.ndarray:
+        """(N_images, C, H, W) -> (N_images, T_img, H_text) via the jitted encoder."""
+        if self.vision_params is None:
+            raise RuntimeError("load vision weights before encoding images")
+        return np.asarray(self._encode_step(self.vision_params, pixel_values))
+
+    # --- warmup -----------------------------------------------------------------------
+    def warmup(self) -> None:
+        """Also compile the vision encoder and the multimodal prefill graphs, so the
+        first image request doesn't pay XLA compilation (extends the base warmup
+        contract, ≈ `application_base.py:348`)."""
+        super().warmup()
+        if self.vision_params is None:
+            return
+        vc = self.config.vision_config
+        side = vc.get("image_size")
+        chans = vc.get("num_channels", 3)
+        if side:
+            pixels = np.zeros((1, chans, side, side), dtype=np.float32)
+            self.encode_images(pixels)
+        from ..ops import sampling as sampling_ops
+
+        b = self.tpu_config.max_batch_size
+        sp = sampling_ops.prepare_sampling_params(b)
+        key = jax.random.PRNGKey(0)
+        h = self.arch_args.hidden_size
+        for bucket in self.cte_buckets:
+            self.reset_cache()
+            ids = np.zeros((b, bucket), dtype=np.int32)
+            pos = np.broadcast_to(np.arange(bucket, dtype=np.int32), (b, bucket)).copy()
+            last = np.zeros((b,), dtype=np.int32)
+            pm = np.zeros((b, bucket, 1), dtype=bool)
+            po = np.zeros((b, bucket, h), dtype=np.float32)
+            tokens, _, self.kv_cache = self._mm_prefill_step(
+                self.params, ids, pos, last, self.kv_cache, sp, key, pm, po)
+            tokens.block_until_ready()
+        self.reset_cache()
+
+    # --- generation -------------------------------------------------------------------
+    def generate(self, input_ids: np.ndarray, pixel_values: Optional[np.ndarray] = None,
+                 **kwargs) -> GenerateOutput:
+        """`generate` with optional images.
+
+        ``pixel_values`` (N_images, C, H, W): every image-token position in
+        ``input_ids`` (== config.image_token_index) receives one image-feature row, in
+        image order — rows must carry exactly T_img image tokens per image, matching
+        HF's placeholder convention."""
+        if pixel_values is None:
+            return super().generate(input_ids, **kwargs)
+        feats = self.encode_images(np.asarray(pixel_values))   # (N, T_img, H)
+        flat = feats.reshape(-1, feats.shape[-1])
+        # the scatter happens against the PADDED ids inside _run_prefill — padding /
+        # row compaction must not misalign features, so only the flat rows travel here
+        return super().generate(input_ids, _mm_embeds=flat, **kwargs)
+
+    # hook used by TpuModelForCausalLM.generate to run the mm prefill graph
+    def _run_prefill(self, padded, sampling_params, key, adapter_ids, mm=None):
+        if mm is None:
+            return super()._run_prefill(padded, sampling_params, key, adapter_ids)
+        flat_feats = mm                                        # (n_rows, H)
+        ids = np.asarray(padded.input_ids)
+        mask = ids == self.image_token_index                   # padded positions
+        n_positions = int(mask.sum())
+        if n_positions != flat_feats.shape[0]:
+            raise ValueError(
+                f"prompt holds {n_positions} image tokens but images produced "
+                f"{flat_feats.shape[0]} feature rows")
+        override = np.zeros(ids.shape + (flat_feats.shape[-1],), dtype=np.float32)
+        override[mask] = flat_feats
+        return self._mm_prefill_step(
+            self.params, padded.input_ids, padded.position_ids,
+            padded.last_token_idx, self.kv_cache, sampling_params, key,
+            mask[..., None], override, adapter_ids)
