@@ -19,6 +19,7 @@ _REGISTRY: Dict[str, str] = {
     "mistral": "neuronx_distributed_inference_tpu.models.mistral.modeling_mistral:MistralForCausalLM",
     "llava": "neuronx_distributed_inference_tpu.models.pixtral.modeling_pixtral:PixtralForConditionalGeneration",
     "pixtral": "neuronx_distributed_inference_tpu.models.pixtral.modeling_pixtral:PixtralForConditionalGeneration",
+    "mllama": "neuronx_distributed_inference_tpu.models.mllama.modeling_mllama:MllamaForConditionalGeneration",
 }
 
 

@@ -1,0 +1,4 @@
+from .modeling_mllama import (MllamaForConditionalGeneration,
+                              MllamaInferenceConfig)
+
+__all__ = ["MllamaForConditionalGeneration", "MllamaInferenceConfig"]

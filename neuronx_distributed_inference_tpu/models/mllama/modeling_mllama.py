@@ -1,0 +1,821 @@
+"""MLlama (Llama-3.2 Vision) family: cross-attention multimodal.
+
+≈ reference `models/mllama/` (1340 + 623 LoC: cross-attention text model +
+`MultimodalKVCacheManager`). Architecture (matches HF mllama):
+
+- **Vision tower**: tiled ViT — patch conv, pre/post tile aspect-ratio embeddings
+  (gated), class token, gated positional embedding, LayerNorm encoder layers, a gated
+  global transformer, and output = concat(final, selected intermediate layer states).
+- **Text model**: llama self-attention layers interleaved with *cross-attention*
+  layers (`cross_attention_layers` indices): q from text (per-head RMSNorm), k/v from
+  the projected vision states (computed ONCE at prefill), tanh-gated residuals, and a
+  full-text-row mask that zeroes the ffn contribution for tokens with no visible image.
+- **Multimodal KV**: the cross-attention K/V are static per request; they live in the
+  cache pytree (``xk``/``xv``) next to the self-attention cache, which is exactly the
+  reference's MultimodalKVCacheManager (`modules/kvcache/`) — and it lets the
+  unmodified decode loop thread them through donation. The decode-time cross-attention
+  mask (last prompt token's row, ≈ HF generate semantics) rides along as ``xmask_dec``/
+  ``xfull_dec``.
+- Text-only requests degrade gracefully: zero vision KV + all-masked rows make every
+  cross layer an exact identity (attn out of zero V is zero; the full-row mask zeroes
+  the ffn), mirroring HF's skip-cross-layers path without a second graph.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...config import InferenceConfig
+from ...modules import gqa, kvcache
+from ...ops import rope as rope_ops
+from ...ops.norms import layer_norm, rms_norm
+from ...parallel.sharding import constrain, named_sharding
+from ..base import (ModelArchArgs, Params, _ACTIVATIONS, _decoder_layer, _embed,
+                    _lm_head, _norm, attend, causal_mask)
+from ...runtime.application import TpuModelForCausalLM
+
+NEG_INF = jnp.finfo(jnp.float32).min
+
+
+@dataclass(frozen=True)
+class MllamaArchArgs(ModelArchArgs):
+    cross_attention_layers: Tuple[int, ...] = ()
+    vision_tokens: int = 0        # static T_vis = max_media * tiles * (patches + 1)
+
+
+# --- text side ------------------------------------------------------------------------
+
+
+def _cross_layer(lp: Params, args: MllamaArchArgs, h, xk, xv, xmask, xfull,
+                 mesh, rules):
+    """Cross-attention decoder layer (HF MllamaCrossAttentionDecoderLayer).
+
+    xk/xv: (B, H_kv, T_vis, D) static vision KV. xmask: (B, S, T_vis) bool allowed.
+    xfull: (B, S, 1) float 0/1 — rows with no visible image get 0 (their ffn output is
+    zeroed; their attention mask flattens to uniform over the zero KV -> exact zero).
+    """
+    resid = h
+    hn = rms_norm(h, lp["ln1"], args.rms_norm_eps)
+    b, s, _ = hn.shape
+    q = (hn @ lp["wq"]).reshape(b, s, args.num_heads, args.head_dim).transpose(0, 2, 1, 3)
+    q = rms_norm(q, lp["q_norm"], args.rms_norm_eps)
+    # attend() reproduces the HF dead-row trick: an all-masked row softmaxes uniform
+    # over the zero vision V -> exact zero attention output
+    attn = attend(q, xk.astype(q.dtype), xv.astype(q.dtype), mask=xmask[:, None],
+                  scale=args.head_dim ** -0.5)
+    attn = attn.transpose(0, 2, 1, 3).reshape(b, s, args.q_size)
+    attn_out = attn @ lp["wo"]
+    attn_out = constrain(attn_out, ("batch", None, None), rules, mesh=mesh)
+    h = resid + jnp.tanh(lp["gate_attn"]) * attn_out
+
+    resid = h
+    hn = rms_norm(h, lp["ln2"], args.rms_norm_eps)
+    act = _ACTIVATIONS[args.activation]
+    ffn = (act(hn @ lp["wg"]) * (hn @ lp["wu"])) @ lp["wd"]
+    # full-text-row mask zeroes the ffn for image-less tokens (cast keeps bf16 runs
+    # from being silently promoted to f32 by the mask multiply)
+    ffn = ffn * xfull.astype(ffn.dtype)
+    ffn = constrain(ffn, ("batch", None, None), rules, mesh=mesh)
+    h = resid + jnp.tanh(lp["gate_mlp"]) * ffn
+    return h
+
+
+def _compute_cross_kv(xlayers: Params, args: MllamaArchArgs,
+                      cross_states: jnp.ndarray):
+    """(B, T_vis, H) projected vision states -> per-cross-layer static K/V stacks
+    (L_cross, B, H_kv, T_vis, D), with per-head k RMSNorm (HF MllamaTextCrossAttention)."""
+    b, t, _ = cross_states.shape
+
+    def one(lp):
+        k = (cross_states @ lp["wk"]).reshape(b, t, args.num_kv_heads, args.head_dim)
+        k = k.transpose(0, 2, 1, 3)
+        k = rms_norm(k, lp["k_norm"], args.rms_norm_eps)
+        v = (cross_states @ lp["wv"]).reshape(b, t, args.num_kv_heads, args.head_dim)
+        v = v.transpose(0, 2, 1, 3)
+        return k, v
+
+    return jax.vmap(one)(xlayers)
+
+
+def _segment_runs(flags: Tuple[bool, ...]) -> List[Tuple[bool, int, int, int]]:
+    runs = []
+    counts = {True: 0, False: 0}
+    i = 0
+    while i < len(flags):
+        j = i
+        while j < len(flags) and flags[j] == flags[i]:
+            j += 1
+        runs.append((flags[i], i, j - i, counts[flags[i]]))
+        counts[flags[i]] += j - i
+        i = j
+    return runs
+
+
+def _run_text_layers(params: Params, args: MllamaArchArgs, h, cos, sin, mask, cache,
+                     xmask, xfull, positions, decode_bucket, mesh, rules):
+    """Interleave self-attention scans with cross-attention layers.
+
+    Self layers scan in contiguous runs (unrolled at cross boundaries — the reference
+    traces fully unrolled, see models/llama4 note)."""
+    is_cross = tuple(i in args.cross_attention_layers
+                     for i in range(args.num_layers))
+    k_all, v_all = cache["k"], cache["v"]          # (L_self, ...) self-attn cache only
+    xk_all, xv_all = cache["xk"], cache["xv"]      # (L_cross, B, H_kv, T_vis, D)
+    new_k = [None] * sum(1 for f in is_cross if not f)
+    new_v = [None] * sum(1 for f in is_cross if not f)
+
+    for cross, g0, n, l0 in _segment_runs(is_cross):
+        if cross:
+            for idx in range(n):
+                lp = jax.tree.map(lambda x: x[l0 + idx], params["xlayers"])
+                h = _cross_layer(lp, args, h, xk_all[l0 + idx], xv_all[l0 + idx],
+                                 xmask, xfull, mesh, rules)
+        else:
+            stack = jax.tree.map(lambda x: x[l0:l0 + n], params["layers"])
+            xs = (stack, k_all[l0:l0 + n], v_all[l0:l0 + n])
+
+            def body(carry_h, layer_xs):
+                lp, kc, vc = layer_xs
+                nh, kc, vc = _decoder_layer(lp, args, carry_h, cos, sin, mask, kc, vc,
+                                            positions, decode_bucket, mesh, rules)
+                return nh, (kc, vc)
+
+            h, (ks, vs) = jax.lax.scan(body, h, xs)
+            for idx in range(n):
+                new_k[l0 + idx] = ks[idx:idx + 1]
+                new_v[l0 + idx] = vs[idx:idx + 1]
+    new_cache = dict(cache)
+    new_cache["k"] = jnp.concatenate(new_k, axis=0)
+    new_cache["v"] = jnp.concatenate(new_v, axis=0)
+    return h, new_cache
+
+
+def prefill_forward(params: Params, args: MllamaArchArgs, input_ids, position_ids,
+                    last_token_idx, cache, cross_states, xmask, xfull,
+                    xmask_dec, xfull_dec, mesh=None, rules=None):
+    """Context encoding with vision cross-attention.
+
+    cross_states (B, T_vis, H): projected vision features (zeros for text-only).
+    xmask/xfull: per-prompt-token cross-attention visibility.
+    xmask_dec/xfull_dec: the visibility row decode steps will use; stored in the cache.
+    """
+    h = _embed(params, args, input_ids, mesh, rules)
+    cos, sin = rope_ops.compute_cos_sin(params["rope_inv_freq"], position_ids,
+                                        args.rope_attention_scaling)
+    s = input_ids.shape[1]
+    mask = (position_ids[:, None, :, None] >= position_ids[:, None, None, :])
+    mask = jnp.logical_and(mask, causal_mask(s, s)[None, None])
+
+    xk, xv = _compute_cross_kv(params["xlayers"], args, cross_states)
+    cache = dict(cache)
+    cache["xk"], cache["xv"] = xk, xv
+    cache["xmask_dec"], cache["xfull_dec"] = xmask_dec, xfull_dec
+
+    h, cache = _run_text_layers(params, args, h, cos, sin, mask, cache,
+                                xmask, xfull, positions=None, decode_bucket=None,
+                                mesh=mesh, rules=rules)
+    h = _norm(h, params["final_norm"], args)
+    h_last = jnp.take_along_axis(h, last_token_idx[:, None, None], axis=1)[:, 0]
+    logits = _lm_head(params, args, h_last, mesh, rules)
+    return logits, cache
+
+
+def decode_forward(params: Params, args: MllamaArchArgs, input_ids, position_ids,
+                   cache, decode_bucket, mesh=None, rules=None, block_table=None,
+                   slot_mapping=None, adapter_ids=None, tree=None,
+                   return_hidden=False):
+    """Token generation; vision KV and the decode cross mask come from the cache."""
+    b, t = input_ids.shape
+    h = _embed(params, args, input_ids, mesh, rules)
+    pos_grid = position_ids[:, None] + jnp.arange(t)[None, :]
+    cos, sin = rope_ops.compute_cos_sin(params["rope_inv_freq"], pos_grid,
+                                        args.rope_attention_scaling)
+    kv_pos = jnp.arange(decode_bucket)[None, None, None, :]
+    q_pos = pos_grid[:, None, :, None]
+    mask = kv_pos <= q_pos
+    xmask = jnp.broadcast_to(cache["xmask_dec"][:, None, :],
+                             (b, t, args.vision_tokens))
+    xfull = jnp.broadcast_to(cache["xfull_dec"][:, None, :], (b, t, 1))
+    h, cache = _run_text_layers(params, args, h, cos, sin, mask, cache,
+                                xmask, xfull, positions=position_ids,
+                                decode_bucket=decode_bucket, mesh=mesh, rules=rules)
+    h = _norm(h, params["final_norm"], args)
+    logits = _lm_head(params, args, h, mesh, rules)
+    if return_hidden:
+        return logits, cache, h
+    return logits, cache
+
+
+# --- vision side ----------------------------------------------------------------------
+
+
+def vision_encode(vp: Dict[str, Any], pixel_values, aspect_ratio_ids,
+                  aspect_ratio_mask, *, patch_size: int, num_heads: int,
+                  intermediate_indices: Tuple[int, ...], norm_eps: float = 1e-5,
+                  act=jax.nn.gelu):
+    """HF MllamaVisionModel.forward, functional.
+
+    pixel_values (B, M, T, C, H, W); aspect_ratio_ids (B, M); aspect_ratio_mask
+    (B, M, T). Returns (B, M*T*P, hidden*(1+len(intermediate))) UNPROJECTED vision
+    states (the multimodal projector runs in the text-side prefill wrapper so its
+    output feeds the cross KV directly)."""
+    b, m, ntiles, c, hh, ww = pixel_values.shape
+    p = patch_size
+    gh, gw = hh // p, ww // p
+    n_patch = gh * gw
+    hidden = vp["patch_w"].shape[-1]
+
+    x = pixel_values.reshape(b * m * ntiles, c, gh, p, gw, p).transpose(0, 2, 4, 1, 3, 5)
+    x = x.reshape(b * m * ntiles, n_patch, c * p * p)
+    h = x @ vp["patch_w"]                                    # (BMT, P, hidden)
+
+    ar_ids = aspect_ratio_ids.reshape(b * m)
+    # pre-tile embedding (gated)
+    pre = jnp.take(vp["pre_tile_embed"], ar_ids, axis=0).reshape(
+        b * m, ntiles, 1, hidden)
+    h = h.reshape(b * m, ntiles, n_patch, hidden) + jnp.tanh(vp["pre_tile_gate"]) * pre
+    # class token
+    h = h.reshape(b * m * ntiles, n_patch, hidden)
+    cls = jnp.broadcast_to(vp["class_embed"], (b * m * ntiles, 1, hidden))
+    h = jnp.concatenate([cls, h], axis=1)
+    n_patch += 1
+    # gated positional embedding
+    h = h.reshape(b * m, ntiles, n_patch, hidden)
+    gate = jnp.tanh(vp["pos_gate"])
+    h = h + (1 - gate) * vp["pos_embed"][None, None]
+    tile_pos = jnp.take(vp["tile_pos_embed"], ar_ids, axis=0).reshape(
+        b * m, ntiles, n_patch, hidden)
+    h = h + gate * tile_pos
+    h = layer_norm(h, vp["ln_pre_w"], vp["ln_pre_b"], eps=norm_eps)
+
+    # pad patches to a multiple of 8 (HF) and build the tile attention mask
+    pad = (8 - (n_patch % 8)) % 8
+    if pad:
+        h = jnp.pad(h, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    pt = n_patch + pad
+    tile_ok = aspect_ratio_mask.reshape(b * m, ntiles, 1).astype(jnp.float32)
+    tok_ok = jnp.broadcast_to(tile_ok, (b * m, ntiles, pt)).reshape(b * m, -1)
+    if pad:
+        tok_ok = tok_ok.reshape(b * m, ntiles, pt).at[:, :, -pad:].set(0.0)
+        tok_ok = tok_ok.reshape(b * m, -1)
+    # HF: mask = (1-ok) @ (1-ok)^T * -inf  -> allowed iff BOTH tokens are live
+    dead = 1.0 - tok_ok
+    additive = (dead[:, :, None] @ dead[:, None, :]) * NEG_INF   # (BM, T, T)
+    additive = additive[:, None]                                  # (BM, 1, T, T)
+
+    d = hidden // num_heads
+    seq = ntiles * pt
+
+    def encoder_layer(hid, lp, gated):
+        hn = layer_norm(hid, lp["ln1_w"], lp["ln1_b"], eps=norm_eps)
+        q = (hn @ lp["wq"]).reshape(b * m, seq, num_heads, d).transpose(0, 2, 1, 3)
+        k = (hn @ lp["wk"]).reshape(b * m, seq, num_heads, d).transpose(0, 2, 1, 3)
+        v = (hn @ lp["wv"]).reshape(b * m, seq, num_heads, d).transpose(0, 2, 1, 3)
+        scores = jnp.einsum("nhqd,nhkd->nhqk", q, k) * (d ** -0.5) + additive
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+        attn = jnp.einsum("nhqk,nhkd->nhqd", probs, v)
+        attn = attn.transpose(0, 2, 1, 3).reshape(b * m, seq, hidden)
+        attn = attn @ lp["wo"]
+        if gated:
+            attn = jnp.tanh(lp["gate_attn"]) * attn
+        hid = hid + attn
+        hn = layer_norm(hid, lp["ln2_w"], lp["ln2_b"], eps=norm_eps)
+        ffn = act(hn @ lp["fc1"] + lp["b1"]) @ lp["fc2"] + lp["b2"]
+        if gated:
+            ffn = jnp.tanh(lp["gate_ffn"]) * ffn
+        return hid + ffn
+
+    h = h.reshape(b * m, seq, hidden)
+
+    def local_body(hid, lp):
+        return encoder_layer(hid, lp, gated=False), hid     # ys = layer INPUT (HF)
+
+    h, inputs_per_layer = jax.lax.scan(local_body, h, vp["layers"])
+    intermediates = jnp.stack([inputs_per_layer[i] for i in intermediate_indices],
+                              axis=-1)                       # (BM, seq, hidden, K)
+
+    h = layer_norm(h, vp["ln_post_w"], vp["ln_post_b"], eps=norm_eps)
+    post = jnp.take(vp["post_tile_embed"], ar_ids, axis=0).reshape(
+        b * m, ntiles, 1, hidden)
+    h = h.reshape(b * m, ntiles, pt, hidden) + jnp.tanh(vp["post_tile_gate"]) * post
+    h = h.reshape(b * m, seq, hidden)
+
+    def global_body(hid, lp):
+        return encoder_layer(hid, lp, gated=True), None
+
+    h, _ = jax.lax.scan(global_body, h, vp["global_layers"])
+
+    # un-pad and concat intermediates (HF: final first, then intermediates)
+    h = h.reshape(b * m, ntiles, pt, hidden)[:, :, :n_patch]
+    inter = intermediates.reshape(b * m, ntiles, pt, hidden * len(intermediate_indices))
+    inter = inter[:, :, :n_patch]
+    out = jnp.concatenate([h, inter], axis=-1)
+    return out.reshape(b, m * ntiles * n_patch, -1)
+
+
+# --- config / application -------------------------------------------------------------
+
+
+class MllamaInferenceConfig(InferenceConfig):
+    REQUIRED_ATTRIBUTES = ("vision_config", "text_config")
+
+    def add_derived_config(self) -> None:
+        tc = self.text_config
+        if not isinstance(tc, dict):
+            tc = tc.to_dict()
+        for k, v in tc.items():
+            if not k.startswith("_"):
+                setattr(self, k, v)
+        if not isinstance(self.vision_config, dict):
+            self.vision_config = self.vision_config.to_dict()
+        if not hasattr(self, "head_dim") or self.head_dim is None:
+            self.head_dim = self.hidden_size // self.num_attention_heads
+        for attr, default in (("rms_norm_eps", 1e-5), ("rope_theta", 500000.0),
+                              ("rope_scaling", None), ("tie_word_embeddings", False),
+                              ("hidden_act", "silu"),
+                              ("max_num_media", 1)):
+            if not hasattr(self, attr):
+                setattr(self, attr, default)
+
+    @property
+    def vision_tokens_per_tile(self) -> int:
+        vc = self.vision_config
+        return (vc["image_size"] // vc["patch_size"]) ** 2 + 1
+
+    @property
+    def total_vision_tokens(self) -> int:
+        return (self.max_num_media * self.vision_config["max_num_tiles"]
+                * self.vision_tokens_per_tile)
+
+
+class MllamaForConditionalGeneration(TpuModelForCausalLM):
+    """≈ NeuronMllamaForConditionalGeneration (`models/mllama/`)."""
+
+    def __init__(self, model_path, config, mesh=None):
+        self._require_base_layout(config.tpu_config, "MLlama")
+        super().__init__(model_path, config, mesh=mesh)
+        self.vision_params = None
+        vc = config.vision_config
+        import functools
+
+        self._encode_fn = functools.partial(
+            vision_encode,
+            patch_size=vc["patch_size"],
+            num_heads=vc["attention_heads"],
+            intermediate_indices=tuple(vc["intermediate_layers_indices"]),
+            norm_eps=vc.get("norm_eps", 1e-5),
+            act=_ACTIVATIONS.get(vc.get("hidden_act", "gelu"), jax.nn.gelu),
+        )
+        self._xprefill_step = self._build_xprefill()
+
+    @classmethod
+    def get_config_cls(cls):
+        return MllamaInferenceConfig
+
+    @classmethod
+    def arch_args_from_config(cls, config) -> MllamaArchArgs:
+        tp = config.tpu_config.tp_degree
+        return MllamaArchArgs(
+            vocab_size=config.vocab_size,
+            hidden_size=config.hidden_size,
+            num_layers=config.num_hidden_layers,
+            num_heads=config.num_attention_heads,
+            num_kv_heads=gqa.effective_kv_heads(tp, config.num_key_value_heads),
+            head_dim=config.head_dim,
+            intermediate_size=config.intermediate_size,
+            rms_norm_eps=config.rms_norm_eps,
+            activation=config.hidden_act,
+            rope_attention_scaling=rope_ops.attention_scaling_from_hf_config(
+                config.rope_scaling),
+            tie_word_embeddings=config.tie_word_embeddings,
+            cross_attention_layers=tuple(config.cross_attention_layers),
+            vision_tokens=config.total_vision_tokens,
+        )
+
+    @classmethod
+    def inv_freq_from_config(cls, config) -> np.ndarray:
+        return rope_ops.inv_freq_from_hf_config(
+            config.head_dim, config.rope_theta, config.rope_scaling)
+
+    def _use_flash_attention(self) -> bool:
+        if self.tpu_config.attention_kernel_enabled is True:
+            raise ValueError("the Pallas flash kernel does not support mllama yet")
+        return False
+
+    def _use_ring_attention(self) -> bool:
+        if self.mesh.shape["cp"] > 1:
+            raise ValueError("context parallelism is not supported for mllama yet")
+        return False
+
+    def decode_fn(self):
+        return decode_forward
+
+    # the plain-text prefill graph still runs through prefill_forward with zero
+    # vision inputs — built by _build_steps via this hook
+    def prefill_fn(self):
+        a = self.arch_args
+
+        def _text_only(params, args, input_ids, position_ids, last_token_idx, cache,
+                       mesh=None, rules=None, **_):
+            b, s = input_ids.shape
+            t_vis = a.vision_tokens
+            h_dim = a.hidden_size
+            zeros_cs = jnp.zeros((b, t_vis, h_dim), dtype=self.tpu_config.jax_dtype)
+            xmask = jnp.zeros((b, s, t_vis), dtype=bool)
+            xfull = jnp.zeros((b, s, 1), dtype=jnp.float32)
+            xmask_dec = jnp.zeros((b, t_vis), dtype=bool)
+            xfull_dec = jnp.zeros((b, 1), dtype=jnp.float32)
+            return prefill_forward(params, args, input_ids, position_ids,
+                                   last_token_idx, cache, zeros_cs, xmask, xfull,
+                                   xmask_dec, xfull_dec, mesh=mesh, rules=rules)
+
+        return _text_only
+
+    def _build_xprefill(self):
+        args = self.arch_args
+        mesh, rules = self.mesh, self.sharding_rules
+        odsc = self.sampling_config
+        from ...ops import sampling as sampling_ops
+
+        precision = ("highest" if self.tpu_config.dtype == "float32" else "default")
+
+        def _prefill_mm(params, vision_params, input_ids, position_ids,
+                        last_token_idx, cache, sampling_params, key,
+                        pixel_values, aspect_ratio_ids, aspect_ratio_mask,
+                        xmask, xfull, xmask_dec, xfull_dec):
+            with jax.default_matmul_precision(precision):
+                vis = self._encode_fn(
+                    vision_params, pixel_values, aspect_ratio_ids, aspect_ratio_mask)
+                cross = vis @ vision_params["proj_w"] + vision_params["proj_b"]
+                logits, cache = prefill_forward(
+                    params, args, input_ids, position_ids, last_token_idx, cache,
+                    cross.astype(self.tpu_config.jax_dtype), xmask, xfull,
+                    xmask_dec, xfull_dec, mesh=mesh, rules=rules)
+                tokens = sampling_ops.sample(logits, sampling_params, key, odsc)
+            return tokens, logits, cache
+
+        return jax.jit(_prefill_mm, donate_argnums=(5,))
+
+    def warmup(self) -> None:
+        """Also compile the vision+cross-attention prefill graph per CTE bucket."""
+        super().warmup()
+        if self.vision_params is None:
+            return
+        from ...ops import sampling as sampling_ops
+
+        a: MllamaArchArgs = self.arch_args
+        vc = self.config.vision_config
+        b = self.tpu_config.max_batch_size
+        m, t = self.config.max_num_media, vc["max_num_tiles"]
+        side, chans = vc["image_size"], vc.get("num_channels", 3)
+        sp = sampling_ops.prepare_sampling_params(b)
+        key = jax.random.PRNGKey(0)
+        pixels = np.zeros((b, m, t, chans, side, side), dtype=np.float32)
+        ar_ids = np.ones((b, m), dtype=np.int32)
+        ar_mask = np.ones((b, m, t), dtype=np.int32)
+        for bucket in self.cte_buckets:
+            self.reset_cache()
+            ids = np.zeros((b, bucket), dtype=np.int32)
+            pos = np.broadcast_to(np.arange(bucket, dtype=np.int32),
+                                  (b, bucket)).copy()
+            last = np.zeros((b,), dtype=np.int32)
+            xmask = np.zeros((b, bucket, a.vision_tokens), dtype=bool)
+            xfull = np.zeros((b, bucket, 1), dtype=np.float32)
+            xmask_dec = np.zeros((b, a.vision_tokens), dtype=bool)
+            xfull_dec = np.zeros((b, 1), dtype=np.float32)
+            tokens, _, self.kv_cache = self._xprefill_step(
+                self.params, self.vision_params, ids, pos, last, self.kv_cache, sp,
+                key, pixels, ar_ids, ar_mask, xmask, xfull, xmask_dec, xfull_dec)
+            tokens.block_until_ready()
+        self.reset_cache()
+
+    # --- cache with static vision KV --------------------------------------------------
+    def reset_cache(self) -> None:
+        a: MllamaArchArgs = self.arch_args
+        n_self = a.num_layers - len(a.cross_attention_layers)
+        spec = kvcache.KVCacheSpec(
+            num_layers=n_self, batch_size=self.tpu_config.max_batch_size,
+            num_kv_heads=a.num_kv_heads, max_seq_len=self.tpu_config.seq_len,
+            head_dim=a.head_dim, dtype=self.tpu_config.kv_cache_jax_dtype)
+        sharding = named_sharding(self.mesh, kvcache.CACHE_LOGICAL)
+        cache = {k: jax.device_put(v, sharding)
+                 for k, v in kvcache.init_cache(spec).items()}
+        b = self.tpu_config.max_batch_size
+        n_cross = len(a.cross_attention_layers)
+        xshape = (n_cross, b, a.num_kv_heads, a.vision_tokens, a.head_dim)
+        xsharding = named_sharding(self.mesh,
+                                   ("layers", "batch", "kv_heads", None, None))
+        dtype = self.tpu_config.jax_dtype
+        cache["xk"] = jax.device_put(jnp.zeros(xshape, dtype=dtype), xsharding)
+        cache["xv"] = jax.device_put(jnp.zeros(xshape, dtype=dtype), xsharding)
+        cache["xmask_dec"] = jnp.zeros((b, a.vision_tokens), dtype=bool)
+        cache["xfull_dec"] = jnp.zeros((b, 1), dtype=jnp.float32)
+        self.kv_cache = cache
+
+    # --- weights ----------------------------------------------------------------------
+    def logical_axes(self) -> Dict:
+        a: MllamaArchArgs = self.arch_args
+        self_axes = {
+            "ln1": ("layers", None), "ln2": ("layers", None),
+            "wq": ("layers", "embed", "heads"),
+            "wk": ("layers", "embed", "kv_heads"),
+            "wv": ("layers", "embed", "kv_heads"),
+            "wo": ("layers", "heads", "embed"),
+            "wg": ("layers", "embed", "mlp"),
+            "wu": ("layers", "embed", "mlp"),
+            "wd": ("layers", "mlp", "embed"),
+        }
+        x_axes = dict(self_axes)
+        x_axes.update({"q_norm": ("layers", None), "k_norm": ("layers", None),
+                       "gate_attn": ("layers",), "gate_mlp": ("layers",)})
+        out = {
+            "embed": ("vocab", "embed"),
+            "layers": self_axes,
+            "xlayers": x_axes,
+            "final_norm": (None,),
+            "rope_inv_freq": (None,),
+        }
+        if not a.tie_word_embeddings:
+            out["lm_head"] = ("embed", "vocab")
+        return out
+
+    def init_random_params(self, key) -> Dict:
+        a: MllamaArchArgs = self.arch_args
+        dtype = self.tpu_config.jax_dtype
+        H = a.hidden_size
+        n_cross = len(a.cross_attention_layers)
+        n_self = a.num_layers - n_cross
+        ks = iter(jax.random.split(key, 48))
+
+        def w(shape, scale=0.02):
+            return (jax.random.normal(next(ks), shape, dtype=jnp.float32)
+                    * scale).astype(dtype)
+
+        def stack(L, cross):
+            p = {
+                "ln1": jnp.ones((L, H), dtype=dtype),
+                "ln2": jnp.ones((L, H), dtype=dtype),
+                "wq": w((L, H, a.q_size)),
+                "wk": w((L, H, a.kv_size)),
+                "wv": w((L, H, a.kv_size)),
+                "wo": w((L, a.q_size, H)),
+                "wg": w((L, H, a.intermediate_size)),
+                "wu": w((L, H, a.intermediate_size)),
+                "wd": w((L, a.intermediate_size, H)),
+            }
+            if cross:
+                p.update({"q_norm": jnp.ones((L, a.head_dim), dtype=dtype),
+                          "k_norm": jnp.ones((L, a.head_dim), dtype=dtype),
+                          "gate_attn": jnp.zeros((L,), dtype=dtype),
+                          "gate_mlp": jnp.zeros((L,), dtype=dtype)})
+            return p
+
+        params = {
+            # HF mllama reserves 8 extra embed rows past vocab_size (image token etc.)
+            "embed": w((a.vocab_size + 8, H)),
+            "layers": stack(n_self, cross=False),
+            "xlayers": stack(n_cross, cross=True),
+            "final_norm": jnp.ones((H,), dtype=dtype),
+            "rope_inv_freq": jnp.asarray(self.inv_freq_from_config(self.config),
+                                         dtype=jnp.float32),
+        }
+        if not a.tie_word_embeddings:
+            params["lm_head"] = w((H, a.vocab_size))
+        return params
+
+    @classmethod
+    def convert_hf_state_dict(cls, state_dict: Dict[str, np.ndarray], config) -> Dict:
+        state_dict = _normalize_mllama_keys(state_dict)
+        args = cls.arch_args_from_config(config)
+        L = config.num_hidden_layers
+        cross = set(args.cross_attention_layers)
+        n_kv, d = config.num_key_value_heads, config.head_dim
+        factor = args.num_kv_heads // n_kv
+
+        def get(name):
+            if name not in state_dict:
+                raise KeyError(f"missing weight {name}")
+            return state_dict[name]
+
+        def linear_t(name):
+            return np.ascontiguousarray(get(name).T)
+
+        self_layers, x_layers = [], []
+        for i in range(L):
+            p = f"model.language_model.layers.{i}."
+            if i in cross:
+                x_layers.append({
+                    "ln1": get(p + "input_layernorm.weight"),
+                    "ln2": get(p + "post_attention_layernorm.weight"),
+                    "wq": linear_t(p + "cross_attn.q_proj.weight"),
+                    "wk": gqa.replicate_kv_weight(
+                        linear_t(p + "cross_attn.k_proj.weight"), n_kv, d, factor),
+                    "wv": gqa.replicate_kv_weight(
+                        linear_t(p + "cross_attn.v_proj.weight"), n_kv, d, factor),
+                    "wo": linear_t(p + "cross_attn.o_proj.weight"),
+                    "q_norm": get(p + "cross_attn.q_norm.weight"),
+                    "k_norm": get(p + "cross_attn.k_norm.weight"),
+                    "gate_attn": get(p + "cross_attn_attn_gate").reshape(()),
+                    "gate_mlp": get(p + "cross_attn_mlp_gate").reshape(()),
+                    "wg": linear_t(p + "mlp.gate_proj.weight"),
+                    "wu": linear_t(p + "mlp.up_proj.weight"),
+                    "wd": linear_t(p + "mlp.down_proj.weight"),
+                })
+            else:
+                self_layers.append({
+                    "ln1": get(p + "input_layernorm.weight"),
+                    "ln2": get(p + "post_attention_layernorm.weight"),
+                    "wq": linear_t(p + "self_attn.q_proj.weight"),
+                    "wk": gqa.replicate_kv_weight(
+                        linear_t(p + "self_attn.k_proj.weight"), n_kv, d, factor),
+                    "wv": gqa.replicate_kv_weight(
+                        linear_t(p + "self_attn.v_proj.weight"), n_kv, d, factor),
+                    "wo": linear_t(p + "self_attn.o_proj.weight"),
+                    "wg": linear_t(p + "mlp.gate_proj.weight"),
+                    "wu": linear_t(p + "mlp.up_proj.weight"),
+                    "wd": linear_t(p + "mlp.down_proj.weight"),
+                })
+
+        def stack(dicts):
+            return {k: np.stack([x[k] for x in dicts]) for k in dicts[0]}
+
+        params = {
+            "embed": get("model.language_model.embed_tokens.weight"),
+            "layers": stack(self_layers),
+            "xlayers": stack(x_layers),
+            "final_norm": get("model.language_model.norm.weight"),
+            "rope_inv_freq": cls.inv_freq_from_config(config),
+        }
+        if not args.tie_word_embeddings:
+            params["lm_head"] = np.ascontiguousarray(get("lm_head.weight").T)
+        return params
+
+    def _post_load_state_dict(self, state_dict) -> None:
+        self.load_vision_from_state_dict(state_dict)
+
+    def load_vision_from_state_dict(self, state_dict) -> None:
+        host = self.convert_hf_vision_state_dict(state_dict, self.config)
+        dtype = self.tpu_config.jax_dtype
+
+        def _put(x):
+            arr = np.asarray(x)
+            if arr.dtype.kind == "f" or arr.dtype.name == "bfloat16":
+                arr = arr.astype(dtype)
+            return jax.device_put(arr)
+
+        self.vision_params = jax.tree.map(_put, host)
+
+    @classmethod
+    def convert_hf_vision_state_dict(cls, state_dict: Dict[str, np.ndarray],
+                                     config) -> Dict:
+        state_dict = _normalize_mllama_keys(state_dict)
+        vc = config.vision_config
+        hidden = vc["hidden_size"]
+
+        def get(name):
+            if name not in state_dict:
+                raise KeyError(f"missing weight {name}")
+            return state_dict[name]
+
+        def linear_t(name):
+            return np.ascontiguousarray(get(name).T)
+
+        def encoder_stack(prefix, n, gated):
+            keys = ["ln1_w", "ln1_b", "wq", "wk", "wv", "wo",
+                    "ln2_w", "ln2_b", "fc1", "b1", "fc2", "b2"]
+            if gated:
+                keys += ["gate_attn", "gate_ffn"]
+            layers = {k: [] for k in keys}
+            for i in range(n):
+                p = f"{prefix}.layers.{i}."
+                layers["ln1_w"].append(get(p + "input_layernorm.weight"))
+                layers["ln1_b"].append(get(p + "input_layernorm.bias"))
+                layers["wq"].append(linear_t(p + "self_attn.q_proj.weight"))
+                layers["wk"].append(linear_t(p + "self_attn.k_proj.weight"))
+                layers["wv"].append(linear_t(p + "self_attn.v_proj.weight"))
+                layers["wo"].append(linear_t(p + "self_attn.o_proj.weight"))
+                layers["ln2_w"].append(get(p + "post_attention_layernorm.weight"))
+                layers["ln2_b"].append(get(p + "post_attention_layernorm.bias"))
+                layers["fc1"].append(linear_t(p + "mlp.fc1.weight"))
+                layers["b1"].append(get(p + "mlp.fc1.bias"))
+                layers["fc2"].append(linear_t(p + "mlp.fc2.weight"))
+                layers["b2"].append(get(p + "mlp.fc2.bias"))
+                if gated:
+                    layers["gate_attn"].append(get(p + "gate_attn").reshape(()))
+                    layers["gate_ffn"].append(get(p + "gate_ffn").reshape(()))
+            return {k: np.stack(v) for k, v in layers.items()}
+
+        v = "model.vision_model."
+        conv = get(v + "patch_embedding.weight")             # (hidden, C, p, p)
+        return {
+            "patch_w": np.ascontiguousarray(conv.reshape(hidden, -1).T),
+            "class_embed": get(v + "class_embedding"),
+            "pos_gate": get(v + "gated_positional_embedding.gate").reshape(()),
+            "pos_embed": get(v + "gated_positional_embedding.embedding"),
+            "tile_pos_embed": get(v + "gated_positional_embedding.tile_embedding.weight"),
+            "pre_tile_embed": get(v + "pre_tile_positional_embedding.embedding.weight"),
+            "pre_tile_gate": get(v + "pre_tile_positional_embedding.gate").reshape(()),
+            "post_tile_embed": get(v + "post_tile_positional_embedding.embedding.weight"),
+            "post_tile_gate": get(v + "post_tile_positional_embedding.gate").reshape(()),
+            "ln_pre_w": get(v + "layernorm_pre.weight"),
+            "ln_pre_b": get(v + "layernorm_pre.bias"),
+            "ln_post_w": get(v + "layernorm_post.weight"),
+            "ln_post_b": get(v + "layernorm_post.bias"),
+            "layers": encoder_stack(v + "transformer", vc["num_hidden_layers"],
+                                    gated=False),
+            "global_layers": encoder_stack(v + "global_transformer",
+                                           vc["num_global_layers"], gated=True),
+            "proj_w": linear_t("model.multi_modal_projector.weight"),
+            "proj_b": get("model.multi_modal_projector.bias"),
+        }
+
+    # --- generation -------------------------------------------------------------------
+    def generate(self, input_ids, pixel_values=None, aspect_ratio_ids=None,
+                 aspect_ratio_mask=None, cross_attention_mask=None, **kwargs):
+        """HF-processor-compatible multimodal generate.
+
+        pixel_values (B, M, T, C, H, W), aspect_ratio_ids (B, M), aspect_ratio_mask
+        (B, M, T), cross_attention_mask (B, S, M, T)."""
+        if pixel_values is None:
+            return super().generate(input_ids, **kwargs)
+        pixel_values = np.asarray(pixel_values, dtype=np.float32)
+        cam = np.asarray(cross_attention_mask, dtype=np.int32)
+        vc = self.config.vision_config
+        m_max, t_max = self.config.max_num_media, vc["max_num_tiles"]
+        if pixel_values.shape[1] != m_max or pixel_values.shape[2] != t_max:
+            raise ValueError(
+                f"pixel_values media/tile dims {pixel_values.shape[1:3]} must match "
+                f"the compiled (max_num_media={m_max}, max_num_tiles={t_max}); pad "
+                f"images and aspect_ratio_mask to the static shape")
+        if cam.shape[2] != m_max or cam.shape[3] != t_max:
+            raise ValueError(
+                f"cross_attention_mask media/tile dims {cam.shape[2:]} must match "
+                f"(max_num_media={m_max}, max_num_tiles={t_max})")
+        attention_mask = kwargs.get("attention_mask")
+        if attention_mask is not None:
+            # pad_prefill_inputs compacts each row's real tokens to the left; the
+            # cross-attention mask rows must follow their tokens
+            am = np.asarray(attention_mask).astype(bool)
+            compacted = np.zeros_like(cam)
+            for i in range(cam.shape[0]):
+                real = cam[i][am[i]]
+                compacted[i, :real.shape[0]] = real
+            cam = compacted
+        mm = {
+            "pixel_values": pixel_values,
+            "aspect_ratio_ids": np.asarray(aspect_ratio_ids, dtype=np.int32),
+            "aspect_ratio_mask": np.asarray(aspect_ratio_mask, dtype=np.int32),
+            "cross_attention_mask": cam,
+        }
+        return super().generate(input_ids, _mm_embeds=mm, **kwargs)
+
+    def _run_prefill(self, padded, sampling_params, key, adapter_ids, mm=None):
+        if mm is None:
+            return super()._run_prefill(padded, sampling_params, key, adapter_ids)
+        a: MllamaArchArgs = self.arch_args
+        b, s = padded.input_ids.shape
+        per_tile = self.config.vision_tokens_per_tile
+        cam = mm["cross_attention_mask"]                 # (B_in, S_in, M, T)
+        allowed = np.repeat(cam.reshape(cam.shape[0], cam.shape[1], -1),
+                            per_tile, axis=2).astype(bool)  # (B_in, S_in, T_vis)
+        xmask = np.zeros((b, s, a.vision_tokens), dtype=bool)
+        s_in = min(allowed.shape[1], s)
+        xmask[:allowed.shape[0], :s_in] = allowed[:, :s_in]
+        xfull = xmask.any(axis=-1, keepdims=True).astype(np.float32)
+        # decode visibility = each row's LAST real prompt token's row (HF generate)
+        last = np.asarray(padded.last_token_idx)
+        xmask_dec = xmask[np.arange(b), np.minimum(last, s - 1)]
+        xfull_dec = xmask_dec.any(axis=-1, keepdims=True).astype(np.float32)
+
+        def _pad_batch(x):
+            if x.shape[0] == b:
+                return x
+            out = np.zeros((b,) + x.shape[1:], dtype=x.dtype)
+            out[:x.shape[0]] = x
+            return out
+
+        return self._xprefill_step(
+            self.params, self.vision_params, padded.input_ids, padded.position_ids,
+            padded.last_token_idx, self.kv_cache, sampling_params, key,
+            _pad_batch(mm["pixel_values"]), _pad_batch(mm["aspect_ratio_ids"]),
+            _pad_batch(mm["aspect_ratio_mask"]), xmask, xfull, xmask_dec, xfull_dec)
+
+
+def _normalize_mllama_keys(state_dict: Dict[str, Any]) -> Dict[str, Any]:
+    """On-disk legacy layout (``language_model.model.*``, bare ``vision_model.*``) ->
+    in-memory layout (``model.language_model.*`` etc.)."""
+    out = {}
+    for k, v in state_dict.items():
+        if k.startswith("language_model.model."):
+            k = "model.language_model." + k[len("language_model.model."):]
+        elif k == "language_model.lm_head.weight":
+            k = "lm_head.weight"
+        elif k.startswith("vision_model.") or k.startswith("multi_modal_projector."):
+            k = "model." + k
+        out[k] = v
+    return out

@@ -138,3 +138,96 @@ def test_multimodal_warmup_compiles(tiny_llava):
     hf, cfg = tiny_llava
     app = _load(_build_app(cfg), hf)
     app.warmup()   # must compile text + vision + mm-prefill graphs without error
+
+
+# --- mllama (cross-attention) ---------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_mllama():
+    from transformers import MllamaConfig, MllamaForConditionalGeneration
+    from transformers.models.mllama.configuration_mllama import (
+        MllamaTextConfig, MllamaVisionConfig)
+
+    vc = MllamaVisionConfig(hidden_size=32, intermediate_size=64,
+                            num_hidden_layers=2, num_global_layers=1,
+                            attention_heads=2, image_size=8, patch_size=4,
+                            num_channels=3, max_num_tiles=2,
+                            intermediate_layers_indices=[0, 1],
+                            supported_aspect_ratios=[[1, 1], [1, 2], [2, 1]],
+                            vision_output_dim=96)  # 32 * (1 final + 2 intermediate)
+    tc = MllamaTextConfig(vocab_size=256, hidden_size=48, intermediate_size=96,
+                          num_hidden_layers=4, num_attention_heads=4,
+                          num_key_value_heads=2, cross_attention_layers=[1, 3],
+                          rope_theta=10000.0,
+                          rope_scaling={"rope_type": "default"},
+                          max_position_embeddings=512, tie_word_embeddings=False,
+                          pad_token_id=0, bos_token_id=1, eos_token_id=2)
+    cfg = MllamaConfig(vision_config=vc, text_config=tc, image_token_index=256)
+    torch.manual_seed(0)
+    hf = MllamaForConditionalGeneration(cfg).eval()
+    return hf, cfg
+
+
+def _build_mllama(cfg):
+    from neuronx_distributed_inference_tpu.models.mllama import (
+        MllamaForConditionalGeneration)
+
+    tpu_cfg = TpuConfig(batch_size=2, seq_len=64, max_context_length=32,
+                        dtype="float32", context_encoding_buckets=[16],
+                        token_generation_buckets=[64])
+    config = MllamaForConditionalGeneration.get_config_cls()(
+        tpu_cfg, load_config=load_pretrained_config(cfg.to_dict()))
+    return MllamaForConditionalGeneration(None, config)
+
+
+def _load_mllama(app, hf):
+    state = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+    params = app.convert_hf_state_dict(state, app.config)
+    app._put_params(params)
+    app.load_vision_from_state_dict(state)
+    return app
+
+
+def test_mllama_generate_matches_hf(tiny_mllama):
+    """Cross-attention multimodal: vision KV computed at prefill, reused at decode."""
+    hf, cfg = tiny_mllama
+    app = _load_mllama(_build_mllama(cfg), hf)
+    rng = np.random.default_rng(0)
+    B, S, M, T = 2, 12, 1, 2
+    input_ids = rng.integers(1, 250, size=(B, S)).astype(np.int64)
+    input_ids[:, 1] = 256                       # <|image|> token
+    # 1 image per row, 2 tiles, second row uses only 1 tile
+    pixels = rng.normal(size=(B, M, T, 3, 8, 8)).astype(np.float32)
+    ar_ids = np.array([[2], [1]], dtype=np.int64)        # [1,2] tiles / [1,1]
+    ar_mask = np.array([[[1, 1]], [[1, 0]]], dtype=np.int64)
+    # tokens after the image token attend to it (HF processor semantics)
+    cam = np.zeros((B, S, M, T), dtype=np.int64)
+    cam[:, 1:, 0, :] = ar_mask[:, 0][:, None, :]
+
+    with torch.no_grad():
+        hf_out = hf.generate(
+            input_ids=torch.tensor(input_ids),
+            pixel_values=torch.tensor(pixels),
+            aspect_ratio_ids=torch.tensor(ar_ids),
+            aspect_ratio_mask=torch.tensor(ar_mask),
+            cross_attention_mask=torch.tensor(cam),
+            max_new_tokens=8, do_sample=False, pad_token_id=0)
+    out = app.generate(input_ids, pixel_values=pixels, aspect_ratio_ids=ar_ids,
+                       aspect_ratio_mask=ar_mask, cross_attention_mask=cam,
+                       max_new_tokens=8)
+    np.testing.assert_array_equal(out.tokens, hf_out[:, S:].numpy())
+
+
+def test_mllama_text_only_matches_hf(tiny_mllama):
+    """Without images the cross layers must be exact identities (zero KV + dead rows),
+    matching HF's skip-cross-layer path."""
+    hf, cfg = tiny_mllama
+    app = _load_mllama(_build_mllama(cfg), hf)
+    rng = np.random.default_rng(1)
+    input_ids = rng.integers(1, 250, size=(2, 10)).astype(np.int64)
+    with torch.no_grad():
+        hf_out = hf.generate(input_ids=torch.tensor(input_ids), max_new_tokens=6,
+                             do_sample=False, pad_token_id=0)
+    out = app.generate(input_ids, max_new_tokens=6)
+    np.testing.assert_array_equal(out.tokens, hf_out[:, 10:].numpy())
