@@ -292,11 +292,25 @@ def vision_encode(vp: Dict[str, Any], pixel_values, aspect_ratio_ids,
 
     h = h.reshape(b * m, seq, hidden)
 
+    # capture only the selected layers' INPUTS (HF hidden_states[i]): scan in
+    # segments split at the intermediate indices instead of materializing every
+    # layer's activations as scan ys
     def local_body(hid, lp):
-        return encoder_layer(hid, lp, gated=False), hid     # ys = layer INPUT (HF)
+        return encoder_layer(hid, lp, gated=False), None
 
-    h, inputs_per_layer = jax.lax.scan(local_body, h, vp["layers"])
-    intermediates = jnp.stack([inputs_per_layer[i] for i in intermediate_indices],
+    captured = {}
+    start = 0
+    n_local = jax.tree.leaves(vp["layers"])[0].shape[0]
+    for i in sorted(set(intermediate_indices)):
+        if i > start:
+            seg = jax.tree.map(lambda x: x[start:i], vp["layers"])
+            h, _ = jax.lax.scan(local_body, h, seg)
+        captured[i] = h
+        start = i
+    if start < n_local:
+        seg = jax.tree.map(lambda x: x[start:], vp["layers"])
+        h, _ = jax.lax.scan(local_body, h, seg)
+    intermediates = jnp.stack([captured[i] for i in intermediate_indices],
                               axis=-1)                       # (BM, seq, hidden, K)
 
     h = layer_norm(h, vp["ln_post_w"], vp["ln_post_b"], eps=norm_eps)
@@ -743,6 +757,11 @@ class MllamaForConditionalGeneration(TpuModelForCausalLM):
         (B, M, T), cross_attention_mask (B, S, M, T)."""
         if pixel_values is None:
             return super().generate(input_ids, **kwargs)
+        if cross_attention_mask is None or aspect_ratio_ids is None \
+                or aspect_ratio_mask is None:
+            raise ValueError("multimodal generate requires aspect_ratio_ids, "
+                             "aspect_ratio_mask and cross_attention_mask (the HF "
+                             "mllama processor produces all three)")
         pixel_values = np.asarray(pixel_values, dtype=np.float32)
         cam = np.asarray(cross_attention_mask, dtype=np.int32)
         vc = self.config.vision_config

@@ -1,0 +1,4 @@
+from .modeling_whisper import (WhisperForConditionalGeneration,
+                               WhisperInferenceConfig)
+
+__all__ = ["WhisperForConditionalGeneration", "WhisperInferenceConfig"]
