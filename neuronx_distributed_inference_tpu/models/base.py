@@ -479,9 +479,19 @@ def _run_stack(params: Params, args: ModelArchArgs, h, cos, sin, mask, cache,
                                        cache_batch_start=cache_batch_start,
                                        adapter_ids=adapter_ids,
                                        ring_positions=ring_positions)
-        return new_h, (kc, vc)
+        from ..utils import tensor_capture as _tc
 
-    h, (k_new, v_new) = jax.lax.scan(body, h, xs)
+        ys = (kc, vc)
+        if _tc._ACTIVE.get() is not None and _tc._ACTIVE.get().wants("hidden_stack"):
+            ys = ys + (new_h,)
+        return new_h, ys
+
+    h, ys = jax.lax.scan(body, h, xs)
+    k_new, v_new = ys[0], ys[1]
+    if len(ys) > 2:
+        from ..utils.tensor_capture import tap
+
+        tap("hidden_stack", ys[2])      # (L, B, S, H) per-layer hidden states
     return h, {"k": k_new, "v": v_new}
 
 
@@ -527,10 +537,13 @@ def prefill_forward(
     With ``slot_mapping`` the cache is a paged pytree (see modules/block_kvcache) and
     writes scatter to flat slots; with ``cache_batch_start`` the dense write lands at a
     specific batch row (continuous-batching insert)."""
+    from ..utils.tensor_capture import tap
+
     h = _embed(params, args, input_ids, mesh, rules)
     if merge_embeds is not None:
         mm_mask, mm_override = merge_embeds
         h = jnp.where(mm_mask, mm_override.astype(h.dtype), h)
+    h = tap("embed", h)
     cos, sin = rope_ops.compute_cos_sin(params["rope_inv_freq"], position_ids,
                                         args.rope_attention_scaling)
     s = input_ids.shape[1]
@@ -560,9 +573,9 @@ def prefill_forward(
                           paged=paged, cache_batch_start=cache_batch_start,
                           adapter_ids=adapter_ids,
                           ring_positions=position_ids if use_ring else None)
-    h = _norm(h, params["final_norm"], args)
+    h = tap("final_hidden", _norm(h, params["final_norm"], args))
     h_last = jnp.take_along_axis(h, last_token_idx[:, None, None], axis=1)[:, 0]
-    logits = _lm_head(params, args, h_last, mesh, rules)
+    logits = tap("logits", _lm_head(params, args, h_last, mesh, rules))
     if return_hidden:
         return logits, cache, h
     return logits, cache

@@ -56,13 +56,13 @@ class WhisperInferenceConfig(InferenceConfig):
                 f"max_target_positions {self.max_target_positions}")
 
 
-def _attention_block(p: Params, prefix: str, hn, q_in, k_in, v_in, heads, mask=None):
-    """Whisper MHA: q/v/out have biases, k does not; q pre-scaled by d^-0.5."""
-    b, s, hdim = q_in.shape
+def _attention_block(p: Params, prefix: str, x, heads, mask=None):
+    """Whisper self-attention MHA: q/v/out have biases, k does not."""
+    b, s, hdim = x.shape
     d = hdim // heads
-    q = (q_in @ p[prefix + "wq"] + p[prefix + "bq"]).reshape(b, s, heads, d)
-    k = (k_in @ p[prefix + "wk"]).reshape(b, k_in.shape[1], heads, d)
-    v = (v_in @ p[prefix + "wv"] + p[prefix + "bv"]).reshape(b, v_in.shape[1], heads, d)
+    q = (x @ p[prefix + "wq"] + p[prefix + "bq"]).reshape(b, s, heads, d)
+    k = (x @ p[prefix + "wk"]).reshape(b, s, heads, d)
+    v = (x @ p[prefix + "wv"] + p[prefix + "bv"]).reshape(b, s, heads, d)
     q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
     out = attend(q, k, v, mask=mask, scale=d ** -0.5)
     out = out.transpose(0, 2, 1, 3).reshape(b, s, hdim)
@@ -84,7 +84,7 @@ def encode(params: Params, input_features: jnp.ndarray, *, heads: int,
 
     def body(hid, lp):
         hn = layer_norm(hid, lp["ln1_w"], lp["ln1_b"], eps=eps)
-        hid = hid + _attention_block(lp, "attn_", hn, hn, hn, hn, heads)
+        hid = hid + _attention_block(lp, "attn_", hn, heads)
         hn = layer_norm(hid, lp["ln2_w"], lp["ln2_b"], eps=eps)
         hid = hid + (jax.nn.gelu(hn @ lp["fc1"] + lp["b1"], approximate=False)
                      @ lp["fc2"] + lp["b2"])
@@ -363,8 +363,9 @@ class WhisperForConditionalGeneration:
                else self.config.eos_token_id)
         eos_done = np.zeros((b,), dtype=bool)
         while n_done < max_new_tokens:
+            # chunk writes occupy cache slots [pos, pos+steps) -> steps <= S - pos
             steps = min(chunk, max_new_tokens - n_done,
-                        self.tpu_config.seq_len - 1 - (pos + 1))
+                        self.tpu_config.seq_len - pos)
             if steps <= 0:
                 break
             positions = np.full((b,), pos, dtype=np.int32)

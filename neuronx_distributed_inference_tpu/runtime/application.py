@@ -437,6 +437,48 @@ class TpuModelForCausalLM:
         logger.info("warmup complete: %d CTE + %d TKG buckets",
                     len(self.cte_buckets), len(self.tkg_buckets))
 
+    # --- debug: tensor capture / replacement (≈ reference extra-output capture,
+    # `models/model_base.py:1076-1182`, and golden injection `models/config.py:1131`) --
+    def prefill_with_capture(self, input_ids, attention_mask=None,
+                             names=None, replacements=None, adapter_ids=None):
+        """Run ONE context-encoding pass with tensor taps active.
+
+        Returns (logits (B, V) fp32, {tap_name: np.ndarray}). Compiles a dedicated
+        graph per call (debug path) using the SAME attention strategy as serving
+        (flash/ring/adapters), so captures localize divergence in the graph actually
+        served. ``replacements`` injects goldens at tap points before downstream
+        compute (divergence isolation)."""
+        from ..utils import tensor_capture as tc
+
+        names = tuple(names if names is not None else tc.KNOWN_TAPS)
+        padded = model_wrapper.pad_prefill_inputs(
+            model_wrapper.to_int32(np.asarray(input_ids)), attention_mask,
+            self.cte_buckets, batch_size=self.tpu_config.max_batch_size)
+        self.reset_cache()
+        args, mesh, rules = self.arch_args, self.mesh, self.sharding_rules
+        prefill_core = self.prefill_fn()
+        precision = "highest" if self.tpu_config.dtype == "float32" else "default"
+        use_ring = self._use_ring_attention()
+        use_flash = (not use_ring) and self._use_flash_attention()
+
+        def fn(params, ids, pos, last, cache, adapters):
+            with tc.capture(names, replacements) as st:
+                with jax.default_matmul_precision(precision):
+                    logits, cache = prefill_core(params, args, ids, pos, last, cache,
+                                                 mesh=mesh, rules=rules,
+                                                 use_flash=use_flash,
+                                                 use_ring=use_ring,
+                                                 adapter_ids=adapters)
+                return logits, st.captured
+
+        logits, captured = jax.jit(fn)(
+            self.params, padded.input_ids, padded.position_ids,
+            padded.last_token_idx, self.kv_cache, adapter_ids)
+        self.reset_cache()
+        b = np.asarray(input_ids).shape[0]
+        return (np.asarray(logits)[:b],
+                {k: np.asarray(v) for k, v in captured.items()})
+
     def _run_prefill(self, padded, sampling_params, key, adapter_ids, mm=None):
         """Dispatch the context-encoding graph (multimodal subclasses override to run
         the embed-merge variant when image features are present)."""
@@ -494,6 +536,22 @@ class TpuModelForCausalLM:
             input_ids, attention_mask, self.cte_buckets, pad_token_id=pad_token_id,
             batch_size=compiled_b)
         self.reset_cache()
+
+        # env-driven repro snapshots (≈ NXD_INFERENCE_CAPTURE_*, utils/snapshot.py)
+        from ..utils import snapshot as snapshot_lib
+
+        snapshot_lib.new_request()
+        snap = {
+            "input_ids": padded.input_ids, "position_ids": padded.position_ids,
+            "last_token_idx": padded.last_token_idx,
+            "sampling_params": sampling_params, "adapter_ids": adapter_ids}
+        if _mm_embeds is not None:          # multimodal requests must replay too
+            if isinstance(_mm_embeds, dict):
+                snap.update({f"mm_{k}": v for k, v in _mm_embeds.items()})
+            else:
+                snap["mm_features"] = _mm_embeds
+        snapshot_lib.maybe_capture("prefill", snap)
+        snapshot_lib.maybe_capture_weights(self.params)
 
         t_start = time.perf_counter()
         key, sub = jax.random.split(key)

@@ -1,0 +1,95 @@
+"""Multi-host launch (≈ reference `scripts/nxdi_distributed_launcher.py:29-151`).
+
+The reference builds an mpirun command with EFA env + `NEURON_RT_ROOT_COMM_ID` and
+runs one process per node; device collectives live inside the compiled NEFFs. The TPU
+equivalent is `jax.distributed.initialize`: one process per host, XLA collectives over
+ICI/DCN are compiled into the jitted graphs, and the only host-side coordination is the
+coordinator handshake.
+
+Usage patterns:
+
+- **TPU pod (GKE / queued resources)**: the scheduler starts one process per host with
+  the TPU env populated; call ``initialize_multihost()`` with no args — JAX infers
+  coordinator/process_id from the TPU metadata.
+- **Explicit cluster** (≈ mpirun --hosts): every host runs
+  ``initialize_multihost(coordinator, num_processes, process_id)``.
+- **Local simulation** (≈ the reference's gloo CPU mode): ``launch_local`` forks N
+  processes with ``JAX_PLATFORMS=cpu`` + per-process env so SPMD logic can be
+  validated without a pod (tests use the 8-device single-process mesh instead, see
+  tests/conftest.py).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from typing import List, Optional
+
+__all__ = ["initialize_multihost", "launch_local", "main"]
+
+
+def initialize_multihost(coordinator_address: Optional[str] = None,
+                         num_processes: Optional[int] = None,
+                         process_id: Optional[int] = None) -> None:
+    """Bring up the JAX distributed runtime (idempotent)."""
+    import jax
+
+    try:
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+    except RuntimeError as e:  # already initialized
+        if "already" not in str(e):
+            raise
+
+
+def launch_local(script: str, num_processes: int, script_args: List[str],
+                 coordinator_port: int = 9911) -> int:
+    """Fork ``num_processes`` CPU processes running ``script`` with the distributed
+    env set (coordinator on localhost). Returns the first nonzero exit code or 0."""
+    procs = []
+    for rank in range(num_processes):
+        env = dict(os.environ)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "TPUINF_COORDINATOR": f"localhost:{coordinator_port}",
+            "TPUINF_NUM_PROCESSES": str(num_processes),
+            "TPUINF_PROCESS_ID": str(rank),
+        })
+        procs.append(subprocess.Popen([sys.executable, script, *script_args],
+                                      env=env))
+    rc = 0
+    for p in procs:
+        p.wait()
+        rc = rc or p.returncode
+    return rc
+
+
+def init_from_env() -> bool:
+    """Initialize from the TPUINF_* env vars set by launch_local (no-op without)."""
+    coord = os.environ.get("TPUINF_COORDINATOR")
+    if not coord:
+        return False
+    initialize_multihost(coord, int(os.environ["TPUINF_NUM_PROCESSES"]),
+                         int(os.environ["TPUINF_PROCESS_ID"]))
+    return True
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """CLI: ``python -m neuronx_distributed_inference_tpu.runtime.launcher
+    --num-processes 2 -- script.py args...``"""
+    import argparse
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--num-processes", type=int, required=True)
+    parser.add_argument("--coordinator-port", type=int, default=9911)
+    parser.add_argument("script")
+    parser.add_argument("script_args", nargs="*")
+    args = parser.parse_args(argv)
+    return launch_local(args.script, args.num_processes, args.script_args,
+                        coordinator_port=args.coordinator_port)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

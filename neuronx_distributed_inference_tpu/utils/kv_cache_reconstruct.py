@@ -1,0 +1,63 @@
+"""Reconstruct logical KV caches for debugging.
+
+≈ reference `utils/kv_cache_reconstruct_utils.py:57-218`, which de-shards per-rank
+device caches back into the logical (B, H, S, D). On TPU the cache is a GSPMD-sharded
+`jax.Array` whose logical view is already global — `np.asarray` performs the gather —
+so reconstruction reduces to slicing + dtype restoration, plus paged-cache block
+unpacking."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+def reconstruct_dense(cache: Dict, seq_len: Optional[int] = None,
+                      batch: Optional[int] = None) -> List[Dict[str, np.ndarray]]:
+    """{"k","v" (L, B, H, S, D)} -> per-layer {"k","v" (B, H, S', D)} float32."""
+    out = []
+    k_all, v_all = np.asarray(cache["k"]), np.asarray(cache["v"])
+    s = seq_len if seq_len is not None else k_all.shape[3]
+    b = batch if batch is not None else k_all.shape[1]
+    for layer in range(k_all.shape[0]):
+        out.append({
+            "k": k_all[layer, :b, :, :s].astype(np.float32),
+            "v": v_all[layer, :b, :, :s].astype(np.float32),
+        })
+    return out
+
+
+def reconstruct_paged(cache: Dict, block_table: np.ndarray,
+                      seq_lens: np.ndarray) -> List[Dict[str, np.ndarray]]:
+    """Paged cache (L, num_blocks, block_size, H, D) + per-seq block tables ->
+    per-layer contiguous {"k","v" (B, H, S_max, D)}."""
+    k_all, v_all = np.asarray(cache["k"]), np.asarray(cache["v"])
+    L, _, block_size, H, D = k_all.shape
+    bt = np.asarray(block_table)
+    b = bt.shape[0]
+    s_max = int(np.max(seq_lens))
+    out = []
+    for layer in range(L):
+        k = np.zeros((b, H, s_max, D), dtype=np.float32)
+        v = np.zeros((b, H, s_max, D), dtype=np.float32)
+        for row in range(b):
+            n = int(seq_lens[row])
+            gathered_k = k_all[layer, bt[row]].reshape(-1, H, D)[:n]
+            gathered_v = v_all[layer, bt[row]].reshape(-1, H, D)[:n]
+            k[row, :, :n] = gathered_k.transpose(1, 0, 2)
+            v[row, :, :n] = gathered_v.transpose(1, 0, 2)
+        out.append({"k": k, "v": v})
+    return out
+
+
+def cache_summary(cache: Dict) -> Dict[str, str]:
+    """Shapes/dtypes/shardings of every cache entry (quick debug print)."""
+    import jax
+
+    out = {}
+    for name, arr in cache.items():
+        sh = getattr(arr, "sharding", None)
+        out[name] = f"{jax.typeof(arr) if hasattr(jax, 'typeof') else arr.shape} " \
+                    f"sharding={sh}"
+    return out

@@ -1,0 +1,54 @@
+"""Device profiling via jax.profiler (≈ reference `utils/profiling.py:33-121`, which
+shells out to `neuron-profile capture` on a NEFF; on TPU the XLA/PJRT stack exposes the
+same capability natively through jax.profiler traces viewable in TensorBoard /
+Perfetto, plus XLA HLO dumps via XLA_FLAGS=--xla_dump_to)."""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from typing import Callable, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(logdir: str, create_perfetto_link: bool = False):
+    """Capture a device trace for the enclosed block (TensorBoard `logdir`)."""
+    os.makedirs(logdir, exist_ok=True)
+    jax.profiler.start_trace(logdir, create_perfetto_link=create_perfetto_link)
+    try:
+        yield logdir
+    finally:
+        jax.profiler.stop_trace()
+
+
+def profile_callable(fn: Callable, *args, logdir: str = "/tmp/tpu_profile",
+                     warmup: int = 1, iters: int = 3, **kwargs):
+    """Profile ``fn(*args, **kwargs)``: warm (compile), then trace ``iters`` runs.
+
+    Returns (last_result, wall_seconds_per_iter). ≈ the reference's profile-largest-
+    bucket flow (`utils/profiling.py:66-121`) without the NEFF bookkeeping."""
+    for _ in range(warmup):
+        result = fn(*args, **kwargs)
+        jax.block_until_ready(result)
+    t0 = time.perf_counter()
+    with trace(logdir):
+        for _ in range(iters):
+            result = fn(*args, **kwargs)
+            jax.block_until_ready(result)
+    return result, (time.perf_counter() - t0) / max(iters, 1)
+
+
+def enable_hlo_dump(dump_dir: str) -> None:
+    """Ask XLA to dump HLO for every subsequent compile (≈ `--hlo-debug` metadata,
+    `inference_demo.py:383-388`). Must run before the first jit compilation."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "--xla_dump_to" not in flags:
+        os.environ["XLA_FLAGS"] = f"{flags} --xla_dump_to={dump_dir}".strip()
+
+
+def annotate(name: str):
+    """Named trace span (shows up in the profiler timeline)."""
+    return jax.profiler.TraceAnnotation(name)

@@ -1,0 +1,44 @@
+"""Runtime environment management (≈ reference `utils/runtime_env.py:6-38` +
+`utils/compile_env.py:6-41`, which set `NEURON_RT_*` / compiler env for long-context
+and MXFP4 runs). TPU equivalents are XLA flags and JAX config knobs."""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+# flags appended for >=32k-context runs (≈ the reference's long-context runtime env:
+# scratchpad page size + DMA options, `models/config.py:577-587`)
+LONG_CONTEXT_THRESHOLD = 32 * 1024
+
+
+def _append_xla_flags(flags: str) -> None:
+    cur = os.environ.get("XLA_FLAGS", "")
+    for f in flags.split():
+        if f.split("=")[0] not in cur:
+            cur = f"{cur} {f}".strip()
+    os.environ["XLA_FLAGS"] = cur
+
+
+def set_runtime_env(seq_len: int, compilation_cache_dir: Optional[str] = None,
+                    host_device_count: Optional[int] = None) -> Dict[str, str]:
+    """Configure process env/JAX config for a serving run. Call BEFORE the first
+    device query / jit. Returns the knobs applied (for logging)."""
+    applied = {}
+    if compilation_cache_dir:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", compilation_cache_dir)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        applied["jax_compilation_cache_dir"] = compilation_cache_dir
+    if host_device_count:
+        _append_xla_flags(
+            f"--xla_force_host_platform_device_count={host_device_count}")
+        applied["host_device_count"] = str(host_device_count)
+    if seq_len >= LONG_CONTEXT_THRESHOLD:
+        # long-context: lean on latency-hiding scheduling and async collectives so
+        # CP/SP collectives overlap compute (≈ --enable-ccop-compute-overlap)
+        _append_xla_flags("--xla_tpu_enable_async_collective_fusion=true")
+        applied["long_context"] = "true"
+    return applied

@@ -1,0 +1,75 @@
+"""Input snapshot capture for bug repro (≈ reference `utils/snapshot.py:18-451`).
+
+Env-driven like the reference's ``NXD_INFERENCE_CAPTURE_*`` hooks
+(`models/application_base.py:421-476`):
+
+- ``TPUINF_CAPTURE_DIR``       — enable capture, write .npz files here
+- ``TPUINF_CAPTURE_AT``        — comma-separated request indices ("0,5"); empty = all
+- ``TPUINF_CAPTURE_WEIGHTS=1`` — also snapshot the (host copies of) weights once
+
+The application calls ``maybe_capture("prefill", {...})`` at its step boundaries; the
+saved artifacts replay a failing input against a fresh build without the serving stack.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+_counter = {"n": -1}
+
+
+def _config():
+    d = os.environ.get("TPUINF_CAPTURE_DIR")
+    if not d:
+        return None
+    at = os.environ.get("TPUINF_CAPTURE_AT", "")
+    indices = ({int(x) for x in at.split(",") if x.strip()} if at.strip() else None)
+    return d, indices
+
+
+def new_request() -> int:
+    """Advance the request counter (call once per generate())."""
+    _counter["n"] += 1
+    return _counter["n"]
+
+
+def maybe_capture(tag: str, arrays: Dict[str, Any],
+                  request_index: Optional[int] = None) -> Optional[str]:
+    """Save arrays to <dir>/request{i}_{tag}.npz when capture is enabled for this
+    request. Returns the path written, or None."""
+    cfg = _config()
+    if cfg is None:
+        return None
+    directory, indices = cfg
+    idx = _counter["n"] if request_index is None else request_index
+    if indices is not None and idx not in indices:
+        return None
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, f"request{idx}_{tag}.npz")
+    np.savez(path, **{k: np.asarray(v) for k, v in arrays.items() if v is not None})
+    return path
+
+
+def maybe_capture_weights(params) -> Optional[str]:
+    """One-time weight snapshot when TPUINF_CAPTURE_WEIGHTS=1."""
+    cfg = _config()
+    if cfg is None or os.environ.get("TPUINF_CAPTURE_WEIGHTS") != "1":
+        return None
+    directory, _ = cfg
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, "weights.npz")
+    if os.path.exists(path):
+        return path
+    import jax
+
+    flat = {}
+
+    def visit(p, x):
+        flat["/".join(str(getattr(k, "key", k)) for k in p)] = np.asarray(x)
+
+    jax.tree_util.tree_map_with_path(visit, params)
+    np.savez(path, **flat)
+    return path

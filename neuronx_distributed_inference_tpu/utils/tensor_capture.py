@@ -1,0 +1,78 @@
+"""Tensor capture (extra graph outputs) and tensor replacement (inject goldens).
+
+≈ reference tensor capture (`models/model_base.py:1076-1182`, `TensorCaptureConfig`
+`models/config.py:1080-1128`) and tensor replacement (`TensorReplacementConfig`
+`models/config.py:1131-1161`, `utils/tensor_replacement/registry.py`). TPU redesign:
+
+The functional model calls ``tap(name, value)`` at known points ("embed",
+"hidden_stack", "final_hidden", "logits"). Outside capture mode the tap is an identity
+with zero overhead. Under ``capture(...)`` the model is re-traced (the application
+builds a dedicated jit), taps record their values as extra outputs, and replacement
+taps return the injected golden instead — the divergence-isolation workflow the
+reference implements with extra graph outputs and mid-graph injection.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+from typing import Any, Dict, Iterable, Optional, Sequence
+
+_ACTIVE: contextvars.ContextVar[Optional["CaptureState"]] = contextvars.ContextVar(
+    "tensor_capture_state", default=None)
+
+# tap points the base model exposes (model families may tap more)
+KNOWN_TAPS = ("embed", "hidden_stack", "final_hidden", "logits")
+# taps whose return value feeds downstream compute (replacement-capable);
+# "hidden_stack" is capture-only — it is emitted AFTER the layer scan consumed it
+REPLACEABLE_TAPS = ("embed", "final_hidden", "logits")
+
+
+class CaptureState:
+    def __init__(self, names: Sequence[str],
+                 replacements: Optional[Dict[str, Any]] = None):
+        self.names = tuple(names)
+        self.replacements = dict(replacements or {})
+        for name in self.replacements:
+            if name not in REPLACEABLE_TAPS:
+                raise ValueError(
+                    f"tap {name!r} is capture-only; replacements are supported at "
+                    f"{REPLACEABLE_TAPS}")
+        self.captured: Dict[str, Any] = {}
+
+    def wants(self, name: str) -> bool:
+        return name in self.names
+
+
+def tap(name: str, value):
+    """Model-side instrumentation point: identity unless capture is active."""
+    st = _ACTIVE.get()
+    if st is None:
+        return value
+    if name in st.replacements:
+        import jax.numpy as jnp
+
+        golden = jnp.asarray(st.replacements[name])
+        if golden.shape != value.shape:
+            raise ValueError(
+                f"replacement for {name!r} has shape {golden.shape} but the tap "
+                f"carries the PADDED shape {value.shape} (pad the golden to the "
+                f"compiled batch/bucket)")
+        value = golden.astype(value.dtype)
+    if st.wants(name):
+        st.captured[name] = value
+    return value
+
+
+@contextlib.contextmanager
+def capture(names: Iterable[str] = KNOWN_TAPS,
+            replacements: Optional[Dict[str, Any]] = None):
+    """Activate taps for the duration of a trace; yields the CaptureState whose
+    ``captured`` dict fills in during tracing (entries are tracers — return them from
+    the traced function to materialize)."""
+    st = CaptureState(tuple(names), replacements)
+    token = _ACTIVE.set(st)
+    try:
+        yield st
+    finally:
+        _ACTIVE.reset(token)

@@ -1,0 +1,124 @@
+"""Aux subsystems: tensor capture/replacement, snapshot, profiling, KV reconstruct,
+runtime env, launcher (≈ reference SURVEY §5 auxiliary subsystems)."""
+
+import os
+
+import numpy as np
+import pytest
+import torch
+
+from neuronx_distributed_inference_tpu.config import TpuConfig, load_pretrained_config
+from neuronx_distributed_inference_tpu.models.llama.modeling_llama import (
+    LlamaForCausalLM, LlamaInferenceConfig)
+
+
+@pytest.fixture(scope="module")
+def tiny_app():
+    from transformers import LlamaConfig, LlamaForCausalLM as HFLlama
+
+    cfg = LlamaConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, tie_word_embeddings=False)
+    torch.manual_seed(0)
+    hf = HFLlama(cfg).eval()
+    tpu_cfg = TpuConfig(batch_size=2, seq_len=64, max_context_length=32,
+                        dtype="float32", context_encoding_buckets=[16],
+                        token_generation_buckets=[64])
+    config = LlamaInferenceConfig(tpu_cfg, load_config=load_pretrained_config(cfg))
+    app = LlamaForCausalLM(None, config)
+    state = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+    app._put_params(app.convert_hf_state_dict(state, app.config))
+    return app
+
+
+def test_tensor_capture_shapes_and_consistency(tiny_app):
+    app = tiny_app
+    rng = np.random.default_rng(0)
+    input_ids = rng.integers(1, 256, size=(2, 10)).astype(np.int64)
+    logits, captured = app.prefill_with_capture(input_ids)
+    assert set(captured) == {"embed", "hidden_stack", "final_hidden", "logits"}
+    assert captured["embed"].shape == (2, 16, 64)
+    assert captured["hidden_stack"].shape == (2, 2, 16, 64)    # (L, B, S, H)
+    assert captured["final_hidden"].shape == (2, 16, 64)
+    # the tapped logits equal the returned logits
+    np.testing.assert_allclose(captured["logits"][:2], logits, rtol=1e-6)
+    # and match the normal generate path
+    out = app.generate(input_ids, max_new_tokens=1, return_logits=True)
+    np.testing.assert_allclose(logits, out.logits[0], atol=1e-5, rtol=1e-5)
+
+
+def test_tensor_replacement_injects_golden(tiny_app):
+    """Injecting a golden at 'embed' must change downstream logits deterministically:
+    replaying the captured embed reproduces identical logits (divergence isolation)."""
+    app = tiny_app
+    rng = np.random.default_rng(1)
+    ids_a = rng.integers(1, 256, size=(2, 10)).astype(np.int64)
+    ids_b = rng.integers(1, 256, size=(2, 10)).astype(np.int64)
+    _, cap_a = app.prefill_with_capture(ids_a)
+    logits_b, _ = app.prefill_with_capture(ids_b)
+    # run prompt B but replace the embedding with prompt A's -> must equal A's logits
+    logits_ab, _ = app.prefill_with_capture(
+        ids_b, replacements={"embed": cap_a["embed"]})
+    logits_a, _ = app.prefill_with_capture(ids_a)
+    np.testing.assert_allclose(logits_ab, logits_a, atol=1e-5, rtol=1e-5)
+    assert np.abs(logits_ab - logits_b).max() > 1e-3
+
+
+def test_snapshot_capture(tiny_app, tmp_path, monkeypatch):
+    monkeypatch.setenv("TPUINF_CAPTURE_DIR", str(tmp_path))
+    monkeypatch.setenv("TPUINF_CAPTURE_AT", "")       # all requests
+    rng = np.random.default_rng(2)
+    input_ids = rng.integers(1, 256, size=(2, 8)).astype(np.int64)
+    tiny_app.generate(input_ids, max_new_tokens=2)
+    files = list(tmp_path.glob("request*_prefill.npz"))
+    assert files, "no snapshot written"
+    data = np.load(files[0])
+    assert data["input_ids"].shape == (2, 16)
+
+
+def test_kv_reconstruct_dense(tiny_app):
+    from neuronx_distributed_inference_tpu.utils.kv_cache_reconstruct import (
+        cache_summary, reconstruct_dense)
+
+    rng = np.random.default_rng(3)
+    input_ids = rng.integers(1, 256, size=(2, 8)).astype(np.int64)
+    tiny_app.generate(input_ids, max_new_tokens=2)
+    layers = reconstruct_dense(tiny_app.kv_cache, seq_len=10)
+    assert len(layers) == 2
+    assert layers[0]["k"].shape == (2, 2, 10, 16)
+    assert layers[0]["k"].dtype == np.float32
+    # cache was actually written (prefill region nonzero)
+    assert np.abs(layers[0]["k"][:, :, :8]).sum() > 0
+    assert "k" in cache_summary(tiny_app.kv_cache)
+
+
+def test_profiling_trace(tiny_app, tmp_path):
+    from neuronx_distributed_inference_tpu.utils.profiling import profile_callable
+
+    rng = np.random.default_rng(4)
+    input_ids = rng.integers(1, 256, size=(2, 8)).astype(np.int64)
+    _, secs = profile_callable(tiny_app.generate, input_ids, max_new_tokens=2,
+                               logdir=str(tmp_path / "trace"), warmup=1, iters=1)
+    assert secs > 0
+    assert any((tmp_path / "trace").rglob("*"))
+
+
+def test_runtime_env_flags(monkeypatch):
+    from neuronx_distributed_inference_tpu.utils import runtime_env
+
+    monkeypatch.setenv("XLA_FLAGS", "")
+    applied = runtime_env.set_runtime_env(seq_len=65536)
+    assert applied.get("long_context") == "true"
+    assert "--xla_tpu_enable_async_collective_fusion=true" in os.environ["XLA_FLAGS"]
+
+
+def test_launcher_cli_parses():
+    from neuronx_distributed_inference_tpu.runtime import launcher
+
+    # arg plumbing only (actual multi-process launch exercised manually / by driver)
+    import argparse
+    try:
+        launcher.main(["--num-processes", "0", "dummy.py"])
+    except SystemExit:
+        pass
+    assert launcher.init_from_env() is False
