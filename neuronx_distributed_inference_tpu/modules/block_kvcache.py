@@ -175,7 +175,8 @@ class BlockAllocator:
 
         Returns (block_ids, num_cached_tokens): with prefix caching on, leading full
         blocks already resident are shared and counted in num_cached_tokens (the caller
-        may skip prefilling them).
+        may skip prefilling them). On exhaustion every block taken here is released
+        before raising (clean rollback — matching native/engine.cpp).
         """
         tokens = np.asarray(tokens, dtype=np.int32)
         n = len(tokens)
@@ -185,32 +186,46 @@ class BlockAllocator:
         num_cached = 0
         prev = b""
         reusing = self.enable_prefix_caching
-        for i in range(n_full):
-            chunk = tokens[i * bs : (i + 1) * bs]
-            h = self._chain_hash(prev, chunk)
-            prev = h
-            if reusing and h in self.hash_to_block:
-                blk = self.hash_to_block[h]
-                self.refcount[blk] += 1
+        try:
+            for i in range(n_full):
+                chunk = tokens[i * bs : (i + 1) * bs]
+                h = self._chain_hash(prev, chunk)
+                prev = h
+                if reusing and h in self.hash_to_block:
+                    blk = self.hash_to_block[h]
+                    self.refcount[blk] += 1
+                    blocks.append(blk)
+                    num_cached += bs
+                    continue
+                reusing = False   # first miss ends the shared prefix
+                blk = self._alloc_one()
+                if self.enable_prefix_caching:
+                    self.hash_to_block[h] = blk
+                    self.block_to_hash[blk] = h
                 blocks.append(blk)
-                num_cached += bs
-                continue
-            reusing = False   # first miss ends the shared prefix
-            blk = self._alloc_one()
-            if self.enable_prefix_caching:
-                self.hash_to_block[h] = blk
-                self.block_to_hash[blk] = h
-            blocks.append(blk)
-        # trailing partial block (or room for the next token) is always private
-        remaining = n - n_full * bs
-        if remaining > 0 or n_full == len(blocks):
-            blocks.append(self._alloc_one())
+            # trailing partial block (or room for the next token) is always private
+            remaining = n - n_full * bs
+            if remaining > 0 or n_full == len(blocks):
+                blocks.append(self._alloc_one())
+        except RuntimeError:
+            for blk in blocks:
+                self._release_one(blk)
+            raise
         return blocks, num_cached
 
     def extend(self, blocks: List[int], seq_len: int) -> None:
-        """Ensure ``blocks`` covers positions [0, seq_len); appends new blocks."""
-        while len(blocks) * self.block_size < seq_len:
-            blocks.append(self._alloc_one())
+        """Ensure ``blocks`` covers positions [0, seq_len); appends new blocks.
+        On exhaustion the appended blocks are released and ``blocks`` restored
+        (clean rollback — matching native/engine.cpp)."""
+        n_in = len(blocks)
+        try:
+            while len(blocks) * self.block_size < seq_len:
+                blocks.append(self._alloc_one())
+        except RuntimeError:
+            for blk in blocks[n_in:]:
+                self._release_one(blk)
+            del blocks[n_in:]
+            raise
 
     def free_sequence(self, blocks: Sequence[int]) -> None:
         for blk in blocks:

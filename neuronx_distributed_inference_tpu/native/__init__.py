@@ -1,0 +1,182 @@
+"""Native host-runtime bindings (ctypes over native/engine.cpp).
+
+The shared library is compiled on first import with the system toolchain and cached
+next to the source (rebuilt when engine.cpp changes). When no compiler is available the
+callers fall back to the pure-Python implementations in modules/block_kvcache — the
+semantic reference the native engine is tested against (tests/test_native_engine.py).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import logging
+import os
+import subprocess
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+logger = logging.getLogger("tpu-inference")
+
+_SRC = os.path.join(os.path.dirname(__file__), "engine.cpp")
+
+
+def _lib_path() -> str:
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    return os.path.join(os.path.dirname(__file__), f"_engine_{digest}.so")
+
+
+def _build() -> Optional[str]:
+    path = _lib_path()
+    if os.path.exists(path):
+        return path
+    # compile to a process-private temp then rename: atomic against concurrent
+    # importers racing on the same cache path
+    tmp = f"{path}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True)
+        os.replace(tmp, path)
+        return path
+    except (OSError, subprocess.CalledProcessError) as e:
+        logger.warning("native engine build failed (%s); using Python fallback", e)
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        return None
+
+
+_lib = None      # None = untried, False = build failed, CDLL = loaded
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Build (if needed) and load the native library; None if unavailable.
+    A failed build is cached — no repeated compile attempts."""
+    global _lib
+    if _lib is not None:
+        return _lib or None
+    path = _build()
+    if path is None:
+        _lib = False
+        return None
+    lib = ctypes.CDLL(path)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.engine_create.restype = ctypes.c_void_p
+    lib.engine_create.argtypes = [ctypes.c_int, ctypes.c_int, ctypes.c_int]
+    lib.engine_destroy.argtypes = [ctypes.c_void_p]
+    lib.engine_num_free.restype = ctypes.c_int
+    lib.engine_num_free.argtypes = [ctypes.c_void_p]
+    lib.engine_allocate_for_prompt.restype = ctypes.c_int
+    lib.engine_allocate_for_prompt.argtypes = [
+        ctypes.c_void_p, i32p, ctypes.c_int, i32p, ctypes.POINTER(ctypes.c_int)]
+    lib.engine_extend.restype = ctypes.c_int
+    lib.engine_extend.argtypes = [ctypes.c_void_p, i32p, ctypes.c_int,
+                                  ctypes.c_int, ctypes.c_int]
+    lib.engine_free_sequence.argtypes = [ctypes.c_void_p, i32p, ctypes.c_int]
+    lib.make_slot_mapping.argtypes = [i32p, ctypes.c_int, ctypes.c_int, i32p,
+                                      ctypes.c_int, ctypes.c_int, u8p, i32p]
+    _lib = lib
+    return lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def _as_i32p(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+class NativeBlockAllocator:
+    """Drop-in for modules/block_kvcache.BlockAllocator backed by the C++ engine."""
+
+    def __init__(self, num_blocks: int, block_size: int,
+                 enable_prefix_caching: bool = False):
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native engine unavailable")
+        self._lib = lib
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self.enable_prefix_caching = enable_prefix_caching
+        self._h = lib.engine_create(num_blocks, block_size,
+                                    int(enable_prefix_caching))
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h:
+            self._lib.engine_destroy(h)
+            self._h = None
+
+    @property
+    def num_free(self) -> int:
+        return self._lib.engine_num_free(self._h)
+
+    def allocate_for_prompt(self, tokens: Sequence[int]) -> Tuple[List[int], int]:
+        toks = np.ascontiguousarray(tokens, dtype=np.int32)
+        out = np.empty((len(toks) // self.block_size + 2,), dtype=np.int32)
+        cached = ctypes.c_int(0)
+        n = self._lib.engine_allocate_for_prompt(
+            self._h, _as_i32p(toks), len(toks), _as_i32p(out),
+            ctypes.byref(cached))
+        if n < 0:
+            raise RuntimeError("out of KV blocks")
+        return out[:n].tolist(), int(cached.value)
+
+    def extend(self, blocks: List[int], seq_len: int) -> None:
+        need = -(-seq_len // self.block_size)
+        cap = max(need, len(blocks)) + 1
+        buf = np.empty((cap,), dtype=np.int32)
+        buf[: len(blocks)] = blocks
+        n = self._lib.engine_extend(self._h, _as_i32p(buf), len(blocks),
+                                    seq_len, cap)
+        if n < 0:
+            raise RuntimeError("out of KV blocks")
+        blocks[:] = buf[:n].tolist()
+
+    def free_sequence(self, blocks: Sequence[int]) -> None:
+        arr = np.ascontiguousarray(blocks, dtype=np.int32)
+        self._lib.engine_free_sequence(self._h, _as_i32p(arr), len(arr))
+
+
+def native_make_slot_mapping(block_table: np.ndarray, positions: np.ndarray,
+                             steps: int, block_size: int,
+                             valid: Optional[np.ndarray] = None) -> np.ndarray:
+    """C++ slot-mapping (drop-in for block_kvcache.make_slot_mapping)."""
+    lib = load()
+    bt = np.ascontiguousarray(block_table, dtype=np.int32)
+    pos = np.ascontiguousarray(positions, dtype=np.int32)
+    rows, max_blocks = bt.shape
+    out = np.empty((rows, steps), dtype=np.int32)
+    vptr = None
+    if valid is not None:
+        varr = np.asarray(valid, dtype=np.uint8)
+        if varr.ndim == 1:                   # per-row validity -> per-element
+            varr = np.broadcast_to(varr[:, None], (rows, steps))
+        varr = np.ascontiguousarray(varr)
+        vptr = varr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+    lib.make_slot_mapping(_as_i32p(bt), rows, max_blocks, _as_i32p(pos), steps,
+                          block_size, vptr, _as_i32p(out))
+    return out
+
+
+def make_block_allocator(num_blocks: int, block_size: int,
+                         enable_prefix_caching: bool = False):
+    """Native allocator when the toolchain permits; Python fallback otherwise."""
+    if available():
+        return NativeBlockAllocator(num_blocks, block_size, enable_prefix_caching)
+    from ..modules.block_kvcache import BlockAllocator
+
+    return BlockAllocator(num_blocks, block_size, enable_prefix_caching)
+
+
+def get_slot_mapping_fn():
+    """The slot-mapping implementation to use (native or Python fallback) — the
+    single dispatch point callers should import."""
+    if available():
+        return native_make_slot_mapping
+    from ..modules.block_kvcache import make_slot_mapping
+
+    return make_slot_mapping

@@ -1,0 +1,248 @@
+// Native host runtime for the paged-KV serving path.
+//
+// ≈ the reference's native layer: NxDI itself is pure Python and leans on closed
+// native deps for its runtime (SURVEY §2.1); the TPU build keeps the device path in
+// XLA but implements the host-side hot loops natively:
+//  - ref-counted block allocator with chained-hash prefix-cache reuse
+//    (≈ modules/block_kvcache.BlockAllocator / the reference's block-KV manager
+//    `modules/kvcache/block_kv_cache_manager.py`)
+//  - slot-mapping generation for decode chunks (per-step scatter targets,
+//    ≈ `block_kv_cache_manager.py:376-431` generate_*_slot_mapping)
+//
+// Exposed as a C ABI consumed via ctypes (native/__init__.py); the Python
+// implementations remain as a fallback and as the semantic reference.
+
+#include <array>
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+// Compact SHA-256 (FIPS 180-4) — the prefix-cache key must be collision-resistant
+// (blocks are SHARED across requests; a collision would hand one request another's
+// KV content), and using the same construction as the Python reference
+// (sha256(prev_digest || tokens)) keeps the two implementations bit-identical.
+struct Sha256 {
+  static constexpr std::array<uint32_t, 64> K = {
+      0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+      0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+      0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+      0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+      0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+      0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+      0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+      0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+      0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+      0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+      0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2};
+
+  static uint32_t rotr(uint32_t x, int n) { return (x >> n) | (x << (32 - n)); }
+
+  static std::array<uint8_t, 32> digest(const uint8_t* data, size_t len) {
+    std::array<uint32_t, 8> h = {0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+                                 0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19};
+    std::vector<uint8_t> msg(data, data + len);
+    msg.push_back(0x80);
+    while (msg.size() % 64 != 56) msg.push_back(0);
+    uint64_t bits = static_cast<uint64_t>(len) * 8;
+    for (int i = 7; i >= 0; --i) msg.push_back((bits >> (8 * i)) & 0xff);
+    for (size_t off = 0; off < msg.size(); off += 64) {
+      uint32_t w[64];
+      for (int i = 0; i < 16; ++i)
+        w[i] = (msg[off + 4 * i] << 24) | (msg[off + 4 * i + 1] << 16) |
+               (msg[off + 4 * i + 2] << 8) | msg[off + 4 * i + 3];
+      for (int i = 16; i < 64; ++i) {
+        uint32_t s0 = rotr(w[i - 15], 7) ^ rotr(w[i - 15], 18) ^ (w[i - 15] >> 3);
+        uint32_t s1 = rotr(w[i - 2], 17) ^ rotr(w[i - 2], 19) ^ (w[i - 2] >> 10);
+        w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+      }
+      auto v = h;
+      for (int i = 0; i < 64; ++i) {
+        uint32_t S1 = rotr(v[4], 6) ^ rotr(v[4], 11) ^ rotr(v[4], 25);
+        uint32_t ch = (v[4] & v[5]) ^ (~v[4] & v[6]);
+        uint32_t t1 = v[7] + S1 + ch + K[i] + w[i];
+        uint32_t S0 = rotr(v[0], 2) ^ rotr(v[0], 13) ^ rotr(v[0], 22);
+        uint32_t maj = (v[0] & v[1]) ^ (v[0] & v[2]) ^ (v[1] & v[2]);
+        uint32_t t2 = S0 + maj;
+        v = {t1 + t2, v[0], v[1], v[2], v[3] + t1, v[4], v[5], v[6]};
+      }
+      for (int i = 0; i < 8; ++i) h[i] += v[i];
+    }
+    std::array<uint8_t, 32> out;
+    for (int i = 0; i < 8; ++i) {
+      out[4 * i] = h[i] >> 24;
+      out[4 * i + 1] = (h[i] >> 16) & 0xff;
+      out[4 * i + 2] = (h[i] >> 8) & 0xff;
+      out[4 * i + 3] = h[i] & 0xff;
+    }
+    return out;
+  }
+};
+
+using Digest = std::array<uint8_t, 32>;
+
+// sha256(prev_digest || tokens) — identical to the Python BlockAllocator chain
+// (prev is empty for the first block, matching Python's b"" seed)
+Digest chain_hash(const Digest* prev, const int32_t* tokens, int n) {
+  std::vector<uint8_t> buf;
+  if (prev != nullptr) buf.insert(buf.end(), prev->begin(), prev->end());
+  const auto* bytes = reinterpret_cast<const uint8_t*>(tokens);
+  buf.insert(buf.end(), bytes, bytes + static_cast<size_t>(n) * 4);
+  return Sha256::digest(buf.data(), buf.size());
+}
+
+std::string key_of(const Digest& d) {
+  return std::string(reinterpret_cast<const char*>(d.data()), d.size());
+}
+
+struct Engine {
+  int num_blocks = 0;
+  int block_size = 0;
+  bool prefix_caching = false;
+  std::vector<int32_t> free_list;              // back = next to allocate (lowest id)
+  std::vector<int32_t> refcount;               // size num_blocks; 0 = free
+  std::unordered_map<std::string, int32_t> hash_to_block;
+  std::vector<std::string> block_hash;         // "" = none
+  std::vector<uint8_t> block_has_hash;
+
+  explicit Engine(int blocks, int bs, bool pc)
+      : num_blocks(blocks), block_size(bs), prefix_caching(pc),
+        refcount(blocks, 0), block_hash(blocks), block_has_hash(blocks, 0) {
+    free_list.reserve(blocks);
+    for (int i = blocks - 1; i >= 0; --i) free_list.push_back(i);
+  }
+
+  int alloc_one() {
+    if (free_list.empty()) return -1;
+    int blk = free_list.back();
+    free_list.pop_back();
+    refcount[blk] = 1;
+    return blk;
+  }
+
+  void release_one(int blk) {
+    if (--refcount[blk] == 0) {
+      if (block_has_hash[blk]) {
+        auto it = hash_to_block.find(block_hash[blk]);
+        if (it != hash_to_block.end() && it->second == blk) hash_to_block.erase(it);
+        block_has_hash[blk] = 0;
+      }
+      free_list.push_back(blk);
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* engine_create(int num_blocks, int block_size, int enable_prefix_caching) {
+  return new Engine(num_blocks, block_size, enable_prefix_caching != 0);
+}
+
+void engine_destroy(void* h) { delete static_cast<Engine*>(h); }
+
+int engine_num_free(void* h) {
+  return static_cast<int>(static_cast<Engine*>(h)->free_list.size());
+}
+
+// Allocate blocks covering `n` prompt tokens (+ the next token's slot).
+// out_blocks must hold ceil(n/bs)+1 entries. Returns the block count, and writes the
+// number of prefix-cached tokens to *out_cached. Returns -1 when out of blocks (any
+// blocks taken so far are rolled back).
+int engine_allocate_for_prompt(void* h, const int32_t* tokens, int n,
+                               int32_t* out_blocks, int* out_cached) {
+  auto* e = static_cast<Engine*>(h);
+  const int bs = e->block_size;
+  const int n_full = n / bs;
+  int count = 0, cached = 0;
+  Digest prev{};
+  bool have_prev = false;
+  bool reusing = e->prefix_caching;
+  for (int i = 0; i < n_full; ++i) {
+    Digest hh = chain_hash(have_prev ? &prev : nullptr, tokens + i * bs, bs);
+    prev = hh;
+    have_prev = true;
+    std::string kk = key_of(hh);
+    if (reusing) {
+      auto it = e->hash_to_block.find(kk);
+      if (it != e->hash_to_block.end()) {
+        e->refcount[it->second]++;
+        out_blocks[count++] = it->second;
+        cached += bs;
+        continue;
+      }
+    }
+    reusing = false;  // first miss ends the shared prefix
+    int blk = e->alloc_one();
+    if (blk < 0) {
+      for (int j = 0; j < count; ++j) e->release_one(out_blocks[j]);
+      return -1;
+    }
+    if (e->prefix_caching) {
+      e->hash_to_block[kk] = blk;
+      e->block_hash[blk] = kk;
+      e->block_has_hash[blk] = 1;
+    }
+    out_blocks[count++] = blk;
+  }
+  // trailing partial block (or next-token room) is always private
+  if (n - n_full * bs > 0 || n_full == count) {
+    int blk = e->alloc_one();
+    if (blk < 0) {
+      for (int j = 0; j < count; ++j) e->release_one(out_blocks[j]);
+      return -1;
+    }
+    out_blocks[count++] = blk;
+  }
+  *out_cached = cached;
+  return count;
+}
+
+// Ensure blocks cover [0, seq_len); appends into out_blocks (capacity max_out).
+// Returns the new count or -1 on exhaustion (appended blocks rolled back).
+int engine_extend(void* h, int32_t* blocks, int n_in, int seq_len, int max_out) {
+  auto* e = static_cast<Engine*>(h);
+  int count = n_in;
+  while (count * e->block_size < seq_len) {
+    int blk = (count < max_out) ? e->alloc_one() : -1;
+    if (blk < 0) {
+      for (int j = n_in; j < count; ++j) e->release_one(blocks[j]);
+      return -1;
+    }
+    blocks[count++] = blk;
+  }
+  return count;
+}
+
+void engine_free_sequence(void* h, const int32_t* blocks, int n) {
+  auto* e = static_cast<Engine*>(h);
+  for (int i = 0; i < n; ++i) e->release_one(blocks[i]);
+}
+
+// Slot mapping: for each of `rows` sequences and `steps` token positions, the flat
+// cache slot written: block_table[row][pos/bs]*bs + pos%bs, or -1 when dropped
+// (position beyond the table, or valid[row*steps+j] == 0). valid is a per-element
+// (rows, steps) mask or null. out is (rows, steps) int32, row-major.
+void make_slot_mapping(const int32_t* block_table, int rows, int max_blocks,
+                       const int32_t* positions, int steps, int block_size,
+                       const uint8_t* valid, int32_t* out) {
+  for (int r = 0; r < rows; ++r) {
+    const int32_t* bt = block_table + static_cast<int64_t>(r) * max_blocks;
+    for (int j = 0; j < steps; ++j) {
+      if (valid != nullptr && !valid[r * steps + j]) {
+        out[r * steps + j] = -1;
+        continue;
+      }
+      int pos = positions[r] + j;
+      int bi = pos / block_size;
+      out[r * steps + j] =
+          (bi < max_blocks) ? bt[bi] * block_size + pos % block_size : -1;
+    }
+  }
+}
+
+}  // extern "C"

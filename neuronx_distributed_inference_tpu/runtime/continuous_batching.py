@@ -80,6 +80,11 @@ class ContinuousBatchingRunner:
         self.last_tok = np.zeros((self.num_slots,), dtype=np.int32)
 
         if self.paged:
+            # native host engine (allocator + slot mapping) when available; the
+            # non-paged path never touches either, so the build is gated here
+            from .. import native as native_lib
+
+            self._slot_mapping_fn = native_lib.get_slot_mapping_fn()
             bs = cfg.pa_block_size
             self.block_size = bs
             self.max_blocks_per_seq = -(-cfg.seq_len // bs)
@@ -87,7 +92,11 @@ class ContinuousBatchingRunner:
                 num_layers=app.arch_args.num_layers, num_blocks=cfg.pa_num_blocks,
                 block_size=bs, num_kv_heads=app.arch_args.num_kv_heads,
                 head_dim=app.arch_args.head_dim, dtype=cfg.kv_cache_jax_dtype)
-            self.allocator = block_kvcache.BlockAllocator(
+            from ..native import make_block_allocator
+
+            # C++ engine when the toolchain permits (native/engine.cpp); Python
+            # fallback keeps identical semantics (tests/test_native_engine.py)
+            self.allocator = make_block_allocator(
                 cfg.pa_num_blocks, bs, enable_prefix_caching=True)
             sharding = named_sharding(app.mesh, block_kvcache.PAGED_CACHE_LOGICAL,
                                       app.sharding_rules)
@@ -270,7 +279,7 @@ class ContinuousBatchingRunner:
             if not active_rows:
                 return emitted
             valid = np.array([r is not None and not r.done for r in self.active])
-            slot_chunk = block_kvcache.make_slot_mapping(
+            slot_chunk = self._slot_mapping_fn(
                 self.block_table, self.positions, steps, self.block_size, valid=valid)
             toks_dev, self.cache = self._decode_step(
                 self.app.params, jnp.asarray(self.last_tok),
@@ -384,7 +393,7 @@ class ContinuousBatchingRunner:
                 pos_row = np.array([start], dtype=np.int32)
                 valid = np.ones((1, padded.bucket), dtype=bool)
                 valid[0, len(window):] = False
-                slot_map = block_kvcache.make_slot_mapping(
+                slot_map = self._slot_mapping_fn(
                     self.block_table[slot : slot + 1], pos_row, padded.bucket,
                     self.block_size, valid=valid)
                 key, sub = jax.random.split(key)

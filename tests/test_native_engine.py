@@ -1,0 +1,106 @@
+"""Native C++ host engine vs the Python reference implementation.
+
+The C++ allocator/slot-mapping (native/engine.cpp) must behave identically to
+modules/block_kvcache across randomized serving workloads."""
+
+import numpy as np
+import pytest
+
+from neuronx_distributed_inference_tpu import native
+from neuronx_distributed_inference_tpu.modules import block_kvcache
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="no C++ toolchain for the native engine")
+
+
+def test_allocator_matches_python_reference():
+    rng = np.random.default_rng(0)
+    py = block_kvcache.BlockAllocator(64, 4, enable_prefix_caching=True)
+    cc = native.NativeBlockAllocator(64, 4, enable_prefix_caching=True)
+
+    live = []   # (py_blocks, cc_blocks)
+    for it in range(200):
+        op = rng.integers(0, 3)
+        if op == 0 or not live:                       # allocate
+            n = int(rng.integers(1, 20))
+            # shared prefixes: draw from a small pool of prompt stems
+            stem = rng.integers(0, 3) * np.ones(8, dtype=np.int32)
+            toks = np.concatenate([stem, rng.integers(0, 50, size=n)]).astype(np.int32)
+            try:
+                pb, pc_cached = py.allocate_for_prompt(toks)
+            except RuntimeError:
+                with pytest.raises(RuntimeError):
+                    cc.allocate_for_prompt(toks)
+                continue
+            cb, cc_cached = cc.allocate_for_prompt(toks)
+            assert len(pb) == len(cb)
+            assert pc_cached == cc_cached, (it, pc_cached, cc_cached)
+            live.append((pb, cb))
+        elif op == 1:                                 # extend
+            i = int(rng.integers(0, len(live)))
+            pb, cb = live[i]
+            target = len(pb) * 4 + int(rng.integers(1, 9))
+            try:
+                py.extend(pb, target)
+            except RuntimeError:
+                with pytest.raises(RuntimeError):
+                    cc.extend(cb, target)
+                continue
+            cc.extend(cb, target)
+            assert len(pb) == len(cb)
+        else:                                          # free
+            i = int(rng.integers(0, len(live)))
+            pb, cb = live.pop(i)
+            py.free_sequence(pb)
+            cc.free_sequence(cb)
+        assert py.num_free == cc.num_free, f"iteration {it}"
+
+
+def test_prefix_cache_reuse_and_refcount():
+    cc = native.NativeBlockAllocator(16, 4, enable_prefix_caching=True)
+    prompt = np.arange(12, dtype=np.int32)            # 3 full blocks
+    b1, cached1 = cc.allocate_for_prompt(prompt)
+    assert cached1 == 0
+    b2, cached2 = cc.allocate_for_prompt(prompt)
+    assert cached2 == 12                  # all 3 full blocks shared; tail block private
+    assert b1[:3] == b2[:3]
+    free_before = cc.num_free
+    cc.free_sequence(b1)
+    # shared blocks still referenced by b2 -> only b1's private tail is released
+    assert cc.num_free == free_before + 1
+    cc.free_sequence(b2)
+    assert cc.num_free == 16
+
+
+def test_slot_mapping_matches_python():
+    rng = np.random.default_rng(1)
+    bt = rng.integers(0, 32, size=(4, 8)).astype(np.int32)
+    pos = rng.integers(0, 20, size=(4,)).astype(np.int32)
+    valid = np.array([True, False, True, True])
+    ours = native.native_make_slot_mapping(bt, pos, 6, 4, valid=valid)
+    ref = block_kvcache.make_slot_mapping(bt, pos, 6, 4, valid=valid)
+    np.testing.assert_array_equal(ours, ref)
+
+
+def test_runner_uses_native_allocator():
+    from transformers import LlamaConfig
+
+    from neuronx_distributed_inference_tpu.native import NativeBlockAllocator
+    from tests.test_continuous_batching import _make_app  # reuse existing fixture fn
+
+    hf_cfg = LlamaConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
+                         num_hidden_layers=2, num_attention_heads=4,
+                         num_key_value_heads=2, tie_word_embeddings=False)
+    app = _make_app(hf_cfg, paged=True)
+    from neuronx_distributed_inference_tpu.runtime.continuous_batching import (
+        ContinuousBatchingRunner)
+
+    runner = ContinuousBatchingRunner(app)
+    assert isinstance(runner.allocator, NativeBlockAllocator)
+    rng = np.random.default_rng(2)
+    for _ in range(3):
+        runner.submit(rng.integers(1, 250, size=(int(rng.integers(3, 12)),)),
+                      max_new_tokens=6)
+    out = runner.run_to_completion()
+    assert len(out) == 3
+    assert all(len(v) == 6 for v in out.values())
