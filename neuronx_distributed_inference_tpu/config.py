@@ -145,6 +145,10 @@ class TpuConfig:
     sequence_parallel_enabled: bool = False
     vocab_parallel: bool = True      # shard embed/lm_head on vocab dim
     flash_decoding_enabled: bool = False
+    # decode attention in batch-parallel layout over ALL chips (batch sharded over
+    # dp x tp, GQA kv heads replicated) — ≈ reference attention DP
+    # (`attention_process_groups.py:125-163`); the rest of the model stays TP
+    attention_dp_enabled: bool = False
 
     # --- dtypes ---
     dtype: str = "bfloat16"
@@ -198,6 +202,11 @@ class TpuConfig:
             raise ValueError("sequence parallelism requires seq_len % tp_degree == 0")
         if self.dp_degree > 1 and not self.is_continuous_batching:
             raise ValueError("attention data parallelism requires continuous batching")
+        if self.attention_dp_enabled and \
+                self.max_batch_size % (self.dp_degree * self.tp_degree) != 0:
+            raise ValueError(
+                "attention_dp_enabled requires max_batch_size divisible by "
+                "dp_degree * tp_degree (batch is sharded over both axes)")
         if self.paged_attention_enabled and self.pa_num_blocks < 1:
             raise ValueError("paged attention requires pa_num_blocks >= 1")
         if self.on_device_sampling_config is not None:

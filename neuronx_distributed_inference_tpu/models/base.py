@@ -376,12 +376,22 @@ def _decoder_layer(
     resid = h
     hn = _norm(h, lp["ln1"], args)
     q, k, v = _project_qkv(lp, args, hn, adapter_ids)
-    # prefill activations shard along seq over cp (sequence/context parallelism,
-    # ≈ SP reduce-scatter + CP seq shards, `model_base.py:1509-1560`); no-op at cp=1
-    seq_ax = "seq" if positions is None else None
-    q = constrain(q, ("batch", "heads", seq_ax, None), rules, mesh=mesh)
-    k = constrain(k, ("batch", "kv_heads", seq_ax, None), rules, mesh=mesh)
-    v = constrain(v, ("batch", "kv_heads", seq_ax, None), rules, mesh=mesh)
+    if positions is None:
+        # prefill activations shard along seq over cp (sequence/context parallelism,
+        # ≈ SP reduce-scatter + CP seq shards, `model_base.py:1509-1560`); no-op at cp=1
+        q = constrain(q, ("batch", "heads", "seq", None), rules, mesh=mesh)
+        k = constrain(k, ("batch", "kv_heads", "seq", None), rules, mesh=mesh)
+        v = constrain(v, ("batch", "kv_heads", "seq", None), rules, mesh=mesh)
+    else:
+        # decode attention layout: identical to prefill by default; under
+        # attention-DP the decode_* rules remap batch over (dp, tp) with replicated
+        # kv heads (GSPMD inserts the region-boundary all-to-alls)
+        q = constrain(q, ("decode_batch", "decode_heads", None, None), rules,
+                      mesh=mesh)
+        k = constrain(k, ("decode_batch", "decode_kv_heads", None, None), rules,
+                      mesh=mesh)
+        v = constrain(v, ("decode_batch", "decode_kv_heads", None, None), rules,
+                      mesh=mesh)
     q, k = rope_ops.apply_rotary(q, k, cos, sin)
 
     if paged is not None:
@@ -395,13 +405,20 @@ def _decoder_layer(
             k_att = block_kvcache.read_seq(k_cache, block_table)
             v_att = block_kvcache.read_seq(v_cache, block_table)
     elif positions is None:
-        # prefill: cache write at [0, S), attend over the fresh (unpadded-bucket) k/v
+        # prefill: cache write at [0, S), attend over the fresh (unpadded-bucket) k/v.
+        # The cache keeps its decode layout (≈ the reference's CP-prefill -> DP/TP-
+        # decode KV handover, `kv_cache_manager.py:469-486` — GSPMD reshards at the
+        # write instead of remapping kv-head indices by hand).
         k_cache = kvcache.write_prefill(k_cache, k, batch_start=cache_batch_start)
         v_cache = kvcache.write_prefill(v_cache, v, batch_start=cache_batch_start)
+        k_cache = constrain(k_cache, kvcache.CACHE_LOGICAL[1:], rules, mesh=mesh)
+        v_cache = constrain(v_cache, kvcache.CACHE_LOGICAL[1:], rules, mesh=mesh)
         k_att, v_att = k, v
     else:
         k_cache = kvcache.write_decode(k_cache, k, positions)
         v_cache = kvcache.write_decode(v_cache, v, positions)
+        k_cache = constrain(k_cache, kvcache.CACHE_LOGICAL[1:], rules, mesh=mesh)
+        v_cache = constrain(v_cache, kvcache.CACHE_LOGICAL[1:], rules, mesh=mesh)
         k_att = kvcache.read_bucket(k_cache, decode_bucket)
         v_att = kvcache.read_bucket(v_cache, decode_bucket)
 

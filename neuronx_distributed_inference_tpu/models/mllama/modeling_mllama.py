@@ -516,7 +516,8 @@ class MllamaForConditionalGeneration(TpuModelForCausalLM):
             num_layers=n_self, batch_size=self.tpu_config.max_batch_size,
             num_kv_heads=a.num_kv_heads, max_seq_len=self.tpu_config.seq_len,
             head_dim=a.head_dim, dtype=self.tpu_config.kv_cache_jax_dtype)
-        sharding = named_sharding(self.mesh, kvcache.CACHE_LOGICAL)
+        sharding = named_sharding(self.mesh, kvcache.CACHE_LOGICAL,
+                                  self.sharding_rules)
         cache = {k: jax.device_put(v, sharding)
                  for k, v in kvcache.init_cache(spec).items()}
         b = self.tpu_config.max_batch_size

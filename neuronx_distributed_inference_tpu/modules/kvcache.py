@@ -30,8 +30,9 @@ import jax.numpy as jnp
 
 KVCache = Dict[str, jnp.ndarray]
 
-# logical axes for sharding the stacked cache
-CACHE_LOGICAL = ("layers", "batch", "kv_heads", "kv_seq", None)
+# logical axes for sharding the stacked cache; the decode_* axes resolve to the
+# standard dp/tp layout unless attention-DP remaps them (parallel/sharding.py)
+CACHE_LOGICAL = ("layers", "decode_batch", "decode_kv_heads", "kv_seq", None)
 
 
 @dataclass(frozen=True)

@@ -146,6 +146,9 @@ def native_make_slot_mapping(block_table: np.ndarray, positions: np.ndarray,
                              valid: Optional[np.ndarray] = None) -> np.ndarray:
     """C++ slot-mapping (drop-in for block_kvcache.make_slot_mapping)."""
     lib = load()
+    if lib is None:
+        raise RuntimeError("native engine unavailable (use get_slot_mapping_fn() "
+                           "for transparent fallback)")
     bt = np.ascontiguousarray(block_table, dtype=np.int32)
     pos = np.ascontiguousarray(positions, dtype=np.int32)
     rows, max_blocks = bt.shape

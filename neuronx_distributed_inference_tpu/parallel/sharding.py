@@ -54,6 +54,17 @@ DEFAULT_RULES: Dict[str, MeshAxes] = {
     "kv_seq": None,
     "act_embed": None,
     "layers": None,
+    # decode-attention layout knobs (≈ reference attention data parallelism,
+    # `modules/attention/attention_process_groups.py:125-163` + the DP KV cache
+    # manager): by default identical to the prefill layout; with
+    # attention_dp_enabled the application remaps decode_batch -> (dp, tp) and
+    # decode_heads/decode_kv_heads -> None, so decode attention runs batch-parallel
+    # over ALL chips with replicated (GQA) kv heads — the GSPMD expression of the
+    # reference's TP-group -> DP-groups split, with the all-to-alls at the region
+    # boundaries inserted by the compiler instead of hand-built process groups.
+    "decode_batch": AXIS_DP,
+    "decode_heads": AXIS_TP,
+    "decode_kv_heads": AXIS_TP,
 }
 
 

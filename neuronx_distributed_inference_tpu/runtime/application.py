@@ -106,6 +106,15 @@ class TpuModelForCausalLM:
         self.sharding_rules = dict(DEFAULT_RULES)
         if not self.tpu_config.vocab_parallel:
             self.sharding_rules["vocab"] = None
+        if self.tpu_config.attention_dp_enabled:
+            # decode attention goes batch-parallel over every chip; GQA kv heads
+            # replicate within each batch shard (≈ attention DP + DP KV cache
+            # manager, `data_parallel_kv_cache_manager.py:8-39`)
+            from ..parallel.mesh import AXIS_DP, AXIS_TP
+
+            self.sharding_rules["decode_batch"] = (AXIS_DP, AXIS_TP)
+            self.sharding_rules["decode_heads"] = None
+            self.sharding_rules["decode_kv_heads"] = None
 
         self.params = None
         self.kv_cache = None
@@ -401,7 +410,8 @@ class TpuModelForCausalLM:
 
     def reset_cache(self) -> None:
         spec = self.cache_spec()
-        sharding = named_sharding(self.mesh, kvcache.CACHE_LOGICAL)
+        sharding = named_sharding(self.mesh, kvcache.CACHE_LOGICAL,
+                                  self.sharding_rules)
         self.kv_cache = jax.tree.map(
             lambda x: jax.device_put(x, sharding), kvcache.init_cache(spec))
 

@@ -92,11 +92,9 @@ class ContinuousBatchingRunner:
                 num_layers=app.arch_args.num_layers, num_blocks=cfg.pa_num_blocks,
                 block_size=bs, num_kv_heads=app.arch_args.num_kv_heads,
                 head_dim=app.arch_args.head_dim, dtype=cfg.kv_cache_jax_dtype)
-            from ..native import make_block_allocator
-
             # C++ engine when the toolchain permits (native/engine.cpp); Python
             # fallback keeps identical semantics (tests/test_native_engine.py)
-            self.allocator = make_block_allocator(
+            self.allocator = native_lib.make_block_allocator(
                 cfg.pa_num_blocks, bs, enable_prefix_caching=True)
             sharding = named_sharding(app.mesh, block_kvcache.PAGED_CACHE_LOGICAL,
                                       app.sharding_rules)

@@ -178,7 +178,8 @@ class EagleSpeculativeModel:
         target.reset_cache()
         from ..parallel.sharding import named_sharding
 
-        sharding = named_sharding(target.mesh, kvcache.CACHE_LOGICAL)
+        sharding = named_sharding(target.mesh, kvcache.CACHE_LOGICAL,
+                               target.sharding_rules)
         self.draft_cache = jax.tree.map(
             lambda x: jax.device_put(x, sharding),
             kvcache.init_cache(self._draft_cache_spec()))

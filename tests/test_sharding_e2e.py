@@ -100,3 +100,44 @@ def test_dp2_tp4_mesh_generate(hf_state):
     got = app.generate(input_ids, max_new_tokens=8)
     want = ref.generate(input_ids, max_new_tokens=8)
     np.testing.assert_array_equal(got.tokens, want.tokens)
+
+
+def test_attention_dp_decode_matches_tp(hf_state):
+    """Attention-DP (decode batch sharded over dp x tp, kv heads replicated) must be
+    numerically identical to plain TP — only the layout/collectives change
+    (≈ reference attention DP, `attention_process_groups.py:125-163`)."""
+    assert len(jax.devices()) >= 8
+
+    def make(attention_dp):
+        tpu_cfg = TpuConfig(batch_size=8, seq_len=64, max_context_length=32,
+                            dtype="float32", tp_degree=8,
+                            attention_dp_enabled=attention_dp,
+                            context_encoding_buckets=[32],
+                            token_generation_buckets=[64])
+        config = LlamaInferenceConfig(tpu_cfg,
+                                      load_config=load_pretrained_config(HF_CFG))
+        app = LlamaForCausalLM(None, config)
+        params = app.convert_hf_state_dict(dict(hf_state), app.config)
+        app._put_params(params)
+        return app
+
+    rng = np.random.default_rng(7)
+    input_ids = rng.integers(1, 256, size=(8, 12)).astype(np.int64)
+
+    ref = make(False).generate(input_ids, max_new_tokens=8)
+    app_dp = make(True)
+    out = app_dp.generate(input_ids, max_new_tokens=8)
+    np.testing.assert_array_equal(out.tokens, ref.tokens)
+
+    # the cache really lives batch-sharded over the tp axis (dp=1 normalizes the
+    # ("dp","tp") spec to just "tp"), with kv heads replicated
+    spec = app_dp.kv_cache["k"].sharding.spec
+    assert "tp" in (spec[1] if isinstance(spec[1], tuple) else (spec[1],)), spec
+    # kv-head dim replicated (trailing None entries are trimmed from the spec)
+    assert len(spec) < 3 or spec[2] is None, spec
+
+
+def test_attention_dp_validates_batch():
+    with pytest.raises(ValueError, match="divisible"):
+        TpuConfig(batch_size=6, seq_len=64, tp_degree=4,
+                  attention_dp_enabled=True)
