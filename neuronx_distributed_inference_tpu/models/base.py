@@ -509,7 +509,8 @@ def _run_stack(params: Params, args: ModelArchArgs, h, cos, sin, mask, cache,
         from ..utils.tensor_capture import tap
 
         tap("hidden_stack", ys[2])      # (L, B, S, H) per-layer hidden states
-    return h, {"k": k_new, "v": v_new}
+    # preserve auxiliary cache entries (e.g. M-RoPE rope_delta) alongside k/v
+    return h, {**cache, "k": k_new, "v": v_new}
 
 
 def _embed(params: Params, args: ModelArchArgs, input_ids, mesh, rules):
@@ -548,6 +549,9 @@ def prefill_forward(
     # positions, ≈ reference image-to-text pipelined vision→CTE merge,
     # `models/image_to_text_model_base.py`)
     merge_embeds: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
+    # M-RoPE (qwen-vl): replace the 1D-position cos/sin with externally computed
+    # multimodal rotary tables (B, S, D); masks/cache writes still use position_ids
+    rope_override: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
 ) -> Tuple[jnp.ndarray, kvcache.KVCache]:
     """Context encoding: returns (last-token logits (B, V) fp32, updated cache).
 
@@ -561,8 +565,11 @@ def prefill_forward(
         mm_mask, mm_override = merge_embeds
         h = jnp.where(mm_mask, mm_override.astype(h.dtype), h)
     h = tap("embed", h)
-    cos, sin = rope_ops.compute_cos_sin(params["rope_inv_freq"], position_ids,
-                                        args.rope_attention_scaling)
+    if rope_override is not None:
+        cos, sin = rope_override
+    else:
+        cos, sin = rope_ops.compute_cos_sin(params["rope_inv_freq"], position_ids,
+                                            args.rope_attention_scaling)
     s = input_ids.shape[1]
     mask = (position_ids[:, None, :, None] >= position_ids[:, None, None, :])
     mask = jnp.logical_and(mask, causal_mask(s, s)[None, None])
@@ -638,7 +645,12 @@ def decode_forward(
     else:
         depths, ancestor = tree
         pos_grid = position_ids[:, None] + jnp.asarray(depths, jnp.int32)[None, :]
-    cos, sin = rope_ops.compute_cos_sin(params["rope_inv_freq"], pos_grid,
+    rope_pos = pos_grid
+    if "rope_delta" in cache:
+        # M-RoPE decode: all three position dims advance together past the prompt,
+        # collapsing to 1D rope at (kv position + per-row delta)
+        rope_pos = pos_grid + cache["rope_delta"][:, None]
+    cos, sin = rope_ops.compute_cos_sin(params["rope_inv_freq"], rope_pos,
                                         args.rope_attention_scaling)
     kv_pos = jnp.arange(decode_bucket)[None, None, None, :]
     q_pos = pos_grid[:, None, :, None]

@@ -1,0 +1,4 @@
+from .modeling_qwen2_5_vl import (Qwen2_5_VLForConditionalGeneration,
+                                  Qwen2_5_VLInferenceConfig)
+
+__all__ = ["Qwen2_5_VLForConditionalGeneration", "Qwen2_5_VLInferenceConfig"]

@@ -1,0 +1,445 @@
+"""Qwen2.5-VL family: window-attention ViT + M-RoPE text model.
+
+≈ reference `models/qwen2_vl` / `models/qwen3_vl` (M-RoPE, deepstack vision —
+`models/model_base.py:1235-1247`). Components (match HF Qwen2.5-VL):
+
+- **Vision tower**: patchified Conv3d embedding, per-patch 2D rotary (h/w halves of
+  head_dim/2), blocks with RMS norms + biased qkv and gated-silu MLP; *window
+  attention* on most blocks (tokens reordered into spatial windows, block-diagonal
+  masks) with `fullatt_block_indexes` attending per-image; a spatial-merge MLP head
+  compresses each 2x2 patch group into one LLM token. Window reorder/index math runs
+  host-side (numpy); the jitted encoder consumes precomputed masks + rope tables.
+- **M-RoPE text model**: Qwen2 architecture whose rotary positions are 3D
+  (temporal/height/width sections of the head dim). The prompt's 3D positions come
+  from the HF `get_rope_index` algorithm (ported host-side); prefill passes the
+  resulting multimodal cos/sin via the base model's ``rope_override``; decode
+  collapses to 1D rope at (kv position + per-row delta), carried in the cache as
+  ``rope_delta`` (see models/base.decode_forward).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...modules import gqa
+from ...ops import rope as rope_ops
+from ...ops.norms import rms_norm
+from ...runtime.image_to_text import (ImageToTextInferenceConfig,
+                                      TpuModelForImageToText)
+from ..qwen2.modeling_qwen2 import Qwen2ForCausalLM, Qwen2InferenceConfig
+
+
+# --- host-side geometry (numpy ports of the HF helpers) -------------------------------
+
+
+def vision_rot_pos_emb(grid_thw: np.ndarray, head_dim: int,
+                       spatial_merge_size: int, theta: float = 10000.0) -> np.ndarray:
+    """Per-patch (h, w) rotary table (seq, head_dim//2), patches in merge-group order
+    (HF `rot_pos_emb`)."""
+    dim_quarter = head_dim // 4
+    inv_freq = 1.0 / (theta ** (np.arange(0, dim_quarter * 2, 2, dtype=np.float64)
+                                / (dim_quarter * 2)))
+    out = []
+    m = spatial_merge_size
+    for t, h, w in grid_thw:
+        hpos = np.broadcast_to(np.arange(h)[:, None], (h, w))
+        wpos = np.broadcast_to(np.arange(w)[None, :], (h, w))
+
+        def merge_order(x):
+            return (x.reshape(h // m, m, w // m, m).transpose(0, 2, 1, 3)
+                    .reshape(-1))
+
+        hp, wp = merge_order(hpos), merge_order(wpos)
+        freqs_h = hp[:, None] * inv_freq[None, :]
+        freqs_w = wp[:, None] * inv_freq[None, :]
+        table = np.concatenate([freqs_h, freqs_w], axis=-1)   # (h*w, head_dim//2)
+        out.append(np.tile(table, (int(t), 1)))
+    return np.concatenate(out, axis=0).astype(np.float32)
+
+
+def get_window_index(grid_thw: np.ndarray, window_size: int,
+                     spatial_merge_size: int, patch_size: int
+                     ) -> Tuple[np.ndarray, np.ndarray]:
+    """(window_index (n_merged,), cu_window_seqlens) — HF `get_window_index`."""
+    window_index: List[np.ndarray] = []
+    cu: List[int] = [0]
+    offset = 0
+    m = spatial_merge_size
+    unit = m * m
+    vit_win = window_size // m // patch_size
+    for t, h, w in grid_thw:
+        lh, lw = h // m, w // m
+        index = np.arange(t * lh * lw).reshape(t, lh, lw)
+        pad_h = vit_win - lh % vit_win
+        pad_w = vit_win - lw % vit_win
+        nwh, nww = (lh + pad_h) // vit_win, (lw + pad_w) // vit_win
+        padded = np.pad(index, ((0, 0), (0, pad_h), (0, pad_w)),
+                        constant_values=-100)
+        padded = padded.reshape(t, nwh, vit_win, nww, vit_win)
+        padded = padded.transpose(0, 1, 3, 2, 4).reshape(t, nwh * nww, vit_win,
+                                                         vit_win)
+        seqlens = (padded != -100).sum(axis=(2, 3)).reshape(-1)
+        flat = padded.reshape(-1)
+        keep = flat[flat != -100]
+        window_index.append(keep + offset)
+        cu.extend((np.cumsum(seqlens) * unit + cu[-1]).tolist())
+        offset += int(t * lh * lw)
+    cu_arr = np.array(sorted(set(cu)), dtype=np.int64)
+    return np.concatenate(window_index), cu_arr
+
+
+def segment_mask(cu_seqlens: np.ndarray, seq_len: int) -> np.ndarray:
+    """cu_seqlens boundaries -> (seq, seq) bool mask (attend within one segment)."""
+    seg = np.searchsorted(cu_seqlens[1:], np.arange(seq_len), side="right")
+    return seg[:, None] == seg[None, :]
+
+
+def get_rope_index_images(input_ids: np.ndarray, attention_mask: Optional[np.ndarray],
+                          image_grid_thw: Optional[np.ndarray],
+                          spatial_merge_size: int, image_token_id: int,
+                          vision_start_token_id: int
+                          ) -> Tuple[np.ndarray, np.ndarray]:
+    """3D rope positions + per-row deltas (HF `get_rope_index`, images only).
+
+    Returns (position_ids (3, B, S) int32, deltas (B,) int32) where delta =
+    (max position + 1) - num_real_tokens."""
+    b, s = input_ids.shape
+    positions = np.zeros((3, b, s), dtype=np.int64)
+    deltas = np.zeros((b,), dtype=np.int64)
+    if image_grid_thw is None or (input_ids == image_token_id).sum() == 0:
+        for i in range(b):
+            mask_row = (attention_mask[i].astype(bool) if attention_mask is not None
+                        else np.ones((s,), dtype=bool))
+            idx = np.cumsum(mask_row) - 1
+            positions[:, i] = np.where(mask_row, idx, 1)
+            deltas[i] = 0
+        return positions.astype(np.int32), deltas.astype(np.int32)
+
+    m = spatial_merge_size
+    image_index = 0
+    for i in range(b):
+        row = input_ids[i]
+        mask_row = (attention_mask[i].astype(bool) if attention_mask is not None
+                    else np.ones((s,), dtype=bool))
+        tokens = row[mask_row].tolist()
+        parts: List[np.ndarray] = []
+        st = 0
+        n_images = sum(1 for j in np.where(np.asarray(tokens) ==
+                                           vision_start_token_id)[0]
+                       if j + 1 < len(tokens) and tokens[j + 1] == image_token_id)
+        for _ in range(n_images):
+            ed = tokens.index(image_token_id, st)
+            t, h, w = image_grid_thw[image_index]
+            image_index += 1
+            lh, lw = int(h) // m, int(w) // m
+            text_len = ed - st
+            st_idx = (parts[-1].max() + 1) if parts else 0
+            if text_len:
+                parts.append(np.broadcast_to(
+                    np.arange(text_len) + st_idx, (3, text_len)).copy())
+                st_idx = parts[-1].max() + 1
+            t_idx = np.repeat(np.arange(int(t)), lh * lw)
+            h_idx = np.tile(np.repeat(np.arange(lh), lw), int(t))
+            w_idx = np.tile(np.arange(lw), lh * int(t))
+            parts.append(np.stack([t_idx, h_idx, w_idx]) + st_idx)
+            st = ed + int(t) * lh * lw
+        if st < len(tokens):
+            st_idx = (parts[-1].max() + 1) if parts else 0
+            text_len = len(tokens) - st
+            parts.append(np.broadcast_to(
+                np.arange(text_len) + st_idx, (3, text_len)).copy())
+        pos_row = np.concatenate(parts, axis=1)       # (3, n_real)
+        positions[:, i, mask_row] = pos_row
+        deltas[i] = int(pos_row.max()) + 1 - len(tokens)
+    return positions.astype(np.int32), deltas.astype(np.int32)
+
+
+# --- vision encoder (jitted) ----------------------------------------------------------
+
+
+def vision_encode(vp: Dict[str, Any], patches: jnp.ndarray, cos: jnp.ndarray,
+                  sin: jnp.ndarray, full_mask: jnp.ndarray, win_mask: jnp.ndarray,
+                  *, num_heads: int, is_full: Tuple[bool, ...],
+                  spatial_merge_unit: int, eps: float = 1e-6) -> jnp.ndarray:
+    """(seq, in_dim) window-ordered patches -> (seq // merge_unit, out_hidden).
+
+    cos/sin (seq, head_dim): 2D rotary tables; full/win masks (seq, seq)."""
+    h = patches @ vp["patch_w"]                       # (seq, hidden)
+    seq, hidden = h.shape
+    d = hidden // num_heads
+    is_full_arr = jnp.asarray(is_full)
+
+    def block(hid, xs):
+        lp, full = xs
+        hn = rms_norm(hid, lp["ln1"], eps)
+        qkv = hn @ lp["wqkv"] + lp["bqkv"]            # (seq, 3*hidden)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(seq, num_heads, d)
+        k = k.reshape(seq, num_heads, d)
+        v = v.reshape(seq, num_heads, d)
+        q = (q * cos[:, None, :] + _rotate_half(q) * sin[:, None, :]).astype(q.dtype)
+        k = (k * cos[:, None, :] + _rotate_half(k) * sin[:, None, :]).astype(k.dtype)
+        mask = jnp.where(full, full_mask, win_mask)
+        scores = jnp.einsum("qhd,khd->hqk", q, k) * (d ** -0.5)
+        scores = jnp.where(mask[None], scores.astype(jnp.float32),
+                           jnp.finfo(jnp.float32).min)
+        probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+        attn = jnp.einsum("hqk,khd->qhd", probs, v).reshape(seq, hidden)
+        hid = hid + (attn @ lp["wo"] + lp["bo"])
+        hn = rms_norm(hid, lp["ln2"], eps)
+        gate = jax.nn.silu(hn @ lp["wg"] + lp["bg"])
+        hid = hid + ((gate * (hn @ lp["wu"] + lp["bu"])) @ lp["wd"] + lp["bd"])
+        return hid, None
+
+    h, _ = jax.lax.scan(block, h, (vp["blocks"], is_full_arr))
+
+    # spatial merge head: RMS norm then 2x2-group MLP into the text hidden size
+    h = rms_norm(h, vp["merge_ln"], eps)
+    h = h.reshape(seq // spatial_merge_unit, spatial_merge_unit * hidden)
+    h = jax.nn.gelu(h @ vp["merge_w1"] + vp["merge_b1"], approximate=False)
+    return h @ vp["merge_w2"] + vp["merge_b2"]
+
+
+def _rotate_half(x):
+    half = x.shape[-1] // 2
+    return jnp.concatenate([-x[..., half:], x[..., :half]], axis=-1)
+
+
+# --- config / application -------------------------------------------------------------
+
+
+class Qwen2_5_VLInferenceConfig(ImageToTextInferenceConfig, Qwen2InferenceConfig):
+    REQUIRED_ATTRIBUTES = ("vision_config", "image_token_id")
+
+    def add_derived_config(self) -> None:
+        ImageToTextInferenceConfig.add_derived_config(self)
+        Qwen2InferenceConfig.add_derived_config(self)
+        for attr, default in (("vision_start_token_id", 151652),):
+            if not hasattr(self, attr):
+                setattr(self, attr, default)
+        rs = getattr(self, "rope_scaling", None)
+        sec = (rs or {}).get("mrope_section")
+        if not sec:
+            # fallback must partition head_dim//2 EXACTLY; remainder -> temporal
+            third = (self.head_dim // 2) // 3
+            sec = [self.head_dim // 2 - 2 * third, third, third]
+        if sum(sec) != self.head_dim // 2:
+            raise ValueError(f"mrope_section {sec} must sum to head_dim//2 "
+                             f"({self.head_dim // 2})")
+        self.mrope_section = sec
+
+
+class Qwen2_5_VLForConditionalGeneration(TpuModelForImageToText, Qwen2ForCausalLM):
+    """≈ reference qwen2_vl/qwen3_vl conditional generation."""
+
+    @classmethod
+    def get_config_cls(cls):
+        return Qwen2_5_VLInferenceConfig
+
+    @classmethod
+    def inv_freq_from_config(cls, config) -> np.ndarray:
+        # mrope keeps the base rotary frequencies; sections only select which of the
+        # 3 position streams drives each channel
+        return rope_ops.default_inv_freq(config.head_dim,
+                                         getattr(config, "rope_theta", 1e6))
+
+    @property
+    def image_token_index(self) -> int:
+        return self.config.image_token_id
+
+    @classmethod
+    def convert_hf_state_dict(cls, state_dict, config):
+        # text side lives under model.language_model.* (or language_model.model.* on
+        # disk); remap to the plain qwen2 layout and reuse its converter
+        text_sd = {}
+        for k, v in state_dict.items():
+            if k.startswith("model.language_model."):
+                text_sd["model." + k[len("model.language_model."):]] = v
+            elif k.startswith("language_model.model."):
+                text_sd["model." + k[len("language_model.model."):]] = v
+            elif k == "language_model.lm_head.weight":
+                text_sd["lm_head.weight"] = v
+            elif k.startswith(("model.visual.", "visual.")):
+                continue
+            elif k.startswith("model.") or k == "lm_head.weight":
+                text_sd[k] = v        # on-disk layout keeps the plain qwen2 keys
+        return super().convert_hf_state_dict(text_sd, config)
+
+    @classmethod
+    def convert_hf_vision_state_dict(cls, state_dict, config):
+        vc = config.vision_config
+        hidden = vc["hidden_size"]
+
+        def norm_key(k):
+            if k.startswith("model.visual."):
+                return "visual." + k[len("model.visual."):]
+            return k
+
+        sd = {norm_key(k): v for k, v in state_dict.items()}
+
+        def get(name):
+            if name not in sd:
+                raise KeyError(f"missing weight {name}")
+            return sd[name]
+
+        def linear_t(name):
+            return np.ascontiguousarray(get(name).T)
+
+        blocks = {k: [] for k in ("ln1", "wqkv", "bqkv", "wo", "bo", "ln2",
+                                  "wg", "bg", "wu", "bu", "wd", "bd")}
+        for i in range(vc["depth"]):
+            p = f"visual.blocks.{i}."
+            blocks["ln1"].append(get(p + "norm1.weight"))
+            blocks["wqkv"].append(linear_t(p + "attn.qkv.weight"))
+            blocks["bqkv"].append(get(p + "attn.qkv.bias"))
+            blocks["wo"].append(linear_t(p + "attn.proj.weight"))
+            blocks["bo"].append(get(p + "attn.proj.bias"))
+            blocks["ln2"].append(get(p + "norm2.weight"))
+            blocks["wg"].append(linear_t(p + "mlp.gate_proj.weight"))
+            blocks["bg"].append(get(p + "mlp.gate_proj.bias"))
+            blocks["wu"].append(linear_t(p + "mlp.up_proj.weight"))
+            blocks["bu"].append(get(p + "mlp.up_proj.bias"))
+            blocks["wd"].append(linear_t(p + "mlp.down_proj.weight"))
+            blocks["bd"].append(get(p + "mlp.down_proj.bias"))
+
+        conv = get("visual.patch_embed.proj.weight")   # (hidden, C, tps, p, p)
+        return {
+            "patch_w": np.ascontiguousarray(conv.reshape(hidden, -1).T),
+            "blocks": {k: np.stack(v) for k, v in blocks.items()},
+            "merge_ln": get("visual.merger.ln_q.weight"),
+            "merge_w1": linear_t("visual.merger.mlp.0.weight"),
+            "merge_b1": get("visual.merger.mlp.0.bias"),
+            "merge_w2": linear_t("visual.merger.mlp.2.weight"),
+            "merge_b2": get("visual.merger.mlp.2.bias"),
+        }
+
+    def vision_encode_fn(self):
+        # unused: this family drives its own encoder jit (variable image grids need
+        # host-side reordering); keep the hook satisfied with identity
+        return lambda vp, px: px
+
+    def __init__(self, model_path, config, mesh=None):
+        super().__init__(model_path, config, mesh=mesh)
+        vc = config.vision_config
+        self._vision_geo = {
+            "patch_size": vc["patch_size"],
+            "spatial_merge_size": vc["spatial_merge_size"],
+            "window_size": vc["window_size"],
+            "num_heads": vc["num_heads"],
+            "depth": vc["depth"],
+            "fullatt": tuple(vc["fullatt_block_indexes"]),
+            "head_dim": vc["hidden_size"] // vc["num_heads"],
+        }
+        m = vc["spatial_merge_size"]
+        # single persistent jit: XLA's trace cache keys on input shapes, so each
+        # image geometry compiles once and is reused across requests
+        self._vision_jit = jax.jit(functools.partial(
+            vision_encode, num_heads=vc["num_heads"],
+            is_full=tuple(i in self._vision_geo["fullatt"]
+                          for i in range(vc["depth"])),
+            spatial_merge_unit=m * m))
+
+    # --- vision -----------------------------------------------------------------------
+    def encode_vision(self, pixel_values: np.ndarray,
+                      image_grid_thw: np.ndarray) -> np.ndarray:
+        """(seq, C*tps*p*p) flattened patches + grids -> (n_llm_tokens, H_text)."""
+        g = self._vision_geo
+        grid = np.asarray(image_grid_thw)
+        seq = int(np.prod(grid, axis=1).sum())
+        m = g["spatial_merge_size"]
+        unit = m * m
+        rpe = vision_rot_pos_emb(grid, g["head_dim"], m)
+        window_index, cu_win = get_window_index(grid, g["window_size"], m,
+                                                g["patch_size"])
+        # reorder patches + rope tables into window order (host)
+        order = (window_index[:, None] * unit + np.arange(unit)[None, :]).reshape(-1)
+        px = np.asarray(pixel_values, dtype=np.float32)[order]
+        rpe = rpe[order]
+        emb = np.concatenate([rpe, rpe], axis=-1)
+        cos, sin = np.cos(emb), np.sin(emb)
+        # masks: per-image full attention + per-window attention
+        cu_full = np.concatenate(
+            [[0], np.cumsum(np.prod(grid, axis=1))]).astype(np.int64)
+        full_mask = segment_mask(cu_full, seq)
+        win_mask = segment_mask(cu_win, seq)
+        feats = np.asarray(self._vision_jit(self.vision_params, px, cos, sin,
+                                            full_mask, win_mask))
+        reverse = np.argsort(window_index)
+        return feats[reverse]
+
+    # --- mm prefill with M-RoPE -------------------------------------------------------
+    def _build_mm_prefill(self):
+        args, mesh, rules = self.arch_args, self.mesh, self.sharding_rules
+        odsc = self.sampling_config
+        prefill_core = self.prefill_fn()
+        sections = tuple(self.config.mrope_section)
+        from ...ops import sampling as sampling_ops
+
+        precision = ("highest" if self.tpu_config.dtype == "float32" else "default")
+        # mirror _build_steps' strategy selection exactly (ring excludes flash)
+        use_ring = self._use_ring_attention()
+        use_flash = (not use_ring) and self._use_flash_attention()
+
+        def _prefill_mm(params, input_ids, position_ids, last_token_idx, cache,
+                        sampling_params, key, mm_mask, mm_override, positions3,
+                        adapter_ids=None):
+            with jax.default_matmul_precision(precision):
+                cos, sin = rope_ops.mrope_cos_sin(
+                    params["rope_inv_freq"], positions3, sections,
+                    args.rope_attention_scaling)
+                logits, cache = prefill_core(
+                    params, args, input_ids, position_ids, last_token_idx, cache,
+                    mesh=mesh, rules=rules, adapter_ids=adapter_ids,
+                    use_flash=use_flash, use_ring=use_ring,
+                    merge_embeds=(mm_mask, mm_override),
+                    rope_override=(cos, sin))
+                tokens = sampling_ops.sample(logits, sampling_params, key, odsc)
+            return tokens, logits, cache
+
+        return jax.jit(_prefill_mm, donate_argnums=(4,))
+
+    def reset_cache(self) -> None:
+        super().reset_cache()
+        b = self.tpu_config.max_batch_size
+        self.kv_cache["rope_delta"] = jnp.zeros((b,), dtype=jnp.int32)
+
+    def warmup(self) -> None:
+        # text graphs only: the vision/mm graphs compile per image-grid geometry, so
+        # there is no single shape to pre-compile (first image request per geometry
+        # pays the compile, like the reference's per-bucket lazy compilation)
+        from ...runtime.application import TpuModelForCausalLM
+
+        TpuModelForCausalLM.warmup(self)
+
+    # --- generation -------------------------------------------------------------------
+    def generate(self, input_ids, pixel_values=None, image_grid_thw=None, **kwargs):
+        if pixel_values is None:
+            return Qwen2ForCausalLM.generate(self, input_ids, **kwargs)
+        feats = self.encode_vision(pixel_values, image_grid_thw)
+        mm = {"features": feats, "grid_thw": np.asarray(image_grid_thw)}
+        return Qwen2ForCausalLM.generate(self, input_ids, _mm_embeds=mm, **kwargs)
+
+    def _run_prefill(self, padded, sampling_params, key, adapter_ids, mm=None):
+        if mm is None:
+            return super(TpuModelForImageToText, self)._run_prefill(
+                padded, sampling_params, key, adapter_ids)
+        mask, override = self._scatter_features(padded, mm["features"])
+        ids = np.asarray(padded.input_ids)
+        # 3D rope positions over the padded (compacted) prompt; pad region gets
+        # sequential continuation (unused — masked out by position validity)
+        valid = np.arange(ids.shape[1])[None, :] <= np.asarray(
+            padded.last_token_idx)[:, None]
+        positions3, deltas = get_rope_index_images(
+            ids, valid.astype(np.int64), mm["grid_thw"],
+            self.config.vision_config["spatial_merge_size"],
+            self.image_token_index, self.config.vision_start_token_id)
+        self.kv_cache["rope_delta"] = jnp.asarray(deltas, dtype=jnp.int32)
+        return self._mm_prefill_step(
+            self.params, padded.input_ids, padded.position_ids,
+            padded.last_token_idx, self.kv_cache, sampling_params, key,
+            mask, override, positions3, adapter_ids)

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import math
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -172,3 +173,22 @@ def apply_rotary(q: jnp.ndarray, k: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndar
     q_rot = q32 * cos + rotate_half(q32) * sin
     k_rot = k32 * cos + rotate_half(k32) * sin
     return q_rot.astype(out_dtype), k_rot.astype(out_dtype)
+
+
+def mrope_cos_sin(inv_freq: jnp.ndarray, positions3: jnp.ndarray,
+                  sections, attention_scaling: float = 1.0):
+    """Multimodal (3D) rotary tables (HF `apply_multimodal_rotary_pos_emb`).
+
+    positions3 (3, B, S): temporal/height/width positions per token. ``sections``
+    partitions the head_dim *half*: channel c of the full head dim takes its rotation
+    from position stream i where c falls in the i-th section (pattern repeated for the
+    second half). Text tokens carry equal positions in all three streams, collapsing
+    to standard 1D rope. Returns (cos, sin) of shape (B, S, head_dim)."""
+    freqs = positions3[..., None].astype(jnp.float32) * inv_freq   # (3, B, S, D/2)
+    emb = jnp.concatenate([freqs, freqs], axis=-1)                 # (3, B, S, D)
+    sec_idx = np.concatenate([np.full((s,), i % 3, dtype=np.int32)
+                              for i, s in enumerate(tuple(sections) * 2)])
+    onehot = jax.nn.one_hot(jnp.asarray(sec_idx), 3, dtype=jnp.float32)  # (D, 3)
+    cos = jnp.einsum("sbtd,ds->btd", jnp.cos(emb), onehot)
+    sin = jnp.einsum("sbtd,ds->btd", jnp.sin(emb), onehot)
+    return cos * attention_scaling, sin * attention_scaling

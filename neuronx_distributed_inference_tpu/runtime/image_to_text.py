@@ -189,21 +189,26 @@ class TpuModelForImageToText(TpuModelForCausalLM):
         # row compaction must not misalign features, so only the flat rows travel here
         return super().generate(input_ids, _mm_embeds=flat, **kwargs)
 
+    def _scatter_features(self, padded, flat_feats):
+        """Scatter flattened image features at image-token positions of the PADDED
+        prompt (compaction-safe). Returns (mask (B, S, 1), override (B, S, H))."""
+        ids = np.asarray(padded.input_ids)
+        mask = ids == self.image_token_index
+        n_positions = int(mask.sum())
+        if n_positions != flat_feats.shape[0]:
+            raise ValueError(
+                f"prompt holds {n_positions} image tokens but the vision tower "
+                f"produced {flat_feats.shape[0]} feature rows")
+        override = np.zeros(ids.shape + (flat_feats.shape[-1],), dtype=np.float32)
+        override[mask] = flat_feats
+        return mask[..., None], override
+
     # hook used by TpuModelForCausalLM.generate to run the mm prefill graph
     def _run_prefill(self, padded, sampling_params, key, adapter_ids, mm=None):
         if mm is None:
             return super()._run_prefill(padded, sampling_params, key, adapter_ids)
-        flat_feats = mm                                        # (n_rows, H)
-        ids = np.asarray(padded.input_ids)
-        mask = ids == self.image_token_index                   # padded positions
-        n_positions = int(mask.sum())
-        if n_positions != flat_feats.shape[0]:
-            raise ValueError(
-                f"prompt holds {n_positions} image tokens but images produced "
-                f"{flat_feats.shape[0]} feature rows")
-        override = np.zeros(ids.shape + (flat_feats.shape[-1],), dtype=np.float32)
-        override[mask] = flat_feats
+        mask, override = self._scatter_features(padded, mm)
         return self._mm_prefill_step(
             self.params, padded.input_ids, padded.position_ids,
             padded.last_token_idx, self.kv_cache, sampling_params, key,
-            mask[..., None], override, adapter_ids)
+            mask, override, adapter_ids)
