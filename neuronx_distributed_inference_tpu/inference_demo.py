@@ -49,6 +49,15 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--cp-degree", type=int, default=1)
     g.add_argument("--ep-degree", type=int, default=1)
 
+    g = p.add_argument_group("parallelism (advanced)")
+    g.add_argument("--sequence-parallel", action="store_true",
+                   help="shard prefill activations along seq (sp)")
+    g.add_argument("--attention-dp", action="store_true",
+                   help="decode attention batch-parallel over dp x tp "
+                        "(replicated GQA kv heads)")
+    g.add_argument("--no-vocab-parallel", dest="vocab_parallel",
+                   action="store_false", default=True)
+
     g = p.add_argument_group("execution")
     g.add_argument("--dtype", default="bfloat16",
                    choices=["bfloat16", "float16", "float32"])
@@ -57,8 +66,32 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--context-encoding-buckets", type=int, nargs="*", default=None)
     g.add_argument("--token-generation-buckets", type=int, nargs="*", default=None)
     g.add_argument("--decode-chunk-size", type=int, default=32)
+    g.add_argument("--async-mode", action="store_true",
+                   help="pipeline decode-chunk dispatch ahead of the host sync")
+    g.add_argument("--attention-kernel", dest="attention_kernel", default=None,
+                   action="store_true",
+                   help="force the Pallas flash prefill kernel on")
     g.add_argument("--cpu", action="store_true",
                    help="force the CPU backend (debug / no-accelerator runs)")
+    g.add_argument("--compilation-cache-dir", default=None,
+                   help="persistent XLA compile cache (utils/runtime_env.py)")
+
+    g = p.add_argument_group("serving features")
+    g.add_argument("--continuous-batching", action="store_true")
+    g.add_argument("--paged-attention", action="store_true")
+    g.add_argument("--pa-num-blocks", type=int, default=0)
+    g.add_argument("--pa-block-size", type=int, default=128)
+    g.add_argument("--quantize-weights", choices=["int8", "float8_e4m3"],
+                   default=None, help="weight-only quantization dtype")
+    g.add_argument("--kv-cache-dtype", default=None,
+                   help="fp8 KV cache dtype (e.g. float8_e4m3)")
+    g.add_argument("--lora-ckpt", action="append", default=None, metavar="NAME=DIR",
+                   help="repeatable; PEFT adapter dirs for multi-LoRA serving")
+    g.add_argument("--max-loras", type=int, default=1)
+    g.add_argument("--max-lora-rank", type=int, default=16)
+    g.add_argument("--speculation-length", type=int, default=0)
+    g.add_argument("--draft-model-path", default=None,
+                   help="draft checkpoint for speculative decoding")
 
     g = p.add_argument_group("sampling")
     g.add_argument("--do-sample", action="store_true")
@@ -86,6 +119,27 @@ def create_tpu_config(args: argparse.Namespace) -> TpuConfig:
     sampling = OnDeviceSamplingConfig(
         do_sample=args.do_sample, top_k=args.top_k, top_p=args.top_p,
         temperature=args.temperature, global_topk=args.global_topk)
+    from .config import (LoraServingConfig, QuantizationConfig, SpeculationConfig)
+
+    quant = None
+    if args.quantize_weights or args.kv_cache_dtype:
+        quant = QuantizationConfig(
+            quantize_weights=bool(args.quantize_weights),
+            weight_dtype=args.quantize_weights or "int8",
+            kv_cache_dtype=args.kv_cache_dtype)
+    lora = None
+    if args.lora_ckpt:
+        for spec in args.lora_ckpt:
+            if "=" not in spec:
+                raise SystemExit(f"--lora-ckpt expects NAME=DIR, got {spec!r}")
+        paths = dict(spec.split("=", 1) for spec in args.lora_ckpt)
+        lora = LoraServingConfig(max_loras=max(args.max_loras, len(paths)),
+                                 max_lora_rank=args.max_lora_rank,
+                                 lora_ckpt_paths=paths)
+    spec_cfg = None
+    if args.speculation_length:
+        spec_cfg = SpeculationConfig(speculation_length=args.speculation_length,
+                                     draft_model_path=args.draft_model_path)
     return TpuConfig(
         batch_size=args.batch_size,
         seq_len=args.seq_len,
@@ -95,11 +149,23 @@ def create_tpu_config(args: argparse.Namespace) -> TpuConfig:
         dp_degree=args.dp_degree,
         cp_degree=args.cp_degree,
         ep_degree=args.ep_degree,
+        sequence_parallel_enabled=args.sequence_parallel,
+        attention_dp_enabled=args.attention_dp,
+        vocab_parallel=args.vocab_parallel,
         dtype=args.dtype,
         enable_bucketing=args.enable_bucketing,
         context_encoding_buckets=args.context_encoding_buckets,
         token_generation_buckets=args.token_generation_buckets,
         decode_chunk_size=args.decode_chunk_size,
+        async_mode=args.async_mode,
+        attention_kernel_enabled=args.attention_kernel,
+        is_continuous_batching=args.continuous_batching,
+        paged_attention_enabled=args.paged_attention,
+        pa_num_blocks=args.pa_num_blocks,
+        pa_block_size=args.pa_block_size,
+        quantization_config=quant,
+        lora_serving_config=lora,
+        speculation_config=spec_cfg,
         on_device_sampling_config=sampling,
     )
 
@@ -109,6 +175,11 @@ def run_inference(args: argparse.Namespace) -> int:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    if args.compilation_cache_dir:
+        from .utils.runtime_env import set_runtime_env
+
+        set_runtime_env(args.seq_len,
+                        compilation_cache_dir=args.compilation_cache_dir)
 
     model_type = args.model_type
     if model_type is None:
@@ -130,7 +201,33 @@ def run_inference(args: argparse.Namespace) -> int:
         if rc != 0:
             return rc
 
-    if args.prompt:
+    if args.speculation_length:
+        if not args.draft_model_path:
+            raise SystemExit("--speculation-length requires --draft-model-path")
+        from .runtime.speculation import FusedSpeculativeModel
+
+        logger.info("loading draft model from %s", args.draft_model_path)
+        with open(f"{args.draft_model_path}/config.json") as f:
+            draft_type = json.load(f).get("model_type", "llama")
+        draft_cls = get_model_cls(draft_type)
+        draft_cfg = create_tpu_config(args)
+        draft_cfg.speculation_config = None
+        draft = draft_cls.from_pretrained(args.draft_model_path, draft_cfg)
+        spec_model = FusedSpeculativeModel(app, draft,
+                                           args.speculation_length,
+                                           greedy=not args.do_sample)
+        input_ids, attention_mask = _encode_prompts(args, tokenizer,
+                                                    app.arch_args.vocab_size)
+        out = spec_model.generate(input_ids, attention_mask=attention_mask,
+                                  max_new_tokens=args.max_new_tokens,
+                                  seed=args.seed)
+        if tokenizer is not None:
+            for row in out.tokens:
+                print(tokenizer.decode([t for t in row if t >= 0]))
+        else:
+            print("speculative tokens:")
+            print(out.tokens)
+    elif args.prompt:
         _run_generation(args, app, tokenizer)
 
     if args.benchmark:

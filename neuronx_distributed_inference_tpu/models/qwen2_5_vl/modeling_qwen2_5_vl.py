@@ -362,9 +362,10 @@ class Qwen2_5_VLForConditionalGeneration(TpuModelForImageToText, Qwen2ForCausalL
         rpe = rpe[order]
         emb = np.concatenate([rpe, rpe], axis=-1)
         cos, sin = np.cos(emb), np.sin(emb)
-        # masks: per-image full attention + per-window attention
-        cu_full = np.concatenate(
-            [[0], np.cumsum(np.prod(grid, axis=1))]).astype(np.int64)
+        # masks: "full" blocks attend per FRAME (HF repeat_interleave(h*w, t)),
+        # window blocks per spatial window
+        frame_lens = np.repeat(grid[:, 1] * grid[:, 2], grid[:, 0])
+        cu_full = np.concatenate([[0], np.cumsum(frame_lens)]).astype(np.int64)
         full_mask = segment_mask(cu_full, seq)
         win_mask = segment_mask(cu_win, seq)
         feats = np.asarray(self._vision_jit(self.vision_params, px, cos, sin,
@@ -380,10 +381,7 @@ class Qwen2_5_VLForConditionalGeneration(TpuModelForImageToText, Qwen2ForCausalL
         sections = tuple(self.config.mrope_section)
         from ...ops import sampling as sampling_ops
 
-        precision = ("highest" if self.tpu_config.dtype == "float32" else "default")
-        # mirror _build_steps' strategy selection exactly (ring excludes flash)
-        use_ring = self._use_ring_attention()
-        use_flash = (not use_ring) and self._use_flash_attention()
+        precision, use_ring, use_flash = self._mm_strategy()
 
         def _prefill_mm(params, input_ids, position_ids, last_token_idx, cache,
                         sampling_params, key, mm_mask, mm_override, positions3,

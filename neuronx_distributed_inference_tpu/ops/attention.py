@@ -52,26 +52,41 @@ def attend(
     logits_soft_cap: Optional[float] = None,
     sinks: Optional[jnp.ndarray] = None,  # (n_q,) learned attention sinks (gpt-oss style)
 ) -> jnp.ndarray:
-    """Masked GQA attention, softmax in fp32. Returns (B, n_q, S_q, D) in q.dtype."""
-    n_q, n_kv = q.shape[1], k.shape[1]
+    """Masked GQA attention, softmax in fp32. Returns (B, n_q, S_q, D) in q.dtype.
+
+    Grouped-query form: q is reshaped to (B, n_kv, rep, S_q, D) and contracted against
+    the UNEXPANDED k/v — a `repeat_kv` materialization would stream rep x the KV bytes
+    through HBM every decode step (the decode hot path is KV-bandwidth-bound, which is
+    why the reference hand-fuses its TKG kernels, `attention_base.py:1679-1994`).
+    """
+    b, n_q, s_q, d = q.shape
+    n_kv = k.shape[1]
     if n_q % n_kv != 0:
         raise ValueError(f"n_q {n_q} not divisible by n_kv {n_kv}")
-    k = repeat_kv(k, n_q // n_kv)
-    v = repeat_kv(v, n_q // n_kv)
+    rep = n_q // n_kv
     if scale is None:
-        scale = q.shape[-1] ** -0.5
+        scale = d ** -0.5
 
-    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+    qg = q.reshape(b, n_kv, rep, s_q, d)
+    scores = jnp.einsum("bkrqd,bktd->bkrqt", qg, k,
                         preferred_element_type=jnp.float32) * scale
     if logits_soft_cap is not None:
         scores = logits_soft_cap * jnp.tanh(scores / logits_soft_cap)
     if mask is not None:
-        scores = jnp.where(mask, scores, NEG_INF)
+        # masks arrive (B, heads|1, S_q, S_kv); lift to the grouped layout
+        if mask.ndim == 4 and mask.shape[1] == 1:
+            gmask = mask[:, :, None]
+        elif mask.ndim == 4:
+            gmask = mask.reshape(b, n_kv, rep, *mask.shape[2:])
+        else:
+            gmask = mask
+        scores = jnp.where(gmask, scores, NEG_INF)
 
     if sinks is not None:
         # learned sink logit per head participates in the softmax denominator only
-        sink = jnp.broadcast_to(sinks.astype(jnp.float32)[None, :, None, None],
-                                scores.shape[:3] + (1,))
+        sink = jnp.broadcast_to(
+            sinks.astype(jnp.float32).reshape(n_kv, rep)[None, :, :, None, None],
+            scores.shape[:4] + (1,))
         scores = jnp.concatenate([scores, sink], axis=-1)
         probs = jnp.exp(scores - jnp.max(scores, axis=-1, keepdims=True))
         probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
@@ -80,5 +95,5 @@ def attend(
         probs = jnp.exp(scores - jnp.max(scores, axis=-1, keepdims=True))
         probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
 
-    out = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(q.dtype), v)
-    return out
+    out = jnp.einsum("bkrqt,bktd->bkrqd", probs.astype(q.dtype), v)
+    return out.reshape(b, n_q, s_q, d)

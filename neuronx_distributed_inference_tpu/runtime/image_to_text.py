@@ -108,6 +108,14 @@ class TpuModelForImageToText(TpuModelForCausalLM):
         self.vision_params = jax.tree.map(_put, host)
 
     # --- multimodal prefill graph -----------------------------------------------------
+    def _mm_strategy(self):
+        """(matmul precision, use_ring, use_flash) — mirrors _build_steps exactly so
+        multimodal prefill graphs never diverge from the serving strategy."""
+        precision = ("highest" if self.tpu_config.dtype == "float32" else "default")
+        use_ring = self._use_ring_attention()
+        use_flash = (not use_ring) and self._use_flash_attention()
+        return precision, use_ring, use_flash
+
     def _build_mm_prefill(self):
         args = self.arch_args
         mesh = self.mesh
@@ -116,10 +124,7 @@ class TpuModelForImageToText(TpuModelForCausalLM):
         prefill_core = self.prefill_fn()
         from ..ops import sampling as sampling_ops
 
-        precision = ("highest" if self.tpu_config.dtype == "float32" else "default")
-        # mirror _build_steps' strategy selection exactly (ring excludes flash)
-        use_ring = self._use_ring_attention()
-        use_flash = (not use_ring) and self._use_flash_attention()
+        precision, use_ring, use_flash = self._mm_strategy()
 
         def _prefill_mm(params, input_ids, position_ids, last_token_idx, cache,
                         sampling_params, key, mm_mask, mm_override, adapter_ids=None):
