@@ -1,0 +1,9 @@
+from .flux import (FluxArchArgs, FluxPipeline, convert_flux_state_dict,
+                   flux_forward, init_flux_params, scheduler_sigmas)
+from .text_encoders import (clip_text_encode, convert_clip_state_dict,
+                            convert_t5_state_dict, t5_encode)
+
+__all__ = ["FluxArchArgs", "FluxPipeline", "convert_flux_state_dict",
+           "flux_forward", "init_flux_params",
+           "scheduler_sigmas", "t5_encode", "clip_text_encode",
+           "convert_t5_state_dict", "convert_clip_state_dict"]

@@ -1,0 +1,175 @@
+"""Text encoders for the diffusion pipeline: T5 encoder + CLIP text model.
+
+≈ reference `models/diffusers/flux/` t5 (903 LoC) and clip (601 LoC) ports. Functional
+JAX implementations parity-tested against the transformers CPU models
+(tests/test_diffusion.py); both are pure encoders (single forward, no KV cache), so
+they compile to one jitted call each.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops.norms import layer_norm, rms_norm
+
+Params = Dict[str, Any]
+
+
+# --- T5 encoder -----------------------------------------------------------------------
+
+
+def t5_relative_buckets(q_len: int, k_len: int, num_buckets: int = 32,
+                        max_distance: int = 128) -> np.ndarray:
+    """Bidirectional relative-position bucket ids (HF `_relative_position_bucket`)."""
+    ctx = np.arange(q_len)[:, None]
+    mem = np.arange(k_len)[None, :]
+    rel = mem - ctx
+    nb = num_buckets // 2
+    out = (rel > 0).astype(np.int64) * nb
+    rel = np.abs(rel)
+    max_exact = nb // 2
+    is_small = rel < max_exact
+    large = max_exact + (np.log(np.maximum(rel, 1) / max_exact)
+                         / np.log(max_distance / max_exact)
+                         * (nb - max_exact)).astype(np.int64)
+    large = np.minimum(large, nb - 1)
+    return out + np.where(is_small, rel, large)
+
+
+def t5_encode(params: Params, input_ids: jnp.ndarray, attention_mask: jnp.ndarray,
+              *, num_heads: int, num_buckets: int = 32, max_distance: int = 128,
+              eps: float = 1e-6) -> jnp.ndarray:
+    """(B, S) ids -> (B, S, H) encoder states (HF T5EncoderModel)."""
+    b, s = input_ids.shape
+    h = jnp.take(params["embed"], input_ids, axis=0)
+    buckets = t5_relative_buckets(s, s, num_buckets, max_distance)
+    # (S, S) buckets -> (heads, S, S) learned bias, shared across layers
+    bias = jnp.take(params["rel_bias"], jnp.asarray(buckets), axis=0)  # (S, S, heads)
+    bias = bias.transpose(2, 0, 1)[None]                               # (1, h, S, S)
+    neg = jnp.finfo(jnp.float32).min
+    bias = bias + jnp.where(attention_mask[:, None, None, :] > 0, 0.0, neg)
+
+    def block(hid, lp):
+        hn = rms_norm(hid, lp["ln1"], eps)
+        q = (hn @ lp["wq"]).reshape(b, s, num_heads, -1).transpose(0, 2, 1, 3)
+        k = (hn @ lp["wk"]).reshape(b, s, num_heads, -1).transpose(0, 2, 1, 3)
+        v = (hn @ lp["wv"]).reshape(b, s, num_heads, -1).transpose(0, 2, 1, 3)
+        # T5 uses NO 1/sqrt(d) scaling (folded into init)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) + bias
+        probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+        attn = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+        attn = attn.transpose(0, 2, 1, 3).reshape(b, s, -1)
+        hid = hid + attn @ lp["wo"]
+        hn = rms_norm(hid, lp["ln2"], eps)
+        gelu = jax.nn.gelu(hn @ lp["wi0"], approximate=True)
+        hid = hid + (gelu * (hn @ lp["wi1"])) @ lp["wo2"]
+        return hid, None
+
+    h, _ = jax.lax.scan(block, h, params["layers"])
+    return rms_norm(h, params["final_ln"], eps)
+
+
+def convert_t5_state_dict(sd, num_layers: int) -> Params:
+    def linear_t(name):
+        return np.ascontiguousarray(sd[name].T)
+
+    layers = {k: [] for k in ("ln1", "wq", "wk", "wv", "wo", "ln2",
+                              "wi0", "wi1", "wo2")}
+    for i in range(num_layers):
+        p = f"encoder.block.{i}."
+        layers["ln1"].append(sd[p + "layer.0.layer_norm.weight"])
+        layers["wq"].append(linear_t(p + "layer.0.SelfAttention.q.weight"))
+        layers["wk"].append(linear_t(p + "layer.0.SelfAttention.k.weight"))
+        layers["wv"].append(linear_t(p + "layer.0.SelfAttention.v.weight"))
+        layers["wo"].append(linear_t(p + "layer.0.SelfAttention.o.weight"))
+        layers["ln2"].append(sd[p + "layer.1.layer_norm.weight"])
+        layers["wi0"].append(linear_t(p + "layer.1.DenseReluDense.wi_0.weight"))
+        layers["wi1"].append(linear_t(p + "layer.1.DenseReluDense.wi_1.weight"))
+        layers["wo2"].append(linear_t(p + "layer.1.DenseReluDense.wo.weight"))
+    return {
+        "embed": sd["shared.weight"],
+        "rel_bias": sd["encoder.block.0.layer.0.SelfAttention."
+                       "relative_attention_bias.weight"],   # (buckets, heads)
+        "layers": {k: np.stack(v) for k, v in layers.items()},
+        "final_ln": sd["encoder.final_layer_norm.weight"],
+    }
+
+
+# --- CLIP text model ------------------------------------------------------------------
+
+
+def clip_text_encode(params: Params, input_ids: jnp.ndarray, *, num_heads: int,
+                     eos_token_id: int, eps: float = 1e-5,
+                     act: str = "quick_gelu"):
+    """(B, S) -> (last_hidden (B, S, H), pooled (B, H)) (HF CLIPTextModel).
+
+    Pooled output = final-LN hidden at each row's eos token (argmax-of-eos like HF)."""
+    b, s = input_ids.shape
+    h = jnp.take(params["embed"], input_ids, axis=0)
+    h = h + params["pos_embed"][:s]
+    causal = np.triu(np.full((s, s), np.finfo(np.float32).min), k=1)
+    causal = jnp.asarray(causal)[None, None]
+    act_fn = (lambda x: x * jax.nn.sigmoid(1.702 * x)) if act == "quick_gelu" \
+        else functools.partial(jax.nn.gelu, approximate=False)
+
+    def block(hid, lp):
+        hn = layer_norm(hid, lp["ln1_w"], lp["ln1_b"], eps=eps)
+        q = (hn @ lp["wq"] + lp["bq"]).reshape(b, s, num_heads, -1).transpose(0, 2, 1, 3)
+        k = (hn @ lp["wk"] + lp["bk"]).reshape(b, s, num_heads, -1).transpose(0, 2, 1, 3)
+        v = (hn @ lp["wv"] + lp["bv"]).reshape(b, s, num_heads, -1).transpose(0, 2, 1, 3)
+        d = q.shape[-1]
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32)
+        scores = scores * (d ** -0.5) + causal
+        probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+        attn = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+        attn = attn.transpose(0, 2, 1, 3).reshape(b, s, -1)
+        hid = hid + (attn @ lp["wo"] + lp["bo"])
+        hn = layer_norm(hid, lp["ln2_w"], lp["ln2_b"], eps=eps)
+        hid = hid + (act_fn(hn @ lp["fc1"] + lp["b1"]) @ lp["fc2"] + lp["b2"])
+        return hid, None
+
+    h, _ = jax.lax.scan(block, h, params["layers"])
+    h = layer_norm(h, params["final_w"], params["final_b"], eps=eps)
+    if eos_token_id == 2:
+        # HF keeps the pre-#24773 legacy behavior for configs with eos_token_id == 2
+        # (OpenAI CLIP): pooled position = argmax of the RAW token ids
+        eos_pos = jnp.argmax(input_ids, axis=-1)
+    else:
+        eos_pos = jnp.argmax((input_ids == eos_token_id).astype(jnp.int32), axis=-1)
+    pooled = jnp.take_along_axis(h, eos_pos[:, None, None], axis=1)[:, 0]
+    return h, pooled
+
+
+def convert_clip_state_dict(sd, num_layers: int) -> Params:
+    def linear_t(name):
+        return np.ascontiguousarray(sd[name].T)
+
+    pre = "text_model."
+    layers = {k: [] for k in ("ln1_w", "ln1_b", "wq", "bq", "wk", "bk", "wv", "bv",
+                              "wo", "bo", "ln2_w", "ln2_b", "fc1", "b1", "fc2", "b2")}
+    for i in range(num_layers):
+        p = f"{pre}encoder.layers.{i}."
+        layers["ln1_w"].append(sd[p + "layer_norm1.weight"])
+        layers["ln1_b"].append(sd[p + "layer_norm1.bias"])
+        for t, name in (("q", "q_proj"), ("k", "k_proj"), ("v", "v_proj"),
+                        ("o", "out_proj")):
+            layers[f"w{t}"].append(linear_t(p + f"self_attn.{name}.weight"))
+            layers[f"b{t}"].append(sd[p + f"self_attn.{name}.bias"])
+        layers["ln2_w"].append(sd[p + "layer_norm2.weight"])
+        layers["ln2_b"].append(sd[p + "layer_norm2.bias"])
+        layers["fc1"].append(linear_t(p + "mlp.fc1.weight"))
+        layers["b1"].append(sd[p + "mlp.fc1.bias"])
+        layers["fc2"].append(linear_t(p + "mlp.fc2.weight"))
+        layers["b2"].append(sd[p + "mlp.fc2.bias"])
+    return {
+        "embed": sd[pre + "embeddings.token_embedding.weight"],
+        "pos_embed": sd[pre + "embeddings.position_embedding.weight"],
+        "layers": {k: np.stack(v) for k, v in layers.items()},
+        "final_w": sd[pre + "final_layer_norm.weight"],
+        "final_b": sd[pre + "final_layer_norm.bias"],
+    }

@@ -203,12 +203,16 @@ class TpuModelForCausalLM:
             return tokens, logits, cache
 
         def _decode(params, tokens0, position_ids, cache, sampling_params, key,
-                    decode_bucket, num_steps, with_logits, adapter_ids=None):
+                    decode_bucket, num_steps, with_logits, adapter_ids=None,
+                    greedy=False):
             """Generate ``num_steps`` tokens in ONE device call via lax.scan.
 
             Host-driven per-token loops pay a host<->device round trip per token; the
             scan keeps the whole decode chunk on device (the TPU-native analog of the
             reference's async double-buffered decode, `modules/async_execution.py`).
+            ``greedy`` (static) skips the dynamic sampling window entirely — the host
+            sets it when every request is argmax, saving the per-step 128k-vocab
+            top-k (~10%% of decode time at 1B scale).
             """
             keys = jax.random.split(key, num_steps)
 
@@ -219,7 +223,11 @@ class TpuModelForCausalLM:
                                                 decode_bucket, mesh=mesh, rules=rules,
                                                 adapter_ids=adapter_ids)
                     last = logits[:, -1, :]
-                    nxt = sampling_ops.sample(last, sampling_params, step_key, odsc)
+                    if greedy:
+                        nxt = sampling_ops.greedy(last)
+                    else:
+                        nxt = sampling_ops.sample(last, sampling_params, step_key,
+                                                  odsc)
                 out = (nxt, last) if with_logits else (nxt, ())
                 return (nxt, pos + 1, cache), out
 
@@ -231,7 +239,7 @@ class TpuModelForCausalLM:
         self._prefill_step = jax.jit(_prefill, donate_argnums=(4,))
         self._decode_step = jax.jit(
             _decode, donate_argnums=(3,),
-            static_argnames=("decode_bucket", "num_steps", "with_logits"))
+            static_argnames=("decode_bucket", "num_steps", "with_logits", "greedy"))
 
     def _use_ring_attention(self) -> bool:
         """Context-parallel (ring attention) prefill when the mesh has a cp axis.
@@ -435,14 +443,23 @@ class TpuModelForCausalLM:
                 self.params, ids, pos, last, self.kv_cache, sp, key, warm_adapters)
             tokens.block_until_ready()
         chunk = max(1, self.tpu_config.decode_chunk_size)
+        # only the reachable decode specializations: do_sample configs never take the
+        # static-greedy graph; pure-greedy non-dynamic configs never take the dynamic
+        if self.sampling_config.do_sample:
+            variants = (False,)
+        elif not self.sampling_config.dynamic:
+            variants = (True,)
+        else:
+            variants = (True, False)
         for bucket in self.tkg_buckets:
-            tok0 = jnp.zeros((b,), dtype=jnp.int32)
-            pos = np.zeros((b,), dtype=np.int32)
-            tokens, _, self.kv_cache = self._decode_step(
-                self.params, tok0, pos, self.kv_cache, sp, key,
-                decode_bucket=bucket, num_steps=min(chunk, bucket), with_logits=False,
-                adapter_ids=warm_adapters)
-            tokens.block_until_ready()
+            for greedy in variants:
+                tok0 = jnp.zeros((b,), dtype=jnp.int32)
+                pos = np.zeros((b,), dtype=np.int32)
+                tokens, _, self.kv_cache = self._decode_step(
+                    self.params, tok0, pos, self.kv_cache, sp, key,
+                    decode_bucket=bucket, num_steps=min(chunk, bucket),
+                    with_logits=False, adapter_ids=warm_adapters, greedy=greedy)
+                tokens.block_until_ready()
         self.reset_cache()
         logger.info("warmup complete: %d CTE + %d TKG buckets",
                     len(self.cte_buckets), len(self.tkg_buckets))
@@ -541,6 +558,11 @@ class TpuModelForCausalLM:
             sampling_params = np.concatenate([sampling_params, pad], axis=0)
         key = jax.random.PRNGKey(seed if not self.sampling_config.deterministic
                                  else self.sampling_config.seed)
+        # host-side greedy detection: all rows argmax -> compile the decode chunk
+        # without the dynamic sampling window (exact same tokens, less work)
+        sp_arr = np.asarray(sampling_params)
+        greedy_only = (not self.sampling_config.do_sample
+                       and bool((sp_arr[:, 0] == 1).all()))
 
         padded = model_wrapper.pad_prefill_inputs(
             input_ids, attention_mask, self.cte_buckets, pad_token_id=pad_token_id,
@@ -621,7 +643,7 @@ class TpuModelForCausalLM:
             toks_dev, logits_chunk, self.kv_cache = self._decode_step(
                 self.params, last_tok, positions, self.kv_cache, sampling_params, sub,
                 decode_bucket=bucket, num_steps=steps, with_logits=return_logits,
-                adapter_ids=adapter_ids)
+                adapter_ids=adapter_ids, greedy=greedy_only)
             last_tok = toks_dev[:, -1]             # device-resident; no sync needed
             n_done += steps
             if async_mode:
