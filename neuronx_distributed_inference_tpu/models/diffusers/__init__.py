@@ -7,3 +7,9 @@ __all__ = ["FluxArchArgs", "FluxPipeline", "convert_flux_state_dict",
            "flux_forward", "init_flux_params",
            "scheduler_sigmas", "t5_encode", "clip_text_encode",
            "convert_t5_state_dict", "convert_clip_state_dict"]
+
+from .vae import (VaeDecoderArgs, convert_vae_decoder_state_dict,
+                  init_vae_decoder_params, vae_decode)
+
+__all__ += ["VaeDecoderArgs", "vae_decode", "convert_vae_decoder_state_dict",
+            "init_vae_decoder_params"]

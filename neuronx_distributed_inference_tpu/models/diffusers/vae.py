@@ -1,0 +1,174 @@
+"""VAE decoder (diffusers AutoencoderKL decoder, as used by Flux).
+
+≈ reference `models/diffusers/flux/` vae (216 LoC). Decode-only: latents -> RGB.
+Structure: conv_in -> mid (resnet, spatial attention, resnet) -> up blocks (resnets +
+nearest-neighbor upsample convs) -> GroupNorm/silu/conv_out. Weight conversion targets
+the diffusers naming (`convert_vae_decoder_state_dict`)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Params = Dict[str, Any]
+
+
+@dataclass(frozen=True)
+class VaeDecoderArgs:
+    latent_channels: int = 16
+    base_channels: int = 128
+    channel_mults: Tuple[int, ...] = (1, 2, 4, 4)   # up blocks run reversed
+    layers_per_block: int = 3                        # decoder resnets per up block
+    out_channels: int = 3
+    norm_groups: int = 32
+    scaling_factor: float = 0.3611
+    shift_factor: float = 0.1159
+
+
+def _group_norm(x: jnp.ndarray, w, b, groups: int, eps: float = 1e-6):
+    """x (B, C, H, W) channelwise GroupNorm (computed f32, cast back to x.dtype)."""
+    in_dtype = x.dtype
+    bsz, c, h, wd = x.shape
+    xg = x.reshape(bsz, groups, c // groups, h, wd).astype(jnp.float32)
+    mean = xg.mean(axis=(2, 3, 4), keepdims=True)
+    var = xg.var(axis=(2, 3, 4), keepdims=True)
+    xg = (xg - mean) * jax.lax.rsqrt(var + eps)
+    y = xg.reshape(bsz, c, h, wd)
+    return (y * w[None, :, None, None] + b[None, :, None, None]).astype(in_dtype)
+
+
+def _conv(x: jnp.ndarray, w, b, stride: int = 1, padding: int = 1):
+    dn = ("NCHW", "OIHW", "NCHW")
+    y = jax.lax.conv_general_dilated(
+        x, w, (stride, stride), [(padding, padding)] * 2, dimension_numbers=dn)
+    return y + b[None, :, None, None]
+
+
+def _resnet(p: Params, prefix: str, x, groups: int):
+    h = _group_norm(x, p[prefix + "n1_w"], p[prefix + "n1_b"], groups)
+    h = _conv(jax.nn.silu(h), p[prefix + "c1_w"], p[prefix + "c1_b"])
+    h = _group_norm(h, p[prefix + "n2_w"], p[prefix + "n2_b"], groups)
+    h = _conv(jax.nn.silu(h), p[prefix + "c2_w"], p[prefix + "c2_b"])
+    if prefix + "sc_w" in p:
+        x = _conv(x, p[prefix + "sc_w"], p[prefix + "sc_b"], padding=0)
+    return x + h
+
+
+def _attn(p: Params, x, groups: int):
+    bsz, c, hh, ww = x.shape
+    h = _group_norm(x, p["attn_n_w"], p["attn_n_b"], groups)
+    flat = h.reshape(bsz, c, hh * ww).transpose(0, 2, 1)    # (B, HW, C)
+    q = flat @ p["attn_q_w"] + p["attn_q_b"]
+    k = flat @ p["attn_k_w"] + p["attn_k_b"]
+    v = flat @ p["attn_v_w"] + p["attn_v_b"]
+    scores = (q @ k.transpose(0, 2, 1)).astype(jnp.float32) * (c ** -0.5)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = (probs @ v) @ p["attn_o_w"] + p["attn_o_b"]
+    return x + out.transpose(0, 2, 1).reshape(bsz, c, hh, ww)
+
+
+def vae_decode(params: Params, latents: jnp.ndarray, args: VaeDecoderArgs
+               ) -> jnp.ndarray:
+    """(B, latent_channels, h, w) -> (B, 3, h*8, w*8) in [-1, 1]."""
+    g = args.norm_groups
+    z = latents / args.scaling_factor + args.shift_factor
+    x = _conv(z, params["conv_in_w"], params["conv_in_b"])
+    x = _resnet(params, "mid_r1_", x, g)
+    x = _attn(params, x, g)
+    x = _resnet(params, "mid_r2_", x, g)
+    n_up = len(args.channel_mults)
+    for u in range(n_up):
+        for r in range(args.layers_per_block):
+            x = _resnet(params, f"up{u}_r{r}_", x, g)
+        if u < n_up - 1:
+            b, c, hh, ww = x.shape
+            x = jax.image.resize(x, (b, c, hh * 2, ww * 2), method="nearest")
+            x = _conv(x, params[f"up{u}_up_w"], params[f"up{u}_up_b"])
+    x = _group_norm(x, params["out_n_w"], params["out_n_b"], g)
+    return _conv(jax.nn.silu(x), params["conv_out_w"], params["conv_out_b"])
+
+
+def convert_vae_decoder_state_dict(sd, args: VaeDecoderArgs) -> Params:
+    """diffusers AutoencoderKL ``decoder.*`` keys -> flat param dict."""
+    out: Params = {}
+
+    def put(dst, src):
+        out[dst + "_w"] = np.asarray(sd[f"decoder.{src}.weight"])
+        out[dst + "_b"] = np.asarray(sd[f"decoder.{src}.bias"])
+
+    def resnet(dst, src):
+        put(dst + "n1", src + ".norm1")
+        put(dst + "c1", src + ".conv1")
+        put(dst + "n2", src + ".norm2")
+        put(dst + "c2", src + ".conv2")
+        if f"decoder.{src}.conv_shortcut.weight" in sd:
+            put(dst + "sc", src + ".conv_shortcut")
+
+    put("conv_in", "conv_in")
+    resnet("mid_r1_", "mid_block.resnets.0")
+    resnet("mid_r2_", "mid_block.resnets.1")
+    out["attn_n_w"] = np.asarray(sd["decoder.mid_block.attentions.0.group_norm.weight"])
+    out["attn_n_b"] = np.asarray(sd["decoder.mid_block.attentions.0.group_norm.bias"])
+    for ours, theirs in (("q", "to_q"), ("k", "to_k"), ("v", "to_v"),
+                         ("o", "to_out.0")):
+        w = np.asarray(sd[f"decoder.mid_block.attentions.0.{theirs}.weight"])
+        out[f"attn_{ours}_w"] = np.ascontiguousarray(w.reshape(w.shape[0], -1).T)
+        out[f"attn_{ours}_b"] = np.asarray(
+            sd[f"decoder.mid_block.attentions.0.{theirs}.bias"])
+    for u in range(len(args.channel_mults)):
+        for r in range(args.layers_per_block):
+            resnet(f"up{u}_r{r}_", f"up_blocks.{u}.resnets.{r}")
+        if f"decoder.up_blocks.{u}.upsamplers.0.conv.weight" in sd:
+            put(f"up{u}_up", f"up_blocks.{u}.upsamplers.0.conv")
+    put("out_n", "conv_norm_out")
+    put("conv_out", "conv_out")
+    return out
+
+
+def init_vae_decoder_params(args: VaeDecoderArgs, key, dtype=np.float32) -> Params:
+    """Random decoder params in the converted layout (tests)."""
+    dtype = np.dtype(jnp.dtype(dtype).name) if hasattr(jnp, "dtype") else dtype
+    rng = np.random.default_rng(int(jax.random.randint(key, (), 0, 2 ** 31 - 1)))
+    mults = list(reversed(args.channel_mults))
+    top = args.base_channels * mults[0]
+    p: Params = {}
+
+    def conv(name, cin, cout, k=3):
+        p[name + "_w"] = (rng.standard_normal((cout, cin, k, k)) * 0.02
+                          ).astype(np.float32)
+        p[name + "_b"] = np.zeros((cout,), np.float32)
+
+    def norm(name, c):
+        p[name + "_w"] = np.ones((c,), np.float32)
+        p[name + "_b"] = np.zeros((c,), np.float32)
+
+    def resnet(prefix, cin, cout):
+        norm(prefix + "n1", cin)
+        conv(prefix + "c1", cin, cout)
+        norm(prefix + "n2", cout)
+        conv(prefix + "c2", cout, cout)
+        if cin != cout:
+            conv(prefix + "sc", cin, cout, k=1)
+
+    conv("conv_in", args.latent_channels, top)
+    resnet("mid_r1_", top, top)
+    resnet("mid_r2_", top, top)
+    norm("attn_n", top)
+    for n in ("q", "k", "v", "o"):
+        p[f"attn_{n}_w"] = (rng.standard_normal((top, top)) * 0.02).astype(np.float32)
+        p[f"attn_{n}_b"] = np.zeros((top,), np.float32)
+    cin = top
+    for u, m in enumerate(mults):
+        cout = args.base_channels * m
+        for r in range(args.layers_per_block):
+            resnet(f"up{u}_r{r}_", cin if r == 0 else cout, cout)
+        cin = cout
+        if u < len(mults) - 1:
+            conv(f"up{u}_up", cout, cout)
+    norm("out_n", cin)
+    conv("conv_out", cin, args.out_channels)
+    return {k: np.asarray(v).astype(dtype) for k, v in p.items()}

@@ -93,14 +93,20 @@ def qeinsum(spec: str, x: jnp.ndarray, w) -> jnp.ndarray:
 
 def quantize_params(params: Dict[str, Any], weight_dtype: str = "int8",
                     names: Sequence[str] = DEFAULT_QUANTIZED_PARAMS) -> Dict[str, Any]:
-    """Convert the named weights of a model param tree (top level + ``layers``)."""
+    """Convert the named weights of a model param tree (top level + ``layers``).
+
+    Leaves that are ALREADY in the quantized {"q","s"} layout pass through untouched,
+    so pre-quantized (or partially pre-quantized) checkpoints load correctly."""
+    def conv(w):
+        return w if is_quantized(w) else quantize_tensor(w, weight_dtype)
+
     out = dict(params)
     if "lm_head" in out and "lm_head" in names:
-        out["lm_head"] = quantize_tensor(out["lm_head"], weight_dtype)
+        out["lm_head"] = conv(out["lm_head"])
     layers = dict(out["layers"])
     for name in names:
         if name in layers:
-            layers[name] = quantize_tensor(layers[name], weight_dtype)
+            layers[name] = conv(layers[name])
     out["layers"] = layers
     return out
 

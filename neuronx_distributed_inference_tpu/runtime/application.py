@@ -348,6 +348,11 @@ class TpuModelForCausalLM:
         """Random weights at the configured shapes (tests / synthetic benchmarks)."""
         self._put_params(self.init_random_params(jax.random.PRNGKey(seed)))
 
+    def load_host_params(self, host_params) -> None:
+        """Install an already-converted host param pytree (public hook for synthetic
+        benchmarks and externally pre-quantized checkpoints)."""
+        self._put_params(host_params)
+
     def set_lora_adapters(self, adapter_state_dicts, alphas=None) -> None:
         """Install PEFT adapter checkpoints into the resident multi-LoRA slots
         (adapter i -> slot i+1; slot 0 stays the zero adapter). ``alphas[i]`` is the
@@ -385,6 +390,7 @@ class TpuModelForCausalLM:
         if qcfg is not None:
             from ..ops.quantization import quantize_params
 
+            # per-leaf: already-quantized leaves pass through (pre-quantized ckpts)
             host_params = quantize_params(host_params, qcfg.weight_dtype)
         shardings = self._param_shardings()
         dtype = self.tpu_config.jax_dtype

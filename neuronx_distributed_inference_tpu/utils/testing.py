@@ -1,0 +1,78 @@
+"""Public module/kernel test harness.
+
+≈ reference `utils/testing.py` (`build_module`/`build_function` :123-267 compile any
+nn.Module/fn at arbitrary tp_degree; `validate_accuracy` :67-120 compares against a
+CPU callable) — the standard pattern for kernel-vs-native parity tests. TPU version:
+
+- ``build_function(fn, tp_degree=...)`` jits ``fn`` over a fresh dp/cp/tp/ep mesh and
+  (optionally) shards its inputs by logical axes — one call replaces the reference's
+  ModelBuilder trace + NEFF load.
+- ``validate_accuracy(device_fn, golden_fn, args)`` runs both and asserts closeness
+  with per-dtype default tolerances (≈ the reference's tol maps).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..parallel import mesh as mesh_lib
+from ..parallel.sharding import named_sharding
+
+# default absolute tolerances per compute dtype (≈ reference per-dtype tol maps,
+# `test_llama3_1_8b_4layer_dtype.py:31-54`)
+DEFAULT_ATOL = {"float32": 2e-5, "bfloat16": 2e-2, "float16": 2e-3}
+
+
+def build_mesh(tp_degree: int = 1, dp_degree: int = 1, cp_degree: int = 1,
+               ep_degree: int = 1):
+    return mesh_lib.build_mesh(tp_degree=tp_degree, dp_degree=dp_degree,
+                               cp_degree=cp_degree, ep_degree=ep_degree)
+
+
+def build_function(fn: Callable, tp_degree: int = 1, dp_degree: int = 1,
+                   ep_degree: int = 1,
+                   in_logical: Optional[Sequence] = None,
+                   static_argnames: Sequence[str] = ()) -> Callable:
+    """Jit ``fn`` for execution over a (tp, dp, ep) mesh.
+
+    ``in_logical``: optional per-positional-argument logical-axis tuples (None =
+    replicated); inputs are device_put with the derived shardings before the call, so
+    GSPMD partitions the function the way serving would.
+    """
+    mesh = build_mesh(tp_degree=tp_degree, dp_degree=dp_degree, ep_degree=ep_degree)
+    jitted = jax.jit(fn, static_argnames=tuple(static_argnames))
+
+    def run(*args, **kwargs):
+        placed = []
+        for i, a in enumerate(args):
+            logical = in_logical[i] if in_logical and i < len(in_logical) else None
+            if logical is not None:
+                a = jax.device_put(a, named_sharding(mesh, logical))
+            placed.append(a)
+        with mesh:
+            return jitted(*placed, **kwargs)
+
+    run.mesh = mesh
+    return run
+
+
+def validate_accuracy(device_fn: Callable, golden_fn: Callable, args: Sequence[Any],
+                      kwargs: Optional[Dict[str, Any]] = None,
+                      atol: Optional[float] = None, rtol: float = 1e-3,
+                      dtype: str = "float32") -> None:
+    """Run ``device_fn`` and ``golden_fn`` on the same inputs and assert the outputs
+    match leaf-by-leaf (≈ reference `validate_accuracy`, `utils/testing.py:67-120`)."""
+    kwargs = kwargs or {}
+    got = jax.tree.leaves(device_fn(*args, **kwargs))
+    want = jax.tree.leaves(golden_fn(*args, **kwargs))
+    if len(got) != len(want):
+        raise AssertionError(f"output arity mismatch: {len(got)} vs {len(want)}")
+    tol = atol if atol is not None else DEFAULT_ATOL.get(dtype, 2e-5)
+    for i, (g, w) in enumerate(zip(got, want)):
+        np.testing.assert_allclose(np.asarray(g, dtype=np.float32),
+                                   np.asarray(w, dtype=np.float32),
+                                   atol=tol, rtol=rtol,
+                                   err_msg=f"output leaf {i} diverged")

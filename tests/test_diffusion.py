@@ -125,3 +125,17 @@ def test_flux_pipeline_end_to_end():
     lat = pipe(txt, pooled, height=8, width=8, num_steps=2)
     assert np.asarray(lat).shape == (1, 4, 8, 8)
     assert np.isfinite(np.asarray(lat)).all()
+
+
+def test_vae_decoder_shapes():
+    from neuronx_distributed_inference_tpu.models.diffusers import (
+        VaeDecoderArgs, init_vae_decoder_params, vae_decode)
+
+    args = VaeDecoderArgs(latent_channels=4, base_channels=16,
+                          channel_mults=(1, 2), layers_per_block=2, norm_groups=4)
+    params = init_vae_decoder_params(args, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    lat = rng.normal(size=(1, 4, 8, 8)).astype(np.float32)
+    img = np.asarray(vae_decode(params, lat, args))
+    assert img.shape == (1, 3, 16, 16)       # one upsample between 2 blocks
+    assert np.isfinite(img).all()

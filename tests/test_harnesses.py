@@ -145,3 +145,30 @@ def test_inference_demo_cli(tiny_ckpt, capsys):
     assert rc == 0, out
     assert "logit matching: passed=True" in out
     assert "decode_tokens_per_second" in out
+
+
+def test_build_function_and_validate_accuracy():
+    """Public module harness (≈ reference utils/testing build_module/validate_accuracy):
+    a sharded matmul over a tp mesh must match the plain numpy golden."""
+    import jax.numpy as jnp
+
+    from neuronx_distributed_inference_tpu.utils.testing import (
+        build_function, validate_accuracy)
+
+    def layer(x, w):
+        return jnp.maximum(x @ w, 0.0)
+
+    run = build_function(layer, tp_degree=8,
+                         in_logical=[("batch", None), (None, "heads")])
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((4, 32)).astype(np.float32)
+    w = rng.standard_normal((32, 64)).astype(np.float32)
+    validate_accuracy(run, lambda x, w: np.maximum(x @ w, 0.0), (x, w))
+
+
+def test_validate_accuracy_raises_on_divergence():
+    from neuronx_distributed_inference_tpu.utils.testing import validate_accuracy
+
+    with np.testing.assert_raises(AssertionError):
+        validate_accuracy(lambda x: x + 1.0, lambda x: x,
+                          (np.ones((2, 2), np.float32),))
