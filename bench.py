@@ -93,7 +93,7 @@ def main() -> None:
                              "original_max_position_embeddings": 8192},
             "tie_word_embeddings": False,
         }
-        batch = 32
+        batch = 64
         quant = QuantizationConfig(quantize_weights=True, weight_dtype="int8",
                                    kv_cache_dtype="float8_e4m3")
         name = ("llama3.1-8b-arch decode tokens/sec/chip "

@@ -79,6 +79,35 @@ class HuggingFaceGenerationAdapter:
     def __call__(self, *args, **kwargs):
         return self.generate(*args, **kwargs)
 
+    def generate_assisted(self, input_ids, assistant_model,
+                          speculation_length: int = 5, attention_mask=None,
+                          max_new_tokens: int = 32, eos_token_id=None,
+                          pad_token_id: Optional[int] = None, seed: int = 0,
+                          **ignored):
+        """HF assisted-decoding analog (≈ reference `_assisted_decoding` routing,
+        `utils/hf_adapter.py:494-933`): draft with ``assistant_model`` (a
+        TpuModelForCausalLM) through the fused speculative engine, verify with the
+        wrapped target. Greedy; returns full sequences like `generate`."""
+        from ..runtime.speculation import FusedSpeculativeModel
+
+        key = (id(assistant_model), speculation_length)
+        if getattr(self, "_spec_cache_key", None) != key:
+            self._spec_model = FusedSpeculativeModel(
+                self.app, assistant_model, speculation_length, greedy=True)
+            self._spec_cache_key = key
+        is_torch = _is_torch(input_ids)
+        ids = _to_numpy(input_ids)
+        mask = _to_numpy(attention_mask) if attention_mask is not None else None
+        out = self._spec_model.generate(
+            ids, attention_mask=mask, max_new_tokens=max_new_tokens,
+            eos_token_id=eos_token_id, pad_token_id=pad_token_id or 0, seed=seed)
+        sequences = out.sequences
+        if is_torch:
+            import torch
+
+            sequences = torch.tensor(sequences, dtype=torch.long)
+        return sequences
+
     def generate_text(self, prompts, max_new_tokens: int = 64, **kwargs):
         """Tokenizer-in, strings-out convenience."""
         if self.tokenizer is None:

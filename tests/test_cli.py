@@ -1,0 +1,42 @@
+"""CLI flag -> config mapping (≈ reference `create_neuron_config` coverage)."""
+
+from neuronx_distributed_inference_tpu.inference_demo import (build_parser,
+                                                              create_tpu_config)
+
+
+def test_flags_map_to_config():
+    args = build_parser().parse_args([
+        "--model-path", "/tmp/x", "--batch-size", "8", "--seq-len", "256",
+        "--tp-degree", "8", "--attention-dp", "--async-mode",
+        "--continuous-batching", "--paged-attention", "--pa-num-blocks", "64",
+        "--pa-block-size", "16", "--quantize-weights", "int8",
+        "--kv-cache-dtype", "float8_e4m3", "--lora-ckpt", "a=/tmp/a",
+        "--max-loras", "2", "--do-sample", "--top-k", "50", "--top-p", "0.9",
+    ])
+    cfg = create_tpu_config(args)
+    assert cfg.tp_degree == 8 and cfg.attention_dp_enabled and cfg.async_mode
+    assert cfg.is_continuous_batching and cfg.paged_attention_enabled
+    assert cfg.pa_num_blocks == 64 and cfg.pa_block_size == 16
+    assert cfg.quantization_config.weight_dtype == "int8"
+    assert cfg.quantization_config.kv_cache_dtype == "float8_e4m3"
+    assert cfg.lora_serving_config.lora_ckpt_paths == {"a": "/tmp/a"}
+    assert cfg.on_device_sampling_config.do_sample
+    assert cfg.on_device_sampling_config.top_k == 50
+
+
+def test_lora_flag_requires_name_eq_dir():
+    import pytest
+
+    args = build_parser().parse_args(
+        ["--model-path", "/tmp/x", "--lora-ckpt", "/tmp/no_name"])
+    with pytest.raises(SystemExit):
+        create_tpu_config(args)
+
+
+def test_speculation_config_mapping():
+    args = build_parser().parse_args([
+        "--model-path", "/tmp/x", "--speculation-length", "4",
+        "--draft-model-path", "/tmp/d"])
+    cfg = create_tpu_config(args)
+    assert cfg.speculation_config.speculation_length == 4
+    assert cfg.speculation_config.draft_model_path == "/tmp/d"

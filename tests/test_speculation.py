@@ -96,3 +96,19 @@ def test_eos_stops_row(target_draft):
     hits = np.nonzero(row == eos)[0]
     if hits.size:  # stop must be at the row's end when EOS fires
         assert hits[0] == out.num_generated[0] - 1
+
+
+def test_hf_adapter_generate_assisted(target_draft):
+    """Adapter assisted-decoding routes through the fused speculative engine and must
+    match plain greedy generation exactly (speculation is lossless under greedy)."""
+    target, draft = target_draft
+    from neuronx_distributed_inference_tpu.utils.hf_adapter import (
+        HuggingFaceGenerationAdapter)
+
+    adapter = HuggingFaceGenerationAdapter(target)
+    rng = np.random.default_rng(11)
+    ids = rng.integers(1, 256, size=(2, 9)).astype(np.int64)
+    ref = target.generate(ids, max_new_tokens=10)
+    seqs = adapter.generate_assisted(ids, draft, speculation_length=3,
+                                     max_new_tokens=10)
+    np.testing.assert_array_equal(np.asarray(seqs)[:, 9:9 + 10], ref.tokens)
