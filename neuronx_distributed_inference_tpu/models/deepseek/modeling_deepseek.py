@@ -120,9 +120,11 @@ def _mla_attention(lp: Params, args: DeepseekArchArgs, hn: jnp.ndarray,
 
     scale = (args.attention_scale if args.attention_scale is not None
              else args.qk_head_dim ** -0.5)
-    scores = (jnp.einsum("bhsr,btr->bhst", q_pe, k_pe_att)
-              + jnp.einsum("bhsc,btc->bhst", q_c, c_att)) * scale
-    scores = jnp.where(mask, scores.astype(jnp.float32), jnp.finfo(jnp.float32).min)
+    scores = (jnp.einsum("bhsr,btr->bhst", q_pe, k_pe_att,
+                         preferred_element_type=jnp.float32)
+              + jnp.einsum("bhsc,btc->bhst", q_c, c_att,
+                           preferred_element_type=jnp.float32)) * scale
+    scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
     probs = jax.nn.softmax(scores, axis=-1).astype(q_pe.dtype)
 
     x = jnp.einsum("bhst,btc->bhsc", probs, c_att)          # (B, h, S, C)

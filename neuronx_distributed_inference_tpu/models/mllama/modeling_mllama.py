@@ -276,8 +276,9 @@ def vision_encode(vp: Dict[str, Any], pixel_values, aspect_ratio_ids,
         q = (hn @ lp["wq"]).reshape(b * m, seq, num_heads, d).transpose(0, 2, 1, 3)
         k = (hn @ lp["wk"]).reshape(b * m, seq, num_heads, d).transpose(0, 2, 1, 3)
         v = (hn @ lp["wv"]).reshape(b * m, seq, num_heads, d).transpose(0, 2, 1, 3)
-        scores = jnp.einsum("nhqd,nhkd->nhqk", q, k) * (d ** -0.5) + additive
-        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+        scores = jnp.einsum("nhqd,nhkd->nhqk", q, k,
+                            preferred_element_type=jnp.float32) * (d ** -0.5) + additive
+        probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
         attn = jnp.einsum("nhqk,nhkd->nhqd", probs, v)
         attn = attn.transpose(0, 2, 1, 3).reshape(b * m, seq, hidden)
         attn = attn @ lp["wo"]

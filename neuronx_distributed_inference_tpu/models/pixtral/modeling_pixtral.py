@@ -88,8 +88,9 @@ def vision_encode(vp: Dict[str, Any], pixel_values: jnp.ndarray,
         v = (hn @ lp["wv"]).reshape(n, -1, num_heads, d).transpose(0, 2, 1, 3)
         q = q * cos + _rotate_half(q) * sin
         k = k * cos + _rotate_half(k) * sin
-        scores = jnp.einsum("nhqd,nhkd->nhqk", q, k) * (d ** -0.5)
-        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+        scores = jnp.einsum("nhqd,nhkd->nhqk", q, k,
+                            preferred_element_type=jnp.float32) * (d ** -0.5)
+        probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
         attn = jnp.einsum("nhqk,nhkd->nhqd", probs, v)
         attn = attn.transpose(0, 2, 1, 3).reshape(n, -1, num_heads * d)
         hid = hid + attn @ lp["wo"]

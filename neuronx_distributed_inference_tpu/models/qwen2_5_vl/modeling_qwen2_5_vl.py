@@ -108,6 +108,12 @@ def get_rope_index_images(input_ids: np.ndarray, attention_mask: Optional[np.nda
 
     Returns (position_ids (3, B, S) int32, deltas (B,) int32) where delta =
     (max position + 1) - num_real_tokens."""
+    if image_grid_thw is not None and (np.asarray(image_grid_thw)[:, 0] > 1).any():
+        # video grids (t > 1) need Qwen2.5-VL's second_per_grid_ts * tokens_per_second
+        # temporal scaling; plain arange positions would be silently wrong M-RoPE
+        raise NotImplementedError(
+            "video inputs (grid t > 1) are not supported: temporal M-RoPE scaling "
+            "(second_per_grid_ts * tokens_per_second) is not implemented")
     b, s = input_ids.shape
     positions = np.zeros((3, b, s), dtype=np.int64)
     deltas = np.zeros((b,), dtype=np.int64)
@@ -185,9 +191,9 @@ def vision_encode(vp: Dict[str, Any], patches: jnp.ndarray, cos: jnp.ndarray,
         q = (q * cos[:, None, :] + _rotate_half(q) * sin[:, None, :]).astype(q.dtype)
         k = (k * cos[:, None, :] + _rotate_half(k) * sin[:, None, :]).astype(k.dtype)
         mask = jnp.where(full, full_mask, win_mask)
-        scores = jnp.einsum("qhd,khd->hqk", q, k) * (d ** -0.5)
-        scores = jnp.where(mask[None], scores.astype(jnp.float32),
-                           jnp.finfo(jnp.float32).min)
+        scores = jnp.einsum("qhd,khd->hqk", q, k,
+                            preferred_element_type=jnp.float32) * (d ** -0.5)
+        scores = jnp.where(mask[None], scores, jnp.finfo(jnp.float32).min)
         probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
         attn = jnp.einsum("hqk,khd->qhd", probs, v).reshape(seq, hidden)
         hid = hid + (attn @ lp["wo"] + lp["bo"])

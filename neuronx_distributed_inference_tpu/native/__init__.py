@@ -132,6 +132,8 @@ class NativeBlockAllocator:
         buf[: len(blocks)] = blocks
         n = self._lib.engine_extend(self._h, _as_i32p(buf), len(blocks),
                                     seq_len, cap)
+        if n == -2:
+            raise RuntimeError("output buffer capacity exhausted")
         if n < 0:
             raise RuntimeError("out of KV blocks")
         blocks[:] = buf[:n].tolist()

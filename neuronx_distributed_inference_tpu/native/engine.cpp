@@ -204,14 +204,18 @@ int engine_allocate_for_prompt(void* h, const int32_t* tokens, int n,
 
 // Ensure blocks cover [0, seq_len); appends into out_blocks (capacity max_out).
 // Returns the new count or -1 on exhaustion (appended blocks rolled back).
+// Returns the new block count, -1 when the pool is out of free blocks, or -2 when
+// the caller's `blocks` buffer capacity (max_out) is exhausted before seq_len is
+// covered. Either failure rolls back blocks allocated by this call.
 int engine_extend(void* h, int32_t* blocks, int n_in, int seq_len, int max_out) {
   auto* e = static_cast<Engine*>(h);
   int count = n_in;
   while (count * e->block_size < seq_len) {
+    int rc = (count < max_out) ? -1 : -2;
     int blk = (count < max_out) ? e->alloc_one() : -1;
     if (blk < 0) {
       for (int j = n_in; j < count; ++j) e->release_one(blocks[j]);
-      return -1;
+      return rc;
     }
     blocks[count++] = blk;
   }

@@ -625,11 +625,22 @@ class TpuModelForCausalLM:
         pending = None                   # (toks_dev, logits_dev, steps, t_dispatch)
         gen_limit = max_new_tokens       # shrunk to the EOS-stop width on early break
 
+        last_sync_t = time.perf_counter()
+
         def _sync_chunk(p):
+            nonlocal last_sync_t
             toks_dev_p, logits_p, steps_p, t0_p = p
             toks = np.asarray(toks_dev_p)          # (B, steps); blocks
             if collect_latency:
-                decode_lat.append((time.perf_counter() - t0_p, steps_p))
+                # async_mode: this chunk was dispatched while the PREVIOUS chunk was
+                # still in flight, so wall time since its dispatch t0 overlaps the
+                # prior chunk's — summing those would double-count. Time since the
+                # previous sync instead: syncs are serialized, so sync-to-sync deltas
+                # partition wall time exactly.
+                now = time.perf_counter()
+                start = max(t0_p, last_sync_t) if async_mode else t0_p
+                decode_lat.append((now - start, steps_p))
+                last_sync_t = now
             chunks.append(toks)
             if return_logits:
                 lc = np.asarray(logits_p)          # (steps, B, V)

@@ -14,8 +14,9 @@ LONG_CONTEXT_THRESHOLD = 32 * 1024
 
 def _append_xla_flags(flags: str) -> None:
     cur = os.environ.get("XLA_FLAGS", "")
+    present = {f.split("=")[0] for f in cur.split()}
     for f in flags.split():
-        if f.split("=")[0] not in cur:
+        if f.split("=")[0] not in present:
             cur = f"{cur} {f}".strip()
     os.environ["XLA_FLAGS"] = cur
 

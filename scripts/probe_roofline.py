@@ -1,0 +1,116 @@
+"""Probe the chip: HBM roofline + int8-matmul efficiency.
+
+Timing protocol for the axon tunnel: chain N dependent calls, then fetch one
+element of the final result to host — the fetch cannot complete until every
+chained execution has, so (wall / N) is a true per-call time.
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+dev = jax.devices()[0]
+print("device:", dev)
+
+
+@jax.jit
+def _probe(x):
+    return jax.lax.slice(x.ravel(), (0,), (1,))
+
+
+def timeit_chain(f, state, n=20):
+    state = f(state)            # warmup/compile
+    _ = np.asarray(_probe(jax.tree.leaves(state)[0]))
+    t0 = time.perf_counter()
+    for _i in range(n):
+        state = f(state)
+    _ = np.asarray(_probe(jax.tree.leaves(state)[0]))
+    return (time.perf_counter() - t0) / n
+
+
+key = jax.random.PRNGKey(0)
+
+# --- 1. HBM copy roofline (read+write), chained x -> x+1 ---------------------
+for gib in (1, 4):
+    x = jax.random.bits(key, (gib * (1 << 30),), dtype=jnp.uint8)
+    f = jax.jit(lambda x: x + 1, donate_argnums=0)
+    t = timeit_chain(f, x)
+    print(f"copy {gib} GiB (chained): {t*1e3:.2f} ms -> {2*gib/t:.0f} GiB/s (rd+wr)")
+    del x, f
+
+# --- 2. int8 matmul pair, chained activation ---------------------------------
+H, I = 4096, 14336
+for dt, name in ((jnp.int8, "int8"), (jnp.bfloat16, "bf16")):
+    w = jax.random.bits(key, (H, I), dtype=jnp.uint8).view(jnp.int8).astype(dt)
+    w2 = jax.random.bits(key, (I, H), dtype=jnp.uint8).view(jnp.int8).astype(dt)
+    a = jax.random.normal(key, (64, H), dtype=jnp.bfloat16)
+
+    def mm(a, w, w2):
+        y = jax.nn.silu(a @ w.astype(jnp.bfloat16)) * 1e-4
+        return (y @ w2.astype(jnp.bfloat16)) * 1e-4
+
+    f = jax.jit(mm, donate_argnums=0)
+    t = timeit_chain(lambda a: f(a, w, w2), a, n=50)
+    bytes_w = (w.size + w2.size) * w.dtype.itemsize
+    print(f"matmul pair {name} ({bytes_w/2**20:.0f} MiB weights): {t*1e6:.0f} us -> "
+          f"{bytes_w/t/2**30:.0f} GiB/s weight-stream")
+    del w, w2, a, f
+
+# --- 3. scan over L layers of int8 matmul pairs (decode MLP structure) -------
+L = 32
+wg = jax.random.bits(key, (L, H, I), dtype=jnp.uint8).view(jnp.int8)
+wd = jax.random.bits(key, (L, I, H), dtype=jnp.uint8).view(jnp.int8)
+sg = jnp.full((L, I), 1e-4, dtype=jnp.float32)
+sd = jnp.full((L, H), 1e-4, dtype=jnp.float32)
+a = jax.random.normal(key, (64, H), dtype=jnp.bfloat16)
+
+
+def stack(a, wg, wd, sg, sd):
+    def body(h, xs):
+        g, d, s1, s2 = xs
+        t = jax.nn.silu((h @ g.astype(jnp.bfloat16)) * s1.astype(jnp.bfloat16))
+        h = (t @ d.astype(jnp.bfloat16)) * s2.astype(jnp.bfloat16)
+        return h, ()
+
+    h, _ = jax.lax.scan(body, a, (wg, wd, sg, sd))
+    return h
+
+
+f = jax.jit(stack, donate_argnums=0)
+t = timeit_chain(lambda a: f(a, wg, wd, sg, sd), a, n=10)
+total = wg.size + wd.size
+print(f"scan {L}x int8 MLP pair ({total/2**30:.1f} GiB): {t*1e3:.2f} ms -> "
+      f"{total/t/2**30:.0f} GiB/s")
+del wg, wd, a, f
+
+# --- 4. decode attention over fp8 cache (bs=64 bucket=256) -------------------
+B, Hkv, S, D, rep = 64, 8, 256, 128, 4
+kc = (jax.random.bits(key, (32, B, Hkv, S, D), dtype=jnp.uint8)
+      .view(jnp.float8_e4m3fn))
+vc = (jax.random.bits(key, (32, B, Hkv, S, D), dtype=jnp.uint8)
+      .view(jnp.float8_e4m3fn))
+q = jax.random.normal(key, (B, Hkv * rep, 1, D), dtype=jnp.bfloat16)
+
+
+def attn_scan(q, kc, vc):
+    def body(h, xs):
+        k, v = xs
+        k = k.astype(jnp.bfloat16)
+        v = v.astype(jnp.bfloat16)
+        qg = h.reshape(B, Hkv, rep, 1, D)
+        s = jnp.einsum("bkrqd,bktd->bkrqt", qg, k,
+                       preferred_element_type=jnp.float32)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bkrqt,bktd->bkrqd", p.astype(jnp.bfloat16), v)
+        return o.reshape(B, Hkv * rep, 1, D), ()
+
+    h, _ = jax.lax.scan(body, q, (kc, vc))
+    return h
+
+
+f = jax.jit(attn_scan, donate_argnums=0)
+t = timeit_chain(lambda q: f(q, kc, vc), q, n=10)
+total = kc.size + vc.size
+print(f"scan 32x decode-attend fp8 cache ({total/2**30:.1f} GiB): {t*1e3:.2f} ms -> "
+      f"{total/t/2**30:.0f} GiB/s")

@@ -1,0 +1,103 @@
+"""Profile the real 8B int8 decode chunk and print the device-op time breakdown.
+
+Usage: python scripts/profile_decode.py [--small]
+Parses the jax.profiler xplane output directly (tensorboard's converter is
+version-broken in this image).
+"""
+import glob
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    small = "--small" in sys.argv
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import bench
+
+    import jax
+
+    from neuronx_distributed_inference_tpu.config import (
+        QuantizationConfig, TpuConfig, load_pretrained_config)
+    from neuronx_distributed_inference_tpu.models.llama.modeling_llama import (
+        LlamaForCausalLM, LlamaInferenceConfig)
+    from neuronx_distributed_inference_tpu.ops import sampling as sampling_ops
+
+    hf_cfg = {
+        "model_type": "llama", "vocab_size": 128256, "hidden_size": 4096,
+        "intermediate_size": 14336, "num_hidden_layers": 32,
+        "num_attention_heads": 32, "num_key_value_heads": 8, "head_dim": 128,
+        "max_position_embeddings": 131072, "rms_norm_eps": 1e-5,
+        "rope_theta": 500000.0,
+        "rope_scaling": {"rope_type": "llama3", "factor": 8.0,
+                         "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                         "original_max_position_embeddings": 8192},
+        "tie_word_embeddings": False,
+    }
+    batch = 64
+    quant = QuantizationConfig(quantize_weights=True, weight_dtype="int8",
+                               kv_cache_dtype="float8_e4m3")
+    tpu_cfg = TpuConfig(batch_size=batch, seq_len=512, max_context_length=256,
+                        dtype="bfloat16", tp_degree=1,
+                        context_encoding_buckets=[128, 256],
+                        token_generation_buckets=[256, 512],
+                        quantization_config=quant)
+    config = LlamaInferenceConfig(tpu_cfg, load_config=load_pretrained_config(hf_cfg))
+    app = LlamaForCausalLM(None, config)
+    t0 = time.time()
+    app.load_host_params(bench._random_quantized_llama_params(hf_cfg, seed=0))
+    print(f"params loaded in {time.time()-t0:.1f}s", flush=True)
+
+    rng = np.random.default_rng(0)
+    input_ids = rng.integers(1, hf_cfg["vocab_size"], size=(batch, 128)).astype(np.int32)
+
+    # warm up (compile both graphs)
+    out = app.generate(input_ids, max_new_tokens=64)
+    print("warm done", flush=True)
+
+    # profile one fresh generate (prefill + 2 decode chunks)
+    trace_dir = "/tmp/jaxprof"
+    os.system(f"rm -rf {trace_dir}")
+    with jax.profiler.trace(trace_dir):
+        out = app.generate(input_ids, max_new_tokens=64, collect_latency=True)
+    print("decode chunk latencies:", out.decode_latencies_s)
+    print("ttft:", out.ttft_s)
+
+    paths = glob.glob(f"{trace_dir}/**/*.xplane.pb", recursive=True)
+    print("xplane files:", paths)
+    analyze(paths)
+
+
+def analyze(paths):
+    os.environ["PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION"] = "python"
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    for p in paths:
+        xs = xplane_pb2.XSpace()
+        with open(p, "rb") as f:
+            xs.ParseFromString(f.read())
+        for plane in xs.planes:
+            if "TPU" not in plane.name and "tpu" not in plane.name.lower():
+                continue
+            print(f"\n=== plane: {plane.name} ===")
+            md = plane.event_metadata
+            agg = {}
+            for line in plane.lines:
+                for ev in line.events:
+                    name = md[ev.metadata_id].name
+                    dur = ev.duration_ps / 1e9  # ms
+                    a = agg.setdefault(name, [0.0, 0])
+                    a[0] += dur
+                    a[1] += 1
+            top = sorted(agg.items(), key=lambda kv: -kv[1][0])[:40]
+            for name, (ms, n) in top:
+                print(f"{ms:9.2f} ms  x{n:<5d} {name[:110]}")
+
+
+if __name__ == "__main__":
+    if sys.argv[1:] and sys.argv[1].endswith(".pb"):
+        analyze(sys.argv[1:])
+    else:
+        main()
