@@ -372,6 +372,7 @@ def _decoder_layer(
     cache_batch_start=0,
     adapter_ids: Optional[jnp.ndarray] = None,   # (B,) multi-LoRA slots
     ring_positions: Optional[jnp.ndarray] = None,  # (B, S) positions -> ring attention
+    window_row=None,   # traced scalar: dense windowed-prefill cache batch row
 ):
     resid = h
     hn = _norm(h, lp["ln1"], args)
@@ -404,6 +405,21 @@ def _decoder_layer(
         else:
             k_att = block_kvcache.read_seq(k_cache, block_table)
             v_att = block_kvcache.read_seq(v_cache, block_table)
+    elif positions is not None and window_row is not None:
+        # dense windowed (chunked) prefill: the T input tokens are a *contiguous
+        # prompt window* starting at positions[0], landing at cache batch rows
+        # [window_row, window_row+B) — write the window as one contiguous block, then
+        # attend over those rows' cache (prior windows + this one). ≈ reference
+        # windowed context encoding (`models/model_base.py:918-973`).
+        k_cache = kvcache.write_prefill(k_cache, k, start=positions[0],
+                                        batch_start=window_row)
+        v_cache = kvcache.write_prefill(v_cache, v, start=positions[0],
+                                        batch_start=window_row)
+        b_rows = k.shape[0]
+        k_att = jax.lax.dynamic_slice_in_dim(
+            kvcache.read_bucket(k_cache, decode_bucket), window_row, b_rows, axis=0)
+        v_att = jax.lax.dynamic_slice_in_dim(
+            kvcache.read_bucket(v_cache, decode_bucket), window_row, b_rows, axis=0)
     elif positions is None:
         # prefill: cache write at [0, S), attend over the fresh (unpadded-bucket) k/v.
         # The cache keeps its decode layout (≈ the reference's CP-prefill -> DP/TP-
@@ -465,7 +481,7 @@ def _decoder_layer(
 def _run_stack(params: Params, args: ModelArchArgs, h, cos, sin, mask, cache,
                positions, decode_bucket, mesh, rules, use_flash=False,
                local_rope_mask=None, paged=None, cache_batch_start=0,
-               adapter_ids=None, ring_positions=None):
+               adapter_ids=None, ring_positions=None, window_row=None):
     """Scan the decoder layers, carrying hidden state, yielding updated cache.
 
     ``local_rope_mask`` (set when args.layer_pattern is not None) is a triple
@@ -495,7 +511,8 @@ def _run_stack(params: Params, args: ModelArchArgs, h, cos, sin, mask, cache,
                                        use_flash=use_flash, paged=paged,
                                        cache_batch_start=cache_batch_start,
                                        adapter_ids=adapter_ids,
-                                       ring_positions=ring_positions)
+                                       ring_positions=ring_positions,
+                                       window_row=window_row)
         from ..utils import tensor_capture as _tc
 
         ys = (kc, vc)
@@ -619,12 +636,19 @@ def decode_forward(
     adapter_ids: Optional[jnp.ndarray] = None,   # (B,) multi-LoRA slots
     tree: Optional[Tuple[np.ndarray, np.ndarray]] = None,  # (depths (T,), ancestor (T,T))
     return_hidden: bool = False,  # also return the final normed hidden states (B, T, H)
+    window_row=None,  # traced scalar: dense windowed prefill at this cache batch row
 ) -> Tuple[jnp.ndarray, kvcache.KVCache]:
     """Token generation: returns (logits (B, T, V) fp32, updated cache).
 
     Dense mode slices the cache at the static ``decode_bucket``; paged mode
     (``block_table``/``slot_mapping`` given) gathers each row's blocks instead, with the
     attention width set by the table (MB * block_size).
+
+    ``window_row`` switches the call to *dense windowed (chunked) prefill*: the T
+    input tokens are a contiguous prompt window at positions [position_ids[0],
+    position_ids[0]+T) landing at cache batch rows [window_row, window_row+B) — the
+    dense-mode analog of the paged windowed prefill (≈ reference windowed CTE,
+    `models/model_base.py:918-973`). All rows share position_ids[0].
 
     ``tree`` switches the T input tokens from a left-to-right chain to a static token
     tree (Medusa / EAGLE tree verify, ≈ reference tree decoding
@@ -682,7 +706,8 @@ def decode_forward(
     h, cache = _run_stack(params, args, h, cos, sin, mask, cache,
                           positions=position_ids, decode_bucket=decode_bucket,
                           mesh=mesh, rules=rules, local_rope_mask=local_rope_mask,
-                          paged=paged, adapter_ids=adapter_ids)
+                          paged=paged, adapter_ids=adapter_ids,
+                          window_row=window_row)
     h = _norm(h, params["final_norm"], args)
     logits = _lm_head(params, args, h, mesh, rules)
     if return_hidden:

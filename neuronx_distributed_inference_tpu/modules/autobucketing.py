@@ -46,12 +46,17 @@ def generate_buckets_for_tkg(tpu_config) -> List[int]:
 
 
 def generate_batch_buckets(tpu_config) -> List[int]:
-    """Batch-dim buckets for continuous batching (≈ 2D batch x seq bucketing :22-63)."""
-    if not tpu_config.enable_bucketing or not tpu_config.is_continuous_batching:
+    """Batch-dim buckets (≈ 2D batch x seq bucketing :22-63): a request batch smaller
+    than ``max_batch_size`` runs at the first-fit batch bucket, so prefill/decode cost
+    scales with the live batch instead of the compiled maximum. Opt-in via
+    ``tpu_config.batch_buckets`` (each bucket compiles its own graphs)."""
+    if not tpu_config.enable_bucketing or not tpu_config.batch_buckets:
         return [tpu_config.max_batch_size]
-    if tpu_config.batch_buckets:
-        return list(tpu_config.batch_buckets)
-    return powers_of_two_ladder(1, tpu_config.max_batch_size)
+    buckets = sorted(set(tpu_config.batch_buckets))
+    if buckets[-1] != tpu_config.max_batch_size:
+        raise ValueError(f"batch_buckets {buckets} must end at max_batch_size "
+                         f"{tpu_config.max_batch_size}")
+    return buckets
 
 
 def select_bucket(buckets: Sequence[int], length: int) -> int:
@@ -62,9 +67,6 @@ def select_bucket(buckets: Sequence[int], length: int) -> int:
     raise ValueError(f"length {length} exceeds largest bucket {buckets[-1]}")
 
 
-def select_bucket_2d(prefill_buckets: Sequence[int], prefix_buckets: Sequence[int],
-                     prefill_len: int, prefix_len: int) -> Tuple[int, int]:
-    """2D (prefill x prefix) bucket pick for prefix caching (≈ :918-1142 of the
-    wrapper's 2D logic, simplified to independent first-fit per dim)."""
-    return select_bucket(prefill_buckets, prefill_len), select_bucket(prefix_buckets,
-                                                                      prefix_len)
+# NOTE: the reference's 2D (prefill x prefix) bucket logic (`model_wrapper.py:918-1142`)
+# has no analog here: paged prefix caching reuses prior blocks through the block table,
+# whose width is static, so prefix length never changes a compiled shape.

@@ -100,6 +100,7 @@ class TpuModelForCausalLM:
 
         self.cte_buckets = autobucketing.generate_buckets_for_cte(self.tpu_config)
         self.tkg_buckets = autobucketing.generate_buckets_for_tkg(self.tpu_config)
+        self.batch_buckets = autobucketing.generate_batch_buckets(self.tpu_config)
 
         from ..parallel.sharding import DEFAULT_RULES
 
@@ -236,10 +237,26 @@ class TpuModelForCausalLM:
             toks = toks.T  # (num_steps, B) -> (B, num_steps)
             return toks, step_logits, cache
 
+        def _window(params, input_ids, start, window_row, cache, decode_bucket):
+            """One dense windowed-prefill step: write the (B, W) prompt window's KV at
+            absolute positions [start, start+W), cache rows [window_row, +B), attending
+            over the rows' earlier windows (≈ windowed CTE, `model_base.py:918-973`).
+            Logits are discarded — the caller seeds generation with a 1-token decode
+            re-feeding each row's true last token."""
+            b = input_ids.shape[0]
+            pos = jnp.full((b,), start, dtype=jnp.int32)
+            with jax.default_matmul_precision(precision):
+                _, cache = decode_core(params, args, input_ids, pos, cache,
+                                       decode_bucket, mesh=mesh, rules=rules,
+                                       window_row=window_row)
+            return cache
+
         self._prefill_step = jax.jit(_prefill, donate_argnums=(4,))
         self._decode_step = jax.jit(
             _decode, donate_argnums=(3,),
             static_argnames=("decode_bucket", "num_steps", "with_logits", "greedy"))
+        self._window_step = jax.jit(_window, donate_argnums=(4,),
+                                    static_argnames=("decode_bucket",))
 
     def _use_ring_attention(self) -> bool:
         """Context-parallel (ring attention) prefill when the mesh has a cp axis.
@@ -422,8 +439,14 @@ class TpuModelForCausalLM:
             dtype=self.tpu_config.kv_cache_jax_dtype,
         )
 
-    def reset_cache(self) -> None:
+    def reset_cache(self, batch_size: Optional[int] = None) -> None:
+        """Fresh zero cache; ``batch_size`` overrides the compiled batch for
+        batch-bucketed requests (see autobucketing.generate_batch_buckets)."""
+        import dataclasses as _dc
+
         spec = self.cache_spec()
+        if batch_size is not None and batch_size != spec.batch_size:
+            spec = _dc.replace(spec, batch_size=batch_size)
         sharding = named_sharding(self.mesh, kvcache.CACHE_LOGICAL,
                                   self.sharding_rules)
         self.kv_cache = jax.tree.map(
@@ -542,6 +565,14 @@ class TpuModelForCausalLM:
         input_ids = model_wrapper.to_int32(input_ids)
         b = input_ids.shape[0]
         compiled_b = self.tpu_config.max_batch_size
+        if len(self.batch_buckets) > 1 and _mm_embeds is None:
+            batch_bucket = autobucketing.select_bucket(self.batch_buckets, b)
+            if batch_bucket != compiled_b:
+                if type(self).reset_cache is not TpuModelForCausalLM.reset_cache:
+                    raise ValueError(
+                        "batch_buckets not supported for families with a custom "
+                        "cache layout")
+                compiled_b = batch_bucket
         if adapter_ids is not None:
             if self.arch_args.lora is None:
                 raise ValueError("adapter_ids given but lora_serving_config is not set")
@@ -570,10 +601,23 @@ class TpuModelForCausalLM:
         greedy_only = (not self.sampling_config.do_sample
                        and bool((sp_arr[:, 0] == 1).all()))
 
+        max_prompt = (int(np.asarray(attention_mask).sum(axis=1).max())
+                      if attention_mask is not None else input_ids.shape[1])
+        windowed = max_prompt > self.cte_buckets[-1]
+        if windowed and self.decode_fn() is not model_base.decode_forward:
+            raise ValueError(
+                f"prompt ({max_prompt}) exceeds the largest context bucket "
+                f"({self.cte_buckets[-1]}) and this family's custom decode path has "
+                f"no dense windowed prefill")
         padded = model_wrapper.pad_prefill_inputs(
-            input_ids, attention_mask, self.cte_buckets, pad_token_id=pad_token_id,
-            batch_size=compiled_b)
-        self.reset_cache()
+            input_ids, attention_mask,
+            self.cte_buckets if not windowed else [self.cte_buckets[-1]],
+            pad_token_id=pad_token_id, batch_size=compiled_b,
+            allow_longer=windowed)
+        if compiled_b != self.tpu_config.max_batch_size:
+            self.reset_cache(batch_size=compiled_b)
+        else:
+            self.reset_cache()
 
         # env-driven repro snapshots (≈ NXD_INFERENCE_CAPTURE_*, utils/snapshot.py)
         from ..utils import snapshot as snapshot_lib
@@ -593,8 +637,38 @@ class TpuModelForCausalLM:
 
         t_start = time.perf_counter()
         key, sub = jax.random.split(key)
-        tokens_dev, logits_dev, self.kv_cache = self._run_prefill(
-            padded, sampling_params, sub, adapter_ids, mm=_mm_embeds)
+        if windowed:
+            # dense windowed (chunked) prefill: largest-bucket windows write the
+            # prompt's KV in sequence; a 1-token decode re-feeding each row's true
+            # last token (an idempotent cache rewrite) then yields the seed logits.
+            if _mm_embeds is not None:
+                raise ValueError("multimodal prompts exceed the largest context "
+                                 "bucket; raise max_context_length")
+            w = self.cte_buckets[-1]
+            total = padded.input_ids.shape[1]
+            if total > self.tpu_config.seq_len:
+                raise ValueError(
+                    f"windowed prefill needs {total} cache slots (prompt rounded up "
+                    f"to {w}-wide windows) but seq_len is {self.tpu_config.seq_len}")
+            for w0 in range(0, total, w):
+                bkt = autobucketing.select_bucket(self.tkg_buckets, w0 + w)
+                self.kv_cache = self._window_step(
+                    self.params, padded.input_ids[:, w0 : w0 + w],
+                    np.int32(w0), np.int32(0), self.kv_cache, decode_bucket=bkt)
+            seed_tok = padded.input_ids[np.arange(padded.input_ids.shape[0]),
+                                        padded.last_token_idx]
+            seed_bucket = autobucketing.select_bucket(
+                self.tkg_buckets, int(padded.true_lengths.max()))
+            toks, step_logits, self.kv_cache = self._decode_step(
+                self.params, jnp.asarray(seed_tok), padded.last_token_idx,
+                self.kv_cache, sampling_params, sub, decode_bucket=seed_bucket,
+                num_steps=1, with_logits=return_logits, adapter_ids=adapter_ids,
+                greedy=greedy_only)
+            tokens_dev = toks[:, 0]
+            logits_dev = step_logits[0] if return_logits else None
+        else:
+            tokens_dev, logits_dev, self.kv_cache = self._run_prefill(
+                padded, sampling_params, sub, adapter_ids, mm=_mm_embeds)
         tokens_dev.block_until_ready()
         ttft = time.perf_counter() - t_start
 

@@ -190,10 +190,36 @@ class ContinuousBatchingRunner:
                 (_, _, cache), toks = jax.lax.scan(body, (tok0, positions, cache), keys)
                 return toks.T, cache
 
+            def _window(params, input_ids, start, slot, cache, decode_bucket):
+                """Batch-1 dense windowed-prefill step at cache row ``slot`` (dense
+                analog of the paged chunked insert; ≈ windowed CTE,
+                `model_base.py:918-973`)."""
+                pos = jnp.full((1,), start, dtype=jnp.int32)
+                with jax.default_matmul_precision(precision):
+                    _, cache = model_base.decode_forward(
+                        params, args, input_ids, pos, cache, decode_bucket,
+                        mesh=mesh, rules=rules, window_row=slot)
+                return cache
+
+            def _seed(params, tok, pos, slot, cache, sampling_params, key,
+                      decode_bucket):
+                """Re-feed the prompt's last token (idempotent KV rewrite) to obtain
+                seed logits after a windowed insert."""
+                with jax.default_matmul_precision(precision):
+                    logits, cache = model_base.decode_forward(
+                        params, args, tok[:, None], pos, cache, decode_bucket,
+                        mesh=mesh, rules=rules, window_row=slot)
+                out = sampling_ops.sample(logits[:, -1], sampling_params, key, odsc)
+                return out, cache
+
             self._insert_step = jax.jit(_insert, donate_argnums=(4,))
             self._decode_step = jax.jit(
                 _decode, donate_argnums=(3,),
                 static_argnames=("decode_bucket", "num_steps"))
+            self._window_step = jax.jit(_window, donate_argnums=(4,),
+                                        static_argnames=("decode_bucket",))
+            self._seed_step = jax.jit(_seed, donate_argnums=(4,),
+                                      static_argnames=("decode_bucket",))
 
     # ------------------------------------------------------------------ API
     def submit(self, prompt: np.ndarray, max_new_tokens: int = 32,
@@ -205,10 +231,14 @@ class ContinuousBatchingRunner:
             raise ValueError(f"prompt ({prompt.size}) + max_new_tokens "
                              f"({max_new_tokens}) exceeds seq_len {self.cfg.seq_len}")
         if not self.paged and prompt.size > self.app.cte_buckets[-1]:
-            raise ValueError(
-                f"prompt ({prompt.size}) exceeds the largest context bucket "
-                f"({self.app.cte_buckets[-1]}); dense mode has no windowed prefill — "
-                f"enable paged_attention for chunked prefill")
+            # dense windowed prefill rounds the prompt up to full windows; those
+            # cache slots must exist
+            w = self.app.cte_buckets[-1]
+            total = -(-prompt.size // w) * w
+            if total > self.cfg.seq_len:
+                raise ValueError(
+                    f"windowed prefill needs {total} cache slots (prompt rounded up "
+                    f"to {w}-wide windows) but seq_len is {self.cfg.seq_len}")
         req = Request(self._next_id, prompt, max_new_tokens, eos_token_id)
         self._next_id += 1
         self.queue.append(req)
@@ -401,6 +431,26 @@ class ContinuousBatchingRunner:
                     jnp.asarray(self.block_table[slot : slot + 1]),
                     jnp.asarray(slot_map), sp_row, sub)
                 start += len(window)
+        elif len(fed) > self.app.cte_buckets[-1]:
+            # dense windowed (chunked) prefill at this slot's cache row, then a
+            # 1-token seed decode re-feeding the last prompt token (idempotent
+            # rewrite) for the first sampled token
+            w = self.app.cte_buckets[-1]
+            total = -(-len(fed) // w) * w
+            ids = np.zeros((1, total), dtype=np.int32)
+            ids[0, : len(fed)] = fed
+            for w0 in range(0, total, w):
+                bkt = autobucketing.select_bucket(self.app.tkg_buckets, w0 + w)
+                self.cache = self._window_step(
+                    self.app.params, ids[:, w0 : w0 + w], np.int32(w0),
+                    np.int32(slot), self.cache, decode_bucket=bkt)
+            key, sub = jax.random.split(key)
+            tok_dev, self.cache = self._seed_step(
+                self.app.params, jnp.asarray(fed[-1:]),
+                np.array([len(fed) - 1], dtype=np.int32), np.int32(slot),
+                self.cache, sp_row, sub,
+                decode_bucket=autobucketing.select_bucket(self.app.tkg_buckets,
+                                                          len(fed)))
         else:
             padded = model_wrapper.pad_prefill_inputs(
                 fed[None, :], None, self.app.cte_buckets, batch_size=1)

@@ -32,12 +32,17 @@ def pad_prefill_inputs(
     buckets: Sequence[int],
     pad_token_id: int = 0,
     batch_size: Optional[int] = None,
+    allow_longer: bool = False,
 ) -> PaddedPrefill:
     """Right-pad (B, S) int inputs to the first-fit sequence bucket.
 
     ``attention_mask`` (B, S) of 0/1 marks real tokens (right-padded). Inputs arriving
     left-padded are normalized to right padding, like the reference's CTE path
     (`model_wrapper.py:725-824`).
+
+    ``allow_longer``: a prompt longer than the largest bucket pads to the next
+    multiple of the largest bucket instead of raising — the layout for dense
+    windowed (chunked) prefill, which slices the result into largest-bucket windows.
     """
     input_ids = np.asarray(input_ids)
     if input_ids.ndim != 2:
@@ -50,7 +55,12 @@ def pad_prefill_inputs(
     if np.any(true_lengths == 0):
         raise ValueError("each sequence needs at least one real token")
 
-    bucket = autobucketing.select_bucket(buckets, int(true_lengths.max()))
+    max_len = int(true_lengths.max())
+    if allow_longer and max_len > buckets[-1]:
+        w = buckets[-1]
+        bucket = -(-max_len // w) * w
+    else:
+        bucket = autobucketing.select_bucket(buckets, max_len)
     out_b = batch_size or b
     if b > out_b:
         raise ValueError(f"batch {b} exceeds compiled batch size {out_b}")
