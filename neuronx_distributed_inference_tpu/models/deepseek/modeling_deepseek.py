@@ -31,10 +31,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...config import InferenceConfig
-from ...modules import kvcache
+from ...modules import block_kvcache, kvcache
 from ...ops import rope as rope_ops
 from ...ops.moe import MoEArgs, moe_block
 from ...ops.norms import rms_norm
+from ...ops.quantization import qapply, qeinsum
 from ...parallel.sharding import constrain, named_sharding
 from ..base import (ModelArchArgs, Params, _ACTIVATIONS, _embed, _lm_head, _mlp,
                     _norm)
@@ -76,25 +77,26 @@ def _mla_attention(lp: Params, args: DeepseekArchArgs, hn: jnp.ndarray,
                    cos: jnp.ndarray, sin: jnp.ndarray, mask: jnp.ndarray,
                    latent_cache: jnp.ndarray,
                    positions: Optional[jnp.ndarray], decode_bucket: Optional[int],
-                   mesh, rules):
+                   mesh, rules, paged=None, cache_batch_start=0):
     """MLA attention over the latent cache.
 
-    hn: (B, S, H) normed hidden states. latent_cache: (B, 1, S_max, R+C).
+    hn: (B, S, H) normed hidden states. latent_cache: dense (B, 1, S_max, R+C), or
+    paged (num_blocks, block_size, 1, R+C) when ``paged=(block_table, slot_mapping)``.
     Returns (attn_out (B, S, heads*v_dim), updated latent_cache)."""
     b, s, _ = hn.shape
     R, C = args.qk_rope_head_dim, args.kv_lora_rank
     nope = args.qk_nope_head_dim
 
     if args.q_lora_rank is None:
-        q = hn @ lp["wq"]
+        q = qapply(hn, lp["wq"])
     else:
-        q_a = rms_norm(hn @ lp["q_a"], lp["q_a_norm"], args.rms_norm_eps)
-        q = q_a @ lp["q_b"]
+        q_a = rms_norm(qapply(hn, lp["q_a"]), lp["q_a_norm"], args.rms_norm_eps)
+        q = qapply(q_a, lp["q_b"])
     q = q.reshape(b, s, args.num_heads, args.qk_head_dim).transpose(0, 2, 1, 3)
     q = constrain(q, ("batch", "heads", None, None), rules, mesh=mesh)
     q_nope, q_pe = q[..., :nope], q[..., nope:]
 
-    ckv = hn @ lp["kv_a"]                                   # (B, S, C + R)
+    ckv = qapply(hn, lp["kv_a"])                            # (B, S, C + R)
     c, k_pe = ckv[..., :C], ckv[..., C:]
     c = rms_norm(c, lp["kv_a_norm"], args.rms_norm_eps)     # (B, S, C)
     k_pe = k_pe[:, None, :, :]                              # (B, 1, S, R)
@@ -105,12 +107,21 @@ def _mla_attention(lp: Params, args: DeepseekArchArgs, hn: jnp.ndarray,
     q_pe, k_pe = rope_ops.apply_rotary(q_pe, k_pe, cos, sin)
 
     # absorb the K half of kv_b into q_nope: (B, h, S, nope) x (h, nope, C)
-    q_c = jnp.einsum("bhsn,hnc->bhsc", q_nope, lp["k_absorb"])
+    q_c = qeinsum("bhsn,hnc->bhsc", q_nope, lp["k_absorb"])
 
     latent_new = jnp.concatenate(
         [k_pe, c[:, None, :, :]], axis=-1)                  # (B, 1, S, R+C)
-    if positions is None:
-        latent_cache = kvcache.write_prefill(latent_cache, latent_new)
+    if paged is not None:
+        block_table, slot_mapping = paged
+        latent_cache = block_kvcache.write_slots(latent_cache, latent_new,
+                                                 slot_mapping)
+        if positions is None:
+            latent_att = latent_new
+        else:
+            latent_att = block_kvcache.read_seq(latent_cache, block_table)
+    elif positions is None:
+        latent_cache = kvcache.write_prefill(latent_cache, latent_new,
+                                             batch_start=cache_batch_start)
         latent_att = latent_new
     else:
         latent_cache = kvcache.write_decode(latent_cache, latent_new, positions)
@@ -128,7 +139,7 @@ def _mla_attention(lp: Params, args: DeepseekArchArgs, hn: jnp.ndarray,
     probs = jax.nn.softmax(scores, axis=-1).astype(q_pe.dtype)
 
     x = jnp.einsum("bhst,btc->bhsc", probs, c_att)          # (B, h, S, C)
-    attn = jnp.einsum("bhsc,hvc->bhsv", x, lp["v_absorb"])  # (B, h, S, v_dim)
+    attn = qeinsum("bhsc,hcv->bhsv", x, lp["v_absorb"])     # (B, h, S, v_dim)
     attn = constrain(attn, ("batch", "heads", None, None), rules, mesh=mesh)
     attn = attn.transpose(0, 2, 1, 3).reshape(b, s, args.num_heads * args.v_head_dim)
     return attn, latent_cache
@@ -136,12 +147,14 @@ def _mla_attention(lp: Params, args: DeepseekArchArgs, hn: jnp.ndarray,
 
 def _deepseek_layer(lp: Params, args: DeepseekArchArgs, h, cos, sin, mask,
                     latent_cache, positions, decode_bucket, mesh, rules,
-                    is_moe: bool):
+                    is_moe: bool, paged=None, cache_batch_start=0):
     resid = h
     hn = _norm(h, lp["ln1"], args)
     attn, latent_cache = _mla_attention(lp, args, hn, cos, sin, mask, latent_cache,
-                                        positions, decode_bucket, mesh, rules)
-    attn_out = attn @ lp["wo"]
+                                        positions, decode_bucket, mesh, rules,
+                                        paged=paged,
+                                        cache_batch_start=cache_batch_start)
+    attn_out = qapply(attn, lp["wo"])
     attn_out = constrain(attn_out, ("batch", None, None), rules, mesh=mesh)
     h = resid + attn_out
 
@@ -156,9 +169,10 @@ def _deepseek_layer(lp: Params, args: DeepseekArchArgs, h, cos, sin, mask,
 
 
 def _run_segments(params: Params, args: DeepseekArchArgs, h, cos, sin, mask, cache,
-                  positions, decode_bucket, mesh, rules):
+                  positions, decode_bucket, mesh, rules, paged=None,
+                  cache_batch_start=0):
     """Scan the dense segment then the MoE segment, carrying hidden + latent cache."""
-    latents = cache["latent"]                       # (L, B, 1, S, R+C)
+    latents = cache["latent"]                       # (L, B, 1, S, R+C) | paged blocks
     kd = args.first_k_dense_replace
     new_latents = []
 
@@ -167,7 +181,8 @@ def _run_segments(params: Params, args: DeepseekArchArgs, h, cos, sin, mask, cac
             lp, lat = xs
             new_h, lat = _deepseek_layer(lp, args, carry_h, cos, sin, mask, lat,
                                          positions, decode_bucket, mesh, rules,
-                                         is_moe=is_moe)
+                                         is_moe=is_moe, paged=paged,
+                                         cache_batch_start=cache_batch_start)
             return new_h, lat
 
         return jax.lax.scan(body, h, (stack, latent_stack))
@@ -186,7 +201,9 @@ def prefill_forward(params: Params, args: DeepseekArchArgs, input_ids, position_
                     slot_mapping=None, cache_batch_start=0, adapter_ids=None,
                     use_ring=False, return_hidden=False):
     """Context encoding over the latent cache (signature-compatible with
-    models/base.prefill_forward; flash/ring/paged/LoRA are not supported for MLA yet)."""
+    models/base.prefill_forward; flash/ring/LoRA are not supported for MLA yet).
+    ``slot_mapping`` switches to the paged latent cache; ``cache_batch_start`` lands
+    the dense write at a continuous-batching slot row."""
     h = _embed(params, args, input_ids, mesh, rules)
     cos, sin = rope_ops.compute_cos_sin(params["rope_inv_freq"], position_ids,
                                         args.rope_attention_scaling)
@@ -194,9 +211,13 @@ def prefill_forward(params: Params, args: DeepseekArchArgs, input_ids, position_
 
     mask = (position_ids[:, None, :, None] >= position_ids[:, None, None, :])
     mask = jnp.logical_and(mask, _cm(input_ids.shape[1], input_ids.shape[1])[None, None])
+    paged = None
+    if slot_mapping is not None:
+        paged = (jnp.zeros((input_ids.shape[0], 1), dtype=jnp.int32), slot_mapping)
     h, cache = _run_segments(params, args, h, cos, sin, mask, cache,
                              positions=None, decode_bucket=None, mesh=mesh,
-                             rules=rules)
+                             rules=rules, paged=paged,
+                             cache_batch_start=cache_batch_start)
     h = _norm(h, params["final_norm"], args)
     h_last = jnp.take_along_axis(h, last_token_idx[:, None, None], axis=1)[:, 0]
     logits = _lm_head(params, args, h_last, mesh, rules)
@@ -209,7 +230,12 @@ def decode_forward(params: Params, args: DeepseekArchArgs, input_ids, position_i
                    cache, decode_bucket, mesh=None, rules=None, block_table=None,
                    slot_mapping=None, adapter_ids=None, tree=None,
                    return_hidden=False):
-    """Token generation over the latent cache (dense bucketed mode)."""
+    """Token generation over the latent cache (dense bucketed or paged mode)."""
+    paged = None
+    if block_table is not None:
+        paged = (block_table, slot_mapping)
+        block_size = cache["latent"].shape[2]
+        decode_bucket = block_table.shape[1] * block_size
     b, t = input_ids.shape
     h = _embed(params, args, input_ids, mesh, rules)
     pos_grid = position_ids[:, None] + jnp.arange(t)[None, :]
@@ -220,7 +246,7 @@ def decode_forward(params: Params, args: DeepseekArchArgs, input_ids, position_i
     mask = kv_pos <= q_pos
     h, cache = _run_segments(params, args, h, cos, sin, mask, cache,
                              positions=position_ids, decode_bucket=decode_bucket,
-                             mesh=mesh, rules=rules)
+                             mesh=mesh, rules=rules, paged=paged)
     h = _norm(h, params["final_norm"], args)
     logits = _lm_head(params, args, h, mesh, rules)
     if return_hidden:
@@ -254,11 +280,25 @@ class DeepseekInferenceConfig(InferenceConfig):
 
 
 class DeepseekForCausalLM(TpuModelForCausalLM):
-    """≈ the reference DeepSeek application built on `DeepseekV3Attention`."""
+    """≈ the reference DeepSeek application built on `DeepseekV3Attention`.
+
+    Quantization (int8/fp8 weight-only over the MLA projections incl. the absorbed
+    kv_b halves, ≈ reference quant flows `models/model_wrapper.py:11-21`), continuous
+    batching, and paged attention run on the latent-cache layout; LoRA and fused
+    speculation remain unsupported for MLA."""
 
     def __init__(self, model_path, config, mesh=None):
-        self._require_base_layout(config.tpu_config, "MLA (DeepSeek)")
+        self._require_base_layout(config.tpu_config, "MLA (DeepSeek)",
+                                  allow=("quantization_config",
+                                         "is_continuous_batching",
+                                         "paged_attention_enabled"))
         super().__init__(model_path, config, mesh=mesh)
+
+    def quantized_param_names(self):
+        from ...ops.quantization import DEFAULT_QUANTIZED_PARAMS
+
+        return DEFAULT_QUANTIZED_PARAMS + (
+            "q_a", "q_b", "kv_a", "k_absorb", "v_absorb")
 
     @classmethod
     def get_config_cls(cls):
@@ -406,7 +446,7 @@ class DeepseekForCausalLM(TpuModelForCausalLM):
                 "kv_a": w((L, H, C + R)),
                 "kv_a_norm": jnp.ones((L, C), dtype=dtype),
                 "k_absorb": w((L, nh, a.qk_nope_head_dim, C)),
-                "v_absorb": w((L, nh, a.v_head_dim, C)),
+                "v_absorb": w((L, nh, C, a.v_head_dim)),
                 "wo": w((L, nh * a.v_head_dim, H)),
             }
             if a.q_lora_rank is None:
@@ -451,6 +491,15 @@ class DeepseekForCausalLM(TpuModelForCausalLM):
         return params
 
     # --- latent cache -----------------------------------------------------------------
+    def make_paged_cache(self, num_blocks: int, block_size: int):
+        """Paged latent cache: (L, num_blocks, block_size, 1, R+C), replicated over
+        tp like the dense latent."""
+        a: DeepseekArchArgs = self.arch_args
+        shape = (a.num_layers, num_blocks, block_size, 1, a.latent_dim)
+        sharding = named_sharding(self.mesh, ("layers", None, None, None, None))
+        return {"latent": jax.device_put(
+            jnp.zeros(shape, dtype=self.tpu_config.kv_cache_jax_dtype), sharding)}
+
     def reset_cache(self) -> None:
         a: DeepseekArchArgs = self.arch_args
         shape = (a.num_layers, self.tpu_config.max_batch_size, 1,
@@ -488,7 +537,10 @@ class DeepseekForCausalLM(TpuModelForCausalLM):
                 "kv_a": linear_t(p + "kv_a_proj_with_mqa.weight"),
                 "kv_a_norm": get(p + "kv_a_layernorm.weight"),
                 "k_absorb": wkv_b[:, :nope, :],
-                "v_absorb": wkv_b[:, nope:, :],
+                # stored (heads, C, v) so the contraction dim sits at axis -2
+                # ((in, out) layout, required by per-channel weight quantization)
+                "v_absorb": np.ascontiguousarray(
+                    wkv_b[:, nope:, :].transpose(0, 2, 1)),
                 "wo": linear_t(p + "o_proj.weight"),
             }
             if args.q_lora_rank is None:

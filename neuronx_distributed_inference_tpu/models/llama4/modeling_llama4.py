@@ -31,9 +31,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...config import InferenceConfig
-from ...modules import gqa, kvcache
+from ...modules import block_kvcache, gqa, kvcache
 from ...ops import rope as rope_ops
 from ...ops.moe import MoEArgs, moe_block
+from ...ops.quantization import qapply
 from ...parallel.sharding import constrain
 from ..base import (ModelArchArgs, Params, _ACTIVATIONS, _embed, _lm_head, _mlp,
                     _norm, _project_qkv, causal_mask)
@@ -66,7 +67,7 @@ def _l2_norm(x: jnp.ndarray, eps: float = 1e-6) -> jnp.ndarray:
 
 def _llama4_layer(lp: Params, args: Llama4ArchArgs, h, rope_ctx, k_cache, v_cache,
                   positions, decode_bucket, mesh, rules, is_moe: bool,
-                  use_rope: jnp.ndarray):
+                  use_rope: jnp.ndarray, paged=None, cache_batch_start=0):
     """One decoder layer; ``use_rope`` is a scanned boolean selecting rope+chunked vs
     nope+global behaviour (cos/sin/masks for both kinds precomputed in rope_ctx)."""
     cos, sin, mask_chunked, mask_global, temp_scales = rope_ctx
@@ -86,9 +87,18 @@ def _llama4_layer(lp: Params, args: Llama4ArchArgs, h, rope_ctx, k_cache, v_cach
         q_r = jnp.where(use_rope, q_r, q_r * temp_scales)
     q, k = q_r, k_r
 
-    if positions is None:
-        k_cache = kvcache.write_prefill(k_cache, k)
-        v_cache = kvcache.write_prefill(v_cache, v)
+    if paged is not None:
+        block_table, slot_mapping = paged
+        k_cache = block_kvcache.write_slots(k_cache, k, slot_mapping)
+        v_cache = block_kvcache.write_slots(v_cache, v, slot_mapping)
+        if positions is None:
+            k_att, v_att = k, v
+        else:
+            k_att = block_kvcache.read_seq(k_cache, block_table)
+            v_att = block_kvcache.read_seq(v_cache, block_table)
+    elif positions is None:
+        k_cache = kvcache.write_prefill(k_cache, k, batch_start=cache_batch_start)
+        v_cache = kvcache.write_prefill(v_cache, v, batch_start=cache_batch_start)
         k_att, v_att = k, v
     else:
         k_cache = kvcache.write_decode(k_cache, k, positions)
@@ -102,7 +112,7 @@ def _llama4_layer(lp: Params, args: Llama4ArchArgs, h, rope_ctx, k_cache, v_cach
     attn = attend(q, k_att.astype(q.dtype), v_att.astype(q.dtype), mask=mask,
                   scale=args.attention_scale)
     attn = attn.transpose(0, 2, 1, 3).reshape(h.shape[0], h.shape[1], args.q_size)
-    attn_out = attn @ lp["wo"]
+    attn_out = qapply(attn, lp["wo"])
     attn_out = constrain(attn_out, ("batch", None, None), rules, mesh=mesh)
     h = resid + attn_out
 
@@ -132,7 +142,8 @@ def _segment_runs(flags: Tuple[bool, ...]) -> List[Tuple[bool, int, int, int]]:
 
 
 def _run_layers(params: Params, args: Llama4ArchArgs, h, rope_ctx, cache,
-                positions, decode_bucket, mesh, rules):
+                positions, decode_bucket, mesh, rules, paged=None,
+                cache_batch_start=0):
     """Scan contiguous dense/MoE runs.
 
     All-MoE configs (Scout) get one scan; alternating configs (Maverick) degenerate to
@@ -154,7 +165,8 @@ def _run_layers(params: Params, args: Llama4ArchArgs, h, rope_ctx, cache,
             lp, kc, vc, ur = layer_xs
             nh, kc, vc = _llama4_layer(lp, args, carry_h, rope_ctx, kc, vc,
                                        positions, decode_bucket, mesh, rules,
-                                       is_moe=_is_moe, use_rope=ur)
+                                       is_moe=_is_moe, use_rope=ur, paged=paged,
+                                       cache_batch_start=cache_batch_start)
             return nh, (kc, vc)
 
         h, (ks, vs) = jax.lax.scan(body, h, xs)
@@ -194,8 +206,12 @@ def prefill_forward(params: Params, args: Llama4ArchArgs, input_ids, position_id
     kv_pos = position_ids[:, None, None, :]
     rope_ctx = (cos, sin, _chunk_mask(q_pos, kv_pos, base, args.attention_chunk_size),
                 base, _temp_scales(args, position_ids))
+    paged = None
+    if slot_mapping is not None:
+        paged = (jnp.zeros((input_ids.shape[0], 1), dtype=jnp.int32), slot_mapping)
     h, cache = _run_layers(params, args, h, rope_ctx, cache, positions=None,
-                           decode_bucket=None, mesh=mesh, rules=rules)
+                           decode_bucket=None, mesh=mesh, rules=rules, paged=paged,
+                           cache_batch_start=cache_batch_start)
     h = _norm(h, params["final_norm"], args)
     h_last = jnp.take_along_axis(h, last_token_idx[:, None, None], axis=1)[:, 0]
     logits = _lm_head(params, args, h_last, mesh, rules)
@@ -208,6 +224,11 @@ def decode_forward(params: Params, args: Llama4ArchArgs, input_ids, position_ids
                    cache, decode_bucket, mesh=None, rules=None, block_table=None,
                    slot_mapping=None, adapter_ids=None, tree=None,
                    return_hidden=False):
+    paged = None
+    if block_table is not None:
+        paged = (block_table, slot_mapping)
+        block_size = cache["k"].shape[2]
+        decode_bucket = block_table.shape[1] * block_size
     b, t = input_ids.shape
     h = _embed(params, args, input_ids, mesh, rules)
     pos_grid = position_ids[:, None] + jnp.arange(t)[None, :]
@@ -219,7 +240,8 @@ def decode_forward(params: Params, args: Llama4ArchArgs, input_ids, position_ids
     rope_ctx = (cos, sin, _chunk_mask(q_pos, kv_pos, base, args.attention_chunk_size),
                 base, _temp_scales(args, pos_grid))
     h, cache = _run_layers(params, args, h, rope_ctx, cache, positions=position_ids,
-                           decode_bucket=decode_bucket, mesh=mesh, rules=rules)
+                           decode_bucket=decode_bucket, mesh=mesh, rules=rules,
+                           paged=paged)
     h = _norm(h, params["final_norm"], args)
     logits = _lm_head(params, args, h, mesh, rules)
     if return_hidden:
@@ -272,10 +294,17 @@ class Llama4InferenceConfig(InferenceConfig):
 
 
 class Llama4ForCausalLM(TpuModelForCausalLM):
-    """≈ NeuronLlama4ForCausalLM (text path)."""
+    """≈ NeuronLlama4ForCausalLM (text path).
+
+    Quantization (int8/fp8 weight-only, ≈ reference quant flows
+    `models/model_wrapper.py:11-21`), continuous batching, and paged attention run on
+    the interleaved dense/MoE layout; LoRA and fused speculation remain unsupported."""
 
     def __init__(self, model_path, config, mesh=None):
-        self._require_base_layout(config.tpu_config, "Llama4")
+        self._require_base_layout(config.tpu_config, "Llama4",
+                                  allow=("quantization_config",
+                                         "is_continuous_batching",
+                                         "paged_attention_enabled"))
         super().__init__(model_path, config, mesh=mesh)
 
     @classmethod

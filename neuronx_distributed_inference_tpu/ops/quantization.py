@@ -27,7 +27,8 @@ from typing import Any, Dict, Optional, Sequence
 import jax
 import jax.numpy as jnp
 
-# params converted by default: every large projection matmul
+# params converted by default: every large projection matmul. All are stored in
+# (..., in, out) layout so per-output-channel scales reduce over axis -2.
 DEFAULT_QUANTIZED_PARAMS = (
     "wq", "wk", "wv", "wo", "wg", "wu", "wd",
     "shared_wg", "shared_wu", "shared_wd", "lm_head",
@@ -93,22 +94,26 @@ def qeinsum(spec: str, x: jnp.ndarray, w) -> jnp.ndarray:
 
 def quantize_params(params: Dict[str, Any], weight_dtype: str = "int8",
                     names: Sequence[str] = DEFAULT_QUANTIZED_PARAMS) -> Dict[str, Any]:
-    """Convert the named weights of a model param tree (top level + ``layers``).
+    """Convert the named weights of a model param tree, recursively over every dict
+    level — covers the base layout (top level + ``layers``) as well as custom layouts
+    (DeepSeek-MLA / Llama4 ``dense``/``moe`` groups).
 
     Leaves that are ALREADY in the quantized {"q","s"} layout pass through untouched,
     so pre-quantized (or partially pre-quantized) checkpoints load correctly."""
-    def conv(w):
-        return w if is_quantized(w) else quantize_tensor(w, weight_dtype)
+    nameset = set(names)
 
-    out = dict(params)
-    if "lm_head" in out and "lm_head" in names:
-        out["lm_head"] = conv(out["lm_head"])
-    layers = dict(out["layers"])
-    for name in names:
-        if name in layers:
-            layers[name] = conv(layers[name])
-    out["layers"] = layers
-    return out
+    def walk(node):
+        if is_quantized(node):
+            return node
+        if isinstance(node, dict):
+            return {k: (quantize_tensor(v, weight_dtype)
+                        if k in nameset and not is_quantized(v)
+                        and not isinstance(v, dict)
+                        else walk(v))
+                    for k, v in node.items()}
+        return node
+
+    return walk(params)
 
 
 # OCP MXFP4 (e2m1) code points: 4-bit index -> value. Sign bit high, then 2-bit
@@ -139,18 +144,19 @@ def dequant_mxfp4(blocks, scales):
 
 def quantized_logical_axes(logical: Dict[str, Any], names: Sequence[str]
                            ) -> Dict[str, Any]:
-    """Transform a logical-axes tree to match a quantized param tree: each quantized
-    leaf's axes apply to ``q``; the scale keeps the output axis, contraction replaced
-    by None."""
+    """Transform a logical-axes tree to match a quantized param tree (recursively,
+    mirroring quantize_params): each quantized leaf's axes apply to ``q``; the scale
+    keeps the output axis, contraction replaced by None."""
+    nameset = set(names)
+
     def _q_axes(axes):
         return {"q": tuple(axes), "s": tuple(list(axes[:-2]) + [None, axes[-1]])}
 
-    out = dict(logical)
-    if "lm_head" in out and "lm_head" in names:
-        out["lm_head"] = _q_axes(out["lm_head"])
-    layers = dict(out["layers"])
-    for name in names:
-        if name in layers:
-            layers[name] = _q_axes(layers[name])
-    out["layers"] = layers
-    return out
+    def walk(node):
+        if isinstance(node, dict):
+            return {k: (_q_axes(v) if k in nameset and not isinstance(v, dict)
+                        else walk(v))
+                    for k, v in node.items()}
+        return node
+
+    return walk(logical)

@@ -122,19 +122,18 @@ class TpuModelForCausalLM:
         self._build_steps()
 
     @staticmethod
-    def _require_base_layout(tc: TpuConfig, family: str) -> None:
-        """Reject serving features that assume the base "layers" param/cache layout
-        (used by families with custom layouts, e.g. MLA/Llama4) — fail loudly at
-        construction rather than deep inside lax.scan tracing."""
+    def _require_base_layout(tc: TpuConfig, family: str,
+                             allow: Tuple[str, ...] = ()) -> None:
+        """Reject serving features a custom-layout family (e.g. MLA/Llama4) has not
+        implemented — fail loudly at construction rather than deep inside lax.scan
+        tracing. ``allow`` names features the family DOES support."""
         unsupported = [name for name, v in (
             ("lora_serving_config", tc.lora_serving_config),
             ("quantization_config", tc.quantization_config),
             ("speculation_config", tc.speculation_config),
-        ) if v is not None]
-        if tc.paged_attention_enabled:
-            unsupported.append("paged_attention_enabled")
-        if tc.is_continuous_batching:
-            unsupported.append("is_continuous_batching")
+            ("paged_attention_enabled", tc.paged_attention_enabled or None),
+            ("is_continuous_batching", tc.is_continuous_batching or None),
+        ) if v is not None and name not in allow]
         if unsupported:
             raise ValueError(f"{', '.join(unsupported)} not supported for the "
                              f"{family} family yet")
@@ -324,13 +323,19 @@ class TpuModelForCausalLM:
         q = self.tpu_config.quantization_config
         return q if (q is not None and q.quantize_weights) else None
 
+    def quantized_param_names(self):
+        """Param leaf names converted by weight quantization (overridable by families
+        with custom layouts, e.g. DeepSeek-MLA's absorbed projections)."""
+        from ..ops.quantization import DEFAULT_QUANTIZED_PARAMS
+
+        return DEFAULT_QUANTIZED_PARAMS
+
     def _param_shardings(self):
-        from ..ops.quantization import (DEFAULT_QUANTIZED_PARAMS,
-                                        quantized_logical_axes)
+        from ..ops.quantization import quantized_logical_axes
 
         logical = self.logical_axes()
         if self._quantization() is not None:
-            logical = quantized_logical_axes(logical, DEFAULT_QUANTIZED_PARAMS)
+            logical = quantized_logical_axes(logical, self.quantized_param_names())
         return tree_shardings(self.mesh, logical, self.sharding_rules)
 
     def load(self, model_path: Optional[str] = None) -> None:
@@ -408,7 +413,8 @@ class TpuModelForCausalLM:
             from ..ops.quantization import quantize_params
 
             # per-leaf: already-quantized leaves pass through (pre-quantized ckpts)
-            host_params = quantize_params(host_params, qcfg.weight_dtype)
+            host_params = quantize_params(host_params, qcfg.weight_dtype,
+                                          names=self.quantized_param_names())
         shardings = self._param_shardings()
         dtype = self.tpu_config.jax_dtype
 
@@ -438,6 +444,21 @@ class TpuModelForCausalLM:
             head_dim=a.head_dim,
             dtype=self.tpu_config.kv_cache_jax_dtype,
         )
+
+    def make_paged_cache(self, num_blocks: int, block_size: int):
+        """Sharded paged KV cache for continuous batching (overridable by families
+        with custom cache layouts, e.g. DeepSeek's latent cache)."""
+        from ..modules import block_kvcache
+
+        a = self.arch_args
+        spec = block_kvcache.PagedKVCacheSpec(
+            num_layers=a.num_layers, num_blocks=num_blocks, block_size=block_size,
+            num_kv_heads=a.num_kv_heads, head_dim=a.head_dim,
+            dtype=self.tpu_config.kv_cache_jax_dtype)
+        sharding = named_sharding(self.mesh, block_kvcache.PAGED_CACHE_LOGICAL,
+                                  self.sharding_rules)
+        return jax.tree.map(lambda x: jax.device_put(x, sharding),
+                            block_kvcache.init_paged_cache(spec))
 
     def reset_cache(self, batch_size: Optional[int] = None) -> None:
         """Fresh zero cache; ``batch_size`` overrides the compiled batch for

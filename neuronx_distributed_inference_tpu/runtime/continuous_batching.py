@@ -88,19 +88,12 @@ class ContinuousBatchingRunner:
             bs = cfg.pa_block_size
             self.block_size = bs
             self.max_blocks_per_seq = -(-cfg.seq_len // bs)
-            self.spec = block_kvcache.PagedKVCacheSpec(
-                num_layers=app.arch_args.num_layers, num_blocks=cfg.pa_num_blocks,
-                block_size=bs, num_kv_heads=app.arch_args.num_kv_heads,
-                head_dim=app.arch_args.head_dim, dtype=cfg.kv_cache_jax_dtype)
             # C++ engine when the toolchain permits (native/engine.cpp); Python
             # fallback keeps identical semantics (tests/test_native_engine.py)
             self.allocator = native_lib.make_block_allocator(
                 cfg.pa_num_blocks, bs, enable_prefix_caching=True)
-            sharding = named_sharding(app.mesh, block_kvcache.PAGED_CACHE_LOGICAL,
-                                      app.sharding_rules)
-            self.cache = jax.tree.map(
-                lambda x: jax.device_put(x, sharding),
-                block_kvcache.init_paged_cache(self.spec))
+            # family hook: custom cache layouts (e.g. DeepSeek latent) page too
+            self.cache = app.make_paged_cache(cfg.pa_num_blocks, bs)
             self.block_table = np.zeros((self.num_slots, self.max_blocks_per_seq),
                                         dtype=np.int32)
         else:
@@ -116,6 +109,10 @@ class ContinuousBatchingRunner:
         args, mesh, rules = app.arch_args, app.mesh, app.sharding_rules
         odsc = self.sampling_config
         precision = "highest" if self.cfg.dtype == "float32" else "default"
+        # family forward cores (custom layouts — MLA, Llama4 — serve through their
+        # own prefill/decode fns; the base family gets models/base.*)
+        prefill_core = app.prefill_fn()
+        decode_core = app.decode_fn()
 
         if self.paged:
             def _insert(params, input_ids, position_ids, last_token_idx, cache,
@@ -124,7 +121,7 @@ class ContinuousBatchingRunner:
                 queries are the (suffix) tokens; prior blocks are visible through the
                 block table."""
                 with jax.default_matmul_precision(precision):
-                    logits, cache = model_base.decode_forward(
+                    logits, cache = decode_core(
                         params, args, input_ids, position_ids, cache, None,
                         mesh=mesh, rules=rules, block_table=block_table_row,
                         slot_mapping=slot_mapping)
@@ -142,7 +139,7 @@ class ContinuousBatchingRunner:
                     tok, pos, cache = carry
                     step_key, slots_j = xs
                     with jax.default_matmul_precision(precision):
-                        logits, cache = model_base.decode_forward(
+                        logits, cache = decode_core(
                             params, args, tok[:, None], pos, cache, None,
                             mesh=mesh, rules=rules, block_table=block_table,
                             slot_mapping=slots_j)
@@ -166,7 +163,7 @@ class ContinuousBatchingRunner:
             def _insert(params, input_ids, position_ids, last_token_idx, cache,
                         slot, sampling_params, key):
                 with jax.default_matmul_precision(precision):
-                    logits, cache = model_base.prefill_forward(
+                    logits, cache = prefill_core(
                         params, args, input_ids, position_ids, last_token_idx, cache,
                         mesh=mesh, rules=rules, cache_batch_start=slot,
                         use_flash=use_flash, use_ring=use_ring)
@@ -180,7 +177,7 @@ class ContinuousBatchingRunner:
                 def body(carry, step_key):
                     tok, pos, cache = carry
                     with jax.default_matmul_precision(precision):
-                        logits, cache = model_base.decode_forward(
+                        logits, cache = decode_core(
                             params, args, tok[:, None], pos, cache, decode_bucket,
                             mesh=mesh, rules=rules)
                         nxt = sampling_ops.sample(logits[:, -1], sampling_params,
@@ -231,6 +228,11 @@ class ContinuousBatchingRunner:
             raise ValueError(f"prompt ({prompt.size}) + max_new_tokens "
                              f"({max_new_tokens}) exceeds seq_len {self.cfg.seq_len}")
         if not self.paged and prompt.size > self.app.cte_buckets[-1]:
+            if self.app.decode_fn() is not model_base.decode_forward:
+                raise ValueError(
+                    f"prompt ({prompt.size}) exceeds the largest context bucket "
+                    f"({self.app.cte_buckets[-1]}) and this family's custom decode "
+                    f"path has no dense windowed prefill")
             # dense windowed prefill rounds the prompt up to full windows; those
             # cache slots must exist
             w = self.app.cte_buckets[-1]

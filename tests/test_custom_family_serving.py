@@ -1,0 +1,103 @@
+"""Serving features x custom-layout families (DeepSeek-MLA, Llama4).
+
+Correctness bar (≈ reference quant flows `models/model_wrapper.py:11-21` and
+quantized model paths `models/llama/modeling_llama.py:626`): int8 weight-only
+quantization, continuous batching, and paged attention must work on the custom
+param/cache layouts — quantized logits stay close to the fp32 reference, and
+slot-served tokens match dedicated runs exactly.
+"""
+
+import numpy as np
+import pytest
+
+from neuronx_distributed_inference_tpu.config import (
+    QuantizationConfig, TpuConfig, load_pretrained_config)
+from neuronx_distributed_inference_tpu.models.deepseek import DeepseekForCausalLM
+from neuronx_distributed_inference_tpu.models.llama4 import Llama4ForCausalLM
+from neuronx_distributed_inference_tpu.runtime.continuous_batching import (
+    ContinuousBatchingRunner)
+
+DEEPSEEK_CFG = {
+    "model_type": "deepseek_v3", "vocab_size": 256, "hidden_size": 64,
+    "num_hidden_layers": 3, "num_attention_heads": 4, "intermediate_size": 128,
+    "kv_lora_rank": 16, "qk_rope_head_dim": 8, "qk_nope_head_dim": 16,
+    "v_head_dim": 16, "first_k_dense_replace": 1, "n_routed_experts": 4,
+    "num_experts_per_tok": 2, "moe_intermediate_size": 32, "n_shared_experts": 1,
+    "n_group": 2, "topk_group": 2, "rope_interleave": True,
+}
+
+LLAMA4_CFG = {
+    "model_type": "llama4_text", "vocab_size": 256, "hidden_size": 64,
+    "num_hidden_layers": 4, "num_attention_heads": 4, "num_key_value_heads": 2,
+    "intermediate_size": 64, "intermediate_size_mlp": 128, "num_local_experts": 4,
+    "interleave_moe_layer_step": 2, "attention_chunk_size": 16,
+    "rope_theta": 10000.0,
+}
+
+
+def _tpu_cfg(quant=False, cb=False, paged=False, dtype="float32"):
+    return TpuConfig(
+        batch_size=2, seq_len=96, max_context_length=32, dtype=dtype,
+        context_encoding_buckets=[16, 32], token_generation_buckets=[48, 96],
+        is_continuous_batching=cb, paged_attention_enabled=paged,
+        pa_num_blocks=48, pa_block_size=8,
+        quantization_config=(QuantizationConfig(quantize_weights=True,
+                                                weight_dtype="int8")
+                             if quant else None),
+    )
+
+
+def _make(app_cls, hf_cfg, **kw):
+    config = app_cls.get_config_cls()(
+        _tpu_cfg(**kw), load_config=load_pretrained_config(hf_cfg))
+    app = app_cls(None, config)
+    app.load_random(seed=0)
+    return app
+
+
+@pytest.mark.parametrize("app_cls,hf_cfg", [
+    (DeepseekForCausalLM, DEEPSEEK_CFG),
+    (Llama4ForCausalLM, LLAMA4_CFG),
+], ids=["deepseek", "llama4"])
+def test_quantized_logit_parity(app_cls, hf_cfg):
+    """int8 weight-only logits track the fp32 reference (same random weights)."""
+    rng = np.random.default_rng(0)
+    ids = rng.integers(1, 256, size=(2, 12)).astype(np.int32)
+    ref = _make(app_cls, hf_cfg)
+    want = ref.generate(ids, max_new_tokens=1, return_logits=True).logits[0]
+    q = _make(app_cls, hf_cfg, quant=True)
+    got = q.generate(ids, max_new_tokens=1, return_logits=True).logits[0]
+    # int8 per-channel quantization error bound, not bit-exactness
+    err = np.abs(got - want).max()
+    scale = np.abs(want).max()
+    assert err < 0.05 * scale + 0.05, f"quantized logits diverged: {err} vs {scale}"
+
+
+@pytest.mark.parametrize("app_cls,hf_cfg", [
+    (DeepseekForCausalLM, DEEPSEEK_CFG),
+    (Llama4ForCausalLM, LLAMA4_CFG),
+], ids=["deepseek", "llama4"])
+@pytest.mark.parametrize("paged", [False, True], ids=["dense", "paged"])
+def test_continuous_batching_matches_dedicated(app_cls, hf_cfg, paged):
+    rng = np.random.default_rng(1)
+    prompts = [rng.integers(1, 256, size=(n,)).astype(np.int32) for n in (12, 7, 19)]
+    plain = _make(app_cls, hf_cfg)
+    want = [plain.generate(p[None, :], max_new_tokens=8).tokens[0].tolist()
+            for p in prompts]
+    app = _make(app_cls, hf_cfg, cb=True, paged=paged)
+    runner = ContinuousBatchingRunner(app, decode_chunk=4)
+    ids = [runner.submit(p, max_new_tokens=8) for p in prompts]
+    results = runner.run_to_completion()
+    for rid, w in zip(ids, want):
+        assert results[rid] == w, f"{app_cls.__name__} paged={paged} diverged"
+
+
+def test_lora_still_rejected_for_custom_layouts():
+    from neuronx_distributed_inference_tpu.config import LoraServingConfig
+
+    cfg = _tpu_cfg()
+    cfg.lora_serving_config = LoraServingConfig(max_loras=1, max_lora_rank=4)
+    config = DeepseekForCausalLM.get_config_cls()(
+        cfg, load_config=load_pretrained_config(DEEPSEEK_CFG))
+    with pytest.raises(ValueError, match="lora_serving_config"):
+        DeepseekForCausalLM(None, config)
