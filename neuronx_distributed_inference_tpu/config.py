@@ -168,6 +168,9 @@ class TpuConfig:
     # latency — the TPU-native answer to the reference's async double-buffering
     decode_chunk_size: int = 32
     attention_kernel_enabled: Optional[bool] = None  # None = auto (TPU yes, CPU no)
+    # Pallas stacked-cache decode kernels (KV-write DMA + length-aware attention,
+    # ≈ reference TKG kernels); None = auto (TPU yes when the arch supports it)
+    decode_kernel_enabled: Optional[bool] = None
     async_mode: bool = False
     paged_attention_enabled: bool = False
     pa_num_blocks: int = 0

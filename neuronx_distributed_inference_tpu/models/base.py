@@ -325,6 +325,66 @@ def _mlp(lp: Params, args: ModelArchArgs, hn: jnp.ndarray, mesh, rules,
     return down
 
 
+def _sharded_kv_write(cache, new_kv, positions, layer_idx, mesh, rules):
+    """Stacked-cache decode KV write (Pallas DMA scatter) under the mesh.
+
+    ≈ the reference's batched KV write kernel (`modules/kvcache/utils.py:20-38`):
+    one strided DMA per batch row instead of the serial per-row while loop XLA
+    lowers a vmapped dynamic_update_slice to."""
+    from ..modules.kvcache import CACHE_LOGICAL
+    from ..ops.flash_decode import write_decode_stacked
+    from ..parallel.sharding import DEFAULT_RULES, logical_to_spec
+
+    interpret = jax.default_backend() == "cpu"
+
+    def _local(c, n, p, li):
+        return write_decode_stacked(c, n, p, li, interpret=interpret)
+
+    if mesh is None:
+        return _local(cache, new_kv, positions, layer_idx)
+    from jax.sharding import PartitionSpec as P
+
+    r = rules or DEFAULT_RULES
+    cache_spec = logical_to_spec(CACHE_LOGICAL, r)
+    new_spec = logical_to_spec(("decode_batch", "decode_kv_heads", None, None), r)
+    pos_spec = logical_to_spec(("decode_batch",), r)
+    fn = jax.shard_map(_local, mesh=mesh,
+                       in_specs=(cache_spec, new_spec, pos_spec, P()),
+                       out_specs=cache_spec, check_vma=False)
+    return fn(cache, new_kv, positions, layer_idx)
+
+
+def _sharded_decode_attend(q, k_cache, v_cache, positions, layer_idx, bucket,
+                           args: ModelArchArgs, mesh, rules):
+    """Stacked-cache decode attention (Pallas, length-aware) under the mesh.
+
+    ≈ the reference TKG attention kernels (`attention_base.py:1483-1677`): reads only
+    KV tiles at or below each row's position instead of the full bucket width."""
+    from ..modules.kvcache import CACHE_LOGICAL
+    from ..ops.flash_decode import flash_decode_attention_stacked
+    from ..parallel.sharding import DEFAULT_RULES, logical_to_spec
+
+    interpret = jax.default_backend() == "cpu"
+
+    def _local(q, kc, vc, p, li):
+        return flash_decode_attention_stacked(
+            q, kc, vc, p, li, bucket=bucket, scale=args.attention_scale,
+            window=args.sliding_window, interpret=interpret)
+
+    if mesh is None:
+        return _local(q, k_cache, v_cache, positions, layer_idx)
+    from jax.sharding import PartitionSpec as P
+
+    r = rules or DEFAULT_RULES
+    cache_spec = logical_to_spec(CACHE_LOGICAL, r)
+    q_spec = logical_to_spec(("decode_batch", "decode_heads", None, None), r)
+    pos_spec = logical_to_spec(("decode_batch",), r)
+    fn = jax.shard_map(_local, mesh=mesh,
+                       in_specs=(q_spec, cache_spec, cache_spec, pos_spec, P()),
+                       out_specs=q_spec, check_vma=False)
+    return fn(q, k_cache, v_cache, positions, layer_idx)
+
+
 def _sharded_flash_attention(q, k, v, args: ModelArchArgs, mesh, rules):
     """Run the Pallas flash kernel with heads local per shard.
 
@@ -373,6 +433,9 @@ def _decoder_layer(
     adapter_ids: Optional[jnp.ndarray] = None,   # (B,) multi-LoRA slots
     ring_positions: Optional[jnp.ndarray] = None,  # (B, S) positions -> ring attention
     window_row=None,   # traced scalar: dense windowed-prefill cache batch row
+    # traced scalar: decode over the STACKED cache via the Pallas kernels
+    # (k_cache/v_cache then carry the full (L, B, H, S, D) arrays)
+    stacked_layer_idx=None,
 ):
     resid = h
     hn = _norm(h, lp["ln1"], args)
@@ -394,6 +457,41 @@ def _decoder_layer(
         v = constrain(v, ("decode_batch", "decode_kv_heads", None, None), rules,
                       mesh=mesh)
     q, k = rope_ops.apply_rotary(q, k, cos, sin)
+
+    if stacked_layer_idx is not None:
+        # kernel decode path: the stacked cache is carried whole (never sliced or
+        # re-stacked by scan) — write the step's rows with a DMA scatter, then run
+        # the length-aware Pallas decode-attention kernel over this layer
+        k_cache = _sharded_kv_write(k_cache, k.astype(k_cache.dtype), positions,
+                                    stacked_layer_idx, mesh, rules)
+        v_cache = _sharded_kv_write(v_cache, v.astype(v_cache.dtype), positions,
+                                    stacked_layer_idx, mesh, rules)
+        attn = _sharded_decode_attend(q, k_cache, v_cache, positions,
+                                      stacked_layer_idx, decode_bucket, args,
+                                      mesh, rules)
+        attn = attn.transpose(0, 2, 1, 3).reshape(h.shape[0], h.shape[1], args.q_size)
+        attn_out = qapply(attn, lp["wo"])
+        if args.lora is not None:
+            attn_out = apply_lora(lp, "wo", attn, attn_out, adapter_ids,
+                                  args.lora.scaling)
+        if args.o_bias:
+            attn_out = attn_out + lp["bo"]
+        attn_out = constrain(attn_out, ("batch", None, None), rules, mesh=mesh)
+        if args.sandwich_norms:
+            attn_out = _norm(attn_out, lp["ln1_post"], args)
+        h = resid + attn_out
+
+        resid = h
+        hn = _norm(h, lp["ln2"], args)
+        if args.moe is not None:
+            ffn = moe_block(lp, args, hn, mesh, rules, _ACTIVATIONS[args.activation])
+        else:
+            ffn = _mlp(lp, args, hn, mesh, rules, adapter_ids)
+        mlp_out = constrain(ffn, ("batch", None, None), rules, mesh=mesh)
+        if args.sandwich_norms:
+            mlp_out = _norm(mlp_out, lp["ln2_post"], args)
+        h = resid + mlp_out
+        return h, k_cache, v_cache
 
     if paged is not None:
         # paged cache: scatter at flat slots; reads gather through the block table
@@ -530,6 +628,30 @@ def _run_stack(params: Params, args: ModelArchArgs, h, cos, sin, mask, cache,
     return h, {**cache, "k": k_new, "v": v_new}
 
 
+def _run_stack_decode_kernel(params: Params, args: ModelArchArgs, h, cos, sin, cache,
+                             positions, decode_bucket, mesh, rules, adapter_ids=None):
+    """Decode layer scan for the Pallas stacked-cache path.
+
+    The cache rides the scan as a CARRY (full stacked arrays, updated in place by the
+    aliased write kernel); only the layer params are scan xs. This removes the
+    per-layer cache slice (xs) and re-stack (ys) copies the generic _run_stack pays."""
+    L = args.num_layers
+
+    def body(carry, xs):
+        carry_h, ck, cv = carry
+        lp, li = xs
+        new_h, ck, cv = _decoder_layer(lp, args, carry_h, cos, sin, None, ck, cv,
+                                       positions, decode_bucket, mesh, rules,
+                                       adapter_ids=adapter_ids,
+                                       stacked_layer_idx=li)
+        return (new_h, ck, cv), ()
+
+    (h, k_new, v_new), _ = jax.lax.scan(
+        body, (h, cache["k"], cache["v"]),
+        (params["layers"], jnp.arange(L, dtype=jnp.int32)))
+    return h, {**cache, "k": k_new, "v": v_new}
+
+
 def _embed(params: Params, args: ModelArchArgs, input_ids, mesh, rules):
     h = jnp.take(params["embed"], input_ids, axis=0)
     if args.embedding_multiplier != 1.0:
@@ -637,6 +759,7 @@ def decode_forward(
     tree: Optional[Tuple[np.ndarray, np.ndarray]] = None,  # (depths (T,), ancestor (T,T))
     return_hidden: bool = False,  # also return the final normed hidden states (B, T, H)
     window_row=None,  # traced scalar: dense windowed prefill at this cache batch row
+    use_kernel: bool = False,  # static: Pallas stacked-cache decode (hot path)
 ) -> Tuple[jnp.ndarray, kvcache.KVCache]:
     """Token generation: returns (logits (B, T, V) fp32, updated cache).
 
@@ -676,6 +799,21 @@ def decode_forward(
         rope_pos = pos_grid + cache["rope_delta"][:, None]
     cos, sin = rope_ops.compute_cos_sin(params["rope_inv_freq"], rope_pos,
                                         args.rope_attention_scaling)
+    if use_kernel:
+        if tree is not None or paged is not None or window_row is not None:
+            raise ValueError("use_kernel supports plain chain decode only")
+        if args.layer_pattern is not None or args.attn_sinks or \
+                args.logits_soft_cap is not None:
+            raise ValueError("use_kernel does not support this architecture")
+        h, cache = _run_stack_decode_kernel(
+            params, args, h, cos, sin, cache, positions=position_ids,
+            decode_bucket=decode_bucket, mesh=mesh, rules=rules,
+            adapter_ids=adapter_ids)
+        h = _norm(h, params["final_norm"], args)
+        logits = _lm_head(params, args, h, mesh, rules)
+        if return_hidden:
+            return logits, cache, h
+        return logits, cache
     kv_pos = jnp.arange(decode_bucket)[None, None, None, :]
     q_pos = pos_grid[:, None, :, None]
     if tree is None:

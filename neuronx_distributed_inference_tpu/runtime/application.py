@@ -190,6 +190,7 @@ class TpuModelForCausalLM:
         rules = self.sharding_rules
         use_ring = self._use_ring_attention()
         use_flash = (not use_ring) and self._use_flash_attention()
+        use_decode_kernel = self._use_decode_kernel()
 
         def _prefill(params, input_ids, position_ids, last_token_idx, cache,
                      sampling_params, key, adapter_ids=None):
@@ -216,12 +217,14 @@ class TpuModelForCausalLM:
             """
             keys = jax.random.split(key, num_steps)
 
+            kernel_kw = {"use_kernel": True} if use_decode_kernel else {}
+
             def body(carry, step_key):
                 tok, pos, cache = carry
                 with jax.default_matmul_precision(precision):
                     logits, cache = decode_core(params, args, tok[:, None], pos, cache,
                                                 decode_bucket, mesh=mesh, rules=rules,
-                                                adapter_ids=adapter_ids)
+                                                adapter_ids=adapter_ids, **kernel_kw)
                     last = logits[:, -1, :]
                     if greedy:
                         nxt = sampling_ops.greedy(last)
@@ -289,6 +292,40 @@ class TpuModelForCausalLM:
                 raise ValueError(
                     f"context bucket {bucket} not divisible by cp_degree {cp}")
         return True
+
+    def _use_decode_kernel(self) -> bool:
+        """Auto-select the Pallas stacked-cache decode path (KV-write DMA scatter +
+        length-aware decode attention, ≈ reference TKG kernel selection,
+        `attention_base.py:1483-1677`): explicit config wins; otherwise on for TPU
+        backends for architectures the kernel supports."""
+        a = self.arch_args
+        cfg = self.tpu_config.decode_kernel_enabled
+        unsupported = None
+        if self.decode_fn() is not model_base.decode_forward:
+            unsupported = "custom decode paths"
+        elif a.logits_soft_cap is not None:
+            unsupported = "logits_soft_cap"
+        elif a.attn_sinks:
+            unsupported = "attention sinks"
+        elif a.layer_pattern is not None:
+            unsupported = "per-layer attention patterns"
+        elif self.tpu_config.paged_attention_enabled:
+            unsupported = "paged attention"
+        elif a.head_dim % 128 != 0 and jax.default_backend() != "cpu":
+            # the KV-write DMA slices the cache's minor dim, which Mosaic requires
+            # aligned to the 128-lane tiling (interpret mode on CPU is unconstrained)
+            unsupported = "head_dim not a multiple of 128"
+        if cfg is not None:
+            if cfg and unsupported is not None:
+                raise ValueError(f"decode_kernel_enabled=True but the decode kernel "
+                                 f"does not support {unsupported}")
+            return cfg
+        if unsupported is not None:
+            return False
+        tp = self.mesh.shape["tp"]
+        if a.num_heads % tp != 0 or a.num_kv_heads % tp != 0:
+            return False
+        return jax.default_backend() not in ("cpu",)
 
     def _use_flash_attention(self) -> bool:
         """Auto-select the Pallas prefill kernel (≈ reference

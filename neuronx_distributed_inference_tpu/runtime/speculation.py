@@ -138,6 +138,10 @@ class FusedSpeculativeModel:
         vocab = t_args.vocab_size
         precision = ("highest" if self.target.tpu_config.dtype == "float32"
                      else "default")
+        # Pallas stacked-cache decode for both models when supported (the draft
+        # chain and the wide verify are both plain chain decodes)
+        t_kernel = {"use_kernel": True} if self.target._use_decode_kernel() else {}
+        d_kernel = {"use_kernel": True} if self.draft._use_decode_kernel() else {}
 
         def _step(t_params, d_params, last_tok, positions, t_cache, d_cache,
                   sampling_params, key, decode_bucket):
@@ -162,7 +166,7 @@ class FusedSpeculativeModel:
                 with jax.default_matmul_precision(precision):
                     logits, cache = model_base.decode_forward(
                         d_params, d_args, tok[:, None], pos, cache, decode_bucket,
-                        mesh=d_mesh, rules=d_rules)
+                        mesh=d_mesh, rules=d_rules, **d_kernel)
                 last = logits[:, -1]
                 if greedy:
                     nxt = jnp.argmax(last, axis=-1).astype(jnp.int32)
@@ -180,7 +184,7 @@ class FusedSpeculativeModel:
             with jax.default_matmul_precision(precision):
                 t_logits, t_cache = model_base.decode_forward(
                     t_params, t_args, target_in, positions, t_cache, decode_bucket,
-                    mesh=mesh, rules=rules)              # (B, K, V)
+                    mesh=mesh, rules=rules, **t_kernel)  # (B, K, V)
 
             if greedy:
                 t_toks = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)  # (B, K)
