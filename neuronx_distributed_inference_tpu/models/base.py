@@ -436,6 +436,9 @@ def _decoder_layer(
     # traced scalar: decode over the STACKED cache via the Pallas kernels
     # (k_cache/v_cache then carry the full (L, B, H, S, D) arrays)
     stacked_layer_idx=None,
+    # (B,) true row lengths: prefill writes into a rolling window cache (the layer's
+    # cache stack is W wide; see kvcache.write_prefill_rolling)
+    rolling_lengths: Optional[jnp.ndarray] = None,
 ):
     resid = h
     hn = _norm(h, lp["ln1"], args)
@@ -522,9 +525,18 @@ def _decoder_layer(
         # prefill: cache write at [0, S), attend over the fresh (unpadded-bucket) k/v.
         # The cache keeps its decode layout (≈ the reference's CP-prefill -> DP/TP-
         # decode KV handover, `kv_cache_manager.py:469-486` — GSPMD reshards at the
-        # write instead of remapping kv-head indices by hand).
-        k_cache = kvcache.write_prefill(k_cache, k, batch_start=cache_batch_start)
-        v_cache = kvcache.write_prefill(v_cache, v, batch_start=cache_batch_start)
+        # write instead of remapping kv-head indices by hand). Rolling (sliding-
+        # window) layers keep only each row's last W tokens at modular slots.
+        if rolling_lengths is not None:
+            k_cache = kvcache.write_prefill_rolling(
+                k_cache, k, rolling_lengths, batch_start=cache_batch_start)
+            v_cache = kvcache.write_prefill_rolling(
+                v_cache, v, rolling_lengths, batch_start=cache_batch_start)
+        else:
+            k_cache = kvcache.write_prefill(k_cache, k,
+                                            batch_start=cache_batch_start)
+            v_cache = kvcache.write_prefill(v_cache, v,
+                                            batch_start=cache_batch_start)
         k_cache = constrain(k_cache, kvcache.CACHE_LOGICAL[1:], rules, mesh=mesh)
         v_cache = constrain(v_cache, kvcache.CACHE_LOGICAL[1:], rules, mesh=mesh)
         k_att, v_att = k, v
@@ -578,33 +590,14 @@ def _decoder_layer(
 
 def _run_stack(params: Params, args: ModelArchArgs, h, cos, sin, mask, cache,
                positions, decode_bucket, mesh, rules, use_flash=False,
-               local_rope_mask=None, paged=None, cache_batch_start=0,
+               paged=None, cache_batch_start=0,
                adapter_ids=None, ring_positions=None, window_row=None):
-    """Scan the decoder layers, carrying hidden state, yielding updated cache.
-
-    ``local_rope_mask`` (set when args.layer_pattern is not None) is a triple
-    (cos_local, sin_local, mask_local): sliding layers select it inside the scan body
-    via a per-layer boolean scanned alongside the stacked params, keeping the layer
-    computation uniform (scan-compatible) while gemma3-style local/global layers differ
-    in both RoPE theta and attention window.
-    """
+    """Scan the decoder layers, carrying hidden state, yielding updated cache."""
     xs = (params["layers"], cache["k"], cache["v"])
-    if local_rope_mask is not None:
-        cos_l, sin_l, mask_l = local_rope_mask
-        is_sliding = jnp.asarray(
-            [kind == "sliding" for kind in args.layer_pattern], dtype=bool)
-        xs = xs + (is_sliding,)
 
     def body(carry_h, layer_xs):
-        if local_rope_mask is None:
-            lp, kc, vc = layer_xs
-            cos_i, sin_i, mask_i = cos, sin, mask
-        else:
-            lp, kc, vc, slide = layer_xs
-            cos_i = jnp.where(slide, cos_l, cos)
-            sin_i = jnp.where(slide, sin_l, sin)
-            mask_i = jnp.where(slide, mask_l, mask)
-        new_h, kc, vc = _decoder_layer(lp, args, carry_h, cos_i, sin_i, mask_i, kc, vc,
+        lp, kc, vc = layer_xs
+        new_h, kc, vc = _decoder_layer(lp, args, carry_h, cos, sin, mask, kc, vc,
                                        positions, decode_bucket, mesh, rules,
                                        use_flash=use_flash, paged=paged,
                                        cache_batch_start=cache_batch_start,
@@ -626,6 +619,88 @@ def _run_stack(params: Params, args: ModelArchArgs, h, cos, sin, mask, cache,
         tap("hidden_stack", ys[2])      # (L, B, S, H) per-layer hidden states
     # preserve auxiliary cache entries (e.g. M-RoPE rope_delta) alongside k/v
     return h, {**cache, "k": k_new, "v": v_new}
+
+
+def _segment_runs(flags: Tuple[bool, ...]):
+    """Contiguous runs of equal flag: [(flag, global_start, run_len, kind_local_start)]
+    — the scan grouping for per-layer attention patterns (same shape as the llama4
+    dense/MoE interleave)."""
+    runs = []
+    counts = {True: 0, False: 0}
+    i = 0
+    while i < len(flags):
+        j = i
+        while j < len(flags) and flags[j] == flags[i]:
+            j += 1
+        runs.append((flags[i], i, j - i, counts[flags[i]]))
+        counts[flags[i]] += j - i
+        i = j
+    return runs
+
+
+def _run_stack_pattern(params: Params, args: ModelArchArgs, h, ctx_full, ctx_slide,
+                       cache, positions, decode_bucket, mesh, rules,
+                       use_flash=False, cache_batch_start=0, adapter_ids=None,
+                       true_lengths=None):
+    """Layer scan for per-layer attention patterns (gemma3/gpt-oss sliding/full
+    interleave): contiguous same-kind runs are scanned together, each against its own
+    cache stack — full layers over the (L_full, B, H, S_max, D) stack, sliding layers
+    over the **rolling** (L_slide, B, H, W, D) stack with modular positions. Each
+    run's RoPE tables / mask / window are static, ≈ the reference's per-layer cache
+    sizes + SWA masks (`kv_cache_manager.py:199-237`, `model_base.py:287-363`).
+
+    ctx_full / ctx_slide: (cos, sin, mask) for each kind. ``true_lengths`` drives the
+    rolling prefill write (which keeps only each row's last W tokens)."""
+    import dataclasses as _dc
+
+    flags = tuple(kind == "sliding" for kind in args.layer_pattern)
+    runs = _segment_runs(flags)
+    w_alloc = cache["k_sliding"].shape[3]
+    args_full = _dc.replace(args, sliding_window=None, layer_pattern=None)
+    args_slide = _dc.replace(args, layer_pattern=None)
+    parts = {True: [], False: []}      # per-kind (k_run, v_run) in kind-local order
+
+    for is_slide, g0, n, l0 in runs:
+        stack = jax.tree.map(lambda x: x[g0 : g0 + n], params["layers"])
+        if is_slide:
+            a_run = args_slide
+            cos_i, sin_i, mask_i = ctx_slide
+            kc_stack = cache["k_sliding"][l0 : l0 + n]
+            vc_stack = cache["v_sliding"][l0 : l0 + n]
+            pos_run = positions % w_alloc if positions is not None else None
+            bucket_run = w_alloc if positions is not None else None
+            rl = true_lengths if positions is None else None
+        else:
+            a_run = args_full
+            cos_i, sin_i, mask_i = ctx_full
+            kc_stack = cache["k"][l0 : l0 + n]
+            vc_stack = cache["v"][l0 : l0 + n]
+            pos_run = positions
+            bucket_run = decode_bucket
+            rl = None
+
+        def body(carry_h, layer_xs, _a=a_run, _cos=cos_i, _sin=sin_i, _mask=mask_i,
+                 _pos=pos_run, _bucket=bucket_run, _rl=rl):
+            lp, kc, vc = layer_xs
+            nh, kc, vc = _decoder_layer(lp, _a, carry_h, _cos, _sin, _mask, kc, vc,
+                                        _pos, _bucket, mesh, rules,
+                                        use_flash=use_flash,
+                                        cache_batch_start=cache_batch_start,
+                                        adapter_ids=adapter_ids,
+                                        rolling_lengths=_rl)
+            return nh, (kc, vc)
+
+        h, (ks, vs) = jax.lax.scan(body, h, (stack, kc_stack, vc_stack))
+        parts[is_slide].append((ks, vs))
+
+    out = dict(cache)
+    if parts[False]:
+        out["k"] = jnp.concatenate([p[0] for p in parts[False]], axis=0)
+        out["v"] = jnp.concatenate([p[1] for p in parts[False]], axis=0)
+    if parts[True]:
+        out["k_sliding"] = jnp.concatenate([p[0] for p in parts[True]], axis=0)
+        out["v_sliding"] = jnp.concatenate([p[1] for p in parts[True]], axis=0)
+    return h, out
 
 
 def _run_stack_decode_kernel(params: Params, args: ModelArchArgs, h, cos, sin, cache,
@@ -716,13 +791,26 @@ def prefill_forward(
     q_pos = position_ids[:, None, :, None]
     sliding = (jnp.logical_and(mask, kv_pos > q_pos - args.sliding_window)
                if args.sliding_window is not None else None)
-    local_rope_mask = None
     if args.layer_pattern is not None:
+        if slot_mapping is not None or use_ring:
+            raise ValueError("paged/ring prefill is not supported for per-layer "
+                             "attention patterns (rolling sliding caches)")
         inv_local = params.get("rope_inv_freq_local", params["rope_inv_freq"])
         cos_l, sin_l = rope_ops.compute_cos_sin(inv_local, position_ids,
                                                 args.local_rope_attention_scaling)
-        local_rope_mask = (cos_l, sin_l, sliding if sliding is not None else mask)
-    elif sliding is not None:
+        h, cache = _run_stack_pattern(
+            params, args, h, (cos, sin, mask),
+            (cos_l, sin_l, sliding if sliding is not None else mask), cache,
+            positions=None, decode_bucket=None, mesh=mesh, rules=rules,
+            use_flash=use_flash, cache_batch_start=cache_batch_start,
+            adapter_ids=adapter_ids, true_lengths=last_token_idx + 1)
+        h = tap("final_hidden", _norm(h, params["final_norm"], args))
+        h_last = jnp.take_along_axis(h, last_token_idx[:, None, None], axis=1)[:, 0]
+        logits = tap("logits", _lm_head(params, args, h_last, mesh, rules))
+        if return_hidden:
+            return logits, cache, h
+        return logits, cache
+    if sliding is not None:
         mask = sliding
 
     paged = None
@@ -732,7 +820,7 @@ def prefill_forward(
         h = constrain(h, ("batch", "seq", None), rules, mesh=mesh)
     h, cache = _run_stack(params, args, h, cos, sin, mask, cache,
                           positions=None, decode_bucket=None, mesh=mesh, rules=rules,
-                          use_flash=use_flash, local_rope_mask=local_rope_mask,
+                          use_flash=use_flash,
                           paged=paged, cache_batch_start=cache_batch_start,
                           adapter_ids=adapter_ids,
                           ring_positions=position_ids if use_ring else None)
@@ -832,18 +920,35 @@ def decode_forward(
         mask = committed | (in_tree & tree_vis)
     sliding = (jnp.logical_and(mask, kv_pos > q_pos - args.sliding_window)
                if args.sliding_window is not None else None)
-    local_rope_mask = None
     if args.layer_pattern is not None:
+        if tree is not None or paged is not None or window_row is not None:
+            raise ValueError("tree/paged/windowed decode is not supported for "
+                             "per-layer attention patterns (rolling sliding caches)")
+        w_alloc = cache["k_sliding"].shape[3]
+        if t > 1 and w_alloc < cache["k"].shape[3]:
+            raise ValueError("multi-token decode over a rolling sliding cache is "
+                             "not supported (slots written this step would alias "
+                             "older positions in the mask)")
         inv_local = params.get("rope_inv_freq_local", params["rope_inv_freq"])
         cos_l, sin_l = rope_ops.compute_cos_sin(inv_local, pos_grid,
                                                 args.local_rope_attention_scaling)
-        local_rope_mask = (cos_l, sin_l, sliding if sliding is not None else mask)
-    elif sliding is not None:
+        window = args.sliding_window if args.sliding_window is not None else w_alloc
+        mask_slide = kvcache.rolling_mask(position_ids, t, w_alloc, window)
+        h, cache = _run_stack_pattern(
+            params, args, h, (cos, sin, mask), (cos_l, sin_l, mask_slide), cache,
+            positions=position_ids, decode_bucket=decode_bucket, mesh=mesh,
+            rules=rules, adapter_ids=adapter_ids)
+        h = _norm(h, params["final_norm"], args)
+        logits = _lm_head(params, args, h, mesh, rules)
+        if return_hidden:
+            return logits, cache, h
+        return logits, cache
+    if sliding is not None:
         mask = sliding
 
     h, cache = _run_stack(params, args, h, cos, sin, mask, cache,
                           positions=position_ids, decode_bucket=decode_bucket,
-                          mesh=mesh, rules=rules, local_rope_mask=local_rope_mask,
+                          mesh=mesh, rules=rules,
                           paged=paged, adapter_ids=adapter_ids,
                           window_row=window_row)
     h = _norm(h, params["final_norm"], args)

@@ -15,9 +15,9 @@ redesign mirrors that split:
 - Every decoder layer is (self-attn, cross-attn, mlp), uniform, so one `lax.scan`
   covers the stack.
 - Greedy decode runs as an on-device `lax.scan` chunk like the causal-LM app.
-
-Weights stay replicated in round 1 (Whisper-large is ~1.5B params; shard via the
-logical-axes hook when profiling justifies)."""
+- Sharding: attention heads and MLP widths carry tp logical axes (batch on dp);
+  weights/caches are device_put with the resulting NamedShardings and GSPMD inserts
+  the collectives — same recipe as the causal-LM families."""
 
 from __future__ import annotations
 
@@ -174,9 +174,12 @@ class WhisperForConditionalGeneration:
     `modeling_whisper.py:432-491`)."""
 
     def __init__(self, model_path: Optional[str], config: WhisperInferenceConfig):
+        from ...parallel import mesh as mesh_lib
+
         self.model_path = model_path
         self.config = config
         self.tpu_config: TpuConfig = config.tpu_config
+        self.mesh = mesh_lib.mesh_from_config(self.tpu_config)
         self.enc_params = None
         self.dec_params = None
         enc_heads = config.encoder_attention_heads
@@ -217,18 +220,55 @@ class WhisperForConditionalGeneration:
         state_dict = ckpt_lib.load_state_dict(path)
         self.load_from_state_dict(state_dict)
 
-    def load_from_state_dict(self, state_dict) -> None:
-        enc, dec = self.convert_hf_state_dict(state_dict, self.config)
+    @staticmethod
+    def _attn_axes(prefix):
+        return {
+            prefix + "wq": ("layers", "embed", "heads"),
+            prefix + "bq": ("layers", "heads"),
+            prefix + "wk": ("layers", "embed", "heads"),
+            prefix + "wv": ("layers", "embed", "heads"),
+            prefix + "bv": ("layers", "heads"),
+            prefix + "wo": ("layers", "heads", "embed"),
+            prefix + "bo": ("layers", None),
+        }
+
+    @classmethod
+    def _layer_axes(cls, cross: bool):
+        axes = {
+            "ln1_w": ("layers", None), "ln1_b": ("layers", None),
+            "ln2_w": ("layers", None), "ln2_b": ("layers", None),
+            "fc1": ("layers", "embed", "mlp"), "b1": ("layers", "mlp"),
+            "fc2": ("layers", "mlp", "embed"), "b2": ("layers", None),
+        }
+        axes.update(cls._attn_axes("attn_"))
+        if cross:
+            axes.update(cls._attn_axes("xattn_"))
+            axes.update({"xln_w": ("layers", None), "xln_b": ("layers", None)})
+        return axes
+
+    def _shard(self, params, layer_axes):
+        """device_put with tp/dp NamedShardings from the logical axes (replicated for
+        leaves without an entry)."""
+        from ...parallel.sharding import named_sharding
+
         dtype = self.tpu_config.jax_dtype
 
-        def _put(x):
+        def _put(x, axes):
             arr = np.asarray(x)
             if arr.dtype.kind == "f":
                 arr = arr.astype(dtype)
-            return jax.device_put(arr)
+            logical = axes if axes is not None else (None,) * arr.ndim
+            return jax.device_put(arr, named_sharding(self.mesh, logical))
 
-        self.enc_params = jax.tree.map(_put, enc)
-        self.dec_params = jax.tree.map(_put, dec)
+        out = {k: _put(v, None) for k, v in params.items() if k != "layers"}
+        out["layers"] = {k: _put(v, layer_axes.get(k))
+                         for k, v in params["layers"].items()}
+        return out
+
+    def load_from_state_dict(self, state_dict) -> None:
+        enc, dec = self.convert_hf_state_dict(state_dict, self.config)
+        self.enc_params = self._shard(enc, self._layer_axes(cross=False))
+        self.dec_params = self._shard(dec, self._layer_axes(cross=True))
 
     @classmethod
     def from_pretrained(cls, model_path: str, tpu_config: TpuConfig):
@@ -321,17 +361,23 @@ class WhisperForConditionalGeneration:
                                                         dtype=np.float32))
 
     def _init_cache(self, b: int, t_enc: int):
+        from ...parallel.sharding import named_sharding
+
         c = self.config
         heads = c.decoder_attention_heads
         d = c.d_model // heads
         L = c.decoder_layers
         S = self.tpu_config.seq_len
         dtype = self.tpu_config.jax_dtype
+        sharding = named_sharding(self.mesh,
+                                  ("layers", "batch", "heads", None, None))
         return {
-            "k": jnp.zeros((L, b, heads, S, d), dtype=dtype),
-            "v": jnp.zeros((L, b, heads, S, d), dtype=dtype),
-            "xk": jnp.zeros((L, b, heads, t_enc, d), dtype=dtype),
-            "xv": jnp.zeros((L, b, heads, t_enc, d), dtype=dtype),
+            "k": jax.device_put(jnp.zeros((L, b, heads, S, d), dtype=dtype), sharding),
+            "v": jax.device_put(jnp.zeros((L, b, heads, S, d), dtype=dtype), sharding),
+            "xk": jax.device_put(jnp.zeros((L, b, heads, t_enc, d), dtype=dtype),
+                                 sharding),
+            "xv": jax.device_put(jnp.zeros((L, b, heads, t_enc, d), dtype=dtype),
+                                 sharding),
         }
 
     def generate(self, input_features: np.ndarray,

@@ -100,6 +100,75 @@ def write_decode(cache_layer: jnp.ndarray, new_kv: jnp.ndarray,
     return jax.vmap(_one)(cache_layer, new_kv, positions)
 
 
+def init_cache_pattern(spec: KVCacheSpec, pattern, window: int) -> KVCache:
+    """Dual-stack cache for per-layer attention patterns (gemma3/gpt-oss alternating
+    sliding/full layers): full-attention layers get a (L_full, B, H, S_max, D) stack,
+    sliding layers a **window-sized rolling** (L_sliding, B, H, W, D) stack — at long
+    seq_len this is the difference between fitting and OOM (≈ reference per-layer
+    cache sizes, `modules/kvcache/kv_cache_manager.py:199-237`)."""
+    import dataclasses as _dc
+
+    n_full = sum(1 for kind in pattern if kind != "sliding")
+    n_slide = len(pattern) - n_full
+    w = rolling_width(spec.max_seq_len, window)
+    full = _dc.replace(spec, num_layers=max(n_full, 1))
+    slide = _dc.replace(spec, num_layers=max(n_slide, 1), max_seq_len=w)
+    return {
+        "k": jnp.zeros(full.shape, dtype=spec.dtype),
+        "v": jnp.zeros(full.shape, dtype=spec.dtype),
+        "k_sliding": jnp.zeros(slide.shape, dtype=spec.dtype),
+        "v_sliding": jnp.zeros(slide.shape, dtype=spec.dtype),
+    }
+
+
+def rolling_width(max_seq_len: int, window: int) -> int:
+    """Allocated width of a rolling sliding-window cache."""
+    return min(max_seq_len, window)
+
+
+def write_prefill_rolling(cache_layer: jnp.ndarray, new_kv: jnp.ndarray,
+                          true_lengths: jnp.ndarray, batch_start=0) -> jnp.ndarray:
+    """Prefill write into a rolling (B, H, W, D) cache: slot j receives the row's
+    newest token at a position ≡ j (mod W) — i.e. the last min(l, W) tokens land at
+    their positions' modular slots, preserving the rolling invariant decode relies
+    on (slot j holds the LARGEST written position congruent to j).
+
+    new_kv (B, H, S, D) holds the bucket's keys; true_lengths (B,) the row's real
+    token count l (padded tail tokens are junk and must not land in slots).
+    ``batch_start`` lands the write at cache rows [batch_start, batch_start+B)
+    (continuous-batching insert).
+    """
+    w = cache_layer.shape[2]
+    s = new_kv.shape[2]
+    b = new_kv.shape[0]
+    slots = jnp.arange(w)[None, :]                       # (1, W)
+    last = true_lengths[:, None] - 1                     # (B, 1)
+    # largest q <= last with q % W == j; negative -> row never wrote that slot
+    q = last - (last - slots) % w                        # (B, W)
+    gather_idx = jnp.clip(q, 0, s - 1)
+    gathered = jnp.take_along_axis(
+        new_kv, gather_idx[:, None, :, None].astype(jnp.int32), axis=2)
+    keep = (q >= 0)[:, None, :, None]
+    rows = jax.lax.dynamic_slice_in_dim(cache_layer, batch_start, b, axis=0)
+    updated = jnp.where(keep, gathered.astype(cache_layer.dtype), rows)
+    return jax.lax.dynamic_update_slice_in_dim(cache_layer, updated, batch_start,
+                                               axis=0)
+
+
+def rolling_mask(positions: jnp.ndarray, t: int, w: int, window: int
+                 ) -> jnp.ndarray:
+    """Decode mask over a rolling cache's W slots.
+
+    positions (B,): write position of the step's first token. After the step's
+    writes at (pos + i) % W, slot j holds the key of position
+    q_j = p_i - ((p_i - j) mod W) for query token i at p_i = positions + i; the
+    mask admits slots with 0 <= q_j > p_i - window. Returns (B, 1, T, W) bool."""
+    slots = jnp.arange(w)[None, None, None, :]
+    q_pos = (positions[:, None] + jnp.arange(t)[None, :])[:, None, :, None]
+    held = q_pos - (q_pos - slots) % w
+    return (held >= 0) & (held > q_pos - window)
+
+
 def batched_gather(cache: KVCache, seq_ids: jnp.ndarray) -> KVCache:
     """Reorder the batch dim by seq_ids (continuous batching batch remap,
     ≈ `model_wrapper.py:569-698` batch sorting)."""

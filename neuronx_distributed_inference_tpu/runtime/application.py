@@ -339,10 +339,6 @@ class TpuModelForCausalLM:
             unsupported = "logits_soft_cap"
         elif a.attn_sinks:
             unsupported = "attention sinks"
-        elif a.layer_pattern is not None:
-            # per-layer window/rope selection happens inside the scan; the Pallas
-            # kernel's window is static per call, so fall back to the jnp path
-            unsupported = "per-layer attention pattern (layer_pattern)"
         if cfg is not None:
             if cfg and unsupported is not None:
                 raise ValueError(
@@ -507,8 +503,14 @@ class TpuModelForCausalLM:
             spec = _dc.replace(spec, batch_size=batch_size)
         sharding = named_sharding(self.mesh, kvcache.CACHE_LOGICAL,
                                   self.sharding_rules)
-        self.kv_cache = jax.tree.map(
-            lambda x: jax.device_put(x, sharding), kvcache.init_cache(spec))
+        a = self.arch_args
+        if a.layer_pattern is not None:
+            # dual-stack cache: rolling window-sized stacks for sliding layers
+            host = kvcache.init_cache_pattern(spec, a.layer_pattern,
+                                              a.sliding_window or spec.max_seq_len)
+        else:
+            host = kvcache.init_cache(spec)
+        self.kv_cache = jax.tree.map(lambda x: jax.device_put(x, sharding), host)
 
     # --- warmup (≈ `application_base.py:348-372`) -------------------------------------
     def warmup(self) -> None:
@@ -662,11 +664,12 @@ class TpuModelForCausalLM:
         max_prompt = (int(np.asarray(attention_mask).sum(axis=1).max())
                       if attention_mask is not None else input_ids.shape[1])
         windowed = max_prompt > self.cte_buckets[-1]
-        if windowed and self.decode_fn() is not model_base.decode_forward:
+        if windowed and (self.decode_fn() is not model_base.decode_forward
+                         or self.arch_args.layer_pattern is not None):
             raise ValueError(
                 f"prompt ({max_prompt}) exceeds the largest context bucket "
-                f"({self.cte_buckets[-1]}) and this family's custom decode path has "
-                f"no dense windowed prefill")
+                f"({self.cte_buckets[-1]}) and this family has no dense windowed "
+                f"prefill (custom decode path or rolling sliding caches)")
         padded = model_wrapper.pad_prefill_inputs(
             input_ids, attention_mask,
             self.cte_buckets if not windowed else [self.cte_buckets[-1]],

@@ -65,6 +65,9 @@ class ContinuousBatchingRunner:
         self.app = app
         self.cfg = cfg
         self.paged = cfg.paged_attention_enabled
+        if self.paged and app.arch_args.layer_pattern is not None:
+            raise ValueError("paged attention is not supported for per-layer "
+                             "attention patterns (rolling sliding caches)")
         self.num_slots = cfg.max_batch_size
         self.decode_chunk = decode_chunk or min(8, max(1, cfg.decode_chunk_size))
         self.sampling_config = app.sampling_config
@@ -228,11 +231,12 @@ class ContinuousBatchingRunner:
             raise ValueError(f"prompt ({prompt.size}) + max_new_tokens "
                              f"({max_new_tokens}) exceeds seq_len {self.cfg.seq_len}")
         if not self.paged and prompt.size > self.app.cte_buckets[-1]:
-            if self.app.decode_fn() is not model_base.decode_forward:
+            if (self.app.decode_fn() is not model_base.decode_forward
+                    or self.app.arch_args.layer_pattern is not None):
                 raise ValueError(
                     f"prompt ({prompt.size}) exceeds the largest context bucket "
-                    f"({self.app.cte_buckets[-1]}) and this family's custom decode "
-                    f"path has no dense windowed prefill")
+                    f"({self.app.cte_buckets[-1]}) and this family has no dense "
+                    f"windowed prefill")
             # dense windowed prefill rounds the prompt up to full windows; those
             # cache slots must exist
             w = self.app.cte_buckets[-1]

@@ -1,0 +1,92 @@
+"""Rolling (window-sized) KV caches for per-layer attention patterns.
+
+Correctness bar (≈ reference per-layer cache sizes,
+`modules/kvcache/kv_cache_manager.py:199-237`): sliding layers must allocate only
+window-sized cache stacks — at 128k context this is the difference between fitting
+and OOM — while HF token parity holds across the rolling boundary (covered by
+tests/test_model_hub.py gemma3/gpt-oss, window 8 < generated length).
+"""
+
+import numpy as np
+
+from neuronx_distributed_inference_tpu.config import TpuConfig, load_pretrained_config
+from neuronx_distributed_inference_tpu.models.gemma3 import Gemma3ForCausalLM
+from neuronx_distributed_inference_tpu.modules import kvcache
+
+
+GEMMA3_CFG = {
+    "model_type": "gemma3_text", "vocab_size": 256, "hidden_size": 64,
+    "intermediate_size": 128, "num_hidden_layers": 4, "num_attention_heads": 4,
+    "num_key_value_heads": 2, "head_dim": 16, "max_position_embeddings": 4096,
+    "rope_theta": 1_000_000.0, "rope_local_base_freq": 10_000.0,
+    "sliding_window": 16, "sliding_window_pattern": 2,
+    "query_pre_attn_scalar": 16, "tie_word_embeddings": True,
+}
+
+
+def _make(seq_len):
+    cfg = TpuConfig(batch_size=2, seq_len=seq_len, max_context_length=32,
+                    dtype="float32", context_encoding_buckets=[32],
+                    token_generation_buckets=[seq_len])
+    config = Gemma3ForCausalLM.get_config_cls()(
+        cfg, load_config=load_pretrained_config(GEMMA3_CFG))
+    app = Gemma3ForCausalLM(None, config)
+    app.load_random(seed=0)
+    return app
+
+
+def test_sliding_layers_allocate_window_sized_cache():
+    app = _make(seq_len=2048)
+    app.reset_cache()
+    # pattern=2: layers 0,2 sliding / 1,3 full
+    assert app.kv_cache["k"].shape == (2, 2, 2, 2048, 16)          # full layers
+    assert app.kv_cache["k_sliding"].shape == (2, 2, 2, 16, 16)    # window-sized
+    full_bytes = app.kv_cache["k"].nbytes + app.kv_cache["v"].nbytes
+    slide_bytes = (app.kv_cache["k_sliding"].nbytes
+                   + app.kv_cache["v_sliding"].nbytes)
+    assert slide_bytes * 64 < full_bytes  # 2048 / 16 = 128x smaller per layer
+
+
+def test_generation_across_rolling_boundary():
+    """Decode far past the window: the rolling cache must keep producing the same
+    tokens a full-width (degenerate-rolling) run produces."""
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(1, 256, size=(2, 20)).astype(np.int32)
+    # window 16 < seq: true rolling
+    small = _make(seq_len=64).generate(prompt, max_new_tokens=30).tokens
+    # window >= seq via a pattern override is not config-reachable; instead check
+    # self-consistency across decode chunk boundaries (chunk 4 vs one big chunk)
+    app = _make(seq_len=64)
+    app.tpu_config.decode_chunk_size = 4
+    chunked = app.generate(prompt, max_new_tokens=30).tokens
+    np.testing.assert_array_equal(small, chunked)
+
+
+def test_write_prefill_rolling_invariant():
+    """Slot j holds the row's largest written position ≡ j (mod W)."""
+    rng = np.random.default_rng(1)
+    w, s = 4, 10
+    cache = np.zeros((2, 1, w, 3), dtype=np.float32)
+    new = rng.standard_normal((2, 1, s, 3)).astype(np.float32)
+    lengths = np.array([7, 2], dtype=np.int32)
+    out = np.asarray(kvcache.write_prefill_rolling(
+        cache, new, lengths))
+    for b, l in enumerate(lengths):
+        for j in range(w):
+            q = (l - 1) - ((l - 1 - j) % w)
+            if q >= 0:
+                np.testing.assert_array_equal(out[b, :, j], new[b, :, q])
+            else:
+                np.testing.assert_array_equal(out[b, :, j], 0.0)
+
+
+def test_rolling_mask_reconstructs_positions():
+    w, window = 4, 4
+    pos = np.array([6], dtype=np.int32)
+    mask = np.asarray(kvcache.rolling_mask(pos, 1, w, window))[0, 0, 0]
+    # slots hold positions: j=0 -> 4, j=1 -> 5, j=2 -> 6, j=3 -> 3 (evicted by
+    # window: 3 <= 6-4+... 3 > 6-4=2 -> kept)
+    assert mask.tolist() == [True, True, True, True]
+    mask = np.asarray(kvcache.rolling_mask(pos, 1, w, 3))[0, 0, 0]
+    # window 3: only positions > 3 admitted -> slot 3 (pos 3) drops
+    assert mask.tolist() == [True, True, True, False]
