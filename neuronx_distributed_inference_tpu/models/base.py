@@ -591,12 +591,22 @@ def _decoder_layer(
 def _run_stack(params: Params, args: ModelArchArgs, h, cos, sin, mask, cache,
                positions, decode_bucket, mesh, rules, use_flash=False,
                paged=None, cache_batch_start=0,
-               adapter_ids=None, ring_positions=None, window_row=None):
-    """Scan the decoder layers, carrying hidden state, yielding updated cache."""
-    xs = (params["layers"], cache["k"], cache["v"])
+               adapter_ids=None, ring_positions=None, window_row=None,
+               capture_layers: Optional[Tuple[int, ...]] = None):
+    """Scan the decoder layers, carrying hidden state, yielding updated cache.
 
-    def body(carry_h, layer_xs):
-        lp, kc, vc = layer_xs
+    ``capture_layers`` (static layer indices) also collects those layers' OUTPUT
+    hidden states — the EAGLE3 conditioning capture (≈ reference target-hidden
+    capture at 3 layers, `models/model_base.py:1429-1432`) — returned as a list of
+    (B, S, H) arrays. Selection happens inside the scan with a carried buffer per
+    index, so no (L, B, S, H) stack ever materializes."""
+    xs = (params["layers"], cache["k"], cache["v"],
+          jnp.arange(len(jax.tree.leaves(params["layers"])[0])))
+    caps0 = tuple(jnp.zeros_like(h) for _ in (capture_layers or ()))
+
+    def body(carry, layer_xs):
+        carry_h, caps = carry
+        lp, kc, vc, li = layer_xs
         new_h, kc, vc = _decoder_layer(lp, args, carry_h, cos, sin, mask, kc, vc,
                                        positions, decode_bucket, mesh, rules,
                                        use_flash=use_flash, paged=paged,
@@ -604,21 +614,27 @@ def _run_stack(params: Params, args: ModelArchArgs, h, cos, sin, mask, cache,
                                        adapter_ids=adapter_ids,
                                        ring_positions=ring_positions,
                                        window_row=window_row)
+        if capture_layers:
+            caps = tuple(jnp.where(li == idx, new_h, buf)
+                         for idx, buf in zip(capture_layers, caps))
         from ..utils import tensor_capture as _tc
 
         ys = (kc, vc)
         if _tc._ACTIVE.get() is not None and _tc._ACTIVE.get().wants("hidden_stack"):
             ys = ys + (new_h,)
-        return new_h, ys
+        return (new_h, caps), ys
 
-    h, ys = jax.lax.scan(body, h, xs)
+    (h, caps), ys = jax.lax.scan(body, (h, caps0), xs)
     k_new, v_new = ys[0], ys[1]
     if len(ys) > 2:
         from ..utils.tensor_capture import tap
 
         tap("hidden_stack", ys[2])      # (L, B, S, H) per-layer hidden states
     # preserve auxiliary cache entries (e.g. M-RoPE rope_delta) alongside k/v
-    return h, {**cache, "k": k_new, "v": v_new}
+    out_cache = {**cache, "k": k_new, "v": v_new}
+    if capture_layers:
+        return h, out_cache, list(caps)
+    return h, out_cache
 
 
 def _segment_runs(flags: Tuple[bool, ...]):
@@ -758,6 +774,9 @@ def prefill_forward(
     adapter_ids: Optional[jnp.ndarray] = None,   # (B,) multi-LoRA slots
     use_ring: bool = False,       # context-parallel prefill via ring attention
     return_hidden: bool = False,  # also return the full normed hidden states (B, S, H)
+    # static layer indices whose output hiddens are captured (EAGLE3 conditioning,
+    # ≈ `model_base.py:1429-1432`); appends a list of (B, S, H) to the return
+    capture_layers: Optional[Tuple[int, ...]] = None,
     # multimodal embed merge: (mask (B, S, 1) bool, override (B, S, H)) — positions
     # where mask is True take the override row (image embeds scattered at image-token
     # positions, ≈ reference image-to-text pipelined vision→CTE merge,
@@ -818,18 +837,23 @@ def prefill_forward(
         paged = (jnp.zeros((input_ids.shape[0], 1), dtype=jnp.int32), slot_mapping)
     if use_ring:
         h = constrain(h, ("batch", "seq", None), rules, mesh=mesh)
-    h, cache = _run_stack(params, args, h, cos, sin, mask, cache,
-                          positions=None, decode_bucket=None, mesh=mesh, rules=rules,
-                          use_flash=use_flash,
-                          paged=paged, cache_batch_start=cache_batch_start,
-                          adapter_ids=adapter_ids,
-                          ring_positions=position_ids if use_ring else None)
+    out = _run_stack(params, args, h, cos, sin, mask, cache,
+                     positions=None, decode_bucket=None, mesh=mesh, rules=rules,
+                     use_flash=use_flash,
+                     paged=paged, cache_batch_start=cache_batch_start,
+                     adapter_ids=adapter_ids,
+                     ring_positions=position_ids if use_ring else None,
+                     capture_layers=capture_layers)
+    h, cache = out[0], out[1]
     h = tap("final_hidden", _norm(h, params["final_norm"], args))
     h_last = jnp.take_along_axis(h, last_token_idx[:, None, None], axis=1)[:, 0]
     logits = tap("logits", _lm_head(params, args, h_last, mesh, rules))
+    res = (logits, cache)
     if return_hidden:
-        return logits, cache, h
-    return logits, cache
+        res = res + (h,)
+    if capture_layers:
+        res = res + (out[2],)
+    return res
 
 
 def decode_forward(
@@ -848,6 +872,8 @@ def decode_forward(
     return_hidden: bool = False,  # also return the final normed hidden states (B, T, H)
     window_row=None,  # traced scalar: dense windowed prefill at this cache batch row
     use_kernel: bool = False,  # static: Pallas stacked-cache decode (hot path)
+    # static layer indices whose output hiddens are captured (EAGLE3 conditioning)
+    capture_layers: Optional[Tuple[int, ...]] = None,
 ) -> Tuple[jnp.ndarray, kvcache.KVCache]:
     """Token generation: returns (logits (B, T, V) fp32, updated cache).
 
@@ -911,12 +937,13 @@ def decode_forward(
         write_start = position_ids[:, None, None, None]            # (B, 1, 1, 1)
         committed = kv_pos < write_start
         rel = kv_pos - write_start                                 # slot idx within tree
-        anc = jnp.asarray(ancestor, bool)                          # (T, T)
+        anc = jnp.asarray(ancestor, bool)         # (T, T) static or (B, T, T) traced
         in_tree = jnp.logical_and(rel >= 0, rel < t)
         rel_c = jnp.broadcast_to(jnp.clip(rel, 0, t - 1),
                                  (b, 1, t, rel.shape[-1]))
+        anc_b = anc[None, None] if anc.ndim == 2 else anc[:, None]
         tree_vis = jnp.take_along_axis(
-            jnp.broadcast_to(anc[None, None], (b, 1, t, t)), rel_c, axis=3)
+            jnp.broadcast_to(anc_b, (b, 1, t, t)), rel_c, axis=3)
         mask = committed | (in_tree & tree_vis)
     sliding = (jnp.logical_and(mask, kv_pos > q_pos - args.sliding_window)
                if args.sliding_window is not None else None)
@@ -946,13 +973,17 @@ def decode_forward(
     if sliding is not None:
         mask = sliding
 
-    h, cache = _run_stack(params, args, h, cos, sin, mask, cache,
-                          positions=position_ids, decode_bucket=decode_bucket,
-                          mesh=mesh, rules=rules,
-                          paged=paged, adapter_ids=adapter_ids,
-                          window_row=window_row)
+    out = _run_stack(params, args, h, cos, sin, mask, cache,
+                     positions=position_ids, decode_bucket=decode_bucket,
+                     mesh=mesh, rules=rules,
+                     paged=paged, adapter_ids=adapter_ids,
+                     window_row=window_row, capture_layers=capture_layers)
+    h, cache = out[0], out[1]
     h = _norm(h, params["final_norm"], args)
     logits = _lm_head(params, args, h, mesh, rules)
+    res = (logits, cache)
     if return_hidden:
-        return logits, cache, h
-    return logits, cache
+        res = res + (h,)
+    if capture_layers:
+        res = res + (out[2],)
+    return res

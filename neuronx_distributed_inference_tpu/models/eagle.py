@@ -89,6 +89,156 @@ def convert_eagle_state_dict(state_dict: Dict[str, np.ndarray],
     return params
 
 
+# --- EAGLE3 -----------------------------------------------------------------------
+#
+# ≈ reference EAGLE3 (`models/model_base.py:1429-1432` target-hidden capture at 3
+# layers, `modules/eagle/`): the draft conditions on fc(concat(h_low, h_mid, h_high))
+# of THREE captured target layers instead of the final hidden, the decoder layer's
+# QKV projections read concat(norm(embed), norm(hidden)) (2H wide), and the draft
+# lm_head predicts over a reduced auxiliary vocabulary mapped back to target ids via
+# a d2t offset table.
+
+
+def init_eagle3_params(args: ModelArchArgs, key: jax.Array, draft_vocab: int,
+                       dtype=jnp.bfloat16,
+                       inv_freq: Optional[np.ndarray] = None) -> Params:
+    """Random EAGLE3 draft params (single midlayer; QKV input width 2H)."""
+    ks = jax.random.split(key, 10)
+    h = args.hidden_size
+
+    def w(k, shape, scale=0.02):
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dtype)
+
+    L, I = 1, args.intermediate_size
+    layers = {
+        "wq": w(ks[0], (L, 2 * h, args.q_size)),
+        "wk": w(ks[1], (L, 2 * h, args.kv_size)),
+        "wv": w(ks[2], (L, 2 * h, args.kv_size)),
+        "wo": w(ks[3], (L, args.q_size, h)),
+        "ln2": jnp.ones((L, h), dtype=dtype),
+        "wg": w(ks[4], (L, h, I)),
+        "wu": w(ks[5], (L, h, I)),
+        "wd": w(ks[6], (L, I, h)),
+    }
+    if inv_freq is None:
+        inv_freq = rope_ops.default_inv_freq(args.head_dim)
+    return {
+        "fc": w(ks[7], (3 * h, h)),
+        "in_norm": jnp.ones((h,), dtype=dtype),
+        "hid_norm": jnp.ones((h,), dtype=dtype),
+        "layers": layers,
+        "final_norm": jnp.ones((h,), dtype=dtype),
+        "lm_head_d": w(ks[8], (h, draft_vocab)),
+        "d2t": jnp.zeros((draft_vocab,), jnp.int32),
+        "rope_inv_freq": jnp.asarray(inv_freq, jnp.float32),
+    }
+
+
+def convert_eagle3_state_dict(state_dict: Dict[str, np.ndarray],
+                              args: ModelArchArgs,
+                              inv_freq: np.ndarray) -> Params:
+    """EAGLE3 checkpoint (``midlayer.*`` single layer, ``fc``, draft lm_head + d2t
+    table) -> draft pytree."""
+    def linear_t(name):
+        return np.ascontiguousarray(state_dict[name].T)
+
+    p = "midlayer."
+    layers = {
+        "wq": linear_t(p + "self_attn.q_proj.weight")[None],
+        "wk": linear_t(p + "self_attn.k_proj.weight")[None],
+        "wv": linear_t(p + "self_attn.v_proj.weight")[None],
+        "wo": linear_t(p + "self_attn.o_proj.weight")[None],
+        "ln2": state_dict[p + "post_attention_layernorm.weight"][None],
+        "wg": linear_t(p + "mlp.gate_proj.weight")[None],
+        "wu": linear_t(p + "mlp.up_proj.weight")[None],
+        "wd": linear_t(p + "mlp.down_proj.weight")[None],
+    }
+    return {
+        "fc": linear_t("fc.weight"),
+        "in_norm": state_dict[p + "input_layernorm.weight"],
+        "hid_norm": state_dict[p + "hidden_norm.weight"],
+        "layers": layers,
+        "final_norm": state_dict["norm.weight"],
+        "lm_head_d": linear_t("lm_head.weight"),
+        "d2t": np.asarray(state_dict["d2t"], np.int32),
+        "rope_inv_freq": np.asarray(inv_freq, np.float32),
+    }
+
+
+def eagle3_fuse_hiddens(d_params: Params, caps) -> jnp.ndarray:
+    """fc(concat(3 captured target hiddens)) -> (..., H) conditioning."""
+    x = jnp.concatenate([c.astype(d_params["fc"].dtype) for c in caps], axis=-1)
+    return x @ d_params["fc"]
+
+
+def eagle3_forward(
+    d_params: Params,
+    t_params: Params,           # target embed reused
+    args: ModelArchArgs,        # draft geometry (heads/kv_heads/head_dim/inter)
+    input_ids: jnp.ndarray,     # (B, T)
+    cond_hidden: jnp.ndarray,   # (B, T, H): fused target hiddens / draft hiddens
+    position_ids: jnp.ndarray,  # (B,) rope+slot position of token 0
+    cache: kvcache.KVCache,
+    decode_bucket: Optional[int],   # None -> prefill over the fresh T tokens
+    slot_offset=0,              # tree slots: token i writes at positions+slot_offset+i
+    depths=None,                # (T,) static rope-depth offsets (tree rounds)
+    extra_mask=None,            # (B, 1, T, bucket) visibility override (tree)
+    mesh=None,
+    rules=None,
+):
+    """One EAGLE3 draft forward. Returns (draft logits (B, T, V_d), draft hiddens
+    (B, T, H), cache). The residual stream is the conditioning hidden (midlayer
+    semantics): h = cond + attn(concat(norm(embed), norm(cond))) then MLP."""
+    b, t = input_ids.shape
+    lp = jax.tree.map(lambda x: x[0], d_params["layers"])
+    e = jnp.take(t_params["embed"], input_ids, axis=0)
+    x = jnp.concatenate([
+        rms_norm(e, d_params["in_norm"], args.rms_norm_eps),
+        rms_norm(cond_hidden.astype(e.dtype), d_params["hid_norm"],
+                 args.rms_norm_eps)], axis=-1)
+
+    if depths is None:
+        pos_grid = position_ids[:, None] + slot_offset + jnp.arange(t)[None, :]
+    else:
+        pos_grid = position_ids[:, None] + jnp.asarray(depths, jnp.int32)[None, :]
+    cos, sin = rope_ops.compute_cos_sin(d_params["rope_inv_freq"], pos_grid,
+                                        args.rope_attention_scaling)
+    q = (x @ lp["wq"]).reshape(b, t, args.num_heads, args.head_dim).transpose(0, 2, 1, 3)
+    k = (x @ lp["wk"]).reshape(b, t, args.num_kv_heads, args.head_dim).transpose(0, 2, 1, 3)
+    v = (x @ lp["wv"]).reshape(b, t, args.num_kv_heads, args.head_dim).transpose(0, 2, 1, 3)
+    q, k = rope_ops.apply_rotary(q, k, cos, sin)
+
+    kc, vc = cache["k"][0], cache["v"][0]
+    if decode_bucket is None:
+        kc = kvcache.write_prefill(kc, k)
+        vc = kvcache.write_prefill(vc, v)
+        k_att, v_att = k, v
+        mask = pos_grid[:, None, :, None] >= pos_grid[:, None, None, :]
+    else:
+        slots = position_ids + slot_offset
+        kc = kvcache.write_decode(kc, k, slots)
+        vc = kvcache.write_decode(vc, v, slots)
+        k_att = kvcache.read_bucket(kc, decode_bucket)
+        v_att = kvcache.read_bucket(vc, decode_bucket)
+        if extra_mask is not None:
+            mask = extra_mask
+        else:
+            kv_pos = jnp.arange(decode_bucket)[None, None, None, :]
+            mask = kv_pos <= pos_grid[:, None, :, None]
+    from ..ops.attention import attend
+
+    attn = attend(q, k_att.astype(q.dtype), v_att.astype(q.dtype), mask=mask)
+    attn = attn.transpose(0, 2, 1, 3).reshape(b, t, args.q_size)
+    h = cond_hidden.astype(e.dtype) + attn @ lp["wo"]
+    hn = rms_norm(h, lp["ln2"], args.rms_norm_eps)
+    ffn = (jax.nn.silu(hn @ lp["wg"]) * (hn @ lp["wu"])) @ lp["wd"]
+    h = h + ffn
+    hn = rms_norm(h, d_params["final_norm"], args.rms_norm_eps)
+    d_logits = (hn @ d_params["lm_head_d"]).astype(jnp.float32)
+    cache = dict(cache, k=kc[None], v=vc[None])
+    return d_logits, h, cache
+
+
 def _fuse_input(d_params: Params, t_params: Params, args: ModelArchArgs,
                 input_ids: jnp.ndarray, cond_hidden: jnp.ndarray) -> jnp.ndarray:
     e = jnp.take(t_params["embed"], input_ids, axis=0)       # (B, T, H)

@@ -1,0 +1,339 @@
+"""EAGLE3 speculative decoding with a dynamic (beam-expanded) token tree.
+
+≈ reference EAGLE3 + dynamic token tree (`models/model_base.py:1429-1432` 3-layer
+target-hidden capture, :2136-2558 tree decoding, `modules/eagle/dynamic_token_tree.py`).
+TPU redesign — everything runs inside ONE jitted step with static shapes:
+
+- The target's prefill/verify decode captures THREE layers' hidden states
+  (capture_layers); the draft conditions on ``fc(concat(h_low, h_mid, h_high))``.
+- The draft proposes a **dynamic tree**: ``depth`` beam-expansion rounds, each keeping
+  the global top-``beam`` (node, token) continuations by cumulative log-probability —
+  the tree's PARENTS and TOKENS are traced per batch row, only the depth schedule is
+  static (node i of round r has depth r+1), so one compiled graph serves every tree
+  the expansion discovers (the reference builds its dynamic tree on CPU per step).
+- Verification is one wide target decode over the N = 1 + depth*beam nodes with a
+  per-row traced ancestor mask; greedy acceptance walks the tree on device; accepted
+  nodes' KV entries are compacted into contiguous slots in both caches
+  (kvcache.compact_decode_slots), so rejected branches never need rollback.
+- The draft predicts over an auxiliary vocabulary (``lm_head_d`` + d2t offsets,
+  target_id = draft_id + d2t[draft_id]).
+
+Greedy acceptance only: output equals the target's plain greedy decode exactly.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models import base as model_base
+from ..models import eagle as eagle_lib
+from ..models.base import ModelArchArgs
+from ..modules import autobucketing, kvcache
+from . import model_wrapper
+from .speculation import SpecGenerateOutput, assemble_spec_output, commit_row
+
+
+class Eagle3SpeculativeModel:
+    """Target `TpuModelForCausalLM` + EAGLE3 draft, fused dynamic-tree speculation."""
+
+    def __init__(self, target, draft_args: ModelArchArgs, *,
+                 depth: int = 3, beam: int = 2, branch: int = 2,
+                 capture_layers: Optional[tuple] = None,
+                 draft_vocab: Optional[int] = None):
+        if depth < 1 or beam < 1:
+            raise ValueError("depth and beam must be >= 1")
+        if branch < beam:
+            # each round draws candidates from beam*branch continuations; fewer
+            # branches than beams could not fill the next beam
+            raise ValueError("branch must be >= beam")
+        if draft_args.hidden_size != target.arch_args.hidden_size:
+            raise ValueError("EAGLE3 draft must share the target's hidden size")
+        self.target = target
+        self.draft_args = draft_args
+        self.depth = depth
+        self.beam = beam
+        self.branch = branch
+        self.num_nodes = 1 + depth * beam
+        L = target.arch_args.num_layers
+        self.capture_layers = (capture_layers if capture_layers is not None
+                               else (1, L // 2, L - 2 if L > 1 else 0))
+        self.draft_vocab = draft_vocab or target.arch_args.vocab_size
+        self.draft_params = None
+        self.draft_cache = None
+        self._build_steps()
+
+    # ------------------------------------------------------------------ weights
+    def load_random_draft(self, seed: int = 0) -> None:
+        self.draft_params = eagle_lib.init_eagle3_params(
+            self.draft_args, jax.random.PRNGKey(seed), self.draft_vocab,
+            dtype=self.target.tpu_config.jax_dtype,
+            inv_freq=self.target.inv_freq_from_config(self.target.config))
+
+    def load_draft(self, state_dict) -> None:
+        host = eagle_lib.convert_eagle3_state_dict(
+            state_dict, self.draft_args,
+            self.target.inv_freq_from_config(self.target.config))
+        dtype = self.target.tpu_config.jax_dtype
+        self.draft_params = jax.tree.map(
+            lambda x: jnp.asarray(np.asarray(x)).astype(dtype)
+            if np.asarray(x).dtype.kind == "f" else jnp.asarray(x), host)
+        self.draft_params["rope_inv_freq"] = jnp.asarray(
+            np.asarray(host["rope_inv_freq"]), jnp.float32)
+
+    def load_host_draft(self, host_params) -> None:
+        """Install an already-built draft pytree (tests / distilled drafts)."""
+        self.draft_params = jax.tree.map(jnp.asarray, host_params)
+
+    def _draft_cache_spec(self) -> kvcache.KVCacheSpec:
+        a = self.draft_args
+        cfg = self.target.tpu_config
+        return kvcache.KVCacheSpec(
+            num_layers=1, batch_size=cfg.max_batch_size,
+            num_kv_heads=a.num_kv_heads, max_seq_len=cfg.seq_len,
+            head_dim=a.head_dim, dtype=cfg.kv_cache_jax_dtype)
+
+    # ------------------------------------------------------------------ device steps
+    def _build_steps(self) -> None:
+        t = self.target
+        t_args, d_args = t.arch_args, self.draft_args
+        mesh, rules = t.mesh, t.sharding_rules
+        depth, beam, branch = self.depth, self.beam, self.branch
+        n_nodes = self.num_nodes
+        caps_idx = tuple(self.capture_layers)
+        precision = "highest" if t.tpu_config.dtype == "float32" else "default"
+        # static depth schedule: node 0 = root, node 1+(r-1)*beam + j has depth r
+        node_depth = np.zeros((n_nodes,), np.int32)
+        for r in range(1, depth + 1):
+            node_depth[1 + (r - 1) * beam : 1 + r * beam] = r
+
+        def _prefill(t_params, d_params, input_ids, position_ids, last_token_idx,
+                     t_cache, d_cache):
+            with jax.default_matmul_precision(precision):
+                logits, t_cache, caps = model_base.prefill_forward(
+                    t_params, t_args, input_ids, position_ids, last_token_idx,
+                    t_cache, mesh=mesh, rules=rules, capture_layers=caps_idx)
+                tok0 = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                g = eagle_lib.eagle3_fuse_hiddens(d_params, caps)   # (B, S, H)
+                cond = jnp.concatenate(
+                    [jnp.zeros_like(g[:, :1]), g[:, :-1]], axis=1)
+                _, _, d_cache = eagle_lib.eagle3_forward(
+                    d_params, t_params, d_args, input_ids, cond,
+                    jnp.zeros_like(last_token_idx), d_cache, None,
+                    mesh=mesh, rules=rules)
+                g_last = jnp.take_along_axis(
+                    g, last_token_idx[:, None, None], axis=1)[:, 0]   # (B, H)
+            return tok0, g_last, t_cache, d_cache
+
+        def _step(t_params, d_params, last_tok, g_cond, positions, t_cache, d_cache,
+                  decode_bucket):
+            """One fused dynamic-tree step: beam expansion + verify + acceptance."""
+            b = last_tok.shape[0]
+            d2t = d_params["d2t"]
+
+            # --- dynamic beam expansion -------------------------------------------
+            # node state (B, N): target-vocab tokens, parents, cumulative logp
+            tokens = jnp.zeros((b, n_nodes), jnp.int32).at[:, 0].set(last_tok)
+            parents = jnp.full((b, n_nodes), -1, jnp.int32)
+            # ancestor-or-self closure (B, N, N), grown per round
+            anc = jnp.broadcast_to(jnp.eye(n_nodes, dtype=bool)[None],
+                                   (b, n_nodes, n_nodes))
+            cum_logp = jnp.zeros((b, n_nodes), jnp.float32)
+            h_all = jnp.zeros((b, n_nodes, t_args.hidden_size),
+                              t.tpu_config.jax_dtype)
+
+            frontier_tok = last_tok[:, None]                     # (B, 1) round-0 input
+            frontier_cond = g_cond[:, None]                      # (B, 1, H)
+            frontier_idx = jnp.zeros((b, 1), jnp.int32)          # node ids
+
+            kv_pos = jnp.arange(decode_bucket)[None, None, None, :]
+            for r in range(depth):
+                width = frontier_tok.shape[1]                    # 1 or beam (static)
+                slot0 = 0 if r == 0 else 1 + (r - 1) * beam
+                # visibility: committed context + ancestors among written tree slots
+                committed = kv_pos < positions[:, None, None, None]
+                rel = kv_pos - positions[:, None, None, None]
+                in_tree = (rel >= 0) & (rel < slot0 + width)
+                # anc rows of the frontier nodes: (B, width, N)
+                anc_f = jnp.take_along_axis(
+                    anc, frontier_idx[:, :, None], axis=1)
+                rel_c = jnp.clip(rel, 0, n_nodes - 1)
+                vis = jnp.take_along_axis(
+                    jnp.broadcast_to(anc_f[:, None], (b, 1, width, n_nodes)),
+                    jnp.broadcast_to(rel_c, (b, 1, width, rel.shape[-1])), axis=3)
+                mask = committed | (in_tree & vis)
+                dep = tuple(int(node_depth[slot0 + j]) for j in range(width))
+                with jax.default_matmul_precision(precision):
+                    d_logits, h_out, d_cache = eagle_lib.eagle3_forward(
+                        d_params, t_params, d_args, frontier_tok, frontier_cond,
+                        positions, d_cache, decode_bucket, slot_offset=slot0,
+                        depths=dep, extra_mask=mask, mesh=mesh, rules=rules)
+                h_all = jax.lax.dynamic_update_slice(
+                    h_all, h_out.astype(h_all.dtype), (0, slot0, 0))
+
+                logp = jax.nn.log_softmax(d_logits, axis=-1)     # (B, width, V_d)
+                top_lp, top_id = jax.lax.top_k(logp, branch)     # (B, width, branch)
+                cand_scores = (jnp.take_along_axis(cum_logp, frontier_idx, axis=1)
+                               [:, :, None] + top_lp).reshape(b, width * branch)
+                sel_lp, sel = jax.lax.top_k(cand_scores, beam)   # (B, beam)
+                parent_local = sel // branch                     # frontier-local
+                parent_node = jnp.take_along_axis(frontier_idx, parent_local, axis=1)
+                draft_ids = jnp.take_along_axis(
+                    top_id.reshape(b, width * branch), sel, axis=1)
+                new_toks = (draft_ids + jnp.take(d2t, draft_ids)).astype(jnp.int32)
+
+                new0 = 1 + r * beam
+                new_ids = new0 + jnp.arange(beam, dtype=jnp.int32)[None, :]
+                tokens = jax.lax.dynamic_update_slice(tokens, new_toks, (0, new0))
+                parents = jax.lax.dynamic_update_slice(parents, parent_node,
+                                                       (0, new0))
+                cum_logp = jax.lax.dynamic_update_slice(cum_logp, sel_lp, (0, new0))
+                # anc rows for the new nodes: parent's closure + self
+                anc_parent = jnp.take_along_axis(anc, parent_node[:, :, None], axis=1)
+                self_hot = jax.nn.one_hot(new_ids, n_nodes, dtype=bool)
+                anc = jax.lax.dynamic_update_slice(
+                    anc, anc_parent | self_hot, (0, new0, 0))
+
+                frontier_tok = new_toks
+                frontier_cond = jnp.take_along_axis(
+                    h_all, jnp.broadcast_to(parent_node[:, :, None],
+                                            (b, beam, h_all.shape[-1])), axis=1)
+                frontier_idx = jnp.broadcast_to(new_ids, (b, beam))
+
+            # --- target verify over the N tree nodes ------------------------------
+            with jax.default_matmul_precision(precision):
+                t_logits, t_cache, caps = model_base.decode_forward(
+                    t_params, t_args, tokens, positions, t_cache, decode_bucket,
+                    mesh=mesh, rules=rules,
+                    tree=(node_depth, anc), capture_layers=caps_idx)
+            t_toks = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)   # (B, N)
+
+            # --- greedy tree walk (device) ----------------------------------------
+            node_depth_j = jnp.asarray(node_depth)[None, :]            # (1, N)
+            node_ids = jnp.arange(n_nodes)[None, :]
+
+            def walk(carry, r):
+                cur, n_acc, path = carry
+                want = jnp.take_along_axis(t_toks, cur[:, None], axis=1)[:, 0]
+                ok = ((parents == cur[:, None]) & (node_depth_j == r + 1)
+                      & (tokens == want[:, None]) & (n_acc == r)[:, None])
+                found = ok.any(axis=1)
+                child = jnp.where(found, jnp.argmax(ok, axis=1), cur)
+                path = path.at[:, r].set(jnp.where(found, child, 0))
+                return (child.astype(jnp.int32),
+                        n_acc + found.astype(jnp.int32), path), None
+
+            path0 = jnp.zeros((b, depth), jnp.int32)
+            (last_node, n, path), _ = jax.lax.scan(
+                walk, (jnp.zeros((b,), jnp.int32), jnp.zeros((b,), jnp.int32),
+                       path0), jnp.arange(depth))
+
+            # committed tokens: accepted path tokens + bonus (target at last node)
+            path_toks = jnp.take_along_axis(tokens, path, axis=1)      # (B, depth)
+            bonus = jnp.take_along_axis(t_toks, last_node[:, None], axis=1)[:, 0]
+            slot_idx = jnp.arange(depth + 1)[None, :]
+            out_toks = jnp.where(
+                slot_idx < n[:, None],
+                jnp.pad(path_toks, ((0, 0), (0, 1))), bonus[:, None])   # (B, depth+1)
+
+            # --- KV compaction: accepted nodes -> contiguous slots ----------------
+            # node i sits at cache slot positions + i; keep the accepted path at
+            # [positions+1, positions+1+n) (root already at positions)
+            src = positions[:, None] + path                            # (B, depth)
+            t_cache = kvcache.compact_decode_slots(
+                {"k": t_cache["k"], "v": t_cache["v"]}, src, positions + 1) | {
+                key: val for key, val in t_cache.items()
+                if key not in ("k", "v")}
+            d_cache = kvcache.compact_decode_slots(
+                {"k": d_cache["k"], "v": d_cache["v"]}, src, positions + 1)
+
+            # next conditioning: fused captured hiddens at the last accepted node
+            g_all = eagle_lib.eagle3_fuse_hiddens(d_params, caps)      # (B, N, H)
+            g_next = jnp.take_along_axis(
+                g_all, jnp.broadcast_to(last_node[:, None, None],
+                                        (b, 1, g_all.shape[-1])), axis=1)[:, 0]
+            return out_toks, n, g_next, t_cache, d_cache
+
+        self._prefill_step = jax.jit(_prefill, donate_argnums=(5, 6))
+        self._spec_step = jax.jit(_step, donate_argnums=(5, 6),
+                                  static_argnames=("decode_bucket",))
+
+    # ------------------------------------------------------------------ generate
+    def generate(
+        self,
+        input_ids: np.ndarray,
+        attention_mask: Optional[np.ndarray] = None,
+        max_new_tokens: int = 32,
+        eos_token_id: Optional[int] = None,
+        pad_token_id: int = 0,
+    ) -> SpecGenerateOutput:
+        target = self.target
+        cfg = target.tpu_config
+        if target.params is None or self.draft_params is None:
+            raise RuntimeError("load target weights and draft params before generate")
+        input_ids = model_wrapper.to_int32(input_ids)
+        b = input_ids.shape[0]
+        compiled_b = cfg.max_batch_size
+
+        padded = model_wrapper.pad_prefill_inputs(
+            input_ids, attention_mask, target.cte_buckets, pad_token_id=pad_token_id,
+            batch_size=compiled_b)
+        target.reset_cache()
+        from ..parallel.sharding import named_sharding
+
+        sharding = named_sharding(target.mesh, kvcache.CACHE_LOGICAL,
+                                  target.sharding_rules)
+        self.draft_cache = jax.tree.map(
+            lambda x: jax.device_put(x, sharding),
+            kvcache.init_cache(self._draft_cache_spec()))
+
+        t_start = time.perf_counter()
+        tok0_dev, g_dev, target.kv_cache, self.draft_cache = self._prefill_step(
+            target.params, self.draft_params, padded.input_ids, padded.position_ids,
+            padded.last_token_idx, target.kv_cache, self.draft_cache)
+        tok0 = np.asarray(tok0_dev)
+        ttft = time.perf_counter() - t_start
+
+        committed: List[List[int]] = [[int(tok0[i])] for i in range(b)]
+        done = np.zeros((compiled_b,), dtype=bool)
+        done[b:] = True
+        if eos_token_id is not None:
+            done[:b] |= tok0[:b] == eos_token_id
+        positions = padded.true_lengths.astype(np.int32).copy()
+        last_tok = tok0.astype(np.int32)
+        g_cond = g_dev
+        accept_hist = np.zeros((self.depth + 1,), dtype=np.int64)
+        steps = 0
+
+        while not all(len(c) >= max_new_tokens or done[i]
+                      for i, c in enumerate(committed)):
+            max_pos = int(positions.max())
+            if max_pos + self.num_nodes >= cfg.seq_len:
+                break
+            bucket = autobucketing.select_bucket(target.tkg_buckets,
+                                                 max_pos + self.num_nodes)
+            out_dev, n_dev, g_cond, target.kv_cache, self.draft_cache = \
+                self._spec_step(target.params, self.draft_params,
+                                jnp.asarray(last_tok), g_cond,
+                                jnp.asarray(positions), target.kv_cache,
+                                self.draft_cache, decode_bucket=bucket)
+            out = np.asarray(out_dev)
+            n = np.asarray(n_dev)
+            steps += 1
+            for i in range(b):
+                if done[i]:
+                    continue
+                take = int(n[i]) + 1
+                accept_hist[take - 1] += 1
+                done[i] = commit_row(committed[i], out[i, :take], eos_token_id,
+                                     max_new_tokens)
+                if not done[i]:
+                    positions[i] += take
+                    last_tok[i] = out[i, take - 1]
+
+        return assemble_spec_output(committed, padded, b, pad_token_id, accept_hist,
+                                    steps, ttft)
