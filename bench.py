@@ -104,6 +104,7 @@ def main() -> None:
                         dtype="bfloat16", tp_degree=1,
                         context_encoding_buckets=[128, 256],
                         token_generation_buckets=[256, 512],
+                        batch_buckets=[1, batch] if batch > 1 else None,
                         quantization_config=quant)
     config = LlamaInferenceConfig(tpu_cfg, load_config=load_pretrained_config(hf_cfg))
     app = LlamaForCausalLM(None, config)
@@ -126,6 +127,18 @@ def main() -> None:
     tok_per_s = total_toks / total_decode_s
     per_step_ms = 1000.0 * chunk_s / chunk_toks
 
+    # serving TTFT: a single request prefilled at batch bucket 1 (first-class
+    # metric, ≈ reference TTFT reporting `utils/benchmark.py:479-494`); the bulk
+    # ttft above amortizes a full batch-64 prefill and is NOT time-to-first-token
+    # for one user
+    single = input_ids[:1]
+    ttfts = []
+    for i in range(12):
+        o1 = app.generate(single, max_new_tokens=1)
+        if i:                                      # first call pays compilation
+            ttfts.append(o1.ttft_s)
+    ttft_p50_ms = 1000.0 * float(np.percentile(ttfts, 50))
+
     print(json.dumps({
         "metric": name,
         "value": round(tok_per_s, 1),
@@ -133,7 +146,8 @@ def main() -> None:
         "vs_baseline": round(tok_per_s / 2000.0, 3),
         "extra": {
             "p50_decode_step_ms": round(float(np.percentile(per_step_ms, 50)), 2),
-            "ttft_s": round(out.ttft_s, 3),
+            "ttft_p50_ms": round(ttft_p50_ms, 1),
+            "ttft_bulk_bs%d_s" % batch: round(out.ttft_s, 3),
         },
     }))
 

@@ -1,0 +1,108 @@
+"""Falcon-7B on the TPU framework (contrib port, ≈ reference
+`contrib/models/falcon-7b/`).
+
+Exercises: multi-query attention (1 KV head), parallel residual with a shared
+LayerNorm, fused MQA query_key_value split, bias-free plain gelu MLP, tied head.
+(The 40B/180B new_decoder_architecture variant is not covered.)
+"""
+
+from typing import Dict
+
+import numpy as np
+
+from neuronx_distributed_inference_tpu.config import InferenceConfig
+from neuronx_distributed_inference_tpu.models.base import ModelArchArgs
+from neuronx_distributed_inference_tpu.ops import rope as rope_ops
+from neuronx_distributed_inference_tpu.runtime.application import (
+    TpuModelForCausalLM)
+
+
+class FalconInferenceConfig(InferenceConfig):
+    REQUIRED_ATTRIBUTES = ("hidden_size", "num_hidden_layers",
+                           "num_attention_heads", "vocab_size")
+
+    def add_derived_config(self) -> None:
+        for attr, default in (("rope_theta", 10000.0),
+                              ("layer_norm_epsilon", 1e-5),
+                              ("parallel_attn", True),
+                              ("multi_query", True),
+                              ("bias", False),
+                              ("new_decoder_architecture", False),
+                              ("alibi", False)):
+            if not hasattr(self, attr) or getattr(self, attr) is None:
+                setattr(self, attr, default)
+        if self.new_decoder_architecture:
+            raise NotImplementedError("falcon new_decoder_architecture (40B/180B) "
+                                      "is not supported")
+        if self.alibi:
+            raise NotImplementedError("alibi falcon variants are not supported")
+
+
+class FalconForCausalLM(TpuModelForCausalLM):
+    @classmethod
+    def get_config_cls(cls):
+        return FalconInferenceConfig
+
+    @classmethod
+    def arch_args_from_config(cls, config) -> ModelArchArgs:
+        h = config.hidden_size
+        return ModelArchArgs(
+            vocab_size=config.vocab_size,
+            hidden_size=h,
+            num_layers=config.num_hidden_layers,
+            num_heads=config.num_attention_heads,
+            num_kv_heads=1 if config.multi_query else config.num_attention_heads,
+            head_dim=h // config.num_attention_heads,
+            intermediate_size=4 * h,
+            rms_norm_eps=config.layer_norm_epsilon,
+            activation="gelu",
+            norm_type="layer", norm_bias=True,
+            mlp_kind="plain", mlp_bias=bool(config.bias),
+            attention_bias=bool(config.bias), o_bias=bool(config.bias),
+            parallel_residual=bool(config.parallel_attn), shared_ln=True,
+            tie_word_embeddings=True,
+        )
+
+    @classmethod
+    def inv_freq_from_config(cls, config) -> np.ndarray:
+        d = config.hidden_size // config.num_attention_heads
+        return rope_ops.default_inv_freq(d, float(config.rope_theta))
+
+    @classmethod
+    def convert_hf_state_dict(cls, state_dict: Dict[str, np.ndarray],
+                              config) -> Dict:
+        h = config.hidden_size
+        nh = config.num_attention_heads
+        d = h // nh
+
+        def get(name):
+            if name not in state_dict:
+                raise KeyError(f"missing weight {name}")
+            return np.asarray(state_dict[name])
+
+        layers = {k: [] for k in ("ln1", "ln1_b", "wq", "wk", "wv", "wo",
+                                  "ln2", "ln2_b", "wg", "wd")}
+        for i in range(config.num_hidden_layers):
+            p = f"transformer.h.{i}."
+            # fused MQA: rows [q (nh*d), k (d), v (d)]
+            qkv = get(p + "self_attention.query_key_value.weight")
+            layers["wq"].append(np.ascontiguousarray(qkv[: nh * d].T))
+            layers["wk"].append(np.ascontiguousarray(qkv[nh * d : nh * d + d].T))
+            layers["wv"].append(np.ascontiguousarray(qkv[nh * d + d :].T))
+            layers["wo"].append(
+                np.ascontiguousarray(get(p + "self_attention.dense.weight").T))
+            layers["ln1"].append(get(p + "input_layernorm.weight"))
+            layers["ln1_b"].append(get(p + "input_layernorm.bias"))
+            layers["ln2"].append(np.ones_like(get(p + "input_layernorm.weight")))
+            layers["ln2_b"].append(np.zeros_like(get(p + "input_layernorm.bias")))
+            layers["wg"].append(
+                np.ascontiguousarray(get(p + "mlp.dense_h_to_4h.weight").T))
+            layers["wd"].append(
+                np.ascontiguousarray(get(p + "mlp.dense_4h_to_h.weight").T))
+        return {
+            "embed": get("transformer.word_embeddings.weight"),
+            "layers": {k: np.stack(v) for k, v in layers.items()},
+            "final_norm": get("transformer.ln_f.weight"),
+            "final_norm_b": get("transformer.ln_f.bias"),
+            "rope_inv_freq": cls.inv_freq_from_config(config),
+        }

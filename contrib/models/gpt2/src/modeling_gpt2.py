@@ -1,0 +1,103 @@
+"""GPT-2 on the TPU framework (contrib port).
+
+≈ reference `contrib/models/gpt2/src/` port pattern: thin arch description +
+HF-state-dict converter over the shared functional core. GPT-2 exercises the
+contrib-arch primitives: learned position embeddings (no rope), biased LayerNorm,
+fused c_attn QKV split, plain (non-gated) gelu MLP, tied output head.
+"""
+
+from typing import Dict
+
+import numpy as np
+
+from neuronx_distributed_inference_tpu.config import InferenceConfig
+from neuronx_distributed_inference_tpu.models.base import ModelArchArgs
+from neuronx_distributed_inference_tpu.runtime.application import (
+    TpuModelForCausalLM)
+
+
+class GPT2InferenceConfig(InferenceConfig):
+    REQUIRED_ATTRIBUTES = ("n_embd", "n_layer", "n_head", "vocab_size", "n_positions")
+
+    def add_derived_config(self) -> None:
+        for attr, default in (("layer_norm_epsilon", 1e-5),
+                              ("activation_function", "gelu_new"),
+                              ("n_inner", None)):
+            if not hasattr(self, attr) or getattr(self, attr) is None:
+                setattr(self, attr, default)
+        if self.n_inner is None:
+            self.n_inner = 4 * self.n_embd
+
+
+class GPT2ForCausalLM(TpuModelForCausalLM):
+    @classmethod
+    def get_config_cls(cls):
+        return GPT2InferenceConfig
+
+    @classmethod
+    def arch_args_from_config(cls, config) -> ModelArchArgs:
+        h = config.n_embd
+        return ModelArchArgs(
+            vocab_size=config.vocab_size,
+            hidden_size=h,
+            num_layers=config.n_layer,
+            num_heads=config.n_head,
+            num_kv_heads=config.n_head,
+            head_dim=h // config.n_head,
+            intermediate_size=config.n_inner,
+            rms_norm_eps=config.layer_norm_epsilon,
+            activation=config.activation_function,
+            norm_type="layer", norm_bias=True,
+            mlp_kind="plain", mlp_bias=True,
+            attention_bias=True, o_bias=True,
+            learned_pos=True,
+            tie_word_embeddings=True,
+        )
+
+    @classmethod
+    def inv_freq_from_config(cls, config) -> np.ndarray:
+        # learned positions: rope collapses to identity via a zero frequency table
+        return np.zeros(((config.n_embd // config.n_head) // 2,), np.float32)
+
+    @classmethod
+    def convert_hf_state_dict(cls, state_dict: Dict[str, np.ndarray],
+                              config) -> Dict:
+        h = config.n_embd
+
+        def get(name):
+            if name not in state_dict:
+                raise KeyError(f"missing weight {name}")
+            return np.asarray(state_dict[name])
+
+        layers = {k: [] for k in ("ln1", "ln1_b", "wq", "wk", "wv", "bq", "bk",
+                                  "bv", "wo", "bo", "ln2", "ln2_b", "wg", "bg",
+                                  "wd", "bd")}
+        for i in range(config.n_layer):
+            p = f"transformer.h.{i}."
+            # HF Conv1D stores weights (in, out): no transpose needed
+            c_attn = get(p + "attn.c_attn.weight")          # (H, 3H)
+            c_attn_b = get(p + "attn.c_attn.bias")          # (3H,)
+            layers["wq"].append(c_attn[:, :h])
+            layers["wk"].append(c_attn[:, h : 2 * h])
+            layers["wv"].append(c_attn[:, 2 * h :])
+            layers["bq"].append(c_attn_b[:h])
+            layers["bk"].append(c_attn_b[h : 2 * h])
+            layers["bv"].append(c_attn_b[2 * h :])
+            layers["wo"].append(get(p + "attn.c_proj.weight"))
+            layers["bo"].append(get(p + "attn.c_proj.bias"))
+            layers["ln1"].append(get(p + "ln_1.weight"))
+            layers["ln1_b"].append(get(p + "ln_1.bias"))
+            layers["ln2"].append(get(p + "ln_2.weight"))
+            layers["ln2_b"].append(get(p + "ln_2.bias"))
+            layers["wg"].append(get(p + "mlp.c_fc.weight"))
+            layers["bg"].append(get(p + "mlp.c_fc.bias"))
+            layers["wd"].append(get(p + "mlp.c_proj.weight"))
+            layers["bd"].append(get(p + "mlp.c_proj.bias"))
+        return {
+            "embed": get("transformer.wte.weight"),
+            "pos_embed": get("transformer.wpe.weight"),
+            "layers": {k: np.stack(v) for k, v in layers.items()},
+            "final_norm": get("transformer.ln_f.weight"),
+            "final_norm_b": get("transformer.ln_f.bias"),
+            "rope_inv_freq": cls.inv_freq_from_config(config),
+        }

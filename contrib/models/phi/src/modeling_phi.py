@@ -1,0 +1,124 @@
+"""Phi-1.5 / Phi-2 on the TPU framework (contrib port, ≈ reference
+`contrib/models/phi-1_5/`).
+
+Exercises: partial rotary, parallel residual with a SHARED input LayerNorm, biased
+projections everywhere, plain gelu MLP, biased untied output head.
+"""
+
+from typing import Dict
+
+import numpy as np
+
+from neuronx_distributed_inference_tpu.config import InferenceConfig
+from neuronx_distributed_inference_tpu.models.base import ModelArchArgs
+from neuronx_distributed_inference_tpu.ops import rope as rope_ops
+from neuronx_distributed_inference_tpu.runtime.application import (
+    TpuModelForCausalLM)
+
+
+class PhiInferenceConfig(InferenceConfig):
+    REQUIRED_ATTRIBUTES = ("hidden_size", "num_hidden_layers",
+                           "num_attention_heads", "vocab_size",
+                           "intermediate_size")
+
+    def add_derived_config(self) -> None:
+        for attr, default in (("partial_rotary_factor", 0.5),
+                              ("rope_theta", 10000.0),
+                              ("layer_norm_eps", 1e-5),
+                              ("hidden_act", "gelu_new"),
+                              ("num_key_value_heads", None)):
+            if not hasattr(self, attr) or getattr(self, attr) is None:
+                setattr(self, attr, default)
+        if self.num_key_value_heads is None:
+            self.num_key_value_heads = self.num_attention_heads
+
+
+class PhiForCausalLM(TpuModelForCausalLM):
+    @classmethod
+    def get_config_cls(cls):
+        return PhiInferenceConfig
+
+    @classmethod
+    def arch_args_from_config(cls, config) -> ModelArchArgs:
+        h = config.hidden_size
+        d = h // config.num_attention_heads
+        return ModelArchArgs(
+            vocab_size=config.vocab_size,
+            hidden_size=h,
+            num_layers=config.num_hidden_layers,
+            num_heads=config.num_attention_heads,
+            num_kv_heads=config.num_key_value_heads,
+            head_dim=d,
+            intermediate_size=config.intermediate_size,
+            rms_norm_eps=config.layer_norm_eps,
+            activation=config.hidden_act,
+            norm_type="layer", norm_bias=True,
+            mlp_kind="plain", mlp_bias=True,
+            attention_bias=True, o_bias=True,
+            parallel_residual=True, shared_ln=True,  # one ln feeds attn AND mlp
+            rotary_dim=int(d * config.partial_rotary_factor),
+        )
+
+    def logical_axes(self) -> Dict:
+        from neuronx_distributed_inference_tpu.models import base as model_base
+
+        axes = model_base.param_logical_axes(self.arch_args)
+        axes["lm_head_b"] = ("vocab",)
+        return axes
+
+    def init_random_params(self, key) -> Dict:
+        import jax.numpy as jnp
+
+        params = super().init_random_params(key)
+        params["lm_head_b"] = jnp.zeros((self.arch_args.vocab_size,),
+                                        self.tpu_config.jax_dtype)
+        return params
+
+    @classmethod
+    def inv_freq_from_config(cls, config) -> np.ndarray:
+        d = config.hidden_size // config.num_attention_heads
+        return rope_ops.default_inv_freq(int(d * config.partial_rotary_factor),
+                                         float(config.rope_theta))
+
+    @classmethod
+    def convert_hf_state_dict(cls, state_dict: Dict[str, np.ndarray],
+                              config) -> Dict:
+        def get(name):
+            if name not in state_dict:
+                raise KeyError(f"missing weight {name}")
+            return np.asarray(state_dict[name])
+
+        def lin_t(name):
+            return np.ascontiguousarray(get(name).T)
+
+        layers = {k: [] for k in ("ln1", "ln1_b", "wq", "wk", "wv", "bq", "bk",
+                                  "bv", "wo", "bo", "ln2", "ln2_b", "wg", "bg",
+                                  "wd", "bd")}
+        for i in range(config.num_hidden_layers):
+            p = f"model.layers.{i}."
+            layers["wq"].append(lin_t(p + "self_attn.q_proj.weight"))
+            layers["wk"].append(lin_t(p + "self_attn.k_proj.weight"))
+            layers["wv"].append(lin_t(p + "self_attn.v_proj.weight"))
+            layers["bq"].append(get(p + "self_attn.q_proj.bias"))
+            layers["bk"].append(get(p + "self_attn.k_proj.bias"))
+            layers["bv"].append(get(p + "self_attn.v_proj.bias"))
+            layers["wo"].append(lin_t(p + "self_attn.dense.weight"))
+            layers["bo"].append(get(p + "self_attn.dense.bias"))
+            layers["ln1"].append(get(p + "input_layernorm.weight"))
+            layers["ln1_b"].append(get(p + "input_layernorm.bias"))
+            # shared_ln: ln2 unused but kept for layout uniformity
+            layers["ln2"].append(np.ones_like(get(p + "input_layernorm.weight")))
+            layers["ln2_b"].append(np.zeros_like(get(p + "input_layernorm.bias")))
+            layers["wg"].append(lin_t(p + "mlp.fc1.weight"))
+            layers["bg"].append(get(p + "mlp.fc1.bias"))
+            layers["wd"].append(lin_t(p + "mlp.fc2.weight"))
+            layers["bd"].append(get(p + "mlp.fc2.bias"))
+        return {
+            "embed": get("model.embed_tokens.weight"),
+            "layers": {k: np.stack(v) for k, v in layers.items()},
+            "final_norm": get("model.final_layernorm.weight"),
+            "final_norm_b": get("model.final_layernorm.bias"),
+            "lm_head": lin_t("lm_head.weight"),
+            "lm_head_b": get("lm_head.bias"),
+            "rope_inv_freq": cls.inv_freq_from_config(config),
+        }

@@ -1,0 +1,99 @@
+"""Phi-3-mini on the TPU framework (contrib port, ≈ reference
+`contrib/models/Phi-3-mini-4k-instruct/`).
+
+Llama-shaped (RMSNorm, rope, gated silu MLP) with fused qkv_proj / gate_up_proj
+checkpoints split at conversion.
+"""
+
+from typing import Dict
+
+import numpy as np
+
+from neuronx_distributed_inference_tpu.config import InferenceConfig
+from neuronx_distributed_inference_tpu.models.base import ModelArchArgs
+from neuronx_distributed_inference_tpu.ops import rope as rope_ops
+from neuronx_distributed_inference_tpu.runtime.application import (
+    TpuModelForCausalLM)
+
+
+class Phi3InferenceConfig(InferenceConfig):
+    REQUIRED_ATTRIBUTES = ("hidden_size", "num_hidden_layers",
+                           "num_attention_heads", "num_key_value_heads",
+                           "vocab_size", "intermediate_size")
+
+    def add_derived_config(self) -> None:
+        for attr, default in (("rope_theta", 10000.0), ("rms_norm_eps", 1e-5),
+                              ("hidden_act", "silu"), ("rope_scaling", None),
+                              ("tie_word_embeddings", False)):
+            if not hasattr(self, attr) or getattr(self, attr) is None:
+                setattr(self, attr, default)
+
+
+class Phi3ForCausalLM(TpuModelForCausalLM):
+    @classmethod
+    def get_config_cls(cls):
+        return Phi3InferenceConfig
+
+    @classmethod
+    def arch_args_from_config(cls, config) -> ModelArchArgs:
+        h = config.hidden_size
+        return ModelArchArgs(
+            vocab_size=config.vocab_size,
+            hidden_size=h,
+            num_layers=config.num_hidden_layers,
+            num_heads=config.num_attention_heads,
+            num_kv_heads=config.num_key_value_heads,
+            head_dim=h // config.num_attention_heads,
+            intermediate_size=config.intermediate_size,
+            rms_norm_eps=config.rms_norm_eps,
+            activation=config.hidden_act,
+            tie_word_embeddings=bool(config.tie_word_embeddings),
+        )
+
+    @classmethod
+    def inv_freq_from_config(cls, config) -> np.ndarray:
+        d = config.hidden_size // config.num_attention_heads
+        return rope_ops.default_inv_freq(d, float(config.rope_theta))
+
+    @classmethod
+    def convert_hf_state_dict(cls, state_dict: Dict[str, np.ndarray],
+                              config) -> Dict:
+        h = config.hidden_size
+        d = h // config.num_attention_heads
+        q_size = config.num_attention_heads * d
+        kv_size = config.num_key_value_heads * d
+
+        def get(name):
+            if name not in state_dict:
+                raise KeyError(f"missing weight {name}")
+            return np.asarray(state_dict[name])
+
+        layers = {k: [] for k in ("ln1", "wq", "wk", "wv", "wo", "ln2",
+                                  "wg", "wu", "wd")}
+        for i in range(config.num_hidden_layers):
+            p = f"model.layers.{i}."
+            qkv = get(p + "self_attn.qkv_proj.weight")      # (q+2kv, H)
+            layers["wq"].append(np.ascontiguousarray(qkv[:q_size].T))
+            layers["wk"].append(
+                np.ascontiguousarray(qkv[q_size : q_size + kv_size].T))
+            layers["wv"].append(
+                np.ascontiguousarray(qkv[q_size + kv_size :].T))
+            layers["wo"].append(
+                np.ascontiguousarray(get(p + "self_attn.o_proj.weight").T))
+            gu = get(p + "mlp.gate_up_proj.weight")         # (2I, H)
+            inter = config.intermediate_size
+            layers["wg"].append(np.ascontiguousarray(gu[:inter].T))
+            layers["wu"].append(np.ascontiguousarray(gu[inter:].T))
+            layers["wd"].append(
+                np.ascontiguousarray(get(p + "mlp.down_proj.weight").T))
+            layers["ln1"].append(get(p + "input_layernorm.weight"))
+            layers["ln2"].append(get(p + "post_attention_layernorm.weight"))
+        out = {
+            "embed": get("model.embed_tokens.weight"),
+            "layers": {k: np.stack(v) for k, v in layers.items()},
+            "final_norm": get("model.norm.weight"),
+            "rope_inv_freq": cls.inv_freq_from_config(config),
+        }
+        if not config.tie_word_embeddings:
+            out["lm_head"] = np.ascontiguousarray(get("lm_head.weight").T)
+        return out

@@ -1,0 +1,113 @@
+"""Pythia / GPT-NeoX on the TPU framework (contrib port, ≈ reference
+`contrib/models/pythia-2.8b/`).
+
+Exercises: partial rotary (rotary_pct), parallel residual, per-head-interleaved
+fused query_key_value split, biased LayerNorm, plain gelu MLP, untied output head.
+"""
+
+from typing import Dict
+
+import numpy as np
+
+from neuronx_distributed_inference_tpu.config import InferenceConfig
+from neuronx_distributed_inference_tpu.models.base import ModelArchArgs
+from neuronx_distributed_inference_tpu.ops import rope as rope_ops
+from neuronx_distributed_inference_tpu.runtime.application import (
+    TpuModelForCausalLM)
+
+
+class PythiaInferenceConfig(InferenceConfig):
+    REQUIRED_ATTRIBUTES = ("hidden_size", "num_hidden_layers",
+                           "num_attention_heads", "vocab_size",
+                           "intermediate_size")
+
+    def add_derived_config(self) -> None:
+        for attr, default in (("rotary_pct", 0.25), ("rotary_emb_base", 10000),
+                              ("layer_norm_eps", 1e-5), ("hidden_act", "gelu"),
+                              ("use_parallel_residual", True)):
+            if not hasattr(self, attr) or getattr(self, attr) is None:
+                setattr(self, attr, default)
+
+
+class PythiaForCausalLM(TpuModelForCausalLM):
+    @classmethod
+    def get_config_cls(cls):
+        return PythiaInferenceConfig
+
+    @classmethod
+    def arch_args_from_config(cls, config) -> ModelArchArgs:
+        h = config.hidden_size
+        d = h // config.num_attention_heads
+        return ModelArchArgs(
+            vocab_size=config.vocab_size,
+            hidden_size=h,
+            num_layers=config.num_hidden_layers,
+            num_heads=config.num_attention_heads,
+            num_kv_heads=config.num_attention_heads,
+            head_dim=d,
+            intermediate_size=config.intermediate_size,
+            rms_norm_eps=config.layer_norm_eps,
+            activation=config.hidden_act,
+            norm_type="layer", norm_bias=True,
+            mlp_kind="plain", mlp_bias=True,
+            attention_bias=True, o_bias=True,
+            parallel_residual=bool(config.use_parallel_residual),
+            rotary_dim=int(d * config.rotary_pct),
+        )
+
+    @classmethod
+    def inv_freq_from_config(cls, config) -> np.ndarray:
+        d = config.hidden_size // config.num_attention_heads
+        return rope_ops.default_inv_freq(int(d * config.rotary_pct),
+                                         float(config.rotary_emb_base))
+
+    @classmethod
+    def convert_hf_state_dict(cls, state_dict: Dict[str, np.ndarray],
+                              config) -> Dict:
+        h = config.hidden_size
+        nh = config.num_attention_heads
+        d = h // nh
+
+        def get(name):
+            if name not in state_dict:
+                raise KeyError(f"missing weight {name}")
+            return np.asarray(state_dict[name])
+
+        layers = {k: [] for k in ("ln1", "ln1_b", "wq", "wk", "wv", "bq", "bk",
+                                  "bv", "wo", "bo", "ln2", "ln2_b", "wg", "bg",
+                                  "wd", "bd")}
+        for i in range(config.num_hidden_layers):
+            p = f"gpt_neox.layers.{i}."
+            # fused QKV is interleaved per head: rows [h0_q, h0_k, h0_v, h1_q, ...]
+            qkv = get(p + "attention.query_key_value.weight").reshape(nh, 3, d, h)
+            qkv_b = get(p + "attention.query_key_value.bias").reshape(nh, 3, d)
+            layers["wq"].append(
+                np.ascontiguousarray(qkv[:, 0].reshape(nh * d, h).T))
+            layers["wk"].append(
+                np.ascontiguousarray(qkv[:, 1].reshape(nh * d, h).T))
+            layers["wv"].append(
+                np.ascontiguousarray(qkv[:, 2].reshape(nh * d, h).T))
+            layers["bq"].append(qkv_b[:, 0].reshape(-1))
+            layers["bk"].append(qkv_b[:, 1].reshape(-1))
+            layers["bv"].append(qkv_b[:, 2].reshape(-1))
+            layers["wo"].append(
+                np.ascontiguousarray(get(p + "attention.dense.weight").T))
+            layers["bo"].append(get(p + "attention.dense.bias"))
+            layers["ln1"].append(get(p + "input_layernorm.weight"))
+            layers["ln1_b"].append(get(p + "input_layernorm.bias"))
+            layers["ln2"].append(get(p + "post_attention_layernorm.weight"))
+            layers["ln2_b"].append(get(p + "post_attention_layernorm.bias"))
+            layers["wg"].append(
+                np.ascontiguousarray(get(p + "mlp.dense_h_to_4h.weight").T))
+            layers["bg"].append(get(p + "mlp.dense_h_to_4h.bias"))
+            layers["wd"].append(
+                np.ascontiguousarray(get(p + "mlp.dense_4h_to_h.weight").T))
+            layers["bd"].append(get(p + "mlp.dense_4h_to_h.bias"))
+        return {
+            "embed": get("gpt_neox.embed_in.weight"),
+            "layers": {k: np.stack(v) for k, v in layers.items()},
+            "final_norm": get("gpt_neox.final_layer_norm.weight"),
+            "final_norm_b": get("gpt_neox.final_layer_norm.bias"),
+            "lm_head": np.ascontiguousarray(get("embed_out.weight").T),
+            "rope_inv_freq": cls.inv_freq_from_config(config),
+        }

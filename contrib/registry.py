@@ -1,0 +1,21 @@
+"""Contrib model hub registry (≈ reference `contrib/models/` community ports).
+
+Importing this module registers every contrib family with the main model registry,
+so `get_model_cls(model_type)` and the CLI resolve them like first-class families.
+"""
+
+from neuronx_distributed_inference_tpu.models import register_model
+
+CONTRIB_MODELS = {
+    "gpt2": "contrib.models.gpt2.src.modeling_gpt2:GPT2ForCausalLM",
+    "opt": "contrib.models.opt.src.modeling_opt:OPTForCausalLM",
+    "gpt_neox": "contrib.models.pythia.src.modeling_pythia:PythiaForCausalLM",
+    "phi": "contrib.models.phi.src.modeling_phi:PhiForCausalLM",
+    "phi3": "contrib.models.phi3.src.modeling_phi3:Phi3ForCausalLM",
+    "starcoder2":
+        "contrib.models.starcoder2.src.modeling_starcoder2:Starcoder2ForCausalLM",
+    "falcon": "contrib.models.falcon.src.modeling_falcon:FalconForCausalLM",
+}
+
+for model_type, path in CONTRIB_MODELS.items():
+    register_model(model_type, path)

@@ -79,6 +79,15 @@ class ModelArchArgs:
     # cos/sin magnitude for sliding layers under a layer_pattern (gpt-oss shares the
     # yarn factor across both layer kinds; gemma3's local rope is unscaled)
     local_rope_attention_scaling: float = 1.0
+    # --- contrib-arch primitives (gpt2/opt/pythia/phi/starcoder2/falcon) ---
+    learned_pos: bool = False        # learned position embeddings (params.pos_embed);
+    #                                  rope disabled via a zero inv_freq table
+    pos_offset: int = 0              # OPT adds 2 to every position index
+    norm_bias: bool = False          # LayerNorm with bias params (ln1_b/ln2_b/...)
+    mlp_kind: str = "gated"          # "gated" (silu gate*up) | "plain" (fc -> act -> fc)
+    parallel_residual: bool = False  # h = x + attn(ln1(x)) + mlp(ln2(x) or ln1(x))
+    shared_ln: bool = False          # parallel residual reusing ONE norm (falcon-7b)
+    rotary_dim: Optional[int] = None  # partial rotary (phi/gpt-neox rotary_pct)
     # MoE FFN (Mixtral/Qwen3-MoE/DBRX); None = dense MLP. See ops/moe.py.
     moe: Optional["MoEArgs"] = None
     # static multi-LoRA serving (see modules/lora.py); None = disabled
@@ -103,6 +112,8 @@ def param_logical_axes(args: ModelArchArgs) -> Params:
         "wo": ("layers", "heads", "embed"),
         "ln2": ("layers", None),
     }
+    if args.norm_bias:
+        layer.update({"ln1_b": ("layers", None), "ln2_b": ("layers", None)})
     if args.moe is not None:
         layer.update({
             "router": ("layers", "embed", None),
@@ -128,6 +139,13 @@ def param_logical_axes(args: ModelArchArgs) -> Params:
             })
             if args.moe.shared_expert_gated:
                 layer["shared_gate"] = ("layers", "embed", None)
+    elif args.mlp_kind == "plain":
+        layer.update({
+            "wg": ("layers", "embed", "mlp"),
+            "wd": ("layers", "mlp", "embed"),
+        })
+        if args.mlp_bias:
+            layer.update({"bg": ("layers", "mlp"), "bd": ("layers", None)})
     else:
         layer.update({
             "wg": ("layers", "embed", "mlp"),
@@ -158,6 +176,10 @@ def param_logical_axes(args: ModelArchArgs) -> Params:
         "final_norm": (None,),
         "rope_inv_freq": (None,),
     }
+    if args.norm_bias:
+        out["final_norm_b"] = (None,)
+    if args.learned_pos:
+        out["pos_embed"] = (None, "embed")
     if args.local_rope_theta is not None:
         out["rope_inv_freq_local"] = (None,)
     if not args.tie_word_embeddings:
@@ -210,12 +232,23 @@ def init_params(args: ModelArchArgs, key: jax.Array, dtype=jnp.bfloat16,
             })
             if args.moe.shared_expert_gated:
                 layers["shared_gate"] = w(ks[13], (L, H, 1))
+    elif args.mlp_kind == "plain":
+        layers.update({
+            "wg": w(ks[4], (L, H, I)),
+            "wd": w(ks[6], (L, I, H)),
+        })
+        if args.mlp_bias:
+            layers.update({"bg": jnp.zeros((L, I), dtype=dtype),
+                           "bd": jnp.zeros((L, H), dtype=dtype)})
     else:
         layers.update({
             "wg": w(ks[4], (L, H, I)),
             "wu": w(ks[5], (L, H, I)),
             "wd": w(ks[6], (L, I, H)),
         })
+    if args.norm_bias:
+        layers.update({"ln1_b": jnp.zeros((L, H), dtype=dtype),
+                       "ln2_b": jnp.zeros((L, H), dtype=dtype)})
     if args.attention_bias:
         layers.update({
             "bq": jnp.zeros((L, args.q_size), dtype=dtype),
@@ -246,13 +279,20 @@ def init_params(args: ModelArchArgs, key: jax.Array, dtype=jnp.bfloat16,
         layers["ln1"] = jnp.zeros((L, H), dtype=dtype)
         layers["ln2"] = jnp.zeros((L, H), dtype=dtype)
     if inv_freq is None:
-        inv_freq = rope_ops.default_inv_freq(args.head_dim)
+        if args.learned_pos:
+            inv_freq = np.zeros((args.head_dim // 2,), np.float32)  # rope = identity
+        else:
+            inv_freq = rope_ops.default_inv_freq(args.rotary_dim or args.head_dim)
     params = {
         "embed": w(ks[7], (args.vocab_size, H)),
         "layers": layers,
         "final_norm": jnp.full((H,), norm_fill, dtype=dtype),
         "rope_inv_freq": jnp.asarray(inv_freq, dtype=jnp.float32),
     }
+    if args.norm_bias:
+        params["final_norm_b"] = jnp.zeros((H,), dtype=dtype)
+    if args.learned_pos:
+        params["pos_embed"] = w(ks[9], (4096 + args.pos_offset, H))
     if args.local_rope_theta is not None:
         params["rope_inv_freq_local"] = jnp.asarray(
             rope_ops.default_inv_freq(args.head_dim, args.local_rope_theta),
@@ -265,16 +305,33 @@ def init_params(args: ModelArchArgs, key: jax.Array, dtype=jnp.bfloat16,
 _ACTIVATIONS = {
     "silu": jax.nn.silu,
     "gelu": jax.nn.gelu,
+    "gelu_new": lambda x: jax.nn.gelu(x, approximate=True),
     "gelu_pytorch_tanh": lambda x: jax.nn.gelu(x, approximate=True),
+    "relu": jax.nn.relu,
 }
 
 
-def _norm(x: jnp.ndarray, weight: jnp.ndarray, args: "ModelArchArgs") -> jnp.ndarray:
-    """Hidden-state norm: RMSNorm by default, bias-free LayerNorm for DBRX."""
+def _norm(x: jnp.ndarray, weight: jnp.ndarray, args: "ModelArchArgs",
+          bias=None) -> jnp.ndarray:
+    """Hidden-state norm: RMSNorm by default, LayerNorm (optionally biased) for
+    DBRX/GPT-style archs."""
     if args.norm_type == "layer":
-        return layer_norm(x, weight, jnp.zeros_like(weight), eps=args.rms_norm_eps)
+        return layer_norm(x, weight,
+                          bias if bias is not None else jnp.zeros_like(weight),
+                          eps=args.rms_norm_eps)
     return rms_norm(x, weight, args.rms_norm_eps,
                     zero_centered=args.zero_centered_norms)
+
+
+def _apply_rope(args: ModelArchArgs, q, k, cos, sin):
+    """Rotary application with optional partial rotary dims (phi/gpt-neox
+    rotary_pct): only the first ``rotary_dim`` channels rotate."""
+    rd = args.rotary_dim
+    if rd is None or rd == args.head_dim:
+        return rope_ops.apply_rotary(q, k, cos, sin)
+    q1, k1 = rope_ops.apply_rotary(q[..., :rd], k[..., :rd], cos, sin)
+    return (jnp.concatenate([q1, q[..., rd:]], axis=-1),
+            jnp.concatenate([k1, k[..., rd:]], axis=-1))
 
 
 def _project_qkv(lp: Params, args: ModelArchArgs, hn: jnp.ndarray,
@@ -311,6 +368,16 @@ def _project_qkv(lp: Params, args: ModelArchArgs, hn: jnp.ndarray,
 def _mlp(lp: Params, args: ModelArchArgs, hn: jnp.ndarray, mesh, rules,
          adapter_ids=None) -> jnp.ndarray:
     act = _ACTIVATIONS[args.activation]
+    if args.mlp_kind == "plain":
+        # fc -> act -> fc (GPT-style, optionally biased)
+        inter = qapply(hn, lp["wg"])
+        if args.mlp_bias:
+            inter = inter + lp["bg"]
+        inter = constrain(act(inter), ("batch", None, "mlp"), rules, mesh=mesh)
+        down = qapply(inter, lp["wd"])
+        if args.mlp_bias:
+            down = down + lp["bd"]
+        return down
     gate = qapply(hn, lp["wg"])
     up = qapply(hn, lp["wu"])
     if args.lora is not None:
@@ -325,23 +392,24 @@ def _mlp(lp: Params, args: ModelArchArgs, hn: jnp.ndarray, mesh, rules,
     return down
 
 
-def _sharded_kv_write(cache, new_kv, positions, layer_idx, mesh, rules):
-    """Stacked-cache decode KV write (Pallas DMA scatter) under the mesh.
+def _sharded_kv_write(k_cache, v_cache, new_k, new_v, positions, layer_idx, mesh,
+                      rules):
+    """Stacked-cache decode K+V write (one Pallas DMA-scatter kernel) under the mesh.
 
     ≈ the reference's batched KV write kernel (`modules/kvcache/utils.py:20-38`):
-    one strided DMA per batch row instead of the serial per-row while loop XLA
-    lowers a vmapped dynamic_update_slice to."""
+    overlapped strided DMAs instead of the serial per-row while loop XLA lowers a
+    vmapped dynamic_update_slice to."""
     from ..modules.kvcache import CACHE_LOGICAL
-    from ..ops.flash_decode import write_decode_stacked
+    from ..ops.flash_decode import write_decode_stacked_kv
     from ..parallel.sharding import DEFAULT_RULES, logical_to_spec
 
     interpret = jax.default_backend() == "cpu"
 
-    def _local(c, n, p, li):
-        return write_decode_stacked(c, n, p, li, interpret=interpret)
+    def _local(ck, cv, nk, nv, p, li):
+        return write_decode_stacked_kv(ck, cv, nk, nv, p, li, interpret=interpret)
 
     if mesh is None:
-        return _local(cache, new_kv, positions, layer_idx)
+        return _local(k_cache, v_cache, new_k, new_v, positions, layer_idx)
     from jax.sharding import PartitionSpec as P
 
     r = rules or DEFAULT_RULES
@@ -349,9 +417,10 @@ def _sharded_kv_write(cache, new_kv, positions, layer_idx, mesh, rules):
     new_spec = logical_to_spec(("decode_batch", "decode_kv_heads", None, None), r)
     pos_spec = logical_to_spec(("decode_batch",), r)
     fn = jax.shard_map(_local, mesh=mesh,
-                       in_specs=(cache_spec, new_spec, pos_spec, P()),
-                       out_specs=cache_spec, check_vma=False)
-    return fn(cache, new_kv, positions, layer_idx)
+                       in_specs=(cache_spec, cache_spec, new_spec, new_spec,
+                                 pos_spec, P()),
+                       out_specs=(cache_spec, cache_spec), check_vma=False)
+    return fn(k_cache, v_cache, new_k, new_v, positions, layer_idx)
 
 
 def _sharded_decode_attend(q, k_cache, v_cache, positions, layer_idx, bucket,
@@ -441,7 +510,7 @@ def _decoder_layer(
     rolling_lengths: Optional[jnp.ndarray] = None,
 ):
     resid = h
-    hn = _norm(h, lp["ln1"], args)
+    hn = _norm(h, lp["ln1"], args, lp.get("ln1_b"))
     q, k, v = _project_qkv(lp, args, hn, adapter_ids)
     if positions is None:
         # prefill activations shard along seq over cp (sequence/context parallelism,
@@ -459,16 +528,15 @@ def _decoder_layer(
                       mesh=mesh)
         v = constrain(v, ("decode_batch", "decode_kv_heads", None, None), rules,
                       mesh=mesh)
-    q, k = rope_ops.apply_rotary(q, k, cos, sin)
+    q, k = _apply_rope(args, q, k, cos, sin)
 
     if stacked_layer_idx is not None:
         # kernel decode path: the stacked cache is carried whole (never sliced or
         # re-stacked by scan) — write the step's rows with a DMA scatter, then run
         # the length-aware Pallas decode-attention kernel over this layer
-        k_cache = _sharded_kv_write(k_cache, k.astype(k_cache.dtype), positions,
-                                    stacked_layer_idx, mesh, rules)
-        v_cache = _sharded_kv_write(v_cache, v.astype(v_cache.dtype), positions,
-                                    stacked_layer_idx, mesh, rules)
+        k_cache, v_cache = _sharded_kv_write(
+            k_cache, v_cache, k.astype(k_cache.dtype), v.astype(v_cache.dtype),
+            positions, stacked_layer_idx, mesh, rules)
         attn = _sharded_decode_attend(q, k_cache, v_cache, positions,
                                       stacked_layer_idx, decode_bucket, args,
                                       mesh, rules)
@@ -482,10 +550,17 @@ def _decoder_layer(
         attn_out = constrain(attn_out, ("batch", None, None), rules, mesh=mesh)
         if args.sandwich_norms:
             attn_out = _norm(attn_out, lp["ln1_post"], args)
+        if args.parallel_residual:
+            mlp_in = (hn if args.shared_ln
+                      else _norm(resid, lp["ln2"], args, lp.get("ln2_b")))
+            ffn = _mlp(lp, args, mlp_in, mesh, rules, adapter_ids)
+            h = resid + attn_out + constrain(ffn, ("batch", None, None), rules,
+                                             mesh=mesh)
+            return h, k_cache, v_cache
         h = resid + attn_out
 
         resid = h
-        hn = _norm(h, lp["ln2"], args)
+        hn = _norm(h, lp["ln2"], args, lp.get("ln2_b"))
         if args.moe is not None:
             ffn = moe_block(lp, args, hn, mesh, rules, _ACTIVATIONS[args.activation])
         else:
@@ -573,10 +648,19 @@ def _decoder_layer(
     attn_out = constrain(attn_out, ("batch", None, None), rules, mesh=mesh)
     if args.sandwich_norms:
         attn_out = _norm(attn_out, lp["ln1_post"], args)
+    if args.parallel_residual:
+        # GPT-NeoX / phi / falcon-style: attention and MLP both branch off the
+        # residual; shared_ln reuses ln1's output as the MLP input
+        mlp_in = (hn if args.shared_ln
+                  else _norm(resid, lp["ln2"], args, lp.get("ln2_b")))
+        ffn = _mlp(lp, args, mlp_in, mesh, rules, adapter_ids)
+        h = resid + attn_out + constrain(ffn, ("batch", None, None), rules,
+                                         mesh=mesh)
+        return h, k_cache, v_cache
     h = resid + attn_out
 
     resid = h
-    hn = _norm(h, lp["ln2"], args)
+    hn = _norm(h, lp["ln2"], args, lp.get("ln2_b"))
     if args.moe is not None:
         ffn = moe_block(lp, args, hn, mesh, rules, _ACTIVATIONS[args.activation])
     else:
@@ -755,6 +839,8 @@ def _lm_head(params: Params, args: ModelArchArgs, h, mesh, rules) -> jnp.ndarray
         logits = (h @ params["embed"].T).astype(jnp.float32)
     else:
         logits = qapply(h, params["lm_head"]).astype(jnp.float32)
+    if "lm_head_b" in params:           # phi-style biased output head
+        logits = logits + params["lm_head_b"].astype(jnp.float32)
     logical = ("batch", "vocab") if logits.ndim == 2 else ("batch", None, "vocab")
     return constrain(logits, logical, rules, mesh=mesh)
 
@@ -794,6 +880,9 @@ def prefill_forward(
     from ..utils.tensor_capture import tap
 
     h = _embed(params, args, input_ids, mesh, rules)
+    if args.learned_pos:
+        h = h + jnp.take(params["pos_embed"], position_ids + args.pos_offset,
+                         axis=0).astype(h.dtype)
     if merge_embeds is not None:
         mm_mask, mm_override = merge_embeds
         h = jnp.where(mm_mask, mm_override.astype(h.dtype), h)
@@ -823,7 +912,7 @@ def prefill_forward(
             positions=None, decode_bucket=None, mesh=mesh, rules=rules,
             use_flash=use_flash, cache_batch_start=cache_batch_start,
             adapter_ids=adapter_ids, true_lengths=last_token_idx + 1)
-        h = tap("final_hidden", _norm(h, params["final_norm"], args))
+        h = tap("final_hidden", _norm(h, params["final_norm"], args, params.get("final_norm_b")))
         h_last = jnp.take_along_axis(h, last_token_idx[:, None, None], axis=1)[:, 0]
         logits = tap("logits", _lm_head(params, args, h_last, mesh, rules))
         if return_hidden:
@@ -845,7 +934,7 @@ def prefill_forward(
                      ring_positions=position_ids if use_ring else None,
                      capture_layers=capture_layers)
     h, cache = out[0], out[1]
-    h = tap("final_hidden", _norm(h, params["final_norm"], args))
+    h = tap("final_hidden", _norm(h, params["final_norm"], args, params.get("final_norm_b")))
     h_last = jnp.take_along_axis(h, last_token_idx[:, None, None], axis=1)[:, 0]
     logits = tap("logits", _lm_head(params, args, h_last, mesh, rules))
     res = (logits, cache)
@@ -906,6 +995,9 @@ def decode_forward(
     else:
         depths, ancestor = tree
         pos_grid = position_ids[:, None] + jnp.asarray(depths, jnp.int32)[None, :]
+    if args.learned_pos:
+        h = h + jnp.take(params["pos_embed"], pos_grid + args.pos_offset,
+                         axis=0).astype(h.dtype)
     rope_pos = pos_grid
     if "rope_delta" in cache:
         # M-RoPE decode: all three position dims advance together past the prompt,
@@ -923,7 +1015,7 @@ def decode_forward(
             params, args, h, cos, sin, cache, positions=position_ids,
             decode_bucket=decode_bucket, mesh=mesh, rules=rules,
             adapter_ids=adapter_ids)
-        h = _norm(h, params["final_norm"], args)
+        h = _norm(h, params["final_norm"], args, params.get("final_norm_b"))
         logits = _lm_head(params, args, h, mesh, rules)
         if return_hidden:
             return logits, cache, h
@@ -965,7 +1057,7 @@ def decode_forward(
             params, args, h, (cos, sin, mask), (cos_l, sin_l, mask_slide), cache,
             positions=position_ids, decode_bucket=decode_bucket, mesh=mesh,
             rules=rules, adapter_ids=adapter_ids)
-        h = _norm(h, params["final_norm"], args)
+        h = _norm(h, params["final_norm"], args, params.get("final_norm_b"))
         logits = _lm_head(params, args, h, mesh, rules)
         if return_hidden:
             return logits, cache, h
@@ -979,7 +1071,7 @@ def decode_forward(
                      paged=paged, adapter_ids=adapter_ids,
                      window_row=window_row, capture_layers=capture_layers)
     h, cache = out[0], out[1]
-    h = _norm(h, params["final_norm"], args)
+    h = _norm(h, params["final_norm"], args, params.get("final_norm_b"))
     logits = _lm_head(params, args, h, mesh, rules)
     res = (logits, cache)
     if return_hidden:

@@ -245,12 +245,117 @@ def write_decode_stacked(
       new_kv, cache)
 
 
+def _kv_write_kv_kernel(pos_ref, lidx_ref, new_k_ref, new_v_ref, _k_in, _v_in,
+                        k_out, v_out, sk, sv, sems, *, t: int, pack: int, win: int,
+                        s_max: int, bb: int):
+    """Combined K+V write, ``bb`` batch rows per cell, all DMAs overlapped."""
+    bi = pl.program_id(0)
+    l = lidx_ref[0]
+    w0s = []
+    for j in range(bb):
+        pos = pos_ref[bi * bb + j]
+        w0 = jnp.minimum(pos // pack, (s_max - win) // pack) * pack
+        w0s.append(w0)
+        pltpu.make_async_copy(k_out.at[l, bi * bb + j, :, pl.ds(w0, win), :],
+                              sk.at[j], sems.at[j, 0]).start()
+        pltpu.make_async_copy(v_out.at[l, bi * bb + j, :, pl.ds(w0, win), :],
+                              sv.at[j], sems.at[j, 1]).start()
+    for j in range(bb):
+        pltpu.make_async_copy(k_out.at[l, bi * bb + j, :, pl.ds(w0s[j], win), :],
+                              sk.at[j], sems.at[j, 0]).wait()
+        pltpu.make_async_copy(v_out.at[l, bi * bb + j, :, pl.ds(w0s[j], win), :],
+                              sv.at[j], sems.at[j, 1]).wait()
+    off = (jnp.stack([pos_ref[bi * bb + j] for j in range(bb)])
+           - jnp.stack(w0s))                                     # (bb,)
+    iota = jax.lax.broadcasted_iota(jnp.int32, (bb, 1, win, 1), 2)
+    sel0 = off[:, None, None, None]
+    vk, vv = sk[:], sv[:]
+    for j in range(t):
+        hit = iota == sel0 + j
+        vk = jnp.where(hit, new_k_ref[:, :, j : j + 1, :], vk)
+        vv = jnp.where(hit, new_v_ref[:, :, j : j + 1, :], vv)
+    sk[:] = vk
+    sv[:] = vv
+    for j in range(bb):
+        pltpu.make_async_copy(sk.at[j],
+                              k_out.at[l, bi * bb + j, :, pl.ds(w0s[j], win), :],
+                              sems.at[j, 0]).start()
+        pltpu.make_async_copy(sv.at[j],
+                              v_out.at[l, bi * bb + j, :, pl.ds(w0s[j], win), :],
+                              sems.at[j, 1]).start()
+    for j in range(bb):
+        pltpu.make_async_copy(sk.at[j],
+                              k_out.at[l, bi * bb + j, :, pl.ds(w0s[j], win), :],
+                              sems.at[j, 0]).wait()
+        pltpu.make_async_copy(sv.at[j],
+                              v_out.at[l, bi * bb + j, :, pl.ds(w0s[j], win), :],
+                              sems.at[j, 1]).wait()
+
+
+def _batch_block(b: int) -> int:
+    for bb in (8, 4, 2):
+        if b % bb == 0:
+            return bb
+    return 1
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def write_decode_stacked_kv(
+    k_cache: jnp.ndarray,        # (L, B, Hkv, S, D) — donated/aliased in place
+    v_cache: jnp.ndarray,
+    new_k: jnp.ndarray,          # (B, Hkv, T, D), already in cache dtype
+    new_v: jnp.ndarray,
+    positions: jnp.ndarray,      # (B,) int32 write position per row
+    layer_idx: jnp.ndarray,      # () int32 layer to write
+    interpret: bool = False,
+):
+    """Scatter the step's K and V rows into both stacked caches in ONE kernel
+    (the reference's batched-KV-write kernel analog, `kvcache/utils.py:20-38`):
+    tile-aligned read-modify-write windows, DMAs for ``bb`` rows in flight at once."""
+    b, h, t, d = new_k.shape
+    s_max = k_cache.shape[3]
+    pack = 8 * max(1, 4 // jnp.dtype(k_cache.dtype).itemsize)
+    win = _round_up(t + pack - 1, pack)
+    if s_max % pack != 0 or s_max < win:
+        raise ValueError(f"cache seq dim {s_max} must be a multiple of {pack} "
+                         f"and at least {win}")
+    bb = _batch_block(b)
+    kernel = functools.partial(_kv_write_kv_kernel, t=t, pack=pack, win=win,
+                               s_max=s_max, bb=bb)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b // bb,),
+        in_specs=[
+            pl.BlockSpec((bb, h, t, d), lambda bi, *_: (bi, 0, 0, 0)),
+            pl.BlockSpec((bb, h, t, d), lambda bi, *_: (bi, 0, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=[pl.BlockSpec(memory_space=pl.ANY),
+                   pl.BlockSpec(memory_space=pl.ANY)],
+        scratch_shapes=[
+            pltpu.VMEM((bb, h, win, d), k_cache.dtype),
+            pltpu.VMEM((bb, h, win, d), v_cache.dtype),
+            pltpu.SemaphoreType.DMA((bb, 2)),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct(k_cache.shape, k_cache.dtype),
+                   jax.ShapeDtypeStruct(v_cache.shape, v_cache.dtype)],
+        input_output_aliases={4: 0, 5: 1},   # caches (after 2 prefetch + 2 new)
+        interpret=interpret,
+    )(positions.astype(jnp.int32), layer_idx.reshape(1).astype(jnp.int32),
+      new_k, new_v, k_cache, v_cache)
+
+
 def _stacked_decode_kernel(pos_ref, lidx_ref, q_ref, k_ref, v_ref, o_ref, m_scratch,
                            l_scratch, acc_scratch, *, scale: float, block_k: int,
-                           num_kv_blocks: int, t: int, rows: int,
-                           window: Optional[int]):
+                           num_kv_blocks: int, t: int, rows: int, bb: int,
+                           hkv: int, window: Optional[int]):
     bi = pl.program_id(0)
-    ki = pl.program_id(2)
+    ki = pl.program_id(1)
     k_start = ki * block_k
 
     @pl.when(ki == 0)
@@ -259,47 +364,56 @@ def _stacked_decode_kernel(pos_ref, lidx_ref, q_ref, k_ref, v_ref, o_ref, m_scra
         l_scratch[:] = jnp.zeros_like(l_scratch)
         acc_scratch[:] = jnp.zeros_like(acc_scratch)
 
-    pos = pos_ref[bi]
-    max_q_pos = pos + t - 1
-    run = k_start <= max_q_pos
+    pos = jnp.stack([pos_ref[bi * bb + j] for j in range(bb)])     # (bb,)
+    run = k_start <= jnp.max(pos) + t - 1
     if window is not None:
-        run = jnp.logical_and(run, k_start + block_k - 1 > pos - window)
+        run = jnp.logical_and(run, k_start + block_k - 1 > jnp.min(pos) - window)
 
     @pl.when(run)
     def _body():
-        q = q_ref[0, 0]                          # (rows, D)
-        k = k_ref[0, 0, 0].astype(q.dtype)       # (block_k, D); fp8 cache casts here
-        v = v_ref[0, 0, 0].astype(q.dtype)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        row_idx = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-        q_pos = pos + row_idx % t
-        kv_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        mask = kv_pos <= q_pos
-        if window is not None:
-            mask = jnp.logical_and(mask, kv_pos > q_pos - window)
-        s = jnp.where(mask, s, NEG_INF)
-
-        m_prev = m_scratch[:, 0:1]
-        l_prev = l_scratch[:, 0:1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        alpha = jnp.exp(jnp.minimum(m_prev - m_new, 0.0))
-        p = jnp.exp(s - m_new)
-        p = jnp.where(mask, p, 0.0)
-        l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
-        acc = acc_scratch[:] * alpha + jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        m_scratch[:] = jnp.broadcast_to(m_new, m_scratch.shape)
-        l_scratch[:] = jnp.broadcast_to(l_new, l_scratch.shape)
-        acc_scratch[:] = acc
+        # static (bb x hkv) loop keeps every op 2D (Mosaic's comfort zone: its
+        # reshape/layout inference rejects multi-dim collapses); the loop unrolls
+        # into straight-line vector code inside ONE big grid cell, so the per-cell
+        # fixed cost amortizes over all heads and bb batch rows
+        kv_iota = jax.lax.broadcasted_iota(jnp.int32, (rows, block_k), 1) + k_start
+        row_iota = jax.lax.broadcasted_iota(jnp.int32, (rows, block_k), 0)
+        for j in range(bb):
+            q_pos = pos[j] + row_iota % t
+            mask = kv_iota <= q_pos
+            if window is not None:
+                mask = jnp.logical_and(mask, kv_iota > q_pos - window)
+            for h in range(hkv):
+                r0 = (j * hkv + h) * rows
+                q = q_ref[j, h]                          # (rows, D)
+                k = k_ref[0, j, h].astype(q.dtype)       # (block_k, D)
+                v = v_ref[0, j, h].astype(q.dtype)
+                s = jax.lax.dot_general(
+                    q, k, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32) * scale
+                s = jnp.where(mask, s, NEG_INF)
+                m_prev = m_scratch[r0 : r0 + rows, 0:1]
+                l_prev = l_scratch[r0 : r0 + rows, 0:1]
+                m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+                alpha = jnp.exp(jnp.minimum(m_prev - m_new, 0.0))
+                p = jnp.exp(s - m_new)
+                p = jnp.where(mask, p, 0.0)
+                l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+                acc = acc_scratch[r0 : r0 + rows] * alpha + jax.lax.dot_general(
+                    p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                m_scratch[r0 : r0 + rows] = jnp.broadcast_to(m_new, (rows, 128))
+                l_scratch[r0 : r0 + rows] = jnp.broadcast_to(l_new, (rows, 128))
+                acc_scratch[r0 : r0 + rows] = acc
 
     @pl.when(ki == num_kv_blocks - 1)
     def _finalize():
-        l = l_scratch[:, 0:1]
-        l_safe = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0, 0] = (acc_scratch[:] / l_safe).astype(o_ref.dtype)
+        for j in range(bb):
+            for h in range(hkv):
+                r0 = (j * hkv + h) * rows
+                l = l_scratch[r0 : r0 + rows, 0:1]
+                l_safe = jnp.where(l == 0.0, 1.0, l)
+                o_ref[j, h] = (acc_scratch[r0 : r0 + rows] / l_safe
+                               ).astype(o_ref.dtype)
 
 
 @functools.partial(
@@ -345,26 +459,30 @@ def flash_decode_attention_stacked(
     else:
         block_k = s_max              # tiny/test configs: one block, no tiling
     num_kv_blocks = -(-bucket // block_k)
+    bb = _batch_block(b)
 
     kernel = functools.partial(
         _stacked_decode_kernel, scale=scale, block_k=block_k,
-        num_kv_blocks=num_kv_blocks, t=t, rows=rows, window=window)
+        num_kv_blocks=num_kv_blocks, t=t, rows=rows, bb=bb, hkv=hkv,
+        window=window)
 
+    # coarse grid: bb batch rows x ALL kv heads per cell — per-cell work must
+    # dominate the fixed per-cell cost or the kernel is overhead-bound
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(b, hkv, num_kv_blocks),
+        grid=(b // bb, num_kv_blocks),
         in_specs=[
-            pl.BlockSpec((1, 1, rows, d), lambda bi, hi, ki, *_: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, 1, 1, block_k, d),
-                         lambda bi, hi, ki, pos, lidx: (lidx[0], bi, hi, ki, 0)),
-            pl.BlockSpec((1, 1, 1, block_k, d),
-                         lambda bi, hi, ki, pos, lidx: (lidx[0], bi, hi, ki, 0)),
+            pl.BlockSpec((bb, hkv, rows, d), lambda bi, ki, *_: (bi, 0, 0, 0)),
+            pl.BlockSpec((1, bb, hkv, block_k, d),
+                         lambda bi, ki, pos, lidx: (lidx[0], bi, 0, ki, 0)),
+            pl.BlockSpec((1, bb, hkv, block_k, d),
+                         lambda bi, ki, pos, lidx: (lidx[0], bi, 0, ki, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, rows, d), lambda bi, hi, ki, *_: (bi, hi, 0, 0)),
+        out_specs=pl.BlockSpec((bb, hkv, rows, d), lambda bi, ki, *_: (bi, 0, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((rows, 128), jnp.float32),
-            pltpu.VMEM((rows, 128), jnp.float32),
-            pltpu.VMEM((rows, d), jnp.float32),
+            pltpu.VMEM((bb * hkv * rows, 128), jnp.float32),
+            pltpu.VMEM((bb * hkv * rows, 128), jnp.float32),
+            pltpu.VMEM((bb * hkv * rows, d), jnp.float32),
         ],
     )
     out = pl.pallas_call(

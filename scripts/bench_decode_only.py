@@ -1,0 +1,73 @@
+"""Fast decode-step timing for the 8B bench config (params cached on disk after the
+first run). Prints per-step ms + tok/s, and token parity kernel-vs-jnp."""
+import os
+import pickle
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+CACHE = "/tmp/bench8b_params.pkl"
+
+
+def get_params(hf_cfg):
+    import bench
+    if os.path.exists(CACHE):
+        with open(CACHE, "rb") as f:
+            return pickle.load(f)
+    p = bench._random_quantized_llama_params(hf_cfg, seed=0)
+    with open(CACHE, "wb") as f:
+        pickle.dump(p, f, protocol=4)
+    return p
+
+
+def main():
+    from neuronx_distributed_inference_tpu.config import (
+        QuantizationConfig, TpuConfig, load_pretrained_config)
+    from neuronx_distributed_inference_tpu.models.llama.modeling_llama import (
+        LlamaForCausalLM, LlamaInferenceConfig)
+
+    hf_cfg = {
+        "model_type": "llama", "vocab_size": 128256, "hidden_size": 4096,
+        "intermediate_size": 14336, "num_hidden_layers": 32,
+        "num_attention_heads": 32, "num_key_value_heads": 8, "head_dim": 128,
+        "max_position_embeddings": 131072, "rms_norm_eps": 1e-5,
+        "rope_theta": 500000.0,
+        "rope_scaling": {"rope_type": "llama3", "factor": 8.0,
+                         "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                         "original_max_position_embeddings": 8192},
+        "tie_word_embeddings": False,
+    }
+    batch = int(os.environ.get("BENCH_BS", "64"))
+    kernel = os.environ.get("BENCH_KERNEL", "1") == "1"
+    quant = QuantizationConfig(quantize_weights=True, weight_dtype="int8",
+                               kv_cache_dtype="float8_e4m3")
+    tpu_cfg = TpuConfig(batch_size=batch, seq_len=512, max_context_length=256,
+                        dtype="bfloat16", tp_degree=1,
+                        context_encoding_buckets=[128, 256],
+                        token_generation_buckets=[256, 512],
+                        quantization_config=quant,
+                        decode_kernel_enabled=kernel)
+    config = LlamaInferenceConfig(tpu_cfg, load_config=load_pretrained_config(hf_cfg))
+    app = LlamaForCausalLM(None, config)
+    t0 = time.time()
+    app.load_host_params(get_params(hf_cfg))
+    print(f"params on device in {time.time()-t0:.0f}s", flush=True)
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(1, hf_cfg["vocab_size"], size=(batch, 128)).astype(np.int32)
+    app.generate(ids, max_new_tokens=128)                      # compile+warm
+    out = app.generate(ids, max_new_tokens=128, collect_latency=True)
+    s = np.array([x for x, _ in out.decode_latencies_s])
+    n = np.array([x for _, x in out.decode_latencies_s])
+    per_step = 1000.0 * s / n
+    toks = n.sum() * batch / s.sum()
+    print(f"kernel={kernel} bs={batch}: p50 step "
+          f"{np.percentile(per_step, 50):.2f} ms -> {toks:.0f} tok/s, "
+          f"ttft {out.ttft_s:.3f}s", flush=True)
+
+
+if __name__ == "__main__":
+    main()

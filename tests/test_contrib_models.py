@@ -1,0 +1,156 @@
+"""Contrib model hub parity: each port matches its HF CPU implementation.
+
+≈ the reference contrib checklist (`contrib/models/*/test/`): tiny random-weight
+config, last-token logit match + multi-step greedy token match.
+"""
+
+import numpy as np
+import pytest
+import torch
+
+from neuronx_distributed_inference_tpu.config import TpuConfig, load_pretrained_config
+
+
+def _tpu_cfg():
+    return TpuConfig(batch_size=2, seq_len=64, max_context_length=32, dtype="float32",
+                     context_encoding_buckets=[16, 32],
+                     token_generation_buckets=[32, 64])
+
+
+def _run_parity(app_cls, hf_model, hf_cfg, atol=5e-4, rtol=1e-3, vocab=256):
+    config = app_cls.get_config_cls()(
+        _tpu_cfg(), load_config=load_pretrained_config(hf_cfg.to_dict()))
+    app = app_cls(None, config)
+    state = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
+    params = app.convert_hf_state_dict(state, app.config)
+    app._put_params(params)
+
+    rng = np.random.default_rng(0)
+    input_ids = rng.integers(1, vocab, size=(2, 12)).astype(np.int64)
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor(input_ids)).logits[:, -1].numpy()
+    out = app.generate(input_ids, max_new_tokens=1, return_logits=True)
+    np.testing.assert_allclose(out.logits[0], hf_logits, atol=atol, rtol=rtol)
+
+    with torch.no_grad():
+        hf_out = hf_model.generate(torch.tensor(input_ids), max_new_tokens=10,
+                                   do_sample=False, pad_token_id=0)
+    out = app.generate(input_ids, max_new_tokens=10)
+    np.testing.assert_array_equal(out.tokens, hf_out[:, 12:].numpy())
+
+
+def test_registry_resolves_contrib_models():
+    import contrib.registry  # noqa: F401  (side effect: registration)
+    from neuronx_distributed_inference_tpu.models import get_model_cls
+
+    for mt in ("gpt2", "opt", "gpt_neox", "phi", "phi3", "starcoder2", "falcon"):
+        assert get_model_cls(mt) is not None
+
+
+def test_gpt2_parity():
+    from transformers import GPT2Config, GPT2LMHeadModel
+
+    from contrib.models.gpt2.src.modeling_gpt2 import GPT2ForCausalLM
+
+    cfg = GPT2Config(vocab_size=256, n_positions=128, n_embd=64, n_layer=2,
+                     n_head=4, activation_function="gelu_new",
+                     resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0)
+    torch.manual_seed(0)
+    hf = GPT2LMHeadModel(cfg).eval()
+    _run_parity(GPT2ForCausalLM, hf, cfg)
+
+
+def test_opt_parity():
+    from transformers import OPTConfig, OPTForCausalLM as HFOPT
+
+    from contrib.models.opt.src.modeling_opt import OPTForCausalLM
+
+    cfg = OPTConfig(vocab_size=256, hidden_size=64, num_hidden_layers=2,
+                    ffn_dim=128, num_attention_heads=4,
+                    max_position_embeddings=128, do_layer_norm_before=True,
+                    activation_function="relu", word_embed_proj_dim=64,
+                    dropout=0.0)
+    torch.manual_seed(0)
+    hf = HFOPT(cfg).eval()
+    _run_parity(OPTForCausalLM, hf, cfg)
+
+
+def test_pythia_parity():
+    from transformers import GPTNeoXConfig, GPTNeoXForCausalLM
+
+    from contrib.models.pythia.src.modeling_pythia import PythiaForCausalLM
+
+    cfg = GPTNeoXConfig(vocab_size=256, hidden_size=64, num_hidden_layers=2,
+                        num_attention_heads=4, intermediate_size=128,
+                        rotary_pct=0.25, max_position_embeddings=128,
+                        use_parallel_residual=True, hidden_act="gelu",
+                        hidden_dropout=0.0, attention_dropout=0.0)
+    torch.manual_seed(0)
+    hf = GPTNeoXForCausalLM(cfg).eval()
+    _run_parity(PythiaForCausalLM, hf, cfg)
+
+
+def test_phi_parity():
+    from transformers import PhiConfig, PhiForCausalLM as HFPhi
+
+    from contrib.models.phi.src.modeling_phi import PhiForCausalLM
+
+    cfg = PhiConfig(vocab_size=256, hidden_size=64, num_hidden_layers=2,
+                    num_attention_heads=4, intermediate_size=128,
+                    partial_rotary_factor=0.5, max_position_embeddings=128,
+                    hidden_act="gelu_new", resid_pdrop=0.0, embd_pdrop=0.0,
+                    attention_dropout=0.0, qk_layernorm=False)
+    torch.manual_seed(0)
+    hf = HFPhi(cfg).eval()
+    _run_parity(PhiForCausalLM, hf, cfg)
+
+
+def test_phi3_parity():
+    from transformers import Phi3Config, Phi3ForCausalLM as HFPhi3
+
+    from contrib.models.phi3.src.modeling_phi3 import Phi3ForCausalLM
+
+    cfg = Phi3Config(vocab_size=256, hidden_size=64, num_hidden_layers=2,
+                     num_attention_heads=4, num_key_value_heads=2,
+                     intermediate_size=128, max_position_embeddings=128,
+                     rope_theta=10000.0, tie_word_embeddings=False,
+                     resid_pdrop=0.0, embd_pdrop=0.0, attention_dropout=0.0,
+                     sliding_window=None, pad_token_id=0, eos_token_id=2,
+                     bos_token_id=1)
+    torch.manual_seed(0)
+    hf = HFPhi3(cfg).eval()
+    _run_parity(Phi3ForCausalLM, hf, cfg)
+
+
+def test_starcoder2_parity():
+    from transformers import Starcoder2Config, Starcoder2ForCausalLM as HFSc2
+
+    from contrib.models.starcoder2.src.modeling_starcoder2 import (
+        Starcoder2ForCausalLM)
+
+    cfg = Starcoder2Config(vocab_size=256, hidden_size=64, num_hidden_layers=2,
+                           num_attention_heads=4, num_key_value_heads=2,
+                           intermediate_size=128, max_position_embeddings=128,
+                           hidden_act="gelu_pytorch_tanh", use_bias=True,
+                           tie_word_embeddings=True, sliding_window=None,
+                           residual_dropout=0.0, embedding_dropout=0.0,
+                           attention_dropout=0.0)
+    torch.manual_seed(0)
+    hf = HFSc2(cfg).eval()
+    _run_parity(Starcoder2ForCausalLM, hf, cfg)
+
+
+def test_falcon_parity():
+    from transformers import FalconConfig, FalconForCausalLM as HFFalcon
+
+    from contrib.models.falcon.src.modeling_falcon import FalconForCausalLM
+
+    cfg = FalconConfig(vocab_size=256, hidden_size=64, num_hidden_layers=2,
+                       num_attention_heads=4, multi_query=True,
+                       parallel_attn=True, bias=False,
+                       new_decoder_architecture=False, alibi=False,
+                       rope_theta=10000.0, max_position_embeddings=128,
+                       hidden_dropout=0.0, attention_dropout=0.0)
+    torch.manual_seed(0)
+    hf = HFFalcon(cfg).eval()
+    _run_parity(FalconForCausalLM, hf, cfg)
