@@ -14,7 +14,9 @@ _REGISTRY: Dict[str, str] = {
     "gpt_oss": "neuronx_distributed_inference_tpu.models.gpt_oss.modeling_gpt_oss:GptOssForCausalLM",
     "dbrx": "neuronx_distributed_inference_tpu.models.dbrx.modeling_dbrx:DbrxForCausalLM",
     "deepseek_v3": "neuronx_distributed_inference_tpu.models.deepseek.modeling_deepseek:DeepseekForCausalLM",
-    "llama4": "neuronx_distributed_inference_tpu.models.llama4.modeling_llama4:Llama4ForCausalLM",
+    # outer multimodal config (text_config + vision_config) -> vision+text app;
+    # bare text config -> text-only app
+    "llama4": "neuronx_distributed_inference_tpu.models.llama4.modeling_llama4_vision:Llama4ForConditionalGeneration",
     "llama4_text": "neuronx_distributed_inference_tpu.models.llama4.modeling_llama4:Llama4ForCausalLM",
     "mistral": "neuronx_distributed_inference_tpu.models.mistral.modeling_mistral:MistralForCausalLM",
     "llava": "neuronx_distributed_inference_tpu.models.pixtral.modeling_pixtral:PixtralForConditionalGeneration",

@@ -195,8 +195,12 @@ def _temp_scales(args: Llama4ArchArgs, pos: jnp.ndarray) -> jnp.ndarray:
 def prefill_forward(params: Params, args: Llama4ArchArgs, input_ids, position_ids,
                     last_token_idx, cache, mesh=None, rules=None, use_flash=False,
                     slot_mapping=None, cache_batch_start=0, adapter_ids=None,
-                    use_ring=False, return_hidden=False):
+                    use_ring=False, return_hidden=False, merge_embeds=None):
     h = _embed(params, args, input_ids, mesh, rules)
+    if merge_embeds is not None:
+        # image features override token embeddings at image-token positions
+        mm_mask, mm_override = merge_embeds
+        h = jnp.where(mm_mask, mm_override.astype(h.dtype), h)
     cos, sin = rope_ops.compute_cos_sin(params["rope_inv_freq"], position_ids,
                                         args.rope_attention_scaling)
     s = input_ids.shape[1]

@@ -1,0 +1,211 @@
+"""Llama4 vision tower + conditional-generation application.
+
+≈ reference `models/llama4/modeling_llama4_vision.py` (~1468 LoC:
+NeuronLlama4VisionModel — unfold-conv patch embedding, 2D rotary attention,
+pixel-shuffle adapter) redesigned as one pure jitted function over the
+image-to-text base (runtime/image_to_text.py):
+
+- Patch embedding = reshape/transpose unfold + linear (torch Unfold's (c, kh, kw)
+  row ordering preserved by the transpose), CLS token appended at the END.
+- 2D rotary: per-patch (x, y) angle tables precomputed host-side (cos/sin over
+  head_dim/2 pairs), applied as an interleaved-pair rotation — the real form of the
+  reference/HF complex multiply.
+- Encoder layers: biased q/k/v/o + exact-gelu biased MLP, pre-LN.
+- Adapter: pixel-shuffle (ratio r packs 1/r^2 patches into channels) + 2-layer
+  gelu MLP, then the multimodal projector to the text hidden size.
+
+Text side: Llama4ForCausalLM (interleaved NoPE/chunked-attention MoE stack,
+modeling_llama4.py); image features merge at image-token positions via the shared
+embed-override prefill.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops.norms import layer_norm
+from ...runtime.image_to_text import (ImageToTextInferenceConfig,
+                                      TpuModelForImageToText)
+from .modeling_llama4 import Llama4ForCausalLM, Llama4InferenceConfig
+
+
+def vision_rope_tables(image_size: int, patch_size: int, hidden: int, heads: int,
+                       theta: float) -> np.ndarray:
+    """(P, d/2) angle table for the 2D rotary (HF Llama4VisionRotaryEmbedding):
+    x/y coordinate frequencies interleaved, zeroed for the CLS token."""
+    idx = image_size // patch_size
+    img_idx = np.arange(idx * idx, dtype=np.int32).reshape(-1, 1)
+    img_idx = np.concatenate([img_idx, img_idx[:1]], axis=0)
+    img_idx[-1, -1] = -2                      # CLS marker
+    fx = img_idx % idx
+    fy = img_idx // idx
+    freq_dim = hidden // heads // 2
+    rope_freq = 1.0 / (theta ** (np.arange(0, freq_dim, 2)[: freq_dim // 2]
+                                 .astype(np.float64) / freq_dim))
+    freqs_x = np.repeat((fx + 1)[..., None] * rope_freq[None, None, :], 2, axis=-1)
+    freqs_y = np.repeat((fy + 1)[..., None] * rope_freq[None, None, :], 2, axis=-1)
+    freqs = np.concatenate([freqs_x, freqs_y], axis=-1)[..., ::2]
+    freqs = np.where(img_idx.reshape(-1, 1, 1) < 0, 0.0, freqs)
+    return freqs[:, 0, :].astype(np.float32)  # (P, d/2)
+
+
+def _rope_2d(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray) -> jnp.ndarray:
+    """Interleaved-pair rotation: x (N, P, heads, D), cos/sin (P, D/2)."""
+    x0 = x[..., 0::2]
+    x1 = x[..., 1::2]
+    c = cos[None, :, None, :]
+    s = sin[None, :, None, :]
+    o0 = x0 * c - x1 * s
+    o1 = x0 * s + x1 * c
+    return jnp.stack([o0, o1], axis=-1).reshape(x.shape)
+
+
+def _pixel_shuffle(x: jnp.ndarray, ratio: float) -> jnp.ndarray:
+    """(N, P, C) -> (N, P*r^2, C/r^2) (HF pixel_shuffle, r = ratio < 1)."""
+    n, p, c = x.shape
+    side = int(np.sqrt(p))
+    x = x.reshape(n, side, side, c)
+    x = x.reshape(n, side, int(side * ratio), int(c / ratio))
+    x = x.transpose(0, 2, 1, 3)
+    x = x.reshape(n, int(side * ratio), int(side * ratio), int(c / ratio ** 2))
+    x = x.transpose(0, 2, 1, 3)
+    return x.reshape(n, -1, x.shape[-1])
+
+
+def llama4_vision_encode(vp: Dict[str, Any], pixel_values: jnp.ndarray, *,
+                         patch_size: int, heads: int, shuffle_ratio: float,
+                         eps: float = 1e-5) -> jnp.ndarray:
+    """(N, C, H, W) pixel tiles -> (N, T_img, H_text) projected image features."""
+    n, c, hh, ww = pixel_values.shape
+    gh, gw = hh // patch_size, ww // patch_size
+    x = pixel_values.reshape(n, c, gh, patch_size, gw, patch_size)
+    x = x.transpose(0, 2, 4, 1, 3, 5).reshape(n, gh * gw, c * patch_size * patch_size)
+    x = x.astype(vp["patch_w"].dtype) @ vp["patch_w"]
+
+    cls = jnp.broadcast_to(vp["class_embed"][None, None, :], (n, 1, x.shape[-1]))
+    x = jnp.concatenate([x, cls], axis=1)
+    x = x + vp["pos_embed"]
+    x = layer_norm(x, vp["pre_w"], vp["pre_b"], eps=eps)
+
+    d = x.shape[-1] // heads
+    cos, sin = vp["rope_cos"], vp["rope_sin"]
+
+    def body(hid, lp):
+        hn = layer_norm(hid, lp["ln1_w"], lp["ln1_b"], eps=eps)
+        p = hn.shape[1]
+        q = (hn @ lp["wq"] + lp["bq"]).reshape(n, p, heads, d)
+        k = (hn @ lp["wk"] + lp["bk"]).reshape(n, p, heads, d)
+        v = (hn @ lp["wv"] + lp["bv"]).reshape(n, p, heads, d)
+        q = _rope_2d(q, cos, sin).astype(hn.dtype)
+        k = _rope_2d(k, cos, sin).astype(hn.dtype)
+        q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+        s = jnp.einsum("nhqd,nhkd->nhqk", q, k,
+                       preferred_element_type=jnp.float32) * (d ** -0.5)
+        probs = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+        attn = jnp.einsum("nhqk,nhkd->nhqd", probs, v)
+        attn = attn.transpose(0, 2, 1, 3).reshape(n, p, heads * d)
+        hid = hid + (attn @ lp["wo"] + lp["bo"])
+        hn = layer_norm(hid, lp["ln2_w"], lp["ln2_b"], eps=eps)
+        hid = hid + (jax.nn.gelu(hn @ lp["fc1"] + lp["b1"], approximate=False)
+                     @ lp["fc2"] + lp["b2"])
+        return hid, None
+
+    x, _ = jax.lax.scan(body, x, vp["layers"])
+    x = layer_norm(x, vp["post_w"], vp["post_b"], eps=eps)
+    x = x[:, :-1]                                  # drop CLS
+    x = _pixel_shuffle(x, shuffle_ratio)
+    x = jax.nn.gelu(x @ vp["adapter_fc1"], approximate=False)
+    x = jax.nn.gelu(x @ vp["adapter_fc2"], approximate=False)
+    return x @ vp["proj"]                          # -> text hidden
+
+
+class Llama4VisionInferenceConfig(ImageToTextInferenceConfig, Llama4InferenceConfig):
+    REQUIRED_ATTRIBUTES = ("vision_config", "image_token_index")
+
+    def add_derived_config(self) -> None:
+        ImageToTextInferenceConfig.add_derived_config(self)
+        Llama4InferenceConfig.add_derived_config(self)
+        if not hasattr(self, "image_token_index"):
+            self.image_token_index = getattr(self, "image_token_id", 200092)
+
+
+class Llama4ForConditionalGeneration(TpuModelForImageToText, Llama4ForCausalLM):
+    """≈ reference NeuronLlama4ForConditionalGeneration (vision tower + text MoE)."""
+
+    @classmethod
+    def get_config_cls(cls):
+        return Llama4VisionInferenceConfig
+
+    @classmethod
+    def convert_hf_state_dict(cls, state_dict, config):
+        # multimodal checkpoints nest the text model under language_model.*
+        text = {k[len("language_model."):]: v for k, v in state_dict.items()
+                if k.startswith("language_model.")}
+        return Llama4ForCausalLM.convert_hf_state_dict(text or state_dict, config)
+
+    def vision_encode_fn(self):
+        vc = self.config.vision_config
+        return functools.partial(
+            llama4_vision_encode,
+            patch_size=vc["patch_size"],
+            heads=vc["num_attention_heads"],
+            shuffle_ratio=float(vc.get("pixel_shuffle_ratio", 0.5)),
+            eps=1e-5)
+
+    @classmethod
+    def convert_hf_vision_state_dict(cls, state_dict, config) -> Dict:
+        vc = config.vision_config
+
+        def get(name):
+            if name not in state_dict:
+                raise KeyError(f"missing weight {name}")
+            return np.asarray(state_dict[name])
+
+        def lin_t(name):
+            return np.ascontiguousarray(get(name).T)
+
+        layers = {k: [] for k in ("ln1_w", "ln1_b", "wq", "bq", "wk", "bk", "wv",
+                                  "bv", "wo", "bo", "ln2_w", "ln2_b", "fc1", "b1",
+                                  "fc2", "b2")}
+        for i in range(vc["num_hidden_layers"]):
+            p = f"vision_model.model.layers.{i}."
+            layers["wq"].append(lin_t(p + "self_attn.q_proj.weight"))
+            layers["bq"].append(get(p + "self_attn.q_proj.bias"))
+            layers["wk"].append(lin_t(p + "self_attn.k_proj.weight"))
+            layers["bk"].append(get(p + "self_attn.k_proj.bias"))
+            layers["wv"].append(lin_t(p + "self_attn.v_proj.weight"))
+            layers["bv"].append(get(p + "self_attn.v_proj.bias"))
+            layers["wo"].append(lin_t(p + "self_attn.o_proj.weight"))
+            layers["bo"].append(get(p + "self_attn.o_proj.bias"))
+            layers["ln1_w"].append(get(p + "input_layernorm.weight"))
+            layers["ln1_b"].append(get(p + "input_layernorm.bias"))
+            layers["ln2_w"].append(get(p + "post_attention_layernorm.weight"))
+            layers["ln2_b"].append(get(p + "post_attention_layernorm.bias"))
+            layers["fc1"].append(lin_t(p + "mlp.fc1.weight"))
+            layers["b1"].append(get(p + "mlp.fc1.bias"))
+            layers["fc2"].append(lin_t(p + "mlp.fc2.weight"))
+            layers["b2"].append(get(p + "mlp.fc2.bias"))
+
+        angles = vision_rope_tables(vc["image_size"], vc["patch_size"],
+                                    vc["hidden_size"], vc["num_attention_heads"],
+                                    float(vc.get("rope_theta", 10000)))
+        return {
+            "patch_w": lin_t("vision_model.patch_embedding.linear.weight"),
+            "class_embed": get("vision_model.class_embedding"),
+            "pos_embed": get("vision_model.positional_embedding_vlm"),
+            "pre_w": get("vision_model.layernorm_pre.weight"),
+            "pre_b": get("vision_model.layernorm_pre.bias"),
+            "post_w": get("vision_model.layernorm_post.weight"),
+            "post_b": get("vision_model.layernorm_post.bias"),
+            "layers": {k: np.stack(v) for k, v in layers.items()},
+            "adapter_fc1": lin_t("vision_model.vision_adapter.mlp.fc1.weight"),
+            "adapter_fc2": lin_t("vision_model.vision_adapter.mlp.fc2.weight"),
+            "proj": lin_t("multi_modal_projector.linear_1.weight"),
+            "rope_cos": np.cos(angles),
+            "rope_sin": np.sin(angles),
+        }

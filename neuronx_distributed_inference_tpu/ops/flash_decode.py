@@ -265,17 +265,17 @@ def _kv_write_kv_kernel(pos_ref, lidx_ref, new_k_ref, new_v_ref, _k_in, _v_in,
                               sk.at[j], sems.at[j, 0]).wait()
         pltpu.make_async_copy(v_out.at[l, bi * bb + j, :, pl.ds(w0s[j], win), :],
                               sv.at[j], sems.at[j, 1]).wait()
-    off = (jnp.stack([pos_ref[bi * bb + j] for j in range(bb)])
-           - jnp.stack(w0s))                                     # (bb,)
-    iota = jax.lax.broadcasted_iota(jnp.int32, (bb, 1, win, 1), 2)
-    sel0 = off[:, None, None, None]
-    vk, vv = sk[:], sv[:]
-    for j in range(t):
-        hit = iota == sel0 + j
-        vk = jnp.where(hit, new_k_ref[:, :, j : j + 1, :], vk)
-        vv = jnp.where(hit, new_v_ref[:, :, j : j + 1, :], vv)
-    sk[:] = vk
-    sv[:] = vv
+    iota = jax.lax.broadcasted_iota(
+        jnp.int32, (sk.shape[1], win, sk.shape[3]), 1)
+    for j in range(bb):
+        off = pos_ref[bi * bb + j] - w0s[j]          # scalar (Mosaic-friendly)
+        vk, vv = sk[j], sv[j]
+        for tok in range(t):
+            hit = iota == off + tok
+            vk = jnp.where(hit, new_k_ref[j, :, tok : tok + 1, :], vk)
+            vv = jnp.where(hit, new_v_ref[j, :, tok : tok + 1, :], vv)
+        sk[j] = vk
+        sv[j] = vv
     for j in range(bb):
         pltpu.make_async_copy(sk.at[j],
                               k_out.at[l, bi * bb + j, :, pl.ds(w0s[j], win), :],
@@ -364,10 +364,14 @@ def _stacked_decode_kernel(pos_ref, lidx_ref, q_ref, k_ref, v_ref, o_ref, m_scra
         l_scratch[:] = jnp.zeros_like(l_scratch)
         acc_scratch[:] = jnp.zeros_like(acc_scratch)
 
-    pos = jnp.stack([pos_ref[bi * bb + j] for j in range(bb)])     # (bb,)
-    run = k_start <= jnp.max(pos) + t - 1
+    import functools as _ft
+
+    pos = [pos_ref[bi * bb + j] for j in range(bb)]                # bb scalars
+    pos_max = _ft.reduce(jnp.maximum, pos)
+    run = k_start <= pos_max + t - 1
     if window is not None:
-        run = jnp.logical_and(run, k_start + block_k - 1 > jnp.min(pos) - window)
+        pos_min = _ft.reduce(jnp.minimum, pos)
+        run = jnp.logical_and(run, k_start + block_k - 1 > pos_min - window)
 
     @pl.when(run)
     def _body():

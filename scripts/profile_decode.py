@@ -47,7 +47,10 @@ def main():
     config = LlamaInferenceConfig(tpu_cfg, load_config=load_pretrained_config(hf_cfg))
     app = LlamaForCausalLM(None, config)
     t0 = time.time()
-    app.load_host_params(bench._random_quantized_llama_params(hf_cfg, seed=0))
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts"))
+    import bench_decode_only
+    app.load_host_params(bench_decode_only.get_params(hf_cfg))
     print(f"params loaded in {time.time()-t0:.1f}s", flush=True)
 
     rng = np.random.default_rng(0)

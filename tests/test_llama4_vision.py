@@ -1,0 +1,98 @@
+"""Llama4 vision tower + conditional generation parity vs HF CPU.
+
+≈ reference llama4 vision integration
+(`test/integration/tp64/models/llama4/test_llama4_vision_text_4layer.py`): tiny
+random-weight config, vision-feature parity + multimodal greedy generate parity.
+"""
+
+import numpy as np
+import pytest
+import torch
+
+from neuronx_distributed_inference_tpu.config import TpuConfig, load_pretrained_config
+
+
+@pytest.fixture(scope="module")
+def tiny_llama4_vision():
+    from transformers import Llama4Config
+    from transformers.models.llama4.modeling_llama4 import (
+        Llama4ForConditionalGeneration as HFL4)
+
+    text = {
+        "vocab_size": 256, "hidden_size": 64, "intermediate_size": 64,
+        "intermediate_size_mlp": 128, "num_hidden_layers": 2,
+        "num_attention_heads": 4, "num_key_value_heads": 2, "head_dim": 16,
+        "num_local_experts": 4, "num_experts_per_tok": 1,
+        "interleave_moe_layer_step": 1, "attention_chunk_size": 16,
+        "rope_theta": 10000.0, "max_position_embeddings": 512,
+        "attn_temperature_tuning": True, "use_qk_norm": True,
+        "no_rope_layers": [1, 0],
+    }
+    vision = {
+        "image_size": 28, "patch_size": 14, "num_channels": 3,
+        "hidden_size": 32, "num_attention_heads": 2, "num_hidden_layers": 2,
+        "intermediate_size": 128,           # = hidden / pixel_shuffle_ratio^2
+        "pixel_shuffle_ratio": 0.5,
+        "projector_input_dim": 64, "projector_output_dim": 64,
+        "vision_output_dim": 64, "rope_theta": 10000,
+        "vision_feature_layer": -1, "vision_feature_select_strategy": "default",
+    }
+    cfg = Llama4Config(text_config=text, vision_config=vision,
+                       image_token_index=250, pad_token_id=0,
+                       boi_token_index=251, eoi_token_index=252)
+    torch.manual_seed(0)
+    hf = HFL4(cfg).eval()
+    return hf, cfg
+
+
+def _build(cfg):
+    from neuronx_distributed_inference_tpu.models.llama4.modeling_llama4_vision import (
+        Llama4ForConditionalGeneration)
+
+    tpu_cfg = TpuConfig(batch_size=2, seq_len=64, max_context_length=32,
+                        dtype="float32", context_encoding_buckets=[32],
+                        token_generation_buckets=[64])
+    config = Llama4ForConditionalGeneration.get_config_cls()(
+        tpu_cfg, load_config=load_pretrained_config(cfg.to_dict()))
+    return Llama4ForConditionalGeneration(None, config)
+
+
+def test_vision_features_match_hf(tiny_llama4_vision):
+    hf, cfg = tiny_llama4_vision
+    app = _build(cfg)
+    state = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+    app.load_vision_from_state_dict(state)
+
+    rng = np.random.default_rng(0)
+    pixels = rng.normal(size=(2, 3, 28, 28)).astype(np.float32)
+    ours = app.encode_images(pixels)                       # (2, T_img, H_text)
+    with torch.no_grad():
+        vis = hf.vision_model(torch.tensor(pixels)).last_hidden_state
+        theirs = hf.multi_modal_projector(
+            vis.reshape(-1, vis.shape[-1])).numpy()
+    np.testing.assert_allclose(ours.reshape(-1, ours.shape[-1]), theirs,
+                               atol=3e-4, rtol=1e-3)
+
+
+def test_multimodal_generate_matches_hf(tiny_llama4_vision):
+    hf, cfg = tiny_llama4_vision
+    app = _build(cfg)
+    state = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+    params = app.convert_hf_state_dict(state, app.config)
+    app._put_params(params)
+    app.load_vision_from_state_dict(state)
+
+    rng = np.random.default_rng(1)
+    # each 28x28 image yields (28/14 * 0.5)^2 = 1 feature token
+    ids = rng.integers(1, 250, size=(2, 10)).astype(np.int64)
+    ids[0, 2] = cfg.image_token_index
+    ids[1, 5] = cfg.image_token_index
+    pixels = rng.normal(size=(2, 3, 28, 28)).astype(np.float32)
+
+    with torch.no_grad():
+        hf_out = hf.generate(input_ids=torch.tensor(ids),
+                             pixel_values=torch.tensor(pixels),
+                             max_new_tokens=8, do_sample=False,
+                             pad_token_id=0)
+    out = app.generate(ids, pixel_values=pixels, max_new_tokens=8)
+    np.testing.assert_array_equal(out.tokens, hf_out[:, 10:].numpy())
