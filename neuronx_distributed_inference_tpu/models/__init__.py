@@ -23,6 +23,7 @@ _REGISTRY: Dict[str, str] = {
     "pixtral": "neuronx_distributed_inference_tpu.models.pixtral.modeling_pixtral:PixtralForConditionalGeneration",
     "mllama": "neuronx_distributed_inference_tpu.models.mllama.modeling_mllama:MllamaForConditionalGeneration",
     "qwen2_5_vl": "neuronx_distributed_inference_tpu.models.qwen2_5_vl.modeling_qwen2_5_vl:Qwen2_5_VLForConditionalGeneration",
+    "qwen3_vl": "neuronx_distributed_inference_tpu.models.qwen3_vl.modeling_qwen3_vl:Qwen3VLForConditionalGeneration",
     # NOTE: whisper (models/whisper) is an encoder-decoder application with its own
     # generate(input_features, ...) interface; it deliberately does NOT register here
     # because this registry feeds the causal-LM CLI/adapters.

@@ -676,7 +676,8 @@ def _run_stack(params: Params, args: ModelArchArgs, h, cos, sin, mask, cache,
                positions, decode_bucket, mesh, rules, use_flash=False,
                paged=None, cache_batch_start=0,
                adapter_ids=None, ring_positions=None, window_row=None,
-               capture_layers: Optional[Tuple[int, ...]] = None):
+               capture_layers: Optional[Tuple[int, ...]] = None,
+               deepstack: Optional[jnp.ndarray] = None):
     """Scan the decoder layers, carrying hidden state, yielding updated cache.
 
     ``capture_layers`` (static layer indices) also collects those layers' OUTPUT
@@ -701,6 +702,11 @@ def _run_stack(params: Params, args: ModelArchArgs, h, cos, sin, mask, cache,
         if capture_layers:
             caps = tuple(jnp.where(li == idx, new_h, buf)
                          for idx, buf in zip(capture_layers, caps))
+        if deepstack is not None:
+            # DeepStack (qwen3-vl): intermediate vision features add into the
+            # first K layers' outputs at image-token positions (pre-scattered)
+            for k_i in range(deepstack.shape[0]):
+                new_h = new_h + jnp.where(li == k_i, deepstack[k_i], 0.0)
         from ..utils import tensor_capture as _tc
 
         ys = (kc, vc)
@@ -863,6 +869,9 @@ def prefill_forward(
     # static layer indices whose output hiddens are captured (EAGLE3 conditioning,
     # ≈ `model_base.py:1429-1432`); appends a list of (B, S, H) to the return
     capture_layers: Optional[Tuple[int, ...]] = None,
+    # (K, B, S, H) per-early-layer additive visual features at image positions
+    # (DeepStack, qwen3-vl; zeros elsewhere)
+    deepstack: Optional[jnp.ndarray] = None,
     # multimodal embed merge: (mask (B, S, 1) bool, override (B, S, H)) — positions
     # where mask is True take the override row (image embeds scattered at image-token
     # positions, ≈ reference image-to-text pipelined vision→CTE merge,
@@ -932,7 +941,7 @@ def prefill_forward(
                      paged=paged, cache_batch_start=cache_batch_start,
                      adapter_ids=adapter_ids,
                      ring_positions=position_ids if use_ring else None,
-                     capture_layers=capture_layers)
+                     capture_layers=capture_layers, deepstack=deepstack)
     h, cache = out[0], out[1]
     h = tap("final_hidden", _norm(h, params["final_norm"], args, params.get("final_norm_b")))
     h_last = jnp.take_along_axis(h, last_token_idx[:, None, None], axis=1)[:, 0]

@@ -1,0 +1,4 @@
+from .modeling_qwen3_vl import (Qwen3VLForConditionalGeneration,
+                                Qwen3VLInferenceConfig)
+
+__all__ = ["Qwen3VLForConditionalGeneration", "Qwen3VLInferenceConfig"]

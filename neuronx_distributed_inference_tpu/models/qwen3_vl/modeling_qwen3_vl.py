@@ -1,0 +1,404 @@
+"""Qwen3-VL (vision-language) family.
+
+≈ reference `models/qwen3_vl/` (vision tower + deepstack + interleaved M-RoPE text).
+TPU redesign over the image-to-text base:
+
+- **Vision tower** (one pure jitted fn): 3D-conv patch embedding as a flat linear,
+  bilinearly-interpolated learned position embeddings (indices/weights precomputed
+  host-side, 4 gathers on device), 2D rotary over (row, col) patch coordinates,
+  pre-LN biased blocks with per-frame full attention (segment mask), spatial-merge
+  MLP head.
+- **DeepStack** (`deepstack_visual_indexes`): intermediate block outputs pass through
+  their own post-shuffle mergers and ADD into the first K text layers' outputs at
+  image-token positions (`models/base.prefill_forward(deepstack=...)`,
+  ≈ reference deepstack integration, `models/model_base.py:1235-1247`).
+- **Text**: qwen3 stack (qk-norm) with *interleaved* M-RoPE
+  (`ops/rope.mrope_cos_sin_interleaved`) — channels cycle [T,H,W,T,H,W,...] instead
+  of qwen2-vl's chunked sections; decode collapses to 1D rope + per-row delta via
+  the shared ``rope_delta`` cache mechanism.
+
+Images only (videos need timestamp-separated grids; the images-only guard lives in
+qwen2_5_vl.get_rope_index_images, reused here).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops import rope as rope_ops
+from ...ops.norms import layer_norm
+from ...runtime.image_to_text import (ImageToTextInferenceConfig,
+                                      TpuModelForImageToText)
+from ..qwen2_5_vl.modeling_qwen2_5_vl import get_rope_index_images, segment_mask
+from ..qwen3.modeling_qwen3 import Qwen3ForCausalLM, Qwen3InferenceConfig
+
+
+# --- host-side geometry ---------------------------------------------------------------
+
+
+def merge_order_coords(grid_thw: np.ndarray, merge_size: int) -> np.ndarray:
+    """(seq, 2) per-patch (row, col) coordinates in the processor's merge-window
+    patch order (HF `rot_pos_emb`)."""
+    out = []
+    for t, h, w in np.asarray(grid_thw):
+        mh, mw = h // merge_size, w // merge_size
+        br = np.arange(mh)[:, None, None, None] * merge_size
+        bc = np.arange(mw)[None, :, None, None] * merge_size
+        ir = np.arange(merge_size)[None, None, :, None]
+        ic = np.arange(merge_size)[None, None, None, :]
+        rows = np.broadcast_to(br + ir, (mh, mw, merge_size, merge_size)).reshape(-1)
+        cols = np.broadcast_to(bc + ic, (mh, mw, merge_size, merge_size)).reshape(-1)
+        coords = np.stack([rows, cols], axis=-1)
+        out.append(np.tile(coords, (int(t), 1)))
+    return np.concatenate(out, axis=0)
+
+
+def vision_rope_tables(grid_thw: np.ndarray, head_dim: int, merge_size: int,
+                       theta: float = 10000.0) -> Tuple[np.ndarray, np.ndarray]:
+    """(seq, head_dim) cos/sin for the vision blocks' 2D rotary."""
+    coords = merge_order_coords(grid_thw, merge_size)          # (seq, 2)
+    dim = head_dim // 2
+    inv = 1.0 / (theta ** (np.arange(0, dim, 2, dtype=np.float64) / dim))
+    freqs = coords[..., None].astype(np.float64) * inv[None, None, :]
+    rpe = freqs.reshape(coords.shape[0], -1)                   # (seq, dim)
+    emb = np.concatenate([rpe, rpe], axis=-1)                  # (seq, head_dim)
+    return np.cos(emb).astype(np.float32), np.sin(emb).astype(np.float32)
+
+
+def pos_embed_interp(grid_thw: np.ndarray, num_grid_per_side: int, merge_size: int
+                     ) -> Tuple[np.ndarray, np.ndarray]:
+    """Bilinear interpolation plan for the learned position grid
+    (HF `fast_pos_embed_interpolate`): returns (idx (4, seq), weights (4, seq)) in
+    the merge-window patch order."""
+    idx_all = [[] for _ in range(4)]
+    w_all = [[] for _ in range(4)]
+    n = num_grid_per_side
+    for t, h, w in np.asarray(grid_thw):
+        h_idx = np.linspace(0, n - 1, int(h))
+        w_idx = np.linspace(0, n - 1, int(w))
+        hf = h_idx.astype(np.int32)
+        wf = w_idx.astype(np.int32)
+        hc = np.clip(hf + 1, None, n - 1)
+        wc = np.clip(wf + 1, None, n - 1)
+        dh = h_idx - hf
+        dw = w_idx - wf
+        idx = [
+            (hf[:, None] * n + wf[None, :]),
+            (hf[:, None] * n + wc[None, :]),
+            (hc[:, None] * n + wf[None, :]),
+            (hc[:, None] * n + wc[None, :]),
+        ]
+        wts = [
+            ((1 - dh)[:, None] * (1 - dw)[None, :]),
+            ((1 - dh)[:, None] * dw[None, :]),
+            (dh[:, None] * (1 - dw)[None, :]),
+            (dh[:, None] * dw[None, :]),
+        ]
+        # permute row-major (h, w) -> merge-window order, tile over t frames
+        mh, mw = int(h) // merge_size, int(w) // merge_size
+        perm = (np.arange(int(h) * int(w))
+                .reshape(mh, merge_size, mw, merge_size)
+                .transpose(0, 2, 1, 3).reshape(-1))
+        for i in range(4):
+            flat_i = idx[i].reshape(-1)[perm]
+            flat_w = wts[i].reshape(-1)[perm]
+            idx_all[i].extend(np.tile(flat_i, int(t)).tolist())
+            w_all[i].extend(np.tile(flat_w, int(t)).tolist())
+    return (np.asarray(idx_all, dtype=np.int32),
+            np.asarray(w_all, dtype=np.float32))
+
+
+# --- vision encoder (jitted) ----------------------------------------------------------
+
+
+def vision_encode(vp: Dict[str, Any], patches: jnp.ndarray, cos: jnp.ndarray,
+                  sin: jnp.ndarray, seg_mask: jnp.ndarray, pos_idx: jnp.ndarray,
+                  pos_w: jnp.ndarray, *, num_heads: int,
+                  deepstack_indexes: Tuple[int, ...], merge_unit: int,
+                  eps: float = 1e-6):
+    """(seq, C*tps*p*p) merge-window-ordered patches ->
+    (main (seq//unit, out_H), deepstack (K, seq//unit, out_H))."""
+    h = patches @ vp["patch_w"] + vp["patch_b"]
+    pos = sum(pos_w[i][:, None] * jnp.take(vp["pos_table"], pos_idx[i], axis=0)
+              for i in range(4))
+    h = h + pos.astype(h.dtype)
+    seq, hidden = h.shape
+    d = hidden // num_heads
+
+    def rot(x):
+        half = x.shape[-1] // 2
+        rot_half = jnp.concatenate([-x[..., half:], x[..., :half]], axis=-1)
+        return x * cos[:, None, :] + rot_half * sin[:, None, :]
+
+    caps = tuple(jnp.zeros_like(h) for _ in deepstack_indexes)
+
+    def block(carry, xs):
+        hid, caps = carry
+        lp, li = xs
+        hn = layer_norm(hid, lp["ln1_w"], lp["ln1_b"], eps=eps)
+        qkv = hn @ lp["wqkv"] + lp["bqkv"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = rot(q.reshape(seq, num_heads, d)).astype(hn.dtype)
+        k = rot(k.reshape(seq, num_heads, d)).astype(hn.dtype)
+        v = v.reshape(seq, num_heads, d)
+        s = jnp.einsum("qhd,khd->hqk", q, k,
+                       preferred_element_type=jnp.float32) * (d ** -0.5)
+        s = jnp.where(seg_mask[None], s, jnp.finfo(jnp.float32).min)
+        p = jax.nn.softmax(s, axis=-1).astype(hn.dtype)
+        attn = jnp.einsum("hqk,khd->qhd", p, v).reshape(seq, hidden)
+        hid = hid + (attn @ lp["wo"] + lp["bo"])
+        hn = layer_norm(hid, lp["ln2_w"], lp["ln2_b"], eps=eps)
+        hid = hid + (jax.nn.gelu(hn @ lp["fc1"] + lp["b1"], approximate=True)
+                     @ lp["fc2"] + lp["b2"])
+        caps = tuple(jnp.where(li == idx, hid, buf)
+                     for idx, buf in zip(deepstack_indexes, caps))
+        return (hid, caps), None
+
+    depth = vp["blocks"]["wqkv"].shape[0]
+    (h, caps), _ = jax.lax.scan(block, (h, caps),
+                                (vp["blocks"], jnp.arange(depth)))
+
+    # main merger: pre-shuffle LayerNorm, then merge-window concat + MLP
+    def merger(x, mp, post_shuffle):
+        if post_shuffle:
+            x = x.reshape(seq // merge_unit, merge_unit * hidden)
+            x = layer_norm(x, mp["ln_w"], mp["ln_b"], eps=eps)
+        else:
+            x = layer_norm(x, mp["ln_w"], mp["ln_b"], eps=eps)
+            x = x.reshape(seq // merge_unit, merge_unit * hidden)
+        x = jax.nn.gelu(x @ mp["fc1"] + mp["b1"], approximate=False)
+        return x @ mp["fc2"] + mp["b2"]
+
+    main = merger(h, vp["merger"], post_shuffle=False)
+    ds = [merger(c, jax.tree.map(lambda t, _j=j: t[_j], vp["ds_mergers"]),
+                 post_shuffle=True)
+          for j, c in enumerate(caps)]
+    return main, jnp.stack(ds) if ds else jnp.zeros((0,) + main.shape)
+
+
+# --- config / application -------------------------------------------------------------
+
+
+class Qwen3VLInferenceConfig(ImageToTextInferenceConfig, Qwen3InferenceConfig):
+    REQUIRED_ATTRIBUTES = ("vision_config", "image_token_id")
+
+    def add_derived_config(self) -> None:
+        ImageToTextInferenceConfig.add_derived_config(self)
+        Qwen3InferenceConfig.add_derived_config(self)
+        for attr, default in (("vision_start_token_id", 151652),):
+            if not hasattr(self, attr):
+                setattr(self, attr, default)
+        rs = getattr(self, "rope_scaling", None)
+        sec = (rs or {}).get("mrope_section")
+        if not sec:
+            third = (self.head_dim // 2) // 3
+            sec = [self.head_dim // 2 - 2 * third, third, third]
+        self.mrope_section = sec
+
+
+class Qwen3VLForConditionalGeneration(TpuModelForImageToText, Qwen3ForCausalLM):
+    """≈ reference Qwen3VL conditional generation (deepstack vision + M-RoPE text)."""
+
+    @classmethod
+    def get_config_cls(cls):
+        return Qwen3VLInferenceConfig
+
+    @classmethod
+    def inv_freq_from_config(cls, config) -> np.ndarray:
+        return rope_ops.default_inv_freq(config.head_dim,
+                                         getattr(config, "rope_theta", 5e6))
+
+    @property
+    def image_token_index(self) -> int:
+        return self.config.image_token_id
+
+    def __init__(self, model_path, config, mesh=None):
+        super().__init__(model_path, config, mesh=mesh)
+        vc = config.vision_config
+        self._vision_geo = {
+            "patch_size": vc["patch_size"],
+            "merge_size": vc["spatial_merge_size"],
+            "num_heads": vc["num_heads"],
+            "head_dim": vc["hidden_size"] // vc["num_heads"],
+            "grid_side": int(vc["num_position_embeddings"] ** 0.5),
+            "deepstack": tuple(vc["deepstack_visual_indexes"]),
+        }
+        m = vc["spatial_merge_size"]
+        self._vision_jit = jax.jit(functools.partial(
+            vision_encode, num_heads=vc["num_heads"],
+            deepstack_indexes=self._vision_geo["deepstack"],
+            merge_unit=m * m))
+
+    def vision_encode_fn(self):
+        # unused (variable image grids drive a dedicated jit); satisfy the hook
+        return lambda vp, px: px
+
+    # --- weights ----------------------------------------------------------------------
+    @classmethod
+    def convert_hf_state_dict(cls, state_dict, config):
+        text_sd = {}
+        for k, v in state_dict.items():
+            if k.startswith("model.language_model."):
+                text_sd["model." + k[len("model.language_model."):]] = v
+            elif k.startswith("language_model.model."):
+                text_sd["model." + k[len("language_model.model."):]] = v
+            elif k == "language_model.lm_head.weight":
+                text_sd["lm_head.weight"] = v
+            elif k.startswith(("model.visual.", "visual.")):
+                continue
+            elif k.startswith("model.") or k == "lm_head.weight":
+                text_sd[k] = v
+        return super().convert_hf_state_dict(text_sd, config)
+
+    @classmethod
+    def convert_hf_vision_state_dict(cls, state_dict, config):
+        vc = config.vision_config
+        hidden = vc["hidden_size"]
+
+        def norm_key(k):
+            if k.startswith("model.visual."):
+                return "visual." + k[len("model.visual."):]
+            return k
+
+        sd = {norm_key(k): v for k, v in state_dict.items()}
+
+        def get(name):
+            if name not in sd:
+                raise KeyError(f"missing weight {name}")
+            return np.asarray(sd[name])
+
+        def lin_t(name):
+            return np.ascontiguousarray(get(name).T)
+
+        blocks = {k: [] for k in ("ln1_w", "ln1_b", "wqkv", "bqkv", "wo", "bo",
+                                  "ln2_w", "ln2_b", "fc1", "b1", "fc2", "b2")}
+        for i in range(vc["depth"]):
+            p = f"visual.blocks.{i}."
+            blocks["ln1_w"].append(get(p + "norm1.weight"))
+            blocks["ln1_b"].append(get(p + "norm1.bias"))
+            blocks["wqkv"].append(lin_t(p + "attn.qkv.weight"))
+            blocks["bqkv"].append(get(p + "attn.qkv.bias"))
+            blocks["wo"].append(lin_t(p + "attn.proj.weight"))
+            blocks["bo"].append(get(p + "attn.proj.bias"))
+            blocks["ln2_w"].append(get(p + "norm2.weight"))
+            blocks["ln2_b"].append(get(p + "norm2.bias"))
+            blocks["fc1"].append(lin_t(p + "mlp.linear_fc1.weight"))
+            blocks["b1"].append(get(p + "mlp.linear_fc1.bias"))
+            blocks["fc2"].append(lin_t(p + "mlp.linear_fc2.weight"))
+            blocks["b2"].append(get(p + "mlp.linear_fc2.bias"))
+
+        def merger_params(prefix):
+            return {
+                "ln_w": get(prefix + "norm.weight"),
+                "ln_b": get(prefix + "norm.bias"),
+                "fc1": lin_t(prefix + "linear_fc1.weight"),
+                "b1": get(prefix + "linear_fc1.bias"),
+                "fc2": lin_t(prefix + "linear_fc2.weight"),
+                "b2": get(prefix + "linear_fc2.bias"),
+            }
+
+        ds = [merger_params(f"visual.deepstack_merger_list.{j}.")
+              for j in range(len(vc["deepstack_visual_indexes"]))]
+        conv = get("visual.patch_embed.proj.weight")   # (hidden, C, tps, p, p)
+        return {
+            "patch_w": np.ascontiguousarray(conv.reshape(hidden, -1).T),
+            "patch_b": get("visual.patch_embed.proj.bias"),
+            "pos_table": get("visual.pos_embed.weight"),
+            "blocks": {k: np.stack(v) for k, v in blocks.items()},
+            "merger": merger_params("visual.merger."),
+            "ds_mergers": {k: np.stack([d[k] for d in ds]) for k in ds[0]}
+            if ds else {},
+        }
+
+    # --- vision -----------------------------------------------------------------------
+    def encode_vision(self, pixel_values: np.ndarray, image_grid_thw: np.ndarray):
+        """Returns (features (n_llm_tokens, H_text), deepstack (K, n_llm_tokens, H))."""
+        g = self._vision_geo
+        grid = np.asarray(image_grid_thw)
+        seq = int(np.prod(grid, axis=1).sum())
+        cos, sin = vision_rope_tables(grid, g["head_dim"], g["merge_size"])
+        pos_idx, pos_w = pos_embed_interp(grid, g["grid_side"], g["merge_size"])
+        frame_lens = np.repeat(grid[:, 1] * grid[:, 2], grid[:, 0])
+        cu = np.concatenate([[0], np.cumsum(frame_lens)]).astype(np.int64)
+        seg = segment_mask(cu, seq)
+        px = np.asarray(pixel_values, dtype=np.float32)
+        main, ds = self._vision_jit(self.vision_params, px, cos, sin, seg,
+                                    pos_idx, pos_w)
+        return np.asarray(main), np.asarray(ds)
+
+    # --- mm prefill with interleaved M-RoPE + deepstack -------------------------------
+    def _build_mm_prefill(self):
+        args, mesh, rules = self.arch_args, self.mesh, self.sharding_rules
+        odsc = self.sampling_config
+        prefill_core = self.prefill_fn()
+        sections = tuple(self.config.mrope_section)
+        from ...ops import sampling as sampling_ops
+
+        precision, use_ring, use_flash = self._mm_strategy()
+
+        def _prefill_mm(params, input_ids, position_ids, last_token_idx, cache,
+                        sampling_params, key, mm_mask, mm_override, positions3,
+                        deepstack, adapter_ids=None):
+            with jax.default_matmul_precision(precision):
+                cos, sin = rope_ops.mrope_cos_sin_interleaved(
+                    params["rope_inv_freq"], positions3, sections,
+                    args.rope_attention_scaling)
+                logits, cache = prefill_core(
+                    params, args, input_ids, position_ids, last_token_idx, cache,
+                    mesh=mesh, rules=rules, adapter_ids=adapter_ids,
+                    use_flash=use_flash, use_ring=use_ring,
+                    merge_embeds=(mm_mask, mm_override),
+                    rope_override=(cos, sin), deepstack=deepstack)
+                tokens = sampling_ops.sample(logits, sampling_params, key, odsc)
+            return tokens, logits, cache
+
+        return jax.jit(_prefill_mm, donate_argnums=(4,))
+
+    def reset_cache(self) -> None:
+        super().reset_cache()
+        b = self.tpu_config.max_batch_size
+        self.kv_cache["rope_delta"] = jnp.zeros((b,), dtype=jnp.int32)
+
+    def warmup(self) -> None:
+        from ...runtime.application import TpuModelForCausalLM
+
+        TpuModelForCausalLM.warmup(self)
+
+    # --- generation -------------------------------------------------------------------
+    def generate(self, input_ids, pixel_values=None, image_grid_thw=None, **kwargs):
+        if pixel_values is None:
+            return Qwen3ForCausalLM.generate(self, input_ids, **kwargs)
+        feats, ds = self.encode_vision(pixel_values, image_grid_thw)
+        mm = {"features": feats, "deepstack": ds,
+              "grid_thw": np.asarray(image_grid_thw)}
+        return Qwen3ForCausalLM.generate(self, input_ids, _mm_embeds=mm, **kwargs)
+
+    def _run_prefill(self, padded, sampling_params, key, adapter_ids, mm=None):
+        if mm is None:
+            return super(TpuModelForImageToText, self)._run_prefill(
+                padded, sampling_params, key, adapter_ids)
+        mask, override = self._scatter_features(padded, mm["features"])
+        ids = np.asarray(padded.input_ids)
+        valid = np.arange(ids.shape[1])[None, :] <= np.asarray(
+            padded.last_token_idx)[:, None]
+        positions3, deltas = get_rope_index_images(
+            ids, valid.astype(np.int64), mm["grid_thw"],
+            self.config.vision_config["spatial_merge_size"],
+            self.image_token_index, self.config.vision_start_token_id)
+        self.kv_cache["rope_delta"] = jnp.asarray(deltas, dtype=jnp.int32)
+        # deepstack features scattered at image positions per early layer
+        k_layers = mm["deepstack"].shape[0]
+        h = self.arch_args.hidden_size
+        ds = np.zeros((k_layers,) + ids.shape + (h,), dtype=np.float32)
+        flat_mask = ids == self.image_token_index
+        for j in range(k_layers):
+            ds[j][flat_mask] = mm["deepstack"][j]
+        return self._mm_prefill_step(
+            self.params, padded.input_ids, padded.position_ids,
+            padded.last_token_idx, self.kv_cache, sampling_params, key,
+            mask, override, positions3, ds, adapter_ids)

@@ -192,3 +192,24 @@ def mrope_cos_sin(inv_freq: jnp.ndarray, positions3: jnp.ndarray,
     cos = jnp.einsum("sbtd,ds->btd", jnp.cos(emb), onehot)
     sin = jnp.einsum("sbtd,ds->btd", jnp.sin(emb), onehot)
     return cos * attention_scaling, sin * attention_scaling
+
+
+def mrope_cos_sin_interleaved(inv_freq: jnp.ndarray, positions3: jnp.ndarray,
+                              sections, attention_scaling: float = 1.0):
+    """Qwen3-VL interleaved M-RoPE (HF `apply_interleaved_mrope`): frequency
+    channel c of the half-dim takes stream H when c % 3 == 1 and c < 3*sec[1],
+    stream W when c % 3 == 2 and c < 3*sec[2], else temporal — [THWTHW...TT]
+    instead of the chunked [TTT..HHH..WWW]. Returns (cos, sin) (B, S, head_dim)."""
+    half = inv_freq.shape[0]
+    sec = tuple(sections)
+    stream = np.zeros((half,), dtype=np.int32)
+    for dim, offset in ((1, 1), (2, 2)):
+        idx = np.arange(offset, sec[dim] * 3, 3)
+        stream[idx] = dim
+    sec_idx = np.concatenate([stream, stream])           # full head dim
+    freqs = positions3[..., None].astype(jnp.float32) * inv_freq   # (3, B, S, D/2)
+    emb = jnp.concatenate([freqs, freqs], axis=-1)                 # (3, B, S, D)
+    onehot = jax.nn.one_hot(jnp.asarray(sec_idx), 3, dtype=jnp.float32)  # (D, 3)
+    cos = jnp.einsum("sbtd,ds->btd", jnp.cos(emb), onehot)
+    sin = jnp.einsum("sbtd,ds->btd", jnp.sin(emb), onehot)
+    return cos * attention_scaling, sin * attention_scaling

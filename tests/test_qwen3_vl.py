@@ -1,0 +1,110 @@
+"""Qwen3-VL parity: deepstack ViT + interleaved M-RoPE text vs HF CPU.
+
+≈ reference `models/qwen3_vl/` coverage (deepstack vision features into early text
+layers, `models/model_base.py:1235-1247`)."""
+
+import numpy as np
+import pytest
+import torch
+
+from neuronx_distributed_inference_tpu.config import TpuConfig, load_pretrained_config
+
+
+@pytest.fixture(scope="module")
+def tiny_qwen3_vl():
+    from transformers import Qwen3VLConfig
+    from transformers import Qwen3VLForConditionalGeneration as HFQwen3VL
+
+    vision = dict(
+        depth=3, hidden_size=32, intermediate_size=64, num_heads=2,
+        in_channels=3, patch_size=4, temporal_patch_size=2,
+        spatial_merge_size=2, out_hidden_size=48, num_position_embeddings=16,
+        deepstack_visual_indexes=[0, 1], hidden_act="gelu_pytorch_tanh")
+    cfg = Qwen3VLConfig(
+        vision_config=vision,
+        text_config=dict(
+            vocab_size=256, hidden_size=48, intermediate_size=96,
+            num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=2,
+            head_dim=12, rope_theta=10000.0, max_position_embeddings=512,
+            tie_word_embeddings=False,
+            rope_scaling={"rope_type": "default", "mrope_section": [2, 2, 2],
+                          "mrope_interleaved": True}),
+        image_token_id=255, video_token_id=254, vision_start_token_id=253,
+        vision_end_token_id=252)
+    torch.manual_seed(0)
+    hf = HFQwen3VL(cfg).eval()
+    return hf, cfg
+
+
+def _build(cfg):
+    from neuronx_distributed_inference_tpu.models.qwen3_vl import (
+        Qwen3VLForConditionalGeneration)
+
+    tpu_cfg = TpuConfig(batch_size=1, seq_len=64, max_context_length=32,
+                        dtype="float32", context_encoding_buckets=[32],
+                        token_generation_buckets=[64])
+    config = Qwen3VLForConditionalGeneration.get_config_cls()(
+        tpu_cfg, load_config=load_pretrained_config(cfg.to_dict()))
+    return Qwen3VLForConditionalGeneration(None, config)
+
+
+def _load(app, hf):
+    state = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+    app._put_params(app.convert_hf_state_dict(state, app.config))
+    app.load_vision_from_state_dict(state)
+    return app
+
+
+def _image_inputs(rng, grid=(1, 8, 8)):
+    t, h, w = grid
+    seq = t * h * w
+    px = rng.normal(size=(seq, 3 * 2 * 4 * 4)).astype(np.float32)
+    return px, np.array([grid], dtype=np.int64)
+
+
+def test_vision_tower_and_deepstack_match_hf(tiny_qwen3_vl):
+    hf, cfg = tiny_qwen3_vl
+    app = _load(_build(cfg), hf)
+    rng = np.random.default_rng(0)
+    px, grid = _image_inputs(rng)
+    main, ds = app.encode_vision(px, grid)
+    with torch.no_grad():
+        hf_main, hf_ds = hf.model.visual(torch.tensor(px),
+                                         grid_thw=torch.tensor(grid))
+    np.testing.assert_allclose(main, hf_main.numpy(), atol=3e-4, rtol=1e-3)
+    assert ds.shape[0] == len(hf_ds)
+    for j in range(ds.shape[0]):
+        np.testing.assert_allclose(ds[j], hf_ds[j].numpy(), atol=3e-4, rtol=1e-3)
+
+
+def test_qwen3_vl_generate_matches_hf(tiny_qwen3_vl):
+    """End-to-end: deepstack injection + interleaved M-RoPE prefill + delta decode."""
+    hf, cfg = tiny_qwen3_vl
+    app = _load(_build(cfg), hf)
+    rng = np.random.default_rng(1)
+    px, grid = _image_inputs(rng)
+    n_llm = 16
+    ids = rng.integers(1, 250, size=(24,))
+    ids[2] = 253
+    ids[3:3 + n_llm] = 255
+    input_ids = ids[None, :]
+    with torch.no_grad():
+        hf_out = hf.generate(input_ids=torch.tensor(input_ids),
+                             pixel_values=torch.tensor(px),
+                             image_grid_thw=torch.tensor(grid),
+                             max_new_tokens=8, do_sample=False, pad_token_id=0)
+    out = app.generate(input_ids, pixel_values=px, image_grid_thw=grid,
+                       max_new_tokens=8)
+    np.testing.assert_array_equal(out.tokens, hf_out[:, 24:].numpy())
+
+
+def test_qwen3_vl_text_only_matches_hf(tiny_qwen3_vl):
+    hf, cfg = tiny_qwen3_vl
+    app = _load(_build(cfg), hf)
+    rng = np.random.default_rng(2)
+    input_ids = rng.integers(1, 250, size=(1, 10)).astype(np.int64)
+    with torch.no_grad():
+        hf_out = hf.generate(input_ids=torch.tensor(input_ids), max_new_tokens=6,
+                             do_sample=False, pad_token_id=0)
+    out = app.generate(input_ids, max_new_tokens=6)
+    np.testing.assert_array_equal(out.tokens, hf_out[:, 10:].numpy())
