@@ -532,14 +532,25 @@ def _decoder_layer(
 
     if stacked_layer_idx is not None:
         # kernel decode path: the stacked cache is carried whole (never sliced or
-        # re-stacked by scan) — write the step's rows with a DMA scatter, then run
-        # the length-aware Pallas decode-attention kernel over this layer
+        # re-stacked by scan) — write the step's rows with a DMA scatter. Short
+        # buckets then attend with jnp over one dynamic layer slice (profiling: the
+        # slice read is ~0.1ms and the attend fuses well; the Pallas attend's
+        # per-cell overhead only pays off once length-aware reads skip real
+        # bandwidth, i.e. long buckets).
         k_cache, v_cache = _sharded_kv_write(
             k_cache, v_cache, k.astype(k_cache.dtype), v.astype(v_cache.dtype),
             positions, stacked_layer_idx, mesh, rules)
-        attn = _sharded_decode_attend(q, k_cache, v_cache, positions,
-                                      stacked_layer_idx, decode_bucket, args,
-                                      mesh, rules)
+        if decode_bucket >= 1024:
+            attn = _sharded_decode_attend(q, k_cache, v_cache, positions,
+                                          stacked_layer_idx, decode_bucket, args,
+                                          mesh, rules)
+        else:
+            sizes = (1,) + k_cache.shape[1:3] + (decode_bucket, k_cache.shape[4])
+            start = (stacked_layer_idx, 0, 0, 0, 0)
+            k_att = jax.lax.dynamic_slice(k_cache, start, sizes)[0]
+            v_att = jax.lax.dynamic_slice(v_cache, start, sizes)[0]
+            attn = attend(q, k_att.astype(q.dtype), v_att.astype(q.dtype),
+                          mask=mask, scale=args.attention_scale)
         attn = attn.transpose(0, 2, 1, 3).reshape(h.shape[0], h.shape[1], args.q_size)
         attn_out = qapply(attn, lp["wo"])
         if args.lora is not None:
@@ -809,8 +820,9 @@ def _run_stack_pattern(params: Params, args: ModelArchArgs, h, ctx_full, ctx_sli
     return h, out
 
 
-def _run_stack_decode_kernel(params: Params, args: ModelArchArgs, h, cos, sin, cache,
-                             positions, decode_bucket, mesh, rules, adapter_ids=None):
+def _run_stack_decode_kernel(params: Params, args: ModelArchArgs, h, cos, sin, mask,
+                             cache, positions, decode_bucket, mesh, rules,
+                             adapter_ids=None):
     """Decode layer scan for the Pallas stacked-cache path.
 
     The cache rides the scan as a CARRY (full stacked arrays, updated in place by the
@@ -821,7 +833,7 @@ def _run_stack_decode_kernel(params: Params, args: ModelArchArgs, h, cos, sin, c
     def body(carry, xs):
         carry_h, ck, cv = carry
         lp, li = xs
-        new_h, ck, cv = _decoder_layer(lp, args, carry_h, cos, sin, None, ck, cv,
+        new_h, ck, cv = _decoder_layer(lp, args, carry_h, cos, sin, mask, ck, cv,
                                        positions, decode_bucket, mesh, rules,
                                        adapter_ids=adapter_ids,
                                        stacked_layer_idx=li)
@@ -1020,8 +1032,13 @@ def decode_forward(
         if args.layer_pattern is not None or args.attn_sinks or \
                 args.logits_soft_cap is not None:
             raise ValueError("use_kernel does not support this architecture")
+        kv_pos_k = jnp.arange(decode_bucket)[None, None, None, :]
+        mask_k = kv_pos_k <= pos_grid[:, None, :, None]
+        if args.sliding_window is not None:
+            mask_k = jnp.logical_and(
+                mask_k, kv_pos_k > pos_grid[:, None, :, None] - args.sliding_window)
         h, cache = _run_stack_decode_kernel(
-            params, args, h, cos, sin, cache, positions=position_ids,
+            params, args, h, cos, sin, mask_k, cache, positions=position_ids,
             decode_bucket=decode_bucket, mesh=mesh, rules=rules,
             adapter_ids=adapter_ids)
         h = _norm(h, params["final_norm"], args, params.get("final_norm_b"))

@@ -85,22 +85,37 @@ class HuggingFaceGenerationAdapter:
                           pad_token_id: Optional[int] = None, seed: int = 0,
                           **ignored):
         """HF assisted-decoding analog (≈ reference `_assisted_decoding` routing,
-        `utils/hf_adapter.py:494-933`): draft with ``assistant_model`` (a
-        TpuModelForCausalLM) through the fused speculative engine, verify with the
-        wrapped target. Greedy; returns full sequences like `generate`."""
+        `utils/hf_adapter.py:494-933`). ``assistant_model`` selects the path:
+
+        - a ``TpuModelForCausalLM`` draft -> fused draft-target speculation
+          (≈ `_fused_assisted_decoding` :494);
+        - a ``MedusaModel`` -> Medusa tree verify (≈ the Medusa loop :798-925);
+        - an ``EagleSpeculativeModel`` / ``Eagle3SpeculativeModel`` -> EAGLE
+          hidden-conditioned speculation (chain / dynamic tree).
+
+        Greedy; returns full sequences like `generate`."""
+        from ..runtime.eagle import EagleSpeculativeModel
+        from ..runtime.eagle3 import Eagle3SpeculativeModel
+        from ..runtime.medusa import MedusaModel
         from ..runtime.speculation import FusedSpeculativeModel
 
-        key = (id(assistant_model), speculation_length)
-        if getattr(self, "_spec_cache_key", None) != key:
-            self._spec_model = FusedSpeculativeModel(
-                self.app, assistant_model, speculation_length, greedy=True)
-            self._spec_cache_key = key
         is_torch = _is_torch(input_ids)
         ids = _to_numpy(input_ids)
         mask = _to_numpy(attention_mask) if attention_mask is not None else None
-        out = self._spec_model.generate(
-            ids, attention_mask=mask, max_new_tokens=max_new_tokens,
-            eos_token_id=eos_token_id, pad_token_id=pad_token_id or 0, seed=seed)
+        common = dict(attention_mask=mask, max_new_tokens=max_new_tokens,
+                      eos_token_id=eos_token_id, pad_token_id=pad_token_id or 0)
+
+        if isinstance(assistant_model,
+                      (MedusaModel, EagleSpeculativeModel, Eagle3SpeculativeModel,
+                       FusedSpeculativeModel)):
+            out = assistant_model.generate(ids, **common)
+        else:
+            key = (id(assistant_model), speculation_length)
+            if getattr(self, "_spec_cache_key", None) != key:
+                self._spec_model = FusedSpeculativeModel(
+                    self.app, assistant_model, speculation_length, greedy=True)
+                self._spec_cache_key = key
+            out = self._spec_model.generate(ids, seed=seed, **common)
         sequences = out.sequences
         if is_torch:
             import torch

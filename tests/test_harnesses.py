@@ -172,3 +172,32 @@ def test_validate_accuracy_raises_on_divergence():
     with np.testing.assert_raises(AssertionError):
         validate_accuracy(lambda x: x + 1.0, lambda x: x,
                           (np.ones((2, 2), np.float32),))
+
+
+def test_hf_adapter_assisted_routing(tiny_app):
+    """generate_assisted reaches the Medusa / EAGLE / EAGLE3 engines (≈ reference
+    `_assisted_decoding` routing, `utils/hf_adapter.py:494-933`) and stays exact."""
+    from neuronx_distributed_inference_tpu.runtime.eagle3 import (
+        Eagle3SpeculativeModel)
+    from neuronx_distributed_inference_tpu.runtime.eagle import (
+        draft_args_from_target)
+    from neuronx_distributed_inference_tpu.runtime.medusa import MedusaModel
+    from neuronx_distributed_inference_tpu.utils.hf_adapter import (
+        HuggingFaceGenerationAdapter)
+
+    adapter = HuggingFaceGenerationAdapter(tiny_app)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(1, 256, size=(2, 8)).astype(np.int64)
+    want = adapter.generate(ids, max_new_tokens=10)
+
+    medusa = MedusaModel(tiny_app, num_medusa_heads=3)
+    medusa.load_random_heads(seed=1)
+    got = adapter.generate_assisted(ids, medusa, max_new_tokens=10)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    e3 = Eagle3SpeculativeModel(
+        tiny_app, draft_args_from_target(tiny_app.arch_args, num_layers=1),
+        depth=2, beam=2, branch=2)
+    e3.load_random_draft(seed=2)
+    got = adapter.generate_assisted(ids, e3, max_new_tokens=10)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
