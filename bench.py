@@ -130,7 +130,10 @@ def main() -> None:
     # serving TTFT: a single request prefilled at batch bucket 1 (first-class
     # metric, ≈ reference TTFT reporting `utils/benchmark.py:479-494`); the bulk
     # ttft above amortizes a full batch-64 prefill and is NOT time-to-first-token
-    # for one user
+    # for one user.
+    # NOTE (profiled): the device-side bs=1 prefill is ~17 ms; the remainder of the
+    # wall TTFT here is the axon tunnel's per-dispatch HTTP overhead (~3-6 ms per
+    # call x param-buffer marshaling), which local PJRT serving does not pay.
     single = input_ids[:1]
     ttfts = []
     for i in range(12):

@@ -50,7 +50,8 @@ class LlamaForCausalLM(TpuModelForCausalLM):
             vocab_size=config.vocab_size,
             hidden_size=config.hidden_size,
             num_layers=config.num_hidden_layers,
-            num_heads=config.num_attention_heads,
+            num_heads=gqa.effective_q_heads(tp, config.num_attention_heads,
+                                            config.num_key_value_heads),
             num_kv_heads=gqa.effective_kv_heads(tp, config.num_key_value_heads),
             head_dim=config.head_dim,
             intermediate_size=config.intermediate_size,
@@ -77,8 +78,10 @@ class LlamaForCausalLM(TpuModelForCausalLM):
         """
         args = cls.arch_args_from_config(config)
         L = config.num_hidden_layers
+        n_q = config.num_attention_heads
         n_kv = config.num_key_value_heads
         d = config.head_dim
+        tp = config.tpu_config.tp_degree
         factor = args.num_kv_heads // n_kv
 
         def get(name):
@@ -98,18 +101,21 @@ class LlamaForCausalLM(TpuModelForCausalLM):
         for i in range(L):
             p = f"model.layers.{i}."
             layers["ln1"].append(get(p + "input_layernorm.weight"))
-            layers["wq"].append(linear_t(p + "self_attn.q_proj.weight"))
+            layers["wq"].append(gqa.expand_q_weight(
+                linear_t(p + "self_attn.q_proj.weight"), n_q, n_kv, d, tp))
             layers["wk"].append(gqa.replicate_kv_weight(
                 linear_t(p + "self_attn.k_proj.weight"), n_kv, d, factor))
             layers["wv"].append(gqa.replicate_kv_weight(
                 linear_t(p + "self_attn.v_proj.weight"), n_kv, d, factor))
-            layers["wo"].append(linear_t(p + "self_attn.o_proj.weight"))
+            layers["wo"].append(gqa.expand_o_weight(
+                get(p + "self_attn.o_proj.weight").T, n_q, n_kv, d, tp))
             layers["ln2"].append(get(p + "post_attention_layernorm.weight"))
             layers["wg"].append(linear_t(p + "mlp.gate_proj.weight"))
             layers["wu"].append(linear_t(p + "mlp.up_proj.weight"))
             layers["wd"].append(linear_t(p + "mlp.down_proj.weight"))
             if args.attention_bias:
-                layers["bq"].append(get(p + "self_attn.q_proj.bias"))
+                layers["bq"].append(gqa.expand_q_bias(
+                    get(p + "self_attn.q_proj.bias"), n_q, n_kv, d, tp))
                 layers["bk"].append(gqa.replicate_kv_bias(
                     get(p + "self_attn.k_proj.bias"), n_kv, d, factor))
                 layers["bv"].append(gqa.replicate_kv_bias(

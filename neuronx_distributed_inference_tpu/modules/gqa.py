@@ -1,16 +1,21 @@
 """GQA head-sharding strategies.
 
 ≈ reference `modules/attention/gqa.py` (`determine_sharding_strategy` :89,
-`get_shardable_head_counts` :105, replicate/pad helpers :164-271). On TPU the only case
-needing weight surgery is kv-head replication when tp_degree exceeds (or doesn't divide)
-the kv-head count: kv heads are repeat-interleaved at conversion time so the ``kv_heads``
-axis shards evenly; query heads keep their order because consecutive q-head groups map to
-consecutive replicated kv heads.
+`get_shardable_head_counts` :105, replicate/pad helpers :164-271). Weight surgery at
+conversion time makes any (tp, kv_heads) combination shardable:
+
+- kv heads repeat-interleave by ``f = lcm(kv, tp) / kv`` so the ``kv_heads`` axis
+  shards evenly;
+- when the replication factor does not divide the per-kv-head query group, query
+  heads PAD with zero heads (zero wq rows, zero wo columns — the padded heads'
+  outputs vanish through wo), the TPU analog of the reference's interleaved-pad
+  strategy.
 """
 
 from __future__ import annotations
 
 import enum
+import math
 from typing import Tuple
 
 import numpy as np
@@ -24,16 +29,77 @@ class GQASharding(enum.Enum):
 def determine_sharding_strategy(tp_degree: int, num_kv_heads: int) -> GQASharding:
     if num_kv_heads % tp_degree == 0:
         return GQASharding.NATIVE
-    if tp_degree % num_kv_heads == 0:
-        return GQASharding.REPLICATE
-    raise ValueError(
-        f"kv_heads={num_kv_heads} and tp={tp_degree} are incompatible: one must divide "
-        f"the other (reference supports the same constraint via pad/replicate)")
+    return GQASharding.REPLICATE
 
 
 def replication_factor(tp_degree: int, num_kv_heads: int) -> int:
-    strategy = determine_sharding_strategy(tp_degree, num_kv_heads)
-    return tp_degree // num_kv_heads if strategy is GQASharding.REPLICATE else 1
+    if num_kv_heads % tp_degree == 0:
+        return 1
+    return math.lcm(num_kv_heads, tp_degree) // num_kv_heads
+
+
+def padded_group_size(tp_degree: int, num_q_heads: int, num_kv_heads: int) -> int:
+    """Query heads per REPLICATED kv head (padded up so every replica gets an equal
+    group; padded heads are zero)."""
+    f = replication_factor(tp_degree, num_kv_heads)
+    group = num_q_heads // num_kv_heads
+    return -(-group // f)
+
+
+def effective_q_heads(tp_degree: int, num_q_heads: int, num_kv_heads: int) -> int:
+    return (effective_kv_heads(tp_degree, num_kv_heads)
+            * padded_group_size(tp_degree, num_q_heads, num_kv_heads))
+
+
+def expand_q_weight(w: np.ndarray, num_q_heads: int, num_kv_heads: int,
+                    head_dim: int, tp_degree: int) -> np.ndarray:
+    """Reorder/pad a (hidden, q_heads*head_dim) query projection for the replicated
+    kv layout: each original kv group's q heads split across the f replicas, padded
+    with zero heads."""
+    f = replication_factor(tp_degree, num_kv_heads)
+    if f == 1:
+        return w
+    hidden = w.shape[0]
+    group = num_q_heads // num_kv_heads
+    gp = padded_group_size(tp_degree, num_q_heads, num_kv_heads)
+    w = w.reshape(hidden, num_kv_heads, group, head_dim)
+    out = np.zeros((hidden, num_kv_heads, f, gp, head_dim), dtype=w.dtype)
+    for r in range(f):
+        take = w[:, :, r * gp : (r + 1) * gp, :]
+        out[:, :, r, : take.shape[2], :] = take
+    return out.reshape(hidden, -1)
+
+
+def expand_o_weight(w: np.ndarray, num_q_heads: int, num_kv_heads: int,
+                    head_dim: int, tp_degree: int) -> np.ndarray:
+    """Matching reorder/pad of the (q_heads*head_dim, hidden) output projection."""
+    f = replication_factor(tp_degree, num_kv_heads)
+    if f == 1:
+        return w
+    hidden = w.shape[1]
+    group = num_q_heads // num_kv_heads
+    gp = padded_group_size(tp_degree, num_q_heads, num_kv_heads)
+    w = w.reshape(num_kv_heads, group, head_dim, hidden)
+    out = np.zeros((num_kv_heads, f, gp, head_dim, hidden), dtype=w.dtype)
+    for r in range(f):
+        take = w[:, r * gp : (r + 1) * gp, :, :]
+        out[:, r, : take.shape[1], :, :] = take
+    return out.reshape(-1, hidden)
+
+
+def expand_q_bias(b: np.ndarray, num_q_heads: int, num_kv_heads: int,
+                  head_dim: int, tp_degree: int) -> np.ndarray:
+    f = replication_factor(tp_degree, num_kv_heads)
+    if f == 1:
+        return b
+    group = num_q_heads // num_kv_heads
+    gp = padded_group_size(tp_degree, num_q_heads, num_kv_heads)
+    b = b.reshape(num_kv_heads, group, head_dim)
+    out = np.zeros((num_kv_heads, f, gp, head_dim), dtype=b.dtype)
+    for r in range(f):
+        take = b[:, r * gp : (r + 1) * gp, :]
+        out[:, r, : take.shape[1], :] = take
+    return out.reshape(-1)
 
 
 def replicate_kv_weight(w: np.ndarray, num_kv_heads: int, head_dim: int,

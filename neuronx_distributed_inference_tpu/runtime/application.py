@@ -705,6 +705,10 @@ class TpuModelForCausalLM:
             if _mm_embeds is not None:
                 raise ValueError("multimodal prompts exceed the largest context "
                                  "bucket; raise max_context_length")
+            if adapter_ids is not None:
+                raise ValueError("windowed prefill does not thread LoRA adapters "
+                                 "into window writes yet; raise "
+                                 "max_context_length to cover the prompt")
             w = self.cte_buckets[-1]
             total = padded.input_ids.shape[1]
             if total > self.tpu_config.seq_len:

@@ -1,0 +1,104 @@
+"""Serving feature x feature combination coverage.
+
+≈ reference config cross-validation + feature-combo integration tests
+(`models/config.py:610-686`, `test/integration/tiny_model/features/`): the
+combinations users actually deploy must be exercised together, not only alone.
+"""
+
+import numpy as np
+import pytest
+
+from neuronx_distributed_inference_tpu.config import (
+    LoraServingConfig, QuantizationConfig, TpuConfig, load_pretrained_config)
+from neuronx_distributed_inference_tpu.models.llama.modeling_llama import (
+    LlamaForCausalLM, LlamaInferenceConfig)
+
+
+def _make(hf_cfg, *, quant=False, cb=False, paged=False, lora=False, batch=2,
+          seq_len=96, cte=(16, 32)):
+    cfg = TpuConfig(
+        batch_size=batch, seq_len=seq_len, max_context_length=cte[-1],
+        dtype="float32", context_encoding_buckets=list(cte),
+        token_generation_buckets=[48, 96],
+        is_continuous_batching=cb, paged_attention_enabled=paged,
+        pa_num_blocks=48, pa_block_size=8,
+        quantization_config=(QuantizationConfig(quantize_weights=True,
+                                                weight_dtype="int8")
+                             if quant else None),
+        lora_serving_config=(LoraServingConfig(max_loras=2, max_lora_rank=4)
+                             if lora else None),
+    )
+    config = LlamaInferenceConfig(cfg, load_config=load_pretrained_config(hf_cfg))
+    app = LlamaForCausalLM(None, config)
+    app.load_random(seed=0)
+    return app
+
+
+def test_quantization_x_speculation(tiny_llama_hf_config):
+    """Fused draft-target speculation over an int8 target stays EXACT vs the int8
+    target's plain greedy decode."""
+    from neuronx_distributed_inference_tpu.runtime.speculation import (
+        FusedSpeculativeModel)
+
+    target = _make(tiny_llama_hf_config, quant=True)
+    draft = _make(tiny_llama_hf_config, quant=True)   # same arch; any draft works
+    spec = FusedSpeculativeModel(target, draft, speculation_length=4)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(1, 256, size=(2, 10)).astype(np.int32)
+    want = target.generate(ids, max_new_tokens=16)
+    out = spec.generate(ids, max_new_tokens=16)
+    np.testing.assert_array_equal(out.tokens, want.tokens)
+
+
+@pytest.mark.parametrize("paged", [False, True], ids=["dense", "paged"])
+def test_quantization_x_continuous_batching(tiny_llama_hf_config, paged):
+    """int8 weights under slot-based serving (dense insert + paged block tables)
+    match the int8 dedicated runs token-for-token."""
+    from neuronx_distributed_inference_tpu.runtime.continuous_batching import (
+        ContinuousBatchingRunner)
+
+    rng = np.random.default_rng(1)
+    prompts = [rng.integers(1, 256, size=(n,)).astype(np.int32) for n in (12, 7, 19)]
+    plain = _make(tiny_llama_hf_config, quant=True)
+    want = [plain.generate(p[None, :], max_new_tokens=8).tokens[0].tolist()
+            for p in prompts]
+    app = _make(tiny_llama_hf_config, quant=True, cb=True, paged=paged)
+    runner = ContinuousBatchingRunner(app, decode_chunk=4)
+    ids = [runner.submit(p, max_new_tokens=8) for p in prompts]
+    results = runner.run_to_completion()
+    for rid, w in zip(ids, want):
+        assert results[rid] == w
+
+
+def test_quantization_x_lora(tiny_llama_hf_config):
+    """Multi-LoRA slots over an int8-quantized base: adapters still route per
+    request and change outputs; slot 0 (base) matches the plain quantized run."""
+    app = _make(tiny_llama_hf_config, quant=True, lora=True)
+    rng = np.random.default_rng(2)
+    sd = {}
+    for i in range(2):
+        for proj, shape in (("q_proj", (64, 64)), ("v_proj", (32, 64))):
+            sd[f"base_model.model.model.layers.{i}.self_attn.{proj}.lora_A.weight"] = \
+                rng.normal(size=(4, 64)).astype(np.float32)
+            sd[f"base_model.model.model.layers.{i}.self_attn.{proj}.lora_B.weight"] = \
+                rng.normal(size=(shape[0], 4)).astype(np.float32) * 3.0
+    app.set_lora_adapters([sd])
+
+    ids = rng.integers(1, 256, size=(2, 10)).astype(np.int32)
+    base_ref = _make(tiny_llama_hf_config, quant=True)
+    want = base_ref.generate(ids, max_new_tokens=8)
+    base = app.generate(ids, adapter_ids=np.zeros(2, np.int32), max_new_tokens=8)
+    np.testing.assert_array_equal(base.tokens, want.tokens)
+    adapted = app.generate(ids, adapter_ids=np.ones(2, np.int32), max_new_tokens=8)
+    assert not np.array_equal(adapted.tokens, base.tokens)
+
+
+def test_windowed_prefill_rejects_lora(tiny_llama_hf_config):
+    """Dense windowed prefill does not thread adapters into window writes yet —
+    must fail loudly instead of silently dropping the adapter."""
+    app = _make(tiny_llama_hf_config, lora=True, seq_len=128)
+    rng = np.random.default_rng(3)
+    long_prompt = rng.integers(1, 256, size=(1, 50)).astype(np.int32)
+    with pytest.raises(ValueError, match="windowed"):
+        app.generate(long_prompt, adapter_ids=np.zeros(1, np.int32),
+                     max_new_tokens=4)

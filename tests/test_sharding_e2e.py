@@ -141,3 +141,41 @@ def test_attention_dp_validates_batch():
     with pytest.raises(ValueError, match="divisible"):
         TpuConfig(batch_size=6, seq_len=64, tp_degree=4,
                   attention_dp_enabled=True)
+
+
+def test_gqa_pad_interleave_non_dividing():
+    """kv=3 heads at tp=2 (neither divides the other): kv heads replicate to
+    lcm=6 and query groups pad with zero heads (≈ reference interleaved-pad,
+    `modules/attention/gqa.py:105-271`) — tokens must match tp=1 exactly."""
+    from transformers import LlamaConfig, LlamaForCausalLM as HFLlama
+
+    cfg = dict(HF_CFG, num_attention_heads=9, num_key_value_heads=3,
+               hidden_size=72, intermediate_size=96)
+    torch.manual_seed(1)
+    model = HFLlama(LlamaConfig(**{k: v for k, v in cfg.items()
+                                   if k != "model_type"})).eval()
+    state = {k: v.detach().numpy() for k, v in model.state_dict().items()}
+
+    def make(tp):
+        tpu_cfg = TpuConfig(batch_size=2, seq_len=64, max_context_length=32,
+                            dtype="float32", tp_degree=tp,
+                            context_encoding_buckets=[32],
+                            token_generation_buckets=[64])
+        config = LlamaInferenceConfig(tpu_cfg,
+                                      load_config=load_pretrained_config(cfg))
+        app = LlamaForCausalLM(None, config)
+        app._put_params(app.convert_hf_state_dict(state, app.config))
+        return app
+
+    app2 = make(2)
+    assert app2.arch_args.num_kv_heads == 6        # lcm(3, 2)
+    # 3 groups of 3 q heads split over 2 replicas -> 6 groups padded to 2 each
+    assert app2.arch_args.num_heads == 12
+
+    rng = np.random.default_rng(7)
+    input_ids = rng.integers(1, 256, size=(2, 14)).astype(np.int64)
+    want = make(1).generate(input_ids, max_new_tokens=10, return_logits=True)
+    got = app2.generate(input_ids, max_new_tokens=10, return_logits=True)
+    np.testing.assert_array_equal(got.tokens, want.tokens)
+    for lw, lg in zip(want.logits, got.logits):
+        np.testing.assert_allclose(lw, lg, atol=1e-4, rtol=1e-4)
