@@ -159,9 +159,11 @@ class ContinuousBatchingRunner:
                                         static_argnames=("num_steps",))
         else:
             # thread the app's prefill strategy (ring for cp>1, Pallas flash, or
-            # dense attend) into insert-time context encoding
+            # dense attend) into insert-time context encoding; decode chunks take
+            # the Pallas stacked-cache path when the arch supports it
             use_ring = app._use_ring_attention()
             use_flash = (not use_ring) and app._use_flash_attention()
+            kernel_kw = ({"use_kernel": True} if app._use_decode_kernel() else {})
 
             def _insert(params, input_ids, position_ids, last_token_idx, cache,
                         slot, sampling_params, key):
@@ -182,7 +184,7 @@ class ContinuousBatchingRunner:
                     with jax.default_matmul_precision(precision):
                         logits, cache = decode_core(
                             params, args, tok[:, None], pos, cache, decode_bucket,
-                            mesh=mesh, rules=rules)
+                            mesh=mesh, rules=rules, **kernel_kw)
                         nxt = sampling_ops.sample(logits[:, -1], sampling_params,
                                                   step_key, odsc)
                     return (nxt, pos + 1, cache), nxt

@@ -104,6 +104,7 @@ class EagleSpeculativeModel:
         mesh, rules = t.mesh, t.sharding_rules
         k = self.k
         precision = "highest" if t.tpu_config.dtype == "float32" else "default"
+        t_kernel = {"use_kernel": True} if t._use_decode_kernel() else {}
 
         def _prefill(t_params, d_params, input_ids, position_ids, last_token_idx,
                      t_cache, d_cache):
@@ -142,7 +143,8 @@ class EagleSpeculativeModel:
             with jax.default_matmul_precision(precision):
                 t_logits, t_cache, t_h = model_base.decode_forward(
                     t_params, t_args, target_in, positions, t_cache, decode_bucket,
-                    mesh=mesh, rules=rules, return_hidden=True)   # (B, K, V/H)
+                    mesh=mesh, rules=rules, return_hidden=True,
+                    **t_kernel)                                   # (B, K, V/H)
             t_toks = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)
             matches = draft_toks == t_toks[:, :-1]
             n = jnp.cumprod(matches.astype(jnp.int32), axis=1).sum(axis=1)
