@@ -71,6 +71,12 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--attention-kernel", dest="attention_kernel", default=None,
                    action="store_true",
                    help="force the Pallas flash prefill kernel on")
+    g.add_argument("--decode-kernel", dest="decode_kernel", default=None,
+                   action="store_true",
+                   help="force the Pallas stacked-cache decode path on")
+    g.add_argument("--batch-buckets", type=int, nargs="*", default=None,
+                   help="batch-dim buckets (small request batches run smaller "
+                        "graphs); must end at the max batch size")
     g.add_argument("--cpu", action="store_true",
                    help="force the CPU backend (debug / no-accelerator runs)")
     g.add_argument("--compilation-cache-dir", default=None,
@@ -159,6 +165,8 @@ def create_tpu_config(args: argparse.Namespace) -> TpuConfig:
         decode_chunk_size=args.decode_chunk_size,
         async_mode=args.async_mode,
         attention_kernel_enabled=args.attention_kernel,
+        decode_kernel_enabled=args.decode_kernel,
+        batch_buckets=args.batch_buckets,
         is_continuous_batching=args.continuous_batching,
         paged_attention_enabled=args.paged_attention,
         pa_num_blocks=args.pa_num_blocks,

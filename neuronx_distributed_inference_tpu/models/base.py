@@ -454,6 +454,80 @@ def _sharded_decode_attend(q, k_cache, v_cache, positions, layer_idx, bucket,
     return fn(q, k_cache, v_cache, positions, layer_idx)
 
 
+def _flash_decoding_step(q, k_new, v_new, k_cache, v_cache, positions,
+                         args: ModelArchArgs, mesh, rules):
+    """KV-sequence-sharded decode step (flash decoding): write + attend in one
+    shard_map.
+
+    ≈ reference flash decoding (`modules/flashdecode/utils.py:11-58`,
+    `attention_base.py:2171-2188`): the KV cache's sequence dim is sharded over the
+    ``cp`` mesh axis; the shard owning each row's position writes the fresh K/V, and
+    every shard computes attention over its local KV range — the partial softmaxes
+    merge with a log-sum-exp reduction (pmax + psum over cp), so decode attention
+    time and per-chip cache memory both scale 1/cp with context length.
+    Returns (attn (B, n_q, T, D), k_cache, v_cache)."""
+    from ..parallel.mesh import AXIS_CP
+    from ..parallel.sharding import DEFAULT_RULES, logical_to_spec
+
+    r = dict(rules or DEFAULT_RULES)
+    d = q.shape[-1]
+    t = q.shape[2]
+    scale = args.attention_scale if args.attention_scale is not None else d ** -0.5
+
+    def _local(q, kn, vn, kc, vc, pos):
+        # all shapes here are PER-SHARD: kc/vc (B', n_kv', S/cp, D), q replicated
+        # over cp with its heads sharded over tp
+        b, n_q = q.shape[0], q.shape[1]
+        n_kv = kc.shape[1]
+        rep = n_q // n_kv
+        local_s = kc.shape[2]
+        base = jax.lax.axis_index(AXIS_CP) * local_s
+        in_range = (pos >= base) & (pos + t <= base + local_s)
+        local_pos = jnp.clip(pos - base, 0, local_s - t)
+
+        def _write(cache, new):
+            def one(row_c, row_n, p, ok):
+                upd = jax.lax.dynamic_update_slice(
+                    row_c, row_n.astype(row_c.dtype), (0, p, 0))
+                return jnp.where(ok, upd, row_c)
+
+            return jax.vmap(one)(cache, new, local_pos, in_range)
+
+        kc = _write(kc, kn)
+        vc = _write(vc, vn)
+
+        kv_pos = base + jnp.arange(local_s)[None, None, None, :]
+        q_pos = (pos[:, None] + jnp.arange(t)[None, :])[:, None, :, None]
+        mask = kv_pos <= q_pos
+        if args.sliding_window is not None:
+            mask = jnp.logical_and(mask, kv_pos > q_pos - args.sliding_window)
+        qg = q.reshape(b, n_kv, rep, t, d)
+        s = jnp.einsum("bkrqd,bktd->bkrqt", qg, kc.astype(q.dtype),
+                       preferred_element_type=jnp.float32) * scale
+        s = jnp.where(mask[:, :, None], s, -jnp.inf)
+        m = jnp.max(s, axis=-1, keepdims=True)              # local max
+        gm = jax.lax.pmax(m, AXIS_CP)                       # global max
+        gm_safe = jnp.where(jnp.isfinite(gm), gm, 0.0)
+        p = jnp.where(jnp.isfinite(s), jnp.exp(s - gm_safe), 0.0)
+        num = jnp.einsum("bkrqt,bktd->bkrqd", p.astype(q.dtype),
+                         vc.astype(q.dtype)).astype(jnp.float32)
+        den = jnp.sum(p, axis=-1, keepdims=True)
+        num = jax.lax.psum(num, AXIS_CP)
+        den = jax.lax.psum(den, AXIS_CP)
+        out = (num / jnp.maximum(den, 1e-20)).astype(q.dtype)
+        return out.reshape(b, n_q, t, d), kc, vc
+
+    q_spec = logical_to_spec(("decode_batch", "decode_heads", None, None), r)
+    new_spec = logical_to_spec(("decode_batch", "decode_kv_heads", None, None), r)
+    kv_spec = logical_to_spec(("decode_batch", "decode_kv_heads", "kv_seq", None), r)
+    pos_spec = logical_to_spec(("decode_batch",), r)
+    fn = jax.shard_map(_local, mesh=mesh,
+                       in_specs=(q_spec, new_spec, new_spec, kv_spec, kv_spec,
+                                 pos_spec),
+                       out_specs=(q_spec, kv_spec, kv_spec), check_vma=False)
+    return fn(q, k_new, v_new, k_cache, v_cache, positions)
+
+
 def _sharded_flash_attention(q, k, v, args: ModelArchArgs, mesh, rules):
     """Run the Pallas flash kernel with heads local per shard.
 
@@ -508,6 +582,7 @@ def _decoder_layer(
     # (B,) true row lengths: prefill writes into a rolling window cache (the layer's
     # cache stack is W wide; see kvcache.write_prefill_rolling)
     rolling_lengths: Optional[jnp.ndarray] = None,
+    flash_decoding: bool = False,   # KV-seq-sharded decode over the cp axis
 ):
     resid = h
     hn = _norm(h, lp["ln1"], args, lp.get("ln1_b"))
@@ -580,6 +655,24 @@ def _decoder_layer(
         if args.sandwich_norms:
             mlp_out = _norm(mlp_out, lp["ln2_post"], args)
         h = resid + mlp_out
+        return h, k_cache, v_cache
+
+    if flash_decoding and positions is not None:
+        attn, k_cache, v_cache = _flash_decoding_step(
+            q, k, v, k_cache, v_cache, positions, args, mesh, rules)
+        attn = attn.transpose(0, 2, 1, 3).reshape(h.shape[0], h.shape[1], args.q_size)
+        attn_out = qapply(attn, lp["wo"])
+        if args.o_bias:
+            attn_out = attn_out + lp["bo"]
+        attn_out = constrain(attn_out, ("batch", None, None), rules, mesh=mesh)
+        h = resid + attn_out
+        resid = h
+        hn = _norm(h, lp["ln2"], args, lp.get("ln2_b"))
+        if args.moe is not None:
+            ffn = moe_block(lp, args, hn, mesh, rules, _ACTIVATIONS[args.activation])
+        else:
+            ffn = _mlp(lp, args, hn, mesh, rules, adapter_ids)
+        h = resid + constrain(ffn, ("batch", None, None), rules, mesh=mesh)
         return h, k_cache, v_cache
 
     if paged is not None:
@@ -688,7 +781,7 @@ def _run_stack(params: Params, args: ModelArchArgs, h, cos, sin, mask, cache,
                paged=None, cache_batch_start=0,
                adapter_ids=None, ring_positions=None, window_row=None,
                capture_layers: Optional[Tuple[int, ...]] = None,
-               deepstack: Optional[jnp.ndarray] = None):
+               deepstack: Optional[jnp.ndarray] = None, flash_decoding=False):
     """Scan the decoder layers, carrying hidden state, yielding updated cache.
 
     ``capture_layers`` (static layer indices) also collects those layers' OUTPUT
@@ -709,7 +802,8 @@ def _run_stack(params: Params, args: ModelArchArgs, h, cos, sin, mask, cache,
                                        cache_batch_start=cache_batch_start,
                                        adapter_ids=adapter_ids,
                                        ring_positions=ring_positions,
-                                       window_row=window_row)
+                                       window_row=window_row,
+                                       flash_decoding=flash_decoding)
         if capture_layers:
             caps = tuple(jnp.where(li == idx, new_h, buf)
                          for idx, buf in zip(capture_layers, caps))
@@ -982,6 +1076,8 @@ def decode_forward(
     return_hidden: bool = False,  # also return the final normed hidden states (B, T, H)
     window_row=None,  # traced scalar: dense windowed prefill at this cache batch row
     use_kernel: bool = False,  # static: Pallas stacked-cache decode (hot path)
+    # static: KV-seq-sharded decode over the cp axis (flash decoding); T must be 1
+    flash_decoding: bool = False,
     # static layer indices whose output hiddens are captured (EAGLE3 conditioning)
     capture_layers: Optional[Tuple[int, ...]] = None,
 ) -> Tuple[jnp.ndarray, kvcache.KVCache]:
@@ -1091,11 +1187,14 @@ def decode_forward(
     if sliding is not None:
         mask = sliding
 
+    if flash_decoding and (t > 1 or tree is not None or paged is not None):
+        raise ValueError("flash decoding supports single-token chain decode only")
     out = _run_stack(params, args, h, cos, sin, mask, cache,
                      positions=position_ids, decode_bucket=decode_bucket,
                      mesh=mesh, rules=rules,
                      paged=paged, adapter_ids=adapter_ids,
-                     window_row=window_row, capture_layers=capture_layers)
+                     window_row=window_row, capture_layers=capture_layers,
+                     flash_decoding=flash_decoding)
     h, cache = out[0], out[1]
     h = _norm(h, params["final_norm"], args, params.get("final_norm_b"))
     logits = _lm_head(params, args, h, mesh, rules)

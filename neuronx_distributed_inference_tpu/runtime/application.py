@@ -107,6 +107,13 @@ class TpuModelForCausalLM:
         self.sharding_rules = dict(DEFAULT_RULES)
         if not self.tpu_config.vocab_parallel:
             self.sharding_rules["vocab"] = None
+        if self.tpu_config.flash_decoding_enabled:
+            # flash decoding: decode-time KV caches shard their sequence dim over
+            # the cp axis (≈ reference flashdecode KV-replication groups,
+            # `modules/flashdecode/utils.py:11-58`)
+            from ..parallel.mesh import AXIS_CP
+
+            self.sharding_rules["kv_seq"] = AXIS_CP
         if self.tpu_config.attention_dp_enabled:
             # decode attention goes batch-parallel over every chip; GQA kv heads
             # replicate within each batch shard (≈ attention DP + DP KV cache
@@ -190,7 +197,8 @@ class TpuModelForCausalLM:
         rules = self.sharding_rules
         use_ring = self._use_ring_attention()
         use_flash = (not use_ring) and self._use_flash_attention()
-        use_decode_kernel = self._use_decode_kernel()
+        use_fd = self._use_flash_decoding()
+        use_decode_kernel = (not use_fd) and self._use_decode_kernel()
 
         def _prefill(params, input_ids, position_ids, last_token_idx, cache,
                      sampling_params, key, adapter_ids=None):
@@ -218,6 +226,8 @@ class TpuModelForCausalLM:
             keys = jax.random.split(key, num_steps)
 
             kernel_kw = {"use_kernel": True} if use_decode_kernel else {}
+            if use_fd:
+                kernel_kw = {"flash_decoding": True}
 
             def body(carry, step_key):
                 tok, pos, cache = carry
@@ -291,6 +301,33 @@ class TpuModelForCausalLM:
             if bucket % cp != 0:
                 raise ValueError(
                     f"context bucket {bucket} not divisible by cp_degree {cp}")
+        return True
+
+    def _use_flash_decoding(self) -> bool:
+        """KV-seq-sharded decode (flash decoding) over the cp axis
+        (≈ reference `modules/flashdecode/`): explicit opt-in via
+        ``flash_decoding_enabled``; requires cp > 1 and the base decode path."""
+        if not self.tpu_config.flash_decoding_enabled:
+            return False
+        cp = self.mesh.shape["cp"]
+        if cp <= 1:
+            raise ValueError("flash_decoding_enabled requires cp_degree > 1 "
+                             "(the KV sequence dim shards over the cp axis)")
+        a = self.arch_args
+        unsupported = None
+        if self.decode_fn() is not model_base.decode_forward:
+            unsupported = "custom decode paths"
+        elif a.attn_sinks or a.logits_soft_cap is not None:
+            unsupported = "attention sinks / logits_soft_cap"
+        elif a.layer_pattern is not None:
+            unsupported = "per-layer attention patterns"
+        elif self.tpu_config.paged_attention_enabled:
+            unsupported = "paged attention"
+        elif self.tpu_config.seq_len % cp != 0:
+            unsupported = f"seq_len not divisible by cp ({cp})"
+        if unsupported is not None:
+            raise ValueError(f"flash_decoding_enabled does not support "
+                             f"{unsupported}")
         return True
 
     def _use_decode_kernel(self) -> bool:

@@ -179,3 +179,33 @@ def test_gqa_pad_interleave_non_dividing():
     np.testing.assert_array_equal(got.tokens, want.tokens)
     for lw, lg in zip(want.logits, got.logits):
         np.testing.assert_allclose(lw, lg, atol=1e-4, rtol=1e-4)
+
+
+def test_flash_decoding_cp2_matches_tp1(hf_state):
+    """flash_decoding_enabled: decode-time KV caches shard their sequence dim over
+    cp (≈ reference `modules/flashdecode/`) — ring-attention prefill + KV-seq-
+    sharded log-sum-exp decode must match the tp=1 tokens/logits exactly."""
+    tpu_cfg = TpuConfig(batch_size=2, seq_len=64, max_context_length=32,
+                        dtype="float32", tp_degree=2, cp_degree=2,
+                        flash_decoding_enabled=True,
+                        context_encoding_buckets=[32],
+                        token_generation_buckets=[64])
+    config = LlamaInferenceConfig(tpu_cfg, load_config=load_pretrained_config(HF_CFG))
+    app = LlamaForCausalLM(None, config)
+    app._put_params(app.convert_hf_state_dict(hf_state, app.config))
+    app.reset_cache()
+    # the cache really is sequence-sharded over cp
+    from jax.sharding import PartitionSpec
+    spec = app.kv_cache["k"].sharding.spec
+    assert "cp" in str(spec), spec
+
+    ref = _make_app(1)
+    ref._put_params(ref.convert_hf_state_dict(hf_state, ref.config))
+
+    rng = np.random.default_rng(9)
+    input_ids = rng.integers(1, 256, size=(2, 18)).astype(np.int64)
+    want = ref.generate(input_ids, max_new_tokens=10, return_logits=True)
+    got = app.generate(input_ids, max_new_tokens=10, return_logits=True)
+    np.testing.assert_array_equal(got.tokens, want.tokens)
+    for lw, lg in zip(want.logits, got.logits):
+        np.testing.assert_allclose(lw, lg, atol=1e-4, rtol=1e-4)
