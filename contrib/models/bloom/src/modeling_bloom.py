@@ -1,0 +1,106 @@
+"""BLOOM on the TPU framework (contrib port).
+
+Exercises: ALiBi attention bias (no positional embeddings), embedding LayerNorm,
+per-head-interleaved fused query_key_value split, biased LayerNorm + plain gelu MLP,
+tied output head.
+"""
+
+from typing import Dict
+
+import numpy as np
+
+from neuronx_distributed_inference_tpu.config import InferenceConfig
+from neuronx_distributed_inference_tpu.models.base import ModelArchArgs, alibi_slopes
+from neuronx_distributed_inference_tpu.runtime.application import (
+    TpuModelForCausalLM)
+
+
+class BloomInferenceConfig(InferenceConfig):
+    REQUIRED_ATTRIBUTES = ("hidden_size", "n_layer", "n_head", "vocab_size")
+
+    def add_derived_config(self) -> None:
+        for attr, default in (("layer_norm_epsilon", 1e-5),):
+            if not hasattr(self, attr) or getattr(self, attr) is None:
+                setattr(self, attr, default)
+
+
+class BloomForCausalLM(TpuModelForCausalLM):
+    @classmethod
+    def get_config_cls(cls):
+        return BloomInferenceConfig
+
+    @classmethod
+    def arch_args_from_config(cls, config) -> ModelArchArgs:
+        h = config.hidden_size
+        return ModelArchArgs(
+            vocab_size=config.vocab_size,
+            hidden_size=h,
+            num_layers=config.n_layer,
+            num_heads=config.n_head,
+            num_kv_heads=config.n_head,
+            head_dim=h // config.n_head,
+            intermediate_size=4 * h,
+            rms_norm_eps=config.layer_norm_epsilon,
+            activation="gelu_pytorch_tanh",       # bloom uses the tanh-approx gelu
+            norm_type="layer", norm_bias=True,
+            mlp_kind="plain", mlp_bias=True,
+            attention_bias=True, o_bias=True,
+            alibi=True, embed_norm=True,
+            tie_word_embeddings=True,
+        )
+
+    @classmethod
+    def inv_freq_from_config(cls, config) -> np.ndarray:
+        d = config.hidden_size // config.n_head
+        return np.zeros((d // 2,), np.float32)    # ALiBi: no rope
+
+    @classmethod
+    def convert_hf_state_dict(cls, state_dict: Dict[str, np.ndarray],
+                              config) -> Dict:
+        h = config.hidden_size
+        nh = config.n_head
+        d = h // nh
+
+        def get(name):
+            if name not in state_dict:
+                raise KeyError(f"missing weight {name}")
+            return np.asarray(state_dict[name])
+
+        layers = {k: [] for k in ("ln1", "ln1_b", "wq", "wk", "wv", "bq", "bk",
+                                  "bv", "wo", "bo", "ln2", "ln2_b", "wg", "bg",
+                                  "wd", "bd")}
+        for i in range(config.n_layer):
+            p = f"transformer.h.{i}."
+            # fused QKV, per-head interleave: rows [h0_q, h0_k, h0_v, h1_q, ...]
+            qkv = get(p + "self_attention.query_key_value.weight").reshape(
+                nh, 3, d, h)
+            qkv_b = get(p + "self_attention.query_key_value.bias").reshape(nh, 3, d)
+            layers["wq"].append(np.ascontiguousarray(qkv[:, 0].reshape(-1, h).T))
+            layers["wk"].append(np.ascontiguousarray(qkv[:, 1].reshape(-1, h).T))
+            layers["wv"].append(np.ascontiguousarray(qkv[:, 2].reshape(-1, h).T))
+            layers["bq"].append(qkv_b[:, 0].reshape(-1))
+            layers["bk"].append(qkv_b[:, 1].reshape(-1))
+            layers["bv"].append(qkv_b[:, 2].reshape(-1))
+            layers["wo"].append(
+                np.ascontiguousarray(get(p + "self_attention.dense.weight").T))
+            layers["bo"].append(get(p + "self_attention.dense.bias"))
+            layers["ln1"].append(get(p + "input_layernorm.weight"))
+            layers["ln1_b"].append(get(p + "input_layernorm.bias"))
+            layers["ln2"].append(get(p + "post_attention_layernorm.weight"))
+            layers["ln2_b"].append(get(p + "post_attention_layernorm.bias"))
+            layers["wg"].append(
+                np.ascontiguousarray(get(p + "mlp.dense_h_to_4h.weight").T))
+            layers["bg"].append(get(p + "mlp.dense_h_to_4h.bias"))
+            layers["wd"].append(
+                np.ascontiguousarray(get(p + "mlp.dense_4h_to_h.weight").T))
+            layers["bd"].append(get(p + "mlp.dense_4h_to_h.bias"))
+        return {
+            "embed": get("transformer.word_embeddings.weight"),
+            "embed_ln": get("transformer.word_embeddings_layernorm.weight"),
+            "embed_ln_b": get("transformer.word_embeddings_layernorm.bias"),
+            "layers": {k: np.stack(v) for k, v in layers.items()},
+            "final_norm": get("transformer.ln_f.weight"),
+            "final_norm_b": get("transformer.ln_f.bias"),
+            "alibi_slopes": alibi_slopes(nh),
+            "rope_inv_freq": cls.inv_freq_from_config(config),
+        }

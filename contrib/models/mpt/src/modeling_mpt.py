@@ -1,0 +1,86 @@
+"""MPT on the TPU framework (contrib port).
+
+Exercises: ALiBi bias, bias-free LayerNorm + plain gelu MLP, fused Wqkv thirds,
+tied output head.
+"""
+
+from typing import Dict
+
+import numpy as np
+
+from neuronx_distributed_inference_tpu.config import InferenceConfig
+from neuronx_distributed_inference_tpu.models.base import ModelArchArgs, alibi_slopes
+from neuronx_distributed_inference_tpu.runtime.application import (
+    TpuModelForCausalLM)
+
+
+class MptInferenceConfig(InferenceConfig):
+    REQUIRED_ATTRIBUTES = ("d_model", "n_layers", "n_heads", "vocab_size")
+
+    def add_derived_config(self) -> None:
+        for attr, default in (("expansion_ratio", 4), ("layer_norm_epsilon", 1e-5)):
+            if not hasattr(self, attr) or getattr(self, attr) is None:
+                setattr(self, attr, default)
+
+
+class MptForCausalLM(TpuModelForCausalLM):
+    @classmethod
+    def get_config_cls(cls):
+        return MptInferenceConfig
+
+    @classmethod
+    def arch_args_from_config(cls, config) -> ModelArchArgs:
+        h = config.d_model
+        return ModelArchArgs(
+            vocab_size=config.vocab_size,
+            hidden_size=h,
+            num_layers=config.n_layers,
+            num_heads=config.n_heads,
+            num_kv_heads=config.n_heads,
+            head_dim=h // config.n_heads,
+            intermediate_size=int(config.expansion_ratio) * h,
+            rms_norm_eps=config.layer_norm_epsilon,
+            activation="gelu",
+            norm_type="layer", norm_bias=False,   # MPT LayerNorms carry no bias
+            mlp_kind="plain", mlp_bias=False,
+            alibi=True,
+            tie_word_embeddings=True,
+        )
+
+    @classmethod
+    def inv_freq_from_config(cls, config) -> np.ndarray:
+        d = config.d_model // config.n_heads
+        return np.zeros((d // 2,), np.float32)
+
+    @classmethod
+    def convert_hf_state_dict(cls, state_dict: Dict[str, np.ndarray],
+                              config) -> Dict:
+        h = config.d_model
+
+        def get(name):
+            if name not in state_dict:
+                raise KeyError(f"missing weight {name}")
+            return np.asarray(state_dict[name])
+
+        layers = {k: [] for k in ("ln1", "wq", "wk", "wv", "wo", "ln2",
+                                  "wg", "wd")}
+        for i in range(config.n_layers):
+            p = f"transformer.blocks.{i}."
+            wqkv = get(p + "attn.Wqkv.weight")      # (3H, H), contiguous thirds
+            layers["wq"].append(np.ascontiguousarray(wqkv[:h].T))
+            layers["wk"].append(np.ascontiguousarray(wqkv[h : 2 * h].T))
+            layers["wv"].append(np.ascontiguousarray(wqkv[2 * h :].T))
+            layers["wo"].append(
+                np.ascontiguousarray(get(p + "attn.out_proj.weight").T))
+            layers["ln1"].append(get(p + "norm_1.weight"))
+            layers["ln2"].append(get(p + "norm_2.weight"))
+            layers["wg"].append(np.ascontiguousarray(get(p + "ffn.up_proj.weight").T))
+            layers["wd"].append(
+                np.ascontiguousarray(get(p + "ffn.down_proj.weight").T))
+        return {
+            "embed": get("transformer.wte.weight"),
+            "layers": {k: np.stack(v) for k, v in layers.items()},
+            "final_norm": get("transformer.norm_f.weight"),
+            "alibi_slopes": alibi_slopes(config.n_heads),
+            "rope_inv_freq": cls.inv_freq_from_config(config),
+        }

@@ -1,0 +1,108 @@
+"""StableLM-2 on the TPU framework (contrib port).
+
+Exercises: partial rotary + biased LayerNorm + GQA + optional qkv biases over the
+gated-MLP core.
+"""
+
+from typing import Dict
+
+import numpy as np
+
+from neuronx_distributed_inference_tpu.config import InferenceConfig
+from neuronx_distributed_inference_tpu.models.base import ModelArchArgs
+from neuronx_distributed_inference_tpu.ops import rope as rope_ops
+from neuronx_distributed_inference_tpu.runtime.application import (
+    TpuModelForCausalLM)
+
+
+class StableLmInferenceConfig(InferenceConfig):
+    REQUIRED_ATTRIBUTES = ("hidden_size", "num_hidden_layers",
+                           "num_attention_heads", "num_key_value_heads",
+                           "vocab_size", "intermediate_size")
+
+    def add_derived_config(self) -> None:
+        for attr, default in (("rope_theta", 10000.0),
+                              ("partial_rotary_factor", 0.25),
+                              ("layer_norm_eps", 1e-5), ("hidden_act", "silu"),
+                              ("use_qkv_bias", False),
+                              ("use_parallel_residual", False)):
+            if not hasattr(self, attr) or getattr(self, attr) is None:
+                setattr(self, attr, default)
+        if self.use_parallel_residual:
+            raise NotImplementedError("parallel-residual stablelm variants are "
+                                      "not covered by this port")
+
+
+class StableLmForCausalLM(TpuModelForCausalLM):
+    @classmethod
+    def get_config_cls(cls):
+        return StableLmInferenceConfig
+
+    @classmethod
+    def arch_args_from_config(cls, config) -> ModelArchArgs:
+        h = config.hidden_size
+        d = h // config.num_attention_heads
+        return ModelArchArgs(
+            vocab_size=config.vocab_size,
+            hidden_size=h,
+            num_layers=config.num_hidden_layers,
+            num_heads=config.num_attention_heads,
+            num_kv_heads=config.num_key_value_heads,
+            head_dim=d,
+            intermediate_size=config.intermediate_size,
+            rms_norm_eps=config.layer_norm_eps,
+            activation=config.hidden_act,
+            norm_type="layer", norm_bias=True,
+            attention_bias=bool(config.use_qkv_bias),
+            rotary_dim=int(d * config.partial_rotary_factor),
+        )
+
+    @classmethod
+    def inv_freq_from_config(cls, config) -> np.ndarray:
+        d = config.hidden_size // config.num_attention_heads
+        return rope_ops.default_inv_freq(int(d * config.partial_rotary_factor),
+                                         float(config.rope_theta))
+
+    @classmethod
+    def convert_hf_state_dict(cls, state_dict: Dict[str, np.ndarray],
+                              config) -> Dict:
+        args = cls.arch_args_from_config(config)
+
+        def get(name):
+            if name not in state_dict:
+                raise KeyError(f"missing weight {name}")
+            return np.asarray(state_dict[name])
+
+        def lin_t(name):
+            return np.ascontiguousarray(get(name).T)
+
+        keys = ["ln1", "ln1_b", "wq", "wk", "wv", "wo", "ln2", "ln2_b",
+                "wg", "wu", "wd"]
+        if args.attention_bias:
+            keys += ["bq", "bk", "bv"]
+        layers = {k: [] for k in keys}
+        for i in range(config.num_hidden_layers):
+            p = f"model.layers.{i}."
+            layers["wq"].append(lin_t(p + "self_attn.q_proj.weight"))
+            layers["wk"].append(lin_t(p + "self_attn.k_proj.weight"))
+            layers["wv"].append(lin_t(p + "self_attn.v_proj.weight"))
+            layers["wo"].append(lin_t(p + "self_attn.o_proj.weight"))
+            if args.attention_bias:
+                layers["bq"].append(get(p + "self_attn.q_proj.bias"))
+                layers["bk"].append(get(p + "self_attn.k_proj.bias"))
+                layers["bv"].append(get(p + "self_attn.v_proj.bias"))
+            layers["ln1"].append(get(p + "input_layernorm.weight"))
+            layers["ln1_b"].append(get(p + "input_layernorm.bias"))
+            layers["ln2"].append(get(p + "post_attention_layernorm.weight"))
+            layers["ln2_b"].append(get(p + "post_attention_layernorm.bias"))
+            layers["wg"].append(lin_t(p + "mlp.gate_proj.weight"))
+            layers["wu"].append(lin_t(p + "mlp.up_proj.weight"))
+            layers["wd"].append(lin_t(p + "mlp.down_proj.weight"))
+        return {
+            "embed": get("model.embed_tokens.weight"),
+            "layers": {k: np.stack(v) for k, v in layers.items()},
+            "final_norm": get("model.norm.weight"),
+            "final_norm_b": get("model.norm.bias"),
+            "lm_head": lin_t("lm_head.weight"),
+            "rope_inv_freq": cls.inv_freq_from_config(config),
+        }

@@ -15,6 +15,10 @@ CONTRIB_MODELS = {
     "starcoder2":
         "contrib.models.starcoder2.src.modeling_starcoder2:Starcoder2ForCausalLM",
     "falcon": "contrib.models.falcon.src.modeling_falcon:FalconForCausalLM",
+    "bloom": "contrib.models.bloom.src.modeling_bloom:BloomForCausalLM",
+    "mpt": "contrib.models.mpt.src.modeling_mpt:MptForCausalLM",
+    "stablelm": "contrib.models.stablelm.src.modeling_stablelm:StableLmForCausalLM",
+    "gemma": "contrib.models.gemma.src.modeling_gemma:GemmaForCausalLM",
 }
 
 for model_type, path in CONTRIB_MODELS.items():

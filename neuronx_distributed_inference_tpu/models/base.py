@@ -88,6 +88,9 @@ class ModelArchArgs:
     parallel_residual: bool = False  # h = x + attn(ln1(x)) + mlp(ln2(x) or ln1(x))
     shared_ln: bool = False          # parallel residual reusing ONE norm (falcon-7b)
     rotary_dim: Optional[int] = None  # partial rotary (phi/gpt-neox rotary_pct)
+    alibi: bool = False              # ALiBi additive attention bias (bloom/mpt);
+    #                                  rope disabled via a zero inv_freq table
+    embed_norm: bool = False         # LayerNorm on embeddings (bloom)
     # MoE FFN (Mixtral/Qwen3-MoE/DBRX); None = dense MLP. See ops/moe.py.
     moe: Optional["MoEArgs"] = None
     # static multi-LoRA serving (see modules/lora.py); None = disabled
@@ -180,6 +183,10 @@ def param_logical_axes(args: ModelArchArgs) -> Params:
         out["final_norm_b"] = (None,)
     if args.learned_pos:
         out["pos_embed"] = (None, "embed")
+    if args.alibi:
+        out["alibi_slopes"] = ("heads",)
+    if args.embed_norm:
+        out.update({"embed_ln": (None,), "embed_ln_b": (None,)})
     if args.local_rope_theta is not None:
         out["rope_inv_freq_local"] = (None,)
     if not args.tie_word_embeddings:
@@ -293,6 +300,12 @@ def init_params(args: ModelArchArgs, key: jax.Array, dtype=jnp.bfloat16,
         params["final_norm_b"] = jnp.zeros((H,), dtype=dtype)
     if args.learned_pos:
         params["pos_embed"] = w(ks[9], (4096 + args.pos_offset, H))
+    if args.alibi:
+        params["alibi_slopes"] = jnp.asarray(
+            alibi_slopes(args.num_heads), dtype=jnp.float32)
+    if args.embed_norm:
+        params["embed_ln"] = jnp.ones((H,), dtype=dtype)
+        params["embed_ln_b"] = jnp.zeros((H,), dtype=dtype)
     if args.local_rope_theta is not None:
         params["rope_inv_freq_local"] = jnp.asarray(
             rope_ops.default_inv_freq(args.head_dim, args.local_rope_theta),
@@ -332,6 +345,29 @@ def _apply_rope(args: ModelArchArgs, q, k, cos, sin):
     q1, k1 = rope_ops.apply_rotary(q[..., :rd], k[..., :rd], cos, sin)
     return (jnp.concatenate([q1, q[..., rd:]], axis=-1),
             jnp.concatenate([k1, k[..., rd:]], axis=-1))
+
+
+def alibi_slopes(num_heads: int) -> np.ndarray:
+    """Standard ALiBi head slopes (power-of-two geometric ladder; the non-power-of-2
+    extension interleaves the next ladder, per the ALiBi paper / HF bloom)."""
+    def pow2_slopes(n):
+        start = 2.0 ** (-(2.0 ** -(np.log2(n) - 3)))
+        return start * (start ** np.arange(n))
+
+    n = 2 ** int(np.floor(np.log2(num_heads)))
+    slopes = pow2_slopes(n)
+    if n < num_heads:
+        extra = pow2_slopes(2 * n)[0::2][: num_heads - n]
+        slopes = np.concatenate([slopes, extra])
+    return slopes.astype(np.float32)
+
+
+def _alibi_bias(slopes: jnp.ndarray, q_pos: jnp.ndarray, kv_pos: jnp.ndarray
+                ) -> jnp.ndarray:
+    """(B?, 1, S_q, S_kv) position grids -> additive (B?, H, S_q, S_kv) bias:
+    slope_h * -(q_pos - kv_pos) (masked positions die via the boolean mask)."""
+    dist = (q_pos - kv_pos).astype(jnp.float32)          # (..., 1, S_q, S_kv)
+    return -slopes[None, :, None, None] * dist
 
 
 def _project_qkv(lp: Params, args: ModelArchArgs, hn: jnp.ndarray,
@@ -583,6 +619,7 @@ def _decoder_layer(
     # cache stack is W wide; see kvcache.write_prefill_rolling)
     rolling_lengths: Optional[jnp.ndarray] = None,
     flash_decoding: bool = False,   # KV-seq-sharded decode over the cp axis
+    attn_bias: Optional[jnp.ndarray] = None,   # additive attention bias (ALiBi)
 ):
     resid = h
     hn = _norm(h, lp["ln1"], args, lp.get("ln1_b"))
@@ -742,7 +779,8 @@ def _decoder_layer(
     else:
         attn = attend(q, k_att, v_att, mask=mask, scale=args.attention_scale,
                       logits_soft_cap=args.logits_soft_cap,
-                      sinks=lp.get("sinks") if args.attn_sinks else None)
+                      sinks=lp.get("sinks") if args.attn_sinks else None,
+                      bias=attn_bias)
     attn = attn.transpose(0, 2, 1, 3).reshape(h.shape[0], h.shape[1], args.q_size)
     attn_out = qapply(attn, lp["wo"])
     if args.lora is not None:
@@ -781,7 +819,8 @@ def _run_stack(params: Params, args: ModelArchArgs, h, cos, sin, mask, cache,
                paged=None, cache_batch_start=0,
                adapter_ids=None, ring_positions=None, window_row=None,
                capture_layers: Optional[Tuple[int, ...]] = None,
-               deepstack: Optional[jnp.ndarray] = None, flash_decoding=False):
+               deepstack: Optional[jnp.ndarray] = None, flash_decoding=False,
+               attn_bias=None):
     """Scan the decoder layers, carrying hidden state, yielding updated cache.
 
     ``capture_layers`` (static layer indices) also collects those layers' OUTPUT
@@ -803,7 +842,8 @@ def _run_stack(params: Params, args: ModelArchArgs, h, cos, sin, mask, cache,
                                        adapter_ids=adapter_ids,
                                        ring_positions=ring_positions,
                                        window_row=window_row,
-                                       flash_decoding=flash_decoding)
+                                       flash_decoding=flash_decoding,
+                                       attn_bias=attn_bias)
         if capture_layers:
             caps = tuple(jnp.where(li == idx, new_h, buf)
                          for idx, buf in zip(capture_layers, caps))
@@ -998,6 +1038,9 @@ def prefill_forward(
     if args.learned_pos:
         h = h + jnp.take(params["pos_embed"], position_ids + args.pos_offset,
                          axis=0).astype(h.dtype)
+    if args.embed_norm:
+        h = layer_norm(h, params["embed_ln"], params["embed_ln_b"],
+                       eps=args.rms_norm_eps)
     if merge_embeds is not None:
         mm_mask, mm_override = merge_embeds
         h = jnp.where(mm_mask, mm_override.astype(h.dtype), h)
@@ -1035,6 +1078,8 @@ def prefill_forward(
         return logits, cache
     if sliding is not None:
         mask = sliding
+    attn_bias = (_alibi_bias(params["alibi_slopes"], q_pos, kv_pos)
+                 if args.alibi else None)
 
     paged = None
     if slot_mapping is not None:
@@ -1047,7 +1092,8 @@ def prefill_forward(
                      paged=paged, cache_batch_start=cache_batch_start,
                      adapter_ids=adapter_ids,
                      ring_positions=position_ids if use_ring else None,
-                     capture_layers=capture_layers, deepstack=deepstack)
+                     capture_layers=capture_layers, deepstack=deepstack,
+                     attn_bias=attn_bias)
     h, cache = out[0], out[1]
     h = tap("final_hidden", _norm(h, params["final_norm"], args, params.get("final_norm_b")))
     h_last = jnp.take_along_axis(h, last_token_idx[:, None, None], axis=1)[:, 0]
@@ -1115,6 +1161,9 @@ def decode_forward(
     if args.learned_pos:
         h = h + jnp.take(params["pos_embed"], pos_grid + args.pos_offset,
                          axis=0).astype(h.dtype)
+    if args.embed_norm:
+        h = layer_norm(h, params["embed_ln"], params["embed_ln_b"],
+                       eps=args.rms_norm_eps)
     rope_pos = pos_grid
     if "rope_delta" in cache:
         # M-RoPE decode: all three position dims advance together past the prompt,
@@ -1189,12 +1238,14 @@ def decode_forward(
 
     if flash_decoding and (t > 1 or tree is not None or paged is not None):
         raise ValueError("flash decoding supports single-token chain decode only")
+    attn_bias = (_alibi_bias(params["alibi_slopes"], q_pos, kv_pos)
+                 if args.alibi else None)
     out = _run_stack(params, args, h, cos, sin, mask, cache,
                      positions=position_ids, decode_bucket=decode_bucket,
                      mesh=mesh, rules=rules,
                      paged=paged, adapter_ids=adapter_ids,
                      window_row=window_row, capture_layers=capture_layers,
-                     flash_decoding=flash_decoding)
+                     flash_decoding=flash_decoding, attn_bias=attn_bias)
     h, cache = out[0], out[1]
     h = _norm(h, params["final_norm"], args, params.get("final_norm_b"))
     logits = _lm_head(params, args, h, mesh, rules)

@@ -51,6 +51,7 @@ def attend(
     scale: Optional[float] = None,
     logits_soft_cap: Optional[float] = None,
     sinks: Optional[jnp.ndarray] = None,  # (n_q,) learned attention sinks (gpt-oss style)
+    bias: Optional[jnp.ndarray] = None,   # additive (B|1, n_q, S_q, S_kv) (ALiBi)
 ) -> jnp.ndarray:
     """Masked GQA attention, softmax in fp32. Returns (B, n_q, S_q, D) in q.dtype.
 
@@ -70,6 +71,9 @@ def attend(
     qg = q.reshape(b, n_kv, rep, s_q, d)
     scores = jnp.einsum("bkrqd,bktd->bkrqt", qg, k,
                         preferred_element_type=jnp.float32) * scale
+    if bias is not None:
+        scores = scores + bias.reshape(
+            bias.shape[0], n_kv, rep, *bias.shape[2:]).astype(jnp.float32)
     if logits_soft_cap is not None:
         scores = logits_soft_cap * jnp.tanh(scores / logits_soft_cap)
     if mask is not None:

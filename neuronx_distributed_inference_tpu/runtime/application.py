@@ -317,8 +317,8 @@ class TpuModelForCausalLM:
         unsupported = None
         if self.decode_fn() is not model_base.decode_forward:
             unsupported = "custom decode paths"
-        elif a.attn_sinks or a.logits_soft_cap is not None:
-            unsupported = "attention sinks / logits_soft_cap"
+        elif a.attn_sinks or a.logits_soft_cap is not None or a.alibi:
+            unsupported = "attention sinks / logits_soft_cap / ALiBi"
         elif a.layer_pattern is not None:
             unsupported = "per-layer attention patterns"
         elif self.tpu_config.paged_attention_enabled:
@@ -346,6 +346,8 @@ class TpuModelForCausalLM:
             unsupported = "attention sinks"
         elif a.layer_pattern is not None:
             unsupported = "per-layer attention patterns"
+        elif a.alibi:
+            unsupported = "ALiBi attention bias"
         elif self.tpu_config.paged_attention_enabled:
             unsupported = "paged attention"
         elif a.head_dim % 128 != 0 and jax.default_backend() != "cpu":
@@ -376,6 +378,8 @@ class TpuModelForCausalLM:
             unsupported = "logits_soft_cap"
         elif a.attn_sinks:
             unsupported = "attention sinks"
+        elif a.alibi:
+            unsupported = "ALiBi attention bias"
         if cfg is not None:
             if cfg and unsupported is not None:
                 raise ValueError(

@@ -17,7 +17,8 @@ def _tpu_cfg():
                      token_generation_buckets=[32, 64])
 
 
-def _run_parity(app_cls, hf_model, hf_cfg, atol=5e-4, rtol=1e-3, vocab=256):
+def _run_parity(app_cls, hf_model, hf_cfg, atol=5e-4, rtol=1e-3, vocab=256,
+                eos_token_id=None):
     config = app_cls.get_config_cls()(
         _tpu_cfg(), load_config=load_pretrained_config(hf_cfg.to_dict()))
     app = app_cls(None, config)
@@ -35,7 +36,7 @@ def _run_parity(app_cls, hf_model, hf_cfg, atol=5e-4, rtol=1e-3, vocab=256):
     with torch.no_grad():
         hf_out = hf_model.generate(torch.tensor(input_ids), max_new_tokens=10,
                                    do_sample=False, pad_token_id=0)
-    out = app.generate(input_ids, max_new_tokens=10)
+    out = app.generate(input_ids, max_new_tokens=10, eos_token_id=eos_token_id)
     np.testing.assert_array_equal(out.tokens, hf_out[:, 12:].numpy())
 
 
@@ -43,7 +44,8 @@ def test_registry_resolves_contrib_models():
     import contrib.registry  # noqa: F401  (side effect: registration)
     from neuronx_distributed_inference_tpu.models import get_model_cls
 
-    for mt in ("gpt2", "opt", "gpt_neox", "phi", "phi3", "starcoder2", "falcon"):
+    for mt in ("gpt2", "opt", "gpt_neox", "phi", "phi3", "starcoder2", "falcon",
+               "bloom", "mpt", "stablelm", "gemma"):
         assert get_model_cls(mt) is not None
 
 
@@ -154,3 +156,59 @@ def test_falcon_parity():
     torch.manual_seed(0)
     hf = HFFalcon(cfg).eval()
     _run_parity(FalconForCausalLM, hf, cfg)
+
+
+def test_bloom_parity():
+    from transformers import BloomConfig, BloomForCausalLM as HFBloom
+
+    from contrib.models.bloom.src.modeling_bloom import BloomForCausalLM
+
+    cfg = BloomConfig(vocab_size=256, hidden_size=64, n_layer=2, n_head=4,
+                      hidden_dropout=0.0, attention_dropout=0.0)
+    torch.manual_seed(0)
+    hf = HFBloom(cfg).eval()
+    _run_parity(BloomForCausalLM, hf, cfg)
+
+
+def test_mpt_parity():
+    from transformers import MptConfig, MptForCausalLM as HFMpt
+
+    from contrib.models.mpt.src.modeling_mpt import MptForCausalLM
+
+    cfg = MptConfig(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+                    expansion_ratio=2, max_seq_len=128)
+    torch.manual_seed(0)
+    hf = HFMpt(cfg).eval()
+    _run_parity(MptForCausalLM, hf, cfg)
+
+
+def test_stablelm_parity():
+    from transformers import StableLmConfig, StableLmForCausalLM as HFStableLm
+
+    from contrib.models.stablelm.src.modeling_stablelm import StableLmForCausalLM
+
+    cfg = StableLmConfig(vocab_size=256, hidden_size=64, num_hidden_layers=2,
+                         num_attention_heads=4, num_key_value_heads=2,
+                         intermediate_size=128, partial_rotary_factor=0.25,
+                         use_qkv_bias=True, max_position_embeddings=128,
+                         attention_dropout=0.0)
+    torch.manual_seed(0)
+    hf = HFStableLm(cfg).eval()
+    _run_parity(StableLmForCausalLM, hf, cfg)
+
+
+def test_gemma_parity():
+    from transformers import GemmaConfig, GemmaForCausalLM as HFGemma
+
+    from contrib.models.gemma.src.modeling_gemma import GemmaForCausalLM
+
+    cfg = GemmaConfig(vocab_size=256, hidden_size=64, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=2,
+                      intermediate_size=128, head_dim=16,
+                      hidden_activation="gelu_pytorch_tanh",
+                      max_position_embeddings=128)
+    torch.manual_seed(0)
+    hf = HFGemma(cfg).eval()
+    # gemma's default eos (token 1) can be emitted by the random model; thread it
+    # so both sides stop identically
+    _run_parity(GemmaForCausalLM, hf, cfg, eos_token_id=1)
