@@ -123,6 +123,85 @@ class HuggingFaceGenerationAdapter:
             sequences = torch.tensor(sequences, dtype=torch.long)
         return sequences
 
+    def generate_with_processors(self, input_ids, logits_processor,
+                                 attention_mask=None, max_new_tokens: int = 32,
+                                 do_sample: bool = False,
+                                 eos_token_id: Optional[int] = None,
+                                 pad_token_id: int = 0, seed: int = 0):
+        """HF logits-processor path (≈ reference `_sample`'s processor handling,
+        `utils/hf_adapter.py:139-257`): a host-driven token-by-token loop — each
+        step's logits come to the host, ``logits_processor`` (an HF
+        LogitsProcessorList or any callable(ids, scores) -> scores, torch tensors)
+        rewrites them, and the host-chosen token feeds the next device step.
+
+        This is the SLOW path (one device dispatch per token); the on-device
+        sampling loop bypasses processors by design, exactly like the reference's
+        on-device-sampling mode."""
+        import torch
+
+        from ..modules import autobucketing
+
+        app = self.app
+        is_torch = _is_torch(input_ids)
+        ids = _to_numpy(input_ids)
+        b = ids.shape[0]
+        # prefill via the normal path (1 token, with logits); the sampled token is
+        # discarded — we re-choose from the processed logits (its KV is never
+        # written, so substituting is safe)
+        out = app.generate(ids, attention_mask=_to_numpy(attention_mask)
+                           if attention_mask is not None else None,
+                           max_new_tokens=1, return_logits=True, seed=seed)
+        positions = np.asarray(
+            (_to_numpy(attention_mask).sum(axis=1)
+             if attention_mask is not None
+             else np.full((b,), ids.shape[1])), dtype=np.int32)
+
+        def choose(hist, scores):
+            t_scores = torch.tensor(scores, dtype=torch.float32)
+            t_scores = logits_processor(torch.tensor(hist, dtype=torch.long),
+                                        t_scores)
+            if do_sample:
+                probs = torch.softmax(t_scores, dim=-1)
+                return torch.multinomial(probs, 1)[:, 0].numpy().astype(np.int32)
+            return t_scores.argmax(dim=-1).numpy().astype(np.int32)
+
+        from ..ops.sampling import prepare_sampling_params
+        import jax
+
+        sp = prepare_sampling_params(app.tpu_config.max_batch_size)
+        key = jax.random.PRNGKey(seed)
+        hist = ids.copy()
+        tok = choose(hist, out.logits[0])
+        hist = np.concatenate([hist, tok[:, None]], axis=1)
+        done = np.zeros((b,), dtype=bool)
+        if eos_token_id is not None:
+            done |= tok == eos_token_id
+
+        compiled_b = app.tpu_config.max_batch_size
+        for _ in range(max_new_tokens - 1):
+            if done.all():
+                break
+            max_pos = int(positions.max())
+            bucket = autobucketing.select_bucket(app.tkg_buckets, max_pos + 1)
+            tok_full = np.zeros((compiled_b,), dtype=np.int32)
+            tok_full[:b] = tok
+            pos_full = np.zeros((compiled_b,), dtype=np.int32)
+            pos_full[:b] = positions
+            key, sub = jax.random.split(key)
+            _, step_logits, app.kv_cache = app._decode_step(
+                app.params, tok_full, pos_full, app.kv_cache, sp, sub,
+                decode_bucket=bucket, num_steps=1, with_logits=True, greedy=True)
+            scores = np.asarray(step_logits[0])[:b]
+            tok = choose(hist, scores)
+            tok = np.where(done, pad_token_id, tok).astype(np.int32)
+            hist = np.concatenate([hist, tok[:, None]], axis=1)
+            positions = positions + 1
+            if eos_token_id is not None:
+                done |= tok == eos_token_id
+        if is_torch:
+            return torch.tensor(hist, dtype=torch.long)
+        return hist
+
     def generate_text(self, prompts, max_new_tokens: int = 64, **kwargs):
         """Tokenizer-in, strings-out convenience."""
         if self.tokenizer is None:

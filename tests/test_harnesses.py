@@ -201,3 +201,27 @@ def test_hf_adapter_assisted_routing(tiny_app):
     e3.load_random_draft(seed=2)
     got = adapter.generate_assisted(ids, e3, max_new_tokens=10)
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_hf_adapter_logits_processors(tiny_app, tiny_ckpt):
+    """generate_with_processors matches HF generate with the same processor
+    (repetition penalty) applied — the host-driven slow path the reference's
+    `_sample` loop implements for processor-bearing requests."""
+    from transformers import (LlamaForCausalLM as HFLlama,
+                              LogitsProcessorList,
+                              RepetitionPenaltyLogitsProcessor)
+
+    from neuronx_distributed_inference_tpu.utils.hf_adapter import (
+        HuggingFaceGenerationAdapter)
+
+    hf = HFLlama.from_pretrained(tiny_ckpt).eval()
+    adapter = HuggingFaceGenerationAdapter(tiny_app)
+    rng = np.random.default_rng(4)
+    ids = rng.integers(1, 256, size=(2, 10)).astype(np.int64)
+
+    with torch.no_grad():
+        want = hf.generate(torch.tensor(ids), max_new_tokens=8, do_sample=False,
+                           repetition_penalty=1.5, pad_token_id=0)
+    procs = LogitsProcessorList([RepetitionPenaltyLogitsProcessor(1.5)])
+    got = adapter.generate_with_processors(ids, procs, max_new_tokens=8)
+    np.testing.assert_array_equal(np.asarray(got), want.numpy())
