@@ -1,0 +1,100 @@
+"""BioGPT on the TPU framework (contrib port, ≈ reference `contrib/models/biogpt/`).
+
+OPT-shaped pre-norm decoder with sqrt(hidden) embedding scaling, learned positions
+at OPT's +2 offset, biased LayerNorm + gelu plain MLP, tied output head.
+"""
+
+from typing import Dict
+
+import numpy as np
+
+from neuronx_distributed_inference_tpu.config import InferenceConfig
+from neuronx_distributed_inference_tpu.models.base import ModelArchArgs
+from neuronx_distributed_inference_tpu.runtime.application import (
+    TpuModelForCausalLM)
+
+
+class BioGptInferenceConfig(InferenceConfig):
+    REQUIRED_ATTRIBUTES = ("hidden_size", "num_hidden_layers",
+                           "num_attention_heads", "vocab_size",
+                           "intermediate_size")
+
+    def add_derived_config(self) -> None:
+        for attr, default in (("hidden_act", "gelu"), ("scale_embedding", True),
+                              ("layer_norm_eps", 1e-12)):
+            if not hasattr(self, attr) or getattr(self, attr) is None:
+                setattr(self, attr, default)
+
+
+class BioGptForCausalLM(TpuModelForCausalLM):
+    @classmethod
+    def get_config_cls(cls):
+        return BioGptInferenceConfig
+
+    @classmethod
+    def arch_args_from_config(cls, config) -> ModelArchArgs:
+        h = config.hidden_size
+        return ModelArchArgs(
+            vocab_size=config.vocab_size,
+            hidden_size=h,
+            num_layers=config.num_hidden_layers,
+            num_heads=config.num_attention_heads,
+            num_kv_heads=config.num_attention_heads,
+            head_dim=h // config.num_attention_heads,
+            intermediate_size=config.intermediate_size,
+            rms_norm_eps=config.layer_norm_eps,
+            activation=config.hidden_act,
+            norm_type="layer", norm_bias=True,
+            mlp_kind="plain", mlp_bias=True,
+            attention_bias=True, o_bias=True,
+            learned_pos=True, pos_offset=2,
+            embedding_multiplier=(float(h) ** 0.5 if config.scale_embedding
+                                  else 1.0),
+            tie_word_embeddings=True,
+        )
+
+    @classmethod
+    def inv_freq_from_config(cls, config) -> np.ndarray:
+        d = config.hidden_size // config.num_attention_heads
+        return np.zeros((d // 2,), np.float32)
+
+    @classmethod
+    def convert_hf_state_dict(cls, state_dict: Dict[str, np.ndarray],
+                              config) -> Dict:
+        def get(name):
+            if name not in state_dict:
+                raise KeyError(f"missing weight {name}")
+            return np.asarray(state_dict[name])
+
+        def lin_t(name):
+            return np.ascontiguousarray(get(name).T)
+
+        layers = {k: [] for k in ("ln1", "ln1_b", "wq", "wk", "wv", "bq", "bk",
+                                  "bv", "wo", "bo", "ln2", "ln2_b", "wg", "bg",
+                                  "wd", "bd")}
+        for i in range(config.num_hidden_layers):
+            p = f"biogpt.layers.{i}."
+            layers["wq"].append(lin_t(p + "self_attn.q_proj.weight"))
+            layers["wk"].append(lin_t(p + "self_attn.k_proj.weight"))
+            layers["wv"].append(lin_t(p + "self_attn.v_proj.weight"))
+            layers["bq"].append(get(p + "self_attn.q_proj.bias"))
+            layers["bk"].append(get(p + "self_attn.k_proj.bias"))
+            layers["bv"].append(get(p + "self_attn.v_proj.bias"))
+            layers["wo"].append(lin_t(p + "self_attn.out_proj.weight"))
+            layers["bo"].append(get(p + "self_attn.out_proj.bias"))
+            layers["ln1"].append(get(p + "self_attn_layer_norm.weight"))
+            layers["ln1_b"].append(get(p + "self_attn_layer_norm.bias"))
+            layers["ln2"].append(get(p + "final_layer_norm.weight"))
+            layers["ln2_b"].append(get(p + "final_layer_norm.bias"))
+            layers["wg"].append(lin_t(p + "fc1.weight"))
+            layers["bg"].append(get(p + "fc1.bias"))
+            layers["wd"].append(lin_t(p + "fc2.weight"))
+            layers["bd"].append(get(p + "fc2.bias"))
+        return {
+            "embed": get("biogpt.embed_tokens.weight"),
+            "pos_embed": get("biogpt.embed_positions.weight"),
+            "layers": {k: np.stack(v) for k, v in layers.items()},
+            "final_norm": get("biogpt.layer_norm.weight"),
+            "final_norm_b": get("biogpt.layer_norm.bias"),
+            "rope_inv_freq": cls.inv_freq_from_config(config),
+        }

@@ -19,6 +19,7 @@ CONTRIB_MODELS = {
     "mpt": "contrib.models.mpt.src.modeling_mpt:MptForCausalLM",
     "stablelm": "contrib.models.stablelm.src.modeling_stablelm:StableLmForCausalLM",
     "gemma": "contrib.models.gemma.src.modeling_gemma:GemmaForCausalLM",
+    "biogpt": "contrib.models.biogpt.src.modeling_biogpt:BioGptForCausalLM",
 }
 
 for model_type, path in CONTRIB_MODELS.items():

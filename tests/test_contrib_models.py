@@ -45,7 +45,7 @@ def test_registry_resolves_contrib_models():
     from neuronx_distributed_inference_tpu.models import get_model_cls
 
     for mt in ("gpt2", "opt", "gpt_neox", "phi", "phi3", "starcoder2", "falcon",
-               "bloom", "mpt", "stablelm", "gemma"):
+               "bloom", "mpt", "stablelm", "gemma", "biogpt"):
         assert get_model_cls(mt) is not None
 
 
@@ -212,3 +212,20 @@ def test_gemma_parity():
     # gemma's default eos (token 1) can be emitted by the random model; thread it
     # so both sides stop identically
     _run_parity(GemmaForCausalLM, hf, cfg, eos_token_id=1)
+
+
+def test_biogpt_parity():
+    from transformers import BioGptConfig, BioGptForCausalLM as HFBioGpt
+
+    from contrib.models.biogpt.src.modeling_biogpt import BioGptForCausalLM
+
+    cfg = BioGptConfig(vocab_size=256, hidden_size=64, num_hidden_layers=2,
+                       num_attention_heads=4, intermediate_size=128,
+                       max_position_embeddings=128, scale_embedding=True,
+                       hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+                       activation_dropout=0.0)
+    torch.manual_seed(0)
+    hf = HFBioGpt(cfg).eval()
+    # sqrt(hidden) embedding scaling amplifies the (benign) score-scaling-order
+    # difference; greedy tokens still match exactly
+    _run_parity(BioGptForCausalLM, hf, cfg, atol=5e-3, rtol=5e-3)
