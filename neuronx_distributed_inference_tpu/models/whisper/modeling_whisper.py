@@ -184,6 +184,24 @@ class WhisperForConditionalGeneration:
         self.dec_params = None
         enc_heads = config.encoder_attention_heads
         dec_heads = config.decoder_attention_heads
+        # heads/mlp axes shard over tp (and cp for mlp): validate divisibility at
+        # construction instead of failing with an opaque NamedSharding error at
+        # device_put (e.g. whisper-large: 20 decoder heads vs tp=8)
+        tp = self.mesh.shape.get("tp", 1)
+        mlp_deg = tp * self.mesh.shape.get("cp", 1)
+        for name, n in (("encoder_attention_heads", enc_heads),
+                        ("decoder_attention_heads", dec_heads)):
+            if n % tp != 0:
+                divisors = [d for d in range(1, n + 1) if n % d == 0]
+                raise ValueError(
+                    f"Whisper {name}={n} is not divisible by tp_degree={tp}; "
+                    f"choose a tp_degree that divides the head count "
+                    f"(valid: {divisors})")
+        for name, n in (("encoder_ffn_dim", getattr(config, "encoder_ffn_dim", 0)),
+                        ("decoder_ffn_dim", getattr(config, "decoder_ffn_dim", 0))):
+            if n and n % mlp_deg != 0:
+                raise ValueError(
+                    f"Whisper {name}={n} is not divisible by tp*cp={mlp_deg}")
         self._encode = jax.jit(functools.partial(encode, heads=enc_heads))
         self._cross_kv = jax.jit(functools.partial(compute_cross_kv, heads=dec_heads))
 

@@ -92,28 +92,41 @@ def qeinsum(spec: str, x: jnp.ndarray, w) -> jnp.ndarray:
     return y * out_scale.astype(y.dtype)
 
 
+# dict keys `quantize_params` descends into. Recursion is scoped so a future
+# family nesting a same-named weight under an unrelated group (consumed with a
+# plain matmul) is never silently converted; such a family extends this via the
+# `group_keys` argument (or `quantized_param_names` for leaf names).
+DEFAULT_QUANTIZED_GROUPS = ("layers", "dense", "moe")
+
+
 def quantize_params(params: Dict[str, Any], weight_dtype: str = "int8",
-                    names: Sequence[str] = DEFAULT_QUANTIZED_PARAMS) -> Dict[str, Any]:
-    """Convert the named weights of a model param tree, recursively over every dict
-    level — covers the base layout (top level + ``layers``) as well as custom layouts
-    (DeepSeek-MLA / Llama4 ``dense``/``moe`` groups).
+                    names: Sequence[str] = DEFAULT_QUANTIZED_PARAMS,
+                    group_keys: Sequence[str] = DEFAULT_QUANTIZED_GROUPS,
+                    ) -> Dict[str, Any]:
+    """Convert the named weights of a model param tree: at the top level and inside
+    the known group containers (``group_keys``, recursively) — covers the base
+    layout (top level + ``layers``) as well as custom layouts (DeepSeek-MLA /
+    Llama4 ``dense``/``moe`` groups) without touching unrelated subtrees.
 
     Leaves that are ALREADY in the quantized {"q","s"} layout pass through untouched,
     so pre-quantized (or partially pre-quantized) checkpoints load correctly."""
     nameset = set(names)
+    groups = set(group_keys)
 
-    def walk(node):
+    def walk(node, in_group):
         if is_quantized(node):
             return node
         if isinstance(node, dict):
             return {k: (quantize_tensor(v, weight_dtype)
-                        if k in nameset and not is_quantized(v)
+                        if in_group and k in nameset and not is_quantized(v)
                         and not isinstance(v, dict)
-                        else walk(v))
+                        else walk(v, k in groups)
+                        if isinstance(v, dict) else v)
                     for k, v in node.items()}
         return node
 
-    return walk(params)
+    # top level counts as a group (base layout keeps lm_head there)
+    return walk(params, True)
 
 
 # OCP MXFP4 (e2m1) code points: 4-bit index -> value. Sign bit high, then 2-bit
@@ -142,21 +155,24 @@ def dequant_mxfp4(blocks, scales):
     return (vals * exp[..., None]).reshape(blocks.shape[:-2] + (-1,))
 
 
-def quantized_logical_axes(logical: Dict[str, Any], names: Sequence[str]
+def quantized_logical_axes(logical: Dict[str, Any], names: Sequence[str],
+                           group_keys: Sequence[str] = DEFAULT_QUANTIZED_GROUPS,
                            ) -> Dict[str, Any]:
-    """Transform a logical-axes tree to match a quantized param tree (recursively,
-    mirroring quantize_params): each quantized leaf's axes apply to ``q``; the scale
-    keeps the output axis, contraction replaced by None."""
+    """Transform a logical-axes tree to match a quantized param tree (scoped to the
+    same group containers as quantize_params): each quantized leaf's axes apply to
+    ``q``; the scale keeps the output axis, contraction replaced by None."""
     nameset = set(names)
+    groups = set(group_keys)
 
     def _q_axes(axes):
         return {"q": tuple(axes), "s": tuple(list(axes[:-2]) + [None, axes[-1]])}
 
-    def walk(node):
+    def walk(node, in_group):
         if isinstance(node, dict):
-            return {k: (_q_axes(v) if k in nameset and not isinstance(v, dict)
-                        else walk(v))
+            return {k: (_q_axes(v)
+                        if in_group and k in nameset and not isinstance(v, dict)
+                        else walk(v, k in groups) if isinstance(v, dict) else v)
                     for k, v in node.items()}
         return node
 
-    return walk(logical)
+    return walk(logical, True)

@@ -151,7 +151,12 @@ class Eagle3SpeculativeModel:
             frontier_idx = jnp.zeros((b, 1), jnp.int32)          # node ids
 
             kv_pos = jnp.arange(decode_bucket)[None, None, None, :]
-            for r in range(depth):
+            # depth+1 rounds: rounds 0..depth-1 expand the tree; the final round
+            # only feeds the deepest-level frontier so its draft KV is written
+            # (those nodes were created in round depth-1 but never forwarded —
+            # without this, a fully-accepted path compacts an unwritten slot
+            # into committed context and later draft steps attend to garbage).
+            for r in range(depth + 1):
                 width = frontier_tok.shape[1]                    # 1 or beam (static)
                 slot0 = 0 if r == 0 else 1 + (r - 1) * beam
                 # visibility: committed context + ancestors among written tree slots
@@ -172,6 +177,8 @@ class Eagle3SpeculativeModel:
                         d_params, t_params, d_args, frontier_tok, frontier_cond,
                         positions, d_cache, decode_bucket, slot_offset=slot0,
                         depths=dep, extra_mask=mask, mesh=mesh, rules=rules)
+                if r == depth:
+                    break
                 h_all = jax.lax.dynamic_update_slice(
                     h_all, h_out.astype(h_all.dtype), (0, slot0, 0))
 

@@ -103,6 +103,50 @@ def test_acceptance_gain_over_eagle1(tiny_llama_hf_config):
     assert mean_e3 > 2.0, mean_e3   # deep paths actually accepted
 
 
+def test_deepest_accepted_node_draft_kv_written(tiny_llama_hf_config):
+    """Regression: nodes created in the LAST expansion round must have draft KV
+    written before compaction. If not, a fully-accepted path (n == depth) copies
+    an unwritten slot into committed context and later draft steps attend to
+    zero KV — output stays exact but acceptance silently degrades."""
+    import jax.numpy as jnp
+
+    target = _make_app(tiny_llama_hf_config)
+    params = dict(target.params)
+    lm = np.array(params["lm_head"], dtype=np.float32)
+    lm[:, 7] = np.abs(lm).max() * 3.0           # greedy collapses to token 7
+    params["lm_head"] = jnp.asarray(lm)
+    target.params = params
+
+    d_args = draft_args_from_target(target.arch_args, num_layers=1)
+    e3 = Eagle3SpeculativeModel(target, d_args, depth=2, beam=2, branch=2,
+                                capture_layers=(1, 1, 1))
+    e3.load_random_draft(seed=6)
+    dp = {k: np.asarray(v) for k, v in e3.draft_params.items() if k != "layers"}
+    layers = {k: np.asarray(v) for k, v in e3.draft_params["layers"].items()}
+    h = target.arch_args.hidden_size
+    eye = np.eye(h, dtype=np.float32)
+    dp["fc"] = np.concatenate([eye, 0 * eye, 0 * eye], axis=0)
+    layers["wo"] = np.zeros_like(layers["wo"])
+    layers["wd"] = np.zeros_like(layers["wd"])
+    dp["final_norm"] = np.asarray(target.params["final_norm"], np.float32)
+    dp["lm_head_d"] = np.asarray(params["lm_head"], np.float32)
+    dp["layers"] = layers
+    e3.load_host_draft(dp)
+
+    rng = np.random.default_rng(2)
+    input_ids = rng.integers(1, 256, size=(2, 10)).astype(np.int32)
+    max_new = 12
+    out = e3.generate(input_ids, max_new_tokens=max_new)
+    assert out.acceptance_counts[-1] > 0        # full-depth paths were accepted
+
+    # every committed draft-cache slot (prompt len 10 + conservatively the first
+    # max_new - depth - 1 committed tokens) must hold written (nonzero) KV
+    k = np.asarray(e3.draft_cache["k"])[0]      # (B, H_kv, S, D)
+    upto = 10 + max_new - e3.depth - 1
+    norms = np.linalg.norm(k[:2, :, :upto, :], axis=-1)   # (B, H_kv, upto)
+    assert (norms > 0).all(), np.argwhere(norms == 0)
+
+
 def test_eagle3_conversion():
     """EAGLE3 checkpoint layout (midlayer.* + fc + draft lm_head + d2t)."""
     from neuronx_distributed_inference_tpu.models.eagle import (

@@ -109,3 +109,40 @@ def test_quantized_moe_runs(tiny_llama_hf_config):
     assert app.params["layers"]["wg"]["q"].dtype == jnp.int8
     out = app.generate(np.array([[5, 9, 2, 7]], dtype=np.int32), max_new_tokens=4)
     assert out.tokens.shape == (1, 4)
+
+
+def test_quantize_params_scoped_to_known_groups():
+    """Recursion is scoped to known group containers (layers/dense/moe): a
+    same-named weight nested under an unrelated subtree is left dense, so a
+    future family consuming it with a plain matmul cannot silently receive a
+    {"q","s"} dict (ADVICE r2)."""
+    from neuronx_distributed_inference_tpu.ops.quantization import (
+        is_quantized, quantize_params, quantized_logical_axes)
+
+    w = np.random.default_rng(0).normal(size=(8, 8)).astype(np.float32)
+    params = {
+        "lm_head": w.copy(),                      # top level: quantized
+        "layers": {"wq": w.copy()},               # known group: quantized
+        "dense": {"wu": w.copy()},                # known group: quantized
+        "moe": {"wd": w.copy()},                  # known group: quantized
+        "vision_adapter": {"wq": w.copy()},       # unrelated subtree: untouched
+        "final_norm": np.ones(8, np.float32),
+    }
+    out = quantize_params(params, "int8")
+    assert is_quantized(out["lm_head"])
+    assert is_quantized(out["layers"]["wq"])
+    assert is_quantized(out["dense"]["wu"])
+    assert is_quantized(out["moe"]["wd"])
+    assert not is_quantized(out["vision_adapter"]["wq"])
+    assert out["vision_adapter"]["wq"].dtype == np.float32
+
+    # the logical-axes transform mirrors the same scoping
+    logical = {
+        "lm_head": ("embed", "vocab"),
+        "layers": {"wq": ("layers", "embed", "heads")},
+        "vision_adapter": {"wq": ("embed", "heads")},
+    }
+    ql = quantized_logical_axes(logical, ("wq", "lm_head"))
+    assert set(ql["lm_head"]) == {"q", "s"}
+    assert set(ql["layers"]["wq"]) == {"q", "s"}
+    assert ql["vision_adapter"]["wq"] == ("embed", "heads")

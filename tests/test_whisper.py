@@ -93,3 +93,11 @@ def test_whisper_tp2_matches_tp1(tiny_whisper):
     assert len(wq.sharding.device_set) == 2
     got = app2.generate(feats, max_new_tokens=12, eos_token_id=-1)
     np.testing.assert_array_equal(got, want)
+
+
+def test_whisper_tp_head_divisibility_validated(tiny_whisper):
+    """tp that does not divide the head count fails at construction with a clear
+    message, not an opaque NamedSharding error at device_put (ADVICE r2)."""
+    _, cfg = tiny_whisper
+    with pytest.raises(ValueError, match="not divisible by tp_degree"):
+        _build(cfg, tp=4)   # 2 heads, tp=4
