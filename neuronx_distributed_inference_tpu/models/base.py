@@ -428,6 +428,38 @@ def _mlp(lp: Params, args: ModelArchArgs, hn: jnp.ndarray, mesh, rules,
     return down
 
 
+def _shard_mapped(local_fn, mesh, rules, in_logical, out_logical):
+    """shard_map a Pallas-kernel wrapper over the mesh with logical-axis operand
+    specs.
+
+    Pallas calls have no GSPMD partitioning rule, so each kernel runs per-shard on
+    its local block (≈ the reference launching one NKI kernel per core,
+    `attention_base.py:121-125`). ``in_logical`` is a sequence of logical-axis
+    tuples (None = fully replicated); ``out_logical`` is one tuple for a single
+    output or a list of tuples for multiple. With ``mesh=None`` the local fn runs
+    unwrapped."""
+    if mesh is None:
+        return local_fn
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.sharding import DEFAULT_RULES, logical_to_spec
+
+    r = rules or DEFAULT_RULES
+
+    def spec(lg):
+        return P() if lg is None else logical_to_spec(lg, r)
+
+    out_specs = (tuple(spec(lg) for lg in out_logical)
+                 if isinstance(out_logical, list) else spec(out_logical))
+    return jax.shard_map(local_fn, mesh=mesh,
+                         in_specs=tuple(spec(lg) for lg in in_logical),
+                         out_specs=out_specs, check_vma=False)
+
+
+_DECODE_NEW_KV = ("decode_batch", "decode_kv_heads", None, None)
+_DECODE_Q = ("decode_batch", "decode_heads", None, None)
+
+
 def _sharded_kv_write(k_cache, v_cache, new_k, new_v, positions, layer_idx, mesh,
                       rules):
     """Stacked-cache decode K+V write (one Pallas DMA-scatter kernel) under the mesh.
@@ -437,25 +469,16 @@ def _sharded_kv_write(k_cache, v_cache, new_k, new_v, positions, layer_idx, mesh
     vmapped dynamic_update_slice to."""
     from ..modules.kvcache import CACHE_LOGICAL
     from ..ops.flash_decode import write_decode_stacked_kv
-    from ..parallel.sharding import DEFAULT_RULES, logical_to_spec
 
     interpret = jax.default_backend() == "cpu"
 
     def _local(ck, cv, nk, nv, p, li):
         return write_decode_stacked_kv(ck, cv, nk, nv, p, li, interpret=interpret)
 
-    if mesh is None:
-        return _local(k_cache, v_cache, new_k, new_v, positions, layer_idx)
-    from jax.sharding import PartitionSpec as P
-
-    r = rules or DEFAULT_RULES
-    cache_spec = logical_to_spec(CACHE_LOGICAL, r)
-    new_spec = logical_to_spec(("decode_batch", "decode_kv_heads", None, None), r)
-    pos_spec = logical_to_spec(("decode_batch",), r)
-    fn = jax.shard_map(_local, mesh=mesh,
-                       in_specs=(cache_spec, cache_spec, new_spec, new_spec,
-                                 pos_spec, P()),
-                       out_specs=(cache_spec, cache_spec), check_vma=False)
+    fn = _shard_mapped(_local, mesh, rules,
+                       [CACHE_LOGICAL, CACHE_LOGICAL, _DECODE_NEW_KV,
+                        _DECODE_NEW_KV, ("decode_batch",), None],
+                       [CACHE_LOGICAL, CACHE_LOGICAL])
     return fn(k_cache, v_cache, new_k, new_v, positions, layer_idx)
 
 
@@ -467,7 +490,6 @@ def _sharded_decode_attend(q, k_cache, v_cache, positions, layer_idx, bucket,
     KV tiles at or below each row's position instead of the full bucket width."""
     from ..modules.kvcache import CACHE_LOGICAL
     from ..ops.flash_decode import flash_decode_attention_stacked
-    from ..parallel.sharding import DEFAULT_RULES, logical_to_spec
 
     interpret = jax.default_backend() == "cpu"
 
@@ -476,18 +498,57 @@ def _sharded_decode_attend(q, k_cache, v_cache, positions, layer_idx, bucket,
             q, kc, vc, p, li, bucket=bucket, scale=args.attention_scale,
             window=args.sliding_window, interpret=interpret)
 
-    if mesh is None:
-        return _local(q, k_cache, v_cache, positions, layer_idx)
-    from jax.sharding import PartitionSpec as P
-
-    r = rules or DEFAULT_RULES
-    cache_spec = logical_to_spec(CACHE_LOGICAL, r)
-    q_spec = logical_to_spec(("decode_batch", "decode_heads", None, None), r)
-    pos_spec = logical_to_spec(("decode_batch",), r)
-    fn = jax.shard_map(_local, mesh=mesh,
-                       in_specs=(q_spec, cache_spec, cache_spec, pos_spec, P()),
-                       out_specs=q_spec, check_vma=False)
+    fn = _shard_mapped(_local, mesh, rules,
+                       [_DECODE_Q, CACHE_LOGICAL, CACHE_LOGICAL,
+                        ("decode_batch",), None],
+                       _DECODE_Q)
     return fn(q, k_cache, v_cache, positions, layer_idx)
+
+
+def _sharded_paged_kv_write(k_cache, v_cache, new_k, new_v, slot_mapping, layer_idx,
+                            mesh, rules):
+    """Stacked paged-cache decode K+V write (Pallas DMA RMW scatter) under the mesh.
+
+    ≈ the reference's batched KV write kernel over the paged layout
+    (`modules/kvcache/utils.py:20-38` + `block_kv_cache_manager.py:268-374`)."""
+    from ..modules.block_kvcache import PAGED_CACHE_LOGICAL
+    from ..ops.paged_decode import write_paged_stacked_kv
+
+    interpret = jax.default_backend() == "cpu"
+
+    def _local(ck, cv, nk, nv, sm, li):
+        return write_paged_stacked_kv(ck, cv, nk, nv, sm, li, interpret=interpret)
+
+    fn = _shard_mapped(_local, mesh, rules,
+                       [PAGED_CACHE_LOGICAL, PAGED_CACHE_LOGICAL, _DECODE_NEW_KV,
+                        _DECODE_NEW_KV, ("decode_batch", None), None],
+                       [PAGED_CACHE_LOGICAL, PAGED_CACHE_LOGICAL])
+    return fn(k_cache, v_cache, new_k, new_v, slot_mapping, layer_idx)
+
+
+def _sharded_paged_attend(q, k_cache, v_cache, positions, layer_idx, block_table,
+                          args: ModelArchArgs, mesh, rules):
+    """Ragged paged decode attention (Pallas, block-table-indexed, length-aware)
+    under the mesh.
+
+    ≈ the reference TKG attention kernels over the paged cache — the serving hot
+    path SURVEY §7 calls "the performance cliff": HBM reads track each row's live
+    length instead of the block-table width."""
+    from ..modules.block_kvcache import PAGED_CACHE_LOGICAL
+    from ..ops.paged_decode import paged_decode_attention_stacked
+
+    interpret = jax.default_backend() == "cpu"
+
+    def _local(q, kc, vc, p, li, bt):
+        return paged_decode_attention_stacked(
+            q, kc, vc, p, li, bt, scale=args.attention_scale,
+            window=args.sliding_window, interpret=interpret)
+
+    fn = _shard_mapped(_local, mesh, rules,
+                       [_DECODE_Q, PAGED_CACHE_LOGICAL, PAGED_CACHE_LOGICAL,
+                        ("decode_batch",), None, ("decode_batch", None)],
+                       _DECODE_Q)
+    return fn(q, k_cache, v_cache, positions, layer_idx, block_table)
 
 
 def _flash_decoding_step(q, k_new, v_new, k_cache, v_cache, positions,
@@ -572,10 +633,7 @@ def _sharded_flash_attention(q, k, v, args: ModelArchArgs, mesh, rules):
     local heads — the same SPMD shape as the reference launching one NKI kernel per
     core (`attention_base.py:121-125`).
     """
-    shard_map = jax.shard_map
-
     from ..ops.flash_attention import flash_attention
-    from ..parallel.sharding import DEFAULT_RULES, logical_to_spec
 
     interpret = jax.default_backend() == "cpu"   # CPU runs (tests) interpret the kernel
 
@@ -583,13 +641,11 @@ def _sharded_flash_attention(q, k, v, args: ModelArchArgs, mesh, rules):
         return flash_attention(q, k, v, causal=True, scale=args.attention_scale,
                                window=args.sliding_window, interpret=interpret)
 
-    if mesh is None:
-        return _local(q, k, v)
-    r = rules or DEFAULT_RULES
-    q_spec = logical_to_spec(("batch", "heads", None, None), r)
-    kv_spec = logical_to_spec(("batch", "kv_heads", None, None), r)
-    fn = shard_map(_local, mesh=mesh, in_specs=(q_spec, kv_spec, kv_spec),
-                   out_specs=q_spec, check_vma=False)
+    fn = _shard_mapped(_local, mesh, rules,
+                       [("batch", "heads", None, None),
+                        ("batch", "kv_heads", None, None),
+                        ("batch", "kv_heads", None, None)],
+                       ("batch", "heads", None, None))
     return fn(q, k, v)
 
 
@@ -615,6 +671,9 @@ def _decoder_layer(
     # traced scalar: decode over the STACKED cache via the Pallas kernels
     # (k_cache/v_cache then carry the full (L, B, H, S, D) arrays)
     stacked_layer_idx=None,
+    # with stacked_layer_idx: (block_table, slot_mapping) — the stacked cache is
+    # PAGED (L, NB, H, BS, D) and the Pallas ragged paged kernels serve the step
+    paged_stacked=None,
     # (B,) true row lengths: prefill writes into a rolling window cache (the layer's
     # cache stack is W wide; see kvcache.write_prefill_rolling)
     rolling_lengths: Optional[jnp.ndarray] = None,
@@ -649,20 +708,31 @@ def _decoder_layer(
         # slice read is ~0.1ms and the attend fuses well; the Pallas attend's
         # per-cell overhead only pays off once length-aware reads skip real
         # bandwidth, i.e. long buckets).
-        k_cache, v_cache = _sharded_kv_write(
-            k_cache, v_cache, k.astype(k_cache.dtype), v.astype(v_cache.dtype),
-            positions, stacked_layer_idx, mesh, rules)
-        if decode_bucket >= 1024:
-            attn = _sharded_decode_attend(q, k_cache, v_cache, positions,
-                                          stacked_layer_idx, decode_bucket, args,
-                                          mesh, rules)
+        if paged_stacked is not None:
+            # ragged paged serving: block-table-indexed write + length-aware attend
+            block_table, slot_mapping = paged_stacked
+            k_cache, v_cache = _sharded_paged_kv_write(
+                k_cache, v_cache, k.astype(k_cache.dtype), v.astype(v_cache.dtype),
+                slot_mapping, stacked_layer_idx, mesh, rules)
+            attn = _sharded_paged_attend(q, k_cache, v_cache, positions,
+                                         stacked_layer_idx, block_table, args,
+                                         mesh, rules)
         else:
-            sizes = (1,) + k_cache.shape[1:3] + (decode_bucket, k_cache.shape[4])
-            start = (stacked_layer_idx, 0, 0, 0, 0)
-            k_att = jax.lax.dynamic_slice(k_cache, start, sizes)[0]
-            v_att = jax.lax.dynamic_slice(v_cache, start, sizes)[0]
-            attn = attend(q, k_att.astype(q.dtype), v_att.astype(q.dtype),
-                          mask=mask, scale=args.attention_scale)
+            k_cache, v_cache = _sharded_kv_write(
+                k_cache, v_cache, k.astype(k_cache.dtype), v.astype(v_cache.dtype),
+                positions, stacked_layer_idx, mesh, rules)
+            if decode_bucket >= 1024:
+                attn = _sharded_decode_attend(q, k_cache, v_cache, positions,
+                                              stacked_layer_idx, decode_bucket,
+                                              args, mesh, rules)
+            else:
+                sizes = (1,) + k_cache.shape[1:3] + (decode_bucket,
+                                                     k_cache.shape[4])
+                start = (stacked_layer_idx, 0, 0, 0, 0)
+                k_att = jax.lax.dynamic_slice(k_cache, start, sizes)[0]
+                v_att = jax.lax.dynamic_slice(v_cache, start, sizes)[0]
+                attn = attend(q, k_att.astype(q.dtype), v_att.astype(q.dtype),
+                              mask=mask, scale=args.attention_scale)
         attn = attn.transpose(0, 2, 1, 3).reshape(h.shape[0], h.shape[1], args.q_size)
         attn_out = qapply(attn, lp["wo"])
         if args.lora is not None:
@@ -979,6 +1049,33 @@ def _run_stack_decode_kernel(params: Params, args: ModelArchArgs, h, cos, sin, m
     return h, {**cache, "k": k_new, "v": v_new}
 
 
+def _run_stack_paged_kernel(params: Params, args: ModelArchArgs, h, cos, sin,
+                            cache, positions, block_table, slot_mapping, mesh,
+                            rules, adapter_ids=None):
+    """Decode layer scan for the Pallas ragged paged path (continuous batching).
+
+    The paged cache (L, NB, H, BS, D) rides the scan as a CARRY — the block pool is
+    never sliced per layer (the gather path's per-layer xs/ys copies scale with the
+    whole pool, not the live tokens). Per layer: block-table RMW write + ragged
+    length-aware attend. ≈ the reference's paged TKG hot path
+    (`block_kv_cache_manager.py:268-374` + `attention_base.py:1483-1677`)."""
+    L = args.num_layers
+
+    def body(carry, xs):
+        carry_h, ck, cv = carry
+        lp, li = xs
+        new_h, ck, cv = _decoder_layer(
+            lp, args, carry_h, cos, sin, None, ck, cv, positions, None, mesh,
+            rules, adapter_ids=adapter_ids, stacked_layer_idx=li,
+            paged_stacked=(block_table, slot_mapping))
+        return (new_h, ck, cv), ()
+
+    (h, k_new, v_new), _ = jax.lax.scan(
+        body, (h, cache["k"], cache["v"]),
+        (params["layers"], jnp.arange(L, dtype=jnp.int32)))
+    return h, {**cache, "k": k_new, "v": v_new}
+
+
 def _embed(params: Params, args: ModelArchArgs, input_ids, mesh, rules):
     h = jnp.take(params["embed"], input_ids, axis=0)
     if args.embedding_multiplier != 1.0:
@@ -1149,7 +1246,7 @@ def decode_forward(
     paged = None
     if block_table is not None:
         paged = (block_table, slot_mapping)
-        block_size = cache["k"].shape[2]
+        block_size = cache["k"].shape[3]
         decode_bucket = block_table.shape[1] * block_size
     b, t = input_ids.shape
     h = _embed(params, args, input_ids, mesh, rules)
@@ -1172,11 +1269,22 @@ def decode_forward(
     cos, sin = rope_ops.compute_cos_sin(params["rope_inv_freq"], rope_pos,
                                         args.rope_attention_scaling)
     if use_kernel:
-        if tree is not None or paged is not None or window_row is not None:
+        if tree is not None or window_row is not None:
             raise ValueError("use_kernel supports plain chain decode only")
         if args.layer_pattern is not None or args.attn_sinks or \
                 args.logits_soft_cap is not None:
             raise ValueError("use_kernel does not support this architecture")
+        if paged is not None:
+            # ragged paged serving hot path: Pallas block-table kernels, cache
+            # as scan carry (never gathered to the table width)
+            h, cache = _run_stack_paged_kernel(
+                params, args, h, cos, sin, cache, position_ids, block_table,
+                slot_mapping, mesh, rules, adapter_ids=adapter_ids)
+            h = _norm(h, params["final_norm"], args, params.get("final_norm_b"))
+            logits = _lm_head(params, args, h, mesh, rules)
+            if return_hidden:
+                return logits, cache, h
+            return logits, cache
         kv_pos_k = jnp.arange(decode_bucket)[None, None, None, :]
         mask_k = kv_pos_k <= pos_grid[:, None, :, None]
         if args.sliding_window is not None:

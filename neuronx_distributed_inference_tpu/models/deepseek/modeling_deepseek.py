@@ -81,7 +81,7 @@ def _mla_attention(lp: Params, args: DeepseekArchArgs, hn: jnp.ndarray,
     """MLA attention over the latent cache.
 
     hn: (B, S, H) normed hidden states. latent_cache: dense (B, 1, S_max, R+C), or
-    paged (num_blocks, block_size, 1, R+C) when ``paged=(block_table, slot_mapping)``.
+    paged (num_blocks, 1, block_size, R+C) when ``paged=(block_table, slot_mapping)``.
     Returns (attn_out (B, S, heads*v_dim), updated latent_cache)."""
     b, s, _ = hn.shape
     R, C = args.qk_rope_head_dim, args.kv_lora_rank
@@ -234,7 +234,7 @@ def decode_forward(params: Params, args: DeepseekArchArgs, input_ids, position_i
     paged = None
     if block_table is not None:
         paged = (block_table, slot_mapping)
-        block_size = cache["latent"].shape[2]
+        block_size = cache["latent"].shape[3]
         decode_bucket = block_table.shape[1] * block_size
     b, t = input_ids.shape
     h = _embed(params, args, input_ids, mesh, rules)
@@ -492,10 +492,10 @@ class DeepseekForCausalLM(TpuModelForCausalLM):
 
     # --- latent cache -----------------------------------------------------------------
     def make_paged_cache(self, num_blocks: int, block_size: int):
-        """Paged latent cache: (L, num_blocks, block_size, 1, R+C), replicated over
+        """Paged latent cache: (L, num_blocks, 1, block_size, R+C), replicated over
         tp like the dense latent."""
         a: DeepseekArchArgs = self.arch_args
-        shape = (a.num_layers, num_blocks, block_size, 1, a.latent_dim)
+        shape = (a.num_layers, num_blocks, 1, block_size, a.latent_dim)
         sharding = named_sharding(self.mesh, ("layers", None, None, None, None))
         return {"latent": jax.device_put(
             jnp.zeros(shape, dtype=self.tpu_config.kv_cache_jax_dtype), sharding)}

@@ -231,7 +231,7 @@ def decode_forward(params: Params, args: Llama4ArchArgs, input_ids, position_ids
     paged = None
     if block_table is not None:
         paged = (block_table, slot_mapping)
-        block_size = cache["k"].shape[2]
+        block_size = cache["k"].shape[3]
         decode_bucket = block_table.shape[1] * block_size
     b, t = input_ids.shape
     h = _embed(params, args, input_ids, mesh, rules)

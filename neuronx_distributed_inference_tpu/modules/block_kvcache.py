@@ -6,9 +6,11 @@ cache = (num_blocks, block_size, H, D), gather via active_block_table, write via
 slot_mapping) and `modules/kvcache/utils.py` (`get_active_block_table` :40-). TPU
 redesign:
 
-- Device layout is layer-stacked ``(L, num_blocks, block_size, H_kv, D)`` so the model's
-  `lax.scan` over layers carries one (NB, BS, H, D) slice per step, exactly like the
-  dense cache.
+- Device layout is layer-stacked ``(L, num_blocks, H_kv, block_size, D)``: each
+  (block, head) holds a contiguous (block_size, D) tile run — the layout the Pallas
+  ragged paged decode kernel streams (ops/paged_decode.py) — and the model's
+  `lax.scan` over layers carries one (NB, H, BS, D) slice per step, exactly like the
+  dense cache's (B, H, S, D) with blocks in the batch position.
 - Writes flatten blocks to a (NB*BS, H, D) slot view and scatter rows at
   ``slot = block_id * block_size + offset`` with out-of-bounds drop semantics — padding
   rows use slot -1 and vanish, replacing the reference's garbage-position padding writes
@@ -36,7 +38,7 @@ PagedKVCache = Dict[str, jnp.ndarray]
 
 # logical axes for sharding the stacked paged cache (blocks stay unsharded — each
 # shard holds full blocks for its kv_heads slice)
-PAGED_CACHE_LOGICAL = ("layers", None, None, "kv_heads", None)
+PAGED_CACHE_LOGICAL = ("layers", None, "kv_heads", None, None)
 
 
 @dataclass(frozen=True)
@@ -50,8 +52,8 @@ class PagedKVCacheSpec:
 
     @property
     def shape(self) -> Tuple[int, int, int, int, int]:
-        return (self.num_layers, self.num_blocks, self.block_size,
-                self.num_kv_heads, self.head_dim)
+        return (self.num_layers, self.num_blocks, self.num_kv_heads,
+                self.block_size, self.head_dim)
 
     @property
     def num_slots(self) -> int:
@@ -72,28 +74,29 @@ def write_slots(cache_layer: jnp.ndarray, new_kv: jnp.ndarray,
     ``slot = block_id * block_size + offset``; negative slots are dropped (padding).
     ≈ the reference's index_put write strategy (`block_kv_cache_manager.py:268-374`).
     """
-    nb, bs, h, d = cache_layer.shape
-    flat = cache_layer.reshape(nb * bs, h, d)
+    nb, h, bs, d = cache_layer.shape
     b, hh, t, dd = new_kv.shape
-    rows = new_kv.transpose(0, 2, 1, 3).reshape(b * t, hh, dd).astype(flat.dtype)
+    rows = new_kv.transpose(0, 2, 1, 3).reshape(b * t, hh, dd).astype(
+        cache_layer.dtype)                                  # (N, H, D)
     slots = slot_mapping.reshape(b * t)
     # negative indices WRAP in jnp (NumPy semantics) — only indices >= size are dropped
-    # by mode="drop"; remap the -1 sentinel to an explicitly out-of-bounds slot, else
-    # every padding write would clobber the final slot of the final block.
-    slots = jnp.where(slots < 0, nb * bs, slots)
-    flat = flat.at[slots].set(rows, mode="drop")
-    return flat.reshape(nb, bs, h, d)
+    # by mode="drop"; remap the -1 sentinel to an explicitly out-of-bounds block, else
+    # every padding write would clobber a live slot.
+    blk = jnp.where(slots < 0, nb, slots // bs)
+    off = jnp.where(slots < 0, 0, slots % bs)
+    # advanced indices (blk, off) separated by the head slice -> result (N, H, D)
+    return cache_layer.at[blk, :, off, :].set(rows, mode="drop")
 
 
 def read_seq(cache_layer: jnp.ndarray, block_table: jnp.ndarray) -> jnp.ndarray:
-    """Gather (NB, BS, H, D) through block tables (B, MB) -> (B, H, MB*BS, D).
+    """Gather (NB, H, BS, D) through block tables (B, MB) -> (B, H, MB*BS, D).
 
     Unused table entries may be any valid block id (masking is positional downstream).
     ≈ `get_active_block_table` + gather (`kvcache/utils.py:40-`).
     """
-    gathered = jnp.take(cache_layer, block_table, axis=0)   # (B, MB, BS, H, D)
-    b, mb, bs, h, d = gathered.shape
-    return gathered.reshape(b, mb * bs, h, d).transpose(0, 2, 1, 3)
+    gathered = jnp.take(cache_layer, block_table, axis=0)   # (B, MB, H, BS, D)
+    b, mb, h, bs, d = gathered.shape
+    return gathered.transpose(0, 2, 1, 3, 4).reshape(b, h, mb * bs, d)
 
 
 def make_slot_mapping(block_table: np.ndarray, positions: np.ndarray,

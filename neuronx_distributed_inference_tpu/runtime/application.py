@@ -330,30 +330,32 @@ class TpuModelForCausalLM:
                              f"{unsupported}")
         return True
 
-    def _use_decode_kernel(self) -> bool:
-        """Auto-select the Pallas stacked-cache decode path (KV-write DMA scatter +
-        length-aware decode attention, ≈ reference TKG kernel selection,
-        `attention_base.py:1483-1677`): explicit config wins; otherwise on for TPU
-        backends for architectures the kernel supports."""
+    def _decode_kernel_arch_gate(self) -> Optional[str]:
+        """Arch features the Pallas decode kernels (dense and paged) do not serve;
+        returns the unsupported-feature name or None. Shared by both selectors so
+        the gates cannot drift from each other."""
         a = self.arch_args
-        cfg = self.tpu_config.decode_kernel_enabled
-        unsupported = None
         if self.decode_fn() is not model_base.decode_forward:
-            unsupported = "custom decode paths"
-        elif a.logits_soft_cap is not None:
-            unsupported = "logits_soft_cap"
-        elif a.attn_sinks:
-            unsupported = "attention sinks"
-        elif a.layer_pattern is not None:
-            unsupported = "per-layer attention patterns"
-        elif a.alibi:
-            unsupported = "ALiBi attention bias"
-        elif self.tpu_config.paged_attention_enabled:
-            unsupported = "paged attention"
-        elif a.head_dim % 128 != 0 and jax.default_backend() != "cpu":
+            return "custom decode paths"
+        if a.logits_soft_cap is not None:
+            return "logits_soft_cap"
+        if a.attn_sinks:
+            return "attention sinks"
+        if a.layer_pattern is not None:
+            return "per-layer attention patterns"
+        if a.alibi:
+            return "ALiBi attention bias"
+        if a.head_dim % 128 != 0 and jax.default_backend() != "cpu":
             # the KV-write DMA slices the cache's minor dim, which Mosaic requires
             # aligned to the 128-lane tiling (interpret mode on CPU is unconstrained)
-            unsupported = "head_dim not a multiple of 128"
+            return "head_dim not a multiple of 128"
+        return None
+
+    def _decode_kernel_select(self, unsupported: Optional[str]) -> bool:
+        """Shared decision tail: explicit config wins (raising when it demands an
+        unsupported combination); otherwise on for TPU backends when supported."""
+        a = self.arch_args
+        cfg = self.tpu_config.decode_kernel_enabled
         if cfg is not None:
             if cfg and unsupported is not None:
                 raise ValueError(f"decode_kernel_enabled=True but the decode kernel "
@@ -365,6 +367,41 @@ class TpuModelForCausalLM:
         if a.num_heads % tp != 0 or a.num_kv_heads % tp != 0:
             return False
         return jax.default_backend() not in ("cpu",)
+
+    def _use_decode_kernel(self) -> bool:
+        """Auto-select the Pallas stacked-cache decode path (KV-write DMA scatter +
+        length-aware decode attention, ≈ reference TKG kernel selection,
+        `attention_base.py:1483-1677`): explicit config wins; otherwise on for TPU
+        backends for architectures the kernel supports."""
+        return self._decode_kernel_select(self._decode_kernel_arch_gate())
+
+    def _use_paged_decode_kernel(self) -> bool:
+        """Auto-select the Pallas ragged paged decode path for continuous-batching
+        serving (block-table-indexed, length-aware kernels — ops/paged_decode.py,
+        ≈ the reference's paged TKG hot path, `block_kv_cache_manager.py:268-374`).
+        Same arch gates as the dense kernel, plus paged-layout constraints."""
+        from ..ops.paged_decode import _pack
+
+        unsupported = self._decode_kernel_arch_gate()
+        if unsupported is None:
+            pack = _pack(self.tpu_config.kv_cache_jax_dtype)
+            if self.tpu_config.pa_block_size % pack != 0:
+                unsupported = (f"pa_block_size {self.tpu_config.pa_block_size} not "
+                               f"a multiple of the {pack}-row KV tile packing")
+        if unsupported is None and (
+                self.mesh.shape.get("dp", 1) * self.mesh.shape.get("cp", 1) != 1
+                or self.tpu_config.attention_dp_enabled):
+            # the block pool is replicated over dp/cp and its kv_heads axis is
+            # plain-tp-sharded; a dp/cp-split batch (or the attention-DP
+            # decode_batch->(dp,tp) remap) is inconsistent with those specs. A
+            # mixed config (dense kernel on, paged serving on such a mesh) is
+            # legitimate, so fall back loudly instead of raising.
+            logger.warning(
+                "paged decode kernels disabled: dp/cp-sharded or attention-DP "
+                "decode layout (the block pool is replicated, kv_heads "
+                "plain-tp-sharded); continuous batching uses the gather path")
+            return False
+        return self._decode_kernel_select(unsupported)
 
     def _use_flash_attention(self) -> bool:
         """Auto-select the Pallas prefill kernel (≈ reference

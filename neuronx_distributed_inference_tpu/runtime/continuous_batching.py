@@ -118,6 +118,13 @@ class ContinuousBatchingRunner:
         decode_core = app.decode_fn()
 
         if self.paged:
+            # ragged paged decode: the Pallas block-table kernels serve the chunked
+            # decode body when the family/layout supports them (the serving hot
+            # path — ≈ SURVEY §7 "ragged paged attention is the performance cliff");
+            # inserts (wide prefix-prefill queries) keep the gather path
+            paged_kernel_kw = (
+                {"use_kernel": True} if app._use_paged_decode_kernel() else {})
+
             def _insert(params, input_ids, position_ids, last_token_idx, cache,
                         block_table_row, slot_mapping, sampling_params, key):
                 """Batch-1 (prefix-)prefill into paged blocks: a wide decode call whose
@@ -145,7 +152,7 @@ class ContinuousBatchingRunner:
                         logits, cache = decode_core(
                             params, args, tok[:, None], pos, cache, None,
                             mesh=mesh, rules=rules, block_table=block_table,
-                            slot_mapping=slots_j)
+                            slot_mapping=slots_j, **paged_kernel_kw)
                         nxt = sampling_ops.sample(logits[:, -1], sampling_params,
                                                   step_key, odsc)
                     return (nxt, pos + 1, cache), nxt

@@ -1,0 +1,222 @@
+"""Ragged paged decode kernels vs the jnp gather path (interpret mode).
+
+≈ reference paged decode correctness: block-gather semantics
+(`modules/kvcache/block_kv_cache_manager.py:268-374`) + TKG attention
+(`attention_base.py:1483-1677`). The Pallas kernels must match the
+write_slots/read_seq + masked-attend reference bit-for-bit in fp32.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from neuronx_distributed_inference_tpu.modules import block_kvcache
+from neuronx_distributed_inference_tpu.ops.paged_decode import (
+    paged_decode_attention_stacked, write_paged_stacked_kv)
+
+
+def _ref_attend(q, k_att, v_att, positions, scale, window=None):
+    """Masked jnp attention over the gathered (B, H, S, D) view (the gather path)."""
+    b, hq, t, d = q.shape
+    hkv = k_att.shape[1]
+    rep = hq // hkv
+    s_kv = k_att.shape[2]
+    kv_pos = jnp.arange(s_kv)[None, None, None, :]
+    q_pos = (positions[:, None] + jnp.arange(t)[None, :])[:, None, :, None]
+    mask = kv_pos <= q_pos
+    if window is not None:
+        mask = jnp.logical_and(mask, kv_pos > q_pos - window)
+    qg = q.reshape(b, hkv, rep, t, d)
+    s = jnp.einsum("bkrtd,bksd->bkrts", qg, k_att.astype(q.dtype),
+                   preferred_element_type=jnp.float32) * scale
+    s = jnp.where(mask[:, :, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bkrts,bksd->bkrtd", p.astype(q.dtype), v_att.astype(q.dtype))
+    return out.reshape(b, hq, t, d)
+
+
+def _setup(seed=0, L=3, NB=12, BS=16, H=2, D=128, B=4, MB=6):
+    rng = np.random.default_rng(seed)
+    k_cache = rng.normal(size=(L, NB, H, BS, D)).astype(np.float32)
+    v_cache = rng.normal(size=(L, NB, H, BS, D)).astype(np.float32)
+    # each row gets a random permutation of physical blocks and a ragged position
+    block_table = np.stack([rng.permutation(NB)[:MB] for _ in range(B)]).astype(np.int32)
+    positions = rng.integers(0, MB * BS - 2, size=(B,)).astype(np.int32)
+    return k_cache, v_cache, block_table, positions
+
+
+def test_write_paged_matches_write_slots():
+    k_cache, v_cache, block_table, positions = _setup()
+    L, NB, H, BS, D = k_cache.shape
+    B, T = positions.shape[0], 1
+    rng = np.random.default_rng(1)
+    new_k = rng.normal(size=(B, H, T, D)).astype(np.float32)
+    new_v = rng.normal(size=(B, H, T, D)).astype(np.float32)
+    slot_mapping = block_kvcache.make_slot_mapping(
+        block_table, positions, T, BS,
+        valid=np.array([True, True, False, True]))   # one dropped row
+    lidx = jnp.asarray(1, jnp.int32)
+
+    ref_k = np.asarray(block_kvcache.write_slots(
+        jnp.asarray(k_cache[1]), jnp.asarray(new_k), jnp.asarray(slot_mapping)))
+    ref_v = np.asarray(block_kvcache.write_slots(
+        jnp.asarray(v_cache[1]), jnp.asarray(new_v), jnp.asarray(slot_mapping)))
+
+    out_k, out_v = write_paged_stacked_kv(
+        jnp.asarray(k_cache), jnp.asarray(v_cache), jnp.asarray(new_k),
+        jnp.asarray(new_v), jnp.asarray(slot_mapping), lidx, interpret=True)
+    out_k, out_v = np.asarray(out_k), np.asarray(out_v)
+
+    np.testing.assert_array_equal(out_k[1], ref_k)
+    np.testing.assert_array_equal(out_v[1], ref_v)
+    # untouched layers stay bit-identical
+    np.testing.assert_array_equal(out_k[0], k_cache[0])
+    np.testing.assert_array_equal(out_k[2], k_cache[2])
+
+
+@pytest.mark.parametrize("t", [1, 3])
+def test_paged_attend_matches_gather_path(t):
+    k_cache, v_cache, block_table, positions = _setup()
+    L, NB, H, BS, D = k_cache.shape
+    B = positions.shape[0]
+    MB = block_table.shape[1]
+    HQ = 4
+    rng = np.random.default_rng(2)
+    q = rng.normal(size=(B, HQ, t, D)).astype(np.float32)
+    scale = D ** -0.5
+    lidx = jnp.asarray(2, jnp.int32)
+
+    k_att = block_kvcache.read_seq(jnp.asarray(k_cache[2]), jnp.asarray(block_table))
+    v_att = block_kvcache.read_seq(jnp.asarray(v_cache[2]), jnp.asarray(block_table))
+    ref = np.asarray(_ref_attend(jnp.asarray(q), k_att, v_att,
+                                 jnp.asarray(positions), scale))
+
+    out = np.asarray(paged_decode_attention_stacked(
+        jnp.asarray(q), jnp.asarray(k_cache), jnp.asarray(v_cache),
+        jnp.asarray(positions), lidx, jnp.asarray(block_table),
+        scale=scale, interpret=True))
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=1e-5)
+
+
+def test_paged_attend_blocks_per_cell_invariant():
+    k_cache, v_cache, block_table, positions = _setup(seed=3)
+    B = positions.shape[0]
+    D = k_cache.shape[-1]
+    q = np.random.default_rng(4).normal(size=(B, 4, 1, D)).astype(np.float32)
+    lidx = jnp.asarray(0, jnp.int32)
+    outs = []
+    for kb in (1, 2, 3, 6):
+        outs.append(np.asarray(paged_decode_attention_stacked(
+            jnp.asarray(q), jnp.asarray(k_cache), jnp.asarray(v_cache),
+            jnp.asarray(positions), lidx, jnp.asarray(block_table),
+            blocks_per_cell=kb, interpret=True)))
+    for o in outs[1:]:
+        np.testing.assert_allclose(o, outs[0], atol=1e-6)
+
+
+def test_paged_attend_sliding_window():
+    k_cache, v_cache, block_table, positions = _setup(seed=5)
+    B = positions.shape[0]
+    D = k_cache.shape[-1]
+    q = np.random.default_rng(6).normal(size=(B, 2, 1, D)).astype(np.float32)
+    lidx = jnp.asarray(1, jnp.int32)
+    scale = D ** -0.5
+    window = 24
+
+    k_att = block_kvcache.read_seq(jnp.asarray(k_cache[1]), jnp.asarray(block_table))
+    v_att = block_kvcache.read_seq(jnp.asarray(v_cache[1]), jnp.asarray(block_table))
+    ref = np.asarray(_ref_attend(jnp.asarray(q), k_att, v_att,
+                                 jnp.asarray(positions), scale, window=window))
+    out = np.asarray(paged_decode_attention_stacked(
+        jnp.asarray(q), jnp.asarray(k_cache), jnp.asarray(v_cache),
+        jnp.asarray(positions), lidx, jnp.asarray(block_table),
+        scale=scale, window=window, interpret=True))
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=1e-5)
+
+
+def test_decode_forward_paged_kernel_matches_gather(tiny_llama_hf_config):
+    """Model-level parity: decode_forward paged with use_kernel=True (Pallas
+    ragged path, cache as scan carry) equals the gather path bit-for-bit."""
+    from neuronx_distributed_inference_tpu.config import (
+        TpuConfig, load_pretrained_config)
+    from neuronx_distributed_inference_tpu.models import base as model_base
+    from neuronx_distributed_inference_tpu.models.llama.modeling_llama import (
+        LlamaForCausalLM, LlamaInferenceConfig)
+
+    tpu_cfg = TpuConfig(
+        batch_size=2, seq_len=96, max_context_length=32, dtype="float32",
+        is_continuous_batching=True, paged_attention_enabled=True,
+        pa_num_blocks=24, pa_block_size=8)
+    config = LlamaInferenceConfig(
+        tpu_cfg, load_config=load_pretrained_config(tiny_llama_hf_config))
+    app = LlamaForCausalLM(None, config)
+    app.load_random(seed=0)
+    assert app._use_paged_decode_kernel() is False   # CPU default: off
+    cache = app.make_paged_cache(24, 8)
+
+    rng = np.random.default_rng(0)
+    block_table = np.stack([rng.permutation(24)[:6] for _ in range(2)]).astype(np.int32)
+    positions = np.array([13, 29], dtype=np.int32)
+    # write some committed context so the kernel reads through the table
+    ctx_k = rng.normal(size=(2, 2, 40, 16)).astype(np.float32) * 0.1
+    slot_ctx = block_kvcache.make_slot_mapping(
+        block_table, np.zeros(2, np.int32), 40, 8)
+    for L in range(cache["k"].shape[0]):
+        cache["k"] = cache["k"].at[L].set(block_kvcache.write_slots(
+            cache["k"][L], jnp.asarray(ctx_k), jnp.asarray(slot_ctx)))
+        cache["v"] = cache["v"].at[L].set(block_kvcache.write_slots(
+            cache["v"][L], jnp.asarray(ctx_k * 0.5), jnp.asarray(slot_ctx)))
+
+    tok = rng.integers(1, 256, size=(2, 1)).astype(np.int32)
+    slot_map = block_kvcache.make_slot_mapping(block_table, positions, 1, 8)
+
+    outs = {}
+    for use_kernel in (False, True):
+        logits, out_cache = model_base.decode_forward(
+            app.params, app.arch_args, jnp.asarray(tok), jnp.asarray(positions),
+            {k: v.copy() for k, v in cache.items()}, None,
+            mesh=app.mesh, rules=app.sharding_rules,
+            block_table=jnp.asarray(block_table), slot_mapping=jnp.asarray(slot_map),
+            use_kernel=use_kernel)
+        outs[use_kernel] = (np.asarray(logits), np.asarray(out_cache["k"]),
+                            np.asarray(out_cache["v"]))
+
+    np.testing.assert_allclose(outs[True][0], outs[False][0], atol=2e-4, rtol=1e-4)
+    np.testing.assert_allclose(outs[True][1], outs[False][1], atol=1e-5)
+    np.testing.assert_allclose(outs[True][2], outs[False][2], atol=1e-5)
+
+
+def test_paged_cb_kernel_matches_gather_tokens(tiny_llama_hf_config):
+    """End-to-end serving parity: paged continuous batching with the Pallas ragged
+    kernels (decode_kernel_enabled=True) emits exactly the gather path's tokens."""
+    from neuronx_distributed_inference_tpu.config import (
+        TpuConfig, load_pretrained_config)
+    from neuronx_distributed_inference_tpu.models.llama.modeling_llama import (
+        LlamaForCausalLM, LlamaInferenceConfig)
+    from neuronx_distributed_inference_tpu.runtime.continuous_batching import (
+        ContinuousBatchingRunner)
+
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, 256, size=(n,)).astype(np.int32) for n in (12, 7, 19)]
+
+    def _run(kernel_enabled):
+        tpu_cfg = TpuConfig(
+            batch_size=2, seq_len=96, max_context_length=32, dtype="float32",
+            context_encoding_buckets=[16, 32], token_generation_buckets=[48, 96],
+            is_continuous_batching=True, paged_attention_enabled=True,
+            pa_num_blocks=48, pa_block_size=8,
+            decode_kernel_enabled=kernel_enabled)
+        config = LlamaInferenceConfig(
+            tpu_cfg, load_config=load_pretrained_config(tiny_llama_hf_config))
+        app = LlamaForCausalLM(None, config)
+        app.load_random(seed=0)
+        runner = ContinuousBatchingRunner(app, decode_chunk=4)
+        if kernel_enabled:
+            assert app._use_paged_decode_kernel() is True
+        ids = [runner.submit(p, max_new_tokens=10) for p in prompts]
+        results = runner.run_to_completion()
+        return [results[rid] for rid in ids]
+
+    assert _run(True) == _run(None)
