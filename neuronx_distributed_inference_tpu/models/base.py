@@ -460,6 +460,19 @@ _DECODE_NEW_KV = ("decode_batch", "decode_kv_heads", None, None)
 _DECODE_Q = ("decode_batch", "decode_heads", None, None)
 
 
+
+def _head_extras(sinks, alibi_slopes, logical_axis):
+    """Per-q-head kernel extras (sinks / ALiBi slopes) -> (in_logical tail,
+    operand tail, kw names) for the shard_map wrappers below."""
+    in_logical, operands, kw_names = [], [], []
+    for name, extra in (("sinks", sinks), ("alibi_slopes", alibi_slopes)):
+        if extra is not None:
+            in_logical.append((logical_axis,))
+            operands.append(extra)
+            kw_names.append(name)
+    return in_logical, operands, kw_names
+
+
 def _sharded_kv_write(k_cache, v_cache, new_k, new_v, positions, layer_idx, mesh,
                       rules):
     """Stacked-cache decode K+V write (one Pallas DMA-scatter kernel) under the mesh.
@@ -483,26 +496,31 @@ def _sharded_kv_write(k_cache, v_cache, new_k, new_v, positions, layer_idx, mesh
 
 
 def _sharded_decode_attend(q, k_cache, v_cache, positions, layer_idx, bucket,
-                           args: ModelArchArgs, mesh, rules):
+                           args: ModelArchArgs, mesh, rules, sinks=None,
+                           alibi_slopes=None):
     """Stacked-cache decode attention (Pallas, length-aware) under the mesh.
 
     ≈ the reference TKG attention kernels (`attention_base.py:1483-1677`): reads only
-    KV tiles at or below each row's position instead of the full bucket width."""
+    KV tiles at or below each row's position instead of the full bucket width.
+    ``sinks``/``alibi_slopes`` are (Hq,) per-q-head extras, sharded with the heads."""
     from ..modules.kvcache import CACHE_LOGICAL
     from ..ops.flash_decode import flash_decode_attention_stacked
 
     interpret = jax.default_backend() == "cpu"
+    xl, xo, kw_names = _head_extras(sinks, alibi_slopes, "decode_heads")
+    in_logical = [_DECODE_Q, CACHE_LOGICAL, CACHE_LOGICAL,
+                  ("decode_batch",), None] + xl
+    operands = [q, k_cache, v_cache, positions, layer_idx] + xo
 
-    def _local(q, kc, vc, p, li):
+    def _local(q, kc, vc, p, li, *extras):
+        kw = dict(zip(kw_names, extras))
         return flash_decode_attention_stacked(
             q, kc, vc, p, li, bucket=bucket, scale=args.attention_scale,
-            window=args.sliding_window, interpret=interpret)
+            window=args.sliding_window, soft_cap=args.logits_soft_cap,
+            interpret=interpret, **kw)
 
-    fn = _shard_mapped(_local, mesh, rules,
-                       [_DECODE_Q, CACHE_LOGICAL, CACHE_LOGICAL,
-                        ("decode_batch",), None],
-                       _DECODE_Q)
-    return fn(q, k_cache, v_cache, positions, layer_idx)
+    fn = _shard_mapped(_local, mesh, rules, in_logical, _DECODE_Q)
+    return fn(*operands)
 
 
 def _sharded_paged_kv_write(k_cache, v_cache, new_k, new_v, slot_mapping, layer_idx,
@@ -527,7 +545,8 @@ def _sharded_paged_kv_write(k_cache, v_cache, new_k, new_v, slot_mapping, layer_
 
 
 def _sharded_paged_attend(q, k_cache, v_cache, positions, layer_idx, block_table,
-                          args: ModelArchArgs, mesh, rules):
+                          args: ModelArchArgs, mesh, rules, sinks=None,
+                          alibi_slopes=None):
     """Ragged paged decode attention (Pallas, block-table-indexed, length-aware)
     under the mesh.
 
@@ -538,17 +557,20 @@ def _sharded_paged_attend(q, k_cache, v_cache, positions, layer_idx, block_table
     from ..ops.paged_decode import paged_decode_attention_stacked
 
     interpret = jax.default_backend() == "cpu"
+    xl, xo, kw_names = _head_extras(sinks, alibi_slopes, "decode_heads")
+    in_logical = [_DECODE_Q, PAGED_CACHE_LOGICAL, PAGED_CACHE_LOGICAL,
+                  ("decode_batch",), None, ("decode_batch", None)] + xl
+    operands = [q, k_cache, v_cache, positions, layer_idx, block_table] + xo
 
-    def _local(q, kc, vc, p, li, bt):
+    def _local(q, kc, vc, p, li, bt, *extras):
+        kw = dict(zip(kw_names, extras))
         return paged_decode_attention_stacked(
             q, kc, vc, p, li, bt, scale=args.attention_scale,
-            window=args.sliding_window, interpret=interpret)
+            window=args.sliding_window, soft_cap=args.logits_soft_cap,
+            interpret=interpret, **kw)
 
-    fn = _shard_mapped(_local, mesh, rules,
-                       [_DECODE_Q, PAGED_CACHE_LOGICAL, PAGED_CACHE_LOGICAL,
-                        ("decode_batch",), None, ("decode_batch", None)],
-                       _DECODE_Q)
-    return fn(q, k_cache, v_cache, positions, layer_idx, block_table)
+    fn = _shard_mapped(_local, mesh, rules, in_logical, _DECODE_Q)
+    return fn(*operands)
 
 
 def _flash_decoding_step(q, k_new, v_new, k_cache, v_cache, positions,
@@ -625,7 +647,8 @@ def _flash_decoding_step(q, k_new, v_new, k_cache, v_cache, positions,
     return fn(q, k_new, v_new, k_cache, v_cache, positions)
 
 
-def _sharded_flash_attention(q, k, v, args: ModelArchArgs, mesh, rules):
+def _sharded_flash_attention(q, k, v, args: ModelArchArgs, mesh, rules, sinks=None,
+                             alibi_slopes=None):
     """Run the Pallas flash kernel with heads local per shard.
 
     Pallas calls have no GSPMD partitioning rule, so under a mesh the kernel is wrapped
@@ -636,17 +659,22 @@ def _sharded_flash_attention(q, k, v, args: ModelArchArgs, mesh, rules):
     from ..ops.flash_attention import flash_attention
 
     interpret = jax.default_backend() == "cpu"   # CPU runs (tests) interpret the kernel
+    xl, xo, kw_names = _head_extras(sinks, alibi_slopes, "heads")
+    in_logical = [("batch", "heads", None, None),
+                  ("batch", "kv_heads", None, None),
+                  ("batch", "kv_heads", None, None)] + xl
+    operands = [q, k, v] + xo
 
-    def _local(q, k, v):
+    def _local(q, k, v, *extras):
+        kw = dict(zip(kw_names, extras))
         return flash_attention(q, k, v, causal=True, scale=args.attention_scale,
-                               window=args.sliding_window, interpret=interpret)
+                               window=args.sliding_window,
+                               soft_cap=args.logits_soft_cap,
+                               interpret=interpret, **kw)
 
-    fn = _shard_mapped(_local, mesh, rules,
-                       [("batch", "heads", None, None),
-                        ("batch", "kv_heads", None, None),
-                        ("batch", "kv_heads", None, None)],
+    fn = _shard_mapped(_local, mesh, rules, in_logical,
                        ("batch", "heads", None, None))
-    return fn(q, k, v)
+    return fn(*operands)
 
 
 def _decoder_layer(
@@ -679,6 +707,13 @@ def _decoder_layer(
     rolling_lengths: Optional[jnp.ndarray] = None,
     flash_decoding: bool = False,   # KV-seq-sharded decode over the cp axis
     attn_bias: Optional[jnp.ndarray] = None,   # additive attention bias (ALiBi)
+    alibi_slopes: Optional[jnp.ndarray] = None,  # (Hq,) — kernel paths compute the
+                                                 # bias in-kernel from these
+    # static fp8 KV scales for THIS layer: (σ_k (Hkv,), σ_v (Hkv,)) fp32. The cache
+    # stores K/σ_k and V/σ_v; σ_k folds into q and σ_v into the attention output —
+    # exact math, so every attend path (jnp / Pallas dense / paged / ring / flash)
+    # serves scaled caches unchanged. ≈ reference static-scale fp8 KV.
+    kv_scales: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
 ):
     resid = h
     hn = _norm(h, lp["ln1"], args, lp.get("ln1_b"))
@@ -701,6 +736,29 @@ def _decoder_layer(
                       mesh=mesh)
     q, k = _apply_rope(args, q, k, cos, sin)
 
+    if kv_scales is not None:
+        # static fp8 scale fold: write K̂ = K/σ_k (the cast to the fp8 cache dtype
+        # happens at the write sites below), attend with q̂ = q·σ_k — so
+        # q̂·K̂ = q·K exactly; the matching σ_v un-fold multiplies the attention
+        # output (just before each o-projection)
+        sk, sv = kv_scales
+        n_rep_s = q.shape[1] // k.shape[1]
+        k = k / sk[None, :, None, None].astype(k.dtype)
+        v = v / sv[None, :, None, None].astype(v.dtype)
+        dt = jnp.dtype(k_cache.dtype)
+        if dt.itemsize == 1 and dt.kind != "i":   # fp8 dtypes report kind 'V'
+            # fp8 cache: saturate instead of overflowing to NaN — calibration sets
+            # σ from sample absmax, and serving values can exceed it slightly
+            import ml_dtypes
+
+            fmax = float(ml_dtypes.finfo(dt).max)
+            k = jnp.clip(k, -fmax, fmax)
+            v = jnp.clip(v, -fmax, fmax)
+        q = q * jnp.repeat(sk, n_rep_s)[None, :, None, None].astype(q.dtype)
+        _sv_unfold = jnp.repeat(sv, n_rep_s)[None, :, None, None]
+    else:
+        _sv_unfold = None
+
     if stacked_layer_idx is not None:
         # kernel decode path: the stacked cache is carried whole (never sliced or
         # re-stacked by scan) — write the step's rows with a DMA scatter. Short
@@ -708,6 +766,7 @@ def _decoder_layer(
         # slice read is ~0.1ms and the attend fuses well; the Pallas attend's
         # per-cell overhead only pays off once length-aware reads skip real
         # bandwidth, i.e. long buckets).
+        sinks_arr = lp.get("sinks") if args.attn_sinks else None
         if paged_stacked is not None:
             # ragged paged serving: block-table-indexed write + length-aware attend
             block_table, slot_mapping = paged_stacked
@@ -716,7 +775,8 @@ def _decoder_layer(
                 slot_mapping, stacked_layer_idx, mesh, rules)
             attn = _sharded_paged_attend(q, k_cache, v_cache, positions,
                                          stacked_layer_idx, block_table, args,
-                                         mesh, rules)
+                                         mesh, rules, sinks=sinks_arr,
+                                         alibi_slopes=alibi_slopes)
         else:
             k_cache, v_cache = _sharded_kv_write(
                 k_cache, v_cache, k.astype(k_cache.dtype), v.astype(v_cache.dtype),
@@ -724,15 +784,27 @@ def _decoder_layer(
             if decode_bucket >= 1024:
                 attn = _sharded_decode_attend(q, k_cache, v_cache, positions,
                                               stacked_layer_idx, decode_bucket,
-                                              args, mesh, rules)
+                                              args, mesh, rules, sinks=sinks_arr,
+                                              alibi_slopes=alibi_slopes)
             else:
                 sizes = (1,) + k_cache.shape[1:3] + (decode_bucket,
                                                      k_cache.shape[4])
                 start = (stacked_layer_idx, 0, 0, 0, 0)
                 k_att = jax.lax.dynamic_slice(k_cache, start, sizes)[0]
                 v_att = jax.lax.dynamic_slice(v_cache, start, sizes)[0]
+                bias = None
+                if alibi_slopes is not None:
+                    t_q = q.shape[2]
+                    q_pos = (positions[:, None] + jnp.arange(t_q)[None, :]
+                             )[:, None, :, None]
+                    kv_pos = jnp.arange(decode_bucket)[None, None, None, :]
+                    bias = _alibi_bias(alibi_slopes, q_pos, kv_pos)
                 attn = attend(q, k_att.astype(q.dtype), v_att.astype(q.dtype),
-                              mask=mask, scale=args.attention_scale)
+                              mask=mask, scale=args.attention_scale,
+                              logits_soft_cap=args.logits_soft_cap,
+                              sinks=sinks_arr, bias=bias)
+        if _sv_unfold is not None:
+            attn = attn * _sv_unfold.astype(attn.dtype)
         attn = attn.transpose(0, 2, 1, 3).reshape(h.shape[0], h.shape[1], args.q_size)
         attn_out = qapply(attn, lp["wo"])
         if args.lora is not None:
@@ -767,6 +839,8 @@ def _decoder_layer(
     if flash_decoding and positions is not None:
         attn, k_cache, v_cache = _flash_decoding_step(
             q, k, v, k_cache, v_cache, positions, args, mesh, rules)
+        if _sv_unfold is not None:
+            attn = attn * _sv_unfold.astype(attn.dtype)
         attn = attn.transpose(0, 2, 1, 3).reshape(h.shape[0], h.shape[1], args.q_size)
         attn_out = qapply(attn, lp["wo"])
         if args.o_bias:
@@ -845,12 +919,17 @@ def _decoder_layer(
                               mesh, rules, scale=args.attention_scale,
                               window=args.sliding_window)
     elif use_flash and positions is None:
-        attn = _sharded_flash_attention(q, k_att, v_att, args, mesh, rules)
+        attn = _sharded_flash_attention(
+            q, k_att, v_att, args, mesh, rules,
+            sinks=lp.get("sinks") if args.attn_sinks else None,
+            alibi_slopes=alibi_slopes)
     else:
         attn = attend(q, k_att, v_att, mask=mask, scale=args.attention_scale,
                       logits_soft_cap=args.logits_soft_cap,
                       sinks=lp.get("sinks") if args.attn_sinks else None,
                       bias=attn_bias)
+    if _sv_unfold is not None:
+        attn = attn * _sv_unfold.astype(attn.dtype)
     attn = attn.transpose(0, 2, 1, 3).reshape(h.shape[0], h.shape[1], args.q_size)
     attn_out = qapply(attn, lp["wo"])
     if args.lora is not None:
@@ -890,7 +969,7 @@ def _run_stack(params: Params, args: ModelArchArgs, h, cos, sin, mask, cache,
                adapter_ids=None, ring_positions=None, window_row=None,
                capture_layers: Optional[Tuple[int, ...]] = None,
                deepstack: Optional[jnp.ndarray] = None, flash_decoding=False,
-               attn_bias=None):
+               attn_bias=None, alibi_slopes=None):
     """Scan the decoder layers, carrying hidden state, yielding updated cache.
 
     ``capture_layers`` (static layer indices) also collects those layers' OUTPUT
@@ -898,13 +977,21 @@ def _run_stack(params: Params, args: ModelArchArgs, h, cos, sin, mask, cache,
     capture at 3 layers, `models/model_base.py:1429-1432`) — returned as a list of
     (B, S, H) arrays. Selection happens inside the scan with a carried buffer per
     index, so no (L, B, S, H) stack ever materializes."""
+    has_scales = "k_scale" in cache
     xs = (params["layers"], cache["k"], cache["v"],
           jnp.arange(len(jax.tree.leaves(params["layers"])[0])))
+    if has_scales:
+        xs = xs + (cache["k_scale"], cache["v_scale"])
     caps0 = tuple(jnp.zeros_like(h) for _ in (capture_layers or ()))
 
     def body(carry, layer_xs):
         carry_h, caps = carry
-        lp, kc, vc, li = layer_xs
+        if has_scales:
+            lp, kc, vc, li, sk, sv = layer_xs
+            kvs = (sk, sv)
+        else:
+            lp, kc, vc, li = layer_xs
+            kvs = None
         new_h, kc, vc = _decoder_layer(lp, args, carry_h, cos, sin, mask, kc, vc,
                                        positions, decode_bucket, mesh, rules,
                                        use_flash=use_flash, paged=paged,
@@ -913,7 +1000,9 @@ def _run_stack(params: Params, args: ModelArchArgs, h, cos, sin, mask, cache,
                                        ring_positions=ring_positions,
                                        window_row=window_row,
                                        flash_decoding=flash_decoding,
-                                       attn_bias=attn_bias)
+                                       attn_bias=attn_bias,
+                                       alibi_slopes=alibi_slopes,
+                                       kv_scales=kvs)
         if capture_layers:
             caps = tuple(jnp.where(li == idx, new_h, buf)
                          for idx, buf in zip(capture_layers, caps))
@@ -1026,7 +1115,7 @@ def _run_stack_pattern(params: Params, args: ModelArchArgs, h, ctx_full, ctx_sli
 
 def _run_stack_decode_kernel(params: Params, args: ModelArchArgs, h, cos, sin, mask,
                              cache, positions, decode_bucket, mesh, rules,
-                             adapter_ids=None):
+                             adapter_ids=None, alibi_slopes=None):
     """Decode layer scan for the Pallas stacked-cache path.
 
     The cache rides the scan as a CARRY (full stacked arrays, updated in place by the
@@ -1034,13 +1123,19 @@ def _run_stack_decode_kernel(params: Params, args: ModelArchArgs, h, cos, sin, m
     per-layer cache slice (xs) and re-stack (ys) copies the generic _run_stack pays."""
     L = args.num_layers
 
+    has_scales = "k_scale" in cache
+
     def body(carry, xs):
         carry_h, ck, cv = carry
         lp, li = xs
+        kvs = ((jnp.take(cache["k_scale"], li, axis=0),
+                jnp.take(cache["v_scale"], li, axis=0)) if has_scales else None)
         new_h, ck, cv = _decoder_layer(lp, args, carry_h, cos, sin, mask, ck, cv,
                                        positions, decode_bucket, mesh, rules,
                                        adapter_ids=adapter_ids,
-                                       stacked_layer_idx=li)
+                                       stacked_layer_idx=li,
+                                       alibi_slopes=alibi_slopes,
+                                       kv_scales=kvs)
         return (new_h, ck, cv), ()
 
     (h, k_new, v_new), _ = jax.lax.scan(
@@ -1051,7 +1146,7 @@ def _run_stack_decode_kernel(params: Params, args: ModelArchArgs, h, cos, sin, m
 
 def _run_stack_paged_kernel(params: Params, args: ModelArchArgs, h, cos, sin,
                             cache, positions, block_table, slot_mapping, mesh,
-                            rules, adapter_ids=None):
+                            rules, adapter_ids=None, alibi_slopes=None):
     """Decode layer scan for the Pallas ragged paged path (continuous batching).
 
     The paged cache (L, NB, H, BS, D) rides the scan as a CARRY — the block pool is
@@ -1061,13 +1156,18 @@ def _run_stack_paged_kernel(params: Params, args: ModelArchArgs, h, cos, sin,
     (`block_kv_cache_manager.py:268-374` + `attention_base.py:1483-1677`)."""
     L = args.num_layers
 
+    has_scales = "k_scale" in cache
+
     def body(carry, xs):
         carry_h, ck, cv = carry
         lp, li = xs
+        kvs = ((jnp.take(cache["k_scale"], li, axis=0),
+                jnp.take(cache["v_scale"], li, axis=0)) if has_scales else None)
         new_h, ck, cv = _decoder_layer(
             lp, args, carry_h, cos, sin, None, ck, cv, positions, None, mesh,
             rules, adapter_ids=adapter_ids, stacked_layer_idx=li,
-            paged_stacked=(block_table, slot_mapping))
+            paged_stacked=(block_table, slot_mapping), alibi_slopes=alibi_slopes,
+            kv_scales=kvs)
         return (new_h, ck, cv), ()
 
     (h, k_new, v_new), _ = jax.lax.scan(
@@ -1190,7 +1290,9 @@ def prefill_forward(
                      adapter_ids=adapter_ids,
                      ring_positions=position_ids if use_ring else None,
                      capture_layers=capture_layers, deepstack=deepstack,
-                     attn_bias=attn_bias)
+                     attn_bias=attn_bias,
+                     alibi_slopes=params.get("alibi_slopes") if args.alibi
+                     else None)
     h, cache = out[0], out[1]
     h = tap("final_hidden", _norm(h, params["final_norm"], args, params.get("final_norm_b")))
     h_last = jnp.take_along_axis(h, last_token_idx[:, None, None], axis=1)[:, 0]
@@ -1271,15 +1373,17 @@ def decode_forward(
     if use_kernel:
         if tree is not None or window_row is not None:
             raise ValueError("use_kernel supports plain chain decode only")
-        if args.layer_pattern is not None or args.attn_sinks or \
-                args.logits_soft_cap is not None:
-            raise ValueError("use_kernel does not support this architecture")
+        if args.layer_pattern is not None:
+            raise ValueError("use_kernel does not support per-layer attention "
+                             "patterns (rolling sliding caches)")
+        slopes = params.get("alibi_slopes") if args.alibi else None
         if paged is not None:
             # ragged paged serving hot path: Pallas block-table kernels, cache
             # as scan carry (never gathered to the table width)
             h, cache = _run_stack_paged_kernel(
                 params, args, h, cos, sin, cache, position_ids, block_table,
-                slot_mapping, mesh, rules, adapter_ids=adapter_ids)
+                slot_mapping, mesh, rules, adapter_ids=adapter_ids,
+                alibi_slopes=slopes)
             h = _norm(h, params["final_norm"], args, params.get("final_norm_b"))
             logits = _lm_head(params, args, h, mesh, rules)
             if return_hidden:
@@ -1293,7 +1397,7 @@ def decode_forward(
         h, cache = _run_stack_decode_kernel(
             params, args, h, cos, sin, mask_k, cache, positions=position_ids,
             decode_bucket=decode_bucket, mesh=mesh, rules=rules,
-            adapter_ids=adapter_ids)
+            adapter_ids=adapter_ids, alibi_slopes=slopes)
         h = _norm(h, params["final_norm"], args, params.get("final_norm_b"))
         logits = _lm_head(params, args, h, mesh, rules)
         if return_hidden:

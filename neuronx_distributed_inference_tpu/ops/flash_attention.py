@@ -14,7 +14,12 @@ context parallelism (`attention_base.py:88-121,684-713`), and the sliding-window
   (reference `cp_offset`) and the chunked-prefill resume offset use the same mechanism;
 - optional ``sliding_window`` adds the in-window constraint (SWA prefill kernel);
 - causal tiles strictly above the diagonal are predicated off (`@pl.when`), skipping
-  their compute like the reference kernels' trapezoid scheduling.
+  their compute like the reference kernels' trapezoid scheduling;
+- arch extras the reference's new CTE kernel carries (`attention_base.py:88-121`):
+  ``logits_soft_cap`` (gemma tanh cap), per-head learned ``sinks`` (gpt-oss — a
+  virtual softmax-denominator logit, folded in at finalize), and per-head ALiBi
+  ``bias_slopes`` (bloom/mpt — bias computed in-kernel from the position iotas, never
+  materialized as a (S, S) tensor).
 
 Grid: (batch, q_heads, q_blocks, kv_blocks); the innermost kv dimension iterates
 sequentially on-core, carrying running (max, sum, acc) in VMEM scratch.
@@ -35,10 +40,19 @@ DEFAULT_BLOCK_K = 128
 NEG_INF = -1e30
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scratch, l_scratch, acc_scratch, *,
-                  scale: float, q_offset: int, block_q: int, block_k: int,
-                  num_kv_blocks: int, causal: bool, window: Optional[int],
-                  kv_len: int):
+def _flash_kernel(q_ref, k_ref, v_ref, *refs, scale: float, q_offset: int,
+                  block_q: int, block_k: int, num_kv_blocks: int, causal: bool,
+                  window: Optional[int], kv_len: int,
+                  soft_cap: Optional[float], has_sinks: bool, has_slopes: bool):
+    # trailing refs: [sinks?], [slopes?], o_ref, m_scratch, l_scratch, acc_scratch
+    idx = 0
+    sinks_ref = slopes_ref = None
+    if has_sinks:
+        sinks_ref, idx = refs[idx], idx + 1
+    if has_slopes:
+        slopes_ref, idx = refs[idx], idx + 1
+    o_ref, m_scratch, l_scratch, acc_scratch = refs[idx : idx + 4]
+
     qi = pl.program_id(2)
     ki = pl.program_id(3)
 
@@ -70,6 +84,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scratch, l_scratch, acc_scratch,
 
         q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
         kv_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        if slopes_ref is not None:
+            # ALiBi: bias = -slope_h * (q_pos - kv_pos), computed from the iotas
+            s = s - slopes_ref[0, 0] * (q_pos - kv_pos).astype(jnp.float32)
+        if soft_cap is not None:
+            s = soft_cap * jnp.tanh(s / soft_cap)
         mask = kv_pos < kv_len               # hide zero-padded kv columns
         if causal:
             mask = jnp.logical_and(mask, kv_pos <= q_pos)
@@ -97,15 +116,26 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scratch, l_scratch, acc_scratch,
 
     @pl.when(ki == num_kv_blocks - 1)
     def _finalize():
+        m = m_scratch[:, 0:1]
         l = l_scratch[:, 0:1]
+        acc = acc_scratch[:]
+        if sinks_ref is not None:
+            # learned sink: one virtual logit per head in the softmax denominator
+            # only (no V contribution) — fold it in with one extra online-softmax
+            # rescale step
+            sink = sinks_ref[0, 0]
+            m_new = jnp.maximum(m, sink)
+            alpha = jnp.exp(jnp.minimum(m - m_new, 0.0))
+            l = alpha * l + jnp.exp(sink - m_new)
+            acc = acc * alpha
         l_safe = jnp.where(l == 0.0, 1.0, l)   # fully-masked rows -> zeros, not NaN
-        o_ref[0, 0] = (acc_scratch[:] / l_safe).astype(o_ref.dtype)
+        o_ref[0, 0] = (acc / l_safe).astype(o_ref.dtype)
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("causal", "scale", "q_offset", "window", "block_q", "block_k",
-                     "interpret"))
+    static_argnames=("causal", "scale", "q_offset", "window", "soft_cap",
+                     "block_q", "block_k", "interpret"))
 def flash_attention(
     q: jnp.ndarray,              # (B, Hq, Sq, D)
     k: jnp.ndarray,              # (B, Hkv, Skv, D)
@@ -114,6 +144,9 @@ def flash_attention(
     scale: Optional[float] = None,
     q_offset: int = 0,
     window: Optional[int] = None,
+    soft_cap: Optional[float] = None,
+    sinks: Optional[jnp.ndarray] = None,        # (Hq,) learned sink logits
+    alibi_slopes: Optional[jnp.ndarray] = None,  # (Hq,) ALiBi slopes
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
     interpret: bool = False,
@@ -148,19 +181,32 @@ def flash_attention(
     kernel = functools.partial(
         _flash_kernel, scale=scale, q_offset=q_offset, block_q=block_q,
         block_k=block_k, num_kv_blocks=num_kv_blocks, causal=causal, window=window,
-        kv_len=skv)
+        kv_len=skv, soft_cap=soft_cap, has_sinks=sinks is not None,
+        has_slopes=alibi_slopes is not None)
+
+    def _head_scalar_spec():
+        # per-head scalar broadcast over the lane dim: (Hq, 128), one row per cell
+        return pl.BlockSpec((1, 128), lambda bi, hi, qi, ki: (hi, 0))
+
+    in_specs = [
+        pl.BlockSpec((1, 1, block_q, d),
+                     lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        pl.BlockSpec((1, 1, block_k, d),
+                     lambda bi, hi, qi, ki, n_rep=n_rep: (bi, hi // n_rep, ki, 0)),
+        pl.BlockSpec((1, 1, block_k, d),
+                     lambda bi, hi, qi, ki, n_rep=n_rep: (bi, hi // n_rep, ki, 0)),
+    ]
+    operands = [q, k, v]
+    for extra in (sinks, alibi_slopes):
+        if extra is not None:
+            in_specs.append(_head_scalar_spec())
+            operands.append(jnp.broadcast_to(
+                extra.astype(jnp.float32)[:, None], (hq, 128)))
 
     out = pl.pallas_call(
         kernel,
         grid=(b, hq, num_q_blocks, num_kv_blocks),
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, d),
-                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, block_k, d),
-                         lambda bi, hi, qi, ki, n_rep=n_rep: (bi, hi // n_rep, ki, 0)),
-            pl.BlockSpec((1, 1, block_k, d),
-                         lambda bi, hi, qi, ki, n_rep=n_rep: (bi, hi // n_rep, ki, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, block_q, d),
                                lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((b, hq, sq_p, d), q.dtype),
@@ -170,7 +216,7 @@ def flash_attention(
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v)
+    )(*operands)
 
     if sq_p != sq:
         out = out[:, :, :sq, :]

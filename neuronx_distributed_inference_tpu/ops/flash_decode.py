@@ -350,10 +350,21 @@ def write_decode_stacked_kv(
       new_k, new_v, k_cache, v_cache)
 
 
-def _stacked_decode_kernel(pos_ref, lidx_ref, q_ref, k_ref, v_ref, o_ref, m_scratch,
-                           l_scratch, acc_scratch, *, scale: float, block_k: int,
+def _stacked_decode_kernel(pos_ref, lidx_ref, q_ref, k_ref, v_ref, *refs,
+                           scale: float, block_k: int,
                            num_kv_blocks: int, t: int, rows: int, bb: int,
-                           hkv: int, window: Optional[int]):
+                           hkv: int, window: Optional[int],
+                           soft_cap: Optional[float], has_sinks: bool,
+                           has_slopes: bool):
+    # trailing refs: [sinks?], [slopes?], o_ref, m_scratch, l_scratch, acc_scratch
+    idx = 0
+    sinks_ref = slopes_ref = None
+    if has_sinks:
+        sinks_ref, idx = refs[idx], idx + 1
+    if has_slopes:
+        slopes_ref, idx = refs[idx], idx + 1
+    o_ref, m_scratch, l_scratch, acc_scratch = refs[idx : idx + 4]
+
     bi = pl.program_id(0)
     ki = pl.program_id(1)
     k_start = ki * block_k
@@ -387,14 +398,20 @@ def _stacked_decode_kernel(pos_ref, lidx_ref, q_ref, k_ref, v_ref, o_ref, m_scra
             if window is not None:
                 mask = jnp.logical_and(mask, kv_iota > q_pos - window)
             for h in range(hkv):
-                r0 = (j * hkv + h) * rows
                 q = q_ref[j, h]                          # (rows, D)
                 k = k_ref[0, j, h].astype(q.dtype)       # (block_k, D)
                 v = v_ref[0, j, h].astype(q.dtype)
                 s = jax.lax.dot_general(
                     q, k, (((1,), (1,)), ((), ())),
                     preferred_element_type=jnp.float32) * scale
+                if slopes_ref is not None:
+                    # ALiBi: per-row slope (rows grouped by q head, batch-invariant)
+                    s = s - slopes_ref[h * rows : (h + 1) * rows, 0:1] * (
+                        q_pos - kv_iota).astype(jnp.float32)
+                if soft_cap is not None:
+                    s = soft_cap * jnp.tanh(s / soft_cap)
                 s = jnp.where(mask, s, NEG_INF)
+                r0 = (j * hkv + h) * rows
                 m_prev = m_scratch[r0 : r0 + rows, 0:1]
                 l_prev = l_scratch[r0 : r0 + rows, 0:1]
                 m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
@@ -414,15 +431,33 @@ def _stacked_decode_kernel(pos_ref, lidx_ref, q_ref, k_ref, v_ref, o_ref, m_scra
         for j in range(bb):
             for h in range(hkv):
                 r0 = (j * hkv + h) * rows
+                m = m_scratch[r0 : r0 + rows, 0:1]
                 l = l_scratch[r0 : r0 + rows, 0:1]
+                acc = acc_scratch[r0 : r0 + rows]
+                if sinks_ref is not None:
+                    # learned sink: virtual denominator-only logit per q head
+                    sink = sinks_ref[h * rows : (h + 1) * rows, 0:1]
+                    m_new = jnp.maximum(m, sink)
+                    alpha = jnp.exp(jnp.minimum(m - m_new, 0.0))
+                    l = alpha * l + jnp.exp(sink - m_new)
+                    acc = acc * alpha
                 l_safe = jnp.where(l == 0.0, 1.0, l)
-                o_ref[j, h] = (acc_scratch[r0 : r0 + rows] / l_safe
-                               ).astype(o_ref.dtype)
+                o_ref[j, h] = (acc / l_safe).astype(o_ref.dtype)
+
+
+def _group_head_scalars(x: jnp.ndarray, hkv: int, n_rep: int, t: int, rows: int
+                        ) -> jnp.ndarray:
+    """(Hq,) per-q-head scalars -> (Hkv*rows, 128): row r of kv head h holds the
+    scalar of q head ``h*n_rep + r//t`` (the kernels' GQA row grouping)."""
+    grouped = jnp.repeat(x.astype(jnp.float32).reshape(hkv, n_rep), t, axis=1)
+    grouped = jnp.pad(grouped, ((0, 0), (0, rows - n_rep * t)))
+    return jnp.broadcast_to(grouped.reshape(hkv * rows, 1), (hkv * rows, 128))
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("bucket", "scale", "window", "block_k", "interpret"))
+    static_argnames=("bucket", "scale", "window", "soft_cap", "block_k",
+                     "interpret"))
 def flash_decode_attention_stacked(
     q: jnp.ndarray,              # (B, Hq, T, D)
     k_cache: jnp.ndarray,        # (L, B, Hkv, S_max, D) — full stacked cache
@@ -432,6 +467,9 @@ def flash_decode_attention_stacked(
     bucket: int,                 # static attention width (<= S_max)
     scale: Optional[float] = None,
     window: Optional[int] = None,
+    soft_cap: Optional[float] = None,
+    sinks: Optional[jnp.ndarray] = None,         # (Hq,) learned sink logits
+    alibi_slopes: Optional[jnp.ndarray] = None,  # (Hq,) ALiBi slopes
     block_k: int = DEFAULT_BLOCK_K,
     interpret: bool = False,
 ) -> jnp.ndarray:
@@ -439,7 +477,8 @@ def flash_decode_attention_stacked(
 
     Reads only KV tiles at or below each row's position (and the static ``bucket``
     bound); the fresh step's K/V must already be written (write_decode_stacked).
-    Returns (B, Hq, T, D) in q.dtype."""
+    Supports the arch extras of the reference TKG kernels: soft-cap, learned sinks,
+    ALiBi (computed in-kernel). Returns (B, Hq, T, D) in q.dtype."""
     b, hq, t, d = q.shape
     _, _, hkv, s_max, _ = k_cache.shape
     if hq % hkv != 0:
@@ -468,20 +507,29 @@ def flash_decode_attention_stacked(
     kernel = functools.partial(
         _stacked_decode_kernel, scale=scale, block_k=block_k,
         num_kv_blocks=num_kv_blocks, t=t, rows=rows, bb=bb, hkv=hkv,
-        window=window)
+        window=window, soft_cap=soft_cap, has_sinks=sinks is not None,
+        has_slopes=alibi_slopes is not None)
 
     # coarse grid: bb batch rows x ALL kv heads per cell — per-cell work must
     # dominate the fixed per-cell cost or the kernel is overhead-bound
+    in_specs = [
+        pl.BlockSpec((bb, hkv, rows, d), lambda bi, ki, *_: (bi, 0, 0, 0)),
+        pl.BlockSpec((1, bb, hkv, block_k, d),
+                     lambda bi, ki, pos, lidx: (lidx[0], bi, 0, ki, 0)),
+        pl.BlockSpec((1, bb, hkv, block_k, d),
+                     lambda bi, ki, pos, lidx: (lidx[0], bi, 0, ki, 0)),
+    ]
+    operands = [qg, k_cache, v_cache]
+    for extra in (sinks, alibi_slopes):
+        if extra is not None:
+            in_specs.append(
+                pl.BlockSpec((hkv * rows, 128), lambda bi, ki, *_: (0, 0)))
+            operands.append(_group_head_scalars(extra, hkv, n_rep, t, rows))
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b // bb, num_kv_blocks),
-        in_specs=[
-            pl.BlockSpec((bb, hkv, rows, d), lambda bi, ki, *_: (bi, 0, 0, 0)),
-            pl.BlockSpec((1, bb, hkv, block_k, d),
-                         lambda bi, ki, pos, lidx: (lidx[0], bi, 0, ki, 0)),
-            pl.BlockSpec((1, bb, hkv, block_k, d),
-                         lambda bi, ki, pos, lidx: (lidx[0], bi, 0, ki, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((bb, hkv, rows, d), lambda bi, ki, *_: (bi, 0, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((bb * hkv * rows, 128), jnp.float32),
@@ -495,7 +543,7 @@ def flash_decode_attention_stacked(
         out_shape=jax.ShapeDtypeStruct((b, hkv, rows, d), q.dtype),
         interpret=interpret,
     )(positions.astype(jnp.int32), layer_idx.reshape(1).astype(jnp.int32),
-      qg, k_cache, v_cache)
+      *operands)
 
     out = out[:, :, : n_rep * t, :].reshape(b, hkv, n_rep, t, d)
     return out.reshape(b, hq, t, d)

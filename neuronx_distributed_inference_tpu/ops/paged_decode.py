@@ -130,10 +130,20 @@ def write_paged_stacked_kv(
 # --- paged decode attention -----------------------------------------------------------
 
 
-def _paged_attend_kernel(pos_ref, lidx_ref, bt_ref, q_ref, *kv_refs, o_ref=None,
+def _paged_attend_kernel(pos_ref, lidx_ref, bt_ref, q_ref, *refs, o_ref=None,
                          m_scratch=None, l_scratch=None, acc_scratch=None,
                          scale: float, bs: int, kb: int, num_cells: int, t: int,
-                         rows: int, hkv: int, window: Optional[int]):
+                         rows: int, hkv: int, window: Optional[int],
+                         soft_cap: Optional[float], has_sinks: bool,
+                         has_slopes: bool):
+    kv_refs = refs[: 2 * kb]
+    idx = 2 * kb
+    sinks_ref = slopes_ref = None
+    if has_sinks:
+        sinks_ref, idx = refs[idx], idx + 1
+    if has_slopes:
+        slopes_ref, idx = refs[idx], idx + 1
+
     b = pl.program_id(0)
     ci = pl.program_id(1)
     pos = pos_ref[b]
@@ -168,6 +178,11 @@ def _paged_attend_kernel(pos_ref, lidx_ref, bt_ref, q_ref, *kv_refs, o_ref=None,
                 s = jax.lax.dot_general(
                     q, k, (((1,), (1,)), ((), ())),
                     preferred_element_type=jnp.float32) * scale
+                if slopes_ref is not None:
+                    s = s - slopes_ref[r0 : r0 + rows, 0:1] * (
+                        q_pos - kv_pos).astype(jnp.float32)
+                if soft_cap is not None:
+                    s = soft_cap * jnp.tanh(s / soft_cap)
                 s = jnp.where(mask, s, NEG_INF)
                 m_prev = m_scratch[r0 : r0 + rows, 0:1]
                 l_prev = l_scratch[r0 : r0 + rows, 0:1]
@@ -187,14 +202,22 @@ def _paged_attend_kernel(pos_ref, lidx_ref, bt_ref, q_ref, *kv_refs, o_ref=None,
     def _finalize():
         for h in range(hkv):
             r0 = h * rows
+            m = m_scratch[r0 : r0 + rows, 0:1]
             l = l_scratch[r0 : r0 + rows, 0:1]
+            acc = acc_scratch[r0 : r0 + rows]
+            if sinks_ref is not None:
+                sink = sinks_ref[r0 : r0 + rows, 0:1]
+                m_new = jnp.maximum(m, sink)
+                alpha = jnp.exp(jnp.minimum(m - m_new, 0.0))
+                l = alpha * l + jnp.exp(sink - m_new)
+                acc = acc * alpha
             l_safe = jnp.where(l == 0.0, 1.0, l)
-            o_ref[0, h] = (acc_scratch[r0 : r0 + rows] / l_safe).astype(o_ref.dtype)
+            o_ref[0, h] = (acc / l_safe).astype(o_ref.dtype)
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("scale", "window", "blocks_per_cell", "interpret"))
+    static_argnames=("scale", "window", "soft_cap", "blocks_per_cell", "interpret"))
 def paged_decode_attention_stacked(
     q: jnp.ndarray,              # (B, Hq, T, D), T small (1 or speculation width)
     k_cache: jnp.ndarray,        # (L, NB, Hkv, BS, D) — full stacked paged cache
@@ -204,6 +227,9 @@ def paged_decode_attention_stacked(
     block_table: jnp.ndarray,    # (B, MB) int32 physical block ids (logical order)
     scale: Optional[float] = None,
     window: Optional[int] = None,
+    soft_cap: Optional[float] = None,
+    sinks: Optional[jnp.ndarray] = None,         # (Hq,) learned sink logits
+    alibi_slopes: Optional[jnp.ndarray] = None,  # (Hq,) ALiBi slopes
     blocks_per_cell: Optional[int] = None,
     interpret: bool = False,
 ) -> jnp.ndarray:
@@ -260,19 +286,30 @@ def paged_decode_attention_stacked(
 
     kernel = functools.partial(
         _paged_attend_kernel, scale=scale, bs=bs, kb=kb, num_cells=num_cells,
-        t=t, rows=rows, hkv=hkv, window=window)
+        t=t, rows=rows, hkv=hkv, window=window, soft_cap=soft_cap,
+        has_sinks=sinks is not None, has_slopes=alibi_slopes is not None)
+
+    extra_specs, extra_ops = [], []
+    for extra in (sinks, alibi_slopes):
+        if extra is not None:
+            from .flash_decode import _group_head_scalars
+
+            extra_specs.append(
+                pl.BlockSpec((hkv * rows, 128), lambda bi, ci, *_: (0, 0)))
+            extra_ops.append(_group_head_scalars(extra, hkv, n_rep, t, rows))
+    n_extra = len(extra_ops)
 
     def _kernel(pos_ref, lidx_ref, bt_ref, q_ref, *rest):
-        kv_refs = rest[: 2 * kb]
-        o_ref, m_s, l_s, acc_s = rest[2 * kb :]
-        kernel(pos_ref, lidx_ref, bt_ref, q_ref, *kv_refs, o_ref=o_ref,
+        ins = rest[: 2 * kb + n_extra]
+        o_ref, m_s, l_s, acc_s = rest[2 * kb + n_extra :]
+        kernel(pos_ref, lidx_ref, bt_ref, q_ref, *ins, o_ref=o_ref,
                m_scratch=m_s, l_scratch=l_s, acc_scratch=acc_s)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(b, num_cells),
         in_specs=[pl.BlockSpec((1, hkv, rows, d), lambda bi, ci, *_: (bi, 0, 0, 0))]
-        + kv_specs,
+        + kv_specs + extra_specs,
         out_specs=pl.BlockSpec((1, hkv, rows, d), lambda bi, ci, *_: (bi, 0, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((hkv * rows, 128), jnp.float32),
@@ -291,7 +328,7 @@ def paged_decode_attention_stacked(
         interpret=interpret,
     )(positions.astype(jnp.int32), layer_idx.reshape(1).astype(jnp.int32),
       block_table.astype(jnp.int32), qg,
-      *([k_cache, v_cache] * kb))
+      *([k_cache, v_cache] * kb), *extra_ops)
 
     out = out[:, :, : n_rep * t, :].reshape(b, hkv, n_rep, t, d)
     return out.reshape(b, hq, t, d)

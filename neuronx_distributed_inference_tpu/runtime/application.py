@@ -337,14 +337,8 @@ class TpuModelForCausalLM:
         a = self.arch_args
         if self.decode_fn() is not model_base.decode_forward:
             return "custom decode paths"
-        if a.logits_soft_cap is not None:
-            return "logits_soft_cap"
-        if a.attn_sinks:
-            return "attention sinks"
         if a.layer_pattern is not None:
             return "per-layer attention patterns"
-        if a.alibi:
-            return "ALiBi attention bias"
         if a.head_dim % 128 != 0 and jax.default_backend() != "cpu":
             # the KV-write DMA slices the cache's minor dim, which Mosaic requires
             # aligned to the 128-lane tiling (interpret mode on CPU is unconstrained)
@@ -410,21 +404,10 @@ class TpuModelForCausalLM:
         CPU (Pallas needs interpret mode there)."""
         a = self.arch_args
         cfg = self.tpu_config.attention_kernel_enabled
-        unsupported = None
-        if a.logits_soft_cap is not None:
-            unsupported = "logits_soft_cap"
-        elif a.attn_sinks:
-            unsupported = "attention sinks"
-        elif a.alibi:
-            unsupported = "ALiBi attention bias"
+        # soft-cap / sinks / ALiBi are served in-kernel (ops/flash_attention.py,
+        # ≈ the reference's new CTE kernel extras, `attention_base.py:88-121`)
         if cfg is not None:
-            if cfg and unsupported is not None:
-                raise ValueError(
-                    f"attention_kernel_enabled=True but the flash kernel does not "
-                    f"support {unsupported} for this architecture")
             return cfg
-        if unsupported is not None:
-            return False
         if a.num_heads % self.mesh.shape["tp"] != 0:
             return False
         return jax.default_backend() not in ("cpu",)
@@ -545,8 +528,16 @@ class TpuModelForCausalLM:
         self.params = jax.tree_util.tree_map_with_path(_put, host_params, shardings)
 
     # --- cache ------------------------------------------------------------------------
+    def _static_kv_scales_enabled(self) -> bool:
+        q = self.tpu_config.quantization_config
+        return q is not None and q.kv_cache_scale_mode == "static"
+
     def cache_spec(self) -> kvcache.KVCacheSpec:
         a = self.arch_args
+        static = self._static_kv_scales_enabled()
+        if static and a.layer_pattern is not None:
+            raise ValueError("static fp8 KV scales are not supported with "
+                             "per-layer attention patterns (rolling caches) yet")
         return kvcache.KVCacheSpec(
             num_layers=a.num_layers,
             batch_size=self.tpu_config.max_batch_size,
@@ -554,7 +545,19 @@ class TpuModelForCausalLM:
             max_seq_len=self.tpu_config.seq_len,
             head_dim=a.head_dim,
             dtype=self.tpu_config.kv_cache_jax_dtype,
+            static_scales=static,
         )
+
+    def _apply_kv_scales(self, cache):
+        """Overwrite the pytree's σ entries with the calibrated host scales."""
+        if getattr(self, "_kv_scales", None) is None or "k_scale" not in cache:
+            return cache
+        sharding = named_sharding(self.mesh, kvcache.SCALE_LOGICAL,
+                                  self.sharding_rules)
+        cache = dict(cache)
+        cache["k_scale"] = jax.device_put(self._kv_scales[0], sharding)
+        cache["v_scale"] = jax.device_put(self._kv_scales[1], sharding)
+        return cache
 
     def make_paged_cache(self, num_blocks: int, block_size: int):
         """Sharded paged KV cache for continuous batching (overridable by families
@@ -568,12 +571,24 @@ class TpuModelForCausalLM:
             dtype=self.tpu_config.kv_cache_jax_dtype)
         sharding = named_sharding(self.mesh, block_kvcache.PAGED_CACHE_LOGICAL,
                                   self.sharding_rules)
-        return jax.tree.map(lambda x: jax.device_put(x, sharding),
-                            block_kvcache.init_paged_cache(spec))
+        cache = jax.tree.map(lambda x: jax.device_put(x, sharding),
+                             block_kvcache.init_paged_cache(spec))
+        if self._static_kv_scales_enabled():
+            scale_sharding = named_sharding(self.mesh, kvcache.SCALE_LOGICAL,
+                                            self.sharding_rules)
+            cache["k_scale"] = jax.device_put(
+                jnp.ones((a.num_layers, a.num_kv_heads), jnp.float32),
+                scale_sharding)
+            cache["v_scale"] = jax.device_put(
+                jnp.ones((a.num_layers, a.num_kv_heads), jnp.float32),
+                scale_sharding)
+            cache = self._apply_kv_scales(cache)
+        return cache
 
     def reset_cache(self, batch_size: Optional[int] = None) -> None:
         """Fresh zero cache; ``batch_size`` overrides the compiled batch for
-        batch-bucketed requests (see autobucketing.generate_batch_buckets)."""
+        batch-bucketed requests (see autobucketing.generate_batch_buckets).
+        Calibrated static KV scales persist across resets."""
         import dataclasses as _dc
 
         spec = self.cache_spec()
@@ -581,6 +596,8 @@ class TpuModelForCausalLM:
             spec = _dc.replace(spec, batch_size=batch_size)
         sharding = named_sharding(self.mesh, kvcache.CACHE_LOGICAL,
                                   self.sharding_rules)
+        scale_sharding = named_sharding(self.mesh, kvcache.SCALE_LOGICAL,
+                                        self.sharding_rules)
         a = self.arch_args
         if a.layer_pattern is not None:
             # dual-stack cache: rolling window-sized stacks for sliding layers
@@ -588,7 +605,68 @@ class TpuModelForCausalLM:
                                               a.sliding_window or spec.max_seq_len)
         else:
             host = kvcache.init_cache(spec)
-        self.kv_cache = jax.tree.map(lambda x: jax.device_put(x, sharding), host)
+        self.kv_cache = {
+            k: jax.device_put(v, scale_sharding if k.endswith("_scale")
+                              else sharding)
+            for k, v in host.items()}
+        self.kv_cache = self._apply_kv_scales(self.kv_cache)
+
+    def calibrate_kv_scales(self, sample_input_ids: np.ndarray,
+                            attention_mask: Optional[np.ndarray] = None) -> None:
+        """Calibrate per-(layer, kv-head) static fp8 scales from sample prompts.
+
+        Runs ONE full-precision prefill over the samples into a temporary
+        model-dtype cache, takes each (layer, head)'s |K|/|V| max over the written
+        positions, and sets σ = absmax / fp8_max (so outliers land inside the fp8
+        range instead of clipping). Scales persist across `reset_cache`.
+        ≈ reference static-scale fp8 KV calibration (`kv_cache_manager.py` fp8
+        paths)."""
+        import dataclasses as _dc
+
+        import ml_dtypes
+
+        if not self._static_kv_scales_enabled():
+            raise RuntimeError("kv_cache_scale_mode='static' is not enabled")
+        if self.params is None:
+            raise RuntimeError("load weights before calibration")
+        spec = _dc.replace(self.cache_spec(), dtype=self.tpu_config.jax_dtype,
+                           static_scales=False)
+        b = spec.batch_size
+        ids = model_wrapper.to_int32(np.asarray(sample_input_ids))
+        padded = model_wrapper.pad_prefill_inputs(ids, attention_mask,
+                                                  self.cte_buckets, batch_size=b)
+        cache = kvcache.init_cache(spec)
+        n_real = ids.shape[0]
+        precision = "highest" if self.tpu_config.dtype == "float32" else "default"
+
+        def _cal(params, input_ids, position_ids, last, cache):
+            with jax.default_matmul_precision(precision):
+                _, cache = self.prefill_fn()(
+                    params, self.arch_args, input_ids, position_ids, last, cache,
+                    mesh=self.mesh, rules=self.sharding_rules)
+            # per (L, H) absmax over the real rows' written positions
+            valid = (jnp.arange(cache["k"].shape[3])[None, :]
+                     <= last[:n_real, None])[None, :, None, :, None]
+            absmax = []
+            for key in ("k", "v"):
+                x = jnp.abs(cache[key][:, :n_real].astype(jnp.float32))
+                absmax.append(jnp.max(jnp.where(valid, x, 0.0), axis=(1, 3, 4)))
+            return absmax[0], absmax[1]
+
+        k_max, v_max = jax.jit(_cal)(
+            self.params, padded.input_ids, padded.position_ids,
+            padded.last_token_idx, cache)
+        fp8_max = float(ml_dtypes.finfo(
+            self.tpu_config.kv_cache_jax_dtype).max)
+        eps = 1e-6
+        k_scale = np.maximum(np.asarray(k_max) / fp8_max, eps).astype(np.float32)
+        v_scale = np.maximum(np.asarray(v_max) / fp8_max, eps).astype(np.float32)
+        self._kv_scales = (k_scale, v_scale)
+        if self.kv_cache is not None and "k_scale" in self.kv_cache:
+            self.kv_cache = self._apply_kv_scales(self.kv_cache)
+        logger.info("calibrated static KV scales: k in [%.4g, %.4g], "
+                    "v in [%.4g, %.4g]", k_scale.min(), k_scale.max(),
+                    v_scale.min(), v_scale.max())
 
     # --- warmup (≈ `application_base.py:348-372`) -------------------------------------
     def warmup(self) -> None:

@@ -91,3 +91,45 @@ def test_decode_step_wall_clock():
     s = sum(x for x, _ in out.decode_latencies_s)
     n = sum(x for _, x in out.decode_latencies_s)
     assert (s / n) * 1000 < 20.0, f"{s/n*1000:.2f} ms/step for a 4-layer tiny model"
+
+
+def _paged_decode_bytes(kernel, mb, steps=4):
+    """Compiled bytes-accessed of one paged-CB decode chunk at block-table width
+    ``mb``, normalized per step."""
+    from neuronx_distributed_inference_tpu.ops import sampling as sampling_ops
+    from neuronx_distributed_inference_tpu.runtime.continuous_batching import (
+        ContinuousBatchingRunner)
+
+    cfg = TpuConfig(batch_size=8, seq_len=4096, max_context_length=128,
+                    dtype="bfloat16", context_encoding_buckets=[128],
+                    token_generation_buckets=[512],
+                    is_continuous_batching=True, paged_attention_enabled=True,
+                    pa_num_blocks=66, pa_block_size=128,
+                    decode_kernel_enabled=kernel)
+    config = LlamaInferenceConfig(cfg, load_config=load_pretrained_config(HF))
+    app = LlamaForCausalLM(None, config)
+    app.load_random(seed=0)
+    r = ContinuousBatchingRunner(app, decode_chunk=steps)
+    b = 8
+    sp = sampling_ops.prepare_sampling_params(b)
+    lowered = r._decode_step.lower(
+        app.params, jnp.zeros((b,), jnp.int32), jnp.full((b,), 128, jnp.int32),
+        r.cache, jnp.zeros((b, mb), jnp.int32), jnp.zeros((b, steps), jnp.int32),
+        sp, jax.random.PRNGKey(0), num_steps=steps)
+    return float(lowered.compile().cost_analysis()["bytes accessed"]) / steps
+
+
+def test_paged_kernel_bytes_invariant_to_table_width():
+    """The ragged paged kernel's compiled traffic must NOT scale with the block-table
+    width — that is the entire point (reads track live length, not table width; the
+    gather path grows with the table, ~1.3x from MB=4 to MB=32 even on this tiny
+    model). Absolute bytes are NOT comparable between the two paths: XLA charges a
+    pallas custom call's operands (the whole block pool) conservatively, while the
+    kernel's real DMA traffic is the indexed blocks only — so the canary is the
+    scaling, not the level."""
+    kern_4 = _paged_decode_bytes(True, 4)
+    kern_32 = _paged_decode_bytes(True, 32)
+    assert kern_32 <= kern_4 * 1.02, (kern_4, kern_32)
+    gather_4 = _paged_decode_bytes(None, 4)
+    gather_32 = _paged_decode_bytes(None, 32)
+    assert gather_32 > gather_4 * 1.15, (gather_4, gather_32)   # documents the cliff
