@@ -14,8 +14,14 @@ shard/load `lora_checkpoint.py:232-336`). TPU redesign:
   einsums ``(x @ A[ids]) @ B[ids] * scaling`` fused by XLA into the surrounding matmuls.
   Adapter slot 0 is the zero adapter ("no LoRA") by convention, so mixed batches of
   base-model and adapter traffic need no masking.
-- "Static multi-LoRA": all adapters are resident in HBM and traced into the graph
-  (≈ the reference's static mode; dynamic host-side adapter swapping is a later round).
+- Static multi-LoRA: all adapters resident in HBM, traced into the graph.
+- Dynamic multi-LoRA (`DynamicLoraManager`): a host-side store holds ANY number of
+  converted adapters; serving swaps them into the fixed device slots between requests
+  with a tiny jitted slot-update (traced slot index + donated buffers — in-place HBM
+  writes, NO recompilation), LRU-evicting adapters the current batch doesn't need.
+  ≈ the reference's dynamic mode: CPU-side sharded adapter store swapped into device
+  weights at serve time (`lora_checkpoint.py:232-336`, dynamic update
+  `models/model_base.py:3389-3396`).
 """
 
 from __future__ import annotations
@@ -115,6 +121,46 @@ _PEFT_NAME = {
 }
 
 
+def convert_single_peft(sd: Dict[str, np.ndarray], args, spec: LoraSpec,
+                        alpha: Optional[float] = None) -> Dict[str, np.ndarray]:
+    """Convert ONE HF-PEFT adapter checkpoint to per-target stacked host arrays
+    ``{name}_a (L, in, r)`` / ``{name}_b (L, r, out)``.
+
+    PEFT stores ``...layers.{l}.{proj}.lora_A.weight`` as (r, in) and ``lora_B`` as
+    (out, r) (torch Linear layout); both are transposed into the x-@-w layout. The
+    adapter's true ``lora_alpha / rank`` scaling (default = its own rank, i.e.
+    scaling 1.0) is **folded into B**, divided by the runtime ``spec.scaling``
+    applied in `apply_lora`, so adapters with different alphas/ranks serve correctly
+    side by side. Rank < spec.rank zero-pads (padded dims contribute nothing).
+    ≈ reference `lora_checkpoint.py:232-336`."""
+    L, r = args.num_layers, spec.rank
+    stripped = {}
+    for k, v in sd.items():
+        k = k.replace("base_model.model.", "").replace("model.layers.", "layers.")
+        stripped[k] = np.asarray(v)
+    out = {}
+    for name in spec.targets:
+        d_in, d_out = _target_dims(args, name)
+        out[f"{name}_a"] = np.zeros((L, d_in, r), dtype=np.float32)
+        out[f"{name}_b"] = np.zeros((L, r, d_out), dtype=np.float32)
+        proj = _PEFT_NAME[name]
+        for layer in range(L):
+            ka = f"layers.{layer}.{proj}.lora_A.weight"
+            kb = f"layers.{layer}.{proj}.lora_B.weight"
+            if ka not in stripped:
+                continue   # adapter doesn't target this projection/layer
+            a = stripped[ka].T          # (in, r_i)
+            b = stripped[kb].T          # (r_i, out)
+            r_i = a.shape[1]
+            if r_i > r:
+                raise ValueError(f"adapter rank {r_i} exceeds configured max "
+                                 f"rank {r}")
+            true_scaling = (alpha / r_i) if alpha is not None else 1.0
+            out[f"{name}_a"][layer, :, :r_i] = a
+            out[f"{name}_b"][layer, :r_i, :] = b * (true_scaling / spec.scaling)
+    return out
+
+
 def convert_peft_state_dicts(
     adapter_state_dicts: Sequence[Dict[str, np.ndarray]],
     args, spec: LoraSpec,
@@ -123,46 +169,123 @@ def convert_peft_state_dicts(
     """Stack HF-PEFT adapter checkpoints into the multi-LoRA layout.
 
     Adapter ``i`` (0-based) lands in slot ``i + 1`` (slot 0 stays the zero adapter).
-    PEFT stores ``...layers.{l}.{proj}.lora_A.weight`` as (r, in) and ``lora_B`` as
-    (out, r) (torch Linear layout); both are transposed into the x-@-w layout.
-
-    Each adapter's true ``lora_alpha / rank`` scaling (``alphas[i]``, from its
-    adapter_config.json; default = its own rank, i.e. scaling 1.0) is **folded into B**
-    so adapters with different alphas/ranks serve correctly side by side; the folded
-    value is divided by the runtime ``spec.scaling`` applied in `apply_lora`. Adapters
-    with rank < spec.rank are zero-padded (padded dims contribute nothing).
-    ≈ reference `lora_checkpoint.py:232-336`.
+    See `convert_single_peft` for the per-adapter layout/scaling rules.
     """
     if len(adapter_state_dicts) > spec.max_loras:
         raise ValueError(f"{len(adapter_state_dicts)} adapters exceed "
                          f"max_loras={spec.max_loras}")
     params = init_lora_params(args, spec)
     for i, sd in enumerate(adapter_state_dicts):
-        slot = i + 1
-        stripped = {}
-        for k, v in sd.items():
-            k = k.replace("base_model.model.", "").replace("model.layers.", "layers.")
-            stripped[k] = np.asarray(v)
+        one = convert_single_peft(
+            sd, args, spec, alpha=None if alphas is None else alphas[i])
         for name in spec.targets:
-            proj = _PEFT_NAME[name]
-            for layer in range(args.num_layers):
-                ka = f"layers.{layer}.{proj}.lora_A.weight"
-                kb = f"layers.{layer}.{proj}.lora_B.weight"
-                if ka not in stripped:
-                    continue   # adapter doesn't target this projection/layer
-                a = stripped[ka].T          # (in, r_i)
-                b = stripped[kb].T          # (r_i, out)
-                r_i = a.shape[1]
-                if r_i > spec.rank:
-                    raise ValueError(
-                        f"adapter {i} rank {r_i} exceeds configured max rank "
-                        f"{spec.rank}")
-                alpha_i = None if alphas is None else alphas[i]
-                true_scaling = (alpha_i / r_i) if alpha_i is not None else 1.0
-                b = b * (true_scaling / spec.scaling)
-                params[f"{name}_lora_a"][layer, slot, :, :r_i] = a
-                params[f"{name}_lora_b"][layer, slot, :r_i, :] = b
+            params[f"{name}_lora_a"][:, i + 1] = one[f"{name}_a"]
+            params[f"{name}_lora_b"][:, i + 1] = one[f"{name}_b"]
     return params
+
+
+class DynamicLoraManager:
+    """Dynamic multi-LoRA: host-side adapter store + device slot swapper.
+
+    Any number of adapters register on the host; serving calls `adapter_ids()` with
+    the batch's adapter names and gets back per-row slot indices, swapping
+    non-resident adapters into device slots first. The swap is a jitted in-place
+    slot write (traced slot index, donated buffers): ONE compiled updater serves
+    every slot, so swaps never recompile the model. Eviction is LRU among slots the
+    current batch does not need. Slot 0 stays the zero adapter (name=None).
+
+    ≈ reference dynamic multi-LoRA (`lora_checkpoint.py:232-336` CPU-side store,
+    `models/model_base.py:3389-3396` dynamic device update).
+    """
+
+    def __init__(self, app):
+        if app.arch_args.lora is None:
+            raise ValueError("construct the application with lora_serving_config")
+        if app.params is None:
+            raise RuntimeError("load base weights before attaching the manager")
+        self.app = app
+        self.spec: LoraSpec = app.arch_args.lora
+        self.host: Dict[str, Dict[str, np.ndarray]] = {}
+        # slots 1..max_loras; index 0 of this list = slot 1
+        self.slot_names: list = [None] * self.spec.max_loras
+        self.last_used: Dict[str, int] = {}
+        self._tick = 0
+        self.swaps = 0
+        self._installer = None
+
+    # --- host store -------------------------------------------------------------
+    def register(self, name: str, state_dict: Dict[str, np.ndarray],
+                 alpha: Optional[float] = None) -> None:
+        """Convert and store an adapter host-side (no device traffic)."""
+        self.host[name] = convert_single_peft(state_dict, self.app.arch_args,
+                                              self.spec, alpha=alpha)
+
+    def register_path(self, name: str, path: str) -> None:
+        sd, alpha, _rank = load_peft_adapter(path)
+        self.register(name, sd, alpha=alpha)
+
+    def register_host_arrays(self, name: str, arrays: Dict[str, np.ndarray]) -> None:
+        """Store already-converted ``{name}_a``/``{name}_b`` arrays (tests,
+        distilled adapters)."""
+        self.host[name] = arrays
+
+    # --- device swap ------------------------------------------------------------
+    def _build_installer(self):
+        targets = self.spec.targets
+
+        def _install(layers, slot, new):
+            out = dict(layers)
+            for name in targets:
+                out[f"{name}_lora_a"] = out[f"{name}_lora_a"].at[:, slot].set(
+                    new[f"{name}_a"].astype(out[f"{name}_lora_a"].dtype))
+                out[f"{name}_lora_b"] = out[f"{name}_lora_b"].at[:, slot].set(
+                    new[f"{name}_b"].astype(out[f"{name}_lora_b"].dtype))
+            return out
+
+        return jax.jit(_install, donate_argnums=(0,))
+
+    def _install(self, slot: int, name: str) -> None:
+        if self._installer is None:
+            self._installer = self._build_installer()
+        new = {k: jnp.asarray(v) for k, v in self.host[name].items()}
+        params = dict(self.app.params)
+        params["layers"] = self._installer(
+            params["layers"], jnp.asarray(slot, jnp.int32), new)
+        self.app.params = params
+        self.swaps += 1
+
+    def ensure(self, names: Sequence[str]) -> Dict[str, int]:
+        """Make every named adapter resident; returns {name: device slot}."""
+        needed = [n for n in dict.fromkeys(names) if n is not None]
+        unknown = [n for n in needed if n not in self.host]
+        if unknown:
+            raise KeyError(f"adapters not registered: {unknown}")
+        if len(needed) > self.spec.max_loras:
+            raise ValueError(f"batch needs {len(needed)} adapters but only "
+                             f"{self.spec.max_loras} device slots exist")
+        self._tick += 1
+        for n in needed:
+            self.last_used[n] = self._tick
+        for n in needed:
+            if n in self.slot_names:
+                continue
+            # free slot first, else LRU-evict a resident adapter not in this batch
+            if None in self.slot_names:
+                idx = self.slot_names.index(None)
+            else:
+                evictable = [i for i, s in enumerate(self.slot_names)
+                             if s not in needed]
+                idx = min(evictable, key=lambda i: self.last_used.get(
+                    self.slot_names[i], 0))
+            self.slot_names[idx] = n
+            self._install(idx + 1, n)
+        return {n: self.slot_names.index(n) + 1 for n in needed}
+
+    def adapter_ids(self, names_per_row: Sequence[Optional[str]]) -> np.ndarray:
+        """(B,) slot ids for a batch of adapter names (None = base model)."""
+        slots = self.ensure([n for n in names_per_row if n is not None])
+        return np.array([0 if n is None else slots[n] for n in names_per_row],
+                        dtype=np.int32)
 
 
 def load_peft_adapter(path: str):

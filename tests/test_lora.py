@@ -189,3 +189,78 @@ def test_alpha_folding_scales_delta(tiny_llama_hf_config):
     out = app.generate(ids, max_new_tokens=6, adapter_ids=np.array([1, 1]))
     want = plain.generate(ids, max_new_tokens=6)
     np.testing.assert_array_equal(out.tokens, want.tokens)
+
+
+def test_dynamic_lora_swaps_match_merged_weights(tiny_llama_hf_config):
+    """Dynamic multi-LoRA (≈ reference dynamic mode, `lora_checkpoint.py:232-336`,
+    `model_base.py:3389-3396`): 4 registered adapters, 2 device slots. Serving each
+    in turn forces swaps/LRU evictions; every request must match its merged-weight
+    reference exactly, and re-serving a resident adapter must not swap."""
+    from neuronx_distributed_inference_tpu.modules.lora import DynamicLoraManager
+
+    lora_cfg = LoraServingConfig(max_loras=2, max_lora_rank=RANK)
+    config = LlamaInferenceConfig(_tpu_cfg(lora_serving_config=lora_cfg),
+                                  load_config=load_pretrained_config(tiny_llama_hf_config))
+    app = LlamaForCausalLM(None, config)
+    spec = app.arch_args.lora
+    app.load_random(seed=0)
+    mgr = DynamicLoraManager(app)
+    adapters = {f"ad{s}": _peft_state_dict(app.arch_args, seed=10 + s)
+                for s in range(4)}
+    for name, sd in adapters.items():
+        mgr.register(name, sd)
+
+    rng = np.random.default_rng(3)
+    ids = rng.integers(1, 256, size=(2, 10)).astype(np.int32)
+
+    def merged_reference(adapter_sd):
+        plain_cfg = LlamaInferenceConfig(
+            _tpu_cfg(), load_config=load_pretrained_config(tiny_llama_hf_config))
+        plain = LlamaForCausalLM(None, plain_cfg)
+        base = model_base.init_params(plain.arch_args, jax.random.PRNGKey(0),
+                                      dtype=jnp.float32)
+        base = jax.tree.map(lambda x: np.array(x, copy=True), base)
+        for name in TARGETS:
+            for layer in range(plain.arch_args.num_layers):
+                a = adapter_sd[
+                    f"base_model.model.model.layers.{layer}.{_PEFT[name]}.lora_A.weight"].T
+                b = adapter_sd[
+                    f"base_model.model.model.layers.{layer}.{_PEFT[name]}.lora_B.weight"].T
+                base["layers"][name][layer] = merge_adapter(
+                    base["layers"][name][layer], a, b, spec.scaling)
+        plain._put_params(base)
+        return plain.generate(ids, max_new_tokens=8).tokens
+
+    # serve ad0..ad3 then ad0 again: 4 installs + 1 re-install after eviction
+    for name in ("ad0", "ad1", "ad2", "ad3", "ad0"):
+        row_ids = mgr.adapter_ids([name, name])
+        out = app.generate(ids, max_new_tokens=8, adapter_ids=row_ids)
+        np.testing.assert_array_equal(out.tokens, merged_reference(adapters[name]),
+                                      err_msg=f"{name} diverged after swap")
+    assert mgr.swaps == 5          # ad3 evicted LRU ad0; serving ad0 swapped again
+
+    # resident adapters re-serve without swapping
+    before = mgr.swaps
+    row_ids = mgr.adapter_ids(["ad0", "ad0"])
+    assert mgr.swaps == before
+
+    # mixed batch: base row + adapter row
+    row_ids = mgr.adapter_ids([None, "ad0"])
+    assert row_ids[0] == 0 and row_ids[1] >= 1
+
+
+def test_dynamic_lora_overcommitted_batch_rejected(tiny_llama_hf_config):
+    from neuronx_distributed_inference_tpu.modules.lora import DynamicLoraManager
+
+    lora_cfg = LoraServingConfig(max_loras=1, max_lora_rank=RANK)
+    config = LlamaInferenceConfig(_tpu_cfg(lora_serving_config=lora_cfg),
+                                  load_config=load_pretrained_config(tiny_llama_hf_config))
+    app = LlamaForCausalLM(None, config)
+    app.load_random(seed=0)
+    mgr = DynamicLoraManager(app)
+    mgr.register("a", _peft_state_dict(app.arch_args, seed=1))
+    mgr.register("b", _peft_state_dict(app.arch_args, seed=2))
+    with pytest.raises(ValueError, match="device slots"):
+        mgr.adapter_ids(["a", "b"])
+    with pytest.raises(KeyError, match="not registered"):
+        mgr.adapter_ids(["missing"])
