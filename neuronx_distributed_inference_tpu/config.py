@@ -111,11 +111,21 @@ class LoraServingConfig:
 
 @dataclass
 class QuantizationConfig:
-    """Weight/KV quantization knobs."""
+    """Weight/KV quantization knobs.
+
+    ``kv_cache_scale_mode``: "direct" casts K/V straight to the fp8 cache dtype
+    (range-lossy on outlier-heavy KV); "static" stores K/σ_k, V/σ_v with calibrated
+    per-(layer, kv-head) scales riding the cache pytree — σ_k folds into q and σ_v
+    into the attention output, so every attend path (jnp, Pallas dense/paged)
+    serves scaled caches without kernel changes. Calibrate via
+    ``app.calibrate_kv_scales(sample_ids)``. ≈ reference static-scale fp8 KV
+    (`modules/kvcache/kv_cache_manager.py` fp8 paths, `models/config.py:511-515`).
+    """
 
     quantize_weights: bool = False
     weight_dtype: str = "int8"       # int8 | float8_e4m3
     kv_cache_dtype: Optional[str] = None  # None = same as model dtype
+    kv_cache_scale_mode: str = "direct"   # direct | static (fp8 caches only)
 
 
 @dataclass
@@ -212,6 +222,14 @@ class TpuConfig:
                 "dp_degree * tp_degree (batch is sharded over both axes)")
         if self.paged_attention_enabled and self.pa_num_blocks < 1:
             raise ValueError("paged attention requires pa_num_blocks >= 1")
+        q = self.quantization_config
+        if q is not None and q.kv_cache_scale_mode not in ("direct", "static"):
+            raise ValueError("kv_cache_scale_mode must be 'direct' or 'static'")
+        if q is not None and q.kv_cache_scale_mode == "static" and (
+                q.kv_cache_dtype is None
+                or not q.kv_cache_dtype.startswith("float8")):
+            raise ValueError("kv_cache_scale_mode='static' requires an fp8 "
+                             "kv_cache_dtype (e.g. float8_e4m3)")
         if self.on_device_sampling_config is not None:
             self.on_device_sampling_config.validate()
         for cfg, bound, name in (

@@ -35,6 +35,10 @@ KVCache = Dict[str, jnp.ndarray]
 CACHE_LOGICAL = ("layers", "decode_batch", "decode_kv_heads", "kv_seq", None)
 
 
+# logical axes for the optional per-(layer, kv-head) static fp8 scales
+SCALE_LOGICAL = ("layers", "decode_kv_heads")
+
+
 @dataclass(frozen=True)
 class KVCacheSpec:
     num_layers: int
@@ -43,6 +47,9 @@ class KVCacheSpec:
     max_seq_len: int
     head_dim: int
     dtype: jnp.dtype = jnp.bfloat16
+    # static-scale fp8: the cache stores K/σ_k, V/σ_v; σ (L, H_kv) fp32 rides the
+    # pytree (≈ reference static-scale fp8 KV, `kv_cache_manager.py` fp8 paths)
+    static_scales: bool = False
 
     @property
     def shape(self) -> Tuple[int, int, int, int, int]:
@@ -51,10 +58,16 @@ class KVCacheSpec:
 
 
 def init_cache(spec: KVCacheSpec) -> KVCache:
-    return {
+    out = {
         "k": jnp.zeros(spec.shape, dtype=spec.dtype),
         "v": jnp.zeros(spec.shape, dtype=spec.dtype),
     }
+    if spec.static_scales:
+        # distinct buffers: the cache pytree is donated whole, and donating the
+        # same buffer twice is a runtime error
+        out["k_scale"] = jnp.ones((spec.num_layers, spec.num_kv_heads), jnp.float32)
+        out["v_scale"] = jnp.ones((spec.num_layers, spec.num_kv_heads), jnp.float32)
+    return out
 
 
 def cache_bytes(spec: KVCacheSpec) -> int:

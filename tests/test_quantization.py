@@ -146,3 +146,106 @@ def test_quantize_params_scoped_to_known_groups():
     assert set(ql["lm_head"]) == {"q", "s"}
     assert set(ql["layers"]["wq"]) == {"q", "s"}
     assert ql["vision_adapter"]["wq"] == ("embed", "heads")
+
+
+def _fp8_kv_app(tiny_cfg, mode, seed=0, outlier_head=None, outlier_gain=2000.0):
+    """Tiny llama with an fp8 KV cache; optionally inflate one kv head's V
+    projection so its values overflow the e4m3 range (the case static scales fix —
+    V errors flow straight to the attention output, unlike K outliers which
+    saturate the softmax identically with or without clipping)."""
+    import jax
+    import jax.numpy as jnp
+
+    from neuronx_distributed_inference_tpu.config import QuantizationConfig
+    from neuronx_distributed_inference_tpu.models import base as model_base
+
+    qc = (None if mode is None else QuantizationConfig(
+        kv_cache_dtype="float8_e4m3", kv_cache_scale_mode=mode))
+    tpu_cfg = TpuConfig(batch_size=2, seq_len=64, max_context_length=32,
+                        dtype="float32", context_encoding_buckets=[16, 32],
+                        token_generation_buckets=[32, 64],
+                        quantization_config=qc)
+    config = LlamaInferenceConfig(tpu_cfg, load_config=load_pretrained_config(tiny_cfg))
+    app = LlamaForCausalLM(None, config)
+    base = model_base.init_params(app.arch_args, jax.random.PRNGKey(seed),
+                                  dtype=jnp.float32)
+    base = jax.tree.map(lambda x: np.array(x, copy=True), base)
+    if outlier_head is not None:
+        d = app.arch_args.head_dim
+        sl = slice(outlier_head * d, (outlier_head + 1) * d)
+        base["layers"]["wv"][:, :, sl] *= outlier_gain
+    app._put_params(base)
+    return app
+
+
+def test_static_kv_scales_unit_scale_matches_direct(tiny_llama_hf_config):
+    """With σ=1 (uncalibrated), the static-scale plumbing is exactly direct cast."""
+    rng = np.random.default_rng(0)
+    ids = rng.integers(1, 256, size=(2, 10)).astype(np.int32)
+    direct = _fp8_kv_app(tiny_llama_hf_config, "direct").generate(
+        ids, max_new_tokens=8, return_logits=True)
+    static = _fp8_kv_app(tiny_llama_hf_config, "static").generate(
+        ids, max_new_tokens=8, return_logits=True)
+    np.testing.assert_array_equal(static.tokens, direct.tokens)
+    np.testing.assert_allclose(static.logits[0], direct.logits[0],
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_static_kv_scales_beat_direct_cast_on_outliers(tiny_llama_hf_config):
+    """Outlier-heavy V (one kv head's values well beyond the e4m3 max): direct
+    cast clips/NaNs the whole head; calibrated static scales keep it in range. Error is
+    measured against the full-precision-cache reference. ≈ reference static-scale
+    fp8 KV (`models/config.py:511-515` + kv_cache_manager fp8 paths)."""
+    rng = np.random.default_rng(1)
+    ids = rng.integers(1, 256, size=(2, 12)).astype(np.int32)
+
+    ref = _fp8_kv_app(tiny_llama_hf_config, None, outlier_head=1).generate(
+        ids, max_new_tokens=4, return_logits=True)
+    direct = _fp8_kv_app(tiny_llama_hf_config, "direct", outlier_head=1).generate(
+        ids, max_new_tokens=4, return_logits=True)
+    app_s = _fp8_kv_app(tiny_llama_hf_config, "static", outlier_head=1)
+    app_s.calibrate_kv_scales(ids)
+    assert app_s._kv_scales[1].max() > 1.0     # the outlier head got a real scale
+    static = app_s.generate(ids, max_new_tokens=4, return_logits=True)
+
+    def worst(outs):
+        # e4m3 overflow produces NaN logits: count those as infinite error
+        # (python max() would silently skip NaN)
+        return max(float(np.nan_to_num(
+            np.abs(np.asarray(a) - np.asarray(r)).max(), nan=np.inf))
+            for a, r in zip(outs.logits, ref.logits))
+
+    err_direct = worst(direct)
+    err_static = worst(static)
+    assert err_static < err_direct * 0.25, (err_static, err_direct)
+
+    # calibrated scales persist across cache resets
+    before = app_s._kv_scales[0].copy()
+    app_s.reset_cache()
+    np.testing.assert_array_equal(
+        np.asarray(app_s.kv_cache["k_scale"]), before)
+
+
+def test_static_kv_scales_kernel_paths_match_jnp(tiny_llama_hf_config):
+    """The Pallas stacked decode path serves scaled caches through the same q/out
+    scale folds — tokens must match the jnp path with static scales enabled."""
+    rng = np.random.default_rng(2)
+    ids = rng.integers(1, 256, size=(2, 10)).astype(np.int32)
+    outs = {}
+    for kernel in (False, True):
+        from neuronx_distributed_inference_tpu.config import QuantizationConfig
+
+        qc = QuantizationConfig(kv_cache_dtype="float8_e4m3",
+                                kv_cache_scale_mode="static")
+        tpu_cfg = TpuConfig(batch_size=2, seq_len=64, max_context_length=32,
+                            dtype="float32", context_encoding_buckets=[16, 32],
+                            token_generation_buckets=[32, 64],
+                            quantization_config=qc,
+                            decode_kernel_enabled=kernel)
+        config = LlamaInferenceConfig(
+            tpu_cfg, load_config=load_pretrained_config(tiny_llama_hf_config))
+        app = LlamaForCausalLM(None, config)
+        app.load_random(seed=0)
+        app.calibrate_kv_scales(ids)
+        outs[kernel] = app.generate(ids, max_new_tokens=8).tokens
+    np.testing.assert_array_equal(outs[True], outs[False])
