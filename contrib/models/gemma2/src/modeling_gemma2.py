@@ -1,0 +1,110 @@
+"""Gemma 2 on the TPU framework (contrib port).
+
+≈ reference gemma-2 contrib. The Gemma-2 block combines sandwich norms
+(post-attention + pre/post-feedforward), alternating sliding/full attention
+(layer_pattern with rolling sliding caches), attention logit soft-capping, a
+final-logit soft cap, query_pre_attn_scalar attention scaling, zero-centered
+(1+w) RMSNorms, sqrt(hidden) embedding scaling, and tied embeddings. The
+soft-cap rides the Pallas kernels (ops/flash_attention.py).
+"""
+
+from typing import Dict
+
+import numpy as np
+
+from neuronx_distributed_inference_tpu.config import InferenceConfig
+from neuronx_distributed_inference_tpu.models.base import ModelArchArgs
+from neuronx_distributed_inference_tpu.ops import rope as rope_ops
+from neuronx_distributed_inference_tpu.runtime.application import (
+    TpuModelForCausalLM)
+
+
+class Gemma2InferenceConfig(InferenceConfig):
+    REQUIRED_ATTRIBUTES = ("hidden_size", "num_hidden_layers",
+                           "num_attention_heads", "num_key_value_heads",
+                           "vocab_size", "intermediate_size", "head_dim")
+
+    def add_derived_config(self) -> None:
+        for attr, default in (("rope_theta", 10000.0), ("rms_norm_eps", 1e-6),
+                              ("hidden_activation", "gelu_pytorch_tanh"),
+                              ("query_pre_attn_scalar", 256.0),
+                              ("attn_logit_softcapping", 50.0),
+                              ("final_logit_softcapping", 30.0),
+                              ("sliding_window", 4096)):
+            if not hasattr(self, attr) or getattr(self, attr) is None:
+                setattr(self, attr, default)
+
+    def layer_pattern(self):
+        # HF Gemma2Attention: sliding on EVEN layer indices, full on odd
+        if getattr(self, "layer_types", None):
+            return tuple("sliding" if t == "sliding_attention" else "full"
+                         for t in self.layer_types)
+        return tuple("sliding" if i % 2 == 0 else "full"
+                     for i in range(self.num_hidden_layers))
+
+
+class Gemma2ForCausalLM(TpuModelForCausalLM):
+    @classmethod
+    def get_config_cls(cls):
+        return Gemma2InferenceConfig
+
+    @classmethod
+    def arch_args_from_config(cls, config) -> ModelArchArgs:
+        return ModelArchArgs(
+            vocab_size=config.vocab_size,
+            hidden_size=config.hidden_size,
+            num_layers=config.num_hidden_layers,
+            num_heads=config.num_attention_heads,
+            num_kv_heads=config.num_key_value_heads,
+            head_dim=config.head_dim,
+            intermediate_size=config.intermediate_size,
+            rms_norm_eps=config.rms_norm_eps,
+            activation=config.hidden_activation,
+            zero_centered_norms=True,
+            sandwich_norms=True,
+            sliding_window=int(config.sliding_window),
+            layer_pattern=config.layer_pattern(),
+            attention_scale=float(config.query_pre_attn_scalar) ** -0.5,
+            logits_soft_cap=float(config.attn_logit_softcapping),
+            final_logits_soft_cap=float(config.final_logit_softcapping),
+            embedding_multiplier=float(config.hidden_size) ** 0.5,
+            tie_word_embeddings=True,
+        )
+
+    @classmethod
+    def inv_freq_from_config(cls, config) -> np.ndarray:
+        return rope_ops.default_inv_freq(config.head_dim,
+                                         float(config.rope_theta))
+
+    @classmethod
+    def convert_hf_state_dict(cls, state_dict: Dict[str, np.ndarray],
+                              config) -> Dict:
+        def get(name):
+            if name not in state_dict:
+                raise KeyError(f"missing weight {name}")
+            return np.asarray(state_dict[name])
+
+        def lin_t(name):
+            return np.ascontiguousarray(get(name).T)
+
+        layers = {k: [] for k in ("ln1", "ln1_post", "wq", "wk", "wv", "wo",
+                                  "ln2", "ln2_post", "wg", "wu", "wd")}
+        for i in range(config.num_hidden_layers):
+            p = f"model.layers.{i}."
+            layers["wq"].append(lin_t(p + "self_attn.q_proj.weight"))
+            layers["wk"].append(lin_t(p + "self_attn.k_proj.weight"))
+            layers["wv"].append(lin_t(p + "self_attn.v_proj.weight"))
+            layers["wo"].append(lin_t(p + "self_attn.o_proj.weight"))
+            layers["ln1"].append(get(p + "input_layernorm.weight"))
+            layers["ln1_post"].append(get(p + "post_attention_layernorm.weight"))
+            layers["ln2"].append(get(p + "pre_feedforward_layernorm.weight"))
+            layers["ln2_post"].append(get(p + "post_feedforward_layernorm.weight"))
+            layers["wg"].append(lin_t(p + "mlp.gate_proj.weight"))
+            layers["wu"].append(lin_t(p + "mlp.up_proj.weight"))
+            layers["wd"].append(lin_t(p + "mlp.down_proj.weight"))
+        return {
+            "embed": get("model.embed_tokens.weight"),
+            "layers": {k: np.stack(v) for k, v in layers.items()},
+            "final_norm": get("model.norm.weight"),
+            "rope_inv_freq": cls.inv_freq_from_config(config),
+        }

@@ -1,0 +1,100 @@
+"""GLM-4 (glm-4-9b-chat-hf architecture) on the TPU framework (contrib port).
+
+≈ reference `contrib/models/glm-4-9b-chat-hf/`. Llama-like decoder with
+half-width interleaved-pair partial rotary (partial_rotary_factor), QKV biases,
+and a fused gate_up projection in the MLP (split at conversion).
+"""
+
+from typing import Dict
+
+import numpy as np
+
+from neuronx_distributed_inference_tpu.config import InferenceConfig
+from neuronx_distributed_inference_tpu.models.base import ModelArchArgs
+from neuronx_distributed_inference_tpu.ops import rope as rope_ops
+from neuronx_distributed_inference_tpu.runtime.application import (
+    TpuModelForCausalLM)
+
+
+class GlmInferenceConfig(InferenceConfig):
+    REQUIRED_ATTRIBUTES = ("hidden_size", "num_hidden_layers",
+                           "num_attention_heads", "num_key_value_heads",
+                           "vocab_size", "intermediate_size")
+
+    def add_derived_config(self) -> None:
+        for attr, default in (("rope_theta", 10000.0), ("rms_norm_eps", 1e-5),
+                              ("partial_rotary_factor", 0.5),
+                              ("attention_bias", True),
+                              ("tie_word_embeddings", False)):
+            if not hasattr(self, attr) or getattr(self, attr) is None:
+                setattr(self, attr, default)
+        if not hasattr(self, "head_dim") or self.head_dim is None:
+            self.head_dim = self.hidden_size // self.num_attention_heads
+
+
+class GlmForCausalLM(TpuModelForCausalLM):
+    @classmethod
+    def get_config_cls(cls):
+        return GlmInferenceConfig
+
+    @classmethod
+    def arch_args_from_config(cls, config) -> ModelArchArgs:
+        rd = int(config.head_dim * float(config.partial_rotary_factor))
+        return ModelArchArgs(
+            vocab_size=config.vocab_size,
+            hidden_size=config.hidden_size,
+            num_layers=config.num_hidden_layers,
+            num_heads=config.num_attention_heads,
+            num_kv_heads=config.num_key_value_heads,
+            head_dim=config.head_dim,
+            intermediate_size=config.intermediate_size,
+            rms_norm_eps=config.rms_norm_eps,
+            attention_bias=bool(config.attention_bias),
+            rotary_dim=rd,
+            rope_interleaved=True,
+            tie_word_embeddings=bool(config.tie_word_embeddings),
+        )
+
+    @classmethod
+    def inv_freq_from_config(cls, config) -> np.ndarray:
+        rd = int(config.head_dim * float(config.partial_rotary_factor))
+        return rope_ops.default_inv_freq(rd, float(config.rope_theta))
+
+    @classmethod
+    def convert_hf_state_dict(cls, state_dict: Dict[str, np.ndarray],
+                              config) -> Dict:
+        def get(name):
+            if name not in state_dict:
+                raise KeyError(f"missing weight {name}")
+            return np.asarray(state_dict[name])
+
+        def lin_t(name):
+            return np.ascontiguousarray(get(name).T)
+
+        I = config.intermediate_size
+        layers = {k: [] for k in ("ln1", "wq", "wk", "wv", "bq", "bk", "bv",
+                                  "wo", "ln2", "wg", "wu", "wd")}
+        for i in range(config.num_hidden_layers):
+            p = f"model.layers.{i}."
+            layers["wq"].append(lin_t(p + "self_attn.q_proj.weight"))
+            layers["wk"].append(lin_t(p + "self_attn.k_proj.weight"))
+            layers["wv"].append(lin_t(p + "self_attn.v_proj.weight"))
+            layers["bq"].append(get(p + "self_attn.q_proj.bias"))
+            layers["bk"].append(get(p + "self_attn.k_proj.bias"))
+            layers["bv"].append(get(p + "self_attn.v_proj.bias"))
+            layers["wo"].append(lin_t(p + "self_attn.o_proj.weight"))
+            layers["ln1"].append(get(p + "input_layernorm.weight"))
+            layers["ln2"].append(get(p + "post_attention_layernorm.weight"))
+            gate_up = lin_t(p + "mlp.gate_up_proj.weight")   # (H, 2I)
+            layers["wg"].append(gate_up[:, :I])
+            layers["wu"].append(gate_up[:, I:])
+            layers["wd"].append(lin_t(p + "mlp.down_proj.weight"))
+        out = {
+            "embed": get("model.embed_tokens.weight"),
+            "layers": {k: np.stack(v) for k, v in layers.items()},
+            "final_norm": get("model.norm.weight"),
+            "rope_inv_freq": cls.inv_freq_from_config(config),
+        }
+        if not config.tie_word_embeddings:
+            out["lm_head"] = lin_t("lm_head.weight")
+        return out

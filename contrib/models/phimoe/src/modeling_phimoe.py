@@ -1,0 +1,129 @@
+"""Phi-3.5-MoE on the TPU framework (contrib port).
+
+≈ reference `contrib/models/Phi-3.5-MoE-instruct/`. Mixtral-geometry MoE with
+the PhiMoE specifics: biased LayerNorms (not RMSNorm), biased attention/output
+projections, a biased lm_head, and **sparsemixer** routing — two sequential
+argmax picks each weighted by a softmax over its jitter band
+(ops/moe.py router_mode="sparsemixer", inference path of HF `sparsemixer`).
+"""
+
+from typing import Dict
+
+import numpy as np
+
+from neuronx_distributed_inference_tpu.config import InferenceConfig
+from neuronx_distributed_inference_tpu.models.base import ModelArchArgs
+from neuronx_distributed_inference_tpu.ops import rope as rope_ops
+from neuronx_distributed_inference_tpu.ops.moe import MoEArgs
+from neuronx_distributed_inference_tpu.runtime.application import (
+    TpuModelForCausalLM)
+
+
+class PhimoeInferenceConfig(InferenceConfig):
+    REQUIRED_ATTRIBUTES = ("hidden_size", "num_hidden_layers",
+                           "num_attention_heads", "num_key_value_heads",
+                           "vocab_size", "intermediate_size",
+                           "num_local_experts", "num_experts_per_tok")
+
+    def add_derived_config(self) -> None:
+        for attr, default in (("rope_theta", 10000.0), ("rms_norm_eps", 1e-5),
+                              ("router_jitter_noise", 0.01),
+                              ("attention_bias", True), ("lm_head_bias", True)):
+            if not hasattr(self, attr) or getattr(self, attr) is None:
+                setattr(self, attr, default)
+        if not hasattr(self, "head_dim") or self.head_dim is None:
+            self.head_dim = self.hidden_size // self.num_attention_heads
+
+
+class PhimoeForCausalLM(TpuModelForCausalLM):
+    @classmethod
+    def get_config_cls(cls):
+        return PhimoeInferenceConfig
+
+    @classmethod
+    def arch_args_from_config(cls, config) -> ModelArchArgs:
+        return ModelArchArgs(
+            vocab_size=config.vocab_size,
+            hidden_size=config.hidden_size,
+            num_layers=config.num_hidden_layers,
+            num_heads=config.num_attention_heads,
+            num_kv_heads=config.num_key_value_heads,
+            head_dim=config.head_dim,
+            intermediate_size=config.intermediate_size,
+            rms_norm_eps=config.rms_norm_eps,
+            norm_type="layer",
+            norm_bias=True,
+            attention_bias=bool(config.attention_bias),
+            o_bias=bool(config.attention_bias),
+            moe=MoEArgs(num_experts=config.num_local_experts,
+                        experts_per_tok=config.num_experts_per_tok,
+                        router_mode="sparsemixer",
+                        router_jitter=float(config.router_jitter_noise)),
+        )
+
+    def logical_axes(self) -> Dict:
+        from neuronx_distributed_inference_tpu.models import base as model_base
+
+        axes = model_base.param_logical_axes(self.arch_args)
+        axes["lm_head_b"] = ("vocab",)
+        return axes
+
+    def init_random_params(self, key) -> Dict:
+        import jax.numpy as jnp
+
+        params = super().init_random_params(key)
+        params["lm_head_b"] = jnp.zeros((self.arch_args.vocab_size,),
+                                        self.tpu_config.jax_dtype)
+        return params
+
+    @classmethod
+    def inv_freq_from_config(cls, config) -> np.ndarray:
+        return rope_ops.default_inv_freq(config.head_dim, float(config.rope_theta))
+
+    @classmethod
+    def convert_hf_state_dict(cls, state_dict: Dict[str, np.ndarray],
+                              config) -> Dict:
+        def get(name):
+            if name not in state_dict:
+                raise KeyError(f"missing weight {name}")
+            return np.asarray(state_dict[name])
+
+        def lin_t(name):
+            return np.ascontiguousarray(get(name).T)
+
+        E = config.num_local_experts
+        layers = {k: [] for k in ("ln1", "ln1_b", "wq", "wk", "wv",
+                                  "bq", "bk", "bv", "wo", "bo",
+                                  "ln2", "ln2_b", "router", "wg", "wu", "wd")}
+        for i in range(config.num_hidden_layers):
+            p = f"model.layers.{i}."
+            layers["wq"].append(lin_t(p + "self_attn.q_proj.weight"))
+            layers["wk"].append(lin_t(p + "self_attn.k_proj.weight"))
+            layers["wv"].append(lin_t(p + "self_attn.v_proj.weight"))
+            layers["bq"].append(get(p + "self_attn.q_proj.bias"))
+            layers["bk"].append(get(p + "self_attn.k_proj.bias"))
+            layers["bv"].append(get(p + "self_attn.v_proj.bias"))
+            layers["wo"].append(lin_t(p + "self_attn.o_proj.weight"))
+            layers["bo"].append(get(p + "self_attn.o_proj.bias"))
+            layers["ln1"].append(get(p + "input_layernorm.weight"))
+            layers["ln1_b"].append(get(p + "input_layernorm.bias"))
+            layers["ln2"].append(get(p + "post_attention_layernorm.weight"))
+            layers["ln2_b"].append(get(p + "post_attention_layernorm.bias"))
+            m = p + "block_sparse_moe."
+            layers["router"].append(lin_t(m + "gate.weight"))
+            # experts: w1 = gate, w3 = up, w2 = down (Mixtral naming)
+            layers["wg"].append(np.stack(
+                [lin_t(m + f"experts.{e}.w1.weight") for e in range(E)]))
+            layers["wu"].append(np.stack(
+                [lin_t(m + f"experts.{e}.w3.weight") for e in range(E)]))
+            layers["wd"].append(np.stack(
+                [lin_t(m + f"experts.{e}.w2.weight") for e in range(E)]))
+        return {
+            "embed": get("model.embed_tokens.weight"),
+            "layers": {k: np.stack(v) for k, v in layers.items()},
+            "final_norm": get("model.norm.weight"),
+            "final_norm_b": get("model.norm.bias"),
+            "lm_head": lin_t("lm_head.weight"),
+            "lm_head_b": get("lm_head.bias"),
+            "rope_inv_freq": cls.inv_freq_from_config(config),
+        }

@@ -20,6 +20,11 @@ CONTRIB_MODELS = {
     "stablelm": "contrib.models.stablelm.src.modeling_stablelm:StableLmForCausalLM",
     "gemma": "contrib.models.gemma.src.modeling_gemma:GemmaForCausalLM",
     "biogpt": "contrib.models.biogpt.src.modeling_biogpt:BioGptForCausalLM",
+    "granite": "contrib.models.granite.src.modeling_granite:GraniteForCausalLM",
+    "cohere": "contrib.models.cohere.src.modeling_cohere:CohereForCausalLM",
+    "glm": "contrib.models.glm.src.modeling_glm:GlmForCausalLM",
+    "gemma2": "contrib.models.gemma2.src.modeling_gemma2:Gemma2ForCausalLM",
+    "phimoe": "contrib.models.phimoe.src.modeling_phimoe:PhimoeForCausalLM",
 }
 
 for model_type, path in CONTRIB_MODELS.items():

@@ -91,6 +91,11 @@ class ModelArchArgs:
     alibi: bool = False              # ALiBi additive attention bias (bloom/mpt);
     #                                  rope disabled via a zero inv_freq table
     embed_norm: bool = False         # LayerNorm on embeddings (bloom)
+    # --- contrib-arch primitives (round 3: granite/cohere/glm4/gemma2) ---
+    residual_multiplier: float = 1.0  # granite scales each branch before the add
+    logits_scale: float = 1.0         # cohere logit_scale / granite 1/logits_scaling
+    final_logits_soft_cap: Optional[float] = None   # gemma2 final tanh cap
+    rope_interleaved: bool = False    # glm4-style pairwise-interleaved rotary
     # MoE FFN (Mixtral/Qwen3-MoE/DBRX); None = dense MLP. See ops/moe.py.
     moe: Optional["MoEArgs"] = None
     # static multi-LoRA serving (see modules/lora.py); None = disabled
@@ -336,13 +341,27 @@ def _norm(x: jnp.ndarray, weight: jnp.ndarray, args: "ModelArchArgs",
                     zero_centered=args.zero_centered_norms)
 
 
+def _deinterleave_rope(x):
+    """(..., D) pairwise-interleaved layout -> half-split layout: channel order
+    (0, 2, 4, ..., 1, 3, 5, ...), the glm4/deepseek interleaved-rotary convention."""
+    b, h, s, d = x.shape
+    return x.reshape(b, h, s, d // 2, 2).transpose(0, 1, 2, 4, 3).reshape(
+        b, h, s, d)
+
+
 def _apply_rope(args: ModelArchArgs, q, k, cos, sin):
     """Rotary application with optional partial rotary dims (phi/gpt-neox
-    rotary_pct): only the first ``rotary_dim`` channels rotate."""
+    rotary_pct) and optional interleaved-pair channel layout (glm4): only the
+    first ``rotary_dim`` channels rotate."""
     rd = args.rotary_dim
     if rd is None or rd == args.head_dim:
+        if args.rope_interleaved:
+            q, k = _deinterleave_rope(q), _deinterleave_rope(k)
         return rope_ops.apply_rotary(q, k, cos, sin)
-    q1, k1 = rope_ops.apply_rotary(q[..., :rd], k[..., :rd], cos, sin)
+    qr, kr = q[..., :rd], k[..., :rd]
+    if args.rope_interleaved:
+        qr, kr = _deinterleave_rope(qr), _deinterleave_rope(kr)
+    q1, k1 = rope_ops.apply_rotary(qr, kr, cos, sin)
     return (jnp.concatenate([q1, q[..., rd:]], axis=-1),
             jnp.concatenate([k1, k[..., rd:]], axis=-1))
 
@@ -715,6 +734,7 @@ def _decoder_layer(
     # serves scaled caches unchanged. ≈ reference static-scale fp8 KV.
     kv_scales: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
 ):
+    rm = args.residual_multiplier          # granite branch scaling (1.0 = no-op)
     resid = h
     hn = _norm(h, lp["ln1"], args, lp.get("ln1_b"))
     q, k, v = _project_qkv(lp, args, hn, adapter_ids)
@@ -819,10 +839,10 @@ def _decoder_layer(
             mlp_in = (hn if args.shared_ln
                       else _norm(resid, lp["ln2"], args, lp.get("ln2_b")))
             ffn = _mlp(lp, args, mlp_in, mesh, rules, adapter_ids)
-            h = resid + attn_out + constrain(ffn, ("batch", None, None), rules,
+            h = resid + rm * attn_out + rm * constrain(ffn, ("batch", None, None), rules,
                                              mesh=mesh)
             return h, k_cache, v_cache
-        h = resid + attn_out
+        h = resid + rm * attn_out
 
         resid = h
         hn = _norm(h, lp["ln2"], args, lp.get("ln2_b"))
@@ -833,7 +853,7 @@ def _decoder_layer(
         mlp_out = constrain(ffn, ("batch", None, None), rules, mesh=mesh)
         if args.sandwich_norms:
             mlp_out = _norm(mlp_out, lp["ln2_post"], args)
-        h = resid + mlp_out
+        h = resid + rm * mlp_out
         return h, k_cache, v_cache
 
     if flash_decoding and positions is not None:
@@ -846,14 +866,14 @@ def _decoder_layer(
         if args.o_bias:
             attn_out = attn_out + lp["bo"]
         attn_out = constrain(attn_out, ("batch", None, None), rules, mesh=mesh)
-        h = resid + attn_out
+        h = resid + rm * attn_out
         resid = h
         hn = _norm(h, lp["ln2"], args, lp.get("ln2_b"))
         if args.moe is not None:
             ffn = moe_block(lp, args, hn, mesh, rules, _ACTIVATIONS[args.activation])
         else:
             ffn = _mlp(lp, args, hn, mesh, rules, adapter_ids)
-        h = resid + constrain(ffn, ("batch", None, None), rules, mesh=mesh)
+        h = resid + rm * constrain(ffn, ("batch", None, None), rules, mesh=mesh)
         return h, k_cache, v_cache
 
     if paged is not None:
@@ -945,10 +965,10 @@ def _decoder_layer(
         mlp_in = (hn if args.shared_ln
                   else _norm(resid, lp["ln2"], args, lp.get("ln2_b")))
         ffn = _mlp(lp, args, mlp_in, mesh, rules, adapter_ids)
-        h = resid + attn_out + constrain(ffn, ("batch", None, None), rules,
+        h = resid + rm * attn_out + rm * constrain(ffn, ("batch", None, None), rules,
                                          mesh=mesh)
         return h, k_cache, v_cache
-    h = resid + attn_out
+    h = resid + rm * attn_out
 
     resid = h
     hn = _norm(h, lp["ln2"], args, lp.get("ln2_b"))
@@ -959,7 +979,7 @@ def _decoder_layer(
     mlp_out = constrain(ffn, ("batch", None, None), rules, mesh=mesh)
     if args.sandwich_norms:
         mlp_out = _norm(mlp_out, lp["ln2_post"], args)
-    h = resid + mlp_out
+    h = resid + rm * mlp_out
     return h, k_cache, v_cache
 
 
@@ -1190,6 +1210,11 @@ def _lm_head(params: Params, args: ModelArchArgs, h, mesh, rules) -> jnp.ndarray
         logits = qapply(h, params["lm_head"]).astype(jnp.float32)
     if "lm_head_b" in params:           # phi-style biased output head
         logits = logits + params["lm_head_b"].astype(jnp.float32)
+    if args.logits_scale != 1.0:        # cohere logit_scale / granite 1/scaling
+        logits = logits * args.logits_scale
+    if args.final_logits_soft_cap is not None:   # gemma2 final tanh cap
+        cap = args.final_logits_soft_cap
+        logits = cap * jnp.tanh(logits / cap)
     logical = ("batch", "vocab") if logits.ndim == 2 else ("batch", None, "vocab")
     return constrain(logits, logical, rules, mesh=mesh)
 

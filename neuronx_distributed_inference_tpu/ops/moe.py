@@ -64,6 +64,9 @@ class MoEArgs:
     # qwen shared expert is sigmoid-gated from the hidden state; DeepSeek's shared
     # experts are an ungated parallel MLP
     shared_expert_gated: bool = True
+    # PhiMoE sparsemixer routing jitter band (router_mode="sparsemixer"): each
+    # pick's weight is the softmax over experts within 2*jitter of the pick
+    router_jitter: float = 0.01
     router_bias: bool = False            # router logits get a learned bias (gpt-oss)
     expert_bias: bool = False            # expert MLPs have biases (gpt-oss)
     # gpt-oss clamped glu: gate/up clipped at ±limit, act = gate·σ(α·gate), out =
@@ -107,6 +110,40 @@ def route(router_w: jnp.ndarray, x: jnp.ndarray, moe: MoEArgs,
         if moe.norm_topk_prob:
             top_vals = top_vals / (jnp.sum(top_vals, axis=-1, keepdims=True) + 1e-20)
         top_vals = top_vals * moe.routed_scaling_factor
+    elif moe.router_mode == "sparsemixer":
+        # PhiMoE sparsemixer, inference path (HF `modeling_phimoe.sparsemixer`,
+        # training=False): two sequential argmax picks; each pick's weight is the
+        # softmax over the experts inside the 2*jitter threshold band, and the
+        # second pick runs on the scores with the first expert masked out. The
+        # two weights are NOT renormalized against each other.
+        if moe.experts_per_tok != 2:
+            raise ValueError("sparsemixer routing requires experts_per_tok == 2")
+        jitter = 2.0 * moe.router_jitter
+
+        def _pick(cur):
+            m = jnp.max(cur, axis=-1, keepdims=True)
+            factor = jnp.maximum(jnp.abs(logits), m)    # |original| clamped at max
+            band_mask = ((m - cur) / factor) > jitter
+            gated = jnp.where(band_mask, -jnp.inf, cur)
+            sel = jnp.argmax(cur, axis=-1)
+            w = jnp.take_along_axis(jax.nn.softmax(gated, axis=-1),
+                                    sel[:, None], axis=1)[:, 0]
+            return sel, w
+
+        sel1, w1 = _pick(logits)
+        masked = jnp.where(jax.nn.one_hot(sel1, moe.num_experts, dtype=bool),
+                           -jnp.inf, logits)
+        # HF quirk: the second threshold band compares the masked max against the
+        # ORIGINAL scores, then applies the mask to the masked scores
+        m2 = jnp.max(masked, axis=-1, keepdims=True)
+        factor2 = jnp.maximum(jnp.abs(logits), m2)
+        band2 = ((m2 - logits) / factor2) > jitter
+        gated2 = jnp.where(band2, -jnp.inf, masked)
+        sel2 = jnp.argmax(masked, axis=-1)
+        w2 = jnp.take_along_axis(jax.nn.softmax(gated2, axis=-1),
+                                 sel2[:, None], axis=1)[:, 0]
+        top_idx = jnp.stack([sel1, sel2], axis=-1)
+        top_vals = jnp.stack([w1, w2], axis=-1)
     elif moe.router_mode == "topk_sigmoid":
         top_vals, top_idx = jax.lax.top_k(logits, moe.experts_per_tok)
         top_vals = jax.nn.sigmoid(top_vals)

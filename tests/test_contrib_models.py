@@ -229,3 +229,81 @@ def test_biogpt_parity():
     # sqrt(hidden) embedding scaling amplifies the (benign) score-scaling-order
     # difference; greedy tokens still match exactly
     _run_parity(BioGptForCausalLM, hf, cfg, atol=5e-3, rtol=5e-3)
+
+
+def test_granite_parity():
+    from transformers import GraniteConfig, GraniteForCausalLM as HFGranite
+
+    from contrib.models.granite.src.modeling_granite import GraniteForCausalLM
+
+    cfg = GraniteConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
+                        num_hidden_layers=2, num_attention_heads=4,
+                        num_key_value_heads=2, embedding_multiplier=12.0,
+                        attention_multiplier=0.015625, residual_multiplier=0.22,
+                        logits_scaling=16.0, tie_word_embeddings=False)
+    torch.manual_seed(0)
+    hf = HFGranite(cfg).eval()
+    _run_parity(GraniteForCausalLM, hf, cfg)
+
+
+def test_cohere_parity():
+    from transformers import CohereConfig, CohereForCausalLM as HFCohere
+
+    from contrib.models.cohere.src.modeling_cohere import CohereForCausalLM
+
+    cfg = CohereConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
+                       num_hidden_layers=2, num_attention_heads=4,
+                       num_key_value_heads=2, logit_scale=0.25,
+                       use_qk_norm=False, tie_word_embeddings=True)
+    torch.manual_seed(0)
+    hf = HFCohere(cfg).eval()
+    _run_parity(CohereForCausalLM, hf, cfg)
+
+
+def test_glm_parity():
+    from transformers import GlmConfig, GlmForCausalLM as HFGlm
+
+    from contrib.models.glm.src.modeling_glm import GlmForCausalLM
+
+    cfg = GlmConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
+                    num_hidden_layers=2, num_attention_heads=4,
+                    num_key_value_heads=2, head_dim=16,
+                    partial_rotary_factor=0.5, attention_bias=True,
+                    pad_token_id=0, eos_token_id=2,
+                    tie_word_embeddings=False)
+    torch.manual_seed(0)
+    hf = HFGlm(cfg).eval()
+    _run_parity(GlmForCausalLM, hf, cfg)
+
+
+def test_gemma2_parity():
+    from transformers import Gemma2Config, Gemma2ForCausalLM as HFGemma2
+
+    from contrib.models.gemma2.src.modeling_gemma2 import Gemma2ForCausalLM
+
+    cfg = Gemma2Config(vocab_size=256, hidden_size=64, intermediate_size=128,
+                       num_hidden_layers=4, num_attention_heads=4,
+                       num_key_value_heads=2, head_dim=16,
+                       query_pre_attn_scalar=16.0,
+                       attn_logit_softcapping=30.0, final_logit_softcapping=20.0,
+                       sliding_window=16)
+    torch.manual_seed(0)
+    hf = HFGemma2(cfg).eval()
+    _run_parity(Gemma2ForCausalLM, hf, cfg, atol=2e-3, rtol=1e-3)
+
+
+def test_phimoe_parity():
+    from transformers import PhimoeConfig, PhimoeForCausalLM as HFPhimoe
+
+    from contrib.models.phimoe.src.modeling_phimoe import PhimoeForCausalLM
+
+    cfg = PhimoeConfig(vocab_size=256, hidden_size=64, intermediate_size=96,
+                       num_hidden_layers=2, num_attention_heads=4,
+                       num_key_value_heads=2, num_local_experts=4,
+                       num_experts_per_tok=2, router_jitter_noise=0.01,
+                       attention_bias=True, lm_head_bias=True,
+                       pad_token_id=0, rope_scaling=None,
+                       sliding_window=None, tie_word_embeddings=False)
+    torch.manual_seed(0)
+    hf = HFPhimoe(cfg).eval()
+    _run_parity(PhimoeForCausalLM, hf, cfg, atol=1e-3, rtol=1e-3)
