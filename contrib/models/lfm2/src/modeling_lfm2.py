@@ -1,0 +1,315 @@
+"""LFM2 (Liquid) on the TPU framework (contrib port).
+
+≈ reference `contrib/models/lfm2-2.6b/`. A conv/attention hybrid: most layers
+are gated short-convolution blocks (in_proj -> B·x through a depthwise causal
+conv of width L_cache, gated by C, out_proj) whose per-layer state is the last
+L_cache gated inputs — not a KV cache; the sparse full-attention layers use
+per-head RMSNorm on q AND k (qk-norm). The hybrid cache pytree carries a
+(L_conv, B, L_cache, H) conv tail next to the attention layers' stacked KV.
+Prefill computes the causal conv as a width-static sum of shifted slices (the
+kernel is tiny); right padding gathers each row's last L_cache real inputs so
+decode resumes exactly at the true length.
+"""
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neuronx_distributed_inference_tpu.config import InferenceConfig
+from neuronx_distributed_inference_tpu.models.base import (
+    ModelArchArgs, causal_mask)
+from neuronx_distributed_inference_tpu.ops import rope as rope_ops
+from neuronx_distributed_inference_tpu.ops.attention import attend
+from neuronx_distributed_inference_tpu.ops.norms import rms_norm
+from neuronx_distributed_inference_tpu.runtime.application import (
+    TpuModelForCausalLM)
+
+
+@dataclass(frozen=True)
+class Lfm2ArchArgs(ModelArchArgs):
+    conv_l_cache: int = 3
+    block_types: Tuple[str, ...] = ()    # per-layer "conv" | "full_attention"
+
+
+def _conv_block_prefill(lp, hn, last_token_idx, args):
+    """Gated short conv over the full sequence; returns (out, conv_state)."""
+    L = args.conv_l_cache
+    bcx = hn @ lp["w_in"]                                  # (B, S, 3H)
+    H = hn.shape[-1]
+    b_g, c_g, x = bcx[..., :H], bcx[..., H : 2 * H], bcx[..., 2 * H :]
+    bx = b_g * x
+
+    s = x.shape[1]
+    # decode tail: the last L real gated inputs per row (zeros if shorter)
+    idx = last_token_idx[:, None] + 1 - L + jnp.arange(L)[None, :]
+    gathered = jnp.take_along_axis(bx, jnp.clip(idx, 0, s - 1)[:, :, None], axis=1)
+    conv_state = jnp.where((idx >= 0)[:, :, None], gathered, 0.0)
+
+    xp = jnp.pad(bx, ((0, 0), (L - 1, 0), (0, 0)))
+    conv = sum(xp[:, j : j + s, :] * lp["conv_w"][j][None, None, :]
+               for j in range(L))
+    y = c_g * conv
+    return y @ lp["w_out"], conv_state
+
+
+def _conv_block_decode(lp, hn, conv_state, args):
+    """One-token conv step; conv_state (B, L, H) holds the last L gated inputs."""
+    bcx = hn @ lp["w_in"]                                  # (B, 1, 3H)
+    H = hn.shape[-1]
+    b_g, c_g, x = bcx[..., :H], bcx[..., H : 2 * H], bcx[..., 2 * H :]
+    bx = (b_g * x)[:, 0]                                   # (B, H)
+    state = jnp.concatenate([conv_state[:, 1:], bx[:, None, :]], axis=1)
+    conv = jnp.sum(state * lp["conv_w"][None, :, :], axis=1)   # (B, H)
+    y = c_g * conv[:, None, :]
+    return y @ lp["w_out"], state
+
+
+def _attn_block(lp, hn, cos, sin, mask, k_cache, v_cache, positions, bucket, args):
+    b, s, _ = hn.shape
+    q = (hn @ lp["wq"]).reshape(b, s, args.num_heads, args.head_dim)
+    k = (hn @ lp["wk"]).reshape(b, s, args.num_kv_heads, args.head_dim)
+    v = (hn @ lp["wv"]).reshape(b, s, args.num_kv_heads, args.head_dim)
+    # per-head RMSNorm on q and k (applied before the head transpose, HF order)
+    q = rms_norm(q, lp["q_norm"], args.rms_norm_eps).transpose(0, 2, 1, 3)
+    k = rms_norm(k, lp["k_norm"], args.rms_norm_eps).transpose(0, 2, 1, 3)
+    v = v.transpose(0, 2, 1, 3)
+    q, k = rope_ops.apply_rotary(q, k, cos, sin)
+
+    if positions is None:
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k.astype(k_cache.dtype), (0, 0, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v.astype(v_cache.dtype), (0, 0, 0, 0))
+        k_att, v_att = k, v
+    else:
+        def _one(row_c, row_n, p):
+            return jax.lax.dynamic_update_slice(
+                row_c, row_n.astype(row_c.dtype), (0, p, 0))
+
+        k_cache = jax.vmap(_one)(k_cache, k, positions)
+        v_cache = jax.vmap(_one)(v_cache, v, positions)
+        k_att = jax.lax.slice_in_dim(k_cache, 0, bucket, axis=2).astype(q.dtype)
+        v_att = jax.lax.slice_in_dim(v_cache, 0, bucket, axis=2).astype(q.dtype)
+
+    attn = attend(q, k_att, v_att, mask=mask)
+    attn = attn.transpose(0, 2, 1, 3).reshape(b, s, args.q_size)
+    return attn @ lp["wo"], k_cache, v_cache
+
+
+def _mlp(lp, hn):
+    return (jax.nn.silu(hn @ lp["wg"]) * (hn @ lp["wu"])) @ lp["wd"]
+
+
+def _forward(params, args: Lfm2ArchArgs, h, cos, sin, mask, cache, positions,
+             decode_bucket, last_token_idx):
+    ks, vs, convs = [], [], []
+    ai = ci = 0
+    for li, kind in enumerate(args.block_types):
+        lp = jax.tree.map(lambda p: p[li], params["layers"])
+        hn = rms_norm(h, lp["ln1"], args.rms_norm_eps)
+        if kind == "full_attention":
+            out, kc, vc = _attn_block(lp, hn, cos, sin, mask, cache["k"][ai],
+                                      cache["v"][ai], positions, decode_bucket,
+                                      args)
+            ks.append(kc)
+            vs.append(vc)
+            ai += 1
+        elif positions is None:
+            out, conv_state = _conv_block_prefill(lp, hn, last_token_idx, args)
+            convs.append(conv_state)
+            ci += 1
+        else:
+            out, conv_state = _conv_block_decode(lp, hn, cache["conv"][ci], args)
+            convs.append(conv_state)
+            ci += 1
+        h = h + out
+        h = h + _mlp_in(lp, h, args)
+    h = rms_norm(h, params["final_norm"], args.rms_norm_eps)
+    out_cache = {"k": jnp.stack(ks), "v": jnp.stack(vs),
+                 "conv": jnp.stack(convs)}
+    return h, out_cache
+
+
+def _mlp_in(lp, h, args):
+    return _mlp(lp, rms_norm(h, lp["ln2"], args.rms_norm_eps))
+
+
+def prefill_forward(params, args: Lfm2ArchArgs, input_ids, position_ids,
+                    last_token_idx, cache, mesh=None, rules=None, use_flash=False,
+                    adapter_ids=None, use_ring=False, return_hidden=False):
+    h = jnp.take(params["embed"], input_ids, axis=0)
+    s = input_ids.shape[1]
+    cos, sin = rope_ops.compute_cos_sin(params["rope_inv_freq"], position_ids)
+    mask = (position_ids[:, None, :, None] >= position_ids[:, None, None, :])
+    mask &= causal_mask(s, s)[None, None]
+    h, out_cache = _forward(params, args, h, cos, sin, mask, cache, None, None,
+                            last_token_idx)
+    h_last = jnp.take_along_axis(h, last_token_idx[:, None, None], axis=1)[:, 0]
+    logits = (h_last @ params["embed"].T).astype(jnp.float32)
+    if return_hidden:
+        return logits, out_cache, h
+    return logits, out_cache
+
+
+def decode_forward(params, args: Lfm2ArchArgs, input_ids, position_ids, cache,
+                   decode_bucket, mesh=None, rules=None, adapter_ids=None,
+                   tree=None, return_hidden=False, **_ignored):
+    if input_ids.shape[1] != 1 or tree is not None:
+        raise ValueError("LFM2 decode is single-token only (the conv state "
+                         "carries one tail per row)")
+    h = jnp.take(params["embed"], input_ids, axis=0)
+    pos_grid = position_ids[:, None]
+    cos, sin = rope_ops.compute_cos_sin(params["rope_inv_freq"], pos_grid)
+    kv_pos = jnp.arange(decode_bucket)[None, None, None, :]
+    mask = kv_pos <= pos_grid[:, None, :, None]
+    h, out_cache = _forward(params, args, h, cos, sin, mask, cache,
+                            position_ids, decode_bucket, None)
+    logits = (h @ params["embed"].T).astype(jnp.float32)
+    if return_hidden:
+        return logits, out_cache, h
+    return logits, out_cache
+
+
+class Lfm2InferenceConfig(InferenceConfig):
+    REQUIRED_ATTRIBUTES = ("hidden_size", "num_hidden_layers",
+                           "num_attention_heads", "num_key_value_heads",
+                           "vocab_size", "intermediate_size", "layer_types")
+
+    def add_derived_config(self) -> None:
+        for attr, default in (("rope_theta", 1000000.0), ("norm_eps", 1e-5),
+                              ("conv_L_cache", 3), ("conv_bias", False)):
+            if not hasattr(self, attr) or getattr(self, attr) is None:
+                setattr(self, attr, default)
+        if not hasattr(self, "head_dim") or self.head_dim is None:
+            self.head_dim = self.hidden_size // self.num_attention_heads
+
+
+class Lfm2ForCausalLM(TpuModelForCausalLM):
+    def __init__(self, model_path, config, mesh=None):
+        self._require_base_layout(config.tpu_config, "LFM2 (conv hybrid)")
+        if getattr(config, "conv_bias", False):
+            raise ValueError("conv_bias=True is not ported yet")
+        super().__init__(model_path, config, mesh=mesh)
+
+    @classmethod
+    def get_config_cls(cls):
+        return Lfm2InferenceConfig
+
+    @classmethod
+    def arch_args_from_config(cls, config) -> Lfm2ArchArgs:
+        return Lfm2ArchArgs(
+            vocab_size=config.vocab_size,
+            hidden_size=config.hidden_size,
+            num_layers=config.num_hidden_layers,
+            num_heads=config.num_attention_heads,
+            num_kv_heads=config.num_key_value_heads,
+            head_dim=config.head_dim,
+            intermediate_size=config.intermediate_size,
+            rms_norm_eps=config.norm_eps,
+            qk_norm=True,
+            tie_word_embeddings=True,
+            conv_l_cache=int(config.conv_L_cache),
+            block_types=tuple(config.layer_types),
+        )
+
+    def prefill_fn(self):
+        return prefill_forward
+
+    def decode_fn(self):
+        return decode_forward
+
+    @classmethod
+    def inv_freq_from_config(cls, config) -> np.ndarray:
+        return rope_ops.default_inv_freq(config.head_dim, float(config.rope_theta))
+
+    def reset_cache(self, batch_size: Optional[int] = None) -> None:
+        a: Lfm2ArchArgs = self.arch_args
+        b = batch_size or self.tpu_config.max_batch_size
+        n_att = sum(1 for k in a.block_types if k == "full_attention")
+        n_conv = len(a.block_types) - n_att
+        dt = self.tpu_config.jax_dtype
+        self.kv_cache = {
+            "k": jnp.zeros((max(n_att, 1), b, a.num_kv_heads,
+                            self.tpu_config.seq_len, a.head_dim), dt),
+            "v": jnp.zeros((max(n_att, 1), b, a.num_kv_heads,
+                            self.tpu_config.seq_len, a.head_dim), dt),
+            "conv": jnp.zeros((max(n_conv, 1), b, a.conv_l_cache,
+                               a.hidden_size), dt),
+        }
+
+    def _put_params(self, host_params) -> None:
+        dtype = self.tpu_config.jax_dtype
+
+        def _put(x):
+            arr = np.asarray(x)
+            if arr.dtype.kind == "f":
+                arr = arr.astype(dtype)
+            return jax.device_put(arr)
+
+        params = jax.tree.map(_put, host_params)
+        params["rope_inv_freq"] = jax.device_put(
+            np.asarray(host_params["rope_inv_freq"], np.float32))
+        self.params = params
+        self.reset_cache()
+
+    def init_random_params(self, key):
+        raise NotImplementedError("load from an HF checkpoint or state dict")
+
+    @classmethod
+    def convert_hf_state_dict(cls, state_dict: Dict[str, np.ndarray],
+                              config) -> Dict:
+        def get(name):
+            if name not in state_dict:
+                raise KeyError(f"missing weight {name}")
+            return np.asarray(state_dict[name])
+
+        def lin_t(name):
+            return np.ascontiguousarray(get(name).T)
+
+        H = config.hidden_size
+        hd = config.head_dim
+        zeros = {
+            "wq": np.zeros((H, config.num_attention_heads * hd), np.float32),
+            "wk": np.zeros((H, config.num_key_value_heads * hd), np.float32),
+            "wv": np.zeros((H, config.num_key_value_heads * hd), np.float32),
+            "wo": np.zeros((config.num_attention_heads * hd, H), np.float32),
+            "q_norm": np.zeros((hd,), np.float32),
+            "k_norm": np.zeros((hd,), np.float32),
+            "w_in": np.zeros((H, 3 * H), np.float32),
+            "w_out": np.zeros((H, H), np.float32),
+            "conv_w": np.zeros((config.conv_L_cache, H), np.float32),
+        }
+        layers: Dict[str, list] = {k: [] for k in
+                                   list(zeros) + ["ln1", "ln2", "wg", "wu", "wd"]}
+        for i in range(config.num_hidden_layers):
+            p = f"model.layers.{i}."
+            layers["ln1"].append(get(p + "operator_norm.weight"))
+            layers["ln2"].append(get(p + "ffn_norm.weight"))
+            layers["wg"].append(lin_t(p + "feed_forward.w1.weight"))
+            layers["wu"].append(lin_t(p + "feed_forward.w3.weight"))
+            layers["wd"].append(lin_t(p + "feed_forward.w2.weight"))
+            filled = dict(zeros)
+            if config.layer_types[i] == "full_attention":
+                filled["wq"] = lin_t(p + "self_attn.q_proj.weight")
+                filled["wk"] = lin_t(p + "self_attn.k_proj.weight")
+                filled["wv"] = lin_t(p + "self_attn.v_proj.weight")
+                filled["wo"] = lin_t(p + "self_attn.out_proj.weight")
+                filled["q_norm"] = get(p + "self_attn.q_layernorm.weight")
+                filled["k_norm"] = get(p + "self_attn.k_layernorm.weight")
+            else:
+                filled["w_in"] = lin_t(p + "conv.in_proj.weight")
+                filled["w_out"] = lin_t(p + "conv.out_proj.weight")
+                # HF conv (H, 1, L): tap j multiplies x[t - (L-1) + j]
+                filled["conv_w"] = np.ascontiguousarray(
+                    get(p + "conv.conv.weight")[:, 0, :].T)
+            for k, v in filled.items():
+                layers[k].append(v)
+        return {
+            "embed": get("model.embed_tokens.weight"),
+            "layers": {k: np.stack(v) for k, v in layers.items()},
+            "final_norm": get("model.embedding_norm.weight"),
+            "rope_inv_freq": cls.inv_freq_from_config(config),
+        }

@@ -1,0 +1,185 @@
+"""LLaVA 1.5 (CLIP tower + Llama) on the TPU framework (contrib port).
+
+≈ reference `contrib/models/llava-v1.5-7b/`. Rides the shared multimodal base
+(runtime/image_to_text.py: separate jitted vision encoder, features scattered at
+image-token positions of the padded prompt, merged into the CTE embedding —
+≈ reference image-to-text pipelined vision→CTE, `models/image_to_text_model_base.py`).
+The tower here is CLIP ViT: patch conv + CLS + learned positions, pre-LN,
+biased attention/MLP with quick-GELU, features taken at hidden layer
+``vision_feature_layer`` (default -2) with the CLS row dropped
+("default" select strategy), then the 2-layer GELU projector.
+"""
+
+import functools
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neuronx_distributed_inference_tpu.models.llama.modeling_llama import (
+    LlamaForCausalLM, LlamaInferenceConfig)
+from neuronx_distributed_inference_tpu.ops.attention import attend
+from neuronx_distributed_inference_tpu.ops.norms import layer_norm
+from neuronx_distributed_inference_tpu.runtime.image_to_text import (
+    ImageToTextInferenceConfig, TpuModelForImageToText)
+
+
+def _quick_gelu(x):
+    return x * jax.nn.sigmoid(1.702 * x)
+
+
+def clip_vision_encode(vp: Dict[str, Any], pixel_values: jnp.ndarray, *,
+                       patch_size: int, num_heads: int, eps: float,
+                       drop_cls: bool) -> jnp.ndarray:
+    """(N, C, H, W) -> (N, T_img, H_text) CLIP ViT features through the projector."""
+    n, c, hh, ww = pixel_values.shape
+    gh, gw = hh // patch_size, ww // patch_size
+    # patch conv as an unfold + matmul (stride == kernel == patch_size)
+    x = pixel_values.reshape(n, c, gh, patch_size, gw, patch_size)
+    x = x.transpose(0, 2, 4, 1, 3, 5).reshape(n, gh * gw, -1)
+    h = x @ vp["patch_w"]                                   # (N, T, H_vis)
+    cls = jnp.broadcast_to(vp["cls"][None, None, :], (n, 1, h.shape[-1]))
+    h = jnp.concatenate([cls, h], axis=1)
+    h = h + vp["pos_embed"][None]
+    h = layer_norm(h, vp["ln_pre"], vp["ln_pre_b"], eps=eps)
+
+    d = h.shape[-1] // num_heads
+
+    def layer(carry, lp):
+        hh = carry
+        x = layer_norm(hh, lp["ln1"], lp["ln1_b"], eps=eps)
+        b, s, _ = x.shape
+        q = (x @ lp["wq"] + lp["bq"]).reshape(b, s, num_heads, d).transpose(0, 2, 1, 3)
+        k = (x @ lp["wk"] + lp["bk"]).reshape(b, s, num_heads, d).transpose(0, 2, 1, 3)
+        v = (x @ lp["wv"] + lp["bv"]).reshape(b, s, num_heads, d).transpose(0, 2, 1, 3)
+        a = attend(q, k, v)                                  # full bidirectional
+        a = a.transpose(0, 2, 1, 3).reshape(b, s, -1)
+        hh = hh + (a @ lp["wo"] + lp["bo"])
+        x = layer_norm(hh, lp["ln2"], lp["ln2_b"], eps=eps)
+        hh = hh + (_quick_gelu(x @ lp["w1"] + lp["b1"]) @ lp["w2"] + lp["b2"])
+        return hh, None
+
+    h, _ = jax.lax.scan(layer, h, vp["layers"])
+    if drop_cls:
+        h = h[:, 1:]
+    feats = jax.nn.gelu(h @ vp["proj_w1"] + vp["proj_b1"], approximate=False)
+    return feats @ vp["proj_w2"] + vp["proj_b2"]
+
+
+class LlavaInferenceConfig(ImageToTextInferenceConfig, LlamaInferenceConfig):
+    REQUIRED_ATTRIBUTES = ("vision_config", "image_token_index")
+
+    def add_derived_config(self) -> None:
+        ImageToTextInferenceConfig.add_derived_config(self)
+        LlamaInferenceConfig.add_derived_config(self)
+        for attr, default in (("vision_feature_layer", -2),
+                              ("vision_feature_select_strategy", "default")):
+            if not hasattr(self, attr) or getattr(self, attr) is None:
+                setattr(self, attr, default)
+        tower = self.vision_config.get("model_type", "clip_vision_model")
+        if tower != "clip_vision_model":
+            raise ValueError(f"LLaVA port supports CLIP vision towers "
+                             f"(got {tower!r}); pixtral towers live in "
+                             f"models/pixtral")
+
+
+def _normalize_keys(state_dict: Dict[str, Any]) -> Dict[str, Any]:
+    """HF legacy llava layout (`language_model.model.*`, bare `vision_tower.*`)
+    -> in-memory layout; in-memory keys pass through."""
+    out = {}
+    for k, v in state_dict.items():
+        if k.startswith("language_model.model."):
+            k = "model.language_model." + k[len("language_model.model."):]
+        elif k == "language_model.lm_head.weight":
+            k = "lm_head.weight"
+        elif k.startswith("vision_tower.") or k.startswith("multi_modal_projector."):
+            k = "model." + k
+        out[k] = v
+    return out
+
+
+class LlavaForConditionalGeneration(TpuModelForImageToText, LlamaForCausalLM):
+    """≈ HF LlavaForConditionalGeneration (CLIP tower + llama text model)."""
+
+    @classmethod
+    def get_config_cls(cls):
+        return LlavaInferenceConfig
+
+    def vision_encode_fn(self):
+        vc = self.config.vision_config
+        strategy = self.config.vision_feature_select_strategy
+        return functools.partial(
+            clip_vision_encode,
+            patch_size=vc["patch_size"],
+            num_heads=vc["num_attention_heads"],
+            eps=vc.get("layer_norm_eps", 1e-5),
+            drop_cls=strategy == "default",
+        )
+
+    @classmethod
+    def convert_hf_state_dict(cls, state_dict: Dict[str, np.ndarray], config) -> Dict:
+        state_dict = _normalize_keys(state_dict)
+        text_sd = {}
+        for k, v in state_dict.items():
+            if k.startswith("model.language_model."):
+                text_sd["model." + k[len("model.language_model."):]] = v
+            elif k == "lm_head.weight":
+                text_sd[k] = v
+        return super().convert_hf_state_dict(text_sd, config)
+
+    @classmethod
+    def convert_hf_vision_state_dict(cls, state_dict: Dict[str, np.ndarray],
+                                     config) -> Dict:
+        state_dict = _normalize_keys(state_dict)
+        vc = config.vision_config
+        # features come from hidden layer `vision_feature_layer` (default -2):
+        # only the layers BELOW it run
+        n_layers = vc["num_hidden_layers"] + 1 + config.vision_feature_layer \
+            if config.vision_feature_layer < 0 else config.vision_feature_layer
+        hidden = vc["hidden_size"]
+
+        def get(name):
+            if name not in state_dict:
+                raise KeyError(f"missing weight {name}")
+            return np.asarray(state_dict[name])
+
+        def lin_t(name):
+            return np.ascontiguousarray(get(name).T)
+
+        keys = ("ln1", "ln1_b", "wq", "bq", "wk", "bk", "wv", "bv", "wo", "bo",
+                "ln2", "ln2_b", "w1", "b1", "w2", "b2")
+        layers = {k: [] for k in keys}
+        for i in range(n_layers):
+            p = f"model.vision_tower.vision_model.encoder.layers.{i}."
+            layers["ln1"].append(get(p + "layer_norm1.weight"))
+            layers["ln1_b"].append(get(p + "layer_norm1.bias"))
+            layers["wq"].append(lin_t(p + "self_attn.q_proj.weight"))
+            layers["bq"].append(get(p + "self_attn.q_proj.bias"))
+            layers["wk"].append(lin_t(p + "self_attn.k_proj.weight"))
+            layers["bk"].append(get(p + "self_attn.k_proj.bias"))
+            layers["wv"].append(lin_t(p + "self_attn.v_proj.weight"))
+            layers["bv"].append(get(p + "self_attn.v_proj.bias"))
+            layers["wo"].append(lin_t(p + "self_attn.out_proj.weight"))
+            layers["bo"].append(get(p + "self_attn.out_proj.bias"))
+            layers["ln2"].append(get(p + "layer_norm2.weight"))
+            layers["ln2_b"].append(get(p + "layer_norm2.bias"))
+            layers["w1"].append(lin_t(p + "mlp.fc1.weight"))
+            layers["b1"].append(get(p + "mlp.fc1.bias"))
+            layers["w2"].append(lin_t(p + "mlp.fc2.weight"))
+            layers["b2"].append(get(p + "mlp.fc2.bias"))
+
+        emb = "model.vision_tower.vision_model.embeddings."
+        conv = get(emb + "patch_embedding.weight")           # (H_vis, C, p, p)
+        return {
+            "patch_w": np.ascontiguousarray(conv.reshape(hidden, -1).T),
+            "cls": get(emb + "class_embedding"),
+            "pos_embed": get(emb + "position_embedding.weight"),
+            "ln_pre": get("model.vision_tower.vision_model.pre_layrnorm.weight"),
+            "ln_pre_b": get("model.vision_tower.vision_model.pre_layrnorm.bias"),
+            "layers": {k: np.stack(v) for k, v in layers.items()},
+            "proj_w1": lin_t("model.multi_modal_projector.linear_1.weight"),
+            "proj_b1": get("model.multi_modal_projector.linear_1.bias"),
+            "proj_w2": lin_t("model.multi_modal_projector.linear_2.weight"),
+            "proj_b2": get("model.multi_modal_projector.linear_2.bias"),
+        }

@@ -1,0 +1,483 @@
+"""RecurrentGemma (Griffin) on the TPU framework (contrib port).
+
+≈ reference `contrib/models/recurrentgemma-2b-it/`. The first NON-KV state cache
+in the hub: Griffin interleaves RG-LRU recurrent blocks (2 per attention block)
+whose per-layer state is a (B, lru_width) fp32 recurrence vector plus a
+(B, conv_width-1, lru_width) causal-conv tail — not a KV cache. TPU redesign:
+
+- **Prefill runs the linear recurrence as a `jax.lax.associative_scan`**
+  (h_t = a_t h_{t-1} + b_t is associative in (a, b)), so the sequential RG-LRU
+  becomes a log-depth parallel scan on the VPU instead of an O(S) loop — the
+  idiomatic TPU form of the recurrence (the HF reference loops over t).
+- Right-padded prefill freezes each row's recurrence at its true length
+  (a=1, b=0 on padding), so the carried decode state is exactly the state at
+  the last real token; the conv tail gathers the last W-1 real inputs.
+- Decode is one fused step per token: conv tail dot + single recurrence update,
+  with the attention layers' sliding-window KV riding the same cache pytree.
+- Attention blocks: GQA + partial rotary + sliding window + biased o_proj.
+- RG-LRU math follows HF `RecurrentGemmaRglru`: block-diagonal sigmoid gates,
+  a = exp(-8 c r_t softplus(Λ)), input scaled by sqrt(1 - a²) (1 at position 0),
+  fp32 accumulation.
+"""
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neuronx_distributed_inference_tpu.config import InferenceConfig
+from neuronx_distributed_inference_tpu.models.base import (
+    ModelArchArgs, causal_mask)
+from neuronx_distributed_inference_tpu.ops import rope as rope_ops
+from neuronx_distributed_inference_tpu.ops.attention import attend
+from neuronx_distributed_inference_tpu.ops.norms import rms_norm
+from neuronx_distributed_inference_tpu.runtime.application import (
+    TpuModelForCausalLM)
+
+
+@dataclass(frozen=True)
+class RecurrentGemmaArchArgs(ModelArchArgs):
+    """Griffin extension: block kinds + recurrent geometry."""
+
+    lru_width: int = 0
+    conv1d_width: int = 4
+    attention_window_size: int = 2048
+    block_types: Tuple[str, ...] = ()        # per-layer "recurrent" | "attention"
+
+
+# --- RG-LRU core ----------------------------------------------------------------------
+
+
+def _rg_lru_gates(lp, x, args):
+    """x (B, S, lru) -> (a, gated, mult), all (B, S, lru) fp32.
+
+    Block-diagonal gate projections per head (HF `input_gate_weight`
+    (nh, bw, bw)); a = exp(-8 * r * softplus(Λ)); gated = x·i_gate;
+    mult = sqrt(1 - a²). The recurrence input is gated * mult (with mult
+    replaced by 1 at position-0 resets — callers apply that)."""
+    bsz, s, lru = x.shape
+    nh = args.num_heads
+    bw = lru // nh
+    xh = x.reshape(bsz, s, nh, bw).astype(jnp.float32)
+    i_gate = jax.nn.sigmoid(
+        jnp.einsum("bsnw,nwv->bsnv", xh, lp["lru_wi"].astype(jnp.float32))
+        + lp["lru_bi"].astype(jnp.float32)).reshape(bsz, s, lru)
+    r_gate = jax.nn.sigmoid(
+        jnp.einsum("bsnw,nwv->bsnv", xh, lp["lru_wr"].astype(jnp.float32))
+        + lp["lru_br"].astype(jnp.float32)).reshape(bsz, s, lru)
+    log_a = -8.0 * r_gate * jax.nn.softplus(lp["lru_lambda"].astype(jnp.float32))
+    a = jnp.exp(log_a)
+    mult = jnp.sqrt(jnp.maximum(1.0 - jnp.exp(2.0 * log_a), 0.0))
+    gated = x.astype(jnp.float32) * i_gate
+    return a, gated, mult
+
+
+def _conv_causal(lp, x, args):
+    """Depthwise causal conv over the sequence: x (B, S, lru) -> (B, S, lru).
+    Kernel lp["conv_w"] (W, lru) (tap j multiplies x[t - (W-1) + j]), bias (lru,)."""
+    w = args.conv1d_width
+    xp = jnp.pad(x, ((0, 0), (w - 1, 0), (0, 0)))
+    s = x.shape[1]
+    out = sum(xp[:, j : j + s, :] * lp["conv_w"][j][None, None, :]
+              for j in range(w))
+    return out + lp["conv_b"][None, None, :]
+
+
+def _recurrent_block_prefill(lp, hn, position_ids, last_token_idx, args):
+    """Full-sequence recurrent block; returns (out (B, S, H), conv_state, lru_state)."""
+    w = args.conv1d_width
+    y = jax.nn.gelu(hn @ lp["wy"] + lp["by"], approximate=True)
+    x = hn @ lp["wx"] + lp["bx"]                             # (B, S, lru)
+
+    # conv tail for decode: the last W-1 REAL inputs per row (zeros if shorter)
+    s = x.shape[1]
+    idx = last_token_idx[:, None] + 1 - (w - 1) + jnp.arange(w - 1)[None, :]
+    gathered = jnp.take_along_axis(
+        x, jnp.clip(idx, 0, s - 1)[:, :, None], axis=1)
+    conv_state = jnp.where((idx >= 0)[:, :, None], gathered, 0.0)
+
+    xc = _conv_causal(lp, x, args)
+    a, gated, mult = _rg_lru_gates(lp, xc, args)
+    reset = (position_ids == 0)[:, :, None]
+    valid = (jnp.arange(s)[None, :] <= last_token_idx[:, None])[:, :, None]
+    # position-0 reset: a = 0, input multiplier = 1 (HF `reset + ~reset * mult`)
+    b = gated * jnp.where(reset, 1.0, mult)
+    a = jnp.where(reset, 0.0, a)
+    # freeze padded positions so the carried state is the last real token's
+    a = jnp.where(valid, a, 1.0)
+    b = jnp.where(valid, b, 0.0)
+
+    def comb(l, r):
+        return (r[0] * l[0], r[0] * l[1] + r[1])
+
+    _, h_seq = jax.lax.associative_scan(comb, (a, b), axis=1)    # (B, S, lru) fp32
+    lru_state = jnp.take_along_axis(
+        h_seq, last_token_idx[:, None, None], axis=1)[:, 0]      # (B, lru)
+
+    out = (h_seq.astype(hn.dtype) * y) @ lp["wo_r"] + lp["bo_r"]
+    return out, conv_state.astype(hn.dtype), lru_state
+
+
+def _recurrent_block_decode(lp, hn, conv_state, lru_state, args):
+    """One-token recurrent step. hn (B, 1, H); returns (out, conv_state, lru_state)."""
+    w = args.conv1d_width
+    y = jax.nn.gelu(hn @ lp["wy"] + lp["by"], approximate=True)
+    x = (hn @ lp["wx"] + lp["bx"])[:, 0]                     # (B, lru)
+    full = jnp.concatenate([conv_state, x[:, None, :]], axis=1)   # (B, W, lru)
+    xc = jnp.sum(full * lp["conv_w"][None, :, :], axis=1) + lp["conv_b"]
+    a, gated, mult = _rg_lru_gates(lp, xc[:, None, :], args)
+    h = a[:, 0] * lru_state + (gated * mult)[:, 0]           # (B, lru) fp32
+    out = (h.astype(hn.dtype)[:, None, :] * y) @ lp["wo_r"] + lp["bo_r"]
+    return out, full[:, 1:, :].astype(conv_state.dtype), h
+
+
+# --- attention block ------------------------------------------------------------------
+
+
+def _attn_block(lp, hn, cos, sin, mask, k_cache, v_cache, positions, bucket, args):
+    """Sliding-window GQA with partial rotary; mirrors models/base semantics over
+    one dense cache layer. Returns (out, k_cache, v_cache)."""
+    b, s, _ = hn.shape
+    q = (hn @ lp["wq"]).reshape(b, s, args.num_heads, args.head_dim
+                                ).transpose(0, 2, 1, 3)
+    k = (hn @ lp["wk"]).reshape(b, s, args.num_kv_heads, args.head_dim
+                                ).transpose(0, 2, 1, 3)
+    v = (hn @ lp["wv"]).reshape(b, s, args.num_kv_heads, args.head_dim
+                                ).transpose(0, 2, 1, 3)
+    rd = args.rotary_dim
+    q1, k1 = rope_ops.apply_rotary(q[..., :rd], k[..., :rd], cos, sin)
+    q = jnp.concatenate([q1, q[..., rd:]], axis=-1)
+    k = jnp.concatenate([k1, k[..., rd:]], axis=-1)
+
+    if positions is None:                                    # prefill
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k.astype(k_cache.dtype), (0, 0, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v.astype(v_cache.dtype), (0, 0, 0, 0))
+        k_att, v_att = k, v
+    else:                                                    # decode
+        def _one(row_c, row_n, p):
+            return jax.lax.dynamic_update_slice(
+                row_c, row_n.astype(row_c.dtype), (0, p, 0))
+
+        k_cache = jax.vmap(_one)(k_cache, k, positions)
+        v_cache = jax.vmap(_one)(v_cache, v, positions)
+        k_att = jax.lax.slice_in_dim(k_cache, 0, bucket, axis=2).astype(q.dtype)
+        v_att = jax.lax.slice_in_dim(v_cache, 0, bucket, axis=2).astype(q.dtype)
+
+    attn = attend(q, k_att, v_att, mask=mask)
+    attn = attn.transpose(0, 2, 1, 3).reshape(b, s, args.q_size)
+    return attn @ lp["wo"] + lp["bo"], k_cache, v_cache
+
+
+# --- full forwards --------------------------------------------------------------------
+
+
+def _mlp(lp, hn):
+    gate = jax.nn.gelu(hn @ lp["wg"] + lp["bg"], approximate=True)
+    return (gate * (hn @ lp["wu"] + lp["bu"])) @ lp["wd"] + lp["bd"]
+
+
+def prefill_forward(params, args: RecurrentGemmaArchArgs, input_ids, position_ids,
+                    last_token_idx, cache, mesh=None, rules=None, use_flash=False,
+                    adapter_ids=None, use_ring=False, return_hidden=False):
+    h = jnp.take(params["embed"], input_ids, axis=0)
+    h = h * jnp.asarray(args.embedding_multiplier, h.dtype)
+    s = input_ids.shape[1]
+    cos, sin = rope_ops.compute_cos_sin(params["rope_inv_freq"], position_ids)
+    mask = (position_ids[:, None, :, None] >= position_ids[:, None, None, :])
+    mask &= causal_mask(s, s)[None, None]
+    kv_pos = position_ids[:, None, None, :]
+    q_pos = position_ids[:, None, :, None]
+    mask &= kv_pos > q_pos - args.attention_window_size
+
+    ks, vs, convs, lrus = [], [], [], []
+    ai = ri = 0
+    for li, kind in enumerate(args.block_types):
+        lp = jax.tree.map(lambda p: p[li] if isinstance(p, jnp.ndarray) else p,
+                          params["layers"])
+        hn = rms_norm(h, lp["ln1"], args.rms_norm_eps, zero_centered=True)
+        if kind == "attention":
+            out, kc, vc = _attn_block(lp, hn, cos, sin, mask, cache["k"][ai],
+                                      cache["v"][ai], None, None, args)
+            ks.append(kc)
+            vs.append(vc)
+            ai += 1
+        else:
+            out, conv_state, lru_state = _recurrent_block_prefill(
+                lp, hn, position_ids, last_token_idx, args)
+            convs.append(conv_state)
+            lrus.append(lru_state)
+            ri += 1
+        h = h + out
+        resid = h
+        hn = rms_norm(h, lp["ln2"], args.rms_norm_eps, zero_centered=True)
+        h = resid + _mlp(lp, hn)
+
+    h = rms_norm(h, params["final_norm"], args.rms_norm_eps, zero_centered=True)
+    h_last = jnp.take_along_axis(h, last_token_idx[:, None, None], axis=1)[:, 0]
+    logits = (h_last @ params["embed"].T).astype(jnp.float32)
+    if args.final_logits_soft_cap is not None:
+        cap = args.final_logits_soft_cap
+        logits = cap * jnp.tanh(logits / cap)
+    out_cache = {"k": jnp.stack(ks), "v": jnp.stack(vs),
+                 "conv": jnp.stack(convs), "lru": jnp.stack(lrus)}
+    if return_hidden:
+        return logits, out_cache, h
+    return logits, out_cache
+
+
+def decode_forward(params, args: RecurrentGemmaArchArgs, input_ids, position_ids,
+                   cache, decode_bucket, mesh=None, rules=None, adapter_ids=None,
+                   tree=None, return_hidden=False, **_ignored):
+    if input_ids.shape[1] != 1 or tree is not None:
+        raise ValueError("RecurrentGemma decode is single-token only (the "
+                         "recurrence carries one state per row)")
+    h = jnp.take(params["embed"], input_ids, axis=0)
+    h = h * jnp.asarray(args.embedding_multiplier, h.dtype)
+    pos_grid = position_ids[:, None]
+    cos, sin = rope_ops.compute_cos_sin(params["rope_inv_freq"], pos_grid)
+    kv_pos = jnp.arange(decode_bucket)[None, None, None, :]
+    q_pos = pos_grid[:, None, :, None]
+    mask = (kv_pos <= q_pos) & (kv_pos > q_pos - args.attention_window_size)
+
+    ks, vs, convs, lrus = [], [], [], []
+    ai = ri = 0
+    for li, kind in enumerate(args.block_types):
+        lp = jax.tree.map(lambda p: p[li] if isinstance(p, jnp.ndarray) else p,
+                          params["layers"])
+        hn = rms_norm(h, lp["ln1"], args.rms_norm_eps, zero_centered=True)
+        if kind == "attention":
+            out, kc, vc = _attn_block(lp, hn, cos, sin, mask, cache["k"][ai],
+                                      cache["v"][ai], position_ids, decode_bucket,
+                                      args)
+            ks.append(kc)
+            vs.append(vc)
+            ai += 1
+        else:
+            out, conv_state, lru_state = _recurrent_block_decode(
+                lp, hn, cache["conv"][ri], cache["lru"][ri], args)
+            convs.append(conv_state)
+            lrus.append(lru_state)
+            ri += 1
+        h = h + out
+        resid = h
+        hn = rms_norm(h, lp["ln2"], args.rms_norm_eps, zero_centered=True)
+        h = resid + _mlp(lp, hn)
+
+    h = rms_norm(h, params["final_norm"], args.rms_norm_eps, zero_centered=True)
+    logits = (h @ params["embed"].T).astype(jnp.float32)
+    if args.final_logits_soft_cap is not None:
+        cap = args.final_logits_soft_cap
+        logits = cap * jnp.tanh(logits / cap)
+    out_cache = {"k": jnp.stack(ks), "v": jnp.stack(vs),
+                 "conv": jnp.stack(convs), "lru": jnp.stack(lrus)}
+    if return_hidden:
+        return logits, out_cache, h
+    return logits, out_cache
+
+
+# --- application ----------------------------------------------------------------------
+
+
+class RecurrentGemmaInferenceConfig(InferenceConfig):
+    REQUIRED_ATTRIBUTES = ("hidden_size", "num_hidden_layers",
+                           "num_attention_heads", "num_key_value_heads",
+                           "vocab_size", "intermediate_size")
+
+    def add_derived_config(self) -> None:
+        for attr, default in (("rope_theta", 10000.0), ("rms_norm_eps", 1e-6),
+                              ("partial_rotary_factor", 0.5),
+                              ("conv1d_width", 4), ("attention_window_size", 2048),
+                              ("logits_soft_cap", 30.0),
+                              ("attention_bias", False),
+                              ("embeddings_scale_by_sqrt_dim", True)):
+            if not hasattr(self, attr) or getattr(self, attr) is None:
+                setattr(self, attr, default)
+        if not hasattr(self, "lru_width") or self.lru_width is None:
+            self.lru_width = self.hidden_size
+        if not hasattr(self, "head_dim") or self.head_dim is None:
+            self.head_dim = self.hidden_size // self.num_attention_heads
+        if not hasattr(self, "block_types") or not self.block_types:
+            self.block_types = ["recurrent", "recurrent", "attention"]
+
+    def layer_block_types(self):
+        pattern = list(self.block_types)
+        return tuple(pattern[i % len(pattern)]
+                     for i in range(self.num_hidden_layers))
+
+
+class RecurrentGemmaForCausalLM(TpuModelForCausalLM):
+    def __init__(self, model_path, config, mesh=None):
+        self._require_base_layout(config.tpu_config, "RecurrentGemma (Griffin)")
+        super().__init__(model_path, config, mesh=mesh)
+
+    @classmethod
+    def get_config_cls(cls):
+        return RecurrentGemmaInferenceConfig
+
+    @classmethod
+    def arch_args_from_config(cls, config) -> RecurrentGemmaArchArgs:
+        if getattr(config, "attention_bias", False):
+            raise ValueError("biased q/k/v projections not ported yet")
+        return RecurrentGemmaArchArgs(
+            vocab_size=config.vocab_size,
+            hidden_size=config.hidden_size,
+            num_layers=config.num_hidden_layers,
+            num_heads=config.num_attention_heads,
+            num_kv_heads=config.num_key_value_heads,
+            head_dim=config.head_dim,
+            intermediate_size=config.intermediate_size // 2,
+            rms_norm_eps=config.rms_norm_eps,
+            rotary_dim=int(config.head_dim * float(config.partial_rotary_factor)),
+            embedding_multiplier=(float(config.hidden_size) ** 0.5
+                                  if config.embeddings_scale_by_sqrt_dim else 1.0),
+            final_logits_soft_cap=float(config.logits_soft_cap),
+            tie_word_embeddings=True,
+            lru_width=int(config.lru_width),
+            conv1d_width=int(config.conv1d_width),
+            attention_window_size=int(config.attention_window_size),
+            block_types=config.layer_block_types(),
+        )
+
+    def prefill_fn(self):
+        return prefill_forward
+
+    def decode_fn(self):
+        return decode_forward
+
+    @classmethod
+    def inv_freq_from_config(cls, config) -> np.ndarray:
+        rd = int(config.head_dim * float(config.partial_rotary_factor))
+        return rope_ops.default_inv_freq(rd, float(config.rope_theta))
+
+    # --- cache ------------------------------------------------------------------
+    def reset_cache(self, batch_size: Optional[int] = None) -> None:
+        a: RecurrentGemmaArchArgs = self.arch_args
+        b = batch_size or self.tpu_config.max_batch_size
+        n_att = sum(1 for k in a.block_types if k == "attention")
+        n_rec = len(a.block_types) - n_att
+        dt = self.tpu_config.jax_dtype
+        self.kv_cache = {
+            "k": jnp.zeros((max(n_att, 1), b, a.num_kv_heads,
+                            self.tpu_config.seq_len, a.head_dim), dt),
+            "v": jnp.zeros((max(n_att, 1), b, a.num_kv_heads,
+                            self.tpu_config.seq_len, a.head_dim), dt),
+            "conv": jnp.zeros((max(n_rec, 1), b, a.conv1d_width - 1,
+                               a.lru_width), dt),
+            "lru": jnp.zeros((max(n_rec, 1), b, a.lru_width), jnp.float32),
+        }
+
+    def _put_params(self, host_params) -> None:
+        dtype = self.tpu_config.jax_dtype
+
+        def _put(x):
+            arr = np.asarray(x)
+            if arr.dtype.kind == "f":
+                arr = arr.astype(np.float32 if arr is host_params.get(
+                    "rope_inv_freq") else dtype)
+            return jax.device_put(arr)
+
+        params = jax.tree.map(_put, host_params)
+        params["rope_inv_freq"] = jax.device_put(
+            np.asarray(host_params["rope_inv_freq"], np.float32))
+        # keep the RG-LRU decay parameter fp32 (the recurrence accumulates fp32)
+        params["layers"]["lru_lambda"] = jax.device_put(
+            np.asarray(host_params["layers"]["lru_lambda"], np.float32))
+        self.params = params
+        self.reset_cache()
+
+    def init_random_params(self, key):
+        raise NotImplementedError("load from an HF checkpoint or state dict")
+
+    @classmethod
+    def convert_hf_state_dict(cls, state_dict: Dict[str, np.ndarray],
+                              config) -> Dict:
+        def get(name):
+            if name not in state_dict:
+                raise KeyError(f"missing weight {name}")
+            return np.asarray(state_dict[name])
+
+        def lin_t(name):
+            return np.ascontiguousarray(get(name).T)
+
+        kinds = config.layer_block_types()
+        L = config.num_hidden_layers
+        lru = config.lru_width
+        zeros = {
+            "wq": np.zeros((config.hidden_size, config.num_attention_heads
+                            * config.head_dim), np.float32),
+            "wk": np.zeros((config.hidden_size, config.num_key_value_heads
+                            * config.head_dim), np.float32),
+            "wv": np.zeros((config.hidden_size, config.num_key_value_heads
+                            * config.head_dim), np.float32),
+            "wo": np.zeros((config.num_attention_heads * config.head_dim,
+                            config.hidden_size), np.float32),
+            "bo": np.zeros((config.hidden_size,), np.float32),
+            "wy": np.zeros((config.hidden_size, lru), np.float32),
+            "by": np.zeros((lru,), np.float32),
+            "wx": np.zeros((config.hidden_size, lru), np.float32),
+            "bx": np.zeros((lru,), np.float32),
+            "wo_r": np.zeros((lru, config.hidden_size), np.float32),
+            "bo_r": np.zeros((config.hidden_size,), np.float32),
+            "conv_w": np.zeros((config.conv1d_width, lru), np.float32),
+            "conv_b": np.zeros((lru,), np.float32),
+            "lru_lambda": np.zeros((lru,), np.float32),
+            "lru_wi": np.zeros((config.num_attention_heads,
+                                lru // config.num_attention_heads,
+                                lru // config.num_attention_heads), np.float32),
+            "lru_bi": np.zeros((config.num_attention_heads,
+                                lru // config.num_attention_heads), np.float32),
+            "lru_wr": np.zeros((config.num_attention_heads,
+                                lru // config.num_attention_heads,
+                                lru // config.num_attention_heads), np.float32),
+            "lru_br": np.zeros((config.num_attention_heads,
+                                lru // config.num_attention_heads), np.float32),
+        }
+        layers: Dict[str, list] = {k: [] for k in
+                                   list(zeros) + ["ln1", "ln2", "wg", "bg",
+                                                  "wu", "bu", "wd", "bd"]}
+        for i in range(L):
+            p = f"model.layers.{i}."
+            t = p + "temporal_block."
+            layers["ln1"].append(get(p + "temporal_pre_norm.weight"))
+            layers["ln2"].append(get(p + "channel_pre_norm.weight"))
+            layers["wg"].append(lin_t(p + "mlp_block.gate_proj.weight"))
+            layers["bg"].append(get(p + "mlp_block.gate_proj.bias"))
+            layers["wu"].append(lin_t(p + "mlp_block.up_proj.weight"))
+            layers["bu"].append(get(p + "mlp_block.up_proj.bias"))
+            layers["wd"].append(lin_t(p + "mlp_block.down_proj.weight"))
+            layers["bd"].append(get(p + "mlp_block.down_proj.bias"))
+            filled = dict(zeros)
+            if kinds[i] == "attention":
+                filled["wq"] = lin_t(t + "q_proj.weight")
+                filled["wk"] = lin_t(t + "k_proj.weight")
+                filled["wv"] = lin_t(t + "v_proj.weight")
+                filled["wo"] = lin_t(t + "o_proj.weight")
+                filled["bo"] = get(t + "o_proj.bias")
+            else:
+                filled["wy"] = lin_t(t + "linear_y.weight")
+                filled["by"] = get(t + "linear_y.bias")
+                filled["wx"] = lin_t(t + "linear_x.weight")
+                filled["bx"] = get(t + "linear_x.bias")
+                filled["wo_r"] = lin_t(t + "linear_out.weight")
+                filled["bo_r"] = get(t + "linear_out.bias")
+                # HF conv (lru, 1, W): tap j multiplies x[t - (W-1) + j]
+                filled["conv_w"] = np.ascontiguousarray(
+                    get(t + "conv_1d.weight")[:, 0, :].T)
+                filled["conv_b"] = get(t + "conv_1d.bias")
+                filled["lru_lambda"] = get(t + "rg_lru.recurrent_param")
+                filled["lru_wi"] = get(t + "rg_lru.input_gate_weight")
+                filled["lru_bi"] = get(t + "rg_lru.input_gate_bias")
+                filled["lru_wr"] = get(t + "rg_lru.recurrent_gate_weight")
+                filled["lru_br"] = get(t + "rg_lru.recurrent_gate_bias")
+            for k, v in filled.items():
+                layers[k].append(v)
+        return {
+            "embed": get("model.embed_tokens.weight"),
+            "layers": {k: np.stack(v) for k, v in layers.items()},
+            "final_norm": get("model.final_norm.weight"),
+            "rope_inv_freq": cls.inv_freq_from_config(config),
+        }

@@ -25,6 +25,9 @@ CONTRIB_MODELS = {
     "glm": "contrib.models.glm.src.modeling_glm:GlmForCausalLM",
     "gemma2": "contrib.models.gemma2.src.modeling_gemma2:Gemma2ForCausalLM",
     "phimoe": "contrib.models.phimoe.src.modeling_phimoe:PhimoeForCausalLM",
+    "recurrent_gemma": "contrib.models.recurrentgemma.src.modeling_recurrentgemma:RecurrentGemmaForCausalLM",
+    "lfm2": "contrib.models.lfm2.src.modeling_lfm2:Lfm2ForCausalLM",
+    "llava": "contrib.models.llava.src.modeling_llava:LlavaForConditionalGeneration",
 }
 
 for model_type, path in CONTRIB_MODELS.items():

@@ -45,7 +45,9 @@ def test_registry_resolves_contrib_models():
     from neuronx_distributed_inference_tpu.models import get_model_cls
 
     for mt in ("gpt2", "opt", "gpt_neox", "phi", "phi3", "starcoder2", "falcon",
-               "bloom", "mpt", "stablelm", "gemma", "biogpt"):
+               "bloom", "mpt", "stablelm", "gemma", "biogpt",
+               "granite", "cohere", "glm", "gemma2", "phimoe",
+               "recurrent_gemma", "lfm2", "llava"):
         assert get_model_cls(mt) is not None
 
 
@@ -307,3 +309,126 @@ def test_phimoe_parity():
     torch.manual_seed(0)
     hf = HFPhimoe(cfg).eval()
     _run_parity(PhimoeForCausalLM, hf, cfg, atol=1e-3, rtol=1e-3)
+
+
+def test_recurrentgemma_parity():
+    """Griffin / RG-LRU: the first non-KV recurrent-state cache in the hub.
+    Prefill runs the recurrence as an associative scan; parity vs HF exercises
+    the recurrence math, the conv tail handoff, and the mixed cache pytree."""
+    from transformers import (RecurrentGemmaConfig,
+                              RecurrentGemmaForCausalLM as HFRg)
+
+    from contrib.models.recurrentgemma.src.modeling_recurrentgemma import (
+        RecurrentGemmaForCausalLM)
+
+    cfg = RecurrentGemmaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=192,
+        num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=2,
+        lru_width=64, conv1d_width=4, attention_window_size=16,
+        embeddings_scale_by_sqrt_dim=True, logits_soft_cap=30.0,
+        partial_rotary_factor=0.5, pad_token_id=0,
+        block_types=["recurrent", "recurrent", "attention"])
+    torch.manual_seed(0)
+    hf = HFRg(cfg).eval()
+    _run_parity(RecurrentGemmaForCausalLM, hf, cfg, atol=2e-3, rtol=1e-3,
+                eos_token_id=1)
+
+
+def test_lfm2_parity():
+    """LFM2 conv/attention hybrid: gated short-conv state cache + qk-norm
+    attention layers in one hybrid cache pytree."""
+    from transformers import Lfm2Config, Lfm2ForCausalLM as HFLfm2
+
+    from contrib.models.lfm2.src.modeling_lfm2 import Lfm2ForCausalLM
+
+    cfg = Lfm2Config(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+        conv_L_cache=3, conv_bias=False, block_auto_adjust_ff_dim=False,
+        layer_types=["conv", "conv", "full_attention", "conv"],
+        pad_token_id=0, tie_word_embeddings=True)
+    torch.manual_seed(0)
+    hf = HFLfm2(cfg).eval()
+    _run_parity(Lfm2ForCausalLM, hf, cfg, atol=1e-3, rtol=1e-3)
+
+
+@pytest.fixture(scope="module")
+def tiny_clip_llava():
+    from transformers import (CLIPVisionConfig, LlamaConfig, LlavaConfig,
+                              LlavaForConditionalGeneration)
+
+    vc = CLIPVisionConfig(hidden_size=32, intermediate_size=64,
+                          num_hidden_layers=3, num_attention_heads=2,
+                          image_size=16, patch_size=8, num_channels=3,
+                          projection_dim=32)
+    tc = LlamaConfig(vocab_size=256, hidden_size=48, intermediate_size=96,
+                     num_hidden_layers=2, num_attention_heads=4,
+                     num_key_value_heads=2, rope_theta=10000.0,
+                     tie_word_embeddings=False)
+    cfg = LlavaConfig(vision_config=vc, text_config=tc, image_token_index=255,
+                      projector_hidden_act="gelu",
+                      vision_feature_layer=-2,
+                      vision_feature_select_strategy="default")
+    torch.manual_seed(0)
+    hf = LlavaForConditionalGeneration(cfg).eval()
+    return hf, cfg
+
+
+def test_llava_clip_vision_encoder_matches_hf(tiny_clip_llava):
+    from contrib.models.llava.src.modeling_llava import (
+        LlavaForConditionalGeneration)
+
+    hf, cfg = tiny_clip_llava
+    tpu_cfg = TpuConfig(batch_size=2, seq_len=64, max_context_length=32,
+                        dtype="float32", context_encoding_buckets=[32],
+                        token_generation_buckets=[64])
+    config = LlavaForConditionalGeneration.get_config_cls()(
+        tpu_cfg, load_config=load_pretrained_config(cfg.to_dict()))
+    app = LlavaForConditionalGeneration(None, config)
+    state = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+    app._put_params(app.convert_hf_state_dict(state, app.config))
+    app.load_vision_from_state_dict(state)
+
+    rng = np.random.default_rng(0)
+    pixels = rng.normal(size=(2, 3, 16, 16)).astype(np.float32)
+    feats = app.encode_images(pixels)                   # (2, 4, H_text): CLS dropped
+    with torch.no_grad():
+        hf_feats = hf.get_image_features(pixel_values=torch.tensor(pixels))
+    np.testing.assert_allclose(feats, np.asarray(hf_feats), atol=3e-4, rtol=1e-3)
+
+
+def test_llava_clip_generate_matches_hf(tiny_clip_llava):
+    """LLaVA-1.5 over the image_to_text base: CLIP features land on image-token
+    positions, greedy decode matches HF CPU; text-only requests still serve."""
+    from contrib.models.llava.src.modeling_llava import (
+        LlavaForConditionalGeneration)
+
+    hf, cfg = tiny_clip_llava
+    tpu_cfg = TpuConfig(batch_size=2, seq_len=64, max_context_length=32,
+                        dtype="float32", context_encoding_buckets=[32],
+                        token_generation_buckets=[64])
+    config = LlavaForConditionalGeneration.get_config_cls()(
+        tpu_cfg, load_config=load_pretrained_config(cfg.to_dict()))
+    app = LlavaForConditionalGeneration(None, config)
+    state = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+    app._put_params(app.convert_hf_state_dict(state, app.config))
+    app.load_vision_from_state_dict(state)
+
+    rng = np.random.default_rng(1)
+    ids = rng.integers(1, 250, size=(2, 20))
+    ids[:, 2:6] = 255                                   # 4 patches per image
+    pixels = rng.normal(size=(2, 3, 16, 16)).astype(np.float32)
+    with torch.no_grad():
+        hf_out = hf.generate(input_ids=torch.tensor(ids),
+                             pixel_values=torch.tensor(pixels),
+                             max_new_tokens=8, do_sample=False, pad_token_id=0)
+    out = app.generate(ids, pixel_values=pixels, max_new_tokens=8)
+    np.testing.assert_array_equal(out.tokens, hf_out[:, 20:].numpy())
+
+    # text-only path still serves
+    tids = rng.integers(1, 250, size=(2, 10)).astype(np.int64)
+    with torch.no_grad():
+        hf_t = hf.generate(input_ids=torch.tensor(tids), max_new_tokens=6,
+                           do_sample=False, pad_token_id=0)
+    out_t = app.generate(tids, max_new_tokens=6)
+    np.testing.assert_array_equal(out_t.tokens, hf_t[:, 10:].numpy())
