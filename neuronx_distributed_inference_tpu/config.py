@@ -110,6 +110,41 @@ class LoraServingConfig:
 
 
 @dataclass
+class MoEHybridShardingConfig:
+    """Decode-time MoE dispatch layout override (≈ reference hybrid sharding:
+    different TP/EP degrees for CTE vs TKG, `models/config.py:1055-1061`, and the
+    EP dispatch collective options `:602,685-686`).
+
+    Values name mesh axes for the DECODE graph's expert-activation constraints:
+    "ep", "tp", "ep_tp" (both), or None (replicated). Prefill keeps the default
+    experts->ep / expert_mlp->tp layout. GSPMD derives each graph's
+    dispatch/combine collectives from these shardings — the TPU equivalent of
+    the reference hand-picking AR_AG/RS_AG/AG_AR per sub-model."""
+
+    decode_experts: Optional[str] = "ep"
+    decode_expert_mlp: Optional[str] = "tp"
+
+    _VALID = (None, "ep", "tp", "ep_tp")
+
+    def validate(self) -> None:
+        for name in ("decode_experts", "decode_expert_mlp"):
+            if getattr(self, name) not in self._VALID:
+                raise ValueError(f"{name} must be one of {self._VALID}")
+        e = self.mesh_axes("decode_experts") or ()
+        m = self.mesh_axes("decode_expert_mlp") or ()
+        e = (e,) if isinstance(e, str) else e
+        m = (m,) if isinstance(m, str) else m
+        if set(e) & set(m):
+            raise ValueError(
+                f"decode_experts and decode_expert_mlp must use disjoint mesh "
+                f"axes (got {self.decode_experts!r} / {self.decode_expert_mlp!r})")
+
+    def mesh_axes(self, name: str):
+        v = getattr(self, name)
+        return ("ep", "tp") if v == "ep_tp" else v
+
+
+@dataclass
 class QuantizationConfig:
     """Weight/KV quantization knobs.
 
@@ -181,6 +216,7 @@ class TpuConfig:
     # Pallas stacked-cache decode kernels (KV-write DMA + length-aware attention,
     # ≈ reference TKG kernels); None = auto (TPU yes when the arch supports it)
     decode_kernel_enabled: Optional[bool] = None
+    moe_hybrid_sharding: Optional[MoEHybridShardingConfig] = None
     async_mode: bool = False
     paged_attention_enabled: bool = False
     pa_num_blocks: int = 0
@@ -232,6 +268,8 @@ class TpuConfig:
                              "kv_cache_dtype (e.g. float8_e4m3)")
         if self.on_device_sampling_config is not None:
             self.on_device_sampling_config.validate()
+        if self.moe_hybrid_sharding is not None:
+            self.moe_hybrid_sharding.validate()
         for cfg, bound, name in (
                 (self.context_encoding_buckets, self.max_context_length,
                  "context_encoding_buckets"),

@@ -55,6 +55,9 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--attention-dp", action="store_true",
                    help="decode attention batch-parallel over dp x tp "
                         "(replicated GQA kv heads)")
+    g.add_argument("--flash-decoding", action="store_true",
+                   help="KV-seq-sharded decode over the cp axis (flash decoding; "
+                        "requires --cp-degree > 1)")
     g.add_argument("--no-vocab-parallel", dest="vocab_parallel",
                    action="store_false", default=True)
 
@@ -89,13 +92,34 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--pa-block-size", type=int, default=128)
     g.add_argument("--quantize-weights", choices=["int8", "float8_e4m3"],
                    default=None, help="weight-only quantization dtype")
+    g.add_argument("--kv-cache-scale-mode", choices=["direct", "static"],
+                   default="direct",
+                   help="fp8 KV: direct cast, or calibrated static per-head scales")
     g.add_argument("--kv-cache-dtype", default=None,
                    help="fp8 KV cache dtype (e.g. float8_e4m3)")
     g.add_argument("--lora-ckpt", action="append", default=None, metavar="NAME=DIR",
                    help="repeatable; PEFT adapter dirs for multi-LoRA serving")
     g.add_argument("--max-loras", type=int, default=1)
     g.add_argument("--max-lora-rank", type=int, default=16)
+    g.add_argument("--dynamic-lora", action="store_true",
+                   help="host-side adapter store with LRU device-slot swapping "
+                        "(adapters registered from --lora-ckpt)")
+    g.add_argument("--adapter-names", default=None,
+                   help="comma-separated adapter name per prompt row "
+                        "('-' = base model); requires --dynamic-lora")
+    g.add_argument("--serve", action="store_true",
+                   help="drive the prompts through the continuous-batching "
+                        "runner (slot-based serving; honors --paged-attention "
+                        "and prefix caching)")
     g.add_argument("--speculation-length", type=int, default=0)
+    g.add_argument("--speculation-type", default="fused",
+                   choices=["fused", "eagle", "eagle3", "medusa"],
+                   help="speculative engine: fused draft/target, EAGLE chain, "
+                        "EAGLE3 dynamic tree, or Medusa heads")
+    g.add_argument("--eagle-depth", type=int, default=3)
+    g.add_argument("--eagle-beam", type=int, default=2)
+    g.add_argument("--eagle-branch", type=int, default=2)
+    g.add_argument("--medusa-heads", type=int, default=4)
     g.add_argument("--draft-model-path", default=None,
                    help="draft checkpoint for speculative decoding")
 
@@ -106,6 +130,8 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--temperature", type=float, default=1.0)
     g.add_argument("--global-topk", type=int, default=256)
     g.add_argument("--seed", type=int, default=0)
+    g.add_argument("--deterministic", action="store_true",
+                   help="fixed PRNG seed stream for reproducible sampling")
 
     g = p.add_argument_group("run modes")
     g.add_argument("--prompt", action="append", default=None,
@@ -114,6 +140,10 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["skip", "token-matching", "logit-matching"],
                    default="skip")
     g.add_argument("--divergence-difference-tol", type=float, default=0.001)
+    g.add_argument("--capture-on-divergence-dir", default=None, metavar="DIR",
+                   help="on a failed logit match, re-run the failing request "
+                        "with input+weight snapshots written to DIR "
+                        "(≈ reference auto-capture, inference_demo.py:635-649)")
     g.add_argument("--benchmark", action="store_true")
     g.add_argument("--benchmark-runs", type=int, default=5)
     g.add_argument("--verbose", action="store_true")
@@ -124,7 +154,8 @@ def create_tpu_config(args: argparse.Namespace) -> TpuConfig:
     """≈ reference `create_neuron_config` (`inference_demo.py:436-490`)."""
     sampling = OnDeviceSamplingConfig(
         do_sample=args.do_sample, top_k=args.top_k, top_p=args.top_p,
-        temperature=args.temperature, global_topk=args.global_topk)
+        temperature=args.temperature, global_topk=args.global_topk,
+        deterministic=args.deterministic)
     from .config import (LoraServingConfig, QuantizationConfig, SpeculationConfig)
 
     quant = None
@@ -132,7 +163,8 @@ def create_tpu_config(args: argparse.Namespace) -> TpuConfig:
         quant = QuantizationConfig(
             quantize_weights=bool(args.quantize_weights),
             weight_dtype=args.quantize_weights or "int8",
-            kv_cache_dtype=args.kv_cache_dtype)
+            kv_cache_dtype=args.kv_cache_dtype,
+            kv_cache_scale_mode=args.kv_cache_scale_mode)
     lora = None
     if args.lora_ckpt:
         for spec in args.lora_ckpt:
@@ -157,6 +189,7 @@ def create_tpu_config(args: argparse.Namespace) -> TpuConfig:
         ep_degree=args.ep_degree,
         sequence_parallel_enabled=args.sequence_parallel,
         attention_dp_enabled=args.attention_dp,
+        flash_decoding_enabled=args.flash_decoding,
         vocab_parallel=args.vocab_parallel,
         dtype=args.dtype,
         enable_bucketing=args.enable_bucketing,
@@ -204,12 +237,56 @@ def run_inference(args: argparse.Namespace) -> int:
 
     tokenizer = _try_load_tokenizer(args.model_path)
 
+    if args.dynamic_lora:
+        if not args.lora_ckpt:
+            raise SystemExit("--dynamic-lora requires --lora-ckpt NAME=DIR entries")
+        from .modules.lora import DynamicLoraManager
+
+        mgr = DynamicLoraManager(app)
+        for spec in args.lora_ckpt:
+            name, adir = spec.split("=", 1)
+            mgr.register_path(name, adir)
+        app._dynamic_lora = mgr
+        logger.info("dynamic LoRA: %d adapters registered, %d device slots",
+                    len(mgr.host), mgr.spec.max_loras)
+
     if args.check_accuracy_mode != "skip":
         rc = _run_accuracy_check(args, app, tokenizer)
         if rc != 0:
             return rc
 
-    if args.speculation_length:
+    if args.speculation_length or args.speculation_type != "fused":
+        spec_model = _build_spec_engine(args, app)
+        input_ids, attention_mask = _encode_prompts(args, tokenizer,
+                                                    app.arch_args.vocab_size)
+        kwargs = {}
+        if args.speculation_type == "fused":
+            kwargs = dict(attention_mask=attention_mask, seed=args.seed)
+        out = spec_model.generate(input_ids, max_new_tokens=args.max_new_tokens,
+                                  **kwargs)
+        if tokenizer is not None:
+            for row in out.tokens:
+                print(tokenizer.decode([t for t in row if t >= 0]))
+        else:
+            print("speculative tokens:")
+            print(out.tokens)
+    elif args.serve:
+        _run_serving(args, app, tokenizer)
+    elif args.prompt:
+        _run_generation(args, app, tokenizer)
+
+    if args.benchmark:
+        report = benchmark_sampling(app, max_new_tokens=args.max_new_tokens,
+                                    n_runs=args.benchmark_runs,
+                                    report_dir=args.compiled_path)
+        print(json.dumps(report.to_dict(), indent=2))
+    return 0
+
+
+def _build_spec_engine(args, app):
+    """Construct the requested speculative engine (≈ reference draft-model setup,
+    `inference_demo.py`: fused/standard/Medusa/EAGLE routing)."""
+    if args.speculation_type == "fused":
         if not args.draft_model_path:
             raise SystemExit("--speculation-length requires --draft-model-path")
         from .runtime.speculation import FusedSpeculativeModel
@@ -221,29 +298,66 @@ def run_inference(args: argparse.Namespace) -> int:
         draft_cfg = create_tpu_config(args)
         draft_cfg.speculation_config = None
         draft = draft_cls.from_pretrained(args.draft_model_path, draft_cfg)
-        spec_model = FusedSpeculativeModel(app, draft,
-                                           args.speculation_length,
-                                           greedy=not args.do_sample)
-        input_ids, attention_mask = _encode_prompts(args, tokenizer,
-                                                    app.arch_args.vocab_size)
-        out = spec_model.generate(input_ids, attention_mask=attention_mask,
-                                  max_new_tokens=args.max_new_tokens,
-                                  seed=args.seed)
-        if tokenizer is not None:
-            for row in out.tokens:
-                print(tokenizer.decode([t for t in row if t >= 0]))
-        else:
-            print("speculative tokens:")
-            print(out.tokens)
-    elif args.prompt:
-        _run_generation(args, app, tokenizer)
+        return FusedSpeculativeModel(app, draft, args.speculation_length,
+                                     greedy=not args.do_sample)
+    if args.speculation_type == "medusa":
+        from .runtime.medusa import MedusaModel
 
-    if args.benchmark:
-        report = benchmark_sampling(app, max_new_tokens=args.max_new_tokens,
-                                    n_runs=args.benchmark_runs,
-                                    report_dir=args.compiled_path)
-        print(json.dumps(report.to_dict(), indent=2))
-    return 0
+        engine = MedusaModel(app, num_medusa_heads=args.medusa_heads)
+        if args.draft_model_path:
+            from .utils import checkpoint as ckpt_lib
+
+            engine.load_heads(ckpt_lib.load_state_dict(args.draft_model_path))
+        else:
+            logger.warning("no --draft-model-path: random Medusa heads "
+                           "(exactness holds; acceptance will be ~1)")
+            engine.load_random_heads()
+        return engine
+    # EAGLE / EAGLE3 chain or dynamic-tree drafts
+    from .runtime.eagle import EagleSpeculativeModel, draft_args_from_target
+
+    d_args = draft_args_from_target(app.arch_args, num_layers=1)
+    if args.speculation_type == "eagle":
+        engine = EagleSpeculativeModel(app, d_args,
+                                       args.speculation_length or 5)
+    else:
+        from .runtime.eagle3 import Eagle3SpeculativeModel
+
+        engine = Eagle3SpeculativeModel(app, d_args, depth=args.eagle_depth,
+                                        beam=args.eagle_beam,
+                                        branch=args.eagle_branch)
+    if args.draft_model_path:
+        from .utils import checkpoint as ckpt_lib
+
+        engine.load_draft(ckpt_lib.load_state_dict(args.draft_model_path))
+    else:
+        logger.warning("no --draft-model-path: random EAGLE draft "
+                       "(exactness holds; acceptance will be ~1)")
+        engine.load_random_draft()
+    return engine
+
+
+def _run_serving(args, app, tokenizer) -> None:
+    """Slot-based continuous-batching serving over the CLI prompts
+    (≈ the reference's continuous-batching serve path)."""
+    from .runtime.continuous_batching import ContinuousBatchingRunner
+
+    runner = ContinuousBatchingRunner(app)
+    input_ids, attention_mask = _encode_prompts(args, tokenizer,
+                                                app.arch_args.vocab_size)
+    rids = []
+    for i in range(input_ids.shape[0]):
+        row = input_ids[i]
+        if attention_mask is not None:
+            row = row[attention_mask[i] > 0]
+        rids.append(runner.submit(row, max_new_tokens=args.max_new_tokens))
+    results = runner.run_to_completion(seed=args.seed)
+    for rid in rids:
+        toks = results[rid]
+        if tokenizer is not None:
+            print(tokenizer.decode(toks))
+        else:
+            print(f"request {rid}: {toks}")
 
 
 def _try_load_tokenizer(model_path: str):
@@ -303,6 +417,22 @@ def _run_accuracy_check(args, app, tokenizer) -> int:
               f"max_abs_err={report.max_abs_error:.5f} "
               f"top1_match={report.top1_match_rate:.4f} "
               f"divergence_index={report.divergence_index}")
+        if not report.passed and args.capture_on_divergence_dir:
+            # ≈ reference auto-capture of failing inputs
+            # (`inference_demo.py:635-649`): replay the failing request with
+            # env-driven snapshots (utils/snapshot.py) for offline repro
+            import os
+
+            logger.warning("logit match failed; capturing repro snapshots "
+                           "to %s", args.capture_on_divergence_dir)
+            os.environ["TPUINF_CAPTURE_DIR"] = args.capture_on_divergence_dir
+            os.environ["TPUINF_CAPTURE_WEIGHTS"] = "1"
+            try:
+                app.generate(input_ids, attention_mask=attention_mask,
+                             max_new_tokens=args.max_new_tokens)
+            finally:
+                os.environ.pop("TPUINF_CAPTURE_DIR", None)
+                os.environ.pop("TPUINF_CAPTURE_WEIGHTS", None)
         return 0 if report.passed else 1
 
     from .utils.accuracy import get_hf_expected_outputs

@@ -847,7 +847,9 @@ def _decoder_layer(
         resid = h
         hn = _norm(h, lp["ln2"], args, lp.get("ln2_b"))
         if args.moe is not None:
-            ffn = moe_block(lp, args, hn, mesh, rules, _ACTIVATIONS[args.activation])
+            ffn = moe_block(lp, args, hn, mesh, rules,
+                            _ACTIVATIONS[args.activation],
+                            decode=positions is not None)
         else:
             ffn = _mlp(lp, args, hn, mesh, rules, adapter_ids)
         mlp_out = constrain(ffn, ("batch", None, None), rules, mesh=mesh)
@@ -870,7 +872,9 @@ def _decoder_layer(
         resid = h
         hn = _norm(h, lp["ln2"], args, lp.get("ln2_b"))
         if args.moe is not None:
-            ffn = moe_block(lp, args, hn, mesh, rules, _ACTIVATIONS[args.activation])
+            ffn = moe_block(lp, args, hn, mesh, rules,
+                            _ACTIVATIONS[args.activation],
+                            decode=positions is not None)
         else:
             ffn = _mlp(lp, args, hn, mesh, rules, adapter_ids)
         h = resid + rm * constrain(ffn, ("batch", None, None), rules, mesh=mesh)
@@ -973,7 +977,9 @@ def _decoder_layer(
     resid = h
     hn = _norm(h, lp["ln2"], args, lp.get("ln2_b"))
     if args.moe is not None:
-        ffn = moe_block(lp, args, hn, mesh, rules, _ACTIVATIONS[args.activation])
+        ffn = moe_block(lp, args, hn, mesh, rules,
+                            _ACTIVATIONS[args.activation],
+                            decode=positions is not None)
     else:
         ffn = _mlp(lp, args, hn, mesh, rules, adapter_ids)
     mlp_out = constrain(ffn, ("batch", None, None), rules, mesh=mesh)
@@ -1158,9 +1164,13 @@ def _run_stack_decode_kernel(params: Params, args: ModelArchArgs, h, cos, sin, m
                                        kv_scales=kvs)
         return (new_h, ck, cv), ()
 
+    import os as _os
+
+    unroll = int(_os.environ.get("TPUINF_DECODE_UNROLL", "1"))
     (h, k_new, v_new), _ = jax.lax.scan(
         body, (h, cache["k"], cache["v"]),
-        (params["layers"], jnp.arange(L, dtype=jnp.int32)))
+        (params["layers"], jnp.arange(L, dtype=jnp.int32)),
+        unroll=max(1, unroll))
     return h, {**cache, "k": k_new, "v": v_new}
 
 

@@ -166,13 +166,17 @@ def route(router_w: jnp.ndarray, x: jnp.ndarray, moe: MoEArgs,
 
 
 def moe_block(lp, args, hn: jnp.ndarray, mesh, rules,
-              activation) -> jnp.ndarray:
+              activation, decode: bool = False) -> jnp.ndarray:
     """(B, S, H) -> (B, S, H) through the MoE FFN.
 
     ``lp`` carries this layer's stacked expert weights: ``router`` (H, E), ``wg``/``wu``
     (E, H, I), ``wd`` (E, I, H), plus optional shared-expert weights.
     """
     moe: MoEArgs = args.moe
+    # decode graphs constrain expert activations to the decode_* MoE axes, which
+    # hybrid sharding may remap (identical to prefill by default)
+    e_ax = "decode_experts" if decode else "experts"
+    m_ax = "decode_expert_mlp" if decode else "expert_mlp"
     if moe.scale_expert_input and moe.expert_bias:
         # unselected experts see zero input but nonzero bias; the unweighted sum
         # would add bias-derived garbage from every expert
@@ -187,7 +191,7 @@ def moe_block(lp, args, hn: jnp.ndarray, mesh, rules,
         # Llama4: expert input pre-scaled by its gate (unselected experts see zeros,
         # which the bias-free glu maps back to zero); combine is then an unweighted sum
         xe = gates.astype(x.dtype).T[:, :, None] * x[None, :, :]    # (E, N, H)
-        xe = constrain(xe, ("experts", "batch", None), rules, mesh=mesh)
+        xe = constrain(xe, (e_ax, "batch", None), rules, mesh=mesh)
         gate_proj = qeinsum("enh,ehi->eni", xe, lp["wg"])
         up_proj = qeinsum("enh,ehi->eni", xe, lp["wu"])
     else:
@@ -205,7 +209,7 @@ def moe_block(lp, args, hn: jnp.ndarray, mesh, rules,
         inter = (up_proj + 1.0) * glu
     else:
         inter = activation(gate_proj) * up_proj
-    inter = constrain(inter, ("experts", None, "expert_mlp"), rules, mesh=mesh)
+    inter = constrain(inter, (e_ax, None, m_ax), rules, mesh=mesh)
     per_expert = qeinsum("eni,eih->enh", inter, lp["wd"])           # (E, N, H)
     if moe.expert_bias:
         per_expert = per_expert + lp["bd"][:, None, :]

@@ -65,6 +65,15 @@ DEFAULT_RULES: Dict[str, MeshAxes] = {
     "decode_batch": AXIS_DP,
     "decode_heads": AXIS_TP,
     "decode_kv_heads": AXIS_TP,
+    # decode-time MoE dispatch layout (≈ reference hybrid sharding: different
+    # TP/EP degrees for CTE vs TKG, `models/config.py:1055-1061`, and the
+    # AR_AG/RS_AG/AG_AR dispatch options, `:602,685-686`). By default identical
+    # to the prefill MoE layout; `moe_hybrid_sharding` remaps these so the
+    # decode graph's expert activations constrain to a different axis split —
+    # GSPMD then derives the dispatch/combine collectives for each graph, the
+    # TPU form of picking the dispatch CC algorithm per sub-model.
+    "decode_experts": AXIS_EP,
+    "decode_expert_mlp": AXIS_TP,
 }
 
 

@@ -123,6 +123,14 @@ class TpuModelForCausalLM:
             self.sharding_rules["decode_batch"] = (AXIS_DP, AXIS_TP)
             self.sharding_rules["decode_heads"] = None
             self.sharding_rules["decode_kv_heads"] = None
+        if self.tpu_config.moe_hybrid_sharding is not None:
+            # hybrid MoE sharding: the decode graph's expert activations take a
+            # different axis split than prefill (≈ reference CTE-vs-TKG TP/EP
+            # groups + dispatch CC options, `models/config.py:1055-1061,602`)
+            h = self.tpu_config.moe_hybrid_sharding
+            self.sharding_rules["decode_experts"] = h.mesh_axes("decode_experts")
+            self.sharding_rules["decode_expert_mlp"] = h.mesh_axes(
+                "decode_expert_mlp")
 
         self.params = None
         self.kv_cache = None

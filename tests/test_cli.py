@@ -1,8 +1,13 @@
 """CLI flag -> config mapping (≈ reference `create_neuron_config` coverage)."""
 
+import pytest
+
 from neuronx_distributed_inference_tpu.inference_demo import (build_parser,
                                                               create_tpu_config)
 
+
+
+pytestmark = pytest.mark.slow  # heavy e2e: excluded from the fast gate
 
 def test_flags_map_to_config():
     args = build_parser().parse_args([
@@ -40,3 +45,42 @@ def test_speculation_config_mapping():
     cfg = create_tpu_config(args)
     assert cfg.speculation_config.speculation_length == 4
     assert cfg.speculation_config.draft_model_path == "/tmp/d"
+
+
+def test_new_serving_flags_map_to_config():
+    args = build_parser().parse_args([
+        "--model-path", "/tmp/x", "--cp-degree", "2", "--flash-decoding",
+        "--kv-cache-dtype", "float8_e4m3", "--kv-cache-scale-mode", "static",
+        "--deterministic", "--seq-len", "256",
+    ])
+    cfg = create_tpu_config(args)
+    assert cfg.flash_decoding_enabled and cfg.cp_degree == 2
+    assert cfg.quantization_config.kv_cache_scale_mode == "static"
+    assert cfg.on_device_sampling_config.deterministic
+
+
+def test_cli_end_to_end_eagle3_and_serve(tmp_path):
+    """Drive the CLI main() twice against a tiny saved checkpoint: once through
+    the EAGLE3 engine (random draft — exactness holds), once through the
+    continuous-batching serve mode."""
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM as HFLlama
+
+    from neuronx_distributed_inference_tpu.inference_demo import main
+
+    ckpt = str(tmp_path / "ckpt")
+    cfg = LlamaConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2)
+    torch.manual_seed(0)
+    HFLlama(cfg).eval().save_pretrained(ckpt, safe_serialization=True)
+
+    base = ["--model-path", ckpt, "--batch-size", "2", "--seq-len", "64",
+            "--max-context-length", "32", "--dtype", "float32",
+            "--max-new-tokens", "6", "--check-accuracy-mode", "skip",
+            "--context-encoding-buckets", "16", "32",
+            "--token-generation-buckets", "32", "64"]
+    assert main(base + ["--speculation-type", "eagle3",
+                        "--eagle-depth", "2"]) == 0
+    assert main(base + ["--serve", "--continuous-batching",
+                        "--prompt", "x", "--prompt", "y"]) == 0

@@ -17,6 +17,9 @@ from neuronx_distributed_inference_tpu.runtime.continuous_batching import (
     ContinuousBatchingRunner)
 
 
+
+pytestmark = pytest.mark.slow  # heavy e2e: excluded from the fast gate
+
 def _make_app(hf_cfg, paged=False, slots=2):
     tpu_cfg = TpuConfig(
         batch_size=slots, seq_len=96, max_context_length=32, dtype="float32",

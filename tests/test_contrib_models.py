@@ -11,6 +11,9 @@ import torch
 from neuronx_distributed_inference_tpu.config import TpuConfig, load_pretrained_config
 
 
+
+pytestmark = pytest.mark.slow  # heavy e2e: excluded from the fast gate
+
 def _tpu_cfg():
     return TpuConfig(batch_size=2, seq_len=64, max_context_length=32, dtype="float32",
                      context_encoding_buckets=[16, 32],

@@ -17,6 +17,9 @@ from neuronx_distributed_inference_tpu.models.llama4 import Llama4ForCausalLM
 from neuronx_distributed_inference_tpu.runtime.continuous_batching import (
     ContinuousBatchingRunner)
 
+
+pytestmark = pytest.mark.slow  # heavy e2e: excluded from the fast gate
+
 DEEPSEEK_CFG = {
     "model_type": "deepseek_v3", "vocab_size": 256, "hidden_size": 64,
     "num_hidden_layers": 3, "num_attention_heads": 4, "intermediate_size": 128,

@@ -10,6 +10,9 @@ import jax
 import jax.numpy as jnp
 
 
+
+pytestmark = pytest.mark.slow  # heavy e2e: excluded from the fast gate
+
 def test_t5_encoder_matches_hf():
     from transformers import T5Config, T5EncoderModel
 

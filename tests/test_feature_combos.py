@@ -14,6 +14,9 @@ from neuronx_distributed_inference_tpu.models.llama.modeling_llama import (
     LlamaForCausalLM, LlamaInferenceConfig)
 
 
+
+pytestmark = pytest.mark.slow  # heavy e2e: excluded from the fast gate
+
 def _make(hf_cfg, *, quant=False, cb=False, paged=False, lora=False, batch=2,
           seq_len=96, cte=(16, 32)):
     cfg = TpuConfig(

@@ -14,6 +14,9 @@ from neuronx_distributed_inference_tpu.utils import accuracy as acc
 from neuronx_distributed_inference_tpu.utils import benchmark as bench
 
 
+
+pytestmark = pytest.mark.slow  # heavy e2e: excluded from the fast gate
+
 # --- accuracy -----------------------------------------------------------------------
 
 def test_token_accuracy_pass_and_fail():

@@ -24,6 +24,9 @@ from neuronx_distributed_inference_tpu.ops.paged_decode import (
     paged_decode_attention_stacked)
 
 
+
+pytestmark = pytest.mark.slow  # heavy e2e: excluded from the fast gate
+
 @pytest.fixture(scope="module")
 def rng():
     return np.random.default_rng(0)

@@ -14,6 +14,9 @@ from neuronx_distributed_inference_tpu.models.llama.modeling_llama import (
 from neuronx_distributed_inference_tpu.modules.lora import (
     LoraSpec, lora_delta, merge_adapter)
 
+
+pytestmark = pytest.mark.slow  # heavy e2e: excluded from the fast gate
+
 RANK, ALPHA = 4, 8.0
 TARGETS = ("wq", "wv", "wg")
 _PEFT = {"wq": "self_attn.q_proj", "wv": "self_attn.v_proj", "wg": "mlp.gate_proj"}

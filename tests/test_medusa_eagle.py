@@ -19,6 +19,9 @@ from neuronx_distributed_inference_tpu.runtime.eagle import (
 from neuronx_distributed_inference_tpu.runtime.medusa import MedusaModel
 
 
+
+pytestmark = pytest.mark.slow  # heavy e2e: excluded from the fast gate
+
 def _make_app(hf_cfg, seed, batch=2):
     tpu_cfg = TpuConfig(
         batch_size=batch, seq_len=128, max_context_length=32, dtype="float32",

@@ -14,6 +14,9 @@ from neuronx_distributed_inference_tpu.config import TpuConfig, load_pretrained_
 from neuronx_distributed_inference_tpu.ops.moe import MoEArgs, route
 
 
+
+pytestmark = pytest.mark.slow  # heavy e2e: excluded from the fast gate
+
 def _tpu_cfg(**kw):
     base = dict(batch_size=2, seq_len=64, max_context_length=32, dtype="float32",
                 context_encoding_buckets=[16, 32], token_generation_buckets=[32, 64])
@@ -123,3 +126,30 @@ def test_moe_tensor_parallel_matches_single_device():
     out1 = app1.generate(input_ids, max_new_tokens=4)
     out2 = app2.generate(input_ids, max_new_tokens=4)
     np.testing.assert_array_equal(out1.tokens, out2.tokens)
+
+
+@pytest.mark.parametrize("mode", ["tp", "ep_tp", None])
+def test_moe_hybrid_decode_sharding_matches_default(mode):
+    """Hybrid MoE sharding (≈ reference CTE-vs-TKG TP/EP groups + dispatch
+    options, `models/config.py:1055-1061,602`): remapping the DECODE graph's
+    expert-activation axes must not change a single token or logit — GSPMD
+    just derives different dispatch/combine collectives per graph."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    from neuronx_distributed_inference_tpu.config import MoEHybridShardingConfig
+
+    app_cls, hf, cfg = _mixtral_pair()
+    base = _load(app_cls, hf, cfg, _tpu_cfg(tp_degree=2, ep_degree=4))
+    hybrid = _load(app_cls, hf, cfg, _tpu_cfg(
+        tp_degree=2, ep_degree=4,
+        moe_hybrid_sharding=MoEHybridShardingConfig(
+            decode_experts=mode,
+            decode_expert_mlp="ep" if mode == "tp" else None)))
+
+    rng = np.random.default_rng(3)
+    input_ids = rng.integers(1, 256, size=(2, 10)).astype(np.int64)
+    out_b = base.generate(input_ids, max_new_tokens=4, return_logits=True)
+    out_h = hybrid.generate(input_ids, max_new_tokens=4, return_logits=True)
+    np.testing.assert_array_equal(out_b.tokens, out_h.tokens)
+    for lb, lh in zip(out_b.logits, out_h.logits):
+        np.testing.assert_allclose(lh, lb, atol=2e-4, rtol=1e-3)

@@ -17,6 +17,9 @@ from neuronx_distributed_inference_tpu.ops.paged_decode import (
     paged_decode_attention_stacked, write_paged_stacked_kv)
 
 
+
+pytestmark = pytest.mark.slow  # heavy e2e: excluded from the fast gate
+
 def _ref_attend(q, k_att, v_att, positions, scale, window=None):
     """Masked jnp attention over the gathered (B, H, S, D) view (the gather path)."""
     b, hq, t, d = q.shape

@@ -12,7 +12,11 @@ import numpy as np
 from neuronx_distributed_inference_tpu.config import TpuConfig, load_pretrained_config
 from neuronx_distributed_inference_tpu.models.gemma3 import Gemma3ForCausalLM
 from neuronx_distributed_inference_tpu.modules import kvcache
+import pytest
 
+
+
+pytestmark = pytest.mark.slow  # heavy e2e: excluded from the fast gate
 
 GEMMA3_CFG = {
     "model_type": "gemma3_text", "vocab_size": 256, "hidden_size": 64,

@@ -81,3 +81,45 @@ def test_deterministic_same_key_same_tokens():
     a = S.sample(logits, jnp.asarray(params), jax.random.PRNGKey(7), cfg)
     b = S.sample(logits, jnp.asarray(params), jax.random.PRNGKey(7), cfg)
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_dp_sharded_sampling_matches_single_device(tiny_llama_hf_config):
+    """DataParallelSampler analog (≈ reference `sampling.py:469-569`): under a
+    dp-sharded mesh the on-device sampler runs batch-parallel via GSPMD — the
+    same seed must commit exactly the same tokens as the unsharded mesh, for
+    greedy AND stochastic sampling."""
+    from neuronx_distributed_inference_tpu.config import (
+        TpuConfig, load_pretrained_config)
+    from neuronx_distributed_inference_tpu.models.llama.modeling_llama import (
+        LlamaForCausalLM, LlamaInferenceConfig)
+
+    if len(jax.devices()) < 2:
+        import pytest
+
+        pytest.skip("needs 2 virtual devices")
+
+    def build(dp):
+        cfg = TpuConfig(batch_size=4, seq_len=64, max_context_length=32,
+                        dtype="float32", dp_degree=dp,
+                        is_continuous_batching=dp > 1,
+                        context_encoding_buckets=[16, 32],
+                        token_generation_buckets=[32, 64],
+                        on_device_sampling_config=OnDeviceSamplingConfig(
+                            do_sample=True, top_k=8, top_p=0.9,
+                            temperature=0.8))
+        config = LlamaInferenceConfig(
+            cfg, load_config=load_pretrained_config(tiny_llama_hf_config))
+        app = LlamaForCausalLM(None, config)
+        app.load_random(seed=0)
+        return app
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(1, 256, size=(4, 10)).astype(np.int32)
+    out1 = build(1).generate(ids, max_new_tokens=8, seed=7)
+    out2 = build(2).generate(ids, max_new_tokens=8, seed=7)
+    np.testing.assert_array_equal(out1.tokens, out2.tokens)
+
+    sp = S.prepare_sampling_params(4)           # greedy rows via dynamic params
+    outg1 = build(1).generate(ids, max_new_tokens=8, sampling_params=sp)
+    outg2 = build(2).generate(ids, max_new_tokens=8, sampling_params=sp)
+    np.testing.assert_array_equal(outg1.tokens, outg2.tokens)

@@ -15,6 +15,9 @@ from neuronx_distributed_inference_tpu.models.llama.modeling_llama import (
 from neuronx_distributed_inference_tpu.parallel import mesh as mesh_lib
 
 
+
+pytestmark = pytest.mark.slow  # heavy e2e: excluded from the fast gate
+
 HF_CFG = {
     "model_type": "llama",
     "vocab_size": 256,

@@ -17,6 +17,9 @@ from neuronx_distributed_inference_tpu.ops.sampling import prepare_sampling_para
 from neuronx_distributed_inference_tpu.runtime.speculation import FusedSpeculativeModel
 
 
+
+pytestmark = pytest.mark.slow  # heavy e2e: excluded from the fast gate
+
 def _make_app(hf_cfg, seed, batch=2, do_sample=False):
     tpu_cfg = TpuConfig(
         batch_size=batch, seq_len=128, max_context_length=32, dtype="float32",

@@ -10,6 +10,9 @@ import torch
 from neuronx_distributed_inference_tpu.config import TpuConfig, load_pretrained_config
 
 
+
+pytestmark = pytest.mark.slow  # heavy e2e: excluded from the fast gate
+
 @pytest.fixture(scope="module")
 def tiny_whisper():
     from transformers import WhisperConfig, WhisperForConditionalGeneration

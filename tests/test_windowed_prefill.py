@@ -18,6 +18,9 @@ from neuronx_distributed_inference_tpu.runtime.continuous_batching import (
     ContinuousBatchingRunner)
 
 
+
+pytestmark = pytest.mark.slow  # heavy e2e: excluded from the fast gate
+
 def _make_app(hf_cfg, cte, batch=2, seq_len=128, batch_buckets=None, cb=False):
     tpu_cfg = TpuConfig(
         batch_size=batch, seq_len=seq_len, max_context_length=cte[-1],
