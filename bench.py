@@ -14,6 +14,7 @@ exactly like the reference's random-weight integration benchmarks (SURVEY §4).
 
 import json
 import sys
+import time
 
 import numpy as np
 
@@ -130,11 +131,30 @@ def main() -> None:
     # serving TTFT: a single request prefilled at batch bucket 1 (first-class
     # metric, ≈ reference TTFT reporting `utils/benchmark.py:479-494`); the bulk
     # ttft above amortizes a full batch-64 prefill and is NOT time-to-first-token
-    # for one user.
-    # NOTE (profiled): the device-side bs=1 prefill is ~17 ms; the remainder of the
-    # wall TTFT here is the axon tunnel's per-dispatch HTTP overhead (~3-6 ms per
-    # call x param-buffer marshaling), which local PJRT serving does not pay.
+    # for one user. Three numbers are reported so the wall figure is attributable:
+    #  - ttft_p50_ms        : wall time of the bs=1 prefill dispatch (what a
+    #                         client sees THROUGH THIS ENVIRONMENT'S TUNNEL)
+    #  - dispatch_floor_ms  : p50 wall time of a no-op jitted dispatch — the
+    #                         tunnel's irreducible blocking round trip (measured
+    #                         ~70 ms here; local PJRT serving does not pay it)
+    #  - ttft_device_ms     : event-timed on-device duration of the same bs=1
+    #                         prefill from a jax.profiler trace — the graph's
+    #                         true latency and the number BASELINE.md's <50 ms
+    #                         north star bounds
+    import jax
+    import jax.numpy as jnp
+
     single = input_ids[:1]
+    f_noop = jax.jit(lambda x: x + 1)
+    xs = jnp.zeros((8, 128), jnp.float32)
+    f_noop(xs).block_until_ready()
+    floor = []
+    for _ in range(10):
+        t0 = time.perf_counter()
+        f_noop(xs).block_until_ready()
+        floor.append(1000 * (time.perf_counter() - t0))
+    dispatch_floor_ms = float(np.percentile(floor, 50))
+
     ttfts = []
     for i in range(12):
         o1 = app.generate(single, max_new_tokens=1)
@@ -142,17 +162,79 @@ def main() -> None:
             ttfts.append(o1.ttft_s)
     ttft_p50_ms = 1000.0 * float(np.percentile(ttfts, 50))
 
+    from neuronx_distributed_inference_tpu.utils import profiling as prof
+
+    trace_dir = "/tmp/bench_ttft_trace"
+    import shutil
+
+    shutil.rmtree(trace_dir, ignore_errors=True)
+    with prof.trace(trace_dir):
+        app.generate(single, max_new_tokens=1)
+    dev = prof.device_time_ms(trace_dir, "prefill")
+    ttft_device_ms = round(dev, 2) if dev is not None else None
+
+    extra = {
+        # no real checkpoints exist in this environment: weights are synthetic
+        # random in the exact serving layout (the reference's own integration
+        # benchmarks use truncated random-weight models, SURVEY §4); real-weight
+        # token parity is covered by the HF-CPU parity suite at tiny scale
+        "weights": "synthetic-random (env has no real checkpoints)",
+        "p50_decode_step_ms": round(float(np.percentile(per_step_ms, 50)), 2),
+        "ttft_p50_ms": round(ttft_p50_ms, 1),
+        "ttft_device_ms": ttft_device_ms,
+        "dispatch_floor_ms": round(dispatch_floor_ms, 1),
+        "ttft_bulk_bs%d_s" % batch: round(out.ttft_s, 3),
+    }
+
+    if not small:
+        extra["paged_serving_tok_per_s"] = _paged_serving_throughput(hf_cfg, quant)
+
     print(json.dumps({
         "metric": name,
         "value": round(tok_per_s, 1),
         "unit": "tokens/s",
         "vs_baseline": round(tok_per_s / 2000.0, 3),
-        "extra": {
-            "p50_decode_step_ms": round(float(np.percentile(per_step_ms, 50)), 2),
-            "ttft_p50_ms": round(ttft_p50_ms, 1),
-            "ttft_bulk_bs%d_s" % batch: round(out.ttft_s, 3),
-        },
+        "extra": extra,
     }))
+
+
+def _paged_serving_throughput(hf_cfg, quant) -> float:
+    """Steady-state decode throughput of the PAGED continuous-batching serving
+    path with the Pallas ragged kernels (the production serving layout; the
+    headline metric above is the dense fixed-batch layout)."""
+    import time as _time
+
+    from neuronx_distributed_inference_tpu.config import (
+        TpuConfig, load_pretrained_config)
+    from neuronx_distributed_inference_tpu.models.llama.modeling_llama import (
+        LlamaForCausalLM, LlamaInferenceConfig)
+    from neuronx_distributed_inference_tpu.runtime.continuous_batching import (
+        ContinuousBatchingRunner)
+
+    bs, seq, block = 32, 1024, 128
+    cfg = TpuConfig(batch_size=bs, seq_len=seq, max_context_length=256,
+                    dtype="bfloat16", tp_degree=1,
+                    context_encoding_buckets=[256],
+                    token_generation_buckets=[seq],
+                    is_continuous_batching=True, paged_attention_enabled=True,
+                    pa_num_blocks=bs * (seq // block) + 8, pa_block_size=block,
+                    quantization_config=quant)
+    config = LlamaInferenceConfig(cfg, load_config=load_pretrained_config(hf_cfg))
+    app = LlamaForCausalLM(None, config)
+    app.load_host_params(_random_quantized_llama_params(hf_cfg, seed=0))
+    runner = ContinuousBatchingRunner(app, decode_chunk=32)
+    rng = np.random.default_rng(0)
+    for _ in range(bs):
+        runner.submit(rng.integers(1, 100000, size=(200,)).astype(np.int32),
+                      max_new_tokens=700)
+    for _ in range(3):                        # place + warm the compiled chunks
+        runner.step()
+    t0 = _time.time()
+    n = 0
+    for _ in range(6):
+        runner.step()
+        n += 32
+    return round(bs * n / (_time.time() - t0), 1)
 
 
 if __name__ == "__main__":

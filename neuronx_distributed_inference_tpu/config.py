@@ -161,6 +161,10 @@ class QuantizationConfig:
     weight_dtype: str = "int8"       # int8 | float8_e4m3
     kv_cache_dtype: Optional[str] = None  # None = same as model dtype
     kv_cache_scale_mode: str = "direct"   # direct | static (fp8 caches only)
+    # int8 dynamic per-token activation quant on qkv/mlp projections (the TPU
+    # rmsnorm_quant analog — int8 x int8 rides the doubled-throughput MXU path);
+    # requires weight_dtype == "int8"
+    activation_quant: bool = False
 
 
 @dataclass
@@ -261,6 +265,9 @@ class TpuConfig:
         q = self.quantization_config
         if q is not None and q.kv_cache_scale_mode not in ("direct", "static"):
             raise ValueError("kv_cache_scale_mode must be 'direct' or 'static'")
+        if q is not None and q.activation_quant and (
+                not q.quantize_weights or q.weight_dtype != "int8"):
+            raise ValueError("activation_quant requires int8 weight quantization")
         if q is not None and q.kv_cache_scale_mode == "static" and (
                 q.kv_cache_dtype is None
                 or not q.kv_cache_dtype.startswith("float8")):

@@ -91,6 +91,10 @@ class ModelArchArgs:
     alibi: bool = False              # ALiBi additive attention bias (bloom/mpt);
     #                                  rope disabled via a zero inv_freq table
     embed_norm: bool = False         # LayerNorm on embeddings (bloom)
+    # int8 dynamic per-token ACTIVATION quantization on the norm-adjacent
+    # projections (qkv + mlp) — the TPU-native rmsnorm_quant analog (int8 MXU;
+    # v5e has no fp8 matmul units). Requires int8 weight quantization.
+    activation_quant: bool = False
     # --- contrib-arch primitives (round 3: granite/cohere/glm4/gemma2) ---
     residual_multiplier: float = 1.0  # granite scales each branch before the add
     logits_scale: float = 1.0         # cohere logit_scale / granite 1/logits_scaling
@@ -393,9 +397,10 @@ def _project_qkv(lp: Params, args: ModelArchArgs, hn: jnp.ndarray,
                  adapter_ids=None):
     """(B, S, H) -> q (B, nq, S, D), k/v (B, nkv, S, D)."""
     b, s, _ = hn.shape
-    q = qapply(hn, lp["wq"])
-    k = qapply(hn, lp["wk"])
-    v = qapply(hn, lp["wv"])
+    aq = args.activation_quant
+    q = qapply(hn, lp["wq"], act_quant=aq)
+    k = qapply(hn, lp["wk"], act_quant=aq)
+    v = qapply(hn, lp["wv"], act_quant=aq)
     if args.lora is not None:
         sc = args.lora.scaling
         q = apply_lora(lp, "wq", hn, q, adapter_ids, sc)
@@ -433,15 +438,16 @@ def _mlp(lp: Params, args: ModelArchArgs, hn: jnp.ndarray, mesh, rules,
         if args.mlp_bias:
             down = down + lp["bd"]
         return down
-    gate = qapply(hn, lp["wg"])
-    up = qapply(hn, lp["wu"])
+    aq = args.activation_quant
+    gate = qapply(hn, lp["wg"], act_quant=aq)
+    up = qapply(hn, lp["wu"], act_quant=aq)
     if args.lora is not None:
         sc = args.lora.scaling
         gate = apply_lora(lp, "wg", hn, gate, adapter_ids, sc)
         up = apply_lora(lp, "wu", hn, up, adapter_ids, sc)
     gate = act(gate)
     inter = constrain(gate * up, ("batch", None, "mlp"), rules, mesh=mesh)
-    down = qapply(inter, lp["wd"])
+    down = qapply(inter, lp["wd"], act_quant=aq)
     if args.lora is not None:
         down = apply_lora(lp, "wd", inter, down, adapter_ids, args.lora.scaling)
     return down

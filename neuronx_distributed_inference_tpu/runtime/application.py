@@ -93,6 +93,11 @@ class TpuModelForCausalLM:
                               rank=lora_cfg.max_lora_rank,
                               alpha=float(lora_cfg.max_lora_rank),
                               targets=targets))
+        qcfg = self.tpu_config.quantization_config
+        if qcfg is not None and qcfg.activation_quant:
+            import dataclasses as _dc
+
+            self.arch_args = _dc.replace(self.arch_args, activation_quant=True)
         self.mesh = mesh if mesh is not None else mesh_lib.mesh_from_config(
             self.tpu_config)
         self.sampling_config = (self.tpu_config.on_device_sampling_config

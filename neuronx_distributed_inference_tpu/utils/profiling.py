@@ -52,3 +52,37 @@ def enable_hlo_dump(dump_dir: str) -> None:
 def annotate(name: str):
     """Named trace span (shows up in the profiler timeline)."""
     return jax.profiler.TraceAnnotation(name)
+
+
+def device_time_ms(logdir: str, name_substr: str) -> Optional[float]:
+    """Sum the ON-DEVICE duration of top-level executable events whose name
+    contains ``name_substr`` in the trace under ``logdir``.
+
+    Parses the jax.profiler xplane output directly (the TPU plane's per-program
+    events, e.g. ``jit__prefill``). This is the event-timed device latency the
+    bench reports next to wall time — on tunneled environments wall time is
+    dominated by dispatch round-trips that local PJRT serving does not pay.
+    Returns None when no trace/plane/event is found."""
+    import glob as _glob
+
+    os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
+    try:
+        from tensorflow.tsl.profiler.protobuf import xplane_pb2
+    except Exception:
+        return None
+    total = 0.0
+    found = False
+    for p in _glob.glob(f"{logdir}/**/*.xplane.pb", recursive=True):
+        xs = xplane_pb2.XSpace()
+        with open(p, "rb") as f:
+            xs.ParseFromString(f.read())
+        for plane in xs.planes:
+            if "TPU" not in plane.name and "tpu" not in plane.name.lower():
+                continue
+            md = plane.event_metadata
+            for line in plane.lines:
+                for ev in line.events:
+                    if name_substr in md[ev.metadata_id].name:
+                        total += ev.duration_ps / 1e9   # ps -> ms
+                        found = True
+    return total if found else None

@@ -249,3 +249,37 @@ def test_static_kv_scales_kernel_paths_match_jnp(tiny_llama_hf_config):
         app.calibrate_kv_scales(ids)
         outs[kernel] = app.generate(ids, max_new_tokens=8).tokens
     np.testing.assert_array_equal(outs[True], outs[False])
+
+
+def test_activation_quant_close_to_weight_only(tiny_llama_hf_config):
+    """int8 dynamic per-token activation quant (the TPU rmsnorm_quant analog):
+    logits stay close to weight-only int8 and greedy tokens mostly agree."""
+    from neuronx_distributed_inference_tpu.config import QuantizationConfig
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(1, 256, size=(2, 10)).astype(np.int32)
+    outs = {}
+    for act in (False, True):
+        qc = QuantizationConfig(quantize_weights=True, weight_dtype="int8",
+                                activation_quant=act)
+        tpu_cfg = TpuConfig(batch_size=2, seq_len=64, max_context_length=32,
+                            dtype="float32", context_encoding_buckets=[16, 32],
+                            token_generation_buckets=[32, 64],
+                            quantization_config=qc)
+        config = LlamaInferenceConfig(
+            tpu_cfg, load_config=load_pretrained_config(tiny_llama_hf_config))
+        app = LlamaForCausalLM(None, config)
+        app.load_random(seed=0)
+        outs[act] = app.generate(ids, max_new_tokens=4, return_logits=True)
+    ref = np.asarray(outs[False].logits[0])
+    got = np.asarray(outs[True].logits[0])
+    scale = np.abs(ref).max()
+    assert np.abs(got - ref).max() < 0.05 * scale, np.abs(got - ref).max()
+
+    # misconfiguration is rejected loudly
+    import pytest
+
+    with pytest.raises(ValueError, match="activation_quant"):
+        TpuConfig(batch_size=1, seq_len=32,
+                  quantization_config=QuantizationConfig(
+                      quantize_weights=False, activation_quant=True))
