@@ -1,0 +1,119 @@
+"""Qwen2-MoE (Qwen1.5-MoE-A2.7B architecture) on the TPU framework (contrib port).
+
+Qwen2 attention (biased qkv) + fine-grained MoE with a sigmoid-gated SHARED
+expert running densely beside the routed experts (softmax-topk routing without
+renormalization) — maps onto ops/moe.py's shared-expert machinery.
+"""
+
+from typing import Dict
+
+import numpy as np
+
+from neuronx_distributed_inference_tpu.config import InferenceConfig
+from neuronx_distributed_inference_tpu.models.base import ModelArchArgs
+from neuronx_distributed_inference_tpu.ops import rope as rope_ops
+from neuronx_distributed_inference_tpu.ops.moe import MoEArgs
+from neuronx_distributed_inference_tpu.runtime.application import (
+    TpuModelForCausalLM)
+
+
+class Qwen2MoeInferenceConfig(InferenceConfig):
+    REQUIRED_ATTRIBUTES = ("hidden_size", "num_hidden_layers",
+                           "num_attention_heads", "num_key_value_heads",
+                           "vocab_size", "intermediate_size",
+                           "num_experts", "num_experts_per_tok",
+                           "moe_intermediate_size")
+
+    def add_derived_config(self) -> None:
+        for attr, default in (("rope_theta", 10000.0), ("rms_norm_eps", 1e-6),
+                              ("norm_topk_prob", False),
+                              ("shared_expert_intermediate_size", 0),
+                              ("decoder_sparse_step", 1),
+                              ("mlp_only_layers", [])):
+            if not hasattr(self, attr) or getattr(self, attr) is None:
+                setattr(self, attr, default)
+        if not hasattr(self, "head_dim") or self.head_dim is None:
+            self.head_dim = self.hidden_size // self.num_attention_heads
+        if self.decoder_sparse_step != 1 or self.mlp_only_layers:
+            raise ValueError("mixed dense/sparse Qwen2-MoE layers are not "
+                             "ported yet (decoder_sparse_step must be 1)")
+
+
+class Qwen2MoeForCausalLM(TpuModelForCausalLM):
+    @classmethod
+    def get_config_cls(cls):
+        return Qwen2MoeInferenceConfig
+
+    @classmethod
+    def arch_args_from_config(cls, config) -> ModelArchArgs:
+        return ModelArchArgs(
+            vocab_size=config.vocab_size,
+            hidden_size=config.hidden_size,
+            num_layers=config.num_hidden_layers,
+            num_heads=config.num_attention_heads,
+            num_kv_heads=config.num_key_value_heads,
+            head_dim=config.head_dim,
+            intermediate_size=config.moe_intermediate_size,
+            rms_norm_eps=config.rms_norm_eps,
+            attention_bias=True,
+            moe=MoEArgs(num_experts=config.num_experts,
+                        experts_per_tok=config.num_experts_per_tok,
+                        norm_topk_prob=bool(config.norm_topk_prob),
+                        shared_expert_intermediate_size=int(
+                            config.shared_expert_intermediate_size),
+                        shared_expert_gated=True),
+            tie_word_embeddings=bool(config.tie_word_embeddings),
+        )
+
+    @classmethod
+    def inv_freq_from_config(cls, config) -> np.ndarray:
+        return rope_ops.default_inv_freq(config.head_dim, float(config.rope_theta))
+
+    @classmethod
+    def convert_hf_state_dict(cls, state_dict: Dict[str, np.ndarray],
+                              config) -> Dict:
+        def get(name):
+            if name not in state_dict:
+                raise KeyError(f"missing weight {name}")
+            return np.asarray(state_dict[name])
+
+        def lin_t(name):
+            return np.ascontiguousarray(get(name).T)
+
+        E = config.num_experts
+        layers = {k: [] for k in
+                  ("ln1", "wq", "wk", "wv", "bq", "bk", "bv", "wo", "ln2",
+                   "router", "wg", "wu", "wd",
+                   "shared_wg", "shared_wu", "shared_wd", "shared_gate")}
+        for i in range(config.num_hidden_layers):
+            p = f"model.layers.{i}."
+            layers["wq"].append(lin_t(p + "self_attn.q_proj.weight"))
+            layers["wk"].append(lin_t(p + "self_attn.k_proj.weight"))
+            layers["wv"].append(lin_t(p + "self_attn.v_proj.weight"))
+            layers["bq"].append(get(p + "self_attn.q_proj.bias"))
+            layers["bk"].append(get(p + "self_attn.k_proj.bias"))
+            layers["bv"].append(get(p + "self_attn.v_proj.bias"))
+            layers["wo"].append(lin_t(p + "self_attn.o_proj.weight"))
+            layers["ln1"].append(get(p + "input_layernorm.weight"))
+            layers["ln2"].append(get(p + "post_attention_layernorm.weight"))
+            m = p + "mlp."
+            layers["router"].append(lin_t(m + "gate.weight"))
+            layers["wg"].append(np.stack(
+                [lin_t(m + f"experts.{e}.gate_proj.weight") for e in range(E)]))
+            layers["wu"].append(np.stack(
+                [lin_t(m + f"experts.{e}.up_proj.weight") for e in range(E)]))
+            layers["wd"].append(np.stack(
+                [lin_t(m + f"experts.{e}.down_proj.weight") for e in range(E)]))
+            layers["shared_wg"].append(lin_t(m + "shared_expert.gate_proj.weight"))
+            layers["shared_wu"].append(lin_t(m + "shared_expert.up_proj.weight"))
+            layers["shared_wd"].append(lin_t(m + "shared_expert.down_proj.weight"))
+            layers["shared_gate"].append(lin_t(m + "shared_expert_gate.weight"))
+        out = {
+            "embed": get("model.embed_tokens.weight"),
+            "layers": {k: np.stack(v) for k, v in layers.items()},
+            "final_norm": get("model.norm.weight"),
+            "rope_inv_freq": cls.inv_freq_from_config(config),
+        }
+        if not config.tie_word_embeddings:
+            out["lm_head"] = lin_t("lm_head.weight")
+        return out

@@ -28,6 +28,10 @@ CONTRIB_MODELS = {
     "recurrent_gemma": "contrib.models.recurrentgemma.src.modeling_recurrentgemma:RecurrentGemmaForCausalLM",
     "lfm2": "contrib.models.lfm2.src.modeling_lfm2:Lfm2ForCausalLM",
     "llava": "contrib.models.llava.src.modeling_llava:LlavaForConditionalGeneration",
+    "helium": "contrib.models.helium.src.modeling_helium:HeliumForCausalLM",
+    "qwen2_moe": "contrib.models.qwen2_moe.src.modeling_qwen2_moe:Qwen2MoeForCausalLM",
+    "olmo2": "contrib.models.olmo2.src.modeling_olmo2:Olmo2ForCausalLM",
+    "nemotron": "contrib.models.nemotron.src.modeling_nemotron:NemotronForCausalLM",
 }
 
 for model_type, path in CONTRIB_MODELS.items():

@@ -63,6 +63,10 @@ class ModelArchArgs:
     attn_sinks: bool = False              # gpt-oss learned per-head attention sinks
     mlp_bias: bool = False
     qk_norm: bool = False                 # qwen3-style per-head RMSNorm on q/k
+    qk_norm_scope: str = "head"           # "head" (per-head) | "full" (olmo2: over
+    #                                       the whole flattened q/k projection)
+    pre_norms: bool = True                # False = no input norms; the branch
+    #                                       output norms (sandwich) carry alone (olmo2)
     sliding_window: Optional[int] = None  # gemma/gpt-oss SWA (applied to all layers if set)
     # per-layer attention kind, e.g. ("sliding", "sliding", ..., "full") — gemma3's
     # alternating local/global pattern; None = every layer identical
@@ -282,9 +286,11 @@ def init_params(args: ModelArchArgs, key: jax.Array, dtype=jnp.bfloat16,
                        for k, v in init_lora_params(args, args.lora).items()})
     norm_fill = 0.0 if args.zero_centered_norms else 1.0
     if args.qk_norm:
+        qn = args.q_size if args.qk_norm_scope == "full" else args.head_dim
+        kn = args.kv_size if args.qk_norm_scope == "full" else args.head_dim
         layers.update({
-            "q_norm": jnp.full((L, args.head_dim), norm_fill, dtype=dtype),
-            "k_norm": jnp.full((L, args.head_dim), norm_fill, dtype=dtype),
+            "q_norm": jnp.full((L, qn), norm_fill, dtype=dtype),
+            "k_norm": jnp.full((L, kn), norm_fill, dtype=dtype),
         })
     if args.sandwich_norms:
         layers.update({
@@ -330,6 +336,7 @@ _ACTIVATIONS = {
     "gelu_new": lambda x: jax.nn.gelu(x, approximate=True),
     "gelu_pytorch_tanh": lambda x: jax.nn.gelu(x, approximate=True),
     "relu": jax.nn.relu,
+    "relu2": lambda x: jnp.square(jax.nn.relu(x)),   # nemotron squared ReLU
 }
 
 
@@ -338,7 +345,8 @@ def _norm(x: jnp.ndarray, weight: jnp.ndarray, args: "ModelArchArgs",
     """Hidden-state norm: RMSNorm by default, LayerNorm (optionally biased) for
     DBRX/GPT-style archs."""
     if args.norm_type == "layer":
-        return layer_norm(x, weight,
+        w = weight + 1.0 if args.zero_centered_norms else weight   # nemotron LN1P
+        return layer_norm(x, w,
                           bias if bias is not None else jnp.zeros_like(weight),
                           eps=args.rms_norm_eps)
     return rms_norm(x, weight, args.rms_norm_eps,
@@ -415,10 +423,15 @@ def _project_qkv(lp: Params, args: ModelArchArgs, hn: jnp.ndarray,
         q = jnp.clip(q, -clip, clip)
         k = jnp.clip(k, -clip, clip)
         v = jnp.clip(v, -clip, clip)
+    if args.qk_norm and args.qk_norm_scope == "full":
+        # olmo2: RMSNorm over the whole flattened q/k projection output
+        zc = args.zero_centered_norms
+        q = rms_norm(q, lp["q_norm"], args.rms_norm_eps, zero_centered=zc)
+        k = rms_norm(k, lp["k_norm"], args.rms_norm_eps, zero_centered=zc)
     q = q.reshape(b, s, args.num_heads, args.head_dim).transpose(0, 2, 1, 3)
     k = k.reshape(b, s, args.num_kv_heads, args.head_dim).transpose(0, 2, 1, 3)
     v = v.reshape(b, s, args.num_kv_heads, args.head_dim).transpose(0, 2, 1, 3)
-    if args.qk_norm:
+    if args.qk_norm and args.qk_norm_scope == "head":
         zc = args.zero_centered_norms
         q = rms_norm(q, lp["q_norm"], args.rms_norm_eps, zero_centered=zc)
         k = rms_norm(k, lp["k_norm"], args.rms_norm_eps, zero_centered=zc)
@@ -742,7 +755,7 @@ def _decoder_layer(
 ):
     rm = args.residual_multiplier          # granite branch scaling (1.0 = no-op)
     resid = h
-    hn = _norm(h, lp["ln1"], args, lp.get("ln1_b"))
+    hn = (_norm(h, lp["ln1"], args, lp.get("ln1_b")) if args.pre_norms else h)
     q, k, v = _project_qkv(lp, args, hn, adapter_ids)
     if positions is None:
         # prefill activations shard along seq over cp (sequence/context parallelism,
@@ -851,7 +864,7 @@ def _decoder_layer(
         h = resid + rm * attn_out
 
         resid = h
-        hn = _norm(h, lp["ln2"], args, lp.get("ln2_b"))
+        hn = (_norm(h, lp["ln2"], args, lp.get("ln2_b")) if args.pre_norms else h)
         if args.moe is not None:
             ffn = moe_block(lp, args, hn, mesh, rules,
                             _ACTIVATIONS[args.activation],
@@ -876,7 +889,7 @@ def _decoder_layer(
         attn_out = constrain(attn_out, ("batch", None, None), rules, mesh=mesh)
         h = resid + rm * attn_out
         resid = h
-        hn = _norm(h, lp["ln2"], args, lp.get("ln2_b"))
+        hn = (_norm(h, lp["ln2"], args, lp.get("ln2_b")) if args.pre_norms else h)
         if args.moe is not None:
             ffn = moe_block(lp, args, hn, mesh, rules,
                             _ACTIVATIONS[args.activation],
@@ -981,7 +994,7 @@ def _decoder_layer(
     h = resid + rm * attn_out
 
     resid = h
-    hn = _norm(h, lp["ln2"], args, lp.get("ln2_b"))
+    hn = (_norm(h, lp["ln2"], args, lp.get("ln2_b")) if args.pre_norms else h)
     if args.moe is not None:
         ffn = moe_block(lp, args, hn, mesh, rules,
                             _ACTIVATIONS[args.activation],
@@ -1170,13 +1183,12 @@ def _run_stack_decode_kernel(params: Params, args: ModelArchArgs, h, cos, sin, m
                                        kv_scales=kvs)
         return (new_h, ck, cv), ()
 
-    import os as _os
-
-    unroll = int(_os.environ.get("TPUINF_DECODE_UNROLL", "1"))
+    # measured on-chip (round 3): unrolling this scan (lax.scan unroll>1) is
+    # ~8x SLOWER (128 ms/step at unroll=8 vs 16.5) — the per-layer Pallas write
+    # kernel calls serialize badly when unrolled; keep the rolled loop
     (h, k_new, v_new), _ = jax.lax.scan(
         body, (h, cache["k"], cache["v"]),
-        (params["layers"], jnp.arange(L, dtype=jnp.int32)),
-        unroll=max(1, unroll))
+        (params["layers"], jnp.arange(L, dtype=jnp.int32)))
     return h, {**cache, "k": k_new, "v": v_new}
 
 

@@ -50,7 +50,8 @@ def test_registry_resolves_contrib_models():
     for mt in ("gpt2", "opt", "gpt_neox", "phi", "phi3", "starcoder2", "falcon",
                "bloom", "mpt", "stablelm", "gemma", "biogpt",
                "granite", "cohere", "glm", "gemma2", "phimoe",
-               "recurrent_gemma", "lfm2", "llava"):
+               "recurrent_gemma", "lfm2", "llava",
+               "helium", "qwen2_moe", "olmo2", "nemotron"):
         assert get_model_cls(mt) is not None
 
 
@@ -435,3 +436,65 @@ def test_llava_clip_generate_matches_hf(tiny_clip_llava):
                            do_sample=False, pad_token_id=0)
     out_t = app.generate(tids, max_new_tokens=6)
     np.testing.assert_array_equal(out_t.tokens, hf_t[:, 10:].numpy())
+
+
+def test_helium_parity():
+    from transformers import HeliumConfig, HeliumForCausalLM as HFHelium
+
+    from contrib.models.helium.src.modeling_helium import HeliumForCausalLM
+
+    cfg = HeliumConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
+                       num_hidden_layers=2, num_attention_heads=4,
+                       num_key_value_heads=2, head_dim=16,
+                       pad_token_id=0, tie_word_embeddings=False)
+    torch.manual_seed(0)
+    hf = HFHelium(cfg).eval()
+    _run_parity(HeliumForCausalLM, hf, cfg)
+
+
+def test_qwen2_moe_parity():
+    from transformers import Qwen2MoeConfig, Qwen2MoeForCausalLM as HFQwen2Moe
+
+    from contrib.models.qwen2_moe.src.modeling_qwen2_moe import (
+        Qwen2MoeForCausalLM)
+
+    cfg = Qwen2MoeConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
+                         moe_intermediate_size=48,
+                         shared_expert_intermediate_size=96,
+                         num_hidden_layers=2, num_attention_heads=4,
+                         num_key_value_heads=2, num_experts=4,
+                         num_experts_per_tok=2, norm_topk_prob=False,
+                         decoder_sparse_step=1, mlp_only_layers=[],
+                         sliding_window=None, tie_word_embeddings=False)
+    torch.manual_seed(0)
+    hf = HFQwen2Moe(cfg).eval()
+    _run_parity(Qwen2MoeForCausalLM, hf, cfg, atol=1e-3, rtol=1e-3)
+
+
+def test_olmo2_parity():
+    from transformers import Olmo2Config, Olmo2ForCausalLM as HFOlmo2
+
+    from contrib.models.olmo2.src.modeling_olmo2 import Olmo2ForCausalLM
+
+    cfg = Olmo2Config(vocab_size=256, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, pad_token_id=0,
+                      tie_word_embeddings=False)
+    torch.manual_seed(0)
+    hf = HFOlmo2(cfg).eval()
+    _run_parity(Olmo2ForCausalLM, hf, cfg)
+
+
+def test_nemotron_parity():
+    from transformers import NemotronConfig, NemotronForCausalLM as HFNemotron
+
+    from contrib.models.nemotron.src.modeling_nemotron import NemotronForCausalLM
+
+    cfg = NemotronConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
+                         num_hidden_layers=2, num_attention_heads=4,
+                         num_key_value_heads=2, head_dim=16,
+                         partial_rotary_factor=0.5, hidden_act="relu2",
+                         pad_token_id=0, tie_word_embeddings=False)
+    torch.manual_seed(0)
+    hf = HFNemotron(cfg).eval()
+    _run_parity(NemotronForCausalLM, hf, cfg)
