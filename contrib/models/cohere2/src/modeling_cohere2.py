@@ -1,0 +1,112 @@
+"""Cohere2 / Command-R7B on the TPU framework (contrib port).
+
+≈ reference `contrib/models/c4ai-command-r7b-12-2024/`. Command-R7B combines
+the Cohere block (single-LayerNorm parallel residual, interleaved rotary,
+logit_scale, tied embeddings) with a 3:1 sliding/full layer pattern where the
+FULL-attention layers use NO positional encoding (NoPE). Mapping: the shared
+layer-pattern machinery (rolling window caches for sliding layers) with the
+full-layer rope table set to ZERO inverse frequencies — cos=1/sin=0 makes the
+rotation the identity, i.e. NoPE — and the sliding layers on the real rope
+table via the local-rope hook.
+"""
+
+from typing import Dict
+
+import numpy as np
+
+from neuronx_distributed_inference_tpu.config import InferenceConfig
+from neuronx_distributed_inference_tpu.models.base import ModelArchArgs
+from neuronx_distributed_inference_tpu.ops import rope as rope_ops
+from neuronx_distributed_inference_tpu.runtime.application import (
+    TpuModelForCausalLM)
+
+
+class Cohere2InferenceConfig(InferenceConfig):
+    REQUIRED_ATTRIBUTES = ("hidden_size", "num_hidden_layers",
+                           "num_attention_heads", "num_key_value_heads",
+                           "vocab_size", "intermediate_size", "layer_types")
+
+    def add_derived_config(self) -> None:
+        for attr, default in (("rope_theta", 10000.0), ("layer_norm_eps", 1e-5),
+                              ("logit_scale", 1.0), ("sliding_window", 4096)):
+            if not hasattr(self, attr) or getattr(self, attr) is None:
+                setattr(self, attr, default)
+        if not hasattr(self, "head_dim") or self.head_dim is None:
+            self.head_dim = self.hidden_size // self.num_attention_heads
+
+    def layer_pattern(self):
+        return tuple("sliding" if t == "sliding_attention" else "full"
+                     for t in self.layer_types)
+
+
+class Cohere2ForCausalLM(TpuModelForCausalLM):
+    @classmethod
+    def get_config_cls(cls):
+        return Cohere2InferenceConfig
+
+    @classmethod
+    def arch_args_from_config(cls, config) -> ModelArchArgs:
+        return ModelArchArgs(
+            vocab_size=config.vocab_size,
+            hidden_size=config.hidden_size,
+            num_layers=config.num_hidden_layers,
+            num_heads=config.num_attention_heads,
+            num_kv_heads=config.num_key_value_heads,
+            head_dim=config.head_dim,
+            intermediate_size=config.intermediate_size,
+            rms_norm_eps=config.layer_norm_eps,
+            norm_type="layer",
+            parallel_residual=True,
+            shared_ln=True,
+            rope_interleaved=True,
+            sliding_window=int(config.sliding_window),
+            layer_pattern=config.layer_pattern(),
+            local_rope_theta=float(config.rope_theta),   # sliding layers' table;
+            #                                              full layers' is zeroed
+
+            logits_scale=float(config.logit_scale),
+            tie_word_embeddings=True,
+        )
+
+    @classmethod
+    def inv_freq_from_config(cls, config) -> np.ndarray:
+        # FULL layers are NoPE: a zero inv-freq table makes rotary the identity
+        rd = config.head_dim
+        return np.zeros((rd // 2,), np.float32)
+
+    @classmethod
+    def local_inv_freq_from_config(cls, config) -> np.ndarray:
+        return rope_ops.default_inv_freq(config.head_dim, float(config.rope_theta))
+
+    @classmethod
+    def convert_hf_state_dict(cls, state_dict: Dict[str, np.ndarray],
+                              config) -> Dict:
+        def get(name):
+            if name not in state_dict:
+                raise KeyError(f"missing weight {name}")
+            return np.asarray(state_dict[name])
+
+        def lin_t(name):
+            return np.ascontiguousarray(get(name).T)
+
+        layers = {k: [] for k in ("ln1", "wq", "wk", "wv", "wo", "ln2",
+                                  "wg", "wu", "wd")}
+        for i in range(config.num_hidden_layers):
+            p = f"model.layers.{i}."
+            layers["wq"].append(lin_t(p + "self_attn.q_proj.weight"))
+            layers["wk"].append(lin_t(p + "self_attn.k_proj.weight"))
+            layers["wv"].append(lin_t(p + "self_attn.v_proj.weight"))
+            layers["wo"].append(lin_t(p + "self_attn.o_proj.weight"))
+            ln = get(p + "input_layernorm.weight")
+            layers["ln1"].append(ln)
+            layers["ln2"].append(np.ones_like(ln))   # unused under shared_ln
+            layers["wg"].append(lin_t(p + "mlp.gate_proj.weight"))
+            layers["wu"].append(lin_t(p + "mlp.up_proj.weight"))
+            layers["wd"].append(lin_t(p + "mlp.down_proj.weight"))
+        return {
+            "embed": get("model.embed_tokens.weight"),
+            "layers": {k: np.stack(v) for k, v in layers.items()},
+            "final_norm": get("model.norm.weight"),
+            "rope_inv_freq": cls.inv_freq_from_config(config),
+            "rope_inv_freq_local": cls.local_inv_freq_from_config(config),
+        }

@@ -1,0 +1,105 @@
+"""SmolLM3 (HuggingFace) on the TPU framework (contrib port).
+
+Llama geometry where every ``no_rope_layer_interval``-th layer uses NO
+positional encoding (NoPE). Mapping: the shared layer-pattern machinery with
+rope layers as the "sliding" kind whose window equals the full sequence
+(rolling cache width == seq_len, i.e. plain causal attention) on the real rope
+table, and NoPE layers as the "full" kind on a ZERO inv-freq table (identity
+rotation) — no new primitives.
+"""
+
+from typing import Dict
+
+import numpy as np
+
+from neuronx_distributed_inference_tpu.config import InferenceConfig
+from neuronx_distributed_inference_tpu.models.base import ModelArchArgs
+from neuronx_distributed_inference_tpu.ops import rope as rope_ops
+from neuronx_distributed_inference_tpu.runtime.application import (
+    TpuModelForCausalLM)
+
+
+class SmolLM3InferenceConfig(InferenceConfig):
+    REQUIRED_ATTRIBUTES = ("hidden_size", "num_hidden_layers",
+                           "num_attention_heads", "num_key_value_heads",
+                           "vocab_size", "intermediate_size", "no_rope_layers")
+
+    def add_derived_config(self) -> None:
+        for attr, default in (("rope_theta", 2000000.0), ("rms_norm_eps", 1e-6),
+                              ("attention_bias", False),
+                              ("tie_word_embeddings", True)):
+            if not hasattr(self, attr) or getattr(self, attr) is None:
+                setattr(self, attr, default)
+        if not hasattr(self, "head_dim") or self.head_dim is None:
+            self.head_dim = self.hidden_size // self.num_attention_heads
+        if getattr(self, "use_sliding_window", False):
+            raise ValueError("SmolLM3 sliding-window variants are not ported yet")
+
+
+class SmolLM3ForCausalLM(TpuModelForCausalLM):
+    @classmethod
+    def get_config_cls(cls):
+        return SmolLM3InferenceConfig
+
+    @classmethod
+    def arch_args_from_config(cls, config) -> ModelArchArgs:
+        # no_rope_layers[i] == 1 -> rope ON ("sliding" kind, full-width window);
+        # 0 -> NoPE ("full" kind on the zeroed global table)
+        pattern = tuple("sliding" if on else "full"
+                        for on in config.no_rope_layers)
+        return ModelArchArgs(
+            vocab_size=config.vocab_size,
+            hidden_size=config.hidden_size,
+            num_layers=config.num_hidden_layers,
+            num_heads=config.num_attention_heads,
+            num_kv_heads=config.num_key_value_heads,
+            head_dim=config.head_dim,
+            intermediate_size=config.intermediate_size,
+            rms_norm_eps=config.rms_norm_eps,
+            attention_bias=bool(config.attention_bias),
+            sliding_window=int(config.tpu_config.seq_len),
+            layer_pattern=pattern,
+            local_rope_theta=float(config.rope_theta),
+            tie_word_embeddings=bool(config.tie_word_embeddings),
+        )
+
+    @classmethod
+    def inv_freq_from_config(cls, config) -> np.ndarray:
+        # NoPE layers ride the zeroed global table (identity rotation)
+        return np.zeros((config.head_dim // 2,), np.float32)
+
+    @classmethod
+    def convert_hf_state_dict(cls, state_dict: Dict[str, np.ndarray],
+                              config) -> Dict:
+        def get(name):
+            if name not in state_dict:
+                raise KeyError(f"missing weight {name}")
+            return np.asarray(state_dict[name])
+
+        def lin_t(name):
+            return np.ascontiguousarray(get(name).T)
+
+        layers = {k: [] for k in ("ln1", "wq", "wk", "wv", "wo", "ln2",
+                                  "wg", "wu", "wd")}
+        for i in range(config.num_hidden_layers):
+            p = f"model.layers.{i}."
+            layers["wq"].append(lin_t(p + "self_attn.q_proj.weight"))
+            layers["wk"].append(lin_t(p + "self_attn.k_proj.weight"))
+            layers["wv"].append(lin_t(p + "self_attn.v_proj.weight"))
+            layers["wo"].append(lin_t(p + "self_attn.o_proj.weight"))
+            layers["ln1"].append(get(p + "input_layernorm.weight"))
+            layers["ln2"].append(get(p + "post_attention_layernorm.weight"))
+            layers["wg"].append(lin_t(p + "mlp.gate_proj.weight"))
+            layers["wu"].append(lin_t(p + "mlp.up_proj.weight"))
+            layers["wd"].append(lin_t(p + "mlp.down_proj.weight"))
+        out = {
+            "embed": get("model.embed_tokens.weight"),
+            "layers": {k: np.stack(v) for k, v in layers.items()},
+            "final_norm": get("model.norm.weight"),
+            "rope_inv_freq": cls.inv_freq_from_config(config),
+            "rope_inv_freq_local": rope_ops.default_inv_freq(
+                config.head_dim, float(config.rope_theta)),
+        }
+        if not config.tie_word_embeddings:
+            out["lm_head"] = lin_t("lm_head.weight")
+        return out

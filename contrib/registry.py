@@ -32,6 +32,8 @@ CONTRIB_MODELS = {
     "qwen2_moe": "contrib.models.qwen2_moe.src.modeling_qwen2_moe:Qwen2MoeForCausalLM",
     "olmo2": "contrib.models.olmo2.src.modeling_olmo2:Olmo2ForCausalLM",
     "nemotron": "contrib.models.nemotron.src.modeling_nemotron:NemotronForCausalLM",
+    "cohere2": "contrib.models.cohere2.src.modeling_cohere2:Cohere2ForCausalLM",
+    "smollm3": "contrib.models.smollm3.src.modeling_smollm3:SmolLM3ForCausalLM",
 }
 
 for model_type, path in CONTRIB_MODELS.items():

@@ -498,3 +498,39 @@ def test_nemotron_parity():
     torch.manual_seed(0)
     hf = HFNemotron(cfg).eval()
     _run_parity(NemotronForCausalLM, hf, cfg)
+
+
+def test_cohere2_parity():
+    """Command-R7B: cohere parallel-residual block + 3:1 sliding/full pattern
+    where full layers are NoPE (zero-inv-freq rope table = identity rotation)."""
+    from transformers import Cohere2Config, Cohere2ForCausalLM as HFCohere2
+
+    from contrib.models.cohere2.src.modeling_cohere2 import Cohere2ForCausalLM
+
+    cfg = Cohere2Config(vocab_size=256, hidden_size=64, intermediate_size=128,
+                        num_hidden_layers=4, num_attention_heads=4,
+                        num_key_value_heads=2, logit_scale=0.25,
+                        sliding_window=16,
+                        layer_types=["sliding_attention", "sliding_attention",
+                                     "sliding_attention", "full_attention"],
+                        pad_token_id=0, tie_word_embeddings=True)
+    torch.manual_seed(0)
+    hf = HFCohere2(cfg).eval()
+    _run_parity(Cohere2ForCausalLM, hf, cfg)
+
+
+def test_smollm3_parity():
+    """SmolLM3: NoPE every 4th layer via the pattern machinery — rope layers as
+    full-width-window 'sliding' kind, NoPE layers on a zeroed rope table."""
+    from transformers import SmolLM3Config, SmolLM3ForCausalLM as HFSmolLM3
+
+    from contrib.models.smollm3.src.modeling_smollm3 import SmolLM3ForCausalLM
+
+    cfg = SmolLM3Config(vocab_size=256, hidden_size=64, intermediate_size=128,
+                        num_hidden_layers=4, num_attention_heads=4,
+                        num_key_value_heads=2,
+                        no_rope_layers=[1, 1, 1, 0], use_sliding_window=False,
+                        pad_token_id=0, tie_word_embeddings=True)
+    torch.manual_seed(0)
+    hf = HFSmolLM3(cfg).eval()
+    _run_parity(SmolLM3ForCausalLM, hf, cfg)
