@@ -1,0 +1,111 @@
+"""GraniteMoe (IBM Granite 3.x MoE) on the TPU framework (contrib port).
+
+Granite's scaling quartet (embedding/attention/residual multipliers + logits
+scaling) over a fused-projection MoE: per-expert input_linear packs gate|up
+(split at conversion), routing is top-k-then-softmax over the selected logits
+(ops/moe.py router_mode="topk_softmax").
+"""
+
+from typing import Dict
+
+import numpy as np
+
+from neuronx_distributed_inference_tpu.config import InferenceConfig
+from neuronx_distributed_inference_tpu.models.base import ModelArchArgs
+from neuronx_distributed_inference_tpu.ops import rope as rope_ops
+from neuronx_distributed_inference_tpu.ops.moe import MoEArgs
+from neuronx_distributed_inference_tpu.runtime.application import (
+    TpuModelForCausalLM)
+
+
+class GraniteMoeInferenceConfig(InferenceConfig):
+    REQUIRED_ATTRIBUTES = ("hidden_size", "num_hidden_layers",
+                           "num_attention_heads", "num_key_value_heads",
+                           "vocab_size", "intermediate_size",
+                           "num_local_experts", "num_experts_per_tok")
+
+    def add_derived_config(self) -> None:
+        defaults = (("rope_theta", 10000.0), ("rms_norm_eps", 1e-6),
+                    ("embedding_multiplier", 1.0), ("attention_multiplier", None),
+                    ("residual_multiplier", 1.0), ("logits_scaling", 1.0),
+                    ("tie_word_embeddings", False), ("attention_bias", False))
+        for attr, default in defaults:
+            if not hasattr(self, attr) or getattr(self, attr) is None:
+                if default is not None or not hasattr(self, attr):
+                    setattr(self, attr, default)
+        if not hasattr(self, "head_dim") or self.head_dim is None:
+            self.head_dim = self.hidden_size // self.num_attention_heads
+
+
+class GraniteMoeForCausalLM(TpuModelForCausalLM):
+    @classmethod
+    def get_config_cls(cls):
+        return GraniteMoeInferenceConfig
+
+    @classmethod
+    def arch_args_from_config(cls, config) -> ModelArchArgs:
+        return ModelArchArgs(
+            vocab_size=config.vocab_size,
+            hidden_size=config.hidden_size,
+            num_layers=config.num_hidden_layers,
+            num_heads=config.num_attention_heads,
+            num_kv_heads=config.num_key_value_heads,
+            head_dim=config.head_dim,
+            intermediate_size=config.intermediate_size,
+            rms_norm_eps=config.rms_norm_eps,
+            attention_scale=config.attention_multiplier,
+            embedding_multiplier=float(config.embedding_multiplier),
+            residual_multiplier=float(config.residual_multiplier),
+            logits_scale=1.0 / float(config.logits_scaling),
+            attention_bias=bool(config.attention_bias),
+            moe=MoEArgs(num_experts=config.num_local_experts,
+                        experts_per_tok=config.num_experts_per_tok,
+                        router_mode="topk_softmax"),
+            tie_word_embeddings=bool(config.tie_word_embeddings),
+        )
+
+    @classmethod
+    def inv_freq_from_config(cls, config) -> np.ndarray:
+        return rope_ops.default_inv_freq(config.head_dim, float(config.rope_theta))
+
+    @classmethod
+    def convert_hf_state_dict(cls, state_dict: Dict[str, np.ndarray],
+                              config) -> Dict:
+        def get(name):
+            if name not in state_dict:
+                raise KeyError(f"missing weight {name}")
+            return np.asarray(state_dict[name])
+
+        def lin_t(name):
+            return np.ascontiguousarray(get(name).T)
+
+        I = config.intermediate_size
+        layers = {k: [] for k in ("ln1", "wq", "wk", "wv", "wo", "ln2",
+                                  "router", "wg", "wu", "wd")}
+        for i in range(config.num_hidden_layers):
+            p = f"model.layers.{i}."
+            layers["wq"].append(lin_t(p + "self_attn.q_proj.weight"))
+            layers["wk"].append(lin_t(p + "self_attn.k_proj.weight"))
+            layers["wv"].append(lin_t(p + "self_attn.v_proj.weight"))
+            layers["wo"].append(lin_t(p + "self_attn.o_proj.weight"))
+            layers["ln1"].append(get(p + "input_layernorm.weight"))
+            layers["ln2"].append(get(p + "post_attention_layernorm.weight"))
+            m = p + "block_sparse_moe."
+            layers["router"].append(lin_t(m + "router.layer.weight"))
+            # input_linear (E, 2I, H): rows [0:I] = gate, [I:2I] = up
+            fused = get(m + "input_linear.weight")
+            layers["wg"].append(np.ascontiguousarray(
+                fused[:, :I, :].transpose(0, 2, 1)))
+            layers["wu"].append(np.ascontiguousarray(
+                fused[:, I:, :].transpose(0, 2, 1)))
+            layers["wd"].append(np.ascontiguousarray(
+                get(m + "output_linear.weight").transpose(0, 2, 1)))
+        out = {
+            "embed": get("model.embed_tokens.weight"),
+            "layers": {k: np.stack(v) for k, v in layers.items()},
+            "final_norm": get("model.norm.weight"),
+            "rope_inv_freq": cls.inv_freq_from_config(config),
+        }
+        if not config.tie_word_embeddings:
+            out["lm_head"] = lin_t("lm_head.weight")
+        return out

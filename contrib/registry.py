@@ -34,6 +34,7 @@ CONTRIB_MODELS = {
     "nemotron": "contrib.models.nemotron.src.modeling_nemotron:NemotronForCausalLM",
     "cohere2": "contrib.models.cohere2.src.modeling_cohere2:Cohere2ForCausalLM",
     "smollm3": "contrib.models.smollm3.src.modeling_smollm3:SmolLM3ForCausalLM",
+    "granitemoe": "contrib.models.granitemoe.src.modeling_granitemoe:GraniteMoeForCausalLM",
 }
 
 for model_type, path in CONTRIB_MODELS.items():

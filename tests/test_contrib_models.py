@@ -51,7 +51,8 @@ def test_registry_resolves_contrib_models():
                "bloom", "mpt", "stablelm", "gemma", "biogpt",
                "granite", "cohere", "glm", "gemma2", "phimoe",
                "recurrent_gemma", "lfm2", "llava",
-               "helium", "qwen2_moe", "olmo2", "nemotron"):
+               "helium", "qwen2_moe", "olmo2", "nemotron",
+               "cohere2", "smollm3", "granitemoe"):
         assert get_model_cls(mt) is not None
 
 
@@ -534,3 +535,22 @@ def test_smollm3_parity():
     torch.manual_seed(0)
     hf = HFSmolLM3(cfg).eval()
     _run_parity(SmolLM3ForCausalLM, hf, cfg)
+
+
+def test_granitemoe_parity():
+    from transformers import (GraniteMoeConfig,
+                              GraniteMoeForCausalLM as HFGraniteMoe)
+
+    from contrib.models.granitemoe.src.modeling_granitemoe import (
+        GraniteMoeForCausalLM)
+
+    cfg = GraniteMoeConfig(vocab_size=256, hidden_size=64, intermediate_size=96,
+                           num_hidden_layers=2, num_attention_heads=4,
+                           num_key_value_heads=2, num_local_experts=4,
+                           num_experts_per_tok=2, embedding_multiplier=6.0,
+                           attention_multiplier=0.0625, residual_multiplier=0.3,
+                           logits_scaling=4.0, pad_token_id=0,
+                           tie_word_embeddings=False)
+    torch.manual_seed(0)
+    hf = HFGraniteMoe(cfg).eval()
+    _run_parity(GraniteMoeForCausalLM, hf, cfg, atol=1e-3, rtol=1e-3)
