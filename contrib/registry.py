@@ -35,6 +35,8 @@ CONTRIB_MODELS = {
     "cohere2": "contrib.models.cohere2.src.modeling_cohere2:Cohere2ForCausalLM",
     "smollm3": "contrib.models.smollm3.src.modeling_smollm3:SmolLM3ForCausalLM",
     "granitemoe": "contrib.models.granitemoe.src.modeling_granitemoe:GraniteMoeForCausalLM",
+    "ernie4_5": "contrib.models.ernie4_5.src.modeling_ernie4_5:Ernie45ForCausalLM",
+    "exaone4": "contrib.models.exaone4.src.modeling_exaone4:Exaone4ForCausalLM",
 }
 
 for model_type, path in CONTRIB_MODELS.items():

@@ -554,3 +554,34 @@ def test_granitemoe_parity():
     torch.manual_seed(0)
     hf = HFGraniteMoe(cfg).eval()
     _run_parity(GraniteMoeForCausalLM, hf, cfg, atol=1e-3, rtol=1e-3)
+
+
+def test_ernie4_5_parity():
+    from transformers import Ernie4_5Config
+    from transformers import Ernie4_5ForCausalLM as HFErnie
+
+    from contrib.models.ernie4_5.src.modeling_ernie4_5 import Ernie45ForCausalLM
+
+    cfg = Ernie4_5Config(vocab_size=256, hidden_size=64, intermediate_size=128,
+                         num_hidden_layers=2, num_attention_heads=4,
+                         num_key_value_heads=2, head_dim=16, use_bias=False,
+                         pad_token_id=0, tie_word_embeddings=True)
+    torch.manual_seed(0)
+    hf = HFErnie(cfg).eval()
+    _run_parity(Ernie45ForCausalLM, hf, cfg)
+
+
+def test_exaone4_parity():
+    from transformers import Exaone4Config, Exaone4ForCausalLM as HFExaone4
+
+    from contrib.models.exaone4.src.modeling_exaone4 import Exaone4ForCausalLM
+
+    cfg = Exaone4Config(vocab_size=256, hidden_size=64, intermediate_size=128,
+                        num_hidden_layers=4, num_attention_heads=4,
+                        num_key_value_heads=2, sliding_window=16,
+                        layer_types=["sliding_attention", "sliding_attention",
+                                     "sliding_attention", "full_attention"],
+                        pad_token_id=0, tie_word_embeddings=False)
+    torch.manual_seed(0)
+    hf = HFExaone4(cfg).eval()
+    _run_parity(Exaone4ForCausalLM, hf, cfg)
