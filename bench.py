@@ -173,6 +173,18 @@ def main() -> None:
     dev = prof.device_time_ms(trace_dir, "prefill")
     ttft_device_ms = round(dev, 2) if dev is not None else None
 
+    # device-timed decode step (same attribution as TTFT: wall per-step carries
+    # ~2-3 ms of tunnel chunk-boundary overhead that local serving doesn't pay)
+    dec_steps = 64
+    dec_trace = "/tmp/bench_decode_trace"
+    shutil.rmtree(dec_trace, ignore_errors=True)
+    app.generate(input_ids, max_new_tokens=1)        # fresh prefill outside trace
+    with prof.trace(dec_trace):
+        app.generate(input_ids, max_new_tokens=dec_steps)
+    ddev = prof.device_time_ms(dec_trace, "decode")
+    decode_step_device_ms = (round(ddev / dec_steps, 2)
+                             if ddev is not None else None)
+
     extra = {
         # no real checkpoints exist in this environment: weights are synthetic
         # random in the exact serving layout (the reference's own integration
@@ -180,6 +192,7 @@ def main() -> None:
         # token parity is covered by the HF-CPU parity suite at tiny scale
         "weights": "synthetic-random (env has no real checkpoints)",
         "p50_decode_step_ms": round(float(np.percentile(per_step_ms, 50)), 2),
+        "decode_step_device_ms": decode_step_device_ms,
         "ttft_p50_ms": round(ttft_p50_ms, 1),
         "ttft_device_ms": ttft_device_ms,
         "dispatch_floor_ms": round(dispatch_floor_ms, 1),
