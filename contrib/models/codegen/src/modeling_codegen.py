@@ -1,0 +1,127 @@
+"""CodeGen (Salesforce) on the TPU framework (contrib port).
+
+GPT-J-style block (shared-LN parallel residual, interleaved partial rotary,
+plain biased gelu MLP, biased lm_head) with CodeGen's TPU-v4-era packed
+qkv_proj: columns grouped into mp_num=4 blocks of [q | v | k], unpacked at
+conversion into the standard per-projection layout (block-major head order is
+self-consistent across q/k/v/out).
+"""
+
+from typing import Dict
+
+import numpy as np
+
+from neuronx_distributed_inference_tpu.config import InferenceConfig
+from neuronx_distributed_inference_tpu.models.base import ModelArchArgs
+from neuronx_distributed_inference_tpu.ops import rope as rope_ops
+from neuronx_distributed_inference_tpu.runtime.application import (
+    TpuModelForCausalLM)
+
+
+class CodeGenInferenceConfig(InferenceConfig):
+    REQUIRED_ATTRIBUTES = ("n_embd", "n_layer", "n_head", "vocab_size")
+
+    def add_derived_config(self) -> None:
+        for attr, default in (("rotary_dim", 64), ("layer_norm_epsilon", 1e-5),
+                              ("n_inner", None),
+                              ("activation_function", "gelu_new"),
+                              ("tie_word_embeddings", False)):
+            if not hasattr(self, attr) or getattr(self, attr) is None:
+                if default is not None or not hasattr(self, attr):
+                    setattr(self, attr, default)
+        if self.n_inner is None:
+            self.n_inner = 4 * self.n_embd
+
+
+class CodeGenForCausalLM(TpuModelForCausalLM):
+    @classmethod
+    def get_config_cls(cls):
+        return CodeGenInferenceConfig
+
+    @classmethod
+    def arch_args_from_config(cls, config) -> ModelArchArgs:
+        d = config.n_embd // config.n_head
+        return ModelArchArgs(
+            vocab_size=config.vocab_size,
+            hidden_size=config.n_embd,
+            num_layers=config.n_layer,
+            num_heads=config.n_head,
+            num_kv_heads=config.n_head,
+            head_dim=d,
+            intermediate_size=config.n_inner,
+            rms_norm_eps=config.layer_norm_epsilon,
+            norm_type="layer",
+            norm_bias=True,
+            activation=config.activation_function,
+            mlp_kind="plain",
+            mlp_bias=True,
+            o_bias=False,
+            parallel_residual=True,
+            shared_ln=True,
+            rotary_dim=int(config.rotary_dim),
+            rope_interleaved=True,
+            tie_word_embeddings=bool(config.tie_word_embeddings),
+        )
+
+    @classmethod
+    def inv_freq_from_config(cls, config) -> np.ndarray:
+        return rope_ops.default_inv_freq(int(config.rotary_dim), 10000.0)
+
+    def logical_axes(self) -> Dict:
+        from neuronx_distributed_inference_tpu.models import base as model_base
+
+        axes = model_base.param_logical_axes(self.arch_args)
+        axes["lm_head_b"] = ("vocab",)
+        return axes
+
+    def init_random_params(self, key) -> Dict:
+        import jax.numpy as jnp
+
+        params = super().init_random_params(key)
+        params["lm_head_b"] = jnp.zeros((self.arch_args.vocab_size,),
+                                        self.tpu_config.jax_dtype)
+        return params
+
+    @classmethod
+    def convert_hf_state_dict(cls, state_dict: Dict[str, np.ndarray],
+                              config) -> Dict:
+        def get(name):
+            if name not in state_dict:
+                raise KeyError(f"missing weight {name}")
+            return np.asarray(state_dict[name])
+
+        def lin_t(name):
+            return np.ascontiguousarray(get(name).T)
+
+        E = config.n_embd
+        ld = E // 4                              # mp_num = 4, local q/v/k width
+        layers = {k: [] for k in ("ln1", "ln1_b", "wq", "wk", "wv", "wo",
+                                  "ln2", "ln2_b", "wg", "bg", "wd", "bd")}
+        for i in range(config.n_layer):
+            p = f"transformer.h.{i}."
+            qkv = lin_t(p + "attn.qkv_proj.weight").reshape(E, 4, 3 * ld)
+            layers["wq"].append(np.ascontiguousarray(
+                qkv[:, :, 0:ld].reshape(E, E)))
+            layers["wv"].append(np.ascontiguousarray(
+                qkv[:, :, ld: 2 * ld].reshape(E, E)))
+            layers["wk"].append(np.ascontiguousarray(
+                qkv[:, :, 2 * ld:].reshape(E, E)))
+            layers["wo"].append(lin_t(p + "attn.out_proj.weight"))
+            ln = get(p + "ln_1.weight")
+            layers["ln1"].append(ln)
+            layers["ln1_b"].append(get(p + "ln_1.bias"))
+            layers["ln2"].append(np.ones_like(ln))       # unused under shared_ln
+            layers["ln2_b"].append(np.zeros_like(ln))
+            layers["wg"].append(lin_t(p + "mlp.fc_in.weight"))
+            layers["bg"].append(get(p + "mlp.fc_in.bias"))
+            layers["wd"].append(lin_t(p + "mlp.fc_out.weight"))
+            layers["bd"].append(get(p + "mlp.fc_out.bias"))
+        return {
+            "embed": get("transformer.wte.weight"),
+            "layers": {k: np.stack(v) for k, v in layers.items()},
+            "final_norm": get("transformer.ln_f.weight"),
+            "final_norm_b": get("transformer.ln_f.bias"),
+            "lm_head": lin_t("lm_head.weight"),
+            "lm_head_b": get("lm_head.bias"),
+            "rope_inv_freq": cls.inv_freq_from_config(config),
+        }

@@ -1,0 +1,117 @@
+"""GPT-Neo (EleutherAI) on the TPU framework (contrib port).
+
+Alternating global/local(256-window) attention layers over learned positions
+and UNSCALED attention scores (scale = 1.0) — the local layers ride the shared
+layer-pattern machinery's rolling window caches, positions come from the
+learned table (no rope: both rope tables zeroed), plain biased gelu MLP.
+"""
+
+from typing import Dict
+
+import numpy as np
+
+from neuronx_distributed_inference_tpu.config import InferenceConfig
+from neuronx_distributed_inference_tpu.models.base import ModelArchArgs
+from neuronx_distributed_inference_tpu.runtime.application import (
+    TpuModelForCausalLM)
+
+
+class GPTNeoInferenceConfig(InferenceConfig):
+    REQUIRED_ATTRIBUTES = ("hidden_size", "num_layers", "num_heads",
+                           "vocab_size", "attention_types")
+
+    def add_derived_config(self) -> None:
+        for attr, default in (("layer_norm_epsilon", 1e-5),
+                              ("window_size", 256),
+                              ("intermediate_size", None),
+                              ("activation_function", "gelu_new"),
+                              ("max_position_embeddings", 2048),
+                              ("tie_word_embeddings", True)):
+            if not hasattr(self, attr) or getattr(self, attr) is None:
+                if default is not None or not hasattr(self, attr):
+                    setattr(self, attr, default)
+        if self.intermediate_size is None:
+            self.intermediate_size = 4 * self.hidden_size
+
+    def layer_pattern(self):
+        kinds = []
+        for block, times in self.attention_types:
+            kinds.extend(list(block) * times)
+        return tuple("sliding" if k == "local" else "full"
+                     for k in kinds[: self.num_layers])
+
+
+class GPTNeoForCausalLM(TpuModelForCausalLM):
+    @classmethod
+    def get_config_cls(cls):
+        return GPTNeoInferenceConfig
+
+    @classmethod
+    def arch_args_from_config(cls, config) -> ModelArchArgs:
+        d = config.hidden_size // config.num_heads
+        return ModelArchArgs(
+            vocab_size=config.vocab_size,
+            hidden_size=config.hidden_size,
+            num_layers=config.num_layers,
+            num_heads=config.num_heads,
+            num_kv_heads=config.num_heads,
+            head_dim=d,
+            intermediate_size=config.intermediate_size,
+            rms_norm_eps=config.layer_norm_epsilon,
+            norm_type="layer",
+            norm_bias=True,
+            activation=config.activation_function,
+            mlp_kind="plain",
+            mlp_bias=True,
+            o_bias=True,
+            attention_scale=1.0,                 # GPT-Neo does not scale scores
+            learned_pos=True,
+            sliding_window=int(config.window_size),
+            layer_pattern=config.layer_pattern(),
+            local_rope_theta=10000.0,   # registers the local table key; both
+            #                             tables are zeroed (learned positions)
+            tie_word_embeddings=bool(config.tie_word_embeddings),
+        )
+
+    @classmethod
+    def inv_freq_from_config(cls, config) -> np.ndarray:
+        d = config.hidden_size // config.num_heads
+        return np.zeros((d // 2,), np.float32)   # no rope: learned positions
+
+    @classmethod
+    def convert_hf_state_dict(cls, state_dict: Dict[str, np.ndarray],
+                              config) -> Dict:
+        def get(name):
+            if name not in state_dict:
+                raise KeyError(f"missing weight {name}")
+            return np.asarray(state_dict[name])
+
+        def lin_t(name):
+            return np.ascontiguousarray(get(name).T)
+
+        layers = {k: [] for k in ("ln1", "ln1_b", "wq", "wk", "wv", "wo", "bo",
+                                  "ln2", "ln2_b", "wg", "bg", "wd", "bd")}
+        for i in range(config.num_layers):
+            p = f"transformer.h.{i}."
+            layers["wq"].append(lin_t(p + "attn.attention.q_proj.weight"))
+            layers["wk"].append(lin_t(p + "attn.attention.k_proj.weight"))
+            layers["wv"].append(lin_t(p + "attn.attention.v_proj.weight"))
+            layers["wo"].append(lin_t(p + "attn.attention.out_proj.weight"))
+            layers["bo"].append(get(p + "attn.attention.out_proj.bias"))
+            layers["ln1"].append(get(p + "ln_1.weight"))
+            layers["ln1_b"].append(get(p + "ln_1.bias"))
+            layers["ln2"].append(get(p + "ln_2.weight"))
+            layers["ln2_b"].append(get(p + "ln_2.bias"))
+            layers["wg"].append(lin_t(p + "mlp.c_fc.weight"))
+            layers["bg"].append(get(p + "mlp.c_fc.bias"))
+            layers["wd"].append(lin_t(p + "mlp.c_proj.weight"))
+            layers["bd"].append(get(p + "mlp.c_proj.bias"))
+        return {
+            "embed": get("transformer.wte.weight"),
+            "pos_embed": get("transformer.wpe.weight"),
+            "layers": {k: np.stack(v) for k, v in layers.items()},
+            "final_norm": get("transformer.ln_f.weight"),
+            "final_norm_b": get("transformer.ln_f.bias"),
+            "rope_inv_freq": cls.inv_freq_from_config(config),
+            "rope_inv_freq_local": cls.inv_freq_from_config(config),
+        }

@@ -1,0 +1,119 @@
+"""GPT-J (EleutherAI 6B) on the TPU framework (contrib port).
+
+Single-LayerNorm parallel-residual block (h = x + attn(ln(x)) + mlp(ln(x))),
+interleaved partial rotary (rotary_dim=64 of head_dim 256), plain biased
+gelu MLP, biased lm_head.
+"""
+
+from typing import Dict
+
+import numpy as np
+
+from neuronx_distributed_inference_tpu.config import InferenceConfig
+from neuronx_distributed_inference_tpu.models.base import ModelArchArgs
+from neuronx_distributed_inference_tpu.ops import rope as rope_ops
+from neuronx_distributed_inference_tpu.runtime.application import (
+    TpuModelForCausalLM)
+
+
+class GPTJInferenceConfig(InferenceConfig):
+    REQUIRED_ATTRIBUTES = ("n_embd", "n_layer", "n_head", "vocab_size")
+
+    def add_derived_config(self) -> None:
+        for attr, default in (("rotary_dim", 64), ("layer_norm_epsilon", 1e-5),
+                              ("n_inner", None),
+                              ("activation_function", "gelu_new"),
+                              ("tie_word_embeddings", False)):
+            if not hasattr(self, attr) or getattr(self, attr) is None:
+                if default is not None or not hasattr(self, attr):
+                    setattr(self, attr, default)
+        if self.n_inner is None:
+            self.n_inner = 4 * self.n_embd
+
+
+class GPTJForCausalLM(TpuModelForCausalLM):
+    @classmethod
+    def get_config_cls(cls):
+        return GPTJInferenceConfig
+
+    @classmethod
+    def arch_args_from_config(cls, config) -> ModelArchArgs:
+        d = config.n_embd // config.n_head
+        return ModelArchArgs(
+            vocab_size=config.vocab_size,
+            hidden_size=config.n_embd,
+            num_layers=config.n_layer,
+            num_heads=config.n_head,
+            num_kv_heads=config.n_head,
+            head_dim=d,
+            intermediate_size=config.n_inner,
+            rms_norm_eps=config.layer_norm_epsilon,
+            norm_type="layer",
+            norm_bias=True,
+            activation=config.activation_function,
+            mlp_kind="plain",
+            mlp_bias=True,
+            o_bias=False,
+            parallel_residual=True,
+            shared_ln=True,
+            rotary_dim=int(config.rotary_dim),
+            rope_interleaved=True,
+            tie_word_embeddings=bool(config.tie_word_embeddings),
+        )
+
+    @classmethod
+    def inv_freq_from_config(cls, config) -> np.ndarray:
+        return rope_ops.default_inv_freq(int(config.rotary_dim), 10000.0)
+
+    def logical_axes(self) -> Dict:
+        from neuronx_distributed_inference_tpu.models import base as model_base
+
+        axes = model_base.param_logical_axes(self.arch_args)
+        axes["lm_head_b"] = ("vocab",)
+        return axes
+
+    def init_random_params(self, key) -> Dict:
+        import jax.numpy as jnp
+
+        params = super().init_random_params(key)
+        params["lm_head_b"] = jnp.zeros((self.arch_args.vocab_size,),
+                                        self.tpu_config.jax_dtype)
+        return params
+
+    @classmethod
+    def convert_hf_state_dict(cls, state_dict: Dict[str, np.ndarray],
+                              config) -> Dict:
+        def get(name):
+            if name not in state_dict:
+                raise KeyError(f"missing weight {name}")
+            return np.asarray(state_dict[name])
+
+        def lin_t(name):
+            return np.ascontiguousarray(get(name).T)
+
+        layers = {k: [] for k in ("ln1", "ln1_b", "wq", "wk", "wv", "wo",
+                                  "ln2", "ln2_b", "wg", "bg", "wd", "bd")}
+        for i in range(config.n_layer):
+            p = f"transformer.h.{i}."
+            layers["wq"].append(lin_t(p + "attn.q_proj.weight"))
+            layers["wk"].append(lin_t(p + "attn.k_proj.weight"))
+            layers["wv"].append(lin_t(p + "attn.v_proj.weight"))
+            layers["wo"].append(lin_t(p + "attn.out_proj.weight"))
+            ln = get(p + "ln_1.weight")
+            layers["ln1"].append(ln)
+            layers["ln1_b"].append(get(p + "ln_1.bias"))
+            layers["ln2"].append(np.ones_like(ln))       # unused under shared_ln
+            layers["ln2_b"].append(np.zeros_like(ln))
+            layers["wg"].append(lin_t(p + "mlp.fc_in.weight"))
+            layers["bg"].append(get(p + "mlp.fc_in.bias"))
+            layers["wd"].append(lin_t(p + "mlp.fc_out.weight"))
+            layers["bd"].append(get(p + "mlp.fc_out.bias"))
+        return {
+            "embed": get("transformer.wte.weight"),
+            "layers": {k: np.stack(v) for k, v in layers.items()},
+            "final_norm": get("transformer.ln_f.weight"),
+            "final_norm_b": get("transformer.ln_f.bias"),
+            "lm_head": lin_t("lm_head.weight"),
+            "lm_head_b": get("lm_head.bias"),
+            "rope_inv_freq": cls.inv_freq_from_config(config),
+        }

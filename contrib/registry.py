@@ -37,6 +37,9 @@ CONTRIB_MODELS = {
     "granitemoe": "contrib.models.granitemoe.src.modeling_granitemoe:GraniteMoeForCausalLM",
     "ernie4_5": "contrib.models.ernie4_5.src.modeling_ernie4_5:Ernie45ForCausalLM",
     "exaone4": "contrib.models.exaone4.src.modeling_exaone4:Exaone4ForCausalLM",
+    "gptj": "contrib.models.gptj.src.modeling_gptj:GPTJForCausalLM",
+    "gpt_neo": "contrib.models.gpt_neo.src.modeling_gpt_neo:GPTNeoForCausalLM",
+    "codegen": "contrib.models.codegen.src.modeling_codegen:CodeGenForCausalLM",
 }
 
 for model_type, path in CONTRIB_MODELS.items():

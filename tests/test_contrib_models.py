@@ -52,7 +52,8 @@ def test_registry_resolves_contrib_models():
                "granite", "cohere", "glm", "gemma2", "phimoe",
                "recurrent_gemma", "lfm2", "llava",
                "helium", "qwen2_moe", "olmo2", "nemotron",
-               "cohere2", "smollm3", "granitemoe"):
+               "cohere2", "smollm3", "granitemoe",
+               "ernie4_5", "exaone4", "gptj", "gpt_neo", "codegen"):
         assert get_model_cls(mt) is not None
 
 
@@ -585,3 +586,49 @@ def test_exaone4_parity():
     torch.manual_seed(0)
     hf = HFExaone4(cfg).eval()
     _run_parity(Exaone4ForCausalLM, hf, cfg)
+
+
+def test_gptj_parity():
+    from transformers import GPTJConfig, GPTJForCausalLM as HFGPTJ
+
+    from contrib.models.gptj.src.modeling_gptj import GPTJForCausalLM
+
+    cfg = GPTJConfig(vocab_size=256, n_embd=64, n_layer=2, n_head=4,
+                     rotary_dim=8, n_inner=128, resid_pdrop=0.0,
+                     embd_pdrop=0.0, attn_pdrop=0.0, tie_word_embeddings=False)
+    torch.manual_seed(0)
+    hf = HFGPTJ(cfg).eval()
+    _run_parity(GPTJForCausalLM, hf, cfg)
+
+
+def test_gpt_neo_parity():
+    """GPT-Neo: alternating global/local(window) attention with learned
+    positions and UNSCALED scores over the layer-pattern machinery."""
+    from transformers import GPTNeoConfig, GPTNeoForCausalLM as HFNeo
+
+    from contrib.models.gpt_neo.src.modeling_gpt_neo import GPTNeoForCausalLM
+
+    cfg = GPTNeoConfig(vocab_size=256, hidden_size=64, num_layers=4,
+                       num_heads=4, window_size=16, intermediate_size=128,
+                       attention_types=[[["global", "local"], 2]],
+                       resid_dropout=0.0, embed_dropout=0.0,
+                       attention_dropout=0.0, tie_word_embeddings=True)
+    torch.manual_seed(0)
+    hf = HFNeo(cfg).eval()
+    _run_parity(GPTNeoForCausalLM, hf, cfg)
+
+
+def test_codegen_parity():
+    """CodeGen: mp_num=4 packed qkv (blocks of [q|v|k]) unpacked at conversion;
+    block-major head order is self-consistent across projections."""
+    from transformers import CodeGenConfig, CodeGenForCausalLM as HFCodeGen
+
+    from contrib.models.codegen.src.modeling_codegen import CodeGenForCausalLM
+
+    cfg = CodeGenConfig(vocab_size=256, n_embd=64, n_layer=2, n_head=4,
+                        rotary_dim=8, n_inner=128, resid_pdrop=0.0,
+                        embd_pdrop=0.0, attn_pdrop=0.0,
+                        tie_word_embeddings=False)
+    torch.manual_seed(0)
+    hf = HFCodeGen(cfg).eval()
+    _run_parity(CodeGenForCausalLM, hf, cfg)
