@@ -1,0 +1,104 @@
+"""OLMoE (AI2 mixture-of-experts) on the TPU framework (contrib port).
+
+Fine-grained MoE (64 experts, top-8, gates from the full softmax WITHOUT
+renormalization) with full-width q/k RMSNorm.
+"""
+
+from typing import Dict
+
+import numpy as np
+
+from neuronx_distributed_inference_tpu.config import InferenceConfig
+from neuronx_distributed_inference_tpu.models.base import ModelArchArgs
+from neuronx_distributed_inference_tpu.ops import rope as rope_ops
+from neuronx_distributed_inference_tpu.ops.moe import MoEArgs
+from neuronx_distributed_inference_tpu.runtime.application import (
+    TpuModelForCausalLM)
+
+
+class OlmoeInferenceConfig(InferenceConfig):
+    REQUIRED_ATTRIBUTES = ("hidden_size", "num_hidden_layers",
+                           "num_attention_heads", "num_key_value_heads",
+                           "vocab_size", "intermediate_size",
+                           "num_experts", "num_experts_per_tok")
+
+    def add_derived_config(self) -> None:
+        for attr, default in (("rope_theta", 10000.0), ("rms_norm_eps", 1e-5),
+                              ("norm_topk_prob", False),
+                              ("tie_word_embeddings", False)):
+            if not hasattr(self, attr) or getattr(self, attr) is None:
+                setattr(self, attr, default)
+        if not hasattr(self, "head_dim") or self.head_dim is None:
+            self.head_dim = self.hidden_size // self.num_attention_heads
+
+
+class OlmoeForCausalLM(TpuModelForCausalLM):
+    @classmethod
+    def get_config_cls(cls):
+        return OlmoeInferenceConfig
+
+    @classmethod
+    def arch_args_from_config(cls, config) -> ModelArchArgs:
+        return ModelArchArgs(
+            vocab_size=config.vocab_size,
+            hidden_size=config.hidden_size,
+            num_layers=config.num_hidden_layers,
+            num_heads=config.num_attention_heads,
+            num_kv_heads=config.num_key_value_heads,
+            head_dim=config.head_dim,
+            intermediate_size=config.intermediate_size,
+            rms_norm_eps=config.rms_norm_eps,
+            qk_norm=True,
+            qk_norm_scope="full",
+            moe=MoEArgs(num_experts=config.num_experts,
+                        experts_per_tok=config.num_experts_per_tok,
+                        norm_topk_prob=bool(config.norm_topk_prob)),
+            tie_word_embeddings=bool(config.tie_word_embeddings),
+        )
+
+    @classmethod
+    def inv_freq_from_config(cls, config) -> np.ndarray:
+        return rope_ops.default_inv_freq(config.head_dim, float(config.rope_theta))
+
+    @classmethod
+    def convert_hf_state_dict(cls, state_dict: Dict[str, np.ndarray],
+                              config) -> Dict:
+        def get(name):
+            if name not in state_dict:
+                raise KeyError(f"missing weight {name}")
+            return np.asarray(state_dict[name])
+
+        def lin_t(name):
+            return np.ascontiguousarray(get(name).T)
+
+        E = config.num_experts
+        layers = {k: [] for k in ("ln1", "wq", "wk", "wv", "wo",
+                                  "q_norm", "k_norm",
+                                  "ln2", "router", "wg", "wu", "wd")}
+        for i in range(config.num_hidden_layers):
+            p = f"model.layers.{i}."
+            layers["wq"].append(lin_t(p + "self_attn.q_proj.weight"))
+            layers["wk"].append(lin_t(p + "self_attn.k_proj.weight"))
+            layers["wv"].append(lin_t(p + "self_attn.v_proj.weight"))
+            layers["wo"].append(lin_t(p + "self_attn.o_proj.weight"))
+            layers["q_norm"].append(get(p + "self_attn.q_norm.weight"))
+            layers["k_norm"].append(get(p + "self_attn.k_norm.weight"))
+            layers["ln1"].append(get(p + "input_layernorm.weight"))
+            layers["ln2"].append(get(p + "post_attention_layernorm.weight"))
+            m = p + "mlp."
+            layers["router"].append(lin_t(m + "gate.weight"))
+            layers["wg"].append(np.stack(
+                [lin_t(m + f"experts.{e}.gate_proj.weight") for e in range(E)]))
+            layers["wu"].append(np.stack(
+                [lin_t(m + f"experts.{e}.up_proj.weight") for e in range(E)]))
+            layers["wd"].append(np.stack(
+                [lin_t(m + f"experts.{e}.down_proj.weight") for e in range(E)]))
+        out = {
+            "embed": get("model.embed_tokens.weight"),
+            "layers": {k: np.stack(v) for k, v in layers.items()},
+            "final_norm": get("model.norm.weight"),
+            "rope_inv_freq": cls.inv_freq_from_config(config),
+        }
+        if not config.tie_word_embeddings:
+            out["lm_head"] = lin_t("lm_head.weight")
+        return out

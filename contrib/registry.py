@@ -40,6 +40,8 @@ CONTRIB_MODELS = {
     "gptj": "contrib.models.gptj.src.modeling_gptj:GPTJForCausalLM",
     "gpt_neo": "contrib.models.gpt_neo.src.modeling_gpt_neo:GPTNeoForCausalLM",
     "codegen": "contrib.models.codegen.src.modeling_codegen:CodeGenForCausalLM",
+    "olmo": "contrib.models.olmo.src.modeling_olmo:OlmoForCausalLM",
+    "olmoe": "contrib.models.olmoe.src.modeling_olmoe:OlmoeForCausalLM",
 }
 
 for model_type, path in CONTRIB_MODELS.items():

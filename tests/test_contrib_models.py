@@ -53,7 +53,8 @@ def test_registry_resolves_contrib_models():
                "recurrent_gemma", "lfm2", "llava",
                "helium", "qwen2_moe", "olmo2", "nemotron",
                "cohere2", "smollm3", "granitemoe",
-               "ernie4_5", "exaone4", "gptj", "gpt_neo", "codegen"):
+               "ernie4_5", "exaone4", "gptj", "gpt_neo", "codegen",
+               "olmo", "olmoe"):
         assert get_model_cls(mt) is not None
 
 
@@ -632,3 +633,32 @@ def test_codegen_parity():
     torch.manual_seed(0)
     hf = HFCodeGen(cfg).eval()
     _run_parity(CodeGenForCausalLM, hf, cfg)
+
+
+def test_olmo_parity():
+    from transformers import OlmoConfig, OlmoForCausalLM as HFOlmo
+
+    from contrib.models.olmo.src.modeling_olmo import OlmoForCausalLM
+
+    cfg = OlmoConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
+                     num_hidden_layers=2, num_attention_heads=4,
+                     num_key_value_heads=2, clip_qkv=8.0,
+                     pad_token_id=0, tie_word_embeddings=False)
+    torch.manual_seed(0)
+    hf = HFOlmo(cfg).eval()
+    _run_parity(OlmoForCausalLM, hf, cfg)
+
+
+def test_olmoe_parity():
+    from transformers import OlmoeConfig, OlmoeForCausalLM as HFOlmoe
+
+    from contrib.models.olmoe.src.modeling_olmoe import OlmoeForCausalLM
+
+    cfg = OlmoeConfig(vocab_size=256, hidden_size=64, intermediate_size=48,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, num_experts=4,
+                      num_experts_per_tok=2, norm_topk_prob=False,
+                      pad_token_id=0, tie_word_embeddings=False)
+    torch.manual_seed(0)
+    hf = HFOlmoe(cfg).eval()
+    _run_parity(OlmoeForCausalLM, hf, cfg, atol=1e-3, rtol=1e-3)
