@@ -1,0 +1,276 @@
+"""Mamba v1 (state-space) on the TPU framework (contrib port).
+
+A pure selective-SSM family — no attention, no KV cache: each layer's state is
+a (B, d_inner, d_state) fp32 SSM state plus a (B, conv_kernel, d_inner)
+causal-conv tail. TPU redesign:
+
+- **Prefill runs the selective scan as `jax.lax.associative_scan`**: the
+  recurrence h_t = exp(ΔA)⊙h_{t-1} + ΔB x_t is diagonal, hence associative in
+  (a, b) — log-depth on the VPU instead of the HF reference's per-token Python
+  loop. (The scan materializes (B, L, d_inner, d_state) discretized tensors;
+  production long-context prefill would chunk the sequence — correctness-first
+  here.)
+- Right-padded prefill freezes each row's state at its true length (a=1, b=0
+  on padding) so decode resumes exactly; the conv tail gathers the last
+  conv_kernel real inputs.
+- Decode is one fused step: conv-tail dot + a single recurrence update.
+
+≈ reference mamba-family contribs (`contrib/models/Falcon-H1-*/`,
+`state-spaces/mamba-*`); math follows HF `MambaMixer.slow_forward`.
+"""
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neuronx_distributed_inference_tpu.config import InferenceConfig
+from neuronx_distributed_inference_tpu.models.base import ModelArchArgs
+from neuronx_distributed_inference_tpu.ops.norms import rms_norm
+from neuronx_distributed_inference_tpu.runtime.application import (
+    TpuModelForCausalLM)
+
+
+@dataclass(frozen=True)
+class MambaArchArgs(ModelArchArgs):
+    d_inner: int = 0
+    d_state: int = 16
+    d_conv: int = 4
+    dt_rank: int = 0
+
+
+def _ssm_params(lp, x, args):
+    """x (B, T, I) post-conv activations -> (dA, dBu, C) for the recurrence.
+    dA/dBu (B, T, I, S) fp32; C (B, T, S) fp32."""
+    proj = x @ lp["x_proj"]                                  # (B, T, R + 2S)
+    r, s = args.dt_rank, args.d_state
+    dt, b_mat, c_mat = proj[..., :r], proj[..., r : r + s], proj[..., r + s :]
+    delta = jax.nn.softplus(
+        (dt @ lp["dt_proj"] + lp["dt_bias"]).astype(jnp.float32))   # (B, T, I)
+    a = -jnp.exp(lp["a_log"].astype(jnp.float32))            # (I, S)
+    d_a = jnp.exp(delta[..., None] * a[None, None])          # (B, T, I, S)
+    d_bu = (delta[..., None] * b_mat.astype(jnp.float32)[:, :, None, :]
+            * x.astype(jnp.float32)[..., None])              # (B, T, I, S)
+    return d_a, d_bu, c_mat.astype(jnp.float32)
+
+
+def _mixer_prefill(lp, hn, last_token_idx, args):
+    """Full-sequence mamba mixer; returns (out (B, T, H), conv_state, ssm_state)."""
+    w = args.d_conv
+    proj = hn @ lp["in_proj"]                                # (B, T, 2I)
+    x, z = proj[..., : args.d_inner], proj[..., args.d_inner :]
+
+    t = x.shape[1]
+    # conv tail for decode: the last W real inputs per row (zeros if shorter)
+    idx = last_token_idx[:, None] + 1 - w + jnp.arange(w)[None, :]
+    gathered = jnp.take_along_axis(x, jnp.clip(idx, 0, t - 1)[:, :, None], axis=1)
+    conv_state = jnp.where((idx >= 0)[:, :, None], gathered, 0.0)
+
+    xp = jnp.pad(x, ((0, 0), (w - 1, 0), (0, 0)))
+    xc = sum(xp[:, j : j + t, :] * lp["conv_w"][j][None, None, :]
+             for j in range(w)) + lp["conv_b"][None, None, :]
+    xc = jax.nn.silu(xc)
+
+    d_a, d_bu, c_mat = _ssm_params(lp, xc, args)
+    valid = (jnp.arange(t)[None, :] <= last_token_idx[:, None])[:, :, None, None]
+    # freeze padded positions so the carried state is the last real token's
+    d_a = jnp.where(valid, d_a, 1.0)
+    d_bu = jnp.where(valid, d_bu, 0.0)
+
+    def comb(l, r):
+        return (r[0] * l[0], r[0] * l[1] + r[1])
+
+    _, h_seq = jax.lax.associative_scan(comb, (d_a, d_bu), axis=1)  # (B,T,I,S)
+    ssm_state = jnp.take_along_axis(
+        h_seq, last_token_idx[:, None, None, None], axis=1)[:, 0]   # (B, I, S)
+
+    y = jnp.einsum("btis,bts->bti", h_seq, c_mat)            # (B, T, I) fp32
+    y = y + xc.astype(jnp.float32) * lp["d_skip"].astype(jnp.float32)[None, None]
+    y = (y.astype(hn.dtype)) * jax.nn.silu(z)
+    return y @ lp["out_proj"], conv_state.astype(hn.dtype), ssm_state
+
+
+def _mixer_decode(lp, hn, conv_state, ssm_state, args):
+    """One-token mamba step. hn (B, 1, H); conv_state (B, W, I) holds the last W
+    raw inputs; ssm_state (B, I, S) fp32."""
+    proj = hn @ lp["in_proj"]
+    x, z = proj[..., : args.d_inner], proj[..., args.d_inner :]
+    x0 = x[:, 0]                                             # (B, I)
+    state = jnp.concatenate([conv_state[:, 1:], x0[:, None, :]], axis=1)
+    xc = jnp.sum(state * lp["conv_w"][None, :, :], axis=1) + lp["conv_b"]
+    xc = jax.nn.silu(xc)[:, None, :]                         # (B, 1, I)
+
+    d_a, d_bu, c_mat = _ssm_params(lp, xc, args)
+    h = d_a[:, 0] * ssm_state + d_bu[:, 0]                   # (B, I, S)
+    y = jnp.einsum("bis,bs->bi", h, c_mat[:, 0])
+    y = y + xc[:, 0].astype(jnp.float32) * lp["d_skip"].astype(jnp.float32)[None]
+    y = (y.astype(hn.dtype)[:, None, :]) * jax.nn.silu(z)
+    return y @ lp["out_proj"], state.astype(conv_state.dtype), h
+
+
+def _forward(params, args: MambaArchArgs, h, cache, positions, last_token_idx):
+    convs, ssms = [], []
+    for li in range(args.num_layers):
+        lp = jax.tree.map(lambda p: p[li], params["layers"])
+        hn = rms_norm(h, lp["ln1"], args.rms_norm_eps)
+        if positions is None:
+            out, conv_state, ssm_state = _mixer_prefill(lp, hn, last_token_idx,
+                                                        args)
+        else:
+            out, conv_state, ssm_state = _mixer_decode(
+                lp, hn, cache["conv"][li], cache["ssm"][li], args)
+        convs.append(conv_state)
+        ssms.append(ssm_state)
+        h = h + out
+    h = rms_norm(h, params["final_norm"], args.rms_norm_eps)
+    return h, {"conv": jnp.stack(convs), "ssm": jnp.stack(ssms)}
+
+
+def prefill_forward(params, args: MambaArchArgs, input_ids, position_ids,
+                    last_token_idx, cache, mesh=None, rules=None, use_flash=False,
+                    adapter_ids=None, use_ring=False, return_hidden=False):
+    h = jnp.take(params["embed"], input_ids, axis=0)
+    h, out_cache = _forward(params, args, h, cache, None, last_token_idx)
+    h_last = jnp.take_along_axis(h, last_token_idx[:, None, None], axis=1)[:, 0]
+    logits = (h_last @ params["embed"].T).astype(jnp.float32)
+    if return_hidden:
+        return logits, out_cache, h
+    return logits, out_cache
+
+
+def decode_forward(params, args: MambaArchArgs, input_ids, position_ids, cache,
+                   decode_bucket, mesh=None, rules=None, adapter_ids=None,
+                   tree=None, return_hidden=False, **_ignored):
+    if input_ids.shape[1] != 1 or tree is not None:
+        raise ValueError("Mamba decode is single-token only (one SSM state "
+                         "per row)")
+    h = jnp.take(params["embed"], input_ids, axis=0)
+    h, out_cache = _forward(params, args, h, cache, position_ids, None)
+    logits = (h @ params["embed"].T).astype(jnp.float32)
+    if return_hidden:
+        return logits, out_cache, h
+    return logits, out_cache
+
+
+class MambaInferenceConfig(InferenceConfig):
+    REQUIRED_ATTRIBUTES = ("hidden_size", "num_hidden_layers", "vocab_size",
+                           "state_size", "conv_kernel")
+
+    def add_derived_config(self) -> None:
+        for attr, default in (("layer_norm_epsilon", 1e-5),
+                              ("use_bias", False), ("use_conv_bias", True),
+                              ("tie_word_embeddings", True)):
+            if not hasattr(self, attr) or getattr(self, attr) is None:
+                setattr(self, attr, default)
+        if not hasattr(self, "intermediate_size") or not self.intermediate_size:
+            self.intermediate_size = 2 * self.hidden_size
+        if not hasattr(self, "time_step_rank") or self.time_step_rank in (
+                None, "auto"):
+            import math
+
+            self.time_step_rank = math.ceil(self.hidden_size / 16)
+        if self.use_bias:
+            raise ValueError("biased in/out projections are not ported yet")
+
+
+class MambaForCausalLM(TpuModelForCausalLM):
+    def __init__(self, model_path, config, mesh=None):
+        self._require_base_layout(config.tpu_config, "Mamba (selective SSM)")
+        super().__init__(model_path, config, mesh=mesh)
+
+    @classmethod
+    def get_config_cls(cls):
+        return MambaInferenceConfig
+
+    @classmethod
+    def arch_args_from_config(cls, config) -> MambaArchArgs:
+        return MambaArchArgs(
+            vocab_size=config.vocab_size,
+            hidden_size=config.hidden_size,
+            num_layers=config.num_hidden_layers,
+            num_heads=1, num_kv_heads=1,
+            head_dim=config.hidden_size,
+            intermediate_size=config.intermediate_size,
+            rms_norm_eps=config.layer_norm_epsilon,
+            tie_word_embeddings=True,
+            d_inner=int(config.intermediate_size),
+            d_state=int(config.state_size),
+            d_conv=int(config.conv_kernel),
+            dt_rank=int(config.time_step_rank),
+        )
+
+    def prefill_fn(self):
+        return prefill_forward
+
+    def decode_fn(self):
+        return decode_forward
+
+    @classmethod
+    def inv_freq_from_config(cls, config) -> np.ndarray:
+        return np.zeros((1,), np.float32)        # no positional encoding at all
+
+    def reset_cache(self, batch_size: Optional[int] = None) -> None:
+        a: MambaArchArgs = self.arch_args
+        b = batch_size or self.tpu_config.max_batch_size
+        dt = self.tpu_config.jax_dtype
+        self.kv_cache = {
+            "conv": jnp.zeros((a.num_layers, b, a.d_conv, a.d_inner), dt),
+            "ssm": jnp.zeros((a.num_layers, b, a.d_inner, a.d_state),
+                             jnp.float32),
+        }
+
+    def _put_params(self, host_params) -> None:
+        dtype = self.tpu_config.jax_dtype
+        fp32_keys = {"a_log", "d_skip", "dt_bias"}   # recurrence stays fp32
+
+        def _put(path, x):
+            arr = np.asarray(x)
+            last = getattr(path[-1], "key", None) if path else None
+            if arr.dtype.kind == "f":
+                arr = arr.astype(np.float32 if last in fp32_keys else dtype)
+            return jax.device_put(arr)
+
+        self.params = jax.tree_util.tree_map_with_path(_put, host_params)
+        self.reset_cache()
+
+    def init_random_params(self, key):
+        raise NotImplementedError("load from an HF checkpoint or state dict")
+
+    @classmethod
+    def convert_hf_state_dict(cls, state_dict: Dict[str, np.ndarray],
+                              config) -> Dict:
+        def get(name):
+            if name not in state_dict:
+                raise KeyError(f"missing weight {name}")
+            return np.asarray(state_dict[name])
+
+        def lin_t(name):
+            return np.ascontiguousarray(get(name).T)
+
+        layers: Dict[str, list] = {k: [] for k in
+                                   ("ln1", "in_proj", "conv_w", "conv_b",
+                                    "x_proj", "dt_proj", "dt_bias", "a_log",
+                                    "d_skip", "out_proj")}
+        for i in range(config.num_hidden_layers):
+            p = f"backbone.layers.{i}."
+            mx = p + "mixer."
+            layers["ln1"].append(get(p + "norm.weight"))
+            layers["in_proj"].append(lin_t(mx + "in_proj.weight"))
+            # HF conv (I, 1, W): tap j multiplies x[t - (W-1) + j]
+            layers["conv_w"].append(np.ascontiguousarray(
+                get(mx + "conv1d.weight")[:, 0, :].T))
+            layers["conv_b"].append(get(mx + "conv1d.bias"))
+            layers["x_proj"].append(lin_t(mx + "x_proj.weight"))
+            layers["dt_proj"].append(lin_t(mx + "dt_proj.weight"))
+            layers["dt_bias"].append(get(mx + "dt_proj.bias"))
+            layers["a_log"].append(get(mx + "A_log"))
+            layers["d_skip"].append(get(mx + "D"))
+            layers["out_proj"].append(lin_t(mx + "out_proj.weight"))
+        return {
+            "embed": get("backbone.embeddings.weight"),
+            "layers": {k: np.stack(v) for k, v in layers.items()},
+            "final_norm": get("backbone.norm_f.weight"),
+            "rope_inv_freq": cls.inv_freq_from_config(config),
+        }

@@ -42,6 +42,7 @@ CONTRIB_MODELS = {
     "codegen": "contrib.models.codegen.src.modeling_codegen:CodeGenForCausalLM",
     "olmo": "contrib.models.olmo.src.modeling_olmo:OlmoForCausalLM",
     "olmoe": "contrib.models.olmoe.src.modeling_olmoe:OlmoeForCausalLM",
+    "mamba": "contrib.models.mamba.src.modeling_mamba:MambaForCausalLM",
 }
 
 for model_type, path in CONTRIB_MODELS.items():

@@ -54,7 +54,7 @@ def test_registry_resolves_contrib_models():
                "helium", "qwen2_moe", "olmo2", "nemotron",
                "cohere2", "smollm3", "granitemoe",
                "ernie4_5", "exaone4", "gptj", "gpt_neo", "codegen",
-               "olmo", "olmoe"):
+               "olmo", "olmoe", "mamba"):
         assert get_model_cls(mt) is not None
 
 
@@ -662,3 +662,19 @@ def test_olmoe_parity():
     torch.manual_seed(0)
     hf = HFOlmoe(cfg).eval()
     _run_parity(OlmoeForCausalLM, hf, cfg, atol=1e-3, rtol=1e-3)
+
+
+def test_mamba_parity():
+    """Pure selective-SSM family (no attention, no KV cache): associative-scan
+    prefill + single-step recurrence decode must match HF's per-token loop."""
+    from transformers import MambaConfig, MambaForCausalLM as HFMamba
+
+    from contrib.models.mamba.src.modeling_mamba import MambaForCausalLM
+
+    cfg = MambaConfig(vocab_size=256, hidden_size=64, state_size=8,
+                      num_hidden_layers=2, conv_kernel=4, expand=2,
+                      time_step_rank=8, use_bias=False, use_conv_bias=True,
+                      pad_token_id=0, tie_word_embeddings=True)
+    torch.manual_seed(0)
+    hf = HFMamba(cfg).eval()
+    _run_parity(MambaForCausalLM, hf, cfg, atol=2e-3, rtol=1e-3)
