@@ -1,0 +1,383 @@
+"""Jamba (AI21 mamba/attention/MoE hybrid) on the TPU framework (contrib port).
+
+The hub's hybrid-SSM family: mamba mixer layers (with Jamba's dt/B/C RMSNorms)
+interleaved with NoPE GQA attention layers (attn_layer_period/offset), every
+layer followed by an FFN that is either a dense gated MLP or a sparse MoE
+(expert_layer_period/offset, softmax-then-topk gates without renorm). The
+hybrid cache pytree carries per-mamba-layer (conv tail, fp32 SSM state) next
+to the attention layers' stacked KV. Prefill runs the selective scan as a
+`jax.lax.associative_scan` (see contrib/models/mamba); heterogeneous per-layer
+params ride a list pytree.
+"""
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neuronx_distributed_inference_tpu.config import InferenceConfig
+from neuronx_distributed_inference_tpu.models.base import (
+    ModelArchArgs, causal_mask)
+from neuronx_distributed_inference_tpu.ops.attention import attend
+from neuronx_distributed_inference_tpu.ops.norms import rms_norm
+from neuronx_distributed_inference_tpu.runtime.application import (
+    TpuModelForCausalLM)
+
+
+@dataclass(frozen=True)
+class JambaArchArgs(ModelArchArgs):
+    d_inner: int = 0
+    d_state: int = 16
+    d_conv: int = 4
+    dt_rank: int = 0
+    layer_kinds: Tuple[str, ...] = ()     # "attention" | "mamba" per layer
+    ffn_kinds: Tuple[str, ...] = ()       # "dense" | "moe" per layer
+    num_experts: int = 16
+    experts_per_tok: int = 2
+
+
+def _mamba_mixer(lp, hn, args, last_token_idx, conv_state, ssm_state):
+    """Jamba mamba mixer (mamba1 + dt/B/C RMSNorms). Prefill when
+    last_token_idx is given (associative scan), else one-token decode."""
+    w = args.d_conv
+    r, s = args.dt_rank, args.d_state
+    proj = hn @ lp["in_proj"]
+    x, z = proj[..., : args.d_inner], proj[..., args.d_inner :]
+
+    if last_token_idx is not None:                      # prefill
+        t = x.shape[1]
+        idx = last_token_idx[:, None] + 1 - w + jnp.arange(w)[None, :]
+        gathered = jnp.take_along_axis(x, jnp.clip(idx, 0, t - 1)[:, :, None],
+                                       axis=1)
+        conv_state = jnp.where((idx >= 0)[:, :, None], gathered, 0.0)
+        xp = jnp.pad(x, ((0, 0), (w - 1, 0), (0, 0)))
+        xc = sum(xp[:, j : j + t, :] * lp["conv_w"][j][None, None, :]
+                 for j in range(w)) + lp["conv_b"][None, None, :]
+        xc = jax.nn.silu(xc)
+    else:                                               # decode (T = 1)
+        x0 = x[:, 0]
+        conv_state = jnp.concatenate([conv_state[:, 1:], x0[:, None, :]], axis=1)
+        xc = jnp.sum(conv_state * lp["conv_w"][None, :, :], axis=1) + lp["conv_b"]
+        xc = jax.nn.silu(xc)[:, None, :]
+
+    ssm_p = xc @ lp["x_proj"]
+    dt, b_mat, c_mat = ssm_p[..., :r], ssm_p[..., r : r + s], ssm_p[..., r + s :]
+    dt = rms_norm(dt, lp["dt_norm"], args.rms_norm_eps)
+    b_mat = rms_norm(b_mat, lp["b_norm"], args.rms_norm_eps)
+    c_mat = rms_norm(c_mat, lp["c_norm"], args.rms_norm_eps)
+    delta = jax.nn.softplus(
+        (dt @ lp["dt_proj"] + lp["dt_bias"]).astype(jnp.float32))
+    a = -jnp.exp(lp["a_log"].astype(jnp.float32))
+    d_a = jnp.exp(delta[..., None] * a[None, None])
+    d_bu = (delta[..., None] * b_mat.astype(jnp.float32)[:, :, None, :]
+            * xc.astype(jnp.float32)[..., None])
+
+    if last_token_idx is not None:
+        t = xc.shape[1]
+        valid = (jnp.arange(t)[None, :]
+                 <= last_token_idx[:, None])[:, :, None, None]
+        d_a = jnp.where(valid, d_a, 1.0)
+        d_bu = jnp.where(valid, d_bu, 0.0)
+
+        def comb(l, rr):
+            return (rr[0] * l[0], rr[0] * l[1] + rr[1])
+
+        _, h_seq = jax.lax.associative_scan(comb, (d_a, d_bu), axis=1)
+        ssm_state = jnp.take_along_axis(
+            h_seq, last_token_idx[:, None, None, None], axis=1)[:, 0]
+        y = jnp.einsum("btis,bts->bti", h_seq, c_mat.astype(jnp.float32))
+    else:
+        ssm_state = d_a[:, 0] * ssm_state + d_bu[:, 0]
+        y = jnp.einsum("bis,bs->bi", ssm_state,
+                       c_mat[:, 0].astype(jnp.float32))[:, None, :]
+    y = y + xc.astype(jnp.float32) * lp["d_skip"].astype(jnp.float32)
+    y = y.astype(hn.dtype) * jax.nn.silu(z)
+    return y @ lp["out_proj"], conv_state.astype(hn.dtype), ssm_state
+
+
+def _attn(lp, hn, mask, k_cache, v_cache, positions, bucket, args):
+    """NoPE GQA attention over one dense cache layer."""
+    b, t, _ = hn.shape
+    q = (hn @ lp["wq"]).reshape(b, t, args.num_heads, args.head_dim
+                                ).transpose(0, 2, 1, 3)
+    k = (hn @ lp["wk"]).reshape(b, t, args.num_kv_heads, args.head_dim
+                                ).transpose(0, 2, 1, 3)
+    v = (hn @ lp["wv"]).reshape(b, t, args.num_kv_heads, args.head_dim
+                                ).transpose(0, 2, 1, 3)
+    if positions is None:
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k.astype(k_cache.dtype), (0, 0, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v.astype(v_cache.dtype), (0, 0, 0, 0))
+        k_att, v_att = k, v
+    else:
+        def _one(row_c, row_n, p):
+            return jax.lax.dynamic_update_slice(
+                row_c, row_n.astype(row_c.dtype), (0, p, 0))
+
+        k_cache = jax.vmap(_one)(k_cache, k, positions)
+        v_cache = jax.vmap(_one)(v_cache, v, positions)
+        k_att = jax.lax.slice_in_dim(k_cache, 0, bucket, axis=2).astype(q.dtype)
+        v_att = jax.lax.slice_in_dim(v_cache, 0, bucket, axis=2).astype(q.dtype)
+    attn = attend(q, k_att, v_att, mask=mask)
+    attn = attn.transpose(0, 2, 1, 3).reshape(b, t, args.q_size)
+    return attn @ lp["wo"], k_cache, v_cache
+
+
+def _ffn(lp, hn, args, kind):
+    if kind == "dense":
+        return (jax.nn.silu(hn @ lp["wg"]) * (hn @ lp["wu"])) @ lp["wd"]
+    # sparse MoE: softmax over ALL experts, top-k gates WITHOUT renorm
+    b, t, hdim = hn.shape
+    x = hn.reshape(b * t, hdim)
+    logits = (x.astype(jnp.float32) @ lp["router"].astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)
+    top_vals, top_idx = jax.lax.top_k(probs, args.experts_per_tok)
+    gates = jnp.einsum("nk,nke->ne", top_vals,
+                       jax.nn.one_hot(top_idx, args.num_experts,
+                                      dtype=jnp.float32))
+    inter = (jax.nn.silu(jnp.einsum("nh,ehi->eni", x, lp["moe_wg"]))
+             * jnp.einsum("nh,ehi->eni", x, lp["moe_wu"]))
+    per_expert = jnp.einsum("eni,eih->enh", inter, lp["moe_wd"])
+    out = jnp.einsum("enh,ne->nh", per_expert, gates.astype(per_expert.dtype))
+    return out.reshape(b, t, hdim).astype(hn.dtype)
+
+
+def _forward(params, args: JambaArchArgs, h, mask, cache, positions, bucket,
+             last_token_idx):
+    ks, vs, convs, ssms = [], [], [], []
+    ai = mi = 0
+    for li, kind in enumerate(args.layer_kinds):
+        lp = params["layers"][li]
+        hn = rms_norm(h, lp["ln1"], args.rms_norm_eps)
+        if kind == "attention":
+            out, kc, vc = _attn(lp, hn, mask, cache["k"][ai], cache["v"][ai],
+                                positions, bucket, args)
+            ks.append(kc)
+            vs.append(vc)
+            ai += 1
+        else:
+            out, conv_state, ssm_state = _mamba_mixer(
+                lp, hn, args, last_token_idx,
+                cache["conv"][mi] if positions is not None else None,
+                cache["ssm"][mi] if positions is not None else None)
+            convs.append(conv_state)
+            ssms.append(ssm_state)
+            mi += 1
+        h = h + out
+        hn = rms_norm(h, lp["ln2"], args.rms_norm_eps)
+        h = h + _ffn(lp, hn, args, args.ffn_kinds[li])
+    h = rms_norm(h, params["final_norm"], args.rms_norm_eps)
+    out_cache = {"k": jnp.stack(ks), "v": jnp.stack(vs),
+                 "conv": jnp.stack(convs), "ssm": jnp.stack(ssms)}
+    return h, out_cache
+
+
+def prefill_forward(params, args: JambaArchArgs, input_ids, position_ids,
+                    last_token_idx, cache, mesh=None, rules=None, use_flash=False,
+                    adapter_ids=None, use_ring=False, return_hidden=False):
+    h = jnp.take(params["embed"], input_ids, axis=0)
+    t = input_ids.shape[1]
+    mask = (position_ids[:, None, :, None] >= position_ids[:, None, None, :])
+    mask &= causal_mask(t, t)[None, None]
+    h, out_cache = _forward(params, args, h, mask, cache, None, None,
+                            last_token_idx)
+    h_last = jnp.take_along_axis(h, last_token_idx[:, None, None], axis=1)[:, 0]
+    logits = (h_last @ params["lm_head"]).astype(jnp.float32)
+    if return_hidden:
+        return logits, out_cache, h
+    return logits, out_cache
+
+
+def decode_forward(params, args: JambaArchArgs, input_ids, position_ids, cache,
+                   decode_bucket, mesh=None, rules=None, adapter_ids=None,
+                   tree=None, return_hidden=False, **_ignored):
+    if input_ids.shape[1] != 1 or tree is not None:
+        raise ValueError("Jamba decode is single-token only")
+    h = jnp.take(params["embed"], input_ids, axis=0)
+    kv_pos = jnp.arange(decode_bucket)[None, None, None, :]
+    mask = kv_pos <= position_ids[:, None, None, None]
+    h, out_cache = _forward(params, args, h, mask, cache, position_ids,
+                            decode_bucket, None)
+    logits = (h @ params["lm_head"]).astype(jnp.float32)
+    if return_hidden:
+        return logits, out_cache, h
+    return logits, out_cache
+
+
+class JambaInferenceConfig(InferenceConfig):
+    REQUIRED_ATTRIBUTES = ("hidden_size", "num_hidden_layers",
+                           "num_attention_heads", "num_key_value_heads",
+                           "vocab_size", "intermediate_size",
+                           "attn_layer_period", "attn_layer_offset",
+                           "expert_layer_period", "expert_layer_offset",
+                           "num_experts", "num_experts_per_tok")
+
+    def add_derived_config(self) -> None:
+        for attr, default in (("rms_norm_eps", 1e-6), ("mamba_d_state", 16),
+                              ("mamba_d_conv", 4), ("mamba_expand", 2),
+                              ("mamba_dt_rank", "auto"),
+                              ("tie_word_embeddings", False)):
+            if not hasattr(self, attr) or getattr(self, attr) is None:
+                setattr(self, attr, default)
+        if not hasattr(self, "head_dim") or self.head_dim is None:
+            self.head_dim = self.hidden_size // self.num_attention_heads
+        if self.mamba_dt_rank == "auto":
+            import math
+
+            self.mamba_dt_rank = math.ceil(self.hidden_size / 16)
+
+    def layer_kinds(self):
+        return tuple(
+            "attention" if i % self.attn_layer_period == self.attn_layer_offset
+            else "mamba" for i in range(self.num_hidden_layers))
+
+    def ffn_kinds(self):
+        return tuple(
+            "moe" if (self.num_experts > 1
+                      and i % self.expert_layer_period == self.expert_layer_offset)
+            else "dense" for i in range(self.num_hidden_layers))
+
+
+class JambaForCausalLM(TpuModelForCausalLM):
+    def __init__(self, model_path, config, mesh=None):
+        self._require_base_layout(config.tpu_config, "Jamba (hybrid SSM)")
+        super().__init__(model_path, config, mesh=mesh)
+
+    @classmethod
+    def get_config_cls(cls):
+        return JambaInferenceConfig
+
+    @classmethod
+    def arch_args_from_config(cls, config) -> JambaArchArgs:
+        return JambaArchArgs(
+            vocab_size=config.vocab_size,
+            hidden_size=config.hidden_size,
+            num_layers=config.num_hidden_layers,
+            num_heads=config.num_attention_heads,
+            num_kv_heads=config.num_key_value_heads,
+            head_dim=config.head_dim,
+            intermediate_size=config.intermediate_size,
+            rms_norm_eps=config.rms_norm_eps,
+            tie_word_embeddings=bool(config.tie_word_embeddings),
+            d_inner=int(config.mamba_expand * config.hidden_size),
+            d_state=int(config.mamba_d_state),
+            d_conv=int(config.mamba_d_conv),
+            dt_rank=int(config.mamba_dt_rank),
+            layer_kinds=config.layer_kinds(),
+            ffn_kinds=config.ffn_kinds(),
+            num_experts=int(config.num_experts),
+            experts_per_tok=int(config.num_experts_per_tok),
+        )
+
+    def prefill_fn(self):
+        return prefill_forward
+
+    def decode_fn(self):
+        return decode_forward
+
+    @classmethod
+    def inv_freq_from_config(cls, config) -> np.ndarray:
+        return np.zeros((1,), np.float32)        # Jamba attention is NoPE
+
+    def reset_cache(self, batch_size: Optional[int] = None) -> None:
+        a: JambaArchArgs = self.arch_args
+        b = batch_size or self.tpu_config.max_batch_size
+        dt = self.tpu_config.jax_dtype
+        n_att = sum(1 for k in a.layer_kinds if k == "attention")
+        n_mamba = len(a.layer_kinds) - n_att
+        self.kv_cache = {
+            "k": jnp.zeros((max(n_att, 1), b, a.num_kv_heads,
+                            self.tpu_config.seq_len, a.head_dim), dt),
+            "v": jnp.zeros((max(n_att, 1), b, a.num_kv_heads,
+                            self.tpu_config.seq_len, a.head_dim), dt),
+            "conv": jnp.zeros((max(n_mamba, 1), b, a.d_conv, a.d_inner), dt),
+            "ssm": jnp.zeros((max(n_mamba, 1), b, a.d_inner, a.d_state),
+                             jnp.float32),
+        }
+
+    def _put_params(self, host_params) -> None:
+        dtype = self.tpu_config.jax_dtype
+        fp32_keys = {"a_log", "d_skip", "dt_bias"}
+
+        def _put(path, x):
+            arr = np.asarray(x)
+            last = getattr(path[-1], "key", None) if path else None
+            if arr.dtype.kind == "f":
+                arr = arr.astype(np.float32 if last in fp32_keys else dtype)
+            return jax.device_put(arr)
+
+        self.params = jax.tree_util.tree_map_with_path(_put, host_params)
+        self.reset_cache()
+
+    def init_random_params(self, key):
+        raise NotImplementedError("load from an HF checkpoint or state dict")
+
+    @classmethod
+    def convert_hf_state_dict(cls, state_dict: Dict[str, np.ndarray],
+                              config) -> Dict:
+        def get(name):
+            if name not in state_dict:
+                raise KeyError(f"missing weight {name}")
+            return np.asarray(state_dict[name])
+
+        def lin_t(name):
+            return np.ascontiguousarray(get(name).T)
+
+        kinds = config.layer_kinds()
+        ffns = config.ffn_kinds()
+        layers = []
+        for i in range(config.num_hidden_layers):
+            p = f"model.layers.{i}."
+            lp: Dict[str, np.ndarray] = {
+                "ln1": get(p + "input_layernorm.weight"),
+                "ln2": get(p + "pre_ff_layernorm.weight"),
+            }
+            if kinds[i] == "attention":
+                lp["wq"] = lin_t(p + "self_attn.q_proj.weight")
+                lp["wk"] = lin_t(p + "self_attn.k_proj.weight")
+                lp["wv"] = lin_t(p + "self_attn.v_proj.weight")
+                lp["wo"] = lin_t(p + "self_attn.o_proj.weight")
+            else:
+                mx = p + "mamba."
+                lp["in_proj"] = lin_t(mx + "in_proj.weight")
+                lp["conv_w"] = np.ascontiguousarray(
+                    get(mx + "conv1d.weight")[:, 0, :].T)
+                lp["conv_b"] = get(mx + "conv1d.bias")
+                lp["x_proj"] = lin_t(mx + "x_proj.weight")
+                lp["dt_proj"] = lin_t(mx + "dt_proj.weight")
+                lp["dt_bias"] = get(mx + "dt_proj.bias")
+                lp["dt_norm"] = get(mx + "dt_layernorm.weight")
+                lp["b_norm"] = get(mx + "b_layernorm.weight")
+                lp["c_norm"] = get(mx + "c_layernorm.weight")
+                lp["a_log"] = get(mx + "A_log")
+                lp["d_skip"] = get(mx + "D")
+                lp["out_proj"] = lin_t(mx + "out_proj.weight")
+            if ffns[i] == "moe":
+                m = p + "feed_forward."
+                lp["router"] = lin_t(m + "router.weight")
+                E = config.num_experts
+                lp["moe_wg"] = np.stack(
+                    [lin_t(m + f"experts.{e}.gate_proj.weight")
+                     for e in range(E)])
+                lp["moe_wu"] = np.stack(
+                    [lin_t(m + f"experts.{e}.up_proj.weight")
+                     for e in range(E)])
+                lp["moe_wd"] = np.stack(
+                    [lin_t(m + f"experts.{e}.down_proj.weight")
+                     for e in range(E)])
+            else:
+                m = p + "feed_forward."
+                lp["wg"] = lin_t(m + "gate_proj.weight")
+                lp["wu"] = lin_t(m + "up_proj.weight")
+                lp["wd"] = lin_t(m + "down_proj.weight")
+            layers.append(lp)
+        return {
+            "embed": get("model.embed_tokens.weight"),
+            "layers": layers,
+            "final_norm": get("model.final_layernorm.weight"),
+            "lm_head": lin_t("lm_head.weight"),
+            "rope_inv_freq": cls.inv_freq_from_config(config),
+        }

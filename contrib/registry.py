@@ -43,6 +43,7 @@ CONTRIB_MODELS = {
     "olmo": "contrib.models.olmo.src.modeling_olmo:OlmoForCausalLM",
     "olmoe": "contrib.models.olmoe.src.modeling_olmoe:OlmoeForCausalLM",
     "mamba": "contrib.models.mamba.src.modeling_mamba:MambaForCausalLM",
+    "jamba": "contrib.models.jamba.src.modeling_jamba:JambaForCausalLM",
 }
 
 for model_type, path in CONTRIB_MODELS.items():
