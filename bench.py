@@ -200,6 +200,14 @@ def main() -> None:
     }
 
     if not small:
+        # free the dense app's device buffers first: the paged serving app loads
+        # its own 8 GB of int8 weights, and two copies exceed one chip's HBM
+        app.params = None
+        app.kv_cache = None
+        del app
+        import gc
+
+        gc.collect()
         extra["paged_serving_tok_per_s"] = _paged_serving_throughput(hf_cfg, quant)
 
     print(json.dumps({
