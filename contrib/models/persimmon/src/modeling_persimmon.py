@@ -1,0 +1,123 @@
+"""Persimmon (Adept 8B) on the TPU framework (contrib port).
+
+Fully-biased decoder with per-head q/k LayerNorm (qk_norm_type="layer"),
+half-width partial rotary (theta 25000), squared-ReLU plain MLP, biased
+LayerNorms, and a per-head-interleaved fused query_key_value projection
+([q|k|v] within each head's 3*d block, unpacked at conversion).
+"""
+
+from typing import Dict
+
+import numpy as np
+
+from neuronx_distributed_inference_tpu.config import InferenceConfig
+from neuronx_distributed_inference_tpu.models.base import ModelArchArgs
+from neuronx_distributed_inference_tpu.ops import rope as rope_ops
+from neuronx_distributed_inference_tpu.runtime.application import (
+    TpuModelForCausalLM)
+
+
+class PersimmonInferenceConfig(InferenceConfig):
+    REQUIRED_ATTRIBUTES = ("hidden_size", "num_hidden_layers",
+                           "num_attention_heads", "vocab_size",
+                           "intermediate_size")
+
+    def add_derived_config(self) -> None:
+        for attr, default in (("rope_theta", 25000.0), ("layer_norm_eps", 1e-5),
+                              ("partial_rotary_factor", 0.5),
+                              ("qk_layernorm", True), ("hidden_act", "relu2"),
+                              ("tie_word_embeddings", False)):
+            if not hasattr(self, attr) or getattr(self, attr) is None:
+                setattr(self, attr, default)
+        if not hasattr(self, "num_key_value_heads") \
+                or self.num_key_value_heads is None:
+            self.num_key_value_heads = self.num_attention_heads
+        if not hasattr(self, "head_dim") or self.head_dim is None:
+            self.head_dim = self.hidden_size // self.num_attention_heads
+
+
+class PersimmonForCausalLM(TpuModelForCausalLM):
+    @classmethod
+    def get_config_cls(cls):
+        return PersimmonInferenceConfig
+
+    @classmethod
+    def arch_args_from_config(cls, config) -> ModelArchArgs:
+        return ModelArchArgs(
+            vocab_size=config.vocab_size,
+            hidden_size=config.hidden_size,
+            num_layers=config.num_hidden_layers,
+            num_heads=config.num_attention_heads,
+            num_kv_heads=config.num_key_value_heads,
+            head_dim=config.head_dim,
+            intermediate_size=config.intermediate_size,
+            rms_norm_eps=config.layer_norm_eps,
+            norm_type="layer",
+            norm_bias=True,
+            activation=config.hidden_act,
+            mlp_kind="plain",
+            mlp_bias=True,
+            attention_bias=True,
+            o_bias=True,
+            qk_norm=bool(config.qk_layernorm),
+            qk_norm_type="layer",
+            rotary_dim=int(config.head_dim * float(config.partial_rotary_factor)),
+            tie_word_embeddings=bool(config.tie_word_embeddings),
+        )
+
+    @classmethod
+    def inv_freq_from_config(cls, config) -> np.ndarray:
+        rd = int(config.head_dim * float(config.partial_rotary_factor))
+        return rope_ops.default_inv_freq(rd, float(config.rope_theta))
+
+    @classmethod
+    def convert_hf_state_dict(cls, state_dict: Dict[str, np.ndarray],
+                              config) -> Dict:
+        def get(name):
+            if name not in state_dict:
+                raise KeyError(f"missing weight {name}")
+            return np.asarray(state_dict[name])
+
+        def lin_t(name):
+            return np.ascontiguousarray(get(name).T)
+
+        H = config.hidden_size
+        n = config.num_attention_heads
+        d = config.head_dim
+        layers = {k: [] for k in ("ln1", "ln1_b", "wq", "wk", "wv",
+                                  "bq", "bk", "bv", "wo", "bo",
+                                  "q_norm", "q_norm_b", "k_norm", "k_norm_b",
+                                  "ln2", "ln2_b", "wg", "bg", "wd", "bd")}
+        for i in range(config.num_hidden_layers):
+            p = f"model.layers.{i}."
+            # query_key_value packs [q|k|v] per head: (H, n, 3, d) in x@w layout
+            qkv = lin_t(p + "self_attn.query_key_value.weight").reshape(H, n, 3, d)
+            bias = get(p + "self_attn.query_key_value.bias").reshape(n, 3, d)
+            layers["wq"].append(np.ascontiguousarray(qkv[:, :, 0].reshape(H, n * d)))
+            layers["wk"].append(np.ascontiguousarray(qkv[:, :, 1].reshape(H, n * d)))
+            layers["wv"].append(np.ascontiguousarray(qkv[:, :, 2].reshape(H, n * d)))
+            layers["bq"].append(np.ascontiguousarray(bias[:, 0].reshape(-1)))
+            layers["bk"].append(np.ascontiguousarray(bias[:, 1].reshape(-1)))
+            layers["bv"].append(np.ascontiguousarray(bias[:, 2].reshape(-1)))
+            layers["wo"].append(lin_t(p + "self_attn.dense.weight"))
+            layers["bo"].append(get(p + "self_attn.dense.bias"))
+            layers["q_norm"].append(get(p + "self_attn.q_layernorm.weight"))
+            layers["q_norm_b"].append(get(p + "self_attn.q_layernorm.bias"))
+            layers["k_norm"].append(get(p + "self_attn.k_layernorm.weight"))
+            layers["k_norm_b"].append(get(p + "self_attn.k_layernorm.bias"))
+            layers["ln1"].append(get(p + "input_layernorm.weight"))
+            layers["ln1_b"].append(get(p + "input_layernorm.bias"))
+            layers["ln2"].append(get(p + "post_attention_layernorm.weight"))
+            layers["ln2_b"].append(get(p + "post_attention_layernorm.bias"))
+            layers["wg"].append(lin_t(p + "mlp.dense_h_to_4h.weight"))
+            layers["bg"].append(get(p + "mlp.dense_h_to_4h.bias"))
+            layers["wd"].append(lin_t(p + "mlp.dense_4h_to_h.weight"))
+            layers["bd"].append(get(p + "mlp.dense_4h_to_h.bias"))
+        return {
+            "embed": get("model.embed_tokens.weight"),
+            "layers": {k: np.stack(v) for k, v in layers.items()},
+            "final_norm": get("model.final_layernorm.weight"),
+            "final_norm_b": get("model.final_layernorm.bias"),
+            "lm_head": lin_t("lm_head.weight"),
+            "rope_inv_freq": cls.inv_freq_from_config(config),
+        }

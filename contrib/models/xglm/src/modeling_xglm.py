@@ -1,0 +1,123 @@
+"""XGLM (Meta multilingual GPT) on the TPU framework (contrib port).
+
+Pre-LN decoder with FIXED sinusoidal positions (fairseq convention: computed,
+not stored — materialized into the learned-position table at conversion, with
+the fairseq +2 offset), sqrt(d_model)-scaled embeddings, biased plain-gelu
+FFN, tied head.
+"""
+
+import math
+from typing import Dict
+
+import numpy as np
+
+from neuronx_distributed_inference_tpu.config import InferenceConfig
+from neuronx_distributed_inference_tpu.models.base import ModelArchArgs
+from neuronx_distributed_inference_tpu.runtime.application import (
+    TpuModelForCausalLM)
+
+
+def sinusoidal_table(num_positions: int, dim: int, padding_idx: int = 1
+                     ) -> np.ndarray:
+    """fairseq/XGLM sinusoidal embedding table ([sin | cos] halves)."""
+    half = dim // 2
+    freq = np.exp(np.arange(half, dtype=np.float64)
+                  * -(math.log(10000.0) / (half - 1)))
+    pos = np.arange(num_positions, dtype=np.float64)[:, None] * freq[None, :]
+    table = np.concatenate([np.sin(pos), np.cos(pos)], axis=1)
+    if dim % 2 == 1:
+        table = np.concatenate([table, np.zeros((num_positions, 1))], axis=1)
+    table[padding_idx] = 0.0
+    return table.astype(np.float32)
+
+
+class XGLMInferenceConfig(InferenceConfig):
+    REQUIRED_ATTRIBUTES = ("d_model", "num_layers", "attention_heads",
+                           "vocab_size", "ffn_dim")
+
+    def add_derived_config(self) -> None:
+        for attr, default in (("layer_norm_eps", 1e-5), ("scale_embedding", True),
+                              ("max_position_embeddings", 2048),
+                              ("activation_function", "gelu"),
+                              ("tie_word_embeddings", True)):
+            if not hasattr(self, attr) or getattr(self, attr) is None:
+                setattr(self, attr, default)
+
+
+class XGLMForCausalLM(TpuModelForCausalLM):
+    @classmethod
+    def get_config_cls(cls):
+        return XGLMInferenceConfig
+
+    @classmethod
+    def arch_args_from_config(cls, config) -> ModelArchArgs:
+        d = config.d_model // config.attention_heads
+        return ModelArchArgs(
+            vocab_size=config.vocab_size,
+            hidden_size=config.d_model,
+            num_layers=config.num_layers,
+            num_heads=config.attention_heads,
+            num_kv_heads=config.attention_heads,
+            head_dim=d,
+            intermediate_size=config.ffn_dim,
+            rms_norm_eps=config.layer_norm_eps,
+            norm_type="layer",
+            norm_bias=True,
+            activation=config.activation_function,
+            mlp_kind="plain",
+            mlp_bias=True,
+            attention_bias=True,
+            o_bias=True,
+            learned_pos=True,                # fixed sinusoidal table, same path
+            pos_offset=2,                    # fairseq offset
+            embedding_multiplier=(math.sqrt(config.d_model)
+                                  if config.scale_embedding else 1.0),
+            tie_word_embeddings=bool(config.tie_word_embeddings),
+        )
+
+    @classmethod
+    def inv_freq_from_config(cls, config) -> np.ndarray:
+        d = config.d_model // config.attention_heads
+        return np.zeros((d // 2,), np.float32)   # positions are sinusoidal, no rope
+
+    @classmethod
+    def convert_hf_state_dict(cls, state_dict: Dict[str, np.ndarray],
+                              config) -> Dict:
+        def get(name):
+            if name not in state_dict:
+                raise KeyError(f"missing weight {name}")
+            return np.asarray(state_dict[name])
+
+        def lin_t(name):
+            return np.ascontiguousarray(get(name).T)
+
+        layers = {k: [] for k in ("ln1", "ln1_b", "wq", "wk", "wv",
+                                  "bq", "bk", "bv", "wo", "bo",
+                                  "ln2", "ln2_b", "wg", "bg", "wd", "bd")}
+        for i in range(config.num_layers):
+            p = f"model.layers.{i}."
+            layers["wq"].append(lin_t(p + "self_attn.q_proj.weight"))
+            layers["wk"].append(lin_t(p + "self_attn.k_proj.weight"))
+            layers["wv"].append(lin_t(p + "self_attn.v_proj.weight"))
+            layers["bq"].append(get(p + "self_attn.q_proj.bias"))
+            layers["bk"].append(get(p + "self_attn.k_proj.bias"))
+            layers["bv"].append(get(p + "self_attn.v_proj.bias"))
+            layers["wo"].append(lin_t(p + "self_attn.out_proj.weight"))
+            layers["bo"].append(get(p + "self_attn.out_proj.bias"))
+            layers["ln1"].append(get(p + "self_attn_layer_norm.weight"))
+            layers["ln1_b"].append(get(p + "self_attn_layer_norm.bias"))
+            layers["ln2"].append(get(p + "final_layer_norm.weight"))
+            layers["ln2_b"].append(get(p + "final_layer_norm.bias"))
+            layers["wg"].append(lin_t(p + "fc1.weight"))
+            layers["bg"].append(get(p + "fc1.bias"))
+            layers["wd"].append(lin_t(p + "fc2.weight"))
+            layers["bd"].append(get(p + "fc2.bias"))
+        return {
+            "embed": get("model.embed_tokens.weight"),
+            "pos_embed": sinusoidal_table(
+                config.max_position_embeddings + 2, config.d_model),
+            "layers": {k: np.stack(v) for k, v in layers.items()},
+            "final_norm": get("model.layer_norm.weight"),
+            "final_norm_b": get("model.layer_norm.bias"),
+            "rope_inv_freq": cls.inv_freq_from_config(config),
+        }

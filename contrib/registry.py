@@ -44,6 +44,9 @@ CONTRIB_MODELS = {
     "olmoe": "contrib.models.olmoe.src.modeling_olmoe:OlmoeForCausalLM",
     "mamba": "contrib.models.mamba.src.modeling_mamba:MambaForCausalLM",
     "jamba": "contrib.models.jamba.src.modeling_jamba:JambaForCausalLM",
+    "persimmon": "contrib.models.persimmon.src.modeling_persimmon:PersimmonForCausalLM",
+    "xglm": "contrib.models.xglm.src.modeling_xglm:XGLMForCausalLM",
+    "seed_oss": "contrib.models.seed_oss.src.modeling_seed_oss:SeedOssForCausalLM",
 }
 
 for model_type, path in CONTRIB_MODELS.items():

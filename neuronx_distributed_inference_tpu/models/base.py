@@ -65,6 +65,8 @@ class ModelArchArgs:
     qk_norm: bool = False                 # qwen3-style per-head RMSNorm on q/k
     qk_norm_scope: str = "head"           # "head" (per-head) | "full" (olmo2: over
     #                                       the whole flattened q/k projection)
+    qk_norm_type: str = "rms"             # "rms" | "layer" (persimmon: biased
+    #                                       per-head LayerNorm, params q_norm_b/k_norm_b)
     pre_norms: bool = True                # False = no input norms; the branch
     #                                       output norms (sandwich) carry alone (olmo2)
     sliding_window: Optional[int] = None  # gemma/gpt-oss SWA (applied to all layers if set)
@@ -180,6 +182,9 @@ def param_logical_axes(args: ModelArchArgs) -> Params:
         layer["sinks"] = ("layers", "heads")
     if args.qk_norm:
         layer.update({"q_norm": ("layers", None), "k_norm": ("layers", None)})
+        if args.qk_norm_type == "layer":
+            layer.update({"q_norm_b": ("layers", None),
+                          "k_norm_b": ("layers", None)})
     if args.sandwich_norms:
         layer.update({"ln1_post": ("layers", None), "ln2_post": ("layers", None)})
     if args.lora is not None:
@@ -292,6 +297,11 @@ def init_params(args: ModelArchArgs, key: jax.Array, dtype=jnp.bfloat16,
             "q_norm": jnp.full((L, qn), norm_fill, dtype=dtype),
             "k_norm": jnp.full((L, kn), norm_fill, dtype=dtype),
         })
+        if args.qk_norm_type == "layer":
+            layers.update({
+                "q_norm_b": jnp.zeros((L, qn), dtype=dtype),
+                "k_norm_b": jnp.zeros((L, kn), dtype=dtype),
+            })
     if args.sandwich_norms:
         layers.update({
             "ln1_post": jnp.full((L, H), norm_fill, dtype=dtype),
@@ -432,9 +442,13 @@ def _project_qkv(lp: Params, args: ModelArchArgs, hn: jnp.ndarray,
     k = k.reshape(b, s, args.num_kv_heads, args.head_dim).transpose(0, 2, 1, 3)
     v = v.reshape(b, s, args.num_kv_heads, args.head_dim).transpose(0, 2, 1, 3)
     if args.qk_norm and args.qk_norm_scope == "head":
-        zc = args.zero_centered_norms
-        q = rms_norm(q, lp["q_norm"], args.rms_norm_eps, zero_centered=zc)
-        k = rms_norm(k, lp["k_norm"], args.rms_norm_eps, zero_centered=zc)
+        if args.qk_norm_type == "layer":
+            q = layer_norm(q, lp["q_norm"], lp["q_norm_b"], eps=args.rms_norm_eps)
+            k = layer_norm(k, lp["k_norm"], lp["k_norm_b"], eps=args.rms_norm_eps)
+        else:
+            zc = args.zero_centered_norms
+            q = rms_norm(q, lp["q_norm"], args.rms_norm_eps, zero_centered=zc)
+            k = rms_norm(k, lp["k_norm"], args.rms_norm_eps, zero_centered=zc)
     return q, k, v
 
 

@@ -54,7 +54,8 @@ def test_registry_resolves_contrib_models():
                "helium", "qwen2_moe", "olmo2", "nemotron",
                "cohere2", "smollm3", "granitemoe",
                "ernie4_5", "exaone4", "gptj", "gpt_neo", "codegen",
-               "olmo", "olmoe", "mamba", "jamba"):
+               "olmo", "olmoe", "mamba", "jamba", "persimmon", "xglm",
+               "seed_oss"):
         assert get_model_cls(mt) is not None
 
 
@@ -699,3 +700,52 @@ def test_jamba_parity():
     torch.manual_seed(0)
     hf = HFJamba(cfg).eval()
     _run_parity(JambaForCausalLM, hf, cfg, atol=2e-3, rtol=1e-3)
+
+
+def test_persimmon_parity():
+    """Persimmon: per-head q/k LayerNorm (biased), per-head-interleaved fused
+    qkv unpacked at conversion, relu2 plain MLP, partial rotary."""
+    from transformers import PersimmonConfig, PersimmonForCausalLM as HFPersimmon
+
+    from contrib.models.persimmon.src.modeling_persimmon import (
+        PersimmonForCausalLM)
+
+    cfg = PersimmonConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
+                          num_hidden_layers=2, num_attention_heads=4,
+                          partial_rotary_factor=0.5, qk_layernorm=True,
+                          hidden_act="relu2", pad_token_id=0,
+                          tie_word_embeddings=False)
+    torch.manual_seed(0)
+    hf = HFPersimmon(cfg).eval()
+    _run_parity(PersimmonForCausalLM, hf, cfg)
+
+
+def test_xglm_parity():
+    """XGLM: computed fairseq sinusoidal positions (offset 2) materialized into
+    the learned-position table; scaled embeddings; biased pre-LN decoder."""
+    from transformers import XGLMConfig, XGLMForCausalLM as HFXglm
+
+    from contrib.models.xglm.src.modeling_xglm import XGLMForCausalLM
+
+    cfg = XGLMConfig(vocab_size=256, d_model=64, ffn_dim=128, num_layers=2,
+                     attention_heads=4, dropout=0.0, attention_dropout=0.0,
+                     activation_dropout=0.0, scale_embedding=True,
+                     pad_token_id=0, tie_word_embeddings=True)
+    torch.manual_seed(0)
+    hf = HFXglm(cfg).eval()
+    _run_parity(XGLMForCausalLM, hf, cfg)
+
+
+def test_seed_oss_parity():
+    from transformers import SeedOssConfig, SeedOssForCausalLM as HFSeedOss
+
+    from contrib.models.seed_oss.src.modeling_seed_oss import SeedOssForCausalLM
+
+    cfg = SeedOssConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
+                        num_hidden_layers=2, num_attention_heads=4,
+                        num_key_value_heads=2, head_dim=16,
+                        attention_bias=True, attention_out_bias=False,
+                        pad_token_id=0, tie_word_embeddings=False)
+    torch.manual_seed(0)
+    hf = HFSeedOss(cfg).eval()
+    _run_parity(SeedOssForCausalLM, hf, cfg)
