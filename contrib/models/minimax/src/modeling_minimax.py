@@ -1,0 +1,401 @@
+"""MiniMax-Text (lightning/linear-attention hybrid MoE) on the TPU framework
+(contrib port).
+
+The hub's linear-attention family: alternating FULL softmax-attention layers
+(standard GQA + rope + KV cache) and LIGHTNING attention layers — per-head
+exponentially-decayed linear attention whose state is a (B, heads, d, d) fp32
+KV outer-product matrix, not a KV cache. TPU redesign:
+
+- Prefill runs the block formulation as a `jax.lax.scan` over sequence blocks
+  with the state matrix as carry: intra-block (QKᵀ ⊙ decay) V plus inter-block
+  (Q ⊙ q_decay) S, then S ← S·e^{-λB} + (K ⊙ k_decay)ᵀ V — the HF reference's
+  Python block loop, expressed as a compiled scan.
+- Right padding: padded V rows are zeroed (their outer products vanish), and
+  the carried state is rescaled by e^{+λ·pad} per row afterwards so decode
+  resumes with exactly the true-length state.
+- Decode is one fused update: S ← e^{-λ}S + kᵀv; out = qS.
+- The block output is RMS-normed, sigmoid-gated from the hidden state, and
+  projected; every layer's FFN is a Mixtral-style MoE (softmax-topk-renorm);
+  the residual stream itself is normed each layer with the alpha/beta factors.
+"""
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neuronx_distributed_inference_tpu.config import InferenceConfig
+from neuronx_distributed_inference_tpu.models.base import (
+    ModelArchArgs, causal_mask)
+from neuronx_distributed_inference_tpu.ops import rope as rope_ops
+from neuronx_distributed_inference_tpu.ops.attention import attend
+from neuronx_distributed_inference_tpu.ops.norms import rms_norm
+from neuronx_distributed_inference_tpu.runtime.application import (
+    TpuModelForCausalLM)
+
+
+@dataclass(frozen=True)
+class MiniMaxArchArgs(ModelArchArgs):
+    layer_kinds: Tuple[str, ...] = ()     # "full" | "linear" per layer
+    block_size: int = 256
+    num_experts: int = 8
+    experts_per_tok: int = 2
+    attn_alpha: float = 1.0
+    attn_beta: float = 1.0
+    mlp_alpha: float = 1.0
+    mlp_beta: float = 1.0
+
+
+def _slope_rate(num_heads: int, layer_idx: int, num_layers: int) -> np.ndarray:
+    """Per-head lightning decay rates (HF `get_slope_rate`)."""
+    base = 1.0 / (2.0 ** (8.0 / num_heads))
+    rate = base ** (np.arange(num_heads) + 1)
+    factor = 1.0 - layer_idx / (num_layers - 1 + 1e-5) + 1e-5
+    return (rate * factor).astype(np.float32)            # (h,)
+
+
+def _lightning_prefill(lp, hn, args, last_token_idx, slope):
+    """Blocked linear attention over the full sequence.
+    Returns (out (B, T, H), state (B, h, d, d) fp32 at each row's true length)."""
+    b, t, _ = hn.shape
+    n, d = args.num_heads, args.head_dim
+    qkv = jax.nn.silu(hn @ lp["wqkv"]).reshape(b, t, n, 3 * d)
+    q = qkv[..., :d].transpose(0, 2, 1, 3)               # (B, h, T, d)
+    k = qkv[..., d : 2 * d].transpose(0, 2, 1, 3)
+    v = qkv[..., 2 * d :].transpose(0, 2, 1, 3)
+    # zero padded V rows: their KV outer products then vanish from the state
+    valid = (jnp.arange(t)[None, :] <= last_token_idx[:, None])
+    v = jnp.where(valid[:, None, :, None], v, 0.0)
+
+    bs = min(args.block_size, t)
+    pad = (-t) % bs
+    if pad:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    nb = (t + pad) // bs
+    sl = slope[None, :, None, None]                      # (1, h, 1, 1)
+    rng_b = jnp.arange(bs, dtype=jnp.float32) + 1.0
+    q_decay = jnp.exp(-sl * rng_b[None, None, :, None])            # (1,h,bs,1)
+    k_decay = jnp.exp(-sl * (bs - rng_b)[None, None, :, None])     # (1,h,bs,1)
+    diff = rng_b[:, None] - rng_b[None, :]
+    diag_decay = jnp.exp(jnp.where(diff >= 0, -sl * diff[None, None], -jnp.inf))
+    block_decay = jnp.exp(-slope * bs)[None, :, None, None]        # (1,h,1,1)
+
+    qb = q.reshape(b, n, nb, bs, d).transpose(2, 0, 1, 3, 4)
+    kb = k.reshape(b, n, nb, bs, d).transpose(2, 0, 1, 3, 4)
+    vb = v.reshape(b, n, nb, bs, d).transpose(2, 0, 1, 3, 4)
+
+    def body(s, xs):
+        qi, ki, vi = xs                                  # (B, h, bs, d)
+        qi32 = qi.astype(jnp.float32)
+        ki32 = ki.astype(jnp.float32)
+        vi32 = vi.astype(jnp.float32)
+        intra = jnp.einsum("bhsd,bhtd->bhst", qi32, ki32) * diag_decay
+        out = (jnp.einsum("bhst,bhtd->bhsd", intra, vi32)
+               + jnp.einsum("bhsd,bhde->bhse", qi32 * q_decay, s))
+        s = s * block_decay + jnp.einsum("bhsd,bhse->bhde", ki32 * k_decay, vi32)
+        return s, out
+
+    s0 = jnp.zeros((b, n, d, d), jnp.float32)
+    state, outs = jax.lax.scan(body, s0, (qb, kb, vb))
+    out = outs.transpose(1, 2, 0, 3, 4).reshape(b, n, nb * bs, d)[:, :, :t]
+
+    # undo the decay the padded tail applied to the state: padded rows added
+    # nothing (v=0) but the per-block e^{-λ·bs} factors still ran over them
+    pad_len = (t + pad - 1) - last_token_idx.astype(jnp.float32)   # (B,)
+    state = state * jnp.exp(slope[None, :, None, None]
+                            * pad_len[:, None, None, None])
+    return _finish_lightning(lp, hn, out), state
+
+
+def _lightning_decode(lp, hn, args, state, slope):
+    """One-token lightning step. hn (B, 1, H); state (B, h, d, d) fp32."""
+    b = hn.shape[0]
+    n, d = args.num_heads, args.head_dim
+    qkv = jax.nn.silu(hn @ lp["wqkv"]).reshape(b, 1, n, 3 * d)
+    q = qkv[:, 0, :, :d].astype(jnp.float32)             # (B, h, d)
+    k = qkv[:, 0, :, d : 2 * d].astype(jnp.float32)
+    v = qkv[:, 0, :, 2 * d :].astype(jnp.float32)
+    ratio = jnp.exp(-slope)[None, :, None, None]
+    state = ratio * state + jnp.einsum("bhd,bhe->bhde", k, v)
+    out = jnp.einsum("bhd,bhde->bhe", q, state)[:, :, None, :]     # (B,h,1,d)
+    out = out.transpose(0, 2, 1, 3).reshape(b, 1, n * d)
+    # _finish_lightning expects (B, h, T, d); rebuild that layout
+    return _finish_lightning(
+        lp, hn, out.reshape(b, 1, n, d).transpose(0, 2, 1, 3)), state
+
+
+def _finish_lightning(lp, hn, out_heads):
+    """(B, h, T, d) attention output -> norm, sigmoid gate, out projection."""
+    b, n, t, d = out_heads.shape
+    out = out_heads.transpose(0, 2, 1, 3).reshape(b, t, n * d).astype(hn.dtype)
+    out = rms_norm(out, lp["attn_norm"], 1e-6)
+    gate = jax.nn.sigmoid(hn @ lp["w_gate"])
+    return (gate * out) @ lp["out_proj"]
+
+
+def _full_attn(lp, hn, cos, sin, mask, k_cache, v_cache, positions, bucket, args):
+    b, t, _ = hn.shape
+    q = (hn @ lp["wq"]).reshape(b, t, args.num_heads, args.head_dim
+                                ).transpose(0, 2, 1, 3)
+    k = (hn @ lp["wk"]).reshape(b, t, args.num_kv_heads, args.head_dim
+                                ).transpose(0, 2, 1, 3)
+    v = (hn @ lp["wv"]).reshape(b, t, args.num_kv_heads, args.head_dim
+                                ).transpose(0, 2, 1, 3)
+    q, k = rope_ops.apply_rotary(q, k, cos, sin)
+    if positions is None:
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k.astype(k_cache.dtype), (0, 0, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v.astype(v_cache.dtype), (0, 0, 0, 0))
+        k_att, v_att = k, v
+    else:
+        def _one(row_c, row_n, p):
+            return jax.lax.dynamic_update_slice(
+                row_c, row_n.astype(row_c.dtype), (0, p, 0))
+
+        k_cache = jax.vmap(_one)(k_cache, k, positions)
+        v_cache = jax.vmap(_one)(v_cache, v, positions)
+        k_att = jax.lax.slice_in_dim(k_cache, 0, bucket, axis=2).astype(q.dtype)
+        v_att = jax.lax.slice_in_dim(v_cache, 0, bucket, axis=2).astype(q.dtype)
+    attn = attend(q, k_att, v_att, mask=mask)
+    attn = attn.transpose(0, 2, 1, 3).reshape(b, t, args.q_size)
+    return attn @ lp["wo"], k_cache, v_cache
+
+
+def _moe(lp, hn, args):
+    """Mixtral-style sparse MoE: softmax over all experts, top-k, renormalize."""
+    b, t, hdim = hn.shape
+    x = hn.reshape(b * t, hdim)
+    logits = x.astype(jnp.float32) @ lp["router"].astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    top_vals, top_idx = jax.lax.top_k(probs, args.experts_per_tok)
+    top_vals = top_vals / jnp.sum(top_vals, axis=-1, keepdims=True)
+    gates = jnp.einsum("nk,nke->ne", top_vals,
+                       jax.nn.one_hot(top_idx, args.num_experts,
+                                      dtype=jnp.float32))
+    inter = (jax.nn.silu(jnp.einsum("nh,ehi->eni", x, lp["moe_wg"]))
+             * jnp.einsum("nh,ehi->eni", x, lp["moe_wu"]))
+    per_expert = jnp.einsum("eni,eih->enh", inter, lp["moe_wd"])
+    out = jnp.einsum("enh,ne->nh", per_expert, gates.astype(per_expert.dtype))
+    return out.reshape(b, t, hdim).astype(hn.dtype)
+
+
+def _forward(params, args: MiniMaxArchArgs, h, cos, sin, mask, cache, positions,
+             bucket, last_token_idx):
+    ks, vs, lins = [], [], []
+    ai = li = 0
+    for idx, kind in enumerate(args.layer_kinds):
+        lp = params["layers"][idx]
+        # MiniMax norms the residual STREAM itself (the normed value carries)
+        h = rms_norm(h, lp["ln1"], args.rms_norm_eps)
+        resid = h
+        if kind == "full":
+            out, kc, vc = _full_attn(lp, h, cos, sin, mask, cache["k"][ai],
+                                     cache["v"][ai], positions, bucket, args)
+            ks.append(kc)
+            vs.append(vc)
+            ai += 1
+        else:
+            slope = jnp.asarray(_slope_rate(args.num_heads, idx,
+                                            args.num_layers))
+            if positions is None:
+                out, state = _lightning_prefill(lp, h, args, last_token_idx,
+                                                slope)
+            else:
+                out, state = _lightning_decode(lp, h, args,
+                                               cache["linear"][li], slope)
+            lins.append(state)
+            li += 1
+        h = resid * args.attn_alpha + out * args.attn_beta
+        h = rms_norm(h, lp["ln2"], args.rms_norm_eps)
+        resid = h
+        h = resid * args.mlp_alpha + _moe(lp, h, args) * args.mlp_beta
+    h = rms_norm(h, params["final_norm"], args.rms_norm_eps)
+    out_cache = {"k": jnp.stack(ks), "v": jnp.stack(vs),
+                 "linear": jnp.stack(lins)}
+    return h, out_cache
+
+
+def prefill_forward(params, args: MiniMaxArchArgs, input_ids, position_ids,
+                    last_token_idx, cache, mesh=None, rules=None, use_flash=False,
+                    adapter_ids=None, use_ring=False, return_hidden=False):
+    h = jnp.take(params["embed"], input_ids, axis=0)
+    t = input_ids.shape[1]
+    cos, sin = rope_ops.compute_cos_sin(params["rope_inv_freq"], position_ids)
+    mask = (position_ids[:, None, :, None] >= position_ids[:, None, None, :])
+    mask &= causal_mask(t, t)[None, None]
+    h, out_cache = _forward(params, args, h, cos, sin, mask, cache, None, None,
+                            last_token_idx)
+    h_last = jnp.take_along_axis(h, last_token_idx[:, None, None], axis=1)[:, 0]
+    logits = (h_last @ params["lm_head"]).astype(jnp.float32)
+    if return_hidden:
+        return logits, out_cache, h
+    return logits, out_cache
+
+
+def decode_forward(params, args: MiniMaxArchArgs, input_ids, position_ids, cache,
+                   decode_bucket, mesh=None, rules=None, adapter_ids=None,
+                   tree=None, return_hidden=False, **_ignored):
+    if input_ids.shape[1] != 1 or tree is not None:
+        raise ValueError("MiniMax decode is single-token only (one linear "
+                         "state per row)")
+    h = jnp.take(params["embed"], input_ids, axis=0)
+    pos_grid = position_ids[:, None]
+    cos, sin = rope_ops.compute_cos_sin(params["rope_inv_freq"], pos_grid)
+    kv_pos = jnp.arange(decode_bucket)[None, None, None, :]
+    mask = kv_pos <= pos_grid[:, None, :, None]
+    h, out_cache = _forward(params, args, h, cos, sin, mask, cache,
+                            position_ids, decode_bucket, None)
+    logits = (h @ params["lm_head"]).astype(jnp.float32)
+    if return_hidden:
+        return logits, out_cache, h
+    return logits, out_cache
+
+
+class MiniMaxInferenceConfig(InferenceConfig):
+    REQUIRED_ATTRIBUTES = ("hidden_size", "num_hidden_layers",
+                           "num_attention_heads", "num_key_value_heads",
+                           "vocab_size", "intermediate_size", "layer_types",
+                           "num_local_experts", "num_experts_per_tok")
+
+    def add_derived_config(self) -> None:
+        for attr, default in (("rope_theta", 1000000.0), ("rms_norm_eps", 1e-5),
+                              ("block_size", 256),
+                              ("full_attn_alpha_factor", 1.0),
+                              ("full_attn_beta_factor", 1.0),
+                              ("mlp_alpha_factor", 1.0),
+                              ("mlp_beta_factor", 1.0),
+                              ("tie_word_embeddings", False)):
+            if not hasattr(self, attr) or getattr(self, attr) is None:
+                setattr(self, attr, default)
+        if not hasattr(self, "head_dim") or self.head_dim is None:
+            self.head_dim = self.hidden_size // self.num_attention_heads
+
+
+class MiniMaxForCausalLM(TpuModelForCausalLM):
+    def __init__(self, model_path, config, mesh=None):
+        self._require_base_layout(config.tpu_config,
+                                  "MiniMax (lightning attention)")
+        super().__init__(model_path, config, mesh=mesh)
+
+    @classmethod
+    def get_config_cls(cls):
+        return MiniMaxInferenceConfig
+
+    @classmethod
+    def arch_args_from_config(cls, config) -> MiniMaxArchArgs:
+        return MiniMaxArchArgs(
+            vocab_size=config.vocab_size,
+            hidden_size=config.hidden_size,
+            num_layers=config.num_hidden_layers,
+            num_heads=config.num_attention_heads,
+            num_kv_heads=config.num_key_value_heads,
+            head_dim=config.head_dim,
+            intermediate_size=config.intermediate_size,
+            rms_norm_eps=config.rms_norm_eps,
+            tie_word_embeddings=bool(config.tie_word_embeddings),
+            layer_kinds=tuple("full" if t == "full_attention" else "linear"
+                              for t in config.layer_types),
+            block_size=int(config.block_size),
+            num_experts=int(config.num_local_experts),
+            experts_per_tok=int(config.num_experts_per_tok),
+            attn_alpha=float(config.full_attn_alpha_factor),
+            attn_beta=float(config.full_attn_beta_factor),
+            mlp_alpha=float(config.mlp_alpha_factor),
+            mlp_beta=float(config.mlp_beta_factor),
+        )
+
+    def prefill_fn(self):
+        return prefill_forward
+
+    def decode_fn(self):
+        return decode_forward
+
+    @classmethod
+    def inv_freq_from_config(cls, config) -> np.ndarray:
+        # rope applies only to the FULL attention layers' rotary half
+        rd = getattr(config, "rotary_dim", None) or config.head_dim
+        return rope_ops.default_inv_freq(rd, float(config.rope_theta))
+
+    def reset_cache(self, batch_size: Optional[int] = None) -> None:
+        a: MiniMaxArchArgs = self.arch_args
+        b = batch_size or self.tpu_config.max_batch_size
+        dt = self.tpu_config.jax_dtype
+        n_full = sum(1 for k in a.layer_kinds if k == "full")
+        n_lin = len(a.layer_kinds) - n_full
+        self.kv_cache = {
+            "k": jnp.zeros((max(n_full, 1), b, a.num_kv_heads,
+                            self.tpu_config.seq_len, a.head_dim), dt),
+            "v": jnp.zeros((max(n_full, 1), b, a.num_kv_heads,
+                            self.tpu_config.seq_len, a.head_dim), dt),
+            "linear": jnp.zeros((max(n_lin, 1), b, a.num_heads,
+                                 a.head_dim, a.head_dim), jnp.float32),
+        }
+
+    def _put_params(self, host_params) -> None:
+        dtype = self.tpu_config.jax_dtype
+
+        def _put(x):
+            arr = np.asarray(x)
+            if arr.dtype.kind == "f":
+                arr = arr.astype(dtype)
+            return jax.device_put(arr)
+
+        params = jax.tree.map(_put, host_params)
+        params["rope_inv_freq"] = jax.device_put(
+            np.asarray(host_params["rope_inv_freq"], np.float32))
+        self.params = params
+        self.reset_cache()
+
+    def init_random_params(self, key):
+        raise NotImplementedError("load from an HF checkpoint or state dict")
+
+    @classmethod
+    def convert_hf_state_dict(cls, state_dict: Dict[str, np.ndarray],
+                              config) -> Dict:
+        def get(name):
+            if name not in state_dict:
+                raise KeyError(f"missing weight {name}")
+            return np.asarray(state_dict[name])
+
+        def lin_t(name):
+            return np.ascontiguousarray(get(name).T)
+
+        E = config.num_local_experts
+        layers = []
+        for i in range(config.num_hidden_layers):
+            p = f"model.layers.{i}."
+            lp: Dict[str, np.ndarray] = {
+                "ln1": get(p + "input_layernorm.weight"),
+                "ln2": get(p + "post_attention_layernorm.weight"),
+            }
+            if config.layer_types[i] == "full_attention":
+                lp["wq"] = lin_t(p + "self_attn.q_proj.weight")
+                lp["wk"] = lin_t(p + "self_attn.k_proj.weight")
+                lp["wv"] = lin_t(p + "self_attn.v_proj.weight")
+                lp["wo"] = lin_t(p + "self_attn.o_proj.weight")
+            else:
+                lp["wqkv"] = lin_t(p + "self_attn.qkv_proj.weight")
+                lp["attn_norm"] = get(p + "self_attn.norm.weight")
+                lp["w_gate"] = lin_t(p + "self_attn.output_gate.weight")
+                lp["out_proj"] = lin_t(p + "self_attn.out_proj.weight")
+            m = p + "block_sparse_moe."
+            lp["router"] = lin_t(m + "gate.weight")
+            lp["moe_wg"] = np.stack(
+                [lin_t(m + f"experts.{e}.w1.weight") for e in range(E)])
+            lp["moe_wu"] = np.stack(
+                [lin_t(m + f"experts.{e}.w3.weight") for e in range(E)])
+            lp["moe_wd"] = np.stack(
+                [lin_t(m + f"experts.{e}.w2.weight") for e in range(E)])
+            layers.append(lp)
+        return {
+            "embed": get("model.embed_tokens.weight"),
+            "layers": layers,
+            "final_norm": get("model.norm.weight"),
+            "lm_head": lin_t("lm_head.weight"),
+            "rope_inv_freq": cls.inv_freq_from_config(config),
+        }

@@ -47,6 +47,7 @@ CONTRIB_MODELS = {
     "persimmon": "contrib.models.persimmon.src.modeling_persimmon:PersimmonForCausalLM",
     "xglm": "contrib.models.xglm.src.modeling_xglm:XGLMForCausalLM",
     "seed_oss": "contrib.models.seed_oss.src.modeling_seed_oss:SeedOssForCausalLM",
+    "minimax": "contrib.models.minimax.src.modeling_minimax:MiniMaxForCausalLM",
 }
 
 for model_type, path in CONTRIB_MODELS.items():

@@ -55,7 +55,7 @@ def test_registry_resolves_contrib_models():
                "cohere2", "smollm3", "granitemoe",
                "ernie4_5", "exaone4", "gptj", "gpt_neo", "codegen",
                "olmo", "olmoe", "mamba", "jamba", "persimmon", "xglm",
-               "seed_oss"):
+               "seed_oss", "minimax"):
         assert get_model_cls(mt) is not None
 
 
@@ -749,3 +749,24 @@ def test_seed_oss_parity():
     torch.manual_seed(0)
     hf = HFSeedOss(cfg).eval()
     _run_parity(SeedOssForCausalLM, hf, cfg)
+
+
+def test_minimax_parity():
+    """MiniMax lightning/linear-attention hybrid: decayed KV-state linear
+    attention (scan-over-blocks prefill, (B,h,d,d) fp32 state cache) alternating
+    with full softmax attention, MoE every layer, normed residual stream."""
+    from transformers import MiniMaxConfig, MiniMaxForCausalLM as HFMiniMax
+
+    from contrib.models.minimax.src.modeling_minimax import MiniMaxForCausalLM
+
+    cfg = MiniMaxConfig(vocab_size=256, hidden_size=64, intermediate_size=96,
+                        num_hidden_layers=4, num_attention_heads=4,
+                        num_key_value_heads=2, head_dim=16,
+                        num_local_experts=4, num_experts_per_tok=2,
+                        block_size=8,
+                        layer_types=["linear_attention", "full_attention",
+                                     "linear_attention", "full_attention"],
+                        pad_token_id=0, tie_word_embeddings=False)
+    torch.manual_seed(0)
+    hf = HFMiniMax(cfg).eval()
+    _run_parity(MiniMaxForCausalLM, hf, cfg, atol=2e-3, rtol=1e-3)
