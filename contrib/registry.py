@@ -48,6 +48,7 @@ CONTRIB_MODELS = {
     "xglm": "contrib.models.xglm.src.modeling_xglm:XGLMForCausalLM",
     "seed_oss": "contrib.models.seed_oss.src.modeling_seed_oss:SeedOssForCausalLM",
     "minimax": "contrib.models.minimax.src.modeling_minimax:MiniMaxForCausalLM",
+    "apertus": "contrib.models.apertus.src.modeling_apertus:ApertusForCausalLM",
 }
 
 for model_type, path in CONTRIB_MODELS.items():

@@ -132,6 +132,8 @@ def param_logical_axes(args: ModelArchArgs) -> Params:
     }
     if args.norm_bias:
         layer.update({"ln1_b": ("layers", None), "ln2_b": ("layers", None)})
+    if args.activation == "xielu":
+        layer.update({"xielu_ap": ("layers", None), "xielu_an": ("layers", None)})
     if args.moe is not None:
         layer.update({
             "router": ("layers", "embed", None),
@@ -289,6 +291,15 @@ def init_params(args: ModelArchArgs, key: jax.Array, dtype=jnp.bfloat16,
 
         layers.update({k: jnp.asarray(v, dtype=dtype)
                        for k, v in init_lora_params(args, args.lora).items()})
+    if args.activation == "xielu":
+        import math as _math
+
+        layers.update({
+            "xielu_ap": jnp.full((L, 1), _math.log(_math.expm1(0.8)),
+                                 dtype=jnp.float32),
+            "xielu_an": jnp.full((L, 1), _math.log(_math.expm1(0.3)),
+                                 dtype=jnp.float32),
+        })
     norm_fill = 0.0 if args.zero_centered_norms else 1.0
     if args.qk_norm:
         qn = args.q_size if args.qk_norm_scope == "full" else args.head_dim
@@ -348,6 +359,17 @@ _ACTIVATIONS = {
     "relu": jax.nn.relu,
     "relu2": lambda x: jnp.square(jax.nn.relu(x)),   # nemotron squared ReLU
 }
+
+
+def _xielu(x, alpha_p, alpha_n, beta=0.5, eps=-1e-6):
+    """xIELU activation with LEARNED per-layer alpha parameters (apertus;
+    arXiv:2411.13010): quadratic-positive / shifted-expm1-negative branches."""
+    x32 = x.astype(jnp.float32)
+    ap = jax.nn.softplus(alpha_p.astype(jnp.float32))
+    an = beta + jax.nn.softplus(alpha_n.astype(jnp.float32))
+    out = jnp.where(x32 > 0, ap * x32 * x32 + beta * x32,
+                    (jnp.expm1(jnp.minimum(x32, eps)) - x32) * an + beta * x32)
+    return out.astype(x.dtype)
 
 
 def _norm(x: jnp.ndarray, weight: jnp.ndarray, args: "ModelArchArgs",
@@ -454,13 +476,18 @@ def _project_qkv(lp: Params, args: ModelArchArgs, hn: jnp.ndarray,
 
 def _mlp(lp: Params, args: ModelArchArgs, hn: jnp.ndarray, mesh, rules,
          adapter_ids=None) -> jnp.ndarray:
-    act = _ACTIVATIONS[args.activation]
+    act = (_ACTIVATIONS[args.activation] if args.activation != "xielu"
+           else None)
     if args.mlp_kind == "plain":
         # fc -> act -> fc (GPT-style, optionally biased)
         inter = qapply(hn, lp["wg"])
         if args.mlp_bias:
             inter = inter + lp["bg"]
-        inter = constrain(act(inter), ("batch", None, "mlp"), rules, mesh=mesh)
+        if args.activation == "xielu":
+            inter = _xielu(inter, lp["xielu_ap"][None], lp["xielu_an"][None])
+        else:
+            inter = act(inter)
+        inter = constrain(inter, ("batch", None, "mlp"), rules, mesh=mesh)
         down = qapply(inter, lp["wd"])
         if args.mlp_bias:
             down = down + lp["bd"]

@@ -55,7 +55,7 @@ def test_registry_resolves_contrib_models():
                "cohere2", "smollm3", "granitemoe",
                "ernie4_5", "exaone4", "gptj", "gpt_neo", "codegen",
                "olmo", "olmoe", "mamba", "jamba", "persimmon", "xglm",
-               "seed_oss", "minimax"):
+               "seed_oss", "minimax", "apertus"):
         assert get_model_cls(mt) is not None
 
 
@@ -770,3 +770,20 @@ def test_minimax_parity():
     torch.manual_seed(0)
     hf = HFMiniMax(cfg).eval()
     _run_parity(MiniMaxForCausalLM, hf, cfg, atol=2e-3, rtol=1e-3)
+
+
+def test_apertus_parity():
+    """Apertus: learned-parameter xIELU activation (per-layer alpha_p/alpha_n)
+    + per-head qk-norm — the hub's first learned activation."""
+    from transformers import ApertusConfig, ApertusForCausalLM as HFApertus
+
+    from contrib.models.apertus.src.modeling_apertus import ApertusForCausalLM
+
+    cfg = ApertusConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
+                        num_hidden_layers=2, num_attention_heads=4,
+                        num_key_value_heads=2, hidden_act="xielu",
+                        pad_token_id=0, tie_word_embeddings=False)
+    torch.manual_seed(0)
+    # the xIELU module keeps its alpha params in bf16; float() them for numpy
+    hf = HFApertus(cfg).eval().float()
+    _run_parity(ApertusForCausalLM, hf, cfg, atol=1e-3, rtol=1e-3)
