@@ -1,0 +1,432 @@
+"""Falcon-H1 (TII mamba2/attention PARALLEL hybrid) on the TPU framework
+(contrib port).
+
+≈ reference `contrib/models/Falcon-H1-0.5B-Instruct/`. Every layer runs a
+Mamba-2-style SSD mixer AND a rope GQA attention head-to-head on the SAME
+normed input, sums the two branch outputs (each with its own multiplier), then
+a gated MLP — plus Falcon-H1's muP-style multiplier family (embedding, ssm-in,
+per-chunk zxbcdt mup vector, attention-in/out, key, mlp gate/down, lm-head).
+The SSD prefill rides the same associative-scan redesign as
+contrib/models/mamba2; the hybrid cache pytree carries per-layer conv tails +
+fp32 SSM states next to the attention KV stacks.
+"""
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neuronx_distributed_inference_tpu.config import InferenceConfig
+from neuronx_distributed_inference_tpu.models.base import (
+    ModelArchArgs, causal_mask)
+from neuronx_distributed_inference_tpu.ops import rope as rope_ops
+from neuronx_distributed_inference_tpu.ops.attention import attend
+from neuronx_distributed_inference_tpu.ops.norms import rms_norm
+from neuronx_distributed_inference_tpu.runtime.application import (
+    TpuModelForCausalLM)
+
+
+@dataclass(frozen=True)
+class FalconH1ArchArgs(ModelArchArgs):
+    d_ssm: int = 0
+    d_state: int = 256
+    d_conv: int = 4
+    ssd_heads: int = 128
+    ssd_head_dim: int = 8
+    n_groups: int = 1
+    ssm_in_mult: float = 1.0
+    ssm_out_mult: float = 1.0
+    ssm_mults: Tuple[float, ...] = (1.0, 1.0, 1.0, 1.0, 1.0)
+    attn_in_mult: float = 1.0
+    attn_out_mult: float = 1.0
+    key_mult: float = 1.0
+    mlp_gate_mult: float = 1.0
+    mlp_down_mult: float = 1.0
+    lm_head_mult: float = 1.0
+    mamba_rms_norm: bool = False
+    norm_before_gate: bool = True
+
+    @property
+    def conv_dim(self) -> int:
+        return self.d_ssm + 2 * self.n_groups * self.d_state
+
+
+def _mup_vector(args: FalconH1ArchArgs) -> np.ndarray:
+    """Per-chunk zxbcdt multipliers over the in_proj output."""
+    gts = args.n_groups * args.d_state
+    v = np.ones((2 * args.d_ssm + 2 * gts + args.ssd_heads,), np.float32)
+    m = args.ssm_mults
+    v[: args.d_ssm] *= m[0]
+    v[args.d_ssm : 2 * args.d_ssm] *= m[1]
+    v[2 * args.d_ssm : 2 * args.d_ssm + gts] *= m[2]
+    v[2 * args.d_ssm + gts : 2 * args.d_ssm + 2 * gts] *= m[3]
+    v[2 * args.d_ssm + 2 * gts :] *= m[4]
+    return v
+
+
+def _expand_groups(x, n_heads, n_groups):
+    b, t, _ = x.shape
+    x = x.reshape(b, t, n_groups, -1)
+    return jnp.repeat(x, n_heads // n_groups, axis=2)
+
+
+def _ssm_terms(lp, xc, dt_raw, args):
+    bsz, t, _ = xc.shape
+    nh, hd, s = args.ssd_heads, args.ssd_head_dim, args.d_state
+    x = xc[..., : args.d_ssm].reshape(bsz, t, nh, hd)
+    b_mat = _expand_groups(xc[..., args.d_ssm : args.d_ssm + args.n_groups * s],
+                           nh, args.n_groups).astype(jnp.float32)
+    c_mat = _expand_groups(xc[..., args.d_ssm + args.n_groups * s :],
+                           nh, args.n_groups).astype(jnp.float32)
+    dt = jax.nn.softplus(dt_raw.astype(jnp.float32)
+                         + lp["dt_bias"].astype(jnp.float32))
+    a_h = -jnp.exp(lp["a_log"].astype(jnp.float32))
+    a = jnp.exp(dt * a_h[None, None, :])[..., None, None]
+    b_term = (dt[..., None, None] * b_mat[:, :, :, None, :]
+              * x.astype(jnp.float32)[..., None])
+    return a, b_term, c_mat, x
+
+
+def _apply_gate(lp, y, z, args):
+    """silu(z) output gate; when ``mamba_rms_norm`` also a grouped RMSNorm,
+    applied before or after the gate per ``mamba_norm_before_gate``."""
+    z32 = jax.nn.silu(z.astype(jnp.float32))
+    if not args.mamba_rms_norm:
+        return y * z32
+    if not args.norm_before_gate:
+        y = y * z32
+    b, t, dim = y.shape
+    g = args.n_groups
+    yg = y.reshape(b, t, g, dim // g)
+    var = jnp.mean(jnp.square(yg), axis=-1, keepdims=True)
+    yg = yg * jax.lax.rsqrt(var + args.rms_norm_eps)
+    yg = lp["gate_norm"].astype(jnp.float32).reshape(g, dim // g) * yg
+    y = yg.reshape(b, t, dim)
+    if args.norm_before_gate:
+        y = y * z32
+    return y
+
+
+def _mixer(lp, hn, args, last_token_idx, conv_state, ssm_state):
+    """Falcon-H1 SSD mixer: prefill (last_token_idx given, associative scan) or
+    one-token decode."""
+    w = args.d_conv
+    x_in = hn * args.ssm_in_mult
+    proj = (x_in @ lp["in_proj"]) * lp["mup"][None, None, :]
+    z = proj[..., : args.d_ssm]
+    xbc = proj[..., args.d_ssm : args.d_ssm + args.conv_dim]
+    dt_raw = proj[..., args.d_ssm + args.conv_dim :]
+
+    if last_token_idx is not None:
+        t = xbc.shape[1]
+        idx = last_token_idx[:, None] + 1 - w + jnp.arange(w)[None, :]
+        gathered = jnp.take_along_axis(xbc, jnp.clip(idx, 0, t - 1)[:, :, None],
+                                       axis=1)
+        conv_state = jnp.where((idx >= 0)[:, :, None], gathered, 0.0)
+        xp = jnp.pad(xbc, ((0, 0), (w - 1, 0), (0, 0)))
+        xc = sum(xp[:, j : j + t, :] * lp["conv_w"][j][None, None, :]
+                 for j in range(w)) + lp["conv_b"][None, None, :]
+        xc = jax.nn.silu(xc)
+        a, b_term, c_mat, x = _ssm_terms(lp, xc, dt_raw, args)
+        valid = (jnp.arange(t)[None, :]
+                 <= last_token_idx[:, None])[..., None, None, None]
+        a = jnp.where(valid, a, 1.0)
+        b_term = jnp.where(valid, b_term, 0.0)
+
+        def comb(l, r):
+            return (r[0] * l[0], r[0] * l[1] + r[1])
+
+        _, h_seq = jax.lax.associative_scan(comb, (a, b_term), axis=1)
+        ssm_state = jnp.take_along_axis(
+            h_seq, last_token_idx[:, None, None, None, None], axis=1)[:, 0]
+        y = jnp.einsum("bthds,bths->bthd", h_seq, c_mat)
+        y = y + x.astype(jnp.float32) * lp["d_skip"].astype(
+            jnp.float32)[None, None, :, None]
+        y = y.reshape(hn.shape[0], t, args.d_ssm)
+    else:
+        xbc0 = xbc[:, 0]
+        conv_state = jnp.concatenate([conv_state[:, 1:], xbc0[:, None, :]],
+                                     axis=1)
+        xc = jnp.sum(conv_state * lp["conv_w"][None, :, :], axis=1) + lp["conv_b"]
+        xc = jax.nn.silu(xc)[:, None, :]
+        a, b_term, c_mat, x = _ssm_terms(lp, xc, dt_raw, args)
+        ssm_state = a[:, 0] * ssm_state + b_term[:, 0]
+        y = jnp.einsum("bhds,bhs->bhd", ssm_state, c_mat[:, 0])
+        y = y + x[:, 0].astype(jnp.float32) * lp["d_skip"].astype(
+            jnp.float32)[None, :, None]
+        y = y.reshape(hn.shape[0], 1, args.d_ssm)
+
+    y = _apply_gate(lp, y, z, args).astype(hn.dtype)
+    return y @ lp["out_proj"], conv_state.astype(hn.dtype), ssm_state
+
+
+def _attn(lp, hn, cos, sin, mask, k_cache, v_cache, positions, bucket, args):
+    b, t, _ = hn.shape
+    q = (hn @ lp["wq"]).reshape(b, t, args.num_heads, args.head_dim
+                                ).transpose(0, 2, 1, 3)
+    k = (hn @ lp["wk"]).reshape(b, t, args.num_kv_heads, args.head_dim
+                                ).transpose(0, 2, 1, 3) * args.key_mult
+    v = (hn @ lp["wv"]).reshape(b, t, args.num_kv_heads, args.head_dim
+                                ).transpose(0, 2, 1, 3)
+    q, k = rope_ops.apply_rotary(q, k, cos, sin)
+    if positions is None:
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k.astype(k_cache.dtype), (0, 0, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v.astype(v_cache.dtype), (0, 0, 0, 0))
+        k_att, v_att = k, v
+    else:
+        def _one(row_c, row_n, p):
+            return jax.lax.dynamic_update_slice(
+                row_c, row_n.astype(row_c.dtype), (0, p, 0))
+
+        k_cache = jax.vmap(_one)(k_cache, k, positions)
+        v_cache = jax.vmap(_one)(v_cache, v, positions)
+        k_att = jax.lax.slice_in_dim(k_cache, 0, bucket, axis=2).astype(q.dtype)
+        v_att = jax.lax.slice_in_dim(v_cache, 0, bucket, axis=2).astype(q.dtype)
+    attn = attend(q, k_att, v_att, mask=mask)
+    attn = attn.transpose(0, 2, 1, 3).reshape(b, t, args.q_size)
+    return attn @ lp["wo"], k_cache, v_cache
+
+
+def _mlp(lp, hn, args):
+    y = (hn @ lp["wu"]) * jax.nn.silu((hn @ lp["wg"]) * args.mlp_gate_mult)
+    return (y @ lp["wd"]) * args.mlp_down_mult
+
+
+def _forward(params, args: FalconH1ArchArgs, h, cos, sin, mask, cache,
+             positions, bucket, last_token_idx):
+    ks, vs, convs, ssms = [], [], [], []
+    for li in range(args.num_layers):
+        lp = jax.tree.map(lambda p: p[li] if isinstance(p, jnp.ndarray) else p,
+                          params["layers"])
+        resid = h
+        hn = rms_norm(h, lp["ln1"], args.rms_norm_eps)
+        m_out, conv_state, ssm_state = _mixer(
+            lp, hn, args, last_token_idx,
+            cache["conv"][li] if positions is not None else None,
+            cache["ssm"][li] if positions is not None else None)
+        a_out, kc, vc = _attn(lp, hn * args.attn_in_mult, cos, sin, mask,
+                              cache["k"][li], cache["v"][li], positions,
+                              bucket, args)
+        convs.append(conv_state)
+        ssms.append(ssm_state)
+        ks.append(kc)
+        vs.append(vc)
+        h = resid + m_out * args.ssm_out_mult + a_out * args.attn_out_mult
+        resid = h
+        hn = rms_norm(h, lp["ln2"], args.rms_norm_eps)
+        h = resid + _mlp(lp, hn, args)
+    h = rms_norm(h, params["final_norm"], args.rms_norm_eps)
+    out_cache = {"k": jnp.stack(ks), "v": jnp.stack(vs),
+                 "conv": jnp.stack(convs), "ssm": jnp.stack(ssms)}
+    return h, out_cache
+
+
+def prefill_forward(params, args: FalconH1ArchArgs, input_ids, position_ids,
+                    last_token_idx, cache, mesh=None, rules=None, use_flash=False,
+                    adapter_ids=None, use_ring=False, return_hidden=False):
+    h = jnp.take(params["embed"], input_ids, axis=0)
+    h = h * jnp.asarray(args.embedding_multiplier, h.dtype)
+    t = input_ids.shape[1]
+    cos, sin = rope_ops.compute_cos_sin(params["rope_inv_freq"], position_ids)
+    mask = (position_ids[:, None, :, None] >= position_ids[:, None, None, :])
+    mask &= causal_mask(t, t)[None, None]
+    h, out_cache = _forward(params, args, h, cos, sin, mask, cache, None, None,
+                            last_token_idx)
+    h_last = jnp.take_along_axis(h, last_token_idx[:, None, None], axis=1)[:, 0]
+    logits = (h_last @ params["lm_head"]).astype(jnp.float32) * args.lm_head_mult
+    if return_hidden:
+        return logits, out_cache, h
+    return logits, out_cache
+
+
+def decode_forward(params, args: FalconH1ArchArgs, input_ids, position_ids,
+                   cache, decode_bucket, mesh=None, rules=None, adapter_ids=None,
+                   tree=None, return_hidden=False, **_ignored):
+    if input_ids.shape[1] != 1 or tree is not None:
+        raise ValueError("Falcon-H1 decode is single-token only")
+    h = jnp.take(params["embed"], input_ids, axis=0)
+    h = h * jnp.asarray(args.embedding_multiplier, h.dtype)
+    pos_grid = position_ids[:, None]
+    cos, sin = rope_ops.compute_cos_sin(params["rope_inv_freq"], pos_grid)
+    kv_pos = jnp.arange(decode_bucket)[None, None, None, :]
+    mask = kv_pos <= pos_grid[:, None, :, None]
+    h, out_cache = _forward(params, args, h, cos, sin, mask, cache,
+                            position_ids, decode_bucket, None)
+    logits = (h @ params["lm_head"]).astype(jnp.float32) * args.lm_head_mult
+    if return_hidden:
+        return logits, out_cache, h
+    return logits, out_cache
+
+
+class FalconH1InferenceConfig(InferenceConfig):
+    REQUIRED_ATTRIBUTES = ("hidden_size", "num_hidden_layers",
+                           "num_attention_heads", "num_key_value_heads",
+                           "vocab_size", "intermediate_size",
+                           "mamba_n_heads", "mamba_d_state")
+
+    def add_derived_config(self) -> None:
+        for attr, default in (("rope_theta", 100000.0), ("rms_norm_eps", 1e-5),
+                              ("mamba_d_conv", 4), ("mamba_expand", 2),
+                              ("mamba_n_groups", 1), ("mamba_d_ssm", None),
+                              ("embedding_multiplier", 1.0),
+                              ("ssm_in_multiplier", 1.0),
+                              ("ssm_out_multiplier", 1.0),
+                              ("ssm_multipliers", [1.0] * 5),
+                              ("attention_in_multiplier", 1.0),
+                              ("attention_out_multiplier", 1.0),
+                              ("key_multiplier", 1.0),
+                              ("mlp_multipliers", [1.0, 1.0]),
+                              ("lm_head_multiplier", 1.0),
+                              ("mamba_rms_norm", False),
+                              ("mamba_norm_before_gate", True),
+                              ("tie_word_embeddings", False)):
+            if not hasattr(self, attr) or getattr(self, attr) is None:
+                if default is not None or not hasattr(self, attr):
+                    setattr(self, attr, default)
+        if not hasattr(self, "head_dim") or self.head_dim is None:
+            self.head_dim = self.hidden_size // self.num_attention_heads
+        if getattr(self, "mamba_d_ssm", None) is None:
+            self.mamba_d_ssm = int(self.mamba_expand * self.hidden_size)
+        for flag in ("attention_bias", "mamba_proj_bias", "projectors_bias",
+                     "mlp_bias"):
+            if getattr(self, flag, False):
+                raise ValueError(f"Falcon-H1 {flag}=True is not ported: "
+                                 "projections here are bias-free (the released "
+                                 "Falcon-H1 checkpoints ship without biases)")
+
+
+class FalconH1ForCausalLM(TpuModelForCausalLM):
+    def __init__(self, model_path, config, mesh=None):
+        self._require_base_layout(config.tpu_config,
+                                  "Falcon-H1 (parallel SSM/attention)")
+        super().__init__(model_path, config, mesh=mesh)
+
+    @classmethod
+    def get_config_cls(cls):
+        return FalconH1InferenceConfig
+
+    @classmethod
+    def arch_args_from_config(cls, config) -> FalconH1ArchArgs:
+        return FalconH1ArchArgs(
+            vocab_size=config.vocab_size,
+            hidden_size=config.hidden_size,
+            num_layers=config.num_hidden_layers,
+            num_heads=config.num_attention_heads,
+            num_kv_heads=config.num_key_value_heads,
+            head_dim=config.head_dim,
+            intermediate_size=config.intermediate_size,
+            rms_norm_eps=config.rms_norm_eps,
+            embedding_multiplier=float(config.embedding_multiplier),
+            tie_word_embeddings=bool(config.tie_word_embeddings),
+            d_ssm=int(config.mamba_d_ssm),
+            d_state=int(config.mamba_d_state),
+            d_conv=int(config.mamba_d_conv),
+            ssd_heads=int(config.mamba_n_heads),
+            ssd_head_dim=int(config.mamba_d_ssm // config.mamba_n_heads),
+            n_groups=int(config.mamba_n_groups),
+            ssm_in_mult=float(config.ssm_in_multiplier),
+            ssm_out_mult=float(config.ssm_out_multiplier),
+            ssm_mults=tuple(float(x) for x in config.ssm_multipliers),
+            attn_in_mult=float(config.attention_in_multiplier),
+            attn_out_mult=float(config.attention_out_multiplier),
+            key_mult=float(config.key_multiplier),
+            mlp_gate_mult=float(config.mlp_multipliers[0]),
+            mlp_down_mult=float(config.mlp_multipliers[1]),
+            lm_head_mult=float(config.lm_head_multiplier),
+            mamba_rms_norm=bool(config.mamba_rms_norm),
+            norm_before_gate=bool(config.mamba_norm_before_gate),
+        )
+
+    def prefill_fn(self):
+        return prefill_forward
+
+    def decode_fn(self):
+        return decode_forward
+
+    @classmethod
+    def inv_freq_from_config(cls, config) -> np.ndarray:
+        return rope_ops.default_inv_freq(config.head_dim, float(config.rope_theta))
+
+    def reset_cache(self, batch_size: Optional[int] = None) -> None:
+        a: FalconH1ArchArgs = self.arch_args
+        b = batch_size or self.tpu_config.max_batch_size
+        dt = self.tpu_config.jax_dtype
+        self.kv_cache = {
+            "k": jnp.zeros((a.num_layers, b, a.num_kv_heads,
+                            self.tpu_config.seq_len, a.head_dim), dt),
+            "v": jnp.zeros((a.num_layers, b, a.num_kv_heads,
+                            self.tpu_config.seq_len, a.head_dim), dt),
+            "conv": jnp.zeros((a.num_layers, b, a.d_conv, a.conv_dim), dt),
+            "ssm": jnp.zeros((a.num_layers, b, a.ssd_heads, a.ssd_head_dim,
+                              a.d_state), jnp.float32),
+        }
+
+    def _put_params(self, host_params) -> None:
+        dtype = self.tpu_config.jax_dtype
+        fp32_keys = {"a_log", "d_skip", "dt_bias", "mup"}
+
+        def _put(path, x):
+            arr = np.asarray(x)
+            last = getattr(path[-1], "key", None) if path else None
+            if arr.dtype.kind == "f":
+                arr = arr.astype(np.float32 if last in fp32_keys else dtype)
+            return jax.device_put(arr)
+
+        self.params = jax.tree_util.tree_map_with_path(_put, host_params)
+        self.reset_cache()
+
+    def init_random_params(self, key):
+        raise NotImplementedError("load from an HF checkpoint or state dict")
+
+    @classmethod
+    def convert_hf_state_dict(cls, state_dict: Dict[str, np.ndarray],
+                              config) -> Dict:
+        def get(name):
+            if name not in state_dict:
+                raise KeyError(f"missing weight {name}")
+            return np.asarray(state_dict[name])
+
+        def lin_t(name):
+            return np.ascontiguousarray(get(name).T)
+
+        args = cls.arch_args_from_config(config)
+        layers: Dict[str, list] = {k: [] for k in
+                                   ("ln1", "ln2", "wq", "wk", "wv", "wo",
+                                    "in_proj", "conv_w", "conv_b", "dt_bias",
+                                    "a_log", "d_skip", "gate_norm", "out_proj",
+                                    "mup", "wg", "wu", "wd")}
+        mup = _mup_vector(args)
+        for i in range(config.num_hidden_layers):
+            p = f"model.layers.{i}."
+            mx = p + "mamba."
+            layers["ln1"].append(get(p + "input_layernorm.weight"))
+            layers["ln2"].append(get(p + "pre_ff_layernorm.weight"))
+            layers["wq"].append(lin_t(p + "self_attn.q_proj.weight"))
+            layers["wk"].append(lin_t(p + "self_attn.k_proj.weight"))
+            layers["wv"].append(lin_t(p + "self_attn.v_proj.weight"))
+            layers["wo"].append(lin_t(p + "self_attn.o_proj.weight"))
+            layers["in_proj"].append(lin_t(mx + "in_proj.weight"))
+            layers["conv_w"].append(np.ascontiguousarray(
+                get(mx + "conv1d.weight")[:, 0, :].T))
+            layers["conv_b"].append(get(mx + "conv1d.bias"))
+            layers["dt_bias"].append(get(mx + "dt_bias"))
+            layers["a_log"].append(get(mx + "A_log"))
+            layers["d_skip"].append(get(mx + "D"))
+            if getattr(config, "mamba_rms_norm", False):
+                layers["gate_norm"].append(get(mx + "norm.weight"))
+            layers["out_proj"].append(lin_t(mx + "out_proj.weight"))
+            layers["mup"].append(mup)
+            layers["wg"].append(lin_t(p + "feed_forward.gate_proj.weight"))
+            layers["wu"].append(lin_t(p + "feed_forward.up_proj.weight"))
+            layers["wd"].append(lin_t(p + "feed_forward.down_proj.weight"))
+        return {
+            "embed": get("model.embed_tokens.weight"),
+            "layers": {k: np.stack(v) for k, v in layers.items() if v},
+            "final_norm": get("model.final_layernorm.weight"),
+            "lm_head": lin_t("lm_head.weight"),
+            "rope_inv_freq": cls.inv_freq_from_config(config),
+        }

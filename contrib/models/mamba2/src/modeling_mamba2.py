@@ -1,0 +1,312 @@
+"""Mamba-2 (SSD / state-space duality) on the TPU framework (contrib port).
+
+The multi-head successor of mamba1: per-head SCALAR decay a_t = e^{Δ_t A_h}
+over a (B, heads, head_dim, state) fp32 SSM state, grouped B/C projections,
+joint x|B|C causal conv, per-head Δ with softplus + clamp, and a GATED output
+RMSNorm (norm(y · silu(z))). TPU redesign mirrors contrib/models/mamba:
+associative-scan prefill over the diagonal recurrence (the scalar per-head
+decay broadcasts over (head_dim, state)), right padding frozen at each row's
+true length, fused single-step decode. Math follows HF
+`Mamba2Mixer.torch_forward`.
+"""
+
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neuronx_distributed_inference_tpu.config import InferenceConfig
+from neuronx_distributed_inference_tpu.models.base import ModelArchArgs
+from neuronx_distributed_inference_tpu.ops.norms import rms_norm
+from neuronx_distributed_inference_tpu.runtime.application import (
+    TpuModelForCausalLM)
+
+
+@dataclass(frozen=True)
+class Mamba2ArchArgs(ModelArchArgs):
+    d_inner: int = 0
+    d_state: int = 128
+    d_conv: int = 4
+    ssd_heads: int = 128
+    ssd_head_dim: int = 64
+    n_groups: int = 8
+    dt_min: float = 0.0
+    dt_max: float = float("inf")
+
+    @property
+    def conv_dim(self) -> int:
+        return self.d_inner + 2 * self.n_groups * self.d_state
+
+
+def _expand_groups(x, n_heads, n_groups):
+    """(B, T, groups*state) -> (B, T, heads, state) (group-to-head repeat)."""
+    b, t, _ = x.shape
+    x = x.reshape(b, t, n_groups, -1)
+    return jnp.repeat(x, n_heads // n_groups, axis=2)
+
+
+def _ssm_terms(lp, xc, dt_raw, args):
+    """Post-conv split + discretization: returns (a, b_term, c, x_heads), with
+    a (B, T, nh, 1, 1) fp32 scalar decays and b_term = Δ·(B ⊗ x) (B, T, nh, hd, s)."""
+    bsz, t, _ = xc.shape
+    nh, hd, s = args.ssd_heads, args.ssd_head_dim, args.d_state
+    x = xc[..., : args.d_inner].reshape(bsz, t, nh, hd)
+    b_mat = _expand_groups(
+        xc[..., args.d_inner : args.d_inner + args.n_groups * s],
+        nh, args.n_groups).astype(jnp.float32)               # (B, T, nh, s)
+    c_mat = _expand_groups(
+        xc[..., args.d_inner + args.n_groups * s :],
+        nh, args.n_groups).astype(jnp.float32)
+    dt = jax.nn.softplus(dt_raw.astype(jnp.float32)
+                         + lp["dt_bias"].astype(jnp.float32))       # (B, T, nh)
+    dt = jnp.clip(dt, args.dt_min, args.dt_max)
+    a_h = -jnp.exp(lp["a_log"].astype(jnp.float32))          # (nh,)
+    a = jnp.exp(dt * a_h[None, None, :])[..., None, None]    # (B, T, nh, 1, 1)
+    b_term = (dt[..., None, None] * b_mat[:, :, :, None, :]
+              * x.astype(jnp.float32)[..., None])            # (B, T, nh, hd, s)
+    return a, b_term, c_mat, x
+
+
+def _conv_prefill(lp, xbc, last_token_idx, args):
+    """Joint causal conv over x|B|C; returns (activated (B,T,conv_dim), tail)."""
+    w = args.d_conv
+    t = xbc.shape[1]
+    idx = last_token_idx[:, None] + 1 - w + jnp.arange(w)[None, :]
+    gathered = jnp.take_along_axis(xbc, jnp.clip(idx, 0, t - 1)[:, :, None],
+                                   axis=1)
+    conv_state = jnp.where((idx >= 0)[:, :, None], gathered, 0.0)
+    xp = jnp.pad(xbc, ((0, 0), (w - 1, 0), (0, 0)))
+    xc = sum(xp[:, j : j + t, :] * lp["conv_w"][j][None, None, :]
+             for j in range(w)) + lp["conv_b"][None, None, :]
+    return jax.nn.silu(xc), conv_state
+
+
+def _mixer_prefill(lp, hn, last_token_idx, args):
+    t = hn.shape[1]
+    proj = hn @ lp["in_proj"]
+    z = proj[..., : args.d_inner]
+    xbc = proj[..., args.d_inner : args.d_inner + args.conv_dim]
+    dt_raw = proj[..., args.d_inner + args.conv_dim :]       # (B, T, nh)
+
+    xc, conv_state = _conv_prefill(lp, xbc, last_token_idx, args)
+    a, b_term, c_mat, x = _ssm_terms(lp, xc, dt_raw, args)
+
+    valid = (jnp.arange(t)[None, :] <= last_token_idx[:, None])[..., None, None,
+                                                                None]
+    a = jnp.where(valid, a, 1.0)
+    b_term = jnp.where(valid, b_term, 0.0)
+
+    def comb(l, r):
+        return (r[0] * l[0], r[0] * l[1] + r[1])
+
+    _, h_seq = jax.lax.associative_scan(comb, (a, b_term), axis=1)
+    ssm_state = jnp.take_along_axis(
+        h_seq, last_token_idx[:, None, None, None, None], axis=1)[:, 0]
+
+    y = jnp.einsum("bthds,bths->bthd", h_seq, c_mat)         # fp32
+    y = y + x.astype(jnp.float32) * lp["d_skip"].astype(jnp.float32)[None, None,
+                                                                     :, None]
+    y = y.reshape(hn.shape[0], t, args.d_inner)
+    y = _gated_norm(lp, y, z, args)
+    return y @ lp["out_proj"], conv_state.astype(hn.dtype), ssm_state
+
+
+def _mixer_decode(lp, hn, conv_state, ssm_state, args):
+    b = hn.shape[0]
+    proj = hn @ lp["in_proj"]
+    z = proj[..., : args.d_inner]
+    xbc = proj[..., args.d_inner : args.d_inner + args.conv_dim][:, 0]
+    dt_raw = proj[..., args.d_inner + args.conv_dim :]
+
+    state = jnp.concatenate([conv_state[:, 1:], xbc[:, None, :]], axis=1)
+    xc = jnp.sum(state * lp["conv_w"][None, :, :], axis=1) + lp["conv_b"]
+    xc = jax.nn.silu(xc)[:, None, :]
+
+    a, b_term, c_mat, x = _ssm_terms(lp, xc, dt_raw, args)
+    h = a[:, 0] * ssm_state + b_term[:, 0]                   # (B, nh, hd, s)
+    y = jnp.einsum("bhds,bhs->bhd", h, c_mat[:, 0])
+    y = y + x[:, 0].astype(jnp.float32) * lp["d_skip"].astype(
+        jnp.float32)[None, :, None]
+    y = y.reshape(b, 1, args.d_inner)
+    y = _gated_norm(lp, y, z, args)
+    return y @ lp["out_proj"], state.astype(conv_state.dtype), h
+
+
+def _gated_norm(lp, y, z, args):
+    """Gated RMSNorm: norm(y * silu(z)) * w (HF MambaRMSNormGated)."""
+    y = y * jax.nn.silu(z.astype(jnp.float32))
+    return rms_norm(y, lp["gate_norm"], args.rms_norm_eps).astype(
+        lp["out_proj"].dtype)
+
+
+def _forward(params, args: Mamba2ArchArgs, h, cache, positions, last_token_idx):
+    convs, ssms = [], []
+    for li in range(args.num_layers):
+        lp = jax.tree.map(lambda p: p[li], params["layers"])
+        hn = rms_norm(h, lp["ln1"], args.rms_norm_eps)
+        if positions is None:
+            out, conv_state, ssm_state = _mixer_prefill(lp, hn, last_token_idx,
+                                                        args)
+        else:
+            out, conv_state, ssm_state = _mixer_decode(
+                lp, hn, cache["conv"][li], cache["ssm"][li], args)
+        convs.append(conv_state)
+        ssms.append(ssm_state)
+        h = h + out
+    h = rms_norm(h, params["final_norm"], args.rms_norm_eps)
+    return h, {"conv": jnp.stack(convs), "ssm": jnp.stack(ssms)}
+
+
+def prefill_forward(params, args: Mamba2ArchArgs, input_ids, position_ids,
+                    last_token_idx, cache, mesh=None, rules=None, use_flash=False,
+                    adapter_ids=None, use_ring=False, return_hidden=False):
+    h = jnp.take(params["embed"], input_ids, axis=0)
+    h, out_cache = _forward(params, args, h, cache, None, last_token_idx)
+    h_last = jnp.take_along_axis(h, last_token_idx[:, None, None], axis=1)[:, 0]
+    head = params["lm_head"] if "lm_head" in params else params["embed"].T
+    logits = (h_last @ head).astype(jnp.float32)
+    if return_hidden:
+        return logits, out_cache, h
+    return logits, out_cache
+
+
+def decode_forward(params, args: Mamba2ArchArgs, input_ids, position_ids, cache,
+                   decode_bucket, mesh=None, rules=None, adapter_ids=None,
+                   tree=None, return_hidden=False, **_ignored):
+    if input_ids.shape[1] != 1 or tree is not None:
+        raise ValueError("Mamba2 decode is single-token only")
+    h = jnp.take(params["embed"], input_ids, axis=0)
+    h, out_cache = _forward(params, args, h, cache, position_ids, None)
+    head = params["lm_head"] if "lm_head" in params else params["embed"].T
+    logits = (h @ head).astype(jnp.float32)
+    if return_hidden:
+        return logits, out_cache, h
+    return logits, out_cache
+
+
+class Mamba2InferenceConfig(InferenceConfig):
+    REQUIRED_ATTRIBUTES = ("hidden_size", "num_hidden_layers", "vocab_size",
+                           "state_size", "conv_kernel", "num_heads", "head_dim")
+
+    def add_derived_config(self) -> None:
+        for attr, default in (("layer_norm_epsilon", 1e-5), ("n_groups", 1),
+                              ("use_bias", False), ("use_conv_bias", True),
+                              ("expand", 2), ("time_step_limit", (0.0, 1e9)),
+                              ("tie_word_embeddings", True)):
+            if not hasattr(self, attr) or getattr(self, attr) is None:
+                setattr(self, attr, default)
+        if not hasattr(self, "intermediate_size") or not self.intermediate_size:
+            self.intermediate_size = int(self.expand * self.hidden_size)
+        if self.use_bias:
+            raise ValueError("biased in/out projections are not ported yet")
+        if self.num_heads * self.head_dim != self.intermediate_size:
+            raise ValueError("num_heads * head_dim must equal intermediate_size")
+
+
+class Mamba2ForCausalLM(TpuModelForCausalLM):
+    def __init__(self, model_path, config, mesh=None):
+        self._require_base_layout(config.tpu_config, "Mamba2 (SSD)")
+        super().__init__(model_path, config, mesh=mesh)
+
+    @classmethod
+    def get_config_cls(cls):
+        return Mamba2InferenceConfig
+
+    @classmethod
+    def arch_args_from_config(cls, config) -> Mamba2ArchArgs:
+        lim = tuple(config.time_step_limit)
+        return Mamba2ArchArgs(
+            vocab_size=config.vocab_size,
+            hidden_size=config.hidden_size,
+            num_layers=config.num_hidden_layers,
+            num_heads=1, num_kv_heads=1,
+            head_dim=config.hidden_size,
+            intermediate_size=config.intermediate_size,
+            rms_norm_eps=config.layer_norm_epsilon,
+            tie_word_embeddings=bool(config.tie_word_embeddings),
+            d_inner=int(config.intermediate_size),
+            d_state=int(config.state_size),
+            d_conv=int(config.conv_kernel),
+            ssd_heads=int(config.num_heads),
+            ssd_head_dim=int(config.head_dim),
+            n_groups=int(config.n_groups),
+            dt_min=float(lim[0]),
+            dt_max=float(min(lim[1], 1e9)),
+        )
+
+    def prefill_fn(self):
+        return prefill_forward
+
+    def decode_fn(self):
+        return decode_forward
+
+    @classmethod
+    def inv_freq_from_config(cls, config) -> np.ndarray:
+        return np.zeros((1,), np.float32)
+
+    def reset_cache(self, batch_size: Optional[int] = None) -> None:
+        a: Mamba2ArchArgs = self.arch_args
+        b = batch_size or self.tpu_config.max_batch_size
+        dt = self.tpu_config.jax_dtype
+        self.kv_cache = {
+            "conv": jnp.zeros((a.num_layers, b, a.d_conv, a.conv_dim), dt),
+            "ssm": jnp.zeros((a.num_layers, b, a.ssd_heads, a.ssd_head_dim,
+                              a.d_state), jnp.float32),
+        }
+
+    def _put_params(self, host_params) -> None:
+        dtype = self.tpu_config.jax_dtype
+        fp32_keys = {"a_log", "d_skip", "dt_bias"}
+
+        def _put(path, x):
+            arr = np.asarray(x)
+            last = getattr(path[-1], "key", None) if path else None
+            if arr.dtype.kind == "f":
+                arr = arr.astype(np.float32 if last in fp32_keys else dtype)
+            return jax.device_put(arr)
+
+        self.params = jax.tree_util.tree_map_with_path(_put, host_params)
+        self.reset_cache()
+
+    def init_random_params(self, key):
+        raise NotImplementedError("load from an HF checkpoint or state dict")
+
+    @classmethod
+    def convert_hf_state_dict(cls, state_dict: Dict[str, np.ndarray],
+                              config) -> Dict:
+        def get(name):
+            if name not in state_dict:
+                raise KeyError(f"missing weight {name}")
+            return np.asarray(state_dict[name])
+
+        def lin_t(name):
+            return np.ascontiguousarray(get(name).T)
+
+        layers: Dict[str, list] = {k: [] for k in
+                                   ("ln1", "in_proj", "conv_w", "conv_b",
+                                    "dt_bias", "a_log", "d_skip", "gate_norm",
+                                    "out_proj")}
+        for i in range(config.num_hidden_layers):
+            p = f"backbone.layers.{i}."
+            mx = p + "mixer."
+            layers["ln1"].append(get(p + "norm.weight"))
+            layers["in_proj"].append(lin_t(mx + "in_proj.weight"))
+            layers["conv_w"].append(np.ascontiguousarray(
+                get(mx + "conv1d.weight")[:, 0, :].T))
+            layers["conv_b"].append(get(mx + "conv1d.bias"))
+            layers["dt_bias"].append(get(mx + "dt_bias"))
+            layers["a_log"].append(get(mx + "A_log"))
+            layers["d_skip"].append(get(mx + "D"))
+            layers["gate_norm"].append(get(mx + "norm.weight"))
+            layers["out_proj"].append(lin_t(mx + "out_proj.weight"))
+        out = {
+            "embed": get("backbone.embeddings.weight"),
+            "layers": {k: np.stack(v) for k, v in layers.items()},
+            "final_norm": get("backbone.norm_f.weight"),
+            "rope_inv_freq": cls.inv_freq_from_config(config),
+        }
+        if not config.tie_word_embeddings:
+            out["lm_head"] = lin_t("lm_head.weight")
+        return out

@@ -49,6 +49,8 @@ CONTRIB_MODELS = {
     "seed_oss": "contrib.models.seed_oss.src.modeling_seed_oss:SeedOssForCausalLM",
     "minimax": "contrib.models.minimax.src.modeling_minimax:MiniMaxForCausalLM",
     "apertus": "contrib.models.apertus.src.modeling_apertus:ApertusForCausalLM",
+    "mamba2": "contrib.models.mamba2.src.modeling_mamba2:Mamba2ForCausalLM",
+    "falcon_h1": "contrib.models.falcon_h1.src.modeling_falcon_h1:FalconH1ForCausalLM",
 }
 
 for model_type, path in CONTRIB_MODELS.items():
