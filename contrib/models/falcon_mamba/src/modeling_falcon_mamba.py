@@ -1,0 +1,32 @@
+"""FalconMamba (TII pure-SSM) on the TPU framework (contrib port).
+
+≈ reference contrib falcon family. Identical to mamba (selective SSM,
+associative-scan prefill, fp32 state + conv-tail cache) except a WEIGHTLESS
+RMSNorm (`FalconMambaMixer.rms_forward`, eps=`mixer_rms_eps`) is applied to
+the dt/B/C splits of x_proj before the recurrence — wired through
+``MambaArchArgs.mixer_rms_eps``. Checkpoint layout matches mamba's
+(`backbone.layers.{i}.mixer.*`), so conversion is inherited unchanged.
+"""
+
+from contrib.models.mamba.src.modeling_mamba import (MambaArchArgs,
+                                                     MambaForCausalLM,
+                                                     MambaInferenceConfig)
+
+
+class FalconMambaInferenceConfig(MambaInferenceConfig):
+    def add_derived_config(self) -> None:
+        super().add_derived_config()
+        if not hasattr(self, "mixer_rms_eps") or self.mixer_rms_eps is None:
+            self.mixer_rms_eps = 1e-6
+
+
+class FalconMambaForCausalLM(MambaForCausalLM):
+    @classmethod
+    def get_config_cls(cls):
+        return FalconMambaInferenceConfig
+
+    @classmethod
+    def arch_args_from_config(cls, config) -> MambaArchArgs:
+        import dataclasses
+        return dataclasses.replace(super().arch_args_from_config(config),
+                                   mixer_rms_eps=float(config.mixer_rms_eps))

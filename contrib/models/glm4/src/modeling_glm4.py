@@ -1,0 +1,48 @@
+"""GLM-4-0414 (glm4 architecture) on the TPU framework (contrib port).
+
+≈ reference contrib GLM-4 family. Identical to glm (half-width
+interleaved-pair partial rotary, QKV biases, fused gate_up MLP) plus
+gemma2-style sandwich norms: `post_self_attn_layernorm` scales the attention
+branch output and `post_mlp_layernorm` the MLP branch output before each
+residual add (HF `Glm4DecoderLayer.forward`), riding the base
+``sandwich_norms`` machinery.
+"""
+
+from typing import Dict
+
+import numpy as np
+
+from contrib.models.glm.src.modeling_glm import (GlmForCausalLM,
+                                                 GlmInferenceConfig)
+from neuronx_distributed_inference_tpu.models.base import ModelArchArgs
+
+
+class Glm4InferenceConfig(GlmInferenceConfig):
+    pass
+
+
+class Glm4ForCausalLM(GlmForCausalLM):
+    @classmethod
+    def get_config_cls(cls):
+        return Glm4InferenceConfig
+
+    @classmethod
+    def arch_args_from_config(cls, config) -> ModelArchArgs:
+        import dataclasses
+        return dataclasses.replace(super().arch_args_from_config(config),
+                                   sandwich_norms=True)
+
+    @classmethod
+    def convert_hf_state_dict(cls, state_dict: Dict[str, np.ndarray],
+                              config) -> Dict:
+        out = super().convert_hf_state_dict(state_dict, config)
+        post1, post2 = [], []
+        for i in range(config.num_hidden_layers):
+            p = f"model.layers.{i}."
+            post1.append(np.asarray(
+                state_dict[p + "post_self_attn_layernorm.weight"]))
+            post2.append(np.asarray(
+                state_dict[p + "post_mlp_layernorm.weight"]))
+        out["layers"]["ln1_post"] = np.stack(post1)
+        out["layers"]["ln2_post"] = np.stack(post2)
+        return out

@@ -1,0 +1,124 @@
+"""GPT-BigCode (SantaCoder/StarCoder1) on the TPU framework (contrib port).
+
+≈ reference contrib starcoder family. GPT-2 block (learned positions, biased
+LayerNorm, plain gelu-tanh MLP, tied head) with multi-query attention: the
+fused `c_attn` packs [q(H) | k(head_dim) | v(head_dim)] and all query heads
+share the single KV head (HF `GPTBigCodeAttention`, multi_query=True). Unlike
+gpt2's Conv1D, BigCode stores nn.Linear weights, so projections transpose at
+conversion.
+"""
+
+from typing import Dict
+
+import numpy as np
+
+from neuronx_distributed_inference_tpu.config import InferenceConfig
+from neuronx_distributed_inference_tpu.models.base import ModelArchArgs
+from neuronx_distributed_inference_tpu.runtime.application import (
+    TpuModelForCausalLM)
+
+
+class GPTBigCodeInferenceConfig(InferenceConfig):
+    REQUIRED_ATTRIBUTES = ("n_embd", "n_layer", "n_head", "vocab_size",
+                           "n_positions")
+
+    def add_derived_config(self) -> None:
+        for attr, default in (("layer_norm_epsilon", 1e-5),
+                              ("activation_function", "gelu_pytorch_tanh"),
+                              ("multi_query", True)):
+            if not hasattr(self, attr) or getattr(self, attr) is None:
+                setattr(self, attr, default)
+        if getattr(self, "n_inner", None) is None:
+            self.n_inner = 4 * self.n_embd
+        if not getattr(self, "scale_attn_weights", True):
+            raise ValueError("scale_attn_weights=False is not ported")
+
+
+class GPTBigCodeForCausalLM(TpuModelForCausalLM):
+    @classmethod
+    def get_config_cls(cls):
+        return GPTBigCodeInferenceConfig
+
+    @classmethod
+    def arch_args_from_config(cls, config) -> ModelArchArgs:
+        h = config.n_embd
+        return ModelArchArgs(
+            vocab_size=config.vocab_size,
+            hidden_size=h,
+            num_layers=config.n_layer,
+            num_heads=config.n_head,
+            num_kv_heads=1 if config.multi_query else config.n_head,
+            head_dim=h // config.n_head,
+            intermediate_size=config.n_inner,
+            rms_norm_eps=config.layer_norm_epsilon,
+            activation=config.activation_function,
+            norm_type="layer", norm_bias=True,
+            mlp_kind="plain", mlp_bias=True,
+            attention_bias=True, o_bias=True,
+            learned_pos=True,
+            tie_word_embeddings=True,
+        )
+
+    @classmethod
+    def inv_freq_from_config(cls, config) -> np.ndarray:
+        # learned positions: rope collapses to identity via a zero frequency table
+        return np.zeros(((config.n_embd // config.n_head) // 2,), np.float32)
+
+    @classmethod
+    def convert_hf_state_dict(cls, state_dict: Dict[str, np.ndarray],
+                              config) -> Dict:
+        h = config.n_embd
+        kv_dim = (h // config.n_head) if config.multi_query else h
+
+        def get(name):
+            if name not in state_dict:
+                raise KeyError(f"missing weight {name}")
+            return np.asarray(state_dict[name])
+
+        def lin_t(name):
+            return np.ascontiguousarray(get(name).T)
+
+        layers = {k: [] for k in ("ln1", "ln1_b", "wq", "wk", "wv", "bq", "bk",
+                                  "bv", "wo", "bo", "ln2", "ln2_b", "wg", "bg",
+                                  "wd", "bd")}
+        nh, hd = config.n_head, h // config.n_head
+        for i in range(config.n_layer):
+            p = f"transformer.h.{i}."
+            c_attn = lin_t(p + "attn.c_attn.weight")
+            c_attn_b = get(p + "attn.c_attn.bias")
+            if config.multi_query:
+                # (H, H + 2·head_dim): [q(H) | k(hd) | v(hd)], one shared KV head
+                qkv_w = (c_attn[:, :h], c_attn[:, h : h + kv_dim],
+                         c_attn[:, h + kv_dim :])
+                qkv_b = (c_attn_b[:h], c_attn_b[h : h + kv_dim],
+                         c_attn_b[h + kv_dim :])
+            else:
+                # MHA packs per-head [q|k|v] chunks of head_dim
+                # (`GPTBigCodeAttention.forward`: view(.., nh, 3·hd).split)
+                w3 = c_attn.reshape(h, nh, 3, hd)
+                b3 = c_attn_b.reshape(nh, 3, hd)
+                qkv_w = tuple(np.ascontiguousarray(w3[:, :, j].reshape(h, h))
+                              for j in range(3))
+                qkv_b = tuple(b3[:, j].reshape(h) for j in range(3))
+            for key, val in zip(("wq", "wk", "wv"), qkv_w):
+                layers[key].append(val)
+            for key, val in zip(("bq", "bk", "bv"), qkv_b):
+                layers[key].append(val)
+            layers["wo"].append(lin_t(p + "attn.c_proj.weight"))
+            layers["bo"].append(get(p + "attn.c_proj.bias"))
+            layers["ln1"].append(get(p + "ln_1.weight"))
+            layers["ln1_b"].append(get(p + "ln_1.bias"))
+            layers["ln2"].append(get(p + "ln_2.weight"))
+            layers["ln2_b"].append(get(p + "ln_2.bias"))
+            layers["wg"].append(lin_t(p + "mlp.c_fc.weight"))
+            layers["bg"].append(get(p + "mlp.c_fc.bias"))
+            layers["wd"].append(lin_t(p + "mlp.c_proj.weight"))
+            layers["bd"].append(get(p + "mlp.c_proj.bias"))
+        return {
+            "embed": get("transformer.wte.weight"),
+            "pos_embed": get("transformer.wpe.weight"),
+            "layers": {k: np.stack(v) for k, v in layers.items()},
+            "final_norm": get("transformer.ln_f.weight"),
+            "final_norm_b": get("transformer.ln_f.bias"),
+            "rope_inv_freq": cls.inv_freq_from_config(config),
+        }

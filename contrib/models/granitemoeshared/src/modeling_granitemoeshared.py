@@ -1,0 +1,61 @@
+"""GraniteMoeShared (IBM granite-4.0-tiny style) on the TPU framework
+(contrib port).
+
+≈ reference contrib granite family. GraniteMoe (granite multiplier quartet +
+topk_softmax-routed fused-projection MoE) plus a DENSE shared expert added to
+every MoE output — ungated, unlike qwen2-moe's sigmoid-gated shared expert
+(HF `GraniteMoeSharedDecoderLayer`: `moe_out + shared_mlp(hn)`), riding
+``MoEArgs.shared_expert_gated=False``.
+"""
+
+import dataclasses
+from typing import Dict
+
+import numpy as np
+
+from contrib.models.granitemoe.src.modeling_granitemoe import (
+    GraniteMoeForCausalLM, GraniteMoeInferenceConfig)
+from neuronx_distributed_inference_tpu.models.base import ModelArchArgs
+
+
+class GraniteMoeSharedInferenceConfig(GraniteMoeInferenceConfig):
+    def add_derived_config(self) -> None:
+        super().add_derived_config()
+        if not hasattr(self, "shared_intermediate_size") or \
+                self.shared_intermediate_size is None:
+            self.shared_intermediate_size = 0
+
+
+class GraniteMoeSharedForCausalLM(GraniteMoeForCausalLM):
+    @classmethod
+    def get_config_cls(cls):
+        return GraniteMoeSharedInferenceConfig
+
+    @classmethod
+    def arch_args_from_config(cls, config) -> ModelArchArgs:
+        args = super().arch_args_from_config(config)
+        moe = dataclasses.replace(
+            args.moe,
+            shared_expert_intermediate_size=int(config.shared_intermediate_size),
+            shared_expert_gated=False)
+        return dataclasses.replace(args, moe=moe)
+
+    @classmethod
+    def convert_hf_state_dict(cls, state_dict: Dict[str, np.ndarray],
+                              config) -> Dict:
+        out = super().convert_hf_state_dict(state_dict, config)
+        if not config.shared_intermediate_size:
+            return out
+        si = config.shared_intermediate_size
+        wg, wu, wd = [], [], []
+        for i in range(config.num_hidden_layers):
+            p = f"model.layers.{i}.shared_mlp."
+            fused = np.asarray(state_dict[p + "input_linear.weight"])  # (2S, H)
+            wg.append(np.ascontiguousarray(fused[:si, :].T))
+            wu.append(np.ascontiguousarray(fused[si:, :].T))
+            wd.append(np.ascontiguousarray(
+                np.asarray(state_dict[p + "output_linear.weight"]).T))
+        out["layers"]["shared_wg"] = np.stack(wg)
+        out["layers"]["shared_wu"] = np.stack(wu)
+        out["layers"]["shared_wd"] = np.stack(wd)
+        return out

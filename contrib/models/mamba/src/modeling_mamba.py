@@ -39,6 +39,9 @@ class MambaArchArgs(ModelArchArgs):
     d_state: int = 16
     d_conv: int = 4
     dt_rank: int = 0
+    # falcon-mamba: weightless RMSNorm over the dt/B/C splits of x_proj
+    # (HF `FalconMambaMixer.rms_forward`); None = plain mamba
+    mixer_rms_eps: Optional[float] = None
 
 
 def _ssm_params(lp, x, args):
@@ -47,6 +50,12 @@ def _ssm_params(lp, x, args):
     proj = x @ lp["x_proj"]                                  # (B, T, R + 2S)
     r, s = args.dt_rank, args.d_state
     dt, b_mat, c_mat = proj[..., :r], proj[..., r : r + s], proj[..., r + s :]
+    if args.mixer_rms_eps is not None:
+        def _rms(v):
+            v32 = v.astype(jnp.float32)
+            var = jnp.mean(jnp.square(v32), axis=-1, keepdims=True)
+            return (v32 * jax.lax.rsqrt(var + args.mixer_rms_eps)).astype(v.dtype)
+        dt, b_mat, c_mat = _rms(dt), _rms(b_mat), _rms(c_mat)
     delta = jax.nn.softplus(
         (dt @ lp["dt_proj"] + lp["dt_bias"]).astype(jnp.float32))   # (B, T, I)
     a = -jnp.exp(lp["a_log"].astype(jnp.float32))            # (I, S)
@@ -134,7 +143,8 @@ def prefill_forward(params, args: MambaArchArgs, input_ids, position_ids,
     h = jnp.take(params["embed"], input_ids, axis=0)
     h, out_cache = _forward(params, args, h, cache, None, last_token_idx)
     h_last = jnp.take_along_axis(h, last_token_idx[:, None, None], axis=1)[:, 0]
-    logits = (h_last @ params["embed"].T).astype(jnp.float32)
+    head = params["lm_head"] if "lm_head" in params else params["embed"].T
+    logits = (h_last @ head).astype(jnp.float32)
     if return_hidden:
         return logits, out_cache, h
     return logits, out_cache
@@ -148,7 +158,8 @@ def decode_forward(params, args: MambaArchArgs, input_ids, position_ids, cache,
                          "per row)")
     h = jnp.take(params["embed"], input_ids, axis=0)
     h, out_cache = _forward(params, args, h, cache, position_ids, None)
-    logits = (h @ params["embed"].T).astype(jnp.float32)
+    head = params["lm_head"] if "lm_head" in params else params["embed"].T
+    logits = (h @ head).astype(jnp.float32)
     if return_hidden:
         return logits, out_cache, h
     return logits, out_cache
@@ -194,7 +205,7 @@ class MambaForCausalLM(TpuModelForCausalLM):
             head_dim=config.hidden_size,
             intermediate_size=config.intermediate_size,
             rms_norm_eps=config.layer_norm_epsilon,
-            tie_word_embeddings=True,
+            tie_word_embeddings=bool(getattr(config, "tie_word_embeddings", True)),
             d_inner=int(config.intermediate_size),
             d_state=int(config.state_size),
             d_conv=int(config.conv_kernel),
@@ -268,9 +279,12 @@ class MambaForCausalLM(TpuModelForCausalLM):
             layers["a_log"].append(get(mx + "A_log"))
             layers["d_skip"].append(get(mx + "D"))
             layers["out_proj"].append(lin_t(mx + "out_proj.weight"))
-        return {
+        out = {
             "embed": get("backbone.embeddings.weight"),
             "layers": {k: np.stack(v) for k, v in layers.items()},
             "final_norm": get("backbone.norm_f.weight"),
             "rope_inv_freq": cls.inv_freq_from_config(config),
         }
+        if not getattr(config, "tie_word_embeddings", True):
+            out["lm_head"] = lin_t("lm_head.weight")
+        return out

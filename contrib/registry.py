@@ -51,6 +51,10 @@ CONTRIB_MODELS = {
     "apertus": "contrib.models.apertus.src.modeling_apertus:ApertusForCausalLM",
     "mamba2": "contrib.models.mamba2.src.modeling_mamba2:Mamba2ForCausalLM",
     "falcon_h1": "contrib.models.falcon_h1.src.modeling_falcon_h1:FalconH1ForCausalLM",
+    "glm4": "contrib.models.glm4.src.modeling_glm4:Glm4ForCausalLM",
+    "gpt_bigcode": "contrib.models.gpt_bigcode.src.modeling_gpt_bigcode:GPTBigCodeForCausalLM",
+    "granitemoeshared": "contrib.models.granitemoeshared.src.modeling_granitemoeshared:GraniteMoeSharedForCausalLM",
+    "falcon_mamba": "contrib.models.falcon_mamba.src.modeling_falcon_mamba:FalconMambaForCausalLM",
 }
 
 for model_type, path in CONTRIB_MODELS.items():

@@ -55,7 +55,8 @@ def test_registry_resolves_contrib_models():
                "cohere2", "smollm3", "granitemoe",
                "ernie4_5", "exaone4", "gptj", "gpt_neo", "codegen",
                "olmo", "olmoe", "mamba", "jamba", "persimmon", "xglm",
-               "seed_oss", "minimax", "apertus", "mamba2", "falcon_h1"):
+               "seed_oss", "minimax", "apertus", "mamba2", "falcon_h1", "glm4",
+               "gpt_bigcode", "granitemoeshared", "falcon_mamba"):
         assert get_model_cls(mt) is not None
 
 
@@ -892,3 +893,95 @@ def test_falcon_h1_gated_norm_variant():
                                        atol=2e-3, rtol=1e-3)
             cur = torch.cat([cur, torch.tensor(out.tokens[:, step:step + 1],
                                                dtype=torch.long)], 1)
+
+
+def test_glm4_parity():
+    """GLM-4-0414: glm plus sandwich norms (post_self_attn / post_mlp branch
+    norms before each residual add)."""
+    from transformers import Glm4Config, Glm4ForCausalLM as HFGlm4
+
+    from contrib.models.glm4.src.modeling_glm4 import Glm4ForCausalLM
+
+    cfg = Glm4Config(vocab_size=256, hidden_size=64, num_hidden_layers=2,
+                     num_attention_heads=4, num_key_value_heads=2,
+                     intermediate_size=128, partial_rotary_factor=0.5,
+                     head_dim=16, attention_bias=True, rope_theta=10000.0,
+                     tie_word_embeddings=False, pad_token_id=0)
+    torch.manual_seed(0)
+    hf = HFGlm4(cfg).eval()
+    _run_parity(Glm4ForCausalLM, hf, cfg)
+
+
+def test_gpt_bigcode_parity():
+    """GPT-BigCode (StarCoder1): GPT-2 block with multi-query attention —
+    fused c_attn packs [q | k(1 head) | v(1 head)]."""
+    from transformers import GPTBigCodeConfig, GPTBigCodeForCausalLM as HFBig
+
+    from contrib.models.gpt_bigcode.src.modeling_gpt_bigcode import (
+        GPTBigCodeForCausalLM)
+
+    cfg = GPTBigCodeConfig(vocab_size=256, n_positions=128, n_embd=64,
+                           n_layer=2, n_head=4, multi_query=True,
+                           activation_function="gelu_pytorch_tanh",
+                           resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0)
+    torch.manual_seed(0)
+    hf = HFBig(cfg).eval()
+    _run_parity(GPTBigCodeForCausalLM, hf, cfg)
+
+
+def test_gpt_bigcode_mha_parity():
+    """multi_query=False: the fused c_attn interleaves per-head [q|k|v]
+    chunks, a different layout than the MQA [q|k|v] blocks."""
+    from transformers import GPTBigCodeConfig, GPTBigCodeForCausalLM as HFBig
+
+    from contrib.models.gpt_bigcode.src.modeling_gpt_bigcode import (
+        GPTBigCodeForCausalLM)
+
+    cfg = GPTBigCodeConfig(vocab_size=256, n_positions=128, n_embd=64,
+                           n_layer=2, n_head=4, multi_query=False,
+                           activation_function="gelu_pytorch_tanh",
+                           resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0)
+    torch.manual_seed(1)
+    hf = HFBig(cfg).eval()
+    _run_parity(GPTBigCodeForCausalLM, hf, cfg)
+
+
+def test_granitemoeshared_parity():
+    """GraniteMoeShared: granitemoe plus an ungated dense shared expert summed
+    with every routed-MoE output."""
+    from transformers import (GraniteMoeSharedConfig,
+                              GraniteMoeSharedForCausalLM as HFGms)
+
+    from contrib.models.granitemoeshared.src.modeling_granitemoeshared import (
+        GraniteMoeSharedForCausalLM)
+
+    cfg = GraniteMoeSharedConfig(
+        vocab_size=256, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, intermediate_size=96,
+        shared_intermediate_size=80, num_local_experts=4,
+        num_experts_per_tok=2, embedding_multiplier=2.0,
+        attention_multiplier=0.3, residual_multiplier=0.8,
+        logits_scaling=1.5, attention_bias=False, rope_theta=10000.0,
+        tie_word_embeddings=False, pad_token_id=0)
+    torch.manual_seed(0)
+    hf = HFGms(cfg).eval()
+    _run_parity(GraniteMoeSharedForCausalLM, hf, cfg, atol=2e-3, rtol=1e-3)
+
+
+def test_falcon_mamba_parity():
+    """FalconMamba: mamba with a weightless RMSNorm over the dt/B/C x_proj
+    splits (mixer_rms_eps)."""
+    from transformers import (FalconMambaConfig,
+                              FalconMambaForCausalLM as HFFalconMamba)
+
+    from contrib.models.falcon_mamba.src.modeling_falcon_mamba import (
+        FalconMambaForCausalLM)
+
+    cfg = FalconMambaConfig(vocab_size=256, hidden_size=32, state_size=8,
+                            num_hidden_layers=2, conv_kernel=4, expand=2,
+                            time_step_rank=4, use_bias=False,
+                            use_conv_bias=True, mixer_rms_eps=1e-6,
+                            pad_token_id=0, tie_word_embeddings=False)
+    torch.manual_seed(0)
+    hf = HFFalconMamba(cfg).eval()
+    _run_parity(FalconMambaForCausalLM, hf, cfg, atol=2e-3, rtol=1e-3)
