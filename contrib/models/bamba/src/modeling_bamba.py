@@ -1,0 +1,321 @@
+"""Bamba (IBM mamba2/attention sequential hybrid) on the TPU framework
+(contrib port).
+
+≈ reference contrib hybrid family. Jamba's heterogeneous-layer layout with
+mamba2 SSD mixers: each layer is ln1 → (SSD mixer OR partial-rotary GQA
+attention) → residual, then pre-ff norm → dense gated MLP → residual (HF
+`BambaDecoderLayer`). The mixer math (grouped B/C expand, joint x|B|C conv,
+gate-then-norm gated RMSNorm, associative-scan prefill) is imported from
+contrib/models/mamba2; the hybrid cache stacks attention KV separately from
+the mamba conv-tail/fp32-SSM states.
+"""
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from contrib.models.mamba2.src.modeling_mamba2 import (Mamba2ArchArgs,
+                                                       _mixer_decode,
+                                                       _mixer_prefill)
+from neuronx_distributed_inference_tpu.config import InferenceConfig
+from neuronx_distributed_inference_tpu.models.base import (
+    ModelArchArgs, causal_mask)
+from neuronx_distributed_inference_tpu.ops import rope as rope_ops
+from neuronx_distributed_inference_tpu.ops.attention import attend
+from neuronx_distributed_inference_tpu.ops.norms import rms_norm
+from neuronx_distributed_inference_tpu.runtime.application import (
+    TpuModelForCausalLM)
+
+
+@dataclass(frozen=True)
+class BambaArchArgs(Mamba2ArchArgs):
+    layer_kinds: Tuple[str, ...] = ()
+    rotary_dim: int = 0
+    attention_scale: Optional[float] = None   # None = head_dim**-0.5
+
+
+def _rot_half(x):
+    half = x.shape[-1] // 2
+    return jnp.concatenate([-x[..., half:], x[..., :half]], axis=-1)
+
+
+def _partial_rotary(q, k, cos, sin, rd):
+    """Rotate the first ``rd`` dims of q/k, pass the rest through (HF partial
+    rotary convention used by `BambaAttention`)."""
+    cos, sin = cos[:, None, :, :], sin[:, None, :, :]
+    qr, qp = q[..., :rd].astype(jnp.float32), q[..., rd:]
+    kr, kp = k[..., :rd].astype(jnp.float32), k[..., rd:]
+    qr = qr * cos + _rot_half(qr) * sin
+    kr = kr * cos + _rot_half(kr) * sin
+    q = jnp.concatenate([qr.astype(q.dtype), qp], axis=-1)
+    k = jnp.concatenate([kr.astype(k.dtype), kp], axis=-1)
+    return q, k
+
+
+def _attn(lp, hn, cos, sin, mask, k_cache, v_cache, positions, bucket, args):
+    b, t, _ = hn.shape
+    q = (hn @ lp["wq"]).reshape(b, t, args.num_heads, args.head_dim
+                                ).transpose(0, 2, 1, 3)
+    k = (hn @ lp["wk"]).reshape(b, t, args.num_kv_heads, args.head_dim
+                                ).transpose(0, 2, 1, 3)
+    v = (hn @ lp["wv"]).reshape(b, t, args.num_kv_heads, args.head_dim
+                                ).transpose(0, 2, 1, 3)
+    q, k = _partial_rotary(q, k, cos, sin, args.rotary_dim)
+    if positions is None:
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k.astype(k_cache.dtype), (0, 0, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v.astype(v_cache.dtype), (0, 0, 0, 0))
+        k_att, v_att = k, v
+    else:
+        def _one(row_c, row_n, p):
+            return jax.lax.dynamic_update_slice(
+                row_c, row_n.astype(row_c.dtype), (0, p, 0))
+
+        k_cache = jax.vmap(_one)(k_cache, k, positions)
+        v_cache = jax.vmap(_one)(v_cache, v, positions)
+        k_att = jax.lax.slice_in_dim(k_cache, 0, bucket, axis=2).astype(q.dtype)
+        v_att = jax.lax.slice_in_dim(v_cache, 0, bucket, axis=2).astype(q.dtype)
+    attn = attend(q, k_att, v_att, mask=mask, scale=args.attention_scale)
+    attn = attn.transpose(0, 2, 1, 3).reshape(b, t, args.q_size)
+    return attn @ lp["wo"], k_cache, v_cache
+
+
+def _forward(params, args: BambaArchArgs, h, cos, sin, mask, cache, positions,
+             bucket, last_token_idx):
+    ks, vs, convs, ssms = [], [], [], []
+    ai = mi = 0
+    for li, kind in enumerate(args.layer_kinds):
+        lp = params["layers"][li]
+        hn = rms_norm(h, lp["ln1"], args.rms_norm_eps)
+        if kind == "attention":
+            out, kc, vc = _attn(lp, hn, cos, sin, mask, cache["k"][ai],
+                                cache["v"][ai], positions, bucket, args)
+            ks.append(kc)
+            vs.append(vc)
+            ai += 1
+        elif positions is None:
+            out, conv_state, ssm_state = _mixer_prefill(lp, hn, last_token_idx,
+                                                        args)
+            convs.append(conv_state)
+            ssms.append(ssm_state)
+            mi += 1
+        else:
+            out, conv_state, ssm_state = _mixer_decode(
+                lp, hn, cache["conv"][mi], cache["ssm"][mi], args)
+            convs.append(conv_state)
+            ssms.append(ssm_state)
+            mi += 1
+        h = h + out
+        hn = rms_norm(h, lp["ln2"], args.rms_norm_eps)
+        h = h + (jax.nn.silu(hn @ lp["wg"]) * (hn @ lp["wu"])) @ lp["wd"]
+    h = rms_norm(h, params["final_norm"], args.rms_norm_eps)
+    out_cache = {"k": jnp.stack(ks) if ks else cache["k"],
+                 "v": jnp.stack(vs) if vs else cache["v"],
+                 "conv": jnp.stack(convs) if convs else cache["conv"],
+                 "ssm": jnp.stack(ssms) if ssms else cache["ssm"]}
+    return h, out_cache
+
+
+def prefill_forward(params, args: BambaArchArgs, input_ids, position_ids,
+                    last_token_idx, cache, mesh=None, rules=None, use_flash=False,
+                    adapter_ids=None, use_ring=False, return_hidden=False):
+    h = jnp.take(params["embed"], input_ids, axis=0)
+    t = input_ids.shape[1]
+    cos, sin = rope_ops.compute_cos_sin(params["rope_inv_freq"], position_ids)
+    mask = (position_ids[:, None, :, None] >= position_ids[:, None, None, :])
+    mask &= causal_mask(t, t)[None, None]
+    h, out_cache = _forward(params, args, h, cos, sin, mask, cache, None, None,
+                            last_token_idx)
+    h_last = jnp.take_along_axis(h, last_token_idx[:, None, None], axis=1)[:, 0]
+    head = params["lm_head"] if "lm_head" in params else params["embed"].T
+    logits = (h_last @ head).astype(jnp.float32)
+    if return_hidden:
+        return logits, out_cache, h
+    return logits, out_cache
+
+
+def decode_forward(params, args: BambaArchArgs, input_ids, position_ids, cache,
+                   decode_bucket, mesh=None, rules=None, adapter_ids=None,
+                   tree=None, return_hidden=False, **_ignored):
+    if input_ids.shape[1] != 1 or tree is not None:
+        raise ValueError("Bamba decode is single-token only")
+    h = jnp.take(params["embed"], input_ids, axis=0)
+    cos, sin = rope_ops.compute_cos_sin(params["rope_inv_freq"],
+                                        position_ids[:, None])
+    kv_pos = jnp.arange(decode_bucket)[None, None, None, :]
+    mask = kv_pos <= position_ids[:, None, None, None]
+    h, out_cache = _forward(params, args, h, cos, sin, mask, cache,
+                            position_ids, decode_bucket, None)
+    head = params["lm_head"] if "lm_head" in params else params["embed"].T
+    logits = (h @ head).astype(jnp.float32)
+    if return_hidden:
+        return logits, out_cache, h
+    return logits, out_cache
+
+
+class BambaInferenceConfig(InferenceConfig):
+    REQUIRED_ATTRIBUTES = ("hidden_size", "num_hidden_layers",
+                           "num_attention_heads", "num_key_value_heads",
+                           "vocab_size", "intermediate_size",
+                           "mamba_n_heads", "mamba_d_state")
+
+    def add_derived_config(self) -> None:
+        for attr, default in (("rope_theta", 10000.0), ("rms_norm_eps", 1e-5),
+                              ("mamba_d_conv", 4), ("mamba_expand", 2),
+                              ("mamba_n_groups", 1),
+                              ("partial_rotary_factor", 0.5),
+                              ("tie_word_embeddings", False)):
+            if not hasattr(self, attr) or getattr(self, attr) is None:
+                setattr(self, attr, default)
+        if not hasattr(self, "head_dim") or self.head_dim is None:
+            self.head_dim = self.hidden_size // self.num_attention_heads
+        if not getattr(self, "layers_block_type", None):
+            # BambaConfig derives layers_block_type from attn_layer_indices
+            # and to_dict() drops the derived list; rebuild it the same way
+            # (or take `layer_types` if a config serialized it under that key)
+            lt = getattr(self, "layer_types", None)
+            if lt:
+                self.layers_block_type = list(lt)
+            else:
+                idx = set(getattr(self, "attn_layer_indices", None) or [])
+                self.layers_block_type = [
+                    "attention" if i in idx else "mamba"
+                    for i in range(self.num_hidden_layers)]
+        for flag in ("attention_bias", "mamba_proj_bias"):
+            if getattr(self, flag, False):
+                raise ValueError(f"Bamba {flag}=True is not ported (released "
+                                 "checkpoints ship bias-free projections)")
+
+
+class BambaForCausalLM(TpuModelForCausalLM):
+    def __init__(self, model_path, config, mesh=None):
+        self._require_base_layout(config.tpu_config,
+                                  "Bamba (mamba2/attention hybrid)")
+        super().__init__(model_path, config, mesh=mesh)
+
+    @classmethod
+    def get_config_cls(cls):
+        return BambaInferenceConfig
+
+    @classmethod
+    def arch_args_from_config(cls, config) -> BambaArchArgs:
+        d_inner = int(config.mamba_expand * config.hidden_size)
+        return BambaArchArgs(
+            vocab_size=config.vocab_size,
+            hidden_size=config.hidden_size,
+            num_layers=config.num_hidden_layers,
+            num_heads=config.num_attention_heads,
+            num_kv_heads=config.num_key_value_heads,
+            head_dim=config.head_dim,
+            intermediate_size=config.intermediate_size,
+            rms_norm_eps=config.rms_norm_eps,
+            tie_word_embeddings=bool(config.tie_word_embeddings),
+            d_inner=d_inner,
+            d_state=int(config.mamba_d_state),
+            d_conv=int(config.mamba_d_conv),
+            ssd_heads=int(config.mamba_n_heads),
+            ssd_head_dim=int(d_inner // config.mamba_n_heads),
+            n_groups=int(config.mamba_n_groups),
+            layer_kinds=tuple(config.layers_block_type),
+            rotary_dim=int(config.head_dim * float(config.partial_rotary_factor)),
+        )
+
+    def prefill_fn(self):
+        return prefill_forward
+
+    def decode_fn(self):
+        return decode_forward
+
+    @classmethod
+    def inv_freq_from_config(cls, config) -> np.ndarray:
+        rd = int(config.head_dim * float(config.partial_rotary_factor))
+        return rope_ops.default_inv_freq(rd, float(config.rope_theta))
+
+    def reset_cache(self, batch_size: Optional[int] = None) -> None:
+        a: BambaArchArgs = self.arch_args
+        b = batch_size or self.tpu_config.max_batch_size
+        dt = self.tpu_config.jax_dtype
+        n_attn = sum(1 for k in a.layer_kinds if k == "attention")
+        n_mamba = a.num_layers - n_attn
+        self.kv_cache = {
+            "k": jnp.zeros((n_attn, b, a.num_kv_heads,
+                            self.tpu_config.seq_len, a.head_dim), dt),
+            "v": jnp.zeros((n_attn, b, a.num_kv_heads,
+                            self.tpu_config.seq_len, a.head_dim), dt),
+            "conv": jnp.zeros((n_mamba, b, a.d_conv, a.conv_dim), dt),
+            "ssm": jnp.zeros((n_mamba, b, a.ssd_heads, a.ssd_head_dim,
+                              a.d_state), jnp.float32),
+        }
+
+    def _put_params(self, host_params) -> None:
+        dtype = self.tpu_config.jax_dtype
+        fp32_keys = {"a_log", "d_skip", "dt_bias"}
+
+        def _put(path, x):
+            arr = np.asarray(x)
+            last = getattr(path[-1], "key", None) if path else None
+            if arr.dtype.kind == "f":
+                arr = arr.astype(np.float32 if last in fp32_keys else dtype)
+            return jax.device_put(arr)
+
+        self.params = jax.tree_util.tree_map_with_path(_put, host_params)
+        self.reset_cache()
+
+    def init_random_params(self, key):
+        raise NotImplementedError("load from an HF checkpoint or state dict")
+
+    @classmethod
+    def convert_hf_state_dict(cls, state_dict: Dict[str, np.ndarray],
+                              config) -> Dict:
+        def get(name):
+            if name not in state_dict:
+                raise KeyError(f"missing weight {name}")
+            return np.asarray(state_dict[name])
+
+        def lin_t(name):
+            return np.ascontiguousarray(get(name).T)
+
+        layers = []
+        for i, kind in enumerate(config.layers_block_type):
+            p = f"model.layers.{i}."
+            lp = {
+                "ln1": get(p + "input_layernorm.weight"),
+                "ln2": get(p + "pre_ff_layernorm.weight"),
+                "wg": lin_t(p + "feed_forward.gate_proj.weight"),
+                "wu": lin_t(p + "feed_forward.up_proj.weight"),
+                "wd": lin_t(p + "feed_forward.down_proj.weight"),
+            }
+            if kind == "attention":
+                lp.update({
+                    "wq": lin_t(p + "self_attn.q_proj.weight"),
+                    "wk": lin_t(p + "self_attn.k_proj.weight"),
+                    "wv": lin_t(p + "self_attn.v_proj.weight"),
+                    "wo": lin_t(p + "self_attn.o_proj.weight"),
+                })
+            else:
+                mx = p + "mamba."
+                lp.update({
+                    "in_proj": lin_t(mx + "in_proj.weight"),
+                    "conv_w": np.ascontiguousarray(
+                        get(mx + "conv1d.weight")[:, 0, :].T),
+                    "conv_b": get(mx + "conv1d.bias"),
+                    "dt_bias": get(mx + "dt_bias"),
+                    "a_log": get(mx + "A_log"),
+                    "d_skip": get(mx + "D"),
+                    "gate_norm": get(mx + "norm.weight"),
+                    "out_proj": lin_t(mx + "out_proj.weight"),
+                })
+            layers.append(lp)
+        out = {
+            "embed": get("model.embed_tokens.weight"),
+            "layers": layers,
+            "final_norm": get("model.final_layernorm.weight"),
+            "rope_inv_freq": cls.inv_freq_from_config(config),
+        }
+        if not config.tie_word_embeddings:
+            out["lm_head"] = lin_t("lm_head.weight")
+        return out

@@ -87,8 +87,12 @@ class Gemma2ForCausalLM(TpuModelForCausalLM):
         def lin_t(name):
             return np.ascontiguousarray(get(name).T)
 
-        layers = {k: [] for k in ("ln1", "ln1_post", "wq", "wk", "wv", "wo",
-                                  "ln2", "ln2_post", "wg", "wu", "wd")}
+        # sandwich norms are absent in the VaultGemma subclass's checkpoints
+        sandwich = "model.layers.0.post_attention_layernorm.weight" in state_dict
+        keys = ["ln1", "wq", "wk", "wv", "wo", "ln2", "wg", "wu", "wd"]
+        if sandwich:
+            keys += ["ln1_post", "ln2_post"]
+        layers = {k: [] for k in keys}
         for i in range(config.num_hidden_layers):
             p = f"model.layers.{i}."
             layers["wq"].append(lin_t(p + "self_attn.q_proj.weight"))
@@ -96,12 +100,15 @@ class Gemma2ForCausalLM(TpuModelForCausalLM):
             layers["wv"].append(lin_t(p + "self_attn.v_proj.weight"))
             layers["wo"].append(lin_t(p + "self_attn.o_proj.weight"))
             layers["ln1"].append(get(p + "input_layernorm.weight"))
-            layers["ln1_post"].append(get(p + "post_attention_layernorm.weight"))
             layers["ln2"].append(get(p + "pre_feedforward_layernorm.weight"))
-            layers["ln2_post"].append(get(p + "post_feedforward_layernorm.weight"))
             layers["wg"].append(lin_t(p + "mlp.gate_proj.weight"))
             layers["wu"].append(lin_t(p + "mlp.up_proj.weight"))
             layers["wd"].append(lin_t(p + "mlp.down_proj.weight"))
+            if sandwich:
+                layers["ln1_post"].append(
+                    get(p + "post_attention_layernorm.weight"))
+                layers["ln2_post"].append(
+                    get(p + "post_feedforward_layernorm.weight"))
         return {
             "embed": get("model.embed_tokens.weight"),
             "layers": {k: np.stack(v) for k, v in layers.items()},

@@ -56,7 +56,8 @@ class GPTBigCodeForCausalLM(TpuModelForCausalLM):
             mlp_kind="plain", mlp_bias=True,
             attention_bias=True, o_bias=True,
             learned_pos=True,
-            tie_word_embeddings=True,
+            tie_word_embeddings=bool(getattr(config, "tie_word_embeddings",
+                                             True)),
         )
 
     @classmethod
@@ -114,7 +115,7 @@ class GPTBigCodeForCausalLM(TpuModelForCausalLM):
             layers["bg"].append(get(p + "mlp.c_fc.bias"))
             layers["wd"].append(lin_t(p + "mlp.c_proj.weight"))
             layers["bd"].append(get(p + "mlp.c_proj.bias"))
-        return {
+        out = {
             "embed": get("transformer.wte.weight"),
             "pos_embed": get("transformer.wpe.weight"),
             "layers": {k: np.stack(v) for k, v in layers.items()},
@@ -122,3 +123,6 @@ class GPTBigCodeForCausalLM(TpuModelForCausalLM):
             "final_norm_b": get("transformer.ln_f.bias"),
             "rope_inv_freq": cls.inv_freq_from_config(config),
         }
+        if not getattr(config, "tie_word_embeddings", True):
+            out["lm_head"] = lin_t("lm_head.weight")
+        return out

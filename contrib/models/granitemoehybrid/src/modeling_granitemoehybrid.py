@@ -1,0 +1,291 @@
+"""GraniteMoeHybrid (IBM granite-4.0 h-family) on the TPU framework
+(contrib port).
+
+≈ reference contrib granite family. Bamba's heterogeneous layout (mamba2 SSD
+mixer layers OR GQA attention layers, per layers_block_type) combined with
+granite's block: every layer ends in the shared ops/moe.py MoE FFN
+(topk_softmax routing + ungated dense shared expert, so EP sharding and
+quantization ride along), with the granite multiplier family (embedding,
+residual, logits_scaling) and attention scaled by the raw
+attention_multiplier. Rope only when position_embedding_type == "rope"
+(granite-4.0-h ships NoPE → zero inv-freq table, identity rotation). The
+mixer and attention come from contrib/models/{mamba2,bamba}.
+"""
+
+from dataclasses import dataclass
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from contrib.models.bamba.src.modeling_bamba import (BambaArchArgs,
+                                                     BambaForCausalLM,
+                                                     _attn)
+from contrib.models.mamba2.src.modeling_mamba2 import (_mixer_decode,
+                                                       _mixer_prefill)
+from neuronx_distributed_inference_tpu.config import InferenceConfig
+from neuronx_distributed_inference_tpu.models.base import causal_mask
+from neuronx_distributed_inference_tpu.ops import rope as rope_ops
+from neuronx_distributed_inference_tpu.ops.moe import MoEArgs, moe_block
+from neuronx_distributed_inference_tpu.ops.norms import rms_norm
+from neuronx_distributed_inference_tpu.runtime.application import (
+    TpuModelForCausalLM)
+
+
+@dataclass(frozen=True)
+class GraniteMoeHybridArchArgs(BambaArchArgs):
+    residual_multiplier: float = 1.0
+    logits_scale: float = 1.0
+
+
+def _ffn(lp, hn, args, mesh, rules, decode):
+    """Shared-core MoE FFN; shared-expert-only when num_local_experts == 0."""
+    if args.moe is not None:
+        return moe_block(lp, args, hn, mesh, rules, jax.nn.silu, decode=decode)
+    b, t, hdim = hn.shape
+    x = hn.reshape(b * t, hdim)
+    shared = (jax.nn.silu(x @ lp["shared_wg"]) * (x @ lp["shared_wu"])
+              ) @ lp["shared_wd"]
+    return shared.reshape(b, t, hdim).astype(hn.dtype)
+
+
+def _forward(params, args: GraniteMoeHybridArchArgs, h, cos, sin, mask, cache,
+             positions, bucket, last_token_idx, mesh, rules):
+    ks, vs, convs, ssms = [], [], [], []
+    ai = mi = 0
+    rm = args.residual_multiplier
+    for li, kind in enumerate(args.layer_kinds):
+        lp = params["layers"][li]
+        hn = rms_norm(h, lp["ln1"], args.rms_norm_eps)
+        if kind == "attention":
+            out, kc, vc = _attn(lp, hn, cos, sin, mask, cache["k"][ai],
+                                cache["v"][ai], positions, bucket, args)
+            ks.append(kc)
+            vs.append(vc)
+            ai += 1
+        elif positions is None:
+            out, conv_state, ssm_state = _mixer_prefill(lp, hn, last_token_idx,
+                                                        args)
+            convs.append(conv_state)
+            ssms.append(ssm_state)
+            mi += 1
+        else:
+            out, conv_state, ssm_state = _mixer_decode(
+                lp, hn, cache["conv"][mi], cache["ssm"][mi], args)
+            convs.append(conv_state)
+            ssms.append(ssm_state)
+            mi += 1
+        h = h + out * rm
+        hn = rms_norm(h, lp["ln2"], args.rms_norm_eps)
+        h = h + _ffn(lp, hn, args, mesh, rules,
+                     decode=positions is not None) * rm
+    h = rms_norm(h, params["final_norm"], args.rms_norm_eps)
+    out_cache = {"k": jnp.stack(ks) if ks else cache["k"],
+                 "v": jnp.stack(vs) if vs else cache["v"],
+                 "conv": jnp.stack(convs) if convs else cache["conv"],
+                 "ssm": jnp.stack(ssms) if ssms else cache["ssm"]}
+    return h, out_cache
+
+
+def prefill_forward(params, args: GraniteMoeHybridArchArgs, input_ids,
+                    position_ids, last_token_idx, cache, mesh=None, rules=None,
+                    use_flash=False, adapter_ids=None, use_ring=False,
+                    return_hidden=False):
+    h = jnp.take(params["embed"], input_ids, axis=0)
+    h = h * jnp.asarray(args.embedding_multiplier, h.dtype)
+    t = input_ids.shape[1]
+    cos, sin = rope_ops.compute_cos_sin(params["rope_inv_freq"], position_ids)
+    mask = (position_ids[:, None, :, None] >= position_ids[:, None, None, :])
+    mask &= causal_mask(t, t)[None, None]
+    h, out_cache = _forward(params, args, h, cos, sin, mask, cache, None, None,
+                            last_token_idx, mesh, rules)
+    h_last = jnp.take_along_axis(h, last_token_idx[:, None, None], axis=1)[:, 0]
+    head = params["lm_head"] if "lm_head" in params else params["embed"].T
+    logits = (h_last @ head).astype(jnp.float32) * args.logits_scale
+    if return_hidden:
+        return logits, out_cache, h
+    return logits, out_cache
+
+
+def decode_forward(params, args: GraniteMoeHybridArchArgs, input_ids,
+                   position_ids, cache, decode_bucket, mesh=None, rules=None,
+                   adapter_ids=None, tree=None, return_hidden=False,
+                   **_ignored):
+    if input_ids.shape[1] != 1 or tree is not None:
+        raise ValueError("GraniteMoeHybrid decode is single-token only")
+    h = jnp.take(params["embed"], input_ids, axis=0)
+    h = h * jnp.asarray(args.embedding_multiplier, h.dtype)
+    cos, sin = rope_ops.compute_cos_sin(params["rope_inv_freq"],
+                                        position_ids[:, None])
+    kv_pos = jnp.arange(decode_bucket)[None, None, None, :]
+    mask = kv_pos <= position_ids[:, None, None, None]
+    h, out_cache = _forward(params, args, h, cos, sin, mask, cache,
+                            position_ids, decode_bucket, None, mesh, rules)
+    head = params["lm_head"] if "lm_head" in params else params["embed"].T
+    logits = (h @ head).astype(jnp.float32) * args.logits_scale
+    if return_hidden:
+        return logits, out_cache, h
+    return logits, out_cache
+
+
+class GraniteMoeHybridInferenceConfig(InferenceConfig):
+    REQUIRED_ATTRIBUTES = ("hidden_size", "num_hidden_layers",
+                           "num_attention_heads", "num_key_value_heads",
+                           "vocab_size", "intermediate_size",
+                           "mamba_n_heads", "mamba_d_state")
+
+    def add_derived_config(self) -> None:
+        for attr, default in (("rope_theta", 10000.0), ("rms_norm_eps", 1e-5),
+                              ("mamba_d_conv", 4), ("mamba_expand", 2),
+                              ("mamba_n_groups", 1),
+                              ("num_local_experts", 0),
+                              ("num_experts_per_tok", 0),
+                              ("shared_intermediate_size", 0),
+                              ("embedding_multiplier", 1.0),
+                              ("attention_multiplier", 1.0),
+                              ("residual_multiplier", 1.0),
+                              ("logits_scaling", 1.0),
+                              ("position_embedding_type", None),
+                              ("tie_word_embeddings", False)):
+            if not hasattr(self, attr) or getattr(self, attr) is None:
+                if default is not None or not hasattr(self, attr):
+                    setattr(self, attr, default)
+        if not hasattr(self, "head_dim") or self.head_dim is None:
+            self.head_dim = self.hidden_size // self.num_attention_heads
+        if not getattr(self, "layers_block_type", None):
+            # HF serializes layers_block_type under `layer_types`
+            self.layers_block_type = (getattr(self, "layer_types", None)
+                                      or ["mamba"] * self.num_hidden_layers)
+        if getattr(self, "attention_bias", False):
+            raise ValueError("GraniteMoeHybrid attention_bias=True is not "
+                             "ported (released checkpoints are bias-free)")
+
+
+class GraniteMoeHybridForCausalLM(BambaForCausalLM):
+    def __init__(self, model_path, config, mesh=None):
+        self._require_base_layout(config.tpu_config,
+                                  "GraniteMoeHybrid (mamba2/attention/MoE)")
+        TpuModelForCausalLM.__init__(self, model_path, config, mesh=mesh)
+
+    @classmethod
+    def get_config_cls(cls):
+        return GraniteMoeHybridInferenceConfig
+
+    @classmethod
+    def arch_args_from_config(cls, config) -> GraniteMoeHybridArchArgs:
+        d_inner = int(config.mamba_expand * config.hidden_size)
+        moe = None
+        if int(config.num_local_experts):
+            moe = MoEArgs(
+                num_experts=int(config.num_local_experts),
+                experts_per_tok=int(config.num_experts_per_tok),
+                router_mode="topk_softmax",
+                shared_expert_intermediate_size=int(
+                    config.shared_intermediate_size),
+                shared_expert_gated=False)
+        return GraniteMoeHybridArchArgs(
+            vocab_size=config.vocab_size,
+            hidden_size=config.hidden_size,
+            num_layers=config.num_hidden_layers,
+            num_heads=config.num_attention_heads,
+            num_kv_heads=config.num_key_value_heads,
+            head_dim=config.head_dim,
+            intermediate_size=config.intermediate_size,
+            rms_norm_eps=config.rms_norm_eps,
+            embedding_multiplier=float(config.embedding_multiplier),
+            tie_word_embeddings=bool(config.tie_word_embeddings),
+            moe=moe,
+            d_inner=d_inner,
+            d_state=int(config.mamba_d_state),
+            d_conv=int(config.mamba_d_conv),
+            ssd_heads=int(config.mamba_n_heads),
+            ssd_head_dim=int(d_inner // config.mamba_n_heads),
+            n_groups=int(config.mamba_n_groups),
+            layer_kinds=tuple(config.layers_block_type),
+            # full-width rotation; NoPE rides a zero inv-freq table
+            rotary_dim=int(config.head_dim),
+            attention_scale=float(config.attention_multiplier),
+            residual_multiplier=float(config.residual_multiplier),
+            logits_scale=1.0 / float(config.logits_scaling),
+        )
+
+    def prefill_fn(self):
+        return prefill_forward
+
+    def decode_fn(self):
+        return decode_forward
+
+    @classmethod
+    def inv_freq_from_config(cls, config) -> np.ndarray:
+        if config.position_embedding_type == "rope":
+            return rope_ops.default_inv_freq(config.head_dim,
+                                             float(config.rope_theta))
+        return np.zeros((config.head_dim // 2,), np.float32)
+
+    @classmethod
+    def convert_hf_state_dict(cls, state_dict: Dict[str, np.ndarray],
+                              config) -> Dict:
+        def get(name):
+            if name not in state_dict:
+                raise KeyError(f"missing weight {name}")
+            return np.asarray(state_dict[name])
+
+        def lin_t(name):
+            return np.ascontiguousarray(get(name).T)
+
+        I, S = config.intermediate_size, config.shared_intermediate_size
+        layers = []
+        for i, kind in enumerate(config.layers_block_type):
+            p = f"model.layers.{i}."
+            sm = p + "shared_mlp."
+            fused = get(sm + "input_linear.weight")                 # (2S, H)
+            lp = {
+                "ln1": get(p + "input_layernorm.weight"),
+                "ln2": get(p + "post_attention_layernorm.weight"),
+                "shared_wg": np.ascontiguousarray(fused[:S, :].T),
+                "shared_wu": np.ascontiguousarray(fused[S:, :].T),
+                "shared_wd": lin_t(sm + "output_linear.weight"),
+            }
+            if config.num_local_experts:
+                mo = p + "block_sparse_moe."
+                ef = get(mo + "input_linear.weight")                # (E, 2I, H)
+                lp.update({
+                    "router": lin_t(mo + "router.layer.weight"),
+                    "wg": np.ascontiguousarray(
+                        ef[:, :I, :].transpose(0, 2, 1)),
+                    "wu": np.ascontiguousarray(
+                        ef[:, I:, :].transpose(0, 2, 1)),
+                    "wd": np.ascontiguousarray(
+                        get(mo + "output_linear.weight").transpose(0, 2, 1)),
+                })
+            if kind == "attention":
+                lp.update({
+                    "wq": lin_t(p + "self_attn.q_proj.weight"),
+                    "wk": lin_t(p + "self_attn.k_proj.weight"),
+                    "wv": lin_t(p + "self_attn.v_proj.weight"),
+                    "wo": lin_t(p + "self_attn.o_proj.weight"),
+                })
+            else:
+                mx = p + "mamba."
+                lp.update({
+                    "in_proj": lin_t(mx + "in_proj.weight"),
+                    "conv_w": np.ascontiguousarray(
+                        get(mx + "conv1d.weight")[:, 0, :].T),
+                    "conv_b": get(mx + "conv1d.bias"),
+                    "dt_bias": get(mx + "dt_bias"),
+                    "a_log": get(mx + "A_log"),
+                    "d_skip": get(mx + "D"),
+                    "gate_norm": get(mx + "norm.weight"),
+                    "out_proj": lin_t(mx + "out_proj.weight"),
+                })
+            layers.append(lp)
+        out = {
+            "embed": get("model.embed_tokens.weight"),
+            "layers": layers,
+            "final_norm": get("model.norm.weight"),
+            "rope_inv_freq": cls.inv_freq_from_config(config),
+        }
+        if not config.tie_word_embeddings:
+            out["lm_head"] = lin_t("lm_head.weight")
+        return out

@@ -1,0 +1,29 @@
+"""VaultGemma (Google DP-trained gemma) on the TPU framework (contrib port).
+
+≈ reference contrib gemma family. Gemma-2 architecture (zero-centered norms,
+soft-caps, sliding/full pattern, query_pre_attn_scalar scaling) WITHOUT the
+sandwich branch norms — `VaultGemmaDecoderLayer` keeps only input_layernorm
+and pre_feedforward_layernorm. Conversion is inherited: gemma2's converter
+detects the absent sandwich-norm weights.
+"""
+
+import dataclasses
+
+from contrib.models.gemma2.src.modeling_gemma2 import (Gemma2ForCausalLM,
+                                                       Gemma2InferenceConfig)
+from neuronx_distributed_inference_tpu.models.base import ModelArchArgs
+
+
+class VaultGemmaInferenceConfig(Gemma2InferenceConfig):
+    pass
+
+
+class VaultGemmaForCausalLM(Gemma2ForCausalLM):
+    @classmethod
+    def get_config_cls(cls):
+        return VaultGemmaInferenceConfig
+
+    @classmethod
+    def arch_args_from_config(cls, config) -> ModelArchArgs:
+        return dataclasses.replace(super().arch_args_from_config(config),
+                                   sandwich_norms=False)

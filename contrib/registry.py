@@ -55,6 +55,9 @@ CONTRIB_MODELS = {
     "gpt_bigcode": "contrib.models.gpt_bigcode.src.modeling_gpt_bigcode:GPTBigCodeForCausalLM",
     "granitemoeshared": "contrib.models.granitemoeshared.src.modeling_granitemoeshared:GraniteMoeSharedForCausalLM",
     "falcon_mamba": "contrib.models.falcon_mamba.src.modeling_falcon_mamba:FalconMambaForCausalLM",
+    "bamba": "contrib.models.bamba.src.modeling_bamba:BambaForCausalLM",
+    "vaultgemma": "contrib.models.vaultgemma.src.modeling_vaultgemma:VaultGemmaForCausalLM",
+    "granitemoehybrid": "contrib.models.granitemoehybrid.src.modeling_granitemoehybrid:GraniteMoeHybridForCausalLM",
 }
 
 for model_type, path in CONTRIB_MODELS.items():

@@ -56,7 +56,8 @@ def test_registry_resolves_contrib_models():
                "ernie4_5", "exaone4", "gptj", "gpt_neo", "codegen",
                "olmo", "olmoe", "mamba", "jamba", "persimmon", "xglm",
                "seed_oss", "minimax", "apertus", "mamba2", "falcon_h1", "glm4",
-               "gpt_bigcode", "granitemoeshared", "falcon_mamba"):
+               "gpt_bigcode", "granitemoeshared", "falcon_mamba", "bamba",
+               "vaultgemma", "granitemoehybrid"):
         assert get_model_cls(mt) is not None
 
 
@@ -985,3 +986,72 @@ def test_falcon_mamba_parity():
     torch.manual_seed(0)
     hf = HFFalconMamba(cfg).eval()
     _run_parity(FalconMambaForCausalLM, hf, cfg, atol=2e-3, rtol=1e-3)
+
+
+def test_bamba_parity():
+    """Bamba: sequential mamba2/attention hybrid — SSD mixer layers and
+    partial-rotary GQA attention layers alternate per layers_block_type,
+    each followed by a dense gated MLP."""
+    from transformers import BambaConfig, BambaForCausalLM as HFBamba
+
+    from contrib.models.bamba.src.modeling_bamba import BambaForCausalLM
+
+    cfg = BambaConfig(vocab_size=256, hidden_size=32, num_hidden_layers=3,
+                      num_attention_heads=4, num_key_value_heads=2,
+                      intermediate_size=64, mamba_n_heads=8, mamba_d_head=8,
+                      mamba_n_groups=2, mamba_d_state=8, mamba_d_conv=4,
+                      mamba_expand=2, attn_layer_indices=[1],
+                      partial_rotary_factor=0.5, rope_theta=10000.0,
+                      tie_word_embeddings=False, pad_token_id=0)
+    torch.manual_seed(0)
+    hf = HFBamba(cfg).eval()
+    _run_parity(BambaForCausalLM, hf, cfg, atol=2e-3, rtol=1e-3)
+
+
+def test_vaultgemma_parity():
+    """VaultGemma: gemma2 without the sandwich branch norms."""
+    from transformers import VaultGemmaConfig, VaultGemmaForCausalLM as HFVg
+
+    from contrib.models.vaultgemma.src.modeling_vaultgemma import (
+        VaultGemmaForCausalLM)
+
+    cfg = VaultGemmaConfig(vocab_size=256, hidden_size=64,
+                           num_hidden_layers=2, num_attention_heads=4,
+                           num_key_value_heads=2, intermediate_size=128,
+                           head_dim=16, query_pre_attn_scalar=16,
+                           sliding_window=8, attn_logit_softcapping=50.0,
+                           final_logit_softcapping=30.0,
+                           layer_types=["sliding_attention", "full_attention"],
+                           hidden_activation="gelu_pytorch_tanh",
+                           pad_token_id=0, tie_word_embeddings=True)
+    torch.manual_seed(0)
+    hf = HFVg(cfg).eval()
+    # eos_token_id=1: HF generate stops at VaultGemma's default eos and pads
+    _run_parity(VaultGemmaForCausalLM, hf, cfg, atol=2e-3, rtol=1e-3,
+                eos_token_id=1)
+
+
+def test_granitemoehybrid_parity():
+    """GraniteMoeHybrid (granite-4.0 h-family): bamba-style mamba2/attention
+    layers, each ending in topk_softmax MoE + ungated shared expert, with
+    granite multipliers and NoPE attention."""
+    from transformers import (GraniteMoeHybridConfig,
+                              GraniteMoeHybridForCausalLM as HFGmh)
+
+    from contrib.models.granitemoehybrid.src.modeling_granitemoehybrid import (
+        GraniteMoeHybridForCausalLM)
+
+    cfg = GraniteMoeHybridConfig(
+        vocab_size=256, hidden_size=32, num_hidden_layers=3,
+        layers_block_type=["mamba", "attention", "mamba"],
+        num_attention_heads=4, num_key_value_heads=2, intermediate_size=64,
+        shared_intermediate_size=48, num_local_experts=4,
+        num_experts_per_tok=2, mamba_n_heads=8, mamba_d_head=8,
+        mamba_n_groups=2, mamba_d_state=8, mamba_d_conv=4, mamba_expand=2,
+        embedding_multiplier=2.0, attention_multiplier=0.3,
+        residual_multiplier=0.8, logits_scaling=1.5,
+        position_embedding_type=None, attention_bias=False,
+        tie_word_embeddings=False, pad_token_id=0)
+    torch.manual_seed(0)
+    hf = HFGmh(cfg).eval()
+    _run_parity(GraniteMoeHybridForCausalLM, hf, cfg, atol=2e-3, rtol=1e-3)
