@@ -1,0 +1,206 @@
+"""OpenAI GPT (GPT-1) on the TPU framework (contrib port).
+
+≈ reference contrib gpt lineage. The one TRUE post-LN decoder in the hub:
+LayerNorm is applied to the residual SUM (`Block.forward`: n = ln_1(x + attn),
+h = ln_2(n + mlp)), which the shared core's branch-norm modes (olmo2/exaone4
+style) cannot express — so this family carries a compact custom forward.
+Learned positions, fused Conv1D c_attn (no transpose), tanh-gelu MLP (HF's
+ACT_FNS maps afn="gelu" to gelu_new), no final norm, tied head.
+"""
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neuronx_distributed_inference_tpu.config import InferenceConfig
+from neuronx_distributed_inference_tpu.models.base import (
+    ModelArchArgs, causal_mask)
+from neuronx_distributed_inference_tpu.ops.attention import attend
+from neuronx_distributed_inference_tpu.ops.norms import layer_norm
+from neuronx_distributed_inference_tpu.runtime.application import (
+    TpuModelForCausalLM)
+
+
+def _attn(lp, h, mask, k_cache, v_cache, positions, bucket, args):
+    b, t, hd = h.shape
+    qkv = h @ lp["c_attn"] + lp["c_attn_b"]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(b, t, args.num_heads, args.head_dim).transpose(0, 2, 1, 3)
+    k = k.reshape(b, t, args.num_heads, args.head_dim).transpose(0, 2, 1, 3)
+    v = v.reshape(b, t, args.num_heads, args.head_dim).transpose(0, 2, 1, 3)
+    if positions is None:
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k.astype(k_cache.dtype), (0, 0, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v.astype(v_cache.dtype), (0, 0, 0, 0))
+        k_att, v_att = k, v
+    else:
+        def _one(row_c, row_n, p):
+            return jax.lax.dynamic_update_slice(
+                row_c, row_n.astype(row_c.dtype), (0, p, 0))
+
+        k_cache = jax.vmap(_one)(k_cache, k, positions)
+        v_cache = jax.vmap(_one)(v_cache, v, positions)
+        k_att = jax.lax.slice_in_dim(k_cache, 0, bucket, axis=2).astype(q.dtype)
+        v_att = jax.lax.slice_in_dim(v_cache, 0, bucket, axis=2).astype(q.dtype)
+    attn = attend(q, k_att, v_att, mask=mask)
+    attn = attn.transpose(0, 2, 1, 3).reshape(b, t, hd)
+    return attn @ lp["c_proj"] + lp["c_proj_b"], k_cache, v_cache
+
+
+def _forward(params, args, h, mask, cache, positions, bucket):
+    eps = args.rms_norm_eps
+    ks, vs = [], []
+    for li in range(args.num_layers):
+        lp = jax.tree.map(lambda p: p[li], params["layers"])
+        a, kc, vc = _attn(lp, h, mask, cache["k"][li], cache["v"][li],
+                          positions, bucket, args)
+        ks.append(kc)
+        vs.append(vc)
+        n = layer_norm(h + a, lp["ln1"], lp["ln1_b"], eps)  # post-LN on SUM
+        m = (jax.nn.gelu(n @ lp["c_fc"] + lp["c_fc_b"], approximate=True)
+             @ lp["c_mlp_proj"]) + lp["c_mlp_proj_b"]
+        h = layer_norm(n + m, lp["ln2"], lp["ln2_b"], eps)
+    return h, {"k": jnp.stack(ks), "v": jnp.stack(vs)}
+
+
+def prefill_forward(params, args, input_ids, position_ids, last_token_idx,
+                    cache, mesh=None, rules=None, use_flash=False,
+                    adapter_ids=None, use_ring=False, return_hidden=False):
+    h = (jnp.take(params["embed"], input_ids, axis=0)
+         + jnp.take(params["pos_embed"], position_ids, axis=0))
+    t = input_ids.shape[1]
+    mask = (position_ids[:, None, :, None] >= position_ids[:, None, None, :])
+    mask &= causal_mask(t, t)[None, None]
+    h, out_cache = _forward(params, args, h, mask, cache, None, None)
+    h_last = jnp.take_along_axis(h, last_token_idx[:, None, None], axis=1)[:, 0]
+    logits = (h_last @ params["embed"].T).astype(jnp.float32)
+    if return_hidden:
+        return logits, out_cache, h
+    return logits, out_cache
+
+
+def decode_forward(params, args, input_ids, position_ids, cache, decode_bucket,
+                   mesh=None, rules=None, adapter_ids=None, tree=None,
+                   return_hidden=False, **_ignored):
+    if input_ids.shape[1] != 1 or tree is not None:
+        raise ValueError("GPT-1 decode is single-token only")
+    h = (jnp.take(params["embed"], input_ids, axis=0)
+         + jnp.take(params["pos_embed"], position_ids[:, None], axis=0))
+    kv_pos = jnp.arange(decode_bucket)[None, None, None, :]
+    mask = kv_pos <= position_ids[:, None, None, None]
+    h, out_cache = _forward(params, args, h, mask, cache, position_ids,
+                            decode_bucket)
+    logits = (h @ params["embed"].T).astype(jnp.float32)
+    if return_hidden:
+        return logits, out_cache, h
+    return logits, out_cache
+
+
+class OpenAIGPTInferenceConfig(InferenceConfig):
+    REQUIRED_ATTRIBUTES = ("n_embd", "n_layer", "n_head", "vocab_size",
+                           "n_positions")
+
+    def add_derived_config(self) -> None:
+        for attr, default in (("layer_norm_epsilon", 1e-5), ("afn", "gelu")):
+            if not hasattr(self, attr) or getattr(self, attr) is None:
+                setattr(self, attr, default)
+        if self.afn != "gelu":
+            raise ValueError(f"GPT-1 activation {self.afn!r} is not ported")
+
+
+class OpenAIGPTForCausalLM(TpuModelForCausalLM):
+    def __init__(self, model_path, config, mesh=None):
+        self._require_base_layout(config.tpu_config, "GPT-1 (post-LN)")
+        super().__init__(model_path, config, mesh=mesh)
+
+    @classmethod
+    def get_config_cls(cls):
+        return OpenAIGPTInferenceConfig
+
+    @classmethod
+    def arch_args_from_config(cls, config) -> ModelArchArgs:
+        h = config.n_embd
+        return ModelArchArgs(
+            vocab_size=config.vocab_size,
+            hidden_size=h,
+            num_layers=config.n_layer,
+            num_heads=config.n_head,
+            num_kv_heads=config.n_head,
+            head_dim=h // config.n_head,
+            intermediate_size=4 * h,
+            rms_norm_eps=config.layer_norm_epsilon,
+            learned_pos=True,
+            tie_word_embeddings=True,
+        )
+
+    def prefill_fn(self):
+        return prefill_forward
+
+    def decode_fn(self):
+        return decode_forward
+
+    @classmethod
+    def inv_freq_from_config(cls, config) -> np.ndarray:
+        return np.zeros(((config.n_embd // config.n_head) // 2,), np.float32)
+
+    def reset_cache(self, batch_size: Optional[int] = None) -> None:
+        a = self.arch_args
+        b = batch_size or self.tpu_config.max_batch_size
+        dt = self.tpu_config.jax_dtype
+        self.kv_cache = {
+            "k": jnp.zeros((a.num_layers, b, a.num_heads,
+                            self.tpu_config.seq_len, a.head_dim), dt),
+            "v": jnp.zeros((a.num_layers, b, a.num_heads,
+                            self.tpu_config.seq_len, a.head_dim), dt),
+        }
+
+    def _put_params(self, host_params) -> None:
+        dtype = self.tpu_config.jax_dtype
+
+        def _put(x):
+            arr = np.asarray(x)
+            if arr.dtype.kind == "f":
+                arr = arr.astype(dtype)
+            return jax.device_put(arr)
+
+        self.params = jax.tree.map(_put, host_params)
+        self.reset_cache()
+
+    def init_random_params(self, key):
+        raise NotImplementedError("load from an HF checkpoint or state dict")
+
+    @classmethod
+    def convert_hf_state_dict(cls, state_dict: Dict[str, np.ndarray],
+                              config) -> Dict:
+        def get(name):
+            if name not in state_dict:
+                raise KeyError(f"missing weight {name}")
+            return np.asarray(state_dict[name])
+
+        layers = {k: [] for k in ("c_attn", "c_attn_b", "c_proj", "c_proj_b",
+                                  "ln1", "ln1_b", "c_fc", "c_fc_b",
+                                  "c_mlp_proj", "c_mlp_proj_b", "ln2", "ln2_b")}
+        for i in range(config.n_layer):
+            p = f"transformer.h.{i}."
+            # HF Conv1D stores (in, out): no transpose needed
+            layers["c_attn"].append(get(p + "attn.c_attn.weight"))
+            layers["c_attn_b"].append(get(p + "attn.c_attn.bias"))
+            layers["c_proj"].append(get(p + "attn.c_proj.weight"))
+            layers["c_proj_b"].append(get(p + "attn.c_proj.bias"))
+            layers["ln1"].append(get(p + "ln_1.weight"))
+            layers["ln1_b"].append(get(p + "ln_1.bias"))
+            layers["c_fc"].append(get(p + "mlp.c_fc.weight"))
+            layers["c_fc_b"].append(get(p + "mlp.c_fc.bias"))
+            layers["c_mlp_proj"].append(get(p + "mlp.c_proj.weight"))
+            layers["c_mlp_proj_b"].append(get(p + "mlp.c_proj.bias"))
+            layers["ln2"].append(get(p + "ln_2.weight"))
+            layers["ln2_b"].append(get(p + "ln_2.bias"))
+        return {
+            "embed": get("transformer.tokens_embed.weight"),
+            "pos_embed": get("transformer.positions_embed.weight"),
+            "layers": {k: np.stack(v) for k, v in layers.items()},
+            "rope_inv_freq": cls.inv_freq_from_config(config),
+        }

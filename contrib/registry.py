@@ -58,6 +58,7 @@ CONTRIB_MODELS = {
     "bamba": "contrib.models.bamba.src.modeling_bamba:BambaForCausalLM",
     "vaultgemma": "contrib.models.vaultgemma.src.modeling_vaultgemma:VaultGemmaForCausalLM",
     "granitemoehybrid": "contrib.models.granitemoehybrid.src.modeling_granitemoehybrid:GraniteMoeHybridForCausalLM",
+    "openai-gpt": "contrib.models.openai_gpt.src.modeling_openai_gpt:OpenAIGPTForCausalLM",
 }
 
 for model_type, path in CONTRIB_MODELS.items():

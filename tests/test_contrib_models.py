@@ -57,7 +57,7 @@ def test_registry_resolves_contrib_models():
                "olmo", "olmoe", "mamba", "jamba", "persimmon", "xglm",
                "seed_oss", "minimax", "apertus", "mamba2", "falcon_h1", "glm4",
                "gpt_bigcode", "granitemoeshared", "falcon_mamba", "bamba",
-               "vaultgemma", "granitemoehybrid"):
+               "vaultgemma", "granitemoehybrid", "openai-gpt"):
         assert get_model_cls(mt) is not None
 
 
@@ -1055,3 +1055,19 @@ def test_granitemoehybrid_parity():
     torch.manual_seed(0)
     hf = HFGmh(cfg).eval()
     _run_parity(GraniteMoeHybridForCausalLM, hf, cfg, atol=2e-3, rtol=1e-3)
+
+
+def test_openai_gpt_parity():
+    """GPT-1: true post-LN (LayerNorm on the residual SUM), learned positions,
+    no final norm — the custom-forward post-LN representative."""
+    from transformers import OpenAIGPTConfig, OpenAIGPTLMHeadModel
+
+    from contrib.models.openai_gpt.src.modeling_openai_gpt import (
+        OpenAIGPTForCausalLM)
+
+    cfg = OpenAIGPTConfig(vocab_size=256, n_positions=128, n_embd=64,
+                          n_layer=2, n_head=4, afn="gelu",
+                          resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0)
+    torch.manual_seed(0)
+    hf = OpenAIGPTLMHeadModel(cfg).eval()
+    _run_parity(OpenAIGPTForCausalLM, hf, cfg)
