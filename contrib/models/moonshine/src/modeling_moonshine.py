@@ -1,0 +1,411 @@
+"""Moonshine (UsefulSensors streaming ASR) on the TPU framework (contrib
+port).
+
+≈ reference whisper integration pattern (separate encoder/decoder instances)
+applied to Moonshine: a RAW-WAVEFORM conv stem (conv k=127 s=64 → tanh →
+1-group GroupNorm → two gelu convs) instead of whisper's mel frontend, rotary
+(partial, theta-scaled by rotary width) self-attention in BOTH encoder and
+decoder, rope-free cross-attention with precomputed encoder K/V, weight-only
+LayerNorms, bias-free attention projections, and a gated-silu decoder MLP
+(fc1 → [hidden | gate] → silu(gate)·hidden → fc2). Greedy loop and KV-cache
+layout mirror models/whisper. Audio batches must be unpadded (no
+attention-mask support), matching the reference whisper port's contract.
+"""
+
+import functools
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neuronx_distributed_inference_tpu.config import (InferenceConfig,
+                                                      TpuConfig)
+from neuronx_distributed_inference_tpu.modules import kvcache
+from neuronx_distributed_inference_tpu.ops.attention import attend
+from neuronx_distributed_inference_tpu.ops.norms import layer_norm
+
+
+def _ln(x, w, eps=1e-5):
+    return layer_norm(x, w, jnp.zeros_like(w), eps=eps)
+
+
+def _rot_half(x):
+    half = x.shape[-1] // 2
+    return jnp.concatenate([-x[..., half:], x[..., :half]], axis=-1)
+
+
+def _rope(q, k, cos, sin):
+    """Partial rotary over the first cos.shape[-1] dims (HF moonshine
+    `apply_rotary_pos_emb`: rotary_dim taken from the cos table width)."""
+    rd = cos.shape[-1]
+    cos, sin = cos[None, None, :, :], sin[None, None, :, :]
+    qr, qp = q[..., :rd].astype(jnp.float32), q[..., rd:]
+    kr, kp = k[..., :rd].astype(jnp.float32), k[..., rd:]
+    qr = qr * cos + _rot_half(qr) * sin
+    kr = kr * cos + _rot_half(kr) * sin
+    q = jnp.concatenate([qr.astype(q.dtype), qp], axis=-1)
+    k = jnp.concatenate([kr.astype(k.dtype), kp], axis=-1)
+    return q, k
+
+
+def _cos_sin(inv_freq, positions):
+    freqs = positions[:, None].astype(jnp.float32) * inv_freq[None, :]
+    emb = jnp.concatenate([freqs, freqs], axis=-1)
+    return jnp.cos(emb), jnp.sin(emb)
+
+
+def _heads(x, heads):
+    b, s, hdim = x.shape
+    return x.reshape(b, s, heads, hdim // heads).transpose(0, 2, 1, 3)
+
+
+def encode(params, input_values, *, heads: int):
+    """(B, T_audio) raw waveform -> (B, T', H) encoder states."""
+    dn = ("NCH", "OIH", "NCH")
+    x = input_values[:, None, :]                            # (B, 1, T)
+    x = jax.lax.conv_general_dilated(x, params["conv1_w"], (64,), "VALID",
+                                     dimension_numbers=dn)
+    x = jnp.tanh(x)
+    # GroupNorm(1 group): normalize over (C, T) jointly, per-channel affine
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=(1, 2), keepdims=True)
+    var = jnp.var(x32, axis=(1, 2), keepdims=True)
+    x32 = (x32 - mu) * jax.lax.rsqrt(var + 1e-5)
+    x = (x32 * params["gn_w"][None, :, None]
+         + params["gn_b"][None, :, None]).astype(x.dtype)
+    x = jax.lax.conv_general_dilated(x, params["conv2_w"], (3,), "VALID",
+                                     dimension_numbers=dn)
+    x = jax.nn.gelu(x + params["conv2_b"][None, :, None], approximate=False)
+    x = jax.lax.conv_general_dilated(x, params["conv3_w"], (2,), "VALID",
+                                     dimension_numbers=dn)
+    x = jax.nn.gelu(x + params["conv3_b"][None, :, None], approximate=False)
+    h = x.transpose(0, 2, 1)                                # (B, T', H)
+
+    cos, sin = _cos_sin(params["inv_freq"], jnp.arange(h.shape[1]))
+
+    def body(hid, lp):
+        hn = _ln(hid, lp["ln1"])
+        q = _heads(hn @ lp["attn_wq"], heads)
+        k = _heads(hn @ lp["attn_wk"], heads)
+        v = _heads(hn @ lp["attn_wv"], heads)
+        q, k = _rope(q, k, cos, sin)
+        a = attend(q, k, v)
+        a = a.transpose(0, 2, 1, 3).reshape(hid.shape)
+        hid = hid + a @ lp["attn_wo"]
+        hn = _ln(hid, lp["ln2"])
+        hid = hid + (jax.nn.gelu(hn @ lp["fc1"] + lp["b1"], approximate=False)
+                     @ lp["fc2"] + lp["b2"])
+        return hid, None
+
+    h, _ = jax.lax.scan(body, h, params["layers"])
+    return _ln(h, params["ln_post"])
+
+
+def compute_cross_kv(dec_params, enc_states, heads: int):
+    """Precompute per-decoder-layer rope-free cross K/V from the encoder."""
+    def one(lp):
+        k = _heads(enc_states @ lp["xattn_wk"], heads)
+        v = _heads(enc_states @ lp["xattn_wv"], heads)
+        return k, v
+
+    return jax.vmap(one)(dec_params["layers"])
+
+
+def decoder_forward(params, input_ids, position_ids, cache,
+                    decode_bucket: Optional[int], *, heads: int):
+    b, t = input_ids.shape
+    pos_grid = position_ids[:, None] + jnp.arange(t)[None, :]
+    h = jnp.take(params["embed"], input_ids, axis=0)
+
+    if decode_bucket is None:
+        mask = pos_grid[:, None, :, None] >= pos_grid[:, None, None, :]
+    else:
+        kv_pos = jnp.arange(decode_bucket)[None, None, None, :]
+        mask = kv_pos <= pos_grid[:, None, :, None]
+    # rope tables are position-dependent per row; decode is single-position
+    cos, sin = _cos_sin(params["inv_freq"], pos_grid[0])
+
+    def body(carry_h, xs):
+        lp, kc, vc, xk, xv = xs
+        hn = _ln(carry_h, lp["ln1"])
+        q = _heads(hn @ lp["attn_wq"], heads)
+        k = _heads(hn @ lp["attn_wk"], heads)
+        v = _heads(hn @ lp["attn_wv"], heads)
+        q, k = _rope(q, k, cos, sin)
+        if decode_bucket is None:
+            kc = kvcache.write_prefill(kc, k)
+            vc = kvcache.write_prefill(vc, v)
+            k_att, v_att = k, v
+        else:
+            kc = kvcache.write_decode(kc, k, position_ids)
+            vc = kvcache.write_decode(vc, v, position_ids)
+            k_att = kvcache.read_bucket(kc, decode_bucket)
+            v_att = kvcache.read_bucket(vc, decode_bucket)
+        a = attend(q, k_att, v_att, mask=mask)
+        carry_h = carry_h + a.transpose(0, 2, 1, 3).reshape(b, t, -1) @ lp["attn_wo"]
+
+        hn = _ln(carry_h, lp["xln"])
+        q = _heads(hn @ lp["xattn_wq"], heads)
+        xo = attend(q, xk, xv)
+        carry_h = carry_h + xo.transpose(0, 2, 1, 3).reshape(b, t, -1) @ lp["xattn_wo"]
+
+        hn = _ln(carry_h, lp["ln2"])
+        inter = hn @ lp["fc1"] + lp["b1"]
+        hid, gate = jnp.split(inter, 2, axis=-1)
+        carry_h = carry_h + (jax.nn.silu(gate) * hid) @ lp["fc2"] + lp["b2"]
+        return carry_h, (kc, vc)
+
+    xs = (params["layers"], cache["k"], cache["v"], cache["xk"], cache["xv"])
+    h, (k_new, v_new) = jax.lax.scan(body, h, xs)
+    h = _ln(h, params["ln_post"])
+    logits = (h @ params["proj_out"]).astype(jnp.float32)
+    return logits, dict(cache, k=k_new, v=v_new)
+
+
+class MoonshineInferenceConfig(InferenceConfig):
+    REQUIRED_ATTRIBUTES = ("hidden_size", "intermediate_size",
+                           "encoder_num_hidden_layers",
+                           "decoder_num_hidden_layers",
+                           "encoder_num_attention_heads",
+                           "decoder_num_attention_heads", "vocab_size")
+
+    def add_derived_config(self) -> None:
+        for attr, default in (("rope_theta", 10000.0),
+                              ("partial_rotary_factor", 0.9),
+                              ("decoder_start_token_id", 1),
+                              ("eos_token_id", 2)):
+            if not hasattr(self, attr) or getattr(self, attr) is None:
+                setattr(self, attr, default)
+        if not hasattr(self, "head_dim") or self.head_dim is None:
+            self.head_dim = (self.hidden_size
+                             // self.decoder_num_attention_heads)
+        for a, b in (("encoder_num_key_value_heads",
+                      "encoder_num_attention_heads"),
+                     ("decoder_num_key_value_heads",
+                      "decoder_num_attention_heads")):
+            if getattr(self, a, None) not in (None, getattr(self, b)):
+                raise ValueError(f"Moonshine GQA ({a}) is not ported — "
+                                 "released checkpoints use MHA")
+
+
+class MoonshineForConditionalGeneration:
+    """Raw-audio encoder + token decoder (whisper-style application)."""
+
+    def __init__(self, model_path: Optional[str],
+                 config: MoonshineInferenceConfig):
+        self.model_path = model_path
+        self.config = config
+        self.tpu_config: TpuConfig = config.tpu_config
+        self.enc_params = None
+        self.dec_params = None
+        enc_heads = config.encoder_num_attention_heads
+        dec_heads = config.decoder_num_attention_heads
+        self._encode = jax.jit(functools.partial(encode, heads=enc_heads))
+        self._cross_kv = jax.jit(
+            functools.partial(compute_cross_kv, heads=dec_heads))
+
+        def _prefill(dec_params, input_ids, position_ids, cache):
+            return decoder_forward(dec_params, input_ids, position_ids, cache,
+                                   None, heads=dec_heads)
+
+        def _decode_chunk(dec_params, tok0, position_ids, cache, decode_bucket,
+                          num_steps):
+            def body(carry, _):
+                tok, pos, cache = carry
+                logits, cache = decoder_forward(dec_params, tok[:, None], pos,
+                                                cache, decode_bucket,
+                                                heads=dec_heads)
+                nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+                return (nxt, pos + 1, cache), nxt
+
+            (_, _, cache), toks = jax.lax.scan(
+                body, (tok0, position_ids, cache), None, length=num_steps)
+            return toks.T, cache
+
+        self._prefill = jax.jit(_prefill, donate_argnums=(3,))
+        self._decode_chunk = jax.jit(_decode_chunk, donate_argnums=(3,),
+                                     static_argnames=("decode_bucket",
+                                                      "num_steps"))
+
+    @classmethod
+    def get_config_cls(cls):
+        return MoonshineInferenceConfig
+
+    @classmethod
+    def inv_freq_from_config(cls, config) -> np.ndarray:
+        rd = int(config.head_dim * float(config.partial_rotary_factor))
+        return (1.0 / float(config.rope_theta)
+                ** (np.arange(0, rd, 2, dtype=np.float32) / rd))
+
+    @classmethod
+    def convert_hf_state_dict(cls, state_dict,
+                              config) -> Tuple[Dict, Dict]:
+        def get(name):
+            if name not in state_dict:
+                raise KeyError(f"missing weight {name}")
+            return np.asarray(state_dict[name])
+
+        def lin_t(name):
+            return np.ascontiguousarray(get(name).T)
+
+        def attn(prefix, out_prefix, out):
+            out.update({
+                out_prefix + "wq": lin_t(prefix + "q_proj.weight"),
+                out_prefix + "wk": lin_t(prefix + "k_proj.weight"),
+                out_prefix + "wv": lin_t(prefix + "v_proj.weight"),
+                out_prefix + "wo": lin_t(prefix + "o_proj.weight"),
+            })
+
+        def stack(dicts):
+            return {k: np.stack([x[k] for x in dicts]) for k in dicts[0]}
+
+        inv_freq = cls.inv_freq_from_config(config)
+        enc_layers = []
+        for i in range(config.encoder_num_hidden_layers):
+            p = f"model.encoder.layers.{i}."
+            lp = {
+                "ln1": get(p + "input_layernorm.weight"),
+                "ln2": get(p + "post_attention_layernorm.weight"),
+                "fc1": lin_t(p + "mlp.fc1.weight"),
+                "b1": get(p + "mlp.fc1.bias"),
+                "fc2": lin_t(p + "mlp.fc2.weight"),
+                "b2": get(p + "mlp.fc2.bias"),
+            }
+            attn(p + "self_attn.", "attn_", lp)
+            enc_layers.append(lp)
+        enc = {
+            "conv1_w": get("model.encoder.conv1.weight"),
+            "gn_w": get("model.encoder.groupnorm.weight"),
+            "gn_b": get("model.encoder.groupnorm.bias"),
+            "conv2_w": get("model.encoder.conv2.weight"),
+            "conv2_b": get("model.encoder.conv2.bias"),
+            "conv3_w": get("model.encoder.conv3.weight"),
+            "conv3_b": get("model.encoder.conv3.bias"),
+            "layers": stack(enc_layers),
+            "ln_post": get("model.encoder.layer_norm.weight"),
+            "inv_freq": inv_freq,
+        }
+
+        dec_layers = []
+        for i in range(config.decoder_num_hidden_layers):
+            p = f"model.decoder.layers.{i}."
+            lp = {
+                "ln1": get(p + "input_layernorm.weight"),
+                "xln": get(p + "post_attention_layernorm.weight"),
+                "ln2": get(p + "final_layernorm.weight"),
+                "fc1": lin_t(p + "mlp.fc1.weight"),
+                "b1": get(p + "mlp.fc1.bias"),
+                "fc2": lin_t(p + "mlp.fc2.weight"),
+                "b2": get(p + "mlp.fc2.bias"),
+            }
+            attn(p + "self_attn.", "attn_", lp)
+            attn(p + "encoder_attn.", "xattn_", lp)
+            dec_layers.append(lp)
+        embed = get("model.decoder.embed_tokens.weight")
+        dec = {
+            "embed": embed,
+            "layers": stack(dec_layers),
+            "ln_post": get("model.decoder.norm.weight"),
+            # tied checkpoints drop proj_out.weight from the serialized dict
+            "proj_out": (lin_t("proj_out.weight")
+                         if "proj_out.weight" in state_dict
+                         else np.ascontiguousarray(embed.T)),
+            "inv_freq": inv_freq,
+        }
+        return enc, dec
+
+    def load_from_state_dict(self, state_dict) -> None:
+        enc, dec = self.convert_hf_state_dict(state_dict, self.config)
+        dtype = self.tpu_config.jax_dtype
+
+        def _put(path, x):
+            arr = np.asarray(x)
+            last = getattr(path[-1], "key", None) if path else None
+            if arr.dtype.kind == "f" and last != "inv_freq":
+                arr = arr.astype(dtype)
+            return jax.device_put(arr)
+
+        self.enc_params = jax.tree_util.tree_map_with_path(_put, enc)
+        self.dec_params = jax.tree_util.tree_map_with_path(_put, dec)
+
+    def load(self, model_path: Optional[str] = None) -> None:
+        from neuronx_distributed_inference_tpu.utils import checkpoint as ckpt
+
+        self.load_from_state_dict(
+            ckpt.load_state_dict(model_path or self.model_path))
+
+    @classmethod
+    def from_pretrained(cls, model_path: str, tpu_config: TpuConfig):
+        from neuronx_distributed_inference_tpu.config import (
+            load_pretrained_config)
+
+        config = MoonshineInferenceConfig(
+            tpu_config, load_config=load_pretrained_config(model_path))
+        app = cls(model_path, config)
+        app.load()
+        return app
+
+    def _init_cache(self, b: int, t_enc: int):
+        c = self.config
+        heads = c.decoder_num_attention_heads
+        d = c.hidden_size // heads
+        L = c.decoder_num_hidden_layers
+        S = self.tpu_config.seq_len
+        dtype = self.tpu_config.jax_dtype
+        return {
+            "k": jnp.zeros((L, b, heads, S, d), dtype=dtype),
+            "v": jnp.zeros((L, b, heads, S, d), dtype=dtype),
+            "xk": jnp.zeros((L, b, heads, t_enc, d), dtype=dtype),
+            "xv": jnp.zeros((L, b, heads, t_enc, d), dtype=dtype),
+        }
+
+    def generate(self, input_values: np.ndarray,
+                 decoder_input_ids: Optional[np.ndarray] = None,
+                 max_new_tokens: int = 64,
+                 eos_token_id: Optional[int] = None) -> np.ndarray:
+        """Greedy transcription of raw waveforms: (B, prompt + generated)."""
+        if self.enc_params is None:
+            raise RuntimeError("load weights before generate")
+        audio = np.asarray(input_values, dtype=np.float32)
+        b = audio.shape[0]
+        if decoder_input_ids is None:
+            decoder_input_ids = np.full(
+                (b, 1), self.config.decoder_start_token_id, dtype=np.int32)
+        ids = np.asarray(decoder_input_ids, dtype=np.int32)
+        enc_states = self._encode(self.enc_params, audio)
+        xk, xv = self._cross_kv(self.dec_params, enc_states)
+        cache = self._init_cache(b, enc_states.shape[1])
+        cache["xk"], cache["xv"] = xk, xv
+
+        pos0 = np.zeros((b,), dtype=np.int32)
+        logits, cache = self._prefill(self.dec_params, ids, pos0, cache)
+        tok = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+
+        out = [ids, np.asarray(tok)[:, None]]
+        n_done, pos = 1, ids.shape[1]
+        chunk = max(1, self.tpu_config.decode_chunk_size)
+        eos = (eos_token_id if eos_token_id is not None
+               else self.config.eos_token_id)
+        eos_done = np.zeros((b,), dtype=bool)
+        while n_done < max_new_tokens:
+            steps = min(chunk, max_new_tokens - n_done,
+                        self.tpu_config.seq_len - pos)
+            if steps <= 0:
+                break
+            positions = np.full((b,), pos, dtype=np.int32)
+            bucket = min(self.tpu_config.seq_len,
+                         1 << (pos + steps).bit_length())
+            toks, cache = self._decode_chunk(self.dec_params, tok, positions,
+                                             cache, decode_bucket=bucket,
+                                             num_steps=steps)
+            toks_np = np.asarray(toks)
+            out.append(toks_np)
+            tok = toks[:, -1]
+            pos += steps
+            n_done += steps
+            if eos is not None:
+                eos_done |= (toks_np == eos).any(axis=1)
+                if eos_done.all():
+                    break
+        return np.concatenate(out, axis=1)

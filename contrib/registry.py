@@ -59,6 +59,7 @@ CONTRIB_MODELS = {
     "vaultgemma": "contrib.models.vaultgemma.src.modeling_vaultgemma:VaultGemmaForCausalLM",
     "granitemoehybrid": "contrib.models.granitemoehybrid.src.modeling_granitemoehybrid:GraniteMoeHybridForCausalLM",
     "openai-gpt": "contrib.models.openai_gpt.src.modeling_openai_gpt:OpenAIGPTForCausalLM",
+    "moonshine": "contrib.models.moonshine.src.modeling_moonshine:MoonshineForConditionalGeneration",
 }
 
 for model_type, path in CONTRIB_MODELS.items():
