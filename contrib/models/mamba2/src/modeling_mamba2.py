@@ -34,6 +34,10 @@ class Mamba2ArchArgs(ModelArchArgs):
     n_groups: int = 8
     dt_min: float = 0.0
     dt_max: float = float("inf")
+    # zamba2: grouped gated-norm variance (HF Zamba2RMSNormGated group_size);
+    # 1 = HF Mamba2's ungrouped MambaRMSNormGated
+    gate_norm_groups: int = 1
+    gate_norm_eps: Optional[float] = None
 
     @property
     def conv_dim(self) -> int:
@@ -135,9 +139,19 @@ def _mixer_decode(lp, hn, conv_state, ssm_state, args):
 
 
 def _gated_norm(lp, y, z, args):
-    """Gated RMSNorm: norm(y * silu(z)) * w (HF MambaRMSNormGated)."""
+    """Gated RMSNorm: norm(y * silu(z)) * w (HF MambaRMSNormGated); variance
+    per ``gate_norm_groups`` groups (Zamba2RMSNormGated) when > 1."""
+    eps = (args.gate_norm_eps if args.gate_norm_eps is not None
+           else args.rms_norm_eps)
     y = y * jax.nn.silu(z.astype(jnp.float32))
-    return rms_norm(y, lp["gate_norm"], args.rms_norm_eps).astype(
+    g = args.gate_norm_groups
+    if g == 1:
+        return rms_norm(y, lp["gate_norm"], eps).astype(lp["out_proj"].dtype)
+    *lead, dim = y.shape
+    yg = y.reshape(*lead, g, dim // g)
+    var = jnp.mean(jnp.square(yg), axis=-1, keepdims=True)
+    y = (yg * jax.lax.rsqrt(var + eps)).reshape(*lead, dim)
+    return (lp["gate_norm"].astype(jnp.float32) * y).astype(
         lp["out_proj"].dtype)
 
 

@@ -1,0 +1,337 @@
+"""Zamba2 (Zyphra shared-block hybrid) on the TPU framework (contrib port).
+
+≈ reference contrib hybrid family. Every layer runs a mamba2 SSD mixer; at
+the ``hybrid_layer_ids`` positions ONE shared transformer block (attention +
+gated-gelu MLP, weights tied across all invocations) first processes
+concat(h, h0) — h0 being the embedding output — with per-invocation LoRA
+adapters on the MLP's gate_up projection restoring expressivity, and its
+output rides a per-layer linear into the mamba input (Zamba2 paper eq. 6;
+HF `Zamba2HybridLayer`). Attention spans the doubled width (scale
+(head_dim/2)^-0.5) and is rope-free unless ``use_mem_rope``; a zero
+inv-freq table makes the rotation an identity when disabled. The mixer math
+(with Zamba2's grouped gated norm, eps 1e-5) comes from contrib/models/mamba2.
+"""
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from contrib.models.mamba2.src.modeling_mamba2 import (Mamba2ArchArgs,
+                                                       _mixer_decode,
+                                                       _mixer_prefill)
+from neuronx_distributed_inference_tpu.config import InferenceConfig
+from neuronx_distributed_inference_tpu.models.base import (
+    ModelArchArgs, causal_mask)
+from neuronx_distributed_inference_tpu.ops import rope as rope_ops
+from neuronx_distributed_inference_tpu.ops.attention import attend
+from neuronx_distributed_inference_tpu.ops.norms import rms_norm
+from neuronx_distributed_inference_tpu.runtime.application import (
+    TpuModelForCausalLM)
+
+
+@dataclass(frozen=True)
+class Zamba2ArchArgs(Mamba2ArchArgs):
+    layer_kinds: Tuple[str, ...] = ()
+
+
+def _shared_block(params, hi, h, h0, cos, sin, mask, k_cache, v_cache,
+                  positions, bucket, args):
+    """One invocation of the tied transformer block at hybrid index ``hi``:
+    concat(h, h0) → ln → attention (2H wide) → ln → MLP+LoRA → per-layer
+    linear. No residuals inside (HF `Zamba2AttentionDecoderLayer`)."""
+    sp = params["shared"]
+    b, t, _ = h.shape
+    x = jnp.concatenate([h, h0], axis=-1)
+    xn = rms_norm(x, sp["ln1"], args.rms_norm_eps)
+    q = (xn @ sp["wq"]).reshape(b, t, args.num_heads, args.head_dim
+                                ).transpose(0, 2, 1, 3)
+    k = (xn @ sp["wk"]).reshape(b, t, args.num_heads, args.head_dim
+                                ).transpose(0, 2, 1, 3)
+    v = (xn @ sp["wv"]).reshape(b, t, args.num_heads, args.head_dim
+                                ).transpose(0, 2, 1, 3)
+    q, k = rope_ops.apply_rotary(q, k, cos, sin)
+    if positions is None:
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k.astype(k_cache.dtype), (0, 0, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v.astype(v_cache.dtype), (0, 0, 0, 0))
+        k_att, v_att = k, v
+    else:
+        def _one(row_c, row_n, p):
+            return jax.lax.dynamic_update_slice(
+                row_c, row_n.astype(row_c.dtype), (0, p, 0))
+
+        k_cache = jax.vmap(_one)(k_cache, k, positions)
+        v_cache = jax.vmap(_one)(v_cache, v, positions)
+        k_att = jax.lax.slice_in_dim(k_cache, 0, bucket, axis=2).astype(q.dtype)
+        v_att = jax.lax.slice_in_dim(v_cache, 0, bucket, axis=2).astype(q.dtype)
+    a = attend(q, k_att, v_att, mask=mask, scale=(args.head_dim / 2) ** -0.5)
+    a = a.transpose(0, 2, 1, 3).reshape(b, t, -1) @ sp["wo"]
+
+    hn = rms_norm(a, sp["ln2"], args.rms_norm_eps)
+    gu = hn @ sp["gate_up"] + (hn @ params["adapter_a"][hi]
+                               ) @ params["adapter_b"][hi]
+    gate, up = jnp.split(gu, 2, axis=-1)
+    mlp = (jax.nn.gelu(gate, approximate=False) * up) @ sp["down"]
+    return mlp @ params["linear"][hi], k_cache, v_cache
+
+
+def _forward(params, args: Zamba2ArchArgs, h, cos, sin, mask, cache, positions,
+             bucket, last_token_idx):
+    h0 = h
+    ks, vs, convs, ssms = [], [], [], []
+    hi = 0
+    for li, kind in enumerate(args.layer_kinds):
+        lp = params["layers"][li]
+        if kind == "hybrid":
+            t_states, kc, vc = _shared_block(
+                params, hi, h, h0, cos, sin, mask, cache["k"][hi],
+                cache["v"][hi], positions, bucket, args)
+            ks.append(kc)
+            vs.append(vc)
+            hi += 1
+        else:
+            t_states = 0.0
+        resid = h
+        hn = rms_norm(h + t_states, lp["ln1"], args.rms_norm_eps)
+        if positions is None:
+            out, conv_state, ssm_state = _mixer_prefill(lp, hn, last_token_idx,
+                                                        args)
+        else:
+            out, conv_state, ssm_state = _mixer_decode(
+                lp, hn, cache["conv"][li], cache["ssm"][li], args)
+        convs.append(conv_state)
+        ssms.append(ssm_state)
+        h = resid + out
+    h = rms_norm(h, params["final_norm"], args.rms_norm_eps)
+    out_cache = {"k": jnp.stack(ks) if ks else cache["k"],
+                 "v": jnp.stack(vs) if vs else cache["v"],
+                 "conv": jnp.stack(convs), "ssm": jnp.stack(ssms)}
+    return h, out_cache
+
+
+def prefill_forward(params, args: Zamba2ArchArgs, input_ids, position_ids,
+                    last_token_idx, cache, mesh=None, rules=None, use_flash=False,
+                    adapter_ids=None, use_ring=False, return_hidden=False):
+    h = jnp.take(params["embed"], input_ids, axis=0)
+    t = input_ids.shape[1]
+    cos, sin = rope_ops.compute_cos_sin(params["rope_inv_freq"], position_ids)
+    mask = (position_ids[:, None, :, None] >= position_ids[:, None, None, :])
+    mask &= causal_mask(t, t)[None, None]
+    h, out_cache = _forward(params, args, h, cos, sin, mask, cache, None, None,
+                            last_token_idx)
+    h_last = jnp.take_along_axis(h, last_token_idx[:, None, None], axis=1)[:, 0]
+    head = params["lm_head"] if "lm_head" in params else params["embed"].T
+    logits = (h_last @ head).astype(jnp.float32)
+    if return_hidden:
+        return logits, out_cache, h
+    return logits, out_cache
+
+
+def decode_forward(params, args: Zamba2ArchArgs, input_ids, position_ids, cache,
+                   decode_bucket, mesh=None, rules=None, adapter_ids=None,
+                   tree=None, return_hidden=False, **_ignored):
+    if input_ids.shape[1] != 1 or tree is not None:
+        raise ValueError("Zamba2 decode is single-token only")
+    h = jnp.take(params["embed"], input_ids, axis=0)
+    cos, sin = rope_ops.compute_cos_sin(params["rope_inv_freq"],
+                                        position_ids[:, None])
+    kv_pos = jnp.arange(decode_bucket)[None, None, None, :]
+    mask = kv_pos <= position_ids[:, None, None, None]
+    h, out_cache = _forward(params, args, h, cos, sin, mask, cache,
+                            position_ids, decode_bucket, None)
+    head = params["lm_head"] if "lm_head" in params else params["embed"].T
+    logits = (h @ head).astype(jnp.float32)
+    if return_hidden:
+        return logits, out_cache, h
+    return logits, out_cache
+
+
+class Zamba2InferenceConfig(InferenceConfig):
+    REQUIRED_ATTRIBUTES = ("hidden_size", "num_hidden_layers",
+                           "num_attention_heads", "vocab_size",
+                           "intermediate_size", "n_mamba_heads",
+                           "mamba_d_state", "hybrid_layer_ids")
+
+    def add_derived_config(self) -> None:
+        for attr, default in (("rope_theta", 10000.0), ("rms_norm_eps", 1e-5),
+                              ("mamba_d_conv", 4), ("mamba_expand", 2),
+                              ("mamba_ngroups", 1), ("adapter_rank", 128),
+                              ("use_mem_rope", False),
+                              ("num_mem_blocks", 1),
+                              ("use_shared_attention_adapter", False),
+                              ("tie_word_embeddings", True)):
+            if not hasattr(self, attr) or getattr(self, attr) is None:
+                setattr(self, attr, default)
+        if not hasattr(self, "attention_head_dim") or \
+                self.attention_head_dim is None:
+            self.attention_head_dim = (2 * self.hidden_size
+                                       // self.num_attention_heads)
+        if not getattr(self, "layers_block_type", None):
+            hyb = set(self.hybrid_layer_ids)
+            self.layers_block_type = ["hybrid" if i in hyb else "mamba"
+                                      for i in range(self.num_hidden_layers)]
+        if int(self.num_mem_blocks) != 1:
+            raise ValueError("Zamba2 num_mem_blocks > 1 is not ported "
+                             "(released checkpoints use one shared block)")
+        if getattr(self, "use_shared_attention_adapter", False):
+            raise ValueError("Zamba2 use_shared_attention_adapter=True is "
+                             "not ported")
+        if getattr(self, "add_bias_linear", False):
+            raise ValueError("Zamba2 add_bias_linear=True is not ported")
+        if getattr(self, "hidden_act", "gelu") != "gelu":
+            raise ValueError(f"Zamba2 hidden_act={self.hidden_act!r} is not "
+                             "ported (shared block uses exact gelu)")
+        kvh = getattr(self, "num_key_value_heads", None)
+        if kvh is not None and kvh != self.num_attention_heads:
+            raise ValueError("Zamba2 GQA (num_key_value_heads < "
+                             "num_attention_heads) is not ported")
+
+
+class Zamba2ForCausalLM(TpuModelForCausalLM):
+    def __init__(self, model_path, config, mesh=None):
+        self._require_base_layout(config.tpu_config,
+                                  "Zamba2 (shared-block hybrid)")
+        super().__init__(model_path, config, mesh=mesh)
+
+    @classmethod
+    def get_config_cls(cls):
+        return Zamba2InferenceConfig
+
+    @classmethod
+    def arch_args_from_config(cls, config) -> Zamba2ArchArgs:
+        d_inner = int(config.mamba_expand * config.hidden_size)
+        return Zamba2ArchArgs(
+            vocab_size=config.vocab_size,
+            hidden_size=config.hidden_size,
+            num_layers=config.num_hidden_layers,
+            num_heads=config.num_attention_heads,
+            num_kv_heads=config.num_attention_heads,
+            head_dim=int(config.attention_head_dim),
+            intermediate_size=config.intermediate_size,
+            rms_norm_eps=config.rms_norm_eps,
+            tie_word_embeddings=bool(config.tie_word_embeddings),
+            d_inner=d_inner,
+            d_state=int(config.mamba_d_state),
+            d_conv=int(config.mamba_d_conv),
+            ssd_heads=int(config.n_mamba_heads),
+            ssd_head_dim=int(d_inner // config.n_mamba_heads),
+            n_groups=int(config.mamba_ngroups),
+            gate_norm_groups=int(config.mamba_ngroups),
+            gate_norm_eps=1e-5,
+            layer_kinds=tuple(config.layers_block_type),
+        )
+
+    def prefill_fn(self):
+        return prefill_forward
+
+    def decode_fn(self):
+        return decode_forward
+
+    @classmethod
+    def inv_freq_from_config(cls, config) -> np.ndarray:
+        if config.use_mem_rope:
+            return rope_ops.default_inv_freq(int(config.attention_head_dim),
+                                             float(config.rope_theta))
+        # rope disabled: identity rotation via a zero frequency table
+        return np.zeros((int(config.attention_head_dim) // 2,), np.float32)
+
+    def reset_cache(self, batch_size: Optional[int] = None) -> None:
+        a: Zamba2ArchArgs = self.arch_args
+        b = batch_size or self.tpu_config.max_batch_size
+        dt = self.tpu_config.jax_dtype
+        n_hyb = sum(1 for k in a.layer_kinds if k == "hybrid")
+        self.kv_cache = {
+            "k": jnp.zeros((n_hyb, b, a.num_heads,
+                            self.tpu_config.seq_len, a.head_dim), dt),
+            "v": jnp.zeros((n_hyb, b, a.num_heads,
+                            self.tpu_config.seq_len, a.head_dim), dt),
+            "conv": jnp.zeros((a.num_layers, b, a.d_conv, a.conv_dim), dt),
+            "ssm": jnp.zeros((a.num_layers, b, a.ssd_heads, a.ssd_head_dim,
+                              a.d_state), jnp.float32),
+        }
+
+    def _put_params(self, host_params) -> None:
+        dtype = self.tpu_config.jax_dtype
+        fp32_keys = {"a_log", "d_skip", "dt_bias"}
+
+        def _put(path, x):
+            arr = np.asarray(x)
+            last = getattr(path[-1], "key", None) if path else None
+            if arr.dtype.kind == "f":
+                arr = arr.astype(np.float32 if last in fp32_keys else dtype)
+            return jax.device_put(arr)
+
+        self.params = jax.tree_util.tree_map_with_path(_put, host_params)
+        self.reset_cache()
+
+    def init_random_params(self, key):
+        raise NotImplementedError("load from an HF checkpoint or state dict")
+
+    @classmethod
+    def convert_hf_state_dict(cls, state_dict: Dict[str, np.ndarray],
+                              config) -> Dict:
+        def get(name):
+            if name not in state_dict:
+                raise KeyError(f"missing weight {name}")
+            return np.asarray(state_dict[name])
+
+        def lin_t(name):
+            return np.ascontiguousarray(get(name).T)
+
+        hyb_ids = [i for i, k in enumerate(config.layers_block_type)
+                   if k == "hybrid"]
+        first = hyb_ids[0]
+        st = f"model.layers.{first}.shared_transformer."
+        shared = {
+            "ln1": get(st + "input_layernorm.weight"),
+            "wq": lin_t(st + "self_attn.q_proj.weight"),
+            "wk": lin_t(st + "self_attn.k_proj.weight"),
+            "wv": lin_t(st + "self_attn.v_proj.weight"),
+            "wo": lin_t(st + "self_attn.o_proj.weight"),
+            "ln2": get(st + "pre_ff_layernorm.weight"),
+            "gate_up": lin_t(st + "feed_forward.gate_up_proj.weight"),
+            "down": lin_t(st + "feed_forward.down_proj.weight"),
+        }
+        # per-invocation LoRA adapters live on the (tied) shared module
+        ad = st + "feed_forward.gate_up_proj_adapter_list."
+        adapter_a = np.stack([lin_t(f"{ad}{j}.0.weight")
+                              for j in range(len(hyb_ids))])
+        adapter_b = np.stack([lin_t(f"{ad}{j}.1.weight")
+                              for j in range(len(hyb_ids))])
+        linear = np.stack([lin_t(f"model.layers.{i}.linear.weight")
+                           for i in hyb_ids])
+
+        layers = []
+        for i, kind in enumerate(config.layers_block_type):
+            p = f"model.layers.{i}."
+            mx = (p + "mamba_decoder." if kind == "hybrid" else p)
+            lp = {
+                "ln1": get(mx + "input_layernorm.weight"),
+                "in_proj": lin_t(mx + "mamba.in_proj.weight"),
+                "conv_w": np.ascontiguousarray(
+                    get(mx + "mamba.conv1d.weight")[:, 0, :].T),
+                "conv_b": get(mx + "mamba.conv1d.bias"),
+                "dt_bias": get(mx + "mamba.dt_bias"),
+                "a_log": get(mx + "mamba.A_log"),
+                "d_skip": get(mx + "mamba.D"),
+                "gate_norm": get(mx + "mamba.norm.weight"),
+                "out_proj": lin_t(mx + "mamba.out_proj.weight"),
+            }
+            layers.append(lp)
+        out = {
+            "embed": get("model.embed_tokens.weight"),
+            "shared": shared,
+            "adapter_a": adapter_a,
+            "adapter_b": adapter_b,
+            "linear": linear,
+            "layers": layers,
+            "final_norm": get("model.final_layernorm.weight"),
+            "rope_inv_freq": cls.inv_freq_from_config(config),
+        }
+        if not config.tie_word_embeddings:
+            out["lm_head"] = lin_t("lm_head.weight")
+        return out

@@ -60,6 +60,7 @@ CONTRIB_MODELS = {
     "granitemoehybrid": "contrib.models.granitemoehybrid.src.modeling_granitemoehybrid:GraniteMoeHybridForCausalLM",
     "openai-gpt": "contrib.models.openai_gpt.src.modeling_openai_gpt:OpenAIGPTForCausalLM",
     "moonshine": "contrib.models.moonshine.src.modeling_moonshine:MoonshineForConditionalGeneration",
+    "zamba2": "contrib.models.zamba2.src.modeling_zamba2:Zamba2ForCausalLM",
 }
 
 for model_type, path in CONTRIB_MODELS.items():

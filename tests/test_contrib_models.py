@@ -57,7 +57,8 @@ def test_registry_resolves_contrib_models():
                "olmo", "olmoe", "mamba", "jamba", "persimmon", "xglm",
                "seed_oss", "minimax", "apertus", "mamba2", "falcon_h1", "glm4",
                "gpt_bigcode", "granitemoeshared", "falcon_mamba", "bamba",
-               "vaultgemma", "granitemoehybrid", "openai-gpt", "moonshine"):
+               "vaultgemma", "granitemoehybrid", "openai-gpt", "moonshine",
+               "zamba2"):
         assert get_model_cls(mt) is not None
 
 
@@ -1112,3 +1113,28 @@ def test_moonshine_parity():
                              max_new_tokens=8, do_sample=False,
                              eos_token_id=-1, pad_token_id=0)
     np.testing.assert_array_equal(out, hf_out.numpy())
+
+
+def test_zamba2_parity():
+    """Zamba2: mamba2 backbone with ONE shared transformer block invoked at
+    hybrid positions on concat(h, h0), per-invocation MLP LoRA adapters, and
+    a per-layer linear feeding the block output into the mamba input."""
+    from transformers import Zamba2Config, Zamba2ForCausalLM as HFZamba2
+
+    from contrib.models.zamba2.src.modeling_zamba2 import Zamba2ForCausalLM
+
+    cfg = Zamba2Config(vocab_size=256, hidden_size=32, num_hidden_layers=4,
+                       hybrid_layer_ids=[1, 3],
+                       layers_block_type=["mamba", "hybrid", "mamba",
+                                          "hybrid"],
+                       num_attention_heads=4, num_key_value_heads=4,
+                       attention_head_dim=16, intermediate_size=64,
+                       num_mem_blocks=1, adapter_rank=4, mamba_d_state=8,
+                       mamba_d_conv=4, mamba_expand=2, n_mamba_heads=4,
+                       mamba_headdim=16, mamba_ngroups=2, use_mem_rope=True,
+                       use_shared_attention_adapter=False,
+                       max_position_embeddings=128, pad_token_id=0,
+                       tie_word_embeddings=True)
+    torch.manual_seed(0)
+    hf = HFZamba2(cfg).eval()
+    _run_parity(Zamba2ForCausalLM, hf, cfg, atol=2e-3, rtol=1e-3)
