@@ -1,0 +1,397 @@
+"""Zamba v1 (Zyphra shared-block hybrid, mamba1 backbone) on the TPU
+framework (contrib port).
+
+≈ reference contrib hybrid family. Zamba2's macro-structure — every layer a
+mamba mixer, with ONE tied transformer block invoked at the hybrid positions
+over concat(h, h0) and fed back through a per-layer linear — but with the
+first-generation pieces: a MULTI-HEAD mamba1 selective-SSM mixer (per-head
+x_proj/dt_proj, HF `ZambaMambaMixer.slow_forward`; prefill redesigned as an
+associative scan over the diagonal recurrence), a shared block without LoRA
+adapters (separate gate/up gated MLP), and NoPE attention at scale
+(head_dim/2)^-0.5 over the doubled width.
+"""
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neuronx_distributed_inference_tpu.config import InferenceConfig
+from neuronx_distributed_inference_tpu.models.base import (
+    ModelArchArgs, causal_mask)
+from neuronx_distributed_inference_tpu.ops.attention import attend
+from neuronx_distributed_inference_tpu.ops.norms import rms_norm
+from neuronx_distributed_inference_tpu.runtime.application import (
+    TpuModelForCausalLM)
+
+ACTS = {"gelu": lambda x: jax.nn.gelu(x, approximate=False),
+        "gelu_pytorch_tanh": lambda x: jax.nn.gelu(x, approximate=True),
+        "silu": jax.nn.silu, "relu": jax.nn.relu}
+
+
+@dataclass(frozen=True)
+class ZambaArchArgs(ModelArchArgs):
+    layer_kinds: Tuple[str, ...] = ()
+    d_inner: int = 0
+    d_state: int = 16
+    d_conv: int = 4
+    dt_rank: int = 0
+    n_mamba_heads: int = 1
+    hidden_act: str = "gelu"
+
+    @property
+    def mamba_head_dim(self) -> int:
+        return self.d_inner // self.n_mamba_heads
+
+
+def _ssm_terms(lp, xc, args):
+    """Post-conv activations -> (dA, dBu, C) via the per-head projections."""
+    b, t, _ = xc.shape
+    nh, ih, s, r = (args.n_mamba_heads, args.mamba_head_dim, args.d_state,
+                    args.dt_rank)
+    xh = xc.reshape(b, t, nh, ih)
+    pr = jnp.einsum("bthi,hri->bthr", xh, lp["x_proj"])      # (B,T,nh,R+2S)
+    dt_r, b_m, c_m = pr[..., :r], pr[..., r : r + s], pr[..., r + s :]
+    delta = jax.nn.softplus(
+        (jnp.einsum("bthr,hir->bthi", dt_r, lp["dt_proj"])
+         + lp["dt_bias"][None, None]).astype(jnp.float32))   # (B,T,nh,Ih)
+    a = -jnp.exp(lp["a_log"].astype(jnp.float32)).reshape(args.d_inner, s)
+    d_a = jnp.exp(delta.reshape(b, t, args.d_inner)[..., None]
+                  * a[None, None])                           # (B,T,I,S)
+    d_bu = (delta[..., None] * b_m[:, :, :, None, :].astype(jnp.float32)
+            * xh.astype(jnp.float32)[..., None]
+            ).reshape(b, t, args.d_inner, s)
+    return d_a, d_bu, c_m.astype(jnp.float32)
+
+
+def _finish(lp, h_states, xc, z, args, shape):
+    """C-contraction + D skip + silu(z) gate + out projection."""
+    b, t = shape
+    nh, ih = args.n_mamba_heads, args.mamba_head_dim
+    c_m = h_states[1]
+    y = jnp.einsum("bthis,bths->bthi",
+                   h_states[0].reshape(b, t, nh, ih, args.d_state), c_m)
+    y = y.reshape(b, t, args.d_inner)
+    y = y + xc.astype(jnp.float32) * lp["d_skip"].astype(
+        jnp.float32).reshape(args.d_inner)[None, None]
+    y = y * jax.nn.silu(z.astype(jnp.float32))
+    return y.astype(lp["out_proj"].dtype) @ lp["out_proj"]
+
+
+def _mixer_prefill(lp, hn, last_token_idx, args):
+    b, t, _ = hn.shape
+    w = args.d_conv
+    proj = hn @ lp["in_proj"]                 # de-interleaved: [x(I) | z(I)]
+    x, z = proj[..., : args.d_inner], proj[..., args.d_inner :]
+
+    idx = last_token_idx[:, None] + 1 - w + jnp.arange(w)[None, :]
+    gathered = jnp.take_along_axis(x, jnp.clip(idx, 0, t - 1)[:, :, None],
+                                   axis=1)
+    conv_state = jnp.where((idx >= 0)[:, :, None], gathered, 0.0)
+    xp = jnp.pad(x, ((0, 0), (w - 1, 0), (0, 0)))
+    xc = sum(xp[:, j : j + t, :] * lp["conv_w"][j][None, None, :]
+             for j in range(w)) + lp["conv_b"][None, None, :]
+    xc = jax.nn.silu(xc)
+
+    d_a, d_bu, c_m = _ssm_terms(lp, xc, args)
+    valid = (jnp.arange(t)[None, :] <= last_token_idx[:, None])[..., None, None]
+    d_a = jnp.where(valid, d_a, 1.0)
+    d_bu = jnp.where(valid, d_bu, 0.0)
+
+    def comb(l, r):
+        return (r[0] * l[0], r[0] * l[1] + r[1])
+
+    _, h_seq = jax.lax.associative_scan(comb, (d_a, d_bu), axis=1)
+    ssm_state = jnp.take_along_axis(
+        h_seq, last_token_idx[:, None, None, None], axis=1)[:, 0]
+    out = _finish(lp, (h_seq, c_m), xc, z, args, (b, t))
+    return out, conv_state.astype(hn.dtype), ssm_state
+
+
+def _mixer_decode(lp, hn, conv_state, ssm_state, args):
+    b = hn.shape[0]
+    proj = hn @ lp["in_proj"]
+    x, z = proj[..., : args.d_inner], proj[..., args.d_inner :]
+    state = jnp.concatenate([conv_state[:, 1:], x[:, 0][:, None, :]], axis=1)
+    xc = jnp.sum(state * lp["conv_w"][None, :, :], axis=1) + lp["conv_b"]
+    xc = jax.nn.silu(xc)[:, None, :]
+    d_a, d_bu, c_m = _ssm_terms(lp, xc, args)
+    h = d_a[:, 0] * ssm_state + d_bu[:, 0]
+    out = _finish(lp, (h[:, None], c_m), xc, z, args, (b, 1))
+    return out, state.astype(conv_state.dtype), h
+
+
+def _shared_block(params, hi, h, h0, mask, k_cache, v_cache, positions,
+                  bucket, args):
+    """One invocation of the tied transformer block (no internal residuals,
+    no adapters — HF `ZambaAttentionDecoderLayer`)."""
+    sp = params["shared"]
+    b, t, _ = h.shape
+    x = jnp.concatenate([h, h0], axis=-1)
+    xn = rms_norm(x, sp["ln1"], args.rms_norm_eps)
+    q = (xn @ sp["wq"]).reshape(b, t, args.num_heads, args.head_dim
+                                ).transpose(0, 2, 1, 3)
+    k = (xn @ sp["wk"]).reshape(b, t, args.num_heads, args.head_dim
+                                ).transpose(0, 2, 1, 3)
+    v = (xn @ sp["wv"]).reshape(b, t, args.num_heads, args.head_dim
+                                ).transpose(0, 2, 1, 3)
+    if positions is None:
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k.astype(k_cache.dtype), (0, 0, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v.astype(v_cache.dtype), (0, 0, 0, 0))
+        k_att, v_att = k, v
+    else:
+        def _one(row_c, row_n, p):
+            return jax.lax.dynamic_update_slice(
+                row_c, row_n.astype(row_c.dtype), (0, p, 0))
+
+        k_cache = jax.vmap(_one)(k_cache, k, positions)
+        v_cache = jax.vmap(_one)(v_cache, v, positions)
+        k_att = jax.lax.slice_in_dim(k_cache, 0, bucket, axis=2).astype(q.dtype)
+        v_att = jax.lax.slice_in_dim(v_cache, 0, bucket, axis=2).astype(q.dtype)
+    a = attend(q, k_att, v_att, mask=mask, scale=(args.head_dim / 2) ** -0.5)
+    a = a.transpose(0, 2, 1, 3).reshape(b, t, -1) @ sp["wo"]
+
+    hn = rms_norm(a, sp["ln2"], args.rms_norm_eps)
+    act = ACTS[args.hidden_act]
+    mlp = (act(hn @ sp["wg"]) * (hn @ sp["wu"])) @ sp["wd"]
+    return mlp @ params["linear"][hi], k_cache, v_cache
+
+
+def _forward(params, args: ZambaArchArgs, h, mask, cache, positions, bucket,
+             last_token_idx):
+    h0 = h
+    ks, vs, convs, ssms = [], [], [], []
+    hi = 0
+    for li, kind in enumerate(args.layer_kinds):
+        lp = params["layers"][li]
+        if kind == "hybrid":
+            t_states, kc, vc = _shared_block(
+                params, hi, h, h0, mask, cache["k"][hi], cache["v"][hi],
+                positions, bucket, args)
+            ks.append(kc)
+            vs.append(vc)
+            hi += 1
+        else:
+            t_states = 0.0
+        resid = h
+        hn = rms_norm(h + t_states, lp["ln1"], args.rms_norm_eps)
+        if positions is None:
+            out, conv_state, ssm_state = _mixer_prefill(lp, hn, last_token_idx,
+                                                        args)
+        else:
+            out, conv_state, ssm_state = _mixer_decode(
+                lp, hn, cache["conv"][li], cache["ssm"][li], args)
+        convs.append(conv_state)
+        ssms.append(ssm_state)
+        h = resid + out
+    h = rms_norm(h, params["final_norm"], args.rms_norm_eps)
+    out_cache = {"k": jnp.stack(ks) if ks else cache["k"],
+                 "v": jnp.stack(vs) if vs else cache["v"],
+                 "conv": jnp.stack(convs), "ssm": jnp.stack(ssms)}
+    return h, out_cache
+
+
+def prefill_forward(params, args: ZambaArchArgs, input_ids, position_ids,
+                    last_token_idx, cache, mesh=None, rules=None, use_flash=False,
+                    adapter_ids=None, use_ring=False, return_hidden=False):
+    h = jnp.take(params["embed"], input_ids, axis=0)
+    t = input_ids.shape[1]
+    mask = (position_ids[:, None, :, None] >= position_ids[:, None, None, :])
+    mask &= causal_mask(t, t)[None, None]
+    h, out_cache = _forward(params, args, h, mask, cache, None, None,
+                            last_token_idx)
+    h_last = jnp.take_along_axis(h, last_token_idx[:, None, None], axis=1)[:, 0]
+    head = params["lm_head"] if "lm_head" in params else params["embed"].T
+    logits = (h_last @ head).astype(jnp.float32)
+    if return_hidden:
+        return logits, out_cache, h
+    return logits, out_cache
+
+
+def decode_forward(params, args: ZambaArchArgs, input_ids, position_ids, cache,
+                   decode_bucket, mesh=None, rules=None, adapter_ids=None,
+                   tree=None, return_hidden=False, **_ignored):
+    if input_ids.shape[1] != 1 or tree is not None:
+        raise ValueError("Zamba decode is single-token only")
+    h = jnp.take(params["embed"], input_ids, axis=0)
+    kv_pos = jnp.arange(decode_bucket)[None, None, None, :]
+    mask = kv_pos <= position_ids[:, None, None, None]
+    h, out_cache = _forward(params, args, h, mask, cache, position_ids,
+                            decode_bucket, None)
+    head = params["lm_head"] if "lm_head" in params else params["embed"].T
+    logits = (h @ head).astype(jnp.float32)
+    if return_hidden:
+        return logits, out_cache, h
+    return logits, out_cache
+
+
+class ZambaInferenceConfig(InferenceConfig):
+    REQUIRED_ATTRIBUTES = ("hidden_size", "num_hidden_layers",
+                           "num_attention_heads", "vocab_size",
+                           "intermediate_size", "mamba_d_state",
+                           "layers_block_type")
+
+    def add_derived_config(self) -> None:
+        for attr, default in (("rms_norm_eps", 1e-5), ("mamba_d_conv", 4),
+                              ("mamba_expand", 2), ("n_mamba_heads", 1),
+                              ("hidden_act", "gelu"),
+                              ("tie_word_embeddings", True)):
+            if not hasattr(self, attr) or getattr(self, attr) is None:
+                setattr(self, attr, default)
+        if not hasattr(self, "attention_head_dim") or \
+                self.attention_head_dim is None:
+            self.attention_head_dim = (2 * self.hidden_size
+                                       // self.num_attention_heads)
+        if getattr(self, "mamba_dt_rank", None) in (None, "auto"):
+            import math
+            self.mamba_dt_rank = math.ceil(self.hidden_size / 16)
+        kvh = getattr(self, "num_key_value_heads", None)
+        if kvh is not None and kvh != self.num_attention_heads:
+            raise ValueError("Zamba GQA is not ported")
+        if getattr(self, "add_bias_linear", False):
+            raise ValueError("Zamba add_bias_linear=True is not ported")
+        if getattr(self, "hidden_mamba_act", "silu") != "silu":
+            raise ValueError("Zamba hidden_mamba_act must be silu")
+
+
+class ZambaForCausalLM(TpuModelForCausalLM):
+    def __init__(self, model_path, config, mesh=None):
+        self._require_base_layout(config.tpu_config,
+                                  "Zamba (shared-block mamba1 hybrid)")
+        super().__init__(model_path, config, mesh=mesh)
+
+    @classmethod
+    def get_config_cls(cls):
+        return ZambaInferenceConfig
+
+    @classmethod
+    def arch_args_from_config(cls, config) -> ZambaArchArgs:
+        d_inner = int(config.mamba_expand * config.hidden_size)
+        return ZambaArchArgs(
+            vocab_size=config.vocab_size,
+            hidden_size=config.hidden_size,
+            num_layers=config.num_hidden_layers,
+            num_heads=config.num_attention_heads,
+            num_kv_heads=config.num_attention_heads,
+            head_dim=int(config.attention_head_dim),
+            intermediate_size=config.intermediate_size,
+            rms_norm_eps=config.rms_norm_eps,
+            tie_word_embeddings=bool(config.tie_word_embeddings),
+            layer_kinds=tuple(config.layers_block_type),
+            d_inner=d_inner,
+            d_state=int(config.mamba_d_state),
+            d_conv=int(config.mamba_d_conv),
+            dt_rank=int(config.mamba_dt_rank),
+            n_mamba_heads=int(config.n_mamba_heads),
+            hidden_act=str(config.hidden_act),
+        )
+
+    def prefill_fn(self):
+        return prefill_forward
+
+    def decode_fn(self):
+        return decode_forward
+
+    @classmethod
+    def inv_freq_from_config(cls, config) -> np.ndarray:
+        # NoPE: identity rotation table (unused by this family's forward)
+        return np.zeros((int(config.attention_head_dim) // 2,), np.float32)
+
+    def reset_cache(self, batch_size: Optional[int] = None) -> None:
+        a: ZambaArchArgs = self.arch_args
+        b = batch_size or self.tpu_config.max_batch_size
+        dt = self.tpu_config.jax_dtype
+        n_hyb = sum(1 for k in a.layer_kinds if k == "hybrid")
+        self.kv_cache = {
+            "k": jnp.zeros((n_hyb, b, a.num_heads,
+                            self.tpu_config.seq_len, a.head_dim), dt),
+            "v": jnp.zeros((n_hyb, b, a.num_heads,
+                            self.tpu_config.seq_len, a.head_dim), dt),
+            "conv": jnp.zeros((a.num_layers, b, a.d_conv, a.d_inner), dt),
+            "ssm": jnp.zeros((a.num_layers, b, a.d_inner, a.d_state),
+                             jnp.float32),
+        }
+
+    def _put_params(self, host_params) -> None:
+        dtype = self.tpu_config.jax_dtype
+        fp32_keys = {"a_log", "d_skip", "dt_bias"}
+
+        def _put(path, x):
+            arr = np.asarray(x)
+            last = getattr(path[-1], "key", None) if path else None
+            if arr.dtype.kind == "f":
+                arr = arr.astype(np.float32 if last in fp32_keys else dtype)
+            return jax.device_put(arr)
+
+        self.params = jax.tree_util.tree_map_with_path(_put, host_params)
+        self.reset_cache()
+
+    def init_random_params(self, key):
+        raise NotImplementedError("load from an HF checkpoint or state dict")
+
+    @classmethod
+    def convert_hf_state_dict(cls, state_dict: Dict[str, np.ndarray],
+                              config) -> Dict:
+        def get(name):
+            if name not in state_dict:
+                raise KeyError(f"missing weight {name}")
+            return np.asarray(state_dict[name])
+
+        def lin_t(name):
+            return np.ascontiguousarray(get(name).T)
+
+        hyb_ids = [i for i, k in enumerate(config.layers_block_type)
+                   if k == "hybrid"]
+        st = f"model.layers.{hyb_ids[0]}.shared_transf."
+        shared = {
+            "ln1": get(st + "input_layernorm.weight"),
+            "wq": lin_t(st + "self_attn.q_proj.weight"),
+            "wk": lin_t(st + "self_attn.k_proj.weight"),
+            "wv": lin_t(st + "self_attn.v_proj.weight"),
+            "wo": lin_t(st + "self_attn.o_proj.weight"),
+            "ln2": get(st + "pre_ff_layernorm.weight"),
+            "wg": lin_t(st + "feed_forward.gate_proj.weight"),
+            "wu": lin_t(st + "feed_forward.up_proj.weight"),
+            "wd": lin_t(st + "feed_forward.down_proj.weight"),
+        }
+        linear = np.stack([lin_t(f"model.layers.{i}.linear.weight")
+                           for i in hyb_ids])
+
+        layers = []
+        for i, kind in enumerate(config.layers_block_type):
+            p = f"model.layers.{i}."
+            mx = (p + "mamba_decoder." if kind == "hybrid" else p)
+            in_proj = lin_t(mx + "mamba.in_proj.weight")       # (H, 2I)
+            # HF packs x/z channel-pairs interleaved (view(B, I, 2, T).chunk):
+            # even columns are the conv/SSM path, odd columns the silu gate
+            in_proj = np.concatenate([in_proj[:, 0::2], in_proj[:, 1::2]],
+                                     axis=1)
+            lp = {
+                "ln1": get(mx + "input_layernorm.weight"),
+                "in_proj": np.ascontiguousarray(in_proj),
+                "conv_w": np.ascontiguousarray(
+                    get(mx + "mamba.conv1d.weight")[:, 0, :].T),
+                "conv_b": get(mx + "mamba.conv1d.bias"),
+                "x_proj": get(mx + "mamba.x_proj_weight"),     # (nh, R+2S, Ih)
+                "dt_proj": get(mx + "mamba.dt_proj_weight"),   # (nh, Ih, R)
+                "dt_bias": get(mx + "mamba.dt_proj_bias"),     # (nh, Ih)
+                "a_log": get(mx + "mamba.A_log"),              # (nh, Ih, S)
+                "d_skip": get(mx + "mamba.D"),                 # (nh, Ih)
+                "out_proj": lin_t(mx + "mamba.out_proj.weight"),
+            }
+            layers.append(lp)
+        out = {
+            "embed": get("model.embed_tokens.weight"),
+            "shared": shared,
+            "linear": linear,
+            "layers": layers,
+            "final_norm": get("model.final_layernorm.weight"),
+            "rope_inv_freq": cls.inv_freq_from_config(config),
+        }
+        if not config.tie_word_embeddings:
+            out["lm_head"] = lin_t("lm_head.weight")
+        return out

@@ -61,6 +61,7 @@ CONTRIB_MODELS = {
     "openai-gpt": "contrib.models.openai_gpt.src.modeling_openai_gpt:OpenAIGPTForCausalLM",
     "moonshine": "contrib.models.moonshine.src.modeling_moonshine:MoonshineForConditionalGeneration",
     "zamba2": "contrib.models.zamba2.src.modeling_zamba2:Zamba2ForCausalLM",
+    "zamba": "contrib.models.zamba.src.modeling_zamba:ZambaForCausalLM",
 }
 
 for model_type, path in CONTRIB_MODELS.items():

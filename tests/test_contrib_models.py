@@ -58,7 +58,7 @@ def test_registry_resolves_contrib_models():
                "seed_oss", "minimax", "apertus", "mamba2", "falcon_h1", "glm4",
                "gpt_bigcode", "granitemoeshared", "falcon_mamba", "bamba",
                "vaultgemma", "granitemoehybrid", "openai-gpt", "moonshine",
-               "zamba2"):
+               "zamba2", "zamba"):
         assert get_model_cls(mt) is not None
 
 
@@ -1138,3 +1138,24 @@ def test_zamba2_parity():
     torch.manual_seed(0)
     hf = HFZamba2(cfg).eval()
     _run_parity(Zamba2ForCausalLM, hf, cfg, atol=2e-3, rtol=1e-3)
+
+
+def test_zamba_parity():
+    """Zamba v1: shared-block hybrid with a MULTI-HEAD mamba1 mixer (per-head
+    x_proj/dt_proj, interleaved x|z in_proj packing) and an adapter-free tied
+    transformer block."""
+    from transformers import ZambaConfig, ZambaForCausalLM as HFZamba
+
+    from contrib.models.zamba.src.modeling_zamba import ZambaForCausalLM
+
+    cfg = ZambaConfig(vocab_size=256, hidden_size=32, num_hidden_layers=4,
+                      attn_layer_period=3, attn_layer_offset=1,
+                      num_attention_heads=4, num_key_value_heads=4,
+                      intermediate_size=64, mamba_d_state=8, mamba_d_conv=4,
+                      mamba_expand=2, mamba_dt_rank=4, n_mamba_heads=2,
+                      use_mamba_kernels=False,
+                      max_position_embeddings=128, pad_token_id=0,
+                      tie_word_embeddings=True)
+    torch.manual_seed(0)
+    hf = HFZamba(cfg).eval()
+    _run_parity(ZambaForCausalLM, hf, cfg, atol=2e-3, rtol=1e-3)
