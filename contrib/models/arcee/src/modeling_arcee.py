@@ -1,0 +1,115 @@
+"""Arcee / AFM-4.5B on the TPU framework (contrib port).
+
+Llama-geometry GQA decoder whose MLP is a ReLU-squared *plain* stack
+(up_proj -> relu(x)^2 -> down_proj, no gate), with YaRN rope scaling for the
+65k context window. ≈ reference `contrib/models/AFM-4.5B-Base/src/modeling_afm.py`
+(arch summary in its README: YaRN factor 20, relu2, separate q/k/v fused at
+conversion). Maps onto the shared core via mlp_kind="plain" + activation="relu2"
+and `rope_ops.inv_freq_from_hf_config` (yarn NTK-by-parts).
+"""
+
+from typing import Dict
+
+import numpy as np
+
+from neuronx_distributed_inference_tpu.config import InferenceConfig
+from neuronx_distributed_inference_tpu.models.base import ModelArchArgs
+from neuronx_distributed_inference_tpu.ops import rope as rope_ops
+from neuronx_distributed_inference_tpu.runtime.application import (
+    TpuModelForCausalLM)
+
+
+class ArceeInferenceConfig(InferenceConfig):
+    REQUIRED_ATTRIBUTES = ("hidden_size", "num_hidden_layers",
+                           "num_attention_heads", "vocab_size",
+                           "intermediate_size")
+
+    def add_derived_config(self) -> None:
+        for attr, default in (("rope_theta", 10000.0), ("rms_norm_eps", 1e-6),
+                              ("attention_bias", False), ("mlp_bias", False),
+                              ("rope_scaling", None),
+                              ("tie_word_embeddings", False)):
+            if not hasattr(self, attr) or getattr(self, attr) is None:
+                setattr(self, attr, default)
+        if not hasattr(self, "num_key_value_heads") \
+                or self.num_key_value_heads is None:
+            self.num_key_value_heads = self.num_attention_heads
+        if not hasattr(self, "head_dim") or self.head_dim is None:
+            self.head_dim = self.hidden_size // self.num_attention_heads
+
+
+class ArceeForCausalLM(TpuModelForCausalLM):
+    @classmethod
+    def get_config_cls(cls):
+        return ArceeInferenceConfig
+
+    @classmethod
+    def arch_args_from_config(cls, config) -> ModelArchArgs:
+        return ModelArchArgs(
+            vocab_size=config.vocab_size,
+            hidden_size=config.hidden_size,
+            num_layers=config.num_hidden_layers,
+            num_heads=config.num_attention_heads,
+            num_kv_heads=config.num_key_value_heads,
+            head_dim=config.head_dim,
+            intermediate_size=config.intermediate_size,
+            rms_norm_eps=config.rms_norm_eps,
+            activation="relu2",
+            mlp_kind="plain",
+            mlp_bias=bool(config.mlp_bias),
+            attention_bias=bool(config.attention_bias),
+            rope_attention_scaling=rope_ops.attention_scaling_from_hf_config(
+                getattr(config, "rope_scaling", None)),
+            tie_word_embeddings=bool(config.tie_word_embeddings),
+        )
+
+    @classmethod
+    def inv_freq_from_config(cls, config) -> np.ndarray:
+        return rope_ops.inv_freq_from_hf_config(
+            config.head_dim, float(config.rope_theta),
+            getattr(config, "rope_scaling", None))
+
+    @classmethod
+    def convert_hf_state_dict(cls, state_dict: Dict[str, np.ndarray],
+                              config) -> Dict:
+        def get(name):
+            if name not in state_dict:
+                raise KeyError(f"missing weight {name}")
+            return np.asarray(state_dict[name])
+
+        def lin_t(name):
+            return np.ascontiguousarray(get(name).T)
+
+        keys = ["ln1", "wq", "wk", "wv", "wo", "ln2", "wg", "wd"]
+        if config.attention_bias:
+            keys += ["bq", "bk", "bv"]
+        if config.mlp_bias:
+            keys += ["bg", "bd"]
+        layers = {k: [] for k in keys}
+        for i in range(config.num_hidden_layers):
+            p = f"model.layers.{i}."
+            layers["wq"].append(lin_t(p + "self_attn.q_proj.weight"))
+            layers["wk"].append(lin_t(p + "self_attn.k_proj.weight"))
+            layers["wv"].append(lin_t(p + "self_attn.v_proj.weight"))
+            if config.attention_bias:
+                layers["bq"].append(get(p + "self_attn.q_proj.bias"))
+                layers["bk"].append(get(p + "self_attn.k_proj.bias"))
+                layers["bv"].append(get(p + "self_attn.v_proj.bias"))
+            layers["wo"].append(lin_t(p + "self_attn.o_proj.weight"))
+            layers["ln1"].append(get(p + "input_layernorm.weight"))
+            layers["ln2"].append(get(p + "post_attention_layernorm.weight"))
+            # plain MLP: fc1 (wg) -> relu^2 -> fc2 (wd)
+            layers["wg"].append(lin_t(p + "mlp.up_proj.weight"))
+            layers["wd"].append(lin_t(p + "mlp.down_proj.weight"))
+            if config.mlp_bias:
+                layers["bg"].append(get(p + "mlp.up_proj.bias"))
+                layers["bd"].append(get(p + "mlp.down_proj.bias"))
+        out = {
+            "embed": get("model.embed_tokens.weight"),
+            "layers": {k: np.stack(v) for k, v in layers.items()},
+            "final_norm": get("model.norm.weight"),
+            "rope_inv_freq": cls.inv_freq_from_config(config),
+        }
+        if not config.tie_word_embeddings:
+            out["lm_head"] = lin_t("lm_head.weight")
+        return out

@@ -1,0 +1,124 @@
+"""OLMo 3 (AI2) on the TPU framework (contrib port).
+
+≈ reference `contrib/models/OLMo-3-7B-Think/src/modeling_olmo3.py`. OLMo 3
+keeps the OLMo-2 block (post-norm: branch outputs RMS-normed before the
+residual add, full-width q/k RMSNorm) and adds a 3:1 sliding/full layer
+pattern with PER-TYPE rope tables: sliding layers always use the plain
+rope_theta table, full-attention layers use the config's scaled table
+(e.g. yarn for the long-context "Think" variants). Mapping: the shared
+layer-pattern machinery with the main rope table scaled
+(`rope_ops.inv_freq_from_hf_config`) and the sliding layers on the
+unscaled table via the local-rope hook.
+"""
+
+from typing import Dict
+
+import numpy as np
+
+from neuronx_distributed_inference_tpu.config import InferenceConfig
+from neuronx_distributed_inference_tpu.models.base import ModelArchArgs
+from neuronx_distributed_inference_tpu.ops import rope as rope_ops
+from neuronx_distributed_inference_tpu.runtime.application import (
+    TpuModelForCausalLM)
+
+
+class Olmo3InferenceConfig(InferenceConfig):
+    REQUIRED_ATTRIBUTES = ("hidden_size", "num_hidden_layers",
+                           "num_attention_heads", "num_key_value_heads",
+                           "vocab_size", "intermediate_size", "layer_types")
+
+    def add_derived_config(self) -> None:
+        for attr, default in (("rope_theta", 10000.0), ("rms_norm_eps", 1e-6),
+                              ("rope_scaling", None), ("sliding_window", 4096),
+                              ("tie_word_embeddings", False)):
+            if not hasattr(self, attr) or getattr(self, attr) is None:
+                setattr(self, attr, default)
+        if not hasattr(self, "head_dim") or self.head_dim is None:
+            self.head_dim = self.hidden_size // self.num_attention_heads
+
+    def layer_pattern(self):
+        return tuple("sliding" if t == "sliding_attention" else "full"
+                     for t in self.layer_types)
+
+
+class Olmo3ForCausalLM(TpuModelForCausalLM):
+    @classmethod
+    def get_config_cls(cls):
+        return Olmo3InferenceConfig
+
+    @classmethod
+    def arch_args_from_config(cls, config) -> ModelArchArgs:
+        return ModelArchArgs(
+            vocab_size=config.vocab_size,
+            hidden_size=config.hidden_size,
+            num_layers=config.num_hidden_layers,
+            num_heads=config.num_attention_heads,
+            num_kv_heads=config.num_key_value_heads,
+            head_dim=config.head_dim,
+            intermediate_size=config.intermediate_size,
+            rms_norm_eps=config.rms_norm_eps,
+            pre_norms=False,
+            sandwich_norms=True,
+            qk_norm=True,
+            qk_norm_scope="full",
+            sliding_window=int(config.sliding_window),
+            layer_pattern=config.layer_pattern(),
+            local_rope_theta=float(config.rope_theta),
+            rope_attention_scaling=rope_ops.attention_scaling_from_hf_config(
+                getattr(config, "rope_scaling", None)),
+            tie_word_embeddings=bool(config.tie_word_embeddings),
+        )
+
+    @classmethod
+    def inv_freq_from_config(cls, config) -> np.ndarray:
+        # full-attention layers: the (possibly yarn-scaled) table
+        return rope_ops.inv_freq_from_hf_config(
+            config.head_dim, float(config.rope_theta),
+            getattr(config, "rope_scaling", None))
+
+    @classmethod
+    def local_inv_freq_from_config(cls, config) -> np.ndarray:
+        # sliding layers: always the unscaled rope_theta table
+        return rope_ops.default_inv_freq(config.head_dim, float(config.rope_theta))
+
+    @classmethod
+    def convert_hf_state_dict(cls, state_dict: Dict[str, np.ndarray],
+                              config) -> Dict:
+        def get(name):
+            if name not in state_dict:
+                raise KeyError(f"missing weight {name}")
+            return np.asarray(state_dict[name])
+
+        def lin_t(name):
+            return np.ascontiguousarray(get(name).T)
+
+        H = config.hidden_size
+        layers = {k: [] for k in ("ln1", "ln1_post", "wq", "wk", "wv", "wo",
+                                  "q_norm", "k_norm",
+                                  "ln2", "ln2_post", "wg", "wu", "wd")}
+        ones = np.ones((H,), np.float32)
+        for i in range(config.num_hidden_layers):
+            p = f"model.layers.{i}."
+            layers["wq"].append(lin_t(p + "self_attn.q_proj.weight"))
+            layers["wk"].append(lin_t(p + "self_attn.k_proj.weight"))
+            layers["wv"].append(lin_t(p + "self_attn.v_proj.weight"))
+            layers["wo"].append(lin_t(p + "self_attn.o_proj.weight"))
+            layers["q_norm"].append(get(p + "self_attn.q_norm.weight"))
+            layers["k_norm"].append(get(p + "self_attn.k_norm.weight"))
+            layers["ln1"].append(ones)
+            layers["ln2"].append(ones)
+            layers["ln1_post"].append(get(p + "post_attention_layernorm.weight"))
+            layers["ln2_post"].append(get(p + "post_feedforward_layernorm.weight"))
+            layers["wg"].append(lin_t(p + "mlp.gate_proj.weight"))
+            layers["wu"].append(lin_t(p + "mlp.up_proj.weight"))
+            layers["wd"].append(lin_t(p + "mlp.down_proj.weight"))
+        out = {
+            "embed": get("model.embed_tokens.weight"),
+            "layers": {k: np.stack(v) for k, v in layers.items()},
+            "final_norm": get("model.norm.weight"),
+            "rope_inv_freq": cls.inv_freq_from_config(config),
+            "rope_inv_freq_local": cls.local_inv_freq_from_config(config),
+        }
+        if not config.tie_word_embeddings:
+            out["lm_head"] = lin_t("lm_head.weight")
+        return out

@@ -62,6 +62,10 @@ CONTRIB_MODELS = {
     "moonshine": "contrib.models.moonshine.src.modeling_moonshine:MoonshineForConditionalGeneration",
     "zamba2": "contrib.models.zamba2.src.modeling_zamba2:Zamba2ForCausalLM",
     "zamba": "contrib.models.zamba.src.modeling_zamba:ZambaForCausalLM",
+    "arcee": "contrib.models.arcee.src.modeling_arcee:ArceeForCausalLM",
+    "olmo3": "contrib.models.olmo3.src.modeling_olmo3:Olmo3ForCausalLM",
+    "hunyuan_v1_dense":
+        "contrib.models.hunyuan.src.modeling_hunyuan:HunYuanDenseForCausalLM",
 }
 
 for model_type, path in CONTRIB_MODELS.items():

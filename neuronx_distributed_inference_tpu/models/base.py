@@ -65,6 +65,8 @@ class ModelArchArgs:
     qk_norm: bool = False                 # qwen3-style per-head RMSNorm on q/k
     qk_norm_scope: str = "head"           # "head" (per-head) | "full" (olmo2: over
     #                                       the whole flattened q/k projection)
+    qk_norm_after_rope: bool = False      # hunyuan: per-head q/k norm applied
+    #                                       AFTER rotary (default is before)
     qk_norm_type: str = "rms"             # "rms" | "layer" (persimmon: biased
     #                                       per-head LayerNorm, params q_norm_b/k_norm_b)
     pre_norms: bool = True                # False = no input norms; the branch
@@ -463,15 +465,21 @@ def _project_qkv(lp: Params, args: ModelArchArgs, hn: jnp.ndarray,
     q = q.reshape(b, s, args.num_heads, args.head_dim).transpose(0, 2, 1, 3)
     k = k.reshape(b, s, args.num_kv_heads, args.head_dim).transpose(0, 2, 1, 3)
     v = v.reshape(b, s, args.num_kv_heads, args.head_dim).transpose(0, 2, 1, 3)
-    if args.qk_norm and args.qk_norm_scope == "head":
-        if args.qk_norm_type == "layer":
-            q = layer_norm(q, lp["q_norm"], lp["q_norm_b"], eps=args.rms_norm_eps)
-            k = layer_norm(k, lp["k_norm"], lp["k_norm_b"], eps=args.rms_norm_eps)
-        else:
-            zc = args.zero_centered_norms
-            q = rms_norm(q, lp["q_norm"], args.rms_norm_eps, zero_centered=zc)
-            k = rms_norm(k, lp["k_norm"], args.rms_norm_eps, zero_centered=zc)
+    if args.qk_norm and args.qk_norm_scope == "head" \
+            and not args.qk_norm_after_rope:
+        q, k = _head_qk_norm(lp, args, q, k)
     return q, k, v
+
+
+def _head_qk_norm(lp: Params, args: ModelArchArgs, q, k):
+    if args.qk_norm_type == "layer":
+        q = layer_norm(q, lp["q_norm"], lp["q_norm_b"], eps=args.rms_norm_eps)
+        k = layer_norm(k, lp["k_norm"], lp["k_norm_b"], eps=args.rms_norm_eps)
+    else:
+        zc = args.zero_centered_norms
+        q = rms_norm(q, lp["q_norm"], args.rms_norm_eps, zero_centered=zc)
+        k = rms_norm(k, lp["k_norm"], args.rms_norm_eps, zero_centered=zc)
+    return q, k
 
 
 def _mlp(lp: Params, args: ModelArchArgs, hn: jnp.ndarray, mesh, rules,
@@ -815,6 +823,8 @@ def _decoder_layer(
         v = constrain(v, ("decode_batch", "decode_kv_heads", None, None), rules,
                       mesh=mesh)
     q, k = _apply_rope(args, q, k, cos, sin)
+    if args.qk_norm and args.qk_norm_scope == "head" and args.qk_norm_after_rope:
+        q, k = _head_qk_norm(lp, args, q, k)   # hunyuan post-rope q/k norm
 
     if kv_scales is not None:
         # static fp8 scale fold: write K̂ = K/σ_k (the cast to the fp8 cache dtype

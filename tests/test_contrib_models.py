@@ -1159,3 +1159,65 @@ def test_zamba_parity():
     torch.manual_seed(0)
     hf = HFZamba(cfg).eval()
     _run_parity(ZambaForCausalLM, hf, cfg, atol=2e-3, rtol=1e-3)
+
+
+def test_arcee_parity():
+    """Arcee/AFM: llama-geometry GQA with a ReLU^2 PLAIN MLP (up->relu^2->down,
+    no gate) and YaRN rope scaling (exercised at factor 4)."""
+    from transformers import ArceeConfig, ArceeForCausalLM as HFArcee
+
+    from contrib.models.arcee.src.modeling_arcee import ArceeForCausalLM
+
+    cfg = ArceeConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, head_dim=16,
+                      rope_scaling={"rope_type": "yarn", "factor": 4.0,
+                                    "original_max_position_embeddings": 32,
+                                    "beta_fast": 32.0, "beta_slow": 1.0},
+                      max_position_embeddings=128,
+                      pad_token_id=0, tie_word_embeddings=False)
+    torch.manual_seed(0)
+    hf = HFArcee(cfg).eval()
+    _run_parity(ArceeForCausalLM, hf, cfg)
+
+
+def test_olmo3_parity():
+    """OLMo 3: the OLMo-2 post-norm block (branch-output norms, full-width
+    qk-norm) + a sliding/full layer pattern whose FULL layers use the
+    yarn-scaled rope table while sliding layers stay on the unscaled one."""
+    from transformers import Olmo3Config, Olmo3ForCausalLM as HFOlmo3
+
+    from contrib.models.olmo3.src.modeling_olmo3 import Olmo3ForCausalLM
+
+    cfg = Olmo3Config(vocab_size=256, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=4, num_attention_heads=4,
+                      num_key_value_heads=2, sliding_window=8,
+                      layer_types=["sliding_attention", "sliding_attention",
+                                   "full_attention", "sliding_attention"],
+                      rope_scaling={"rope_type": "yarn", "factor": 4.0,
+                                    "original_max_position_embeddings": 32,
+                                    "beta_fast": 32.0, "beta_slow": 1.0},
+                      max_position_embeddings=128,
+                      pad_token_id=0, tie_word_embeddings=False)
+    torch.manual_seed(0)
+    hf = HFOlmo3(cfg).eval()
+    _run_parity(Olmo3ForCausalLM, hf, cfg, atol=1e-3)
+
+
+def test_hunyuan_parity():
+    """HunYuan v1 dense: per-head q/k RMSNorm applied AFTER rotary
+    (qk_norm_after_rope) over an otherwise llama-shaped GQA block."""
+    from transformers import (HunYuanDenseV1Config,
+                              HunYuanDenseV1ForCausalLM as HFHunYuan)
+
+    from contrib.models.hunyuan.src.modeling_hunyuan import (
+        HunYuanDenseForCausalLM)
+
+    cfg = HunYuanDenseV1Config(vocab_size=256, hidden_size=64,
+                               intermediate_size=128, num_hidden_layers=2,
+                               num_attention_heads=4, num_key_value_heads=2,
+                               head_dim=16, pad_token_id=0,
+                               tie_word_embeddings=False)
+    torch.manual_seed(0)
+    hf = HFHunYuan(cfg).eval()
+    _run_parity(HunYuanDenseForCausalLM, hf, cfg, eos_token_id=2)
