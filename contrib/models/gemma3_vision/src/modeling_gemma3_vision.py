@@ -1,0 +1,177 @@
+"""Gemma 3 VLM (SigLIP tower + Gemma3 text) on the TPU framework (contrib port).
+
+≈ reference `contrib/models/gemma3-vision/` (Gemma3ForConditionalGeneration:
+fixed-resolution SigLIP 400M encode + multimodal projector + Gemma3 LLM).
+Rides the shared multimodal base (runtime/image_to_text.py). The tower is a
+SigLIP ViT: biased patch conv + learned positions (no CLS token), pre-LN
+blocks with biased attention and tanh-GELU MLP, final post_layernorm. The
+Gemma3 projector then average-pools the patch grid down to
+``mm_tokens_per_image`` tokens, applies the zero-centered gemma RMSNorm
+(mm_soft_emb_norm), and matmuls into text hidden size
+(mm_input_projection_weight). Features land on image-token positions AFTER the
+text embedding multiplier (sqrt(H)) is applied to text tokens — matching HF's
+masked_scatter of unscaled projected features.
+"""
+
+import functools
+from typing import Any, Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+from neuronx_distributed_inference_tpu.models.gemma3.modeling_gemma3 import (
+    Gemma3ForCausalLM, Gemma3InferenceConfig)
+from neuronx_distributed_inference_tpu.ops.attention import attend
+from neuronx_distributed_inference_tpu.ops.norms import layer_norm, rms_norm
+from neuronx_distributed_inference_tpu.runtime.image_to_text import (
+    ImageToTextInferenceConfig, TpuModelForImageToText)
+
+
+def _gelu_tanh(x):
+    return jnp.asarray(0.5) * x * (1.0 + jnp.tanh(
+        jnp.sqrt(2.0 / jnp.pi) * (x + 0.044715 * x ** 3)))
+
+
+def siglip_vision_encode(vp: Dict[str, Any], pixel_values: jnp.ndarray, *,
+                         patch_size: int, num_heads: int, eps: float,
+                         pool_kernel: int) -> jnp.ndarray:
+    """(N, C, H, W) -> (N, mm_tokens, H_text) SigLIP features through the
+    gemma3 avg-pool projector."""
+    n, c, hh, ww = pixel_values.shape
+    gh, gw = hh // patch_size, ww // patch_size
+    # patch conv (with bias) as unfold + matmul (stride == kernel)
+    x = pixel_values.reshape(n, c, gh, patch_size, gw, patch_size)
+    x = x.transpose(0, 2, 4, 1, 3, 5).reshape(n, gh * gw, -1)
+    h = x @ vp["patch_w"] + vp["patch_b"]
+    h = h + vp["pos_embed"][None]
+
+    d = h.shape[-1] // num_heads
+
+    def layer(hh, lp):
+        x = layer_norm(hh, lp["ln1"], lp["ln1_b"], eps=eps)
+        b, s, _ = x.shape
+        q = (x @ lp["wq"] + lp["bq"]).reshape(b, s, num_heads, d
+                                              ).transpose(0, 2, 1, 3)
+        k = (x @ lp["wk"] + lp["bk"]).reshape(b, s, num_heads, d
+                                              ).transpose(0, 2, 1, 3)
+        v = (x @ lp["wv"] + lp["bv"]).reshape(b, s, num_heads, d
+                                              ).transpose(0, 2, 1, 3)
+        a = attend(q, k, v)                                # full bidirectional
+        a = a.transpose(0, 2, 1, 3).reshape(b, s, -1)
+        hh = hh + (a @ lp["wo"] + lp["bo"])
+        x = layer_norm(hh, lp["ln2"], lp["ln2_b"], eps=eps)
+        hh = hh + (_gelu_tanh(x @ lp["w1"] + lp["b1"]) @ lp["w2"] + lp["b2"])
+        return hh, None
+
+    import jax
+    h, _ = jax.lax.scan(layer, h, vp["layers"])
+    h = layer_norm(h, vp["ln_post"], vp["ln_post_b"], eps=eps)
+
+    # gemma3 projector: avg-pool the (gh, gw) patch grid to tokens_per_side²
+    hv = h.shape[-1]
+    k = pool_kernel
+    grid = h.reshape(n, gh, gw, hv)
+    pooled = grid.reshape(n, gh // k, k, gw // k, k, hv).mean(axis=(2, 4))
+    pooled = pooled.reshape(n, -1, hv)
+    normed = rms_norm(pooled, vp["soft_emb_norm"], eps, zero_centered=True)
+    return normed @ vp["proj_w"]
+
+
+class Gemma3VisionInferenceConfig(ImageToTextInferenceConfig,
+                                  Gemma3InferenceConfig):
+    REQUIRED_ATTRIBUTES = ("vision_config", "image_token_index")
+
+    def add_derived_config(self) -> None:
+        ImageToTextInferenceConfig.add_derived_config(self)
+        Gemma3InferenceConfig.add_derived_config(self)
+        if not hasattr(self, "mm_tokens_per_image") \
+                or self.mm_tokens_per_image is None:
+            self.mm_tokens_per_image = 256
+
+
+class Gemma3ForConditionalGeneration(TpuModelForImageToText,
+                                     Gemma3ForCausalLM):
+    """≈ HF Gemma3ForConditionalGeneration (SigLIP tower + gemma3 text)."""
+
+    @classmethod
+    def get_config_cls(cls):
+        return Gemma3VisionInferenceConfig
+
+    def vision_encode_fn(self):
+        vc = self.config.vision_config
+        patches_per_side = vc["image_size"] // vc["patch_size"]
+        tokens_per_side = int(self.config.mm_tokens_per_image ** 0.5)
+        return functools.partial(
+            siglip_vision_encode,
+            patch_size=vc["patch_size"],
+            num_heads=vc["num_attention_heads"],
+            eps=vc.get("layer_norm_eps", 1e-6),
+            pool_kernel=patches_per_side // tokens_per_side,
+        )
+
+    @classmethod
+    def convert_hf_state_dict(cls, state_dict: Dict[str, np.ndarray],
+                              config) -> Dict:
+        text_sd = {}
+        for k, v in state_dict.items():
+            if k.startswith("model.language_model."):
+                text_sd["model." + k[len("model.language_model."):]] = v
+            elif k.startswith("language_model.model."):
+                text_sd["model." + k[len("language_model.model."):]] = v
+            elif k in ("lm_head.weight", "language_model.lm_head.weight"):
+                text_sd["lm_head.weight"] = v
+        return super().convert_hf_state_dict(text_sd, config)
+
+    @classmethod
+    def convert_hf_vision_state_dict(cls, state_dict: Dict[str, np.ndarray],
+                                     config) -> Dict:
+        def norm_key(k):
+            return k[6:] if k.startswith("model.") else k
+
+        state_dict = {norm_key(k): v for k, v in state_dict.items()}
+        vc = config.vision_config
+        hidden = vc["hidden_size"]
+
+        def get(name):
+            if name not in state_dict:
+                raise KeyError(f"missing weight {name}")
+            return np.asarray(state_dict[name])
+
+        def lin_t(name):
+            return np.ascontiguousarray(get(name).T)
+
+        keys = ("ln1", "ln1_b", "wq", "bq", "wk", "bk", "wv", "bv", "wo", "bo",
+                "ln2", "ln2_b", "w1", "b1", "w2", "b2")
+        layers = {k: [] for k in keys}
+        for i in range(vc["num_hidden_layers"]):
+            p = f"vision_tower.vision_model.encoder.layers.{i}."
+            layers["ln1"].append(get(p + "layer_norm1.weight"))
+            layers["ln1_b"].append(get(p + "layer_norm1.bias"))
+            layers["wq"].append(lin_t(p + "self_attn.q_proj.weight"))
+            layers["bq"].append(get(p + "self_attn.q_proj.bias"))
+            layers["wk"].append(lin_t(p + "self_attn.k_proj.weight"))
+            layers["bk"].append(get(p + "self_attn.k_proj.bias"))
+            layers["wv"].append(lin_t(p + "self_attn.v_proj.weight"))
+            layers["bv"].append(get(p + "self_attn.v_proj.bias"))
+            layers["wo"].append(lin_t(p + "self_attn.out_proj.weight"))
+            layers["bo"].append(get(p + "self_attn.out_proj.bias"))
+            layers["ln2"].append(get(p + "layer_norm2.weight"))
+            layers["ln2_b"].append(get(p + "layer_norm2.bias"))
+            layers["w1"].append(lin_t(p + "mlp.fc1.weight"))
+            layers["b1"].append(get(p + "mlp.fc1.bias"))
+            layers["w2"].append(lin_t(p + "mlp.fc2.weight"))
+            layers["b2"].append(get(p + "mlp.fc2.bias"))
+
+        emb = "vision_tower.vision_model.embeddings."
+        conv = get(emb + "patch_embedding.weight")           # (H_vis, C, p, p)
+        return {
+            "patch_w": np.ascontiguousarray(conv.reshape(hidden, -1).T),
+            "patch_b": get(emb + "patch_embedding.bias"),
+            "pos_embed": get(emb + "position_embedding.weight"),
+            "layers": {k: np.stack(v) for k, v in layers.items()},
+            "ln_post": get("vision_tower.vision_model.post_layernorm.weight"),
+            "ln_post_b": get("vision_tower.vision_model.post_layernorm.bias"),
+            "soft_emb_norm": get(
+                "multi_modal_projector.mm_soft_emb_norm.weight"),
+            "proj_w": get("multi_modal_projector.mm_input_projection_weight"),
+        }

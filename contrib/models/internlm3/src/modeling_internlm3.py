@@ -1,0 +1,109 @@
+"""InternLM3 (Shanghai AI Lab) on the TPU framework (contrib port).
+
+≈ reference `contrib/models/internlm3-8b-instruct/src/modeling_internlm3.py`.
+Llama-geometry GQA decoder with two independent bias knobs: ``qkv_bias``
+(biases on q/k/v only) and ``bias`` (biases on o_proj and the gated MLP),
+RMSNorm, silu-gated MLP, optional dynamic/linear rope scaling.
+"""
+
+from typing import Dict
+
+import numpy as np
+
+from neuronx_distributed_inference_tpu.config import InferenceConfig
+from neuronx_distributed_inference_tpu.models.base import ModelArchArgs
+from neuronx_distributed_inference_tpu.ops import rope as rope_ops
+from neuronx_distributed_inference_tpu.runtime.application import (
+    TpuModelForCausalLM)
+
+
+class InternLM3InferenceConfig(InferenceConfig):
+    REQUIRED_ATTRIBUTES = ("hidden_size", "num_hidden_layers",
+                           "num_attention_heads", "num_key_value_heads",
+                           "vocab_size", "intermediate_size")
+
+    def add_derived_config(self) -> None:
+        for attr, default in (("rope_theta", 10000.0), ("rms_norm_eps", 1e-6),
+                              ("qkv_bias", False), ("bias", False),
+                              ("rope_scaling", None),
+                              ("tie_word_embeddings", False)):
+            if not hasattr(self, attr) or getattr(self, attr) is None:
+                setattr(self, attr, default)
+        if not hasattr(self, "head_dim") or self.head_dim is None:
+            self.head_dim = self.hidden_size // self.num_attention_heads
+
+
+class InternLM3ForCausalLM(TpuModelForCausalLM):
+    @classmethod
+    def get_config_cls(cls):
+        return InternLM3InferenceConfig
+
+    @classmethod
+    def arch_args_from_config(cls, config) -> ModelArchArgs:
+        return ModelArchArgs(
+            vocab_size=config.vocab_size,
+            hidden_size=config.hidden_size,
+            num_layers=config.num_hidden_layers,
+            num_heads=config.num_attention_heads,
+            num_kv_heads=config.num_key_value_heads,
+            head_dim=config.head_dim,
+            intermediate_size=config.intermediate_size,
+            rms_norm_eps=config.rms_norm_eps,
+            attention_bias=bool(config.qkv_bias),
+            o_bias=bool(config.bias),
+            mlp_bias=bool(config.bias),
+            tie_word_embeddings=bool(config.tie_word_embeddings),
+        )
+
+    @classmethod
+    def inv_freq_from_config(cls, config) -> np.ndarray:
+        return rope_ops.inv_freq_from_hf_config(
+            config.head_dim, float(config.rope_theta),
+            getattr(config, "rope_scaling", None))
+
+    @classmethod
+    def convert_hf_state_dict(cls, state_dict: Dict[str, np.ndarray],
+                              config) -> Dict:
+        def get(name):
+            if name not in state_dict:
+                raise KeyError(f"missing weight {name}")
+            return np.asarray(state_dict[name])
+
+        def lin_t(name):
+            return np.ascontiguousarray(get(name).T)
+
+        keys = ["ln1", "wq", "wk", "wv", "wo", "ln2", "wg", "wu", "wd"]
+        if config.qkv_bias:
+            keys += ["bq", "bk", "bv"]
+        if config.bias:
+            keys += ["bo", "bg", "bu", "bd"]
+        layers = {k: [] for k in keys}
+        for i in range(config.num_hidden_layers):
+            p = f"model.layers.{i}."
+            layers["wq"].append(lin_t(p + "self_attn.q_proj.weight"))
+            layers["wk"].append(lin_t(p + "self_attn.k_proj.weight"))
+            layers["wv"].append(lin_t(p + "self_attn.v_proj.weight"))
+            if config.qkv_bias:
+                layers["bq"].append(get(p + "self_attn.q_proj.bias"))
+                layers["bk"].append(get(p + "self_attn.k_proj.bias"))
+                layers["bv"].append(get(p + "self_attn.v_proj.bias"))
+            layers["wo"].append(lin_t(p + "self_attn.o_proj.weight"))
+            if config.bias:
+                layers["bo"].append(get(p + "self_attn.o_proj.bias"))
+                layers["bg"].append(get(p + "mlp.gate_proj.bias"))
+                layers["bu"].append(get(p + "mlp.up_proj.bias"))
+                layers["bd"].append(get(p + "mlp.down_proj.bias"))
+            layers["ln1"].append(get(p + "input_layernorm.weight"))
+            layers["ln2"].append(get(p + "post_attention_layernorm.weight"))
+            layers["wg"].append(lin_t(p + "mlp.gate_proj.weight"))
+            layers["wu"].append(lin_t(p + "mlp.up_proj.weight"))
+            layers["wd"].append(lin_t(p + "mlp.down_proj.weight"))
+        out = {
+            "embed": get("model.embed_tokens.weight"),
+            "layers": {k: np.stack(v) for k, v in layers.items()},
+            "final_norm": get("model.norm.weight"),
+            "rope_inv_freq": cls.inv_freq_from_config(config),
+        }
+        if not config.tie_word_embeddings:
+            out["lm_head"] = lin_t("lm_head.weight")
+        return out

@@ -1,0 +1,126 @@
+"""MiniCPM4 (OpenBMB) on the TPU framework (contrib port).
+
+≈ reference `contrib/models/MiniCPM4-8B/src/modeling_minicpm.py`. Llama
+geometry with the muP scaling family: embeddings × scale_emb, every residual
+branch × scale_depth/sqrt(num_layers), and the final hidden divided by
+(hidden_size / dim_model_base) before the lm_head — mapped onto
+embedding_multiplier / residual_multiplier / logits_scale. LongRoPE
+(rope_type "longrope") divides inv_freq by the per-dim short/long ext factors
+(long when max_position_embeddings exceeds the original window) and scales
+cos/sin by sqrt(1 + ln(s)/ln(orig_max)).
+"""
+
+import math
+from typing import Dict
+
+import numpy as np
+
+from neuronx_distributed_inference_tpu.config import InferenceConfig
+from neuronx_distributed_inference_tpu.models.base import ModelArchArgs
+from neuronx_distributed_inference_tpu.ops import rope as rope_ops
+from neuronx_distributed_inference_tpu.runtime.application import (
+    TpuModelForCausalLM)
+
+
+def _longrope_params(config):
+    rs = getattr(config, "rope_scaling", None) or {}
+    rtype = rs.get("rope_type", rs.get("type", "default"))
+    if rtype != "longrope":
+        return None
+    orig = rs.get("original_max_position_embeddings",
+                  config.max_position_embeddings)
+    use_long = config.max_position_embeddings > orig
+    factors = np.asarray(rs.get("long_factor" if use_long else "short_factor"),
+                         np.float32)
+    scale = config.max_position_embeddings / orig
+    attn = (math.sqrt(1 + math.log(scale) / math.log(orig))
+            if scale > 1.0 else 1.0)
+    return factors, attn
+
+
+class MiniCPMInferenceConfig(InferenceConfig):
+    REQUIRED_ATTRIBUTES = ("hidden_size", "num_hidden_layers",
+                           "num_attention_heads", "num_key_value_heads",
+                           "vocab_size", "intermediate_size")
+
+    def add_derived_config(self) -> None:
+        for attr, default in (("rope_theta", 10000.0), ("rms_norm_eps", 1e-6),
+                              ("scale_emb", 1.0), ("dim_model_base", None),
+                              ("scale_depth", 1.0), ("rope_scaling", None),
+                              ("max_position_embeddings", 4096),
+                              ("tie_word_embeddings", False)):
+            if not hasattr(self, attr) or getattr(self, attr) is None:
+                setattr(self, attr, default)
+        if self.dim_model_base is None:
+            self.dim_model_base = self.hidden_size
+        if not hasattr(self, "head_dim") or self.head_dim is None:
+            self.head_dim = self.hidden_size // self.num_attention_heads
+
+
+class MiniCPMForCausalLM(TpuModelForCausalLM):
+    @classmethod
+    def get_config_cls(cls):
+        return MiniCPMInferenceConfig
+
+    @classmethod
+    def arch_args_from_config(cls, config) -> ModelArchArgs:
+        lr = _longrope_params(config)
+        return ModelArchArgs(
+            vocab_size=config.vocab_size,
+            hidden_size=config.hidden_size,
+            num_layers=config.num_hidden_layers,
+            num_heads=config.num_attention_heads,
+            num_kv_heads=config.num_key_value_heads,
+            head_dim=config.head_dim,
+            intermediate_size=config.intermediate_size,
+            rms_norm_eps=config.rms_norm_eps,
+            embedding_multiplier=float(config.scale_emb),
+            residual_multiplier=float(config.scale_depth)
+            / math.sqrt(config.num_hidden_layers),
+            logits_scale=float(config.dim_model_base) / config.hidden_size,
+            rope_attention_scaling=(lr[1] if lr is not None else 1.0),
+            tie_word_embeddings=bool(config.tie_word_embeddings),
+        )
+
+    @classmethod
+    def inv_freq_from_config(cls, config) -> np.ndarray:
+        base = rope_ops.default_inv_freq(config.head_dim,
+                                         float(config.rope_theta))
+        lr = _longrope_params(config)
+        if lr is not None:
+            base = base / lr[0]
+        return base
+
+    @classmethod
+    def convert_hf_state_dict(cls, state_dict: Dict[str, np.ndarray],
+                              config) -> Dict:
+        def get(name):
+            if name not in state_dict:
+                raise KeyError(f"missing weight {name}")
+            return np.asarray(state_dict[name])
+
+        def lin_t(name):
+            return np.ascontiguousarray(get(name).T)
+
+        layers = {k: [] for k in ("ln1", "wq", "wk", "wv", "wo",
+                                  "ln2", "wg", "wu", "wd")}
+        for i in range(config.num_hidden_layers):
+            p = f"model.layers.{i}."
+            layers["wq"].append(lin_t(p + "self_attn.q_proj.weight"))
+            layers["wk"].append(lin_t(p + "self_attn.k_proj.weight"))
+            layers["wv"].append(lin_t(p + "self_attn.v_proj.weight"))
+            layers["wo"].append(lin_t(p + "self_attn.o_proj.weight"))
+            layers["ln1"].append(get(p + "input_layernorm.weight"))
+            layers["ln2"].append(get(p + "post_attention_layernorm.weight"))
+            layers["wg"].append(lin_t(p + "mlp.gate_proj.weight"))
+            layers["wu"].append(lin_t(p + "mlp.up_proj.weight"))
+            layers["wd"].append(lin_t(p + "mlp.down_proj.weight"))
+        out = {
+            "embed": get("model.embed_tokens.weight"),
+            "layers": {k: np.stack(v) for k, v in layers.items()},
+            "final_norm": get("model.norm.weight"),
+            "rope_inv_freq": cls.inv_freq_from_config(config),
+        }
+        if not config.tie_word_embeddings:
+            out["lm_head"] = lin_t("lm_head.weight")
+        return out

@@ -1,0 +1,99 @@
+"""Orion (OrionStar AI, 14B) on the TPU framework (contrib port).
+
+≈ reference `contrib/models/orion-14b-chat/src/modeling_orion.py`. Llama
+geometry and rope, but every norm is a standard *biased* LayerNorm
+(input/post-attention/final) instead of RMSNorm; silu-gated MLP, no
+attention/MLP biases, untied lm_head. Maps onto the shared core via
+norm_type="layer" + norm_bias=True.
+"""
+
+from typing import Dict
+
+import numpy as np
+
+from neuronx_distributed_inference_tpu.config import InferenceConfig
+from neuronx_distributed_inference_tpu.models.base import ModelArchArgs
+from neuronx_distributed_inference_tpu.ops import rope as rope_ops
+from neuronx_distributed_inference_tpu.runtime.application import (
+    TpuModelForCausalLM)
+
+
+class OrionInferenceConfig(InferenceConfig):
+    REQUIRED_ATTRIBUTES = ("hidden_size", "num_hidden_layers",
+                           "num_attention_heads", "vocab_size",
+                           "intermediate_size")
+
+    def add_derived_config(self) -> None:
+        for attr, default in (("rope_theta", 10000.0), ("rms_norm_eps", 1e-5),
+                              ("tie_word_embeddings", False)):
+            if not hasattr(self, attr) or getattr(self, attr) is None:
+                setattr(self, attr, default)
+        if not hasattr(self, "num_key_value_heads") \
+                or self.num_key_value_heads is None:
+            self.num_key_value_heads = self.num_attention_heads
+        if not hasattr(self, "head_dim") or self.head_dim is None:
+            self.head_dim = self.hidden_size // self.num_attention_heads
+
+
+class OrionForCausalLM(TpuModelForCausalLM):
+    @classmethod
+    def get_config_cls(cls):
+        return OrionInferenceConfig
+
+    @classmethod
+    def arch_args_from_config(cls, config) -> ModelArchArgs:
+        return ModelArchArgs(
+            vocab_size=config.vocab_size,
+            hidden_size=config.hidden_size,
+            num_layers=config.num_hidden_layers,
+            num_heads=config.num_attention_heads,
+            num_kv_heads=config.num_key_value_heads,
+            head_dim=config.head_dim,
+            intermediate_size=config.intermediate_size,
+            rms_norm_eps=config.rms_norm_eps,
+            norm_type="layer",
+            norm_bias=True,
+            tie_word_embeddings=bool(config.tie_word_embeddings),
+        )
+
+    @classmethod
+    def inv_freq_from_config(cls, config) -> np.ndarray:
+        return rope_ops.default_inv_freq(config.head_dim,
+                                         float(config.rope_theta))
+
+    @classmethod
+    def convert_hf_state_dict(cls, state_dict: Dict[str, np.ndarray],
+                              config) -> Dict:
+        def get(name):
+            if name not in state_dict:
+                raise KeyError(f"missing weight {name}")
+            return np.asarray(state_dict[name])
+
+        def lin_t(name):
+            return np.ascontiguousarray(get(name).T)
+
+        layers = {k: [] for k in ("ln1", "ln1_b", "wq", "wk", "wv", "wo",
+                                  "ln2", "ln2_b", "wg", "wu", "wd")}
+        for i in range(config.num_hidden_layers):
+            p = f"model.layers.{i}."
+            layers["wq"].append(lin_t(p + "self_attn.q_proj.weight"))
+            layers["wk"].append(lin_t(p + "self_attn.k_proj.weight"))
+            layers["wv"].append(lin_t(p + "self_attn.v_proj.weight"))
+            layers["wo"].append(lin_t(p + "self_attn.o_proj.weight"))
+            layers["ln1"].append(get(p + "input_layernorm.weight"))
+            layers["ln1_b"].append(get(p + "input_layernorm.bias"))
+            layers["ln2"].append(get(p + "post_attention_layernorm.weight"))
+            layers["ln2_b"].append(get(p + "post_attention_layernorm.bias"))
+            layers["wg"].append(lin_t(p + "mlp.gate_proj.weight"))
+            layers["wu"].append(lin_t(p + "mlp.up_proj.weight"))
+            layers["wd"].append(lin_t(p + "mlp.down_proj.weight"))
+        out = {
+            "embed": get("model.embed_tokens.weight"),
+            "layers": {k: np.stack(v) for k, v in layers.items()},
+            "final_norm": get("model.norm.weight"),
+            "final_norm_b": get("model.norm.bias"),
+            "rope_inv_freq": cls.inv_freq_from_config(config),
+        }
+        if not config.tie_word_embeddings:
+            out["lm_head"] = lin_t("lm_head.weight")
+        return out

@@ -1,0 +1,327 @@
+"""Trinity (Arcee AFMoE family: Nano/Mini/Large) on the TPU framework
+(contrib port).
+
+≈ reference `contrib/models/Trinity/src/modeling_trinity.py` (AfmoeForCausalLM).
+The architecture stacks four independent features on a GQA decoder:
+
+- **Mixed attention**: a sliding/full layer pattern where sliding layers use
+  rope and a windowed causal mask, while full-attention layers are NoPE
+  (no rotary at all) with a plain causal mask.
+- **Gated attention**: a per-HEAD sigmoid gate projected from the normed layer
+  input (gate_proj: hidden -> num_heads, one scalar per head) multiplies the
+  attention output before o_proj.
+- **Dual norms** (4 RMSNorms/layer): input_layernorm -> attn ->
+  post_attention_layernorm -> +residual; pre_mlp_layernorm -> MLP/MoE ->
+  post_mlp_layernorm -> +residual; plus per-head q/k RMSNorm before rope.
+- **Mixed dense/MoE**: the first num_dense_layers use a dense silu-gated MLP;
+  the rest route 128+ experts with SIGMOID scores, top-k selected on
+  scores + expert_bias (bias affects selection only), gates = the unbiased
+  scores renormalized to sum 1, times route_scale — plus one ungated shared
+  expert added densely. muP: embeddings scaled by sqrt(hidden_size).
+"""
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neuronx_distributed_inference_tpu.config import InferenceConfig
+from neuronx_distributed_inference_tpu.models.base import (
+    ModelArchArgs, causal_mask)
+from neuronx_distributed_inference_tpu.ops import rope as rope_ops
+from neuronx_distributed_inference_tpu.ops.attention import attend
+from neuronx_distributed_inference_tpu.ops.moe import MoEArgs, moe_block
+from neuronx_distributed_inference_tpu.ops.norms import rms_norm
+from neuronx_distributed_inference_tpu.runtime.application import (
+    TpuModelForCausalLM)
+
+
+@dataclass(frozen=True)
+class TrinityArchArgs(ModelArchArgs):
+    layer_kinds: Tuple[str, ...] = ()      # "sliding" | "full" per layer
+    mlp_kinds: Tuple[str, ...] = ()        # "dense" | "moe" per layer
+    mup_embed_scale: float = 1.0
+
+
+def _attention(lp, args: TrinityArchArgs, hn, cos, sin, mask, k_cache, v_cache,
+               positions, bucket, use_rope: bool):
+    b, t, _ = hn.shape
+    nq, nkv, d = args.num_heads, args.num_kv_heads, args.head_dim
+    q = (hn @ lp["wq"]).reshape(b, t, nq, d).transpose(0, 2, 1, 3)
+    k = (hn @ lp["wk"]).reshape(b, t, nkv, d).transpose(0, 2, 1, 3)
+    v = (hn @ lp["wv"]).reshape(b, t, nkv, d).transpose(0, 2, 1, 3)
+    q = rms_norm(q, lp["q_norm"], args.rms_norm_eps)
+    k = rms_norm(k, lp["k_norm"], args.rms_norm_eps)
+    if use_rope:
+        q, k = rope_ops.apply_rotary(q, k, cos, sin)
+    if positions is None:
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k.astype(k_cache.dtype), (0, 0, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v.astype(v_cache.dtype), (0, 0, 0, 0))
+        k_att, v_att = k, v
+    else:
+        def _one(row_c, row_n, p):
+            return jax.lax.dynamic_update_slice(
+                row_c, row_n.astype(row_c.dtype), (0, p, 0))
+
+        k_cache = jax.vmap(_one)(k_cache, k, positions)
+        v_cache = jax.vmap(_one)(v_cache, v, positions)
+        k_att = jax.lax.slice_in_dim(k_cache, 0, bucket, axis=2).astype(q.dtype)
+        v_att = jax.lax.slice_in_dim(v_cache, 0, bucket, axis=2).astype(q.dtype)
+    attn = attend(q, k_att, v_att, mask=mask)              # (B, nq, T, d)
+    # per-head sigmoid gate from the normed layer input: (B, T, nq) scalars
+    gate = jax.nn.sigmoid(hn @ lp["w_attn_gate"])
+    attn = attn * gate.transpose(0, 2, 1)[..., None]
+    attn = attn.transpose(0, 2, 1, 3).reshape(b, t, nq * d)
+    return attn @ lp["wo"], k_cache, v_cache
+
+
+def _dense_mlp(lp, hn):
+    return (jax.nn.silu(hn @ lp["wg"]) * (hn @ lp["wu"])) @ lp["wd"]
+
+
+def _moe_mlp(lp, args: TrinityArchArgs, hn, mesh, rules, decode):
+    """Sigmoid routing, selection-only expert bias, renormalized unbiased gates
+    × route_scale, ungated shared expert — the shared `ops/moe.moe_block` with
+    router_mode="sigmoid_group" (n_group=1) + router_cb covers all of it, and
+    carries the EP/TP sharding constraints on the expert intermediates."""
+    return moe_block(lp, args, hn, mesh, rules, jax.nn.silu, decode=decode)
+
+
+def _forward(params, args: TrinityArchArgs, h, cos, sin, full_mask,
+             sliding_mask, cache, positions, bucket, mesh=None, rules=None):
+    ks, vs = [], []
+    for idx, kind in enumerate(args.layer_kinds):
+        lp = params["layers"][idx]
+        resid = h
+        hn = rms_norm(h, lp["ln1"], args.rms_norm_eps)
+        mask = sliding_mask if kind == "sliding" else full_mask
+        out, kc, vc = _attention(lp, args, hn, cos, sin, mask,
+                                 cache["k"][idx], cache["v"][idx], positions,
+                                 bucket, use_rope=(kind == "sliding"))
+        ks.append(kc)
+        vs.append(vc)
+        h = resid + rms_norm(out, lp["ln_post_attn"], args.rms_norm_eps)
+        resid = h
+        hn = rms_norm(h, lp["ln_pre_mlp"], args.rms_norm_eps)
+        mlp_out = (_dense_mlp(lp, hn) if args.mlp_kinds[idx] == "dense"
+                   else _moe_mlp(lp, args, hn, mesh, rules,
+                                 decode=positions is not None))
+        h = resid + rms_norm(mlp_out, lp["ln_post_mlp"], args.rms_norm_eps)
+    h = rms_norm(h, params["final_norm"], args.rms_norm_eps)
+    return h, {"k": jnp.stack(ks), "v": jnp.stack(vs)}
+
+
+def prefill_forward(params, args: TrinityArchArgs, input_ids, position_ids,
+                    last_token_idx, cache, mesh=None, rules=None,
+                    use_flash=False, adapter_ids=None, use_ring=False,
+                    return_hidden=False):
+    h = jnp.take(params["embed"], input_ids, axis=0) * args.mup_embed_scale
+    t = input_ids.shape[1]
+    cos, sin = rope_ops.compute_cos_sin(params["rope_inv_freq"], position_ids)
+    mask = (position_ids[:, None, :, None] >= position_ids[:, None, None, :])
+    mask &= causal_mask(t, t)[None, None]
+    kv_pos = position_ids[:, None, None, :]
+    q_pos = position_ids[:, None, :, None]
+    sliding = jnp.logical_and(mask, kv_pos > q_pos - args.sliding_window)
+    h, out_cache = _forward(params, args, h, cos, sin, mask, sliding, cache,
+                            None, None, mesh=mesh, rules=rules)
+    h_last = jnp.take_along_axis(h, last_token_idx[:, None, None], axis=1)[:, 0]
+    logits = (h_last @ params["lm_head"]).astype(jnp.float32)
+    if return_hidden:
+        return logits, out_cache, h
+    return logits, out_cache
+
+
+def decode_forward(params, args: TrinityArchArgs, input_ids, position_ids,
+                   cache, decode_bucket, mesh=None, rules=None,
+                   adapter_ids=None, tree=None, return_hidden=False,
+                   **_ignored):
+    if input_ids.shape[1] != 1 or tree is not None:
+        raise ValueError("Trinity decode is single-token only in this port")
+    h = jnp.take(params["embed"], input_ids, axis=0) * args.mup_embed_scale
+    pos_grid = position_ids[:, None]
+    cos, sin = rope_ops.compute_cos_sin(params["rope_inv_freq"], pos_grid)
+    kv_pos = jnp.arange(decode_bucket)[None, None, None, :]
+    q_pos = pos_grid[:, None, :, None]
+    mask = kv_pos <= q_pos
+    sliding = jnp.logical_and(mask, kv_pos > q_pos - args.sliding_window)
+    h, out_cache = _forward(params, args, h, cos, sin, mask, sliding, cache,
+                            position_ids, decode_bucket, mesh=mesh,
+                            rules=rules)
+    logits = (h @ params["lm_head"]).astype(jnp.float32)
+    if return_hidden:
+        return logits, out_cache, h
+    return logits, out_cache
+
+
+class TrinityInferenceConfig(InferenceConfig):
+    REQUIRED_ATTRIBUTES = ("hidden_size", "num_hidden_layers",
+                           "num_attention_heads", "num_key_value_heads",
+                           "vocab_size", "intermediate_size",
+                           "moe_intermediate_size", "num_local_experts",
+                           "num_experts_per_tok", "layer_types")
+
+    def add_derived_config(self) -> None:
+        for attr, default in (("rope_theta", 10000.0), ("rms_norm_eps", 1e-5),
+                              ("sliding_window", 2048),
+                              ("num_dense_layers", 2),
+                              ("route_scale", 1.0), ("mup_enabled", True),
+                              ("tie_word_embeddings", False)):
+            if not hasattr(self, attr) or getattr(self, attr) is None:
+                setattr(self, attr, default)
+        if not hasattr(self, "head_dim") or self.head_dim is None:
+            self.head_dim = self.hidden_size // self.num_attention_heads
+
+
+class TrinityForCausalLM(TpuModelForCausalLM):
+    def __init__(self, model_path, config, mesh=None):
+        self._require_base_layout(config.tpu_config, "Trinity (AFMoE)")
+        super().__init__(model_path, config, mesh=mesh)
+
+    @classmethod
+    def get_config_cls(cls):
+        return TrinityInferenceConfig
+
+    @classmethod
+    def arch_args_from_config(cls, config) -> TrinityArchArgs:
+        import math
+        return TrinityArchArgs(
+            vocab_size=config.vocab_size,
+            hidden_size=config.hidden_size,
+            num_layers=config.num_hidden_layers,
+            num_heads=config.num_attention_heads,
+            num_kv_heads=config.num_key_value_heads,
+            head_dim=config.head_dim,
+            intermediate_size=config.intermediate_size,
+            rms_norm_eps=config.rms_norm_eps,
+            sliding_window=int(config.sliding_window),
+            tie_word_embeddings=bool(config.tie_word_embeddings),
+            layer_kinds=tuple("sliding" if t == "sliding_attention" else "full"
+                              for t in config.layer_types),
+            mlp_kinds=tuple("dense" if i < config.num_dense_layers else "moe"
+                            for i in range(config.num_hidden_layers)),
+            moe=MoEArgs(
+                num_experts=int(config.num_local_experts),
+                experts_per_tok=int(config.num_experts_per_tok),
+                router_mode="sigmoid_group",
+                n_group=1,
+                topk_group=1,
+                score_correction_bias=True,
+                norm_topk_prob=True,
+                routed_scaling_factor=float(config.route_scale),
+                shared_expert_intermediate_size=int(
+                    config.moe_intermediate_size),
+                shared_expert_gated=False,
+            ),
+            mup_embed_scale=(math.sqrt(config.hidden_size)
+                             if config.mup_enabled else 1.0),
+        )
+
+    def prefill_fn(self):
+        return prefill_forward
+
+    def decode_fn(self):
+        return decode_forward
+
+    @classmethod
+    def inv_freq_from_config(cls, config) -> np.ndarray:
+        return rope_ops.default_inv_freq(config.head_dim,
+                                         float(config.rope_theta))
+
+    def reset_cache(self, batch_size: Optional[int] = None) -> None:
+        a: TrinityArchArgs = self.arch_args
+        b = batch_size or self.tpu_config.max_batch_size
+        dt = self.tpu_config.jax_dtype
+        self.kv_cache = {
+            "k": jnp.zeros((a.num_layers, b, a.num_kv_heads,
+                            self.tpu_config.seq_len, a.head_dim), dt),
+            "v": jnp.zeros((a.num_layers, b, a.num_kv_heads,
+                            self.tpu_config.seq_len, a.head_dim), dt),
+        }
+
+    def _put_params(self, host_params) -> None:
+        dtype = self.tpu_config.jax_dtype
+
+        def _put(x):
+            arr = np.asarray(x)
+            if arr.dtype.kind == "f":
+                arr = arr.astype(dtype)
+            return jax.device_put(arr)
+
+        params = jax.tree.map(_put, host_params)
+        params["rope_inv_freq"] = jax.device_put(
+            np.asarray(host_params["rope_inv_freq"], np.float32))
+        for i, lp in enumerate(params["layers"]):
+            if "router_cb" in lp:     # selection bias stays fp32
+                lp["router_cb"] = jax.device_put(np.asarray(
+                    host_params["layers"][i]["router_cb"], np.float32))
+        self.params = params
+        self.reset_cache()
+
+    def init_random_params(self, key):
+        raise NotImplementedError("load from an HF checkpoint or state dict")
+
+    @classmethod
+    def convert_hf_state_dict(cls, state_dict: Dict[str, np.ndarray],
+                              config) -> Dict:
+        def get(name):
+            if name not in state_dict:
+                raise KeyError(f"missing weight {name}")
+            return np.asarray(state_dict[name])
+
+        def lin_t(name):
+            return np.ascontiguousarray(get(name).T)
+
+        E = config.num_local_experts
+        layers = []
+        for i in range(config.num_hidden_layers):
+            p = f"model.layers.{i}."
+            lp: Dict[str, np.ndarray] = {
+                "ln1": get(p + "input_layernorm.weight"),
+                "ln_post_attn": get(p + "post_attention_layernorm.weight"),
+                "ln_pre_mlp": get(p + "pre_mlp_layernorm.weight"),
+                "ln_post_mlp": get(p + "post_mlp_layernorm.weight"),
+                "wq": lin_t(p + "self_attn.q_proj.weight"),
+                "wk": lin_t(p + "self_attn.k_proj.weight"),
+                "wv": lin_t(p + "self_attn.v_proj.weight"),
+                "wo": lin_t(p + "self_attn.o_proj.weight"),
+                "q_norm": get(p + "self_attn.q_norm.weight"),
+                "k_norm": get(p + "self_attn.k_norm.weight"),
+                # per-head gate: (num_heads, hidden) in HF layout
+                "w_attn_gate": lin_t(p + "self_attn.gate_proj.weight"),
+            }
+            if i < config.num_dense_layers:
+                lp["wg"] = lin_t(p + "mlp.gate_proj.weight")
+                lp["wu"] = lin_t(p + "mlp.up_proj.weight")
+                lp["wd"] = lin_t(p + "mlp.down_proj.weight")
+            else:
+                m = p + "mlp."
+                lp["router"] = lin_t(m + "router.gate.weight")
+                lp["router_cb"] = get(m + "expert_bias")
+                lp["wg"] = np.stack(
+                    [lin_t(m + f"experts.{e}.gate_proj.weight")
+                     for e in range(E)])
+                lp["wu"] = np.stack(
+                    [lin_t(m + f"experts.{e}.up_proj.weight")
+                     for e in range(E)])
+                lp["wd"] = np.stack(
+                    [lin_t(m + f"experts.{e}.down_proj.weight")
+                     for e in range(E)])
+                lp["shared_wg"] = lin_t(m + "shared_experts.gate_proj.weight")
+                lp["shared_wu"] = lin_t(m + "shared_experts.up_proj.weight")
+                lp["shared_wd"] = lin_t(m + "shared_experts.down_proj.weight")
+            layers.append(lp)
+        return {
+            "embed": get("model.embed_tokens.weight"),
+            "layers": layers,
+            "final_norm": get("model.norm.weight"),
+            "lm_head": (lin_t("lm_head.weight")
+                        if not config.tie_word_embeddings
+                        else np.ascontiguousarray(
+                            get("model.embed_tokens.weight").T)),
+            "rope_inv_freq": cls.inv_freq_from_config(config),
+        }

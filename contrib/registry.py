@@ -66,6 +66,19 @@ CONTRIB_MODELS = {
     "olmo3": "contrib.models.olmo3.src.modeling_olmo3:Olmo3ForCausalLM",
     "hunyuan_v1_dense":
         "contrib.models.hunyuan.src.modeling_hunyuan:HunYuanDenseForCausalLM",
+    "internlm3":
+        "contrib.models.internlm3.src.modeling_internlm3:InternLM3ForCausalLM",
+    "orion": "contrib.models.orion.src.modeling_orion:OrionForCausalLM",
+    "minicpm": "contrib.models.minicpm.src.modeling_minicpm:MiniCPMForCausalLM",
+    "minicpm4":
+        "contrib.models.minicpm.src.modeling_minicpm:MiniCPMForCausalLM",
+    "afmoe": "contrib.models.trinity.src.modeling_trinity:TrinityForCausalLM",
+    # outer gemma3 VLM config (text_config + vision_config); the bare-text
+    # model_type "gemma3_text" stays on the core text class
+    "gemma3": ("contrib.models.gemma3_vision.src.modeling_gemma3_vision:"
+               "Gemma3ForConditionalGeneration"),
+    "gemma3_vision": ("contrib.models.gemma3_vision.src.modeling_gemma3_vision:"
+                      "Gemma3ForConditionalGeneration"),
 }
 
 for model_type, path in CONTRIB_MODELS.items():

@@ -174,6 +174,9 @@ def param_logical_axes(args: ModelArchArgs) -> Params:
             "wu": ("layers", "embed", "mlp"),
             "wd": ("layers", "mlp", "embed"),
         })
+        if args.mlp_bias:
+            layer.update({"bg": ("layers", "mlp"), "bu": ("layers", "mlp"),
+                          "bd": ("layers", None)})
     if args.attention_bias:
         layer.update({
             "bq": ("layers", "heads"),
@@ -270,6 +273,10 @@ def init_params(args: ModelArchArgs, key: jax.Array, dtype=jnp.bfloat16,
             layers.update({"bg": jnp.zeros((L, I), dtype=dtype),
                            "bd": jnp.zeros((L, H), dtype=dtype)})
     else:
+        if args.mlp_bias:
+            layers.update({"bg": jnp.zeros((L, I), dtype=dtype),
+                           "bu": jnp.zeros((L, I), dtype=dtype),
+                           "bd": jnp.zeros((L, H), dtype=dtype)})
         layers.update({
             "wg": w(ks[4], (L, H, I)),
             "wu": w(ks[5], (L, H, I)),
@@ -507,11 +514,16 @@ def _mlp(lp: Params, args: ModelArchArgs, hn: jnp.ndarray, mesh, rules,
         sc = args.lora.scaling
         gate = apply_lora(lp, "wg", hn, gate, adapter_ids, sc)
         up = apply_lora(lp, "wu", hn, up, adapter_ids, sc)
+    if args.mlp_bias:
+        gate = gate + lp["bg"]
+        up = up + lp["bu"]
     gate = act(gate)
     inter = constrain(gate * up, ("batch", None, "mlp"), rules, mesh=mesh)
     down = qapply(inter, lp["wd"], act_quant=aq)
     if args.lora is not None:
         down = apply_lora(lp, "wd", inter, down, adapter_ids, args.lora.scaling)
+    if args.mlp_bias:
+        down = down + lp["bd"]
     return down
 
 

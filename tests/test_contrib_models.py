@@ -4,6 +4,8 @@
 config, last-token logit match + multi-step greedy token match.
 """
 
+import math
+
 import numpy as np
 import pytest
 import torch
@@ -58,7 +60,9 @@ def test_registry_resolves_contrib_models():
                "seed_oss", "minimax", "apertus", "mamba2", "falcon_h1", "glm4",
                "gpt_bigcode", "granitemoeshared", "falcon_mamba", "bamba",
                "vaultgemma", "granitemoehybrid", "openai-gpt", "moonshine",
-               "zamba2", "zamba"):
+               "zamba2", "zamba", "arcee", "olmo3", "hunyuan_v1_dense",
+               "internlm3", "orion", "minicpm", "minicpm4", "afmoe",
+               "gemma3", "gemma3_vision"):
         assert get_model_cls(mt) is not None
 
 
@@ -1221,3 +1225,445 @@ def test_hunyuan_parity():
     torch.manual_seed(0)
     hf = HFHunYuan(cfg).eval()
     _run_parity(HunYuanDenseForCausalLM, hf, cfg, eos_token_id=2)
+
+
+# ---- hand-rolled torch oracle for families whose HF classes aren't in the
+# ---- installed transformers (internlm3 / orion / minicpm4). The oracle is an
+# ---- independent from-the-paper implementation with HF-style module names so
+# ---- each port's convert_hf_state_dict runs unchanged on its state_dict().
+
+class _OracleAttn(torch.nn.Module):
+    def __init__(self, H, nq, nkv, d, qkv_bias, o_bias):
+        super().__init__()
+        self.q_proj = torch.nn.Linear(H, nq * d, bias=qkv_bias)
+        self.k_proj = torch.nn.Linear(H, nkv * d, bias=qkv_bias)
+        self.v_proj = torch.nn.Linear(H, nkv * d, bias=qkv_bias)
+        self.o_proj = torch.nn.Linear(nq * d, H, bias=o_bias)
+        self.nq, self.nkv, self.d = nq, nkv, d
+
+    def forward(self, x, inv_freq, attn_scale):
+        B, S, _ = x.shape
+        q = self.q_proj(x).view(B, S, self.nq, self.d).transpose(1, 2)
+        k = self.k_proj(x).view(B, S, self.nkv, self.d).transpose(1, 2)
+        v = self.v_proj(x).view(B, S, self.nkv, self.d).transpose(1, 2)
+        pos = torch.arange(S, dtype=torch.float32)
+        freqs = torch.outer(pos, torch.tensor(inv_freq))
+        emb = torch.cat([freqs, freqs], dim=-1)
+        cos = (emb.cos() * attn_scale)[None, None]
+        sin = (emb.sin() * attn_scale)[None, None]
+
+        def rot(t):
+            h = t.shape[-1] // 2
+            return torch.cat([-t[..., h:], t[..., :h]], dim=-1)
+
+        q = q * cos + rot(q) * sin
+        k = k * cos + rot(k) * sin
+        rep = self.nq // self.nkv
+        k = k.repeat_interleave(rep, dim=1)
+        v = v.repeat_interleave(rep, dim=1)
+        scores = (q @ k.transpose(-1, -2)) / math.sqrt(self.d)
+        mask = torch.full((S, S), float("-inf")).triu(1)
+        attn = torch.softmax(scores + mask, dim=-1) @ v
+        return self.o_proj(attn.transpose(1, 2).reshape(B, S, -1))
+
+
+class _OracleMLP(torch.nn.Module):
+    def __init__(self, H, I, bias):
+        super().__init__()
+        self.gate_proj = torch.nn.Linear(H, I, bias=bias)
+        self.up_proj = torch.nn.Linear(H, I, bias=bias)
+        self.down_proj = torch.nn.Linear(I, H, bias=bias)
+
+    def forward(self, x):
+        return self.down_proj(torch.nn.functional.silu(self.gate_proj(x))
+                              * self.up_proj(x))
+
+
+class _OracleRMSNorm(torch.nn.Module):
+    def __init__(self, H, eps):
+        super().__init__()
+        self.weight = torch.nn.Parameter(torch.ones(H))
+        self.eps = eps
+
+    def forward(self, x):
+        var = x.pow(2).mean(-1, keepdim=True)
+        return self.weight * x * torch.rsqrt(var + self.eps)
+
+
+class _OracleLayer(torch.nn.Module):
+    def __init__(self, H, I, nq, nkv, d, eps, norm, qkv_bias, proj_bias):
+        super().__init__()
+        mk = ((lambda: torch.nn.LayerNorm(H, eps=eps)) if norm == "layer"
+              else (lambda: _OracleRMSNorm(H, eps)))
+        self.input_layernorm = mk()
+        self.post_attention_layernorm = mk()
+        self.self_attn = _OracleAttn(H, nq, nkv, d, qkv_bias, proj_bias)
+        self.mlp = _OracleMLP(H, I, proj_bias)
+
+
+class _OracleModel(torch.nn.Module):
+    """Pre-norm llama-variant oracle: norm in {rms, layer}; optional qkv/proj
+    biases; muP knobs (scale_emb, per-branch residual multiplier, final
+    hidden divided by hidden/dim_model_base)."""
+
+    def __init__(self, V, H, I, L, nq, nkv, d, eps=1e-5, norm="rms",
+                 qkv_bias=False, proj_bias=False, inv_freq=None,
+                 attn_scale=1.0, scale_emb=1.0, res_mult=1.0,
+                 logits_div=1.0):
+        super().__init__()
+        inner = torch.nn.Module()
+        inner.embed_tokens = torch.nn.Embedding(V, H)
+        inner.layers = torch.nn.ModuleList(
+            [_OracleLayer(H, I, nq, nkv, d, eps, norm, qkv_bias, proj_bias)
+             for _ in range(L)])
+        inner.norm = (torch.nn.LayerNorm(H, eps=eps) if norm == "layer"
+                      else _OracleRMSNorm(H, eps))
+        self.model = inner
+        self.lm_head = torch.nn.Linear(H, V, bias=False)
+        self.inv_freq = (inv_freq if inv_freq is not None
+                         else (10000.0 ** (-np.arange(0, d, 2) / d)).astype(np.float32))
+        self.attn_scale = attn_scale
+        self.scale_emb, self.res_mult, self.logits_div = scale_emb, res_mult, logits_div
+
+    def forward(self, ids):
+        h = self.model.embed_tokens(ids) * self.scale_emb
+        for lyr in self.model.layers:
+            h = h + lyr.self_attn(lyr.input_layernorm(h), self.inv_freq,
+                                  self.attn_scale) * self.res_mult
+            h = h + lyr.mlp(lyr.post_attention_layernorm(h)) * self.res_mult
+        h = self.model.norm(h) / self.logits_div
+        return self.lm_head(h)
+
+
+def _run_parity_oracle(app_cls, oracle, hf_cfg_dict, atol=5e-4, rtol=1e-3):
+    config = app_cls.get_config_cls()(
+        _tpu_cfg(), load_config=load_pretrained_config(hf_cfg_dict))
+    app = app_cls(None, config)
+    state = {k: v.detach().numpy() for k, v in oracle.state_dict().items()}
+    params = app.convert_hf_state_dict(state, app.config)
+    app._put_params(params)
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(1, hf_cfg_dict["vocab_size"], size=(2, 12)).astype(np.int64)
+    with torch.no_grad():
+        ref_logits = oracle(torch.tensor(ids))[:, -1].numpy()
+    out = app.generate(ids, max_new_tokens=1, return_logits=True)
+    np.testing.assert_allclose(out.logits[0], ref_logits, atol=atol, rtol=rtol)
+
+    cur = torch.tensor(ids)
+    for _ in range(8):                      # full-recompute greedy oracle
+        with torch.no_grad():
+            nxt = oracle(cur)[:, -1].argmax(-1)
+        cur = torch.cat([cur, nxt[:, None]], 1)
+    out = app.generate(ids, max_new_tokens=8, eos_token_id=-1)
+    np.testing.assert_array_equal(out.tokens, cur[:, 12:].numpy())
+
+
+def test_internlm3_parity():
+    """InternLM3: llama geometry + independent qkv_bias (q/k/v) and bias
+    (o_proj + gated-MLP) knobs, both exercised."""
+    from contrib.models.internlm3.src.modeling_internlm3 import (
+        InternLM3ForCausalLM)
+
+    cfg = dict(model_type="internlm3", vocab_size=256, hidden_size=64,
+               intermediate_size=128, num_hidden_layers=2,
+               num_attention_heads=4, num_key_value_heads=2, head_dim=16,
+               qkv_bias=True, bias=True, rms_norm_eps=1e-5,
+               rope_theta=10000.0, tie_word_embeddings=False)
+    torch.manual_seed(0)
+    oracle = _OracleModel(256, 64, 128, 2, 4, 2, 16, eps=1e-5,
+                          qkv_bias=True, proj_bias=True).eval()
+    with torch.no_grad():                    # biases are zero-init; randomize
+        for n, p in oracle.named_parameters():
+            if n.endswith(".bias"):
+                p.copy_(torch.randn_like(p) * 0.05)
+    _run_parity_oracle(InternLM3ForCausalLM, oracle, cfg)
+
+
+def test_orion_parity():
+    """Orion: llama geometry with BIASED LayerNorm everywhere instead of
+    RMSNorm (norm_type=layer + norm_bias)."""
+    from contrib.models.orion.src.modeling_orion import OrionForCausalLM
+
+    cfg = dict(model_type="orion", vocab_size=256, hidden_size=64,
+               intermediate_size=128, num_hidden_layers=2,
+               num_attention_heads=4, num_key_value_heads=4,
+               rms_norm_eps=1e-5, rope_theta=10000.0,
+               tie_word_embeddings=False)
+    torch.manual_seed(0)
+    oracle = _OracleModel(256, 64, 128, 2, 4, 4, 16, eps=1e-5,
+                          norm="layer").eval()
+    with torch.no_grad():
+        for n, p in oracle.named_parameters():
+            if "layernorm.bias" in n or n == "model.norm.bias":
+                p.copy_(torch.randn_like(p) * 0.1)
+    _run_parity_oracle(OrionForCausalLM, oracle, cfg)
+
+
+def test_minicpm4_parity():
+    """MiniCPM4: muP scaling family (scale_emb=2, scale_depth/sqrt(L) branch
+    multiplier, hidden/(H/dim_model_base) logit divisor) + LongRoPE ext
+    factors with the sqrt(1+ln s/ln orig) cos/sin magnitude."""
+    from contrib.models.minicpm.src.modeling_minicpm import (
+        MiniCPMForCausalLM, _longrope_params)
+
+    rs = {"rope_type": "longrope",
+          "short_factor": [1.0] * 8, "long_factor": list(np.linspace(1, 3, 8)),
+          "original_max_position_embeddings": 32}
+    cfg = dict(model_type="minicpm", vocab_size=256, hidden_size=64,
+               intermediate_size=128, num_hidden_layers=2,
+               num_attention_heads=4, num_key_value_heads=2,
+               rms_norm_eps=1e-5, rope_theta=10000.0, scale_emb=2.0,
+               scale_depth=1.4, dim_model_base=32,
+               max_position_embeddings=128, rope_scaling=rs,
+               tie_word_embeddings=False)
+
+    class _C:  # mimic config attrs for the helper
+        pass
+    c = _C()
+    c.rope_scaling, c.max_position_embeddings = rs, 128
+    factors, attn_scale = _longrope_params(c)
+    assert attn_scale > 1.0                  # long branch engaged
+
+    base = (10000.0 ** (-np.arange(0, 16, 2) / 16)).astype(np.float32)
+    torch.manual_seed(0)
+    oracle = _OracleModel(256, 64, 128, 2, 4, 2, 16, eps=1e-5,
+                          inv_freq=base / factors, attn_scale=attn_scale,
+                          scale_emb=2.0, res_mult=1.4 / math.sqrt(2),
+                          logits_div=64 / 32).eval()
+    _run_parity_oracle(MiniCPMForCausalLM, oracle, cfg)
+
+
+class _TrinityOracleLayer(torch.nn.Module):
+    def __init__(self, H, nq, nkv, d, I_dense, I_moe, E, eps, dense):
+        super().__init__()
+        rms = lambda n: _OracleRMSNorm(n, eps)  # noqa: E731
+        self.input_layernorm = rms(H)
+        self.post_attention_layernorm = rms(H)
+        self.pre_mlp_layernorm = rms(H)
+        self.post_mlp_layernorm = rms(H)
+        sa = torch.nn.Module()
+        sa.q_proj = torch.nn.Linear(H, nq * d, bias=False)
+        sa.k_proj = torch.nn.Linear(H, nkv * d, bias=False)
+        sa.v_proj = torch.nn.Linear(H, nkv * d, bias=False)
+        sa.o_proj = torch.nn.Linear(nq * d, H, bias=False)
+        sa.q_norm = rms(d)
+        sa.k_norm = rms(d)
+        sa.gate_proj = torch.nn.Linear(H, nq, bias=False)  # one gate per head
+        self.self_attn = sa
+        mlp = torch.nn.Module()
+        if dense:
+            mlp.gate_proj = torch.nn.Linear(H, I_dense, bias=False)
+            mlp.up_proj = torch.nn.Linear(H, I_dense, bias=False)
+            mlp.down_proj = torch.nn.Linear(I_dense, H, bias=False)
+        else:
+            router = torch.nn.Module()
+            router.gate = torch.nn.Linear(H, E, bias=False)
+            mlp.router = router
+            mlp.expert_bias = torch.nn.Parameter(torch.zeros(E))
+            mlp.experts = torch.nn.ModuleList()
+            for _ in range(E):
+                ex = torch.nn.Module()
+                ex.gate_proj = torch.nn.Linear(H, I_moe, bias=False)
+                ex.up_proj = torch.nn.Linear(H, I_moe, bias=False)
+                ex.down_proj = torch.nn.Linear(I_moe, H, bias=False)
+                mlp.experts.append(ex)
+            sh = torch.nn.Module()
+            sh.gate_proj = torch.nn.Linear(H, I_moe, bias=False)
+            sh.up_proj = torch.nn.Linear(H, I_moe, bias=False)
+            sh.down_proj = torch.nn.Linear(I_moe, H, bias=False)
+            mlp.shared_experts = sh
+        self.mlp = mlp
+        self.dense = dense
+
+
+class _TrinityOracle(torch.nn.Module):
+    """Independent AFMoE oracle: sliding(rope)/full(NoPE) attention with a
+    per-head sigmoid gate, 4-norm sandwich blocks, sigmoid+bias routing with
+    renormalized unbiased gates × route_scale, shared expert, muP embeds."""
+
+    def __init__(self, V, H, L, nq, nkv, d, I_dense, I_moe, E, topk, window,
+                 layer_kinds, num_dense, route_scale=1.0, eps=1e-5):
+        super().__init__()
+        inner = torch.nn.Module()
+        inner.embed_tokens = torch.nn.Embedding(V, H)
+        inner.layers = torch.nn.ModuleList(
+            [_TrinityOracleLayer(H, nq, nkv, d, I_dense, I_moe, E, eps,
+                                 i < num_dense) for i in range(L)])
+        inner.norm = _OracleRMSNorm(H, eps)
+        self.model = inner
+        self.lm_head = torch.nn.Linear(H, V, bias=False)
+        self.nq, self.nkv, self.d, self.topk = nq, nkv, d, topk
+        self.window, self.kinds, self.route_scale = window, layer_kinds, route_scale
+        self.mup = math.sqrt(H)
+        self.inv_freq = (10000.0 ** (-np.arange(0, d, 2) / d)).astype(np.float32)
+
+    def _attn(self, lyr, x, use_rope):
+        B, S, _ = x.shape
+        sa = lyr.self_attn
+        q = sa.q_proj(x).view(B, S, self.nq, self.d).transpose(1, 2)
+        k = sa.k_proj(x).view(B, S, self.nkv, self.d).transpose(1, 2)
+        v = sa.v_proj(x).view(B, S, self.nkv, self.d).transpose(1, 2)
+        q, k = sa.q_norm(q), sa.k_norm(k)
+        if use_rope:
+            pos = torch.arange(S, dtype=torch.float32)
+            freqs = torch.outer(pos, torch.tensor(self.inv_freq))
+            emb = torch.cat([freqs, freqs], dim=-1)
+            cos, sin = emb.cos()[None, None], emb.sin()[None, None]
+
+            def rot(t):
+                h = t.shape[-1] // 2
+                return torch.cat([-t[..., h:], t[..., :h]], dim=-1)
+
+            q = q * cos + rot(q) * sin
+            k = k * cos + rot(k) * sin
+        rep = self.nq // self.nkv
+        k = k.repeat_interleave(rep, dim=1)
+        v = v.repeat_interleave(rep, dim=1)
+        scores = (q @ k.transpose(-1, -2)) / math.sqrt(self.d)
+        pos = torch.arange(S)
+        mask = pos[None, :] <= pos[:, None]
+        if use_rope:  # sliding layers additionally window the mask
+            mask &= pos[None, :] > pos[:, None] - self.window
+        scores = scores.masked_fill(~mask, float("-inf"))
+        attn = torch.softmax(scores, dim=-1) @ v            # (B, nq, S, d)
+        gate = torch.sigmoid(sa.gate_proj(x))               # (B, S, nq)
+        attn = attn * gate.transpose(1, 2)[..., None]
+        return sa.o_proj(attn.transpose(1, 2).reshape(B, S, -1))
+
+    def _moe(self, mlp, x):
+        B, S, H = x.shape
+        flat = x.reshape(-1, H)
+        scores = torch.sigmoid(mlp.router.gate(flat).float())
+        _, idx = torch.topk(scores + mlp.expert_bias.float()[None], self.topk)
+        w = torch.gather(scores, 1, idx)
+        w = w / w.sum(-1, keepdim=True)
+        w = w * self.route_scale
+        out = torch.zeros_like(flat)
+        for n in range(flat.shape[0]):
+            for j in range(self.topk):
+                ex = mlp.experts[idx[n, j]]
+                h = torch.nn.functional.silu(ex.gate_proj(flat[n])) * ex.up_proj(flat[n])
+                out[n] += w[n, j] * ex.down_proj(h)
+        sh = mlp.shared_experts
+        shared = sh.down_proj(torch.nn.functional.silu(sh.gate_proj(flat))
+                              * sh.up_proj(flat))
+        return (out + shared).reshape(B, S, H)
+
+    def forward(self, ids):
+        h = self.model.embed_tokens(ids) * self.mup
+        for i, lyr in enumerate(self.model.layers):
+            x = lyr.input_layernorm(h)
+            a = self._attn(lyr, x, use_rope=(self.kinds[i] == "sliding_attention"))
+            h = h + lyr.post_attention_layernorm(a)
+            x = lyr.pre_mlp_layernorm(h)
+            m = (lyr.mlp.down_proj(torch.nn.functional.silu(lyr.mlp.gate_proj(x))
+                                   * lyr.mlp.up_proj(x))
+                 if lyr.dense else self._moe(lyr.mlp, x))
+            h = h + lyr.post_mlp_layernorm(m)
+        return self.lm_head(self.model.norm(h))
+
+
+def test_trinity_parity():
+    """Trinity/AFMoE: mixed sliding(rope)/full(NoPE) attention with per-head
+    sigmoid output gates, 4-norm blocks, first-2-dense then sigmoid+expert-bias
+    MoE with shared expert, muP embedding scale, route_scale=2."""
+    from contrib.models.trinity.src.modeling_trinity import TrinityForCausalLM
+
+    kinds = ["sliding_attention", "sliding_attention", "full_attention",
+             "sliding_attention"]
+    cfg = dict(model_type="afmoe", vocab_size=256, hidden_size=64,
+               num_hidden_layers=4, num_attention_heads=4,
+               num_key_value_heads=2, head_dim=16, intermediate_size=128,
+               moe_intermediate_size=32, num_local_experts=8,
+               num_experts_per_tok=2, num_dense_layers=2, sliding_window=8,
+               layer_types=kinds, route_scale=2.0, rms_norm_eps=1e-5,
+               rope_theta=10000.0, mup_enabled=True, tie_word_embeddings=False)
+    torch.manual_seed(0)
+    oracle = _TrinityOracle(256, 64, 4, 4, 2, 16, 128, 32, 8, 2, 8,
+                            kinds, 2, route_scale=2.0).eval()
+    with torch.no_grad():
+        for lyr in oracle.model.layers:
+            if not lyr.dense:
+                lyr.mlp.expert_bias.copy_(torch.randn(8) * 0.5)
+    _run_parity_oracle(TrinityForCausalLM, oracle, cfg, atol=2e-3)
+
+
+@pytest.fixture(scope="module")
+def tiny_gemma3_vlm():
+    from transformers import (Gemma3Config, Gemma3ForConditionalGeneration,
+                              Gemma3TextConfig, SiglipVisionConfig)
+
+    vc = SiglipVisionConfig(hidden_size=32, intermediate_size=64,
+                            num_hidden_layers=2, num_attention_heads=2,
+                            image_size=16, patch_size=4, num_channels=3,
+                            vision_use_head=False)
+    tc = Gemma3TextConfig(vocab_size=256, hidden_size=48, intermediate_size=96,
+                          num_hidden_layers=2, num_attention_heads=4,
+                          num_key_value_heads=2, head_dim=16,
+                          sliding_window=8, sliding_window_pattern=2,
+                          layer_types=["sliding_attention", "full_attention"],
+                          rope_theta=10000.0, rope_local_base_freq=10000.0,
+                          query_pre_attn_scalar=16.0,
+                          tie_word_embeddings=True)
+    cfg = Gemma3Config(vision_config=vc, text_config=tc, image_token_index=255,
+                       mm_tokens_per_image=4, pad_token_id=0)
+    torch.manual_seed(0)
+    hf = Gemma3ForConditionalGeneration(cfg).eval()
+    return hf, cfg
+
+
+def test_gemma3_vision_encoder_matches_hf(tiny_gemma3_vlm):
+    """SigLIP tower + gemma3 avg-pool projector: (4,4) patch grid pooled to 4
+    tokens, zero-centered soft-emb norm, projection to text hidden."""
+    from contrib.models.gemma3_vision.src.modeling_gemma3_vision import (
+        Gemma3ForConditionalGeneration)
+
+    hf, cfg = tiny_gemma3_vlm
+    tpu_cfg = TpuConfig(batch_size=2, seq_len=64, max_context_length=32,
+                        dtype="float32", context_encoding_buckets=[32],
+                        token_generation_buckets=[64])
+    config = Gemma3ForConditionalGeneration.get_config_cls()(
+        tpu_cfg, load_config=load_pretrained_config(cfg.to_dict()))
+    app = Gemma3ForConditionalGeneration(None, config)
+    state = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+    app._put_params(app.convert_hf_state_dict(state, app.config))
+    app.load_vision_from_state_dict(state)
+
+    rng = np.random.default_rng(0)
+    pixels = rng.normal(size=(2, 3, 16, 16)).astype(np.float32)
+    feats = app.encode_images(pixels)                   # (2, 4, H_text)
+    with torch.no_grad():
+        hf_feats = hf.get_image_features(pixel_values=torch.tensor(pixels))
+    np.testing.assert_allclose(feats, np.asarray(hf_feats), atol=3e-4,
+                               rtol=1e-3)
+
+
+def test_gemma3_vision_generate_matches_hf(tiny_gemma3_vlm):
+    """Gemma3 VLM greedy decode matches HF CPU; image features merge at
+    image-token positions after the sqrt(H) text-embed multiplier."""
+    from contrib.models.gemma3_vision.src.modeling_gemma3_vision import (
+        Gemma3ForConditionalGeneration)
+
+    hf, cfg = tiny_gemma3_vlm
+    tpu_cfg = TpuConfig(batch_size=2, seq_len=64, max_context_length=32,
+                        dtype="float32", context_encoding_buckets=[32],
+                        token_generation_buckets=[64])
+    config = Gemma3ForConditionalGeneration.get_config_cls()(
+        tpu_cfg, load_config=load_pretrained_config(cfg.to_dict()))
+    app = Gemma3ForConditionalGeneration(None, config)
+    state = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+    app._put_params(app.convert_hf_state_dict(state, app.config))
+    app.load_vision_from_state_dict(state)
+
+    rng = np.random.default_rng(1)
+    ids = rng.integers(1, 250, size=(2, 20))
+    ids[:, 2:6] = 255                                   # 4 pooled tokens/image
+    pixels = rng.normal(size=(2, 3, 16, 16)).astype(np.float32)
+    with torch.no_grad():
+        hf_out = hf.generate(input_ids=torch.tensor(ids),
+                             pixel_values=torch.tensor(pixels),
+                             max_new_tokens=8, do_sample=False, pad_token_id=0)
+    out = app.generate(ids, pixel_values=pixels, max_new_tokens=8,
+                       eos_token_id=-1)
+    np.testing.assert_array_equal(out.tokens, hf_out[:, 20:].numpy())
