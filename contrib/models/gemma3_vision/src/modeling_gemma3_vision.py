@@ -21,51 +21,23 @@ import numpy as np
 
 from neuronx_distributed_inference_tpu.models.gemma3.modeling_gemma3 import (
     Gemma3ForCausalLM, Gemma3InferenceConfig)
-from neuronx_distributed_inference_tpu.ops.attention import attend
-from neuronx_distributed_inference_tpu.ops.norms import layer_norm, rms_norm
+from neuronx_distributed_inference_tpu.ops.norms import rms_norm
+from neuronx_distributed_inference_tpu.ops.vit import ViTSpec, vit_encode
 from neuronx_distributed_inference_tpu.runtime.image_to_text import (
     ImageToTextInferenceConfig, TpuModelForImageToText)
-
-
-def _gelu_tanh(x):
-    return jnp.asarray(0.5) * x * (1.0 + jnp.tanh(
-        jnp.sqrt(2.0 / jnp.pi) * (x + 0.044715 * x ** 3)))
 
 
 def siglip_vision_encode(vp: Dict[str, Any], pixel_values: jnp.ndarray, *,
                          patch_size: int, num_heads: int, eps: float,
                          pool_kernel: int) -> jnp.ndarray:
-    """(N, C, H, W) -> (N, mm_tokens, H_text) SigLIP features through the
-    gemma3 avg-pool projector."""
-    n, c, hh, ww = pixel_values.shape
-    gh, gw = hh // patch_size, ww // patch_size
-    # patch conv (with bias) as unfold + matmul (stride == kernel)
-    x = pixel_values.reshape(n, c, gh, patch_size, gw, patch_size)
-    x = x.transpose(0, 2, 4, 1, 3, 5).reshape(n, gh * gw, -1)
-    h = x @ vp["patch_w"] + vp["patch_b"]
-    h = h + vp["pos_embed"][None]
-
-    d = h.shape[-1] // num_heads
-
-    def layer(hh, lp):
-        x = layer_norm(hh, lp["ln1"], lp["ln1_b"], eps=eps)
-        b, s, _ = x.shape
-        q = (x @ lp["wq"] + lp["bq"]).reshape(b, s, num_heads, d
-                                              ).transpose(0, 2, 1, 3)
-        k = (x @ lp["wk"] + lp["bk"]).reshape(b, s, num_heads, d
-                                              ).transpose(0, 2, 1, 3)
-        v = (x @ lp["wv"] + lp["bv"]).reshape(b, s, num_heads, d
-                                              ).transpose(0, 2, 1, 3)
-        a = attend(q, k, v)                                # full bidirectional
-        a = a.transpose(0, 2, 1, 3).reshape(b, s, -1)
-        hh = hh + (a @ lp["wo"] + lp["bo"])
-        x = layer_norm(hh, lp["ln2"], lp["ln2_b"], eps=eps)
-        hh = hh + (_gelu_tanh(x @ lp["w1"] + lp["b1"]) @ lp["w2"] + lp["b2"])
-        return hh, None
-
-    import jax
-    h, _ = jax.lax.scan(layer, h, vp["layers"])
-    h = layer_norm(h, vp["ln_post"], vp["ln_post_b"], eps=eps)
+    """(N, C, H, W) -> (N, mm_tokens, H_text) SigLIP features (shared ViT)
+    through the gemma3 avg-pool projector."""
+    n = pixel_values.shape[0]
+    gh = pixel_values.shape[2] // patch_size
+    gw = pixel_values.shape[3] // patch_size
+    spec = ViTSpec(patch_size=patch_size, num_heads=num_heads, eps=eps,
+                   act="gelu_tanh")
+    h = vit_encode(vp, pixel_values, spec)
 
     # gemma3 projector: avg-pool the (gh, gw) patch grid to tokens_per_side²
     hv = h.shape[-1]

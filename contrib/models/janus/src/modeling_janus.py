@@ -1,0 +1,147 @@
+"""Janus (DeepSeek Janus-1.3B multimodal) on the TPU framework (contrib port).
+
+≈ reference `contrib/models/Janus-1.3B/src/modeling_janus.py` — which ports the
+llama LM backbone only ("text-only version", its line 428). This port EXCEEDS
+that scope: the full image-understanding path runs on the shared multimodal
+base (runtime/image_to_text.py) — a SigLIP-shaped tower (biased attention with
+an optional per-head q/k LayerNorm, erf-GELU MLP, patch conv + learned
+positions, final post_layernorm) followed by the depth-2 GELU aligner MLP,
+features landing on <image_placeholder> token positions. The VQVAE
+image-GENERATION decoder stays out of scope on both sides.
+"""
+
+import functools
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neuronx_distributed_inference_tpu.models.llama.modeling_llama import (
+    LlamaForCausalLM, LlamaInferenceConfig)
+from neuronx_distributed_inference_tpu.ops.vit import ViTSpec, vit_encode
+from neuronx_distributed_inference_tpu.runtime.image_to_text import (
+    ImageToTextInferenceConfig, TpuModelForImageToText)
+
+
+def janus_vision_encode(vp: Dict[str, Any], pixel_values: jnp.ndarray, *,
+                        patch_size: int, num_heads: int, eps: float,
+                        qk_norm: bool) -> jnp.ndarray:
+    """(N, C, H, W) -> (N, T_img, H_text) through the shared ViT + aligner."""
+    spec = ViTSpec(patch_size=patch_size, num_heads=num_heads, eps=eps,
+                   act="gelu", qk_norm=qk_norm)
+    h = vit_encode(vp, pixel_values, spec)
+    # aligner: fc1, then (gelu -> linear) per extra depth
+    h = h @ vp["align_w1"] + vp["align_b1"]
+    for w, b in zip(vp["align_ws"], vp["align_bs"]):
+        h = jax.nn.gelu(h, approximate=False) @ w + b
+    return h
+
+
+class JanusInferenceConfig(ImageToTextInferenceConfig, LlamaInferenceConfig):
+    REQUIRED_ATTRIBUTES = ("vision_config",)
+
+    def add_derived_config(self) -> None:
+        ImageToTextInferenceConfig.add_derived_config(self)
+        LlamaInferenceConfig.add_derived_config(self)
+        if not hasattr(self, "image_token_index"):
+            self.image_token_index = getattr(self, "image_token_id", None)
+        if self.image_token_index is None:
+            raise ValueError("janus config needs image_token_id")
+
+
+class JanusForConditionalGeneration(TpuModelForImageToText, LlamaForCausalLM):
+    """≈ HF JanusForConditionalGeneration (understanding path)."""
+
+    @classmethod
+    def get_config_cls(cls):
+        return JanusInferenceConfig
+
+    def vision_encode_fn(self):
+        vc = self.config.vision_config
+        return functools.partial(
+            janus_vision_encode,
+            patch_size=vc["patch_size"],
+            num_heads=vc["num_attention_heads"],
+            eps=vc.get("layer_norm_eps", 1e-6),
+            qk_norm=bool(vc.get("use_qk_norm", False)),
+        )
+
+    @classmethod
+    def convert_hf_state_dict(cls, state_dict: Dict[str, np.ndarray],
+                              config) -> Dict:
+        text_sd = {}
+        for k, v in state_dict.items():
+            if k.startswith("model.language_model."):
+                text_sd["model." + k[len("model.language_model."):]] = v
+            elif k == "lm_head.weight":
+                text_sd[k] = v
+        return super().convert_hf_state_dict(text_sd, config)
+
+    @classmethod
+    def convert_hf_vision_state_dict(cls, state_dict: Dict[str, np.ndarray],
+                                     config) -> Dict:
+        def norm_key(k):
+            return k[6:] if k.startswith("model.") else k
+
+        state_dict = {norm_key(k): v for k, v in state_dict.items()}
+        vc = config.vision_config
+        hidden = vc["hidden_size"]
+        qk_norm = bool(vc.get("use_qk_norm", False))
+
+        def get(name):
+            if name not in state_dict:
+                raise KeyError(f"missing weight {name}")
+            return np.asarray(state_dict[name])
+
+        def lin_t(name):
+            return np.ascontiguousarray(get(name).T)
+
+        keys = ["ln1", "ln1_b", "wq", "bq", "wk", "bk", "wv", "bv", "wo", "bo",
+                "ln2", "ln2_b", "w1", "b1", "w2", "b2"]
+        if qk_norm:
+            keys += ["q_norm", "q_norm_b", "k_norm", "k_norm_b"]
+        layers = {k: [] for k in keys}
+        for i in range(vc["num_hidden_layers"]):
+            p = f"vision_model.encoder.layers.{i}."
+            layers["ln1"].append(get(p + "layer_norm1.weight"))
+            layers["ln1_b"].append(get(p + "layer_norm1.bias"))
+            layers["wq"].append(lin_t(p + "self_attn.q_proj.weight"))
+            layers["bq"].append(get(p + "self_attn.q_proj.bias"))
+            layers["wk"].append(lin_t(p + "self_attn.k_proj.weight"))
+            layers["bk"].append(get(p + "self_attn.k_proj.bias"))
+            layers["wv"].append(lin_t(p + "self_attn.v_proj.weight"))
+            layers["bv"].append(get(p + "self_attn.v_proj.bias"))
+            layers["wo"].append(lin_t(p + "self_attn.projection_layer.weight"))
+            layers["bo"].append(get(p + "self_attn.projection_layer.bias"))
+            if qk_norm:
+                layers["q_norm"].append(get(p + "self_attn.q_norm.weight"))
+                layers["q_norm_b"].append(get(p + "self_attn.q_norm.bias"))
+                layers["k_norm"].append(get(p + "self_attn.k_norm.weight"))
+                layers["k_norm_b"].append(get(p + "self_attn.k_norm.bias"))
+            layers["ln2"].append(get(p + "layer_norm2.weight"))
+            layers["ln2_b"].append(get(p + "layer_norm2.bias"))
+            layers["w1"].append(lin_t(p + "mlp.fc1.weight"))
+            layers["b1"].append(get(p + "mlp.fc1.bias"))
+            layers["w2"].append(lin_t(p + "mlp.fc2.weight"))
+            layers["b2"].append(get(p + "mlp.fc2.bias"))
+
+        emb = "vision_model.embeddings."
+        conv = get(emb + "patch_embedding.weight")           # (H_vis, C, p, p)
+        depth = int(vc.get("depth", 2))
+        align_ws, align_bs = [], []
+        for i in range(depth - 1):
+            align_ws.append(lin_t(f"aligner.hidden_layers.{i}.weight"))
+            align_bs.append(get(f"aligner.hidden_layers.{i}.bias"))
+        return {
+            "patch_w": np.ascontiguousarray(conv.reshape(hidden, -1).T),
+            "patch_b": get(emb + "patch_embedding.bias"),
+            "pos_embed": get(emb + "position_embedding.weight"),
+            "layers": {k: np.stack(v) for k, v in layers.items()},
+            "ln_post": get("vision_model.post_layernorm.weight"),
+            "ln_post_b": get("vision_model.post_layernorm.bias"),
+            "align_w1": lin_t("aligner.fc1.weight"),
+            "align_b1": get("aligner.fc1.bias"),
+            "align_ws": align_ws,
+            "align_bs": align_bs,
+        }

@@ -19,48 +19,21 @@ import numpy as np
 
 from neuronx_distributed_inference_tpu.models.llama.modeling_llama import (
     LlamaForCausalLM, LlamaInferenceConfig)
-from neuronx_distributed_inference_tpu.ops.attention import attend
-from neuronx_distributed_inference_tpu.ops.norms import layer_norm
+from neuronx_distributed_inference_tpu.ops.vit import ViTSpec, vit_encode
 from neuronx_distributed_inference_tpu.runtime.image_to_text import (
     ImageToTextInferenceConfig, TpuModelForImageToText)
-
-
-def _quick_gelu(x):
-    return x * jax.nn.sigmoid(1.702 * x)
 
 
 def clip_vision_encode(vp: Dict[str, Any], pixel_values: jnp.ndarray, *,
                        patch_size: int, num_heads: int, eps: float,
                        drop_cls: bool) -> jnp.ndarray:
-    """(N, C, H, W) -> (N, T_img, H_text) CLIP ViT features through the projector."""
-    n, c, hh, ww = pixel_values.shape
-    gh, gw = hh // patch_size, ww // patch_size
-    # patch conv as an unfold + matmul (stride == kernel == patch_size)
-    x = pixel_values.reshape(n, c, gh, patch_size, gw, patch_size)
-    x = x.transpose(0, 2, 4, 1, 3, 5).reshape(n, gh * gw, -1)
-    h = x @ vp["patch_w"]                                   # (N, T, H_vis)
-    cls = jnp.broadcast_to(vp["cls"][None, None, :], (n, 1, h.shape[-1]))
-    h = jnp.concatenate([cls, h], axis=1)
-    h = h + vp["pos_embed"][None]
-    h = layer_norm(h, vp["ln_pre"], vp["ln_pre_b"], eps=eps)
-
-    d = h.shape[-1] // num_heads
-
-    def layer(carry, lp):
-        hh = carry
-        x = layer_norm(hh, lp["ln1"], lp["ln1_b"], eps=eps)
-        b, s, _ = x.shape
-        q = (x @ lp["wq"] + lp["bq"]).reshape(b, s, num_heads, d).transpose(0, 2, 1, 3)
-        k = (x @ lp["wk"] + lp["bk"]).reshape(b, s, num_heads, d).transpose(0, 2, 1, 3)
-        v = (x @ lp["wv"] + lp["bv"]).reshape(b, s, num_heads, d).transpose(0, 2, 1, 3)
-        a = attend(q, k, v)                                  # full bidirectional
-        a = a.transpose(0, 2, 1, 3).reshape(b, s, -1)
-        hh = hh + (a @ lp["wo"] + lp["bo"])
-        x = layer_norm(hh, lp["ln2"], lp["ln2_b"], eps=eps)
-        hh = hh + (_quick_gelu(x @ lp["w1"] + lp["b1"]) @ lp["w2"] + lp["b2"])
-        return hh, None
-
-    h, _ = jax.lax.scan(layer, h, vp["layers"])
+    """(N, C, H, W) -> (N, T_img, H_text) CLIP ViT features (shared ViT:
+    CLS + pre-LN + quick-GELU, no post-norm at feature layer -2) through the
+    2-layer GELU projector."""
+    spec = ViTSpec(patch_size=patch_size, num_heads=num_heads, eps=eps,
+                   act="quick_gelu", patch_bias=False, cls_token=True,
+                   pre_ln=True, post_ln=False)
+    h = vit_encode(vp, pixel_values, spec)
     if drop_cls:
         h = h[:, 1:]
     feats = jax.nn.gelu(h @ vp["proj_w1"] + vp["proj_b1"], approximate=False)

@@ -26,12 +26,25 @@ def _longrope_params(config):
     rs = getattr(config, "rope_scaling", None) or {}
     rtype = rs.get("rope_type", rs.get("type", "default"))
     if rtype != "longrope":
+        if rtype != "default":
+            raise NotImplementedError(
+                f"minicpm port supports rope_type 'longrope'/'default', got "
+                f"{rtype!r}")
         return None
     orig = rs.get("original_max_position_embeddings",
                   config.max_position_embeddings)
-    use_long = config.max_position_embeddings > orig
+    # static graphs must pick ONE factor set: choose by the context the engine
+    # actually serves (tpu seq_len when known, else the config window) — the
+    # long branch only engages when serving beyond the original window, so
+    # typical-length prompts keep HF's short_factor table
+    tc = getattr(config, "tpu_config", None)
+    served = (tc.seq_len if tc is not None
+              else config.max_position_embeddings)
+    use_long = served > orig
     factors = np.asarray(rs.get("long_factor" if use_long else "short_factor"),
                          np.float32)
+    # the cos/sin magnitude factor is a CONSTANT from the config window
+    # (HF longrope convention), independent of which factor table serves
     scale = config.max_position_embeddings / orig
     attn = (math.sqrt(1 + math.log(scale) / math.log(orig))
             if scale > 1.0 else 1.0)

@@ -1,0 +1,185 @@
+"""Ovis2 (AIDC visual-tokenizer multimodal; represents Ovis2.5) on the TPU
+framework (contrib port).
+
+≈ reference `contrib/models/Ovis2.5-9B/`. Ovis is architecturally unlike the
+projector VLMs: the AIMv2-style tower (RMSNorm blocks, silu-gated MLP,
+bias-free attention, patch-embed RMSNorm before learned positions) feeds a
+2x2 hidden-stride merge, then a linear+LayerNorm head produces a SOFTMAX
+distribution over a discrete *visual vocabulary*; image features are that
+probability vector times a learned visual embedding table (vte) — soft visual
+tokens in text-embedding space. The last ``num_visual_indicator_tokens`` vte
+rows are bound to the special indicator token ids (img_start/end etc.), whose
+text embeddings are REPLACED by their vte rows at prefill; served here by
+extending the shared base's feature scatter. Text backbone: qwen2.
+"""
+
+import functools
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neuronx_distributed_inference_tpu.models.qwen2.modeling_qwen2 import (
+    Qwen2ForCausalLM, Qwen2InferenceConfig)
+from neuronx_distributed_inference_tpu.ops.norms import layer_norm
+from neuronx_distributed_inference_tpu.ops.vit import ViTSpec, vit_encode
+from neuronx_distributed_inference_tpu.runtime.image_to_text import (
+    ImageToTextInferenceConfig, TpuModelForImageToText)
+
+
+def ovis2_vision_encode(vp: Dict[str, Any], pixel_values: jnp.ndarray, *,
+                        patch_size: int, num_heads: int, eps: float,
+                        ln_eps: float, hidden_stride: int,
+                        qkv_bias: bool) -> jnp.ndarray:
+    """(N, C, H, W) -> (N, T_img, H_text) soft visual tokens through the vte."""
+    n = pixel_values.shape[0]
+    gh = pixel_values.shape[2] // patch_size
+    gw = pixel_values.shape[3] // patch_size
+    spec = ViTSpec(patch_size=patch_size, num_heads=num_heads, eps=eps,
+                   norm="rms", mlp="gated_silu", attn_bias=qkv_bias,
+                   embed_rms=True)
+    h = vit_encode(vp, pixel_values, spec)
+
+    # 2x2 (hidden_stride) spatial merge: (gh/hs * gw/hs, hs^2 * d_vis)
+    hs = hidden_stride
+    hv = h.shape[-1]
+    grid = h.reshape(n, gh, gw, hv)
+    grid = grid.reshape(n, gh // hs, hs, gw // hs, hs, hv)
+    merged = grid.transpose(0, 1, 3, 2, 4, 5).reshape(
+        n, (gh // hs) * (gw // hs), hs * hs * hv)
+
+    logits = merged @ vp["head_w"]
+    logits = layer_norm(logits, vp["head_norm"], vp["head_norm_b"], eps=ln_eps)
+    probs = jax.nn.softmax(logits, axis=-1)       # (N, T, V_vis - n_indicator)
+    # zero-padded indicator probabilities contribute nothing: use the vte slice
+    return probs @ vp["vte"]                      # (N, T, H_text)
+
+
+class Ovis2InferenceConfig(ImageToTextInferenceConfig, Qwen2InferenceConfig):
+    REQUIRED_ATTRIBUTES = ("vision_config",)
+
+    def add_derived_config(self) -> None:
+        ImageToTextInferenceConfig.add_derived_config(self)
+        Qwen2InferenceConfig.add_derived_config(self)
+        if not hasattr(self, "image_token_index"):
+            self.image_token_index = getattr(self, "image_token_id", None)
+        if self.image_token_index is None:
+            raise ValueError("ovis2 config needs image_token_id")
+        if not hasattr(self, "visual_indicator_token_ids"):
+            self.visual_indicator_token_ids = []
+
+
+class Ovis2ForConditionalGeneration(TpuModelForImageToText, Qwen2ForCausalLM):
+    """≈ HF Ovis2ForConditionalGeneration."""
+
+    def __init__(self, model_path, config, mesh=None):
+        super().__init__(model_path, config, mesh=mesh)
+        self._indicator_feats = None    # (n_indicator, H_text), host
+
+    @classmethod
+    def get_config_cls(cls):
+        return Ovis2InferenceConfig
+
+    def vision_encode_fn(self):
+        vc = self.config.vision_config
+        return functools.partial(
+            ovis2_vision_encode,
+            patch_size=vc["patch_size"],
+            num_heads=vc["num_attention_heads"],
+            eps=vc.get("rms_norm_eps", 1e-5),
+            ln_eps=1e-5,
+            hidden_stride=int(vc.get("hidden_stride", 1)),
+            qkv_bias=bool(vc.get("qkv_bias", True)),
+        )
+
+    @classmethod
+    def convert_hf_state_dict(cls, state_dict: Dict[str, np.ndarray],
+                              config) -> Dict:
+        text_sd = {}
+        for k, v in state_dict.items():
+            if k.startswith("model.language_model."):
+                text_sd["model." + k[len("model.language_model."):]] = v
+            elif k == "lm_head.weight":
+                text_sd[k] = v
+        return super().convert_hf_state_dict(text_sd, config)
+
+    @classmethod
+    def convert_hf_vision_state_dict(cls, state_dict: Dict[str, np.ndarray],
+                                     config) -> Dict:
+        def norm_key(k):
+            return k[6:] if k.startswith("model.") else k
+
+        state_dict = {norm_key(k): v for k, v in state_dict.items()}
+        vc = config.vision_config
+        hidden = vc["hidden_size"]
+        qkv_bias = bool(vc.get("qkv_bias", True))
+        n_ind = int(vc.get("num_visual_indicator_tokens", 0))
+
+        def get(name):
+            if name not in state_dict:
+                raise KeyError(f"missing weight {name}")
+            return np.asarray(state_dict[name])
+
+        def lin_t(name):
+            return np.ascontiguousarray(get(name).T)
+
+        keys = ["ln1", "wq", "wk", "wv", "wo", "ln2", "wg", "wu", "wd"]
+        if qkv_bias:
+            keys += ["bq", "bk", "bv", "bo"]
+        layers = {k: [] for k in keys}
+        for i in range(vc["num_hidden_layers"]):
+            p = f"vision_tower.transformer.encoder.layers.{i}."
+            layers["ln1"].append(get(p + "rms_norm1.weight"))
+            layers["wq"].append(lin_t(p + "attention.q_proj.weight"))
+            layers["wk"].append(lin_t(p + "attention.k_proj.weight"))
+            layers["wv"].append(lin_t(p + "attention.v_proj.weight"))
+            layers["wo"].append(lin_t(p + "attention.out_proj.weight"))
+            if qkv_bias:
+                layers["bq"].append(get(p + "attention.q_proj.bias"))
+                layers["bk"].append(get(p + "attention.k_proj.bias"))
+                layers["bv"].append(get(p + "attention.v_proj.bias"))
+                layers["bo"].append(get(p + "attention.out_proj.bias"))
+            layers["ln2"].append(get(p + "rms_norm2.weight"))
+            layers["wg"].append(lin_t(p + "ffn.gate_proj.weight"))
+            layers["wu"].append(lin_t(p + "ffn.up_proj.weight"))
+            layers["wd"].append(lin_t(p + "ffn.down_proj.weight"))
+
+        emb = "vision_tower.transformer.embeddings."
+        conv = get(emb + "patch_embedding.weight")           # (H_vis, C, p, p)
+        vte = get("visual_embeddings_table.weight")          # (V_vis, H_text)
+        return {
+            "patch_w": np.ascontiguousarray(conv.reshape(hidden, -1).T),
+            "patch_b": get(emb + "patch_embedding.bias"),
+            "embed_norm": get(emb + "rms_norm.weight"),
+            "pos_embed": get(emb + "position_embedding.weight"),
+            "layers": {k: np.stack(v) for k, v in layers.items()},
+            "ln_post": get("vision_tower.transformer.rms_norm.weight"),
+            "head_w": lin_t("vision_tower.head_linear.weight"),
+            "head_norm": get("vision_tower.head_norm.weight"),
+            "head_norm_b": get("vision_tower.head_norm.bias"),
+            # image soft tokens use the non-indicator vte slice; the tail rows
+            # are the indicator embeddings, swapped in at their token positions
+            "vte": vte[: vte.shape[0] - n_ind] if n_ind else vte,
+            "vte_indicators": vte[vte.shape[0] - n_ind:] if n_ind else vte[:0],
+        }
+
+    def _put_vision_params(self, host: Dict) -> None:
+        self._indicator_feats = np.asarray(host.pop("vte_indicators"),
+                                           np.float32)
+        super()._put_vision_params(host)
+
+    def _scatter_features(self, padded, flat_feats):
+        """Image soft tokens at image positions + vte rows at the visual
+        indicator token positions (HF Ovis2Model.forward's second scatter)."""
+        mask, override = super()._scatter_features(padded, flat_feats)
+        ind_ids = list(self.config.visual_indicator_token_ids or [])
+        if ind_ids and self._indicator_feats is not None \
+                and len(self._indicator_feats):
+            ids = np.asarray(padded.input_ids)
+            for i, tok in enumerate(ind_ids):
+                m = ids == tok
+                if m.any():
+                    override[m] = self._indicator_feats[i]
+                    mask = mask | m[..., None]
+        return mask, override

@@ -79,6 +79,8 @@ CONTRIB_MODELS = {
                "Gemma3ForConditionalGeneration"),
     "gemma3_vision": ("contrib.models.gemma3_vision.src.modeling_gemma3_vision:"
                       "Gemma3ForConditionalGeneration"),
+    "janus": "contrib.models.janus.src.modeling_janus:JanusForConditionalGeneration",
+    "ovis2": "contrib.models.ovis2.src.modeling_ovis2:Ovis2ForConditionalGeneration",
 }
 
 for model_type, path in CONTRIB_MODELS.items():

@@ -1,0 +1,116 @@
+"""Shared ViT encoder for vision towers (CLIP / SigLIP / Janus / AIMv2 shapes).
+
+One scanned pre-norm transformer parameterized by the few axes the tower
+families actually differ on — norm type (LayerNorm vs RMSNorm), MLP kind
+(plain GELU-variant vs silu-gated), activation, CLS token, embedding pre-norms,
+optional per-head q/k LayerNorm — so llava (CLIP), gemma3-vision (SigLIP),
+janus, and ovis2 (AIMv2) share a single implementation. Each family keeps its
+own head/projector on the returned hidden states.
+
+The patch conv runs as an unfold + matmul (stride == kernel == patch_size), so
+``patch_w`` is the HF conv weight (H_vis, C, p, p) reshaped to (C*p*p, H_vis).
+
+≈ reference: each contrib VLM re-implements its tower in torch
+(`contrib/models/{llava-v1.5-7b,gemma3-vision,...}/src`); here the XLA scan
+serves them all.
+"""
+
+from dataclasses import dataclass
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from .norms import layer_norm, rms_norm
+
+__all__ = ["ViTSpec", "vit_encode"]
+
+
+@dataclass(frozen=True)
+class ViTSpec:
+    patch_size: int
+    num_heads: int
+    eps: float
+    norm: str = "layer"          # "layer" (biased LayerNorm) | "rms"
+    act: str = "gelu_tanh"       # "gelu_tanh" | "gelu" | "quick_gelu"
+    mlp: str = "plain"           # "plain" (fc1 -> act -> fc2) | "gated_silu"
+    attn_bias: bool = True       # biases on q/k/v/o projections
+    patch_bias: bool = True      # bias on the patch conv
+    cls_token: bool = False      # CLIP prepends a learned CLS row
+    pre_ln: bool = False         # CLIP pre_layrnorm after embeddings
+    embed_rms: bool = False      # AIMv2 RMSNorm on patch embeds before pos
+    post_ln: bool = True         # final post-norm over the last hidden state
+    qk_norm: bool = False        # per-head LayerNorm on q/k (janus option)
+
+
+def _act(spec: ViTSpec, x):
+    if spec.act == "quick_gelu":
+        return x * jax.nn.sigmoid(1.702 * x)
+    if spec.act == "gelu":
+        return jax.nn.gelu(x, approximate=False)
+    return jax.nn.gelu(x, approximate=True)          # tanh approximation
+
+
+def _norm(spec: ViTSpec, x, w, b):
+    if spec.norm == "rms":
+        return rms_norm(x, w, spec.eps)
+    return layer_norm(x, w, b, eps=spec.eps)
+
+
+def vit_encode(vp: Dict[str, Any], pixel_values: jnp.ndarray,
+               spec: ViTSpec) -> jnp.ndarray:
+    """(N, C, H, W) -> (N, T(+1 if cls), H_vis) tower hidden states."""
+    n, c, hh, ww = pixel_values.shape
+    p = spec.patch_size
+    gh, gw = hh // p, ww // p
+    x = pixel_values.reshape(n, c, gh, p, gw, p)
+    x = x.transpose(0, 2, 4, 1, 3, 5).reshape(n, gh * gw, -1)
+    h = x @ vp["patch_w"]
+    if spec.patch_bias:
+        h = h + vp["patch_b"]
+    if spec.embed_rms:
+        h = rms_norm(h, vp["embed_norm"], spec.eps)
+    if spec.cls_token:
+        cls = jnp.broadcast_to(vp["cls"][None, None, :], (n, 1, h.shape[-1]))
+        h = jnp.concatenate([cls, h], axis=1)
+    h = h + vp["pos_embed"][None]
+    if spec.pre_ln:
+        h = _norm(spec, h, vp["ln_pre"], vp.get("ln_pre_b"))
+
+    d = h.shape[-1] // spec.num_heads
+
+    def layer(hh, lp):
+        x = _norm(spec, hh, lp["ln1"], lp.get("ln1_b"))
+        b, s, _ = x.shape
+
+        def proj(wk, bk):
+            y = x @ lp[wk]
+            if spec.attn_bias:
+                y = y + lp[bk]
+            return y.reshape(b, s, spec.num_heads, d)
+
+        q, k = proj("wq", "bq"), proj("wk", "bk")
+        if spec.qk_norm:
+            q = layer_norm(q, lp["q_norm"], lp["q_norm_b"], eps=spec.eps)
+            k = layer_norm(k, lp["k_norm"], lp["k_norm_b"], eps=spec.eps)
+        q = q.transpose(0, 2, 1, 3)
+        k = k.transpose(0, 2, 1, 3)
+        v = proj("wv", "bv").transpose(0, 2, 1, 3)
+        from .attention import attend
+        a = attend(q, k, v)                          # full bidirectional
+        a = a.transpose(0, 2, 1, 3).reshape(b, s, -1)
+        a = a @ lp["wo"]
+        if spec.attn_bias:
+            a = a + lp["bo"]
+        hh = hh + a
+        x = _norm(spec, hh, lp["ln2"], lp.get("ln2_b"))
+        if spec.mlp == "gated_silu":
+            m = (jax.nn.silu(x @ lp["wg"]) * (x @ lp["wu"])) @ lp["wd"]
+        else:
+            m = _act(spec, x @ lp["w1"] + lp["b1"]) @ lp["w2"] + lp["b2"]
+        return hh + m, None
+
+    h, _ = jax.lax.scan(layer, h, vp["layers"])
+    if spec.post_ln:
+        h = _norm(spec, h, vp["ln_post"], vp.get("ln_post_b"))
+    return h
