@@ -1,0 +1,614 @@
+"""IDEFICS (Flamingo-style gated cross-attention VLM) on the TPU framework
+(contrib port).
+
+≈ reference `contrib/models/idefics-9b-instruct/`. Unlike the projector VLMs,
+IDEFICS conditions a llama-shaped LM on images through GATED CROSS-ATTENTION
+blocks inserted before every ``cross_layer_interval``-th decoder layer:
+h += tanh(alpha_cross)·cross_attn(ln(h), img); h += tanh(alpha_dense)·mlp(ln(h)),
+with rows attending no image hard-zeroed (cross_attention_gate). Vision side:
+a CLIP tower (shared ops/vit.py) optionally compressed by the PERCEIVER
+RESAMPLER (latents cross-attending [context; latents], stable softmax).
+TPU design mirrors the mllama family: cross k/v are computed once at prefill
+and ride the cache pytree; the decode visibility row (last prompt token's
+image_attention_mask) rides along as ``xmask_dec``. Extras vs llama: no GQA,
+optional POST-rope per-head q/k RMSNorm, decoupled embeddings/lm_head
+(additional vocab rows concatenated at conversion).
+"""
+
+import functools
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neuronx_distributed_inference_tpu.config import InferenceConfig
+from neuronx_distributed_inference_tpu.models.base import (
+    ModelArchArgs, causal_mask)
+from neuronx_distributed_inference_tpu.ops import rope as rope_ops
+from neuronx_distributed_inference_tpu.ops.attention import attend
+from neuronx_distributed_inference_tpu.ops.norms import layer_norm, rms_norm
+from neuronx_distributed_inference_tpu.ops.vit import ViTSpec, vit_encode
+from neuronx_distributed_inference_tpu.runtime.application import (
+    TpuModelForCausalLM)
+
+
+@dataclass(frozen=True)
+class IdeficsArchArgs(ModelArchArgs):
+    cross_layer_interval: int = 1
+    vision_tokens: int = 0          # num_images * tokens_per_image (static)
+    qk_layer_norms: bool = False
+
+
+# --- vision: CLIP tower + optional perceiver resampler ---------------------------
+
+
+def idefics_vision_encode(vp: Dict[str, Any], pixel_values: jnp.ndarray, *,
+                          patch_size: int, num_heads: int, eps: float,
+                          resampler: bool, perceiver_heads: int,
+                          perceiver_qk_norms: bool) -> jnp.ndarray:
+    """(N_img, C, H, W) -> (N_img, T_img, H_vis) image hidden states."""
+    # HF IdeficsVisionTransformer post-norms only the pooled CLS output; the
+    # last_hidden_state fed to the perceiver/cross-attention is UN-normed
+    spec = ViTSpec(patch_size=patch_size, num_heads=num_heads, eps=eps,
+                   act="gelu", patch_bias=False, cls_token=True, pre_ln=True,
+                   post_ln=False)
+    h = vit_encode(vp, pixel_values, spec)          # (N, 1+T, H_vis) incl CLS
+    if not resampler:
+        return h
+
+    pp = vp["perceiver"]
+    n = h.shape[0]
+    latents = jnp.broadcast_to(pp["latents"][None], (n,) + pp["latents"].shape)
+
+    def block(lat, lp):
+        ctx = layer_norm(h, lp["ctx_ln"], lp["ctx_ln_b"], eps=1e-5)
+        ql = layer_norm(lat, lp["lat_ln"], lp["lat_ln_b"], eps=1e-5)
+        kv_in = jnp.concatenate([ctx, ql], axis=1)
+        d = lp["wq"].shape[1] // perceiver_heads
+        b, s_l, _ = ql.shape
+        s_kv = kv_in.shape[1]
+        q = (ql @ lp["wq"]).reshape(b, s_l, perceiver_heads, d
+                                    ).transpose(0, 2, 1, 3)
+        k = (kv_in @ lp["wk"]).reshape(b, s_kv, perceiver_heads, d
+                                       ).transpose(0, 2, 1, 3)
+        v = (kv_in @ lp["wv"]).reshape(b, s_kv, perceiver_heads, d
+                                       ).transpose(0, 2, 1, 3)
+        if perceiver_qk_norms:
+            q = layer_norm(q, lp["q_ln"], lp["q_ln_b"], eps=1e-5)
+            k = layer_norm(k, lp["k_ln"], lp["k_ln_b"], eps=1e-5)
+        a = attend(q, k, v)
+        a = a.transpose(0, 2, 1, 3).reshape(b, s_l, -1)
+        lat = lat + a @ lp["wo"]
+        x = layer_norm(lat, lp["mlp_ln"], lp["mlp_ln_b"], eps=1e-5)
+        lat = lat + jax.nn.relu(x @ lp["fc"]) @ lp["c_proj"]
+        return lat, None
+
+    latents, _ = jax.lax.scan(block, latents, pp["blocks"])
+    return layer_norm(latents, pp["out_ln"], pp["out_ln_b"], eps=1e-5)
+
+
+# --- text stack -------------------------------------------------------------------
+
+
+def _qk_head_norm(lp, args, q, k):
+    q = rms_norm(q, lp["q_ln"], args.rms_norm_eps)
+    k = rms_norm(k, lp["k_ln"], args.rms_norm_eps)
+    return q, k
+
+
+def _self_layer(lp, args: IdeficsArchArgs, h, cos, sin, mask, k_cache, v_cache,
+                positions, bucket):
+    b, t, _ = h.shape
+    n, d = args.num_heads, args.head_dim
+    hn = rms_norm(h, lp["ln1"], args.rms_norm_eps)
+    q = (hn @ lp["wq"]).reshape(b, t, n, d).transpose(0, 2, 1, 3)
+    k = (hn @ lp["wk"]).reshape(b, t, n, d).transpose(0, 2, 1, 3)
+    v = (hn @ lp["wv"]).reshape(b, t, n, d).transpose(0, 2, 1, 3)
+    q, k = rope_ops.apply_rotary(q, k, cos, sin)
+    # NOTE: config.qk_layer_norms applies to the CROSS attention only — HF's
+    # IdeficsDecoderLayer builds its self-attention without them
+    if positions is None:
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k.astype(k_cache.dtype), (0, 0, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v.astype(v_cache.dtype), (0, 0, 0, 0))
+        k_att, v_att = k, v
+    else:
+        def _one(row_c, row_n, p):
+            return jax.lax.dynamic_update_slice(
+                row_c, row_n.astype(row_c.dtype), (0, p, 0))
+
+        k_cache = jax.vmap(_one)(k_cache, k, positions)
+        v_cache = jax.vmap(_one)(v_cache, v, positions)
+        k_att = jax.lax.slice_in_dim(k_cache, 0, bucket, axis=2).astype(q.dtype)
+        v_att = jax.lax.slice_in_dim(v_cache, 0, bucket, axis=2).astype(q.dtype)
+    attn = attend(q, k_att, v_att, mask=mask)
+    attn = attn.transpose(0, 2, 1, 3).reshape(b, t, n * d)
+    h = h + attn @ lp["wo"]
+    hn = rms_norm(h, lp["ln2"], args.rms_norm_eps)
+    h = h + (jax.nn.silu(hn @ lp["wg"]) * (hn @ lp["wu"])) @ lp["wd"]
+    return h, k_cache, v_cache
+
+
+def _cross_block(lp, args: IdeficsArchArgs, h, xk, xv, xmask, xgate):
+    """xk/xv (B, H, T_vis, D) precomputed image KV; xmask (B, S, T_vis) bool;
+    xgate (B, S, 1) float zeroing rows that attend no image."""
+    b, t, _ = h.shape
+    n, d = args.num_heads, args.head_dim
+    hn = rms_norm(h, lp["ln1"], args.rms_norm_eps)
+    q = (hn @ lp["wq"]).reshape(b, t, n, d).transpose(0, 2, 1, 3)
+    k, v = xk.astype(q.dtype), xv.astype(q.dtype)
+    if args.qk_layer_norms:
+        q, k = _qk_head_norm(lp, args, q, k)
+    # a fully-masked row would softmax over -inf only; give it one fake slot
+    # (the xgate zero erases its output)
+    safe_mask = jnp.logical_or(xmask, ~xmask.any(-1, keepdims=True))
+    attn = attend(q, k, v, mask=safe_mask[:, None])
+    attn = attn.transpose(0, 2, 1, 3).reshape(b, t, n * d)
+    attn = (attn @ lp["wo"]) * xgate.astype(h.dtype)
+    h = h + jnp.tanh(lp["alpha_cross"]) * attn
+    hn = rms_norm(h, lp["ln2"], args.rms_norm_eps)
+    mlp = (jax.nn.silu(hn @ lp["wg"]) * (hn @ lp["wu"])) @ lp["wd"]
+    return h + jnp.tanh(lp["alpha_dense"]) * mlp
+
+
+def _compute_cross_kv(params, args: IdeficsArchArgs, image_states):
+    """image_states (B, T_vis, H_vis) -> per-cross-layer (B, H, T_vis, D)."""
+    b, tv, _ = image_states.shape
+    n, d = args.num_heads, args.head_dim
+    xks, xvs = [], []
+    for lp in params["cross_layers"]:
+        xk = (image_states @ lp["wk"]).reshape(b, tv, n, d).transpose(0, 2, 1, 3)
+        xv = (image_states @ lp["wv"]).reshape(b, tv, n, d).transpose(0, 2, 1, 3)
+        xks.append(xk)
+        xvs.append(xv)
+    return jnp.stack(xks), jnp.stack(xvs)
+
+
+def _run_stack(params, args: IdeficsArchArgs, h, cos, sin, mask, cache,
+               xmask, xgate, positions, bucket):
+    ks, vs = [], []
+    xi = 0
+    for i in range(args.num_layers):
+        if i % args.cross_layer_interval == 0:
+            h = _cross_block(params["cross_layers"][i // args.cross_layer_interval],
+                             args, h, cache["xk"][xi], cache["xv"][xi],
+                             xmask, xgate)
+            xi += 1
+        lp = {k: v[i] for k, v in params["layers"].items()}  # stacked arrays
+        h, kc, vc = _self_layer(lp, args, h, cos, sin, mask, cache["k"][i],
+                                cache["v"][i], positions, bucket)
+        ks.append(kc)
+        vs.append(vc)
+    h = rms_norm(h, params["final_norm"], args.rms_norm_eps)
+    out = {"k": jnp.stack(ks), "v": jnp.stack(vs), "xk": cache["xk"],
+           "xv": cache["xv"], "xmask_dec": cache["xmask_dec"]}
+    return h, out
+
+
+def _logits(params, h):
+    out = h @ params["lm_head"]
+    if "lm_head_extra" in params:
+        out = jnp.concatenate([out, h @ params["lm_head_extra"]], axis=-1)
+    return out.astype(jnp.float32)
+
+
+def prefill_forward(params, args: IdeficsArchArgs, input_ids, position_ids,
+                    last_token_idx, cache, image_states, xmask, xmask_dec,
+                    mesh=None, rules=None, **_ignored):
+    h = jnp.take(params["embed"], input_ids, axis=0)
+    t = input_ids.shape[1]
+    cos, sin = rope_ops.compute_cos_sin(params["rope_inv_freq"], position_ids)
+    mask = (position_ids[:, None, :, None] >= position_ids[:, None, None, :])
+    mask &= causal_mask(t, t)[None, None]
+    xk, xv = _compute_cross_kv(params, args, image_states)
+    cache = dict(cache, xk=xk, xv=xv, xmask_dec=xmask_dec)
+    xgate = xmask.any(-1, keepdims=True).astype(jnp.float32)
+    h, out_cache = _run_stack(params, args, h, cos, sin, mask, cache,
+                              xmask, xgate, None, None)
+    h_last = jnp.take_along_axis(h, last_token_idx[:, None, None], axis=1)[:, 0]
+    return _logits(params, h_last), out_cache
+
+
+def decode_forward(params, args: IdeficsArchArgs, input_ids, position_ids,
+                   cache, decode_bucket, mesh=None, rules=None, tree=None,
+                   **_ignored):
+    if input_ids.shape[1] != 1 or tree is not None:
+        raise ValueError("idefics decode is single-token only in this port")
+    h = jnp.take(params["embed"], input_ids, axis=0)
+    pos_grid = position_ids[:, None]
+    cos, sin = rope_ops.compute_cos_sin(params["rope_inv_freq"], pos_grid)
+    kv_pos = jnp.arange(decode_bucket)[None, None, None, :]
+    mask = kv_pos <= pos_grid[:, None, :, None]
+    xmask = cache["xmask_dec"][:, None, :]                     # (B, 1, T_vis)
+    xgate = xmask.any(-1, keepdims=True).astype(jnp.float32)
+    h, out_cache = _run_stack(params, args, h, cos, sin, mask, cache,
+                              xmask, xgate, position_ids, decode_bucket)
+    return _logits(params, h), out_cache
+
+
+# --- application ------------------------------------------------------------------
+
+
+class IdeficsInferenceConfig(InferenceConfig):
+    REQUIRED_ATTRIBUTES = ("hidden_size", "num_hidden_layers",
+                           "num_attention_heads", "vocab_size",
+                           "intermediate_size", "vision_config")
+
+    def add_derived_config(self) -> None:
+        for attr, default in (("rope_theta", 10000.0), ("rms_norm_eps", 1e-6),
+                              ("cross_layer_interval", 1),
+                              ("qk_layer_norms", False),
+                              ("additional_vocab_size", 0),
+                              ("max_num_images", 1),
+                              ("tie_word_embeddings", False)):
+            if not hasattr(self, attr) or getattr(self, attr) is None:
+                setattr(self, attr, default)
+        if not isinstance(self.vision_config, dict):
+            self.vision_config = self.vision_config.to_dict()
+        if hasattr(self, "perceiver_config") \
+                and not isinstance(self.perceiver_config, dict):
+            self.perceiver_config = self.perceiver_config.to_dict()
+        if not hasattr(self, "perceiver_config"):
+            self.perceiver_config = {}
+        if hasattr(self, "use_resampler"):
+            self.perceiver_config["use_resampler"] = bool(self.use_resampler)
+        if not hasattr(self, "head_dim") or self.head_dim is None:
+            self.head_dim = self.hidden_size // self.num_attention_heads
+
+    def tokens_per_image(self) -> int:
+        pc = self.perceiver_config
+        if pc.get("use_resampler"):
+            return int(pc["resampler_n_latents"])
+        vc = self.vision_config
+        return (vc["image_size"] // vc["patch_size"]) ** 2 + 1   # incl CLS
+
+
+class IdeficsForVisionText2Text(TpuModelForCausalLM):
+    """≈ HF IdeficsForVisionText2Text."""
+
+    def __init__(self, model_path, config, mesh=None):
+        self._require_base_layout(config.tpu_config, "IDEFICS")
+        super().__init__(model_path, config, mesh=mesh)
+        self.vision_params = None
+        vc = config.vision_config
+        pc = config.perceiver_config
+        self._encode_fn = functools.partial(
+            idefics_vision_encode,
+            patch_size=vc["patch_size"],
+            num_heads=vc["num_attention_heads"],
+            eps=vc.get("layer_norm_eps", 1e-5),
+            resampler=bool(pc.get("use_resampler")),
+            perceiver_heads=int(pc.get("resampler_n_heads", 1)),
+            perceiver_qk_norms=bool(pc.get("qk_layer_norms_perceiver")),
+        )
+        self._xprefill_step = jax.jit(self._make_xprefill(), donate_argnums=(5,))
+
+    @classmethod
+    def get_config_cls(cls):
+        return IdeficsInferenceConfig
+
+    @classmethod
+    def arch_args_from_config(cls, config) -> IdeficsArchArgs:
+        return IdeficsArchArgs(
+            vocab_size=config.vocab_size + config.additional_vocab_size,
+            hidden_size=config.hidden_size,
+            num_layers=config.num_hidden_layers,
+            num_heads=config.num_attention_heads,
+            num_kv_heads=config.num_attention_heads,   # no GQA in idefics
+            head_dim=config.head_dim,
+            intermediate_size=config.intermediate_size,
+            rms_norm_eps=config.rms_norm_eps,
+            tie_word_embeddings=bool(config.tie_word_embeddings),
+            cross_layer_interval=int(config.cross_layer_interval),
+            vision_tokens=int(config.max_num_images)
+            * config.tokens_per_image(),
+            qk_layer_norms=bool(config.qk_layer_norms),
+        )
+
+    def prefill_fn(self):
+        a = self.arch_args
+
+        def _text_only(params, args, input_ids, position_ids, last_token_idx,
+                       cache, mesh=None, rules=None, **_):
+            b, s = input_ids.shape
+            vc = self.config.vision_config
+            h_vis = vc["embed_dim"]
+            zeros = jnp.zeros((b, a.vision_tokens, h_vis),
+                              dtype=self.tpu_config.jax_dtype)
+            xmask = jnp.zeros((b, s, a.vision_tokens), dtype=bool)
+            xmask_dec = jnp.zeros((b, a.vision_tokens), dtype=bool)
+            return prefill_forward(params, args, input_ids, position_ids,
+                                   last_token_idx, cache, zeros, xmask,
+                                   xmask_dec, mesh=mesh, rules=rules)
+
+        return _text_only
+
+    def decode_fn(self):
+        return decode_forward
+
+    def _make_xprefill(self):
+        args = self.arch_args
+        odsc = self.sampling_config
+        from neuronx_distributed_inference_tpu.ops import sampling as sampling_ops
+
+        precision = ("highest" if self.tpu_config.dtype == "float32"
+                     else "default")
+
+        def _prefill_mm(params, vision_params, input_ids, position_ids,
+                        last_token_idx, cache, sampling_params, key,
+                        pixel_values, xmask, xmask_dec):
+            with jax.default_matmul_precision(precision):
+                b = input_ids.shape[0]
+                n_img = pixel_values.shape[1]
+                flat = pixel_values.reshape((b * n_img,) + pixel_values.shape[2:])
+                img = self._encode_fn(vision_params, flat)
+                img = img.reshape(b, -1, img.shape[-1])    # (B, T_vis, H_vis)
+                logits, cache = prefill_forward(
+                    params, args, input_ids, position_ids, last_token_idx,
+                    cache, img.astype(self.tpu_config.jax_dtype), xmask,
+                    xmask_dec)
+                tokens = sampling_ops.sample(logits, sampling_params, key, odsc)
+            return tokens, logits, cache
+
+        return _prefill_mm
+
+    @classmethod
+    def inv_freq_from_config(cls, config) -> np.ndarray:
+        return rope_ops.default_inv_freq(config.head_dim,
+                                         float(config.rope_theta))
+
+    def reset_cache(self, batch_size: Optional[int] = None) -> None:
+        a: IdeficsArchArgs = self.arch_args
+        b = batch_size or self.tpu_config.max_batch_size
+        dt = self.tpu_config.jax_dtype
+        n_cross = (a.num_layers + a.cross_layer_interval - 1) \
+            // a.cross_layer_interval
+        self.kv_cache = {
+            "k": jnp.zeros((a.num_layers, b, a.num_heads,
+                            self.tpu_config.seq_len, a.head_dim), dt),
+            "v": jnp.zeros((a.num_layers, b, a.num_heads,
+                            self.tpu_config.seq_len, a.head_dim), dt),
+            "xk": jnp.zeros((n_cross, b, a.num_heads, a.vision_tokens,
+                             a.head_dim), dt),
+            "xv": jnp.zeros((n_cross, b, a.num_heads, a.vision_tokens,
+                             a.head_dim), dt),
+            "xmask_dec": jnp.zeros((b, a.vision_tokens), dtype=bool),
+        }
+
+    def _put_params(self, host_params) -> None:
+        dtype = self.tpu_config.jax_dtype
+
+        def _put(x):
+            arr = np.asarray(x)
+            if arr.dtype.kind == "f":
+                arr = arr.astype(dtype)
+            return jax.device_put(arr)
+
+        params = jax.tree.map(_put, host_params)
+        params["rope_inv_freq"] = jax.device_put(
+            np.asarray(host_params["rope_inv_freq"], np.float32))
+        self.params = params
+        self.reset_cache()
+
+    def init_random_params(self, key):
+        raise NotImplementedError("load from an HF checkpoint or state dict")
+
+    def _post_load_state_dict(self, state_dict) -> None:
+        host = self.convert_hf_vision_state_dict(state_dict, self.config)
+        dtype = self.tpu_config.jax_dtype
+
+        def _put(x):
+            arr = np.asarray(x)
+            if arr.dtype.kind == "f" or arr.dtype.name == "bfloat16":
+                arr = arr.astype(dtype)
+            return jax.device_put(arr)
+
+        self.vision_params = jax.tree.map(_put, host)
+
+    load_vision_from_state_dict = _post_load_state_dict
+
+    # --- generate ------------------------------------------------------------------
+    def generate(self, input_ids, pixel_values=None, image_attention_mask=None,
+                 **kwargs):
+        """pixel_values (B, num_images, C, H, W); image_attention_mask
+        (B, S, num_images) 0/1 per HF processor (default: attend all)."""
+        if pixel_values is None:
+            return super().generate(input_ids, **kwargs)
+        pixel_values = np.asarray(pixel_values, dtype=np.float32)
+        b, s = np.asarray(input_ids).shape
+        n_img = pixel_values.shape[1]
+        m_max = int(self.config.max_num_images)
+        if n_img > m_max:
+            raise ValueError(
+                f"request carries {n_img} images but the graph was compiled "
+                f"for max_num_images={m_max}; raise config.max_num_images")
+        if image_attention_mask is None:
+            image_attention_mask = np.ones((b, s, n_img), dtype=np.int32)
+        iam = np.asarray(image_attention_mask, dtype=np.int32)
+        if n_img < m_max:   # pad the image axis to the compiled static shape
+            pad_n = m_max - n_img
+            pixel_values = np.concatenate(
+                [pixel_values, np.zeros((pixel_values.shape[0], pad_n)
+                                        + pixel_values.shape[2:],
+                                        pixel_values.dtype)], axis=1)
+            iam = np.concatenate(
+                [iam, np.zeros(iam.shape[:2] + (pad_n,), iam.dtype)], axis=2)
+        mm = {"pixel_values": pixel_values, "image_attention_mask": iam}
+        return super().generate(input_ids, _mm_embeds=mm, **kwargs)
+
+    def _run_prefill(self, padded, sampling_params, key, adapter_ids, mm=None):
+        if mm is None:
+            return super()._run_prefill(padded, sampling_params, key,
+                                        adapter_ids)
+        a: IdeficsArchArgs = self.arch_args
+        b, s = padded.input_ids.shape
+        tpi = self.config.tokens_per_image()
+        iam = mm["image_attention_mask"]                 # (B_in, S_in, n_img)
+        allowed = np.repeat(iam, tpi, axis=2).astype(bool)
+        xmask = np.zeros((b, s, a.vision_tokens), dtype=bool)
+        s_in = min(allowed.shape[1], s)
+        xmask[:allowed.shape[0], :s_in, :allowed.shape[2]] = allowed[:, :s_in]
+        last = np.asarray(padded.last_token_idx)
+        xmask_dec = xmask[np.arange(b), np.minimum(last, s - 1)]
+        pix = mm["pixel_values"]
+        if pix.shape[0] < b:
+            pad = np.zeros((b - pix.shape[0],) + pix.shape[1:], pix.dtype)
+            pix = np.concatenate([pix, pad], axis=0)
+        return self._xprefill_step(
+            self.params, self.vision_params, padded.input_ids,
+            padded.position_ids, padded.last_token_idx, self.kv_cache,
+            sampling_params, key, pix, xmask, xmask_dec)
+
+    # --- conversion ----------------------------------------------------------------
+    @classmethod
+    def convert_hf_state_dict(cls, state_dict: Dict[str, np.ndarray],
+                              config) -> Dict:
+        def get(name):
+            if name not in state_dict:
+                raise KeyError(f"missing weight {name}")
+            return np.asarray(state_dict[name])
+
+        def lin_t(name):
+            return np.ascontiguousarray(get(name).T)
+
+        qk = bool(config.qk_layer_norms)   # cross-attention layers only
+        self_keys = ["ln1", "wq", "wk", "wv", "wo", "ln2", "wg", "wu", "wd"]
+        layers = {k: [] for k in self_keys}
+        cross = []
+        interval = int(config.cross_layer_interval)
+        for i in range(config.num_hidden_layers):
+            p = f"model.layers.{i}."
+            layers["ln1"].append(get(p + "input_layernorm.weight"))
+            layers["wq"].append(lin_t(p + "self_attn.q_proj.weight"))
+            layers["wk"].append(lin_t(p + "self_attn.k_proj.weight"))
+            layers["wv"].append(lin_t(p + "self_attn.v_proj.weight"))
+            layers["wo"].append(lin_t(p + "self_attn.o_proj.weight"))
+            layers["ln2"].append(get(p + "post_attention_layernorm.weight"))
+            layers["wg"].append(lin_t(p + "mlp.gate_proj.weight"))
+            layers["wu"].append(lin_t(p + "mlp.up_proj.weight"))
+            layers["wd"].append(lin_t(p + "mlp.down_proj.weight"))
+            if i % interval == 0:
+                g = f"model.gated_cross_attn_layers.{i // interval}."
+                clp = {
+                    "ln1": get(g + "input_layernorm.weight"),
+                    "wq": lin_t(g + "cross_attn.q_proj.weight"),
+                    "wk": lin_t(g + "cross_attn.k_proj.weight"),
+                    "wv": lin_t(g + "cross_attn.v_proj.weight"),
+                    "wo": lin_t(g + "cross_attn.o_proj.weight"),
+                    "ln2": get(g + "post_attention_layernorm.weight"),
+                    "wg": lin_t(g + "mlp.gate_proj.weight"),
+                    "wu": lin_t(g + "mlp.up_proj.weight"),
+                    "wd": lin_t(g + "mlp.down_proj.weight"),
+                    "alpha_cross": get(g + "alpha_cross_attn").reshape(-1),
+                    "alpha_dense": get(g + "alpha_dense").reshape(-1),
+                }
+                if qk:
+                    clp["q_ln"] = get(g + "cross_attn.q_layer_norm.weight")
+                    clp["k_ln"] = get(g + "cross_attn.k_layer_norm.weight")
+                cross.append(clp)
+
+        embed = get("model.embed_tokens.weight")
+        if "model.embed_tokens.additional_embedding.weight" in state_dict:
+            embed = np.concatenate(
+                [embed, get("model.embed_tokens.additional_embedding.weight")],
+                axis=0)
+        lm_head = lin_t("lm_head.weight")
+        out = {
+            "embed": embed,
+            "layers": {k: np.stack(v) for k, v in layers.items()},
+            "cross_layers": cross,
+            "final_norm": get("model.norm.weight"),
+            "lm_head": lm_head,
+            "rope_inv_freq": cls.inv_freq_from_config(config),
+        }
+        if "lm_head.additional_fc.weight" in state_dict:
+            out["lm_head_extra"] = lin_t("lm_head.additional_fc.weight")
+        return out
+
+    @classmethod
+    def convert_hf_vision_state_dict(cls, state_dict: Dict[str, np.ndarray],
+                                     config) -> Dict:
+        vc = config.vision_config
+        pc = config.perceiver_config
+        hidden = vc["embed_dim"]
+
+        def get(name):
+            if name not in state_dict:
+                raise KeyError(f"missing weight {name}")
+            return np.asarray(state_dict[name])
+
+        def lin_t(name):
+            return np.ascontiguousarray(get(name).T)
+
+        keys = ("ln1", "ln1_b", "wq", "bq", "wk", "bk", "wv", "bv", "wo", "bo",
+                "ln2", "ln2_b", "w1", "b1", "w2", "b2")
+        layers = {k: [] for k in keys}
+        for i in range(vc["num_hidden_layers"]):
+            p = f"model.vision_model.encoder.layers.{i}."
+            layers["ln1"].append(get(p + "layer_norm1.weight"))
+            layers["ln1_b"].append(get(p + "layer_norm1.bias"))
+            layers["wq"].append(lin_t(p + "self_attn.q_proj.weight"))
+            layers["bq"].append(get(p + "self_attn.q_proj.bias"))
+            layers["wk"].append(lin_t(p + "self_attn.k_proj.weight"))
+            layers["bk"].append(get(p + "self_attn.k_proj.bias"))
+            layers["wv"].append(lin_t(p + "self_attn.v_proj.weight"))
+            layers["bv"].append(get(p + "self_attn.v_proj.bias"))
+            layers["wo"].append(lin_t(p + "self_attn.out_proj.weight"))
+            layers["bo"].append(get(p + "self_attn.out_proj.bias"))
+            layers["ln2"].append(get(p + "layer_norm2.weight"))
+            layers["ln2_b"].append(get(p + "layer_norm2.bias"))
+            layers["w1"].append(lin_t(p + "mlp.fc1.weight"))
+            layers["b1"].append(get(p + "mlp.fc1.bias"))
+            layers["w2"].append(lin_t(p + "mlp.fc2.weight"))
+            layers["b2"].append(get(p + "mlp.fc2.bias"))
+
+        emb = "model.vision_model.embeddings."
+        conv = get(emb + "patch_embedding.weight")
+        vp = {
+            "patch_w": np.ascontiguousarray(conv.reshape(hidden, -1).T),
+            "cls": get(emb + "class_embedding"),
+            "pos_embed": get(emb + "position_embedding.weight"),
+            "ln_pre": get("model.vision_model.pre_layrnorm.weight"),
+            "ln_pre_b": get("model.vision_model.pre_layrnorm.bias"),
+            "layers": {k: np.stack(v) for k, v in layers.items()},
+            # post_layernorm only norms HF's pooled CLS output — unused here
+        }
+        if pc.get("use_resampler"):
+            pr = "model.perceiver_resampler."
+            blocks = {k: [] for k in ("ctx_ln", "ctx_ln_b", "lat_ln",
+                                      "lat_ln_b", "wq", "wk", "wv", "wo",
+                                      "mlp_ln", "mlp_ln_b", "fc", "c_proj",
+                                      "q_ln", "q_ln_b", "k_ln", "k_ln_b")}
+            qk = bool(pc.get("qk_layer_norms_perceiver"))
+            for i in range(int(pc["resampler_depth"])):
+                bp = pr + f"blocks.{i}."
+                blocks["ctx_ln"].append(get(bp + "0.context_layer_norm.weight"))
+                blocks["ctx_ln_b"].append(get(bp + "0.context_layer_norm.bias"))
+                blocks["lat_ln"].append(get(bp + "0.latents_layer_norm.weight"))
+                blocks["lat_ln_b"].append(get(bp + "0.latents_layer_norm.bias"))
+                blocks["wq"].append(lin_t(bp + "0.q_proj.weight"))
+                blocks["wk"].append(lin_t(bp + "0.k_proj.weight"))
+                blocks["wv"].append(lin_t(bp + "0.v_proj.weight"))
+                blocks["wo"].append(lin_t(bp + "0.output_proj.weight"))
+                if qk:
+                    blocks["q_ln"].append(get(bp + "0.q_layer_norm.weight"))
+                    blocks["q_ln_b"].append(get(bp + "0.q_layer_norm.bias"))
+                    blocks["k_ln"].append(get(bp + "0.k_layer_norm.weight"))
+                    blocks["k_ln_b"].append(get(bp + "0.k_layer_norm.bias"))
+                blocks["mlp_ln"].append(get(bp + "1.ln.weight"))
+                blocks["mlp_ln_b"].append(get(bp + "1.ln.bias"))
+                blocks["fc"].append(lin_t(bp + "1.fc.weight"))
+                blocks["c_proj"].append(lin_t(bp + "1.c_proj.weight"))
+            if not qk:
+                for k in ("q_ln", "q_ln_b", "k_ln", "k_ln_b"):
+                    del blocks[k]
+            vp["perceiver"] = {
+                "latents": get(pr + "latents"),
+                "blocks": {k: np.stack(v) for k, v in blocks.items()},
+                "out_ln": get(pr + "layer_norm.weight"),
+                "out_ln_b": get(pr + "layer_norm.bias"),
+            }
+        return vp

@@ -1,0 +1,127 @@
+"""Qwen2.5-Omni (thinker text backbone) on the TPU framework (contrib port).
+
+≈ reference `contrib/models/Qwen2.5-Omni-7B/src/modeling_qwen2_5_omni.py`,
+which serves the THINKER's text model only ("focuses on text-only inference",
+its line 20; the audio/vision towers and the talker speech head are out of
+scope on both sides). The text backbone is qwen2-shaped (GQA, biased qkv,
+silu-gated MLP) whose mrope/TMRoPE reduces exactly to standard rope for
+text-only inputs (all three mrope sections share the 1D positions). Config
+rides nested as ``thinker_config.text_config``; weights carry a
+``thinker.model.`` / ``thinker.lm_head`` prefix — both flattened here.
+"""
+
+from typing import Dict
+
+import numpy as np
+
+from neuronx_distributed_inference_tpu.config import InferenceConfig
+from neuronx_distributed_inference_tpu.models.base import ModelArchArgs
+from neuronx_distributed_inference_tpu.ops import rope as rope_ops
+from neuronx_distributed_inference_tpu.runtime.application import (
+    TpuModelForCausalLM)
+
+
+class Qwen25OmniInferenceConfig(InferenceConfig):
+    REQUIRED_ATTRIBUTES = ("hidden_size", "num_hidden_layers",
+                           "num_attention_heads", "num_key_value_heads",
+                           "vocab_size", "intermediate_size")
+
+    def add_derived_config(self) -> None:
+        # outer omni config: thinker_config -> text_config holds the LM fields;
+        # a bare thinker config nests text_config directly
+        tc = getattr(self, "thinker_config", None)
+        if tc is None and hasattr(self, "text_config"):
+            tc = {"text_config": self.text_config}
+        if tc is not None:
+            if not isinstance(tc, dict):
+                tc = tc.to_dict()
+            inner = tc.get("text_config", tc)
+            if not isinstance(inner, dict):
+                inner = inner.to_dict()
+            for k, v in inner.items():
+                if not k.startswith("_"):
+                    setattr(self, k, v)
+            if getattr(self, "pad_token_id", None) is None:
+                self.pad_token_id = tc.get("pad_token_id")
+        for attr, default in (("rope_theta", 1000000.0),
+                              ("rms_norm_eps", 1e-6),
+                              ("tie_word_embeddings", False)):
+            if not hasattr(self, attr) or getattr(self, attr) is None:
+                setattr(self, attr, default)
+        if not hasattr(self, "head_dim") or self.head_dim is None:
+            self.head_dim = self.hidden_size // self.num_attention_heads
+
+
+class Qwen25OmniThinkerForCausalLM(TpuModelForCausalLM):
+    @classmethod
+    def get_config_cls(cls):
+        return Qwen25OmniInferenceConfig
+
+    @classmethod
+    def arch_args_from_config(cls, config) -> ModelArchArgs:
+        return ModelArchArgs(
+            vocab_size=config.vocab_size,
+            hidden_size=config.hidden_size,
+            num_layers=config.num_hidden_layers,
+            num_heads=config.num_attention_heads,
+            num_kv_heads=config.num_key_value_heads,
+            head_dim=config.head_dim,
+            intermediate_size=config.intermediate_size,
+            rms_norm_eps=config.rms_norm_eps,
+            attention_bias=True,            # qwen2-style biased qkv
+            tie_word_embeddings=bool(config.tie_word_embeddings),
+        )
+
+    @classmethod
+    def inv_freq_from_config(cls, config) -> np.ndarray:
+        # mrope with identical t/h/w positions == standard rope (text-only)
+        return rope_ops.default_inv_freq(config.head_dim,
+                                         float(config.rope_theta))
+
+    @classmethod
+    def convert_hf_state_dict(cls, state_dict: Dict[str, np.ndarray],
+                              config) -> Dict:
+        def flat_key(k):
+            for pre in ("model.thinker.model.", "thinker.model."):
+                if k.startswith(pre):
+                    return "model." + k[len(pre):]
+            for pre in ("model.thinker.lm_head.", "thinker.lm_head."):
+                if k.startswith(pre):
+                    return "lm_head." + k[len(pre):]
+            return k
+
+        state_dict = {flat_key(k): v for k, v in state_dict.items()}
+
+        def get(name):
+            if name not in state_dict:
+                raise KeyError(f"missing weight {name}")
+            return np.asarray(state_dict[name])
+
+        def lin_t(name):
+            return np.ascontiguousarray(get(name).T)
+
+        layers = {k: [] for k in ("ln1", "wq", "wk", "wv", "bq", "bk", "bv",
+                                  "wo", "ln2", "wg", "wu", "wd")}
+        for i in range(config.num_hidden_layers):
+            p = f"model.layers.{i}."
+            layers["wq"].append(lin_t(p + "self_attn.q_proj.weight"))
+            layers["wk"].append(lin_t(p + "self_attn.k_proj.weight"))
+            layers["wv"].append(lin_t(p + "self_attn.v_proj.weight"))
+            layers["bq"].append(get(p + "self_attn.q_proj.bias"))
+            layers["bk"].append(get(p + "self_attn.k_proj.bias"))
+            layers["bv"].append(get(p + "self_attn.v_proj.bias"))
+            layers["wo"].append(lin_t(p + "self_attn.o_proj.weight"))
+            layers["ln1"].append(get(p + "input_layernorm.weight"))
+            layers["ln2"].append(get(p + "post_attention_layernorm.weight"))
+            layers["wg"].append(lin_t(p + "mlp.gate_proj.weight"))
+            layers["wu"].append(lin_t(p + "mlp.up_proj.weight"))
+            layers["wd"].append(lin_t(p + "mlp.down_proj.weight"))
+        out = {
+            "embed": get("model.embed_tokens.weight"),
+            "layers": {k: np.stack(v) for k, v in layers.items()},
+            "final_norm": get("model.norm.weight"),
+            "rope_inv_freq": cls.inv_freq_from_config(config),
+        }
+        if not config.tie_word_embeddings:
+            out["lm_head"] = lin_t("lm_head.weight")
+        return out

@@ -81,6 +81,13 @@ CONTRIB_MODELS = {
                       "Gemma3ForConditionalGeneration"),
     "janus": "contrib.models.janus.src.modeling_janus:JanusForConditionalGeneration",
     "ovis2": "contrib.models.ovis2.src.modeling_ovis2:Ovis2ForConditionalGeneration",
+    "idefics":
+        "contrib.models.idefics.src.modeling_idefics:IdeficsForVisionText2Text",
+    "qwen2_5_omni": ("contrib.models.qwen2_5_omni.src.modeling_qwen2_5_omni:"
+                     "Qwen25OmniThinkerForCausalLM"),
+    "qwen2_5_omni_thinker": (
+        "contrib.models.qwen2_5_omni.src.modeling_qwen2_5_omni:"
+        "Qwen25OmniThinkerForCausalLM"),
 }
 
 for model_type, path in CONTRIB_MODELS.items():

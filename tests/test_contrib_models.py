@@ -62,7 +62,8 @@ def test_registry_resolves_contrib_models():
                "vaultgemma", "granitemoehybrid", "openai-gpt", "moonshine",
                "zamba2", "zamba", "arcee", "olmo3", "hunyuan_v1_dense",
                "internlm3", "orion", "minicpm", "minicpm4", "afmoe",
-               "gemma3", "gemma3_vision"):
+               "gemma3", "gemma3_vision", "janus", "ovis2", "idefics",
+               "qwen2_5_omni", "qwen2_5_omni_thinker"):
         assert get_model_cls(mt) is not None
 
 
@@ -1772,3 +1773,124 @@ def test_ovis2_generate_matches_hf():
     out = app.generate(ids, pixel_values=pixels, max_new_tokens=8,
                        eos_token_id=-1)
     np.testing.assert_array_equal(out.tokens, hf_out[:, 20:].numpy())
+
+
+def test_idefics_generate_matches_hf():
+    """IDEFICS gated cross-attention: perceiver-resampled CLIP features, cross
+    blocks every 2 layers with tanh-alpha gates, post-rope per-head qk norms,
+    decoupled embeddings/lm_head (2 additional vocab rows)."""
+    from transformers import IdeficsConfig, IdeficsForVisionText2Text as HFIdefics
+
+    from contrib.models.idefics.src.modeling_idefics import (
+        IdeficsForVisionText2Text)
+
+    cfg = IdeficsConfig(
+        vocab_size=256, additional_vocab_size=2, hidden_size=32,
+        intermediate_size=64, num_hidden_layers=4, num_attention_heads=4,
+        cross_layer_interval=2, qk_layer_norms=True, rms_norm_eps=1e-5,
+        tie_word_embeddings=False, pad_token_id=0, bos_token_id=1,
+        eos_token_id=2, freeze_text_layers=False, freeze_vision_layers=False,
+        vision_config={"embed_dim": 24, "image_size": 16, "patch_size": 8,
+                       "num_hidden_layers": 2, "num_attention_heads": 2,
+                       "intermediate_size": 48, "hidden_act": "gelu",
+                       "num_channels": 3},
+        perceiver_config={"use_resampler": True, "resampler_n_latents": 4,
+                          "resampler_depth": 2, "resampler_n_heads": 2,
+                          "resampler_head_dim": 12,
+                          "qk_layer_norms_perceiver": True},
+    )
+    torch.manual_seed(0)
+    hf = HFIdefics(cfg).eval()
+    with torch.no_grad():   # HF post-norms only the pooled CLS; must be unused
+        hf.model.vision_model.post_layernorm.weight.copy_(torch.randn(24))
+        hf.model.vision_model.post_layernorm.bias.copy_(torch.randn(24))
+
+    tpu_cfg = TpuConfig(batch_size=2, seq_len=64, max_context_length=32,
+                        dtype="float32", context_encoding_buckets=[32],
+                        token_generation_buckets=[64])
+    config = IdeficsForVisionText2Text.get_config_cls()(
+        tpu_cfg, load_config=load_pretrained_config(
+            dict(cfg.to_dict(), max_num_images=2)))
+    app = IdeficsForVisionText2Text(None, config)
+    state = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+    app._put_params(app.convert_hf_state_dict(state, app.config))
+    app.load_vision_from_state_dict(state)
+
+    rng = np.random.default_rng(1)
+    ids = rng.integers(3, 258, size=(2, 12))    # incl additional-vocab ids
+    pixels = rng.normal(size=(2, 1, 3, 16, 16)).astype(np.float32)
+    out = app.generate(ids, pixel_values=pixels, max_new_tokens=6,
+                       eos_token_id=-1)
+
+    # HF full-recompute greedy oracle (attend-all image mask each step)
+    cur = torch.tensor(ids)
+    for _ in range(6):
+        iam = torch.ones((2, cur.shape[1], 1), dtype=torch.long)
+        with torch.no_grad():
+            logits = hf(input_ids=cur, pixel_values=torch.tensor(pixels),
+                        image_attention_mask=iam).logits
+        cur = torch.cat([cur, logits[:, -1].argmax(-1)[:, None]], 1)
+    np.testing.assert_array_equal(out.tokens, cur[:, 12:].numpy())
+
+    # text-only path still serves (zero image states, fully-masked cross rows)
+    tids = rng.integers(3, 250, size=(2, 10)).astype(np.int64)
+    out_t = app.generate(tids, max_new_tokens=4, eos_token_id=-1)
+    cur = torch.tensor(tids)
+    for _ in range(4):
+        iam = torch.zeros((2, cur.shape[1], 1), dtype=torch.long)
+        with torch.no_grad():
+            logits = hf(input_ids=cur,
+                        pixel_values=torch.zeros(2, 1, 3, 16, 16),
+                        image_attention_mask=iam).logits
+        cur = torch.cat([cur, logits[:, -1].argmax(-1)[:, None]], 1)
+    np.testing.assert_array_equal(out_t.tokens, cur[:, 10:].numpy())
+
+
+def test_qwen2_5_omni_thinker_parity():
+    """Qwen2.5-Omni thinker text backbone (matches the reference contrib's
+    text-only scope): qwen2-shaped GQA with biased qkv; mrope with shared 1D
+    positions == standard rope."""
+    from transformers import Qwen2_5OmniThinkerConfig
+    from transformers.models.qwen2_5_omni.modeling_qwen2_5_omni import (
+        Qwen2_5OmniThinkerForConditionalGeneration as HFThinker)
+
+    from contrib.models.qwen2_5_omni.src.modeling_qwen2_5_omni import (
+        Qwen25OmniThinkerForCausalLM)
+
+    cfg = Qwen2_5OmniThinkerConfig(
+        text_config=dict(vocab_size=256, hidden_size=32, intermediate_size=64,
+                         num_hidden_layers=2, num_attention_heads=4,
+                         num_key_value_heads=2, rope_theta=10000.0,
+                         rope_scaling={"mrope_section": [2, 1, 1],
+                                       "rope_type": "default",
+                                       "type": "default"},
+                         tie_word_embeddings=False),
+        audio_config=dict(d_model=16, encoder_layers=1,
+                          encoder_attention_heads=2, encoder_ffn_dim=32,
+                          num_mel_bins=8, max_source_positions=10, n_window=2,
+                          output_dim=32),
+        vision_config=dict(hidden_size=16, intermediate_size=32, depth=2,
+                           num_heads=2, patch_size=4, spatial_merge_size=1,
+                           temporal_patch_size=1, out_hidden_size=32,
+                           fullatt_block_indexes=[1], window_size=8),
+        vision_start_token_id=251, vision_end_token_id=252,
+        audio_start_token_id=253, audio_end_token_id=254,
+        image_token_id=255, video_token_id=250, audio_token_id=249,
+        position_id_per_seconds=25, seconds_per_chunk=2, pad_token_id=0,
+    )
+    torch.manual_seed(0)
+    hf = HFThinker(cfg).eval()
+
+    config = Qwen25OmniThinkerForCausalLM.get_config_cls()(
+        _tpu_cfg(), load_config=load_pretrained_config(cfg.to_dict()))
+    app = Qwen25OmniThinkerForCausalLM(None, config)
+    state = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+    app._put_params(app.convert_hf_state_dict(state, app.config))
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(3, 249, size=(2, 12)).astype(np.int64)
+    with torch.no_grad():
+        hf_out = hf.generate(torch.tensor(ids), max_new_tokens=8,
+                             do_sample=False, pad_token_id=0)
+    out = app.generate(ids, max_new_tokens=8, eos_token_id=-1)
+    np.testing.assert_array_equal(out.tokens, hf_out[:, 12:].numpy())
