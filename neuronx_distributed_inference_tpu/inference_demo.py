@@ -48,6 +48,19 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--dp-degree", type=int, default=1)
     g.add_argument("--cp-degree", type=int, default=1)
     g.add_argument("--ep-degree", type=int, default=1)
+    g.add_argument("--pp-degree", type=int, default=1,
+                   help="accepted for config parity; must be 1 (same as the "
+                        "reference, whose pp is a no-op)")
+    g.add_argument("--mlp-cp-degree", type=int, default=None,
+                   help="MLP-CP is structural here: the mlp logical axis "
+                        "already shards over (cp, tp); value must equal "
+                        "cp-degree when given")
+    g.add_argument("--moe-tp-degree", dest="moe_tkg_tp", type=int, default=None,
+                   help="decode-graph MoE expert_mlp axis override (hybrid "
+                        "sharding): 0 replicates, >0 shards over tp")
+    g.add_argument("--moe-ep-degree", dest="moe_tkg_ep", type=int, default=None,
+                   help="decode-graph MoE experts axis override (hybrid "
+                        "sharding): 0 replicates, >0 shards over ep")
 
     g = p.add_argument_group("parallelism (advanced)")
     g.add_argument("--sequence-parallel", action="store_true",
@@ -120,10 +133,17 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--eagle-beam", type=int, default=2)
     g.add_argument("--eagle-branch", type=int, default=2)
     g.add_argument("--medusa-heads", type=int, default=4)
+    g.add_argument("--token-tree-json", default=None, metavar="JSON",
+                   help="static speculation tree as a JSON list of root-to-node "
+                        "token paths (modules/token_tree); medusa only — eagle "
+                        "builds its tree dynamically (--eagle-beam/branch)")
+    g.add_argument("--draft-model-tp-degree", type=int, default=None,
+                   help="tp degree for the draft model (default: target's)")
     g.add_argument("--draft-model-path", default=None,
                    help="draft checkpoint for speculative decoding")
 
     g = p.add_argument_group("sampling")
+    g.add_argument("--pad-token-id", type=int, default=0)
     g.add_argument("--do-sample", action="store_true")
     g.add_argument("--top-k", type=int, default=1)
     g.add_argument("--top-p", type=float, default=1.0)
@@ -140,6 +160,26 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["skip", "token-matching", "logit-matching"],
                    default="skip")
     g.add_argument("--divergence-difference-tol", type=float, default=0.001)
+    g.add_argument("--tol-map", default=None, metavar="JSON",
+                   help='''per-position tolerance map for logit matching, e.g.
+                        {"64": [1e-3, 1e-4]} — the entry with the largest key
+                        <= position applies (utils/accuracy.py)''')
+    g.add_argument("--num-tokens-to-check", type=int, default=None,
+                   help="limit token/logit matching to the first N generated "
+                        "tokens")
+    g.add_argument("--expected-outputs-path", default=None, metavar="NPY",
+                   help="golden token matrix (.npy) for token matching instead "
+                        "of running the HF CPU model")
+    g.add_argument("--output-logits", action="store_true",
+                   help="also print per-step last-token logits stats")
+    g.add_argument("--allow-input-truncation", action="store_true",
+                   help="truncate prompts longer than max_context_length "
+                        "instead of raising")
+    g.add_argument("--input-capture-save-dir", default=None, metavar="DIR",
+                   help="snapshot every request's inputs (and weights once) to "
+                        "DIR (utils/snapshot; sets TPUINF_CAPTURE_*)")
+    g.add_argument("--max-num-seqs", type=int, default=None,
+                   help="continuous-batching slot count (default: batch size)")
     g.add_argument("--capture-on-divergence-dir", default=None, metavar="DIR",
                    help="on a failed logit match, re-run the failing request "
                         "with input+weight snapshots written to DIR "
@@ -174,10 +214,33 @@ def create_tpu_config(args: argparse.Namespace) -> TpuConfig:
         lora = LoraServingConfig(max_loras=max(args.max_loras, len(paths)),
                                  max_lora_rank=args.max_lora_rank,
                                  lora_ckpt_paths=paths)
+    if args.max_num_seqs:
+        # serving slot count IS the compiled batch (the runner packs requests
+        # into cfg.batch_size rows)
+        args.batch_size = max(args.batch_size, args.max_num_seqs)
     spec_cfg = None
     if args.speculation_length:
         spec_cfg = SpeculationConfig(speculation_length=args.speculation_length,
                                      draft_model_path=args.draft_model_path)
+    if args.pp_degree != 1:
+        raise SystemExit("--pp-degree must be 1 (pipeline parallelism is a "
+                         "config no-op, matching the reference)")
+    if args.mlp_cp_degree not in (None, args.cp_degree):
+        raise SystemExit(f"--mlp-cp-degree must equal --cp-degree "
+                         f"({args.cp_degree}): the mlp logical axis shards "
+                         f"over (cp, tp) structurally")
+    moe_hybrid = None
+    if args.moe_tkg_tp is not None or args.moe_tkg_ep is not None:
+        from .config import MoEHybridShardingConfig
+
+        def axis(v, name):
+            if v is None:
+                return name          # keep the default layout on that axis
+            return None if v == 0 else name
+
+        moe_hybrid = MoEHybridShardingConfig(
+            decode_experts=axis(args.moe_tkg_ep, "ep"),
+            decode_expert_mlp=axis(args.moe_tkg_tp, "tp"))
     return TpuConfig(
         batch_size=args.batch_size,
         seq_len=args.seq_len,
@@ -201,6 +264,7 @@ def create_tpu_config(args: argparse.Namespace) -> TpuConfig:
         decode_kernel_enabled=args.decode_kernel,
         batch_buckets=args.batch_buckets,
         is_continuous_batching=args.continuous_batching,
+        moe_hybrid_sharding=moe_hybrid,
         paged_attention_enabled=args.paged_attention,
         pa_num_blocks=args.pa_num_blocks,
         pa_block_size=args.pa_block_size,
@@ -221,6 +285,11 @@ def run_inference(args: argparse.Namespace) -> int:
 
         set_runtime_env(args.seq_len,
                         compilation_cache_dir=args.compilation_cache_dir)
+    if args.input_capture_save_dir:
+        import os
+
+        os.environ["TPUINF_CAPTURE_DIR"] = args.input_capture_save_dir
+        os.environ["TPUINF_CAPTURE_WEIGHTS"] = "1"
 
     model_type = args.model_type
     if model_type is None:
@@ -297,13 +366,33 @@ def _build_spec_engine(args, app):
         draft_cls = get_model_cls(draft_type)
         draft_cfg = create_tpu_config(args)
         draft_cfg.speculation_config = None
+        if args.draft_model_tp_degree:
+            import dataclasses
+
+            target_world = (draft_cfg.tp_degree * draft_cfg.dp_degree
+                            * draft_cfg.cp_degree * draft_cfg.ep_degree)
+            # re-runs __post_init__ so degree validation applies to the override
+            draft_cfg = dataclasses.replace(
+                draft_cfg, tp_degree=args.draft_model_tp_degree)
+            draft_world = (draft_cfg.tp_degree * draft_cfg.dp_degree
+                           * draft_cfg.cp_degree * draft_cfg.ep_degree)
+            if draft_world != target_world:
+                raise SystemExit(
+                    f"--draft-model-tp-degree {args.draft_model_tp_degree}: "
+                    f"draft world size {draft_world} must equal the target's "
+                    f"{target_world} (both run inside one jitted step)")
         draft = draft_cls.from_pretrained(args.draft_model_path, draft_cfg)
         return FusedSpeculativeModel(app, draft, args.speculation_length,
                                      greedy=not args.do_sample)
     if args.speculation_type == "medusa":
         from .runtime.medusa import MedusaModel
 
-        engine = MedusaModel(app, num_medusa_heads=args.medusa_heads)
+        tree = None
+        if args.token_tree_json:
+            from .modules.token_tree import TokenTree
+
+            tree = TokenTree.from_paths(json.loads(args.token_tree_json))
+        engine = MedusaModel(app, num_medusa_heads=args.medusa_heads, tree=tree)
         if args.draft_model_path:
             from .utils import checkpoint as ckpt_lib
 
@@ -314,6 +403,9 @@ def _build_spec_engine(args, app):
             engine.load_random_heads()
         return engine
     # EAGLE / EAGLE3 chain or dynamic-tree drafts
+    if args.token_tree_json:
+        raise SystemExit("--token-tree-json is medusa-only; eagle drafts build "
+                         "their tree dynamically (--eagle-beam/--eagle-branch)")
     from .runtime.eagle import EagleSpeculativeModel, draft_args_from_target
 
     d_args = draft_args_from_target(app.arch_args, num_layers=1)
@@ -393,7 +485,12 @@ def _encode_prompts(args, tokenizer, vocab_size: int = 1000) -> tuple:
         prompts = prompts[: args.batch_size]
     if len(prompts) < args.batch_size:
         prompts = (prompts * args.batch_size)[: args.batch_size]
-    enc = tokenizer(prompts, return_tensors="np", padding=True)
+    if tokenizer.pad_token_id is None:
+        tokenizer.pad_token_id = args.pad_token_id
+    enc = tokenizer(prompts, return_tensors="np", padding=True,
+                    truncation=bool(args.allow_input_truncation),
+                    max_length=(args.max_context_length or args.seq_len
+                                if args.allow_input_truncation else None))
     return enc["input_ids"].astype(np.int32), enc["attention_mask"].astype(np.int32)
 
 
@@ -403,20 +500,32 @@ def _run_accuracy_check(args, app, tokenizer) -> int:
 
     from .utils.accuracy import check_accuracy_vs_hf, check_token_accuracy
 
-    logger.info("loading HF CPU golden model from %s", args.model_path)
-    hf_model = transformers.AutoModelForCausalLM.from_pretrained(
-        args.model_path, torch_dtype="float32").eval()
+    need_hf = not (args.expected_outputs_path
+                   and args.check_accuracy_mode == "token-matching")
+    hf_model = None
+    if need_hf:
+        logger.info("loading HF CPU golden model from %s", args.model_path)
+        hf_model = transformers.AutoModelForCausalLM.from_pretrained(
+            args.model_path, torch_dtype="float32").eval()
     input_ids, attention_mask = _encode_prompts(args, tokenizer,
                                                 app.arch_args.vocab_size)
 
+    n_check = args.num_tokens_to_check or args.max_new_tokens
+    tol_map = None
+    if args.tol_map:
+        tol_map = {int(k): tuple(v) for k, v in json.loads(args.tol_map).items()}
     if args.check_accuracy_mode == "logit-matching":
         report = check_accuracy_vs_hf(
-            app, hf_model, input_ids, args.max_new_tokens, attention_mask,
-            divergence_difference_tol=args.divergence_difference_tol)
+            app, hf_model, input_ids, n_check, attention_mask,
+            divergence_difference_tol=args.divergence_difference_tol,
+            tol_map=tol_map)
         print(f"logit matching: passed={report.passed} "
               f"max_abs_err={report.max_abs_error:.5f} "
               f"top1_match={report.top1_match_rate:.4f} "
               f"divergence_index={report.divergence_index}")
+        if args.output_logits:
+            for i, step in enumerate(report.per_step_max_err or []):
+                print(f"  step {i}: max_abs_err={step:.5f}")
         if not report.passed and args.capture_on_divergence_dir:
             # ≈ reference auto-capture of failing inputs
             # (`inference_demo.py:635-649`): replay the failing request with
@@ -437,10 +546,13 @@ def _run_accuracy_check(args, app, tokenizer) -> int:
 
     from .utils.accuracy import get_hf_expected_outputs
 
-    expected_tokens, _ = get_hf_expected_outputs(hf_model, input_ids,
-                                                 args.max_new_tokens, attention_mask)
+    if args.expected_outputs_path:
+        expected_tokens = np.load(args.expected_outputs_path)
+    else:
+        expected_tokens, _ = get_hf_expected_outputs(hf_model, input_ids,
+                                                     n_check, attention_mask)
     out = app.generate(input_ids, attention_mask=attention_mask,
-                       max_new_tokens=args.max_new_tokens)
+                       max_new_tokens=n_check)
     ok = check_token_accuracy(out.tokens, expected_tokens)
     print(f"token matching: passed={ok}")
     return 0 if ok else 1

@@ -700,16 +700,21 @@ def _flash_decoding_step(q, k_new, v_new, k_cache, v_cache, positions,
         rep = n_q // n_kv
         local_s = kc.shape[2]
         base = jax.lax.axis_index(AXIS_CP) * local_s
-        in_range = (pos >= base) & (pos + t <= base + local_s)
-        local_pos = jnp.clip(pos - base, 0, local_s - t)
 
         def _write(cache, new):
-            def one(row_c, row_n, p, ok):
-                upd = jax.lax.dynamic_update_slice(
-                    row_c, row_n.astype(row_c.dtype), (0, p, 0))
-                return jnp.where(ok, upd, row_c)
+            # per-token scatter: a T-token span may straddle shard boundaries,
+            # so each fresh row lands on whichever shard owns ITS position
+            def one(row_c, row_n, p0):
+                for j in range(t):
+                    pj = p0 + j - base
+                    ok = (pj >= 0) & (pj < local_s)
+                    upd = jax.lax.dynamic_update_slice(
+                        row_c, row_n[:, j:j + 1].astype(row_c.dtype),
+                        (0, jnp.clip(pj, 0, local_s - 1), 0))
+                    row_c = jnp.where(ok, upd, row_c)
+                return row_c
 
-            return jax.vmap(one)(cache, new, local_pos, in_range)
+            return jax.vmap(one)(cache, new, pos)
 
         kc = _write(kc, kn)
         vc = _write(vc, vn)
@@ -1437,7 +1442,7 @@ def decode_forward(
     return_hidden: bool = False,  # also return the final normed hidden states (B, T, H)
     window_row=None,  # traced scalar: dense windowed prefill at this cache batch row
     use_kernel: bool = False,  # static: Pallas stacked-cache decode (hot path)
-    # static: KV-seq-sharded decode over the cp axis (flash decoding); T must be 1
+    # static: KV-seq-sharded decode over the cp axis (flash decoding); multi-token chains OK, tree/paged unsupported
     flash_decoding: bool = False,
     # static layer indices whose output hiddens are captured (EAGLE3 conditioning)
     capture_layers: Optional[Tuple[int, ...]] = None,
@@ -1564,8 +1569,10 @@ def decode_forward(
     if sliding is not None:
         mask = sliding
 
-    if flash_decoding and (t > 1 or tree is not None or paged is not None):
-        raise ValueError("flash decoding supports single-token chain decode only")
+    if flash_decoding and (tree is not None or paged is not None):
+        raise ValueError("flash decoding supports chain decode only (no "
+                         "tree/paged); multi-token chains (speculative wide "
+                         "verify) are supported")
     attn_bias = (_alibi_bias(params["alibi_slopes"], q_pos, kv_pos)
                  if args.alibi else None)
     out = _run_stack(params, args, h, cos, sin, mask, cache,

@@ -139,9 +139,20 @@ class FusedSpeculativeModel:
         precision = ("highest" if self.target.tpu_config.dtype == "float32"
                      else "default")
         # Pallas stacked-cache decode for both models when supported (the draft
-        # chain and the wide verify are both plain chain decodes)
-        t_kernel = {"use_kernel": True} if self.target._use_decode_kernel() else {}
-        d_kernel = {"use_kernel": True} if self.draft._use_decode_kernel() else {}
+        # chain and the wide verify are both plain chain decodes). Under
+        # flash_decoding_enabled the verify is a multi-token chain over the
+        # KV-seq-sharded cache — decode_forward's flash-decoding path now
+        # scatters each of the K fresh tokens to its owning cp shard.
+        if self.target._use_flash_decoding():
+            t_kernel = {"flash_decoding": True}
+        else:
+            t_kernel = ({"use_kernel": True}
+                        if self.target._use_decode_kernel() else {})
+        if self.draft._use_flash_decoding():
+            d_kernel = {"flash_decoding": True}
+        else:
+            d_kernel = ({"use_kernel": True}
+                        if self.draft._use_decode_kernel() else {})
 
         def _step(t_params, d_params, last_tok, positions, t_cache, d_cache,
                   sampling_params, key, decode_bucket):

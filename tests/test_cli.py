@@ -84,3 +84,31 @@ def test_cli_end_to_end_eagle3_and_serve(tmp_path):
                         "--eagle-depth", "2"]) == 0
     assert main(base + ["--serve", "--continuous-batching",
                         "--prompt", "x", "--prompt", "y"]) == 0
+
+
+def test_parity_flags_map_to_config():
+    """Round-3 parity flags: hybrid MoE sharding, pp/mlp-cp validation,
+    max-num-seqs batch widening, draft tp override."""
+    args = build_parser().parse_args([
+        "--model-path", "/tmp/x", "--batch-size", "2", "--ep-degree", "2",
+        "--moe-tp-degree", "0", "--moe-ep-degree", "2",
+        "--max-num-seqs", "8",
+    ])
+    cfg = create_tpu_config(args)
+    assert cfg.batch_size == 8                       # widened to the slot count
+    assert cfg.moe_hybrid_sharding is not None
+    assert cfg.moe_hybrid_sharding.decode_expert_mlp is None   # 0 -> replicated
+    assert cfg.moe_hybrid_sharding.decode_experts == "ep"
+
+    args = build_parser().parse_args(
+        ["--model-path", "/tmp/x", "--pp-degree", "2"])
+    with pytest.raises(SystemExit):
+        create_tpu_config(args)
+
+    args = build_parser().parse_args(
+        ["--model-path", "/tmp/x", "--cp-degree", "2", "--mlp-cp-degree", "4"])
+    with pytest.raises(SystemExit):
+        create_tpu_config(args)
+    args = build_parser().parse_args(
+        ["--model-path", "/tmp/x", "--cp-degree", "2", "--mlp-cp-degree", "2"])
+    create_tpu_config(args)                          # equal degrees accepted
