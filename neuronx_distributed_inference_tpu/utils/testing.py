@@ -76,3 +76,52 @@ def validate_accuracy(device_fn: Callable, golden_fn: Callable, args: Sequence[A
                                    np.asarray(w, dtype=np.float32),
                                    atol=tol, rtol=rtol,
                                    err_msg=f"output leaf {i} diverged")
+
+
+def extract_layer_params(params, layer_idx: int):
+    """Slice ONE decoder layer's params out of a loaded app's stacked tree.
+
+    ≈ reference module-from-model test templates
+    (`module_test/module_from_model_template/`): families stack per-layer
+    weights as (L, ...) arrays under ``params["layers"]``; this returns the
+    {name: (…)} dict for ``layer_idx``, usable directly with the shared
+    ``models.base._decoder_layer`` (or any family-level layer fn) for
+    module-level validation against a reference implementation.
+    """
+    return {k: v[layer_idx] for k, v in params["layers"].items()}
+
+
+def run_decoder_layer(app, layer_idx: int, hidden, position_ids=None):
+    """Run one decoder layer of a loaded causal-LM app on ``hidden`` (B, S, H),
+    prefill-style (fresh KV, full causal mask), returning its output hidden.
+
+    The single-module analog of a full forward: extract the layer, build the
+    rope tables and mask exactly as the traced prefill does, call the shared
+    `_decoder_layer`. Use with `validate_accuracy` against the corresponding
+    HF layer for module-level parity hunting.
+    """
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..models import base as model_base
+    from ..ops import rope as rope_ops
+
+    args = app.arch_args
+    h = jnp.asarray(hidden)
+    b, s, _ = h.shape
+    if position_ids is None:
+        position_ids = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
+    else:
+        position_ids = jnp.asarray(position_ids)
+    cos, sin = rope_ops.compute_cos_sin(app.params["rope_inv_freq"],
+                                        position_ids,
+                                        args.rope_attention_scaling)
+    mask = (position_ids[:, None, :, None] >= position_ids[:, None, None, :])
+    mask = jnp.logical_and(mask, model_base.causal_mask(s, s)[None, None])
+    lp = extract_layer_params(app.params, layer_idx)
+    k_cache = jnp.zeros((b, args.num_kv_heads, s, args.head_dim), h.dtype)
+    v_cache = jnp.zeros_like(k_cache)
+    out, _, _ = model_base._decoder_layer(
+        lp, args, h, cos, sin, mask, k_cache, v_cache,
+        positions=None, decode_bucket=None, mesh=None, rules=None)
+    return np.asarray(out)

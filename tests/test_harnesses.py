@@ -228,3 +228,52 @@ def test_hf_adapter_logits_processors(tiny_app, tiny_ckpt):
     procs = LogitsProcessorList([RepetitionPenaltyLogitsProcessor(1.5)])
     got = adapter.generate_with_processors(ids, procs, max_new_tokens=8)
     np.testing.assert_array_equal(np.asarray(got), want.numpy())
+
+
+def test_module_from_model_template():
+    """Module-from-model testing template (≈ reference
+    `module_test/module_from_model_template/`): extract ONE decoder layer of a
+    loaded llama app and validate it module-level against HF's layer 0."""
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM as HFLlama
+
+    from neuronx_distributed_inference_tpu.config import (
+        TpuConfig, load_pretrained_config)
+    from neuronx_distributed_inference_tpu.models.llama.modeling_llama import (
+        LlamaForCausalLM, LlamaInferenceConfig)
+    from neuronx_distributed_inference_tpu.utils.testing import (
+        extract_layer_params, run_decoder_layer, validate_accuracy)
+
+    hf_cfg = dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                  num_hidden_layers=2, num_attention_heads=8,
+                  num_key_value_heads=4, rms_norm_eps=1e-5,
+                  rope_theta=10000.0, tie_word_embeddings=False)
+    torch.manual_seed(0)
+    hf = HFLlama(LlamaConfig(**hf_cfg)).eval()
+
+    tpu_cfg = TpuConfig(batch_size=2, seq_len=64, max_context_length=32,
+                        dtype="float32", context_encoding_buckets=[32],
+                        token_generation_buckets=[64])
+    config = LlamaInferenceConfig(
+        tpu_cfg, load_config=load_pretrained_config(
+            dict(hf_cfg, model_type="llama")))
+    app = LlamaForCausalLM(None, config)
+    state = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+    app._put_params(app.convert_hf_state_dict(state, app.config))
+
+    lp = extract_layer_params(app.params, 0)
+    assert lp["wq"].shape == (64, 8 * 8)          # one layer's (H, nq*d)
+
+    rng = np.random.default_rng(0)
+    hidden = rng.normal(size=(2, 8, 64)).astype(np.float32)
+
+    def golden(h):
+        pos = torch.arange(8)[None].repeat(2, 1)
+        rot = hf.model.rotary_emb(torch.tensor(h), pos)
+        with torch.no_grad():
+            return hf.model.layers[0](
+                torch.tensor(h), position_embeddings=rot,
+                attention_mask=None).numpy()
+
+    validate_accuracy(lambda h: run_decoder_layer(app, 0, h), golden,
+                      [hidden], atol=2e-4, rtol=1e-3)
