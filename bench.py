@@ -1,9 +1,19 @@
-"""Benchmark entry point: prints ONE JSON line with the headline metric.
+"""Benchmark entry point: prints the headline-metric JSON line (re-emitted, with a
+progressively richer ``extra``, after each enrichment phase — the driver parses the
+last complete line).
 
 Headline: Llama-3.1-8B-architecture decode throughput on ONE chip — int8 weight-only
 quantization (the 8B bf16 weights alone exceed a single v5e's HBM) + fp8 KV cache,
 measured through the full serving path (bucketed prefill, chunked greedy decode).
 ``vs_baseline`` is against the BASELINE.md north star of 2000 decode tok/s/chip.
+
+Structure (the round-3 bench timed out under the driver's budget and lost every
+number — VERDICT r3 #1): the headline JSON line is printed and flushed THE MOMENT
+the dense measurement finishes; enrichment phases (device-timed decode/TTFT,
+bandwidth utilization, paged serving) then run one by one, each gated on the
+remaining time budget (``BENCH_TIME_BUDGET_S``, default 1200 s), and the enriched
+JSON line is re-printed at the end. A timeout at any point still leaves a complete,
+parseable headline on stdout. All progress chatter goes to stderr.
 
 ``--small`` runs the 1B-architecture bf16 variant (fast sanity check).
 
@@ -13,10 +23,32 @@ exactly like the reference's random-weight integration benchmarks (SURVEY §4).
 """
 
 import json
+import os
 import sys
 import time
 
 import numpy as np
+
+T0 = time.time()
+BUDGET_S = float(os.environ.get("BENCH_TIME_BUDGET_S", "1200"))
+
+# v5e ("TPU v5 lite") HBM bandwidth; used for the bandwidth-utilization roofline
+# number (VERDICT r3 #10). Decode at bs<=64 is weight-streaming-bound, so
+# bytes-read/step ÷ device-step-time ÷ peak-BW is the MFU-analog that matters.
+_HBM_BW_BYTES_PER_S = {
+    "TPU v5 lite": 819e9,
+    "TPU v5": 2765e9,
+    "TPU v4": 1228e9,
+    "TPU v6 lite": 1640e9,
+}
+
+
+def _remaining() -> float:
+    return BUDGET_S - (time.time() - T0)
+
+
+def _note(msg: str) -> None:
+    print(f"[bench +{time.time() - T0:.0f}s] {msg}", file=sys.stderr, flush=True)
 
 
 def _random_quantized_llama_params(cfg, seed: int = 0):
@@ -60,8 +92,38 @@ def _random_quantized_llama_params(cfg, seed: int = 0):
     return params
 
 
+def _streamed_bytes_per_decode_step(hf_cfg, quant, batch, avg_ctx) -> int:
+    """Bytes read from HBM per decode step: every layer weight + lm_head (streamed
+    once per step regardless of batch) + the KV prefix each sequence attends over."""
+    L = hf_cfg["num_hidden_layers"]
+    H = hf_cfg["hidden_size"]
+    I = hf_cfg["intermediate_size"]
+    d = hf_cfg["head_dim"]
+    q_size = hf_cfg["num_attention_heads"] * d
+    kv_size = hf_cfg["num_key_value_heads"] * d
+    V = hf_cfg["vocab_size"]
+    wbytes = 1 if (quant is not None and quant.quantize_weights) else 2
+    per_layer = (H * q_size + 2 * H * kv_size + q_size * H  # attention
+                 + 3 * H * I) * wbytes                      # gate/up/down
+    lm_head = H * V * wbytes
+    kvbytes = 1 if (quant is not None and quant.kv_cache_dtype) else 2
+    kv_read = batch * L * 2 * kv_size * int(avg_ctx) * kvbytes
+    return L * per_layer + lm_head + kv_read
+
+
 def main() -> None:
     small = "--small" in sys.argv
+
+    import jax
+
+    # Persistent compile cache: repeated phases (and repeated bench runs on the
+    # same machine) skip recompilation — the r3 timeout was compile-dominated.
+    try:
+        jax.config.update("jax_compilation_cache_dir", "/tmp/tpu_bench_jax_cache")
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception as e:  # cache is an optimization, never a failure
+        _note(f"compile cache unavailable: {e}")
 
     from neuronx_distributed_inference_tpu.config import (
         QuantizationConfig, TpuConfig, load_pretrained_config)
@@ -109,6 +171,7 @@ def main() -> None:
                         quantization_config=quant)
     config = LlamaInferenceConfig(tpu_cfg, load_config=load_pretrained_config(hf_cfg))
     app = LlamaForCausalLM(None, config)
+    _note("loading params")
     if small:
         app.load_random(seed=0)
     else:
@@ -118,8 +181,10 @@ def main() -> None:
     input_ids = rng.integers(1, hf_cfg["vocab_size"],
                              size=(batch, prompt_len)).astype(np.int32)
 
-    # warm both graphs (compile), then measure
+    # ---- headline: warm both graphs (compile), then measure -------------------
+    _note("dense warmup (compiles prefill+decode)")
     app.generate(input_ids, max_new_tokens=decode_steps)
+    _note("dense measure")
     out = app.generate(input_ids, max_new_tokens=decode_steps, collect_latency=True)
     chunk_s = np.array([s for s, _ in out.decode_latencies_s])
     chunk_toks = np.array([t for _, t in out.decode_latencies_s])
@@ -128,63 +193,6 @@ def main() -> None:
     tok_per_s = total_toks / total_decode_s
     per_step_ms = 1000.0 * chunk_s / chunk_toks
 
-    # serving TTFT: a single request prefilled at batch bucket 1 (first-class
-    # metric, ≈ reference TTFT reporting `utils/benchmark.py:479-494`); the bulk
-    # ttft above amortizes a full batch-64 prefill and is NOT time-to-first-token
-    # for one user. Three numbers are reported so the wall figure is attributable:
-    #  - ttft_p50_ms        : wall time of the bs=1 prefill dispatch (what a
-    #                         client sees THROUGH THIS ENVIRONMENT'S TUNNEL)
-    #  - dispatch_floor_ms  : p50 wall time of a no-op jitted dispatch — the
-    #                         tunnel's irreducible blocking round trip (measured
-    #                         ~70 ms here; local PJRT serving does not pay it)
-    #  - ttft_device_ms     : event-timed on-device duration of the same bs=1
-    #                         prefill from a jax.profiler trace — the graph's
-    #                         true latency and the number BASELINE.md's <50 ms
-    #                         north star bounds
-    import jax
-    import jax.numpy as jnp
-
-    single = input_ids[:1]
-    f_noop = jax.jit(lambda x: x + 1)
-    xs = jnp.zeros((8, 128), jnp.float32)
-    f_noop(xs).block_until_ready()
-    floor = []
-    for _ in range(10):
-        t0 = time.perf_counter()
-        f_noop(xs).block_until_ready()
-        floor.append(1000 * (time.perf_counter() - t0))
-    dispatch_floor_ms = float(np.percentile(floor, 50))
-
-    ttfts = []
-    for i in range(12):
-        o1 = app.generate(single, max_new_tokens=1)
-        if i:                                      # first call pays compilation
-            ttfts.append(o1.ttft_s)
-    ttft_p50_ms = 1000.0 * float(np.percentile(ttfts, 50))
-
-    from neuronx_distributed_inference_tpu.utils import profiling as prof
-
-    trace_dir = "/tmp/bench_ttft_trace"
-    import shutil
-
-    shutil.rmtree(trace_dir, ignore_errors=True)
-    with prof.trace(trace_dir):
-        app.generate(single, max_new_tokens=1)
-    dev = prof.device_time_ms(trace_dir, "prefill")
-    ttft_device_ms = round(dev, 2) if dev is not None else None
-
-    # device-timed decode step (same attribution as TTFT: wall per-step carries
-    # ~2-3 ms of tunnel chunk-boundary overhead that local serving doesn't pay)
-    dec_steps = 64
-    dec_trace = "/tmp/bench_decode_trace"
-    shutil.rmtree(dec_trace, ignore_errors=True)
-    app.generate(input_ids, max_new_tokens=1)        # fresh prefill outside trace
-    with prof.trace(dec_trace):
-        app.generate(input_ids, max_new_tokens=dec_steps)
-    ddev = prof.device_time_ms(dec_trace, "decode")
-    decode_step_device_ms = (round(ddev / dec_steps, 2)
-                             if ddev is not None else None)
-
     extra = {
         # no real checkpoints exist in this environment: weights are synthetic
         # random in the exact serving layout (the reference's own integration
@@ -192,14 +200,102 @@ def main() -> None:
         # token parity is covered by the HF-CPU parity suite at tiny scale
         "weights": "synthetic-random (env has no real checkpoints)",
         "p50_decode_step_ms": round(float(np.percentile(per_step_ms, 50)), 2),
-        "decode_step_device_ms": decode_step_device_ms,
-        "ttft_p50_ms": round(ttft_p50_ms, 1),
-        "ttft_device_ms": ttft_device_ms,
-        "dispatch_floor_ms": round(dispatch_floor_ms, 1),
         "ttft_bulk_bs%d_s" % batch: round(out.ttft_s, 3),
     }
+    result = {
+        "metric": name,
+        "value": round(tok_per_s, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(tok_per_s / 2000.0, 3),
+        "extra": extra,
+    }
+    # EARLY EMIT: the driver keeps whatever is on stdout at timeout — this line
+    # makes the headline survivable no matter what the enrichment phases cost.
+    print(json.dumps(result), flush=True)
 
-    if not small:
+    # ---- enrichment phases, each budget-gated ---------------------------------
+    import jax.numpy as jnp
+
+    from neuronx_distributed_inference_tpu.utils import profiling as prof
+
+    import shutil
+
+    decode_step_device_ms = None
+    if _remaining() > 120:
+        _note("phase: device-timed decode step")
+        try:
+            dec_steps = 64
+            dec_trace = "/tmp/bench_decode_trace"
+            shutil.rmtree(dec_trace, ignore_errors=True)
+            app.generate(input_ids, max_new_tokens=1)  # fresh prefill outside trace
+            with prof.trace(dec_trace):
+                app.generate(input_ids, max_new_tokens=dec_steps)
+            ddev = prof.device_time_ms(dec_trace, "decode")
+            if ddev is not None:
+                decode_step_device_ms = round(ddev / dec_steps, 2)
+            extra["decode_step_device_ms"] = decode_step_device_ms
+        except Exception as e:
+            _note(f"decode trace failed: {e}")
+        print(json.dumps(result), flush=True)
+
+    # Bandwidth utilization (roofline): free arithmetic once we have a device
+    # time; falls back to wall p50 when the trace phase was skipped.
+    step_ms = decode_step_device_ms or extra["p50_decode_step_ms"]
+    dev_kind = jax.devices()[0].device_kind
+    bw = next((v for k, v in _HBM_BW_BYTES_PER_S.items() if k in dev_kind), 819e9)
+    bytes_step = _streamed_bytes_per_decode_step(
+        hf_cfg, quant, batch, prompt_len + decode_steps / 2)
+    extra["hbm_bw_utilization"] = round(
+        bytes_step / (step_ms * 1e-3) / bw, 3)
+    extra["streamed_bytes_per_step_gb"] = round(bytes_step / 1e9, 2)
+    print(json.dumps(result), flush=True)
+
+    if _remaining() > 150:
+        # serving TTFT: a single request prefilled at batch bucket 1 (first-class
+        # metric, ≈ reference TTFT reporting `utils/benchmark.py:479-494`); the
+        # bulk ttft above amortizes a full batch-64 prefill and is NOT
+        # time-to-first-token for one user. Three numbers, so the wall figure is
+        # attributable:
+        #  - ttft_p50_ms        : wall time of the bs=1 prefill dispatch (what a
+        #                         client sees THROUGH THIS ENVIRONMENT'S TUNNEL)
+        #  - dispatch_floor_ms  : p50 wall time of a no-op jitted dispatch — the
+        #                         tunnel's irreducible blocking round trip
+        #  - ttft_device_ms     : event-timed on-device duration of the same bs=1
+        #                         prefill (the number BASELINE.md's <50 ms north
+        #                         star bounds)
+        _note("phase: single-request TTFT")
+        try:
+            single = input_ids[:1]
+            f_noop = jax.jit(lambda x: x + 1)
+            xs = jnp.zeros((8, 128), jnp.float32)
+            f_noop(xs).block_until_ready()
+            floor = []
+            for _ in range(10):
+                t0 = time.perf_counter()
+                f_noop(xs).block_until_ready()
+                floor.append(1000 * (time.perf_counter() - t0))
+            extra["dispatch_floor_ms"] = round(float(np.percentile(floor, 50)), 1)
+
+            ttfts = []
+            for i in range(8):
+                o1 = app.generate(single, max_new_tokens=1)
+                if i:  # first call pays the bs=1-bucket compilation
+                    ttfts.append(o1.ttft_s)
+            extra["ttft_p50_ms"] = round(
+                1000.0 * float(np.percentile(ttfts, 50)), 1)
+
+            trace_dir = "/tmp/bench_ttft_trace"
+            shutil.rmtree(trace_dir, ignore_errors=True)
+            with prof.trace(trace_dir):
+                app.generate(single, max_new_tokens=1)
+            dev = prof.device_time_ms(trace_dir, "prefill")
+            extra["ttft_device_ms"] = round(dev, 2) if dev is not None else None
+        except Exception as e:
+            _note(f"ttft phase failed: {e}")
+        print(json.dumps(result), flush=True)
+
+    if not small and _remaining() > 360:
+        _note("phase: paged continuous-batching serving (same config as headline)")
         # free the dense app's device buffers first: the paged serving app loads
         # its own 8 GB of int8 weights, and two copies exceed one chip's HBM
         app.params = None
@@ -208,21 +304,22 @@ def main() -> None:
         import gc
 
         gc.collect()
-        extra["paged_serving_tok_per_s"] = _paged_serving_throughput(hf_cfg, quant)
+        try:
+            paged = _paged_serving_throughput(hf_cfg, quant, batch)
+            extra["paged_serving_tok_per_s"] = paged
+            extra["paged_vs_dense"] = round(paged / tok_per_s, 3)
+        except Exception as e:
+            _note(f"paged phase failed: {e}")
 
-    print(json.dumps({
-        "metric": name,
-        "value": round(tok_per_s, 1),
-        "unit": "tokens/s",
-        "vs_baseline": round(tok_per_s / 2000.0, 3),
-        "extra": extra,
-    }))
+    # FINAL EMIT: same schema, enriched extra. The driver parses the last JSON
+    # line; if the process was killed earlier, the early emit already landed.
+    print(json.dumps(result), flush=True)
 
 
-def _paged_serving_throughput(hf_cfg, quant) -> float:
+def _paged_serving_throughput(hf_cfg, quant, batch) -> float:
     """Steady-state decode throughput of the PAGED continuous-batching serving
-    path with the Pallas ragged kernels (the production serving layout; the
-    headline metric above is the dense fixed-batch layout)."""
+    path with the Pallas ragged kernels, at the SAME batch/quant config as the
+    dense headline (VERDICT r3 #2: the serving path must carry the headline)."""
     import time as _time
 
     from neuronx_distributed_inference_tpu.config import (
@@ -232,7 +329,7 @@ def _paged_serving_throughput(hf_cfg, quant) -> float:
     from neuronx_distributed_inference_tpu.runtime.continuous_batching import (
         ContinuousBatchingRunner)
 
-    bs, seq, block = 32, 1024, 128
+    bs, seq, block = batch, 1024, 128
     cfg = TpuConfig(batch_size=bs, seq_len=seq, max_context_length=256,
                     dtype="bfloat16", tp_degree=1,
                     context_encoding_buckets=[256],
