@@ -157,8 +157,22 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--prompt", action="append", default=None,
                    help="repeatable; prompts to generate from")
     g.add_argument("--check-accuracy-mode",
-                   choices=["skip", "token-matching", "logit-matching"],
-                   default="skip")
+                   choices=["skip", "token-matching", "logit-matching",
+                            "chunked-prefill-logit-matching"],
+                   default="skip",
+                   help="chunked-prefill-logit-matching drives the paged "
+                        "chunked-prefill loop (utils/accuracy."
+                        "generate_with_chunked_prefill, ≈ reference "
+                        "accuracy.py:940) and logit-matches it vs HF CPU")
+    g.add_argument("--draft-golden-path", default=None, metavar="DIR",
+                   help="draft-logit goldens dir for fused speculation "
+                        "(≈ reference run_accuracy_draft_logit_test_flow, "
+                        "accuracy.py:1214); with --save-draft-goldens the dir "
+                        "is written instead of checked")
+    g.add_argument("--save-draft-goldens", action="store_true",
+                   help="write draft logits to --draft-golden-path instead of "
+                        "checking against it")
+    g.add_argument("--num-draft-loops-to-check", type=int, default=6)
     g.add_argument("--divergence-difference-tol", type=float, default=0.001)
     g.add_argument("--tol-map", default=None, metavar="JSON",
                    help='''per-position tolerance map for logit matching, e.g.
@@ -324,6 +338,10 @@ def run_inference(args: argparse.Namespace) -> int:
         if rc != 0:
             return rc
 
+    if args.draft_golden_path and not (args.speculation_length
+                                       or args.speculation_type != "fused"):
+        raise SystemExit("--draft-golden-path requires a speculative run "
+                         "(--speculation-length with --draft-model-path)")
     if args.speculation_length or args.speculation_type != "fused":
         spec_model = _build_spec_engine(args, app)
         input_ids, attention_mask = _encode_prompts(args, tokenizer,
@@ -331,8 +349,31 @@ def run_inference(args: argparse.Namespace) -> int:
         kwargs = {}
         if args.speculation_type == "fused":
             kwargs = dict(attention_mask=attention_mask, seed=args.seed)
+            if args.draft_golden_path:
+                kwargs["capture_draft_logits"] = True
         out = spec_model.generate(input_ids, max_new_tokens=args.max_new_tokens,
                                   **kwargs)
+        if args.draft_golden_path and args.speculation_type == "fused":
+            # draft/target divergence reported separately (≈ reference
+            # `run_accuracy_draft_logit_test_flow`, accuracy.py:1214)
+            from .utils import accuracy as accuracy_lib
+
+            if args.save_draft_goldens:
+                accuracy_lib.save_draft_goldens(args.draft_golden_path,
+                                                out.draft_logits)
+                print(f"draft goldens: saved {len(out.draft_logits)} loops "
+                      f"to {args.draft_golden_path}")
+            else:
+                drep = accuracy_lib.check_accuracy_draft_logits(
+                    out.draft_logits,
+                    accuracy_lib.load_draft_goldens(args.draft_golden_path),
+                    num_loops_to_check=args.num_draft_loops_to_check)
+                print(f"draft logit matching: passed={drep.passed} "
+                      f"loops_checked={drep.checked_loops} "
+                      f"max_topk_err={drep.max_topk_err:.5f} "
+                      f"first_failure={drep.first_failure}")
+                if not drep.passed:
+                    return 1
         if tokenizer is not None:
             for row in out.tokens:
                 print(tokenizer.decode([t for t in row if t >= 0]))
@@ -514,6 +555,30 @@ def _run_accuracy_check(args, app, tokenizer) -> int:
     tol_map = None
     if args.tol_map:
         tol_map = {int(k): tuple(v) for k, v in json.loads(args.tol_map).items()}
+    if args.check_accuracy_mode == "chunked-prefill-logit-matching":
+        from .utils.accuracy import (check_logit_accuracy,
+                                     generate_with_chunked_prefill,
+                                     get_hf_expected_outputs)
+
+        if attention_mask is not None and not np.asarray(attention_mask).all():
+            # the chunked-prefill loop feeds the padded batch as-is (lockstep
+            # chunks, the reference's [max_num_seqs, input_len] contract) while
+            # HF goldens are computed per-row unpadded — unequal-length prompts
+            # would spuriously fail
+            raise SystemExit("chunked-prefill-logit-matching requires "
+                             "equal-length prompts (lockstep chunk contract)")
+        expected_tokens, expected_logits = get_hf_expected_outputs(
+            hf_model, input_ids, n_check, attention_mask)
+        tokens, logits = generate_with_chunked_prefill(app, input_ids, n_check)
+        report = check_logit_accuracy(
+            logits, expected_logits,
+            divergence_difference_tol=args.divergence_difference_tol,
+            tol_map=tol_map)
+        tok_ok = bool((tokens == expected_tokens[:, : tokens.shape[1]]).all())
+        print(f"chunked-prefill logit matching: passed={report.passed} "
+              f"tokens_match={tok_ok} max_abs_err={report.max_abs_error:.5f} "
+              f"divergence_index={report.divergence_index}")
+        return 0 if (report.passed and tok_ok) else 1
     if args.check_accuracy_mode == "logit-matching":
         report = check_accuracy_vs_hf(
             app, hf_model, input_ids, n_check, attention_mask,

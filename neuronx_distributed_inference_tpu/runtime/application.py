@@ -35,6 +35,7 @@ from ..models import base as model_base
 from ..ops import sampling as sampling_ops
 from ..parallel import mesh as mesh_lib
 from ..parallel.sharding import named_sharding, shard_put, tree_shardings
+from ..utils import benchmark as benchmark_lib
 from ..utils import checkpoint as ckpt_lib
 from . import model_wrapper
 
@@ -905,6 +906,7 @@ class TpuModelForCausalLM:
                 padded, sampling_params, sub, adapter_ids, mm=_mm_embeds)
         tokens_dev.block_until_ready()
         ttft = time.perf_counter() - t_start
+        benchmark_lib.record_submodel(benchmark_lib.CONTEXT_ENCODING_MODEL, ttft)
 
         all_logits = [np.asarray(logits_dev)[:b]] if return_logits else None
         chunks = [np.asarray(tokens_dev)[:, None]]
@@ -939,16 +941,18 @@ class TpuModelForCausalLM:
             nonlocal last_sync_t
             toks_dev_p, logits_p, steps_p, t0_p = p
             toks = np.asarray(toks_dev_p)          # (B, steps); blocks
+            # async_mode: this chunk was dispatched while the PREVIOUS chunk was
+            # still in flight, so wall time since its dispatch t0 overlaps the
+            # prior chunk's — summing those would double-count. Time since the
+            # previous sync instead: syncs are serialized, so sync-to-sync deltas
+            # partition wall time exactly.
+            now = time.perf_counter()
+            start = max(t0_p, last_sync_t) if async_mode else t0_p
+            benchmark_lib.record_submodel(benchmark_lib.TOKEN_GENERATION_MODEL,
+                                          now - start)
             if collect_latency:
-                # async_mode: this chunk was dispatched while the PREVIOUS chunk was
-                # still in flight, so wall time since its dispatch t0 overlaps the
-                # prior chunk's — summing those would double-count. Time since the
-                # previous sync instead: syncs are serialized, so sync-to-sync deltas
-                # partition wall time exactly.
-                now = time.perf_counter()
-                start = max(t0_p, last_sync_t) if async_mode else t0_p
                 decode_lat.append((now - start, steps_p))
-                last_sync_t = now
+            last_sync_t = now
             chunks.append(toks)
             if return_logits:
                 lc = np.asarray(logits_p)          # (steps, B, V)

@@ -143,7 +143,15 @@ class TpuModelForImageToText(TpuModelForCausalLM):
         """(N_images, C, H, W) -> (N_images, T_img, H_text) via the jitted encoder."""
         if self.vision_params is None:
             raise RuntimeError("load vision weights before encoding images")
-        return np.asarray(self._encode_step(self.vision_params, pixel_values))
+        import time as _time
+
+        from ..utils import benchmark as benchmark_lib
+
+        t0 = _time.perf_counter()
+        feats = np.asarray(self._encode_step(self.vision_params, pixel_values))
+        benchmark_lib.record_submodel(benchmark_lib.VISION_ENCODER_MODEL,
+                                      _time.perf_counter() - t0)
+        return feats
 
     # --- warmup -----------------------------------------------------------------------
     def warmup(self) -> None:

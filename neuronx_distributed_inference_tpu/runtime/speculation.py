@@ -40,6 +40,7 @@ from ..config import OnDeviceSamplingConfig
 from ..models import base as model_base
 from ..modules import autobucketing
 from ..ops import sampling as sampling_ops
+from ..utils import benchmark as benchmark_lib
 from . import model_wrapper
 
 
@@ -51,6 +52,8 @@ class SpecGenerateOutput:
     acceptance_counts: np.ndarray     # histogram over tokens-emitted-per-step (len K)
     steps: int = 0
     ttft_s: Optional[float] = None
+    # per-step (B, K-1, V) draft logits when requested via capture_draft_logits
+    draft_logits: Optional[List[np.ndarray]] = None
 
 
 def commit_row(committed_i: List[int], toks, eos_token_id: Optional[int],
@@ -155,13 +158,16 @@ class FusedSpeculativeModel:
                         if self.draft._use_decode_kernel() else {})
 
         def _step(t_params, d_params, last_tok, positions, t_cache, d_cache,
-                  sampling_params, key, decode_bucket):
+                  sampling_params, key, decode_bucket, with_draft_logits=False):
             """One fused speculative step.
 
             last_tok (B,) int32: last committed token (its KV not yet written).
             positions (B,) int32: write position of last_tok.
-            Returns (out_tokens (B, K), num_valid (B,), t_cache, d_cache) where
-            out_tokens[:, :num_valid] are the newly committed tokens.
+            Returns (out_tokens (B, K), num_valid (B,), t_cache, d_cache, extras)
+            where out_tokens[:, :num_valid] are the newly committed tokens and
+            extras is the (B, K-1, V) draft logits when ``with_draft_logits``
+            (static) is set — the capture feeding draft-logit accuracy checks
+            (≈ reference `capture_draft_logits`, `utils/accuracy.py:1214`) — else ().
             """
             key_d, key_acc, key_res, key_bonus = jax.random.split(key, 4)
             d_keys = jax.random.split(key_d, k)
@@ -232,10 +238,12 @@ class FusedSpeculativeModel:
                 slot = jnp.arange(k)[None, :]
                 out_toks = jnp.where(slot < n[:, None], drafts_ext, correction)
 
-            return out_toks, n.astype(jnp.int32), t_cache, d_cache
+            extras = draft_logits if with_draft_logits else ()
+            return out_toks, n.astype(jnp.int32), t_cache, d_cache, extras
 
-        self._spec_step = jax.jit(_step, donate_argnums=(4, 5),
-                                  static_argnames=("decode_bucket",))
+        self._spec_step = jax.jit(
+            _step, donate_argnums=(4, 5),
+            static_argnames=("decode_bucket", "with_draft_logits"))
 
     # ------------------------------------------------------------------ generate
     def generate(
@@ -247,11 +255,16 @@ class FusedSpeculativeModel:
         eos_token_id: Optional[int] = None,
         pad_token_id: int = 0,
         seed: int = 0,
+        capture_draft_logits: bool = False,
     ) -> SpecGenerateOutput:
         """Host orchestration loop (≈ `_fused_assisted_decoding`, `hf_adapter.py:494`).
 
         Rows commit a variable 1..K tokens per step, so rows advance unevenly; finished
         rows keep stepping (SPMD batch) with frozen positions and their outputs dropped.
+
+        ``capture_draft_logits`` returns the per-step (B, K-1, V) draft logits in
+        ``output.draft_logits`` for draft-logit accuracy checking (≈ reference
+        `run_accuracy_draft_logit_test_flow`, `utils/accuracy.py:1214`).
         """
         target, draft = self.target, self.draft
         cfg = target.tpu_config
@@ -288,6 +301,7 @@ class FusedSpeculativeModel:
             padded.last_token_idx, draft.kv_cache, sampling_params, sub)
         tok0 = np.asarray(tok0_dev)
         ttft = time.perf_counter() - t_start
+        benchmark_lib.record_submodel(benchmark_lib.CONTEXT_ENCODING_MODEL, ttft)
 
         committed: List[List[int]] = [[int(tok0[i])] for i in range(b)]
         done = np.zeros((compiled_b,), dtype=bool)
@@ -298,6 +312,7 @@ class FusedSpeculativeModel:
         last_tok = tok0.astype(np.int32)
         accept_hist = np.zeros((self.k,), dtype=np.int64)
         steps = 0
+        draft_logits_loops: List[np.ndarray] = []
 
         while not all(len(c) >= max_new_tokens or done[i] for i, c in enumerate(committed)):
             max_pos = int(positions.max())
@@ -306,12 +321,18 @@ class FusedSpeculativeModel:
             bucket = autobucketing.select_bucket(target.tkg_buckets,
                                                  max_pos + self.k)
             key, sub = jax.random.split(key)
-            out_dev, n_dev, target.kv_cache, draft.kv_cache = self._spec_step(
+            t_step0 = time.perf_counter()
+            out_dev, n_dev, target.kv_cache, draft.kv_cache, extras = self._spec_step(
                 target.params, draft.params, jnp.asarray(last_tok),
                 jnp.asarray(positions), target.kv_cache, draft.kv_cache,
-                sampling_params, sub, decode_bucket=bucket)
+                sampling_params, sub, decode_bucket=bucket,
+                with_draft_logits=capture_draft_logits)
             out = np.asarray(out_dev)    # (B, K)
             n = np.asarray(n_dev)        # (B,)
+            benchmark_lib.record_submodel(benchmark_lib.SPECULATION_MODEL,
+                                          time.perf_counter() - t_step0)
+            if capture_draft_logits:
+                draft_logits_loops.append(np.asarray(extras))  # (B, K-1, V)
             steps += 1
             for i in range(b):
                 if done[i]:
@@ -325,5 +346,8 @@ class FusedSpeculativeModel:
                     last_tok[i] = out[i, take - 1]
             # frozen rows re-step harmlessly at their last position
 
-        return assemble_spec_output(committed, padded, b, pad_token_id, accept_hist,
-                                    steps, ttft)
+        out = assemble_spec_output(committed, padded, b, pad_token_id, accept_hist,
+                                   steps, ttft)
+        if capture_draft_logits:
+            out.draft_logits = draft_logits_loops
+        return out

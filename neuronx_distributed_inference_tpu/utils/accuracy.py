@@ -155,3 +155,212 @@ def check_accuracy_vs_hf(app, hf_model, input_ids: np.ndarray, max_new_tokens: i
                                   divergence_difference_tol, tol_map)
     report.passed = report.passed and token_ok
     return report
+
+
+# ---------------------------------------------------------------------------
+# Draft-logit matching (speculative decoding)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class DraftLogitReport:
+    passed: bool
+    checked_loops: int
+    # (loop, draft_iter) of the first tolerance failure; None when passed
+    first_failure: Optional[Tuple[int, int]]
+    max_topk_err: float
+
+
+def save_draft_goldens(directory: str, draft_logits_loops: List[np.ndarray]) -> None:
+    """Save per-loop draft logits as ``draft_logits_{n}.npy`` (≈ the reference's
+    ``draft_logits_{n}.pt`` golden dirs, `utils/accuracy.py:1233-1240`)."""
+    import os
+
+    os.makedirs(directory, exist_ok=True)
+    for i, arr in enumerate(draft_logits_loops):
+        np.save(os.path.join(directory, f"draft_logits_{i}.npy"), np.asarray(arr))
+
+
+def load_draft_goldens(directory: str) -> List[np.ndarray]:
+    """Load goldens saved by :func:`save_draft_goldens`, sorted by loop number."""
+    import os
+    import re
+
+    nums = sorted(int(m.group(1)) for f in os.listdir(directory)
+                  if (m := re.match(r"draft_logits_(\d+)\.npy$", f)))
+    return [np.load(os.path.join(directory, f"draft_logits_{n}.npy")) for n in nums]
+
+
+def check_accuracy_draft_logits(
+    actual_loops: List[np.ndarray],     # per spec step: (B, K-1, V) draft logits
+    expected_loops: List[np.ndarray],   # goldens, same shape
+    num_loops_to_check: int = 6,
+    top_k: int = 2,
+    rtol: float = 1e-5,
+    atol: float = 0.02,
+) -> DraftLogitReport:
+    """Per-draft-loop logit matching (≈ `check_accuracy_draft_logit` /
+    `check_logits_per_draft_loop`, reference `utils/accuracy.py:1214-1268`).
+
+    For each draft loop, each draft iteration's actual logits are compared at the
+    golden's top-``top_k`` token positions (allclose within rtol/atol). A tolerance
+    failure fails the check; a top-1 *token* divergence (argmax mismatch without a
+    tolerance failure) only stops further validation within that loop — later
+    iterations were conditioned on a different token, exactly the reference's
+    early-stop semantics."""
+    passed = True
+    first_failure = None
+    max_err = 0.0
+    n = min(num_loops_to_check, len(actual_loops), len(expected_loops))
+    if n == 0:
+        # a silent pass over zero comparisons would defeat the check (empty or
+        # wrong golden dir, or a capture that produced no loops)
+        raise ValueError(
+            f"no draft loops to compare (actual={len(actual_loops)}, "
+            f"expected={len(expected_loops)})")
+    for loop in range(n):
+        got = np.asarray(actual_loops[loop], dtype=np.float32)    # (B, K-1, V)
+        want = np.asarray(expected_loops[loop], dtype=np.float32)
+        if got.ndim == 2:                   # unbatched (K-1, V) goldens
+            got, want = got[None], want[None]
+        iters = min(got.shape[1], want.shape[1])
+        for i in range(iters):
+            idx = np.argsort(want[:, i], axis=-1)[:, -top_k:]      # (B, top_k)
+            got_k = np.take_along_axis(got[:, i], idx, axis=-1)
+            want_k = np.take_along_axis(want[:, i], idx, axis=-1)
+            err = float(np.max(np.abs(got_k - want_k)))
+            max_err = max(max_err, err)
+            if not np.allclose(got_k, want_k, rtol=rtol, atol=atol):
+                logger.warning(
+                    "draft logit mismatch at loop %d iter %d: max|err|=%.5f "
+                    "(atol=%.5f)", loop, i, err, atol)
+                if passed:
+                    first_failure = (loop, i)
+                passed = False
+                break
+            if (np.argmax(got[:, i], axis=-1)
+                    != np.argmax(want[:, i], axis=-1)).any():
+                logger.info(
+                    "draft tokens diverge at loop %d iter %d; validated up to "
+                    "here in this loop", loop, i)
+                break
+        if not passed:
+            break
+    return DraftLogitReport(passed=passed, checked_loops=n,
+                            first_failure=first_failure, max_topk_err=max_err)
+
+
+def check_draft_accuracy_vs_reference(
+    spec_model, golden_source, input_ids: np.ndarray, max_new_tokens: int = 32,
+    num_loops_to_check: int = 6, top_k: int = 2, atol: float = 0.02,
+) -> DraftLogitReport:
+    """One-call draft-logit flow (≈ `run_accuracy_draft_logit_test_flow` :1214):
+    run the fused speculative model with draft-logit capture and compare against
+    ``golden_source`` — a golden directory (str) or a list of per-loop arrays."""
+    out = spec_model.generate(np.asarray(input_ids),
+                              max_new_tokens=max_new_tokens,
+                              capture_draft_logits=True)
+    expected = (load_draft_goldens(golden_source)
+                if isinstance(golden_source, str) else golden_source)
+    return check_accuracy_draft_logits(out.draft_logits, expected,
+                                       num_loops_to_check=num_loops_to_check,
+                                       top_k=top_k, atol=atol)
+
+
+# ---------------------------------------------------------------------------
+# Chunked-prefill generation loop (paged KV accuracy path)
+# ---------------------------------------------------------------------------
+
+
+def generate_with_chunked_prefill(app, input_ids: np.ndarray,
+                                  max_new_tokens: int,
+                                  chunk_size: Optional[int] = None):
+    """Generate through the chunked-prefill paged-KV path, returning per-step
+    logits for accuracy comparison (≈ reference `generate_with_chunked_prefill`,
+    `utils/accuracy.py:940-1030`).
+
+    The prompt (all rows the same length, like the reference's
+    ``[max_num_seqs, input_len]`` contract) is prefilled in lockstep chunks: each
+    iteration feeds ``chunk_size`` tokens per row as a wide paged decode call whose
+    queries see all prior chunks' KV through an identity block table. Decode then
+    runs greedy one token at a time with logits captured.
+
+    Returns ``(tokens (B, max_new_tokens), logits)`` where ``logits`` is a
+    per-step list of (B, V) arrays — feed to :func:`check_logit_accuracy`.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..modules.block_kvcache import make_slot_mapping
+
+    cfg = app.tpu_config
+    if not cfg.paged_attention_enabled:
+        raise ValueError("generate_with_chunked_prefill requires "
+                         "paged_attention_enabled")
+    input_ids = np.asarray(input_ids).astype(np.int32)
+    b, s = input_ids.shape
+    if s + max_new_tokens > cfg.seq_len:
+        # out-of-range positions would map to slot -1 (dropped KV writes) and
+        # silently corrupt later steps' attention instead of erroring
+        raise ValueError(f"prompt ({s}) + max_new_tokens ({max_new_tokens}) "
+                         f"exceeds seq_len {cfg.seq_len}")
+    bs = cfg.pa_block_size
+    nb_per_seq = -(-cfg.seq_len // bs)
+    if b * nb_per_seq > cfg.pa_num_blocks:
+        raise ValueError(f"need {b * nb_per_seq} blocks for {b} rows of "
+                         f"seq_len {cfg.seq_len}, have {cfg.pa_num_blocks}")
+    chunk = int(chunk_size or cfg.max_context_length)
+    block_table = np.arange(b * nb_per_seq, dtype=np.int32).reshape(b, nb_per_seq)
+    cache = app.make_paged_cache(cfg.pa_num_blocks, bs)
+
+    args, mesh, rules = app.arch_args, app.mesh, app.sharding_rules
+    decode_core = app.decode_fn()
+    precision = "highest" if cfg.dtype == "float32" else "default"
+
+    @jax.jit
+    def _prefill_chunk(params, ids, pos, cache, table, slots):
+        with jax.default_matmul_precision(precision):
+            logits, cache = decode_core(params, args, ids, pos, cache, None,
+                                        mesh=mesh, rules=rules,
+                                        block_table=table, slot_mapping=slots)
+        return logits, cache
+
+    @jax.jit
+    def _decode_one(params, tok, pos, cache, table, slots):
+        with jax.default_matmul_precision(precision):
+            logits, cache = decode_core(params, args, tok[:, None], pos, cache,
+                                        None, mesh=mesh, rules=rules,
+                                        block_table=table, slot_mapping=slots)
+        return logits[:, -1], cache
+
+    table_dev = jnp.asarray(block_table)
+    last_logits = None
+    for start in range(0, s, chunk):
+        end = min(start + chunk, s)
+        w = end - start
+        ids = np.zeros((b, chunk), dtype=np.int32)
+        ids[:, :w] = input_ids[:, start:end]
+        valid = np.zeros((b, chunk), dtype=bool)
+        valid[:, :w] = True
+        pos = np.full((b,), start, dtype=np.int32)
+        slots = make_slot_mapping(block_table, pos, chunk, bs, valid=valid)
+        logits, cache = _prefill_chunk(app.params, jnp.asarray(ids),
+                                       jnp.asarray(pos), cache, table_dev,
+                                       jnp.asarray(slots))
+        last_logits = np.asarray(logits[:, w - 1])       # (B, V)
+
+    all_logits = [last_logits]
+    tok = np.argmax(last_logits, axis=-1).astype(np.int32)
+    tokens = [tok]
+    positions = np.full((b,), s, dtype=np.int32)
+    for _ in range(max_new_tokens - 1):
+        slots = make_slot_mapping(block_table, positions, 1, bs)
+        step_logits, cache = _decode_one(app.params, jnp.asarray(tok),
+                                         jnp.asarray(positions), cache,
+                                         table_dev, jnp.asarray(slots))
+        step_logits = np.asarray(step_logits)
+        all_logits.append(step_logits)
+        tok = np.argmax(step_logits, axis=-1).astype(np.int32)
+        tokens.append(tok)
+        positions = positions + 1
+    return np.stack(tokens, axis=1), all_logits

@@ -9,6 +9,7 @@ are the BASELINE.md headline metrics.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import time
@@ -18,6 +19,45 @@ from typing import Dict, List, Optional
 import numpy as np
 
 BENCHMARK_REPORT_FILENAME = "benchmark_report.json"
+
+# submodel names follow the reference's constants (`utils/benchmark.py:380-429`)
+CONTEXT_ENCODING_MODEL = "context_encoding_model"
+TOKEN_GENERATION_MODEL = "token_generation_model"
+SPECULATION_MODEL = "speculation_model"
+VISION_ENCODER_MODEL = "vision_encoder_model"
+
+# Per-submodel latency registry (≈ the reference's forward pre/post hooks,
+# `create_submodule_latency_collectors`/`register_latency_collectors`
+# `utils/benchmark.py:380-414`). Functional JAX has no module hooks, so the
+# runtimes call `record_submodel(...)` at their dispatch sites (prefill, decode
+# chunk, speculative step, vision encode); recording is a no-op unless a
+# `submodel_collection()` scope is active.
+_ACTIVE_SUBMODELS: Optional[Dict[str, "LatencyCollector"]] = None
+
+
+@contextlib.contextmanager
+def submodel_collection():
+    """Scope under which runtime dispatch sites record per-submodel latencies.
+    Yields the {submodel_name: LatencyCollector} dict being filled."""
+    global _ACTIVE_SUBMODELS
+    prev, _ACTIVE_SUBMODELS = _ACTIVE_SUBMODELS, {}
+    try:
+        yield _ACTIVE_SUBMODELS
+    finally:
+        _ACTIVE_SUBMODELS = prev
+
+
+def record_submodel(name: str, seconds: float) -> None:
+    """Record one latency sample for a submodel; no-op outside a collection scope."""
+    if _ACTIVE_SUBMODELS is None:
+        return
+    _ACTIVE_SUBMODELS.setdefault(name, LatencyCollector()).samples_s.append(seconds)
+
+
+def generate_submodel_reports(
+        collectors: Dict[str, "LatencyCollector"]) -> Dict[str, Dict[str, float]]:
+    """Percentile report per submodel (≈ `generate_submodule_reports` :415-429)."""
+    return {name: c.report() for name, c in collectors.items() if c.samples_s}
 
 
 @dataclass
@@ -29,7 +69,7 @@ class BenchmarkReport:
     n_runs: int
     batch_size: int
     max_new_tokens: int
-    extra: Dict[str, float] = field(default_factory=dict)
+    extra: Dict[str, object] = field(default_factory=dict)
 
     def to_dict(self) -> Dict:
         return {
@@ -71,8 +111,13 @@ def benchmark_sampling(
     n_runs: int = 5,
     warmup_runs: int = 1,
     report_dir: Optional[str] = None,
+    submodel_breakdown: bool = True,
 ) -> BenchmarkReport:
-    """Measure end-to-end generate latency/throughput (≈ `benchmark_sampling` :21)."""
+    """Measure end-to-end generate latency/throughput (≈ `benchmark_sampling` :21).
+
+    ``submodel_breakdown`` additionally reports per-submodel latency percentiles
+    (context encoding / token generation chunks / speculation steps / vision encode)
+    under ``extra["submodels"]`` (≈ reference `utils/benchmark.py:380-429`)."""
     cfg = app.tpu_config
     if input_ids is None:
         rng = np.random.default_rng(0)
@@ -88,17 +133,19 @@ def benchmark_sampling(
     decode_s = 0.0
     decode_tokens = 0
     generated_tokens = 0
+    scope = submodel_collection() if submodel_breakdown else contextlib.nullcontext({})
     total_t0 = time.perf_counter()
-    for _ in range(n_runs):
-        t0 = time.perf_counter()
-        out = app.generate(input_ids, max_new_tokens=max_new_tokens,
-                           collect_latency=True)
-        e2e.append(time.perf_counter() - t0)
-        ttft.append(out.ttft_s)
-        generated_tokens += out.tokens.size
-        for s, toks in out.decode_latencies_s or []:
-            decode_s += s
-            decode_tokens += toks * input_ids.shape[0]
+    with scope as collectors:
+        for _ in range(n_runs):
+            t0 = time.perf_counter()
+            out = app.generate(input_ids, max_new_tokens=max_new_tokens,
+                               collect_latency=True)
+            e2e.append(time.perf_counter() - t0)
+            ttft.append(out.ttft_s)
+            generated_tokens += out.tokens.size
+            for s, toks in out.decode_latencies_s or []:
+                decode_s += s
+                decode_tokens += toks * input_ids.shape[0]
     total_time = time.perf_counter() - total_t0
 
     report = BenchmarkReport(
@@ -110,6 +157,8 @@ def benchmark_sampling(
         batch_size=int(input_ids.shape[0]),
         max_new_tokens=max_new_tokens,
     )
+    if submodel_breakdown and collectors:
+        report.extra["submodels"] = generate_submodel_reports(collectors)
     if report_dir:
         report.save(report_dir)
     return report

@@ -112,3 +112,38 @@ def test_parity_flags_map_to_config():
     args = build_parser().parse_args(
         ["--model-path", "/tmp/x", "--cp-degree", "2", "--mlp-cp-degree", "2"])
     create_tpu_config(args)                          # equal degrees accepted
+
+
+def test_cli_chunked_prefill_accuracy_and_draft_goldens(tmp_path):
+    """Round-4 harness parity through the CLI: the chunked-prefill accuracy
+    mode (paged path vs HF CPU) and the draft-logit golden save+check flow."""
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM as HFLlama
+
+    from neuronx_distributed_inference_tpu.inference_demo import main
+
+    ckpt = str(tmp_path / "ckpt")
+    cfg = LlamaConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2)
+    torch.manual_seed(0)
+    HFLlama(cfg).eval().save_pretrained(ckpt, safe_serialization=True)
+
+    base = ["--model-path", ckpt, "--batch-size", "1", "--seq-len", "64",
+            "--max-context-length", "32", "--dtype", "float32",
+            "--max-new-tokens", "6",
+            "--context-encoding-buckets", "16", "32",
+            "--token-generation-buckets", "32", "64",
+            "--prompt", "hello world"]
+
+    assert main(base + ["--check-accuracy-mode",
+                        "chunked-prefill-logit-matching",
+                        "--continuous-batching", "--paged-attention",
+                        "--pa-num-blocks", "24", "--pa-block-size", "8",
+                        "--divergence-difference-tol", "0.002"]) == 0
+
+    goldens = str(tmp_path / "draft_goldens")
+    spec = base + ["--speculation-length", "3", "--draft-model-path", ckpt,
+                   "--draft-golden-path", goldens]
+    assert main(spec + ["--save-draft-goldens"]) == 0
+    assert main(spec) == 0          # deterministic greedy re-run matches goldens
