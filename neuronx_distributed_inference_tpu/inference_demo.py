@@ -31,7 +31,17 @@ logger = logging.getLogger("tpu-inference")
 
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(description="TPU inference demo")
-    p.add_argument("--model-path", required=True, help="HF checkpoint directory")
+    p.add_argument("--model-path", default=None,
+                   help="HF checkpoint directory (optional with "
+                        "--artifacts-path)")
+    p.add_argument("--artifacts-path", default=None, metavar="DIR",
+                   help="warm start from a serving-artifact dir saved by "
+                        "--save-artifacts: skips HF ingest + quantization and "
+                        "reuses the dir's compile cache (≈ reference "
+                        "--skip-compile)")
+    p.add_argument("--save-artifacts", default=None, metavar="DIR",
+                   help="after load, persist config + converted/quantized "
+                        "weights + compile cache dir for warm starts")
     p.add_argument("--model-type", default=None,
                    help="model family (default: read model_type from config.json)")
     p.add_argument("--compiled-path", default=None,
@@ -290,6 +300,8 @@ def create_tpu_config(args: argparse.Namespace) -> TpuConfig:
 
 
 def run_inference(args: argparse.Namespace) -> int:
+    if not args.model_path and not args.artifacts_path:
+        raise SystemExit("one of --model-path or --artifacts-path is required")
     if args.cpu:
         import jax
 
@@ -299,22 +311,53 @@ def run_inference(args: argparse.Namespace) -> int:
 
         set_runtime_env(args.seq_len,
                         compilation_cache_dir=args.compilation_cache_dir)
+    if args.save_artifacts and not args.compilation_cache_dir:
+        # register the artifact compile cache BEFORE the cold run's jits so the
+        # first warm start already skips compilation (the --skip-compile analog)
+        import os
+
+        from .utils.runtime_env import set_runtime_env
+
+        set_runtime_env(args.seq_len,
+                        compilation_cache_dir=os.path.join(args.save_artifacts,
+                                                           "compile_cache"))
     if args.input_capture_save_dir:
         import os
 
         os.environ["TPUINF_CAPTURE_DIR"] = args.input_capture_save_dir
         os.environ["TPUINF_CAPTURE_WEIGHTS"] = "1"
 
-    model_type = args.model_type
-    if model_type is None:
-        with open(f"{args.model_path}/config.json") as f:
-            model_type = json.load(f).get("model_type", "llama")
-    model_cls = get_model_cls(model_type)
+    if args.artifacts_path:
+        # warm start from a saved serving-artifact dir: no HF ingest, no
+        # re-quantize, compile cache reused (≈ reference --skip-compile,
+        # `inference_demo.py:367-372`)
+        if args.check_accuracy_mode != "skip" and not args.model_path:
+            raise SystemExit("--check-accuracy-mode needs the HF golden model: "
+                             "pass --model-path alongside --artifacts-path")
+        logger.warning("--artifacts-path: serving config comes from the saved "
+                       "tpu_config.json; serving flags (batch-size, seq-len, "
+                       "buckets, quantization, parallelism, ...) on this "
+                       "command line are ignored")
+        with open(f"{args.artifacts_path}/tpu_config.json") as f:
+            model_type = args.model_type or json.load(f).get("model_type",
+                                                             "llama")
+        model_cls = get_model_cls(model_type)
+        logger.info("warm start: %s from artifacts %s", model_cls.__name__,
+                    args.artifacts_path)
+        app = model_cls.from_artifacts(args.artifacts_path)
+    else:
+        model_type = args.model_type
+        if model_type is None:
+            with open(f"{args.model_path}/config.json") as f:
+                model_type = json.load(f).get("model_type", "llama")
+        model_cls = get_model_cls(model_type)
 
-    tpu_config = create_tpu_config(args)
-    logger.info("building %s (%s) tp=%d", model_cls.__name__, model_type,
-                tpu_config.tp_degree)
-    app = model_cls.from_pretrained(args.model_path, tpu_config)
+        tpu_config = create_tpu_config(args)
+        logger.info("building %s (%s) tp=%d", model_cls.__name__, model_type,
+                    tpu_config.tp_degree)
+        app = model_cls.from_pretrained(args.model_path, tpu_config)
+    if args.save_artifacts:
+        app.save_artifacts(args.save_artifacts)
     if args.compiled_path:
         app.save_config(args.compiled_path)
 
@@ -493,9 +536,11 @@ def _run_serving(args, app, tokenizer) -> None:
             print(f"request {rid}: {toks}")
 
 
-def _try_load_tokenizer(model_path: str):
+def _try_load_tokenizer(model_path: Optional[str]):
     import os
 
+    if model_path is None:
+        return None
     if not any(os.path.exists(os.path.join(model_path, f))
                for f in ("tokenizer.json", "tokenizer_config.json",
                          "tokenizer.model")):

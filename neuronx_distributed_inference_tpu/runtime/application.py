@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import functools
 import logging
+import os
 import time
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Tuple
@@ -1011,6 +1012,64 @@ class TpuModelForCausalLM:
     # --- artifact save/load (compiled dir ≈ model.pt + neuron_config.json) ------------
     def save_config(self, directory: str) -> str:
         return self.config.save(directory)
+
+    def save_artifacts(self, directory: str) -> str:
+        """Persist the full serving artifact dir: config JSON + the CONVERTED
+        (HF-rewritten, quantized, serving-layout) weights + calibrated KV scales.
+
+        A second process start via :meth:`from_artifacts` skips HF ingest and
+        re-quantization entirely and reuses the artifact dir's XLA compile cache
+        — the TPU form of the reference's quantized-checkpoint generation,
+        pre-sharded weight save, and ``--skip-compile`` compiled-dir reuse
+        (`models/application_base.py:744-797`, `:240-265`, `inference_demo.py:367-372`).
+        """
+        if self.params is None:
+            raise RuntimeError("load weights before save_artifacts")
+        self.config.save(directory)
+        host = jax.device_get(self.params)
+        ckpt_lib.save_param_tree(os.path.join(directory, "weights"), host)
+        if getattr(self, "_kv_scales", None) is not None:
+            ckpt_lib.save_param_tree(
+                os.path.join(directory, "kv_scales"),
+                {"k": np.asarray(self._kv_scales[0]),
+                 "v": np.asarray(self._kv_scales[1])})
+        os.makedirs(os.path.join(directory, "compile_cache"), exist_ok=True)
+        logger.info("serving artifacts saved to %s", directory)
+        return directory
+
+    def load_artifacts(self, directory: str) -> None:
+        """Install weights from an artifact dir (no HF ingest, no re-quantize:
+        already-quantized leaves pass through `_put_params` untouched)."""
+        t0 = time.time()
+        host = ckpt_lib.load_param_tree(os.path.join(directory, "weights"))
+        scales_dir = os.path.join(directory, "kv_scales")
+        if os.path.isdir(scales_dir):
+            sc = ckpt_lib.load_param_tree(scales_dir)
+            self._kv_scales = (np.asarray(sc["k"]), np.asarray(sc["v"]))
+        self._put_params(host)
+        logger.info("loaded artifacts in %.1fs", time.time() - t0)
+
+    @classmethod
+    def from_artifacts(cls, directory: str, mesh=None):
+        """Reconstruct an application from :meth:`save_artifacts` output.
+
+        Reflection-based config reload picks the saved config class; the
+        artifact dir's ``compile_cache/`` is registered as the persistent XLA
+        compilation cache BEFORE any jit, so warm starts also skip compilation
+        (the ``--skip-compile`` analog)."""
+        from ..config import InferenceConfig
+        from ..utils.runtime_env import set_runtime_env
+
+        config = InferenceConfig.load(directory)
+        if not jax.config.jax_compilation_cache_dir:
+            # respect an explicitly configured cache (e.g. a shared
+            # --compilation-cache-dir); otherwise reuse the artifact dir's
+            set_runtime_env(config.tpu_config.seq_len,
+                            compilation_cache_dir=os.path.join(
+                                directory, "compile_cache"))
+        app = cls(None, config, mesh=mesh)
+        app.load_artifacts(directory)
+        return app
 
     @classmethod
     def from_pretrained(cls, model_path: str, tpu_config: TpuConfig,

@@ -147,3 +147,32 @@ def test_cli_chunked_prefill_accuracy_and_draft_goldens(tmp_path):
                    "--draft-golden-path", goldens]
     assert main(spec + ["--save-draft-goldens"]) == 0
     assert main(spec) == 0          # deterministic greedy re-run matches goldens
+
+
+def test_cli_artifact_warm_start(tmp_path):
+    """--save-artifacts then --artifacts-path warm start must generate without
+    the HF checkpoint present (it is deleted between the runs)."""
+    import shutil
+
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM as HFLlama
+
+    from neuronx_distributed_inference_tpu.inference_demo import main
+
+    ckpt = str(tmp_path / "ckpt")
+    cfg = LlamaConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2)
+    torch.manual_seed(0)
+    HFLlama(cfg).eval().save_pretrained(ckpt, safe_serialization=True)
+    art = str(tmp_path / "artifacts")
+
+    base = ["--batch-size", "1", "--seq-len", "64",
+            "--max-context-length", "32", "--dtype", "float32",
+            "--max-new-tokens", "4",
+            "--context-encoding-buckets", "16", "32",
+            "--token-generation-buckets", "32", "64",
+            "--prompt", "hello"]
+    assert main(["--model-path", ckpt, "--save-artifacts", art] + base) == 0
+    shutil.rmtree(ckpt)                      # warm start must not need it
+    assert main(["--artifacts-path", art] + base) == 0
