@@ -352,8 +352,6 @@ class TpuModelForCausalLM:
         a = self.arch_args
         if self.decode_fn() is not model_base.decode_forward:
             return "custom decode paths"
-        if a.layer_pattern is not None:
-            return "per-layer attention patterns"
         if a.head_dim % 128 != 0 and jax.default_backend() != "cpu":
             # the KV-write DMA slices the cache's minor dim, which Mosaic requires
             # aligned to the 128-lane tiling (interpret mode on CPU is unconstrained)
@@ -391,6 +389,13 @@ class TpuModelForCausalLM:
         Same arch gates as the dense kernel, plus paged-layout constraints."""
         from ..ops.paged_decode import _pack
 
+        if self.arch_args.layer_pattern is not None:
+            # rolling sliding stacks don't page; the DENSE kernel serves pattern
+            # families (see _run_stack_pattern_decode_kernel) but the block-pool
+            # layout cannot. decode_kernel_enabled=True refers to the dense
+            # kernel, so this is a quiet decline, not a config error (paged
+            # serving for pattern families is rejected by the CB runner anyway).
+            return False
         unsupported = self._decode_kernel_arch_gate()
         if unsupported is None:
             pack = _pack(self.tpu_config.kv_cache_jax_dtype)
@@ -1028,6 +1033,10 @@ class TpuModelForCausalLM:
         self.config.save(directory)
         host = jax.device_get(self.params)
         ckpt_lib.save_param_tree(os.path.join(directory, "weights"), host)
+        vision = getattr(self, "vision_params", None)
+        if vision is not None:   # multimodal families: the artifact must be whole
+            ckpt_lib.save_param_tree(os.path.join(directory, "vision_weights"),
+                                     jax.device_get(vision))
         if getattr(self, "_kv_scales", None) is not None:
             ckpt_lib.save_param_tree(
                 os.path.join(directory, "kv_scales"),
@@ -1042,6 +1051,9 @@ class TpuModelForCausalLM:
         already-quantized leaves pass through `_put_params` untouched)."""
         t0 = time.time()
         host = ckpt_lib.load_param_tree(os.path.join(directory, "weights"))
+        vdir = os.path.join(directory, "vision_weights")
+        if os.path.isdir(vdir):
+            self._put_vision_params(ckpt_lib.load_param_tree(vdir))
         scales_dir = os.path.join(directory, "kv_scales")
         if os.path.isdir(scales_dir):
             sc = ckpt_lib.load_param_tree(scales_dir)

@@ -35,7 +35,7 @@ def run(name, fn, *args):
         out = fn_j(*args)
     jax.block_until_ready(out)
     dt = (time.perf_counter() - t0) / n * 1000
-    print(f"{name:14s} {dt:7.2f} ms/iter")
+    print(f"{name:14s} {dt:7.2f} ms/iter", flush=True)
     return dt
 
 

@@ -79,24 +79,36 @@ def test_artifact_save_load_skips_hf_ingest(tmp_path, tiny_llama_hf_config,
     orig_qp = q_ops.quantize_params
 
     def _no_requant(params, dtype, names):
-        out = orig_qp(params, dtype, names)
-        # every quantized leaf must have passed through (already int8)
-        def chk(p, o):
-            if isinstance(p, dict) and "q" in p:
-                assert p["q"].dtype == np.int8
-            return o
-        return out
+        # every quantized leaf must arrive ALREADY int8 (pass-through, not a
+        # float re-quantization)
+        def walk(node):
+            if isinstance(node, dict):
+                if "q" in node and "s" in node:
+                    assert np.asarray(node["q"]).dtype == np.int8, \
+                        "warm start re-quantized from float"
+                else:
+                    for v in node.values():
+                        walk(v)
+        walk(params["layers"])
+        walk({"lm": params["lm_head"]})
+        return orig_qp(params, dtype, names)
 
     monkeypatch.setattr(q_ops, "quantize_params", _no_requant)
 
-    app2 = LlamaForCausalLM.from_artifacts(art)
-    out2 = app2.generate(ids, max_new_tokens=8)
-    np.testing.assert_array_equal(ref.tokens, out2.tokens)
-
-    # compile cache registered to the artifact dir
+    # clear any cache dir leaked by earlier tests so the registration check is
+    # about THIS artifact dir, not a stale global
     import jax
 
-    assert jax.config.jax_compilation_cache_dir.endswith("compile_cache")
+    prev_cache = jax.config.jax_compilation_cache_dir
+    jax.config.update("jax_compilation_cache_dir", None)
+    try:
+        app2 = LlamaForCausalLM.from_artifacts(art)
+        out2 = app2.generate(ids, max_new_tokens=8)
+        np.testing.assert_array_equal(ref.tokens, out2.tokens)
+        # compile cache registered to the artifact dir
+        assert jax.config.jax_compilation_cache_dir == f"{art}/compile_cache"
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev_cache)
 
 
 def test_artifact_saves_calibrated_kv_scales(tmp_path, tiny_llama_hf_config):

@@ -1,0 +1,89 @@
+"""Multi-host launcher executed coverage (VERDICT r3 #8).
+
+Drives `runtime/launcher.py` end-to-end: a REAL two-process `jax.distributed`
+CPU world (gloo collectives, 4 virtual devices per process = 8 global) runs a
+tiny tp=8 Llama generate; both ranks must emit identical tokens, and those
+tokens must equal the single-process 8-device run of the same model — the
+multi-controller analog of the reference's gloo CPU-mode SPMD validation
+(`scripts/nxdi_distributed_launcher.py:29-151`, `application_base.py:554-626`).
+"""
+
+import os
+import re
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow  # forks two fresh interpreters
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_WORKER = """
+import os, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+sys.path.insert(0, {repo!r})
+from neuronx_distributed_inference_tpu.runtime import launcher
+assert launcher.init_from_env(), "TPUINF_* env missing"
+assert jax.process_count() == 2, jax.process_count()
+import numpy as np
+from neuronx_distributed_inference_tpu.config import (TpuConfig,
+                                                      load_pretrained_config)
+from neuronx_distributed_inference_tpu.models.llama.modeling_llama import (
+    LlamaForCausalLM, LlamaInferenceConfig)
+hf = {hf!r}
+cfg = TpuConfig(batch_size=2, seq_len=64, max_context_length=32,
+                dtype="float32", tp_degree=8,
+                context_encoding_buckets=[16, 32],
+                token_generation_buckets=[32, 64])
+config = LlamaInferenceConfig(cfg, load_config=load_pretrained_config(hf))
+app = LlamaForCausalLM(None, config)
+app.load_random(seed=0)
+out = app.generate(np.array([[5, 9, 42, 7], [3, 1, 4, 1]], dtype=np.int64),
+                   max_new_tokens=6)
+print("RANK", jax.process_index(), "TOKENS", out.tokens.tolist(), flush=True)
+"""
+
+
+def test_two_process_world_generates_and_matches_single_process(
+        tmp_path, tiny_llama_hf_config):
+    worker = tmp_path / "worker.py"
+    worker.write_text(_WORKER.format(repo=REPO, hf=tiny_llama_hf_config))
+
+    # the pytest process already owns a jax runtime; fork the launcher CLI so
+    # the two-process world bootstraps cleanly
+    proc = subprocess.run(
+        [sys.executable, "-m",
+         "neuronx_distributed_inference_tpu.runtime.launcher",
+         "--num-processes", "2", "--coordinator-port", "9977",
+         "--", str(worker)],
+        capture_output=True, text=True, timeout=240,
+        env={**os.environ, "PYTHONPATH": REPO}, cwd=str(tmp_path))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    ranks = dict(re.findall(r"RANK (\d) TOKENS (\[\[.*?\]\])", proc.stdout))
+    assert set(ranks) == {"0", "1"}, proc.stdout
+    assert ranks["0"] == ranks["1"], "ranks disagree"
+    multihost_tokens = np.array(eval(ranks["0"]))  # noqa: S307 - our own output
+
+    # single-process 8-device run of the identical model must match exactly
+    from neuronx_distributed_inference_tpu.config import (
+        TpuConfig, load_pretrained_config)
+    from neuronx_distributed_inference_tpu.models.llama.modeling_llama import (
+        LlamaForCausalLM, LlamaInferenceConfig)
+
+    cfg = TpuConfig(batch_size=2, seq_len=64, max_context_length=32,
+                    dtype="float32", tp_degree=8,
+                    context_encoding_buckets=[16, 32],
+                    token_generation_buckets=[32, 64])
+    config = LlamaInferenceConfig(
+        cfg, load_config=load_pretrained_config(tiny_llama_hf_config))
+    app = LlamaForCausalLM(None, config)
+    app.load_random(seed=0)
+    out = app.generate(np.array([[5, 9, 42, 7], [3, 1, 4, 1]], dtype=np.int64),
+                       max_new_tokens=6)
+    np.testing.assert_array_equal(out.tokens, multihost_tokens)

@@ -94,3 +94,40 @@ def test_rolling_mask_reconstructs_positions():
     mask = np.asarray(kvcache.rolling_mask(pos, 1, w, 3))[0, 0, 0]
     # window 3: only positions > 3 admitted -> slot 3 (pos 3) drops
     assert mask.tolist() == [True, True, True, False]
+
+
+def _make_kernel(seq_len, kernel):
+    cfg = TpuConfig(batch_size=2, seq_len=seq_len, max_context_length=32,
+                    dtype="float32", context_encoding_buckets=[32],
+                    token_generation_buckets=[seq_len],
+                    decode_kernel_enabled=kernel)
+    config = Gemma3ForCausalLM.get_config_cls()(
+        cfg, load_config=load_pretrained_config(GEMMA3_CFG))
+    app = Gemma3ForCausalLM(None, config)
+    app.load_random(seed=0)
+    return app
+
+
+def test_pattern_decode_kernel_matches_jnp_path():
+    """VERDICT r3 #7: sliding/full interleaved layers decode through the Pallas
+    stacked-cache kernels (rolling write at p mod W, length-aware attend over
+    min(p+1, W) slots) and must match the jnp rolling path token-for-token far
+    past the rolling boundary (window 16 << 30 generated)."""
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(1, 256, size=(2, 20)).astype(np.int32)
+    jnp_path = _make_kernel(64, kernel=False)
+    kern_path = _make_kernel(64, kernel=True)
+    ref = jnp_path.generate(prompt, max_new_tokens=30, return_logits=True)
+    got = kern_path.generate(prompt, max_new_tokens=30, return_logits=True)
+    np.testing.assert_array_equal(ref.tokens, got.tokens)
+    for i, (a, b) in enumerate(zip(ref.logits, got.logits)):
+        np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-4,
+                                   err_msg=f"step {i}")
+
+
+def test_pattern_decode_kernel_selector_reports_path():
+    """The selector must report the kernel path for pattern families now that the
+    gate is lifted (explicit True no longer raises; CPU auto stays off)."""
+    app = _make_kernel(64, kernel=True)
+    assert app._use_decode_kernel() is True
+    assert app._use_paged_decode_kernel() is False   # rolling stacks don't page
