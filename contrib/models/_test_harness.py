@@ -1,0 +1,176 @@
+"""Shared contrib parity harness (used by every contrib/models/<fam>/test/).
+
+Extracted from the former central tests/test_contrib_models.py: tiny
+random-weight config, last-token logit match + multi-step greedy token match
+(== the reference contrib checklist, `contrib/models/*/test/`), plus the
+hand-rolled torch oracle family for architectures absent from the installed
+transformers (internlm3 / orion / minicpm4 — see each family's README).
+"""
+
+import math  # noqa: F401
+
+import numpy as np
+import pytest
+import torch
+
+from neuronx_distributed_inference_tpu.config import (  # noqa: F401
+    TpuConfig, load_pretrained_config)
+
+__all__ = ["_tpu_cfg", "_run_parity", "_run_parity_oracle", "_OracleAttn",
+           "_OracleMLP", "_OracleRMSNorm", "_OracleLayer", "_OracleModel"]
+
+
+def _tpu_cfg():
+    return TpuConfig(batch_size=2, seq_len=64, max_context_length=32, dtype="float32",
+                     context_encoding_buckets=[16, 32],
+                     token_generation_buckets=[32, 64])
+
+
+def _run_parity(app_cls, hf_model, hf_cfg, atol=5e-4, rtol=1e-3, vocab=256,
+                eos_token_id=None):
+    config = app_cls.get_config_cls()(
+        _tpu_cfg(), load_config=load_pretrained_config(hf_cfg.to_dict()))
+    app = app_cls(None, config)
+    state = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
+    params = app.convert_hf_state_dict(state, app.config)
+    app._put_params(params)
+
+    rng = np.random.default_rng(0)
+    input_ids = rng.integers(1, vocab, size=(2, 12)).astype(np.int64)
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor(input_ids)).logits[:, -1].numpy()
+    out = app.generate(input_ids, max_new_tokens=1, return_logits=True)
+    np.testing.assert_allclose(out.logits[0], hf_logits, atol=atol, rtol=rtol)
+
+    with torch.no_grad():
+        hf_out = hf_model.generate(torch.tensor(input_ids), max_new_tokens=10,
+                                   do_sample=False, pad_token_id=0)
+    out = app.generate(input_ids, max_new_tokens=10, eos_token_id=eos_token_id)
+    np.testing.assert_array_equal(out.tokens, hf_out[:, 12:].numpy())
+
+
+class _OracleAttn(torch.nn.Module):
+    def __init__(self, H, nq, nkv, d, qkv_bias, o_bias):
+        super().__init__()
+        self.q_proj = torch.nn.Linear(H, nq * d, bias=qkv_bias)
+        self.k_proj = torch.nn.Linear(H, nkv * d, bias=qkv_bias)
+        self.v_proj = torch.nn.Linear(H, nkv * d, bias=qkv_bias)
+        self.o_proj = torch.nn.Linear(nq * d, H, bias=o_bias)
+        self.nq, self.nkv, self.d = nq, nkv, d
+
+    def forward(self, x, inv_freq, attn_scale):
+        B, S, _ = x.shape
+        q = self.q_proj(x).view(B, S, self.nq, self.d).transpose(1, 2)
+        k = self.k_proj(x).view(B, S, self.nkv, self.d).transpose(1, 2)
+        v = self.v_proj(x).view(B, S, self.nkv, self.d).transpose(1, 2)
+        pos = torch.arange(S, dtype=torch.float32)
+        freqs = torch.outer(pos, torch.tensor(inv_freq))
+        emb = torch.cat([freqs, freqs], dim=-1)
+        cos = (emb.cos() * attn_scale)[None, None]
+        sin = (emb.sin() * attn_scale)[None, None]
+
+        def rot(t):
+            h = t.shape[-1] // 2
+            return torch.cat([-t[..., h:], t[..., :h]], dim=-1)
+
+        q = q * cos + rot(q) * sin
+        k = k * cos + rot(k) * sin
+        rep = self.nq // self.nkv
+        k = k.repeat_interleave(rep, dim=1)
+        v = v.repeat_interleave(rep, dim=1)
+        scores = (q @ k.transpose(-1, -2)) / math.sqrt(self.d)
+        mask = torch.full((S, S), float("-inf")).triu(1)
+        attn = torch.softmax(scores + mask, dim=-1) @ v
+        return self.o_proj(attn.transpose(1, 2).reshape(B, S, -1))
+
+
+class _OracleMLP(torch.nn.Module):
+    def __init__(self, H, I, bias):
+        super().__init__()
+        self.gate_proj = torch.nn.Linear(H, I, bias=bias)
+        self.up_proj = torch.nn.Linear(H, I, bias=bias)
+        self.down_proj = torch.nn.Linear(I, H, bias=bias)
+
+    def forward(self, x):
+        return self.down_proj(torch.nn.functional.silu(self.gate_proj(x))
+                              * self.up_proj(x))
+
+
+class _OracleRMSNorm(torch.nn.Module):
+    def __init__(self, H, eps):
+        super().__init__()
+        self.weight = torch.nn.Parameter(torch.ones(H))
+        self.eps = eps
+
+    def forward(self, x):
+        var = x.pow(2).mean(-1, keepdim=True)
+        return self.weight * x * torch.rsqrt(var + self.eps)
+
+
+class _OracleLayer(torch.nn.Module):
+    def __init__(self, H, I, nq, nkv, d, eps, norm, qkv_bias, proj_bias):
+        super().__init__()
+        mk = ((lambda: torch.nn.LayerNorm(H, eps=eps)) if norm == "layer"
+              else (lambda: _OracleRMSNorm(H, eps)))
+        self.input_layernorm = mk()
+        self.post_attention_layernorm = mk()
+        self.self_attn = _OracleAttn(H, nq, nkv, d, qkv_bias, proj_bias)
+        self.mlp = _OracleMLP(H, I, proj_bias)
+
+
+class _OracleModel(torch.nn.Module):
+    """Pre-norm llama-variant oracle: norm in {rms, layer}; optional qkv/proj
+    biases; muP knobs (scale_emb, per-branch residual multiplier, final
+    hidden divided by hidden/dim_model_base)."""
+
+    def __init__(self, V, H, I, L, nq, nkv, d, eps=1e-5, norm="rms",
+                 qkv_bias=False, proj_bias=False, inv_freq=None,
+                 attn_scale=1.0, scale_emb=1.0, res_mult=1.0,
+                 logits_div=1.0):
+        super().__init__()
+        inner = torch.nn.Module()
+        inner.embed_tokens = torch.nn.Embedding(V, H)
+        inner.layers = torch.nn.ModuleList(
+            [_OracleLayer(H, I, nq, nkv, d, eps, norm, qkv_bias, proj_bias)
+             for _ in range(L)])
+        inner.norm = (torch.nn.LayerNorm(H, eps=eps) if norm == "layer"
+                      else _OracleRMSNorm(H, eps))
+        self.model = inner
+        self.lm_head = torch.nn.Linear(H, V, bias=False)
+        self.inv_freq = (inv_freq if inv_freq is not None
+                         else (10000.0 ** (-np.arange(0, d, 2) / d)).astype(np.float32))
+        self.attn_scale = attn_scale
+        self.scale_emb, self.res_mult, self.logits_div = scale_emb, res_mult, logits_div
+
+    def forward(self, ids):
+        h = self.model.embed_tokens(ids) * self.scale_emb
+        for lyr in self.model.layers:
+            h = h + lyr.self_attn(lyr.input_layernorm(h), self.inv_freq,
+                                  self.attn_scale) * self.res_mult
+            h = h + lyr.mlp(lyr.post_attention_layernorm(h)) * self.res_mult
+        h = self.model.norm(h) / self.logits_div
+        return self.lm_head(h)
+
+
+def _run_parity_oracle(app_cls, oracle, hf_cfg_dict, atol=5e-4, rtol=1e-3):
+    config = app_cls.get_config_cls()(
+        _tpu_cfg(), load_config=load_pretrained_config(hf_cfg_dict))
+    app = app_cls(None, config)
+    state = {k: v.detach().numpy() for k, v in oracle.state_dict().items()}
+    params = app.convert_hf_state_dict(state, app.config)
+    app._put_params(params)
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(1, hf_cfg_dict["vocab_size"], size=(2, 12)).astype(np.int64)
+    with torch.no_grad():
+        ref_logits = oracle(torch.tensor(ids))[:, -1].numpy()
+    out = app.generate(ids, max_new_tokens=1, return_logits=True)
+    np.testing.assert_allclose(out.logits[0], ref_logits, atol=atol, rtol=rtol)
+
+    cur = torch.tensor(ids)
+    for _ in range(8):                      # full-recompute greedy oracle
+        with torch.no_grad():
+            nxt = oracle(cur)[:, -1].argmax(-1)
+        cur = torch.cat([cur, nxt[:, None]], 1)
+    out = app.generate(ids, max_new_tokens=8, eos_token_id=-1)
+    np.testing.assert_array_equal(out.tokens, cur[:, 12:].numpy())

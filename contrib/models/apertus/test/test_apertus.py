@@ -1,0 +1,31 @@
+"""apertus parity tests (reference contrib shape: README.md + src/ + test/ per family).
+
+Moved from the former central tests/test_contrib_models.py; executed both directly
+(`pytest contrib/models/apertus/test/`) and through the tests/test_contrib_models.py
+aggregator (the CI gate).
+"""
+
+
+import pytest
+import torch
+
+from contrib.models._test_harness import *  # noqa: F401,F403
+
+pytestmark = pytest.mark.slow
+
+
+def test_apertus_parity():
+    """Apertus: learned-parameter xIELU activation (per-layer alpha_p/alpha_n)
+    + per-head qk-norm — the hub's first learned activation."""
+    from transformers import ApertusConfig, ApertusForCausalLM as HFApertus
+
+    from contrib.models.apertus.src.modeling_apertus import ApertusForCausalLM
+
+    cfg = ApertusConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
+                        num_hidden_layers=2, num_attention_heads=4,
+                        num_key_value_heads=2, hidden_act="xielu",
+                        pad_token_id=0, tie_word_embeddings=False)
+    torch.manual_seed(0)
+    # the xIELU module keeps its alpha params in bf16; float() them for numpy
+    hf = HFApertus(cfg).eval().float()
+    _run_parity(ApertusForCausalLM, hf, cfg, atol=1e-3, rtol=1e-3)

@@ -1,0 +1,34 @@
+"""arcee parity tests (reference contrib shape: README.md + src/ + test/ per family).
+
+Moved from the former central tests/test_contrib_models.py; executed both directly
+(`pytest contrib/models/arcee/test/`) and through the tests/test_contrib_models.py
+aggregator (the CI gate).
+"""
+
+
+import pytest
+import torch
+
+from contrib.models._test_harness import *  # noqa: F401,F403
+
+pytestmark = pytest.mark.slow
+
+
+def test_arcee_parity():
+    """Arcee/AFM: llama-geometry GQA with a ReLU^2 PLAIN MLP (up->relu^2->down,
+    no gate) and YaRN rope scaling (exercised at factor 4)."""
+    from transformers import ArceeConfig, ArceeForCausalLM as HFArcee
+
+    from contrib.models.arcee.src.modeling_arcee import ArceeForCausalLM
+
+    cfg = ArceeConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, head_dim=16,
+                      rope_scaling={"rope_type": "yarn", "factor": 4.0,
+                                    "original_max_position_embeddings": 32,
+                                    "beta_fast": 32.0, "beta_slow": 1.0},
+                      max_position_embeddings=128,
+                      pad_token_id=0, tie_word_embeddings=False)
+    torch.manual_seed(0)
+    hf = HFArcee(cfg).eval()
+    _run_parity(ArceeForCausalLM, hf, cfg)

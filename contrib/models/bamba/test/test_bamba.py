@@ -1,0 +1,34 @@
+"""bamba parity tests (reference contrib shape: README.md + src/ + test/ per family).
+
+Moved from the former central tests/test_contrib_models.py; executed both directly
+(`pytest contrib/models/bamba/test/`) and through the tests/test_contrib_models.py
+aggregator (the CI gate).
+"""
+
+
+import pytest
+import torch
+
+from contrib.models._test_harness import *  # noqa: F401,F403
+
+pytestmark = pytest.mark.slow
+
+
+def test_bamba_parity():
+    """Bamba: sequential mamba2/attention hybrid — SSD mixer layers and
+    partial-rotary GQA attention layers alternate per layers_block_type,
+    each followed by a dense gated MLP."""
+    from transformers import BambaConfig, BambaForCausalLM as HFBamba
+
+    from contrib.models.bamba.src.modeling_bamba import BambaForCausalLM
+
+    cfg = BambaConfig(vocab_size=256, hidden_size=32, num_hidden_layers=3,
+                      num_attention_heads=4, num_key_value_heads=2,
+                      intermediate_size=64, mamba_n_heads=8, mamba_d_head=8,
+                      mamba_n_groups=2, mamba_d_state=8, mamba_d_conv=4,
+                      mamba_expand=2, attn_layer_indices=[1],
+                      partial_rotary_factor=0.5, rope_theta=10000.0,
+                      tie_word_embeddings=False, pad_token_id=0)
+    torch.manual_seed(0)
+    hf = HFBamba(cfg).eval()
+    _run_parity(BambaForCausalLM, hf, cfg, atol=2e-3, rtol=1e-3)

@@ -1,0 +1,31 @@
+"""biogpt parity tests (reference contrib shape: README.md + src/ + test/ per family).
+
+Moved from the former central tests/test_contrib_models.py; executed both directly
+(`pytest contrib/models/biogpt/test/`) and through the tests/test_contrib_models.py
+aggregator (the CI gate).
+"""
+
+
+import pytest
+import torch
+
+from contrib.models._test_harness import *  # noqa: F401,F403
+
+pytestmark = pytest.mark.slow
+
+
+def test_biogpt_parity():
+    from transformers import BioGptConfig, BioGptForCausalLM as HFBioGpt
+
+    from contrib.models.biogpt.src.modeling_biogpt import BioGptForCausalLM
+
+    cfg = BioGptConfig(vocab_size=256, hidden_size=64, num_hidden_layers=2,
+                       num_attention_heads=4, intermediate_size=128,
+                       max_position_embeddings=128, scale_embedding=True,
+                       hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+                       activation_dropout=0.0)
+    torch.manual_seed(0)
+    hf = HFBioGpt(cfg).eval()
+    # sqrt(hidden) embedding scaling amplifies the (benign) score-scaling-order
+    # difference; greedy tokens still match exactly
+    _run_parity(BioGptForCausalLM, hf, cfg, atol=5e-3, rtol=5e-3)

@@ -1,0 +1,26 @@
+"""bloom parity tests (reference contrib shape: README.md + src/ + test/ per family).
+
+Moved from the former central tests/test_contrib_models.py; executed both directly
+(`pytest contrib/models/bloom/test/`) and through the tests/test_contrib_models.py
+aggregator (the CI gate).
+"""
+
+
+import pytest
+import torch
+
+from contrib.models._test_harness import *  # noqa: F401,F403
+
+pytestmark = pytest.mark.slow
+
+
+def test_bloom_parity():
+    from transformers import BloomConfig, BloomForCausalLM as HFBloom
+
+    from contrib.models.bloom.src.modeling_bloom import BloomForCausalLM
+
+    cfg = BloomConfig(vocab_size=256, hidden_size=64, n_layer=2, n_head=4,
+                      hidden_dropout=0.0, attention_dropout=0.0)
+    torch.manual_seed(0)
+    hf = HFBloom(cfg).eval()
+    _run_parity(BloomForCausalLM, hf, cfg)

@@ -1,0 +1,30 @@
+"""codegen parity tests (reference contrib shape: README.md + src/ + test/ per family).
+
+Moved from the former central tests/test_contrib_models.py; executed both directly
+(`pytest contrib/models/codegen/test/`) and through the tests/test_contrib_models.py
+aggregator (the CI gate).
+"""
+
+
+import pytest
+import torch
+
+from contrib.models._test_harness import *  # noqa: F401,F403
+
+pytestmark = pytest.mark.slow
+
+
+def test_codegen_parity():
+    """CodeGen: mp_num=4 packed qkv (blocks of [q|v|k]) unpacked at conversion;
+    block-major head order is self-consistent across projections."""
+    from transformers import CodeGenConfig, CodeGenForCausalLM as HFCodeGen
+
+    from contrib.models.codegen.src.modeling_codegen import CodeGenForCausalLM
+
+    cfg = CodeGenConfig(vocab_size=256, n_embd=64, n_layer=2, n_head=4,
+                        rotary_dim=8, n_inner=128, resid_pdrop=0.0,
+                        embd_pdrop=0.0, attn_pdrop=0.0,
+                        tie_word_embeddings=False)
+    torch.manual_seed(0)
+    hf = HFCodeGen(cfg).eval()
+    _run_parity(CodeGenForCausalLM, hf, cfg)

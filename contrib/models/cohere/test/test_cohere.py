@@ -1,0 +1,28 @@
+"""cohere parity tests (reference contrib shape: README.md + src/ + test/ per family).
+
+Moved from the former central tests/test_contrib_models.py; executed both directly
+(`pytest contrib/models/cohere/test/`) and through the tests/test_contrib_models.py
+aggregator (the CI gate).
+"""
+
+
+import pytest
+import torch
+
+from contrib.models._test_harness import *  # noqa: F401,F403
+
+pytestmark = pytest.mark.slow
+
+
+def test_cohere_parity():
+    from transformers import CohereConfig, CohereForCausalLM as HFCohere
+
+    from contrib.models.cohere.src.modeling_cohere import CohereForCausalLM
+
+    cfg = CohereConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
+                       num_hidden_layers=2, num_attention_heads=4,
+                       num_key_value_heads=2, logit_scale=0.25,
+                       use_qk_norm=False, tie_word_embeddings=True)
+    torch.manual_seed(0)
+    hf = HFCohere(cfg).eval()
+    _run_parity(CohereForCausalLM, hf, cfg)

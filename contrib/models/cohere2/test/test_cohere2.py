@@ -1,0 +1,33 @@
+"""cohere2 parity tests (reference contrib shape: README.md + src/ + test/ per family).
+
+Moved from the former central tests/test_contrib_models.py; executed both directly
+(`pytest contrib/models/cohere2/test/`) and through the tests/test_contrib_models.py
+aggregator (the CI gate).
+"""
+
+
+import pytest
+import torch
+
+from contrib.models._test_harness import *  # noqa: F401,F403
+
+pytestmark = pytest.mark.slow
+
+
+def test_cohere2_parity():
+    """Command-R7B: cohere parallel-residual block + 3:1 sliding/full pattern
+    where full layers are NoPE (zero-inv-freq rope table = identity rotation)."""
+    from transformers import Cohere2Config, Cohere2ForCausalLM as HFCohere2
+
+    from contrib.models.cohere2.src.modeling_cohere2 import Cohere2ForCausalLM
+
+    cfg = Cohere2Config(vocab_size=256, hidden_size=64, intermediate_size=128,
+                        num_hidden_layers=4, num_attention_heads=4,
+                        num_key_value_heads=2, logit_scale=0.25,
+                        sliding_window=16,
+                        layer_types=["sliding_attention", "sliding_attention",
+                                     "sliding_attention", "full_attention"],
+                        pad_token_id=0, tie_word_embeddings=True)
+    torch.manual_seed(0)
+    hf = HFCohere2(cfg).eval()
+    _run_parity(Cohere2ForCausalLM, hf, cfg)

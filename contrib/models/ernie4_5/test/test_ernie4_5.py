@@ -1,0 +1,29 @@
+"""ernie4_5 parity tests (reference contrib shape: README.md + src/ + test/ per family).
+
+Moved from the former central tests/test_contrib_models.py; executed both directly
+(`pytest contrib/models/ernie4_5/test/`) and through the tests/test_contrib_models.py
+aggregator (the CI gate).
+"""
+
+
+import pytest
+import torch
+
+from contrib.models._test_harness import *  # noqa: F401,F403
+
+pytestmark = pytest.mark.slow
+
+
+def test_ernie4_5_parity():
+    from transformers import Ernie4_5Config
+    from transformers import Ernie4_5ForCausalLM as HFErnie
+
+    from contrib.models.ernie4_5.src.modeling_ernie4_5 import Ernie45ForCausalLM
+
+    cfg = Ernie4_5Config(vocab_size=256, hidden_size=64, intermediate_size=128,
+                         num_hidden_layers=2, num_attention_heads=4,
+                         num_key_value_heads=2, head_dim=16, use_bias=False,
+                         pad_token_id=0, tie_word_embeddings=True)
+    torch.manual_seed(0)
+    hf = HFErnie(cfg).eval()
+    _run_parity(Ernie45ForCausalLM, hf, cfg)

@@ -1,0 +1,30 @@
+"""exaone4 parity tests (reference contrib shape: README.md + src/ + test/ per family).
+
+Moved from the former central tests/test_contrib_models.py; executed both directly
+(`pytest contrib/models/exaone4/test/`) and through the tests/test_contrib_models.py
+aggregator (the CI gate).
+"""
+
+
+import pytest
+import torch
+
+from contrib.models._test_harness import *  # noqa: F401,F403
+
+pytestmark = pytest.mark.slow
+
+
+def test_exaone4_parity():
+    from transformers import Exaone4Config, Exaone4ForCausalLM as HFExaone4
+
+    from contrib.models.exaone4.src.modeling_exaone4 import Exaone4ForCausalLM
+
+    cfg = Exaone4Config(vocab_size=256, hidden_size=64, intermediate_size=128,
+                        num_hidden_layers=4, num_attention_heads=4,
+                        num_key_value_heads=2, sliding_window=16,
+                        layer_types=["sliding_attention", "sliding_attention",
+                                     "sliding_attention", "full_attention"],
+                        pad_token_id=0, tie_word_embeddings=False)
+    torch.manual_seed(0)
+    hf = HFExaone4(cfg).eval()
+    _run_parity(Exaone4ForCausalLM, hf, cfg)

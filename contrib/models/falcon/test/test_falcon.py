@@ -1,0 +1,30 @@
+"""falcon parity tests (reference contrib shape: README.md + src/ + test/ per family).
+
+Moved from the former central tests/test_contrib_models.py; executed both directly
+(`pytest contrib/models/falcon/test/`) and through the tests/test_contrib_models.py
+aggregator (the CI gate).
+"""
+
+
+import pytest
+import torch
+
+from contrib.models._test_harness import *  # noqa: F401,F403
+
+pytestmark = pytest.mark.slow
+
+
+def test_falcon_parity():
+    from transformers import FalconConfig, FalconForCausalLM as HFFalcon
+
+    from contrib.models.falcon.src.modeling_falcon import FalconForCausalLM
+
+    cfg = FalconConfig(vocab_size=256, hidden_size=64, num_hidden_layers=2,
+                       num_attention_heads=4, multi_query=True,
+                       parallel_attn=True, bias=False,
+                       new_decoder_architecture=False, alibi=False,
+                       rope_theta=10000.0, max_position_embeddings=128,
+                       hidden_dropout=0.0, attention_dropout=0.0)
+    torch.manual_seed(0)
+    hf = HFFalcon(cfg).eval()
+    _run_parity(FalconForCausalLM, hf, cfg)

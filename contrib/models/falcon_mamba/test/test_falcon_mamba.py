@@ -1,0 +1,33 @@
+"""falcon_mamba parity tests (reference contrib shape: README.md + src/ + test/ per family).
+
+Moved from the former central tests/test_contrib_models.py; executed both directly
+(`pytest contrib/models/falcon_mamba/test/`) and through the tests/test_contrib_models.py
+aggregator (the CI gate).
+"""
+
+
+import pytest
+import torch
+
+from contrib.models._test_harness import *  # noqa: F401,F403
+
+pytestmark = pytest.mark.slow
+
+
+def test_falcon_mamba_parity():
+    """FalconMamba: mamba with a weightless RMSNorm over the dt/B/C x_proj
+    splits (mixer_rms_eps)."""
+    from transformers import (FalconMambaConfig,
+                              FalconMambaForCausalLM as HFFalconMamba)
+
+    from contrib.models.falcon_mamba.src.modeling_falcon_mamba import (
+        FalconMambaForCausalLM)
+
+    cfg = FalconMambaConfig(vocab_size=256, hidden_size=32, state_size=8,
+                            num_hidden_layers=2, conv_kernel=4, expand=2,
+                            time_step_rank=4, use_bias=False,
+                            use_conv_bias=True, mixer_rms_eps=1e-6,
+                            pad_token_id=0, tie_word_embeddings=False)
+    torch.manual_seed(0)
+    hf = HFFalconMamba(cfg).eval()
+    _run_parity(FalconMambaForCausalLM, hf, cfg, atol=2e-3, rtol=1e-3)

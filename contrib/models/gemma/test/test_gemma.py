@@ -1,0 +1,31 @@
+"""gemma parity tests (reference contrib shape: README.md + src/ + test/ per family).
+
+Moved from the former central tests/test_contrib_models.py; executed both directly
+(`pytest contrib/models/gemma/test/`) and through the tests/test_contrib_models.py
+aggregator (the CI gate).
+"""
+
+
+import pytest
+import torch
+
+from contrib.models._test_harness import *  # noqa: F401,F403
+
+pytestmark = pytest.mark.slow
+
+
+def test_gemma_parity():
+    from transformers import GemmaConfig, GemmaForCausalLM as HFGemma
+
+    from contrib.models.gemma.src.modeling_gemma import GemmaForCausalLM
+
+    cfg = GemmaConfig(vocab_size=256, hidden_size=64, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=2,
+                      intermediate_size=128, head_dim=16,
+                      hidden_activation="gelu_pytorch_tanh",
+                      max_position_embeddings=128)
+    torch.manual_seed(0)
+    hf = HFGemma(cfg).eval()
+    # gemma's default eos (token 1) can be emitted by the random model; thread it
+    # so both sides stop identically
+    _run_parity(GemmaForCausalLM, hf, cfg, eos_token_id=1)

@@ -1,0 +1,30 @@
+"""gemma2 parity tests (reference contrib shape: README.md + src/ + test/ per family).
+
+Moved from the former central tests/test_contrib_models.py; executed both directly
+(`pytest contrib/models/gemma2/test/`) and through the tests/test_contrib_models.py
+aggregator (the CI gate).
+"""
+
+
+import pytest
+import torch
+
+from contrib.models._test_harness import *  # noqa: F401,F403
+
+pytestmark = pytest.mark.slow
+
+
+def test_gemma2_parity():
+    from transformers import Gemma2Config, Gemma2ForCausalLM as HFGemma2
+
+    from contrib.models.gemma2.src.modeling_gemma2 import Gemma2ForCausalLM
+
+    cfg = Gemma2Config(vocab_size=256, hidden_size=64, intermediate_size=128,
+                       num_hidden_layers=4, num_attention_heads=4,
+                       num_key_value_heads=2, head_dim=16,
+                       query_pre_attn_scalar=16.0,
+                       attn_logit_softcapping=30.0, final_logit_softcapping=20.0,
+                       sliding_window=16)
+    torch.manual_seed(0)
+    hf = HFGemma2(cfg).eval()
+    _run_parity(Gemma2ForCausalLM, hf, cfg, atol=2e-3, rtol=1e-3)

@@ -1,0 +1,34 @@
+"""Shared fixture for the gemma3_vision parity tests (conftest so pytest
+resolves it both in direct runs and through the tests/ aggregator)."""
+
+import numpy as np  # noqa: F401
+import pytest
+import torch  # noqa: F401
+
+from neuronx_distributed_inference_tpu.config import (  # noqa: F401
+    TpuConfig, load_pretrained_config)
+from contrib.models._test_harness import *  # noqa: F401,F403
+
+
+@pytest.fixture(scope="module")
+def tiny_gemma3_vlm():
+    from transformers import (Gemma3Config, Gemma3ForConditionalGeneration,
+                              Gemma3TextConfig, SiglipVisionConfig)
+
+    vc = SiglipVisionConfig(hidden_size=32, intermediate_size=64,
+                            num_hidden_layers=2, num_attention_heads=2,
+                            image_size=16, patch_size=4, num_channels=3,
+                            vision_use_head=False)
+    tc = Gemma3TextConfig(vocab_size=256, hidden_size=48, intermediate_size=96,
+                          num_hidden_layers=2, num_attention_heads=4,
+                          num_key_value_heads=2, head_dim=16,
+                          sliding_window=8, sliding_window_pattern=2,
+                          layer_types=["sliding_attention", "full_attention"],
+                          rope_theta=10000.0, rope_local_base_freq=10000.0,
+                          query_pre_attn_scalar=16.0,
+                          tie_word_embeddings=True)
+    cfg = Gemma3Config(vision_config=vc, text_config=tc, image_token_index=255,
+                       mm_tokens_per_image=4, pad_token_id=0)
+    torch.manual_seed(0)
+    hf = Gemma3ForConditionalGeneration(cfg).eval()
+    return hf, cfg

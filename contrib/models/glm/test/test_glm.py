@@ -1,0 +1,30 @@
+"""glm parity tests (reference contrib shape: README.md + src/ + test/ per family).
+
+Moved from the former central tests/test_contrib_models.py; executed both directly
+(`pytest contrib/models/glm/test/`) and through the tests/test_contrib_models.py
+aggregator (the CI gate).
+"""
+
+
+import pytest
+import torch
+
+from contrib.models._test_harness import *  # noqa: F401,F403
+
+pytestmark = pytest.mark.slow
+
+
+def test_glm_parity():
+    from transformers import GlmConfig, GlmForCausalLM as HFGlm
+
+    from contrib.models.glm.src.modeling_glm import GlmForCausalLM
+
+    cfg = GlmConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
+                    num_hidden_layers=2, num_attention_heads=4,
+                    num_key_value_heads=2, head_dim=16,
+                    partial_rotary_factor=0.5, attention_bias=True,
+                    pad_token_id=0, eos_token_id=2,
+                    tie_word_embeddings=False)
+    torch.manual_seed(0)
+    hf = HFGlm(cfg).eval()
+    _run_parity(GlmForCausalLM, hf, cfg)

@@ -1,0 +1,31 @@
+"""glm4 parity tests (reference contrib shape: README.md + src/ + test/ per family).
+
+Moved from the former central tests/test_contrib_models.py; executed both directly
+(`pytest contrib/models/glm4/test/`) and through the tests/test_contrib_models.py
+aggregator (the CI gate).
+"""
+
+
+import pytest
+import torch
+
+from contrib.models._test_harness import *  # noqa: F401,F403
+
+pytestmark = pytest.mark.slow
+
+
+def test_glm4_parity():
+    """GLM-4-0414: glm plus sandwich norms (post_self_attn / post_mlp branch
+    norms before each residual add)."""
+    from transformers import Glm4Config, Glm4ForCausalLM as HFGlm4
+
+    from contrib.models.glm4.src.modeling_glm4 import Glm4ForCausalLM
+
+    cfg = Glm4Config(vocab_size=256, hidden_size=64, num_hidden_layers=2,
+                     num_attention_heads=4, num_key_value_heads=2,
+                     intermediate_size=128, partial_rotary_factor=0.5,
+                     head_dim=16, attention_bias=True, rope_theta=10000.0,
+                     tie_word_embeddings=False, pad_token_id=0)
+    torch.manual_seed(0)
+    hf = HFGlm4(cfg).eval()
+    _run_parity(Glm4ForCausalLM, hf, cfg)

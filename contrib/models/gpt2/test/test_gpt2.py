@@ -1,0 +1,27 @@
+"""gpt2 parity tests (reference contrib shape: README.md + src/ + test/ per family).
+
+Moved from the former central tests/test_contrib_models.py; executed both directly
+(`pytest contrib/models/gpt2/test/`) and through the tests/test_contrib_models.py
+aggregator (the CI gate).
+"""
+
+
+import pytest
+import torch
+
+from contrib.models._test_harness import *  # noqa: F401,F403
+
+pytestmark = pytest.mark.slow
+
+
+def test_gpt2_parity():
+    from transformers import GPT2Config, GPT2LMHeadModel
+
+    from contrib.models.gpt2.src.modeling_gpt2 import GPT2ForCausalLM
+
+    cfg = GPT2Config(vocab_size=256, n_positions=128, n_embd=64, n_layer=2,
+                     n_head=4, activation_function="gelu_new",
+                     resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0)
+    torch.manual_seed(0)
+    hf = GPT2LMHeadModel(cfg).eval()
+    _run_parity(GPT2ForCausalLM, hf, cfg)

@@ -1,0 +1,48 @@
+"""gpt_bigcode parity tests (reference contrib shape: README.md + src/ + test/ per family).
+
+Moved from the former central tests/test_contrib_models.py; executed both directly
+(`pytest contrib/models/gpt_bigcode/test/`) and through the tests/test_contrib_models.py
+aggregator (the CI gate).
+"""
+
+
+import pytest
+import torch
+
+from contrib.models._test_harness import *  # noqa: F401,F403
+
+pytestmark = pytest.mark.slow
+
+
+def test_gpt_bigcode_parity():
+    """GPT-BigCode (StarCoder1): GPT-2 block with multi-query attention —
+    fused c_attn packs [q | k(1 head) | v(1 head)]."""
+    from transformers import GPTBigCodeConfig, GPTBigCodeForCausalLM as HFBig
+
+    from contrib.models.gpt_bigcode.src.modeling_gpt_bigcode import (
+        GPTBigCodeForCausalLM)
+
+    cfg = GPTBigCodeConfig(vocab_size=256, n_positions=128, n_embd=64,
+                           n_layer=2, n_head=4, multi_query=True,
+                           activation_function="gelu_pytorch_tanh",
+                           resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0)
+    torch.manual_seed(0)
+    hf = HFBig(cfg).eval()
+    _run_parity(GPTBigCodeForCausalLM, hf, cfg)
+
+
+def test_gpt_bigcode_mha_parity():
+    """multi_query=False: the fused c_attn interleaves per-head [q|k|v]
+    chunks, a different layout than the MQA [q|k|v] blocks."""
+    from transformers import GPTBigCodeConfig, GPTBigCodeForCausalLM as HFBig
+
+    from contrib.models.gpt_bigcode.src.modeling_gpt_bigcode import (
+        GPTBigCodeForCausalLM)
+
+    cfg = GPTBigCodeConfig(vocab_size=256, n_positions=128, n_embd=64,
+                           n_layer=2, n_head=4, multi_query=False,
+                           activation_function="gelu_pytorch_tanh",
+                           resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0)
+    torch.manual_seed(1)
+    hf = HFBig(cfg).eval()
+    _run_parity(GPTBigCodeForCausalLM, hf, cfg)

@@ -1,0 +1,31 @@
+"""gpt_neo parity tests (reference contrib shape: README.md + src/ + test/ per family).
+
+Moved from the former central tests/test_contrib_models.py; executed both directly
+(`pytest contrib/models/gpt_neo/test/`) and through the tests/test_contrib_models.py
+aggregator (the CI gate).
+"""
+
+
+import pytest
+import torch
+
+from contrib.models._test_harness import *  # noqa: F401,F403
+
+pytestmark = pytest.mark.slow
+
+
+def test_gpt_neo_parity():
+    """GPT-Neo: alternating global/local(window) attention with learned
+    positions and UNSCALED scores over the layer-pattern machinery."""
+    from transformers import GPTNeoConfig, GPTNeoForCausalLM as HFNeo
+
+    from contrib.models.gpt_neo.src.modeling_gpt_neo import GPTNeoForCausalLM
+
+    cfg = GPTNeoConfig(vocab_size=256, hidden_size=64, num_layers=4,
+                       num_heads=4, window_size=16, intermediate_size=128,
+                       attention_types=[[["global", "local"], 2]],
+                       resid_dropout=0.0, embed_dropout=0.0,
+                       attention_dropout=0.0, tie_word_embeddings=True)
+    torch.manual_seed(0)
+    hf = HFNeo(cfg).eval()
+    _run_parity(GPTNeoForCausalLM, hf, cfg)

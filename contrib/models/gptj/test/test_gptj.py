@@ -1,0 +1,27 @@
+"""gptj parity tests (reference contrib shape: README.md + src/ + test/ per family).
+
+Moved from the former central tests/test_contrib_models.py; executed both directly
+(`pytest contrib/models/gptj/test/`) and through the tests/test_contrib_models.py
+aggregator (the CI gate).
+"""
+
+
+import pytest
+import torch
+
+from contrib.models._test_harness import *  # noqa: F401,F403
+
+pytestmark = pytest.mark.slow
+
+
+def test_gptj_parity():
+    from transformers import GPTJConfig, GPTJForCausalLM as HFGPTJ
+
+    from contrib.models.gptj.src.modeling_gptj import GPTJForCausalLM
+
+    cfg = GPTJConfig(vocab_size=256, n_embd=64, n_layer=2, n_head=4,
+                     rotary_dim=8, n_inner=128, resid_pdrop=0.0,
+                     embd_pdrop=0.0, attn_pdrop=0.0, tie_word_embeddings=False)
+    torch.manual_seed(0)
+    hf = HFGPTJ(cfg).eval()
+    _run_parity(GPTJForCausalLM, hf, cfg)

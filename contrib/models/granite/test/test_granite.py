@@ -1,0 +1,29 @@
+"""granite parity tests (reference contrib shape: README.md + src/ + test/ per family).
+
+Moved from the former central tests/test_contrib_models.py; executed both directly
+(`pytest contrib/models/granite/test/`) and through the tests/test_contrib_models.py
+aggregator (the CI gate).
+"""
+
+
+import pytest
+import torch
+
+from contrib.models._test_harness import *  # noqa: F401,F403
+
+pytestmark = pytest.mark.slow
+
+
+def test_granite_parity():
+    from transformers import GraniteConfig, GraniteForCausalLM as HFGranite
+
+    from contrib.models.granite.src.modeling_granite import GraniteForCausalLM
+
+    cfg = GraniteConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
+                        num_hidden_layers=2, num_attention_heads=4,
+                        num_key_value_heads=2, embedding_multiplier=12.0,
+                        attention_multiplier=0.015625, residual_multiplier=0.22,
+                        logits_scaling=16.0, tie_word_embeddings=False)
+    torch.manual_seed(0)
+    hf = HFGranite(cfg).eval()
+    _run_parity(GraniteForCausalLM, hf, cfg)

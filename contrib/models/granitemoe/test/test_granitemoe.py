@@ -1,0 +1,33 @@
+"""granitemoe parity tests (reference contrib shape: README.md + src/ + test/ per family).
+
+Moved from the former central tests/test_contrib_models.py; executed both directly
+(`pytest contrib/models/granitemoe/test/`) and through the tests/test_contrib_models.py
+aggregator (the CI gate).
+"""
+
+
+import pytest
+import torch
+
+from contrib.models._test_harness import *  # noqa: F401,F403
+
+pytestmark = pytest.mark.slow
+
+
+def test_granitemoe_parity():
+    from transformers import (GraniteMoeConfig,
+                              GraniteMoeForCausalLM as HFGraniteMoe)
+
+    from contrib.models.granitemoe.src.modeling_granitemoe import (
+        GraniteMoeForCausalLM)
+
+    cfg = GraniteMoeConfig(vocab_size=256, hidden_size=64, intermediate_size=96,
+                           num_hidden_layers=2, num_attention_heads=4,
+                           num_key_value_heads=2, num_local_experts=4,
+                           num_experts_per_tok=2, embedding_multiplier=6.0,
+                           attention_multiplier=0.0625, residual_multiplier=0.3,
+                           logits_scaling=4.0, pad_token_id=0,
+                           tie_word_embeddings=False)
+    torch.manual_seed(0)
+    hf = HFGraniteMoe(cfg).eval()
+    _run_parity(GraniteMoeForCausalLM, hf, cfg, atol=1e-3, rtol=1e-3)

@@ -1,0 +1,40 @@
+"""granitemoehybrid parity tests (reference contrib shape: README.md + src/ + test/ per family).
+
+Moved from the former central tests/test_contrib_models.py; executed both directly
+(`pytest contrib/models/granitemoehybrid/test/`) and through the tests/test_contrib_models.py
+aggregator (the CI gate).
+"""
+
+
+import pytest
+import torch
+
+from contrib.models._test_harness import *  # noqa: F401,F403
+
+pytestmark = pytest.mark.slow
+
+
+def test_granitemoehybrid_parity():
+    """GraniteMoeHybrid (granite-4.0 h-family): bamba-style mamba2/attention
+    layers, each ending in topk_softmax MoE + ungated shared expert, with
+    granite multipliers and NoPE attention."""
+    from transformers import (GraniteMoeHybridConfig,
+                              GraniteMoeHybridForCausalLM as HFGmh)
+
+    from contrib.models.granitemoehybrid.src.modeling_granitemoehybrid import (
+        GraniteMoeHybridForCausalLM)
+
+    cfg = GraniteMoeHybridConfig(
+        vocab_size=256, hidden_size=32, num_hidden_layers=3,
+        layers_block_type=["mamba", "attention", "mamba"],
+        num_attention_heads=4, num_key_value_heads=2, intermediate_size=64,
+        shared_intermediate_size=48, num_local_experts=4,
+        num_experts_per_tok=2, mamba_n_heads=8, mamba_d_head=8,
+        mamba_n_groups=2, mamba_d_state=8, mamba_d_conv=4, mamba_expand=2,
+        embedding_multiplier=2.0, attention_multiplier=0.3,
+        residual_multiplier=0.8, logits_scaling=1.5,
+        position_embedding_type=None, attention_bias=False,
+        tie_word_embeddings=False, pad_token_id=0)
+    torch.manual_seed(0)
+    hf = HFGmh(cfg).eval()
+    _run_parity(GraniteMoeHybridForCausalLM, hf, cfg, atol=2e-3, rtol=1e-3)

@@ -1,0 +1,36 @@
+"""granitemoeshared parity tests (reference contrib shape: README.md + src/ + test/ per family).
+
+Moved from the former central tests/test_contrib_models.py; executed both directly
+(`pytest contrib/models/granitemoeshared/test/`) and through the tests/test_contrib_models.py
+aggregator (the CI gate).
+"""
+
+
+import pytest
+import torch
+
+from contrib.models._test_harness import *  # noqa: F401,F403
+
+pytestmark = pytest.mark.slow
+
+
+def test_granitemoeshared_parity():
+    """GraniteMoeShared: granitemoe plus an ungated dense shared expert summed
+    with every routed-MoE output."""
+    from transformers import (GraniteMoeSharedConfig,
+                              GraniteMoeSharedForCausalLM as HFGms)
+
+    from contrib.models.granitemoeshared.src.modeling_granitemoeshared import (
+        GraniteMoeSharedForCausalLM)
+
+    cfg = GraniteMoeSharedConfig(
+        vocab_size=256, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, intermediate_size=96,
+        shared_intermediate_size=80, num_local_experts=4,
+        num_experts_per_tok=2, embedding_multiplier=2.0,
+        attention_multiplier=0.3, residual_multiplier=0.8,
+        logits_scaling=1.5, attention_bias=False, rope_theta=10000.0,
+        tie_word_embeddings=False, pad_token_id=0)
+    torch.manual_seed(0)
+    hf = HFGms(cfg).eval()
+    _run_parity(GraniteMoeSharedForCausalLM, hf, cfg, atol=2e-3, rtol=1e-3)

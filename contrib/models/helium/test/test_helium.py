@@ -1,0 +1,28 @@
+"""helium parity tests (reference contrib shape: README.md + src/ + test/ per family).
+
+Moved from the former central tests/test_contrib_models.py; executed both directly
+(`pytest contrib/models/helium/test/`) and through the tests/test_contrib_models.py
+aggregator (the CI gate).
+"""
+
+
+import pytest
+import torch
+
+from contrib.models._test_harness import *  # noqa: F401,F403
+
+pytestmark = pytest.mark.slow
+
+
+def test_helium_parity():
+    from transformers import HeliumConfig, HeliumForCausalLM as HFHelium
+
+    from contrib.models.helium.src.modeling_helium import HeliumForCausalLM
+
+    cfg = HeliumConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
+                       num_hidden_layers=2, num_attention_heads=4,
+                       num_key_value_heads=2, head_dim=16,
+                       pad_token_id=0, tie_word_embeddings=False)
+    torch.manual_seed(0)
+    hf = HFHelium(cfg).eval()
+    _run_parity(HeliumForCausalLM, hf, cfg)

@@ -1,0 +1,33 @@
+"""hunyuan parity tests (reference contrib shape: README.md + src/ + test/ per family).
+
+Moved from the former central tests/test_contrib_models.py; executed both directly
+(`pytest contrib/models/hunyuan/test/`) and through the tests/test_contrib_models.py
+aggregator (the CI gate).
+"""
+
+
+import pytest
+import torch
+
+from contrib.models._test_harness import *  # noqa: F401,F403
+
+pytestmark = pytest.mark.slow
+
+
+def test_hunyuan_parity():
+    """HunYuan v1 dense: per-head q/k RMSNorm applied AFTER rotary
+    (qk_norm_after_rope) over an otherwise llama-shaped GQA block."""
+    from transformers import (HunYuanDenseV1Config,
+                              HunYuanDenseV1ForCausalLM as HFHunYuan)
+
+    from contrib.models.hunyuan.src.modeling_hunyuan import (
+        HunYuanDenseForCausalLM)
+
+    cfg = HunYuanDenseV1Config(vocab_size=256, hidden_size=64,
+                               intermediate_size=128, num_hidden_layers=2,
+                               num_attention_heads=4, num_key_value_heads=2,
+                               head_dim=16, pad_token_id=0,
+                               tie_word_embeddings=False)
+    torch.manual_seed(0)
+    hf = HFHunYuan(cfg).eval()
+    _run_parity(HunYuanDenseForCausalLM, hf, cfg, eos_token_id=2)

@@ -1,0 +1,88 @@
+"""idefics parity tests (reference contrib shape: README.md + src/ + test/ per family).
+
+Moved from the former central tests/test_contrib_models.py; executed both directly
+(`pytest contrib/models/idefics/test/`) and through the tests/test_contrib_models.py
+aggregator (the CI gate).
+"""
+
+
+import numpy as np
+import pytest
+import torch
+
+from neuronx_distributed_inference_tpu.config import (  # noqa: F401
+    TpuConfig, load_pretrained_config)
+from contrib.models._test_harness import *  # noqa: F401,F403
+
+pytestmark = pytest.mark.slow
+
+
+def test_idefics_generate_matches_hf():
+    """IDEFICS gated cross-attention: perceiver-resampled CLIP features, cross
+    blocks every 2 layers with tanh-alpha gates, post-rope per-head qk norms,
+    decoupled embeddings/lm_head (2 additional vocab rows)."""
+    from transformers import IdeficsConfig, IdeficsForVisionText2Text as HFIdefics
+
+    from contrib.models.idefics.src.modeling_idefics import (
+        IdeficsForVisionText2Text)
+
+    cfg = IdeficsConfig(
+        vocab_size=256, additional_vocab_size=2, hidden_size=32,
+        intermediate_size=64, num_hidden_layers=4, num_attention_heads=4,
+        cross_layer_interval=2, qk_layer_norms=True, rms_norm_eps=1e-5,
+        tie_word_embeddings=False, pad_token_id=0, bos_token_id=1,
+        eos_token_id=2, freeze_text_layers=False, freeze_vision_layers=False,
+        vision_config={"embed_dim": 24, "image_size": 16, "patch_size": 8,
+                       "num_hidden_layers": 2, "num_attention_heads": 2,
+                       "intermediate_size": 48, "hidden_act": "gelu",
+                       "num_channels": 3},
+        perceiver_config={"use_resampler": True, "resampler_n_latents": 4,
+                          "resampler_depth": 2, "resampler_n_heads": 2,
+                          "resampler_head_dim": 12,
+                          "qk_layer_norms_perceiver": True},
+    )
+    torch.manual_seed(0)
+    hf = HFIdefics(cfg).eval()
+    with torch.no_grad():   # HF post-norms only the pooled CLS; must be unused
+        hf.model.vision_model.post_layernorm.weight.copy_(torch.randn(24))
+        hf.model.vision_model.post_layernorm.bias.copy_(torch.randn(24))
+
+    tpu_cfg = TpuConfig(batch_size=2, seq_len=64, max_context_length=32,
+                        dtype="float32", context_encoding_buckets=[32],
+                        token_generation_buckets=[64])
+    config = IdeficsForVisionText2Text.get_config_cls()(
+        tpu_cfg, load_config=load_pretrained_config(
+            dict(cfg.to_dict(), max_num_images=2)))
+    app = IdeficsForVisionText2Text(None, config)
+    state = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+    app._put_params(app.convert_hf_state_dict(state, app.config))
+    app.load_vision_from_state_dict(state)
+
+    rng = np.random.default_rng(1)
+    ids = rng.integers(3, 258, size=(2, 12))    # incl additional-vocab ids
+    pixels = rng.normal(size=(2, 1, 3, 16, 16)).astype(np.float32)
+    out = app.generate(ids, pixel_values=pixels, max_new_tokens=6,
+                       eos_token_id=-1)
+
+    # HF full-recompute greedy oracle (attend-all image mask each step)
+    cur = torch.tensor(ids)
+    for _ in range(6):
+        iam = torch.ones((2, cur.shape[1], 1), dtype=torch.long)
+        with torch.no_grad():
+            logits = hf(input_ids=cur, pixel_values=torch.tensor(pixels),
+                        image_attention_mask=iam).logits
+        cur = torch.cat([cur, logits[:, -1].argmax(-1)[:, None]], 1)
+    np.testing.assert_array_equal(out.tokens, cur[:, 12:].numpy())
+
+    # text-only path still serves (zero image states, fully-masked cross rows)
+    tids = rng.integers(3, 250, size=(2, 10)).astype(np.int64)
+    out_t = app.generate(tids, max_new_tokens=4, eos_token_id=-1)
+    cur = torch.tensor(tids)
+    for _ in range(4):
+        iam = torch.zeros((2, cur.shape[1], 1), dtype=torch.long)
+        with torch.no_grad():
+            logits = hf(input_ids=cur,
+                        pixel_values=torch.zeros(2, 1, 3, 16, 16),
+                        image_attention_mask=iam).logits
+        cur = torch.cat([cur, logits[:, -1].argmax(-1)[:, None]], 1)
+    np.testing.assert_array_equal(out_t.tokens, cur[:, 10:].numpy())

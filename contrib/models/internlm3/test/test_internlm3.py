@@ -1,0 +1,35 @@
+"""internlm3 parity tests (reference contrib shape: README.md + src/ + test/ per family).
+
+Moved from the former central tests/test_contrib_models.py; executed both directly
+(`pytest contrib/models/internlm3/test/`) and through the tests/test_contrib_models.py
+aggregator (the CI gate).
+"""
+
+
+import pytest
+import torch
+
+from contrib.models._test_harness import *  # noqa: F401,F403
+
+pytestmark = pytest.mark.slow
+
+
+def test_internlm3_parity():
+    """InternLM3: llama geometry + independent qkv_bias (q/k/v) and bias
+    (o_proj + gated-MLP) knobs, both exercised."""
+    from contrib.models.internlm3.src.modeling_internlm3 import (
+        InternLM3ForCausalLM)
+
+    cfg = dict(model_type="internlm3", vocab_size=256, hidden_size=64,
+               intermediate_size=128, num_hidden_layers=2,
+               num_attention_heads=4, num_key_value_heads=2, head_dim=16,
+               qkv_bias=True, bias=True, rms_norm_eps=1e-5,
+               rope_theta=10000.0, tie_word_embeddings=False)
+    torch.manual_seed(0)
+    oracle = _OracleModel(256, 64, 128, 2, 4, 2, 16, eps=1e-5,
+                          qkv_bias=True, proj_bias=True).eval()
+    with torch.no_grad():                    # biases are zero-init; randomize
+        for n, p in oracle.named_parameters():
+            if n.endswith(".bias"):
+                p.copy_(torch.randn_like(p) * 0.05)
+    _run_parity_oracle(InternLM3ForCausalLM, oracle, cfg)

@@ -1,0 +1,35 @@
+"""jamba parity tests (reference contrib shape: README.md + src/ + test/ per family).
+
+Moved from the former central tests/test_contrib_models.py; executed both directly
+(`pytest contrib/models/jamba/test/`) and through the tests/test_contrib_models.py
+aggregator (the CI gate).
+"""
+
+
+import pytest
+import torch
+
+from contrib.models._test_harness import *  # noqa: F401,F403
+
+pytestmark = pytest.mark.slow
+
+
+def test_jamba_parity():
+    """Jamba hybrid: mamba mixers (+dt/B/C norms) + NoPE attention + MoE-every-
+    other-layer in one heterogeneous cache pytree."""
+    from transformers import JambaConfig, JambaForCausalLM as HFJamba
+
+    from contrib.models.jamba.src.modeling_jamba import JambaForCausalLM
+
+    cfg = JambaConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=4, num_attention_heads=4,
+                      num_key_value_heads=2,
+                      attn_layer_period=4, attn_layer_offset=2,
+                      expert_layer_period=2, expert_layer_offset=1,
+                      num_experts=4, num_experts_per_tok=2,
+                      mamba_d_state=8, mamba_d_conv=4, mamba_expand=2,
+                      mamba_dt_rank=8, use_mamba_kernels=False,
+                      pad_token_id=0, tie_word_embeddings=False)
+    torch.manual_seed(0)
+    hf = HFJamba(cfg).eval()
+    _run_parity(JambaForCausalLM, hf, cfg, atol=2e-3, rtol=1e-3)

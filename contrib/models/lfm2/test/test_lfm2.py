@@ -1,0 +1,32 @@
+"""lfm2 parity tests (reference contrib shape: README.md + src/ + test/ per family).
+
+Moved from the former central tests/test_contrib_models.py; executed both directly
+(`pytest contrib/models/lfm2/test/`) and through the tests/test_contrib_models.py
+aggregator (the CI gate).
+"""
+
+
+import pytest
+import torch
+
+from contrib.models._test_harness import *  # noqa: F401,F403
+
+pytestmark = pytest.mark.slow
+
+
+def test_lfm2_parity():
+    """LFM2 conv/attention hybrid: gated short-conv state cache + qk-norm
+    attention layers in one hybrid cache pytree."""
+    from transformers import Lfm2Config, Lfm2ForCausalLM as HFLfm2
+
+    from contrib.models.lfm2.src.modeling_lfm2 import Lfm2ForCausalLM
+
+    cfg = Lfm2Config(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+        conv_L_cache=3, conv_bias=False, block_auto_adjust_ff_dim=False,
+        layer_types=["conv", "conv", "full_attention", "conv"],
+        pad_token_id=0, tie_word_embeddings=True)
+    torch.manual_seed(0)
+    hf = HFLfm2(cfg).eval()
+    _run_parity(Lfm2ForCausalLM, hf, cfg, atol=1e-3, rtol=1e-3)

@@ -1,0 +1,32 @@
+"""Shared fixture for the llava parity tests (conftest so pytest
+resolves it both in direct runs and through the tests/ aggregator)."""
+
+import numpy as np  # noqa: F401
+import pytest
+import torch  # noqa: F401
+
+from neuronx_distributed_inference_tpu.config import (  # noqa: F401
+    TpuConfig, load_pretrained_config)
+from contrib.models._test_harness import *  # noqa: F401,F403
+
+
+@pytest.fixture(scope="module")
+def tiny_clip_llava():
+    from transformers import (CLIPVisionConfig, LlamaConfig, LlavaConfig,
+                              LlavaForConditionalGeneration)
+
+    vc = CLIPVisionConfig(hidden_size=32, intermediate_size=64,
+                          num_hidden_layers=3, num_attention_heads=2,
+                          image_size=16, patch_size=8, num_channels=3,
+                          projection_dim=32)
+    tc = LlamaConfig(vocab_size=256, hidden_size=48, intermediate_size=96,
+                     num_hidden_layers=2, num_attention_heads=4,
+                     num_key_value_heads=2, rope_theta=10000.0,
+                     tie_word_embeddings=False)
+    cfg = LlavaConfig(vision_config=vc, text_config=tc, image_token_index=255,
+                      projector_hidden_act="gelu",
+                      vision_feature_layer=-2,
+                      vision_feature_select_strategy="default")
+    torch.manual_seed(0)
+    hf = LlavaForConditionalGeneration(cfg).eval()
+    return hf, cfg

@@ -1,0 +1,79 @@
+"""llava parity tests (reference contrib shape: README.md + src/ + test/ per family).
+
+Moved from the former central tests/test_contrib_models.py; executed both directly
+(`pytest contrib/models/llava/test/`) and through the tests/test_contrib_models.py
+aggregator (the CI gate).
+"""
+
+
+import numpy as np
+import pytest
+import torch
+
+from neuronx_distributed_inference_tpu.config import (  # noqa: F401
+    TpuConfig, load_pretrained_config)
+from contrib.models._test_harness import *  # noqa: F401,F403
+
+pytestmark = pytest.mark.slow
+
+from contrib.models.llava.test.conftest import tiny_clip_llava  # noqa: F401,E402
+
+
+def test_llava_clip_vision_encoder_matches_hf(tiny_clip_llava):
+    from contrib.models.llava.src.modeling_llava import (
+        LlavaForConditionalGeneration)
+
+    hf, cfg = tiny_clip_llava
+    tpu_cfg = TpuConfig(batch_size=2, seq_len=64, max_context_length=32,
+                        dtype="float32", context_encoding_buckets=[32],
+                        token_generation_buckets=[64])
+    config = LlavaForConditionalGeneration.get_config_cls()(
+        tpu_cfg, load_config=load_pretrained_config(cfg.to_dict()))
+    app = LlavaForConditionalGeneration(None, config)
+    state = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+    app._put_params(app.convert_hf_state_dict(state, app.config))
+    app.load_vision_from_state_dict(state)
+
+    rng = np.random.default_rng(0)
+    pixels = rng.normal(size=(2, 3, 16, 16)).astype(np.float32)
+    feats = app.encode_images(pixels)                   # (2, 4, H_text): CLS dropped
+    with torch.no_grad():
+        hf_feats = hf.get_image_features(pixel_values=torch.tensor(pixels))
+    np.testing.assert_allclose(feats, np.asarray(hf_feats), atol=3e-4, rtol=1e-3)
+
+
+def test_llava_clip_generate_matches_hf(tiny_clip_llava):
+    """LLaVA-1.5 over the image_to_text base: CLIP features land on image-token
+    positions, greedy decode matches HF CPU; text-only requests still serve."""
+    from contrib.models.llava.src.modeling_llava import (
+        LlavaForConditionalGeneration)
+
+    hf, cfg = tiny_clip_llava
+    tpu_cfg = TpuConfig(batch_size=2, seq_len=64, max_context_length=32,
+                        dtype="float32", context_encoding_buckets=[32],
+                        token_generation_buckets=[64])
+    config = LlavaForConditionalGeneration.get_config_cls()(
+        tpu_cfg, load_config=load_pretrained_config(cfg.to_dict()))
+    app = LlavaForConditionalGeneration(None, config)
+    state = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+    app._put_params(app.convert_hf_state_dict(state, app.config))
+    app.load_vision_from_state_dict(state)
+
+    rng = np.random.default_rng(1)
+    ids = rng.integers(1, 250, size=(2, 20))
+    ids[:, 2:6] = 255                                   # 4 patches per image
+    pixels = rng.normal(size=(2, 3, 16, 16)).astype(np.float32)
+    with torch.no_grad():
+        hf_out = hf.generate(input_ids=torch.tensor(ids),
+                             pixel_values=torch.tensor(pixels),
+                             max_new_tokens=8, do_sample=False, pad_token_id=0)
+    out = app.generate(ids, pixel_values=pixels, max_new_tokens=8)
+    np.testing.assert_array_equal(out.tokens, hf_out[:, 20:].numpy())
+
+    # text-only path still serves
+    tids = rng.integers(1, 250, size=(2, 10)).astype(np.int64)
+    with torch.no_grad():
+        hf_t = hf.generate(input_ids=torch.tensor(tids), max_new_tokens=6,
+                           do_sample=False, pad_token_id=0)
+    out_t = app.generate(tids, max_new_tokens=6)
+    np.testing.assert_array_equal(out_t.tokens, hf_t[:, 10:].numpy())

@@ -1,0 +1,30 @@
+"""mamba parity tests (reference contrib shape: README.md + src/ + test/ per family).
+
+Moved from the former central tests/test_contrib_models.py; executed both directly
+(`pytest contrib/models/mamba/test/`) and through the tests/test_contrib_models.py
+aggregator (the CI gate).
+"""
+
+
+import pytest
+import torch
+
+from contrib.models._test_harness import *  # noqa: F401,F403
+
+pytestmark = pytest.mark.slow
+
+
+def test_mamba_parity():
+    """Pure selective-SSM family (no attention, no KV cache): associative-scan
+    prefill + single-step recurrence decode must match HF's per-token loop."""
+    from transformers import MambaConfig, MambaForCausalLM as HFMamba
+
+    from contrib.models.mamba.src.modeling_mamba import MambaForCausalLM
+
+    cfg = MambaConfig(vocab_size=256, hidden_size=64, state_size=8,
+                      num_hidden_layers=2, conv_kernel=4, expand=2,
+                      time_step_rank=8, use_bias=False, use_conv_bias=True,
+                      pad_token_id=0, tie_word_embeddings=True)
+    torch.manual_seed(0)
+    hf = HFMamba(cfg).eval()
+    _run_parity(MambaForCausalLM, hf, cfg, atol=2e-3, rtol=1e-3)

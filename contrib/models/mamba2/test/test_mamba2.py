@@ -1,0 +1,46 @@
+"""mamba2 parity tests (reference contrib shape: README.md + src/ + test/ per family).
+
+Moved from the former central tests/test_contrib_models.py; executed both directly
+(`pytest contrib/models/mamba2/test/`) and through the tests/test_contrib_models.py
+aggregator (the CI gate).
+"""
+
+
+import pytest
+import torch
+
+from contrib.models._test_harness import *  # noqa: F401,F403
+
+pytestmark = pytest.mark.slow
+
+
+def test_mamba2_parity():
+    """Mamba-2 / SSD: per-head scalar-decay multi-head SSM with grouped B/C,
+    joint x|B|C conv, and gated output RMSNorm — associative-scan prefill."""
+    from transformers import Mamba2Config, Mamba2ForCausalLM as HFMamba2
+
+    from contrib.models.mamba2.src.modeling_mamba2 import Mamba2ForCausalLM
+
+    cfg = Mamba2Config(vocab_size=256, hidden_size=32, state_size=8,
+                       num_hidden_layers=2, conv_kernel=4, expand=2,
+                       num_heads=4, head_dim=16, n_groups=2,
+                       use_bias=False, use_conv_bias=True,
+                       pad_token_id=0, tie_word_embeddings=True)
+    torch.manual_seed(0)
+    hf = HFMamba2(cfg).eval()
+    _run_parity(Mamba2ForCausalLM, hf, cfg, atol=2e-3, rtol=1e-3)
+
+
+def test_mamba2_untied_lm_head():
+    from transformers import Mamba2Config, Mamba2ForCausalLM as HFMamba2
+
+    from contrib.models.mamba2.src.modeling_mamba2 import Mamba2ForCausalLM
+
+    cfg = Mamba2Config(vocab_size=256, hidden_size=32, state_size=8,
+                       num_hidden_layers=2, conv_kernel=4, expand=2,
+                       num_heads=4, head_dim=16, n_groups=2,
+                       use_bias=False, use_conv_bias=True,
+                       pad_token_id=0, tie_word_embeddings=False)
+    torch.manual_seed(3)
+    hf = HFMamba2(cfg).eval()
+    _run_parity(Mamba2ForCausalLM, hf, cfg, atol=2e-3, rtol=1e-3)

@@ -1,0 +1,50 @@
+"""minicpm parity tests (reference contrib shape: README.md + src/ + test/ per family).
+
+Moved from the former central tests/test_contrib_models.py; executed both directly
+(`pytest contrib/models/minicpm/test/`) and through the tests/test_contrib_models.py
+aggregator (the CI gate).
+"""
+
+import math  # noqa: F401
+
+import numpy as np
+import pytest
+import torch
+
+from contrib.models._test_harness import *  # noqa: F401,F403
+
+pytestmark = pytest.mark.slow
+
+
+def test_minicpm4_parity():
+    """MiniCPM4: muP scaling family (scale_emb=2, scale_depth/sqrt(L) branch
+    multiplier, hidden/(H/dim_model_base) logit divisor) + LongRoPE ext
+    factors with the sqrt(1+ln s/ln orig) cos/sin magnitude."""
+    from contrib.models.minicpm.src.modeling_minicpm import (
+        MiniCPMForCausalLM, _longrope_params)
+
+    rs = {"rope_type": "longrope",
+          "short_factor": [1.0] * 8, "long_factor": list(np.linspace(1, 3, 8)),
+          "original_max_position_embeddings": 32}
+    cfg = dict(model_type="minicpm", vocab_size=256, hidden_size=64,
+               intermediate_size=128, num_hidden_layers=2,
+               num_attention_heads=4, num_key_value_heads=2,
+               rms_norm_eps=1e-5, rope_theta=10000.0, scale_emb=2.0,
+               scale_depth=1.4, dim_model_base=32,
+               max_position_embeddings=128, rope_scaling=rs,
+               tie_word_embeddings=False)
+
+    class _C:  # mimic config attrs for the helper
+        pass
+    c = _C()
+    c.rope_scaling, c.max_position_embeddings = rs, 128
+    factors, attn_scale = _longrope_params(c)
+    assert attn_scale > 1.0                  # long branch engaged
+
+    base = (10000.0 ** (-np.arange(0, 16, 2) / 16)).astype(np.float32)
+    torch.manual_seed(0)
+    oracle = _OracleModel(256, 64, 128, 2, 4, 2, 16, eps=1e-5,
+                          inv_freq=base / factors, attn_scale=attn_scale,
+                          scale_emb=2.0, res_mult=1.4 / math.sqrt(2),
+                          logits_div=64 / 32).eval()
+    _run_parity_oracle(MiniCPMForCausalLM, oracle, cfg)

@@ -1,0 +1,35 @@
+"""minimax parity tests (reference contrib shape: README.md + src/ + test/ per family).
+
+Moved from the former central tests/test_contrib_models.py; executed both directly
+(`pytest contrib/models/minimax/test/`) and through the tests/test_contrib_models.py
+aggregator (the CI gate).
+"""
+
+
+import pytest
+import torch
+
+from contrib.models._test_harness import *  # noqa: F401,F403
+
+pytestmark = pytest.mark.slow
+
+
+def test_minimax_parity():
+    """MiniMax lightning/linear-attention hybrid: decayed KV-state linear
+    attention (scan-over-blocks prefill, (B,h,d,d) fp32 state cache) alternating
+    with full softmax attention, MoE every layer, normed residual stream."""
+    from transformers import MiniMaxConfig, MiniMaxForCausalLM as HFMiniMax
+
+    from contrib.models.minimax.src.modeling_minimax import MiniMaxForCausalLM
+
+    cfg = MiniMaxConfig(vocab_size=256, hidden_size=64, intermediate_size=96,
+                        num_hidden_layers=4, num_attention_heads=4,
+                        num_key_value_heads=2, head_dim=16,
+                        num_local_experts=4, num_experts_per_tok=2,
+                        block_size=8,
+                        layer_types=["linear_attention", "full_attention",
+                                     "linear_attention", "full_attention"],
+                        pad_token_id=0, tie_word_embeddings=False)
+    torch.manual_seed(0)
+    hf = HFMiniMax(cfg).eval()
+    _run_parity(MiniMaxForCausalLM, hf, cfg, atol=2e-3, rtol=1e-3)

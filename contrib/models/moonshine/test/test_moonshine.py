@@ -1,0 +1,58 @@
+"""moonshine parity tests (reference contrib shape: README.md + src/ + test/ per family).
+
+Moved from the former central tests/test_contrib_models.py; executed both directly
+(`pytest contrib/models/moonshine/test/`) and through the tests/test_contrib_models.py
+aggregator (the CI gate).
+"""
+
+
+import numpy as np
+import pytest
+import torch
+
+from neuronx_distributed_inference_tpu.config import (  # noqa: F401
+    TpuConfig, load_pretrained_config)
+from contrib.models._test_harness import *  # noqa: F401,F403
+
+pytestmark = pytest.mark.slow
+
+
+def test_moonshine_parity():
+    """Moonshine ASR (whisper-style enc-dec contrib): raw-waveform conv stem,
+    rotary encoder/decoder self-attention, rope-free cross-attention,
+    gated-silu decoder MLP. Logit + greedy parity vs HF."""
+    from transformers import (MoonshineConfig,
+                              MoonshineForConditionalGeneration as HFMoon)
+
+    from contrib.models.moonshine.src.modeling_moonshine import (
+        MoonshineForConditionalGeneration)
+
+    cfg = MoonshineConfig(vocab_size=256, hidden_size=32, intermediate_size=64,
+                          encoder_num_hidden_layers=2,
+                          decoder_num_hidden_layers=2,
+                          encoder_num_attention_heads=4,
+                          decoder_num_attention_heads=4,
+                          encoder_num_key_value_heads=4,
+                          decoder_num_key_value_heads=4,
+                          max_position_embeddings=128,
+                          decoder_start_token_id=1, eos_token_id=2,
+                          pad_token_id=0)
+    torch.manual_seed(0)
+    hf = HFMoon(cfg).eval()
+
+    config = MoonshineForConditionalGeneration.get_config_cls()(
+        _tpu_cfg(), load_config=load_pretrained_config(cfg.to_dict()))
+    app = MoonshineForConditionalGeneration(None, config)
+    state = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+    app.load_from_state_dict(state)
+
+    rng = np.random.default_rng(0)
+    audio = rng.standard_normal((2, 4000)).astype(np.float32) * 0.1
+    # -1 sentinel disables EOS on both sides (same trick as test_whisper)
+    out = app.generate(audio, max_new_tokens=8, eos_token_id=-1)
+
+    with torch.no_grad():
+        hf_out = hf.generate(input_values=torch.tensor(audio),
+                             max_new_tokens=8, do_sample=False,
+                             eos_token_id=-1, pad_token_id=0)
+    np.testing.assert_array_equal(out, hf_out.numpy())

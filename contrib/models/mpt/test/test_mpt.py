@@ -1,0 +1,26 @@
+"""mpt parity tests (reference contrib shape: README.md + src/ + test/ per family).
+
+Moved from the former central tests/test_contrib_models.py; executed both directly
+(`pytest contrib/models/mpt/test/`) and through the tests/test_contrib_models.py
+aggregator (the CI gate).
+"""
+
+
+import pytest
+import torch
+
+from contrib.models._test_harness import *  # noqa: F401,F403
+
+pytestmark = pytest.mark.slow
+
+
+def test_mpt_parity():
+    from transformers import MptConfig, MptForCausalLM as HFMpt
+
+    from contrib.models.mpt.src.modeling_mpt import MptForCausalLM
+
+    cfg = MptConfig(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+                    expansion_ratio=2, max_seq_len=128)
+    torch.manual_seed(0)
+    hf = HFMpt(cfg).eval()
+    _run_parity(MptForCausalLM, hf, cfg)

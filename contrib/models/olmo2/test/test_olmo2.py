@@ -1,0 +1,28 @@
+"""olmo2 parity tests (reference contrib shape: README.md + src/ + test/ per family).
+
+Moved from the former central tests/test_contrib_models.py; executed both directly
+(`pytest contrib/models/olmo2/test/`) and through the tests/test_contrib_models.py
+aggregator (the CI gate).
+"""
+
+
+import pytest
+import torch
+
+from contrib.models._test_harness import *  # noqa: F401,F403
+
+pytestmark = pytest.mark.slow
+
+
+def test_olmo2_parity():
+    from transformers import Olmo2Config, Olmo2ForCausalLM as HFOlmo2
+
+    from contrib.models.olmo2.src.modeling_olmo2 import Olmo2ForCausalLM
+
+    cfg = Olmo2Config(vocab_size=256, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, pad_token_id=0,
+                      tie_word_embeddings=False)
+    torch.manual_seed(0)
+    hf = HFOlmo2(cfg).eval()
+    _run_parity(Olmo2ForCausalLM, hf, cfg)

@@ -1,0 +1,37 @@
+"""olmo3 parity tests (reference contrib shape: README.md + src/ + test/ per family).
+
+Moved from the former central tests/test_contrib_models.py; executed both directly
+(`pytest contrib/models/olmo3/test/`) and through the tests/test_contrib_models.py
+aggregator (the CI gate).
+"""
+
+
+import pytest
+import torch
+
+from contrib.models._test_harness import *  # noqa: F401,F403
+
+pytestmark = pytest.mark.slow
+
+
+def test_olmo3_parity():
+    """OLMo 3: the OLMo-2 post-norm block (branch-output norms, full-width
+    qk-norm) + a sliding/full layer pattern whose FULL layers use the
+    yarn-scaled rope table while sliding layers stay on the unscaled one."""
+    from transformers import Olmo3Config, Olmo3ForCausalLM as HFOlmo3
+
+    from contrib.models.olmo3.src.modeling_olmo3 import Olmo3ForCausalLM
+
+    cfg = Olmo3Config(vocab_size=256, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=4, num_attention_heads=4,
+                      num_key_value_heads=2, sliding_window=8,
+                      layer_types=["sliding_attention", "sliding_attention",
+                                   "full_attention", "sliding_attention"],
+                      rope_scaling={"rope_type": "yarn", "factor": 4.0,
+                                    "original_max_position_embeddings": 32,
+                                    "beta_fast": 32.0, "beta_slow": 1.0},
+                      max_position_embeddings=128,
+                      pad_token_id=0, tie_word_embeddings=False)
+    torch.manual_seed(0)
+    hf = HFOlmo3(cfg).eval()
+    _run_parity(Olmo3ForCausalLM, hf, cfg, atol=1e-3)

@@ -1,0 +1,29 @@
+"""olmoe parity tests (reference contrib shape: README.md + src/ + test/ per family).
+
+Moved from the former central tests/test_contrib_models.py; executed both directly
+(`pytest contrib/models/olmoe/test/`) and through the tests/test_contrib_models.py
+aggregator (the CI gate).
+"""
+
+
+import pytest
+import torch
+
+from contrib.models._test_harness import *  # noqa: F401,F403
+
+pytestmark = pytest.mark.slow
+
+
+def test_olmoe_parity():
+    from transformers import OlmoeConfig, OlmoeForCausalLM as HFOlmoe
+
+    from contrib.models.olmoe.src.modeling_olmoe import OlmoeForCausalLM
+
+    cfg = OlmoeConfig(vocab_size=256, hidden_size=64, intermediate_size=48,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, num_experts=4,
+                      num_experts_per_tok=2, norm_topk_prob=False,
+                      pad_token_id=0, tie_word_embeddings=False)
+    torch.manual_seed(0)
+    hf = HFOlmoe(cfg).eval()
+    _run_parity(OlmoeForCausalLM, hf, cfg, atol=1e-3, rtol=1e-3)

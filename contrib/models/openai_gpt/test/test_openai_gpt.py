@@ -1,0 +1,30 @@
+"""openai_gpt parity tests (reference contrib shape: README.md + src/ + test/ per family).
+
+Moved from the former central tests/test_contrib_models.py; executed both directly
+(`pytest contrib/models/openai_gpt/test/`) and through the tests/test_contrib_models.py
+aggregator (the CI gate).
+"""
+
+
+import pytest
+import torch
+
+from contrib.models._test_harness import *  # noqa: F401,F403
+
+pytestmark = pytest.mark.slow
+
+
+def test_openai_gpt_parity():
+    """GPT-1: true post-LN (LayerNorm on the residual SUM), learned positions,
+    no final norm — the custom-forward post-LN representative."""
+    from transformers import OpenAIGPTConfig, OpenAIGPTLMHeadModel
+
+    from contrib.models.openai_gpt.src.modeling_openai_gpt import (
+        OpenAIGPTForCausalLM)
+
+    cfg = OpenAIGPTConfig(vocab_size=256, n_positions=128, n_embd=64,
+                          n_layer=2, n_head=4, afn="gelu",
+                          resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0)
+    torch.manual_seed(0)
+    hf = OpenAIGPTLMHeadModel(cfg).eval()
+    _run_parity(OpenAIGPTForCausalLM, hf, cfg)

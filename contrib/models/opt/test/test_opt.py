@@ -1,0 +1,29 @@
+"""opt parity tests (reference contrib shape: README.md + src/ + test/ per family).
+
+Moved from the former central tests/test_contrib_models.py; executed both directly
+(`pytest contrib/models/opt/test/`) and through the tests/test_contrib_models.py
+aggregator (the CI gate).
+"""
+
+
+import pytest
+import torch
+
+from contrib.models._test_harness import *  # noqa: F401,F403
+
+pytestmark = pytest.mark.slow
+
+
+def test_opt_parity():
+    from transformers import OPTConfig, OPTForCausalLM as HFOPT
+
+    from contrib.models.opt.src.modeling_opt import OPTForCausalLM
+
+    cfg = OPTConfig(vocab_size=256, hidden_size=64, num_hidden_layers=2,
+                    ffn_dim=128, num_attention_heads=4,
+                    max_position_embeddings=128, do_layer_norm_before=True,
+                    activation_function="relu", word_embed_proj_dim=64,
+                    dropout=0.0)
+    torch.manual_seed(0)
+    hf = HFOPT(cfg).eval()
+    _run_parity(OPTForCausalLM, hf, cfg)

@@ -1,0 +1,34 @@
+"""orion parity tests (reference contrib shape: README.md + src/ + test/ per family).
+
+Moved from the former central tests/test_contrib_models.py; executed both directly
+(`pytest contrib/models/orion/test/`) and through the tests/test_contrib_models.py
+aggregator (the CI gate).
+"""
+
+
+import pytest
+import torch
+
+from contrib.models._test_harness import *  # noqa: F401,F403
+
+pytestmark = pytest.mark.slow
+
+
+def test_orion_parity():
+    """Orion: llama geometry with BIASED LayerNorm everywhere instead of
+    RMSNorm (norm_type=layer + norm_bias)."""
+    from contrib.models.orion.src.modeling_orion import OrionForCausalLM
+
+    cfg = dict(model_type="orion", vocab_size=256, hidden_size=64,
+               intermediate_size=128, num_hidden_layers=2,
+               num_attention_heads=4, num_key_value_heads=4,
+               rms_norm_eps=1e-5, rope_theta=10000.0,
+               tie_word_embeddings=False)
+    torch.manual_seed(0)
+    oracle = _OracleModel(256, 64, 128, 2, 4, 4, 16, eps=1e-5,
+                          norm="layer").eval()
+    with torch.no_grad():
+        for n, p in oracle.named_parameters():
+            if "layernorm.bias" in n or n == "model.norm.bias":
+                p.copy_(torch.randn_like(p) * 0.1)
+    _run_parity_oracle(OrionForCausalLM, oracle, cfg)

@@ -1,0 +1,69 @@
+"""ovis2 parity tests (reference contrib shape: README.md + src/ + test/ per family).
+
+Moved from the former central tests/test_contrib_models.py; executed both directly
+(`pytest contrib/models/ovis2/test/`) and through the tests/test_contrib_models.py
+aggregator (the CI gate).
+"""
+
+
+import numpy as np
+import pytest
+import torch
+
+from neuronx_distributed_inference_tpu.config import (  # noqa: F401
+    TpuConfig, load_pretrained_config)
+from contrib.models._test_harness import *  # noqa: F401,F403
+
+pytestmark = pytest.mark.slow
+
+
+def test_ovis2_generate_matches_hf():
+    """Ovis2 visual tokenizer: AIMv2 tower -> 2x2 stride merge -> softmax over
+    a visual vocabulary -> soft tokens through the vte; indicator token ids get
+    their vte rows swapped in; qwen2 backbone."""
+    from transformers import (Ovis2Config, Ovis2ForConditionalGeneration
+                              as HFOvis2, Qwen2Config)
+    from transformers.models.ovis2.configuration_ovis2 import Ovis2VisionConfig
+
+    from contrib.models.ovis2.src.modeling_ovis2 import (
+        Ovis2ForConditionalGeneration)
+
+    vc = Ovis2VisionConfig(hidden_size=32, intermediate_size=64,
+                           num_hidden_layers=2, num_attention_heads=2,
+                           image_size=16, patch_size=4, num_channels=3,
+                           hidden_stride=2, vocab_size=64,
+                           num_visual_indicator_tokens=5, qkv_bias=False)
+    tc = Qwen2Config(vocab_size=256, hidden_size=24, intermediate_size=48,
+                     num_hidden_layers=2, num_attention_heads=4,
+                     num_key_value_heads=2, rope_theta=10000.0,
+                     tie_word_embeddings=False)
+    cfg = Ovis2Config(vision_config=vc, text_config=tc, image_token_id=255,
+                      visual_indicator_token_ids=[250, 251, 252, 253, 254],
+                      hidden_size=24, vocab_size=256, pad_token_id=0)
+    torch.manual_seed(0)
+    hf = HFOvis2(cfg).eval()
+
+    tpu_cfg = TpuConfig(batch_size=2, seq_len=64, max_context_length=32,
+                        dtype="float32", context_encoding_buckets=[32],
+                        token_generation_buckets=[64])
+    config = Ovis2ForConditionalGeneration.get_config_cls()(
+        tpu_cfg, load_config=load_pretrained_config(cfg.to_dict()))
+    app = Ovis2ForConditionalGeneration(None, config)
+    state = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+    app._put_params(app.convert_hf_state_dict(state, app.config))
+    app.load_vision_from_state_dict(state)
+
+    rng = np.random.default_rng(1)
+    ids = rng.integers(1, 250, size=(2, 20))
+    ids[:, 2] = 250                                     # img_start indicator
+    ids[:, 3:7] = 255                                   # 4 soft tokens/image
+    ids[:, 7] = 251                                     # img_end indicator
+    pixels = rng.normal(size=(2, 3, 16, 16)).astype(np.float32)
+    with torch.no_grad():
+        hf_out = hf.generate(input_ids=torch.tensor(ids),
+                             pixel_values=torch.tensor(pixels),
+                             max_new_tokens=8, do_sample=False,
+                             pad_token_id=0)
+    out = app.generate(ids, pixel_values=pixels, max_new_tokens=8,
+                       eos_token_id=-1)
+    np.testing.assert_array_equal(out.tokens, hf_out[:, 20:].numpy())

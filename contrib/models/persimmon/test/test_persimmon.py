@@ -1,0 +1,32 @@
+"""persimmon parity tests (reference contrib shape: README.md + src/ + test/ per family).
+
+Moved from the former central tests/test_contrib_models.py; executed both directly
+(`pytest contrib/models/persimmon/test/`) and through the tests/test_contrib_models.py
+aggregator (the CI gate).
+"""
+
+
+import pytest
+import torch
+
+from contrib.models._test_harness import *  # noqa: F401,F403
+
+pytestmark = pytest.mark.slow
+
+
+def test_persimmon_parity():
+    """Persimmon: per-head q/k LayerNorm (biased), per-head-interleaved fused
+    qkv unpacked at conversion, relu2 plain MLP, partial rotary."""
+    from transformers import PersimmonConfig, PersimmonForCausalLM as HFPersimmon
+
+    from contrib.models.persimmon.src.modeling_persimmon import (
+        PersimmonForCausalLM)
+
+    cfg = PersimmonConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
+                          num_hidden_layers=2, num_attention_heads=4,
+                          partial_rotary_factor=0.5, qk_layernorm=True,
+                          hidden_act="relu2", pad_token_id=0,
+                          tie_word_embeddings=False)
+    torch.manual_seed(0)
+    hf = HFPersimmon(cfg).eval()
+    _run_parity(PersimmonForCausalLM, hf, cfg)

@@ -1,0 +1,29 @@
+"""phi parity tests (reference contrib shape: README.md + src/ + test/ per family).
+
+Moved from the former central tests/test_contrib_models.py; executed both directly
+(`pytest contrib/models/phi/test/`) and through the tests/test_contrib_models.py
+aggregator (the CI gate).
+"""
+
+
+import pytest
+import torch
+
+from contrib.models._test_harness import *  # noqa: F401,F403
+
+pytestmark = pytest.mark.slow
+
+
+def test_phi_parity():
+    from transformers import PhiConfig, PhiForCausalLM as HFPhi
+
+    from contrib.models.phi.src.modeling_phi import PhiForCausalLM
+
+    cfg = PhiConfig(vocab_size=256, hidden_size=64, num_hidden_layers=2,
+                    num_attention_heads=4, intermediate_size=128,
+                    partial_rotary_factor=0.5, max_position_embeddings=128,
+                    hidden_act="gelu_new", resid_pdrop=0.0, embd_pdrop=0.0,
+                    attention_dropout=0.0, qk_layernorm=False)
+    torch.manual_seed(0)
+    hf = HFPhi(cfg).eval()
+    _run_parity(PhiForCausalLM, hf, cfg)

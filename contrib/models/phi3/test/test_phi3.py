@@ -1,0 +1,31 @@
+"""phi3 parity tests (reference contrib shape: README.md + src/ + test/ per family).
+
+Moved from the former central tests/test_contrib_models.py; executed both directly
+(`pytest contrib/models/phi3/test/`) and through the tests/test_contrib_models.py
+aggregator (the CI gate).
+"""
+
+
+import pytest
+import torch
+
+from contrib.models._test_harness import *  # noqa: F401,F403
+
+pytestmark = pytest.mark.slow
+
+
+def test_phi3_parity():
+    from transformers import Phi3Config, Phi3ForCausalLM as HFPhi3
+
+    from contrib.models.phi3.src.modeling_phi3 import Phi3ForCausalLM
+
+    cfg = Phi3Config(vocab_size=256, hidden_size=64, num_hidden_layers=2,
+                     num_attention_heads=4, num_key_value_heads=2,
+                     intermediate_size=128, max_position_embeddings=128,
+                     rope_theta=10000.0, tie_word_embeddings=False,
+                     resid_pdrop=0.0, embd_pdrop=0.0, attention_dropout=0.0,
+                     sliding_window=None, pad_token_id=0, eos_token_id=2,
+                     bos_token_id=1)
+    torch.manual_seed(0)
+    hf = HFPhi3(cfg).eval()
+    _run_parity(Phi3ForCausalLM, hf, cfg)

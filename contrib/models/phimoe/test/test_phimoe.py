@@ -1,0 +1,31 @@
+"""phimoe parity tests (reference contrib shape: README.md + src/ + test/ per family).
+
+Moved from the former central tests/test_contrib_models.py; executed both directly
+(`pytest contrib/models/phimoe/test/`) and through the tests/test_contrib_models.py
+aggregator (the CI gate).
+"""
+
+
+import pytest
+import torch
+
+from contrib.models._test_harness import *  # noqa: F401,F403
+
+pytestmark = pytest.mark.slow
+
+
+def test_phimoe_parity():
+    from transformers import PhimoeConfig, PhimoeForCausalLM as HFPhimoe
+
+    from contrib.models.phimoe.src.modeling_phimoe import PhimoeForCausalLM
+
+    cfg = PhimoeConfig(vocab_size=256, hidden_size=64, intermediate_size=96,
+                       num_hidden_layers=2, num_attention_heads=4,
+                       num_key_value_heads=2, num_local_experts=4,
+                       num_experts_per_tok=2, router_jitter_noise=0.01,
+                       attention_bias=True, lm_head_bias=True,
+                       pad_token_id=0, rope_scaling=None,
+                       sliding_window=None, tie_word_embeddings=False)
+    torch.manual_seed(0)
+    hf = HFPhimoe(cfg).eval()
+    _run_parity(PhimoeForCausalLM, hf, cfg, atol=1e-3, rtol=1e-3)

@@ -1,0 +1,29 @@
+"""pythia parity tests (reference contrib shape: README.md + src/ + test/ per family).
+
+Moved from the former central tests/test_contrib_models.py; executed both directly
+(`pytest contrib/models/pythia/test/`) and through the tests/test_contrib_models.py
+aggregator (the CI gate).
+"""
+
+
+import pytest
+import torch
+
+from contrib.models._test_harness import *  # noqa: F401,F403
+
+pytestmark = pytest.mark.slow
+
+
+def test_pythia_parity():
+    from transformers import GPTNeoXConfig, GPTNeoXForCausalLM
+
+    from contrib.models.pythia.src.modeling_pythia import PythiaForCausalLM
+
+    cfg = GPTNeoXConfig(vocab_size=256, hidden_size=64, num_hidden_layers=2,
+                        num_attention_heads=4, intermediate_size=128,
+                        rotary_pct=0.25, max_position_embeddings=128,
+                        use_parallel_residual=True, hidden_act="gelu",
+                        hidden_dropout=0.0, attention_dropout=0.0)
+    torch.manual_seed(0)
+    hf = GPTNeoXForCausalLM(cfg).eval()
+    _run_parity(PythiaForCausalLM, hf, cfg)

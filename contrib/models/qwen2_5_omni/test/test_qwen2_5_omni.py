@@ -1,0 +1,67 @@
+"""qwen2_5_omni parity tests (reference contrib shape: README.md + src/ + test/ per family).
+
+Moved from the former central tests/test_contrib_models.py; executed both directly
+(`pytest contrib/models/qwen2_5_omni/test/`) and through the tests/test_contrib_models.py
+aggregator (the CI gate).
+"""
+
+
+import numpy as np
+import pytest
+import torch
+
+from neuronx_distributed_inference_tpu.config import (  # noqa: F401
+    TpuConfig, load_pretrained_config)
+from contrib.models._test_harness import *  # noqa: F401,F403
+
+pytestmark = pytest.mark.slow
+
+
+def test_qwen2_5_omni_thinker_parity():
+    """Qwen2.5-Omni thinker text backbone (matches the reference contrib's
+    text-only scope): qwen2-shaped GQA with biased qkv; mrope with shared 1D
+    positions == standard rope."""
+    from transformers import Qwen2_5OmniThinkerConfig
+    from transformers.models.qwen2_5_omni.modeling_qwen2_5_omni import (
+        Qwen2_5OmniThinkerForConditionalGeneration as HFThinker)
+
+    from contrib.models.qwen2_5_omni.src.modeling_qwen2_5_omni import (
+        Qwen25OmniThinkerForCausalLM)
+
+    cfg = Qwen2_5OmniThinkerConfig(
+        text_config=dict(vocab_size=256, hidden_size=32, intermediate_size=64,
+                         num_hidden_layers=2, num_attention_heads=4,
+                         num_key_value_heads=2, rope_theta=10000.0,
+                         rope_scaling={"mrope_section": [2, 1, 1],
+                                       "rope_type": "default",
+                                       "type": "default"},
+                         tie_word_embeddings=False),
+        audio_config=dict(d_model=16, encoder_layers=1,
+                          encoder_attention_heads=2, encoder_ffn_dim=32,
+                          num_mel_bins=8, max_source_positions=10, n_window=2,
+                          output_dim=32),
+        vision_config=dict(hidden_size=16, intermediate_size=32, depth=2,
+                           num_heads=2, patch_size=4, spatial_merge_size=1,
+                           temporal_patch_size=1, out_hidden_size=32,
+                           fullatt_block_indexes=[1], window_size=8),
+        vision_start_token_id=251, vision_end_token_id=252,
+        audio_start_token_id=253, audio_end_token_id=254,
+        image_token_id=255, video_token_id=250, audio_token_id=249,
+        position_id_per_seconds=25, seconds_per_chunk=2, pad_token_id=0,
+    )
+    torch.manual_seed(0)
+    hf = HFThinker(cfg).eval()
+
+    config = Qwen25OmniThinkerForCausalLM.get_config_cls()(
+        _tpu_cfg(), load_config=load_pretrained_config(cfg.to_dict()))
+    app = Qwen25OmniThinkerForCausalLM(None, config)
+    state = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+    app._put_params(app.convert_hf_state_dict(state, app.config))
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(3, 249, size=(2, 12)).astype(np.int64)
+    with torch.no_grad():
+        hf_out = hf.generate(torch.tensor(ids), max_new_tokens=8,
+                             do_sample=False, pad_token_id=0)
+    out = app.generate(ids, max_new_tokens=8, eos_token_id=-1)
+    np.testing.assert_array_equal(out.tokens, hf_out[:, 12:].numpy())

@@ -1,0 +1,33 @@
+"""qwen2_moe parity tests (reference contrib shape: README.md + src/ + test/ per family).
+
+Moved from the former central tests/test_contrib_models.py; executed both directly
+(`pytest contrib/models/qwen2_moe/test/`) and through the tests/test_contrib_models.py
+aggregator (the CI gate).
+"""
+
+
+import pytest
+import torch
+
+from contrib.models._test_harness import *  # noqa: F401,F403
+
+pytestmark = pytest.mark.slow
+
+
+def test_qwen2_moe_parity():
+    from transformers import Qwen2MoeConfig, Qwen2MoeForCausalLM as HFQwen2Moe
+
+    from contrib.models.qwen2_moe.src.modeling_qwen2_moe import (
+        Qwen2MoeForCausalLM)
+
+    cfg = Qwen2MoeConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
+                         moe_intermediate_size=48,
+                         shared_expert_intermediate_size=96,
+                         num_hidden_layers=2, num_attention_heads=4,
+                         num_key_value_heads=2, num_experts=4,
+                         num_experts_per_tok=2, norm_topk_prob=False,
+                         decoder_sparse_step=1, mlp_only_layers=[],
+                         sliding_window=None, tie_word_embeddings=False)
+    torch.manual_seed(0)
+    hf = HFQwen2Moe(cfg).eval()
+    _run_parity(Qwen2MoeForCausalLM, hf, cfg, atol=1e-3, rtol=1e-3)

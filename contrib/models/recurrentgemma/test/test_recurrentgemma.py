@@ -1,0 +1,37 @@
+"""recurrentgemma parity tests (reference contrib shape: README.md + src/ + test/ per family).
+
+Moved from the former central tests/test_contrib_models.py; executed both directly
+(`pytest contrib/models/recurrentgemma/test/`) and through the tests/test_contrib_models.py
+aggregator (the CI gate).
+"""
+
+
+import pytest
+import torch
+
+from contrib.models._test_harness import *  # noqa: F401,F403
+
+pytestmark = pytest.mark.slow
+
+
+def test_recurrentgemma_parity():
+    """Griffin / RG-LRU: the first non-KV recurrent-state cache in the hub.
+    Prefill runs the recurrence as an associative scan; parity vs HF exercises
+    the recurrence math, the conv tail handoff, and the mixed cache pytree."""
+    from transformers import (RecurrentGemmaConfig,
+                              RecurrentGemmaForCausalLM as HFRg)
+
+    from contrib.models.recurrentgemma.src.modeling_recurrentgemma import (
+        RecurrentGemmaForCausalLM)
+
+    cfg = RecurrentGemmaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=192,
+        num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=2,
+        lru_width=64, conv1d_width=4, attention_window_size=16,
+        embeddings_scale_by_sqrt_dim=True, logits_soft_cap=30.0,
+        partial_rotary_factor=0.5, pad_token_id=0,
+        block_types=["recurrent", "recurrent", "attention"])
+    torch.manual_seed(0)
+    hf = HFRg(cfg).eval()
+    _run_parity(RecurrentGemmaForCausalLM, hf, cfg, atol=2e-3, rtol=1e-3,
+                eos_token_id=1)

@@ -1,0 +1,31 @@
+"""smollm3 parity tests (reference contrib shape: README.md + src/ + test/ per family).
+
+Moved from the former central tests/test_contrib_models.py; executed both directly
+(`pytest contrib/models/smollm3/test/`) and through the tests/test_contrib_models.py
+aggregator (the CI gate).
+"""
+
+
+import pytest
+import torch
+
+from contrib.models._test_harness import *  # noqa: F401,F403
+
+pytestmark = pytest.mark.slow
+
+
+def test_smollm3_parity():
+    """SmolLM3: NoPE every 4th layer via the pattern machinery — rope layers as
+    full-width-window 'sliding' kind, NoPE layers on a zeroed rope table."""
+    from transformers import SmolLM3Config, SmolLM3ForCausalLM as HFSmolLM3
+
+    from contrib.models.smollm3.src.modeling_smollm3 import SmolLM3ForCausalLM
+
+    cfg = SmolLM3Config(vocab_size=256, hidden_size=64, intermediate_size=128,
+                        num_hidden_layers=4, num_attention_heads=4,
+                        num_key_value_heads=2,
+                        no_rope_layers=[1, 1, 1, 0], use_sliding_window=False,
+                        pad_token_id=0, tie_word_embeddings=True)
+    torch.manual_seed(0)
+    hf = HFSmolLM3(cfg).eval()
+    _run_parity(SmolLM3ForCausalLM, hf, cfg)

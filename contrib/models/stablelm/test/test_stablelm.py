@@ -1,0 +1,29 @@
+"""stablelm parity tests (reference contrib shape: README.md + src/ + test/ per family).
+
+Moved from the former central tests/test_contrib_models.py; executed both directly
+(`pytest contrib/models/stablelm/test/`) and through the tests/test_contrib_models.py
+aggregator (the CI gate).
+"""
+
+
+import pytest
+import torch
+
+from contrib.models._test_harness import *  # noqa: F401,F403
+
+pytestmark = pytest.mark.slow
+
+
+def test_stablelm_parity():
+    from transformers import StableLmConfig, StableLmForCausalLM as HFStableLm
+
+    from contrib.models.stablelm.src.modeling_stablelm import StableLmForCausalLM
+
+    cfg = StableLmConfig(vocab_size=256, hidden_size=64, num_hidden_layers=2,
+                         num_attention_heads=4, num_key_value_heads=2,
+                         intermediate_size=128, partial_rotary_factor=0.25,
+                         use_qkv_bias=True, max_position_embeddings=128,
+                         attention_dropout=0.0)
+    torch.manual_seed(0)
+    hf = HFStableLm(cfg).eval()
+    _run_parity(StableLmForCausalLM, hf, cfg)

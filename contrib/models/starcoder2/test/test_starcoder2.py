@@ -1,0 +1,32 @@
+"""starcoder2 parity tests (reference contrib shape: README.md + src/ + test/ per family).
+
+Moved from the former central tests/test_contrib_models.py; executed both directly
+(`pytest contrib/models/starcoder2/test/`) and through the tests/test_contrib_models.py
+aggregator (the CI gate).
+"""
+
+
+import pytest
+import torch
+
+from contrib.models._test_harness import *  # noqa: F401,F403
+
+pytestmark = pytest.mark.slow
+
+
+def test_starcoder2_parity():
+    from transformers import Starcoder2Config, Starcoder2ForCausalLM as HFSc2
+
+    from contrib.models.starcoder2.src.modeling_starcoder2 import (
+        Starcoder2ForCausalLM)
+
+    cfg = Starcoder2Config(vocab_size=256, hidden_size=64, num_hidden_layers=2,
+                           num_attention_heads=4, num_key_value_heads=2,
+                           intermediate_size=128, max_position_embeddings=128,
+                           hidden_act="gelu_pytorch_tanh", use_bias=True,
+                           tie_word_embeddings=True, sliding_window=None,
+                           residual_dropout=0.0, embedding_dropout=0.0,
+                           attention_dropout=0.0)
+    torch.manual_seed(0)
+    hf = HFSc2(cfg).eval()
+    _run_parity(Starcoder2ForCausalLM, hf, cfg)

@@ -1,0 +1,171 @@
+"""trinity parity tests (reference contrib shape: README.md + src/ + test/ per family).
+
+Moved from the former central tests/test_contrib_models.py; executed both directly
+(`pytest contrib/models/trinity/test/`) and through the tests/test_contrib_models.py
+aggregator (the CI gate).
+"""
+
+import math  # noqa: F401
+
+import numpy as np
+import pytest
+import torch
+
+from contrib.models._test_harness import *  # noqa: F401,F403
+
+pytestmark = pytest.mark.slow
+
+
+class _TrinityOracleLayer(torch.nn.Module):
+    def __init__(self, H, nq, nkv, d, I_dense, I_moe, E, eps, dense):
+        super().__init__()
+        rms = lambda n: _OracleRMSNorm(n, eps)  # noqa: E731
+        self.input_layernorm = rms(H)
+        self.post_attention_layernorm = rms(H)
+        self.pre_mlp_layernorm = rms(H)
+        self.post_mlp_layernorm = rms(H)
+        sa = torch.nn.Module()
+        sa.q_proj = torch.nn.Linear(H, nq * d, bias=False)
+        sa.k_proj = torch.nn.Linear(H, nkv * d, bias=False)
+        sa.v_proj = torch.nn.Linear(H, nkv * d, bias=False)
+        sa.o_proj = torch.nn.Linear(nq * d, H, bias=False)
+        sa.q_norm = rms(d)
+        sa.k_norm = rms(d)
+        sa.gate_proj = torch.nn.Linear(H, nq, bias=False)  # one gate per head
+        self.self_attn = sa
+        mlp = torch.nn.Module()
+        if dense:
+            mlp.gate_proj = torch.nn.Linear(H, I_dense, bias=False)
+            mlp.up_proj = torch.nn.Linear(H, I_dense, bias=False)
+            mlp.down_proj = torch.nn.Linear(I_dense, H, bias=False)
+        else:
+            router = torch.nn.Module()
+            router.gate = torch.nn.Linear(H, E, bias=False)
+            mlp.router = router
+            mlp.expert_bias = torch.nn.Parameter(torch.zeros(E))
+            mlp.experts = torch.nn.ModuleList()
+            for _ in range(E):
+                ex = torch.nn.Module()
+                ex.gate_proj = torch.nn.Linear(H, I_moe, bias=False)
+                ex.up_proj = torch.nn.Linear(H, I_moe, bias=False)
+                ex.down_proj = torch.nn.Linear(I_moe, H, bias=False)
+                mlp.experts.append(ex)
+            sh = torch.nn.Module()
+            sh.gate_proj = torch.nn.Linear(H, I_moe, bias=False)
+            sh.up_proj = torch.nn.Linear(H, I_moe, bias=False)
+            sh.down_proj = torch.nn.Linear(I_moe, H, bias=False)
+            mlp.shared_experts = sh
+        self.mlp = mlp
+        self.dense = dense
+
+
+class _TrinityOracle(torch.nn.Module):
+    """Independent AFMoE oracle: sliding(rope)/full(NoPE) attention with a
+    per-head sigmoid gate, 4-norm sandwich blocks, sigmoid+bias routing with
+    renormalized unbiased gates × route_scale, shared expert, muP embeds."""
+
+    def __init__(self, V, H, L, nq, nkv, d, I_dense, I_moe, E, topk, window,
+                 layer_kinds, num_dense, route_scale=1.0, eps=1e-5):
+        super().__init__()
+        inner = torch.nn.Module()
+        inner.embed_tokens = torch.nn.Embedding(V, H)
+        inner.layers = torch.nn.ModuleList(
+            [_TrinityOracleLayer(H, nq, nkv, d, I_dense, I_moe, E, eps,
+                                 i < num_dense) for i in range(L)])
+        inner.norm = _OracleRMSNorm(H, eps)
+        self.model = inner
+        self.lm_head = torch.nn.Linear(H, V, bias=False)
+        self.nq, self.nkv, self.d, self.topk = nq, nkv, d, topk
+        self.window, self.kinds, self.route_scale = window, layer_kinds, route_scale
+        self.mup = math.sqrt(H)
+        self.inv_freq = (10000.0 ** (-np.arange(0, d, 2) / d)).astype(np.float32)
+
+    def _attn(self, lyr, x, use_rope):
+        B, S, _ = x.shape
+        sa = lyr.self_attn
+        q = sa.q_proj(x).view(B, S, self.nq, self.d).transpose(1, 2)
+        k = sa.k_proj(x).view(B, S, self.nkv, self.d).transpose(1, 2)
+        v = sa.v_proj(x).view(B, S, self.nkv, self.d).transpose(1, 2)
+        q, k = sa.q_norm(q), sa.k_norm(k)
+        if use_rope:
+            pos = torch.arange(S, dtype=torch.float32)
+            freqs = torch.outer(pos, torch.tensor(self.inv_freq))
+            emb = torch.cat([freqs, freqs], dim=-1)
+            cos, sin = emb.cos()[None, None], emb.sin()[None, None]
+
+            def rot(t):
+                h = t.shape[-1] // 2
+                return torch.cat([-t[..., h:], t[..., :h]], dim=-1)
+
+            q = q * cos + rot(q) * sin
+            k = k * cos + rot(k) * sin
+        rep = self.nq // self.nkv
+        k = k.repeat_interleave(rep, dim=1)
+        v = v.repeat_interleave(rep, dim=1)
+        scores = (q @ k.transpose(-1, -2)) / math.sqrt(self.d)
+        pos = torch.arange(S)
+        mask = pos[None, :] <= pos[:, None]
+        if use_rope:  # sliding layers additionally window the mask
+            mask &= pos[None, :] > pos[:, None] - self.window
+        scores = scores.masked_fill(~mask, float("-inf"))
+        attn = torch.softmax(scores, dim=-1) @ v            # (B, nq, S, d)
+        gate = torch.sigmoid(sa.gate_proj(x))               # (B, S, nq)
+        attn = attn * gate.transpose(1, 2)[..., None]
+        return sa.o_proj(attn.transpose(1, 2).reshape(B, S, -1))
+
+    def _moe(self, mlp, x):
+        B, S, H = x.shape
+        flat = x.reshape(-1, H)
+        scores = torch.sigmoid(mlp.router.gate(flat).float())
+        _, idx = torch.topk(scores + mlp.expert_bias.float()[None], self.topk)
+        w = torch.gather(scores, 1, idx)
+        w = w / w.sum(-1, keepdim=True)
+        w = w * self.route_scale
+        out = torch.zeros_like(flat)
+        for n in range(flat.shape[0]):
+            for j in range(self.topk):
+                ex = mlp.experts[idx[n, j]]
+                h = torch.nn.functional.silu(ex.gate_proj(flat[n])) * ex.up_proj(flat[n])
+                out[n] += w[n, j] * ex.down_proj(h)
+        sh = mlp.shared_experts
+        shared = sh.down_proj(torch.nn.functional.silu(sh.gate_proj(flat))
+                              * sh.up_proj(flat))
+        return (out + shared).reshape(B, S, H)
+
+    def forward(self, ids):
+        h = self.model.embed_tokens(ids) * self.mup
+        for i, lyr in enumerate(self.model.layers):
+            x = lyr.input_layernorm(h)
+            a = self._attn(lyr, x, use_rope=(self.kinds[i] == "sliding_attention"))
+            h = h + lyr.post_attention_layernorm(a)
+            x = lyr.pre_mlp_layernorm(h)
+            m = (lyr.mlp.down_proj(torch.nn.functional.silu(lyr.mlp.gate_proj(x))
+                                   * lyr.mlp.up_proj(x))
+                 if lyr.dense else self._moe(lyr.mlp, x))
+            h = h + lyr.post_mlp_layernorm(m)
+        return self.lm_head(self.model.norm(h))
+
+
+def test_trinity_parity():
+    """Trinity/AFMoE: mixed sliding(rope)/full(NoPE) attention with per-head
+    sigmoid output gates, 4-norm blocks, first-2-dense then sigmoid+expert-bias
+    MoE with shared expert, muP embedding scale, route_scale=2."""
+    from contrib.models.trinity.src.modeling_trinity import TrinityForCausalLM
+
+    kinds = ["sliding_attention", "sliding_attention", "full_attention",
+             "sliding_attention"]
+    cfg = dict(model_type="afmoe", vocab_size=256, hidden_size=64,
+               num_hidden_layers=4, num_attention_heads=4,
+               num_key_value_heads=2, head_dim=16, intermediate_size=128,
+               moe_intermediate_size=32, num_local_experts=8,
+               num_experts_per_tok=2, num_dense_layers=2, sliding_window=8,
+               layer_types=kinds, route_scale=2.0, rms_norm_eps=1e-5,
+               rope_theta=10000.0, mup_enabled=True, tie_word_embeddings=False)
+    torch.manual_seed(0)
+    oracle = _TrinityOracle(256, 64, 4, 4, 2, 16, 128, 32, 8, 2, 8,
+                            kinds, 2, route_scale=2.0).eval()
+    with torch.no_grad():
+        for lyr in oracle.model.layers:
+            if not lyr.dense:
+                lyr.mlp.expert_bias.copy_(torch.randn(8) * 0.5)
+    _run_parity_oracle(TrinityForCausalLM, oracle, cfg, atol=2e-3)

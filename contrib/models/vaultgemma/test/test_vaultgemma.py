@@ -1,0 +1,37 @@
+"""vaultgemma parity tests (reference contrib shape: README.md + src/ + test/ per family).
+
+Moved from the former central tests/test_contrib_models.py; executed both directly
+(`pytest contrib/models/vaultgemma/test/`) and through the tests/test_contrib_models.py
+aggregator (the CI gate).
+"""
+
+
+import pytest
+import torch
+
+from contrib.models._test_harness import *  # noqa: F401,F403
+
+pytestmark = pytest.mark.slow
+
+
+def test_vaultgemma_parity():
+    """VaultGemma: gemma2 without the sandwich branch norms."""
+    from transformers import VaultGemmaConfig, VaultGemmaForCausalLM as HFVg
+
+    from contrib.models.vaultgemma.src.modeling_vaultgemma import (
+        VaultGemmaForCausalLM)
+
+    cfg = VaultGemmaConfig(vocab_size=256, hidden_size=64,
+                           num_hidden_layers=2, num_attention_heads=4,
+                           num_key_value_heads=2, intermediate_size=128,
+                           head_dim=16, query_pre_attn_scalar=16,
+                           sliding_window=8, attn_logit_softcapping=50.0,
+                           final_logit_softcapping=30.0,
+                           layer_types=["sliding_attention", "full_attention"],
+                           hidden_activation="gelu_pytorch_tanh",
+                           pad_token_id=0, tie_word_embeddings=True)
+    torch.manual_seed(0)
+    hf = HFVg(cfg).eval()
+    # eos_token_id=1: HF generate stops at VaultGemma's default eos and pads
+    _run_parity(VaultGemmaForCausalLM, hf, cfg, atol=2e-3, rtol=1e-3,
+                eos_token_id=1)

@@ -1,0 +1,30 @@
+"""xglm parity tests (reference contrib shape: README.md + src/ + test/ per family).
+
+Moved from the former central tests/test_contrib_models.py; executed both directly
+(`pytest contrib/models/xglm/test/`) and through the tests/test_contrib_models.py
+aggregator (the CI gate).
+"""
+
+
+import pytest
+import torch
+
+from contrib.models._test_harness import *  # noqa: F401,F403
+
+pytestmark = pytest.mark.slow
+
+
+def test_xglm_parity():
+    """XGLM: computed fairseq sinusoidal positions (offset 2) materialized into
+    the learned-position table; scaled embeddings; biased pre-LN decoder."""
+    from transformers import XGLMConfig, XGLMForCausalLM as HFXglm
+
+    from contrib.models.xglm.src.modeling_xglm import XGLMForCausalLM
+
+    cfg = XGLMConfig(vocab_size=256, d_model=64, ffn_dim=128, num_layers=2,
+                     attention_heads=4, dropout=0.0, attention_dropout=0.0,
+                     activation_dropout=0.0, scale_embedding=True,
+                     pad_token_id=0, tie_word_embeddings=True)
+    torch.manual_seed(0)
+    hf = HFXglm(cfg).eval()
+    _run_parity(XGLMForCausalLM, hf, cfg)

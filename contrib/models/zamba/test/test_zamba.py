@@ -1,0 +1,35 @@
+"""zamba parity tests (reference contrib shape: README.md + src/ + test/ per family).
+
+Moved from the former central tests/test_contrib_models.py; executed both directly
+(`pytest contrib/models/zamba/test/`) and through the tests/test_contrib_models.py
+aggregator (the CI gate).
+"""
+
+
+import pytest
+import torch
+
+from contrib.models._test_harness import *  # noqa: F401,F403
+
+pytestmark = pytest.mark.slow
+
+
+def test_zamba_parity():
+    """Zamba v1: shared-block hybrid with a MULTI-HEAD mamba1 mixer (per-head
+    x_proj/dt_proj, interleaved x|z in_proj packing) and an adapter-free tied
+    transformer block."""
+    from transformers import ZambaConfig, ZambaForCausalLM as HFZamba
+
+    from contrib.models.zamba.src.modeling_zamba import ZambaForCausalLM
+
+    cfg = ZambaConfig(vocab_size=256, hidden_size=32, num_hidden_layers=4,
+                      attn_layer_period=3, attn_layer_offset=1,
+                      num_attention_heads=4, num_key_value_heads=4,
+                      intermediate_size=64, mamba_d_state=8, mamba_d_conv=4,
+                      mamba_expand=2, mamba_dt_rank=4, n_mamba_heads=2,
+                      use_mamba_kernels=False,
+                      max_position_embeddings=128, pad_token_id=0,
+                      tie_word_embeddings=True)
+    torch.manual_seed(0)
+    hf = HFZamba(cfg).eval()
+    _run_parity(ZambaForCausalLM, hf, cfg, atol=2e-3, rtol=1e-3)

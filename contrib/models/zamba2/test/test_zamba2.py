@@ -1,0 +1,39 @@
+"""zamba2 parity tests (reference contrib shape: README.md + src/ + test/ per family).
+
+Moved from the former central tests/test_contrib_models.py; executed both directly
+(`pytest contrib/models/zamba2/test/`) and through the tests/test_contrib_models.py
+aggregator (the CI gate).
+"""
+
+
+import pytest
+import torch
+
+from contrib.models._test_harness import *  # noqa: F401,F403
+
+pytestmark = pytest.mark.slow
+
+
+def test_zamba2_parity():
+    """Zamba2: mamba2 backbone with ONE shared transformer block invoked at
+    hybrid positions on concat(h, h0), per-invocation MLP LoRA adapters, and
+    a per-layer linear feeding the block output into the mamba input."""
+    from transformers import Zamba2Config, Zamba2ForCausalLM as HFZamba2
+
+    from contrib.models.zamba2.src.modeling_zamba2 import Zamba2ForCausalLM
+
+    cfg = Zamba2Config(vocab_size=256, hidden_size=32, num_hidden_layers=4,
+                       hybrid_layer_ids=[1, 3],
+                       layers_block_type=["mamba", "hybrid", "mamba",
+                                          "hybrid"],
+                       num_attention_heads=4, num_key_value_heads=4,
+                       attention_head_dim=16, intermediate_size=64,
+                       num_mem_blocks=1, adapter_rank=4, mamba_d_state=8,
+                       mamba_d_conv=4, mamba_expand=2, n_mamba_heads=4,
+                       mamba_headdim=16, mamba_ngroups=2, use_mem_rope=True,
+                       use_shared_attention_adapter=False,
+                       max_position_embeddings=128, pad_token_id=0,
+                       tie_word_embeddings=True)
+    torch.manual_seed(0)
+    hf = HFZamba2(cfg).eval()
+    _run_parity(Zamba2ForCausalLM, hf, cfg, atol=2e-3, rtol=1e-3)

@@ -1,0 +1,92 @@
+"""Probe v2: scan-consumed stacked int8 weights — weights passed as EXPLICIT jit
+arguments (closure constants get shipped to axon's remote-compile service, which
+is why v1 spent 10+ min per variant compile)."""
+import sys, time
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+L, H, I, B = 8, 4096, 14336, 64
+
+def run(name, fn, *args):
+    """Device-timed via profiler xplane: wall timing is invalid on the axon
+    remoting platform (unfetched results are lazily/not executed), and each
+    blocking fetch pays a ~100 ms tunnel round trip that would swamp the
+    kernel time."""
+    import shutil
+    sys.path.insert(0, "/root/repo")
+    from neuronx_distributed_inference_tpu.utils import profiling as prof
+
+    fn_j = jax.jit(fn)
+    t0 = time.perf_counter()
+    jax.block_until_ready(fn_j(*args))
+    compile_s = time.perf_counter() - t0
+    d = f"/tmp/probe_trace_{name.split()[0]}"
+    shutil.rmtree(d, ignore_errors=True)
+    n = 5
+    with prof.trace(d):
+        for _ in range(n):
+            jax.block_until_ready(fn_j(*args))
+    dev = prof.device_time_ms(d, "jit_")
+    dt = dev / n if dev is not None else float("nan")
+    print(f"{name:12s} {dt:7.2f} ms/iter device  (compile {compile_s:.1f}s)",
+          flush=True)
+
+def body_mm(h, q, g, d):
+    a = h @ q.astype(h.dtype)
+    gg = a @ g.astype(h.dtype)
+    return jnp.maximum(gg, 0) @ d.astype(h.dtype)
+
+def A(x, wq, wg, wd):          # scan xs (today's path)
+    def body(h, xs):
+        return body_mm(h, *xs), ()
+    h, _ = jax.lax.scan(body, x, (wq, wg, wd))
+    return h
+
+def C(x, wqT, wgT, wdT):       # pre-transposed stacks, contract on last axis
+    def body(h, xs):
+        qT, gT, dT = xs
+        a = jax.lax.dot_general(h, qT.astype(h.dtype), (((1,), (1,)), ((), ())))
+        g = jax.lax.dot_general(a, gT.astype(h.dtype), (((1,), (1,)), ((), ())))
+        return jax.lax.dot_general(jnp.maximum(g, 0), dT.astype(h.dtype),
+                                   (((1,), (1,)), ((), ()))), ()
+    h, _ = jax.lax.scan(body, x, (wqT, wgT, wdT))
+    return h
+
+def D(x, wq, wg, wd):          # int8 x int8 MXU dots (activation quant)
+    def q8(v):
+        s = jnp.max(jnp.abs(v.astype(jnp.float32)), -1, keepdims=True) / 127.
+        s = jnp.maximum(s, 1e-8)
+        return jnp.clip(jnp.round(v.astype(jnp.float32) / s), -127, 127
+                        ).astype(jnp.int8), s
+    def mm8(v, w):
+        vq, s = q8(v)
+        y = jax.lax.dot_general(vq, w, (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.int32)
+        return (y.astype(jnp.float32) * s).astype(jnp.bfloat16)
+    def body(h, xs):
+        q, g, d = xs
+        return mm8(jnp.maximum(mm8(mm8(h, q), g), 0), d), ()
+    h, _ = jax.lax.scan(body, x, (wq, wg, wd))
+    return h
+
+def main():
+    rng = np.random.default_rng(0)
+    t0 = time.perf_counter()
+    wq = jnp.asarray(rng.integers(-127, 128, (L, H, H), dtype=np.int8))
+    wg = jnp.asarray(rng.integers(-127, 128, (L, H, I), dtype=np.int8))
+    wd = jnp.asarray(rng.integers(-127, 128, (L, I, H), dtype=np.int8))
+    jax.block_until_ready((wq, wg, wd))
+    print(f"transfer {time.perf_counter()-t0:.1f}s", flush=True)
+    wqT = jnp.transpose(wq, (0, 2, 1)).copy()
+    wgT = jnp.transpose(wg, (0, 2, 1)).copy()
+    wdT = jnp.transpose(wd, (0, 2, 1)).copy()
+    x = jnp.asarray(rng.standard_normal((B, H)), jnp.bfloat16)
+    run("A xs-slices", A, x, wq, wg, wd)
+    run("C pre-T", C, x, wqT, wgT, wdT)
+    run("D int8dot", D, x, wq, wg, wd)
+    wbytes = wq.size + wg.size + wd.size
+    print(f"floor {wbytes/819e9*1000:.2f} ms ({wbytes/1e9:.2f} GB)", flush=True)
+
+if __name__ == "__main__":
+    main()
