@@ -1,0 +1,20 @@
+"""Shared test bootstrap: force the virtual 8-device CPU mesh.
+
+Imported (for its side effects) by tests/conftest.py and contrib/conftest.py —
+one copy of the platform forcing, mirroring the reference's CPU-mode SPMD
+validation (`NXD_CPU_MODE` + gloo, `models/application_base.py:554-626`).
+Must run before the first jax device query.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# the environment's TPU plugin overrides JAX_PLATFORMS; force CPU explicitly
+jax.config.update("jax_platforms", "cpu")

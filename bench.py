@@ -213,6 +213,31 @@ def main() -> None:
     # makes the headline survivable no matter what the enrichment phases cost.
     print(json.dumps(result), flush=True)
 
+    if _remaining() > 90:
+        # async dispatch-ahead (VERDICT r3 #4): chunk N+1 is dispatched from
+        # chunk N's device-resident last token before N is synced — the SAME
+        # decode executable, so enabling it on the warm app compiles nothing.
+        # The headline takes the better mode; both numbers are reported.
+        _note("phase: async dispatch-ahead probe")
+        try:
+            app.tpu_config.async_mode = True
+            out_a = app.generate(input_ids, max_new_tokens=decode_steps,
+                                 collect_latency=True)
+            a_s = sum(s for s, _ in out_a.decode_latencies_s)
+            a_toks = sum(t for _, t in out_a.decode_latencies_s) * batch
+            async_tok_per_s = a_toks / a_s
+            extra["sync_tok_per_s"] = round(tok_per_s, 1)
+            extra["async_tok_per_s"] = round(async_tok_per_s, 1)
+            if async_tok_per_s > tok_per_s:
+                result["value"] = round(async_tok_per_s, 1)
+                result["vs_baseline"] = round(async_tok_per_s / 2000.0, 3)
+            else:                      # keep serving in the faster mode
+                app.tpu_config.async_mode = False
+        except Exception as e:
+            _note(f"async probe failed: {e}")
+            app.tpu_config.async_mode = False
+        print(json.dumps(result), flush=True)
+
     # ---- enrichment phases, each budget-gated ---------------------------------
     import jax.numpy as jnp
 

@@ -1,18 +1,10 @@
 """Direct-run configuration for contrib family tests
 (`pytest contrib/models/<fam>/test/`): the same virtual 8-device CPU mesh as
-tests/conftest.py, so family parity runs never require TPU hardware."""
+tests/conftest.py via the shared repo-root bootstrap."""
 
 import os
 import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8").strip()
-
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import _tpu_test_bootstrap  # noqa: F401,E402  (side effect: CPU mesh)

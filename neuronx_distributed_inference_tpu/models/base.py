@@ -882,7 +882,8 @@ def _decoder_layer(
             # ragged paged serving: block-table-indexed write + length-aware attend
             block_table, slot_mapping = paged_stacked
             k_cache, v_cache = _sharded_paged_kv_write(
-                k_cache, v_cache, k.astype(k_cache.dtype), v.astype(v_cache.dtype),
+                k_cache, v_cache, kvcache.to_cache_dtype(k, k_cache.dtype),
+                kvcache.to_cache_dtype(v, v_cache.dtype),
                 slot_mapping, stacked_layer_idx, mesh, rules)
             attn = _sharded_paged_attend(q, k_cache, v_cache, positions,
                                          stacked_layer_idx, block_table, args,
@@ -891,7 +892,8 @@ def _decoder_layer(
         else:
             wp = positions if write_positions is None else write_positions
             k_cache, v_cache = _sharded_kv_write(
-                k_cache, v_cache, k.astype(k_cache.dtype), v.astype(v_cache.dtype),
+                k_cache, v_cache, kvcache.to_cache_dtype(k, k_cache.dtype),
+                kvcache.to_cache_dtype(v, v_cache.dtype),
                 wp, stacked_layer_idx, mesh, rules)
             if decode_bucket >= 1024:
                 attn = _sharded_decode_attend(q, k_cache, v_cache, positions,

@@ -76,8 +76,10 @@ def write_slots(cache_layer: jnp.ndarray, new_kv: jnp.ndarray,
     """
     nb, h, bs, d = cache_layer.shape
     b, hh, t, dd = new_kv.shape
-    rows = new_kv.transpose(0, 2, 1, 3).reshape(b * t, hh, dd).astype(
-        cache_layer.dtype)                                  # (N, H, D)
+    from .kvcache import to_cache_dtype
+
+    rows = to_cache_dtype(new_kv.transpose(0, 2, 1, 3).reshape(b * t, hh, dd),
+                          cache_layer.dtype)                # (N, H, D)
     slots = slot_mapping.reshape(b * t)
     # negative indices WRAP in jnp (NumPy semantics) — only indices >= size are dropped
     # by mode="drop"; remap the -1 sentinel to an explicitly out-of-bounds block, else

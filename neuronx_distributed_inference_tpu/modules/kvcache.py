@@ -85,6 +85,24 @@ def read_bucket(cache_layer: jnp.ndarray, bucket: int) -> jnp.ndarray:
     return jax.lax.slice_in_dim(cache_layer, 0, bucket, axis=2)
 
 
+
+def to_cache_dtype(x: jnp.ndarray, dtype) -> jnp.ndarray:
+    """Cast K/V values to the cache dtype, SATURATING for fp8 caches.
+
+    A plain astype overflows to NaN (e4m3fn) / Inf (e5m2) for |v| beyond the
+    format's range; outlier keys past the dynamic range would poison attention
+    (and the kernels' fast bit-surgery fp8 decode assumes finite payloads, so
+    the corruption would surface as plausible-looking wrong logits rather than
+    NaN). Every cache-write path funnels through this helper."""
+    dt = jnp.dtype(dtype)
+    if dt.itemsize == 1 and dt.kind not in "iub":   # fp8 dtypes report kind 'V'
+        import ml_dtypes
+
+        fmax = float(ml_dtypes.finfo(dt).max)
+        x = jnp.clip(x, -fmax, fmax)
+    return x.astype(dtype)
+
+
 def write_prefill(cache_layer: jnp.ndarray, new_kv: jnp.ndarray,
                   start: int = 0, batch_start: int = 0) -> jnp.ndarray:
     """Write (B, H, S_new, D) into the cache at [start, start+S_new) along seq,
@@ -94,7 +112,8 @@ def write_prefill(cache_layer: jnp.ndarray, new_kv: jnp.ndarray,
     resumes mid-way; continuous batching inserts a fresh sequence at its batch slot).
     """
     return jax.lax.dynamic_update_slice(
-        cache_layer, new_kv.astype(cache_layer.dtype), (batch_start, 0, start, 0))
+        cache_layer, to_cache_dtype(new_kv, cache_layer.dtype),
+        (batch_start, 0, start, 0))
 
 
 def write_decode(cache_layer: jnp.ndarray, new_kv: jnp.ndarray,
@@ -108,7 +127,7 @@ def write_decode(cache_layer: jnp.ndarray, new_kv: jnp.ndarray,
     def _one(row_cache, row_new, pos):
         # row_cache (H, S, D), row_new (H, T, D)
         return jax.lax.dynamic_update_slice(
-            row_cache, row_new.astype(row_cache.dtype), (0, pos, 0))
+            row_cache, to_cache_dtype(row_new, row_cache.dtype), (0, pos, 0))
 
     return jax.vmap(_one)(cache_layer, new_kv, positions)
 
@@ -163,7 +182,7 @@ def write_prefill_rolling(cache_layer: jnp.ndarray, new_kv: jnp.ndarray,
         new_kv, gather_idx[:, None, :, None].astype(jnp.int32), axis=2)
     keep = (q >= 0)[:, None, :, None]
     rows = jax.lax.dynamic_slice_in_dim(cache_layer, batch_start, b, axis=0)
-    updated = jnp.where(keep, gathered.astype(cache_layer.dtype), rows)
+    updated = jnp.where(keep, to_cache_dtype(gathered, cache_layer.dtype), rows)
     return jax.lax.dynamic_update_slice_in_dim(cache_layer, updated, batch_start,
                                                axis=0)
 

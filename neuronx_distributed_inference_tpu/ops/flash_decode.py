@@ -30,6 +30,8 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 DEFAULT_BLOCK_K = 256
+from .paged_decode import _vmem_cast
+
 NEG_INF = -1e30
 
 
@@ -399,8 +401,8 @@ def _stacked_decode_kernel(pos_ref, lidx_ref, q_ref, k_ref, v_ref, *refs,
                 mask = jnp.logical_and(mask, kv_iota > q_pos - window)
             for h in range(hkv):
                 q = q_ref[j, h]                          # (rows, D)
-                k = k_ref[0, j, h].astype(q.dtype)       # (block_k, D)
-                v = v_ref[0, j, h].astype(q.dtype)
+                k = _vmem_cast(k_ref[0, j, h], q.dtype)  # (block_k, D)
+                v = _vmem_cast(v_ref[0, j, h], q.dtype)
                 s = jax.lax.dot_general(
                     q, k, (((1,), (1,)), ((), ())),
                     preferred_element_type=jnp.float32) * scale

@@ -37,6 +37,31 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
+def _vmem_cast(x: jnp.ndarray, dtype) -> jnp.ndarray:
+    """Fast in-kernel cast of fp8 cache tiles to the compute dtype.
+
+    Mosaic lowers `astype` from fp8 through a scalarized emulation that costs
+    ~10 ms/step at bs=64 (measured: the paged attend dropped 16.1 -> 6.5
+    ms/step when the cache was bf16 instead of f8e4m3). fp8 -> bf16 is pure
+    bit surgery — widen to i32, rebase the exponent, reassemble — which runs
+    at VPU integer rate. Denormals flush to zero (KV scales keep serving
+    values normal; the saturating write precludes NaN/Inf payloads)."""
+    if x.dtype == dtype:
+        return x
+    name = jnp.dtype(x.dtype).name
+    if name not in ("float8_e4m3fn", "float8_e5m2") or dtype != jnp.bfloat16:
+        return x.astype(dtype)
+    u = jax.lax.bitcast_convert_type(x, jnp.uint8).astype(jnp.int32)
+    if name == "float8_e4m3fn":                      # s eeee mmm, bias 7
+        s, e, m = (u >> 7) & 1, (u >> 3) & 0xF, u & 0x7
+        bits = (s << 15) | ((e + 120) << 7) | (m << 4)
+    else:                                            # s eeeee mm, bias 15
+        s, e, m = (u >> 7) & 1, (u >> 2) & 0x1F, u & 0x3
+        bits = (s << 15) | ((e + 112) << 7) | (m << 5)
+    bits = jnp.where(e == 0, 0, bits).astype(jnp.uint16)
+    return jax.lax.bitcast_convert_type(bits, jnp.bfloat16)
+
+
 def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
@@ -136,6 +161,13 @@ def _paged_attend_kernel(pos_ref, lidx_ref, bt_ref, q_ref, *refs, o_ref=None,
                          rows: int, hkv: int, window: Optional[int],
                          soft_cap: Optional[float], has_sinks: bool,
                          has_slopes: bool):
+    """Block-diagonal head packing: every kv head's q rows stack into ONE
+    (hkv*rows, D) operand and the cell's kv blocks into ONE (hkv*kb*bs, D)
+    operand, so the cell runs 2 large MXU dots + a single vectorized flash
+    update instead of hkv*kb tiny per-head ops (the v1 shape was VPU-
+    serialization-bound: 15.7 ms/step at bs=64 — 13x off the dense attend).
+    Cross-head (off-diagonal) score tiles are masked to -inf; they waste MXU
+    flops the 8x-wider op amortizes, not bandwidth."""
     kv_refs = refs[: 2 * kb]
     idx = 2 * kb
     sinks_ref = slopes_ref = None
@@ -154,65 +186,69 @@ def _paged_attend_kernel(pos_ref, lidx_ref, bt_ref, q_ref, *refs, o_ref=None,
         l_scratch[:] = jnp.zeros_like(l_scratch)
         acc_scratch[:] = jnp.zeros_like(acc_scratch)
 
-    row_iota = jax.lax.broadcasted_iota(jnp.int32, (rows, bs), 0)
-    blk_iota = jax.lax.broadcasted_iota(jnp.int32, (rows, bs), 1)
-    for j in range(kb):
-        g = ci * kb + j                        # logical block index of this fetch
-        k_start = g * bs
-        run = k_start <= pos + t - 1           # group fully beyond the row -> skip
-        if window is not None:
-            run = jnp.logical_and(run, k_start + bs - 1 > pos - window)
+    width = kb * bs                            # kv positions fetched this cell
+    k_start = ci * width
+    run = k_start <= pos + t - 1               # cell fully beyond the row -> skip
+    if window is not None:
+        run = jnp.logical_and(run, k_start + width - 1 > pos - window)
 
-        @pl.when(run)
-        def _body(j=j, k_start=k_start):
-            q_pos = pos + row_iota % t
-            kv_pos = k_start + blk_iota
-            mask = kv_pos <= q_pos
-            if window is not None:
-                mask = jnp.logical_and(mask, kv_pos > q_pos - window)
-            for h in range(hkv):
-                r0 = h * rows
-                q = q_ref[0, h]                              # (rows, D)
-                k = kv_refs[2 * j][0, 0, h].astype(q.dtype)  # (BS, D)
-                v = kv_refs[2 * j + 1][0, 0, h].astype(q.dtype)
-                s = jax.lax.dot_general(
-                    q, k, (((1,), (1,)), ((), ())),
-                    preferred_element_type=jnp.float32) * scale
-                if slopes_ref is not None:
-                    s = s - slopes_ref[r0 : r0 + rows, 0:1] * (
-                        q_pos - kv_pos).astype(jnp.float32)
-                if soft_cap is not None:
-                    s = soft_cap * jnp.tanh(s / soft_cap)
-                s = jnp.where(mask, s, NEG_INF)
-                m_prev = m_scratch[r0 : r0 + rows, 0:1]
-                l_prev = l_scratch[r0 : r0 + rows, 0:1]
-                m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-                alpha = jnp.exp(jnp.minimum(m_prev - m_new, 0.0))
-                p = jnp.exp(s - m_new)
-                p = jnp.where(mask, p, 0.0)
-                l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
-                acc = acc_scratch[r0 : r0 + rows] * alpha + jax.lax.dot_general(
-                    p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-                    preferred_element_type=jnp.float32)
-                m_scratch[r0 : r0 + rows] = jnp.broadcast_to(m_new, (rows, 128))
-                l_scratch[r0 : r0 + rows] = jnp.broadcast_to(l_new, (rows, 128))
-                acc_scratch[r0 : r0 + rows] = acc
+    @pl.when(run)
+    def _body():
+        nrows = hkv * rows
+        # stacked operands: q (hkv*rows, D); K/V blocks concat to (hkv*width, D)
+        q = q_ref[0].reshape(nrows, q_ref.shape[-1])
+        k = jnp.concatenate([r[0, 0] for r in kv_refs[0::2]], axis=1)
+        v = jnp.concatenate([r[0, 0] for r in kv_refs[1::2]], axis=1)
+        k = _vmem_cast(k.reshape(hkv * width, k.shape[-1]), q.dtype)
+        v = _vmem_cast(v.reshape(hkv * width, v.shape[-1]), q.dtype)
+
+        row_iota = jax.lax.broadcasted_iota(jnp.int32, (nrows, hkv * width), 0)
+        col_iota = jax.lax.broadcasted_iota(jnp.int32, (nrows, hkv * width), 1)
+        # row r = head * rows + i, token index i % t; K stacking is (hkv, width)
+        # row-major, so column c belongs to kv head c // width at in-cell offset
+        # c % width
+        q_pos = pos + (row_iota % rows) % t
+        kv_pos = k_start + col_iota % width
+        same_head = (row_iota // rows) == (col_iota // width)
+        mask = jnp.logical_and(same_head, kv_pos <= q_pos)
+        if window is not None:
+            mask = jnp.logical_and(mask, kv_pos > q_pos - window)
+
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if slopes_ref is not None:
+            s = s - slopes_ref[:, 0:1] * (q_pos - kv_pos).astype(jnp.float32)
+        if soft_cap is not None:
+            s = soft_cap * jnp.tanh(s / soft_cap)
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_scratch[:, 0:1]
+        l_prev = l_scratch[:, 0:1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(jnp.minimum(m_prev - m_new, 0.0))
+        p = jnp.exp(s - m_new)
+        p = jnp.where(mask, p, 0.0)
+        l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        acc = acc_scratch[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scratch[:] = jnp.broadcast_to(m_new, m_scratch.shape)
+        l_scratch[:] = jnp.broadcast_to(l_new, l_scratch.shape)
+        acc_scratch[:] = acc
 
     @pl.when(ci == num_cells - 1)
     def _finalize():
-        for h in range(hkv):
-            r0 = h * rows
-            m = m_scratch[r0 : r0 + rows, 0:1]
-            l = l_scratch[r0 : r0 + rows, 0:1]
-            acc = acc_scratch[r0 : r0 + rows]
-            if sinks_ref is not None:
-                sink = sinks_ref[r0 : r0 + rows, 0:1]
-                m_new = jnp.maximum(m, sink)
-                alpha = jnp.exp(jnp.minimum(m - m_new, 0.0))
-                l = alpha * l + jnp.exp(sink - m_new)
-                acc = acc * alpha
-            l_safe = jnp.where(l == 0.0, 1.0, l)
-            o_ref[0, h] = (acc / l_safe).astype(o_ref.dtype)
+        m = m_scratch[:, 0:1]
+        l = l_scratch[:, 0:1]
+        acc = acc_scratch[:]
+        if sinks_ref is not None:
+            sink = sinks_ref[:, 0:1]
+            m_new = jnp.maximum(m, sink)
+            alpha = jnp.exp(jnp.minimum(m - m_new, 0.0))
+            l = alpha * l + jnp.exp(sink - m_new)
+            acc = acc * alpha
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc / l_safe).reshape(o_ref.shape[1:]).astype(o_ref.dtype)
 
 
 @functools.partial(

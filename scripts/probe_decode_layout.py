@@ -1,0 +1,124 @@
+"""Probe: kill the decode scan's s8[1,4096,4096] dynamic-slice copies by forcing
+NATURAL layouts on the stacked attention weights (VERDICT r3 #3).
+
+xplane shows XLA stores the (L, 4096, 4096) attention stacks TRANSPOSED
+({1,2,0}) and then must materialize each layer's slice per step
+(`constant_dynamic-slice_fusion`, ~0.75 ms/step at 32 layers), while the MLP
+stacks keep natural {2,1,0} layout and their slices fuse straight into the
+matmuls at ~90% of the HBM floor (scripts/probe_scan_weights2.py). Forcing
+major_to_minor=(0,1,2) on wq/wk/wv/wo should put attention on the MLP path.
+
+Run on the real chip; builds an 8-layer 8B-geometry int8+fp8KV llama at bs=64.
+"""
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+
+def step_ms_and_copies(app, input_ids, tag):
+    import shutil
+
+    import jax
+
+    from neuronx_distributed_inference_tpu.utils import profiling as prof
+
+    app.generate(input_ids, max_new_tokens=8)       # compile + warm
+    d = f"/tmp/probe_layout_{tag}"
+    shutil.rmtree(d, ignore_errors=True)
+    steps = 64
+    app.generate(input_ids, max_new_tokens=1)
+    with prof.trace(d):
+        app.generate(input_ids, max_new_tokens=steps)
+
+    import glob
+    import os
+
+    os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    tot = {}
+    for p in glob.glob(f"{d}/**/*.xplane.pb", recursive=True):
+        xs = xplane_pb2.XSpace()
+        xs.ParseFromString(open(p, "rb").read())
+        for plane in xs.planes:
+            if "TPU" not in plane.name:
+                continue
+            for line in plane.lines:
+                for ev in line.events:
+                    name = plane.event_metadata[ev.metadata_id].name
+                    tot[name] = tot.get(name, 0) + ev.duration_ps / 1e9
+    decode_ms = sum(ms for n, ms in tot.items() if "while" in n and
+                    "jit__decode" not in n)
+    dec = max((ms for n, ms in tot.items()
+               if n.startswith("jit__decode")), default=None)
+    copies = sum(ms for n, ms in tot.items() if "dynamic-slice" in n and
+                 "s8[1,4096" in n)
+    print(f"[{tag}] decode total {dec:.1f} ms / {steps} steps = "
+          f"{dec / steps:.2f} ms/step; s8 slice-copies {copies / steps:.3f} ms/step",
+          flush=True)
+    top = sorted(tot.items(), key=lambda kv: -kv[1])[:12]
+    for n, ms in top:
+        print(f"   {ms / steps:7.3f} ms/step  {n[:100]}", flush=True)
+    return dec / steps
+
+
+def main():
+    import jax
+
+    from neuronx_distributed_inference_tpu.config import (
+        QuantizationConfig, TpuConfig, load_pretrained_config)
+    from neuronx_distributed_inference_tpu.models.llama.modeling_llama import (
+        LlamaForCausalLM, LlamaInferenceConfig)
+
+    sys.path.insert(0, "/root/repo")
+    import bench
+
+    hf_cfg = {
+        "model_type": "llama", "vocab_size": 128256, "hidden_size": 4096,
+        "intermediate_size": 14336, "num_hidden_layers": 8,
+        "num_attention_heads": 32, "num_key_value_heads": 8, "head_dim": 128,
+        "max_position_embeddings": 131072, "rms_norm_eps": 1e-5,
+        "rope_theta": 500000.0,
+        "rope_scaling": {"rope_type": "llama3", "factor": 8.0,
+                         "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                         "original_max_position_embeddings": 8192},
+        "tie_word_embeddings": False,
+    }
+    batch = 64
+    quant = QuantizationConfig(quantize_weights=True, weight_dtype="int8",
+                               kv_cache_dtype="float8_e4m3")
+    tpu_cfg = TpuConfig(batch_size=batch, seq_len=512, max_context_length=256,
+                        dtype="bfloat16", tp_degree=1,
+                        context_encoding_buckets=[128, 256],
+                        token_generation_buckets=[256, 512],
+                        quantization_config=quant)
+    config = LlamaInferenceConfig(tpu_cfg, load_config=load_pretrained_config(hf_cfg))
+    app = LlamaForCausalLM(None, config)
+    t0 = time.time()
+    app.load_host_params(bench._random_quantized_llama_params(hf_cfg, seed=0))
+    print(f"load {time.time() - t0:.0f}s", flush=True)
+
+    rng = np.random.default_rng(0)
+    input_ids = rng.integers(1, hf_cfg["vocab_size"],
+                             size=(batch, 128)).astype(np.int32)
+
+    base = step_ms_and_copies(app, input_ids, "baseline")
+
+    from jax.experimental.layout import Format, Layout
+
+    for name in ("wq", "wk", "wv", "wo"):
+        leaf = app.params["layers"][name]["q"]
+        fmt = Format(Layout(major_to_minor=(0, 1, 2)), leaf.sharding)
+        app.params["layers"][name]["q"] = jax.device_put(leaf, fmt)
+        print(name, "->", app.params["layers"][name]["q"].format.layout,
+              flush=True)
+    forced = step_ms_and_copies(app, input_ids, "natural-layout")
+    print(f"baseline {base:.2f} -> natural {forced:.2f} ms/step", flush=True)
+
+
+if __name__ == "__main__":
+    main()

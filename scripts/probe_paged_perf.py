@@ -1,0 +1,113 @@
+"""Probe: paged continuous-batching decode vs dense decode at the same config
+(VERDICT r3 #2 — paged must reach >=70% of dense).
+
+8-layer 8B-geometry int8+fp8KV llama at bs=64; measures the dense fixed-batch
+chunked decode and the ContinuousBatchingRunner paged step, both device-timed,
+and dumps the paged step's top ops so the gap is attributable.
+"""
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+
+def xplane_table(trace_dir):
+    import glob
+    import os
+
+    os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    tot = {}
+    for p in glob.glob(f"{trace_dir}/**/*.xplane.pb", recursive=True):
+        xs = xplane_pb2.XSpace()
+        xs.ParseFromString(open(p, "rb").read())
+        for plane in xs.planes:
+            if "TPU" not in plane.name:
+                continue
+            for line in plane.lines:
+                for ev in line.events:
+                    name = plane.event_metadata[ev.metadata_id].name
+                    tot[name] = tot.get(name, 0) + ev.duration_ps / 1e9
+    return tot
+
+
+def main():
+    from neuronx_distributed_inference_tpu.config import (
+        QuantizationConfig, TpuConfig, load_pretrained_config)
+    from neuronx_distributed_inference_tpu.models.llama.modeling_llama import (
+        LlamaForCausalLM, LlamaInferenceConfig)
+    from neuronx_distributed_inference_tpu.runtime.continuous_batching import (
+        ContinuousBatchingRunner)
+    from neuronx_distributed_inference_tpu.utils import profiling as prof
+
+    import bench
+    import shutil
+
+    hf_cfg = {
+        "model_type": "llama", "vocab_size": 128256, "hidden_size": 4096,
+        "intermediate_size": 14336, "num_hidden_layers": 8,
+        "num_attention_heads": 32, "num_key_value_heads": 8, "head_dim": 128,
+        "max_position_embeddings": 131072, "rms_norm_eps": 1e-5,
+        "rope_theta": 500000.0,
+        "rope_scaling": {"rope_type": "llama3", "factor": 8.0,
+                         "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                         "original_max_position_embeddings": 8192},
+        "tie_word_embeddings": False,
+    }
+    batch, seq, block = 64, 1024, int(__import__("os").environ.get("PROBE_BLOCK", 128))
+    quant = QuantizationConfig(quantize_weights=True, weight_dtype="int8",
+                               kv_cache_dtype=__import__("os").environ.get("PROBE_KVD", "float8_e4m3"))
+    cfg = TpuConfig(batch_size=batch, seq_len=seq, max_context_length=256,
+                    dtype="bfloat16", tp_degree=1,
+                    context_encoding_buckets=[256],
+                    token_generation_buckets=[seq],
+                    is_continuous_batching=True, paged_attention_enabled=True,
+                    pa_num_blocks=batch * (seq // block) + 8, pa_block_size=block,
+                    quantization_config=quant)
+    config = LlamaInferenceConfig(cfg, load_config=load_pretrained_config(hf_cfg))
+    app = LlamaForCausalLM(None, config)
+    t0 = time.time()
+    app.load_host_params(bench._random_quantized_llama_params(hf_cfg, seed=0))
+    print(f"load {time.time() - t0:.0f}s; paged kernels: "
+          f"{app._use_paged_decode_kernel()}", flush=True)
+
+    runner = ContinuousBatchingRunner(app, decode_chunk=32)
+    rng = np.random.default_rng(0)
+    for _ in range(batch):
+        runner.submit(rng.integers(1, 100000, size=(200,)).astype(np.int32),
+                      max_new_tokens=700)
+    t0 = time.time()
+    for _ in range(3):
+        runner.step()
+    print(f"place+warm {time.time() - t0:.0f}s", flush=True)
+
+    t0 = time.time()
+    n = 0
+    for _ in range(6):
+        runner.step()
+        n += 32
+    wall = time.time() - t0
+    print(f"paged wall: {batch * n / wall:.0f} tok/s "
+          f"({1000 * wall / n:.2f} ms/step)", flush=True)
+
+    d = "/tmp/probe_paged_trace"
+    shutil.rmtree(d, ignore_errors=True)
+    with prof.trace(d):
+        for _ in range(2):
+            runner.step()
+    tot = xplane_table(d)
+    steps = 64
+    dec = max((ms for name, ms in tot.items() if name.startswith("jit__decode")),
+              default=0.0)
+    print(f"paged decode device: {dec / steps:.2f} ms/step "
+          f"-> {batch * 1000 / (dec / steps):.0f} tok/s device-limit", flush=True)
+    for name, ms in sorted(tot.items(), key=lambda kv: -kv[1])[:14]:
+        print(f"   {ms / steps:7.3f} ms/step  {name[:100]}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -8,18 +8,9 @@ accelerator hardware by forcing the host platform to expose 8 devices.
 import os
 import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-xla_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in xla_flags:
-    os.environ["XLA_FLAGS"] = (
-        xla_flags + " --xla_force_host_platform_device_count=8").strip()
-
-import jax  # noqa: E402
-
-# the environment's TPU plugin overrides JAX_PLATFORMS; force CPU explicitly
-jax.config.update("jax_platforms", "cpu")
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import _tpu_test_bootstrap  # noqa: F401,E402  (side effect: CPU mesh)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -61,3 +61,36 @@ def test_batched_gather_reorders_sequences():
     swapped = kvcache.batched_gather(cache, jnp.asarray([1, 0]))
     np.testing.assert_array_equal(np.asarray(swapped["k"][:, 0]), 2.0)
     np.testing.assert_array_equal(np.asarray(swapped["k"][:, 1]), 1.0)
+
+
+def test_fp8_cache_writes_saturate_outliers():
+    """Values past the fp8 range must SATURATE at every cache-write path, not
+    overflow to NaN (e4m3fn) / Inf (e5m2) — the kernels' fast fp8 decode
+    assumes finite payloads, so an overflow would surface as silently wrong
+    logits rather than NaN."""
+    import jax.numpy as jnp
+    import ml_dtypes
+
+    from neuronx_distributed_inference_tpu.modules import kvcache
+    from neuronx_distributed_inference_tpu.modules.block_kvcache import (
+        write_slots)
+
+    for dt in (jnp.float8_e4m3fn, jnp.float8_e5m2):
+        fmax = float(ml_dtypes.finfo(dt).max)
+        x = jnp.array([10 * fmax, -10 * fmax, 3.5, 0.0], jnp.float32)
+        out = np.asarray(kvcache.to_cache_dtype(x, dt)).astype(np.float32)
+        assert np.isfinite(out).all(), dt
+        assert out[0] == fmax and out[1] == -fmax
+
+    # through the dense prefill write
+    cache = jnp.zeros((2, 2, 8, 4), jnp.float8_e4m3fn)
+    new = jnp.full((2, 2, 3, 4), 1e6, jnp.float32)
+    written = np.asarray(kvcache.write_prefill(cache, new)).astype(np.float32)
+    assert np.isfinite(written).all()
+
+    # through the paged slot write
+    pool = jnp.zeros((4, 2, 8, 4), jnp.float8_e4m3fn)
+    newp = jnp.full((1, 2, 2, 4), -1e6, jnp.float32)
+    slots = jnp.array([[0, 1]], jnp.int32)
+    writtenp = np.asarray(write_slots(pool, newp, slots)).astype(np.float32)
+    assert np.isfinite(writtenp).all()
