@@ -332,6 +332,9 @@ def main() -> None:
         try:
             paged = _paged_serving_throughput(hf_cfg, quant, batch)
             extra["paged_serving_tok_per_s"] = paged
+            # mode-matched ratio: the paged runner dispatches synchronously, so
+            # compare against the dense SYNC number (tok_per_s), not the async
+            # headline
             extra["paged_vs_dense"] = round(paged / tok_per_s, 3)
         except Exception as e:
             _note(f"paged phase failed: {e}")

@@ -1233,6 +1233,44 @@ def _run_stack_pattern(params: Params, args: ModelArchArgs, h, ctx_full, ctx_sli
     return h, out
 
 
+def _run_stack_paged_gather(params: Params, args: ModelArchArgs, h, cos, sin,
+                            mask, cache, positions, decode_bucket, block_table,
+                            slot_mapping, mesh, rules, adapter_ids=None,
+                            attn_bias=None):
+    """Paged gather-path layer scan with the block pool as a scan CARRY.
+
+    The generic `_run_stack` feeds the pool through scan xs/ys, which stacks a
+    full second copy of the (L, NB, H, BS, D) pool for the ys output — at
+    bs=64 x 32 layers that is +4.4 GB and OOMs the chip (measured: the paged
+    insert graph hit 16.23/15.75 GB HBM). Carrying the pool and updating one
+    layer per step via dynamic_update_index keeps the peak at pool + one
+    transient layer slice. Used by the paged INSERT (wide prefix-prefill
+    queries) and any paged decode the Pallas kernel declines."""
+    L = args.num_layers
+    has_scales = "k_scale" in cache
+
+    def body(carry, xs):
+        carry_h, ck, cv = carry
+        lp, li = xs
+        kvs = ((jnp.take(cache["k_scale"], li, axis=0),
+                jnp.take(cache["v_scale"], li, axis=0)) if has_scales else None)
+        kc = jax.lax.dynamic_index_in_dim(ck, li, 0, keepdims=False)
+        vc = jax.lax.dynamic_index_in_dim(cv, li, 0, keepdims=False)
+        new_h, kc, vc = _decoder_layer(lp, args, carry_h, cos, sin, mask, kc, vc,
+                                       positions, decode_bucket, mesh, rules,
+                                       paged=(block_table, slot_mapping),
+                                       adapter_ids=adapter_ids,
+                                       attn_bias=attn_bias, kv_scales=kvs)
+        ck = jax.lax.dynamic_update_index_in_dim(ck, kc, li, 0)
+        cv = jax.lax.dynamic_update_index_in_dim(cv, vc, li, 0)
+        return (new_h, ck, cv), ()
+
+    (h, k_new, v_new), _ = jax.lax.scan(
+        body, (h, cache["k"], cache["v"]),
+        (params["layers"], jnp.arange(L, dtype=jnp.int32)))
+    return h, {**cache, "k": k_new, "v": v_new}
+
+
 def _run_stack_pattern_decode_kernel(params: Params, args: ModelArchArgs, h,
                                      ctx_full, ctx_slide, cache, positions,
                                      decode_bucket, mesh, rules,
@@ -1667,6 +1705,18 @@ def decode_forward(
                          "verify) are supported")
     attn_bias = (_alibi_bias(params["alibi_slopes"], q_pos, kv_pos)
                  if args.alibi else None)
+    if paged is not None and not capture_layers:
+        # pool rides as a scan carry — the generic xs/ys path would stack a
+        # second full pool copy (OOM at serving scale; see _run_stack_paged_gather)
+        h, cache = _run_stack_paged_gather(
+            params, args, h, cos, sin, mask, cache, position_ids, decode_bucket,
+            block_table, slot_mapping, mesh, rules, adapter_ids=adapter_ids,
+            attn_bias=attn_bias)
+        h = _norm(h, params["final_norm"], args, params.get("final_norm_b"))
+        logits = _lm_head(params, args, h, mesh, rules)
+        if return_hidden:
+            return logits, cache, h
+        return logits, cache
     out = _run_stack(params, args, h, cos, sin, mask, cache,
                      positions=position_ids, decode_bucket=decode_bucket,
                      mesh=mesh, rules=rules,

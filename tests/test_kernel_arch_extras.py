@@ -172,8 +172,9 @@ def test_bloom_takes_kernel_paths_with_same_tokens():
 
 
 def test_gpt_oss_flash_prefill_allowed():
-    """Sinks + SWA arch: the prefill flash kernel is no longer gated off (decode
-    keeps the rolling-cache path due to layer_pattern — still reported)."""
+    """Sinks + SWA arch: both the prefill flash kernel AND (since the round-4
+    rolling-kernel lift, models/base._run_stack_pattern_decode_kernel) the
+    stacked decode kernel serve the sliding/full layer pattern."""
     from neuronx_distributed_inference_tpu.models.gpt_oss.modeling_gpt_oss import (
         GptOssForCausalLM)
 
@@ -192,9 +193,11 @@ def test_gpt_oss_flash_prefill_allowed():
         tpu_cfg, load_config=load_pretrained_config(hf_cfg))
     app = GptOssForCausalLM(None, config)
     assert app._use_flash_attention() is True
-    with pytest.raises(ValueError, match="per-layer attention patterns"):
-        # decode kernel remains honestly gated on the rolling-cache layout
-        cfg2 = TpuConfig(batch_size=2, seq_len=64, max_context_length=32,
-                         dtype="float32", decode_kernel_enabled=True)
-        GptOssForCausalLM(None, GptOssForCausalLM.get_config_cls()(
-            cfg2, load_config=load_pretrained_config(hf_cfg)))._use_decode_kernel()
+    # the rolling-cache decode gate is lifted: explicit opt-in now selects the
+    # pattern kernel path (parity pinned in tests/test_rolling_cache.py)
+    cfg2 = TpuConfig(batch_size=2, seq_len=64, max_context_length=32,
+                     dtype="float32", decode_kernel_enabled=True)
+    app2 = GptOssForCausalLM(None, GptOssForCausalLM.get_config_cls()(
+        cfg2, load_config=load_pretrained_config(hf_cfg)))
+    assert app2._use_decode_kernel() is True
+    assert app2._use_paged_decode_kernel() is False   # rolling stacks don't page
