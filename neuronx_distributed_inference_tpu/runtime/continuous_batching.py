@@ -58,7 +58,8 @@ class Request:
 class ContinuousBatchingRunner:
     """Slot-based continuous batching engine over a `TpuModelForCausalLM`."""
 
-    def __init__(self, app, decode_chunk: Optional[int] = None):
+    def __init__(self, app, decode_chunk: Optional[int] = None,
+                 async_mode: Optional[bool] = None):
         cfg = app.tpu_config
         if not cfg.is_continuous_batching:
             raise ValueError("tpu_config.is_continuous_batching must be enabled")
@@ -71,6 +72,16 @@ class ContinuousBatchingRunner:
         self.num_slots = cfg.max_batch_size
         self.decode_chunk = decode_chunk or min(8, max(1, cfg.decode_chunk_size))
         self.sampling_config = app.sampling_config
+        # async dispatch-ahead (≈ application.generate's async_mode and the
+        # reference's 2-deep async decode, `modules/async_execution.py:190-306`):
+        # in steady state chunk N+1 is dispatched from chunk N's device-resident
+        # last tokens BEFORE N is synced, hiding the per-chunk host round trip.
+        # Only entered when provably safe (no placements pending, no row with an
+        # eos stop, every row >2 chunks from its max/seq bound, block headroom);
+        # anything else drains the pipeline and runs the exact sync path, so
+        # emitted-token semantics only ever LAG by one chunk, never change.
+        self.async_mode = (cfg.async_mode if async_mode is None else async_mode)
+        self._pending = None                   # (toks_dev (slots, steps), steps)
 
         # host-side greedy detection (== application.generate's): every slot
         # argmax -> the decode chunk compiles without the dynamic sampling
@@ -283,14 +294,73 @@ class ContinuousBatchingRunner:
     def has_work(self) -> bool:
         return bool(self.queue) or any(r is not None for r in self.active)
 
+    def _async_ok(self, extra_steps: int) -> bool:
+        """True when dispatch-ahead is provably exact for the next chunk(s):
+        no queued placements, no row that could stop (eos or max/seq bound)
+        within ``extra_steps``, and (paged) enough free blocks that growth
+        cannot preempt while a chunk is in flight."""
+        if not self.async_mode or self.queue:
+            return False
+        rows = [r for r in self.active if r is not None and not r.done]
+        if not rows:
+            return False
+        # bound by ACTIVE rows only: finished slots keep their frozen position
+        # (possibly seq_len-1), which must not cap live rows
+        if max(r.position for r in rows) + extra_steps >= self.cfg.seq_len - 1:
+            return False
+        for r in rows:
+            if r.eos_token_id is not None:
+                return False
+            if len(r.generated) + extra_steps >= r.max_new_tokens:
+                return False
+        if self.paged:
+            worst = len(rows) * (-(-extra_steps // self.block_size) + 1)
+            if self.allocator.num_free < worst:
+                return False
+        return True
+
+    def _drain(self, emitted: Dict[int, List[int]]) -> None:
+        """Sync + commit the in-flight chunk (no-op when nothing is pending)."""
+        if self._pending is None:
+            return
+        toks_dev, steps = self._pending
+        self._pending = None
+        self._commit(np.asarray(toks_dev), steps, emitted)
+
+    def _commit(self, toks: np.ndarray, steps: int,
+                emitted: Dict[int, List[int]]) -> None:
+        """Fold one synced chunk's tokens (slots, steps) into request state."""
+        for slot, req in enumerate(self.active):
+            if req is None or req.done:
+                continue
+            for j in range(steps):
+                t = int(toks[slot, j])
+                req.generated.append(t)
+                req.position += 1
+                emitted.setdefault(req.request_id, []).append(t)
+                if ((req.eos_token_id is not None and t == req.eos_token_id)
+                        or len(req.generated) >= req.max_new_tokens):
+                    break
+            self.positions[slot] = req.position
+            self.last_tok[slot] = req.generated[-1]
+            self._maybe_finish(req, emitted)
+
     def step(self, key: Optional[jax.Array] = None) -> Dict[int, List[int]]:
         """Place queued requests into free slots, then run one decode chunk.
 
-        Returns {request_id: newly generated tokens} for this step.
+        Returns {request_id: newly generated tokens} for this step (in
+        async steady state the tokens lag one chunk behind the dispatches).
         """
         if key is None:
             self._key, key = jax.random.split(self._key)
         emitted: Dict[int, List[int]] = {}
+
+        # leaving steady state (placements pending, a row near a stop bound, or
+        # async off) drains the pipeline first so the sync path sees exact state
+        if self._pending is not None and (
+                self.queue or not self._async_ok(
+                    self._pending[1] + 2 * self.decode_chunk)):
+            self._drain(emitted)
 
         # --- placement (≈ CTE dispatch for new seq_ids) -------------------------
         for slot in range(self.num_slots):
@@ -322,14 +392,26 @@ class ContinuousBatchingRunner:
 
         active_rows = [r for r in self.active if r is not None]
         if not active_rows:
+            self._drain(emitted)
             return emitted
 
         # --- one decode chunk for every slot ------------------------------------
+        # while a chunk is in flight, the dispatch state is the committed state
+        # advanced uniformly by its width (_async_ok guarantees no row stops
+        # mid-pipeline, so the advance is exact); its last tokens feed the next
+        # chunk as a DEVICE array — no host sync on the hot path
         chunk = self.decode_chunk
-        max_pos = int(self.positions.max())
+        pend_steps = self._pending[1] if self._pending is not None else 0
+        positions = self.positions + pend_steps
+        # room is bounded by the LIVE rows; finished slots keep a frozen
+        # position (possibly seq_len-1) that must not truncate active requests
+        live = [r for r in active_rows if not r.done]
+        max_pos = (max(r.position for r in live) + pend_steps if live
+                   else int(positions.max()))
         steps = min(chunk, self.cfg.seq_len - 1 - max_pos)
         if steps <= 0:
             # longest row is out of seq_len room; force-finish (truncate) it
+            self._drain(emitted)
             victim = max(active_rows, key=lambda r: r.position)
             victim.truncated = True
             self._finish(victim)
@@ -337,41 +419,36 @@ class ContinuousBatchingRunner:
         valid = np.array([r is not None and not r.done for r in self.active])
         key, sub = jax.random.split(key)
         sp = self._sampling_matrix()
+        tok0 = (self._pending[0][:, -1] if self._pending is not None
+                else jnp.asarray(self.last_tok))
         if self.paged:
-            active_rows = self._grow_blocks(active_rows, steps)
+            active_rows = self._grow_blocks(active_rows, pend_steps + steps)
             if not active_rows:
+                self._drain(emitted)
                 return emitted
             valid = np.array([r is not None and not r.done for r in self.active])
             slot_chunk = self._slot_mapping_fn(
-                self.block_table, self.positions, steps, self.block_size, valid=valid)
+                self.block_table, positions, steps, self.block_size, valid=valid)
             toks_dev, self.cache = self._decode_step(
-                self.app.params, jnp.asarray(self.last_tok),
-                jnp.asarray(self.positions), self.cache,
+                self.app.params, tok0,
+                jnp.asarray(positions), self.cache,
                 jnp.asarray(self.block_table), jnp.asarray(slot_chunk), sp, sub,
                 num_steps=steps, greedy=self._greedy)
         else:
             bucket = autobucketing.select_bucket(self.app.tkg_buckets,
                                                  max_pos + steps)
             toks_dev, self.cache = self._decode_step(
-                self.app.params, jnp.asarray(self.last_tok),
-                jnp.asarray(self.positions), self.cache, sp, sub,
+                self.app.params, tok0,
+                jnp.asarray(positions), self.cache, sp, sub,
                 decode_bucket=bucket, num_steps=steps, greedy=self._greedy)
-        toks = np.asarray(toks_dev)                     # (slots, steps)
 
-        for slot, req in enumerate(self.active):
-            if req is None or req.done:
-                continue
-            for j in range(steps):
-                t = int(toks[slot, j])
-                req.generated.append(t)
-                req.position += 1
-                emitted.setdefault(req.request_id, []).append(t)
-                if ((req.eos_token_id is not None and t == req.eos_token_id)
-                        or len(req.generated) >= req.max_new_tokens):
-                    break
-            self.positions[slot] = req.position
-            self.last_tok[slot] = req.generated[-1]
-            self._maybe_finish(req, emitted)
+        if self._async_ok(pend_steps + steps + chunk):
+            prior, self._pending = self._pending, (toks_dev, steps)
+            if prior is not None:
+                self._commit(np.asarray(prior[0]), prior[1], emitted)
+        else:
+            self._drain(emitted)                       # older chunk commits first
+            self._commit(np.asarray(toks_dev), steps, emitted)
         return emitted
 
     def run_to_completion(self, seed: int = 0) -> Dict[int, List[int]]:

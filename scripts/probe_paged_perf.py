@@ -85,14 +85,22 @@ def main():
         runner.step()
     print(f"place+warm {time.time() - t0:.0f}s", flush=True)
 
-    t0 = time.time()
-    n = 0
-    for _ in range(6):
-        runner.step()
-        n += 32
-    wall = time.time() - t0
-    print(f"paged wall: {batch * n / wall:.0f} tok/s "
-          f"({1000 * wall / n:.2f} ms/step)", flush=True)
+    def measure(tag, n_chunks=6):
+        t0 = time.time()
+        n = 0
+        for _ in range(n_chunks):
+            runner.step()
+            n += runner.decode_chunk
+        wall = time.time() - t0
+        print(f"paged wall [{tag}]: {batch * n / wall:.0f} tok/s "
+              f"({1000 * wall / n:.2f} ms/step)", flush=True)
+
+    measure("sync")
+    runner.async_mode = True
+    t0 = time.time(); runner.step(); print(f"fill {time.time()-t0:.2f}s", flush=True)
+    t0 = time.time(); runner.step(); print(f"async step1 {time.time()-t0:.2f}s", flush=True)
+    measure("async")
+    runner.async_mode = False
 
     d = "/tmp/probe_paged_trace"
     shutil.rmtree(d, ignore_errors=True)

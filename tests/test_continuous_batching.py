@@ -196,3 +196,72 @@ def test_allocator_exhaustion_raises():
     alloc.allocate_for_prompt(np.arange(4))   # 1 full + 1 next-token block
     with pytest.raises(RuntimeError, match="out of KV blocks"):
         alloc.allocate_for_prompt(np.arange(4))
+
+
+def test_async_dispatch_ahead_matches_sync(tiny_llama_hf_config, prompts):
+    """Async dispatch-ahead (chunk N+1 dispatched from chunk N's device-resident
+    tokens) must emit exactly the sync path's tokens — it only ever LAGS by one
+    chunk in steady state and drains to the exact sync path at every boundary."""
+    ref_app = _make_app(tiny_llama_hf_config, paged=True, slots=2)
+    ref = ContinuousBatchingRunner(ref_app, decode_chunk=4)
+    for p in prompts:
+        ref.submit(p, max_new_tokens=24)
+    want = ref.run_to_completion(seed=0)
+
+    app = _make_app(tiny_llama_hf_config, paged=True, slots=2)
+    runner = ContinuousBatchingRunner(app, decode_chunk=4, async_mode=True)
+    for p in prompts:
+        runner.submit(p, max_new_tokens=24)
+    got = runner.run_to_completion(seed=0)
+    assert got == want
+
+
+def test_async_dispatch_ahead_with_eos_falls_back(tiny_llama_hf_config, prompts):
+    """Rows carrying an eos stop keep exact sync semantics (the safety gate
+    refuses to pipeline them)."""
+    ref_app = _make_app(tiny_llama_hf_config, paged=True, slots=2)
+    ref = ContinuousBatchingRunner(ref_app, decode_chunk=4)
+    for p in prompts:
+        ref.submit(p, max_new_tokens=16, eos_token_id=7)
+    want = ref.run_to_completion(seed=0)
+
+    app = _make_app(tiny_llama_hf_config, paged=True, slots=2)
+    runner = ContinuousBatchingRunner(app, decode_chunk=4, async_mode=True)
+    for p in prompts:
+        runner.submit(p, max_new_tokens=16, eos_token_id=7)
+    got = runner.run_to_completion(seed=0)
+    assert got == want
+
+
+def test_async_dispatch_ahead_dense_matches_sync(tiny_llama_hf_config, prompts):
+    """The DENSE (non-paged) continuous-batching path pipelines too."""
+    ref_app = _make_app(tiny_llama_hf_config, paged=False, slots=2)
+    ref = ContinuousBatchingRunner(ref_app, decode_chunk=4)
+    for p in prompts:
+        ref.submit(p, max_new_tokens=24)
+    want = ref.run_to_completion(seed=0)
+
+    app = _make_app(tiny_llama_hf_config, paged=False, slots=2)
+    runner = ContinuousBatchingRunner(app, decode_chunk=4, async_mode=True)
+    for p in prompts:
+        runner.submit(p, max_new_tokens=24)
+    got = runner.run_to_completion(seed=0)
+    assert got == want
+
+
+def test_finished_slot_at_seq_end_does_not_truncate_others(tiny_llama_hf_config):
+    """A request that legitimately ends at position seq_len-1 must not cap the
+    step budget of unrelated active rows (frozen finished-slot positions used
+    to feed max_pos, spuriously truncating everyone else)."""
+    rng = np.random.default_rng(0)
+    app = _make_app(tiny_llama_hf_config, paged=True, slots=2)
+    runner = ContinuousBatchingRunner(app, decode_chunk=4)
+    # seq_len is 96 in _make_app: row A fills the whole sequence
+    runner.submit(rng.integers(1, 256, size=(31,)).astype(np.int32),
+                  max_new_tokens=65)
+    runner.submit(rng.integers(1, 256, size=(8,)).astype(np.int32),
+                  max_new_tokens=40)
+    out = runner.run_to_completion(seed=0)
+    a, b = runner.finished[0], runner.finished[1]
+    assert len(a.generated) == 65
+    assert not b.truncated and len(b.generated) == 40
