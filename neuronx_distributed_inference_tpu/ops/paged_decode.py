@@ -157,28 +157,31 @@ def write_paged_stacked_kv(
 
 def _paged_attend_kernel(pos_ref, lidx_ref, bt_ref, q_ref, *refs, o_ref=None,
                          m_scratch=None, l_scratch=None, acc_scratch=None,
-                         scale: float, bs: int, kb: int, num_cells: int, t: int,
+                         scale: float, bs: int, kb: int, bb: int,
+                         num_cells: int, t: int,
                          rows: int, hkv: int, window: Optional[int],
                          soft_cap: Optional[float], has_sinks: bool,
                          has_slopes: bool):
-    """Block-diagonal head packing: every kv head's q rows stack into ONE
-    (hkv*rows, D) operand and the cell's kv blocks into ONE (hkv*kb*bs, D)
-    operand, so the cell runs 2 large MXU dots + a single vectorized flash
-    update instead of hkv*kb tiny per-head ops (the v1 shape was VPU-
-    serialization-bound: 15.7 ms/step at bs=64 — 13x off the dense attend).
-    Cross-head (off-diagonal) score tiles are masked to -inf; they waste MXU
-    flops the 8x-wider op amortizes, not bandwidth."""
-    kv_refs = refs[: 2 * kb]
-    idx = 2 * kb
+    """Block-diagonal head packing over ``bb`` batch rows per grid cell.
+
+    Per row: every kv head's q rows stack into ONE (hkv*rows, D) operand and
+    the cell's kv blocks into ONE (hkv*width, D) operand, so each row costs
+    2 large MXU dots + a single vectorized flash update instead of hkv*kb tiny
+    per-head ops (v1 was VPU-serialization-bound: 15.7 ms/step at bs=64).
+    Cross-head (off-diagonal) score tiles are masked to -inf — wasted MXU
+    flops that the 8x-wider op amortizes, not bandwidth. Batching ``bb`` rows
+    per cell amortizes the per-cell grid fixed cost (v2 at bb=1 measured
+    ~12 us/cell with only ~3 us of real work)."""
+    kv_refs = refs[: 2 * kb * bb]
+    idx = 2 * kb * bb
     sinks_ref = slopes_ref = None
     if has_sinks:
         sinks_ref, idx = refs[idx], idx + 1
     if has_slopes:
         slopes_ref, idx = refs[idx], idx + 1
 
-    b = pl.program_id(0)
+    bi = pl.program_id(0)
     ci = pl.program_id(1)
-    pos = pos_ref[b]
 
     @pl.when(ci == 0)
     def _init():
@@ -186,69 +189,77 @@ def _paged_attend_kernel(pos_ref, lidx_ref, bt_ref, q_ref, *refs, o_ref=None,
         l_scratch[:] = jnp.zeros_like(l_scratch)
         acc_scratch[:] = jnp.zeros_like(acc_scratch)
 
-    width = kb * bs                            # kv positions fetched this cell
+    width = kb * bs                            # kv positions fetched per row
     k_start = ci * width
-    run = k_start <= pos + t - 1               # cell fully beyond the row -> skip
-    if window is not None:
-        run = jnp.logical_and(run, k_start + width - 1 > pos - window)
+    nrows = hkv * rows
+    d = q_ref.shape[-1]
 
-    @pl.when(run)
-    def _body():
-        nrows = hkv * rows
-        # stacked operands: q (hkv*rows, D); K/V blocks concat to (hkv*width, D)
-        q = q_ref[0].reshape(nrows, q_ref.shape[-1])
-        k = jnp.concatenate([r[0, 0] for r in kv_refs[0::2]], axis=1)
-        v = jnp.concatenate([r[0, 0] for r in kv_refs[1::2]], axis=1)
-        k = _vmem_cast(k.reshape(hkv * width, k.shape[-1]), q.dtype)
-        v = _vmem_cast(v.reshape(hkv * width, v.shape[-1]), q.dtype)
-
-        row_iota = jax.lax.broadcasted_iota(jnp.int32, (nrows, hkv * width), 0)
-        col_iota = jax.lax.broadcasted_iota(jnp.int32, (nrows, hkv * width), 1)
-        # row r = head * rows + i, token index i % t; K stacking is (hkv, width)
-        # row-major, so column c belongs to kv head c // width at in-cell offset
-        # c % width
-        q_pos = pos + (row_iota % rows) % t
-        kv_pos = k_start + col_iota % width
-        same_head = (row_iota // rows) == (col_iota // width)
-        mask = jnp.logical_and(same_head, kv_pos <= q_pos)
+    for j in range(bb):                        # static unroll over batch rows
+        pos = pos_ref[bi * bb + j]
+        run = k_start <= pos + t - 1           # cell fully beyond the row -> skip
         if window is not None:
-            mask = jnp.logical_and(mask, kv_pos > q_pos - window)
+            run = jnp.logical_and(run, k_start + width - 1 > pos - window)
+        r0 = j * nrows
 
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if slopes_ref is not None:
-            s = s - slopes_ref[:, 0:1] * (q_pos - kv_pos).astype(jnp.float32)
-        if soft_cap is not None:
-            s = soft_cap * jnp.tanh(s / soft_cap)
-        s = jnp.where(mask, s, NEG_INF)
+        @pl.when(run)
+        def _body(j=j, pos=pos, r0=r0):
+            q = q_ref[j].reshape(nrows, d)
+            k = jnp.concatenate(
+                [kv_refs[2 * (j * kb + g)][0, 0] for g in range(kb)], axis=1)
+            v = jnp.concatenate(
+                [kv_refs[2 * (j * kb + g) + 1][0, 0] for g in range(kb)], axis=1)
+            k = _vmem_cast(k.reshape(hkv * width, d), q.dtype)
+            v = _vmem_cast(v.reshape(hkv * width, d), q.dtype)
 
-        m_prev = m_scratch[:, 0:1]
-        l_prev = l_scratch[:, 0:1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        alpha = jnp.exp(jnp.minimum(m_prev - m_new, 0.0))
-        p = jnp.exp(s - m_new)
-        p = jnp.where(mask, p, 0.0)
-        l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
-        acc = acc_scratch[:] * alpha + jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        m_scratch[:] = jnp.broadcast_to(m_new, m_scratch.shape)
-        l_scratch[:] = jnp.broadcast_to(l_new, l_scratch.shape)
-        acc_scratch[:] = acc
+            row_iota = jax.lax.broadcasted_iota(jnp.int32, (nrows, hkv * width), 0)
+            col_iota = jax.lax.broadcasted_iota(jnp.int32, (nrows, hkv * width), 1)
+            # row r = head * rows + i, token index i % t; K stacking is
+            # (hkv, width) row-major, so column c belongs to kv head c // width
+            # at in-cell offset c % width
+            q_pos = pos + (row_iota % rows) % t
+            kv_pos = k_start + col_iota % width
+            same_head = (row_iota // rows) == (col_iota // width)
+            mask = jnp.logical_and(same_head, kv_pos <= q_pos)
+            if window is not None:
+                mask = jnp.logical_and(mask, kv_pos > q_pos - window)
+
+            s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32) * scale
+            if slopes_ref is not None:
+                s = s - slopes_ref[:, 0:1] * (q_pos - kv_pos).astype(jnp.float32)
+            if soft_cap is not None:
+                s = soft_cap * jnp.tanh(s / soft_cap)
+            s = jnp.where(mask, s, NEG_INF)
+
+            m_prev = m_scratch[r0 : r0 + nrows, 0:1]
+            l_prev = l_scratch[r0 : r0 + nrows, 0:1]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+            alpha = jnp.exp(jnp.minimum(m_prev - m_new, 0.0))
+            p = jnp.exp(s - m_new)
+            p = jnp.where(mask, p, 0.0)
+            l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+            acc = acc_scratch[r0 : r0 + nrows] * alpha + jax.lax.dot_general(
+                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            m_scratch[r0 : r0 + nrows] = jnp.broadcast_to(m_new, (nrows, 128))
+            l_scratch[r0 : r0 + nrows] = jnp.broadcast_to(l_new, (nrows, 128))
+            acc_scratch[r0 : r0 + nrows] = acc
 
     @pl.when(ci == num_cells - 1)
     def _finalize():
-        m = m_scratch[:, 0:1]
-        l = l_scratch[:, 0:1]
-        acc = acc_scratch[:]
-        if sinks_ref is not None:
-            sink = sinks_ref[:, 0:1]
-            m_new = jnp.maximum(m, sink)
-            alpha = jnp.exp(jnp.minimum(m - m_new, 0.0))
-            l = alpha * l + jnp.exp(sink - m_new)
-            acc = acc * alpha
-        l_safe = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0] = (acc / l_safe).reshape(o_ref.shape[1:]).astype(o_ref.dtype)
+        for j in range(bb):
+            r0 = j * nrows
+            m = m_scratch[r0 : r0 + nrows, 0:1]
+            l = l_scratch[r0 : r0 + nrows, 0:1]
+            acc = acc_scratch[r0 : r0 + nrows]
+            if sinks_ref is not None:
+                sink = sinks_ref[:, 0:1]
+                m_new = jnp.maximum(m, sink)
+                alpha = jnp.exp(jnp.minimum(m - m_new, 0.0))
+                l = alpha * l + jnp.exp(sink - m_new)
+                acc = acc * alpha
+            l_safe = jnp.where(l == 0.0, 1.0, l)
+            o_ref[j] = (acc / l_safe).reshape(o_ref.shape[1:]).astype(o_ref.dtype)
 
 
 @functools.partial(
@@ -296,32 +307,44 @@ def paged_decode_attention_stacked(
     while mb % kb != 0:
         kb -= 1
     num_cells = mb // kb
+    # batch rows per cell: amortizes the per-cell grid fixed cost further
+    # (bounded by VMEM: 2*bb*kb KV refs resident per cell)
+    bb = 1
+    kv_itemsize = jnp.dtype(k_cache.dtype).itemsize
+    for cand in (4, 2):
+        if (b % cand == 0
+                and 2 * cand * kb * hkv * bs * d * kv_itemsize <= 6 * 2 ** 20):
+            bb = cand
+            break
 
-    def _kv_index_map(j):
+    def _kv_index_map(j, g):
         def index_map(bi, ci, pos, lidx, bt):
-            g = ci * kb + j
+            row = bi * bb + j
+            gg = ci * kb + g
             # clamp out-of-range fetches to the nearest live block — beyond-live
             # groups to the last live block (this step's fresh tokens reach
             # pos + t - 1) and, under a sliding window, below-window groups to the
             # first in-window block: the repeated (layer, block) tuple matches the
             # neighbouring grid step, so Mosaic elides the DMA and HBM traffic
             # tracks the live (windowed) length, not the table width
-            last_live = (pos[bi] + t - 1) // bs
-            g = jnp.minimum(g, last_live)
+            last_live = (pos[row] + t - 1) // bs
+            gg = jnp.minimum(gg, last_live)
             if window is not None:
-                first_live = jnp.maximum(pos[bi] - (window - 1), 0) // bs
-                g = jnp.maximum(g, jnp.minimum(first_live, last_live))
-            return (lidx[0], bt[bi, g], 0, 0, 0)
+                first_live = jnp.maximum(pos[row] - (window - 1), 0) // bs
+                gg = jnp.maximum(gg, jnp.minimum(first_live, last_live))
+            return (lidx[0], bt[row, gg], 0, 0, 0)
 
         return index_map
 
     kv_specs = []
-    for j in range(kb):
-        kv_specs.append(pl.BlockSpec((1, 1, hkv, bs, d), _kv_index_map(j)))
-        kv_specs.append(pl.BlockSpec((1, 1, hkv, bs, d), _kv_index_map(j)))
+    for j in range(bb):
+        for g in range(kb):
+            kv_specs.append(pl.BlockSpec((1, 1, hkv, bs, d), _kv_index_map(j, g)))
+            kv_specs.append(pl.BlockSpec((1, 1, hkv, bs, d), _kv_index_map(j, g)))
 
     kernel = functools.partial(
-        _paged_attend_kernel, scale=scale, bs=bs, kb=kb, num_cells=num_cells,
+        _paged_attend_kernel, scale=scale, bs=bs, kb=kb, bb=bb,
+        num_cells=num_cells,
         t=t, rows=rows, hkv=hkv, window=window, soft_cap=soft_cap,
         has_sinks=sinks is not None, has_slopes=alibi_slopes is not None)
 
@@ -336,21 +359,23 @@ def paged_decode_attention_stacked(
     n_extra = len(extra_ops)
 
     def _kernel(pos_ref, lidx_ref, bt_ref, q_ref, *rest):
-        ins = rest[: 2 * kb + n_extra]
-        o_ref, m_s, l_s, acc_s = rest[2 * kb + n_extra :]
+        ins = rest[: 2 * kb * bb + n_extra]
+        o_ref, m_s, l_s, acc_s = rest[2 * kb * bb + n_extra :]
         kernel(pos_ref, lidx_ref, bt_ref, q_ref, *ins, o_ref=o_ref,
                m_scratch=m_s, l_scratch=l_s, acc_scratch=acc_s)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
-        grid=(b, num_cells),
-        in_specs=[pl.BlockSpec((1, hkv, rows, d), lambda bi, ci, *_: (bi, 0, 0, 0))]
+        grid=(b // bb, num_cells),
+        in_specs=[pl.BlockSpec((bb, hkv, rows, d),
+                               lambda bi, ci, *_: (bi, 0, 0, 0))]
         + kv_specs + extra_specs,
-        out_specs=pl.BlockSpec((1, hkv, rows, d), lambda bi, ci, *_: (bi, 0, 0, 0)),
+        out_specs=pl.BlockSpec((bb, hkv, rows, d),
+                               lambda bi, ci, *_: (bi, 0, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((hkv * rows, 128), jnp.float32),
-            pltpu.VMEM((hkv * rows, 128), jnp.float32),
-            pltpu.VMEM((hkv * rows, d), jnp.float32),
+            pltpu.VMEM((bb * hkv * rows, 128), jnp.float32),
+            pltpu.VMEM((bb * hkv * rows, 128), jnp.float32),
+            pltpu.VMEM((bb * hkv * rows, d), jnp.float32),
         ],
     )
     # the per-layer cache view (4D) keeps the kv BlockSpecs rank-4; layer selection
@@ -364,7 +389,7 @@ def paged_decode_attention_stacked(
         interpret=interpret,
     )(positions.astype(jnp.int32), layer_idx.reshape(1).astype(jnp.int32),
       block_table.astype(jnp.int32), qg,
-      *([k_cache, v_cache] * kb), *extra_ops)
+      *([k_cache, v_cache] * (kb * bb)), *extra_ops)
 
     out = out[:, :, : n_rep * t, :].reshape(b, hkv, n_rep, t, d)
     return out.reshape(b, hq, t, d)

@@ -223,3 +223,40 @@ def test_paged_cb_kernel_matches_gather_tokens(tiny_llama_hf_config):
         return [results[rid] for rid in ids]
 
     assert _run(True) == _run(None)
+
+
+def test_paged_attention_bb4_matches_gather(tiny_llama_hf_config):
+    """4 slots -> the kernel's bb=4 multi-row-per-cell path (the serving shape);
+    tokens must match the gather path exactly (fp32 CPU)."""
+    from neuronx_distributed_inference_tpu.config import (
+        TpuConfig, load_pretrained_config)
+    from neuronx_distributed_inference_tpu.models.llama.modeling_llama import (
+        LlamaForCausalLM, LlamaInferenceConfig)
+    from neuronx_distributed_inference_tpu.runtime.continuous_batching import (
+        ContinuousBatchingRunner)
+
+    def make(kernel):
+        cfg = TpuConfig(batch_size=4, seq_len=96, max_context_length=32,
+                        dtype="float32", context_encoding_buckets=[16, 32],
+                        token_generation_buckets=[48, 96],
+                        is_continuous_batching=True,
+                        paged_attention_enabled=True,
+                        pa_num_blocks=52, pa_block_size=8,
+                        decode_kernel_enabled=kernel)
+        config = LlamaInferenceConfig(
+            cfg, load_config=load_pretrained_config(tiny_llama_hf_config))
+        app = LlamaForCausalLM(None, config)
+        app.load_random(seed=0)
+        return app
+
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(1, 256, size=(n,)).astype(np.int32)
+               for n in (12, 7, 19, 25)]
+
+    outs = {}
+    for kernel in (True, None):
+        runner = ContinuousBatchingRunner(make(kernel), decode_chunk=4)
+        for p in prompts:
+            runner.submit(p, max_new_tokens=20)
+        outs[kernel] = runner.run_to_completion(seed=0)
+    assert outs[True] == outs[None]
