@@ -222,6 +222,12 @@ class TpuConfig:
     decode_kernel_enabled: Optional[bool] = None
     moe_hybrid_sharding: Optional[MoEHybridShardingConfig] = None
     async_mode: bool = False
+    # store quantized attention stacks transposed ((L, out, in) "qT" payloads).
+    # Measured NEUTRAL on v5e (round 4): the decode scan's wq/wo slice copies
+    # move to wk/wv instead of disappearing — XLA re-picks a copy for one QKV
+    # operand either way (ROUND4_NOTES §9). Kept as an opt-in knob for other
+    # geometries/compilers; default off.
+    transpose_attention_stacks: bool = False
     paged_attention_enabled: bool = False
     pa_num_blocks: int = 0
     pa_block_size: int = 128

@@ -92,6 +92,10 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--context-encoding-buckets", type=int, nargs="*", default=None)
     g.add_argument("--token-generation-buckets", type=int, nargs="*", default=None)
     g.add_argument("--decode-chunk-size", type=int, default=32)
+    g.add_argument("--transpose-attention-stacks", action="store_true",
+                   help="store quantized attention stacks transposed "
+                        "((L, out, in) qT payloads) — measured neutral on "
+                        "v5e, opt-in for other geometries (ops/quantization)")
     g.add_argument("--async-mode", action="store_true",
                    help="pipeline decode-chunk dispatch ahead of the host sync")
     g.add_argument("--attention-kernel", dest="attention_kernel", default=None,
@@ -284,6 +288,7 @@ def create_tpu_config(args: argparse.Namespace) -> TpuConfig:
         token_generation_buckets=args.token_generation_buckets,
         decode_chunk_size=args.decode_chunk_size,
         async_mode=args.async_mode,
+        transpose_attention_stacks=args.transpose_attention_stacks,
         attention_kernel_enabled=args.attention_kernel,
         decode_kernel_enabled=args.decode_kernel,
         batch_buckets=args.batch_buckets,

@@ -34,11 +34,20 @@ DEFAULT_QUANTIZED_PARAMS = (
     "shared_wg", "shared_wu", "shared_wd", "lm_head",
 )
 
+# stacked attention projections stored TRANSPOSED ((..., out, in) as "qT"):
+# XLA chooses a transposed physical layout for these under the decode layer
+# scan and then materializes an s8[1, in, out] copy of every per-layer slice
+# (~0.75 ms/step at 32 layers, ROUND3_NOTES §3 / ROUND4_NOTES §9); storing
+# them logically transposed makes the natural row-major layout THE layout the
+# dots want, so the scan slice fuses straight into the matmul (the MLP stacks
+# already behave this way untransposed).
+TRANSPOSED_ATTENTION_PARAMS = ("wq", "wk", "wv", "wo")
+
 _QMAX = {"int8": 127.0, "float8_e4m3": 448.0}
 
 
 def is_quantized(w) -> bool:
-    return isinstance(w, dict) and "q" in w and "s" in w
+    return isinstance(w, dict) and ("q" in w or "qT" in w) and "s" in w
 
 
 def quantize_tensor(w, weight_dtype: str = "int8") -> Dict[str, Any]:
@@ -66,7 +75,43 @@ def quantize_tensor(w, weight_dtype: str = "int8") -> Dict[str, Any]:
 
 
 def dequantize_tensor(qw: Dict[str, jnp.ndarray], dtype=jnp.float32) -> jnp.ndarray:
+    """Dequantize back to the logical (..., in, out) orientation."""
+    if "qT" in qw:
+        w = jnp.swapaxes(qw["qT"].astype(jnp.float32), -1, -2)
+        return (w * qw["s"]).astype(dtype)
     return (qw["q"].astype(jnp.float32) * qw["s"]).astype(dtype)
+
+
+def transpose_attention_stacks(
+    params: Dict[str, Any],
+    names: Sequence[str] = TRANSPOSED_ATTENTION_PARAMS,
+    group_keys: Optional[Sequence[str]] = None,
+) -> Dict[str, Any]:
+    """Convert the named quantized weights to the transposed {"qT","s"} form
+    (see TRANSPOSED_ATTENTION_PARAMS). Already-transposed leaves pass through,
+    so artifact reloads are idempotent. Host-side: the contiguous copy here IS
+    the physical layout device_put uploads."""
+    import numpy as np
+
+    nameset = set(names)
+    # shares quantize_params' group scoping so the two walks can never diverge
+    groups = set(DEFAULT_QUANTIZED_GROUPS if group_keys is None else group_keys)
+
+    def conv(w):
+        if not (is_quantized(w) and "q" in w):
+            return w
+        return {"qT": np.ascontiguousarray(np.swapaxes(np.asarray(w["q"]),
+                                                       -1, -2)),
+                "s": w["s"]}
+
+    def walk(node, in_group):
+        if not isinstance(node, dict) or is_quantized(node):
+            return node
+        return {k: (conv(v) if in_group and k in nameset and is_quantized(v)
+                    else walk(v, k in groups) if isinstance(v, dict) else v)
+                for k, v in node.items()}
+
+    return walk(params, True)
 
 
 def qapply(x: jnp.ndarray, w, act_quant: bool = False) -> jnp.ndarray:
@@ -80,6 +125,22 @@ def qapply(x: jnp.ndarray, w, act_quant: bool = False) -> jnp.ndarray:
     XLA fuses the quantize into the preceding norm/elementwise ops."""
     if not is_quantized(w):
         return x @ w
+    if "qT" in w:
+        # transposed storage (..., out, in): contract both operands' LAST axes
+        wq = w["qT"]
+        dims = (((x.ndim - 1,), (wq.ndim - 1,)), ((), ()))
+        if act_quant and wq.dtype == jnp.int8:
+            sx = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1,
+                         keepdims=True) / 127.0
+            sx = jnp.maximum(sx, 1e-8)
+            xq = jnp.clip(jnp.round(x.astype(jnp.float32) / sx),
+                          -127, 127).astype(jnp.int8)
+            y = jax.lax.dot_general(xq, wq, dims,
+                                    preferred_element_type=jnp.int32)
+            return (y.astype(jnp.float32) * sx
+                    * w["s"].reshape(-1)).astype(x.dtype)
+        y = jax.lax.dot_general(x, wq.astype(x.dtype), dims)
+        return y * w["s"].reshape(-1).astype(y.dtype)
     if act_quant and w["q"].dtype == jnp.int8:
         sx = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1, keepdims=True) / 127.0
         sx = jnp.maximum(sx, 1e-8)
@@ -102,6 +163,9 @@ def qeinsum(spec: str, x: jnp.ndarray, w) -> jnp.ndarray:
     """
     if not is_quantized(w):
         return jnp.einsum(spec, x, w)
+    if "qT" in w:
+        raise ValueError("qeinsum does not take transposed-storage weights "
+                         "(attention projections go through qapply)")
     y = jnp.einsum(spec, x, w["q"].astype(x.dtype))
     out_scale = w["s"]                     # (..., 1, out); experts lead
     # result layout for "nh,ehi->eni" / "eni,eih->enh": (E, N, out) — scale is
@@ -174,19 +238,27 @@ def dequant_mxfp4(blocks, scales):
 
 def quantized_logical_axes(logical: Dict[str, Any], names: Sequence[str],
                            group_keys: Sequence[str] = DEFAULT_QUANTIZED_GROUPS,
+                           transposed_names: Sequence[str] = (),
                            ) -> Dict[str, Any]:
     """Transform a logical-axes tree to match a quantized param tree (scoped to the
     same group containers as quantize_params): each quantized leaf's axes apply to
-    ``q``; the scale keeps the output axis, contraction replaced by None."""
+    ``q``; the scale keeps the output axis, contraction replaced by None.
+    ``transposed_names`` get the {"qT","s"} form: the payload's last two axes
+    swap, the scale keeps the ORIGINAL output axis."""
     nameset = set(names)
+    tset = set(transposed_names)
     groups = set(group_keys)
 
-    def _q_axes(axes):
-        return {"q": tuple(axes), "s": tuple(list(axes[:-2]) + [None, axes[-1]])}
+    def _q_axes(axes, transposed):
+        s_axes = tuple(list(axes[:-2]) + [None, axes[-1]])
+        if transposed:
+            qt = tuple(list(axes[:-2]) + [axes[-1], axes[-2]])
+            return {"qT": qt, "s": s_axes}
+        return {"q": tuple(axes), "s": s_axes}
 
     def walk(node, in_group):
         if isinstance(node, dict):
-            return {k: (_q_axes(v)
+            return {k: (_q_axes(v, k in tset)
                         if in_group and k in nameset and not isinstance(v, dict)
                         else walk(v, k in groups) if isinstance(v, dict) else v)
                     for k, v in node.items()}

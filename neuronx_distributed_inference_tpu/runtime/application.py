@@ -444,12 +444,27 @@ class TpuModelForCausalLM:
 
         return DEFAULT_QUANTIZED_PARAMS
 
+    def _transposed_param_names(self):
+        """Quantized attention stacks stored transposed (see
+        ops/quantization.TRANSPOSED_ATTENTION_PARAMS); intersected with this
+        family's quantized names so custom layouts (e.g. DeepSeek's absorbed
+        projections) are never touched."""
+        from ..ops.quantization import TRANSPOSED_ATTENTION_PARAMS
+
+        if (self._quantization() is None
+                or not self.tpu_config.transpose_attention_stacks):
+            return ()
+        return tuple(n for n in TRANSPOSED_ATTENTION_PARAMS
+                     if n in self.quantized_param_names())
+
     def _param_shardings(self):
         from ..ops.quantization import quantized_logical_axes
 
         logical = self.logical_axes()
         if self._quantization() is not None:
-            logical = quantized_logical_axes(logical, self.quantized_param_names())
+            logical = quantized_logical_axes(
+                logical, self.quantized_param_names(),
+                transposed_names=self._transposed_param_names())
         return tree_shardings(self.mesh, logical, self.sharding_rules)
 
     def load(self, model_path: Optional[str] = None) -> None:
@@ -524,11 +539,16 @@ class TpuModelForCausalLM:
                 host_params["layers"] = {**host_params["layers"], **missing}
         qcfg = self._quantization()
         if qcfg is not None:
-            from ..ops.quantization import quantize_params
+            from ..ops.quantization import (quantize_params,
+                                            transpose_attention_stacks)
 
             # per-leaf: already-quantized leaves pass through (pre-quantized ckpts)
             host_params = quantize_params(host_params, qcfg.weight_dtype,
                                           names=self.quantized_param_names())
+            tnames = self._transposed_param_names()
+            if tnames:
+                host_params = transpose_attention_stacks(host_params,
+                                                         names=tnames)
         shardings = self._param_shardings()
         dtype = self.tpu_config.jax_dtype
 
@@ -539,7 +559,7 @@ class TpuModelForCausalLM:
             if first.startswith("rope_inv_freq") or last == "s":
                 # rope tables and quantization scales stay fp32
                 arr = arr.astype(np.float32)
-            elif last == "q":
+            elif last in ("q", "qT"):
                 pass                      # int8/fp8 payloads keep their dtype
             elif arr.dtype.kind == "f" or arr.dtype.name == "bfloat16":
                 arr = arr.astype(dtype) if arr.dtype != dtype else arr

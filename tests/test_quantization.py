@@ -283,3 +283,73 @@ def test_activation_quant_close_to_weight_only(tiny_llama_hf_config):
         TpuConfig(batch_size=1, seq_len=32,
                   quantization_config=QuantizationConfig(
                       quantize_weights=False, activation_quant=True))
+
+
+def test_transposed_attention_stacks_opt_in(tiny_llama_hf_config):
+    """transpose_attention_stacks=True stores attention projections as
+    (L, out, in) "qT" payloads (MLP stacks keep "q") and must generate the
+    same tokens and near-identical logits as the untransposed layout."""
+    from neuronx_distributed_inference_tpu.config import (
+        QuantizationConfig, TpuConfig, load_pretrained_config)
+    from neuronx_distributed_inference_tpu.models.llama.modeling_llama import (
+        LlamaForCausalLM, LlamaInferenceConfig)
+
+    def make(transposed):
+        cfg = TpuConfig(batch_size=2, seq_len=64, max_context_length=32,
+                        dtype="float32", context_encoding_buckets=[16, 32],
+                        token_generation_buckets=[32, 64],
+                        transpose_attention_stacks=transposed,
+                        quantization_config=QuantizationConfig(
+                            quantize_weights=True, weight_dtype="int8"))
+        config = LlamaInferenceConfig(
+            cfg, load_config=load_pretrained_config(tiny_llama_hf_config))
+        app = LlamaForCausalLM(None, config)
+        app.load_random(seed=0)
+        return app
+
+    rng = np.random.default_rng(5)
+    ids = rng.integers(1, 256, size=(2, 10)).astype(np.int32)
+    plain = make(False)
+    trans = make(True)
+    assert "qT" in trans.params["layers"]["wq"]
+    assert "q" in trans.params["layers"]["wg"]        # MLP untouched
+    L, H = np.asarray(trans.params["layers"]["ln1"]).shape
+    assert trans.params["layers"]["wq"]["qT"].shape[-1] == H
+
+    a = plain.generate(ids, max_new_tokens=6, return_logits=True)
+    b = trans.generate(ids, max_new_tokens=6, return_logits=True)
+    np.testing.assert_array_equal(a.tokens, b.tokens)
+    for i, (x, y) in enumerate(zip(a.logits, b.logits)):
+        np.testing.assert_allclose(x, y, rtol=1e-4, atol=1e-4, err_msg=f"step {i}")
+
+
+def test_transposed_stacks_with_activation_quant(tiny_llama_hf_config):
+    """qT storage composed with int8 activation quantization (the int8 x int8
+    MXU dot contracts both operands' LAST axes) must match the untransposed
+    act-quant path exactly — both quantize activations identically."""
+    from neuronx_distributed_inference_tpu.config import (
+        QuantizationConfig, TpuConfig, load_pretrained_config)
+    from neuronx_distributed_inference_tpu.models.llama.modeling_llama import (
+        LlamaForCausalLM, LlamaInferenceConfig)
+
+    def make(transposed):
+        cfg = TpuConfig(batch_size=2, seq_len=64, max_context_length=32,
+                        dtype="float32", context_encoding_buckets=[16, 32],
+                        token_generation_buckets=[32, 64],
+                        transpose_attention_stacks=transposed,
+                        quantization_config=QuantizationConfig(
+                            quantize_weights=True, weight_dtype="int8",
+                            activation_quant=True))
+        config = LlamaInferenceConfig(
+            cfg, load_config=load_pretrained_config(tiny_llama_hf_config))
+        app = LlamaForCausalLM(None, config)
+        app.load_random(seed=0)
+        return app
+
+    rng = np.random.default_rng(6)
+    ids = rng.integers(1, 256, size=(2, 10)).astype(np.int32)
+    a = make(False).generate(ids, max_new_tokens=6, return_logits=True)
+    b = make(True).generate(ids, max_new_tokens=6, return_logits=True)
+    np.testing.assert_array_equal(a.tokens, b.tokens)
+    for i, (x, y) in enumerate(zip(a.logits, b.logits)):
+        np.testing.assert_allclose(x, y, rtol=1e-5, atol=1e-5, err_msg=f"step {i}")
