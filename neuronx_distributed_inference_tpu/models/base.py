@@ -1083,46 +1083,51 @@ def _decoder_layer(
     return h, k_cache, v_cache
 
 
-def _run_stack(params: Params, args: ModelArchArgs, h, cos, sin, mask, cache,
-               positions, decode_bucket, mesh, rules, use_flash=False,
-               paged=None, cache_batch_start=0,
-               adapter_ids=None, ring_positions=None, window_row=None,
-               capture_layers: Optional[Tuple[int, ...]] = None,
-               deepstack: Optional[jnp.ndarray] = None, flash_decoding=False,
-               attn_bias=None, alibi_slopes=None):
-    """Scan the decoder layers, carrying hidden state, yielding updated cache.
+def _scan_layers(stack_params, k_stack, v_stack, h, step, *, cache_mode="xs",
+                 kv_scale_stacks=None, layer_indices=None,
+                 capture_layers: Optional[Tuple[int, ...]] = None,
+                 deepstack: Optional[jnp.ndarray] = None,
+                 allow_hidden_tap: bool = False):
+    """THE layer-stack scan driver — every runner below is a thin strategy wrapper.
 
-    ``capture_layers`` (static layer indices) also collects those layers' OUTPUT
-    hidden states — the EAGLE3 conditioning capture (≈ reference target-hidden
-    capture at 3 layers, `models/model_base.py:1429-1432`) — returned as a list of
-    (B, S, H) arrays. Selection happens inside the scan with a carried buffer per
-    index, so no (L, B, S, H) stack ever materializes."""
-    has_scales = "k_scale" in cache
-    xs = (params["layers"], cache["k"], cache["v"],
-          jnp.arange(len(jax.tree.leaves(params["layers"])[0])))
-    if has_scales:
-        xs = xs + (cache["k_scale"], cache["v_scale"])
+    ``step(h, lp, kc, vc, li, kv_scales) -> (new_h, kc, vc)`` is the per-layer
+    attention/MLP strategy: it closes over rope tables / masks / mesh and calls
+    `_decoder_layer` with its path-specific kwargs. The driver owns everything the
+    six pre-consolidation runners duplicated: the `lax.scan` scaffolding, the
+    cache plumbing per ``cache_mode``, the fp8 KV-scale gather, the EAGLE3
+    capture buffers (selection happens inside the scan with one carried buffer
+    per index, so no (L, B, S, H) stack materializes — ≈ reference target-hidden
+    capture, `models/model_base.py:1429-1432`), the DeepStack adds, and the
+    hidden-stack tensor-capture tap.
+
+    cache_mode:
+      "xs"          — k/v stacks slice per layer through scan xs and re-stack
+                      through ys (generic prefill/decode path).
+      "carry"       — k/v stacks ride the scan carry WHOLE; step receives the
+                      full stacked arrays (the Pallas kernels index layer ``li``
+                      in-kernel via aliased writes — no slice/re-stack copies).
+      "carry_slice" — stacks ride the carry whole; the driver hands step a
+                      per-layer dynamic slice and writes it back (paged gather:
+                      the xs/ys path would stack a second full block-pool copy
+                      for the ys output and OOM at serving scale).
+
+    Returns ``(h, k_new, v_new, caps)`` with ``caps`` a list of captured hidden
+    states (empty unless ``capture_layers``)."""
+    n = len(jax.tree.leaves(stack_params)[0])
+    li_all = (jnp.arange(n, dtype=jnp.int32) if layer_indices is None
+              else layer_indices)
+    has_scales = kv_scale_stacks is not None
     caps0 = tuple(jnp.zeros_like(h) for _ in (capture_layers or ()))
+    from ..utils import tensor_capture as _tc
 
-    def body(carry, layer_xs):
-        carry_h, caps = carry
-        if has_scales:
-            lp, kc, vc, li, sk, sv = layer_xs
-            kvs = (sk, sv)
-        else:
-            lp, kc, vc, li = layer_xs
-            kvs = None
-        new_h, kc, vc = _decoder_layer(lp, args, carry_h, cos, sin, mask, kc, vc,
-                                       positions, decode_bucket, mesh, rules,
-                                       use_flash=use_flash, paged=paged,
-                                       cache_batch_start=cache_batch_start,
-                                       adapter_ids=adapter_ids,
-                                       ring_positions=ring_positions,
-                                       window_row=window_row,
-                                       flash_decoding=flash_decoding,
-                                       attn_bias=attn_bias,
-                                       alibi_slopes=alibi_slopes,
-                                       kv_scales=kvs)
+    if allow_hidden_tap and cache_mode != "xs":
+        raise ValueError("hidden_stack capture requires cache_mode='xs' (the "
+                         "carry modes never stack per-layer hidden states)")
+    want_hidden = (allow_hidden_tap and _tc._ACTIVE.get() is not None
+                   and _tc._ACTIVE.get().wants("hidden_stack"))
+
+    def _post(caps, li, new_h):
+        # capture BEFORE deepstack: EAGLE3 conditions on the raw layer output
         if capture_layers:
             caps = tuple(jnp.where(li == idx, new_h, buf)
                          for idx, buf in zip(capture_layers, caps))
@@ -1131,23 +1136,90 @@ def _run_stack(params: Params, args: ModelArchArgs, h, cos, sin, mask, cache,
             # first K layers' outputs at image-token positions (pre-scattered)
             for k_i in range(deepstack.shape[0]):
                 new_h = new_h + jnp.where(li == k_i, deepstack[k_i], 0.0)
-        from ..utils import tensor_capture as _tc
+        return caps, new_h
 
-        ys = (kc, vc)
-        if _tc._ACTIVE.get() is not None and _tc._ACTIVE.get().wants("hidden_stack"):
-            ys = ys + (new_h,)
-        return (new_h, caps), ys
+    if cache_mode == "xs":
+        xs = (stack_params, k_stack, v_stack, li_all)
+        if has_scales:
+            xs = xs + tuple(kv_scale_stacks)
 
-    (h, caps), ys = jax.lax.scan(body, (h, caps0), xs)
-    k_new, v_new = ys[0], ys[1]
-    if len(ys) > 2:
-        from ..utils.tensor_capture import tap
+        def body(carry, layer_xs):
+            carry_h, caps = carry
+            if has_scales:
+                lp, kc, vc, li, sk, sv = layer_xs
+                kvs = (sk, sv)
+            else:
+                lp, kc, vc, li = layer_xs
+                kvs = None
+            new_h, kc, vc = step(carry_h, lp, kc, vc, li, kvs)
+            caps, new_h = _post(caps, li, new_h)
+            ys = (kc, vc) + ((new_h,) if want_hidden else ())
+            return (new_h, caps), ys
 
-        tap("hidden_stack", ys[2])      # (L, B, S, H) per-layer hidden states
+        (h, caps), ys = jax.lax.scan(body, (h, caps0), xs)
+        k_new, v_new = ys[0], ys[1]
+        if want_hidden:
+            from ..utils.tensor_capture import tap
+
+            tap("hidden_stack", ys[2])  # (L, B, S, H) per-layer hidden states
+        return h, k_new, v_new, list(caps)
+
+    def body(carry, xs):
+        carry_h, ck, cv, caps = carry
+        lp, li = xs
+        kvs = ((jnp.take(kv_scale_stacks[0], li, axis=0),
+                jnp.take(kv_scale_stacks[1], li, axis=0)) if has_scales else None)
+        if cache_mode == "carry_slice":
+            kc = jax.lax.dynamic_index_in_dim(ck, li, 0, keepdims=False)
+            vc = jax.lax.dynamic_index_in_dim(cv, li, 0, keepdims=False)
+            new_h, kc, vc = step(carry_h, lp, kc, vc, li, kvs)
+            ck = jax.lax.dynamic_update_index_in_dim(ck, kc, li, 0)
+            cv = jax.lax.dynamic_update_index_in_dim(cv, vc, li, 0)
+        else:
+            new_h, ck, cv = step(carry_h, lp, ck, cv, li, kvs)
+        caps, new_h = _post(caps, li, new_h)
+        return (new_h, ck, cv, caps), ()
+
+    # measured on-chip (round 3): unrolling this scan (lax.scan unroll>1) is
+    # ~8x SLOWER (128 ms/step at unroll=8 vs 16.5) — the per-layer Pallas write
+    # kernel calls serialize badly when unrolled; keep the rolled loop
+    (h, k_new, v_new, caps), _ = jax.lax.scan(
+        body, (h, k_stack, v_stack, caps0), (stack_params, li_all))
+    return h, k_new, v_new, list(caps)
+
+
+def _cache_scales(cache):
+    return ((cache["k_scale"], cache["v_scale"]) if "k_scale" in cache else None)
+
+
+def _run_stack(params: Params, args: ModelArchArgs, h, cos, sin, mask, cache,
+               positions, decode_bucket, mesh, rules, use_flash=False,
+               paged=None, cache_batch_start=0,
+               adapter_ids=None, ring_positions=None, window_row=None,
+               capture_layers: Optional[Tuple[int, ...]] = None,
+               deepstack: Optional[jnp.ndarray] = None, flash_decoding=False,
+               attn_bias=None, alibi_slopes=None):
+    """Generic layer scan (xs/ys cache plumbing) — see `_scan_layers`."""
+    def step(carry_h, lp, kc, vc, li, kvs):
+        return _decoder_layer(lp, args, carry_h, cos, sin, mask, kc, vc,
+                              positions, decode_bucket, mesh, rules,
+                              use_flash=use_flash, paged=paged,
+                              cache_batch_start=cache_batch_start,
+                              adapter_ids=adapter_ids,
+                              ring_positions=ring_positions,
+                              window_row=window_row,
+                              flash_decoding=flash_decoding,
+                              attn_bias=attn_bias, alibi_slopes=alibi_slopes,
+                              kv_scales=kvs)
+
+    h, k_new, v_new, caps = _scan_layers(
+        params["layers"], cache["k"], cache["v"], h, step, cache_mode="xs",
+        kv_scale_stacks=_cache_scales(cache), capture_layers=capture_layers,
+        deepstack=deepstack, allow_hidden_tap=True)
     # preserve auxiliary cache entries (e.g. M-RoPE rope_delta) alongside k/v
     out_cache = {**cache, "k": k_new, "v": v_new}
     if capture_layers:
-        return h, out_cache, list(caps)
+        return h, out_cache, caps
     return h, out_cache
 
 
@@ -1209,18 +1281,17 @@ def _run_stack_pattern(params: Params, args: ModelArchArgs, h, ctx_full, ctx_sli
             bucket_run = decode_bucket
             rl = None
 
-        def body(carry_h, layer_xs, _a=a_run, _cos=cos_i, _sin=sin_i, _mask=mask_i,
-                 _pos=pos_run, _bucket=bucket_run, _rl=rl):
-            lp, kc, vc = layer_xs
-            nh, kc, vc = _decoder_layer(lp, _a, carry_h, _cos, _sin, _mask, kc, vc,
-                                        _pos, _bucket, mesh, rules,
-                                        use_flash=use_flash,
-                                        cache_batch_start=cache_batch_start,
-                                        adapter_ids=adapter_ids,
-                                        rolling_lengths=_rl)
-            return nh, (kc, vc)
+        def step(carry_h, lp, kc, vc, li, kvs, _a=a_run, _cos=cos_i, _sin=sin_i,
+                 _mask=mask_i, _pos=pos_run, _bucket=bucket_run, _rl=rl):
+            return _decoder_layer(lp, _a, carry_h, _cos, _sin, _mask, kc, vc,
+                                  _pos, _bucket, mesh, rules,
+                                  use_flash=use_flash,
+                                  cache_batch_start=cache_batch_start,
+                                  adapter_ids=adapter_ids,
+                                  rolling_lengths=_rl)
 
-        h, (ks, vs) = jax.lax.scan(body, h, (stack, kc_stack, vc_stack))
+        h, ks, vs, _ = _scan_layers(stack, kc_stack, vc_stack, h, step,
+                                    cache_mode="xs")
         parts[is_slide].append((ks, vs))
 
     out = dict(cache)
@@ -1246,28 +1317,16 @@ def _run_stack_paged_gather(params: Params, args: ModelArchArgs, h, cos, sin,
     layer per step via dynamic_update_index keeps the peak at pool + one
     transient layer slice. Used by the paged INSERT (wide prefix-prefill
     queries) and any paged decode the Pallas kernel declines."""
-    L = args.num_layers
-    has_scales = "k_scale" in cache
+    def step(carry_h, lp, kc, vc, li, kvs):
+        return _decoder_layer(lp, args, carry_h, cos, sin, mask, kc, vc,
+                              positions, decode_bucket, mesh, rules,
+                              paged=(block_table, slot_mapping),
+                              adapter_ids=adapter_ids,
+                              attn_bias=attn_bias, kv_scales=kvs)
 
-    def body(carry, xs):
-        carry_h, ck, cv = carry
-        lp, li = xs
-        kvs = ((jnp.take(cache["k_scale"], li, axis=0),
-                jnp.take(cache["v_scale"], li, axis=0)) if has_scales else None)
-        kc = jax.lax.dynamic_index_in_dim(ck, li, 0, keepdims=False)
-        vc = jax.lax.dynamic_index_in_dim(cv, li, 0, keepdims=False)
-        new_h, kc, vc = _decoder_layer(lp, args, carry_h, cos, sin, mask, kc, vc,
-                                       positions, decode_bucket, mesh, rules,
-                                       paged=(block_table, slot_mapping),
-                                       adapter_ids=adapter_ids,
-                                       attn_bias=attn_bias, kv_scales=kvs)
-        ck = jax.lax.dynamic_update_index_in_dim(ck, kc, li, 0)
-        cv = jax.lax.dynamic_update_index_in_dim(cv, vc, li, 0)
-        return (new_h, ck, cv), ()
-
-    (h, k_new, v_new), _ = jax.lax.scan(
-        body, (h, cache["k"], cache["v"]),
-        (params["layers"], jnp.arange(L, dtype=jnp.int32)))
+    h, k_new, v_new, _ = _scan_layers(
+        params["layers"], cache["k"], cache["v"], h, step,
+        cache_mode="carry_slice", kv_scale_stacks=_cache_scales(cache))
     return h, {**cache, "k": k_new, "v": v_new}
 
 
@@ -1311,19 +1370,17 @@ def _run_stack_pattern_decode_kernel(params: Params, args: ModelArchArgs, h,
             bucket_run = decode_bucket
             carry_k, carry_v = ck, cv
 
-        def body(carry, xs, _cos=cos_i, _sin=sin_i, _mask=mask_i,
-                 _pa=pos_attend, _pw=pos_write, _bucket=bucket_run):
-            carry_h, kk, vv = carry
-            lp, li_j = xs
-            nh, kk, vv = _decoder_layer(lp, args_plain, carry_h, _cos, _sin,
-                                        _mask, kk, vv, _pa, _bucket, mesh, rules,
-                                        adapter_ids=adapter_ids,
-                                        stacked_layer_idx=li_j,
-                                        write_positions=_pw)
-            return (nh, kk, vv), ()
+        def step(carry_h, lp, kk, vv, li_j, kvs, _cos=cos_i, _sin=sin_i,
+                 _mask=mask_i, _pa=pos_attend, _pw=pos_write, _bucket=bucket_run):
+            return _decoder_layer(lp, args_plain, carry_h, _cos, _sin,
+                                  _mask, kk, vv, _pa, _bucket, mesh, rules,
+                                  adapter_ids=adapter_ids,
+                                  stacked_layer_idx=li_j,
+                                  write_positions=_pw)
 
-        (h, carry_k, carry_v), _ = jax.lax.scan(body, (h, carry_k, carry_v),
-                                                (stack, li))
+        h, carry_k, carry_v, _ = _scan_layers(stack, carry_k, carry_v, h, step,
+                                              cache_mode="carry",
+                                              layer_indices=li)
         if is_slide:
             cks, cvs = carry_k, carry_v
         else:
@@ -1340,29 +1397,15 @@ def _run_stack_decode_kernel(params: Params, args: ModelArchArgs, h, cos, sin, m
     The cache rides the scan as a CARRY (full stacked arrays, updated in place by the
     aliased write kernel); only the layer params are scan xs. This removes the
     per-layer cache slice (xs) and re-stack (ys) copies the generic _run_stack pays."""
-    L = args.num_layers
+    def step(carry_h, lp, ck, cv, li, kvs):
+        return _decoder_layer(lp, args, carry_h, cos, sin, mask, ck, cv,
+                              positions, decode_bucket, mesh, rules,
+                              adapter_ids=adapter_ids, stacked_layer_idx=li,
+                              alibi_slopes=alibi_slopes, kv_scales=kvs)
 
-    has_scales = "k_scale" in cache
-
-    def body(carry, xs):
-        carry_h, ck, cv = carry
-        lp, li = xs
-        kvs = ((jnp.take(cache["k_scale"], li, axis=0),
-                jnp.take(cache["v_scale"], li, axis=0)) if has_scales else None)
-        new_h, ck, cv = _decoder_layer(lp, args, carry_h, cos, sin, mask, ck, cv,
-                                       positions, decode_bucket, mesh, rules,
-                                       adapter_ids=adapter_ids,
-                                       stacked_layer_idx=li,
-                                       alibi_slopes=alibi_slopes,
-                                       kv_scales=kvs)
-        return (new_h, ck, cv), ()
-
-    # measured on-chip (round 3): unrolling this scan (lax.scan unroll>1) is
-    # ~8x SLOWER (128 ms/step at unroll=8 vs 16.5) — the per-layer Pallas write
-    # kernel calls serialize badly when unrolled; keep the rolled loop
-    (h, k_new, v_new), _ = jax.lax.scan(
-        body, (h, cache["k"], cache["v"]),
-        (params["layers"], jnp.arange(L, dtype=jnp.int32)))
+    h, k_new, v_new, _ = _scan_layers(
+        params["layers"], cache["k"], cache["v"], h, step, cache_mode="carry",
+        kv_scale_stacks=_cache_scales(cache))
     return h, {**cache, "k": k_new, "v": v_new}
 
 
@@ -1376,25 +1419,16 @@ def _run_stack_paged_kernel(params: Params, args: ModelArchArgs, h, cos, sin,
     whole pool, not the live tokens). Per layer: block-table RMW write + ragged
     length-aware attend. ≈ the reference's paged TKG hot path
     (`block_kv_cache_manager.py:268-374` + `attention_base.py:1483-1677`)."""
-    L = args.num_layers
-
-    has_scales = "k_scale" in cache
-
-    def body(carry, xs):
-        carry_h, ck, cv = carry
-        lp, li = xs
-        kvs = ((jnp.take(cache["k_scale"], li, axis=0),
-                jnp.take(cache["v_scale"], li, axis=0)) if has_scales else None)
-        new_h, ck, cv = _decoder_layer(
+    def step(carry_h, lp, ck, cv, li, kvs):
+        return _decoder_layer(
             lp, args, carry_h, cos, sin, None, ck, cv, positions, None, mesh,
             rules, adapter_ids=adapter_ids, stacked_layer_idx=li,
             paged_stacked=(block_table, slot_mapping), alibi_slopes=alibi_slopes,
             kv_scales=kvs)
-        return (new_h, ck, cv), ()
 
-    (h, k_new, v_new), _ = jax.lax.scan(
-        body, (h, cache["k"], cache["v"]),
-        (params["layers"], jnp.arange(L, dtype=jnp.int32)))
+    h, k_new, v_new, _ = _scan_layers(
+        params["layers"], cache["k"], cache["v"], h, step, cache_mode="carry",
+        kv_scale_stacks=_cache_scales(cache))
     return h, {**cache, "k": k_new, "v": v_new}
 
 
@@ -1419,6 +1453,20 @@ def _lm_head(params: Params, args: ModelArchArgs, h, mesh, rules) -> jnp.ndarray
         logits = cap * jnp.tanh(logits / cap)
     logical = ("batch", "vocab") if logits.ndim == 2 else ("batch", None, "vocab")
     return constrain(logits, logical, rules, mesh=mesh)
+
+
+def _finalize_logits(params, args: ModelArchArgs, h, cache, mesh, rules,
+                     return_hidden=False, caps=None):
+    """Shared decode epilogue: final norm + lm_head, assembling the
+    (logits, cache[, hidden][, captures]) return tuple every decode path shares."""
+    h = _norm(h, params["final_norm"], args, params.get("final_norm_b"))
+    logits = _lm_head(params, args, h, mesh, rules)
+    res = (logits, cache)
+    if return_hidden:
+        res = res + (h,)
+    if caps is not None:
+        res = res + (caps,)
+    return res
 
 
 def prefill_forward(
@@ -1622,11 +1670,8 @@ def decode_forward(
                 params, args, h, (cos, sin, mask_full), (cos_l, sin_l, mask_slide),
                 cache, position_ids, decode_bucket, mesh, rules,
                 adapter_ids=adapter_ids)
-            h = _norm(h, params["final_norm"], args, params.get("final_norm_b"))
-            logits = _lm_head(params, args, h, mesh, rules)
-            if return_hidden:
-                return logits, cache, h
-            return logits, cache
+            return _finalize_logits(params, args, h, cache, mesh, rules,
+                                    return_hidden)
         slopes = params.get("alibi_slopes") if args.alibi else None
         if paged is not None:
             # ragged paged serving hot path: Pallas block-table kernels, cache
@@ -1635,11 +1680,8 @@ def decode_forward(
                 params, args, h, cos, sin, cache, position_ids, block_table,
                 slot_mapping, mesh, rules, adapter_ids=adapter_ids,
                 alibi_slopes=slopes)
-            h = _norm(h, params["final_norm"], args, params.get("final_norm_b"))
-            logits = _lm_head(params, args, h, mesh, rules)
-            if return_hidden:
-                return logits, cache, h
-            return logits, cache
+            return _finalize_logits(params, args, h, cache, mesh, rules,
+                                    return_hidden)
         kv_pos_k = jnp.arange(decode_bucket)[None, None, None, :]
         mask_k = kv_pos_k <= pos_grid[:, None, :, None]
         if args.sliding_window is not None:
@@ -1649,11 +1691,8 @@ def decode_forward(
             params, args, h, cos, sin, mask_k, cache, positions=position_ids,
             decode_bucket=decode_bucket, mesh=mesh, rules=rules,
             adapter_ids=adapter_ids, alibi_slopes=slopes)
-        h = _norm(h, params["final_norm"], args, params.get("final_norm_b"))
-        logits = _lm_head(params, args, h, mesh, rules)
-        if return_hidden:
-            return logits, cache, h
-        return logits, cache
+        return _finalize_logits(params, args, h, cache, mesh, rules,
+                                return_hidden)
     kv_pos = jnp.arange(decode_bucket)[None, None, None, :]
     q_pos = pos_grid[:, None, :, None]
     if tree is None:
@@ -1691,11 +1730,8 @@ def decode_forward(
             params, args, h, (cos, sin, mask), (cos_l, sin_l, mask_slide), cache,
             positions=position_ids, decode_bucket=decode_bucket, mesh=mesh,
             rules=rules, adapter_ids=adapter_ids)
-        h = _norm(h, params["final_norm"], args, params.get("final_norm_b"))
-        logits = _lm_head(params, args, h, mesh, rules)
-        if return_hidden:
-            return logits, cache, h
-        return logits, cache
+        return _finalize_logits(params, args, h, cache, mesh, rules,
+                                return_hidden)
     if sliding is not None:
         mask = sliding
 
@@ -1712,23 +1748,14 @@ def decode_forward(
             params, args, h, cos, sin, mask, cache, position_ids, decode_bucket,
             block_table, slot_mapping, mesh, rules, adapter_ids=adapter_ids,
             attn_bias=attn_bias)
-        h = _norm(h, params["final_norm"], args, params.get("final_norm_b"))
-        logits = _lm_head(params, args, h, mesh, rules)
-        if return_hidden:
-            return logits, cache, h
-        return logits, cache
+        return _finalize_logits(params, args, h, cache, mesh, rules,
+                                return_hidden)
     out = _run_stack(params, args, h, cos, sin, mask, cache,
                      positions=position_ids, decode_bucket=decode_bucket,
                      mesh=mesh, rules=rules,
                      paged=paged, adapter_ids=adapter_ids,
                      window_row=window_row, capture_layers=capture_layers,
                      flash_decoding=flash_decoding, attn_bias=attn_bias)
-    h, cache = out[0], out[1]
-    h = _norm(h, params["final_norm"], args, params.get("final_norm_b"))
-    logits = _lm_head(params, args, h, mesh, rules)
-    res = (logits, cache)
-    if return_hidden:
-        res = res + (h,)
-    if capture_layers:
-        res = res + (out[2],)
-    return res
+    return _finalize_logits(params, args, out[0], out[1], mesh, rules,
+                            return_hidden,
+                            caps=out[2] if capture_layers else None)
