@@ -56,10 +56,26 @@ class Request:
 
 
 class ContinuousBatchingRunner:
-    """Slot-based continuous batching engine over a `TpuModelForCausalLM`."""
+    """Slot-based continuous batching engine over a `TpuModelForCausalLM`.
+
+    With ``draft``/``speculation_length`` the serving loop runs FUSED SPECULATIVE
+    decode chunks instead of one-token steps (≈ the reference serving fused spec
+    through CB + paged KV: per-sequence multi-token slot mapping
+    `block_kv_cache_manager.py:402-431` ``generate_fusedspec_slot_mapping``, CB +
+    fused-spec config coupling `models/config.py:245-258`). TPU redesign: each
+    dispatch scans ``spec_chunk`` fused iterations ON DEVICE — draft loop + wide
+    K-token verify + acceptance — with per-row positions advancing in-graph by
+    each row's accepted length and the (B, K) block slot mapping recomputed from
+    the live positions inside the graph, so the host round trip amortizes over
+    the whole chunk. Rejected-token KV needs no rollback: the next window's
+    writes start at the committed position and cover the stale region before any
+    length-aware read (same position-masked discipline as runtime/speculation.py).
+    """
 
     def __init__(self, app, decode_chunk: Optional[int] = None,
-                 async_mode: Optional[bool] = None):
+                 async_mode: Optional[bool] = None, draft=None,
+                 speculation_length: Optional[int] = None,
+                 spec_chunk: Optional[int] = None):
         cfg = app.tpu_config
         if not cfg.is_continuous_batching:
             raise ValueError("tpu_config.is_continuous_batching must be enabled")
@@ -93,6 +109,47 @@ class ContinuousBatchingRunner:
         self._greedy = (not self.sampling_config.do_sample
                         and bool((np.asarray(sp)[:, 0] == 1).all()))
 
+        # --- fused speculation through the serving loop ------------------------
+        self.draft = draft
+        self.k = 0
+        if draft is None and (speculation_length is not None
+                              or spec_chunk is not None):
+            raise ValueError("speculation_length/spec_chunk require a draft "
+                             "model (pass draft=<TpuModelForCausalLM>)")
+        if draft is not None:
+            if speculation_length is None or speculation_length < 2:
+                raise ValueError(
+                    "speculation_length must be >= 2 (1 draft + 1 verify)")
+            if app.arch_args.vocab_size != draft.arch_args.vocab_size:
+                raise ValueError("target and draft must share a vocabulary")
+            for attr in ("seq_len", "max_batch_size", "max_context_length"):
+                if getattr(cfg, attr) != getattr(draft.tpu_config, attr):
+                    raise ValueError(
+                        f"target/draft tpu_config.{attr} mismatch: "
+                        f"{getattr(cfg, attr)} vs "
+                        f"{getattr(draft.tpu_config, attr)}")
+            if (app.arch_args.layer_pattern is not None
+                    or draft.arch_args.layer_pattern is not None):
+                raise ValueError(
+                    "speculative continuous batching does not support per-layer "
+                    "attention patterns (the wide verify would alias rolling "
+                    "sliding-cache slots)")
+            if not self._greedy:
+                odsc = self.sampling_config
+                if not (odsc.do_sample or odsc.dynamic):
+                    raise ValueError(
+                        "multinomial speculation requires a sampling config with "
+                        "do_sample or dynamic params (see FusedSpeculativeModel)")
+            self.k = speculation_length
+            # per-dispatch fused iterations; each commits 1..K tokens per row
+            self.spec_chunk = spec_chunk or max(1, self.decode_chunk // self.k)
+            # dispatch-ahead needs a host-predictable uniform advance; spec
+            # advance is data-dependent (accepted length), so the pipeline
+            # cannot be proven exact — the on-device chunk amortizes instead
+            self.async_mode = False
+            # histogram over tokens-committed-per-(row, iteration), length K
+            self.acceptance_counts = np.zeros((self.k,), dtype=np.int64)
+
         self.queue: List[Request] = []
         self.active: List[Optional[Request]] = [None] * self.num_slots
         self.finished: Dict[int, Request] = {}
@@ -124,6 +181,18 @@ class ContinuousBatchingRunner:
             app.reset_cache()
             self.cache = app.kv_cache
             app.kv_cache = None   # the runner owns the cache now
+
+        if draft is not None:
+            # the draft cache shares the block geometry (and block TABLE) with
+            # the target: one allocator decision covers both pools, and the
+            # prefix-cache hash stays valid because every insert writes both
+            if self.paged:
+                self.d_cache = draft.make_paged_cache(cfg.pa_num_blocks,
+                                                      cfg.pa_block_size)
+            else:
+                draft.reset_cache()
+                self.d_cache = draft.kv_cache
+                draft.kv_cache = None
 
         self._build_steps()
 
@@ -261,6 +330,148 @@ class ContinuousBatchingRunner:
             self._seed_step = jax.jit(_seed, donate_argnums=(4,),
                                       static_argnames=("decode_bucket",))
 
+        if self.draft is not None:
+            self._build_spec_steps()
+
+    def _build_spec_steps(self) -> None:
+        """Fused-speculation serving chunks: per dispatch, ``num_iters`` on-device
+        iterations of (draft scan -> wide K verify -> acceptance), per-row
+        positions advancing in-graph by each row's accepted length.
+
+        ≈ reference fused spec over CB + block KV (`block_kv_cache_manager.py:402`
+        ``generate_fusedspec_slot_mapping``): here the (B, K) slot mapping is
+        recomputed from the live positions INSIDE the graph each iteration (a
+        block-table gather), because the host cannot know them in advance."""
+        from .speculation import speculative_accept
+
+        app, draft = self.app, self.draft
+        t_args, mesh, rules = app.arch_args, app.mesh, app.sharding_rules
+        d_args, d_mesh, d_rules = (draft.arch_args, draft.mesh,
+                                   draft.sharding_rules)
+        odsc = self.sampling_config
+        k = self.k
+        vocab = t_args.vocab_size
+        precision = "highest" if self.cfg.dtype == "float32" else "default"
+        t_decode = app.decode_fn()
+        d_decode = draft.decode_fn()
+
+        paged = self.paged
+        if paged:
+            bs = self.block_size
+            mb = self.max_blocks_per_seq
+            t_kw = ({"use_kernel": True}
+                    if app._use_paged_decode_kernel() else {})
+            d_kw = ({"use_kernel": True}
+                    if draft._use_paged_decode_kernel() else {})
+        else:
+            t_kw = {"use_kernel": True} if app._use_decode_kernel() else {}
+            d_kw = {"use_kernel": True} if draft._use_decode_kernel() else {}
+
+        def _spec_chunk(t_params, d_params, tok0, positions, alive0, t_cache,
+                        d_cache, block_table, sampling_params, eos_ids, key,
+                        num_iters, greedy, decode_bucket=None):
+            iter_keys = jax.random.split(key, num_iters)
+
+            def one_iter(carry, key_i):
+                tok, pos, alive, t_cache, d_cache = carry
+                key_d, key_acc = jax.random.split(key_i)
+                d_keys = jax.random.split(key_d, k)
+                if paged:
+                    # per-sequence K-wide slot mapping from the LIVE positions
+                    p = pos[:, None] + jnp.arange(k, dtype=jnp.int32)[None, :]
+                    blk = jnp.take_along_axis(
+                        block_table, jnp.minimum(p // bs, mb - 1), axis=1)
+                    sm = jnp.where(alive[:, None], blk * bs + p % bs, -1)
+                    d_extra = dict(block_table=block_table)
+                    t_extra = dict(block_table=block_table, slot_mapping=sm)
+                    sm_cols = sm.T[:, :, None]                    # (K, B, 1)
+                else:
+                    d_extra = t_extra = {}
+                    sm_cols = jnp.zeros((k, 1, 1), dtype=jnp.int32)
+
+                # draft loop: k iterations proposing k-1 candidates; the k-th
+                # runs so d_{k-1}'s KV lands before a possible full accept
+                def draft_body(dc, xs):
+                    dtok, dpos, cache = dc
+                    key_j, sm_j = xs
+                    kwj = dict(d_extra)
+                    if paged:
+                        kwj["slot_mapping"] = sm_j
+                    with jax.default_matmul_precision(precision):
+                        logits, cache = d_decode(
+                            d_params, d_args, dtok[:, None], dpos, cache,
+                            decode_bucket, mesh=d_mesh, rules=d_rules,
+                            **kwj, **d_kw)
+                    last = logits[:, -1]
+                    if greedy:
+                        nxt = jnp.argmax(last, axis=-1).astype(jnp.int32)
+                    else:
+                        nxt = sampling_ops.sample(last, sampling_params,
+                                                  key_j, odsc)
+                    return (nxt, dpos + 1, cache), (nxt, last)
+
+                (_, _, d_cache), (d_toks, d_logits) = jax.lax.scan(
+                    draft_body, (tok, pos, d_cache), (d_keys, sm_cols))
+                d_toks = d_toks.T[:, : k - 1]                     # (B, K-1)
+                d_logits = d_logits.transpose(1, 0, 2)[:, : k - 1]
+
+                t_in = jnp.concatenate([tok[:, None], d_toks], axis=1)
+                with jax.default_matmul_precision(precision):
+                    t_logits, t_cache = t_decode(
+                        t_params, t_args, t_in, pos, t_cache, decode_bucket,
+                        mesh=mesh, rules=rules, **t_extra, **t_kw)
+                out_toks, n = speculative_accept(
+                    d_toks, d_logits, t_logits, sampling_params, key_acc,
+                    greedy=greedy, odsc=odsc, vocab=vocab)
+
+                take = jnp.where(alive, n + 1, 0)
+                new_tok = jnp.take_along_axis(
+                    out_toks, jnp.maximum(take - 1, 0)[:, None], axis=1)[:, 0]
+                tok = jnp.where(alive, new_tok, tok)
+                pos = pos + take
+                # a row whose committed window contains its eos stops advancing
+                # (the host replays the exact same stopping rule when committing)
+                win = jnp.arange(k, dtype=jnp.int32)[None, :] < take[:, None]
+                hit_eos = jnp.any(win & (out_toks == eos_ids[:, None]), axis=1)
+                alive = alive & ~hit_eos
+                return (tok, pos, alive, t_cache, d_cache), (out_toks, n)
+
+            (_, _, _, t_cache, d_cache), (outs, ns) = jax.lax.scan(
+                one_iter, (tok0, positions, alive0, t_cache, d_cache), iter_keys)
+            return outs, ns, t_cache, d_cache
+
+        self._spec_step = jax.jit(
+            _spec_chunk, donate_argnums=(5, 6),
+            static_argnames=("num_iters", "greedy", "decode_bucket"))
+
+        if paged:
+            def _d_insert(d_params, input_ids, position_ids, cache,
+                          block_table_row, slot_mapping):
+                with jax.default_matmul_precision(precision):
+                    _, cache = d_decode(
+                        d_params, d_args, input_ids, position_ids, cache, None,
+                        mesh=d_mesh, rules=d_rules, block_table=block_table_row,
+                        slot_mapping=slot_mapping)
+                return cache
+
+            self._d_insert_step = jax.jit(_d_insert, donate_argnums=(3,))
+        else:
+            d_prefill = draft.prefill_fn()
+            use_ring = draft._use_ring_attention()
+            use_flash = (not use_ring) and draft._use_flash_attention()
+
+            def _d_insert(d_params, input_ids, position_ids, last_token_idx,
+                          cache, slot):
+                with jax.default_matmul_precision(precision):
+                    _, cache = d_prefill(
+                        d_params, d_args, input_ids, position_ids,
+                        last_token_idx, cache, mesh=d_mesh, rules=d_rules,
+                        cache_batch_start=slot, use_flash=use_flash,
+                        use_ring=use_ring)
+                return cache
+
+            self._d_insert_step = jax.jit(_d_insert, donate_argnums=(4,))
+
     # ------------------------------------------------------------------ API
     def submit(self, prompt: np.ndarray, max_new_tokens: int = 32,
                eos_token_id: Optional[int] = None) -> int:
@@ -271,6 +482,11 @@ class ContinuousBatchingRunner:
             raise ValueError(f"prompt ({prompt.size}) + max_new_tokens "
                              f"({max_new_tokens}) exceeds seq_len {self.cfg.seq_len}")
         if not self.paged and prompt.size > self.app.cte_buckets[-1]:
+            if self.draft is not None:
+                raise ValueError(
+                    f"prompt ({prompt.size}) exceeds the largest context bucket "
+                    f"({self.app.cte_buckets[-1]}); speculative CB supports "
+                    f"windowed (chunked) prefill only in paged mode")
             if (self.app.decode_fn() is not model_base.decode_forward
                     or self.app.arch_args.layer_pattern is not None):
                 raise ValueError(
@@ -345,24 +561,9 @@ class ContinuousBatchingRunner:
             self.last_tok[slot] = req.generated[-1]
             self._maybe_finish(req, emitted)
 
-    def step(self, key: Optional[jax.Array] = None) -> Dict[int, List[int]]:
-        """Place queued requests into free slots, then run one decode chunk.
-
-        Returns {request_id: newly generated tokens} for this step (in
-        async steady state the tokens lag one chunk behind the dispatches).
-        """
-        if key is None:
-            self._key, key = jax.random.split(self._key)
-        emitted: Dict[int, List[int]] = {}
-
-        # leaving steady state (placements pending, a row near a stop bound, or
-        # async off) drains the pipeline first so the sync path sees exact state
-        if self._pending is not None and (
-                self.queue or not self._async_ok(
-                    self._pending[1] + 2 * self.decode_chunk)):
-            self._drain(emitted)
-
-        # --- placement (≈ CTE dispatch for new seq_ids) -------------------------
+    def _place_queued(self, key, emitted: Dict[int, List[int]]):
+        """Place queued requests into free slots (≈ CTE dispatch for new
+        seq_ids); returns the advanced PRNG key."""
         for slot in range(self.num_slots):
             if not self.queue or self.active[slot] is not None:
                 continue
@@ -371,7 +572,9 @@ class ContinuousBatchingRunner:
             if self.paged:
                 # require room for the prompt plus one decode chunk, else a fresh
                 # insert can be preempted before generating a single token (thrash)
-                need = -(-(fed_len + 1 + self.decode_chunk) // self.block_size)
+                chunk_tokens = (self.spec_chunk * self.k if self.draft is not None
+                                else self.decode_chunk)
+                need = -(-(fed_len + 1 + chunk_tokens) // self.block_size)
                 if self.allocator.num_free < need:
                     break
             self.queue.pop(0)
@@ -389,7 +592,34 @@ class ContinuousBatchingRunner:
             self.positions[slot] = req.position
             self.last_tok[slot] = req.generated[-1]
             self._maybe_finish(req, emitted)
+        return key
 
+    def step(self, key: Optional[jax.Array] = None) -> Dict[int, List[int]]:
+        """Place queued requests into free slots, then run one decode chunk.
+
+        Returns {request_id: newly generated tokens} for this step (in
+        async steady state the tokens lag one chunk behind the dispatches).
+        """
+        if key is None:
+            self._key, key = jax.random.split(self._key)
+        emitted: Dict[int, List[int]] = {}
+
+        # leaving steady state (placements pending, a row near a stop bound, or
+        # async off) drains the pipeline first so the sync path sees exact state
+        if self._pending is not None and (
+                self.queue or not self._async_ok(
+                    self._pending[1] + 2 * self.decode_chunk)):
+            self._drain(emitted)
+
+        key = self._place_queued(key, emitted)
+        if self.draft is not None:
+            return self._step_spec(key, emitted)
+        return self._step_plain(key, emitted)
+
+    def _step_plain(self, key, emitted: Dict[int, List[int]]
+                    ) -> Dict[int, List[int]]:
+        """One plain (non-speculative) decode chunk for every slot. Also the
+        exact near-boundary fallback for spec mode (see _step_spec)."""
         active_rows = [r for r in self.active if r is not None]
         if not active_rows:
             self._drain(emitted)
@@ -449,6 +679,72 @@ class ContinuousBatchingRunner:
         else:
             self._drain(emitted)                       # older chunk commits first
             self._commit(np.asarray(toks_dev), steps, emitted)
+        return emitted
+
+    def _step_spec(self, key, emitted: Dict[int, List[int]]
+                   ) -> Dict[int, List[int]]:
+        """One fused-speculation serving dispatch: ``spec_chunk`` on-device
+        iterations, then an exact host replay of the commit/stopping rules."""
+        from .speculation import commit_row
+
+        active_rows = [r for r in self.active if r is not None]
+        live = [r for r in active_rows if not r.done]
+        if not live:
+            return emitted
+        max_pos = max(r.position for r in live)
+        # every fused iteration needs a full K-token cache window
+        room = (self.cfg.seq_len - 1 - max_pos) // self.k
+        if room <= 0:
+            # a row within K-1 positions of seq_len still has budget for its
+            # remaining tokens: finish it with EXACT plain decode steps (draft
+            # KV gaps from this path only dent later acceptance rates, never
+            # correctness — the target verifies every token)
+            return self._step_plain(key, emitted)
+        iters = max(1, min(self.spec_chunk, room,
+                           # an iteration commits >=1 token/row: running past the
+                           # tightest row's remaining budget only wastes flops
+                           min(r.max_new_tokens - len(r.generated)
+                               for r in live)))
+        if self.paged:
+            active_rows = self._grow_blocks(active_rows, iters * self.k)
+            if not active_rows:
+                return emitted
+        alive0 = np.array([r is not None and not r.done for r in self.active])
+        eos_ids = np.array(
+            [(-1 if r is None or r.eos_token_id is None else r.eos_token_id)
+             for r in self.active], dtype=np.int32)
+        key, sub = jax.random.split(key)
+        sp = self._sampling_matrix()
+        bt = (jnp.asarray(self.block_table) if self.paged
+              else jnp.zeros((1, 1), dtype=jnp.int32))
+        bucket = (None if self.paged
+                  else autobucketing.select_bucket(self.app.tkg_buckets,
+                                                   max_pos + iters * self.k))
+        outs, ns, self.cache, self.d_cache = self._spec_step(
+            self.app.params, self.draft.params, jnp.asarray(self.last_tok),
+            jnp.asarray(self.positions), jnp.asarray(alive0), self.cache,
+            self.d_cache, bt, sp, jnp.asarray(eos_ids), sub,
+            num_iters=iters, greedy=self._greedy, decode_bucket=bucket)
+        outs = np.asarray(outs)           # (iters, slots, K)
+        ns = np.asarray(ns)               # (iters, slots)
+        for it in range(iters):
+            for slot, req in enumerate(self.active):
+                if req is None or req.done:
+                    continue
+                take = int(ns[it, slot]) + 1
+                pre = len(req.generated)
+                done = commit_row(req.generated, outs[it, slot, :take],
+                                  req.eos_token_id, req.max_new_tokens)
+                added = len(req.generated) - pre
+                if added:
+                    self.acceptance_counts[added - 1] += 1
+                req.position += added
+                emitted.setdefault(req.request_id, []).extend(
+                    req.generated[pre:])
+                self.positions[slot] = req.position
+                self.last_tok[slot] = req.generated[-1]
+                if done:
+                    self._finish(req)
         return emitted
 
     def run_to_completion(self, seed: int = 0) -> Dict[int, List[int]]:
@@ -542,6 +838,12 @@ class ContinuousBatchingRunner:
                     padded.last_token_idx, self.cache,
                     jnp.asarray(self.block_table[slot : slot + 1]),
                     jnp.asarray(slot_map), sp_row, sub)
+                if self.draft is not None:
+                    self.d_cache = self._d_insert_step(
+                        self.draft.params, padded.input_ids, pos_row,
+                        self.d_cache,
+                        jnp.asarray(self.block_table[slot : slot + 1]),
+                        jnp.asarray(slot_map))
                 start += len(window)
         elif len(fed) > self.app.cte_buckets[-1]:
             # dense windowed (chunked) prefill at this slot's cache row, then a
@@ -570,6 +872,11 @@ class ContinuousBatchingRunner:
                 self.app.params, padded.input_ids, padded.position_ids,
                 padded.last_token_idx, self.cache, jnp.asarray(slot, dtype=jnp.int32),
                 sp_row, key)
+            if self.draft is not None:
+                self.d_cache = self._d_insert_step(
+                    self.draft.params, padded.input_ids, padded.position_ids,
+                    padded.last_token_idx, self.d_cache,
+                    jnp.asarray(slot, dtype=jnp.int32))
         return int(np.asarray(tok_dev)[0])
 
     def _maybe_finish(self, req: Request, emitted) -> None:

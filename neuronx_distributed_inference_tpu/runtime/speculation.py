@@ -94,6 +94,53 @@ def assemble_spec_output(committed: List[List[int]], padded, b: int,
                               ttft_s=ttft)
 
 
+def speculative_accept(draft_toks, draft_logits, t_logits, sampling_params, key,
+                       odsc, greedy: bool, vocab: int):
+    """Speculative acceptance for one verify window — shared by the whole-batch
+    fused flow and the continuous-batching serving path.
+
+    draft_toks (B, K-1) int32, draft_logits (B, K-1, V), t_logits (B, K, V).
+    Greedy: exact token match (`n` = longest accepted prefix). Multinomial:
+    rejection sampling — accept d_j with prob min(1, p_t(d_j)/p_d(d_j)), resample
+    the first rejection from norm(max(p_t - p_d, 0)) (acceptance math ≈ reference
+    `model_base.py:1706-1790`). Returns (out_toks (B, K), n (B,)):
+    out_toks[:, :n+1] are the committed tokens (n accepted drafts + one
+    correction/bonus)."""
+    k = t_logits.shape[1]
+    if greedy:
+        t_toks = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)      # (B, K)
+        matches = draft_toks == t_toks[:, :-1]                        # (B, K-1)
+        n = jnp.cumprod(matches.astype(jnp.int32), axis=1).sum(axis=1)
+        return t_toks, n.astype(jnp.int32)
+    key_acc, key_res, key_bonus = jax.random.split(key, 3)
+    sp = sampling_params[:, None, :]      # broadcast over the K-1 positions
+    pt_w, pt_idx = sampling_ops.window_probs(t_logits[:, :-1], sp, odsc)
+    pd_w, pd_idx = sampling_ops.window_probs(draft_logits, sp, odsc)
+    p_t = sampling_ops.scatter_to_vocab(pt_w, pt_idx, vocab)          # (B,K-1,V)
+    p_d = sampling_ops.scatter_to_vocab(pd_w, pd_idx, vocab)
+    d_sel = draft_toks[..., None]
+    pt_d = jnp.take_along_axis(p_t, d_sel, axis=-1)[..., 0]           # (B, K-1)
+    pd_d = jnp.take_along_axis(p_d, d_sel, axis=-1)[..., 0]
+    u = jax.random.uniform(key_acc, pt_d.shape, dtype=jnp.float32)
+    accept = u < jnp.minimum(1.0, pt_d / jnp.maximum(pd_d, 1e-20))
+    n = jnp.cumprod(accept.astype(jnp.int32), axis=1).sum(axis=1)
+
+    resid = jnp.maximum(p_t - p_d, 0.0)
+    resid_sum = resid.sum(axis=-1, keepdims=True)
+    # all-accepted positions may have a zero residual; fall back to p_t
+    resid = jnp.where(resid_sum > 1e-9, resid / jnp.maximum(resid_sum, 1e-20),
+                      p_t)
+    resampled = jax.random.categorical(
+        key_res, jnp.log(jnp.maximum(resid, 1e-20)), axis=-1
+    ).astype(jnp.int32)                                               # (B, K-1)
+    bonus = sampling_ops.sample(t_logits[:, -1], sampling_params, key_bonus, odsc)
+    drafts_ext = jnp.concatenate([draft_toks, bonus[:, None]], axis=1)
+    correction = jnp.concatenate([resampled, bonus[:, None]], axis=1)
+    slot = jnp.arange(k)[None, :]
+    out_toks = jnp.where(slot < n[:, None], drafts_ext, correction)
+    return out_toks, n.astype(jnp.int32)
+
+
 class FusedSpeculativeModel:
     """Owns a target and a draft `TpuModelForCausalLM` and runs fused spec decode.
 
@@ -169,7 +216,7 @@ class FusedSpeculativeModel:
             (static) is set — the capture feeding draft-logit accuracy checks
             (≈ reference `capture_draft_logits`, `utils/accuracy.py:1214`) — else ().
             """
-            key_d, key_acc, key_res, key_bonus = jax.random.split(key, 4)
+            key_d, key_acc = jax.random.split(key)
             d_keys = jax.random.split(key_d, k)
 
             # --- draft loop: k iterations proposing k-1 candidates (one dispatch).
@@ -203,43 +250,11 @@ class FusedSpeculativeModel:
                     t_params, t_args, target_in, positions, t_cache, decode_bucket,
                     mesh=mesh, rules=rules, **t_kernel)  # (B, K, V)
 
-            if greedy:
-                t_toks = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)  # (B, K)
-                matches = draft_toks == t_toks[:, :-1]                    # (B, K-1)
-                n = jnp.cumprod(matches.astype(jnp.int32), axis=1).sum(axis=1)
-                out_toks = t_toks
-            else:
-                # rejection sampling: accept d_j with prob min(1, p_t(d_j)/p_d(d_j));
-                # on first rejection resample from norm(max(p_t - p_d, 0)).
-                sp = sampling_params[:, None, :]  # broadcast over the K-1 positions
-                pt_w, pt_idx = sampling_ops.window_probs(t_logits[:, :-1], sp, odsc)
-                pd_w, pd_idx = sampling_ops.window_probs(draft_logits, sp, odsc)
-                p_t = sampling_ops.scatter_to_vocab(pt_w, pt_idx, vocab)  # (B,K-1,V)
-                p_d = sampling_ops.scatter_to_vocab(pd_w, pd_idx, vocab)
-                d_sel = draft_toks[..., None]
-                pt_d = jnp.take_along_axis(p_t, d_sel, axis=-1)[..., 0]   # (B, K-1)
-                pd_d = jnp.take_along_axis(p_d, d_sel, axis=-1)[..., 0]
-                u = jax.random.uniform(key_acc, pt_d.shape, dtype=jnp.float32)
-                accept = u < jnp.minimum(1.0, pt_d / jnp.maximum(pd_d, 1e-20))
-                n = jnp.cumprod(accept.astype(jnp.int32), axis=1).sum(axis=1)
-
-                resid = jnp.maximum(p_t - p_d, 0.0)
-                resid_sum = resid.sum(axis=-1, keepdims=True)
-                # all-accepted positions may have a zero residual; fall back to p_t
-                resid = jnp.where(resid_sum > 1e-9, resid / jnp.maximum(resid_sum, 1e-20),
-                                  p_t)
-                resampled = jax.random.categorical(
-                    key_res, jnp.log(jnp.maximum(resid, 1e-20)), axis=-1
-                ).astype(jnp.int32)                                        # (B, K-1)
-                bonus = sampling_ops.sample(t_logits[:, -1], sampling_params,
-                                            key_bonus, odsc)               # (B,)
-                drafts_ext = jnp.concatenate([draft_toks, bonus[:, None]], axis=1)
-                correction = jnp.concatenate([resampled, bonus[:, None]], axis=1)
-                slot = jnp.arange(k)[None, :]
-                out_toks = jnp.where(slot < n[:, None], drafts_ext, correction)
-
+            out_toks, n = speculative_accept(draft_toks, draft_logits, t_logits,
+                                             sampling_params, key_acc, odsc,
+                                             greedy, vocab)
             extras = draft_logits if with_draft_logits else ()
-            return out_toks, n.astype(jnp.int32), t_cache, d_cache, extras
+            return out_toks, n, t_cache, d_cache, extras
 
         self._spec_step = jax.jit(
             _step, donate_argnums=(4, 5),

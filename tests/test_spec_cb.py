@@ -1,0 +1,186 @@
+"""Speculative decoding through continuous batching (the serving path).
+
+≈ the reference serving fused speculation through CB + paged KV
+(`block_kv_cache_manager.py:402-431` ``generate_fusedspec_slot_mapping``,
+CB/fused-spec config coupling `models/config.py:245-258`).
+
+Correctness bar: greedy fused speculation is an EXACT acceleration, so CB+spec
+serving must emit exactly the tokens a dedicated plain greedy run produces —
+across paged and dense caches, staggered placement / slot reuse, prefix caching,
+eos stopping, and regardless of the draft model.
+"""
+
+import numpy as np
+import pytest
+
+from neuronx_distributed_inference_tpu.config import (
+    OnDeviceSamplingConfig, TpuConfig, load_pretrained_config)
+from neuronx_distributed_inference_tpu.models.llama.modeling_llama import (
+    LlamaForCausalLM, LlamaInferenceConfig)
+from neuronx_distributed_inference_tpu.runtime.continuous_batching import (
+    ContinuousBatchingRunner)
+
+pytestmark = pytest.mark.slow  # heavy e2e: excluded from the fast gate
+
+
+def _make_app(hf_cfg, seed=0, paged=False, slots=2, do_sample=False):
+    tpu_cfg = TpuConfig(
+        batch_size=slots, seq_len=96, max_context_length=32, dtype="float32",
+        context_encoding_buckets=[16, 32], token_generation_buckets=[48, 96],
+        is_continuous_batching=True, paged_attention_enabled=paged,
+        pa_num_blocks=48, pa_block_size=8,
+        on_device_sampling_config=OnDeviceSamplingConfig(do_sample=do_sample),
+    )
+    config = LlamaInferenceConfig(tpu_cfg, load_config=load_pretrained_config(hf_cfg))
+    app = LlamaForCausalLM(None, config)
+    app.load_random(seed=seed)
+    return app
+
+
+def _draft_cfg(tiny_llama_hf_config):
+    cfg = dict(tiny_llama_hf_config)
+    cfg.update(hidden_size=32, intermediate_size=64, num_hidden_layers=1,
+               num_attention_heads=2, num_key_value_heads=2)
+    return cfg
+
+
+@pytest.fixture(scope="module")
+def prompts():
+    rng = np.random.default_rng(7)
+    return [rng.integers(1, 256, size=(n,)).astype(np.int32) for n in (12, 7, 19)]
+
+
+@pytest.fixture(scope="module")
+def reference_tokens(tiny_llama_hf_config, prompts):
+    """Per-prompt greedy tokens from dedicated plain (non-spec) runs."""
+    app = _make_app(tiny_llama_hf_config)
+    return {i: app.generate(p[None, :], max_new_tokens=10).tokens[0].tolist()
+            for i, p in enumerate(prompts)}
+
+
+def _spec_runner(tiny_llama_hf_config, paged, **kw):
+    target = _make_app(tiny_llama_hf_config, seed=0, paged=paged)
+    draft = _make_app(_draft_cfg(tiny_llama_hf_config), seed=1, paged=paged)
+    return ContinuousBatchingRunner(target, draft=draft, speculation_length=4,
+                                    **kw)
+
+
+def test_paged_cb_spec_matches_dedicated_runs(tiny_llama_hf_config, prompts,
+                                              reference_tokens):
+    runner = _spec_runner(tiny_llama_hf_config, paged=True, spec_chunk=2)
+    ids = [runner.submit(p, max_new_tokens=10) for p in prompts]  # 3 reqs, 2 slots
+    results = runner.run_to_completion()
+    assert set(results) == set(ids)
+    for i, rid in enumerate(ids):
+        assert results[rid] == reference_tokens[i], f"request {i} diverged"
+    # all blocks returned after completion
+    assert runner.allocator.num_free == runner.allocator.num_blocks
+
+
+def test_dense_cb_spec_matches_dedicated_runs(tiny_llama_hf_config, prompts,
+                                              reference_tokens):
+    runner = _spec_runner(tiny_llama_hf_config, paged=False, spec_chunk=2)
+    ids = [runner.submit(p, max_new_tokens=10) for p in prompts]
+    results = runner.run_to_completion()
+    for i, rid in enumerate(ids):
+        assert results[rid] == reference_tokens[i], f"request {i} diverged"
+
+
+def test_cb_spec_self_draft_accepts_everything(tiny_llama_hf_config, prompts):
+    """Draft == target: every window fully accepts, so the acceptance histogram
+    is concentrated at K and throughput is ~K tokens per fused iteration."""
+    target = _make_app(tiny_llama_hf_config, seed=0, paged=True)
+    draft = _make_app(tiny_llama_hf_config, seed=0, paged=True)
+    runner = ContinuousBatchingRunner(target, draft=draft, speculation_length=4)
+    # budget = 1 (insert token) + 3 full K=4 windows, so every commit is full
+    # and the committed-token histogram concentrates at K
+    rid = runner.submit(prompts[0], max_new_tokens=13)
+    results = runner.run_to_completion()
+    ref = _make_app(tiny_llama_hf_config).generate(
+        prompts[0][None, :], max_new_tokens=13).tokens[0].tolist()
+    assert results[rid] == ref
+    assert runner.acceptance_counts[:-1].sum() == 0, "self-draft must fully accept"
+    assert runner.acceptance_counts[-1] > 0
+
+
+def test_cb_spec_eos_stops_row_exactly(tiny_llama_hf_config, prompts,
+                                       reference_tokens):
+    """An eos mid-stream stops that request at the eos token; co-resident
+    requests are unaffected."""
+    eos = reference_tokens[0][4]
+    runner = _spec_runner(tiny_llama_hf_config, paged=True)
+    r0 = runner.submit(prompts[0], max_new_tokens=10, eos_token_id=eos)
+    r1 = runner.submit(prompts[1], max_new_tokens=10)
+    results = runner.run_to_completion()
+    want = reference_tokens[0][: reference_tokens[0].index(eos) + 1]
+    assert results[r0] == want
+    assert results[r0][-1] == eos
+    assert results[r1] == reference_tokens[1]
+
+
+def test_cb_spec_prefix_cache_shares_blocks(tiny_llama_hf_config):
+    """Prefix caching under spec serving: the second request's full prefix
+    blocks are shared AND both caches (target + draft) serve it correctly —
+    every insert writes both pools, so the host-side content hash stays valid."""
+    rng = np.random.default_rng(3)
+    prefix = rng.integers(1, 256, size=(16,)).astype(np.int32)
+    pa = np.concatenate([prefix, rng.integers(1, 256, size=(4,)).astype(np.int32)])
+    pb = np.concatenate([prefix, rng.integers(1, 256, size=(5,)).astype(np.int32)])
+    plain = _make_app(tiny_llama_hf_config)
+    want_a = plain.generate(pa[None, :], max_new_tokens=8).tokens[0].tolist()
+    want_b = plain.generate(pb[None, :], max_new_tokens=8).tokens[0].tolist()
+
+    runner = _spec_runner(tiny_llama_hf_config, paged=True)
+    ra = runner.submit(pa, max_new_tokens=8)
+    rb = runner.submit(pb, max_new_tokens=8)
+    runner.step()
+    req_a = runner.finished.get(ra) or next(
+        r for r in runner.active if r and r.request_id == ra)
+    req_b = runner.finished.get(rb) or next(
+        r for r in runner.active if r and r.request_id == rb)
+    assert req_a.blocks[:2] == req_b.blocks[:2], "prefix blocks not shared"
+    results = runner.run_to_completion()
+    assert results[ra] == want_a
+    assert results[rb] == want_b
+
+
+def test_cb_spec_multinomial_runs_deterministically(tiny_llama_hf_config,
+                                                    prompts):
+    """Multinomial spec serving: rejection-sampling acceptance runs end-to-end
+    and is reproducible for a fixed seed."""
+    def run():
+        target = _make_app(tiny_llama_hf_config, seed=0, paged=True,
+                           do_sample=True)
+        draft = _make_app(_draft_cfg(tiny_llama_hf_config), seed=1, paged=True,
+                          do_sample=True)
+        runner = ContinuousBatchingRunner(target, draft=draft,
+                                          speculation_length=3)
+        ids = [runner.submit(p, max_new_tokens=8) for p in prompts[:2]]
+        return [runner.run_to_completion(seed=5)[rid] for rid in ids]
+
+    first, second = run(), run()
+    assert first == second
+    assert all(len(t) == 8 for t in first)
+
+
+def test_cb_spec_seq_boundary_finishes_exactly(tiny_llama_hf_config):
+    """A request whose tail lands within K-1 positions of seq_len must still
+    finish with its full budget via the exact plain-decode fallback (it must
+    NOT be force-truncated: found-by-review regression)."""
+    rng = np.random.default_rng(11)
+    prompt = rng.integers(1, 256, size=(88,)).astype(np.int32)  # 88 + 6 <= 96
+    plain = _make_app(tiny_llama_hf_config)
+    want = plain.generate(prompt[None, :], max_new_tokens=6).tokens[0].tolist()
+
+    runner = _spec_runner(tiny_llama_hf_config, paged=True)
+    rid = runner.submit(prompt, max_new_tokens=6)
+    results = runner.run_to_completion()
+    assert results[rid] == want
+    assert not runner.finished[rid].truncated
+
+
+def test_cb_spec_validates_geometry(tiny_llama_hf_config):
+    target = _make_app(tiny_llama_hf_config, seed=0, paged=True)
+    draft = _make_app(_draft_cfg(tiny_llama_hf_config), seed=1, paged=True)
+    with pytest.raises(ValueError, match="speculation_length"):
+        ContinuousBatchingRunner(target, draft=draft, speculation_length=1)
