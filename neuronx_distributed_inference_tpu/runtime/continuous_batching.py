@@ -22,6 +22,7 @@ seq_id, `modules/kvcache/data_parallel_kv_cache_manager.py`, block-KV slot mappi
 from __future__ import annotations
 
 import logging
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -44,9 +45,22 @@ class Request:
     prompt: np.ndarray                   # (S,) int32
     max_new_tokens: int
     eos_token_id: Optional[int] = None
+    # per-request (3,) [top_k, top_p, temperature]; None = runner defaults
+    # (≈ reference per-request sampling params, `generation/sampling.py:99-209`)
+    sampling_params: Optional[np.ndarray] = None
+    # multi-LoRA adapter slot (0 = base weights; ≈ reference CB forward carrying
+    # adapter_ids per batch line, `models/model_wrapper.py:252-311`)
+    adapter_id: int = 0
     generated: List[int] = field(default_factory=list)
     slot: int = -1
     blocks: List[int] = field(default_factory=list)
+    # chunked-prefill state (paged, max_insert_tokens_per_step): the request
+    # holds its slot while its prompt streams in bounded windows, excluded from
+    # decode until complete (≈ reference chunked prefill, `kvcache/utils.py`)
+    inserting: bool = False
+    fed: Optional[np.ndarray] = None     # prompt (+ resumed generated) to write
+    insert_pos: int = 0                  # fed tokens already written
+    tok0_dev: object = None              # final window's sampled seed token
     # KV write position of the *next fed token* == len(prompt) + len(generated) - 1
     # (the newest generated token is the next input; its KV is not yet written)
     position: int = 0
@@ -75,10 +89,22 @@ class ContinuousBatchingRunner:
     def __init__(self, app, decode_chunk: Optional[int] = None,
                  async_mode: Optional[bool] = None, draft=None,
                  speculation_length: Optional[int] = None,
-                 spec_chunk: Optional[int] = None):
+                 spec_chunk: Optional[int] = None,
+                 max_insert_tokens_per_step: Optional[int] = None):
         cfg = app.tpu_config
         if not cfg.is_continuous_batching:
             raise ValueError("tpu_config.is_continuous_batching must be enabled")
+        if max_insert_tokens_per_step is not None:
+            if not cfg.paged_attention_enabled:
+                raise ValueError("max_insert_tokens_per_step (chunked-prefill "
+                                 "scheduling) requires paged attention")
+            if max_insert_tokens_per_step < 1:
+                raise ValueError("max_insert_tokens_per_step must be >= 1")
+        # chunked-prefill scheduling: cap prompt tokens written per step so a
+        # long insert interleaves with resident decode chunks instead of
+        # stalling them (bounds resident decode latency / TTFT jitter; ≈ the
+        # reference's chunked prefill interleave, `modules/kvcache/utils.py`)
+        self.insert_cap = max_insert_tokens_per_step
         self.app = app
         self.cfg = cfg
         self.paged = cfg.paged_attention_enabled
@@ -86,7 +112,9 @@ class ContinuousBatchingRunner:
             raise ValueError("paged attention is not supported for per-layer "
                              "attention patterns (rolling sliding caches)")
         self.num_slots = cfg.max_batch_size
-        self.decode_chunk = decode_chunk or min(8, max(1, cfg.decode_chunk_size))
+        # config-consistent with the dense path (decode_chunk_size default 32):
+        # the serving loop pays the host round trip once per chunk
+        self.decode_chunk = decode_chunk or max(1, cfg.decode_chunk_size)
         self.sampling_config = app.sampling_config
         # async dispatch-ahead (≈ application.generate's async_mode and the
         # reference's 2-deep async decode, `modules/async_execution.py:190-306`):
@@ -96,18 +124,39 @@ class ContinuousBatchingRunner:
         # eos stop, every row >2 chunks from its max/seq bound, block headroom);
         # anything else drains the pipeline and runs the exact sync path, so
         # emitted-token semantics only ever LAG by one chunk, never change.
+        #
+        # Modes: True = always (exactness-gated), False = never, "auto" =
+        # measured self-selection — dispatch-ahead only pays when the host
+        # round trip is a sizable fraction of the chunk's wall time (measured
+        # r4: +32% at short chunks, a 5% REGRESSION at 0.9 s chunks where the
+        # ~100 ms round trip is already amortized), so auto times the first
+        # sync chunks and a blocking round trip, then decides.
         self.async_mode = (cfg.async_mode if async_mode is None else async_mode)
+        self._async_auto = self.async_mode == "auto"
+        if self._async_auto:
+            self.async_mode = False            # until measured
+        self._chunk_times: List[float] = []
+        self._round_trip_s: Optional[float] = None
         self._pending = None                   # (toks_dev (slots, steps), steps)
 
         # host-side greedy detection (== application.generate's): every slot
         # argmax -> the decode chunk compiles without the dynamic sampling
-        # window (measured 6.3 ms/step of global-topk at bs=64, 128k vocab)
+        # window (measured 6.3 ms/step of global-topk at bs=64, 128k vocab).
+        # With per-request params the flag is re-derived per chunk over the
+        # LIVE rows (_chunk_greedy), so all-greedy traffic keeps the fast
+        # executable and mixed traffic falls back to the (B, 3) sampler.
         sp = sampling_ops.prepare_sampling_params(
             1, top_k=self.sampling_config.top_k,
             top_p=self.sampling_config.top_p,
             temperature=self.sampling_config.temperature)
         self._greedy = (not self.sampling_config.do_sample
                         and bool((np.asarray(sp)[:, 0] == 1).all()))
+        # per-slot (slots, 3) sampling matrix; rows overwritten at placement
+        self._default_sp_row = np.asarray(sp)[0]
+        self._slot_sp = np.tile(self._default_sp_row, (self.num_slots, 1))
+        # per-slot LoRA adapter slots (0 = base), threaded into every chunk
+        self.adapter_ids = np.zeros((self.num_slots,), dtype=np.int32)
+        self._lora_on = app.arch_args.lora is not None
 
         # --- fused speculation through the serving loop ------------------------
         self.draft = draft
@@ -147,6 +196,7 @@ class ContinuousBatchingRunner:
             # advance is data-dependent (accepted length), so the pipeline
             # cannot be proven exact — the on-device chunk amortizes instead
             self.async_mode = False
+            self._async_auto = False
             # histogram over tokens-committed-per-(row, iteration), length K
             self.acceptance_counts = np.zeros((self.k,), dtype=np.int64)
 
@@ -216,7 +266,8 @@ class ContinuousBatchingRunner:
                 {"use_kernel": True} if app._use_paged_decode_kernel() else {})
 
             def _insert(params, input_ids, position_ids, last_token_idx, cache,
-                        block_table_row, slot_mapping, sampling_params, key):
+                        block_table_row, slot_mapping, sampling_params, key,
+                        adapter_row):
                 """Batch-1 (prefix-)prefill into paged blocks: a wide decode call whose
                 queries are the (suffix) tokens; prior blocks are visible through the
                 block table."""
@@ -224,14 +275,15 @@ class ContinuousBatchingRunner:
                     logits, cache = decode_core(
                         params, args, input_ids, position_ids, cache, None,
                         mesh=mesh, rules=rules, block_table=block_table_row,
-                        slot_mapping=slot_mapping)
+                        slot_mapping=slot_mapping, adapter_ids=adapter_row)
                 last = jnp.take_along_axis(
                     logits, last_token_idx[:, None, None], axis=1)[:, 0]
                 tok = sampling_ops.sample(last, sampling_params, key, odsc)
                 return tok, cache
 
             def _decode(params, tok0, positions, cache, block_table, slot_chunk,
-                        sampling_params, key, num_steps, greedy=False):
+                        sampling_params, key, adapter_ids, num_steps,
+                        greedy=False):
                 keys = jax.random.split(key, num_steps)
                 slots_t = slot_chunk.T[:, :, None]          # (T, B, 1)
 
@@ -242,7 +294,8 @@ class ContinuousBatchingRunner:
                         logits, cache = decode_core(
                             params, args, tok[:, None], pos, cache, None,
                             mesh=mesh, rules=rules, block_table=block_table,
-                            slot_mapping=slots_j, **paged_kernel_kw)
+                            slot_mapping=slots_j, adapter_ids=adapter_ids,
+                            **paged_kernel_kw)
                         if greedy:
                             # all rows argmax: skip the global-topk sampling
                             # window (measured 6.3 ms/step at bs=64, 128k vocab)
@@ -269,17 +322,18 @@ class ContinuousBatchingRunner:
             kernel_kw = ({"use_kernel": True} if app._use_decode_kernel() else {})
 
             def _insert(params, input_ids, position_ids, last_token_idx, cache,
-                        slot, sampling_params, key):
+                        slot, sampling_params, key, adapter_row):
                 with jax.default_matmul_precision(precision):
                     logits, cache = prefill_core(
                         params, args, input_ids, position_ids, last_token_idx, cache,
                         mesh=mesh, rules=rules, cache_batch_start=slot,
-                        use_flash=use_flash, use_ring=use_ring)
+                        use_flash=use_flash, use_ring=use_ring,
+                        adapter_ids=adapter_row)
                 tok = sampling_ops.sample(logits, sampling_params, key, odsc)
                 return tok, cache
 
             def _decode(params, tok0, positions, cache, sampling_params, key,
-                        decode_bucket, num_steps, greedy=False):
+                        adapter_ids, decode_bucket, num_steps, greedy=False):
                 keys = jax.random.split(key, num_steps)
 
                 def body(carry, step_key):
@@ -287,7 +341,8 @@ class ContinuousBatchingRunner:
                     with jax.default_matmul_precision(precision):
                         logits, cache = decode_core(
                             params, args, tok[:, None], pos, cache, decode_bucket,
-                            mesh=mesh, rules=rules, **kernel_kw)
+                            mesh=mesh, rules=rules, adapter_ids=adapter_ids,
+                            **kernel_kw)
                         if greedy:
                             nxt = sampling_ops.greedy(logits[:, -1])
                         else:
@@ -299,7 +354,8 @@ class ContinuousBatchingRunner:
                 (_, _, cache), toks = jax.lax.scan(body, (tok0, positions, cache), keys)
                 return toks.T, cache
 
-            def _window(params, input_ids, start, slot, cache, decode_bucket):
+            def _window(params, input_ids, start, slot, cache, adapter_row,
+                        decode_bucket):
                 """Batch-1 dense windowed-prefill step at cache row ``slot`` (dense
                 analog of the paged chunked insert; ≈ windowed CTE,
                 `model_base.py:918-973`)."""
@@ -307,17 +363,19 @@ class ContinuousBatchingRunner:
                 with jax.default_matmul_precision(precision):
                     _, cache = model_base.decode_forward(
                         params, args, input_ids, pos, cache, decode_bucket,
-                        mesh=mesh, rules=rules, window_row=slot)
+                        mesh=mesh, rules=rules, window_row=slot,
+                        adapter_ids=adapter_row)
                 return cache
 
             def _seed(params, tok, pos, slot, cache, sampling_params, key,
-                      decode_bucket):
+                      adapter_row, decode_bucket):
                 """Re-feed the prompt's last token (idempotent KV rewrite) to obtain
                 seed logits after a windowed insert."""
                 with jax.default_matmul_precision(precision):
                     logits, cache = model_base.decode_forward(
                         params, args, tok[:, None], pos, cache, decode_bucket,
-                        mesh=mesh, rules=rules, window_row=slot)
+                        mesh=mesh, rules=rules, window_row=slot,
+                        adapter_ids=adapter_row)
                 out = sampling_ops.sample(logits[:, -1], sampling_params, key, odsc)
                 return out, cache
 
@@ -369,7 +427,7 @@ class ContinuousBatchingRunner:
 
         def _spec_chunk(t_params, d_params, tok0, positions, alive0, t_cache,
                         d_cache, block_table, sampling_params, eos_ids, key,
-                        num_iters, greedy, decode_bucket=None):
+                        adapter_ids, num_iters, greedy, decode_bucket=None):
             iter_keys = jax.random.split(key, num_iters)
 
             def one_iter(carry, key_i):
@@ -417,9 +475,13 @@ class ContinuousBatchingRunner:
 
                 t_in = jnp.concatenate([tok[:, None], d_toks], axis=1)
                 with jax.default_matmul_precision(precision):
+                    # adapters apply to the TARGET only: the draft proposes from
+                    # base weights (acceptance corrects any drift — exactness
+                    # never depends on the draft)
                     t_logits, t_cache = t_decode(
                         t_params, t_args, t_in, pos, t_cache, decode_bucket,
-                        mesh=mesh, rules=rules, **t_extra, **t_kw)
+                        mesh=mesh, rules=rules, adapter_ids=adapter_ids,
+                        **t_extra, **t_kw)
                 out_toks, n = speculative_accept(
                     d_toks, d_logits, t_logits, sampling_params, key_acc,
                     greedy=greedy, odsc=odsc, vocab=vocab)
@@ -474,10 +536,35 @@ class ContinuousBatchingRunner:
 
     # ------------------------------------------------------------------ API
     def submit(self, prompt: np.ndarray, max_new_tokens: int = 32,
-               eos_token_id: Optional[int] = None) -> int:
+               eos_token_id: Optional[int] = None,
+               sampling_params=None, adapter_id: int = 0) -> int:
+        """``sampling_params``: per-request (3,) [top_k, top_p, temperature]
+        (≈ reference per-request sampling, `generation/sampling.py:99-209`);
+        ``adapter_id``: multi-LoRA slot, 0 = base (≈ CB forward adapter_ids,
+        `models/model_wrapper.py:252-311`)."""
         prompt = np.asarray(prompt).astype(np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
+        if sampling_params is not None:
+            sampling_params = np.asarray(sampling_params,
+                                         dtype=np.float32).reshape(-1)
+            if sampling_params.shape != (3,):
+                raise ValueError("sampling_params must be (top_k, top_p, "
+                                 "temperature)")
+            if not (self.sampling_config.dynamic
+                    or self.sampling_config.do_sample):
+                raise ValueError(
+                    "per-request sampling_params require a sampling config "
+                    "with dynamic=True or do_sample=True (otherwise the "
+                    "on-device sampler is a plain argmax and the params "
+                    "would be silently ignored)")
+        if adapter_id != 0:
+            if not self._lora_on:
+                raise ValueError("adapter_id given but the model has no "
+                                 "lora_serving_config")
+            n_slots = self.app.arch_args.lora.num_slots
+            if not (0 <= adapter_id < n_slots):
+                raise ValueError(f"adapter_id must be in [0, {n_slots})")
         if prompt.size + max_new_tokens > self.cfg.seq_len:
             raise ValueError(f"prompt ({prompt.size}) + max_new_tokens "
                              f"({max_new_tokens}) exceeds seq_len {self.cfg.seq_len}")
@@ -501,10 +588,25 @@ class ContinuousBatchingRunner:
                 raise ValueError(
                     f"windowed prefill needs {total} cache slots (prompt rounded up "
                     f"to {w}-wide windows) but seq_len is {self.cfg.seq_len}")
-        req = Request(self._next_id, prompt, max_new_tokens, eos_token_id)
+        req = Request(self._next_id, prompt, max_new_tokens, eos_token_id,
+                      sampling_params=sampling_params, adapter_id=adapter_id)
         self._next_id += 1
         self.queue.append(req)
         return req.request_id
+
+    def _row_greedy(self, req: Request) -> bool:
+        """Does this request's sampling reduce to exact argmax? (top_k == 1
+        rows take the argmax branch inside ops/sampling.sample regardless of
+        temperature/top_p/noise.)"""
+        if req.sampling_params is None:
+            return self._greedy
+        return float(req.sampling_params[0]) == 1.0
+
+    def _chunk_greedy(self, rows: List[Request]) -> bool:
+        """All-greedy chunks compile without the dynamic sampling window
+        (measured 6.3 ms/step at bs=64 over a 128k vocab); any sampled row
+        falls the whole chunk back to the per-request (B, 3) sampler."""
+        return all(self._row_greedy(r) for r in rows)
 
     @property
     def has_work(self) -> bool:
@@ -517,6 +619,8 @@ class ContinuousBatchingRunner:
         cannot preempt while a chunk is in flight."""
         if not self.async_mode or self.queue:
             return False
+        if any(r is not None and r.inserting for r in self.active):
+            return False     # mid-insert rows activate at unpredictable steps
         rows = [r for r in self.active if r is not None and not r.done]
         if not rows:
             return False
@@ -547,7 +651,7 @@ class ContinuousBatchingRunner:
                 emitted: Dict[int, List[int]]) -> None:
         """Fold one synced chunk's tokens (slots, steps) into request state."""
         for slot, req in enumerate(self.active):
-            if req is None or req.done:
+            if req is None or req.done or req.inserting:
                 continue
             for j in range(steps):
                 t = int(toks[slot, j])
@@ -578,20 +682,55 @@ class ContinuousBatchingRunner:
                 if self.allocator.num_free < need:
                     break
             self.queue.pop(0)
+            # per-slot sampling/adapter rows must be live BEFORE the insert
+            # samples the request's first token
+            self._slot_sp[slot] = (req.sampling_params
+                                   if req.sampling_params is not None
+                                   else self._default_sp_row)
+            self.adapter_ids[slot] = req.adapter_id
+            req.slot = slot
+            self._place_counter += 1
+            req.placed_seq = self._place_counter
+            self.active[slot] = req
+            if self.insert_cap is not None:
+                # chunked-prefill scheduling: the slot is held, the prompt
+                # streams in bounded windows via _advance_inserts
+                self._begin_insert(req, slot)
+                continue
             key, sub = jax.random.split(key)
             resumed = bool(req.generated)   # preempted earlier; KV recomputed now
             tok0 = self._insert(req, slot, sub)
-            req.slot = slot
             req.position = fed_len
-            self._place_counter += 1
-            req.placed_seq = self._place_counter
             if not resumed:
                 req.generated = [tok0]
                 emitted.setdefault(req.request_id, []).append(tok0)
-            self.active[slot] = req
             self.positions[slot] = req.position
             self.last_tok[slot] = req.generated[-1]
             self._maybe_finish(req, emitted)
+        return key
+
+    def _advance_inserts(self, key, emitted: Dict[int, List[int]]):
+        """Chunked-prefill scheduling: spend at most ``insert_cap`` prompt
+        tokens across the in-progress inserts, activating each request for
+        decode once its final window lands. Returns the advanced PRNG key."""
+        budget = self.insert_cap
+        for slot, req in enumerate(self.active):
+            if req is None or not req.inserting or budget <= 0:
+                continue
+            key, used = self._insert_windows(req, slot, key, budget=budget)
+            budget -= used
+            if req.insert_pos >= len(req.fed):
+                req.inserting = False
+                resumed = bool(req.generated)
+                req.position = len(req.fed)
+                tok0 = int(np.asarray(req.tok0_dev)[0])
+                req.tok0_dev = None
+                if not resumed:
+                    req.generated = [tok0]
+                    emitted.setdefault(req.request_id, []).append(tok0)
+                self.positions[slot] = req.position
+                self.last_tok[slot] = req.generated[-1]
+                self._maybe_finish(req, emitted)
         return key
 
     def step(self, key: Optional[jax.Array] = None) -> Dict[int, List[int]]:
@@ -612,6 +751,8 @@ class ContinuousBatchingRunner:
             self._drain(emitted)
 
         key = self._place_queued(key, emitted)
+        if self.insert_cap is not None:
+            key = self._advance_inserts(key, emitted)
         if self.draft is not None:
             return self._step_spec(key, emitted)
         return self._step_plain(key, emitted)
@@ -634,10 +775,13 @@ class ContinuousBatchingRunner:
         pend_steps = self._pending[1] if self._pending is not None else 0
         positions = self.positions + pend_steps
         # room is bounded by the LIVE rows; finished slots keep a frozen
-        # position (possibly seq_len-1) that must not truncate active requests
-        live = [r for r in active_rows if not r.done]
-        max_pos = (max(r.position for r in live) + pend_steps if live
-                   else int(positions.max()))
+        # position (possibly seq_len-1) that must not truncate active requests;
+        # mid-insert rows don't decode yet
+        live = [r for r in active_rows if not r.done and not r.inserting]
+        if not live:
+            self._drain(emitted)
+            return emitted
+        max_pos = max(r.position for r in live) + pend_steps
         steps = min(chunk, self.cfg.seq_len - 1 - max_pos)
         if steps <= 0:
             # longest row is out of seq_len room; force-finish (truncate) it
@@ -646,31 +790,34 @@ class ContinuousBatchingRunner:
             victim.truncated = True
             self._finish(victim)
             return emitted
-        valid = np.array([r is not None and not r.done for r in self.active])
         key, sub = jax.random.split(key)
         sp = self._sampling_matrix()
+        greedy = self._chunk_greedy(live)
+        adapters = jnp.asarray(self.adapter_ids)
         tok0 = (self._pending[0][:, -1] if self._pending is not None
                 else jnp.asarray(self.last_tok))
+        t_dispatch = time.perf_counter() if self._async_auto else None
         if self.paged:
             active_rows = self._grow_blocks(active_rows, pend_steps + steps)
             if not active_rows:
                 self._drain(emitted)
                 return emitted
-            valid = np.array([r is not None and not r.done for r in self.active])
+            valid = np.array([r is not None and not r.done and not r.inserting
+                              for r in self.active])
             slot_chunk = self._slot_mapping_fn(
                 self.block_table, positions, steps, self.block_size, valid=valid)
             toks_dev, self.cache = self._decode_step(
                 self.app.params, tok0,
                 jnp.asarray(positions), self.cache,
                 jnp.asarray(self.block_table), jnp.asarray(slot_chunk), sp, sub,
-                num_steps=steps, greedy=self._greedy)
+                adapters, num_steps=steps, greedy=greedy)
         else:
             bucket = autobucketing.select_bucket(self.app.tkg_buckets,
                                                  max_pos + steps)
             toks_dev, self.cache = self._decode_step(
                 self.app.params, tok0,
-                jnp.asarray(positions), self.cache, sp, sub,
-                decode_bucket=bucket, num_steps=steps, greedy=self._greedy)
+                jnp.asarray(positions), self.cache, sp, sub, adapters,
+                decode_bucket=bucket, num_steps=steps, greedy=greedy)
 
         if self._async_ok(pend_steps + steps + chunk):
             prior, self._pending = self._pending, (toks_dev, steps)
@@ -679,7 +826,33 @@ class ContinuousBatchingRunner:
         else:
             self._drain(emitted)                       # older chunk commits first
             self._commit(np.asarray(toks_dev), steps, emitted)
+            if t_dispatch is not None:
+                self._note_chunk_time(time.perf_counter() - t_dispatch, steps)
         return emitted
+
+    def _note_chunk_time(self, wall_s: float, steps: int) -> None:
+        """async_mode="auto": time full-size sync chunks (sample 1 discarded —
+        it includes compilation), measure one blocking round trip, then enable
+        dispatch-ahead only when the round trip is >20% of the chunk's wall
+        time (the r4 measurement: +32% at that regime, -5% when the chunk
+        already amortizes the trip)."""
+        if not self._async_auto or steps != self.decode_chunk:
+            return
+        self._chunk_times.append(wall_s)
+        if len(self._chunk_times) < 3:
+            return
+        if self._round_trip_s is None:
+            np.asarray(jnp.asarray(np.int32(0)) + 1)   # warm (compile once)
+            t0 = time.perf_counter()
+            np.asarray(jnp.asarray(np.int32(1)) + 1)   # host->device->host
+            self._round_trip_s = time.perf_counter() - t0
+        chunk_s = min(self._chunk_times[1:])
+        self._async_auto = False
+        self.async_mode = self._round_trip_s / max(chunk_s, 1e-9) > 0.2
+        logger.info(
+            "async auto-decision: round_trip=%.1fms chunk=%.1fms -> %s",
+            1e3 * self._round_trip_s, 1e3 * chunk_s,
+            "dispatch-ahead ON" if self.async_mode else "sync")
 
     def _step_spec(self, key, emitted: Dict[int, List[int]]
                    ) -> Dict[int, List[int]]:
@@ -688,7 +861,7 @@ class ContinuousBatchingRunner:
         from .speculation import commit_row
 
         active_rows = [r for r in self.active if r is not None]
-        live = [r for r in active_rows if not r.done]
+        live = [r for r in active_rows if not r.done and not r.inserting]
         if not live:
             return emitted
         max_pos = max(r.position for r in live)
@@ -709,7 +882,8 @@ class ContinuousBatchingRunner:
             active_rows = self._grow_blocks(active_rows, iters * self.k)
             if not active_rows:
                 return emitted
-        alive0 = np.array([r is not None and not r.done for r in self.active])
+        alive0 = np.array([r is not None and not r.done and not r.inserting
+                           for r in self.active])
         eos_ids = np.array(
             [(-1 if r is None or r.eos_token_id is None else r.eos_token_id)
              for r in self.active], dtype=np.int32)
@@ -724,12 +898,13 @@ class ContinuousBatchingRunner:
             self.app.params, self.draft.params, jnp.asarray(self.last_tok),
             jnp.asarray(self.positions), jnp.asarray(alive0), self.cache,
             self.d_cache, bt, sp, jnp.asarray(eos_ids), sub,
-            num_iters=iters, greedy=self._greedy, decode_bucket=bucket)
+            jnp.asarray(self.adapter_ids), num_iters=iters,
+            greedy=self._chunk_greedy(live), decode_bucket=bucket)
         outs = np.asarray(outs)           # (iters, slots, K)
         ns = np.asarray(ns)               # (iters, slots)
         for it in range(iters):
             for slot, req in enumerate(self.active):
-                if req is None or req.done:
+                if req is None or req.done or req.inserting:
                     continue
                 take = int(ns[it, slot]) + 1
                 pre = len(req.generated)
@@ -767,6 +942,8 @@ class ContinuousBatchingRunner:
         while True:
             try:
                 for req in active_rows:
+                    if req.inserting:
+                        continue   # blocks for the full prompt already held
                     self.allocator.extend(req.blocks, req.position + steps + 1)
                     self.block_table[req.slot, : len(req.blocks)] = req.blocks
                 return active_rows
@@ -788,15 +965,106 @@ class ContinuousBatchingRunner:
             self.allocator.free_sequence(req.blocks)
             self.block_table[req.slot, :] = 0
             req.blocks = []
+        self._slot_sp[req.slot] = self._default_sp_row
+        self.adapter_ids[req.slot] = 0
         req.slot = -1
+        req.inserting = False       # chunked-insert progress restarts at resume
+        req.fed = None
+        req.insert_pos = 0
+        req.tok0_dev = None
         self.queue.insert(0, req)   # resumes first; _insert refeeds prompt + generated
 
     # ------------------------------------------------------------------ internals
     def _sampling_matrix(self) -> np.ndarray:
-        return sampling_ops.prepare_sampling_params(
-            self.num_slots,
-            top_k=self.sampling_config.top_k, top_p=self.sampling_config.top_p,
-            temperature=self.sampling_config.temperature)
+        """Current per-slot (slots, 3) sampling params (rows set at placement)."""
+        return self._slot_sp
+
+    def _begin_insert(self, req: Request, slot: int) -> None:
+        """Allocate blocks + prefix-cache lookup for the request's full prompt;
+        initialize the windowed-insert cursor (paged mode)."""
+        # resumed (preempted) requests refeed prompt + generated[:-1]; the newest
+        # generated token stays the next decode input (its KV is never written here)
+        fed = req.prompt
+        if req.generated:
+            fed = np.concatenate(
+                [req.prompt, np.asarray(req.generated[:-1], dtype=np.int32)])
+        # prefix-cache identity must include the ADAPTER: LoRA changes the
+        # K/V projections, so the same prompt under different adapters has
+        # different cache content. Salting the first hashed token keys the
+        # whole chain (every later block hash chains on the first).
+        hashed = fed
+        if req.adapter_id != 0:
+            hashed = fed.copy()
+            hashed[0] ^= np.int32(req.adapter_id << 20)
+        req.blocks, cached_len = self.allocator.allocate_for_prompt(hashed)
+        # never skip the whole prompt: the last token's logits seed generation
+        cached_len = min(cached_len, len(fed) - 1)
+        if self.insert_cap is not None and cached_len > 0:
+            # chunked-prefill race (found by review): the allocator registers
+            # prefix hashes at ALLOCATION, but with capped inserts the KV
+            # streams in over later steps — a same-prefix request placed
+            # meanwhile would reuse blocks whose KV hasn't landed. Trust the
+            # skip only through blocks every in-progress insert has fully
+            # written; shared-but-unwritten blocks are simply REwritten here
+            # (identical content: the chained hash keys tokens + adapter).
+            unsafe = set()
+            for r in self.active:
+                if r is not None and r.inserting and r is not req:
+                    unsafe.update(r.blocks[r.insert_pos // self.block_size:])
+            safe_tokens = 0
+            for i, blk in enumerate(req.blocks):
+                end = (i + 1) * self.block_size
+                if end > cached_len or blk in unsafe:
+                    break
+                safe_tokens = end
+            cached_len = min(cached_len, safe_tokens)
+        self.block_table[slot, : len(req.blocks)] = req.blocks
+        req.fed = fed
+        req.insert_pos = cached_len
+        req.tok0_dev = None
+        req.inserting = True
+
+    def _insert_windows(self, req: Request, slot: int, key, budget=None):
+        """Run paged prefill windows from ``req.insert_pos``, consuming at most
+        ``budget`` prompt tokens (None = all): each window's queries see the
+        prior windows' KV through the block table (≈ windowed context encoding,
+        reference `model_base.py:918-973`, and the chunked-prefill flow of
+        `ChunkedPrefillConfig`). The final window's sampled token is stored in
+        ``req.tok0_dev``. Returns (key, tokens_consumed)."""
+        fed = req.fed
+        max_window = self.app.cte_buckets[-1]
+        sp_row = self._slot_sp[slot : slot + 1]
+        ad_row = jnp.asarray(self.adapter_ids[slot : slot + 1])
+        used = 0
+        while req.insert_pos < len(fed) and (budget is None or used < budget):
+            wlen = len(fed) - req.insert_pos
+            if budget is not None:
+                wlen = min(wlen, budget - used)
+            wlen = min(wlen, max_window)
+            window = fed[req.insert_pos : req.insert_pos + wlen]
+            padded = model_wrapper.pad_prefill_inputs(
+                window[None, :], None, self.app.cte_buckets, batch_size=1)
+            pos_row = np.array([req.insert_pos], dtype=np.int32)
+            valid = np.ones((1, padded.bucket), dtype=bool)
+            valid[0, len(window):] = False
+            slot_map = self._slot_mapping_fn(
+                self.block_table[slot : slot + 1], pos_row, padded.bucket,
+                self.block_size, valid=valid)
+            key, sub = jax.random.split(key)
+            req.tok0_dev, self.cache = self._insert_step(
+                self.app.params, padded.input_ids, pos_row,
+                padded.last_token_idx, self.cache,
+                jnp.asarray(self.block_table[slot : slot + 1]),
+                jnp.asarray(slot_map), sp_row, sub, ad_row)
+            if self.draft is not None:
+                self.d_cache = self._d_insert_step(
+                    self.draft.params, padded.input_ids, pos_row,
+                    self.d_cache,
+                    jnp.asarray(self.block_table[slot : slot + 1]),
+                    jnp.asarray(slot_map))
+            req.insert_pos += wlen
+            used += wlen
+        return key, used
 
     def _insert(self, req: Request, slot: int, key) -> int:
         # resumed (preempted) requests refeed prompt + generated[:-1]; the newest
@@ -805,46 +1073,15 @@ class ContinuousBatchingRunner:
         if req.generated:
             fed = np.concatenate(
                 [req.prompt, np.asarray(req.generated[:-1], dtype=np.int32)])
-        cached_len = 0
-        if self.paged:
-            req.blocks, cached_len = self.allocator.allocate_for_prompt(fed)
-            # never skip the whole prompt: the last token's logits seed generation
-            cached_len = min(cached_len, len(fed) - 1)
-            self.block_table[slot, : len(req.blocks)] = req.blocks
 
-        sp_row = self._sampling_matrix()[slot : slot + 1]
+        sp_row = self._slot_sp[slot : slot + 1]
+        ad_row = jnp.asarray(self.adapter_ids[slot : slot + 1])
 
         if self.paged:
-            # windowed (chunked) prefill: feed CTE-bucket-size windows sequentially;
-            # each window's queries see the prior windows' KV through the block table
-            # (≈ windowed context encoding, reference `model_base.py:918-973`, and the
-            # chunked-prefill flow of `ChunkedPrefillConfig`).
-            max_window = self.app.cte_buckets[-1]
-            start = cached_len
-            tok_dev = None
-            while start < len(fed):
-                window = fed[start : min(start + max_window, len(fed))]
-                padded = model_wrapper.pad_prefill_inputs(
-                    window[None, :], None, self.app.cte_buckets, batch_size=1)
-                pos_row = np.array([start], dtype=np.int32)
-                valid = np.ones((1, padded.bucket), dtype=bool)
-                valid[0, len(window):] = False
-                slot_map = self._slot_mapping_fn(
-                    self.block_table[slot : slot + 1], pos_row, padded.bucket,
-                    self.block_size, valid=valid)
-                key, sub = jax.random.split(key)
-                tok_dev, self.cache = self._insert_step(
-                    self.app.params, padded.input_ids, pos_row,
-                    padded.last_token_idx, self.cache,
-                    jnp.asarray(self.block_table[slot : slot + 1]),
-                    jnp.asarray(slot_map), sp_row, sub)
-                if self.draft is not None:
-                    self.d_cache = self._d_insert_step(
-                        self.draft.params, padded.input_ids, pos_row,
-                        self.d_cache,
-                        jnp.asarray(self.block_table[slot : slot + 1]),
-                        jnp.asarray(slot_map))
-                start += len(window)
+            self._begin_insert(req, slot)
+            key, _ = self._insert_windows(req, slot, key)
+            req.inserting = False
+            tok_dev = req.tok0_dev
         elif len(fed) > self.app.cte_buckets[-1]:
             # dense windowed (chunked) prefill at this slot's cache row, then a
             # 1-token seed decode re-feeding the last prompt token (idempotent
@@ -857,12 +1094,12 @@ class ContinuousBatchingRunner:
                 bkt = autobucketing.select_bucket(self.app.tkg_buckets, w0 + w)
                 self.cache = self._window_step(
                     self.app.params, ids[:, w0 : w0 + w], np.int32(w0),
-                    np.int32(slot), self.cache, decode_bucket=bkt)
+                    np.int32(slot), self.cache, ad_row, decode_bucket=bkt)
             key, sub = jax.random.split(key)
             tok_dev, self.cache = self._seed_step(
                 self.app.params, jnp.asarray(fed[-1:]),
                 np.array([len(fed) - 1], dtype=np.int32), np.int32(slot),
-                self.cache, sp_row, sub,
+                self.cache, sp_row, sub, ad_row,
                 decode_bucket=autobucketing.select_bucket(self.app.tkg_buckets,
                                                           len(fed)))
         else:
@@ -871,7 +1108,7 @@ class ContinuousBatchingRunner:
             tok_dev, self.cache = self._insert_step(
                 self.app.params, padded.input_ids, padded.position_ids,
                 padded.last_token_idx, self.cache, jnp.asarray(slot, dtype=jnp.int32),
-                sp_row, key)
+                sp_row, key, ad_row)
             if self.draft is not None:
                 self.d_cache = self._d_insert_step(
                     self.draft.params, padded.input_ids, padded.position_ids,
@@ -893,4 +1130,8 @@ class ContinuousBatchingRunner:
             if self.paged:
                 self.allocator.free_sequence(req.blocks)
                 self.block_table[req.slot, :] = 0
+            # reset the slot's sampling/adapter rows so all-greedy traffic
+            # re-engages the fast argmax executable
+            self._slot_sp[req.slot] = self._default_sp_row
+            self.adapter_ids[req.slot] = 0
             req.slot = -1

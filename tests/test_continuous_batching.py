@@ -265,3 +265,103 @@ def test_finished_slot_at_seq_end_does_not_truncate_others(tiny_llama_hf_config)
     a, b = runner.finished[0], runner.finished[1]
     assert len(a.generated) == 65
     assert not b.truncated and len(b.generated) == 40
+
+
+def test_async_auto_decides_by_measurement(tiny_llama_hf_config, prompts):
+    """async_mode="auto" times the first sync chunks + a blocking round trip,
+    then self-selects; tokens stay exact either way (r4 found shipped async a
+    measured regression at deep configs — the knob must not degrade by default)."""
+    ref_app = _make_app(tiny_llama_hf_config, paged=True, slots=2)
+    ref = ContinuousBatchingRunner(ref_app, decode_chunk=4)
+    for p in prompts:
+        ref.submit(p, max_new_tokens=24)
+    want = ref.run_to_completion(seed=0)
+
+    app = _make_app(tiny_llama_hf_config, paged=True, slots=2)
+    runner = ContinuousBatchingRunner(app, decode_chunk=4, async_mode="auto")
+    assert runner.async_mode is False          # undecided -> sync
+    for p in prompts:
+        runner.submit(p, max_new_tokens=24)
+    got = runner.run_to_completion(seed=0)
+    assert got == want
+    assert not runner._async_auto               # a decision was made
+
+
+def test_async_auto_decision_rule(tiny_llama_hf_config):
+    """The decision rule itself: round trip >20% of chunk wall -> ON."""
+    app = _make_app(tiny_llama_hf_config, paged=True, slots=2)
+    runner = ContinuousBatchingRunner(app, decode_chunk=4, async_mode="auto")
+    runner._round_trip_s = 0.1
+    for dt in (5.0, 0.25, 0.25):               # sample 1 (compile) discarded
+        runner._note_chunk_time(dt, steps=4)
+    assert runner.async_mode is True           # 0.1 / 0.25 = 0.4 > 0.2
+
+    app2 = _make_app(tiny_llama_hf_config, paged=True, slots=2)
+    runner2 = ContinuousBatchingRunner(app2, decode_chunk=4, async_mode="auto")
+    runner2._round_trip_s = 0.1
+    for dt in (5.0, 0.9, 0.9):
+        runner2._note_chunk_time(dt, steps=4)
+    assert runner2.async_mode is False         # 0.1 / 0.9 = 0.11 < 0.2
+
+
+def test_chunked_prefill_scheduling_interleaves(tiny_llama_hf_config):
+    """max_insert_tokens_per_step caps prompt tokens written per step, so a
+    resident request keeps decoding WHILE a long prompt streams in — bounding
+    resident decode latency during inserts (≈ reference chunked prefill).
+    Outputs must still exactly match dedicated runs."""
+    rng = np.random.default_rng(13)
+    short = rng.integers(1, 256, size=(8,)).astype(np.int32)
+    long_p = rng.integers(1, 256, size=(64,)).astype(np.int32)
+    plain = _make_app(tiny_llama_hf_config)
+    want_short = plain.generate(short[None, :], max_new_tokens=20).tokens[0].tolist()
+    want_long = plain.generate(long_p[None, :], max_new_tokens=6).tokens[0].tolist()
+
+    app = _make_app(tiny_llama_hf_config, paged=True)
+    runner = ContinuousBatchingRunner(app, decode_chunk=2,
+                                      max_insert_tokens_per_step=16)
+    r_short = runner.submit(short, max_new_tokens=20)
+    runner.step()                       # short placed + fully inserted (8 <= 16)
+    r_long = runner.submit(long_p, max_new_tokens=6)
+
+    interleaved = False
+    guard = 0
+    while runner.has_work:
+        em = runner.step()
+        long_req = next((r for r in runner.active
+                         if r and r.request_id == r_long), None)
+        if long_req is not None and long_req.inserting and em.get(r_short):
+            interleaved = True          # short decoded while long still inserting
+        guard += 1
+        assert guard < 200
+    assert interleaved, "long insert stalled the resident request"
+    results = {rid: req.generated for rid, req in runner.finished.items()}
+    assert results[r_short] == want_short
+    assert results[r_long] == want_long
+
+
+def test_chunked_prefill_requires_paged(tiny_llama_hf_config):
+    app = _make_app(tiny_llama_hf_config, paged=False)
+    with pytest.raises(ValueError, match="paged"):
+        ContinuousBatchingRunner(app, max_insert_tokens_per_step=16)
+
+
+def test_chunked_prefill_prefix_race_is_safe(tiny_llama_hf_config):
+    """Found-by-review race: with capped inserts the allocator registers prefix
+    hashes at allocation but the KV streams in over later steps — a same-prompt
+    request placed mid-insert must NOT trust the not-yet-written blocks."""
+    rng = np.random.default_rng(17)
+    prompt = rng.integers(1, 256, size=(64,)).astype(np.int32)
+    plain = _make_app(tiny_llama_hf_config)
+    want = plain.generate(prompt[None, :], max_new_tokens=6).tokens[0].tolist()
+
+    app = _make_app(tiny_llama_hf_config, paged=True)
+    runner = ContinuousBatchingRunner(app, decode_chunk=2,
+                                      max_insert_tokens_per_step=16)
+    ra = runner.submit(prompt, max_new_tokens=6)
+    runner.step()                                   # A mid-insert (16/64)
+    req_a = next(r for r in runner.active if r and r.request_id == ra)
+    assert req_a.inserting
+    rb = runner.submit(prompt, max_new_tokens=6)    # same prompt, A unfinished
+    results = runner.run_to_completion()
+    assert results[ra] == want
+    assert results[rb] == want, "request B reused unwritten prefix blocks"

@@ -212,3 +212,39 @@ def test_flash_decoding_cp2_matches_tp1(hf_state):
     np.testing.assert_array_equal(got.tokens, want.tokens)
     for lw, lg in zip(want.logits, got.logits):
         np.testing.assert_allclose(lw, lg, atol=1e-4, rtol=1e-4)
+
+
+def test_attention_dp_continuous_batching_matches_tp(hf_state):
+    """Attention-DP x continuous batching (the reference COUPLES them:
+    attention DP requires CB, `models/config.py:678-679`): the CB runner on a
+    dp=2 x tp=4 mesh must emit exactly the plain tp=8 runner's tokens, for
+    both the paged and dense cache layouts."""
+    from neuronx_distributed_inference_tpu.runtime.continuous_batching import (
+        ContinuousBatchingRunner)
+
+    def run(attention_dp, paged):
+        tpu_cfg = TpuConfig(batch_size=8, seq_len=96, max_context_length=32,
+                            dtype="float32",
+                            tp_degree=4 if attention_dp else 8,
+                            dp_degree=2 if attention_dp else 1,
+                            attention_dp_enabled=attention_dp,
+                            is_continuous_batching=True,
+                            paged_attention_enabled=paged,
+                            pa_num_blocks=96, pa_block_size=8,
+                            context_encoding_buckets=[16, 32],
+                            token_generation_buckets=[48, 96])
+        config = LlamaInferenceConfig(tpu_cfg,
+                                      load_config=load_pretrained_config(HF_CFG))
+        app = LlamaForCausalLM(None, config)
+        app._put_params(app.convert_hf_state_dict(dict(hf_state), app.config))
+        runner = ContinuousBatchingRunner(app, decode_chunk=4)
+        rng = np.random.default_rng(9)
+        rids = [runner.submit(rng.integers(1, 256, size=(n,)).astype(np.int32),
+                              max_new_tokens=8) for n in (12, 7, 19)]
+        results = runner.run_to_completion()
+        return [results[r] for r in rids]
+
+    for paged in (True, False):
+        want = run(attention_dp=False, paged=paged)
+        got = run(attention_dp=True, paged=paged)
+        assert got == want, f"attention-DP CB diverged (paged={paged})"
