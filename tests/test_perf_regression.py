@@ -115,7 +115,7 @@ def _paged_decode_bytes(kernel, mb, steps=4):
     lowered = r._decode_step.lower(
         app.params, jnp.zeros((b,), jnp.int32), jnp.full((b,), 128, jnp.int32),
         r.cache, jnp.zeros((b, mb), jnp.int32), jnp.zeros((b, steps), jnp.int32),
-        sp, jax.random.PRNGKey(0), num_steps=steps)
+        sp, jax.random.PRNGKey(0), jnp.zeros((b,), jnp.int32), num_steps=steps)
     return float(lowered.compile().cost_analysis()["bytes accessed"]) / steps
 
 
