@@ -578,11 +578,15 @@ def _sharded_kv_write(k_cache, v_cache, new_k, new_v, positions, layer_idx, mesh
 
     ≈ the reference's batched KV write kernel (`modules/kvcache/utils.py:20-38`):
     overlapped strided DMAs instead of the serial per-row while loop XLA lowers a
-    vmapped dynamic_update_slice to."""
-    from ..modules.kvcache import CACHE_LOGICAL
+    vmapped dynamic_update_slice to. The saturating cache-dtype cast lives HERE
+    (not at call sites) so the kernel read-side assumption — fp8 payloads are
+    finite — is guaranteed by the write site itself."""
+    from ..modules.kvcache import CACHE_LOGICAL, to_cache_dtype
     from ..ops.flash_decode import write_decode_stacked_kv
 
     interpret = jax.default_backend() == "cpu"
+    new_k = to_cache_dtype(new_k, k_cache.dtype)
+    new_v = to_cache_dtype(new_v, v_cache.dtype)
 
     def _local(ck, cv, nk, nv, p, li):
         return write_decode_stacked_kv(ck, cv, nk, nv, p, li, interpret=interpret)
@@ -627,11 +631,15 @@ def _sharded_paged_kv_write(k_cache, v_cache, new_k, new_v, slot_mapping, layer_
     """Stacked paged-cache decode K+V write (Pallas DMA RMW scatter) under the mesh.
 
     ≈ the reference's batched KV write kernel over the paged layout
-    (`modules/kvcache/utils.py:20-38` + `block_kv_cache_manager.py:268-374`)."""
+    (`modules/kvcache/utils.py:20-38` + `block_kv_cache_manager.py:268-374`).
+    The saturating cache-dtype cast lives HERE (see _sharded_kv_write)."""
     from ..modules.block_kvcache import PAGED_CACHE_LOGICAL
+    from ..modules.kvcache import to_cache_dtype
     from ..ops.paged_decode import write_paged_stacked_kv
 
     interpret = jax.default_backend() == "cpu"
+    new_k = to_cache_dtype(new_k, k_cache.dtype)
+    new_v = to_cache_dtype(new_v, v_cache.dtype)
 
     def _local(ck, cv, nk, nv, sm, li):
         return write_paged_stacked_kv(ck, cv, nk, nv, sm, li, interpret=interpret)
@@ -882,9 +890,8 @@ def _decoder_layer(
             # ragged paged serving: block-table-indexed write + length-aware attend
             block_table, slot_mapping = paged_stacked
             k_cache, v_cache = _sharded_paged_kv_write(
-                k_cache, v_cache, kvcache.to_cache_dtype(k, k_cache.dtype),
-                kvcache.to_cache_dtype(v, v_cache.dtype),
-                slot_mapping, stacked_layer_idx, mesh, rules)
+                k_cache, v_cache, k, v, slot_mapping, stacked_layer_idx, mesh,
+                rules)
             attn = _sharded_paged_attend(q, k_cache, v_cache, positions,
                                          stacked_layer_idx, block_table, args,
                                          mesh, rules, sinks=sinks_arr,
@@ -892,9 +899,7 @@ def _decoder_layer(
         else:
             wp = positions if write_positions is None else write_positions
             k_cache, v_cache = _sharded_kv_write(
-                k_cache, v_cache, kvcache.to_cache_dtype(k, k_cache.dtype),
-                kvcache.to_cache_dtype(v, v_cache.dtype),
-                wp, stacked_layer_idx, mesh, rules)
+                k_cache, v_cache, k, v, wp, stacked_layer_idx, mesh, rules)
             if decode_bucket >= 1024:
                 attn = _sharded_decode_attend(q, k_cache, v_cache, positions,
                                               stacked_layer_idx, decode_bucket,

@@ -155,6 +155,117 @@ def write_paged_stacked_kv(
 # --- paged decode attention -----------------------------------------------------------
 
 
+def _paged_attend_kernel_v3(pos_ref, lidx_ref, bt_ref, q_ref, *refs,
+                            o_ref=None, m_scratch=None, l_scratch=None,
+                            acc_scratch=None, scale: float, bs: int, kb: int,
+                            bb: int, num_cells: int, t: int, qr: int,
+                            nq: int, hkv: int, window: Optional[int],
+                            soft_cap: Optional[float], has_sinks: bool,
+                            has_slopes: bool):
+    """v3 cell body: FLAT q packing + per-block-group dots, no concat.
+
+    v2 padded each head's q rows to 8 sublanes and concatenated the cell's kb
+    blocks into one (hkv*width, D) operand — measured on-chip the cell is
+    VPU-epilogue-bound (fp8 was SLOWER than bf16 despite half the DMA), and
+    the score matrix was 2x over-padded on rows plus a VMEM concat copy per
+    row-unit. v3 packs q as (hkv*n_rep*t, D) rows with NO per-head padding
+    (the head index is recovered as row // qr in the mask iota) and runs one
+    (nq, hkv*bs) dot + flash update PER BLOCK GROUP straight off each fetched
+    block ref: half the score elements, half the MXU flops, zero concat.
+    Cross-head score tiles are masked; the masked-zero p rows make the single
+    packed p @ V dot exact (same trick as v2)."""
+    kv_refs = refs[: 2 * kb * bb]
+    idx = 2 * kb * bb
+    sinks_ref = slopes_ref = None
+    if has_sinks:
+        sinks_ref, idx = refs[idx], idx + 1
+    if has_slopes:
+        slopes_ref, idx = refs[idx], idx + 1
+
+    bi = pl.program_id(0)
+    ci = pl.program_id(1)
+
+    @pl.when(ci == 0)
+    def _init():
+        m_scratch[:] = jnp.full_like(m_scratch, NEG_INF)
+        l_scratch[:] = jnp.zeros_like(l_scratch)
+        acc_scratch[:] = jnp.zeros_like(acc_scratch)
+
+    width = kb * bs
+    k_start = ci * width
+    d = q_ref.shape[-1]
+    cols = hkv * bs
+
+    row_iota = jax.lax.broadcasted_iota(jnp.int32, (nq, cols), 0)
+    col_iota = jax.lax.broadcasted_iota(jnp.int32, (nq, cols), 1)
+    same_head = (row_iota // qr) == (col_iota // bs)
+    tok_idx = (row_iota % qr) % t
+    col_off = col_iota % bs
+
+    for j in range(bb):                        # static unroll over batch rows
+        pos = pos_ref[bi * bb + j]
+        run = k_start <= pos + t - 1           # cell fully beyond the row -> skip
+        if window is not None:
+            run = jnp.logical_and(run, k_start + width - 1 > pos - window)
+        r0 = j * nq
+
+        @pl.when(run)
+        def _body(j=j, pos=pos, r0=r0):
+            q = q_ref[j]                                   # (nq, d)
+            q_pos = pos + tok_idx
+            for g in range(kb):
+                k = _vmem_cast(kv_refs[2 * (j * kb + g)][0, 0].reshape(cols, d),
+                               q.dtype)
+                v = _vmem_cast(
+                    kv_refs[2 * (j * kb + g) + 1][0, 0].reshape(cols, d),
+                    q.dtype)
+                kv_pos = k_start + g * bs + col_off
+                mask = jnp.logical_and(same_head, kv_pos <= q_pos)
+                if window is not None:
+                    mask = jnp.logical_and(mask, kv_pos > q_pos - window)
+
+                s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                        preferred_element_type=jnp.float32
+                                        ) * scale
+                if slopes_ref is not None:
+                    s = s - slopes_ref[:, 0:1] * (q_pos - kv_pos).astype(
+                        jnp.float32)
+                if soft_cap is not None:
+                    s = soft_cap * jnp.tanh(s / soft_cap)
+                s = jnp.where(mask, s, NEG_INF)
+
+                m_prev = m_scratch[r0 : r0 + nq, 0:1]
+                l_prev = l_scratch[r0 : r0 + nq, 0:1]
+                m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+                alpha = jnp.exp(jnp.minimum(m_prev - m_new, 0.0))
+                p = jnp.exp(s - m_new)
+                p = jnp.where(mask, p, 0.0)
+                l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+                acc = acc_scratch[r0 : r0 + nq] * alpha + jax.lax.dot_general(
+                    p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                m_scratch[r0 : r0 + nq] = jnp.broadcast_to(m_new, (nq, 128))
+                l_scratch[r0 : r0 + nq] = jnp.broadcast_to(l_new, (nq, 128))
+                acc_scratch[r0 : r0 + nq] = acc
+
+    @pl.when(ci == num_cells - 1)
+    def _finalize():
+        for j in range(bb):
+            r0 = j * nq
+            m = m_scratch[r0 : r0 + nq, 0:1]
+            l = l_scratch[r0 : r0 + nq, 0:1]
+            acc = acc_scratch[r0 : r0 + nq]
+            if sinks_ref is not None:
+                sink = sinks_ref[:, 0:1]
+                m_new = jnp.maximum(m, sink)
+                alpha = jnp.exp(jnp.minimum(m - m_new, 0.0))
+                l = alpha * l + jnp.exp(sink - m_new)
+                acc = acc * alpha
+            l_safe = jnp.where(l == 0.0, 1.0, l)
+            o_ref[j] = (acc / l_safe).reshape(o_ref.shape[1:]).astype(
+                o_ref.dtype)
+
+
 def _paged_attend_kernel(pos_ref, lidx_ref, bt_ref, q_ref, *refs, o_ref=None,
                          m_scratch=None, l_scratch=None, acc_scratch=None,
                          scale: float, bs: int, kb: int, bb: int,
@@ -264,7 +375,8 @@ def _paged_attend_kernel(pos_ref, lidx_ref, bt_ref, q_ref, *refs, o_ref=None,
 
 @functools.partial(
     jax.jit,
-    static_argnames=("scale", "window", "soft_cap", "blocks_per_cell", "interpret"))
+    static_argnames=("scale", "window", "soft_cap", "blocks_per_cell",
+                     "interpret", "variant"))
 def paged_decode_attention_stacked(
     q: jnp.ndarray,              # (B, Hq, T, D), T small (1 or speculation width)
     k_cache: jnp.ndarray,        # (L, NB, Hkv, BS, D) — full stacked paged cache
@@ -279,6 +391,7 @@ def paged_decode_attention_stacked(
     alibi_slopes: Optional[jnp.ndarray] = None,  # (Hq,) ALiBi slopes
     blocks_per_cell: Optional[int] = None,
     interpret: bool = False,
+    variant: int = 2,
 ) -> jnp.ndarray:
     """Ragged paged decode attention over one layer of the stacked paged cache.
 
@@ -286,6 +399,9 @@ def paged_decode_attention_stacked(
     maps over the scalar-prefetched table); block groups beyond a row's position are
     clamped to the row's last live block (DMA elided) and predicated off. The fresh
     step's K/V must already be written (write_paged_stacked_kv).
+    ``variant``: 2 = head-padded concat cells (the measured default), 3 = flat-q
+    per-block-group cells (measured neutral-bf16 / worse-fp8 on v5e at bs=64 —
+    kept for other geometries; see _paged_attend_kernel_v3).
     Returns (B, Hq, T, D) in q.dtype."""
     b, hq, t, d = q.shape
     _, nb, hkv, bs, _ = k_cache.shape
@@ -296,10 +412,18 @@ def paged_decode_attention_stacked(
     if scale is None:
         scale = d ** -0.5
 
-    qg = q.reshape(b, hkv, n_rep, t, d).reshape(b, hkv, n_rep * t, d)
-    rows = max(8, _round_up(n_rep * t, 8))
-    if rows != n_rep * t:
-        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, rows - n_rep * t), (0, 0)))
+    qr = n_rep * t
+    if variant == 3:
+        nq = _round_up(hkv * qr, 8)
+        qg = q.reshape(b, hkv, qr, d).reshape(b, hkv * qr, d)
+        if nq != hkv * qr:
+            qg = jnp.pad(qg, ((0, 0), (0, nq - hkv * qr), (0, 0)))
+        rows = None
+    else:
+        qg = q.reshape(b, hkv, n_rep, t, d).reshape(b, hkv, n_rep * t, d)
+        rows = max(8, _round_up(n_rep * t, 8))
+        if rows != n_rep * t:
+            qg = jnp.pad(qg, ((0, 0), (0, 0), (0, rows - n_rep * t), (0, 0)))
 
     # fetch kb blocks per grid cell so per-cell fixed cost amortizes (~512 kv
     # positions per cell unless the table is shorter)
@@ -342,11 +466,26 @@ def paged_decode_attention_stacked(
             kv_specs.append(pl.BlockSpec((1, 1, hkv, bs, d), _kv_index_map(j, g)))
             kv_specs.append(pl.BlockSpec((1, 1, hkv, bs, d), _kv_index_map(j, g)))
 
-    kernel = functools.partial(
-        _paged_attend_kernel, scale=scale, bs=bs, kb=kb, bb=bb,
-        num_cells=num_cells,
-        t=t, rows=rows, hkv=hkv, window=window, soft_cap=soft_cap,
-        has_sinks=sinks is not None, has_slopes=alibi_slopes is not None)
+    if variant == 3:
+        kernel = functools.partial(
+            _paged_attend_kernel_v3, scale=scale, bs=bs, kb=kb, bb=bb,
+            num_cells=num_cells, t=t, qr=qr, nq=nq, hkv=hkv, window=window,
+            soft_cap=soft_cap, has_sinks=sinks is not None,
+            has_slopes=alibi_slopes is not None)
+        q_spec = pl.BlockSpec((bb, nq, d), lambda bi, ci, *_: (bi, 0, 0))
+        out_shape = jax.ShapeDtypeStruct((b, nq, d), q.dtype)
+        n_scr_rows = bb * nq
+        extra_rows = nq
+    else:
+        kernel = functools.partial(
+            _paged_attend_kernel, scale=scale, bs=bs, kb=kb, bb=bb,
+            num_cells=num_cells,
+            t=t, rows=rows, hkv=hkv, window=window, soft_cap=soft_cap,
+            has_sinks=sinks is not None, has_slopes=alibi_slopes is not None)
+        q_spec = pl.BlockSpec((bb, hkv, rows, d), lambda bi, ci, *_: (bi, 0, 0, 0))
+        out_shape = jax.ShapeDtypeStruct((b, hkv, rows, d), q.dtype)
+        n_scr_rows = bb * hkv * rows
+        extra_rows = hkv * rows
 
     extra_specs, extra_ops = [], []
     for extra in (sinks, alibi_slopes):
@@ -354,8 +493,12 @@ def paged_decode_attention_stacked(
             from .flash_decode import _group_head_scalars
 
             extra_specs.append(
-                pl.BlockSpec((hkv * rows, 128), lambda bi, ci, *_: (0, 0)))
-            extra_ops.append(_group_head_scalars(extra, hkv, n_rep, t, rows))
+                pl.BlockSpec((extra_rows, 128), lambda bi, ci, *_: (0, 0)))
+            grouped = _group_head_scalars(extra, hkv, n_rep, t,
+                                          qr if variant == 3 else rows)
+            if variant == 3 and nq != hkv * qr:
+                grouped = jnp.pad(grouped, ((0, nq - hkv * qr), (0, 0)))
+            extra_ops.append(grouped)
     n_extra = len(extra_ops)
 
     def _kernel(pos_ref, lidx_ref, bt_ref, q_ref, *rest):
@@ -367,15 +510,12 @@ def paged_decode_attention_stacked(
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(b // bb, num_cells),
-        in_specs=[pl.BlockSpec((bb, hkv, rows, d),
-                               lambda bi, ci, *_: (bi, 0, 0, 0))]
-        + kv_specs + extra_specs,
-        out_specs=pl.BlockSpec((bb, hkv, rows, d),
-                               lambda bi, ci, *_: (bi, 0, 0, 0)),
+        in_specs=[q_spec] + kv_specs + extra_specs,
+        out_specs=pl.BlockSpec(q_spec.block_shape, q_spec.index_map),
         scratch_shapes=[
-            pltpu.VMEM((bb * hkv * rows, 128), jnp.float32),
-            pltpu.VMEM((bb * hkv * rows, 128), jnp.float32),
-            pltpu.VMEM((bb * hkv * rows, d), jnp.float32),
+            pltpu.VMEM((n_scr_rows, 128), jnp.float32),
+            pltpu.VMEM((n_scr_rows, 128), jnp.float32),
+            pltpu.VMEM((n_scr_rows, d), jnp.float32),
         ],
     )
     # the per-layer cache view (4D) keeps the kv BlockSpecs rank-4; layer selection
@@ -385,11 +525,14 @@ def paged_decode_attention_stacked(
     out = pl.pallas_call(
         _kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, hkv, rows, d), q.dtype),
+        out_shape=out_shape,
         interpret=interpret,
     )(positions.astype(jnp.int32), layer_idx.reshape(1).astype(jnp.int32),
       block_table.astype(jnp.int32), qg,
       *([k_cache, v_cache] * (kb * bb)), *extra_ops)
 
-    out = out[:, :, : n_rep * t, :].reshape(b, hkv, n_rep, t, d)
+    if variant == 3:
+        out = out[:, : hkv * qr, :].reshape(b, hkv, n_rep, t, d)
+    else:
+        out = out[:, :, : n_rep * t, :].reshape(b, hkv, n_rep, t, d)
     return out.reshape(b, hq, t, d)

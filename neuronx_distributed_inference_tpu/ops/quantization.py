@@ -164,8 +164,14 @@ def qeinsum(spec: str, x: jnp.ndarray, w) -> jnp.ndarray:
     if not is_quantized(w):
         return jnp.einsum(spec, x, w)
     if "qT" in w:
-        raise ValueError("qeinsum does not take transposed-storage weights "
-                         "(attention projections go through qapply)")
+        # transposed storage (..., out, in): swap the SPEC's last two weight
+        # axes so the flag is layout-transparent for any family routing an
+        # attention projection through qeinsum rather than qapply
+        ins, out = spec.split("->")
+        xs, ws = ins.split(",")
+        ws = ws[:-2] + ws[-1] + ws[-2]
+        y = jnp.einsum(f"{xs},{ws}->{out}", x, w["qT"].astype(x.dtype))
+        return y * w["s"].astype(y.dtype)
     y = jnp.einsum(spec, x, w["q"].astype(x.dtype))
     out_scale = w["s"]                     # (..., 1, out); experts lead
     # result layout for "nh,ehi->eni" / "eni,eih->enh": (E, N, out) — scale is

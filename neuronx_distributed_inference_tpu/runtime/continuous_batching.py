@@ -124,6 +124,9 @@ class ContinuousBatchingRunner:
         # eos stop, every row >2 chunks from its max/seq bound, block headroom);
         # anything else drains the pipeline and runs the exact sync path, so
         # emitted-token semantics only ever LAG by one chunk, never change.
+        # KNOWN LIMIT: any active row with eos_token_id set disables
+        # dispatch-ahead entirely (an early eos mid-pipeline cannot be proven
+        # exact) — pipelining only engages for max_new_tokens-bounded traffic.
         #
         # Modes: True = always (exactness-gated), False = never, "auto" =
         # measured self-selection — dispatch-ahead only pays when the host

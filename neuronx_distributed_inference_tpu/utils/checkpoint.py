@@ -177,12 +177,16 @@ def _resolve_dtype(name: str) -> np.dtype:
 
 
 def _flatten_param_tree(tree, prefix=""):
-    """Depth-first (key-sorted) flatten of a nested-dict param tree."""
+    """Depth-first (key-sorted) flatten of a nested-dict param tree.
+
+    None leaves are yielded as-is (recorded in the manifest with dtype "none")
+    so the save/load round-trip preserves the tree SHAPE exactly — a family
+    whose tree carries optional None entries must get them back on warm start."""
     if isinstance(tree, dict):
         for k in sorted(tree):
             yield from _flatten_param_tree(tree[k], f"{prefix}{k}/")
     elif tree is None:
-        return
+        yield prefix[:-1], None
     else:
         yield prefix[:-1], np.asarray(tree)
 
@@ -194,6 +198,10 @@ def save_param_tree(directory: str, params) -> str:
     offset = 0
     with open(os.path.join(directory, ARTIFACT_PAYLOAD), "wb") as payload:
         for key, arr in _flatten_param_tree(params):
+            if arr is None:
+                manifest.append({"key": key, "dtype": "none", "shape": [],
+                                 "offset": offset, "nbytes": 0})
+                continue
             arr = np.ascontiguousarray(arr)
             if arr.dtype.kind not in "fiub" and arr.dtype.name not in (
                     "bfloat16", "float8_e4m3fn", "float8_e5m2", "float8_e4m3"):
@@ -217,9 +225,12 @@ def load_param_tree(directory: str):
                         mode="r")
     tree: Dict[str, Any] = {}
     for ent in manifest:
-        dt = _resolve_dtype(ent["dtype"])
-        raw = payload[ent["offset"] : ent["offset"] + ent["nbytes"]]
-        arr = raw.view(dt).reshape(ent["shape"])
+        if ent["dtype"] == "none":
+            arr = None
+        else:
+            dt = _resolve_dtype(ent["dtype"])
+            raw = payload[ent["offset"] : ent["offset"] + ent["nbytes"]]
+            arr = raw.view(dt).reshape(ent["shape"])
         node = tree
         parts = ent["key"].split("/")
         for p in parts[:-1]:

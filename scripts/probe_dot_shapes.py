@@ -1,0 +1,107 @@
+"""Probe: raw Mosaic dot cost at the paged-attend cell shapes.
+
+Hypothesis: s = dot_general(q (64,128), k (4096,128), contract (1,1)) forces a
+per-cell transpose of the 4096x128 K operand (MXU wants the contraction on
+dim 0 of B), while the PV dot p (64,4096) @ v (4096,128) is layout-native.
+Measures, per kernel invocation (grid of 32 cells to mimic the attend):
+  a) qk_t  : dot(q, k, ((1,),(1,)))      - the current attend's K dot
+  b) qk_n  : dot(q, kT, ((1,),(0,)))     - same math, K pre-transposed (128,4096)
+  c) pv    : dot(p, v, ((1,),(0,)))      - the PV dot for reference
+  d) full  : a) + exp + masks + b)-style PV (one flash-ish cell)
+"""
+
+import functools
+import shutil
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+CELLS = 32
+M, K, N = 64, 128, 4096      # q rows, head dim, cell kv width
+
+
+def run(name, kernel, args_shapes, dtype):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    rng = np.random.default_rng(0)
+    ops = [jnp.asarray(rng.normal(size=s), dtype=dtype) * 0.3
+           for s in args_shapes]
+    out_shape = jax.ShapeDtypeStruct((M, 128), jnp.float32)
+
+    fn = pl.pallas_call(
+        kernel,
+        grid=(CELLS,),
+        in_specs=[pl.BlockSpec(s, lambda i: tuple(0 for _ in s))
+                  for s in args_shapes],
+        out_specs=pl.BlockSpec((M, 128), lambda i: (0, 0)),
+        out_shape=out_shape,
+    )
+    f = jax.jit(fn)
+    jax.block_until_ready(f(*ops))
+    d = f"/tmp/probe_dot_{name}"
+    shutil.rmtree(d, ignore_errors=True)
+    iters = 30
+    with jax.profiler.trace(d):
+        for _ in range(iters):
+            jax.block_until_ready(f(*ops))
+    sys.path.insert(0, "/root/repo/scripts")
+    from probe_paged_perf import xplane_table
+
+    tot = xplane_table(d)
+    dev_us = sum(ms for n, ms in tot.items() if n.startswith("jit_")) / iters * 1e3
+    print(f"{name:6s} {dev_us:8.1f} us/call  ({dev_us / CELLS:6.2f} us/cell)",
+          flush=True)
+
+
+def main():
+    import jax.numpy as jnp
+    from jax import lax
+
+    def qk_t(q_ref, k_ref, o_ref):
+        s = lax.dot_general(q_ref[...], k_ref[...], (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+        o_ref[...] = jnp.sum(s, axis=1, keepdims=True) + jnp.zeros((M, 128),
+                                                                   jnp.float32)
+
+    def qk_n(q_ref, kt_ref, o_ref):
+        s = lax.dot_general(q_ref[...], kt_ref[...], (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+        o_ref[...] = jnp.sum(s, axis=1, keepdims=True) + jnp.zeros((M, 128),
+                                                                   jnp.float32)
+
+    def pv(p_ref, v_ref, o_ref):
+        s = lax.dot_general(p_ref[...], v_ref[...], (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+        o_ref[...] = s.astype(jnp.float32)
+
+    def full_t(q_ref, k_ref, v_ref, o_ref):
+        s = lax.dot_general(q_ref[...], k_ref[...], (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+        p = jnp.exp(s - jnp.max(s, axis=1, keepdims=True))
+        o_ref[...] = lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[...], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    def full_n(q_ref, kt_ref, v_ref, o_ref):
+        s = lax.dot_general(q_ref[...], kt_ref[...], (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+        p = jnp.exp(s - jnp.max(s, axis=1, keepdims=True))
+        o_ref[...] = lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[...], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    dt = jnp.bfloat16
+    run("qk_t", qk_t, [(M, K), (N, K)], dt)
+    run("qk_n", qk_n, [(M, K), (K, N)], dt)
+    run("pv", pv, [(M, N), (N, K)], dt)
+    run("full_t", full_t, [(M, K), (N, K), (N, K)], dt)
+    run("full_n", full_n, [(M, K), (K, N), (N, K)], dt)
+
+
+if __name__ == "__main__":
+    main()

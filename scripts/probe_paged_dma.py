@@ -1,0 +1,100 @@
+"""Probe: pure DMA cost of the v2 paged-attend block streaming.
+
+Same grid, BlockSpecs, and clamped index maps as _paged_attend_kernel (bb=4,
+kb=4), but the body only touches one element per fetched block — so the
+measured time is the cost of STREAMING the blocks through the grid, without
+the dots/masks/flash updates. Compare with the full kernel's time to split
+DMA vs compute, at bf16 and fp8.
+"""
+
+import functools
+import shutil
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+B, HKV, D, BS, MB, L = 64, 8, 128, 128, 8, 8
+NB = B * MB + 8
+KB, BB = 4, 4
+CELLS = MB // KB
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    rng = np.random.default_rng(0)
+    positions = jnp.asarray(rng.integers(200, 900, size=(B,)), dtype=jnp.int32)
+    perm = rng.permutation(NB)[: B * MB].reshape(B, MB)
+    bt = jnp.asarray(perm, dtype=jnp.int32)
+
+    def kv_index_map(j, g):
+        def index_map(bi, ci, pos, lidx, btab):
+            row = bi * BB + j
+            gg = ci * KB + g
+            last_live = pos[row] // BS
+            gg = jnp.minimum(gg, last_live)
+            return (lidx[0], btab[row, gg], 0, 0, 0)
+
+        return index_map
+
+    def body(pos_ref, lidx_ref, bt_ref, *refs):
+        kv_refs = refs[:-1]
+        o_ref = refs[-1]
+        acc = jnp.zeros((8, 128), jnp.float32)
+        for r in kv_refs:
+            # touch a sublane-aligned tile so the block fetch isn't elided
+            acc = acc + r[0, 0, :, :8, :].astype(jnp.float32).sum(axis=1)
+        o_ref[...] = acc
+
+    for dtype_name in ("bfloat16", "float8_e4m3fn"):
+        dt = jnp.dtype(dtype_name)
+        kc = (jnp.asarray(rng.normal(size=(L, NB, HKV, BS, D)),
+                          dtype=jnp.bfloat16) * 0.3).astype(dt)
+        vc = (jnp.asarray(rng.normal(size=(L, NB, HKV, BS, D)),
+                          dtype=jnp.bfloat16) * 0.3).astype(dt)
+        kv_specs = []
+        for j in range(BB):
+            for g in range(KB):
+                kv_specs.append(pl.BlockSpec((1, 1, HKV, BS, D),
+                                             kv_index_map(j, g)))
+                kv_specs.append(pl.BlockSpec((1, 1, HKV, BS, D),
+                                             kv_index_map(j, g)))
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,
+            grid=(B // BB, CELLS),
+            in_specs=kv_specs,
+            out_specs=pl.BlockSpec((8, 128), lambda bi, ci, *_: (0, 0)),
+        )
+        fn = pl.pallas_call(
+            body, grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32))
+
+        @jax.jit
+        def run(pos, btab, kc, vc):
+            return fn(pos, jnp.asarray([3], jnp.int32), btab,
+                      *([kc, vc] * (KB * BB)))
+
+        jax.block_until_ready(run(positions, bt, kc, vc))
+        d = f"/tmp/probe_dma_{dtype_name}"
+        shutil.rmtree(d, ignore_errors=True)
+        iters = 30
+        with jax.profiler.trace(d):
+            for _ in range(iters):
+                jax.block_until_ready(run(positions, bt, kc, vc))
+        sys.path.insert(0, "/root/repo/scripts")
+        from probe_paged_perf import xplane_table
+
+        tot = xplane_table(d)
+        dev_us = sum(ms for n, ms in tot.items()
+                     if n.startswith("jit_run")) / iters * 1e3
+        print(f"dma_only {dtype_name:14s} {dev_us:8.1f} us/call", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -353,3 +353,25 @@ def test_transposed_stacks_with_activation_quant(tiny_llama_hf_config):
     np.testing.assert_array_equal(a.tokens, b.tokens)
     for i, (x, y) in enumerate(zip(a.logits, b.logits)):
         np.testing.assert_allclose(x, y, rtol=1e-5, atol=1e-5, err_msg=f"step {i}")
+
+
+def test_qeinsum_transposed_storage_matches_plain():
+    """qeinsum with {"qT","s"} transposed storage must equal the {"q","s"}
+    path for the MoE-style specs (layout-transparent qT handling)."""
+    import numpy as np
+
+    from neuronx_distributed_inference_tpu.ops.quantization import qeinsum
+
+    rng = np.random.default_rng(0)
+    for spec, x_shape, w_shape in (
+            ("nh,hi->ni", (5, 8), (8, 6)),
+            ("enh,ehi->eni", (3, 5, 8), (3, 8, 6)),
+    ):
+        x = jnp.asarray(rng.normal(size=x_shape), dtype=jnp.float32)
+        q = rng.integers(-127, 128, size=w_shape).astype(np.int8)
+        s = np.full(w_shape[:-2] + (1, w_shape[-1]), 3e-3, dtype=np.float32)
+        w = {"q": jnp.asarray(q), "s": jnp.asarray(s)}
+        wt = {"qT": jnp.asarray(np.swapaxes(q, -1, -2)), "s": jnp.asarray(s)}
+        got = np.asarray(qeinsum(spec, x, wt))
+        want = np.asarray(qeinsum(spec, x, w))
+        np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
