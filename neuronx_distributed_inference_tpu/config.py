@@ -276,9 +276,15 @@ class TpuConfig:
             raise ValueError("activation_quant requires int8 weight quantization")
         if q is not None and q.kv_cache_scale_mode == "static" and (
                 q.kv_cache_dtype is None
-                or not q.kv_cache_dtype.startswith("float8")):
-            raise ValueError("kv_cache_scale_mode='static' requires an fp8 "
-                             "kv_cache_dtype (e.g. float8_e4m3)")
+                or not (q.kv_cache_dtype.startswith("float8")
+                        or q.kv_cache_dtype == "int8")):
+            raise ValueError("kv_cache_scale_mode='static' requires an fp8 or "
+                             "int8 kv_cache_dtype (e.g. float8_e4m3, int8)")
+        if (q is not None and q.kv_cache_dtype == "int8"
+                and q.kv_cache_scale_mode != "static"):
+            raise ValueError("int8 kv_cache_dtype requires "
+                             "kv_cache_scale_mode='static' (an unscaled round "
+                             "to int8 destroys K/V values)")
         if self.on_device_sampling_config is not None:
             self.on_device_sampling_config.validate()
         if self.moe_hybrid_sharding is not None:

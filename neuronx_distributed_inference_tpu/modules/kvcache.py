@@ -100,6 +100,12 @@ def to_cache_dtype(x: jnp.ndarray, dtype) -> jnp.ndarray:
 
         fmax = float(ml_dtypes.finfo(dt).max)
         x = jnp.clip(x, -fmax, fmax)
+    elif dt == jnp.int8:
+        # int8 KV (static scales only): values arrive pre-scaled to [-127, 127]
+        # (cache stores round(K/sigma * 127) via sigma' = sigma/127); round +
+        # saturate so serving outliers past the calibrated range clip, and the
+        # int8-native attend kernels can consume the payload on the MXU
+        x = jnp.clip(jnp.round(x.astype(jnp.float32)), -127, 127)
     return x.astype(dtype)
 
 

@@ -401,11 +401,27 @@ def _stacked_decode_kernel(pos_ref, lidx_ref, q_ref, k_ref, v_ref, *refs,
                 mask = jnp.logical_and(mask, kv_iota > q_pos - window)
             for h in range(hkv):
                 q = q_ref[j, h]                          # (rows, D)
-                k = _vmem_cast(k_ref[0, j, h], q.dtype)  # (block_k, D)
-                v = _vmem_cast(v_ref[0, j, h], q.dtype)
-                s = jax.lax.dot_general(
-                    q, k, (((1,), (1,)), ((), ())),
-                    preferred_element_type=jnp.float32) * scale
+                int8_kv = k_ref.dtype == jnp.int8
+                if int8_kv:
+                    # int8 KV (static scales): int8 x int8 on the MXU, no cast
+                    # of the streamed K/V (see paged_decode for the scheme)
+                    k = k_ref[0, j, h]
+                    v = v_ref[0, j, h]
+                    qf = q.astype(jnp.float32)
+                    sx = jnp.maximum(
+                        jnp.max(jnp.abs(qf), axis=1, keepdims=True) / 127.0,
+                        1e-8)
+                    q = jnp.clip(jnp.round(qf / sx), -127, 127).astype(jnp.int8)
+                    s = jax.lax.dot_general(
+                        q, k, (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.int32
+                    ).astype(jnp.float32) * (sx * scale)
+                else:
+                    k = _vmem_cast(k_ref[0, j, h], q.dtype)  # (block_k, D)
+                    v = _vmem_cast(v_ref[0, j, h], q.dtype)
+                    s = jax.lax.dot_general(
+                        q, k, (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32) * scale
                 if slopes_ref is not None:
                     # ALiBi: per-row slope (rows grouped by q head, batch-invariant)
                     s = s - slopes_ref[h * rows : (h + 1) * rows, 0:1] * (
@@ -421,9 +437,17 @@ def _stacked_decode_kernel(pos_ref, lidx_ref, q_ref, k_ref, v_ref, *refs,
                 p = jnp.exp(s - m_new)
                 p = jnp.where(mask, p, 0.0)
                 l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
-                acc = acc_scratch[r0 : r0 + rows] * alpha + jax.lax.dot_general(
-                    p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-                    preferred_element_type=jnp.float32)
+                if int8_kv:
+                    pi = jnp.round(p * 127.0).astype(jnp.int8)
+                    pv_d = jax.lax.dot_general(
+                        pi, v, (((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.int32
+                    ).astype(jnp.float32) * (1.0 / 127.0)
+                else:
+                    pv_d = jax.lax.dot_general(
+                        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32)
+                acc = acc_scratch[r0 : r0 + rows] * alpha + pv_d
                 m_scratch[r0 : r0 + rows] = jnp.broadcast_to(m_new, (rows, 128))
                 l_scratch[r0 : r0 + rows] = jnp.broadcast_to(l_new, (rows, 128))
                 acc_scratch[r0 : r0 + rows] = acc

@@ -319,8 +319,22 @@ def _paged_attend_kernel(pos_ref, lidx_ref, bt_ref, q_ref, *refs, o_ref=None,
                 [kv_refs[2 * (j * kb + g)][0, 0] for g in range(kb)], axis=1)
             v = jnp.concatenate(
                 [kv_refs[2 * (j * kb + g) + 1][0, 0] for g in range(kb)], axis=1)
-            k = _vmem_cast(k.reshape(hkv * width, d), q.dtype)
-            v = _vmem_cast(v.reshape(hkv * width, d), q.dtype)
+            int8_kv = k.dtype == jnp.int8
+            k = k.reshape(hkv * width, d)
+            v = v.reshape(hkv * width, d)
+            if int8_kv:
+                # int8 KV (static scales): feed the MXU int8 x int8 directly —
+                # no cast of the streamed operands. q rows quantize per-row
+                # (tiny), scores rescale by sx; p quantizes to [0, 127] for the
+                # PV dot (the cache payload is already K/sigma resp. V/sigma,
+                # the per-head sigma fold happens outside the kernel).
+                qf = q.astype(jnp.float32)
+                sx = jnp.max(jnp.abs(qf), axis=1, keepdims=True) / 127.0
+                sx = jnp.maximum(sx, 1e-8)
+                q = jnp.clip(jnp.round(qf / sx), -127, 127).astype(jnp.int8)
+            else:
+                k = _vmem_cast(k, q.dtype)
+                v = _vmem_cast(v, q.dtype)
 
             row_iota = jax.lax.broadcasted_iota(jnp.int32, (nrows, hkv * width), 0)
             col_iota = jax.lax.broadcasted_iota(jnp.int32, (nrows, hkv * width), 1)
@@ -334,8 +348,15 @@ def _paged_attend_kernel(pos_ref, lidx_ref, bt_ref, q_ref, *refs, o_ref=None,
             if window is not None:
                 mask = jnp.logical_and(mask, kv_pos > q_pos - window)
 
-            s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                    preferred_element_type=jnp.float32) * scale
+            if int8_kv:
+                s = jax.lax.dot_general(
+                    q, k, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.int32
+                ).astype(jnp.float32) * (sx * scale)
+            else:
+                s = jax.lax.dot_general(
+                    q, k, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32) * scale
             if slopes_ref is not None:
                 s = s - slopes_ref[:, 0:1] * (q_pos - kv_pos).astype(jnp.float32)
             if soft_cap is not None:
@@ -349,9 +370,17 @@ def _paged_attend_kernel(pos_ref, lidx_ref, bt_ref, q_ref, *refs, o_ref=None,
             p = jnp.exp(s - m_new)
             p = jnp.where(mask, p, 0.0)
             l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
-            acc = acc_scratch[r0 : r0 + nrows] * alpha + jax.lax.dot_general(
-                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)
+            if int8_kv:
+                pi = jnp.round(p * 127.0).astype(jnp.int8)
+                pv = jax.lax.dot_general(
+                    pi, v, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.int32
+                ).astype(jnp.float32) * (1.0 / 127.0)
+            else:
+                pv = jax.lax.dot_general(
+                    p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+            acc = acc_scratch[r0 : r0 + nrows] * alpha + pv
             m_scratch[r0 : r0 + nrows] = jnp.broadcast_to(m_new, (nrows, 128))
             l_scratch[r0 : r0 + nrows] = jnp.broadcast_to(l_new, (nrows, 128))
             acc_scratch[r0 : r0 + nrows] = acc
@@ -376,7 +405,7 @@ def _paged_attend_kernel(pos_ref, lidx_ref, bt_ref, q_ref, *refs, o_ref=None,
 @functools.partial(
     jax.jit,
     static_argnames=("scale", "window", "soft_cap", "blocks_per_cell",
-                     "interpret", "variant"))
+                     "rows_per_cell", "interpret", "variant"))
 def paged_decode_attention_stacked(
     q: jnp.ndarray,              # (B, Hq, T, D), T small (1 or speculation width)
     k_cache: jnp.ndarray,        # (L, NB, Hkv, BS, D) — full stacked paged cache
@@ -390,6 +419,7 @@ def paged_decode_attention_stacked(
     sinks: Optional[jnp.ndarray] = None,         # (Hq,) learned sink logits
     alibi_slopes: Optional[jnp.ndarray] = None,  # (Hq,) ALiBi slopes
     blocks_per_cell: Optional[int] = None,
+    rows_per_cell: Optional[int] = None,
     interpret: bool = False,
     variant: int = 2,
 ) -> jnp.ndarray:
@@ -425,21 +455,38 @@ def paged_decode_attention_stacked(
         if rows != n_rep * t:
             qg = jnp.pad(qg, ((0, 0), (0, 0), (0, rows - n_rep * t), (0, 0)))
 
-    # fetch kb blocks per grid cell so per-cell fixed cost amortizes (~512 kv
-    # positions per cell unless the table is shorter)
-    kb = blocks_per_cell or max(1, min(mb, 512 // bs))
+    # cell geometry (r5 on-chip sweep at bs=64/BS=128/Hkv=8/D=128): batch 4
+    # rows per cell to amortize grid fixed cost, and size the per-cell KV
+    # footprint to ~2 MB so Mosaic's automatic double-buffering fits in VMEM
+    # and block fetches PIPELINE against compute — larger cells (the old
+    # 512-position heuristic) serialized DMA with the body (bf16 335 -> 291 us
+    # per layer; fp8 405 -> 399, cast-bound).
+    kv_itemsize = jnp.dtype(k_cache.dtype).itemsize
+    # int8 prefers bigger cells (r5 sweep: 182 us at 4 MB/cell vs 210 at
+    # 2 MB — the int8 body is cheap enough that fetch batching wins);
+    # bf16/fp8 pipeline best at ~2 MB/cell
+    budget = (4 if jnp.dtype(k_cache.dtype) == jnp.int8 else 2) * 2 ** 20
+    if rows_per_cell is not None:
+        if b % rows_per_cell != 0:
+            raise ValueError(f"rows_per_cell {rows_per_cell} must divide {b}")
+        bb = rows_per_cell
+    else:
+        # bound bb so even a kb=1 cell fits the budget (large pa_block_size /
+        # many kv heads would otherwise blow VMEM with double-buffering)
+        one_block = 2 * hkv * bs * d * kv_itemsize
+        bb = 1
+        for cand in (4, 2):
+            if b % cand == 0 and cand * one_block <= max(budget, one_block):
+                bb = cand
+                break
+    if blocks_per_cell:
+        kb = min(mb, blocks_per_cell)
+    else:
+        per_block = 2 * bb * hkv * bs * d * kv_itemsize
+        kb = min(mb, max(1, budget // per_block))
     while mb % kb != 0:
         kb -= 1
     num_cells = mb // kb
-    # batch rows per cell: amortizes the per-cell grid fixed cost further
-    # (bounded by VMEM: 2*bb*kb KV refs resident per cell)
-    bb = 1
-    kv_itemsize = jnp.dtype(k_cache.dtype).itemsize
-    for cand in (4, 2):
-        if (b % cand == 0
-                and 2 * cand * kb * hkv * bs * d * kv_itemsize <= 6 * 2 ** 20):
-            bb = cand
-            break
 
     def _kv_index_map(j, g):
         def index_map(bi, ci, pos, lidx, bt):

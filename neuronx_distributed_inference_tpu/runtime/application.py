@@ -696,11 +696,14 @@ class TpuModelForCausalLM:
         k_max, v_max = jax.jit(_cal)(
             self.params, padded.input_ids, padded.position_ids,
             padded.last_token_idx, cache)
-        fp8_max = float(ml_dtypes.finfo(
-            self.tpu_config.kv_cache_jax_dtype).max)
+        kv_dt = jnp.dtype(self.tpu_config.kv_cache_jax_dtype)
+        if kv_dt == jnp.int8:
+            cache_max = 127.0
+        else:
+            cache_max = float(ml_dtypes.finfo(kv_dt).max)
         eps = 1e-6
-        k_scale = np.maximum(np.asarray(k_max) / fp8_max, eps).astype(np.float32)
-        v_scale = np.maximum(np.asarray(v_max) / fp8_max, eps).astype(np.float32)
+        k_scale = np.maximum(np.asarray(k_max) / cache_max, eps).astype(np.float32)
+        v_scale = np.maximum(np.asarray(v_max) / cache_max, eps).astype(np.float32)
         self._kv_scales = (k_scale, v_scale)
         if self.kv_cache is not None and "k_scale" in self.kv_cache:
             self.kv_cache = self._apply_kv_scales(self.kv_cache)

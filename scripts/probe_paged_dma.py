@@ -50,7 +50,7 @@ def main():
         for r in kv_refs:
             # touch a sublane-aligned tile so the block fetch isn't elided
             acc = acc + r[0, 0, :, :8, :].astype(jnp.float32).sum(axis=1)
-        o_ref[...] = acc
+        o_ref[...] = acc[None]
 
     for dtype_name in ("bfloat16", "float8_e4m3fn"):
         dt = jnp.dtype(dtype_name)
@@ -69,11 +69,11 @@ def main():
             num_scalar_prefetch=3,
             grid=(B // BB, CELLS),
             in_specs=kv_specs,
-            out_specs=pl.BlockSpec((8, 128), lambda bi, ci, *_: (0, 0)),
+            out_specs=pl.BlockSpec((1, 8, 128), lambda bi, ci, *_: (bi, 0, 0)),
         )
         fn = pl.pallas_call(
             body, grid_spec=grid_spec,
-            out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32))
+            out_shape=jax.ShapeDtypeStruct((B // BB, 8, 128), jnp.float32))
 
         @jax.jit
         def run(pos, btab, kc, vc):

@@ -59,8 +59,10 @@ def main():
         "tie_word_embeddings": False,
     }
     batch, seq, block = 64, 1024, int(__import__("os").environ.get("PROBE_BLOCK", 128))
-    quant = QuantizationConfig(quantize_weights=True, weight_dtype="int8",
-                               kv_cache_dtype=__import__("os").environ.get("PROBE_KVD", "float8_e4m3"))
+    kvd = __import__("os").environ.get("PROBE_KVD", "float8_e4m3")
+    quant = QuantizationConfig(
+        quantize_weights=True, weight_dtype="int8", kv_cache_dtype=kvd,
+        kv_cache_scale_mode="static" if kvd == "int8" else "direct")
     cfg = TpuConfig(batch_size=batch, seq_len=seq, max_context_length=256,
                     dtype="bfloat16", tp_degree=1,
                     context_encoding_buckets=[256],

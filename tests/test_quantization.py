@@ -375,3 +375,69 @@ def test_qeinsum_transposed_storage_matches_plain():
         got = np.asarray(qeinsum(spec, x, wt))
         want = np.asarray(qeinsum(spec, x, w))
         np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+
+def test_int8_kv_static_scales_close_and_paths_agree(tiny_llama_hf_config):
+    """int8 KV cache (static per-head scales, r5): logits stay close to the
+    full-precision cache, and the jnp / Pallas-kernel / paged-CB paths agree
+    with each other (the kernels run MXU-native int8 dots with per-row q and
+    [0,127] p quantization; quantization noise must be the ONLY difference)."""
+    from neuronx_distributed_inference_tpu.config import QuantizationConfig
+    from neuronx_distributed_inference_tpu.runtime.continuous_batching import (
+        ContinuousBatchingRunner)
+
+    rng = np.random.default_rng(6)
+    ids = rng.integers(1, 256, size=(2, 10)).astype(np.int32)
+
+    def make(qc=None, kernel=None, paged=False):
+        tpu_cfg = TpuConfig(batch_size=2, seq_len=64, max_context_length=32,
+                            dtype="float32", context_encoding_buckets=[16, 32],
+                            token_generation_buckets=[32, 64],
+                            quantization_config=qc,
+                            decode_kernel_enabled=kernel,
+                            is_continuous_batching=paged,
+                            paged_attention_enabled=paged,
+                            pa_num_blocks=24 if paged else 0,
+                            pa_block_size=32 if paged else 128)
+        config = LlamaInferenceConfig(
+            tpu_cfg, load_config=load_pretrained_config(tiny_llama_hf_config))
+        app = LlamaForCausalLM(None, config)
+        app.load_random(seed=0)
+        return app
+
+    ref = make().generate(ids, max_new_tokens=8, return_logits=True)
+
+    qc = QuantizationConfig(kv_cache_dtype="int8",
+                            kv_cache_scale_mode="static")
+    outs = {}
+    for kernel in (False, True):
+        app = make(qc, kernel=kernel)
+        app.calibrate_kv_scales(ids)
+        outs[kernel] = app.generate(ids, max_new_tokens=8, return_logits=True)
+        # int8 KV is an approximation: logits close to full precision
+        err = np.max(np.abs(np.asarray(outs[kernel].logits[0])
+                            - np.asarray(ref.logits[0])))
+        assert err < 0.35, f"int8 KV drifted too far (kernel={kernel}): {err}"
+    # both decode paths see the same cache payloads; token agreement expected
+    np.testing.assert_array_equal(outs[True].tokens, outs[False].tokens)
+
+    # paged CB serving with int8 KV completes and matches the non-paged
+    # int8 tokens (same quantization scheme through the ragged kernels)
+    app_p = make(qc, paged=True)
+    app_p.calibrate_kv_scales(ids)
+    runner = ContinuousBatchingRunner(app_p, decode_chunk=4)
+    rids = [runner.submit(ids[i], max_new_tokens=8) for i in range(2)]
+    res = runner.run_to_completion()
+    for i, rid in enumerate(rids):
+        assert len(res[rid]) == 8
+        assert res[rid] == list(outs[True].tokens[i][:8]), (
+            f"paged int8 serving diverged for row {i}")
+
+
+def test_int8_kv_requires_static_mode():
+    from neuronx_distributed_inference_tpu.config import QuantizationConfig
+
+    with pytest.raises(ValueError, match="static"):
+        TpuConfig(batch_size=1, seq_len=32,
+                  quantization_config=QuantizationConfig(
+                      kv_cache_dtype="int8")).validate()
