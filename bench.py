@@ -351,7 +351,7 @@ def main() -> None:
         paged_app = None
         try:
             paged_sync, paged_async, paged_app = _paged_serving_throughput(
-                hf_cfg, quant, batch)
+                hf_cfg, batch)
             extra["paged_sync_tok_per_s"] = paged_sync
             extra["paged_async_tok_per_s"] = paged_async
             pq = paged_app.tpu_config.quantization_config
@@ -383,7 +383,7 @@ def main() -> None:
     print(json.dumps(result), flush=True)
 
 
-def _paged_serving_throughput(hf_cfg, quant, batch):
+def _paged_serving_throughput(hf_cfg, batch):
     """Steady-state decode throughput of the PAGED continuous-batching serving
     path with the Pallas ragged kernels, at the SAME batch/weight-quant config
     as the dense headline (VERDICT r3 #2: the serving path must carry the
@@ -423,11 +423,11 @@ def _paged_serving_throughput(hf_cfg, quant, batch):
     app = LlamaForCausalLM(None, config)
     app.load_host_params(_random_quantized_llama_params(hf_cfg, seed=0))
     rng = np.random.default_rng(0)
-    try:
-        app.calibrate_kv_scales(
-            rng.integers(1, 100000, size=(2, 200)).astype(np.int32))
-    except Exception as e:
-        _note(f"kv calibration skipped ({e}); sigma=1 scales (perf-identical)")
+    # NO in-bench calibration: calibrate_kv_scales builds a transient DENSE
+    # cache (~4.3 GB at this geometry) on top of weights + the paged pool and
+    # OOMed the chip. sigma=1 scales are PERF-identical (same ops, same
+    # bytes); int8 accuracy with calibrated scales is pinned on CPU by
+    # tests/test_quantization.py::test_int8_kv_static_scales_close_and_paths_agree.
     runner = ContinuousBatchingRunner(app, decode_chunk=32)
     for _ in range(bs):
         runner.submit(rng.integers(1, 100000, size=(200,)).astype(np.int32),
@@ -497,15 +497,9 @@ def _paged_spec_throughput(app, hf_cfg, batch):
                                     load_config=load_pretrained_config(draft_hf))
     draft = LlamaForCausalLM(None, d_config)
     draft.load_host_params(_random_quantized_llama_params(draft_hf, seed=1))
-    try:
-        # int8-static KV with sigma=1 collapses O(1) K/V to {-1,0,1} and would
-        # corrupt the DRAFT's predictions (acceptance-sensitive), not just add
-        # noise — calibrate it like the target
-        draft.calibrate_kv_scales(
-            np.random.default_rng(2).integers(
-                1, 100000, size=(2, 200)).astype(np.int32))
-    except Exception as e:
-        _note(f"draft kv calibration skipped ({e})")
+    # no calibration (see _paged_serving_throughput): with RANDOM weights the
+    # acceptance floor is ~chance regardless of draft cache fidelity, and the
+    # full-accept ceiling is acceptance-independent — the two numbers reported
 
     runner = ContinuousBatchingRunner(app, draft=draft, speculation_length=k,
                                       spec_chunk=8)

@@ -257,11 +257,14 @@ def eagle_prefill_forward(
     cache: kvcache.KVCache,
     mesh=None,
     rules=None,
+    slot_mapping=None,         # (B, S) paged write slots (-1 = drop)
 ) -> kvcache.KVCache:
     """Draft context encoding: populates the draft KV cache and returns it.
 
     (Prefill emits no draft proposal — the first fused step drafts from the target's
-    prefill hidden — so no lm_head runs here.)"""
+    prefill hidden — so no lm_head runs here.) With ``slot_mapping`` the draft
+    cache is PAGED (continuous-batching serving; blocks shared with the target's
+    table, pools separate)."""
     del last_token_idx
     h = _fuse_input(d_params, t_params, args, input_ids, cond_hidden)
     cos, sin = rope_ops.compute_cos_sin(d_params["rope_inv_freq"], position_ids,
@@ -269,9 +272,13 @@ def eagle_prefill_forward(
     s = input_ids.shape[1]
     mask = (position_ids[:, None, :, None] >= position_ids[:, None, None, :])
     mask = jnp.logical_and(mask, causal_mask(s, s)[None, None])
+    paged = None
+    if slot_mapping is not None:
+        paged = (jnp.zeros((input_ids.shape[0], 1), dtype=jnp.int32),
+                 slot_mapping)
     _, cache = model_base._run_stack(d_params, args, h, cos, sin, mask, cache,
                                      positions=None, decode_bucket=None,
-                                     mesh=mesh, rules=rules)
+                                     mesh=mesh, rules=rules, paged=paged)
     return cache
 
 
@@ -283,23 +290,30 @@ def eagle_decode_forward(
     cond_hidden: jnp.ndarray,  # (B, T, H)
     position_ids: jnp.ndarray, # (B,)
     cache: kvcache.KVCache,
-    decode_bucket: int,
+    decode_bucket: Optional[int],
     mesh=None,
     rules=None,
+    block_table=None,          # (B, MB) paged: per-seq block ids
+    slot_mapping=None,         # (B, T) paged: flat write slots
 ) -> Tuple[jnp.ndarray, jnp.ndarray, kvcache.KVCache]:
     """Draft token generation. Returns (logits (B, T, V), draft hiddens (B, T, H),
-    cache)."""
+    cache). With ``block_table``/``slot_mapping`` the draft cache is paged
+    (CB serving; reads gather through the table)."""
     b, t = input_ids.shape
     h = _fuse_input(d_params, t_params, args, input_ids, cond_hidden)
     pos_grid = position_ids[:, None] + jnp.arange(t)[None, :]
     cos, sin = rope_ops.compute_cos_sin(d_params["rope_inv_freq"], pos_grid,
                                         args.rope_attention_scaling)
+    paged = None
+    if block_table is not None:
+        paged = (block_table, slot_mapping)
+        decode_bucket = block_table.shape[1] * cache["k"].shape[3]
     kv_pos = jnp.arange(decode_bucket)[None, None, None, :]
     mask = kv_pos <= pos_grid[:, None, :, None]
     h, cache = model_base._run_stack(d_params, args, h, cos, sin, mask, cache,
                                      positions=position_ids,
                                      decode_bucket=decode_bucket,
-                                     mesh=mesh, rules=rules)
+                                     mesh=mesh, rules=rules, paged=paged)
     hn = rms_norm(h, d_params["final_norm"], args.rms_norm_eps)
     logits = model_base._lm_head(t_params, args, hn, mesh, rules)
     return logits, hn, cache

@@ -90,7 +90,8 @@ class ContinuousBatchingRunner:
                  async_mode: Optional[bool] = None, draft=None,
                  speculation_length: Optional[int] = None,
                  spec_chunk: Optional[int] = None,
-                 max_insert_tokens_per_step: Optional[int] = None):
+                 max_insert_tokens_per_step: Optional[int] = None,
+                 eagle_draft=None):
         cfg = app.tpu_config
         if not cfg.is_continuous_batching:
             raise ValueError("tpu_config.is_continuous_batching must be enabled")
@@ -161,13 +162,38 @@ class ContinuousBatchingRunner:
         self.adapter_ids = np.zeros((self.num_slots,), dtype=np.int32)
         self._lora_on = app.arch_args.lora is not None
 
-        # --- fused speculation through the serving loop ------------------------
+        # --- speculation through the serving loop ------------------------------
+        # two draft kinds: ``draft`` (a full TpuModelForCausalLM — fused spec)
+        # or ``eagle_draft`` ((draft_args, draft_params) — EAGLE-style hidden-
+        # state-conditioned 1-layer draft, greedy, paged serving only)
         self.draft = draft
+        self.eagle = eagle_draft
         self.k = 0
-        if draft is None and (speculation_length is not None
-                              or spec_chunk is not None):
+        if draft is not None and eagle_draft is not None:
+            raise ValueError("draft and eagle_draft are mutually exclusive")
+        if (draft is None and eagle_draft is None
+                and (speculation_length is not None or spec_chunk is not None)):
             raise ValueError("speculation_length/spec_chunk require a draft "
-                             "model (pass draft=<TpuModelForCausalLM>)")
+                             "model (pass draft= or eagle_draft=)")
+        if eagle_draft is not None:
+            if speculation_length is None or speculation_length < 2:
+                raise ValueError(
+                    "speculation_length must be >= 2 (1 draft + 1 verify)")
+            if not self.paged:
+                raise ValueError("eagle_draft serving requires paged attention")
+            if not self._greedy:
+                raise ValueError("EAGLE serving is greedy-only (matches "
+                                 "runtime/eagle.py)")
+            if max_insert_tokens_per_step is not None:
+                raise ValueError("eagle_draft does not compose with "
+                                 "max_insert_tokens_per_step (the draft "
+                                 "conditioning hidden must be continuous "
+                                 "across insert windows)")
+            self.k = speculation_length
+            self.spec_chunk = spec_chunk or max(1, self.decode_chunk // self.k)
+            self.async_mode = False
+            self._async_auto = False
+            self.acceptance_counts = np.zeros((self.k,), dtype=np.int64)
         if draft is not None:
             if speculation_length is None or speculation_length < 2:
                 raise ValueError(
@@ -246,6 +272,28 @@ class ContinuousBatchingRunner:
                 draft.reset_cache()
                 self.d_cache = draft.kv_cache
                 draft.kv_cache = None
+        elif eagle_draft is not None:
+            # EAGLE draft pool: same block table, own (1-layer) pool in the
+            # MODEL dtype (the quantized-KV scale folds don't apply to the
+            # draft; its pool is tiny)
+            from ..modules import block_kvcache
+            from ..parallel.sharding import named_sharding
+
+            d_args = eagle_draft[0]
+            spec = block_kvcache.PagedKVCacheSpec(
+                num_layers=d_args.num_layers, num_blocks=cfg.pa_num_blocks,
+                block_size=cfg.pa_block_size,
+                num_kv_heads=d_args.num_kv_heads, head_dim=d_args.head_dim,
+                dtype=cfg.jax_dtype)
+            sharding = named_sharding(app.mesh,
+                                      block_kvcache.PAGED_CACHE_LOGICAL,
+                                      app.sharding_rules)
+            self.d_cache = jax.tree.map(
+                lambda x: jax.device_put(x, sharding),
+                block_kvcache.init_paged_cache(spec))
+            # per-slot draft conditioning hidden (device-resident across steps)
+            self._h_cond = jnp.zeros(
+                (self.num_slots, app.arch_args.hidden_size), cfg.jax_dtype)
 
         self._build_steps()
 
@@ -393,6 +441,121 @@ class ContinuousBatchingRunner:
 
         if self.draft is not None:
             self._build_spec_steps()
+        elif self.eagle is not None:
+            self._build_eagle_steps()
+
+    def _build_eagle_steps(self) -> None:
+        """EAGLE speculation through paged serving: hidden-state-conditioned
+        1-layer draft (≈ runtime/eagle.py fused step, re-hosted on the CB block
+        layout). The per-slot conditioning hidden rides DEVICE-resident runner
+        state; inserts run the target's windowed prefix-prefill with
+        return_hidden and stream the shifted hiddens into the draft pool."""
+        from ..models import eagle as eagle_lib
+
+        app = self.app
+        t_args, mesh, rules = app.arch_args, app.mesh, app.sharding_rules
+        d_args = self.eagle[0]
+        k = self.k
+        bs_blk = self.block_size
+        mb = self.max_blocks_per_seq
+        precision = "highest" if self.cfg.dtype == "float32" else "default"
+        t_decode = app.decode_fn()
+        t_kw = ({"use_kernel": True}
+                if app._use_paged_decode_kernel() else {})
+        odsc = self.sampling_config
+
+        def _insert_eagle(t_params, d_params, input_ids, position_ids,
+                          last_token_idx, t_cache, d_cache, bt_row, slot_map,
+                          sampling_params, key, h_prev):
+            """One prefix-prefill window: target (samples seed token, returns
+            hiddens) + EAGLE draft prefill conditioned on the shifted hiddens
+            (h_prev = last hidden of the previous window; zeros for the first)."""
+            with jax.default_matmul_precision(precision):
+                logits, t_cache, h_full = t_decode(
+                    t_params, t_args, input_ids, position_ids, t_cache, None,
+                    mesh=mesh, rules=rules, block_table=bt_row,
+                    slot_mapping=slot_map, return_hidden=True)
+                last = jnp.take_along_axis(
+                    logits, last_token_idx[:, None, None], axis=1)[:, 0]
+                tok = sampling_ops.sample(last, sampling_params, key, odsc)
+                cond = jnp.concatenate(
+                    [h_prev[:, None].astype(h_full.dtype), h_full[:, :-1]],
+                    axis=1)
+                pos_grid = position_ids[:, None] + jnp.arange(
+                    input_ids.shape[1], dtype=jnp.int32)[None, :]
+                d_cache = eagle_lib.eagle_prefill_forward(
+                    d_params, t_params, d_args, input_ids, cond, pos_grid,
+                    last_token_idx, d_cache, mesh=mesh, rules=rules,
+                    slot_mapping=slot_map)
+                h_last = jnp.take_along_axis(
+                    h_full, last_token_idx[:, None, None], axis=1)[:, 0]
+            return tok, h_last, t_cache, d_cache
+
+        self._insert_step_eagle = jax.jit(_insert_eagle, donate_argnums=(5, 6))
+
+        def _eagle_chunk(t_params, d_params, tok0, h0, positions, alive0,
+                         t_cache, d_cache, block_table, eos_ids, key,
+                         num_iters):
+            """``num_iters`` on-device EAGLE iterations: K-1 hidden-conditioned
+            draft proposals + wide K verify (greedy exact-match acceptance),
+            per-row positions AND conditioning hiddens advancing in-graph."""
+            del key                      # greedy: no sampling noise
+
+            def one_iter(carry, _):
+                tok, h, pos, alive, t_cache, d_cache = carry
+                p = pos[:, None] + jnp.arange(k, dtype=jnp.int32)[None, :]
+                blk = jnp.take_along_axis(
+                    block_table, jnp.minimum(p // bs_blk, mb - 1), axis=1)
+                sm = jnp.where(alive[:, None], blk * bs_blk + p % bs_blk, -1)
+                sm_cols = sm.T[:, :, None]                  # (K, B, 1)
+
+                def draft_body(dc, sm_j):
+                    dtok, dh, dpos, cache = dc
+                    with jax.default_matmul_precision(precision):
+                        logits, h_d, cache = eagle_lib.eagle_decode_forward(
+                            d_params, t_params, d_args, dtok[:, None],
+                            dh[:, None, :], dpos, cache, None, mesh=mesh,
+                            rules=rules, block_table=block_table,
+                            slot_mapping=sm_j)
+                    nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+                    return (nxt, h_d[:, -1], dpos + 1, cache), nxt
+
+                (_, _, _, d_cache), d_toks = jax.lax.scan(
+                    draft_body, (tok, h, pos, d_cache), sm_cols)
+                d_toks = d_toks.T[:, : k - 1]               # (B, K-1)
+
+                t_in = jnp.concatenate([tok[:, None], d_toks], axis=1)
+                with jax.default_matmul_precision(precision):
+                    t_logits, t_cache, t_h = t_decode(
+                        t_params, t_args, t_in, pos, t_cache, None,
+                        mesh=mesh, rules=rules, block_table=block_table,
+                        slot_mapping=sm, return_hidden=True, **t_kw)
+                t_toks = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)
+                matches = d_toks == t_toks[:, :-1]
+                n = jnp.cumprod(matches.astype(jnp.int32), axis=1).sum(
+                    axis=1).astype(jnp.int32)
+
+                take = jnp.where(alive, n + 1, 0)
+                new_tok = jnp.take_along_axis(
+                    t_toks, jnp.maximum(take - 1, 0)[:, None], axis=1)[:, 0]
+                h_next = jnp.take_along_axis(
+                    t_h, n[:, None, None], axis=1)[:, 0]    # hidden at slot n
+                tok = jnp.where(alive, new_tok, tok)
+                h = jnp.where(alive[:, None], h_next, h)
+                pos = pos + take
+                win = jnp.arange(k, dtype=jnp.int32)[None, :] < take[:, None]
+                hit_eos = jnp.any(win & (t_toks == eos_ids[:, None]), axis=1)
+                alive = alive & ~hit_eos
+                return (tok, h, pos, alive, t_cache, d_cache), (t_toks, n)
+
+            (_, h_out, _, _, t_cache, d_cache), (outs, ns) = jax.lax.scan(
+                one_iter, (tok0, h0, positions, alive0, t_cache, d_cache),
+                None, length=num_iters)
+            return outs, ns, h_out, t_cache, d_cache
+
+        self._spec_step_eagle = jax.jit(
+            _eagle_chunk, donate_argnums=(6, 7),
+            static_argnames=("num_iters",))
 
     def _build_spec_steps(self) -> None:
         """Fused-speculation serving chunks: per dispatch, ``num_iters`` on-device
@@ -554,6 +717,8 @@ class ContinuousBatchingRunner:
             if sampling_params.shape != (3,):
                 raise ValueError("sampling_params must be (top_k, top_p, "
                                  "temperature)")
+            if self.eagle is not None and sampling_params[0] != 1:
+                raise ValueError("EAGLE serving is greedy-only")
             if not (self.sampling_config.dynamic
                     or self.sampling_config.do_sample):
                 raise ValueError(
@@ -561,6 +726,9 @@ class ContinuousBatchingRunner:
                     "with dynamic=True or do_sample=True (otherwise the "
                     "on-device sampler is a plain argmax and the params "
                     "would be silently ignored)")
+        if self.eagle is not None and adapter_id != 0:
+            raise ValueError("eagle_draft serving does not route per-request "
+                             "adapters yet")
         if adapter_id != 0:
             if not self._lora_on:
                 raise ValueError("adapter_id given but the model has no "
@@ -679,7 +847,7 @@ class ContinuousBatchingRunner:
             if self.paged:
                 # require room for the prompt plus one decode chunk, else a fresh
                 # insert can be preempted before generating a single token (thrash)
-                chunk_tokens = (self.spec_chunk * self.k if self.draft is not None
+                chunk_tokens = (self.spec_chunk * self.k if self.k
                                 else self.decode_chunk)
                 need = -(-(fed_len + 1 + chunk_tokens) // self.block_size)
                 if self.allocator.num_free < need:
@@ -756,7 +924,7 @@ class ContinuousBatchingRunner:
         key = self._place_queued(key, emitted)
         if self.insert_cap is not None:
             key = self._advance_inserts(key, emitted)
-        if self.draft is not None:
+        if self.k:
             return self._step_spec(key, emitted)
         return self._step_plain(key, emitted)
 
@@ -894,15 +1062,23 @@ class ContinuousBatchingRunner:
         sp = self._sampling_matrix()
         bt = (jnp.asarray(self.block_table) if self.paged
               else jnp.zeros((1, 1), dtype=jnp.int32))
-        bucket = (None if self.paged
-                  else autobucketing.select_bucket(self.app.tkg_buckets,
-                                                   max_pos + iters * self.k))
-        outs, ns, self.cache, self.d_cache = self._spec_step(
-            self.app.params, self.draft.params, jnp.asarray(self.last_tok),
-            jnp.asarray(self.positions), jnp.asarray(alive0), self.cache,
-            self.d_cache, bt, sp, jnp.asarray(eos_ids), sub,
-            jnp.asarray(self.adapter_ids), num_iters=iters,
-            greedy=self._chunk_greedy(live), decode_bucket=bucket)
+        if self.eagle is not None:
+            outs, ns, self._h_cond, self.cache, self.d_cache = \
+                self._spec_step_eagle(
+                    self.app.params, self.eagle[1], jnp.asarray(self.last_tok),
+                    self._h_cond, jnp.asarray(self.positions),
+                    jnp.asarray(alive0), self.cache, self.d_cache, bt,
+                    jnp.asarray(eos_ids), sub, num_iters=iters)
+        else:
+            bucket = (None if self.paged
+                      else autobucketing.select_bucket(self.app.tkg_buckets,
+                                                       max_pos + iters * self.k))
+            outs, ns, self.cache, self.d_cache = self._spec_step(
+                self.app.params, self.draft.params, jnp.asarray(self.last_tok),
+                jnp.asarray(self.positions), jnp.asarray(alive0), self.cache,
+                self.d_cache, bt, sp, jnp.asarray(eos_ids), sub,
+                jnp.asarray(self.adapter_ids), num_iters=iters,
+                greedy=self._chunk_greedy(live), decode_bucket=bucket)
         outs = np.asarray(outs)           # (iters, slots, K)
         ns = np.asarray(ns)               # (iters, slots)
         for it in range(iters):
@@ -1077,6 +1253,8 @@ class ContinuousBatchingRunner:
             fed = np.concatenate(
                 [req.prompt, np.asarray(req.generated[:-1], dtype=np.int32)])
 
+        if self.paged and self.eagle is not None:
+            return self._insert_eagle_host(req, slot, key, fed)
         sp_row = self._slot_sp[slot : slot + 1]
         ad_row = jnp.asarray(self.adapter_ids[slot : slot + 1])
 
@@ -1117,6 +1295,44 @@ class ContinuousBatchingRunner:
                     self.draft.params, padded.input_ids, padded.position_ids,
                     padded.last_token_idx, self.d_cache,
                     jnp.asarray(slot, dtype=jnp.int32))
+        return int(np.asarray(tok_dev)[0])
+
+    def _insert_eagle_host(self, req: Request, slot: int, key, fed) -> int:
+        """EAGLE-mode paged insert: windowed prefix-prefill with the target's
+        hiddens streamed (shifted) into the draft pool; the conditioning hidden
+        carries across windows and seeds the slot's device-resident state.
+
+        Prefix-cache SKIPPING is disabled here (cached_len forced 0): the draft
+        conditioning needs the hidden of the token before each window, which a
+        skipped prefix doesn't produce. Shared full blocks are simply rewritten
+        with identical content (the chain hash keys tokens), so block SHARING
+        still dedups memory."""
+        req.blocks, _ = self.allocator.allocate_for_prompt(fed)
+        self.block_table[slot, : len(req.blocks)] = req.blocks
+        sp_row = self._slot_sp[slot : slot + 1]
+        max_window = self.app.cte_buckets[-1]
+        h_prev = jnp.zeros((1, self.app.arch_args.hidden_size),
+                           self.cfg.jax_dtype)
+        start = 0
+        tok_dev = None
+        while start < len(fed):
+            window = fed[start : start + max_window]
+            padded = model_wrapper.pad_prefill_inputs(
+                window[None, :], None, self.app.cte_buckets, batch_size=1)
+            pos_row = np.array([start], dtype=np.int32)
+            valid = np.ones((1, padded.bucket), dtype=bool)
+            valid[0, len(window):] = False
+            slot_map = self._slot_mapping_fn(
+                self.block_table[slot : slot + 1], pos_row, padded.bucket,
+                self.block_size, valid=valid)
+            key, sub = jax.random.split(key)
+            tok_dev, h_prev, self.cache, self.d_cache = self._insert_step_eagle(
+                self.app.params, self.eagle[1], padded.input_ids, pos_row,
+                padded.last_token_idx, self.cache, self.d_cache,
+                jnp.asarray(self.block_table[slot : slot + 1]),
+                jnp.asarray(slot_map), sp_row, sub, h_prev)
+            start += len(window)
+        self._h_cond = self._h_cond.at[slot].set(h_prev[0])
         return int(np.asarray(tok_dev)[0])
 
     def _maybe_finish(self, req: Request, emitted) -> None:

@@ -187,3 +187,24 @@ def test_submit_validation(tiny_llama_hf_config, prompts):
         runner.submit(prompts[0], adapter_id=1)
     with pytest.raises(ValueError, match="top_k"):
         runner.submit(prompts[0], sampling_params=(1, 1))
+
+
+def test_submit_rejects_inert_sampling_params(tiny_llama_hf_config, prompts):
+    """With dynamic=False and do_sample=False the on-device sampler is plain
+    argmax; custom sampling_params would be silently ignored — submit must
+    refuse them (found-by-review regression: this guard was briefly dead)."""
+    from neuronx_distributed_inference_tpu.config import OnDeviceSamplingConfig
+
+    tpu_cfg = TpuConfig(
+        batch_size=2, seq_len=96, max_context_length=32, dtype="float32",
+        context_encoding_buckets=[16, 32], token_generation_buckets=[48, 96],
+        is_continuous_batching=True, paged_attention_enabled=True,
+        pa_num_blocks=48, pa_block_size=8,
+        on_device_sampling_config=OnDeviceSamplingConfig(dynamic=False))
+    config = LlamaInferenceConfig(
+        tpu_cfg, load_config=load_pretrained_config(tiny_llama_hf_config))
+    app = LlamaForCausalLM(None, config)
+    app.load_random(seed=0)
+    runner = ContinuousBatchingRunner(app)
+    with pytest.raises(ValueError, match="dynamic"):
+        runner.submit(prompts[0], sampling_params=(8, 0.9, 0.7))

@@ -184,3 +184,59 @@ def test_cb_spec_validates_geometry(tiny_llama_hf_config):
     draft = _make_app(_draft_cfg(tiny_llama_hf_config), seed=1, paged=True)
     with pytest.raises(ValueError, match="speculation_length"):
         ContinuousBatchingRunner(target, draft=draft, speculation_length=1)
+
+
+def test_eagle_cb_matches_dedicated_runs(tiny_llama_hf_config, prompts,
+                                         reference_tokens):
+    """EAGLE speculation through paged continuous batching: greedy exactness
+    means CB+EAGLE must emit exactly the dedicated plain runs' tokens,
+    regardless of the (random) draft."""
+    import jax
+
+    from neuronx_distributed_inference_tpu.models import eagle as eagle_lib
+    from neuronx_distributed_inference_tpu.runtime.eagle import (
+        draft_args_from_target)
+
+    target = _make_app(tiny_llama_hf_config, seed=0, paged=True)
+    d_args = draft_args_from_target(target.arch_args)
+    d_params = eagle_lib.init_eagle_params(
+        d_args, jax.random.PRNGKey(3), dtype=target.tpu_config.jax_dtype,
+        inv_freq=target.inv_freq_from_config(target.config))
+    runner = ContinuousBatchingRunner(
+        target, eagle_draft=(d_args, d_params), speculation_length=3)
+    ids = [runner.submit(p, max_new_tokens=10) for p in prompts]  # 3 reqs, 2 slots
+    results = runner.run_to_completion()
+    for i, rid in enumerate(ids):
+        assert results[rid] == reference_tokens[i], f"request {i} diverged"
+    assert runner.allocator.num_free == runner.allocator.num_blocks
+
+
+def test_eagle_cb_long_prompt_and_eos(tiny_llama_hf_config, prompts,
+                                      reference_tokens):
+    """EAGLE CB with a windowed (multi-window) insert and an eos stop."""
+    import jax
+
+    from neuronx_distributed_inference_tpu.models import eagle as eagle_lib
+    from neuronx_distributed_inference_tpu.runtime.eagle import (
+        draft_args_from_target)
+
+    rng = np.random.default_rng(23)
+    long_p = rng.integers(1, 256, size=(50,)).astype(np.int32)  # > bucket 32
+    plain = _make_app(tiny_llama_hf_config)
+    want_long = plain.generate(long_p[None, :], max_new_tokens=8
+                               ).tokens[0].tolist()
+    eos = reference_tokens[0][4]
+
+    target = _make_app(tiny_llama_hf_config, seed=0, paged=True)
+    d_args = draft_args_from_target(target.arch_args)
+    d_params = eagle_lib.init_eagle_params(
+        d_args, jax.random.PRNGKey(3), dtype=target.tpu_config.jax_dtype,
+        inv_freq=target.inv_freq_from_config(target.config))
+    runner = ContinuousBatchingRunner(
+        target, eagle_draft=(d_args, d_params), speculation_length=3)
+    r_long = runner.submit(long_p, max_new_tokens=8)
+    r_eos = runner.submit(prompts[0], max_new_tokens=10, eos_token_id=eos)
+    results = runner.run_to_completion()
+    assert results[r_long] == want_long
+    want_eos = reference_tokens[0][: reference_tokens[0].index(eos) + 1]
+    assert results[r_eos] == want_eos
