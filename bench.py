@@ -479,9 +479,12 @@ def _paged_spec_throughput(app, hf_cfg, batch):
     k = 4
     tgt_cfg = app.tpu_config
     quant = tgt_cfg.quantization_config     # draft matches the serving config
+    # standard head_dim (128) so the DRAFT also rides the paged Pallas kernels
+    # (the r5 first run used head_dim=64, which the kernel gate declines — the
+    # draft fell to the gather path and dominated the iteration at 140 ms)
     draft_hf = dict(hf_cfg, hidden_size=2048, intermediate_size=8192,
-                    num_hidden_layers=8, num_attention_heads=32,
-                    num_key_value_heads=8, head_dim=64)
+                    num_hidden_layers=8, num_attention_heads=16,
+                    num_key_value_heads=4, head_dim=128)
     d_tpu = TpuConfig(batch_size=tgt_cfg.max_batch_size, seq_len=tgt_cfg.seq_len,
                       max_context_length=tgt_cfg.max_context_length,
                       dtype="bfloat16", tp_degree=1,

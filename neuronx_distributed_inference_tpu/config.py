@@ -160,11 +160,20 @@ class QuantizationConfig:
     quantize_weights: bool = False
     weight_dtype: str = "int8"       # int8 | float8_e4m3
     kv_cache_dtype: Optional[str] = None  # None = same as model dtype
-    kv_cache_scale_mode: str = "direct"   # direct | static (fp8 caches only)
+    kv_cache_scale_mode: str = "direct"   # direct | static (fp8/int8 caches)
+
     # int8 dynamic per-token activation quant on qkv/mlp projections (the TPU
     # rmsnorm_quant analog — int8 x int8 rides the doubled-throughput MXU path);
     # requires weight_dtype == "int8"
     activation_quant: bool = False
+
+    @classmethod
+    def for_kv_dtype(cls, kv_cache_dtype: str, **kw) -> "QuantizationConfig":
+        """Config for a KV cache dtype with the right scale mode (int8 REQUIRES
+        static per-head scales; fp8 defaults to direct cast) — the single place
+        scripts/benches derive the pairing from."""
+        mode = "static" if kv_cache_dtype == "int8" else "direct"
+        return cls(kv_cache_dtype=kv_cache_dtype, kv_cache_scale_mode=mode, **kw)
 
 
 @dataclass

@@ -559,6 +559,22 @@ _DECODE_NEW_KV = ("decode_batch", "decode_kv_heads", None, None)
 _DECODE_Q = ("decode_batch", "decode_heads", None, None)
 
 
+def _stacked_attend_min_bucket() -> int:
+    """Smallest decode bucket that takes the Pallas length-aware stacked attend
+    instead of dynamic-slice + jnp.
+
+    MEASURED r5 (8B bs=64, bucket 512, 128-step decode): the slice+jnp path
+    runs 17.7 ms/step (fp8) / 17.3 (int8) vs the stacked kernel's 20.9 / 20.2
+    — even though the slice COPIES cost ~2.55 ms/step (3x cache traffic), the
+    kernel's per-cell costs at short widths cost more. Length-aware reads only
+    pay at >=1024-wide buckets, confirming the r4 tuning. Overridable for
+    probes via TPUINF_STACKED_ATTEND_MIN_BUCKET — read at TRACE time, so it
+    must be set before the first compile (a warm executable never re-reads it)."""
+    import os
+
+    return int(os.environ.get("TPUINF_STACKED_ATTEND_MIN_BUCKET", "1024"))
+
+
 
 def _head_extras(sinks, alibi_slopes, logical_axis):
     """Per-q-head kernel extras (sinks / ALiBi slopes) -> (in_logical tail,
@@ -900,7 +916,7 @@ def _decoder_layer(
             wp = positions if write_positions is None else write_positions
             k_cache, v_cache = _sharded_kv_write(
                 k_cache, v_cache, k, v, wp, stacked_layer_idx, mesh, rules)
-            if decode_bucket >= 1024:
+            if decode_bucket >= _stacked_attend_min_bucket():
                 attn = _sharded_decode_attend(q, k_cache, v_cache, positions,
                                               stacked_layer_idx, decode_bucket,
                                               args, mesh, rules, sinks=sinks_arr,

@@ -42,8 +42,9 @@ def main():
     }
     batch = int(os.environ.get("BENCH_BS", "64"))
     kernel = os.environ.get("BENCH_KERNEL", "1") == "1"
-    quant = QuantizationConfig(quantize_weights=True, weight_dtype="int8",
-                               kv_cache_dtype="float8_e4m3")
+    kvd = os.environ.get("BENCH_KVD", "float8_e4m3")
+    quant = QuantizationConfig.for_kv_dtype(
+        kvd, quantize_weights=True, weight_dtype="int8")
     tpu_cfg = TpuConfig(batch_size=batch, seq_len=512, max_context_length=256,
                         dtype="bfloat16", tp_degree=1,
                         context_encoding_buckets=[128, 256],

@@ -60,9 +60,8 @@ def main():
     }
     batch, seq, block = 64, 1024, int(__import__("os").environ.get("PROBE_BLOCK", 128))
     kvd = __import__("os").environ.get("PROBE_KVD", "float8_e4m3")
-    quant = QuantizationConfig(
-        quantize_weights=True, weight_dtype="int8", kv_cache_dtype=kvd,
-        kv_cache_scale_mode="static" if kvd == "int8" else "direct")
+    quant = QuantizationConfig.for_kv_dtype(
+        kvd, quantize_weights=True, weight_dtype="int8")
     cfg = TpuConfig(batch_size=batch, seq_len=seq, max_context_length=256,
                     dtype="bfloat16", tp_degree=1,
                     context_encoding_buckets=[256],
