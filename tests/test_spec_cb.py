@@ -45,16 +45,23 @@ def _draft_cfg(tiny_llama_hf_config):
 
 
 @pytest.fixture(scope="module")
+def plain_app(tiny_llama_hf_config):
+    """One shared plain (non-spec) reference app for every dedicated-run
+    comparison in this module (each _make_app pays a full compile)."""
+    return _make_app(tiny_llama_hf_config)
+
+
+@pytest.fixture(scope="module")
 def prompts():
     rng = np.random.default_rng(7)
     return [rng.integers(1, 256, size=(n,)).astype(np.int32) for n in (12, 7, 19)]
 
 
 @pytest.fixture(scope="module")
-def reference_tokens(tiny_llama_hf_config, prompts):
+def reference_tokens(plain_app, prompts):
     """Per-prompt greedy tokens from dedicated plain (non-spec) runs."""
-    app = _make_app(tiny_llama_hf_config)
-    return {i: app.generate(p[None, :], max_new_tokens=10).tokens[0].tolist()
+    return {i: plain_app.generate(p[None, :],
+                                  max_new_tokens=10).tokens[0].tolist()
             for i, p in enumerate(prompts)}
 
 
@@ -86,7 +93,8 @@ def test_dense_cb_spec_matches_dedicated_runs(tiny_llama_hf_config, prompts,
         assert results[rid] == reference_tokens[i], f"request {i} diverged"
 
 
-def test_cb_spec_self_draft_accepts_everything(tiny_llama_hf_config, prompts):
+def test_cb_spec_self_draft_accepts_everything(tiny_llama_hf_config, prompts,
+                                               plain_app):
     """Draft == target: every window fully accepts, so the acceptance histogram
     is concentrated at K and throughput is ~K tokens per fused iteration."""
     target = _make_app(tiny_llama_hf_config, seed=0, paged=True)
@@ -96,7 +104,7 @@ def test_cb_spec_self_draft_accepts_everything(tiny_llama_hf_config, prompts):
     # and the committed-token histogram concentrates at K
     rid = runner.submit(prompts[0], max_new_tokens=13)
     results = runner.run_to_completion()
-    ref = _make_app(tiny_llama_hf_config).generate(
+    ref = plain_app.generate(
         prompts[0][None, :], max_new_tokens=13).tokens[0].tolist()
     assert results[rid] == ref
     assert runner.acceptance_counts[:-1].sum() == 0, "self-draft must fully accept"
@@ -118,7 +126,7 @@ def test_cb_spec_eos_stops_row_exactly(tiny_llama_hf_config, prompts,
     assert results[r1] == reference_tokens[1]
 
 
-def test_cb_spec_prefix_cache_shares_blocks(tiny_llama_hf_config):
+def test_cb_spec_prefix_cache_shares_blocks(tiny_llama_hf_config, plain_app):
     """Prefix caching under spec serving: the second request's full prefix
     blocks are shared AND both caches (target + draft) serve it correctly —
     every insert writes both pools, so the host-side content hash stays valid."""
@@ -126,9 +134,8 @@ def test_cb_spec_prefix_cache_shares_blocks(tiny_llama_hf_config):
     prefix = rng.integers(1, 256, size=(16,)).astype(np.int32)
     pa = np.concatenate([prefix, rng.integers(1, 256, size=(4,)).astype(np.int32)])
     pb = np.concatenate([prefix, rng.integers(1, 256, size=(5,)).astype(np.int32)])
-    plain = _make_app(tiny_llama_hf_config)
-    want_a = plain.generate(pa[None, :], max_new_tokens=8).tokens[0].tolist()
-    want_b = plain.generate(pb[None, :], max_new_tokens=8).tokens[0].tolist()
+    want_a = plain_app.generate(pa[None, :], max_new_tokens=8).tokens[0].tolist()
+    want_b = plain_app.generate(pb[None, :], max_new_tokens=8).tokens[0].tolist()
 
     runner = _spec_runner(tiny_llama_hf_config, paged=True)
     ra = runner.submit(pa, max_new_tokens=8)
@@ -163,14 +170,15 @@ def test_cb_spec_multinomial_runs_deterministically(tiny_llama_hf_config,
     assert all(len(t) == 8 for t in first)
 
 
-def test_cb_spec_seq_boundary_finishes_exactly(tiny_llama_hf_config):
+def test_cb_spec_seq_boundary_finishes_exactly(tiny_llama_hf_config,
+                                               plain_app):
     """A request whose tail lands within K-1 positions of seq_len must still
     finish with its full budget via the exact plain-decode fallback (it must
     NOT be force-truncated: found-by-review regression)."""
     rng = np.random.default_rng(11)
     prompt = rng.integers(1, 256, size=(88,)).astype(np.int32)  # 88 + 6 <= 96
-    plain = _make_app(tiny_llama_hf_config)
-    want = plain.generate(prompt[None, :], max_new_tokens=6).tokens[0].tolist()
+    want = plain_app.generate(prompt[None, :],
+                              max_new_tokens=6).tokens[0].tolist()
 
     runner = _spec_runner(tiny_llama_hf_config, paged=True)
     rid = runner.submit(prompt, max_new_tokens=6)
@@ -212,7 +220,7 @@ def test_eagle_cb_matches_dedicated_runs(tiny_llama_hf_config, prompts,
 
 
 def test_eagle_cb_long_prompt_and_eos(tiny_llama_hf_config, prompts,
-                                      reference_tokens):
+                                      reference_tokens, plain_app):
     """EAGLE CB with a windowed (multi-window) insert and an eos stop."""
     import jax
 
@@ -222,9 +230,8 @@ def test_eagle_cb_long_prompt_and_eos(tiny_llama_hf_config, prompts,
 
     rng = np.random.default_rng(23)
     long_p = rng.integers(1, 256, size=(50,)).astype(np.int32)  # > bucket 32
-    plain = _make_app(tiny_llama_hf_config)
-    want_long = plain.generate(long_p[None, :], max_new_tokens=8
-                               ).tokens[0].tolist()
+    want_long = plain_app.generate(long_p[None, :], max_new_tokens=8
+                                   ).tokens[0].tolist()
     eos = reference_tokens[0][4]
 
     target = _make_app(tiny_llama_hf_config, seed=0, paged=True)
@@ -240,3 +247,23 @@ def test_eagle_cb_long_prompt_and_eos(tiny_llama_hf_config, prompts,
     assert results[r_long] == want_long
     want_eos = reference_tokens[0][: reference_tokens[0].index(eos) + 1]
     assert results[r_eos] == want_eos
+
+
+def test_cb_spec_composes_with_chunked_prefill(tiny_llama_hf_config, prompts,
+                                               reference_tokens, plain_app):
+    """Fused speculation + chunked-prefill scheduling: a long prompt streams in
+    capped windows (both pools written per window) while spec decoding serves
+    residents; outputs stay exact."""
+    rng = np.random.default_rng(31)
+    long_p = rng.integers(1, 256, size=(50,)).astype(np.int32)
+    want_long = plain_app.generate(long_p[None, :], max_new_tokens=8
+                                   ).tokens[0].tolist()
+
+    runner = _spec_runner(tiny_llama_hf_config, paged=True,
+                          max_insert_tokens_per_step=16)
+    r0 = runner.submit(prompts[0], max_new_tokens=10)
+    runner.step()                                  # resident decoding
+    r_long = runner.submit(long_p, max_new_tokens=8)
+    results = runner.run_to_completion()
+    assert results[r0] == reference_tokens[0]
+    assert results[r_long] == want_long
