@@ -120,10 +120,13 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--quantize-weights", choices=["int8", "float8_e4m3"],
                    default=None, help="weight-only quantization dtype")
     g.add_argument("--kv-cache-scale-mode", choices=["direct", "static"],
-                   default="direct",
-                   help="fp8 KV: direct cast, or calibrated static per-head scales")
+                   default=None,
+                   help="direct cast, or calibrated static per-head scales "
+                        "(default: static for int8 KV, direct for fp8)")
     g.add_argument("--kv-cache-dtype", default=None,
-                   help="fp8 KV cache dtype (e.g. float8_e4m3)")
+                   choices=["float8_e4m3", "float8_e5m2", "int8"],
+                   help="KV cache dtype (int8 rides the MXU-native attend "
+                        "kernels and requires static scales)")
     g.add_argument("--lora-ckpt", action="append", default=None, metavar="NAME=DIR",
                    help="repeatable; PEFT adapter dirs for multi-LoRA serving")
     g.add_argument("--max-loras", type=int, default=1)
@@ -228,11 +231,15 @@ def create_tpu_config(args: argparse.Namespace) -> TpuConfig:
 
     quant = None
     if args.quantize_weights or args.kv_cache_dtype:
-        quant = QuantizationConfig(
-            quantize_weights=bool(args.quantize_weights),
-            weight_dtype=args.quantize_weights or "int8",
-            kv_cache_dtype=args.kv_cache_dtype,
-            kv_cache_scale_mode=args.kv_cache_scale_mode)
+        kw = dict(quantize_weights=bool(args.quantize_weights),
+                  weight_dtype=args.quantize_weights or "int8")
+        if args.kv_cache_scale_mode is None and args.kv_cache_dtype:
+            # the dtype -> scale-mode pairing lives in ONE place
+            quant = QuantizationConfig.for_kv_dtype(args.kv_cache_dtype, **kw)
+        else:
+            quant = QuantizationConfig(
+                kv_cache_dtype=args.kv_cache_dtype,
+                kv_cache_scale_mode=args.kv_cache_scale_mode or "direct", **kw)
     lora = None
     if args.lora_ckpt:
         for spec in args.lora_ckpt:
@@ -367,6 +374,7 @@ def run_inference(args: argparse.Namespace) -> int:
         app.save_config(args.compiled_path)
 
     tokenizer = _try_load_tokenizer(args.model_path)
+    _maybe_calibrate_kv(app, args, tokenizer)
 
     if args.dynamic_lora:
         if not args.lora_ckpt:
@@ -391,7 +399,7 @@ def run_inference(args: argparse.Namespace) -> int:
         raise SystemExit("--draft-golden-path requires a speculative run "
                          "(--speculation-length with --draft-model-path)")
     if args.speculation_length or args.speculation_type != "fused":
-        spec_model = _build_spec_engine(args, app)
+        spec_model = _build_spec_engine(args, app, tokenizer)
         input_ids, attention_mask = _encode_prompts(args, tokenizer,
                                                     app.arch_args.vocab_size)
         kwargs = {}
@@ -441,7 +449,7 @@ def run_inference(args: argparse.Namespace) -> int:
     return 0
 
 
-def _build_spec_engine(args, app):
+def _build_spec_engine(args, app, tokenizer=None):
     """Construct the requested speculative engine (≈ reference draft-model setup,
     `inference_demo.py`: fused/standard/Medusa/EAGLE routing)."""
     if args.speculation_type == "fused":
@@ -471,6 +479,8 @@ def _build_spec_engine(args, app):
                     f"draft world size {draft_world} must equal the target's "
                     f"{target_world} (both run inside one jitted step)")
         draft = draft_cls.from_pretrained(args.draft_model_path, draft_cfg)
+        _maybe_calibrate_kv(draft, args,
+                            tokenizer or _try_load_tokenizer(args.draft_model_path))
         return FusedSpeculativeModel(app, draft, args.speculation_length,
                                      greedy=not args.do_sample)
     if args.speculation_type == "medusa":
@@ -561,6 +571,20 @@ def _try_load_tokenizer(model_path: Optional[str]):
     except Exception:
         logger.info("no tokenizer found at %s; using raw token ids", model_path)
         return None
+
+
+def _maybe_calibrate_kv(app, args, tokenizer) -> None:
+    """Static KV scales (int8 KV's requirement) silently run at sigma=1
+    without calibration — sub-unit K/V round to zero and generation degrades
+    with NO error (found by review). The demo calibrates on its own prompts;
+    artifact warm starts carry their saved scales and skip this."""
+    if (not hasattr(app, "calibrate_kv_scales")
+            or not getattr(app, "_static_kv_scales_enabled", lambda: False)()
+            or getattr(app, "_kv_scales", None) is not None):
+        return
+    cal_ids, _ = _encode_prompts(args, tokenizer, app.arch_args.vocab_size)
+    logger.info("calibrating static KV scales on the CLI prompts")
+    app.calibrate_kv_scales(cal_ids)
 
 
 def _encode_prompts(args, tokenizer, vocab_size: int = 1000) -> tuple:

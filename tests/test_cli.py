@@ -176,3 +176,22 @@ def test_cli_artifact_warm_start(tmp_path):
     assert main(["--model-path", ckpt, "--save-artifacts", art] + base) == 0
     shutil.rmtree(ckpt)                      # warm start must not need it
     assert main(["--artifacts-path", art] + base) == 0
+
+
+def test_int8_kv_flag_auto_pairs_static_scales():
+    """--kv-cache-dtype int8 must default to static scale mode (int8 without
+    per-head scales destroys K/V; config validation would reject it)."""
+    args = build_parser().parse_args([
+        "--model-path", "/tmp/x", "--batch-size", "2", "--seq-len", "64",
+        "--kv-cache-dtype", "int8",
+    ])
+    cfg = create_tpu_config(args)
+    assert cfg.quantization_config.kv_cache_dtype == "int8"
+    assert cfg.quantization_config.kv_cache_scale_mode == "static"
+    # fp8 keeps the direct default
+    args2 = build_parser().parse_args([
+        "--model-path", "/tmp/x", "--batch-size", "2", "--seq-len", "64",
+        "--kv-cache-dtype", "float8_e4m3",
+    ])
+    assert (create_tpu_config(args2).quantization_config.kv_cache_scale_mode
+            == "direct")
