@@ -23,13 +23,16 @@ from neuronx_distributed_inference_tpu.runtime.continuous_batching import (
 pytestmark = pytest.mark.slow  # heavy e2e: excluded from the fast gate
 
 
-def _make_app(hf_cfg, seed=0, paged=False, slots=2, do_sample=False):
+def _make_app(hf_cfg, seed=0, paged=False, slots=2, do_sample=False,
+              **tpu_kw):
+    tpu_kw.setdefault("pa_block_size", 8)
     tpu_cfg = TpuConfig(
         batch_size=slots, seq_len=96, max_context_length=32, dtype="float32",
         context_encoding_buckets=[16, 32], token_generation_buckets=[48, 96],
         is_continuous_batching=True, paged_attention_enabled=paged,
-        pa_num_blocks=48, pa_block_size=8,
+        pa_num_blocks=48,
         on_device_sampling_config=OnDeviceSamplingConfig(do_sample=do_sample),
+        **tpu_kw,
     )
     config = LlamaInferenceConfig(tpu_cfg, load_config=load_pretrained_config(hf_cfg))
     app = LlamaForCausalLM(None, config)
@@ -267,3 +270,29 @@ def test_cb_spec_composes_with_chunked_prefill(tiny_llama_hf_config, prompts,
     results = runner.run_to_completion()
     assert results[r0] == reference_tokens[0]
     assert results[r_long] == want_long
+
+
+def test_cb_spec_with_int8_kv_target(tiny_llama_hf_config, prompts):
+    """Speculative serving over an int8-KV (static scales) target: greedy
+    CB+spec must match the plain int8-KV dedicated run token-for-token (the
+    int8 quantization changes logits identically on both paths)."""
+    from neuronx_distributed_inference_tpu.config import QuantizationConfig
+
+    qc = QuantizationConfig.for_kv_dtype("int8")
+    plain = _make_app(tiny_llama_hf_config, paged=False, pa_block_size=32,
+                      quantization_config=qc)
+    plain.calibrate_kv_scales(prompts[0][None, :])
+    want = plain.generate(prompts[0][None, :], max_new_tokens=8
+                          ).tokens[0].tolist()
+
+    target = _make_app(tiny_llama_hf_config, paged=True, pa_block_size=32,
+                       quantization_config=qc)
+    target._kv_scales = plain._kv_scales           # same calibration
+    draft = _make_app(_draft_cfg(tiny_llama_hf_config), seed=1, paged=True,
+                      pa_block_size=32)
+
+    runner = ContinuousBatchingRunner(target, draft=draft,
+                                      speculation_length=3)
+    rid = runner.submit(prompts[0], max_new_tokens=8)
+    results = runner.run_to_completion()
+    assert results[rid] == want, "int8-KV spec serving diverged from plain int8"
