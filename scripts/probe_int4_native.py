@@ -1,0 +1,89 @@
+"""Probe: does XLA's native s4 dtype stream at 4-bit bandwidth on TPU v5e?
+
+If `jnp.int4` arrays are stored packed and the s4->s8 convert fuses into the
+dot's operand read, weight-only int4 needs no Pallas kernel at all.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+B, IN, OUT = 64, 4096, 14336
+L = 8
+R = 40
+
+
+@jax.jit
+def _fetch(x):
+    return jax.lax.slice(x.ravel(), (0,), (1,))
+
+
+def timeit_chain(fn, state, iters=10):
+    state = fn(state)
+    np.asarray(_fetch(jax.tree.leaves(state)[0]))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state = fn(state)
+    np.asarray(_fetch(jax.tree.leaves(state)[0]))
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    rng = np.random.default_rng(0)
+    x8 = jnp.asarray(rng.integers(-127, 128, (B, IN), dtype=np.int8))
+    w4np = rng.integers(-8, 8, (L, IN, OUT), dtype=np.int8)
+    w8 = jnp.asarray(rng.integers(-127, 128, (L, IN, OUT), dtype=np.int8))
+    import ml_dtypes
+    w4 = jax.device_put(w4np.astype(ml_dtypes.int4))
+    print("int4 array OK:", w4.dtype, w4.shape,
+          "nbytes (API):", w4.nbytes if hasattr(w4, "nbytes") else "?")
+
+    def _requant(z):
+        z = z.astype(jnp.float32)
+        s = jnp.maximum(jnp.max(jnp.abs(z), axis=1, keepdims=True), 1e-6) / 127.0
+        return jnp.clip(jnp.round(z / s), -127, 127).astype(jnp.int8)
+
+    def make(dot):
+        @jax.jit
+        def f(x, w):
+            def step(c, wl):
+                return _requant(dot(c, wl)[:, :IN]), None
+            def rep(_, c):
+                return jax.lax.scan(step, c, w)[0]
+            return jax.lax.fori_loop(0, R, rep, x)
+        return f
+
+    dot8 = lambda c, wl: jax.lax.dot_general(
+        c, wl, (((1,), (0,)), ((), ())), preferred_element_type=jnp.int32)
+    dot4 = lambda c, wl: jax.lax.dot_general(
+        c, wl.astype(jnp.int8), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    # also try a direct mixed s8 x s4 dot
+    def dot4d(c, wl):
+        return jax.lax.dot_general(c, wl, (((1,), (0,)), ((), ())),
+                                   preferred_element_type=jnp.int32)
+
+    f8, f4, f4d = make(dot8), make(dot4), make(dot4d)
+    t8 = timeit_chain(lambda x: f8(x, w8), x8) / R
+    t4 = timeit_chain(lambda x: f4(x, w4), x8) / R
+    try:
+        t4d = timeit_chain(lambda x: f4d(x, w4), x8) / R
+    except Exception as e:
+        t4d = None
+        print("mixed s8xs4 dot unsupported:", type(e).__name__)
+
+    int8_bytes = L * IN * OUT
+    bw = 819e9
+    print(f"int8       : {t8*1e3:8.3f} ms ({int8_bytes/t8/1e9:6.1f} GB/s) "
+          f"floor {int8_bytes/bw*1e3:.3f}")
+    print(f"s4 convert : {t4*1e3:8.3f} ms ({int8_bytes/2/t4/1e9:6.1f} GB/s packed) "
+          f"floor {int8_bytes/2/bw*1e3:.3f}")
+    if t4d is not None:
+        print(f"s4 direct  : {t4d*1e3:8.3f} ms ({int8_bytes/2/t4d/1e9:6.1f} GB/s packed)")
+    print(f"ratio s4/int8: {t4/t8:.3f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,112 @@
+"""Isolate the 3x slowdown seen in probe_w4_kernel main_b's scan structure."""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+B, IN, OUT = 64, 4096, 14336
+L = 8
+R = 40
+
+
+@jax.jit
+def _fetch(x):
+    return jax.lax.slice(x.ravel(), (0,), (1,))
+
+
+def timeit_chain(fn, state, iters=10):
+    state = fn(state)
+    np.asarray(_fetch(jax.tree.leaves(state)[0]))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state = fn(state)
+    np.asarray(_fetch(jax.tree.leaves(state)[0]))
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    rng = np.random.default_rng(0)
+    x8 = jnp.asarray(rng.integers(-127, 128, (B, IN), dtype=np.int8))
+    w8 = jnp.asarray(rng.integers(-127, 128, (L, IN, OUT), dtype=np.int8))
+
+    # A: int8 carry (the fast structure from probe_w4_matmul)
+    @jax.jit
+    def scan_a(x, w):
+        def step(c, wl):
+            y = jax.lax.dot_general(c, wl, (((1,), (0,)), ((), ())),
+                                    preferred_element_type=jnp.int32)
+            z = y[:, :IN].astype(jnp.float32)
+            s = jnp.maximum(jnp.max(jnp.abs(z), axis=1, keepdims=True), 1e-6) / 127.0
+            return jnp.clip(jnp.round(z / s), -127, 127).astype(jnp.int8), None
+        def rep(_, c):
+            return jax.lax.scan(step, c, w)[0]
+        return jax.lax.fori_loop(0, R, rep, x)
+
+    # B: f32 carry, requant at step start (the slow structure from main_b)
+    @jax.jit
+    def scan_b(x, w):
+        def step(c, wl):
+            z = c
+            s = jnp.maximum(jnp.max(jnp.abs(z), axis=1, keepdims=True), 1e-6) / 127.0
+            xq = jnp.clip(jnp.round(z / s), -127, 127).astype(jnp.int8)
+            y = jax.lax.dot_general(xq, wl, (((1,), (0,)), ((), ())),
+                                    preferred_element_type=jnp.int32)
+            return y[:, :IN].astype(jnp.float32) * (s / 127.0), None
+        def rep(_, c):
+            return jax.lax.scan(step, c, w)[0]
+        return jax.lax.fori_loop(0, R, rep, x.astype(jnp.float32))
+
+    ta = timeit_chain(lambda x: scan_a(x, w8), x8) / R
+    tb = timeit_chain(lambda x: scan_b(x, w8), x8) / R
+    by = L * IN * OUT
+    print(f"A int8-carry: {ta*1e3:7.3f} ms ({by/ta/1e9:6.1f} GB/s)")
+    print(f"B f32-carry : {tb*1e3:7.3f} ms ({by/tb/1e9:6.1f} GB/s)")
+
+
+
+
+def main2():
+    rng = np.random.default_rng(0)
+    x8 = jnp.asarray(rng.integers(-127, 128, (B, IN), dtype=np.int8))
+    w8 = jnp.asarray(rng.integers(-127, 128, (L, IN, OUT), dtype=np.int8))
+
+    # C: int8 carry + carried scale; dot first, requant at end
+    @jax.jit
+    def scan_c(x, w):
+        def step(c, wl):
+            xq, sp = c
+            y = jax.lax.dot_general(xq, wl, (((1,), (0,)), ((), ())),
+                                    preferred_element_type=jnp.int32)
+            z = y[:, :IN].astype(jnp.float32) * sp
+            s = jnp.maximum(jnp.max(jnp.abs(z), axis=1, keepdims=True), 1e-6) / 127.0
+            xq2 = jnp.clip(jnp.round(z / s), -127, 127).astype(jnp.int8)
+            return (xq2, s / 127.0), None
+        def rep(_, c):
+            return jax.lax.scan(step, (c, jnp.ones((B, 1), jnp.float32)), w)[0][0]
+        return jax.lax.fori_loop(0, R, rep, x)
+
+    # D: same as B but bf16 carry
+    @jax.jit
+    def scan_d(x, w):
+        def step(c, wl):
+            z = c.astype(jnp.float32)
+            s = jnp.maximum(jnp.max(jnp.abs(z), axis=1, keepdims=True), 1e-6) / 127.0
+            xq = jnp.clip(jnp.round(z / s), -127, 127).astype(jnp.int8)
+            y = jax.lax.dot_general(xq, wl, (((1,), (0,)), ((), ())),
+                                    preferred_element_type=jnp.int32)
+            return (y[:, :IN].astype(jnp.float32) * (s / 127.0)).astype(jnp.bfloat16), None
+        def rep(_, c):
+            return jax.lax.scan(step, c, w)[0]
+        return jax.lax.fori_loop(0, R, rep, x.astype(jnp.bfloat16))
+
+    tc = timeit_chain(lambda x: scan_c(x, w8), x8) / R
+    td = timeit_chain(lambda x: scan_d(x, w8), x8) / R
+    by = L * IN * OUT
+    print(f"C int8+scale carry: {tc*1e3:7.3f} ms ({by/tc/1e9:6.1f} GB/s)")
+    print(f"D bf16 carry      : {td*1e3:7.3f} ms ({by/td/1e9:6.1f} GB/s)")
+
+
+if __name__ == "__main__":
+    main()
+    main2()

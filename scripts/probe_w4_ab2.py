@@ -1,0 +1,77 @@
+"""Corrected A/B probe: consume ALL dot output columns so XLA cannot narrow the
+dot through the chain slice (probe_w4_ab's `y[:, :IN]` silently dropped 71% of
+the weight reads — the HLO showed s32[64,4096] dots)."""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+B, IN, OUT = 64, 4096, 14336
+L = 8
+R = 40
+
+
+@jax.jit
+def _fetch(x):
+    return jax.lax.slice(x.ravel(), (0,), (1,))
+
+
+def timeit_chain(fn, state, iters=10):
+    state = fn(state)
+    np.asarray(_fetch(jax.tree.leaves(state)[0]))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state = fn(state)
+    np.asarray(_fetch(jax.tree.leaves(state)[0]))
+    return (time.perf_counter() - t0) / iters
+
+
+def _fold(y):
+    """(B, OUT) -> (B, IN) using every column (no narrowing possible)."""
+    z = (y[:, :IN] + y[:, IN:2 * IN] + y[:, 2 * IN:3 * IN]
+         + y[:, OUT - IN:])
+    return z
+
+
+def main():
+    rng = np.random.default_rng(0)
+    x8 = jnp.asarray(rng.integers(-127, 128, (B, IN), dtype=np.int8))
+    w8 = jnp.asarray(rng.integers(-127, 128, (L, IN, OUT), dtype=np.int8))
+
+    # A: int8 carry, requant at step end
+    @jax.jit
+    def scan_a(x, w):
+        def step(c, wl):
+            y = jax.lax.dot_general(c, wl, (((1,), (0,)), ((), ())),
+                                    preferred_element_type=jnp.int32)
+            z = _fold(y).astype(jnp.float32)
+            s = jnp.maximum(jnp.max(jnp.abs(z), axis=1, keepdims=True), 1e-6) / 127.0
+            return jnp.clip(jnp.round(z / s), -127, 127).astype(jnp.int8), None
+        def rep(_, c):
+            return jax.lax.scan(step, c, w)[0]
+        return jax.lax.fori_loop(0, R, rep, x)
+
+    # B: f32 carry, quantize at step start (the model's structure)
+    @jax.jit
+    def scan_b(x, w):
+        def step(c, wl):
+            s = jnp.maximum(jnp.max(jnp.abs(c), axis=1, keepdims=True), 1e-6) / 127.0
+            xq = jnp.clip(jnp.round(c / s), -127, 127).astype(jnp.int8)
+            y = jax.lax.dot_general(xq, wl, (((1,), (0,)), ((), ())),
+                                    preferred_element_type=jnp.int32)
+            return _fold(y).astype(jnp.float32) * (s / 127.0), None
+        def rep(_, c):
+            return jax.lax.scan(step, c, w)[0]
+        return jax.lax.fori_loop(0, R, rep, x.astype(jnp.float32))
+
+    ta = timeit_chain(lambda x: scan_a(x, w8), x8) / R
+    tb = timeit_chain(lambda x: scan_b(x, w8), x8) / R
+    by = L * IN * OUT
+    print(f"A int8-carry : {ta*1e3:7.3f} ms ({by/ta/1e9:6.1f} GB/s) "
+          f"floor {by/819e9*1e3:.3f} ms")
+    print(f"B f32-carry  : {tb*1e3:7.3f} ms ({by/tb/1e9:6.1f} GB/s)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,98 @@
+"""Probe the REAL baseline and w4 candidates for the weight-only decode path:
+
+  E: bf16 x int8 (convert fused into dot) — what the headline runs today
+  F: bf16 x native-s4 (convert fused?) — dream path, no kernel needed
+  G: bf16 x XLA nibble-unpack — does XLA fuse int ops into the dot read?
+
+All chains consume every output column (see probe_w4_ab2 narrowing bug).
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+B, IN, OUT = 64, 4096, 14336
+L = 8
+R = 40
+
+
+@jax.jit
+def _fetch(x):
+    return jax.lax.slice(x.ravel(), (0,), (1,))
+
+
+def timeit_chain(fn, state, iters=10):
+    state = fn(state)
+    np.asarray(_fetch(jax.tree.leaves(state)[0]))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state = fn(state)
+    np.asarray(_fetch(jax.tree.leaves(state)[0]))
+    return (time.perf_counter() - t0) / iters
+
+
+def _fold(y):
+    return (y[:, :IN] + y[:, IN:2 * IN] + y[:, 2 * IN:3 * IN] + y[:, OUT - IN:])
+
+
+def _norm(z):
+    # keep the carry bounded like a norm would
+    return (z / jnp.maximum(jnp.max(jnp.abs(z), axis=1, keepdims=True), 1e-6)
+            ).astype(jnp.bfloat16)
+
+
+def make_scan(dot):
+    @jax.jit
+    def f(x, w):
+        def step(c, wl):
+            y = dot(c, wl)
+            return _norm(_fold(y).astype(jnp.float32)), None
+        def rep(_, c):
+            return jax.lax.scan(step, c, w)[0]
+        return jax.lax.fori_loop(0, R, rep, x)
+    return f
+
+
+def main():
+    rng = np.random.default_rng(0)
+    xb = jnp.asarray(rng.standard_normal((B, IN)).astype(np.float32)).astype(jnp.bfloat16)
+    w8 = jnp.asarray(rng.integers(-127, 128, (L, IN, OUT), dtype=np.int8))
+    w4np = rng.integers(-8, 8, (L, IN, OUT), dtype=np.int8)
+    packed = jnp.asarray(((w4np[:, 1::2] << 4) | (w4np[:, 0::2] & 0xF)).astype(np.int8))
+
+    dot_e = lambda c, wl: jax.lax.dot_general(
+        c, wl.astype(jnp.bfloat16), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    def dot_g(c, wl):
+        p = wl.astype(jnp.int32)
+        lo = ((((p & 15) ^ 8) - 8)).astype(jnp.bfloat16)
+        hi = jax.lax.shift_right_arithmetic(p, 4).astype(jnp.bfloat16)
+        return (jax.lax.dot_general(c[:, 0::2], lo, (((1,), (0,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+                + jax.lax.dot_general(c[:, 1::2], hi, (((1,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32))
+
+    by = L * IN * OUT
+    fe = make_scan(dot_e)
+    te = timeit_chain(lambda x: fe(x, w8), xb) / R
+    print(f"E bf16 x int8 : {te*1e3:7.3f} ms ({by/te/1e9:6.1f} GB/s) "
+          f"floor {by/819e9*1e3:.3f} ms")
+    try:
+        import ml_dtypes
+        w4n = jax.device_put(w4np.astype(ml_dtypes.int4))
+        np.asarray(_fetch(w4n))  # surface transfer errors here, not later
+        ff = make_scan(dot_e)  # same convert-into-dot form, s4 operand
+        tf = timeit_chain(lambda x: ff(x, w4n), xb) / R
+        print(f"F bf16 x s4   : {tf*1e3:7.3f} ms ({by/2/tf/1e9:6.1f} GB/s packed) "
+              f"floor {by/2/819e9*1e3:.3f} ms")
+    except Exception as e:
+        print("F bf16 x s4   : FAILED", type(e).__name__, str(e)[:120])
+    fg = make_scan(dot_g)
+    tg = timeit_chain(lambda x: fg(x, packed), xb) / R
+    print(f"G bf16 x nibble: {tg*1e3:7.3f} ms ({by/2/tg/1e9:6.1f} GB/s packed)")
+
+
+if __name__ == "__main__":
+    main()

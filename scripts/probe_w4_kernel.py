@@ -1,0 +1,236 @@
+"""Probe: Pallas w4 (int4-packed) streaming matmul vs int8 XLA baseline.
+
+probe_w4_matmul.py showed XLA cannot fuse the nibble unpack (w4 ratio 0.95 vs
+int8 — the whole bandwidth win burned on VPU materialization). This kernel
+streams the packed (IN/2, OUT) int8 plane through BlockSpec tiles, unpacks in
+VMEM (3 int8 shifts per 2 weights), and runs two int8 MXU dots per tile:
+
+    y = xe @ lo(P) + xo @ hi(P),   lo = (P << 4) >> 4,  hi = P >> 4
+
+Packing puts W[2i] in the low nibble and W[2i+1] in the high nibble of byte i,
+so both dots keep the natural (IN/2, OUT) layout — no interleave relayout.
+Grid (L, OUT/bo): layer-major so each layer's tiles stream contiguously.
+"""
+
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+B, IN, OUT = 64, 4096, 14336
+L = 8
+BO = 512  # out-tile width
+
+
+@jax.jit
+def _fetch(x):
+    return jax.lax.slice(x.ravel(), (0,), (1,))
+
+
+def timeit_chain(fn, state, iters=10):
+    state = fn(state)
+    np.asarray(_fetch(jax.tree.leaves(state)[0]))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state = fn(state)
+    np.asarray(_fetch(jax.tree.leaves(state)[0]))
+    return (time.perf_counter() - t0) / iters
+
+
+def _w4_kernel(xe_ref, xo_ref, p_ref, o_ref):
+    # int8 vector shifts don't legalize in Mosaic — widen to i32 for the nibble
+    # arithmetic (same trick as paged_decode._vmem_cast), narrow to int8 for MXU
+    p = p_ref[0].astype(jnp.int32)                 # (IN/2, BO)
+    lo = (((p & 15) ^ 8) - 8).astype(jnp.int8)
+    hi = jax.lax.shift_right_arithmetic(p, 4).astype(jnp.int8)
+    acc = jax.lax.dot_general(xe_ref[...], lo, (((1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.int32)
+    acc = acc + jax.lax.dot_general(xo_ref[...], hi, (((1,), (0,)), ((), ())),
+                                    preferred_element_type=jnp.int32)
+    o_ref[0] = acc
+
+
+@functools.partial(jax.jit, static_argnames=())
+def w4_matmul_stacked(xe, xo, packed):
+    """(B, IN/2) int8 x 2, packed (L, IN/2, OUT) int8 -> (L, B, OUT) int32."""
+    l, hin, out = packed.shape
+    nt = out // BO
+    return pl.pallas_call(
+        _w4_kernel,
+        grid=(l, nt),
+        in_specs=[
+            pl.BlockSpec((B, hin), lambda li, ti: (0, 0)),
+            pl.BlockSpec((B, hin), lambda li, ti: (0, 0)),
+            pl.BlockSpec((1, hin, BO), lambda li, ti: (li, 0, ti)),
+        ],
+        out_specs=pl.BlockSpec((1, B, BO), lambda li, ti: (li, 0, ti)),
+        out_shape=jax.ShapeDtypeStruct((l, B, out), jnp.int32),
+    )(xe, xo, packed)
+
+
+def main():
+    rng = np.random.default_rng(0)
+    x8 = jnp.asarray(rng.integers(-127, 128, (B, IN), dtype=np.int8))
+    w8 = jnp.asarray(rng.integers(-127, 128, (L, IN, OUT), dtype=np.int8))
+    w4 = rng.integers(-8, 8, (L, IN, OUT), dtype=np.int8)
+    packed = ((w4[:, 1::2] << 4) | (w4[:, 0::2] & 0xF)).astype(np.int8)
+    p4 = jnp.asarray(packed)
+    xe, xo = x8[:, 0::2], x8[:, 1::2]
+
+    # correctness vs jnp dequant
+    got = np.asarray(w4_matmul_stacked(xe, xo, p4)[0])
+    want = np.asarray(xe, np.int32) @ w4[0, 0::2] + np.asarray(xo, np.int32) @ w4[0, 1::2]
+    assert np.array_equal(got, want), np.abs(got - want).max()
+    print("kernel exact vs int reference: OK")
+
+    R = 40  # in-jit repetitions so device work dominates tunnel dispatch
+
+    def _requant(z):
+        z = z.astype(jnp.float32)
+        s = jnp.maximum(jnp.max(jnp.abs(z), axis=1, keepdims=True), 1e-6) / 127.0
+        return jnp.clip(jnp.round(z / s), -127, 127).astype(jnp.int8)
+
+    @jax.jit
+    def int8_mm(x, w):
+        def step(c, wl):
+            y = jax.lax.dot_general(c, wl, (((1,), (0,)), ((), ())),
+                                    preferred_element_type=jnp.int32)
+            return _requant(y[:, :IN]), None
+        def rep(_, c):
+            return jax.lax.scan(step, c, w)[0]
+        return jax.lax.fori_loop(0, R, rep, x)
+
+    @jax.jit
+    def chain_w4(x, p):
+        def rep(_, c):
+            y = w4_matmul_stacked(c[:, 0::2], c[:, 1::2], p)
+            return _requant(y[-1, :, :IN])
+        return jax.lax.fori_loop(0, R, rep, x)
+
+    t8 = timeit_chain(lambda x: int8_mm(x, w8), x8, iters=10) / R
+    t4 = timeit_chain(lambda x: chain_w4(x, p4), x8, iters=10) / R
+    int8_bytes = L * IN * OUT
+    bw = 819e9
+    print(f"int8 scan (w/ requant chain): {t8*1e3:8.3f} ms  "
+          f"({int8_bytes/t8/1e9:6.1f} GB/s)  floor {int8_bytes/bw*1e3:.3f} ms")
+    print(f"pallas w4 (one call, {L} layers): {t4*1e3:8.3f} ms  "
+          f"({int8_bytes/2/t4/1e9:6.1f} GB/s of packed)  floor {int8_bytes/2/bw*1e3:.3f} ms")
+    print(f"w4/int8 ratio : {t4/t8:.3f}")
+
+
+
+
+# --- variant B: the real call shape — bf16 out, fused scales, per-layer calls ---------
+
+BO_B = 512
+
+
+def _w4b_kernel(lidx_ref, xe_ref, xo_ref, sx_ref, p_ref, s_ref, o_ref):
+    p = p_ref[0].astype(jnp.int32)
+    lo = (((p & 15) ^ 8) - 8).astype(jnp.int8)
+    hi = jax.lax.shift_right_arithmetic(p, 4).astype(jnp.int8)
+    acc = jax.lax.dot_general(xe_ref[...], lo, (((1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.int32)
+    acc = acc + jax.lax.dot_general(xo_ref[...], hi, (((1,), (0,)), ((), ())),
+                                    preferred_element_type=jnp.int32)
+    o_ref[...] = (acc.astype(jnp.float32) * sx_ref[:, 0:1] * s_ref[0, 0]
+                  ).astype(o_ref.dtype)
+
+
+def w4_layer_matmul(xe, xo, sx, packed, scales, lidx):
+    """One layer's matmul from the FULL stacked packed array (scalar-prefetch
+    layer index — no XLA slice materialization)."""
+    l, hin, out = packed.shape
+    b = xe.shape[0]
+    nt = out // BO_B
+    from jax.experimental.pallas import tpu as pltpu2
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nt,),
+        in_specs=[
+            pl.BlockSpec((b, hin), lambda ti, lidx: (0, 0)),
+            pl.BlockSpec((b, hin), lambda ti, lidx: (0, 0)),
+            pl.BlockSpec((b, 128), lambda ti, lidx: (0, 0)),
+            pl.BlockSpec((1, hin, BO_B), lambda ti, lidx: (lidx[0], 0, ti)),
+            pl.BlockSpec((1, 1, BO_B), lambda ti, lidx: (lidx[0], 0, ti)),
+        ],
+        out_specs=pl.BlockSpec((b, BO_B), lambda ti, lidx: (0, ti)),
+    )
+    return pl.pallas_call(
+        _w4b_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, out), jnp.bfloat16),
+    )(lidx.reshape(1).astype(jnp.int32), xe, xo, sx, packed,
+      scales.reshape(l, 1, out))
+
+
+def main_b():
+    rng = np.random.default_rng(0)
+    w4 = rng.integers(-8, 8, (L, IN, OUT), dtype=np.int8)
+    packed = jnp.asarray(((w4[:, 1::2] << 4) | (w4[:, 0::2] & 0xF)).astype(np.int8))
+    scales = jnp.asarray(rng.uniform(0.5, 2.0, (L, OUT)).astype(np.float32)) * 1e-2
+    x8 = jnp.asarray(rng.integers(-127, 128, (B, IN), dtype=np.int8))
+    w8 = jnp.asarray(rng.integers(-127, 128, (L, IN, OUT), dtype=np.int8))
+
+    # correctness
+    sx0 = jnp.ones((B, 128), jnp.float32) * 1e-3
+    got = np.asarray(w4_layer_matmul(x8[:, 0::2], x8[:, 1::2], sx0, packed,
+                                     scales, jnp.int32(3)))
+    x_np = np.asarray(x8, np.int32)
+    want = (x_np[:, 0::2] @ w4[3, 0::2] + x_np[:, 1::2] @ w4[3, 1::2]
+            ).astype(np.float32) * 1e-3 * np.asarray(scales)[3]
+    rel = np.abs(got.astype(np.float32) - want) / np.maximum(np.abs(want), 1e-3)
+    assert rel.max() < 0.02, rel.max()
+    print("variant B exact-within-bf16: OK")
+
+    R2 = 40
+
+    def _requant8(z):
+        z = z.astype(jnp.float32)
+        s = jnp.maximum(jnp.max(jnp.abs(z), axis=1, keepdims=True), 1e-6) / 127.0
+        return (jnp.clip(jnp.round(z / s), -127, 127).astype(jnp.int8),
+                (s / 127.0).astype(jnp.float32))
+
+    @jax.jit
+    def w4_scan(x, p, s):
+        # the REAL call pattern: per-layer pallas_call inside lax.scan over the
+        # layer index, full stacked arrays captured by closure
+        def step(c, li):
+            xq, sxr = _requant8(c)
+            sx = jnp.broadcast_to(sxr, (B, 128))
+            y = w4_layer_matmul(xq[:, 0::2], xq[:, 1::2], sx, p, s, li)
+            return y[:, :IN].astype(jnp.float32), None
+
+        def rep(_, c):
+            return jax.lax.scan(step, c, jnp.arange(L, dtype=jnp.int32))[0]
+        return jax.lax.fori_loop(0, R2, rep, x.astype(jnp.float32))
+
+    @jax.jit
+    def int8_scan(x, w):
+        def step(c, wl):
+            xq, sxr = _requant8(c)
+            y = jax.lax.dot_general(xq, wl, (((1,), (0,)), ((), ())),
+                                    preferred_element_type=jnp.int32)
+            return (y[:, :IN].astype(jnp.float32) * sxr), None
+
+        def rep(_, c):
+            return jax.lax.scan(step, c, w)[0]
+        return jax.lax.fori_loop(0, R2, rep, x.astype(jnp.float32))
+
+    tb = timeit_chain(lambda x: w4_scan(x, packed, scales), x8, iters=10) / R2
+    t8 = timeit_chain(lambda x: int8_scan(x, w8), x8, iters=10) / R2
+    int8_bytes = L * IN * OUT
+    print(f"int8 scan        : {t8*1e3:8.3f} ms ({int8_bytes/t8/1e9:6.1f} GB/s)")
+    print(f"w4 scan (real)   : {tb*1e3:8.3f} ms ({int8_bytes/2/tb/1e9:6.1f} GB/s packed)")
+    print(f"per-layer: int8 {t8/L*1e6:.1f} us  w4 {tb/L*1e6:.1f} us  "
+          f"(floors {IN*OUT/819e9*1e6:.1f} / {IN*OUT/2/819e9*1e6:.1f})")
+    print(f"ratio w4/int8    : {tb/t8:.3f}")
+
+
+if __name__ == "__main__":
+    main()
+    main_b()

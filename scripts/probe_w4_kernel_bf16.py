@@ -1,0 +1,344 @@
+"""Pallas w4 kernel, bf16-activation variant, vs the real weight-only int8
+baseline (bf16 x int8-convert dot, ~113 us/layer at these shapes).
+
+Chain consumes all output columns (see probe_w4_ab2 narrowing bug).
+"""
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+B, IN, OUT = 64, 4096, 14336
+L = 8
+R = 40
+BO = 512
+
+
+@jax.jit
+def _fetch(x):
+    return jax.lax.slice(x.ravel(), (0,), (1,))
+
+
+def timeit_chain(fn, state, iters=10):
+    state = fn(state)
+    np.asarray(_fetch(jax.tree.leaves(state)[0]))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state = fn(state)
+    np.asarray(_fetch(jax.tree.leaves(state)[0]))
+    return (time.perf_counter() - t0) / iters
+
+
+def _w4_kernel(lidx_ref, xe_ref, xo_ref, p_ref, s_ref, o_ref):
+    p = p_ref[0].astype(jnp.int32)
+    lo = (((p & 15) ^ 8) - 8).astype(jnp.bfloat16)
+    hi = jax.lax.shift_right_arithmetic(p, 4).astype(jnp.bfloat16)
+    acc = jax.lax.dot_general(xe_ref[...], lo, (((1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.float32)
+    acc = acc + jax.lax.dot_general(xo_ref[...], hi, (((1,), (0,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+    o_ref[...] = (acc * s_ref[0, 0]).astype(o_ref.dtype)
+
+
+def w4_layer_matmul(xe, xo, packed, scales, lidx):
+    l, hin, out = packed.shape
+    b = xe.shape[0]
+    nt = out // BO
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nt,),
+        in_specs=[
+            pl.BlockSpec((b, hin), lambda ti, lidx: (0, 0)),
+            pl.BlockSpec((b, hin), lambda ti, lidx: (0, 0)),
+            pl.BlockSpec((1, hin, BO), lambda ti, lidx: (lidx[0], 0, ti)),
+            pl.BlockSpec((1, 1, BO), lambda ti, lidx: (lidx[0], 0, ti)),
+        ],
+        out_specs=pl.BlockSpec((b, BO), lambda ti, lidx: (0, ti)),
+    )
+    return pl.pallas_call(
+        _w4_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, out), jnp.bfloat16),
+    )(lidx.reshape(1).astype(jnp.int32), xe, xo, packed,
+      scales.reshape(l, 1, out))
+
+
+def _fold(y):
+    return (y[:, :IN] + y[:, IN:2 * IN] + y[:, 2 * IN:3 * IN] + y[:, OUT - IN:])
+
+
+def _norm(z):
+    return (z / jnp.maximum(jnp.max(jnp.abs(z), axis=1, keepdims=True), 1e-6)
+            ).astype(jnp.bfloat16)
+
+
+def main():
+    rng = np.random.default_rng(0)
+    xb = jnp.asarray(rng.standard_normal((B, IN)).astype(np.float32)).astype(jnp.bfloat16)
+    w8 = jnp.asarray(rng.integers(-127, 128, (L, IN, OUT), dtype=np.int8))
+    w4np = rng.integers(-8, 8, (L, IN, OUT), dtype=np.int8)
+    packed = jnp.asarray(((w4np[:, 1::2] << 4) | (w4np[:, 0::2] & 0xF)).astype(np.int8))
+    scales = jnp.asarray(rng.uniform(0.5, 2.0, (L, OUT)).astype(np.float32))
+
+    # correctness
+    got = np.asarray(w4_layer_matmul(xb[:, 0::2], xb[:, 1::2], packed, scales,
+                                     jnp.int32(3))).astype(np.float32)
+    xf = np.asarray(xb).astype(np.float32)
+    want = (xf[:, 0::2] @ w4np[3, 0::2] + xf[:, 1::2] @ w4np[3, 1::2]) * np.asarray(scales)[3]
+    rel = np.abs(got - want) / np.maximum(np.abs(want), 1e-2)
+    assert rel.max() < 0.05, rel.max()
+    print("w4 bf16 kernel correct: OK")
+
+    @jax.jit
+    def scan_e(x, w):
+        def step(c, wl):
+            y = jax.lax.dot_general(c, wl.astype(jnp.bfloat16),
+                                    (((1,), (0,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+            return _norm(_fold(y)), None
+        def rep(_, c):
+            return jax.lax.scan(step, c, w)[0]
+        return jax.lax.fori_loop(0, R, rep, x)
+
+    @jax.jit
+    def scan_w4(x, p, s):
+        def step(c, li):
+            y = w4_layer_matmul(c[:, 0::2], c[:, 1::2], p, s, li)
+            return _norm(_fold(y.astype(jnp.float32))), None
+        def rep(_, c):
+            return jax.lax.scan(step, c, jnp.arange(L, dtype=jnp.int32))[0]
+        return jax.lax.fori_loop(0, R, rep, x)
+
+    by = L * IN * OUT
+    te = timeit_chain(lambda x: scan_e(x, w8), xb) / R
+    t4 = timeit_chain(lambda x: scan_w4(x, packed, scales), xb) / R
+    print(f"E bf16 x int8 : {te*1e3:7.3f} ms ({by/te/1e9:6.1f} GB/s) "
+          f"per-layer {te/L*1e6:5.1f} us (floor {IN*OUT/819e9*1e6:.1f})")
+    print(f"W4 pallas     : {t4*1e3:7.3f} ms ({by/2/t4/1e9:6.1f} GB/s packed) "
+          f"per-layer {t4/L*1e6:5.1f} us (floor {IN*OUT/2/819e9*1e6:.1f})")
+    print(f"ratio w4/int8 : {t4/te:.3f}")
+
+
+
+
+# --- W4A8: int8 activations (quantized outside), int8 MXU dots, bf16 out -------------
+
+
+def _w4a8_kernel(lidx_ref, xe_ref, xo_ref, sx_ref, p_ref, s_ref, o_ref):
+    p = p_ref[0].astype(jnp.int32)
+    lo = (((p & 15) ^ 8) - 8).astype(jnp.int8)
+    hi = jax.lax.shift_right_arithmetic(p, 4).astype(jnp.int8)
+    acc = jax.lax.dot_general(xe_ref[...], lo, (((1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.int32)
+    acc = acc + jax.lax.dot_general(xo_ref[...], hi, (((1,), (0,)), ((), ())),
+                                    preferred_element_type=jnp.int32)
+    o_ref[...] = (acc.astype(jnp.float32) * sx_ref[:, 0:1] * s_ref[0, 0]
+                  ).astype(o_ref.dtype)
+
+
+def w4a8_layer_matmul(xq, sx, packed, scales, lidx):
+    l, hin, out = packed.shape
+    b = xq.shape[0]
+    nt = out // BO
+    xe, xo = xq[:, 0::2], xq[:, 1::2]
+    sxp = jnp.broadcast_to(sx, (b, 128))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nt,),
+        in_specs=[
+            pl.BlockSpec((b, hin), lambda ti, lidx: (0, 0)),
+            pl.BlockSpec((b, hin), lambda ti, lidx: (0, 0)),
+            pl.BlockSpec((b, 128), lambda ti, lidx: (0, 0)),
+            pl.BlockSpec((1, hin, BO), lambda ti, lidx: (lidx[0], 0, ti)),
+            pl.BlockSpec((1, 1, BO), lambda ti, lidx: (lidx[0], 0, ti)),
+        ],
+        out_specs=pl.BlockSpec((b, BO), lambda ti, lidx: (0, ti)),
+    )
+    return pl.pallas_call(
+        _w4a8_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, out), jnp.bfloat16),
+    )(lidx.reshape(1).astype(jnp.int32), xe, xo, sxp, packed,
+      scales.reshape(l, 1, out))
+
+
+def main_a8():
+    rng = np.random.default_rng(0)
+    xb = jnp.asarray(rng.standard_normal((B, IN)).astype(np.float32)).astype(jnp.bfloat16)
+    w4np = rng.integers(-8, 8, (L, IN, OUT), dtype=np.int8)
+    packed = jnp.asarray(((w4np[:, 1::2] << 4) | (w4np[:, 0::2] & 0xF)).astype(np.int8))
+    scales = jnp.asarray(rng.uniform(0.5, 2.0, (L, OUT)).astype(np.float32))
+
+    def quant(x):
+        xf = x.astype(jnp.float32)
+        s = jnp.maximum(jnp.max(jnp.abs(xf), axis=1, keepdims=True), 1e-6) / 127.0
+        return (jnp.clip(jnp.round(xf / s), -127, 127).astype(jnp.int8),
+                s)
+
+    # correctness
+    xq0, sx0 = quant(xb)
+    got = np.asarray(w4a8_layer_matmul(xq0, sx0, packed, scales, jnp.int32(5))
+                     ).astype(np.float32)
+    xf = np.asarray(xq0, np.int32)
+    want = ((xf[:, 0::2] @ w4np[5, 0::2] + xf[:, 1::2] @ w4np[5, 1::2])
+            * np.asarray(sx0) * np.asarray(scales)[5])
+    rel = np.abs(got - want) / np.maximum(np.abs(want), 1e-2)
+    assert rel.max() < 0.05, rel.max()
+    print("w4a8 kernel correct: OK")
+
+    @jax.jit
+    def scan_w4a8(x, p, s):
+        def step(c, li):
+            xq, sx = quant(c)
+            y = w4a8_layer_matmul(xq, sx, p, s, li)
+            return _norm(_fold(y.astype(jnp.float32))), None
+        def rep(_, c):
+            return jax.lax.scan(step, c, jnp.arange(L, dtype=jnp.int32))[0]
+        return jax.lax.fori_loop(0, R, rep, x)
+
+    by = L * IN * OUT
+    t = timeit_chain(lambda x: scan_w4a8(x, packed, scales), xb) / R
+    print(f"W4A8 pallas   : {t*1e3:7.3f} ms ({by/2/t/1e9:6.1f} GB/s packed) "
+          f"per-layer {t/L*1e6:5.1f} us (floor {IN*OUT/2/819e9*1e6:.1f})")
+
+
+def main_a8_half():
+    """Half-split packing: byte[i] = (W[i+hin] << 4) | (W[i] & 0xF) — xe/xo are
+    contiguous halves of x (no strided lane relayout per step)."""
+    rng = np.random.default_rng(0)
+    xb = jnp.asarray(rng.standard_normal((B, IN)).astype(np.float32)).astype(jnp.bfloat16)
+    w4np = rng.integers(-8, 8, (L, IN, OUT), dtype=np.int8)
+    hin = IN // 2
+    packed = jnp.asarray(((w4np[:, hin:] << 4) | (w4np[:, :hin] & 0xF)).astype(np.int8))
+    scales = jnp.asarray(rng.uniform(0.5, 2.0, (L, OUT)).astype(np.float32))
+
+    def quant(x):
+        xf = x.astype(jnp.float32)
+        s = jnp.maximum(jnp.max(jnp.abs(xf), axis=1, keepdims=True), 1e-6) / 127.0
+        return (jnp.clip(jnp.round(xf / s), -127, 127).astype(jnp.int8), s)
+
+    def w4a8_half(xq, sx, p, s, lidx):
+        l, hn, out = p.shape
+        b = xq.shape[0]
+        nt = out // BO
+        sxp = jnp.broadcast_to(sx, (b, 128))
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(nt,),
+            in_specs=[
+                pl.BlockSpec((b, hn), lambda ti, lidx: (0, 0)),
+                pl.BlockSpec((b, hn), lambda ti, lidx: (0, 1)),
+                pl.BlockSpec((b, 128), lambda ti, lidx: (0, 0)),
+                pl.BlockSpec((1, hn, BO), lambda ti, lidx: (lidx[0], 0, ti)),
+                pl.BlockSpec((1, 1, BO), lambda ti, lidx: (lidx[0], 0, ti)),
+            ],
+            out_specs=pl.BlockSpec((b, BO), lambda ti, lidx: (0, ti)),
+        )
+        return pl.pallas_call(
+            _w4a8_kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((b, out), jnp.bfloat16),
+        )(lidx.reshape(1).astype(jnp.int32), xq, xq, sxp, p,
+          s.reshape(l, 1, out))
+
+    xq0, sx0 = quant(xb)
+    got = np.asarray(w4a8_half(xq0, sx0, packed, scales, jnp.int32(5))
+                     ).astype(np.float32)
+    xf = np.asarray(xq0, np.int32)
+    want = ((xf[:, :hin] @ w4np[5, :hin] + xf[:, hin:] @ w4np[5, hin:])
+            * np.asarray(sx0) * np.asarray(scales)[5])
+    rel = np.abs(got - want) / np.maximum(np.abs(want), 1e-2)
+    assert rel.max() < 0.05, rel.max()
+    print("w4a8-half kernel correct: OK")
+
+    @jax.jit
+    def scan_h(x, p, s):
+        def step(c, li):
+            xq, sx = quant(c)
+            y = w4a8_half(xq, sx, p, s, li)
+            return _norm(_fold(y.astype(jnp.float32))), None
+        def rep(_, c):
+            return jax.lax.scan(step, c, jnp.arange(L, dtype=jnp.int32))[0]
+        return jax.lax.fori_loop(0, R, rep, x)
+
+    by = L * IN * OUT
+    t = timeit_chain(lambda x: scan_h(x, packed, scales), xb) / R
+    print(f"W4A8 half-split: {t*1e3:7.3f} ms ({by/2/t/1e9:6.1f} GB/s packed) "
+          f"per-layer {t/L*1e6:5.1f} us (floor {IN*OUT/2/819e9*1e6:.1f})")
+
+
+
+
+def main_iso():
+    """Isolate the ~45us/call gap: epilogue cost (int32-out variant) and tile
+    count (BO=1024)."""
+    rng = np.random.default_rng(0)
+    xb = jnp.asarray(rng.standard_normal((B, IN)).astype(np.float32)).astype(jnp.bfloat16)
+    w4np = rng.integers(-8, 8, (L, IN, OUT), dtype=np.int8)
+    hin = IN // 2
+    packed = jnp.asarray(((w4np[:, hin:] << 4) | (w4np[:, :hin] & 0xF)).astype(np.int8))
+    scales = jnp.asarray(rng.uniform(0.5, 2.0, (L, OUT)).astype(np.float32))
+
+    def quant(x):
+        xf = x.astype(jnp.float32)
+        s = jnp.maximum(jnp.max(jnp.abs(xf), axis=1, keepdims=True), 1e-6) / 127.0
+        return (jnp.clip(jnp.round(xf / s), -127, 127).astype(jnp.int8), s)
+
+    def _kern_raw(lidx_ref, xe_ref, xo_ref, p_ref, o_ref):
+        p = p_ref[0].astype(jnp.int32)
+        lo = (((p & 15) ^ 8) - 8).astype(jnp.int8)
+        hi = jax.lax.shift_right_arithmetic(p, 4).astype(jnp.int8)
+        acc = jax.lax.dot_general(xe_ref[...], lo, (((1,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.int32)
+        acc = acc + jax.lax.dot_general(xo_ref[...], hi, (((1,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.int32)
+        o_ref[...] = acc
+
+    def call_raw(xq, p, lidx, bo):
+        l, hn, out = p.shape
+        b = xq.shape[0]
+        nt = out // bo
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(nt,),
+            in_specs=[
+                pl.BlockSpec((b, hn), lambda ti, lidx: (0, 0)),
+                pl.BlockSpec((b, hn), lambda ti, lidx: (0, 1)),
+                pl.BlockSpec((1, hn, bo), lambda ti, lidx: (lidx[0], 0, ti)),
+            ],
+            out_specs=pl.BlockSpec((b, bo), lambda ti, lidx: (0, ti)),
+        )
+        return pl.pallas_call(
+            _kern_raw, grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((b, out), jnp.int32),
+        )(lidx.reshape(1).astype(jnp.int32), xq, xq, p)
+
+    def make(bo):
+        @jax.jit
+        def scan_f(x, p):
+            def step(c, li):
+                xq, sx = quant(c)
+                y = call_raw(xq, p, li, bo)
+                return _norm(_fold(y.astype(jnp.float32)) * sx), None
+            def rep(_, c):
+                return jax.lax.scan(step, c, jnp.arange(L, dtype=jnp.int32))[0]
+            return jax.lax.fori_loop(0, R, rep, x)
+        return scan_f
+
+    by = L * IN * OUT
+    for bo in (512, 1024, 2048):
+        f = make(bo)
+        t = timeit_chain(lambda x: f(x, packed), xb) / R
+        print(f"W4A8 raw BO={bo:4d}: {t*1e3:7.3f} ms per-layer {t/L*1e6:5.1f} us "
+              f"({by/2/t/1e9:6.1f} GB/s packed)")
+
+
+if __name__ == "__main__":
+    main()
+    main_a8()
+    main_a8_half()
+    main_iso()

@@ -1,0 +1,101 @@
+"""Probe: is an int4-packed weight matmul viable at XLA level, or does it need Pallas?
+
+Decode is HBM-bound; int8 weights stream at ~90% of roofline (ROUND5_NOTES §12).
+int4 packing halves weight bytes — worth ~2x on the MLP matmuls IF the unpack
+(two nibbles per int8 byte) can ride along without materializing the unpacked
+tensor in HBM. The packing scheme avoids any interleave relayout: byte[i, o]
+holds W[2i, o] in the low nibble and W[2i+1, o] in the high nibble, so
+
+    y = x[:, 0::2] @ lo(P) + x[:, 1::2] @ hi(P)
+
+with lo/hi each (in/2, out) — same-shaped dots, no lane shuffles. This script
+times, at the 8B decode shapes (bs=64):
+
+  a) int8 baseline          x8 @ w8                     (what the model runs today)
+  b) XLA w4                 nibble-ops feeding two dots (fused? or materialized?)
+  c) DMA floor              int4 bytes / 819 GB/s       (printed, not run)
+"""
+
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+B, IN, OUT = 64, 4096, 14336
+L = 8
+R = 40  # in-jit repetitions: one dispatch carries R*L layer matmuls  # stacked layers to defeat caching between iterations
+
+
+@jax.jit
+def _fetch(x):
+    return jax.lax.slice(x.ravel(), (0,), (1,))
+
+
+def timeit_chain(fn, state, iters=30):
+    """Axon-tunnel-safe timing: chain dependent calls, fetch one element at the
+    end — wall/iters is true per-call time (see probe_roofline.py)."""
+    state = fn(state)
+    np.asarray(_fetch(jax.tree.leaves(state)[0]))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state = fn(state)
+    np.asarray(_fetch(jax.tree.leaves(state)[0]))
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    rng = np.random.default_rng(0)
+    x8 = jnp.asarray(rng.integers(-127, 128, (B, IN), dtype=np.int8))
+    w8 = jnp.asarray(rng.integers(-127, 128, (L, IN, OUT), dtype=np.int8))
+    # packed: byte = (W[2i+1] << 4) | (W[2i] & 0xF), values in [-8, 7]
+    w4 = rng.integers(-8, 8, (L, IN, OUT), dtype=np.int8)
+    packed = ((w4[:, 1::2] << 4) | (w4[:, 0::2] & 0xF)).astype(np.int8)
+    p4 = jnp.asarray(packed)
+
+    def requant(y):
+        # fold the (B, OUT) int32 output back to a (B, IN) int8 activation so
+        # calls chain through real data dependencies
+        z = y[:, :IN].astype(jnp.float32)
+        s = jnp.maximum(jnp.max(jnp.abs(z), axis=1, keepdims=True), 1e-6) / 127.0
+        return jnp.clip(jnp.round(z / s), -127, 127).astype(jnp.int8)
+
+    @jax.jit
+    def int8_mm(x, w):
+        def step(c, wl):
+            y = jax.lax.dot_general(c, wl, (((1,), (0,)), ((), ())),
+                                    preferred_element_type=jnp.int32)
+            return requant(y), None
+        def rep(_, c):
+            return jax.lax.scan(step, c, w)[0]
+        return jax.lax.fori_loop(0, R, rep, x)
+
+    @jax.jit
+    def w4_mm(x, p):
+        def step(c, pl_):
+            lo = ((pl_ & 0xF) ^ 8) - 8          # sign-extended low nibble
+            hi = jax.lax.shift_right_arithmetic(pl_, jnp.int8(4))
+            y = (jax.lax.dot_general(c[:, 0::2], lo, (((1,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.int32)
+                 + jax.lax.dot_general(c[:, 1::2], hi, (((1,), (0,)), ((), ())),
+                                       preferred_element_type=jnp.int32))
+            return requant(y), None
+        def rep(_, c):
+            return jax.lax.scan(step, c, p)[0]
+        return jax.lax.fori_loop(0, R, rep, x)
+
+    t8 = timeit_chain(lambda x: int8_mm(x, w8), x8, iters=10)
+    t4 = timeit_chain(lambda x: w4_mm(x, p4), x8, iters=10)
+    t8, t4 = t8 / R, t4 / R
+    int8_bytes = L * IN * OUT
+    bw = 819e9
+    print(f"int8 baseline : {t8*1e3:8.3f} ms  ({int8_bytes/t8/1e9:6.1f} GB/s)  "
+          f"floor {int8_bytes/bw*1e3:.3f} ms")
+    print(f"XLA w4        : {t4*1e3:8.3f} ms  ({int8_bytes/2/t4/1e9:6.1f} GB/s)  "
+          f"floor {int8_bytes/2/bw*1e3:.3f} ms")
+    print(f"w4/int8 ratio : {t4/t8:.3f}  (win if < 1; ~0.5 = full bandwidth win)")
+
+
+if __name__ == "__main__":
+    main()
