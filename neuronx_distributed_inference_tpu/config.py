@@ -158,7 +158,10 @@ class QuantizationConfig:
     """
 
     quantize_weights: bool = False
-    weight_dtype: str = "int8"       # int8 | float8_e4m3
+    # int8 | float8_e4m3 | int4 ("int4" packs the large streaming projections
+    # to 4 bits via the Pallas w4 matmul — ops/w4.py — and keeps the small
+    # ones int8; not supported for MoE expert weights)
+    weight_dtype: str = "int8"
     kv_cache_dtype: Optional[str] = None  # None = same as model dtype
     kv_cache_scale_mode: str = "direct"   # direct | static (fp8/int8 caches)
 
@@ -278,6 +281,11 @@ class TpuConfig:
         if self.paged_attention_enabled and self.pa_num_blocks < 1:
             raise ValueError("paged attention requires pa_num_blocks >= 1")
         q = self.quantization_config
+        if q is not None and q.quantize_weights:
+            from .ops.quantization import WEIGHT_DTYPES
+
+            if q.weight_dtype not in WEIGHT_DTYPES:
+                raise ValueError(f"weight_dtype must be one of {WEIGHT_DTYPES}")
         if q is not None and q.kv_cache_scale_mode not in ("direct", "static"):
             raise ValueError("kv_cache_scale_mode must be 'direct' or 'static'")
         if q is not None and q.activation_quant and (

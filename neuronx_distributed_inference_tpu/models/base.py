@@ -1104,11 +1104,59 @@ def _decoder_layer(
     return h, k_cache, v_cache
 
 
+def _w4_kernel_ok(mesh) -> bool:
+    """Static routing for int4 weights: the Pallas w4 matmul has no GSPMD
+    partitioning rule, so it runs only on single-device meshes (the bench /
+    serving configuration); sharded meshes take the XLA dequant path inside
+    w4_apply (correct under GSPMD, slower — multi-chip int4 kernels via
+    shard_map are future work)."""
+    return mesh is None or mesh.devices.size == 1
+
+
+def _split_w4_stacks(tree):
+    """Pull int4-packed {"q4","s"} leaves OUT of the scan xs: their stacked
+    payload must reach the Pallas kernel whole (an xs slice feeding a
+    pallas_call materializes a per-layer copy — exactly the traffic int4
+    exists to avoid; see ops/w4.py). Returns (stripped_tree, [(path, leaf)])."""
+    from ..ops.w4 import is_w4
+
+    found = []
+
+    def walk(node, path):
+        if isinstance(node, dict):
+            if is_w4(node):
+                found.append((path, node))
+                return None
+            return {k: walk(v, path + (k,)) for k, v in node.items()}
+        return node
+
+    return walk(tree, ()), found
+
+
+def _merge_w4_stacks(lp, w4_stacks, li, use_kernel):
+    """Re-attach the full stacked w4 leaves (plus the in-scan layer index and
+    the static kernel-vs-dequant routing flag) into a sliced layer-param tree."""
+    if not w4_stacks:
+        return lp
+
+    def insert(node, path, leaf):
+        node = dict(node)
+        if len(path) == 1:
+            node[path[0]] = leaf
+        else:
+            node[path[0]] = insert(node[path[0]], path[1:], leaf)
+        return node
+
+    for path, leaf in w4_stacks:
+        lp = insert(lp, path, {**leaf, "layer": li, "use_kernel": use_kernel})
+    return lp
+
+
 def _scan_layers(stack_params, k_stack, v_stack, h, step, *, cache_mode="xs",
                  kv_scale_stacks=None, layer_indices=None,
                  capture_layers: Optional[Tuple[int, ...]] = None,
                  deepstack: Optional[jnp.ndarray] = None,
-                 allow_hidden_tap: bool = False):
+                 allow_hidden_tap: bool = False, mesh=None):
     """THE layer-stack scan driver — every runner below is a thin strategy wrapper.
 
     ``step(h, lp, kc, vc, li, kv_scales) -> (new_h, kc, vc)`` is the per-layer
@@ -1134,6 +1182,8 @@ def _scan_layers(stack_params, k_stack, v_stack, h, step, *, cache_mode="xs",
 
     Returns ``(h, k_new, v_new, caps)`` with ``caps`` a list of captured hidden
     states (empty unless ``capture_layers``)."""
+    stack_params, w4_stacks = _split_w4_stacks(stack_params)
+    w4_kernel = _w4_kernel_ok(mesh)
     n = len(jax.tree.leaves(stack_params)[0])
     li_all = (jnp.arange(n, dtype=jnp.int32) if layer_indices is None
               else layer_indices)
@@ -1159,19 +1209,25 @@ def _scan_layers(stack_params, k_stack, v_stack, h, step, *, cache_mode="xs",
                 new_h = new_h + jnp.where(li == k_i, deepstack[k_i], 0.0)
         return caps, new_h
 
+    # w4 stacks are indexed by RUN-LOCAL position (the stacks were sliced to
+    # this scan's layers), while ``li`` may be a GLOBAL cache-layer index
+    # (pattern runners) — carry a separate local arange for the merge
+    w4_li = jnp.arange(n, dtype=jnp.int32)
+
     if cache_mode == "xs":
-        xs = (stack_params, k_stack, v_stack, li_all)
+        xs = (stack_params, k_stack, v_stack, li_all, w4_li)
         if has_scales:
             xs = xs + tuple(kv_scale_stacks)
 
         def body(carry, layer_xs):
             carry_h, caps = carry
             if has_scales:
-                lp, kc, vc, li, sk, sv = layer_xs
+                lp, kc, vc, li, wli, sk, sv = layer_xs
                 kvs = (sk, sv)
             else:
-                lp, kc, vc, li = layer_xs
+                lp, kc, vc, li, wli = layer_xs
                 kvs = None
+            lp = _merge_w4_stacks(lp, w4_stacks, wli, w4_kernel)
             new_h, kc, vc = step(carry_h, lp, kc, vc, li, kvs)
             caps, new_h = _post(caps, li, new_h)
             ys = (kc, vc) + ((new_h,) if want_hidden else ())
@@ -1187,7 +1243,8 @@ def _scan_layers(stack_params, k_stack, v_stack, h, step, *, cache_mode="xs",
 
     def body(carry, xs):
         carry_h, ck, cv, caps = carry
-        lp, li = xs
+        lp, li, wli = xs
+        lp = _merge_w4_stacks(lp, w4_stacks, wli, w4_kernel)
         kvs = ((jnp.take(kv_scale_stacks[0], li, axis=0),
                 jnp.take(kv_scale_stacks[1], li, axis=0)) if has_scales else None)
         if cache_mode == "carry_slice":
@@ -1205,7 +1262,7 @@ def _scan_layers(stack_params, k_stack, v_stack, h, step, *, cache_mode="xs",
     # ~8x SLOWER (128 ms/step at unroll=8 vs 16.5) — the per-layer Pallas write
     # kernel calls serialize badly when unrolled; keep the rolled loop
     (h, k_new, v_new, caps), _ = jax.lax.scan(
-        body, (h, k_stack, v_stack, caps0), (stack_params, li_all))
+        body, (h, k_stack, v_stack, caps0), (stack_params, li_all, w4_li))
     return h, k_new, v_new, list(caps)
 
 
@@ -1236,7 +1293,7 @@ def _run_stack(params: Params, args: ModelArchArgs, h, cos, sin, mask, cache,
     h, k_new, v_new, caps = _scan_layers(
         params["layers"], cache["k"], cache["v"], h, step, cache_mode="xs",
         kv_scale_stacks=_cache_scales(cache), capture_layers=capture_layers,
-        deepstack=deepstack, allow_hidden_tap=True)
+        deepstack=deepstack, allow_hidden_tap=True, mesh=mesh)
     # preserve auxiliary cache entries (e.g. M-RoPE rope_delta) alongside k/v
     out_cache = {**cache, "k": k_new, "v": v_new}
     if capture_layers:
@@ -1312,7 +1369,7 @@ def _run_stack_pattern(params: Params, args: ModelArchArgs, h, ctx_full, ctx_sli
                                   rolling_lengths=_rl)
 
         h, ks, vs, _ = _scan_layers(stack, kc_stack, vc_stack, h, step,
-                                    cache_mode="xs")
+                                    cache_mode="xs", mesh=mesh)
         parts[is_slide].append((ks, vs))
 
     out = dict(cache)
@@ -1347,7 +1404,8 @@ def _run_stack_paged_gather(params: Params, args: ModelArchArgs, h, cos, sin,
 
     h, k_new, v_new, _ = _scan_layers(
         params["layers"], cache["k"], cache["v"], h, step,
-        cache_mode="carry_slice", kv_scale_stacks=_cache_scales(cache))
+        cache_mode="carry_slice", kv_scale_stacks=_cache_scales(cache),
+        mesh=mesh)
     return h, {**cache, "k": k_new, "v": v_new}
 
 
@@ -1401,7 +1459,7 @@ def _run_stack_pattern_decode_kernel(params: Params, args: ModelArchArgs, h,
 
         h, carry_k, carry_v, _ = _scan_layers(stack, carry_k, carry_v, h, step,
                                               cache_mode="carry",
-                                              layer_indices=li)
+                                              layer_indices=li, mesh=mesh)
         if is_slide:
             cks, cvs = carry_k, carry_v
         else:
@@ -1426,7 +1484,7 @@ def _run_stack_decode_kernel(params: Params, args: ModelArchArgs, h, cos, sin, m
 
     h, k_new, v_new, _ = _scan_layers(
         params["layers"], cache["k"], cache["v"], h, step, cache_mode="carry",
-        kv_scale_stacks=_cache_scales(cache))
+        kv_scale_stacks=_cache_scales(cache), mesh=mesh)
     return h, {**cache, "k": k_new, "v": v_new}
 
 
@@ -1449,7 +1507,7 @@ def _run_stack_paged_kernel(params: Params, args: ModelArchArgs, h, cos, sin,
 
     h, k_new, v_new, _ = _scan_layers(
         params["layers"], cache["k"], cache["v"], h, step, cache_mode="carry",
-        kv_scale_stacks=_cache_scales(cache))
+        kv_scale_stacks=_cache_scales(cache), mesh=mesh)
     return h, {**cache, "k": k_new, "v": v_new}
 
 
@@ -1464,7 +1522,14 @@ def _lm_head(params: Params, args: ModelArchArgs, h, mesh, rules) -> jnp.ndarray
     if args.tie_word_embeddings:
         logits = (h @ params["embed"].T).astype(jnp.float32)
     else:
-        logits = qapply(h, params["lm_head"]).astype(jnp.float32)
+        from ..ops.w4 import is_w4
+
+        head = params["lm_head"]
+        if is_w4(head):
+            # opt-in int4 lm_head (flat 2D leaf, not under the layer scan):
+            # attach the same static kernel-vs-dequant routing the scan applies
+            head = {**head, "use_kernel": _w4_kernel_ok(mesh)}
+        logits = qapply(h, head).astype(jnp.float32)
     if "lm_head_b" in params:           # phi-style biased output head
         logits = logits + params["lm_head_b"].astype(jnp.float32)
     if args.logits_scale != 1.0:        # cohere logit_scale / granite 1/scaling

@@ -34,6 +34,13 @@ DEFAULT_QUANTIZED_PARAMS = (
     "shared_wg", "shared_wu", "shared_wd", "lm_head",
 )
 
+# params packed to int4 under weight_dtype="int4" (the rest of the quantized
+# names stay int8). wk/wv are EXCLUDED: at their sizes the per-call fixed cost
+# of the w4 Pallas matmul exceeds the halved DMA (see ops/w4.py); lm_head is
+# excluded for accuracy (logits feed sampling directly) — both stay int8.
+W4_DEFAULT_PARAMS = ("wq", "wo", "wg", "wu", "wd",
+                     "shared_wg", "shared_wu", "shared_wd")
+
 # stacked attention projections stored TRANSPOSED ((..., out, in) as "qT"):
 # XLA chooses a transposed physical layout for these under the decode layer
 # scan and then materializes an s8[1, in, out] copy of every per-layer slice
@@ -45,9 +52,12 @@ TRANSPOSED_ATTENTION_PARAMS = ("wq", "wk", "wv", "wo")
 
 _QMAX = {"int8": 127.0, "float8_e4m3": 448.0}
 
+WEIGHT_DTYPES = ("int8", "float8_e4m3", "int4")
+
 
 def is_quantized(w) -> bool:
-    return isinstance(w, dict) and ("q" in w or "qT" in w) and "s" in w
+    return (isinstance(w, dict) and ("q" in w or "qT" in w or "q4" in w)
+            and "s" in w)
 
 
 def quantize_tensor(w, weight_dtype: str = "int8") -> Dict[str, Any]:
@@ -61,8 +71,12 @@ def quantize_tensor(w, weight_dtype: str = "int8") -> Dict[str, Any]:
     import ml_dtypes
     import numpy as np
 
+    if weight_dtype == "int4":
+        from .w4 import pack_int4
+
+        return pack_int4(w)
     if weight_dtype not in _QMAX:
-        raise ValueError(f"weight_dtype must be one of {sorted(_QMAX)}")
+        raise ValueError(f"weight_dtype must be one of {sorted(WEIGHT_DTYPES)}")
     w32 = np.asarray(jax.device_get(w) if isinstance(w, jax.Array) else w,
                      dtype=np.float32)
     absmax = np.max(np.abs(w32), axis=-2, keepdims=True)
@@ -76,6 +90,10 @@ def quantize_tensor(w, weight_dtype: str = "int8") -> Dict[str, Any]:
 
 def dequantize_tensor(qw: Dict[str, jnp.ndarray], dtype=jnp.float32) -> jnp.ndarray:
     """Dequantize back to the logical (..., in, out) orientation."""
+    if "q4" in qw:
+        from .w4 import dequant_w4
+
+        return dequant_w4(qw, dtype)
     if "qT" in qw:
         w = jnp.swapaxes(qw["qT"].astype(jnp.float32), -1, -2)
         return (w * qw["s"]).astype(dtype)
@@ -125,6 +143,12 @@ def qapply(x: jnp.ndarray, w, act_quant: bool = False) -> jnp.ndarray:
     XLA fuses the quantize into the preceding norm/elementwise ops."""
     if not is_quantized(w):
         return x @ w
+    if "q4" in w:
+        # int4-packed: Pallas streaming matmul (single-device) or the XLA
+        # dequant path (sharded meshes / CPU model tests) — see ops/w4.py
+        from .w4 import w4_apply
+
+        return w4_apply(x, w)
     if "qT" in w:
         # transposed storage (..., out, in): contract both operands' LAST axes
         wq = w["qT"]
@@ -163,6 +187,10 @@ def qeinsum(spec: str, x: jnp.ndarray, w) -> jnp.ndarray:
     """
     if not is_quantized(w):
         return jnp.einsum(spec, x, w)
+    if "q4" in w:
+        raise ValueError(
+            "int4 weights are not supported for einsum-consumed (MoE expert) "
+            "weights — quantize MoE families with weight_dtype='int8'")
     if "qT" in w:
         # transposed storage (..., out, in): swap the SPEC's last two weight
         # axes so the flag is layout-transparent for any family routing an
@@ -189,22 +217,37 @@ DEFAULT_QUANTIZED_GROUPS = ("layers", "dense", "moe")
 def quantize_params(params: Dict[str, Any], weight_dtype: str = "int8",
                     names: Sequence[str] = DEFAULT_QUANTIZED_PARAMS,
                     group_keys: Sequence[str] = DEFAULT_QUANTIZED_GROUPS,
+                    int4_names: Optional[Sequence[str]] = None,
                     ) -> Dict[str, Any]:
     """Convert the named weights of a model param tree: at the top level and inside
     the known group containers (``group_keys``, recursively) — covers the base
     layout (top level + ``layers``) as well as custom layouts (DeepSeek-MLA /
     Llama4 ``dense``/``moe`` groups) without touching unrelated subtrees.
 
+    ``weight_dtype="int4"`` packs ``int4_names`` (default W4_DEFAULT_PARAMS)
+    to {"q4","s"} and the REMAINING names to int8 — the small projections
+    aren't worth a w4 kernel call (see W4_DEFAULT_PARAMS note).
+
     Leaves that are ALREADY in the quantized {"q","s"} layout pass through untouched,
     so pre-quantized (or partially pre-quantized) checkpoints load correctly."""
     nameset = set(names)
     groups = set(group_keys)
+    if weight_dtype == "int4":
+        w4set = nameset & set(W4_DEFAULT_PARAMS if int4_names is None
+                              else int4_names)
+    else:
+        w4set = set()
+
+    def conv(k, v):
+        return quantize_tensor(v, "int4" if k in w4set else
+                               ("int8" if w4set or weight_dtype == "int4"
+                                else weight_dtype))
 
     def walk(node, in_group):
         if is_quantized(node):
             return node
         if isinstance(node, dict):
-            return {k: (quantize_tensor(v, weight_dtype)
+            return {k: (conv(k, v)
                         if in_group and k in nameset and not is_quantized(v)
                         and not isinstance(v, dict)
                         else walk(v, k in groups)
@@ -245,18 +288,25 @@ def dequant_mxfp4(blocks, scales):
 def quantized_logical_axes(logical: Dict[str, Any], names: Sequence[str],
                            group_keys: Sequence[str] = DEFAULT_QUANTIZED_GROUPS,
                            transposed_names: Sequence[str] = (),
+                           int4_names: Sequence[str] = (),
                            ) -> Dict[str, Any]:
     """Transform a logical-axes tree to match a quantized param tree (scoped to the
     same group containers as quantize_params): each quantized leaf's axes apply to
     ``q``; the scale keeps the output axis, contraction replaced by None.
     ``transposed_names`` get the {"qT","s"} form: the payload's last two axes
-    swap, the scale keeps the ORIGINAL output axis."""
+    swap, the scale keeps the ORIGINAL output axis. ``int4_names`` get the
+    {"q4","s"} form: the packed payload keeps the SAME axis names (even/odd
+    packing halves the contraction dim without changing which mesh axis shards
+    it — each packed row is a self-contained pair of logical rows)."""
     nameset = set(names)
     tset = set(transposed_names)
+    w4set = set(int4_names)
     groups = set(group_keys)
 
-    def _q_axes(axes, transposed):
+    def _q_axes(axes, transposed, w4):
         s_axes = tuple(list(axes[:-2]) + [None, axes[-1]])
+        if w4:
+            return {"q4": tuple(axes), "s": s_axes}
         if transposed:
             qt = tuple(list(axes[:-2]) + [axes[-1], axes[-2]])
             return {"qT": qt, "s": s_axes}
@@ -264,7 +314,7 @@ def quantized_logical_axes(logical: Dict[str, Any], names: Sequence[str],
 
     def walk(node, in_group):
         if isinstance(node, dict):
-            return {k: (_q_axes(v, k in tset)
+            return {k: (_q_axes(v, k in tset and k not in w4set, k in w4set)
                         if in_group and k in nameset and not isinstance(v, dict)
                         else walk(v, k in groups) if isinstance(v, dict) else v)
                     for k, v in node.items()}

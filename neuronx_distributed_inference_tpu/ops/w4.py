@@ -1,0 +1,289 @@
+"""int4 weight-only quantization: packed storage + Pallas streaming matmul.
+
+Decode is HBM-bandwidth-bound and the int8 weight stream already runs at ~90%
+of roofline (ROUND5_NOTES §12), so the only way to shrink the decode step
+further is fewer weight bytes: int4 halves them. The reference stops at
+int8/fp8 weights (NxD quantize configs, `models/model_wrapper.py:11-21`) and
+MXFP4 for gpt-oss ingest — this is a capability beyond reference parity.
+
+Measured on v5e (scripts/probe_w4_kernel_bf16.py, 4096x14336 @ bs=64):
+- XLA cannot ride the nibble unpack into the dot's operand read (ratio 0.95 of
+  int8 — the whole bandwidth win burned on VPU materialization), and the native
+  `jnp.int4` dtype is UNIMPLEMENTED on this backend, so the unpack must live in
+  a Pallas kernel.
+- The Pallas W4A8 kernel (int8 MXU dots) streams a layer in ~46 us of real
+  work vs ~80 us for the int8 XLA dot (36 us DMA floor): a ~1.7x win on the
+  weight-streaming portion of the decode step.
+
+Layout: **half-split packing**. A logical weight W (..., in, out) packs rows
+i and i+in/2 into one byte:
+
+    packed[..., i, o] = (W[..., i + in/2, o] << 4) | (W[..., i, o] & 0xF)
+
+so the kernel unpacks straight into ONE contiguous (in, bo) VMEM scratch (lo
+nibbles fill rows [0, in/2), hi nibbles rows [in/2, in) — two plain
+sublane-range stores, no interleave shuffle) and runs a SINGLE dot against the
+whole x tile. The first (even/odd, two-dot) design split x into strided
+halves, and the on-chip profile showed XLA materializing those slices through
+transposed relayout fusions at ~26 us each per wd layer call — half the
+kernel's own cost. Under a sharded mesh the q4 leaf takes the XLA dequant path
+(w4_apply), where GSPMD keeps any packing correct.
+
+Mosaic cannot legalize int8 vector shifts, so the nibble arithmetic widens to
+i32 and narrows back (same trick as paged_decode._vmem_cast).
+
+The stacked (L, in/2, out) payload is NEVER sliced by the layer scan — it
+reaches the kernel whole (closure through `_scan_layers`, see models/base) and
+the layer index arrives via scalar prefetch, so the per-layer "slice" is just
+a BlockSpec index-map coordinate (an XLA slice of a packed operand feeding a
+pallas_call would materialize a per-layer copy and destroy the win).
+
+Activations: per-token dynamic int8 quantization happens OUTSIDE the kernel
+(XLA fuses it into the preceding norm); the kernel runs int8 x int8 on the MXU
+(394 TOPS — the bf16-dot variant measured MXU-bound at B=64) and applies both
+scales (per-token sx, per-channel s) in the f32 epilogue before the bf16 cast.
+For wide inputs (prefill), the grid adds an m dimension; the unpacked weight
+tile is cached in VMEM scratch at mi==0 and reused across the m sweep.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# out-tile width: measured best at 512 (1024 was ~10% slower, 2048 blew VMEM)
+_BO = 512
+# m-tile height for wide (prefill) inputs
+_BM = 512
+
+
+def is_w4(w) -> bool:
+    return isinstance(w, dict) and "q4" in w and "s" in w
+
+
+def pack_int4(w, scale_axis: int = -2) -> Dict[str, Any]:
+    """Symmetric per-output-channel int4 quantization, half-split packed.
+
+    ``w`` (..., in, out) float -> {"q4": int8 (..., in/2, out) packed,
+    "s": f32 (..., 1, out)}. Host-side numpy (see quantize_tensor): a model
+    larger than one device's HBM never materializes unsharded on device.
+    """
+    import numpy as np
+
+    w32 = np.asarray(jax.device_get(w) if isinstance(w, jax.Array) else w,
+                     dtype=np.float32)
+    if w32.shape[-2] % 2:
+        raise ValueError(f"int4 packing needs an even contraction dim, got "
+                         f"{w32.shape}")
+    absmax = np.max(np.abs(w32), axis=-2, keepdims=True)
+    scale = np.maximum(absmax / 7.0, 1e-12)
+    q = np.clip(np.round(w32 / scale), -7, 7).astype(np.int8)
+    h = q.shape[-2] // 2
+    lo = q[..., :h, :]
+    hi = q[..., h:, :]
+    packed = ((hi << 4) | (lo & 0xF)).astype(np.int8)
+    return {"q4": packed, "s": scale.astype(np.float32)}
+
+
+def unpack_int4(packed) -> "np.ndarray":
+    """Host-side inverse of the packing (returns int values, no scales)."""
+    import numpy as np
+
+    p = np.asarray(packed).astype(np.int8)
+    lo = ((p & 0xF) ^ 8) - 8
+    hi = p >> 4                       # numpy int8 >> is arithmetic
+    return np.concatenate([lo, hi], axis=-2)
+
+
+def dequant_w4(qw: Dict[str, Any], dtype=jnp.float32) -> jnp.ndarray:
+    """Dequantize a {"q4","s"} leaf back to the logical (..., in, out) weight
+    (host/differentiable-free reference path; used by CPU fallbacks + tests)."""
+    p = qw["q4"].astype(jnp.int32)
+    lo = ((p & 0xF) ^ 8) - 8
+    hi = jax.lax.shift_right_arithmetic(p, 4)
+    w = jnp.concatenate([lo, hi], axis=-2).astype(jnp.float32)
+    return (w * qw["s"]).astype(dtype)
+
+
+def _w4_kernel(lidx_ref, x_ref, sx_ref, p_ref, s_ref, o_ref, w_s, *,
+               int8_acts: bool, hin: int):
+    mi = pl.program_id(1)
+
+    @pl.when(mi == 0)
+    def _unpack():
+        p = p_ref[0].astype(jnp.int32)
+        tgt = jnp.int8 if int8_acts else jnp.bfloat16
+        # half-split: lo nibbles are logical rows [0, hin), hi rows [hin, 2hin)
+        # — two contiguous sublane-range stores, one dot-ready (in, bo) scratch
+        w_s[:hin] = ((((p & 15) ^ 8) - 8)).astype(tgt)
+        w_s[hin:] = jax.lax.shift_right_arithmetic(p, 4).astype(tgt)
+
+    pref = jnp.int32 if int8_acts else jnp.float32
+    acc = jax.lax.dot_general(x_ref[...], w_s[...], (((1,), (0,)), ((), ())),
+                              preferred_element_type=pref)
+    acc = acc.astype(jnp.float32) * s_ref[0, 0]
+    if int8_acts:
+        acc = acc * sx_ref[:, 0:1]
+    o_ref[...] = acc.astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def w4_matmul_stacked(
+    x: jnp.ndarray,              # (M, in) bf16/f32 activations
+    packed: jnp.ndarray,         # (L, in/2, out) int8 — FULL stacked payload
+    scales: jnp.ndarray,         # (L, 1, out) f32
+    layer_idx: jnp.ndarray,      # () int32
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """One layer's ``x @ W`` from the stacked int4-packed weight.
+
+    Decode (M <= _BM): W4A8 — x quantizes per-token to int8 outside the kernel
+    and the dots run int8 x int8 on the MXU. Wider inputs (prefill) keep bf16
+    activations (no act-quant error where compute, not bandwidth, binds) and
+    sweep m tiles with the unpacked weight cached in VMEM scratch.
+    Returns (M, out) bf16.
+    """
+    l, hin, out = packed.shape
+    m, in_dim = x.shape
+    if in_dim != 2 * hin:
+        raise ValueError(f"x in-dim {in_dim} != 2*{hin}")
+
+    int8_acts = m <= _BM
+    if int8_acts:
+        xf = x.astype(jnp.float32)
+        sx = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1, keepdims=True),
+                         1e-8) / 127.0
+        xq = jnp.clip(jnp.round(xf / sx), -127, 127).astype(jnp.int8)
+        sxp = jnp.broadcast_to(sx.astype(jnp.float32), (m, 128))
+        bm = m
+    else:
+        xq = x.astype(jnp.bfloat16)
+        sxp = jnp.zeros((8, 128), jnp.float32)     # unused
+        bm = _BM
+
+    # size (bm, bo) so everything fits the default 16 MB scoped-vmem budget —
+    # raising the budget via compiler_params backfired (XLA then placed the
+    # whole (M, out) OUTPUT in scoped vmem and blew the 128 MB chip total)
+    xbytes = xq.dtype.itemsize
+    wsbytes = 1 if int8_acts else 2
+
+    def _est(bm_, bo_):
+        # Mosaic pipelines streamed blocks with up to THREE live buffers
+        # (measured: a plan sized with a 2-buffer model overflowed by exactly
+        # one buffer generation); the (2*hin, bo) scratch is single-buffered
+        return (3 * (2 * bm_ * hin * xbytes + hin * bo_ + 2 * bm_ * bo_
+                     + bm_ * 128 * 4)
+                + 2 * hin * bo_ * wsbytes)
+
+    bo = _BO if out % _BO == 0 else out
+    while _est(bm, bo) > 15 * 2 ** 20:
+        if bo > 128 and bo % 2 == 0 and out % (bo // 2) == 0:
+            bo //= 2
+        elif not int8_acts and bm > 64:
+            bm //= 2
+        else:
+            break
+    import os as _os
+    if _os.environ.get("W4_DEBUG"):
+        print(f"[w4] m={m} hin={hin} out={out} int8_acts={int8_acts} "
+              f"bm={bm} bo={bo} est={_est(bm, bo)/2**20:.2f}MB", flush=True)
+    if not int8_acts and m % bm:
+        xq = jnp.pad(xq, ((0, bm - m % bm), (0, 0)))
+    mp = xq.shape[0]
+    nm = mp // bm
+    nt = out // bo
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nt, nm),                 # m fastest: weight tile reused across m
+        in_specs=[
+            pl.BlockSpec((bm, 2 * hin), lambda ti, mi, lidx: (mi, 0)),
+            pl.BlockSpec((bm, 128) if int8_acts else (8, 128),
+                         lambda ti, mi, lidx: (mi, 0) if int8_acts else (0, 0)),
+            pl.BlockSpec((1, hin, bo), lambda ti, mi, lidx: (lidx[0], 0, ti)),
+            pl.BlockSpec((1, 1, bo), lambda ti, mi, lidx: (lidx[0], 0, ti)),
+        ],
+        out_specs=pl.BlockSpec((bm, bo), lambda ti, mi, lidx: (mi, ti)),
+        scratch_shapes=[
+            pltpu.VMEM((2 * hin, bo), jnp.int8 if int8_acts else jnp.bfloat16),
+        ],
+    )
+    kernel = functools.partial(_w4_kernel, int8_acts=int8_acts, hin=hin)
+    y = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((mp, out), jnp.bfloat16),
+        interpret=interpret,
+    )(layer_idx.reshape(1).astype(jnp.int32), xq, sxp, packed, scales)
+    return y[:m] if mp != m else y
+
+
+def w4_apply(x: jnp.ndarray, w: Dict[str, Any],
+             interpret: Optional[bool] = None) -> jnp.ndarray:
+    """qapply-equivalent for a w4 leaf: handles arbitrary leading dims and both
+    stacked ({"q4": (L, in/2, out), "layer": li}) and flat ({"q4": (in/2, out)})
+    layouts.
+
+    ``w["use_kernel"]`` (a static bool attached by the layer scan) selects the
+    Pallas kernel (single-device meshes — the bench/serving configuration) or
+    the XLA dequant path (multi-device meshes, where a pallas_call has no GSPMD
+    partitioning rule: the dequantized per-layer slice is a plain dot GSPMD can
+    shard; correct everywhere, fast only where it doesn't matter).
+    Returns x.dtype."""
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    q4, s = w["q4"], w["s"]
+    use_kernel = w.get("use_kernel", True)
+    if q4.ndim == 2:
+        if not use_kernel:
+            return (x @ dequant_w4({"q4": q4, "s": s}, x.dtype)).astype(x.dtype)
+        q4 = q4[None]
+        s = s.reshape(1, 1, -1)
+        li = jnp.int32(0)
+    else:
+        if q4.ndim != 3:
+            raise ValueError(f"w4 payload must be (in/2, out) or (L, in/2, out), "
+                             f"got {q4.shape} — int4 is not supported for "
+                             f"einsum-consumed (MoE expert) weights")
+        li = w.get("layer")
+        if li is None:
+            raise ValueError("stacked w4 leaf reached w4_apply without a layer "
+                             "index — int4 weights must flow through the layer "
+                             "scan's closure path (see _scan_layers)")
+        s = s.reshape(q4.shape[0], 1, -1)
+        if not use_kernel:
+            wl = {"q4": jax.lax.dynamic_index_in_dim(q4, li, 0, keepdims=False),
+                  "s": jax.lax.dynamic_index_in_dim(s, li, 0, keepdims=False)}
+            return (x @ dequant_w4(wl, x.dtype)).astype(x.dtype)
+    lead = x.shape[:-1]
+    m = 1
+    for d in lead:
+        m *= d
+    x2 = x.reshape(m, x.shape[-1])
+    y = w4_matmul_stacked(x2, q4, s.astype(jnp.float32), li,
+                          interpret=interpret)
+    return y.reshape(*lead, y.shape[-1]).astype(x.dtype)
+
+
+def repack_int8_to_int4(qw: Dict[str, Any]) -> Dict[str, Any]:
+    """Re-quantize an int8 {"q","s"} leaf to the int4 {"q4","s"} layout without
+    materializing the float weight: q4 = round(q * 7/127), s4 = s * 127/7.
+    Used to int4-convert pre-quantized int8 checkpoints (and the synthetic
+    bench trees, which are born int8)."""
+    import numpy as np
+
+    q = np.asarray(qw["q"])
+    if q.dtype != np.int8:
+        raise ValueError(f"repack_int8_to_int4 needs an int8 payload, got {q.dtype}")
+    q4 = np.clip(np.round(q.astype(np.float32) * (7.0 / 127.0)), -7, 7
+                 ).astype(np.int8)
+    h = q4.shape[-2] // 2
+    lo = q4[..., :h, :]
+    hi = q4[..., h:, :]
+    packed = ((hi << 4) | (lo & 0xF)).astype(np.int8)
+    return {"q4": packed, "s": np.asarray(qw["s"]) * np.float32(127.0 / 7.0)}

@@ -444,6 +444,17 @@ class TpuModelForCausalLM:
 
         return DEFAULT_QUANTIZED_PARAMS
 
+    def _int4_param_names(self):
+        """Quantized names packed to int4 under weight_dtype='int4' (the large
+        streaming projections; see ops/quantization.W4_DEFAULT_PARAMS)."""
+        from ..ops.quantization import W4_DEFAULT_PARAMS
+
+        q = self._quantization()
+        if q is None or q.weight_dtype != "int4":
+            return ()
+        return tuple(n for n in W4_DEFAULT_PARAMS
+                     if n in self.quantized_param_names())
+
     def _transposed_param_names(self):
         """Quantized attention stacks stored transposed (see
         ops/quantization.TRANSPOSED_ATTENTION_PARAMS); intersected with this
@@ -464,7 +475,8 @@ class TpuModelForCausalLM:
         if self._quantization() is not None:
             logical = quantized_logical_axes(
                 logical, self.quantized_param_names(),
-                transposed_names=self._transposed_param_names())
+                transposed_names=self._transposed_param_names(),
+                int4_names=self._int4_param_names())
         return tree_shardings(self.mesh, logical, self.sharding_rules)
 
     def load(self, model_path: Optional[str] = None) -> None:
@@ -542,9 +554,17 @@ class TpuModelForCausalLM:
             from ..ops.quantization import (quantize_params,
                                             transpose_attention_stacks)
 
+            if (qcfg.weight_dtype == "int4"
+                    and getattr(self.arch_args, "moe", None) is not None):
+                raise ValueError(
+                    "weight_dtype='int4' is not supported for MoE families "
+                    "(expert weights flow through qeinsum, which has no w4 "
+                    "kernel path) — use 'int8'")
             # per-leaf: already-quantized leaves pass through (pre-quantized ckpts)
             host_params = quantize_params(host_params, qcfg.weight_dtype,
-                                          names=self.quantized_param_names())
+                                          names=self.quantized_param_names(),
+                                          int4_names=self._int4_param_names()
+                                          or None)
             tnames = self._transposed_param_names()
             if tnames:
                 host_params = transpose_attention_stacks(host_params,
@@ -559,8 +579,8 @@ class TpuModelForCausalLM:
             if first.startswith("rope_inv_freq") or last == "s":
                 # rope tables and quantization scales stay fp32
                 arr = arr.astype(np.float32)
-            elif last in ("q", "qT"):
-                pass                      # int8/fp8 payloads keep their dtype
+            elif last in ("q", "qT", "q4"):
+                pass                      # int8/fp8/int4-packed payloads keep dtype
             elif arr.dtype.kind == "f" or arr.dtype.name == "bfloat16":
                 arr = arr.astype(dtype) if arr.dtype != dtype else arr
             return jax.device_put(arr, s)

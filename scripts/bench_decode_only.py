@@ -43,8 +43,9 @@ def main():
     batch = int(os.environ.get("BENCH_BS", "64"))
     kernel = os.environ.get("BENCH_KERNEL", "1") == "1"
     kvd = os.environ.get("BENCH_KVD", "float8_e4m3")
+    w4 = os.environ.get("BENCH_W4", "0") == "1"
     quant = QuantizationConfig.for_kv_dtype(
-        kvd, quantize_weights=True, weight_dtype="int8")
+        kvd, quantize_weights=True, weight_dtype="int4" if w4 else "int8")
     tpu_cfg = TpuConfig(batch_size=batch, seq_len=512, max_context_length=256,
                         dtype="bfloat16", tp_degree=1,
                         context_encoding_buckets=[128, 256],
@@ -54,7 +55,16 @@ def main():
     config = LlamaInferenceConfig(tpu_cfg, load_config=load_pretrained_config(hf_cfg))
     app = LlamaForCausalLM(None, config)
     t0 = time.time()
-    app.load_host_params(get_params(hf_cfg))
+    params = get_params(hf_cfg)
+    if w4:
+        from neuronx_distributed_inference_tpu.ops.quantization import (
+            W4_DEFAULT_PARAMS)
+        from neuronx_distributed_inference_tpu.ops.w4 import repack_int8_to_int4
+        params = dict(params)
+        params["layers"] = {
+            k: (repack_int8_to_int4(v) if k in W4_DEFAULT_PARAMS else v)
+            for k, v in params["layers"].items()}
+    app.load_host_params(params)
     print(f"params on device in {time.time()-t0:.0f}s", flush=True)
 
     rng = np.random.default_rng(0)
@@ -65,7 +75,7 @@ def main():
     n = np.array([x for _, x in out.decode_latencies_s])
     per_step = 1000.0 * s / n
     toks = n.sum() * batch / s.sum()
-    print(f"kernel={kernel} bs={batch}: p50 step "
+    print(f"kernel={kernel} w4={w4} bs={batch}: p50 step "
           f"{np.percentile(per_step, 50):.2f} ms -> {toks:.0f} tok/s, "
           f"ttft {out.ttft_s:.3f}s", flush=True)
 

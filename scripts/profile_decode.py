@@ -37,8 +37,10 @@ def main():
         "tie_word_embeddings": False,
     }
     batch = 64
-    quant = QuantizationConfig(quantize_weights=True, weight_dtype="int8",
-                               kv_cache_dtype="float8_e4m3")
+    w4 = os.environ.get("BENCH_W4", "0") == "1"
+    kvd = os.environ.get("BENCH_KVD", "float8_e4m3")
+    quant = QuantizationConfig.for_kv_dtype(
+        kvd, quantize_weights=True, weight_dtype="int4" if w4 else "int8")
     tpu_cfg = TpuConfig(batch_size=batch, seq_len=512, max_context_length=256,
                         dtype="bfloat16", tp_degree=1,
                         context_encoding_buckets=[128, 256],
@@ -50,7 +52,16 @@ def main():
     sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "scripts"))
     import bench_decode_only
-    app.load_host_params(bench_decode_only.get_params(hf_cfg))
+    params = bench_decode_only.get_params(hf_cfg)
+    if w4:
+        from neuronx_distributed_inference_tpu.ops.quantization import (
+            W4_DEFAULT_PARAMS)
+        from neuronx_distributed_inference_tpu.ops.w4 import repack_int8_to_int4
+        params = dict(params)
+        params["layers"] = {
+            k: (repack_int8_to_int4(v) if k in W4_DEFAULT_PARAMS else v)
+            for k, v in params["layers"].items()}
+    app.load_host_params(params)
     print(f"params loaded in {time.time()-t0:.1f}s", flush=True)
 
     rng = np.random.default_rng(0)
