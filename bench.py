@@ -53,8 +53,11 @@ def _note(msg: str) -> None:
     print(f"[bench +{time.time() - T0:.0f}s] {msg}", file=sys.stderr, flush=True)
 
 
-def _random_quantized_llama_params(cfg, seed: int = 0):
-    """Host int8 param tree for the llama arch described by ``cfg`` (HF dict)."""
+def _random_quantized_llama_params(cfg, seed: int = 0, weight_dtype: str = "int8"):
+    """Host quantized param tree for the llama arch described by ``cfg`` (HF
+    dict): born int8; for weight_dtype="int4" the big streaming projections are
+    repacked to the q4 layout (ops/w4.repack_int8_to_int4 — same path a real
+    pre-quantized int8 checkpoint takes)."""
     rng = np.random.default_rng(seed)
     L = cfg["num_hidden_layers"]
     H = cfg["hidden_size"]
@@ -65,7 +68,17 @@ def _random_quantized_llama_params(cfg, seed: int = 0):
     V = cfg["vocab_size"]
 
     def qw(*shape):
-        return {"q": rng.integers(-127, 128, size=shape, dtype=np.int8),
+        # layer-stacked weights tile ONE random layer across L: decode streams
+        # identical bytes regardless of values (this is a perf bench on
+        # synthetic weights either way) and synthesis drops from ~20 min to
+        # seconds — the r5b full-budget run lost every enrichment phase to
+        # param synthesis under CPU contention
+        if len(shape) == 3:
+            one = rng.integers(-127, 128, size=shape[1:], dtype=np.int8)
+            q = np.broadcast_to(one, shape)
+        else:
+            q = rng.integers(-127, 128, size=shape, dtype=np.int8)
+        return {"q": q,
                 "s": np.full(shape[:-2] + (1, shape[-1]), 2e-4, dtype=np.float32)}
 
     import ml_dtypes
@@ -91,6 +104,24 @@ def _random_quantized_llama_params(cfg, seed: int = 0):
             d, cfg["rope_theta"], cfg["rope_scaling"]),
         "lm_head": qw(H, V),
     }
+    if weight_dtype == "int4":
+        from neuronx_distributed_inference_tpu.ops.quantization import (
+            W4_DEFAULT_PARAMS)
+        from neuronx_distributed_inference_tpu.ops.w4 import repack_int8_to_int4
+
+        def to4(v):
+            # repack ONE layer and re-broadcast: repacking the L-broadcast view
+            # would materialize multi-GB float32 temporaries per leaf
+            if v["q"].ndim == 3:
+                one = repack_int8_to_int4({"q": v["q"][0], "s": v["s"][0]})
+                L = v["q"].shape[0]
+                return {"q4": np.broadcast_to(one["q4"], (L,) + one["q4"].shape),
+                        "s": np.broadcast_to(one["s"], (L,) + one["s"].shape)}
+            return repack_int8_to_int4(v)
+
+        params["layers"] = {
+            k: (to4(v) if k in W4_DEFAULT_PARAMS else v)
+            for k, v in params["layers"].items()}
     return params
 
 
@@ -104,9 +135,13 @@ def _streamed_bytes_per_decode_step(hf_cfg, quant, batch, avg_ctx) -> int:
     q_size = hf_cfg["num_attention_heads"] * d
     kv_size = hf_cfg["num_key_value_heads"] * d
     V = hf_cfg["vocab_size"]
-    wbytes = 1 if (quant is not None and quant.quantize_weights) else 2
-    per_layer = (H * q_size + 2 * H * kv_size + q_size * H  # attention
-                 + 3 * H * I) * wbytes                      # gate/up/down
+    wq = quant is not None and quant.quantize_weights
+    wbytes = 1 if wq else 2
+    # int4 halves the big streaming projections (ops/w4.py W4_DEFAULT_PARAMS:
+    # wq/wo/wg/wu/wd); wk/wv and lm_head stay int8
+    w4bytes = 0.5 if (wq and quant.weight_dtype == "int4") else wbytes
+    per_layer = ((H * q_size + q_size * H + 3 * H * I) * w4bytes
+                 + 2 * H * kv_size * wbytes)
     lm_head = H * V * wbytes
     kvbytes = 1 if (quant is not None and quant.kv_cache_dtype) else 2
     kv_read = batch * L * 2 * kv_size * int(avg_ctx) * kvbytes
@@ -159,15 +194,15 @@ def main() -> None:
             "tie_word_embeddings": False,
         }
         batch = 64
-        # int8 KV with static per-head scales: measured r5 sweep — dense decode
-        # 17.31 ms/step vs 17.70 for fp8-direct (the int8 slice astype fuses
-        # better), and the serving phase's kernels are MXU-native on int8; one
-        # cache format across the whole artifact makes paged_vs_dense a true
-        # same-config ratio
+        # int4 weights (Pallas W4A8 streaming matmul, ops/w4.py — measured
+        # r5: 13.48 ms/step vs 18.23 int8 same-session) + int8 KV with static
+        # per-head scales (r5 sweep: int8 beats fp8-direct and the serving
+        # kernels are MXU-native on int8); one weight+cache format across the
+        # whole artifact keeps paged_vs_dense a true same-config ratio
         quant = QuantizationConfig.for_kv_dtype(
-            "int8", quantize_weights=True, weight_dtype="int8")
+            "int8", quantize_weights=True, weight_dtype="int4")
         name = ("llama3.1-8b-arch decode tokens/sec/chip "
-                f"(bs={batch}, int8 weights, int8 KV, tp=1)")
+                f"(bs={batch}, int4 weights, int8 KV, tp=1)")
 
     prompt_len, decode_steps = 128, 128
     tpu_cfg = TpuConfig(batch_size=batch, seq_len=512, max_context_length=256,
@@ -182,7 +217,8 @@ def main() -> None:
     if small:
         app.load_random(seed=0)
     else:
-        app.load_host_params(_random_quantized_llama_params(hf_cfg, seed=0))
+        app.load_host_params(_random_quantized_llama_params(
+            hf_cfg, seed=0, weight_dtype=quant.weight_dtype))
 
     rng = np.random.default_rng(0)
     input_ids = rng.integers(1, hf_cfg["vocab_size"],
@@ -298,6 +334,7 @@ def main() -> None:
         hf_cfg, quant, batch, prompt_len + decode_steps / 2)
     extra["hbm_bw_utilization"] = round(
         bytes_step / (step_ms * 1e-3) / bw, 3)
+    # int4 keeps decode HBM-bound but the ratio is vs the REDUCED bytes
     extra["streamed_bytes_per_step_gb"] = round(bytes_step / 1e9, 2)
     print(json.dumps(result), flush=True)
 
@@ -319,11 +356,14 @@ def main() -> None:
             single = input_ids[:1]
             f_noop = jax.jit(lambda x: x + 1)
             xs = jnp.zeros((8, 128), jnp.float32)
-            f_noop(xs).block_until_ready()
+            np.asarray(f_noop(xs))
             floor = []
-            for _ in range(10):
+            for i in range(10):
+                # vary the input and FETCH the result: the tunnel client
+                # elides repeated identical unfetched executions (a r5b run
+                # reported floor 0.0 from block_until_ready on elided calls)
                 t0 = time.perf_counter()
-                f_noop(xs).block_until_ready()
+                np.asarray(f_noop(xs + i))
                 floor.append(1000 * (time.perf_counter() - t0))
             extra["dispatch_floor_ms"] = round(float(np.percentile(floor, 50)), 1)
 
@@ -414,7 +454,7 @@ def _paged_serving_throughput(hf_cfg, batch):
     # fp8 (whose in-kernel cast is VPU-bound). Accuracy is pinned by
     # tests/test_quantization.py::test_int8_kv_static_scales_close_and_paths_agree.
     pquant = QuantizationConfig.for_kv_dtype(
-        "int8", quantize_weights=True, weight_dtype="int8")
+        "int8", quantize_weights=True, weight_dtype="int4")
     bs, seq, block = batch, 1024, 128
     cfg = TpuConfig(batch_size=bs, seq_len=seq, max_context_length=256,
                     dtype="bfloat16", tp_degree=1,
@@ -425,7 +465,8 @@ def _paged_serving_throughput(hf_cfg, batch):
                     quantization_config=pquant)
     config = LlamaInferenceConfig(cfg, load_config=load_pretrained_config(hf_cfg))
     app = LlamaForCausalLM(None, config)
-    app.load_host_params(_random_quantized_llama_params(hf_cfg, seed=0))
+    app.load_host_params(_random_quantized_llama_params(
+        hf_cfg, seed=0, weight_dtype=pquant.weight_dtype))
     rng = np.random.default_rng(0)
     # NO in-bench calibration: calibrate_kv_scales builds a transient DENSE
     # cache (~4.3 GB at this geometry) on top of weights + the paged pool and
@@ -503,7 +544,8 @@ def _paged_spec_throughput(app, hf_cfg, batch):
     d_config = LlamaInferenceConfig(d_tpu,
                                     load_config=load_pretrained_config(draft_hf))
     draft = LlamaForCausalLM(None, d_config)
-    draft.load_host_params(_random_quantized_llama_params(draft_hf, seed=1))
+    draft.load_host_params(_random_quantized_llama_params(
+        draft_hf, seed=1, weight_dtype=quant.weight_dtype))
     # no calibration (see _paged_serving_throughput): with RANDOM weights the
     # acceptance floor is ~chance regardless of draft cache fidelity, and the
     # full-accept ceiling is acceptance-independent — the two numbers reported

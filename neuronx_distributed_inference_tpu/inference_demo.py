@@ -117,7 +117,7 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--paged-attention", action="store_true")
     g.add_argument("--pa-num-blocks", type=int, default=0)
     g.add_argument("--pa-block-size", type=int, default=128)
-    g.add_argument("--quantize-weights", choices=["int8", "float8_e4m3"],
+    g.add_argument("--quantize-weights", choices=["int8", "float8_e4m3", "int4"],
                    default=None, help="weight-only quantization dtype")
     g.add_argument("--kv-cache-scale-mode", choices=["direct", "static"],
                    default=None,

@@ -15,22 +15,26 @@ Measured on v5e (scripts/probe_w4_kernel_bf16.py, 4096x14336 @ bs=64):
   work vs ~80 us for the int8 XLA dot (36 us DMA floor): a ~1.7x win on the
   weight-streaming portion of the decode step.
 
-Layout: **half-split packing**. A logical weight W (..., in, out) packs rows
-i and i+in/2 into one byte:
+Layout: **half-split packing, biased lo nibble**. A logical weight W
+(..., in, out) packs rows i and i+in/2 into one byte:
 
-    packed[..., i, o] = (W[..., i + in/2, o] << 4) | (W[..., i, o] & 0xF)
+    packed[..., i, o] = (W[..., i + in/2, o] << 4) | ((W[..., i, o] + 8) & 0xF)
 
-so the kernel unpacks straight into ONE contiguous (in, bo) VMEM scratch (lo
-nibbles fill rows [0, in/2), hi nibbles rows [in/2, in) — two plain
-sublane-range stores, no interleave shuffle) and runs a SINGLE dot against the
-whole x tile. The first (even/odd, two-dot) design split x into strided
-halves, and the on-chip profile showed XLA materializing those slices through
-transposed relayout fusions at ~26 us each per wd layer call — half the
-kernel's own cost. Under a sharded mesh the q4 leaf takes the XLA dequant path
-(w4_apply), where GSPMD keeps any packing correct.
-
-Mosaic cannot legalize int8 vector shifts, so the nibble arithmetic widens to
-i32 and narrows back (same trick as paged_decode._vmem_cast).
+The lo nibble is stored BIASED (+8, so 0..15 unsigned) while the hi nibble is
+two's complement: ``p & 15`` recovers ``lo + 8`` with a constant bias the
+epilogue removes via ``-8 * rowsum(x_lo)``, and ``p & 0xF0`` IS ``16 * hi`` as
+a signed byte (the hi dot's int32 accumulator shifts right 4, exact) — so the
+in-kernel unpack is two int8 AND ops into one contiguous (in, bo) VMEM scratch
+(two plain sublane-range stores, no interleave shuffle), with no i32
+widen/narrow relayouts and no shifts: Mosaic legalizes neither int8 vector
+shifts nor int8 subtraction, and the widen/narrow relayouts of an i32-domain
+unpack dominated the kernel (measured, see ROUND5_NOTES §14). An earlier
+even/odd two-dot design split x into strided halves; the on-chip profile
+showed XLA materializing those slices through transposed relayout fusions at
+~26 us each per wd layer call. Half-split keeps x whole. Unaligned-hin shapes
+fall back to the i32 unpack (same trick as paged_decode._vmem_cast). Under a
+sharded mesh the q4 leaf takes the XLA dequant path (w4_apply), where GSPMD
+keeps any packing correct.
 
 The stacked (L, in/2, out) payload is NEVER sliced by the layer scan — it
 reaches the kernel whole (closure through `_scan_layers`, see models/base) and
@@ -55,6 +59,11 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+# packed-layout version, recorded in weight artifacts: v2 = half-split with
+# BIASED lo nibble (v1, an interim unbiased even/odd layout, decodes silently
+# wrong under v2 unpack — loaders must refuse mismatched artifacts)
+W4_PACK_VERSION = 2
 
 # out-tile width: measured best at 512 (1024 was ~10% slower, 2048 blew VMEM)
 _BO = 512
@@ -86,7 +95,7 @@ def pack_int4(w, scale_axis: int = -2) -> Dict[str, Any]:
     h = q.shape[-2] // 2
     lo = q[..., :h, :]
     hi = q[..., h:, :]
-    packed = ((hi << 4) | (lo & 0xF)).astype(np.int8)
+    packed = ((hi << 4) | ((lo + 8) & 0xF)).astype(np.int8)
     return {"q4": packed, "s": scale.astype(np.float32)}
 
 
@@ -95,7 +104,7 @@ def unpack_int4(packed) -> "np.ndarray":
     import numpy as np
 
     p = np.asarray(packed).astype(np.int8)
-    lo = ((p & 0xF) ^ 8) - 8
+    lo = (p & 0xF) - 8                # lo nibble is stored biased by +8
     hi = p >> 4                       # numpy int8 >> is arithmetic
     return np.concatenate([lo, hi], axis=-2)
 
@@ -104,29 +113,49 @@ def dequant_w4(qw: Dict[str, Any], dtype=jnp.float32) -> jnp.ndarray:
     """Dequantize a {"q4","s"} leaf back to the logical (..., in, out) weight
     (host/differentiable-free reference path; used by CPU fallbacks + tests)."""
     p = qw["q4"].astype(jnp.int32)
-    lo = ((p & 0xF) ^ 8) - 8
+    lo = (p & 0xF) - 8                # lo nibble is stored biased by +8
     hi = jax.lax.shift_right_arithmetic(p, 4)
     w = jnp.concatenate([lo, hi], axis=-2).astype(jnp.float32)
     return (w * qw["s"]).astype(dtype)
 
 
 def _w4_kernel(lidx_ref, x_ref, sx_ref, p_ref, s_ref, o_ref, w_s, *,
-               int8_acts: bool, hin: int):
+               int8_acts: bool, hin: int, fast_unpack: bool):
     mi = pl.program_id(1)
 
     @pl.when(mi == 0)
     def _unpack():
-        p = p_ref[0].astype(jnp.int32)
-        tgt = jnp.int8 if int8_acts else jnp.bfloat16
-        # half-split: lo nibbles are logical rows [0, hin), hi rows [hin, 2hin)
-        # — two contiguous sublane-range stores, one dot-ready (in, bo) scratch
-        w_s[:hin] = ((((p & 15) ^ 8) - 8)).astype(tgt)
-        w_s[hin:] = jax.lax.shift_right_arithmetic(p, 4).astype(tgt)
+        if fast_unpack:
+            # AND-only unpack, pure int8 vector ops (no i32 widen/narrow
+            # relayouts — those dominated the kernel, see module docstring):
+            # rows [0, hin) hold the UNSIGNED lo nibbles (bias corrected in
+            # the epilogue via -8*rowsum(x_lo)); rows [hin, 2hin) hold
+            # p & 0xF0, which in two's complement IS 16*hi — the hi dot's
+            # int32 accumulator shifts right 4 (exact).
+            p = p_ref[0]
+            w_s[:hin] = p & jnp.int8(15)
+            w_s[hin:] = p & jnp.int8(-16)
+        else:
+            p = p_ref[0].astype(jnp.int32)
+            tgt = jnp.int8 if int8_acts else jnp.bfloat16
+            w_s[:hin] = ((p & 15) - 8).astype(tgt)
+            w_s[hin:] = jax.lax.shift_right_arithmetic(p, 4).astype(tgt)
 
-    pref = jnp.int32 if int8_acts else jnp.float32
-    acc = jax.lax.dot_general(x_ref[...], w_s[...], (((1,), (0,)), ((), ())),
-                              preferred_element_type=pref)
-    acc = acc.astype(jnp.float32) * s_ref[0, 0]
+    if fast_unpack:
+        dims = (((1,), (0,)), ((), ()))
+        acc_l = jax.lax.dot_general(x_ref[:, :hin], w_s[:hin], dims,
+                                    preferred_element_type=jnp.int32)
+        acc_h = jax.lax.dot_general(x_ref[:, hin:], w_s[hin:], dims,
+                                    preferred_element_type=jnp.int32)
+        rs = jnp.sum(x_ref[:, :hin].astype(jnp.int32), axis=1, keepdims=True)
+        acc = (acc_l - 8 * rs
+               + jax.lax.shift_right_arithmetic(acc_h, 4)).astype(jnp.float32)
+    else:
+        pref = jnp.int32 if int8_acts else jnp.float32
+        acc = jax.lax.dot_general(x_ref[...], w_s[...], (((1,), (0,)), ((), ())),
+                                  preferred_element_type=pref
+                                  ).astype(jnp.float32)
+    acc = acc * s_ref[0, 0]
     if int8_acts:
         acc = acc * sx_ref[:, 0:1]
     o_ref[...] = acc.astype(o_ref.dtype)
@@ -153,18 +182,27 @@ def w4_matmul_stacked(
     if in_dim != 2 * hin:
         raise ValueError(f"x in-dim {in_dim} != 2*{hin}")
 
-    int8_acts = m <= _BM
+    # wide (prefill) inputs also take the A8 path when the fast AND-unpack is
+    # available: int8 MXU doubles the bf16 rate (compute binds at prefill) and
+    # the reference's own prefill act-quants (rmsnorm_quant, fp8 there);
+    # per-token int8 act quant error is ~0.4% relative. The bf16 sweep remains
+    # for unaligned hin. TPUINF_W4_PREFILL_BF16 opts out — read at TRACE time
+    # (like TPUINF_STACKED_ATTEND_MIN_BUCKET): set it before the first compile;
+    # a warm executable never re-reads it.
+    import os as _os
+    int8_acts = (m <= _BM
+                 or (hin % 128 == 0
+                     and not _os.environ.get("TPUINF_W4_PREFILL_BF16")))
     if int8_acts:
         xf = x.astype(jnp.float32)
         sx = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1, keepdims=True),
                          1e-8) / 127.0
         xq = jnp.clip(jnp.round(xf / sx), -127, 127).astype(jnp.int8)
         sxp = jnp.broadcast_to(sx.astype(jnp.float32), (m, 128))
-        bm = m
     else:
         xq = x.astype(jnp.bfloat16)
         sxp = jnp.zeros((8, 128), jnp.float32)     # unused
-        bm = _BM
+    bm = min(m, _BM)
 
     # size (bm, bo) so everything fits the default 16 MB scoped-vmem budget —
     # raising the budget via compiler_params backfired (XLA then placed the
@@ -181,19 +219,26 @@ def w4_matmul_stacked(
                 + 2 * hin * bo_ * wsbytes)
 
     bo = _BO if out % _BO == 0 else out
+    can_tile_m = m > _BM                 # decode keeps its single whole-m tile
     while _est(bm, bo) > 15 * 2 ** 20:
-        if bo > 128 and bo % 2 == 0 and out % (bo // 2) == 0:
+        # prefer shrinking bm (when m-tiling): a wide out tile keeps the MXU
+        # fed (bo=128 makes every cell a single-tile-wide dot)
+        if can_tile_m and bm > 64 and (bm > bo or bo <= 128):
+            bm //= 2
+        elif bo > 128 and bo % 2 == 0 and out % (bo // 2) == 0:
             bo //= 2
-        elif not int8_acts and bm > 64:
+        elif can_tile_m and bm > 64:
             bm //= 2
         else:
             break
-    import os as _os
     if _os.environ.get("W4_DEBUG"):
         print(f"[w4] m={m} hin={hin} out={out} int8_acts={int8_acts} "
               f"bm={bm} bo={bo} est={_est(bm, bo)/2**20:.2f}MB", flush=True)
-    if not int8_acts and m % bm:
-        xq = jnp.pad(xq, ((0, bm - m % bm), (0, 0)))
+    if m % bm:
+        pad = bm - m % bm
+        xq = jnp.pad(xq, ((0, pad), (0, 0)))
+        if int8_acts:
+            sxp = jnp.pad(sxp, ((0, pad), (0, 0)))
     mp = xq.shape[0]
     nm = mp // bm
     nt = out // bo
@@ -213,7 +258,10 @@ def w4_matmul_stacked(
             pltpu.VMEM((2 * hin, bo), jnp.int8 if int8_acts else jnp.bfloat16),
         ],
     )
-    kernel = functools.partial(_w4_kernel, int8_acts=int8_acts, hin=hin)
+    # the AND-only unpack needs int8 operands and lane-aligned x halves
+    fast_unpack = int8_acts and hin % 128 == 0
+    kernel = functools.partial(_w4_kernel, int8_acts=int8_acts, hin=hin,
+                               fast_unpack=fast_unpack)
     y = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -285,5 +333,5 @@ def repack_int8_to_int4(qw: Dict[str, Any]) -> Dict[str, Any]:
     h = q4.shape[-2] // 2
     lo = q4[..., :h, :]
     hi = q4[..., h:, :]
-    packed = ((hi << 4) | (lo & 0xF)).astype(np.int8)
+    packed = ((hi << 4) | ((lo + 8) & 0xF)).astype(np.int8)
     return {"q4": packed, "s": np.asarray(qw["s"]) * np.float32(127.0 / 7.0)}

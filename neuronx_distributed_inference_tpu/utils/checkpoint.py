@@ -212,15 +212,31 @@ def save_param_tree(directory: str, params) -> str:
                              "shape": list(arr.shape), "offset": offset,
                              "nbytes": len(data)})
             offset += len(data)
+    from ..ops.w4 import W4_PACK_VERSION
+
     with open(os.path.join(directory, ARTIFACT_MANIFEST), "w") as f:
-        json.dump(manifest, f)
+        # record the int4 packed-layout version: q4 payloads from a different
+        # packing decode silently wrong, so loaders must be able to refuse
+        json.dump({"w4_pack_version": W4_PACK_VERSION, "entries": manifest}, f)
     return directory
 
 
 def load_param_tree(directory: str):
     """Load a param pytree saved by :func:`save_param_tree` (memory-mapped)."""
+    from ..ops.w4 import W4_PACK_VERSION
+
     with open(os.path.join(directory, ARTIFACT_MANIFEST)) as f:
         manifest = json.load(f)
+    if isinstance(manifest, dict):
+        ver = manifest.get("w4_pack_version")
+        manifest = manifest["entries"]
+    else:                               # legacy list-form manifest (pre-int4)
+        ver = None
+    if any(e["key"].endswith("/q4") for e in manifest) and ver != W4_PACK_VERSION:
+        raise ValueError(
+            f"artifact int4 pack version {ver} != current {W4_PACK_VERSION} — "
+            "re-save the artifacts from the source checkpoint (the packed "
+            "nibble layout changed; old payloads would decode silently wrong)")
     payload = np.memmap(os.path.join(directory, ARTIFACT_PAYLOAD), dtype=np.uint8,
                         mode="r")
     tree: Dict[str, Any] = {}

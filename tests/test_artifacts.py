@@ -78,7 +78,7 @@ def test_artifact_save_load_skips_hf_ingest(tmp_path, tiny_llama_hf_config,
 
     orig_qp = q_ops.quantize_params
 
-    def _no_requant(params, dtype, names):
+    def _no_requant(params, dtype, names, **kw):
         # every quantized leaf must arrive ALREADY int8 (pass-through, not a
         # float re-quantization)
         def walk(node):

@@ -365,3 +365,41 @@ def test_chunked_prefill_prefix_race_is_safe(tiny_llama_hf_config):
     results = runner.run_to_completion()
     assert results[ra] == want
     assert results[rb] == want, "request B reused unwritten prefix blocks"
+
+
+def test_paged_cb_int4_matches_dedicated_run(tiny_llama_hf_config):
+    """int4 weights through paged continuous batching (the serving config the
+    bench runs): greedy tokens must match a dedicated plain run of the SAME
+    int4 app — the w4 matmuls ride _scan_layers identically in both paths."""
+    from neuronx_distributed_inference_tpu.config import QuantizationConfig
+
+    def make(paged):
+        tpu_cfg = TpuConfig(
+            batch_size=2, seq_len=96, max_context_length=32, dtype="float32",
+            context_encoding_buckets=[16, 32], token_generation_buckets=[48, 96],
+            is_continuous_batching=True, paged_attention_enabled=paged,
+            pa_num_blocks=48, pa_block_size=8,
+            quantization_config=QuantizationConfig(quantize_weights=True,
+                                                   weight_dtype="int4"),
+        )
+        config = LlamaInferenceConfig(tpu_cfg,
+                                      load_config=load_pretrained_config(
+                                          tiny_llama_hf_config))
+        app = LlamaForCausalLM(None, config)
+        app.load_random(seed=0)
+        return app
+
+    rng = np.random.default_rng(5)
+    prompts4 = [rng.integers(1, 256, size=(n,)).astype(np.int32)
+                for n in (11, 6)]
+    plain = make(paged=False)
+    assert "q4" in plain.params["layers"]["wg"]
+    want = [plain.generate(p[None, :], max_new_tokens=8).tokens[0].tolist()
+            for p in prompts4]
+
+    app = make(paged=True)
+    runner = ContinuousBatchingRunner(app, decode_chunk=4)
+    ids = [runner.submit(p, max_new_tokens=8) for p in prompts4]
+    results = runner.run_to_completion()
+    for i, rid in enumerate(ids):
+        assert results[rid] == want[i], f"request {i} diverged"

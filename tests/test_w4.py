@@ -47,7 +47,7 @@ def test_kernel_decode_matches_integer_reference():
     rng = np.random.default_rng(1)
     L, hin, out, m = 3, 128, 384, 16
     q = rng.integers(-7, 8, (L, 2 * hin, out), dtype=np.int8)
-    packed = ((q[:, hin:] << 4) | (q[:, :hin] & 0xF)).astype(np.int8)
+    packed = ((q[:, hin:] << 4) | ((q[:, :hin] + 8) & 0xF)).astype(np.int8)
     s = rng.uniform(0.5, 2.0, (L, 1, out)).astype(np.float32) * 1e-2
     x = rng.standard_normal((m, 2 * hin)).astype(np.float32)
     xb = jnp.asarray(x).astype(jnp.bfloat16)
@@ -218,3 +218,63 @@ def test_int4_rejects_moe():
     app = MixtralForCausalLM(None, config)
     with pytest.raises(ValueError, match="int4"):
         app.load_random(seed=0)
+
+
+def test_int4_artifacts_roundtrip(tmp_path, tiny_llama_hf_config):
+    """Warm-start artifacts preserve the q4 leaves (no re-pack, identical
+    tokens) — the int4 analog of the artifacts skip-ingest guarantee."""
+    quant = _app(tiny_llama_hf_config, quant="int4")
+    rng = np.random.default_rng(9)
+    ids = rng.integers(1, 256, size=(2, 10)).astype(np.int32)
+    ref = quant.generate(ids, max_new_tokens=6)
+
+    art = str(tmp_path / "artifacts")
+    quant.save_artifacts(art)
+    app2 = LlamaForCausalLM.from_artifacts(art)
+    lp = app2.params["layers"]
+    assert "q4" in lp["wg"] and "q" in lp["wk"]
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(quant.params["layers"]["wg"]["q4"])),
+        np.asarray(jax.device_get(lp["wg"]["q4"])))
+    out2 = app2.generate(ids, max_new_tokens=6)
+    np.testing.assert_array_equal(np.asarray(ref.tokens), np.asarray(out2.tokens))
+
+
+def test_kernel_prefill_a8_mtiled_matches_integer_reference():
+    """Wide-M A8 path with hin % 128 == 0 (every real model): the m-tiled grid
+    with per-tile sxp and scratch reuse across the m sweep must be exact vs an
+    integer reference — this is the path production PREFILL takes."""
+    rng = np.random.default_rng(10)
+    L, hin, out, m = 2, 128, 256, 700      # m > _BM, not a multiple of bm
+    q = rng.integers(-7, 8, (L, 2 * hin, out), dtype=np.int8)
+    packed = ((q[:, hin:] << 4) | ((q[:, :hin] + 8) & 0xF)).astype(np.int8)
+    s = rng.uniform(0.5, 2.0, (L, 1, out)).astype(np.float32) * 1e-2
+    x = rng.standard_normal((m, 2 * hin)).astype(np.float32)
+    xb = jnp.asarray(x).astype(jnp.bfloat16)
+    y = np.asarray(w4_matmul_stacked(xb, jnp.asarray(packed), jnp.asarray(s),
+                                     jnp.int32(0), interpret=True), np.float32)
+    assert y.shape == (m, out)
+    xf = np.asarray(xb, np.float32)
+    sx = np.maximum(np.abs(xf).max(axis=-1, keepdims=True), 1e-8) / 127.0
+    xq = np.clip(np.round(xf / sx), -127, 127).astype(np.int32)
+    ref = (xq @ q[0].astype(np.int32)) * sx * s[0]
+    assert np.abs(y - ref).max() <= np.abs(ref).max() * 2 ** -7
+
+
+def test_artifact_rejects_mismatched_w4_pack_version(tmp_path,
+                                                     tiny_llama_hf_config):
+    """An artifact whose recorded int4 pack version differs from the current
+    layout must refuse to load (old payloads decode silently wrong)."""
+    import json as _json
+
+    from neuronx_distributed_inference_tpu.utils import checkpoint as ckpt_lib
+
+    app = _app(tiny_llama_hf_config, quant="int4")
+    art = str(tmp_path / "artifacts")
+    app.save_artifacts(art)
+    man_path = f"{art}/weights/{ckpt_lib.ARTIFACT_MANIFEST}"
+    man = _json.load(open(man_path))
+    man["w4_pack_version"] = 1
+    _json.dump(man, open(man_path, "w"))
+    with pytest.raises(ValueError, match="pack version"):
+        LlamaForCausalLM.from_artifacts(art)
