@@ -36,7 +36,7 @@ def main():
                          "original_max_position_embeddings": 8192},
         "tie_word_embeddings": False,
     }
-    batch = 64
+    batch = int(os.environ.get("BENCH_BS", "64"))
     w4 = os.environ.get("BENCH_W4", "0") == "1"
     kvd = os.environ.get("BENCH_KVD", "float8_e4m3")
     quant = QuantizationConfig.for_kv_dtype(
