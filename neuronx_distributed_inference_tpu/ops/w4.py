@@ -53,6 +53,7 @@ tile is cached in VMEM scratch at mi==0 and reused across the m sweep.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Any, Dict, Optional
 
 import jax
@@ -65,8 +66,20 @@ from jax.experimental.pallas import tpu as pltpu
 # wrong under v2 unpack — loaders must refuse mismatched artifacts)
 W4_PACK_VERSION = 2
 
-# out-tile width: measured best at 512 (1024 was ~10% slower, 2048 blew VMEM)
-_BO = 512
+# out-tile width cap: r5b sweep on the single-dot kernel — 1024 beats 512 at
+# both bs=64 (12.92 vs 13.48 ms/step) and bs=128 (17.06 vs 17.36); the VMEM
+# model below still shrinks per-shape (wd lands at 256 either way).
+# TPUINF_W4_BO overrides for on-chip sweeps (read at TRACE time: set it before
+# the first compile; a warm executable never re-reads it).
+_BO = 1024
+
+
+def _bo_cap() -> int:
+    try:
+        cap = int(os.environ.get("TPUINF_W4_BO", _BO))
+    except ValueError:
+        cap = _BO
+    return cap if cap >= 128 else _BO
 # m-tile height for wide (prefill) inputs
 _BM = 512
 
@@ -189,10 +202,9 @@ def w4_matmul_stacked(
     # for unaligned hin. TPUINF_W4_PREFILL_BF16 opts out — read at TRACE time
     # (like TPUINF_STACKED_ATTEND_MIN_BUCKET): set it before the first compile;
     # a warm executable never re-reads it.
-    import os as _os
     int8_acts = (m <= _BM
                  or (hin % 128 == 0
-                     and not _os.environ.get("TPUINF_W4_PREFILL_BF16")))
+                     and not os.environ.get("TPUINF_W4_PREFILL_BF16")))
     if int8_acts:
         xf = x.astype(jnp.float32)
         sx = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1, keepdims=True),
@@ -218,20 +230,30 @@ def w4_matmul_stacked(
                      + bm_ * 128 * 4)
                 + 2 * hin * bo_ * wsbytes)
 
-    bo = _BO if out % _BO == 0 else out
+    # out-tile candidates: lane-aligned (128-multiple) divisors of out, widest
+    # first, capped by _BO; odd out dims (no aligned divisor) run whole-out.
+    # Walking divisors (not halving) keeps every candidate aligned — halving
+    # 896 would visit 448, which Mosaic rejects.
+    cap = _bo_cap()
+    bo_cands = [d for d in range(min(out, cap), 127, -128) if out % d == 0]
+    if not bo_cands:
+        bo_cands = [out]
+    boi = 0
+    bo = bo_cands[boi]
     can_tile_m = m > _BM                 # decode keeps its single whole-m tile
     while _est(bm, bo) > 15 * 2 ** 20:
         # prefer shrinking bm (when m-tiling): a wide out tile keeps the MXU
-        # fed (bo=128 makes every cell a single-tile-wide dot)
-        if can_tile_m and bm > 64 and (bm > bo or bo <= 128):
+        # fed (a 128-wide out tile makes every cell a single-tile-wide dot)
+        if can_tile_m and bm > 64 and (bm > bo or boi == len(bo_cands) - 1):
             bm //= 2
-        elif bo > 128 and bo % 2 == 0 and out % (bo // 2) == 0:
-            bo //= 2
+        elif boi < len(bo_cands) - 1:
+            boi += 1
+            bo = bo_cands[boi]
         elif can_tile_m and bm > 64:
             bm //= 2
         else:
             break
-    if _os.environ.get("W4_DEBUG"):
+    if os.environ.get("W4_DEBUG"):
         print(f"[w4] m={m} hin={hin} out={out} int8_acts={int8_acts} "
               f"bm={bm} bo={bo} est={_est(bm, bo)/2**20:.2f}MB", flush=True)
     if m % bm:
