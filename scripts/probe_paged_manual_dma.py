@@ -1,0 +1,254 @@
+"""Probe: manually double-buffered paged decode attend vs the in-repo v2 kernel.
+
+The r5 cell-body probe showed v2's compute is ~141 us/call on resident
+operands while the real kernel costs 335 bf16 / 182 int8 — the gap is
+UN-OVERLAPPED DMA: Mosaic waits for a grid step's BlockSpec fetches before the
+body and only issues the next step's after it. This variant takes the KV pool
+as ANY-space operands and hand-pipelines: at each (row, chunk) step it first
+ISSUES the next step's block copies, then computes on the buffers fetched one
+step ago. Per-block dots (v3-style, no concat).
+
+Shapes: B=64, Hq=32, Hkv=8, D=128, BS=128, table width 8, live 200-900, int8 KV.
+"""
+
+import functools
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+B, HQ, HKV, D, BS, MB, L = 64, 32, 8, 128, 128, 8, 8
+NB = B * MB + 8
+G = 4                      # blocks per chunk
+NCH = MB // G              # chunks per row
+NSTEP = B * NCH            # flat (row, chunk) work items
+NEG_INF = -1e30
+
+
+def manual_paged_attend(q, k_cache, v_cache, positions, layer_idx, block_table,
+                        interpret=False):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, hq, t, d = q.shape
+    n_rep = hq // HKV
+    rows = max(8, n_rep * t)
+    scale = d ** -0.5
+    qg = q.reshape(b, HKV, n_rep * t, d)
+    if rows != n_rep * t:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, rows - n_rep * t), (0, 0)))
+    nrows = HKV * rows
+
+    def kernel(pos_ref, lidx_ref, bt_ref, q_ref, k_any, v_any, o_ref,
+               kbuf, vbuf, m_s, l_s, acc_s, sems):
+        step = pl.program_id(0)
+        ri = step // NCH
+        ci = step % NCH
+        l = lidx_ref[0]
+
+        def issue(s, buf_p):
+            # start the G block fetches of flat work item s into buffer parity
+            r = s // NCH
+            c = s % NCH
+            pos = pos_ref[r]
+            last_live = pos // BS
+            for g in range(G):
+                gg = c * G + g
+                ggc = jnp.minimum(gg, last_live)     # clamp: harmless refetch
+                blk = bt_ref[r, ggc]
+                pltpu.make_async_copy(
+                    k_any.at[l, blk], kbuf.at[buf_p, g], sems.at[buf_p, g, 0]
+                ).start()
+                pltpu.make_async_copy(
+                    v_any.at[l, blk], vbuf.at[buf_p, g], sems.at[buf_p, g, 1]
+                ).start()
+
+        @pl.when(step == 0)
+        def _prologue():
+            issue(0, 0)
+
+        # issue NEXT step's fetches before computing this one
+        @pl.when(step + 1 < NSTEP)
+        def _prefetch():
+            issue(step + 1, (step + 1) % 2)
+
+        p_ = step % 2
+        r = ri
+        pos = pos_ref[r]
+        # wait this step's buffers
+        for g in range(G):
+            pltpu.make_async_copy(k_any.at[0, 0], kbuf.at[p_, g],
+                                  sems.at[p_, g, 0]).wait()
+            pltpu.make_async_copy(v_any.at[0, 0], vbuf.at[p_, g],
+                                  sems.at[p_, g, 1]).wait()
+
+        @pl.when(ci == 0)
+        def _init():
+            m_s[:] = jnp.full_like(m_s, NEG_INF)
+            l_s[:] = jnp.zeros_like(l_s)
+            acc_s[:] = jnp.zeros_like(acc_s)
+
+        qv = q_ref[0].reshape(nrows, d)
+        int8_kv = kbuf.dtype == jnp.int8
+        if int8_kv:
+            qf = qv.astype(jnp.float32)
+            sx = jnp.maximum(jnp.max(jnp.abs(qf), axis=1, keepdims=True),
+                             1e-8) / 127.0
+            qq = jnp.clip(jnp.round(qf / sx), -127, 127).astype(jnp.int8)
+        row_i = jax.lax.broadcasted_iota(jnp.int32, (nrows, HKV * BS), 0)
+        col_i = jax.lax.broadcasted_iota(jnp.int32, (nrows, HKV * BS), 1)
+        same_head = (row_i // rows) == (col_i // BS)
+        col_off = col_i % BS
+
+        run_chunk = ci * G * BS <= pos
+        @pl.when(run_chunk)
+        def _compute():
+            for g in range(G):
+                k = kbuf[p_, g].reshape(HKV * BS, d)
+                v = vbuf[p_, g].reshape(HKV * BS, d)
+                kv_pos = (ci * G + g) * BS + col_off
+                mask = jnp.logical_and(same_head, kv_pos <= pos)
+                if int8_kv:
+                    s = jax.lax.dot_general(
+                        qq, k, (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.int32
+                    ).astype(jnp.float32) * (sx * scale)
+                else:
+                    s = jax.lax.dot_general(
+                        k.astype(qv.dtype), qv, (((1,), (1,)), ((), ()))
+                    ).astype(jnp.float32).T * scale
+                s = jnp.where(mask, s, NEG_INF)
+                m_prev = m_s[:, 0:1]
+                l_prev = l_s[:, 0:1]
+                m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+                alpha = jnp.exp(jnp.minimum(m_prev - m_new, 0.0))
+                p = jnp.exp(s - m_new)
+                p = jnp.where(mask, p, 0.0)
+                l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+                if int8_kv:
+                    pi = jnp.round(p * 127.0).astype(jnp.int8)
+                    pv = jax.lax.dot_general(
+                        pi, v, (((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.int32
+                    ).astype(jnp.float32) * (1.0 / 127.0)
+                else:
+                    pv = jax.lax.dot_general(
+                        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32)
+                acc_s[...] = acc_s[...] * alpha + pv
+                m_s[:] = jnp.broadcast_to(m_new, m_s.shape)
+                l_s[:] = jnp.broadcast_to(l_new, l_s.shape)
+
+        @pl.when(ci == NCH - 1)
+        def _finalize():
+            lv = l_s[:, 0:1]
+            l_safe = jnp.where(lv == 0.0, 1.0, lv)
+            o_ref[0] = (acc_s[...] / l_safe).reshape(HKV, rows, d).astype(
+                o_ref.dtype)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(NSTEP,),
+        in_specs=[
+            pl.BlockSpec((1, HKV, rows, d),
+                         lambda s, pos, lidx, bt: (s // NCH, 0, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, HKV, rows, d),
+                               lambda s, pos, lidx, bt: (s // NCH, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, G, HKV, BS, D), k_cache.dtype),
+            pltpu.VMEM((2, G, HKV, BS, D), v_cache.dtype),
+            pltpu.VMEM((HKV * rows, 128), jnp.float32),
+            pltpu.VMEM((HKV * rows, 128), jnp.float32),
+            pltpu.VMEM((HKV * rows, D), jnp.float32),
+            pltpu.SemaphoreType.DMA((2, G, 2)),
+        ],
+    )
+    import jax
+
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, HKV, rows, d), q.dtype),
+        interpret=interpret,
+    )(positions.astype(jnp.int32), layer_idx.reshape(1).astype(jnp.int32),
+      block_table.astype(jnp.int32), qg, k_cache, v_cache)
+    out = out[:, :, : n_rep * t, :].reshape(b, HKV, n_rep, t, d)
+    return out.reshape(b, hq, t, d)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from neuronx_distributed_inference_tpu.ops.paged_decode import (
+        paged_decode_attention_stacked)
+
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(B, HQ, 1, D)), dtype=jnp.bfloat16) * 0.3
+    positions = jnp.asarray(rng.integers(200, 900, size=(B,)), dtype=jnp.int32)
+    perm = rng.permutation(NB)[: B * MB].reshape(B, MB)
+    bt = jnp.asarray(perm, dtype=jnp.int32)
+    kc = jnp.asarray(rng.integers(-80, 81, size=(L, NB, HKV, BS, D)),
+                     dtype=jnp.int8)
+    vc = jnp.asarray(rng.integers(-80, 81, size=(L, NB, HKV, BS, D)),
+                     dtype=jnp.int8)
+
+    ref = np.asarray(paged_decode_attention_stacked(
+        q, kc, vc, positions, jnp.int32(3), bt), np.float32)
+    got = np.asarray(manual_paged_attend(q, kc, vc, positions, jnp.int32(3), bt),
+                     np.float32)
+    err = np.abs(got - ref).max() / max(np.abs(ref).max(), 1e-6)
+    print("manual vs v2 rel err:", err)
+    assert err < 0.05, err
+
+    @jax.jit
+    def run_v2(q, kc, vc, pos, bt):
+        def step(c, li):
+            o = paged_decode_attention_stacked(q, kc, vc, pos, li, bt)
+            return c + o.astype(jnp.float32).mean(), None
+        return jax.lax.scan(step, 0.0, jnp.arange(L, dtype=jnp.int32))[0]
+
+    @jax.jit
+    def run_manual(q, kc, vc, pos, bt):
+        def step(c, li):
+            o = manual_paged_attend(q, kc, vc, pos, li, bt)
+            return c + o.astype(jnp.float32).mean(), None
+        return jax.lax.scan(step, 0.0, jnp.arange(L, dtype=jnp.int32))[0]
+
+    @jax.jit
+    def _fetch(x):
+        return x.reshape(1)[:1]
+
+    def timeit(fn, iters=10, reps=20):
+        import jax.numpy as jnp
+
+        @jax.jit
+        def reps_fn(q, kc, vc, pos, bt):
+            def body(i, c):
+                return c + fn.__wrapped__(q, kc, vc, pos, bt) if False else \
+                    c + fn(q, kc, vc, pos, bt)
+            return jax.lax.fori_loop(0, reps, body, 0.0)
+
+        np.asarray(_fetch(reps_fn(q, kc, vc, positions, bt)))
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(iters):
+            out = reps_fn(q, kc, vc, positions, bt)
+        np.asarray(_fetch(out))
+        return (time.perf_counter() - t0) / iters / reps / L
+
+    t2 = timeit(run_v2)
+    tm = timeit(run_manual)
+    print(f"v2     : {t2*1e6:7.1f} us/layer")
+    print(f"manual : {tm*1e6:7.1f} us/layer")
+
+
+if __name__ == "__main__":
+    main()
