@@ -159,8 +159,8 @@ class QuantizationConfig:
 
     quantize_weights: bool = False
     # int8 | float8_e4m3 | int4 ("int4" packs the large streaming projections
-    # to 4 bits via the Pallas w4 matmul — ops/w4.py — and keeps the small
-    # ones int8; not supported for MoE expert weights)
+    # — including MoE expert stacks — to 4 bits via the Pallas w4 matmuls,
+    # ops/w4.py, and keeps the small ones int8)
     weight_dtype: str = "int8"
     kv_cache_dtype: Optional[str] = None  # None = same as model dtype
     kv_cache_scale_mode: str = "direct"   # direct | static (fp8/int8 caches)

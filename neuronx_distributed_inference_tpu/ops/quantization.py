@@ -188,9 +188,33 @@ def qeinsum(spec: str, x: jnp.ndarray, w) -> jnp.ndarray:
     if not is_quantized(w):
         return jnp.einsum(spec, x, w)
     if "q4" in w:
-        raise ValueError(
-            "int4 weights are not supported for einsum-consumed (MoE expert) "
-            "weights — quantize MoE families with weight_dtype='int8'")
+        # MoE expert weights (dense all-experts patterns): route to the w4 MoE
+        # kernel on single-device meshes, GSPMD dequant otherwise (see w4_apply)
+        from .w4 import _slice_stacked_w4, dequant_w4, w4_moe_matmul_stacked
+
+        if spec not in ("nh,ehi->eni", "enh,ehi->eni", "eni,eih->enh"):
+            raise ValueError(f"int4 qeinsum supports the dense all-experts MoE "
+                             f"patterns only, got {spec!r}")
+        q4, sc = w["q4"], w["s"]
+        li = w.get("layer")
+        if q4.ndim == 3:               # non-stacked (E, in/2, out)
+            q4 = q4[None]
+            sc = sc[None] if sc.ndim == 3 else sc
+            li = jnp.int32(0)
+        elif li is None:
+            raise ValueError("stacked MoE w4 leaf reached qeinsum without a "
+                             "layer index — int4 expert weights must flow "
+                             "through the layer scan (see _scan_layers)")
+        if not w.get("use_kernel", True):
+            wl = _slice_stacked_w4(
+                q4, sc.reshape(q4.shape[0], q4.shape[1], 1, -1), li)
+            return jnp.einsum(spec, x, dequant_w4(wl, x.dtype))
+        interpret = jax.default_backend() == "cpu"
+        y = w4_moe_matmul_stacked(x, q4,
+                                  sc.reshape(q4.shape[0], q4.shape[1], 1, -1),
+                                  li, per_expert_x=spec.startswith("e"),
+                                  interpret=interpret)
+        return y.astype(x.dtype)
     if "qT" in w:
         # transposed storage (..., out, in): swap the SPEC's last two weight
         # axes so the flag is layout-transparent for any family routing an

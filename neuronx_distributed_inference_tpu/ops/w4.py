@@ -84,6 +84,58 @@ def _bo_cap() -> int:
 _BM = 512
 
 
+def _plan_tiles(m: int, hin: int, out: int, *, xbytes: int, wsbytes: int,
+                tag: str = "") -> tuple:
+    """Pick (bm, bo) so one grid cell fits the default 16 MB scoped-vmem
+    budget — raising the budget via compiler_params backfired (XLA then placed
+    the whole output in scoped vmem and blew the 128 MB chip total).
+
+    The estimator models Mosaic pipelining streamed blocks with up to THREE
+    live buffers (measured: a 2-buffer model overflowed by exactly one buffer
+    generation); the (2*hin, bo) scratch is single-buffered. Out-tile
+    candidates are lane-aligned (128-multiple) DIVISORS of out, widest first,
+    capped by _BO/TPUINF_W4_BO — walking divisors (not halving) keeps every
+    candidate aligned: halving 896 would visit 448, which Mosaic rejects.
+    Odd out dims (no aligned divisor) run whole-out."""
+    bm = min(m, _BM)
+
+    def _est(bm_, bo_):
+        return (3 * (2 * bm_ * hin * xbytes + hin * bo_ + 2 * bm_ * bo_
+                     + bm_ * 128 * 4)
+                + 2 * hin * bo_ * wsbytes)
+
+    cap = _bo_cap()
+    bo_cands = [d for d in range(min(out, cap), 127, -128) if out % d == 0]
+    if not bo_cands:
+        bo_cands = [out]
+    boi = 0
+    bo = bo_cands[boi]
+    can_tile_m = m > _BM                 # decode keeps its single whole-m tile
+    while _est(bm, bo) > 15 * 2 ** 20:
+        # prefer shrinking bm (when m-tiling): a wide out tile keeps the MXU
+        # fed (a 128-wide out tile makes every cell a single-tile-wide dot)
+        if can_tile_m and bm > 64 and (bm > bo or boi == len(bo_cands) - 1):
+            bm //= 2
+        elif boi < len(bo_cands) - 1:
+            boi += 1
+            bo = bo_cands[boi]
+        elif can_tile_m and bm > 64:
+            bm //= 2
+        else:
+            break
+    if os.environ.get("W4_DEBUG"):
+        print(f"[w4] m={m} hin={hin} out={out} {tag} bm={bm} bo={bo} "
+              f"est={_est(bm, bo)/2**20:.2f}MB", flush=True)
+    return bm, bo
+
+
+def _slice_stacked_w4(q4, s, li):
+    """One layer's {"q4","s"} leaf from the stacked payload (the shared
+    slicing convention for the GSPMD dequant fallbacks in w4_apply/qeinsum)."""
+    return {"q4": jax.lax.dynamic_index_in_dim(q4, li, 0, keepdims=False),
+            "s": jax.lax.dynamic_index_in_dim(s, li, 0, keepdims=False)}
+
+
 def is_w4(w) -> bool:
     return isinstance(w, dict) and "q4" in w and "s" in w
 
@@ -132,43 +184,51 @@ def dequant_w4(qw: Dict[str, Any], dtype=jnp.float32) -> jnp.ndarray:
     return (w * qw["s"]).astype(dtype)
 
 
+def _unpack_into(w_s, p, hin: int, int8_acts: bool, fast_unpack: bool):
+    """Unpack one packed (hin, bo) tile into the (2*hin, bo) dot-ready scratch.
+
+    fast path: AND-only unpack, pure int8 vector ops (no i32 widen/narrow
+    relayouts — those dominated the kernel, see module docstring): rows
+    [0, hin) hold the UNSIGNED lo nibbles (bias corrected in the epilogue via
+    -8*rowsum(x_lo)); rows [hin, 2hin) hold p & 0xF0, which in two's
+    complement IS 16*hi — the hi dot's int32 accumulator shifts right 4
+    (exact)."""
+    if fast_unpack:
+        w_s[:hin] = p & jnp.int8(15)
+        w_s[hin:] = p & jnp.int8(-16)
+    else:
+        p32 = p.astype(jnp.int32)
+        tgt = jnp.int8 if int8_acts else jnp.bfloat16
+        w_s[:hin] = ((p32 & 15) - 8).astype(tgt)
+        w_s[hin:] = jax.lax.shift_right_arithmetic(p32, 4).astype(tgt)
+
+
+def _w4_cell(x, w_s, hin: int, int8_acts: bool, fast_unpack: bool):
+    """The shared dot body: (bm, 2hin) x against the unpacked scratch -> f32
+    accumulator (per-channel/per-token scales applied by the caller)."""
+    if fast_unpack:
+        dims = (((1,), (0,)), ((), ()))
+        acc_l = jax.lax.dot_general(x[:, :hin], w_s[:hin], dims,
+                                    preferred_element_type=jnp.int32)
+        acc_h = jax.lax.dot_general(x[:, hin:], w_s[hin:], dims,
+                                    preferred_element_type=jnp.int32)
+        rs = jnp.sum(x[:, :hin].astype(jnp.int32), axis=1, keepdims=True)
+        return (acc_l - 8 * rs
+                + jax.lax.shift_right_arithmetic(acc_h, 4)).astype(jnp.float32)
+    pref = jnp.int32 if int8_acts else jnp.float32
+    return jax.lax.dot_general(x, w_s[...], (((1,), (0,)), ((), ())),
+                               preferred_element_type=pref).astype(jnp.float32)
+
+
 def _w4_kernel(lidx_ref, x_ref, sx_ref, p_ref, s_ref, o_ref, w_s, *,
                int8_acts: bool, hin: int, fast_unpack: bool):
     mi = pl.program_id(1)
 
     @pl.when(mi == 0)
     def _unpack():
-        if fast_unpack:
-            # AND-only unpack, pure int8 vector ops (no i32 widen/narrow
-            # relayouts — those dominated the kernel, see module docstring):
-            # rows [0, hin) hold the UNSIGNED lo nibbles (bias corrected in
-            # the epilogue via -8*rowsum(x_lo)); rows [hin, 2hin) hold
-            # p & 0xF0, which in two's complement IS 16*hi — the hi dot's
-            # int32 accumulator shifts right 4 (exact).
-            p = p_ref[0]
-            w_s[:hin] = p & jnp.int8(15)
-            w_s[hin:] = p & jnp.int8(-16)
-        else:
-            p = p_ref[0].astype(jnp.int32)
-            tgt = jnp.int8 if int8_acts else jnp.bfloat16
-            w_s[:hin] = ((p & 15) - 8).astype(tgt)
-            w_s[hin:] = jax.lax.shift_right_arithmetic(p, 4).astype(tgt)
+        _unpack_into(w_s, p_ref[0], hin, int8_acts, fast_unpack)
 
-    if fast_unpack:
-        dims = (((1,), (0,)), ((), ()))
-        acc_l = jax.lax.dot_general(x_ref[:, :hin], w_s[:hin], dims,
-                                    preferred_element_type=jnp.int32)
-        acc_h = jax.lax.dot_general(x_ref[:, hin:], w_s[hin:], dims,
-                                    preferred_element_type=jnp.int32)
-        rs = jnp.sum(x_ref[:, :hin].astype(jnp.int32), axis=1, keepdims=True)
-        acc = (acc_l - 8 * rs
-               + jax.lax.shift_right_arithmetic(acc_h, 4)).astype(jnp.float32)
-    else:
-        pref = jnp.int32 if int8_acts else jnp.float32
-        acc = jax.lax.dot_general(x_ref[...], w_s[...], (((1,), (0,)), ((), ())),
-                                  preferred_element_type=pref
-                                  ).astype(jnp.float32)
-    acc = acc * s_ref[0, 0]
+    acc = _w4_cell(x_ref[...], w_s, hin, int8_acts, fast_unpack) * s_ref[0, 0]
     if int8_acts:
         acc = acc * sx_ref[:, 0:1]
     o_ref[...] = acc.astype(o_ref.dtype)
@@ -216,46 +276,9 @@ def w4_matmul_stacked(
         sxp = jnp.zeros((8, 128), jnp.float32)     # unused
     bm = min(m, _BM)
 
-    # size (bm, bo) so everything fits the default 16 MB scoped-vmem budget —
-    # raising the budget via compiler_params backfired (XLA then placed the
-    # whole (M, out) OUTPUT in scoped vmem and blew the 128 MB chip total)
-    xbytes = xq.dtype.itemsize
-    wsbytes = 1 if int8_acts else 2
-
-    def _est(bm_, bo_):
-        # Mosaic pipelines streamed blocks with up to THREE live buffers
-        # (measured: a plan sized with a 2-buffer model overflowed by exactly
-        # one buffer generation); the (2*hin, bo) scratch is single-buffered
-        return (3 * (2 * bm_ * hin * xbytes + hin * bo_ + 2 * bm_ * bo_
-                     + bm_ * 128 * 4)
-                + 2 * hin * bo_ * wsbytes)
-
-    # out-tile candidates: lane-aligned (128-multiple) divisors of out, widest
-    # first, capped by _BO; odd out dims (no aligned divisor) run whole-out.
-    # Walking divisors (not halving) keeps every candidate aligned — halving
-    # 896 would visit 448, which Mosaic rejects.
-    cap = _bo_cap()
-    bo_cands = [d for d in range(min(out, cap), 127, -128) if out % d == 0]
-    if not bo_cands:
-        bo_cands = [out]
-    boi = 0
-    bo = bo_cands[boi]
-    can_tile_m = m > _BM                 # decode keeps its single whole-m tile
-    while _est(bm, bo) > 15 * 2 ** 20:
-        # prefer shrinking bm (when m-tiling): a wide out tile keeps the MXU
-        # fed (a 128-wide out tile makes every cell a single-tile-wide dot)
-        if can_tile_m and bm > 64 and (bm > bo or boi == len(bo_cands) - 1):
-            bm //= 2
-        elif boi < len(bo_cands) - 1:
-            boi += 1
-            bo = bo_cands[boi]
-        elif can_tile_m and bm > 64:
-            bm //= 2
-        else:
-            break
-    if os.environ.get("W4_DEBUG"):
-        print(f"[w4] m={m} hin={hin} out={out} int8_acts={int8_acts} "
-              f"bm={bm} bo={bo} est={_est(bm, bo)/2**20:.2f}MB", flush=True)
+    bm, bo = _plan_tiles(m, hin, out, xbytes=xq.dtype.itemsize,
+                         wsbytes=1 if int8_acts else 2,
+                         tag=f"int8_acts={int8_acts}")
     if m % bm:
         pad = bm - m % bm
         xq = jnp.pad(xq, ((0, pad), (0, 0)))
@@ -317,9 +340,9 @@ def w4_apply(x: jnp.ndarray, w: Dict[str, Any],
         li = jnp.int32(0)
     else:
         if q4.ndim != 3:
-            raise ValueError(f"w4 payload must be (in/2, out) or (L, in/2, out), "
-                             f"got {q4.shape} — int4 is not supported for "
-                             f"einsum-consumed (MoE expert) weights")
+            raise ValueError(f"w4_apply takes (in/2, out) or (L, in/2, out) "
+                             f"payloads, got {q4.shape} — 4-D stacked expert "
+                             f"weights route through qeinsum's MoE patterns")
         li = w.get("layer")
         if li is None:
             raise ValueError("stacked w4 leaf reached w4_apply without a layer "
@@ -327,9 +350,8 @@ def w4_apply(x: jnp.ndarray, w: Dict[str, Any],
                              "scan's closure path (see _scan_layers)")
         s = s.reshape(q4.shape[0], 1, -1)
         if not use_kernel:
-            wl = {"q4": jax.lax.dynamic_index_in_dim(q4, li, 0, keepdims=False),
-                  "s": jax.lax.dynamic_index_in_dim(s, li, 0, keepdims=False)}
-            return (x @ dequant_w4(wl, x.dtype)).astype(x.dtype)
+            return (x @ dequant_w4(_slice_stacked_w4(q4, s, li), x.dtype)
+                    ).astype(x.dtype)
     lead = x.shape[:-1]
     m = 1
     for d in lead:
@@ -357,3 +379,112 @@ def repack_int8_to_int4(qw: Dict[str, Any]) -> Dict[str, Any]:
     hi = q4[..., h:, :]
     packed = ((hi << 4) | ((lo + 8) & 0xF)).astype(np.int8)
     return {"q4": packed, "s": np.asarray(qw["s"]) * np.float32(127.0 / 7.0)}
+
+
+def _w4_moe_kernel(lidx_ref, x_ref, sx_ref, p_ref, s_ref, o_ref, w_s, *,
+                   int8_acts: bool, hin: int, fast_unpack: bool,
+                   per_expert_x: bool):
+    mi = pl.program_id(2)
+
+    @pl.when(mi == 0)
+    def _unpack():
+        _unpack_into(w_s, p_ref[0, 0], hin, int8_acts, fast_unpack)
+
+    x = x_ref[0] if per_expert_x else x_ref[...]
+    acc = _w4_cell(x, w_s, hin, int8_acts, fast_unpack) * s_ref[0, 0, 0]
+    if int8_acts:
+        sx = sx_ref[0] if per_expert_x else sx_ref[...]
+        acc = acc * sx[:, 0:1]
+    o_ref[0] = acc.astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("per_expert_x", "interpret"))
+def w4_moe_matmul_stacked(
+    x: jnp.ndarray,              # (N, in) shared or (E, N, in) per-expert
+    packed: jnp.ndarray,         # (L, E, in/2, out) int8 — FULL stacked payload
+    scales: jnp.ndarray,         # (L, E, 1, out) f32
+    layer_idx: jnp.ndarray,      # () int32
+    per_expert_x: bool = False,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Dense all-experts MoE matmul from the stacked int4-packed expert weights
+    (the ``nh,ehi->eni`` / ``eni,eih->enh`` qeinsum patterns, ops/moe.py).
+    Same design as w4_matmul_stacked with an expert grid dimension; every
+    (expert, out-tile) unpacks once and is swept over the m tiles.
+    Returns (E, N, out) bf16."""
+    l, e, hin, out = packed.shape
+    n = x.shape[-2]
+    if x.shape[-1] != 2 * hin:
+        raise ValueError(f"x in-dim {x.shape[-1]} != 2*{hin}")
+
+    # same activation-dtype rule as the dense path (incl. the
+    # TPUINF_W4_PREFILL_BF16 opt-out) — see w4_matmul_stacked
+    int8_acts = (n <= _BM
+                 or (hin % 128 == 0
+                     and not os.environ.get("TPUINF_W4_PREFILL_BF16")))
+    if int8_acts:
+        xf = x.astype(jnp.float32)
+        sx = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1, keepdims=True),
+                         1e-8) / 127.0
+        xq = jnp.clip(jnp.round(xf / sx), -127, 127).astype(jnp.int8)
+        sxp = jnp.broadcast_to(sx.astype(jnp.float32), x.shape[:-1] + (128,))
+    else:
+        xq = x.astype(jnp.bfloat16)
+        sxp = jnp.zeros(x.shape[:-2] + (8, 128), jnp.float32)   # unused
+
+    bm, bo = _plan_tiles(n, hin, out, xbytes=xq.dtype.itemsize,
+                         wsbytes=1 if int8_acts else 2,
+                         tag=f"moe int8_acts={int8_acts}")
+    if n % bm:
+        pad = bm - n % bm
+        width = [(0, 0)] * (x.ndim - 2) + [(0, pad), (0, 0)]
+        xq = jnp.pad(xq, width)
+        sxp = jnp.pad(sxp, width)
+    np_ = xq.shape[-2]
+    nm = np_ // bm
+    nt = out // bo
+    fast_unpack = int8_acts and hin % 128 == 0
+    sbm = bm if int8_acts else 8
+
+    if per_expert_x:
+        x_spec = pl.BlockSpec((1, bm, 2 * hin),
+                              lambda ei, ti, mi, lidx: (ei, mi, 0))
+        sx_spec = pl.BlockSpec(
+            (1, sbm, 128),
+            (lambda ei, ti, mi, lidx: (ei, mi, 0)) if int8_acts
+            else (lambda ei, ti, mi, lidx: (ei, 0, 0)))
+    else:
+        x_spec = pl.BlockSpec((bm, 2 * hin), lambda ei, ti, mi, lidx: (mi, 0))
+        sx_spec = pl.BlockSpec(
+            (sbm, 128),
+            (lambda ei, ti, mi, lidx: (mi, 0)) if int8_acts
+            else (lambda ei, ti, mi, lidx: (0, 0)))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(e, nt, nm),
+        in_specs=[
+            x_spec,
+            sx_spec,
+            pl.BlockSpec((1, 1, hin, bo),
+                         lambda ei, ti, mi, lidx: (lidx[0], ei, 0, ti)),
+            pl.BlockSpec((1, 1, 1, bo),
+                         lambda ei, ti, mi, lidx: (lidx[0], ei, 0, ti)),
+        ],
+        out_specs=pl.BlockSpec((1, bm, bo),
+                               lambda ei, ti, mi, lidx: (ei, mi, ti)),
+        scratch_shapes=[
+            pltpu.VMEM((2 * hin, bo), jnp.int8 if int8_acts else jnp.bfloat16),
+        ],
+    )
+    kernel = functools.partial(_w4_moe_kernel, int8_acts=int8_acts, hin=hin,
+                               fast_unpack=fast_unpack,
+                               per_expert_x=per_expert_x)
+    y = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((e, np_, out), jnp.bfloat16),
+        interpret=interpret,
+    )(layer_idx.reshape(1).astype(jnp.int32), xq, sxp, packed,
+      scales.reshape(l, e, 1, out).astype(jnp.float32))
+    return y[:, :n] if np_ != n else y

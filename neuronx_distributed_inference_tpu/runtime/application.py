@@ -554,12 +554,6 @@ class TpuModelForCausalLM:
             from ..ops.quantization import (quantize_params,
                                             transpose_attention_stacks)
 
-            if (qcfg.weight_dtype == "int4"
-                    and getattr(self.arch_args, "moe", None) is not None):
-                raise ValueError(
-                    "weight_dtype='int4' is not supported for MoE families "
-                    "(expert weights flow through qeinsum, which has no w4 "
-                    "kernel path) — use 'int8'")
             # per-leaf: already-quantized leaves pass through (pre-quantized ckpts)
             host_params = quantize_params(host_params, qcfg.weight_dtype,
                                           names=self.quantized_param_names(),

@@ -123,12 +123,24 @@ def test_quantize_params_int4_split():
     assert again["layers"]["wq"] is out["layers"]["wq"]
 
 
-def test_qeinsum_rejects_int4():
+def test_qeinsum_int4_moe_patterns():
+    """qeinsum routes the dense all-experts MoE patterns to the w4 MoE kernel
+    (dequant fallback checked via use_kernel=False) and rejects other specs."""
+    from neuronx_distributed_inference_tpu.ops.w4 import dequant_w4
+
     rng = np.random.default_rng(5)
-    qw = {k: jnp.asarray(v) for k, v in
-          pack_int4(rng.normal(size=(3, 16, 8)).astype(np.float32)).items()}
-    with pytest.raises(ValueError, match="int4"):
-        qeinsum("nh,ehi->eni", jnp.zeros((5, 16)), qw)
+    w = rng.normal(size=(3, 16, 8)).astype(np.float32) * 0.1   # (E, H, I)
+    qw = {k: jnp.asarray(v) for k, v in pack_int4(w).items()}
+    x = jnp.asarray(rng.standard_normal((5, 16)).astype(np.float32))
+    got = np.asarray(qeinsum("nh,ehi->eni", x, qw), np.float32)
+    want = np.einsum("nh,ehi->eni", np.asarray(x),
+                     np.asarray(dequant_w4(qw)))
+    assert _cosine(got, want) > 0.999
+    gotd = np.asarray(qeinsum("nh,ehi->eni", x, {**qw, "use_kernel": False}),
+                      np.float32)
+    assert _cosine(gotd, want) > 0.9999
+    with pytest.raises(ValueError, match="patterns"):
+        qeinsum("nk,nke->ne", x, qw)
 
 
 def test_quantize_tensor_int4_dispatch():
@@ -204,7 +216,10 @@ def test_int4_llama_tp2_dequant_path_matches_dequantized_twin(
     np.testing.assert_array_equal(np.asarray(out.tokens), np.asarray(out2.tokens))
 
 
-def test_int4_rejects_moe():
+def test_int4_moe_matches_dequant_twin():
+    """Mixtral-class int4: expert weights pack to 4-D q4 stacks and serve
+    through the w4 MoE kernel (tp=2 here -> the exact GSPMD dequant route;
+    see _dequantized_twin_params for the int8-leaf caveat)."""
     from neuronx_distributed_inference_tpu.models.mixtral.modeling_mixtral import (
         MixtralForCausalLM, MixtralInferenceConfig)
 
@@ -216,16 +231,38 @@ def test_int4_rejects_moe():
         "rope_theta": 10000.0, "tie_word_embeddings": False,
         "num_local_experts": 4, "num_experts_per_tok": 2,
     }
-    tpu_cfg = TpuConfig(
-        batch_size=1, seq_len=32, max_context_length=16, dtype="float32",
-        context_encoding_buckets=[16], token_generation_buckets=[32],
-        quantization_config=QuantizationConfig(quantize_weights=True,
-                                               weight_dtype="int4"))
-    config = MixtralInferenceConfig(tpu_cfg,
-                                    load_config=load_pretrained_config(hf_cfg))
-    app = MixtralForCausalLM(None, config)
-    with pytest.raises(ValueError, match="int4"):
-        app.load_random(seed=0)
+
+    def make(quant, tp):
+        tpu_cfg = TpuConfig(
+            batch_size=1, seq_len=32, max_context_length=16, dtype="float32",
+            tp_degree=tp,
+            context_encoding_buckets=[16], token_generation_buckets=[32],
+            quantization_config=QuantizationConfig(quantize_weights=quant,
+                                                   weight_dtype="int4"))
+        config = MixtralInferenceConfig(
+            tpu_cfg, load_config=load_pretrained_config(hf_cfg))
+        app = MixtralForCausalLM(None, config)
+        return app
+
+    ids = np.array([[5, 9, 2, 7]], dtype=np.int32)
+
+    # 1-device mesh: the MoE kernel path (interpret) runs end to end
+    kapp = make(True, tp=1)
+    kapp.load_random(seed=0)
+    assert "q4" in kapp.params["layers"]["wg"]
+    assert kapp.params["layers"]["wg"]["q4"].ndim == 4      # (L, E, H/2, I)
+    kout = kapp.generate(ids, max_new_tokens=4)
+    assert kout.tokens.shape == (1, 4)
+
+    # tp=2 mesh: dequant route; tokens must match a dequantized twin exactly
+    quant = make(True, tp=2)
+    quant.load_random(seed=0)
+    out = quant.generate(ids, max_new_tokens=4)
+    twin = make(False, tp=2)
+    twin.load_random(seed=0)
+    twin.load_host_params(_dequantized_twin_params(quant.params))
+    out2 = twin.generate(ids, max_new_tokens=4)
+    np.testing.assert_array_equal(np.asarray(out.tokens), np.asarray(out2.tokens))
 
 
 def test_int4_artifacts_roundtrip(tmp_path, tiny_llama_hf_config):
