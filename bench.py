@@ -464,8 +464,27 @@ def main() -> None:
                     paged_app, hf_cfg,
                     paged_app.tpu_config.max_batch_size)
                 extra.update(spec)
+                paged = extra.get("paged_serving_tok_per_s")
+                if paged:
+                    extra["paged_spec_ceiling_vs_paged"] = round(
+                        spec["paged_spec_full_accept_tok_per_s"] / paged, 3)
+                    if "paged_spec_floor_tok_per_s" in spec:
+                        extra["paged_spec_floor_vs_paged"] = round(
+                            spec["paged_spec_floor_tok_per_s"] / paged, 3)
             except Exception as e:
                 _note(f"spec serving phase failed: {e}")
+            print(json.dumps(result), flush=True)
+
+        if paged_app is not None and _remaining() > 180:
+            # self-draft variant (VERDICT r5 #5): draft = target drives the
+            # REAL accept/commit/rollback path at (near-)full acceptance —
+            # the ceiling stops being arithmetic and becomes a measurement
+            _note("phase: self-draft speculative serving (accept-path check)")
+            try:
+                extra.update(_paged_spec_selfdraft(
+                    paged_app, paged_app.tpu_config.max_batch_size))
+            except Exception as e:
+                _note(f"self-draft spec phase failed: {e}")
 
     # FINAL EMIT: same schema, enriched extra. The driver parses the last JSON
     # line; if the process was killed earlier, the early emit already landed.
@@ -549,13 +568,53 @@ def _paged_serving_throughput(hf_cfg, batch):
     return sync, async_, app
 
 
+def _spec_runner_measure(runner, batch, k, n_chunks=4, max_new=760):
+    """Warm + measure a spec CB runner; returns (tok_per_s, accept_mean,
+    iter_ms, full_accept_tok_per_s)."""
+    import time as _time
+
+    rng = np.random.default_rng(0)
+    for _ in range(batch):
+        runner.submit(rng.integers(1, 100000, size=(200,)).astype(np.int32),
+                      max_new_tokens=max_new)
+    for _ in range(2):                         # place + warm the spec chunk
+        runner.step()
+
+    h0 = runner.acceptance_counts.copy()
+    i0 = runner.spec_iters_run
+    n_tokens = 0
+    t0 = _time.time()
+    for _ in range(n_chunks):
+        em = runner.step()
+        n_tokens += sum(len(v) for v in em.values())
+    wall = _time.time() - t0
+    # actually-dispatched iterations (step() clamps a chunk below spec_chunk
+    # near request tails — assuming n_chunks * spec_chunk would bias iter_ms
+    # and the ceiling low whenever the budget runs out mid-chunk)
+    iters = max(1, runner.spec_iters_run - i0)
+    hist = runner.acceptance_counts - h0       # measured window only
+    accept_mean = float((hist * (np.arange(k) + 1)).sum() / max(1, hist.sum()))
+    iter_ms = 1000.0 * wall / iters
+    return (round(n_tokens / wall, 1), round(accept_mean, 2),
+            round(iter_ms, 2), round(batch * k / (wall / iters), 1))
+
+
 def _paged_spec_throughput(app, hf_cfg, batch):
     """Fused speculation through ContinuousBatchingRunner at the serving
     config: the 8B target serves with a small (8-layer, 2048-hidden) draft,
-    both on the target app's quantization config.
-    Returns the extra-dict entries (floor/ceiling/acceptance/iteration time)."""
-    import time as _time
+    both on the target app's quantization config (int4 weights through the
+    W4A8 kernels, int8-KV paged pools for BOTH models).
+    Returns the extra-dict entries (floor/ceiling/acceptance/iteration time).
 
+    Three measurements:
+    - raw spec chunks (adaptive OFF): iteration time + the acceptance-
+      independent full-accept CEILING;
+    - adaptive floor (spec_adaptive=True): with random weights acceptance is
+      ~chance, so the runner detects the loss and serves PLAIN chunks — the
+      measured floor is ~plain-paged throughput instead of ~plain/k;
+    - self-draft (draft = target, see _paged_spec_selfdraft): full acceptance
+      through the REAL accept/commit path, validating the ceiling arithmetic.
+    """
     from neuronx_distributed_inference_tpu.config import (
         TpuConfig, load_pretrained_config)
     from neuronx_distributed_inference_tpu.models.llama.modeling_llama import (
@@ -590,38 +649,83 @@ def _paged_spec_throughput(app, hf_cfg, batch):
         draft_hf, seed=1, weight_dtype=quant.weight_dtype))
     # no calibration (see _paged_serving_throughput): with RANDOM weights the
     # acceptance floor is ~chance regardless of draft cache fidelity, and the
-    # full-accept ceiling is acceptance-independent — the two numbers reported
+    # full-accept ceiling is acceptance-independent — the numbers reported
 
-    runner = ContinuousBatchingRunner(app, draft=draft, speculation_length=k,
-                                      spec_chunk=8)
-    rng = np.random.default_rng(0)
-    for _ in range(batch):
-        runner.submit(rng.integers(1, 100000, size=(200,)).astype(np.int32),
-                      max_new_tokens=600)
-    for _ in range(2):                         # place + warm the spec chunk
-        runner.step()
-
-    n_tokens = 0
-    n_chunks = 4
-    t0 = _time.time()
-    for _ in range(n_chunks):
-        em = runner.step()
-        n_tokens += sum(len(v) for v in em.values())
-    wall = _time.time() - t0
-    iters = n_chunks * runner.spec_chunk
-    hist = runner.acceptance_counts
-    accept_mean = float((hist * (np.arange(k) + 1)).sum() / max(1, hist.sum()))
-    iter_ms = 1000.0 * wall / iters
-    return {
+    # spec_chunk default == decode_chunk (32): the per-ITERATION dispatch
+    # amortization matches plain decode's per-step share (~3.4 ms at the
+    # measured ~109 ms floor) instead of the old 8-iteration chunks (~13.6)
+    runner = ContinuousBatchingRunner(app, draft=draft, speculation_length=k)
+    tok_s, accept_mean, iter_ms, ceiling = _spec_runner_measure(
+        runner, batch, k)
+    out = {
         # measured committed-token throughput at random-weight acceptance
-        "paged_spec_tok_per_s": round(n_tokens / wall, 1),
-        "paged_spec_accept_mean": round(accept_mean, 2),
-        "paged_spec_iter_ms": round(iter_ms, 2),
+        "paged_spec_tok_per_s": tok_s,
+        "paged_spec_accept_mean": accept_mean,
+        "paged_spec_iter_ms": iter_ms,
         # the fused iteration costs the same regardless of acceptance: at full
         # acceptance every iteration commits K tokens per row
-        "paged_spec_full_accept_tok_per_s": round(
-            batch * k / (wall / iters), 1),
+        "paged_spec_full_accept_tok_per_s": ceiling,
+        "paged_spec_chunk_iters": runner.spec_chunk,
     }
+    _drain_runner(runner)
+
+    # --- adaptive floor: worst-case (chance-acceptance) serving rate -------
+    # spec_adaptive falls back to plain decode chunks when measured
+    # acceptance cannot pay for the spec iteration, so the serving FLOOR is
+    # ~plain-paged throughput (minus the periodic re-probe chunk)
+    try:
+        _note("spec phase: adaptive floor (spec_adaptive=True)")
+        runner = ContinuousBatchingRunner(app, draft=draft,
+                                          speculation_length=k,
+                                          spec_adaptive=True)
+        tok_s, _, _, _ = _spec_runner_measure(runner, batch, k, n_chunks=6)
+        out["paged_spec_floor_tok_per_s"] = tok_s
+    except Exception as e:  # the raw numbers above still stand
+        _note(f"adaptive-floor measurement failed: {e}")
+    finally:
+        _drain_runner(runner)
+    return out
+
+
+def _drain_runner(runner) -> None:
+    """Release a CB runner's device pools (target + draft) for the next phase."""
+    import gc
+
+    runner.cache = None
+    runner.d_cache = None
+    gc.collect()
+
+
+def _paged_spec_selfdraft(app, batch):
+    """Self-draft speculation: draft IS the target (same weights object — no
+    extra HBM for params; the draft needs its own paged pool). Greedy
+    acceptance then accepts (nearly) everything THROUGH THE REAL
+    accept/commit/rollback path, so the measured committed-token throughput
+    validates the full-accept ceiling arithmetic (VERDICT r5 #5: the ceiling
+    was previously pure arithmetic; this drives the actual accept path).
+    Within ~10% of the ceiling = validated; any residual gap is the cost the
+    ceiling arithmetic hides (host replay, acceptance select, numeric-tie
+    argmax flips between the 1-token draft pass and the K-wide verify)."""
+    from neuronx_distributed_inference_tpu.runtime.continuous_batching import (
+        ContinuousBatchingRunner)
+
+    k = 4
+    runner = ContinuousBatchingRunner(app, draft=app, speculation_length=k)
+    try:
+        tok_s, accept_mean, iter_ms, ceiling = _spec_runner_measure(
+            runner, batch, k)
+        return {
+            "paged_spec_selfdraft_tok_per_s": tok_s,
+            "paged_spec_selfdraft_accept_mean": accept_mean,
+            "paged_spec_selfdraft_iter_ms": iter_ms,
+            # the self-draft iteration runs the FULL target as its own draft
+            # (k-1 extra target passes), so it validates the accept path
+            # against its OWN measured-iteration ceiling, not the small-draft
+            # one: at full acceptance this ratio should be within ~10% of 1.0
+            "paged_spec_selfdraft_vs_own_ceiling": round(tok_s / ceiling, 3),
+        }
+    finally:
+        _drain_runner(runner)
 
 
 if __name__ == "__main__":

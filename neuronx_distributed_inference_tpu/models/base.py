@@ -527,6 +527,19 @@ def _mlp(lp: Params, args: ModelArchArgs, hn: jnp.ndarray, mesh, rules,
     return down
 
 
+def shard_map_compat(local_fn, *, mesh, in_specs, out_specs):
+    """shard_map with the replication check off, across jax versions (current
+    jax exposes `jax.shard_map(..., check_vma=)`; older releases have
+    `jax.experimental.shard_map.shard_map(..., check_rep=)`)."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(local_fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map as _sm
+
+    return _sm(local_fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=False)
+
+
 def _shard_mapped(local_fn, mesh, rules, in_logical, out_logical):
     """shard_map a Pallas-kernel wrapper over the mesh with logical-axis operand
     specs.
@@ -550,9 +563,9 @@ def _shard_mapped(local_fn, mesh, rules, in_logical, out_logical):
 
     out_specs = (tuple(spec(lg) for lg in out_logical)
                  if isinstance(out_logical, list) else spec(out_logical))
-    return jax.shard_map(local_fn, mesh=mesh,
-                         in_specs=tuple(spec(lg) for lg in in_logical),
-                         out_specs=out_specs, check_vma=False)
+    return shard_map_compat(local_fn, mesh=mesh,
+                            in_specs=tuple(spec(lg) for lg in in_logical),
+                            out_specs=out_specs)
 
 
 _DECODE_NEW_KV = ("decode_batch", "decode_kv_heads", None, None)
@@ -768,10 +781,10 @@ def _flash_decoding_step(q, k_new, v_new, k_cache, v_cache, positions,
     new_spec = logical_to_spec(("decode_batch", "decode_kv_heads", None, None), r)
     kv_spec = logical_to_spec(("decode_batch", "decode_kv_heads", "kv_seq", None), r)
     pos_spec = logical_to_spec(("decode_batch",), r)
-    fn = jax.shard_map(_local, mesh=mesh,
-                       in_specs=(q_spec, new_spec, new_spec, kv_spec, kv_spec,
-                                 pos_spec),
-                       out_specs=(q_spec, kv_spec, kv_spec), check_vma=False)
+    fn = shard_map_compat(_local, mesh=mesh,
+                          in_specs=(q_spec, new_spec, new_spec, kv_spec,
+                                    kv_spec, pos_spec),
+                          out_specs=(q_spec, kv_spec, kv_spec))
     return fn(q, k_new, v_new, k_cache, v_cache, positions)
 
 
@@ -1542,9 +1555,27 @@ def _lm_head(params: Params, args: ModelArchArgs, h, mesh, rules) -> jnp.ndarray
 
 
 def _finalize_logits(params, args: ModelArchArgs, h, cache, mesh, rules,
-                     return_hidden=False, caps=None):
+                     return_hidden=False, caps=None, skip_logits=False):
     """Shared decode epilogue: final norm + lm_head, assembling the
-    (logits, cache[, hidden][, captures]) return tuple every decode path shares."""
+    (logits, cache[, hidden][, captures]) return tuple every decode path shares.
+
+    ``skip_logits`` (static) drops the final norm + lm_head entirely and
+    returns ``(None, cache, ...)`` — for KV-only forwards whose logits are
+    never read (the last draft step of a speculative iteration runs only so
+    its KV lands before a possible full accept; streaming the lm_head and
+    materializing a (B, V) logits tensor for it is pure waste)."""
+    if skip_logits:
+        if return_hidden:
+            # every other path returns the POST-final-norm hidden; handing a
+            # pre-norm hidden out here would silently corrupt e.g. EAGLE
+            # conditioning built on it
+            raise ValueError("skip_logits does not compose with return_hidden "
+                             "(the final norm is skipped along with the "
+                             "lm_head, so the hidden would be pre-norm)")
+        res = (None, cache)
+        if caps is not None:
+            res = res + (caps,)
+        return res
     h = _norm(h, params["final_norm"], args, params.get("final_norm_b"))
     logits = _lm_head(params, args, h, mesh, rules)
     res = (logits, cache)
@@ -1686,6 +1717,9 @@ def decode_forward(
     flash_decoding: bool = False,
     # static layer indices whose output hiddens are captured (EAGLE3 conditioning)
     capture_layers: Optional[Tuple[int, ...]] = None,
+    # static: KV-only forward — skip final norm + lm_head, logits return None
+    # (the k-th draft step of a fused speculative iteration)
+    skip_logits: bool = False,
 ) -> Tuple[jnp.ndarray, kvcache.KVCache]:
     """Token generation: returns (logits (B, T, V) fp32, updated cache).
 
@@ -1757,7 +1791,7 @@ def decode_forward(
                 cache, position_ids, decode_bucket, mesh, rules,
                 adapter_ids=adapter_ids)
             return _finalize_logits(params, args, h, cache, mesh, rules,
-                                    return_hidden)
+                                    return_hidden, skip_logits=skip_logits)
         slopes = params.get("alibi_slopes") if args.alibi else None
         if paged is not None:
             # ragged paged serving hot path: Pallas block-table kernels, cache
@@ -1767,7 +1801,7 @@ def decode_forward(
                 slot_mapping, mesh, rules, adapter_ids=adapter_ids,
                 alibi_slopes=slopes)
             return _finalize_logits(params, args, h, cache, mesh, rules,
-                                    return_hidden)
+                                    return_hidden, skip_logits=skip_logits)
         kv_pos_k = jnp.arange(decode_bucket)[None, None, None, :]
         mask_k = kv_pos_k <= pos_grid[:, None, :, None]
         if args.sliding_window is not None:
@@ -1778,7 +1812,7 @@ def decode_forward(
             decode_bucket=decode_bucket, mesh=mesh, rules=rules,
             adapter_ids=adapter_ids, alibi_slopes=slopes)
         return _finalize_logits(params, args, h, cache, mesh, rules,
-                                return_hidden)
+                                return_hidden, skip_logits=skip_logits)
     kv_pos = jnp.arange(decode_bucket)[None, None, None, :]
     q_pos = pos_grid[:, None, :, None]
     if tree is None:
@@ -1817,7 +1851,7 @@ def decode_forward(
             positions=position_ids, decode_bucket=decode_bucket, mesh=mesh,
             rules=rules, adapter_ids=adapter_ids)
         return _finalize_logits(params, args, h, cache, mesh, rules,
-                                return_hidden)
+                                return_hidden, skip_logits=skip_logits)
     if sliding is not None:
         mask = sliding
 
@@ -1835,7 +1869,7 @@ def decode_forward(
             block_table, slot_mapping, mesh, rules, adapter_ids=adapter_ids,
             attn_bias=attn_bias)
         return _finalize_logits(params, args, h, cache, mesh, rules,
-                                return_hidden)
+                                return_hidden, skip_logits=skip_logits)
     out = _run_stack(params, args, h, cos, sin, mask, cache,
                      positions=position_ids, decode_bucket=decode_bucket,
                      mesh=mesh, rules=rules,
@@ -1843,5 +1877,5 @@ def decode_forward(
                      window_row=window_row, capture_layers=capture_layers,
                      flash_decoding=flash_decoding, attn_bias=attn_bias)
     return _finalize_logits(params, args, out[0], out[1], mesh, rules,
-                            return_hidden,
+                            return_hidden, skip_logits=skip_logits,
                             caps=out[2] if capture_layers else None)

@@ -295,10 +295,14 @@ def eagle_decode_forward(
     rules=None,
     block_table=None,          # (B, MB) paged: per-seq block ids
     slot_mapping=None,         # (B, T) paged: flat write slots
+    skip_logits: bool = False,  # static: KV-only step — skip the (target) lm_head
 ) -> Tuple[jnp.ndarray, jnp.ndarray, kvcache.KVCache]:
     """Draft token generation. Returns (logits (B, T, V), draft hiddens (B, T, H),
     cache). With ``block_table``/``slot_mapping`` the draft cache is paged
-    (CB serving; reads gather through the table)."""
+    (CB serving; reads gather through the table). ``skip_logits`` returns None
+    logits — the k-th draft step of a fused iteration runs only for its KV
+    write, and the EAGLE draft head is the TARGET's full lm_head (the single
+    largest weight stream in the draft step)."""
     b, t = input_ids.shape
     h = _fuse_input(d_params, t_params, args, input_ids, cond_hidden)
     pos_grid = position_ids[:, None] + jnp.arange(t)[None, :]
@@ -315,5 +319,7 @@ def eagle_decode_forward(
                                      decode_bucket=decode_bucket,
                                      mesh=mesh, rules=rules, paged=paged)
     hn = rms_norm(h, d_params["final_norm"], args.rms_norm_eps)
+    if skip_logits:
+        return None, hn, cache
     logits = model_base._lm_head(t_params, args, hn, mesh, rules)
     return logits, hn, cache

@@ -77,30 +77,84 @@ def _pack(dtype) -> int:
 
 def _paged_write_kernel(slots_ref, lidx_ref, new_k_ref, new_v_ref, _k_in, _v_in,
                         k_out, v_out, sk, sv, sems, *, t: int, pack: int, bs: int):
+    """Per-row scatter of the step's t fresh tokens, tile-aligned RMW.
+
+    t == 1 (plain decode): one RMW window per row. t in {2..8} (the
+    speculative multi-query commit): the common case — consecutive live slots
+    inside ONE aligned pack window (pack >= 32 for int8/fp8 caches, so a K<=8
+    chain straddles a window boundary at most once every pack positions) —
+    collapses to a SINGLE read-modify-write per row: 4 DMA waits instead of
+    4*t. Rows that straddle a window/block boundary, carry dropped (-1) slots,
+    or aren't consecutive fall back to the per-token loop. Dropped slots stay
+    predicated off in both paths (the conditional commit: a dead CB slot or a
+    masked speculative row writes nothing)."""
     b = pl.program_id(0)
     l = lidx_ref[0]
-    for tok in range(t):                       # t is tiny (1 or speculation width)
-        slot = slots_ref[b * t + tok]
 
-        @pl.when(slot >= 0)
-        def _write(slot=slot, tok=tok):
-            blk = slot // bs
-            off = slot % bs
-            w0 = (off // pack) * pack          # aligned window inside the block
-            dst_k = k_out.at[l, blk, :, pl.ds(w0, pack), :]
-            dst_v = v_out.at[l, blk, :, pl.ds(w0, pack), :]
-            pltpu.make_async_copy(dst_k, sk, sems.at[0]).start()
-            pltpu.make_async_copy(dst_v, sv, sems.at[1]).start()
-            pltpu.make_async_copy(dst_k, sk, sems.at[0]).wait()
-            pltpu.make_async_copy(dst_v, sv, sems.at[1]).wait()
+    def _rmw(blk, w0, edit):
+        """One aligned-window RMW: read both tiles, apply ``edit``, write back."""
+        dst_k = k_out.at[l, blk, :, pl.ds(w0, pack), :]
+        dst_v = v_out.at[l, blk, :, pl.ds(w0, pack), :]
+        pltpu.make_async_copy(dst_k, sk, sems.at[0]).start()
+        pltpu.make_async_copy(dst_v, sv, sems.at[1]).start()
+        pltpu.make_async_copy(dst_k, sk, sems.at[0]).wait()
+        pltpu.make_async_copy(dst_v, sv, sems.at[1]).wait()
+        edit()
+        pltpu.make_async_copy(sk, dst_k, sems.at[0]).start()
+        pltpu.make_async_copy(sv, dst_v, sems.at[1]).start()
+        pltpu.make_async_copy(sk, dst_k, sems.at[0]).wait()
+        pltpu.make_async_copy(sv, dst_v, sems.at[1]).wait()
+
+    def _per_token():
+        for tok in range(t):                   # t is tiny (1 or speculation width)
+            slot = slots_ref[b * t + tok]
+
+            @pl.when(slot >= 0)
+            def _write(slot=slot, tok=tok):
+                blk = slot // bs
+                off = slot % bs
+                w0 = (off // pack) * pack      # aligned window inside the block
+
+                def edit(off=off, w0=w0, tok=tok):
+                    iota = jax.lax.broadcasted_iota(jnp.int32, sk.shape, 1)
+                    hit = iota == off - w0
+                    sk[:] = jnp.where(hit, new_k_ref[0, :, tok : tok + 1, :],
+                                      sk[:])
+                    sv[:] = jnp.where(hit, new_v_ref[0, :, tok : tok + 1, :],
+                                      sv[:])
+
+                _rmw(blk, w0, edit)
+
+    if t == 1:
+        _per_token()
+        return
+
+    slot0 = slots_ref[b * t]
+    contig = slot0 >= 0
+    for tok in range(1, t):
+        contig = jnp.logical_and(contig, slots_ref[b * t + tok] == slot0 + tok)
+    off0 = slot0 % bs
+    # same aligned window => same block (bs % pack == 0, enforced by the caller)
+    one_window = jnp.logical_and(contig, off0 // pack == (off0 + t - 1) // pack)
+
+    @pl.when(one_window)
+    def _fused():
+        blk = slot0 // bs
+        w0 = (off0 // pack) * pack
+
+        def edit():
             iota = jax.lax.broadcasted_iota(jnp.int32, sk.shape, 1)
-            hit = iota == off - w0
-            sk[:] = jnp.where(hit, new_k_ref[0, :, tok : tok + 1, :], sk[:])
-            sv[:] = jnp.where(hit, new_v_ref[0, :, tok : tok + 1, :], sv[:])
-            pltpu.make_async_copy(sk, dst_k, sems.at[0]).start()
-            pltpu.make_async_copy(sv, dst_v, sems.at[1]).start()
-            pltpu.make_async_copy(sk, dst_k, sems.at[0]).wait()
-            pltpu.make_async_copy(sv, dst_v, sems.at[1]).wait()
+            rel = iota - (off0 - w0)           # window row -> fresh-token index
+            for tok in range(t):
+                hit = rel == tok
+                sk[:] = jnp.where(hit, new_k_ref[0, :, tok : tok + 1, :], sk[:])
+                sv[:] = jnp.where(hit, new_v_ref[0, :, tok : tok + 1, :], sv[:])
+
+        _rmw(blk, w0, edit)
+
+    @pl.when(jnp.logical_not(one_window))
+    def _straddle():
+        _per_token()
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -116,7 +170,9 @@ def write_paged_stacked_kv(
     """Scatter the step's K and V rows into the stacked paged cache in one kernel.
 
     ≈ `write_kv_cache_at_batch_kernel` (`modules/kvcache/utils.py:20-38`) over the
-    paged layout: per-token tile-aligned RMW window, -1 slots dropped."""
+    paged layout: tile-aligned RMW windows, -1 slots dropped. T > 1 (the
+    speculative multi-query commit) collapses a row's consecutive
+    same-window slots into ONE RMW — see _paged_write_kernel."""
     b, h, t, d = new_k.shape
     bs = k_cache.shape[3]
     pack = _pack(k_cache.dtype)
@@ -429,6 +485,14 @@ def paged_decode_attention_stacked(
     maps over the scalar-prefetched table); block groups beyond a row's position are
     clamped to the row's last live block (DMA elided) and predicated off. The fresh
     step's K/V must already be written (write_paged_stacked_kv).
+
+    T = 1 is plain chain decode. T in {2..8} is the MULTI-QUERY (ragged
+    verify) shape — the q_len>1 ragged-paged-attention case: the K
+    speculative positions of every row attend in ONE pass over the row's
+    live blocks (each block group is streamed once for all T queries) with
+    an intra-chunk causal mask (q_pos = pos + tok index, kv_pos <= q_pos),
+    instead of T single-token attends or a table-width gather that would
+    stream the cache T times.
     ``variant``: 2 = head-padded concat cells (the measured default), 3 = flat-q
     per-block-group cells (measured neutral-bf16 / worse-fp8 on v5e at bs=64 —
     kept for other geometries; see _paged_attend_kernel_v3).

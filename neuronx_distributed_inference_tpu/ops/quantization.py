@@ -252,8 +252,16 @@ def quantize_params(params: Dict[str, Any], weight_dtype: str = "int8",
     to {"q4","s"} and the REMAINING names to int8 — the small projections
     aren't worth a w4 kernel call (see W4_DEFAULT_PARAMS note).
 
-    Leaves that are ALREADY in the quantized {"q","s"} layout pass through untouched,
-    so pre-quantized (or partially pre-quantized) checkpoints load correctly."""
+    Leaves that are ALREADY in the quantized {"q","s"} layout pass through
+    untouched, so pre-quantized (or partially pre-quantized) checkpoints load
+    correctly — with ONE exception: under ``weight_dtype="int4"`` a
+    pre-quantized int8 leaf whose name is in ``int4_names`` is REPACKED to the
+    {"q4","s"} layout (ops/w4.repack_int8_to_int4, no float intermediate), so
+    an int8 checkpoint loaded with an int4 config actually serves int4 instead
+    of silently staying on the int8 path. fp8 pre-quantized payloads cannot be
+    repacked losslessly and pass through with a warning."""
+    import logging
+
     nameset = set(names)
     groups = set(group_keys)
     if weight_dtype == "int4":
@@ -267,6 +275,29 @@ def quantize_params(params: Dict[str, Any], weight_dtype: str = "int8",
                                ("int8" if w4set or weight_dtype == "int4"
                                 else weight_dtype))
 
+    def reconv(k, v):
+        """Already-quantized leaf named for int4: repack int8 payloads."""
+        import numpy as np
+
+        from .w4 import repack_int8_to_int4
+
+        if "q4" in v:
+            return v                        # already the target layout
+        payload = v.get("q", v.get("qT"))
+        if np.asarray(payload).dtype != np.int8:
+            logging.getLogger("tpu-inference").warning(
+                "weight_dtype='int4': pre-quantized %s leaf %r cannot be "
+                "repacked to int4 (only int8 payloads can); serving it as-is",
+                np.asarray(payload).dtype, k)
+            return v
+        if "qT" in v:
+            # transposed int8 storage (..., out, in): restore the logical
+            # orientation first — the q4 layout packs the contraction dim
+            return repack_int8_to_int4(
+                {"q": np.ascontiguousarray(
+                    np.swapaxes(np.asarray(v["qT"]), -1, -2)), "s": v["s"]})
+        return repack_int8_to_int4(v)
+
     def walk(node, in_group):
         if is_quantized(node):
             return node
@@ -274,6 +305,8 @@ def quantize_params(params: Dict[str, Any], weight_dtype: str = "int8",
             return {k: (conv(k, v)
                         if in_group and k in nameset and not is_quantized(v)
                         and not isinstance(v, dict)
+                        else reconv(k, v)
+                        if in_group and k in w4set and is_quantized(v)
                         else walk(v, k in groups)
                         if isinstance(v, dict) else v)
                     for k, v in node.items()}
@@ -319,9 +352,17 @@ def quantized_logical_axes(logical: Dict[str, Any], names: Sequence[str],
     ``q``; the scale keeps the output axis, contraction replaced by None.
     ``transposed_names`` get the {"qT","s"} form: the payload's last two axes
     swap, the scale keeps the ORIGINAL output axis. ``int4_names`` get the
-    {"q4","s"} form: the packed payload keeps the SAME axis names (even/odd
-    packing halves the contraction dim without changing which mesh axis shards
-    it — each packed row is a self-contained pair of logical rows)."""
+    {"q4","s"} form: the packed payload keeps the SAME axis names. NOTE the
+    shipped packing is HALF-SPLIT (ops/w4.py: byte row i pairs logical rows i
+    and i + in/2, lo nibble stored biased), so a packed row is NOT a
+    self-contained pair of adjacent logical rows — sharding the packed
+    contraction axis would split each byte's two logical rows across shards.
+    That is safe ONLY because sharded meshes never run the Pallas kernel:
+    w4_apply routes multi-device meshes through the GSPMD dequant path
+    (`use_kernel=False`), where the dequantized (in, out) weight is a plain
+    dot GSPMD repartitions correctly regardless of the byte layout. A future
+    shard_map w4 kernel must shard the OUTPUT axis (or unpack before
+    resharding), never the packed contraction axis."""
     nameset = set(names)
     tset = set(transposed_names)
     w4set = set(int4_names)

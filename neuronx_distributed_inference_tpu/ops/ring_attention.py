@@ -109,12 +109,13 @@ def ring_attention(
     q_spec = logical_to_spec(("batch", "heads", "seq", None), rules)
     kv_spec = logical_to_spec(("batch", "kv_heads", "seq", None), rules)
     pos_spec = logical_to_spec(("batch", "seq"), rules)
-    fn = jax.shard_map(
+    from ..models.base import shard_map_compat
+
+    fn = shard_map_compat(
         partial(_ring_local, cp_size=cp_size, scale=scale, n_rep=n_rep,
                 window=window),
         mesh=mesh,
         in_specs=(q_spec, kv_spec, kv_spec, pos_spec, pos_spec),
         out_specs=q_spec,
-        check_vma=False,
     )
     return fn(q, k, v, q_pos, kv_pos)

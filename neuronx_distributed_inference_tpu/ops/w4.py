@@ -140,12 +140,19 @@ def is_w4(w) -> bool:
     return isinstance(w, dict) and "q4" in w and "s" in w
 
 
-def pack_int4(w, scale_axis: int = -2) -> Dict[str, Any]:
+def pack_int4(w) -> Dict[str, Any]:
     """Symmetric per-output-channel int4 quantization, half-split packed.
 
     ``w`` (..., in, out) float -> {"q4": int8 (..., in/2, out) packed,
     "s": f32 (..., 1, out)}. Host-side numpy (see quantize_tensor): a model
     larger than one device's HBM never materializes unsharded on device.
+
+    The scale reduction is FIXED over the contraction dim (axis -2): every
+    consumer (the Pallas kernel epilogue, dequant_w4, the GSPMD dequant dot)
+    applies ``s`` per OUTPUT channel after the contraction sum — a scale that
+    varied along the contraction axis could not be factored out of the dot.
+    (An earlier ``scale_axis`` parameter was accepted and silently ignored;
+    it is gone rather than half-honored.)
     """
     import numpy as np
 

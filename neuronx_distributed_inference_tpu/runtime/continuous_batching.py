@@ -91,7 +91,8 @@ class ContinuousBatchingRunner:
                  speculation_length: Optional[int] = None,
                  spec_chunk: Optional[int] = None,
                  max_insert_tokens_per_step: Optional[int] = None,
-                 eagle_draft=None):
+                 eagle_draft=None, spec_adaptive: bool = False,
+                 spec_min_accept: float = 1.25, spec_probe_every: int = 8):
         cfg = app.tpu_config
         if not cfg.is_continuous_batching:
             raise ValueError("tpu_config.is_continuous_batching must be enabled")
@@ -190,7 +191,7 @@ class ContinuousBatchingRunner:
                                  "conditioning hidden must be continuous "
                                  "across insert windows)")
             self.k = speculation_length
-            self.spec_chunk = spec_chunk or max(1, self.decode_chunk // self.k)
+            self.spec_chunk = spec_chunk or max(1, self.decode_chunk)
             self.async_mode = False
             self._async_auto = False
             self.acceptance_counts = np.zeros((self.k,), dtype=np.int64)
@@ -219,8 +220,15 @@ class ContinuousBatchingRunner:
                         "multinomial speculation requires a sampling config with "
                         "do_sample or dynamic params (see FusedSpeculativeModel)")
             self.k = speculation_length
-            # per-dispatch fused iterations; each commits 1..K tokens per row
-            self.spec_chunk = spec_chunk or max(1, self.decode_chunk // self.k)
+            # per-dispatch fused iterations; each commits 1..K tokens per row.
+            # Default: the PLAIN chunk's iteration count (not its token
+            # count): a spec chunk of N iterations commits N..N*K tokens, and
+            # what the chunk amortizes is the fixed host-dispatch cost PER
+            # ITERATION — at decode_chunk//K (the old default, 8 iters) a
+            # ~109 ms dispatch floor added ~13.6 ms to every measured
+            # iteration; at decode_chunk (32) it adds the same ~3.4 ms a
+            # plain decode step pays
+            self.spec_chunk = spec_chunk or max(1, self.decode_chunk)
             # dispatch-ahead needs a host-predictable uniform advance; spec
             # advance is data-dependent (accepted length), so the pipeline
             # cannot be proven exact — the on-device chunk amortizes instead
@@ -228,6 +236,27 @@ class ContinuousBatchingRunner:
             self._async_auto = False
             # histogram over tokens-committed-per-(row, iteration), length K
             self.acceptance_counts = np.zeros((self.k,), dtype=np.int64)
+
+        # adaptive speculation (the serving FLOOR guard): when the measured
+        # per-iteration acceptance of a spec chunk falls below
+        # ``spec_min_accept`` committed tokens/row/iteration, subsequent
+        # chunks run the PLAIN decode path (a spec iteration costs more than
+        # a decode step, so at chance-level acceptance speculation is a pure
+        # loss — this bounds the worst case at ~plain-paged throughput
+        # instead of ~plain/2). Every ``spec_probe_every`` plain chunks one
+        # spec chunk re-probes acceptance. Exactness is unaffected (both
+        # chunk kinds are exact); the draft cache develops KV gaps over the
+        # plain stretches, which only depresses probe acceptance — the
+        # re-enable path is intentionally pessimistic.
+        self.spec_adaptive = spec_adaptive
+        self.spec_min_accept = spec_min_accept
+        self.spec_probe_every = spec_probe_every
+        self._spec_off = False
+        self._spec_plain_chunks = 0
+        # total fused iterations actually DISPATCHED (clamps can shrink a
+        # chunk below spec_chunk near request tails) — the honest denominator
+        # for measured iteration time
+        self.spec_iters_run = 0
 
         self.queue: List[Request] = []
         self.active: List[Optional[Request]] = [None] * self.num_slots
@@ -451,6 +480,7 @@ class ContinuousBatchingRunner:
         state; inserts run the target's windowed prefix-prefill with
         return_hidden and stream the shifted hiddens into the draft pool."""
         from ..models import eagle as eagle_lib
+        from . import speculation as spec_lib
 
         app = self.app
         t_args, mesh, rules = app.arch_args, app.mesh, app.sharding_rules
@@ -509,6 +539,9 @@ class ContinuousBatchingRunner:
                 sm = jnp.where(alive[:, None], blk * bs_blk + p % bs_blk, -1)
                 sm_cols = sm.T[:, :, None]                  # (K, B, 1)
 
+                # k-1 proposal steps + one KV-only step (skip_logits: the
+                # k-th proposal is discarded, and the EAGLE draft head is the
+                # TARGET's full lm_head — the largest stream in the step)
                 def draft_body(dc, sm_j):
                     dtok, dh, dpos, cache = dc
                     with jax.default_matmul_precision(precision):
@@ -520,9 +553,15 @@ class ContinuousBatchingRunner:
                     nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
                     return (nxt, h_d[:, -1], dpos + 1, cache), nxt
 
-                (_, _, _, d_cache), d_toks = jax.lax.scan(
-                    draft_body, (tok, h, pos, d_cache), sm_cols)
-                d_toks = d_toks.T[:, : k - 1]               # (B, K-1)
+                (d_last, d_h, d_pos, d_cache), d_toks = jax.lax.scan(
+                    draft_body, (tok, h, pos, d_cache), sm_cols[: k - 1])
+                d_toks = d_toks.T                           # (B, K-1)
+                with jax.default_matmul_precision(precision):
+                    _, _, d_cache = eagle_lib.eagle_decode_forward(
+                        d_params, t_params, d_args, d_last[:, None],
+                        d_h[:, None, :], d_pos, d_cache, None, mesh=mesh,
+                        rules=rules, block_table=block_table,
+                        slot_mapping=sm_cols[k - 1], skip_logits=True)
 
                 t_in = jnp.concatenate([tok[:, None], d_toks], axis=1)
                 with jax.default_matmul_precision(precision):
@@ -535,18 +574,14 @@ class ContinuousBatchingRunner:
                 n = jnp.cumprod(matches.astype(jnp.int32), axis=1).sum(
                     axis=1).astype(jnp.int32)
 
-                take = jnp.where(alive, n + 1, 0)
-                new_tok = jnp.take_along_axis(
-                    t_toks, jnp.maximum(take - 1, 0)[:, None], axis=1)[:, 0]
+                take, new_tok, alive_next = spec_lib.chunk_advance(
+                    alive, t_toks, n, eos_ids)
                 h_next = jnp.take_along_axis(
                     t_h, n[:, None, None], axis=1)[:, 0]    # hidden at slot n
-                tok = jnp.where(alive, new_tok, tok)
-                h = jnp.where(alive[:, None], h_next, h)
+                tok = jnp.where(take > 0, new_tok, tok)
+                h = jnp.where((take > 0)[:, None], h_next, h)
                 pos = pos + take
-                win = jnp.arange(k, dtype=jnp.int32)[None, :] < take[:, None]
-                hit_eos = jnp.any(win & (t_toks == eos_ids[:, None]), axis=1)
-                alive = alive & ~hit_eos
-                return (tok, h, pos, alive, t_cache, d_cache), (t_toks, n)
+                return (tok, h, pos, alive_next, t_cache, d_cache), (t_toks, n)
 
             (_, h_out, _, _, t_cache, d_cache), (outs, ns) = jax.lax.scan(
                 one_iter, (tok0, h0, positions, alive0, t_cache, d_cache),
@@ -566,6 +601,7 @@ class ContinuousBatchingRunner:
         ``generate_fusedspec_slot_mapping``): here the (B, K) slot mapping is
         recomputed from the live positions INSIDE the graph each iteration (a
         block-table gather), because the host cannot know them in advance."""
+        from . import speculation as spec_lib
         from .speculation import speculative_accept
 
         app, draft = self.app, self.draft
@@ -591,6 +627,12 @@ class ContinuousBatchingRunner:
             t_kw = {"use_kernel": True} if app._use_decode_kernel() else {}
             d_kw = {"use_kernel": True} if draft._use_decode_kernel() else {}
 
+        # the k-th draft step is KV-only (its proposal is discarded): skip the
+        # draft's final norm + lm_head when the family forward supports it —
+        # streaming the draft lm_head for a discarded proposal is pure waste
+        d_skip = (dict(skip_logits=True)
+                  if d_decode is model_base.decode_forward else {})
+
         def _spec_chunk(t_params, d_params, tok0, positions, alive0, t_cache,
                         d_cache, block_table, sampling_params, eos_ids, key,
                         adapter_ids, num_iters, greedy, decode_bucket=None):
@@ -599,7 +641,7 @@ class ContinuousBatchingRunner:
             def one_iter(carry, key_i):
                 tok, pos, alive, t_cache, d_cache = carry
                 key_d, key_acc = jax.random.split(key_i)
-                d_keys = jax.random.split(key_d, k)
+                d_keys = jax.random.split(key_d, k - 1)
                 if paged:
                     # per-sequence K-wide slot mapping from the LIVE positions
                     p = pos[:, None] + jnp.arange(k, dtype=jnp.int32)[None, :]
@@ -613,8 +655,11 @@ class ContinuousBatchingRunner:
                     d_extra = t_extra = {}
                     sm_cols = jnp.zeros((k, 1, 1), dtype=jnp.int32)
 
-                # draft loop: k iterations proposing k-1 candidates; the k-th
-                # runs so d_{k-1}'s KV lands before a possible full accept
+                # draft loop: k-1 proposal steps, then one KV-only step so
+                # d_{k-1}'s KV lands before a possible full accept (no logits
+                # for it — see d_skip). Greedy chunks stack only the proposed
+                # tokens; the (B, V) per-step logits are stacked ONLY when the
+                # rejection sampler needs them (multinomial acceptance).
                 def draft_body(dc, xs):
                     dtok, dpos, cache = dc
                     key_j, sm_j = xs
@@ -629,15 +674,27 @@ class ContinuousBatchingRunner:
                     last = logits[:, -1]
                     if greedy:
                         nxt = jnp.argmax(last, axis=-1).astype(jnp.int32)
-                    else:
-                        nxt = sampling_ops.sample(last, sampling_params,
-                                                  key_j, odsc)
+                        return (nxt, dpos + 1, cache), nxt
+                    nxt = sampling_ops.sample(last, sampling_params,
+                                              key_j, odsc)
                     return (nxt, dpos + 1, cache), (nxt, last)
 
-                (_, _, d_cache), (d_toks, d_logits) = jax.lax.scan(
-                    draft_body, (tok, pos, d_cache), (d_keys, sm_cols))
-                d_toks = d_toks.T[:, : k - 1]                     # (B, K-1)
-                d_logits = d_logits.transpose(1, 0, 2)[:, : k - 1]
+                (d_last, d_pos, d_cache), ys = jax.lax.scan(
+                    draft_body, (tok, pos, d_cache),
+                    (d_keys, sm_cols[: k - 1]))
+                if greedy:
+                    d_toks, d_logits = ys.T, None                 # (B, K-1)
+                else:
+                    d_toks = ys[0].T                              # (B, K-1)
+                    d_logits = ys[1].transpose(1, 0, 2)           # (B, K-1, V)
+                kwf = dict(d_extra)
+                if paged:
+                    kwf["slot_mapping"] = sm_cols[k - 1]
+                with jax.default_matmul_precision(precision):
+                    _, d_cache = d_decode(
+                        d_params, d_args, d_last[:, None], d_pos, d_cache,
+                        decode_bucket, mesh=d_mesh, rules=d_rules,
+                        **kwf, **d_kw, **d_skip)
 
                 t_in = jnp.concatenate([tok[:, None], d_toks], axis=1)
                 with jax.default_matmul_precision(precision):
@@ -652,17 +709,13 @@ class ContinuousBatchingRunner:
                     d_toks, d_logits, t_logits, sampling_params, key_acc,
                     greedy=greedy, odsc=odsc, vocab=vocab)
 
-                take = jnp.where(alive, n + 1, 0)
-                new_tok = jnp.take_along_axis(
-                    out_toks, jnp.maximum(take - 1, 0)[:, None], axis=1)[:, 0]
-                tok = jnp.where(alive, new_tok, tok)
-                pos = pos + take
-                # a row whose committed window contains its eos stops advancing
+                # rows whose committed window contains their eos stop advancing
                 # (the host replays the exact same stopping rule when committing)
-                win = jnp.arange(k, dtype=jnp.int32)[None, :] < take[:, None]
-                hit_eos = jnp.any(win & (out_toks == eos_ids[:, None]), axis=1)
-                alive = alive & ~hit_eos
-                return (tok, pos, alive, t_cache, d_cache), (out_toks, n)
+                take, new_tok, alive_next = spec_lib.chunk_advance(
+                    alive, out_toks, n, eos_ids)
+                tok = jnp.where(take > 0, new_tok, tok)
+                pos = pos + take
+                return (tok, pos, alive_next, t_cache, d_cache), (out_toks, n)
 
             (_, _, _, t_cache, d_cache), (outs, ns) = jax.lax.scan(
                 one_iter, (tok0, positions, alive0, t_cache, d_cache), iter_keys)
@@ -1035,6 +1088,12 @@ class ContinuousBatchingRunner:
         live = [r for r in active_rows if not r.done and not r.inserting]
         if not live:
             return emitted
+        if self.spec_adaptive and self._spec_off:
+            self._spec_plain_chunks += 1
+            if self._spec_plain_chunks < self.spec_probe_every:
+                return self._step_plain(key, emitted)
+            self._spec_plain_chunks = 0
+            self._spec_off = False         # re-probe with one spec chunk
         max_pos = max(r.position for r in live)
         # every fused iteration needs a full K-token cache window
         room = (self.cfg.seq_len - 1 - max_pos) // self.k
@@ -1044,11 +1103,15 @@ class ContinuousBatchingRunner:
             # KV gaps from this path only dent later acceptance rates, never
             # correctness — the target verifies every token)
             return self._step_plain(key, emitted)
-        iters = max(1, min(self.spec_chunk, room,
-                           # an iteration commits >=1 token/row: running past the
-                           # tightest row's remaining budget only wastes flops
-                           min(r.max_new_tokens - len(r.generated)
-                               for r in live)))
+        # an iteration commits >=1 token/row: running past the tightest row's
+        # remaining budget only wastes flops. Clamped values quantize to
+        # powers of two — num_iters is a static jit arg (see
+        # speculation.quantize_chunk_iters).
+        from .speculation import quantize_chunk_iters
+
+        iters = quantize_chunk_iters(
+            self.spec_chunk, room,
+            min(r.max_new_tokens - len(r.generated) for r in live))
         if self.paged:
             active_rows = self._grow_blocks(active_rows, iters * self.k)
             if not active_rows:
@@ -1081,6 +1144,8 @@ class ContinuousBatchingRunner:
                 greedy=self._chunk_greedy(live), decode_bucket=bucket)
         outs = np.asarray(outs)           # (iters, slots, K)
         ns = np.asarray(ns)               # (iters, slots)
+        self.spec_iters_run += iters
+        chunk_added = chunk_cells = 0
         for it in range(iters):
             for slot, req in enumerate(self.active):
                 if req is None or req.done or req.inserting:
@@ -1092,6 +1157,8 @@ class ContinuousBatchingRunner:
                 added = len(req.generated) - pre
                 if added:
                     self.acceptance_counts[added - 1] += 1
+                chunk_added += added
+                chunk_cells += 1
                 req.position += added
                 emitted.setdefault(req.request_id, []).extend(
                     req.generated[pre:])
@@ -1099,6 +1166,14 @@ class ContinuousBatchingRunner:
                 self.last_tok[slot] = req.generated[-1]
                 if done:
                     self._finish(req)
+        if (self.spec_adaptive and chunk_cells
+                and chunk_added / chunk_cells < self.spec_min_accept):
+            self._spec_off = True
+            logger.info(
+                "adaptive speculation: %.2f committed tokens/row/iteration "
+                "< %.2f — serving plain decode chunks (spec re-probe every "
+                "%d chunks)", chunk_added / chunk_cells,
+                self.spec_min_accept, self.spec_probe_every)
         return emitted
 
     def run_to_completion(self, seed: int = 0) -> Dict[int, List[int]]:

@@ -28,7 +28,8 @@ from ..models import eagle as eagle_lib
 from ..models.base import ModelArchArgs
 from ..modules import autobucketing, kvcache
 from . import model_wrapper
-from .speculation import SpecGenerateOutput, assemble_spec_output, commit_row
+from .speculation import (SpecGenerateOutput, assemble_spec_output,
+                          chunk_advance, quantize_chunk_iters, replay_chunk)
 
 
 def draft_args_from_target(target_args: ModelArchArgs, num_layers: int = 1,
@@ -60,7 +61,8 @@ def draft_args_from_target(target_args: ModelArchArgs, num_layers: int = 1,
 class EagleSpeculativeModel:
     """Owns a target `TpuModelForCausalLM` + EAGLE draft params; runs fused spec."""
 
-    def __init__(self, target, draft_args: ModelArchArgs, speculation_length: int):
+    def __init__(self, target, draft_args: ModelArchArgs, speculation_length: int,
+                 spec_chunk: int = 8):
         if speculation_length < 2:
             raise ValueError("speculation_length must be >= 2")
         if draft_args.hidden_size != target.arch_args.hidden_size:
@@ -68,6 +70,10 @@ class EagleSpeculativeModel:
         self.target = target
         self.draft_args = draft_args
         self.k = speculation_length
+        # fused iterations per device dispatch (positions / conditioning
+        # hiddens / eos-stops advance in-graph; the host replays the exact
+        # commit rules after the sync — same discipline as the CB EAGLE chunk)
+        self.spec_chunk = max(1, spec_chunk)
         self.draft_params = None
         self.draft_cache = None
         self._build_steps()
@@ -123,9 +129,11 @@ class EagleSpeculativeModel:
                     h_full, last_token_idx[:, None, None], axis=1)[:, 0]
             return tok0, h_last, t_cache, d_cache
 
-        def _step(t_params, d_params, last_tok, h_cond, positions, t_cache, d_cache,
-                  decode_bucket):
-            """One fused EAGLE step: k-1 draft proposals + one target verify."""
+        def _iter(t_params, d_params, last_tok, h_cond, positions, t_cache,
+                  d_cache, decode_bucket):
+            """One fused EAGLE iteration: k-1 draft proposals + one KV-only
+            draft step (skip_logits — the k-th proposal is discarded and the
+            draft head is the TARGET's full lm_head) + one target verify."""
             def draft_body(carry, _):
                 tok, h, pos, cache = carry
                 with jax.default_matmul_precision(precision):
@@ -135,9 +143,15 @@ class EagleSpeculativeModel:
                 nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
                 return (nxt, h_d[:, -1], pos + 1, cache), nxt
 
-            (_, _, _, d_cache), draft_toks = jax.lax.scan(
-                draft_body, (last_tok, h_cond, positions, d_cache), None, length=k)
-            draft_toks = draft_toks.T[:, : k - 1]                    # (B, K-1)
+            (d_last, d_h, d_pos, d_cache), draft_toks = jax.lax.scan(
+                draft_body, (last_tok, h_cond, positions, d_cache), None,
+                length=k - 1)
+            draft_toks = draft_toks.T                                # (B, K-1)
+            with jax.default_matmul_precision(precision):
+                _, _, d_cache = eagle_lib.eagle_decode_forward(
+                    d_params, t_params, d_args, d_last[:, None],
+                    d_h[:, None, :], d_pos, d_cache, decode_bucket,
+                    mesh=mesh, rules=rules, skip_logits=True)
 
             target_in = jnp.concatenate([last_tok[:, None], draft_toks], axis=1)
             with jax.default_matmul_precision(precision):
@@ -153,9 +167,33 @@ class EagleSpeculativeModel:
                 t_h, n[:, None, None], axis=1)[:, 0]                 # (B, H)
             return t_toks, n.astype(jnp.int32), h_next, t_cache, d_cache
 
+        def _chunk(t_params, d_params, tok0, h0, positions0, alive0, t_cache,
+                   d_cache, eos_ids, decode_bucket, num_iters):
+            """``num_iters`` fused EAGLE iterations in ONE dispatch: per-row
+            positions AND conditioning hiddens advance in-graph by each row's
+            accepted length; a row whose committed window contains its eos
+            stops advancing (host replays the exact stop rules)."""
+            def one_iter(carry, _):
+                tok, h, pos, alive, t_cache, d_cache = carry
+                t_toks, n, h_next, t_cache, d_cache = _iter(
+                    t_params, d_params, tok, h, pos, t_cache, d_cache,
+                    decode_bucket)
+                take, new_tok, alive_next = chunk_advance(alive, t_toks, n,
+                                                          eos_ids)
+                tok = jnp.where(take > 0, new_tok, tok)
+                h = jnp.where((take > 0)[:, None], h_next, h)
+                pos = pos + take
+                return (tok, h, pos, alive_next, t_cache, d_cache), (t_toks, n)
+
+            (_, h_out, _, _, t_cache, d_cache), (outs, ns) = jax.lax.scan(
+                one_iter, (tok0, h0, positions0, alive0, t_cache, d_cache),
+                None, length=num_iters)
+            return outs, ns, h_out, t_cache, d_cache
+
         self._prefill_step = jax.jit(_prefill, donate_argnums=(5, 6))
-        self._spec_step = jax.jit(_step, donate_argnums=(5, 6),
-                                  static_argnames=("decode_bucket",))
+        self._spec_chunk = jax.jit(_chunk, donate_argnums=(6, 7),
+                                   static_argnames=("decode_bucket",
+                                                    "num_iters"))
 
     # ------------------------------------------------------------------ generate
     def generate(
@@ -204,31 +242,37 @@ class EagleSpeculativeModel:
         accept_hist = np.zeros((self.k,), dtype=np.int64)
         steps = 0
 
+        eos_ids = np.full((compiled_b,),
+                          -1 if eos_token_id is None else eos_token_id,
+                          dtype=np.int32)
         while not all(len(c) >= max_new_tokens or done[i]
                       for i, c in enumerate(committed)):
-            max_pos = int(positions.max())
+            live_pos = [int(positions[i]) for i, c in enumerate(committed)
+                        if not done[i] and len(c) < max_new_tokens]
+            max_pos = max(live_pos)
             if max_pos + self.k >= cfg.seq_len:
                 break
+            room = (cfg.seq_len - 1 - max_pos) // self.k
+            remaining = min(max_new_tokens - len(c)
+                            for i, c in enumerate(committed)
+                            if not done[i] and len(c) < max_new_tokens)
+            iters = quantize_chunk_iters(self.spec_chunk, room, remaining)
             bucket = autobucketing.select_bucket(target.tkg_buckets,
-                                                 max_pos + self.k)
+                                                 max_pos + self.k * iters)
+            alive0 = np.array([i < b and not done[i]
+                               and len(committed[i]) < max_new_tokens
+                               for i in range(compiled_b)])
             out_dev, n_dev, h_cond, target.kv_cache, self.draft_cache = \
-                self._spec_step(target.params, self.draft_params,
-                                jnp.asarray(last_tok), h_cond,
-                                jnp.asarray(positions), target.kv_cache,
-                                self.draft_cache, decode_bucket=bucket)
-            out = np.asarray(out_dev)
-            n = np.asarray(n_dev)
-            steps += 1
-            for i in range(b):
-                if done[i]:
-                    continue
-                take = int(n[i]) + 1
-                accept_hist[take - 1] += 1
-                done[i] = commit_row(committed[i], out[i, :take], eos_token_id,
-                                     max_new_tokens)
-                if not done[i]:
-                    positions[i] += take
-                    last_tok[i] = out[i, take - 1]
+                self._spec_chunk(target.params, self.draft_params,
+                                 jnp.asarray(last_tok), h_cond,
+                                 jnp.asarray(positions), jnp.asarray(alive0),
+                                 target.kv_cache, self.draft_cache,
+                                 jnp.asarray(eos_ids), decode_bucket=bucket,
+                                 num_iters=iters)
+            out = np.asarray(out_dev)    # (iters, B, K)
+            n = np.asarray(n_dev)        # (iters, B)
+            steps += replay_chunk(out, n, committed, done, positions, last_tok,
+                                  accept_hist, eos_token_id, max_new_tokens)
 
         return assemble_spec_output(committed, padded, b, pad_token_id, accept_hist,
                                     steps, ttft)

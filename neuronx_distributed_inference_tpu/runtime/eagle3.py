@@ -35,7 +35,8 @@ from ..models import eagle as eagle_lib
 from ..models.base import ModelArchArgs
 from ..modules import autobucketing, kvcache
 from . import model_wrapper
-from .speculation import SpecGenerateOutput, assemble_spec_output, commit_row
+from .speculation import (SpecGenerateOutput, assemble_spec_output,
+                          chunk_advance, quantize_chunk_iters, replay_chunk)
 
 
 class Eagle3SpeculativeModel:
@@ -44,7 +45,7 @@ class Eagle3SpeculativeModel:
     def __init__(self, target, draft_args: ModelArchArgs, *,
                  depth: int = 3, beam: int = 2, branch: int = 2,
                  capture_layers: Optional[tuple] = None,
-                 draft_vocab: Optional[int] = None):
+                 draft_vocab: Optional[int] = None, spec_chunk: int = 8):
         if depth < 1 or beam < 1:
             raise ValueError("depth and beam must be >= 1")
         if branch < beam:
@@ -63,6 +64,10 @@ class Eagle3SpeculativeModel:
         self.capture_layers = (capture_layers if capture_layers is not None
                                else (1, L // 2, L - 2 if L > 1 else 0))
         self.draft_vocab = draft_vocab or target.arch_args.vocab_size
+        # fused tree iterations per device dispatch (positions / fused
+        # conditioning hiddens / eos-stops advance in-graph; the host replays
+        # the exact commit rules after the sync)
+        self.spec_chunk = max(1, spec_chunk)
         self.draft_params = None
         self.draft_cache = None
         self._build_steps()
@@ -265,9 +270,34 @@ class Eagle3SpeculativeModel:
                                         (b, 1, g_all.shape[-1])), axis=1)[:, 0]
             return out_toks, n, g_next, t_cache, d_cache
 
+        def _chunk(t_params, d_params, tok0, g0, positions0, alive0, t_cache,
+                   d_cache, eos_ids, decode_bucket, num_iters):
+            """``num_iters`` fused dynamic-tree iterations in ONE dispatch:
+            per-row positions and fused conditioning hiddens advance in-graph
+            by each row's accepted length; a row whose committed window
+            contains its eos stops advancing (host replays the exact stop
+            rules after the sync)."""
+            def one_iter(carry, _):
+                tok, g, pos, alive, t_cache, d_cache = carry
+                out_toks, n, g_next, t_cache, d_cache = _step(
+                    t_params, d_params, tok, g, pos, t_cache, d_cache,
+                    decode_bucket)
+                take, new_tok, alive_next = chunk_advance(alive, out_toks, n,
+                                                          eos_ids)
+                tok = jnp.where(take > 0, new_tok, tok)
+                g = jnp.where((take > 0)[:, None], g_next, g)
+                pos = pos + take
+                return (tok, g, pos, alive_next, t_cache, d_cache), (out_toks, n)
+
+            (_, g_out, _, _, t_cache, d_cache), (outs, ns) = jax.lax.scan(
+                one_iter, (tok0, g0, positions0, alive0, t_cache, d_cache),
+                None, length=num_iters)
+            return outs, ns, g_out, t_cache, d_cache
+
         self._prefill_step = jax.jit(_prefill, donate_argnums=(5, 6))
-        self._spec_step = jax.jit(_step, donate_argnums=(5, 6),
-                                  static_argnames=("decode_bucket",))
+        self._spec_chunk = jax.jit(_chunk, donate_argnums=(6, 7),
+                                   static_argnames=("decode_bucket",
+                                                    "num_iters"))
 
     # ------------------------------------------------------------------ generate
     def generate(
@@ -316,31 +346,41 @@ class Eagle3SpeculativeModel:
         accept_hist = np.zeros((self.depth + 1,), dtype=np.int64)
         steps = 0
 
+        eos_ids = np.full((compiled_b,),
+                          -1 if eos_token_id is None else eos_token_id,
+                          dtype=np.int32)
         while not all(len(c) >= max_new_tokens or done[i]
                       for i, c in enumerate(committed)):
-            max_pos = int(positions.max())
+            live_pos = [int(positions[i]) for i, c in enumerate(committed)
+                        if not done[i] and len(c) < max_new_tokens]
+            max_pos = max(live_pos)
             if max_pos + self.num_nodes >= cfg.seq_len:
                 break
-            bucket = autobucketing.select_bucket(target.tkg_buckets,
-                                                 max_pos + self.num_nodes)
+            # an iteration advances a row by at most depth+1 positions but
+            # needs num_nodes cache slots of headroom for its tree
+            room = ((cfg.seq_len - 1 - max_pos - (self.num_nodes - 1))
+                    // (self.depth + 1) + 1)
+            remaining = min(max_new_tokens - len(c)
+                            for i, c in enumerate(committed)
+                            if not done[i] and len(c) < max_new_tokens)
+            iters = quantize_chunk_iters(self.spec_chunk, room, remaining)
+            bucket = autobucketing.select_bucket(
+                target.tkg_buckets,
+                max_pos + (self.depth + 1) * (iters - 1) + self.num_nodes)
+            alive0 = np.array([i < b and not done[i]
+                               and len(committed[i]) < max_new_tokens
+                               for i in range(compiled_b)])
             out_dev, n_dev, g_cond, target.kv_cache, self.draft_cache = \
-                self._spec_step(target.params, self.draft_params,
-                                jnp.asarray(last_tok), g_cond,
-                                jnp.asarray(positions), target.kv_cache,
-                                self.draft_cache, decode_bucket=bucket)
-            out = np.asarray(out_dev)
-            n = np.asarray(n_dev)
-            steps += 1
-            for i in range(b):
-                if done[i]:
-                    continue
-                take = int(n[i]) + 1
-                accept_hist[take - 1] += 1
-                done[i] = commit_row(committed[i], out[i, :take], eos_token_id,
-                                     max_new_tokens)
-                if not done[i]:
-                    positions[i] += take
-                    last_tok[i] = out[i, take - 1]
+                self._spec_chunk(target.params, self.draft_params,
+                                 jnp.asarray(last_tok), g_cond,
+                                 jnp.asarray(positions), jnp.asarray(alive0),
+                                 target.kv_cache, self.draft_cache,
+                                 jnp.asarray(eos_ids), decode_bucket=bucket,
+                                 num_iters=iters)
+            out = np.asarray(out_dev)    # (iters, B, depth+1)
+            n = np.asarray(n_dev)        # (iters, B)
+            steps += replay_chunk(out, n, committed, done, positions, last_tok,
+                                  accept_hist, eos_token_id, max_new_tokens)
 
         return assemble_spec_output(committed, padded, b, pad_token_id, accept_hist,
                                     steps, ttft)

@@ -56,6 +56,71 @@ class SpecGenerateOutput:
     draft_logits: Optional[List[np.ndarray]] = None
 
 
+def quantize_chunk_iters(spec_chunk: int, *clamps: int) -> int:
+    """Iteration count for the next fused chunk: ``spec_chunk`` when no clamp
+    binds, else the largest power of two <= the tightest clamp.
+
+    ``num_iters`` is a STATIC jit argument — every distinct value compiles a
+    fresh executable of the whole draft+verify chunk graph. Near the tail of
+    a generation the seq-room / remaining-budget clamps would otherwise sweep
+    arbitrary values (31, 14, 5, 2, ...), each paying a full compile;
+    restricting the set to {spec_chunk} ∪ powers-of-two bounds the executables
+    at ~log2(spec_chunk) for a few wasted-iteration percent."""
+    cap = min(clamps)
+    if cap >= spec_chunk:
+        return max(1, spec_chunk)
+    if cap <= 1:
+        return 1
+    return 1 << (cap.bit_length() - 1)
+
+
+def chunk_advance(alive, out_toks, n, eos_ids):
+    """Shared in-graph advance for one fused-speculation iteration.
+
+    Given the iteration's committed-window tokens ``out_toks`` (B, W) and
+    accepted-draft counts ``n`` (B,), returns ``(take, new_tok, alive)``:
+    rows take ``n + 1`` tokens while alive (0 when frozen), the new last
+    committed token, and the alive mask with eos-hitting rows frozen — the
+    device-side mirror of the host's commit_row stop rule. Every speculative
+    runtime's chunk body (fused / EAGLE / EAGLE3 / CB) advances through this
+    one helper so the in-graph rule cannot drift from the host replay."""
+    width = out_toks.shape[1]
+    take = jnp.where(alive, n + 1, 0)
+    new_tok = jnp.take_along_axis(
+        out_toks, jnp.maximum(take - 1, 0)[:, None], axis=1)[:, 0]
+    win = jnp.arange(width, dtype=jnp.int32)[None, :] < take[:, None]
+    hit_eos = jnp.any(win & (out_toks == eos_ids[:, None]), axis=1)
+    return take, new_tok, alive & ~hit_eos
+
+
+def replay_chunk(out, n, committed: List[List[int]], done, positions, last_tok,
+                 accept_hist, eos_token_id: Optional[int],
+                 max_new_tokens: int) -> int:
+    """Exact host replay of one chunk's commits (the authority over device
+    state): folds the per-iteration outputs ``out`` (iters, B, W) / ``n``
+    (iters, B) into the committed lists via commit_row, advancing positions /
+    last_tok for rows that stay live. Returns the number of iterations that
+    still had live rows (tail iterations past everyone's stop ran — the
+    device cannot know acceptance in advance — but committed nothing)."""
+    b = len(committed)
+    used_iters = 0
+    for it in range(out.shape[0]):
+        used = False
+        for i in range(b):
+            if done[i] or len(committed[i]) >= max_new_tokens:
+                continue
+            used = True
+            take = int(n[it, i]) + 1
+            accept_hist[take - 1] += 1
+            done[i] = commit_row(committed[i], out[it, i, :take],
+                                 eos_token_id, max_new_tokens)
+            if not done[i]:
+                positions[i] += take
+                last_tok[i] = out[it, i, take - 1]
+        used_iters += int(used)
+    return used_iters
+
+
 def commit_row(committed_i: List[int], toks, eos_token_id: Optional[int],
                max_new_tokens: int) -> bool:
     """Append a step's committed tokens to one row; True if the row is now done.
@@ -148,7 +213,8 @@ class FusedSpeculativeModel:
     smaller model of the same family (or any arch with the same tokenizer).
     """
 
-    def __init__(self, target, draft, speculation_length: int, greedy: bool = True):
+    def __init__(self, target, draft, speculation_length: int, greedy: bool = True,
+                 spec_chunk: int = 8):
         if speculation_length < 2:
             raise ValueError("speculation_length must be >= 2 (1 draft + 1 verify)")
         if target.arch_args.vocab_size != draft.arch_args.vocab_size:
@@ -173,6 +239,10 @@ class FusedSpeculativeModel:
         self.draft = draft
         self.k = speculation_length
         self.greedy = greedy
+        # fused iterations per device dispatch (the host round trip amortizes
+        # over the whole chunk; positions/eos-stops advance IN-GRAPH and the
+        # host replays the exact commit rules after the sync)
+        self.spec_chunk = max(1, spec_chunk)
         self.sampling_config = target.sampling_config
         self._build_step()
 
@@ -204,27 +274,28 @@ class FusedSpeculativeModel:
             d_kernel = ({"use_kernel": True}
                         if self.draft._use_decode_kernel() else {})
 
-        def _step(t_params, d_params, last_tok, positions, t_cache, d_cache,
-                  sampling_params, key, decode_bucket, with_draft_logits=False):
-            """One fused speculative step.
+        def _iter(t_params, d_params, last_tok, positions, t_cache, d_cache,
+                  sampling_params, key, decode_bucket, with_draft_logits):
+            """One fused speculative iteration (draft loop + wide verify + accept).
 
             last_tok (B,) int32: last committed token (its KV not yet written).
             positions (B,) int32: write position of last_tok.
-            Returns (out_tokens (B, K), num_valid (B,), t_cache, d_cache, extras)
-            where out_tokens[:, :num_valid] are the newly committed tokens and
-            extras is the (B, K-1, V) draft logits when ``with_draft_logits``
-            (static) is set — the capture feeding draft-logit accuracy checks
-            (≈ reference `capture_draft_logits`, `utils/accuracy.py:1214`) — else ().
+            Returns (out_tokens (B, K), num_valid (B,), draft_logits|None,
+            t_cache, d_cache).
             """
             key_d, key_acc = jax.random.split(key)
-            d_keys = jax.random.split(key_d, k)
+            d_keys = jax.random.split(key_d, k - 1)
+            want_d_logits = with_draft_logits or not greedy
 
-            # --- draft loop: k iterations proposing k-1 candidates (one dispatch).
-            # The k-th iteration's *proposal* is discarded; it runs so that d_{k-1}'s
-            # KV lands in the draft cache — on full acceptance the next step starts
-            # past it and would otherwise read a never-written slot (the reference
-            # loops the draft spec_len times for the same reason,
-            # `model_base.py:1881-1930`).
+            # --- draft loop: k-1 proposal steps, then ONE KV-only step. The
+            # k-th forward runs so that d_{k-1}'s KV lands in the draft cache —
+            # on full acceptance the next step starts past it and would
+            # otherwise read a never-written slot (the reference loops the
+            # draft spec_len times for the same reason, `model_base.py:1881-1930`)
+            # — but its PROPOSAL is discarded, so it skips the draft's final
+            # norm + lm_head (skip_logits). Greedy chunks also skip stacking
+            # the (B, V) per-step draft logits through the scan: only the
+            # rejection sampler (or a draft-logit capture) reads them.
             def draft_body(carry, key_j):
                 tok, pos, cache = carry
                 with jax.default_matmul_precision(precision):
@@ -236,12 +307,21 @@ class FusedSpeculativeModel:
                     nxt = jnp.argmax(last, axis=-1).astype(jnp.int32)
                 else:
                     nxt = sampling_ops.sample(last, sampling_params, key_j, odsc)
-                return (nxt, pos + 1, cache), (nxt, last)
+                return (nxt, pos + 1, cache), ((nxt, last) if want_d_logits
+                                               else nxt)
 
-            (_, _, d_cache), (draft_toks, draft_logits) = jax.lax.scan(
+            (d_last, d_pos, d_cache), ys = jax.lax.scan(
                 draft_body, (last_tok, positions, d_cache), d_keys)
-            draft_toks = draft_toks.T[:, : k - 1]                       # (B, K-1)
-            draft_logits = draft_logits.transpose(1, 0, 2)[:, : k - 1]  # (B, K-1, V)
+            if want_d_logits:
+                draft_toks = ys[0].T                          # (B, K-1)
+                draft_logits = ys[1].transpose(1, 0, 2)       # (B, K-1, V)
+            else:
+                draft_toks, draft_logits = ys.T, None
+            with jax.default_matmul_precision(precision):
+                _, d_cache = model_base.decode_forward(
+                    d_params, d_args, d_last[:, None], d_pos, d_cache,
+                    decode_bucket, mesh=d_mesh, rules=d_rules,
+                    skip_logits=True, **d_kernel)
 
             # --- target verify: one wide decode over [last, d_1, ..., d_{k-1}] ------
             target_in = jnp.concatenate([last_tok[:, None], draft_toks], axis=1)
@@ -253,12 +333,40 @@ class FusedSpeculativeModel:
             out_toks, n = speculative_accept(draft_toks, draft_logits, t_logits,
                                              sampling_params, key_acc, odsc,
                                              greedy, vocab)
-            extras = draft_logits if with_draft_logits else ()
-            return out_toks, n, t_cache, d_cache, extras
+            return out_toks, n, draft_logits, t_cache, d_cache
 
-        self._spec_step = jax.jit(
-            _step, donate_argnums=(4, 5),
-            static_argnames=("decode_bucket", "with_draft_logits"))
+        def _chunk(t_params, d_params, tok0, positions0, alive0, t_cache,
+                   d_cache, sampling_params, eos_ids, key, decode_bucket,
+                   num_iters, with_draft_logits=False):
+            """``num_iters`` fused iterations in ONE device dispatch: per-row
+            positions advance in-graph by each row's accepted length and a row
+            whose committed window contains its eos stops advancing (the host
+            replays the exact same stopping rules after the sync — same
+            discipline as the CB serving chunk). Returns
+            ((out_toks (N, B, K), n (N, B)[, draft_logits (N, B, K-1, V)]),
+            t_cache, d_cache)."""
+            iter_keys = jax.random.split(key, num_iters)
+
+            def one_iter(carry, key_i):
+                tok, pos, alive, t_cache, d_cache = carry
+                out_toks, n, d_logits, t_cache, d_cache = _iter(
+                    t_params, d_params, tok, pos, t_cache, d_cache,
+                    sampling_params, key_i, decode_bucket, with_draft_logits)
+                take, new_tok, alive = chunk_advance(alive, out_toks, n,
+                                                     eos_ids)
+                tok = jnp.where(take > 0, new_tok, tok)
+                pos = pos + take
+                ys = (out_toks, n) + ((d_logits,) if with_draft_logits else ())
+                return (tok, pos, alive, t_cache, d_cache), ys
+
+            (_, _, _, t_cache, d_cache), ys = jax.lax.scan(
+                one_iter, (tok0, positions0, alive0, t_cache, d_cache),
+                iter_keys)
+            return ys, t_cache, d_cache
+
+        self._spec_chunk = jax.jit(
+            _chunk, donate_argnums=(5, 6),
+            static_argnames=("decode_bucket", "num_iters", "with_draft_logits"))
 
     # ------------------------------------------------------------------ generate
     def generate(
@@ -277,9 +385,14 @@ class FusedSpeculativeModel:
         Rows commit a variable 1..K tokens per step, so rows advance unevenly; finished
         rows keep stepping (SPMD batch) with frozen positions and their outputs dropped.
 
-        ``capture_draft_logits`` returns the per-step (B, K-1, V) draft logits in
-        ``output.draft_logits`` for draft-logit accuracy checking (≈ reference
-        `run_accuracy_draft_logit_test_flow`, `utils/accuracy.py:1214`).
+        Each device dispatch runs up to ``spec_chunk`` fused iterations with
+        positions / eos-stops advancing IN-GRAPH (one host round trip per
+        chunk, not per iteration); the host then replays the exact commit /
+        stopping rules over the chunk's per-iteration outputs.
+
+        ``capture_draft_logits`` returns the per-iteration (B, K-1, V) draft
+        logits in ``output.draft_logits`` for draft-logit accuracy checking
+        (≈ reference `run_accuracy_draft_logit_test_flow`, `utils/accuracy.py:1214`).
         """
         target, draft = self.target, self.draft
         cfg = target.tpu_config
@@ -329,36 +442,45 @@ class FusedSpeculativeModel:
         steps = 0
         draft_logits_loops: List[np.ndarray] = []
 
+        eos_ids = np.full((compiled_b,),
+                          -1 if eos_token_id is None else eos_token_id,
+                          dtype=np.int32)
         while not all(len(c) >= max_new_tokens or done[i] for i, c in enumerate(committed)):
-            max_pos = int(positions.max())
+            # live rows only bound the chunk: a finished row's frozen position
+            # must not shrink (or end) the live rows' budget, and alive0=False
+            # freezes it in-graph
+            live_pos = [int(positions[i]) for i, c in enumerate(committed)
+                        if not done[i] and len(c) < max_new_tokens]
+            max_pos = max(live_pos)
             if max_pos + self.k >= cfg.seq_len:
                 break
+            room = (cfg.seq_len - 1 - max_pos) // self.k
+            remaining = min(max_new_tokens - len(c)
+                            for i, c in enumerate(committed)
+                            if not done[i] and len(c) < max_new_tokens)
+            iters = quantize_chunk_iters(self.spec_chunk, room, remaining)
             bucket = autobucketing.select_bucket(target.tkg_buckets,
-                                                 max_pos + self.k)
+                                                 max_pos + self.k * iters)
+            alive0 = np.array([i < b and not done[i]
+                               and len(committed[i]) < max_new_tokens
+                               for i in range(compiled_b)])
             key, sub = jax.random.split(key)
             t_step0 = time.perf_counter()
-            out_dev, n_dev, target.kv_cache, draft.kv_cache, extras = self._spec_step(
+            ys, target.kv_cache, draft.kv_cache = self._spec_chunk(
                 target.params, draft.params, jnp.asarray(last_tok),
-                jnp.asarray(positions), target.kv_cache, draft.kv_cache,
-                sampling_params, sub, decode_bucket=bucket,
+                jnp.asarray(positions), jnp.asarray(alive0), target.kv_cache,
+                draft.kv_cache, sampling_params, jnp.asarray(eos_ids), sub,
+                decode_bucket=bucket, num_iters=iters,
                 with_draft_logits=capture_draft_logits)
-            out = np.asarray(out_dev)    # (B, K)
-            n = np.asarray(n_dev)        # (B,)
+            out = np.asarray(ys[0])      # (iters, B, K)
+            n = np.asarray(ys[1])        # (iters, B)
             benchmark_lib.record_submodel(benchmark_lib.SPECULATION_MODEL,
                                           time.perf_counter() - t_step0)
             if capture_draft_logits:
-                draft_logits_loops.append(np.asarray(extras))  # (B, K-1, V)
-            steps += 1
-            for i in range(b):
-                if done[i]:
-                    continue
-                take = int(n[i]) + 1
-                accept_hist[take - 1] += 1
-                done[i] = commit_row(committed[i], out[i, :take], eos_token_id,
-                                     max_new_tokens)
-                if not done[i]:
-                    positions[i] += take
-                    last_tok[i] = out[i, take - 1]
+                chunk_logits = np.asarray(ys[2])               # (iters, B, K-1, V)
+                draft_logits_loops.extend(chunk_logits[j] for j in range(iters))
+            steps += replay_chunk(out, n, committed, done, positions, last_tok,
+                                  accept_hist, eos_token_id, max_new_tokens)
             # frozen rows re-step harmlessly at their last position
 
         out = assemble_spec_output(committed, padded, b, pad_token_id, accept_hist,

@@ -79,7 +79,7 @@ def test_write_paged_matches_write_slots():
     np.testing.assert_array_equal(out_k[2], k_cache[2])
 
 
-@pytest.mark.parametrize("t", [1, 3])
+@pytest.mark.parametrize("t", [1, 3, 4, 8])
 @pytest.mark.parametrize("variant", [2, 3])
 def test_paged_attend_matches_gather_path(t, variant):
     k_cache, v_cache, block_table, positions = _setup()
@@ -297,3 +297,48 @@ def test_fp8_kernel_vs_gather_divergence_bounded():
     # bf16 flash vs fp32 softmax plus the denormal flush: the bound documents
     # the measured divergence envelope (typically ~1e-2 at these magnitudes)
     assert err < 5e-2, f"kernel-vs-gather divergence {err} exceeds bound"
+
+
+@pytest.mark.parametrize("case", ["contiguous", "straddle_window",
+                                  "straddle_block", "mixed_drop",
+                                  "noncontiguous"])
+def test_write_paged_multi_token_commit(case):
+    """The T>1 write (the speculative multi-query commit) must match
+    write_slots across every path: the fused single-RMW fast path (consecutive
+    slots inside one aligned pack window), the per-token fallback (window or
+    block straddles, non-consecutive slots), and dropped (-1) predication."""
+    k_cache, v_cache, block_table, positions = _setup(seed=9)
+    L, NB, H, BS, D = k_cache.shape
+    slots = {
+        # fp32 pack window is 8 rows: [16..19] sits inside [16, 24)
+        "contiguous": np.array([[16, 17, 18, 19], [32, 33, 34, 35],
+                                [48, 49, 50, 51], [64, 65, 66, 67]], np.int32),
+        "straddle_window": np.array([[6, 7, 8, 9], [22, 23, 24, 25],
+                                     [38, 39, 40, 41], [54, 55, 56, 57]],
+                                    np.int32),
+        "straddle_block": np.array([[14, 15, 16, 17], [30, 31, 32, 33],
+                                    [46, 47, 48, 49], [62, 63, 64, 65]],
+                                   np.int32),
+        "mixed_drop": np.array([[16, 17, -1, 19], [100, 101, 102, 103],
+                                [-1, -1, -1, -1], [0, 1, 2, 3]], np.int32),
+        "noncontiguous": np.array([[5, 9, 20, 33], [0, 2, 4, 6],
+                                   [40, 41, 50, 51], [80, 81, 82, 95]],
+                                  np.int32),
+    }[case]
+    B, T = slots.shape
+    rng = np.random.default_rng(10)
+    new_k = rng.normal(size=(B, H, T, D)).astype(np.float32)
+    new_v = rng.normal(size=(B, H, T, D)).astype(np.float32)
+    lidx = jnp.asarray(1, jnp.int32)
+
+    ref_k = np.asarray(block_kvcache.write_slots(
+        jnp.asarray(k_cache[1]), jnp.asarray(new_k), jnp.asarray(slots)))
+    ref_v = np.asarray(block_kvcache.write_slots(
+        jnp.asarray(v_cache[1]), jnp.asarray(new_v), jnp.asarray(slots)))
+    out_k, out_v = write_paged_stacked_kv(
+        jnp.asarray(k_cache), jnp.asarray(v_cache), jnp.asarray(new_k),
+        jnp.asarray(new_v), jnp.asarray(slots), lidx, interpret=True)
+    np.testing.assert_array_equal(np.asarray(out_k)[1], ref_k)
+    np.testing.assert_array_equal(np.asarray(out_v)[1], ref_v)
+    np.testing.assert_array_equal(np.asarray(out_k)[0], k_cache[0])
+    np.testing.assert_array_equal(np.asarray(out_k)[2], k_cache[2])

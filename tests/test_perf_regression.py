@@ -26,6 +26,16 @@ HF = {
 }
 
 
+def _bytes_accessed(lowered):
+    """bytes-accessed from a lowered computation, across jax versions
+    (cost_analysis() returns a dict on current jax, a one-element list of
+    dicts on older releases)."""
+    cost = lowered.compile().cost_analysis()
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0]
+    return float(cost["bytes accessed"])
+
+
 def _app(kernel):
     cfg = TpuConfig(batch_size=8, seq_len=512, max_context_length=128,
                     dtype="bfloat16", context_encoding_buckets=[128],
@@ -48,8 +58,7 @@ def _decode_bytes(app, steps=4):
         app.params, jnp.zeros((b,), jnp.int32), np.full((b,), 128, np.int32),
         app.kv_cache, sp, jax.random.PRNGKey(0), decode_bucket=512,
         num_steps=steps, with_logits=False, greedy=True)
-    cost = lowered.compile().cost_analysis()
-    return float(cost["bytes accessed"]) / steps
+    return _bytes_accessed(lowered) / steps
 
 
 def test_decode_step_bytes_bounded():
@@ -116,7 +125,7 @@ def _paged_decode_bytes(kernel, mb, steps=4):
         app.params, jnp.zeros((b,), jnp.int32), jnp.full((b,), 128, jnp.int32),
         r.cache, jnp.zeros((b, mb), jnp.int32), jnp.zeros((b, steps), jnp.int32),
         sp, jax.random.PRNGKey(0), jnp.zeros((b,), jnp.int32), num_steps=steps)
-    return float(lowered.compile().cost_analysis()["bytes accessed"]) / steps
+    return _bytes_accessed(lowered) / steps
 
 
 def test_paged_kernel_bytes_invariant_to_table_width():
@@ -133,3 +142,50 @@ def test_paged_kernel_bytes_invariant_to_table_width():
     gather_4 = _paged_decode_bytes(None, 4)
     gather_32 = _paged_decode_bytes(None, 32)
     assert gather_32 > gather_4 * 1.15, (gather_4, gather_32)   # documents the cliff
+
+
+def _multiquery_paged_bytes(kernel, mb, t=4):
+    """Compiled bytes-accessed of one MULTI-QUERY (q_len=t) paged decode — the
+    speculative verify shape — at block-table width ``mb``."""
+    from neuronx_distributed_inference_tpu.models import base as model_base
+
+    cfg = TpuConfig(batch_size=8, seq_len=4096, max_context_length=128,
+                    dtype="bfloat16", context_encoding_buckets=[128],
+                    token_generation_buckets=[512],
+                    is_continuous_batching=True, paged_attention_enabled=True,
+                    pa_num_blocks=66, pa_block_size=128,
+                    decode_kernel_enabled=kernel)
+    config = LlamaInferenceConfig(cfg, load_config=load_pretrained_config(HF))
+    app = LlamaForCausalLM(None, config)
+    app.load_random(seed=0)
+    cache = app.make_paged_cache(cfg.pa_num_blocks, cfg.pa_block_size)
+    b = 8
+    use_kernel = bool(kernel)
+
+    def _verify(params, ids, positions, cache, bt, sm):
+        return model_base.decode_forward(
+            params, app.arch_args, ids, positions, cache, None,
+            mesh=app.mesh, rules=app.sharding_rules, block_table=bt,
+            slot_mapping=sm, use_kernel=use_kernel)
+
+    lowered = jax.jit(_verify, donate_argnums=(3,)).lower(
+        app.params, jnp.zeros((b, t), jnp.int32), jnp.full((b,), 128, jnp.int32),
+        cache, jnp.zeros((b, mb), jnp.int32), jnp.zeros((b, t), jnp.int32))
+    return _bytes_accessed(lowered)
+
+
+def test_multiquery_paged_attend_bytes_invariant_to_table_width():
+    """The q_len>1 (speculative verify) paged kernel path must keep the
+    compiled traffic INVARIANT to the block-table width, exactly like the
+    q_len=1 canary above — the multi-query attend streams each row's live
+    blocks once for all K queries. The gather fallback grows with the table
+    (and re-streams it per query), which is the cliff the kernel exists to
+    avoid; absolute levels are not comparable between the paths (XLA charges
+    a pallas custom call's operands conservatively), so the canary is the
+    scaling."""
+    kern_4 = _multiquery_paged_bytes(True, 4)
+    kern_32 = _multiquery_paged_bytes(True, 32)
+    assert kern_32 <= kern_4 * 1.02, (kern_4, kern_32)
+    gather_4 = _multiquery_paged_bytes(None, 4)
+    gather_32 = _multiquery_paged_bytes(None, 32)
+    assert gather_32 > gather_4 * 1.15, (gather_4, gather_32)

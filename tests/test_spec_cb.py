@@ -296,3 +296,38 @@ def test_cb_spec_with_int8_kv_target(tiny_llama_hf_config, prompts):
     rid = runner.submit(prompts[0], max_new_tokens=8)
     results = runner.run_to_completion()
     assert results[rid] == want, "int8-KV spec serving diverged from plain int8"
+
+
+def test_cb_spec_default_chunk_partial_accepts_exact(tiny_llama_hf_config,
+                                                     prompts,
+                                                     reference_tokens):
+    """The DEFAULT spec_chunk (== decode_chunk iterations, the single-dispatch
+    serving configuration) with a disagreeing random draft: partial-accept
+    rollback must actually be exercised (acceptance mass below K) while the
+    emitted tokens stay exactly the dedicated plain runs' — including
+    staggered placement / slot reuse (3 requests over 2 slots)."""
+    runner = _spec_runner(tiny_llama_hf_config, paged=True)   # default chunk
+    assert runner.spec_chunk == runner.decode_chunk
+    ids = [runner.submit(p, max_new_tokens=10) for p in prompts]
+    results = runner.run_to_completion()
+    for i, rid in enumerate(ids):
+        assert results[rid] == reference_tokens[i], f"request {i} diverged"
+    # a random tiny draft disagrees often: the sub-K histogram bins must have
+    # mass, or this test proved nothing about partial-accept rollback
+    assert runner.acceptance_counts[: runner.k - 1].sum() > 0
+    assert runner.allocator.num_free == runner.allocator.num_blocks
+
+
+def test_cb_spec_adaptive_floor_stays_exact(tiny_llama_hf_config, prompts,
+                                            reference_tokens):
+    """spec_adaptive: a chance-level draft must trip the fallback to plain
+    decode chunks (the serving floor guard) — and the emitted tokens must
+    STILL exactly match the dedicated plain runs (both chunk kinds are
+    exact, so mixing them is too)."""
+    runner = _spec_runner(tiny_llama_hf_config, paged=True, spec_adaptive=True,
+                          spec_min_accept=10.0)   # impossible bar: always trips
+    ids = [runner.submit(p, max_new_tokens=10) for p in prompts]
+    results = runner.run_to_completion()
+    for i, rid in enumerate(ids):
+        assert results[rid] == reference_tokens[i], f"request {i} diverged"
+    assert runner._spec_off, "the adaptive guard never engaged"

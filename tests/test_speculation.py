@@ -158,3 +158,51 @@ def test_fused_spec_composes_with_flash_decoding(tiny_llama_hf_config):
                                  greedy=True)
     out = spec.generate(input_ids, max_new_tokens=60)
     np.testing.assert_array_equal(out.tokens, want.tokens)
+
+
+def test_chunked_dispatch_matches_per_iteration(target_draft):
+    """The multi-iteration single-dispatch chunk (spec_chunk > 1, positions
+    and eos-stops advancing in-graph) must emit EXACTLY what per-iteration
+    dispatch emits — including an eos that lands mid-chunk, which must stop
+    that row's in-graph advance at the same token the host replay commits."""
+    target, draft = target_draft
+    rng = np.random.default_rng(21)
+    input_ids = rng.integers(1, 256, size=(2, 9)).astype(np.int32)
+
+    one = FusedSpeculativeModel(target, draft, speculation_length=3,
+                                spec_chunk=1)
+    ref = one.generate(input_ids, max_new_tokens=14)
+    chunked = FusedSpeculativeModel(target, draft, speculation_length=3,
+                                    spec_chunk=4)
+    out = chunked.generate(input_ids, max_new_tokens=14)
+    np.testing.assert_array_equal(out.tokens, ref.tokens)
+    np.testing.assert_array_equal(out.num_generated, ref.num_generated)
+    np.testing.assert_array_equal(out.acceptance_counts, ref.acceptance_counts)
+
+    # eos mid-stream (hence mid-chunk for spec_chunk=4): same stopping point
+    eos = int(ref.tokens[0, 4])
+    ref_e = one.generate(input_ids, max_new_tokens=14, eos_token_id=eos)
+    out_e = chunked.generate(input_ids, max_new_tokens=14, eos_token_id=eos)
+    np.testing.assert_array_equal(out_e.num_generated, ref_e.num_generated)
+    for i in range(2):
+        np.testing.assert_array_equal(
+            out_e.tokens[i, : out_e.num_generated[i]],
+            ref_e.tokens[i, : ref_e.num_generated[i]])
+
+
+def test_chunked_capture_draft_logits_matches(target_draft):
+    """capture_draft_logits under chunked dispatch: one (B, K-1, V) array per
+    ITERATION, identical to the per-iteration dispatch's captures."""
+    target, draft = target_draft
+    rng = np.random.default_rng(22)
+    input_ids = rng.integers(1, 256, size=(2, 8)).astype(np.int32)
+    one = FusedSpeculativeModel(target, draft, speculation_length=3,
+                                spec_chunk=1)
+    ref = one.generate(input_ids, max_new_tokens=9, capture_draft_logits=True)
+    chunked = FusedSpeculativeModel(target, draft, speculation_length=3,
+                                    spec_chunk=3)
+    out = chunked.generate(input_ids, max_new_tokens=9,
+                           capture_draft_logits=True)
+    assert len(out.draft_logits) >= len(ref.draft_logits)
+    for a, b in zip(ref.draft_logits, out.draft_logits):
+        np.testing.assert_allclose(b, a, atol=1e-5, rtol=1e-5)

@@ -465,3 +465,52 @@ def test_int4_pattern_family_matches_dequant_twin():
         out2 = twin.generate(ids, max_new_tokens=8)
         np.testing.assert_array_equal(np.asarray(out.tokens),
                                       np.asarray(out2.tokens))
+
+
+def test_int8_checkpoint_repacks_to_int4_on_load(tiny_llama_hf_config):
+    """A PRE-QUANTIZED int8 {"q","s"} checkpoint loaded under
+    weight_dtype='int4' must serve int4 (repack_int8_to_int4 in the load
+    path), not silently stay on the int8 path — and the repacked model's
+    greedy tokens must match loading the same checkpoint through an
+    explicitly repacked tree."""
+    from neuronx_distributed_inference_tpu.ops.quantization import (
+        W4_DEFAULT_PARAMS)
+    from neuronx_distributed_inference_tpu.ops.w4 import repack_int8_to_int4
+
+    def make(weight_dtype):
+        tpu_cfg = TpuConfig(
+            batch_size=1, seq_len=64, max_context_length=32, dtype="float32",
+            context_encoding_buckets=[16, 32], token_generation_buckets=[32, 64],
+            quantization_config=QuantizationConfig(
+                quantize_weights=True, weight_dtype=weight_dtype))
+        config = LlamaInferenceConfig(
+            tpu_cfg, load_config=load_pretrained_config(tiny_llama_hf_config))
+        return LlamaForCausalLM(None, config)
+
+    # an int8-quantized host tree (what a pre-quantized int8 checkpoint is)
+    int8_app = make("int8")
+    int8_app.load_random(seed=3)
+    host_int8 = jax.tree.map(np.asarray, int8_app.params)
+
+    app = make("int4")
+    app.load_host_params(host_int8)
+    for name in ("wq", "wo", "wg", "wu", "wd"):
+        assert name in W4_DEFAULT_PARAMS
+        assert "q4" in app.params["layers"][name], f"{name} not repacked"
+    assert "q" in app.params["layers"]["wk"]       # small projections stay int8
+
+    rng = np.random.default_rng(5)
+    ids = rng.integers(1, 256, size=(1, 10)).astype(np.int32)
+    out = app.generate(ids, max_new_tokens=6)
+
+    # reference: repack the same tree explicitly before loading
+    explicit = dict(host_int8)
+    explicit["layers"] = {
+        k: (repack_int8_to_int4(v) if k in W4_DEFAULT_PARAMS
+            and isinstance(v, dict) and "q" in v else v)
+        for k, v in host_int8["layers"].items()}
+    app2 = make("int4")
+    app2.load_host_params(explicit)
+    out2 = app2.generate(ids, max_new_tokens=6)
+    np.testing.assert_array_equal(np.asarray(out.tokens),
+                                  np.asarray(out2.tokens))
