@@ -141,6 +141,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="drive the prompts through the continuous-batching "
                         "runner (slot-based serving; honors --paged-attention "
                         "and prefix caching)")
+    g.add_argument("--prefill-chunk", type=int, default=0,
+                   help="serve with MIXED prefill+decode steps (paged only): "
+                        "prompts stream as chunk rows of this bucket inside "
+                        "the decode dispatches (the token-budget scheduler)")
+    g.add_argument("--prefill-token-budget", type=int, default=0,
+                   help="max prompt tokens packed per mixed serving step "
+                        "(default 2x --prefill-chunk)")
     g.add_argument("--speculation-length", type=int, default=0)
     g.add_argument("--speculation-type", default="fused",
                    choices=["fused", "eagle", "eagle3", "medusa"],
@@ -533,7 +540,14 @@ def _run_serving(args, app, tokenizer) -> None:
     (≈ the reference's continuous-batching serve path)."""
     from .runtime.continuous_batching import ContinuousBatchingRunner
 
-    runner = ContinuousBatchingRunner(app)
+    kw = {}
+    if args.prefill_chunk:
+        kw["prefill_chunk"] = args.prefill_chunk
+    if args.prefill_token_budget:
+        # forwarded even without --prefill-chunk so the runner's own
+        # validation raises instead of silently ignoring the flag
+        kw["prefill_token_budget"] = args.prefill_token_budget
+    runner = ContinuousBatchingRunner(app, **kw)
     input_ids, attention_mask = _encode_prompts(args, tokenizer,
                                                 app.arch_args.vocab_size)
     rids = []

@@ -682,15 +682,18 @@ def _sharded_paged_kv_write(k_cache, v_cache, new_k, new_v, slot_mapping, layer_
 
 def _sharded_paged_attend(q, k_cache, v_cache, positions, layer_idx, block_table,
                           args: ModelArchArgs, mesh, rules, sinks=None,
-                          alibi_slopes=None):
+                          alibi_slopes=None, q_lens=None):
     """Ragged paged decode attention (Pallas, block-table-indexed, length-aware)
     under the mesh.
 
     ≈ the reference TKG attention kernels over the paged cache — the serving hot
     path SURVEY §7 calls "the performance cliff": HBM reads track each row's live
-    length instead of the block-table width."""
+    length instead of the block-table width. With ``q_lens`` the MIXED-STEP
+    kernel serves per-row variable q_len (decode rows q=1 alongside prefill
+    chunks) in one call — see ops/paged_decode.paged_mixed_attention_stacked."""
     from ..modules.block_kvcache import PAGED_CACHE_LOGICAL
-    from ..ops.paged_decode import paged_decode_attention_stacked
+    from ..ops.paged_decode import (paged_decode_attention_stacked,
+                                    paged_mixed_attention_stacked)
 
     interpret = jax.default_backend() == "cpu"
     xl, xo, kw_names = _head_extras(sinks, alibi_slopes, "decode_heads")
@@ -698,12 +701,20 @@ def _sharded_paged_attend(q, k_cache, v_cache, positions, layer_idx, block_table
                   ("decode_batch",), None, ("decode_batch", None)] + xl
     operands = [q, k_cache, v_cache, positions, layer_idx, block_table] + xo
 
-    def _local(q, kc, vc, p, li, bt, *extras):
+    if q_lens is not None:
+        in_logical = in_logical[:4] + [("decode_batch",)] + in_logical[4:]
+        operands = operands[:4] + [q_lens] + operands[4:]
+
+    def _local(q, kc, vc, p, *rest):
+        extras = rest[3 if q_lens is not None else 2:]
         kw = dict(zip(kw_names, extras))
-        return paged_decode_attention_stacked(
-            q, kc, vc, p, li, bt, scale=args.attention_scale,
-            window=args.sliding_window, soft_cap=args.logits_soft_cap,
-            interpret=interpret, **kw)
+        kw.update(scale=args.attention_scale, window=args.sliding_window,
+                  soft_cap=args.logits_soft_cap, interpret=interpret)
+        if q_lens is not None:
+            ql, li, bt = rest[:3]
+            return paged_mixed_attention_stacked(q, kc, vc, p, ql, li, bt, **kw)
+        li, bt = rest[:2]
+        return paged_decode_attention_stacked(q, kc, vc, p, li, bt, **kw)
 
     fn = _shard_mapped(_local, mesh, rules, in_logical, _DECODE_Q)
     return fn(*operands)
@@ -843,6 +854,9 @@ def _decoder_layer(
     # with stacked_layer_idx: (block_table, slot_mapping) — the stacked cache is
     # PAGED (L, NB, H, BS, D) and the Pallas ragged paged kernels serve the step
     paged_stacked=None,
+    # (B,) per-row live query counts: MIXED-STEP ragged serving (decode rows
+    # q=1 + prefill-chunk rows q<=T in one dispatch); kernel path only
+    q_lens: Optional[jnp.ndarray] = None,
     # (B,) true row lengths: prefill writes into a rolling window cache (the layer's
     # cache stack is W wide; see kvcache.write_prefill_rolling)
     rolling_lengths: Optional[jnp.ndarray] = None,
@@ -924,7 +938,8 @@ def _decoder_layer(
             attn = _sharded_paged_attend(q, k_cache, v_cache, positions,
                                          stacked_layer_idx, block_table, args,
                                          mesh, rules, sinks=sinks_arr,
-                                         alibi_slopes=alibi_slopes)
+                                         alibi_slopes=alibi_slopes,
+                                         q_lens=q_lens)
         else:
             wp = positions if write_positions is None else write_positions
             k_cache, v_cache = _sharded_kv_write(
@@ -1503,20 +1518,22 @@ def _run_stack_decode_kernel(params: Params, args: ModelArchArgs, h, cos, sin, m
 
 def _run_stack_paged_kernel(params: Params, args: ModelArchArgs, h, cos, sin,
                             cache, positions, block_table, slot_mapping, mesh,
-                            rules, adapter_ids=None, alibi_slopes=None):
+                            rules, adapter_ids=None, alibi_slopes=None,
+                            q_lens=None):
     """Decode layer scan for the Pallas ragged paged path (continuous batching).
 
     The paged cache (L, NB, H, BS, D) rides the scan as a CARRY — the block pool is
     never sliced per layer (the gather path's per-layer xs/ys copies scale with the
     whole pool, not the live tokens). Per layer: block-table RMW write + ragged
-    length-aware attend. ≈ the reference's paged TKG hot path
+    length-aware attend (with ``q_lens``: the mixed-step variable-q_len attend).
+    ≈ the reference's paged TKG hot path
     (`block_kv_cache_manager.py:268-374` + `attention_base.py:1483-1677`)."""
     def step(carry_h, lp, ck, cv, li, kvs):
         return _decoder_layer(
             lp, args, carry_h, cos, sin, None, ck, cv, positions, None, mesh,
             rules, adapter_ids=adapter_ids, stacked_layer_idx=li,
             paged_stacked=(block_table, slot_mapping), alibi_slopes=alibi_slopes,
-            kv_scales=kvs)
+            kv_scales=kvs, q_lens=q_lens)
 
     h, k_new, v_new, _ = _scan_layers(
         params["layers"], cache["k"], cache["v"], h, step, cache_mode="carry",
@@ -1555,7 +1572,8 @@ def _lm_head(params: Params, args: ModelArchArgs, h, mesh, rules) -> jnp.ndarray
 
 
 def _finalize_logits(params, args: ModelArchArgs, h, cache, mesh, rules,
-                     return_hidden=False, caps=None, skip_logits=False):
+                     return_hidden=False, caps=None, skip_logits=False,
+                     logit_idx=None):
     """Shared decode epilogue: final norm + lm_head, assembling the
     (logits, cache[, hidden][, captures]) return tuple every decode path shares.
 
@@ -1563,7 +1581,13 @@ def _finalize_logits(params, args: ModelArchArgs, h, cache, mesh, rules,
     returns ``(None, cache, ...)`` — for KV-only forwards whose logits are
     never read (the last draft step of a speculative iteration runs only so
     its KV lands before a possible full accept; streaming the lm_head and
-    materializing a (B, V) logits tensor for it is pure waste)."""
+    materializing a (B, V) logits tensor for it is pure waste).
+
+    ``logit_idx`` ((B,) traced) gathers ONE hidden row per sequence before the
+    final norm + lm_head, so only that token pays the vocab projection —
+    logits return (B, 1, V). The chunked-insert / mixed-step sampling shape:
+    a T-token prefill chunk needs logits only at its last live token
+    (materializing (B, T, V) for a 128k vocab is ~131 MB per insert window)."""
     if skip_logits:
         if return_hidden:
             # every other path returns the POST-final-norm hidden; handing a
@@ -1576,6 +1600,11 @@ def _finalize_logits(params, args: ModelArchArgs, h, cache, mesh, rules,
         if caps is not None:
             res = res + (caps,)
         return res
+    if logit_idx is not None:
+        if return_hidden:
+            raise ValueError("logit_idx does not compose with return_hidden "
+                             "(the hidden would be a single gathered row)")
+        h = jnp.take_along_axis(h, logit_idx[:, None, None], axis=1)  # (B,1,H)
     h = _norm(h, params["final_norm"], args, params.get("final_norm_b"))
     logits = _lm_head(params, args, h, mesh, rules)
     res = (logits, cache)
@@ -1720,6 +1749,14 @@ def decode_forward(
     # static: KV-only forward — skip final norm + lm_head, logits return None
     # (the k-th draft step of a fused speculative iteration)
     skip_logits: bool = False,
+    # (B,) per-row live query counts — MIXED-STEP ragged serving (paged only):
+    # decode rows carry q_len 1 and prefill-chunk rows up to T in ONE dispatch;
+    # tokens at or beyond q_lens[b] are padding (masked attention, slot -1
+    # writes expected in slot_mapping)
+    q_lens: Optional[jnp.ndarray] = None,
+    # (B,) traced: compute logits ONLY at this token index per row (see
+    # _finalize_logits); returns (B, 1, V)
+    logit_idx: Optional[jnp.ndarray] = None,
 ) -> Tuple[jnp.ndarray, kvcache.KVCache]:
     """Token generation: returns (logits (B, T, V) fp32, updated cache).
 
@@ -1745,6 +1782,11 @@ def decode_forward(
         paged = (block_table, slot_mapping)
         block_size = cache["k"].shape[3]
         decode_bucket = block_table.shape[1] * block_size
+    if q_lens is not None and (block_table is None or tree is not None
+                               or window_row is not None or flash_decoding):
+        raise ValueError("q_lens (mixed-step ragged serving) requires paged "
+                         "chain decode (block_table given; no tree/window/"
+                         "flash-decoding)")
     b, t = input_ids.shape
     h = _embed(params, args, input_ids, mesh, rules)
     if tree is None:
@@ -1791,7 +1833,8 @@ def decode_forward(
                 cache, position_ids, decode_bucket, mesh, rules,
                 adapter_ids=adapter_ids)
             return _finalize_logits(params, args, h, cache, mesh, rules,
-                                    return_hidden, skip_logits=skip_logits)
+                                    return_hidden, skip_logits=skip_logits,
+                                    logit_idx=logit_idx)
         slopes = params.get("alibi_slopes") if args.alibi else None
         if paged is not None:
             # ragged paged serving hot path: Pallas block-table kernels, cache
@@ -1799,9 +1842,10 @@ def decode_forward(
             h, cache = _run_stack_paged_kernel(
                 params, args, h, cos, sin, cache, position_ids, block_table,
                 slot_mapping, mesh, rules, adapter_ids=adapter_ids,
-                alibi_slopes=slopes)
+                alibi_slopes=slopes, q_lens=q_lens)
             return _finalize_logits(params, args, h, cache, mesh, rules,
-                                    return_hidden, skip_logits=skip_logits)
+                                    return_hidden, skip_logits=skip_logits,
+                                    logit_idx=logit_idx)
         kv_pos_k = jnp.arange(decode_bucket)[None, None, None, :]
         mask_k = kv_pos_k <= pos_grid[:, None, :, None]
         if args.sliding_window is not None:
@@ -1812,11 +1856,20 @@ def decode_forward(
             decode_bucket=decode_bucket, mesh=mesh, rules=rules,
             adapter_ids=adapter_ids, alibi_slopes=slopes)
         return _finalize_logits(params, args, h, cache, mesh, rules,
-                                return_hidden, skip_logits=skip_logits)
+                                return_hidden, skip_logits=skip_logits,
+                                logit_idx=logit_idx)
     kv_pos = jnp.arange(decode_bucket)[None, None, None, :]
     q_pos = pos_grid[:, None, :, None]
     if tree is None:
         mask = kv_pos <= q_pos                                     # (B, 1, T, bucket)
+        if q_lens is not None:
+            # mixed-step ragged rows: tokens at or beyond a row's q_len are
+            # padding — fully masked (attend's finite NEG_INF keeps their
+            # softmax NaN-free; their outputs are discarded and their KV
+            # writes carry slot -1)
+            mask = jnp.logical_and(
+                mask,
+                (jnp.arange(t)[None, :] < q_lens[:, None])[:, None, :, None])
     else:
         # committed-context slots are visible to all nodes; tree slots follow ancestry
         write_start = position_ids[:, None, None, None]            # (B, 1, 1, 1)
@@ -1851,7 +1904,8 @@ def decode_forward(
             positions=position_ids, decode_bucket=decode_bucket, mesh=mesh,
             rules=rules, adapter_ids=adapter_ids)
         return _finalize_logits(params, args, h, cache, mesh, rules,
-                                return_hidden, skip_logits=skip_logits)
+                                return_hidden, skip_logits=skip_logits,
+                                logit_idx=logit_idx)
     if sliding is not None:
         mask = sliding
 
@@ -1869,7 +1923,8 @@ def decode_forward(
             block_table, slot_mapping, mesh, rules, adapter_ids=adapter_ids,
             attn_bias=attn_bias)
         return _finalize_logits(params, args, h, cache, mesh, rules,
-                                return_hidden, skip_logits=skip_logits)
+                                return_hidden, skip_logits=skip_logits,
+                                logit_idx=logit_idx)
     out = _run_stack(params, args, h, cos, sin, mask, cache,
                      positions=position_ids, decode_bucket=decode_bucket,
                      mesh=mesh, rules=rules,
@@ -1878,4 +1933,5 @@ def decode_forward(
                      flash_decoding=flash_decoding, attn_bias=attn_bias)
     return _finalize_logits(params, args, out[0], out[1], mesh, rules,
                             return_hidden, skip_logits=skip_logits,
+                            logit_idx=logit_idx,
                             caps=out[2] if capture_layers else None)

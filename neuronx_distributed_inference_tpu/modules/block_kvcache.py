@@ -124,6 +124,20 @@ def make_slot_mapping(block_table: np.ndarray, positions: np.ndarray,
     return slots.astype(np.int32)
 
 
+def make_chunk_slot_mapping(block_table: np.ndarray, positions: np.ndarray,
+                            lengths: np.ndarray, num_tokens: int,
+                            block_size: int) -> np.ndarray:
+    """Host helper: flat slots (B, T) for per-row CONTIGUOUS token runs of
+    ragged lengths — the mixed-step prefill-chunk commit shape. Row b writes
+    ``lengths[b]`` tokens at positions ``positions[b] + t``; the suffix gets
+    slot -1 (dropped). The result satisfies the chunk-write kernel's contract
+    (live slots are a position-consecutive prefix; see
+    ops/paged_decode._paged_write_kernel)."""
+    valid = np.arange(num_tokens)[None, :] < np.asarray(lengths)[:, None]
+    return make_slot_mapping(block_table, positions, num_tokens, block_size,
+                             valid=valid)
+
+
 # ---------------------------------------------------------------------------
 # Host-side block allocator with prefix caching
 # ---------------------------------------------------------------------------

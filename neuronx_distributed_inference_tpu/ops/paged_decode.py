@@ -75,8 +75,9 @@ def _pack(dtype) -> int:
 # --- paged KV write -------------------------------------------------------------------
 
 
-def _paged_write_kernel(slots_ref, lidx_ref, new_k_ref, new_v_ref, _k_in, _v_in,
-                        k_out, v_out, sk, sv, sems, *, t: int, pack: int, bs: int):
+def _paged_write_kernel(slots_ref, lidx_ref, live_ref, new_k_ref, new_v_ref,
+                        _k_in, _v_in, k_out, v_out, sk, sv, sems, *, t: int,
+                        pack: int, bs: int):
     """Per-row scatter of the step's t fresh tokens, tile-aligned RMW.
 
     t == 1 (plain decode): one RMW window per row. t in {2..8} (the
@@ -87,7 +88,17 @@ def _paged_write_kernel(slots_ref, lidx_ref, new_k_ref, new_v_ref, _k_in, _v_in,
     4*t. Rows that straddle a window/block boundary, carry dropped (-1) slots,
     or aren't consecutive fall back to the per-token loop. Dropped slots stay
     predicated off in both paths (the conditional commit: a dead CB slot or a
-    masked speculative row writes nothing)."""
+    masked speculative row writes nothing).
+
+    t > 8 (the CHUNK-length commit of mixed prefill+decode serving steps):
+    each row's live slots must be the position-consecutive prefix of the row
+    (suffix -1 padding only — the shape make_slot_mapping emits for a
+    contiguous token run with a tail valid mask; live counts arrive scalar-
+    prefetched in ``live_ref``). The row's run is walked per aligned pack
+    window: ONE read-modify-write commits up to ``pack`` tokens (4 DMA waits
+    per window instead of per token), and window boundaries coincide with
+    position boundaries (bs % pack == 0), so block crossings just change the
+    window's destination block."""
     b = pl.program_id(0)
     l = lidx_ref[0]
 
@@ -127,6 +138,43 @@ def _paged_write_kernel(slots_ref, lidx_ref, new_k_ref, new_v_ref, _k_in, _v_in,
 
     if t == 1:
         _per_token()
+        return
+
+    if t > 8:
+        # chunk-length commit: consecutive positions, suffix drops only. Walk
+        # the run window by window — group boundaries are the positions where
+        # slot % pack rolls to 0 (consecutive positions advance off by 1 and
+        # bs % pack == 0, so this holds across block crossings too).
+        n = live_ref[b]
+
+        @pl.when(n > 0)
+        def _chunk():
+            base = b * t
+            a0 = slots_ref[base] % pack    # first token's offset in its window
+            for g in range((t + pack - 1) // pack + 1):
+                t0 = jnp.maximum(g * pack - a0, 0)
+                t1 = jnp.minimum((g + 1) * pack - a0, n)
+                cnt = t1 - t0
+
+                @pl.when(cnt > 0)
+                def _one(t0=t0, cnt=cnt):
+                    s0 = slots_ref[base + t0]
+                    blk = s0 // bs
+                    off = s0 % bs
+                    w0 = (off // pack) * pack
+
+                    def edit(off=off, w0=w0, t0=t0, cnt=cnt):
+                        iota = jax.lax.broadcasted_iota(jnp.int32, sk.shape, 1)
+                        rel = iota - (off - w0)    # window row -> token offset
+                        for j in range(pack):      # blends only; one RMW total
+                            src = jnp.minimum(t0 + j, t - 1)
+                            hit = jnp.logical_and(rel == j, j < cnt)
+                            sk[:] = jnp.where(
+                                hit, new_k_ref[0, :, pl.ds(src, 1), :], sk[:])
+                            sv[:] = jnp.where(
+                                hit, new_v_ref[0, :, pl.ds(src, 1), :], sv[:])
+
+                    _rmw(blk, w0, edit)
         return
 
     slot0 = slots_ref[b * t]
@@ -170,18 +218,44 @@ def write_paged_stacked_kv(
     """Scatter the step's K and V rows into the stacked paged cache in one kernel.
 
     ≈ `write_kv_cache_at_batch_kernel` (`modules/kvcache/utils.py:20-38`) over the
-    paged layout: tile-aligned RMW windows, -1 slots dropped. T > 1 (the
-    speculative multi-query commit) collapses a row's consecutive
-    same-window slots into ONE RMW — see _paged_write_kernel."""
+    paged layout: tile-aligned RMW windows, -1 slots dropped. T in {2..8} (the
+    speculative multi-query commit) collapses a row's consecutive same-window
+    slots into ONE RMW; T > 8 (the chunk-length commit of mixed serving steps)
+    walks the row's consecutive run one RMW per aligned pack window — each
+    row's live slots must then be a position-consecutive prefix (suffix -1
+    padding only; ENFORCED: a non-conforming suffix is dropped like -1 slots,
+    never written to the wrong place). See _paged_write_kernel."""
     b, h, t, d = new_k.shape
     bs = k_cache.shape[3]
     pack = _pack(k_cache.dtype)
     if bs % pack != 0:
         raise ValueError(f"pa_block_size {bs} must be a multiple of {pack} for "
                          f"{k_cache.dtype} caches")
+    slots = slot_mapping.reshape(b, -1).astype(jnp.int32)
+    # per-row live-token counts for the chunk path (t > 8): the length of the
+    # longest POSITION-CONSECUTIVE prefix — slot +1 within a block, or a jump
+    # to some block's first slot right after a block's last (bs % pack == 0
+    # makes those exactly the pack-window boundaries the kernel walks).
+    # Clamping here ENFORCES the chunk contract in-graph: a malformed mapping
+    # (interior -1, non-consecutive jump) has its non-conforming suffix
+    # DROPPED — the defined -1 semantics — instead of corrupting other slots.
+    # Tiny and cheap to compute unconditionally, and keeping the operand list
+    # fixed keeps one kernel signature across all T
+    if t > 1:
+        prev, nxt = slots[:, :-1], slots[:, 1:]
+        ok = jnp.logical_or(
+            nxt == prev + 1,
+            jnp.logical_and(nxt % bs == 0,
+                            jnp.logical_and(nxt >= 0, prev % bs == bs - 1)))
+        run = jnp.concatenate(
+            [slots[:, :1] >= 0, jnp.logical_and(ok, slots[:, 1:] >= 0)],
+            axis=1)
+        live = jnp.sum(jnp.cumprod(run.astype(jnp.int32), axis=1), axis=1)
+    else:
+        live = jnp.sum((slots >= 0).astype(jnp.int32), axis=1)
     kernel = functools.partial(_paged_write_kernel, t=t, pack=pack, bs=bs)
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=3,
         grid=(b,),
         in_specs=[
             pl.BlockSpec((1, h, t, d), lambda bi, *_: (bi, 0, 0, 0)),
@@ -202,10 +276,10 @@ def write_paged_stacked_kv(
         grid_spec=grid_spec,
         out_shape=[jax.ShapeDtypeStruct(k_cache.shape, k_cache.dtype),
                    jax.ShapeDtypeStruct(v_cache.shape, v_cache.dtype)],
-        input_output_aliases={4: 0, 5: 1},   # caches (after 2 prefetch + 2 new)
+        input_output_aliases={5: 0, 6: 1},   # caches (after 3 prefetch + 2 new)
         interpret=interpret,
-    )(slot_mapping.reshape(-1).astype(jnp.int32),
-      layer_idx.reshape(1).astype(jnp.int32), new_k, new_v, k_cache, v_cache)
+    )(slots.reshape(-1), layer_idx.reshape(1).astype(jnp.int32), live,
+      new_k, new_v, k_cache, v_cache)
 
 
 # --- paged decode attention -----------------------------------------------------------
@@ -647,3 +721,286 @@ def paged_decode_attention_stacked(
     else:
         out = out[:, :, : n_rep * t, :].reshape(b, hkv, n_rep, t, d)
     return out.reshape(b, hq, t, d)
+
+
+# --- mixed-step ragged paged attention ------------------------------------------------
+
+
+def _paged_mixed_attend_kernel(pos_ref, qlen_ref, lidx_ref, bt_ref, q_ref,
+                               *refs, o_ref=None, m_scratch=None,
+                               l_scratch=None, acc_scratch=None, scale: float,
+                               bs: int, kb: int, num_cells: int, qt: int,
+                               hq: int, n_rep: int, hkv: int, tr: int,
+                               window: Optional[int],
+                               soft_cap: Optional[float], has_sinks: bool,
+                               has_slopes: bool):
+    """Mixed-step cell body: per-row VARIABLE q_len over token-major q tiles.
+
+    Grid is (row, q_tile, kv_cell). q rows pack token-major — row r of a tile
+    is q head ``r % hq`` of token ``tile0 + r // hq`` — so a q tile is ``qt``
+    whole tokens and tiling never splits a head group. Decode rows (q_len 1)
+    run only tile 0 and only the cells at or below their position; prefill-
+    chunk rows (q_len up to the chunk bucket) run the causal triangle: tile
+    qi skips every cell beyond ``pos + min(q_len, (qi+1)*qt) - 1``, and the
+    clamped kv index map turns the skipped fetches into elided DMAs — HBM
+    traffic tracks each row's LIVE length exactly as in the q_len=1 kernel.
+    Rows/tokens at or beyond q_len are masked (l stays 0 -> output rows 0)."""
+    kv_refs = refs[: 2 * kb]
+    idx = 2 * kb
+    sinks_ref = slopes_ref = None
+    if has_sinks:
+        sinks_ref, idx = refs[idx], idx + 1
+    if has_slopes:
+        slopes_ref, idx = refs[idx], idx + 1
+
+    bi = pl.program_id(0)
+    qi = pl.program_id(1)
+    ci = pl.program_id(2)
+
+    @pl.when(ci == 0)
+    def _init():
+        m_scratch[:] = jnp.full_like(m_scratch, NEG_INF)
+        l_scratch[:] = jnp.zeros_like(l_scratch)
+        acc_scratch[:] = jnp.zeros_like(acc_scratch)
+
+    width = kb * bs
+    k_start = ci * width
+    d = q_ref.shape[-1]
+    cols = hkv * bs
+
+    pos = pos_ref[bi]
+    qlen = qlen_ref[bi]
+    tile0 = qi * qt                       # first token of this q tile
+    tile_max_q = pos + jnp.minimum(qlen, tile0 + qt) - 1
+    run = jnp.logical_and(tile0 < qlen, k_start <= tile_max_q)
+    if window is not None:
+        run = jnp.logical_and(run, k_start + width - 1 > pos + tile0 - window)
+
+    row_iota = jax.lax.broadcasted_iota(jnp.int32, (tr, cols), 0)
+    col_iota = jax.lax.broadcasted_iota(jnp.int32, (tr, cols), 1)
+    tok = tile0 + row_iota // hq          # global in-chunk token index
+    same_head = ((row_iota % hq) // n_rep) == (col_iota // bs)
+    col_off = col_iota % bs
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0]                                   # (tr, d)
+        q_pos = pos + tok
+        live = tok < qlen
+        int8_kv = jnp.dtype(kv_refs[0].dtype) == jnp.int8
+        if int8_kv:
+            # int8 KV (static scales): MXU int8 x int8, per-row q quantization
+            # — same discipline as the q_len<=8 kernel
+            qf = q.astype(jnp.float32)
+            sx = jnp.max(jnp.abs(qf), axis=1, keepdims=True) / 127.0
+            sx = jnp.maximum(sx, 1e-8)
+            qq = jnp.clip(jnp.round(qf / sx), -127, 127).astype(jnp.int8)
+        for g in range(kb):
+            k = kv_refs[2 * g][0, 0].reshape(cols, d)
+            v = kv_refs[2 * g + 1][0, 0].reshape(cols, d)
+            kv_pos = k_start + g * bs + col_off
+            mask = jnp.logical_and(jnp.logical_and(same_head, live),
+                                   kv_pos <= q_pos)
+            if window is not None:
+                mask = jnp.logical_and(mask, kv_pos > q_pos - window)
+
+            if int8_kv:
+                s = jax.lax.dot_general(
+                    qq, k, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.int32
+                ).astype(jnp.float32) * (sx * scale)
+            else:
+                k = _vmem_cast(k, q.dtype)
+                s = jax.lax.dot_general(
+                    q, k, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32) * scale
+            if slopes_ref is not None:
+                s = s - slopes_ref[:, 0:1] * (q_pos - kv_pos).astype(
+                    jnp.float32)
+            if soft_cap is not None:
+                s = soft_cap * jnp.tanh(s / soft_cap)
+            s = jnp.where(mask, s, NEG_INF)
+
+            m_prev = m_scratch[:, 0:1]
+            l_prev = l_scratch[:, 0:1]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+            alpha = jnp.exp(jnp.minimum(m_prev - m_new, 0.0))
+            p = jnp.exp(s - m_new)
+            p = jnp.where(mask, p, 0.0)
+            l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+            if int8_kv:
+                pi = jnp.round(p * 127.0).astype(jnp.int8)
+                pv = jax.lax.dot_general(
+                    pi, v, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.int32
+                ).astype(jnp.float32) * (1.0 / 127.0)
+            else:
+                v = _vmem_cast(v, q.dtype)
+                pv = jax.lax.dot_general(
+                    p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+            acc_scratch[:] = acc_scratch[:] * alpha + pv
+            m_scratch[:] = jnp.broadcast_to(m_new, (tr, 128))
+            l_scratch[:] = jnp.broadcast_to(l_new, (tr, 128))
+
+    @pl.when(ci == num_cells - 1)
+    def _finalize():
+        m = m_scratch[:, 0:1]
+        l = l_scratch[:, 0:1]
+        acc = acc_scratch[:]
+        if sinks_ref is not None:
+            sink = sinks_ref[:, 0:1]
+            m_new = jnp.maximum(m, sink)
+            alpha = jnp.exp(jnp.minimum(m - m_new, 0.0))
+            l = alpha * l + jnp.exp(sink - m_new)
+            acc = acc * alpha
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("scale", "window", "soft_cap", "blocks_per_cell",
+                     "q_tile", "interpret"))
+def paged_mixed_attention_stacked(
+    q: jnp.ndarray,              # (B, Hq, T, D), T = chunk bucket (e.g. 64..256)
+    k_cache: jnp.ndarray,        # (L, NB, Hkv, BS, D) — full stacked paged cache
+    v_cache: jnp.ndarray,
+    positions: jnp.ndarray,      # (B,) int32 position of q[:, :, 0]
+    q_lens: jnp.ndarray,         # (B,) int32 live queries per row (1..T)
+    layer_idx: jnp.ndarray,      # () int32 layer to attend over
+    block_table: jnp.ndarray,    # (B, MB) int32 physical block ids (logical order)
+    scale: Optional[float] = None,
+    window: Optional[int] = None,
+    soft_cap: Optional[float] = None,
+    sinks: Optional[jnp.ndarray] = None,         # (Hq,) learned sink logits
+    alibi_slopes: Optional[jnp.ndarray] = None,  # (Hq,) ALiBi slopes
+    blocks_per_cell: Optional[int] = None,
+    q_tile: Optional[int] = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """MIXED-STEP ragged paged attention: per-row variable q_len in one kernel.
+
+    The mixed prefill+decode serving shape (≈ "Ragged Paged Attention", PAPERS.md):
+    decode rows carry q_len 1, prefill-chunk rows carry q_len up to the chunk
+    bucket T, all in one dispatch. Per row, the q_lens[b] live queries attend
+    causally over the row's blocks — q token i at position positions[b] + i sees
+    kv positions <= its own (the in-chunk causal triangle plus all committed
+    context); the chunk's fresh K/V must already be written
+    (write_paged_stacked_kv). Tokens at or beyond q_lens[b] are padding: masked
+    in-kernel, output rows zero, and their KV writes must carry slot -1.
+
+    Generalizes paged_decode_attention_stacked's uniform multi-query attend
+    (q_len 2..8, the speculative verify) to chunk-length ragged rows with
+    q-tiling: token-major q tiles of ``qt`` tokens bound the score tile to
+    (qt*Hq, Hkv*BS) VMEM whatever T is, and per-(row, tile) cell skipping keeps
+    HBM traffic on each row's causal live length — a decode row costs exactly
+    the q_len=1 kernel's traffic, never the table width.
+    Returns (B, Hq, T, D) in q.dtype."""
+    b, hq, t, d = q.shape
+    _, nb, hkv, bs, _ = k_cache.shape
+    mb = block_table.shape[1]
+    if hq % hkv != 0:
+        raise ValueError(f"q heads {hq} not divisible by kv heads {hkv}")
+    n_rep = hq // hkv
+    if scale is None:
+        scale = d ** -0.5
+
+    # q tile: whole tokens, (qt * hq) rows, sublane-aligned. ~128 rows per tile
+    # keeps the (tr, hkv*bs) score tile ~0.5 MB fp32 at serving geometry.
+    if q_tile is not None:
+        qt = q_tile
+    else:
+        qt = max(1, 128 // hq)
+    while (qt * hq) % 8 != 0:
+        qt += 1
+    tr = qt * hq
+    nqt = -(-t // qt)
+    t_pad = nqt * qt
+
+    # token-major packing: row r of a tile = q head r % hq of token r // hq
+    qg = q.transpose(0, 2, 1, 3).reshape(b, t * hq, d)
+    if t_pad != t:
+        qg = jnp.pad(qg, ((0, 0), (0, (t_pad - t) * hq), (0, 0)))
+
+    kv_itemsize = jnp.dtype(k_cache.dtype).itemsize
+    budget = (4 if jnp.dtype(k_cache.dtype) == jnp.int8 else 2) * 2 ** 20
+    if blocks_per_cell:
+        kb = min(mb, blocks_per_cell)
+    else:
+        per_block = 2 * hkv * bs * d * kv_itemsize
+        kb = min(mb, max(1, budget // per_block))
+    while mb % kb != 0:
+        kb -= 1
+    num_cells = mb // kb
+
+    def _kv_index_map(g):
+        def index_map(bi, qi, ci, pos, qlen, lidx, bt):
+            gg = ci * kb + g
+            # clamp to the TILE's live end: cells beyond it repeat the previous
+            # grid step's (layer, block) tuple, so Mosaic elides the DMA
+            live_end = (pos[bi]
+                        + jnp.maximum(jnp.minimum(qlen[bi], (qi + 1) * qt), 1)
+                        - 1)
+            last_live = live_end // bs
+            gg = jnp.minimum(gg, last_live)
+            if window is not None:
+                first_live = jnp.maximum(
+                    pos[bi] + qi * qt - (window - 1), 0) // bs
+                gg = jnp.maximum(gg, jnp.minimum(first_live, last_live))
+            return (lidx[0], bt[bi, gg], 0, 0, 0)
+
+        return index_map
+
+    kv_specs = []
+    for g in range(kb):
+        kv_specs.append(pl.BlockSpec((1, 1, hkv, bs, d), _kv_index_map(g)))
+        kv_specs.append(pl.BlockSpec((1, 1, hkv, bs, d), _kv_index_map(g)))
+
+    extra_specs, extra_ops = [], []
+    for extra in (sinks, alibi_slopes):
+        if extra is not None:
+            # per-row scalar of q head r % hq: the (hq,) pattern tiled over the
+            # tile's qt tokens — identical for every tile
+            grouped = jnp.tile(extra.astype(jnp.float32), qt)
+            grouped = jnp.broadcast_to(grouped[:, None], (tr, 128))
+            extra_specs.append(
+                pl.BlockSpec((tr, 128), lambda bi, qi, ci, *_: (0, 0)))
+            extra_ops.append(grouped)
+    n_extra = len(extra_ops)
+
+    kernel = functools.partial(
+        _paged_mixed_attend_kernel, scale=scale, bs=bs, kb=kb,
+        num_cells=num_cells, qt=qt, hq=hq, n_rep=n_rep, hkv=hkv, tr=tr,
+        window=window, soft_cap=soft_cap, has_sinks=sinks is not None,
+        has_slopes=alibi_slopes is not None)
+
+    def _kernel(pos_ref, qlen_ref, lidx_ref, bt_ref, q_ref, *rest):
+        ins = rest[: 2 * kb + n_extra]
+        o_ref, m_s, l_s, acc_s = rest[2 * kb + n_extra:]
+        kernel(pos_ref, qlen_ref, lidx_ref, bt_ref, q_ref, *ins, o_ref=o_ref,
+               m_scratch=m_s, l_scratch=l_s, acc_scratch=acc_s)
+
+    q_spec = pl.BlockSpec((1, tr, d), lambda bi, qi, ci, *_: (bi, qi, 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(b, nqt, num_cells),
+        in_specs=[q_spec] + kv_specs + extra_specs,
+        out_specs=pl.BlockSpec(q_spec.block_shape, q_spec.index_map),
+        scratch_shapes=[
+            pltpu.VMEM((tr, 128), jnp.float32),
+            pltpu.VMEM((tr, 128), jnp.float32),
+            pltpu.VMEM((tr, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        _kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, t_pad * hq, d), q.dtype),
+        interpret=interpret,
+    )(positions.astype(jnp.int32), q_lens.astype(jnp.int32),
+      layer_idx.reshape(1).astype(jnp.int32), block_table.astype(jnp.int32),
+      qg, *([k_cache, v_cache] * kb), *extra_ops)
+
+    out = out[:, : t * hq, :].reshape(b, t, hq, d)
+    return out.transpose(0, 2, 1, 3)

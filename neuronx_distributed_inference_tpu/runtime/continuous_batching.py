@@ -92,7 +92,10 @@ class ContinuousBatchingRunner:
                  spec_chunk: Optional[int] = None,
                  max_insert_tokens_per_step: Optional[int] = None,
                  eagle_draft=None, spec_adaptive: bool = False,
-                 spec_min_accept: float = 1.25, spec_probe_every: int = 8):
+                 spec_min_accept: float = 1.25, spec_probe_every: int = 8,
+                 prefill_chunk: Optional[int] = None,
+                 prefill_token_budget: Optional[int] = None,
+                 mixed_decode_steps: Optional[int] = None):
         cfg = app.tpu_config
         if not cfg.is_continuous_batching:
             raise ValueError("tpu_config.is_continuous_batching must be enabled")
@@ -107,6 +110,46 @@ class ContinuousBatchingRunner:
         # stalling them (bounds resident decode latency / TTFT jitter; ≈ the
         # reference's chunked prefill interleave, `modules/kvcache/utils.py`)
         self.insert_cap = max_insert_tokens_per_step
+        # --- MIXED prefill+decode serving steps (token-budget scheduler) -------
+        # With ``prefill_chunk`` every serving step that has an insert in flight
+        # packs ALL alive decode rows (a short chained-decode scan) plus up to
+        # ``prefill_token_budget`` prompt tokens — as prefill-CHUNK rows of the
+        # variable-q_len ragged paged attend — into ONE jitted dispatch,
+        # replacing the per-window bs=1 _insert_step loop (≈ "Ragged Paged
+        # Attention", PAPERS.md: decode rows q=1 + prefill chunks in the same
+        # kernel). Decode rows never stall behind inserts; inserts never wait
+        # behind full decode chunks.
+        if prefill_chunk is not None:
+            if not cfg.paged_attention_enabled:
+                raise ValueError("prefill_chunk (mixed-step scheduling) "
+                                 "requires paged attention")
+            if prefill_chunk < 1:
+                raise ValueError("prefill_chunk must be >= 1")
+            if max_insert_tokens_per_step is not None:
+                raise ValueError("prefill_chunk and max_insert_tokens_per_step "
+                                 "are mutually exclusive insert schedulers")
+            if draft is not None or eagle_draft is not None:
+                raise ValueError("mixed-step scheduling does not compose with "
+                                 "speculative serving yet")
+        elif prefill_token_budget is not None or mixed_decode_steps is not None:
+            raise ValueError("prefill_token_budget/mixed_decode_steps require "
+                             "prefill_chunk")
+        self.mixed = prefill_chunk is not None
+        self.prefill_chunk = prefill_chunk
+        self.prefill_budget = (prefill_token_budget
+                               if prefill_token_budget is not None
+                               else (2 * prefill_chunk if self.mixed else 0))
+        # chunk-row bucket count: the dispatch carries a FIXED number of chunk
+        # rows (unused rows are fully padded), so the executable never varies
+        # with the instantaneous insert load
+        self.chunk_rows = (max(1, self.prefill_budget // prefill_chunk)
+                           if self.mixed else 0)
+        # decode iterations chained inside each mixed dispatch: enough to keep
+        # resident decode throughput healthy while inserts stream, short enough
+        # that a chunk lands (and TTFT accrues) every few iterations
+        self.mixed_decode_steps = mixed_decode_steps or min(
+            8, decode_chunk or max(1, cfg.decode_chunk_size))
+        self.num_preemptions = 0
         self.app = app
         self.cfg = cfg
         self.paged = cfg.paged_attention_enabled
@@ -344,22 +387,55 @@ class ContinuousBatchingRunner:
             # inserts (wide prefix-prefill queries) keep the gather path
             paged_kernel_kw = (
                 {"use_kernel": True} if app._use_paged_decode_kernel() else {})
+            # the base decode path supports the epilogue/ragged extras
+            # (logit_idx, skip_logits, q_lens); custom family forwards (MLA,
+            # Llama4) keep the plain full-logits insert
+            base_decode = decode_core is model_base.decode_forward
+            if self.mixed and not base_decode:
+                raise ValueError("mixed-step scheduling requires the base "
+                                 "decode path (custom family decode forwards "
+                                 "lack q_lens/logit_idx)")
 
             def _insert(params, input_ids, position_ids, last_token_idx, cache,
                         block_table_row, slot_mapping, sampling_params, key,
                         adapter_row):
                 """Batch-1 (prefix-)prefill into paged blocks: a wide decode call whose
                 queries are the (suffix) tokens; prior blocks are visible through the
-                block table."""
+                block table. On the base decode path only the last real token
+                pays the lm_head (logit_idx gather — a padded 256-wide window
+                over a 128k vocab would otherwise materialize ~131 MB of
+                discarded logits)."""
                 with jax.default_matmul_precision(precision):
-                    logits, cache = decode_core(
-                        params, args, input_ids, position_ids, cache, None,
-                        mesh=mesh, rules=rules, block_table=block_table_row,
-                        slot_mapping=slot_mapping, adapter_ids=adapter_row)
-                last = jnp.take_along_axis(
-                    logits, last_token_idx[:, None, None], axis=1)[:, 0]
+                    if base_decode:
+                        logits, cache = decode_core(
+                            params, args, input_ids, position_ids, cache, None,
+                            mesh=mesh, rules=rules, block_table=block_table_row,
+                            slot_mapping=slot_mapping, adapter_ids=adapter_row,
+                            logit_idx=last_token_idx)
+                        last = logits[:, 0]
+                    else:
+                        logits, cache = decode_core(
+                            params, args, input_ids, position_ids, cache, None,
+                            mesh=mesh, rules=rules, block_table=block_table_row,
+                            slot_mapping=slot_mapping, adapter_ids=adapter_row)
+                        last = jnp.take_along_axis(
+                            logits, last_token_idx[:, None, None], axis=1)[:, 0]
                 tok = sampling_ops.sample(last, sampling_params, key, odsc)
                 return tok, cache
+
+            def _insert_nol(params, input_ids, position_ids, cache,
+                            block_table_row, slot_mapping, adapter_row):
+                """INTERMEDIATE insert window: KV-only. The sampled token of a
+                non-final window is discarded, so skip the final norm, lm_head
+                and sampling entirely (skip_logits — same discipline as the
+                k-th draft step of a fused speculative iteration)."""
+                with jax.default_matmul_precision(precision):
+                    _, cache = decode_core(
+                        params, args, input_ids, position_ids, cache, None,
+                        mesh=mesh, rules=rules, block_table=block_table_row,
+                        slot_mapping=slot_mapping, adapter_ids=adapter_row,
+                        skip_logits=True)
+                return cache
 
             def _decode(params, tok0, positions, cache, block_table, slot_chunk,
                         sampling_params, key, adapter_ids, num_steps,
@@ -391,8 +467,67 @@ class ContinuousBatchingRunner:
                 return toks.T, cache
 
             self._insert_step = jax.jit(_insert, donate_argnums=(4,))
+            self._insert_step_nol = (jax.jit(_insert_nol, donate_argnums=(3,))
+                                     if base_decode else None)
             self._decode_step = jax.jit(_decode, donate_argnums=(3,),
                                         static_argnames=("num_steps", "greedy"))
+
+            if self.mixed:
+                def _mixed(params, tok0, positions, cache, block_table,
+                           slot_chunk, chunk_ids, chunk_pos, chunk_qlens,
+                           chunk_bt, chunk_slots, sampling_params, chunk_sp,
+                           key, adapter_ids, chunk_adapters, num_steps,
+                           greedy=False):
+                    """One MIXED serving step, ONE dispatch: the C prefill-chunk
+                    rows run the variable-q_len ragged paged attend (each row's
+                    last live token alone pays the lm_head via logit_idx;
+                    padded rows carry slot -1 everywhere), then ``num_steps``
+                    chained decode iterations advance every slot exactly as a
+                    plain chunk would. Chunk rows and decode rows touch
+                    disjoint blocks (shared prefix blocks are rewritten with
+                    identical content), so the order inside the dispatch is
+                    immaterial."""
+                    key_c, key_d = jax.random.split(key)
+                    with jax.default_matmul_precision(precision):
+                        logits_c, cache = decode_core(
+                            params, args, chunk_ids, chunk_pos, cache, None,
+                            mesh=mesh, rules=rules, block_table=chunk_bt,
+                            slot_mapping=chunk_slots,
+                            adapter_ids=chunk_adapters, q_lens=chunk_qlens,
+                            logit_idx=chunk_qlens - 1, **paged_kernel_kw)
+                        if greedy:
+                            chunk_tok = sampling_ops.greedy(logits_c[:, 0])
+                        else:
+                            chunk_tok = sampling_ops.sample(
+                                logits_c[:, 0], chunk_sp, key_c, odsc)
+
+                    keys = jax.random.split(key_d, num_steps)
+                    slots_t = slot_chunk.T[:, :, None]          # (steps, B, 1)
+
+                    def body(carry, xs):
+                        tok, pos, cache = carry
+                        step_key, slots_j = xs
+                        with jax.default_matmul_precision(precision):
+                            logits, cache = decode_core(
+                                params, args, tok[:, None], pos, cache, None,
+                                mesh=mesh, rules=rules, block_table=block_table,
+                                slot_mapping=slots_j, adapter_ids=adapter_ids,
+                                **paged_kernel_kw)
+                            if greedy:
+                                nxt = sampling_ops.greedy(logits[:, -1])
+                            else:
+                                nxt = sampling_ops.sample(logits[:, -1],
+                                                          sampling_params,
+                                                          step_key, odsc)
+                        return (nxt, pos + 1, cache), nxt
+
+                    (_, _, cache), toks = jax.lax.scan(
+                        body, (tok0, positions, cache), (keys, slots_t))
+                    return toks.T, chunk_tok, cache
+
+                self._mixed_step = jax.jit(
+                    _mixed, donate_argnums=(3,),
+                    static_argnames=("num_steps", "greedy"))
         else:
             # thread the app's prefill strategy (ring for cp>1, Pallas flash, or
             # dense attend) into insert-time context encoding; decode chunks take
@@ -726,16 +861,47 @@ class ContinuousBatchingRunner:
             static_argnames=("num_iters", "greedy", "decode_bucket"))
 
         if paged:
-            def _d_insert(d_params, input_ids, position_ids, cache,
-                          block_table_row, slot_mapping):
-                with jax.default_matmul_precision(precision):
-                    _, cache = d_decode(
-                        d_params, d_args, input_ids, position_ids, cache, None,
-                        mesh=d_mesh, rules=d_rules, block_table=block_table_row,
-                        slot_mapping=slot_mapping)
-                return cache
+            t_base = t_decode is model_base.decode_forward
 
-            self._d_insert_step = jax.jit(_d_insert, donate_argnums=(3,))
+            def _insert_pair(t_params, d_params, input_ids, position_ids,
+                             last_token_idx, t_cache, d_cache, bt_row,
+                             slot_mapping, sampling_params, key, adapter_row,
+                             final):
+                """One prefix-prefill window for BOTH pools in ONE dispatch —
+                the draft insert was previously a second jitted call per
+                window (its own ~dispatch-floor of host latency every
+                window). Only the prompt-FINAL window (static ``final``)
+                pays the target's lm_head + sampling; intermediate windows
+                run both models KV-only (skip_logits)."""
+                with jax.default_matmul_precision(precision):
+                    if final:
+                        tkw = dict(logit_idx=last_token_idx) if t_base else {}
+                        logits, t_cache = t_decode(
+                            t_params, t_args, input_ids, position_ids, t_cache,
+                            None, mesh=mesh, rules=rules, block_table=bt_row,
+                            slot_mapping=slot_mapping, adapter_ids=adapter_row,
+                            **tkw)
+                        last = (logits[:, 0] if t_base else jnp.take_along_axis(
+                            logits, last_token_idx[:, None, None], axis=1)[:, 0])
+                        tok = sampling_ops.sample(last, sampling_params, key,
+                                                  odsc)
+                    else:
+                        tkw = dict(skip_logits=True) if t_base else {}
+                        _, t_cache = t_decode(
+                            t_params, t_args, input_ids, position_ids, t_cache,
+                            None, mesh=mesh, rules=rules, block_table=bt_row,
+                            slot_mapping=slot_mapping, adapter_ids=adapter_row,
+                            **tkw)
+                        tok = jnp.zeros((input_ids.shape[0],), jnp.int32)
+                    _, d_cache = d_decode(
+                        d_params, d_args, input_ids, position_ids, d_cache,
+                        None, mesh=d_mesh, rules=d_rules, block_table=bt_row,
+                        slot_mapping=slot_mapping, **d_skip)
+                return tok, t_cache, d_cache
+
+            self._insert_pair_step = jax.jit(_insert_pair,
+                                             donate_argnums=(5, 6),
+                                             static_argnames=("final",))
         else:
             d_prefill = draft.prefill_fn()
             use_ring = draft._use_ring_attention()
@@ -916,9 +1082,10 @@ class ContinuousBatchingRunner:
             self._place_counter += 1
             req.placed_seq = self._place_counter
             self.active[slot] = req
-            if self.insert_cap is not None:
+            if self.insert_cap is not None or self.mixed:
                 # chunked-prefill scheduling: the slot is held, the prompt
-                # streams in bounded windows via _advance_inserts
+                # streams in bounded windows via _advance_inserts (insert_cap)
+                # or as chunk rows of the mixed dispatches (_step_mixed)
                 self._begin_insert(req, slot)
                 continue
             key, sub = jax.random.split(key)
@@ -979,6 +1146,8 @@ class ContinuousBatchingRunner:
             key = self._advance_inserts(key, emitted)
         if self.k:
             return self._step_spec(key, emitted)
+        if self.mixed:
+            return self._step_mixed(key, emitted)
         return self._step_plain(key, emitted)
 
     def _step_plain(self, key, emitted: Dict[int, List[int]]
@@ -1077,6 +1246,133 @@ class ContinuousBatchingRunner:
             "async auto-decision: round_trip=%.1fms chunk=%.1fms -> %s",
             1e3 * self._round_trip_s, 1e3 * chunk_s,
             "dispatch-ahead ON" if self.async_mode else "sync")
+
+    def _step_mixed(self, key, emitted: Dict[int, List[int]]
+                    ) -> Dict[int, List[int]]:
+        """One MIXED prefill+decode serving step (the token-budget scheduler).
+
+        While any placed request is still streaming its prompt, each dispatch
+        packs ALL alive decode rows (``mixed_decode_steps`` chained decode
+        iterations) PLUS up to ``prefill_token_budget`` prompt tokens from the
+        in-flight inserts — as prefill-chunk rows of the variable-q_len ragged
+        paged attend — into ONE jitted call. Residents never stall behind a
+        prompt (the insert-window loop's stop-the-world bs=1 dispatches), and
+        a prompt makes progress every step regardless of decode load. With no
+        insert in flight this falls through to the full-width plain chunks.
+
+        Exact host-side commit rules: a chunk advances ``insert_pos`` only; the
+        chunk whose last token completes the prompt samples tok0 (discarded on
+        preemption-resume, exactly like _advance_inserts); prefix-cache hits
+        entered at _begin_insert mean the first chunk starts mid-prompt; eos
+        and max_new_tokens replay on the host via _commit/_maybe_finish."""
+        active_rows = [r for r in self.active if r is not None]
+        inserting = [r for r in active_rows if r.inserting]
+        if not inserting:
+            # pure-decode steady state: fall through BEFORE draining so async
+            # dispatch-ahead keeps overlapping (_step_plain owns _pending)
+            return self._step_plain(key, emitted)
+        self._drain(emitted)
+
+        live = [r for r in active_rows if not r.done and not r.inserting]
+        # no live decode rows: a 1-iteration decode scan rides along (all its
+        # writes slot -1, tokens discarded) instead of mixed_decode_steps of
+        # pure waste — cold-start TTFT is chunk-bound, not scan-bound
+        steps = self.mixed_decode_steps if live else 1
+        if live:
+            from .speculation import quantize_chunk_iters
+
+            max_pos = max(r.position for r in live)
+            # num_steps is a STATIC jit arg: quantize the seq-room clamp to
+            # powers of two (same discipline as the spec chunk) so tail-of-
+            # generation rooms don't sweep fresh executables
+            room = self.cfg.seq_len - 1 - max_pos
+            steps = (quantize_chunk_iters(steps, room) if room > 0 else 0)
+            if steps <= 0:
+                victim = max(live, key=lambda r: r.position)
+                victim.truncated = True
+                self._finish(victim)
+                return emitted
+            active_rows = self._grow_blocks(active_rows, steps)
+            if not active_rows:
+                return emitted
+            # growth may have preempted an inserting request
+            inserting = [r for r in active_rows if r.inserting]
+            live = [r for r in active_rows if not r.done and not r.inserting]
+            if not inserting:
+                return self._step_plain(key, emitted)
+
+        # token budget -> chunk assignments, oldest placement first (FIFO
+        # completion; every in-flight insert advances before any one hogs the
+        # budget twice)
+        c_rows, t_bucket = self.chunk_rows, self.prefill_chunk
+        budget = self.prefill_budget
+        chosen: List[tuple] = []
+        for r in sorted(inserting, key=lambda r: r.placed_seq):
+            if len(chosen) == c_rows or budget <= 0:
+                break
+            wlen = min(t_bucket, len(r.fed) - r.insert_pos, budget)
+            if wlen <= 0:
+                continue
+            chosen.append((r, wlen))
+            budget -= wlen
+
+        mb = self.max_blocks_per_seq
+        chunk_ids = np.zeros((c_rows, t_bucket), np.int32)
+        chunk_pos = np.zeros((c_rows,), np.int32)
+        chunk_qlens = np.ones((c_rows,), np.int32)  # padded rows: 1 dead query
+        chunk_bt = np.zeros((c_rows, mb), np.int32)
+        chunk_lens = np.zeros((c_rows,), np.int32)
+        chunk_sp = np.tile(self._default_sp_row, (c_rows, 1))
+        chunk_ad = np.zeros((c_rows,), np.int32)
+        for i, (r, wlen) in enumerate(chosen):
+            chunk_ids[i, :wlen] = r.fed[r.insert_pos : r.insert_pos + wlen]
+            chunk_pos[i] = r.insert_pos
+            chunk_qlens[i] = wlen
+            chunk_bt[i] = self.block_table[r.slot]
+            chunk_lens[i] = wlen
+            chunk_sp[i] = self._slot_sp[r.slot]
+            chunk_ad[i] = self.adapter_ids[r.slot]
+        # padded chunk rows write nothing (all slots -1); live rows commit
+        # their consecutive run through the chunk-length one-RMW-per-window
+        # write path
+        chunk_slots = block_kvcache.make_chunk_slot_mapping(
+            chunk_bt, chunk_pos, chunk_lens, t_bucket, self.block_size)
+
+        valid = np.array([r is not None and not r.done and not r.inserting
+                          for r in self.active])
+        slot_chunk = self._slot_mapping_fn(
+            self.block_table, self.positions, steps, self.block_size,
+            valid=valid)
+        greedy = self._chunk_greedy(live + [r for r, _ in chosen])
+        key, sub = jax.random.split(key)
+        toks_dev, chunk_tok_dev, self.cache = self._mixed_step(
+            self.app.params, jnp.asarray(self.last_tok),
+            jnp.asarray(self.positions), self.cache,
+            jnp.asarray(self.block_table), jnp.asarray(slot_chunk),
+            jnp.asarray(chunk_ids), jnp.asarray(chunk_pos),
+            jnp.asarray(chunk_qlens), jnp.asarray(chunk_bt),
+            jnp.asarray(chunk_slots), self._sampling_matrix(),
+            jnp.asarray(chunk_sp), sub, jnp.asarray(self.adapter_ids),
+            jnp.asarray(chunk_ad), num_steps=steps, greedy=greedy)
+
+        if live:
+            self._commit(np.asarray(toks_dev), steps, emitted)
+        chunk_tok = np.asarray(chunk_tok_dev)
+        for i, (r, wlen) in enumerate(chosen):
+            r.insert_pos += wlen
+            if r.insert_pos < len(r.fed):
+                continue
+            r.inserting = False
+            resumed = bool(r.generated)   # preempted earlier; KV recomputed now
+            r.position = len(r.fed)
+            if not resumed:
+                tok0 = int(chunk_tok[i])
+                r.generated = [tok0]
+                emitted.setdefault(r.request_id, []).append(tok0)
+            self.positions[r.slot] = r.position
+            self.last_tok[r.slot] = r.generated[-1]
+            self._maybe_finish(r, emitted)
+        return emitted
 
     def _step_spec(self, key, emitted: Dict[int, List[int]]
                    ) -> Dict[int, List[int]]:
@@ -1214,6 +1510,7 @@ class ContinuousBatchingRunner:
 
     def _preempt(self, req: Request) -> None:
         logger.info("preempting request %d (out of KV blocks)", req.request_id)
+        self.num_preemptions += 1
         self.active[req.slot] = None
         if self.paged:
             self.allocator.free_sequence(req.blocks)
@@ -1253,7 +1550,7 @@ class ContinuousBatchingRunner:
         req.blocks, cached_len = self.allocator.allocate_for_prompt(hashed)
         # never skip the whole prompt: the last token's logits seed generation
         cached_len = min(cached_len, len(fed) - 1)
-        if self.insert_cap is not None and cached_len > 0:
+        if (self.insert_cap is not None or self.mixed) and cached_len > 0:
             # chunked-prefill race (found by review): the allocator registers
             # prefix hashes at ALLOCATION, but with capped inserts the KV
             # streams in over later steps — a same-prefix request placed
@@ -1283,12 +1580,17 @@ class ContinuousBatchingRunner:
         ``budget`` prompt tokens (None = all): each window's queries see the
         prior windows' KV through the block table (≈ windowed context encoding,
         reference `model_base.py:918-973`, and the chunked-prefill flow of
-        `ChunkedPrefillConfig`). The final window's sampled token is stored in
-        ``req.tok0_dev``. Returns (key, tokens_consumed)."""
+        `ChunkedPrefillConfig`). Only the prompt-FINAL window samples (and
+        stores ``req.tok0_dev``); intermediate windows run KV-only
+        (skip_logits), and with a draft model both pools are written by ONE
+        fused dispatch per window. Returns (key, tokens_consumed)."""
         fed = req.fed
         max_window = self.app.cte_buckets[-1]
         sp_row = self._slot_sp[slot : slot + 1]
         ad_row = jnp.asarray(self.adapter_ids[slot : slot + 1])
+        # hoisted: the row's blocks are fully allocated at _begin_insert and
+        # the table row never changes across this request's windows
+        bt_row = jnp.asarray(self.block_table[slot : slot + 1])
         used = 0
         while req.insert_pos < len(fed) and (budget is None or used < budget):
             wlen = len(fed) - req.insert_pos
@@ -1301,21 +1603,28 @@ class ContinuousBatchingRunner:
             pos_row = np.array([req.insert_pos], dtype=np.int32)
             valid = np.ones((1, padded.bucket), dtype=bool)
             valid[0, len(window):] = False
-            slot_map = self._slot_mapping_fn(
+            slot_map = jnp.asarray(self._slot_mapping_fn(
                 self.block_table[slot : slot + 1], pos_row, padded.bucket,
-                self.block_size, valid=valid)
-            key, sub = jax.random.split(key)
-            req.tok0_dev, self.cache = self._insert_step(
-                self.app.params, padded.input_ids, pos_row,
-                padded.last_token_idx, self.cache,
-                jnp.asarray(self.block_table[slot : slot + 1]),
-                jnp.asarray(slot_map), sp_row, sub, ad_row)
+                self.block_size, valid=valid))
+            final = req.insert_pos + wlen >= len(fed)
             if self.draft is not None:
-                self.d_cache = self._d_insert_step(
-                    self.draft.params, padded.input_ids, pos_row,
-                    self.d_cache,
-                    jnp.asarray(self.block_table[slot : slot + 1]),
-                    jnp.asarray(slot_map))
+                key, sub = jax.random.split(key)
+                tok_dev, self.cache, self.d_cache = self._insert_pair_step(
+                    self.app.params, self.draft.params, padded.input_ids,
+                    pos_row, padded.last_token_idx, self.cache, self.d_cache,
+                    bt_row, slot_map, sp_row, sub, ad_row, final=final)
+                if final:
+                    req.tok0_dev = tok_dev
+            elif final or self._insert_step_nol is None:
+                key, sub = jax.random.split(key)
+                req.tok0_dev, self.cache = self._insert_step(
+                    self.app.params, padded.input_ids, pos_row,
+                    padded.last_token_idx, self.cache, bt_row, slot_map,
+                    sp_row, sub, ad_row)
+            else:
+                self.cache = self._insert_step_nol(
+                    self.app.params, padded.input_ids, pos_row, self.cache,
+                    bt_row, slot_map, ad_row)
             req.insert_pos += wlen
             used += wlen
         return key, used

@@ -1,0 +1,278 @@
+"""Mixed prefill+decode serving steps (the token-budget scheduler).
+
+≈ the serving design of "Ragged Paged Attention" (PAPERS.md): decode rows and
+prefill-chunk rows share ONE dispatch, replacing the insert-window loop's
+stop-the-world bs=1 prefills.
+
+Correctness bar: mixed-step serving is a pure scheduling change, so it must
+emit EXACTLY the tokens of a sequential insert-then-decode reference run
+(greedy) — across multi-chunk prompts, slot reuse, mid-prompt
+preemption/resume, prefix-cache hits, and eos landing in a step that also
+carries prefill chunks.
+"""
+
+import numpy as np
+import pytest
+
+from neuronx_distributed_inference_tpu.config import (
+    TpuConfig, load_pretrained_config)
+from neuronx_distributed_inference_tpu.models.llama.modeling_llama import (
+    LlamaForCausalLM, LlamaInferenceConfig)
+from neuronx_distributed_inference_tpu.runtime.continuous_batching import (
+    ContinuousBatchingRunner)
+
+pytestmark = pytest.mark.slow  # heavy e2e: excluded from the fast gate
+
+
+def _make_app(hf_cfg, seed=0, paged=True, slots=2, **tpu_kw):
+    tpu_kw.setdefault("pa_num_blocks", 48)
+    tpu_kw.setdefault("pa_block_size", 8)
+    tpu_cfg = TpuConfig(
+        batch_size=slots, seq_len=96, max_context_length=32, dtype="float32",
+        context_encoding_buckets=[16, 32], token_generation_buckets=[48, 96],
+        is_continuous_batching=True, paged_attention_enabled=paged,
+        **tpu_kw,
+    )
+    config = LlamaInferenceConfig(tpu_cfg,
+                                  load_config=load_pretrained_config(hf_cfg))
+    app = LlamaForCausalLM(None, config)
+    app.load_random(seed=seed)
+    return app
+
+
+def _mixed_runner(app, **kw):
+    kw.setdefault("decode_chunk", 4)
+    kw.setdefault("prefill_chunk", 16)
+    kw.setdefault("prefill_token_budget", 32)
+    kw.setdefault("mixed_decode_steps", 2)
+    return ContinuousBatchingRunner(app, **kw)
+
+
+@pytest.fixture(scope="module")
+def plain_app(tiny_llama_hf_config):
+    """Dedicated plain app: the sequential insert-then-decode reference."""
+    tpu_cfg = TpuConfig(batch_size=2, seq_len=96, max_context_length=32,
+                        dtype="float32", context_encoding_buckets=[16, 32],
+                        token_generation_buckets=[48, 96])
+    config = LlamaInferenceConfig(
+        tpu_cfg, load_config=load_pretrained_config(tiny_llama_hf_config))
+    app = LlamaForCausalLM(None, config)
+    app.load_random(seed=0)
+    return app
+
+
+@pytest.fixture(scope="module")
+def prompts():
+    rng = np.random.default_rng(7)
+    # 50 > prefill_chunk 16: the long prompt streams over 4 mixed steps
+    return [rng.integers(1, 256, size=(n,)).astype(np.int32)
+            for n in (12, 7, 50)]
+
+
+@pytest.fixture(scope="module")
+def reference_tokens(plain_app, prompts):
+    return {i: plain_app.generate(p[None, :],
+                                  max_new_tokens=10).tokens[0].tolist()
+            for i, p in enumerate(prompts)}
+
+
+def test_mixed_step_matches_sequential_reference(tiny_llama_hf_config, prompts,
+                                                 reference_tokens):
+    """3 requests over 2 slots (staggered placement + slot reuse), one prompt
+    spanning 4 prefill chunks: token-for-token vs dedicated plain runs."""
+    runner = _mixed_runner(_make_app(tiny_llama_hf_config))
+    ids = [runner.submit(p, max_new_tokens=10) for p in prompts]
+    results = runner.run_to_completion()
+    for i, rid in enumerate(ids):
+        assert results[rid] == reference_tokens[i], f"request {i} diverged"
+    assert runner.allocator.num_free == runner.allocator.num_blocks
+
+
+def test_mixed_step_kernel_path_matches_gather(tiny_llama_hf_config, prompts,
+                                               reference_tokens):
+    """The same traffic with the Pallas mixed kernel forced on
+    (decode_kernel_enabled=True): chunk rows ride the variable-q_len ragged
+    attend + chunk-length one-RMW commit, tokens stay exact."""
+    app = _make_app(tiny_llama_hf_config, decode_kernel_enabled=True)
+    assert app._use_paged_decode_kernel() is True
+    runner = _mixed_runner(app)
+    ids = [runner.submit(p, max_new_tokens=10) for p in prompts]
+    results = runner.run_to_completion()
+    for i, rid in enumerate(ids):
+        assert results[rid] == reference_tokens[i], f"request {i} diverged"
+
+
+def test_mixed_step_decode_advances_while_inserting(tiny_llama_hf_config,
+                                                    prompts, reference_tokens,
+                                                    plain_app):
+    """The point of the scheduler: a resident request keeps emitting tokens in
+    the SAME steps that stream a long prompt's chunks (no stop-the-world
+    insert), and both stay exact."""
+    rng = np.random.default_rng(13)
+    long_p = rng.integers(1, 256, size=(60,)).astype(np.int32)
+    want_long = plain_app.generate(long_p[None, :],
+                                   max_new_tokens=6).tokens[0].tolist()
+    want_short = plain_app.generate(prompts[0][None, :],
+                                    max_new_tokens=20).tokens[0].tolist()
+
+    runner = _mixed_runner(_make_app(tiny_llama_hf_config), prefill_chunk=8,
+                           prefill_token_budget=8)
+    r_short = runner.submit(prompts[0], max_new_tokens=20)
+    runner.step()                      # short placed + inserted + decoding
+    r_long = runner.submit(long_p, max_new_tokens=6)
+
+    interleaved = False
+    guard = 0
+    while runner.has_work:
+        em = runner.step()
+        long_req = next((r for r in runner.active
+                         if r and r.request_id == r_long), None)
+        if long_req is not None and long_req.inserting and em.get(r_short):
+            interleaved = True
+        guard += 1
+        assert guard < 200
+    assert interleaved, "the long insert stalled the resident request"
+    results = {rid: req.generated for rid, req in runner.finished.items()}
+    assert results[r_short] == want_short
+    assert results[r_long] == want_long
+
+
+def test_mixed_step_preemption_resume_mid_prompt(tiny_llama_hf_config,
+                                                 plain_app):
+    """Out-of-blocks preemption must be able to evict a request and the victim
+    must resume — re-entering its prompt MID-STREAM through chunk rows — with
+    exactly the dedicated-run tokens."""
+    rng = np.random.default_rng(9)
+    prompts2 = [rng.integers(1, 256, size=(n,)).astype(np.int32)
+                for n in (20, 21)]
+    want = [plain_app.generate(p[None, :], max_new_tokens=24).tokens[0].tolist()
+            for p in prompts2]
+
+    app = _make_app(tiny_llama_hf_config, pa_num_blocks=9)
+    # 72 slots cannot hold 2 x (21 + 24 + chunk): the newest request preempts
+    runner = _mixed_runner(app, prefill_chunk=8, prefill_token_budget=8)
+    ids = [runner.submit(p, max_new_tokens=24) for p in prompts2]
+    results = runner.run_to_completion()
+    assert runner.num_preemptions > 0, "the pool was never exhausted"
+    for i, rid in enumerate(ids):
+        assert not runner.finished[rid].truncated
+        assert results[rid] == want[i], f"request {i} diverged after preemption"
+
+
+def test_mixed_step_prefix_cache_hit_skips_to_decode(tiny_llama_hf_config,
+                                                     plain_app):
+    """A same-prefix request placed after the first completes shares the
+    prefix blocks and enters its first chunk mid-prompt (only the suffix is
+    streamed); tokens stay exact."""
+    rng = np.random.default_rng(3)
+    prefix = rng.integers(1, 256, size=(16,)).astype(np.int32)
+    pa = np.concatenate([prefix,
+                         rng.integers(1, 256, size=(4,)).astype(np.int32)])
+    pb = np.concatenate([prefix,
+                         rng.integers(1, 256, size=(5,)).astype(np.int32)])
+    want_a = plain_app.generate(pa[None, :], max_new_tokens=8).tokens[0].tolist()
+    want_b = plain_app.generate(pb[None, :], max_new_tokens=8).tokens[0].tolist()
+
+    runner = _mixed_runner(_make_app(tiny_llama_hf_config))
+    ra = runner.submit(pa, max_new_tokens=8)
+    runner.step()
+    runner.step()                       # A fully inserted (2 chunks), decoding
+    req_a = next(r for r in runner.active if r and r.request_id == ra)
+    assert not req_a.inserting
+    rb = runner.submit(pb, max_new_tokens=8)
+    runner.step()                       # B placed: prefix blocks shared + hit
+    req_b = next(r for r in runner.active if r and r.request_id == rb)
+    assert req_b.blocks[:2] == req_a.blocks[:2], "prefix blocks not shared"
+    results = runner.run_to_completion()
+    assert results[ra] == want_a
+    assert results[rb] == want_b
+
+
+def test_mixed_step_prefix_race_is_safe(tiny_llama_hf_config, plain_app):
+    """The chunked-prefill prefix race (allocator registers hashes at
+    allocation, KV streams in later) must stay safe under the mixed
+    scheduler: a same-prompt request placed mid-insert rewrites the
+    not-yet-written blocks."""
+    rng = np.random.default_rng(17)
+    prompt = rng.integers(1, 256, size=(64,)).astype(np.int32)
+    want = plain_app.generate(prompt[None, :],
+                              max_new_tokens=6).tokens[0].tolist()
+
+    runner = _mixed_runner(_make_app(tiny_llama_hf_config), prefill_chunk=16,
+                           prefill_token_budget=16)
+    ra = runner.submit(prompt, max_new_tokens=6)
+    runner.step()                                   # A mid-insert (16/64)
+    req_a = next(r for r in runner.active if r and r.request_id == ra)
+    assert req_a.inserting
+    rb = runner.submit(prompt, max_new_tokens=6)    # same prompt, A unfinished
+    results = runner.run_to_completion()
+    assert results[ra] == want
+    assert results[rb] == want, "request B reused unwritten prefix blocks"
+
+
+def test_mixed_step_eos_during_chunk_step(tiny_llama_hf_config, prompts,
+                                          reference_tokens, plain_app):
+    """An eos stop landing in a step that ALSO carries prefill chunks: the
+    stopping row commits exactly to its eos while the insert proceeds."""
+    rng = np.random.default_rng(23)
+    long_p = rng.integers(1, 256, size=(60,)).astype(np.int32)
+    want_long = plain_app.generate(long_p[None, :],
+                                   max_new_tokens=8).tokens[0].tolist()
+    eos = reference_tokens[0][4]
+    want_eos = reference_tokens[0][: reference_tokens[0].index(eos) + 1]
+
+    runner = _mixed_runner(_make_app(tiny_llama_hf_config), prefill_chunk=8,
+                           prefill_token_budget=8, mixed_decode_steps=2)
+    r0 = runner.submit(prompts[0], max_new_tokens=10, eos_token_id=eos)
+    runner.step()                       # r0 resident and decoding
+    r_long = runner.submit(long_p, max_new_tokens=8)
+    saw_concurrent_stop = False
+    guard = 0
+    while runner.has_work:
+        em = runner.step()
+        long_req = next((r for r in runner.active
+                         if r and r.request_id == r_long), None)
+        if (long_req is not None and long_req.inserting
+                and em.get(r0) and eos in em[r0]):
+            saw_concurrent_stop = True  # eos emitted by a chunk-carrying step
+        guard += 1
+        assert guard < 200
+    results = {rid: req.generated for rid, req in runner.finished.items()}
+    assert results[r0] == want_eos
+    assert results[r0][-1] == eos
+    assert results[r_long] == want_long
+    assert saw_concurrent_stop, (
+        "the eos never landed in a step that carried prefill chunks — the "
+        "scenario this test exists for was not exercised")
+
+
+def test_mixed_step_per_request_sampling_params(tiny_llama_hf_config, prompts,
+                                                reference_tokens):
+    """A greedy (top_k=1) per-request sampling row through the mixed path
+    behaves exactly like the default-greedy path."""
+    from neuronx_distributed_inference_tpu.config import OnDeviceSamplingConfig
+
+    app = _make_app(tiny_llama_hf_config,
+                    on_device_sampling_config=OnDeviceSamplingConfig(
+                        dynamic=True))
+    runner = _mixed_runner(app)
+    rid = runner.submit(prompts[0], max_new_tokens=10,
+                        sampling_params=np.array([1.0, 1.0, 1.0], np.float32))
+    results = runner.run_to_completion()
+    assert results[rid] == reference_tokens[0]
+
+
+def test_mixed_step_validates_config(tiny_llama_hf_config):
+    dense = _make_app(tiny_llama_hf_config, paged=False)
+    with pytest.raises(ValueError, match="paged"):
+        ContinuousBatchingRunner(dense, prefill_chunk=16)
+    app = _make_app(tiny_llama_hf_config)
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        ContinuousBatchingRunner(app, prefill_chunk=16,
+                                 max_insert_tokens_per_step=16)
+    with pytest.raises(ValueError, match="require prefill_chunk"):
+        ContinuousBatchingRunner(app, prefill_token_budget=32)
+    draft = _make_app(tiny_llama_hf_config, seed=1)
+    with pytest.raises(ValueError, match="speculative"):
+        ContinuousBatchingRunner(app, prefill_chunk=16, draft=draft,
+                                 speculation_length=4)

@@ -14,7 +14,8 @@ import jax.numpy as jnp
 
 from neuronx_distributed_inference_tpu.modules import block_kvcache
 from neuronx_distributed_inference_tpu.ops.paged_decode import (
-    paged_decode_attention_stacked, write_paged_stacked_kv)
+    paged_decode_attention_stacked, paged_mixed_attention_stacked,
+    write_paged_stacked_kv)
 
 
 
@@ -297,6 +298,215 @@ def test_fp8_kernel_vs_gather_divergence_bounded():
     # bf16 flash vs fp32 softmax plus the denormal flush: the bound documents
     # the measured divergence envelope (typically ~1e-2 at these magnitudes)
     assert err < 5e-2, f"kernel-vs-gather divergence {err} exceeds bound"
+
+
+# --- mixed-step ragged paged attention (per-row variable q_len) -----------------------
+
+
+def _ref_attend_ragged(q, k_att, v_att, positions, q_lens, scale, window=None):
+    """Gather-path reference with per-row q_len masking; padding rows zeroed."""
+    b, hq, t, d = q.shape
+    out = _ref_attend(q, k_att, v_att, positions, scale, window=window)
+    live = (np.arange(t)[None, :] < np.asarray(q_lens)[:, None])
+    return np.where(live[:, None, :, None], np.nan_to_num(np.asarray(out)), 0.0)
+
+
+@pytest.mark.parametrize("q_tile", [None, 2, 8])
+def test_mixed_attend_matches_gather_path(q_tile):
+    """Per-row VARIABLE q_len (decode rows q=1 beside chunk rows q<=T) must
+    match the gathered masked-attend reference on every live query token, and
+    zero the padding rows."""
+    k_cache, v_cache, block_table, positions = _setup(seed=7, BS=16, MB=8)
+    L, NB, H, BS, D = k_cache.shape
+    B, MB = block_table.shape
+    T, HQ = 24, 4
+    positions = np.array([5, 0, 40, 100], dtype=np.int32)
+    q_lens = np.array([1, T, 13, 1], dtype=np.int32)
+    rng = np.random.default_rng(8)
+    q = rng.normal(size=(B, HQ, T, D)).astype(np.float32)
+    scale = D ** -0.5
+    lidx = jnp.asarray(1, jnp.int32)
+
+    k_att = block_kvcache.read_seq(jnp.asarray(k_cache[1]),
+                                   jnp.asarray(block_table))
+    v_att = block_kvcache.read_seq(jnp.asarray(v_cache[1]),
+                                   jnp.asarray(block_table))
+    want = _ref_attend_ragged(jnp.asarray(q), k_att, v_att,
+                              jnp.asarray(positions), q_lens, scale)
+    got = np.asarray(paged_mixed_attention_stacked(
+        jnp.asarray(q), jnp.asarray(k_cache), jnp.asarray(v_cache),
+        jnp.asarray(positions), jnp.asarray(q_lens), lidx,
+        jnp.asarray(block_table), scale=scale, q_tile=q_tile, interpret=True))
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=1e-5)
+
+
+def test_mixed_attend_sliding_window():
+    k_cache, v_cache, block_table, positions = _setup(seed=11, BS=16, MB=8)
+    L, NB, H, BS, D = k_cache.shape
+    B = block_table.shape[0]
+    T = 16
+    positions = np.array([3, 0, 60, 90], dtype=np.int32)
+    q_lens = np.array([16, 1, 9, 16], dtype=np.int32)
+    q = np.random.default_rng(12).normal(size=(B, 2, T, D)).astype(np.float32)
+    scale = D ** -0.5
+    lidx = jnp.asarray(0, jnp.int32)
+    window = 24
+
+    k_att = block_kvcache.read_seq(jnp.asarray(k_cache[0]),
+                                   jnp.asarray(block_table))
+    v_att = block_kvcache.read_seq(jnp.asarray(v_cache[0]),
+                                   jnp.asarray(block_table))
+    want = _ref_attend_ragged(jnp.asarray(q), k_att, v_att,
+                              jnp.asarray(positions), q_lens, scale,
+                              window=window)
+    got = np.asarray(paged_mixed_attention_stacked(
+        jnp.asarray(q), jnp.asarray(k_cache), jnp.asarray(v_cache),
+        jnp.asarray(positions), jnp.asarray(q_lens), lidx,
+        jnp.asarray(block_table), scale=scale, window=window, interpret=True))
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=1e-5)
+
+
+def test_mixed_attend_int8_kv_matches_existing_int8_path():
+    """int8 static-scale KV through the mixed kernel must agree with the
+    EXISTING int8 multi-query kernel (same per-q-row quantization, same 1/127
+    p granularity) at a uniform q_len both serve — the int8 discipline itself
+    is accuracy-pinned by tests/test_quantization.py."""
+    k_cache, v_cache, block_table, positions = _setup(seed=13, BS=16, MB=8)
+    kq = np.clip(np.round(k_cache * 32), -127, 127).astype(np.int8)
+    vq = np.clip(np.round(v_cache * 32), -127, 127).astype(np.int8)
+    B = block_table.shape[0]
+    D = k_cache.shape[-1]
+    T = 8
+    positions = np.array([5, 0, 40, 100], dtype=np.int32)
+    q_lens = np.full((B,), T, dtype=np.int32)
+    q = np.random.default_rng(14).normal(size=(B, 4, T, D)).astype(np.float32)
+    scale = D ** -0.5
+    lidx = jnp.asarray(1, jnp.int32)
+
+    want = np.asarray(paged_decode_attention_stacked(
+        jnp.asarray(q), jnp.asarray(kq), jnp.asarray(vq),
+        jnp.asarray(positions), lidx, jnp.asarray(block_table),
+        scale=scale, interpret=True))
+    got = np.asarray(paged_mixed_attention_stacked(
+        jnp.asarray(q), jnp.asarray(kq), jnp.asarray(vq),
+        jnp.asarray(positions), jnp.asarray(q_lens), lidx,
+        jnp.asarray(block_table), scale=scale, interpret=True))
+    # both paths quantize p at 1/127 granularity but partition flash blocks
+    # differently; agreement within ~1 payload unit (<1% of the int8 range)
+    np.testing.assert_allclose(got, want, atol=1.0)
+
+
+def test_write_paged_chunk_commit_matches_write_slots():
+    """Chunk-length (t > 8) commits: per-row contiguous runs of RAGGED lengths
+    (tail -1 padding, lengths 0/1/partial/full, block crossings) must match
+    write_slots exactly through the one-RMW-per-pack-window path."""
+    k_cache, v_cache, block_table, positions = _setup(seed=9)
+    L, NB, H, BS, D = k_cache.shape
+    T = 24
+    pos = np.array([3, 0, 60, 14], dtype=np.int32)       # 3: straddles blocks
+    lens = np.array([24, 17, 1, 0], dtype=np.int32)      # full/partial/one/none
+    slots = block_kvcache.make_chunk_slot_mapping(block_table, pos, lens, T, BS)
+    B = pos.shape[0]
+    rng = np.random.default_rng(10)
+    new_k = rng.normal(size=(B, H, T, D)).astype(np.float32)
+    new_v = rng.normal(size=(B, H, T, D)).astype(np.float32)
+    lidx = jnp.asarray(1, jnp.int32)
+
+    ref_k = np.asarray(block_kvcache.write_slots(
+        jnp.asarray(k_cache[1]), jnp.asarray(new_k), jnp.asarray(slots)))
+    ref_v = np.asarray(block_kvcache.write_slots(
+        jnp.asarray(v_cache[1]), jnp.asarray(new_v), jnp.asarray(slots)))
+    out_k, out_v = write_paged_stacked_kv(
+        jnp.asarray(k_cache), jnp.asarray(v_cache), jnp.asarray(new_k),
+        jnp.asarray(new_v), jnp.asarray(slots), lidx, interpret=True)
+    np.testing.assert_array_equal(np.asarray(out_k)[1], ref_k)
+    np.testing.assert_array_equal(np.asarray(out_v)[1], ref_v)
+    np.testing.assert_array_equal(np.asarray(out_k)[0], k_cache[0])
+    np.testing.assert_array_equal(np.asarray(out_k)[2], k_cache[2])
+
+
+def test_write_paged_chunk_commit_drops_nonconforming_suffix():
+    """Found by review: the t>8 path trusts a position-consecutive-prefix
+    contract; a malformed mapping (interior -1 hole, non-consecutive jump)
+    must have its non-conforming SUFFIX dropped — the defined -1 semantics —
+    and must never write to the wrong slot."""
+    k_cache, v_cache, block_table, positions = _setup(seed=21)
+    L, NB, H, BS, D = k_cache.shape
+    B, T = 2, 16
+    slots = np.zeros((B, T), np.int32)
+    slots[0] = np.arange(10, 26)
+    slots[0, 5] = -1                                 # interior hole
+    slots[1] = np.concatenate([np.arange(3, 11), np.arange(40, 48)])  # jump
+    rng = np.random.default_rng(22)
+    new_k = rng.normal(size=(B, H, T, D)).astype(np.float32)
+    new_v = rng.normal(size=(B, H, T, D)).astype(np.float32)
+    lidx = jnp.asarray(0, jnp.int32)
+
+    exp = np.full((B, T), -1, np.int32)
+    exp[0, :5] = slots[0, :5]                        # conforming prefixes only
+    exp[1, :8] = slots[1, :8]
+    ref_k = np.asarray(block_kvcache.write_slots(
+        jnp.asarray(k_cache[0]), jnp.asarray(new_k), jnp.asarray(exp)))
+    out_k, _ = write_paged_stacked_kv(
+        jnp.asarray(k_cache), jnp.asarray(v_cache), jnp.asarray(new_k),
+        jnp.asarray(new_v), jnp.asarray(slots), lidx, interpret=True)
+    np.testing.assert_array_equal(np.asarray(out_k)[0], ref_k)
+
+
+def test_decode_forward_mixed_qlens_kernel_matches_gather(tiny_llama_hf_config):
+    """Model-level mixed-step parity: decode_forward with per-row q_lens and a
+    logit_idx gather — kernel path vs gather path, logits and caches."""
+    from neuronx_distributed_inference_tpu.config import (
+        TpuConfig, load_pretrained_config)
+    from neuronx_distributed_inference_tpu.models import base as model_base
+    from neuronx_distributed_inference_tpu.models.llama.modeling_llama import (
+        LlamaForCausalLM, LlamaInferenceConfig)
+
+    tpu_cfg = TpuConfig(
+        batch_size=3, seq_len=96, max_context_length=32, dtype="float32",
+        is_continuous_batching=True, paged_attention_enabled=True,
+        pa_num_blocks=24, pa_block_size=8)
+    config = LlamaInferenceConfig(
+        tpu_cfg, load_config=load_pretrained_config(tiny_llama_hf_config))
+    app = LlamaForCausalLM(None, config)
+    app.load_random(seed=0)
+    cache = app.make_paged_cache(24, 8)
+
+    rng = np.random.default_rng(0)
+    B, T = 3, 16
+    block_table = np.stack(
+        [rng.permutation(24)[:8] for _ in range(B)]).astype(np.int32)
+    positions = np.array([13, 0, 29], dtype=np.int32)
+    q_lens = np.array([1, 16, 7], dtype=np.int32)
+    ctx = rng.normal(size=(B, 2, 40, 16)).astype(np.float32) * 0.1
+    slot_ctx = block_kvcache.make_slot_mapping(
+        block_table, np.zeros(B, np.int32), 40, 8)
+    for L in range(cache["k"].shape[0]):
+        cache["k"] = cache["k"].at[L].set(block_kvcache.write_slots(
+            cache["k"][L], jnp.asarray(ctx), jnp.asarray(slot_ctx)))
+        cache["v"] = cache["v"].at[L].set(block_kvcache.write_slots(
+            cache["v"][L], jnp.asarray(ctx * 0.5), jnp.asarray(slot_ctx)))
+    ids = rng.integers(1, 256, size=(B, T)).astype(np.int32)
+    slot_map = block_kvcache.make_chunk_slot_mapping(
+        block_table, positions, q_lens, T, 8)
+
+    outs = {}
+    for use_kernel in (False, True):
+        logits, out_cache = model_base.decode_forward(
+            app.params, app.arch_args, jnp.asarray(ids), jnp.asarray(positions),
+            {k: v.copy() for k, v in cache.items()}, None,
+            mesh=app.mesh, rules=app.sharding_rules,
+            block_table=jnp.asarray(block_table),
+            slot_mapping=jnp.asarray(slot_map), use_kernel=use_kernel,
+            q_lens=jnp.asarray(q_lens), logit_idx=jnp.asarray(q_lens - 1))
+        outs[use_kernel] = (np.asarray(logits), np.asarray(out_cache["k"]),
+                            np.asarray(out_cache["v"]))
+
+    assert outs[True][0].shape == (B, 1, tiny_llama_hf_config["vocab_size"])
+    np.testing.assert_allclose(outs[True][0], outs[False][0], atol=2e-4,
+                               rtol=1e-4)
+    np.testing.assert_allclose(outs[True][1], outs[False][1], atol=1e-5)
+    np.testing.assert_allclose(outs[True][2], outs[False][2], atol=1e-5)
 
 
 @pytest.mark.parametrize("case", ["contiguous", "straddle_window",

@@ -189,3 +189,62 @@ def test_multiquery_paged_attend_bytes_invariant_to_table_width():
     gather_4 = _multiquery_paged_bytes(None, 4)
     gather_32 = _multiquery_paged_bytes(None, 32)
     assert gather_32 > gather_4 * 1.15, (gather_4, gather_32)
+
+
+def _mixed_chunk_paged_bytes(kernel, mb, t, b=4):
+    """Compiled bytes-accessed of one MIXED-STEP chunk attend (per-row q_lens
+    at chunk length ``t``, logit_idx sampling gather) at block-table width
+    ``mb``."""
+    from neuronx_distributed_inference_tpu.models import base as model_base
+
+    cfg = TpuConfig(batch_size=b, seq_len=4096, max_context_length=128,
+                    dtype="bfloat16", context_encoding_buckets=[128],
+                    token_generation_buckets=[512],
+                    is_continuous_batching=True, paged_attention_enabled=True,
+                    pa_num_blocks=66, pa_block_size=128,
+                    decode_kernel_enabled=kernel)
+    config = LlamaInferenceConfig(cfg, load_config=load_pretrained_config(HF))
+    app = LlamaForCausalLM(None, config)
+    app.load_random(seed=0)
+    cache = app.make_paged_cache(cfg.pa_num_blocks, cfg.pa_block_size)
+    use_kernel = bool(kernel)
+
+    def _chunk(params, ids, positions, q_lens, cache, bt, sm):
+        return model_base.decode_forward(
+            params, app.arch_args, ids, positions, cache, None,
+            mesh=app.mesh, rules=app.sharding_rules, block_table=bt,
+            slot_mapping=sm, use_kernel=use_kernel, q_lens=q_lens,
+            logit_idx=q_lens - 1)
+
+    lowered = jax.jit(_chunk, donate_argnums=(4,)).lower(
+        app.params, jnp.zeros((b, t), jnp.int32),
+        jnp.full((b,), 64, jnp.int32), jnp.full((b,), t, jnp.int32),
+        cache, jnp.zeros((b, mb), jnp.int32), jnp.zeros((b, t), jnp.int32))
+    return _bytes_accessed(lowered)
+
+
+@pytest.mark.parametrize("t", [64, 128, 256])
+def test_mixed_chunk_attend_never_falls_back_to_gather(t):
+    """The ISSUE-2 canary: the mixed-step chunked attend at q_len 64/128/256
+    must ride the Pallas variable-q_len kernel — compiled traffic INVARIANT to
+    the block-table width. A silent fallback to the gather path would scale
+    with the table (it materializes the full (B, MB*BS) KV view per layer),
+    which is exactly the regression this canary pins. Gather growth itself is
+    documented at t=64 below.
+
+    Widths 16 vs 32: below 16 blocks the kernel's per-cell block count (and
+    so its conservative XLA operand accounting — each cell block is a
+    separate pallas operand) is table-bound rather than VMEM-budget-bound, so
+    the canary compares two widths where the cell geometry is fixed and only
+    the table grows."""
+    kern_16 = _mixed_chunk_paged_bytes(True, 16, t)
+    kern_32 = _mixed_chunk_paged_bytes(True, 32, t)
+    assert kern_32 <= kern_16 * 1.02, (kern_16, kern_32)
+
+
+def test_mixed_chunk_gather_fallback_grows_with_table():
+    """Documents the cliff the mixed kernel avoids: the gather path's chunk
+    attend traffic grows with the block-table width."""
+    gather_4 = _mixed_chunk_paged_bytes(None, 4, 64)
+    gather_32 = _mixed_chunk_paged_bytes(None, 32, 64)
+    assert gather_32 > gather_4 * 1.15, (gather_4, gather_32)
