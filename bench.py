@@ -50,10 +50,20 @@ def _remaining() -> float:
 
 
 def _tok_per_s(out, bs: int) -> float:
-    """Decode tokens/s from a collect_latency generate output."""
-    total_s = sum(t for t, _ in out.decode_latencies_s)
-    total_toks = sum(n for _, n in out.decode_latencies_s) * bs
-    return total_toks / total_s
+    """Decode tokens/s from a collect_latency generate output (the shared
+    utils/benchmark definition; import deferred — jax config happens in main)."""
+    from neuronx_distributed_inference_tpu.utils.benchmark import decode_tok_per_s
+
+    return decode_tok_per_s(out, bs)
+
+
+def _p_ms(values_s, key: str) -> float:
+    """One percentile (ms) of second-valued samples through THE shared
+    percentile definition (utils/benchmark.percentiles) — bench keys and
+    runner.stats() cannot drift apart."""
+    from neuronx_distributed_inference_tpu.utils.benchmark import percentiles
+
+    return percentiles(list(values_s))[key]
 
 
 def _note(msg: str) -> None:
@@ -252,7 +262,8 @@ def main() -> None:
         # benchmarks use truncated random-weight models, SURVEY §4); real-weight
         # token parity is covered by the HF-CPU parity suite at tiny scale
         "weights": "synthetic-random (env has no real checkpoints)",
-        "p50_decode_step_ms": round(float(np.percentile(per_step_ms, 50)), 2),
+        "p50_decode_step_ms": round(_p_ms(per_step_ms / 1000.0,
+                                          "latency_ms_p50"), 2),
         "ttft_bulk_bs%d_s" % batch: round(out.ttft_s, 3),
     }
     result = {
@@ -398,16 +409,15 @@ def main() -> None:
                 # reported floor 0.0 from block_until_ready on elided calls)
                 t0 = time.perf_counter()
                 np.asarray(f_noop(xs + i))
-                floor.append(1000 * (time.perf_counter() - t0))
-            extra["dispatch_floor_ms"] = round(float(np.percentile(floor, 50)), 1)
+                floor.append(time.perf_counter() - t0)
+            extra["dispatch_floor_ms"] = round(_p_ms(floor, "latency_ms_p50"), 1)
 
             ttfts = []
             for i in range(8):
                 o1 = app.generate(single, max_new_tokens=1)
                 if i:  # first call pays the bs=1-bucket compilation
                     ttfts.append(o1.ttft_s)
-            extra["ttft_p50_ms"] = round(
-                1000.0 * float(np.percentile(ttfts, 50)), 1)
+            extra["ttft_p50_ms"] = round(_p_ms(ttfts, "latency_ms_p50"), 1)
 
             trace_dir = "/tmp/bench_ttft_trace"
             shutil.rmtree(trace_dir, ignore_errors=True)
@@ -625,9 +635,13 @@ def _spec_runner_measure(runner, batch, k, n_chunks=4, max_new=760):
     # actually-dispatched iterations (step() clamps a chunk below spec_chunk
     # near request tails — assuming n_chunks * spec_chunk would bias iter_ms
     # and the ceiling low whenever the budget runs out mid-chunk)
+    from neuronx_distributed_inference_tpu.utils.metrics import acceptance_mean
+
     iters = max(1, runner.spec_iters_run - i0)
+    # acceptance from the runner's registry histogram through the ONE shared
+    # mean definition (utils/metrics.acceptance_mean — same as runner.stats())
     hist = runner.acceptance_counts - h0       # measured window only
-    accept_mean = float((hist * (np.arange(k) + 1)).sum() / max(1, hist.sum()))
+    accept_mean = acceptance_mean(hist)
     iter_ms = 1000.0 * wall / iters
     return (round(n_tokens / wall, 1), round(accept_mean, 2),
             round(iter_ms, 2), round(batch * k / (wall / iters), 1))
@@ -734,32 +748,29 @@ def _drive_open_loop(runner, prompts, arrivals, max_new):
     """Drive a CB runner under an open-loop arrival trace.
 
     Requests are submitted at their (precomputed) arrival offsets while the
-    serving loop steps; per-request TTFT is wall time from ARRIVAL to the
-    step() that emitted its first token. Returns (ttft_s list, tokens, wall_s).
-    """
+    serving loop steps. TTFT / token accounting is NOT recomputed here — the
+    runner's telemetry records the events and the caller reads runner.stats()
+    (the same numbers a production scrape would see). Each submit backdates
+    ``arrival_ts`` to the SCHEDULED arrival: a request that arrives while
+    step() is blocking is only submitted after the step returns, and that
+    wait is exactly the interference this phase measures (it must count in
+    TTFT, matching the pre-telemetry birth-time bookkeeping). Returns
+    wall_s."""
     import time as _time
 
-    t0 = _time.time()
+    t0 = _time.perf_counter()
     idx = 0
-    birth = {}
-    ttfts = []
-    tokens = 0
     while idx < len(arrivals) or runner.has_work:
-        now = _time.time() - t0
+        now = _time.perf_counter() - t0
         while idx < len(arrivals) and arrivals[idx] <= now:
-            rid = runner.submit(prompts[idx], max_new_tokens=max_new)
-            birth[rid] = arrivals[idx]
+            runner.submit(prompts[idx], max_new_tokens=max_new,
+                          arrival_ts=t0 + arrivals[idx])
             idx += 1
         if not runner.has_work:
-            _time.sleep(max(0.0, arrivals[idx] - (_time.time() - t0)))
+            _time.sleep(max(0.0, arrivals[idx] - (_time.perf_counter() - t0)))
             continue
-        em = runner.step()
-        now = _time.time() - t0
-        for rid, toks in em.items():
-            if toks and rid in birth:
-                ttfts.append(now - birth.pop(rid))
-            tokens += len(toks)
-    return ttfts, tokens, _time.time() - t0
+        runner.step()
+    return _time.perf_counter() - t0
 
 
 def _paged_arrival_serving(app, batch, closed_loop_tok_s):
@@ -797,7 +808,9 @@ def _paged_arrival_serving(app, batch, closed_loop_tok_s):
                                mixed_decode_steps=8)),
     ]
     for name, kw in variants:
-        runner = ContinuousBatchingRunner(app, **kw)
+        # telemetry ON: the phase reads TTFT percentiles and token counts off
+        # runner.stats() instead of hand-rolled birth/emit bookkeeping
+        runner = ContinuousBatchingRunner(app, telemetry=True, **kw)
         # warm every executable this schedule touches (insert windows / mixed
         # dispatch / plain chunks) outside the measured trace
         for p in warm:
@@ -806,13 +819,14 @@ def _paged_arrival_serving(app, batch, closed_loop_tok_s):
         while runner.has_work and guard < 200:
             runner.step()
             guard += 1
-        ttfts, tokens, wall = _drive_open_loop(runner, prompts, arrivals,
-                                               max_new)
-        out[f"{name}_tok_per_s"] = round(tokens / wall, 1)
-        out[f"{name}_ttft_p50_ms"] = round(
-            1000.0 * float(np.percentile(ttfts, 50)), 1)
-        out[f"{name}_ttft_p99_ms"] = round(
-            1000.0 * float(np.percentile(ttfts, 99)), 1)
+        runner.telemetry.reset()     # drop the warmup from the measured stats
+        wall = _drive_open_loop(runner, prompts, arrivals, max_new)
+        s = runner.stats()
+        out[f"{name}_tok_per_s"] = round(s["tokens_emitted"] / wall, 1)
+        out[f"{name}_ttft_p50_ms"] = round(s["ttft_ms"]["latency_ms_p50"], 1)
+        out[f"{name}_ttft_p99_ms"] = round(s["ttft_ms"]["latency_ms_p99"], 1)
+        out[f"{name}_queue_wait_p99_ms"] = round(
+            s["queue_wait_ms"]["latency_ms_p99"], 1)
         _drain_runner(runner)
         del runner
         gc.collect()

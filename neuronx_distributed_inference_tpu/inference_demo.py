@@ -148,6 +148,21 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--prefill-token-budget", type=int, default=0,
                    help="max prompt tokens packed per mixed serving step "
                         "(default 2x --prefill-chunk)")
+    g.add_argument("--metrics-out", default=None, metavar="PATH",
+                   help="with --serve: write the final metrics registry as "
+                        "Prometheus text exposition to PATH (enables serving "
+                        "telemetry, utils/metrics.py)")
+    g.add_argument("--trace-out", default=None, metavar="PATH",
+                   help="with --serve: write the step timeline + request "
+                        "lifecycle as Chrome/Perfetto trace-event JSON to "
+                        "PATH (enables serving telemetry)")
+    g.add_argument("--events-out", default=None, metavar="PATH",
+                   help="with --serve: spool per-request lifecycle and step "
+                        "events to PATH as JSONL while serving (enables "
+                        "serving telemetry)")
+    g.add_argument("--stats-interval", type=int, default=0, metavar="N",
+                   help="with --serve: log a runner.stats() JSON snapshot "
+                        "every N serving steps (enables serving telemetry)")
     g.add_argument("--speculation-length", type=int, default=0)
     g.add_argument("--speculation-type", default="fused",
                    choices=["fused", "eagle", "eagle3", "medusa"],
@@ -537,7 +552,10 @@ def _build_spec_engine(args, app, tokenizer=None):
 
 def _run_serving(args, app, tokenizer) -> None:
     """Slot-based continuous-batching serving over the CLI prompts
-    (≈ the reference's continuous-batching serve path)."""
+    (≈ the reference's continuous-batching serve path). Any of
+    --metrics-out / --trace-out / --events-out / --stats-interval turns the
+    serving telemetry on (utils/metrics.py): per-request lifecycle events,
+    the per-dispatch step timeline, and the metrics registry."""
     from .runtime.continuous_batching import ContinuousBatchingRunner
 
     kw = {}
@@ -547,7 +565,13 @@ def _run_serving(args, app, tokenizer) -> None:
         # forwarded even without --prefill-chunk so the runner's own
         # validation raises instead of silently ignoring the flag
         kw["prefill_token_budget"] = args.prefill_token_budget
-    runner = ContinuousBatchingRunner(app, **kw)
+    telemetry = None
+    if (args.metrics_out or args.trace_out or args.events_out
+            or args.stats_interval):
+        from .utils.metrics import ServingTelemetry
+
+        telemetry = ServingTelemetry(jsonl_path=args.events_out)
+    runner = ContinuousBatchingRunner(app, telemetry=telemetry, **kw)
     input_ids, attention_mask = _encode_prompts(args, tokenizer,
                                                 app.arch_args.vocab_size)
     rids = []
@@ -556,13 +580,33 @@ def _run_serving(args, app, tokenizer) -> None:
         if attention_mask is not None:
             row = row[attention_mask[i] > 0]
         rids.append(runner.submit(row, max_new_tokens=args.max_new_tokens))
-    results = runner.run_to_completion(seed=args.seed)
+    def _log_stats(n_steps: int) -> None:
+        if args.stats_interval and n_steps % args.stats_interval == 0:
+            logger.info("serving stats @ step %d: %s", n_steps,
+                        json.dumps(runner.stats(), default=str))
+
+    results = runner.run_to_completion(seed=args.seed, on_step=_log_stats)
     for rid in rids:
         toks = results[rid]
         if tokenizer is not None:
             print(tokenizer.decode(toks))
         else:
             print(f"request {rid}: {toks}")
+    if telemetry is not None:
+        telemetry.close()
+        if args.metrics_out:
+            with open(args.metrics_out, "w") as f:
+                f.write(telemetry.prometheus_text())
+            logger.info("wrote Prometheus metrics to %s", args.metrics_out)
+        if args.trace_out:
+            telemetry.write_chrome_trace(args.trace_out)
+            logger.info("wrote Chrome trace to %s", args.trace_out)
+        s = runner.stats()
+        logger.info(
+            "serving summary: %d requests, %d tokens, steps=%s, ttft_p50=%s ms",
+            s["requests_finished"], s["tokens_emitted"], s["steps"],
+            None if s["ttft_ms"] is None
+            else round(s["ttft_ms"]["latency_ms_p50"], 1))
 
 
 def _try_load_tokenizer(model_path: Optional[str]):

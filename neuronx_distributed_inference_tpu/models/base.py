@@ -1318,10 +1318,11 @@ def _run_stack(params: Params, args: ModelArchArgs, h, cos, sin, mask, cache,
                               attn_bias=attn_bias, alibi_slopes=alibi_slopes,
                               kv_scales=kvs)
 
-    h, k_new, v_new, caps = _scan_layers(
-        params["layers"], cache["k"], cache["v"], h, step, cache_mode="xs",
-        kv_scale_stacks=_cache_scales(cache), capture_layers=capture_layers,
-        deepstack=deepstack, allow_hidden_tap=True, mesh=mesh)
+    with jax.named_scope("layer_stack"):   # dispatch annotation (device traces)
+        h, k_new, v_new, caps = _scan_layers(
+            params["layers"], cache["k"], cache["v"], h, step, cache_mode="xs",
+            kv_scale_stacks=_cache_scales(cache), capture_layers=capture_layers,
+            deepstack=deepstack, allow_hidden_tap=True, mesh=mesh)
     # preserve auxiliary cache entries (e.g. M-RoPE rope_delta) alongside k/v
     out_cache = {**cache, "k": k_new, "v": v_new}
     if capture_layers:
@@ -1542,33 +1543,41 @@ def _run_stack_paged_kernel(params: Params, args: ModelArchArgs, h, cos, sin,
 
 
 def _embed(params: Params, args: ModelArchArgs, input_ids, mesh, rules):
-    h = jnp.take(params["embed"], input_ids, axis=0)
-    if args.embedding_multiplier != 1.0:
-        h = h * jnp.asarray(args.embedding_multiplier, h.dtype)
-    return constrain(h, ("batch", None, None), rules, mesh=mesh)
+    # named_scope: dispatch annotation — the phase shows up named in
+    # jax.profiler device traces / HLO metadata (utils/profiling.py), so the
+    # serving loop's host spans (utils/metrics.ServingTelemetry.annotate)
+    # line up against on-device embed/layers/lm_head time
+    with jax.named_scope("embed"):
+        h = jnp.take(params["embed"], input_ids, axis=0)
+        if args.embedding_multiplier != 1.0:
+            h = h * jnp.asarray(args.embedding_multiplier, h.dtype)
+        return constrain(h, ("batch", None, None), rules, mesh=mesh)
 
 
 def _lm_head(params: Params, args: ModelArchArgs, h, mesh, rules) -> jnp.ndarray:
-    if args.tie_word_embeddings:
-        logits = (h @ params["embed"].T).astype(jnp.float32)
-    else:
-        from ..ops.w4 import is_w4
+    with jax.named_scope("lm_head"):
+        if args.tie_word_embeddings:
+            logits = (h @ params["embed"].T).astype(jnp.float32)
+        else:
+            from ..ops.w4 import is_w4
 
-        head = params["lm_head"]
-        if is_w4(head):
-            # opt-in int4 lm_head (flat 2D leaf, not under the layer scan):
-            # attach the same static kernel-vs-dequant routing the scan applies
-            head = {**head, "use_kernel": _w4_kernel_ok(mesh)}
-        logits = qapply(h, head).astype(jnp.float32)
-    if "lm_head_b" in params:           # phi-style biased output head
-        logits = logits + params["lm_head_b"].astype(jnp.float32)
-    if args.logits_scale != 1.0:        # cohere logit_scale / granite 1/scaling
-        logits = logits * args.logits_scale
-    if args.final_logits_soft_cap is not None:   # gemma2 final tanh cap
-        cap = args.final_logits_soft_cap
-        logits = cap * jnp.tanh(logits / cap)
-    logical = ("batch", "vocab") if logits.ndim == 2 else ("batch", None, "vocab")
-    return constrain(logits, logical, rules, mesh=mesh)
+            head = params["lm_head"]
+            if is_w4(head):
+                # opt-in int4 lm_head (flat 2D leaf, not under the layer scan):
+                # attach the same static kernel-vs-dequant routing the scan
+                # applies
+                head = {**head, "use_kernel": _w4_kernel_ok(mesh)}
+            logits = qapply(h, head).astype(jnp.float32)
+        if "lm_head_b" in params:           # phi-style biased output head
+            logits = logits + params["lm_head_b"].astype(jnp.float32)
+        if args.logits_scale != 1.0:    # cohere logit_scale / granite 1/scaling
+            logits = logits * args.logits_scale
+        if args.final_logits_soft_cap is not None:   # gemma2 final tanh cap
+            cap = args.final_logits_soft_cap
+            logits = cap * jnp.tanh(logits / cap)
+        logical = (("batch", "vocab") if logits.ndim == 2
+                   else ("batch", None, "vocab"))
+        return constrain(logits, logical, rules, mesh=mesh)
 
 
 def _finalize_logits(params, args: ModelArchArgs, h, cache, mesh, rules,

@@ -124,7 +124,7 @@ def _plan_tiles(m: int, hin: int, out: int, *, xbytes: int, wsbytes: int,
         else:
             break
     if os.environ.get("W4_DEBUG"):
-        print(f"[w4] m={m} hin={hin} out={out} {tag} bm={bm} bo={bo} "
+        print(f"[w4] m={m} hin={hin} out={out} {tag} bm={bm} bo={bo} "  # debug-ok: env-gated
               f"est={_est(bm, bo)/2**20:.2f}MB", flush=True)
     return bm, bo
 

@@ -39,6 +39,11 @@ from . import model_wrapper
 logger = logging.getLogger("tpu-inference")
 
 
+def _emitted_count(emitted: Dict[int, List[int]]) -> int:
+    """Total tokens in a {request_id: new tokens} step-emission dict."""
+    return sum(len(v) for v in emitted.values())
+
+
 @dataclass
 class Request:
     request_id: int
@@ -95,10 +100,38 @@ class ContinuousBatchingRunner:
                  spec_min_accept: float = 1.25, spec_probe_every: int = 8,
                  prefill_chunk: Optional[int] = None,
                  prefill_token_budget: Optional[int] = None,
-                 mixed_decode_steps: Optional[int] = None):
+                 mixed_decode_steps: Optional[int] = None,
+                 telemetry=None):
         cfg = app.tpu_config
         if not cfg.is_continuous_batching:
             raise ValueError("tpu_config.is_continuous_batching must be enabled")
+        # --- serving telemetry (utils/metrics.py) -----------------------------
+        # ``telemetry``: a ServingTelemetry, True (enable with defaults), or
+        # None/False (disabled — the default). The REGISTRY stays live either
+        # way: the runner's always-on counters (preemptions, spec acceptance,
+        # spec iterations) migrate onto it with thin back-compat properties;
+        # only per-step / per-token EVENT recording is gated on ``enabled``
+        # (the near-zero-cost path pinned by tests/test_perf_regression.py).
+        from ..utils import metrics as metrics_lib
+
+        if telemetry is None or telemetry is False:
+            telemetry = metrics_lib.ServingTelemetry(enabled=False)
+        elif telemetry is True:
+            telemetry = metrics_lib.ServingTelemetry()
+        self.telemetry = telemetry
+        reg = telemetry.registry
+        self._m_preempt = reg.counter(
+            "serving_preemptions_total",
+            "requests preempted (KV blocks exhausted; requeued for recompute)")
+        self._m_spec_iters = reg.counter(
+            "serving_spec_iterations_total",
+            "fused speculative iterations actually dispatched")
+        self._m_round_trip = reg.gauge(
+            "serving_async_round_trip_seconds",
+            "measured host<->device round trip (async auto mode)")
+        self._m_chunk_wall = reg.histogram(
+            "serving_chunk_wall_seconds",
+            help="wall time of full-size sync decode chunks (async auto mode)")
         if max_insert_tokens_per_step is not None:
             if not cfg.paged_attention_enabled:
                 raise ValueError("max_insert_tokens_per_step (chunked-prefill "
@@ -149,7 +182,6 @@ class ContinuousBatchingRunner:
         # that a chunk lands (and TTFT accrues) every few iterations
         self.mixed_decode_steps = mixed_decode_steps or min(
             8, decode_chunk or max(1, cfg.decode_chunk_size))
-        self.num_preemptions = 0
         self.app = app
         self.cfg = cfg
         self.paged = cfg.paged_attention_enabled
@@ -184,7 +216,7 @@ class ContinuousBatchingRunner:
         if self._async_auto:
             self.async_mode = False            # until measured
         self._chunk_times: List[float] = []
-        self._round_trip_s: Optional[float] = None
+        # _round_trip_s lives on the registry gauge (back-compat property below)
         self._pending = None                   # (toks_dev (slots, steps), steps)
 
         # host-side greedy detection (== application.generate's): every slot
@@ -237,7 +269,6 @@ class ContinuousBatchingRunner:
             self.spec_chunk = spec_chunk or max(1, self.decode_chunk)
             self.async_mode = False
             self._async_auto = False
-            self.acceptance_counts = np.zeros((self.k,), dtype=np.int64)
         if draft is not None:
             if speculation_length is None or speculation_length < 2:
                 raise ValueError(
@@ -277,8 +308,14 @@ class ContinuousBatchingRunner:
             # cannot be proven exact — the on-device chunk amortizes instead
             self.async_mode = False
             self._async_auto = False
+        if self.k:
             # histogram over tokens-committed-per-(row, iteration), length K
-            self.acceptance_counts = np.zeros((self.k,), dtype=np.int64)
+            # (registry-backed; ``acceptance_counts`` is the back-compat
+            # view) — ONE registration for both draft kinds
+            self._m_accept = reg.histogram(
+                "serving_spec_acceptance_tokens",
+                buckets=list(range(1, self.k + 1)),
+                help="tokens committed per (row, fused iteration)")
 
         # adaptive speculation (the serving FLOOR guard): when the measured
         # per-iteration acceptance of a spec chunk falls below
@@ -298,8 +335,8 @@ class ContinuousBatchingRunner:
         self._spec_plain_chunks = 0
         # total fused iterations actually DISPATCHED (clamps can shrink a
         # chunk below spec_chunk near request tails) — the honest denominator
-        # for measured iteration time
-        self.spec_iters_run = 0
+        # for measured iteration time; registry-backed (``spec_iters_run`` is
+        # the back-compat property)
 
         self.queue: List[Request] = []
         self.active: List[Optional[Request]] = [None] * self.num_slots
@@ -919,14 +956,80 @@ class ContinuousBatchingRunner:
 
             self._d_insert_step = jax.jit(_d_insert, donate_argnums=(4,))
 
+    # ------------------------------------------------ telemetry (utils/metrics)
+    # The runner's historical ad-hoc counters live on the metrics registry
+    # now; these thin properties keep the old attribute surface working
+    # (bench.py's measurement windows, tests poking _round_trip_s, ...).
+    @property
+    def num_preemptions(self) -> int:
+        return self._m_preempt.value
+
+    @num_preemptions.setter
+    def num_preemptions(self, v: int) -> None:
+        self._m_preempt.value = int(v)
+
+    @property
+    def spec_iters_run(self) -> int:
+        return self._m_spec_iters.value
+
+    @spec_iters_run.setter
+    def spec_iters_run(self, v: int) -> None:
+        self._m_spec_iters.value = int(v)
+
+    @property
+    def acceptance_counts(self) -> np.ndarray:
+        """Live length-K view of the acceptance histogram's counts (bucket
+        i = iterations that committed i+1 tokens). Spec serving only."""
+        return self._m_accept.counts[: self.k]
+
+    @property
+    def _round_trip_s(self) -> Optional[float]:
+        g = self._m_round_trip
+        return g.value if g.updated else None
+
+    @_round_trip_s.setter
+    def _round_trip_s(self, v: Optional[float]) -> None:
+        if v is None:
+            self._m_round_trip.value, self._m_round_trip.updated = 0.0, False
+        else:
+            self._m_round_trip.set(v)
+
+    def stats(self) -> Dict[str, object]:
+        """Point-in-time serving snapshot: telemetry aggregates (TTFT/TPOT/
+        queue-wait percentiles, per-kind step counts — populated only when
+        telemetry is enabled) plus the always-on runner state (queue depth,
+        occupancy, KV blocks, preemptions, spec acceptance)."""
+        from ..utils import metrics as metrics_lib
+
+        s = self.telemetry.snapshot()
+        s["num_slots"] = self.num_slots
+        s["queue_depth"] = len(self.queue)
+        s["active_requests"] = sum(r is not None for r in self.active)
+        s["num_preemptions"] = self.num_preemptions
+        if self.paged:
+            s["kv_blocks_total"] = self.allocator.num_blocks
+            s["kv_blocks_free"] = self.allocator.num_free
+        if self.k:
+            s["spec"] = {
+                "iterations": self.spec_iters_run,
+                "acceptance_counts": self.acceptance_counts.tolist(),
+                "accept_mean": metrics_lib.acceptance_mean(
+                    self.acceptance_counts),
+            }
+        return s
+
     # ------------------------------------------------------------------ API
     def submit(self, prompt: np.ndarray, max_new_tokens: int = 32,
                eos_token_id: Optional[int] = None,
-               sampling_params=None, adapter_id: int = 0) -> int:
+               sampling_params=None, adapter_id: int = 0,
+               arrival_ts: Optional[float] = None) -> int:
         """``sampling_params``: per-request (3,) [top_k, top_p, temperature]
         (≈ reference per-request sampling, `generation/sampling.py:99-209`);
         ``adapter_id``: multi-LoRA slot, 0 = base (≈ CB forward adapter_ids,
-        `models/model_wrapper.py:252-311`)."""
+        `models/model_wrapper.py:252-311`); ``arrival_ts``: optional
+        ``time.perf_counter()`` timestamp of the request's true upstream
+        arrival for telemetry TTFT/queue-wait (defaults to now — open-loop
+        drivers backdate it so wait spent inside a blocking step() counts)."""
         prompt = np.asarray(prompt).astype(np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
@@ -982,6 +1085,8 @@ class ContinuousBatchingRunner:
                       sampling_params=sampling_params, adapter_id=adapter_id)
         self._next_id += 1
         self.queue.append(req)
+        self.telemetry.request_arrival(req.request_id, int(prompt.size),
+                                       max_new_tokens, ts=arrival_ts)
         return req.request_id
 
     def _row_greedy(self, req: Request) -> bool:
@@ -1082,6 +1187,8 @@ class ContinuousBatchingRunner:
             self._place_counter += 1
             req.placed_seq = self._place_counter
             self.active[slot] = req
+            self.telemetry.request_placed(req.request_id, slot,
+                                          resumed=bool(req.generated))
             if self.insert_cap is not None or self.mixed:
                 # chunked-prefill scheduling: the slot is held, the prompt
                 # streams in bounded windows via _advance_inserts (insert_cap)
@@ -1145,15 +1252,26 @@ class ContinuousBatchingRunner:
         if self.insert_cap is not None:
             key = self._advance_inserts(key, emitted)
         if self.k:
-            return self._step_spec(key, emitted)
-        if self.mixed:
-            return self._step_mixed(key, emitted)
-        return self._step_plain(key, emitted)
+            emitted = self._step_spec(key, emitted)
+        elif self.mixed:
+            emitted = self._step_mixed(key, emitted)
+        else:
+            emitted = self._step_plain(key, emitted)
+        # telemetry epilogue (single attribute test when disabled): fold this
+        # step's emissions into the per-request records (first-token / commit
+        # events) and refresh the queue gauge
+        if self.telemetry.enabled:
+            self.telemetry.note_emitted(emitted)
+            self.telemetry.set_queue_depth(len(self.queue))
+        return emitted
 
     def _step_plain(self, key, emitted: Dict[int, List[int]]
                     ) -> Dict[int, List[int]]:
         """One plain (non-speculative) decode chunk for every slot. Also the
         exact near-boundary fallback for spec mode (see _step_spec)."""
+        tel = self.telemetry
+        t_step = tel.step_start()
+        n_emit0 = _emitted_count(emitted) if t_step is not None else 0
         active_rows = [r for r in self.active if r is not None]
         if not active_rows:
             self._drain(emitted)
@@ -1199,18 +1317,20 @@ class ContinuousBatchingRunner:
                               for r in self.active])
             slot_chunk = self._slot_mapping_fn(
                 self.block_table, positions, steps, self.block_size, valid=valid)
-            toks_dev, self.cache = self._decode_step(
-                self.app.params, tok0,
-                jnp.asarray(positions), self.cache,
-                jnp.asarray(self.block_table), jnp.asarray(slot_chunk), sp, sub,
-                adapters, num_steps=steps, greedy=greedy)
+            with tel.annotate("decode"):
+                toks_dev, self.cache = self._decode_step(
+                    self.app.params, tok0,
+                    jnp.asarray(positions), self.cache,
+                    jnp.asarray(self.block_table), jnp.asarray(slot_chunk),
+                    sp, sub, adapters, num_steps=steps, greedy=greedy)
         else:
             bucket = autobucketing.select_bucket(self.app.tkg_buckets,
                                                  max_pos + steps)
-            toks_dev, self.cache = self._decode_step(
-                self.app.params, tok0,
-                jnp.asarray(positions), self.cache, sp, sub, adapters,
-                decode_bucket=bucket, num_steps=steps, greedy=greedy)
+            with tel.annotate("decode"):
+                toks_dev, self.cache = self._decode_step(
+                    self.app.params, tok0,
+                    jnp.asarray(positions), self.cache, sp, sub, adapters,
+                    decode_bucket=bucket, num_steps=steps, greedy=greedy)
 
         if self._async_ok(pend_steps + steps + chunk):
             prior, self._pending = self._pending, (toks_dev, steps)
@@ -1221,6 +1341,13 @@ class ContinuousBatchingRunner:
             self._commit(np.asarray(toks_dev), steps, emitted)
             if t_dispatch is not None:
                 self._note_chunk_time(time.perf_counter() - t_dispatch, steps)
+        if t_step is not None:
+            tel.step_record(
+                t_step, "decode", iterations=steps,
+                tokens=_emitted_count(emitted) - n_emit0,
+                occupancy=len(live), slots=self.num_slots,
+                kv_free=self.allocator.num_free if self.paged else None,
+                kv_total=self.allocator.num_blocks if self.paged else None)
         return emitted
 
     def _note_chunk_time(self, wall_s: float, steps: int) -> None:
@@ -1231,6 +1358,7 @@ class ContinuousBatchingRunner:
         already amortizes the trip)."""
         if not self._async_auto or steps != self.decode_chunk:
             return
+        self._m_chunk_wall.observe(wall_s)
         self._chunk_times.append(wall_s)
         if len(self._chunk_times) < 3:
             return
@@ -1271,6 +1399,9 @@ class ContinuousBatchingRunner:
             # pure-decode steady state: fall through BEFORE draining so async
             # dispatch-ahead keeps overlapping (_step_plain owns _pending)
             return self._step_plain(key, emitted)
+        tel = self.telemetry
+        t_step = tel.step_start()
+        n_emit0 = _emitted_count(emitted) if t_step is not None else 0
         self._drain(emitted)
 
         live = [r for r in active_rows if not r.done and not r.inserting]
@@ -1345,20 +1476,22 @@ class ContinuousBatchingRunner:
             valid=valid)
         greedy = self._chunk_greedy(live + [r for r, _ in chosen])
         key, sub = jax.random.split(key)
-        toks_dev, chunk_tok_dev, self.cache = self._mixed_step(
-            self.app.params, jnp.asarray(self.last_tok),
-            jnp.asarray(self.positions), self.cache,
-            jnp.asarray(self.block_table), jnp.asarray(slot_chunk),
-            jnp.asarray(chunk_ids), jnp.asarray(chunk_pos),
-            jnp.asarray(chunk_qlens), jnp.asarray(chunk_bt),
-            jnp.asarray(chunk_slots), self._sampling_matrix(),
-            jnp.asarray(chunk_sp), sub, jnp.asarray(self.adapter_ids),
-            jnp.asarray(chunk_ad), num_steps=steps, greedy=greedy)
+        with tel.annotate("mixed"):
+            toks_dev, chunk_tok_dev, self.cache = self._mixed_step(
+                self.app.params, jnp.asarray(self.last_tok),
+                jnp.asarray(self.positions), self.cache,
+                jnp.asarray(self.block_table), jnp.asarray(slot_chunk),
+                jnp.asarray(chunk_ids), jnp.asarray(chunk_pos),
+                jnp.asarray(chunk_qlens), jnp.asarray(chunk_bt),
+                jnp.asarray(chunk_slots), self._sampling_matrix(),
+                jnp.asarray(chunk_sp), sub, jnp.asarray(self.adapter_ids),
+                jnp.asarray(chunk_ad), num_steps=steps, greedy=greedy)
 
         if live:
             self._commit(np.asarray(toks_dev), steps, emitted)
         chunk_tok = np.asarray(chunk_tok_dev)
         for i, (r, wlen) in enumerate(chosen):
+            tel.request_prefill_chunk(r.request_id, wlen, r.insert_pos)
             r.insert_pos += wlen
             if r.insert_pos < len(r.fed):
                 continue
@@ -1372,6 +1505,15 @@ class ContinuousBatchingRunner:
             self.positions[r.slot] = r.position
             self.last_tok[r.slot] = r.generated[-1]
             self._maybe_finish(r, emitted)
+        if t_step is not None:
+            tel.step_record(
+                t_step, "mixed", iterations=steps,
+                tokens=_emitted_count(emitted) - n_emit0,
+                occupancy=len(live), slots=self.num_slots,
+                prefill_tokens=sum(w for _, w in chosen),
+                prefill_budget=self.prefill_budget,
+                kv_free=self.allocator.num_free,
+                kv_total=self.allocator.num_blocks)
         return emitted
 
     def _step_spec(self, key, emitted: Dict[int, List[int]]
@@ -1384,6 +1526,9 @@ class ContinuousBatchingRunner:
         live = [r for r in active_rows if not r.done and not r.inserting]
         if not live:
             return emitted
+        tel = self.telemetry
+        t_step = tel.step_start()
+        n_emit0 = _emitted_count(emitted) if t_step is not None else 0
         if self.spec_adaptive and self._spec_off:
             self._spec_plain_chunks += 1
             if self._spec_plain_chunks < self.spec_probe_every:
@@ -1422,25 +1567,29 @@ class ContinuousBatchingRunner:
         bt = (jnp.asarray(self.block_table) if self.paged
               else jnp.zeros((1, 1), dtype=jnp.int32))
         if self.eagle is not None:
-            outs, ns, self._h_cond, self.cache, self.d_cache = \
-                self._spec_step_eagle(
-                    self.app.params, self.eagle[1], jnp.asarray(self.last_tok),
-                    self._h_cond, jnp.asarray(self.positions),
-                    jnp.asarray(alive0), self.cache, self.d_cache, bt,
-                    jnp.asarray(eos_ids), sub, num_iters=iters)
+            with tel.annotate("spec_chunk"):
+                outs, ns, self._h_cond, self.cache, self.d_cache = \
+                    self._spec_step_eagle(
+                        self.app.params, self.eagle[1],
+                        jnp.asarray(self.last_tok),
+                        self._h_cond, jnp.asarray(self.positions),
+                        jnp.asarray(alive0), self.cache, self.d_cache, bt,
+                        jnp.asarray(eos_ids), sub, num_iters=iters)
         else:
             bucket = (None if self.paged
                       else autobucketing.select_bucket(self.app.tkg_buckets,
                                                        max_pos + iters * self.k))
-            outs, ns, self.cache, self.d_cache = self._spec_step(
-                self.app.params, self.draft.params, jnp.asarray(self.last_tok),
-                jnp.asarray(self.positions), jnp.asarray(alive0), self.cache,
-                self.d_cache, bt, sp, jnp.asarray(eos_ids), sub,
-                jnp.asarray(self.adapter_ids), num_iters=iters,
-                greedy=self._chunk_greedy(live), decode_bucket=bucket)
+            with tel.annotate("spec_chunk"):
+                outs, ns, self.cache, self.d_cache = self._spec_step(
+                    self.app.params, self.draft.params,
+                    jnp.asarray(self.last_tok),
+                    jnp.asarray(self.positions), jnp.asarray(alive0),
+                    self.cache, self.d_cache, bt, sp, jnp.asarray(eos_ids),
+                    sub, jnp.asarray(self.adapter_ids), num_iters=iters,
+                    greedy=self._chunk_greedy(live), decode_bucket=bucket)
         outs = np.asarray(outs)           # (iters, slots, K)
         ns = np.asarray(ns)               # (iters, slots)
-        self.spec_iters_run += iters
+        self._m_spec_iters.inc(iters)
         chunk_added = chunk_cells = 0
         for it in range(iters):
             for slot, req in enumerate(self.active):
@@ -1452,7 +1601,7 @@ class ContinuousBatchingRunner:
                                   req.eos_token_id, req.max_new_tokens)
                 added = len(req.generated) - pre
                 if added:
-                    self.acceptance_counts[added - 1] += 1
+                    self._m_accept.observe(added)
                 chunk_added += added
                 chunk_cells += 1
                 req.position += added
@@ -1462,6 +1611,15 @@ class ContinuousBatchingRunner:
                 self.last_tok[slot] = req.generated[-1]
                 if done:
                     self._finish(req)
+        if t_step is not None:
+            tel.step_record(
+                t_step, "spec_chunk", iterations=iters,
+                tokens=_emitted_count(emitted) - n_emit0,
+                occupancy=len(live), slots=self.num_slots,
+                kv_free=self.allocator.num_free if self.paged else None,
+                kv_total=self.allocator.num_blocks if self.paged else None,
+                accept_mean=(chunk_added / chunk_cells if chunk_cells
+                             else None))
         if (self.spec_adaptive and chunk_cells
                 and chunk_added / chunk_cells < self.spec_min_accept):
             self._spec_off = True
@@ -1472,13 +1630,18 @@ class ContinuousBatchingRunner:
                 self.spec_min_accept, self.spec_probe_every)
         return emitted
 
-    def run_to_completion(self, seed: int = 0) -> Dict[int, List[int]]:
-        """Drive step() until every submitted request finishes; returns all outputs."""
+    def run_to_completion(self, seed: int = 0,
+                          on_step=None) -> Dict[int, List[int]]:
+        """Drive step() until every submitted request finishes; returns all
+        outputs. ``on_step(step_count)`` is called after every step (e.g. the
+        CLI's periodic stats logging)."""
         self._key = jax.random.PRNGKey(seed)
         guard = 0
         while self.has_work:
             self.step()
             guard += 1
+            if on_step is not None:
+                on_step(guard)
             if guard > 10000:
                 raise RuntimeError("continuous batching did not converge")
         return {rid: req.generated for rid, req in self.finished.items()}
@@ -1510,7 +1673,8 @@ class ContinuousBatchingRunner:
 
     def _preempt(self, req: Request) -> None:
         logger.info("preempting request %d (out of KV blocks)", req.request_id)
-        self.num_preemptions += 1
+        self._m_preempt.inc()
+        self.telemetry.request_preempted(req.request_id)
         self.active[req.slot] = None
         if self.paged:
             self.allocator.free_sequence(req.blocks)
@@ -1569,6 +1733,8 @@ class ContinuousBatchingRunner:
                     break
                 safe_tokens = end
             cached_len = min(cached_len, safe_tokens)
+        if cached_len > 0:
+            self.telemetry.request_prefix_hit(req.request_id, int(cached_len))
         self.block_table[slot, : len(req.blocks)] = req.blocks
         req.fed = fed
         req.insert_pos = cached_len
@@ -1585,6 +1751,7 @@ class ContinuousBatchingRunner:
         (skip_logits), and with a draft model both pools are written by ONE
         fused dispatch per window. Returns (key, tokens_consumed)."""
         fed = req.fed
+        tel = self.telemetry
         max_window = self.app.cte_buckets[-1]
         sp_row = self._slot_sp[slot : slot + 1]
         ad_row = jnp.asarray(self.adapter_ids[slot : slot + 1])
@@ -1593,6 +1760,7 @@ class ContinuousBatchingRunner:
         bt_row = jnp.asarray(self.block_table[slot : slot + 1])
         used = 0
         while req.insert_pos < len(fed) and (budget is None or used < budget):
+            t_w = tel.step_start()
             wlen = len(fed) - req.insert_pos
             if budget is not None:
                 wlen = min(wlen, budget - used)
@@ -1607,26 +1775,37 @@ class ContinuousBatchingRunner:
                 self.block_table[slot : slot + 1], pos_row, padded.bucket,
                 self.block_size, valid=valid))
             final = req.insert_pos + wlen >= len(fed)
-            if self.draft is not None:
-                key, sub = jax.random.split(key)
-                tok_dev, self.cache, self.d_cache = self._insert_pair_step(
-                    self.app.params, self.draft.params, padded.input_ids,
-                    pos_row, padded.last_token_idx, self.cache, self.d_cache,
-                    bt_row, slot_map, sp_row, sub, ad_row, final=final)
-                if final:
-                    req.tok0_dev = tok_dev
-            elif final or self._insert_step_nol is None:
-                key, sub = jax.random.split(key)
-                req.tok0_dev, self.cache = self._insert_step(
-                    self.app.params, padded.input_ids, pos_row,
-                    padded.last_token_idx, self.cache, bt_row, slot_map,
-                    sp_row, sub, ad_row)
-            else:
-                self.cache = self._insert_step_nol(
-                    self.app.params, padded.input_ids, pos_row, self.cache,
-                    bt_row, slot_map, ad_row)
+            with tel.annotate("insert_window"):
+                if self.draft is not None:
+                    key, sub = jax.random.split(key)
+                    tok_dev, self.cache, self.d_cache = self._insert_pair_step(
+                        self.app.params, self.draft.params, padded.input_ids,
+                        pos_row, padded.last_token_idx, self.cache,
+                        self.d_cache, bt_row, slot_map, sp_row, sub, ad_row,
+                        final=final)
+                    if final:
+                        req.tok0_dev = tok_dev
+                elif final or self._insert_step_nol is None:
+                    key, sub = jax.random.split(key)
+                    req.tok0_dev, self.cache = self._insert_step(
+                        self.app.params, padded.input_ids, pos_row,
+                        padded.last_token_idx, self.cache, bt_row, slot_map,
+                        sp_row, sub, ad_row)
+                else:
+                    self.cache = self._insert_step_nol(
+                        self.app.params, padded.input_ids, pos_row, self.cache,
+                        bt_row, slot_map, ad_row)
+            tel.request_prefill_chunk(req.request_id, int(wlen),
+                                      int(req.insert_pos))
             req.insert_pos += wlen
             used += wlen
+            if t_w is not None:
+                tel.step_record(
+                    t_w, "insert_window", iterations=1,
+                    prefill_tokens=int(wlen), slots=self.num_slots,
+                    kv_free=self.allocator.num_free,
+                    kv_total=self.allocator.num_blocks,
+                    request_id=req.request_id)
         return key, used
 
     def _insert(self, req: Request, slot: int, key) -> int:
@@ -1639,12 +1818,16 @@ class ContinuousBatchingRunner:
 
         if self.paged and self.eagle is not None:
             return self._insert_eagle_host(req, slot, key, fed)
+        tel = self.telemetry
+        # paged inserts are timed per window inside _insert_windows; only the
+        # dense branches below consume this timer
+        t_i = None if self.paged else tel.step_start()
         sp_row = self._slot_sp[slot : slot + 1]
         ad_row = jnp.asarray(self.adapter_ids[slot : slot + 1])
 
         if self.paged:
             self._begin_insert(req, slot)
-            key, _ = self._insert_windows(req, slot, key)
+            key, _ = self._insert_windows(req, slot, key)   # records per window
             req.inserting = False
             tok_dev = req.tok0_dev
         elif len(fed) > self.app.cte_buckets[-1]:
@@ -1679,6 +1862,11 @@ class ContinuousBatchingRunner:
                     self.draft.params, padded.input_ids, padded.position_ids,
                     padded.last_token_idx, self.d_cache,
                     jnp.asarray(slot, dtype=jnp.int32))
+        if t_i is not None and not self.paged:
+            tel.request_prefill_chunk(req.request_id, len(fed), 0)
+            tel.step_record(t_i, "insert", iterations=1,
+                            prefill_tokens=len(fed), slots=self.num_slots,
+                            request_id=req.request_id)
         return int(np.asarray(tok_dev)[0])
 
     def _insert_eagle_host(self, req: Request, slot: int, key, fed) -> int:
@@ -1710,11 +1898,24 @@ class ContinuousBatchingRunner:
                 self.block_table[slot : slot + 1], pos_row, padded.bucket,
                 self.block_size, valid=valid)
             key, sub = jax.random.split(key)
-            tok_dev, h_prev, self.cache, self.d_cache = self._insert_step_eagle(
-                self.app.params, self.eagle[1], padded.input_ids, pos_row,
-                padded.last_token_idx, self.cache, self.d_cache,
-                jnp.asarray(self.block_table[slot : slot + 1]),
-                jnp.asarray(slot_map), sp_row, sub, h_prev)
+            t_w = self.telemetry.step_start()
+            with self.telemetry.annotate("insert_window"):
+                tok_dev, h_prev, self.cache, self.d_cache = \
+                    self._insert_step_eagle(
+                        self.app.params, self.eagle[1], padded.input_ids,
+                        pos_row, padded.last_token_idx, self.cache,
+                        self.d_cache,
+                        jnp.asarray(self.block_table[slot : slot + 1]),
+                        jnp.asarray(slot_map), sp_row, sub, h_prev)
+            self.telemetry.request_prefill_chunk(req.request_id, len(window),
+                                                 start)
+            if t_w is not None:
+                self.telemetry.step_record(
+                    t_w, "insert_window", iterations=1,
+                    prefill_tokens=len(window), slots=self.num_slots,
+                    kv_free=self.allocator.num_free,
+                    kv_total=self.allocator.num_blocks,
+                    request_id=req.request_id)
             start += len(window)
         self._h_cond = self._h_cond.at[slot].set(h_prev[0])
         return int(np.asarray(tok_dev)[0])
@@ -1728,6 +1929,12 @@ class ContinuousBatchingRunner:
     def _finish(self, req: Request) -> None:
         req.done = True
         self.finished[req.request_id] = req
+        reason = ("truncated" if req.truncated
+                  else "eos" if (req.eos_token_id is not None and req.generated
+                                 and req.generated[-1] == req.eos_token_id)
+                  else "length")
+        self.telemetry.request_finished(req.request_id, reason,
+                                        len(req.generated))
         if req.slot >= 0:
             self.active[req.slot] = None
             if self.paged:

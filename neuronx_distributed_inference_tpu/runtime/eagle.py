@@ -28,6 +28,7 @@ from ..models import eagle as eagle_lib
 from ..models.base import ModelArchArgs
 from ..modules import autobucketing, kvcache
 from . import model_wrapper
+from . import speculation as spec_lib
 from .speculation import (SpecGenerateOutput, assemble_spec_output,
                           chunk_advance, quantize_chunk_iters, replay_chunk)
 
@@ -76,6 +77,7 @@ class EagleSpeculativeModel:
         self.spec_chunk = max(1, spec_chunk)
         self.draft_params = None
         self.draft_cache = None
+        spec_lib.attach_spec_metrics(self, self.k, "eagle chain")
         self._build_steps()
 
     def load_random_draft(self, seed: int = 0) -> None:
@@ -274,5 +276,6 @@ class EagleSpeculativeModel:
             steps += replay_chunk(out, n, committed, done, positions, last_tok,
                                   accept_hist, eos_token_id, max_new_tokens)
 
+        spec_lib.record_spec_metrics(self, accept_hist, steps)
         return assemble_spec_output(committed, padded, b, pad_token_id, accept_hist,
                                     steps, ttft)

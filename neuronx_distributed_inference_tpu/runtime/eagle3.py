@@ -35,6 +35,7 @@ from ..models import eagle as eagle_lib
 from ..models.base import ModelArchArgs
 from ..modules import autobucketing, kvcache
 from . import model_wrapper
+from . import speculation as spec_lib
 from .speculation import (SpecGenerateOutput, assemble_spec_output,
                           chunk_advance, quantize_chunk_iters, replay_chunk)
 
@@ -70,6 +71,7 @@ class Eagle3SpeculativeModel:
         self.spec_chunk = max(1, spec_chunk)
         self.draft_params = None
         self.draft_cache = None
+        spec_lib.attach_spec_metrics(self, self.depth + 1, "eagle3 tree")
         self._build_steps()
 
     # ------------------------------------------------------------------ weights
@@ -382,5 +384,6 @@ class Eagle3SpeculativeModel:
             steps += replay_chunk(out, n, committed, done, positions, last_tok,
                                   accept_hist, eos_token_id, max_new_tokens)
 
+        spec_lib.record_spec_metrics(self, accept_hist, steps)
         return assemble_spec_output(committed, padded, b, pad_token_id, accept_hist,
                                     steps, ttft)

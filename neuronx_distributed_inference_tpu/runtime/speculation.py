@@ -137,6 +137,43 @@ def commit_row(committed_i: List[int], toks, eos_token_id: Optional[int],
     return len(committed_i) >= max_new_tokens
 
 
+def attach_spec_metrics(engine, k: int, kind: str) -> None:
+    """Give a speculative engine a cumulative acceptance registry
+    (utils/metrics.py): a fixed-bucket histogram over tokens-committed-per-
+    verify-step plus step/token counters, accumulated ACROSS generate()
+    calls (the per-call histogram stays on SpecGenerateOutput). Shared by
+    FusedSpeculativeModel / EagleSpeculativeModel / Eagle3SpeculativeModel."""
+    from ..utils import metrics as metrics_lib
+
+    engine.metrics = metrics_lib.MetricsRegistry()
+    engine._m_accept = engine.metrics.histogram(
+        "spec_acceptance_tokens", buckets=list(range(1, k + 1)),
+        help=f"tokens committed per verify step ({kind})")
+    engine._m_steps = engine.metrics.counter(
+        "spec_steps_total", "verify steps run across generate() calls")
+    engine._m_tokens = engine.metrics.counter(
+        "spec_tokens_committed_total", "tokens committed by acceptance")
+
+
+def record_spec_metrics(engine, accept_hist: np.ndarray, steps: int) -> None:
+    """Fold one generate() call's acceptance histogram into the engine's
+    cumulative registry."""
+    h = engine._m_accept
+    h.counts[: accept_hist.size] += accept_hist
+    tokens = int((accept_hist * (np.arange(accept_hist.size) + 1)).sum())
+    h.sum += float(tokens)
+    engine._m_steps.inc(steps)
+    engine._m_tokens.inc(tokens)
+
+
+def spec_accept_mean(engine) -> float:
+    """Cumulative mean committed tokens per verify step (the one shared
+    definition — utils/metrics.acceptance_mean over the engine histogram)."""
+    from ..utils import metrics as metrics_lib
+
+    return metrics_lib.acceptance_mean(engine._m_accept.counts[:-1])
+
+
 def assemble_spec_output(committed: List[List[int]], padded, b: int,
                          pad_token_id: int, accept_hist: np.ndarray, steps: int,
                          ttft: Optional[float]) -> SpecGenerateOutput:
@@ -244,6 +281,7 @@ class FusedSpeculativeModel:
         # host replays the exact commit rules after the sync)
         self.spec_chunk = max(1, spec_chunk)
         self.sampling_config = target.sampling_config
+        attach_spec_metrics(self, self.k, "fused draft-target")
         self._build_step()
 
     # ------------------------------------------------------------------ step
@@ -483,6 +521,7 @@ class FusedSpeculativeModel:
                                   accept_hist, eos_token_id, max_new_tokens)
             # frozen rows re-step harmlessly at their last position
 
+        record_spec_metrics(self, accept_hist, steps)
         out = assemble_spec_output(committed, padded, b, pad_token_id, accept_hist,
                                    steps, ttft)
         if capture_draft_logits:

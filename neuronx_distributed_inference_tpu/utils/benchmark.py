@@ -92,7 +92,11 @@ class BenchmarkReport:
 
 
 def percentiles(values_s: List[float]) -> Dict[str, float]:
-    """p50/p90/p95/p99/p100/avg in milliseconds (reference metric definitions)."""
+    """p50/p90/p95/p99/p100/avg in milliseconds (reference metric definitions).
+
+    THE percentile definition for every serving surface: bench.py's phases,
+    `utils/metrics.ServingTelemetry.snapshot()` (runner.stats()), and the
+    submodel reports all route through here, so their keys cannot drift."""
     arr = np.asarray(values_s, dtype=np.float64) * 1e3
     return {
         "latency_ms_p50": float(np.percentile(arr, 50)),
@@ -102,6 +106,14 @@ def percentiles(values_s: List[float]) -> Dict[str, float]:
         "latency_ms_p100": float(np.percentile(arr, 100)),
         "latency_ms_avg": float(np.mean(arr)),
     }
+
+
+def decode_tok_per_s(out, batch: int) -> float:
+    """Decode tokens/s from a ``collect_latency`` generate output (shared by
+    bench.py's phases — previously hand-rolled there)."""
+    total_s = sum(t for t, _ in out.decode_latencies_s)
+    total_toks = sum(n for _, n in out.decode_latencies_s) * batch
+    return total_toks / total_s
 
 
 def benchmark_sampling(

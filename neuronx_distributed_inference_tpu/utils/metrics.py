@@ -1,0 +1,594 @@
+"""Serving observability: metrics registry + per-request lifecycle telemetry.
+
+Dependency-free (numpy only) counterpart of a Prometheus client plus a
+Chrome-trace step timeline, sized for the continuous-batching serving loop:
+
+- ``MetricsRegistry``: counters, gauges, and FIXED-BUCKET histograms with a
+  near-zero-cost disabled path (disabled registries hand out shared null
+  instruments whose ``inc``/``set``/``observe`` are one-attribute no-ops),
+  exported as Prometheus text exposition or a plain dict.
+- ``ServingTelemetry``: the serving loop's event spine. Per-request lifecycle
+  events (arrival → placement → prefill chunks → first token → decode commits
+  → preemption/resume → prefix hits → finish) aggregate into TTFT / TPOT /
+  queue-wait percentiles; every dispatch records a STEP event (kind,
+  occupancy, tokens committed, iterations, prefill-budget use, KV blocks,
+  spec acceptance) exportable as Chrome/Perfetto trace-event JSON; events can
+  be spooled to JSONL as they happen. ``annotate(kind)`` wraps host dispatch
+  spans in ``jax.profiler`` trace annotations so the host timeline aligns
+  with device traces (utils/profiling.py).
+
+The registry is ALWAYS live inside a runner (counter updates are rare host
+events — preemptions, chunk boundaries — and cost an int add); the
+``enabled`` flag gates the per-step / per-token event recording, which is the
+only part with hot-path frequency. tests/test_perf_regression.py pins the
+disabled path's per-step overhead.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+# latency-shaped default buckets (seconds): 1 ms .. 60 s, ~log-spaced
+DEFAULT_TIME_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                        0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+
+# ------------------------------------------------------------------ instruments
+class Counter:
+    """Monotonic counter. ``value`` is a plain int/float; ``inc`` is the only
+    mutator (back-compat properties may also assign ``value`` directly)."""
+
+    __slots__ = ("name", "help", "labels", "value")
+
+    def __init__(self, name: str, help: str = "",
+                 labels: Optional[Dict[str, str]] = None):
+        self.name, self.help, self.labels = name, help, labels
+        self.value = 0
+
+    def inc(self, n=1) -> None:
+        self.value += n
+
+
+class Gauge:
+    """Point-in-time value. ``updated`` distinguishes "never set" from 0.0
+    (back-compat: the runner's ``_round_trip_s`` is None until measured)."""
+
+    __slots__ = ("name", "help", "labels", "value", "updated")
+
+    def __init__(self, name: str, help: str = "",
+                 labels: Optional[Dict[str, str]] = None):
+        self.name, self.help, self.labels = name, help, labels
+        self.value = 0.0
+        self.updated = False
+
+    def set(self, v) -> None:
+        self.value = float(v)
+        self.updated = True
+
+
+class Histogram:
+    """Fixed-bucket histogram: ``buckets`` are ascending upper bounds with an
+    implicit +Inf overflow bucket appended; ``counts`` is a LIVE np.int64
+    array of len(buckets)+1 (integer-valued histograms like spec acceptance
+    expose ``counts[:K]`` as the back-compat ``acceptance_counts`` view)."""
+
+    __slots__ = ("name", "help", "labels", "buckets", "counts", "sum", "_bk")
+
+    def __init__(self, name: str, buckets: Sequence[float], help: str = "",
+                 labels: Optional[Dict[str, str]] = None):
+        if not buckets or list(buckets) != sorted(buckets):
+            raise ValueError("histogram buckets must be non-empty ascending "
+                             "upper bounds")
+        self.name, self.help, self.labels = name, help, labels
+        self.buckets = tuple(float(b) for b in buckets)
+        self._bk = np.asarray(self.buckets, dtype=np.float64)
+        self.counts = np.zeros(len(self.buckets) + 1, dtype=np.int64)
+        self.sum = 0.0
+
+    def observe(self, v) -> None:
+        # side="left": an observation equal to a bound lands IN that bucket
+        # (le semantics), so integer buckets [1..K] map value k -> counts[k-1]
+        self.counts[int(np.searchsorted(self._bk, v, side="left"))] += 1
+        self.sum += v
+
+    @property
+    def count(self) -> int:
+        return int(self.counts.sum())
+
+
+class _Null:
+    """Shared no-op instrument for disabled registries: every mutator returns
+    immediately; reads are inert defaults."""
+
+    name = help = ""
+    labels = None
+    value = 0
+    updated = False
+    sum = 0.0
+    count = 0
+    buckets = ()
+
+    def inc(self, n=1):
+        pass
+
+    def set(self, v):
+        pass
+
+    def observe(self, v):
+        pass
+
+    @property
+    def counts(self):
+        return np.zeros(1, dtype=np.int64)
+
+
+_NULL = _Null()
+
+
+def acceptance_mean(counts: np.ndarray) -> float:
+    """Mean committed tokens/row/iteration from an acceptance histogram whose
+    bucket i counts iterations that committed i+1 tokens (the shared helper:
+    runner.stats(), bench.py's spec phases, and eagle engines all read the
+    histogram through this one definition)."""
+    counts = np.asarray(counts)
+    total = int(counts.sum())
+    if total == 0:
+        return 0.0
+    return float((counts * (np.arange(counts.size) + 1)).sum() / total)
+
+
+# ------------------------------------------------------------------ registry
+def _key(name: str, labels: Optional[Dict[str, str]]) -> str:
+    if not labels:
+        return name
+    inner = ",".join(f'{k}="{labels[k]}"' for k in sorted(labels))
+    return f"{name}{{{inner}}}"
+
+
+class MetricsRegistry:
+    """Name-keyed get-or-create store of instruments.
+
+    ``enabled=False`` hands out the shared null instrument — the zero-cost
+    path for callers that want instrumented code with no accounting at all
+    (the serving runner keeps its registry enabled and gates only the
+    event-recording side; see module docstring)."""
+
+    def __init__(self, enabled: bool = True):
+        self.enabled = enabled
+        self._metrics: Dict[str, object] = {}
+
+    def _get(self, cls, name, help, labels, **kw):
+        if not self.enabled:
+            return _NULL
+        key = _key(name, labels)
+        m = self._metrics.get(key)
+        if m is None:
+            m = cls(name, help=help, labels=labels, **kw)
+            self._metrics[key] = m
+        elif type(m) is not cls:
+            raise ValueError(f"metric {key!r} already registered as "
+                             f"{type(m).__name__}, requested {cls.__name__}")
+        return m
+
+    def counter(self, name: str, help: str = "",
+                labels: Optional[Dict[str, str]] = None) -> Counter:
+        return self._get(Counter, name, help, labels)
+
+    def gauge(self, name: str, help: str = "",
+              labels: Optional[Dict[str, str]] = None) -> Gauge:
+        return self._get(Gauge, name, help, labels)
+
+    def histogram(self, name: str, buckets: Sequence[float] = DEFAULT_TIME_BUCKETS,
+                  help: str = "",
+                  labels: Optional[Dict[str, str]] = None) -> Histogram:
+        return self._get(Histogram, name, help, labels, buckets=buckets)
+
+    def reset(self) -> None:
+        """Zero every instrument IN PLACE (cached instrument references stay
+        valid — bench measurement windows reset between phases)."""
+        for m in self._metrics.values():
+            if isinstance(m, Counter):
+                m.value = 0
+            elif isinstance(m, Gauge):
+                m.value, m.updated = 0.0, False
+            elif isinstance(m, Histogram):
+                m.counts[:] = 0
+                m.sum = 0.0
+
+    def to_dict(self) -> Dict[str, object]:
+        out: Dict[str, object] = {}
+        for key, m in self._metrics.items():
+            if isinstance(m, Histogram):
+                out[key] = {"buckets": list(m.buckets),
+                            "counts": m.counts.tolist(),
+                            "sum": m.sum, "count": m.count}
+            elif isinstance(m, Gauge):
+                out[key] = m.value if m.updated else None
+            else:
+                out[key] = m.value
+        return out
+
+    def prometheus_text(self) -> str:
+        """Prometheus text exposition (version 0.0.4): HELP/TYPE headers,
+        cumulative ``le``-labelled histogram buckets ending at +Inf, _sum and
+        _count series."""
+        lines: List[str] = []
+        seen_header = set()
+        for m in self._metrics.values():
+            kind = {Counter: "counter", Gauge: "gauge",
+                    Histogram: "histogram"}[type(m)]
+            if m.name not in seen_header:
+                seen_header.add(m.name)
+                if m.help:
+                    lines.append(f"# HELP {m.name} {m.help}")
+                lines.append(f"# TYPE {m.name} {kind}")
+            base = dict(m.labels) if m.labels else {}
+            if isinstance(m, Histogram):
+                cum = 0
+                for b, c in zip(m.buckets + (float("inf"),), m.counts):
+                    cum += int(c)
+                    lines.append(_series(f"{m.name}_bucket",
+                                         {**base, "le": _le(b)}, cum))
+                lines.append(_series(f"{m.name}_sum", base, m.sum))
+                lines.append(_series(f"{m.name}_count", base, m.count))
+            elif isinstance(m, Gauge):
+                lines.append(_series(m.name, base,
+                                     m.value if m.updated else 0.0))
+            else:
+                lines.append(_series(m.name, base, m.value))
+        return "\n".join(lines) + ("\n" if lines else "")
+
+
+def _le(bound: float) -> str:
+    if bound == float("inf"):
+        return "+Inf"
+    return repr(bound) if bound != int(bound) else str(int(bound))
+
+
+def _series(name: str, labels: Dict[str, str], value) -> str:
+    if labels:
+        inner = ",".join(f'{k}="{v}"' for k, v in labels.items())
+        return f"{name}{{{inner}}} {value}"
+    return f"{name} {value}"
+
+
+# ------------------------------------------------------------------ telemetry
+class ServingTelemetry:
+    """Event spine of the continuous-batching serving loop.
+
+    ``enabled=False`` (the runner default) turns every event/step recorder
+    into an immediate return — the registry stays live for the always-on
+    counters (preemptions, spec acceptance) but nothing per-step or
+    per-token is recorded. All timestamps share ONE clock
+    (``time.perf_counter``) so ``stats()`` percentiles and the JSONL event
+    log are recomputable from each other."""
+
+    def __init__(self, enabled: bool = True,
+                 registry: Optional[MetricsRegistry] = None,
+                 jsonl_path: Optional[str] = None,
+                 max_records: Optional[int] = 200_000):
+        self.enabled = enabled
+        self.registry = registry if registry is not None else MetricsRegistry()
+        self.events: List[dict] = []        # lifecycle event log
+        self.steps: List[dict] = []         # step timeline
+        self.requests: Dict[int, dict] = {}
+        # in-memory retention bound for long-lived serving: past
+        # ``max_records`` entries per log the OLDEST quarter is dropped (and
+        # counted — no silent truncation; the registry aggregates and the
+        # JSONL spool keep the full history). None = unbounded.
+        self.max_records = max_records
+        self._t0 = time.perf_counter()      # trace epoch
+        self._jsonl = None
+        if jsonl_path and enabled:
+            self._jsonl = open(jsonl_path, "w")
+        reg = self.registry
+        self._c_steps: Dict[str, Counter] = {}   # per-kind cache (hot path)
+        self._c_dropped = reg.counter(
+            "serving_telemetry_dropped_records_total",
+            "in-memory event/step/request records evicted past max_records")
+        self._c_requests = reg.counter(
+            "serving_requests_total", "requests submitted")
+        self._c_finished = reg.counter(
+            "serving_requests_finished_total", "requests finished")
+        self._c_tokens = reg.counter(
+            "serving_tokens_emitted_total", "tokens emitted to clients")
+        self._c_prefill = reg.counter(
+            "serving_prefill_tokens_total", "prompt tokens written")
+        self._c_prefix = reg.counter(
+            "serving_prefix_hit_tokens_total",
+            "prompt tokens skipped via prefix-cache hits")
+        self._h_ttft = reg.histogram(
+            "serving_ttft_seconds", help="arrival to first emitted token")
+        self._h_tpot = reg.histogram(
+            "serving_tpot_seconds", DEFAULT_TIME_BUCKETS,
+            help="per-output-token time after the first token")
+        self._h_queue = reg.histogram(
+            "serving_queue_wait_seconds", help="arrival to slot placement")
+        self._g_kv_free = reg.gauge("serving_kv_blocks_free")
+        self._g_kv_used = reg.gauge("serving_kv_blocks_used")
+        self._g_queue = reg.gauge("serving_queue_depth")
+        self._g_occupancy = reg.gauge("serving_batch_occupancy",
+                                      "live decode rows in the last step")
+
+    # ------------------------------------------------------------ event log
+    def _trim(self, log: List) -> None:
+        if self.max_records is not None and len(log) > self.max_records:
+            n = self.max_records // 4
+            del log[:n]
+            self._c_dropped.inc(n)
+
+    def _event(self, event: str, request_id: Optional[int] = None,
+               _ts: Optional[float] = None, **fields):
+        rec = {"ts": (_ts if _ts is not None else time.perf_counter())
+               - self._t0, "event": event}
+        if request_id is not None:
+            rec["request_id"] = request_id
+        rec.update(fields)
+        self.events.append(rec)
+        self._trim(self.events)
+        if self._jsonl is not None:
+            self._jsonl.write(json.dumps(rec) + "\n")
+        return rec
+
+    def request_arrival(self, rid: int, prompt_len: int,
+                        max_new_tokens: int,
+                        ts: Optional[float] = None) -> None:
+        """``ts``: optional ``time.perf_counter()`` timestamp of when the
+        request ACTUALLY arrived upstream (defaults to now). Open-loop
+        drivers backdate to the scheduled arrival so queue wait spent inside
+        a blocking step() is not hidden by submit granularity."""
+        self._c_requests.inc()
+        if not self.enabled:
+            return
+        rec = self._event("arrival", rid, _ts=ts, prompt_len=prompt_len,
+                          max_new_tokens=max_new_tokens)
+        self.requests[rid] = {
+            "arrival_ts": rec["ts"], "placed_ts": None, "first_token_ts": None,
+            "last_token_ts": None, "finish_ts": None, "prompt_len": prompt_len,
+            "tokens": 0, "prefill_tokens": 0, "prefix_hit_tokens": 0,
+            "preemptions": 0, "finish_reason": None, "tpot_observed": False,
+        }
+
+    def request_placed(self, rid: int, slot: int, resumed: bool = False) -> None:
+        if not self.enabled:
+            return
+        rec = self._event("placed", rid, slot=slot, resumed=resumed)
+        r = self.requests.get(rid)
+        if r is not None and r["placed_ts"] is None:
+            r["placed_ts"] = rec["ts"]
+            self._h_queue.observe(rec["ts"] - r["arrival_ts"])
+
+    def request_prefix_hit(self, rid: int, tokens: int) -> None:
+        self._c_prefix.inc(tokens)
+        if not self.enabled:
+            return
+        self._event("prefix_hit", rid, tokens=tokens)
+        r = self.requests.get(rid)
+        if r is not None:
+            r["prefix_hit_tokens"] += tokens
+
+    def request_prefill_chunk(self, rid: int, tokens: int, pos: int) -> None:
+        if not self.enabled:
+            return
+        self._c_prefill.inc(tokens)
+        self._event("prefill_chunk", rid, tokens=tokens, pos=pos)
+        r = self.requests.get(rid)
+        if r is not None:
+            r["prefill_tokens"] += tokens
+
+    def request_preempted(self, rid: int) -> None:
+        if not self.enabled:
+            return
+        self._event("preempted", rid)
+        r = self.requests.get(rid)
+        if r is not None:
+            r["preemptions"] += 1
+
+    def request_finished(self, rid: int, reason: str, n_tokens: int) -> None:
+        self._c_finished.inc()
+        if not self.enabled:
+            return
+        rec = self._event("finish", rid, reason=reason, tokens=n_tokens)
+        r = self.requests.get(rid)
+        if r is None:
+            return
+        r["finish_ts"], r["finish_reason"] = rec["ts"], reason
+        self._maybe_observe_tpot(r)
+        if (self.max_records is not None
+                and len(self.requests) > self.max_records):
+            # evict oldest FINISHED records (dict preserves insertion order);
+            # histograms already hold their latency samples
+            drop = [k for k, v in self.requests.items()
+                    if v["finish_ts"] is not None][: self.max_records // 4]
+            for k in drop:
+                del self.requests[k]
+            self._c_dropped.inc(len(drop))
+
+    def _maybe_observe_tpot(self, r: dict) -> None:
+        """Observe TPOT once per finished request — from finish OR from the
+        step-end note_emitted, whichever lands last (the runner finishes a
+        request inside the step, BEFORE the step's emissions are folded in)."""
+        if (r["tpot_observed"] or r["finish_ts"] is None
+                or r["first_token_ts"] is None or r["tokens"] <= 1):
+            return
+        r["tpot_observed"] = True
+        self._h_tpot.observe(
+            (r["last_token_ts"] - r["first_token_ts"]) / (r["tokens"] - 1))
+
+    def note_emitted(self, emitted: Dict[int, List[int]]) -> None:
+        """Fold one step's {request_id: new tokens} into the per-request
+        records: first-token events (TTFT) and per-commit events (TPOT)."""
+        if not self.enabled or not emitted:
+            return
+        for rid, toks in emitted.items():
+            if not toks:
+                continue
+            n = len(toks)
+            self._c_tokens.inc(n)
+            r = self.requests.get(rid)
+            if r is None:
+                continue
+            if r["first_token_ts"] is None:
+                rec = self._event("first_token", rid)
+                r["first_token_ts"] = rec["ts"]
+                self._h_ttft.observe(rec["ts"] - r["arrival_ts"])
+                ts = rec["ts"]
+                self._event("commit", rid, tokens=n)
+            else:
+                ts = self._event("commit", rid, tokens=n)["ts"]
+            r["tokens"] += n
+            r["last_token_ts"] = ts
+            self._maybe_observe_tpot(r)
+
+    # ------------------------------------------------------------ step timeline
+    def step_start(self) -> Optional[float]:
+        """Hot-path entry: None (one attribute test) when disabled."""
+        if not self.enabled:
+            return None
+        return time.perf_counter()
+
+    def step_record(self, t0: Optional[float], kind: str, *, iterations: int = 0,
+                    tokens: int = 0, occupancy: int = 0, slots: int = 0,
+                    prefill_tokens: int = 0, prefill_budget: int = 0,
+                    kv_free: Optional[int] = None, kv_total: Optional[int] = None,
+                    accept_mean: Optional[float] = None,
+                    request_id: Optional[int] = None) -> None:
+        """Record one dispatch of the serving loop (kinds: ``decode``,
+        ``spec_chunk``, ``mixed``, ``insert_window``, ``insert``). Durations
+        are host spans over dispatch + host commit; device overlap shows up
+        through the paired ``annotate()`` spans in a jax.profiler trace."""
+        if t0 is None or not self.enabled:
+            return
+        now = time.perf_counter()
+        rec = {"ts": t0 - self._t0, "dur_s": now - t0, "kind": kind,
+               "iterations": iterations, "tokens": tokens,
+               "occupancy": occupancy, "slots": slots,
+               "prefill_tokens": prefill_tokens,
+               "prefill_budget": prefill_budget}
+        if kv_total is not None:
+            rec["kv_blocks_free"] = kv_free
+            rec["kv_blocks_total"] = kv_total
+            self._g_kv_free.set(kv_free)
+            self._g_kv_used.set(kv_total - kv_free)
+        if accept_mean is not None:
+            rec["accept_mean"] = round(accept_mean, 4)
+        if request_id is not None:
+            rec["request_id"] = request_id
+        c = self._c_steps.get(kind)
+        if c is None:
+            c = self.registry.counter("serving_steps_total",
+                                      "dispatches by step kind",
+                                      labels={"kind": kind})
+            self._c_steps[kind] = c
+        c.inc()
+        self._g_occupancy.set(occupancy)
+        self.steps.append(rec)
+        self._trim(self.steps)
+        if self._jsonl is not None:
+            self._jsonl.write(json.dumps({"event": "step", **rec}) + "\n")
+
+    def set_queue_depth(self, n: int) -> None:
+        if self.enabled:
+            self._g_queue.set(n)
+
+    def annotate(self, kind: str):
+        """jax.profiler host span for a dispatch (aligns the step timeline
+        with device traces); a shared null context when disabled."""
+        if not self.enabled:
+            return contextlib.nullcontext()
+        from . import profiling
+
+        return profiling.annotate(f"serving_step:{kind}")
+
+    # ------------------------------------------------------------ export
+    def snapshot(self) -> Dict[str, object]:
+        """Aggregate view: TTFT/TPOT/queue-wait percentiles from the RAW
+        per-request records (the same samples the event log carries, so the
+        two are mutually recomputable), per-kind step counts, and the full
+        registry dump."""
+        from .benchmark import percentiles
+
+        ttft, queue_wait, tpot = [], [], []
+        for r in self.requests.values():
+            if r["first_token_ts"] is not None:
+                ttft.append(r["first_token_ts"] - r["arrival_ts"])
+            if r["placed_ts"] is not None:
+                queue_wait.append(r["placed_ts"] - r["arrival_ts"])
+            if (r["first_token_ts"] is not None and r["tokens"] > 1
+                    and r["last_token_ts"] is not None):
+                tpot.append((r["last_token_ts"] - r["first_token_ts"])
+                            / (r["tokens"] - 1))
+        steps: Dict[str, int] = {}
+        tokens_by_kind: Dict[str, int] = {}
+        for s in self.steps:
+            steps[s["kind"]] = steps.get(s["kind"], 0) + 1
+            tokens_by_kind[s["kind"]] = (tokens_by_kind.get(s["kind"], 0)
+                                         + s["tokens"])
+        out: Dict[str, object] = {
+            "requests_submitted": self._c_requests.value,
+            "requests_finished": self._c_finished.value,
+            "tokens_emitted": self._c_tokens.value,
+            "prefill_tokens": self._c_prefill.value,
+            "prefix_hit_tokens": self._c_prefix.value,
+            "steps": steps,
+            "tokens_by_step_kind": tokens_by_kind,
+            "ttft_ms": percentiles(ttft) if ttft else None,
+            "tpot_ms": percentiles(tpot) if tpot else None,
+            "queue_wait_ms": percentiles(queue_wait) if queue_wait else None,
+            "counters": self.registry.to_dict(),
+        }
+        return out
+
+    def chrome_trace(self) -> Dict[str, object]:
+        """Chrome/Perfetto trace-event JSON: step dispatches as complete
+        ("X") events on tid 0 carrying kind/occupancy/KV-utilization args,
+        request lifecycle as instant ("i") events on tid 1."""
+        evs: List[dict] = [
+            {"name": "process_name", "ph": "M", "pid": 0,
+             "args": {"name": "cb-serving"}},
+            {"name": "thread_name", "ph": "M", "pid": 0, "tid": 0,
+             "args": {"name": "steps"}},
+            {"name": "thread_name", "ph": "M", "pid": 0, "tid": 1,
+             "args": {"name": "requests"}},
+        ]
+        for s in self.steps:
+            args = {k: v for k, v in s.items() if k not in ("ts", "dur_s")}
+            if s.get("kv_blocks_total"):
+                args["kv_utilization"] = round(
+                    1.0 - s["kv_blocks_free"] / s["kv_blocks_total"], 4)
+            evs.append({"name": f"step:{s['kind']}", "ph": "X", "cat": "step",
+                        "ts": s["ts"] * 1e6, "dur": s["dur_s"] * 1e6,
+                        "pid": 0, "tid": 0, "args": args})
+        for e in self.events:
+            args = {k: v for k, v in e.items() if k not in ("ts", "event")}
+            evs.append({"name": e["event"], "ph": "i", "s": "t",
+                        "cat": "request", "ts": e["ts"] * 1e6,
+                        "pid": 0, "tid": 1, "args": args})
+        return {"traceEvents": evs, "displayTimeUnit": "ms"}
+
+    def write_chrome_trace(self, path: str) -> str:
+        with open(path, "w") as f:
+            json.dump(self.chrome_trace(), f)
+        return path
+
+    def prometheus_text(self) -> str:
+        return self.registry.prometheus_text()
+
+    def reset(self) -> None:
+        """Clear events/steps/request records and zero the registry in place
+        (bench measurement windows; cached instrument references stay valid)."""
+        self.events.clear()
+        self.steps.clear()
+        self.requests.clear()
+        self.registry.reset()
+        self._t0 = time.perf_counter()
+
+    def close(self) -> None:
+        if self._jsonl is not None:
+            self._jsonl.close()
+            self._jsonl = None

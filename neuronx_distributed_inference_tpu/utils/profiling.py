@@ -54,7 +54,8 @@ def annotate(name: str):
     return jax.profiler.TraceAnnotation(name)
 
 
-def device_time_ms(logdir: str, name_substr: str) -> Optional[float]:
+def device_time_ms(logdir: str, name_substr: str,
+                   plane_substr: str = "tpu") -> Optional[float]:
     """Sum the ON-DEVICE duration of top-level executable events whose name
     contains ``name_substr`` in the trace under ``logdir``.
 
@@ -62,7 +63,11 @@ def device_time_ms(logdir: str, name_substr: str) -> Optional[float]:
     events, e.g. ``jit__prefill``). This is the event-timed device latency the
     bench reports next to wall time — on tunneled environments wall time is
     dominated by dispatch round-trips that local PJRT serving does not pay.
-    Returns None when no trace/plane/event is found."""
+    ``plane_substr`` filters planes case-insensitively (default the TPU device
+    plane; pass "" to scan every plane — e.g. the ``/host:CPU`` plane on the
+    CPU backend, which is how tests/test_profiling.py exercises this parser
+    without accelerator hardware). Returns None when no trace/plane/event is
+    found."""
     import glob as _glob
 
     os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
@@ -77,7 +82,7 @@ def device_time_ms(logdir: str, name_substr: str) -> Optional[float]:
         with open(p, "rb") as f:
             xs.ParseFromString(f.read())
         for plane in xs.planes:
-            if "TPU" not in plane.name and "tpu" not in plane.name.lower():
+            if plane_substr and plane_substr.lower() not in plane.name.lower():
                 continue
             md = plane.event_metadata
             for line in plane.lines:

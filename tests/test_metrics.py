@@ -1,0 +1,282 @@
+"""utils/metrics.py: the serving observability registry + telemetry.
+
+Fast (no model, no jit): instrument semantics, Prometheus text exposition
+validity, dict export, the disabled near-zero-cost path, lifecycle-event
+aggregation (TTFT/TPOT/queue-wait), Chrome-trace export shape, and JSONL
+spooling. The e2e serving pins live in tests/test_telemetry_serving.py.
+"""
+
+import json
+import re
+
+import numpy as np
+import pytest
+
+from neuronx_distributed_inference_tpu.utils import benchmark as benchmark_lib
+from neuronx_distributed_inference_tpu.utils.metrics import (
+    Counter, Gauge, Histogram, MetricsRegistry, ServingTelemetry,
+    acceptance_mean)
+
+
+# ------------------------------------------------------------------ instruments
+def test_counter_gauge_semantics():
+    c = Counter("c_total")
+    c.inc()
+    c.inc(3)
+    assert c.value == 4
+    g = Gauge("g")
+    assert not g.updated
+    g.set(2.5)
+    assert g.updated and g.value == 2.5
+
+
+def test_histogram_buckets_le_semantics():
+    h = Histogram("h", buckets=[1, 2, 4])
+    for v in (0.5, 1, 1.5, 2, 4, 9):
+        h.observe(v)
+    # le semantics: a value equal to a bound lands IN that bucket
+    assert h.counts.tolist() == [2, 2, 1, 1]
+    assert h.count == 6
+    assert h.sum == pytest.approx(18.0)
+
+
+def test_histogram_integer_buckets_back_compat_view():
+    """The spec-acceptance layout: buckets [1..K], value k -> counts[k-1]
+    (the runner's ``acceptance_counts`` view depends on this mapping)."""
+    k = 4
+    h = Histogram("acc", buckets=list(range(1, k + 1)))
+    for v, n in ((1, 3), (2, 2), (4, 5)):
+        for _ in range(n):
+            h.observe(v)
+    assert h.counts[:k].tolist() == [3, 2, 0, 5]
+    assert acceptance_mean(h.counts[:k]) == pytest.approx(
+        (3 * 1 + 2 * 2 + 5 * 4) / 10)
+    assert acceptance_mean(np.zeros(k)) == 0.0
+
+
+def test_histogram_rejects_bad_buckets():
+    with pytest.raises(ValueError):
+        Histogram("h", buckets=[])
+    with pytest.raises(ValueError):
+        Histogram("h", buckets=[2, 1])
+
+
+# ------------------------------------------------------------------ registry
+def test_registry_get_or_create_and_type_conflict():
+    reg = MetricsRegistry()
+    c1 = reg.counter("x_total")
+    assert reg.counter("x_total") is c1
+    with pytest.raises(ValueError):
+        reg.gauge("x_total")
+    # labelled series are distinct instruments under one name
+    a = reg.counter("steps_total", labels={"kind": "decode"})
+    b = reg.counter("steps_total", labels={"kind": "mixed"})
+    assert a is not b
+
+
+def test_disabled_registry_hands_out_null_instruments():
+    reg = MetricsRegistry(enabled=False)
+    c = reg.counter("x_total")
+    c.inc(100)
+    assert c.value == 0
+    h = reg.histogram("h", buckets=[1])
+    h.observe(5)
+    assert h.count == 0
+    assert reg.to_dict() == {}
+    assert reg.prometheus_text() == ""
+
+
+def test_registry_reset_keeps_instrument_references():
+    reg = MetricsRegistry()
+    c = reg.counter("x_total")
+    g = reg.gauge("g")
+    h = reg.histogram("h", buckets=[1, 2])
+    c.inc(5)
+    g.set(1.0)
+    h.observe(1.5)
+    reg.reset()
+    assert c.value == 0 and not g.updated and h.count == 0 and h.sum == 0.0
+    c.inc()                      # the cached reference still feeds the registry
+    assert reg.to_dict()["x_total"] == 1
+
+
+def test_prometheus_text_exposition_valid():
+    reg = MetricsRegistry()
+    reg.counter("req_total", "requests").inc(3)
+    reg.gauge("depth", "queue depth").set(2)
+    h = reg.histogram("lat_seconds", buckets=[0.1, 1.0], help="latency")
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    reg.counter("steps_total", labels={"kind": "decode"}).inc(7)
+    text = reg.prometheus_text()
+    lines = text.strip().split("\n")
+    # every non-comment line is `name[{labels}] value`
+    series = re.compile(
+        r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[a-zA-Z_]+="[^"]*"'
+        r'(,[a-zA-Z_+]+="[^"]*")*\})? -?[0-9.+eEinf]+$')
+    for ln in lines:
+        if ln.startswith("#"):
+            assert re.match(r"^# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]*", ln), ln
+        else:
+            assert series.match(ln), ln
+    assert "# TYPE req_total counter" in text
+    assert "# TYPE lat_seconds histogram" in text
+    assert 'steps_total{kind="decode"} 7' in text
+    # histogram buckets are CUMULATIVE and end at +Inf == _count
+    assert 'lat_seconds_bucket{le="0.1"} 1' in text
+    assert 'lat_seconds_bucket{le="1"} 2' in text
+    assert 'lat_seconds_bucket{le="+Inf"} 3' in text
+    assert "lat_seconds_count 3" in text
+
+
+# ------------------------------------------------------------------ telemetry
+def _drive_fake_requests(tel):
+    """Two requests through the lifecycle with controlled commits."""
+    tel.request_arrival(0, prompt_len=10, max_new_tokens=4)
+    tel.request_arrival(1, prompt_len=20, max_new_tokens=4)
+    tel.request_placed(0, slot=0)
+    tel.request_prefix_hit(0, 8)
+    tel.request_prefill_chunk(0, 10, 0)
+    t0 = tel.step_start()
+    tel.step_record(t0, "decode", iterations=2, tokens=2, occupancy=1,
+                    slots=2, kv_free=40, kv_total=48)
+    tel.note_emitted({0: [5, 6]})
+    tel.request_placed(1, slot=1)
+    tel.note_emitted({0: [7], 1: [9]})
+    tel.request_finished(0, "length", 3)
+    tel.note_emitted({1: [10, 11, 12]})
+    tel.request_finished(1, "eos", 4)
+
+
+def test_telemetry_lifecycle_aggregates_and_event_log_agree(tmp_path):
+    """stats() percentiles must be recomputable from the JSONL event log —
+    the acceptance bar for the serving integration, pinned here on the
+    telemetry layer alone with synthetic events."""
+    path = str(tmp_path / "events.jsonl")
+    tel = ServingTelemetry(jsonl_path=path)
+    _drive_fake_requests(tel)
+    tel.close()
+    snap = tel.snapshot()
+    assert snap["requests_submitted"] == 2
+    assert snap["requests_finished"] == 2
+    assert snap["tokens_emitted"] == 7
+    assert snap["prefix_hit_tokens"] == 8
+    assert snap["steps"] == {"decode": 1}
+
+    events = [json.loads(ln) for ln in open(path)]
+    # recompute TTFT/TPOT/queue-wait from the log alone
+    arr = {e["request_id"]: e["ts"] for e in events if e["event"] == "arrival"}
+    first = {e["request_id"]: e["ts"] for e in events
+             if e["event"] == "first_token"}
+    placed = {e["request_id"]: e["ts"] for e in events if e["event"] == "placed"}
+    last, counts = {}, {}
+    for e in events:
+        if e["event"] == "commit":
+            last[e["request_id"]] = e["ts"]
+            counts[e["request_id"]] = counts.get(e["request_id"], 0) \
+                + e["tokens"]
+    ttft = [first[r] - arr[r] for r in sorted(first)]
+    qwait = [placed[r] - arr[r] for r in sorted(placed)]
+    tpot = [(last[r] - first[r]) / (counts[r] - 1)
+            for r in sorted(first) if counts[r] > 1]
+    assert snap["ttft_ms"] == pytest.approx(benchmark_lib.percentiles(ttft))
+    assert snap["queue_wait_ms"] == pytest.approx(
+        benchmark_lib.percentiles(qwait))
+    assert snap["tpot_ms"] == pytest.approx(benchmark_lib.percentiles(tpot))
+    # step events are spooled to the same log
+    assert any(e["event"] == "step" and e["kind"] == "decode" for e in events)
+
+
+def test_telemetry_chrome_trace_shape():
+    tel = ServingTelemetry()
+    _drive_fake_requests(tel)
+    trace = tel.chrome_trace()
+    js = json.loads(json.dumps(trace))          # round-trips as JSON
+    evs = js["traceEvents"]
+    steps = [e for e in evs if e.get("cat") == "step"]
+    assert steps, "no step events exported"
+    for e in steps:
+        assert e["ph"] == "X" and e["dur"] >= 0
+        for key in ("kind", "occupancy", "tokens", "iterations"):
+            assert key in e["args"], key
+    assert steps[0]["args"]["kv_utilization"] == pytest.approx(1 - 40 / 48,
+                                                               abs=1e-4)
+    insts = [e for e in evs if e.get("cat") == "request"]
+    assert {"arrival", "first_token", "finish"} <= {e["name"] for e in insts}
+
+
+def test_telemetry_disabled_records_nothing_but_counts():
+    tel = ServingTelemetry(enabled=False)
+    _drive_fake_requests(tel)
+    assert tel.events == [] and tel.steps == [] and tel.requests == {}
+    snap = tel.snapshot()
+    assert snap["ttft_ms"] is None
+    # placement-frequency counters stay live (back-compat surface)
+    assert snap["requests_submitted"] == 2
+    assert snap["requests_finished"] == 2
+    assert snap["prefix_hit_tokens"] == 8
+    # but nothing per-token was recorded
+    assert snap["tokens_emitted"] == 0
+    assert tel.step_start() is None
+
+
+def test_telemetry_reset():
+    tel = ServingTelemetry()
+    _drive_fake_requests(tel)
+    tel.reset()
+    assert tel.events == [] and tel.steps == [] and tel.requests == {}
+    assert tel.snapshot()["requests_submitted"] == 0
+
+
+def test_telemetry_bounded_retention_counts_drops():
+    """Long-lived serving must not grow host memory without bound: past
+    ``max_records`` the oldest quarter of each in-memory log is evicted and
+    the eviction is VISIBLE (dropped-records counter — no silent caps)."""
+    tel = ServingTelemetry(max_records=40)
+    for rid in range(60):
+        tel.request_arrival(rid, prompt_len=4, max_new_tokens=2)
+        tel.note_emitted({rid: [1, 2]})
+        tel.request_finished(rid, "length", 2)
+    assert len(tel.events) <= 40
+    assert len(tel.requests) <= 41
+    dropped = tel.registry.counter(
+        "serving_telemetry_dropped_records_total").value
+    assert dropped > 0
+    # aggregates keep the FULL history even after eviction
+    assert tel.snapshot()["requests_submitted"] == 60
+    assert tel._h_ttft.count == 60
+
+
+def test_arrival_ts_backdates_ttft():
+    """Open-loop drivers pass the SCHEDULED arrival time: queue wait spent
+    inside a blocking step() must count in TTFT (bench.py arrival phase)."""
+    import time
+
+    tel = ServingTelemetry()
+    t_sched = time.perf_counter() - 0.5        # arrived 500 ms ago
+    tel.request_arrival(0, prompt_len=4, max_new_tokens=2, ts=t_sched)
+    tel.note_emitted({0: [1]})
+    snap = tel.snapshot()
+    assert snap["ttft_ms"]["latency_ms_p50"] >= 500.0
+
+
+def test_engine_spec_metrics_helpers():
+    """runtime/speculation's engine-side registry helpers (used by the
+    fused/EAGLE/EAGLE3 engines) accumulate across generate() calls."""
+    from neuronx_distributed_inference_tpu.runtime.speculation import (
+        attach_spec_metrics, record_spec_metrics, spec_accept_mean)
+
+    class Engine:
+        pass
+
+    e = Engine()
+    attach_spec_metrics(e, 4, "test")
+    assert spec_accept_mean(e) == 0.0
+    record_spec_metrics(e, np.array([2, 0, 0, 1]), steps=3)
+    record_spec_metrics(e, np.array([0, 0, 0, 3]), steps=3)
+    assert e._m_steps.value == 6
+    assert e._m_tokens.value == (2 * 1 + 1 * 4) + 3 * 4
+    assert spec_accept_mean(e) == pytest.approx((2 + 4 + 12) / 6)
+    assert e.metrics.to_dict()["spec_acceptance_tokens"]["counts"][:4] == \
+        [2, 0, 0, 4]
