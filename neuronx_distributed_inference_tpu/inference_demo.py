@@ -98,6 +98,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "v5e, opt-in for other geometries (ops/quantization)")
     g.add_argument("--async-mode", action="store_true",
                    help="pipeline decode-chunk dispatch ahead of the host sync")
+    g.add_argument("--async-depth", type=int, default=None,
+                   help="dispatch-ahead pipeline depth for --serve (chunks in "
+                        "flight before the host syncs the oldest; default 2, "
+                        "eos/max-new stops tracked on device)")
     g.add_argument("--attention-kernel", dest="attention_kernel", default=None,
                    action="store_true",
                    help="force the Pallas flash prefill kernel on")
@@ -559,6 +563,8 @@ def _run_serving(args, app, tokenizer) -> None:
     from .runtime.continuous_batching import ContinuousBatchingRunner
 
     kw = {}
+    if args.async_depth is not None:
+        kw["async_depth"] = args.async_depth
     if args.prefill_chunk:
         kw["prefill_chunk"] = args.prefill_chunk
     if args.prefill_token_budget:

@@ -680,6 +680,58 @@ def _sharded_paged_kv_write(k_cache, v_cache, new_k, new_v, slot_mapping, layer_
     return fn(k_cache, v_cache, new_k, new_v, slot_mapping, layer_idx)
 
 
+def _paged_fused_enabled() -> bool:
+    """Static routing for the FUSED paged append+attend kernel (the decode hot
+    path): one pallas call per layer writes the step's K/V and attends —
+    eliminating the per-layer write dispatch and the read-after-write of the
+    just-written block. Default ON; TPUINF_PAGED_FUSED=0 falls back to the
+    separate write-then-attend kernels (read at TRACE time — set before the
+    first compile)."""
+    import os
+
+    return os.environ.get("TPUINF_PAGED_FUSED", "1") != "0"
+
+
+def _sharded_paged_fused(q, k_cache, v_cache, new_k, new_v, positions,
+                         slot_mapping, layer_idx, block_table,
+                         args: ModelArchArgs, mesh, rules, sinks=None,
+                         alibi_slopes=None):
+    """FUSED paged decode step (write + attend in ONE pallas call) under the
+    mesh.
+
+    ≈ the reference TKG hot path (`block_kv_cache_manager.py:268-374` +
+    `attention_base.py:1483-1677`) collapsed to a single kernel per layer:
+    the fresh tokens commit through the same RMW windows as
+    `write_paged_stacked_kv` and attend from VMEM operands, while committed
+    blocks stream through a prefetch-pipelined manual DMA loop (see
+    ops/paged_decode.fused_paged_decode_stacked). The saturating cache-dtype
+    cast lives HERE (see _sharded_kv_write). Returns (attn, k_cache, v_cache)."""
+    from ..modules.block_kvcache import PAGED_CACHE_LOGICAL
+    from ..modules.kvcache import to_cache_dtype
+    from ..ops.paged_decode import fused_paged_decode_stacked
+
+    interpret = jax.default_backend() == "cpu"
+    new_k = to_cache_dtype(new_k, k_cache.dtype)
+    new_v = to_cache_dtype(new_v, v_cache.dtype)
+    xl, xo, kw_names = _head_extras(sinks, alibi_slopes, "decode_heads")
+    in_logical = [_DECODE_Q, PAGED_CACHE_LOGICAL, PAGED_CACHE_LOGICAL,
+                  _DECODE_NEW_KV, _DECODE_NEW_KV, ("decode_batch",),
+                  ("decode_batch", None), None, ("decode_batch", None)] + xl
+    operands = [q, k_cache, v_cache, new_k, new_v, positions, slot_mapping,
+                layer_idx, block_table] + xo
+
+    def _local(q, kc, vc, nk, nv, p, sm, li, bt, *extras):
+        kw = dict(zip(kw_names, extras))
+        return fused_paged_decode_stacked(
+            q, nk, nv, kc, vc, p, sm, li, bt, scale=args.attention_scale,
+            window=args.sliding_window, soft_cap=args.logits_soft_cap,
+            interpret=interpret, **kw)
+
+    fn = _shard_mapped(_local, mesh, rules, in_logical,
+                       [_DECODE_Q, PAGED_CACHE_LOGICAL, PAGED_CACHE_LOGICAL])
+    return fn(*operands)
+
+
 def _sharded_paged_attend(q, k_cache, v_cache, positions, layer_idx, block_table,
                           args: ModelArchArgs, mesh, rules, sinks=None,
                           alibi_slopes=None, q_lens=None):
@@ -930,16 +982,28 @@ def _decoder_layer(
         # bandwidth, i.e. long buckets).
         sinks_arr = lp.get("sinks") if args.attn_sinks else None
         if paged_stacked is not None:
-            # ragged paged serving: block-table-indexed write + length-aware attend
+            # ragged paged serving: block-table-indexed write + length-aware
+            # attend. Decode rows (uniform q_len <= 8) take the FUSED
+            # append+attend kernel — ONE pallas call per layer instead of a
+            # write dispatch plus an attend that re-reads the just-written
+            # block; mixed steps (q_lens) keep the separate kernels (the
+            # chunk-length write is the t > 8 one-RMW-per-window path)
             block_table, slot_mapping = paged_stacked
-            k_cache, v_cache = _sharded_paged_kv_write(
-                k_cache, v_cache, k, v, slot_mapping, stacked_layer_idx, mesh,
-                rules)
-            attn = _sharded_paged_attend(q, k_cache, v_cache, positions,
-                                         stacked_layer_idx, block_table, args,
-                                         mesh, rules, sinks=sinks_arr,
-                                         alibi_slopes=alibi_slopes,
-                                         q_lens=q_lens)
+            if (q_lens is None and q.shape[2] <= 8 and _paged_fused_enabled()):
+                attn, k_cache, v_cache = _sharded_paged_fused(
+                    q, k_cache, v_cache, k, v, positions, slot_mapping,
+                    stacked_layer_idx, block_table, args, mesh, rules,
+                    sinks=sinks_arr, alibi_slopes=alibi_slopes)
+            else:
+                k_cache, v_cache = _sharded_paged_kv_write(
+                    k_cache, v_cache, k, v, slot_mapping, stacked_layer_idx,
+                    mesh, rules)
+                attn = _sharded_paged_attend(q, k_cache, v_cache, positions,
+                                             stacked_layer_idx, block_table,
+                                             args, mesh, rules,
+                                             sinks=sinks_arr,
+                                             alibi_slopes=alibi_slopes,
+                                             q_lens=q_lens)
         else:
             wp = positions if write_positions is None else write_positions
             k_cache, v_cache = _sharded_kv_write(

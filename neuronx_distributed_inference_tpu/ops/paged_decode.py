@@ -19,9 +19,16 @@ cliff". These kernels are the TPU answer:
 - **Write** is a tile-aligned read-modify-write per fresh token (Mosaic DMA slices on
   the sublane dim must be whole packed tiles), with dropped-slot (-1) padding writes
   predicated off — replacing the reference's garbage-position padding writes.
+- **Fused append+attend** (`fused_paged_decode_stacked`, the q_len<=8 decode hot
+  path): ONE pallas call per layer commits the fresh tokens through the same RMW
+  windows AND attends — fresh K/V from VMEM operands (no read-after-write of the
+  appended block), committed blocks through a manual ``prefetch_depth``-deep
+  `make_async_copy` pipeline whose loop bound is each row's LIVE block count.
+  Halves the per-step dispatch count vs separate write-then-attend.
 
 Decode is HBM-bandwidth-bound: the win over the gather path is strictly fewer cache
-bytes read per step (table-width -> live-length).
+bytes read per step (table-width -> live-length), and — fused — fewer kernel
+boundaries between them.
 """
 
 from __future__ import annotations
@@ -75,46 +82,37 @@ def _pack(dtype) -> int:
 # --- paged KV write -------------------------------------------------------------------
 
 
-def _paged_write_kernel(slots_ref, lidx_ref, live_ref, new_k_ref, new_v_ref,
-                        _k_in, _v_in, k_out, v_out, sk, sv, sems, *, t: int,
-                        pack: int, bs: int):
-    """Per-row scatter of the step's t fresh tokens, tile-aligned RMW.
+def _window_rmw(k_out, v_out, sk, sv, sems, l, blk, w0, pack, edit):
+    """One aligned-window RMW against the stacked pool: read both K/V tiles
+    into scratch, apply ``edit`` to the scratch, write back. THE write
+    primitive every commit path shares (per-token, one-window fused, chunk)."""
+    dst_k = k_out.at[l, blk, :, pl.ds(w0, pack), :]
+    dst_v = v_out.at[l, blk, :, pl.ds(w0, pack), :]
+    pltpu.make_async_copy(dst_k, sk, sems.at[0]).start()
+    pltpu.make_async_copy(dst_v, sv, sems.at[1]).start()
+    pltpu.make_async_copy(dst_k, sk, sems.at[0]).wait()
+    pltpu.make_async_copy(dst_v, sv, sems.at[1]).wait()
+    edit()
+    pltpu.make_async_copy(sk, dst_k, sems.at[0]).start()
+    pltpu.make_async_copy(sv, dst_v, sems.at[1]).start()
+    pltpu.make_async_copy(sk, dst_k, sems.at[0]).wait()
+    pltpu.make_async_copy(sv, dst_v, sems.at[1]).wait()
 
-    t == 1 (plain decode): one RMW window per row. t in {2..8} (the
-    speculative multi-query commit): the common case — consecutive live slots
-    inside ONE aligned pack window (pack >= 32 for int8/fp8 caches, so a K<=8
-    chain straddles a window boundary at most once every pack positions) —
-    collapses to a SINGLE read-modify-write per row: 4 DMA waits instead of
-    4*t. Rows that straddle a window/block boundary, carry dropped (-1) slots,
-    or aren't consecutive fall back to the per-token loop. Dropped slots stay
-    predicated off in both paths (the conditional commit: a dead CB slot or a
-    masked speculative row writes nothing).
 
-    t > 8 (the CHUNK-length commit of mixed prefill+decode serving steps):
-    each row's live slots must be the position-consecutive prefix of the row
-    (suffix -1 padding only — the shape make_slot_mapping emits for a
-    contiguous token run with a tail valid mask; live counts arrive scalar-
-    prefetched in ``live_ref``). The row's run is walked per aligned pack
-    window: ONE read-modify-write commits up to ``pack`` tokens (4 DMA waits
-    per window instead of per token), and window boundaries coincide with
-    position boundaries (bs % pack == 0), so block crossings just change the
-    window's destination block."""
-    b = pl.program_id(0)
-    l = lidx_ref[0]
+def _append_tokens_rmw(slots_ref, new_k_ref, new_v_ref, k_out, v_out, sk, sv,
+                       sems, l, b, *, t: int, pack: int, bs: int):
+    """Shared t<=8 fresh-token commit: tile-aligned RMW windows, -1 slots dropped.
+
+    The write body of `_paged_write_kernel` (plain decode t=1 and the
+    speculative multi-query commit t in 2..8), factored out so the FUSED
+    append+attend kernel (`fused_paged_decode_stacked`) commits through the
+    exact same windows. The common case — consecutive live slots inside ONE
+    aligned pack window — collapses to a single read-modify-write per row
+    (4 DMA waits, not 4*t); straddling / dropped / non-consecutive slots fall
+    back to the per-token loop."""
 
     def _rmw(blk, w0, edit):
-        """One aligned-window RMW: read both tiles, apply ``edit``, write back."""
-        dst_k = k_out.at[l, blk, :, pl.ds(w0, pack), :]
-        dst_v = v_out.at[l, blk, :, pl.ds(w0, pack), :]
-        pltpu.make_async_copy(dst_k, sk, sems.at[0]).start()
-        pltpu.make_async_copy(dst_v, sv, sems.at[1]).start()
-        pltpu.make_async_copy(dst_k, sk, sems.at[0]).wait()
-        pltpu.make_async_copy(dst_v, sv, sems.at[1]).wait()
-        edit()
-        pltpu.make_async_copy(sk, dst_k, sems.at[0]).start()
-        pltpu.make_async_copy(sv, dst_v, sems.at[1]).start()
-        pltpu.make_async_copy(sk, dst_k, sems.at[0]).wait()
-        pltpu.make_async_copy(sv, dst_v, sems.at[1]).wait()
+        _window_rmw(k_out, v_out, sk, sv, sems, l, blk, w0, pack, edit)
 
     def _per_token():
         for tok in range(t):                   # t is tiny (1 or speculation width)
@@ -138,43 +136,6 @@ def _paged_write_kernel(slots_ref, lidx_ref, live_ref, new_k_ref, new_v_ref,
 
     if t == 1:
         _per_token()
-        return
-
-    if t > 8:
-        # chunk-length commit: consecutive positions, suffix drops only. Walk
-        # the run window by window — group boundaries are the positions where
-        # slot % pack rolls to 0 (consecutive positions advance off by 1 and
-        # bs % pack == 0, so this holds across block crossings too).
-        n = live_ref[b]
-
-        @pl.when(n > 0)
-        def _chunk():
-            base = b * t
-            a0 = slots_ref[base] % pack    # first token's offset in its window
-            for g in range((t + pack - 1) // pack + 1):
-                t0 = jnp.maximum(g * pack - a0, 0)
-                t1 = jnp.minimum((g + 1) * pack - a0, n)
-                cnt = t1 - t0
-
-                @pl.when(cnt > 0)
-                def _one(t0=t0, cnt=cnt):
-                    s0 = slots_ref[base + t0]
-                    blk = s0 // bs
-                    off = s0 % bs
-                    w0 = (off // pack) * pack
-
-                    def edit(off=off, w0=w0, t0=t0, cnt=cnt):
-                        iota = jax.lax.broadcasted_iota(jnp.int32, sk.shape, 1)
-                        rel = iota - (off - w0)    # window row -> token offset
-                        for j in range(pack):      # blends only; one RMW total
-                            src = jnp.minimum(t0 + j, t - 1)
-                            hit = jnp.logical_and(rel == j, j < cnt)
-                            sk[:] = jnp.where(
-                                hit, new_k_ref[0, :, pl.ds(src, 1), :], sk[:])
-                            sv[:] = jnp.where(
-                                hit, new_v_ref[0, :, pl.ds(src, 1), :], sv[:])
-
-                    _rmw(blk, w0, edit)
         return
 
     slot0 = slots_ref[b * t]
@@ -203,6 +164,75 @@ def _paged_write_kernel(slots_ref, lidx_ref, live_ref, new_k_ref, new_v_ref,
     @pl.when(jnp.logical_not(one_window))
     def _straddle():
         _per_token()
+
+
+def _paged_write_kernel(slots_ref, lidx_ref, live_ref, new_k_ref, new_v_ref,
+                        _k_in, _v_in, k_out, v_out, sk, sv, sems, *, t: int,
+                        pack: int, bs: int):
+    """Per-row scatter of the step's t fresh tokens, tile-aligned RMW.
+
+    t == 1 (plain decode): one RMW window per row. t in {2..8} (the
+    speculative multi-query commit): the common case — consecutive live slots
+    inside ONE aligned pack window (pack >= 32 for int8/fp8 caches, so a K<=8
+    chain straddles a window boundary at most once every pack positions) —
+    collapses to a SINGLE read-modify-write per row: 4 DMA waits instead of
+    4*t. Rows that straddle a window/block boundary, carry dropped (-1) slots,
+    or aren't consecutive fall back to the per-token loop. Dropped slots stay
+    predicated off in both paths (the conditional commit: a dead CB slot or a
+    masked speculative row writes nothing).
+
+    t > 8 (the CHUNK-length commit of mixed prefill+decode serving steps):
+    each row's live slots must be the position-consecutive prefix of the row
+    (suffix -1 padding only — the shape make_slot_mapping emits for a
+    contiguous token run with a tail valid mask; live counts arrive scalar-
+    prefetched in ``live_ref``). The row's run is walked per aligned pack
+    window: ONE read-modify-write commits up to ``pack`` tokens (4 DMA waits
+    per window instead of per token), and window boundaries coincide with
+    position boundaries (bs % pack == 0), so block crossings just change the
+    window's destination block."""
+    b = pl.program_id(0)
+    l = lidx_ref[0]
+
+    if t <= 8:
+        _append_tokens_rmw(slots_ref, new_k_ref, new_v_ref, k_out, v_out,
+                           sk, sv, sems, l, b, t=t, pack=pack, bs=bs)
+        return
+
+    # chunk-length commit (t > 8): consecutive positions, suffix drops only.
+    # Walk the run window by window — group boundaries are the positions where
+    # slot % pack rolls to 0 (consecutive positions advance off by 1 and
+    # bs % pack == 0, so this holds across block crossings too).
+    n = live_ref[b]
+
+    @pl.when(n > 0)
+    def _chunk():
+        base = b * t
+        a0 = slots_ref[base] % pack        # first token's offset in its window
+        for g in range((t + pack - 1) // pack + 1):
+            t0 = jnp.maximum(g * pack - a0, 0)
+            t1 = jnp.minimum((g + 1) * pack - a0, n)
+            cnt = t1 - t0
+
+            @pl.when(cnt > 0)
+            def _one(t0=t0, cnt=cnt):
+                s0 = slots_ref[base + t0]
+                blk = s0 // bs
+                off = s0 % bs
+                w0 = (off // pack) * pack
+
+                def edit(off=off, w0=w0, t0=t0, cnt=cnt):
+                    iota = jax.lax.broadcasted_iota(jnp.int32, sk.shape, 1)
+                    rel = iota - (off - w0)        # window row -> token offset
+                    for j in range(pack):          # blends only; one RMW total
+                        src = jnp.minimum(t0 + j, t - 1)
+                        hit = jnp.logical_and(rel == j, j < cnt)
+                        sk[:] = jnp.where(
+                            hit, new_k_ref[0, :, pl.ds(src, 1), :], sk[:])
+                        sv[:] = jnp.where(
+                            hit, new_v_ref[0, :, pl.ds(src, 1), :], sv[:])
+
+                _window_rmw(k_out, v_out, sk, sv, sems, l, blk, w0, pack,
+                            edit)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -721,6 +751,395 @@ def paged_decode_attention_stacked(
     else:
         out = out[:, :, : n_rep * t, :].reshape(b, hkv, n_rep, t, d)
     return out.reshape(b, hq, t, d)
+
+
+# --- fused KV-append + attend (single-dispatch decode hot path) -----------------------
+
+
+def _fused_append_attend_kernel(pos_ref, lidx_ref, slots_ref, bt_ref, q_ref,
+                                new_k_ref, new_v_ref, *refs, scale: float,
+                                bs: int, t: int, qr: int, nq: int, hkv: int,
+                                pack: int, pdepth: int,
+                                window: Optional[int],
+                                soft_cap: Optional[float], has_sinks: bool,
+                                has_slopes: bool):
+    """Fused decode body: commit the step's fresh K/V AND attend, one grid row
+    per batch row.
+
+    Layout of ``refs``: [sinks?, slopes?, k_in, v_in, o_ref, k_out, v_out,
+    ks, vs, wk, wv, m_s, l_s, acc_s, ssem, wsem].
+
+    Three phases per row:
+      1. WRITE — the row's t fresh tokens commit through the same tile-aligned
+         RMW windows as `_paged_write_kernel` (shared `_append_tokens_rmw`).
+         The common one-window case overlaps: the window READ is issued first,
+         the blend happens while iotas/scratch init run, and the write-BACK is
+         left in flight across the whole attend (waited at row end) — safe
+         because the attend never reads fresh lanes from HBM (phase 3 attends
+         them from the VMEM operands) and committed lanes are written back
+         byte-identical.
+      2. STREAM — committed context attends over the row's LIVE blocks only:
+         a ``pdepth``-deep manual DMA pipeline (make_async_copy per block,
+         wait slot i, compute, refill slot i) walks blocks
+         [window_start_block, ceil(pos/bs)). Dead table cells are never
+         fetched (the loop bound is the live length, not the table width),
+         and block fetches overlap the QK/AV compute explicitly instead of
+         relying on the BlockSpec pipeliner's fixed double-buffering.
+      3. FRESH — the t fresh tokens attend from the operands with the
+         intra-chunk causal mask (kv token j visible to q token i iff j <= i,
+         and only if its slot is live), eliminating the separate-kernel
+         read-after-write of the just-written block.
+
+    q rows pack FLAT (hkv * n_rep * t, D) with no per-head padding (v3
+    packing): row r is kv-head ``r // qr``, token ``(r % qr) % t``."""
+    idx = 0
+    sinks_ref = slopes_ref = None
+    if has_sinks:
+        sinks_ref, idx = refs[idx], idx + 1
+    if has_slopes:
+        slopes_ref, idx = refs[idx], idx + 1
+    _k_in, _v_in, o_ref, k_out, v_out = refs[idx : idx + 5]
+    (ks, vs, wk, wv, m_s, l_s, acc_s, ssem, wsem) = refs[idx + 5 :]
+
+    bi = pl.program_id(0)
+    l = lidx_ref[0]
+    pos = pos_ref[bi]
+    d = q_ref.shape[-1]
+    cols = hkv * bs
+
+    # ---- phase 1a: classify the write and issue the window READ early -------
+    slot0 = slots_ref[bi * t]
+    if t == 1:
+        one_window = slot0 >= 0
+        fallback = jnp.zeros((), jnp.bool_)    # dead slot writes nothing
+    else:
+        contig = slot0 >= 0
+        for tok in range(1, t):
+            contig = jnp.logical_and(contig,
+                                     slots_ref[bi * t + tok] == slot0 + tok)
+        off0_ = slot0 % bs
+        one_window = jnp.logical_and(
+            contig, off0_ // pack == (off0_ + t - 1) // pack)
+        fallback = jnp.logical_not(one_window)
+    blk_w = jnp.maximum(slot0, 0) // bs
+    w0 = (jnp.maximum(slot0, 0) % bs // pack) * pack
+    dst_k = k_out.at[l, blk_w, :, pl.ds(w0, pack), :]
+    dst_v = v_out.at[l, blk_w, :, pl.ds(w0, pack), :]
+
+    @pl.when(one_window)
+    def _start_window_read():
+        pltpu.make_async_copy(dst_k, wk, wsem.at[0]).start()
+        pltpu.make_async_copy(dst_v, wv, wsem.at[1]).start()
+
+    # ---- flash state init + iotas (overlaps the RMW read latency) -----------
+    m_s[:] = jnp.full_like(m_s, NEG_INF)
+    l_s[:] = jnp.zeros_like(l_s)
+    acc_s[:] = jnp.zeros_like(acc_s)
+
+    q = q_ref[0]                                           # (nq, d)
+    int8_kv = jnp.dtype(k_out.dtype) == jnp.int8
+    if int8_kv:
+        # int8 KV (static scales): MXU int8 x int8 — same discipline as the
+        # separate attend kernel; per-row q quantization happens once
+        qf = q.astype(jnp.float32)
+        sx = jnp.max(jnp.abs(qf), axis=1, keepdims=True) / 127.0
+        sx = jnp.maximum(sx, 1e-8)
+        qq = jnp.clip(jnp.round(qf / sx), -127, 127).astype(jnp.int8)
+    else:
+        qq = sx = None
+
+    row_iota = jax.lax.broadcasted_iota(jnp.int32, (nq, cols), 0)
+    col_iota = jax.lax.broadcasted_iota(jnp.int32, (nq, cols), 1)
+    same_head = (row_iota // qr) == (col_iota // bs)
+    tok_idx = (row_iota % qr) % t
+    q_pos = pos + tok_idx                                  # (nq, cols)
+    col_off = col_iota % bs
+
+    def _flash_update(kmat, vmat, mask, s_extra_pos=None):
+        """One flash step over (nq, C) score columns; kmat/vmat are (C, d) in
+        the cache dtype. ``s_extra_pos`` = (q_pos - kv_pos) for ALiBi."""
+        if int8_kv:
+            s = jax.lax.dot_general(
+                qq, kmat, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.int32
+            ).astype(jnp.float32) * (sx * scale)
+        else:
+            s = jax.lax.dot_general(
+                q, _vmem_cast(kmat, q.dtype), (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+        if slopes_ref is not None:
+            s = s - slopes_ref[:, 0:1] * s_extra_pos.astype(jnp.float32)
+        if soft_cap is not None:
+            s = soft_cap * jnp.tanh(s / soft_cap)
+        s = jnp.where(mask, s, NEG_INF)
+        m_prev = m_s[:, 0:1]
+        l_prev = l_s[:, 0:1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(jnp.minimum(m_prev - m_new, 0.0))
+        p = jnp.exp(s - m_new)
+        p = jnp.where(mask, p, 0.0)
+        l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        if int8_kv:
+            pi = jnp.round(p * 127.0).astype(jnp.int8)
+            pv = jax.lax.dot_general(
+                pi, vmat, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32
+            ).astype(jnp.float32) * (1.0 / 127.0)
+        else:
+            pv = jax.lax.dot_general(
+                p.astype(q.dtype), _vmem_cast(vmat, q.dtype),
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+        acc_s[:] = acc_s[:] * alpha + pv
+        m_s[:] = jnp.broadcast_to(m_new, (nq, 128))
+        l_s[:] = jnp.broadcast_to(l_new, (nq, 128))
+
+    # ---- phase 1b: blend the fresh tokens, leave the write-back in flight ---
+    @pl.when(one_window)
+    def _blend_and_write_back():
+        pltpu.make_async_copy(dst_k, wk, wsem.at[0]).wait()
+        pltpu.make_async_copy(dst_v, wv, wsem.at[1]).wait()
+        iota = jax.lax.broadcasted_iota(jnp.int32, wk.shape, 1)
+        rel = iota - (jnp.maximum(slot0, 0) % bs - w0)
+        for tok in range(t):
+            hit = rel == tok
+            wk[:] = jnp.where(hit, new_k_ref[0, :, tok : tok + 1, :], wk[:])
+            wv[:] = jnp.where(hit, new_v_ref[0, :, tok : tok + 1, :], wv[:])
+        pltpu.make_async_copy(wk, dst_k, wsem.at[0]).start()
+        pltpu.make_async_copy(wv, dst_v, wsem.at[1]).start()
+
+    if t > 1:
+        @pl.when(fallback)
+        def _straddle_write():
+            # straddling / dropped / non-consecutive slots: the shared
+            # synchronous per-token RMW loop (rare — at most once every
+            # ``pack`` positions per row)
+            _append_tokens_rmw(slots_ref, new_k_ref, new_v_ref, k_out, v_out,
+                               wk, wv, wsem, l, bi, t=t, pack=pack, bs=bs)
+
+    # ---- phase 2: stream the committed blocks (live length only) ------------
+    blk_hi = (pos + bs - 1) // bs              # ceil(pos / bs): kv_pos < pos
+    if window is not None:
+        blk_lo = jnp.maximum(pos - (window - 1), 0) // bs
+        blk_lo = jnp.minimum(blk_lo, blk_hi)
+    else:
+        blk_lo = jnp.zeros((), jnp.int32)
+
+    def _stream_dma(i, slot):
+        pb = bt_ref[bi, i]
+        return (pltpu.make_async_copy(k_out.at[l, pb], ks.at[slot],
+                                      ssem.at[0, slot]),
+                pltpu.make_async_copy(v_out.at[l, pb], vs.at[slot],
+                                      ssem.at[1, slot]))
+
+    for j in range(pdepth):                    # warm-up: fill the pipeline
+        @pl.when(blk_lo + j < blk_hi)
+        def _warm(j=j):
+            i = blk_lo + j
+            dk, dv = _stream_dma(i, i % pdepth)
+            dk.start()
+            dv.start()
+
+    def _stream_body(i, _):
+        slot = jax.lax.rem(i, pdepth)
+        dk, dv = _stream_dma(i, slot)
+        dk.wait()
+        dv.wait()
+        kmat = ks[slot].reshape(cols, d)
+        vmat = vs[slot].reshape(cols, d)
+        kv_pos = i * bs + col_off
+        mask = jnp.logical_and(same_head, kv_pos < pos)
+        if window is not None:
+            mask = jnp.logical_and(mask, kv_pos > q_pos - window)
+        _flash_update(kmat, vmat, mask,
+                      s_extra_pos=(q_pos - kv_pos) if has_slopes else None)
+
+        @pl.when(i + pdepth < blk_hi)
+        def _refill():
+            nk, nv = _stream_dma(i + pdepth, slot)
+            nk.start()
+            nv.start()
+
+        return 0
+
+    jax.lax.fori_loop(blk_lo, blk_hi, _stream_body, 0)
+
+    # ---- phase 3: the fresh tokens attend from the operands -----------------
+    cols_f = hkv * t
+    kf = new_k_ref[0].reshape(cols_f, d)
+    vf = new_v_ref[0].reshape(cols_f, d)
+    row_f = jax.lax.broadcasted_iota(jnp.int32, (nq, cols_f), 0)
+    col_f = jax.lax.broadcasted_iota(jnp.int32, (nq, cols_f), 1)
+    tok_f = col_f % t
+    mask_f = jnp.logical_and((row_f // qr) == (col_f // t),
+                             tok_f <= (row_f % qr) % t)
+    live_f = jnp.zeros((nq, cols_f), jnp.bool_)
+    for j in range(t):
+        live_f = jnp.logical_or(
+            live_f, jnp.logical_and(tok_f == j, slots_ref[bi * t + j] >= 0))
+    mask_f = jnp.logical_and(mask_f, live_f)
+    q_pos_f = pos + (row_f % qr) % t
+    kv_pos_f = pos + tok_f
+    if window is not None:
+        mask_f = jnp.logical_and(mask_f, kv_pos_f > q_pos_f - window)
+    _flash_update(kf, vf, mask_f,
+                  s_extra_pos=(q_pos_f - kv_pos_f) if has_slopes else None)
+
+    # ---- finalize -----------------------------------------------------------
+    m = m_s[:, 0:1]
+    lsum = l_s[:, 0:1]
+    acc = acc_s[:]
+    if sinks_ref is not None:
+        sink = sinks_ref[:, 0:1]
+        m_new = jnp.maximum(m, sink)
+        alpha = jnp.exp(jnp.minimum(m - m_new, 0.0))
+        lsum = alpha * lsum + jnp.exp(sink - m_new)
+        acc = acc * alpha
+    l_safe = jnp.where(lsum == 0.0, 1.0, lsum)
+    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
+
+    @pl.when(one_window)
+    def _drain_write_back():
+        pltpu.make_async_copy(wk, dst_k, wsem.at[0]).wait()
+        pltpu.make_async_copy(wv, dst_v, wsem.at[1]).wait()
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("scale", "window", "soft_cap", "prefetch_depth",
+                     "interpret"))
+def fused_paged_decode_stacked(
+    q: jnp.ndarray,              # (B, Hq, T, D), T <= 8 (1 or speculation width)
+    new_k: jnp.ndarray,          # (B, Hkv, T, D), already in cache dtype
+    new_v: jnp.ndarray,
+    k_cache: jnp.ndarray,        # (L, NB, Hkv, BS, D) — donated/aliased in place
+    v_cache: jnp.ndarray,
+    positions: jnp.ndarray,      # (B,) int32 write position of q[:, :, 0]
+    slot_mapping: jnp.ndarray,   # (B, T) int32 flat slots (block*BS + off); -1 = drop
+    layer_idx: jnp.ndarray,      # () int32 layer to serve
+    block_table: jnp.ndarray,    # (B, MB) int32 physical block ids (logical order)
+    scale: Optional[float] = None,
+    window: Optional[int] = None,
+    soft_cap: Optional[float] = None,
+    sinks: Optional[jnp.ndarray] = None,         # (Hq,) learned sink logits
+    alibi_slopes: Optional[jnp.ndarray] = None,  # (Hq,) ALiBi slopes
+    prefetch_depth: Optional[int] = None,
+    interpret: bool = False,
+):
+    """FUSED KV-append + ragged paged attend: one pallas call serves the layer.
+
+    ≈ the reference TKG hot path collapsed to a single kernel: what
+    `write_paged_stacked_kv` + `paged_decode_attention_stacked` did in TWO
+    dispatches per layer — with the attend RE-READING the block the write had
+    just committed — happens in one. Exact same math: the fresh tokens are
+    written through the identical RMW windows AND attended from the VMEM
+    operands (never read back from HBM), so per step the cache is streamed
+    ONCE at each row's live length. Committed blocks stream through a
+    ``prefetch_depth``-deep manual DMA pipeline (explicit double/multi-
+    buffering against the QK/AV compute) instead of the BlockSpec pipeliner.
+
+    CONTRACT: rows whose slots are dropped (-1) do not write, and their fresh
+    tokens are masked OUT of the attend — a dead serving slot's output row is
+    unspecified-but-finite (the separate-kernel path attends whatever stale
+    bytes sit at those cache positions instead; live rows are bit-exact
+    between the two paths, dead rows are discarded by the host either way).
+
+    Returns (attn (B, Hq, T, D) in q.dtype, k_cache, v_cache)."""
+    b, hq, t, d = q.shape
+    if t > 8:
+        raise ValueError(f"fused append+attend serves decode rows (T <= 8), "
+                         f"got T={t}")
+    _, nb, hkv, bs, _ = k_cache.shape
+    mb = block_table.shape[1]
+    if hq % hkv != 0:
+        raise ValueError(f"q heads {hq} not divisible by kv heads {hkv}")
+    pack = _pack(k_cache.dtype)
+    if bs % pack != 0:
+        raise ValueError(f"pa_block_size {bs} must be a multiple of {pack} for "
+                         f"{k_cache.dtype} caches")
+    n_rep = hq // hkv
+    if scale is None:
+        scale = d ** -0.5
+    qr = n_rep * t
+    nq = _round_up(hkv * qr, 8)
+    qg = q.reshape(b, hkv * qr, d)
+    if nq != hkv * qr:
+        qg = jnp.pad(qg, ((0, 0), (0, nq - hkv * qr), (0, 0)))
+
+    kv_itemsize = jnp.dtype(k_cache.dtype).itemsize
+    if prefetch_depth is not None:
+        pdepth = prefetch_depth
+    else:
+        # pipeline depth: keep ~the separate kernel's per-cell VMEM budget in
+        # flight (int8 4 MB / bf16+fp8 2 MB — the r5 sweep's pipelining
+        # sweet spots), power of two for the cheap slot modulo
+        budget = (4 if jnp.dtype(k_cache.dtype) == jnp.int8 else 2) * 2 ** 20
+        per_block = 2 * hkv * bs * d * kv_itemsize
+        pdepth = 2
+        while pdepth * 2 <= max(2, budget // per_block) and pdepth < 8:
+            pdepth *= 2
+
+    extra_specs, extra_ops = [], []
+    for extra in (sinks, alibi_slopes):
+        if extra is not None:
+            from .flash_decode import _group_head_scalars
+
+            grouped = _group_head_scalars(extra, hkv, n_rep, t, qr)
+            if nq != hkv * qr:
+                grouped = jnp.pad(grouped, ((0, nq - hkv * qr), (0, 0)))
+            extra_specs.append(
+                pl.BlockSpec((nq, 128), lambda bi, *_: (0, 0)))
+            extra_ops.append(grouped)
+    n_extra = len(extra_ops)
+
+    kernel = functools.partial(
+        _fused_append_attend_kernel, scale=scale, bs=bs, t=t, qr=qr, nq=nq,
+        hkv=hkv, pack=pack, pdepth=pdepth, window=window, soft_cap=soft_cap,
+        has_sinks=sinks is not None, has_slopes=alibi_slopes is not None)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec((1, nq, d), lambda bi, *_: (bi, 0, 0)),
+            pl.BlockSpec((1, hkv, t, d), lambda bi, *_: (bi, 0, 0, 0)),
+            pl.BlockSpec((1, hkv, t, d), lambda bi, *_: (bi, 0, 0, 0)),
+        ] + extra_specs + [
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, nq, d), lambda bi, *_: (bi, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((pdepth, hkv, bs, d), k_cache.dtype),
+            pltpu.VMEM((pdepth, hkv, bs, d), v_cache.dtype),
+            pltpu.VMEM((hkv, pack, d), k_cache.dtype),
+            pltpu.VMEM((hkv, pack, d), v_cache.dtype),
+            pltpu.VMEM((nq, 128), jnp.float32),
+            pltpu.VMEM((nq, 128), jnp.float32),
+            pltpu.VMEM((nq, d), jnp.float32),
+            pltpu.SemaphoreType.DMA((2, pdepth)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    out, kc, vc = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct((b, nq, d), q.dtype),
+                   jax.ShapeDtypeStruct(k_cache.shape, k_cache.dtype),
+                   jax.ShapeDtypeStruct(v_cache.shape, v_cache.dtype)],
+        # caches alias in place (after 4 prefetch + q/new_k/new_v + extras)
+        input_output_aliases={7 + n_extra: 1, 8 + n_extra: 2},
+        interpret=interpret,
+    )(positions.astype(jnp.int32), layer_idx.reshape(1).astype(jnp.int32),
+      slot_mapping.reshape(-1).astype(jnp.int32), block_table.astype(jnp.int32),
+      qg, new_k, new_v, *extra_ops, k_cache, v_cache)
+
+    out = out[:, : hkv * qr, :].reshape(b, hkv, n_rep, t, d)
+    return out.reshape(b, hq, t, d), kc, vc
 
 
 # --- mixed-step ragged paged attention ------------------------------------------------

@@ -92,7 +92,8 @@ class ContinuousBatchingRunner:
     """
 
     def __init__(self, app, decode_chunk: Optional[int] = None,
-                 async_mode: Optional[bool] = None, draft=None,
+                 async_mode: Optional[bool] = None,
+                 async_depth: Optional[int] = None, draft=None,
                  speculation_length: Optional[int] = None,
                  spec_chunk: Optional[int] = None,
                  max_insert_tokens_per_step: Optional[int] = None,
@@ -195,15 +196,18 @@ class ContinuousBatchingRunner:
         self.sampling_config = app.sampling_config
         # async dispatch-ahead (≈ application.generate's async_mode and the
         # reference's 2-deep async decode, `modules/async_execution.py:190-306`):
-        # in steady state chunk N+1 is dispatched from chunk N's device-resident
-        # last tokens BEFORE N is synced, hiding the per-chunk host round trip.
-        # Only entered when provably safe (no placements pending, no row with an
-        # eos stop, every row >2 chunks from its max/seq bound, block headroom);
-        # anything else drains the pipeline and runs the exact sync path, so
-        # emitted-token semantics only ever LAG by one chunk, never change.
-        # KNOWN LIMIT: any active row with eos_token_id set disables
-        # dispatch-ahead entirely (an early eos mid-pipeline cannot be proven
-        # exact) — pipelining only engages for max_new_tokens-bounded traffic.
+        # in steady state chunk N+1..N+depth are dispatched from chunk N's
+        # DEVICE-RESIDENT carry state (last token / position / alive / budget
+        # per row) before N is synced, so host commit work (np.asarray of the
+        # oldest chunk's tokens, bookkeeping, telemetry) fully overlaps device
+        # execution instead of gating the next dispatch. Stops are tracked ON
+        # DEVICE: a row that emits its eos or exhausts max_new_tokens FREEZES
+        # in-graph (token/position pinned, KV writes dropped — exactly the
+        # host's replay rules), so rows with eos stops pipeline too. The
+        # pipeline still drains to the exact sync path whenever placements are
+        # pending, a row nears the seq_len bound, or block headroom runs out —
+        # emitted-token semantics only ever LAG by up to ``async_depth``
+        # chunks, never change.
         #
         # Modes: True = always (exactness-gated), False = never, "auto" =
         # measured self-selection — dispatch-ahead only pays when the host
@@ -211,13 +215,28 @@ class ContinuousBatchingRunner:
         # r4: +32% at short chunks, a 5% REGRESSION at 0.9 s chunks where the
         # ~100 ms round trip is already amortized), so auto times the first
         # sync chunks and a blocking round trip, then decides.
+        # ``async_depth`` (default 2, matching the reference's 2-deep async
+        # decode) bounds the chunks in flight after a dispatch.
         self.async_mode = (cfg.async_mode if async_mode is None else async_mode)
         self._async_auto = self.async_mode == "auto"
         if self._async_auto:
             self.async_mode = False            # until measured
+        self.async_depth = max(1, int(
+            async_depth if async_depth is not None
+            else getattr(cfg, "async_depth", None) or 2))
         self._chunk_times: List[float] = []
         # _round_trip_s lives on the registry gauge (back-compat property below)
-        self._pending = None                   # (toks_dev (slots, steps), steps)
+        # FIFO of in-flight chunks [(toks_dev (slots, steps), steps)] plus the
+        # device-resident carry state of the NEWEST dispatch
+        self._inflight: List[tuple] = []
+        self._dev_state = None                 # (tok, pos, alive, budget) dev
+        self._m_depth = reg.gauge(
+            "serving_dispatch_depth",
+            "configured dispatch-ahead pipeline depth")
+        self._m_depth.set(self.async_depth)
+        self._m_inflight = reg.gauge(
+            "serving_inflight_chunks",
+            "decode chunks currently in flight (dispatch-ahead pipeline)")
 
         # host-side greedy detection (== application.generate's): every slot
         # argmax -> the decode chunk compiles without the dynamic sampling
@@ -333,6 +352,12 @@ class ContinuousBatchingRunner:
         self.spec_probe_every = spec_probe_every
         self._spec_off = False
         self._spec_plain_chunks = 0
+        # guard-state gauge: 1 while the floor guard is serving plain chunks
+        # (scrapes + runner.stats() surface WHY spec throughput reads like
+        # plain-paged throughput at chance acceptance)
+        self._m_spec_guard = reg.gauge(
+            "serving_spec_adaptive_fallback",
+            "1 while the adaptive spec floor guard is serving plain chunks")
         # total fused iterations actually DISPATCHED (clamps can shrink a
         # chunk below spec_chunk near request tails) — the honest denominator
         # for measured iteration time; registry-backed (``spec_iters_run`` is
@@ -474,20 +499,31 @@ class ContinuousBatchingRunner:
                         skip_logits=True)
                 return cache
 
-            def _decode(params, tok0, positions, cache, block_table, slot_chunk,
-                        sampling_params, key, adapter_ids, num_steps,
-                        greedy=False):
+            def _decode(params, tok0, positions, alive0, budget0, cache,
+                        block_table, slot_chunk, sampling_params, key,
+                        adapter_ids, eos_ids, num_steps, greedy=False):
+                """``num_steps`` chained decode iterations with ON-DEVICE stop
+                tracking: a row that emits its eos or exhausts its max-new
+                budget FREEZES in-graph (token/position pinned, KV writes
+                dropped) — exactly the host's commit/stop replay rules, so
+                dispatch-ahead stays exact across chunk boundaries without
+                the host having to prove no row can stop mid-pipeline. The
+                returned (tok, pos, alive, budget) carry feeds the NEXT
+                chunk's dispatch device-resident."""
                 keys = jax.random.split(key, num_steps)
                 slots_t = slot_chunk.T[:, :, None]          # (T, B, 1)
 
                 def body(carry, xs):
-                    tok, pos, cache = carry
+                    tok, pos, alive, budget, cache = carry
                     step_key, slots_j = xs
+                    # frozen rows write nothing (their precomputed slots were
+                    # host-estimated past their stop point)
+                    slots_live = jnp.where(alive[:, None], slots_j, -1)
                     with jax.default_matmul_precision(precision):
                         logits, cache = decode_core(
                             params, args, tok[:, None], pos, cache, None,
                             mesh=mesh, rules=rules, block_table=block_table,
-                            slot_mapping=slots_j, adapter_ids=adapter_ids,
+                            slot_mapping=slots_live, adapter_ids=adapter_ids,
                             **paged_kernel_kw)
                         if greedy:
                             # all rows argmax: skip the global-topk sampling
@@ -497,16 +533,22 @@ class ContinuousBatchingRunner:
                             nxt = sampling_ops.sample(logits[:, -1],
                                                       sampling_params,
                                                       step_key, odsc)
-                    return (nxt, pos + 1, cache), nxt
+                    nxt = jnp.where(alive, nxt, tok)
+                    pos = pos + alive.astype(pos.dtype)
+                    budget = budget - alive.astype(budget.dtype)
+                    alive = jnp.logical_and(alive, budget > 0)
+                    alive = jnp.logical_and(alive, nxt != eos_ids)
+                    return (nxt, pos, alive, budget, cache), nxt
 
-                (_, _, cache), toks = jax.lax.scan(
-                    body, (tok0, positions, cache), (keys, slots_t))
-                return toks.T, cache
+                (tok_l, pos_l, alive_l, budget_l, cache), toks = jax.lax.scan(
+                    body, (tok0, positions, alive0, budget0, cache),
+                    (keys, slots_t))
+                return toks.T, (tok_l, pos_l, alive_l, budget_l), cache
 
             self._insert_step = jax.jit(_insert, donate_argnums=(4,))
             self._insert_step_nol = (jax.jit(_insert_nol, donate_argnums=(3,))
                                      if base_decode else None)
-            self._decode_step = jax.jit(_decode, donate_argnums=(3,),
+            self._decode_step = jax.jit(_decode, donate_argnums=(5,),
                                         static_argnames=("num_steps", "greedy"))
 
             if self.mixed:
@@ -584,12 +626,17 @@ class ContinuousBatchingRunner:
                 tok = sampling_ops.sample(logits, sampling_params, key, odsc)
                 return tok, cache
 
-            def _decode(params, tok0, positions, cache, sampling_params, key,
-                        adapter_ids, decode_bucket, num_steps, greedy=False):
+            def _decode(params, tok0, positions, alive0, budget0, cache,
+                        sampling_params, key, adapter_ids, eos_ids,
+                        decode_bucket, num_steps, greedy=False):
+                """Dense decode chunk with the same ON-DEVICE stop tracking as
+                the paged chunk (see above); frozen rows re-write their frozen
+                position with identical bytes — the dense path's existing
+                harmless-rewrite discipline for inactive slots."""
                 keys = jax.random.split(key, num_steps)
 
                 def body(carry, step_key):
-                    tok, pos, cache = carry
+                    tok, pos, alive, budget, cache = carry
                     with jax.default_matmul_precision(precision):
                         logits, cache = decode_core(
                             params, args, tok[:, None], pos, cache, decode_bucket,
@@ -601,10 +648,16 @@ class ContinuousBatchingRunner:
                             nxt = sampling_ops.sample(logits[:, -1],
                                                       sampling_params,
                                                       step_key, odsc)
-                    return (nxt, pos + 1, cache), nxt
+                    nxt = jnp.where(alive, nxt, tok)
+                    pos = pos + alive.astype(pos.dtype)
+                    budget = budget - alive.astype(budget.dtype)
+                    alive = jnp.logical_and(alive, budget > 0)
+                    alive = jnp.logical_and(alive, nxt != eos_ids)
+                    return (nxt, pos, alive, budget, cache), nxt
 
-                (_, _, cache), toks = jax.lax.scan(body, (tok0, positions, cache), keys)
-                return toks.T, cache
+                (tok_l, pos_l, alive_l, budget_l, cache), toks = jax.lax.scan(
+                    body, (tok0, positions, alive0, budget0, cache), keys)
+                return toks.T, (tok_l, pos_l, alive_l, budget_l), cache
 
             def _window(params, input_ids, start, slot, cache, adapter_row,
                         decode_bucket):
@@ -633,7 +686,7 @@ class ContinuousBatchingRunner:
 
             self._insert_step = jax.jit(_insert, donate_argnums=(4,))
             self._decode_step = jax.jit(
-                _decode, donate_argnums=(3,),
+                _decode, donate_argnums=(5,),
                 static_argnames=("decode_bucket", "num_steps", "greedy"))
             self._window_step = jax.jit(_window, donate_argnums=(4,),
                                         static_argnames=("decode_bucket",))
@@ -1006,6 +1059,11 @@ class ContinuousBatchingRunner:
         s["queue_depth"] = len(self.queue)
         s["active_requests"] = sum(r is not None for r in self.active)
         s["num_preemptions"] = self.num_preemptions
+        s["async"] = {
+            "mode": bool(self.async_mode),
+            "depth": self.async_depth,
+            "in_flight": len(self._inflight),
+        }
         if self.paged:
             s["kv_blocks_total"] = self.allocator.num_blocks
             s["kv_blocks_free"] = self.allocator.num_free
@@ -1015,6 +1073,16 @@ class ContinuousBatchingRunner:
                 "acceptance_counts": self.acceptance_counts.tolist(),
                 "accept_mean": metrics_lib.acceptance_mean(
                     self.acceptance_counts),
+                # the adaptive floor guard's CURRENT state: when
+                # fallback_active, spec throughput reads as ~plain-paged
+                # throughput BY DESIGN (chance-level acceptance detected)
+                "adaptive": {
+                    "enabled": self.spec_adaptive,
+                    "fallback_active": self._spec_off,
+                    "plain_chunks_since_probe": self._spec_plain_chunks,
+                    "min_accept": self.spec_min_accept,
+                    "probe_every": self.spec_probe_every,
+                },
             }
         return s
 
@@ -1107,11 +1175,18 @@ class ContinuousBatchingRunner:
     def has_work(self) -> bool:
         return bool(self.queue) or any(r is not None for r in self.active)
 
+    def _pend_steps(self) -> int:
+        """Total decode steps currently in flight (dispatch-ahead pipeline)."""
+        return sum(s for _, s in self._inflight)
+
     def _async_ok(self, extra_steps: int) -> bool:
-        """True when dispatch-ahead is provably exact for the next chunk(s):
-        no queued placements, no row that could stop (eos or max/seq bound)
-        within ``extra_steps``, and (paged) enough free blocks that growth
-        cannot preempt while a chunk is in flight."""
+        """True when dispatch-ahead is exact for the next chunk(s): no queued
+        placements, no mid-insert rows, seq-room for the optimistic uniform
+        advance, and (paged) enough free blocks that growth cannot preempt
+        while chunks are in flight. Rows that may STOP (eos / max-new) no
+        longer veto the pipeline: the decode chunk freezes stopped rows ON
+        DEVICE (the same rules the host replays at commit), so the pipeline
+        stays exact however deep it runs."""
         if not self.async_mode or self.queue:
             return False
         if any(r is not None and r.inserting for r in self.active):
@@ -1120,14 +1195,11 @@ class ContinuousBatchingRunner:
         if not rows:
             return False
         # bound by ACTIVE rows only: finished slots keep their frozen position
-        # (possibly seq_len-1), which must not cap live rows
+        # (possibly seq_len-1), which must not cap live rows. The host
+        # estimate is an upper bound (device-frozen rows stop advancing), so
+        # the seq-room check stays conservative.
         if max(r.position for r in rows) + extra_steps >= self.cfg.seq_len - 1:
             return False
-        for r in rows:
-            if r.eos_token_id is not None:
-                return False
-            if len(r.generated) + extra_steps >= r.max_new_tokens:
-                return False
         if self.paged:
             worst = len(rows) * (-(-extra_steps // self.block_size) + 1)
             if self.allocator.num_free < worst:
@@ -1135,12 +1207,13 @@ class ContinuousBatchingRunner:
         return True
 
     def _drain(self, emitted: Dict[int, List[int]]) -> None:
-        """Sync + commit the in-flight chunk (no-op when nothing is pending)."""
-        if self._pending is None:
-            return
-        toks_dev, steps = self._pending
-        self._pending = None
-        self._commit(np.asarray(toks_dev), steps, emitted)
+        """Sync + commit every in-flight chunk, oldest first (no-op when the
+        pipeline is empty)."""
+        while self._inflight:
+            toks_dev, steps = self._inflight.pop(0)
+            self._commit(np.asarray(toks_dev), steps, emitted)
+        self._dev_state = None
+        self._m_inflight.set(0)
 
     def _commit(self, toks: np.ndarray, steps: int,
                 emitted: Dict[int, List[int]]) -> None:
@@ -1241,11 +1314,12 @@ class ContinuousBatchingRunner:
             self._key, key = jax.random.split(self._key)
         emitted: Dict[int, List[int]] = {}
 
-        # leaving steady state (placements pending, a row near a stop bound, or
-        # async off) drains the pipeline first so the sync path sees exact state
-        if self._pending is not None and (
+        # leaving steady state (placements pending, a row near the seq bound,
+        # block headroom gone, or async off) drains the pipeline first so the
+        # sync path sees exact state
+        if self._inflight and (
                 self.queue or not self._async_ok(
-                    self._pending[1] + 2 * self.decode_chunk)):
+                    self._pend_steps() + 2 * self.decode_chunk)):
             self._drain(emitted)
 
         key = self._place_queued(key, emitted)
@@ -1278,12 +1352,13 @@ class ContinuousBatchingRunner:
             return emitted
 
         # --- one decode chunk for every slot ------------------------------------
-        # while a chunk is in flight, the dispatch state is the committed state
-        # advanced uniformly by its width (_async_ok guarantees no row stops
-        # mid-pipeline, so the advance is exact); its last tokens feed the next
-        # chunk as a DEVICE array — no host sync on the hot path
+        # while chunks are in flight, the dispatch state is the DEVICE carry of
+        # the newest chunk (token / position / alive / budget per row — stops
+        # are tracked in-graph, so the carry is exact even when rows stop
+        # mid-pipeline); the host's uniform-advance estimate is only used for
+        # the conservative seq-room clamp and the slot precompute
         chunk = self.decode_chunk
-        pend_steps = self._pending[1] if self._pending is not None else 0
+        pend_steps = self._pend_steps()
         positions = self.positions + pend_steps
         # room is bounded by the LIVE rows; finished slots keep a frozen
         # position (possibly seq_len-1) that must not truncate active requests;
@@ -1305,8 +1380,21 @@ class ContinuousBatchingRunner:
         sp = self._sampling_matrix()
         greedy = self._chunk_greedy(live)
         adapters = jnp.asarray(self.adapter_ids)
-        tok0 = (self._pending[0][:, -1] if self._pending is not None
-                else jnp.asarray(self.last_tok))
+        if self._dev_state is not None:
+            tok0, pos_dev, alive_dev, budget_dev = self._dev_state
+        else:
+            tok0 = jnp.asarray(self.last_tok)
+            pos_dev = jnp.asarray(self.positions)
+            alive_dev = jnp.asarray(
+                np.array([r is not None and not r.done and not r.inserting
+                          for r in self.active]))
+            budget_dev = jnp.asarray(
+                np.array([(r.max_new_tokens - len(r.generated))
+                          if (r is not None and not r.done and not r.inserting)
+                          else 0 for r in self.active], dtype=np.int32))
+        eos_ids = jnp.asarray(np.array(
+            [(-1 if r is None or r.eos_token_id is None else r.eos_token_id)
+             for r in self.active], dtype=np.int32))
         t_dispatch = time.perf_counter() if self._async_auto else None
         if self.paged:
             active_rows = self._grow_blocks(active_rows, pend_steps + steps)
@@ -1318,26 +1406,31 @@ class ContinuousBatchingRunner:
             slot_chunk = self._slot_mapping_fn(
                 self.block_table, positions, steps, self.block_size, valid=valid)
             with tel.annotate("decode"):
-                toks_dev, self.cache = self._decode_step(
-                    self.app.params, tok0,
-                    jnp.asarray(positions), self.cache,
+                toks_dev, dev_state, self.cache = self._decode_step(
+                    self.app.params, tok0, pos_dev, alive_dev, budget_dev,
+                    self.cache,
                     jnp.asarray(self.block_table), jnp.asarray(slot_chunk),
-                    sp, sub, adapters, num_steps=steps, greedy=greedy)
+                    sp, sub, adapters, eos_ids, num_steps=steps, greedy=greedy)
         else:
             bucket = autobucketing.select_bucket(self.app.tkg_buckets,
                                                  max_pos + steps)
             with tel.annotate("decode"):
-                toks_dev, self.cache = self._decode_step(
-                    self.app.params, tok0,
-                    jnp.asarray(positions), self.cache, sp, sub, adapters,
+                toks_dev, dev_state, self.cache = self._decode_step(
+                    self.app.params, tok0, pos_dev, alive_dev, budget_dev,
+                    self.cache, sp, sub, adapters, eos_ids,
                     decode_bucket=bucket, num_steps=steps, greedy=greedy)
 
         if self._async_ok(pend_steps + steps + chunk):
-            prior, self._pending = self._pending, (toks_dev, steps)
-            if prior is not None:
-                self._commit(np.asarray(prior[0]), prior[1], emitted)
+            # steady state: append the new chunk, keep at most async_depth in
+            # flight — committing the oldest overlaps the newer dispatches
+            self._inflight.append((toks_dev, steps))
+            self._dev_state = dev_state
+            while len(self._inflight) > self.async_depth:
+                toks, st = self._inflight.pop(0)
+                self._commit(np.asarray(toks), st, emitted)
+            self._m_inflight.set(len(self._inflight))
         else:
-            self._drain(emitted)                       # older chunk commits first
+            self._drain(emitted)                       # older chunks commit first
             self._commit(np.asarray(toks_dev), steps, emitted)
             if t_dispatch is not None:
                 self._note_chunk_time(time.perf_counter() - t_dispatch, steps)
@@ -1346,6 +1439,7 @@ class ContinuousBatchingRunner:
                 t_step, "decode", iterations=steps,
                 tokens=_emitted_count(emitted) - n_emit0,
                 occupancy=len(live), slots=self.num_slots,
+                in_flight=len(self._inflight),
                 kv_free=self.allocator.num_free if self.paged else None,
                 kv_total=self.allocator.num_blocks if self.paged else None)
         return emitted
@@ -1397,7 +1491,7 @@ class ContinuousBatchingRunner:
         inserting = [r for r in active_rows if r.inserting]
         if not inserting:
             # pure-decode steady state: fall through BEFORE draining so async
-            # dispatch-ahead keeps overlapping (_step_plain owns _pending)
+            # dispatch-ahead keeps overlapping (_step_plain owns the pipeline)
             return self._step_plain(key, emitted)
         tel = self.telemetry
         t_step = tel.step_start()
@@ -1535,6 +1629,7 @@ class ContinuousBatchingRunner:
                 return self._step_plain(key, emitted)
             self._spec_plain_chunks = 0
             self._spec_off = False         # re-probe with one spec chunk
+            self._m_spec_guard.set(0)
         max_pos = max(r.position for r in live)
         # every fused iteration needs a full K-token cache window
         room = (self.cfg.seq_len - 1 - max_pos) // self.k
@@ -1623,6 +1718,7 @@ class ContinuousBatchingRunner:
         if (self.spec_adaptive and chunk_cells
                 and chunk_added / chunk_cells < self.spec_min_accept):
             self._spec_off = True
+            self._m_spec_guard.set(1)
             logger.info(
                 "adaptive speculation: %.2f committed tokens/row/iteration "
                 "< %.2f — serving plain decode chunks (spec re-probe every "

@@ -20,7 +20,12 @@ TPU redesign:
   acceptance of ``n`` tokens the next step starts at ``pos + n + 1`` and its writes cover
   the entire stale region before any read (decode masks are position-bounded), so
   rejected-token cache entries never need rollback — same trick as the reference's
-  position-masked cache reads.
+  position-masked cache reads. The PAGED serving variant (the CB spec chunk,
+  `runtime/continuous_batching.py`) rides the FUSED append+attend kernel for both the
+  draft chain (q_len 1) and the wide verify (q_len K <= 8): the fresh window attends
+  from VMEM operands and committed blocks mask ``kv_pos < pos``, so the stale region is
+  never even read — the position-masking discipline moves into the kernel
+  (ops/paged_decode.fused_paged_decode_stacked).
 
 Per step, the target emits between 1 and ``speculation_length`` committed tokens:
 ``n`` accepted drafts plus one correction/bonus token.

@@ -457,7 +457,8 @@ class ServingTelemetry:
                     prefill_tokens: int = 0, prefill_budget: int = 0,
                     kv_free: Optional[int] = None, kv_total: Optional[int] = None,
                     accept_mean: Optional[float] = None,
-                    request_id: Optional[int] = None) -> None:
+                    request_id: Optional[int] = None,
+                    in_flight: Optional[int] = None) -> None:
         """Record one dispatch of the serving loop (kinds: ``decode``,
         ``spec_chunk``, ``mixed``, ``insert_window``, ``insert``). Durations
         are host spans over dispatch + host commit; device overlap shows up
@@ -479,6 +480,12 @@ class ServingTelemetry:
             rec["accept_mean"] = round(accept_mean, 4)
         if request_id is not None:
             rec["request_id"] = request_id
+        if in_flight is not None:
+            # dispatch-ahead pipeline occupancy at record time (the step
+            # timeline's view of the depth-N pipeline; the registry gauges
+            # serving_dispatch_depth / serving_inflight_chunks carry the
+            # scrape-time values)
+            rec["in_flight"] = in_flight
         c = self._c_steps.get(kind)
         if c is None:
             c = self.registry.counter("serving_steps_total",

@@ -216,9 +216,12 @@ def test_async_dispatch_ahead_matches_sync(tiny_llama_hf_config, prompts):
     assert got == want
 
 
-def test_async_dispatch_ahead_with_eos_falls_back(tiny_llama_hf_config, prompts):
-    """Rows carrying an eos stop keep exact sync semantics (the safety gate
-    refuses to pipeline them)."""
+def test_async_dispatch_ahead_with_eos_matches_sync(tiny_llama_hf_config,
+                                                    prompts):
+    """Rows carrying an eos stop PIPELINE now (they used to veto dispatch-ahead
+    entirely): the decode chunk tracks stops ON DEVICE — a row that emits its
+    eos freezes in-graph with the exact rules the host replays at commit — so
+    emitted tokens must still match the sync path bit-for-bit."""
     ref_app = _make_app(tiny_llama_hf_config, paged=True, slots=2)
     ref = ContinuousBatchingRunner(ref_app, decode_chunk=4)
     for p in prompts:
@@ -231,6 +234,72 @@ def test_async_dispatch_ahead_with_eos_falls_back(tiny_llama_hf_config, prompts)
         runner.submit(p, max_new_tokens=16, eos_token_id=7)
     got = runner.run_to_completion(seed=0)
     assert got == want
+
+
+@pytest.mark.parametrize("paged", [True, False])
+def test_async_depth2_matches_sync_and_pipelines(tiny_llama_hf_config, prompts,
+                                                 paged):
+    """Depth-2 dispatch-ahead (the default; ≈ the reference's 2-deep async
+    decode): tokens must be EXACT vs sync on the same trace, the pipeline must
+    actually reach 2 chunks in flight, and runner.stats() must surface the
+    depth/in-flight gauges."""
+    ref_app = _make_app(tiny_llama_hf_config, paged=paged, slots=2)
+    ref = ContinuousBatchingRunner(ref_app, decode_chunk=4)
+    for p in prompts:
+        ref.submit(p, max_new_tokens=24, eos_token_id=7)
+    want = ref.run_to_completion(seed=0)
+
+    app = _make_app(tiny_llama_hf_config, paged=paged, slots=2)
+    runner = ContinuousBatchingRunner(app, decode_chunk=4, async_mode=True,
+                                      async_depth=2)
+    assert runner.async_depth == 2
+    for p in prompts:
+        runner.submit(p, max_new_tokens=24, eos_token_id=7)
+    import jax as _jax
+
+    runner._key = _jax.random.PRNGKey(0)
+    max_inflight = 0
+    guard = 0
+    while runner.has_work and guard < 200:
+        runner.step()
+        max_inflight = max(max_inflight, len(runner._inflight))
+        guard += 1
+    got = {rid: req.generated for rid, req in runner.finished.items()}
+    assert got == want
+    assert max_inflight == 2
+    s = runner.stats()
+    assert s["async"]["depth"] == 2
+    assert s["async"]["mode"] is True
+    reg = runner.telemetry.registry
+    assert reg.gauge("serving_dispatch_depth").value == 2
+
+
+def test_async_depth1_keeps_old_single_chunk_lag(tiny_llama_hf_config, prompts):
+    """async_depth=1 reproduces the pre-depth-N behavior (at most one chunk in
+    flight) and stays exact."""
+    ref_app = _make_app(tiny_llama_hf_config, paged=True, slots=2)
+    ref = ContinuousBatchingRunner(ref_app, decode_chunk=4)
+    for p in prompts:
+        ref.submit(p, max_new_tokens=24)
+    want = ref.run_to_completion(seed=0)
+
+    app = _make_app(tiny_llama_hf_config, paged=True, slots=2)
+    runner = ContinuousBatchingRunner(app, decode_chunk=4, async_mode=True,
+                                      async_depth=1)
+    for p in prompts:
+        runner.submit(p, max_new_tokens=24)
+    import jax as _jax
+
+    runner._key = _jax.random.PRNGKey(0)
+    max_inflight = 0
+    guard = 0
+    while runner.has_work and guard < 200:
+        runner.step()
+        max_inflight = max(max_inflight, len(runner._inflight))
+        guard += 1
+    got = {rid: req.generated for rid, req in runner.finished.items()}
+    assert got == want
+    assert max_inflight == 1
 
 
 def test_async_dispatch_ahead_dense_matches_sync(tiny_llama_hf_config, prompts):

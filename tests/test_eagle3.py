@@ -52,18 +52,39 @@ def test_random_draft_matches_plain_greedy(tiny_llama_hf_config):
     assert out.acceptance_counts.sum() >= out.steps
 
 
-def test_acceptance_gain_over_eagle1(tiny_llama_hf_config):
-    """Drive the target into a repetitive greedy regime; an EAGLE3 hidden-readout
-    draft then accepts deep tree paths while a random EAGLE-v1 chain stays ~1."""
+def _collapse_target_to_constant(target):
+    """Drive the target's greedy decode into a CONSTANT regime (token 7
+    forever once first emitted).
+
+    Two edits are needed, not one. Biasing the lm_head column alone
+    (``lm[:, 7] = C * ones``) gives ``logits_7 = C * sum(hn)``, whose SIGN
+    flips with the hidden — the regime it produces is a period-2 oscillation
+    (7, x, 7, x, ...), not a collapse. The readout draft these tests wire is
+    one step LAGGED: under the EAGLE conditioning convention the draft input
+    pairs token t_i with feature f_{i-1}, and the zeroed midlayer passes the
+    feature through unchanged, so its readout predicts t_i — which only
+    equals the target's next token t_{i+1} in a CONSTANT regime. Pinning
+    embed(7) to a positive constant keeps sum(hidden) > 0 after every token-7
+    step (the residual stream dominates the small random layer outputs), so
+    the first 7 locks the collapse."""
     import jax.numpy as jnp
 
-    target = _make_app(tiny_llama_hf_config)
-    # bias the lm_head so greedy decode collapses to token 7 after a few steps
     params = dict(target.params)
     lm = np.array(params["lm_head"], dtype=np.float32)
     lm[:, 7] = np.abs(lm).max() * 3.0
     params["lm_head"] = jnp.asarray(lm)
+    emb = np.array(params["embed"], dtype=np.float32)
+    emb[7] = 0.5
+    params["embed"] = jnp.asarray(emb)
     target.params = params
+    return params
+
+
+def test_acceptance_gain_over_eagle1(tiny_llama_hf_config):
+    """Drive the target into a repetitive greedy regime; an EAGLE3 hidden-readout
+    draft then accepts deep tree paths while a random EAGLE-v1 chain stays ~1."""
+    target = _make_app(tiny_llama_hf_config)
+    params = _collapse_target_to_constant(target)
 
     rng = np.random.default_rng(2)
     input_ids = rng.integers(1, 256, size=(2, 10)).astype(np.int32)
@@ -111,14 +132,8 @@ def test_deepest_accepted_node_draft_kv_written(tiny_llama_hf_config):
     written before compaction. If not, a fully-accepted path (n == depth) copies
     an unwritten slot into committed context and later draft steps attend to
     zero KV — output stays exact but acceptance silently degrades."""
-    import jax.numpy as jnp
-
     target = _make_app(tiny_llama_hf_config)
-    params = dict(target.params)
-    lm = np.array(params["lm_head"], dtype=np.float32)
-    lm[:, 7] = np.abs(lm).max() * 3.0           # greedy collapses to token 7
-    params["lm_head"] = jnp.asarray(lm)
-    target.params = params
+    params = _collapse_target_to_constant(target)
 
     d_args = draft_args_from_target(target.arch_args, num_layers=1)
     e3 = Eagle3SpeculativeModel(target, d_args, depth=2, beam=2, branch=2,

@@ -48,3 +48,48 @@ def test_no_committed_log_or_trace_spool_files():
         if f.endswith((".log", ".jsonl.spool")) or f == "nohup.out":
             bad.append(os.path.relpath(os.path.join(root, f), PKG))
     assert not bad, f"committed log/debug files inside the package: {bad}"
+
+
+def test_no_bytecode_or_pycache_ever_tracked():
+    """``__pycache__``/``*.pyc`` must never become tracked: they churn every
+    run, leak interpreter paths, and silently bloat diffs. Guarded at the git
+    index level (an untracked __pycache__ on disk is fine — .gitignore's job),
+    so a stray ``git add -A`` cannot land bytecode."""
+    import subprocess
+
+    repo = os.path.dirname(PKG)
+    files = subprocess.run(
+        ["git", "ls-files"], cwd=repo, capture_output=True, text=True,
+        check=True).stdout.splitlines()
+    bad = [f for f in files
+           if "__pycache__" in f or f.endswith((".pyc", ".pyo"))]
+    assert not bad, f"bytecode tracked in git: {bad}"
+    gitignore = os.path.join(repo, ".gitignore")
+    with open(gitignore) as fh:
+        patterns = fh.read()
+    assert "__pycache__" in patterns and "*.py" in patterns, (
+        ".gitignore must keep __pycache__/*.pyc ignored")
+
+
+def test_ops_kernels_carry_reference_mapping_header():
+    """Every kernel module under ops/ documents WHERE it sits relative to the
+    reference implementation: the module docstring carries the ``≈`` mapping
+    marker (e.g. "≈ reference paged decode: ...") or explicitly declares the
+    capability beyond reference parity. New kernels must keep the convention —
+    it is how a reader navigates from TPU kernel to the NxDI code it
+    reproduces."""
+    import ast
+
+    ops_dir = os.path.join(PKG, "ops")
+    missing = []
+    for f in sorted(os.listdir(ops_dir)):
+        if not f.endswith(".py") or f == "__init__.py":
+            continue
+        path = os.path.join(ops_dir, f)
+        with open(path) as fh:
+            doc = ast.get_docstring(ast.parse(fh.read())) or ""
+        if "≈" not in doc and "beyond reference parity" not in doc:
+            missing.append(f)
+    assert not missing, (
+        "ops/ modules missing the reference-mapping docstring header "
+        f"(‘≈ reference ...’ or an explicit beyond-parity note): {missing}")

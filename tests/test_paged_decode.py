@@ -552,3 +552,151 @@ def test_write_paged_multi_token_commit(case):
     np.testing.assert_array_equal(np.asarray(out_v)[1], ref_v)
     np.testing.assert_array_equal(np.asarray(out_k)[0], k_cache[0])
     np.testing.assert_array_equal(np.asarray(out_k)[2], k_cache[2])
+
+
+# --- fused KV-append + attend (the single-dispatch decode hot path) -------------------
+
+
+def _fused_case(t, dtype, seed=0, positions=None, dead_rows=(1,), window=None,
+                soft_cap=None, sinks=False, alibi=False):
+    """Build one fused-vs-separate comparison case; returns (separate attend,
+    fused attend, caches-equal, live row mask)."""
+    from neuronx_distributed_inference_tpu.ops.paged_decode import (
+        fused_paged_decode_stacked)
+
+    rng = np.random.default_rng(seed)
+    L, NB, Hkv, BS, D = 2, 26, 2, 32, 64
+    B, Hq, MB = 4, 4, 6
+    def draw(shape):
+        x = jnp.asarray(rng.normal(size=shape), jnp.float32)
+        if dtype == jnp.int8:
+            return jnp.asarray(rng.integers(-100, 100, size=shape), jnp.int8)
+        return x.astype(jnp.bfloat16).astype(dtype)
+    k_cache, v_cache = draw((L, NB, Hkv, BS, D)), draw((L, NB, Hkv, BS, D))
+    new_k, new_v = draw((B, Hkv, t, D)), draw((B, Hkv, t, D))
+    q = jnp.asarray(rng.normal(size=(B, Hq, t, D)), jnp.float32).astype(
+        jnp.bfloat16)
+    block_table = jnp.asarray(
+        rng.permutation(NB)[: B * MB].reshape(B, MB), jnp.int32)
+    if positions is None:
+        positions = np.array([0, 5, 40, 100], np.int32)
+    slots = np.zeros((B, t), np.int32)
+    for b in range(B):
+        for j in range(t):
+            p = positions[b] + j
+            slots[b, j] = int(block_table[b, p // BS]) * BS + p % BS
+    for r in dead_rows:
+        slots[r, :] = -1            # dead serving slot: write dropped
+    pos = jnp.asarray(positions)
+    sm = jnp.asarray(slots)
+    lidx = jnp.asarray(1, jnp.int32)
+    sk = (jnp.asarray(rng.normal(size=(Hq,)), jnp.float32) if sinks else None)
+    sl = (jnp.abs(jnp.asarray(rng.normal(size=(Hq,)), jnp.float32))
+          if alibi else None)
+    kw = dict(window=window, soft_cap=soft_cap, sinks=sk, alibi_slopes=sl,
+              interpret=True)
+
+    kc1, vc1 = write_paged_stacked_kv(k_cache, v_cache, new_k, new_v, sm,
+                                      lidx, interpret=True)
+    out_sep = paged_decode_attention_stacked(q, kc1, vc1, pos, lidx,
+                                             block_table, **kw)
+    out_fused, kc2, vc2 = fused_paged_decode_stacked(
+        q, new_k, new_v, k_cache, v_cache, pos, sm, lidx, block_table, **kw)
+    caches_equal = bool(jnp.array_equal(kc1, kc2)
+                        and jnp.array_equal(vc1, vc2))
+    live = np.array([r not in dead_rows for r in range(B)])
+    return (np.asarray(out_sep, np.float32), np.asarray(out_fused, np.float32),
+            caches_equal, live)
+
+
+@pytest.mark.parametrize("t", [1, 4, 8])
+@pytest.mark.parametrize("dtype", ["bfloat16", "int8", "float8_e4m3fn"])
+def test_fused_append_attend_matches_separate(t, dtype):
+    """EXACTNESS parity of the fused append+attend vs separate
+    write-then-attend, across KV dtypes and q_len 1/4/8: the CACHES must be
+    bit-identical (same RMW windows), and LIVE rows' attend outputs must agree
+    to flash-accumulation-order tolerance (the fused kernel attends the fresh
+    tokens from VMEM operands and streams committed blocks one at a time, so
+    the m/l update order — and, for int8, the in-kernel p-quantization points
+    — differ from the separate kernel's cell grouping; the math is the same
+    softmax). Dead (-1) rows are contract-exempt: the separate path attends
+    stale cache bytes at their fresh positions, the fused path masks them —
+    both outputs are discarded by the host."""
+    dt = jnp.dtype(dtype)
+    out_sep, out_fused, caches_equal, live = _fused_case(t, dt)
+    assert caches_equal
+    # int8: the in-kernel p-quantization (1/127 steps, scaled by |V|) lands at
+    # different flash-update points under the two block groupings — bound the
+    # divergence at 1% of the output scale; floats get a fixed few-ulp bound
+    tol = (0.01 * np.abs(out_sep[live]).max() if dtype == "int8" else 0.02)
+    np.testing.assert_allclose(out_fused[live], out_sep[live], atol=tol)
+
+
+def test_fused_append_attend_block_straddling_append():
+    """A t>1 append whose slots straddle a pack-window/block boundary takes
+    the per-token RMW fallback inside the fused kernel — caches must still be
+    bit-identical with the separate write."""
+    # positions chosen so rows straddle the fp32 pack window (8) and the
+    # BS=32 block boundary mid-append
+    for positions in (np.array([30, 31, 33, 62], np.int32),
+                      np.array([6, 29, 61, 93], np.int32)):
+        out_sep, out_fused, caches_equal, live = _fused_case(
+            4, jnp.bfloat16, positions=positions)
+        assert caches_equal
+        np.testing.assert_allclose(out_fused[live], out_sep[live], atol=0.02)
+
+
+def test_fused_append_attend_sliding_window_sinks_softcap_alibi():
+    """Head extras ride the fused kernel identically to the separate attend."""
+    for kw in (dict(window=48), dict(soft_cap=30.0, sinks=True),
+               dict(alibi=True)):
+        out_sep, out_fused, caches_equal, live = _fused_case(
+            4, jnp.bfloat16, **kw)
+        assert caches_equal
+        np.testing.assert_allclose(out_fused[live], out_sep[live], atol=0.02)
+
+
+def test_decode_forward_fused_matches_separate_path(tiny_llama_hf_config):
+    """Model-level: decode_forward with the fused kernel (default) vs the
+    separate write+attend kernels (TPUINF_PAGED_FUSED=0 routing, exercised
+    here by comparing against the gather path) must produce matching logits
+    and caches through the full layer scan."""
+    from neuronx_distributed_inference_tpu.config import (
+        TpuConfig, load_pretrained_config)
+    from neuronx_distributed_inference_tpu.models import base as model_base
+    from neuronx_distributed_inference_tpu.models.llama.modeling_llama import (
+        LlamaForCausalLM, LlamaInferenceConfig)
+
+    cfg = TpuConfig(batch_size=2, seq_len=256, max_context_length=64,
+                    dtype="float32", context_encoding_buckets=[64],
+                    token_generation_buckets=[128],
+                    is_continuous_batching=True, paged_attention_enabled=True,
+                    pa_num_blocks=20, pa_block_size=16)
+    config = LlamaInferenceConfig(cfg,
+                                  load_config=load_pretrained_config(
+                                      tiny_llama_hf_config))
+    app = LlamaForCausalLM(None, config)
+    app.load_random(seed=0)
+    cache = app.make_paged_cache(cfg.pa_num_blocks, cfg.pa_block_size)
+    B, T = 2, 4
+    rng = np.random.default_rng(3)
+    ids = rng.integers(1, 250, size=(B, T)).astype(np.int32)
+    positions = np.array([10, 37], np.int32)
+    block_table = np.arange(20).reshape(2, 10).astype(np.int32)
+    slot_map = block_kvcache.make_slot_mapping(block_table, positions, T, 16)
+
+    outs = {}
+    for use_kernel in (True, False):            # True rides the FUSED path now
+        logits, out_cache = model_base.decode_forward(
+            app.params, app.arch_args, jnp.asarray(ids), jnp.asarray(positions),
+            {k: v.copy() for k, v in cache.items()}, None,
+            mesh=app.mesh, rules=app.sharding_rules,
+            block_table=jnp.asarray(block_table),
+            slot_mapping=jnp.asarray(slot_map), use_kernel=use_kernel)
+        outs[use_kernel] = (np.asarray(logits), np.asarray(out_cache["k"]),
+                            np.asarray(out_cache["v"]))
+
+    np.testing.assert_allclose(outs[True][0], outs[False][0], atol=2e-4,
+                               rtol=1e-4)
+    np.testing.assert_allclose(outs[True][1], outs[False][1], atol=1e-5)
+    np.testing.assert_allclose(outs[True][2], outs[False][2], atol=1e-5)

@@ -78,14 +78,33 @@ def test_quantized_llama_generates_close_logits(tiny_llama_hf_config, weight_dty
 
 
 def test_fp8_kv_cache_generates_close_logits(tiny_llama_hf_config):
+    """fp8-KV logits must stay close to the bf16-KV reference — but only over
+    steps computed under the SAME context. With a random tiny model the greedy
+    logits are near-flat, so fp8 quantization noise legitimately flips an
+    argmax within a few steps; from that point the two runs feed different
+    tokens and their logits are incomparable (the old last-step comparison
+    measured trajectory divergence, not numerics: cosine was 0.9999 at every
+    step while the generated prefixes still agreed)."""
     rng = np.random.default_rng(4)
     ids = rng.integers(1, 256, size=(2, 12)).astype(np.int32)
     ref = _app(tiny_llama_hf_config).generate(ids, max_new_tokens=6, return_logits=True)
     fp8 = _app(tiny_llama_hf_config, kv_dtype="float8_e4m3")
     out = fp8.generate(ids, max_new_tokens=6, return_logits=True)
     assert fp8.kv_cache["k"].dtype == jnp.float8_e4m3fn
-    # decode logits flow through fp8-quantized KV reads
-    assert _cosine(out.logits[-1], ref.logits[-1]) > 0.98
+    # decode logits flow through fp8-quantized KV reads: compare step i only
+    # while the generated prefixes (the context those logits were computed
+    # under) still agree across ALL rows
+    ref_toks = np.asarray(ref.tokens)
+    fp8_toks = np.asarray(out.tokens)
+    comparable = 0
+    for i in range(len(ref.logits)):
+        if i > 0 and not (ref_toks[:, :i] == fp8_toks[:, :i]).all():
+            break
+        assert _cosine(out.logits[i], ref.logits[i]) > 0.98, i
+        comparable = i + 1
+    # the comparison must actually exercise fp8 decode reads (prefill logits
+    # alone would vacuously pass): require at least two decode steps
+    assert comparable >= 3, (comparable, ref_toks, fp8_toks)
 
 
 def test_quantized_moe_runs(tiny_llama_hf_config):

@@ -331,3 +331,10 @@ def test_cb_spec_adaptive_floor_stays_exact(tiny_llama_hf_config, prompts,
     for i, rid in enumerate(ids):
         assert results[rid] == reference_tokens[i], f"request {i} diverged"
     assert runner._spec_off, "the adaptive guard never engaged"
+    # the guard's state is a first-class serving surface now: stats() and the
+    # registry gauge expose it (the bench asserts the fallback through this)
+    ad = runner.stats()["spec"]["adaptive"]
+    assert ad["enabled"] and ad["fallback_active"]
+    assert ad["min_accept"] == 10.0
+    assert runner.telemetry.registry.gauge(
+        "serving_spec_adaptive_fallback").value == 1
