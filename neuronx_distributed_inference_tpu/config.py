@@ -269,8 +269,16 @@ class TpuConfig:
         for deg_name in ("tp_degree", "dp_degree", "cp_degree", "ep_degree", "pp_degree"):
             if getattr(self, deg_name) < 1:
                 raise ValueError(f"{deg_name} must be >= 1")
-        if self.sequence_parallel_enabled and self.seq_len % self.tp_degree != 0:
-            raise ValueError("sequence parallelism requires seq_len % tp_degree == 0")
+        if self.sequence_parallel_enabled and \
+                self.seq_len % (self.cp_degree * self.tp_degree) != 0:
+            # residuals shard their sequence dim over BOTH model axes (the
+            # act_seq rule maps to (cp, tp), parallel/sharding.py), so the
+            # divisibility requirement is the product, not tp alone
+            raise ValueError(
+                f"sequence_parallel_enabled requires seq_len divisible by "
+                f"cp_degree * tp_degree (seq_len={self.seq_len}, "
+                f"cp_degree={self.cp_degree}, tp_degree={self.tp_degree}, "
+                f"cp*tp={self.cp_degree * self.tp_degree})")
         if self.dp_degree > 1 and not self.is_continuous_batching:
             raise ValueError("attention data parallelism requires continuous batching")
         if self.attention_dp_enabled and \

@@ -34,6 +34,7 @@ from ..ops.attention import attend, causal_mask
 from ..ops.moe import MoEArgs, moe_block
 from ..ops.norms import layer_norm, rms_norm
 from ..ops.quantization import qapply
+from ..parallel import overlap as overlap_lib
 from ..parallel.sharding import constrain
 
 Params = Dict[str, Any]
@@ -443,13 +444,26 @@ def _alibi_bias(slopes: jnp.ndarray, q_pos: jnp.ndarray, kv_pos: jnp.ndarray
 
 
 def _project_qkv(lp: Params, args: ModelArchArgs, hn: jnp.ndarray,
-                 adapter_ids=None):
-    """(B, S, H) -> q (B, nq, S, D), k/v (B, nkv, S, D)."""
+                 adapter_ids=None, mesh=None, rules=None, ov=None):
+    """(B, S, H) -> q (B, nq, S, D), k/v (B, nkv, S, D).
+
+    ``ov`` ("seq"/"hidden", see parallel/overlap.layer_phase) routes the three
+    projections through ONE fused collective matmul: the all-gather half of
+    the sharded-residual collective rotates activation shards in behind the
+    MXU instead of blocking in front of it."""
     b, s, _ = hn.shape
     aq = args.activation_quant
-    q = qapply(hn, lp["wq"], act_quant=aq)
-    k = qapply(hn, lp["wk"], act_quant=aq)
-    v = qapply(hn, lp["wv"], act_quant=aq)
+    qkv = None
+    if ov is not None:
+        qkv = overlap_lib.column_projection(
+            hn, [lp["wq"], lp["wk"], lp["wv"]], mesh, rules, ov,
+            ("heads", "kv_heads", "kv_heads"))
+    if qkv is not None:
+        q, k, v = qkv
+    else:
+        q = qapply(hn, lp["wq"], act_quant=aq)
+        k = qapply(hn, lp["wk"], act_quant=aq)
+        v = qapply(hn, lp["wv"], act_quant=aq)
     if args.lora is not None:
         sc = args.lora.scaling
         q = apply_lora(lp, "wq", hn, q, adapter_ids, sc)
@@ -478,6 +492,26 @@ def _project_qkv(lp: Params, args: ModelArchArgs, hn: jnp.ndarray,
     return q, k, v
 
 
+def _o_proj(lp: Params, args: ModelArchArgs, attn: jnp.ndarray, mesh, rules,
+            ov, adapter_ids, resid_logical) -> jnp.ndarray:
+    """Attention output projection, landing in the residual layout.
+
+    ``ov`` routes through the matmul->reduce-scatter collective matmul
+    (parallel/overlap.py): partial sums rotate-accumulate around the tp ring
+    and the output arrives already sharded like the residual stream. The
+    fallback is qapply + a GSPMD constraint (which turns the all-reduce into
+    reduce-scatter when the residual rules are sharded)."""
+    out = (overlap_lib.row_projection(attn, lp["wo"], mesh, rules, ov, "heads")
+           if ov is not None else None)
+    if out is None:
+        out = qapply(attn, lp["wo"])
+    if args.lora is not None:
+        out = apply_lora(lp, "wo", attn, out, adapter_ids, args.lora.scaling)
+    if args.o_bias:
+        out = out + lp["bo"]
+    return constrain(out, resid_logical, rules, mesh=mesh)
+
+
 def _head_qk_norm(lp: Params, args: ModelArchArgs, q, k):
     if args.qk_norm_type == "layer":
         q = layer_norm(q, lp["q_norm"], lp["q_norm_b"], eps=args.rms_norm_eps)
@@ -490,12 +524,15 @@ def _head_qk_norm(lp: Params, args: ModelArchArgs, q, k):
 
 
 def _mlp(lp: Params, args: ModelArchArgs, hn: jnp.ndarray, mesh, rules,
-         adapter_ids=None) -> jnp.ndarray:
+         adapter_ids=None, ov=None) -> jnp.ndarray:
     act = (_ACTIVATIONS[args.activation] if args.activation != "xielu"
            else None)
     if args.mlp_kind == "plain":
         # fc -> act -> fc (GPT-style, optionally biased)
-        inter = qapply(hn, lp["wg"])
+        cols = (overlap_lib.column_projection(hn, [lp["wg"]], mesh, rules, ov,
+                                              ("mlp",))
+                if ov is not None else None)
+        inter = cols[0] if cols is not None else qapply(hn, lp["wg"])
         if args.mlp_bias:
             inter = inter + lp["bg"]
         if args.activation == "xielu":
@@ -503,13 +540,23 @@ def _mlp(lp: Params, args: ModelArchArgs, hn: jnp.ndarray, mesh, rules,
         else:
             inter = act(inter)
         inter = constrain(inter, ("batch", None, "mlp"), rules, mesh=mesh)
-        down = qapply(inter, lp["wd"])
+        down = (overlap_lib.row_projection(inter, lp["wd"], mesh, rules, ov,
+                                           "mlp")
+                if ov is not None else None)
+        if down is None:
+            down = qapply(inter, lp["wd"])
         if args.mlp_bias:
             down = down + lp["bd"]
         return down
     aq = args.activation_quant
-    gate = qapply(hn, lp["wg"], act_quant=aq)
-    up = qapply(hn, lp["wu"], act_quant=aq)
+    cols = (overlap_lib.column_projection(hn, [lp["wg"], lp["wu"]], mesh,
+                                          rules, ov, ("mlp", "mlp"))
+            if ov is not None else None)
+    if cols is not None:
+        gate, up = cols
+    else:
+        gate = qapply(hn, lp["wg"], act_quant=aq)
+        up = qapply(hn, lp["wu"], act_quant=aq)
     if args.lora is not None:
         sc = args.lora.scaling
         gate = apply_lora(lp, "wg", hn, gate, adapter_ids, sc)
@@ -519,7 +566,10 @@ def _mlp(lp: Params, args: ModelArchArgs, hn: jnp.ndarray, mesh, rules,
         up = up + lp["bu"]
     gate = act(gate)
     inter = constrain(gate * up, ("batch", None, "mlp"), rules, mesh=mesh)
-    down = qapply(inter, lp["wd"], act_quant=aq)
+    down = (overlap_lib.row_projection(inter, lp["wd"], mesh, rules, ov, "mlp")
+            if ov is not None else None)
+    if down is None:
+        down = qapply(inter, lp["wd"], act_quant=aq)
     if args.lora is not None:
         down = apply_lora(lp, "wd", inter, down, adapter_ids, args.lora.scaling)
     if args.mlp_bias:
@@ -927,9 +977,19 @@ def _decoder_layer(
     kv_scales: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
 ):
     rm = args.residual_multiplier          # granite branch scaling (1.0 = no-op)
+    # sharded-residual layout (sequence parallelism): prefill residuals shard
+    # over seq (act_seq: (cp, tp)); decode residuals (T≈1) shard over hidden
+    # (act_embed: tp). Both rules default to None, making this the exact
+    # replicated layout of before. ``ov`` additionally routes the dense
+    # projections through the overlap-scheduled collective matmuls.
+    resid_logical = (("batch", "act_seq", None) if positions is None
+                     else ("batch", None, "act_embed"))
+    ov = overlap_lib.layer_phase(args, mesh, rules,
+                                 decode=positions is not None)
     resid = h
     hn = (_norm(h, lp["ln1"], args, lp.get("ln1_b")) if args.pre_norms else h)
-    q, k, v = _project_qkv(lp, args, hn, adapter_ids)
+    q, k, v = _project_qkv(lp, args, hn, adapter_ids, mesh=mesh, rules=rules,
+                           ov=ov)
     if positions is None:
         # prefill activations shard along seq over cp (sequence/context parallelism,
         # ≈ SP reduce-scatter + CP seq shards, `model_base.py:1509-1560`); no-op at cp=1
@@ -1033,20 +1093,15 @@ def _decoder_layer(
         if _sv_unfold is not None:
             attn = attn * _sv_unfold.astype(attn.dtype)
         attn = attn.transpose(0, 2, 1, 3).reshape(h.shape[0], h.shape[1], args.q_size)
-        attn_out = qapply(attn, lp["wo"])
-        if args.lora is not None:
-            attn_out = apply_lora(lp, "wo", attn, attn_out, adapter_ids,
-                                  args.lora.scaling)
-        if args.o_bias:
-            attn_out = attn_out + lp["bo"]
-        attn_out = constrain(attn_out, ("batch", None, None), rules, mesh=mesh)
+        attn_out = _o_proj(lp, args, attn, mesh, rules, ov, adapter_ids,
+                           resid_logical)
         if args.sandwich_norms:
             attn_out = _norm(attn_out, lp["ln1_post"], args)
         if args.parallel_residual:
             mlp_in = (hn if args.shared_ln
                       else _norm(resid, lp["ln2"], args, lp.get("ln2_b")))
-            ffn = _mlp(lp, args, mlp_in, mesh, rules, adapter_ids)
-            h = resid + rm * attn_out + rm * constrain(ffn, ("batch", None, None), rules,
+            ffn = _mlp(lp, args, mlp_in, mesh, rules, adapter_ids, ov=ov)
+            h = resid + rm * attn_out + rm * constrain(ffn, resid_logical, rules,
                                              mesh=mesh)
             return h, k_cache, v_cache
         h = resid + rm * attn_out
@@ -1058,8 +1113,8 @@ def _decoder_layer(
                             _ACTIVATIONS[args.activation],
                             decode=positions is not None)
         else:
-            ffn = _mlp(lp, args, hn, mesh, rules, adapter_ids)
-        mlp_out = constrain(ffn, ("batch", None, None), rules, mesh=mesh)
+            ffn = _mlp(lp, args, hn, mesh, rules, adapter_ids, ov=ov)
+        mlp_out = constrain(ffn, resid_logical, rules, mesh=mesh)
         if args.sandwich_norms:
             mlp_out = _norm(mlp_out, lp["ln2_post"], args)
         h = resid + rm * mlp_out
@@ -1071,10 +1126,8 @@ def _decoder_layer(
         if _sv_unfold is not None:
             attn = attn * _sv_unfold.astype(attn.dtype)
         attn = attn.transpose(0, 2, 1, 3).reshape(h.shape[0], h.shape[1], args.q_size)
-        attn_out = qapply(attn, lp["wo"])
-        if args.o_bias:
-            attn_out = attn_out + lp["bo"]
-        attn_out = constrain(attn_out, ("batch", None, None), rules, mesh=mesh)
+        attn_out = _o_proj(lp, args, attn, mesh, rules, ov, adapter_ids,
+                           resid_logical)
         h = resid + rm * attn_out
         resid = h
         hn = (_norm(h, lp["ln2"], args, lp.get("ln2_b")) if args.pre_norms else h)
@@ -1083,8 +1136,8 @@ def _decoder_layer(
                             _ACTIVATIONS[args.activation],
                             decode=positions is not None)
         else:
-            ffn = _mlp(lp, args, hn, mesh, rules, adapter_ids)
-        h = resid + rm * constrain(ffn, ("batch", None, None), rules, mesh=mesh)
+            ffn = _mlp(lp, args, hn, mesh, rules, adapter_ids, ov=ov)
+        h = resid + rm * constrain(ffn, resid_logical, rules, mesh=mesh)
         return h, k_cache, v_cache
 
     if paged is not None:
@@ -1162,12 +1215,8 @@ def _decoder_layer(
     if _sv_unfold is not None:
         attn = attn * _sv_unfold.astype(attn.dtype)
     attn = attn.transpose(0, 2, 1, 3).reshape(h.shape[0], h.shape[1], args.q_size)
-    attn_out = qapply(attn, lp["wo"])
-    if args.lora is not None:
-        attn_out = apply_lora(lp, "wo", attn, attn_out, adapter_ids, args.lora.scaling)
-    if args.o_bias:
-        attn_out = attn_out + lp["bo"]
-    attn_out = constrain(attn_out, ("batch", None, None), rules, mesh=mesh)
+    attn_out = _o_proj(lp, args, attn, mesh, rules, ov, adapter_ids,
+                       resid_logical)
     if args.sandwich_norms:
         attn_out = _norm(attn_out, lp["ln1_post"], args)
     if args.parallel_residual:
@@ -1175,8 +1224,8 @@ def _decoder_layer(
         # residual; shared_ln reuses ln1's output as the MLP input
         mlp_in = (hn if args.shared_ln
                   else _norm(resid, lp["ln2"], args, lp.get("ln2_b")))
-        ffn = _mlp(lp, args, mlp_in, mesh, rules, adapter_ids)
-        h = resid + rm * attn_out + rm * constrain(ffn, ("batch", None, None), rules,
+        ffn = _mlp(lp, args, mlp_in, mesh, rules, adapter_ids, ov=ov)
+        h = resid + rm * attn_out + rm * constrain(ffn, resid_logical, rules,
                                          mesh=mesh)
         return h, k_cache, v_cache
     h = resid + rm * attn_out
@@ -1188,8 +1237,8 @@ def _decoder_layer(
                             _ACTIVATIONS[args.activation],
                             decode=positions is not None)
     else:
-        ffn = _mlp(lp, args, hn, mesh, rules, adapter_ids)
-    mlp_out = constrain(ffn, ("batch", None, None), rules, mesh=mesh)
+        ffn = _mlp(lp, args, hn, mesh, rules, adapter_ids, ov=ov)
+    mlp_out = constrain(ffn, resid_logical, rules, mesh=mesh)
     if args.sandwich_norms:
         mlp_out = _norm(mlp_out, lp["ln2_post"], args)
     h = resid + rm * mlp_out

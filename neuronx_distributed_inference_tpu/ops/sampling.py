@@ -15,7 +15,8 @@
 
 from __future__ import annotations
 
-from typing import Optional
+import os
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -23,6 +24,71 @@ import jax.numpy as jnp
 from ..config import OnDeviceSamplingConfig
 
 NEG_INF = -1e30
+
+
+def _sharded_vocab_axis(logits_shape, mesh, rules) -> Optional[str]:
+    """The mesh axis to run per-shard top-k over, or None for the dense path.
+
+    Sharded sampling is on by default whenever the lm_head is vocab-sharded
+    over a real axis (the ``vocab`` rule, tp by default) and the shapes
+    divide; ``TPUINF_SHARDED_SAMPLING=0`` opts out (trace-time)."""
+    if mesh is None:
+        return None
+    if os.environ.get("TPUINF_SHARDED_SAMPLING", "1") == "0":
+        return None
+    from ..parallel.sharding import DEFAULT_RULES
+
+    r = rules or DEFAULT_RULES
+    ax = r.get("vocab")
+    if not isinstance(ax, str) or mesh.shape.get(ax, 1) <= 1:
+        return None
+    if logits_shape[-1] % mesh.shape[ax] != 0:
+        return None
+    batch_rule = r.get("batch")
+    b_axes = ((batch_rule,) if isinstance(batch_rule, str)
+              else tuple(batch_rule or ()))
+    b_div = 1
+    for a in b_axes:
+        b_div *= mesh.shape.get(a, 1)
+    if logits_shape[0] % b_div != 0:
+        return None
+    return ax
+
+
+def vocab_topk_window(logits: jnp.ndarray, k_width: int, mesh, rules,
+                      axis: str) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """``lax.top_k(logits, k_width)`` computed WITHOUT materializing the full
+    (..., V) logits on one shard: each shard top-ks its local vocab slice,
+    the (tiny) per-shard candidate windows all-gather across the axis, and a
+    final top-k merges them. ≈ the reference's staged ``nxd_topk`` collective
+    (`modules/generation/sampling.py:303-328`).
+
+    Exactness: candidates concatenate in ascending-vocab-chunk order and each
+    shard's window is value-desc/index-asc, so the merge's tie-breaking (lower
+    position wins) reproduces dense ``lax.top_k`` bit-for-bit — including the
+    order among equal logits."""
+    from ..parallel.sharding import logical_to_spec
+
+    nd = logits.ndim
+    logical = ("batch",) + (None,) * (nd - 2) + ("vocab",)
+    spec = logical_to_spec(logical, rules)
+    out_spec = logical_to_spec(("batch",) + (None,) * (nd - 1), rules)
+
+    def _local(lg):
+        v_loc = lg.shape[-1]
+        kw = min(k_width, v_loc)
+        vals, idx = jax.lax.top_k(lg, kw)
+        gidx = idx + jax.lax.axis_index(axis) * v_loc
+        allv = jax.lax.all_gather(vals, axis, axis=nd - 1, tiled=True)
+        alli = jax.lax.all_gather(gidx, axis, axis=nd - 1, tiled=True)
+        mvals, mpos = jax.lax.top_k(allv, k_width)
+        return mvals, jnp.take_along_axis(alli, mpos, axis=-1)
+
+    from ..models.base import shard_map_compat
+
+    fn = shard_map_compat(_local, mesh=mesh, in_specs=(spec,),
+                          out_specs=(out_spec, out_spec))
+    return fn(logits)
 
 
 def prepare_sampling_params(batch_size: int, top_k=1, top_p=1.0, temperature=1.0):
@@ -45,16 +111,26 @@ def _masked_window(
     logits: jnp.ndarray,                  # (..., V) fp32
     sampling_params: jnp.ndarray,         # (..., 3) broadcastable to logits[:-1]
     config: OnDeviceSamplingConfig,
+    mesh=None,
+    rules=None,
 ):
     """Shared top-k/top-p/temperature masking over the global-topk window.
 
     Returns ``(masked (..., K), top_idx (..., K))``: temperature-scaled logits in
     descending order with rejected entries at NEG_INF, plus their vocab indices.
+    With a mesh whose ``vocab`` rule is sharded, the window comes from the
+    per-shard top-k merge (vocab_topk_window) — no full (..., V) logits ever
+    land on one chip.
     """
     logits = logits.astype(jnp.float32)
     vocab = logits.shape[-1]
     k_width = min(config.global_topk, vocab)
-    top_vals, top_idx = jax.lax.top_k(logits, k_width)   # (..., K) desc order
+    axis = _sharded_vocab_axis(logits.shape, mesh, rules)
+    if axis is not None:
+        top_vals, top_idx = vocab_topk_window(logits, k_width, mesh, rules,
+                                              axis)
+    else:
+        top_vals, top_idx = jax.lax.top_k(logits, k_width)  # (..., K) desc
 
     top_k = sampling_params[..., 0:1]                    # (..., 1) float
     top_p = sampling_params[..., 1:2]
@@ -82,15 +158,23 @@ def sample(
     sampling_params: jnp.ndarray,         # (B, 3) [top_k, top_p, temperature]
     key: Optional[jax.Array],
     config: OnDeviceSamplingConfig,
+    mesh=None,
+    rules=None,
 ) -> jnp.ndarray:
-    """Return sampled token ids (B,) int32, entirely on device."""
+    """Return sampled token ids (B,) int32, entirely on device.
+
+    ``mesh``/``rules`` opt into tp-sharded sampling: the candidate window is
+    merged from per-shard top-ks (the full (B, V) logits stay vocab-sharded);
+    the gumbel draw and masking then run on the tiny (B, K) window, identical
+    to the dense path."""
     logits = logits.astype(jnp.float32)
     batch = logits.shape[0]
 
     if not config.do_sample and not config.dynamic:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return greedy(logits, mesh=mesh, rules=rules)
 
-    masked, top_idx = _masked_window(logits, sampling_params, config)
+    masked, top_idx = _masked_window(logits, sampling_params, config,
+                                     mesh=mesh, rules=rules)
 
     greedy_choice = jnp.zeros((batch,), dtype=jnp.int32)  # index 0 = argmax in sorted order
     if key is None:
@@ -108,11 +192,14 @@ def window_probs(
     logits: jnp.ndarray,                  # (..., V)
     sampling_params: jnp.ndarray,         # (..., 3)
     config: OnDeviceSamplingConfig,
+    mesh=None,
+    rules=None,
 ):
     """Post-mask probabilities over the global-topk window: ``(probs (..., K),
     idx (..., K))``. Used by speculative acceptance, which needs the *distribution* a
     token was (or would be) sampled from, not just a draw."""
-    masked, top_idx = _masked_window(logits, sampling_params, config)
+    masked, top_idx = _masked_window(logits, sampling_params, config,
+                                     mesh=mesh, rules=rules)
     return jax.nn.softmax(masked, axis=-1), top_idx
 
 
@@ -128,5 +215,13 @@ def scatter_to_vocab(probs: jnp.ndarray, idx: jnp.ndarray, vocab: int) -> jnp.nd
     return flat_out.reshape(out.shape)
 
 
-def greedy(logits: jnp.ndarray) -> jnp.ndarray:
-    return jnp.argmax(logits.astype(jnp.float32), axis=-1).astype(jnp.int32)
+def greedy(logits: jnp.ndarray, mesh=None, rules=None) -> jnp.ndarray:
+    """Argmax token ids; under a vocab-sharded mesh the argmax merges
+    per-shard (value, index) candidates instead of gathering (B, V) logits
+    (same lowest-index tie-breaking as dense argmax)."""
+    logits = logits.astype(jnp.float32)
+    axis = _sharded_vocab_axis(logits.shape, mesh, rules)
+    if axis is not None:
+        _, idx = vocab_topk_window(logits, 1, mesh, rules, axis)
+        return idx[..., 0].astype(jnp.int32)
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32)

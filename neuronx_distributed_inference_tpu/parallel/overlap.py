@@ -1,0 +1,324 @@
+"""Overlap-scheduled tensor-parallel collective matmuls.
+
+Replaces the blocking GSPMD collective placement around the dense layer matmuls
+with explicit shard_map **collective matmuls** — the TPU analog of the
+reference's sequence-parallel Row/ColumnParallelLinear pairing
+(`modules/attention/attention_base.py:210-218`, sequence-parallel norm in the
+attention/MLP blocks) and of the decomposed collective-matmul schedules in
+TPLA / "Overlap Communication with Dependent Computation" (PAPERS.md):
+
+- **all-gather -> matmul** (column-parallel: qkv / gate-up). The activation
+  enters *sharded* (sequence-sharded in prefill, hidden-sharded in decode) and
+  each chip starts the matmul on the shard it already owns while
+  `lax.ppermute` rotates the next shard in around the tp ring — the ICI
+  transfer hides behind the MXU instead of serializing in front of it.
+- **matmul -> reduce-scatter** (row-parallel: o-proj / down-proj). Each chip
+  computes per-destination partial tiles and rotate-accumulates them around
+  the ring, so the reduction traffic overlaps the remaining tiles' compute and
+  the output lands already in the sharded residual layout.
+
+Together with the sequence-parallel residual path (`models/base.py`
+``act_seq`` / ``act_embed`` residual constraints) this converts the per-layer
+all-reduces XLA would place after o-proj/down-proj into all-gather +
+reduce-scatter *halves fused into the matmuls* — same bytes on the wire,
+no blocking collective on the critical path.
+
+Selection is trace-time: the layer takes this path when the mesh has tp > 1,
+the residual rules are sharded (``sequence_parallel_enabled``), and the
+operand shapes/weights are eligible; ``TPUINF_TP_OVERLAP=0`` opts out and
+falls back to today's pure GSPMD constraint placement (read at TRACE time —
+set before the first compile; a warm executable never re-reads it).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .mesh import AXIS_CP, AXIS_EP, AXIS_TP
+from .sharding import DEFAULT_RULES, logical_to_spec
+
+
+def overlap_enabled() -> bool:
+    """TPUINF_TP_OVERLAP=0 falls back to GSPMD constraint placement (trace-time)."""
+    return os.environ.get("TPUINF_TP_OVERLAP", "1") != "0"
+
+
+def _shard_map(local_fn, mesh, in_specs, out_specs):
+    """shard_map with the replication check off, across jax versions (kept local
+    to avoid a models.base import cycle — see models/base.shard_map_compat)."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(local_fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map as _sm
+
+    return _sm(local_fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=False)
+
+
+def _rule_is_tp(rules: Dict, name: str) -> bool:
+    v = (rules or DEFAULT_RULES).get(name)
+    if v == AXIS_TP:
+        return True
+    # (cp, tp)-style tuples are tp-equivalent when the other axes are size 1
+    # (the caller checks cp == ep == 1 before asking)
+    return isinstance(v, tuple) and AXIS_TP in v
+
+
+def layer_phase(args, mesh, rules, *, decode: bool) -> Optional[str]:
+    """Decide whether THIS trace's dense projections take the collective-matmul
+    path. Returns ``"seq"`` (prefill: activations sequence-sharded over tp),
+    ``"hidden"`` (decode: T is 1-ish so the residual shards over the hidden
+    dim instead — the decode analog of sequence parallelism), or None for the
+    GSPMD fallback.
+
+    The ring rotates over the tp axis only, so cp/ep must be size 1 (cp > 1
+    configs keep ring-attention prefill + GSPMD constraints); LoRA and
+    activation-quant projections keep their fused qapply paths.
+    """
+    if mesh is None or not overlap_enabled():
+        return None
+    shape = dict(mesh.shape)
+    if shape.get(AXIS_TP, 1) <= 1:
+        return None
+    if shape.get(AXIS_CP, 1) != 1 or shape.get(AXIS_EP, 1) != 1:
+        return None
+    if args.lora is not None or args.activation_quant:
+        return None
+    r = rules or DEFAULT_RULES
+    if decode:
+        if r.get("act_embed") != AXIS_TP:
+            return None
+        # attention-DP remaps decode head rules to None — the collective
+        # matmuls produce head-sharded projections, so both must agree
+        if r.get("decode_heads") != AXIS_TP or r.get("decode_kv_heads") != AXIS_TP:
+            return None
+        return "hidden"
+    if not _rule_is_tp(r, "act_seq"):
+        return None
+    if r.get("heads") != AXIS_TP or r.get("kv_heads") != AXIS_TP:
+        return None
+    return "seq"
+
+
+def _plain(w) -> bool:
+    """Quantized weights ride dict payloads ({"q","s"} / {"q4","s"}) through
+    qapply; the collective matmuls serve plain dense arrays only."""
+    return not isinstance(w, dict)
+
+
+def _perm(tp: int):
+    return [(i, (i + 1) % tp) for i in range(tp)]
+
+
+def column_projection(x, ws: Sequence, mesh, rules, phase: str,
+                      out_logicals: Sequence[str]):
+    """Fused column-parallel projection ``x @ [w_0 | w_1 | ...]`` with the
+    all-gather half of the residual collective folded into the matmul.
+
+    ``phase="seq"``: x (B, S, H) sequence-sharded (``act_seq``); each chip
+    matmuls the seq shard it holds while ppermute rotates the next one in;
+    outputs are full-sequence with their out dim tp-sharded.
+    ``phase="hidden"``: x (B, T, H) hidden-sharded (``act_embed``); the ring
+    rotates hidden shards and accumulates partial contractions against the
+    matching weight row block.
+
+    Returns a list of (B, S, O_i) outputs (out dims tp-sharded), or None when
+    the operands are ineligible (quantized payloads, non-dividing shapes) —
+    the caller falls back to qapply + GSPMD placement.
+    """
+    r = rules or DEFAULT_RULES
+    tp = mesh.shape[AXIS_TP]
+    if not all(_plain(w) for w in ws):
+        return None
+    b, s, h = x.shape
+    if h % tp != 0 or any(w.shape[-1] % tp != 0 for w in ws):
+        return None
+    if phase == "seq" and s % tp != 0:
+        return None
+    sizes = [w.shape[-1] // tp for w in ws]
+    x_logical = (("batch", None, "act_embed") if phase == "hidden"
+                 else ("batch", "act_seq", None))
+    in_specs = (logical_to_spec(x_logical, r),) + tuple(
+        logical_to_spec((None, name), r) for name in out_logicals)
+    out_specs = tuple(logical_to_spec(("batch", None, name), r)
+                      for name in out_logicals)
+    perm = _perm(tp)
+
+    def _split(out):
+        parts, o0 = [], 0
+        for sz in sizes:
+            parts.append(jax.lax.dynamic_slice_in_dim(out, o0, sz, axis=2))
+            o0 += sz
+        return tuple(parts)
+
+    if phase == "seq":
+
+        def _local(xs, *wl):
+            w = jnp.concatenate(wl, axis=-1)            # (H, sum O_i / tp)
+            rk = jax.lax.axis_index(AXIS_TP)
+            s_loc = xs.shape[1]
+            dt = jnp.result_type(xs.dtype, w.dtype)
+            out = jnp.zeros((xs.shape[0], tp * s_loc, w.shape[-1]), dtype=dt)
+            cur = xs
+            for k in range(tp):
+                # issue the ring transfer FIRST: the matmul below does not
+                # depend on it, so the scheduler hides the ICI hop behind MXU
+                nxt = (jax.lax.ppermute(cur, AXIS_TP, perm)
+                       if k < tp - 1 else None)
+                blk = jnp.matmul(cur, w).astype(dt)
+                src = (rk - k) % tp                      # chunk held this step
+                out = jax.lax.dynamic_update_slice(out, blk, (0, src * s_loc, 0))
+                cur = nxt
+            return _split(out)
+
+    else:
+
+        def _local(xs, *wl):
+            w = jnp.concatenate(wl, axis=-1)            # (H, sum O_i / tp)
+            rk = jax.lax.axis_index(AXIS_TP)
+            h_loc = xs.shape[-1]
+            dt = jnp.result_type(xs.dtype, w.dtype)
+            acc = jnp.zeros(xs.shape[:-1] + (w.shape[-1],), dtype=jnp.float32)
+            cur = xs
+            for k in range(tp):
+                nxt = (jax.lax.ppermute(cur, AXIS_TP, perm)
+                       if k < tp - 1 else None)
+                src = (rk - k) % tp
+                w_rows = jax.lax.dynamic_slice_in_dim(w, src * h_loc, h_loc,
+                                                      axis=0)
+                acc = acc + jnp.matmul(cur, w_rows,
+                                       preferred_element_type=jnp.float32)
+                cur = nxt
+            return _split(acc.astype(dt))
+
+    fn = _shard_map(_local, mesh, in_specs, out_specs)
+    return list(fn(x, *ws))
+
+
+def row_projection(x, w, mesh, rules, phase: str, in_logical: str):
+    """Row-parallel projection ``x @ w`` with the reduce-scatter half of the
+    residual collective folded in: x (B, S, I) has its contraction dim
+    tp-sharded (``in_logical``: "heads" for o-proj, "mlp" for down-proj) and
+    the partial sums rotate-accumulate around the tp ring, landing directly in
+    the sharded residual layout (seq-sharded in prefill, hidden-sharded in
+    decode). Per-destination partial tiles are computed lazily inside the
+    ring so each tile's matmul overlaps the previous tile's ppermute.
+
+    Returns the (B, S, H) output (residual-sharded), or None when ineligible.
+    """
+    r = rules or DEFAULT_RULES
+    tp = mesh.shape[AXIS_TP]
+    if not _plain(w):
+        return None
+    b, s, i = x.shape
+    h = w.shape[-1]
+    if i % tp != 0:
+        return None
+    if phase == "seq" and s % tp != 0:
+        return None
+    if phase == "hidden" and h % tp != 0:
+        return None
+    in_specs = (logical_to_spec(("batch", None, in_logical), r),
+                logical_to_spec((in_logical, None), r))
+    out_logical = (("batch", None, "act_embed") if phase == "hidden"
+                   else ("batch", "act_seq", None))
+    out_spec = logical_to_spec(out_logical, r)
+    perm = _perm(tp)
+
+    def _local(xs, wl):
+        rk = jax.lax.axis_index(AXIS_TP)
+        dt = jnp.result_type(xs.dtype, wl.dtype)
+        if phase == "seq":
+            s_loc = xs.shape[1] // tp
+
+            def part(c):
+                xc = jax.lax.dynamic_slice_in_dim(xs, c * s_loc, s_loc, axis=1)
+                return jnp.matmul(xc, wl, preferred_element_type=jnp.float32)
+        else:
+            h_loc = wl.shape[-1] // tp
+
+            def part(c):
+                wc = jax.lax.dynamic_slice_in_dim(wl, c * h_loc, h_loc, axis=1)
+                return jnp.matmul(xs, wc, preferred_element_type=jnp.float32)
+
+        acc = part((rk - 1) % tp)
+        for k in range(1, tp):
+            acc = jax.lax.ppermute(acc, AXIS_TP, perm)
+            acc = acc + part((rk - k - 1) % tp)
+        # after tp-1 hops the accumulator at rank r holds destination tile r,
+        # having collected every rank's partial along the ring
+        return acc.astype(dt)
+
+    fn = _shard_map(_local, mesh, in_specs, out_spec)
+    return fn(x, w)
+
+
+# ---------------------------------------------------------------------------
+# ICI traffic accounting
+# ---------------------------------------------------------------------------
+
+# optimized-HLO collective ops counted as inter-chip traffic (fusion suffixes
+# like all-reduce-start / all-gather-done collapse onto their base name)
+_COLLECTIVE_OPS = ("all-reduce", "all-gather", "reduce-scatter",
+                   "collective-permute", "all-to-all")
+_DTYPE_BYTES = {"pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "bf16": 2,
+                "f16": 2, "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8,
+                "f64": 8, "f8e4m3fn": 1, "f8e5m2": 1}
+_COLLECTIVE_RE = re.compile(
+    r"=\s+(?:\()?(\w+)\[([\d,]*)\][^=]*?\b"
+    r"(all-reduce|all-gather|reduce-scatter|collective-permute|all-to-all)"
+    r"(?:-start)?\(")
+
+
+def collective_stats(hlo_text: str) -> Dict[str, object]:
+    """Count collectives (and their output bytes) in an optimized-HLO dump.
+
+    The multichip analog of the HBM bytes-accessed canaries
+    (tests/test_perf_regression.py): ``counts`` pins the collective schedule
+    of a compiled step (a refactor that reintroduces a stray all-gather shows
+    up immediately) and ``bytes`` approximates the per-dispatch ICI traffic
+    as the summed output shapes of every collective op. ``-done`` halves of
+    async pairs carry no shape of their own and are not double counted.
+    """
+    counts: Dict[str, int] = {}
+    total = 0
+    for m in _COLLECTIVE_RE.finditer(hlo_text):
+        dtype, dims, op = m.group(1), m.group(2), m.group(3)
+        counts[op] = counts.get(op, 0) + 1
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * _DTYPE_BYTES.get(dtype, 4)
+    return {"counts": counts, "count_total": sum(counts.values()),
+            "bytes": total}
+
+
+def compiled_collective_stats(compiled) -> Dict[str, object]:
+    """collective_stats over a jax Compiled object's optimized HLO."""
+    return collective_stats(compiled.as_text())
+
+
+def estimated_ici_bytes_per_step(args, tp: int, batch: int, t: int = 1,
+                                 dtype_bytes: int = 2) -> int:
+    """Analytic per-decode-step ICI bytes at tp > 1 (the telemetry gauge's
+    model, shape-derived so it never needs a compile).
+
+    Per layer the residual crosses the ring twice (attention + MLP), each
+    crossing one all-gather plus one reduce-scatter (or the all-reduce XLA
+    fuses them into — same bytes either way, which is why there is no
+    seq-parallel/overlap knob here): ``2 * 2 * (tp-1)/tp * B*T*H``. The
+    epilogue adds one hidden-dim gather ahead of the vocab-sharded lm_head
+    and the (negligible, k-width) sampling window merge.
+    """
+    if tp <= 1:
+        return 0
+    ring = (tp - 1) / tp
+    act = batch * t * args.hidden_size * dtype_bytes
+    per_layer = 2 * 2 * act * ring
+    return int(args.num_layers * per_layer + act * ring)

@@ -22,8 +22,16 @@ Logical axes used by the model code:
 - ``batch``     : batch dim of activations and KV caches (dp)
 - ``seq``       : sequence dim of activations (cp; sp when enabled)
 - ``kv_seq``    : sequence dim of KV caches (cp for flash-decoding-style sharding)
-- ``act_embed`` : hidden dim of activations (only sharded under sequence-parallel-off
-                  tensor layouts; normally None)
+- ``act_seq``   : sequence dim of the PREFILL residual stream between layers —
+                  None (replicated) by default; ``sequence_parallel_enabled``
+                  maps it to (cp, tp) so residuals/norms live sequence-sharded
+                  and the per-layer all-reduces split into all-gather +
+                  reduce-scatter halves (fused into the collective matmuls,
+                  parallel/overlap.py; ≈ reference sequence-parallel norm)
+- ``act_embed`` : hidden dim of the DECODE residual stream — None by default;
+                  ``sequence_parallel_enabled`` maps it to tp (decode steps
+                  have T≈1, so the residual shards over hidden instead of
+                  seq — the decode analog of sequence parallelism)
 """
 
 from __future__ import annotations
@@ -52,6 +60,7 @@ DEFAULT_RULES: Dict[str, MeshAxes] = {
     "batch": AXIS_DP,
     "seq": AXIS_CP,
     "kv_seq": None,
+    "act_seq": None,
     "act_embed": None,
     "layers": None,
     # decode-attention layout knobs (≈ reference attention data parallelism,

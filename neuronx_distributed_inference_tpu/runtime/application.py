@@ -114,6 +114,18 @@ class TpuModelForCausalLM:
         self.sharding_rules = dict(DEFAULT_RULES)
         if not self.tpu_config.vocab_parallel:
             self.sharding_rules["vocab"] = None
+        if self.tpu_config.sequence_parallel_enabled:
+            # sequence-parallel residual/norm path (≈ reference sequence-
+            # parallel norm in the attention/MLP blocks): prefill residuals
+            # shard over seq on the model axes; decode residuals (T≈1) shard
+            # over hidden — converting the per-layer all-reduces into
+            # all-gather + reduce-scatter halves, which the overlap-scheduled
+            # collective matmuls (parallel/overlap.py) fuse into the qkv /
+            # gate-up / o-proj / down-proj matmuls at tp > 1
+            from ..parallel.mesh import AXIS_CP, AXIS_TP
+
+            self.sharding_rules["act_seq"] = (AXIS_CP, AXIS_TP)
+            self.sharding_rules["act_embed"] = AXIS_TP
         if self.tpu_config.flash_decoding_enabled:
             # flash decoding: decode-time KV caches shard their sequence dim over
             # the cp axis (≈ reference flashdecode KV-replication groups,
@@ -223,7 +235,8 @@ class TpuModelForCausalLM:
                                              rules=rules, use_flash=use_flash,
                                              adapter_ids=adapter_ids,
                                              use_ring=use_ring)
-                tokens = sampling_ops.sample(logits, sampling_params, key, odsc)
+                tokens = sampling_ops.sample(logits, sampling_params, key, odsc,
+                                             mesh=mesh, rules=rules)
             return tokens, logits, cache
 
         def _decode(params, tokens0, position_ids, cache, sampling_params, key,
@@ -252,10 +265,10 @@ class TpuModelForCausalLM:
                                                 adapter_ids=adapter_ids, **kernel_kw)
                     last = logits[:, -1, :]
                     if greedy:
-                        nxt = sampling_ops.greedy(last)
+                        nxt = sampling_ops.greedy(last, mesh=mesh, rules=rules)
                     else:
                         nxt = sampling_ops.sample(last, sampling_params, step_key,
-                                                  odsc)
+                                                  odsc, mesh=mesh, rules=rules)
                 out = (nxt, last) if with_logits else (nxt, ())
                 return (nxt, pos + 1, cache), out
 

@@ -237,6 +237,20 @@ class ContinuousBatchingRunner:
         self._m_inflight = reg.gauge(
             "serving_inflight_chunks",
             "decode chunks currently in flight (dispatch-ahead pipeline)")
+        # multichip visibility: the serving mesh's tp degree as a gauge, and a
+        # shape-derived PER-TOKEN-ROW ICI traffic estimate (parallel/overlap)
+        # attached to every step-timeline record on tp > 1 meshes — decode
+        # iterations charge the compiled slot count, prefill windows/chunks
+        # charge their written token widths (see _ici_bytes)
+        self._m_tp = reg.gauge(
+            "serving_tp_degree",
+            "tensor-parallel degree of the serving mesh")
+        self._m_tp.set(cfg.tp_degree)
+        from ..parallel import overlap as overlap_lib
+
+        self._ici_bytes_per_token = overlap_lib.estimated_ici_bytes_per_step(
+            app.arch_args, cfg.tp_degree, batch=1, t=1,
+            dtype_bytes=jnp.dtype(cfg.jax_dtype).itemsize)
 
         # host-side greedy detection (== application.generate's): every slot
         # argmax -> the decode chunk compiles without the dynamic sampling
@@ -482,7 +496,8 @@ class ContinuousBatchingRunner:
                             slot_mapping=slot_mapping, adapter_ids=adapter_row)
                         last = jnp.take_along_axis(
                             logits, last_token_idx[:, None, None], axis=1)[:, 0]
-                tok = sampling_ops.sample(last, sampling_params, key, odsc)
+                tok = sampling_ops.sample(last, sampling_params, key, odsc,
+                                          mesh=mesh, rules=rules)
                 return tok, cache
 
             def _insert_nol(params, input_ids, position_ids, cache,
@@ -528,11 +543,13 @@ class ContinuousBatchingRunner:
                         if greedy:
                             # all rows argmax: skip the global-topk sampling
                             # window (measured 6.3 ms/step at bs=64, 128k vocab)
-                            nxt = sampling_ops.greedy(logits[:, -1])
+                            nxt = sampling_ops.greedy(logits[:, -1],
+                                                      mesh=mesh, rules=rules)
                         else:
                             nxt = sampling_ops.sample(logits[:, -1],
                                                       sampling_params,
-                                                      step_key, odsc)
+                                                      step_key, odsc,
+                                                      mesh=mesh, rules=rules)
                     nxt = jnp.where(alive, nxt, tok)
                     pos = pos + alive.astype(pos.dtype)
                     budget = budget - alive.astype(budget.dtype)
@@ -575,10 +592,13 @@ class ContinuousBatchingRunner:
                             adapter_ids=chunk_adapters, q_lens=chunk_qlens,
                             logit_idx=chunk_qlens - 1, **paged_kernel_kw)
                         if greedy:
-                            chunk_tok = sampling_ops.greedy(logits_c[:, 0])
+                            chunk_tok = sampling_ops.greedy(logits_c[:, 0],
+                                                            mesh=mesh,
+                                                            rules=rules)
                         else:
                             chunk_tok = sampling_ops.sample(
-                                logits_c[:, 0], chunk_sp, key_c, odsc)
+                                logits_c[:, 0], chunk_sp, key_c, odsc,
+                                mesh=mesh, rules=rules)
 
                     keys = jax.random.split(key_d, num_steps)
                     slots_t = slot_chunk.T[:, :, None]          # (steps, B, 1)
@@ -593,11 +613,15 @@ class ContinuousBatchingRunner:
                                 slot_mapping=slots_j, adapter_ids=adapter_ids,
                                 **paged_kernel_kw)
                             if greedy:
-                                nxt = sampling_ops.greedy(logits[:, -1])
+                                nxt = sampling_ops.greedy(logits[:, -1],
+                                                          mesh=mesh,
+                                                          rules=rules)
                             else:
                                 nxt = sampling_ops.sample(logits[:, -1],
                                                           sampling_params,
-                                                          step_key, odsc)
+                                                          step_key, odsc,
+                                                          mesh=mesh,
+                                                          rules=rules)
                         return (nxt, pos + 1, cache), nxt
 
                     (_, _, cache), toks = jax.lax.scan(
@@ -623,7 +647,8 @@ class ContinuousBatchingRunner:
                         mesh=mesh, rules=rules, cache_batch_start=slot,
                         use_flash=use_flash, use_ring=use_ring,
                         adapter_ids=adapter_row)
-                tok = sampling_ops.sample(logits, sampling_params, key, odsc)
+                tok = sampling_ops.sample(logits, sampling_params, key, odsc,
+                                          mesh=mesh, rules=rules)
                 return tok, cache
 
             def _decode(params, tok0, positions, alive0, budget0, cache,
@@ -643,11 +668,13 @@ class ContinuousBatchingRunner:
                             mesh=mesh, rules=rules, adapter_ids=adapter_ids,
                             **kernel_kw)
                         if greedy:
-                            nxt = sampling_ops.greedy(logits[:, -1])
+                            nxt = sampling_ops.greedy(logits[:, -1],
+                                                      mesh=mesh, rules=rules)
                         else:
                             nxt = sampling_ops.sample(logits[:, -1],
                                                       sampling_params,
-                                                      step_key, odsc)
+                                                      step_key, odsc,
+                                                      mesh=mesh, rules=rules)
                     nxt = jnp.where(alive, nxt, tok)
                     pos = pos + alive.astype(pos.dtype)
                     budget = budget - alive.astype(budget.dtype)
@@ -681,7 +708,8 @@ class ContinuousBatchingRunner:
                         params, args, tok[:, None], pos, cache, decode_bucket,
                         mesh=mesh, rules=rules, window_row=slot,
                         adapter_ids=adapter_row)
-                out = sampling_ops.sample(logits[:, -1], sampling_params, key, odsc)
+                out = sampling_ops.sample(logits[:, -1], sampling_params, key,
+                                          odsc, mesh=mesh, rules=rules)
                 return out, cache
 
             self._insert_step = jax.jit(_insert, donate_argnums=(4,))
@@ -732,7 +760,8 @@ class ContinuousBatchingRunner:
                     slot_mapping=slot_map, return_hidden=True)
                 last = jnp.take_along_axis(
                     logits, last_token_idx[:, None, None], axis=1)[:, 0]
-                tok = sampling_ops.sample(last, sampling_params, key, odsc)
+                tok = sampling_ops.sample(last, sampling_params, key, odsc,
+                                          mesh=mesh, rules=rules)
                 cond = jnp.concatenate(
                     [h_prev[:, None].astype(h_full.dtype), h_full[:, :-1]],
                     axis=1)
@@ -901,7 +930,8 @@ class ContinuousBatchingRunner:
                         nxt = jnp.argmax(last, axis=-1).astype(jnp.int32)
                         return (nxt, dpos + 1, cache), nxt
                     nxt = sampling_ops.sample(last, sampling_params,
-                                              key_j, odsc)
+                                              key_j, odsc, mesh=d_mesh,
+                                              rules=d_rules)
                     return (nxt, dpos + 1, cache), (nxt, last)
 
                 (d_last, d_pos, d_cache), ys = jax.lax.scan(
@@ -974,7 +1004,7 @@ class ContinuousBatchingRunner:
                         last = (logits[:, 0] if t_base else jnp.take_along_axis(
                             logits, last_token_idx[:, None, None], axis=1)[:, 0])
                         tok = sampling_ops.sample(last, sampling_params, key,
-                                                  odsc)
+                                                  odsc, mesh=mesh, rules=rules)
                     else:
                         tkw = dict(skip_logits=True) if t_base else {}
                         _, t_cache = t_decode(
@@ -1441,8 +1471,21 @@ class ContinuousBatchingRunner:
                 occupancy=len(live), slots=self.num_slots,
                 in_flight=len(self._inflight),
                 kv_free=self.allocator.num_free if self.paged else None,
-                kv_total=self.allocator.num_blocks if self.paged else None)
+                kv_total=self.allocator.num_blocks if self.paged else None,
+                ici_bytes=self._ici_bytes(steps))
         return emitted
+
+    def _ici_bytes(self, iterations: int, prefill_tokens: int = 0
+                   ) -> Optional[int]:
+        """Step-timeline ICI traffic: per-token-row estimate times the token
+        rows the dispatch moves — each decode iteration carries the compiled
+        slot count of rows, prefill windows/chunks carry their written token
+        widths. None on tp=1 meshes, so single-chip step records keep their
+        exact pre-multichip shape."""
+        if not self._ici_bytes_per_token:
+            return None
+        units = int(iterations) * self.num_slots + int(prefill_tokens)
+        return self._ici_bytes_per_token * max(1, units)
 
     def _note_chunk_time(self, wall_s: float, steps: int) -> None:
         """async_mode="auto": time full-size sync chunks (sample 1 discarded —
@@ -1607,7 +1650,9 @@ class ContinuousBatchingRunner:
                 prefill_tokens=sum(w for _, w in chosen),
                 prefill_budget=self.prefill_budget,
                 kv_free=self.allocator.num_free,
-                kv_total=self.allocator.num_blocks)
+                kv_total=self.allocator.num_blocks,
+                ici_bytes=self._ici_bytes(steps,
+                                          sum(w for _, w in chosen)))
         return emitted
 
     def _step_spec(self, key, emitted: Dict[int, List[int]]
@@ -1714,7 +1759,8 @@ class ContinuousBatchingRunner:
                 kv_free=self.allocator.num_free if self.paged else None,
                 kv_total=self.allocator.num_blocks if self.paged else None,
                 accept_mean=(chunk_added / chunk_cells if chunk_cells
-                             else None))
+                             else None),
+                ici_bytes=self._ici_bytes(iters))
         if (self.spec_adaptive and chunk_cells
                 and chunk_added / chunk_cells < self.spec_min_accept):
             self._spec_off = True
@@ -1901,7 +1947,8 @@ class ContinuousBatchingRunner:
                     prefill_tokens=int(wlen), slots=self.num_slots,
                     kv_free=self.allocator.num_free,
                     kv_total=self.allocator.num_blocks,
-                    request_id=req.request_id)
+                    request_id=req.request_id,
+                    ici_bytes=self._ici_bytes(0, int(wlen)))
         return key, used
 
     def _insert(self, req: Request, slot: int, key) -> int:
@@ -1962,7 +2009,8 @@ class ContinuousBatchingRunner:
             tel.request_prefill_chunk(req.request_id, len(fed), 0)
             tel.step_record(t_i, "insert", iterations=1,
                             prefill_tokens=len(fed), slots=self.num_slots,
-                            request_id=req.request_id)
+                            request_id=req.request_id,
+                            ici_bytes=self._ici_bytes(0, len(fed)))
         return int(np.asarray(tok_dev)[0])
 
     def _insert_eagle_host(self, req: Request, slot: int, key, fed) -> int:
@@ -2011,7 +2059,8 @@ class ContinuousBatchingRunner:
                     prefill_tokens=len(window), slots=self.num_slots,
                     kv_free=self.allocator.num_free,
                     kv_total=self.allocator.num_blocks,
-                    request_id=req.request_id)
+                    request_id=req.request_id,
+                    ici_bytes=self._ici_bytes(0, len(window)))
             start += len(window)
         self._h_cond = self._h_cond.at[slot].set(h_prev[0])
         return int(np.asarray(tok_dev)[0])

@@ -349,7 +349,8 @@ class FusedSpeculativeModel:
                 if greedy:
                     nxt = jnp.argmax(last, axis=-1).astype(jnp.int32)
                 else:
-                    nxt = sampling_ops.sample(last, sampling_params, key_j, odsc)
+                    nxt = sampling_ops.sample(last, sampling_params, key_j,
+                                              odsc, mesh=d_mesh, rules=d_rules)
                 return (nxt, pos + 1, cache), ((nxt, last) if want_d_logits
                                                else nxt)
 

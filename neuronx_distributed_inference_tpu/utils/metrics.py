@@ -458,7 +458,8 @@ class ServingTelemetry:
                     kv_free: Optional[int] = None, kv_total: Optional[int] = None,
                     accept_mean: Optional[float] = None,
                     request_id: Optional[int] = None,
-                    in_flight: Optional[int] = None) -> None:
+                    in_flight: Optional[int] = None,
+                    ici_bytes: Optional[int] = None) -> None:
         """Record one dispatch of the serving loop (kinds: ``decode``,
         ``spec_chunk``, ``mixed``, ``insert_window``, ``insert``). Durations
         are host spans over dispatch + host commit; device overlap shows up
@@ -486,6 +487,11 @@ class ServingTelemetry:
             # serving_dispatch_depth / serving_inflight_chunks carry the
             # scrape-time values)
             rec["in_flight"] = in_flight
+        if ici_bytes is not None:
+            # per-dispatch inter-chip traffic (tp > 1 meshes only; the
+            # runner's shape-derived estimate, parallel/overlap.py —
+            # multichip runs become visible in the step timeline exports)
+            rec["ici_bytes"] = ici_bytes
         c = self._c_steps.get(kind)
         if c is None:
             c = self.registry.counter("serving_steps_total",

@@ -292,6 +292,74 @@ def test_mixed_chunk_gather_fallback_grows_with_table():
     assert gather_32 > gather_4 * 1.15, (gather_4, gather_32)
 
 
+def _tp_paged_decode_collective_stats(mb, b=8, steps=2, tp=2, sp=True,
+                                      overlap=True):
+    """Collective schedule (+ output bytes) of the COMPILED tp>1 paged-CB
+    decode chunk — the multichip serving hot path — via
+    parallel/overlap.collective_stats over the optimized HLO."""
+    import os
+
+    from neuronx_distributed_inference_tpu.ops import sampling as sampling_ops
+    from neuronx_distributed_inference_tpu.parallel import overlap as overlap_lib
+    from neuronx_distributed_inference_tpu.runtime.continuous_batching import (
+        ContinuousBatchingRunner)
+
+    cfg = TpuConfig(batch_size=b, seq_len=4096, max_context_length=128,
+                    dtype="bfloat16", context_encoding_buckets=[128],
+                    token_generation_buckets=[512],
+                    is_continuous_batching=True, paged_attention_enabled=True,
+                    pa_num_blocks=66, pa_block_size=128, tp_degree=tp,
+                    sequence_parallel_enabled=sp)
+    config = LlamaInferenceConfig(cfg, load_config=load_pretrained_config(HF))
+    app = LlamaForCausalLM(None, config)
+    app.load_random(seed=0)
+    r = ContinuousBatchingRunner(app, decode_chunk=steps)
+    sp_arr = sampling_ops.prepare_sampling_params(b)
+    prev = os.environ.get("TPUINF_TP_OVERLAP")
+    os.environ["TPUINF_TP_OVERLAP"] = "1" if overlap else "0"
+    try:
+        lowered = r._decode_step.lower(
+            app.params, jnp.zeros((b,), jnp.int32),
+            jnp.full((b,), 128, jnp.int32), jnp.ones((b,), bool),
+            jnp.full((b,), 64, jnp.int32), r.cache,
+            jnp.zeros((b, mb), jnp.int32), jnp.zeros((b, steps), jnp.int32),
+            sp_arr, jax.random.PRNGKey(0), jnp.zeros((b,), jnp.int32),
+            jnp.full((b,), -1, jnp.int32), num_steps=steps)
+        return overlap_lib.compiled_collective_stats(lowered.compile())
+    finally:
+        if prev is None:
+            os.environ.pop("TPUINF_TP_OVERLAP", None)
+        else:
+            os.environ["TPUINF_TP_OVERLAP"] = prev
+
+
+def test_tp_decode_collective_schedule_pinned():
+    """The PR-5 multichip canary: the tp>1 decode step's collective schedule
+    is pinned per layer and its ICI bytes are table/batch-shape-invariant.
+
+    The layer stack runs under lax.scan, so the optimized HLO carries the
+    per-layer collective schedule exactly once — a refactor that reintroduces
+    a stray all-gather (or any per-layer collective) changes ``counts``
+    immediately. Invariance: block-table width and slot count must not leak
+    into the schedule (reads track live state; collectives move activations,
+    never table-shaped buffers)."""
+    s4 = _tp_paged_decode_collective_stats(mb=4)
+    s32 = _tp_paged_decode_collective_stats(mb=32)
+    assert s4["counts"] == s32["counts"], (s4["counts"], s32["counts"])
+    assert s4["bytes"] == s32["bytes"], (s4["bytes"], s32["bytes"])
+    # schedule (op mix) is batch-shape-invariant too; bytes scale with rows
+    sb4 = _tp_paged_decode_collective_stats(mb=4, b=4)
+    assert sb4["counts"] == s4["counts"], (sb4["counts"], s4["counts"])
+    # per-layer pin: a small, bounded schedule (ring permutes + the residual
+    # halves + sampling merge) — growth here is a reintroduced collective
+    assert 0 < s4["count_total"] <= 48, s4
+    # the overlap path really is overlap-scheduled: ring collective-permutes
+    # present; the GSPMD fallback carries none
+    assert s4["counts"].get("collective-permute", 0) > 0, s4
+    fb = _tp_paged_decode_collective_stats(mb=4, overlap=False)
+    assert fb["counts"].get("collective-permute", 0) == 0, fb
+
+
 def test_disabled_telemetry_adds_no_measurable_step_overhead():
     """The ISSUE-3 canary: the serving loop's telemetry hooks
     (step_start / annotate / step_record / note_emitted — exactly the calls

@@ -214,6 +214,131 @@ def test_flash_decoding_cp2_matches_tp1(hf_state):
         np.testing.assert_allclose(lw, lg, atol=1e-4, rtol=1e-4)
 
 
+def _make_sp_app(hf_state, tp, sp, overlap=None, sharded_sampling=None):
+    """App at tp with sequence parallelism + optional trace-time env toggles
+    (fresh app => fresh jit closures => the env is re-read at trace)."""
+    import os
+
+    if overlap is not None:
+        os.environ["TPUINF_TP_OVERLAP"] = "1" if overlap else "0"
+    if sharded_sampling is not None:
+        os.environ["TPUINF_SHARDED_SAMPLING"] = ("1" if sharded_sampling
+                                                 else "0")
+    tpu_cfg = TpuConfig(batch_size=2, seq_len=64, max_context_length=32,
+                        dtype="float32", tp_degree=tp,
+                        sequence_parallel_enabled=sp,
+                        context_encoding_buckets=[32],
+                        token_generation_buckets=[64])
+    config = LlamaInferenceConfig(tpu_cfg,
+                                  load_config=load_pretrained_config(HF_CFG))
+    app = LlamaForCausalLM(None, config)
+    app._put_params(app.convert_hf_state_dict(dict(hf_state), app.config))
+    return app
+
+
+@pytest.mark.parametrize("tp", [2, 4, 8])
+def test_seq_parallel_overlap_and_fallback_match_tp1(hf_state, tp):
+    """The PR-5 exactness matrix at tp∈{2,4,8}: sequence-parallel residuals
+    through the overlap collective matmuls AND the GSPMD-constraint fallback
+    (TPUINF_TP_OVERLAP=0) must reproduce tp=1 prefill/decode/sampling —
+    tokens exactly, logits within fp32 collective-reorder tolerance."""
+    import os
+
+    rng = np.random.default_rng(0)
+    input_ids = rng.integers(1, 256, size=(2, 20)).astype(np.int64)
+    want = _make_sp_app(hf_state, 1, False).generate(
+        input_ids, max_new_tokens=10, return_logits=True)
+    try:
+        for overlap in (True, False):
+            got = _make_sp_app(hf_state, tp, True, overlap=overlap).generate(
+                input_ids, max_new_tokens=10, return_logits=True)
+            np.testing.assert_array_equal(got.tokens, want.tokens)
+            for lw, lg in zip(want.logits, got.logits):
+                np.testing.assert_allclose(lw, lg, atol=1e-4, rtol=1e-4)
+    finally:
+        os.environ.pop("TPUINF_TP_OVERLAP", None)
+
+
+def test_seq_parallel_off_still_matches_tp1(hf_state):
+    """seq-parallel OFF at tp=8 (the pre-PR-5 layout) stays exact — the
+    residual-rule plumbing must be a no-op when the flag is off."""
+    rng = np.random.default_rng(3)
+    input_ids = rng.integers(1, 256, size=(2, 18)).astype(np.int64)
+    want = _make_sp_app(hf_state, 1, False).generate(input_ids,
+                                                     max_new_tokens=8)
+    got = _make_sp_app(hf_state, 8, False).generate(input_ids,
+                                                    max_new_tokens=8)
+    np.testing.assert_array_equal(got.tokens, want.tokens)
+
+
+def test_sharded_sampling_matches_full_logits_gather(hf_state):
+    """tp=8 generate with the per-shard top-k merge vs the dense-window path
+    (TPUINF_SHARDED_SAMPLING=0): identical tokens, greedy AND multinomial."""
+    import os
+
+    from neuronx_distributed_inference_tpu.ops import sampling as sampling_ops
+
+    rng = np.random.default_rng(11)
+    input_ids = rng.integers(1, 256, size=(2, 16)).astype(np.int64)
+    sp = sampling_ops.prepare_sampling_params(2, top_k=[1, 20], top_p=0.9,
+                                              temperature=0.8)
+    try:
+        for params in (None, sp):
+            a = _make_sp_app(hf_state, 8, True, sharded_sampling=True)
+            b = _make_sp_app(hf_state, 8, True, sharded_sampling=False)
+            got = a.generate(input_ids, max_new_tokens=8, sampling_params=params,
+                             seed=5)
+            want = b.generate(input_ids, max_new_tokens=8,
+                              sampling_params=params, seed=5)
+            np.testing.assert_array_equal(got.tokens, want.tokens)
+    finally:
+        os.environ.pop("TPUINF_SHARDED_SAMPLING", None)
+
+
+def test_seq_parallel_cb_and_fused_spec_match(hf_state):
+    """Sequence parallelism through the paged CB runner and fused speculation
+    at tp=8: emitted tokens must equal the non-seq-parallel runs' exactly
+    (the serving-path exactness bar; mirrored by dryrun scenario 12)."""
+    from neuronx_distributed_inference_tpu.runtime.continuous_batching import (
+        ContinuousBatchingRunner)
+
+    draft_cfg = dict(HF_CFG, num_hidden_layers=1)
+
+    def run(sp, spec):
+        tpu_cfg = TpuConfig(batch_size=2, seq_len=96, max_context_length=32,
+                            dtype="float32", tp_degree=8,
+                            sequence_parallel_enabled=sp,
+                            is_continuous_batching=True,
+                            paged_attention_enabled=True,
+                            pa_num_blocks=48, pa_block_size=8,
+                            context_encoding_buckets=[16, 32],
+                            token_generation_buckets=[48, 96])
+        config = LlamaInferenceConfig(
+            tpu_cfg, load_config=load_pretrained_config(HF_CFG))
+        tgt = LlamaForCausalLM(None, config)
+        tgt.load_random(seed=0)
+        if spec:
+            d_config = LlamaInferenceConfig(
+                tpu_cfg, load_config=load_pretrained_config(draft_cfg))
+            d = LlamaForCausalLM(None, d_config)
+            d.load_random(seed=1)
+            runner = ContinuousBatchingRunner(tgt, draft=d,
+                                              speculation_length=4,
+                                              spec_chunk=2)
+        else:
+            runner = ContinuousBatchingRunner(tgt, decode_chunk=4)
+        rng = np.random.default_rng(9)
+        rids = [runner.submit(rng.integers(1, 256, size=(n,)).astype(np.int32),
+                              max_new_tokens=6) for n in (12, 7, 19)]
+        results = runner.run_to_completion()
+        return [results[r] for r in rids]
+
+    for spec in (False, True):
+        want = run(sp=False, spec=spec)
+        got = run(sp=True, spec=spec)
+        assert got == want, f"seq-parallel CB diverged (spec={spec})"
+
+
 def test_attention_dp_continuous_batching_matches_tp(hf_state):
     """Attention-DP x continuous batching (the reference COUPLES them:
     attention DP requires CB, `models/config.py:678-679`): the CB runner on a

@@ -1,0 +1,237 @@
+"""Overlap-scheduled collective matmuls, the sequence-parallel residual path,
+and tp-sharded sampling (parallel/overlap.py, ops/sampling.py PR-5 additions).
+
+Unit-level exactness on the virtual 8-device mesh: every collective-matmul
+primitive must reproduce its dense matmul bit-for-tolerance, the sharded
+top-k window must reproduce dense ``lax.top_k`` bit-for-bit (including tie
+order), and the trace-time gates must decline ineligible configurations.
+Model-level e2e (tp∈{2,4,8} vs tp=1 through generate/CB/speculation) lives in
+tests/test_sharding_e2e.py and the multichip dryrun.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from neuronx_distributed_inference_tpu.config import (
+    OnDeviceSamplingConfig, TpuConfig)
+from neuronx_distributed_inference_tpu.models.base import ModelArchArgs
+from neuronx_distributed_inference_tpu.ops import sampling as sampling_ops
+from neuronx_distributed_inference_tpu.parallel import mesh as mesh_lib
+from neuronx_distributed_inference_tpu.parallel import overlap as overlap_lib
+from neuronx_distributed_inference_tpu.parallel.sharding import DEFAULT_RULES
+
+RULES = dict(DEFAULT_RULES, act_seq=("cp", "tp"), act_embed="tp")
+
+
+@pytest.fixture(scope="module")
+def tp_mesh():
+    assert len(jax.devices()) >= 8, "conftest must force 8 CPU devices"
+    return mesh_lib.build_mesh(tp_degree=8)
+
+
+# ------------------------------------------------------------ collective matmuls
+def test_column_projection_seq_matches_dense(tp_mesh):
+    """all-gather->matmul ring (prefill): seq-sharded x, fused [wq|wk|wv]."""
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((2, 16, 32)).astype(np.float32)
+    ws = [rng.standard_normal((32, o)).astype(np.float32) for o in (64, 16, 16)]
+    got = overlap_lib.column_projection(
+        jnp.asarray(x), [jnp.asarray(w) for w in ws], tp_mesh, RULES, "seq",
+        ("heads", "kv_heads", "kv_heads"))
+    assert got is not None
+    for g, w in zip(got, ws):
+        np.testing.assert_allclose(np.asarray(g), x @ w, atol=1e-5, rtol=1e-5)
+
+
+def test_column_projection_hidden_matches_dense(tp_mesh):
+    """Contraction-ring variant (decode): hidden-sharded x accumulates partial
+    products against the matching weight row blocks."""
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((4, 1, 64)).astype(np.float32)
+    ws = [rng.standard_normal((64, o)).astype(np.float32) for o in (32, 16)]
+    got = overlap_lib.column_projection(
+        jnp.asarray(x), [jnp.asarray(w) for w in ws], tp_mesh, RULES,
+        "hidden", ("mlp", "mlp"))
+    assert got is not None
+    for g, w in zip(got, ws):
+        np.testing.assert_allclose(np.asarray(g), x @ w, atol=1e-5, rtol=1e-5)
+
+
+@pytest.mark.parametrize("phase,shape", [("seq", (2, 16, 48)),
+                                         ("hidden", (3, 2, 48))])
+def test_row_projection_matches_dense(tp_mesh, phase, shape):
+    """matmul->reduce-scatter ring: partial sums rotate-accumulate to the
+    sharded residual layout; the global result is the full row-parallel sum."""
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal(shape).astype(np.float32)
+    w = rng.standard_normal((shape[-1], 64)).astype(np.float32)
+    got = overlap_lib.row_projection(jnp.asarray(x), jnp.asarray(w), tp_mesh,
+                                     RULES, phase, "heads")
+    assert got is not None
+    np.testing.assert_allclose(np.asarray(got), x @ w, atol=1e-5, rtol=1e-5)
+
+
+def test_projections_decline_ineligible_operands(tp_mesh):
+    """Quantized dict payloads and non-dividing shapes fall back (return None)
+    instead of mis-sharding."""
+    x = jnp.zeros((2, 16, 32))
+    qw = {"q": jnp.zeros((32, 64), jnp.int8), "s": jnp.zeros((1, 64))}
+    assert overlap_lib.column_projection(
+        x, [qw], tp_mesh, RULES, "seq", ("heads",)) is None
+    assert overlap_lib.row_projection(
+        x, qw, tp_mesh, RULES, "seq", "heads") is None
+    # out dim 36 % 8 != 0
+    assert overlap_lib.column_projection(
+        x, [jnp.zeros((32, 36))], tp_mesh, RULES, "seq", ("heads",)) is None
+    # seq 10 % 8 != 0 on the seq phase
+    assert overlap_lib.column_projection(
+        jnp.zeros((2, 10, 32)), [jnp.zeros((32, 64))], tp_mesh, RULES, "seq",
+        ("heads",)) is None
+
+
+def _tiny_args(**kw):
+    return ModelArchArgs(vocab_size=64, hidden_size=32, num_layers=1,
+                         num_heads=8, num_kv_heads=8, head_dim=4,
+                         intermediate_size=64, **kw)
+
+
+def test_layer_phase_gates(tp_mesh):
+    args = _tiny_args()
+    assert overlap_lib.layer_phase(args, tp_mesh, RULES, decode=False) == "seq"
+    assert overlap_lib.layer_phase(args, tp_mesh, RULES,
+                                   decode=True) == "hidden"
+    # default rules (no sharded residual) -> GSPMD fallback
+    assert overlap_lib.layer_phase(args, tp_mesh, DEFAULT_RULES,
+                                   decode=False) is None
+    # no mesh / tp=1 -> fallback
+    assert overlap_lib.layer_phase(args, None, RULES, decode=False) is None
+    assert overlap_lib.layer_phase(
+        args, mesh_lib.single_device_mesh(), RULES, decode=False) is None
+    # cp>1 meshes keep ring-attention prefill + GSPMD constraints
+    cp_mesh = mesh_lib.build_mesh(tp_degree=4, cp_degree=2)
+    assert overlap_lib.layer_phase(args, cp_mesh, RULES, decode=False) is None
+    # activation-quant projections keep their fused qapply path
+    assert overlap_lib.layer_phase(_tiny_args(activation_quant=True), tp_mesh,
+                                   RULES, decode=False) is None
+    # attention-DP decode layout (replicated decode head rules) is ineligible
+    adp = dict(RULES, decode_heads=None, decode_kv_heads=None)
+    assert overlap_lib.layer_phase(args, tp_mesh, adp, decode=True) is None
+    # env opt-out falls back at trace time
+    os.environ["TPUINF_TP_OVERLAP"] = "0"
+    try:
+        assert overlap_lib.layer_phase(args, tp_mesh, RULES,
+                                       decode=False) is None
+    finally:
+        os.environ.pop("TPUINF_TP_OVERLAP", None)
+
+
+# ------------------------------------------------------------ sharded sampling
+def test_vocab_topk_window_matches_dense_including_ties(tp_mesh):
+    """The per-shard top-k merge must equal dense lax.top_k bit-for-bit —
+    values AND index order. Quantizing logits to a coarse grid forces equal
+    values within and across shards, pinning the tie-break contract."""
+    rng = np.random.default_rng(3)
+    logits = np.round(rng.standard_normal((4, 256)) * 2) / 2
+    logits = logits.astype(np.float32)
+    want_v, want_i = jax.lax.top_k(jnp.asarray(logits), 32)
+    got_v, got_i = sampling_ops.vocab_topk_window(
+        jnp.asarray(logits), 32, tp_mesh, DEFAULT_RULES, "tp")
+    np.testing.assert_array_equal(np.asarray(got_v), np.asarray(want_v))
+    np.testing.assert_array_equal(np.asarray(got_i), np.asarray(want_i))
+
+
+def test_vocab_topk_window_wider_than_shard(tp_mesh):
+    """k_width > V/tp: each shard contributes its whole slice; the merge must
+    still equal the dense window."""
+    rng = np.random.default_rng(4)
+    logits = rng.standard_normal((2, 64)).astype(np.float32)   # 8 per shard
+    want_v, want_i = jax.lax.top_k(jnp.asarray(logits), 32)
+    got_v, got_i = sampling_ops.vocab_topk_window(
+        jnp.asarray(logits), 32, tp_mesh, DEFAULT_RULES, "tp")
+    np.testing.assert_array_equal(np.asarray(got_v), np.asarray(want_v))
+    np.testing.assert_array_equal(np.asarray(got_i), np.asarray(want_i))
+
+
+def test_sharded_sample_and_greedy_match_dense(tp_mesh):
+    """sample()/greedy() with a mesh must emit the dense path's exact tokens
+    (sharded window -> identical masked logits -> identical gumbel argmax)."""
+    rng = np.random.default_rng(5)
+    logits = rng.standard_normal((8, 256)).astype(np.float32)
+    cfg = OnDeviceSamplingConfig(do_sample=True, global_topk=64)
+    sp = sampling_ops.prepare_sampling_params(8, top_k=[1, 5, 50, -1] * 2,
+                                              top_p=0.9, temperature=0.8)
+    key = jax.random.PRNGKey(7)
+    dense = sampling_ops.sample(jnp.asarray(logits), jnp.asarray(sp), key, cfg)
+    sharded = sampling_ops.sample(jnp.asarray(logits), jnp.asarray(sp), key,
+                                  cfg, mesh=tp_mesh, rules=DEFAULT_RULES)
+    np.testing.assert_array_equal(np.asarray(dense), np.asarray(sharded))
+
+    g_dense = sampling_ops.greedy(jnp.asarray(logits))
+    g_sharded = sampling_ops.greedy(jnp.asarray(logits), mesh=tp_mesh,
+                                    rules=DEFAULT_RULES)
+    np.testing.assert_array_equal(np.asarray(g_dense), np.asarray(g_sharded))
+
+
+def test_sharded_window_probs_match_dense(tp_mesh):
+    """Speculative acceptance reads window_probs; the sharded window must give
+    the identical distribution (3D logits: the verify-window shape)."""
+    rng = np.random.default_rng(6)
+    logits = rng.standard_normal((2, 3, 256)).astype(np.float32)
+    cfg = OnDeviceSamplingConfig(do_sample=True, global_topk=32)
+    sp = jnp.asarray(sampling_ops.prepare_sampling_params(2, top_k=25,
+                                                          top_p=0.95))[:, None]
+    want_p, want_i = sampling_ops.window_probs(jnp.asarray(logits), sp, cfg)
+    got_p, got_i = sampling_ops.window_probs(jnp.asarray(logits), sp, cfg,
+                                             mesh=tp_mesh, rules=DEFAULT_RULES)
+    np.testing.assert_array_equal(np.asarray(want_i), np.asarray(got_i))
+    np.testing.assert_allclose(np.asarray(want_p), np.asarray(got_p),
+                               atol=1e-7)
+
+
+def test_sharded_sampling_declines_indivisible_vocab(tp_mesh):
+    """V % tp != 0 must fall back to the dense path, not crash shard_map."""
+    logits = jnp.asarray(np.random.default_rng(7)
+                         .standard_normal((2, 250)).astype(np.float32))
+    got = sampling_ops.greedy(logits, mesh=tp_mesh, rules=DEFAULT_RULES)
+    np.testing.assert_array_equal(np.asarray(got),
+                                  np.asarray(sampling_ops.greedy(logits)))
+
+
+# ------------------------------------------------------------ config + telemetry
+def test_config_rejects_seq_parallel_indivisible():
+    with pytest.raises(ValueError, match="cp_degree \\* tp_degree"):
+        TpuConfig(seq_len=100, tp_degree=4, cp_degree=2,
+                  sequence_parallel_enabled=True)
+    # tp alone divides but cp*tp does not -> still rejected (the old check
+    # only tested tp_degree)
+    with pytest.raises(ValueError, match="cp_degree \\* tp_degree"):
+        TpuConfig(seq_len=64, tp_degree=4, cp_degree=3,
+                  sequence_parallel_enabled=True)
+    TpuConfig(seq_len=64, tp_degree=4, cp_degree=2,
+              sequence_parallel_enabled=True)     # divisible: fine
+
+
+def test_estimated_ici_bytes_shape():
+    args = _tiny_args()
+    assert overlap_lib.estimated_ici_bytes_per_step(args, 1, 8) == 0
+    b8 = overlap_lib.estimated_ici_bytes_per_step(args, 8, 8)
+    assert b8 > 0
+    # the estimate scales with layers + batch, never with table widths
+    assert overlap_lib.estimated_ici_bytes_per_step(args, 8, 16) == 2 * b8
+
+
+def test_collective_stats_parses_hlo_text():
+    text = """
+  %ag = f32[2,64]{1,0} all-gather(f32[2,8]{1,0} %x), replica_groups={}
+  %cp.1 = bf16[4,16]{1,0} collective-permute(bf16[4,16]{1,0} %y)
+  %ar = (f32[8]{0}, f32[8]{0}) all-reduce-start(f32[8]{0} %z), to_apply=%add
+  %ard = f32[8]{0} all-reduce-done((f32[8]{0}, f32[8]{0}) %ar)
+"""
+    s = overlap_lib.collective_stats(text)
+    assert s["counts"] == {"all-gather": 1, "collective-permute": 1,
+                           "all-reduce": 1}
+    assert s["bytes"] == 2 * 64 * 4 + 4 * 16 * 2 + 8 * 4
