@@ -1,0 +1,24 @@
+"""Graph-contract auditor + repo-specific lint pass.
+
+Static verification of the dispatch invariants the serving stack's perf and
+correctness story rests on (≈ the reference's compile-time guarantees: the KV
+cache is ALIASED between graph inputs and outputs, `model_wrapper.py:1600-1612`,
+and the serving loop never syncs mid-step):
+
+- ``registry``:  every serving dispatch registers (fn, declared contract,
+  captured example args) through ``audited_jit`` — donation is DERIVED from the
+  declared cache args, so a mis-indexed ``donate_argnums`` cannot be written.
+- ``auditor``:   lowers each registered dispatch to StableHLO + compiled HLO
+  and statically verifies aliasing, host-sync freedom, dtype contracts,
+  collective schedules and HBM/ICI byte budgets.
+- ``lint``:      AST pass over the package with repo-specific rules (host syncs
+  in step loops, unregistered ``jax.jit`` sites, tracer branches, stray
+  prints, ...).
+
+Run both via ``scripts/audit_graphs.py`` (JSON report, non-zero exit on any
+unwaived violation) or the tier-1 ``contracts`` tests.
+"""
+
+from . import contracts, registry  # noqa: F401
+
+__all__ = ["contracts", "registry"]

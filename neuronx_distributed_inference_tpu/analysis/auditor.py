@@ -1,0 +1,440 @@
+"""Graph auditor: statically verify a registered dispatch's contract from its
+lowered StableHLO and compiled HLO.
+
+No execution happens here — every property is read off the compiled program,
+which is the entire point: "the hot path has no host round trips" or "the KV
+pool is read once" are properties of the GRAPH, and hoping the runtime behaves
+is how round 1 shipped a 3x decode-traffic regression no test noticed.
+
+What each check reads:
+
+- ``aliasing``     ``lowered.args_info`` (donated flags) + the ``@main``
+                   signature's ``tf.aliasing_output`` attributes, unioned with
+                   the compiled module's ``input_output_alias={...}`` config
+                   (multi-device lowerings defer alias placement to compile
+                   time, so the StableHLO attribute alone under-reports on
+                   tp>1 meshes). A donated buffer jax could not alias
+                   (shape/dtype drift between the cache in and cache out)
+                   appears in neither — that is the "donation silently
+                   failed" disaster case, and it also subsumes the
+                   dtype-preservation contract for caches (an int8 pool that
+                   comes back bf16 cannot alias).
+- ``host_sync``    callback custom-calls / infeed / outfeed / host send-recv
+                   in the lowered module text.
+- ``dtypes``       any ``f64`` tensor; declared fp32 accumulation present.
+- ``upcast``       ``stablehlo.convert`` ops bf16/f16 -> f32 whose RESULT is
+                   cache-leaf-sized or bigger.
+- ``collectives``  op multiset from the optimized HLO
+                   (parallel/overlap.collective_stats).
+- ``hbm_bytes`` / ``ici_bytes``  XLA cost analysis / summed collective output
+                   bytes against the declared ceilings.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import math
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+
+from ..parallel import overlap as overlap_lib
+from .contracts import DispatchContract, Measurement, Rule
+from .registry import AuditedDispatch
+
+__all__ = ["AuditUnit", "Finding", "Report", "audit"]
+
+_CALLBACK_RE = re.compile(
+    r"xla_python_cpu_callback|xla_python_gpu_callback|xla_ffi_python"
+    r"|stablehlo\.infeed|stablehlo\.outfeed"
+    r"|stablehlo\.send|stablehlo\.recv")
+_F64_RE = re.compile(r"tensor<(?:[0-9x]+x)?f64[>x]")
+_FP32_ACCUM_RE = re.compile(
+    r"dot_general[^\n]*\(tensor<[^>]*xbf16>,\s*tensor<[^>]*xbf16>\)"
+    r"\s*->\s*tensor<[^>]*xf32>")
+_UPCAST_RE = re.compile(
+    r"stablehlo\.convert[^\n]*:\s*\(tensor<(?:[0-9x]+x)?(?:bf16|f16)>\)"
+    r"\s*->\s*tensor<((?:\d+x)*)f32>")
+
+
+@dataclass
+class AuditUnit:
+    """One auditable lowering: a registered dispatch, optionally re-specced.
+
+    ``argmod`` transforms the captured example specs (e.g. widen the block
+    table for an invariance variant); ``overrides`` replace keyword args
+    (static chunk sizes); ``env`` pins trace-time environment toggles
+    (TPUINF_PAGED_FUSED, TPUINF_TP_OVERLAP) for the duration of the lowering.
+    """
+
+    name: str
+    dispatch: AuditedDispatch
+    overrides: Dict[str, object] = field(default_factory=dict)
+    argmod: Optional[Callable] = None
+    env: Dict[str, str] = field(default_factory=dict)
+    contract: Optional[DispatchContract] = None   # override (variants)
+
+    def resolved_contract(self) -> DispatchContract:
+        return self.contract or self.dispatch.contract
+
+
+@dataclass
+class Finding:
+    unit: str
+    check: str
+    status: str          # "pass" | "fail" | "waived" | "skipped" | "error"
+    detail: str = ""
+
+    @property
+    def violating(self) -> bool:
+        return self.status in ("fail", "error")
+
+
+@dataclass
+class Report:
+    findings: List[Finding] = field(default_factory=list)
+    measurements: Dict[str, Measurement] = field(default_factory=dict)
+
+    @property
+    def ok(self) -> bool:
+        return not any(f.violating for f in self.findings)
+
+    def violations(self) -> List[Finding]:
+        return [f for f in self.findings if f.violating]
+
+    def by_unit(self, unit: str) -> List[Finding]:
+        return [f for f in self.findings if f.unit == unit]
+
+    def to_dict(self) -> dict:
+        return {
+            "ok": self.ok,
+            "findings": [vars(f).copy() for f in self.findings],
+            "measurements": {
+                k: {"bytes_accessed": m.bytes_accessed, "steps": m.steps,
+                    "bytes_per_step": m.bytes_per_step,
+                    "collective_counts": m.collective_counts,
+                    "collective_bytes": m.collective_bytes}
+                for k, m in self.measurements.items()},
+        }
+
+
+@contextlib.contextmanager
+def _env_pinned(env: Dict[str, str]):
+    prev = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        yield
+    finally:
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+# --------------------------------------------------------------------------- lowering
+def _lower_unit(unit: AuditUnit):
+    d = unit.dispatch
+    if d.example is None:
+        raise RuntimeError(f"unit {unit.name!r}: dispatch "
+                           f"{d.contract.kind!r} has no captured example")
+    args, kwargs = d.example
+    if unit.argmod is not None:
+        args, kwargs = unit.argmod(args, dict(kwargs))
+    kwargs = dict(kwargs, **unit.overrides)
+    with _env_pinned(unit.env):
+        return d._jit.lower(*args, **kwargs), (args, kwargs)
+
+
+def _main_signature(text: str) -> str:
+    for line in text.splitlines():
+        if "func.func public @main(" in line:
+            return line
+    i = text.find("@main(")
+    return text[i: text.find("\n", i)] if i >= 0 else ""
+
+
+def _aliased_arg_indices(text: str) -> set:
+    """Flat arg indices carrying ``tf.aliasing_output`` in the @main signature."""
+    sig = _main_signature(text)
+    out = set()
+    chunks = re.split(r"%arg(\d+):", sig)
+    # chunks: [pre, idx0, body0, idx1, body1, ...]
+    for i in range(1, len(chunks) - 1, 2):
+        if "tf.aliasing_output" in chunks[i + 1]:
+            out.add(int(chunks[i]))
+    return out
+
+
+def _compiled_alias_param_indices(text: str) -> set:
+    """Flat param indices aliased per the compiled HLO module's
+    ``input_output_alias={ {out_idx}: (param_idx, {}, may-alias), ... }``
+    header — where multi-device lowerings record the aliases the StableHLO
+    ``tf.aliasing_output`` attribute carries on single-device ones."""
+    start = text.find("input_output_alias={")
+    if start < 0:
+        return set()
+    i = text.index("{", start)
+    depth, j = 0, i
+    for j in range(i, len(text)):
+        if text[j] == "{":
+            depth += 1
+        elif text[j] == "}":
+            depth -= 1
+            if depth == 0:
+                break
+    span = text[i: j + 1]
+    return {int(m.group(1)) for m in re.finditer(r"\(\s*(\d+)\s*,", span)}
+
+
+def _flat_arg_layout(args: tuple, kwargs: dict, cache_names: Tuple[str, ...],
+                     fn, static_names: Tuple[str, ...]) -> Tuple[dict, int]:
+    """Map each declared cache arg name -> (start, stop) flat leaf range in
+    jax's (args, kwargs) flatten order (statics excluded — they are not
+    lowered args); returns (ranges, total_leaves)."""
+    import inspect
+
+    params = list(inspect.signature(fn).parameters)
+    pos_names = params[: len(args)]
+    ranges: Dict[str, Tuple[int, int]] = {}
+    idx = 0
+    for name, a in zip(pos_names, args):
+        if name in static_names:
+            continue
+        n = len(jax.tree_util.tree_leaves(a))
+        if name in cache_names:
+            ranges[name] = (idx, idx + n)
+        idx += n
+    # keyword args flatten after positionals, in dict-key sorted order
+    for name in sorted(kwargs):
+        if name in static_names:
+            continue
+        n = len(jax.tree_util.tree_leaves(kwargs[name]))
+        if name in cache_names:
+            ranges[name] = (idx, idx + n)
+        idx += n
+    return ranges, idx
+
+
+def _min_cache_leaf_elems(args: tuple, kwargs: dict,
+                          cache_names: Tuple[str, ...], fn) -> Optional[int]:
+    import inspect
+
+    params = list(inspect.signature(fn).parameters)
+    leaves = []
+    for name, a in zip(params[: len(args)], args):
+        if name in cache_names:
+            leaves += jax.tree_util.tree_leaves(a)
+    for name in cache_names:
+        if name in kwargs:
+            leaves += jax.tree_util.tree_leaves(kwargs[name])
+    sizes = [math.prod(x.shape) for x in leaves if hasattr(x, "shape")]
+    return min(sizes) if sizes else None
+
+
+def _bytes_accessed(compiled) -> float:
+    cost = compiled.cost_analysis()
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0]
+    # strict lookup: a missing key must surface as an audit ERROR, never as a
+    # silent 0.0 that makes every byte ceiling vacuously pass
+    return float(cost["bytes accessed"])
+
+
+def _flops(compiled) -> float:
+    cost = compiled.cost_analysis()
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0]
+    return float(cost.get("flops", 0.0))
+
+
+# --------------------------------------------------------------------------- checks
+def _emit(findings: List[Finding], contract: DispatchContract, unit: str,
+          check: str, ok: bool, detail: str) -> None:
+    if ok:
+        findings.append(Finding(unit, check, "pass"))
+    elif check in contract.waivers:
+        findings.append(Finding(
+            unit, check, "waived",
+            f"{detail} [waived: {contract.waivers[check]}]"))
+    else:
+        findings.append(Finding(unit, check, "fail", detail))
+
+
+def _audit_unit(unit: AuditUnit, findings: List[Finding],
+                measurements: Dict[str, Measurement]) -> None:
+    contract = unit.resolved_contract()
+    lowered, (args, kwargs) = _lower_unit(unit)
+    text = lowered.as_text()
+    with _env_pinned(unit.env):
+        compiled = lowered.compile()
+    compiled_text = compiled.as_text()
+
+    # ---- aliasing --------------------------------------------------------
+    info_leaves = jax.tree_util.tree_leaves(
+        lowered.args_info, is_leaf=lambda x: hasattr(x, "donated"))
+    donated = {i for i, leaf in enumerate(info_leaves) if leaf.donated}
+    aliased = (_aliased_arg_indices(text)
+               | _compiled_alias_param_indices(compiled_text))
+    ranges, total = _flat_arg_layout(
+        args, kwargs, contract.cache_args + contract.donate_extra,
+        unit.dispatch.fn, unit.dispatch.static_argnames)
+    problems = []
+    if total != len(info_leaves):
+        problems.append(f"arg layout mismatch ({total} example leaves vs "
+                        f"{len(info_leaves)} lowered args)")
+    for name in contract.cache_args:
+        if name not in ranges:
+            problems.append(f"cache arg {name!r} not found in example args")
+            continue
+        lo, hi = ranges[name]
+        not_donated = [i for i in range(lo, hi) if i not in donated]
+        if not_donated:
+            problems.append(
+                f"cache arg {name!r}: {len(not_donated)}/{hi - lo} leaves "
+                f"NOT donated (flat args {not_donated[:6]}...) — the pool is "
+                f"double-buffered")
+        not_aliased = [i for i in range(lo, hi)
+                       if i in donated and i not in aliased]
+        if not_aliased:
+            problems.append(
+                f"cache arg {name!r}: donated leaves {not_aliased[:6]} carry "
+                f"no input_output_alias — donation silently failed (shape/"
+                f"dtype drift between cache in and cache out?)")
+    # donate_extra args are donated to free memory, with NO aliasing promise
+    # (contracts.py) — exclude them from the orphan catch-all
+    extra_idx = set()
+    for name in contract.donate_extra:
+        if name in ranges:
+            extra_idx |= set(range(*ranges[name]))
+    orphans = donated - aliased - extra_idx
+    if orphans and not problems:
+        problems.append(f"donated args {sorted(orphans)[:6]} not aliased")
+    if contract.cache_args or donated:
+        _emit(findings, contract, unit.name, "aliasing", not problems,
+              "; ".join(problems))
+    else:
+        findings.append(Finding(unit.name, "aliasing", "skipped",
+                                "no cache args declared, nothing donated"))
+
+    # ---- host_sync -------------------------------------------------------
+    if contract.host_sync_free:
+        hits = sorted(set(_CALLBACK_RE.findall(text)))
+        _emit(findings, contract, unit.name, "host_sync", not hits,
+              f"host-side ops in lowered graph: {hits}")
+    else:
+        findings.append(Finding(unit.name, "host_sync", "skipped",
+                                "contract does not claim host-sync freedom"))
+
+    # ---- dtypes ----------------------------------------------------------
+    dt_problems = []
+    if _F64_RE.search(text):
+        dt_problems.append("f64 tensor present (silent x64 upcast)")
+    if contract.fp32_accum and not _FP32_ACCUM_RE.search(text):
+        dt_problems.append("declared fp32 accumulation, but no "
+                           "bf16 x bf16 -> f32 contraction in the graph")
+    _emit(findings, contract, unit.name, "dtypes", not dt_problems,
+          "; ".join(dt_problems))
+
+    # ---- upcast ----------------------------------------------------------
+    threshold = contract.max_upcast_elems
+    if threshold == "auto":
+        threshold = _min_cache_leaf_elems(args, kwargs, contract.cache_args,
+                                          unit.dispatch.fn)
+    if threshold is None:
+        findings.append(Finding(unit.name, "upcast", "skipped",
+                                "no threshold (no cache args / disabled)"))
+    else:
+        big = []
+        for m in _UPCAST_RE.finditer(text):
+            dims = [int(d) for d in m.group(1).split("x") if d]
+            elems = math.prod(dims) if dims else 1
+            if elems >= threshold:
+                big.append(elems)
+        _emit(findings, contract, unit.name, "upcast", not big,
+              f"bf16->f32 converts producing {big[:4]} elems "
+              f"(>= cache-leaf threshold {threshold}) — a silently upcast "
+              f"pool/residual stream")
+
+    # ---- collectives + measurements --------------------------------------
+    stats = overlap_lib.collective_stats(compiled_text)
+    steps_arg = contract.steps_arg
+    steps = 1
+    if steps_arg is not None:
+        v = unit.overrides.get(steps_arg, unit.dispatch.static_value(steps_arg))
+        if v is None and steps_arg in kwargs:
+            v = kwargs[steps_arg]
+        steps = int(v) if v is not None else 1
+    meas = Measurement(
+        bytes_accessed=_bytes_accessed(compiled), steps=max(1, steps),
+        collective_counts=dict(stats["counts"]),
+        collective_bytes=int(stats["bytes"]), flops=_flops(compiled))
+    measurements[unit.name] = meas
+
+    decl = contract.collectives
+    if decl is None:
+        findings.append(Finding(unit.name, "collectives", "skipped",
+                                "no schedule declared"))
+    elif decl == "forbid":
+        _emit(findings, contract, unit.name, "collectives",
+              meas.collective_total == 0,
+              f"collectives present in a declared-collective-free dispatch: "
+              f"{meas.collective_counts}")
+    else:
+        _emit(findings, contract, unit.name, "collectives",
+              meas.collective_counts == dict(decl),
+              f"collective multiset {meas.collective_counts} != declared "
+              f"{dict(decl)}")
+
+    if contract.hbm_bytes is None:
+        findings.append(Finding(unit.name, "hbm_bytes", "skipped", ""))
+    else:
+        _emit(findings, contract, unit.name, "hbm_bytes",
+              meas.bytes_per_step <= contract.hbm_bytes,
+              f"bytes/step {meas.bytes_per_step:.3g} exceeds declared ceiling "
+              f"{contract.hbm_bytes:.3g}")
+    if contract.ici_bytes is None:
+        findings.append(Finding(unit.name, "ici_bytes", "skipped", ""))
+    else:
+        _emit(findings, contract, unit.name, "ici_bytes",
+              meas.collective_bytes <= contract.ici_bytes,
+              f"collective bytes {meas.collective_bytes} exceed declared "
+              f"ceiling {contract.ici_bytes:.3g}")
+
+
+def audit(units: Sequence[AuditUnit], rules: Sequence[Rule] = ()) -> Report:
+    """Audit every unit, then evaluate cross-unit budget rules."""
+    report = Report()
+    for unit in units:
+        try:
+            _audit_unit(unit, report.findings, report.measurements)
+        except Exception as e:  # an unauditable dispatch IS a violation
+            report.findings.append(Finding(
+                unit.name, "audit", "error",
+                f"{type(e).__name__}: {e}"))
+    for rule in rules:
+        missing = [r for r in rule.requires if r not in report.measurements]
+        if missing:
+            report.findings.append(Finding(
+                rule.name, "rule", "error",
+                f"rule inputs never measured: {missing}"))
+            continue
+        try:
+            violations = rule.fn(report.measurements)
+        except Exception as e:
+            report.findings.append(Finding(rule.name, "rule", "error",
+                                           f"{type(e).__name__}: {e}"))
+            continue
+        if not violations:
+            report.findings.append(Finding(rule.name, "rule", "pass"))
+        elif rule.waiver:
+            report.findings.append(Finding(
+                rule.name, "rule", "waived",
+                f"{'; '.join(violations)} [waived: {rule.waiver}]"))
+        else:
+            report.findings.append(Finding(rule.name, "rule", "fail",
+                                           "; ".join(violations)))
+    return report

@@ -1,0 +1,359 @@
+"""Geometry-pinned budget canaries, migrated from tests/test_perf_regression.py
+onto the registry/auditor framework.
+
+Each canary is (AuditUnits at a pinned geometry) + (cross-unit Rules): the
+auditor measures compiled bytes-accessed / collective schedules once per unit,
+the rules encode the relations that used to live as scattered asserts —
+table-width invariance, fused-vs-separate ratios, the one-KV-pass bound, the
+pinned tp collective schedule. tests/test_perf_regression.py keeps its test
+names as thin wrappers over these groups so history stays comparable.
+
+The canary geometry (4-layer, 256-hidden, 66x128 block pool, bf16) is the
+smallest shape where the paged-pool charges dominate params — at the tiny
+2-layer harness scale the pool is noise and the ratios measure nothing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .auditor import AuditUnit
+from .contracts import (Rule, absolute_rule, collective_bound_rule,
+                        collective_equal_rule, min_growth_rule, ratio_rule)
+from .harness import generic_contract as _harness_contract
+from .registry import audited_jit
+
+
+def generic_contract(d, *, collectives="forbid"):
+    """Canary-unit contract: the fleet checks minus the generic HBM ceiling —
+    at the canary geometry XLA's conservative pallas-operand accounting can
+    legitimately exceed it, and the RELATIONAL rules are the budget here."""
+    return dataclasses.replace(_harness_contract(d, collectives=collectives),
+                               hbm_bytes=None)
+
+__all__ = ["CANARY_HF", "build_canary_units", "canary_group", "clear_caches",
+           "GROUPS"]
+
+CANARY_HF = {
+    "model_type": "llama", "vocab_size": 256, "hidden_size": 256,
+    "intermediate_size": 512, "num_hidden_layers": 4,
+    "num_attention_heads": 2, "num_key_value_heads": 2,
+    "max_position_embeddings": 1024, "rms_norm_eps": 1e-5,
+    "rope_theta": 10000.0, "tie_word_embeddings": False,
+}
+
+_POOL_BYTES = 66 * 128 * 2 * 128 * 2       # blocks x BS x Hkv x D x bf16
+_ONE_KV_PASS = CANARY_HF["num_hidden_layers"] * 2 * 2 * _POOL_BYTES
+
+
+@functools.lru_cache(maxsize=None)
+def _dense_app(kernel):
+    from ..config import TpuConfig, load_pretrained_config
+    from ..models.llama.modeling_llama import (LlamaForCausalLM,
+                                               LlamaInferenceConfig)
+
+    cfg = TpuConfig(batch_size=8, seq_len=512, max_context_length=128,
+                    dtype="bfloat16", context_encoding_buckets=[128],
+                    token_generation_buckets=[512],
+                    decode_kernel_enabled=kernel)
+    config = LlamaInferenceConfig(cfg,
+                                  load_config=load_pretrained_config(CANARY_HF))
+    app = LlamaForCausalLM(None, config)
+    app.load_random(seed=0)
+    return app
+
+
+@functools.lru_cache(maxsize=None)
+def _paged_runner(kernel, tp=1, sp=False, b=8, steps=4, tag=""):
+    """``tag`` keys ENV-variant units (fused/separate, overlap/fallback) to
+    their own runner: jax caches the traced jaxpr per jit object, so two
+    lowerings of ONE dispatch under different trace-time env toggles would
+    silently reuse the first trace — each variant needs its own jit."""
+    del tag
+    from ..config import TpuConfig, load_pretrained_config
+    from ..models.llama.modeling_llama import (LlamaForCausalLM,
+                                               LlamaInferenceConfig)
+    from ..runtime.continuous_batching import ContinuousBatchingRunner
+
+    cfg = TpuConfig(batch_size=b, seq_len=4096, max_context_length=128,
+                    dtype="bfloat16", context_encoding_buckets=[128],
+                    token_generation_buckets=[512],
+                    is_continuous_batching=True, paged_attention_enabled=True,
+                    pa_num_blocks=66, pa_block_size=128,
+                    decode_kernel_enabled=kernel, tp_degree=tp,
+                    sequence_parallel_enabled=sp)
+    config = LlamaInferenceConfig(cfg,
+                                  load_config=load_pretrained_config(CANARY_HF))
+    app = LlamaForCausalLM(None, config)
+    app.load_random(seed=0)
+    return app, ContinuousBatchingRunner(app, decode_chunk=steps)
+
+
+def _set_paged_decode_example(app, runner, b=8, steps=4, mb=4):
+    from ..ops import sampling as sampling_ops
+
+    sp = sampling_ops.prepare_sampling_params(b)
+    runner._decode_step.set_example(
+        app.params, jnp.zeros((b,), jnp.int32), jnp.full((b,), 128, jnp.int32),
+        jnp.ones((b,), bool), jnp.full((b,), 64, jnp.int32), runner.cache,
+        jnp.zeros((b, mb), jnp.int32), jnp.zeros((b, steps), jnp.int32),
+        sp, jax.random.PRNGKey(0), jnp.zeros((b,), jnp.int32),
+        jnp.full((b,), -1, jnp.int32), num_steps=steps)
+
+
+def _widen_table(arg_idx, mb):
+    """argmod widening the block table (positional ``arg_idx``) to ``mb``."""
+
+    def mod(args, kwargs):
+        args = list(args)
+        bt = args[arg_idx]
+        args[arg_idx] = jax.ShapeDtypeStruct((bt.shape[0], mb), bt.dtype)
+        return tuple(args), kwargs
+
+    return mod
+
+
+def _paged_decode_unit(name, kernel, mb, fused=True, tp=1, sp=False, b=8,
+                       steps=4, env_extra=None, collectives="forbid"):
+    env = {"TPUINF_PAGED_FUSED": "1" if fused else "0"}
+    env.update(env_extra or {})
+    app, runner = _paged_runner(kernel, tp=tp, sp=sp, b=b, steps=steps,
+                                tag=",".join(f"{k}={v}" for k, v in
+                                             sorted(env.items())))
+    _set_paged_decode_example(app, runner, b=b, steps=steps, mb=4)
+    return AuditUnit(
+        name, runner._decode_step, argmod=_widen_table(6, mb), env=env,
+        contract=generic_contract(runner._decode_step,
+                                  collectives=collectives))
+
+
+# --------------------------------------------------------------------- groups
+def _group_dense_decode() -> Tuple[List[AuditUnit], List[Rule]]:
+    """Dense decode per-step traffic: jnp path within 3x of the ideal working
+    set; the Pallas stacked-cache path never pays MORE than the jnp path."""
+    from ..ops import sampling as sampling_ops
+
+    units = []
+    for tag, kernel in (("jnp", False), ("kernel", True)):
+        app = _dense_app(kernel)
+        app.reset_cache()
+        b = app.tpu_config.max_batch_size
+        sp = sampling_ops.prepare_sampling_params(b)
+        app._decode_step.set_example(
+            app.params, jnp.zeros((b,), jnp.int32),
+            np.full((b,), 128, np.int32), app.kv_cache, sp,
+            jax.random.PRNGKey(0), decode_bucket=512, num_steps=4,
+            with_logits=False, greedy=True)
+        units.append(AuditUnit(f"dense_decode_{tag}", app._decode_step,
+                               contract=generic_contract(app._decode_step)))
+    app = _dense_app(False)
+    ideal = (sum(x.nbytes for x in jax.tree.leaves(app.params))
+             + sum(x.nbytes for x in jax.tree.leaves(app.kv_cache)))
+    rules = [
+        absolute_rule("dense_decode_bytes_bounded", "dense_decode_jnp",
+                      3.0 * ideal),
+        ratio_rule("kernel_decode_not_more_traffic", "dense_decode_kernel",
+                   "dense_decode_jnp", 1.1),
+    ]
+    return units, rules
+
+
+def _group_fused_paged() -> Tuple[List[AuditUnit], List[Rule]]:
+    """Fused append+attend: table-width-invariant traffic, <=0.25x the
+    separate write-then-attend charge, and within 2x of one aliased KV pass."""
+    units = [
+        _paged_decode_unit("fused_mb4", True, 4, fused=True),
+        _paged_decode_unit("fused_mb32", True, 32, fused=True),
+        _paged_decode_unit("separate_mb4", True, 4, fused=False),
+    ]
+    rules = [
+        ratio_rule("fused_table_invariant", "fused_mb32", "fused_mb4", 1.02),
+        ratio_rule("fused_vs_separate", "fused_mb4", "separate_mb4", 0.25),
+        absolute_rule("fused_one_kv_pass", "fused_mb4", 2.0 * _ONE_KV_PASS),
+    ]
+    return units, rules
+
+
+def _group_paged_table_width() -> Tuple[List[AuditUnit], List[Rule]]:
+    """q_len=1 paged decode: kernel traffic invariant to table width; the
+    gather fallback grows with it (documents the cliff the kernel avoids)."""
+    units = [
+        _paged_decode_unit("paged_kern_mb4", True, 4),
+        _paged_decode_unit("paged_kern_mb32", True, 32),
+        _paged_decode_unit("paged_gather_mb4", None, 4),
+        _paged_decode_unit("paged_gather_mb32", None, 32),
+    ]
+    rules = [
+        ratio_rule("paged_kernel_table_invariant", "paged_kern_mb32",
+                   "paged_kern_mb4", 1.02),
+        min_growth_rule("paged_gather_grows_with_table", "paged_gather_mb32",
+                        "paged_gather_mb4", 1.15),
+    ]
+    return units, rules
+
+
+def _mq_verify_dispatch(app, use_kernel):
+    """Registered canary dispatch for the multi-query (spec verify) attend."""
+    from ..models import base as model_base
+
+    def _verify(params, ids, positions, cache, bt, sm):
+        return model_base.decode_forward(
+            params, app.arch_args, ids, positions, cache, None,
+            mesh=app.mesh, rules=app.sharding_rules, block_table=bt,
+            slot_mapping=sm, use_kernel=use_kernel)
+
+    return audited_jit(_verify, kind="canary.mq_verify",
+                       cache_args=("cache",))
+
+
+def _group_multiquery() -> Tuple[List[AuditUnit], List[Rule]]:
+    """q_len>1 (speculative verify) attend: same invariance/cliff pair."""
+    units = []
+    b, t = 8, 4
+    for tag, kernel in (("kern", True), ("gather", None)):
+        app, _ = _paged_runner(kernel)
+        cache = app.make_paged_cache(66, 128)
+        d = _mq_verify_dispatch(app, bool(kernel))
+        d.set_example(app.params, jnp.zeros((b, t), jnp.int32),
+                      jnp.full((b,), 128, jnp.int32), cache,
+                      jnp.zeros((b, 4), jnp.int32),
+                      jnp.zeros((b, t), jnp.int32))
+        for mb in (4, 32):
+            units.append(AuditUnit(
+                f"mq_{tag}_mb{mb}", d, argmod=_widen_table(4, mb),
+                contract=generic_contract(d)))
+    rules = [
+        ratio_rule("mq_kernel_table_invariant", "mq_kern_mb32", "mq_kern_mb4",
+                   1.02),
+        min_growth_rule("mq_gather_grows_with_table", "mq_gather_mb32",
+                        "mq_gather_mb4", 1.15),
+    ]
+    return units, rules
+
+
+def _mixed_chunk_dispatch(app, use_kernel):
+    """Registered canary dispatch for the mixed-step variable-q_len attend."""
+    from ..models import base as model_base
+
+    def _chunk(params, ids, positions, q_lens, cache, bt, sm):
+        return model_base.decode_forward(
+            params, app.arch_args, ids, positions, cache, None,
+            mesh=app.mesh, rules=app.sharding_rules, block_table=bt,
+            slot_mapping=sm, use_kernel=use_kernel, q_lens=q_lens,
+            logit_idx=q_lens - 1)
+
+    return audited_jit(_chunk, kind="canary.mixed_chunk",
+                       cache_args=("cache",))
+
+
+def _group_mixed_chunk(chunk_lens=(64, 128, 256)
+                       ) -> Tuple[List[AuditUnit], List[Rule]]:
+    """Mixed-step chunk attend at q_len 64/128/256 must ride the variable-
+    q_len kernel (table-invariant); the gather fallback grows with the table.
+
+    Widths 16 vs 32 for the kernel: below 16 blocks the per-cell geometry is
+    table-bound, so the invariance pair must sit where only the table grows.
+    """
+    units: List[AuditUnit] = []
+    rules: List[Rule] = []
+    b = 4
+    app, _ = _paged_runner(True, b=b)
+    cache = app.make_paged_cache(66, 128)
+    for t in chunk_lens:
+        # one dispatch per chunk length: examples are per-dispatch state
+        d = _mixed_chunk_dispatch(app, True)
+        d.set_example(app.params, jnp.zeros((b, t), jnp.int32),
+                      jnp.full((b,), 64, jnp.int32),
+                      jnp.full((b,), t, jnp.int32), cache,
+                      jnp.zeros((b, 16), jnp.int32),
+                      jnp.zeros((b, t), jnp.int32))
+        for mb in (16, 32):
+            units.append(AuditUnit(
+                f"mixed_kern_t{t}_mb{mb}", d, argmod=_widen_table(5, mb),
+                contract=generic_contract(d)))
+        rules.append(ratio_rule(f"mixed_kernel_table_invariant_t{t}",
+                                f"mixed_kern_t{t}_mb32",
+                                f"mixed_kern_t{t}_mb16", 1.02))
+    app_g, _ = _paged_runner(None, b=b)
+    cache_g = app_g.make_paged_cache(66, 128)
+    dg = _mixed_chunk_dispatch(app_g, False)
+    t = 64
+    dg.set_example(app_g.params, jnp.zeros((b, t), jnp.int32),
+                   jnp.full((b,), 64, jnp.int32),
+                   jnp.full((b,), t, jnp.int32), cache_g,
+                   jnp.zeros((b, 4), jnp.int32),
+                   jnp.zeros((b, t), jnp.int32))
+    for mb in (4, 32):
+        units.append(AuditUnit(
+            f"mixed_gather_mb{mb}", dg, argmod=_widen_table(5, mb),
+            contract=generic_contract(dg)))
+    rules.append(min_growth_rule("mixed_gather_grows_with_table",
+                                 "mixed_gather_mb32", "mixed_gather_mb4",
+                                 1.15))
+    return units, rules
+
+
+def _group_tp_collectives() -> Tuple[List[AuditUnit], List[Rule]]:
+    """The PR-5 multichip canary: the tp>1 paged decode step's collective
+    schedule is pinned per layer and table/batch-shape-invariant; the overlap
+    path carries ring permutes, the GSPMD fallback carries none."""
+    units = []
+    for name, mb, b, overlap in (
+            ("tp_mb4", 4, 8, True), ("tp_mb32", 32, 8, True),
+            ("tp_b4", 4, 4, True), ("tp_fallback", 4, 8, False)):
+        units.append(_paged_decode_unit(
+            name, None, mb, tp=2, sp=True, b=b, steps=2,
+            env_extra={"TPUINF_TP_OVERLAP": "1" if overlap else "0"},
+            collectives=None))
+    rules = [
+        collective_equal_rule("tp_schedule_table_invariant", "tp_mb32",
+                              "tp_mb4", bytes_too=True),
+        collective_equal_rule("tp_schedule_batch_invariant", "tp_b4",
+                              "tp_mb4", bytes_too=False),
+        collective_bound_rule("tp_schedule_pinned", "tp_mb4", max_total=48,
+                              require_ops=("collective-permute",)),
+        collective_bound_rule("tp_fallback_no_ring", "tp_fallback",
+                              max_total=64,
+                              forbid_ops=("collective-permute",)),
+    ]
+    return units, rules
+
+
+GROUPS: Dict[str, object] = {
+    "dense_decode": _group_dense_decode,
+    "fused_paged": _group_fused_paged,
+    "paged_table_width": _group_paged_table_width,
+    "multiquery": _group_multiquery,
+    "mixed_chunk": _group_mixed_chunk,
+    "tp_collectives": _group_tp_collectives,
+}
+
+
+def canary_group(name: str) -> Tuple[List[AuditUnit], List[Rule]]:
+    return GROUPS[name]()
+
+
+def clear_caches() -> None:
+    """Drop the cached canary apps/runners (bf16 params + 66x128 block pools
+    per variant — hundreds of MB across all groups). The caches exist so
+    groups audited in one pass share builders; call this once the reports are
+    in hand so a long pytest session / the audit driver doesn't retain the
+    fleets until process exit."""
+    _dense_app.cache_clear()
+    _paged_runner.cache_clear()
+
+
+def build_canary_units(names=None) -> Tuple[List[AuditUnit], List[Rule]]:
+    units: List[AuditUnit] = []
+    rules: List[Rule] = []
+    for name in (names if names is not None else GROUPS):
+        u, r = canary_group(name)
+        units += u
+        rules += r
+    return units, rules

@@ -1,0 +1,197 @@
+"""Dispatch contracts: what a registered serving dispatch PROMISES about its
+compiled graph.
+
+A contract is declarative data — the auditor (analysis/auditor.py) is the only
+consumer. Every check carries a stable name so a finding can be waived
+explicitly (``waivers={"check": "reason"}``): a waiver is a visible, reasoned
+suppression recorded in the JSON report, never a silent one.
+
+Check names
+-----------
+``aliasing``     every leaf of every declared cache arg is donated AND actually
+                 aliased input->output in the lowered module (donation that
+                 silently fails to alias is an invisible 2x KV HBM cost).
+``host_sync``    no host callbacks (pure/io/debug callback custom-calls) and no
+                 infeed/outfeed/send/recv in the lowered module.
+``dtypes``       no f64 anywhere; with ``fp32_accum`` declared, at least one
+                 bf16 x bf16 -> f32 contraction is present.
+``upcast``       no bf16/f16 -> f32 convert producing a buffer at least as
+                 large as the smallest cache leaf (a silently-upcast KV pool or
+                 residual stream; small f32 islands — norms, softmax — pass).
+``collectives``  the compiled module's collective-op multiset matches the
+                 declared schedule ("forbid" = none at all; a dict = exact).
+``hbm_bytes``    compiled cost-analysis bytes-accessed per step stays under the
+                 declared ceiling.
+``ici_bytes``    summed collective output bytes per dispatch stays under the
+                 declared ceiling.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional, Tuple, Union
+
+CHECK_NAMES = ("aliasing", "host_sync", "dtypes", "upcast", "collectives",
+               "hbm_bytes", "ici_bytes")
+
+
+@dataclass
+class DispatchContract:
+    """Declared invariants for one registered serving dispatch.
+
+    ``cache_args`` are PARAMETER NAMES (not indices): ``audited_jit`` resolves
+    them against the function signature and derives ``donate_argnums`` from
+    them, so a registered site cannot mis-index its donation by construction.
+    """
+
+    kind: str
+    # cache-pytree parameters (donated + verified aliased + dtype-preserved)
+    cache_args: Tuple[str, ...] = ()
+    # additional donated parameters that are NOT caches (no aliasing required)
+    donate_extra: Tuple[str, ...] = ()
+    # static argname holding the per-dispatch iteration count; byte budgets
+    # are normalized by its captured value (1 when None)
+    steps_arg: Optional[str] = None
+    host_sync_free: bool = True
+    fp32_accum: bool = False
+    # "auto": threshold = smallest cache-leaf element count from the captured
+    # example; int: explicit element threshold; None: skip the check
+    max_upcast_elems: Union[str, int, None] = "auto"
+    # None: skip | "forbid": no collectives | dict op->count: exact multiset
+    collectives: Union[None, str, Dict[str, int]] = None
+    # absolute bytes-accessed ceiling per step (None: skip; cross-dispatch
+    # RELATIVE budgets live in auditor.Rule, not here)
+    hbm_bytes: Optional[float] = None
+    # absolute collective-output-bytes ceiling per dispatch (None: skip)
+    ici_bytes: Optional[float] = None
+    # check name -> reason; a waived finding is reported, not enforced
+    waivers: Dict[str, str] = field(default_factory=dict)
+
+    def __post_init__(self) -> None:
+        for name in self.waivers:
+            if name not in CHECK_NAMES:
+                raise ValueError(f"waiver for unknown check {name!r} "
+                                 f"(known: {CHECK_NAMES})")
+        if not self.kind:
+            raise ValueError("contract needs a non-empty kind")
+
+
+@dataclass
+class Rule:
+    """A cross-dispatch budget rule, evaluated AFTER all units are measured.
+
+    ``fn(measurements)`` receives ``{unit_name: Measurement}`` and returns a
+    list of violation strings (empty = pass). This is where the relational
+    perf canaries live (table-width invariance, fused-vs-separate ratios,
+    pinned collective schedules): one framework for ad-hoc thresholds that
+    used to be scattered across tests/test_perf_regression.py.
+    """
+
+    name: str
+    fn: Callable[[Dict[str, "Measurement"]], list]
+    requires: Tuple[str, ...] = ()     # unit names the rule reads
+    waiver: Optional[str] = None
+
+
+@dataclass
+class Measurement:
+    """Per-unit numbers the auditor extracts from the compiled dispatch."""
+
+    bytes_accessed: float = 0.0        # cost-analysis total for the dispatch
+    steps: int = 1                     # captured steps_arg value (min 1)
+    collective_counts: Dict[str, int] = field(default_factory=dict)
+    collective_bytes: int = 0
+    flops: float = 0.0
+
+    @property
+    def bytes_per_step(self) -> float:
+        return self.bytes_accessed / max(1, self.steps)
+
+    @property
+    def collective_total(self) -> int:
+        return sum(self.collective_counts.values())
+
+
+def ratio_rule(name: str, a: str, b: str, max_ratio: float,
+               waiver: Optional[str] = None) -> Rule:
+    """bytes_per_step(a) <= max_ratio * bytes_per_step(b)."""
+
+    def fn(m):
+        xa, xb = m[a].bytes_per_step, m[b].bytes_per_step
+        if xa > max_ratio * xb:
+            return [f"{a} bytes/step {xa:.3g} exceeds "
+                    f"{max_ratio} x {b} ({xb:.3g})"]
+        return []
+
+    return Rule(name, fn, requires=(a, b), waiver=waiver)
+
+
+def min_growth_rule(name: str, a: str, b: str, min_ratio: float,
+                    waiver: Optional[str] = None) -> Rule:
+    """bytes_per_step(a) > min_ratio * bytes_per_step(b) — documents a cliff
+    (e.g. the gather fallback really does scale with table width; if it stops
+    growing, the kernel-vs-gather canaries are no longer measuring anything)."""
+
+    def fn(m):
+        xa, xb = m[a].bytes_per_step, m[b].bytes_per_step
+        if xa <= min_ratio * xb:
+            return [f"{a} bytes/step {xa:.3g} no longer grows past "
+                    f"{min_ratio} x {b} ({xb:.3g}) — canary geometry is stale"]
+        return []
+
+    return Rule(name, fn, requires=(a, b), waiver=waiver)
+
+
+def absolute_rule(name: str, a: str, ceiling: float,
+                  waiver: Optional[str] = None) -> Rule:
+    """bytes_per_step(a) <= ceiling."""
+
+    def fn(m):
+        xa = m[a].bytes_per_step
+        if xa > ceiling:
+            return [f"{a} bytes/step {xa:.3g} exceeds ceiling {ceiling:.3g}"]
+        return []
+
+    return Rule(name, fn, requires=(a,), waiver=waiver)
+
+
+def collective_equal_rule(name: str, a: str, b: str, bytes_too: bool = True,
+                          waiver: Optional[str] = None) -> Rule:
+    """Collective-op multiset (and optionally ICI bytes) of a == b — the
+    shape-invariance half of the pinned-schedule canary."""
+
+    def fn(m):
+        out = []
+        if m[a].collective_counts != m[b].collective_counts:
+            out.append(f"{a} collective schedule {m[a].collective_counts} != "
+                       f"{b} {m[b].collective_counts}")
+        if bytes_too and m[a].collective_bytes != m[b].collective_bytes:
+            out.append(f"{a} collective bytes {m[a].collective_bytes} != "
+                       f"{b} {m[b].collective_bytes}")
+        return out
+
+    return Rule(name, fn, requires=(a, b), waiver=waiver)
+
+
+def collective_bound_rule(name: str, a: str, max_total: int,
+                          require_ops: Tuple[str, ...] = (),
+                          forbid_ops: Tuple[str, ...] = (),
+                          waiver: Optional[str] = None) -> Rule:
+    """Schedule size cap + required/forbidden op presence for one unit."""
+
+    def fn(m):
+        out = []
+        counts = m[a].collective_counts
+        total = sum(counts.values())
+        if not 0 < total <= max_total:
+            out.append(f"{a} collective count {total} outside (0, {max_total}]"
+                       f" — a reintroduced (or vanished) per-layer collective")
+        for op in require_ops:
+            if counts.get(op, 0) <= 0:
+                out.append(f"{a} missing required collective {op!r}: {counts}")
+        for op in forbid_ops:
+            if counts.get(op, 0) > 0:
+                out.append(f"{a} carries forbidden collective {op!r}: {counts}")
+        return out
+
+    return Rule(name, fn, requires=(a,), waiver=waiver)

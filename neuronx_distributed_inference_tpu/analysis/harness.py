@@ -1,0 +1,329 @@
+"""Serving-fleet harness: build one tiny runtime of every dispatch flavor,
+exercise it so each registered dispatch captures a real example, and hand the
+auditor the resulting AuditUnits.
+
+Shared by ``scripts/audit_graphs.py`` (full fleet, JSON report) and the tier-1
+``tests/test_graph_contracts.py`` (reduced scope so the fast gate stays fast).
+Everything here runs at toy scale — 2-layer 64-hidden llama on the CPU mesh —
+because the properties the auditor checks (aliasing, host callbacks, dtype
+discipline, collective multisets, RELATIVE byte budgets) are scale-invariant:
+a dispatch that double-buffers its KV pool does so at every size.
+
+Byte budgets: generic units get a declared ceiling of
+``GENERIC_HBM_BUDGET_X x (example input bytes)`` per step — loose enough for
+the known scan/gather taxes and XLA's conservative pallas-operand accounting,
+tight enough to catch the round-1 class of regression (cache copies multiplying
+traffic). The sharp, geometry-pinned budgets live in analysis/canaries.py.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from .auditor import AuditUnit
+from .contracts import DispatchContract
+from .registry import AuditedDispatch, find, live_dispatches
+
+__all__ = ["FLEET_KINDS", "TINY_HF", "build_fleet_units", "generic_contract",
+           "GENERIC_HBM_BUDGET_X"]
+
+TINY_HF = {
+    "model_type": "llama", "vocab_size": 256, "hidden_size": 64,
+    "intermediate_size": 128, "num_hidden_layers": 2,
+    "num_attention_heads": 4, "num_key_value_heads": 2,
+    "max_position_embeddings": 512, "rms_norm_eps": 1e-5,
+    "rope_theta": 10000.0, "tie_word_embeddings": False,
+}
+
+# generic per-step bytes-accessed ceiling, as a multiple of the dispatch's
+# example input bytes (params + caches + activations). The jnp scan path's
+# known cache-movement tax is ~2.6x the ideal working set; XLA charges pallas
+# custom-call operands conservatively (whole pool per operand) — 8x input
+# bytes clears both with margin while still failing on an extra O(pool) copy
+# per layer.
+GENERIC_HBM_BUDGET_X = 8.0
+
+
+def _example_input_bytes(d: AuditedDispatch) -> Optional[float]:
+    if d.example is None:
+        return None
+    args, kwargs = d.example
+    leaves = jax.tree_util.tree_leaves((args, kwargs))
+    sizes = [math.prod(x.shape) * np.dtype(x.dtype).itemsize
+             for x in leaves if hasattr(x, "shape") and hasattr(x, "dtype")]
+    return float(sum(sizes)) if sizes else None
+
+
+def generic_contract(d: AuditedDispatch, *,
+                     collectives="forbid") -> DispatchContract:
+    """The fleet-wide declared contract for one registered dispatch: its own
+    registration-time declarations plus the harness-level collective schedule
+    (tp=1 fleet: no collectives at all) and the generic byte budget."""
+    c = d.contract
+    in_bytes = _example_input_bytes(d)
+    return DispatchContract(
+        kind=c.kind, cache_args=c.cache_args, donate_extra=c.donate_extra,
+        steps_arg=c.steps_arg, host_sync_free=c.host_sync_free,
+        fp32_accum=c.fp32_accum, max_upcast_elems=c.max_upcast_elems,
+        collectives=collectives,
+        hbm_bytes=(GENERIC_HBM_BUDGET_X * in_bytes
+                   if in_bytes is not None else None),
+        ici_bytes=0 if collectives == "forbid" else None,
+        waivers=dict(c.waivers))
+
+
+def _unit(kind: str, *, require: bool = True,
+          collectives="forbid") -> List[AuditUnit]:
+    d = find(kind)
+    if d is None or d.example is None:
+        if require:
+            raise RuntimeError(
+                f"fleet dispatch {kind!r} was never registered/exercised — "
+                f"a runtime stopped registering its steps (or the harness "
+                f"stopped exercising it)")
+        return []
+    return [AuditUnit(kind, d, contract=generic_contract(
+        d, collectives=collectives))]
+
+
+# ------------------------------------------------------------------- builders
+# Each _exercise_* builder RETURNS the app/runner/engine it drove: the
+# registry holds dispatches by weakref, so the caller must keep the owner
+# alive until the AuditUnits take their own strong dispatch references.
+def _tiny_app(paged: bool = False, cb: bool = False, slots: int = 2,
+              hf: Optional[dict] = None, seed: int = 0, seq_len: int = 96):
+    from ..config import (OnDeviceSamplingConfig, TpuConfig,
+                          load_pretrained_config)
+    from ..models.llama.modeling_llama import (LlamaForCausalLM,
+                                               LlamaInferenceConfig)
+
+    tpu_cfg = TpuConfig(
+        batch_size=slots, seq_len=seq_len, max_context_length=32,
+        dtype="float32", context_encoding_buckets=[16, 32],
+        token_generation_buckets=[48, 96],
+        is_continuous_batching=cb, paged_attention_enabled=paged,
+        pa_num_blocks=48, pa_block_size=8,
+        on_device_sampling_config=OnDeviceSamplingConfig())
+    config = LlamaInferenceConfig(tpu_cfg,
+                                  load_config=load_pretrained_config(hf or
+                                                                     TINY_HF))
+    app = LlamaForCausalLM(None, config)
+    app.load_random(seed=seed)
+    return app
+
+
+def _prompts(sizes: Sequence[int], seed: int = 7) -> List[np.ndarray]:
+    rng = np.random.default_rng(seed)
+    return [rng.integers(1, 256, size=(n,)).astype(np.int32) for n in sizes]
+
+
+def _exercise_plain() -> Any:
+    app = _tiny_app()
+    (p_short, p_long) = _prompts((12, 40))
+    # short prompt: prefill + decode; >max-CE-bucket prompt: windowed prefill
+    app.generate(p_short[None, :], max_new_tokens=4)
+    app.generate(np.stack([p_long, p_long]), max_new_tokens=4)
+    return app
+
+
+def _exercise_cb(paged: bool, mixed: bool = False) -> Any:
+    from ..runtime.continuous_batching import ContinuousBatchingRunner
+
+    app = _tiny_app(paged=paged, cb=True)
+    kw = dict(prefill_chunk=16) if mixed else {}
+    if paged and not mixed:
+        # chunked inserts: a >cap prompt runs intermediate (KV-only) windows
+        # through cb.paged.insert_nol before the final sampling window
+        kw = dict(max_insert_tokens_per_step=16)
+    runner = ContinuousBatchingRunner(app, decode_chunk=4, **kw)
+    for p in _prompts((12, 19, 40)):
+        runner.submit(p, max_new_tokens=6)
+    runner.run_to_completion()
+    return runner
+
+
+def _exercise_cb_spec() -> Any:
+    from ..runtime.continuous_batching import ContinuousBatchingRunner
+
+    target = _tiny_app(paged=True, cb=True, seed=0)
+    draft_hf = dict(TINY_HF, hidden_size=32, intermediate_size=64,
+                    num_hidden_layers=1, num_attention_heads=2,
+                    num_key_value_heads=2)
+    draft = _tiny_app(paged=True, cb=True, hf=draft_hf, seed=1)
+    runner = ContinuousBatchingRunner(target, draft=draft,
+                                      speculation_length=4, spec_chunk=2)
+    for p in _prompts((12, 40)):
+        runner.submit(p, max_new_tokens=6)
+    runner.run_to_completion()
+    return runner
+
+
+def _exercise_cb_eagle() -> Any:
+    from ..models import eagle as eagle_lib
+    from ..runtime.continuous_batching import ContinuousBatchingRunner
+    from ..runtime.eagle import draft_args_from_target
+
+    target = _tiny_app(paged=True, cb=True, seed=0)
+    d_args = draft_args_from_target(target.arch_args, num_layers=1)
+    d_params = eagle_lib.init_eagle_params(
+        d_args, jax.random.PRNGKey(3),
+        dtype=target.tpu_config.jax_dtype,
+        inv_freq=target.inv_freq_from_config(target.config))
+    runner = ContinuousBatchingRunner(
+        target, eagle_draft=(d_args, d_params), speculation_length=3)
+    for p in _prompts((12, 40)):
+        runner.submit(p, max_new_tokens=6)
+    runner.run_to_completion()
+    return runner
+
+
+def _exercise_spec() -> Any:
+    from ..runtime.speculation import FusedSpeculativeModel
+
+    target = _tiny_app(seed=0)
+    draft_hf = dict(TINY_HF, hidden_size=32, intermediate_size=64,
+                    num_hidden_layers=1, num_attention_heads=2,
+                    num_key_value_heads=2)
+    draft = _tiny_app(hf=draft_hf, seed=1)
+    spec = FusedSpeculativeModel(target, draft, speculation_length=3,
+                                 greedy=True)
+    ids = np.stack(_prompts((10, 10), seed=9))
+    spec.generate(ids, max_new_tokens=6)
+    return spec
+
+
+def _exercise_eagle() -> Any:
+    from ..runtime.eagle import EagleSpeculativeModel, draft_args_from_target
+
+    target = _tiny_app(seed=0, seq_len=128)
+    d_args = draft_args_from_target(target.arch_args, num_layers=1)
+    spec = EagleSpeculativeModel(target, d_args, speculation_length=3)
+    spec.load_random_draft(seed=5)
+    ids = np.stack(_prompts((10, 10), seed=11))
+    spec.generate(ids, max_new_tokens=6)
+    return spec
+
+
+def _exercise_eagle3() -> Any:
+    from ..runtime.eagle import draft_args_from_target
+    from ..runtime.eagle3 import Eagle3SpeculativeModel
+
+    target = _tiny_app(seed=0, seq_len=128)
+    d_args = draft_args_from_target(target.arch_args, num_layers=1)
+    spec = Eagle3SpeculativeModel(target, d_args, depth=2, beam=2, branch=2)
+    spec.load_random_draft(seed=6)
+    ids = np.stack(_prompts((10, 10), seed=13))
+    spec.generate(ids, max_new_tokens=6)
+    return spec
+
+
+def _exercise_medusa() -> Any:
+    from ..runtime.medusa import MedusaModel
+
+    app = _tiny_app(seed=0, seq_len=128)
+    medusa = MedusaModel(app, num_medusa_heads=4)
+    medusa.load_random_heads(seed=1)
+    ids = np.stack(_prompts((10, 10), seed=15))
+    medusa.generate(ids, max_new_tokens=6)
+    return medusa
+
+
+def _exercise_mm() -> Any:
+    """Multimodal prefill: a tiny random Llava (Pixtral vision + Mistral text).
+
+    Needs torch/transformers for the vision-side weights — callers treat an
+    ImportError as "scope unavailable", never as a pass.
+    """
+    import torch
+    from transformers import (LlavaConfig, LlavaForConditionalGeneration,
+                              MistralConfig, PixtralVisionConfig)
+
+    from ..config import TpuConfig, load_pretrained_config
+    from ..models.pixtral import PixtralForConditionalGeneration
+
+    vc = PixtralVisionConfig(hidden_size=32, intermediate_size=64,
+                             num_hidden_layers=2, num_attention_heads=2,
+                             image_size=16, patch_size=4, num_channels=3,
+                             rope_theta=10000.0, hidden_act="gelu")
+    tc = MistralConfig(vocab_size=256, hidden_size=48, intermediate_size=96,
+                       num_hidden_layers=2, num_attention_heads=4,
+                       num_key_value_heads=2, head_dim=12, sliding_window=None,
+                       rope_theta=10000.0, tie_word_embeddings=False)
+    cfg = LlavaConfig(vision_config=vc, text_config=tc, image_token_index=255,
+                      projector_hidden_act="gelu", vision_feature_layer=-1,
+                      vision_feature_select_strategy="full")
+    torch.manual_seed(0)
+    hf = LlavaForConditionalGeneration(cfg).eval()
+    tpu_cfg = TpuConfig(batch_size=2, seq_len=64, max_context_length=32,
+                        dtype="float32", context_encoding_buckets=[32],
+                        token_generation_buckets=[64])
+    config = PixtralForConditionalGeneration.get_config_cls()(
+        tpu_cfg, load_config=load_pretrained_config(cfg.to_dict()))
+    app = PixtralForConditionalGeneration(None, config)
+    state = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+    app._put_params(app.convert_hf_state_dict(state, app.config))
+    app.load_vision_from_state_dict(state)
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(1, 250, size=(2, 24)).astype(np.int32)
+    ids[:, 2:18] = 255                                    # 16 image tokens
+    pixels = rng.normal(size=(2, 3, 16, 16)).astype(np.float32)
+    app.generate(ids, max_new_tokens=2, pixel_values=pixels)
+    return app
+
+
+# scope name -> (exercise fn, kinds it must register+capture)
+SCOPES: Dict[str, Tuple] = {
+    "plain": (_exercise_plain,
+              ("plain.prefill", "plain.decode", "plain.window")),
+    "cb_dense": (lambda: _exercise_cb(False),
+                 ("cb.dense.insert", "cb.dense.decode", "cb.dense.window",
+                  "cb.dense.seed")),
+    "cb_paged": (lambda: _exercise_cb(True),
+                 ("cb.paged.insert", "cb.paged.insert_nol",
+                  "cb.paged.decode")),
+    "cb_mixed": (lambda: _exercise_cb(True, mixed=True),
+                 ("cb.paged.mixed",)),
+    "cb_spec": (_exercise_cb_spec, ("cb.spec.chunk", "cb.spec.insert_pair")),
+    "cb_eagle": (_exercise_cb_eagle, ("cb.eagle.insert", "cb.eagle.chunk")),
+    "spec": (_exercise_spec, ("spec.chunk",)),
+    "eagle": (_exercise_eagle, ("eagle.prefill", "eagle.chunk")),
+    "eagle3": (_exercise_eagle3, ("eagle3.prefill", "eagle3.chunk")),
+    "medusa": (_exercise_medusa,
+               ("medusa.prefill", "medusa.verify", "medusa.compact")),
+    "mm": (_exercise_mm, ("mm.prefill", "mm.encode")),
+}
+
+# every dispatch kind the full fleet exercises — DERIVED from SCOPES so the
+# two can never drift (the mm scope needs torch/transformers for the tiny
+# vision weights; the script skips it with a visible note when missing)
+FLEET_KINDS = tuple(k for _, kinds in SCOPES.values() for k in kinds)
+
+
+def build_fleet_units(scopes: Optional[Sequence[str]] = None,
+                      ) -> Tuple[List[AuditUnit], List[str]]:
+    """Exercise the requested scopes (default: all) and return
+    (units-to-audit, notes). A scope whose optional deps are missing is
+    reported in notes, not silently dropped."""
+    notes: List[str] = []
+    units: List[AuditUnit] = []
+    for name in (scopes if scopes is not None else SCOPES):
+        if name not in SCOPES:
+            raise ValueError(f"unknown scope {name!r} "
+                             f"(known: {sorted(SCOPES)})")
+        fn, kinds = SCOPES[name]
+        try:
+            # the returned runner/app keeps the registry's weakrefs alive
+            # until the units take their own strong dispatch references
+            keepalive = fn()
+        except ImportError as e:
+            notes.append(f"scope {name!r} skipped: missing dep ({e})")
+            continue
+        for kind in kinds:
+            units += _unit(kind)
+        del keepalive
+    return units, notes

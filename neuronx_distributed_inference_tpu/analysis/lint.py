@@ -1,0 +1,349 @@
+"""Repo-specific AST lint pass over the package.
+
+These are not style rules — each one encodes a serving invariant that grep or
+review keeps missing:
+
+``stray-print``     bare ``print(`` in library code (the CLI and env-gated
+                    ``# debug-ok`` prints excepted) — subsumes the old
+                    test_hygiene grep.
+``raw-jit``         a ``jax.jit`` call inside ``runtime/`` that never
+                    registers with the auditor (every serving dispatch must go
+                    through ``analysis.registry.audited_jit`` so its contract
+                    is machine-checked; one-shot utility jits carry an
+                    explicit waiver comment).
+``jit-no-donate``   a jitted function taking cache-named parameters
+                    (``cache``/``t_cache``/``d_cache``/``kv_cache``/...)
+                    whose donation does not cover them — the statically
+                    visible half of the "donation silently failed" bug.
+``tracer-branch``   a Python ``if`` on a (non-static) parameter of a traced
+                    function — a retrace/ConcretizationError landmine.
+``time-in-jit``     ``time.*`` inside a traced function (measures trace time
+                    once, then becomes a constant).
+``step-loop-sync``  ``.item()`` / ``.block_until_ready()`` /
+                    ``jax.device_get`` inside a ``@step_loop_body``-marked
+                    serving loop, or ``asarray`` conversions inside a
+                    per-row python loop there (hoist them — PR 2 measured
+                    per-window conversions at milliseconds per dispatch).
+
+Waive a line with ``# lint: ok(<rule>)`` or ``# lint: ok(<rule>): reason``
+(``# debug-ok`` keeps working for ``stray-print``). Waived findings are
+REPORTED with their reason — suppression is visible, never silent.
+"""
+
+from __future__ import annotations
+
+import ast
+import functools
+import os
+import re
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["LintFinding", "lint_package", "lint_paths", "lint_source",
+           "RULES", "PKG_ROOT"]
+
+RULES = ("stray-print", "raw-jit", "jit-no-donate", "tracer-branch",
+         "time-in-jit", "step-loop-sync")
+
+PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# files whose prints ARE the user interface
+_PRINT_ALLOWED = {"inference_demo.py"}
+_CACHE_PARAM_RE = re.compile(r"^(.*_)?cache$")
+_WAIVE_RE = re.compile(r"lint:\s*ok\(([\w, -]+)\)(?::\s*(.*?))?\s*(?:#|$)")
+
+
+@dataclass
+class LintFinding:
+    rule: str
+    path: str
+    line: int
+    msg: str
+    status: str = "fail"          # "fail" | "waived"
+    reason: str = ""
+
+    @property
+    def violating(self) -> bool:
+        return self.status == "fail"
+
+    def __str__(self) -> str:
+        tag = "" if self.status == "fail" else f" [waived: {self.reason}]"
+        return f"{self.path}:{self.line}: [{self.rule}] {self.msg}{tag}"
+
+
+def _dotted(node: ast.AST) -> str:
+    """'jax.jit' for Attribute chains, 'audited_jit' for Names."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _const_str_tuple(node: Optional[ast.AST]) -> Tuple[str, ...]:
+    if node is None:
+        return ()
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(e.value for e in node.elts
+                     if isinstance(e, ast.Constant)
+                     and isinstance(e.value, str))
+    return ()
+
+
+def _const_int_tuple(node: Optional[ast.AST]) -> Tuple[int, ...]:
+    if node is None:
+        return ()
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(e.value for e in node.elts
+                     if isinstance(e, ast.Constant)
+                     and isinstance(e.value, int))
+    return ()
+
+
+def _kw(call: ast.Call, name: str) -> Optional[ast.AST]:
+    for k in call.keywords:
+        if k.arg == name:
+            return k.value
+    return None
+
+
+class _ModuleLint:
+    def __init__(self, src: str, path: str, rel: str):
+        self.src = src
+        self.path = path
+        self.rel = rel                       # package-relative, '/'-separated
+        self.lines = src.splitlines()
+        self.tree = ast.parse(src)
+        self.findings: List[LintFinding] = []
+        # names that mean jax.jit in this module: the dotted form plus any
+        # `from jax import jit [as x]` / `import jax as j` alias — an
+        # alias-imported dispatch site must not evade the raw-jit growth gate
+        self.raw_jit_names = {"jax.jit"}
+        for n in ast.walk(self.tree):
+            if isinstance(n, ast.ImportFrom) and n.module == "jax":
+                for a in n.names:
+                    if a.name == "jit":
+                        self.raw_jit_names.add(a.asname or a.name)
+            elif isinstance(n, ast.Import):
+                for a in n.names:
+                    if a.name == "jax" and a.asname:
+                        self.raw_jit_names.add(f"{a.asname}.jit")
+        # every FunctionDef in the module, by name, ALL of them: local step
+        # bodies reuse names across builder methods (continuous_batching.py
+        # defines `_insert` three times), so a flat last-wins map would check
+        # the wrong body — resolution picks the nearest def above the call
+        self.fn_defs: Dict[str, List[ast.FunctionDef]] = {}
+        for n in ast.walk(self.tree):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.fn_defs.setdefault(n.name, []).append(n)
+
+    # ---- waiver / emit ---------------------------------------------------
+    def _line_waiver(self, lineno: int, rule: str) -> Optional[str]:
+        # a waiver holds on the flagged line itself or on a COMMENT-ONLY line
+        # directly above it (long call expressions push the comment onto its
+        # own line). A waiver trailing a code line must NOT bleed onto the
+        # next line — that would silently suppress an unwaived violation.
+        for ln in (lineno, lineno - 1):
+            line = self.lines[ln - 1] if 0 < ln <= len(self.lines) else ""
+            if ln != lineno and not line.lstrip().startswith("#"):
+                continue
+            if rule == "stray-print" and "debug-ok" in line:
+                m = re.search(r"debug-ok:?\s*(.*)", line)
+                return (m.group(1).strip() if m and m.group(1).strip()
+                        else "env-gated debug print")
+            m = _WAIVE_RE.search(line)
+            if m and rule in [r.strip() for r in m.group(1).split(",")]:
+                return m.group(2) or "waived at line"
+        return None
+
+    def emit(self, rule: str, node: ast.AST, msg: str) -> None:
+        reason = self._line_waiver(node.lineno, rule)
+        self.findings.append(LintFinding(
+            rule, self.rel, node.lineno, msg,
+            status="waived" if reason is not None else "fail",
+            reason=reason or ""))
+
+    # ---- rules -----------------------------------------------------------
+    def run(self) -> List[LintFinding]:
+        self._rule_print()
+        jit_calls = [n for n in ast.walk(self.tree)
+                     if isinstance(n, ast.Call)
+                     and (_dotted(n.func) in self.raw_jit_names
+                          or _dotted(n.func) in ("audited_jit",
+                                                 "registry.audited_jit"))]
+        traced: List[Tuple[ast.FunctionDef, Tuple[str, ...]]] = []
+        for call in jit_calls:
+            is_raw = _dotted(call.func) in self.raw_jit_names
+            if is_raw and self.rel.startswith("runtime/"):
+                self.emit("raw-jit", call,
+                          "jax.jit dispatch site never registers with the "
+                          "graph auditor (use analysis.registry.audited_jit)")
+            self._rule_no_donate(call, is_raw)
+            target = self._resolve_target(call)
+            if target is not None:
+                statics = _const_str_tuple(_kw(call, "static_argnames"))
+                traced.append((target, statics))
+        for fn, statics in traced:
+            self._rule_tracer_branch(fn, statics)
+            self._rule_time(fn)
+        for fn in (f for defs in self.fn_defs.values() for f in defs):
+            if any(_dotted(d).split(".")[-1] == "step_loop_body"
+                   for d in fn.decorator_list):
+                self._rule_step_loop(fn)
+        return self.findings
+
+    def _rule_print(self) -> None:
+        base = os.path.basename(self.path)
+        if base in _PRINT_ALLOWED:
+            return
+        for n in ast.walk(self.tree):
+            if (isinstance(n, ast.Call) and isinstance(n.func, ast.Name)
+                    and n.func.id == "print"):
+                self.emit("stray-print", n,
+                          "bare print( in library code — log through the "
+                          "tpu-inference logger or record telemetry")
+
+    def _resolve_target(self, call: ast.Call) -> Optional[ast.FunctionDef]:
+        if not (call.args and isinstance(call.args[0], ast.Name)):
+            return None
+        cands = self.fn_defs.get(call.args[0].id, [])
+        # the `def _step(...)` -> jit(_step) idiom binds the def lexically
+        # above the call: nearest preceding def wins (a same-named def further
+        # down belongs to a different builder scope)
+        prior = [f for f in cands if f.lineno <= call.lineno]
+        if prior:
+            return max(prior, key=lambda f: f.lineno)
+        return cands[0] if cands else None
+
+    def _rule_no_donate(self, call: ast.Call, is_raw: bool) -> None:
+        target = self._resolve_target(call)
+        if target is None:                    # cross-module target: can't see
+            return
+        params = [a.arg for a in target.args.posonlyargs + target.args.args]
+        cache_idx = [i for i, p in enumerate(params)
+                     if _CACHE_PARAM_RE.match(p)]
+        if not cache_idx:
+            return
+        if is_raw:
+            covered = set(_const_int_tuple(_kw(call, "donate_argnums")))
+            covered |= {params.index(nm) for nm in
+                        _const_str_tuple(_kw(call, "donate_argnames"))
+                        if nm in params}
+        else:
+            names = _const_str_tuple(_kw(call, "cache_args")) + \
+                _const_str_tuple(_kw(call, "donate_extra"))
+            covered = {params.index(nm) for nm in names if nm in params}
+        missing = [params[i] for i in cache_idx if i not in covered]
+        if missing:
+            self.emit("jit-no-donate", call,
+                      f"jitted {target.name}() takes cache-shaped "
+                      f"{missing} without donating them — the pool is "
+                      f"double-buffered (2x KV HBM)")
+
+    def _rule_tracer_branch(self, fn: ast.FunctionDef,
+                            statics: Tuple[str, ...]) -> None:
+        traced_params = {a.arg for a in fn.args.posonlyargs + fn.args.args
+                         + fn.args.kwonlyargs} - set(statics)
+        for sub in ast.walk(fn):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and sub is not fn:
+                traced_params |= {a.arg for a in
+                                  sub.args.posonlyargs + sub.args.args}
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.If):
+                continue
+            # `x is None` / `x is not None` is a static pytree-shape branch
+            if isinstance(node.test, ast.Compare) and all(
+                    isinstance(op, (ast.Is, ast.IsNot))
+                    for op in node.test.ops):
+                continue
+            names = {n.id for n in ast.walk(node.test)
+                     if isinstance(n, ast.Name)}
+            hot = sorted(names & traced_params)
+            if hot:
+                self.emit("tracer-branch", node,
+                          f"python `if` on tracer-typed {hot} inside traced "
+                          f"{fn.name}() — use lax.cond/jnp.where or declare "
+                          f"it static")
+
+    def _rule_time(self, fn: ast.FunctionDef) -> None:
+        for node in ast.walk(fn):
+            if (isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "time"):
+                self.emit("time-in-jit", node,
+                          f"time.{node.attr} inside traced {fn.name}() — "
+                          f"evaluates once at trace time")
+
+    def _rule_step_loop(self, fn: ast.FunctionDef) -> None:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and isinstance(node.func,
+                                                         ast.Attribute):
+                if node.func.attr in ("item", "block_until_ready"):
+                    self.emit("step-loop-sync", node,
+                              f".{node.func.attr}() host sync inside "
+                              f"step-loop body {fn.name}()")
+                elif _dotted(node.func) == "jax.device_get":
+                    self.emit("step-loop-sync", node,
+                              f"jax.device_get inside step-loop body "
+                              f"{fn.name}()")
+        seen = set()          # nested loops re-walk inner bodies: one finding
+        for loop in ast.walk(fn):
+            if not isinstance(loop, (ast.For, ast.While)):
+                continue
+            for node in ast.walk(loop):
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "asarray"
+                        and (node.lineno, node.col_offset) not in seen):
+                    seen.add((node.lineno, node.col_offset))
+                    self.emit("step-loop-sync", node,
+                              f"per-row asarray conversion inside a python "
+                              f"loop in step-loop body {fn.name}() — hoist "
+                              f"to one batched conversion per dispatch")
+
+
+def lint_source(src: str, rel: str = "<memory>.py") -> List[LintFinding]:
+    """Lint one source string (test hook)."""
+    return _ModuleLint(src, rel, rel).run()
+
+
+def lint_paths(paths: Sequence[str], root: str = PKG_ROOT
+               ) -> List[LintFinding]:
+    findings: List[LintFinding] = []
+    for path in paths:
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        with open(path) as fh:
+            src = fh.read()
+        try:
+            findings += _ModuleLint(src, path, rel).run()
+        except SyntaxError as e:
+            findings.append(LintFinding("parse", rel, e.lineno or 0, str(e)))
+    return findings
+
+
+def package_files(root: str = PKG_ROOT) -> List[str]:
+    out = []
+    for dirpath, dirnames, files in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        out += [os.path.join(dirpath, f) for f in sorted(files)
+                if f.endswith(".py")]
+    return sorted(out)
+
+
+@functools.lru_cache(maxsize=4)
+def _lint_package_cached(root: str) -> Tuple[LintFinding, ...]:
+    return tuple(lint_paths(package_files(root), root))
+
+
+def lint_package(root: str = PKG_ROOT) -> List[LintFinding]:
+    """Lint the whole package. Cached per root for the lifetime of the
+    process (three tier-1 tests walk the package; source does not change
+    mid-session) — `_lint_package_cached.cache_clear()` if it ever does."""
+    return list(_lint_package_cached(root))

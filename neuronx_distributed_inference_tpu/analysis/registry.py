@@ -1,0 +1,190 @@
+"""Dispatch registry: ``audited_jit`` — the registered replacement for raw
+``jax.jit`` at serving dispatch sites.
+
+Why a wrapper instead of a convention: the two invariants that rot silently
+are (a) ``donate_argnums`` drifting out of sync with the cache parameters as
+signatures grow (donation that fails to alias doubles KV HBM with no error)
+and (b) new dispatch sites never being audited at all. ``audited_jit`` kills
+both by construction — donation is DERIVED from the declared cache parameter
+NAMES, and registration is a side effect of building the step, so the auditor
+(and the ``raw-jit`` lint rule) can see every site.
+
+The wrapper captures the first real call's argument shapes/dtypes as
+``jax.ShapeDtypeStruct`` specs (one ``is None`` check per call afterwards —
+nothing on the hot path), which is exactly what the auditor needs to re-lower
+the dispatch offline. Fixtures may also inject specs via ``set_example``.
+"""
+
+from __future__ import annotations
+
+import inspect
+import weakref
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+
+from .contracts import DispatchContract
+
+__all__ = ["audited_jit", "register_external", "step_loop_body",
+           "live_dispatches", "find", "clear"]
+
+# weakrefs: dispatches die with their runner; the registry must not keep every
+# runner a test session ever built alive
+_REGISTRY: list = []
+
+
+def _prune() -> None:
+    _REGISTRY[:] = [r for r in _REGISTRY if r() is not None]
+
+
+def _register(dispatch: "AuditedDispatch") -> None:
+    if len(_REGISTRY) % 64 == 63:
+        _prune()
+    _REGISTRY.append(weakref.ref(dispatch))
+
+
+def live_dispatches() -> Dict[str, "AuditedDispatch"]:
+    """kind -> newest live dispatch of that kind."""
+    out: Dict[str, AuditedDispatch] = {}
+    for ref in _REGISTRY:          # registration order: later wins
+        d = ref()
+        if d is not None:
+            out[d.contract.kind] = d
+    return out
+
+
+def find(kind: str) -> Optional["AuditedDispatch"]:
+    return live_dispatches().get(kind)
+
+
+def clear() -> None:
+    _REGISTRY.clear()
+
+
+def _spec_of(x):
+    if hasattr(x, "shape") and hasattr(x, "dtype"):
+        return jax.ShapeDtypeStruct(tuple(x.shape), x.dtype)
+    return x
+
+
+class AuditedDispatch:
+    """A jitted serving dispatch + its contract + a captured example."""
+
+    def __init__(self, fn, contract: DispatchContract, jitted,
+                 static_argnames: Tuple[str, ...] = ()) -> None:
+        self.contract = contract
+        self.fn = fn
+        self._jit = jitted
+        self.static_argnames = tuple(static_argnames)
+        self.example: Optional[Tuple[tuple, dict]] = None
+        _register(self)
+
+    # ---- call path -------------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        if self.example is None:
+            self.set_example(*args, **kwargs)
+        return self._jit(*args, **kwargs)
+
+    def set_example(self, *args, **kwargs) -> None:
+        """Record abstract arg specs for offline lowering (arrays become
+        ShapeDtypeStructs; static python values pass through verbatim)."""
+        self.example = (jax.tree_util.tree_map(_spec_of, args),
+                        {k: jax.tree_util.tree_map(_spec_of, v)
+                         for k, v in kwargs.items()})
+
+    # ---- audit surface ---------------------------------------------------
+    def lower(self, *args, **kwargs):
+        return self._jit.lower(*args, **kwargs)
+
+    def lower_example(self, **overrides):
+        """Lower from the captured example; ``overrides`` replace keyword args
+        (e.g. a different static ``num_steps``) before lowering."""
+        if self.example is None:
+            raise RuntimeError(
+                f"dispatch {self.contract.kind!r} has no captured example — "
+                f"run it once (or set_example) before auditing")
+        args, kwargs = self.example
+        kwargs = dict(kwargs, **overrides)
+        return self._jit.lower(*args, **kwargs)
+
+    def static_value(self, name: str, default=None):
+        """Captured value of a (static) argument, by name."""
+        if self.example is None:
+            return default
+        args, kwargs = self.example
+        if name in kwargs:
+            return kwargs[name]
+        try:
+            bound = inspect.signature(self.fn).bind_partial(*args, **kwargs)
+            return bound.arguments.get(name, default)
+        except TypeError:
+            return default
+
+    def __getattr__(self, name: str) -> Any:
+        # anything else (trace, eval_shape, ...) behaves like the raw jit
+        return getattr(self._jit, name)
+
+
+def _param_indices(fn, names: Tuple[str, ...], kind: str) -> Tuple[int, ...]:
+    params = list(inspect.signature(fn).parameters)
+    out = []
+    for n in names:
+        if n not in params:
+            raise ValueError(f"audited_jit({kind!r}): declared arg {n!r} not "
+                             f"in {fn.__name__} signature {params}")
+        out.append(params.index(n))
+    return tuple(out)
+
+
+def audited_jit(fn, *, kind: str, cache_args: Tuple[str, ...] = (),
+                donate_extra: Tuple[str, ...] = (),
+                static_argnames: Tuple[str, ...] = (),
+                steps_arg: Optional[str] = None,
+                waivers: Optional[Dict[str, str]] = None,
+                **contract_kw) -> AuditedDispatch:
+    """``jax.jit`` + contract registration for a serving dispatch.
+
+    ``cache_args``/``donate_extra`` are parameter NAMES; donation indices are
+    derived from the signature, so they cannot be mis-indexed. Remaining
+    ``contract_kw`` forward to :class:`DispatchContract` (host_sync_free,
+    fp32_accum, collectives, hbm_bytes, ...).
+    """
+    contract = DispatchContract(
+        kind=kind, cache_args=tuple(cache_args),
+        donate_extra=tuple(donate_extra), steps_arg=steps_arg,
+        waivers=dict(waivers or {}), **contract_kw)
+    donate = (_param_indices(fn, contract.cache_args, kind)
+              + _param_indices(fn, contract.donate_extra, kind))
+    # keep_unused=True: jit drops unused args from the lowered module by
+    # default, which would break the auditor's example-leaf -> lowered-arg
+    # index mapping. Serving dispatches use every arg, so this is free.
+    jit_kw: Dict[str, Any] = {"keep_unused": True}
+    if donate:
+        jit_kw["donate_argnums"] = donate
+    if static_argnames:
+        jit_kw["static_argnames"] = tuple(static_argnames)
+    return AuditedDispatch(fn, contract, jax.jit(fn, **jit_kw),
+                           static_argnames=tuple(static_argnames))
+
+
+def register_external(jitted, fn, contract: DispatchContract,
+                      static_argnames: Tuple[str, ...] = ()
+                      ) -> AuditedDispatch:
+    """Wrap an ALREADY-jitted callable (donation as the caller made it) —
+    for fixtures that deliberately model a legacy/broken site, and for
+    family-owned jits that cannot flow through ``audited_jit``."""
+    return AuditedDispatch(fn, contract, jitted,
+                           static_argnames=tuple(static_argnames))
+
+
+def step_loop_body(fn):
+    """No-op marker for host-side serving step-loop bodies.
+
+    The lint pass (analysis/lint.py) resolves this decorator STATICALLY and
+    holds the marked function to the step-loop discipline: no ``.item()`` /
+    ``block_until_ready()`` host syncs, and no per-row ``asarray`` conversion
+    loops (hoist them — PR 2 measured the per-window conversions at
+    milliseconds per dispatch).
+    """
+    fn.__step_loop_body__ = True
+    return fn

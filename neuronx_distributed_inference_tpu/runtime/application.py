@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..analysis.registry import audited_jit
 from ..config import InferenceConfig, OnDeviceSamplingConfig, TpuConfig
 from ..modules import autobucketing, kvcache
 from ..models import base as model_base
@@ -291,12 +292,16 @@ class TpuModelForCausalLM:
                                        window_row=window_row)
             return cache
 
-        self._prefill_step = jax.jit(_prefill, donate_argnums=(4,))
-        self._decode_step = jax.jit(
-            _decode, donate_argnums=(3,),
-            static_argnames=("decode_bucket", "num_steps", "with_logits", "greedy"))
-        self._window_step = jax.jit(_window, donate_argnums=(4,),
-                                    static_argnames=("decode_bucket",))
+        self._prefill_step = audited_jit(
+            _prefill, kind="plain.prefill", cache_args=("cache",))
+        self._decode_step = audited_jit(
+            _decode, kind="plain.decode", cache_args=("cache",),
+            static_argnames=("decode_bucket", "num_steps", "with_logits",
+                             "greedy"),
+            steps_arg="num_steps")
+        self._window_step = audited_jit(
+            _window, kind="plain.window", cache_args=("cache",),
+            static_argnames=("decode_bucket",))
 
     def _use_ring_attention(self) -> bool:
         """Context-parallel (ring attention) prefill when the mesh has a cp axis.
@@ -720,6 +725,8 @@ class TpuModelForCausalLM:
                 absmax.append(jnp.max(jnp.where(valid, x, 0.0), axis=(1, 3, 4)))
             return absmax[0], absmax[1]
 
+        # one-shot calibration over a throwaway local cache — not a serving
+        # dispatch  # lint: ok(raw-jit, jit-no-donate): one-shot, cache discarded
         k_max, v_max = jax.jit(_cal)(
             self.params, padded.input_ids, padded.position_ids,
             padded.last_token_idx, cache)
@@ -813,6 +820,8 @@ class TpuModelForCausalLM:
                                                  adapter_ids=adapters)
                 return logits, st.captured
 
+        # debug tap path: compiles per call, cache reset right after
+        # lint: ok(raw-jit, jit-no-donate): debug capture path, not serving
         logits, captured = jax.jit(fn)(
             self.params, padded.input_ids, padded.position_ids,
             padded.last_token_idx, self.kv_cache, adapter_ids)

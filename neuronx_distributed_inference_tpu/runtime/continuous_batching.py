@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..analysis.registry import audited_jit, step_loop_body
 from ..models import base as model_base
 from ..modules import autobucketing, block_kvcache
 from ..ops import sampling as sampling_ops
@@ -562,11 +563,16 @@ class ContinuousBatchingRunner:
                     (keys, slots_t))
                 return toks.T, (tok_l, pos_l, alive_l, budget_l), cache
 
-            self._insert_step = jax.jit(_insert, donate_argnums=(4,))
-            self._insert_step_nol = (jax.jit(_insert_nol, donate_argnums=(3,))
-                                     if base_decode else None)
-            self._decode_step = jax.jit(_decode, donate_argnums=(5,),
-                                        static_argnames=("num_steps", "greedy"))
+            self._insert_step = audited_jit(
+                _insert, kind="cb.paged.insert", cache_args=("cache",))
+            self._insert_step_nol = (
+                audited_jit(_insert_nol, kind="cb.paged.insert_nol",
+                            cache_args=("cache",))
+                if base_decode else None)
+            self._decode_step = audited_jit(
+                _decode, kind="cb.paged.decode", cache_args=("cache",),
+                static_argnames=("num_steps", "greedy"),
+                steps_arg="num_steps")
 
             if self.mixed:
                 def _mixed(params, tok0, positions, cache, block_table,
@@ -628,9 +634,10 @@ class ContinuousBatchingRunner:
                         body, (tok0, positions, cache), (keys, slots_t))
                     return toks.T, chunk_tok, cache
 
-                self._mixed_step = jax.jit(
-                    _mixed, donate_argnums=(3,),
-                    static_argnames=("num_steps", "greedy"))
+                self._mixed_step = audited_jit(
+                    _mixed, kind="cb.paged.mixed", cache_args=("cache",),
+                    static_argnames=("num_steps", "greedy"),
+                    steps_arg="num_steps")
         else:
             # thread the app's prefill strategy (ring for cp>1, Pallas flash, or
             # dense attend) into insert-time context encoding; decode chunks take
@@ -712,14 +719,18 @@ class ContinuousBatchingRunner:
                                           odsc, mesh=mesh, rules=rules)
                 return out, cache
 
-            self._insert_step = jax.jit(_insert, donate_argnums=(4,))
-            self._decode_step = jax.jit(
-                _decode, donate_argnums=(5,),
-                static_argnames=("decode_bucket", "num_steps", "greedy"))
-            self._window_step = jax.jit(_window, donate_argnums=(4,),
-                                        static_argnames=("decode_bucket",))
-            self._seed_step = jax.jit(_seed, donate_argnums=(4,),
-                                      static_argnames=("decode_bucket",))
+            self._insert_step = audited_jit(
+                _insert, kind="cb.dense.insert", cache_args=("cache",))
+            self._decode_step = audited_jit(
+                _decode, kind="cb.dense.decode", cache_args=("cache",),
+                static_argnames=("decode_bucket", "num_steps", "greedy"),
+                steps_arg="num_steps")
+            self._window_step = audited_jit(
+                _window, kind="cb.dense.window", cache_args=("cache",),
+                static_argnames=("decode_bucket",))
+            self._seed_step = audited_jit(
+                _seed, kind="cb.dense.seed", cache_args=("cache",),
+                static_argnames=("decode_bucket",))
 
         if self.draft is not None:
             self._build_spec_steps()
@@ -775,7 +786,9 @@ class ContinuousBatchingRunner:
                     h_full, last_token_idx[:, None, None], axis=1)[:, 0]
             return tok, h_last, t_cache, d_cache
 
-        self._insert_step_eagle = jax.jit(_insert_eagle, donate_argnums=(5, 6))
+        self._insert_step_eagle = audited_jit(
+            _insert_eagle, kind="cb.eagle.insert",
+            cache_args=("t_cache", "d_cache"))
 
         def _eagle_chunk(t_params, d_params, tok0, h0, positions, alive0,
                          t_cache, d_cache, block_table, eos_ids, key,
@@ -842,9 +855,10 @@ class ContinuousBatchingRunner:
                 None, length=num_iters)
             return outs, ns, h_out, t_cache, d_cache
 
-        self._spec_step_eagle = jax.jit(
-            _eagle_chunk, donate_argnums=(6, 7),
-            static_argnames=("num_iters",))
+        self._spec_step_eagle = audited_jit(
+            _eagle_chunk, kind="cb.eagle.chunk",
+            cache_args=("t_cache", "d_cache"),
+            static_argnames=("num_iters",), steps_arg="num_iters")
 
     def _build_spec_steps(self) -> None:
         """Fused-speculation serving chunks: per dispatch, ``num_iters`` on-device
@@ -976,9 +990,11 @@ class ContinuousBatchingRunner:
                 one_iter, (tok0, positions, alive0, t_cache, d_cache), iter_keys)
             return outs, ns, t_cache, d_cache
 
-        self._spec_step = jax.jit(
-            _spec_chunk, donate_argnums=(5, 6),
-            static_argnames=("num_iters", "greedy", "decode_bucket"))
+        self._spec_step = audited_jit(
+            _spec_chunk, kind="cb.spec.chunk",
+            cache_args=("t_cache", "d_cache"),
+            static_argnames=("num_iters", "greedy", "decode_bucket"),
+            steps_arg="num_iters")
 
         if paged:
             t_base = t_decode is model_base.decode_forward
@@ -1019,9 +1035,10 @@ class ContinuousBatchingRunner:
                         slot_mapping=slot_mapping, **d_skip)
                 return tok, t_cache, d_cache
 
-            self._insert_pair_step = jax.jit(_insert_pair,
-                                             donate_argnums=(5, 6),
-                                             static_argnames=("final",))
+            self._insert_pair_step = audited_jit(
+                _insert_pair, kind="cb.spec.insert_pair",
+                cache_args=("t_cache", "d_cache"),
+                static_argnames=("final",))
         else:
             d_prefill = draft.prefill_fn()
             use_ring = draft._use_ring_attention()
@@ -1037,7 +1054,8 @@ class ContinuousBatchingRunner:
                         use_ring=use_ring)
                 return cache
 
-            self._d_insert_step = jax.jit(_d_insert, donate_argnums=(4,))
+            self._d_insert_step = audited_jit(
+                _d_insert, kind="cb.spec.d_insert", cache_args=("cache",))
 
     # ------------------------------------------------ telemetry (utils/metrics)
     # The runner's historical ad-hoc counters live on the metrics registry
@@ -1369,6 +1387,7 @@ class ContinuousBatchingRunner:
             self.telemetry.set_queue_depth(len(self.queue))
         return emitted
 
+    @step_loop_body
     def _step_plain(self, key, emitted: Dict[int, List[int]]
                     ) -> Dict[int, List[int]]:
         """One plain (non-speculative) decode chunk for every slot. Also the
@@ -1457,6 +1476,9 @@ class ContinuousBatchingRunner:
             self._dev_state = dev_state
             while len(self._inflight) > self.async_depth:
                 toks, st = self._inflight.pop(0)
+                # committing the OLDEST in-flight chunk is the one designed
+                # host sync of dispatch-ahead
+                # lint: ok(step-loop-sync): oldest-chunk commit, the designed sync
                 self._commit(np.asarray(toks), st, emitted)
             self._m_inflight.set(len(self._inflight))
         else:
@@ -1512,6 +1534,7 @@ class ContinuousBatchingRunner:
             1e3 * self._round_trip_s, 1e3 * chunk_s,
             "dispatch-ahead ON" if self.async_mode else "sync")
 
+    @step_loop_body
     def _step_mixed(self, key, emitted: Dict[int, List[int]]
                     ) -> Dict[int, List[int]]:
         """One MIXED prefill+decode serving step (the token-budget scheduler).
@@ -1655,6 +1678,7 @@ class ContinuousBatchingRunner:
                                           sum(w for _, w in chosen)))
         return emitted
 
+    @step_loop_body
     def _step_spec(self, key, emitted: Dict[int, List[int]]
                    ) -> Dict[int, List[int]]:
         """One fused-speculation serving dispatch: ``spec_chunk`` on-device

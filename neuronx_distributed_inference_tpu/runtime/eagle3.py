@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..analysis.registry import audited_jit
 from ..models import base as model_base
 from ..models import eagle as eagle_lib
 from ..models.base import ModelArchArgs
@@ -296,10 +297,12 @@ class Eagle3SpeculativeModel:
                 None, length=num_iters)
             return outs, ns, g_out, t_cache, d_cache
 
-        self._prefill_step = jax.jit(_prefill, donate_argnums=(5, 6))
-        self._spec_chunk = jax.jit(_chunk, donate_argnums=(6, 7),
-                                   static_argnames=("decode_bucket",
-                                                    "num_iters"))
+        self._prefill_step = audited_jit(
+            _prefill, kind="eagle3.prefill", cache_args=("t_cache", "d_cache"))
+        self._spec_chunk = audited_jit(
+            _chunk, kind="eagle3.chunk", cache_args=("t_cache", "d_cache"),
+            static_argnames=("decode_bucket", "num_iters"),
+            steps_arg="num_iters")
 
     # ------------------------------------------------------------------ generate
     def generate(

@@ -24,6 +24,7 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import numpy as np
 
+from ..analysis.registry import audited_jit
 from ..config import InferenceConfig
 from .application import GenerateOutput, TpuModelForCausalLM
 
@@ -66,7 +67,10 @@ class TpuModelForImageToText(TpuModelForCausalLM):
     def __init__(self, model_path, config, mesh=None):
         super().__init__(model_path, config, mesh=mesh)
         self.vision_params = None
-        self._encode_step = jax.jit(self.vision_encode_fn())
+        # serving dispatch (the vision tower runs per request): registered so
+        # the auditor can prove it callback-free like the text-side steps
+        self._encode_step = audited_jit(self.vision_encode_fn(),
+                                        kind="mm.encode")
         self._mm_prefill_step = self._build_mm_prefill()
 
     # --- per-family hooks -------------------------------------------------------------
@@ -137,7 +141,8 @@ class TpuModelForImageToText(TpuModelForCausalLM):
                 tokens = sampling_ops.sample(logits, sampling_params, key, odsc)
             return tokens, logits, cache
 
-        return jax.jit(_prefill_mm, donate_argnums=(4,))
+        return audited_jit(_prefill_mm, kind="mm.prefill",
+                           cache_args=("cache",))
 
     def encode_images(self, pixel_values: np.ndarray) -> np.ndarray:
         """(N_images, C, H, W) -> (N_images, T_img, H_text) via the jitted encoder."""

@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..analysis.registry import audited_jit
 from ..models import base as model_base
 from ..modules import autobucketing, kvcache
 from ..modules.token_tree import DEFAULT_TREE_PATHS, TokenTree
@@ -142,11 +143,14 @@ class MedusaModel:
                 topk = _head_topk(medusa_params, h, kb)                    # (B,N,M,kb)
             return target, topk, cache
 
-        self._prefill_step = jax.jit(_prefill, donate_argnums=(5,))
-        self._verify_step = jax.jit(_verify, donate_argnums=(4,),
-                                    static_argnames=("decode_bucket",))
-        self._compact_step = jax.jit(kvcache.compact_decode_slots,
-                                     donate_argnums=(0,))
+        self._prefill_step = audited_jit(
+            _prefill, kind="medusa.prefill", cache_args=("cache",))
+        self._verify_step = audited_jit(
+            _verify, kind="medusa.verify", cache_args=("cache",),
+            static_argnames=("decode_bucket",))
+        self._compact_step = audited_jit(
+            kvcache.compact_decode_slots, kind="medusa.compact",
+            cache_args=("cache",))
 
     # ------------------------------------------------------------------ generate
     def generate(

@@ -41,6 +41,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..analysis.registry import audited_jit
 from ..config import OnDeviceSamplingConfig
 from ..models import base as model_base
 from ..modules import autobucketing
@@ -408,9 +409,11 @@ class FusedSpeculativeModel:
                 iter_keys)
             return ys, t_cache, d_cache
 
-        self._spec_chunk = jax.jit(
-            _chunk, donate_argnums=(5, 6),
-            static_argnames=("decode_bucket", "num_iters", "with_draft_logits"))
+        self._spec_chunk = audited_jit(
+            _chunk, kind="spec.chunk", cache_args=("t_cache", "d_cache"),
+            static_argnames=("decode_bucket", "num_iters",
+                             "with_draft_logits"),
+            steps_arg="num_iters")
 
     # ------------------------------------------------------------------ generate
     def generate(

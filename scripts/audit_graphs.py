@@ -1,0 +1,178 @@
+#!/usr/bin/env python
+"""Graph-contract audit driver: lint the package, exercise the serving fleet,
+statically verify every registered dispatch, and emit a JSON report.
+
+Exit code 0 iff no unwaived violation — wire it as a CI gate or pre-commit
+hook. Waived findings are printed (suppression is visible, never silent).
+
+Usage:
+    python scripts/audit_graphs.py                      # full fleet + lint
+    python scripts/audit_graphs.py --scopes cb_paged spec
+    python scripts/audit_graphs.py --lint-only          # AST pass only (fast)
+    python scripts/audit_graphs.py --changed            # pre-commit fast mode:
+                                                        #   lint changed files,
+                                                        #   audit touched scopes
+    python scripts/audit_graphs.py --canaries           # also run the pinned
+                                                        #   byte/collective
+                                                        #   budget canaries
+    python scripts/audit_graphs.py -o report.json
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import _tpu_test_bootstrap  # noqa: F401,E402  (side effect: 8-device CPU mesh)
+
+from neuronx_distributed_inference_tpu.analysis import lint  # noqa: E402
+
+# which audit scopes a changed runtime file invalidates (--changed mode).
+# Scopes must cover DEPENDENTS, not just the file's own dispatches:
+# application.py is absent on purpose — every engine owns or subclasses
+# TpuModelForCausalLM, so touching it re-runs the whole fleet (unmapped →
+# broad); speculation.py's accept/commit helpers are imported by the CB
+# runner and every spec-family engine; eagle.py's draft_args_from_target
+# builds the eagle3 scope's draft.
+_FILE_SCOPES = {
+    "runtime/continuous_batching.py": ["cb_dense", "cb_paged", "cb_mixed",
+                                       "cb_spec", "cb_eagle"],
+    "runtime/speculation.py": ["spec", "cb_spec", "cb_eagle", "eagle",
+                               "eagle3", "medusa"],
+    "runtime/eagle.py": ["eagle", "cb_eagle", "eagle3"],
+    "runtime/eagle3.py": ["eagle3"],
+    "runtime/medusa.py": ["medusa"],
+    "runtime/image_to_text.py": ["mm"],
+}
+# any other package .py change (application.py, models/modules/ops/parallel/
+# analysis/config/utils/new files) re-runs the whole fleet — see
+# _scopes_for_changes
+
+
+def _changed_files():
+    out = subprocess.run(
+        ["git", "diff", "--name-only", "HEAD"],
+        cwd=REPO, capture_output=True, text=True, check=False).stdout
+    staged = subprocess.run(
+        ["git", "diff", "--name-only", "--cached", "HEAD"],
+        cwd=REPO, capture_output=True, text=True, check=False).stdout
+    untracked = subprocess.run(
+        ["git", "ls-files", "--others", "--exclude-standard"],
+        cwd=REPO, capture_output=True, text=True, check=False).stdout
+    return sorted({f for f in (out + staged + untracked).splitlines()
+                   if f.strip()})
+
+
+def _scopes_for_changes(files):
+    """None = run the whole fleet. Fail CLOSED: any package .py change that
+    is not specifically mapped to scopes (config.py, utils/, a brand-new
+    runtime module, ...) re-runs everything — an unmapped file must widen the
+    audit, never shrink it."""
+    pkg = "neuronx_distributed_inference_tpu/"
+    scopes = set()
+    broad = False
+    for f in files:
+        if not f.startswith(pkg) or not f.endswith(".py"):
+            continue
+        rel = f[len(pkg):]
+        if rel in _FILE_SCOPES:
+            scopes.update(_FILE_SCOPES[rel])
+        else:
+            broad = True
+    return None if broad else sorted(scopes)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--scopes", nargs="*", default=None,
+                    help="fleet scopes to audit (default: all)")
+    ap.add_argument("--lint-only", action="store_true",
+                    help="run only the AST lint pass")
+    ap.add_argument("--changed", action="store_true",
+                    help="fast pre-commit mode: lint only files changed vs "
+                         "HEAD, audit only the scopes those files touch")
+    ap.add_argument("--canaries", action="store_true",
+                    help="also run the geometry-pinned byte/collective "
+                         "budget canaries (slower)")
+    ap.add_argument("-o", "--out", default=None,
+                    help="write the JSON report here (default: stdout only)")
+    args = ap.parse_args(argv)
+
+    report = {"lint": [], "graph": None, "canaries": None, "notes": []}
+    failed = False
+
+    # ---- lint pass ---------------------------------------------------------
+    # one snapshot of the changed-file list: lint and scope selection must
+    # agree even if the worktree moves under us
+    changed = _changed_files() if args.changed else []
+    if args.changed:
+        pkg_files = [os.path.join(REPO, f) for f in changed
+                     if f.startswith("neuronx_distributed_inference_tpu/")
+                     and f.endswith(".py") and os.path.exists(
+                         os.path.join(REPO, f))]
+        findings = lint.lint_paths(pkg_files) if pkg_files else []
+        report["notes"].append(f"--changed: linted {len(pkg_files)} files")
+    else:
+        findings = lint.lint_package()
+    report["lint"] = [
+        {"rule": f.rule, "path": f.path, "line": f.line, "msg": f.msg,
+         "status": f.status, "reason": f.reason} for f in findings]
+    for f in findings:
+        print(("FAIL " if f.violating else "ok   ") + str(f))
+        failed |= f.violating
+
+    # ---- graph audit -------------------------------------------------------
+    scopes = args.scopes
+    if args.changed and scopes is None:
+        scopes = _scopes_for_changes(changed)
+        report["notes"].append(f"--changed: auditing scopes {scopes}")
+    if not args.lint_only and scopes != []:
+        from neuronx_distributed_inference_tpu.analysis import harness
+        from neuronx_distributed_inference_tpu.analysis.auditor import audit
+
+        units, notes = harness.build_fleet_units(scopes)
+        report["notes"] += notes
+        rep = audit(units)
+        report["graph"] = rep.to_dict()
+        for f in rep.findings:
+            if f.status in ("pass", "skipped"):
+                continue
+            tag = "FAIL " if f.violating else "ok   "
+            print(f"{tag}{f.unit}: [{f.check}] {f.status} {f.detail}")
+        for name in sorted(rep.measurements):
+            m = rep.measurements[name]
+            print(f"meas {name}: {m.bytes_per_step:.3g} B/step over "
+                  f"{m.steps} steps, collectives={m.collective_counts}")
+        failed |= not rep.ok
+
+    # ---- pinned canaries ---------------------------------------------------
+    if args.canaries and not args.lint_only:
+        from neuronx_distributed_inference_tpu.analysis import canaries
+        from neuronx_distributed_inference_tpu.analysis.auditor import audit
+
+        crep = audit(*canaries.build_canary_units())
+        canaries.clear_caches()           # reports are data; drop the fleets
+        report["canaries"] = crep.to_dict()
+        for f in crep.findings:
+            if f.status in ("pass", "skipped"):
+                continue
+            tag = "FAIL " if f.violating else "ok   "
+            print(f"{tag}{f.unit}: [{f.check}] {f.status} {f.detail}")
+        failed |= not crep.ok
+
+    for note in report["notes"]:
+        print("note:", note)
+    if args.out:
+        with open(args.out, "w") as fh:
+            json.dump(report, fh, indent=2)
+        print("report written to", args.out)
+    print("AUDIT", "FAILED" if failed else "OK")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,357 @@
+"""Graph-contract auditor: known-bad fixtures every checker must flag, the
+waiver mechanics, and a fast real-dispatch audit (plain + paged CB scopes).
+
+The fixtures are the auditor's own regression suite: each one is the smallest
+compiled graph that EXHIBITS one contract violation — a non-donated cache, a
+donation jax could not alias, a host callback smuggled into a step fn, a
+silently upcast pool, an extra all-reduce, a blown byte budget. If a checker
+stops failing its fixture, that invariant is no longer machine-checked.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from neuronx_distributed_inference_tpu.analysis import registry
+from neuronx_distributed_inference_tpu.analysis.auditor import (AuditUnit,
+                                                                audit)
+from neuronx_distributed_inference_tpu.analysis.contracts import (
+    DispatchContract, absolute_rule, ratio_rule)
+from neuronx_distributed_inference_tpu.analysis.registry import (
+    audited_jit, register_external)
+
+pytestmark = pytest.mark.contracts
+
+
+def _cache(n=256):
+    return {"k": jnp.zeros((2, n), jnp.bfloat16),
+            "v": jnp.zeros((2, n), jnp.bfloat16)}
+
+
+def _status(report, check, unit=None):
+    for f in report.findings:
+        if f.check == check and (unit is None or f.unit == unit):
+            return f.status, f.detail
+    raise AssertionError(f"no {check!r} finding in {report.findings}")
+
+
+def _audit_one(dispatch, name="fx", contract=None):
+    return audit([AuditUnit(name, dispatch, contract=contract)])
+
+
+# ------------------------------------------------------------------ clean pass
+def test_clean_fixture_passes_every_check():
+    def _step(params, tok, cache):
+        h = jnp.dot(params, tok.astype(params.dtype),
+                    preferred_element_type=jnp.float32)
+        cache = {k: v + 1 for k, v in cache.items()}
+        return h.astype(params.dtype), cache
+
+    d = audited_jit(_step, kind="fx.clean", cache_args=("cache",),
+                    fp32_accum=True)
+    d(jnp.zeros((8, 8), jnp.bfloat16), jnp.zeros((8, 2), jnp.int32), _cache())
+    rep = _audit_one(d)
+    assert rep.ok, rep.findings
+    assert _status(rep, "aliasing")[0] == "pass"
+    assert _status(rep, "host_sync")[0] == "pass"
+    assert _status(rep, "dtypes")[0] == "pass"
+    assert _status(rep, "upcast")[0] == "pass"
+
+
+# ------------------------------------------------------------------ known-bad
+def test_non_donated_cache_flagged():
+    """The legacy-site disaster: a cache-carrying step that never donates —
+    the pool is silently double-buffered."""
+
+    def _step(params, cache):
+        return {k: v + params for k, v in cache.items()}
+
+    d = register_external(
+        jax.jit(_step, keep_unused=True), _step,
+        DispatchContract(kind="fx.nodonate", cache_args=("cache",)))
+    d.set_example(jnp.ones((), jnp.bfloat16), _cache())
+    rep = _audit_one(d)
+    status, detail = _status(rep, "aliasing")
+    assert status == "fail" and "NOT donated" in detail
+
+
+def test_donation_that_cannot_alias_flagged():
+    """donate_argnums is present but the cache comes back a different dtype —
+    jax drops the alias silently, XLA allocates a second pool. This is the
+    invisible-2x-HBM case the aliasing check exists for."""
+
+    def _step(params, cache):
+        return {k: (v + params).astype(jnp.float32) for k, v in cache.items()}
+
+    d = register_external(
+        jax.jit(_step, donate_argnums=(1,), keep_unused=True), _step,
+        DispatchContract(kind="fx.alias_drift", cache_args=("cache",),
+                         max_upcast_elems=None))
+    d.set_example(jnp.ones((), jnp.bfloat16), _cache())
+    rep = _audit_one(d)
+    status, detail = _status(rep, "aliasing")
+    assert status == "fail" and "no input_output_alias" in detail
+
+
+def test_pure_callback_in_step_fn_flagged():
+    def _step(params, tok, cache):
+        tok = jax.pure_callback(
+            lambda x: np.asarray(x) + 1, jax.ShapeDtypeStruct(tok.shape,
+                                                              tok.dtype), tok)
+        return tok, {k: v + 1 for k, v in cache.items()}
+
+    d = audited_jit(_step, kind="fx.callback", cache_args=("cache",))
+    d(jnp.ones((), jnp.bfloat16), jnp.zeros((4,), jnp.int32), _cache())
+    rep = _audit_one(d)
+    status, detail = _status(rep, "host_sync")
+    assert status == "fail" and "callback" in detail
+
+
+def test_io_callback_in_step_fn_flagged():
+    import jax.experimental
+
+    def _step(tok, cache):
+        jax.experimental.io_callback(lambda x: None, None, tok)
+        return tok + 1, {k: v + 1 for k, v in cache.items()}
+
+    d = audited_jit(_step, kind="fx.iocallback", cache_args=("cache",))
+    d(jnp.zeros((4,), jnp.int32), _cache())
+    rep = _audit_one(d)
+    assert _status(rep, "host_sync")[0] == "fail"
+
+
+def test_cache_sized_bf16_to_f32_upcast_flagged():
+    """A silently upcast residual/pool: some bf16 buffer at least as large as
+    the smallest cache leaf converts to f32 inside the graph."""
+
+    def _step(params, tok, cache):
+        big = (tok.astype(jnp.bfloat16) + params).astype(jnp.float32)
+        return big.sum(), {k: v + 1 for k, v in cache.items()}
+
+    d = audited_jit(_step, kind="fx.upcast", cache_args=("cache",))
+    d(jnp.ones((), jnp.bfloat16), jnp.zeros((2, 4096), jnp.int32), _cache())
+    rep = _audit_one(d)
+    status, detail = _status(rep, "upcast")
+    assert status == "fail" and "f32" in detail
+
+
+def test_small_f32_islands_pass_upcast():
+    """Norms/softmax-sized f32 math must NOT trip the upcast check."""
+
+    def _step(params, tok, cache):
+        small = tok[:, :4].astype(jnp.bfloat16).astype(jnp.float32)
+        return small.sum(), {k: v + 1 for k, v in cache.items()}
+
+    d = audited_jit(_step, kind="fx.upcast_small", cache_args=("cache",))
+    d(jnp.ones((), jnp.bfloat16), jnp.zeros((2, 4096), jnp.int32), _cache())
+    assert _status(_audit_one(d), "upcast")[0] == "pass"
+
+
+def test_missing_declared_fp32_accum_flagged():
+    def _step(params, tok, cache):
+        h = jnp.dot(params, tok)                   # bf16 x bf16 -> bf16
+        return h, {k: v + 1 for k, v in cache.items()}
+
+    d = audited_jit(_step, kind="fx.accum", cache_args=("cache",),
+                    fp32_accum=True)
+    d(jnp.zeros((8, 8), jnp.bfloat16), jnp.zeros((8, 2), jnp.bfloat16),
+      _cache())
+    rep = _audit_one(d)
+    status, detail = _status(rep, "dtypes")
+    assert status == "fail" and "fp32 accumulation" in detail
+
+
+def test_extra_allreduce_flagged_by_declared_schedule():
+    """The compiled collective multiset must match the declared schedule: a
+    dispatch declared collective-free that carries an all-reduce fails."""
+    from neuronx_distributed_inference_tpu.models.base import shard_map_compat
+
+    mesh = jax.make_mesh((jax.device_count(),), ("tp",))
+    spec = jax.sharding.PartitionSpec("tp")
+
+    def _step(tok, cache):
+        def local(x):
+            return jax.lax.psum(x, "tp")
+
+        red = shard_map_compat(local, mesh=mesh, in_specs=(spec,),
+                               out_specs=spec)(tok)
+        return red, {k: v + 1 for k, v in cache.items()}
+
+    d = audited_jit(_step, kind="fx.allreduce", cache_args=("cache",),
+                    collectives="forbid")
+    d(jnp.zeros((jax.device_count(), 8), jnp.float32), _cache())
+    rep = _audit_one(d)
+    status, detail = _status(rep, "collectives")
+    assert status == "fail" and "all-reduce" in detail
+
+    # the same graph with the schedule DECLARED passes exactly
+    counts = rep.measurements["fx"].collective_counts
+    d2 = audited_jit(_step, kind="fx.allreduce_ok", cache_args=("cache",),
+                     collectives=dict(counts))
+    d2.set_example(*d.example[0])
+    assert _status(_audit_one(d2), "collectives")[0] == "pass"
+
+
+def test_blown_hbm_budget_flagged_and_rules_evaluate():
+    def _step(params, cache):
+        return {k: v + params for k, v in cache.items()}
+
+    d = audited_jit(_step, kind="fx.budget", cache_args=("cache",),
+                    hbm_bytes=1.0)
+    d(jnp.ones((), jnp.bfloat16), _cache())
+    rep = audit([AuditUnit("fx", d)],
+                rules=[absolute_rule("fx_abs", "fx", 1.0),
+                       ratio_rule("fx_self", "fx", "fx", 2.0)])
+    assert _status(rep, "hbm_bytes")[0] == "fail"
+    assert _status(rep, "rule", unit="fx_abs")[0] == "fail"
+    assert _status(rep, "rule", unit="fx_self")[0] == "pass"
+    assert not rep.ok
+
+
+def test_unlowerable_unit_is_a_violation_not_a_skip():
+    def _step(params, cache):
+        return {k: v + params for k, v in cache.items()}
+
+    d = audited_jit(_step, kind="fx.noexample", cache_args=("cache",))
+    rep = _audit_one(d)            # no example captured
+    assert not rep.ok
+    assert any(f.check == "audit" and f.status == "error"
+               for f in rep.findings)
+
+
+# -------------------------------------------------------------------- waivers
+def test_waiver_reports_but_does_not_enforce():
+    def _step(params, cache):
+        return {k: v + params for k, v in cache.items()}
+
+    d = register_external(
+        jax.jit(_step, keep_unused=True), _step,
+        DispatchContract(kind="fx.waived", cache_args=("cache",),
+                         waivers={"aliasing": "legacy fixture, modeled"}))
+    d.set_example(jnp.ones((), jnp.bfloat16), _cache())
+    rep = _audit_one(d)
+    status, detail = _status(rep, "aliasing")
+    assert status == "waived" and "legacy fixture" in detail
+    assert rep.ok                   # waived findings do not fail the audit
+
+
+def test_unknown_waiver_name_rejected():
+    with pytest.raises(ValueError, match="unknown check"):
+        DispatchContract(kind="x", waivers={"alias": "typo"})
+
+
+# ------------------------------------------------------- registry ergonomics
+def test_audited_jit_derives_donation_from_names():
+    def _step(params, tok, t_cache, d_cache):
+        return tok + 1, {k: v + 1 for k, v in t_cache.items()}, \
+            {k: v + 1 for k, v in d_cache.items()}
+
+    d = audited_jit(_step, kind="fx.derive",
+                    cache_args=("t_cache", "d_cache"))
+    d(jnp.ones((), jnp.bfloat16), jnp.zeros((4,), jnp.int32), _cache(),
+      _cache())
+    assert _audit_one(d).ok
+
+
+def test_donate_extra_needs_no_alias():
+    """donate_extra args are donated purely to free memory — a scratch buffer
+    with no corresponding output must NOT trip the aliasing orphan check."""
+
+    def _step(params, scratch, cache):
+        return (scratch * 0).sum(), {k: v + params for k, v in cache.items()}
+
+    d = audited_jit(_step, kind="fx.extra", cache_args=("cache",),
+                    donate_extra=("scratch",))
+    d(jnp.ones((), jnp.bfloat16), jnp.zeros((2, 64), jnp.bfloat16), _cache())
+    rep = _audit_one(d)
+    assert _status(rep, "aliasing")[0] == "pass", rep.findings
+
+
+def test_audited_jit_rejects_unknown_cache_name():
+    def _step(params, tok, cache):
+        return tok, cache
+
+    with pytest.raises(ValueError, match="not in"):
+        audited_jit(_step, kind="fx.bad", cache_args=("kv_cache",))
+
+
+def test_registry_find_returns_newest_live():
+    def _step(cache):
+        return {k: v + 1 for k, v in cache.items()}
+
+    a = audited_jit(_step, kind="fx.newest", cache_args=("cache",))
+    b = audited_jit(_step, kind="fx.newest", cache_args=("cache",))
+    assert registry.find("fx.newest") is b
+    del b
+    assert registry.find("fx.newest") is a
+
+
+# ------------------------------------------------------------ real dispatches
+def test_plain_and_paged_cb_dispatch_contracts_hold():
+    """Fast real-graph gate: the plain app + paged CB runner register, capture
+    examples, and every contract check passes on the lowered graphs. The full
+    fleet (spec/eagle/eagle3/medusa/mm) runs in the slow marker below and via
+    scripts/audit_graphs.py."""
+    from neuronx_distributed_inference_tpu.analysis import harness
+
+    units, notes = harness.build_fleet_units(["plain", "cb_paged"])
+    assert not notes, notes
+    assert {u.name for u in units} >= {
+        "plain.prefill", "plain.decode", "plain.window",
+        "cb.paged.insert", "cb.paged.insert_nol", "cb.paged.decode"}
+    rep = audit(units)
+    assert rep.ok, "\n".join(
+        f"{f.unit}: [{f.check}] {f.detail}" for f in rep.violations())
+    # donated KV pools really alias: the aliasing check ran (not skipped)
+    for unit in ("plain.decode", "cb.paged.decode"):
+        assert _status(rep, "aliasing", unit=unit)[0] == "pass"
+
+
+@pytest.mark.slow
+def test_full_fleet_contracts_hold():
+    """Every serving dispatch kind in the fleet passes its declared contract
+    (the test-suite twin of `scripts/audit_graphs.py`)."""
+    from neuronx_distributed_inference_tpu.analysis import harness
+
+    scopes = [s for s in harness.SCOPES if s not in ("plain", "cb_paged")]
+    units, notes = harness.build_fleet_units(scopes)
+    # a scope skipped for missing optional deps must FAIL this gate, not
+    # silently shrink it (the test env ships torch/transformers for the mm
+    # scope; harness notes exist for the script's softer reporting)
+    assert not notes, notes
+    rep = audit(units)
+    assert rep.ok, "\n".join(
+        f"{f.unit}: [{f.check}] {f.detail}" for f in rep.violations())
+
+
+# -------------------------------------------------------- --changed scope map
+def test_changed_mode_scope_map_fails_closed():
+    """The pre-commit fast mode must WIDEN for shared-machinery files, never
+    shrink: application.py backs every engine (full fleet), speculation.py's
+    accept/commit helpers feed the CB runner and every spec family, and
+    eagle.py builds the eagle3 scope's draft."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "audit_graphs", os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "scripts", "audit_graphs.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    pkg = "neuronx_distributed_inference_tpu/"
+    # application.py (and any unmapped package file) -> full fleet
+    assert mod._scopes_for_changes([pkg + "runtime/application.py"]) is None
+    assert mod._scopes_for_changes([pkg + "models/base.py"]) is None
+    # dependent-scope widening
+    assert set(mod._scopes_for_changes([pkg + "runtime/eagle.py"])) >= {
+        "eagle", "cb_eagle", "eagle3"}
+    assert set(mod._scopes_for_changes([pkg + "runtime/speculation.py"])) >= {
+        "spec", "cb_spec", "cb_eagle", "eagle", "eagle3", "medusa"}
+    # a doc/test-only change audits nothing
+    assert mod._scopes_for_changes(["docs/STATIC_ANALYSIS.md"]) == []
+    # every mapped scope name actually exists in the harness
+    from neuronx_distributed_inference_tpu.analysis import harness
+    for scopes in mod._FILE_SCOPES.values():
+        assert set(scopes) <= set(harness.SCOPES)

@@ -6,59 +6,54 @@ covers that), so CI guards the *compiled program's* memory traffic instead:
 XLA's cost analysis of a decode step bounds "bytes accessed", which is exactly what
 regressed in round 1 (scan cache-slice copies + a serialized KV write tripled the
 decode step's traffic without any test noticing).
+
+The canary MECHANICS now live in ``analysis/canaries.py`` on the graph-contract
+auditor: each group is (AuditUnits at a pinned geometry) + (cross-unit budget
+Rules), measured once by ``analysis.auditor.audit`` — one framework, shared with
+``scripts/audit_graphs.py --canaries``, instead of per-test ad-hoc
+``cost_analysis`` plumbing. The tests below keep their historical names as thin
+wrappers over named rules so history stays comparable; each also inherits the
+generic contract checks (aliasing, host-sync freedom, dtype discipline) on its
+units for free.
 """
 
+import functools
+
 import jax
-import jax.numpy as jnp
-import numpy as np
 import pytest
 
-from neuronx_distributed_inference_tpu.config import TpuConfig, load_pretrained_config
-from neuronx_distributed_inference_tpu.models.llama.modeling_llama import (
-    LlamaForCausalLM, LlamaInferenceConfig)
+from neuronx_distributed_inference_tpu.analysis import canaries
+from neuronx_distributed_inference_tpu.analysis.auditor import audit
+
+HF = canaries.CANARY_HF
 
 
-HF = {
-    "model_type": "llama", "vocab_size": 256, "hidden_size": 256,
-    "intermediate_size": 512, "num_hidden_layers": 4, "num_attention_heads": 2,
-    "num_key_value_heads": 2, "max_position_embeddings": 1024,
-    "rms_norm_eps": 1e-5, "rope_theta": 10000.0, "tie_word_embeddings": False,
-}
+@functools.lru_cache(maxsize=None)
+def _group_report(name):
+    """Audit one canary group once per session; wrappers read its findings."""
+    units, rules = canaries.canary_group(name)
+    return audit(units, rules)
 
 
-def _bytes_accessed(lowered):
-    """bytes-accessed from a lowered computation, across jax versions
-    (cost_analysis() returns a dict on current jax, a one-element list of
-    dicts on older releases)."""
-    cost = lowered.compile().cost_analysis()
-    if isinstance(cost, (list, tuple)):
-        cost = cost[0]
-    return float(cost["bytes accessed"])
+@pytest.fixture(scope="module", autouse=True)
+def _drop_canary_fleets():
+    """Reports are plain data; the cached canary apps/runners (params +
+    block pools per variant) must not stay resident for the rest of the
+    pytest session once this module's wrappers have their reports."""
+    yield
+    canaries.clear_caches()
 
 
-def _app(kernel):
-    cfg = TpuConfig(batch_size=8, seq_len=512, max_context_length=128,
-                    dtype="bfloat16", context_encoding_buckets=[128],
-                    token_generation_buckets=[512],
-                    decode_kernel_enabled=kernel)
-    config = LlamaInferenceConfig(cfg, load_config=load_pretrained_config(HF))
-    app = LlamaForCausalLM(None, config)
-    app.load_random(seed=0)
-    return app
-
-
-def _decode_bytes(app, steps=4):
-    """Compiled bytes-accessed of one decode chunk, normalized per step."""
-    from neuronx_distributed_inference_tpu.ops import sampling as sampling_ops
-
-    app.reset_cache()
-    b = app.tpu_config.max_batch_size
-    sp = sampling_ops.prepare_sampling_params(b)
-    lowered = app._decode_step.lower(
-        app.params, jnp.zeros((b,), jnp.int32), np.full((b,), 128, np.int32),
-        app.kv_cache, sp, jax.random.PRNGKey(0), decode_bucket=512,
-        num_steps=steps, with_logits=False, greedy=True)
-    return _bytes_accessed(lowered) / steps
+def _assert_rules(report, *rule_names):
+    """The whole group audit holds (units + rules), and each named rule both
+    ran and passed — a rule that silently vanishes is itself a failure."""
+    assert report.ok, "\n".join(
+        f"{f.unit}: [{f.check}] {f.status} {f.detail}"
+        for f in report.violations())
+    for name in rule_names:
+        statuses = [f.status for f in report.findings
+                    if f.unit == name and f.check == "rule"]
+        assert statuses == ["pass"], (name, statuses, report.findings)
 
 
 def test_decode_step_bytes_bounded():
@@ -66,13 +61,9 @@ def test_decode_step_bytes_bounded():
 
     Ideal = params once + KV bucket read + small activations. The jnp path pays
     the known scan cache-movement taxes (~2.6x today — the reason the Pallas
-    stacked-cache path exists); the bound fails if anything pushes it further."""
-    app = _app(kernel=False)
-    per_step = _decode_bytes(app)
-    params_bytes = sum(x.nbytes for x in jax.tree.leaves(app.params))
-    cache_bytes = sum(x.nbytes for x in jax.tree.leaves(app.kv_cache))
-    ideal = params_bytes + cache_bytes          # one pass over weights + cache
-    assert per_step < 3.0 * ideal, (per_step, ideal)
+    stacked-cache path exists); the bound fails if anything pushes it further.
+    (Wrapper: ``dense_decode`` canary group.)"""
+    _assert_rules(_group_report("dense_decode"), "dense_decode_bytes_bounded")
 
 
 def test_kernel_decode_not_more_traffic():
@@ -80,19 +71,32 @@ def test_kernel_decode_not_more_traffic():
 
     (XLA cannot see inside pallas custom-calls, so this bounds the surrounding
     graph: no hidden cache copies at the kernel boundaries.)"""
-    per_step_kernel = _decode_bytes(_app(kernel=True))
-    per_step_jnp = _decode_bytes(_app(kernel=False))
-    assert per_step_kernel < per_step_jnp * 1.1, (per_step_kernel, per_step_jnp)
+    _assert_rules(_group_report("dense_decode"), "kernel_decode_not_more_traffic")
 
 
 @pytest.mark.skipif(jax.default_backend() == "cpu",
                     reason="wall-clock thresholds need accelerator hardware")
 def test_decode_step_wall_clock():
     """On real hardware: a tiny-model decode step stays under a generous bound
-    (catches order-of-magnitude regressions without flaking on noise)."""
+    (catches order-of-magnitude regressions without flaking on noise).
+
+    Wall-clock is a runtime property, not a graph property — this one stays
+    off the auditor by design."""
     import time
 
-    app = _app(kernel=None)
+    import numpy as np
+
+    from neuronx_distributed_inference_tpu.config import (
+        TpuConfig, load_pretrained_config)
+    from neuronx_distributed_inference_tpu.models.llama.modeling_llama import (
+        LlamaForCausalLM, LlamaInferenceConfig)
+
+    cfg = TpuConfig(batch_size=8, seq_len=512, max_context_length=128,
+                    dtype="bfloat16", context_encoding_buckets=[128],
+                    token_generation_buckets=[512])
+    config = LlamaInferenceConfig(cfg, load_config=load_pretrained_config(HF))
+    app = LlamaForCausalLM(None, config)
+    app.load_random(seed=0)
     rng = np.random.default_rng(0)
     ids = rng.integers(1, 256, size=(8, 16)).astype(np.int32)
     app.generate(ids, max_new_tokens=64)
@@ -100,46 +104,6 @@ def test_decode_step_wall_clock():
     s = sum(x for x, _ in out.decode_latencies_s)
     n = sum(x for _, x in out.decode_latencies_s)
     assert (s / n) * 1000 < 20.0, f"{s/n*1000:.2f} ms/step for a 4-layer tiny model"
-
-
-def _paged_decode_bytes(kernel, mb, steps=4, fused=True):
-    """Compiled bytes-accessed of one paged-CB decode chunk at block-table width
-    ``mb``, normalized per step. ``fused`` toggles the fused append+attend
-    kernel vs the separate write-then-attend kernels (trace-time env)."""
-    import os
-
-    from neuronx_distributed_inference_tpu.ops import sampling as sampling_ops
-    from neuronx_distributed_inference_tpu.runtime.continuous_batching import (
-        ContinuousBatchingRunner)
-
-    cfg = TpuConfig(batch_size=8, seq_len=4096, max_context_length=128,
-                    dtype="bfloat16", context_encoding_buckets=[128],
-                    token_generation_buckets=[512],
-                    is_continuous_batching=True, paged_attention_enabled=True,
-                    pa_num_blocks=66, pa_block_size=128,
-                    decode_kernel_enabled=kernel)
-    config = LlamaInferenceConfig(cfg, load_config=load_pretrained_config(HF))
-    app = LlamaForCausalLM(None, config)
-    app.load_random(seed=0)
-    r = ContinuousBatchingRunner(app, decode_chunk=steps)
-    b = 8
-    sp = sampling_ops.prepare_sampling_params(b)
-    prev = os.environ.get("TPUINF_PAGED_FUSED")
-    os.environ["TPUINF_PAGED_FUSED"] = "1" if fused else "0"
-    try:
-        lowered = r._decode_step.lower(
-            app.params, jnp.zeros((b,), jnp.int32),
-            jnp.full((b,), 128, jnp.int32), jnp.ones((b,), bool),
-            jnp.full((b,), 64, jnp.int32), r.cache,
-            jnp.zeros((b, mb), jnp.int32), jnp.zeros((b, steps), jnp.int32),
-            sp, jax.random.PRNGKey(0), jnp.zeros((b,), jnp.int32),
-            jnp.full((b,), -1, jnp.int32), num_steps=steps)
-    finally:
-        if prev is None:
-            os.environ.pop("TPUINF_PAGED_FUSED", None)
-        else:
-            os.environ["TPUINF_PAGED_FUSED"] = prev
-    return _bytes_accessed(lowered) / steps
 
 
 def test_fused_paged_decode_bytes_one_kv_pass_and_table_invariant():
@@ -154,20 +118,10 @@ def test_fused_paged_decode_bytes_one_kv_pass_and_table_invariant():
         the real read-after-write of the appended block. Compiled
         bytes-accessed must therefore sit within 2x of the aliased
         pool-in+out accounting (L layers x (k+v) x (in+out)), and far below
-        the separate path's charge (measured ~9x at this geometry)."""
-    fused_4 = _paged_decode_bytes(True, 4, fused=True)
-    fused_32 = _paged_decode_bytes(True, 32, fused=True)
-    assert fused_32 <= fused_4 * 1.02, (fused_4, fused_32)
-
-    sep_4 = _paged_decode_bytes(True, 4, fused=False)
-    assert fused_4 <= 0.25 * sep_4, (fused_4, sep_4)
-
-    # one-KV-pass bound: L x (k+v) x (in + out) pool charges, 2x slack for
-    # params/activations/logits in the surrounding graph
-    cfg_pool = 66 * 128 * 2 * 128 * 2            # blocks x BS x Hkv x D x bf16
-    l_layers = HF["num_hidden_layers"]
-    pass_bytes = l_layers * 2 * 2 * cfg_pool
-    assert fused_4 <= 2.0 * pass_bytes, (fused_4, pass_bytes)
+        the separate path's charge (measured ~9x at this geometry).
+    (Wrapper: ``fused_paged`` canary group.)"""
+    _assert_rules(_group_report("fused_paged"), "fused_table_invariant",
+                  "fused_vs_separate", "fused_one_kv_pass")
 
 
 def test_paged_kernel_bytes_invariant_to_table_width():
@@ -177,43 +131,10 @@ def test_paged_kernel_bytes_invariant_to_table_width():
     model). Absolute bytes are NOT comparable between the two paths: XLA charges a
     pallas custom call's operands (the whole block pool) conservatively, while the
     kernel's real DMA traffic is the indexed blocks only — so the canary is the
-    scaling, not the level."""
-    kern_4 = _paged_decode_bytes(True, 4)
-    kern_32 = _paged_decode_bytes(True, 32)
-    assert kern_32 <= kern_4 * 1.02, (kern_4, kern_32)
-    gather_4 = _paged_decode_bytes(None, 4)
-    gather_32 = _paged_decode_bytes(None, 32)
-    assert gather_32 > gather_4 * 1.15, (gather_4, gather_32)   # documents the cliff
-
-
-def _multiquery_paged_bytes(kernel, mb, t=4):
-    """Compiled bytes-accessed of one MULTI-QUERY (q_len=t) paged decode — the
-    speculative verify shape — at block-table width ``mb``."""
-    from neuronx_distributed_inference_tpu.models import base as model_base
-
-    cfg = TpuConfig(batch_size=8, seq_len=4096, max_context_length=128,
-                    dtype="bfloat16", context_encoding_buckets=[128],
-                    token_generation_buckets=[512],
-                    is_continuous_batching=True, paged_attention_enabled=True,
-                    pa_num_blocks=66, pa_block_size=128,
-                    decode_kernel_enabled=kernel)
-    config = LlamaInferenceConfig(cfg, load_config=load_pretrained_config(HF))
-    app = LlamaForCausalLM(None, config)
-    app.load_random(seed=0)
-    cache = app.make_paged_cache(cfg.pa_num_blocks, cfg.pa_block_size)
-    b = 8
-    use_kernel = bool(kernel)
-
-    def _verify(params, ids, positions, cache, bt, sm):
-        return model_base.decode_forward(
-            params, app.arch_args, ids, positions, cache, None,
-            mesh=app.mesh, rules=app.sharding_rules, block_table=bt,
-            slot_mapping=sm, use_kernel=use_kernel)
-
-    lowered = jax.jit(_verify, donate_argnums=(3,)).lower(
-        app.params, jnp.zeros((b, t), jnp.int32), jnp.full((b,), 128, jnp.int32),
-        cache, jnp.zeros((b, mb), jnp.int32), jnp.zeros((b, t), jnp.int32))
-    return _bytes_accessed(lowered)
+    scaling, not the level. (Wrapper: ``paged_table_width`` canary group.)"""
+    _assert_rules(_group_report("paged_table_width"),
+                  "paged_kernel_table_invariant",
+                  "paged_gather_grows_with_table")
 
 
 def test_multiquery_paged_attend_bytes_invariant_to_table_width():
@@ -222,47 +143,9 @@ def test_multiquery_paged_attend_bytes_invariant_to_table_width():
     q_len=1 canary above — the multi-query attend streams each row's live
     blocks once for all K queries. The gather fallback grows with the table
     (and re-streams it per query), which is the cliff the kernel exists to
-    avoid; absolute levels are not comparable between the paths (XLA charges
-    a pallas custom call's operands conservatively), so the canary is the
-    scaling."""
-    kern_4 = _multiquery_paged_bytes(True, 4)
-    kern_32 = _multiquery_paged_bytes(True, 32)
-    assert kern_32 <= kern_4 * 1.02, (kern_4, kern_32)
-    gather_4 = _multiquery_paged_bytes(None, 4)
-    gather_32 = _multiquery_paged_bytes(None, 32)
-    assert gather_32 > gather_4 * 1.15, (gather_4, gather_32)
-
-
-def _mixed_chunk_paged_bytes(kernel, mb, t, b=4):
-    """Compiled bytes-accessed of one MIXED-STEP chunk attend (per-row q_lens
-    at chunk length ``t``, logit_idx sampling gather) at block-table width
-    ``mb``."""
-    from neuronx_distributed_inference_tpu.models import base as model_base
-
-    cfg = TpuConfig(batch_size=b, seq_len=4096, max_context_length=128,
-                    dtype="bfloat16", context_encoding_buckets=[128],
-                    token_generation_buckets=[512],
-                    is_continuous_batching=True, paged_attention_enabled=True,
-                    pa_num_blocks=66, pa_block_size=128,
-                    decode_kernel_enabled=kernel)
-    config = LlamaInferenceConfig(cfg, load_config=load_pretrained_config(HF))
-    app = LlamaForCausalLM(None, config)
-    app.load_random(seed=0)
-    cache = app.make_paged_cache(cfg.pa_num_blocks, cfg.pa_block_size)
-    use_kernel = bool(kernel)
-
-    def _chunk(params, ids, positions, q_lens, cache, bt, sm):
-        return model_base.decode_forward(
-            params, app.arch_args, ids, positions, cache, None,
-            mesh=app.mesh, rules=app.sharding_rules, block_table=bt,
-            slot_mapping=sm, use_kernel=use_kernel, q_lens=q_lens,
-            logit_idx=q_lens - 1)
-
-    lowered = jax.jit(_chunk, donate_argnums=(4,)).lower(
-        app.params, jnp.zeros((b, t), jnp.int32),
-        jnp.full((b,), 64, jnp.int32), jnp.full((b,), t, jnp.int32),
-        cache, jnp.zeros((b, mb), jnp.int32), jnp.zeros((b, t), jnp.int32))
-    return _bytes_accessed(lowered)
+    avoid. (Wrapper: ``multiquery`` canary group.)"""
+    _assert_rules(_group_report("multiquery"), "mq_kernel_table_invariant",
+                  "mq_gather_grows_with_table")
 
 
 @pytest.mark.parametrize("t", [64, 128, 256])
@@ -271,66 +154,22 @@ def test_mixed_chunk_attend_never_falls_back_to_gather(t):
     must ride the Pallas variable-q_len kernel — compiled traffic INVARIANT to
     the block-table width. A silent fallback to the gather path would scale
     with the table (it materializes the full (B, MB*BS) KV view per layer),
-    which is exactly the regression this canary pins. Gather growth itself is
-    documented at t=64 below.
+    which is exactly the regression this canary pins.
 
     Widths 16 vs 32: below 16 blocks the kernel's per-cell block count (and
-    so its conservative XLA operand accounting — each cell block is a
-    separate pallas operand) is table-bound rather than VMEM-budget-bound, so
-    the canary compares two widths where the cell geometry is fixed and only
-    the table grows."""
-    kern_16 = _mixed_chunk_paged_bytes(True, 16, t)
-    kern_32 = _mixed_chunk_paged_bytes(True, 32, t)
-    assert kern_32 <= kern_16 * 1.02, (kern_16, kern_32)
+    so its conservative XLA operand accounting) is table-bound rather than
+    VMEM-budget-bound, so the canary compares two widths where the cell
+    geometry is fixed and only the table grows. (Wrapper: ``mixed_chunk``
+    canary group — audited once, asserted per chunk length.)"""
+    _assert_rules(_group_report("mixed_chunk"),
+                  f"mixed_kernel_table_invariant_t{t}")
 
 
 def test_mixed_chunk_gather_fallback_grows_with_table():
     """Documents the cliff the mixed kernel avoids: the gather path's chunk
     attend traffic grows with the block-table width."""
-    gather_4 = _mixed_chunk_paged_bytes(None, 4, 64)
-    gather_32 = _mixed_chunk_paged_bytes(None, 32, 64)
-    assert gather_32 > gather_4 * 1.15, (gather_4, gather_32)
-
-
-def _tp_paged_decode_collective_stats(mb, b=8, steps=2, tp=2, sp=True,
-                                      overlap=True):
-    """Collective schedule (+ output bytes) of the COMPILED tp>1 paged-CB
-    decode chunk — the multichip serving hot path — via
-    parallel/overlap.collective_stats over the optimized HLO."""
-    import os
-
-    from neuronx_distributed_inference_tpu.ops import sampling as sampling_ops
-    from neuronx_distributed_inference_tpu.parallel import overlap as overlap_lib
-    from neuronx_distributed_inference_tpu.runtime.continuous_batching import (
-        ContinuousBatchingRunner)
-
-    cfg = TpuConfig(batch_size=b, seq_len=4096, max_context_length=128,
-                    dtype="bfloat16", context_encoding_buckets=[128],
-                    token_generation_buckets=[512],
-                    is_continuous_batching=True, paged_attention_enabled=True,
-                    pa_num_blocks=66, pa_block_size=128, tp_degree=tp,
-                    sequence_parallel_enabled=sp)
-    config = LlamaInferenceConfig(cfg, load_config=load_pretrained_config(HF))
-    app = LlamaForCausalLM(None, config)
-    app.load_random(seed=0)
-    r = ContinuousBatchingRunner(app, decode_chunk=steps)
-    sp_arr = sampling_ops.prepare_sampling_params(b)
-    prev = os.environ.get("TPUINF_TP_OVERLAP")
-    os.environ["TPUINF_TP_OVERLAP"] = "1" if overlap else "0"
-    try:
-        lowered = r._decode_step.lower(
-            app.params, jnp.zeros((b,), jnp.int32),
-            jnp.full((b,), 128, jnp.int32), jnp.ones((b,), bool),
-            jnp.full((b,), 64, jnp.int32), r.cache,
-            jnp.zeros((b, mb), jnp.int32), jnp.zeros((b, steps), jnp.int32),
-            sp_arr, jax.random.PRNGKey(0), jnp.zeros((b,), jnp.int32),
-            jnp.full((b,), -1, jnp.int32), num_steps=steps)
-        return overlap_lib.compiled_collective_stats(lowered.compile())
-    finally:
-        if prev is None:
-            os.environ.pop("TPUINF_TP_OVERLAP", None)
-        else:
-            os.environ["TPUINF_TP_OVERLAP"] = prev
+    _assert_rules(_group_report("mixed_chunk"),
+                  "mixed_gather_grows_with_table")
 
 
 def test_tp_decode_collective_schedule_pinned():
@@ -339,25 +178,15 @@ def test_tp_decode_collective_schedule_pinned():
 
     The layer stack runs under lax.scan, so the optimized HLO carries the
     per-layer collective schedule exactly once — a refactor that reintroduces
-    a stray all-gather (or any per-layer collective) changes ``counts``
+    a stray all-gather (or any per-layer collective) changes the multiset
     immediately. Invariance: block-table width and slot count must not leak
     into the schedule (reads track live state; collectives move activations,
-    never table-shaped buffers)."""
-    s4 = _tp_paged_decode_collective_stats(mb=4)
-    s32 = _tp_paged_decode_collective_stats(mb=32)
-    assert s4["counts"] == s32["counts"], (s4["counts"], s32["counts"])
-    assert s4["bytes"] == s32["bytes"], (s4["bytes"], s32["bytes"])
-    # schedule (op mix) is batch-shape-invariant too; bytes scale with rows
-    sb4 = _tp_paged_decode_collective_stats(mb=4, b=4)
-    assert sb4["counts"] == s4["counts"], (sb4["counts"], s4["counts"])
-    # per-layer pin: a small, bounded schedule (ring permutes + the residual
-    # halves + sampling merge) — growth here is a reintroduced collective
-    assert 0 < s4["count_total"] <= 48, s4
-    # the overlap path really is overlap-scheduled: ring collective-permutes
-    # present; the GSPMD fallback carries none
-    assert s4["counts"].get("collective-permute", 0) > 0, s4
-    fb = _tp_paged_decode_collective_stats(mb=4, overlap=False)
-    assert fb["counts"].get("collective-permute", 0) == 0, fb
+    never table-shaped buffers). The overlap path must carry ring
+    collective-permutes; the GSPMD fallback none. (Wrapper:
+    ``tp_collectives`` canary group.)"""
+    _assert_rules(_group_report("tp_collectives"),
+                  "tp_schedule_table_invariant", "tp_schedule_batch_invariant",
+                  "tp_schedule_pinned", "tp_fallback_no_ring")
 
 
 def test_disabled_telemetry_adds_no_measurable_step_overhead():
@@ -370,8 +199,11 @@ def test_disabled_telemetry_adds_no_measurable_step_overhead():
     (~a few tens of µs of numpy) is orders of magnitude SMALLER than a real
     jitted decode dispatch (~ms), so a 25% bound here corresponds to a
     sub-percent bound on the real step; the best-of-repeats guard keeps
-    scheduler noise from flaking the gate."""
+    scheduler noise from flaking the gate. (Host-side runtime property — stays
+    off the graph auditor by design.)"""
     import time
+
+    import numpy as np
 
     from neuronx_distributed_inference_tpu.utils.metrics import (
         ServingTelemetry)
