@@ -509,11 +509,14 @@ def main() -> None:
         gc.collect()
         paged_app = None
         try:
-            paged_sync, paged_async, paged_depth, paged_app = \
+            paged_sync, paged_async, paged_depth, paged_app, tel_extra = \
                 _paged_serving_throughput(hf_cfg, min(batch, 64), tp_degree)
             extra["paged_sync_tok_per_s"] = paged_sync
             extra["paged_async_tok_per_s"] = paged_async
             extra["paged_async_depth"] = paged_depth
+            # ISSUE-7: enabled+carry telemetry cost (1.0 = free) + the
+            # profiled host-vs-device decomposition of the dispatch floor
+            extra.update(tel_extra)
             pq = paged_app.tpu_config.quantization_config
             extra["paged_kv_dtype"] = f"{pq.kv_cache_dtype}-{pq.kv_cache_scale_mode}"
             paged = max(paged_sync, paged_async)
@@ -673,6 +676,15 @@ def _paged_serving_throughput(hf_cfg, batch, tp_degree=1):
         runner.step()
     async_ = measure()
     runner.async_mode = False
+    # ISSUE-7 observability window on the same warm executables: the
+    # enabled+carry telemetry overhead ratio and the profiled host/device
+    # dispatch-gap decomposition. Never allowed to sink the headline.
+    tel_extra = {}
+    if _remaining() > 120:
+        try:
+            tel_extra = _telemetry_overhead_and_gap(runner, rng, bs)
+        except Exception as e:
+            _note(f"telemetry overhead/gap window failed: {e}")
     # release the runner's 4.4 GB block pools so the follow-on spec phase can
     # build its own (target + draft) without OOMing the chip; the APP (weights)
     # is returned for reuse — a second 8 GB host->device load costs ~7 min
@@ -682,7 +694,67 @@ def _paged_serving_throughput(hf_cfg, batch, tp_degree=1):
     import gc
 
     gc.collect()
-    return sync, async_, depth, app
+    return sync, async_, depth, app, tel_extra
+
+
+def _telemetry_overhead_and_gap(runner, rng, bs, n_chunks=3, prompt_len=100,
+                                max_new=480, tok_high=100000,
+                                logdir="/tmp/tpu_bench_profile_serving",
+                                plane="tpu"):
+    """ISSUE-7 observability window on an ALREADY-WARM runner (no fresh
+    compiles): (a) ``telemetry_overhead_ratio`` — steady-state decode tok/s
+    with telemetry ENABLED (host hooks + the in-graph device-carry drain at
+    each pipeline flush) over the same window with ``enabled=False`` (1.0 =
+    telemetry is free; the carry's in-graph adds ride in BOTH numbers since
+    they are threaded unconditionally); (b) ``dispatch_gap_ms`` — a short
+    jax.profiler-traced window attributed per dispatch kind
+    (runner.attribute_device_time): host step span minus on-device time per
+    decode dispatch, the host share of the ~109 ms dispatch floor ROADMAP
+    open item 2 targets. Returns bench ``extra`` keys; device attribution
+    keys are None when the backend's xplane carries no matching events."""
+    import shutil
+    import time as _time
+
+    from neuronx_distributed_inference_tpu.utils import profiling as prof
+
+    runner.run_to_completion()            # drain the headline rows first
+    tel = runner.telemetry
+    tel.enabled = True
+    tel.reset()
+    runner.reset_device_telemetry()
+    for _ in range(bs):
+        runner.submit(rng.integers(1, tok_high,
+                                   size=(prompt_len,)).astype(np.int32),
+                      max_new_tokens=max_new)
+    runner.step()                         # place + seed every row (warm graphs)
+
+    def window(chunks):
+        t0 = _time.time()
+        n = 0
+        for _ in range(chunks):
+            n += sum(len(v) for v in runner.step().values())
+        return n / (_time.time() - t0)
+
+    # adjacent same-kind windows: every row stays alive through both (the
+    # max_new budget covers all chunks below), so off-vs-on is apples-to-apples
+    tel.enabled = False
+    off = window(n_chunks)
+    tel.enabled = True
+    on = window(n_chunks)
+    out = {"telemetry_overhead_ratio": round(on / off, 3)}
+
+    # traced gap window: host spans of the MEASURED window only
+    tel.reset()
+    runner.reset_device_telemetry()
+    shutil.rmtree(logdir, ignore_errors=True)
+    with prof.trace(logdir):
+        window(2)
+    timing = runner.attribute_device_time(logdir, plane_substr=plane)
+    dec = timing.get("decode", {})
+    out["dispatch_gap_ms"] = dec.get("dispatch_gap_ms")
+    out["decode_device_ms_per_dispatch"] = dec.get("device_ms_per_dispatch")
+    tel.enabled = False
+    return out
 
 
 def _spec_runner_measure(runner, batch, k, n_chunks=4, max_new=760):

@@ -280,13 +280,17 @@ def _audit_unit(unit: AuditUnit, findings: List[Finding],
     aliased = (_aliased_arg_indices(text)
                | _compiled_alias_param_indices(compiled_text))
     ranges, total = _flat_arg_layout(
-        args, kwargs, contract.cache_args + contract.donate_extra,
+        args, kwargs,
+        contract.cache_args + contract.carry_args + contract.donate_extra,
         unit.dispatch.fn, unit.dispatch.static_argnames)
     problems = []
     if total != len(info_leaves):
         problems.append(f"arg layout mismatch ({total} example leaves vs "
                         f"{len(info_leaves)} lowered args)")
-    for name in contract.cache_args:
+    # carry buffers (the in-graph telemetry block) are held to the same
+    # donated-AND-actually-aliased bar as caches — a carry that silently
+    # fails to alias copies itself every dispatch
+    for name in contract.cache_args + contract.carry_args:
         if name not in ranges:
             problems.append(f"cache arg {name!r} not found in example args")
             continue

@@ -97,11 +97,13 @@ def _paged_runner(kernel, tp=1, sp=False, b=8, steps=4, tag=""):
 
 def _set_paged_decode_example(app, runner, b=8, steps=4, mb=4):
     from ..ops import sampling as sampling_ops
+    from ..utils import device_telemetry as dtel
 
     sp = sampling_ops.prepare_sampling_params(b)
     runner._decode_step.set_example(
         app.params, jnp.zeros((b,), jnp.int32), jnp.full((b,), 128, jnp.int32),
         jnp.ones((b,), bool), jnp.full((b,), 64, jnp.int32), runner.cache,
+        dtel.init_carry(),
         jnp.zeros((b, mb), jnp.int32), jnp.zeros((b, steps), jnp.int32),
         sp, jax.random.PRNGKey(0), jnp.zeros((b,), jnp.int32),
         jnp.full((b,), -1, jnp.int32), num_steps=steps)
@@ -128,7 +130,7 @@ def _paged_decode_unit(name, kernel, mb, fused=True, tp=1, sp=False, b=8,
                                              sorted(env.items())))
     _set_paged_decode_example(app, runner, b=b, steps=steps, mb=4)
     return AuditUnit(
-        name, runner._decode_step, argmod=_widen_table(6, mb), env=env,
+        name, runner._decode_step, argmod=_widen_table(7, mb), env=env,
         contract=generic_contract(runner._decode_step,
                                   collectives=collectives))
 

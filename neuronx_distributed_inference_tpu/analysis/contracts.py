@@ -47,6 +47,11 @@ class DispatchContract:
     kind: str
     # cache-pytree parameters (donated + verified aliased + dtype-preserved)
     cache_args: Tuple[str, ...] = ()
+    # small device-resident carry buffers (the in-graph telemetry block,
+    # utils/device_telemetry.py): donated + verified aliased like a cache,
+    # but EXCLUDED from the cache-sized upcast threshold — a 14-element
+    # counter vector must not drag the "cache-leaf-sized" bar down to noise
+    carry_args: Tuple[str, ...] = ()
     # additional donated parameters that are NOT caches (no aliasing required)
     donate_extra: Tuple[str, ...] = ()
     # static argname holding the per-dispatch iteration count; byte budgets

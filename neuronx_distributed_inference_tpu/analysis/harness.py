@@ -66,7 +66,8 @@ def generic_contract(d: AuditedDispatch, *,
     c = d.contract
     in_bytes = _example_input_bytes(d)
     return DispatchContract(
-        kind=c.kind, cache_args=c.cache_args, donate_extra=c.donate_extra,
+        kind=c.kind, cache_args=c.cache_args, carry_args=c.carry_args,
+        donate_extra=c.donate_extra,
         steps_arg=c.steps_arg, host_sync_free=c.host_sync_free,
         fp32_accum=c.fp32_accum, max_upcast_elems=c.max_upcast_elems,
         collectives=collectives,

@@ -24,6 +24,19 @@ review keeps missing:
                     serving loop, or ``asarray`` conversions inside a
                     per-row python loop there (hoist them — PR 2 measured
                     per-window conversions at milliseconds per dispatch).
+``telemetry-in-jit`` host telemetry/registry mutation inside TRACED code
+                    (a jitted/audited_jit step fn or a def nested in one):
+                    ``self.telemetry.*``, instrument mutators
+                    (``._m_x.inc/observe``), or registry get-or-create calls
+                    — a host-object mutation under trace runs once per
+                    TRACE, not per step, so it silently records garbage.
+                    Under a ``@step_loop_body`` host loop only registry
+                    GET-OR-CREATE (``registry.counter/gauge/histogram``) is
+                    flagged: instruments must be cached at construction, not
+                    looked up per step (mutating a cached instrument there
+                    is the designed pattern). The in-graph device carry
+                    (utils/device_telemetry.py) is the sanctioned way to
+                    count inside a graph.
 
 Waive a line with ``# lint: ok(<rule>)`` or ``# lint: ok(<rule>): reason``
 (``# debug-ok`` keeps working for ``stray-print``). Waived findings are
@@ -43,7 +56,7 @@ __all__ = ["LintFinding", "lint_package", "lint_paths", "lint_source",
            "RULES", "PKG_ROOT"]
 
 RULES = ("stray-print", "raw-jit", "jit-no-donate", "tracer-branch",
-         "time-in-jit", "step-loop-sync")
+         "time-in-jit", "step-loop-sync", "telemetry-in-jit")
 
 PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -192,10 +205,12 @@ class _ModuleLint:
         for fn, statics in traced:
             self._rule_tracer_branch(fn, statics)
             self._rule_time(fn)
+            self._rule_telemetry(fn, traced=True)
         for fn in (f for defs in self.fn_defs.values() for f in defs):
             if any(_dotted(d).split(".")[-1] == "step_loop_body"
                    for d in fn.decorator_list):
                 self._rule_step_loop(fn)
+                self._rule_telemetry(fn, traced=False)
         return self.findings
 
     def _rule_print(self) -> None:
@@ -280,6 +295,41 @@ class _ModuleLint:
                 self.emit("time-in-jit", node,
                           f"time.{node.attr} inside traced {fn.name}() — "
                           f"evaluates once at trace time")
+
+    # metric-instrument attribute prefixes the runner/telemetry caches use
+    _INSTRUMENT_RE = re.compile(r"^_(m|c|g|h)_")
+
+    def _rule_telemetry(self, fn: ast.FunctionDef, traced: bool) -> None:
+        """Host telemetry under trace records once per TRACE; registry
+        get-or-create in a host step loop allocates/hashes per step."""
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            parts = _dotted(node.func).split(".")
+            if len(parts) < 2:
+                continue
+            attr, owner = parts[-1], parts[:-1]
+            is_registry_create = (attr in ("counter", "gauge", "histogram")
+                                  and any(p in ("registry", "reg", "metrics")
+                                          for p in owner))
+            if traced:
+                is_tel = "telemetry" in owner
+                is_mutator = (attr in ("inc", "observe", "set")
+                              and any(self._INSTRUMENT_RE.match(p)
+                                      for p in owner))
+                if is_tel or is_mutator or is_registry_create:
+                    self.emit("telemetry-in-jit", node,
+                              f"host telemetry/registry call "
+                              f"{_dotted(node.func)}() inside traced "
+                              f"{fn.name}() — runs once per trace, not per "
+                              f"step; thread the device telemetry carry "
+                              f"(utils/device_telemetry.py) instead")
+            elif is_registry_create:
+                self.emit("telemetry-in-jit", node,
+                          f"registry get-or-create {_dotted(node.func)}() "
+                          f"inside step-loop body {fn.name}() — cache the "
+                          f"instrument at construction (per-step name "
+                          f"hashing + dict lookup on the hot path)")
 
     def _rule_step_loop(self, fn: ast.FunctionDef) -> None:
         for node in ast.walk(fn):

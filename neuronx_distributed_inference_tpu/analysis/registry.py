@@ -137,6 +137,7 @@ def _param_indices(fn, names: Tuple[str, ...], kind: str) -> Tuple[int, ...]:
 
 
 def audited_jit(fn, *, kind: str, cache_args: Tuple[str, ...] = (),
+                carry_args: Tuple[str, ...] = (),
                 donate_extra: Tuple[str, ...] = (),
                 static_argnames: Tuple[str, ...] = (),
                 steps_arg: Optional[str] = None,
@@ -144,16 +145,21 @@ def audited_jit(fn, *, kind: str, cache_args: Tuple[str, ...] = (),
                 **contract_kw) -> AuditedDispatch:
     """``jax.jit`` + contract registration for a serving dispatch.
 
-    ``cache_args``/``donate_extra`` are parameter NAMES; donation indices are
-    derived from the signature, so they cannot be mis-indexed. Remaining
-    ``contract_kw`` forward to :class:`DispatchContract` (host_sync_free,
-    fp32_accum, collectives, hbm_bytes, ...).
+    ``cache_args``/``carry_args``/``donate_extra`` are parameter NAMES;
+    donation indices are derived from the signature, so they cannot be
+    mis-indexed. ``carry_args`` are small device-resident carry buffers (the
+    in-graph telemetry block): donated + aliasing-verified like caches, but
+    excluded from the cache-sized upcast threshold. Remaining ``contract_kw``
+    forward to :class:`DispatchContract` (host_sync_free, fp32_accum,
+    collectives, hbm_bytes, ...).
     """
     contract = DispatchContract(
         kind=kind, cache_args=tuple(cache_args),
+        carry_args=tuple(carry_args),
         donate_extra=tuple(donate_extra), steps_arg=steps_arg,
         waivers=dict(waivers or {}), **contract_kw)
     donate = (_param_indices(fn, contract.cache_args, kind)
+              + _param_indices(fn, contract.carry_args, kind)
               + _param_indices(fn, contract.donate_extra, kind))
     # keep_unused=True: jit drops unused args from the lowered module by
     # default, which would break the auditor's example-leaf -> lowered-arg

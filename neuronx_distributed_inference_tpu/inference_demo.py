@@ -167,6 +167,23 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--stats-interval", type=int, default=0, metavar="N",
                    help="with --serve: log a runner.stats() JSON snapshot "
                         "every N serving steps (enables serving telemetry)")
+    g.add_argument("--slo", default=None, metavar="SPEC",
+                   help="with --serve: rolling-window SLO targets as "
+                        "key=value pairs (utils/slo.py), e.g. "
+                        "'ttft_p99_ms=500,queue_p99_ms=200,window_s=30'. "
+                        "Evaluated every --slo-interval steps; exports the "
+                        "serving_slo_healthy gauge + structured violation "
+                        "logs (enables serving telemetry)")
+    g.add_argument("--slo-interval", type=int, default=25, metavar="N",
+                   help="with --slo: serving steps between SLO evaluations "
+                        "(N >= 1; 0 disables periodic evaluation — the "
+                        "final evaluation at exit still runs)")
+    g.add_argument("--debug-bundle", default=None, metavar="PATH",
+                   help="with --serve: write a flight-recorder debug bundle "
+                        "(config, versions, metrics, last-N step records "
+                        "with drained device counters) to PATH at exit AND "
+                        "on a serving-loop fault; SIGUSR1 dumps one from a "
+                        "live process (enables serving telemetry)")
     g.add_argument("--speculation-length", type=int, default=0)
     g.add_argument("--speculation-type", default="fused",
                    choices=["fused", "eagle", "eagle3", "medusa"],
@@ -573,11 +590,27 @@ def _run_serving(args, app, tokenizer) -> None:
         kw["prefill_token_budget"] = args.prefill_token_budget
     telemetry = None
     if (args.metrics_out or args.trace_out or args.events_out
-            or args.stats_interval):
+            or args.stats_interval or args.slo or args.debug_bundle):
         from .utils.metrics import ServingTelemetry
 
         telemetry = ServingTelemetry(jsonl_path=args.events_out)
     runner = ContinuousBatchingRunner(app, telemetry=telemetry, **kw)
+    slo_monitor = None
+    if args.slo:
+        from .utils.slo import SLOConfig, SLOMonitor
+
+        slo_monitor = SLOMonitor(telemetry, SLOConfig.parse(args.slo))
+
+    def _dump_bundle(reason: str) -> str:
+        return telemetry.flight.dump_bundle(
+            args.debug_bundle, config=app.tpu_config,
+            metrics=telemetry.registry.to_dict(), stats=runner.stats(),
+            reason=reason)
+
+    if args.debug_bundle:
+        from .utils.flight_recorder import install_signal_dump
+
+        install_signal_dump(_dump_bundle)
     input_ids, attention_mask = _encode_prompts(args, tokenizer,
                                                 app.arch_args.vocab_size)
     rids = []
@@ -590,8 +623,29 @@ def _run_serving(args, app, tokenizer) -> None:
         if args.stats_interval and n_steps % args.stats_interval == 0:
             logger.info("serving stats @ step %d: %s", n_steps,
                         json.dumps(runner.stats(), default=str))
+        if (slo_monitor is not None and args.slo_interval > 0
+                and n_steps % args.slo_interval == 0):
+            rep = slo_monitor.evaluate()
+            if not rep.healthy:
+                logger.warning("SLO unhealthy @ step %d: %s", n_steps,
+                               "; ".join(rep.violations))
 
-    results = runner.run_to_completion(seed=args.seed, on_step=_log_stats)
+    try:
+        results = runner.run_to_completion(seed=args.seed, on_step=_log_stats)
+    except BaseException:
+        # a faulting serving loop leaves its last N step records + drained
+        # device counters in the bundle — the post-mortem artifact
+        if args.debug_bundle:
+            logger.warning("serving loop fault: debug bundle at %s",
+                           _dump_bundle("exception"))
+        raise
+    if slo_monitor is not None:
+        rep = slo_monitor.evaluate()
+        logger.info("final SLO evaluation: healthy=%s%s", rep.healthy,
+                    "" if rep.healthy else " (" + "; ".join(rep.violations)
+                    + ")")
+    if args.debug_bundle:
+        logger.info("debug bundle written to %s", _dump_bundle("exit"))
     for rid in rids:
         toks = results[rid]
         if tokenizer is not None:

@@ -35,6 +35,7 @@ from ..models import base as model_base
 from ..modules import autobucketing, block_kvcache
 from ..ops import sampling as sampling_ops
 from ..parallel.sharding import named_sharding
+from ..utils import device_telemetry as dtel
 from . import model_wrapper
 
 logger = logging.getLogger("tpu-inference")
@@ -385,6 +386,19 @@ class ContinuousBatchingRunner:
         self._place_counter = 0
         self._key = jax.random.PRNGKey(0)
 
+        # device-resident telemetry carry (utils/device_telemetry.py): a
+        # (CARRY_LEN,) int32 counter block threaded DONATED+ALIASED through
+        # every jitted step below and accumulated with in-graph adds (the
+        # analysis/ auditor proves the aliasing and host-sync freedom).
+        # Threaded regardless of telemetry.enabled — the counter adds are
+        # noise next to a decode iteration's weight stream and one executable
+        # per step kind keeps the telemetry=False token stream bit-identical
+        # — but only ever FETCHED (np.asarray) when telemetry is enabled AND
+        # the dispatch pipeline is empty, i.e. at a sync the runner already
+        # pays. Zero new host syncs.
+        self._telem_dev = dtel.init_carry()
+        self._telem_drained = None      # last-drained carry object (identity)
+
         self.positions = np.zeros((self.num_slots,), dtype=np.int32)
         self.last_tok = np.zeros((self.num_slots,), dtype=np.int32)
 
@@ -473,15 +487,19 @@ class ContinuousBatchingRunner:
                                  "decode path (custom family decode forwards "
                                  "lack q_lens/logit_idx)")
 
+            bs_blk = self.block_size
+
             def _insert(params, input_ids, position_ids, last_token_idx, cache,
-                        block_table_row, slot_mapping, sampling_params, key,
-                        adapter_row):
+                        telem, block_table_row, slot_mapping, sampling_params,
+                        key, adapter_row, emit_seed):
                 """Batch-1 (prefix-)prefill into paged blocks: a wide decode call whose
                 queries are the (suffix) tokens; prior blocks are visible through the
                 block table. On the base decode path only the last real token
                 pays the lm_head (logit_idx gather — a padded 256-wide window
                 over a 128k vocab would otherwise materialize ~131 MB of
-                discarded logits)."""
+                discarded logits). ``emit_seed`` is the host-known 0/1 flag:
+                the sampled seed counts as an emitted token only when the host
+                will emit it (resumed re-inserts discard it)."""
                 with jax.default_matmul_precision(precision):
                     if base_decode:
                         logits, cache = decode_core(
@@ -499,9 +517,12 @@ class ContinuousBatchingRunner:
                             logits, last_token_idx[:, None, None], axis=1)[:, 0]
                 tok = sampling_ops.sample(last, sampling_params, key, odsc,
                                           mesh=mesh, rules=rules)
-                return tok, cache
+                telem = dtel.prefill_tick(telem, slot_mapping, bs_blk)
+                telem = dtel.seed_tick(telem, emit_seed)
+                telem = dtel.bump_kind(telem, dtel.KIND_INSERT_WINDOW)
+                return tok, cache, telem
 
-            def _insert_nol(params, input_ids, position_ids, cache,
+            def _insert_nol(params, input_ids, position_ids, cache, telem,
                             block_table_row, slot_mapping, adapter_row):
                 """INTERMEDIATE insert window: KV-only. The sampled token of a
                 non-final window is discarded, so skip the final norm, lm_head
@@ -513,10 +534,12 @@ class ContinuousBatchingRunner:
                         mesh=mesh, rules=rules, block_table=block_table_row,
                         slot_mapping=slot_mapping, adapter_ids=adapter_row,
                         skip_logits=True)
-                return cache
+                telem = dtel.prefill_tick(telem, slot_mapping, bs_blk)
+                telem = dtel.bump_kind(telem, dtel.KIND_INSERT_WINDOW)
+                return cache, telem
 
             def _decode(params, tok0, positions, alive0, budget0, cache,
-                        block_table, slot_chunk, sampling_params, key,
+                        telem, block_table, slot_chunk, sampling_params, key,
                         adapter_ids, eos_ids, num_steps, greedy=False):
                 """``num_steps`` chained decode iterations with ON-DEVICE stop
                 tracking: a row that emits its eos or exhausts its max-new
@@ -530,7 +553,7 @@ class ContinuousBatchingRunner:
                 slots_t = slot_chunk.T[:, :, None]          # (T, B, 1)
 
                 def body(carry, xs):
-                    tok, pos, alive, budget, cache = carry
+                    tok, pos, alive, budget, cache, telem = carry
                     step_key, slots_j = xs
                     # frozen rows write nothing (their precomputed slots were
                     # host-estimated past their stop point)
@@ -551,35 +574,42 @@ class ContinuousBatchingRunner:
                                                       sampling_params,
                                                       step_key, odsc,
                                                       mesh=mesh, rules=rules)
+                    telem = dtel.decode_tick(telem, alive, nxt, eos_ids)
+                    telem = dtel.kv_tick(telem, slots_live, bs_blk)
                     nxt = jnp.where(alive, nxt, tok)
                     pos = pos + alive.astype(pos.dtype)
                     budget = budget - alive.astype(budget.dtype)
                     alive = jnp.logical_and(alive, budget > 0)
                     alive = jnp.logical_and(alive, nxt != eos_ids)
-                    return (nxt, pos, alive, budget, cache), nxt
+                    return (nxt, pos, alive, budget, cache, telem), nxt
 
-                (tok_l, pos_l, alive_l, budget_l, cache), toks = jax.lax.scan(
-                    body, (tok0, positions, alive0, budget0, cache),
-                    (keys, slots_t))
-                return toks.T, (tok_l, pos_l, alive_l, budget_l), cache
+                (tok_l, pos_l, alive_l, budget_l, cache, telem), toks = \
+                    jax.lax.scan(
+                        body, (tok0, positions, alive0, budget0, cache, telem),
+                        (keys, slots_t))
+                telem = dtel.bump_kind(telem, dtel.KIND_DECODE)
+                return toks.T, (tok_l, pos_l, alive_l, budget_l), cache, telem
 
             self._insert_step = audited_jit(
-                _insert, kind="cb.paged.insert", cache_args=("cache",))
+                _insert, kind="cb.paged.insert", cache_args=("cache",),
+                carry_args=("telem",))
             self._insert_step_nol = (
                 audited_jit(_insert_nol, kind="cb.paged.insert_nol",
-                            cache_args=("cache",))
+                            cache_args=("cache",), carry_args=("telem",))
                 if base_decode else None)
             self._decode_step = audited_jit(
                 _decode, kind="cb.paged.decode", cache_args=("cache",),
+                carry_args=("telem",),
                 static_argnames=("num_steps", "greedy"),
                 steps_arg="num_steps")
 
             if self.mixed:
-                def _mixed(params, tok0, positions, cache, block_table,
-                           slot_chunk, chunk_ids, chunk_pos, chunk_qlens,
-                           chunk_bt, chunk_slots, sampling_params, chunk_sp,
-                           key, adapter_ids, chunk_adapters, num_steps,
-                           greedy=False):
+                def _mixed(params, tok0, positions, alive0, budget0, cache,
+                           telem, block_table, slot_chunk, chunk_ids,
+                           chunk_pos, chunk_qlens, chunk_bt, chunk_slots,
+                           chunk_emit, sampling_params, chunk_sp,
+                           key, adapter_ids, chunk_adapters, eos_ids,
+                           num_steps, greedy=False):
                     """One MIXED serving step, ONE dispatch: the C prefill-chunk
                     rows run the variable-q_len ragged paged attend (each row's
                     last live token alone pays the lm_head via logit_idx;
@@ -588,7 +618,13 @@ class ContinuousBatchingRunner:
                     plain chunk would. Chunk rows and decode rows touch
                     disjoint blocks (shared prefix blocks are rewritten with
                     identical content), so the order inside the dispatch is
-                    immaterial."""
+                    immaterial.
+
+                    ``alive0``/``budget0``/``eos_ids`` feed the telemetry
+                    carry's COUNTING-ONLY replay of the host commit rules
+                    (tokens stay ungated — the host ignores post-stop tokens,
+                    exactly as before); ``chunk_emit`` flags chunk rows whose
+                    final-window seed the host will emit."""
                     key_c, key_d = jax.random.split(key)
                     with jax.default_matmul_precision(precision):
                         logits_c, cache = decode_core(
@@ -605,12 +641,14 @@ class ContinuousBatchingRunner:
                             chunk_tok = sampling_ops.sample(
                                 logits_c[:, 0], chunk_sp, key_c, odsc,
                                 mesh=mesh, rules=rules)
+                    telem = dtel.prefill_tick(telem, chunk_slots, bs_blk)
+                    telem = dtel.seed_tick(telem, jnp.sum(chunk_emit))
 
                     keys = jax.random.split(key_d, num_steps)
                     slots_t = slot_chunk.T[:, :, None]          # (steps, B, 1)
 
                     def body(carry, xs):
-                        tok, pos, cache = carry
+                        tok, pos, cache, alive_t, budget_t, telem = carry
                         step_key, slots_j = xs
                         with jax.default_matmul_precision(precision):
                             logits, cache = decode_core(
@@ -628,14 +666,23 @@ class ContinuousBatchingRunner:
                                                           step_key, odsc,
                                                           mesh=mesh,
                                                           rules=rules)
-                        return (nxt, pos + 1, cache), nxt
+                        telem = dtel.decode_tick(telem, alive_t, nxt, eos_ids)
+                        telem = dtel.kv_tick(telem, slots_j, bs_blk)
+                        budget_t = budget_t - alive_t.astype(budget_t.dtype)
+                        alive_t = jnp.logical_and(alive_t, budget_t > 0)
+                        alive_t = jnp.logical_and(alive_t, nxt != eos_ids)
+                        return (nxt, pos + 1, cache, alive_t, budget_t,
+                                telem), nxt
 
-                    (_, _, cache), toks = jax.lax.scan(
-                        body, (tok0, positions, cache), (keys, slots_t))
-                    return toks.T, chunk_tok, cache
+                    (_, _, cache, _, _, telem), toks = jax.lax.scan(
+                        body, (tok0, positions, cache, alive0, budget0, telem),
+                        (keys, slots_t))
+                    telem = dtel.bump_kind(telem, dtel.KIND_MIXED)
+                    return toks.T, chunk_tok, cache, telem
 
                 self._mixed_step = audited_jit(
                     _mixed, kind="cb.paged.mixed", cache_args=("cache",),
+                    carry_args=("telem",),
                     static_argnames=("num_steps", "greedy"),
                     steps_arg="num_steps")
         else:
@@ -647,7 +694,8 @@ class ContinuousBatchingRunner:
             kernel_kw = ({"use_kernel": True} if app._use_decode_kernel() else {})
 
             def _insert(params, input_ids, position_ids, last_token_idx, cache,
-                        slot, sampling_params, key, adapter_row):
+                        telem, slot, sampling_params, key, adapter_row,
+                        emit_seed):
                 with jax.default_matmul_precision(precision):
                     logits, cache = prefill_core(
                         params, args, input_ids, position_ids, last_token_idx, cache,
@@ -656,10 +704,15 @@ class ContinuousBatchingRunner:
                         adapter_ids=adapter_row)
                 tok = sampling_ops.sample(logits, sampling_params, key, odsc,
                                           mesh=mesh, rules=rules)
-                return tok, cache
+                n_real = jnp.sum(last_token_idx + 1)
+                telem = telem.at[dtel.IDX_PREFILL].add(n_real)
+                telem = telem.at[dtel.IDX_KV_WRITES].add(n_real)
+                telem = dtel.seed_tick(telem, emit_seed)
+                telem = dtel.bump_kind(telem, dtel.KIND_INSERT)
+                return tok, cache, telem
 
             def _decode(params, tok0, positions, alive0, budget0, cache,
-                        sampling_params, key, adapter_ids, eos_ids,
+                        telem, sampling_params, key, adapter_ids, eos_ids,
                         decode_bucket, num_steps, greedy=False):
                 """Dense decode chunk with the same ON-DEVICE stop tracking as
                 the paged chunk (see above); frozen rows re-write their frozen
@@ -668,7 +721,7 @@ class ContinuousBatchingRunner:
                 keys = jax.random.split(key, num_steps)
 
                 def body(carry, step_key):
-                    tok, pos, alive, budget, cache = carry
+                    tok, pos, alive, budget, cache, telem = carry
                     with jax.default_matmul_precision(precision):
                         logits, cache = decode_core(
                             params, args, tok[:, None], pos, cache, decode_bucket,
@@ -682,32 +735,41 @@ class ContinuousBatchingRunner:
                                                       sampling_params,
                                                       step_key, odsc,
                                                       mesh=mesh, rules=rules)
+                    telem = dtel.decode_tick(telem, alive, nxt, eos_ids)
+                    telem = dtel.dense_kv_tick(telem, alive)
                     nxt = jnp.where(alive, nxt, tok)
                     pos = pos + alive.astype(pos.dtype)
                     budget = budget - alive.astype(budget.dtype)
                     alive = jnp.logical_and(alive, budget > 0)
                     alive = jnp.logical_and(alive, nxt != eos_ids)
-                    return (nxt, pos, alive, budget, cache), nxt
+                    return (nxt, pos, alive, budget, cache, telem), nxt
 
-                (tok_l, pos_l, alive_l, budget_l, cache), toks = jax.lax.scan(
-                    body, (tok0, positions, alive0, budget0, cache), keys)
-                return toks.T, (tok_l, pos_l, alive_l, budget_l), cache
+                (tok_l, pos_l, alive_l, budget_l, cache, telem), toks = \
+                    jax.lax.scan(
+                        body, (tok0, positions, alive0, budget0, cache, telem),
+                        keys)
+                telem = dtel.bump_kind(telem, dtel.KIND_DECODE)
+                return toks.T, (tok_l, pos_l, alive_l, budget_l), cache, telem
 
-            def _window(params, input_ids, start, slot, cache, adapter_row,
-                        decode_bucket):
+            def _window(params, input_ids, start, slot, cache, telem, n_real,
+                        adapter_row, decode_bucket):
                 """Batch-1 dense windowed-prefill step at cache row ``slot`` (dense
                 analog of the paged chunked insert; ≈ windowed CTE,
-                `model_base.py:918-973`)."""
+                `model_base.py:918-973`). ``n_real``: host-known count of real
+                (non-padding) prompt tokens in this window, for the carry."""
                 pos = jnp.full((1,), start, dtype=jnp.int32)
                 with jax.default_matmul_precision(precision):
                     _, cache = model_base.decode_forward(
                         params, args, input_ids, pos, cache, decode_bucket,
                         mesh=mesh, rules=rules, window_row=slot,
                         adapter_ids=adapter_row)
-                return cache
+                telem = telem.at[dtel.IDX_PREFILL].add(n_real)
+                telem = telem.at[dtel.IDX_KV_WRITES].add(n_real)
+                telem = dtel.bump_kind(telem, dtel.KIND_INSERT_WINDOW)
+                return cache, telem
 
-            def _seed(params, tok, pos, slot, cache, sampling_params, key,
-                      adapter_row, decode_bucket):
+            def _seed(params, tok, pos, slot, cache, telem, sampling_params,
+                      key, adapter_row, emit_seed, decode_bucket):
                 """Re-feed the prompt's last token (idempotent KV rewrite) to obtain
                 seed logits after a windowed insert."""
                 with jax.default_matmul_precision(precision):
@@ -717,19 +779,25 @@ class ContinuousBatchingRunner:
                         adapter_ids=adapter_row)
                 out = sampling_ops.sample(logits[:, -1], sampling_params, key,
                                           odsc, mesh=mesh, rules=rules)
-                return out, cache
+                telem = dtel.seed_tick(telem, emit_seed)
+                telem = dtel.bump_kind(telem, dtel.KIND_INSERT_WINDOW)
+                return out, cache, telem
 
             self._insert_step = audited_jit(
-                _insert, kind="cb.dense.insert", cache_args=("cache",))
+                _insert, kind="cb.dense.insert", cache_args=("cache",),
+                carry_args=("telem",))
             self._decode_step = audited_jit(
                 _decode, kind="cb.dense.decode", cache_args=("cache",),
+                carry_args=("telem",),
                 static_argnames=("decode_bucket", "num_steps", "greedy"),
                 steps_arg="num_steps")
             self._window_step = audited_jit(
                 _window, kind="cb.dense.window", cache_args=("cache",),
+                carry_args=("telem",),
                 static_argnames=("decode_bucket",))
             self._seed_step = audited_jit(
                 _seed, kind="cb.dense.seed", cache_args=("cache",),
+                carry_args=("telem",),
                 static_argnames=("decode_bucket",))
 
         if self.draft is not None:
@@ -759,8 +827,8 @@ class ContinuousBatchingRunner:
         odsc = self.sampling_config
 
         def _insert_eagle(t_params, d_params, input_ids, position_ids,
-                          last_token_idx, t_cache, d_cache, bt_row, slot_map,
-                          sampling_params, key, h_prev):
+                          last_token_idx, t_cache, d_cache, telem, bt_row,
+                          slot_map, sampling_params, key, h_prev, emit_seed):
             """One prefix-prefill window: target (samples seed token, returns
             hiddens) + EAGLE draft prefill conditioned on the shifted hiddens
             (h_prev = last hidden of the previous window; zeros for the first)."""
@@ -784,22 +852,29 @@ class ContinuousBatchingRunner:
                     slot_mapping=slot_map)
                 h_last = jnp.take_along_axis(
                     h_full, last_token_idx[:, None, None], axis=1)[:, 0]
-            return tok, h_last, t_cache, d_cache
+            telem = dtel.prefill_tick(telem, slot_map, bs_blk)
+            telem = dtel.seed_tick(telem, emit_seed)
+            telem = dtel.bump_kind(telem, dtel.KIND_INSERT_WINDOW)
+            return tok, h_last, t_cache, d_cache, telem
 
         self._insert_step_eagle = audited_jit(
             _insert_eagle, kind="cb.eagle.insert",
-            cache_args=("t_cache", "d_cache"))
+            cache_args=("t_cache", "d_cache"), carry_args=("telem",))
 
         def _eagle_chunk(t_params, d_params, tok0, h0, positions, alive0,
-                         t_cache, d_cache, block_table, eos_ids, key,
-                         num_iters):
+                         budget0, t_cache, d_cache, telem, block_table,
+                         eos_ids, key, num_iters):
             """``num_iters`` on-device EAGLE iterations: K-1 hidden-conditioned
             draft proposals + wide K verify (greedy exact-match acceptance),
-            per-row positions AND conditioning hiddens advancing in-graph."""
+            per-row positions AND conditioning hiddens advancing in-graph.
+            ``budget0`` feeds the telemetry carry's counting-only replay of
+            the host commit rules (the real advance ignores budgets — the
+            host truncates at commit, utils/device_telemetry.spec_tick)."""
             del key                      # greedy: no sampling noise
 
             def one_iter(carry, _):
-                tok, h, pos, alive, t_cache, d_cache = carry
+                tok, h, pos, alive, alive_t, budget_t, t_cache, d_cache, \
+                    telem = carry
                 p = pos[:, None] + jnp.arange(k, dtype=jnp.int32)[None, :]
                 blk = jnp.take_along_axis(
                     block_table, jnp.minimum(p // bs_blk, mb - 1), axis=1)
@@ -843,21 +918,28 @@ class ContinuousBatchingRunner:
 
                 take, new_tok, alive_next = spec_lib.chunk_advance(
                     alive, t_toks, n, eos_ids)
+                telem = dtel.kv_tick(telem, sm, bs_blk)
+                telem, alive_t, budget_t = dtel.spec_tick(
+                    telem, alive_t, budget_t, t_toks, n, eos_ids)
                 h_next = jnp.take_along_axis(
                     t_h, n[:, None, None], axis=1)[:, 0]    # hidden at slot n
                 tok = jnp.where(take > 0, new_tok, tok)
                 h = jnp.where((take > 0)[:, None], h_next, h)
                 pos = pos + take
-                return (tok, h, pos, alive_next, t_cache, d_cache), (t_toks, n)
+                return (tok, h, pos, alive_next, alive_t, budget_t, t_cache,
+                        d_cache, telem), (t_toks, n)
 
-            (_, h_out, _, _, t_cache, d_cache), (outs, ns) = jax.lax.scan(
-                one_iter, (tok0, h0, positions, alive0, t_cache, d_cache),
-                None, length=num_iters)
-            return outs, ns, h_out, t_cache, d_cache
+            (_, h_out, _, _, _, _, t_cache, d_cache, telem), (outs, ns) = \
+                jax.lax.scan(
+                    one_iter, (tok0, h0, positions, alive0, alive0, budget0,
+                               t_cache, d_cache, telem),
+                    None, length=num_iters)
+            telem = dtel.bump_kind(telem, dtel.KIND_SPEC)
+            return outs, ns, h_out, t_cache, d_cache, telem
 
         self._spec_step_eagle = audited_jit(
             _eagle_chunk, kind="cb.eagle.chunk",
-            cache_args=("t_cache", "d_cache"),
+            cache_args=("t_cache", "d_cache"), carry_args=("telem",),
             static_argnames=("num_iters",), steps_arg="num_iters")
 
     def _build_spec_steps(self) -> None:
@@ -901,13 +983,15 @@ class ContinuousBatchingRunner:
         d_skip = (dict(skip_logits=True)
                   if d_decode is model_base.decode_forward else {})
 
-        def _spec_chunk(t_params, d_params, tok0, positions, alive0, t_cache,
-                        d_cache, block_table, sampling_params, eos_ids, key,
-                        adapter_ids, num_iters, greedy, decode_bucket=None):
+        def _spec_chunk(t_params, d_params, tok0, positions, alive0, budget0,
+                        t_cache, d_cache, telem, block_table, sampling_params,
+                        eos_ids, key, adapter_ids, num_iters, greedy,
+                        decode_bucket=None):
             iter_keys = jax.random.split(key, num_iters)
 
             def one_iter(carry, key_i):
-                tok, pos, alive, t_cache, d_cache = carry
+                tok, pos, alive, alive_t, budget_t, t_cache, d_cache, \
+                    telem = carry
                 key_d, key_acc = jax.random.split(key_i)
                 d_keys = jax.random.split(key_d, k - 1)
                 if paged:
@@ -982,17 +1066,29 @@ class ContinuousBatchingRunner:
                 # (the host replays the exact same stopping rule when committing)
                 take, new_tok, alive_next = spec_lib.chunk_advance(
                     alive, out_toks, n, eos_ids)
+                if paged:
+                    telem = dtel.kv_tick(telem, sm, bs)
+                else:
+                    # dense verify writes K slots per live row
+                    telem = telem.at[dtel.IDX_KV_WRITES].add(
+                        k * jnp.sum(alive))
+                telem, alive_t, budget_t = dtel.spec_tick(
+                    telem, alive_t, budget_t, out_toks, n, eos_ids)
                 tok = jnp.where(take > 0, new_tok, tok)
                 pos = pos + take
-                return (tok, pos, alive_next, t_cache, d_cache), (out_toks, n)
+                return (tok, pos, alive_next, alive_t, budget_t, t_cache,
+                        d_cache, telem), (out_toks, n)
 
-            (_, _, _, t_cache, d_cache), (outs, ns) = jax.lax.scan(
-                one_iter, (tok0, positions, alive0, t_cache, d_cache), iter_keys)
-            return outs, ns, t_cache, d_cache
+            (_, _, _, _, _, t_cache, d_cache, telem), (outs, ns) = \
+                jax.lax.scan(
+                    one_iter, (tok0, positions, alive0, alive0, budget0,
+                               t_cache, d_cache, telem), iter_keys)
+            telem = dtel.bump_kind(telem, dtel.KIND_SPEC)
+            return outs, ns, t_cache, d_cache, telem
 
         self._spec_step = audited_jit(
             _spec_chunk, kind="cb.spec.chunk",
-            cache_args=("t_cache", "d_cache"),
+            cache_args=("t_cache", "d_cache"), carry_args=("telem",),
             static_argnames=("num_iters", "greedy", "decode_bucket"),
             steps_arg="num_iters")
 
@@ -1000,9 +1096,9 @@ class ContinuousBatchingRunner:
             t_base = t_decode is model_base.decode_forward
 
             def _insert_pair(t_params, d_params, input_ids, position_ids,
-                             last_token_idx, t_cache, d_cache, bt_row,
+                             last_token_idx, t_cache, d_cache, telem, bt_row,
                              slot_mapping, sampling_params, key, adapter_row,
-                             final):
+                             emit_seed, final):
                 """One prefix-prefill window for BOTH pools in ONE dispatch —
                 the draft insert was previously a second jitted call per
                 window (its own ~dispatch-floor of host latency every
@@ -1033,11 +1129,15 @@ class ContinuousBatchingRunner:
                         d_params, d_args, input_ids, position_ids, d_cache,
                         None, mesh=d_mesh, rules=d_rules, block_table=bt_row,
                         slot_mapping=slot_mapping, **d_skip)
-                return tok, t_cache, d_cache
+                telem = dtel.prefill_tick(telem, slot_mapping, bs)
+                if final:
+                    telem = dtel.seed_tick(telem, emit_seed)
+                telem = dtel.bump_kind(telem, dtel.KIND_INSERT_WINDOW)
+                return tok, t_cache, d_cache, telem
 
             self._insert_pair_step = audited_jit(
                 _insert_pair, kind="cb.spec.insert_pair",
-                cache_args=("t_cache", "d_cache"),
+                cache_args=("t_cache", "d_cache"), carry_args=("telem",),
                 static_argnames=("final",))
         else:
             d_prefill = draft.prefill_fn()
@@ -1095,13 +1195,153 @@ class ContinuousBatchingRunner:
         else:
             self._m_round_trip.set(v)
 
+    # ------------------------------------------ device-resident telemetry carry
+    def _carry_replay_state(self):
+        """Per-row (alive, budget, eos_id) counting state for the telemetry
+        carry's in-graph replay of the host commit rules — THE one
+        definition all step kinds share (plain/mixed/spec), so the replay
+        rule cannot desynchronize between sites. Must be built AFTER any
+        block-growth preemption: a preempted victim's tokens were always
+        host-discarded, so the counting roster has to see the
+        post-preemption state."""
+        alive = np.array([r is not None and not r.done and not r.inserting
+                          for r in self.active])
+        budget = np.array([(r.max_new_tokens - len(r.generated))
+                           if (r is not None and not r.done
+                               and not r.inserting)
+                           else 0 for r in self.active], dtype=np.int32)
+        eos_ids = np.array(
+            [(-1 if r is None or r.eos_token_id is None else r.eos_token_id)
+             for r in self.active], dtype=np.int32)
+        return alive, budget, eos_ids
+
+    def _drain_device_telemetry(self) -> None:
+        """Fetch the cumulative in-graph counter block and fold it into the
+        telemetry (latest snapshot + the flight-recorder ring's newest step
+        record). Zero new host syncs by construction: only runs when the
+        dispatch pipeline is EMPTY, i.e. the newest dispatch's tokens were
+        already synced this step — in async steady state the fetch is skipped
+        and the drained counters lag by up to ``async_depth`` chunks (they
+        catch up exactly at the next pipeline flush)."""
+        # identity dirty-check: every dispatch returns a NEW carry array, so
+        # `is` on the last-drained object skips the fetch (and a duplicate
+        # JSONL device_counters line) when nothing was dispatched since —
+        # e.g. a stats() call right after the step epilogue already drained
+        if (not self.telemetry.enabled or self._inflight
+                or self._telem_dev is self._telem_drained):
+            return
+        self.telemetry.note_device_counters(
+            dtel.to_dict(np.asarray(self._telem_dev)))
+        self._telem_drained = self._telem_dev
+
+    def reset_device_telemetry(self) -> None:
+        """Zero the device counter block (bench measurement windows). Only
+        legal with an empty dispatch pipeline — the carry of an in-flight
+        chunk cannot be replaced without corrupting the chain."""
+        if self._inflight:
+            raise RuntimeError("cannot reset the device telemetry carry with "
+                               "chunks in flight — drain the pipeline first")
+        self._telem_dev = dtel.init_carry()
+        self._telem_drained = self._telem_dev
+        self.telemetry.note_device_counters(
+            dtel.to_dict(np.zeros((dtel.CARRY_LEN,), np.int32)))
+
+    # telemetry step kind -> jit-program name substrings of the dispatches
+    # that serve it (the profiler's device-time attribution key; the jitted
+    # fn `_decode` lowers as `jit__decode`). The insert FAMILY shares
+    # substrings (`_insert` also matches `_insert_nol`/`_insert_pair`/
+    # `_insert_eagle`), so attribution MERGES the `insert`/`insert_window`
+    # step kinds into one `insert` row — per-kind rows would double-count
+    # the shared device events and publish a meaningless (often negative)
+    # gap whenever both kinds occur in one profiled window.
+    DISPATCH_KIND_EVENTS = {
+        "decode": ("_decode",),
+        "spec_chunk": ("_spec_chunk", "_eagle_chunk"),
+        "mixed": ("_mixed",),
+        "insert": ("_insert", "_window", "_seed"),
+    }
+
+    @staticmethod
+    def _attr_family(kind: str) -> str:
+        return "insert" if kind in ("insert", "insert_window") else kind
+
+    def attribute_device_time(self, logdir: str, plane_substr: str = "tpu",
+                              since_ts: Optional[float] = None
+                              ) -> Dict[str, dict]:
+        """Per-dispatch-kind device-time attribution from a jax.profiler trace
+        captured over a serving window (scripts/profile_serving.py drives
+        this; utils/profiling.device_time_by_substr parses the xplane dump).
+
+        For every step kind the telemetry observed, reports total on-device
+        time, total host span (the step timeline's dur_s), dispatch count,
+        and the host-device GAP — the dispatch-floor decomposition ROADMAP
+        open item 2 targets. Lands in the metrics registry
+        (``serving_device_time_ms{kind=}`` / ``serving_dispatch_gap_ms{kind=}``)
+        and in ``stats()["timing"]``. Device totals are None when the trace
+        carries no matching events (e.g. an unlabelled backend).
+
+        PRECONDITION: host spans come from the telemetry step timeline, so
+        the timeline must cover the SAME window as the trace — either call
+        ``telemetry.reset()`` immediately before tracing (what
+        scripts/profile_serving.py and bench.py do) or pass ``since_ts``
+        (telemetry-epoch seconds: the newest ``steps[-1]["ts"]`` before the
+        trace started) to window the host side; otherwise host_ms covers the
+        whole session while device_ms covers only the trace, and the gap
+        inflates silently."""
+        from ..utils import profiling
+
+        steps = [s for s in self.telemetry.steps
+                 if since_ts is None or s["ts"] >= since_ts]
+        kinds = sorted({self._attr_family(s["kind"]) for s in steps})
+        dev = profiling.device_time_by_substr(
+            logdir, {k: self.DISPATCH_KIND_EVENTS.get(k, (k,))
+                     for k in kinds}, plane_substr=plane_substr)
+        host_ms: Dict[str, float] = {}
+        n_disp: Dict[str, int] = {}
+        for s in steps:
+            k = self._attr_family(s["kind"])
+            host_ms[k] = host_ms.get(k, 0.0) + s["dur_s"] * 1e3
+            n_disp[k] = n_disp.get(k, 0) + 1
+        reg = self.telemetry.registry
+        timing: Dict[str, dict] = {}
+        for kind in kinds:
+            d_ms = dev.get(kind)
+            h_ms = host_ms.get(kind, 0.0)
+            n = max(1, n_disp.get(kind, 0))
+            gap = None if d_ms is None else h_ms - d_ms
+            timing[kind] = {
+                "dispatches": n_disp.get(kind, 0),
+                "device_ms": None if d_ms is None else round(d_ms, 3),
+                "host_ms": round(h_ms, 3),
+                "device_ms_per_dispatch": (None if d_ms is None
+                                           else round(d_ms / n, 3)),
+                "dispatch_gap_ms": (None if gap is None
+                                    else round(gap / n, 3)),
+            }
+            if d_ms is not None:
+                reg.gauge("serving_device_time_ms",
+                          "on-device ms attributed to this dispatch kind "
+                          "over the profiled window",
+                          labels={"kind": kind}).set(d_ms)
+                reg.gauge("serving_dispatch_gap_ms",
+                          "host-span minus device-time per dispatch "
+                          "(the dispatch floor's host share)",
+                          labels={"kind": kind}).set(gap / n)
+        self.telemetry.set_device_timing(timing)
+        return timing
+
     def stats(self) -> Dict[str, object]:
         """Point-in-time serving snapshot: telemetry aggregates (TTFT/TPOT/
-        queue-wait percentiles, per-kind step counts — populated only when
-        telemetry is enabled) plus the always-on runner state (queue depth,
-        occupancy, KV blocks, preemptions, spec acceptance)."""
+        queue-wait percentiles, per-kind step counts, drained device counters,
+        profiled per-kind timing — populated only when telemetry is enabled)
+        plus the always-on runner state (queue depth, occupancy, KV blocks,
+        preemptions, spec acceptance)."""
         from ..utils import metrics as metrics_lib
 
+        # refresh the drained device counters when it costs nothing (pipeline
+        # empty — the sync already happened); in async steady state the last
+        # drained snapshot is reported as-is (it lags by design)
+        self._drain_device_telemetry()
         s = self.telemetry.snapshot()
         s["num_slots"] = self.num_slots
         s["queue_depth"] = len(self.queue)
@@ -1379,12 +1619,22 @@ class ContinuousBatchingRunner:
             emitted = self._step_mixed(key, emitted)
         else:
             emitted = self._step_plain(key, emitted)
+        # all requests finished with chunks still in flight: the trailing
+        # dispatch-ahead chunks hold only device-frozen rows (the in-graph
+        # stop rules), so committing them adds nothing — flush the pipeline
+        # so the runner (and the telemetry carry drain below) ends clean
+        # instead of parking a dead chunk forever
+        if self._inflight and not self.has_work:
+            self._drain(emitted)
         # telemetry epilogue (single attribute test when disabled): fold this
         # step's emissions into the per-request records (first-token / commit
-        # events) and refresh the queue gauge
+        # events), refresh the queue gauge, and drain the device counter
+        # carry when the pipeline is empty (zero new syncs — the newest
+        # dispatch was already synced on that path)
         if self.telemetry.enabled:
             self.telemetry.note_emitted(emitted)
             self.telemetry.set_queue_depth(len(self.queue))
+            self._drain_device_telemetry()
         return emitted
 
     @step_loop_body
@@ -1429,45 +1679,47 @@ class ContinuousBatchingRunner:
         sp = self._sampling_matrix()
         greedy = self._chunk_greedy(live)
         adapters = jnp.asarray(self.adapter_ids)
+        t_dispatch = time.perf_counter() if self._async_auto else None
+        if self.paged:
+            # grow (and possibly PREEMPT) before building the dispatch state:
+            # a preempted victim must not be counted alive by the device
+            # telemetry carry (its tokens were always host-discarded; the
+            # counting replay has to see the post-preemption roster too)
+            active_rows = self._grow_blocks(active_rows, pend_steps + steps)
+            if not active_rows:
+                self._drain(emitted)
+                return emitted
+        alive_h, budget_h, eos_h = self._carry_replay_state()
         if self._dev_state is not None:
             tok0, pos_dev, alive_dev, budget_dev = self._dev_state
         else:
             tok0 = jnp.asarray(self.last_tok)
             pos_dev = jnp.asarray(self.positions)
-            alive_dev = jnp.asarray(
-                np.array([r is not None and not r.done and not r.inserting
-                          for r in self.active]))
-            budget_dev = jnp.asarray(
-                np.array([(r.max_new_tokens - len(r.generated))
-                          if (r is not None and not r.done and not r.inserting)
-                          else 0 for r in self.active], dtype=np.int32))
-        eos_ids = jnp.asarray(np.array(
-            [(-1 if r is None or r.eos_token_id is None else r.eos_token_id)
-             for r in self.active], dtype=np.int32))
-        t_dispatch = time.perf_counter() if self._async_auto else None
+            alive_dev = jnp.asarray(alive_h)
+            budget_dev = jnp.asarray(budget_h)
+        eos_ids = jnp.asarray(eos_h)
         if self.paged:
-            active_rows = self._grow_blocks(active_rows, pend_steps + steps)
-            if not active_rows:
-                self._drain(emitted)
-                return emitted
-            valid = np.array([r is not None and not r.done and not r.inserting
-                              for r in self.active])
             slot_chunk = self._slot_mapping_fn(
-                self.block_table, positions, steps, self.block_size, valid=valid)
+                self.block_table, positions, steps, self.block_size,
+                valid=alive_h)
             with tel.annotate("decode"):
-                toks_dev, dev_state, self.cache = self._decode_step(
-                    self.app.params, tok0, pos_dev, alive_dev, budget_dev,
-                    self.cache,
-                    jnp.asarray(self.block_table), jnp.asarray(slot_chunk),
-                    sp, sub, adapters, eos_ids, num_steps=steps, greedy=greedy)
+                toks_dev, dev_state, self.cache, self._telem_dev = \
+                    self._decode_step(
+                        self.app.params, tok0, pos_dev, alive_dev, budget_dev,
+                        self.cache, self._telem_dev,
+                        jnp.asarray(self.block_table), jnp.asarray(slot_chunk),
+                        sp, sub, adapters, eos_ids, num_steps=steps,
+                        greedy=greedy)
         else:
             bucket = autobucketing.select_bucket(self.app.tkg_buckets,
                                                  max_pos + steps)
             with tel.annotate("decode"):
-                toks_dev, dev_state, self.cache = self._decode_step(
-                    self.app.params, tok0, pos_dev, alive_dev, budget_dev,
-                    self.cache, sp, sub, adapters, eos_ids,
-                    decode_bucket=bucket, num_steps=steps, greedy=greedy)
+                toks_dev, dev_state, self.cache, self._telem_dev = \
+                    self._decode_step(
+                        self.app.params, tok0, pos_dev, alive_dev, budget_dev,
+                        self.cache, self._telem_dev, sp, sub, adapters,
+                        eos_ids, decode_bucket=bucket, num_steps=steps,
+                        greedy=greedy)
 
         if self._async_ok(pend_steps + steps + chunk):
             # steady state: append the new chunk, keep at most async_depth in
@@ -1615,6 +1867,10 @@ class ContinuousBatchingRunner:
         chunk_lens = np.zeros((c_rows,), np.int32)
         chunk_sp = np.tile(self._default_sp_row, (c_rows, 1))
         chunk_ad = np.zeros((c_rows,), np.int32)
+        # telemetry-carry seed flag: 1 for chunk rows whose window completes
+        # the prompt AND whose sampled seed the host will emit (resumed
+        # re-inserts discard it) — host-known at dispatch time
+        chunk_emit = np.zeros((c_rows,), np.int32)
         for i, (r, wlen) in enumerate(chosen):
             chunk_ids[i, :wlen] = r.fed[r.insert_pos : r.insert_pos + wlen]
             chunk_pos[i] = r.insert_pos
@@ -1623,29 +1879,37 @@ class ContinuousBatchingRunner:
             chunk_lens[i] = wlen
             chunk_sp[i] = self._slot_sp[r.slot]
             chunk_ad[i] = self.adapter_ids[r.slot]
+            chunk_emit[i] = int(r.insert_pos + wlen >= len(r.fed)
+                                and not r.generated)
         # padded chunk rows write nothing (all slots -1); live rows commit
         # their consecutive run through the chunk-length one-RMW-per-window
         # write path
         chunk_slots = block_kvcache.make_chunk_slot_mapping(
             chunk_bt, chunk_pos, chunk_lens, t_bucket, self.block_size)
 
-        valid = np.array([r is not None and not r.done and not r.inserting
-                          for r in self.active])
+        # telemetry-carry counting state: the mixed scan itself advances every
+        # slot; the carry replays the host's budget/eos commit rules so the
+        # drained counters match the host exactly (tokens stay ungated)
+        valid, budget0, eos_ids = self._carry_replay_state()
         slot_chunk = self._slot_mapping_fn(
             self.block_table, self.positions, steps, self.block_size,
             valid=valid)
         greedy = self._chunk_greedy(live + [r for r, _ in chosen])
         key, sub = jax.random.split(key)
         with tel.annotate("mixed"):
-            toks_dev, chunk_tok_dev, self.cache = self._mixed_step(
-                self.app.params, jnp.asarray(self.last_tok),
-                jnp.asarray(self.positions), self.cache,
-                jnp.asarray(self.block_table), jnp.asarray(slot_chunk),
-                jnp.asarray(chunk_ids), jnp.asarray(chunk_pos),
-                jnp.asarray(chunk_qlens), jnp.asarray(chunk_bt),
-                jnp.asarray(chunk_slots), self._sampling_matrix(),
-                jnp.asarray(chunk_sp), sub, jnp.asarray(self.adapter_ids),
-                jnp.asarray(chunk_ad), num_steps=steps, greedy=greedy)
+            toks_dev, chunk_tok_dev, self.cache, self._telem_dev = \
+                self._mixed_step(
+                    self.app.params, jnp.asarray(self.last_tok),
+                    jnp.asarray(self.positions), jnp.asarray(valid),
+                    jnp.asarray(budget0), self.cache, self._telem_dev,
+                    jnp.asarray(self.block_table), jnp.asarray(slot_chunk),
+                    jnp.asarray(chunk_ids), jnp.asarray(chunk_pos),
+                    jnp.asarray(chunk_qlens), jnp.asarray(chunk_bt),
+                    jnp.asarray(chunk_slots), jnp.asarray(chunk_emit),
+                    self._sampling_matrix(),
+                    jnp.asarray(chunk_sp), sub, jnp.asarray(self.adapter_ids),
+                    jnp.asarray(chunk_ad), jnp.asarray(eos_ids),
+                    num_steps=steps, greedy=greedy)
 
         if live:
             self._commit(np.asarray(toks_dev), steps, emitted)
@@ -1721,36 +1985,37 @@ class ContinuousBatchingRunner:
             active_rows = self._grow_blocks(active_rows, iters * self.k)
             if not active_rows:
                 return emitted
-        alive0 = np.array([r is not None and not r.done and not r.inserting
-                           for r in self.active])
-        eos_ids = np.array(
-            [(-1 if r is None or r.eos_token_id is None else r.eos_token_id)
-             for r in self.active], dtype=np.int32)
+        # per-row remaining budgets for the telemetry carry's commit_row
+        # replay (the real in-graph advance ignores budgets by design)
+        alive0, budget0, eos_ids = self._carry_replay_state()
         key, sub = jax.random.split(key)
         sp = self._sampling_matrix()
         bt = (jnp.asarray(self.block_table) if self.paged
               else jnp.zeros((1, 1), dtype=jnp.int32))
         if self.eagle is not None:
             with tel.annotate("spec_chunk"):
-                outs, ns, self._h_cond, self.cache, self.d_cache = \
-                    self._spec_step_eagle(
+                outs, ns, self._h_cond, self.cache, self.d_cache, \
+                    self._telem_dev = self._spec_step_eagle(
                         self.app.params, self.eagle[1],
                         jnp.asarray(self.last_tok),
                         self._h_cond, jnp.asarray(self.positions),
-                        jnp.asarray(alive0), self.cache, self.d_cache, bt,
+                        jnp.asarray(alive0), jnp.asarray(budget0),
+                        self.cache, self.d_cache, self._telem_dev, bt,
                         jnp.asarray(eos_ids), sub, num_iters=iters)
         else:
             bucket = (None if self.paged
                       else autobucketing.select_bucket(self.app.tkg_buckets,
                                                        max_pos + iters * self.k))
             with tel.annotate("spec_chunk"):
-                outs, ns, self.cache, self.d_cache = self._spec_step(
-                    self.app.params, self.draft.params,
-                    jnp.asarray(self.last_tok),
-                    jnp.asarray(self.positions), jnp.asarray(alive0),
-                    self.cache, self.d_cache, bt, sp, jnp.asarray(eos_ids),
-                    sub, jnp.asarray(self.adapter_ids), num_iters=iters,
-                    greedy=self._chunk_greedy(live), decode_bucket=bucket)
+                outs, ns, self.cache, self.d_cache, self._telem_dev = \
+                    self._spec_step(
+                        self.app.params, self.draft.params,
+                        jnp.asarray(self.last_tok),
+                        jnp.asarray(self.positions), jnp.asarray(alive0),
+                        jnp.asarray(budget0), self.cache, self.d_cache,
+                        self._telem_dev, bt, sp, jnp.asarray(eos_ids),
+                        sub, jnp.asarray(self.adapter_ids), num_iters=iters,
+                        greedy=self._chunk_greedy(live), decode_bucket=bucket)
         outs = np.asarray(outs)           # (iters, slots, K)
         ns = np.asarray(ns)               # (iters, slots)
         self._m_spec_iters.inc(iters)
@@ -1941,26 +2206,32 @@ class ContinuousBatchingRunner:
                 self.block_table[slot : slot + 1], pos_row, padded.bucket,
                 self.block_size, valid=valid))
             final = req.insert_pos + wlen >= len(fed)
+            # seed flag for the telemetry carry: the final window's sampled
+            # token counts as emitted only when the host will emit it
+            emit = np.int32(int(final and not req.generated))
             with tel.annotate("insert_window"):
                 if self.draft is not None:
                     key, sub = jax.random.split(key)
-                    tok_dev, self.cache, self.d_cache = self._insert_pair_step(
-                        self.app.params, self.draft.params, padded.input_ids,
-                        pos_row, padded.last_token_idx, self.cache,
-                        self.d_cache, bt_row, slot_map, sp_row, sub, ad_row,
-                        final=final)
+                    tok_dev, self.cache, self.d_cache, self._telem_dev = \
+                        self._insert_pair_step(
+                            self.app.params, self.draft.params,
+                            padded.input_ids, pos_row, padded.last_token_idx,
+                            self.cache, self.d_cache, self._telem_dev, bt_row,
+                            slot_map, sp_row, sub, ad_row, emit, final=final)
                     if final:
                         req.tok0_dev = tok_dev
                 elif final or self._insert_step_nol is None:
                     key, sub = jax.random.split(key)
-                    req.tok0_dev, self.cache = self._insert_step(
-                        self.app.params, padded.input_ids, pos_row,
-                        padded.last_token_idx, self.cache, bt_row, slot_map,
-                        sp_row, sub, ad_row)
+                    req.tok0_dev, self.cache, self._telem_dev = \
+                        self._insert_step(
+                            self.app.params, padded.input_ids, pos_row,
+                            padded.last_token_idx, self.cache,
+                            self._telem_dev, bt_row, slot_map,
+                            sp_row, sub, ad_row, emit)
                 else:
-                    self.cache = self._insert_step_nol(
+                    self.cache, self._telem_dev = self._insert_step_nol(
                         self.app.params, padded.input_ids, pos_row, self.cache,
-                        bt_row, slot_map, ad_row)
+                        self._telem_dev, bt_row, slot_map, ad_row)
             tel.request_prefill_chunk(req.request_id, int(wlen),
                                       int(req.insert_pos))
             req.insert_pos += wlen
@@ -1992,6 +2263,9 @@ class ContinuousBatchingRunner:
         sp_row = self._slot_sp[slot : slot + 1]
         ad_row = jnp.asarray(self.adapter_ids[slot : slot + 1])
 
+        # telemetry-carry seed flag: resumed (preempted) re-inserts discard
+        # their sampled seed, so the host passes 0
+        emit = np.int32(int(not req.generated))
         if self.paged:
             self._begin_insert(req, slot)
             key, _ = self._insert_windows(req, slot, key)   # records per window
@@ -2007,23 +2281,26 @@ class ContinuousBatchingRunner:
             ids[0, : len(fed)] = fed
             for w0 in range(0, total, w):
                 bkt = autobucketing.select_bucket(self.app.tkg_buckets, w0 + w)
-                self.cache = self._window_step(
+                self.cache, self._telem_dev = self._window_step(
                     self.app.params, ids[:, w0 : w0 + w], np.int32(w0),
-                    np.int32(slot), self.cache, ad_row, decode_bucket=bkt)
+                    np.int32(slot), self.cache, self._telem_dev,
+                    np.int32(max(0, min(w, len(fed) - w0))), ad_row,
+                    decode_bucket=bkt)
             key, sub = jax.random.split(key)
-            tok_dev, self.cache = self._seed_step(
+            tok_dev, self.cache, self._telem_dev = self._seed_step(
                 self.app.params, jnp.asarray(fed[-1:]),
                 np.array([len(fed) - 1], dtype=np.int32), np.int32(slot),
-                self.cache, sp_row, sub, ad_row,
+                self.cache, self._telem_dev, sp_row, sub, ad_row, emit,
                 decode_bucket=autobucketing.select_bucket(self.app.tkg_buckets,
                                                           len(fed)))
         else:
             padded = model_wrapper.pad_prefill_inputs(
                 fed[None, :], None, self.app.cte_buckets, batch_size=1)
-            tok_dev, self.cache = self._insert_step(
+            tok_dev, self.cache, self._telem_dev = self._insert_step(
                 self.app.params, padded.input_ids, padded.position_ids,
-                padded.last_token_idx, self.cache, jnp.asarray(slot, dtype=jnp.int32),
-                sp_row, key, ad_row)
+                padded.last_token_idx, self.cache, self._telem_dev,
+                jnp.asarray(slot, dtype=jnp.int32),
+                sp_row, key, ad_row, emit)
             if self.draft is not None:
                 self.d_cache = self._d_insert_step(
                     self.draft.params, padded.input_ids, padded.position_ids,
@@ -2067,14 +2344,16 @@ class ContinuousBatchingRunner:
                 self.block_size, valid=valid)
             key, sub = jax.random.split(key)
             t_w = self.telemetry.step_start()
+            final = start + len(window) >= len(fed)
+            emit = np.int32(int(final and not req.generated))
             with self.telemetry.annotate("insert_window"):
-                tok_dev, h_prev, self.cache, self.d_cache = \
+                tok_dev, h_prev, self.cache, self.d_cache, self._telem_dev = \
                     self._insert_step_eagle(
                         self.app.params, self.eagle[1], padded.input_ids,
                         pos_row, padded.last_token_idx, self.cache,
-                        self.d_cache,
+                        self.d_cache, self._telem_dev,
                         jnp.asarray(self.block_table[slot : slot + 1]),
-                        jnp.asarray(slot_map), sp_row, sub, h_prev)
+                        jnp.asarray(slot_map), sp_row, sub, h_prev, emit)
             self.telemetry.request_prefill_chunk(req.request_id, len(window),
                                                  start)
             if t_w is not None:

@@ -31,6 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..analysis.registry import audited_jit
+from ..utils import profiling
 from ..models import base as model_base
 from ..models import eagle as eagle_lib
 from ..models.base import ModelArchArgs
@@ -334,9 +335,12 @@ class Eagle3SpeculativeModel:
             kvcache.init_cache(self._draft_cache_spec()))
 
         t_start = time.perf_counter()
-        tok0_dev, g_dev, target.kv_cache, self.draft_cache = self._prefill_step(
-            target.params, self.draft_params, padded.input_ids, padded.position_ids,
-            padded.last_token_idx, target.kv_cache, self.draft_cache)
+        with profiling.annotate("dispatch:eagle3.prefill"):
+            tok0_dev, g_dev, target.kv_cache, self.draft_cache = \
+                self._prefill_step(
+                    target.params, self.draft_params, padded.input_ids,
+                    padded.position_ids, padded.last_token_idx,
+                    target.kv_cache, self.draft_cache)
         tok0 = np.asarray(tok0_dev)
         ttft = time.perf_counter() - t_start
 
@@ -375,13 +379,15 @@ class Eagle3SpeculativeModel:
             alive0 = np.array([i < b and not done[i]
                                and len(committed[i]) < max_new_tokens
                                for i in range(compiled_b)])
-            out_dev, n_dev, g_cond, target.kv_cache, self.draft_cache = \
-                self._spec_chunk(target.params, self.draft_params,
-                                 jnp.asarray(last_tok), g_cond,
-                                 jnp.asarray(positions), jnp.asarray(alive0),
-                                 target.kv_cache, self.draft_cache,
-                                 jnp.asarray(eos_ids), decode_bucket=bucket,
-                                 num_iters=iters)
+            with profiling.annotate("dispatch:eagle3.chunk"):
+                out_dev, n_dev, g_cond, target.kv_cache, self.draft_cache = \
+                    self._spec_chunk(
+                        target.params, self.draft_params,
+                        jnp.asarray(last_tok), g_cond,
+                        jnp.asarray(positions), jnp.asarray(alive0),
+                        target.kv_cache, self.draft_cache,
+                        jnp.asarray(eos_ids), decode_bucket=bucket,
+                        num_iters=iters)
             out = np.asarray(out_dev)    # (iters, B, depth+1)
             n = np.asarray(n_dev)        # (iters, B)
             steps += replay_chunk(out, n, committed, done, positions, last_tok,

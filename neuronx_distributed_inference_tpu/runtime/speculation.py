@@ -42,6 +42,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..analysis.registry import audited_jit
+from ..utils import profiling
 from ..config import OnDeviceSamplingConfig
 from ..models import base as model_base
 from ..modules import autobucketing
@@ -513,12 +514,14 @@ class FusedSpeculativeModel:
                                for i in range(compiled_b)])
             key, sub = jax.random.split(key)
             t_step0 = time.perf_counter()
-            ys, target.kv_cache, draft.kv_cache = self._spec_chunk(
-                target.params, draft.params, jnp.asarray(last_tok),
-                jnp.asarray(positions), jnp.asarray(alive0), target.kv_cache,
-                draft.kv_cache, sampling_params, jnp.asarray(eos_ids), sub,
-                decode_bucket=bucket, num_iters=iters,
-                with_draft_logits=capture_draft_logits)
+            with profiling.annotate("dispatch:spec.chunk"):
+                ys, target.kv_cache, draft.kv_cache = self._spec_chunk(
+                    target.params, draft.params, jnp.asarray(last_tok),
+                    jnp.asarray(positions), jnp.asarray(alive0),
+                    target.kv_cache, draft.kv_cache, sampling_params,
+                    jnp.asarray(eos_ids), sub,
+                    decode_bucket=bucket, num_iters=iters,
+                    with_draft_logits=capture_draft_logits)
             out = np.asarray(ys[0])      # (iters, B, K)
             n = np.asarray(ys[1])        # (iters, B)
             benchmark_lib.record_submodel(benchmark_lib.SPECULATION_MODEL,

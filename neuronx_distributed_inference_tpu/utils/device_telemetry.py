@@ -1,0 +1,170 @@
+"""Device-resident telemetry carry: a fixed-shape counter block accumulated
+IN-GRAPH by every continuous-batching dispatch kind.
+
+Why: host-side telemetry (utils/metrics.py) observes the runner at commit
+time, but depth-N dispatch-ahead already makes host step records lag the
+device by ``async_depth`` chunks, and the planned ``lax.while_loop``
+device-resident serving loop (ROADMAP open item 2) removes the per-step host
+boundary entirely. The carry keeps the counters WITH the computation: a small
+``(CARRY_LEN,)`` int32 vector threaded as a donated/aliased operand through
+every jitted serving step, updated with in-graph adds, and drained to the
+host only at sync points the runner already pays (the oldest-chunk commit /
+pipeline flush) — zero new host syncs, and the analysis/ auditor machine-
+checks the carry's aliasing and host-sync freedom like any cache operand
+(``audited_jit(carry_args=("telem",))``).
+
+Exactness contract: the token/eos/occupancy counters REPLAY the host's
+commit rules in-graph (budget and eos stops, ``runtime/speculation.commit_row``
+semantics for spec windows), so once the dispatch pipeline flushes the drained
+counters equal the host event-log recompute exactly — the property
+tests/test_device_telemetry.py pins across plain/spec/mixed/async paths.
+
+Counter layout (int32; document any change in docs/OBSERVABILITY.md):
+
+==================  =========================================================
+``tokens``          tokens committed by decode/spec/mixed iterations, under
+                    the host's exact budget/eos replay (seed tokens separate)
+``spec_accepted``   tokens committed by speculative acceptance (subset of
+                    ``tokens``; == ``tokens`` in pure-spec serving)
+``spec_cells``      live (row, iteration) cells in spec chunks — the
+                    acceptance-histogram count denominator
+``occupancy``       sum of live rows over decode iterations / spec cells
+                    (== ``tokens`` in non-spec serving, == ``spec_cells`` in
+                    spec serving)
+``kv_writes``       KV cache slots written (paged: valid slot-mapping
+                    entries; dense: live-row writes)
+``kv_blocks``       paged blocks newly entered (a valid slot at a block's
+                    first position)
+``eos``             rows stopped by emitting their eos token
+``prefill_tokens``  prompt tokens written by insert windows / mixed chunk rows
+``seed_tokens``     first tokens sampled at prompt completion that the host
+                    emits (flag-gated: resumed re-inserts pass 0)
+``step:<kind>``     dispatches per step kind (decode / spec_chunk / mixed /
+                    insert / insert_window)
+==================  =========================================================
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["CARRY_LEN", "FIELDS", "KINDS", "init_carry", "to_dict",
+           "decode_tick", "dense_kv_tick", "kv_tick", "prefill_tick",
+           "seed_tick", "spec_tick", "bump_kind"]
+
+# named scalar counters, then one dispatch counter per step kind
+FIELDS = ("tokens", "spec_accepted", "spec_cells", "occupancy", "kv_writes",
+          "kv_blocks", "eos", "prefill_tokens", "seed_tokens")
+KINDS = ("decode", "spec_chunk", "mixed", "insert", "insert_window")
+
+IDX_TOKENS = 0
+IDX_SPEC_ACCEPTED = 1
+IDX_SPEC_CELLS = 2
+IDX_OCCUPANCY = 3
+IDX_KV_WRITES = 4
+IDX_KV_BLOCKS = 5
+IDX_EOS = 6
+IDX_PREFILL = 7
+IDX_SEED = 8
+KIND_BASE = len(FIELDS)
+CARRY_LEN = KIND_BASE + len(KINDS)
+
+KIND_DECODE = KINDS.index("decode")
+KIND_SPEC = KINDS.index("spec_chunk")
+KIND_MIXED = KINDS.index("mixed")
+KIND_INSERT = KINDS.index("insert")
+KIND_INSERT_WINDOW = KINDS.index("insert_window")
+
+
+def init_carry():
+    """Fresh zeroed carry block (host- or device-side)."""
+    return jnp.zeros((CARRY_LEN,), jnp.int32)
+
+
+def to_dict(arr) -> Dict[str, int]:
+    """Host-side view of a drained carry: named counters + per-kind step
+    counts + the derived totals the tests/stats() read."""
+    arr = np.asarray(arr).astype(np.int64)
+    out = {name: int(arr[i]) for i, name in enumerate(FIELDS)}
+    out["steps"] = {k: int(arr[KIND_BASE + i]) for i, k in enumerate(KINDS)
+                    if arr[KIND_BASE + i]}
+    out["tokens_total"] = out["tokens"] + out["seed_tokens"]
+    return out
+
+
+# --------------------------------------------------------------- in-graph ticks
+# All helpers are pure jnp (trace-safe), take and return the carry vector, and
+# cost a handful of scalar reductions + dynamic-update-slices per call — noise
+# next to a decode iteration's weight stream.
+def decode_tick(telem, alive, nxt, eos_ids):
+    """One chained decode iteration: ``alive`` rows each commit one token
+    (``nxt``); a live row emitting its eos stops — the exact mirror of the
+    host's per-token commit/stop replay (ContinuousBatchingRunner._commit)."""
+    n = jnp.sum(alive)
+    telem = telem.at[IDX_TOKENS].add(n)
+    telem = telem.at[IDX_OCCUPANCY].add(n)
+    return telem.at[IDX_EOS].add(jnp.sum(alive & (nxt == eos_ids)))
+
+
+def kv_tick(telem, slots, block_size: int):
+    """Paged KV writes from a slot mapping (-1 = dropped write): valid slots
+    written, plus blocks newly entered (slot at a block's first position)."""
+    valid = slots >= 0
+    telem = telem.at[IDX_KV_WRITES].add(jnp.sum(valid))
+    return telem.at[IDX_KV_BLOCKS].add(
+        jnp.sum(valid & (slots % block_size == 0)))
+
+
+def dense_kv_tick(telem, alive):
+    """Dense-cache decode writes: one slot per live row (frozen rows re-write
+    their pinned position with identical bytes — not counted)."""
+    return telem.at[IDX_KV_WRITES].add(jnp.sum(alive))
+
+
+def prefill_tick(telem, slots, block_size: int):
+    """One paged insert window / mixed chunk row set: prompt tokens written =
+    valid slot-mapping entries (padding carries -1)."""
+    telem = telem.at[IDX_PREFILL].add(jnp.sum(slots >= 0))
+    return kv_tick(telem, slots, block_size)
+
+
+def seed_tick(telem, emit):
+    """Prompt-final sampled token: ``emit`` is the HOST-known 0/1 flag (a
+    resumed/preempted re-insert discards its seed, so the host passes 0)."""
+    return telem.at[IDX_SEED].add(emit)
+
+
+def spec_tick(telem, alive_t, budget, out_toks, n, eos_ids):
+    """One fused-speculation iteration, replaying ``commit_row`` exactly.
+
+    ``alive_t``/``budget`` are the COUNTING replay state (the device's real
+    alive mask ignores per-row budgets — the host truncates at commit; here
+    we truncate in-graph so the counters match the host): a row commits
+    ``min(n + 1, budget, first-eos-position + 1)`` tokens, dies on budget
+    exhaustion or an eos that lands within its committed window. Returns
+    ``(telem, alive_t, budget)`` for the next iteration."""
+    width = out_toks.shape[1]
+    take = n + 1
+    idx = jnp.arange(width, dtype=jnp.int32)[None, :]
+    is_eos = (out_toks == eos_ids[:, None]) & (idx < take[:, None])
+    eos_pos = jnp.min(jnp.where(is_eos, idx, width), axis=1)
+    committed = jnp.minimum(jnp.minimum(take, budget), eos_pos + 1)
+    committed = jnp.where(alive_t, committed, 0)
+    eos_hit = alive_t & (eos_pos + 1 == committed)
+    cells = jnp.sum(alive_t)
+    total = jnp.sum(committed)
+    telem = telem.at[IDX_TOKENS].add(total)
+    telem = telem.at[IDX_SPEC_ACCEPTED].add(total)
+    telem = telem.at[IDX_SPEC_CELLS].add(cells)
+    telem = telem.at[IDX_OCCUPANCY].add(cells)
+    telem = telem.at[IDX_EOS].add(jnp.sum(eos_hit))
+    budget = budget - committed
+    return telem, alive_t & (budget > 0) & ~eos_hit, budget
+
+
+def bump_kind(telem, kind_id: int):
+    """Count one dispatch of a (trace-time static) step kind."""
+    return telem.at[KIND_BASE + kind_id].add(1)

@@ -1,0 +1,162 @@
+"""Flight recorder: a bounded ring of the last N serving step records plus a
+one-call debug-bundle dump for faults.
+
+The ring shares the step-record dicts ``ServingTelemetry.step_record``
+appends (kind, host timestamps, duration, occupancy, KV state, in-flight
+depth — and, once the runner drains the in-graph carry, the cumulative
+device counters under ``"device"``), so it costs one deque append per
+dispatch and is always warm when something goes wrong.
+
+``dump_bundle`` writes a single self-contained JSON file: schema tag,
+wall-clock stamp, package/jax versions, the serving config, a metrics
+snapshot, the ring contents, and a pointer to any live XLA HLO dump
+(``--xla_dump_to``) — everything a bug report needs to be triaged without
+the box. ``load_bundle`` round-trips it (tests/test_flight_recorder_slo.py
+pins dump → parse → matches live ``stats()``).
+
+Fault hooks: ``install_signal_dump`` arms a SIGUSR1 (by default) handler
+that dumps the bundle from a live serving process; the CLI's
+``--debug-bundle`` flag additionally dumps on an unhandled serving-loop
+exception (inference_demo.py).
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import logging
+import os
+import re
+import signal
+import sys
+import time
+from typing import Callable, Dict, List, Optional
+
+logger = logging.getLogger("tpu-inference")
+
+__all__ = ["FlightRecorder", "BUNDLE_SCHEMA", "load_bundle",
+           "install_signal_dump"]
+
+BUNDLE_SCHEMA = "tpu-inference-debug-bundle/1"
+
+
+def _versions() -> Dict[str, str]:
+    out = {"python": sys.version.split()[0]}
+    for mod in ("jax", "jaxlib", "numpy"):
+        try:
+            out[mod] = __import__(mod).__version__
+        except Exception:
+            out[mod] = "unavailable"
+    return out
+
+
+def _hlo_dump_dir() -> Optional[str]:
+    """Pointer to a live XLA HLO dump if one is configured (the bundle
+    records WHERE the HLO landed, never the multi-GB dump itself)."""
+    m = re.search(r"--xla_dump_to=(\S+)", os.environ.get("XLA_FLAGS", ""))
+    return m.group(1) if m else None
+
+
+def _jsonable(obj):
+    """Best-effort JSON coercion: numpy scalars/arrays, dataclass-ish
+    configs, and anything else via repr — a debug bundle must never fail to
+    serialize because one field was exotic."""
+    import dataclasses
+
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    if isinstance(obj, dict):
+        return {str(k): _jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonable(v) for v in obj]
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return {f.name: _jsonable(getattr(obj, f.name))
+                for f in dataclasses.fields(obj)}
+    if hasattr(obj, "item") and getattr(obj, "ndim", None) == 0:
+        return obj.item()
+    if hasattr(obj, "tolist"):
+        return obj.tolist()
+    return repr(obj)
+
+
+class FlightRecorder:
+    """Bounded ring of the last ``capacity`` step records."""
+
+    def __init__(self, capacity: int = 256):
+        if capacity < 1:
+            raise ValueError("flight recorder capacity must be >= 1")
+        self.capacity = capacity
+        self._ring: collections.deque = collections.deque(maxlen=capacity)
+        self.dropped = 0              # records evicted by the ring bound
+
+    def record(self, rec: dict) -> None:
+        if len(self._ring) == self.capacity:
+            self.dropped += 1
+        self._ring.append(rec)
+
+    def records(self) -> List[dict]:
+        return list(self._ring)
+
+    def clear(self) -> None:
+        self._ring.clear()
+        self.dropped = 0
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+    # ------------------------------------------------------------------ bundle
+    def dump_bundle(self, path: str, *, config=None, metrics=None,
+                    stats=None, reason: str = "manual",
+                    extra: Optional[dict] = None) -> str:
+        """Write the debug bundle to ``path`` and return it.
+
+        ``config``: the serving TpuConfig (or any dataclass/dict);
+        ``metrics``: a MetricsRegistry dump (``registry.to_dict()``);
+        ``stats``: a live ``runner.stats()`` snapshot; ``reason``: what
+        triggered the dump (``manual`` / ``signal`` / ``exception`` / ...).
+        """
+        bundle = {
+            "schema": BUNDLE_SCHEMA,
+            "created_unix": time.time(),
+            "reason": reason,
+            "versions": _versions(),
+            "hlo_dump": _hlo_dump_dir(),
+            "config": _jsonable(config),
+            "metrics": _jsonable(metrics),
+            "stats": _jsonable(stats),
+            "ring": _jsonable(self.records()),
+            "ring_dropped": self.dropped,
+            "extra": _jsonable(extra),
+        }
+        tmp = f"{path}.tmp"
+        with open(tmp, "w") as fh:
+            json.dump(bundle, fh, indent=1)
+        os.replace(tmp, path)       # atomic: a fault mid-dump never truncates
+        return path
+
+
+def load_bundle(path: str) -> dict:
+    """Parse a debug bundle; raises on schema mismatch (a bundle from a
+    future incompatible layout must fail loudly, not half-parse)."""
+    with open(path) as fh:
+        bundle = json.load(fh)
+    if bundle.get("schema") != BUNDLE_SCHEMA:
+        raise ValueError(f"not a {BUNDLE_SCHEMA} bundle: "
+                         f"{bundle.get('schema')!r}")
+    return bundle
+
+
+def install_signal_dump(dump: Callable[[str], str],
+                        signum: int = signal.SIGUSR1):
+    """Arm ``signum`` to dump a debug bundle from a live serving process.
+
+    ``dump(reason)`` is the caller's closure (it knows the runner/paths);
+    returns the previous handler so callers can restore it."""
+    def _handler(sig, frame):
+        del sig, frame
+        try:
+            logger.warning("debug bundle written to %s", dump("signal"))
+        except Exception as e:                        # never kill the server
+            logger.warning("debug-bundle dump failed: %s", e)
+
+    return signal.signal(signum, _handler)

@@ -175,6 +175,11 @@ class MetricsRegistry:
                              f"{type(m).__name__}, requested {cls.__name__}")
         return m
 
+    def get(self, name: str, labels: Optional[Dict[str, str]] = None):
+        """Peek an instrument WITHOUT registering it (None when absent) —
+        read-side consumers (the SLO monitor) must not create series."""
+        return self._metrics.get(_key(name, labels))
+
     def counter(self, name: str, help: str = "",
                 labels: Optional[Dict[str, str]] = None) -> Counter:
         return self._get(Counter, name, help, labels)
@@ -271,12 +276,25 @@ class ServingTelemetry:
     def __init__(self, enabled: bool = True,
                  registry: Optional[MetricsRegistry] = None,
                  jsonl_path: Optional[str] = None,
-                 max_records: Optional[int] = 200_000):
+                 max_records: Optional[int] = 200_000,
+                 flight_records: int = 256):
         self.enabled = enabled
         self.registry = registry if registry is not None else MetricsRegistry()
         self.events: List[dict] = []        # lifecycle event log
         self.steps: List[dict] = []         # step timeline
         self.requests: Dict[int, dict] = {}
+        # flight recorder: bounded ring of the last N step records, dumpable
+        # as a debug bundle on fault/signal (utils/flight_recorder.py). The
+        # ring shares the step-record dicts, so drained device counters
+        # attached via note_device_counters() appear in the ring too.
+        from .flight_recorder import FlightRecorder
+
+        self.flight = FlightRecorder(flight_records) if flight_records else None
+        # latest drained device-counter snapshot (the in-graph telemetry
+        # carry, utils/device_telemetry.py) and the last profiled per-kind
+        # device-time attribution (runner.attribute_device_time)
+        self.device_counters: Optional[Dict[str, object]] = None
+        self.timing: Optional[Dict[str, dict]] = None
         # in-memory retention bound for long-lived serving: past
         # ``max_records`` entries per log the OLDEST quarter is dropped (and
         # counted — no silent truncation; the registry aggregates and the
@@ -502,12 +520,34 @@ class ServingTelemetry:
         self._g_occupancy.set(occupancy)
         self.steps.append(rec)
         self._trim(self.steps)
+        if self.flight is not None:
+            self.flight.record(rec)
         if self._jsonl is not None:
             self._jsonl.write(json.dumps({"event": "step", **rec}) + "\n")
 
     def set_queue_depth(self, n: int) -> None:
         if self.enabled:
             self._g_queue.set(n)
+
+    def note_device_counters(self, counters: Dict[str, object]) -> None:
+        """Fold a drained device-counter snapshot (the in-graph telemetry
+        carry) into the telemetry: becomes the latest ``device`` view in
+        snapshot()/stats(), and is attached to the newest step record so the
+        flight-recorder ring carries it (same dict object — the ring shares
+        step records)."""
+        if not self.enabled:
+            return
+        self.device_counters = counters
+        if self.steps:
+            self.steps[-1]["device"] = counters
+        if self._jsonl is not None:
+            self._jsonl.write(json.dumps(
+                {"event": "device_counters", **counters}) + "\n")
+
+    def set_device_timing(self, timing: Dict[str, dict]) -> None:
+        """Record a profiled per-kind device-time attribution (the runner's
+        attribute_device_time result) for snapshot()["timing"]."""
+        self.timing = timing
 
     def annotate(self, kind: str):
         """jax.profiler host span for a dispatch (aligns the step timeline
@@ -554,6 +594,11 @@ class ServingTelemetry:
             "tpot_ms": percentiles(tpot) if tpot else None,
             "queue_wait_ms": percentiles(queue_wait) if queue_wait else None,
             "counters": self.registry.to_dict(),
+            # latest drained in-graph counter block (lags by <= async_depth
+            # chunks in dispatch-ahead steady state; exact at pipeline flush)
+            "device": self.device_counters,
+            # per-kind device-time attribution of the last profiled window
+            "timing": self.timing,
         }
         return out
 
@@ -599,6 +644,10 @@ class ServingTelemetry:
         self.steps.clear()
         self.requests.clear()
         self.registry.reset()
+        self.device_counters = None
+        self.timing = None
+        if self.flight is not None:
+            self.flight.clear()
         self._t0 = time.perf_counter()
 
     def close(self) -> None:

@@ -6,11 +6,14 @@ Perfetto, plus XLA HLO dumps via XLA_FLAGS=--xla_dump_to)."""
 from __future__ import annotations
 
 import contextlib
+import logging
 import os
 import time
-from typing import Callable, Optional
+from typing import Callable, Dict, Mapping, Optional, Sequence
 
 import jax
+
+logger = logging.getLogger("tpu-inference")
 
 
 @contextlib.contextmanager
@@ -29,7 +32,22 @@ def profile_callable(fn: Callable, *args, logdir: str = "/tmp/tpu_profile",
     """Profile ``fn(*args, **kwargs)``: warm (compile), then trace ``iters`` runs.
 
     Returns (last_result, wall_seconds_per_iter). ≈ the reference's profile-largest-
-    bucket flow (`utils/profiling.py:66-121`) without the NEFF bookkeeping."""
+    bucket flow (`utils/profiling.py:66-121`) without the NEFF bookkeeping.
+
+    ``iters`` must be >= 1 (``iters=0`` used to return an UNBOUND result and
+    a meaningless time) and ``warmup`` >= 1 is required for an honest
+    per-iteration number: the first call compiles, so ``warmup=0`` folds
+    compile time into the reported wall time — allowed (cold-start studies
+    measure exactly that) but warned, never silent."""
+    if iters < 1:
+        raise ValueError(f"profile_callable needs iters >= 1 (got {iters}) — "
+                         f"0 iterations has no result or per-iter time")
+    if warmup < 0:
+        raise ValueError(f"warmup must be >= 0 (got {warmup})")
+    if warmup == 0:
+        logger.warning(
+            "profile_callable(warmup=0): the first traced call compiles, so "
+            "the reported per-iter wall time includes compile time")
     for _ in range(warmup):
         result = fn(*args, **kwargs)
         jax.block_until_ready(result)
@@ -38,7 +56,7 @@ def profile_callable(fn: Callable, *args, logdir: str = "/tmp/tpu_profile",
         for _ in range(iters):
             result = fn(*args, **kwargs)
             jax.block_until_ready(result)
-    return result, (time.perf_counter() - t0) / max(iters, 1)
+    return result, (time.perf_counter() - t0) / iters
 
 
 def enable_hlo_dump(dump_dir: str) -> None:
@@ -52,6 +70,31 @@ def enable_hlo_dump(dump_dir: str) -> None:
 def annotate(name: str):
     """Named trace span (shows up in the profiler timeline)."""
     return jax.profiler.TraceAnnotation(name)
+
+
+def _iter_xplane_events(logdir: str, plane_substr: str):
+    """Yield ``(event_name, duration_ms)`` for every event in the trace's
+    xplane dumps whose plane name matches ``plane_substr`` (case-insensitive;
+    "" = every plane). Yields nothing when the protobuf stack or the trace is
+    absent — callers treat "no events" as None, never as 0."""
+    import glob as _glob
+
+    os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
+    try:
+        from tensorflow.tsl.profiler.protobuf import xplane_pb2
+    except Exception:
+        return
+    for p in _glob.glob(f"{logdir}/**/*.xplane.pb", recursive=True):
+        xs = xplane_pb2.XSpace()
+        with open(p, "rb") as f:
+            xs.ParseFromString(f.read())
+        for plane in xs.planes:
+            if plane_substr and plane_substr.lower() not in plane.name.lower():
+                continue
+            md = plane.event_metadata
+            for line in plane.lines:
+                for ev in line.events:
+                    yield md[ev.metadata_id].name, ev.duration_ps / 1e9
 
 
 def device_time_ms(logdir: str, name_substr: str,
@@ -68,26 +111,30 @@ def device_time_ms(logdir: str, name_substr: str,
     CPU backend, which is how tests/test_profiling.py exercises this parser
     without accelerator hardware). Returns None when no trace/plane/event is
     found."""
-    import glob as _glob
-
-    os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
-    try:
-        from tensorflow.tsl.profiler.protobuf import xplane_pb2
-    except Exception:
-        return None
     total = 0.0
     found = False
-    for p in _glob.glob(f"{logdir}/**/*.xplane.pb", recursive=True):
-        xs = xplane_pb2.XSpace()
-        with open(p, "rb") as f:
-            xs.ParseFromString(f.read())
-        for plane in xs.planes:
-            if plane_substr and plane_substr.lower() not in plane.name.lower():
-                continue
-            md = plane.event_metadata
-            for line in plane.lines:
-                for ev in line.events:
-                    if name_substr in md[ev.metadata_id].name:
-                        total += ev.duration_ps / 1e9   # ps -> ms
-                        found = True
+    for name, dur_ms in _iter_xplane_events(logdir, plane_substr):
+        if name_substr in name:
+            total += dur_ms
+            found = True
     return total if found else None
+
+
+def device_time_by_substr(logdir: str,
+                          names: Mapping[str, Sequence[str]],
+                          plane_substr: str = "tpu"
+                          ) -> Dict[str, Optional[float]]:
+    """Per-key on-device time over ONE xplane walk: ``names`` maps each
+    output key (e.g. a serving dispatch kind) to the event-name substrings
+    that attribute to it (e.g. the jitted step-fn names — ``_decode`` matches
+    the compiled program ``jit__decode``). A key whose substrings match no
+    event reports None (distinguishable from a measured 0). Substring sets
+    may overlap — each key sums independently, so overlapping keys double-
+    COUNT, not double-report (documented for the insert family, where every
+    variant is an insert window)."""
+    totals: Dict[str, float] = {}
+    for name, dur_ms in _iter_xplane_events(logdir, plane_substr):
+        for key, subs in names.items():
+            if any(s in name for s in subs):
+                totals[key] = totals.get(key, 0.0) + dur_ms
+    return {key: totals.get(key) for key in names}

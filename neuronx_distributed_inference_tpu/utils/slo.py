@@ -1,0 +1,213 @@
+"""Rolling-window SLO evaluation over the serving telemetry.
+
+An ``SLOConfig`` declares targets (TTFT/TPOT/queue p99 ceilings, a spec-
+acceptance floor, a KV-headroom floor, a preemption-rate ceiling); an
+``SLOMonitor`` evaluates them over the last ``window_s`` seconds of the
+``ServingTelemetry`` request records plus the live registry gauges, exposes
+the verdict as a health gauge (``serving_slo_healthy``) + a violations
+counter, and logs every violation as ONE structured JSON line — the shape a
+per-replica health exporter (ROADMAP open item 4: the engine/frontend split's
+router ingests exactly these signals) scrapes.
+
+Config strings (the CLI's ``--slo`` flag) are ``key=value`` pairs:
+
+    --slo "ttft_p99_ms=500,queue_p99_ms=200,min_accept_mean=1.5,window_s=30"
+
+Unset targets are simply not evaluated — an empty config is healthy by
+definition.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+logger = logging.getLogger("tpu-inference")
+
+__all__ = ["SLOConfig", "SLOMonitor", "SLOReport"]
+
+
+@dataclasses.dataclass
+class SLOConfig:
+    """Serving-level objectives; ``None`` disables a target."""
+
+    ttft_p99_ms: Optional[float] = None
+    ttft_p50_ms: Optional[float] = None
+    tpot_p99_ms: Optional[float] = None
+    queue_p99_ms: Optional[float] = None
+    # floor on mean committed tokens/row/iteration (spec serving)
+    min_accept_mean: Optional[float] = None
+    # floor on free-KV-block fraction (paged serving)
+    min_kv_headroom: Optional[float] = None
+    # ceiling on preemptions per minute over the window
+    max_preemptions_per_min: Optional[float] = None
+    window_s: float = 60.0
+
+    @classmethod
+    def parse(cls, spec: str) -> "SLOConfig":
+        """Parse the CLI's ``key=value[,key=value...]`` form; unknown keys
+        raise (a typo'd SLO must not silently pass forever)."""
+        fields = {f.name for f in dataclasses.fields(cls)}
+        kw = {}
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if "=" not in part:
+                raise ValueError(f"SLO spec entry {part!r} is not key=value")
+            k, v = part.split("=", 1)
+            k = k.strip()
+            if k not in fields:
+                raise ValueError(f"unknown SLO target {k!r} "
+                                 f"(known: {sorted(fields)})")
+            kw[k] = float(v)
+        return cls(**kw)
+
+    def targets(self) -> Dict[str, float]:
+        return {f.name: getattr(self, f.name)
+                for f in dataclasses.fields(self)
+                if f.name != "window_s" and getattr(self, f.name) is not None}
+
+
+@dataclasses.dataclass
+class SLOReport:
+    healthy: bool
+    violations: List[str]
+    values: Dict[str, Optional[float]]      # measured value per target
+    window_s: float
+    window_requests: int
+
+
+def _p(vals: List[float], q: float) -> Optional[float]:
+    if not vals:
+        return None
+    return float(np.percentile(np.asarray(vals), q))
+
+
+class SLOMonitor:
+    """Evaluates an SLOConfig against a live ServingTelemetry.
+
+    One monitor per runner/replica; call ``evaluate()`` periodically (the
+    CLI's ``--slo`` wiring evaluates every ``--slo-interval`` serving steps).
+    State between calls is only the preemption-counter baseline (for the
+    rate target) — everything else reads the telemetry fresh.
+    """
+
+    def __init__(self, telemetry, config: SLOConfig):
+        self.telemetry = telemetry
+        self.config = config
+        reg = telemetry.registry
+        self._g_healthy = reg.gauge(
+            "serving_slo_healthy",
+            "1 while every configured SLO target holds, else 0")
+        self._c_violations = reg.counter(
+            "serving_slo_violations_total",
+            "SLO target violations observed across evaluations")
+        self._g_healthy.set(1)
+        self._last_eval_t: Optional[float] = None
+        self._last_preempt = self._preemptions()
+
+    def _preemptions(self) -> int:
+        c = self.telemetry.registry.get("serving_preemptions_total")
+        return int(c.value) if c is not None else 0
+
+    # ------------------------------------------------------------------ eval
+    def evaluate(self, now: Optional[float] = None) -> SLOReport:
+        """One rolling-window evaluation; sets the health gauge, counts and
+        logs violations (one structured JSON log line per unhealthy eval)."""
+        tel = self.telemetry
+        cfg = self.config
+        now = (time.perf_counter() if now is None else now) - tel._t0
+        lo = now - cfg.window_s
+
+        ttft, tpot, queue = [], [], []
+        n_win = 0
+        for r in tel.requests.values():
+            ft, lt = r["first_token_ts"], r["last_token_ts"]
+            live = r["finish_ts"] is None
+            if ft is not None and ft >= lo:
+                n_win += 1
+                ttft.append(1e3 * (ft - r["arrival_ts"]))
+            elif ft is None and live and r["arrival_ts"] <= now:
+                # CENSORED sample: a live request with no first token yet
+                # contributes its AGE as a TTFT lower bound — a wedged
+                # replica (requests arrive, nothing is produced) must flag
+                # the ceiling, not read as "nothing measured, no verdict"
+                n_win += 1
+                ttft.append(1e3 * (now - r["arrival_ts"]))
+            # TPOT windows on ACTIVITY (last token in window), not on the
+            # first token: a generation longer than window_s would otherwise
+            # drop out of the window while still degrading
+            if ft is not None and lt is not None and lt >= lo \
+                    and r["tokens"] > 1:
+                tpot.append(1e3 * (lt - ft) / (r["tokens"] - 1))
+            if r["placed_ts"] is not None and r["placed_ts"] >= lo:
+                queue.append(1e3 * (r["placed_ts"] - r["arrival_ts"]))
+            elif r["placed_ts"] is None and live and r["arrival_ts"] <= now:
+                # censored queue-wait for requests still waiting on a slot
+                queue.append(1e3 * (now - r["arrival_ts"]))
+
+        reg = tel.registry
+        values: Dict[str, Optional[float]] = {
+            "ttft_p99_ms": _p(ttft, 99), "ttft_p50_ms": _p(ttft, 50),
+            "tpot_p99_ms": _p(tpot, 99), "queue_p99_ms": _p(queue, 99),
+        }
+        # spec acceptance over the whole registry histogram (cumulative —
+        # a windowed acceptance needs the device carry's per-window deltas;
+        # the floor target is about sustained regime shifts, where the
+        # cumulative mean converges to the recent mean)
+        hist = reg.get("serving_spec_acceptance_tokens")
+        if hist is not None and hist.count:
+            from .metrics import acceptance_mean
+
+            values["min_accept_mean"] = acceptance_mean(hist.counts[:-1])
+        else:
+            values["min_accept_mean"] = None
+        free = reg.get("serving_kv_blocks_free")
+        used = reg.get("serving_kv_blocks_used")
+        if free is not None and used is not None and free.updated:
+            total = free.value + used.value
+            values["min_kv_headroom"] = (free.value / total) if total else None
+        else:
+            values["min_kv_headroom"] = None
+        dt = None if self._last_eval_t is None else max(1e-9,
+                                                        now - self._last_eval_t)
+        preempt = self._preemptions()
+        if dt is not None:
+            values["max_preemptions_per_min"] = \
+                60.0 * (preempt - self._last_preempt) / dt
+        else:
+            values["max_preemptions_per_min"] = None
+        self._last_eval_t = now
+        self._last_preempt = preempt
+
+        violations: List[str] = []
+        for name, target in cfg.targets().items():
+            v = values.get(name)
+            if v is None:
+                continue                       # nothing measured: no verdict
+            if name.startswith("min_"):
+                if v < target:
+                    violations.append(f"{name}: {v:.4g} < floor {target:.4g}")
+            elif v > target:
+                violations.append(f"{name}: {v:.4g} > ceiling {target:.4g}")
+
+        healthy = not violations
+        self._g_healthy.set(1 if healthy else 0)
+        if violations:
+            self._c_violations.inc(len(violations))
+            # ONE structured line per unhealthy evaluation — log scrapers
+            # key on "slo_violation"
+            logger.warning("slo_violation %s", json.dumps({
+                "violations": violations, "window_s": cfg.window_s,
+                "window_requests": n_win,
+                "values": {k: v for k, v in values.items()
+                           if v is not None}}))
+        return SLOReport(healthy=healthy, violations=violations,
+                         values=values, window_s=cfg.window_s,
+                         window_requests=n_win)

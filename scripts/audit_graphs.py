@@ -47,6 +47,17 @@ _FILE_SCOPES = {
     "runtime/eagle3.py": ["eagle3"],
     "runtime/medusa.py": ["medusa"],
     "runtime/image_to_text.py": ["mm"],
+    # ISSUE-7 telemetry split: the device carry's tick helpers are traced
+    # INTO every CB dispatch kind (continuous_batching threads the carry
+    # through plain/spec/mixed/insert/eagle), so a carry-touching edit
+    # re-audits the full CB fleet; the host-side observability modules
+    # (metrics/flight_recorder/slo) never enter a graph — lint-only ([]
+    # audits nothing, which is exactly their graph footprint).
+    "utils/device_telemetry.py": ["cb_dense", "cb_paged", "cb_mixed",
+                                  "cb_spec", "cb_eagle"],
+    "utils/metrics.py": [],
+    "utils/flight_recorder.py": [],
+    "utils/slo.py": [],
 }
 # any other package .py change (application.py, models/modules/ops/parallel/
 # analysis/config/utils/new files) re-runs the whole fleet — see

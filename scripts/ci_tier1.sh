@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# Tier-1 verify: the ONE command a change must keep green (ROADMAP "Tier-1
+# verify" — this script IS that command, so CI, pre-commit hooks, and humans
+# run the same thing).
+#
+#   scripts/ci_tier1.sh                 # full tier-1 suite (CPU mesh)
+#   T1_TIMEOUT=1200 scripts/ci_tier1.sh # slower box
+#
+# Exits with pytest's status; prints DOTS_PASSED=<n> (the count of passing
+# test dots) so drivers can compare against the seed count without parsing
+# pytest's summary line. The log survives at $T1_LOG for triage.
+set -o pipefail
+
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+T1_LOG="${T1_LOG:-/tmp/_t1.log}"
+T1_TIMEOUT="${T1_TIMEOUT:-870}"
+
+rm -f "$T1_LOG"
+timeout -k 10 "$T1_TIMEOUT" env JAX_PLATFORMS=cpu \
+    python -m pytest "$REPO/tests/" -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
+    -p no:randomly 2>&1 | tee "$T1_LOG"
+rc=${PIPESTATUS[0]}
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$T1_LOG" | tr -cd . | wc -c)"
+exit "$rc"

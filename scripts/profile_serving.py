@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""Per-dispatch device-time attribution for the continuous-batching serving
+loop: run a short serving window under a jax.profiler trace, parse the xplane
+dump, and report per-step-kind device time vs host span — the dispatch-floor
+decomposition (`dispatch_gap_ms`) ROADMAP open item 2 targets.
+
+Drives a tiny (CPU-capable) runner by default so the tool is runnable
+anywhere; on TPU hardware the same flow attributes the real device plane
+(the default ``--plane tpu``; pass ``--plane ""`` to scan every plane, which
+is how the CPU backend's host plane is read).
+
+Usage:
+    python scripts/profile_serving.py                       # plain paged CB
+    python scripts/profile_serving.py --mode mixed --plane ""
+    python scripts/profile_serving.py --mode spec -o timing.json
+
+Output: a JSON report {timing: {kind: {device_ms, host_ms, dispatch_gap_ms,
+dispatches, ...}}, device_counters, stats_lite} — the same attribution lands
+on the runner's metrics registry (``serving_device_time_ms{kind=}`` /
+``serving_dispatch_gap_ms{kind=}``) and in ``runner.stats()["timing"]``.
+"""
+
+import argparse
+import json
+import os
+import shutil
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import _tpu_test_bootstrap  # noqa: F401,E402  (side effect: 8-device CPU mesh)
+
+
+def build_runner(mode: str):
+    from neuronx_distributed_inference_tpu.analysis.harness import (_prompts,
+                                                                    _tiny_app)
+    from neuronx_distributed_inference_tpu.runtime.continuous_batching import (
+        ContinuousBatchingRunner)
+
+    if mode == "spec":
+        from neuronx_distributed_inference_tpu.analysis.harness import TINY_HF
+
+        target = _tiny_app(paged=True, cb=True, seed=0)
+        draft_hf = dict(TINY_HF, hidden_size=32, intermediate_size=64,
+                        num_hidden_layers=1, num_attention_heads=2,
+                        num_key_value_heads=2)
+        draft = _tiny_app(paged=True, cb=True, hf=draft_hf, seed=1)
+        runner = ContinuousBatchingRunner(target, draft=draft,
+                                          speculation_length=4, spec_chunk=2,
+                                          telemetry=True)
+    elif mode == "mixed":
+        app = _tiny_app(paged=True, cb=True)
+        runner = ContinuousBatchingRunner(app, decode_chunk=4,
+                                          prefill_chunk=16,
+                                          prefill_token_budget=32,
+                                          mixed_decode_steps=2,
+                                          telemetry=True)
+    else:
+        app = _tiny_app(paged=True, cb=True)
+        runner = ContinuousBatchingRunner(app, decode_chunk=4, telemetry=True)
+    return runner, list(_prompts((12, 19, 40)))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--mode", choices=("plain", "mixed", "spec"),
+                    default="plain")
+    ap.add_argument("--max-new-tokens", type=int, default=10)
+    ap.add_argument("--logdir", default="/tmp/tpu_profile_serving")
+    ap.add_argument("--plane", default="tpu",
+                    help='xplane name filter ("tpu" = device plane; "" scans '
+                         'every plane — use on the CPU backend)')
+    ap.add_argument("-o", "--out", default=None,
+                    help="write the JSON report here (default: stdout only)")
+    args = ap.parse_args(argv)
+
+    from neuronx_distributed_inference_tpu.utils import profiling as prof
+
+    runner, prompts = build_runner(args.mode)
+    # warm OUTSIDE the trace: every executable this schedule touches compiles
+    # here, so the traced window measures steady-state dispatches only
+    for p in prompts:
+        runner.submit(p, max_new_tokens=args.max_new_tokens)
+    runner.run_to_completion()
+    runner.telemetry.reset()
+    runner.reset_device_telemetry()   # measured window only (carry is cumulative)
+
+    shutil.rmtree(args.logdir, ignore_errors=True)
+    with prof.trace(args.logdir):
+        for p in prompts:
+            runner.submit(p, max_new_tokens=args.max_new_tokens)
+        runner.run_to_completion()
+
+    timing = runner.attribute_device_time(args.logdir,
+                                          plane_substr=args.plane)
+    s = runner.stats()
+    report = {
+        "mode": args.mode,
+        "plane": args.plane,
+        "logdir": args.logdir,
+        "timing": timing,
+        "device_counters": s.get("device"),
+        "stats_lite": {
+            "tokens_emitted": s["tokens_emitted"],
+            "steps": s["steps"],
+            "ttft_p50_ms": (None if s["ttft_ms"] is None
+                            else round(s["ttft_ms"]["latency_ms_p50"], 2)),
+        },
+    }
+    print(json.dumps(report, indent=2))
+    if args.out:
+        with open(args.out, "w") as fh:
+            json.dump(report, fh, indent=2)
+        print(f"report written to {args.out}", file=sys.stderr)
+    # device rows can be None on backends whose xplane lacks matching events;
+    # the host spans are always attributed, so the tool still reports
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -82,8 +82,26 @@ def test_cli_end_to_end_eagle3_and_serve(tmp_path):
             "--token-generation-buckets", "32", "64"]
     assert main(base + ["--speculation-type", "eagle3",
                         "--eagle-depth", "2"]) == 0
+    bundle = str(tmp_path / "bundle.json")
+    metrics = str(tmp_path / "metrics.prom")
     assert main(base + ["--serve", "--continuous-batching",
-                        "--prompt", "x", "--prompt", "y"]) == 0
+                        "--prompt", "x", "--prompt", "y",
+                        "--slo", "ttft_p99_ms=60000,window_s=120",
+                        "--slo-interval", "2",
+                        "--debug-bundle", bundle,
+                        "--metrics-out", metrics]) == 0
+    # the serve run left a parseable debug bundle + the SLO health gauge
+    from neuronx_distributed_inference_tpu.utils.flight_recorder import (
+        load_bundle)
+
+    b = load_bundle(bundle)
+    assert b["reason"] == "exit" and b["ring"], b.keys()
+    prom = open(metrics).read()
+    # line-anchored on the SERIES line: a bare "serving_slo_healthy 1"
+    # substring would also match the HELP header text and pass vacuously
+    import re
+
+    assert re.search(r"^serving_slo_healthy 1(\.0)?$", prom, re.M), prom
 
 
 def test_parity_flags_map_to_config():

@@ -1,0 +1,253 @@
+"""Flight recorder + SLO monitor (the ISSUE-7 part-(c) acceptance bar):
+
+  (a) the debug bundle ROUND-TRIPS — dump from a live serving run, parse,
+      and the parsed stats/metrics/ring match the live ``runner.stats()``
+      and telemetry (including the drained device-counter block),
+  (b) ring semantics: bounded, drop-counted, shared with the step timeline,
+  (c) SIGUSR1 dumps a bundle from a live process,
+  (d) the SLO monitor's healthy/violation verdicts, gauge + counter export,
+      structured violation log line, and the config-string parser.
+"""
+
+import json
+import os
+import signal
+
+import pytest
+
+from neuronx_distributed_inference_tpu.analysis.harness import (_prompts,
+                                                                _tiny_app)
+from neuronx_distributed_inference_tpu.runtime.continuous_batching import (
+    ContinuousBatchingRunner)
+from neuronx_distributed_inference_tpu.utils.flight_recorder import (
+    BUNDLE_SCHEMA, FlightRecorder, install_signal_dump, load_bundle)
+from neuronx_distributed_inference_tpu.utils.metrics import ServingTelemetry
+from neuronx_distributed_inference_tpu.utils.slo import (SLOConfig,
+                                                         SLOMonitor)
+
+
+@pytest.fixture(scope="module")
+def served():
+    """ONE short paged serving run with telemetry on, shared below."""
+    app = _tiny_app(paged=True, cb=True)
+    tel = ServingTelemetry()
+    runner = ContinuousBatchingRunner(app, decode_chunk=4, telemetry=tel)
+    rids = [runner.submit(p, max_new_tokens=8) for p in _prompts((12, 7, 19))]
+    results = runner.run_to_completion()
+    return runner, tel, rids, results
+
+
+# ---------------------------------------------------------------------- ring
+def test_ring_bounded_and_drop_counted():
+    fr = FlightRecorder(capacity=4)
+    for i in range(10):
+        fr.record({"i": i})
+    assert len(fr) == 4
+    assert [r["i"] for r in fr.records()] == [6, 7, 8, 9]
+    assert fr.dropped == 6
+    fr.clear()
+    assert len(fr) == 0 and fr.dropped == 0
+
+
+def test_ring_capacity_validated():
+    with pytest.raises(ValueError, match="capacity"):
+        FlightRecorder(capacity=0)
+
+
+def test_ring_shares_step_records_with_timeline(served):
+    _, tel, _, _ = served
+    assert tel.flight is not None
+    ring = tel.flight.records()
+    # same dict OBJECTS as the step timeline tail — one append per dispatch,
+    # and the drained device counters attached post-hoc appear in both
+    assert ring == tel.steps[-len(ring):]
+    assert ring[-1] is tel.steps[-1]
+    assert "device" in ring[-1]
+
+
+# -------------------------------------------------------------------- bundle
+def test_bundle_round_trips_and_matches_live_stats(served, tmp_path):
+    runner, tel, _, _ = served
+    live = runner.stats()
+    path = str(tmp_path / "bundle.json")
+    assert tel.flight.dump_bundle(
+        path, config={"decode_chunk": 4}, metrics=tel.registry.to_dict(),
+        stats=live, reason="test") == path
+
+    b = load_bundle(path)
+    assert b["schema"] == BUNDLE_SCHEMA and b["reason"] == "test"
+    assert b["versions"]["jax"] not in ("", "unavailable")
+    assert b["config"] == {"decode_chunk": 4}
+    # the drained device-counter block survives the round trip exactly
+    dev = live["device"]
+    assert b["stats"]["device"]["tokens"] == dev["tokens"]
+    assert b["stats"]["device"]["steps"] == dev["steps"]
+    assert b["stats"]["tokens_emitted"] == live["tokens_emitted"]
+    # metrics snapshot: every live counter series is in the bundle
+    assert (b["metrics"]["serving_tokens_emitted_total"]
+            == tel.registry.to_dict()["serving_tokens_emitted_total"])
+    # ring: same records (modulo JSON coercion), newest carries the counters
+    assert len(b["ring"]) == len(tel.flight)
+    assert [r["kind"] for r in b["ring"]] == [r["kind"] for r in tel.steps[
+        -len(b["ring"]):]]
+    assert b["ring"][-1]["device"]["tokens"] == dev["tokens"]
+    assert b["ring_dropped"] == tel.flight.dropped
+
+
+def test_bundle_schema_mismatch_fails_loudly(tmp_path):
+    p = tmp_path / "junk.json"
+    p.write_text(json.dumps({"schema": "something/else", "ring": []}))
+    with pytest.raises(ValueError, match="not a"):
+        load_bundle(str(p))
+
+
+def test_bundle_jsonable_never_fails_on_exotic_fields(tmp_path):
+    import numpy as np
+
+    class Odd:
+        def __repr__(self):
+            return "Odd()"
+
+    fr = FlightRecorder()
+    fr.record({"arr": np.arange(3), "scalar": np.int32(7), "odd": Odd()})
+    b = load_bundle(fr.dump_bundle(str(tmp_path / "b.json")))
+    assert b["ring"][0] == {"arr": [0, 1, 2], "scalar": 7, "odd": "Odd()"}
+
+
+def test_signal_dump_from_live_process(tmp_path):
+    fr = FlightRecorder()
+    fr.record({"kind": "decode"})
+    path = str(tmp_path / "sig.json")
+    prev = install_signal_dump(lambda reason: fr.dump_bundle(path,
+                                                             reason=reason))
+    try:
+        os.kill(os.getpid(), signal.SIGUSR1)
+        b = load_bundle(path)
+        assert b["reason"] == "signal" and b["ring"] == [{"kind": "decode"}]
+    finally:
+        signal.signal(signal.SIGUSR1, prev)
+
+
+# ----------------------------------------------------------------------- SLO
+def test_slo_config_parse():
+    cfg = SLOConfig.parse("ttft_p99_ms=500, queue_p99_ms=200,window_s=30")
+    assert cfg.ttft_p99_ms == 500 and cfg.queue_p99_ms == 200
+    assert cfg.window_s == 30 and cfg.tpot_p99_ms is None
+    assert set(cfg.targets()) == {"ttft_p99_ms", "queue_p99_ms"}
+    with pytest.raises(ValueError, match="unknown SLO target"):
+        SLOConfig.parse("ttft_99=500")
+    with pytest.raises(ValueError, match="key=value"):
+        SLOConfig.parse("ttft_p99_ms")
+
+
+def test_slo_healthy_run_sets_gauge(served):
+    _, tel, _, _ = served
+    mon = SLOMonitor(tel, SLOConfig.parse(
+        "ttft_p99_ms=600000,tpot_p99_ms=600000,queue_p99_ms=600000,"
+        "window_s=3600"))
+    rep = mon.evaluate()
+    assert rep.healthy and rep.violations == []
+    assert rep.window_requests == 3
+    assert rep.values["ttft_p99_ms"] is not None
+    assert tel.registry.get("serving_slo_healthy").value == 1
+
+
+def test_slo_violation_counted_logged_and_gauged(served, caplog):
+    _, tel, _, _ = served
+    mon = SLOMonitor(tel, SLOConfig.parse("ttft_p99_ms=0.0001,window_s=3600"))
+    with caplog.at_level("WARNING", logger="tpu-inference"):
+        rep = mon.evaluate()
+    assert not rep.healthy and len(rep.violations) == 1
+    assert "ttft_p99_ms" in rep.violations[0]
+    assert tel.registry.get("serving_slo_healthy").value == 0
+    assert tel.registry.get("serving_slo_violations_total").value == 1
+    # ONE structured JSON line per unhealthy evaluation
+    line = next(r.message for r in caplog.records
+                if r.message.startswith("slo_violation "))
+    payload = json.loads(line.split(" ", 1)[1])
+    assert payload["violations"] == rep.violations
+    assert payload["window_requests"] == 3
+
+
+def test_slo_window_excludes_old_requests(served):
+    _, tel, _, _ = served
+    mon = SLOMonitor(tel, SLOConfig.parse("ttft_p99_ms=0.0001,window_s=1e-9"))
+    # an (effectively) empty window measures nothing -> no verdict, healthy
+    rep = mon.evaluate(now=tel._t0 + 1e6)
+    assert rep.healthy and rep.window_requests == 0
+    assert rep.values["ttft_p99_ms"] is None
+
+
+def test_slo_wedged_replica_flags_ttft_via_censored_age():
+    """A replica where requests arrive but NO first token is ever produced
+    must go unhealthy: live no-first-token requests contribute their AGE as
+    a censored TTFT (and queue-wait) lower bound instead of vanishing from
+    the window ('nothing measured' is exactly how a wedge would hide)."""
+    tel = ServingTelemetry()
+    tel.request_arrival(0, prompt_len=8, max_new_tokens=16)
+    mon = SLOMonitor(tel, SLOConfig(ttft_p99_ms=500.0, queue_p99_ms=500.0,
+                                    window_s=60.0))
+    rep = mon.evaluate(now=tel._t0 + 10.0)   # 10 s old, still tokenless
+    assert not rep.healthy and len(rep.violations) == 2
+    assert rep.values["ttft_p99_ms"] == pytest.approx(10_000.0, rel=1e-3)
+    assert rep.values["queue_p99_ms"] == pytest.approx(10_000.0, rel=1e-3)
+    # once finished (e.g. cancelled), the dead request stops counting
+    tel.request_finished(0, "truncated", 0)
+    assert mon.evaluate(now=tel._t0 + 20.0).values["ttft_p99_ms"] is None
+
+
+def test_slo_tpot_windows_on_activity_not_first_token():
+    """A generation older than window_s whose tokens are still flowing must
+    keep contributing TPOT — the window keys on last-token activity."""
+    tel = ServingTelemetry()
+    tel.request_arrival(0, prompt_len=8, max_new_tokens=1000)
+    r = tel.requests[0]
+    r["placed_ts"] = r["arrival_ts"]
+    r["first_token_ts"] = r["arrival_ts"] + 1.0     # long ago
+    r["last_token_ts"] = r["arrival_ts"] + 100.0    # active right now
+    r["tokens"] = 100
+    mon = SLOMonitor(tel, SLOConfig(tpot_p99_ms=500.0, window_s=30.0))
+    rep = mon.evaluate(now=tel._t0 + r["arrival_ts"] + 101.0)
+    # (100 - 1) s over 99 tokens = 1000 ms/token > the 500 ms ceiling
+    assert not rep.healthy
+    assert rep.values["tpot_p99_ms"] == pytest.approx(1000.0, rel=1e-3)
+
+
+def test_slo_kv_headroom_floor():
+    tel = ServingTelemetry()
+    tel.registry.gauge("serving_kv_blocks_free").set(10)
+    tel.registry.gauge("serving_kv_blocks_used").set(90)
+    mon = SLOMonitor(tel, SLOConfig(min_kv_headroom=0.25))
+    rep = mon.evaluate()
+    assert not rep.healthy and rep.values["min_kv_headroom"] == 0.1
+    tel.registry.gauge("serving_kv_blocks_free").set(40)
+    tel.registry.gauge("serving_kv_blocks_used").set(60)
+    assert mon.evaluate().healthy
+
+
+def test_slo_preemption_rate_needs_two_evals():
+    tel = ServingTelemetry()
+    c = tel.registry.counter("serving_preemptions_total")
+    mon = SLOMonitor(tel, SLOConfig(max_preemptions_per_min=5.0))
+    # first evaluation has no baseline interval -> no rate verdict
+    rep0 = mon.evaluate(now=tel._t0 + 1.0)
+    assert rep0.healthy and rep0.values["max_preemptions_per_min"] is None
+    c.inc(6)  # 6 preemptions over the next 60 s window == 6/min > 5/min
+    rep1 = mon.evaluate(now=tel._t0 + 61.0)
+    assert not rep1.healthy
+    assert rep1.values["max_preemptions_per_min"] == pytest.approx(6.0)
+
+
+def test_slo_monitor_never_creates_read_side_series():
+    tel = ServingTelemetry()
+    mon = SLOMonitor(tel, SLOConfig(min_accept_mean=1.5,
+                                    min_kv_headroom=0.1,
+                                    max_preemptions_per_min=1.0))
+    before = set(tel.registry.to_dict())
+    mon.evaluate()
+    mon.evaluate()
+    # peeking absent instruments must not register them: the only series the
+    # monitor owns are its own health gauge + violations counter (created at
+    # construction), and the spec-acceptance histogram it READS stays absent
+    assert set(tel.registry.to_dict()) == before
+    assert tel.registry.get("serving_spec_acceptance_tokens") is None

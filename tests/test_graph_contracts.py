@@ -351,6 +351,17 @@ def test_changed_mode_scope_map_fails_closed():
         "spec", "cb_spec", "cb_eagle", "eagle", "eagle3", "medusa"}
     # a doc/test-only change audits nothing
     assert mod._scopes_for_changes(["docs/STATIC_ANALYSIS.md"]) == []
+    # ISSUE-7: the in-graph telemetry carry is threaded through EVERY CB
+    # dispatch kind, so a carry edit re-audits the full CB fleet...
+    assert set(mod._scopes_for_changes(
+        [pkg + "utils/device_telemetry.py"])) == {
+        "cb_dense", "cb_paged", "cb_mixed", "cb_spec", "cb_eagle"}
+    # ...while the host-side observability modules never enter a graph
+    # (lint-only), and an UNMAPPED utils module still fails closed
+    assert mod._scopes_for_changes([pkg + "utils/flight_recorder.py"]) == []
+    assert mod._scopes_for_changes([pkg + "utils/slo.py"]) == []
+    assert mod._scopes_for_changes([pkg + "utils/metrics.py"]) == []
+    assert mod._scopes_for_changes([pkg + "utils/benchmark.py"]) is None
     # every mapped scope name actually exists in the harness
     from neuronx_distributed_inference_tpu.analysis import harness
     for scopes in mod._FILE_SCOPES.values():

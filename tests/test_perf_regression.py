@@ -198,9 +198,12 @@ def test_disabled_telemetry_adds_no_measurable_step_overhead():
     stand-in step workload vs the same loop without the hooks. The workload
     (~a few tens of µs of numpy) is orders of magnitude SMALLER than a real
     jitted decode dispatch (~ms), so a 25% bound here corresponds to a
-    sub-percent bound on the real step; the best-of-repeats guard keeps
-    scheduler noise from flaking the gate. (Host-side runtime property — stays
-    off the graph auditor by design.)"""
+    sub-percent bound on the real step; the best-of-repeats guard plus an
+    absolute per-step-delta escape hatch (r12: a contended CI box inflates
+    the µs-scale bare loop itself, which flaked the purely-relative gate)
+    keeps scheduler noise from flaking the gate while still catching real
+    work sneaking onto the disabled path. (Host-side runtime property —
+    stays off the graph auditor by design.)"""
     import time
 
     import numpy as np
@@ -240,7 +243,77 @@ def test_disabled_telemetry_adds_no_measurable_step_overhead():
             times.append(time.perf_counter() - t0)
         best.append(min(times))
     t_bare, t_inst = best
-    assert t_inst < t_bare * 1.25, (
-        f"disabled-telemetry hooks cost {(t_inst / t_bare - 1) * 100:.1f}% "
-        f"on a µs-scale stand-in step (bare {t_bare * 1e3:.2f} ms, "
+    per_step_delta = (t_inst - t_bare) / n
+    assert t_inst < t_bare * 1.25 or per_step_delta < 100e-6, (
+        f"disabled-telemetry hooks cost {(t_inst / t_bare - 1) * 100:.1f}% / "
+        f"{per_step_delta * 1e6:.0f} µs per step on a µs-scale stand-in "
+        f"(bare {t_bare * 1e3:.2f} ms, "
         f"instrumented {t_inst * 1e3:.2f} ms for {n} steps)")
+
+
+def test_enabled_telemetry_with_carry_drain_stays_microseconds_per_step():
+    """The ISSUE-7 extension of the canary above: the ENABLED path — per-step
+    record building, note_emitted lifecycle folding, flight-ring append, AND
+    the device-carry drain (to_dict of the fetched counter block) — must stay
+    O(100 µs)/step. Two-sided guard: the relative bound vs the same µs-scale
+    stand-in workload catches creep on an idle box, and the ABSOLUTE
+    per-step-delta ceiling keeps a contended CI box (where the µs-scale bare
+    loop itself inflates) from flaking the gate while still catching the
+    real failure modes — a per-step device sync (~ms over the tunnel) or
+    per-step spooling of the full event log. Either bound passing is
+    acceptance: both are far under 1% of a real ~100 ms decode-chunk
+    dispatch (bench.py's ``telemetry_overhead_ratio`` measures the same
+    property on the real serving loop)."""
+    import time
+
+    import numpy as np
+
+    from neuronx_distributed_inference_tpu.utils import (
+        device_telemetry as dtel)
+    from neuronx_distributed_inference_tpu.utils.metrics import (
+        ServingTelemetry)
+
+    tel = ServingTelemetry()                       # ENABLED, flight ring on
+    a = np.random.default_rng(0).standard_normal((96, 96))
+    emitted = {i: [1, 2, 3, 4] for i in range(8)}
+    for rid in emitted:
+        tel.request_arrival(rid, prompt_len=16, max_new_tokens=64)
+        tel.request_placed(rid, slot=rid)
+    carry = np.zeros((dtel.CARRY_LEN,), np.int32)  # a drained (host) block
+
+    def bare(n):
+        acc = 0.0
+        for _ in range(n):
+            acc += float((a @ a)[0, 0])
+        return acc
+
+    def instrumented(n):
+        acc = 0.0
+        for _ in range(n):
+            t0 = tel.step_start()
+            with tel.annotate("decode"):
+                acc += float((a @ a)[0, 0])
+            tel.step_record(t0, "decode", iterations=4, tokens=32,
+                            occupancy=8, slots=8, kv_free=40, kv_total=48)
+            tel.note_emitted(emitted)
+            tel.note_device_counters(dtel.to_dict(carry))
+        return acc
+
+    n = 300
+    bare(n), instrumented(n)                      # warm caches / allocator
+    best = []
+    for fn in (bare, instrumented):
+        times = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            fn(n)
+            times.append(time.perf_counter() - t0)
+        best.append(min(times))
+    t_bare, t_inst = best
+    per_step_delta = (t_inst - t_bare) / n
+    assert t_inst < t_bare * 4.0 or per_step_delta < 800e-6, (
+        f"enabled-telemetry + carry-drain hooks cost "
+        f"{(t_inst / t_bare - 1) * 100:.1f}% / "
+        f"{per_step_delta * 1e6:.0f} µs per step on a µs-scale stand-in "
+        f"(bare {t_bare * 1e3:.2f} ms, instrumented {t_inst * 1e3:.2f} ms "
+        f"for {n} steps)")

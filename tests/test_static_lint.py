@@ -303,6 +303,61 @@ def test_step_loop_sync_waiver_reported():
                for f in fs)
 
 
+def test_telemetry_mutation_in_traced_fn_flagged():
+    """The ISSUE-7 fixture: host telemetry/registry mutation under trace runs
+    once per TRACE, not per step — it silently records garbage."""
+    fs = _run("""
+        import jax
+
+        def _step(self, params, tok, cache):
+            self.telemetry.step_record(None, "decode")
+            self._m_tokens.inc(4)
+            c = self.registry.counter("serving_steps_total")
+            return tok + 1, cache
+
+        step = jax.jit(_step, donate_argnums=(3,))
+    """, rel="ops/fake.py")
+    hits = [f for f in fs if f.rule == "telemetry-in-jit" and f.violating]
+    assert len(hits) == 3, fs
+    assert any("once per trace" in f.msg for f in hits)
+
+
+def test_registry_create_in_step_loop_flagged_but_instrument_mutation_ok():
+    """Under a @step_loop_body HOST loop, mutating a CACHED instrument is the
+    designed pattern; registry get-or-create per step is not."""
+    fs = _run("""
+        from neuronx_distributed_inference_tpu.analysis.registry import (
+            step_loop_body)
+
+        @step_loop_body
+        def _step(self, emitted):
+            self._m_accept.observe(3)                      # cached: fine
+            self.telemetry.step_record(None, "decode")     # host loop: fine
+            bad = self.telemetry.registry.counter("serving_x_total")
+            return emitted
+    """, rel="ops/fake.py")
+    hits = [f for f in fs if f.rule == "telemetry-in-jit" and f.violating]
+    assert len(hits) == 1 and "get-or-create" in hits[0].msg, fs
+
+
+def test_device_telemetry_carry_helpers_not_flagged():
+    """The sanctioned in-graph counting path (utils/device_telemetry.py
+    helpers on the carry operand) must NOT trip the telemetry rule."""
+    fs = _run("""
+        import jax
+        from neuronx_distributed_inference_tpu.utils import (
+            device_telemetry as dtel)
+
+        def _step(params, tok, cache, telem):
+            telem = dtel.decode_tick(telem, tok > 0, tok, tok)
+            telem = dtel.bump_kind(telem, dtel.KIND_DECODE)
+            return tok + 1, cache, telem
+
+        step = jax.jit(_step, donate_argnums=(2, 3))
+    """, rel="ops/fake.py")
+    assert "telemetry-in-jit" not in _rules(fs), fs
+
+
 def test_unmarked_loop_body_not_held_to_step_rules():
     fs = _run("""
         def _commit(self, toks):
