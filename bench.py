@@ -592,6 +592,22 @@ def main() -> None:
             except Exception as e:
                 _note(f"arrival phase failed: {e}")
 
+        if paged_app is not None and _remaining() > 240:
+            # ISSUE-9 scale-out phase: the engine/frontend split under an
+            # open-loop arrival trace — a prefix-affinity router over 2
+            # replicas (independent runners, shared weights) vs the SAME
+            # trace under random placement, plus a host-RAM KV tier leg.
+            # Affinity numbers refuse to publish if the prefix cache was off
+            # for the run (same honesty pattern as the r5 spec-floor marker).
+            _note("phase: multi-replica router serving (affinity vs random "
+                  "placement, KV host tier)")
+            try:
+                extra.update(_router_arrival_serving(
+                    paged_app, paged_app.tpu_config.max_batch_size,
+                    extra.get("paged_serving_tok_per_s")))
+            except Exception as e:
+                _note(f"router phase failed: {e}")
+
     # FINAL EMIT: same schema, enriched extra. The driver parses the last JSON
     # line; if the process was killed earlier, the early emit already landed.
     print(json.dumps(result), flush=True)
@@ -1007,6 +1023,149 @@ def _paged_arrival_serving(app, batch, closed_loop_tok_s):
     out["arrival_paged_serving_tok_per_s"] = out["arrival_mixed_tok_per_s"]
     out["arrival_ttft_p50_ms"] = out["arrival_mixed_ttft_p50_ms"]
     out["arrival_ttft_p99_ms"] = out["arrival_mixed_ttft_p99_ms"]
+    return out
+
+
+def _drive_router_open_loop(router, prompts, arrivals, max_new):
+    """Open-loop arrival driver for the multi-replica router (the router
+    analog of _drive_open_loop): submit at the scheduled offsets while the
+    router steps every replica. Samples per-replica load (queue + live rows)
+    each step for the imbalance number. Returns (wall_s, depth_samples)."""
+    import time as _time
+
+    t0 = _time.perf_counter()
+    idx = 0
+    samples = []                     # per step: [replica load, ...]
+    while idx < len(arrivals) or router.has_work:
+        now = _time.perf_counter() - t0
+        while idx < len(arrivals) and arrivals[idx] <= now:
+            router.submit(prompts[idx], max_new_tokens=max_new,
+                          arrival_ts=t0 + arrivals[idx])
+            idx += 1
+        if not router.has_work:
+            _time.sleep(max(0.0, arrivals[idx] - (_time.perf_counter() - t0)))
+            continue
+        router.step()
+        samples.append([a["queue_depth"] + a["active_requests"]
+                        for a in router.stats()["replicas"].values()])
+    return _time.perf_counter() - t0, samples
+
+
+def _router_arrival_serving(app, batch, closed_loop_tok_s, n_replicas=2):
+    """ISSUE-9 scale-out phase: an open-loop Poisson trace of PREFIX-SHARING
+    prompts served by a PrefixAffinityRouter over ``n_replicas`` independent
+    runners (one weights object, one paged pool each), twice: affinity
+    placement vs random placement — same trace, so the prefix-hit delta is
+    the router's doing. A third leg forces the host-RAM KV tier's
+    evict→readmit path (spill every idle block, then re-offer the shared
+    prefixes).
+
+    HONESTY GUARD (same pattern as the r5 spec-floor marker): the affinity
+    keys are refused — ``router_affinity_invalid`` is emitted instead — if
+    the replicas' prefix caches were not actually enabled for the run, since
+    a hit ratio over a disabled cache is vacuously 0 vs 0."""
+    import gc
+
+    from neuronx_distributed_inference_tpu.runtime.continuous_batching import (
+        ContinuousBatchingRunner)
+    from neuronx_distributed_inference_tpu.serving import (EngineReplica,
+                                                           HostKVTier,
+                                                           PrefixAffinityRouter)
+
+    cfg = app.tpu_config
+    slots = max(2, batch // (2 * n_replicas))
+    n_req = 4 * n_replicas
+    # geometry-adaptive so the phase also runs at toy scale: prompts take a
+    # quarter of seq_len, half of that a BLOCK-ALIGNED shared prefix
+    prompt_len = max(2 * cfg.pa_block_size, min(256, cfg.seq_len // 4))
+    prefix_len = max(cfg.pa_block_size,
+                     (prompt_len // 2 // cfg.pa_block_size)
+                     * cfg.pa_block_size)
+    max_new = min(192, cfg.seq_len - prompt_len - 8)
+    if max_new < 4:
+        raise ValueError(f"seq_len {cfg.seq_len} too small for the router "
+                         f"arrival phase")
+    rate = 0.5 * (closed_loop_tok_s or 2000.0) / max_new
+    rng = np.random.default_rng(17)
+    arrivals = np.cumsum(rng.exponential(1.0 / rate, size=n_req))
+    # two prefix FAMILIES: half the trace shares prefix A, half prefix B —
+    # affinity should route each family to the replica holding its blocks
+    prefixes = [rng.integers(1, 100000, size=(prefix_len,)).astype(np.int32)
+                for _ in range(2)]
+    prompts = [np.concatenate([
+        prefixes[i % 2],
+        rng.integers(1, 100000,
+                     size=(prompt_len - prefix_len,)).astype(np.int32)])
+        for i in range(n_req)]
+    out = {"router_replicas": n_replicas,
+           "router_arrival_rate_req_s": round(rate, 2)}
+
+    def build(policy, tier):
+        reps = [EngineReplica(
+            str(i), lambda tel, t=tier: ContinuousBatchingRunner(
+                app, decode_chunk=32, telemetry=tel, kv_tier=t))
+            for i in range(n_replicas)]
+        return PrefixAffinityRouter(reps, policy=policy), reps
+
+    def prefix_hits(reps):
+        return sum(
+            (reps_i.registry.get("serving_prefix_hit_tokens_total").value
+             if reps_i.registry.get("serving_prefix_hit_tokens_total")
+             else 0) for reps_i in reps)
+
+    total_prompt_toks = sum(len(p) for p in prompts)
+    runs = {}
+    for policy in ("affinity", "random"):
+        tier = HostKVTier(capacity_blocks=4 * slots)
+        router, reps = build(policy, tier)
+        wall, samples = _drive_router_open_loop(router, prompts, arrivals,
+                                                max_new)
+        s = router.stats()
+        mean_loads = np.asarray(samples, dtype=np.float64).mean(axis=0) \
+            if samples else np.zeros(n_replicas)
+        imbalance = (float(mean_loads.max() / mean_loads.mean())
+                     if mean_loads.mean() > 0 else 1.0)
+        runs[policy] = {
+            "tok_per_s": round(s["tokens"] / wall, 1),
+            "hit_ratio": round(prefix_hits(reps) / total_prompt_toks, 4),
+            "imbalance": round(imbalance, 3),
+            "prefix_caching": s["prefix_caching"],
+            "spills": s["affinity_spills"],
+        }
+        if policy == "affinity":
+            # tier leg: spill every committed prefix to host RAM, then
+            # re-offer the two shared prefixes — the readmit path must fire
+            for rep in reps:
+                rep.runner.spill_idle_blocks()
+            for pre in prefixes:
+                router.submit(np.concatenate([
+                    pre, rng.integers(1, 100000, size=(8,)).astype(np.int32)]),
+                    max_new_tokens=16)
+            router.run_to_completion()
+            evict = sum(r.runner.kv_tier.evictions for r in reps)
+            readmit = sum(r.runner.kv_tier.readmit_blocks for r in reps)
+            out["kv_tier_evictions"] = evict
+            out["kv_tier_readmit_blocks"] = readmit
+            out["kv_tier_readmit_hit_ratio"] = round(
+                readmit / max(1, evict), 3)
+        for rep in reps:
+            _drain_runner(rep.runner)
+        del router, reps
+        gc.collect()
+
+    out["router_tok_per_s"] = runs["affinity"]["tok_per_s"]
+    out["router_random_tok_per_s"] = runs["random"]["tok_per_s"]
+    out["replica_load_imbalance"] = runs["affinity"]["imbalance"]
+    if not runs["affinity"]["prefix_caching"]:
+        # refuse to publish a hit ratio measured over a disabled cache
+        out["router_affinity_invalid"] = (
+            "prefix cache disabled during the affinity run — hit ratio "
+            "would be vacuous")
+        _note(f"router affinity INVALID: {out['router_affinity_invalid']}")
+    else:
+        out["prefix_affinity_hit_ratio"] = runs["affinity"]["hit_ratio"]
+        out["prefix_random_hit_ratio"] = runs["random"]["hit_ratio"]
+        out["router_affinity_spills"] = runs["affinity"]["spills"]
     return out
 
 

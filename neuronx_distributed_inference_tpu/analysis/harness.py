@@ -233,6 +233,32 @@ def _exercise_medusa() -> Any:
     return medusa
 
 
+def _exercise_serving_tier() -> Any:
+    """Host-RAM KV tiering through a paged CB runner: serve a prompt with two
+    full prefix blocks, force the idle blocks to spill to the host tier, then
+    serve a same-prefix prompt so the cb.paged.tier_readmit scatter actually
+    dispatches (the audit needs its captured example)."""
+    from ..runtime.continuous_batching import ContinuousBatchingRunner
+    from ..serving.kv_tiering import HostKVTier
+
+    app = _tiny_app(paged=True, cb=True)
+    tier = HostKVTier(capacity_blocks=16)
+    runner = ContinuousBatchingRunner(app, decode_chunk=4, kv_tier=tier)
+    rng = np.random.default_rng(21)
+    prefix = rng.integers(1, 256, size=(16,)).astype(np.int32)   # 2 blocks
+    tail = rng.integers(1, 256, size=(4,)).astype(np.int32)
+    runner.submit(np.concatenate([prefix, tail]), max_new_tokens=4)
+    runner.run_to_completion()
+    if runner.spill_idle_blocks() < 2:
+        raise RuntimeError("tier harness failed to spill the prefix blocks")
+    runner.submit(np.concatenate([prefix, tail[::-1]]), max_new_tokens=4)
+    runner.run_to_completion()
+    if runner.kv_tier.readmit_blocks < 2:
+        raise RuntimeError("tier harness never re-admitted — the "
+                           "cb.paged.tier_readmit example was not captured")
+    return runner
+
+
 def _exercise_mm() -> Any:
     """Multimodal prefill: a tiny random Llava (Pixtral vision + Mistral text).
 
@@ -291,6 +317,7 @@ SCOPES: Dict[str, Tuple] = {
                  ("cb.paged.mixed",)),
     "cb_spec": (_exercise_cb_spec, ("cb.spec.chunk", "cb.spec.insert_pair")),
     "cb_eagle": (_exercise_cb_eagle, ("cb.eagle.insert", "cb.eagle.chunk")),
+    "serving_tier": (_exercise_serving_tier, ("cb.paged.tier_readmit",)),
     "spec": (_exercise_spec, ("spec.chunk",)),
     "eagle": (_exercise_eagle, ("eagle.prefill", "eagle.chunk")),
     "eagle3": (_exercise_eagle3, ("eagle3.prefill", "eagle3.chunk")),

@@ -6,11 +6,12 @@ review keeps missing:
 ``stray-print``     bare ``print(`` in library code (the CLI and env-gated
                     ``# debug-ok`` prints excepted) — subsumes the old
                     test_hygiene grep.
-``raw-jit``         a ``jax.jit`` call inside ``runtime/`` that never
-                    registers with the auditor (every serving dispatch must go
-                    through ``analysis.registry.audited_jit`` so its contract
-                    is machine-checked; one-shot utility jits carry an
-                    explicit waiver comment).
+``raw-jit``         a ``jax.jit`` call inside ``runtime/`` or ``serving/``
+                    that never registers with the auditor (every serving
+                    dispatch must go through
+                    ``analysis.registry.audited_jit`` so its contract is
+                    machine-checked; one-shot utility jits carry an explicit
+                    waiver comment).
 ``jit-no-donate``   a jitted function taking cache-named parameters
                     (``cache``/``t_cache``/``d_cache``/``kv_cache``/...)
                     whose donation does not cover them — the statically
@@ -193,7 +194,7 @@ class _ModuleLint:
         traced: List[Tuple[ast.FunctionDef, Tuple[str, ...]]] = []
         for call in jit_calls:
             is_raw = _dotted(call.func) in self.raw_jit_names
-            if is_raw and self.rel.startswith("runtime/"):
+            if is_raw and self.rel.startswith(("runtime/", "serving/")):
                 self.emit("raw-jit", call,
                           "jax.jit dispatch site never registers with the "
                           "graph auditor (use analysis.registry.audited_jit)")

@@ -141,6 +141,17 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--adapter-names", default=None,
                    help="comma-separated adapter name per prompt row "
                         "('-' = base model); requires --dynamic-lora")
+    g.add_argument("--replicas", type=int, default=1, metavar="N",
+                   help="with --serve: shard serving over N engine replicas "
+                        "(independent runners on shared weights) behind the "
+                        "prefix-affinity router (serving/router.py)")
+    g.add_argument("--kv-host-tier", action="store_true",
+                   help="with --serve + paged attention: tier cold paged KV "
+                        "blocks to host RAM (serving/kv_tiering.py) — evicted "
+                        "on headroom pressure, re-admitted bit-identically "
+                        "on prefix hits")
+    g.add_argument("--kv-tier-blocks", type=int, default=1024, metavar="N",
+                   help="host-RAM tier capacity in KV blocks (default 1024)")
     g.add_argument("--serve", action="store_true",
                    help="drive the prompts through the continuous-batching "
                         "runner (slot-based serving; honors --paged-attention "
@@ -576,9 +587,14 @@ def _run_serving(args, app, tokenizer) -> None:
     (≈ the reference's continuous-batching serve path). Any of
     --metrics-out / --trace-out / --events-out / --stats-interval turns the
     serving telemetry on (utils/metrics.py): per-request lifecycle events,
-    the per-dispatch step timeline, and the metrics registry."""
+    the per-dispatch step timeline, and the metrics registry. With
+    --replicas > 1 (or --kv-host-tier) the requests route through the
+    scale-out frontend instead: N engine replicas on shared weights behind
+    the prefix-affinity router, optionally with the host-RAM KV tier."""
     from .runtime.continuous_batching import ContinuousBatchingRunner
 
+    if args.replicas > 1 or args.kv_host_tier:
+        return _run_serving_routed(args, app, tokenizer)
     kw = {}
     if args.async_depth is not None:
         kw["async_depth"] = args.async_depth
@@ -667,6 +683,139 @@ def _run_serving(args, app, tokenizer) -> None:
             s["requests_finished"], s["tokens_emitted"], s["steps"],
             None if s["ttft_ms"] is None
             else round(s["ttft_ms"]["latency_ms_p50"], 1))
+
+
+def _run_serving_routed(args, app, tokenizer) -> None:
+    """Scale-out serving path (--replicas / --kv-host-tier): N engine
+    replicas — independent continuous-batching runners sharing the loaded
+    weights — behind the prefix-affinity router, with an optional host-RAM
+    KV tier SHARED by the replicas (the store is content-addressed, so a
+    prefix spilled by one replica re-admits on any of them)."""
+    from .runtime.continuous_batching import ContinuousBatchingRunner
+    from .serving import EngineReplica, HostKVTier, PrefixAffinityRouter
+
+    if args.replicas < 1:
+        raise SystemExit("--replicas must be >= 1")
+    if args.kv_host_tier and not app.tpu_config.paged_attention_enabled:
+        raise SystemExit("--kv-host-tier requires --paged-attention")
+    kw = {}
+    if args.async_depth is not None:
+        kw["async_depth"] = args.async_depth
+    if args.prefill_chunk:
+        kw["prefill_chunk"] = args.prefill_chunk
+    if args.prefill_token_budget:
+        kw["prefill_token_budget"] = args.prefill_token_budget
+    telemetry_on = bool(args.metrics_out or args.trace_out or args.events_out
+                        or args.stats_interval or args.slo
+                        or args.debug_bundle)
+    tier = (HostKVTier(capacity_blocks=args.kv_tier_blocks)
+            if args.kv_host_tier else None)
+    replicas = [
+        EngineReplica(str(i),
+                      lambda tel: ContinuousBatchingRunner(
+                          app, telemetry=tel, kv_tier=tier, **kw),
+                      telemetry_enabled=telemetry_on,
+                      # one JSONL spool per replica (events interleave
+                      # meaninglessly in one file; suffix keeps them apart)
+                      jsonl_path=(f"{args.events_out}.replica{i}"
+                                  if args.events_out else None))
+        for i in range(args.replicas)]
+    router = PrefixAffinityRouter(replicas)
+    logger.info("routed serving: %d replicas, kv host tier: %s",
+                args.replicas,
+                f"{args.kv_tier_blocks} blocks" if tier else "off")
+
+    slo_monitors = []
+    if args.slo:
+        from .utils.slo import SLOConfig, SLOMonitor
+
+        slo_cfg = SLOConfig.parse(args.slo)
+        slo_monitors = [(rep, SLOMonitor(rep.runner.telemetry, slo_cfg))
+                        for rep in replicas]
+
+    def _dump_bundles(reason: str):
+        paths = []
+        for rep in replicas:
+            paths.append(rep.runner.telemetry.flight.dump_bundle(
+                f"{args.debug_bundle}.replica{rep.replica_id}",
+                config=app.tpu_config,
+                metrics=rep.registry.to_dict(),
+                stats=rep.stats(), reason=reason))
+        return paths
+
+    if args.debug_bundle:
+        from .utils.flight_recorder import install_signal_dump
+
+        install_signal_dump(lambda reason: ", ".join(_dump_bundles(reason)))
+
+    input_ids, attention_mask = _encode_prompts(args, tokenizer,
+                                                app.arch_args.vocab_size)
+    rids = []
+    for i in range(input_ids.shape[0]):
+        row = input_ids[i]
+        if attention_mask is not None:
+            row = row[attention_mask[i] > 0]
+        rids.append(router.submit(row, max_new_tokens=args.max_new_tokens))
+
+    n_steps = 0
+    try:
+        while router.has_work:
+            router.step()
+            n_steps += 1
+            if args.stats_interval and n_steps % args.stats_interval == 0:
+                logger.info("router stats @ step %d: %s", n_steps,
+                            json.dumps(router.stats(), default=str))
+            if (slo_monitors and args.slo_interval > 0
+                    and n_steps % args.slo_interval == 0):
+                for rep, mon in slo_monitors:
+                    rep_r = mon.evaluate()
+                    if not rep_r.healthy:
+                        logger.warning(
+                            "SLO unhealthy @ step %d replica %s: %s",
+                            n_steps, rep.replica_id,
+                            "; ".join(rep_r.violations))
+            if n_steps > 100000:
+                raise SystemExit("routed serving did not converge")
+    except BaseException:
+        if args.debug_bundle:
+            logger.warning("routed serving fault: debug bundles at %s",
+                           ", ".join(_dump_bundles("exception")))
+        raise
+    for rep, mon in slo_monitors:
+        rep_r = mon.evaluate()
+        logger.info("final SLO evaluation replica %s: healthy=%s%s",
+                    rep.replica_id, rep_r.healthy,
+                    "" if rep_r.healthy
+                    else " (" + "; ".join(rep_r.violations) + ")")
+    if args.debug_bundle:
+        logger.info("debug bundles written to %s",
+                    ", ".join(_dump_bundles("exit")))
+    results = {rid: req.generated for rid, req in router.requests.items()}
+    for rid in rids:
+        toks = results[rid]
+        if tokenizer is not None:
+            print(tokenizer.decode(toks))
+        else:
+            print(f"request {rid}: {toks}")
+    s = router.stats()
+    logger.info("router summary: %d requests, %d tokens, "
+                "affinity_hits=%d, spills=%d, migrations=%d",
+                s["finished"], s["tokens"], s["affinity_hits"],
+                s["affinity_spills"], s["migrations"])
+    if args.metrics_out:
+        # ONE exposition: router series + every replica's replica-labelled
+        # registry (utils/metrics.py default_labels merging)
+        with open(args.metrics_out, "w") as f:
+            f.write(router.prometheus_text())
+        logger.info("wrote merged Prometheus metrics to %s", args.metrics_out)
+    if args.trace_out:
+        for rep in replicas:
+            path = f"{args.trace_out}.replica{rep.replica_id}"
+            rep.runner.telemetry.write_chrome_trace(path)
+            logger.info("wrote replica %s Chrome trace to %s",
+                        rep.replica_id, path)
+    for rep in replicas:
+        rep.runner.telemetry.close()
 
 
 def _try_load_tokenizer(model_path: Optional[str]):

@@ -24,7 +24,7 @@ from __future__ import annotations
 import logging
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -104,7 +104,7 @@ class ContinuousBatchingRunner:
                  prefill_chunk: Optional[int] = None,
                  prefill_token_budget: Optional[int] = None,
                  mixed_decode_steps: Optional[int] = None,
-                 telemetry=None):
+                 telemetry=None, kv_tier=None):
         cfg = app.tpu_config
         if not cfg.is_continuous_batching:
             raise ValueError("tpu_config.is_continuous_batching must be enabled")
@@ -402,6 +402,20 @@ class ContinuousBatchingRunner:
         self.positions = np.zeros((self.num_slots,), dtype=np.int32)
         self.last_tok = np.zeros((self.num_slots,), dtype=np.int32)
 
+        # --- host-RAM KV tier (serving/kv_tiering.py) -------------------------
+        # ``kv_tier``: a HostKVTier. Swaps the block allocator for the tiered
+        # variant (idle pool + host store behind the free list) and installs
+        # the cb.paged.tier_readmit dispatch that restores spilled blocks
+        # before a prefix-hit request's first insert window.
+        self.kv_tier = kv_tier
+        if kv_tier is not None:
+            if not cfg.paged_attention_enabled:
+                raise ValueError("kv_tier (host-RAM KV tiering) requires "
+                                 "paged attention")
+            if draft is not None or eagle_draft is not None:
+                raise ValueError("kv_tier does not compose with speculative "
+                                 "serving yet (the draft pool's blocks are "
+                                 "not captured by the spill path)")
         if self.paged:
             # native host engine (allocator + slot mapping) when available; the
             # non-paged path never touches either, so the build is gated here
@@ -411,12 +425,34 @@ class ContinuousBatchingRunner:
             bs = cfg.pa_block_size
             self.block_size = bs
             self.max_blocks_per_seq = -(-cfg.seq_len // bs)
-            # C++ engine when the toolchain permits (native/engine.cpp); Python
-            # fallback keeps identical semantics (tests/test_native_engine.py)
-            self.allocator = native_lib.make_block_allocator(
-                cfg.pa_num_blocks, bs, enable_prefix_caching=True)
+            if kv_tier is not None:
+                from ..serving.kv_tiering import (TieredBlockAllocator,
+                                                  build_readmit_step)
+
+                self.allocator = TieredBlockAllocator(cfg.pa_num_blocks, bs,
+                                                      kv_tier)
+                self._tier_readmit_step = build_readmit_step()
+            else:
+                # C++ engine when the toolchain permits (native/engine.cpp);
+                # Python fallback keeps identical semantics
+                # (tests/test_native_engine.py)
+                self.allocator = native_lib.make_block_allocator(
+                    cfg.pa_num_blocks, bs, enable_prefix_caching=True)
             # family hook: custom cache layouts (e.g. DeepSeek latent) page too
             self.cache = app.make_paged_cache(cfg.pa_num_blocks, bs)
+            if kv_tier is not None:
+                # base layout: block-indexed k/v pools plus (quantized KV)
+                # global per-(layer, head) scale tensors, which spill/readmit
+                # pass through untouched — custom family layouts (e.g.
+                # DeepSeek latent) have no generic spill/readmit shape
+                extra = set(self.cache.keys()) - {"k", "v", "k_scale",
+                                                  "v_scale"}
+                if "k" not in self.cache or extra:
+                    raise ValueError("kv_tier supports the base {k, v} paged "
+                                     "layout only (custom family cache "
+                                     f"layouts — extra keys {sorted(extra)} "
+                                     "— have no spill/readmit shape)")
+                self.allocator.read_blocks = self._read_tier_blocks
             self.block_table = np.zeros((self.num_slots, self.max_blocks_per_seq),
                                         dtype=np.int32)
         else:
@@ -1157,6 +1193,84 @@ class ContinuousBatchingRunner:
             self._d_insert_step = audited_jit(
                 _d_insert, kind="cb.spec.d_insert", cache_args=("cache",))
 
+    # ------------------------------------------------ host-RAM KV tier hooks
+    def _read_tier_blocks(self, block_ids: np.ndarray):
+        """Tier spill gather: (L, N, H, BS, D) device views of the named
+        blocks from both pools. A fresh gather buffer, so the snapshot stays
+        valid however the (donated) cache buffers move afterwards."""
+        idx = jnp.asarray(block_ids, dtype=jnp.int32)
+        return self.cache["k"][:, idx], self.cache["v"][:, idx]
+
+    def _dispatch_readmits(self) -> None:
+        """Scatter queued host-tier blocks back into the paged pool — ONE
+        bucketed ``cb.paged.tier_readmit`` dispatch, issued BEFORE the
+        requesting prompt's first insert window so the windows (and every
+        later decode) read the restored prefix through the block table."""
+        if self.kv_tier is None:
+            return
+        pending = self.allocator.take_pending_readmits()
+        if not pending:
+            return
+        from ..serving.kv_tiering import READMIT_BUCKET_CAP, readmit_bucket
+
+        tier = self.kv_tier
+        tier.note_readmitted(len(pending))
+        # one dispatch per <=cap-block chunk (a >cap batch would overflow the
+        # largest bucket); padding rows carry block id -1 and drop
+        for lo in range(0, len(pending), READMIT_BUCKET_CAP):
+            chunk = pending[lo : lo + READMIT_BUCKET_CAP]
+            ks, vs, ids = [], [], []
+            for blk, _h, host_blk in chunk:
+                k, v = host_blk.materialize()
+                ks.append(k)
+                vs.append(v)
+                ids.append(blk)
+            b = readmit_bucket(len(ids))
+            # (L, N, H, BS, D) stacked on the block axis
+            k_new = np.stack(ks, axis=1)
+            v_new = np.stack(vs, axis=1)
+            if b > len(ids):
+                pad_shape = (k_new.shape[0], b - len(ids)) + k_new.shape[2:]
+                k_new = np.concatenate(
+                    [k_new, np.zeros(pad_shape, dtype=k_new.dtype)], axis=1)
+                v_new = np.concatenate(
+                    [v_new, np.zeros(pad_shape, dtype=v_new.dtype)], axis=1)
+            id_arr = np.full((b,), -1, dtype=np.int32)
+            id_arr[: len(ids)] = ids
+            tel = self.telemetry
+            t0 = tel.step_start()
+            with tel.annotate("tier_readmit"):
+                self.cache, self._telem_dev = self._tier_readmit_step(
+                    self.cache, self._telem_dev, jnp.asarray(k_new),
+                    jnp.asarray(v_new), jnp.asarray(id_arr),
+                    block_size=self.block_size)
+            if t0 is not None:
+                tel.step_record(
+                    t0, "tier_readmit", iterations=1,
+                    prefill_tokens=len(ids) * self.block_size,
+                    slots=self.num_slots,
+                    kv_free=self.allocator.num_free,
+                    kv_total=self.allocator.num_blocks)
+
+    def _free_blocks(self, req: Request) -> None:
+        """Release a request's blocks. With the tiered allocator a mid-prompt
+        preemption/truncation must not park the (possibly unwritten) tail
+        blocks as idle prefix-cache entries — their hashes are registered at
+        allocation but the KV streams in over later windows."""
+        if self.kv_tier is not None and req.inserting:
+            no_park = set(req.blocks[req.insert_pos // self.block_size:])
+            self.allocator.free_sequence(req.blocks, no_park=no_park)
+        else:
+            self.allocator.free_sequence(req.blocks)
+
+    def spill_idle_blocks(self, keep: int = 0) -> int:
+        """Force the tier's evict path: spill all but ``keep`` idle blocks to
+        host RAM (drain/maintenance hook; tests and the audit harness use it
+        to exercise evict→readmit deterministically). No-op without a tier."""
+        if self.kv_tier is None:
+            return 0
+        return self.allocator.spill_idle(keep)
+
     # ------------------------------------------------ telemetry (utils/metrics)
     # The runner's historical ad-hoc counters live on the metrics registry
     # now; these thin properties keep the old attribute surface working
@@ -1259,6 +1373,7 @@ class ContinuousBatchingRunner:
         "spec_chunk": ("_spec_chunk", "_eagle_chunk"),
         "mixed": ("_mixed",),
         "insert": ("_insert", "_window", "_seed"),
+        "tier_readmit": ("_tier_readmit",),
     }
 
     @staticmethod
@@ -1355,6 +1470,12 @@ class ContinuousBatchingRunner:
         if self.paged:
             s["kv_blocks_total"] = self.allocator.num_blocks
             s["kv_blocks_free"] = self.allocator.num_free
+        if self.kv_tier is not None:
+            # idle blocks count in kv_blocks_free (they are allocatable
+            # headroom — the router's admission signal); the strict free-list
+            # count and the host-store state ride alongside
+            s["kv_blocks_free_device"] = self.allocator.num_free_device
+            s["kv_tier"] = self.kv_tier.stats()
         if self.k:
             s["spec"] = {
                 "iterations": self.spec_iters_run,
@@ -1378,14 +1499,20 @@ class ContinuousBatchingRunner:
     def submit(self, prompt: np.ndarray, max_new_tokens: int = 32,
                eos_token_id: Optional[int] = None,
                sampling_params=None, adapter_id: int = 0,
-               arrival_ts: Optional[float] = None) -> int:
+               arrival_ts: Optional[float] = None,
+               resume_tokens: Optional[Sequence[int]] = None) -> int:
         """``sampling_params``: per-request (3,) [top_k, top_p, temperature]
         (≈ reference per-request sampling, `generation/sampling.py:99-209`);
         ``adapter_id``: multi-LoRA slot, 0 = base (≈ CB forward adapter_ids,
         `models/model_wrapper.py:252-311`); ``arrival_ts``: optional
         ``time.perf_counter()`` timestamp of the request's true upstream
         arrival for telemetry TTFT/queue-wait (defaults to now — open-loop
-        drivers backdate it so wait spent inside a blocking step() counts)."""
+        drivers backdate it so wait spent inside a blocking step() counts);
+        ``resume_tokens``: tokens this request ALREADY generated elsewhere
+        (cross-replica migration, serving/router.py) — the request enters the
+        same resume path a preempted request takes (KV recomputed from
+        prompt + resume_tokens at placement; none of them re-emitted), so a
+        migrated stream continues exactly where the source replica stopped."""
         prompt = np.asarray(prompt).astype(np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
@@ -1437,8 +1564,16 @@ class ContinuousBatchingRunner:
                 raise ValueError(
                     f"windowed prefill needs {total} cache slots (prompt rounded up "
                     f"to {w}-wide windows) but seq_len is {self.cfg.seq_len}")
+        if resume_tokens is not None and len(resume_tokens) >= max_new_tokens:
+            raise ValueError("resume_tokens already meets max_new_tokens — "
+                             "the migrated request is finished, not served")
         req = Request(self._next_id, prompt, max_new_tokens, eos_token_id,
                       sampling_params=sampling_params, adapter_id=adapter_id)
+        if resume_tokens:
+            # cross-replica migration: enters the preemption-resume path at
+            # placement (prompt + resume_tokens[:-1] refed, last token is the
+            # next decode input; nothing re-emitted)
+            req.generated = [int(t) for t in resume_tokens]
         self._next_id += 1
         self.queue.append(req)
         self.telemetry.request_arrival(req.request_id, int(prompt.size),
@@ -2061,6 +2196,32 @@ class ContinuousBatchingRunner:
                 self.spec_min_accept, self.spec_probe_every)
         return emitted
 
+    def drain_requests(self):
+        """Evict every unfinished request through the existing preemption/
+        resume path (serving/router.py replica drain): flush the dispatch
+        pipeline (its tokens still count), preempt live rows — mid-prompt
+        inserts included — and hand back the evicted Request objects for
+        re-placement elsewhere. Returns (emitted, requests): ``emitted`` is
+        the final {request_id: tokens} of the flush, ``requests`` preserve
+        prompt/generated/sampling/adapter state so ``submit(...,
+        resume_tokens=req.generated)`` on another runner continues the exact
+        stream."""
+        emitted: Dict[int, List[int]] = {}
+        self._drain(emitted)
+        if self.telemetry.enabled and emitted:
+            self.telemetry.note_emitted(emitted)
+        for req in list(self.active):
+            if req is not None and not req.done:
+                self._preempt(req)
+        out = list(self.queue)
+        self.queue.clear()
+        if self.kv_tier is not None:
+            # the replica is leaving the placement set: park nothing — spill
+            # every committed prefix to host RAM so the bytes survive the
+            # replica (a re-added replica re-admits them on the next hit)
+            self.spill_idle_blocks()
+        return emitted, out
+
     def run_to_completion(self, seed: int = 0,
                           on_step=None) -> Dict[int, List[int]]:
         """Drive step() until every submitted request finishes; returns all
@@ -2108,7 +2269,7 @@ class ContinuousBatchingRunner:
         self.telemetry.request_preempted(req.request_id)
         self.active[req.slot] = None
         if self.paged:
-            self.allocator.free_sequence(req.blocks)
+            self._free_blocks(req)
             self.block_table[req.slot, :] = 0
             req.blocks = []
         self._slot_sp[req.slot] = self._default_sp_row
@@ -2167,6 +2328,9 @@ class ContinuousBatchingRunner:
         if cached_len > 0:
             self.telemetry.request_prefix_hit(req.request_id, int(cached_len))
         self.block_table[slot, : len(req.blocks)] = req.blocks
+        # host-tier prefix hits: restore the spilled blocks BEFORE any insert
+        # window dispatches (the windows' queries read them via the table)
+        self._dispatch_readmits()
         req.fed = fed
         req.insert_pos = cached_len
         req.tok0_dev = None
@@ -2386,7 +2550,7 @@ class ContinuousBatchingRunner:
         if req.slot >= 0:
             self.active[req.slot] = None
             if self.paged:
-                self.allocator.free_sequence(req.blocks)
+                self._free_blocks(req)
                 self.block_table[req.slot, :] = 0
             # reset the slot's sampling/adapter rows so all-greedy traffic
             # re-engages the fast argmax executable

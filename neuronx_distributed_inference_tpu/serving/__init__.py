@@ -1,0 +1,30 @@
+"""Scale-out serving: engine/frontend split over the continuous-batching core.
+
+The stack factors into three layers (ROADMAP open item 4 — "one runner on one
+mesh cannot be millions of users"):
+
+- ``engine``: :class:`EngineReplica` — a ContinuousBatchingRunner plus its
+  telemetry/SLO state as a self-contained replica with a stable id, an
+  admission interface (KV-block headroom, queue depth, in-flight chunks), and
+  per-replica labelled metric export.
+- ``router``: :class:`PrefixAffinityRouter` — the frontend. Owns the arrival
+  queue and places each request on a replica by prefix-cache affinity (the
+  same chained block-content hashes the BlockAllocator keys its prefix cache
+  by), load-balancing on KV headroom + queue depth, with graceful spill and
+  drain/migration through the runner's preemption/resume path.
+- ``kv_tiering``: :class:`HostKVTier` — a host-RAM tier for cold paged KV
+  blocks (evict least-recently-attended committed blocks to host buffers,
+  re-admit bit-identically on prefix hit), extending KV capacity past HBM.
+
+Replicas are plain Python objects over independent runners, so "N replicas"
+can mean N sub-meshes on one host (the dryrun harness fakes 8 devices) or,
+later, N hosts behind the gloo launcher — the router only speaks the
+admission interface.
+"""
+
+from .engine import EngineReplica
+from .kv_tiering import HostKVTier
+from .router import PrefixAffinityRouter, RouterRequest
+
+__all__ = ["EngineReplica", "HostKVTier", "PrefixAffinityRouter",
+           "RouterRequest"]

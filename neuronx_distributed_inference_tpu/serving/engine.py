@@ -1,0 +1,227 @@
+"""Engine core of the scale-out split: one replica = runner + telemetry/SLO.
+
+:class:`EngineReplica` wraps a ContinuousBatchingRunner as a self-contained
+serving replica with a stable id. It adds exactly what the frontend
+(serving/router.py) needs and nothing the runner already does:
+
+- **Admission interface** — ``can_admit`` / ``admission()``: KV-block
+  headroom, queue depth, and in-flight chunk count, all computed from state
+  the runner already tracks (``stats()`` + the metrics registry). The router
+  load-balances and spills on these signals; the SLO monitor reads the same
+  registry.
+- **Per-replica labelled metrics** — the replica builds its runner's
+  telemetry on a ``MetricsRegistry(default_labels={"replica": id})``, so
+  every instrument the runner (or SLO monitor) registers carries the replica
+  label with zero per-call-site threading, and N replicas' expositions
+  concatenate into one scrape.
+- **Prefix-affinity probe** — ``resident_prefix_blocks(hashes)``: how many
+  leading chained block hashes of a prompt are resident on this replica
+  (device prefix cache, idle pool, or its host-RAM tier). The router's
+  placement score.
+- **Drain** — ``drain()``: evict every unfinished request through the
+  runner's existing mid-prompt preemption/resume path and hand the payloads
+  back for re-placement; with a KV tier attached the committed prefixes are
+  spilled to host RAM on the way out.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..modules.block_kvcache import BlockAllocator
+from ..runtime.continuous_batching import ContinuousBatchingRunner
+from ..utils import metrics as metrics_lib
+
+__all__ = ["EngineReplica", "prompt_block_hashes"]
+
+
+def prompt_block_hashes(prompt: np.ndarray, block_size: int,
+                        adapter_id: int = 0) -> List[bytes]:
+    """Chained content hashes of the prompt's leading FULL blocks — the same
+    chain (and the same adapter salt) the runner's prefix cache keys blocks
+    by (``_begin_insert`` / BlockAllocator), so a router-side hash walk and a
+    replica-side residency probe speak one language."""
+    prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
+    if adapter_id != 0 and prompt.size:
+        prompt = prompt.copy()
+        prompt[0] ^= np.int32(adapter_id << 20)
+    out: List[bytes] = []
+    prev = b""
+    for i in range(len(prompt) // block_size):
+        prev = BlockAllocator._chain_hash(
+            prev, prompt[i * block_size : (i + 1) * block_size])
+        out.append(prev)
+    return out
+
+
+class EngineReplica:
+    """A ContinuousBatchingRunner packaged as one serving replica.
+
+    ``runner_factory``: callable ``(telemetry) -> ContinuousBatchingRunner``
+    — the replica owns telemetry construction so the registry carries its
+    ``replica=<id>`` default label. Pass an existing runner via ``runner=``
+    instead when the caller already built one (its metrics then keep their
+    unlabelled names).
+    """
+
+    def __init__(self, replica_id: str, runner_factory=None, *,
+                 runner: Optional[ContinuousBatchingRunner] = None,
+                 telemetry_enabled: bool = False,
+                 jsonl_path: Optional[str] = None,
+                 max_queue_depth: Optional[int] = None):
+        if (runner is None) == (runner_factory is None):
+            raise ValueError("pass exactly one of runner_factory / runner")
+        self.replica_id = str(replica_id)
+        if runner is None:
+            registry = metrics_lib.MetricsRegistry(
+                default_labels={"replica": self.replica_id})
+            telemetry = metrics_lib.ServingTelemetry(
+                enabled=telemetry_enabled, registry=registry,
+                jsonl_path=jsonl_path)
+            runner = runner_factory(telemetry)
+            if runner.telemetry is not telemetry:
+                raise ValueError("runner_factory must build the runner on the "
+                                 "telemetry it is given (pass telemetry= "
+                                 "through to ContinuousBatchingRunner)")
+        self.runner = runner
+        self.registry = runner.telemetry.registry
+        # replica-lifecycle gauges (labelled like everything else here)
+        self._g_accepting = self.registry.gauge(
+            "serving_replica_accepting",
+            "1 while this replica is in the router's placement set")
+        self._g_accepting.set(1)
+        # queue-admission ceiling: a replica whose backlog already covers
+        # 2x its slots gains nothing from more queue — the router should
+        # spill to a less loaded replica instead
+        self.max_queue_depth = (max_queue_depth if max_queue_depth is not None
+                                else 2 * runner.num_slots)
+        self.draining = False
+        if runner.paged and runner.kv_tier is not None:
+            self._tier_gauges = {
+                k: self.registry.gauge(
+                    f"serving_kv_tier_{k}",
+                    "host-RAM KV tier state (serving/kv_tiering.py)")
+                for k in ("host_blocks", "evictions", "host_evictions",
+                          "readmit_blocks")}
+        else:
+            self._tier_gauges = None
+
+    # ------------------------------------------------------------- admission
+    def admission(self) -> Dict[str, object]:
+        """The router's placement signals, point-in-time: queue depth,
+        in-flight chunk count, live occupancy, and (paged) KV-block headroom
+        — the tiered allocator counts idle blocks as headroom, which is the
+        wiring that makes host-tier eviction admission-driven."""
+        r = self.runner
+        out = {
+            "replica": self.replica_id,
+            "accepting": not self.draining,
+            "queue_depth": len(r.queue),
+            "inflight_chunks": len(r._inflight),
+            "active_requests": sum(
+                q is not None and not q.done for q in r.active),
+            "num_slots": r.num_slots,
+        }
+        if r.paged:
+            out["kv_blocks_free"] = r.allocator.num_free
+            out["kv_blocks_total"] = r.allocator.num_blocks
+            out["kv_headroom_frac"] = (r.allocator.num_free
+                                       / max(1, r.allocator.num_blocks))
+        return out
+
+    def blocks_needed(self, prompt_len: int) -> int:
+        """Blocks a fresh placement of this prompt requires — the same
+        prompt + one-decode-chunk bound ``_place_queued`` admits by."""
+        r = self.runner
+        if not r.paged:
+            return 0
+        chunk_tokens = r.spec_chunk * r.k if r.k else r.decode_chunk
+        return -(-(prompt_len + 1 + chunk_tokens) // r.block_size)
+
+    def can_admit(self, prompt_len: int) -> bool:
+        """Would this replica make progress on the request rather than just
+        queue it? False while draining, past the queue ceiling, or (paged)
+        when even after the queue drains the pool cannot hold the prompt."""
+        if self.draining:
+            return False
+        r = self.runner
+        if len(r.queue) >= self.max_queue_depth:
+            return False
+        if r.paged and self.blocks_needed(prompt_len) > r.allocator.num_blocks:
+            return False
+        return True
+
+    def has_headroom(self, prompt_len: int) -> bool:
+        """Immediate placement headroom (no wait): free blocks cover the
+        prompt and a free slot exists. The router prefers these replicas and
+        records a graceful SPILL when the affinity target lacks them."""
+        r = self.runner
+        if self.draining:
+            return False
+        # the backlog ahead of us must fit in the free slots for placement
+        # to be immediate (queue == free slots means we'd wait a generation)
+        free_slots = sum(q is None for q in r.active)
+        if len(r.queue) >= free_slots:
+            return False
+        if r.paged and self.blocks_needed(prompt_len) > r.allocator.num_free:
+            return False
+        return True
+
+    # ------------------------------------------------------------- affinity
+    def resident_prefix_blocks(self, hashes: List[bytes]) -> int:
+        """Leading blocks of the hash chain resident on THIS replica: the
+        device prefix cache (live or idle) first, then the host tier (a hit
+        there re-admits, which still beats recompute)."""
+        r = self.runner
+        if not r.paged:
+            return 0
+        alloc = r.allocator
+        tier = r.kv_tier
+        n = 0
+        for h in hashes:
+            if h in getattr(alloc, "hash_to_block", {}):
+                n += 1
+            elif tier is not None and h in tier:
+                n += 1
+            else:
+                break
+        return n
+
+    # ------------------------------------------------------------- serving
+    def submit(self, prompt, **kw) -> int:
+        return self.runner.submit(prompt, **kw)
+
+    def step(self, key=None) -> Dict[int, List[int]]:
+        if self._tier_gauges is not None:
+            ts = self.runner.kv_tier.stats()
+            for k, g in self._tier_gauges.items():
+                g.set(ts[k])
+        return self.runner.step(key)
+
+    @property
+    def has_work(self) -> bool:
+        return self.runner.has_work
+
+    def stats(self) -> Dict[str, object]:
+        s = self.runner.stats()
+        s["replica"] = self.replica_id
+        s["admission"] = self.admission()
+        return s
+
+    def drain(self):
+        """Leave the placement set: evict every unfinished request through
+        the runner's preemption/resume path and return (emitted, requests)
+        for the router to re-place. The replica stays steppable (it may be
+        re-added later)."""
+        self.draining = True
+        self._g_accepting.set(0)
+        return self.runner.drain_requests()
+
+    def reactivate(self) -> None:
+        self.draining = False
+        self._g_accepting.set(1)
+
+    def prometheus_text(self) -> str:
+        return self.registry.prometheus_text()

@@ -1,0 +1,403 @@
+"""Host-RAM KV tier for cold paged blocks: spill on reclaim, re-admit on hit.
+
+The paged block layout is already transfer-friendly (each block is a
+contiguous ``(L, H, BS, D)`` tile run — the property "Ragged Paged Attention"
+builds its streaming on), so KV capacity does not have to end at HBM:
+
+- **Idle pool** (:class:`TieredBlockAllocator`): a committed full block whose
+  refcount drops to zero keeps its device residency AND its prefix-cache hash
+  instead of returning to the free list — re-referencing it is free. Idle
+  blocks still count as allocatable headroom (``num_free``), which is exactly
+  the admission signal the router reads: headroom pressure is what drives
+  eviction.
+- **Spill** (headroom-driven eviction): when an allocation finds the free
+  list empty, the least-recently-attended idle block is reclaimed — its
+  content is first copied device→host (one batched gather +
+  ``copy_to_host_async``, the host-side analog of the PR 4 prefetch-pipeline
+  transfer shape: start the copy early, materialize at the last moment) and
+  parked in the host store keyed by the same chained content hash.
+- **Re-admit**: ``BlockAllocator.allocate_for_prompt``'s prefix walk consults
+  the host store after a device miss; a hit allocates a fresh device block,
+  counts the tokens cached, and queues the block for re-admission. The runner
+  dispatches ONE ``cb.paged.tier_readmit`` scatter (an ``audited_jit`` site —
+  cache donated/aliased, telemetry carry threaded) BEFORE the request's first
+  insert window, so the windows' queries see the restored KV through the
+  block table exactly as if it had never left the device.
+
+Exactness guarantee: spill reads the committed bytes and re-admit writes them
+back verbatim — the round trip is BIT-identical in the cache dtype (int8/fp8
+KV included; pinned by tests/test_kv_tiering.py), so a re-admitted prefix can
+never perturb a token stream.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..analysis.registry import audited_jit
+from ..modules.block_kvcache import BlockAllocator
+from ..utils import device_telemetry as dtel
+
+__all__ = ["HostKVTier", "TieredBlockAllocator", "READMIT_BUCKET_CAP",
+           "build_readmit_step", "readmit_bucket"]
+
+# largest blocks-per-readmit-dispatch bucket; bigger batches dispatch in
+# cap-sized chunks (ContinuousBatchingRunner._dispatch_readmits)
+READMIT_BUCKET_CAP = 64
+
+
+def readmit_bucket(n: int, cap: int = READMIT_BUCKET_CAP) -> int:
+    """Blocks-per-readmit-dispatch bucket: next power of two (capped) so the
+    scatter executable count stays logarithmic in batch size."""
+    b = 1
+    while b < n and b < cap:
+        b *= 2
+    return b
+
+
+def build_readmit_step(kind: str = "cb.paged.tier_readmit"):
+    """The tier's ONE device dispatch: scatter N host-restored blocks back
+    into the paged pool. ``block_ids`` rows of -1 are padding (remapped past
+    the block axis and dropped), so a handful of power-of-two bucket shapes
+    cover every re-admission batch."""
+
+    def _tier_readmit(cache, telem, k_new, v_new, block_ids, block_size):
+        nb = cache["k"].shape[1]
+        blk = jnp.where(block_ids < 0, nb, block_ids)       # OOB -> dropped
+        cache = dict(cache)
+        cache["k"] = cache["k"].at[:, blk].set(
+            k_new.astype(cache["k"].dtype), mode="drop")
+        cache["v"] = cache["v"].at[:, blk].set(
+            v_new.astype(cache["v"].dtype), mode="drop")
+        n_live = jnp.sum(block_ids >= 0)
+        telem = telem.at[dtel.IDX_KV_WRITES].add(n_live * block_size)
+        telem = telem.at[dtel.IDX_KV_BLOCKS].add(n_live)
+        telem = dtel.bump_kind(telem, dtel.KIND_TIER_READMIT)
+        return cache, telem
+
+    return audited_jit(_tier_readmit, kind=kind, cache_args=("cache",),
+                       carry_args=("telem",),
+                       static_argnames=("block_size",))
+
+
+class _HostBlock:
+    """One spilled block: the device gather result until materialized, then
+    plain numpy bytes. ``copy_to_host_async`` is scheduled at spill time so
+    the D2H transfer overlaps whatever the serving loop dispatches next."""
+
+    __slots__ = ("k", "v", "stamp", "_np")
+
+    def __init__(self, k, v, stamp: int):
+        self.k, self.v, self.stamp = k, v, stamp
+        self._np: Optional[Tuple[np.ndarray, np.ndarray]] = None
+
+    def materialize(self) -> Tuple[np.ndarray, np.ndarray]:
+        if self._np is None:
+            self._np = (np.asarray(self.k), np.asarray(self.v))
+            self.k = self.v = None          # drop the device handles
+        return self._np
+
+    def nbytes(self) -> int:
+        if self._np is not None:
+            return self._np[0].nbytes + self._np[1].nbytes
+        return int(np.prod(self.k.shape) * self.k.dtype.itemsize * 2)
+
+
+class HostKVTier:
+    """Host-RAM store of spilled paged KV blocks, keyed by the allocator's
+    chained content hash; LRU-by-last-attended eviction past
+    ``capacity_blocks``.
+
+    The tier is wired to a runner by ``ContinuousBatchingRunner(kv_tier=)``:
+    the runner installs ``read_blocks`` (a batched gather over its live cache)
+    and drives spills/readmits; the router reads ``stats()`` alongside the
+    replica's admission signals.
+    """
+
+    def __init__(self, capacity_blocks: int = 1024):
+        if capacity_blocks < 0:
+            raise ValueError("capacity_blocks must be >= 0")
+        self.capacity_blocks = capacity_blocks
+        self.store: Dict[bytes, _HostBlock] = {}
+        self._clock = 0
+        # counters (always-on ints; the owning replica's registry exports
+        # them with the replica label via EngineReplica)
+        self.evictions = 0           # device blocks spilled to host
+        self.host_evictions = 0      # host entries dropped past capacity
+        self.discards = 0            # spill candidates dropped (capacity 0)
+        self.readmit_blocks = 0      # host blocks restored to device
+        self.readmit_requests = 0    # requests that hit the host tier
+
+    # ------------------------------------------------------------ bookkeeping
+    def tick(self) -> int:
+        self._clock += 1
+        return self._clock
+
+    def __contains__(self, h: bytes) -> bool:
+        return h in self.store
+
+    def host_blocks(self) -> int:
+        return len(self.store)
+
+    def host_bytes(self) -> int:
+        return sum(b.nbytes() for b in self.store.values())
+
+    def stats(self) -> Dict[str, int]:
+        return {
+            "capacity_blocks": self.capacity_blocks,
+            "host_blocks": self.host_blocks(),
+            "evictions": self.evictions,
+            "host_evictions": self.host_evictions,
+            "discards": self.discards,
+            "readmit_blocks": self.readmit_blocks,
+            "readmit_requests": self.readmit_requests,
+        }
+
+    # ------------------------------------------------------------ spill side
+    def spill(self, block_ids: List[int], hashes: List[bytes],
+              read_blocks: Callable) -> None:
+        """Copy the named device blocks into the host store (one batched
+        gather, async D2H). Called by the allocator's reclaim path just
+        before the blocks are handed out for reuse — the gather is enqueued
+        ahead of any overwrite, so it reads the committed bytes.
+        ``read_blocks`` is the OWNING replica's cache gather: a tier may be
+        shared by several replicas (the store is content-addressed, and KV
+        bytes for the same prefix are replica-invariant under shared weights
+        and config), so each spill names its source."""
+        todo = [(b, h) for b, h in zip(block_ids, hashes)
+                if h not in self.store]
+        if not todo:
+            return
+        if self.capacity_blocks == 0:
+            self.discards += len(todo)
+            return
+        ids = np.asarray([b for b, _ in todo], dtype=np.int32)
+        k, v = read_blocks(ids)                 # (L, N, H, BS, D) device
+        try:
+            k.copy_to_host_async()
+            v.copy_to_host_async()
+        except AttributeError:                   # non-array backends
+            pass
+        stamp = self.tick()
+        fresh = []
+        for i, (_, h) in enumerate(todo):
+            hb = _HostBlock(k[:, i], v[:, i], stamp)
+            self.store[h] = hb
+            fresh.append(hb)
+            self.evictions += 1
+        # materialize NOW (both D2H copies are already in flight, so the
+        # waits overlap): a lazily-held device slice would pin the gather's
+        # HBM buffer for the store entry's whole lifetime — the tier would
+        # quietly be device-resident, growing HBM instead of relieving it
+        for hb in fresh:
+            hb.materialize()
+        self._enforce_capacity()
+
+    def _enforce_capacity(self) -> None:
+        while len(self.store) > self.capacity_blocks:
+            h = min(self.store, key=lambda x: self.store[x].stamp)
+            del self.store[h]
+            self.host_evictions += 1
+
+    # ------------------------------------------------------------ readmit side
+    def reserve(self, h: bytes) -> _HostBlock:
+        """REMOVE one host block for a queued re-admission. Removal at
+        reservation time (not at dispatch) matters: a reclaim later in the
+        same allocation could otherwise LRU-evict the entry between the
+        prefix walk and the readmit dispatch, and the prompt would skip
+        prefill over a block that never got its bytes back."""
+        return self.store.pop(h)
+
+    def restore(self, h: bytes, blk: _HostBlock) -> None:
+        """Put a reserved block back (allocation rollback)."""
+        self.store[h] = blk
+        self._enforce_capacity()
+
+    def note_readmitted(self, n_blocks: int) -> None:
+        self.readmit_blocks += n_blocks
+
+
+class TieredBlockAllocator(BlockAllocator):
+    """BlockAllocator + an idle pool and a host tier behind the free list.
+
+    Invariants on top of the base allocator:
+    - a hashed block at refcount 0 parks in ``idle`` (device-resident,
+      hash registered, reusable for free) instead of the free list;
+    - ``_alloc_one`` prefers the free list, then reclaims the
+      least-recently-attended idle block — spilling its bytes to the host
+      tier first — and only then raises;
+    - ``allocate_for_prompt``'s prefix walk sees three tiers: live blocks
+      (refcounted share), idle blocks (reactivate), host store (allocate +
+      queue a re-admission; ``take_pending_readmits`` hands the queue to the
+      runner's readmit dispatch).
+    ``num_free`` counts free + idle: idle blocks ARE allocatable headroom,
+    and the admission signals the router reads must say so.
+    """
+
+    def __init__(self, num_blocks: int, block_size: int, tier: HostKVTier):
+        super().__init__(num_blocks, block_size, enable_prefix_caching=True)
+        self.tier = tier
+        self.idle: Dict[int, int] = {}           # block -> last-attended stamp
+        self._pending_readmits: List[Tuple[int, bytes]] = []
+        # installed by the owning runner: block_ids -> (k, v) device gathers
+        # of ITS cache (a shared tier needs to know which replica is spilling)
+        self.read_blocks: Optional[Callable] = None
+
+    @property
+    def num_free(self) -> int:
+        return len(self.free) + len(self.idle)
+
+    @property
+    def num_free_device(self) -> int:
+        """Free-list-only headroom (no reclaim needed to use it)."""
+        return len(self.free)
+
+    # ---------------------------------------------------------------- internals
+    def _release_one(self, blk: int) -> None:
+        self.refcount[blk] -= 1
+        if self.refcount[blk] > 0:
+            return
+        del self.refcount[blk]
+        if blk in self.block_to_hash:
+            # committed (hashed) block: park idle, keep the hash registered
+            self.idle[blk] = self.tier.tick()
+            return
+        self.free.append(blk)
+
+    def _alloc_one(self) -> int:
+        if self.free:
+            blk = self.free.pop()
+            self.refcount[blk] = 1
+            return blk
+        if self.idle:
+            blk = min(self.idle, key=self.idle.get)   # least recently attended
+            self._reclaim(blk)
+            self.refcount[blk] = 1
+            return blk
+        raise RuntimeError("out of KV blocks")
+
+    def _reclaim(self, blk: int) -> None:
+        """Spill one idle block to the host tier and unregister its hash."""
+        del self.idle[blk]
+        h = self.block_to_hash.pop(blk)
+        self.hash_to_block.pop(h, None)
+        if self.read_blocks is None:
+            raise RuntimeError("TieredBlockAllocator.read_blocks is not "
+                               "installed — attach the tier via "
+                               "ContinuousBatchingRunner(kv_tier=...)")
+        self.tier.spill([blk], [h], self.read_blocks)
+
+    def _reactivate(self, blk: int) -> None:
+        del self.idle[blk]
+        self.refcount[blk] = 1
+
+    def spill_idle(self, keep: int = 0) -> int:
+        """Maintenance/drain hook: spill all but ``keep`` newest idle blocks
+        to the host tier (ONE batched gather, not per-block dispatches) and
+        return them to the free list. Used by replica drain (a removed
+        replica's committed prefixes survive as host bytes) and by
+        tests/harness to force the evict→readmit path."""
+        pairs: List[Tuple[int, bytes]] = []
+        while len(self.idle) > keep:
+            blk = min(self.idle, key=self.idle.get)
+            del self.idle[blk]
+            h = self.block_to_hash.pop(blk)
+            self.hash_to_block.pop(h, None)
+            pairs.append((blk, h))
+            self.free.append(blk)
+        if pairs:
+            if self.read_blocks is None:
+                raise RuntimeError("TieredBlockAllocator.read_blocks is not "
+                                   "installed — attach the tier via "
+                                   "ContinuousBatchingRunner(kv_tier=...)")
+            self.tier.spill([b for b, _ in pairs], [h for _, h in pairs],
+                            self.read_blocks)
+        return len(pairs)
+
+    def free_sequence(self, blocks, no_park=()) -> None:
+        """Release a sequence's blocks. ``no_park``: block ids that must NOT
+        survive as idle prefix-cache entries — a mid-prompt preemption leaves
+        the tail blocks registered but (possibly) unwritten, and an idle pool
+        would otherwise serve their garbage to the next same-prefix request
+        (the base allocator is immune: it drops hashes at release)."""
+        for blk in blocks:
+            if blk in no_park:
+                h = self.block_to_hash.pop(blk, None)
+                if h is not None:
+                    self.hash_to_block.pop(h, None)
+            self._release_one(blk)
+
+    # ---------------------------------------------------------------- prompts
+    def allocate_for_prompt(self, tokens) -> Tuple[List[int], int]:
+        tokens = np.asarray(tokens, dtype=np.int32)
+        n = len(tokens)
+        bs = self.block_size
+        n_full = n // bs
+        blocks: List[int] = []
+        registered: List[int] = []      # blocks whose hash THIS call created
+        pending: List[Tuple[int, bytes]] = []
+        num_cached = 0
+        prev = b""
+        reusing = True
+        hit_tier = False
+        try:
+            for i in range(n_full):
+                chunk = tokens[i * bs : (i + 1) * bs]
+                h = self._chain_hash(prev, chunk)
+                prev = h
+                if reusing and h in self.hash_to_block:
+                    blk = self.hash_to_block[h]
+                    if blk in self.idle:
+                        self._reactivate(blk)
+                    else:
+                        self.refcount[blk] += 1
+                    blocks.append(blk)
+                    num_cached += bs
+                    continue
+                if reusing and h in self.tier:
+                    blk = self._alloc_one()
+                    self.hash_to_block[h] = blk
+                    self.block_to_hash[blk] = h
+                    registered.append(blk)
+                    # reserve the host bytes NOW: a reclaim later in this
+                    # very walk must not LRU-evict them before the dispatch
+                    pending.append((blk, h, self.tier.reserve(h)))
+                    blocks.append(blk)
+                    num_cached += bs
+                    hit_tier = True
+                    continue
+                reusing = False
+                blk = self._alloc_one()
+                self.hash_to_block[h] = blk
+                self.block_to_hash[blk] = h
+                registered.append(blk)
+                blocks.append(blk)
+            remaining = n - n_full * bs
+            if remaining > 0 or n_full == len(blocks):
+                blocks.append(self._alloc_one())
+        except RuntimeError:
+            # clean rollback: hashes registered here must not survive (an
+            # idle-parked never-written block would serve garbage later),
+            # reserved host bytes go back to the store, and queued
+            # re-admissions never reach the runner
+            for _, h, hb in pending:
+                self.tier.restore(h, hb)
+            for blk in registered:
+                h = self.block_to_hash.pop(blk, None)
+                if h is not None:
+                    self.hash_to_block.pop(h, None)
+            for blk in blocks:
+                self._release_one(blk)
+            raise
+        if pending:
+            self._pending_readmits.extend(pending)
+        if hit_tier:
+            self.tier.readmit_requests += 1
+        return blocks, num_cached
+
+    def take_pending_readmits(self) -> List[Tuple[int, bytes]]:
+        out, self._pending_readmits = self._pending_readmits, []
+        return out

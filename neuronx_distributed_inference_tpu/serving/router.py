@@ -1,0 +1,401 @@
+"""Frontend of the scale-out split: prefix-affinity request router.
+
+:class:`PrefixAffinityRouter` owns the arrival queue and places each request
+on an :class:`~.engine.EngineReplica`:
+
+- **Prefix-cache affinity**: the router hashes the prompt's leading full
+  blocks with the SAME chained content hash the replicas' prefix caches key
+  blocks by (engine.prompt_block_hashes), and scores each replica by how many
+  leading blocks it already holds (device cache, idle pool, or host-RAM
+  tier). The replica holding the longest prefix wins ties — the placement
+  that converts block residency into skipped prefill.
+- **Load balancing**: among equal-affinity replicas the one with the most KV
+  headroom wins, then the shallowest queue — the admission signals
+  EngineReplica.admission() exports (the same numbers the SLO monitor and a
+  scrape see).
+- **Graceful spill**: when the affinity target is saturated
+  (``has_headroom`` false), the request places on the best-by-load admitting
+  replica instead and the LOST prefix hit is recorded
+  (``router_affinity_spills_total`` + lost-block count) — saturation trades
+  recompute for latency, visibly.
+- **Drain**: ``drain_replica(id)`` evicts the replica's live requests
+  through the runner's existing mid-prompt preemption/resume path and
+  re-places them (``submit(resume_tokens=...)`` on the target), preserving
+  every request's emitted stream exactly across the migration.
+
+The router is synchronous-cooperative: ``step()`` places what the replicas
+can admit, then steps every replica with work (one serving wave). An async
+server loop wraps ``submit``/``step``; the placement policy has no timing
+dependence, so the tests drive it deterministically.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..utils import metrics as metrics_lib
+from .engine import EngineReplica, prompt_block_hashes
+
+logger = logging.getLogger("tpu-inference")
+
+__all__ = ["PrefixAffinityRouter", "RouterRequest"]
+
+
+@dataclass
+class RouterRequest:
+    """Frontend-side request record: the prompt + serving params, the
+    precomputed affinity hash chain, and the placement/emission state the
+    router tracks across replicas (a request may migrate)."""
+
+    request_id: int
+    prompt: np.ndarray
+    max_new_tokens: int
+    eos_token_id: Optional[int] = None
+    sampling_params: Optional[np.ndarray] = None
+    adapter_id: int = 0
+    arrival_ts: Optional[float] = None
+    hashes: List[bytes] = field(default_factory=list)
+    replica: Optional[str] = None        # current placement (None = queued)
+    local_id: Optional[int] = None       # runner-side request id
+    generated: List[int] = field(default_factory=list)
+    done: bool = False
+    migrations: int = 0
+    affinity_blocks: int = 0             # resident blocks at placement time
+
+
+class PrefixAffinityRouter:
+    """Place requests over N EngineReplicas by prefix affinity + load.
+
+    ``policy``: ``"affinity"`` (default), ``"load"`` (headroom/queue only),
+    or ``"random"`` (uniform over admitting replicas — the bench's control
+    arm for the affinity-hit comparison).
+    """
+
+    def __init__(self, replicas: Sequence[EngineReplica],
+                 policy: str = "affinity", seed: int = 0):
+        if not replicas:
+            raise ValueError("need at least one replica")
+        ids = [r.replica_id for r in replicas]
+        if len(set(ids)) != len(ids):
+            raise ValueError(f"replica ids must be unique, got {ids}")
+        if policy not in ("affinity", "load", "random"):
+            raise ValueError(f"unknown placement policy {policy!r}")
+        self.replicas: Dict[str, EngineReplica] = {
+            r.replica_id: r for r in replicas}
+        self.policy = policy
+        self._rng = np.random.default_rng(seed)
+        paged = {r.runner.paged for r in replicas}
+        if len(paged) != 1:
+            raise ValueError("replicas must agree on paged vs dense serving")
+        self.paged = paged.pop()
+        if self.paged:
+            sizes = {r.runner.block_size for r in replicas}
+            if len(sizes) != 1:
+                raise ValueError("replicas must share one pa_block_size "
+                                 f"(got {sorted(sizes)})")
+            self.block_size = sizes.pop()
+        else:
+            self.block_size = 0
+        self.queue: List[RouterRequest] = []
+        self.requests: Dict[int, RouterRequest] = {}
+        self._next_id = 0
+        # (replica_id, local_id) -> global request id
+        self._local: Dict[tuple, int] = {}
+        # affinity needs more than a prefix cache being ON: the router must
+        # be able to SEE each replica's resident hashes. The native C++
+        # allocator keeps its hash table internal, so a fleet on it honestly
+        # degrades to load placement (and bench's honesty guard refuses to
+        # publish affinity numbers) instead of scoring every replica 0.
+        self.prefix_caching = self.paged and all(
+            getattr(r.runner.allocator, "enable_prefix_caching", False)
+            and hasattr(r.runner.allocator, "hash_to_block")
+            for r in replicas)
+
+        reg = metrics_lib.MetricsRegistry()
+        self.registry = reg
+        self._c_submitted = reg.counter(
+            "router_requests_total", "requests accepted by the frontend")
+        self._c_placed = reg.counter(
+            "router_placements_total", "request placements onto replicas "
+            "(migrations re-count)")
+        self._c_finished = reg.counter(
+            "router_requests_finished_total", "requests fully served")
+        self._c_tokens = reg.counter(
+            "router_tokens_total", "tokens emitted across all replicas")
+        self._c_aff_hits = reg.counter(
+            "router_prefix_affinity_hits_total",
+            "placements that landed on a replica already holding >=1 "
+            "leading prompt block")
+        self._c_aff_blocks = reg.counter(
+            "router_prefix_affinity_blocks_total",
+            "resident leading blocks at placement (skipped prefill, blocks)")
+        self._c_spills = reg.counter(
+            "router_affinity_spills_total",
+            "placements diverted off a saturated affinity target")
+        self._c_spill_blocks = reg.counter(
+            "router_affinity_lost_blocks_total",
+            "resident blocks LOST to spills (recompute bought latency)")
+        self._c_migrations = reg.counter(
+            "router_migrations_total",
+            "requests re-placed by a replica drain")
+        self._g_queue = reg.gauge(
+            "router_queue_depth", "requests waiting at the frontend")
+
+    # ---------------------------------------------------------------- intake
+    def submit(self, prompt, max_new_tokens: int = 32,
+               eos_token_id: Optional[int] = None, sampling_params=None,
+               adapter_id: int = 0, arrival_ts: Optional[float] = None) -> int:
+        prompt = np.asarray(prompt).astype(np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("empty prompt")
+        req = RouterRequest(
+            self._next_id, prompt, max_new_tokens, eos_token_id,
+            None if sampling_params is None
+            else np.asarray(sampling_params, dtype=np.float32).reshape(-1),
+            adapter_id, arrival_ts,
+            hashes=(prompt_block_hashes(prompt, self.block_size, adapter_id)
+                    if self.paged else []))
+        self._next_id += 1
+        self.requests[req.request_id] = req
+        self.queue.append(req)
+        self._c_submitted.inc()
+        self._g_queue.set(len(self.queue))
+        return req.request_id
+
+    # ------------------------------------------------------------- placement
+    def _affinity(self, req: RouterRequest) -> Dict[str, int]:
+        return {rid: rep.resident_prefix_blocks(req.hashes)
+                for rid, rep in self.replicas.items()
+                if not rep.draining}
+
+    def _load_key(self, rep: EngineReplica):
+        """Sort key: most KV headroom first, then shallowest queue, then
+        fewest live rows — ties broken by id for determinism."""
+        a = rep.admission()
+        return (-a.get("kv_blocks_free", 0), a["queue_depth"],
+                a["active_requests"], rep.replica_id)
+
+    def _choose(self, req: RouterRequest):
+        """Returns (replica, affinity_blocks, spilled_from) or None when no
+        replica can admit the request right now."""
+        # a migrated request refeeds prompt + generated at placement, so its
+        # KV footprint is the FULL stream so far, not the prompt alone
+        n = len(req.prompt) + len(req.generated)
+        admitting = [r for r in self.replicas.values() if r.can_admit(n)]
+        if not admitting:
+            return None
+        if self.policy == "random":
+            rep = admitting[int(self._rng.integers(len(admitting)))]
+            return rep, rep.resident_prefix_blocks(req.hashes), None
+        if self.policy == "load" or not self.prefix_caching:
+            rep = min(admitting, key=self._load_key)
+            return rep, rep.resident_prefix_blocks(req.hashes), None
+        aff = self._affinity(req)
+        best_aff = max((aff.get(r.replica_id, 0) for r in admitting),
+                       default=0)
+        if best_aff > 0:
+            targets = [r for r in admitting
+                       if aff.get(r.replica_id, 0) == best_aff]
+            # affinity target with immediate headroom wins; a saturated
+            # target spills to the best-by-load admitting replica
+            ready = [r for r in targets if r.has_headroom(n)]
+            if ready:
+                rep = min(ready, key=self._load_key)
+                return rep, best_aff, None
+            others = [r for r in admitting if r not in targets]
+            ready_others = [r for r in others if r.has_headroom(n)]
+            if ready_others:
+                rep = min(ready_others, key=self._load_key)
+                return rep, aff.get(rep.replica_id, 0), best_aff
+            # nobody has immediate headroom: queue on the affinity target
+            # (the hit survives the wait)
+            rep = min(targets, key=self._load_key)
+            return rep, best_aff, None
+        rep = min(admitting, key=self._load_key)
+        return rep, 0, None
+
+    def place_queued(self) -> int:
+        """Place as many queued requests as replicas will admit (FIFO).
+        Returns the number placed this call."""
+        placed = 0
+        remaining: List[RouterRequest] = []
+        for req in self.queue:
+            choice = self._choose(req)
+            if choice is None:
+                remaining.append(req)
+                continue
+            rep, aff_blocks, lost = choice
+            self._place(req, rep, aff_blocks, lost)
+            placed += 1
+        self.queue = remaining
+        self._g_queue.set(len(self.queue))
+        return placed
+
+    def _place(self, req: RouterRequest, rep: EngineReplica,
+               aff_blocks: int, lost: Optional[int]) -> None:
+        kw = dict(max_new_tokens=req.max_new_tokens,
+                  eos_token_id=req.eos_token_id,
+                  adapter_id=req.adapter_id, arrival_ts=req.arrival_ts)
+        if req.sampling_params is not None:
+            kw["sampling_params"] = req.sampling_params
+        if req.generated:
+            kw["resume_tokens"] = req.generated
+        req.local_id = rep.submit(req.prompt, **kw)
+        req.replica = rep.replica_id
+        req.affinity_blocks = aff_blocks
+        self._local[(rep.replica_id, req.local_id)] = req.request_id
+        self._c_placed.inc()
+        if aff_blocks > 0:
+            self._c_aff_hits.inc()
+            self._c_aff_blocks.inc(aff_blocks)
+        if lost is not None:
+            self._c_spills.inc()
+            self._c_spill_blocks.inc(max(0, lost - aff_blocks))
+
+    # ------------------------------------------------------------- serving
+    def step(self) -> Dict[int, List[int]]:
+        """One serving wave: place what fits, step every replica with work,
+        fold each replica's emissions back to frontend request ids."""
+        self.place_queued()
+        emitted: Dict[int, List[int]] = {}
+        for rid, rep in self.replicas.items():
+            if not rep.has_work:
+                continue
+            for local_id, toks in rep.step().items():
+                self._fold(rid, local_id, toks, emitted)
+        return emitted
+
+    def _fold(self, rid: str, local_id: int, toks: List[int],
+              emitted: Dict[int, List[int]]) -> None:
+        gid = self._local.get((rid, local_id))
+        if gid is None:                     # foreign submit, not ours
+            return
+        req = self.requests[gid]
+        if toks:
+            req.generated.extend(toks)
+            emitted.setdefault(gid, []).extend(toks)
+            self._c_tokens.inc(len(toks))
+        rep = self.replicas[rid]
+        local = rep.runner.finished.get(local_id)
+        if local is not None and not req.done:
+            req.done = True
+            self._c_finished.inc()
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self.queue) or any(r.has_work
+                                       for r in self.replicas.values())
+
+    def run_to_completion(self, max_steps: int = 10000) -> Dict[int, List[int]]:
+        guard = 0
+        while self.has_work:
+            self.step()
+            guard += 1
+            if guard > max_steps:
+                raise RuntimeError("router serving did not converge")
+        return {rid: req.generated for rid, req in self.requests.items()}
+
+    # ------------------------------------------------------------- lifecycle
+    def drain_replica(self, replica_id: str) -> int:
+        """Remove a replica from the placement set: its live requests are
+        preempted through the runner's mid-prompt preemption/resume path and
+        re-queued at the FRONT of the arrival queue (they resume first, with
+        their generated tokens carried via ``resume_tokens``). Returns the
+        number of requests migrated. The replica object stays registered
+        (``reactivate_replica`` re-adds it)."""
+        rep = self.replicas[replica_id]
+        emitted, evicted = rep.drain()
+        # tokens committed by the pipeline flush still belong to the stream
+        final: Dict[int, List[int]] = {}
+        for local_id, toks in emitted.items():
+            self._fold(replica_id, local_id, toks, final)
+        migrated = 0
+        for r in reversed(evicted):
+            gid = self._local.pop((replica_id, r.request_id), None)
+            if gid is None:
+                continue
+            req = self.requests[gid]
+            req.replica = None
+            req.local_id = None
+            req.migrations += 1
+            self.queue.insert(0, req)
+            migrated += 1
+            self._c_migrations.inc()
+        self._g_queue.set(len(self.queue))
+        logger.info("drained replica %s: %d requests re-queued for migration",
+                    replica_id, migrated)
+        return migrated
+
+    def reactivate_replica(self, replica_id: str) -> None:
+        self.replicas[replica_id].reactivate()
+
+    # ------------------------------------------------------------- export
+    def stats(self) -> Dict[str, object]:
+        per_replica = {rid: rep.admission()
+                       for rid, rep in self.replicas.items()}
+        depths = [a["queue_depth"] + a["active_requests"]
+                  for a in per_replica.values()]
+        mean = sum(depths) / max(1, len(depths))
+        return {
+            "policy": self.policy,
+            "prefix_caching": self.prefix_caching,
+            "queue_depth": len(self.queue),
+            "requests": self._c_submitted.value,
+            "finished": self._c_finished.value,
+            "tokens": self._c_tokens.value,
+            "placements": self._c_placed.value,
+            "affinity_hits": self._c_aff_hits.value,
+            "affinity_blocks": self._c_aff_blocks.value,
+            "affinity_spills": self._c_spills.value,
+            "affinity_lost_blocks": self._c_spill_blocks.value,
+            "migrations": self._c_migrations.value,
+            # max/mean replica load (queue + live rows) — the imbalance
+            # number bench publishes as replica_load_imbalance
+            "load_imbalance": (max(depths) / mean if mean > 0 else 1.0),
+            "replicas": per_replica,
+        }
+
+    def prometheus_text(self) -> str:
+        """One exposition: the router's own series plus every replica's
+        (replica-labelled) registry — the label-merging the
+        MetricsRegistry(default_labels=) satellite exists for. Repeated
+        ``# HELP``/``# TYPE`` headers are dropped (every replica registers
+        the same families; a second metadata line for one family is invalid
+        exposition and real scrapers reject the whole page)."""
+        parts = [self.registry.prometheus_text()]
+        parts += [rep.prometheus_text() for rep in self.replicas.values()]
+        # regroup by family: the format requires one metadata block and ALL
+        # series of a family to be consecutive; headers keep first-seen text
+        meta: Dict[str, List[str]] = {}        # family -> header lines
+        series: Dict[str, List[str]] = {}      # family -> series lines
+        order: List[str] = []
+
+        def family_of(line: str) -> str:
+            if line.startswith("#"):
+                toks = line.split(None, 3)
+                return toks[2] if len(toks) >= 3 else line
+            name = line.split("{", 1)[0].split(" ", 1)[0]
+            # histogram child series fold into their family
+            for suffix in ("_bucket", "_sum", "_count"):
+                if name.endswith(suffix) and name[: -len(suffix)] in meta:
+                    return name[: -len(suffix)]
+            return name
+        for part in parts:
+            for line in part.splitlines():
+                fam = family_of(line)
+                if fam not in meta:
+                    meta[fam] = []
+                    series[fam] = []
+                    order.append(fam)
+                if line.startswith("#"):
+                    if not any(ln.split(None, 2)[1] == line.split(None, 2)[1]
+                               for ln in meta[fam]):
+                        meta[fam].append(line)
+                else:
+                    series[fam].append(line)
+        out = [ln for fam in order for ln in meta[fam] + series[fam]]
+        return "\n".join(out) + ("\n" if out else "")

@@ -40,7 +40,8 @@ Counter layout (int32; document any change in docs/OBSERVABILITY.md):
 ``seed_tokens``     first tokens sampled at prompt completion that the host
                     emits (flag-gated: resumed re-inserts pass 0)
 ``step:<kind>``     dispatches per step kind (decode / spec_chunk / mixed /
-                    insert / insert_window)
+                    insert / insert_window / tier_readmit — the host-RAM KV
+                    tier's block re-admission scatter, serving/kv_tiering.py)
 ==================  =========================================================
 """
 
@@ -58,7 +59,8 @@ __all__ = ["CARRY_LEN", "FIELDS", "KINDS", "init_carry", "to_dict",
 # named scalar counters, then one dispatch counter per step kind
 FIELDS = ("tokens", "spec_accepted", "spec_cells", "occupancy", "kv_writes",
           "kv_blocks", "eos", "prefill_tokens", "seed_tokens")
-KINDS = ("decode", "spec_chunk", "mixed", "insert", "insert_window")
+KINDS = ("decode", "spec_chunk", "mixed", "insert", "insert_window",
+         "tier_readmit")
 
 IDX_TOKENS = 0
 IDX_SPEC_ACCEPTED = 1
@@ -77,6 +79,7 @@ KIND_SPEC = KINDS.index("spec_chunk")
 KIND_MIXED = KINDS.index("mixed")
 KIND_INSERT = KINDS.index("insert")
 KIND_INSERT_WINDOW = KINDS.index("insert_window")
+KIND_TIER_READMIT = KINDS.index("tier_readmit")
 
 
 def init_carry():

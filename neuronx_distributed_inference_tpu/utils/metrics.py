@@ -156,15 +156,34 @@ class MetricsRegistry:
     ``enabled=False`` hands out the shared null instrument — the zero-cost
     path for callers that want instrumented code with no accounting at all
     (the serving runner keeps its registry enabled and gates only the
-    event-recording side; see module docstring)."""
+    event-recording side; see module docstring).
 
-    def __init__(self, enabled: bool = True):
+    ``default_labels``: labels merged into EVERY instrument this registry
+    creates (per-call labels win on key collision). The scale-out engine
+    split (serving/engine.py) threads ``{"replica": "<id>"}`` here so every
+    counter a replica's runner registers carries the replica label without
+    any per-call-site threading — N replicas' registries concatenate into
+    one exposition where series stay distinguishable."""
+
+    def __init__(self, enabled: bool = True,
+                 default_labels: Optional[Dict[str, str]] = None):
         self.enabled = enabled
+        self.default_labels = (dict(default_labels) if default_labels
+                               else None)
         self._metrics: Dict[str, object] = {}
+
+    def _merge_labels(self, labels: Optional[Dict[str, str]]
+                      ) -> Optional[Dict[str, str]]:
+        if not self.default_labels:
+            return labels
+        if not labels:
+            return dict(self.default_labels)
+        return {**self.default_labels, **labels}
 
     def _get(self, cls, name, help, labels, **kw):
         if not self.enabled:
             return _NULL
+        labels = self._merge_labels(labels)
         key = _key(name, labels)
         m = self._metrics.get(key)
         if m is None:
@@ -177,8 +196,10 @@ class MetricsRegistry:
 
     def get(self, name: str, labels: Optional[Dict[str, str]] = None):
         """Peek an instrument WITHOUT registering it (None when absent) —
-        read-side consumers (the SLO monitor) must not create series."""
-        return self._metrics.get(_key(name, labels))
+        read-side consumers (the SLO monitor) must not create series. The
+        default labels apply here too, so a reader that names only the
+        series-specific labels finds the replica-labelled instrument."""
+        return self._metrics.get(_key(name, self._merge_labels(labels)))
 
     def counter(self, name: str, help: str = "",
                 labels: Optional[Dict[str, str]] = None) -> Counter:

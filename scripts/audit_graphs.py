@@ -40,7 +40,7 @@ from neuronx_distributed_inference_tpu.analysis import lint  # noqa: E402
 # builds the eagle3 scope's draft.
 _FILE_SCOPES = {
     "runtime/continuous_batching.py": ["cb_dense", "cb_paged", "cb_mixed",
-                                       "cb_spec", "cb_eagle"],
+                                       "cb_spec", "cb_eagle", "serving_tier"],
     "runtime/speculation.py": ["spec", "cb_spec", "cb_eagle", "eagle",
                                "eagle3", "medusa"],
     "runtime/eagle.py": ["eagle", "cb_eagle", "eagle3"],
@@ -54,10 +54,22 @@ _FILE_SCOPES = {
     # (metrics/flight_recorder/slo) never enter a graph — lint-only ([]
     # audits nothing, which is exactly their graph footprint).
     "utils/device_telemetry.py": ["cb_dense", "cb_paged", "cb_mixed",
-                                  "cb_spec", "cb_eagle"],
+                                  "cb_spec", "cb_eagle", "serving_tier"],
     "utils/metrics.py": [],
     "utils/flight_recorder.py": [],
     "utils/slo.py": [],
+    # ISSUE-9 engine/frontend split: the router and engine are host-side
+    # placement/admission logic over runner APIs — they never enter a graph
+    # (lint-only); the KV tier DOES touch cache operands (its readmit scatter
+    # is a registered dispatch and its spill gathers read the live pool), so
+    # a tiering edit re-audits its own scope plus the paged CB fleet whose
+    # caches it shares buffers with. Any OTHER serving/ file stays unmapped
+    # and fails closed to the full fleet (test_graph_contracts pins this).
+    "serving/__init__.py": [],
+    "serving/engine.py": [],
+    "serving/router.py": [],
+    "serving/kv_tiering.py": ["serving_tier", "cb_paged", "cb_mixed",
+                              "cb_spec", "cb_eagle"],
 }
 # any other package .py change (application.py, models/modules/ops/parallel/
 # analysis/config/utils/new files) re-runs the whole fleet — see

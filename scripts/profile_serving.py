@@ -62,10 +62,58 @@ def build_runner(mode: str):
     return runner, list(_prompts((12, 19, 40)))
 
 
+def profile_replicas(n, max_new, logdir, plane):
+    """Per-replica device-time attribution (ISSUE-9 scale-out split): N
+    engine replicas on one tiny app, each traced in its OWN window while the
+    others idle. Same-kind dispatches lower to identical program names across
+    replicas, so a single shared trace could not split device time between
+    them — sequential solo windows keep the attribution honest. Rows come
+    back keyed ``replica<i>:<kind>``."""
+    from neuronx_distributed_inference_tpu.analysis.harness import (_prompts,
+                                                                    _tiny_app)
+    from neuronx_distributed_inference_tpu.runtime.continuous_batching import (
+        ContinuousBatchingRunner)
+    from neuronx_distributed_inference_tpu.serving import EngineReplica
+    from neuronx_distributed_inference_tpu.utils import profiling as prof
+
+    app = _tiny_app(paged=True, cb=True)
+    replicas = [
+        EngineReplica(str(i),
+                      lambda tel: ContinuousBatchingRunner(
+                          app, decode_chunk=4, telemetry=tel),
+                      telemetry_enabled=True)
+        for i in range(n)]
+    prompts = list(_prompts((12, 19, 40)))
+    timing = {}
+    for rep in replicas:
+        # warm outside the trace, then a solo traced window
+        for p in prompts:
+            rep.submit(p, max_new_tokens=max_new)
+        while rep.has_work:
+            rep.step()
+        rep.runner.telemetry.reset()
+        rep.runner.reset_device_telemetry()
+        rdir = f"{logdir}/replica{rep.replica_id}"
+        shutil.rmtree(rdir, ignore_errors=True)
+        with prof.trace(rdir):
+            for p in prompts:
+                rep.submit(p, max_new_tokens=max_new)
+            while rep.has_work:
+                rep.step()
+        for kind, row in rep.runner.attribute_device_time(
+                rdir, plane_substr=plane).items():
+            timing[f"replica{rep.replica_id}:{kind}"] = row
+    return timing
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--mode", choices=("plain", "mixed", "spec"),
                     default="plain")
+    ap.add_argument("--replicas", type=int, default=1,
+                    help="profile N engine replicas (serving/engine.py), one "
+                         "traced solo window each — timing rows come back "
+                         "per replica (plain mode only)")
     ap.add_argument("--max-new-tokens", type=int, default=10)
     ap.add_argument("--logdir", default="/tmp/tpu_profile_serving")
     ap.add_argument("--plane", default="tpu",
@@ -76,6 +124,21 @@ def main(argv=None):
     args = ap.parse_args(argv)
 
     from neuronx_distributed_inference_tpu.utils import profiling as prof
+
+    if args.replicas > 1:
+        if args.mode != "plain":
+            ap.error("--replicas composes with --mode plain only")
+        timing = profile_replicas(args.replicas, args.max_new_tokens,
+                                  args.logdir, args.plane)
+        report = {"mode": "plain", "replicas": args.replicas,
+                  "plane": args.plane, "logdir": args.logdir,
+                  "timing": timing}
+        print(json.dumps(report, indent=2))
+        if args.out:
+            with open(args.out, "w") as fh:
+                json.dump(report, fh, indent=2)
+            print(f"report written to {args.out}", file=sys.stderr)
+        return 0
 
     runner, prompts = build_runner(args.mode)
     # warm OUTSIDE the trace: every executable this schedule touches compiles

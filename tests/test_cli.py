@@ -104,6 +104,75 @@ def test_cli_end_to_end_eagle3_and_serve(tmp_path):
     assert re.search(r"^serving_slo_healthy 1(\.0)?$", prom, re.M), prom
 
 
+def test_cli_routed_serve_replicas_and_kv_tier(tmp_path):
+    """--serve --replicas 2 --kv-host-tier: the scale-out path — requests
+    route through the prefix-affinity router over two engine replicas with a
+    shared host tier, and the merged exposition carries router series plus
+    replica-labelled runner series."""
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM as HFLlama
+
+    from neuronx_distributed_inference_tpu.inference_demo import main
+
+    ckpt = str(tmp_path / "ckpt")
+    cfg = LlamaConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2)
+    torch.manual_seed(0)
+    HFLlama(cfg).eval().save_pretrained(ckpt, safe_serialization=True)
+
+    metrics = str(tmp_path / "metrics.prom")
+    events = str(tmp_path / "events.jsonl")
+    bundle = str(tmp_path / "bundle.json")
+    assert main(["--model-path", ckpt, "--batch-size", "2", "--seq-len", "64",
+                 "--max-context-length", "32", "--dtype", "float32",
+                 "--max-new-tokens", "6", "--check-accuracy-mode", "skip",
+                 "--context-encoding-buckets", "16", "32",
+                 "--token-generation-buckets", "32", "64",
+                 "--continuous-batching", "--paged-attention",
+                 "--pa-num-blocks", "48", "--pa-block-size", "8",
+                 "--serve", "--replicas", "2",
+                 "--kv-host-tier", "--kv-tier-blocks", "64",
+                 "--prompt", "x", "--prompt", "y",
+                 "--stats-interval", "2", "--metrics-out", metrics,
+                 "--events-out", events,
+                 "--slo", "ttft_p99_ms=60000,window_s=120",
+                 "--slo-interval", "2",
+                 "--debug-bundle", bundle]) == 0
+    prom = open(metrics).read()
+    assert "router_requests_total 2" in prom
+    assert 'replica="0"' in prom and 'replica="1"' in prom
+    # the tier gauges export per replica once serving ran
+    assert "serving_kv_tier_host_blocks" in prom
+    # the merged exposition stays format-valid: one metadata block per
+    # family, and each family's series form ONE contiguous run
+    typed, fams = set(), []
+    for ln in prom.splitlines():
+        if ln.startswith("# TYPE "):
+            fam = ln.split()[2]
+            assert fam not in typed, f"duplicate TYPE for {fam}"
+            typed.add(fam)
+        elif ln and not ln.startswith("#"):
+            fam = ln.split("{", 1)[0].split(" ", 1)[0]
+            for sfx in ("_bucket", "_sum", "_count"):
+                if fam.endswith(sfx) and fam[: -len(sfx)] in typed:
+                    fam = fam[: -len(sfx)]
+            fams.append(fam)
+    runs = [f for i, f in enumerate(fams) if i == 0 or fams[i - 1] != f]
+    assert len(runs) == len(set(runs)), "family series are not consecutive"
+    # per-replica observability artifacts exist and parse
+    import json as _json
+
+    for i in ("0", "1"):
+        lines = open(f"{events}.replica{i}").read().splitlines()
+        assert any(_json.loads(ln)["event"] == "arrival" for ln in lines)
+        from neuronx_distributed_inference_tpu.utils.flight_recorder import (
+            load_bundle)
+
+        b = load_bundle(f"{bundle}.replica{i}")
+        assert b["reason"] == "exit"
+
+
 def test_parity_flags_map_to_config():
     """Round-3 parity flags: hybrid MoE sharding, pp/mlp-cp validation,
     max-num-seqs batch widening, draft tp override."""

@@ -352,16 +352,30 @@ def test_changed_mode_scope_map_fails_closed():
     # a doc/test-only change audits nothing
     assert mod._scopes_for_changes(["docs/STATIC_ANALYSIS.md"]) == []
     # ISSUE-7: the in-graph telemetry carry is threaded through EVERY CB
-    # dispatch kind, so a carry edit re-audits the full CB fleet...
+    # dispatch kind (ISSUE-9 added the tier-readmit scatter to that set), so
+    # a carry edit re-audits the full CB fleet...
     assert set(mod._scopes_for_changes(
         [pkg + "utils/device_telemetry.py"])) == {
-        "cb_dense", "cb_paged", "cb_mixed", "cb_spec", "cb_eagle"}
+        "cb_dense", "cb_paged", "cb_mixed", "cb_spec", "cb_eagle",
+        "serving_tier"}
     # ...while the host-side observability modules never enter a graph
     # (lint-only), and an UNMAPPED utils module still fails closed
     assert mod._scopes_for_changes([pkg + "utils/flight_recorder.py"]) == []
     assert mod._scopes_for_changes([pkg + "utils/slo.py"]) == []
     assert mod._scopes_for_changes([pkg + "utils/metrics.py"]) == []
     assert mod._scopes_for_changes([pkg + "utils/benchmark.py"]) is None
+    # ISSUE-9 engine/frontend split: router/engine are host-side placement
+    # logic (lint-only); the KV tier touches cache operands -> its own scope
+    # plus the paged CB fleet; an UNMAPPED serving/ file fails closed to the
+    # full fleet (a new serving module must widen the audit, never shrink it)
+    assert mod._scopes_for_changes([pkg + "serving/router.py"]) == []
+    assert mod._scopes_for_changes([pkg + "serving/engine.py"]) == []
+    assert set(mod._scopes_for_changes([pkg + "serving/kv_tiering.py"])) == {
+        "serving_tier", "cb_paged", "cb_mixed", "cb_spec", "cb_eagle"}
+    assert mod._scopes_for_changes(
+        [pkg + "serving/prefill_pool.py"]) is None
+    assert "serving_tier" in set(mod._scopes_for_changes(
+        [pkg + "runtime/continuous_batching.py"]))
     # every mapped scope name actually exists in the harness
     from neuronx_distributed_inference_tpu.analysis import harness
     for scopes in mod._FILE_SCOPES.values():

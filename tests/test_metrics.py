@@ -149,6 +149,42 @@ def _drive_fake_requests(tel):
     tel.request_finished(1, "eos", 4)
 
 
+def test_registry_default_labels_merge_and_exposition():
+    """ISSUE-9 per-replica labelling: default_labels ride every instrument a
+    registry creates (the engine threads {"replica": id} once instead of at
+    every call site), per-call labels win on collision, and the Prometheus
+    exposition carries the merged label set."""
+    reg = MetricsRegistry(default_labels={"replica": "3"})
+    reg.counter("req_total", "requests", labels={"kind": "decode"}).inc(2)
+    reg.gauge("depth", "queue depth").set(1.5)
+    text = reg.prometheus_text()
+    assert 'req_total{replica="3",kind="decode"} 2' in text
+    assert 'depth{replica="3"} 1.5' in text
+    # exposition stays series-shaped with merged labels
+    series = re.compile(
+        r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[a-zA-Z_]+="[^"]*"'
+        r'(,[a-zA-Z_+]+="[^"]*")*\})? -?[0-9.+eEinf]+$')
+    for ln in text.strip().split("\n"):
+        if not ln.startswith("#"):
+            assert series.match(ln), ln
+    # per-call value WINS on key collision (explicit beats default)
+    c = reg.counter("req_total", labels={"replica": "9", "kind": "x"})
+    assert c.labels["replica"] == "9"
+    # read-side get() resolves through the default labels, and two
+    # registries with different defaults keep distinct series
+    assert reg.get("depth") is not None
+    assert reg.get("req_total", labels={"kind": "decode"}) is not None
+    other = MetricsRegistry(default_labels={"replica": "4"})
+    other.gauge("depth").set(9)
+    merged = reg.prometheus_text() + other.prometheus_text()
+    assert 'depth{replica="3"} 1.5' in merged
+    assert 'depth{replica="4"} 9.0' in merged
+    # no defaults -> exactly the old behavior (unlabelled names)
+    plain = MetricsRegistry()
+    plain.counter("req_total").inc()
+    assert "req_total 1" in plain.prometheus_text()
+
+
 def test_telemetry_lifecycle_aggregates_and_event_log_agree(tmp_path):
     """stats() percentiles must be recomputable from the JSONL event log —
     the acceptance bar for the serving integration, pinned here on the

@@ -1,0 +1,264 @@
+"""Scale-out serving (serving/engine.py + serving/router.py): N replicas
+behind the prefix-affinity router must serve BIT-identical streams to
+dedicated single-runner references — including forced drain/migration and a
+forced KV-tier evict→readmit — while the placement counters (affinity hits,
+spills, migrations, load) tell the truth about what the router did."""
+
+import numpy as np
+import pytest
+
+from neuronx_distributed_inference_tpu.config import (
+    TpuConfig, load_pretrained_config)
+from neuronx_distributed_inference_tpu.models.llama.modeling_llama import (
+    LlamaForCausalLM, LlamaInferenceConfig)
+from neuronx_distributed_inference_tpu.runtime.continuous_batching import (
+    ContinuousBatchingRunner)
+from neuronx_distributed_inference_tpu.serving import (EngineReplica,
+                                                       HostKVTier,
+                                                       PrefixAffinityRouter)
+from neuronx_distributed_inference_tpu.serving.engine import (
+    prompt_block_hashes)
+
+BS = 8   # pa_block_size everywhere here
+
+
+def _make_app(hf_cfg, slots=2, blocks=48, seq_len=96):
+    tpu_cfg = TpuConfig(
+        batch_size=slots, seq_len=seq_len, max_context_length=32,
+        dtype="float32", context_encoding_buckets=[16, 32],
+        token_generation_buckets=[48, 96], is_continuous_batching=True,
+        paged_attention_enabled=True, pa_num_blocks=blocks, pa_block_size=BS)
+    config = LlamaInferenceConfig(tpu_cfg,
+                                  load_config=load_pretrained_config(hf_cfg))
+    app = LlamaForCausalLM(None, config)
+    app.load_random(seed=0)
+    return app
+
+
+@pytest.fixture(scope="module")
+def app(tiny_llama_hf_config):
+    return _make_app(tiny_llama_hf_config)
+
+
+def _replicas(app, n=2, tier=None, **runner_kw):
+    return [EngineReplica(
+        str(i), lambda tel: ContinuousBatchingRunner(
+            app, decode_chunk=4, telemetry=tel, kv_tier=tier, **runner_kw))
+        for i in range(n)]
+
+
+def _reference(app, prompts, max_new):
+    return [app.generate(p[None, :], max_new_tokens=max_new
+                         ).tokens[0].tolist() for p in prompts]
+
+
+def _live_replica(router):
+    for rid, rep in router.replicas.items():
+        if any(r is not None and not r.done for r in rep.runner.active):
+            return rid
+    raise AssertionError("no replica has live requests")
+
+
+# ----------------------------------------------------------------- e2e exact
+def test_multi_replica_e2e_exact_with_migration_and_readmit(
+        tiny_llama_hf_config, app):
+    """THE acceptance e2e: a staggered (Poisson-ish) trace over 2 replicas,
+    one forced drain/migration mid-stream and one forced KV-tier
+    evict→readmit, every emitted stream bit-identical to its dedicated
+    single-runner reference."""
+    rng = np.random.default_rng(0)
+    prefix = rng.integers(1, 256, size=(2 * BS,)).astype(np.int32)
+    prompts = [
+        np.concatenate([prefix, rng.integers(1, 256, size=(4,)).astype(np.int32)]),
+        rng.integers(1, 256, size=(12,)).astype(np.int32),
+        rng.integers(1, 256, size=(19,)).astype(np.int32),
+        np.concatenate([prefix, rng.integers(1, 256, size=(6,)).astype(np.int32)]),
+    ]
+    refs = _reference(app, prompts, max_new=12)
+
+    tier = HostKVTier(capacity_blocks=32)
+    router = PrefixAffinityRouter(_replicas(app, 2, tier=tier))
+    # staggered arrivals: first wave, serve a little, then a second wave
+    rids = [router.submit(prompts[i], max_new_tokens=12) for i in (0, 1, 2)]
+    router.step()
+    # forced DRAIN of a replica with live requests -> migration via the
+    # preemption/resume path; streams must continue exactly
+    victim = _live_replica(router)
+    assert router.drain_replica(victim) >= 1
+    router.step()
+    router.reactivate_replica(victim)
+    # forced tier EVICT: everything idle spills to host RAM; the late
+    # same-prefix arrival must hit the host tier and READMIT
+    router.run_to_completion()
+    spilled = sum(rep.runner.spill_idle_blocks()
+                  for rep in router.replicas.values())
+    assert spilled >= 2, "no committed prefix blocks to spill"
+    rids.append(router.submit(prompts[3], max_new_tokens=12))
+    out = router.run_to_completion()
+    for i, rid in enumerate(rids):
+        assert out[rid] == refs[i], f"request {i} diverged from reference"
+    s = router.stats()
+    assert s["migrations"] >= 1, "the drain never migrated a live request"
+    assert tier.readmit_blocks >= 2, "the tier evict->readmit never fired"
+    assert s["finished"] == len(rids)
+
+
+def test_drain_mid_prompt_insert_migrates_exactly(tiny_llama_hf_config):
+    """Drain while a request is still STREAMING ITS PROMPT (chunked insert):
+    the mid-prompt preemption/resume path re-places it and the stream matches
+    the dedicated run."""
+    app = _make_app(tiny_llama_hf_config)
+    rng = np.random.default_rng(5)
+    long_prompt = rng.integers(1, 256, size=(40,)).astype(np.int32)
+    (want,) = _reference(app, [long_prompt], max_new=8)
+
+    router = PrefixAffinityRouter(_replicas(
+        app, 2, max_insert_tokens_per_step=16))
+    rid = router.submit(long_prompt, max_new_tokens=8)
+    router.place_queued()
+    rep = router.replicas[router.requests[rid].replica]
+    rep.step()                              # one 16-token insert window only
+    assert any(r is not None and r.inserting for r in rep.runner.active), \
+        "test setup: the prompt should still be mid-insert"
+    assert router.drain_replica(rep.replica_id) == 1
+    out = router.run_to_completion()
+    assert out[rid] == want
+    assert router.requests[rid].migrations == 1
+
+
+# ------------------------------------------------------------- placement
+def test_affinity_places_on_prefix_holder(tiny_llama_hf_config, app):
+    tier = HostKVTier(capacity_blocks=32)
+    router = PrefixAffinityRouter(_replicas(app, 2, tier=tier))
+    rng = np.random.default_rng(7)
+    prefix = rng.integers(1, 256, size=(2 * BS,)).astype(np.int32)
+    pa = np.concatenate([prefix, rng.integers(1, 256, size=(3,)).astype(np.int32)])
+    pb = np.concatenate([prefix, rng.integers(1, 256, size=(5,)).astype(np.int32)])
+    ra = router.submit(pa, max_new_tokens=4)
+    router.run_to_completion()
+    holder = router.requests[ra].replica
+    hashes = prompt_block_hashes(pb, BS)
+    assert router.replicas[holder].resident_prefix_blocks(hashes) == 2
+    rb = router.submit(pb, max_new_tokens=4)
+    router.place_queued()
+    assert router.requests[rb].replica == holder
+    s = router.stats()
+    assert s["affinity_hits"] == 1 and s["affinity_blocks"] == 2
+    router.run_to_completion()
+
+
+def test_saturated_affinity_target_spills_with_accounting(
+        tiny_llama_hf_config, app):
+    tier = HostKVTier(capacity_blocks=32)
+    router = PrefixAffinityRouter(_replicas(app, 2, tier=tier))
+    rng = np.random.default_rng(11)
+    prefix = rng.integers(1, 256, size=(2 * BS,)).astype(np.int32)
+
+    def pp(n, seed):
+        r = np.random.default_rng(seed)
+        return np.concatenate([prefix,
+                               r.integers(1, 256, size=(n,)).astype(np.int32)])
+
+    # wave 1: make one replica the prefix holder, then fill BOTH its slots
+    # with long same-prefix requests (affinity concentrates them there)
+    r0 = router.submit(pp(3, 1), max_new_tokens=4)
+    router.run_to_completion()
+    holder = router.requests[r0].replica
+    long_ids = [router.submit(pp(4 + i, 2 + i), max_new_tokens=30)
+                for i in range(2)]
+    router.step()
+    for rid in long_ids:
+        assert router.requests[rid].replica == holder
+    # the holder's slots are now full; a fresh same-prefix request must
+    # SPILL to the idle replica and the lost hit must be recorded
+    spilled_rid = router.submit(pp(9, 9), max_new_tokens=4)
+    router.place_queued()
+    assert router.requests[spilled_rid].replica != holder
+    s = router.stats()
+    assert s["affinity_spills"] == 1
+    assert s["affinity_lost_blocks"] >= 2
+    router.run_to_completion()
+
+
+def test_policies_and_validation(tiny_llama_hf_config, app):
+    reps = _replicas(app, 2)
+    with pytest.raises(ValueError, match="policy"):
+        PrefixAffinityRouter(reps, policy="lru")
+    with pytest.raises(ValueError, match="unique"):
+        PrefixAffinityRouter([reps[0], reps[0]])
+    with pytest.raises(ValueError, match="at least one"):
+        PrefixAffinityRouter([])
+    router = PrefixAffinityRouter(_replicas(app, 2), policy="random", seed=3)
+    rng = np.random.default_rng(13)
+    prompts = [rng.integers(1, 256, size=(n,)).astype(np.int32)
+               for n in (10, 11, 12, 13)]
+    refs = _reference(app, prompts, max_new=6)
+    rids = [router.submit(p, max_new_tokens=6) for p in prompts]
+    out = router.run_to_completion()
+    for i, rid in enumerate(rids):
+        assert out[rid] == refs[i]
+    # random placement records no affinity intent
+    assert router.stats()["affinity_spills"] == 0
+
+
+def test_admission_signals_and_queue_ceiling(tiny_llama_hf_config, app):
+    (rep,) = _replicas(app, 1)
+    a = rep.admission()
+    assert a["accepting"] and a["queue_depth"] == 0
+    assert a["kv_blocks_total"] == 48
+    assert 0.0 < a["kv_headroom_frac"] <= 1.0
+    assert rep.blocks_needed(12) == -(-(12 + 1 + 4) // BS)
+    assert rep.can_admit(12)
+    # a prompt no pool size can hold is refused outright
+    assert not rep.can_admit(10_000)
+    # queue ceiling: 2x slots
+    rng = np.random.default_rng(17)
+    for _ in range(rep.max_queue_depth):
+        rep.runner.queue.append(object())          # depth without placement
+    assert not rep.can_admit(12)
+    rep.runner.queue.clear()
+    rep.draining = True
+    assert not rep.can_admit(12)
+
+
+def test_replica_label_merged_exposition(tiny_llama_hf_config, app):
+    """The metrics satellite end-to-end: every instrument a replica's runner
+    registers carries replica=<id> via registry default_labels, and the
+    router exposition concatenates router + replica series scrapeably."""
+    router = PrefixAffinityRouter(_replicas(app, 2))
+    rng = np.random.default_rng(19)
+    rid = router.submit(rng.integers(1, 256, size=(10,)).astype(np.int32),
+                        max_new_tokens=4)
+    router.run_to_completion()
+    assert router.requests[rid].done
+    text = router.prometheus_text()
+    assert "router_requests_total 1" in text
+    for i in ("0", "1"):
+        assert f'replica="{i}"' in text
+    # a runner-registered series carries the label without the runner ever
+    # having threaded it
+    assert 'serving_preemptions_total{replica="0"} 0' in text
+    # the replica registry resolves reads through the default labels too
+    rep0 = router.replicas["0"]
+    assert rep0.registry.get("serving_preemptions_total") is not None
+
+
+def test_engine_replica_factory_validation(tiny_llama_hf_config, app):
+    with pytest.raises(ValueError, match="exactly one"):
+        EngineReplica("0")
+    with pytest.raises(ValueError, match="telemetry"):
+        EngineReplica("0", lambda tel: ContinuousBatchingRunner(
+            app, decode_chunk=4))   # factory ignored the telemetry
+    runner = ContinuousBatchingRunner(app, decode_chunk=4)
+    rep = EngineReplica("x", runner=runner)
+    assert rep.runner is runner
+
+
+def test_router_rejects_mixed_block_geometry(tiny_llama_hf_config, app):
+    other = _make_app(tiny_llama_hf_config, blocks=24)
+    other.tpu_config.pa_block_size = 16           # forged geometry mismatch
+    r1 = _replicas(app, 1)[0]
+    runner2 = ContinuousBatchingRunner(other, decode_chunk=4)
+    r2 = EngineReplica("1", runner=runner2)
+    with pytest.raises(ValueError, match="block_size"):
+        PrefixAffinityRouter([r1, r2])

@@ -109,6 +109,32 @@ def test_module_alias_jit_in_runtime_flagged():
     assert "jit-no-donate" in _rules(fs)
 
 
+def test_unregistered_jit_in_serving_flagged():
+    """ISSUE-9: serving/ is a dispatching subsystem like runtime/ — a raw
+    jax.jit there (e.g. a new tiering transfer) must register with the
+    auditor or carry a waiver, exactly like the runner's steps."""
+    fs = _run("""
+        import jax
+
+        def _readmit(cache, blocks):
+            return cache
+
+        step = jax.jit(_readmit)
+    """, rel="serving/kv_tiering.py")
+    assert "raw-jit" in _rules(fs)
+    # audited_jit in serving/ is the sanctioned form
+    fs = _run("""
+        from ..analysis.registry import audited_jit
+
+        def _readmit(cache, blocks):
+            return cache
+
+        step = audited_jit(_readmit, kind="cb.paged.tier_readmit",
+                           cache_args=("cache",))
+    """, rel="serving/kv_tiering.py")
+    assert "raw-jit" not in _rules(fs)
+
+
 def test_unregistered_jit_outside_runtime_not_flagged():
     fs = _run("""
         import jax
