@@ -70,17 +70,19 @@ def _dense_app(kernel):
 
 
 @functools.lru_cache(maxsize=None)
-def _paged_runner(kernel, tp=1, sp=False, b=8, steps=4, tag=""):
+def _paged_runner(kernel, tp=1, sp=False, b=8, steps=4, tag="", mega=0):
     """``tag`` keys ENV-variant units (fused/separate, overlap/fallback) to
     their own runner: jax caches the traced jaxpr per jit object, so two
     lowerings of ONE dispatch under different trace-time env toggles would
-    silently reuse the first trace — each variant needs its own jit."""
-    del tag
+    silently reuse the first trace — each variant needs its own jit.
+    ``mega`` > 0 builds the runner with the device-resident megastep
+    (megastep_k = megastep_ring = mega) so its while_loop dispatch exists."""
     from ..config import TpuConfig, load_pretrained_config
     from ..models.llama.modeling_llama import (LlamaForCausalLM,
                                                LlamaInferenceConfig)
     from ..runtime.continuous_batching import ContinuousBatchingRunner
 
+    del tag
     cfg = TpuConfig(batch_size=b, seq_len=4096, max_context_length=128,
                     dtype="bfloat16", context_encoding_buckets=[128],
                     token_generation_buckets=[512],
@@ -92,7 +94,8 @@ def _paged_runner(kernel, tp=1, sp=False, b=8, steps=4, tag=""):
                                   load_config=load_pretrained_config(CANARY_HF))
     app = LlamaForCausalLM(None, config)
     app.load_random(seed=0)
-    return app, ContinuousBatchingRunner(app, decode_chunk=steps)
+    kw = dict(megastep_k=mega, megastep_ring=mega) if mega else {}
+    return app, ContinuousBatchingRunner(app, decode_chunk=steps, **kw)
 
 
 def _set_paged_decode_example(app, runner, b=8, steps=4, mb=4):
@@ -301,6 +304,54 @@ def _group_mixed_chunk(chunk_lens=(64, 128, 256)
     return units, rules
 
 
+def _set_megastep_example(app, runner, b=8, ring=4, mb=4):
+    from ..ops import sampling as sampling_ops
+    from ..utils import device_telemetry as dtel
+
+    sp = sampling_ops.prepare_sampling_params(b)
+    runner._megastep_step.set_example(
+        app.params, jnp.zeros((b,), jnp.int32), jnp.full((b,), 128, jnp.int32),
+        jnp.ones((b,), bool), jnp.full((b,), 64, jnp.int32), runner.cache,
+        dtel.init_carry(), jnp.zeros((b, mb), jnp.int32),
+        jnp.full((b,), 4096, jnp.int32), sp, jax.random.PRNGKey(0),
+        jnp.zeros((b,), jnp.int32), jnp.full((b,), -1, jnp.int32),
+        jnp.asarray(ring, jnp.int32), jnp.asarray(0, jnp.int32),
+        ring_cap=ring, greedy=True)
+
+
+def _group_megastep() -> Tuple[List[AuditUnit], List[Rule]]:
+    """ISSUE-10 megastep canary: the device-resident while_loop serving step
+    is ONE executable whose compiled HBM traffic is ~K-invariant — weights
+    and caches are passed (and charged) ONCE however many inner steps the
+    loop runs. The K sweep rides the only K-shaped static (the ring
+    capacity); the in-loop iteration count itself is a dynamic operand, so a
+    4x ring sweep bounding byte growth at 2% pins exactly the "dispatch floor
+    amortizes K×, bytes don't" property the bs=1 bench phase banks on. The
+    absolute rule bounds the whole megastep at 16x one weights+KV-pool pass
+    (measured 11.6x at this geometry: XLA charges pallas custom-call
+    operands whole-pool per operand and the while body's charges stack on
+    the entry/exit copies — the rule is a regression tripwire against an
+    extra O(pool) copy, not a sharp bound)."""
+    b, ring = 8, 4
+    app, runner = _paged_runner(True, b=b, mega=ring, tag="mega")
+    _set_megastep_example(app, runner, b=b, ring=ring, mb=4)
+    d = runner._megastep_step
+    units = [
+        AuditUnit("megastep_ring4", d, contract=generic_contract(d)),
+        AuditUnit("megastep_ring16", d, overrides={"ring_cap": 16},
+                  contract=generic_contract(d)),
+    ]
+    ideal = (sum(x.nbytes for x in jax.tree.leaves(app.params))
+             + sum(x.nbytes for x in jax.tree.leaves(runner.cache)))
+    rules = [
+        ratio_rule("megastep_bytes_k_invariant", "megastep_ring16",
+                   "megastep_ring4", 1.02),
+        absolute_rule("megastep_one_weights_pass", "megastep_ring4",
+                      16.0 * ideal),
+    ]
+    return units, rules
+
+
 def _group_tp_collectives() -> Tuple[List[AuditUnit], List[Rule]]:
     """The PR-5 multichip canary: the tp>1 paged decode step's collective
     schedule is pinned per layer and table/batch-shape-invariant; the overlap
@@ -333,6 +384,7 @@ GROUPS: Dict[str, object] = {
     "paged_table_width": _group_paged_table_width,
     "multiquery": _group_multiquery,
     "mixed_chunk": _group_mixed_chunk,
+    "megastep": _group_megastep,
     "tp_collectives": _group_tp_collectives,
 }
 

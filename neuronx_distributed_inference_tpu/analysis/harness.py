@@ -147,6 +147,25 @@ def _exercise_cb(paged: bool, mixed: bool = False) -> Any:
     return runner
 
 
+def _exercise_cb_megastep() -> Any:
+    """Device-resident serving megastep (ISSUE-10): run a paged CB runner
+    whose plain decode dispatch is the lax.while_loop megastep, with a ring
+    smaller than K so the ring-full service exit is exercised too (the
+    executable is ONE program either way — n_iters is a dynamic operand)."""
+    from ..runtime.continuous_batching import ContinuousBatchingRunner
+
+    app = _tiny_app(paged=True, cb=True)
+    runner = ContinuousBatchingRunner(app, decode_chunk=4, megastep_k=4,
+                                      megastep_ring=4)
+    for p in _prompts((12, 19)):
+        runner.submit(p, max_new_tokens=6)
+    runner.run_to_completion()
+    if not runner._megastep_exit_counters:
+        raise RuntimeError("megastep harness never dispatched a megastep — "
+                           "the cb.paged.megastep example was not captured")
+    return runner
+
+
 def _exercise_cb_spec() -> Any:
     from ..runtime.continuous_batching import ContinuousBatchingRunner
 
@@ -315,6 +334,7 @@ SCOPES: Dict[str, Tuple] = {
                   "cb.paged.decode")),
     "cb_mixed": (lambda: _exercise_cb(True, mixed=True),
                  ("cb.paged.mixed",)),
+    "cb_megastep": (_exercise_cb_megastep, ("cb.paged.megastep",)),
     "cb_spec": (_exercise_cb_spec, ("cb.spec.chunk", "cb.spec.insert_pair")),
     "cb_eagle": (_exercise_cb_eagle, ("cb.eagle.insert", "cb.eagle.chunk")),
     "serving_tier": (_exercise_serving_tier, ("cb.paged.tier_readmit",)),

@@ -163,6 +163,18 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--prefill-token-budget", type=int, default=0,
                    help="max prompt tokens packed per mixed serving step "
                         "(default 2x --prefill-chunk)")
+    g.add_argument("--megastep", type=int, default=0, metavar="K",
+                   help="with --serve + paged attention: run plain decode as "
+                        "device-resident MEGASTEPS — one jitted "
+                        "lax.while_loop of up to K inner steps per dispatch "
+                        "with on-device scheduler state and in-graph early "
+                        "exits (bs=1 pays the dispatch floor once per K "
+                        "tokens instead of once per token)")
+    g.add_argument("--megastep-ring", type=int, default=0, metavar="N",
+                   help="with --megastep: emitted-token ring capacity "
+                        "(default K) — the megastep yields for host service "
+                        "when the ring fills, bounding commit latency "
+                        "independently of K")
     g.add_argument("--metrics-out", default=None, metavar="PATH",
                    help="with --serve: write the final metrics registry as "
                         "Prometheus text exposition to PATH (enables serving "
@@ -604,6 +616,12 @@ def _run_serving(args, app, tokenizer) -> None:
         # forwarded even without --prefill-chunk so the runner's own
         # validation raises instead of silently ignoring the flag
         kw["prefill_token_budget"] = args.prefill_token_budget
+    if args.megastep:
+        kw["megastep_k"] = args.megastep
+    if args.megastep_ring:
+        # forwarded even without --megastep so the runner's own validation
+        # raises instead of silently ignoring the flag
+        kw["megastep_ring"] = args.megastep_ring
     telemetry = None
     if (args.metrics_out or args.trace_out or args.events_out
             or args.stats_interval or args.slo or args.debug_bundle):
@@ -705,6 +723,10 @@ def _run_serving_routed(args, app, tokenizer) -> None:
         kw["prefill_chunk"] = args.prefill_chunk
     if args.prefill_token_budget:
         kw["prefill_token_budget"] = args.prefill_token_budget
+    if args.megastep:
+        kw["megastep_k"] = args.megastep
+    if args.megastep_ring:
+        kw["megastep_ring"] = args.megastep_ring
     telemetry_on = bool(args.metrics_out or args.trace_out or args.events_out
                         or args.stats_interval or args.slo
                         or args.debug_bundle)

@@ -124,6 +124,26 @@ def make_slot_mapping(block_table: np.ndarray, positions: np.ndarray,
     return slots.astype(np.int32)
 
 
+def device_slot_advance(block_table: jnp.ndarray, positions: jnp.ndarray,
+                        alive: jnp.ndarray, block_size: int) -> jnp.ndarray:
+    """IN-GRAPH single-token slot mapping from DEVICE-resident positions: the
+    ``lax.while_loop`` megastep's per-inner-step analog of
+    :func:`make_slot_mapping` (ISSUE-10). The host cannot precompute the
+    megastep's slot chunk — early exits make the executed positions
+    data-dependent — so each inner step derives its own write slot from the
+    authoritative device positions through the (host-pre-reserved) block
+    table. Rows advance INTO pre-reserved table entries as positions cross
+    block boundaries; the megastep's coverage early-exit guarantees no live
+    row ever reads past its reserved run, and frozen rows get slot -1 (the
+    dropped-write sentinel, same as the scan path's ``slots_live``).
+    """
+    mb = block_table.shape[1]
+    blk_idx = jnp.minimum(positions // block_size, mb - 1)
+    phys = jnp.take_along_axis(block_table, blk_idx[:, None], axis=1)[:, 0]
+    slots = phys * block_size + positions % block_size
+    return jnp.where(alive, slots, -1)
+
+
 def make_chunk_slot_mapping(block_table: np.ndarray, positions: np.ndarray,
                             lengths: np.ndarray, num_tokens: int,
                             block_size: int) -> np.ndarray:

@@ -1,0 +1,48 @@
+"""On-device emitted-token ring buffer for the device-resident serving
+megastep (the ``lax.while_loop`` inner loop, ISSUE-10 / ROADMAP open item 2).
+
+≈ reference async output queue (`modules/async_execution.py:190-306`): the
+reference's 2-deep async decode parks each step's output tensor host-side and
+syncs one step late; here the per-inner-step tokens never leave the device —
+the while_loop body pushes one ``(B,)`` token row per executed inner step into
+a fixed ``(capacity, B)`` ring that rides the loop carry, and the host drains
+the whole ring ONCE per megastep (the megastep's single sync), replaying its
+commit rules over ``ring[:n_executed]``. TPU redesign notes:
+
+- The ring is (capacity, B) rather than (B, capacity) so each push is one
+  contiguous ``dynamic_update_index_in_dim`` row write (no strided scatter).
+- Capacity is a trace-time static (the jitted megastep's ring shape); the
+  executed-iteration count ``n`` is DYNAMIC — one executable serves every
+  early-exit length, and the ring-full condition is one of the megastep's
+  in-graph host-service exits (the host commits, i.e. "services", the ring
+  and the next dispatch starts the cursor back at 0 — the wrap).
+- Rows frozen in-graph (eos/budget stops) still push their pinned token,
+  exactly like the scan-chunk path's ``toks`` output: the host replay
+  discards post-stop tokens, so the two paths stay bit-identical.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+__all__ = ["init_ring", "push", "drain"]
+
+
+def init_ring(capacity: int, batch: int) -> jnp.ndarray:
+    """Fresh zeroed (capacity, B) int32 ring (trace-time: capacity static)."""
+    return jnp.zeros((capacity, batch), jnp.int32)
+
+
+def push(ring: jnp.ndarray, i, toks: jnp.ndarray) -> jnp.ndarray:
+    """Write one inner step's per-row tokens at ring row ``i`` (traced int32
+    cursor) — one contiguous row update inside the while_loop body."""
+    return lax.dynamic_update_index_in_dim(ring, toks, i, axis=0)
+
+
+def drain(ring_host, n: int) -> np.ndarray:
+    """Host-side view of the committed prefix of a synced ring:
+    (capacity, B) -> (B, n) in the (slots, steps) layout the runner's
+    ``_commit`` replay consumes."""
+    return np.asarray(ring_host)[:n].T

@@ -34,11 +34,28 @@ from ..analysis.registry import audited_jit, step_loop_body
 from ..models import base as model_base
 from ..modules import autobucketing, block_kvcache
 from ..ops import sampling as sampling_ops
+from ..ops import token_ring
 from ..parallel.sharding import named_sharding
 from ..utils import device_telemetry as dtel
 from . import model_wrapper
 
 logger = logging.getLogger("tpu-inference")
+
+# Device-resident megastep (ISSUE-10 / ROADMAP open item 2): in-graph exit
+# codes of the lax.while_loop serving loop, in evaluation priority order.
+# ``iters`` = ran the full requested inner-step count; ``stopped`` = every row
+# froze in-graph (eos / max-new budget); ``blocks`` = a live row reached its
+# host-pre-reserved block coverage; ``arrival`` = the host's pending-arrival
+# service flag cut the loop after one step; ``ring`` = the emitted-token ring
+# filled before the requested count (the host drains — "services" — it and
+# the next megastep continues).
+MEGASTEP_EXIT_ITERS = 0
+MEGASTEP_EXIT_STOPPED = 1
+MEGASTEP_EXIT_BLOCKS = 2
+MEGASTEP_EXIT_ARRIVAL = 3
+MEGASTEP_EXIT_RING = 4
+MEGASTEP_EXITS = {0: "iters", 1: "stopped", 2: "blocks", 3: "arrival",
+                  4: "ring"}
 
 
 def _emitted_count(emitted: Dict[int, List[int]]) -> int:
@@ -104,6 +121,8 @@ class ContinuousBatchingRunner:
                  prefill_chunk: Optional[int] = None,
                  prefill_token_budget: Optional[int] = None,
                  mixed_decode_steps: Optional[int] = None,
+                 megastep_k: Optional[int] = None,
+                 megastep_ring: Optional[int] = None,
                  telemetry=None, kv_tier=None):
         cfg = app.tpu_config
         if not cfg.is_continuous_batching:
@@ -170,6 +189,55 @@ class ContinuousBatchingRunner:
         elif prefill_token_budget is not None or mixed_decode_steps is not None:
             raise ValueError("prefill_token_budget/mixed_decode_steps require "
                              "prefill_chunk")
+        # --- device-resident serving megasteps (ISSUE-10) ----------------------
+        # With ``megastep_k`` every plain decode dispatch becomes ONE jitted
+        # lax.while_loop of up to K inner steps with the scheduler state that
+        # used to be a host replica — alive masks, positions, remaining
+        # budgets, slot-mapping advance through the block table, eos stops,
+        # the emitted-token ring — living AUTHORITATIVELY on device. The loop
+        # early-exits in-graph (all rows stopped / host-pre-reserved block
+        # coverage reached / emitted ring full / pending-arrival service
+        # flag), so bs=1 decode pays the ~109 ms dispatch floor once per K
+        # tokens instead of once per token while insert latency stays bounded
+        # by the ring's service condition, not by K. The host syncs ONCE per
+        # megastep (executed-count + ring) and replays the exact commit rules
+        # over the drained prefix. Composes with async_depth (megasteps
+        # pipeline like scan chunks), with spec serving (the near-boundary /
+        # adaptive plain fall-through runs megasteps), and with the mixed
+        # scheduler (its pure-decode fall-through runs megasteps).
+        if megastep_k is not None:
+            if not cfg.paged_attention_enabled:
+                raise ValueError("megastep_k (device-resident serving "
+                                 "megasteps) requires paged attention — the "
+                                 "in-loop slot-mapping advance consumes the "
+                                 "block table")
+            if megastep_k < 1:
+                raise ValueError("megastep_k must be >= 1")
+            if megastep_ring is not None and megastep_ring < 1:
+                raise ValueError("megastep_ring must be >= 1")
+        elif megastep_ring is not None:
+            raise ValueError("megastep_ring requires megastep_k")
+        self.megastep_k = megastep_k
+        self.megastep_ring = (megastep_ring if megastep_ring is not None
+                              else megastep_k)
+        # host mirrors of the megastep's in-graph exit/progress accounting:
+        # per-reason exit counters (stats()["megastep"]["exits"] reads their
+        # live values, so a telemetry.reset() between bench windows scopes
+        # exits, dispatches AND inner_steps to the same window) plus the
+        # committed-inner-step counter that must equal the device carry's
+        # ``megastep_iters`` field at every pipeline flush
+        self._megastep_exit_counters: Dict[str, object] = {}
+        self._m_megastep_iters = reg.counter(
+            "serving_megastep_inner_steps_total",
+            "decode inner steps committed through device-resident megasteps")
+        # scheduler fall-through visibility (ISSUE-10 satellite): every
+        # degradation to the plain path goes through ONE guarded exit that
+        # counts the reason and stamps it on the next step-timeline record
+        # of ANY kind — a megastep/mixed run that quietly degrades is
+        # visible in telemetry. Pending notes accumulate (a truncation
+        # immediately followed by a pure-decode fall-through loses neither).
+        self._pending_fall_through: List[str] = []
+        self._ft_counters: Dict[tuple, object] = {}
         self.mixed = prefill_chunk is not None
         self.prefill_chunk = prefill_chunk
         self.prefill_budget = (prefill_token_budget
@@ -638,6 +706,106 @@ class ContinuousBatchingRunner:
                 carry_args=("telem",),
                 static_argnames=("num_steps", "greedy"),
                 steps_arg="num_steps")
+
+            if self.megastep_k is not None:
+                def _megastep(params, tok0, positions, alive0, budget0, cache,
+                              telem, block_table, coverage, sampling_params,
+                              key, adapter_ids, eos_ids, n_iters, service,
+                              ring_cap, greedy=False):
+                    """ONE device-resident serving megastep: a lax.while_loop
+                    of up to ``min(n_iters, ring_cap)`` decode inner steps
+                    whose scheduler state — token/position/alive/budget
+                    carry, per-step slot-mapping advance through the block
+                    table, eos/budget stops, the emitted-token ring — is
+                    AUTHORITATIVE on device (the host state is the replica
+                    now). Early exits, checked before every inner step:
+
+                    - all rows stopped (the in-graph mirror of the host's
+                      commit/stop replay — same freeze rules as the scan);
+                    - a live row's next write position reached ``coverage``
+                      (its host-pre-reserved block budget, in positions:
+                      ``len(blocks) * block_size``) — in-loop block
+                      consumption never outruns the reservation;
+                    - the emitted ring filled (``ring_cap`` < requested);
+                    - the host's pending-arrival ``service`` flag (the loop
+                      yields after ONE step so queued work is serviced at
+                      step-wise latency, not K-step latency).
+
+                    ``n_iters`` and ``service`` are DYNAMIC operands — one
+                    executable serves every seq-room clamp and queue state;
+                    only ``ring_cap``/``greedy`` are static. Returns the
+                    ring, the executed count, the exit code, and the device
+                    carry that seeds the next dispatch (async megasteps
+                    pipeline exactly like scan chunks)."""
+                    keys = jax.random.split(key, ring_cap)
+                    ring0 = token_ring.init_ring(ring_cap, tok0.shape[0])
+                    n_eff = jnp.minimum(n_iters, ring_cap)
+
+                    def in_coverage(pos, alive):
+                        return jnp.all(jnp.where(alive, pos < coverage, True))
+
+                    def cond(carry):
+                        i, tok, pos, alive, budget, ring, cache, telem = carry
+                        more = (jnp.any(alive) & (i < n_eff)
+                                & in_coverage(pos, alive))
+                        return more & ((i == 0) | (service == 0))
+
+                    def body(carry):
+                        i, tok, pos, alive, budget, ring, cache, telem = carry
+                        slots = block_kvcache.device_slot_advance(
+                            block_table, pos, alive, bs_blk)[:, None]
+                        with jax.default_matmul_precision(precision):
+                            logits, cache = decode_core(
+                                params, args, tok[:, None], pos, cache, None,
+                                mesh=mesh, rules=rules,
+                                block_table=block_table, slot_mapping=slots,
+                                adapter_ids=adapter_ids, **paged_kernel_kw)
+                            if greedy:
+                                nxt = sampling_ops.greedy(logits[:, -1],
+                                                          mesh=mesh,
+                                                          rules=rules)
+                            else:
+                                nxt = sampling_ops.sample(logits[:, -1],
+                                                          sampling_params,
+                                                          keys[i], odsc,
+                                                          mesh=mesh,
+                                                          rules=rules)
+                        telem = dtel.decode_tick(telem, alive, nxt, eos_ids)
+                        telem = dtel.kv_tick(telem, slots, bs_blk)
+                        telem = dtel.megastep_iter_tick(telem)
+                        nxt = jnp.where(alive, nxt, tok)
+                        ring = token_ring.push(ring, i, nxt)
+                        pos = pos + alive.astype(pos.dtype)
+                        budget = budget - alive.astype(budget.dtype)
+                        alive = jnp.logical_and(alive, budget > 0)
+                        alive = jnp.logical_and(alive, nxt != eos_ids)
+                        return (i + 1, nxt, pos, alive, budget, ring, cache,
+                                telem)
+
+                    (n_run, tok_l, pos_l, alive_l, budget_l, ring, cache,
+                     telem) = jax.lax.while_loop(
+                        cond, body,
+                        (jnp.asarray(0, jnp.int32), tok0, positions, alive0,
+                         budget0, ring0, cache, telem))
+                    stopped = ~jnp.any(alive_l)
+                    blocks = ~in_coverage(pos_l, alive_l)
+                    served = (service != 0) & (n_run < n_eff)
+                    ring_full = (n_run >= ring_cap) & (ring_cap < n_iters)
+                    exit_code = jnp.where(
+                        stopped, MEGASTEP_EXIT_STOPPED,
+                        jnp.where(blocks, MEGASTEP_EXIT_BLOCKS,
+                                  jnp.where(served, MEGASTEP_EXIT_ARRIVAL,
+                                            jnp.where(ring_full,
+                                                      MEGASTEP_EXIT_RING,
+                                                      MEGASTEP_EXIT_ITERS))))
+                    telem = dtel.bump_kind(telem, dtel.KIND_MEGASTEP)
+                    return ((ring, n_run, exit_code.astype(jnp.int32)),
+                            (tok_l, pos_l, alive_l, budget_l), cache, telem)
+
+                self._megastep_step = audited_jit(
+                    _megastep, kind="cb.paged.megastep",
+                    cache_args=("cache",), carry_args=("telem",),
+                    static_argnames=("ring_cap", "greedy"))
 
             if self.mixed:
                 def _mixed(params, tok0, positions, alive0, budget0, cache,
@@ -1310,6 +1478,17 @@ class ContinuousBatchingRunner:
             self._m_round_trip.set(v)
 
     # ------------------------------------------ device-resident telemetry carry
+    def _dispatch_carry(self, alive_h, budget_h):
+        """(tok, pos, alive, budget) operands for the next decode dispatch:
+        the device-resident carry of the newest in-flight dispatch when one
+        exists (authoritative — stops tracked in-graph), else the host
+        state. THE one definition both the scan-chunk and megastep paths
+        seed from, so the carry-vs-host precedence cannot desynchronize."""
+        if self._dev_state is not None:
+            return self._dev_state
+        return (jnp.asarray(self.last_tok), jnp.asarray(self.positions),
+                jnp.asarray(alive_h), jnp.asarray(budget_h))
+
     def _carry_replay_state(self):
         """Per-row (alive, budget, eos_id) counting state for the telemetry
         carry's in-graph replay of the host commit rules — THE one
@@ -1355,7 +1534,15 @@ class ContinuousBatchingRunner:
         if self._inflight:
             raise RuntimeError("cannot reset the device telemetry carry with "
                                "chunks in flight — drain the pipeline first")
-        self._telem_dev = dtel.init_carry()
+        fresh = dtel.init_carry()
+        if hasattr(self._telem_dev, "sharding"):
+            # preserve the live carry's placement: a default-placed zeros
+            # block silently RECOMPILES every warm step executable on a
+            # multi-device mesh (the donated carry's sharding is part of the
+            # jit cache key) — measured 287 ms on the 8-device CPU mesh,
+            # paid by the first step of every bench measurement window
+            fresh = jax.device_put(fresh, self._telem_dev.sharding)
+        self._telem_dev = fresh
         self._telem_drained = self._telem_dev
         self.telemetry.note_device_counters(
             dtel.to_dict(np.zeros((dtel.CARRY_LEN,), np.int32)))
@@ -1374,6 +1561,7 @@ class ContinuousBatchingRunner:
         "mixed": ("_mixed",),
         "insert": ("_insert", "_window", "_seed"),
         "tier_readmit": ("_tier_readmit",),
+        "megastep": ("_megastep",),
     }
 
     @staticmethod
@@ -1476,6 +1664,23 @@ class ContinuousBatchingRunner:
             # count and the host-store state ride alongside
             s["kv_blocks_free_device"] = self.allocator.num_free_device
             s["kv_tier"] = self.kv_tier.stats()
+        if self.megastep_k is not None:
+            # committed megastep accounting (host mirror of the device
+            # carry's megastep fields — equal at every pipeline flush):
+            # per-exit-reason dispatch counts + total inner steps, the
+            # honesty surface the bench's bs=1 phase reads before publishing
+            # a megastep number. All three read the registry counters, so a
+            # telemetry.reset() between bench windows scopes them together.
+            exits = {r: int(c.value)
+                     for r, c in sorted(self._megastep_exit_counters.items())
+                     if c.value}
+            s["megastep"] = {
+                "k": self.megastep_k,
+                "ring": self.megastep_ring,
+                "dispatches": sum(exits.values()),
+                "inner_steps": self._m_megastep_iters.value,
+                "exits": exits,
+            }
         if self.k:
             s["spec"] = {
                 "iterations": self.spec_iters_run,
@@ -1599,8 +1804,12 @@ class ContinuousBatchingRunner:
         return bool(self.queue) or any(r is not None for r in self.active)
 
     def _pend_steps(self) -> int:
-        """Total decode steps currently in flight (dispatch-ahead pipeline)."""
-        return sum(s for _, s in self._inflight)
+        """Upper bound on decode steps currently in flight (dispatch-ahead
+        pipeline). Scan entries advance exactly their step count; megastep
+        entries advance AT MOST their dispatched inner-step bound (early
+        exits advance less — the device carry is exact, this host estimate
+        only feeds the conservative seq-room / block-growth clamps)."""
+        return sum(e[4] if e[0] == "mega" else e[2] for e in self._inflight)
 
     def _async_ok(self, extra_steps: int) -> bool:
         """True when dispatch-ahead is exact for the next chunk(s): no queued
@@ -1630,13 +1839,42 @@ class ContinuousBatchingRunner:
         return True
 
     def _drain(self, emitted: Dict[int, List[int]]) -> None:
-        """Sync + commit every in-flight chunk, oldest first (no-op when the
-        pipeline is empty)."""
+        """Sync + commit every in-flight dispatch, oldest first (no-op when
+        the pipeline is empty)."""
         while self._inflight:
-            toks_dev, steps = self._inflight.pop(0)
-            self._commit(np.asarray(toks_dev), steps, emitted)
+            self._commit_entry(self._inflight.pop(0), emitted)
         self._dev_state = None
         self._m_inflight.set(0)
+
+    def _commit_entry(self, entry, emitted: Dict[int, List[int]]):
+        """Sync + commit one in-flight dispatch result.
+
+        Scan entries ``("scan", toks_dev, steps)`` carry a host-known step
+        count; megastep entries ``("mega", ring_dev, n_dev, exit_dev, n_max)``
+        sync the device's executed-iteration count, the exit code, and the
+        token ring in the megastep's ONE host sync, then replay the exact
+        same per-token commit rules over the drained ``ring[:n]`` prefix.
+        Returns ``(steps_committed, exit_reason-or-None)``."""
+        if entry[0] == "mega":
+            _, ring_dev, n_dev, exit_dev, _n_max = entry
+            n = int(np.asarray(n_dev))
+            code = int(np.asarray(exit_dev))
+            if n:
+                self._commit(token_ring.drain(ring_dev, n), n, emitted)
+            reason = MEGASTEP_EXITS.get(code, str(code))
+            self._m_megastep_iters.inc(n)
+            c = self._megastep_exit_counters.get(reason)
+            if c is None:
+                c = self.telemetry.registry.counter(
+                    "serving_megastep_exits_total",
+                    "megastep in-graph early-exit/completion reasons",
+                    labels={"reason": reason})
+                self._megastep_exit_counters[reason] = c
+            c.inc()
+            return n, reason
+        _, toks_dev, steps = entry
+        self._commit(np.asarray(toks_dev), steps, emitted)
+        return steps, None
 
     def _commit(self, toks: np.ndarray, steps: int,
                 emitted: Dict[int, List[int]]) -> None:
@@ -1740,9 +1978,11 @@ class ContinuousBatchingRunner:
         # leaving steady state (placements pending, a row near the seq bound,
         # block headroom gone, or async off) drains the pipeline first so the
         # sync path sees exact state
+        look_ahead = (self.megastep_k if self.megastep_k is not None
+                      else self.decode_chunk)
         if self._inflight and (
                 self.queue or not self._async_ok(
-                    self._pend_steps() + 2 * self.decode_chunk)):
+                    self._pend_steps() + 2 * look_ahead)):
             self._drain(emitted)
 
         key = self._place_queued(key, emitted)
@@ -1776,7 +2016,13 @@ class ContinuousBatchingRunner:
     def _step_plain(self, key, emitted: Dict[int, List[int]]
                     ) -> Dict[int, List[int]]:
         """One plain (non-speculative) decode chunk for every slot. Also the
-        exact near-boundary fallback for spec mode (see _step_spec)."""
+        exact near-boundary fallback for spec mode (see _step_spec). With
+        ``megastep_k`` the plain dispatch is the device-resident while_loop
+        megastep instead of the host-stepped scan chunk — every caller
+        (step(), the spec fall-through, the mixed fall-through) inherits it
+        through this one interception point."""
+        if self.megastep_k is not None:
+            return self._step_device_loop(key, emitted)
         tel = self.telemetry
         t_step = tel.step_start()
         n_emit0 = _emitted_count(emitted) if t_step is not None else 0
@@ -1825,13 +2071,8 @@ class ContinuousBatchingRunner:
                 self._drain(emitted)
                 return emitted
         alive_h, budget_h, eos_h = self._carry_replay_state()
-        if self._dev_state is not None:
-            tok0, pos_dev, alive_dev, budget_dev = self._dev_state
-        else:
-            tok0 = jnp.asarray(self.last_tok)
-            pos_dev = jnp.asarray(self.positions)
-            alive_dev = jnp.asarray(alive_h)
-            budget_dev = jnp.asarray(budget_h)
+        tok0, pos_dev, alive_dev, budget_dev = self._dispatch_carry(
+            alive_h, budget_h)
         eos_ids = jnp.asarray(eos_h)
         if self.paged:
             slot_chunk = self._slot_mapping_fn(
@@ -1859,14 +2100,13 @@ class ContinuousBatchingRunner:
         if self._async_ok(pend_steps + steps + chunk):
             # steady state: append the new chunk, keep at most async_depth in
             # flight — committing the oldest overlaps the newer dispatches
-            self._inflight.append((toks_dev, steps))
+            self._inflight.append(("scan", toks_dev, steps))
             self._dev_state = dev_state
             while len(self._inflight) > self.async_depth:
-                toks, st = self._inflight.pop(0)
                 # committing the OLDEST in-flight chunk is the one designed
                 # host sync of dispatch-ahead
                 # lint: ok(step-loop-sync): oldest-chunk commit, the designed sync
-                self._commit(np.asarray(toks), st, emitted)
+                self._commit_entry(self._inflight.pop(0), emitted)
             self._m_inflight.set(len(self._inflight))
         else:
             self._drain(emitted)                       # older chunks commit first
@@ -1881,8 +2121,179 @@ class ContinuousBatchingRunner:
                 in_flight=len(self._inflight),
                 kv_free=self.allocator.num_free if self.paged else None,
                 kv_total=self.allocator.num_blocks if self.paged else None,
-                ici_bytes=self._ici_bytes(steps))
+                ici_bytes=self._ici_bytes(steps),
+                extra=self._consume_fall_through())
         return emitted
+
+    @step_loop_body
+    def _step_device_loop(self, key, emitted: Dict[int, List[int]]
+                          ) -> Dict[int, List[int]]:
+        """One device-resident serving MEGASTEP (ISSUE-10 / ROADMAP open item
+        2): dispatch ONE jitted lax.while_loop of up to ``megastep_k`` decode
+        inner steps, then sync once and replay the host commit rules over the
+        drained emitted-token ring. The scheduler state the step-wise path
+        keeps authoritative on the host — alive/budget/eos stops, positions,
+        the slot-mapping advance — lives on device for the whole loop; the
+        host contributes only the conservative pre-dispatch clamps (seq room,
+        best-effort block reservation) and the pending-arrival service flag.
+        Exactness: the in-graph freeze rules are the scan chunk's, the ring
+        replay is ``_commit``'s, and early exits only regroup dispatches —
+        the emitted stream is bit-identical to the step-wise path."""
+        tel = self.telemetry
+        t_step = tel.step_start()
+        n_emit0 = _emitted_count(emitted) if t_step is not None else 0
+        active_rows = [r for r in self.active if r is not None]
+        live = [r for r in active_rows if not r.done and not r.inserting]
+        if not live:
+            self._drain(emitted)
+            return emitted
+        pend = self._pend_steps()
+        max_pos = max(r.position for r in live) + pend
+        # seq-room clamp rides as a DYNAMIC operand (n_iters): unlike the
+        # scan chunk's static num_steps, tail-of-generation rooms never sweep
+        # fresh executables — ONE megastep executable serves every clamp
+        n = min(self.megastep_k, self.cfg.seq_len - 1 - max_pos)
+        if n <= 0:
+            self._drain(emitted)
+            victim = max(live, key=lambda r: r.position)
+            victim.truncated = True
+            self._finish(victim)
+            return emitted
+        active_rows = self._reserve_megastep_blocks(active_rows, pend + n)
+        if not active_rows:
+            self._drain(emitted)
+            return emitted
+        live = [r for r in active_rows if not r.done and not r.inserting]
+        if not live:
+            self._drain(emitted)
+            return emitted
+        alive_h, budget_h, eos_h = self._carry_replay_state()
+        tok0, pos_dev, alive_dev, budget_dev = self._dispatch_carry(
+            alive_h, budget_h)
+        # per-row coverage of the host-pre-reserved block budget, in
+        # POSITIONS: the loop's in-graph block consumption early-exits when a
+        # live row's true device position reaches it (the host estimate can
+        # be short under allocator pressure — that costs loop iterations,
+        # never correctness)
+        coverage = np.zeros((self.num_slots,), np.int32)
+        for slot, r in enumerate(self.active):
+            if r is not None:
+                coverage[slot] = len(r.blocks) * self.block_size
+        # pending-arrival service flag: with queued work that could not place
+        # (no free slot / blocks), yield after ONE inner step so a finishing
+        # row is serviced at step-wise latency instead of K-step latency
+        service = np.int32(1 if self.queue else 0)
+        greedy = self._chunk_greedy(live)
+        key, sub = jax.random.split(key)
+        with tel.annotate("megastep"):
+            (ring_dev, n_dev, exit_dev), dev_state, self.cache, \
+                self._telem_dev = self._megastep_step(
+                    self.app.params, tok0, pos_dev, alive_dev, budget_dev,
+                    self.cache, self._telem_dev,
+                    jnp.asarray(self.block_table), jnp.asarray(coverage),
+                    self._sampling_matrix(), sub,
+                    jnp.asarray(self.adapter_ids), jnp.asarray(eos_h),
+                    np.int32(n), service, ring_cap=self.megastep_ring,
+                    greedy=greedy)
+        entry = ("mega", ring_dev, n_dev, exit_dev, min(n, self.megastep_ring))
+        n_done = None
+        if self._async_ok(pend + n + self.megastep_k):
+            self._inflight.append(entry)
+            self._dev_state = dev_state
+            while len(self._inflight) > self.async_depth:
+                # committing the OLDEST in-flight megastep is the one
+                # designed host sync of dispatch-ahead
+                # lint: ok(step-loop-sync): oldest-chunk commit, the designed sync
+                self._commit_entry(self._inflight.pop(0), emitted)
+            self._m_inflight.set(len(self._inflight))
+        else:
+            self._drain(emitted)                    # older dispatches first
+            n_done = self._commit_entry(entry, emitted)
+        if t_step is not None:
+            extra = self._consume_fall_through() or {}
+            extra["megastep_requested"] = n
+            if n_done is not None:
+                # sync path: the executed count and in-graph exit reason are
+                # already on the host (async records them at commit time via
+                # the exits counter instead — the dispatch-time record only
+                # knows the upper bound)
+                extra["megastep_exit"] = n_done[1]
+            tel.step_record(
+                t_step, "megastep",
+                iterations=n_done[0] if n_done is not None else n,
+                tokens=_emitted_count(emitted) - n_emit0,
+                occupancy=len(live), slots=self.num_slots,
+                in_flight=len(self._inflight),
+                kv_free=self.allocator.num_free,
+                kv_total=self.allocator.num_blocks,
+                ici_bytes=self._ici_bytes(
+                    n_done[0] if n_done is not None else n),
+                extra=extra)
+        return emitted
+
+    def _reserve_megastep_blocks(self, active_rows: List[Request],
+                                 steps: int) -> List[Request]:
+        """Best-effort block reservation for one megastep: extend every
+        decoding row toward ``position + steps + 1`` coverage but STOP at
+        allocator exhaustion instead of preempting — the megastep's in-graph
+        coverage check early-exits when a live row reaches its reserved
+        budget, so partial coverage costs loop iterations, never
+        correctness. The preempting grower (``_grow_blocks``) only runs when
+        some row cannot cover even its next KV write (zero-progress stall)."""
+        bs = self.block_size
+        for req in active_rows:
+            if req.inserting or req.done:
+                continue        # insert rows hold their full-prompt blocks
+            want = req.position + steps + 1
+            if len(req.blocks) * bs < want:
+                try:
+                    self.allocator.extend(req.blocks, want)
+                except RuntimeError:
+                    # partial reservation: take what the free list still has,
+                    # one block at a time (extend() rolls back all-or-nothing)
+                    while len(req.blocks) * bs < want:
+                        try:
+                            self.allocator.extend(req.blocks,
+                                                  len(req.blocks) * bs + 1)
+                        except RuntimeError:
+                            break
+            self.block_table[req.slot, : len(req.blocks)] = req.blocks
+        if any(not r.inserting and not r.done
+               and len(r.blocks) * bs <= r.position for r in active_rows):
+            active_rows = self._grow_blocks(active_rows, 1)
+        return active_rows
+
+    def _fall_through(self, from_kind: str, reason: str, key,
+                      emitted: Dict[int, List[int]]) -> Dict[int, List[int]]:
+        """The ONE guarded scheduler exit to the plain path (ISSUE-10
+        satellite): count the degradation, stamp the reason on the next
+        step-timeline record, then run the plain step (which is the megastep
+        when megastep_k is set — a mixed/spec run that quietly degrades is
+        visible in telemetry, never silent)."""
+        self._note_fall_through(from_kind, reason)
+        return self._step_plain(key, emitted)
+
+    def _note_fall_through(self, from_kind: str, reason: str) -> None:
+        self._pending_fall_through.append(f"{from_kind}:{reason}")
+        c = self._ft_counters.get((from_kind, reason))
+        if c is None:
+            c = self.telemetry.registry.counter(
+                "serving_fallthrough_total",
+                "scheduler fall-throughs / degradations by origin and reason",
+                labels={"from": from_kind, "reason": reason})
+            self._ft_counters[(from_kind, reason)] = c
+        c.inc()
+
+    def _consume_fall_through(self) -> Optional[Dict[str, object]]:
+        """Step-timeline payload for the pending fall-through notes (one-shot
+        — consumed by the NEXT recorded step of any kind, so a note from a
+        branch that records no step itself, e.g. the mixed seq-room
+        truncation, still lands on the timeline instead of going stale)."""
+        if not self._pending_fall_through:
+            return None
+        reasons = ",".join(self._pending_fall_through)
+        self._pending_fall_through = []
+        return {"fall_through": reasons}
 
     def _ici_bytes(self, iterations: int, prefill_tokens: int = 0
                    ) -> Optional[int]:
@@ -1945,7 +2356,8 @@ class ContinuousBatchingRunner:
         if not inserting:
             # pure-decode steady state: fall through BEFORE draining so async
             # dispatch-ahead keeps overlapping (_step_plain owns the pipeline)
-            return self._step_plain(key, emitted)
+            return self._fall_through("mixed", "no_insert_in_flight", key,
+                                      emitted)
         tel = self.telemetry
         t_step = tel.step_start()
         n_emit0 = _emitted_count(emitted) if t_step is not None else 0
@@ -1969,15 +2381,18 @@ class ContinuousBatchingRunner:
                 victim = max(live, key=lambda r: r.position)
                 victim.truncated = True
                 self._finish(victim)
+                self._note_fall_through("mixed", "seq_room_truncated")
                 return emitted
             active_rows = self._grow_blocks(active_rows, steps)
             if not active_rows:
+                self._note_fall_through("mixed", "all_rows_preempted")
                 return emitted
             # growth may have preempted an inserting request
             inserting = [r for r in active_rows if r.inserting]
             live = [r for r in active_rows if not r.done and not r.inserting]
             if not inserting:
-                return self._step_plain(key, emitted)
+                return self._fall_through("mixed", "inserts_preempted", key,
+                                          emitted)
 
         # token budget -> chunk assignments, oldest placement first (FIFO
         # completion; every in-flight insert advances before any one hogs the
@@ -2074,7 +2489,8 @@ class ContinuousBatchingRunner:
                 kv_free=self.allocator.num_free,
                 kv_total=self.allocator.num_blocks,
                 ici_bytes=self._ici_bytes(steps,
-                                          sum(w for _, w in chosen)))
+                                          sum(w for _, w in chosen)),
+                extra=self._consume_fall_through())
         return emitted
 
     @step_loop_body
@@ -2094,7 +2510,8 @@ class ContinuousBatchingRunner:
         if self.spec_adaptive and self._spec_off:
             self._spec_plain_chunks += 1
             if self._spec_plain_chunks < self.spec_probe_every:
-                return self._step_plain(key, emitted)
+                return self._fall_through("spec", "adaptive_floor", key,
+                                          emitted)
             self._spec_plain_chunks = 0
             self._spec_off = False         # re-probe with one spec chunk
             self._m_spec_guard.set(0)
@@ -2106,7 +2523,7 @@ class ContinuousBatchingRunner:
             # remaining tokens: finish it with EXACT plain decode steps (draft
             # KV gaps from this path only dent later acceptance rates, never
             # correctness — the target verifies every token)
-            return self._step_plain(key, emitted)
+            return self._fall_through("spec", "seq_room", key, emitted)
         # an iteration commits >=1 token/row: running past the tightest row's
         # remaining budget only wastes flops. Clamped values quantize to
         # powers of two — num_iters is a static jit arg (see
@@ -2184,7 +2601,8 @@ class ContinuousBatchingRunner:
                 kv_total=self.allocator.num_blocks if self.paged else None,
                 accept_mean=(chunk_added / chunk_cells if chunk_cells
                              else None),
-                ici_bytes=self._ici_bytes(iters))
+                ici_bytes=self._ici_bytes(iters),
+                extra=self._consume_fall_through())
         if (self.spec_adaptive and chunk_cells
                 and chunk_added / chunk_cells < self.spec_min_accept):
             self._spec_off = True

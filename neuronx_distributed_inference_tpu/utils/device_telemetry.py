@@ -39,9 +39,15 @@ Counter layout (int32; document any change in docs/OBSERVABILITY.md):
 ``prefill_tokens``  prompt tokens written by insert windows / mixed chunk rows
 ``seed_tokens``     first tokens sampled at prompt completion that the host
                     emits (flag-gated: resumed re-inserts pass 0)
+``megastep_iters``  inner steps executed by device-resident megastep loops
+                    (the ``lax.while_loop`` serving path, ISSUE-10: per-inner-
+                    step progress is otherwise invisible to the host until the
+                    megastep's one sync — once the pipeline flushes this
+                    equals the host's committed-iteration counter exactly)
 ``step:<kind>``     dispatches per step kind (decode / spec_chunk / mixed /
                     insert / insert_window / tier_readmit — the host-RAM KV
-                    tier's block re-admission scatter, serving/kv_tiering.py)
+                    tier's block re-admission scatter, serving/kv_tiering.py —
+                    / megastep — the device-resident while_loop decode)
 ==================  =========================================================
 """
 
@@ -54,13 +60,14 @@ import numpy as np
 
 __all__ = ["CARRY_LEN", "FIELDS", "KINDS", "init_carry", "to_dict",
            "decode_tick", "dense_kv_tick", "kv_tick", "prefill_tick",
-           "seed_tick", "spec_tick", "bump_kind"]
+           "seed_tick", "spec_tick", "megastep_iter_tick", "bump_kind"]
 
 # named scalar counters, then one dispatch counter per step kind
 FIELDS = ("tokens", "spec_accepted", "spec_cells", "occupancy", "kv_writes",
-          "kv_blocks", "eos", "prefill_tokens", "seed_tokens")
+          "kv_blocks", "eos", "prefill_tokens", "seed_tokens",
+          "megastep_iters")
 KINDS = ("decode", "spec_chunk", "mixed", "insert", "insert_window",
-         "tier_readmit")
+         "tier_readmit", "megastep")
 
 IDX_TOKENS = 0
 IDX_SPEC_ACCEPTED = 1
@@ -71,6 +78,7 @@ IDX_KV_BLOCKS = 5
 IDX_EOS = 6
 IDX_PREFILL = 7
 IDX_SEED = 8
+IDX_MEGA_ITERS = 9
 KIND_BASE = len(FIELDS)
 CARRY_LEN = KIND_BASE + len(KINDS)
 
@@ -80,6 +88,7 @@ KIND_MIXED = KINDS.index("mixed")
 KIND_INSERT = KINDS.index("insert")
 KIND_INSERT_WINDOW = KINDS.index("insert_window")
 KIND_TIER_READMIT = KINDS.index("tier_readmit")
+KIND_MEGASTEP = KINDS.index("megastep")
 
 
 def init_carry():
@@ -166,6 +175,13 @@ def spec_tick(telem, alive_t, budget, out_toks, n, eos_ids):
     telem = telem.at[IDX_EOS].add(jnp.sum(eos_hit))
     budget = budget - committed
     return telem, alive_t & (budget > 0) & ~eos_hit, budget
+
+
+def megastep_iter_tick(telem):
+    """One executed inner step of a device-resident megastep while_loop —
+    ticked INSIDE the loop body (early exits leave the untaken iterations
+    uncounted, exactly like the host's committed-iteration mirror)."""
+    return telem.at[IDX_MEGA_ITERS].add(1)
 
 
 def bump_kind(telem, kind_id: int):

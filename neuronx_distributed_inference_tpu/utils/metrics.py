@@ -498,11 +498,15 @@ class ServingTelemetry:
                     accept_mean: Optional[float] = None,
                     request_id: Optional[int] = None,
                     in_flight: Optional[int] = None,
-                    ici_bytes: Optional[int] = None) -> None:
+                    ici_bytes: Optional[int] = None,
+                    extra: Optional[Dict[str, object]] = None) -> None:
         """Record one dispatch of the serving loop (kinds: ``decode``,
-        ``spec_chunk``, ``mixed``, ``insert_window``, ``insert``). Durations
-        are host spans over dispatch + host commit; device overlap shows up
-        through the paired ``annotate()`` spans in a jax.profiler trace."""
+        ``spec_chunk``, ``mixed``, ``insert_window``, ``insert``,
+        ``megastep``). Durations are host spans over dispatch + host commit;
+        device overlap shows up through the paired ``annotate()`` spans in a
+        jax.profiler trace. ``extra`` merges caller-specific fields into the
+        record (megastep exit reason, scheduler fall-through reason) without
+        widening this signature per kind."""
         if t0 is None or not self.enabled:
             return
         now = time.perf_counter()
@@ -511,6 +515,8 @@ class ServingTelemetry:
                "occupancy": occupancy, "slots": slots,
                "prefill_tokens": prefill_tokens,
                "prefill_budget": prefill_budget}
+        if extra:
+            rec.update(extra)
         if kv_total is not None:
             rec["kv_blocks_free"] = kv_free
             rec["kv_blocks_total"] = kv_total

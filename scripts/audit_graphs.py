@@ -40,7 +40,14 @@ from neuronx_distributed_inference_tpu.analysis import lint  # noqa: E402
 # builds the eagle3 scope's draft.
 _FILE_SCOPES = {
     "runtime/continuous_batching.py": ["cb_dense", "cb_paged", "cb_mixed",
-                                       "cb_spec", "cb_eagle", "serving_tier"],
+                                       "cb_megastep", "cb_spec", "cb_eagle",
+                                       "serving_tier"],
+    # ISSUE-10 megastep: the token ring is traced only into the while_loop
+    # megastep dispatch; an edit re-audits that scope. block_kvcache's
+    # device_slot_advance ALSO feeds the megastep, but block_kvcache stays
+    # deliberately unmapped (its write/read helpers trace into every paged
+    # dispatch — unmapped fails closed to the full fleet).
+    "ops/token_ring.py": ["cb_megastep"],
     "runtime/speculation.py": ["spec", "cb_spec", "cb_eagle", "eagle",
                                "eagle3", "medusa"],
     "runtime/eagle.py": ["eagle", "cb_eagle", "eagle3"],
@@ -54,7 +61,8 @@ _FILE_SCOPES = {
     # (metrics/flight_recorder/slo) never enter a graph — lint-only ([]
     # audits nothing, which is exactly their graph footprint).
     "utils/device_telemetry.py": ["cb_dense", "cb_paged", "cb_mixed",
-                                  "cb_spec", "cb_eagle", "serving_tier"],
+                                  "cb_megastep", "cb_spec", "cb_eagle",
+                                  "serving_tier"],
     "utils/metrics.py": [],
     "utils/flight_recorder.py": [],
     "utils/slo.py": [],
@@ -69,7 +77,7 @@ _FILE_SCOPES = {
     "serving/engine.py": [],
     "serving/router.py": [],
     "serving/kv_tiering.py": ["serving_tier", "cb_paged", "cb_mixed",
-                              "cb_spec", "cb_eagle"],
+                              "cb_megastep", "cb_spec", "cb_eagle"],
 }
 # any other package .py change (application.py, models/modules/ops/parallel/
 # analysis/config/utils/new files) re-runs the whole fleet — see

@@ -37,4 +37,17 @@ if [ "${SERVING_TIER1_TESTS:-0}" -lt 1 ]; then
     echo "ERROR: scale-out serving tests are not in the tier-1 marker set" >&2
     [ "$rc" -eq 0 ] && rc=1
 fi
+
+# ISSUE-10 unchanged-semantics guard: the device-resident megastep exactness
+# matrix (tests/test_megastep.py) must stay collected inside the tier-1
+# marker set — same rationale as the serving guard above.
+MEGASTEP_TIER1_TESTS=$(env JAX_PLATFORMS=cpu python -m pytest \
+    "$REPO/tests/test_megastep.py" \
+    -q -m 'not slow' --collect-only -p no:cacheprovider 2>/dev/null \
+    | grep -ac '::' || true)
+echo "MEGASTEP_TIER1_TESTS=$MEGASTEP_TIER1_TESTS"
+if [ "${MEGASTEP_TIER1_TESTS:-0}" -lt 1 ]; then
+    echo "ERROR: megastep exactness tests are not in the tier-1 marker set" >&2
+    [ "$rc" -eq 0 ] && rc=1
+fi
 exit "$rc"

@@ -56,6 +56,12 @@ def build_runner(mode: str):
                                           prefill_token_budget=32,
                                           mixed_decode_steps=2,
                                           telemetry=True)
+    elif mode == "megastep":
+        # ISSUE-10 device-resident while_loop serving: the attribution's
+        # megastep row decomposes the once-per-K-tokens dispatch floor
+        app = _tiny_app(paged=True, cb=True)
+        runner = ContinuousBatchingRunner(app, decode_chunk=4, megastep_k=8,
+                                          telemetry=True)
     else:
         app = _tiny_app(paged=True, cb=True)
         runner = ContinuousBatchingRunner(app, decode_chunk=4, telemetry=True)
@@ -108,7 +114,7 @@ def profile_replicas(n, max_new, logdir, plane):
 
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--mode", choices=("plain", "mixed", "spec"),
+    ap.add_argument("--mode", choices=("plain", "mixed", "spec", "megastep"),
                     default="plain")
     ap.add_argument("--replicas", type=int, default=1,
                     help="profile N engine replicas (serving/engine.py), one "

@@ -352,12 +352,17 @@ def test_changed_mode_scope_map_fails_closed():
     # a doc/test-only change audits nothing
     assert mod._scopes_for_changes(["docs/STATIC_ANALYSIS.md"]) == []
     # ISSUE-7: the in-graph telemetry carry is threaded through EVERY CB
-    # dispatch kind (ISSUE-9 added the tier-readmit scatter to that set), so
-    # a carry edit re-audits the full CB fleet...
+    # dispatch kind (ISSUE-9 added the tier-readmit scatter, ISSUE-10 the
+    # while_loop megastep), so a carry edit re-audits the full CB fleet...
     assert set(mod._scopes_for_changes(
         [pkg + "utils/device_telemetry.py"])) == {
-        "cb_dense", "cb_paged", "cb_mixed", "cb_spec", "cb_eagle",
-        "serving_tier"}
+        "cb_dense", "cb_paged", "cb_mixed", "cb_megastep", "cb_spec",
+        "cb_eagle", "serving_tier"}
+    # ISSUE-10: the token ring is traced only into the megastep dispatch;
+    # any OTHER new ops module still fails closed to the full fleet
+    assert mod._scopes_for_changes([pkg + "ops/token_ring.py"]) == [
+        "cb_megastep"]
+    assert mod._scopes_for_changes([pkg + "ops/ring_buffer2.py"]) is None
     # ...while the host-side observability modules never enter a graph
     # (lint-only), and an UNMAPPED utils module still fails closed
     assert mod._scopes_for_changes([pkg + "utils/flight_recorder.py"]) == []
@@ -371,7 +376,8 @@ def test_changed_mode_scope_map_fails_closed():
     assert mod._scopes_for_changes([pkg + "serving/router.py"]) == []
     assert mod._scopes_for_changes([pkg + "serving/engine.py"]) == []
     assert set(mod._scopes_for_changes([pkg + "serving/kv_tiering.py"])) == {
-        "serving_tier", "cb_paged", "cb_mixed", "cb_spec", "cb_eagle"}
+        "serving_tier", "cb_paged", "cb_mixed", "cb_megastep", "cb_spec",
+        "cb_eagle"}
     assert mod._scopes_for_changes(
         [pkg + "serving/prefill_pool.py"]) is None
     assert "serving_tier" in set(mod._scopes_for_changes(

@@ -1,0 +1,278 @@
+"""Device-resident serving megasteps (ISSUE-10 / ROADMAP open item 2): the
+``lax.while_loop`` serving loop must produce BIT-IDENTICAL tokens to the
+step-wise path across the whole exactness matrix — K in {1, 4, 16} x
+async_depth in {1, 2}, including mid-loop eos, in-loop block consumption up
+to the host-pre-reserved budget followed by a ``blocks`` early-exit, the
+emitted-ring wrap service exit, the pending-arrival service flag, and
+spec-chunk / mixed-step composition through the ONE guarded fall-through —
+while the device telemetry carry's per-inner-step counters keep matching the
+host's event-log recompute exactly at every pipeline flush.
+"""
+
+import numpy as np
+import pytest
+
+from neuronx_distributed_inference_tpu.config import (
+    OnDeviceSamplingConfig, TpuConfig, load_pretrained_config)
+from neuronx_distributed_inference_tpu.models.llama.modeling_llama import (
+    LlamaForCausalLM, LlamaInferenceConfig)
+from neuronx_distributed_inference_tpu.runtime.continuous_batching import (
+    ContinuousBatchingRunner)
+
+
+def _make_app(hf_cfg, paged=True, slots=2, blocks=48, seq_len=96,
+              sampling=None):
+    tpu_cfg = TpuConfig(
+        batch_size=slots, seq_len=seq_len, max_context_length=32,
+        dtype="float32", context_encoding_buckets=[16, 32],
+        token_generation_buckets=[48, 96],
+        is_continuous_batching=True, paged_attention_enabled=paged,
+        pa_num_blocks=blocks, pa_block_size=8,
+        on_device_sampling_config=sampling,
+    )
+    config = LlamaInferenceConfig(tpu_cfg,
+                                  load_config=load_pretrained_config(hf_cfg))
+    app = LlamaForCausalLM(None, config)
+    app.load_random(seed=0)
+    return app
+
+
+@pytest.fixture(scope="module")
+def app(tiny_llama_hf_config):
+    return _make_app(tiny_llama_hf_config)
+
+
+@pytest.fixture(scope="module")
+def prompts():
+    rng = np.random.default_rng(7)
+    return [rng.integers(1, 256, size=(n,)).astype(np.int32) for n in (12, 19)]
+
+
+@pytest.fixture(scope="module")
+def base_tokens(app, prompts):
+    """Reference greedy tokens from the STEP-WISE (scan-chunk) path."""
+    runner = ContinuousBatchingRunner(app, decode_chunk=4)
+    rids = [runner.submit(p, max_new_tokens=12) for p in prompts]
+    res = runner.run_to_completion()
+    return [res[r] for r in rids]
+
+
+def _device_matches_host(runner):
+    """The flush-time identities the telemetry carry guarantees, plus the
+    megastep-specific one: drained ``megastep_iters`` == the host's
+    committed-inner-step counter == stats()["megastep"]["inner_steps"]."""
+    assert not runner._inflight, "pipeline must be flushed for exactness"
+    s = runner.stats()
+    d = s["device"]
+    tokens = sum(e["tokens"] for e in runner.telemetry.events
+                 if e["event"] == "commit")
+    assert d["tokens_total"] == s["tokens_emitted"] == tokens
+    kinds = {}
+    for rec in runner.telemetry.steps:
+        kinds[rec["kind"]] = kinds.get(rec["kind"], 0) + 1
+    assert d["steps"] == kinds, (d["steps"], kinds)
+    if runner.megastep_k is not None:
+        m = s["megastep"]
+        assert d["megastep_iters"] == m["inner_steps"]
+        assert d["steps"].get("megastep", 0) == m["dispatches"]
+        assert sum(m["exits"].values()) == m["dispatches"]
+    return s, d
+
+
+@pytest.mark.parametrize("k", [1, 4, 16])
+@pytest.mark.parametrize("depth", [1, 2])
+def test_megastep_matrix_exactness(app, prompts, base_tokens, k, depth):
+    """K x async_depth matrix: bit-identical tokens, exact counters, and the
+    megastep actually carried the decode work (no silent step-wise run)."""
+    runner = ContinuousBatchingRunner(app, decode_chunk=4, megastep_k=k,
+                                      async_mode=True, async_depth=depth,
+                                      telemetry=True)
+    rids = [runner.submit(p, max_new_tokens=12) for p in prompts]
+    res = runner.run_to_completion()
+    assert [res[r] for r in rids] == base_tokens, f"K={k} depth={depth}"
+    s, d = _device_matches_host(runner)
+    assert d["steps"].get("megastep", 0) > 0
+    assert d["steps"].get("decode", 0) == 0   # nothing fell back to the scan
+
+
+def test_megastep_sync_exactness(app, prompts, base_tokens):
+    runner = ContinuousBatchingRunner(app, decode_chunk=4, megastep_k=8,
+                                      telemetry=True)
+    rids = [runner.submit(p, max_new_tokens=12) for p in prompts]
+    res = runner.run_to_completion()
+    assert [res[r] for r in rids] == base_tokens
+    _device_matches_host(runner)
+
+
+def test_megastep_mid_loop_eos(app, prompts, base_tokens):
+    """A row emitting its eos mid-loop freezes in-graph and the megastep
+    early-exits ``stopped`` once every row froze — same tokens as the
+    step-wise eos replay, device eos counter exact."""
+    eos = int(base_tokens[0][5])
+    runner = ContinuousBatchingRunner(app, decode_chunk=4, megastep_k=16,
+                                      telemetry=True)
+    rid = runner.submit(prompts[0], max_new_tokens=12, eos_token_id=eos)
+    out = runner.run_to_completion()[rid]
+    assert out == base_tokens[0][:6]
+    s, d = _device_matches_host(runner)
+    assert d["eos"] == 1
+    assert s["megastep"]["exits"].get("stopped", 0) >= 1
+
+
+def test_megastep_ring_wrap_service(app, prompts, base_tokens):
+    """megastep_ring < megastep_k: each dispatch runs at most ring inner
+    steps, exits ``ring``, the host drains (services) the ring, and the next
+    dispatch continues — tokens stay bit-identical."""
+    runner = ContinuousBatchingRunner(app, decode_chunk=4, megastep_k=16,
+                                      megastep_ring=4, telemetry=True)
+    rids = [runner.submit(p, max_new_tokens=12) for p in prompts]
+    res = runner.run_to_completion()
+    assert [res[r] for r in rids] == base_tokens
+    s, _ = _device_matches_host(runner)
+    assert s["megastep"]["exits"].get("ring", 0) >= 1
+    for rec in runner.telemetry.steps:
+        if rec["kind"] == "megastep":
+            assert rec["iterations"] <= 4
+
+
+def test_megastep_block_budget_early_exit(tiny_llama_hf_config, prompts):
+    """In-loop block consumption up to the host-pre-reserved budget: with the
+    free list drained to one spare block, the megastep reserves what it can,
+    early-exits ``blocks`` at the coverage edge, and continues next dispatch
+    once blocks free up — tokens identical to the unconstrained run, and the
+    zero-progress preemption path never fires."""
+    app = _make_app(tiny_llama_hf_config)
+    max_new = 40
+    ref = ContinuousBatchingRunner(app, decode_chunk=4)
+    ref_ids = [ref.submit(p, max_new_tokens=max_new) for p in prompts]
+    ref_out = ref.run_to_completion()
+    runner = ContinuousBatchingRunner(app, decode_chunk=4, megastep_k=16,
+                                      telemetry=True)
+    rids = [runner.submit(p, max_new_tokens=max_new) for p in prompts]
+    runner.step()                   # place both prompts + first full megastep
+    # squeeze the free list down to ONE spare block (a filler "prompt" holds
+    # the rest) so the next best-effort reservation comes up short of K
+    bs = runner.block_size
+    n_hold = runner.allocator.num_free - 1
+    assert n_hold > 0
+    filler = np.arange(1000, 1000 + n_hold * bs - 1).astype(np.int32) % 251
+    held, _ = runner.allocator.allocate_for_prompt(filler)
+    assert runner.allocator.num_free == 1
+    runner.step()                   # partial coverage -> in-graph blocks exit
+    s = runner.stats()
+    assert s["megastep"]["exits"].get("blocks", 0) >= 1, s["megastep"]
+    runner.allocator.free_sequence(held)     # release pressure; continue
+    res = runner.run_to_completion()
+    assert [res[r] for r in rids] == [ref_out[r] for r in ref_ids]
+    _device_matches_host(runner)
+    assert runner.num_preemptions == 0
+
+
+def test_megastep_arrival_flag_early_exit(app, prompts, base_tokens):
+    """Queued work that cannot place sets the in-graph service flag: the
+    megastep yields after ONE inner step (insert latency bounded by the
+    service condition, not by K) and the queued request's tokens still land
+    bit-identically."""
+    long_new = 12
+    # reference: step-wise serving of 3 requests through 2 slots
+    ref = ContinuousBatchingRunner(app, decode_chunk=4)
+    ref_ids = [ref.submit(p, max_new_tokens=long_new)
+               for p in [*prompts, prompts[0]]]
+    ref_out = ref.run_to_completion()
+    runner = ContinuousBatchingRunner(app, decode_chunk=4, megastep_k=16,
+                                      telemetry=True)
+    rids = [runner.submit(p, max_new_tokens=long_new)
+            for p in [*prompts, prompts[0]]]
+    res = runner.run_to_completion()
+    assert [res[r] for r in rids] == [ref_out[r] for r in ref_ids]
+    s, _ = _device_matches_host(runner)
+    assert s["megastep"]["exits"].get("arrival", 0) >= 1
+
+
+def test_megastep_sampled_exactness_aligned(tiny_llama_hf_config, prompts):
+    """Sampled serving: with the megastep's inner-step count aligned to the
+    step-wise chunk (K == ring == decode_chunk, no early exit in the
+    window), the in-graph key schedule is identical and sampled tokens stay
+    BIT-exact — the strongest available sampled-path equivalence (unaligned
+    groupings legitimately consume different keys)."""
+    sampling = OnDeviceSamplingConfig(do_sample=True, top_k=8,
+                                      temperature=0.8)
+    app = _make_app(tiny_llama_hf_config, sampling=sampling)
+    ref = ContinuousBatchingRunner(app, decode_chunk=8)
+    rids = [ref.submit(p, max_new_tokens=16) for p in prompts]
+    ref_out = ref.run_to_completion()
+    runner = ContinuousBatchingRunner(app, decode_chunk=8, megastep_k=8,
+                                      telemetry=True)
+    rids2 = [runner.submit(p, max_new_tokens=16) for p in prompts]
+    res = runner.run_to_completion()
+    assert [res[r] for r in rids2] == [ref_out[r] for r in rids]
+    _device_matches_host(runner)
+
+
+def test_megastep_spec_composition(tiny_llama_hf_config, app, prompts):
+    """Spec serving + megastep: the near-boundary plain fall-through runs
+    device megasteps (visible in the fall-through counter and the device
+    step counts), tokens identical to the same spec config without it."""
+    draft_hf = dict(tiny_llama_hf_config, hidden_size=32,
+                    intermediate_size=64, num_hidden_layers=1,
+                    num_attention_heads=2, num_key_value_heads=2)
+    draft = _make_app(draft_hf)
+    max_new = 84                      # drives the row into the seq_len-K band
+    ref = ContinuousBatchingRunner(app, draft=draft, speculation_length=4,
+                                   spec_chunk=2)
+    rid = ref.submit(prompts[0], max_new_tokens=max_new)
+    ref_out = ref.run_to_completion()[rid]
+    runner = ContinuousBatchingRunner(app, draft=draft, speculation_length=4,
+                                      spec_chunk=2, megastep_k=4,
+                                      telemetry=True)
+    rid2 = runner.submit(prompts[0], max_new_tokens=max_new)
+    out = runner.run_to_completion()[rid2]
+    assert out == ref_out
+    s, d = _device_matches_host(runner)
+    assert d["steps"].get("spec_chunk", 0) > 0
+    assert d["steps"].get("megastep", 0) > 0
+    ft = runner.telemetry.registry.get(
+        "serving_fallthrough_total",
+        labels={"from": "spec", "reason": "seq_room"})
+    assert ft is not None and ft.value > 0
+
+
+def test_megastep_mixed_fall_through_recorded(tiny_llama_hf_config, prompts):
+    """Mixed scheduler + megastep: the ONE guarded fall-through runs the
+    megastep, counts the reason, and stamps it on the very next megastep
+    step-timeline record — a degraded mixed run is visible, never silent."""
+    app = _make_app(tiny_llama_hf_config)
+    ref = ContinuousBatchingRunner(app, decode_chunk=4, prefill_chunk=16,
+                                   prefill_token_budget=32,
+                                   mixed_decode_steps=2)
+    rids = [ref.submit(p, max_new_tokens=8) for p in prompts]
+    ref_out = ref.run_to_completion()
+    runner = ContinuousBatchingRunner(app, decode_chunk=4, prefill_chunk=16,
+                                      prefill_token_budget=32,
+                                      mixed_decode_steps=2, megastep_k=4,
+                                      telemetry=True)
+    rids2 = [runner.submit(p, max_new_tokens=8) for p in prompts]
+    res = runner.run_to_completion()
+    assert [res[r] for r in rids2] == [ref_out[r] for r in rids]
+    s, d = _device_matches_host(runner)
+    assert d["steps"].get("mixed", 0) > 0
+    assert d["steps"].get("megastep", 0) > 0
+    stamped = [rec for rec in runner.telemetry.steps
+               if rec["kind"] == "megastep" and "fall_through" in rec]
+    assert stamped and stamped[0]["fall_through"].startswith("mixed:")
+    c = runner.telemetry.registry.get(
+        "serving_fallthrough_total",
+        labels={"from": "mixed", "reason": "no_insert_in_flight"})
+    assert c is not None and c.value > 0
+
+
+def test_megastep_validation(tiny_llama_hf_config, app):
+    dense = _make_app(tiny_llama_hf_config, paged=False)
+    with pytest.raises(ValueError, match="paged"):
+        ContinuousBatchingRunner(dense, megastep_k=4)
+    with pytest.raises(ValueError, match="megastep_k must be"):
+        ContinuousBatchingRunner(app, megastep_k=0)
+    with pytest.raises(ValueError, match="megastep_ring must be"):
+        ContinuousBatchingRunner(app, megastep_k=4, megastep_ring=0)
+    with pytest.raises(ValueError, match="megastep_ring requires"):
+        ContinuousBatchingRunner(app, megastep_ring=4)

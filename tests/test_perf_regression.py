@@ -172,6 +172,21 @@ def test_mixed_chunk_gather_fallback_grows_with_table():
                   "mixed_gather_grows_with_table")
 
 
+def test_megastep_one_executable_bytes_k_invariant():
+    """The ISSUE-10 canary: the device-resident serving megastep is ONE
+    executable whose compiled HBM traffic is ~K-invariant — weights and KV
+    pools are passed (and charged) ONCE however many inner steps the
+    lax.while_loop runs. The inner-step count is a DYNAMIC operand (no
+    executable sweep across seq-room clamps at all); the only K-shaped
+    static is the emitted-token ring capacity, and a 4x ring sweep must move
+    compiled bytes by <2% (measured: identical). The absolute rule bounds
+    the whole dispatch at 16x one weights+pool pass — the tripwire against
+    an extra O(pool) copy sneaking into the loop body. (Wrapper:
+    ``megastep`` canary group.)"""
+    _assert_rules(_group_report("megastep"),
+                  "megastep_bytes_k_invariant", "megastep_one_weights_pass")
+
+
 def test_tp_decode_collective_schedule_pinned():
     """The PR-5 multichip canary: the tp>1 decode step's collective schedule
     is pinned per layer and its ICI bytes are table/batch-shape-invariant.
