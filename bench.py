@@ -626,6 +626,21 @@ def main() -> None:
             except Exception as e:
                 _note(f"router phase failed: {e}")
 
+        if paged_app is not None and _remaining() > 200:
+            # ISSUE-11 fault-schedule phase: the router trace re-run under
+            # injected hard replica death + host-tier corruption, against a
+            # fault-free control of the SAME trace. Publishes goodput under
+            # faults, recovery latency, zero-loss, and a bit-exactness
+            # marker; REFUSES (faults_invalid) if no fault actually fired.
+            _note("phase: fault-schedule serving (injected replica death + "
+                  "corruption vs fault-free control)")
+            try:
+                extra.update(_router_fault_serving(
+                    paged_app, paged_app.tpu_config.max_batch_size,
+                    extra.get("paged_serving_tok_per_s")))
+            except Exception as e:
+                _note(f"fault phase failed: {e}")
+
     # FINAL EMIT: same schema, enriched extra. The driver parses the last JSON
     # line; if the process was killed earlier, the early emit already landed.
     print(json.dumps(result), flush=True)
@@ -1309,6 +1324,128 @@ def _router_arrival_serving(app, batch, closed_loop_tok_s, n_replicas=2):
         out["prefix_affinity_hit_ratio"] = runs["affinity"]["hit_ratio"]
         out["prefix_random_hit_ratio"] = runs["random"]["hit_ratio"]
         out["router_affinity_spills"] = runs["affinity"]["spills"]
+    return out
+
+
+def _router_fault_serving(app, batch, closed_loop_tok_s, n_replicas=2):
+    """ISSUE-11 fault-schedule phase: the PR 8 router trace re-run under
+    injected faults — hard death of replica "0" mid-trace plus one host-tier
+    entry corruption — with the supervisor auto-recovering, against a
+    fault-free CONTROL of the same trace. Publishes:
+
+    - ``goodput_under_faults_ratio``: fault-run tok/s over the control's
+      (the cost of losing a replica and recovering its streams);
+    - ``recovery_time_ms_p50/p99`` over recover_replica invocations;
+    - ``requests_lost_total`` (MUST be 0 — the zero-loss guarantee);
+    - ``fault_streams_bit_exact``: every greedy trace stream compared
+      token-for-token against the fault-free control.
+
+    HONESTY GUARD (r5 pattern): if no fault actually fired — a mis-aimed
+    schedule, a refactored seam — the keys are REFUSED and
+    ``faults_invalid`` says why; a fault-tolerance number measured on a
+    fault-free run is vacuous."""
+    import gc
+
+    from neuronx_distributed_inference_tpu.runtime.continuous_batching import (
+        ContinuousBatchingRunner)
+    from neuronx_distributed_inference_tpu.serving import (EngineReplica,
+                                                           FaultInjector,
+                                                           HostKVTier,
+                                                           PrefixAffinityRouter)
+
+    cfg = app.tpu_config
+    slots = max(2, batch // (2 * n_replicas))
+    n_req = 4 * n_replicas
+    prompt_len = max(2 * cfg.pa_block_size, min(256, cfg.seq_len // 4))
+    prefix_len = max(cfg.pa_block_size,
+                     (prompt_len // 2 // cfg.pa_block_size)
+                     * cfg.pa_block_size)
+    max_new = min(192, cfg.seq_len - prompt_len - 8)
+    if max_new < 4:
+        raise ValueError(f"seq_len {cfg.seq_len} too small for the fault "
+                         f"phase")
+    rate = 0.5 * (closed_loop_tok_s or 2000.0) / max_new
+    rng = np.random.default_rng(23)
+    arrivals = np.cumsum(rng.exponential(1.0 / rate, size=n_req))
+    prefixes = [rng.integers(1, 100000, size=(prefix_len,)).astype(np.int32)
+                for _ in range(2)]
+    prompts = [np.concatenate([
+        prefixes[i % 2],
+        rng.integers(1, 100000,
+                     size=(prompt_len - prefix_len,)).astype(np.int32)])
+        for i in range(n_req)]
+
+    def build(injector):
+        tier = HostKVTier(capacity_blocks=4 * slots)
+        reps = [EngineReplica(
+            str(i), lambda tel, t=tier: ContinuousBatchingRunner(
+                app, decode_chunk=32, telemetry=tel, kv_tier=t))
+            for i in range(n_replicas)]
+        return PrefixAffinityRouter(reps, fault_injector=injector,
+                                    auto_recover=True), reps, tier
+
+    runs = {}
+    for leg in ("control", "faults"):
+        inj = (None if leg == "control" else FaultInjector(
+            "death@0:at_step=3;corrupt@1:every_n=1,once=1", seed=11))
+        router, reps, tier = build(inj)
+        # seed the host tier BEFORE the trace so the corruption has bytes to
+        # hit mid-run: serve both shared prefixes once and spill them
+        for pre in prefixes:
+            router.submit(np.concatenate([
+                pre, rng.integers(1, 100000, size=(4,)).astype(np.int32)]),
+                max_new_tokens=4)
+        router.run_to_completion()
+        for rep in reps:
+            rep.runner.spill_idle_blocks()
+        n_seed = len(router.requests)
+        wall, _samples = _drive_router_open_loop(router, prompts, arrivals,
+                                                 max_new)
+        s = router.stats()
+        runs[leg] = {
+            "tok_per_s": s["tokens"] / wall,
+            "streams": {i - n_seed: list(router.requests[i].generated)
+                        for i in router.requests if i >= n_seed},
+            "lost": s["requests"] - s["finished"],
+            "recovery_ms": list(router.recovery_times_ms),
+            "fired": inj.fired_total if inj is not None else 0,
+            "integrity_failures": tier.integrity_failures,
+            "failed_replicas": [r for r, st in s["replica_state"].items()
+                                if st == "failed"],
+        }
+        for rep in reps:
+            if runs[leg]["failed_replicas"] and \
+                    rep.replica_id in runs[leg]["failed_replicas"]:
+                continue                    # a dead runner cannot drain
+            _drain_runner(rep.runner)
+        del router, reps
+        gc.collect()
+
+    f, c = runs["faults"], runs["control"]
+    out = {"fault_replicas": n_replicas,
+           "faults_injected_total": f["fired"],
+           "fault_control_tok_per_s": round(c["tok_per_s"], 1)}
+    if f["fired"] == 0 or not f["failed_replicas"]:
+        out["faults_invalid"] = (
+            "no fault fired (or no replica failed) during the fault leg — "
+            "fault-tolerance numbers over a fault-free run are vacuous")
+        _note(f"fault phase INVALID: {out['faults_invalid']}")
+        return out
+    exact = all(f["streams"][i] == c["streams"][i]
+                for i in range(len(prompts)))
+    out.update({
+        "goodput_under_faults_ratio": round(
+            f["tok_per_s"] / max(c["tok_per_s"], 1e-9), 3),
+        "recovery_time_ms_p50": round(_p_ms(
+            [t / 1e3 for t in f["recovery_ms"]], "latency_ms_p50"), 3),
+        "recovery_time_ms_p99": round(_p_ms(
+            [t / 1e3 for t in f["recovery_ms"]], "latency_ms_p99"), 3),
+        "requests_lost_total": f["lost"],
+        "fault_streams_bit_exact": exact,
+        "kv_tier_integrity_failures_total": f["integrity_failures"],
+    })
+    if f["lost"] or not exact:
+        _note(f"FAULT PHASE REGRESSION: lost={f['lost']} bit_exact={exact}")
     return out
 
 

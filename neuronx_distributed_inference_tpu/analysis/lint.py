@@ -38,6 +38,15 @@ review keeps missing:
                     is the designed pattern). The in-graph device carry
                     (utils/device_telemetry.py) is the sanctioned way to
                     count inside a graph.
+``silent-except``   an ``except`` handler in ``serving/``/``runtime/`` that
+                    SWALLOWS the failure: no re-raise, no logged reason, no
+                    metrics counter anywhere in its body. Serving code
+                    treats partial failure as the steady state — a
+                    swallowed exception is a recovery path that silently
+                    stopped recovering (the pre-ISSUE-11 fleet died of
+                    exactly one of these reaching the frontend). Degrade
+                    VISIBLY (log / count / re-raise) or waive with a
+                    reason.
 
 Waive a line with ``# lint: ok(<rule>)`` or ``# lint: ok(<rule>): reason``
 (``# debug-ok`` keeps working for ``stray-print``). Waived findings are
@@ -57,7 +66,8 @@ __all__ = ["LintFinding", "lint_package", "lint_paths", "lint_source",
            "RULES", "PKG_ROOT"]
 
 RULES = ("stray-print", "raw-jit", "jit-no-donate", "tracer-branch",
-         "time-in-jit", "step-loop-sync", "telemetry-in-jit")
+         "time-in-jit", "step-loop-sync", "telemetry-in-jit",
+         "silent-except")
 
 PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -186,6 +196,7 @@ class _ModuleLint:
     # ---- rules -----------------------------------------------------------
     def run(self) -> List[LintFinding]:
         self._rule_print()
+        self._rule_silent_except()
         jit_calls = [n for n in ast.walk(self.tree)
                      if isinstance(n, ast.Call)
                      and (_dotted(n.func) in self.raw_jit_names
@@ -224,6 +235,47 @@ class _ModuleLint:
                 self.emit("stray-print", n,
                           "bare print( in library code — log through the "
                           "tpu-inference logger or record telemetry")
+
+    # visibility markers that make an except handler non-silent: the failure
+    # is re-raised, logged, or counted — anything else is a swallow
+    def _except_visible(self, handler: ast.ExceptHandler) -> bool:
+        for n in ast.walk(handler):
+            if isinstance(n, ast.Raise):
+                return True
+            if not isinstance(n, ast.Call):
+                continue
+            parts = _dotted(n.func).split(".")
+            if not parts:
+                continue
+            if parts[0] in ("logger", "logging", "warnings"):
+                return True
+            attr, owner = parts[-1], parts[:-1]
+            if attr in ("inc", "observe"):
+                return True              # metrics counter/histogram mutation
+            if attr == "set" and any(self._INSTRUMENT_RE.match(p)
+                                     for p in owner):
+                return True
+        return False
+
+    def _rule_silent_except(self) -> None:
+        """Serving/runtime invariant (ISSUE-11): partial failure is the
+        steady state, so every except handler must degrade VISIBLY. A
+        handler with no re-raise, no log line, and no metrics mutation
+        swallowed a failure the fleet will never hear about."""
+        if not self.rel.startswith(("runtime/", "serving/")):
+            return
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Try):
+                continue
+            for h in node.handlers:
+                if self._except_visible(h):
+                    continue
+                what = ("bare except" if h.type is None
+                        else f"except {ast.unparse(h.type)}")
+                self.emit("silent-except", h,
+                          f"{what} swallows the failure — no re-raise, "
+                          f"logged reason, or metrics counter in the "
+                          f"handler; degrade visibly or waive with a reason")
 
     def _resolve_target(self, call: ast.Call) -> Optional[ast.FunctionDef]:
         if not (call.args and isinstance(call.args[0], ast.Name)):

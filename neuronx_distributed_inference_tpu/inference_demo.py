@@ -17,6 +17,7 @@ from __future__ import annotations
 import argparse
 import json
 import logging
+import os
 import sys
 from typing import List, Optional
 
@@ -152,6 +153,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "on prefix hits")
     g.add_argument("--kv-tier-blocks", type=int, default=1024, metavar="N",
                    help="host-RAM tier capacity in KV blocks (default 1024)")
+    g.add_argument("--inject-faults", default=None, metavar="SPEC",
+                   help="with --serve --replicas N: drive the routed fleet "
+                        "through the deterministic fault injector "
+                        "(serving/faults.py) — semicolon-separated "
+                        "'kind[@replica][:at_step=N|every_n=N,...]' entries, "
+                        "e.g. 'death@0:at_step=4;corrupt@1:every_n=1,once=1'. "
+                        "Kinds: exception, stall, death, alloc, corrupt, "
+                        "truncate. The router supervises: failures retry "
+                        "with backoff, dead replicas FAIL and their streams "
+                        "auto-recover onto survivors")
     g.add_argument("--serve", action="store_true",
                    help="drive the prompts through the continuous-batching "
                         "runner (slot-based serving; honors --paged-attention "
@@ -607,6 +618,11 @@ def _run_serving(args, app, tokenizer) -> None:
 
     if args.replicas > 1 or args.kv_host_tier:
         return _run_serving_routed(args, app, tokenizer)
+    if args.inject_faults:
+        raise SystemExit("--inject-faults requires the routed serving path "
+                         "(--replicas N and/or --kv-host-tier): faults are "
+                         "injected at the replica seams the router "
+                         "supervises")
     kw = {}
     if args.async_depth is not None:
         kw["async_depth"] = args.async_depth
@@ -742,10 +758,19 @@ def _run_serving_routed(args, app, tokenizer) -> None:
                       jsonl_path=(f"{args.events_out}.replica{i}"
                                   if args.events_out else None))
         for i in range(args.replicas)]
-    router = PrefixAffinityRouter(replicas)
-    logger.info("routed serving: %d replicas, kv host tier: %s",
+    injector = None
+    if args.inject_faults:
+        from .serving.faults import FaultInjector
+
+        injector = FaultInjector(args.inject_faults)
+    router = PrefixAffinityRouter(
+        replicas, fault_injector=injector, auto_recover=True,
+        debug_bundle_dir=(os.path.dirname(args.debug_bundle) or "."
+                          if args.debug_bundle else None))
+    logger.info("routed serving: %d replicas, kv host tier: %s, faults: %s",
                 args.replicas,
-                f"{args.kv_tier_blocks} blocks" if tier else "off")
+                f"{args.kv_tier_blocks} blocks" if tier else "off",
+                args.inject_faults or "off")
 
     slo_monitors = []
     if args.slo:
@@ -824,6 +849,12 @@ def _run_serving_routed(args, app, tokenizer) -> None:
                 "affinity_hits=%d, spills=%d, migrations=%d",
                 s["finished"], s["tokens"], s["affinity_hits"],
                 s["affinity_spills"], s["migrations"])
+    if injector is not None or s["failures"]:
+        logger.info("fault-tolerance summary: faults_injected=%d, "
+                    "failures=%d, recoveries=%d, recovered_requests=%d, "
+                    "replica_state=%s",
+                    s["faults_injected"], s["failures"], s["recoveries"],
+                    s["recovered_requests"], s["replica_state"])
     if args.metrics_out:
         # ONE exposition: router series + every replica's replica-labelled
         # registry (utils/metrics.py default_labels merging)

@@ -163,6 +163,16 @@ def make_chunk_slot_mapping(block_table: np.ndarray, positions: np.ndarray,
 # ---------------------------------------------------------------------------
 
 
+class KVBlocksExhausted(RuntimeError):
+    """The paged pool (free list + idle pool) cannot satisfy an allocation.
+
+    A RuntimeError subclass so every existing ``except RuntimeError`` recovery
+    path (preempting growth, allocation rollback, partial megastep
+    reservation) keeps working, while new callers — request placement, the
+    serving router's shed path — can catch exhaustion SPECIFICALLY and
+    degrade (preempt-or-shed) instead of treating it as a generic crash."""
+
+
 class BlockAllocator:
     """Free-list block allocator with optional prefix-cache reuse.
 
@@ -187,7 +197,7 @@ class BlockAllocator:
 
     def _alloc_one(self) -> int:
         if not self.free:
-            raise RuntimeError("out of KV blocks")
+            raise KVBlocksExhausted("out of KV blocks")
         blk = self.free.pop()
         self.refcount[blk] = 1
         return blk

@@ -1923,15 +1923,28 @@ class ContinuousBatchingRunner:
             self.active[slot] = req
             self.telemetry.request_placed(req.request_id, slot,
                                           resumed=bool(req.generated))
-            if self.insert_cap is not None or self.mixed:
-                # chunked-prefill scheduling: the slot is held, the prompt
-                # streams in bounded windows via _advance_inserts (insert_cap)
-                # or as chunk rows of the mixed dispatches (_step_mixed)
-                self._begin_insert(req, slot)
-                continue
-            key, sub = jax.random.split(key)
-            resumed = bool(req.generated)   # preempted earlier; KV recomputed now
-            tok0 = self._insert(req, slot, sub)
+            try:
+                if self.insert_cap is not None or self.mixed:
+                    # chunked-prefill scheduling: the slot is held, the
+                    # prompt streams in bounded windows via _advance_inserts
+                    # (insert_cap) or as chunk rows of the mixed dispatches
+                    # (_step_mixed)
+                    self._begin_insert(req, slot)
+                    continue
+                key, sub = jax.random.split(key)
+                resumed = bool(req.generated)   # preempted; KV recomputed now
+                tok0 = self._insert(req, slot, sub)
+            # lint: ok(silent-except): _unplace_on_exhaustion logs and counts serving_fallthrough_total{from=place}
+            except block_kvcache.KVBlocksExhausted:
+                # PREEMPT-OR-SHED, not a crash (ISSUE-11): the free-count
+                # precheck above can still lose to allocation (a tiered
+                # reclaim spilling mid-walk, an injected failure, prefix
+                # blocks growing under a shared pool). The request un-places
+                # back to the queue front and the NEWEST insert preempts to
+                # the resume path to open headroom; placement resumes next
+                # step (the router's shed path handles sustained pressure).
+                self._unplace_on_exhaustion(req, slot)
+                break
             req.position = fed_len
             if not resumed:
                 req.generated = [tok0]
@@ -2248,6 +2261,7 @@ class ContinuousBatchingRunner:
             if len(req.blocks) * bs < want:
                 try:
                     self.allocator.extend(req.blocks, want)
+                # lint: ok(silent-except): designed partial reservation — short coverage costs loop iterations (in-graph coverage early-exit), never correctness
                 except RuntimeError:
                     # partial reservation: take what the free list still has,
                     # one block at a time (extend() rolls back all-or-nothing)
@@ -2255,6 +2269,7 @@ class ContinuousBatchingRunner:
                         try:
                             self.allocator.extend(req.blocks,
                                                   len(req.blocks) * bs + 1)
+                        # lint: ok(silent-except): end of the best-effort walk — the megastep's coverage exit handles the shortfall
                         except RuntimeError:
                             break
             self.block_table[req.slot, : len(req.blocks)] = req.blocks
@@ -2670,6 +2685,7 @@ class ContinuousBatchingRunner:
                     self.allocator.extend(req.blocks, req.position + steps + 1)
                     self.block_table[req.slot, : len(req.blocks)] = req.blocks
                 return active_rows
+            # lint: ok(silent-except): recovery IS the handler — _preempt (logs + counts serving_preemptions_total) or truncate-finish
             except RuntimeError:
                 if len(active_rows) > 1:
                     victim = max(active_rows, key=lambda r: r.placed_seq)
@@ -2680,6 +2696,33 @@ class ContinuousBatchingRunner:
                 active_rows = [r for r in self.active if r is not None]
                 if not active_rows:
                     return []
+
+    def _unplace_on_exhaustion(self, req: Request, slot: int) -> None:
+        """Placement hit allocator exhaustion (ISSUE-11 graceful
+        degradation): undo the half-done placement (allocate_for_prompt
+        already rolled its blocks back), re-queue the request at the front,
+        and PREEMPT the newest inserting row — the resume path the
+        mechanism already has — so the next placement attempt finds
+        headroom. Counted as a visible scheduler degradation
+        (``serving_fallthrough_total{from="place",reason="kv_exhausted"}``)
+        — serving slows down under exhaustion; it never dies of it."""
+        logger.warning(
+            "placement of request %d hit KV-block exhaustion: re-queued; "
+            "preempting the newest insert for headroom", req.request_id)
+        self.active[slot] = None
+        self._slot_sp[slot] = self._default_sp_row
+        self.adapter_ids[slot] = 0
+        req.slot = -1
+        req.inserting = False
+        req.fed = None
+        req.insert_pos = 0
+        req.tok0_dev = None
+        self.queue.insert(0, req)
+        self._note_fall_through("place", "kv_exhausted")
+        inserting = [r for r in self.active
+                     if r is not None and r.inserting and not r.done]
+        if inserting:
+            self._preempt(max(inserting, key=lambda r: r.placed_seq))
 
     def _preempt(self, req: Request) -> None:
         logger.info("preempting request %d (out of KV blocks)", req.request_id)

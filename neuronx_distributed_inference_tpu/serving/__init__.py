@@ -15,6 +15,10 @@ mesh cannot be millions of users"):
 - ``kv_tiering``: :class:`HostKVTier` — a host-RAM tier for cold paged KV
   blocks (evict least-recently-attended committed blocks to host buffers,
   re-admit bit-identically on prefix hit), extending KV capacity past HBM.
+- ``faults``: :class:`FaultInjector` — deterministic, seeded fault
+  injection over the seams above (dispatch exceptions, wedged dispatches,
+  hard replica death, allocation failure, host-tier corruption), so the
+  router's supervision/recovery paths are exercised, not hoped for.
 
 Replicas are plain Python objects over independent runners, so "N replicas"
 can mean N sub-meshes on one host (the dryrun harness fakes 8 devices) or,
@@ -23,8 +27,13 @@ admission interface.
 """
 
 from .engine import EngineReplica
+from .faults import (FaultInjector, FaultSpec, InjectedFault,
+                     InjectedReplicaDeath)
 from .kv_tiering import HostKVTier
-from .router import PrefixAffinityRouter, RouterRequest
+from .router import (PrefixAffinityRouter, RouterOverloaded, RouterRequest,
+                     REPLICA_DEGRADED, REPLICA_FAILED, REPLICA_HEALTHY)
 
 __all__ = ["EngineReplica", "HostKVTier", "PrefixAffinityRouter",
-           "RouterRequest"]
+           "RouterRequest", "RouterOverloaded", "FaultInjector", "FaultSpec",
+           "InjectedFault", "InjectedReplicaDeath", "REPLICA_HEALTHY",
+           "REPLICA_DEGRADED", "REPLICA_FAILED"]

@@ -99,12 +99,18 @@ class EngineReplica:
                                 else 2 * runner.num_slots)
         self.draining = False
         if runner.paged and runner.kv_tier is not None:
+            # per-replica VIEWS of the (possibly shared) tier's state — a
+            # shared tier repeats the same value under every replica label,
+            # so these are gauges, deliberately NOT _total-named counters
+            # (sum() over replicas of a shared tier would double-count; the
+            # authoritative counter is tier.stats()["integrity_failures"],
+            # which bench publishes as kv_tier_integrity_failures_total)
             self._tier_gauges = {
                 k: self.registry.gauge(
                     f"serving_kv_tier_{k}",
                     "host-RAM KV tier state (serving/kv_tiering.py)")
                 for k in ("host_blocks", "evictions", "host_evictions",
-                          "readmit_blocks")}
+                          "readmit_blocks", "integrity_failures")}
         else:
             self._tier_gauges = None
 

@@ -1,0 +1,345 @@
+"""Deterministic fault injection for the scale-out serving stack.
+
+Production TPU serving treats partial failure as the steady state (the
+Gemma-on-TPU serving comparison, PAPERS.md): replicas wedge, dispatches
+throw, host-RAM KV bytes rot, whole engines die without a goodbye. The
+recovery machinery in serving/router.py (supervision, backoff, watchdog,
+``recover_replica``) is only trustworthy if every one of those paths is
+EXERCISED — by tests and by bench — not hoped for. This module is the
+harness: a seeded, declarative :class:`FaultInjector` that wraps the
+existing seams and fires faults on a deterministic schedule.
+
+Fault kinds (``FAULT_KINDS``):
+
+``exception``   a transient dispatch exception raised from the replica's
+                ``step()`` seam (:class:`InjectedFault`) — the retry/backoff
+                path's food.
+``stall``       a wedged dispatch: ``step()`` blocks for ``stall_ms`` before
+                proceeding — the watchdog's food.
+``death``       hard replica death: the replica raises
+                :class:`InjectedReplicaDeath` on every ``step``/``submit``/
+                ``drain`` call from the fire point on (until ``revive``) —
+                ``recover_replica``'s food.
+``alloc``       one :class:`~..modules.block_kvcache.KVBlocksExhausted`
+                raised from the replica allocator's next ``_alloc_one`` —
+                the preempt-or-shed path's food.
+``corrupt``     flip bytes in one host-KV-tier entry (checksum intact from
+                spill time, bytes now wrong) — the readmit integrity check's
+                food.
+``truncate``    shrink one host-tier entry's arrays (a torn/partial copy) —
+                same check, different failure shape.
+
+Fault-spec grammar (CLI ``--inject-faults``, one string; documented in
+docs/SERVING.md):
+
+    spec     := entry (";" entry)*
+    entry    := kind ["@" replica] [":" key "=" value ("," key "=" value)*]
+    keys     := at_step | every_n | once | stall_ms
+
+``at_step=N`` fires when the REPLICA's step counter reaches N (``once=1``
+by default); ``every_n=N`` fires on every N-th step (``once=0`` by
+default); no schedule key means ``at_step=1``. For ``corrupt``/``truncate``
+the schedule means "at or AFTER": a mutation scheduled before the host
+tier holds any bytes stays armed and fires at the first step with
+something to corrupt. ``replica`` scopes the entry to one replica id;
+omitted = every replica. Example::
+
+    --inject-faults "death@0:at_step=4;exception:every_n=7;corrupt@1:at_step=2"
+
+Determinism: the schedule is step-counted (no wall clock), and the only
+randomness — which host-tier entry a ``corrupt``/``truncate`` picks — comes
+from the injector's own seeded generator, so a fault run is replayable.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from ..modules.block_kvcache import KVBlocksExhausted
+
+logger = logging.getLogger("tpu-inference")
+
+__all__ = ["FAULT_KINDS", "FaultSpec", "FaultInjector", "InjectedFault",
+           "InjectedReplicaDeath", "parse_fault_specs"]
+
+FAULT_KINDS = ("exception", "stall", "death", "alloc", "corrupt", "truncate")
+
+
+class InjectedFault(RuntimeError):
+    """A transient injected dispatch failure (retryable)."""
+
+
+class InjectedReplicaDeath(InjectedFault):
+    """Hard replica death: every call after the fire point raises this —
+    the replica cannot cooperate with its own recovery."""
+
+
+@dataclass
+class FaultSpec:
+    """One declarative fault: what, where, when.
+
+    Exactly one of ``at_step``/``every_n`` schedules it (neither defaults
+    to ``at_step=1``); ``once`` bounds repeat fires per replica (defaults
+    True for ``at_step``, False for ``every_n``)."""
+
+    kind: str
+    replica: Optional[str] = None        # None = every replica
+    at_step: Optional[int] = None
+    every_n: Optional[int] = None
+    once: Optional[bool] = None
+    stall_ms: float = 100.0
+
+    def __post_init__(self):
+        if self.kind not in FAULT_KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r} "
+                             f"(known: {FAULT_KINDS})")
+        if self.at_step is not None and self.every_n is not None:
+            raise ValueError("at_step and every_n are mutually exclusive")
+        if self.at_step is None and self.every_n is None:
+            self.at_step = 1
+        if self.at_step is not None and self.at_step < 1:
+            raise ValueError("at_step must be >= 1")
+        if self.every_n is not None and self.every_n < 1:
+            raise ValueError("every_n must be >= 1")
+        if self.once is None:
+            self.once = self.every_n is None
+        if self.stall_ms < 0:
+            raise ValueError("stall_ms must be >= 0")
+
+    @classmethod
+    def parse(cls, entry: str) -> "FaultSpec":
+        entry = entry.strip()
+        head, _, args = entry.partition(":")
+        kind, _, replica = head.strip().partition("@")
+        kw: Dict[str, object] = {"kind": kind.strip(),
+                                 "replica": replica.strip() or None}
+        for part in args.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if "=" not in part:
+                raise ValueError(f"fault spec entry {part!r} is not "
+                                 f"key=value (in {entry!r})")
+            k, v = (s.strip() for s in part.split("=", 1))
+            if k in ("at_step", "every_n"):
+                kw[k] = int(v)
+            elif k == "once":
+                kw[k] = v.lower() in ("1", "true", "yes")
+            elif k == "stall_ms":
+                kw[k] = float(v)
+            else:
+                raise ValueError(f"unknown fault spec key {k!r} "
+                                 f"(known: at_step, every_n, once, stall_ms)")
+        return cls(**kw)
+
+
+def parse_fault_specs(text: str) -> List[FaultSpec]:
+    """Parse the CLI's semicolon-separated fault-spec string."""
+    return [FaultSpec.parse(e) for e in text.split(";") if e.strip()]
+
+
+class FaultInjector:
+    """Fires :class:`FaultSpec` schedules against a router's replicas.
+
+    Construction takes specs (objects or the grammar string) plus a seed;
+    ``PrefixAffinityRouter(fault_injector=...)`` calls :meth:`attach`, which
+    wraps each replica's seams:
+
+    - ``EngineReplica.step`` — the schedule is evaluated here (one tick per
+      step call); ``exception``/``death`` raise, ``stall`` sleeps, and
+      ``corrupt``/``truncate``/``alloc`` arm their targets before the real
+      step runs.
+    - ``EngineReplica.submit`` / ``drain`` — poisoned by ``death`` (a dead
+      replica cannot cooperate with anything, drain included).
+    - ``allocator._alloc_one`` — raises one injected
+      :class:`KVBlocksExhausted` per armed ``alloc`` fault.
+    - the replica's host KV tier — ``corrupt``/``truncate`` mutate one
+      seeded-random entry's bytes in place.
+
+    Every fire is counted: ``fired`` (plain dict, always) and the
+    ``faults_injected_total{kind=,replica=}`` counter on the router registry
+    (when attached). ``fired_total == 0`` after a run means no fault
+    actually hit — bench refuses to publish fault metrics on that
+    (``faults_invalid``), the r5 honesty pattern.
+    """
+
+    def __init__(self, specs: Union[str, Sequence[FaultSpec]] = (),
+                 seed: int = 0):
+        if isinstance(specs, str):
+            specs = parse_fault_specs(specs)
+        self.specs: List[FaultSpec] = list(specs)
+        self._rng = np.random.default_rng(seed)
+        self._steps: Dict[str, int] = {}            # replica -> step count
+        self._spec_fired: Dict[int, set] = {}       # spec idx -> replica ids
+        self._dead: set = set()
+        self._alloc_pending: Dict[str, int] = {}
+        self.fired: Dict[Tuple[str, str], int] = {} # (kind, replica) -> count
+        self.fired_total = 0
+        self._registry = None
+        self._counters: Dict[Tuple[str, str], object] = {}
+
+    # ------------------------------------------------------------------ attach
+    def attach(self, router) -> None:
+        """Wrap every replica of ``router`` (called by the router ctor)."""
+        self._registry = router.registry
+        for rep in router.replicas.values():
+            self.attach_replica(rep)
+
+    def attach_replica(self, rep) -> None:
+        """Wrap one replica's seams (also used when a FAILED replica is
+        swapped for a fresh one at reactivation)."""
+        rid = rep.replica_id
+        self._steps.setdefault(rid, 0)
+
+        real_step = rep.step
+
+        def _step(key=None):
+            self._on_step(rid, rep)
+            return real_step(key)
+
+        rep.step = _step
+        for name in ("submit", "drain"):
+            real = getattr(rep, name)
+
+            def _guarded(*a, _real=real, **kw):
+                self._check_dead(rid)
+                return _real(*a, **kw)
+
+            setattr(rep, name, _guarded)
+        # the native C++ allocator has no Python alloc seam — alloc faults
+        # need the Python/tiered allocator (the KVBlocksExhausted path)
+        alloc = getattr(rep.runner, "allocator", None)
+        if alloc is not None and hasattr(alloc, "_alloc_one"):
+            real_alloc = alloc._alloc_one
+
+            def _alloc_one():
+                if self._alloc_pending.get(rid, 0) > 0:
+                    self._alloc_pending[rid] -= 1
+                    self._count("alloc", rid)
+                    raise KVBlocksExhausted("out of KV blocks (injected)")
+                return real_alloc()
+
+            alloc._alloc_one = _alloc_one
+
+    def revive(self, replica_id: str) -> None:
+        """Forget a death: the (fresh) replica under this id serves again.
+        Called by ``router.reactivate_replica`` so a recovered fleet does
+        not stay poisoned by a one-shot death spec."""
+        self._dead.discard(replica_id)
+
+    # ------------------------------------------------------------------ firing
+    def _check_dead(self, rid: str) -> None:
+        if rid in self._dead:
+            raise InjectedReplicaDeath(
+                f"replica {rid} is dead (injected hard death)")
+
+    def _on_step(self, rid: str, rep) -> None:
+        self._check_dead(rid)
+        self._steps[rid] += 1
+        step = self._steps[rid]
+        for i, spec in enumerate(self.specs):
+            if spec.replica is not None and spec.replica != rid:
+                continue
+            if not self._due(i, spec, rid, step):
+                continue
+            self._fire(i, spec, rid, rep, step)
+
+    def _due(self, i: int, spec: FaultSpec, rid: str, step: int) -> bool:
+        if spec.once and rid in self._spec_fired.get(i, ()):
+            return False
+        if spec.at_step is not None:
+            if spec.kind in ("corrupt", "truncate"):
+                # "at or after": a corruption scheduled before the tier
+                # holds any bytes stays armed (the fire un-consumes itself
+                # on an empty store) instead of silently never firing
+                return step >= spec.at_step
+            return step == spec.at_step
+        return step % spec.every_n == 0
+
+    def _fire(self, i: int, spec: FaultSpec, rid: str, rep,
+              step: int) -> None:
+        self._spec_fired.setdefault(i, set()).add(rid)
+        kind = spec.kind
+        if kind in ("corrupt", "truncate"):
+            n = self._corrupt_tier(rep, truncate=(kind == "truncate"))
+            if n:
+                self._count(kind, rid, n)
+            else:
+                # nothing to corrupt yet (empty store): a `once` schedule is
+                # NOT consumed — it fires as soon as the tier holds bytes,
+                # so `every_n=1,once=1` means "corrupt the first entry that
+                # ever exists" deterministically
+                self._spec_fired[i].discard(rid)
+            return
+        if kind == "alloc":
+            # armed here, counted when the wrapped _alloc_one actually raises
+            self._alloc_pending[rid] = self._alloc_pending.get(rid, 0) + 1
+            return
+        if kind == "stall":
+            self._count(kind, rid)
+            logger.warning("injected %.0f ms dispatch stall on replica %s "
+                           "(step %d)", spec.stall_ms, rid, step)
+            time.sleep(spec.stall_ms / 1e3)
+            return
+        if kind == "death":
+            self._dead.add(rid)
+            self._count(kind, rid)
+            raise InjectedReplicaDeath(
+                f"replica {rid} died (injected at step {step})")
+        self._count("exception", rid)
+        raise InjectedFault(
+            f"injected dispatch exception on replica {rid} (step {step})")
+
+    def _corrupt_tier(self, rep, truncate: bool) -> int:
+        """Mutate one seeded-random host-tier entry's bytes in place (the
+        checksum stays what spill stamped, so the readmit verify MUST trip).
+        Returns entries mutated (0 when the replica has no tier entries —
+        the schedule was mis-aimed; counted as not-fired so bench's
+        ``faults_invalid`` honesty marker can see it)."""
+        tier = getattr(rep.runner, "kv_tier", None)
+        if tier is None or not tier.store:
+            logger.warning("corrupt/truncate fault found no host-tier "
+                           "entries on replica %s — nothing mutated",
+                           rep.replica_id)
+            return 0
+        keys = sorted(tier.store)
+        h = keys[int(self._rng.integers(len(keys)))]
+        blk = tier.store[h]
+        k, v = blk.materialize()
+        if truncate:
+            # a torn copy: half the K bytes survive, shape collapses
+            flat = np.ascontiguousarray(k).reshape(-1)
+            blk._np = (flat[: max(1, flat.size // 2)].copy(), v)
+        else:
+            kk = np.ascontiguousarray(k).copy()
+            kk.view(np.uint8).reshape(-1)[0] ^= 0xFF
+            blk._np = (kk, v)
+        return 1
+
+    def _count(self, kind: str, rid: str, n: int = 1) -> None:
+        key = (kind, rid)
+        self.fired[key] = self.fired.get(key, 0) + n
+        self.fired_total += n
+        if self._registry is not None:
+            c = self._counters.get(key)
+            if c is None:
+                c = self._registry.counter(
+                    "faults_injected_total",
+                    "faults fired by the serving fault injector",
+                    labels={"kind": kind, "replica": rid})
+                self._counters[key] = c
+            c.inc(n)
+
+    def stats(self) -> Dict[str, object]:
+        return {
+            "specs": len(self.specs),
+            "fired_total": self.fired_total,
+            "fired": {f"{k}@{r}": n for (k, r), n in sorted(self.fired.items())},
+            "dead": sorted(self._dead),
+            "steps": dict(self._steps),
+        }

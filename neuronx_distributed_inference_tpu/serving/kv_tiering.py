@@ -32,17 +32,21 @@ never perturb a token stream.
 
 from __future__ import annotations
 
+import logging
+import zlib
 from typing import Callable, Dict, List, Optional, Tuple
 
 import jax.numpy as jnp
 import numpy as np
 
 from ..analysis.registry import audited_jit
-from ..modules.block_kvcache import BlockAllocator
+from ..modules.block_kvcache import BlockAllocator, KVBlocksExhausted
 from ..utils import device_telemetry as dtel
 
-__all__ = ["HostKVTier", "TieredBlockAllocator", "READMIT_BUCKET_CAP",
-           "build_readmit_step", "readmit_bucket"]
+logger = logging.getLogger("tpu-inference")
+
+__all__ = ["HostKVTier", "TieredBlockAllocator", "KVBlocksExhausted",
+           "READMIT_BUCKET_CAP", "build_readmit_step", "readmit_bucket"]
 
 # largest blocks-per-readmit-dispatch bucket; bigger batches dispatch in
 # cap-sized chunks (ContinuousBatchingRunner._dispatch_readmits)
@@ -86,19 +90,40 @@ def build_readmit_step(kind: str = "cb.paged.tier_readmit"):
 class _HostBlock:
     """One spilled block: the device gather result until materialized, then
     plain numpy bytes. ``copy_to_host_async`` is scheduled at spill time so
-    the D2H transfer overlaps whatever the serving loop dispatches next."""
+    the D2H transfer overlaps whatever the serving loop dispatches next.
 
-    __slots__ = ("k", "v", "stamp", "_np")
+    Materialization also stamps a CONTENT CHECKSUM over the host bytes (shape
+    descriptor + crc32): a host-RAM entry can rot between spill and re-admit
+    (bit flips, a truncating copy, a fault injector), and a re-admitted
+    garbage block would silently perturb every later token of any stream
+    sharing the prefix. ``verify()`` recomputes the checksum — the readmit
+    path refuses (drops the entry, falls back to re-prefill) on mismatch."""
+
+    __slots__ = ("k", "v", "stamp", "_np", "checksum")
 
     def __init__(self, k, v, stamp: int):
         self.k, self.v, self.stamp = k, v, stamp
         self._np: Optional[Tuple[np.ndarray, np.ndarray]] = None
+        self.checksum: Optional[int] = None
+
+    @staticmethod
+    def _digest(k: np.ndarray, v: np.ndarray) -> int:
+        crc = zlib.crc32(repr((k.shape, str(k.dtype),
+                               v.shape, str(v.dtype))).encode())
+        crc = zlib.crc32(np.ascontiguousarray(k).tobytes(), crc)
+        return zlib.crc32(np.ascontiguousarray(v).tobytes(), crc)
 
     def materialize(self) -> Tuple[np.ndarray, np.ndarray]:
         if self._np is None:
             self._np = (np.asarray(self.k), np.asarray(self.v))
             self.k = self.v = None          # drop the device handles
+            self.checksum = self._digest(*self._np)
         return self._np
+
+    def verify(self) -> bool:
+        """True iff the host bytes still match the spill-time checksum."""
+        k, v = self.materialize()
+        return self.checksum == self._digest(k, v)
 
     def nbytes(self) -> int:
         if self._np is not None:
@@ -130,6 +155,7 @@ class HostKVTier:
         self.discards = 0            # spill candidates dropped (capacity 0)
         self.readmit_blocks = 0      # host blocks restored to device
         self.readmit_requests = 0    # requests that hit the host tier
+        self.integrity_failures = 0  # entries dropped on checksum mismatch
 
     # ------------------------------------------------------------ bookkeeping
     def tick(self) -> int:
@@ -154,6 +180,7 @@ class HostKVTier:
             "discards": self.discards,
             "readmit_blocks": self.readmit_blocks,
             "readmit_requests": self.readmit_requests,
+            "integrity_failures": self.integrity_failures,
         }
 
     # ------------------------------------------------------------ spill side
@@ -179,7 +206,8 @@ class HostKVTier:
         try:
             k.copy_to_host_async()
             v.copy_to_host_async()
-        except AttributeError:                   # non-array backends
+        # lint: ok(silent-except): non-array backends have no async D2H; materialize() below copies synchronously either way
+        except AttributeError:
             pass
         stamp = self.tick()
         fresh = []
@@ -203,13 +231,27 @@ class HostKVTier:
             self.host_evictions += 1
 
     # ------------------------------------------------------------ readmit side
-    def reserve(self, h: bytes) -> _HostBlock:
-        """REMOVE one host block for a queued re-admission. Removal at
-        reservation time (not at dispatch) matters: a reclaim later in the
-        same allocation could otherwise LRU-evict the entry between the
-        prefix walk and the readmit dispatch, and the prompt would skip
-        prefill over a block that never got its bytes back."""
-        return self.store.pop(h)
+    def reserve(self, h: bytes) -> Optional[_HostBlock]:
+        """REMOVE one host block for a queued re-admission, verifying its
+        content checksum first. Removal at reservation time (not at dispatch)
+        matters: a reclaim later in the same allocation could otherwise
+        LRU-evict the entry between the prefix walk and the readmit dispatch,
+        and the prompt would skip prefill over a block that never got its
+        bytes back.
+
+        Returns ``None`` when the entry failed verification — it is DROPPED
+        (never restored, never dispatched) and counted in
+        ``integrity_failures``; the caller treats the hash as a miss and the
+        tokens re-prefill instead of reading garbage KV."""
+        blk = self.store.pop(h)
+        if not blk.verify():
+            self.integrity_failures += 1
+            logger.warning(
+                "host KV tier entry %s failed its content checksum — "
+                "dropped; the prefix re-prefills instead of re-admitting "
+                "corrupt bytes", h.hex()[:16])
+            return None
+        return blk
 
     def restore(self, h: bytes, blk: _HostBlock) -> None:
         """Put a reserved block back (allocation rollback)."""
@@ -277,7 +319,11 @@ class TieredBlockAllocator(BlockAllocator):
             self._reclaim(blk)
             self.refcount[blk] = 1
             return blk
-        raise RuntimeError("out of KV blocks")
+        # typed exhaustion, NOT a hard crash: placement catches this and
+        # preempts-or-sheds (runtime/continuous_batching._place_queued), the
+        # growth/reservation paths already preempt or take partial coverage,
+        # and the router sheds by SLO signal — serving degrades, never dies
+        raise KVBlocksExhausted("out of KV blocks")
 
     def _reclaim(self, blk: int) -> None:
         """Spill one idle block to the host tier and unregister its hash."""
@@ -358,14 +404,25 @@ class TieredBlockAllocator(BlockAllocator):
                     num_cached += bs
                     continue
                 if reusing and h in self.tier:
+                    # allocate + register FIRST (exactly what the fresh-miss
+                    # path below would do), so an exhaustion raise here rolls
+                    # back cleanly with the tier entry untouched
                     blk = self._alloc_one()
                     self.hash_to_block[h] = blk
                     self.block_to_hash[blk] = h
                     registered.append(blk)
-                    # reserve the host bytes NOW: a reclaim later in this
-                    # very walk must not LRU-evict them before the dispatch
-                    pending.append((blk, h, self.tier.reserve(h)))
                     blocks.append(blk)
+                    # reserve the host bytes NOW: a reclaim later in this
+                    # very walk must not LRU-evict them before the dispatch.
+                    # reserve() verifies the content checksum — a corrupt/
+                    # truncated entry comes back None (dropped + counted), the
+                    # block stays allocated as a plain miss, and the tokens
+                    # RE-PREFILL instead of reading garbage KV.
+                    host_blk = self.tier.reserve(h)
+                    if host_blk is None:
+                        reusing = False
+                        continue
+                    pending.append((blk, h, host_blk))
                     num_cached += bs
                     hit_tier = True
                     continue

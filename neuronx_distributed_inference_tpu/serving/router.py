@@ -27,11 +27,45 @@ The router is synchronous-cooperative: ``step()`` places what the replicas
 can admit, then steps every replica with work (one serving wave). An async
 server loop wraps ``submit``/``step``; the placement policy has no timing
 dependence, so the tests drive it deterministically.
+
+Fault tolerance (ISSUE-11): ``step()`` SUPERVISES the replicas instead of
+dying with them. Each replica moves through a small lifecycle::
+
+    HEALTHY ──exception/stall──► DEGRADED ──streak > max_retries──► FAILED
+       ▲            │(bounded exponential backoff, then retried)        │
+       │            └──successful step──► HEALTHY                       │
+       └──────── reactivate_replica (fresh runner after FAILED) ◄───────┘
+
+- Transient errors retry with bounded exponential backoff (``max_retries``
+  consecutive failures, counted in
+  ``router_replica_failures_total{replica=,reason=}`` — never silent).
+- A watchdog declares a replica FAILED on repeated failure or wall-clock
+  stall: the wall time of ``rep.step()`` at the router IS the router-level
+  dispatch gap (the same signal PR 7's per-dispatch gap attribution
+  measures inside the runner), so a wedged dispatch trips
+  ``watchdog_stall_s`` without any cooperation from the wedged replica.
+- Hard death (:class:`~.faults.InjectedReplicaDeath`, or any exception from
+  a replica already FAILED) short-circuits to FAILED.
+- The transition to FAILED dumps an automatic flight-recorder debug bundle
+  (``debug_bundle_dir``) and, with ``auto_recover=True``, immediately runs
+  :meth:`recover_replica` so the displaced streams continue on the
+  survivors.
+
+``recover_replica`` is the NON-cooperative counterpart of
+``drain_replica``: it never touches the dead runner's device state — every
+in-flight stream is rebuilt from the router's own journal (the prompt plus
+every committed token in ``RouterRequest.generated``) and re-queued at the
+front for ``submit(resume_tokens=...)`` on a survivor, so greedy streams
+continue bit-identically (the guarantee drain/migration already meets, now
+without the dead replica's help).
 """
 
 from __future__ import annotations
 
+import json
 import logging
+import os
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
 
@@ -39,10 +73,23 @@ import numpy as np
 
 from ..utils import metrics as metrics_lib
 from .engine import EngineReplica, prompt_block_hashes
+from .faults import InjectedReplicaDeath
 
 logger = logging.getLogger("tpu-inference")
 
-__all__ = ["PrefixAffinityRouter", "RouterRequest"]
+__all__ = ["PrefixAffinityRouter", "RouterRequest", "RouterOverloaded",
+           "REPLICA_HEALTHY", "REPLICA_DEGRADED", "REPLICA_FAILED"]
+
+# replica lifecycle states (serving_replica_state gauge values)
+REPLICA_HEALTHY = "healthy"
+REPLICA_DEGRADED = "degraded"
+REPLICA_FAILED = "failed"
+_STATE_GAUGE = {REPLICA_HEALTHY: 0, REPLICA_DEGRADED: 1, REPLICA_FAILED: 2}
+
+
+class RouterOverloaded(RuntimeError):
+    """submit() shed the request (queue past ``shed_queue_depth`` while the
+    SLO signal says unhealthy) — the caller should back off / 503."""
 
 
 @dataclass
@@ -76,7 +123,32 @@ class PrefixAffinityRouter:
     """
 
     def __init__(self, replicas: Sequence[EngineReplica],
-                 policy: str = "affinity", seed: int = 0):
+                 policy: str = "affinity", seed: int = 0, *,
+                 fault_injector=None, max_retries: int = 3,
+                 max_backoff_steps: int = 32,
+                 watchdog_stall_s: Optional[float] = None,
+                 debug_bundle_dir: Optional[str] = None,
+                 auto_recover: bool = False,
+                 shed_queue_depth: Optional[int] = None,
+                 slo_signal=None):
+        """Supervision knobs (fault tolerance, ISSUE-11):
+
+        ``fault_injector``: a :class:`~.faults.FaultInjector` to attach
+        (wraps the replica seams; test/bench harness).
+        ``max_retries``: consecutive failures before a replica goes FAILED
+        (each retry backs off ``2**streak`` router steps, capped at
+        ``max_backoff_steps``).
+        ``watchdog_stall_s``: wall-clock ceiling for one ``rep.step()`` —
+        exceeding it counts as a ``stall`` failure (None = watchdog off).
+        ``debug_bundle_dir``: where the automatic on-FAILED flight-recorder
+        debug bundle lands (None = skip the dump, still log).
+        ``auto_recover``: run :meth:`recover_replica` immediately on the
+        transition to FAILED.
+        ``shed_queue_depth``: arrival-queue depth past which ``submit``
+        sheds (raises :class:`RouterOverloaded`) — only while ``slo_signal``
+        (a callable returning True when healthy) says unhealthy, or always
+        past the bound when no signal is given. None = never shed.
+        """
         if not replicas:
             raise ValueError("need at least one replica")
         ids = [r.replica_id for r in replicas]
@@ -144,6 +216,61 @@ class PrefixAffinityRouter:
             "requests re-placed by a replica drain")
         self._g_queue = reg.gauge(
             "router_queue_depth", "requests waiting at the frontend")
+        # --- replica supervision / fault tolerance (ISSUE-11) --------------
+        self.max_retries = int(max_retries)
+        self.max_backoff_steps = int(max_backoff_steps)
+        self.watchdog_stall_s = watchdog_stall_s
+        self.debug_bundle_dir = debug_bundle_dir
+        self.auto_recover = auto_recover
+        self.shed_queue_depth = shed_queue_depth
+        self.slo_signal = slo_signal
+        self._step_count = 0
+        self._health: Dict[str, str] = {}
+        self._fail_streak: Dict[str, int] = {rid: 0 for rid in self.replicas}
+        self._retry_after: Dict[str, int] = {rid: 0 for rid in self.replicas}
+        self.recovery_times_ms: List[float] = []
+        self._c_failures: Dict[tuple, object] = {}       # (replica, reason)
+        self._g_state = {
+            rid: reg.gauge(
+                "serving_replica_state",
+                "replica lifecycle: 0 healthy, 1 degraded, 2 failed",
+                labels={"replica": rid})
+            for rid in self.replicas}
+        for rid in self.replicas:
+            self._set_state(rid, REPLICA_HEALTHY)
+        self._c_recoveries = reg.counter(
+            "router_recoveries_total",
+            "non-cooperative replica recoveries (recover_replica)")
+        self._c_recovered = reg.counter(
+            "router_recovered_requests_total",
+            "in-flight requests rebuilt from the router journal and "
+            "re-queued by recover_replica")
+        self._c_shed = reg.counter(
+            "router_requests_shed_total",
+            "arrivals refused by the overload shed (queue past "
+            "shed_queue_depth while the SLO signal is unhealthy)")
+        self._c_aff_unavail = reg.counter(
+            "router_affinity_unavailable_total",
+            "placements whose best prefix holder was draining/degraded/"
+            "failed — re-scored against the healthy set, lost hit counted")
+        self.fault_injector = fault_injector
+        if fault_injector is not None:
+            fault_injector.attach(self)
+
+    # ------------------------------------------------------------- lifecycle state
+    def _set_state(self, rid: str, state: str) -> None:
+        self._health[rid] = state
+        self._g_state[rid].set(_STATE_GAUGE[state])
+
+    def replica_state(self, replica_id: str) -> str:
+        return self._health[replica_id]
+
+    def _placeable(self, rep: EngineReplica) -> bool:
+        """In the placement set: HEALTHY and not draining. DEGRADED replicas
+        are backing off a failure (their next step may fail again) and
+        FAILED replicas are gone — neither takes new work."""
+        return (self._health[rep.replica_id] == REPLICA_HEALTHY
+                and not rep.draining)
 
     # ---------------------------------------------------------------- intake
     def submit(self, prompt, max_new_tokens: int = 32,
@@ -152,6 +279,19 @@ class PrefixAffinityRouter:
         prompt = np.asarray(prompt).astype(np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
+        if (self.shed_queue_depth is not None
+                and len(self.queue) >= self.shed_queue_depth
+                and (self.slo_signal is None or not self.slo_signal())):
+            # graceful degradation under exhaustion/overload: shed by SLO
+            # signal at the frontend instead of queueing into a wedge —
+            # counted, logged, surfaced to the caller as a typed error
+            self._c_shed.inc()
+            logger.warning(
+                "shedding arrival: frontend queue %d >= %d and the SLO "
+                "signal is unhealthy", len(self.queue), self.shed_queue_depth)
+            raise RouterOverloaded(
+                f"frontend queue depth {len(self.queue)} >= shed bound "
+                f"{self.shed_queue_depth}")
         req = RouterRequest(
             self._next_id, prompt, max_new_tokens, eos_token_id,
             None if sampling_params is None
@@ -170,7 +310,7 @@ class PrefixAffinityRouter:
     def _affinity(self, req: RouterRequest) -> Dict[str, int]:
         return {rid: rep.resident_prefix_blocks(req.hashes)
                 for rid, rep in self.replicas.items()
-                if not rep.draining}
+                if self._placeable(rep)}
 
     def _load_key(self, rep: EngineReplica):
         """Sort key: most KV headroom first, then shallowest queue, then
@@ -185,7 +325,12 @@ class PrefixAffinityRouter:
         # a migrated request refeeds prompt + generated at placement, so its
         # KV footprint is the FULL stream so far, not the prompt alone
         n = len(req.prompt) + len(req.generated)
-        admitting = [r for r in self.replicas.values() if r.can_admit(n)]
+        # only HEALTHY, non-draining replicas take placements: can_admit
+        # alone knows nothing about the supervision lifecycle, and placing
+        # onto a DEGRADED/FAILED replica would strand the request behind a
+        # failure the router already knows about
+        admitting = [r for r in self.replicas.values()
+                     if self._placeable(r) and r.can_admit(n)]
         if not admitting:
             return None
         if self.policy == "random":
@@ -197,6 +342,23 @@ class PrefixAffinityRouter:
         aff = self._affinity(req)
         best_aff = max((aff.get(r.replica_id, 0) for r in admitting),
                        default=0)
+        # a draining/degraded/failed replica may hold a LONGER prefix than
+        # any placeable one: the request re-scores against the healthy set
+        # (it must NOT place on a non-healthy holder) and the lost hit is
+        # counted — recompute bought availability, visibly
+        best_unavail = 0
+        for rid, rep in self.replicas.items():
+            if not self._placeable(rep):
+                try:
+                    best_unavail = max(best_unavail,
+                                       rep.resident_prefix_blocks(req.hashes))
+                # a dead replica's probe may raise — its blocks are
+                # unreachable anyway, which is exactly "no affinity"
+                # lint: ok(silent-except): dead-replica affinity probe; the blocks it would score are unreachable
+                except Exception:
+                    pass
+        if best_unavail > best_aff:
+            self._c_aff_unavail.inc()
         if best_aff > 0:
             targets = [r for r in admitting
                        if aff.get(r.replica_id, 0) == best_aff]
@@ -259,15 +421,138 @@ class PrefixAffinityRouter:
     # ------------------------------------------------------------- serving
     def step(self) -> Dict[int, List[int]]:
         """One serving wave: place what fits, step every replica with work,
-        fold each replica's emissions back to frontend request ids."""
+        fold each replica's emissions back to frontend request ids.
+
+        SUPERVISED (ISSUE-11): a per-replica failure no longer kills the
+        frontend. Exceptions from ``rep.step()`` are caught and counted; the
+        replica degrades, backs off, retries, and FAILS after
+        ``max_retries`` consecutive failures (or immediately on hard
+        death); a wall-clock stall past ``watchdog_stall_s`` counts as a
+        failure too. FAILED replicas are skipped entirely (their streams
+        move via recover_replica)."""
+        self._step_count += 1
         self.place_queued()
         emitted: Dict[int, List[int]] = {}
-        for rid, rep in self.replicas.items():
-            if not rep.has_work:
+        for rid, rep in list(self.replicas.items()):
+            if self._health[rid] == REPLICA_FAILED:
                 continue
-            for local_id, toks in rep.step().items():
+            if self._step_count < self._retry_after[rid]:
+                continue                      # backing off a recent failure
+            if not rep.has_work:
+                if self._health[rid] == REPLICA_DEGRADED:
+                    # nothing to retry against; an idle degraded replica
+                    # rejoins the placement set
+                    self._note_step_ok(rid)
+                continue
+            t0 = time.perf_counter()
+            try:
+                step_out = rep.step()
+            # lint: ok(silent-except): THE supervisor handler — _on_replica_failure counts router_replica_failures_total and logs every failure
+            except Exception as e:
+                self._on_replica_failure(rid, e)
+                continue
+            wall = time.perf_counter() - t0
+            if (self.watchdog_stall_s is not None
+                    and wall > self.watchdog_stall_s):
+                # the router-level dispatch gap (PR 7's stall signal at
+                # this altitude): the step RETURNED but took far too long —
+                # a wedged dispatch inside it. Counted like a failure;
+                # repeated stalls fail the replica.
+                self._on_replica_failure(rid, None, reason="stall",
+                                         wall_s=wall)
+            else:
+                self._note_step_ok(rid)
+            for local_id, toks in step_out.items():
                 self._fold(rid, local_id, toks, emitted)
         return emitted
+
+    def _note_step_ok(self, rid: str) -> None:
+        if self._fail_streak[rid]:
+            logger.info("replica %s recovered after %d failure(s)",
+                        rid, self._fail_streak[rid])
+        self._fail_streak[rid] = 0
+        self._retry_after[rid] = 0
+        if self._health[rid] == REPLICA_DEGRADED:
+            self._set_state(rid, REPLICA_HEALTHY)
+
+    def _count_failure(self, rid: str, reason: str) -> None:
+        key = (rid, reason)
+        c = self._c_failures.get(key)
+        if c is None:
+            c = self.registry.counter(
+                "router_replica_failures_total",
+                "replica step failures seen by the supervisor",
+                labels={"replica": rid, "reason": reason})
+            self._c_failures[key] = c
+        c.inc()
+
+    def _on_replica_failure(self, rid: str, exc: Optional[BaseException],
+                            reason: Optional[str] = None,
+                            wall_s: Optional[float] = None) -> None:
+        if reason is None:
+            reason = ("death" if isinstance(exc, InjectedReplicaDeath)
+                      else "exception")
+        self._count_failure(rid, reason)
+        self._fail_streak[rid] += 1
+        streak = self._fail_streak[rid]
+        if reason == "death" or streak > self.max_retries:
+            self._fail_replica(rid, reason, exc)
+            return
+        backoff = min(2 ** streak, self.max_backoff_steps)
+        self._retry_after[rid] = self._step_count + backoff
+        self._set_state(rid, REPLICA_DEGRADED)
+        logger.warning(
+            "replica %s %s (%s) — failure %d/%d, retrying in %d router "
+            "step(s)", rid, reason,
+            exc if exc is not None else f"step wall {wall_s:.3f}s > "
+            f"watchdog {self.watchdog_stall_s:.3f}s",
+            streak, self.max_retries, backoff)
+
+    def _fail_replica(self, rid: str, reason: str,
+                      exc: Optional[BaseException] = None) -> None:
+        """The DEGRADED→FAILED (or straight-to-FAILED) transition: leave the
+        placement set for good, dump the flight-recorder debug bundle, and
+        (auto_recover) rebuild the replica's streams from the journal."""
+        if self._health[rid] == REPLICA_FAILED:
+            return
+        self._set_state(rid, REPLICA_FAILED)
+        logger.error("replica %s FAILED (%s): %s — %s", rid, reason,
+                     exc if exc is not None else "watchdog/stall",
+                     "auto-recovering its streams" if self.auto_recover
+                     else "awaiting recover_replica()")
+        self._dump_failure_bundle(rid, reason, exc)
+        if self.auto_recover:
+            self.recover_replica(rid)
+
+    def _dump_failure_bundle(self, rid: str, reason: str,
+                             exc: Optional[BaseException]) -> str:
+        """Automatic debug bundle on FAILED — best-effort by design: the
+        bundle reads the (host-side) telemetry ring and registry, never the
+        dead device, and a dump failure must not mask the failure being
+        dumped."""
+        if self.debug_bundle_dir is None:
+            return ""
+        rep = self.replicas[rid]
+        flight = getattr(rep.runner.telemetry, "flight", None)
+        if flight is None:
+            logger.warning("replica %s has no flight recorder — no FAILED "
+                           "debug bundle", rid)
+            return ""
+        path = os.path.join(self.debug_bundle_dir,
+                            f"replica-{rid}-failed.json")
+        try:
+            out = flight.dump_bundle(
+                path, metrics=rep.registry.to_dict(), stats=None,
+                reason=f"replica_failed:{reason}",
+                extra={"replica": rid, "exception": repr(exc),
+                       "router_step": self._step_count,
+                       "fail_streak": self._fail_streak[rid]})
+            logger.warning("replica %s FAILED debug bundle: %s", rid, out)
+            return out
+        except Exception as e:
+            logger.warning("replica %s FAILED debug-bundle dump failed: %s",
+                           rid, e)
+            return ""
 
     def _fold(self, rid: str, local_id: int, toks: List[int],
               emitted: Dict[int, List[int]]) -> None:
@@ -287,8 +572,41 @@ class PrefixAffinityRouter:
 
     @property
     def has_work(self) -> bool:
-        return bool(self.queue) or any(r.has_work
-                                       for r in self.replicas.values())
+        """Work the router can still make progress on: the arrival queue
+        plus live replicas' work. A FAILED replica's roster does NOT count —
+        its runner may hold ghost rows forever (that's why it failed); its
+        real streams move to the queue via recover_replica."""
+        return bool(self.queue) or any(
+            rep.has_work for rid, rep in self.replicas.items()
+            if self._health[rid] != REPLICA_FAILED)
+
+    def _diagnostic_snapshot(self) -> Dict[str, object]:
+        """What a wedged fleet looks like, from the exception alone: queue
+        depth + head ids, and per replica its lifecycle state, backoff,
+        work flag, and in-flight frontend request ids."""
+        per_replica: Dict[str, object] = {}
+        for rid, rep in self.replicas.items():
+            inflight = sorted(gid for (r, _l), gid in self._local.items()
+                              if r == rid
+                              and not self.requests[gid].done)
+            try:
+                has_work = bool(rep.has_work)
+            except Exception as e:   # lint: ok(silent-except): snapshot of a possibly-dead replica; the error IS the diagnostic
+                has_work = f"unreadable: {e!r}"
+            per_replica[rid] = {
+                "state": self._health[rid],
+                "draining": rep.draining,
+                "has_work": has_work,
+                "fail_streak": self._fail_streak[rid],
+                "retry_after_step": self._retry_after[rid],
+                "inflight_request_ids": inflight[:16],
+            }
+        return {
+            "router_step": self._step_count,
+            "queue_depth": len(self.queue),
+            "queued_request_ids": [r.request_id for r in self.queue[:16]],
+            "replicas": per_replica,
+        }
 
     def run_to_completion(self, max_steps: int = 10000) -> Dict[int, List[int]]:
         guard = 0
@@ -296,7 +614,12 @@ class PrefixAffinityRouter:
             self.step()
             guard += 1
             if guard > max_steps:
-                raise RuntimeError("router serving did not converge")
+                # a wedged fleet must be debuggable from the exception
+                # alone: who is queued, who holds what, who is backing off
+                raise RuntimeError(
+                    f"router serving did not converge after {max_steps} "
+                    f"steps; diagnostic: "
+                    f"{json.dumps(self._diagnostic_snapshot(), default=str)}")
         return {rid: req.generated for rid, req in self.requests.items()}
 
     # ------------------------------------------------------------- lifecycle
@@ -330,13 +653,123 @@ class PrefixAffinityRouter:
                     replica_id, migrated)
         return migrated
 
-    def reactivate_replica(self, replica_id: str) -> None:
+    def recover_replica(self, replica_id: str) -> int:
+        """NON-cooperative crash recovery: rebuild every in-flight stream of
+        a dead replica from the router's OWN journal — unlike
+        ``drain_replica`` this never calls into the dead runner (no drain,
+        no pipeline flush, no device work).
+
+        - Every in-flight request maps back through ``_local`` to its
+          :class:`RouterRequest`, which holds the full prompt and every
+          COMMITTED token (``generated``); the request re-queues at the
+          FRONT and re-places on a survivor via ``submit(resume_tokens=)``
+          — greedy streams continue bit-identically (tokens the dead
+          replica computed but never committed to the router were never
+          emitted to a client, so recomputing them changes nothing
+          observable).
+        - The shared :class:`HostKVTier` is reconciled: host-byte
+          reservations the dead replica held for queued re-admissions are
+          restored to the store (host-side state, no cooperation needed);
+          its device-resident blocks are written off (unreachable).
+        - The replica is marked FAILED (placement/affinity/stepping all skip
+          it) until ``reactivate_replica(replica_id, replica=<fresh>)``.
+
+        Returns the number of requests re-queued."""
+        t0 = time.perf_counter()
+        rep = self.replicas[replica_id]
+        if self._health[replica_id] != REPLICA_FAILED:
+            self._set_state(replica_id, REPLICA_FAILED)
+        # --- journal rebuild (no dead-runner involvement) -------------------
+        moved: List[RouterRequest] = []
+        for key in [k for k in self._local if k[0] == replica_id]:
+            gid = self._local.pop(key)
+            req = self.requests[gid]
+            if req.done:
+                continue
+            req.replica = None
+            req.local_id = None
+            req.migrations += 1
+            moved.append(req)
+        moved.sort(key=lambda r: r.request_id)       # arrival order
+        for req in reversed(moved):
+            self.queue.insert(0, req)                # resumes first
+        self._g_queue.set(len(self.queue))
+        # --- shared-tier reconciliation (host-side state only) --------------
+        restored = 0
+        try:
+            tier = rep.runner.kv_tier
+            if tier is not None:
+                for _blk, h, host_blk in \
+                        rep.runner.allocator.take_pending_readmits():
+                    tier.restore(h, host_blk)
+                    restored += 1
+        except Exception as e:
+            # the dead replica's host state may itself be corrupt; its
+            # reservations are then lost to the store (re-prefill covers
+            # the prefixes) — visible, never fatal to the recovery
+            logger.warning("tier reconciliation for dead replica %s "
+                           "failed: %s", replica_id, e)
+        self._c_recoveries.inc()
+        self._c_recovered.inc(len(moved))
+        ms = 1e3 * (time.perf_counter() - t0)
+        self.recovery_times_ms.append(ms)
+        logger.warning(
+            "recovered replica %s without its cooperation: %d stream(s) "
+            "rebuilt from the journal and re-queued, %d tier "
+            "reservation(s) restored (%.2f ms)",
+            replica_id, len(moved), restored, ms)
+        return len(moved)
+
+    def reactivate_replica(self, replica_id: str,
+                           replica: Optional[EngineReplica] = None) -> None:
+        """Return a replica to the placement set.
+
+        A DRAINED replica reactivates in place (its runner kept serving
+        state coherently). A FAILED replica's runner is NOT trustworthy —
+        its roster still holds ghost rows for streams that already moved —
+        so reactivation after FAILED requires a FRESH ``replica`` object
+        (same id, new runner); passing none raises."""
+        old = self.replicas[replica_id]
+        if replica is not None:
+            if replica.replica_id != replica_id:
+                raise ValueError(
+                    f"replacement replica id {replica.replica_id!r} != "
+                    f"{replica_id!r}")
+            if replica.runner.paged != self.paged or (
+                    self.paged
+                    and replica.runner.block_size != self.block_size):
+                raise ValueError("replacement replica must match the "
+                                 "fleet's paged/block-size geometry")
+            self.replicas[replica_id] = replica
+            if self.fault_injector is not None:
+                self.fault_injector.attach_replica(replica)
+        elif self._health[replica_id] == REPLICA_FAILED:
+            raise ValueError(
+                f"replica {replica_id} is FAILED: its runner still holds "
+                f"the dead roster; reactivate with a fresh replica= "
+                f"(same id, new runner)")
+        del old
+        if self.fault_injector is not None:
+            self.fault_injector.revive(replica_id)
         self.replicas[replica_id].reactivate()
+        self._fail_streak[replica_id] = 0
+        self._retry_after[replica_id] = 0
+        self._set_state(replica_id, REPLICA_HEALTHY)
 
     # ------------------------------------------------------------- export
     def stats(self) -> Dict[str, object]:
-        per_replica = {rid: rep.admission()
-                       for rid, rep in self.replicas.items()}
+        per_replica = {}
+        for rid, rep in self.replicas.items():
+            try:
+                a = dict(rep.admission())
+            except Exception as e:
+                # a dead replica must not take the stats surface with it
+                logger.warning("admission probe of replica %s failed: %s",
+                               rid, e)
+                a = {"replica": rid, "queue_depth": 0, "active_requests": 0,
+                     "error": repr(e)}
+            a["state"] = self._health[rid]
+            per_replica[rid] = a
         depths = [a["queue_depth"] + a["active_requests"]
                   for a in per_replica.values()]
         mean = sum(depths) / max(1, len(depths))
@@ -356,6 +789,17 @@ class PrefixAffinityRouter:
             # max/mean replica load (queue + live rows) — the imbalance
             # number bench publishes as replica_load_imbalance
             "load_imbalance": (max(depths) / mean if mean > 0 else 1.0),
+            # supervision / fault tolerance (ISSUE-11)
+            "replica_state": dict(self._health),
+            "failures": sum(c.value for c in self._c_failures.values()),
+            "recoveries": self._c_recoveries.value,
+            "recovered_requests": self._c_recovered.value,
+            "shed": self._c_shed.value,
+            "affinity_unavailable": self._c_aff_unavail.value,
+            "recovery_times_ms": [round(t, 3)
+                                  for t in self.recovery_times_ms],
+            "faults_injected": (self.fault_injector.fired_total
+                                if self.fault_injector is not None else 0),
             "replicas": per_replica,
         }
 

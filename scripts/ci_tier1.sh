@@ -50,4 +50,17 @@ if [ "${MEGASTEP_TIER1_TESTS:-0}" -lt 1 ]; then
     echo "ERROR: megastep exactness tests are not in the tier-1 marker set" >&2
     [ "$rc" -eq 0 ] && rc=1
 fi
+
+# ISSUE-11 unchanged-semantics guard: the fault-tolerance suite (injected
+# death/corruption/exhaustion recovery, supervision lifecycle) must stay
+# collected inside the tier-1 marker set — same rationale as above.
+FAULTS_TIER1_TESTS=$(env JAX_PLATFORMS=cpu python -m pytest \
+    "$REPO/tests/test_faults.py" \
+    -q -m 'not slow' --collect-only -p no:cacheprovider 2>/dev/null \
+    | grep -ac '::' || true)
+echo "FAULTS_TIER1_TESTS=$FAULTS_TIER1_TESTS"
+if [ "${FAULTS_TIER1_TESTS:-0}" -lt 1 ]; then
+    echo "ERROR: fault-tolerance tests are not in the tier-1 marker set" >&2
+    [ "$rc" -eq 0 ] && rc=1
+fi
 exit "$rc"

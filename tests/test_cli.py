@@ -173,6 +173,53 @@ def test_cli_routed_serve_replicas_and_kv_tier(tmp_path):
         assert b["reason"] == "exit"
 
 
+def test_cli_routed_serve_inject_faults_survives(tmp_path):
+    """--inject-faults (ISSUE-11): a transient injected dispatch exception
+    mid-serve degrades + retries under the router's supervision — the run
+    still exits 0 with every prompt served, and the failure/fault counters
+    land in the merged exposition."""
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM as HFLlama
+
+    from neuronx_distributed_inference_tpu.inference_demo import main
+
+    ckpt = str(tmp_path / "ckpt")
+    cfg = LlamaConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2)
+    torch.manual_seed(0)
+    HFLlama(cfg).eval().save_pretrained(ckpt, safe_serialization=True)
+
+    metrics = str(tmp_path / "metrics.prom")
+    assert main(["--model-path", ckpt, "--batch-size", "2", "--seq-len", "64",
+                 "--max-context-length", "32", "--dtype", "float32",
+                 "--max-new-tokens", "6", "--check-accuracy-mode", "skip",
+                 "--context-encoding-buckets", "16", "32",
+                 "--token-generation-buckets", "32", "64",
+                 "--continuous-batching", "--paged-attention",
+                 "--pa-num-blocks", "48", "--pa-block-size", "8",
+                 "--serve", "--replicas", "2",
+                 "--inject-faults", "exception@0:at_step=1",
+                 "--prompt", "x", "--prompt", "y",
+                 "--metrics-out", metrics]) == 0
+    prom = open(metrics).read()
+    assert 'faults_injected_total{kind="exception",replica="0"} 1' in prom
+    assert ('router_replica_failures_total{replica="0",'
+            'reason="exception"} 1') in prom
+    assert "router_requests_finished_total 2" in prom
+    # a single-runner serve refuses the flag (faults need the router seams)
+    with pytest.raises(SystemExit, match="routed serving"):
+        main(["--model-path", ckpt, "--batch-size", "2", "--seq-len", "64",
+              "--max-context-length", "32", "--dtype", "float32",
+              "--check-accuracy-mode", "skip",
+              "--context-encoding-buckets", "16", "32",
+              "--token-generation-buckets", "32", "64",
+              "--continuous-batching", "--paged-attention",
+              "--pa-num-blocks", "48", "--pa-block-size", "8",
+              "--serve", "--inject-faults", "death@0",
+              "--prompt", "x"])
+
+
 def test_parity_flags_map_to_config():
     """Round-3 parity flags: hybrid MoE sharding, pp/mlp-cp validation,
     max-num-seqs batch widening, draft tp override."""

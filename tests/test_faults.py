@@ -1,0 +1,469 @@
+"""Fault-tolerant serving (ISSUE-11): injected replica death, corruption,
+stalls, and KV exhaustion must DEGRADE the fleet — counted, logged, bundled
+— never kill it, and recovered greedy streams must be BIT-identical to the
+fault-free run with zero requests lost.
+
+Every fault here goes through serving/faults.py's deterministic injector —
+the same seams bench's fault-schedule phase drives — so the recovery paths
+are exercised, not hoped for."""
+
+import numpy as np
+import pytest
+
+from neuronx_distributed_inference_tpu.config import (
+    TpuConfig, load_pretrained_config)
+from neuronx_distributed_inference_tpu.models.llama.modeling_llama import (
+    LlamaForCausalLM, LlamaInferenceConfig)
+from neuronx_distributed_inference_tpu.modules.block_kvcache import (
+    KVBlocksExhausted)
+from neuronx_distributed_inference_tpu.runtime.continuous_batching import (
+    ContinuousBatchingRunner)
+from neuronx_distributed_inference_tpu.serving import (
+    EngineReplica, FaultInjector, FaultSpec, HostKVTier, PrefixAffinityRouter,
+    RouterOverloaded, REPLICA_DEGRADED, REPLICA_FAILED, REPLICA_HEALTHY)
+from neuronx_distributed_inference_tpu.serving.faults import parse_fault_specs
+
+BS = 8   # pa_block_size everywhere here
+
+
+def _make_app(hf_cfg, slots=2, blocks=48, seq_len=96):
+    tpu_cfg = TpuConfig(
+        batch_size=slots, seq_len=seq_len, max_context_length=32,
+        dtype="float32", context_encoding_buckets=[16, 32],
+        token_generation_buckets=[48, 96], is_continuous_batching=True,
+        paged_attention_enabled=True, pa_num_blocks=blocks, pa_block_size=BS)
+    config = LlamaInferenceConfig(tpu_cfg,
+                                  load_config=load_pretrained_config(hf_cfg))
+    app = LlamaForCausalLM(None, config)
+    app.load_random(seed=0)
+    return app
+
+
+@pytest.fixture(scope="module")
+def app(tiny_llama_hf_config):
+    return _make_app(tiny_llama_hf_config)
+
+
+def _replica(app, rid, tier=None, **runner_kw):
+    return EngineReplica(
+        str(rid), lambda tel: ContinuousBatchingRunner(
+            app, decode_chunk=4, telemetry=tel, kv_tier=tier, **runner_kw))
+
+
+def _replicas(app, n=2, tier=None, **runner_kw):
+    return [_replica(app, i, tier=tier, **runner_kw) for i in range(n)]
+
+
+def _reference(app, prompts, max_new):
+    return [app.generate(p[None, :], max_new_tokens=max_new
+                         ).tokens[0].tolist() for p in prompts]
+
+
+def _prompts(seed, sizes):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(1, 256, size=(n,)).astype(np.int32) for n in sizes]
+
+
+def _warm(app):
+    """One throwaway generation so later per-step timing excludes compiles
+    (the watchdog tests time real steps)."""
+    runner = ContinuousBatchingRunner(app, decode_chunk=4)
+    runner.submit(np.arange(1, 11, dtype=np.int32), max_new_tokens=4)
+    runner.run_to_completion()
+
+
+# -------------------------------------------------------------- fault specs
+def test_fault_spec_grammar_and_validation():
+    specs = parse_fault_specs(
+        "death@0:at_step=4; exception:every_n=7 ;"
+        "stall@1:at_step=2,stall_ms=250;corrupt@1:every_n=1,once=1")
+    assert [s.kind for s in specs] == ["death", "exception", "stall",
+                                      "corrupt"]
+    assert specs[0].replica == "0" and specs[0].at_step == 4
+    assert specs[0].once is True              # at_step defaults once
+    assert specs[1].replica is None and specs[1].every_n == 7
+    assert specs[1].once is False             # every_n defaults repeating
+    assert specs[2].stall_ms == 250.0
+    assert specs[3].once is True
+    # no schedule key = fire on the first step
+    assert FaultSpec.parse("death").at_step == 1
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        FaultSpec.parse("segfault@0")
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        FaultSpec(kind="death", at_step=1, every_n=2)
+    with pytest.raises(ValueError, match="unknown fault spec key"):
+        FaultSpec.parse("death@0:when=4")
+    with pytest.raises(ValueError, match="key=value"):
+        FaultSpec.parse("death@0:at_step")
+
+
+# ------------------------------------------------- the acceptance e2e: death
+def test_hard_death_recover_replica_bit_exact_zero_lost(
+        tiny_llama_hf_config, app, tmp_path):
+    """THE acceptance e2e: hard replica death mid-generation. The supervisor
+    FAILs the replica on the spot (death is not retryable), dumps a debug
+    bundle, and recover_replica rebuilds every in-flight stream from the
+    router's own journal — the dead runner is never asked for anything —
+    with greedy output bit-identical to the fault-free run and zero
+    requests lost."""
+    prompts = _prompts(31, (12, 19, 10, 17))
+    refs = _reference(app, prompts, max_new=10)
+
+    tier = HostKVTier(capacity_blocks=32)
+    inj = FaultInjector("death@0:at_step=2", seed=0)
+    router = PrefixAffinityRouter(
+        _replicas(app, 2, tier=tier), fault_injector=inj,
+        auto_recover=True, debug_bundle_dir=str(tmp_path))
+    rids = [router.submit(p, max_new_tokens=10) for p in prompts]
+    out = router.run_to_completion()
+
+    assert inj.fired_total >= 1, "the death fault never fired"
+    for i, rid in enumerate(rids):
+        assert out[rid] == refs[i], f"request {i} diverged after recovery"
+    s = router.stats()
+    assert s["replica_state"]["0"] == REPLICA_FAILED
+    assert s["recoveries"] == 1
+    assert s["recovered_requests"] >= 1, \
+        "the dead replica held no in-flight streams — the fault hit nothing"
+    assert s["finished"] == len(rids)
+    lost = s["requests"] - s["finished"]
+    assert lost == 0, f"{lost} request(s) lost to the crash"
+    # the on-FAILED debug bundle is automatic
+    bundle = tmp_path / "replica-0-failed.json"
+    assert bundle.exists(), "no debug bundle on the FAILED transition"
+    from neuronx_distributed_inference_tpu.utils.flight_recorder import (
+        load_bundle)
+    b = load_bundle(str(bundle))
+    assert b["reason"].startswith("replica_failed:death")
+    assert b["extra"]["replica"] == "0"
+    # dead-replica metrics: failures counted by reason, state gauge at 2
+    assert s["failures"] >= 1
+    text = router.prometheus_text()
+    assert 'router_replica_failures_total{replica="0",reason="death"} 1' \
+        in text
+    assert 'serving_replica_state{replica="0"} 2.0' in text
+    assert 'faults_injected_total{kind="death",replica="0"} 1' in text
+
+
+def test_recover_then_reactivate_with_fresh_runner(
+        tiny_llama_hf_config, app, tmp_path):
+    """FAILED → recover → reactivate round trip (satellite): a FAILED
+    replica cannot rejoin in place (its runner holds the dead roster); a
+    FRESH runner under the same id rejoins, takes placements, and serves
+    bit-exactly."""
+    prompts = _prompts(37, (11, 14, 13, 16))
+    refs = _reference(app, prompts, max_new=8)
+    inj = FaultInjector("death@0:at_step=2")
+    router = PrefixAffinityRouter(_replicas(app, 2), fault_injector=inj,
+                                  auto_recover=True)
+    rids = [router.submit(p, max_new_tokens=8) for p in prompts[:2]]
+    out = router.run_to_completion()
+    assert router.replica_state("0") == REPLICA_FAILED
+    # in-place reactivation of a FAILED replica is refused
+    with pytest.raises(ValueError, match="fresh"):
+        router.reactivate_replica("0")
+    # geometry-mismatched replacements are refused too
+    with pytest.raises(ValueError, match="id"):
+        router.reactivate_replica("0", replica=_replica(app, "9"))
+    router.reactivate_replica("0", replica=_replica(app, "0"))
+    assert router.replica_state("0") == REPLICA_HEALTHY
+    # the revived id serves again: drain the OTHER replica so placement has
+    # nowhere else to go
+    router.drain_replica("1")
+    rids += [router.submit(p, max_new_tokens=8) for p in prompts[2:]]
+    router.place_queued()
+    for rid in rids[2:]:
+        assert router.requests[rid].replica == "0"
+    out = router.run_to_completion()
+    for i, rid in enumerate(rids):
+        assert out[rid] == refs[i]
+    assert router.stats()["finished"] == len(rids)
+
+
+def test_drain_reactivate_round_trip_placement_resumes(
+        tiny_llama_hf_config, app):
+    """Drain → reactivate round trip (satellite): a drained replica
+    reactivates IN PLACE and immediately takes placements again."""
+    prompts = _prompts(41, (10, 15))
+    refs = _reference(app, prompts, max_new=6)
+    router = PrefixAffinityRouter(_replicas(app, 2))
+    r0 = router.submit(prompts[0], max_new_tokens=6)
+    router.step()
+    victim = router.requests[r0].replica
+    router.drain_replica(victim)
+    assert not router._placeable(router.replicas[victim])
+    router.reactivate_replica(victim)
+    assert router.replica_state(victim) == REPLICA_HEALTHY
+    # drain the other replica: the reactivated one must take the placement
+    other = next(r for r in router.replicas if r != victim)
+    router.drain_replica(other)
+    r1 = router.submit(prompts[1], max_new_tokens=6)
+    router.place_queued()
+    assert router.requests[r1].replica == victim
+    out = router.run_to_completion()
+    assert out[r0] == refs[0] and out[r1] == refs[1]
+
+
+# ------------------------------------------------------- corruption/truncation
+@pytest.mark.parametrize("kind", ["corrupt", "truncate"])
+def test_host_tier_corruption_trips_checksum_and_reprefills(
+        tiny_llama_hf_config, kind):
+    """Integrity: a corrupted/truncated host-tier entry must trip the
+    readmit checksum — the entry drops (counted), the prompt RE-PREFILLS
+    the block, and the stream completes bit-exactly instead of serving
+    garbage KV."""
+    app = _make_app(tiny_llama_hf_config)
+    rng = np.random.default_rng(43)
+    prefix = rng.integers(1, 256, size=(2 * BS,)).astype(np.int32)
+    pa = np.concatenate([prefix,
+                         rng.integers(1, 256, size=(4,)).astype(np.int32)])
+    pb = np.concatenate([prefix,
+                         rng.integers(1, 256, size=(6,)).astype(np.int32)])
+    (ref_a, ref_b) = _reference(app, [pa, pb], max_new=8)
+
+    tier = HostKVTier(capacity_blocks=32)
+    # at_step=1 + empty store pins the "at or AFTER" schedule semantics:
+    # the mutation stays armed past step 1 and fires at the first step
+    # where the tier actually holds bytes, exactly once
+    inj = FaultInjector(f"{kind}@0:at_step=1", seed=7)
+    router = PrefixAffinityRouter(_replicas(app, 1, tier=tier),
+                                  fault_injector=inj)
+    ra = router.submit(pa, max_new_tokens=8)
+    router.run_to_completion()
+    # spill the committed prefix to host RAM, then corrupt ONE entry on the
+    # next step (the injector fires before placement walks the tier)
+    spilled = router.replicas["0"].runner.spill_idle_blocks()
+    assert spilled >= 2, "no committed prefix to spill"
+    rb = router.submit(pb, max_new_tokens=8)
+    out = router.run_to_completion()
+    assert inj.fired_total == 1, "the corruption never fired"
+    assert tier.integrity_failures == 1, \
+        "the checksum did not trip on the mutated entry"
+    assert out[ra] == ref_a and out[rb] == ref_b, \
+        "stream diverged — corrupt KV bytes were served"
+    # the corrupt entry (and, chain order, anything after it) re-prefilled
+    # rather than re-admitting; never all of the spilled blocks came back
+    assert tier.readmit_blocks < spilled
+    # the engine exports a per-replica VIEW of the tier's integrity counter
+    # (gauge — a shared tier repeats under every label; the authoritative
+    # counter is tier.stats(), which bench publishes)
+    text = router.prometheus_text()
+    assert 'serving_kv_tier_integrity_failures{replica="0"} 1.0' in text
+
+
+# --------------------------------------------------------------- exhaustion
+def test_placement_kv_exhaustion_preempts_and_requeues_not_raises(
+        tiny_llama_hf_config):
+    """The kv_tiering 'out of KV blocks' hard crash is now preempt-or-shed:
+    an allocation failure during placement un-places the request (queue
+    front), counts a visible fall-through, and serving continues to the
+    exact streams."""
+    app = _make_app(tiny_llama_hf_config)
+    prompts = _prompts(47, (12, 14))
+    refs = _reference(app, prompts, max_new=8)
+    tier = HostKVTier(capacity_blocks=16)
+    runner = ContinuousBatchingRunner(app, decode_chunk=4, kv_tier=tier)
+    r0 = runner.submit(prompts[0], max_new_tokens=8)
+    runner.step()
+    # inject one exhaustion into the NEXT allocation (the second request's
+    # placement) — the old code let this RuntimeError kill the serving loop
+    real = runner.allocator._alloc_one
+    state = {"armed": True}
+
+    def _alloc_once():
+        if state["armed"]:
+            state["armed"] = False
+            raise KVBlocksExhausted("out of KV blocks (test)")
+        return real()
+
+    runner.allocator._alloc_one = _alloc_once
+    r1 = runner.submit(prompts[1], max_new_tokens=8)
+    out = dict(runner.run_to_completion())
+    assert runner.finished[r0].generated == refs[0]
+    assert runner.finished[r1].generated == refs[1]
+    ft = runner.telemetry.registry.get(
+        "serving_fallthrough_total",
+        labels={"from": "place", "reason": "kv_exhausted"})
+    assert ft is not None and ft.value == 1, \
+        "the exhaustion fall-through was not counted"
+
+
+def test_router_alloc_injection_survives(tiny_llama_hf_config, app):
+    """Router-level: an injected allocator failure anywhere in a replica's
+    step (placement or growth) degrades — preempt/requeue — and every
+    stream still matches its reference."""
+    prompts = _prompts(53, (12, 16, 11))
+    refs = _reference(app, prompts, max_new=8)
+    inj = FaultInjector("alloc@0:at_step=2")
+    router = PrefixAffinityRouter(_replicas(app, 2), fault_injector=inj)
+    rids = [router.submit(p, max_new_tokens=8) for p in prompts]
+    out = router.run_to_completion()
+    for i, rid in enumerate(rids):
+        assert out[rid] == refs[i]
+    assert router.stats()["finished"] == len(rids)
+
+
+def test_shed_by_slo_signal_instead_of_queueing_into_a_wedge(
+        tiny_llama_hf_config, app):
+    """Graceful degradation: past shed_queue_depth with the SLO signal
+    unhealthy, submit() sheds (typed, counted) instead of queueing forever."""
+    healthy = {"v": False}
+    router = PrefixAffinityRouter(
+        _replicas(app, 1), shed_queue_depth=2,
+        slo_signal=lambda: healthy["v"])
+    router.drain_replica("0")            # nothing placeable: queue builds
+    p = _prompts(59, (10, 10, 10))
+    router.submit(p[0], max_new_tokens=4)
+    router.submit(p[1], max_new_tokens=4)
+    with pytest.raises(RouterOverloaded):
+        router.submit(p[2], max_new_tokens=4)
+    assert router.stats()["shed"] == 1
+    # a healthy SLO signal lifts the shed (the queue is deep but serving)
+    healthy["v"] = True
+    router.submit(p[2], max_new_tokens=4)
+    router.reactivate_replica("0")
+    router.run_to_completion()
+    assert router.stats()["finished"] == 3
+
+
+# ------------------------------------------------- retry/backoff + watchdog
+def test_transient_exception_retries_with_backoff_and_heals(
+        tiny_llama_hf_config, app):
+    """A transient dispatch exception DEGRADES the replica (counted, backed
+    off), the retry succeeds, the replica heals to HEALTHY, and the streams
+    are exact."""
+    prompts = _prompts(61, (12, 15, 11, 13))
+    refs = _reference(app, prompts, max_new=8)
+    inj = FaultInjector("exception@0:at_step=2")
+    router = PrefixAffinityRouter(_replicas(app, 2), fault_injector=inj)
+    rids = [router.submit(p, max_new_tokens=8) for p in prompts]
+    seen_degraded = False
+    guard = 0
+    while router.has_work:
+        router.step()
+        seen_degraded |= router.replica_state("0") == REPLICA_DEGRADED
+        guard += 1
+        assert guard < 500
+    out = {rid: req.generated for rid, req in router.requests.items()}
+    assert inj.fired_total == 1
+    assert seen_degraded, "the failure never degraded the replica"
+    assert router.replica_state("0") == REPLICA_HEALTHY, \
+        "the replica did not heal after the successful retry"
+    for i, rid in enumerate(rids):
+        assert out[rid] == refs[i]
+    s = router.stats()
+    assert s["failures"] == 1 and s["finished"] == len(rids)
+    assert 'router_replica_failures_total{replica="0",reason="exception"} 1' \
+        in router.prometheus_text()
+
+
+def test_repeated_failure_exhausts_retries_to_failed(
+        tiny_llama_hf_config, app, tmp_path):
+    """max_retries bounds the retry loop: a replica that keeps throwing goes
+    FAILED (bundle dumped), and the fleet finishes on the survivor."""
+    prompts = _prompts(67, (12, 14))
+    refs = _reference(app, prompts, max_new=8)
+    inj = FaultInjector("exception@0:every_n=1,once=0")
+    router = PrefixAffinityRouter(
+        _replicas(app, 2), fault_injector=inj, max_retries=2,
+        auto_recover=True, debug_bundle_dir=str(tmp_path))
+    rids = [router.submit(p, max_new_tokens=8) for p in prompts]
+    out = router.run_to_completion()
+    assert router.replica_state("0") == REPLICA_FAILED
+    assert (tmp_path / "replica-0-failed.json").exists()
+    for i, rid in enumerate(rids):
+        assert out[rid] == refs[i]
+    s = router.stats()
+    assert s["failures"] == 3           # max_retries=2 + the failing one
+    assert s["finished"] == len(rids)
+    assert s["recovery_times_ms"], "recover_replica never timed itself"
+
+
+def test_watchdog_declares_wall_clock_stall(tiny_llama_hf_config, app,
+                                            tmp_path):
+    """The wall-clock watchdog (the router-level dispatch-gap signal): a
+    wedged dispatch that still returns counts as a stall failure; repeated
+    stalls FAIL the replica and its streams recover on the survivor."""
+    _warm(app)                           # timing below excludes compiles
+    prompts = _prompts(71, (12, 15))
+    refs = _reference(app, prompts, max_new=8)
+    inj = FaultInjector("stall@0:every_n=1,once=0,stall_ms=400")
+    router = PrefixAffinityRouter(
+        _replicas(app, 2), fault_injector=inj, max_retries=1,
+        watchdog_stall_s=0.2, auto_recover=True,
+        debug_bundle_dir=str(tmp_path))
+    rids = [router.submit(p, max_new_tokens=8) for p in prompts]
+    out = router.run_to_completion()
+    assert router.replica_state("0") == REPLICA_FAILED
+    text = router.prometheus_text()
+    assert 'router_replica_failures_total{replica="0",reason="stall"} 2' \
+        in text
+    for i, rid in enumerate(rids):
+        assert out[rid] == refs[i]
+    assert router.stats()["finished"] == len(rids)
+
+
+# -------------------------------------------------------- characterization
+def test_replica_exception_no_longer_propagates_out_of_step(
+        tiny_llama_hf_config, app):
+    """Characterization (the pre-ISSUE-11 failure mode): one exception
+    inside a replica step used to propagate out of router.step() and kill
+    the frontend. Now it is supervised."""
+    router = PrefixAffinityRouter(_replicas(app, 2))
+    rid = router.submit(_prompts(73, (12,))[0], max_new_tokens=6)
+    router.place_queued()
+    victim = router.requests[rid].replica
+
+    def _boom(key=None):
+        raise RuntimeError("synthetic replica fault")
+
+    router.replicas[victim].step = _boom
+    out = router.step()                   # must NOT raise
+    assert isinstance(out, dict)
+    assert router.replica_state(victim) == REPLICA_DEGRADED
+    assert router.stats()["failures"] == 1
+
+
+def test_run_to_completion_diagnostic_snapshot_on_wedge(
+        tiny_llama_hf_config, app):
+    """Satellite: the non-convergence error carries a diagnostic snapshot
+    (queue depth, per-replica state/work/in-flight ids) — a wedged fleet is
+    debuggable from the exception alone."""
+    router = PrefixAffinityRouter(_replicas(app, 1))
+    router.drain_replica("0")             # nothing placeable, queue wedges
+    router.submit(_prompts(79, (10,))[0], max_new_tokens=4)
+    with pytest.raises(RuntimeError) as ei:
+        router.run_to_completion(max_steps=3)
+    msg = str(ei.value)
+    assert "diagnostic" in msg and '"queue_depth": 1' in msg
+    assert '"state": "healthy"' in msg and '"draining": true' in msg
+    assert '"queued_request_ids": [0]' in msg
+
+
+def test_lost_affinity_to_non_healthy_holder_is_counted(
+        tiny_llama_hf_config, app):
+    """Satellite: a request whose best prefix holder is draining re-scores
+    against the healthy set — placed elsewhere, and the lost hit counted
+    (router_affinity_unavailable_total), never placed on the drainer."""
+    # per-replica tiers: a SHARED tier would hand the drained replica's
+    # spilled prefix to the survivor (that's the shared tier working as
+    # designed), and no affinity would be lost at all
+    router = PrefixAffinityRouter(
+        [_replica(app, i, tier=HostKVTier(capacity_blocks=32))
+         for i in range(2)])
+    rng = np.random.default_rng(83)
+    prefix = rng.integers(1, 256, size=(2 * BS,)).astype(np.int32)
+    pa = np.concatenate([prefix,
+                         rng.integers(1, 256, size=(3,)).astype(np.int32)])
+    pb = np.concatenate([prefix,
+                         rng.integers(1, 256, size=(5,)).astype(np.int32)])
+    ra = router.submit(pa, max_new_tokens=4)
+    router.run_to_completion()
+    holder = router.requests[ra].replica
+    router.drain_replica(holder)          # the prefix holder leaves
+    rb = router.submit(pb, max_new_tokens=4)
+    router.place_queued()
+    assert router.requests[rb].replica != holder, \
+        "placed onto a non-healthy replica"
+    assert router.stats()["affinity_unavailable"] == 1
+    router.run_to_completion()

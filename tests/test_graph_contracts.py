@@ -375,6 +375,9 @@ def test_changed_mode_scope_map_fails_closed():
     # full fleet (a new serving module must widen the audit, never shrink it)
     assert mod._scopes_for_changes([pkg + "serving/router.py"]) == []
     assert mod._scopes_for_changes([pkg + "serving/engine.py"]) == []
+    # ISSUE-11: the fault injector wraps replica seams on the host —
+    # lint-only, like router/engine
+    assert mod._scopes_for_changes([pkg + "serving/faults.py"]) == []
     assert set(mod._scopes_for_changes([pkg + "serving/kv_tiering.py"])) == {
         "serving_tier", "cb_paged", "cb_mixed", "cb_megastep", "cb_spec",
         "cb_eagle"}

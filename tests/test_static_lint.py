@@ -392,6 +392,72 @@ def test_unmarked_loop_body_not_held_to_step_rules():
     assert _rules(fs) == []
 
 
+def test_silent_except_flagged_in_serving_and_runtime():
+    """ISSUE-11 fixture: a swallowed exception in serving/runtime code is a
+    recovery path that silently stopped recovering."""
+    src = """
+        def f(self, x):
+            try:
+                return self.go(x)
+            except RuntimeError:
+                pass
+    """
+    for rel in ("serving/fake.py", "runtime/fake.py"):
+        assert _rules(_run(src, rel)) == ["silent-except"], rel
+    # a bare except that swallows is flagged too
+    fs = _run("""
+        def f(self, x):
+            try:
+                return self.go(x)
+            except:
+                x = None
+    """, rel="serving/fake.py")
+    assert _rules(fs) == ["silent-except"]
+    # outside the serving/runtime scope the rule stays quiet
+    assert _rules(_run(src, "ops/fake.py")) == []
+
+
+def test_silent_except_visible_handlers_pass():
+    """Re-raise, a logged reason, or a metrics counter each make the handler
+    non-silent — the three sanctioned degradation shapes."""
+    fs = _run("""
+        import logging
+
+        logger = logging.getLogger("x")
+
+        def f(self, x):
+            try:
+                return self.go(x)
+            except ValueError:
+                logger.warning("go failed on %s", x)
+            try:
+                return self.go(x)
+            except RuntimeError:
+                self._c_failures.inc()
+            try:
+                return self.go(x)
+            except KeyError as e:
+                if x:
+                    raise
+    """, rel="serving/fake.py")
+    assert "silent-except" not in _rules(fs), fs
+
+
+def test_silent_except_waiver_reported_not_silent():
+    fs = _run("""
+        def f(self, x):
+            try:
+                return self.go(x)
+            # lint: ok(silent-except): probe of optional state; absence is the answer
+            except AttributeError:
+                pass
+    """, rel="runtime/fake.py")
+    assert _rules(fs) == []
+    waived = [f for f in fs if f.status == "waived"
+              and f.rule == "silent-except"]
+    assert len(waived) == 1 and "absence is the answer" in waived[0].reason
+
+
 # ------------------------------------------------------------------ whole tree
 def test_package_lint_clean():
     """The shipped tree carries ZERO unwaived lint findings — and every waiver
