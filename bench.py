@@ -1153,10 +1153,20 @@ def _paged_arrival_serving(app, batch, closed_loop_tok_s):
                                prefill_token_budget=256,
                                mixed_decode_steps=8)),
     ]
+    events_jsonl = "/tmp/bench_arrival_events.jsonl"
     for name, kw in variants:
         # telemetry ON: the phase reads TTFT percentiles and token counts off
-        # runner.stats() instead of hand-rolled birth/emit bookkeeping
-        runner = ContinuousBatchingRunner(app, telemetry=True, **kw)
+        # runner.stats() instead of hand-rolled birth/emit bookkeeping. The
+        # serving (mixed) variant additionally spools its event log so the
+        # phase ships an explain_request.py-ready artifact.
+        if name == "arrival_mixed":
+            from neuronx_distributed_inference_tpu.utils.metrics import (
+                ServingTelemetry)
+
+            telemetry = ServingTelemetry(jsonl_path=events_jsonl)
+        else:
+            telemetry = True
+        runner = ContinuousBatchingRunner(app, telemetry=telemetry, **kw)
         # warm every executable this schedule touches (insert windows / mixed
         # dispatch / plain chunks) outside the measured trace
         for p in warm:
@@ -1173,6 +1183,26 @@ def _paged_arrival_serving(app, batch, closed_loop_tok_s):
         out[f"{name}_ttft_p99_ms"] = round(s["ttft_ms"]["latency_ms_p99"], 1)
         out[f"{name}_queue_wait_p99_ms"] = round(
             s["queue_wait_ms"]["latency_ms_p99"], 1)
+        if name == "arrival_mixed":
+            # TRACE HONESTY GUARD (r5 pattern): every request of the phase
+            # must reconstruct into a complete causal span tree whose
+            # latency waterfall reconciles to the recorded TTFT/E2E within
+            # 5% — otherwise the phase's latency keys describe requests the
+            # event stream cannot actually explain, and the trace keys
+            # refuse to publish.
+            from neuronx_distributed_inference_tpu.serving import tracing
+
+            cov = tracing.validate_coverage(runner.telemetry, tolerance=0.05)
+            runner.telemetry.close()
+            if cov["ok"]:
+                out["arrival_trace_coverage"] = 1.0
+                out["arrival_trace_requests"] = cov["requests"]
+                out["arrival_waterfall_max_residual_frac"] = \
+                    cov["max_residual_frac"]
+                out["arrival_events_jsonl"] = events_jsonl
+            else:
+                out["trace_coverage_invalid"] = cov["reason"]
+                _note(f"arrival trace coverage INVALID: {cov['reason']}")
         _drain_runner(runner)
         del runner
         gc.collect()

@@ -652,10 +652,12 @@ def _run_serving(args, app, tokenizer) -> None:
         slo_monitor = SLOMonitor(telemetry, SLOConfig.parse(args.slo))
 
     def _dump_bundle(reason: str) -> str:
+        from .serving import tracing
+
         return telemetry.flight.dump_bundle(
             args.debug_bundle, config=app.tpu_config,
             metrics=telemetry.registry.to_dict(), stats=runner.stats(),
-            reason=reason)
+            spans=tracing.inflight_span_trees_safe(telemetry), reason=reason)
 
     if args.debug_bundle:
         from .utils.flight_recorder import install_signal_dump
@@ -781,12 +783,15 @@ def _run_serving_routed(args, app, tokenizer) -> None:
                         for rep in replicas]
 
     def _dump_bundles(reason: str):
+        from .serving import tracing
+
         paths = []
         for rep in replicas:
             paths.append(rep.runner.telemetry.flight.dump_bundle(
                 f"{args.debug_bundle}.replica{rep.replica_id}",
                 config=app.tpu_config,
                 metrics=rep.registry.to_dict(),
+                spans=tracing.inflight_span_trees_safe(rep.runner.telemetry),
                 stats=rep.stats(), reason=reason))
         return paths
 
@@ -862,11 +867,25 @@ def _run_serving_routed(args, app, tokenizer) -> None:
             f.write(router.prometheus_text())
         logger.info("wrote merged Prometheus metrics to %s", args.metrics_out)
     if args.trace_out:
+        from .serving import tracing
+
         for rep in replicas:
             path = f"{args.trace_out}.replica{rep.replica_id}"
             rep.runner.telemetry.write_chrome_trace(path)
             logger.info("wrote replica %s Chrome trace to %s",
                         rep.replica_id, path)
+        # the fleet-merged view: router + every replica on ONE shared epoch
+        # clock, replica-prefixed tracks (serving/tracing.py — supersedes
+        # the per-replica-only exports this path used to settle for)
+        tracing.write_merged_chrome_trace(
+            args.trace_out, [rep.trace_source() for rep in replicas],
+            router.trace_source())
+        logger.info("wrote fleet-merged Chrome trace to %s", args.trace_out)
+    if args.events_out:
+        # the router journal rides next to the per-replica spools so
+        # scripts/explain_request.py can rebuild fleet traces offline
+        path = router.write_trace_events(f"{args.events_out}.router")
+        logger.info("wrote router trace journal to %s", path)
     for rep in replicas:
         rep.runner.telemetry.close()
 

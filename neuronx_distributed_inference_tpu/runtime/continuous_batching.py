@@ -1369,11 +1369,14 @@ class ContinuousBatchingRunner:
         idx = jnp.asarray(block_ids, dtype=jnp.int32)
         return self.cache["k"][:, idx], self.cache["v"][:, idx]
 
-    def _dispatch_readmits(self) -> None:
+    def _dispatch_readmits(self, for_request: Optional[int] = None) -> None:
         """Scatter queued host-tier blocks back into the paged pool — ONE
         bucketed ``cb.paged.tier_readmit`` dispatch, issued BEFORE the
         requesting prompt's first insert window so the windows (and every
-        later decode) read the restored prefix through the block table."""
+        later decode) read the restored prefix through the block table.
+        ``for_request`` stamps the step-timeline record with the request
+        whose prefix walk reserved the bytes, so its span tree
+        (serving/tracing.py) carries the readmit as its own."""
         if self.kv_tier is None:
             return
         pending = self.allocator.take_pending_readmits()
@@ -1418,7 +1421,8 @@ class ContinuousBatchingRunner:
                     prefill_tokens=len(ids) * self.block_size,
                     slots=self.num_slots,
                     kv_free=self.allocator.num_free,
-                    kv_total=self.allocator.num_blocks)
+                    kv_total=self.allocator.num_blocks,
+                    request_id=for_request)
 
     def _free_blocks(self, req: Request) -> None:
         """Release a request's blocks. With the tiered allocator a mid-prompt
@@ -1705,7 +1709,8 @@ class ContinuousBatchingRunner:
                eos_token_id: Optional[int] = None,
                sampling_params=None, adapter_id: int = 0,
                arrival_ts: Optional[float] = None,
-               resume_tokens: Optional[Sequence[int]] = None) -> int:
+               resume_tokens: Optional[Sequence[int]] = None,
+               trace_id: Optional[str] = None) -> int:
         """``sampling_params``: per-request (3,) [top_k, top_p, temperature]
         (≈ reference per-request sampling, `generation/sampling.py:99-209`);
         ``adapter_id``: multi-LoRA slot, 0 = base (≈ CB forward adapter_ids,
@@ -1717,7 +1722,11 @@ class ContinuousBatchingRunner:
         (cross-replica migration, serving/router.py) — the request enters the
         same resume path a preempted request takes (KV recomputed from
         prompt + resume_tokens at placement; none of them re-emitted), so a
-        migrated stream continues exactly where the source replica stopped."""
+        migrated stream continues exactly where the source replica stopped;
+        ``trace_id``: request-scoped trace context (serving/tracing.py) —
+        the router threads its frontend-minted id here so this runner's
+        lifecycle events stay joinable with the other replicas' into one
+        causal span tree (default: the telemetry mints a local one)."""
         prompt = np.asarray(prompt).astype(np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
@@ -1782,7 +1791,8 @@ class ContinuousBatchingRunner:
         self._next_id += 1
         self.queue.append(req)
         self.telemetry.request_arrival(req.request_id, int(prompt.size),
-                                       max_new_tokens, ts=arrival_ts)
+                                       max_new_tokens, ts=arrival_ts,
+                                       trace_id=trace_id)
         return req.request_id
 
     def _row_greedy(self, req: Request) -> bool:
@@ -2791,7 +2801,7 @@ class ContinuousBatchingRunner:
         self.block_table[slot, : len(req.blocks)] = req.blocks
         # host-tier prefix hits: restore the spilled blocks BEFORE any insert
         # window dispatches (the windows' queries read them via the table)
-        self._dispatch_readmits()
+        self._dispatch_readmits(for_request=req.request_id)
         req.fed = fed
         req.insert_pos = cached_len
         req.tok0_dev = None

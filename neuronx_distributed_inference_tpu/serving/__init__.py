@@ -19,6 +19,12 @@ mesh cannot be millions of users"):
   injection over the seams above (dispatch exceptions, wedged dispatches,
   hard replica death, allocation failure, host-tier corruption), so the
   router's supervision/recovery paths are exercised, not hoped for.
+- ``tracing``: fleet-scope request tracing — trace ids minted at
+  ``router.submit()`` and threaded through placement, causal span trees
+  rebuilt from the telemetry event streams + router journal (continuity
+  across drain/migration AND ``recover_replica``), a fleet-merged Perfetto
+  export on one shared epoch clock, and the latency-waterfall explainer
+  behind ``scripts/explain_request.py``.
 
 Replicas are plain Python objects over independent runners, so "N replicas"
 can mean N sub-meshes on one host (the dryrun harness fakes 8 devices) or,
@@ -26,6 +32,7 @@ later, N hosts behind the gloo launcher — the router only speaks the
 admission interface.
 """
 
+from . import tracing
 from .engine import EngineReplica
 from .faults import (FaultInjector, FaultSpec, InjectedFault,
                      InjectedReplicaDeath)
@@ -36,4 +43,4 @@ from .router import (PrefixAffinityRouter, RouterOverloaded, RouterRequest,
 __all__ = ["EngineReplica", "HostKVTier", "PrefixAffinityRouter",
            "RouterRequest", "RouterOverloaded", "FaultInjector", "FaultSpec",
            "InjectedFault", "InjectedReplicaDeath", "REPLICA_HEALTHY",
-           "REPLICA_DEGRADED", "REPLICA_FAILED"]
+           "REPLICA_DEGRADED", "REPLICA_FAILED", "tracing"]

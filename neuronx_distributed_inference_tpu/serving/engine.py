@@ -229,5 +229,14 @@ class EngineReplica:
         self.draining = False
         self._g_accepting.set(1)
 
-    def prometheus_text(self) -> str:
-        return self.registry.prometheus_text()
+    def prometheus_text(self, exemplars: bool = False) -> str:
+        return self.registry.prometheus_text(exemplars=exemplars)
+
+    def trace_source(self) -> Dict[str, object]:
+        """This replica's telemetry stream as a tracing source
+        (serving/tracing.py): the ``replica<id>``-named events/steps/epoch
+        triple the fleet-merge and span-tree builders consume."""
+        from . import tracing
+
+        return tracing.source_from_telemetry(f"replica{self.replica_id}",
+                                             self.runner.telemetry)

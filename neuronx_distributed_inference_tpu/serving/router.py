@@ -112,6 +112,10 @@ class RouterRequest:
     done: bool = False
     migrations: int = 0
     affinity_blocks: int = 0             # resident blocks at placement time
+    # request-scoped trace context (serving/tracing.py): minted at submit,
+    # threaded through every placement so the replicas' lifecycle events and
+    # the router journal join into one causal span tree per request
+    trace_id: Optional[str] = None
 
 
 class PrefixAffinityRouter:
@@ -253,9 +257,59 @@ class PrefixAffinityRouter:
             "router_affinity_unavailable_total",
             "placements whose best prefix holder was draining/degraded/"
             "failed — re-scored against the healthy set, lost hit counted")
+        # --- request tracing (serving/tracing.py) ---------------------------
+        # the router journal doubles as the trace spine: trace ids are minted
+        # here and every placement / migration / recovery decision is an
+        # event, so a request's history survives any single replica's death
+        import uuid
+
+        self.trace_epoch = time.perf_counter()
+        self._trace_salt = uuid.uuid4().hex[:8]
+        self.trace_events: List[dict] = []
+        # in-memory retention bound, mirroring ServingTelemetry.max_records:
+        # past it the OLDEST quarter drops (counted — a long-lived frontend
+        # must not grow one journal dict per event forever; spool with
+        # write_trace_events for the full history)
+        self.max_trace_events = 200_000
+        self._c_trace_dropped = reg.counter(
+            "router_trace_events_dropped_total",
+            "journal events evicted past the in-memory retention bound")
         self.fault_injector = fault_injector
         if fault_injector is not None:
             fault_injector.attach(self)
+
+    # ------------------------------------------------------------- tracing
+    def _trace_event(self, event: str, req: Optional[RouterRequest] = None,
+                     **fields) -> None:
+        rec = {"ts": time.perf_counter() - self.trace_epoch, "event": event}
+        if req is not None:
+            rec["trace_id"] = req.trace_id
+            rec["request_id"] = req.request_id
+        rec.update(fields)
+        self.trace_events.append(rec)
+        if (self.max_trace_events is not None
+                and len(self.trace_events) > self.max_trace_events):
+            n = self.max_trace_events // 4
+            del self.trace_events[:n]
+            self._c_trace_dropped.inc(n)
+
+    def trace_source(self) -> Dict[str, object]:
+        """This journal as a tracing source (serving/tracing.py)."""
+        from . import tracing
+
+        return tracing.source_from_router(self)
+
+    def write_trace_events(self, path: str) -> str:
+        """Spool the router journal as JSONL (same epoch-header convention
+        the telemetry spools use, so scripts/explain_request.py merges the
+        files offline on the shared clock)."""
+        with open(path, "w") as fh:
+            fh.write(json.dumps({"event": "telemetry_epoch",
+                                 "epoch": self.trace_epoch,
+                                 "unix_ts": time.time()}) + "\n")
+            for rec in self.trace_events:
+                fh.write(json.dumps(rec) + "\n")
+        return path
 
     # ------------------------------------------------------------- lifecycle state
     def _set_state(self, rid: str, state: str) -> None:
@@ -286,6 +340,7 @@ class PrefixAffinityRouter:
             # signal at the frontend instead of queueing into a wedge —
             # counted, logged, surfaced to the caller as a typed error
             self._c_shed.inc()
+            self._trace_event("shed", queue_depth=len(self.queue))
             logger.warning(
                 "shedding arrival: frontend queue %d >= %d and the SLO "
                 "signal is unhealthy", len(self.queue), self.shed_queue_depth)
@@ -299,11 +354,14 @@ class PrefixAffinityRouter:
             adapter_id, arrival_ts,
             hashes=(prompt_block_hashes(prompt, self.block_size, adapter_id)
                     if self.paged else []))
+        req.trace_id = f"t-{self._trace_salt}-{req.request_id:06x}"
         self._next_id += 1
         self.requests[req.request_id] = req
         self.queue.append(req)
         self._c_submitted.inc()
         self._g_queue.set(len(self.queue))
+        self._trace_event("submit", req, prompt_len=int(prompt.size),
+                          max_new_tokens=max_new_tokens)
         return req.request_id
 
     # ------------------------------------------------------------- placement
@@ -401,7 +459,8 @@ class PrefixAffinityRouter:
                aff_blocks: int, lost: Optional[int]) -> None:
         kw = dict(max_new_tokens=req.max_new_tokens,
                   eos_token_id=req.eos_token_id,
-                  adapter_id=req.adapter_id, arrival_ts=req.arrival_ts)
+                  adapter_id=req.adapter_id, arrival_ts=req.arrival_ts,
+                  trace_id=req.trace_id)
         if req.sampling_params is not None:
             kw["sampling_params"] = req.sampling_params
         if req.generated:
@@ -411,6 +470,10 @@ class PrefixAffinityRouter:
         req.affinity_blocks = aff_blocks
         self._local[(rep.replica_id, req.local_id)] = req.request_id
         self._c_placed.inc()
+        self._trace_event("place", req, replica=rep.replica_id,
+                          local_id=req.local_id, affinity_blocks=aff_blocks,
+                          spilled_from=lost, migrations=req.migrations,
+                          policy=self.policy)
         if aff_blocks > 0:
             self._c_aff_hits.inc()
             self._c_aff_blocks.inc(aff_blocks)
@@ -516,6 +579,7 @@ class PrefixAffinityRouter:
         if self._health[rid] == REPLICA_FAILED:
             return
         self._set_state(rid, REPLICA_FAILED)
+        self._trace_event("replica_failed", replica=rid, reason=reason)
         logger.error("replica %s FAILED (%s): %s — %s", rid, reason,
                      exc if exc is not None else "watchdog/stall",
                      "auto-recovering its streams" if self.auto_recover
@@ -541,9 +605,15 @@ class PrefixAffinityRouter:
         path = os.path.join(self.debug_bundle_dir,
                             f"replica-{rid}-failed.json")
         try:
+            # the span trees of everything in flight on the dead replica at
+            # dump time: the post-mortem shows WHERE each stream was, not
+            # just that streams existed (serving/tracing.py)
+            from . import tracing
+
             out = flight.dump_bundle(
                 path, metrics=rep.registry.to_dict(), stats=None,
                 reason=f"replica_failed:{reason}",
+                spans=tracing.inflight_span_trees_safe(rep.runner.telemetry),
                 extra={"replica": rid, "exception": repr(exc),
                        "router_step": self._step_count,
                        "fail_streak": self._fail_streak[rid]})
@@ -569,6 +639,8 @@ class PrefixAffinityRouter:
         if local is not None and not req.done:
             req.done = True
             self._c_finished.inc()
+            self._trace_event("finish", req, replica=rid,
+                              tokens=len(req.generated))
 
     @property
     def has_work(self) -> bool:
@@ -648,6 +720,8 @@ class PrefixAffinityRouter:
             self.queue.insert(0, req)
             migrated += 1
             self._c_migrations.inc()
+            self._trace_event("migrate_out", req, from_replica=replica_id,
+                              tokens_so_far=len(req.generated))
         self._g_queue.set(len(self.queue))
         logger.info("drained replica %s: %d requests re-queued for migration",
                     replica_id, migrated)
@@ -690,6 +764,11 @@ class PrefixAffinityRouter:
             req.local_id = None
             req.migrations += 1
             moved.append(req)
+            # the journal is the ONLY witness of this window: the dead
+            # replica's own event log ends mid-stream, so the span tree
+            # synthesizes a `recovered` span from this event
+            self._trace_event("recover", req, from_replica=replica_id,
+                              resumed_tokens=len(req.generated))
         moved.sort(key=lambda r: r.request_id)       # arrival order
         for req in reversed(moved):
             self.queue.insert(0, req)                # resumes first

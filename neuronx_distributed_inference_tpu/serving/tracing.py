@@ -1,0 +1,690 @@
+"""Fleet-scope request tracing: causal span trees over the serving telemetry.
+
+The observability stack already records everything that happens to a request
+— lifecycle events (utils/metrics.ServingTelemetry), the per-dispatch step
+timeline, the router's placement decisions — but scattered across N replica
+event logs and the router journal. This module turns those streams into ONE
+causal span tree per request:
+
+- **Trace context**: a ``trace_id`` minted at ``router.submit()`` (or by a
+  standalone runner's telemetry) and threaded through placement →
+  ``EngineReplica.submit`` → ``ContinuousBatchingRunner.submit`` →
+  ``request_arrival``, so every event a request generates — on any replica it
+  ever runs on — carries one joinable key.
+- **Span trees**: per request, a root ``request`` span with ``queue_wait``,
+  ``placement``, per-window ``prefill_chunk`` spans *linked to the dispatch
+  step-timeline record that carried them* (so the PR 7 device-time
+  attribution splits them into host/gap/device), ``tier_readmit``,
+  ``preempt``/``resume``, a ``decode`` span with per-commit children, and a
+  ``finish`` reason.
+- **Continuity edges**: a request that migrates off a drained replica
+  resumes as a new SEGMENT with a ``migrated_from`` link; a request whose
+  replica DIED gets a synthesized ``recovered`` span built from the router's
+  own journal (the dead replica's log ends mid-stream; the trace doesn't).
+- **Clock model**: every telemetry stream timestamps against one process
+  clock (``time.perf_counter``) with a per-stream epoch (its ``_t0``).
+  Sources normalize onto the SHARED epoch by adding their epoch back —
+  that's the whole clock model, and it is what makes the fleet-merged
+  Perfetto export honest (JSONL spools carry a ``telemetry_epoch`` header
+  line so offline files merge the same way).
+- **Waterfall + reconciliation**: :func:`waterfall` decomposes a request's
+  TTFT/E2E into queue-wait / own-prefill / readmit / decode / interference /
+  dispatch-gap components measured independently from the step timeline; the
+  components must SUM to the recorded TTFT/E2E (a double-counted or
+  overlapping step record breaks the sum — reconciliation is the integrity
+  test, not a pretty-printer). ``scripts/explain_request.py`` is the CLI.
+
+Everything here is host-side post-processing over already-recorded events:
+the serving loop gains NO new work (and no new host syncs) from tracing —
+the only live-path additions are the trace-id string on arrival and the
+last-exemplar store on histogram observes, both gated on telemetry being
+enabled (tests/test_perf_regression.py pins the off path).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["source_from_telemetry", "source_from_router", "load_jsonl_source",
+           "build_trace_set", "build_fleet_traces", "validate_trace",
+           "validate_coverage", "waterfall", "inflight_span_trees",
+           "inflight_span_trees_safe", "merged_chrome_trace",
+           "write_merged_chrome_trace", "PREFILL_KINDS", "DECODE_KINDS"]
+
+# step-timeline kinds by role (the event→span classification key)
+PREFILL_KINDS = ("insert", "insert_window")
+DECODE_KINDS = ("decode", "spec_chunk", "megastep")
+MIXED_KINDS = ("mixed",)
+
+
+# ---------------------------------------------------------------- sources
+def source_from_telemetry(name: str, telemetry) -> dict:
+    """Wrap a live ServingTelemetry as a trace source (shares the lists —
+    build immediately, don't hold across a reset())."""
+    return {"name": name, "events": telemetry.events,
+            "steps": telemetry.steps, "epoch": telemetry.epoch}
+
+
+def source_from_router(router) -> dict:
+    """The router journal as a trace source (its placement/migration/recovery
+    events; it has no step timeline — the replicas dispatch)."""
+    return {"name": "router", "events": router.trace_events, "steps": [],
+            "epoch": router.trace_epoch}
+
+
+def load_jsonl_source(path: str, name: Optional[str] = None) -> dict:
+    """Read a ServingTelemetry JSONL spool (or a router journal dump) back
+    into a trace source. ``telemetry_epoch`` header lines set the clock
+    origin; a LATER epoch line marks a reset() — everything before it
+    belongs to a discarded measurement window and is dropped."""
+    events: List[dict] = []
+    steps: List[dict] = []
+    epoch = 0.0
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            ev = rec.get("event")
+            if ev == "telemetry_epoch":
+                events.clear()
+                steps.clear()
+                epoch = float(rec["epoch"])
+            elif ev == "step":
+                steps.append({k: v for k, v in rec.items() if k != "event"})
+            elif ev == "device_counters":
+                continue
+            else:
+                events.append(rec)
+    return {"name": name or path, "events": events, "steps": steps,
+            "epoch": epoch}
+
+
+# ---------------------------------------------------------------- span trees
+def _abs_steps(source: dict) -> List[dict]:
+    """Step records with absolute (shared-epoch) t0/t1, sorted by start."""
+    epoch = source.get("epoch", 0.0)
+    out = []
+    for i, s in enumerate(source.get("steps") or []):
+        t0 = s["ts"] + epoch
+        out.append({"index": i, "t0": t0, "t1": t0 + s.get("dur_s", 0.0),
+                    "kind": s.get("kind"), "request_id": s.get("request_id"),
+                    "tokens": s.get("tokens", 0),
+                    "prefill_tokens": s.get("prefill_tokens", 0)})
+    out.sort(key=lambda s: s["t0"])
+    return out
+
+
+def _carrying_step(steps_abs: List[dict], ts: float) -> Optional[dict]:
+    """The dispatch record that carried an event: the newest step whose host
+    span STARTED at or before the event (lifecycle events are emitted during
+    or immediately after the host span of the dispatch that produced them —
+    both orders occur in the runner, so matching on start is the invariant)."""
+    lo, hi = 0, len(steps_abs)
+    while lo < hi:
+        mid = (lo + hi) // 2
+        if steps_abs[mid]["t0"] <= ts:
+            lo = mid + 1
+        else:
+            hi = mid
+    return steps_abs[lo - 1] if lo else None
+
+
+def _device_split(kind: Optional[str], dur_ms: float,
+                  timing: Optional[Dict[str, dict]]) -> Optional[dict]:
+    """Split a span's host duration into device/gap components using the
+    PR 7 per-kind attribution ratios (None when no timing was profiled or
+    the backend reported no device events)."""
+    if not timing or kind is None:
+        return None
+    row = timing.get(kind)
+    if row is None and kind in PREFILL_KINDS:
+        # insert-family kinds are attributed under one merged "insert" row
+        # (runner._attr_family — per-kind rows would double-count shared
+        # insert events)
+        row = timing.get("insert")
+    if not row or not row.get("host_ms") or row.get("device_ms") is None:
+        return None
+    frac = min(1.0, row["device_ms"] / row["host_ms"])
+    return {"device_ms": round(dur_ms * frac, 3),
+            "host_gap_ms": round(dur_ms * (1.0 - frac), 3)}
+
+
+class _TreeBuilder:
+    def __init__(self, source_name: str):
+        self.spans: List[dict] = []
+        self.source = source_name
+
+    def add(self, name: str, kind: str, t0: float, t1: Optional[float],
+            parent: Optional[int], **attrs) -> int:
+        sid = len(self.spans)
+        self.spans.append({"id": sid, "parent": parent, "name": name,
+                           "kind": kind, "t0": t0, "t1": t1,
+                           "source": self.source,
+                           "attrs": {k: v for k, v in attrs.items()
+                                     if v is not None}})
+        return sid
+
+
+def build_trace_set(source: dict,
+                    timing: Optional[Dict[str, dict]] = None) -> dict:
+    """One telemetry stream → ``{"name", "steps": abs-steps,
+    "traces": {request_id: trace}}``.
+
+    A trace is ``{"trace_id", "request_id", "source", "complete", "spans",
+    "arrival_ts"/"placed_ts"/"first_token_ts"/"finish_ts"}`` with every span
+    parented under span 0 (the ``request`` root). ``complete`` means the
+    request finished — an in-flight request's open spans have ``t1: None``
+    (the span-leak check keys on this)."""
+    epoch = source.get("epoch", 0.0)
+    steps_abs = _abs_steps(source)
+    by_rid: Dict[int, List[dict]] = {}
+    for e in source.get("events") or []:
+        rid = e.get("request_id")
+        if rid is None:
+            continue
+        by_rid.setdefault(rid, []).append(e)
+
+    traces: Dict[int, dict] = {}
+    for rid, evs in by_rid.items():
+        evs = sorted(evs, key=lambda e: e["ts"])
+        arrival = next((e for e in evs if e["event"] == "arrival"), None)
+        if arrival is None:
+            continue          # trimmed log: no tree without a birth record
+        t_arr = arrival["ts"] + epoch
+        finish = next((e for e in evs if e["event"] == "finish"), None)
+        t_fin = finish["ts"] + epoch if finish is not None else None
+        tb = _TreeBuilder(source["name"])
+        root = tb.add("request", "request", t_arr, t_fin, None,
+                      trace_id=arrival.get("trace_id"),
+                      prompt_len=arrival.get("prompt_len"),
+                      max_new_tokens=arrival.get("max_new_tokens"),
+                      finish_reason=(finish.get("reason")
+                                     if finish is not None else None),
+                      tokens=(finish.get("tokens")
+                              if finish is not None else None))
+        placed = [e for e in evs if e["event"] == "placed"]
+        t_placed = placed[0]["ts"] + epoch if placed else None
+        tb.add("queue_wait", "queue_wait", t_arr, t_placed, root)
+        for e in placed:
+            t = e["ts"] + epoch
+            tb.add("resume" if e.get("resumed") else "placement",
+                   "placement", t, t, root, slot=e.get("slot"),
+                   resumed=e.get("resumed"))
+        for e in evs:
+            t = e["ts"] + epoch
+            if e["event"] == "preempted":
+                tb.add("preempt", "preempt", t, t, root)
+            elif e["event"] == "prefix_hit":
+                tb.add("prefix_hit", "prefix_hit", t, t, root,
+                       tokens=e.get("tokens"))
+            elif e["event"] == "prefill_chunk":
+                step = _carrying_step(steps_abs, t)
+                if step is not None and (step["kind"] in PREFILL_KINDS
+                                         or step["kind"] in MIXED_KINDS):
+                    dur_ms = (step["t1"] - step["t0"]) * 1e3
+                    tb.add("prefill_chunk", "prefill", step["t0"], step["t1"],
+                           root, tokens=e.get("tokens"), pos=e.get("pos"),
+                           step_kind=step["kind"], step_index=step["index"],
+                           device=_device_split(step["kind"], dur_ms, timing))
+                else:
+                    tb.add("prefill_chunk", "prefill", t, t, root,
+                           tokens=e.get("tokens"), pos=e.get("pos"))
+        # this request's own tier re-admissions (stamped by the runner)
+        for step in steps_abs:
+            if step["kind"] == "tier_readmit" and step["request_id"] == rid:
+                tb.add("tier_readmit", "tier_readmit", step["t0"], step["t1"],
+                       root, step_index=step["index"],
+                       tokens=step["prefill_tokens"])
+        first_tok = next((e for e in evs if e["event"] == "first_token"), None)
+        if first_tok is not None:
+            t_ft = first_tok["ts"] + epoch
+            commits = [e for e in evs if e["event"] == "commit"]
+            t_last = (commits[-1]["ts"] + epoch) if commits else t_ft
+            dec = tb.add("decode", "decode", t_ft,
+                         t_last if finish is not None else None, root,
+                         tokens=sum(e.get("tokens", 0) for e in commits))
+            for e in commits:
+                t = e["ts"] + epoch
+                step = _carrying_step(steps_abs, t)
+                tb.add("decode_commit", "decode_commit", t, t, dec,
+                       tokens=e.get("tokens"),
+                       step_kind=step["kind"] if step else None,
+                       step_index=step["index"] if step else None)
+        traces[rid] = {
+            "trace_id": arrival.get("trace_id"), "request_id": rid,
+            "source": source["name"], "complete": finish is not None,
+            "arrival_ts": t_arr, "placed_ts": t_placed,
+            "first_token_ts": (first_tok["ts"] + epoch
+                               if first_tok is not None else None),
+            "finish_ts": t_fin, "spans": tb.spans,
+        }
+    return {"name": source["name"], "steps": steps_abs, "traces": traces}
+
+
+# ---------------------------------------------------------------- validation
+def validate_trace(trace: dict) -> List[str]:
+    """Structural problems of one span tree: unparented (orphan) spans,
+    multiple roots, and — for COMPLETE traces — spans left open (the span
+    leak the finish/shed paths must not allow)."""
+    problems = []
+    ids = {s["id"] for s in trace["spans"]}
+    roots = [s for s in trace["spans"] if s["parent"] is None]
+    if len(roots) != 1:
+        problems.append(f"expected exactly 1 root span, got {len(roots)}")
+    for s in trace["spans"]:
+        if s["parent"] is not None and s["parent"] not in ids:
+            problems.append(f"orphan span {s['id']} ({s['name']}): parent "
+                            f"{s['parent']} missing")
+        if trace.get("complete") and s["t1"] is None:
+            problems.append(f"span {s['id']} ({s['name']}) open after finish")
+        if s["t1"] is not None and s["t1"] < s["t0"] - 1e-9:
+            problems.append(f"span {s['id']} ({s['name']}) ends before it "
+                            f"starts")
+    return problems
+
+
+# ---------------------------------------------------------------- waterfall
+def _clip(t0: float, t1: float, lo: float, hi: float) -> float:
+    return max(0.0, min(t1, hi) - max(t0, lo))
+
+
+def _union_len(intervals: List[Tuple[float, float]]) -> float:
+    total, end = 0.0, None
+    for a, b in sorted(intervals):
+        if end is None or a > end:
+            total += b - a
+            end = b
+        elif b > end:
+            total += b - end
+            end = b
+    return total
+
+
+def waterfall(trace: dict, steps_abs: List[dict],
+              timing: Optional[Dict[str, dict]] = None,
+              tolerance: float = 0.05) -> dict:
+    """Latency decomposition of one request from the step timeline.
+
+    Components (ms): ``queue_wait`` (arrival→placed), then — over
+    [placed, first_token] for TTFT and [placed, finish] for E2E — the
+    clipped host spans of every overlapping dispatch record, classified:
+
+    - ``prefill``: dispatches that carried THIS request's prefill windows
+      (linked via the span tree), plus its own ``tier_readmit`` restores
+      (reported separately as ``tier_readmit``);
+    - ``decode``: decode-family dispatches after this request's first token
+      (continuous batching advances every live row, ours included);
+    - ``decode_interference``: decode-family dispatches BEFORE our first
+      token (residents decoding while our prefill waits);
+    - ``prefill_interference``: insert-family dispatches carrying OTHER
+      requests' windows;
+    - ``dispatch_gap``: wall time covered by NO dispatch record (host
+      scheduling / commit / dispatch-floor time).
+
+    RECONCILIATION: ``dispatch_gap`` is measured independently (window minus
+    the UNION of dispatch intervals), so the component sum equals the
+    recorded TTFT/E2E only if the step records partition the timeline —
+    overlapping or double-counted records break the sum. ``reconciled`` is
+    the |sum − recorded| ≤ tolerance × recorded verdict for both windows."""
+    t_arr, t_placed = trace["arrival_ts"], trace["placed_ts"]
+    t_ft, t_fin = trace["first_token_ts"], trace["finish_ts"]
+    out = {"request_id": trace["request_id"], "trace_id": trace["trace_id"],
+           "complete": trace["complete"], "reconciled": False,
+           "ttft_ms": None, "e2e_ms": None}
+    if t_placed is None or t_ft is None:
+        out["error"] = "incomplete trace: no placement / first token"
+        return out
+    own_prefill_steps = {s["attrs"]["step_index"] for s in trace["spans"]
+                         if s["kind"] == "prefill"
+                         and "step_index" in s["attrs"]}
+    own_readmit_steps = {s["attrs"]["step_index"] for s in trace["spans"]
+                        if s["kind"] == "tier_readmit"
+                        and "step_index" in s["attrs"]}
+
+    def decompose(lo: float, hi: float) -> Dict[str, float]:
+        comp = {"queue_wait": (t_placed - t_arr) * 1e3, "prefill": 0.0,
+                "tier_readmit": 0.0, "decode": 0.0,
+                "decode_interference": 0.0, "prefill_interference": 0.0,
+                "dispatch_gap": 0.0}
+        by_kind: Dict[str, float] = {}
+        covered: List[Tuple[float, float]] = []
+        for s in steps_abs:
+            dur = _clip(s["t0"], s["t1"], lo, hi)
+            if dur <= 0.0:
+                continue
+            covered.append((max(s["t0"], lo), min(s["t1"], hi)))
+            kind = s["kind"]
+            if s["index"] in own_prefill_steps:
+                cat = "prefill"
+            elif s["index"] in own_readmit_steps:
+                cat = "tier_readmit"
+            elif kind in PREFILL_KINDS or kind == "tier_readmit":
+                cat = "prefill_interference"
+            elif max(s["t0"], lo) >= t_ft:
+                cat = "decode"
+            else:
+                cat = "decode_interference"
+            comp[cat] += dur * 1e3
+            by_kind[kind] = by_kind.get(kind, 0.0) + dur * 1e3
+        comp["dispatch_gap"] = ((hi - lo) - _union_len(covered)) * 1e3
+        comp["_by_kind"] = by_kind
+        return comp
+
+    ttft_ms = (t_ft - t_arr) * 1e3
+    out["ttft_ms"] = round(ttft_ms, 3)
+    ttft_comp = decompose(t_placed, t_ft)
+    by_kind_ttft = ttft_comp.pop("_by_kind")
+    ttft_sum = sum(ttft_comp.values())
+    out["ttft_components_ms"] = {k: round(v, 3)
+                                 for k, v in ttft_comp.items()}
+    out["ttft_residual_frac"] = (abs(ttft_sum - ttft_ms)
+                                 / max(ttft_ms, 1e-9))
+    ok = out["ttft_residual_frac"] <= tolerance
+    if trace["complete"] and t_fin is not None:
+        e2e_ms = (t_fin - t_arr) * 1e3
+        out["e2e_ms"] = round(e2e_ms, 3)
+        e2e_comp = decompose(t_placed, t_fin)
+        e2e_comp.pop("_by_kind")
+        e2e_sum = sum(e2e_comp.values())
+        out["e2e_components_ms"] = {k: round(v, 3)
+                                    for k, v in e2e_comp.items()}
+        out["e2e_residual_frac"] = (abs(e2e_sum - e2e_ms)
+                                    / max(e2e_ms, 1e-9))
+        ok = ok and out["e2e_residual_frac"] <= tolerance
+    if timing:
+        split = {}
+        for kind, ms in by_kind_ttft.items():
+            d = _device_split(kind, ms, timing)
+            if d is not None:
+                split[kind] = d
+        if split:
+            out["ttft_device_split_ms"] = split
+    out["reconciled"] = ok
+    return out
+
+
+def validate_coverage(telemetry, tolerance: float = 0.05,
+                      timing: Optional[Dict[str, dict]] = None,
+                      source_name: str = "runner") -> dict:
+    """The bench honesty guard: EVERY request in the telemetry's event log
+    must yield a complete, structurally valid span tree whose waterfall
+    reconciles within ``tolerance`` — otherwise the caller refuses to
+    publish (``trace_coverage_invalid``, the r5 pattern)."""
+    ts = build_trace_set(source_from_telemetry(source_name, telemetry),
+                         timing=timing)
+    incomplete, orphans, unreconciled = [], [], []
+    max_resid = 0.0
+    for rid, trace in sorted(ts["traces"].items()):
+        if not trace["complete"]:
+            incomplete.append(rid)
+            continue
+        if validate_trace(trace):
+            orphans.append(rid)
+            continue
+        wf = waterfall(trace, ts["steps"], timing=timing,
+                       tolerance=tolerance)
+        for key in ("ttft_residual_frac", "e2e_residual_frac"):
+            if wf.get(key) is not None:
+                max_resid = max(max_resid, wf[key])
+        if not wf["reconciled"]:
+            unreconciled.append(rid)
+    n = len(ts["traces"])
+    ok = n > 0 and not (incomplete or orphans or unreconciled)
+    reason = None
+    if n == 0:
+        reason = "no traced requests in the event log"
+    elif incomplete:
+        reason = f"incomplete span trees for requests {incomplete[:8]}"
+    elif orphans:
+        reason = f"structurally invalid trees for requests {orphans[:8]}"
+    elif unreconciled:
+        reason = (f"waterfall components do not reconcile within "
+                  f"{tolerance:.0%} for requests {unreconciled[:8]}")
+    return {"ok": ok, "requests": n, "incomplete": incomplete,
+            "orphans": orphans, "unreconciled": unreconciled,
+            "max_residual_frac": round(max_resid, 5), "reason": reason}
+
+
+def inflight_span_trees(telemetry) -> List[dict]:
+    """Span trees of every request still in flight — what the flight
+    recorder embeds in a debug bundle so a post-mortem shows exactly where
+    each live request was when the dump fired."""
+    ts = build_trace_set(source_from_telemetry("runner", telemetry))
+    return [t for _rid, t in sorted(ts["traces"].items())
+            if not t["complete"]]
+
+
+def inflight_span_trees_safe(telemetry) -> Optional[List[dict]]:
+    """The crash-path variant: every debug-bundle dump site enriches with
+    span trees THROUGH this guard, so a tracing failure can never mask the
+    fault being dumped (None = enrichment unavailable, bundle still lands)."""
+    try:
+        return inflight_span_trees(telemetry)
+    # lint: ok(silent-except): best-effort bundle enrichment on the crash path; the dump itself must never be masked by it
+    except Exception:
+        return None
+
+
+# ---------------------------------------------------------------- fleet merge
+def build_fleet_traces(replica_sources: Sequence[dict],
+                       router_source: Optional[dict] = None,
+                       timing: Optional[Dict[str, Dict[str, dict]]] = None
+                       ) -> Dict[str, dict]:
+    """Merge N replicas' span trees (plus the router journal) into one
+    fleet-level trace per ``trace_id``.
+
+    Each fleet trace has ONE root ``request`` span; each replica visit is a
+    ``segment:<replica>`` child (the replica-local tree re-parented under
+    it). Continuity edges: segment k>0 carries ``migrated_from``
+    (drain/migration) or ``recovered_from`` (the replica DIED — a
+    ``recovered`` span synthesized from the router journal covers the
+    failure-to-resubmit window, and the dead segment's open spans are closed
+    at the recovery boundary so the merged tree leaks nothing). Router
+    placement/queue spans ride under the root when a journal is given."""
+    sets = {src["name"]: build_trace_set(
+        src, timing=(timing or {}).get(src["name"]))
+        for src in replica_sources}
+    by_tid: Dict[str, List[dict]] = {}
+    for name, ts in sets.items():
+        for trace in ts["traces"].values():
+            tid = trace.get("trace_id")
+            if tid is not None:
+                by_tid.setdefault(tid, []).append(trace)
+    router_by_tid: Dict[str, List[dict]] = {}
+    r_epoch = router_source.get("epoch", 0.0) if router_source else 0.0
+    if router_source:
+        for e in router_source.get("events") or []:
+            tid = e.get("trace_id")
+            if tid is not None:
+                router_by_tid.setdefault(tid, []).append(e)
+    out: Dict[str, dict] = {}
+    for tid in set(by_tid) | set(router_by_tid):
+        segments = sorted(by_tid.get(tid, ()),
+                          key=lambda t: t["arrival_ts"])
+        r_evs = sorted(router_by_tid.get(tid, ()), key=lambda e: e["ts"])
+        submit = next((e for e in r_evs if e["event"] == "submit"), None)
+        r_finish = next((e for e in r_evs if e["event"] == "finish"), None)
+        t0 = (submit["ts"] + r_epoch if submit is not None
+              else segments[0]["arrival_ts"] if segments else 0.0)
+        fins = [s["finish_ts"] for s in segments if s["finish_ts"] is not None]
+        t1 = (r_finish["ts"] + r_epoch if r_finish is not None
+              else max(fins) if fins and segments[-1]["complete"] else None)
+        tb = _TreeBuilder("fleet")
+        root = tb.add("request", "request", t0, t1, None, trace_id=tid,
+                      segments=len(segments),
+                      frontend_request_id=(submit.get("request_id")
+                                           if submit else None))
+        # router-altitude spans: frontend queue wait + every placement
+        places = [e for e in r_evs if e["event"] == "place"]
+        if submit is not None:
+            tb.add("queue_wait", "queue_wait", t0,
+                   places[0]["ts"] + r_epoch if places else None, root,
+                   altitude="router")
+        for e in places:
+            t = e["ts"] + r_epoch
+            tb.add("placement", "placement", t, t, root, altitude="router",
+                   replica=e.get("replica"), local_id=e.get("local_id"),
+                   affinity_blocks=e.get("affinity_blocks"),
+                   spilled_from_blocks=e.get("spilled_from"),
+                   migration=e.get("migrations", 0) > 0)
+        for e in r_evs:
+            t = e["ts"] + r_epoch
+            if e["event"] == "migrate_out":
+                tb.add("migration", "migration", t, t, root,
+                       altitude="router", from_replica=e.get("from_replica"))
+            elif e["event"] == "recover":
+                nxt = next((p["ts"] + r_epoch for p in places
+                            if p["ts"] >= e["ts"]), None)
+                # the synthesized span: the dead replica cannot report this
+                # window; the router journal is the only witness
+                tb.add("recovered", "recovered", t, nxt if nxt else t, root,
+                       altitude="router", from_replica=e.get("from_replica"),
+                       resumed_tokens=e.get("resumed_tokens"))
+        recovers = [e for e in r_evs if e["event"] == "recover"]
+        for i, seg in enumerate(segments):
+            edge = {}
+            if i > 0:
+                prev = segments[i - 1]
+                recovered = any(prev["arrival_ts"] <= e["ts"] + r_epoch
+                                <= seg["arrival_ts"] for e in recovers)
+                edge = ({"recovered_from": prev["source"]} if recovered
+                        else {"migrated_from": prev["source"]})
+            seg_root = tb.add(f"segment:{seg['source']}", "segment",
+                              seg["arrival_ts"],
+                              seg["finish_ts"], root,
+                              replica=seg["source"],
+                              local_request_id=seg["request_id"], **edge)
+            # boundary to close a dead/abandoned segment's open spans at:
+            # the next segment's arrival (the stream provably moved on)
+            boundary = (segments[i + 1]["arrival_ts"]
+                        if i + 1 < len(segments) else None)
+            id_map = {}
+            for s in seg["spans"]:
+                t1s = s["t1"]
+                closed_by = None
+                if t1s is None and boundary is not None:
+                    t1s, closed_by = boundary, edge or "handoff"
+                parent = (seg_root if s["parent"] is None
+                          else id_map[s["parent"]])
+                attrs = dict(s["attrs"])
+                if closed_by:
+                    attrs["closed_at_handoff"] = True
+                sid = tb.add(s["name"], s["kind"], s["t0"], t1s, parent,
+                             **attrs)
+                id_map[s["id"]] = sid
+            if boundary is not None and seg["spans"] and seg_root is not None:
+                # the abandoned segment itself closes at the hand-off
+                if tb.spans[seg_root]["t1"] is None:
+                    tb.spans[seg_root]["t1"] = boundary
+        complete = (segments[-1]["complete"] if segments else False) and (
+            r_finish is not None or router_source is None or not r_evs)
+        out[tid] = {"trace_id": tid, "complete": complete,
+                    "segments": [s["source"] for s in segments],
+                    "frontend_request_id": (submit.get("request_id")
+                                            if submit else None),
+                    "arrival_ts": t0, "finish_ts": t1, "spans": tb.spans,
+                    # waterfall over a fleet trace uses the LAST segment's
+                    # replica-local view (its steps carried the finish)
+                    "last_segment": segments[-1] if segments else None}
+    return out
+
+
+# ---------------------------------------------------------------- perfetto
+def _chrome_events_for_source(pid: int, source: dict, epoch0: float,
+                              trace_ids: Dict[int, str]) -> List[dict]:
+    evs: List[dict] = [
+        {"name": "process_name", "ph": "M", "pid": pid,
+         "args": {"name": source["name"]}},
+        {"name": "thread_name", "ph": "M", "pid": pid, "tid": 0,
+         "args": {"name": f"{source['name']}:steps"}},
+        {"name": "thread_name", "ph": "M", "pid": pid, "tid": 1,
+         "args": {"name": f"{source['name']}:requests"}},
+    ]
+    shift = source.get("epoch", 0.0) - epoch0
+    for s in source.get("steps") or []:
+        args = {k: v for k, v in s.items() if k not in ("ts", "dur_s")}
+        evs.append({"name": f"step:{s['kind']}", "ph": "X", "cat": "step",
+                    "ts": (s["ts"] + shift) * 1e6,
+                    "dur": s.get("dur_s", 0.0) * 1e6,
+                    "pid": pid, "tid": 0, "args": args})
+    # per-request bookkeeping so every async begin this source opens is
+    # CLOSED by this source: a segment abandoned mid-stream (migration /
+    # replica death) otherwise dangles to end-of-trace in Perfetto
+    open_at: Dict[object, float] = {}      # tid_str -> last event ts
+    closed = set()
+    for e in source.get("events") or []:
+        args = {k: v for k, v in e.items() if k not in ("ts", "event")}
+        rid = e.get("request_id")
+        tid_str = e.get("trace_id") or trace_ids.get(rid)
+        if tid_str is not None:
+            args["trace_id"] = tid_str
+        evs.append({"name": e["event"], "ph": "i", "s": "t", "cat": "request",
+                    "ts": (e["ts"] + shift) * 1e6, "pid": pid, "tid": 1,
+                    "args": args})
+        # async begin/end per request: same (cat, id) across processes, so
+        # a migrated request's segments join on one async track chain
+        # (replica streams open at `arrival`, the router's at `submit`)
+        if tid_str is None:
+            continue
+        if e["event"] in ("arrival", "submit"):
+            evs.append({"name": f"request:{tid_str}", "ph": "b",
+                        "cat": "request_span", "id": tid_str,
+                        "ts": (e["ts"] + shift) * 1e6, "pid": pid, "tid": 1,
+                        "args": {"trace_id": tid_str}})
+            open_at[tid_str] = e["ts"]
+        elif tid_str in open_at:
+            open_at[tid_str] = e["ts"]
+            if e["event"] == "finish":
+                evs.append({"name": f"request:{tid_str}", "ph": "e",
+                            "cat": "request_span", "id": tid_str,
+                            "ts": (e["ts"] + shift) * 1e6, "pid": pid,
+                            "tid": 1, "args": {"trace_id": tid_str}})
+                closed.add(tid_str)
+    for tid_str, last_ts in open_at.items():
+        if tid_str in closed:
+            continue
+        # abandoned (migrated/recovered-away) or still-in-flight segment:
+        # close at this source's last sighting, visibly marked
+        evs.append({"name": f"request:{tid_str}", "ph": "e",
+                    "cat": "request_span", "id": tid_str,
+                    "ts": (last_ts + shift) * 1e6, "pid": pid, "tid": 1,
+                    "args": {"trace_id": tid_str,
+                             "closed": "end_of_stream"}})
+    return evs
+
+
+def merged_chrome_trace(replica_sources: Sequence[dict],
+                        router_source: Optional[dict] = None) -> dict:
+    """ONE Chrome/Perfetto trace for the whole fleet: router + N replicas as
+    separate processes with replica-prefixed tracks, every timestamp
+    normalized onto the shared epoch (the earliest source epoch — all
+    sources share one ``time.perf_counter`` clock in-process, and JSONL
+    epoch headers restore the same relation offline). Replaces the
+    per-replica-only exports the scale-out split shipped with (same-name
+    device programs still cannot share one xplane trace — DEVICE attribution
+    stays per-solo-window; this merge is the host-side timeline)."""
+    sources = list(replica_sources)
+    all_sources = sources + ([router_source] if router_source else [])
+    if not all_sources:
+        return {"traceEvents": [], "displayTimeUnit": "ms"}
+    epoch0 = min(s.get("epoch", 0.0) for s in all_sources)
+    evs: List[dict] = []
+    if router_source is not None:
+        evs += _chrome_events_for_source(0, router_source, epoch0, {})
+    for i, src in enumerate(sources):
+        trace_ids = {e.get("request_id"): e.get("trace_id")
+                     for e in (src.get("events") or [])
+                     if e.get("event") == "arrival" and e.get("trace_id")}
+        evs += _chrome_events_for_source(i + 1, src, epoch0, trace_ids)
+    return {"traceEvents": evs, "displayTimeUnit": "ms"}
+
+
+def write_merged_chrome_trace(path: str,
+                              replica_sources: Sequence[dict],
+                              router_source: Optional[dict] = None) -> str:
+    with open(path, "w") as fh:
+        json.dump(merged_chrome_trace(replica_sources, router_source), fh)
+    return path

@@ -107,13 +107,16 @@ class FlightRecorder:
     # ------------------------------------------------------------------ bundle
     def dump_bundle(self, path: str, *, config=None, metrics=None,
                     stats=None, reason: str = "manual",
-                    extra: Optional[dict] = None) -> str:
+                    spans=None, extra: Optional[dict] = None) -> str:
         """Write the debug bundle to ``path`` and return it.
 
         ``config``: the serving TpuConfig (or any dataclass/dict);
         ``metrics``: a MetricsRegistry dump (``registry.to_dict()``);
         ``stats``: a live ``runner.stats()`` snapshot; ``reason``: what
-        triggered the dump (``manual`` / ``signal`` / ``exception`` / ...).
+        triggered the dump (``manual`` / ``signal`` / ``exception`` / ...);
+        ``spans``: span trees of the requests in flight at dump time
+        (``serving.tracing.inflight_span_trees`` — the post-mortem shows
+        WHERE each live stream was, not just that streams existed).
         """
         bundle = {
             "schema": BUNDLE_SCHEMA,
@@ -126,6 +129,7 @@ class FlightRecorder:
             "stats": _jsonable(stats),
             "ring": _jsonable(self.records()),
             "ring_dropped": self.dropped,
+            "spans": _jsonable(spans),
             "extra": _jsonable(extra),
         }
         tmp = f"{path}.tmp"

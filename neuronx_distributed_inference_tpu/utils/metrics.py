@@ -75,9 +75,17 @@ class Histogram:
     """Fixed-bucket histogram: ``buckets`` are ascending upper bounds with an
     implicit +Inf overflow bucket appended; ``counts`` is a LIVE np.int64
     array of len(buckets)+1 (integer-valued histograms like spec acceptance
-    expose ``counts[:K]`` as the back-compat ``acceptance_counts`` view)."""
+    expose ``counts[:K]`` as the back-compat ``acceptance_counts`` view).
 
-    __slots__ = ("name", "help", "labels", "buckets", "counts", "sum", "_bk")
+    ``observe(v, exemplar={"trace_id": ...})`` additionally remembers the
+    LAST exemplar per bucket (labels, value, unix ts) — the OpenMetrics
+    exemplar wiring that lets a scraped TTFT/TPOT bucket name the request
+    trace that landed in it (serving/tracing.py). Exemplar storage is lazy:
+    a histogram that never sees one keeps ``exemplars`` None and the observe
+    hot path pays a single ``is not None`` test."""
+
+    __slots__ = ("name", "help", "labels", "buckets", "counts", "sum", "_bk",
+                 "exemplars")
 
     def __init__(self, name: str, buckets: Sequence[float], help: str = "",
                  labels: Optional[Dict[str, str]] = None):
@@ -89,12 +97,18 @@ class Histogram:
         self._bk = np.asarray(self.buckets, dtype=np.float64)
         self.counts = np.zeros(len(self.buckets) + 1, dtype=np.int64)
         self.sum = 0.0
+        self.exemplars: Optional[Dict[int, tuple]] = None
 
-    def observe(self, v) -> None:
+    def observe(self, v, exemplar: Optional[Dict[str, str]] = None) -> None:
         # side="left": an observation equal to a bound lands IN that bucket
         # (le semantics), so integer buckets [1..K] map value k -> counts[k-1]
-        self.counts[int(np.searchsorted(self._bk, v, side="left"))] += 1
+        idx = int(np.searchsorted(self._bk, v, side="left"))
+        self.counts[idx] += 1
         self.sum += v
+        if exemplar is not None:
+            if self.exemplars is None:
+                self.exemplars = {}
+            self.exemplars[idx] = (dict(exemplar), float(v), time.time())
 
     @property
     def count(self) -> int:
@@ -119,7 +133,7 @@ class _Null:
     def set(self, v):
         pass
 
-    def observe(self, v):
+    def observe(self, v, exemplar=None):
         pass
 
     @property
@@ -225,6 +239,7 @@ class MetricsRegistry:
             elif isinstance(m, Histogram):
                 m.counts[:] = 0
                 m.sum = 0.0
+                m.exemplars = None
 
     def to_dict(self) -> Dict[str, object]:
         out: Dict[str, object] = {}
@@ -239,10 +254,16 @@ class MetricsRegistry:
                 out[key] = m.value
         return out
 
-    def prometheus_text(self) -> str:
+    def prometheus_text(self, exemplars: bool = False) -> str:
         """Prometheus text exposition (version 0.0.4): HELP/TYPE headers,
         cumulative ``le``-labelled histogram buckets ending at +Inf, _sum and
-        _count series."""
+        _count series.
+
+        ``exemplars=True`` appends OpenMetrics exemplar suffixes
+        (``# {trace_id="..."} value unix_ts``) to histogram bucket lines that
+        have one. GATED off by default: exemplar syntax is OpenMetrics, not
+        Prometheus text 0.0.4, and a plain-Prometheus scraper must keep
+        receiving valid exposition (tests/test_tracing.py pins both shapes)."""
         lines: List[str] = []
         seen_header = set()
         for m in self._metrics.values():
@@ -256,10 +277,17 @@ class MetricsRegistry:
             base = dict(m.labels) if m.labels else {}
             if isinstance(m, Histogram):
                 cum = 0
-                for b, c in zip(m.buckets + (float("inf"),), m.counts):
+                for i, (b, c) in enumerate(zip(m.buckets + (float("inf"),),
+                                               m.counts)):
                     cum += int(c)
-                    lines.append(_series(f"{m.name}_bucket",
-                                         {**base, "le": _le(b)}, cum))
+                    line = _series(f"{m.name}_bucket",
+                                   {**base, "le": _le(b)}, cum)
+                    if exemplars and m.exemplars and i in m.exemplars:
+                        ex_labels, ex_val, ex_ts = m.exemplars[i]
+                        inner = ",".join(f'{k}="{v}"'
+                                         for k, v in ex_labels.items())
+                        line += f" # {{{inner}}} {ex_val} {ex_ts:.3f}"
+                    lines.append(line)
                 lines.append(_series(f"{m.name}_sum", base, m.sum))
                 lines.append(_series(f"{m.name}_count", base, m.count))
             elif isinstance(m, Gauge):
@@ -322,9 +350,17 @@ class ServingTelemetry:
         # JSONL spool keep the full history). None = unbounded.
         self.max_records = max_records
         self._t0 = time.perf_counter()      # trace epoch
+        # per-instance trace-id salt: replicas minting their own ids (no
+        # router upstream) must not collide when their event logs merge into
+        # one fleet trace (serving/tracing.py)
+        import uuid
+
+        self._trace_salt = uuid.uuid4().hex[:8]
+        self._trace_seq = 0
         self._jsonl = None
         if jsonl_path and enabled:
             self._jsonl = open(jsonl_path, "w")
+            self._write_epoch_line()
         reg = self.registry
         self._c_steps: Dict[str, Counter] = {}   # per-kind cache (hot path)
         self._c_dropped = reg.counter(
@@ -355,6 +391,32 @@ class ServingTelemetry:
                                       "live decode rows in the last step")
 
     # ------------------------------------------------------------ event log
+    @property
+    def epoch(self) -> float:
+        """The stream's clock origin as a ``time.perf_counter()`` value:
+        every event/step ``ts`` is relative to this. Same-process sources
+        (router + N replicas) normalize onto ONE shared epoch by adding it
+        back — the clock model the fleet-merged trace export is built on."""
+        return self._t0
+
+    def _write_epoch_line(self) -> None:
+        """Spool the clock origin so an OFFLINE reader (explain_request.py)
+        can place this file's relative timestamps on the shared process
+        clock. Re-written on reset(): everything before the newest epoch
+        line belongs to a discarded measurement window."""
+        if self._jsonl is not None:
+            self._jsonl.write(json.dumps(
+                {"event": "telemetry_epoch", "epoch": self._t0,
+                 "unix_ts": time.time()}) + "\n")
+
+    def mint_trace_id(self) -> str:
+        self._trace_seq += 1
+        return f"t-{self._trace_salt}-{self._trace_seq:06x}"
+
+    def trace_id_of(self, rid: int) -> Optional[str]:
+        r = self.requests.get(rid)
+        return r.get("trace_id") if r is not None else None
+
     def _trim(self, log: List) -> None:
         if self.max_records is not None and len(log) > self.max_records:
             n = self.max_records // 4
@@ -376,21 +438,31 @@ class ServingTelemetry:
 
     def request_arrival(self, rid: int, prompt_len: int,
                         max_new_tokens: int,
-                        ts: Optional[float] = None) -> None:
+                        ts: Optional[float] = None,
+                        trace_id: Optional[str] = None) -> None:
         """``ts``: optional ``time.perf_counter()`` timestamp of when the
         request ACTUALLY arrived upstream (defaults to now). Open-loop
         drivers backdate to the scheduled arrival so queue wait spent inside
-        a blocking step() is not hidden by submit granularity."""
+        a blocking step() is not hidden by submit granularity.
+
+        ``trace_id``: request-scoped trace context (serving/tracing.py) —
+        the router mints one at frontend submit and threads it through
+        placement so a request's events stay joinable across replicas; a
+        standalone runner's telemetry mints its own. Minted only on the
+        ENABLED path (the disabled path must stay allocation-free)."""
         self._c_requests.inc()
         if not self.enabled:
             return
+        if trace_id is None:
+            trace_id = self.mint_trace_id()
         rec = self._event("arrival", rid, _ts=ts, prompt_len=prompt_len,
-                          max_new_tokens=max_new_tokens)
+                          max_new_tokens=max_new_tokens, trace_id=trace_id)
         self.requests[rid] = {
             "arrival_ts": rec["ts"], "placed_ts": None, "first_token_ts": None,
             "last_token_ts": None, "finish_ts": None, "prompt_len": prompt_len,
             "tokens": 0, "prefill_tokens": 0, "prefix_hit_tokens": 0,
             "preemptions": 0, "finish_reason": None, "tpot_observed": False,
+            "trace_id": trace_id,
         }
 
     def request_placed(self, rid: int, slot: int, resumed: bool = False) -> None:
@@ -400,7 +472,8 @@ class ServingTelemetry:
         r = self.requests.get(rid)
         if r is not None and r["placed_ts"] is None:
             r["placed_ts"] = rec["ts"]
-            self._h_queue.observe(rec["ts"] - r["arrival_ts"])
+            self._h_queue.observe(rec["ts"] - r["arrival_ts"],
+                                  exemplar=self._exemplar(r))
 
     def request_prefix_hit(self, rid: int, tokens: int) -> None:
         self._c_prefix.inc(tokens)
@@ -448,6 +521,13 @@ class ServingTelemetry:
                 del self.requests[k]
             self._c_dropped.inc(len(drop))
 
+    @staticmethod
+    def _exemplar(r: Optional[dict]) -> Optional[Dict[str, str]]:
+        """Exemplar labels for a latency observation: the request's trace id
+        (None when untraced — the observe then skips exemplar storage)."""
+        tid = r.get("trace_id") if r is not None else None
+        return {"trace_id": tid} if tid else None
+
     def _maybe_observe_tpot(self, r: dict) -> None:
         """Observe TPOT once per finished request — from finish OR from the
         step-end note_emitted, whichever lands last (the runner finishes a
@@ -457,7 +537,8 @@ class ServingTelemetry:
             return
         r["tpot_observed"] = True
         self._h_tpot.observe(
-            (r["last_token_ts"] - r["first_token_ts"]) / (r["tokens"] - 1))
+            (r["last_token_ts"] - r["first_token_ts"]) / (r["tokens"] - 1),
+            exemplar=self._exemplar(r))
 
     def note_emitted(self, emitted: Dict[int, List[int]]) -> None:
         """Fold one step's {request_id: new tokens} into the per-request
@@ -475,7 +556,8 @@ class ServingTelemetry:
             if r["first_token_ts"] is None:
                 rec = self._event("first_token", rid)
                 r["first_token_ts"] = rec["ts"]
-                self._h_ttft.observe(rec["ts"] - r["arrival_ts"])
+                self._h_ttft.observe(rec["ts"] - r["arrival_ts"],
+                                     exemplar=self._exemplar(r))
                 ts = rec["ts"]
                 self._event("commit", rid, tokens=n)
             else:
@@ -661,8 +743,8 @@ class ServingTelemetry:
             json.dump(self.chrome_trace(), f)
         return path
 
-    def prometheus_text(self) -> str:
-        return self.registry.prometheus_text()
+    def prometheus_text(self, exemplars: bool = False) -> str:
+        return self.registry.prometheus_text(exemplars=exemplars)
 
     def reset(self) -> None:
         """Clear events/steps/request records and zero the registry in place
@@ -676,6 +758,9 @@ class ServingTelemetry:
         if self.flight is not None:
             self.flight.clear()
         self._t0 = time.perf_counter()
+        # offline readers drop everything before the newest epoch line (the
+        # discarded window's events reference a dead clock origin)
+        self._write_epoch_line()
 
     def close(self) -> None:
         if self._jsonl is not None:

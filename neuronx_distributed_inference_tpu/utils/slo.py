@@ -47,6 +47,12 @@ class SLOConfig:
     # ceiling on preemptions per minute over the window
     max_preemptions_per_min: Optional[float] = None
     window_s: float = 60.0
+    # how many offending requests a violated latency target NAMES in the
+    # slo_violation line (worst-k by sample value, with trace ids — the
+    # jump-off into scripts/explain_request.py)
+    worst_k: int = 3
+
+    _NON_TARGETS = ("window_s", "worst_k")
 
     @classmethod
     def parse(cls, spec: str) -> "SLOConfig":
@@ -65,13 +71,14 @@ class SLOConfig:
             if k not in fields:
                 raise ValueError(f"unknown SLO target {k!r} "
                                  f"(known: {sorted(fields)})")
-            kw[k] = float(v)
+            kw[k] = int(v) if k == "worst_k" else float(v)
         return cls(**kw)
 
     def targets(self) -> Dict[str, float]:
         return {f.name: getattr(self, f.name)
                 for f in dataclasses.fields(self)
-                if f.name != "window_s" and getattr(self, f.name) is not None}
+                if f.name not in self._NON_TARGETS
+                and getattr(self, f.name) is not None}
 
 
 @dataclasses.dataclass
@@ -81,6 +88,10 @@ class SLOReport:
     values: Dict[str, Optional[float]]      # measured value per target
     window_s: float
     window_requests: int
+    # per violated LATENCY target: the worst-k offending requests
+    # [{request_id, trace_id, value_ms}, ...] — the aggregate percentile,
+    # made actionable (feed the trace_id to scripts/explain_request.py)
+    offenders: Dict[str, List[dict]] = dataclasses.field(default_factory=dict)
 
 
 def _p(vals: List[float], q: float) -> Optional[float]:
@@ -125,32 +136,38 @@ class SLOMonitor:
         now = (time.perf_counter() if now is None else now) - tel._t0
         lo = now - cfg.window_s
 
-        ttft, tpot, queue = [], [], []
+        # samples carry their request id so a violated target can NAME its
+        # worst-k offenders instead of only an aggregate percentile
+        ttft_s, tpot_s, queue_s = [], [], []
         n_win = 0
-        for r in tel.requests.values():
+        for rid, r in tel.requests.items():
             ft, lt = r["first_token_ts"], r["last_token_ts"]
             live = r["finish_ts"] is None
             if ft is not None and ft >= lo:
                 n_win += 1
-                ttft.append(1e3 * (ft - r["arrival_ts"]))
+                ttft_s.append((1e3 * (ft - r["arrival_ts"]), rid))
             elif ft is None and live and r["arrival_ts"] <= now:
                 # CENSORED sample: a live request with no first token yet
                 # contributes its AGE as a TTFT lower bound — a wedged
                 # replica (requests arrive, nothing is produced) must flag
                 # the ceiling, not read as "nothing measured, no verdict"
                 n_win += 1
-                ttft.append(1e3 * (now - r["arrival_ts"]))
+                ttft_s.append((1e3 * (now - r["arrival_ts"]), rid))
             # TPOT windows on ACTIVITY (last token in window), not on the
             # first token: a generation longer than window_s would otherwise
             # drop out of the window while still degrading
             if ft is not None and lt is not None and lt >= lo \
                     and r["tokens"] > 1:
-                tpot.append(1e3 * (lt - ft) / (r["tokens"] - 1))
+                tpot_s.append((1e3 * (lt - ft) / (r["tokens"] - 1), rid))
             if r["placed_ts"] is not None and r["placed_ts"] >= lo:
-                queue.append(1e3 * (r["placed_ts"] - r["arrival_ts"]))
+                queue_s.append((1e3 * (r["placed_ts"] - r["arrival_ts"]),
+                                rid))
             elif r["placed_ts"] is None and live and r["arrival_ts"] <= now:
                 # censored queue-wait for requests still waiting on a slot
-                queue.append(1e3 * (now - r["arrival_ts"]))
+                queue_s.append((1e3 * (now - r["arrival_ts"]), rid))
+        ttft = [v for v, _ in ttft_s]
+        tpot = [v for v, _ in tpot_s]
+        queue = [v for v, _ in queue_s]
 
         reg = tel.registry
         values: Dict[str, Optional[float]] = {
@@ -187,6 +204,9 @@ class SLOMonitor:
         self._last_preempt = preempt
 
         violations: List[str] = []
+        samples_by_target = {"ttft_p99_ms": ttft_s, "ttft_p50_ms": ttft_s,
+                             "tpot_p99_ms": tpot_s, "queue_p99_ms": queue_s}
+        offenders: Dict[str, List[dict]] = {}
         for name, target in cfg.targets().items():
             v = values.get(name)
             if v is None:
@@ -196,6 +216,17 @@ class SLOMonitor:
                     violations.append(f"{name}: {v:.4g} < floor {target:.4g}")
             elif v > target:
                 violations.append(f"{name}: {v:.4g} > ceiling {target:.4g}")
+                samples = samples_by_target.get(name)
+                if samples:
+                    # the worst-k requests behind the blown percentile —
+                    # named, with trace ids, so the violation is actionable
+                    # (scripts/explain_request.py takes it from here)
+                    worst = sorted(samples, reverse=True)[: max(0, cfg.worst_k)]
+                    offenders[name] = [
+                        {"request_id": rid,
+                         "trace_id": tel.requests[rid].get("trace_id"),
+                         "value_ms": round(val, 3)}
+                        for val, rid in worst]
 
         healthy = not violations
         self._g_healthy.set(1 if healthy else 0)
@@ -206,8 +237,9 @@ class SLOMonitor:
             logger.warning("slo_violation %s", json.dumps({
                 "violations": violations, "window_s": cfg.window_s,
                 "window_requests": n_win,
+                "offenders": offenders,
                 "values": {k: v for k, v in values.items()
                            if v is not None}}))
         return SLOReport(healthy=healthy, violations=violations,
                          values=values, window_s=cfg.window_s,
-                         window_requests=n_win)
+                         window_requests=n_win, offenders=offenders)

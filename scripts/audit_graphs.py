@@ -79,6 +79,9 @@ _FILE_SCOPES = {
     # ISSUE-11 fault tolerance: the injector/supervisor are host-side seam
     # wrappers over replica APIs — they never enter a graph (lint-only)
     "serving/faults.py": [],
+    # ISSUE-12 request tracing: pure post-processing over already-recorded
+    # telemetry events — never enters a graph (lint-only)
+    "serving/tracing.py": [],
     "serving/kv_tiering.py": ["serving_tier", "cb_paged", "cb_mixed",
                               "cb_megastep", "cb_spec", "cb_eagle"],
 }

@@ -63,4 +63,17 @@ if [ "${FAULTS_TIER1_TESTS:-0}" -lt 1 ]; then
     echo "ERROR: fault-tolerance tests are not in the tier-1 marker set" >&2
     [ "$rc" -eq 0 ] && rc=1
 fi
+
+# ISSUE-12 unchanged-semantics guard: the request-tracing suite (span-tree
+# continuity across migration/recovery, waterfall reconciliation, exemplar
+# exposition) must stay collected inside the tier-1 marker set.
+TRACING_TIER1_TESTS=$(env JAX_PLATFORMS=cpu python -m pytest \
+    "$REPO/tests/test_tracing.py" \
+    -q -m 'not slow' --collect-only -p no:cacheprovider 2>/dev/null \
+    | grep -ac '::' || true)
+echo "TRACING_TIER1_TESTS=$TRACING_TIER1_TESTS"
+if [ "${TRACING_TIER1_TESTS:-0}" -lt 1 ]; then
+    echo "ERROR: request-tracing tests are not in the tier-1 marker set" >&2
+    [ "$rc" -eq 0 ] && rc=1
+fi
 exit "$rc"

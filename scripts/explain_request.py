@@ -1,0 +1,209 @@
+#!/usr/bin/env python
+"""Tail-latency explainer: rebuild a request's causal span tree from serving
+event logs and print a latency waterfall whose components RECONCILE to the
+recorded TTFT / E2E (serving/tracing.py — the reconciliation is the test; a
+waterfall that doesn't sum is an event-stream integrity failure, and this
+tool exits non-zero on it).
+
+Inputs are the JSONL spools the serving stack already writes:
+
+    # single runner (CLI --events-out / bench arrival phase)
+    python scripts/explain_request.py events.jsonl --request 3
+    python scripts/explain_request.py events.jsonl --all
+
+    # fleet: replica spools + the router journal (CLI routed serve writes
+    # events.jsonl.replica<i> and events.jsonl.router)
+    python scripts/explain_request.py events.jsonl.replica* \\
+        --router events.jsonl.router --trace t-ab12cd34-000001
+
+Every file carries a ``telemetry_epoch`` header line, so timestamps from
+different files normalize onto ONE shared clock; a request that migrated (or
+survived ``recover_replica``) prints as a single connected trace with
+``migrated_from`` / ``recovered_from`` continuity edges and one waterfall
+per replica segment."""
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from neuronx_distributed_inference_tpu.serving import tracing  # noqa: E402
+
+
+def _bar(ms: float, total: float, width: int = 28) -> str:
+    n = 0 if total <= 0 else int(round(width * ms / total))
+    return "#" * max(0, min(width, n))
+
+
+def _print_waterfall(wf: dict, indent: str = "") -> None:
+    for phase, key in (("TTFT", "ttft_components_ms"),
+                       ("E2E", "e2e_components_ms")):
+        total = wf.get(f"{phase.lower()}_ms")
+        comp = wf.get(key)
+        if total is None or comp is None:
+            continue
+        print(f"{indent}{phase} {total:.1f} ms")
+        for name, ms in comp.items():
+            if ms <= 0:
+                continue
+            print(f"{indent}  {name:<22} {ms:9.2f} ms  {_bar(ms, total)}")
+        resid = wf.get(f"{phase.lower()}_residual_frac")
+        print(f"{indent}  reconciliation: components sum within "
+              f"{resid * 100:.2f}% of recorded {phase} "
+              f"[{'OK' if wf['reconciled'] else 'FAIL'}]")
+    if "ttft_device_split_ms" in wf:
+        print(f"{indent}  device attribution (profiled per-kind ratios):")
+        for kind, d in wf["ttft_device_split_ms"].items():
+            print(f"{indent}    {kind:<20} device {d['device_ms']:.2f} ms / "
+                  f"host+gap {d['host_gap_ms']:.2f} ms")
+
+
+def _print_tree(spans, indent: str = "  ") -> None:
+    children = {}
+    for s in spans:
+        children.setdefault(s["parent"], []).append(s)
+    def rec(parent, depth):
+        for s in sorted(children.get(parent, ()), key=lambda x: x["t0"]):
+            dur = ("open" if s["t1"] is None
+                   else f"{(s['t1'] - s['t0']) * 1e3:.2f} ms")
+            attrs = {k: v for k, v in s["attrs"].items()
+                     if k in ("replica", "migrated_from", "recovered_from",
+                              "tokens", "slot", "step_kind", "finish_reason",
+                              "from_replica", "resumed_tokens")}
+            extra = f"  {attrs}" if attrs else ""
+            print(f"{indent}{'  ' * depth}{s['name']:<24} {dur}{extra}")
+            rec(s["id"], depth + 1)
+    rec(None, 0)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("events", nargs="+",
+                    help="ServingTelemetry JSONL spool(s), one per replica")
+    ap.add_argument("--router", default=None, metavar="PATH",
+                    help="router journal JSONL (PrefixAffinityRouter."
+                         "write_trace_events) — enables fleet mode")
+    ap.add_argument("--request", type=int, default=None,
+                    help="request id to explain (frontend id in fleet mode)")
+    ap.add_argument("--trace", default=None, help="trace id to explain")
+    ap.add_argument("--all", action="store_true",
+                    help="validate EVERY request (the bench coverage mode)")
+    ap.add_argument("--tolerance", type=float, default=0.05,
+                    help="waterfall reconciliation tolerance (default 5%%)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the machine-readable report instead of text")
+    args = ap.parse_args(argv)
+    if not (args.all or args.request is not None or args.trace):
+        args.all = True
+
+    sources = [tracing.load_jsonl_source(p, name=os.path.basename(p))
+               for p in args.events]
+    router_source = (tracing.load_jsonl_source(args.router, name="router")
+                     if args.router else None)
+    sets = {s["name"]: tracing.build_trace_set(s) for s in sources}
+
+    failures = 0
+    report = {"tolerance": args.tolerance, "requests": []}
+
+    def explain_local(name, trace):
+        nonlocal failures
+        wf = tracing.waterfall(trace, sets[name]["steps"],
+                               tolerance=args.tolerance)
+        problems = tracing.validate_trace(trace)
+        ok = wf["reconciled"] and trace["complete"] and not problems
+        failures += 0 if ok else 1
+        report["requests"].append({"source": name, **wf,
+                                   "problems": problems, "ok": ok})
+        if not args.as_json:
+            print(f"\nrequest {trace['request_id']} "
+                  f"(trace {trace['trace_id']}, {name})"
+                  + ("" if trace["complete"] else "  [IN FLIGHT]"))
+            _print_tree(trace["spans"])
+            _print_waterfall(wf, indent="  ")
+            for p in problems:
+                print(f"  PROBLEM: {p}")
+
+    if router_source is not None or len(sources) > 1:
+        fleet = tracing.build_fleet_traces(sources, router_source)
+        wanted = fleet
+        if args.trace:
+            wanted = {k: v for k, v in fleet.items() if k == args.trace}
+        elif args.request is not None:
+            wanted = {k: v for k, v in fleet.items()
+                      if v.get("frontend_request_id") == args.request}
+        if not wanted:
+            print("no matching trace found", file=sys.stderr)
+            return 2
+        for tid, ft in sorted(wanted.items()):
+            problems = tracing.validate_trace(ft)
+            # same integrity contract as single-file mode: an incomplete
+            # trace (a stream the fleet never finished) is a FAILURE — the
+            # lost-request scenario is exactly what this tool must not
+            # green-light
+            if not ft["complete"]:
+                problems = problems + ["trace incomplete: request never "
+                                       "finished"]
+            if not args.as_json:
+                print(f"\ntrace {tid} (frontend request "
+                      f"{ft['frontend_request_id']}): "
+                      f"{len(ft['segments'])} segment(s) over "
+                      f"{ft['segments']}"
+                      + ("" if ft["complete"] else "  [IN FLIGHT]"))
+                _print_tree(ft["spans"])
+                for p in problems:
+                    print(f"  PROBLEM: {p}")
+            failures += 1 if problems else 0
+            rep_row = {"trace_id": tid, "segments": ft["segments"],
+                       "complete": ft["complete"], "problems": problems,
+                       "segment_waterfalls": []}
+            # one waterfall per replica segment, against THAT replica's
+            # dispatch timeline (a segment's latency belongs to its host)
+            for name, ts in sets.items():
+                for rid, tr in sorted(ts["traces"].items()):
+                    if tr.get("trace_id") == tid and tr["complete"]:
+                        wf = tracing.waterfall(tr, ts["steps"],
+                                               tolerance=args.tolerance)
+                        failures += 0 if wf["reconciled"] else 1
+                        rep_row["segment_waterfalls"].append(
+                            {"source": name, **wf})
+                        if not args.as_json:
+                            print(f"  segment on {name}:")
+                            _print_waterfall(wf, indent="    ")
+            report["requests"].append(rep_row)
+    else:
+        name, ts = next(iter(sets.items()))
+        traces = ts["traces"]
+        if args.trace:
+            traces = {r: t for r, t in traces.items()
+                      if t.get("trace_id") == args.trace}
+        elif args.request is not None:
+            traces = {r: t for r, t in traces.items()
+                      if r == args.request}
+        if not traces:
+            print("no matching request found", file=sys.stderr)
+            return 2
+        for rid in sorted(traces):
+            explain_local(name, traces[rid])
+
+    report["ok"] = failures == 0
+    if args.as_json:
+        print(json.dumps(report, indent=1))
+    elif failures:
+        print(f"\n{failures} request(s) FAILED validation/reconciliation",
+              file=sys.stderr)
+    return 0 if failures == 0 else 1
+
+
+if __name__ == "__main__":
+    try:
+        rc = main()
+    except BrokenPipeError:
+        # piping through `head` closes stdout early; the exit code is this
+        # tool's integrity contract, so a closed pipe must not read as a
+        # reconciliation failure — exit 141 (128+SIGPIPE), like coreutils
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        rc = 141
+    sys.exit(rc)

@@ -68,13 +68,19 @@ def build_runner(mode: str):
     return runner, list(_prompts((12, 19, 40)))
 
 
-def profile_replicas(n, max_new, logdir, plane):
+def profile_replicas(n, max_new, logdir, plane, merged_trace=None):
     """Per-replica device-time attribution (ISSUE-9 scale-out split): N
     engine replicas on one tiny app, each traced in its OWN window while the
     others idle. Same-kind dispatches lower to identical program names across
-    replicas, so a single shared trace could not split device time between
-    them — sequential solo windows keep the attribution honest. Rows come
-    back keyed ``replica<i>:<kind>``."""
+    replicas, so a single shared xplane trace could not split DEVICE time
+    between them — sequential solo windows keep that attribution honest.
+
+    ``merged_trace``: additionally write ONE fleet-merged Chrome/Perfetto
+    trace of the replicas' HOST-side step/event timelines, normalized onto
+    the shared epoch clock with replica-prefixed tracks
+    (serving/tracing.py). This supersedes the old per-replica-only trace
+    caveat for everything host-side; only the xplane device attribution
+    stays per-solo-window."""
     from neuronx_distributed_inference_tpu.analysis.harness import (_prompts,
                                                                     _tiny_app)
     from neuronx_distributed_inference_tpu.runtime.continuous_batching import (
@@ -109,6 +115,13 @@ def profile_replicas(n, max_new, logdir, plane):
         for kind, row in rep.runner.attribute_device_time(
                 rdir, plane_substr=plane).items():
             timing[f"replica{rep.replica_id}:{kind}"] = row
+    if merged_trace:
+        from neuronx_distributed_inference_tpu.serving import tracing
+
+        tracing.write_merged_chrome_trace(
+            merged_trace, [rep.trace_source() for rep in replicas])
+        print(f"fleet-merged Chrome trace written to {merged_trace}",
+              file=sys.stderr)
     return timing
 
 
@@ -120,6 +133,12 @@ def main(argv=None):
                     help="profile N engine replicas (serving/engine.py), one "
                          "traced solo window each — timing rows come back "
                          "per replica (plain mode only)")
+    ap.add_argument("--merged-trace", default=None, metavar="PATH",
+                    help="with --replicas: also write ONE fleet-merged "
+                         "Chrome/Perfetto trace of the replicas' host "
+                         "timelines on the shared epoch clock "
+                         "(serving/tracing.py; device attribution stays "
+                         "per-solo-window)")
     ap.add_argument("--max-new-tokens", type=int, default=10)
     ap.add_argument("--logdir", default="/tmp/tpu_profile_serving")
     ap.add_argument("--plane", default="tpu",
@@ -131,13 +150,18 @@ def main(argv=None):
 
     from neuronx_distributed_inference_tpu.utils import profiling as prof
 
+    if args.merged_trace and args.replicas <= 1:
+        ap.error("--merged-trace requires --replicas > 1 (a single runner's "
+                 "trace needs no merging — use the CLI's --trace-out)")
     if args.replicas > 1:
         if args.mode != "plain":
             ap.error("--replicas composes with --mode plain only")
         timing = profile_replicas(args.replicas, args.max_new_tokens,
-                                  args.logdir, args.plane)
+                                  args.logdir, args.plane,
+                                  merged_trace=args.merged_trace)
         report = {"mode": "plain", "replicas": args.replicas,
                   "plane": args.plane, "logdir": args.logdir,
+                  "merged_trace": args.merged_trace,
                   "timing": timing}
         print(json.dumps(report, indent=2))
         if args.out:

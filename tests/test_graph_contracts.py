@@ -378,6 +378,9 @@ def test_changed_mode_scope_map_fails_closed():
     # ISSUE-11: the fault injector wraps replica seams on the host —
     # lint-only, like router/engine
     assert mod._scopes_for_changes([pkg + "serving/faults.py"]) == []
+    # ISSUE-12: request tracing is post-processing over recorded telemetry
+    # events — lint-only; any OTHER new serving/ file still fails closed
+    assert mod._scopes_for_changes([pkg + "serving/tracing.py"]) == []
     assert set(mod._scopes_for_changes([pkg + "serving/kv_tiering.py"])) == {
         "serving_tier", "cb_paged", "cb_mixed", "cb_megastep", "cb_spec",
         "cb_eagle"}

@@ -266,6 +266,47 @@ def test_disabled_telemetry_adds_no_measurable_step_overhead():
         f"instrumented {t_inst * 1e3:.2f} ms for {n} steps)")
 
 
+def test_tracing_off_path_adds_no_per_observation_overhead():
+    """The ISSUE-12 canary beside the two above: request tracing is post-hoc
+    span building, so the LIVE serving path gains only (a) the
+    ``exemplar=None`` default on histogram observes and (b) the trace-id
+    mint at arrival — and the mint must not run at all when telemetry is
+    disabled. Pinned as an absolute per-call ceiling on the no-exemplar
+    observe (generous vs a ~ms dispatch; catches accidental per-observe
+    exemplar/dict work sneaking onto the default path) plus the
+    disabled-path allocation check."""
+    import time
+
+    from neuronx_distributed_inference_tpu.utils.metrics import (
+        MetricsRegistry, ServingTelemetry)
+
+    # (b) disabled telemetry mints nothing — arrival stays allocation-free
+    tel = ServingTelemetry(enabled=False)
+    for rid in range(100):
+        tel.request_arrival(rid, prompt_len=16, max_new_tokens=64)
+    assert tel._trace_seq == 0 and tel.requests == {}
+
+    # (a) the no-exemplar observe: best-of-repeats absolute per-call bound
+    h = MetricsRegistry().histogram("t_seconds")
+    h.observe(0.01)                                  # warm
+    n = 2000
+    best = min(_timed(lambda: [h.observe(0.01) for _ in range(n)])
+               for _ in range(5))
+    per_call = best / n
+    assert per_call < 50e-6, (
+        f"no-exemplar Histogram.observe costs {per_call * 1e6:.1f} µs/call "
+        f"— exemplar work leaked onto the tracing-off path")
+    assert h.exemplars is None, "observe() without exemplar allocated storage"
+
+
+def _timed(fn):
+    import time
+
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
+
+
 def test_enabled_telemetry_with_carry_drain_stays_microseconds_per_step():
     """The ISSUE-7 extension of the canary above: the ENABLED path — per-step
     record building, note_emitted lifecycle folding, flight-ring append, AND

@@ -1,0 +1,456 @@
+"""Fleet-scope request tracing (ISSUE-12): causal span trees rebuilt from the
+serving telemetry must be COMPLETE (every request, every span parented, no
+leaks), CONTINUOUS across drain/migration and injected death + recovery
+(single connected trace, token streams bit-identical to the untraced run),
+and HONEST (the latency waterfall's components reconcile to the recorded
+TTFT/E2E — reconciliation is the test, not a pretty-printer). Plus the
+satellite surfaces: OpenMetrics exemplars on histogram buckets, worst-k
+offender naming in slo_violation lines, span trees in debug bundles, the
+fleet-merged Chrome export, and the explain_request.py CLI."""
+
+import importlib.util
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from neuronx_distributed_inference_tpu.config import (
+    TpuConfig, load_pretrained_config)
+from neuronx_distributed_inference_tpu.models.llama.modeling_llama import (
+    LlamaForCausalLM, LlamaInferenceConfig)
+from neuronx_distributed_inference_tpu.runtime.continuous_batching import (
+    ContinuousBatchingRunner)
+from neuronx_distributed_inference_tpu.serving import (
+    EngineReplica, FaultInjector, HostKVTier, PrefixAffinityRouter, tracing)
+from neuronx_distributed_inference_tpu.utils.metrics import (
+    MetricsRegistry, ServingTelemetry)
+from neuronx_distributed_inference_tpu.utils.slo import SLOConfig, SLOMonitor
+
+BS = 8   # pa_block_size everywhere here
+
+
+def _make_app(hf_cfg, slots=2, blocks=48, seq_len=96):
+    tpu_cfg = TpuConfig(
+        batch_size=slots, seq_len=seq_len, max_context_length=32,
+        dtype="float32", context_encoding_buckets=[16, 32],
+        token_generation_buckets=[48, 96], is_continuous_batching=True,
+        paged_attention_enabled=True, pa_num_blocks=blocks, pa_block_size=BS)
+    config = LlamaInferenceConfig(tpu_cfg,
+                                  load_config=load_pretrained_config(hf_cfg))
+    app = LlamaForCausalLM(None, config)
+    app.load_random(seed=0)
+    return app
+
+
+@pytest.fixture(scope="module")
+def app(tiny_llama_hf_config):
+    return _make_app(tiny_llama_hf_config)
+
+
+def _replicas(app, n=2, tier=None):
+    return [EngineReplica(
+        str(i), lambda tel: ContinuousBatchingRunner(
+            app, decode_chunk=4, telemetry=tel, kv_tier=tier),
+        telemetry_enabled=True) for i in range(n)]
+
+
+def _prompts(seed, sizes):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(1, 256, size=(n,)).astype(np.int32) for n in sizes]
+
+
+def _reference(app, prompts, max_new):
+    return [app.generate(p[None, :], max_new_tokens=max_new
+                         ).tokens[0].tolist() for p in prompts]
+
+
+def _fleet_sources(router):
+    return [r.trace_source() for r in router.replicas.values()]
+
+
+# ------------------------------------------------------------- propagation
+def test_trace_ids_minted_and_propagated(app):
+    """router.submit mints the trace id; it reaches every replica arrival
+    event through placement, and a standalone runner's telemetry mints its
+    own when none is given."""
+    router = PrefixAffinityRouter(_replicas(app, 2))
+    rid = router.submit(np.arange(1, 11, dtype=np.int32), max_new_tokens=4)
+    tid = router.requests[rid].trace_id
+    assert tid and tid.startswith("t-")
+    router.run_to_completion()
+    arrivals = [e for r in router.replicas.values()
+                for e in r.runner.telemetry.events if e["event"] == "arrival"]
+    assert arrivals and all(e.get("trace_id") == tid for e in arrivals)
+    # journal events carry the same id
+    assert all(e["trace_id"] == tid for e in router.trace_events
+               if e.get("request_id") == rid)
+
+    tel = ServingTelemetry()
+    runner = ContinuousBatchingRunner(app, decode_chunk=4, telemetry=tel)
+    r2 = runner.submit(np.arange(1, 9, dtype=np.int32), max_new_tokens=2)
+    assert tel.trace_id_of(r2)          # locally minted
+    r3 = runner.submit(np.arange(1, 9, dtype=np.int32), max_new_tokens=2,
+                       trace_id="t-external-000001")
+    assert tel.trace_id_of(r3) == "t-external-000001"
+    runner.run_to_completion()
+
+
+# ------------------------------------------------------- single-runner trees
+def test_span_trees_complete_parented_and_reconciled(app):
+    """THE single-runner acceptance: every request yields a complete span
+    tree (all spans parented, none open after finish) whose waterfall
+    components reconcile to the recorded TTFT and E2E within 5%."""
+    tel = ServingTelemetry()
+    runner = ContinuousBatchingRunner(app, decode_chunk=4, telemetry=tel)
+    for p in _prompts(3, (12, 19, 10, 17)):
+        runner.submit(p, max_new_tokens=8)
+    runner.run_to_completion()
+    cov = tracing.validate_coverage(tel, tolerance=0.05)
+    assert cov["ok"], cov
+    assert cov["requests"] == 4
+    ts = tracing.build_trace_set(tracing.source_from_telemetry("r", tel))
+    for rid, trace in ts["traces"].items():
+        assert trace["complete"]
+        assert tracing.validate_trace(trace) == []
+        names = {s["name"] for s in trace["spans"]}
+        assert {"request", "queue_wait", "prefill_chunk", "decode"} <= names
+        # prefill spans link to the dispatch record that carried them
+        pf = [s for s in trace["spans"] if s["kind"] == "prefill"]
+        assert pf and all("step_index" in s["attrs"] for s in pf)
+        wf = tracing.waterfall(trace, ts["steps"])
+        assert wf["reconciled"], wf
+        assert wf["ttft_residual_frac"] <= 0.05
+        assert wf["e2e_residual_frac"] <= 0.05
+        # components are a partition: all non-negative
+        assert all(v >= 0 for v in wf["e2e_components_ms"].values())
+
+
+def test_span_leak_check_open_in_flight_closed_at_finish(app):
+    """inflight_span_trees reports OPEN spans mid-serving; after completion
+    every span is closed — the leak check the flight-recorder bundles rely
+    on."""
+    tel = ServingTelemetry()
+    runner = ContinuousBatchingRunner(app, decode_chunk=4, telemetry=tel)
+    for p in _prompts(5, (12, 19)):
+        runner.submit(p, max_new_tokens=12)
+    runner.step()
+    mid = tracing.inflight_span_trees(tel)
+    assert mid, "no in-flight trees mid-serving"
+    assert any(s["t1"] is None for t in mid for s in t["spans"])
+    runner.run_to_completion()
+    assert tracing.inflight_span_trees(tel) == []
+    ts = tracing.build_trace_set(tracing.source_from_telemetry("r", tel))
+    assert all(s["t1"] is not None
+               for t in ts["traces"].values() for s in t["spans"])
+
+
+def test_tier_readmit_span_attributed_to_requesting_request(app):
+    """A host-tier readmit dispatch is stamped with the request whose prefix
+    walk reserved the bytes, and lands as a tier_readmit span in ITS tree."""
+    tier = HostKVTier(capacity_blocks=32)
+    tel = ServingTelemetry()
+    runner = ContinuousBatchingRunner(app, decode_chunk=4, telemetry=tel,
+                                      kv_tier=tier)
+    prefix = np.arange(1, 2 * BS + 1, dtype=np.int32)
+    runner.submit(np.concatenate([prefix, [101, 102]]), max_new_tokens=4)
+    runner.run_to_completion()
+    runner.spill_idle_blocks()
+    rid = runner.submit(np.concatenate([prefix, [201, 202]]),
+                        max_new_tokens=4)
+    runner.run_to_completion()
+    ts = tracing.build_trace_set(tracing.source_from_telemetry("r", tel))
+    spans = [s for s in ts["traces"][rid]["spans"]
+             if s["kind"] == "tier_readmit"]
+    assert spans, "readmit never attributed to the requesting request"
+    assert tracing.validate_trace(ts["traces"][rid]) == []
+
+
+# ------------------------------------------------------------- continuity
+def test_trace_continuity_across_drain_migration(app):
+    """Forced drain mid-generation: the migrated request's fleet trace is ONE
+    connected tree with a migrated_from edge, zero orphan spans, and the
+    token stream is bit-identical to the untraced reference run."""
+    prompts = _prompts(31, (12, 19, 10, 17))
+    refs = _reference(app, prompts, max_new=16)
+    router = PrefixAffinityRouter(_replicas(app, 2))
+    rids = [router.submit(p, max_new_tokens=16) for p in prompts]
+    router.step()
+    assert router.drain_replica("0") >= 1, "nothing migrated — test is vacuous"
+    out = router.run_to_completion()
+    for i, rid in enumerate(rids):
+        assert out[rid] == refs[i], f"request {i} diverged under tracing"
+    fleet = tracing.build_fleet_traces(_fleet_sources(router),
+                                       router.trace_source())
+    migrated = [t for t in fleet.values() if len(t["segments"]) > 1]
+    assert migrated, "no multi-segment trace after a forced drain"
+    for t in fleet.values():
+        assert t["complete"]
+        assert tracing.validate_trace(t) == [], tracing.validate_trace(t)
+    for t in migrated:
+        segs = [s for s in t["spans"] if s["kind"] == "segment"]
+        assert len(segs) == len(t["segments"])
+        assert "migrated_from" in segs[1]["attrs"]
+        assert any(s["kind"] == "migration" for s in t["spans"])
+
+
+def test_trace_continuity_across_injected_death_and_recovery(app):
+    """Injected hard death + recover_replica: the displaced request's trace
+    SURVIVES the replica — a `recovered` span synthesized from the router
+    journal bridges the dead replica's truncated log to the survivor's
+    segment (recovered_from edge), every span parented and closed, tokens
+    bit-identical to the fault-free reference."""
+    prompts = _prompts(37, (12, 19, 10, 17))
+    refs = _reference(app, prompts, max_new=10)
+    inj = FaultInjector("death@0:at_step=2", seed=0)
+    router = PrefixAffinityRouter(_replicas(app, 2), fault_injector=inj,
+                                  auto_recover=True)
+    rids = [router.submit(p, max_new_tokens=10) for p in prompts]
+    out = router.run_to_completion()
+    assert inj.fired_total >= 1
+    for i, rid in enumerate(rids):
+        assert out[rid] == refs[i], f"request {i} diverged after recovery"
+    fleet = tracing.build_fleet_traces(_fleet_sources(router),
+                                       router.trace_source())
+    recovered = [t for t in fleet.values()
+                 if any(s["kind"] == "recovered" for s in t["spans"])]
+    assert recovered, "no recovered span synthesized from the journal"
+    for t in recovered:
+        assert t["complete"]
+        assert tracing.validate_trace(t) == [], tracing.validate_trace(t)
+        segs = [s for s in t["spans"] if s["kind"] == "segment"]
+        assert len(segs) >= 2
+        assert "recovered_from" in segs[-1]["attrs"]
+        # the dead replica's open spans were closed at the hand-off
+        assert all(s["t1"] is not None for s in t["spans"])
+    # every trace in the fleet is complete despite the death
+    assert all(t["complete"] for t in fleet.values())
+
+
+# ------------------------------------------------------------- exemplars
+def test_exemplar_exposition_gated_and_valid():
+    """Histogram buckets carry `# {trace_id="..."} value ts` ONLY under
+    exemplars=True; the default exposition stays plain-Prometheus valid."""
+    import re
+
+    reg = MetricsRegistry()
+    h = reg.histogram("ttft_seconds", buckets=(0.1, 1.0), help="ttft")
+    h.observe(0.05, exemplar={"trace_id": "t-abc-000001"})
+    h.observe(5.0, exemplar={"trace_id": "t-abc-000002"})
+    h.observe(0.07)                      # no exemplar: bucket keeps the last
+    plain = reg.prometheus_text()
+    assert "# {" not in plain.replace("# HELP", "").replace("# TYPE", "")
+    for line in plain.splitlines():
+        assert re.fullmatch(
+            r"(# (HELP|TYPE) .*)|([a-zA-Z_:][a-zA-Z0-9_:]*({[^}]*})? \S+)",
+            line), f"invalid plain exposition line: {line}"
+    ex = reg.prometheus_text(exemplars=True)
+    b1 = next(l for l in ex.splitlines() if 'le="0.1"' in l)
+    assert '# {trace_id="t-abc-000001"} 0.05' in b1
+    binf = next(l for l in ex.splitlines() if 'le="+Inf"' in l)
+    assert '# {trace_id="t-abc-000002"} 5.0' in binf
+    # exemplar suffix carries a unix timestamp
+    assert float(b1.rsplit(" ", 1)[1]) > 1e9
+    # registry reset drops exemplars with the counts
+    reg.reset()
+    assert h.exemplars is None
+    # disabled registries accept the exemplar kwarg as a no-op
+    MetricsRegistry(enabled=False).histogram("x").observe(1.0,
+                                                          exemplar={"a": "b"})
+
+
+def test_ttft_histogram_carries_request_exemplar():
+    tel = ServingTelemetry()
+    tel.request_arrival(0, prompt_len=8, max_new_tokens=4)
+    tel.request_placed(0, slot=0)
+    tel.note_emitted({0: [7]})
+    tid = tel.trace_id_of(0)
+    text = tel.prometheus_text(exemplars=True)
+    assert f'trace_id="{tid}"' in text
+    assert f'trace_id="{tid}"' not in tel.prometheus_text()
+
+
+# ------------------------------------------------------------- slo offenders
+def test_slo_violation_names_worst_k_offenders(caplog):
+    """A violated latency target names its worst-k requests (ids + trace ids
+    + values) in both the SLOReport and the structured slo_violation line."""
+    import logging
+
+    tel = ServingTelemetry()
+    now = time.perf_counter()
+    # three requests with TTFTs ~1000/600/10 ms via backdated arrivals
+    for rid, age in ((0, 1.0), (1, 0.6), (2, 0.01)):
+        tel.request_arrival(rid, prompt_len=8, max_new_tokens=4,
+                            ts=now - age)
+        tel.request_placed(rid, slot=rid)
+        tel.note_emitted({rid: [5]})
+    mon = SLOMonitor(tel, SLOConfig(ttft_p99_ms=50.0, worst_k=2))
+    with caplog.at_level(logging.WARNING, logger="tpu-inference"):
+        rep = mon.evaluate()
+    assert not rep.healthy
+    off = rep.offenders["ttft_p99_ms"]
+    assert [o["request_id"] for o in off] == [0, 1]       # worst first, k=2
+    assert off[0]["value_ms"] > off[1]["value_ms"] > 500.0
+    assert off[0]["trace_id"] == tel.trace_id_of(0)
+    line = next(r.message for r in caplog.records
+                if r.message.startswith("slo_violation "))
+    payload = json.loads(line.split(" ", 1)[1])
+    assert payload["offenders"]["ttft_p99_ms"] == off
+    # parse() accepts the worst_k knob as an int
+    assert SLOConfig.parse("ttft_p99_ms=50,worst_k=5").worst_k == 5
+
+
+# ------------------------------------------------------------- bundles
+def test_debug_bundle_embeds_inflight_span_trees(app, tmp_path):
+    from neuronx_distributed_inference_tpu.utils.flight_recorder import (
+        load_bundle)
+
+    tel = ServingTelemetry()
+    runner = ContinuousBatchingRunner(app, decode_chunk=4, telemetry=tel)
+    for p in _prompts(7, (12, 19)):
+        runner.submit(p, max_new_tokens=12)
+    runner.step()
+    path = str(tmp_path / "bundle.json")
+    tel.flight.dump_bundle(path, metrics=tel.registry.to_dict(),
+                           spans=tracing.inflight_span_trees(tel),
+                           reason="test")
+    b = load_bundle(path)
+    assert b["spans"], "bundle carries no in-flight span trees"
+    assert all(t["complete"] is False for t in b["spans"])
+    assert all(s["parent"] is None or isinstance(s["parent"], int)
+               for t in b["spans"] for s in t["spans"])
+    runner.run_to_completion()
+
+
+# ------------------------------------------------------------- fleet export
+def test_merged_chrome_trace_shared_epoch_and_prefixed_tracks(app):
+    router = PrefixAffinityRouter(_replicas(app, 2))
+    for p in _prompts(9, (12, 19, 10)):
+        router.submit(p, max_new_tokens=6)
+    router.run_to_completion()
+    trace = tracing.merged_chrome_trace(_fleet_sources(router),
+                                        router.trace_source())
+    evs = trace["traceEvents"]
+    procs = {e["args"]["name"] for e in evs if e["name"] == "process_name"}
+    assert procs == {"router", "replica0", "replica1"}
+    tracks = {e["args"]["name"] for e in evs if e["name"] == "thread_name"}
+    assert "replica0:steps" in tracks and "replica1:requests" in tracks
+    # shared-epoch normalization: all timestamps non-negative, and the
+    # earliest source starts at ~0
+    tss = [e["ts"] for e in evs if "ts" in e and e["ph"] != "M"]
+    assert min(tss) >= 0.0
+    # request async spans join per trace id (begin+end, same id)
+    begins = [e for e in evs if e["ph"] == "b"]
+    ends = [e for e in evs if e["ph"] == "e"]
+    assert begins and len(begins) == len(ends)
+    assert all(e["id"].startswith("t-") for e in begins)
+    # every replica step slice is replica-scoped (distinct pids)
+    step_pids = {e["pid"] for e in evs if e["ph"] == "X"}
+    assert len(step_pids) == 2
+
+
+def test_jsonl_round_trip_offline_sources(app, tmp_path):
+    """The JSONL spool (with its telemetry_epoch header) reloads into the
+    same traces the in-memory stream yields — the offline path
+    explain_request.py uses."""
+    path = str(tmp_path / "ev.jsonl")
+    tel = ServingTelemetry(jsonl_path=path)
+    runner = ContinuousBatchingRunner(app, decode_chunk=4, telemetry=tel)
+    rids = [runner.submit(p, max_new_tokens=6)
+            for p in _prompts(11, (12, 19))]
+    runner.run_to_completion()
+    tel.close()
+    src = tracing.load_jsonl_source(path, name="offline")
+    assert src["epoch"] == tel.epoch
+    offline = tracing.build_trace_set(src)
+    live = tracing.build_trace_set(tracing.source_from_telemetry("live", tel))
+    assert set(offline["traces"]) == set(live["traces"]) == set(rids)
+    for rid in rids:
+        assert (offline["traces"][rid]["trace_id"]
+                == live["traces"][rid]["trace_id"])
+        wf = tracing.waterfall(offline["traces"][rid], offline["steps"])
+        assert wf["reconciled"], wf
+
+
+def test_explain_request_cli_waterfall_reconciles(app, tmp_path, capsys):
+    spec = importlib.util.spec_from_file_location(
+        "explain_request", os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "scripts", "explain_request.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    path = str(tmp_path / "ev.jsonl")
+    tel = ServingTelemetry(jsonl_path=path)
+    runner = ContinuousBatchingRunner(app, decode_chunk=4, telemetry=tel)
+    for p in _prompts(13, (12, 19, 10)):
+        runner.submit(p, max_new_tokens=6)
+    runner.run_to_completion()
+    tel.close()
+
+    assert mod.main([path, "--all"]) == 0
+    text = capsys.readouterr().out
+    assert "reconciliation: components sum within" in text and "[OK]" in text
+    assert mod.main([path, "--request", "1"]) == 0
+    out = capsys.readouterr().out
+    assert "request 1 " in out and "queue_wait" in out
+    # machine-readable mode round-trips
+    assert mod.main([path, "--all", "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["ok"] and len(payload["requests"]) == 3
+    # a missing request id is a distinct error code
+    assert mod.main([path, "--request", "99"]) == 2
+
+
+def test_explain_request_cli_fleet_mode_single_connected_trace(
+        app, tmp_path, capsys):
+    """Fleet mode: replica spools + the router journal reconstruct a
+    migrated request as ONE connected trace with segment waterfalls."""
+    spec = importlib.util.spec_from_file_location(
+        "explain_request", os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "scripts", "explain_request.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    paths = [str(tmp_path / f"ev.replica{i}") for i in range(2)]
+    reps = [EngineReplica(
+        str(i), lambda tel: ContinuousBatchingRunner(app, decode_chunk=4,
+                                                     telemetry=tel),
+        telemetry_enabled=True, jsonl_path=paths[i]) for i in range(2)]
+    router = PrefixAffinityRouter(reps)
+    for p in _prompts(17, (12, 19, 10, 17)):
+        router.submit(p, max_new_tokens=16)
+    router.step()
+    assert router.drain_replica("0") >= 1
+    router.run_to_completion()
+    rpath = router.write_trace_events(str(tmp_path / "ev.router"))
+    for rep in reps:
+        rep.runner.telemetry.close()
+
+    assert mod.main(paths + ["--router", rpath, "--all"]) == 0
+    out = capsys.readouterr().out
+    assert "segment(s)" in out
+    assert "migrated_from" in out
+
+
+def test_explain_request_cli_fleet_mode_fails_on_incomplete_trace(
+        tmp_path, capsys):
+    """Fleet mode holds the same integrity contract as single-file mode: a
+    request the fleet never finished (killed mid-flight or genuinely lost)
+    exits non-zero under --all instead of green-lighting the loss."""
+    spec = importlib.util.spec_from_file_location(
+        "explain_request", os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "scripts", "explain_request.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    p0, p1 = str(tmp_path / "ev.replica0"), str(tmp_path / "ev.replica1")
+    tel0 = ServingTelemetry(jsonl_path=p0)
+    tel0.request_arrival(0, prompt_len=4, max_new_tokens=4)   # never finishes
+    tel0.close()
+    ServingTelemetry(jsonl_path=p1).close()
+    assert mod.main([p0, p1, "--all"]) == 1
+    assert "trace incomplete" in capsys.readouterr().out
