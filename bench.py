@@ -641,6 +641,23 @@ def main() -> None:
             except Exception as e:
                 _note(f"fault phase failed: {e}")
 
+        if paged_app is not None and _remaining() > 200:
+            # ISSUE-13 multi-tenant overload phase: a bursty bulk tenant +
+            # steady interactive tenant on the SAME trace, served by the SLA
+            # control plane (weighted-fair budgets, priority preemption,
+            # brown-out shed) vs a FIFO control. Publishes per-class
+            # TTFT/TPOT percentiles, goodput under overload, shed-by-class,
+            # and a preempt-resume bit-exactness marker; REFUSES
+            # (multitenant_invalid) if no shed/preemption actually fired.
+            _note("phase: multi-tenant overload serving (SLA classes vs "
+                  "FIFO control)")
+            try:
+                extra.update(_multitenant_serving(
+                    paged_app, paged_app.tpu_config.max_batch_size,
+                    extra.get("paged_serving_tok_per_s")))
+            except Exception as e:
+                _note(f"multitenant phase failed: {e}")
+
     # FINAL EMIT: same schema, enriched extra. The driver parses the last JSON
     # line; if the process was killed earlier, the early emit already landed.
     print(json.dumps(result), flush=True)
@@ -1476,6 +1493,291 @@ def _router_fault_serving(app, batch, closed_loop_tok_s, n_replicas=2):
     })
     if f["lost"] or not exact:
         _note(f"FAULT PHASE REGRESSION: lost={f['lost']} bit_exact={exact}")
+    return out
+
+
+def _multitenant_serving(app, batch, closed_loop_tok_s, n_replicas=2):
+    """ISSUE-13 multi-tenant overload phase: one trace — a BURSTY bulk
+    tenant (clumped long prompts) beside a STEADY Poisson interactive
+    tenant — served twice:
+
+    - **sla**: the overload control plane ON — SLA classes with
+      weighted-fair mixed-step prefill budgets, priority placement,
+      preemptive priorities, and the brown-out ladder driven by a frontend
+      backlog health signal;
+    - **fifo**: the classless control — same replicas, same trace, plain
+      FIFO everywhere.
+
+    Runs on a dedicated OVERLOAD PROBE fleet (tiny llama, 2 replicas x 2
+    slots, recorded in ``multitenant_probe_arch``): overload behavior is a
+    property of the control plane, not the model, and the 64-slot bench app
+    cannot be saturated within the phase budget — the same isolation
+    argument as the bs=1 dispatch-floor probe. Latency is measured at the
+    FRONTEND (submit wall time -> first/last folded token), identically for
+    both legs and robust to migration/preemption. Publishes per-class
+    TTFT/TPOT p50/p99 for both legs, ``goodput_under_overload_ratio``
+    (interactive tokens from requests whose TTFT landed within 2x the
+    unloaded p99, sla leg over FIFO control), ``requests_shed_by_class``,
+    preemption counts, and ``preempted_resumed_bit_exact`` (every admitted
+    stream token-compared against its dedicated single-request greedy
+    reference — preempted/migrated streams included).
+
+    HONESTY GUARD (r5 pattern): if the sla leg fired NO shed and NO
+    preemption, the overload never actually engaged the control plane —
+    the latency/goodput keys are REFUSED and ``multitenant_invalid`` says
+    why."""
+    import gc
+    import time as _time
+
+    from neuronx_distributed_inference_tpu.config import (
+        TpuConfig, load_pretrained_config)
+    from neuronx_distributed_inference_tpu.models.llama.modeling_llama import (
+        LlamaForCausalLM, LlamaInferenceConfig)
+    from neuronx_distributed_inference_tpu.runtime.continuous_batching import (
+        ContinuousBatchingRunner)
+    from neuronx_distributed_inference_tpu.serving import (
+        EngineReplica, PrefixAffinityRouter, RouterOverloaded, SLAClass,
+        SLAClassSet)
+
+    del app, batch, closed_loop_tok_s          # probe fleet (see docstring)
+    probe_hf = {
+        "model_type": "llama", "vocab_size": 256, "hidden_size": 64,
+        "intermediate_size": 128, "num_hidden_layers": 2,
+        "num_attention_heads": 4, "num_key_value_heads": 2,
+        "max_position_embeddings": 512, "rms_norm_eps": 1e-5,
+        "rope_theta": 10000.0, "tie_word_embeddings": False,
+    }
+    seq, block, slots = 192, 8, 2
+    cfg = TpuConfig(batch_size=slots, seq_len=seq, max_context_length=48,
+                    dtype="float32", context_encoding_buckets=[16, 48],
+                    token_generation_buckets=[seq],
+                    is_continuous_batching=True, paged_attention_enabled=True,
+                    pa_num_blocks=120, pa_block_size=block)
+    config = LlamaInferenceConfig(cfg,
+                                  load_config=load_pretrained_config(probe_hf))
+    papp = LlamaForCausalLM(None, config)
+    papp.load_random(seed=0)
+    sla = SLAClassSet([
+        SLAClass("interactive", priority=0, weight=4.0, sheddable=False),
+        SLAClass("bulk", priority=1, weight=1.0)], default="bulk")
+
+    rng = np.random.default_rng(29)
+    inter_len, inter_new = 12, 10
+    bulk_len, bulk_new = 80, 32
+    # the trace, in ROUTER STEPS (deterministic across box speeds): bulk
+    # arrives in two clumps (the bursty tenant), interactive arrivals are
+    # Poisson-gapped throughout
+    bulk_bursts = {0: 5, 8: 5, 11: 3}
+    n_inter = 10
+    inter_steps = np.cumsum(np.maximum(1, rng.poisson(3.0, size=n_inter)))
+    inter_prompts = [rng.integers(1, 250, size=(inter_len,)).astype(np.int32)
+                     for _ in range(n_inter)]
+    bulk_prompts = [rng.integers(1, 250, size=(bulk_len,)).astype(np.int32)
+                    for _ in range(sum(bulk_bursts.values()))]
+    refs = {("i", i): papp.generate(p[None, :], max_new_tokens=inter_new
+                                    ).tokens[0].tolist()
+            for i, p in enumerate(inter_prompts)}
+    refs.update({("b", i): papp.generate(p[None, :], max_new_tokens=bulk_new
+                                         ).tokens[0].tolist()
+                 for i, p in enumerate(bulk_prompts)})
+
+    def build_router(with_sla):
+        classes = sla if with_sla else None
+        reps = [EngineReplica(
+            str(i), lambda tel: ContinuousBatchingRunner(
+                papp, decode_chunk=4, prefill_chunk=16,
+                prefill_token_budget=32, mixed_decode_steps=2,
+                telemetry=tel, sla_classes=classes),
+            # a shallow replica queue keeps the backlog at the FRONTEND,
+            # where the shed/brown-out machinery lives (a deep replica
+            # queue would just hide the overload from the router)
+            max_queue_depth=2)
+            for i in range(n_replicas)]
+        holder = {}
+        router = PrefixAffinityRouter(
+            reps, sla_classes=classes,
+            # health = "the frontend backlog is small": sustained backlog
+            # IS the overload the brown-out ladder exists for
+            slo_signal=((lambda: len(holder["r"].queue) < 3) if with_sla
+                        else None),
+            brownout_up_after=1, brownout_down_after=3)
+        holder["r"] = router
+        # warm every executable this schedule touches (mixed dispatch,
+        # insert windows, plain chunks) OUTSIDE the measured trace — each
+        # leg builds fresh runners, so each leg pays its own compiles here
+        warm_rng = np.random.default_rng(5)
+        for n, mx in ((inter_len, inter_new), (bulk_len, bulk_new)):
+            router.submit(warm_rng.integers(1, 250, size=(n,)).astype(
+                np.int32), max_new_tokens=mx)
+        router.run_to_completion()
+        return router, reps
+
+    def run_leg(with_sla):
+        router, reps = build_router(with_sla)
+        t0 = _time.perf_counter()
+        placed = {}                      # (tenant, idx) -> frontend rid
+        arrive, first, last, ntok = {}, {}, {}, {}
+        shed = 0
+        bursts = dict(bulk_bursts)
+        bi = ii = step = 0
+
+        def _submit(key, prompt, max_new, cls):
+            nonlocal shed
+            now = _time.perf_counter()
+            try:
+                rid = router.submit(
+                    prompt, max_new_tokens=max_new, arrival_ts=now,
+                    **({"sla_class": cls} if with_sla else {}))
+            except RouterOverloaded:
+                shed += 1
+                return
+            placed[key] = rid
+            arrive[rid] = now
+
+        while step < 500:
+            for _ in range(bursts.pop(step, 0)):
+                _submit(("b", bi), bulk_prompts[bi], bulk_new, "bulk")
+                bi += 1
+            while ii < n_inter and inter_steps[ii] <= step:
+                _submit(("i", ii), inter_prompts[ii], inter_new,
+                        "interactive")
+                ii += 1
+            em = router.step()
+            now = _time.perf_counter()
+            for rid, toks in em.items():
+                if toks:
+                    first.setdefault(rid, now)
+                    last[rid] = now
+                    ntok[rid] = ntok.get(rid, 0) + len(toks)
+            step += 1
+            if ii >= n_inter and not bursts and not router.has_work:
+                break
+        wall = _time.perf_counter() - t0
+        # bit-exactness over every ADMITTED stream — preempted/migrated
+        # included (shed requests were refused typed+counted at the door,
+        # never silently lost). A stream cut short by the step cap is
+        # TRUNCATION (its tokens must be a strict prefix of the reference),
+        # not divergence — the refusal below handles it; only a non-prefix
+        # mismatch is a real regression.
+        exact, truncated = True, False
+        for key, rid in placed.items():
+            gen, ref = router.requests[rid].generated, refs[key]
+            if gen == ref:
+                continue
+            if not router.requests[rid].done and ref[: len(gen)] == gen:
+                truncated = True
+            else:
+                exact = False
+        complete = (ii >= n_inter and not bursts and not router.has_work
+                    and not truncated)
+        finished = sum(1 for rid in placed.values()
+                       if router.requests[rid].done)
+        ttft = {"interactive": [], "bulk": []}
+        tpot = {"interactive": [], "bulk": []}
+        for (kind, _i), rid in placed.items():
+            cls = "interactive" if kind == "i" else "bulk"
+            if rid in first:
+                ttft[cls].append(first[rid] - arrive[rid])
+            if rid in first and ntok.get(rid, 0) > 1:
+                tpot[cls].append((last[rid] - first[rid]) / (ntok[rid] - 1))
+        s = router.stats()
+        leg = {
+            "wall": wall, "steps": step, "shed": shed, "exact": exact,
+            "complete": complete,
+            "finished": finished, "admitted": len(placed),
+            "ttft": ttft, "tpot": tpot,
+            "class_preemptions": sum(
+                s.get("sla", {}).get("preempted_by_class", {}).values()),
+            "shed_by_class": dict(
+                s.get("sla", {}).get("shed_by_class", {})),
+            "brownout_transitions": len(
+                [e for e in router.trace_events if e["event"] == "brownout"]),
+            "inter_tok_in_target": None,   # filled by the caller (needs bar)
+            "placed": placed, "router_requests": router.requests,
+            "first": first, "arrive": arrive,
+        }
+        for rep in reps:
+            _drain_runner(rep.runner)
+        del router, reps
+        gc.collect()
+        return leg
+
+    # ---- unloaded interactive TTFT: the acceptance bar's denominator -------
+    router0, reps0 = build_router(True)
+    un_samples = []
+    for p in inter_prompts[:4]:
+        t = _time.perf_counter()
+        rid = router0.submit(p, max_new_tokens=inter_new, arrival_ts=t,
+                             sla_class="interactive")
+        while not router0.requests[rid].generated:
+            router0.step()
+        un_samples.append(_time.perf_counter() - t)
+        router0.run_to_completion()
+    for rep in reps0:
+        _drain_runner(rep.runner)
+    del router0, reps0
+    gc.collect()
+    un_p99 = _p_ms(un_samples, "latency_ms_p99")
+
+    legs = {name: run_leg(with_sla)
+            for name, with_sla in (("sla", True), ("fifo", False))}
+
+    out = {
+        "multitenant_replicas": n_replicas,
+        "multitenant_probe_arch": "llama 2L/64H probe, 2x2 slots (overload "
+                                  "isolation; control-plane behavior is "
+                                  "model-independent)",
+        "multitenant_interactive_ttft_p99_unloaded_ms": round(un_p99, 1),
+    }
+    target_s = 2.0 * un_p99 / 1e3       # the acceptance bar: 2x unloaded p99
+    for name, leg in legs.items():
+        for cls in ("interactive", "bulk"):
+            for metric, samples in (("ttft", leg["ttft"][cls]),
+                                    ("tpot", leg["tpot"][cls])):
+                for q in ("p50", "p99"):
+                    out[f"multitenant_{name}_{cls}_{metric}_{q}_ms"] = (
+                        round(_p_ms(samples, f"latency_ms_{q}"), 1)
+                        if samples else None)
+        # goodput: tokens of interactive requests whose TTFT met the bar
+        good = sum(len(leg["router_requests"][rid].generated)
+                   for (kind, _i), rid in leg["placed"].items()
+                   if kind == "i" and rid in leg["first"]
+                   and leg["first"][rid] - leg["arrive"][rid] <= target_s)
+        leg["goodput_tok_s"] = good / leg["wall"]
+        out[f"multitenant_{name}_interactive_goodput_tok_per_s"] = round(
+            leg["goodput_tok_s"], 2)
+    s_leg = legs["sla"]
+    out["requests_shed_by_class"] = s_leg["shed_by_class"]
+    out["multitenant_shed_total"] = s_leg["shed"]
+    out["multitenant_class_preemptions"] = s_leg["class_preemptions"]
+    out["multitenant_brownout_transitions"] = s_leg["brownout_transitions"]
+    if not (s_leg["complete"] and legs["fifo"]["complete"]):
+        # the step cap cut a leg short: its streams are prefixes, not
+        # measurements — refuse rather than publish truncated latencies (or
+        # a false bit-exactness regression)
+        out["multitenant_invalid"] = (
+            "a leg did not complete within the step cap — truncated streams "
+            "measure the cap, not the control plane")
+        _note(f"multitenant phase INVALID: {out['multitenant_invalid']}")
+        return out
+    if s_leg["shed"] == 0 and s_leg["class_preemptions"] == 0:
+        out["multitenant_invalid"] = (
+            "no shed and no preemption fired in the sla leg — the overload "
+            "trace never engaged the control plane; its latency/goodput "
+            "numbers would be vacuous")
+        _note(f"multitenant phase INVALID: {out['multitenant_invalid']}")
+        return out
+    out["preempted_resumed_bit_exact"] = bool(
+        s_leg["exact"] and legs["fifo"]["exact"])
+    out["goodput_under_overload_ratio"] = round(
+        s_leg["goodput_tok_s"] / max(legs["fifo"]["goodput_tok_s"], 1e-9), 3)
+    p99_sla = out.get("multitenant_sla_interactive_ttft_p99_ms")
+    if p99_sla is not None and un_p99 > 0:
+        out["multitenant_interactive_ttft_p99_vs_unloaded"] = round(
+            p99_sla / un_p99, 3)
+    if not out["preempted_resumed_bit_exact"]:
+        _note("MULTITENANT PHASE REGRESSION: a preempted/admitted stream "
+              "diverged from its reference")
     return out
 
 

@@ -153,6 +153,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "on prefix hits")
     g.add_argument("--kv-tier-blocks", type=int, default=1024, metavar="N",
                    help="host-RAM tier capacity in KV blocks (default 1024)")
+    g.add_argument("--sla-classes", default=None, metavar="SPEC",
+                   help="with --serve: SLA class set (serving/sla.py "
+                        "grammar, e.g. \"interactive:priority=0,weight=4;"
+                        "batch:priority=1,weight=1\"; the literal "
+                        "\"default\" = the stock interactive/standard/batch "
+                        "set). Turns on weighted-fair mixed-step prefill "
+                        "budgets in every runner and — on the routed path — "
+                        "priority placement, preemptive priorities, and the "
+                        "SLO-driven brown-out ladder")
     g.add_argument("--inject-faults", default=None, metavar="SPEC",
                    help="with --serve --replicas N: drive the routed fleet "
                         "through the deterministic fault injector "
@@ -605,6 +614,35 @@ def _build_spec_engine(args, app, tokenizer=None):
     return engine
 
 
+def _parse_sla_classes(spec: str):
+    """--sla-classes SPEC -> SLAClassSet; \"default\" = the stock set."""
+    from .serving.sla import SLAClassSet, default_class_set
+
+    if spec.strip().lower() == "default":
+        return default_class_set()
+    return SLAClassSet.parse(spec)
+
+
+def _merge_class_slo_targets(slo_cfg, sla_classes) -> None:
+    """The class set's declared latency targets (--sla-classes
+    \"interactive:ttft_target_ms=150,...\") feed the SLO monitor's
+    per-class evaluation; explicit dotted --slo keys win on collision.
+    A dotted --slo key naming a class OUTSIDE the set raises — a typo'd
+    per-class SLO must not silently never evaluate."""
+    if sla_classes is None:
+        return
+    unknown = [c for c in slo_cfg.class_targets
+               if c not in sla_classes.names()]
+    if unknown:
+        raise SystemExit(
+            f"--slo names unknown SLA class(es) {unknown} "
+            f"(--sla-classes defines {sla_classes.names()})")
+    for cls, targets in sla_classes.slo_class_targets().items():
+        merged = dict(targets)
+        merged.update(slo_cfg.class_targets.get(cls, {}))
+        slo_cfg.class_targets[cls] = merged
+
+
 def _run_serving(args, app, tokenizer) -> None:
     """Slot-based continuous-batching serving over the CLI prompts
     (≈ the reference's continuous-batching serve path). Any of
@@ -638,6 +676,11 @@ def _run_serving(args, app, tokenizer) -> None:
         # forwarded even without --megastep so the runner's own validation
         # raises instead of silently ignoring the flag
         kw["megastep_ring"] = args.megastep_ring
+    if args.sla_classes:
+        # single-runner serving gets the weighted-fair mixed-step budgets;
+        # the router-level machinery (priority placement, preemption,
+        # brown-out) lives on the routed path
+        kw["sla_classes"] = _parse_sla_classes(args.sla_classes)
     telemetry = None
     if (args.metrics_out or args.trace_out or args.events_out
             or args.stats_interval or args.slo or args.debug_bundle):
@@ -649,7 +692,9 @@ def _run_serving(args, app, tokenizer) -> None:
     if args.slo:
         from .utils.slo import SLOConfig, SLOMonitor
 
-        slo_monitor = SLOMonitor(telemetry, SLOConfig.parse(args.slo))
+        slo_cfg = SLOConfig.parse(args.slo)
+        _merge_class_slo_targets(slo_cfg, kw.get("sla_classes"))
+        slo_monitor = SLOMonitor(telemetry, slo_cfg)
 
     def _dump_bundle(reason: str) -> str:
         from .serving import tracing
@@ -745,6 +790,10 @@ def _run_serving_routed(args, app, tokenizer) -> None:
         kw["megastep_k"] = args.megastep
     if args.megastep_ring:
         kw["megastep_ring"] = args.megastep_ring
+    sla_classes = (_parse_sla_classes(args.sla_classes)
+                   if args.sla_classes else None)
+    if sla_classes is not None:
+        kw["sla_classes"] = sla_classes
     telemetry_on = bool(args.metrics_out or args.trace_out or args.events_out
                         or args.stats_interval or args.slo
                         or args.debug_bundle)
@@ -767,18 +816,22 @@ def _run_serving_routed(args, app, tokenizer) -> None:
         injector = FaultInjector(args.inject_faults)
     router = PrefixAffinityRouter(
         replicas, fault_injector=injector, auto_recover=True,
+        sla_classes=sla_classes,
         debug_bundle_dir=(os.path.dirname(args.debug_bundle) or "."
                           if args.debug_bundle else None))
-    logger.info("routed serving: %d replicas, kv host tier: %s, faults: %s",
+    logger.info("routed serving: %d replicas, kv host tier: %s, faults: %s, "
+                "sla: %s",
                 args.replicas,
                 f"{args.kv_tier_blocks} blocks" if tier else "off",
-                args.inject_faults or "off")
+                args.inject_faults or "off",
+                sla_classes if sla_classes is not None else "off")
 
     slo_monitors = []
     if args.slo:
         from .utils.slo import SLOConfig, SLOMonitor
 
         slo_cfg = SLOConfig.parse(args.slo)
+        _merge_class_slo_targets(slo_cfg, sla_classes)
         slo_monitors = [(rep, SLOMonitor(rep.runner.telemetry, slo_cfg))
                         for rep in replicas]
 

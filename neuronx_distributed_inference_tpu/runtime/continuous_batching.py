@@ -91,6 +91,11 @@ class Request:
     done: bool = False
     truncated: bool = False              # force-finished out of cache room
     placed_seq: int = -1                 # placement order; newest = preemption victim
+    # SLA class (serving/sla.py): the tenant tier this request serves under.
+    # None = runner has no class set (every scheduling decision is legacy
+    # FIFO); with a class set, the mixed-step weighted-fair budget split and
+    # the router's priority placement / preemption / brown-out read it.
+    sla_class: Optional[str] = None
 
 
 class ContinuousBatchingRunner:
@@ -123,7 +128,7 @@ class ContinuousBatchingRunner:
                  mixed_decode_steps: Optional[int] = None,
                  megastep_k: Optional[int] = None,
                  megastep_ring: Optional[int] = None,
-                 telemetry=None, kv_tier=None):
+                 telemetry=None, kv_tier=None, sla_classes=None):
         cfg = app.tpu_config
         if not cfg.is_continuous_batching:
             raise ValueError("tpu_config.is_continuous_batching must be enabled")
@@ -238,6 +243,25 @@ class ContinuousBatchingRunner:
         # immediately followed by a pure-decode fall-through loses neither).
         self._pending_fall_through: List[str] = []
         self._ft_counters: Dict[tuple, object] = {}
+        # --- SLA classes (serving/sla.py, overload control plane) -------------
+        # ``sla_classes``: an SLAClassSet. None (the default) keeps every
+        # scheduling decision bit-identical to the classless runner: requests
+        # carry sla_class=None and the mixed-step budget assignment stays
+        # pure FIFO. With a set, submits resolve (and validate) their class,
+        # telemetry labels TTFT/TPOT/queue observations with it, and
+        # _step_mixed splits the prefill token budget across the classes
+        # present by weight (work-conserving — see _assign_prefill_chunks).
+        if sla_classes is not None:
+            from ..serving.sla import SLAClassSet
+
+            if not isinstance(sla_classes, SLAClassSet):
+                raise ValueError("sla_classes must be a serving.sla."
+                                 "SLAClassSet (or None)")
+        self.sla = sla_classes
+        # per-class prompt-token accounting (weighted-fair visibility):
+        # serving_class_prefill_tokens_total{sla_class=} counts what each
+        # class actually drew from the budget
+        self._class_prefill_counters: Dict[str, object] = {}
         self.mixed = prefill_chunk is not None
         self.prefill_chunk = prefill_chunk
         self.prefill_budget = (prefill_token_budget
@@ -1710,7 +1734,8 @@ class ContinuousBatchingRunner:
                sampling_params=None, adapter_id: int = 0,
                arrival_ts: Optional[float] = None,
                resume_tokens: Optional[Sequence[int]] = None,
-               trace_id: Optional[str] = None) -> int:
+               trace_id: Optional[str] = None,
+               sla_class: Optional[str] = None) -> int:
         """``sampling_params``: per-request (3,) [top_k, top_p, temperature]
         (≈ reference per-request sampling, `generation/sampling.py:99-209`);
         ``adapter_id``: multi-LoRA slot, 0 = base (≈ CB forward adapter_ids,
@@ -1726,7 +1751,10 @@ class ContinuousBatchingRunner:
         ``trace_id``: request-scoped trace context (serving/tracing.py) —
         the router threads its frontend-minted id here so this runner's
         lifecycle events stay joinable with the other replicas' into one
-        causal span tree (default: the telemetry mints a local one)."""
+        causal span tree (default: the telemetry mints a local one);
+        ``sla_class``: the tenant tier (serving/sla.py) — requires the
+        runner to have been built with ``sla_classes=``; unlabelled submits
+        map to the set's default class."""
         prompt = np.asarray(prompt).astype(np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
@@ -1781,8 +1809,15 @@ class ContinuousBatchingRunner:
         if resume_tokens is not None and len(resume_tokens) >= max_new_tokens:
             raise ValueError("resume_tokens already meets max_new_tokens — "
                              "the migrated request is finished, not served")
+        if self.sla is not None:
+            sla_class = self.sla.resolve(sla_class)    # unknown class raises
+        elif sla_class is not None:
+            raise ValueError("sla_class given but the runner has no "
+                             "sla_classes set (pass sla_classes= at "
+                             "construction)")
         req = Request(self._next_id, prompt, max_new_tokens, eos_token_id,
-                      sampling_params=sampling_params, adapter_id=adapter_id)
+                      sampling_params=sampling_params, adapter_id=adapter_id,
+                      sla_class=sla_class)
         if resume_tokens:
             # cross-replica migration: enters the preemption-resume path at
             # placement (prompt + resume_tokens[:-1] refed, last token is the
@@ -1792,7 +1827,8 @@ class ContinuousBatchingRunner:
         self.queue.append(req)
         self.telemetry.request_arrival(req.request_id, int(prompt.size),
                                        max_new_tokens, ts=arrival_ts,
-                                       trace_id=trace_id)
+                                       trace_id=trace_id,
+                                       sla_class=sla_class)
         return req.request_id
 
     def _row_greedy(self, req: Request) -> bool:
@@ -2357,6 +2393,85 @@ class ContinuousBatchingRunner:
             1e3 * self._round_trip_s, 1e3 * chunk_s,
             "dispatch-ahead ON" if self.async_mode else "sync")
 
+    def _assign_prefill_chunks(self, inserting: List[Request]) -> List[tuple]:
+        """Token budget -> mixed-step chunk assignments ``[(req, wlen), ...]``.
+
+        Classless (``sla_classes=None``) or single-class traffic: oldest
+        placement first (FIFO completion; every in-flight insert advances
+        before any one hogs the budget twice) — bit-identical to the
+        pre-SLA scheduler.
+
+        With more than one SLA class inserting: WEIGHTED-FAIR (ISSUE-13
+        tentpole b). The per-step prefill token budget splits across the
+        classes PRESENT by their configured weights, each class spends its
+        share FIFO over its own rows, and unspent share redistributes to the
+        remaining rows most-important-class first (work-conserving: the full
+        budget is always offered). A bulk tenant's 100k-token prompt can
+        therefore never starve interactive prefill — the interactive class
+        draws its weight share every step — while an idle-class budget is
+        never wasted. Only chunk ordering/sizing changes; the host commit
+        rules (and therefore every emitted stream) stay exact."""
+        c_rows, t_bucket = self.chunk_rows, self.prefill_chunk
+        budget = self.prefill_budget
+        fifo = sorted(inserting, key=lambda r: r.placed_seq)
+        if self.sla is None or len({r.sla_class for r in fifo}) <= 1:
+            chosen: List[tuple] = []
+            for r in fifo:
+                if len(chosen) == c_rows or budget <= 0:
+                    break
+                wlen = min(t_bucket, len(r.fed) - r.insert_pos, budget)
+                if wlen <= 0:
+                    continue
+                chosen.append((r, wlen))
+                budget -= wlen
+            return chosen
+        # chunk rows are a fixed resource: hand them out most-important
+        # class first, FIFO within a class
+        ranked = sorted(fifo, key=lambda r: (self.sla.priority(r.sla_class),
+                                             r.placed_seq))
+        rows = [r for r in ranked if len(r.fed) - r.insert_pos > 0][:c_rows]
+        if not rows:
+            return []
+        present = sorted({r.sla_class for r in rows}, key=self.sla.priority)
+        wsum = sum(self.sla.weight(c) for c in present)
+        share = {c: int(budget * self.sla.weight(c) / wsum) for c in present}
+        for c in present:       # integer-rounding remainder, top class first
+            if budget - sum(share.values()) <= 0:
+                break
+            share[c] += 1
+        width = {r.request_id: 0 for r in rows}
+
+        def give(r: Request, amount: int) -> int:
+            take = min(amount, t_bucket - width[r.request_id],
+                       len(r.fed) - r.insert_pos - width[r.request_id])
+            width[r.request_id] += take
+            return take
+
+        for r in rows:                          # pass 1: class weight shares
+            share[r.sla_class] -= give(r, share[r.sla_class])
+        left = sum(share.values())
+        for r in rows:                          # pass 2: work-conserving
+            if left <= 0:
+                break
+            left -= give(r, left)
+        return [(r, width[r.request_id]) for r in rows
+                if width[r.request_id] > 0]
+
+    def _count_class_prefill(self, sla_class: Optional[str],
+                             tokens: int) -> None:
+        """serving_class_prefill_tokens_total{sla_class=}: what each class
+        actually drew from the mixed-step budget (weighted-fair visibility)."""
+        if sla_class is None or not tokens:
+            return
+        c = self._class_prefill_counters.get(sla_class)
+        if c is None:
+            c = self.telemetry.registry.counter(
+                "serving_class_prefill_tokens_total",
+                "prompt tokens drawn from the mixed-step prefill budget, "
+                "by SLA class", labels={"sla_class": sla_class})
+            self._class_prefill_counters[sla_class] = c
+        c.inc(tokens)
+
     @step_loop_body
     def _step_mixed(self, key, emitted: Dict[int, List[int]]
                     ) -> Dict[int, List[int]]:
@@ -2419,20 +2534,10 @@ class ContinuousBatchingRunner:
                 return self._fall_through("mixed", "inserts_preempted", key,
                                           emitted)
 
-        # token budget -> chunk assignments, oldest placement first (FIFO
-        # completion; every in-flight insert advances before any one hogs the
-        # budget twice)
+        # token budget -> chunk assignments (weighted-fair across SLA
+        # classes when >1 class is inserting; plain FIFO otherwise)
         c_rows, t_bucket = self.chunk_rows, self.prefill_chunk
-        budget = self.prefill_budget
-        chosen: List[tuple] = []
-        for r in sorted(inserting, key=lambda r: r.placed_seq):
-            if len(chosen) == c_rows or budget <= 0:
-                break
-            wlen = min(t_bucket, len(r.fed) - r.insert_pos, budget)
-            if wlen <= 0:
-                continue
-            chosen.append((r, wlen))
-            budget -= wlen
+        chosen = self._assign_prefill_chunks(inserting)
 
         mb = self.max_blocks_per_seq
         chunk_ids = np.zeros((c_rows, t_bucket), np.int32)
@@ -2491,6 +2596,7 @@ class ContinuousBatchingRunner:
         chunk_tok = np.asarray(chunk_tok_dev)
         for i, (r, wlen) in enumerate(chosen):
             tel.request_prefill_chunk(r.request_id, wlen, r.insert_pos)
+            self._count_class_prefill(r.sla_class, wlen)
             r.insert_pos += wlen
             if r.insert_pos < len(r.fed):
                 continue
@@ -2664,6 +2770,38 @@ class ContinuousBatchingRunner:
             # replica (a re-added replica re-admits them on the next hit)
             self.spill_idle_blocks()
         return emitted, out
+
+    def evict_request(self, request_id: int):
+        """Evict ONE unfinished request through the preemption/resume path
+        and REMOVE it from this runner — the single-request counterpart of
+        ``drain_requests`` (router-level SLA preemption, serving/router.py:
+        a high-class arrival that cannot place preempts the newest
+        lowest-class victim, which then migrates to another replica or
+        re-queues here later via ``submit(resume_tokens=)``; greedy streams
+        resume bit-identically either way).
+
+        The dispatch pipeline is flushed first (its committed tokens still
+        belong to their streams), so the preempted state is exact. With a KV
+        tier attached the victim's committed full blocks park in the idle
+        pool (and spill to host RAM under pressure) exactly as any
+        preemption's do. Returns ``(emitted, request-or-None)``: ``emitted``
+        is the flush's {request_id: tokens}; the Request preserves
+        prompt/generated/sampling/adapter/sla state for re-submission."""
+        emitted: Dict[int, List[int]] = {}
+        self._drain(emitted)
+        if self.telemetry.enabled and emitted:
+            self.telemetry.note_emitted(emitted)
+        req = next((r for r in self.active
+                    if r is not None and r.request_id == request_id), None)
+        if req is not None and not req.done:
+            self._preempt(req)               # re-queues at the front ...
+            self.queue.remove(req)           # ... and leaves with us instead
+            return emitted, req
+        req = next((r for r in self.queue if r.request_id == request_id),
+                   None)
+        if req is not None:
+            self.queue.remove(req)
+        return emitted, req
 
     def run_to_completion(self, seed: int = 0,
                           on_step=None) -> Dict[int, List[int]]:

@@ -25,6 +25,14 @@ mesh cannot be millions of users"):
   across drain/migration AND ``recover_replica``), a fleet-merged Perfetto
   export on one shared epoch clock, and the latency-waterfall explainer
   behind ``scripts/explain_request.py``.
+- ``sla``: :class:`SLAClass`/:class:`SLAClassSet` — tenant tiers for the
+  overload control plane: priority placement + preemption order,
+  weighted-fair mixed-step prefill budgets, per-class latency targets, and
+  the brown-out shed order.
+- ``autoscaler``: :class:`ReplicaAutoscaler` — grows replicas from a
+  registered factory under sustained queue/KV/SLO pressure and
+  drains+retires them when the fleet idles (two-phase, bit-exact
+  migration), with hysteresis and min/max bounds.
 
 Replicas are plain Python objects over independent runners, so "N replicas"
 can mean N sub-meshes on one host (the dryrun harness fakes 8 devices) or,
@@ -33,14 +41,19 @@ admission interface.
 """
 
 from . import tracing
+from .autoscaler import ReplicaAutoscaler
 from .engine import EngineReplica
 from .faults import (FaultInjector, FaultSpec, InjectedFault,
                      InjectedReplicaDeath)
 from .kv_tiering import HostKVTier
 from .router import (PrefixAffinityRouter, RouterOverloaded, RouterRequest,
-                     REPLICA_DEGRADED, REPLICA_FAILED, REPLICA_HEALTHY)
+                     REPLICA_DEGRADED, REPLICA_FAILED, REPLICA_HEALTHY,
+                     REPLICA_RETIRED)
+from .sla import SLAClass, SLAClassSet, default_class_set
 
 __all__ = ["EngineReplica", "HostKVTier", "PrefixAffinityRouter",
            "RouterRequest", "RouterOverloaded", "FaultInjector", "FaultSpec",
            "InjectedFault", "InjectedReplicaDeath", "REPLICA_HEALTHY",
-           "REPLICA_DEGRADED", "REPLICA_FAILED", "tracing"]
+           "REPLICA_DEGRADED", "REPLICA_FAILED", "REPLICA_RETIRED",
+           "SLAClass", "SLAClassSet", "ReplicaAutoscaler",
+           "default_class_set", "tracing"]

@@ -1,0 +1,239 @@
+"""SLO-driven replica autoscaling over the prefix-affinity router.
+
+:class:`ReplicaAutoscaler` closes the loop ROADMAP open item 3 left open:
+the router balances load and the SLO monitor judges health, and this module
+CHANGES THE FLEET in response — growing replicas from a registered factory
+under sustained pressure and draining/retiring them when the fleet idles.
+Everything it does rides machinery that is already bit-exact:
+
+- **Grow**: ``replica_factory(replica_id) -> EngineReplica`` builds a fresh
+  replica (same weights object, own runner/pool) and
+  ``router.add_replica()`` puts it in the placement set. New arrivals place
+  onto it from the next wave.
+- **Shrink**: ``router.drain_replica(id)`` migrates the victim's live
+  streams through the mid-prompt preempt/resume path (bit-exact — the PR 8
+  guarantee), then once the replica is empty ``router.remove_replica(id)``
+  retires it. Shrink is therefore a two-phase ``drain → retire`` and the
+  autoscaler never drops a token.
+
+Signals (evaluated per :meth:`tick`):
+
+- router arrival-queue depth (``scale_up_queue_depth`` — sustained backlog
+  means the fleet cannot place what arrives);
+- mean KV-block headroom fraction over HEALTHY replicas
+  (``scale_up_kv_headroom`` floor — the admission signal the router
+  load-balances on, aggregated);
+- the SLO state (``slo_signal`` unhealthy counts as pressure — the same
+  callable the router's brown-out ladder reads, so the autoscaler GROWS
+  while the ladder sheds and the two meet in the middle).
+
+Hysteresis: a signal must persist for ``up_after``/``down_after``
+consecutive ticks and a ``cooldown_s`` quiet period separates actions, so
+a bursty trace cannot thrash the fleet. ``clock`` is injectable (tests
+drive a fake clock; production uses ``time.monotonic``).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, Dict, List, Optional
+
+logger = logging.getLogger("tpu-inference")
+
+__all__ = ["ReplicaAutoscaler"]
+
+
+class ReplicaAutoscaler:
+    """Grow/drain/retire replicas from router pressure signals.
+
+    ``tick()`` evaluates the signals once and performs AT MOST one action;
+    call it from the serving loop (every step or on a timer). Returns the
+    action taken (``"grow:<id>"``, ``"drain:<id>"``, ``"retire:<id>"``) or
+    None."""
+
+    def __init__(self, router, replica_factory: Callable[[str], object], *,
+                 min_replicas: int = 1, max_replicas: int = 4,
+                 scale_up_queue_depth: int = 4,
+                 scale_up_kv_headroom: float = 0.1,
+                 scale_down_queue_depth: int = 0,
+                 scale_down_kv_headroom: float = 0.5,
+                 up_after: int = 2, down_after: int = 4,
+                 cooldown_s: float = 10.0,
+                 slo_signal: Optional[Callable[[], bool]] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        if min_replicas < 1:
+            raise ValueError("min_replicas must be >= 1")
+        if max_replicas < min_replicas:
+            raise ValueError("max_replicas must be >= min_replicas")
+        if up_after < 1 or down_after < 1:
+            raise ValueError("up_after/down_after must be >= 1")
+        self.router = router
+        self.replica_factory = replica_factory
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self.scale_up_queue_depth = int(scale_up_queue_depth)
+        self.scale_up_kv_headroom = float(scale_up_kv_headroom)
+        self.scale_down_queue_depth = int(scale_down_queue_depth)
+        self.scale_down_kv_headroom = float(scale_down_kv_headroom)
+        self.up_after = int(up_after)
+        self.down_after = int(down_after)
+        self.cooldown_s = float(cooldown_s)
+        self.slo_signal = slo_signal
+        self.clock = clock
+        self._up_streak = 0
+        self._down_streak = 0
+        self._last_action_t: Optional[float] = None
+        self._next_id = 0
+        self._draining: List[str] = []       # drain issued, retire pending
+        reg = router.registry
+        self._c_up = reg.counter(
+            "autoscaler_scale_ups_total",
+            "replicas grown from the factory")
+        self._c_down = reg.counter(
+            "autoscaler_scale_downs_total",
+            "replicas drained + retired (two-phase; counted at retire)")
+        self._g_replicas = reg.gauge(
+            "autoscaler_replicas", "replicas currently in the placement set")
+        self._g_replicas.set(self._fleet_size())
+
+    # -------------------------------------------------------------- signals
+    def _fleet_size(self) -> int:
+        """Replicas that can take or hold work (FAILED ones don't count —
+        recovery owns them; they are capacity only after reactivation)."""
+        return sum(1 for rid in self.router.replicas
+                   if self.router.replica_state(rid) != "failed")
+
+    def _healthy_admissions(self) -> List[Dict[str, object]]:
+        out = []
+        for rid, rep in self.router.replicas.items():
+            if self.router.replica_state(rid) != "healthy" or rep.draining:
+                continue
+            try:
+                out.append(rep.admission())
+            # lint: ok(silent-except): admission probe of a replica mid-failure; the supervisor owns its lifecycle
+            except Exception:
+                continue
+        return out
+
+    def _mean_kv_headroom(self) -> Optional[float]:
+        fr = [a["kv_headroom_frac"] for a in self._healthy_admissions()
+              if "kv_headroom_frac" in a]
+        return (sum(fr) / len(fr)) if fr else None
+
+    def pressure(self) -> Dict[str, object]:
+        """The signal snapshot one tick evaluates (also the stats surface)."""
+        queue = len(self.router.queue)
+        headroom = self._mean_kv_headroom()
+        slo_unhealthy = (self.slo_signal is not None
+                         and not bool(self.slo_signal()))
+        up = (queue > self.scale_up_queue_depth
+              or (headroom is not None
+                  and headroom < self.scale_up_kv_headroom)
+              or slo_unhealthy)
+        down = (queue <= self.scale_down_queue_depth
+                and not slo_unhealthy
+                and (headroom is None
+                     or headroom > self.scale_down_kv_headroom))
+        return {"queue_depth": queue, "kv_headroom": headroom,
+                "slo_unhealthy": slo_unhealthy, "up": up, "down": down}
+
+    def _cooling(self, now: float) -> bool:
+        return (self._last_action_t is not None
+                and now - self._last_action_t < self.cooldown_s)
+
+    # ----------------------------------------------------------------- tick
+    def tick(self) -> Optional[str]:
+        now = self.clock()
+        # phase 2 of a shrink first: retire any drained-out replica (no
+        # cooldown gate — the capacity already left at drain time)
+        for rid in list(self._draining):
+            rep = self.router.replicas.get(rid)
+            if rep is None:
+                self._draining.remove(rid)
+                continue
+            if not rep.has_work:
+                self.router.remove_replica(rid)
+                self._draining.remove(rid)
+                self._c_down.inc()
+                self._g_replicas.set(self._fleet_size())
+                logger.info("autoscaler: retired drained replica %s", rid)
+                return f"retire:{rid}"
+        p = self.pressure()
+        if p["up"]:
+            self._up_streak += 1
+            self._down_streak = 0
+        elif p["down"]:
+            self._down_streak += 1
+            self._up_streak = 0
+        else:
+            self._up_streak = self._down_streak = 0
+        size = self._fleet_size()
+        if (self._up_streak >= self.up_after and size < self.max_replicas
+                and not self._cooling(now)):
+            return self._grow(now, p)
+        if (self._down_streak >= self.down_after
+                and size - len(self._draining) > self.min_replicas
+                and not self._cooling(now)):
+            return self._drain_one(now, p)
+        return None
+
+    def _grow(self, now: float, pressure: Dict[str, object]) -> str:
+        # fresh ids: autoscaled replicas are "as<N>" and never collide with
+        # the seed fleet's ids (add_replica rejects collisions anyway)
+        while f"as{self._next_id}" in self.router.replicas:
+            self._next_id += 1
+        rid = f"as{self._next_id}"
+        self._next_id += 1
+        replica = self.replica_factory(rid)
+        if replica.replica_id != rid:
+            raise ValueError(f"replica_factory must honor the id it is "
+                             f"given (got {replica.replica_id!r}, want "
+                             f"{rid!r})")
+        self.router.add_replica(replica)
+        self._c_up.inc()
+        self._g_replicas.set(self._fleet_size())
+        self._last_action_t = now
+        self._up_streak = 0
+        logger.warning("autoscaler: GREW replica %s (%s)", rid, pressure)
+        return f"grow:{rid}"
+
+    def _drain_one(self, now: float, pressure: Dict[str, object]) -> Optional[str]:
+        # victim: the least-loaded healthy replica (its streams migrate the
+        # cheapest); never one already draining
+        best, best_key = None, None
+        for rid, rep in self.router.replicas.items():
+            if (self.router.replica_state(rid) != "healthy" or rep.draining
+                    or rid in self._draining):
+                continue
+            try:
+                a = rep.admission()
+            # lint: ok(silent-except): admission probe mid-failure; the supervisor owns the lifecycle
+            except Exception:
+                continue
+            key = (a["queue_depth"] + a["active_requests"], rid)
+            if best_key is None or key < best_key:
+                best, best_key = rid, key
+        if best is None:
+            return None
+        migrated = self.router.drain_replica(best)
+        self._draining.append(best)
+        self._last_action_t = now
+        self._down_streak = 0
+        logger.warning("autoscaler: DRAINING replica %s (%d streams "
+                       "migrating; %s)", best, migrated, pressure)
+        return f"drain:{best}"
+
+    # ---------------------------------------------------------------- export
+    def stats(self) -> Dict[str, object]:
+        return {
+            "replicas": self._fleet_size(),
+            "min": self.min_replicas, "max": self.max_replicas,
+            "draining": list(self._draining),
+            "scale_ups": int(self._c_up.value),
+            "scale_downs": int(self._c_down.value),
+            "up_streak": self._up_streak, "down_streak": self._down_streak,
+            "cooldown_s": self.cooldown_s,
+            "cooling": self._cooling(self.clock()),
+            "pressure": self.pressure(),
+        }

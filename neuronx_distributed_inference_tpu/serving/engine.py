@@ -225,6 +225,13 @@ class EngineReplica:
         self._g_accepting.set(0)
         return self.runner.drain_requests()
 
+    def evict_request(self, local_id: int):
+        """Single-request drain (router SLA preemption, serving/router.py):
+        evict ONE request through the runner's preempt path and hand it
+        back — the replica stays in the placement set. Returns
+        ``(emitted, request-or-None)``."""
+        return self.runner.evict_request(local_id)
+
     def reactivate(self) -> None:
         self.draining = False
         self._g_accepting.set(1)

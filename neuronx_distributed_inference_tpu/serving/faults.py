@@ -28,6 +28,13 @@ Fault kinds (``FAULT_KINDS``):
                 food.
 ``truncate``    shrink one host-tier entry's arrays (a torn/partial copy) —
                 same check, different failure shape.
+``overload``    a seeded tenant BURST: ``burst`` synthetic arrivals of
+                ``burst_prompt``-token prompts (class ``burst_class``, or
+                the router's least-important class) submitted at the
+                frontend — THROUGH admission, so the shed path is what
+                absorbs them — followed by a ``stall_ms`` slow-drain stall
+                on the stepping replica. The brown-out / shed / preemption
+                paths' food (ISSUE-13).
 
 Fault-spec grammar (CLI ``--inject-faults``, one string; documented in
 docs/SERVING.md):
@@ -35,6 +42,7 @@ docs/SERVING.md):
     spec     := entry (";" entry)*
     entry    := kind ["@" replica] [":" key "=" value ("," key "=" value)*]
     keys     := at_step | every_n | once | stall_ms
+                | burst | burst_prompt | burst_new | burst_class
 
 ``at_step=N`` fires when the REPLICA's step counter reaches N (``once=1``
 by default); ``every_n=N`` fires on every N-th step (``once=0`` by
@@ -67,7 +75,8 @@ logger = logging.getLogger("tpu-inference")
 __all__ = ["FAULT_KINDS", "FaultSpec", "FaultInjector", "InjectedFault",
            "InjectedReplicaDeath", "parse_fault_specs"]
 
-FAULT_KINDS = ("exception", "stall", "death", "alloc", "corrupt", "truncate")
+FAULT_KINDS = ("exception", "stall", "death", "alloc", "corrupt", "truncate",
+               "overload")
 
 
 class InjectedFault(RuntimeError):
@@ -93,11 +102,20 @@ class FaultSpec:
     every_n: Optional[int] = None
     once: Optional[bool] = None
     stall_ms: float = 100.0
+    # ``overload`` knobs: burst size / prompt length / max-new of the
+    # injected tenant burst, and the SLA class it arrives under (None = the
+    # router's least-important class, or classless on a classless router)
+    burst: int = 8
+    burst_prompt: int = 64
+    burst_new: int = 16
+    burst_class: Optional[str] = None
 
     def __post_init__(self):
         if self.kind not in FAULT_KINDS:
             raise ValueError(f"unknown fault kind {self.kind!r} "
                              f"(known: {FAULT_KINDS})")
+        if self.burst < 1 or self.burst_prompt < 1 or self.burst_new < 1:
+            raise ValueError("burst/burst_prompt/burst_new must be >= 1")
         if self.at_step is not None and self.every_n is not None:
             raise ValueError("at_step and every_n are mutually exclusive")
         if self.at_step is None and self.every_n is None:
@@ -126,15 +144,20 @@ class FaultSpec:
                 raise ValueError(f"fault spec entry {part!r} is not "
                                  f"key=value (in {entry!r})")
             k, v = (s.strip() for s in part.split("=", 1))
-            if k in ("at_step", "every_n"):
+            if k in ("at_step", "every_n", "burst", "burst_prompt",
+                     "burst_new"):
                 kw[k] = int(v)
             elif k == "once":
                 kw[k] = v.lower() in ("1", "true", "yes")
             elif k == "stall_ms":
                 kw[k] = float(v)
+            elif k == "burst_class":
+                kw[k] = v
             else:
                 raise ValueError(f"unknown fault spec key {k!r} "
-                                 f"(known: at_step, every_n, once, stall_ms)")
+                                 f"(known: at_step, every_n, once, stall_ms, "
+                                 f"burst, burst_prompt, burst_new, "
+                                 f"burst_class)")
         return cls(**kw)
 
 
@@ -181,12 +204,18 @@ class FaultInjector:
         self.fired: Dict[Tuple[str, str], int] = {} # (kind, replica) -> count
         self.fired_total = 0
         self._registry = None
+        self._router = None                         # overload bursts submit here
         self._counters: Dict[Tuple[str, str], object] = {}
+        # overload-burst visibility: arrivals the injector actually pushed
+        # through admission vs arrivals admission shed back at it
+        self.burst_submitted = 0
+        self.burst_shed = 0
 
     # ------------------------------------------------------------------ attach
     def attach(self, router) -> None:
         """Wrap every replica of ``router`` (called by the router ctor)."""
         self._registry = router.registry
+        self._router = router
         for rep in router.replicas.values():
             self.attach_replica(rep)
 
@@ -280,6 +309,19 @@ class FaultInjector:
             # armed here, counted when the wrapped _alloc_one actually raises
             self._alloc_pending[rid] = self._alloc_pending.get(rid, 0) + 1
             return
+        if kind == "overload":
+            n = self._overload_burst(spec)
+            if n:
+                self._count(kind, rid)
+            else:
+                # no router / nothing submitted: not fired — bench's
+                # honesty marker must see a mis-aimed overload schedule
+                self._spec_fired[i].discard(rid)
+            if spec.stall_ms:
+                # the slow-drain half: the stepping replica wedges for
+                # stall_ms while the burst sits in the frontend queue
+                time.sleep(spec.stall_ms / 1e3)
+            return
         if kind == "stall":
             self._count(kind, rid)
             logger.warning("injected %.0f ms dispatch stall on replica %s "
@@ -294,6 +336,59 @@ class FaultInjector:
         self._count("exception", rid)
         raise InjectedFault(
             f"injected dispatch exception on replica {rid} (step {step})")
+
+    def _overload_burst(self, spec: FaultSpec) -> int:
+        """Fire one seeded tenant burst at the FRONTEND: ``burst`` synthetic
+        prompts of ``burst_prompt`` tokens submitted through the router's
+        normal admission (class ``burst_class``, defaulting to the router's
+        least-important sheddable class) — so brown-out shed, queue-bound
+        shed, priority placement and preemption all see exactly what a
+        misbehaving tenant would send. Returns arrivals ATTEMPTED (0 when no
+        router is attached — the schedule was mis-aimed and the fire is
+        un-consumed)."""
+        router = self._router
+        if router is None:
+            logger.warning("overload fault has no attached router — "
+                           "nothing injected")
+            return 0
+        from .router import RouterOverloaded
+
+        cls = spec.burst_class
+        sla = getattr(router, "sla", None)
+        if cls is None and sla is not None:
+            order = sla.shed_order()
+            cls = order[0] if order else sla.names()[-1]
+        rep = next(iter(router.replicas.values()))
+        vocab = int(rep.runner.app.arch_args.vocab_size)
+        seq_len = int(rep.runner.cfg.seq_len)
+        plen = max(1, min(spec.burst_prompt, seq_len - spec.burst_new - 1))
+        submitted = shed = 0
+        for _ in range(spec.burst):
+            p = self._rng.integers(1, vocab,
+                                   size=(plen,)).astype(np.int32)
+            try:
+                router.submit(p, max_new_tokens=spec.burst_new,
+                              sla_class=cls)
+                submitted += 1
+            # lint: ok(silent-except): the shed IS the system working — the router counted+logged it (router_class_shed_total) and the burst summary below reports the tally
+            except RouterOverloaded:
+                shed += 1
+            except ValueError as e:
+                # a mis-configured burst_class (unknown class / classless
+                # router) is a DRIVER error, not a replica failure — it must
+                # not leak into the supervisor and fail the replica. Counted
+                # as not-fired (the schedule stays armed; bench's honesty
+                # marker sees the misaim).
+                logger.warning("overload burst mis-configured "
+                               "(burst_class=%r): %s — nothing injected",
+                               cls, e)
+                return 0
+        self.burst_submitted += submitted
+        self.burst_shed += shed
+        logger.warning("injected overload burst: %d arrivals (class=%s, "
+                       "prompt=%d tokens), %d shed by admission",
+                       submitted + shed, cls, plen, shed)
+        return submitted + shed
 
     def _corrupt_tier(self, rep, truncate: bool) -> int:
         """Mutate one seeded-random host-tier entry's bytes in place (the
@@ -342,4 +437,6 @@ class FaultInjector:
             "fired": {f"{k}@{r}": n for (k, r), n in sorted(self.fired.items())},
             "dead": sorted(self._dead),
             "steps": dict(self._steps),
+            "burst_submitted": self.burst_submitted,
+            "burst_shed": self.burst_shed,
         }

@@ -78,18 +78,33 @@ from .faults import InjectedReplicaDeath
 logger = logging.getLogger("tpu-inference")
 
 __all__ = ["PrefixAffinityRouter", "RouterRequest", "RouterOverloaded",
-           "REPLICA_HEALTHY", "REPLICA_DEGRADED", "REPLICA_FAILED"]
+           "REPLICA_HEALTHY", "REPLICA_DEGRADED", "REPLICA_FAILED",
+           "REPLICA_RETIRED"]
 
 # replica lifecycle states (serving_replica_state gauge values)
 REPLICA_HEALTHY = "healthy"
 REPLICA_DEGRADED = "degraded"
 REPLICA_FAILED = "failed"
-_STATE_GAUGE = {REPLICA_HEALTHY: 0, REPLICA_DEGRADED: 1, REPLICA_FAILED: 2}
+REPLICA_RETIRED = "retired"          # removed by remove_replica (autoscaler)
+_STATE_GAUGE = {REPLICA_HEALTHY: 0, REPLICA_DEGRADED: 1, REPLICA_FAILED: 2,
+                REPLICA_RETIRED: 3}
 
 
 class RouterOverloaded(RuntimeError):
-    """submit() shed the request (queue past ``shed_queue_depth`` while the
-    SLO signal says unhealthy) — the caller should back off / 503."""
+    """submit() shed the request — the caller should back off / 503.
+
+    Raised by the legacy global queue bound (queue past ``shed_queue_depth``
+    while the SLO signal says unhealthy) AND by the SLA brown-out ladder
+    (the request's class is shed at the current degradation level).
+    ``sla_class`` names the shed class (None on a classless router);
+    ``retry_after_s`` is the back-off hint the caller should surface as
+    Retry-After."""
+
+    def __init__(self, msg: str, sla_class: Optional[str] = None,
+                 retry_after_s: Optional[float] = None):
+        super().__init__(msg)
+        self.sla_class = sla_class
+        self.retry_after_s = retry_after_s
 
 
 @dataclass
@@ -116,6 +131,12 @@ class RouterRequest:
     # threaded through every placement so the replicas' lifecycle events and
     # the router journal join into one causal span tree per request
     trace_id: Optional[str] = None
+    # SLA class (serving/sla.py): the tenant tier — priority placement,
+    # weighted-fair budgets, brown-out shed order, preemption victimhood
+    sla_class: Optional[str] = None
+    # router-level SLA preemptions this request suffered (it re-queued and
+    # resumed bit-exactly each time; distinct from replica-local preemptions)
+    class_preemptions: int = 0
 
 
 class PrefixAffinityRouter:
@@ -134,7 +155,11 @@ class PrefixAffinityRouter:
                  debug_bundle_dir: Optional[str] = None,
                  auto_recover: bool = False,
                  shed_queue_depth: Optional[int] = None,
-                 slo_signal=None):
+                 slo_signal=None, sla_classes=None,
+                 preemptive: Optional[bool] = None,
+                 brownout_up_after: int = 3, brownout_down_after: int = 5,
+                 brownout_decode_cap: int = 1,
+                 shed_retry_after_s: float = 1.0):
         """Supervision knobs (fault tolerance, ISSUE-11):
 
         ``fault_injector``: a :class:`~.faults.FaultInjector` to attach
@@ -152,6 +177,25 @@ class PrefixAffinityRouter:
         sheds (raises :class:`RouterOverloaded`) — only while ``slo_signal``
         (a callable returning True when healthy) says unhealthy, or always
         past the bound when no signal is given. None = never shed.
+
+        Overload control plane (ISSUE-13):
+
+        ``sla_classes``: an :class:`~.sla.SLAClassSet`. Turns on priority
+        placement (most-important class places first), per-class admission,
+        the brown-out ladder, and class preemption; every replica runner
+        must have been built with the SAME set (weighted-fair budgets read
+        it inside ``_step_mixed``).
+        ``preemptive``: may a high-class arrival that cannot place preempt
+        the NEWEST lowest-class running request? (victim re-queues and
+        resumes bit-exactly — migrate or park-in-tier). Default: True when
+        ``sla_classes`` is given.
+        ``brownout_up_after`` / ``brownout_down_after``: consecutive
+        unhealthy/healthy ``slo_signal`` readings (one per ``step()``)
+        before the brown-out level rises/falls — the hysteresis.
+        ``brownout_decode_cap``: max CONCURRENT placements of a class whose
+        "cap" ladder rung is active (fleet-wide).
+        ``shed_retry_after_s``: Retry-After unit — a level-L shed carries
+        ``retry_after_s = L * shed_retry_after_s``.
         """
         if not replicas:
             raise ValueError("need at least one replica")
@@ -228,6 +272,53 @@ class PrefixAffinityRouter:
         self.auto_recover = auto_recover
         self.shed_queue_depth = shed_queue_depth
         self.slo_signal = slo_signal
+        # --- SLA classes + brown-out ladder (ISSUE-13) ----------------------
+        if sla_classes is not None:
+            from .sla import SLAClassSet
+
+            if not isinstance(sla_classes, SLAClassSet):
+                raise ValueError("sla_classes must be a serving.sla."
+                                 "SLAClassSet (or None)")
+        self.sla = sla_classes
+        if sla_classes is not None:
+            # every replica runner must share the class set: a mismatch
+            # would otherwise surface as a ValueError from runner.submit
+            # MID-place_queued, leaving already-placed requests still queued
+            # (double-placement on the next wave)
+            for rep in replicas:
+                self._check_replica_classes(rep)
+        self.preemptive = (bool(preemptive) if preemptive is not None
+                           else sla_classes is not None)
+        if self.preemptive and sla_classes is None:
+            raise ValueError("preemptive=True requires sla_classes")
+        self.brownout_up_after = int(brownout_up_after)
+        self.brownout_down_after = int(brownout_down_after)
+        self.brownout_decode_cap = int(brownout_decode_cap)
+        self.shed_retry_after_s = float(shed_retry_after_s)
+        # the LADDER: rung L applies the first L actions. Built from the
+        # class set's shed order (least-important sheddable classes first,
+        # top class excluded): shed class arrivals FIRST, then cap its
+        # decode concurrency, then move one class up — degradation never
+        # touches top-class traffic (ISSUE-13 tentpole d)
+        self._ladder: List[tuple] = []
+        if sla_classes is not None:
+            for cls in sla_classes.shed_order():
+                self._ladder.append(("shed", cls))
+                self._ladder.append(("cap", cls))
+        self._brownout_level = 0
+        self._unhealthy_streak = 0
+        self._healthy_streak = 0
+        # tokens folded OUTSIDE a step's replica sweep (the SLA preemption's
+        # pipeline flush) — merged into the next step()'s returned emissions
+        self._pending_emitted: Dict[int, List[int]] = {}
+        self._g_brownout = reg.gauge(
+            "router_brownout_level",
+            "current brown-out ladder rung (0 = no degradation)")
+        self._g_brownout.set(0)
+        self._c_brownout: Dict[str, object] = {}       # direction -> counter
+        self._c_class_shed: Dict[str, object] = {}     # class -> counter
+        self._c_class_preempt: Dict[str, object] = {}  # victim class -> counter
+        self._c_class_deferred: Dict[str, object] = {} # class -> counter
         self._step_count = 0
         self._health: Dict[str, str] = {}
         self._fail_streak: Dict[str, int] = {rid: 0 for rid in self.replicas}
@@ -327,33 +418,71 @@ class PrefixAffinityRouter:
                 and not rep.draining)
 
     # ---------------------------------------------------------------- intake
+    def _shed(self, sla_class: Optional[str], reason: str, msg: str) -> None:
+        """One typed shed: counted (total + per class), journaled, logged,
+        raised with the class and a Retry-After hint."""
+        self._c_shed.inc()
+        if sla_class is not None:
+            c = self._c_class_shed.get(sla_class)
+            if c is None:
+                c = self.registry.counter(
+                    "router_class_shed_total",
+                    "arrivals shed by class (brown-out ladder + queue bound)",
+                    labels={"sla_class": sla_class})
+                self._c_class_shed[sla_class] = c
+            c.inc()
+        retry = self.shed_retry_after_s * max(1, self._brownout_level)
+        self._trace_event("shed", queue_depth=len(self.queue),
+                          sla_class=sla_class, reason=reason,
+                          brownout_level=self._brownout_level)
+        logger.warning("shedding arrival (%s, class=%s): %s", reason,
+                       sla_class, msg)
+        raise RouterOverloaded(msg, sla_class=sla_class, retry_after_s=retry)
+
+    def _brownout_actions(self) -> Dict[str, set]:
+        """Classes currently shed / capped by the active ladder rungs."""
+        out = {"shed": set(), "cap": set()}
+        for kind, cls in self._ladder[: self._brownout_level]:
+            out[kind].add(cls)
+        return out
+
     def submit(self, prompt, max_new_tokens: int = 32,
                eos_token_id: Optional[int] = None, sampling_params=None,
-               adapter_id: int = 0, arrival_ts: Optional[float] = None) -> int:
+               adapter_id: int = 0, arrival_ts: Optional[float] = None,
+               sla_class: Optional[str] = None) -> int:
         prompt = np.asarray(prompt).astype(np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
+        if self.sla is not None:
+            sla_class = self.sla.resolve(sla_class)    # unknown class raises
+        elif sla_class is not None:
+            raise ValueError("sla_class given but the router has no "
+                             "sla_classes set")
+        # brown-out admission (ISSUE-13 tentpole d): at the current ladder
+        # rung the class's arrivals are shed outright — lowest classes go
+        # first, the top class is never on the ladder
+        if sla_class is not None and self._brownout_level > 0 \
+                and sla_class in self._brownout_actions()["shed"]:
+            self._shed(sla_class, "brownout",
+                       f"class {sla_class!r} shed at brown-out level "
+                       f"{self._brownout_level}")
         if (self.shed_queue_depth is not None
                 and len(self.queue) >= self.shed_queue_depth
                 and (self.slo_signal is None or not self.slo_signal())):
             # graceful degradation under exhaustion/overload: shed by SLO
             # signal at the frontend instead of queueing into a wedge —
             # counted, logged, surfaced to the caller as a typed error
-            self._c_shed.inc()
-            self._trace_event("shed", queue_depth=len(self.queue))
-            logger.warning(
-                "shedding arrival: frontend queue %d >= %d and the SLO "
-                "signal is unhealthy", len(self.queue), self.shed_queue_depth)
-            raise RouterOverloaded(
-                f"frontend queue depth {len(self.queue)} >= shed bound "
-                f"{self.shed_queue_depth}")
+            self._shed(sla_class, "queue_bound",
+                       f"frontend queue depth {len(self.queue)} >= shed "
+                       f"bound {self.shed_queue_depth}")
         req = RouterRequest(
             self._next_id, prompt, max_new_tokens, eos_token_id,
             None if sampling_params is None
             else np.asarray(sampling_params, dtype=np.float32).reshape(-1),
             adapter_id, arrival_ts,
             hashes=(prompt_block_hashes(prompt, self.block_size, adapter_id)
-                    if self.paged else []))
+                    if self.paged else []),
+            sla_class=sla_class)
         req.trace_id = f"t-{self._trace_salt}-{req.request_id:06x}"
         self._next_id += 1
         self.requests[req.request_id] = req
@@ -361,7 +490,7 @@ class PrefixAffinityRouter:
         self._c_submitted.inc()
         self._g_queue.set(len(self.queue))
         self._trace_event("submit", req, prompt_len=int(prompt.size),
-                          max_new_tokens=max_new_tokens)
+                          max_new_tokens=max_new_tokens, sla_class=sla_class)
         return req.request_id
 
     # ------------------------------------------------------------- placement
@@ -438,22 +567,150 @@ class PrefixAffinityRouter:
         rep = min(admitting, key=self._load_key)
         return rep, 0, None
 
+    def _live_class_count(self, cls: str) -> int:
+        """CONCURRENT placements of a class, fleet-wide (brown-out cap).
+        Walks ``_local`` — live placements only, since finished entries are
+        pruned at _fold — not the ever-growing ``requests`` journal."""
+        return sum(1 for gid in set(self._local.values())
+                   if self.requests[gid].sla_class == cls
+                   and self.requests[gid].replica is not None
+                   and not self.requests[gid].done)
+
+    def _defer_capped(self, req: RouterRequest) -> None:
+        c = self._c_class_deferred.get(req.sla_class)
+        if c is None:
+            c = self.registry.counter(
+                "router_class_placements_deferred_total",
+                "placements deferred by the brown-out decode-concurrency cap",
+                labels={"sla_class": req.sla_class})
+            self._c_class_deferred[req.sla_class] = c
+        c.inc()
+
     def place_queued(self) -> int:
-        """Place as many queued requests as replicas will admit (FIFO).
-        Returns the number placed this call."""
+        """Place as many queued requests as replicas will admit. Classless:
+        FIFO (unchanged). With SLA classes: most-important class first (FIFO
+        within a class — request ids are arrival order), brown-out decode
+        caps honored, and a high-class request that cannot place may preempt
+        the newest lowest-class victim (``preemptive``). Returns the number
+        placed this call."""
         placed = 0
+        if self.sla is not None:
+            ordered = sorted(self.queue,
+                             key=lambda r: (self.sla.priority(r.sla_class),
+                                            r.request_id))
+            capped = self._brownout_actions()["cap"]
+        else:
+            ordered = list(self.queue)
+            capped = set()
         remaining: List[RouterRequest] = []
-        for req in self.queue:
+        displaced: List[RouterRequest] = []      # preemption victims, re-queued
+        for req in ordered:
+            if (req.sla_class in capped
+                    and self._live_class_count(req.sla_class)
+                    >= self.brownout_decode_cap):
+                # brown-out rung "cap": the class keeps at most
+                # brownout_decode_cap concurrent streams — deferred, not
+                # lost (it places when a stream of its class finishes)
+                self._defer_capped(req)
+                remaining.append(req)
+                continue
             choice = self._choose(req)
+            if self.preemptive and req.sla_class is not None:
+                # "can't place" for a classed request means no healthy
+                # replica can take it IMMEDIATELY (admitting into a queue
+                # behind lower-class streams is exactly the starvation the
+                # preemptive tier exists to break): preempt the newest
+                # lowest-class victim, then re-choose — the freed slot (and
+                # its blocks) admit the high-class request this wave
+                n = len(req.prompt) + len(req.generated)
+                immediate = any(
+                    self._placeable(r) and r.has_headroom(n)
+                    for r in self.replicas.values())
+                # feasibility: evicting victims can only help if SOME healthy
+                # replica's pool could ever hold the request — a request no
+                # pool can fit must not churn lower-class streams every wave
+                feasible = any(
+                    self._placeable(r)
+                    and (not r.runner.paged
+                         or r.blocks_needed(n) <= r.runner.allocator.num_blocks)
+                    for r in self.replicas.values())
+                if (choice is None or not immediate) and feasible:
+                    victim = self._preempt_for(req)
+                    if victim is not None:
+                        displaced.append(victim)
+                        choice = self._choose(req)
             if choice is None:
                 remaining.append(req)
                 continue
             rep, aff_blocks, lost = choice
             self._place(req, rep, aff_blocks, lost)
             placed += 1
-        self.queue = remaining
+        self.queue = remaining + displaced
         self._g_queue.set(len(self.queue))
         return placed
+
+    def _preempt_for(self, req: RouterRequest) -> Optional[RouterRequest]:
+        """Preemptive priorities (ISSUE-13 tentpole c): evict the NEWEST
+        victim of the LOWEST class strictly below ``req``'s, through the
+        runner's existing mid-prompt preempt path (``evict_request``). The
+        victim's committed prefix parks in the idle pool / host KV tier
+        (tiered allocators) and the request re-queues — it migrates to
+        whichever replica next admits it and resumes bit-exactly via
+        ``submit(resume_tokens=)``. Returns the displaced RouterRequest (to
+        re-queue), or None when no strictly-lower-class victim exists."""
+        my_p = self.sla.priority(req.sla_class)
+        victim = None
+        vkey = None
+        for (rid, _local), gid in self._local.items():
+            v = self.requests[gid]
+            if v.done or v.replica != rid:
+                continue
+            if self._health.get(rid) != REPLICA_HEALTHY:
+                continue           # a dead replica cannot cooperate
+            vp = self.sla.priority(v.sla_class)
+            if vp <= my_p:
+                continue           # only strictly lower classes are victims
+            key = (vp, gid)        # lowest class first, then newest placed
+            if vkey is None or key > vkey:
+                vkey, victim = key, v
+        if victim is None:
+            return None
+        rep = self.replicas[victim.replica]
+        rid, local_id = victim.replica, victim.local_id
+        emitted, _evicted = rep.evict_request(local_id)
+        # the eviction's pipeline flush may still commit tokens (they belong
+        # to their streams) — fold them into the PENDING buffer, which the
+        # enclosing step() merges into its returned emissions (a stream that
+        # finishes inside the flush must still reach a streaming consumer)
+        for lid, toks in emitted.items():
+            self._fold(rid, lid, toks, self._pending_emitted)
+        self._local.pop((rid, local_id), None)
+        victim.replica = None
+        victim.local_id = None
+        if victim.done:
+            # the flush finished it — nothing to re-queue, but headroom
+            # opened all the same
+            return None
+        victim.migrations += 1
+        victim.class_preemptions += 1
+        c = self._c_class_preempt.get(victim.sla_class)
+        if c is None:
+            c = self.registry.counter(
+                "router_class_preemptions_total",
+                "requests preempted by a higher-SLA-class arrival",
+                labels={"victim_class": victim.sla_class})
+            self._c_class_preempt[victim.sla_class] = c
+        c.inc()
+        self._trace_event("class_preempt", victim, from_replica=rid,
+                          for_request=req.request_id,
+                          for_class=req.sla_class,
+                          tokens_so_far=len(victim.generated))
+        logger.info(
+            "SLA preemption: request %d (%s) evicted from replica %s for "
+            "request %d (%s); it re-queues and resumes bit-exactly",
+            victim.request_id, victim.sla_class, rid, req.request_id,
+            req.sla_class)
+        return victim
 
     def _place(self, req: RouterRequest, rep: EngineReplica,
                aff_blocks: int, lost: Optional[int]) -> None:
@@ -463,6 +720,10 @@ class PrefixAffinityRouter:
                   trace_id=req.trace_id)
         if req.sampling_params is not None:
             kw["sampling_params"] = req.sampling_params
+        if req.sla_class is not None:
+            # the runner re-validates against ITS class set (the fleet must
+            # share one; a mismatch raises at placement, never silently)
+            kw["sla_class"] = req.sla_class
         if req.generated:
             kw["resume_tokens"] = req.generated
         req.local_id = rep.submit(req.prompt, **kw)
@@ -494,8 +755,12 @@ class PrefixAffinityRouter:
         failure too. FAILED replicas are skipped entirely (their streams
         move via recover_replica)."""
         self._step_count += 1
+        self._update_brownout()
         self.place_queued()
-        emitted: Dict[int, List[int]] = {}
+        # emissions folded during placement (SLA-preemption pipeline flush)
+        # belong to this step's output
+        emitted: Dict[int, List[int]] = self._pending_emitted
+        self._pending_emitted = {}
         for rid, rep in list(self.replicas.items()):
             if self._health[rid] == REPLICA_FAILED:
                 continue
@@ -528,6 +793,76 @@ class PrefixAffinityRouter:
             for local_id, toks in step_out.items():
                 self._fold(rid, local_id, toks, emitted)
         return emitted
+
+    def _check_replica_classes(self, rep: EngineReplica) -> None:
+        """A classed router requires every replica runner to carry the SAME
+        class set — full value equality (priorities, weights, shed flags,
+        default), not just names: a runner weighting `bulk` 4x while the
+        router preempts bulk victims would be contradictory policy with no
+        error. Checked at construction/add time, not mid-placement."""
+        rsla = getattr(rep.runner, "sla", None)
+        if (rsla is None or list(rsla) != list(self.sla)
+                or rsla.default != self.sla.default):
+            raise ValueError(
+                f"replica {rep.replica_id!r} runner was not built with the "
+                f"router's sla_classes (runner: {rsla!r}, router: "
+                f"{self.sla!r}); pass the same SLAClassSet to every "
+                f"ContinuousBatchingRunner")
+
+    # ----------------------------------------------------------- brown-out
+    def _update_brownout(self) -> None:
+        """One ``slo_signal`` reading per router step, hysteresis-gated:
+        ``brownout_up_after`` consecutive unhealthy readings raise the
+        ladder one rung, ``brownout_down_after`` consecutive healthy ones
+        lower it. No SLA classes / no signal / empty ladder = inert."""
+        if not self._ladder or self.slo_signal is None:
+            return
+        if bool(self.slo_signal()):
+            self._healthy_streak += 1
+            self._unhealthy_streak = 0
+            if (self._brownout_level > 0
+                    and self._healthy_streak >= self.brownout_down_after):
+                self._set_brownout(self._brownout_level - 1, "down")
+                self._healthy_streak = 0
+        else:
+            self._unhealthy_streak += 1
+            self._healthy_streak = 0
+            if (self._brownout_level < len(self._ladder)
+                    and self._unhealthy_streak >= self.brownout_up_after):
+                self._set_brownout(self._brownout_level + 1, "up")
+                self._unhealthy_streak = 0
+
+    def _set_brownout(self, level: int, direction: str) -> None:
+        """One ladder transition: gauge + per-direction counter + journal
+        event, and the degradation is STAMPED on every healthy replica's
+        next step-timeline record through the runner's ``_fall_through``
+        reason plumbing — a browned-out fleet is visible in the same place
+        a degraded scheduler is, never silent."""
+        self._brownout_level = level
+        self._g_brownout.set(level)
+        c = self._c_brownout.get(direction)
+        if c is None:
+            c = self.registry.counter(
+                "router_brownout_transitions_total",
+                "brown-out ladder transitions", labels={"direction": direction})
+            self._c_brownout[direction] = c
+        c.inc()
+        acts = self._brownout_actions()
+        self._trace_event("brownout", level=level, direction=direction,
+                          shed=sorted(acts["shed"]), cap=sorted(acts["cap"]))
+        logger.warning(
+            "brown-out level %d (%s): shedding %s, capping %s (decode cap "
+            "%d)", level, direction, sorted(acts["shed"]) or "nothing",
+            sorted(acts["cap"]) or "nothing", self.brownout_decode_cap)
+        for rid, rep in self.replicas.items():
+            if self._health.get(rid) != REPLICA_HEALTHY:
+                continue
+            try:
+                rep.runner._note_fall_through("brownout",
+                                              f"{direction}_level_{level}")
+            # lint: ok(silent-except): best-effort telemetry stamp; the transition is already counted+logged at the router
+            except Exception:
+                pass
 
     def _note_step_ok(self, rid: str) -> None:
         if self._fail_streak[rid]:
@@ -641,6 +976,10 @@ class PrefixAffinityRouter:
             self._c_finished.inc()
             self._trace_event("finish", req, replica=rid,
                               tokens=len(req.generated))
+            # prune the placement map: finished rows emit nothing further
+            # (the runner's commit skips done rows), and keeping every entry
+            # ever served would make the preemption/cap scans O(history)
+            self._local.pop((rid, local_id), None)
 
     @property
     def has_work(self) -> bool:
@@ -819,6 +1158,8 @@ class PrefixAffinityRouter:
                     and replica.runner.block_size != self.block_size):
                 raise ValueError("replacement replica must match the "
                                  "fleet's paged/block-size geometry")
+            if self.sla is not None:
+                self._check_replica_classes(replica)
             self.replicas[replica_id] = replica
             if self.fault_injector is not None:
                 self.fault_injector.attach_replica(replica)
@@ -834,6 +1175,79 @@ class PrefixAffinityRouter:
         self._fail_streak[replica_id] = 0
         self._retry_after[replica_id] = 0
         self._set_state(replica_id, REPLICA_HEALTHY)
+
+    def add_replica(self, replica: EngineReplica) -> None:
+        """Grow the fleet by one replica (serving/autoscaler.py scale-up).
+        The replica must match the fleet's paged/block-size geometry and
+        carry a fresh id; it joins HEALTHY and takes placements from the
+        next ``place_queued``."""
+        rid = replica.replica_id
+        if rid in self.replicas:
+            raise ValueError(f"replica id {rid!r} already registered "
+                             f"(reactivate_replica swaps a FAILED one)")
+        if replica.runner.paged != self.paged or (
+                self.paged and replica.runner.block_size != self.block_size):
+            raise ValueError("new replica must match the fleet's "
+                             "paged/block-size geometry")
+        if self.sla is not None:
+            self._check_replica_classes(replica)
+        # affinity needs hash visibility on EVERY replica (ctor contract);
+        # one opaque allocator degrades the whole fleet to load placement
+        if self.prefix_caching and not (
+                getattr(replica.runner.allocator, "enable_prefix_caching",
+                        False)
+                and hasattr(replica.runner.allocator, "hash_to_block")):
+            logger.warning("replica %s has no prefix-hash visibility: fleet "
+                           "degrades to load placement", rid)
+            self.prefix_caching = False
+        self.replicas[rid] = replica
+        self._fail_streak[rid] = 0
+        self._retry_after[rid] = 0
+        self._g_state[rid] = self.registry.gauge(
+            "serving_replica_state",
+            "replica lifecycle: 0 healthy, 1 degraded, 2 failed, 3 retired",
+            labels={"replica": rid})
+        self._set_state(rid, REPLICA_HEALTHY)
+        if self.fault_injector is not None:
+            self.fault_injector.attach_replica(replica)
+        self._trace_event("add_replica", replica=rid,
+                          fleet_size=len(self.replicas))
+        logger.info("added replica %s (fleet size %d)", rid,
+                    len(self.replicas))
+
+    def remove_replica(self, replica_id: str) -> EngineReplica:
+        """Retire a replica for good (autoscaler scale-down). A live replica
+        must have been DRAINED first (its streams migrated bit-exactly) and
+        hold no unfinished work; a FAILED replica retires as-is (its streams
+        already moved via ``recover_replica``). The state gauge is left at
+        ``retired`` so the scale-down is visible in the scrape history.
+        Returns the removed replica."""
+        rep = self.replicas[replica_id]
+        if len(self.replicas) <= 1:
+            raise ValueError("cannot remove the last replica")
+        if self._health[replica_id] != REPLICA_FAILED:
+            inflight = [gid for (r, _l), gid in self._local.items()
+                        if r == replica_id and not self.requests[gid].done]
+            if not rep.draining:
+                raise ValueError(
+                    f"replica {replica_id} is not draining: call "
+                    f"drain_replica() first so its streams migrate")
+            if inflight or rep.has_work:
+                raise ValueError(
+                    f"replica {replica_id} still has live work "
+                    f"(in-flight frontend ids {inflight[:8]}); step the "
+                    f"router until it drains")
+        self._set_state(replica_id, REPLICA_RETIRED)
+        del self.replicas[replica_id]
+        del self._health[replica_id]
+        self._fail_streak.pop(replica_id, None)
+        self._retry_after.pop(replica_id, None)
+        self._g_state.pop(replica_id, None)
+        self._trace_event("remove_replica", replica=replica_id,
+                          fleet_size=len(self.replicas))
+        logger.info("retired replica %s (fleet size %d)", replica_id,
+                    len(self.replicas))
+        return rep
 
     # ------------------------------------------------------------- export
     def stats(self) -> Dict[str, object]:
@@ -880,6 +1294,27 @@ class PrefixAffinityRouter:
             "faults_injected": (self.fault_injector.fired_total
                                 if self.fault_injector is not None else 0),
             "replicas": per_replica,
+            # overload control plane (ISSUE-13): brown-out state + per-class
+            # shed/preempt/defer accounting (absent on classless routers)
+            **({"sla": {
+                "classes": self.sla.names(),
+                "default": self.sla.default,
+                "brownout_level": self._brownout_level,
+                "brownout_ladder": [f"{k}:{c}" for k, c in self._ladder],
+                "brownout_shed": sorted(self._brownout_actions()["shed"]),
+                "brownout_capped": sorted(self._brownout_actions()["cap"]),
+                "shed_by_class": {c: int(cnt.value) for c, cnt
+                                  in sorted(self._c_class_shed.items())},
+                "preempted_by_class": {
+                    c: int(cnt.value) for c, cnt
+                    in sorted(self._c_class_preempt.items())},
+                "deferred_by_class": {
+                    c: int(cnt.value) for c, cnt
+                    in sorted(self._c_class_deferred.items())},
+                "queued_by_class": {
+                    c: sum(1 for r in self.queue if r.sla_class == c)
+                    for c in self.sla.names()},
+            }} if self.sla is not None else {}),
         }
 
     def prometheus_text(self) -> str:

@@ -1,0 +1,219 @@
+"""SLA classes for multi-tenant serving (the overload control plane's type).
+
+An :class:`SLAClass` names one tenant tier and carries everything the
+control plane needs to treat its traffic differently:
+
+- ``priority`` — placement/preemption order (0 = most important). The router
+  places high-priority arrivals first, and a high-priority request that
+  cannot place may preempt the NEWEST request of a strictly lower class
+  (serving/router.py).
+- ``weight`` — the class's share of the mixed-step prefill token budget
+  (runtime/continuous_batching._step_mixed splits ``prefill_token_budget``
+  across the classes present by weight, work-conserving), so one tenant's
+  100k-token prompts can never starve interactive prefill.
+- ``ttft_target_ms`` / ``tpot_target_ms`` — optional per-class latency
+  targets; :meth:`SLAClassSet.slo_class_targets` exports them in the shape
+  ``utils/slo.SLOConfig.class_targets`` consumes.
+- ``sheddable`` — may the brown-out ladder shed this class's ARRIVALS under
+  sustained SLO degradation? The most-important class is never shed by the
+  ladder regardless of the flag (only the global queue bound touches it).
+
+An :class:`SLAClassSet` is the ordered registry one router + its replicas
+share. Config strings (CLI ``--sla-classes``, bench) use the grammar::
+
+    spec  := class (";" class)*
+    class := name ":" key "=" value ("," key "=" value)*
+    keys  := priority | weight | ttft_target_ms | tpot_target_ms
+             | sheddable | default
+
+    --sla-classes "interactive:priority=0,weight=4,ttft_target_ms=250;\
+standard:priority=1,weight=2,default=1;batch:priority=2,weight=1"
+
+Unlabelled submits map to the ``default`` class (exactly one per set;
+defaults to the LOWEST-priority-number class when none is flagged).
+``DEFAULT_CLASSES`` is the stock interactive/standard/batch three-tier set.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+__all__ = ["SLAClass", "SLAClassSet", "DEFAULT_CLASSES", "default_class_set"]
+
+
+@dataclasses.dataclass(frozen=True)
+class SLAClass:
+    """One tenant tier. ``priority``: 0 = most important (placement and
+    preemption order); ``weight``: weighted-fair share of the mixed-step
+    prefill budget; ``sheddable``: brown-out may shed this class's arrivals
+    (the top class is protected regardless)."""
+
+    name: str
+    priority: int
+    weight: float = 1.0
+    ttft_target_ms: Optional[float] = None
+    tpot_target_ms: Optional[float] = None
+    sheddable: bool = True
+
+    def __post_init__(self):
+        if not self.name or any(c in self.name for c in ";:,= \t\n{}\""):
+            raise ValueError(f"invalid SLA class name {self.name!r}")
+        if self.priority < 0:
+            raise ValueError("priority must be >= 0 (0 = most important)")
+        if not self.weight > 0:
+            raise ValueError("weight must be > 0")
+
+
+class SLAClassSet:
+    """Ordered, validated registry of SLA classes.
+
+    ``default``: the class unlabelled submits map to (name); when omitted,
+    the most-important (lowest priority number) class.
+    """
+
+    def __init__(self, classes: Sequence[SLAClass],
+                 default: Optional[str] = None):
+        classes = list(classes)
+        if not classes:
+            raise ValueError("need at least one SLA class")
+        names = [c.name for c in classes]
+        if len(set(names)) != len(names):
+            raise ValueError(f"SLA class names must be unique, got {names}")
+        prios = [c.priority for c in classes]
+        if len(set(prios)) != len(prios):
+            # strict order keeps victim selection / shed order deterministic
+            raise ValueError(
+                f"SLA class priorities must be unique, got {prios}")
+        self._by_name: Dict[str, SLAClass] = {c.name: c for c in classes}
+        # most-important first, everywhere
+        self._ordered = sorted(classes, key=lambda c: c.priority)
+        if default is None:
+            default = self._ordered[0].name
+        if default not in self._by_name:
+            raise ValueError(f"default class {default!r} not in {names}")
+        self.default = default
+
+    # ------------------------------------------------------------- lookups
+    def __contains__(self, name: str) -> bool:
+        return name in self._by_name
+
+    def __len__(self) -> int:
+        return len(self._by_name)
+
+    def __iter__(self):
+        return iter(self._ordered)
+
+    def names(self) -> List[str]:
+        """Class names, most-important first."""
+        return [c.name for c in self._ordered]
+
+    def get(self, name: str) -> SLAClass:
+        try:
+            return self._by_name[name]
+        except KeyError:
+            raise ValueError(f"unknown SLA class {name!r} "
+                             f"(known: {self.names()})") from None
+
+    def resolve(self, name: Optional[str]) -> str:
+        """The class an (optionally unlabelled) submit lands in."""
+        if name is None:
+            return self.default
+        return self.get(name).name
+
+    def priority(self, name: str) -> int:
+        return self.get(name).priority
+
+    def weight(self, name: str) -> float:
+        return self.get(name).weight
+
+    def top(self) -> SLAClass:
+        """The most-important class (never shed/capped by brown-out)."""
+        return self._ordered[0]
+
+    def shed_order(self) -> List[str]:
+        """Brown-out shed order: LEAST-important sheddable classes first;
+        the top class is excluded regardless of its flag."""
+        return [c.name for c in reversed(self._ordered[1:]) if c.sheddable]
+
+    def slo_class_targets(self) -> Dict[str, Dict[str, float]]:
+        """Per-class latency targets in the ``SLOConfig.class_targets``
+        shape (classes without targets are absent)."""
+        out: Dict[str, Dict[str, float]] = {}
+        for c in self._ordered:
+            t: Dict[str, float] = {}
+            if c.ttft_target_ms is not None:
+                t["ttft_p99_ms"] = c.ttft_target_ms
+            if c.tpot_target_ms is not None:
+                t["tpot_p99_ms"] = c.tpot_target_ms
+            if t:
+                out[c.name] = t
+        return out
+
+    # ------------------------------------------------------------- parsing
+    @classmethod
+    def parse(cls, spec: str) -> "SLAClassSet":
+        """Parse the CLI grammar (module docstring); unknown keys raise —
+        a typo'd class config must not silently serve everyone equal."""
+        classes: List[SLAClass] = []
+        default = None
+        for entry in spec.split(";"):
+            entry = entry.strip()
+            if not entry:
+                continue
+            name, _, args = entry.partition(":")
+            name = name.strip()
+            kw: Dict[str, object] = {"name": name}
+            is_default = False
+            for part in args.split(","):
+                part = part.strip()
+                if not part:
+                    continue
+                if "=" not in part:
+                    raise ValueError(f"SLA class entry {part!r} is not "
+                                     f"key=value (in {entry!r})")
+                k, v = (s.strip() for s in part.split("=", 1))
+                if k == "priority":
+                    kw[k] = int(v)
+                elif k in ("weight", "ttft_target_ms", "tpot_target_ms"):
+                    kw[k] = float(v)
+                elif k == "sheddable":
+                    kw[k] = v.lower() in ("1", "true", "yes")
+                elif k == "default":
+                    is_default = v.lower() in ("1", "true", "yes")
+                else:
+                    raise ValueError(
+                        f"unknown SLA class key {k!r} (known: priority, "
+                        f"weight, ttft_target_ms, tpot_target_ms, "
+                        f"sheddable, default)")
+            if "priority" not in kw:
+                # declaration order is the priority when unstated
+                kw["priority"] = len(classes)
+            classes.append(SLAClass(**kw))
+            if is_default:
+                if default is not None:
+                    raise ValueError("more than one SLA class flagged "
+                                     "default=1")
+                default = name
+        return cls(classes, default=default)
+
+    def __repr__(self) -> str:
+        inner = "; ".join(
+            f"{c.name}(p{c.priority}, w{c.weight:g}"
+            + ("" if c.sheddable else ", unsheddable") + ")"
+            for c in self._ordered)
+        return f"SLAClassSet[{inner}; default={self.default}]"
+
+
+# the stock three-tier set: latency-sensitive interactive traffic, the
+# default standard tier, and sheddable bulk/batch work
+DEFAULT_CLASSES = (
+    SLAClass("interactive", priority=0, weight=4.0, sheddable=False),
+    SLAClass("standard", priority=1, weight=2.0),
+    SLAClass("batch", priority=2, weight=1.0),
+)
+
+
+def default_class_set() -> SLAClassSet:
+    """The stock interactive/standard/batch set with ``standard`` default."""
+    return SLAClassSet(DEFAULT_CLASSES, default="standard")

@@ -516,7 +516,12 @@ def build_fleet_traces(replica_sources: Sequence[dict],
         root = tb.add("request", "request", t0, t1, None, trace_id=tid,
                       segments=len(segments),
                       frontend_request_id=(submit.get("request_id")
-                                           if submit else None))
+                                           if submit else None),
+                      # SLA class (serving/sla.py): the tier this request
+                      # served under — journaled at submit, so a waterfall
+                      # can be sliced by tenant class
+                      sla_class=(submit.get("sla_class")
+                                 if submit else None))
         # router-altitude spans: frontend queue wait + every placement
         places = [e for e in r_evs if e["event"] == "place"]
         if submit is not None:

@@ -439,7 +439,8 @@ class ServingTelemetry:
     def request_arrival(self, rid: int, prompt_len: int,
                         max_new_tokens: int,
                         ts: Optional[float] = None,
-                        trace_id: Optional[str] = None) -> None:
+                        trace_id: Optional[str] = None,
+                        sla_class: Optional[str] = None) -> None:
         """``ts``: optional ``time.perf_counter()`` timestamp of when the
         request ACTUALLY arrived upstream (defaults to now). Open-loop
         drivers backdate to the scheduled arrival so queue wait spent inside
@@ -449,20 +450,27 @@ class ServingTelemetry:
         the router mints one at frontend submit and threads it through
         placement so a request's events stay joinable across replicas; a
         standalone runner's telemetry mints its own. Minted only on the
-        ENABLED path (the disabled path must stay allocation-free)."""
+        ENABLED path (the disabled path must stay allocation-free).
+
+        ``sla_class``: the tenant tier (serving/sla.py). Stamped on the
+        record (the SLO monitor's per-class targets and offender
+        attribution key on it) and every TTFT/TPOT/queue-wait observation
+        of a classed request ALSO lands in the ``sla_class``-labelled
+        histogram series beside the fleet-wide one."""
         self._c_requests.inc()
         if not self.enabled:
             return
         if trace_id is None:
             trace_id = self.mint_trace_id()
         rec = self._event("arrival", rid, _ts=ts, prompt_len=prompt_len,
-                          max_new_tokens=max_new_tokens, trace_id=trace_id)
+                          max_new_tokens=max_new_tokens, trace_id=trace_id,
+                          **({"sla_class": sla_class} if sla_class else {}))
         self.requests[rid] = {
             "arrival_ts": rec["ts"], "placed_ts": None, "first_token_ts": None,
             "last_token_ts": None, "finish_ts": None, "prompt_len": prompt_len,
             "tokens": 0, "prefill_tokens": 0, "prefix_hit_tokens": 0,
             "preemptions": 0, "finish_reason": None, "tpot_observed": False,
-            "trace_id": trace_id,
+            "trace_id": trace_id, "sla_class": sla_class,
         }
 
     def request_placed(self, rid: int, slot: int, resumed: bool = False) -> None:
@@ -474,6 +482,8 @@ class ServingTelemetry:
             r["placed_ts"] = rec["ts"]
             self._h_queue.observe(rec["ts"] - r["arrival_ts"],
                                   exemplar=self._exemplar(r))
+            self._class_observe(self._h_queue, r,
+                                rec["ts"] - r["arrival_ts"])
 
     def request_prefix_hit(self, rid: int, tokens: int) -> None:
         self._c_prefix.inc(tokens)
@@ -528,6 +538,17 @@ class ServingTelemetry:
         tid = r.get("trace_id") if r is not None else None
         return {"trace_id": tid} if tid else None
 
+    def _class_observe(self, base: Histogram, r: Optional[dict], v) -> None:
+        """Mirror one latency observation into the request's ``sla_class``-
+        labelled series beside the fleet-wide histogram (serving/sla.py) —
+        a classless request (or a disabled-path call, which never reaches
+        here) costs one dict read."""
+        cls = r.get("sla_class") if r is not None else None
+        if not cls:
+            return
+        self.registry.histogram(base.name, base.buckets, help=base.help,
+                                labels={"sla_class": cls}).observe(v)
+
     def _maybe_observe_tpot(self, r: dict) -> None:
         """Observe TPOT once per finished request — from finish OR from the
         step-end note_emitted, whichever lands last (the runner finishes a
@@ -536,9 +557,9 @@ class ServingTelemetry:
                 or r["first_token_ts"] is None or r["tokens"] <= 1):
             return
         r["tpot_observed"] = True
-        self._h_tpot.observe(
-            (r["last_token_ts"] - r["first_token_ts"]) / (r["tokens"] - 1),
-            exemplar=self._exemplar(r))
+        tpot = (r["last_token_ts"] - r["first_token_ts"]) / (r["tokens"] - 1)
+        self._h_tpot.observe(tpot, exemplar=self._exemplar(r))
+        self._class_observe(self._h_tpot, r, tpot)
 
     def note_emitted(self, emitted: Dict[int, List[int]]) -> None:
         """Fold one step's {request_id: new tokens} into the per-request
@@ -558,6 +579,8 @@ class ServingTelemetry:
                 r["first_token_ts"] = rec["ts"]
                 self._h_ttft.observe(rec["ts"] - r["arrival_ts"],
                                      exemplar=self._exemplar(r))
+                self._class_observe(self._h_ttft, r,
+                                    rec["ts"] - r["arrival_ts"])
                 ts = rec["ts"]
                 self._event("commit", rid, tokens=n)
             else:
@@ -676,15 +699,30 @@ class ServingTelemetry:
         from .benchmark import percentiles
 
         ttft, queue_wait, tpot = [], [], []
+        # per-SLA-class sample splits (serving/sla.py): populated only when
+        # classed requests exist, so classless snapshots keep their shape
+        by_class: Dict[str, Dict[str, list]] = {}
         for r in self.requests.values():
+            cls = r.get("sla_class")
+            c = (by_class.setdefault(
+                cls, {"ttft": [], "tpot": [], "queue_wait": [], "tokens": []})
+                if cls else None)
             if r["first_token_ts"] is not None:
                 ttft.append(r["first_token_ts"] - r["arrival_ts"])
+                if c is not None:
+                    c["ttft"].append(ttft[-1])
             if r["placed_ts"] is not None:
                 queue_wait.append(r["placed_ts"] - r["arrival_ts"])
+                if c is not None:
+                    c["queue_wait"].append(queue_wait[-1])
             if (r["first_token_ts"] is not None and r["tokens"] > 1
                     and r["last_token_ts"] is not None):
                 tpot.append((r["last_token_ts"] - r["first_token_ts"])
                             / (r["tokens"] - 1))
+                if c is not None:
+                    c["tpot"].append(tpot[-1])
+            if c is not None:
+                c["tokens"].append(r["tokens"])
         steps: Dict[str, int] = {}
         tokens_by_kind: Dict[str, int] = {}
         for s in self.steps:
@@ -709,6 +747,17 @@ class ServingTelemetry:
             # per-kind device-time attribution of the last profiled window
             "timing": self.timing,
         }
+        if by_class:
+            out["by_class"] = {
+                cls: {
+                    "requests": len(c["tokens"]),
+                    "tokens": int(sum(c["tokens"])),
+                    "ttft_ms": percentiles(c["ttft"]) if c["ttft"] else None,
+                    "tpot_ms": percentiles(c["tpot"]) if c["tpot"] else None,
+                    "queue_wait_ms": (percentiles(c["queue_wait"])
+                                      if c["queue_wait"] else None),
+                }
+                for cls, c in sorted(by_class.items())}
         return out
 
     def chrome_trace(self) -> Dict[str, object]:

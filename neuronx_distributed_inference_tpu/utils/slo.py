@@ -15,6 +15,17 @@ Config strings (the CLI's ``--slo`` flag) are ``key=value`` pairs:
 
 Unset targets are simply not evaluated — an empty config is healthy by
 definition.
+
+Per-SLA-class targets (ISSUE-13): a dotted key scopes a LATENCY target to
+one class — evaluated over only that class's samples, violated as
+``<class>.<target>``, offenders carrying the class label::
+
+    --slo "ttft_p99_ms=500,interactive.ttft_p99_ms=150,batch.tpot_p99_ms=80"
+
+Requests are classed by the ``sla_class`` their telemetry arrival recorded
+(runner ``submit(sla_class=)`` — serving/sla.py); a class target over a run
+with no classed requests measures nothing and renders no verdict, exactly
+like any other unmeasured target.
 """
 
 from __future__ import annotations
@@ -51,15 +62,25 @@ class SLOConfig:
     # slo_violation line (worst-k by sample value, with trace ids — the
     # jump-off into scripts/explain_request.py)
     worst_k: int = 3
+    # per-SLA-class latency targets: {class: {target_name: ceiling_ms}} —
+    # evaluated over that class's samples only (ISSUE-13 satellite)
+    class_targets: Dict[str, Dict[str, float]] = dataclasses.field(
+        default_factory=dict)
 
-    _NON_TARGETS = ("window_s", "worst_k")
+    _NON_TARGETS = ("window_s", "worst_k", "class_targets")
+    # targets a dotted <class>.<key> entry may scope (latency-sample-backed)
+    _CLASS_TARGET_KEYS = ("ttft_p99_ms", "ttft_p50_ms", "tpot_p99_ms",
+                          "queue_p99_ms")
 
     @classmethod
     def parse(cls, spec: str) -> "SLOConfig":
-        """Parse the CLI's ``key=value[,key=value...]`` form; unknown keys
-        raise (a typo'd SLO must not silently pass forever)."""
+        """Parse the CLI's ``key=value[,key=value...]`` form; dotted keys
+        (``interactive.ttft_p99_ms=150``) scope a latency target to one SLA
+        class. Unknown keys raise (a typo'd SLO must not silently pass
+        forever)."""
         fields = {f.name for f in dataclasses.fields(cls)}
         kw = {}
+        class_targets: Dict[str, Dict[str, float]] = {}
         for part in spec.split(","):
             part = part.strip()
             if not part:
@@ -68,10 +89,20 @@ class SLOConfig:
                 raise ValueError(f"SLO spec entry {part!r} is not key=value")
             k, v = part.split("=", 1)
             k = k.strip()
+            if "." in k:
+                cls_name, _, target = k.partition(".")
+                if target not in cls._CLASS_TARGET_KEYS:
+                    raise ValueError(
+                        f"unknown per-class SLO target {target!r} in {k!r} "
+                        f"(known: {list(cls._CLASS_TARGET_KEYS)})")
+                class_targets.setdefault(cls_name, {})[target] = float(v)
+                continue
             if k not in fields:
                 raise ValueError(f"unknown SLO target {k!r} "
                                  f"(known: {sorted(fields)})")
             kw[k] = int(v) if k == "worst_k" else float(v)
+        if class_targets:
+            kw["class_targets"] = class_targets
         return cls(**kw)
 
     def targets(self) -> Dict[str, float]:
@@ -89,9 +120,13 @@ class SLOReport:
     window_s: float
     window_requests: int
     # per violated LATENCY target: the worst-k offending requests
-    # [{request_id, trace_id, value_ms}, ...] — the aggregate percentile,
-    # made actionable (feed the trace_id to scripts/explain_request.py)
+    # [{request_id, trace_id, sla_class, value_ms}, ...] — the aggregate
+    # percentile, made actionable (feed the trace_id to
+    # scripts/explain_request.py; the class label says WHOSE tier blew it)
     offenders: Dict[str, List[dict]] = dataclasses.field(default_factory=dict)
+    # measured value per configured per-class target: {class: {target: v}}
+    class_values: Dict[str, Dict[str, Optional[float]]] = dataclasses.field(
+        default_factory=dict)
 
 
 def _p(vals: List[float], q: float) -> Optional[float]:
@@ -136,38 +171,39 @@ class SLOMonitor:
         now = (time.perf_counter() if now is None else now) - tel._t0
         lo = now - cfg.window_s
 
-        # samples carry their request id so a violated target can NAME its
-        # worst-k offenders instead of only an aggregate percentile
+        # samples carry their request id (worst-k offender naming) and SLA
+        # class (per-class targets + offender attribution, serving/sla.py)
         ttft_s, tpot_s, queue_s = [], [], []
         n_win = 0
         for rid, r in tel.requests.items():
             ft, lt = r["first_token_ts"], r["last_token_ts"]
             live = r["finish_ts"] is None
+            cls = r.get("sla_class")
             if ft is not None and ft >= lo:
                 n_win += 1
-                ttft_s.append((1e3 * (ft - r["arrival_ts"]), rid))
+                ttft_s.append((1e3 * (ft - r["arrival_ts"]), rid, cls))
             elif ft is None and live and r["arrival_ts"] <= now:
                 # CENSORED sample: a live request with no first token yet
                 # contributes its AGE as a TTFT lower bound — a wedged
                 # replica (requests arrive, nothing is produced) must flag
                 # the ceiling, not read as "nothing measured, no verdict"
                 n_win += 1
-                ttft_s.append((1e3 * (now - r["arrival_ts"]), rid))
+                ttft_s.append((1e3 * (now - r["arrival_ts"]), rid, cls))
             # TPOT windows on ACTIVITY (last token in window), not on the
             # first token: a generation longer than window_s would otherwise
             # drop out of the window while still degrading
             if ft is not None and lt is not None and lt >= lo \
                     and r["tokens"] > 1:
-                tpot_s.append((1e3 * (lt - ft) / (r["tokens"] - 1), rid))
+                tpot_s.append((1e3 * (lt - ft) / (r["tokens"] - 1), rid, cls))
             if r["placed_ts"] is not None and r["placed_ts"] >= lo:
                 queue_s.append((1e3 * (r["placed_ts"] - r["arrival_ts"]),
-                                rid))
+                                rid, cls))
             elif r["placed_ts"] is None and live and r["arrival_ts"] <= now:
                 # censored queue-wait for requests still waiting on a slot
-                queue_s.append((1e3 * (now - r["arrival_ts"]), rid))
-        ttft = [v for v, _ in ttft_s]
-        tpot = [v for v, _ in tpot_s]
-        queue = [v for v, _ in queue_s]
+                queue_s.append((1e3 * (now - r["arrival_ts"]), rid, cls))
+        ttft = [v for v, _, _ in ttft_s]
+        tpot = [v for v, _, _ in tpot_s]
+        queue = [v for v, _, _ in queue_s]
 
         reg = tel.registry
         values: Dict[str, Optional[float]] = {
@@ -207,6 +243,20 @@ class SLOMonitor:
         samples_by_target = {"ttft_p99_ms": ttft_s, "ttft_p50_ms": ttft_s,
                              "tpot_p99_ms": tpot_s, "queue_p99_ms": queue_s}
         offenders: Dict[str, List[dict]] = {}
+
+        def _name_offenders(key: str, samples: List[tuple]) -> None:
+            """The worst-k requests behind a blown percentile — named, with
+            trace ids AND class labels, so the violation is actionable
+            (scripts/explain_request.py takes it from here)."""
+            worst = sorted(samples, key=lambda s: s[0],
+                           reverse=True)[: max(0, cfg.worst_k)]
+            offenders[key] = [
+                {"request_id": rid,
+                 "trace_id": tel.requests[rid].get("trace_id"),
+                 "sla_class": s_cls,
+                 "value_ms": round(val, 3)}
+                for val, rid, s_cls in worst]
+
         for name, target in cfg.targets().items():
             v = values.get(name)
             if v is None:
@@ -218,15 +268,29 @@ class SLOMonitor:
                 violations.append(f"{name}: {v:.4g} > ceiling {target:.4g}")
                 samples = samples_by_target.get(name)
                 if samples:
-                    # the worst-k requests behind the blown percentile —
-                    # named, with trace ids, so the violation is actionable
-                    # (scripts/explain_request.py takes it from here)
-                    worst = sorted(samples, reverse=True)[: max(0, cfg.worst_k)]
-                    offenders[name] = [
-                        {"request_id": rid,
-                         "trace_id": tel.requests[rid].get("trace_id"),
-                         "value_ms": round(val, 3)}
-                        for val, rid in worst]
+                    _name_offenders(name, samples)
+
+        # per-SLA-class targets (ISSUE-13): each evaluates over ONLY its
+        # class's samples; violations and offenders carry the class name,
+        # so the monitor can finally say WHOSE tier degraded instead of
+        # judging the fleet as one blob
+        class_values: Dict[str, Dict[str, Optional[float]]] = {}
+        for cls_name, targets in cfg.class_targets.items():
+            cvals: Dict[str, Optional[float]] = {}
+            for name, target in targets.items():
+                samples = [s for s in samples_by_target.get(name, ())
+                           if s[2] == cls_name]
+                q = 50 if name.endswith("p50_ms") else 99
+                v = _p([s[0] for s in samples], q)
+                cvals[name] = v
+                if v is None:
+                    continue                   # nothing measured: no verdict
+                if v > target:
+                    violations.append(
+                        f"{cls_name}.{name}: {v:.4g} > ceiling {target:.4g}")
+                    if samples:
+                        _name_offenders(f"{cls_name}.{name}", samples)
+            class_values[cls_name] = cvals
 
         healthy = not violations
         self._g_healthy.set(1 if healthy else 0)
@@ -239,7 +303,12 @@ class SLOMonitor:
                 "window_requests": n_win,
                 "offenders": offenders,
                 "values": {k: v for k, v in values.items()
-                           if v is not None}}))
+                           if v is not None},
+                **({"class_values": {
+                    c: {k: v for k, v in cv.items() if v is not None}
+                    for c, cv in class_values.items()}}
+                   if class_values else {})}))
         return SLOReport(healthy=healthy, violations=violations,
                          values=values, window_s=cfg.window_s,
-                         window_requests=n_win, offenders=offenders)
+                         window_requests=n_win, offenders=offenders,
+                         class_values=class_values)

@@ -82,6 +82,13 @@ _FILE_SCOPES = {
     # ISSUE-12 request tracing: pure post-processing over already-recorded
     # telemetry events — never enters a graph (lint-only)
     "serving/tracing.py": [],
+    # ISSUE-13 overload control plane: SLA classes are plain config objects
+    # and the autoscaler drives router APIs (add/drain/remove_replica) —
+    # neither enters a graph (lint-only). The weighted-fair budget split
+    # itself lives in continuous_batching.py, whose row above already
+    # re-audits the full CB fleet (cb_mixed included) on any edit.
+    "serving/sla.py": [],
+    "serving/autoscaler.py": [],
     "serving/kv_tiering.py": ["serving_tier", "cb_paged", "cb_mixed",
                               "cb_megastep", "cb_spec", "cb_eagle"],
 }

@@ -76,4 +76,17 @@ if [ "${TRACING_TIER1_TESTS:-0}" -lt 1 ]; then
     echo "ERROR: request-tracing tests are not in the tier-1 marker set" >&2
     [ "$rc" -eq 0 ] && rc=1
 fi
+
+# ISSUE-13 unchanged-semantics guard: the multi-tenant overload suite (SLA
+# classes, weighted-fair budgets, preemptive priorities, brown-out ladder,
+# autoscaler) must stay collected inside the tier-1 marker set.
+MULTITENANT_TIER1_TESTS=$(env JAX_PLATFORMS=cpu python -m pytest \
+    "$REPO/tests/test_multitenant.py" \
+    -q -m 'not slow' --collect-only -p no:cacheprovider 2>/dev/null \
+    | grep -ac '::' || true)
+echo "MULTITENANT_TIER1_TESTS=$MULTITENANT_TIER1_TESTS"
+if [ "${MULTITENANT_TIER1_TESTS:-0}" -lt 1 ]; then
+    echo "ERROR: multi-tenant overload tests are not in the tier-1 marker set" >&2
+    [ "$rc" -eq 0 ] && rc=1
+fi
 exit "$rc"

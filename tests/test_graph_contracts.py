@@ -381,6 +381,11 @@ def test_changed_mode_scope_map_fails_closed():
     # ISSUE-12: request tracing is post-processing over recorded telemetry
     # events — lint-only; any OTHER new serving/ file still fails closed
     assert mod._scopes_for_changes([pkg + "serving/tracing.py"]) == []
+    # ISSUE-13: SLA classes are plain config and the autoscaler drives
+    # router APIs — lint-only; the weighted-fair split itself lives in
+    # continuous_batching.py, whose map re-audits the full CB fleet
+    assert mod._scopes_for_changes([pkg + "serving/sla.py"]) == []
+    assert mod._scopes_for_changes([pkg + "serving/autoscaler.py"]) == []
     assert set(mod._scopes_for_changes([pkg + "serving/kv_tiering.py"])) == {
         "serving_tier", "cb_paged", "cb_mixed", "cb_megastep", "cb_spec",
         "cb_eagle"}

@@ -1,0 +1,544 @@
+"""Overload-robust multi-tenant serving (ISSUE-13): SLA-class admission,
+weighted-fair mixed-step budgets, preemptive priorities, the brown-out
+ladder, and SLO-driven autoscaling.
+
+Correctness bar: every scheduling decision of the control plane is a pure
+RE-ORDERING — whatever the classes, weights, preemptions, or fleet resizes
+did, every admitted greedy stream must stay bit-identical to its dedicated
+single-request reference (shed requests are refused typed+counted at the
+door, never silently lost)."""
+
+import numpy as np
+import pytest
+
+from neuronx_distributed_inference_tpu.config import (
+    TpuConfig, load_pretrained_config)
+from neuronx_distributed_inference_tpu.models.llama.modeling_llama import (
+    LlamaForCausalLM, LlamaInferenceConfig)
+from neuronx_distributed_inference_tpu.runtime.continuous_batching import (
+    ContinuousBatchingRunner)
+from neuronx_distributed_inference_tpu.serving import (
+    EngineReplica, FaultInjector, PrefixAffinityRouter, ReplicaAutoscaler,
+    RouterOverloaded, SLAClass, SLAClassSet, default_class_set)
+
+BS = 8   # pa_block_size everywhere here
+
+
+def _make_app(hf_cfg, slots=2, blocks=48, seq_len=96):
+    tpu_cfg = TpuConfig(
+        batch_size=slots, seq_len=seq_len, max_context_length=32,
+        dtype="float32", context_encoding_buckets=[16, 32],
+        token_generation_buckets=[48, 96], is_continuous_batching=True,
+        paged_attention_enabled=True, pa_num_blocks=blocks, pa_block_size=BS)
+    config = LlamaInferenceConfig(tpu_cfg,
+                                  load_config=load_pretrained_config(hf_cfg))
+    app = LlamaForCausalLM(None, config)
+    app.load_random(seed=0)
+    return app
+
+
+@pytest.fixture(scope="module")
+def app(tiny_llama_hf_config):
+    return _make_app(tiny_llama_hf_config)
+
+
+@pytest.fixture(scope="module")
+def sla():
+    return default_class_set()
+
+
+def _replicas(app, n=1, sla_classes=None, ids=None, **runner_kw):
+    runner_kw.setdefault("decode_chunk", 4)
+    return [EngineReplica(
+        rid, lambda tel: ContinuousBatchingRunner(
+            app, telemetry=tel, sla_classes=sla_classes, **runner_kw))
+        for rid in (ids or [str(i) for i in range(n)])]
+
+
+def _reference(app, prompts, max_new):
+    return [app.generate(p[None, :], max_new_tokens=max_new
+                         ).tokens[0].tolist() for p in prompts]
+
+
+# ------------------------------------------------------------- class set
+def test_sla_class_set_grammar_and_validation():
+    s = SLAClassSet.parse(
+        "interactive:priority=0,weight=4,ttft_target_ms=250,sheddable=0;"
+        "standard:priority=1,weight=2,default=1;batch:priority=2,weight=1")
+    assert s.names() == ["interactive", "standard", "batch"]
+    assert s.default == "standard"
+    assert s.resolve(None) == "standard"
+    assert s.resolve("batch") == "batch"
+    # shed order: least-important sheddable first, top class excluded
+    assert s.shed_order() == ["batch", "standard"]
+    assert s.slo_class_targets() == {
+        "interactive": {"ttft_p99_ms": 250.0}}
+    with pytest.raises(ValueError, match="unknown SLA class"):
+        s.resolve("turbo")
+    with pytest.raises(ValueError, match="unique"):
+        SLAClassSet([SLAClass("a", 0), SLAClass("b", 0)])
+    with pytest.raises(ValueError, match="unknown SLA class key"):
+        SLAClassSet.parse("a:prio=1")
+    with pytest.raises(ValueError, match="weight"):
+        SLAClass("a", 0, weight=0.0)
+    # an unsheddable bottom class never enters the ladder
+    s2 = SLAClassSet([SLAClass("hi", 0), SLAClass("lo", 1, sheddable=False)])
+    assert s2.shed_order() == []
+
+
+def test_sla_class_threads_runner_and_telemetry(app, sla):
+    runner = ContinuousBatchingRunner(app, decode_chunk=4, telemetry=True,
+                                      sla_classes=sla)
+    rng = np.random.default_rng(3)
+    p = rng.integers(1, 256, size=(10,)).astype(np.int32)
+    ra = runner.submit(p, max_new_tokens=4, sla_class="interactive")
+    rb = runner.submit(p, max_new_tokens=4)          # default class
+    with pytest.raises(ValueError, match="unknown SLA class"):
+        runner.submit(p, sla_class="nope")
+    runner.run_to_completion()
+    assert runner.finished[ra].sla_class == "interactive"
+    assert runner.finished[rb].sla_class == "standard"
+    recs = runner.telemetry.requests
+    assert recs[ra]["sla_class"] == "interactive"
+    # class-labelled TTFT series landed beside the fleet-wide one
+    h = runner.telemetry.registry.get("serving_ttft_seconds",
+                                      labels={"sla_class": "interactive"})
+    assert h is not None and h.count == 1
+    assert "interactive" in runner.stats()["by_class"]
+    # a classless runner refuses class labels outright
+    plain = ContinuousBatchingRunner(app, decode_chunk=4)
+    with pytest.raises(ValueError, match="no sla_classes"):
+        plain.submit(p, sla_class="interactive")
+
+
+# ------------------------------------------------- weighted-fair budgets
+def _mixed_runner(app, sla_classes=None, **kw):
+    kw.setdefault("decode_chunk", 4)
+    kw.setdefault("prefill_chunk", 16)
+    kw.setdefault("prefill_token_budget", 32)
+    kw.setdefault("mixed_decode_steps", 2)
+    return ContinuousBatchingRunner(app, telemetry=True,
+                                    sla_classes=sla_classes, **kw)
+
+
+def test_weighted_fair_anti_starvation(tiny_llama_hf_config, sla):
+    """TWO bulk tenants' long prompts saturating every chunk row and the
+    whole token budget must NOT starve an interactive prompt's prefill:
+    weighted-fair ranks the interactive row first and hands it its weight
+    share on its very first step in the batch — under FIFO it waits until
+    a bulk prompt finishes streaming."""
+    app = _make_app(tiny_llama_hf_config, slots=3)
+    rng = np.random.default_rng(7)
+    bulks = [rng.integers(1, 256, size=(64,)).astype(np.int32)   # 4 chunks
+             for _ in range(2)]
+    inter = rng.integers(1, 256, size=(12,)).astype(np.int32)
+    refs = _reference(app, bulks + [inter], max_new=6)
+
+    def first_interactive_chunk_step(sla_classes, bulk_cls, inter_cls):
+        runner = _mixed_runner(app, sla_classes=sla_classes)
+        bs = [runner.submit(b, max_new_tokens=6, sla_class=bulk_cls)
+              for b in bulks]
+        i = runner.submit(inter, max_new_tokens=6, sla_class=inter_cls)
+        steps_until = None
+        for step in range(60):
+            before = runner.telemetry.requests[i]["prefill_tokens"]
+            runner.step()
+            if steps_until is None and \
+                    runner.telemetry.requests[i]["prefill_tokens"] > before:
+                steps_until = step
+            if not runner.has_work:
+                break
+        out = [runner.finished[b].generated for b in bs] + [
+            runner.finished[i].generated]
+        assert out == refs
+        return steps_until
+
+    # weighted-fair: the interactive insert advances on its FIRST step in
+    # the batch (rows hand out most-important-first; its weight share of
+    # the budget covers the whole 12-token prompt)
+    wf = first_interactive_chunk_step(sla, "batch", "interactive")
+    # FIFO (classless): the two bulk inserts hold BOTH chunk rows and the
+    # full 32-token budget every step until one completes — interactive
+    # starves in the meantime
+    fifo = first_interactive_chunk_step(None, None, None)
+    assert wf == 0, f"weighted-fair starved interactive prefill ({wf})"
+    assert fifo >= 1, f"FIFO control unexpectedly interleaved ({fifo})"
+
+
+def test_equal_weight_classes_match_fifo_streams(tiny_llama_hf_config):
+    """FIFO-equivalence: with every class at EQUAL weight the weighted-fair
+    split is a pure re-ordering — every emitted stream stays bit-identical
+    to the classless FIFO runner's on the same workload."""
+    app = _make_app(tiny_llama_hf_config)
+    eq = SLAClassSet([SLAClass("a", 0, weight=1.0),
+                      SLAClass("b", 1, weight=1.0)])
+    rng = np.random.default_rng(11)
+    prompts = [rng.integers(1, 256, size=(n,)).astype(np.int32)
+               for n in (40, 25, 12)]
+    classes = ["a", "b", "a"]
+
+    def serve(sla_classes):
+        runner = _mixed_runner(app, sla_classes=sla_classes)
+        rids = [runner.submit(p, max_new_tokens=6,
+                              sla_class=(c if sla_classes else None))
+                for p, c in zip(prompts, classes)]
+        out = runner.run_to_completion()
+        return [out[r] for r in rids]
+
+    assert serve(eq) == serve(None)
+
+
+def test_single_class_scheduling_identical_to_fifo(tiny_llama_hf_config,
+                                                   sla):
+    """With ONE class inserting, the weighted-fair path is the FIFO code
+    path — chunk-for-chunk identical scheduling, not merely same tokens."""
+    app = _make_app(tiny_llama_hf_config)
+    rng = np.random.default_rng(13)
+    prompts = [rng.integers(1, 256, size=(n,)).astype(np.int32)
+               for n in (40, 30)]
+
+    def chunk_events(sla_classes):
+        runner = _mixed_runner(app, sla_classes=sla_classes)
+        for p in prompts:
+            runner.submit(p, max_new_tokens=4,
+                          sla_class=("standard" if sla_classes else None))
+        runner.run_to_completion()
+        return [(e["request_id"], e["tokens"], e["pos"])
+                for e in runner.telemetry.events
+                if e["event"] == "prefill_chunk"]
+
+    assert chunk_events(sla) == chunk_events(None)
+
+
+# ------------------------------------------------- preemptive priorities
+def test_class_preemption_migrates_victim_bit_exact(tiny_llama_hf_config,
+                                                    sla):
+    """Two bulk streams fill the only replica's slots; an interactive
+    arrival preempts the NEWEST bulk victim through the existing preempt
+    path. Victim re-queues, resumes, and every stream matches its
+    reference."""
+    app = _make_app(tiny_llama_hf_config)
+    rng = np.random.default_rng(17)
+    prompts = [rng.integers(1, 256, size=(n,)).astype(np.int32)
+               for n in (12, 14, 16)]
+    refs = _reference(app, prompts, max_new=12)
+    router = PrefixAffinityRouter(_replicas(app, 1, sla_classes=sla),
+                                  sla_classes=sla)
+    b0 = router.submit(prompts[0], max_new_tokens=12, sla_class="batch")
+    b1 = router.submit(prompts[1], max_new_tokens=12, sla_class="batch")
+    router.step()
+    assert router.requests[b1].replica == "0"
+    i0 = router.submit(prompts[2], max_new_tokens=12, sla_class="interactive")
+    router.step()
+    s = router.stats()["sla"]
+    assert s["preempted_by_class"].get("batch", 0) == 1
+    # victim selection: the NEWEST bulk placement (b1), never b0
+    assert router.requests[b1].class_preemptions == 1
+    assert router.requests[b0].class_preemptions == 0
+    assert router.requests[i0].replica is not None
+    out = router.run_to_completion()
+    for rid, ref in zip((b0, b1, i0), refs):
+        assert out[rid] == ref
+    # the victim's history is journaled for the span tree
+    assert any(e["event"] == "class_preempt"
+               and e["request_id"] == b1 for e in router.trace_events)
+
+
+def test_class_preemption_parks_in_tier_and_resumes(tiny_llama_hf_config,
+                                                    sla):
+    """Park-in-tier variant: with a host KV tier attached, the victim's
+    committed blocks leave through the tiered free path (idle pool / host
+    RAM) and the resumed stream still matches its reference."""
+    from neuronx_distributed_inference_tpu.serving import HostKVTier
+
+    app = _make_app(tiny_llama_hf_config)
+    tier = HostKVTier(capacity_blocks=32)
+    rng = np.random.default_rng(19)
+    # block-aligned bulk prompts so committed prefixes are parkable
+    prompts = [rng.integers(1, 256, size=(n,)).astype(np.int32)
+               for n in (2 * BS, 2 * BS + 3, 10)]
+    refs = _reference(app, prompts, max_new=10)
+    router = PrefixAffinityRouter(
+        _replicas(app, 1, sla_classes=sla, kv_tier=tier), sla_classes=sla)
+    b0 = router.submit(prompts[0], max_new_tokens=10, sla_class="batch")
+    b1 = router.submit(prompts[1], max_new_tokens=10, sla_class="batch")
+    for _ in range(2):
+        router.step()
+    i0 = router.submit(prompts[2], max_new_tokens=10,
+                       sla_class="interactive")
+    router.step()
+    assert router.stats()["sla"]["preempted_by_class"].get("batch", 0) >= 1
+    out = router.run_to_completion()
+    for rid, ref in zip((b0, b1, i0), refs):
+        assert out[rid] == ref
+    # the victim's committed full blocks were parked (idle pool), visible
+    # as prefix-cache hits when it resumed
+    rep = next(iter(router.replicas.values()))
+    hits = rep.registry.get("serving_prefix_hit_tokens_total")
+    assert hits is not None and hits.value > 0
+
+
+def test_preemption_needs_strictly_lower_class(tiny_llama_hf_config, sla):
+    """Equal-class traffic never preempts itself: a batch arrival against a
+    batch-full replica queues, it does not evict."""
+    app = _make_app(tiny_llama_hf_config)
+    router = PrefixAffinityRouter(_replicas(app, 1, sla_classes=sla),
+                                  sla_classes=sla)
+    rng = np.random.default_rng(23)
+    prompts = [rng.integers(1, 256, size=(12,)).astype(np.int32)
+               for _ in range(3)]
+    for p in prompts[:2]:
+        router.submit(p, max_new_tokens=10, sla_class="batch")
+    router.step()
+    router.submit(prompts[2], max_new_tokens=10, sla_class="batch")
+    router.step()
+    assert router.stats()["sla"]["preempted_by_class"] == {}
+    router.run_to_completion()
+
+
+# ------------------------------------------------------- brown-out ladder
+def test_brownout_ladder_orders_shed_then_cap_never_top(
+        tiny_llama_hf_config, sla):
+    """The ladder under sustained unhealthy signal: shed batch, cap batch,
+    shed standard, cap standard — interactive is NEVER shed — and a healthy
+    signal walks it back down with hysteresis."""
+    app = _make_app(tiny_llama_hf_config)
+    healthy = [True]
+    router = PrefixAffinityRouter(
+        _replicas(app, 1, sla_classes=sla), sla_classes=sla,
+        slo_signal=lambda: healthy[0],
+        brownout_up_after=2, brownout_down_after=2)
+    assert router.stats()["sla"]["brownout_ladder"] == [
+        "shed:batch", "cap:batch", "shed:standard", "cap:standard"]
+    rng = np.random.default_rng(29)
+    p = rng.integers(1, 256, size=(10,)).astype(np.int32)
+
+    healthy[0] = False
+    router.step(); router.step()                     # level 1: shed batch
+    assert router.stats()["sla"]["brownout_level"] == 1
+    with pytest.raises(RouterOverloaded) as exc:
+        router.submit(p, max_new_tokens=4, sla_class="batch")
+    assert exc.value.sla_class == "batch"
+    assert exc.value.retry_after_s and exc.value.retry_after_s > 0
+    router.submit(p, max_new_tokens=4, sla_class="standard")   # still in
+    router.step(); router.step()                     # level 2: cap batch
+    assert router.stats()["sla"]["brownout_capped"] == ["batch"]
+    router.step(); router.step()                     # level 3: shed standard
+    with pytest.raises(RouterOverloaded):
+        router.submit(p, max_new_tokens=4, sla_class="standard")
+    # the top class is NEVER shed, at any level
+    router.step(); router.step()                     # level 4 (max)
+    assert router.stats()["sla"]["brownout_level"] == 4
+    router.submit(p, max_new_tokens=4, sla_class="interactive")
+    # per-class shed accounting + typed trace events
+    shed = router.stats()["sla"]["shed_by_class"]
+    assert shed.get("batch") == 1 and shed.get("standard") == 1
+    # recovery: healthy readings walk the ladder down (hysteresis: 2 each)
+    healthy[0] = True
+    for _ in range(8):
+        router.step()
+    assert router.stats()["sla"]["brownout_level"] == 0
+    ups = router.registry.get("router_brownout_transitions_total",
+                              labels={"direction": "up"})
+    downs = router.registry.get("router_brownout_transitions_total",
+                                labels={"direction": "down"})
+    assert ups.value == 4 and downs.value == 4
+    router.run_to_completion()
+
+
+def test_brownout_decode_cap_defers_lowest_class(tiny_llama_hf_config, sla):
+    """At the cap rung, batch work still QUEUED at the frontend defers
+    (counted, not shed, not placed) while already-running batch streams
+    drain — and it places again once the ladder walks back down. Deferred
+    work is never lost."""
+    app = _make_app(tiny_llama_hf_config)
+    healthy = [True]
+    reps = [EngineReplica(
+        "0", lambda tel: ContinuousBatchingRunner(
+            app, decode_chunk=4, telemetry=tel, sla_classes=sla),
+        max_queue_depth=1)]          # shallow: backlog stays at the frontend
+    router = PrefixAffinityRouter(
+        reps, sla_classes=sla, preemptive=False,
+        slo_signal=lambda: healthy[0],
+        brownout_up_after=1, brownout_down_after=1, brownout_decode_cap=1)
+    rng = np.random.default_rng(31)
+    prompts = [rng.integers(1, 256, size=(10,)).astype(np.int32)
+               for _ in range(4)]
+    # healthy intake: 2 batch into the slots, 1 into the replica queue, 1
+    # stuck at the FRONTEND (replica queue ceiling 1)
+    b_ids = [router.submit(p, max_new_tokens=12, sla_class="batch")
+             for p in prompts]
+    router.step()
+    assert len(router.queue) >= 1
+    # sustained unhealthy: ladder reaches the cap rung; the frontend-queued
+    # batch request now DEFERS every wave (live batch >= cap 1)
+    healthy[0] = False
+    router.step(); router.step()
+    assert router.stats()["sla"]["brownout_level"] >= 2
+    assert "batch" in router.stats()["sla"]["brownout_capped"]
+    router.step()
+    deferred = router.registry.get(
+        "router_class_placements_deferred_total",
+        labels={"sla_class": "batch"})
+    assert deferred is not None and deferred.value >= 1
+    # recovery: ladder walks down, the deferred request places and finishes
+    healthy[0] = True
+    out = router.run_to_completion()
+    for rid in b_ids:
+        assert len(out[rid]) == 12                   # deferred, never lost
+
+
+# ------------------------------------------------------------- autoscaler
+def test_autoscaler_grow_drain_hysteresis_fake_clock(tiny_llama_hf_config,
+                                                     sla):
+    """The state machine on a fake clock: sustained backlog grows (after
+    up_after ticks, respecting cooldown + max); idle drains + retires (down
+    to min); every stream bit-exact across the resizes."""
+    app = _make_app(tiny_llama_hf_config)
+    rng = np.random.default_rng(37)
+    prompts = [rng.integers(1, 256, size=(10 + n,)).astype(np.int32)
+               for n in range(8)]
+    refs = _reference(app, prompts, max_new=8)
+    clock = [0.0]
+    router = PrefixAffinityRouter(
+        _replicas(app, 1, sla_classes=sla), sla_classes=sla)
+
+    def factory(rid):
+        return _replicas(app, sla_classes=sla, ids=[rid])[0]
+
+    asc = ReplicaAutoscaler(router, factory, min_replicas=1, max_replicas=2,
+                            scale_up_queue_depth=1, up_after=2, down_after=3,
+                            cooldown_s=5.0, clock=lambda: clock[0])
+    rids = [router.submit(p, max_new_tokens=8, sla_class="standard")
+            for p in prompts]
+    router.place_queued()
+    assert len(router.queue) >= 2
+    assert asc.tick() is None                 # streak 1 of 2: hysteresis
+    clock[0] += 1
+    act = asc.tick()
+    assert act and act.startswith("grow:")
+    assert "as0" in router.replicas
+    clock[0] += 1
+    assert asc.tick() is None                 # cooldown gates a second grow
+    out = router.run_to_completion()
+    for rid, ref in zip(rids, refs):
+        assert out[rid] == ref
+    # idle: down_after ticks of quiet -> drain, then retire once empty
+    clock[0] += 10
+    acts = []
+    for _ in range(8):
+        acts.append(asc.tick())
+        clock[0] += 1
+    assert any(a and a.startswith("drain:") for a in acts)
+    assert any(a and a.startswith("retire:") for a in acts)
+    assert len(router.replicas) == 1          # back at min_replicas
+    s = asc.stats()
+    assert s["scale_ups"] == 1 and s["scale_downs"] == 1
+    # min bound: no further drain at fleet size 1
+    for _ in range(6):
+        assert asc.tick() is None or False
+        clock[0] += 1
+
+
+def test_autoscaler_validation_and_router_remove_guards(
+        tiny_llama_hf_config, app, sla):
+    router = PrefixAffinityRouter(_replicas(app, 2, sla_classes=sla),
+                                  sla_classes=sla)
+    with pytest.raises(ValueError, match="min_replicas"):
+        ReplicaAutoscaler(router, lambda rid: None, min_replicas=0)
+    with pytest.raises(ValueError, match="max_replicas"):
+        ReplicaAutoscaler(router, lambda rid: None, min_replicas=2,
+                          max_replicas=1)
+    # remove_replica refuses a live, undrained replica
+    with pytest.raises(ValueError, match="drain"):
+        router.remove_replica("0")
+    # and refuses to remove the last one
+    router.drain_replica("0")
+    router.remove_replica("0")
+    with pytest.raises(ValueError, match="last replica"):
+        router.remove_replica("1")
+    # add_replica refuses id collisions
+    with pytest.raises(ValueError, match="already registered"):
+        router.add_replica(_replicas(app, sla_classes=sla, ids=["1"])[0])
+
+
+# ------------------------------------------------------ per-class SLO
+def test_slo_per_class_targets_and_offender_attribution(caplog):
+    """Per-class targets judge ONLY their class's samples; violations and
+    offenders carry the class label (the monitor can finally say WHOSE tier
+    degraded)."""
+    import json as _json
+    import logging
+    import time as _time
+
+    from neuronx_distributed_inference_tpu.utils.metrics import (
+        ServingTelemetry)
+    from neuronx_distributed_inference_tpu.utils.slo import (
+        SLOConfig, SLOMonitor)
+
+    cfg = SLOConfig.parse(
+        "interactive.ttft_p99_ms=50,batch.ttft_p99_ms=5000")
+    assert cfg.class_targets == {
+        "interactive": {"ttft_p99_ms": 50.0},
+        "batch": {"ttft_p99_ms": 5000.0}}
+    with pytest.raises(ValueError, match="per-class SLO target"):
+        SLOConfig.parse("interactive.nope_ms=1")
+
+    tel = ServingTelemetry()
+    now = _time.perf_counter()
+    # interactive blew its 50 ms target; batch is far inside its 5 s one
+    for rid, age, cls in ((0, 0.5, "interactive"), (1, 0.4, "interactive"),
+                          (2, 1.0, "batch")):
+        tel.request_arrival(rid, prompt_len=8, max_new_tokens=4,
+                            ts=now - age, sla_class=cls)
+        tel.request_placed(rid, slot=rid)
+        tel.note_emitted({rid: [5]})
+    mon = SLOMonitor(tel, cfg)
+    with caplog.at_level(logging.WARNING, logger="tpu-inference"):
+        rep = mon.evaluate()
+    assert not rep.healthy
+    assert any(v.startswith("interactive.ttft_p99_ms") for v in rep.violations)
+    assert not any(v.startswith("batch.") for v in rep.violations)
+    off = rep.offenders["interactive.ttft_p99_ms"]
+    assert {o["sla_class"] for o in off} == {"interactive"}
+    assert off[0]["value_ms"] >= off[-1]["value_ms"] > 300.0
+    assert rep.class_values["interactive"]["ttft_p99_ms"] > 50.0
+    line = next(r.message for r in caplog.records
+                if r.message.startswith("slo_violation "))
+    payload = _json.loads(line.split(" ", 1)[1])
+    assert "interactive.ttft_p99_ms" in payload["offenders"]
+    assert payload["class_values"]["interactive"]["ttft_p99_ms"] > 50.0
+
+
+# ------------------------------------------------------ overload fault kind
+def test_overload_fault_kind_bursts_through_admission(tiny_llama_hf_config,
+                                                      sla):
+    """The ``overload`` fault fires a seeded tenant burst THROUGH router
+    admission (class defaulting to the least-important sheddable one) plus
+    a slow-drain stall — counted in ``fired`` like every other kind."""
+    from neuronx_distributed_inference_tpu.serving.faults import FaultSpec
+
+    spec = FaultSpec.parse(
+        "overload@0:at_step=2,burst=3,burst_prompt=12,burst_new=4,"
+        "stall_ms=0")
+    assert (spec.kind, spec.replica, spec.burst, spec.burst_prompt,
+            spec.burst_new) == ("overload", "0", 3, 12, 4)
+    with pytest.raises(ValueError, match="burst"):
+        FaultSpec(kind="overload", burst=0)
+
+    app = _make_app(tiny_llama_hf_config)
+    inj = FaultInjector([spec], seed=7)
+    router = PrefixAffinityRouter(_replicas(app, 1, sla_classes=sla),
+                                  sla_classes=sla, fault_injector=inj)
+    rng = np.random.default_rng(41)
+    rid = router.submit(rng.integers(1, 256, size=(10,)).astype(np.int32),
+                        max_new_tokens=6, sla_class="interactive")
+    out = router.run_to_completion()
+    assert inj.fired.get(("overload", "0"), 0) == 1
+    assert inj.burst_submitted == 3
+    # the burst landed in the injector's default class = lowest sheddable
+    burst = [r for r in router.requests.values() if r.request_id != rid]
+    assert len(burst) == 3
+    assert {r.sla_class for r in burst} == {"batch"}
+    assert len(out[rid]) == 6                 # the real tenant still served
+    assert inj.stats()["burst_submitted"] == 3
