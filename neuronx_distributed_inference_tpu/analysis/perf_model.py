@@ -1,0 +1,287 @@
+"""Analytical roofline performance model over the audited serving dispatches.
+
+Every registered dispatch (analysis/registry.py) carries a captured example
+spec, and its compiled module carries XLA cost analysis — HBM bytes accessed,
+FLOPs — plus a collective schedule (the ICI bytes the tp overlap machinery
+already counts). That is everything a roofline needs: against a device-spec
+table (peak FLOP/s, HBM GB/s, ICI GB/s) each dispatch classifies as
+memory-/compute-/interconnect-bound and gets an EXPECTED step time
+
+    t_expected = max(bytes / BW_hbm,  flops / peak_flops,  ici_bytes / BW_ici)
+
+so a measured per-dispatch device time (PR 7 ``attribute_device_time``)
+divides into an EFFICIENCY (1.0 = running at the roofline of its bound).
+``hbm_bw_utilization`` stops being one hand-derived bench number: for a
+memory-bound dispatch the efficiency IS the bandwidth utilization, derived
+per kind from the same compiled costs the graph auditor budgets.
+
+Honesty contract: a device the spec table does not know (this CPU container,
+an unrecognized accelerator) resolves to an UNVERIFIED spec — byte/FLOP
+derivations still work (they are hardware-independent), but expected times
+and efficiencies are None and ``bound`` reads ``"unverified"``. The bench
+refuses hardware-claim keys under an unverified spec (utils/provenance.py);
+nothing in this module ever substitutes a made-up peak.
+
+Everything here is OFFLINE analysis: the model reads captured example specs
+and AOT cost analysis only — no new dispatches, no host syncs on the serving
+path (the graph auditor keeps that true: this module traces nothing).
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, replace
+from typing import Dict, Mapping, Optional
+
+logger = logging.getLogger("tpu-inference")
+
+__all__ = ["DeviceSpec", "DEVICE_SPECS", "UNVERIFIED_SPEC",
+           "resolve_device_spec", "DispatchExpectation", "classify",
+           "PerfModel", "LOW_EFFICIENCY", "BOUND_MEMORY", "BOUND_COMPUTE",
+           "BOUND_ICI", "BOUND_UNVERIFIED", "hbm_utilization"]
+
+BOUND_MEMORY = "memory"
+BOUND_COMPUTE = "compute"
+BOUND_ICI = "interconnect"
+BOUND_UNVERIFIED = "unverified"
+
+# below this measured-vs-model efficiency a dispatch is "far below its bound"
+# and the join emits one structured ``roofline_below_bound {json}`` log line
+# (the r5 hbm_bw_utilization 0.46 would NOT trip this — 0.46 of roofline is
+# normal serving; 0.1 catches a dispatch that is pathologically off, e.g. a
+# gather fallback or a host-sync stall inside the measured window)
+LOW_EFFICIENCY = 0.1
+
+
+@dataclass(frozen=True)
+class DeviceSpec:
+    """Peak capabilities of one device generation.
+
+    ``peak_flops`` is the bf16 dense peak (the serving dispatches' int8/int4
+    matmuls run at up to 2x this on the MXU — the classification is
+    conservative toward "compute-bound", which only sharpens a memory-bound
+    verdict). ``ici_bytes_per_s`` is the aggregate per-chip interconnect
+    bandwidth. ``verified=False`` marks the catch-all spec for hardware the
+    table does not know: no peaks, no expected times, no efficiency claims.
+    """
+
+    name: str                 # provenance hardware class, e.g. "tpu-v5e"
+    kind_substr: str          # matched against jax Device.device_kind
+    peak_flops: Optional[float]
+    hbm_bytes_per_s: Optional[float]
+    ici_bytes_per_s: Optional[float]
+    verified: bool = True
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "verified": self.verified,
+                "peak_flops": self.peak_flops,
+                "hbm_bytes_per_s": self.hbm_bytes_per_s,
+                "ici_bytes_per_s": self.ici_bytes_per_s}
+
+
+# ORDER MATTERS: "TPU v5" is a substring of "TPU v5 lite", so the lite entry
+# must match first (same ordering contract the old bench-local table had).
+# HBM numbers are the ones the r1-r5 utilization figures were derived
+# against; ICI aggregates are per-chip link totals (v5e 1600 Gb/s, v4
+# 2400 Gb/s, v5p 4800 Gb/s, v6e 3584 Gb/s).
+DEVICE_SPECS = (
+    DeviceSpec("tpu-v5e", "TPU v5 lite", 197e12, 819e9, 200e9),
+    DeviceSpec("tpu-v5p", "TPU v5", 459e12, 2765e9, 600e9),
+    DeviceSpec("tpu-v4", "TPU v4", 275e12, 1228e9, 300e9),
+    DeviceSpec("tpu-v6e", "TPU v6 lite", 918e12, 1640e9, 448e9),
+)
+
+UNVERIFIED_SPEC = DeviceSpec("unverified", "", None, None, None,
+                             verified=False)
+
+
+def resolve_device_spec(device=None) -> DeviceSpec:
+    """Spec for ``device`` (default: ``jax.devices()[0]``) by device_kind
+    substring. Anything the table does not know — this CPU container, a
+    future TPU generation, a GPU — resolves to an unverified spec named
+    after its platform: measured numbers on it are real, but nothing may be
+    normalized against a peak the table cannot vouch for."""
+    if device is None:
+        import jax
+
+        device = jax.devices()[0]
+    kind = getattr(device, "device_kind", "") or ""
+    for spec in DEVICE_SPECS:
+        if spec.kind_substr and spec.kind_substr in kind:
+            return spec
+    platform = getattr(device, "platform", "unknown") or "unknown"
+    return replace(UNVERIFIED_SPEC, name=f"unverified-{platform}")
+
+
+@dataclass
+class DispatchExpectation:
+    """Analytical expectation for ONE dispatch kind, normalized per inner
+    step (the registration-time ``steps_arg`` — a decode chunk of 48
+    iterations divides by 48; a while_loop megastep's cost analysis already
+    counts the body once, so steps stays 1 and per-step means per inner
+    iteration there too)."""
+
+    kind: str
+    steps: int
+    bytes_per_step: float
+    flops_per_step: float
+    ici_bytes_per_step: float
+    t_hbm_ms: Optional[float]
+    t_flops_ms: Optional[float]
+    t_ici_ms: Optional[float]
+    bound: str
+    expected_ms_per_step: Optional[float]
+
+    def to_dict(self) -> dict:
+        return {
+            "kind": self.kind, "steps": self.steps,
+            "bytes_per_step": round(self.bytes_per_step, 1),
+            "flops_per_step": round(self.flops_per_step, 1),
+            "ici_bytes_per_step": round(self.ici_bytes_per_step, 1),
+            "t_hbm_ms": self.t_hbm_ms, "t_flops_ms": self.t_flops_ms,
+            "t_ici_ms": self.t_ici_ms, "bound": self.bound,
+            "expected_ms_per_step": self.expected_ms_per_step,
+        }
+
+
+def _ms(num: float, denom: Optional[float]) -> Optional[float]:
+    if denom is None or denom <= 0:
+        return None
+    return 1e3 * num / denom
+
+
+def classify(kind: str, bytes_accessed: float, flops: float,
+             ici_bytes: float, spec: DeviceSpec,
+             steps: int = 1) -> DispatchExpectation:
+    """Roofline-classify one dispatch's compiled costs against ``spec``.
+
+    The expected time is the MAX of the three resource times — the roofline
+    lower bound on execution. On an unverified spec the byte/FLOP derivation
+    still happens (it is hardware-independent) but every time and the bound
+    verdict are refused (None / "unverified")."""
+    steps = max(1, int(steps))
+    b = bytes_accessed / steps
+    f = flops / steps
+    i = ici_bytes / steps
+    t_hbm = _ms(b, spec.hbm_bytes_per_s)
+    t_flops = _ms(f, spec.peak_flops)
+    t_ici = _ms(i, spec.ici_bytes_per_s) if i > 0 else (
+        0.0 if spec.verified else None)
+    if not spec.verified:
+        bound, expected = BOUND_UNVERIFIED, None
+    else:
+        times = {BOUND_MEMORY: t_hbm or 0.0, BOUND_COMPUTE: t_flops or 0.0,
+                 BOUND_ICI: t_ici or 0.0}
+        bound = max(times, key=times.get)
+        expected = times[bound]
+    # full precision throughout: toy-scale audits have sub-microsecond
+    # expectations and rounding here would corrupt every downstream ratio
+    return DispatchExpectation(
+        kind=kind, steps=steps, bytes_per_step=b, flops_per_step=f,
+        ici_bytes_per_step=i, t_hbm_ms=t_hbm, t_flops_ms=t_flops,
+        t_ici_ms=t_ici, bound=bound, expected_ms_per_step=expected)
+
+
+def hbm_utilization(bytes_per_step: float, step_ms: float,
+                    spec: Optional[DeviceSpec] = None) -> Optional[float]:
+    """Fraction of ``spec``'s peak HBM bandwidth a measured step achieved —
+    the bench's headline roofline number, now derived from the ONE spec
+    table. None on an unverified spec (the caller renames or refuses the
+    key; it must not divide by a peak nobody vouched for)."""
+    spec = spec if spec is not None else resolve_device_spec()
+    if spec.hbm_bytes_per_s is None or step_ms <= 0:
+        return None
+    return bytes_per_step / (step_ms * 1e-3) / spec.hbm_bytes_per_s
+
+
+class PerfModel:
+    """Per-dispatch roofline expectations over the live dispatch registry.
+
+    Expectations are cached per (kind, dispatch identity): the underlying
+    ``AuditedDispatch.example_cost()`` AOT-compiles the captured example
+    ONCE (hitting jax's persistent compile cache when enabled) — this runs
+    only from offline analysis paths (profiled-window attribution, bench,
+    scripts), never on the serving hot path."""
+
+    def __init__(self, spec: Optional[DeviceSpec] = None):
+        self.spec = spec if spec is not None else resolve_device_spec()
+        self._cache: Dict[str, tuple] = {}    # kind -> (dispatch, expectation)
+
+    def spec_dict(self) -> dict:
+        return self.spec.to_dict()
+
+    def expectation_for(self, dispatch) -> DispatchExpectation:
+        """Expectation for a registered dispatch (raises when the dispatch
+        has no captured example or cannot be AOT-compiled — callers on
+        guarded paths catch and report, never mask)."""
+        kind = dispatch.contract.kind
+        hit = self._cache.get(kind)
+        # validity = same dispatch AND same captured example: set_example()
+        # re-captures build a new example tuple (and reset the registry-side
+        # cost cache), so a stale expectation cannot outlive the example it
+        # was derived from
+        if (hit is not None and hit[0] is dispatch
+                and hit[1] is dispatch.example):
+            return hit[2]
+        cost = dispatch.example_cost()
+        exp = classify(kind, cost["bytes_accessed"], cost["flops"],
+                       cost["collective_bytes"], self.spec,
+                       steps=cost["steps"])
+        self._cache[kind] = (dispatch, dispatch.example, exp)
+        return exp
+
+    def expectation(self, kind: str) -> Optional[DispatchExpectation]:
+        """Expectation for the newest LIVE dispatch registered under
+        ``kind`` (None when no such dispatch has captured an example)."""
+        from .registry import find
+
+        d = find(kind)
+        if d is None or d.example is None:
+            return None
+        return self.expectation_for(d)
+
+    @staticmethod
+    def efficiency(expected_ms: Optional[float],
+                   measured_ms: Optional[float]) -> Optional[float]:
+        """Measured-vs-model efficiency: model expectation over measured
+        device time (1.0 = at the roofline; >1 means the model under-counts
+        — worth a look, not a victory)."""
+        if expected_ms is None or not measured_ms or measured_ms <= 0:
+            return None
+        return expected_ms / measured_ms
+
+    def join(self, timing: Mapping[str, dict],
+             iterations: Optional[Mapping[str, int]] = None,
+             dispatches: Optional[Mapping[str, object]] = None) -> dict:
+        """Join a profiled per-kind ``timing`` table (PR 7
+        ``attribute_device_time`` shape: ``{kind: {device_ms, dispatches,
+        ...}}``) with the model: per kind, the expectation, the expected
+        window time (``expected_ms_per_step x window iterations``) and the
+        efficiency. ``dispatches`` maps timing kinds to the owning runner's
+        AuditedDispatch objects (default: the global registry by kind name).
+        Per-kind failures degrade to an ``error`` entry — one bad lowering
+        must not take down the whole join."""
+        by_kind: Dict[str, dict] = {}
+        for kind, t in timing.items():
+            d = (dispatches or {}).get(kind)
+            try:
+                exp = (self.expectation_for(d) if d is not None
+                       else self.expectation(kind))
+            except Exception as e:
+                logger.warning("roofline model failed for %r: %s", kind, e)
+                by_kind[kind] = {"error": f"{type(e).__name__}: {e}"}
+                continue
+            if exp is None:
+                continue
+            entry = exp.to_dict()
+            iters = max(1, int((iterations or {}).get(
+                kind, t.get("dispatches") or 1)))
+            entry["window_iterations"] = iters
+            dev_ms = t.get("device_ms")
+            if dev_ms and exp.expected_ms_per_step is not None:
+                expected = exp.expected_ms_per_step * iters
+                entry["expected_window_ms"] = expected
+                entry["measured_window_ms"] = dev_ms
+                entry["efficiency"] = self.efficiency(expected, dev_ms)
+            by_kind[kind] = entry
+        return {"spec": self.spec_dict(), "by_kind": by_kind}

@@ -77,6 +77,7 @@ class AuditedDispatch:
         self._jit = jitted
         self.static_argnames = tuple(static_argnames)
         self.example: Optional[Tuple[tuple, dict]] = None
+        self._example_cost: Optional[Dict[str, float]] = None
         _register(self)
 
     # ---- call path -------------------------------------------------------
@@ -91,6 +92,7 @@ class AuditedDispatch:
         self.example = (jax.tree_util.tree_map(_spec_of, args),
                         {k: jax.tree_util.tree_map(_spec_of, v)
                          for k, v in kwargs.items()})
+        self._example_cost = None      # costs follow the example they came from
 
     # ---- audit surface ---------------------------------------------------
     def lower(self, *args, **kwargs):
@@ -106,6 +108,39 @@ class AuditedDispatch:
         args, kwargs = self.example
         kwargs = dict(kwargs, **overrides)
         return self._jit.lower(*args, **kwargs)
+
+    def example_cost(self) -> Dict[str, float]:
+        """Compiled-cost summary of the captured example — the roofline
+        model's input (analysis/perf_model.py): HBM bytes accessed, FLOPs,
+        collective (ICI) output bytes, and the captured ``steps_arg`` value
+        the per-step normalization divides by.
+
+        Cached after the first call: the AOT ``lower().compile()`` runs once
+        per dispatch (and hits jax's persistent compile cache when enabled).
+        This is an OFFLINE analysis hook — profiled-window attribution,
+        bench phases and scripts call it; the serving hot path never does.
+        Raises when no example was captured (run the dispatch once first)."""
+        if self._example_cost is None:
+            compiled = self.lower_example().compile()
+            cost = compiled.cost_analysis()
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0]
+            from ..parallel import overlap as overlap_lib
+
+            stats = overlap_lib.collective_stats(compiled.as_text())
+            steps = 1
+            if self.contract.steps_arg is not None:
+                v = self.static_value(self.contract.steps_arg)
+                steps = int(v) if v is not None else 1
+            # strict "bytes accessed" lookup, same rationale as the auditor:
+            # a missing key must raise, never read as a silent 0.0
+            self._example_cost = {
+                "bytes_accessed": float(cost["bytes accessed"]),
+                "flops": float(cost.get("flops", 0.0)),
+                "collective_bytes": float(stats["bytes"]),
+                "steps": max(1, steps),
+            }
+        return dict(self._example_cost)
 
     def static_value(self, name: str, default=None):
         """Captured value of a (static) argument, by name."""

@@ -147,6 +147,11 @@ class ContinuousBatchingRunner:
             telemetry = metrics_lib.ServingTelemetry()
         self.telemetry = telemetry
         reg = telemetry.registry
+        # roofline perf model (analysis/perf_model.py): built LAZILY by the
+        # first attribute_device_time() — the serving loop itself never
+        # constructs it (tests/test_perf_regression.py pins that the
+        # disabled-telemetry path leaves this None)
+        self._perf_model = None
         self._m_preempt = reg.counter(
             "serving_preemptions_total",
             "requests preempted (KV blocks exhausted; requeued for recompute)")
@@ -1659,7 +1664,86 @@ class ContinuousBatchingRunner:
                           "(the dispatch floor's host share)",
                           labels={"kind": kind}).set(gap / n)
         self.telemetry.set_device_timing(timing)
+        # measured-vs-model join (ISSUE-14): per-kind roofline efficiency
+        # from the analytical model over the same window. Guarded — a model
+        # failure (unlowerable example, missing cost key) degrades to an
+        # error entry in stats()["roofline"], never breaks the attribution.
+        iters_by_kind: Dict[str, int] = {}
+        for s in steps:
+            k = self._attr_family(s["kind"])
+            iters_by_kind[k] = (iters_by_kind.get(k, 0)
+                                + max(1, int(s.get("iterations") or 1)))
+        self.telemetry.set_roofline(
+            self._roofline_join(timing, iters_by_kind))
         return timing
+
+    def _roofline_dispatch(self, kind: str):
+        """This runner's own AuditedDispatch serving a telemetry step kind
+        (None when the kind has no single owning dispatch here). Using the
+        runner's objects — not the global registry — keeps the join honest
+        when several runners of different geometry are alive at once."""
+        if kind == "spec_chunk":
+            return (getattr(self, "_spec_step_eagle", None)
+                    if self.eagle is not None
+                    else getattr(self, "_spec_step", None))
+        # the merged "insert" timing row aggregates device events from the
+        # whole insert FAMILY (_insert/_insert_nol/_window/_seed — see
+        # DISPATCH_KIND_EVENTS), so no single dispatch's expectation can
+        # honestly divide its measured time: the family is EXCLUDED from
+        # the join rather than modeled wrong (a deflated efficiency would
+        # emit spurious roofline_below_bound warnings for healthy runners)
+        return {
+            "decode": getattr(self, "_decode_step", None),
+            "mixed": getattr(self, "_mixed_step", None),
+            "megastep": getattr(self, "_megastep_step", None),
+            "tier_readmit": getattr(self, "_tier_readmit_step", None),
+        }.get(kind)
+
+    def _roofline_join(self, timing: Dict[str, dict],
+                       iters_by_kind: Dict[str, int]) -> Dict[str, object]:
+        """Join the profiled timing table with the analytical roofline model
+        (analysis/perf_model.py): ``serving_roofline_efficiency{kind=}``
+        gauges, the stats()["roofline"] block, the provenance build_info
+        stamp, and ONE structured ``roofline_below_bound {json}`` log line
+        per kind running far below its bound."""
+        import json as _json
+
+        try:
+            from ..analysis import perf_model
+            from ..utils import provenance
+
+            if self._perf_model is None:
+                self._perf_model = perf_model.PerfModel()
+            provenance.stamp_registry(self.telemetry.registry)
+            dispatches = {k: self._roofline_dispatch(k) for k in timing}
+            roof = self._perf_model.join(
+                timing, iters_by_kind,
+                {k: d for k, d in dispatches.items() if d is not None})
+            reg = self.telemetry.registry
+            for kind, entry in roof["by_kind"].items():
+                eff = entry.get("efficiency")
+                if eff is None:
+                    continue
+                reg.gauge(
+                    "serving_roofline_efficiency",
+                    "measured-vs-roofline-model efficiency over the last "
+                    "profiled window (1.0 = at the bound)",
+                    labels={"kind": kind}).set(eff)
+                if eff < perf_model.LOW_EFFICIENCY:
+                    logger.warning("roofline_below_bound %s", _json.dumps({
+                        "kind": kind, "bound": entry.get("bound"),
+                        "efficiency": eff,
+                        "expected_window_ms": entry.get("expected_window_ms"),
+                        "measured_window_ms": entry.get("measured_window_ms"),
+                        "bytes_per_step": entry.get("bytes_per_step"),
+                    }))
+            return roof
+        except Exception as e:
+            # visible degradation: the error lands in stats()["roofline"]
+            # AND the log — the attribution result must survive regardless
+            logger.warning("roofline join failed: %s: %s",
+                           type(e).__name__, e)
+            return {"error": f"{type(e).__name__}: {e}"}
 
     def stats(self) -> Dict[str, object]:
         """Point-in-time serving snapshot: telemetry aggregates (TTFT/TPOT/

@@ -40,9 +40,11 @@ __all__ = ["FlightRecorder", "BUNDLE_SCHEMA", "load_bundle",
 BUNDLE_SCHEMA = "tpu-inference-debug-bundle/1"
 
 
-def _versions() -> Dict[str, str]:
+def _versions(mods: tuple = ("jax", "jaxlib", "numpy")) -> Dict[str, str]:
+    """Best-effort module-version table (shared probe: the provenance
+    fingerprint reuses it with its own module list — one place to fix)."""
     out = {"python": sys.version.split()[0]}
-    for mod in ("jax", "jaxlib", "numpy"):
+    for mod in mods:
         try:
             out[mod] = __import__(mod).__version__
         except Exception:
@@ -117,11 +119,26 @@ class FlightRecorder:
         ``spans``: span trees of the requests in flight at dump time
         (``serving.tracing.inflight_span_trees`` — the post-mortem shows
         WHERE each live stream was, not just that streams existed).
+
+        The bundle also carries the hardware/software provenance
+        fingerprint (utils/provenance.py) — GUARDED like the span
+        enrichment: a fingerprint failure records an error string, it
+        never masks the fault being dumped. A live ``stats()`` snapshot
+        passed via ``stats`` already embeds the last roofline join
+        (``stats()["roofline"]``), so bundles are hardware-attributable
+        end to end.
         """
+        try:
+            from . import provenance as _prov
+
+            prov = _prov.fingerprint()
+        except Exception as e:          # never mask the fault being dumped
+            prov = {"error": f"{type(e).__name__}: {e}"}
         bundle = {
             "schema": BUNDLE_SCHEMA,
             "created_unix": time.time(),
             "reason": reason,
+            "provenance": prov,
             "versions": _versions(),
             "hlo_dump": _hlo_dump_dir(),
             "config": _jsonable(config),

@@ -228,6 +228,19 @@ class MetricsRegistry:
                   labels: Optional[Dict[str, str]] = None) -> Histogram:
         return self._get(Histogram, name, help, labels, buckets=buckets)
 
+    def info(self, name: str, labels: Optional[Dict[str, str]] = None,
+             help: str = "") -> Gauge:
+        """Info-style gauge (the Prometheus ``build_info`` convention): the
+        VALUE is pinned to 1 and the payload lives in the labels — joins
+        and dashboards multiply by it to attribute series to a build/
+        hardware fingerprint (utils/provenance.stamp_registry). Get-or-
+        create like every instrument; re-calling re-pins 1 (a reset()
+        between bench windows zeroes it like any gauge, so stampers re-call
+        after reset)."""
+        g = self._get(Gauge, name, help, labels)
+        g.set(1)
+        return g
+
     def reset(self) -> None:
         """Zero every instrument IN PLACE (cached instrument references stay
         valid — bench measurement windows reset between phases)."""
@@ -344,6 +357,11 @@ class ServingTelemetry:
         # device-time attribution (runner.attribute_device_time)
         self.device_counters: Optional[Dict[str, object]] = None
         self.timing: Optional[Dict[str, dict]] = None
+        # last measured-vs-roofline-model join (analysis/perf_model.py),
+        # attached by runner.attribute_device_time alongside ``timing`` —
+        # never computed here (the model's AOT lowering must stay off every
+        # telemetry path; a plain read is all snapshot() does)
+        self.roofline: Optional[Dict[str, object]] = None
         # in-memory retention bound for long-lived serving: past
         # ``max_records`` entries per log the OLDEST quarter is dropped (and
         # counted — no silent truncation; the registry aggregates and the
@@ -681,6 +699,12 @@ class ServingTelemetry:
         attribute_device_time result) for snapshot()["timing"]."""
         self.timing = timing
 
+    def set_roofline(self, roofline: Optional[Dict[str, object]]) -> None:
+        """Record the measured-vs-roofline-model join for
+        snapshot()["roofline"] (runner.attribute_device_time attaches it
+        next to the timing table it was joined against)."""
+        self.roofline = roofline
+
     def annotate(self, kind: str):
         """jax.profiler host span for a dispatch (aligns the step timeline
         with device traces); a shared null context when disabled."""
@@ -746,6 +770,9 @@ class ServingTelemetry:
             "device": self.device_counters,
             # per-kind device-time attribution of the last profiled window
             "timing": self.timing,
+            # measured-vs-roofline-model join of the last profiled window
+            # (analysis/perf_model.py; None until an attribution ran)
+            "roofline": self.roofline,
         }
         if by_class:
             out["by_class"] = {
@@ -804,6 +831,7 @@ class ServingTelemetry:
         self.registry.reset()
         self.device_counters = None
         self.timing = None
+        self.roofline = None
         if self.flight is not None:
             self.flight.clear()
         self._t0 = time.perf_counter()

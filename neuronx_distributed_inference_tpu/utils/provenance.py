@@ -1,0 +1,167 @@
+"""Hardware/software provenance fingerprinting for perf artifacts.
+
+The repo's perf trajectory mixes TPU-driver-captured rounds (r1-r5) with
+CPU-container rounds (r6-r7), and until now only prose in the snapshot files
+told them apart. This module makes the distinction STRUCTURAL:
+
+- ``fingerprint()``: one process-cached dict — platform, device kind+count,
+  the resolved roofline device spec (analysis/perf_model.py) and whether it
+  is VERIFIED, jax/jaxlib/libtpu versions, git sha, and an anonymized host
+  class — stamped into every bench snapshot, debug bundle
+  (utils/flight_recorder.py) and, via ``stamp_registry``, a Prometheus
+  ``build_info``-style metric.
+- ``key``: the provenance GROUP a snapshot belongs to ("tpu-v5e",
+  "cpu-container", ...). scripts/perf_trajectory.py groups the committed
+  snapshots by it, so cross-hardware numbers are never compared as one
+  series.
+- the HARDWARE-CLAIM refusal: keys that normalize a measurement against a
+  hardware peak (``hbm_bw_utilization``, ``prefill_mfu_bf16``) may only be
+  published under a verified spec. ``claim_key``/``apply_to_extra`` rename
+  them ``*_unverified`` otherwise — the r5 honesty pattern (refuse the
+  number's NAME, keep the measurement visible), made structural so a
+  CPU-container run can never masquerade as the TPU trajectory again.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import socket
+import subprocess
+from typing import Dict, Optional
+
+logger = logging.getLogger("tpu-inference")
+
+__all__ = ["SCHEMA", "HARDWARE_CLAIM_KEYS", "fingerprint", "claim_key",
+           "apply_to_extra", "flat_labels", "stamp_registry"]
+
+SCHEMA = "tpu-inference-provenance/1"
+
+# bench ``extra`` keys that CLAIM a hardware-normalized efficiency: each
+# divides a measurement by a device peak, so under an unverified spec the
+# denominator is a guess and the NAME must say so. Absolute tok/s keys stay
+# un-renamed (they are honest measurements of this box); the refusal for
+# cross-hardware headline comparisons is the ``tpu_baseline_comparable``
+# flag apply_to_extra stamps (top-level ``vs_baseline`` is driver-parsed
+# schema and cannot be renamed without breaking the harness contract).
+HARDWARE_CLAIM_KEYS = ("hbm_bw_utilization", "prefill_mfu_bf16")
+
+_FP: Optional[dict] = None
+
+
+def _git_sha() -> Optional[str]:
+    try:
+        repo = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        out = subprocess.run(
+            ["git", "rev-parse", "--short=12", "HEAD"], cwd=repo,
+            capture_output=True, text=True, timeout=10, check=False)
+        sha = out.stdout.strip()
+        return sha or None
+    except Exception:
+        return None
+
+
+def _versions() -> Dict[str, str]:
+    from .flight_recorder import _versions as _probe
+
+    out = _probe(("jax", "jaxlib"))
+    try:
+        import importlib.metadata as _md
+
+        out["libtpu"] = _md.version("libtpu")
+    except Exception:
+        out["libtpu"] = "absent"
+    return out
+
+
+def fingerprint(refresh: bool = False) -> dict:
+    """The process's hardware/software fingerprint (cached after the first
+    call — the git subprocess and device probe run once, never per scrape
+    or per step). ``refresh=True`` re-probes (tests)."""
+    global _FP
+    if _FP is not None and not refresh:
+        return dict(_FP)
+    import jax
+
+    from ..analysis import perf_model
+
+    dev = jax.devices()[0]
+    spec = perf_model.resolve_device_spec(dev)
+    platform = getattr(dev, "platform", "unknown") or "unknown"
+    _FP = {
+        "schema": SCHEMA,
+        # the provenance GROUP: hardware class for verified specs, the
+        # "<platform>-container" catch-all otherwise — what the trajectory
+        # checker separates series by
+        "key": spec.name if spec.verified else f"{platform}-container",
+        "verified": spec.verified,
+        "capture": "local",
+        "platform": platform,
+        "device_kind": getattr(dev, "device_kind", "") or "",
+        "device_count": jax.device_count(),
+        "device_spec": spec.name,
+        "versions": _versions(),
+        "git_sha": _git_sha(),
+        # anonymized host CLASS (a short hostname digest): distinguishes
+        # boxes within one provenance group (r06's container was ~6x slower
+        # than r07's) without recording the hostname itself
+        "host_class": hashlib.sha256(
+            socket.gethostname().encode()).hexdigest()[:8],
+    }
+    return dict(_FP)
+
+
+def claim_key(name: str, fp: Optional[dict] = None) -> str:
+    """The name a hardware-claim bench key must publish under: unchanged on
+    a verified spec, ``<name>_unverified`` otherwise. Write sites use this
+    so the refusal is structural — the verified name cannot be produced on
+    unverified hardware at all."""
+    fp = fp if fp is not None else fingerprint()
+    return name if fp.get("verified") else f"{name}_unverified"
+
+
+def apply_to_extra(extra: dict, fp: Optional[dict] = None) -> dict:
+    """Safety net over a bench ``extra`` dict (idempotent; mutates AND
+    returns it): stamp the provenance block, rename any hardware-claim key
+    that slipped in under its verified name, and on unverified specs flag
+    that absolute tok/s and ``vs_baseline`` are not comparable to the
+    TPU-measured baseline trajectory."""
+    fp = fp if fp is not None else fingerprint()
+    extra["provenance"] = fp
+    if fp.get("verified"):
+        return extra
+    for name in HARDWARE_CLAIM_KEYS:
+        if name in extra:
+            extra[f"{name}_unverified"] = extra.pop(name)
+    extra["tpu_baseline_comparable"] = False
+    return extra
+
+
+def flat_labels(fp: Optional[dict] = None) -> Dict[str, str]:
+    """Flat string labels for the ``build_info``-style metric (nested
+    version dicts flattened; every value stringified for exposition)."""
+    fp = fp if fp is not None else fingerprint()
+    v = fp.get("versions", {})
+    return {
+        "key": str(fp.get("key")),
+        "verified": "1" if fp.get("verified") else "0",
+        "platform": str(fp.get("platform")),
+        "device_kind": str(fp.get("device_kind")),
+        "device_count": str(fp.get("device_count")),
+        "jax": str(v.get("jax")),
+        "git_sha": str(fp.get("git_sha")),
+        "host_class": str(fp.get("host_class")),
+    }
+
+
+def stamp_registry(registry, fp: Optional[dict] = None):
+    """Register the ``serving_build_info`` info-style gauge (value pinned to
+    1; the payload is the labels — the Prometheus ``build_info``
+    convention) on ``registry``. Safe to call repeatedly (get-or-create)."""
+    return registry.info(
+        "serving_build_info",
+        labels=flat_labels(fp),
+        help="hardware/software provenance of this serving process "
+             "(info-style: value pinned to 1, payload in the labels)")

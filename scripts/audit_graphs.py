@@ -89,6 +89,13 @@ _FILE_SCOPES = {
     # re-audits the full CB fleet (cb_mixed included) on any edit.
     "serving/sla.py": [],
     "serving/autoscaler.py": [],
+    # ISSUE-14 roofline model + provenance: offline analysis over the
+    # ALREADY-captured dispatch examples and compiled cost analysis (the
+    # model lowers AOT, it never traces a new dispatch), and the provenance
+    # fingerprint is pure host-side probing — lint-only. Any OTHER new
+    # analysis/ or utils/ file stays unmapped and fails closed.
+    "analysis/perf_model.py": [],
+    "utils/provenance.py": [],
     "serving/kv_tiering.py": ["serving_tier", "cb_paged", "cb_mixed",
                               "cb_megastep", "cb_spec", "cb_eagle"],
 }

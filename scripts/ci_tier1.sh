@@ -89,4 +89,18 @@ if [ "${MULTITENANT_TIER1_TESTS:-0}" -lt 1 ]; then
     echo "ERROR: multi-tenant overload tests are not in the tier-1 marker set" >&2
     [ "$rc" -eq 0 ] && rc=1
 fi
+
+# ISSUE-14 unchanged-semantics guard: the roofline perf-model suite (model
+# vs hand-computed costs, bound classification, unverified-spec refusal,
+# trajectory grouping/regression gate) must stay collected inside the
+# tier-1 marker set.
+PERF_MODEL_TIER1_TESTS=$(env JAX_PLATFORMS=cpu python -m pytest \
+    "$REPO/tests/test_perf_model.py" "$REPO/tests/test_perf_trajectory.py" \
+    -q -m 'not slow' --collect-only -p no:cacheprovider 2>/dev/null \
+    | grep -ac '::' || true)
+echo "PERF_MODEL_TIER1_TESTS=$PERF_MODEL_TIER1_TESTS"
+if [ "${PERF_MODEL_TIER1_TESTS:-0}" -lt 1 ]; then
+    echo "ERROR: roofline perf-model tests are not in the tier-1 marker set" >&2
+    [ "$rc" -eq 0 ] && rc=1
+fi
 exit "$rc"

@@ -1,0 +1,324 @@
+#!/usr/bin/env python
+"""Perf-trajectory renderer + regression checker over the committed snapshots.
+
+Parses every ``BENCH_r*.json`` / ``MULTICHIP_r*.json``, groups them by their
+structured provenance fingerprint (ISSUE-14: r1-r5 are TPU-v5e
+driver-captured, r6-r7 are CPU-container runs — they must NEVER be read as
+one series), renders the per-key trajectory of each group, and in ``--ci``
+mode exits non-zero when a tracked key regresses vs the last same-provenance
+snapshot beyond its pinned tolerance.
+
+What is gated where (the honesty model):
+
+- ANALYTIC keys (``streamed_bytes_per_step_gb``, ``ici_bytes_per_step``)
+  derive from the byte model / compiled schedule, not wall clocks — gated
+  TIGHTLY in every provenance group (these are the ROADMAP item-4
+  "roofline-style bytes-per-step canaries": a CPU run that silently grows
+  the byte model fails here even though its tok/s mean nothing).
+- RATIO keys (``paged_vs_dense``, ``megastep_speedup_vs_stepwise``, ...)
+  are box-relative — gated loosely in every group.
+- ABSOLUTE keys (tok/s, ms) are hardware measurements — gated only inside
+  VERIFIED provenance groups. CPU containers differ ~6x box to box (r06 vs
+  r07); gating their absolutes would be noise, publishing them as the
+  trajectory would be the exact masquerade this tool exists to prevent.
+
+Usage:
+    python scripts/perf_trajectory.py              # render the trajectory
+    python scripts/perf_trajectory.py --ci         # regression gate
+    python scripts/perf_trajectory.py --dir PATH --json report.json
+
+Exit codes: 0 clean; 1 tracked regression (--ci); 2 malformed snapshot.
+"""
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_SNAP_RE = re.compile(r"(BENCH|MULTICHIP)_r(\d+)\.json$")
+
+# ---------------------------------------------------------------- gate rules
+# key -> relative tolerance. Direction + provenance requirement per class.
+ANALYTIC_LOWER_BETTER = {          # gated in EVERY provenance group
+    "streamed_bytes_per_step_gb": 0.05,
+    "ici_bytes_per_step": 0.05,
+    "ici_bytes_per_step_est": 0.05,
+}
+RATIO_HIGHER_BETTER = {            # box-relative ratios: every group, loose
+    "paged_vs_dense": 0.15,
+    "paged_vs_headline": 0.25,
+    "megastep_speedup_vs_stepwise": 0.40,
+    "tp_scaling_efficiency": 0.25,
+    "prefill_interference_ratio": 0.25,
+    "goodput_under_overload_ratio": 0.30,
+    "goodput_under_faults_ratio": 0.30,
+    "paged_spec_selfdraft_vs_own_ceiling": 0.20,
+    "prefix_affinity_hit_ratio": 0.25,
+    "ok": 0.0,                     # multichip dryrun verdict must stay 1
+}
+RATIO_LOWER_BETTER = {
+    "telemetry_overhead_ratio": 0.50,
+}
+ABS_HIGHER_BETTER = {              # hardware measurements: VERIFIED groups only
+    "value": 0.15,
+    "sync_tok_per_s": 0.15,
+    "async_tok_per_s": 0.15,
+    "dense_bs64_sync_tok_per_s": 0.15,
+    "dense_bs64_async_tok_per_s": 0.15,
+    "paged_serving_tok_per_s": 0.15,
+    "paged_sync_tok_per_s": 0.15,
+    "paged_async_tok_per_s": 0.15,
+    "bs1_decode_tok_per_s": 0.20,
+    "bs1_stepwise_tok_per_s": 0.20,
+    "arrival_paged_serving_tok_per_s": 0.20,
+    "router_tok_per_s": 0.20,
+}
+ABS_LOWER_BETTER = {
+    "p50_decode_step_ms": 0.25,
+    "decode_step_device_ms": 0.25,
+    "ttft_p50_ms": 0.25,
+    "ttft_device_ms": 0.25,
+    "dispatch_floor_ms": 0.25,
+    "dispatch_gap_ms": 0.40,
+}
+
+
+@dataclass
+class Snapshot:
+    path: str
+    family: str                    # "bench" | "multichip"
+    round: int
+    key: str                       # provenance group key
+    verified: bool
+    metrics: Dict[str, float] = field(default_factory=dict)
+    invalid_markers: Dict[str, str] = field(default_factory=dict)
+    notes: List[str] = field(default_factory=list)
+
+
+class SnapshotError(Exception):
+    pass
+
+
+def _last_json_line(tail: str) -> Optional[dict]:
+    parsed = None
+    for line in tail.splitlines():
+        line = line.strip()
+        if line.startswith("{") and line.endswith("}"):
+            try:
+                parsed = json.loads(line)
+            except ValueError:
+                continue
+    return parsed
+
+
+def _fold_numeric(metrics: Dict[str, float], markers: Dict[str, str],
+                  d: dict) -> None:
+    for k, v in d.items():
+        if k == "provenance" or isinstance(v, dict):
+            continue
+        if isinstance(v, str):
+            if k.endswith("_invalid"):
+                markers[k] = v
+            continue
+        if isinstance(v, bool):
+            metrics[k] = 1.0 if v else 0.0
+        elif isinstance(v, (int, float)):
+            metrics[k] = float(v)
+
+
+def load_snapshot(path: str) -> Snapshot:
+    m = _SNAP_RE.search(os.path.basename(path))
+    if not m:
+        raise SnapshotError(f"{path}: not a BENCH_r*/MULTICHIP_r* snapshot")
+    family, rnd = m.group(1).lower(), int(m.group(2))
+    try:
+        with open(path) as fh:
+            data = json.load(fh)
+    except (OSError, ValueError) as e:
+        raise SnapshotError(f"{path}: unreadable snapshot ({e})")
+    if not isinstance(data, dict):
+        raise SnapshotError(f"{path}: snapshot is not a JSON object")
+
+    metrics: Dict[str, float] = {}
+    markers: Dict[str, str] = {}
+    notes: List[str] = []
+    parsed = None
+    if family == "bench":
+        parsed = data.get("parsed") or _last_json_line(data.get("tail", ""))
+        if parsed:
+            _fold_numeric(metrics, markers,
+                          {k: v for k, v in parsed.items() if k != "extra"})
+            _fold_numeric(metrics, markers, parsed.get("extra") or {})
+        else:
+            notes.append("no parseable headline line (timed-out round?)")
+    else:
+        metrics["ok"] = 1.0 if data.get("ok") else 0.0
+        for line in data.get("tail", "").splitlines():
+            if line.startswith("MULTICHIP_PERF "):
+                try:
+                    _fold_numeric(metrics, markers,
+                                  json.loads(line[len("MULTICHIP_PERF "):]))
+                except ValueError:
+                    notes.append("unparseable MULTICHIP_PERF line")
+
+    prov = data.get("provenance")
+    if prov is None and parsed:
+        prov = (parsed.get("extra") or {}).get("provenance")
+    if not isinstance(prov, dict) or not prov.get("key"):
+        # fail OPEN into a quarantine group, visibly: an unstamped snapshot
+        # is never compared against either real series
+        notes.append("no structured provenance block — grouped as 'unknown' "
+                     "(backfill it or re-run bench on a stamped tree)")
+        prov = {"key": "unknown", "verified": False}
+    return Snapshot(path=path, family=family, round=rnd,
+                    key=str(prov["key"]), verified=bool(prov.get("verified")),
+                    metrics=metrics, invalid_markers=markers, notes=notes)
+
+
+def load_all(root: str) -> List[Snapshot]:
+    paths = sorted(glob.glob(os.path.join(root, "BENCH_r*.json"))
+                   + glob.glob(os.path.join(root, "MULTICHIP_r*.json")))
+    if not paths:
+        raise SnapshotError(f"no BENCH_r*/MULTICHIP_r* snapshots under {root}")
+    return [load_snapshot(p) for p in paths]
+
+
+def group_snapshots(snaps: List[Snapshot]
+                    ) -> Dict[Tuple[str, str], List[Snapshot]]:
+    groups: Dict[Tuple[str, str], List[Snapshot]] = {}
+    for s in snaps:
+        groups.setdefault((s.family, s.key), []).append(s)
+    for series in groups.values():
+        series.sort(key=lambda s: s.round)
+    return groups
+
+
+def _rule_for(key: str, verified: bool):
+    """(direction, tolerance) when ``key`` is gated for this provenance,
+    else None. direction: +1 higher-better, -1 lower-better."""
+    for table, direction in ((ANALYTIC_LOWER_BETTER, -1),
+                             (RATIO_HIGHER_BETTER, +1),
+                             (RATIO_LOWER_BETTER, -1)):
+        if key in table:
+            return direction, table[key]
+    if verified:
+        if key in ABS_HIGHER_BETTER:
+            return +1, ABS_HIGHER_BETTER[key]
+        if key in ABS_LOWER_BETTER:
+            return -1, ABS_LOWER_BETTER[key]
+    return None
+
+
+def check_regressions(series: List[Snapshot]) -> List[dict]:
+    """Tracked-key regressions across CONSECUTIVE metric-bearing snapshots
+    of one provenance group (a key absent on either side is skipped — new
+    keys cannot regress, honestly-refused keys do not false-fail)."""
+    out: List[dict] = []
+    withm = [s for s in series if s.metrics]
+    for prev, cur in zip(withm, withm[1:]):
+        for key, new in sorted(cur.metrics.items()):
+            if key not in prev.metrics:
+                continue
+            rule = _rule_for(key, cur.verified and prev.verified)
+            if rule is None:
+                continue
+            direction, tol = rule
+            old = prev.metrics[key]
+            bad = (new < old * (1 - tol) if direction > 0
+                   else new > old * (1 + tol))
+            if bad:
+                out.append({
+                    "key": key, "group": cur.key, "family": cur.family,
+                    "rounds": [prev.round, cur.round],
+                    "previous": old, "current": new,
+                    "tolerance": tol,
+                    "direction": "higher-better" if direction > 0
+                    else "lower-better",
+                })
+    return out
+
+
+def render(groups: Dict[Tuple[str, str], List[Snapshot]]) -> str:
+    lines: List[str] = []
+    for (family, key), series in sorted(groups.items()):
+        rounds = [s.round for s in series]
+        verified = all(s.verified for s in series)
+        lines.append(f"== {family} :: {key} "
+                     f"({'verified' if verified else 'unverified'}) — "
+                     f"rounds {rounds}")
+        keys = sorted({k for s in series for k in s.metrics})
+        for k in keys:
+            vals = " ".join(
+                f"{s.metrics[k]:>10.4g}" if k in s.metrics else f"{'—':>10}"
+                for s in series)
+            gated = _rule_for(k, verified)
+            tag = (" [gated]" if gated else "")
+            lines.append(f"  {k:<42}{vals}{tag}")
+        for s in series:
+            for k, msg in sorted(s.invalid_markers.items()):
+                lines.append(f"  note r{s.round:02d}: {k}: {msg}")
+            for n in s.notes:
+                lines.append(f"  note r{s.round:02d}: {n}")
+        lines.append("")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--dir", default=REPO,
+                    help="directory holding the snapshots (default: repo)")
+    ap.add_argument("--ci", action="store_true",
+                    help="exit 1 when a tracked key regresses vs the last "
+                         "same-provenance snapshot beyond its tolerance")
+    ap.add_argument("--json", default=None,
+                    help="also write the grouped report as JSON")
+    args = ap.parse_args(argv)
+
+    try:
+        snaps = load_all(args.dir)
+    except SnapshotError as e:
+        print(f"ERROR: {e}", file=sys.stderr)
+        return 2
+    groups = group_snapshots(snaps)
+    print(render(groups))
+
+    regressions: List[dict] = []
+    for series in groups.values():
+        regressions += check_regressions(series)
+    for r in regressions:
+        print(f"REGRESSION [{r['family']} :: {r['group']}] {r['key']}: "
+              f"r{r['rounds'][0]:02d} {r['previous']:g} -> "
+              f"r{r['rounds'][1]:02d} {r['current']:g} "
+              f"({r['direction']}, tol {r['tolerance']:.0%})")
+
+    if args.json:
+        report = {
+            "groups": {
+                f"{family}::{key}": [
+                    {"round": s.round, "path": os.path.basename(s.path),
+                     "verified": s.verified, "metrics": s.metrics,
+                     "invalid_markers": s.invalid_markers, "notes": s.notes}
+                    for s in series]
+                for (family, key), series in sorted(groups.items())},
+            "regressions": regressions,
+        }
+        with open(args.json, "w") as fh:
+            json.dump(report, fh, indent=2)
+        print(f"report written to {args.json}")
+
+    if regressions:
+        print(f"TRAJECTORY {'FAILED' if args.ci else 'REGRESSED'} "
+              f"({len(regressions)} tracked regression(s))")
+        return 1 if args.ci else 0
+    print("TRAJECTORY OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

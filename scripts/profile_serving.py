@@ -193,6 +193,11 @@ def main(argv=None):
         "plane": args.plane,
         "logdir": args.logdir,
         "timing": timing,
+        # ISSUE-14 measured-vs-model join: the analytical roofline
+        # expectation + efficiency per profiled kind (None device rows
+        # leave the expectation without an efficiency; an unverified spec
+        # reports bound "unverified" and no expected times)
+        "roofline": s.get("roofline"),
         "device_counters": s.get("device"),
         "stats_lite": {
             "tokens_emitted": s["tokens_emitted"],

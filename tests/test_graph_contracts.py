@@ -386,6 +386,12 @@ def test_changed_mode_scope_map_fails_closed():
     # continuous_batching.py, whose map re-audits the full CB fleet
     assert mod._scopes_for_changes([pkg + "serving/sla.py"]) == []
     assert mod._scopes_for_changes([pkg + "serving/autoscaler.py"]) == []
+    # ISSUE-14: the roofline model reads captured examples + AOT cost
+    # analysis and provenance probes the host — neither enters a graph
+    # (lint-only); any OTHER new analysis/ module still fails closed
+    assert mod._scopes_for_changes([pkg + "analysis/perf_model.py"]) == []
+    assert mod._scopes_for_changes([pkg + "utils/provenance.py"]) == []
+    assert mod._scopes_for_changes([pkg + "analysis/perf_model2.py"]) is None
     assert set(mod._scopes_for_changes([pkg + "serving/kv_tiering.py"])) == {
         "serving_tier", "cb_paged", "cb_mixed", "cb_megastep", "cb_spec",
         "cb_eagle"}

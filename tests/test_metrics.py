@@ -130,6 +130,41 @@ def test_prometheus_text_exposition_valid():
     assert "lat_seconds_count 3" in text
 
 
+def test_info_gauge_exposition_format():
+    """ISSUE-14 info-style gauge (registry.info): value pinned to 1 with
+    the payload in the labels — the Prometheus build_info convention the
+    provenance stamp uses. Same validity bar as the exposition test above:
+    the info series must parse as a plain gauge for any scraper."""
+    reg = MetricsRegistry()
+    g = reg.info("serving_build_info",
+                 labels={"key": "cpu-container", "verified": "0",
+                         "git_sha": "abc123"},
+                 help="provenance fingerprint")
+    assert g.value == 1.0 and g.updated
+    text = reg.prometheus_text()
+    assert "# TYPE serving_build_info gauge" in text
+    line = [ln for ln in text.splitlines()
+            if ln.startswith("serving_build_info{")]
+    assert len(line) == 1
+    assert line[0].endswith(" 1.0")
+    for frag in ('key="cpu-container"', 'verified="0"', 'git_sha="abc123"'):
+        assert frag in line[0]
+    series = re.compile(
+        r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[a-zA-Z_]+="[^"]*"'
+        r'(,[a-zA-Z_+]+="[^"]*")*\})? -?[0-9.+eEinf]+$')
+    assert series.match(line[0]), line[0]
+    # re-calling is get-or-create (no duplicate series) and re-pins 1
+    # even after a reset() zeroed it
+    reg.reset()
+    assert g.value == 0.0
+    g2 = reg.info("serving_build_info",
+                  labels={"key": "cpu-container", "verified": "0",
+                          "git_sha": "abc123"})
+    assert g2 is g and g.value == 1.0
+    # a disabled registry hands out the shared null instrument
+    assert MetricsRegistry(enabled=False).info("x").value == 0
+
+
 # ------------------------------------------------------------------ telemetry
 def _drive_fake_requests(tel):
     """Two requests through the lifecycle with controlled commits."""

@@ -373,3 +373,41 @@ def test_enabled_telemetry_with_carry_drain_stays_microseconds_per_step():
         f"{per_step_delta * 1e6:.0f} µs per step on a µs-scale stand-in "
         f"(bare {t_bare * 1e3:.2f} ms, instrumented {t_inst * 1e3:.2f} ms "
         f"for {n} steps)")
+
+
+def test_roofline_plumbing_adds_no_overhead_off_the_profiled_path():
+    """The ISSUE-14 canary beside the three above: the roofline
+    measured-vs-model join runs ONLY inside attribute_device_time (an
+    explicit profiling window). Off that path the plumbing is one None
+    attribute on the telemetry (read by snapshot()) — no model build, no
+    AOT lowering, no provenance probe (whose git subprocess would be
+    milliseconds), pinned as an absolute per-call ceiling on the
+    snapshot-side read plus the structural no-state checks
+    (tests/test_perf_model.py pins the runner-level half: serving steps
+    with telemetry disabled leave runner._perf_model None)."""
+    import sys
+    import time
+
+    from neuronx_distributed_inference_tpu.utils.metrics import (
+        ServingTelemetry)
+
+    tel = ServingTelemetry(enabled=False)
+    assert tel.roofline is None
+    # the off-path read: snapshot()["roofline"] must be a plain attribute
+    # carry-through (no computation, no model import side effects)
+    n = 500
+    tel.snapshot()                                   # warm
+    best = min(_timed(lambda: [tel.snapshot() for _ in range(n)])
+               for _ in range(3))
+    per_call = best / n
+    assert per_call < 2e-3, (
+        f"disabled-telemetry snapshot() costs {per_call * 1e6:.0f} µs/call "
+        f"— roofline/provenance work leaked onto the read path")
+    # structural: nothing on this path imported/probed provenance state
+    # (fingerprint caching is module-level; a probe would have populated it)
+    prov_mod = sys.modules.get(
+        "neuronx_distributed_inference_tpu.utils.provenance")
+    if prov_mod is not None:
+        t0 = time.perf_counter()
+        prov_mod.fingerprint()                        # cached after first use
+        assert time.perf_counter() - t0 < 0.5

@@ -1,0 +1,205 @@
+"""scripts/perf_trajectory.py: provenance-grouped trajectory + regression
+gate (ISSUE-14), over synthetic snapshot fixtures AND the committed tree.
+
+The checker's whole job is to keep CPU-container numbers from masquerading
+as the TPU trajectory: mixed-provenance snapshots must land in distinct
+groups, absolute keys must only gate inside verified groups, analytic
+bytes-per-step canaries must gate everywhere, and a malformed snapshot must
+be a loud error, never a silently skipped file."""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _mod():
+    spec = importlib.util.spec_from_file_location(
+        "perf_trajectory", os.path.join(REPO, "scripts",
+                                        "perf_trajectory.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+TPU_PROV = {"schema": "tpu-inference-provenance/1", "key": "tpu-v5e",
+            "verified": True, "capture": "driver-captured"}
+CPU_PROV = {"schema": "tpu-inference-provenance/1", "key": "cpu-container",
+            "verified": False, "capture": "local"}
+
+
+def _bench_snap(path, n, prov, extra, value=1000.0):
+    line = {"metric": "m", "value": value, "unit": "tokens/s",
+            "vs_baseline": value / 2000.0, "extra": extra}
+    with open(path, "w") as fh:
+        json.dump({"n": n, "cmd": "bench", "rc": 0, "provenance": prov,
+                   "tail": json.dumps(line) + "\n", "parsed": line}, fh)
+
+
+def _write_series(d, rounds):
+    """rounds: [(n, prov, value, extra)] -> BENCH_rNN.json files."""
+    for n, prov, value, extra in rounds:
+        _bench_snap(str(d / f"BENCH_r{n:02d}.json"), n, prov, extra, value)
+
+
+# ------------------------------------------------------------------ grouping
+def test_mixed_provenance_snapshots_group_separately(tmp_path):
+    mod = _mod()
+    _write_series(tmp_path, [
+        (1, TPU_PROV, 1000.0, {"streamed_bytes_per_step_gb": 8.0}),
+        (2, TPU_PROV, 1200.0, {"streamed_bytes_per_step_gb": 8.0}),
+        (3, CPU_PROV, 2.5, {"streamed_bytes_per_step_gb": 8.0}),
+    ])
+    groups = mod.group_snapshots(mod.load_all(str(tmp_path)))
+    assert set(groups) == {("bench", "tpu-v5e"), ("bench", "cpu-container")}
+    assert [s.round for s in groups[("bench", "tpu-v5e")]] == [1, 2]
+    assert [s.round for s in groups[("bench", "cpu-container")]] == [3]
+    # the real committed tree groups the same way (acceptance bar): r1-r5
+    # TPU vs r6-r7 CPU, both bench and multichip families
+    real = mod.group_snapshots(mod.load_all(REPO))
+    assert [s.round for s in real[("bench", "tpu-v5e")]] == [1, 2, 3, 4, 5]
+    assert [s.round for s in real[("bench", "cpu-container")]] == [6, 7]
+    assert ("multichip", "tpu-v5e") in real
+    assert ("multichip", "cpu-container") in real
+
+
+def test_unstamped_snapshot_quarantines_as_unknown(tmp_path):
+    mod = _mod()
+    line = {"metric": "m", "value": 5.0, "unit": "tokens/s", "extra": {}}
+    with open(tmp_path / "BENCH_r01.json", "w") as fh:
+        json.dump({"n": 1, "rc": 0, "tail": json.dumps(line),
+                   "parsed": line}, fh)
+    s = mod.load_snapshot(str(tmp_path / "BENCH_r01.json"))
+    assert s.key == "unknown" and not s.verified
+    assert any("provenance" in n for n in s.notes)
+
+
+# ------------------------------------------------------------- regression gate
+def test_absolute_regression_gates_only_verified_groups(tmp_path):
+    mod = _mod()
+    # a 10x tok/s collapse: fails in the TPU group...
+    _write_series(tmp_path, [(1, TPU_PROV, 5000.0, {}),
+                             (2, TPU_PROV, 500.0, {})])
+    groups = mod.group_snapshots(mod.load_all(str(tmp_path)))
+    regs = mod.check_regressions(groups[("bench", "tpu-v5e")])
+    assert any(r["key"] == "value" for r in regs)
+    # ...but NOT in a cpu-container group (different boxes differ ~6x;
+    # absolute numbers there are not the trajectory)
+    for f in tmp_path.glob("*.json"):
+        f.unlink()
+    _write_series(tmp_path, [(6, CPU_PROV, 5000.0, {}),
+                             (7, CPU_PROV, 500.0, {})])
+    groups = mod.group_snapshots(mod.load_all(str(tmp_path)))
+    assert mod.check_regressions(groups[("bench", "cpu-container")]) == []
+
+
+def test_analytic_bytes_canary_gates_every_provenance(tmp_path):
+    """The ROADMAP item-4 bytes-per-step canary: a byte-model increase past
+    5% fails even on the CPU container; a decrease (an optimization) and
+    within-tolerance noise pass."""
+    mod = _mod()
+    _write_series(tmp_path, [
+        (6, CPU_PROV, 10.0, {"streamed_bytes_per_step_gb": 2.52}),
+        (7, CPU_PROV, 10.0, {"streamed_bytes_per_step_gb": 3.10}),
+    ])
+    groups = mod.group_snapshots(mod.load_all(str(tmp_path)))
+    regs = mod.check_regressions(groups[("bench", "cpu-container")])
+    assert [r["key"] for r in regs] == ["streamed_bytes_per_step_gb"]
+    assert regs[0]["rounds"] == [6, 7]
+    # decrease passes (r4->r5 int4 halved the stream on the real tree)
+    for f in tmp_path.glob("*.json"):
+        f.unlink()
+    _write_series(tmp_path, [
+        (6, CPU_PROV, 10.0, {"streamed_bytes_per_step_gb": 8.31}),
+        (7, CPU_PROV, 10.0, {"streamed_bytes_per_step_gb": 5.76}),
+    ])
+    groups = mod.group_snapshots(mod.load_all(str(tmp_path)))
+    assert mod.check_regressions(groups[("bench", "cpu-container")]) == []
+
+
+def test_ratio_tolerance_and_missing_keys(tmp_path):
+    mod = _mod()
+    _write_series(tmp_path, [
+        # paged_vs_dense 0.70 -> 0.62: -11% < 15% tolerance, passes; the
+        # megastep ratio only exists in r7 (new key — cannot regress)
+        (6, CPU_PROV, 10.0, {"paged_vs_dense": 0.70}),
+        (7, CPU_PROV, 10.0, {"paged_vs_dense": 0.62,
+                             "megastep_speedup_vs_stepwise": 7.2}),
+    ])
+    groups = mod.group_snapshots(mod.load_all(str(tmp_path)))
+    assert mod.check_regressions(groups[("bench", "cpu-container")]) == []
+    # past tolerance it fails in ANY provenance group
+    for f in tmp_path.glob("*.json"):
+        f.unlink()
+    _write_series(tmp_path, [
+        (6, CPU_PROV, 10.0, {"paged_vs_dense": 0.70}),
+        (7, CPU_PROV, 10.0, {"paged_vs_dense": 0.40}),
+    ])
+    groups = mod.group_snapshots(mod.load_all(str(tmp_path)))
+    regs = mod.check_regressions(groups[("bench", "cpu-container")])
+    assert [r["key"] for r in regs] == ["paged_vs_dense"]
+
+
+# ------------------------------------------------------------ CLI / exit codes
+def _run_cli(args):
+    return subprocess.run([sys.executable,
+                           os.path.join(REPO, "scripts",
+                                        "perf_trajectory.py")] + args,
+                          capture_output=True, text=True)
+
+
+def test_ci_exit_codes(tmp_path):
+    # clean series -> 0
+    _write_series(tmp_path, [(1, TPU_PROV, 1000.0, {}),
+                             (2, TPU_PROV, 1100.0, {})])
+    r = _run_cli(["--dir", str(tmp_path), "--ci"])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "TRAJECTORY OK" in r.stdout
+    # regressed series -> 1 under --ci, 0 (reported) without
+    _write_series(tmp_path, [(3, TPU_PROV, 100.0, {})])
+    r = _run_cli(["--dir", str(tmp_path), "--ci"])
+    assert r.returncode == 1
+    assert "REGRESSION" in r.stdout
+    assert _run_cli(["--dir", str(tmp_path)]).returncode == 0
+
+
+def test_malformed_snapshot_errors(tmp_path):
+    with open(tmp_path / "BENCH_r01.json", "w") as fh:
+        fh.write('{"n": 1, "tail": TRUNCATED')
+    r = _run_cli(["--dir", str(tmp_path), "--ci"])
+    assert r.returncode == 2
+    assert "ERROR" in r.stderr
+    # an empty directory is an error too (a gate over nothing is vacuous)
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert _run_cli(["--dir", str(empty), "--ci"]).returncode == 2
+
+
+def test_ci_passes_on_the_committed_tree_and_writes_json(tmp_path):
+    """The acceptance bar: the committed r1-r7 snapshots run clean, report
+    the two provenance series, and --ci exits 0."""
+    out = str(tmp_path / "report.json")
+    r = _run_cli(["--ci", "--json", out])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "bench :: tpu-v5e (verified)" in r.stdout
+    assert "bench :: cpu-container (unverified)" in r.stdout
+    rep = json.load(open(out))
+    assert rep["regressions"] == []
+    assert "bench::tpu-v5e" in rep["groups"]
+    assert "multichip::cpu-container" in rep["groups"]
+
+
+def test_multichip_ok_verdict_gated(tmp_path):
+    mod = _mod()
+    for n, ok in ((1, True), (2, False)):
+        with open(tmp_path / f"MULTICHIP_r{n:02d}.json", "w") as fh:
+            json.dump({"n_devices": 8, "rc": 0 if ok else 1, "ok": ok,
+                       "provenance": CPU_PROV, "tail": ""}, fh)
+    groups = mod.group_snapshots(mod.load_all(str(tmp_path)))
+    regs = mod.check_regressions(groups[("multichip", "cpu-container")])
+    assert [r["key"] for r in regs] == ["ok"]
