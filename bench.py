@@ -674,6 +674,21 @@ def main() -> None:
             except Exception as e:
                 _note(f"multitenant phase failed: {e}")
 
+        if paged_app is not None and _remaining() > 120:
+            # ISSUE-15 memory-pressure phase: forced KV churn (spill /
+            # readmit / preempt-resume) through the block-ledgered tiered
+            # runner; publishes fragmentation, idle-age p50, host-tier
+            # watermark, and the leak counter (MUST be 0 under the
+            # conservation audit); REFUSES (memledger_invalid) if no churn
+            # actually occurred.
+            _note("phase: KV memory pressure (block-ledger churn + "
+                  "conservation audit)")
+            try:
+                extra.update(_memledger_pressure(
+                    paged_app, paged_app.tpu_config.max_batch_size))
+            except Exception as e:
+                _note(f"memledger phase failed: {e}")
+
     # FINAL EMIT: same schema, enriched extra. The driver parses the last JSON
     # line; if the process was killed earlier, the early emit already landed.
     # apply_to_extra is the structural refusal net (idempotent): any
@@ -1818,6 +1833,94 @@ def _multitenant_serving(app, batch, closed_loop_tok_s, n_replicas=2):
         _note("MULTITENANT PHASE REGRESSION: a preempted/admitted stream "
               "diverged from its reference")
     return out
+
+
+def _memledger_pressure(app, batch):
+    """ISSUE-15 memory-pressure phase: forced KV churn — spill, readmit,
+    preempt/resume — through a block-ledgered tiered runner
+    (serving/memledger.py), publishing the ledger's fragmentation /
+    idle-age / host-tier-watermark telemetry and the leak counter, which
+    MUST be 0 under the conservation audit.
+
+    HONESTY GUARD (r5 pattern): if no churn actually occurred — nothing
+    spilled, nothing re-admitted, nothing preempted — the keys are REFUSED
+    and ``memledger_invalid`` says why; memory-accountability numbers over
+    an idle pool are vacuous."""
+    from neuronx_distributed_inference_tpu.runtime.continuous_batching import (
+        ContinuousBatchingRunner)
+    from neuronx_distributed_inference_tpu.serving import HostKVTier
+
+    cfg = app.tpu_config
+    bs = cfg.pa_block_size
+    tier = HostKVTier(capacity_blocks=64)
+    runner = ContinuousBatchingRunner(app, decode_chunk=8, kv_tier=tier)
+    out = {}
+    try:
+        if runner.ledger is None:
+            out["memledger_invalid"] = ("runner has no block ledger — the "
+                                        "allocator lacks Python seams")
+            _note(f"memledger phase INVALID: {out['memledger_invalid']}")
+            return out
+        rng = np.random.default_rng(31)
+        prefixes = [rng.integers(1, 100000, size=(2 * bs,)).astype(np.int32)
+                    for _ in range(4)]
+
+        def prompt(i):
+            return np.concatenate([
+                prefixes[i % len(prefixes)],
+                rng.integers(1, 100000, size=(bs,)).astype(np.int32)])
+
+        # 1) commit the shared prefixes (park idle), then SPILL them to host
+        for i in range(len(prefixes)):
+            runner.submit(prompt(i), max_new_tokens=4)
+        runner.run_to_completion()
+        spilled = runner.spill_idle_blocks()
+        # 2) a same-prefix wave pulls the bytes back: READMIT churn
+        for i in range(len(prefixes)):
+            runner.submit(prompt(i), max_new_tokens=4)
+        runner.run_to_completion()
+        # 3) preempt/resume churn: a wave drained mid-flight and resumed —
+        # the migration hand-off the ledger must balance across
+        n_wave = min(8, 2 * runner.num_slots)
+        for i in range(n_wave):
+            runner.submit(prompt(i), max_new_tokens=48)
+        runner.step()
+        runner.step()
+        mem_mid = runner.stats()["memory"]     # fragmentation under load
+        _, evicted = runner.drain_requests()   # audits the hand-off itself
+        preempted = sum(1 for r in evicted if r.generated)
+        for r in evicted:
+            runner.submit(r.prompt, max_new_tokens=r.max_new_tokens,
+                          resume_tokens=r.generated or None)
+        runner.run_to_completion()
+        mem = runner.stats()["memory"]
+        aud = runner.audit_ledger()
+        out.update({
+            "memledger_spilled_blocks": int(spilled),
+            "memledger_readmit_blocks": int(tier.readmit_blocks),
+            "memledger_preemptions": int(preempted),
+        })
+        if spilled < 1 or tier.readmit_blocks < 1 or preempted < 1:
+            out["memledger_invalid"] = (
+                "no churn occurred (spill/readmit/preempt) — the ledger "
+                "numbers below would measure an idle pool, not memory "
+                "accountability under pressure")
+            _note(f"memledger phase INVALID: {out['memledger_invalid']}")
+            return out
+        out.update({
+            "kv_fragmentation_ratio": mem_mid.get("fragmentation_ratio"),
+            "kv_idle_age_p50_s": (mem.get("idle_age_s") or {}).get("p50"),
+            "kv_host_tier_watermark": int(tier.watermark),
+            "kv_leaked_blocks_total": int(aud["leaked_blocks"]),
+            "memledger_audit_ok": bool(aud["ok"]),
+        })
+        if aud["leaked_blocks"] or not aud["ok"]:
+            _note(f"MEMLEDGER PHASE REGRESSION: leaked="
+                  f"{aud['leaked_blocks']} audit_ok={aud['ok']} "
+                  f"violations={aud['violations'][:3]}")
+        return out
+    finally:
+        _drain_runner(runner)
 
 
 def _paged_spec_selfdraft(app, batch):

@@ -170,7 +170,15 @@ class KVBlocksExhausted(RuntimeError):
     path (preempting growth, allocation rollback, partial megastep
     reservation) keeps working, while new callers — request placement, the
     serving router's shed path — can catch exhaustion SPECIFICALLY and
-    degrade (preempt-or-shed) instead of treating it as a generic crash."""
+    degrade (preempt-or-shed) instead of treating it as a generic crash.
+
+    OOM forensics (serving/memledger.py): when the raising allocator carries
+    a KV block ledger, the exception is stamped with ``ledger_snapshot`` —
+    the owner-state breakdown and top holders (request ids, ages, SLA
+    classes) at the exhaustion point, so "out of KV blocks" names who holds
+    the pool instead of just that it is full."""
+
+    ledger_snapshot: Optional[dict] = None
 
 
 class BlockAllocator:

@@ -21,6 +21,7 @@ seq_id, `modules/kvcache/data_parallel_kv_cache_manager.py`, block-KV slot mappi
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import time
 from dataclasses import dataclass, field
@@ -128,7 +129,8 @@ class ContinuousBatchingRunner:
                  mixed_decode_steps: Optional[int] = None,
                  megastep_k: Optional[int] = None,
                  megastep_ring: Optional[int] = None,
-                 telemetry=None, kv_tier=None, sla_classes=None):
+                 telemetry=None, kv_tier=None, sla_classes=None,
+                 memledger: Optional[bool] = None):
         cfg = app.tpu_config
         if not cfg.is_continuous_batching:
             raise ValueError("tpu_config.is_continuous_batching must be enabled")
@@ -513,6 +515,16 @@ class ContinuousBatchingRunner:
                 raise ValueError("kv_tier does not compose with speculative "
                                  "serving yet (the draft pool's blocks are "
                                  "not captured by the spill path)")
+        # --- KV block ledger (serving/memledger.py) ---------------------------
+        # ``memledger``: None = auto (attach whenever the allocator exposes
+        # the Python seams — the tiered allocator always does; the native C++
+        # allocator is opaque), True = require a ledger (selects the Python
+        # allocator over the native one), False = off. All host-side — zero
+        # new dispatches or syncs.
+        if memledger is True and not cfg.paged_attention_enabled:
+            raise ValueError("memledger (the KV block ledger) requires paged "
+                             "attention — there are no blocks to account "
+                             "for on the dense path")
         if self.paged:
             # native host engine (allocator + slot mapping) when available; the
             # non-paged path never touches either, so the build is gated here
@@ -529,6 +541,14 @@ class ContinuousBatchingRunner:
                 self.allocator = TieredBlockAllocator(cfg.pa_num_blocks, bs,
                                                       kv_tier)
                 self._tier_readmit_step = build_readmit_step()
+            elif memledger is True:
+                # a required ledger needs the Python seams the native C++
+                # engine cannot expose — same semantics, auditable
+                from ..modules.block_kvcache import (
+                    BlockAllocator as _PyBlockAllocator)
+
+                self.allocator = _PyBlockAllocator(
+                    cfg.pa_num_blocks, bs, enable_prefix_caching=True)
             else:
                 # C++ engine when the toolchain permits (native/engine.cpp);
                 # Python fallback keeps identical semantics
@@ -552,7 +572,24 @@ class ContinuousBatchingRunner:
                 self.allocator.read_blocks = self._read_tier_blocks
             self.block_table = np.zeros((self.num_slots, self.max_blocks_per_seq),
                                         dtype=np.int32)
+            # KV block ledger: attach when the allocator has Python seams
+            # (tiered always; plain paged under the Python fallback or
+            # memledger=True). Every allocator mutation below runs under a
+            # _led() attribution context so the ledger can name holders.
+            self.ledger = None
+            if memledger is not False and hasattr(self.allocator,
+                                                  "_alloc_one"):
+                from ..serving import memledger as memledger_lib
+
+                self.ledger = memledger_lib.BlockLedger(
+                    self.allocator, tier=kv_tier, registry=reg)
+                self.ledger.bytes_per_block = self._bytes_per_block()
+                memledger_lib.note_runner(self)
+            elif memledger is True:
+                raise ValueError("memledger=True but the allocator has no "
+                                 "Python seams to ledger")
         else:
+            self.ledger = None
             app.reset_cache()
             self.cache = app.kv_cache
             app.kv_cache = None   # the runner owns the cache now
@@ -1444,6 +1481,10 @@ class ContinuousBatchingRunner:
                     self.cache, self._telem_dev, jnp.asarray(k_new),
                     jnp.asarray(v_new), jnp.asarray(id_arr),
                     block_size=self.block_size)
+            if self.ledger is not None:
+                # the scatter is enqueued: the blocks' KV is authoritative
+                # on device again (readmit_inflight -> live)
+                self.ledger.readmit_committed(ids)
             if t0 is not None:
                 tel.step_record(
                     t0, "tier_readmit", iterations=1,
@@ -1453,16 +1494,84 @@ class ContinuousBatchingRunner:
                     kv_total=self.allocator.num_blocks,
                     request_id=for_request)
 
-    def _free_blocks(self, req: Request) -> None:
+    def _bytes_per_block(self) -> int:
+        """Per-block KV bytes across the pool arrays (block axis 1) — the
+        ledger's byte-attribution scale. 0 when the layout is opaque."""
+        try:
+            nb = self.allocator.num_blocks
+            total = sum(
+                int(v.nbytes) for v in self.cache.values()
+                if getattr(v, "ndim", 0) >= 2 and v.shape[1] == nb)
+            d_cache = getattr(self, "d_cache", None)
+            if isinstance(d_cache, dict):
+                total += sum(
+                    int(v.nbytes) for v in d_cache.values()
+                    if getattr(v, "ndim", 0) >= 2 and v.shape[1] == nb)
+            return total // max(1, nb)
+        # lint: ok(silent-except): attribution scale only — an exotic family cache layout degrades bytes to 0, never breaks construction
+        except Exception:
+            return 0
+
+    def _led(self, req: Optional[Request], seam: str,
+             expect_exhaustion: bool = False):
+        """Ledger attribution context for one allocator seam (a shared null
+        context when no ledger is attached). ``expect_exhaustion``: the seam
+        probes headroom and handles KVBlocksExhausted as designed
+        degradation — no OOM forensics capture."""
+        if self.ledger is None:
+            return contextlib.nullcontext()
+        return self.ledger.context(
+            request_id=None if req is None else req.request_id, seam=seam,
+            sla_class=None if req is None else req.sla_class,
+            expect_exhaustion=expect_exhaustion)
+
+    def _expected_holders(self) -> Dict[int, Dict[int, int]]:
+        """The runner's own roster of legitimate block holders: every live
+        (placed, unfinished) request and its blocks list — the audit's
+        cross-check that turns a dropped release into an attributed leak."""
+        exp: Dict[int, Dict[int, int]] = {}
+        for r in self.active:
+            if r is None or r.done:
+                continue
+            held: Dict[int, int] = {}
+            for blk in r.blocks:
+                held[blk] = held.get(blk, 0) + 1
+            exp[r.request_id] = held
+        return exp
+
+    def _kv_fragmentation(self) -> float:
+        """Internal fragmentation over live requests: the fraction of
+        allocated slots not (yet) holding committed KV — tail-block padding
+        plus growth reservations."""
+        held = used = 0
+        for r in self.active:
+            if r is None or r.done or not r.blocks:
+                continue
+            held += len(r.blocks) * self.block_size
+            used += r.insert_pos if r.inserting else r.position
+        return round(1.0 - used / held, 4) if held else 0.0
+
+    def audit_ledger(self, raise_on_violation: bool = False) -> Optional[dict]:
+        """Run the ledger's conservation audit against the runner's roster.
+        None when no ledger is attached. Non-raising mode (serving) logs one
+        structured ``memledger_violation {json}`` line and bumps
+        ``memledger_violations_total`` on failure."""
+        if self.ledger is None:
+            return None
+        return self.ledger.audit(expected_holders=self._expected_holders(),
+                                 raise_on_violation=raise_on_violation)
+
+    def _free_blocks(self, req: Request, seam: str = "release") -> None:
         """Release a request's blocks. With the tiered allocator a mid-prompt
         preemption/truncation must not park the (possibly unwritten) tail
         blocks as idle prefix-cache entries — their hashes are registered at
         allocation but the KV streams in over later windows."""
-        if self.kv_tier is not None and req.inserting:
-            no_park = set(req.blocks[req.insert_pos // self.block_size:])
-            self.allocator.free_sequence(req.blocks, no_park=no_park)
-        else:
-            self.allocator.free_sequence(req.blocks)
+        with self._led(req, seam):
+            if self.kv_tier is not None and req.inserting:
+                no_park = set(req.blocks[req.insert_pos // self.block_size:])
+                self.allocator.free_sequence(req.blocks, no_park=no_park)
+            else:
+                self.allocator.free_sequence(req.blocks)
 
     def spill_idle_blocks(self, keep: int = 0) -> int:
         """Force the tier's evict path: spill all but ``keep`` idle blocks to
@@ -1776,6 +1885,28 @@ class ContinuousBatchingRunner:
             # count and the host-store state ride alongside
             s["kv_blocks_free_device"] = self.allocator.num_free_device
             s["kv_tier"] = self.kv_tier.stats()
+        if self.ledger is not None:
+            # byte attribution + conservation view (serving/memledger.py):
+            # owner-state counts, top holders by request/class, idle ages,
+            # fragmentation, the last OOM snapshot, and an on-demand audit.
+            # GUARDED: a ledger failure degrades to an error entry — the
+            # rest of the snapshot (and any bundle embedding it) survives.
+            try:
+                mem = self.ledger.snapshot()
+                mem["fragmentation_ratio"] = self._kv_fragmentation()
+                aud = self.audit_ledger()
+                mem["audit"] = {"ok": aud["ok"],
+                                "violations": len(aud["violations"]),
+                                "leaked_blocks": aud["leaked_blocks"]}
+                if self.ledger.last_oom is not None:
+                    mem["last_oom"] = self.ledger.last_oom
+                self.ledger.export_gauges(
+                    fragmentation=mem["fragmentation_ratio"])
+                s["memory"] = mem
+            except Exception as e:
+                logger.warning("memledger stats failed: %s: %s",
+                               type(e).__name__, e)
+                s["memory"] = {"error": f"{type(e).__name__}: {e}"}
         if self.megastep_k is not None:
             # committed megastep accounting (host mirror of the device
             # carry's megastep fields — equal at every pipeline flush):
@@ -2389,19 +2520,24 @@ class ContinuousBatchingRunner:
                 continue        # insert rows hold their full-prompt blocks
             want = req.position + steps + 1
             if len(req.blocks) * bs < want:
-                try:
-                    self.allocator.extend(req.blocks, want)
-                # lint: ok(silent-except): designed partial reservation — short coverage costs loop iterations (in-graph coverage early-exit), never correctness
-                except RuntimeError:
-                    # partial reservation: take what the free list still has,
-                    # one block at a time (extend() rolls back all-or-nothing)
-                    while len(req.blocks) * bs < want:
-                        try:
-                            self.allocator.extend(req.blocks,
-                                                  len(req.blocks) * bs + 1)
-                        # lint: ok(silent-except): end of the best-effort walk — the megastep's coverage exit handles the shortfall
-                        except RuntimeError:
-                            break
+                # this walk PROBES the free list until it raises (partial
+                # coverage by design) — suppress the OOM forensics capture
+                with self._led(req, "megastep_reserve",
+                               expect_exhaustion=True):
+                    try:
+                        self.allocator.extend(req.blocks, want)
+                    # lint: ok(silent-except): designed partial reservation — short coverage costs loop iterations (in-graph coverage early-exit), never correctness
+                    except RuntimeError:
+                        # partial reservation: take what the free list still
+                        # has, one block at a time (extend() rolls back
+                        # all-or-nothing)
+                        while len(req.blocks) * bs < want:
+                            try:
+                                self.allocator.extend(
+                                    req.blocks, len(req.blocks) * bs + 1)
+                            # lint: ok(silent-except): end of the best-effort walk — the megastep's coverage exit handles the shortfall
+                            except RuntimeError:
+                                break
             self.block_table[req.slot, : len(req.blocks)] = req.blocks
         if any(not r.inserting and not r.done
                and len(r.blocks) * bs <= r.position for r in active_rows):
@@ -2853,6 +2989,10 @@ class ContinuousBatchingRunner:
             # every committed prefix to host RAM so the bytes survive the
             # replica (a re-added replica re-admits them on the next hit)
             self.spill_idle_blocks()
+        # migration hand-off audit point: the drained pool must balance
+        # bit-for-bit (every evicted request's blocks released, idle spills
+        # accounted) before the streams move elsewhere
+        self.audit_ledger()
         return emitted, out
 
     def evict_request(self, request_id: int):
@@ -2880,6 +3020,7 @@ class ContinuousBatchingRunner:
         if req is not None and not req.done:
             self._preempt(req)               # re-queues at the front ...
             self.queue.remove(req)           # ... and leaves with us instead
+            self.audit_ledger()              # single-request hand-off audit
             return emitted, req
         req = next((r for r in self.queue if r.request_id == request_id),
                    None)
@@ -2914,7 +3055,11 @@ class ContinuousBatchingRunner:
                 for req in active_rows:
                     if req.inserting:
                         continue   # blocks for the full prompt already held
-                    self.allocator.extend(req.blocks, req.position + steps + 1)
+                    # exhaustion here is handled by the preempting grower —
+                    # designed degradation, not an OOM forensics event
+                    with self._led(req, "grow", expect_exhaustion=True):
+                        self.allocator.extend(req.blocks,
+                                              req.position + steps + 1)
                     self.block_table[req.slot, : len(req.blocks)] = req.blocks
                 return active_rows
             # lint: ok(silent-except): recovery IS the handler — _preempt (logs + counts serving_preemptions_total) or truncate-finish
@@ -2941,6 +3086,11 @@ class ContinuousBatchingRunner:
         logger.warning(
             "placement of request %d hit KV-block exhaustion: re-queued; "
             "preempting the newest insert for headroom", req.request_id)
+        if self.ledger is not None:
+            # OOM forensics: who holds the pool at the exhaustion point —
+            # covers injected alloc faults too (they raise ABOVE the
+            # ledger's own exception-path capture in the wrapped seam)
+            self.ledger.note_exhaustion("place")
         self.active[slot] = None
         self._slot_sp[slot] = self._default_sp_row
         self.adapter_ids[slot] = 0
@@ -2959,10 +3109,16 @@ class ContinuousBatchingRunner:
     def _preempt(self, req: Request) -> None:
         logger.info("preempting request %d (out of KV blocks)", req.request_id)
         self._m_preempt.inc()
-        self.telemetry.request_preempted(req.request_id)
+        self.telemetry.request_preempted(
+            req.request_id,
+            blocks_held=len(req.blocks) if self.paged else None)
         self.active[req.slot] = None
         if self.paged:
-            self._free_blocks(req)
+            if self.ledger is not None:
+                # holdings-timeline hand-off marker: blocks held AT preempt
+                self.ledger.note_event(req.request_id, "preempt",
+                                       tokens=len(req.generated))
+            self._free_blocks(req, seam="preempt")
             self.block_table[req.slot, :] = 0
             req.blocks = []
         self._slot_sp[req.slot] = self._default_sp_row
@@ -2996,7 +3152,9 @@ class ContinuousBatchingRunner:
         if req.adapter_id != 0:
             hashed = fed.copy()
             hashed[0] ^= np.int32(req.adapter_id << 20)
-        req.blocks, cached_len = self.allocator.allocate_for_prompt(hashed)
+        with self._led(req, "place"):
+            req.blocks, cached_len = self.allocator.allocate_for_prompt(
+                hashed)
         # never skip the whole prompt: the last token's logits seed generation
         cached_len = min(cached_len, len(fed) - 1)
         if (self.insert_cap is not None or self.mixed) and cached_len > 0:
@@ -3181,7 +3339,8 @@ class ContinuousBatchingRunner:
         skipped prefix doesn't produce. Shared full blocks are simply rewritten
         with identical content (the chain hash keys tokens), so block SHARING
         still dedups memory."""
-        req.blocks, _ = self.allocator.allocate_for_prompt(fed)
+        with self._led(req, "place"):
+            req.blocks, _ = self.allocator.allocate_for_prompt(fed)
         self.block_table[slot, : len(req.blocks)] = req.blocks
         sp_row = self._slot_sp[slot : slot + 1]
         max_window = self.app.cte_buckets[-1]
@@ -3243,7 +3402,7 @@ class ContinuousBatchingRunner:
         if req.slot >= 0:
             self.active[req.slot] = None
             if self.paged:
-                self._free_blocks(req)
+                self._free_blocks(req, seam="finish")
                 self.block_table[req.slot, :] = 0
             # reset the slot's sampling/adapter rows so all-greedy traffic
             # re-engages the fast argmax executable

@@ -33,6 +33,12 @@ mesh cannot be millions of users"):
   registered factory under sustained queue/KV/SLO pressure and
   drains+retires them when the fleet idles (two-phase, bit-exact
   migration), with hysteresis and min/max bounds.
+- ``memledger``: :class:`BlockLedger` — the accountable-KV-memory layer:
+  every physical block attributed to an owner state ({free, live(request),
+  idle(hash), host-reserved(hash), readmit-in-flight}), a conservation
+  auditor over the allocator's real structures, fragmentation/idle-age
+  telemetry, per-request/per-class byte attribution, and OOM forensics
+  (``KVBlocksExhausted.ledger_snapshot`` naming the top holders).
 
 Replicas are plain Python objects over independent runners, so "N replicas"
 can mean N sub-meshes on one host (the dryrun harness fakes 8 devices) or,
@@ -40,9 +46,10 @@ later, N hosts behind the gloo launcher — the router only speaks the
 admission interface.
 """
 
-from . import tracing
+from . import memledger, tracing
 from .autoscaler import ReplicaAutoscaler
 from .engine import EngineReplica
+from .memledger import BlockLedger, MemLedgerViolation
 from .faults import (FaultInjector, FaultSpec, InjectedFault,
                      InjectedReplicaDeath)
 from .kv_tiering import HostKVTier
@@ -56,4 +63,5 @@ __all__ = ["EngineReplica", "HostKVTier", "PrefixAffinityRouter",
            "InjectedFault", "InjectedReplicaDeath", "REPLICA_HEALTHY",
            "REPLICA_DEGRADED", "REPLICA_FAILED", "REPLICA_RETIRED",
            "SLAClass", "SLAClassSet", "ReplicaAutoscaler",
-           "default_class_set", "tracing"]
+           "default_class_set", "tracing", "memledger", "BlockLedger",
+           "MemLedgerViolation"]

@@ -26,6 +26,7 @@ serving replica with a stable id. It adds exactly what the frontend
 
 from __future__ import annotations
 
+import logging
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -66,6 +67,10 @@ class EngineReplica:
     unlabelled names).
     """
 
+    # serving-mode ledger-audit cadence (waves): a leak surfaces within this
+    # many steps even if nothing drains, evicts, or scrapes in between
+    LEDGER_AUDIT_EVERY = 64
+
     def __init__(self, replica_id: str, runner_factory=None, *,
                  runner: Optional[ContinuousBatchingRunner] = None,
                  telemetry_enabled: bool = False,
@@ -98,6 +103,7 @@ class EngineReplica:
         self.max_queue_depth = (max_queue_depth if max_queue_depth is not None
                                 else 2 * runner.num_slots)
         self.draining = False
+        self._wave = 0      # step counter for the periodic ledger audit
         if runner.paged and runner.kv_tier is not None:
             # per-replica VIEWS of the (possibly shared) tier's state — a
             # shared tier repeats the same value under every replica label,
@@ -204,6 +210,18 @@ class EngineReplica:
             ts = self.runner.kv_tier.stats()
             for k, g in self._tier_gauges.items():
                 g.set(ts[k])
+        led = getattr(self.runner, "ledger", None)
+        if led is not None:
+            # periodic (NOT per-wave — both are O(num_blocks) host work the
+            # hot loop must not pay every step): refresh the replica-labelled
+            # owner-state gauges and run the conservation audit, so a leaked
+            # block surfaces within bounded waves even if nothing drains or
+            # scrapes; every Prometheus scrape does both too
+            self._wave += 1
+            if self._wave % self.LEDGER_AUDIT_EVERY == 0:
+                led.export_gauges(
+                    fragmentation=self.runner._kv_fragmentation())
+                self.runner.audit_ledger()
         return self.runner.step(key)
 
     @property
@@ -237,6 +255,18 @@ class EngineReplica:
         self._g_accepting.set(1)
 
     def prometheus_text(self, exemplars: bool = False) -> str:
+        # scrape-time conservation audit + gauge refresh: a leaked block is
+        # visible in THIS exposition (memledger_violations_total /
+        # serving_kv_leaked_blocks_total), not only after the next drain
+        if getattr(self.runner, "ledger", None) is not None:
+            try:
+                self.runner.audit_ledger()
+                self.runner.ledger.export_gauges(
+                    fragmentation=self.runner._kv_fragmentation())
+            except Exception as e:   # lint: ok(silent-except): a broken ledger must not break the scrape itself (logged)
+                logging.getLogger("tpu-inference").warning(
+                    "scrape-time ledger audit failed on replica %s: %s",
+                    self.replica_id, e)
         return self.registry.prometheus_text(exemplars=exemplars)
 
     def trace_source(self) -> Dict[str, object]:

@@ -23,6 +23,11 @@ Fault kinds (``FAULT_KINDS``):
 ``alloc``       one :class:`~..modules.block_kvcache.KVBlocksExhausted`
                 raised from the replica allocator's next ``_alloc_one`` —
                 the preempt-or-shed path's food.
+``leak``        DROP the allocator's next ``_release_one`` (the refcount is
+                never decremented, so the block stays held by a request
+                that no longer exists) — the KV block ledger's food: the
+                conservation auditor (serving/memledger.py) must detect the
+                leak and attribute it to the exact request and seam.
 ``corrupt``     flip bytes in one host-KV-tier entry (checksum intact from
                 spill time, bytes now wrong) — the readmit integrity check's
                 food.
@@ -75,8 +80,8 @@ logger = logging.getLogger("tpu-inference")
 __all__ = ["FAULT_KINDS", "FaultSpec", "FaultInjector", "InjectedFault",
            "InjectedReplicaDeath", "parse_fault_specs"]
 
-FAULT_KINDS = ("exception", "stall", "death", "alloc", "corrupt", "truncate",
-               "overload")
+FAULT_KINDS = ("exception", "stall", "death", "alloc", "leak", "corrupt",
+               "truncate", "overload")
 
 
 class InjectedFault(RuntimeError):
@@ -201,6 +206,7 @@ class FaultInjector:
         self._spec_fired: Dict[int, set] = {}       # spec idx -> replica ids
         self._dead: set = set()
         self._alloc_pending: Dict[str, int] = {}
+        self._leak_pending: Dict[str, int] = {}
         self.fired: Dict[Tuple[str, str], int] = {} # (kind, replica) -> count
         self.fired_total = 0
         self._registry = None
@@ -254,6 +260,25 @@ class FaultInjector:
                 return real_alloc()
 
             alloc._alloc_one = _alloc_one
+        if alloc is not None and hasattr(alloc, "_release_one"):
+            # the `leak` kind: swallow ONE release — wrapping the CURRENT
+            # instance attribute means the block ledger's own seam wrapper
+            # (attached at runner construction, below us) never sees the
+            # release either, exactly like a real dropped-release bug
+            real_release = alloc._release_one
+
+            def _release_one(blk):
+                if self._leak_pending.get(rid, 0) > 0:
+                    self._leak_pending[rid] -= 1
+                    self._count("leak", rid)
+                    logger.warning(
+                        "injected KV block leak on replica %s: release of "
+                        "block %d dropped (refcount never decremented)",
+                        rid, blk)
+                    return
+                return real_release(blk)
+
+            alloc._release_one = _release_one
 
     def revive(self, replica_id: str) -> None:
         """Forget a death: the (fresh) replica under this id serves again.
@@ -308,6 +333,10 @@ class FaultInjector:
         if kind == "alloc":
             # armed here, counted when the wrapped _alloc_one actually raises
             self._alloc_pending[rid] = self._alloc_pending.get(rid, 0) + 1
+            return
+        if kind == "leak":
+            # armed here, counted when the wrapped _release_one drops one
+            self._leak_pending[rid] = self._leak_pending.get(rid, 0) + 1
             return
         if kind == "overload":
             n = self._overload_burst(spec)

@@ -156,6 +156,7 @@ class HostKVTier:
         self.readmit_blocks = 0      # host blocks restored to device
         self.readmit_requests = 0    # requests that hit the host tier
         self.integrity_failures = 0  # entries dropped on checksum mismatch
+        self.watermark = 0           # peak store occupancy (blocks) ever seen
 
     # ------------------------------------------------------------ bookkeeping
     def tick(self) -> int:
@@ -175,6 +176,7 @@ class HostKVTier:
         return {
             "capacity_blocks": self.capacity_blocks,
             "host_blocks": self.host_blocks(),
+            "watermark": self.watermark,
             "evictions": self.evictions,
             "host_evictions": self.host_evictions,
             "discards": self.discards,
@@ -216,6 +218,7 @@ class HostKVTier:
             self.store[h] = hb
             fresh.append(hb)
             self.evictions += 1
+        self.watermark = max(self.watermark, len(self.store))
         # materialize NOW (both D2H copies are already in flight, so the
         # waits overlap): a lazily-held device slice would pin the gather's
         # HBM buffer for the store entry's whole lifetime — the tier would
@@ -256,6 +259,7 @@ class HostKVTier:
     def restore(self, h: bytes, blk: _HostBlock) -> None:
         """Put a reserved block back (allocation rollback)."""
         self.store[h] = blk
+        self.watermark = max(self.watermark, len(self.store))
         self._enforce_capacity()
 
     def note_readmitted(self, n_blocks: int) -> None:

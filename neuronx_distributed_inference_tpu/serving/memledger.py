@@ -1,0 +1,807 @@
+"""Accountable KV memory: a per-replica block ledger with per-request
+attribution, conservation auditing, and OOM forensics.
+
+KV-block capacity is the admission signal, the autoscaler input, and the
+migration currency of the whole serving stack — yet the paged allocator's
+refcounts, idle pool, host tier, and in-flight readmit reservations are
+trusted bookkeeping that nothing audits. A leaked block silently shrinks
+capacity forever, and ``KVBlocksExhausted`` fires with no record of who
+holds what. This module is the memory analog of the PR 7 time attribution:
+every physical block is attributed to an OWNER STATE, and a conservation
+auditor proves — bit for bit, at every hand-off — that the bookkeeping
+balances. All host-side: zero new dispatches, zero new host syncs.
+
+Owner-state machine (a disjoint partition of the device pool)::
+
+    free ──alloc──▶ live(request_id) ──release──▶ free
+                      │  ▲                   └──▶ idle(hash)     (tiered:
+                      │  │ reactivate / alloc-reclaim(spill)      hashed
+                      │  │                                        blocks park)
+                      │  idle(hash)
+                      │
+      tier hit: alloc + tier.reserve(hash)
+                      ▼
+           host_reserved(hash)  ── take_pending_readmits ──▶ readmit_inflight
+                                   ── readmit dispatch commits ──▶ live
+
+``free``            on the allocator free list.
+``live``            refcounted; holders attributed per request (shared
+                    prefix blocks carry one holder entry per sharer, and
+                    the per-block holder sum must equal the refcount).
+``idle``            the tiered allocator's idle pool (refcount 0,
+                    device-resident, hash registered — allocatable headroom).
+``host_reserved``   allocated for a host-tier prefix hit; the reserved host
+                    bytes sit in the allocator's pending-readmit queue.
+``readmit_inflight`` taken by the runner for the readmit scatter but not yet
+                    committed — a block stuck here is an orphaned readmit.
+
+The ledger maintains this machine by wrapping the EXISTING seams
+(``BlockAllocator._alloc_one``/``_release_one``, the tiered allocator's
+reactivate/spill/pending-readmit flow) at instance level — the same idiom
+the fault injector uses — with the runner supplying attribution context
+(request id, seam name, SLA class) around its allocator calls.
+
+``audit()`` is the conservation check: free + live + idle + host_reserved +
+readmit_inflight == num_blocks, the ledger's view matches the allocator's
+actual structures (free list, refcounts, idle pool, hash bijection, pending
+queue), per-block holder sums match refcounts, and — given the runner's
+expected-holder roster — every held block belongs to a live request. A
+dropped release (the ``leak`` fault kind, serving/faults.py) shows up as a
+block held by a request that no longer exists, attributed to the exact
+request id and the seam that last touched it. Violations raise in tests
+(the autouse conftest fixture) and emit ONE structured
+``memledger_violation {json}`` line + a counter in serving.
+
+On top of the ledger: fragmentation / idle-age / host-tier telemetry
+(``serving_kv_blocks{state=}``, ``serving_kv_idle_age_seconds{quantile=}``,
+``serving_kv_bytes{sla_class=}``), per-request byte attribution in
+``stats()["memory"]``, and OOM forensics — ``KVBlocksExhausted`` carries a
+``ledger_snapshot`` naming the top holders, so "out of KV blocks" is
+answerable (scripts/explain_memory.py renders it).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import time
+import weakref
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..modules.block_kvcache import KVBlocksExhausted
+
+logger = logging.getLogger("tpu-inference")
+
+__all__ = ["BlockLedger", "MemLedgerViolation", "STATES",
+           "FREE", "LIVE", "IDLE", "HOST_RESERVED", "READMIT_INFLIGHT",
+           "note_runner", "live_runners", "snapshot_safe", "timeline_safe"]
+
+FREE = "free"
+LIVE = "live"
+IDLE = "idle"
+HOST_RESERVED = "host_reserved"
+READMIT_INFLIGHT = "readmit_inflight"
+STATES = (FREE, LIVE, IDLE, HOST_RESERVED, READMIT_INFLIGHT)
+
+# bounded per-request holdings timeline (events per request / requests kept)
+TIMELINE_EVENTS_PER_REQUEST = 64
+TIMELINE_REQUESTS = 1024
+
+
+class MemLedgerViolation(RuntimeError):
+    """The conservation audit found the bookkeeping out of balance. Carries
+    the full audit report in ``.report``."""
+
+    def __init__(self, report: dict):
+        self.report = report
+        head = report["violations"][0] if report["violations"] else {}
+        super().__init__(
+            f"KV block ledger audit failed: {len(report['violations'])} "
+            f"violation(s); first: {head}")
+
+
+class _Ctx:
+    """Attribution context for one runner seam (who is allocating/releasing,
+    from where). ``credits`` collects the holder credits the inner wrapped
+    calls recorded during one ``allocate_for_prompt``, so the post-call
+    reconcile can credit refcount-share prefix hits the internals never
+    surface."""
+
+    __slots__ = ("request_id", "seam", "sla_class", "credits",
+                 "expect_exhaustion")
+
+    def __init__(self, request_id, seam, sla_class,
+                 expect_exhaustion=False):
+        self.request_id = request_id
+        self.seam = seam
+        self.sla_class = sla_class
+        self.expect_exhaustion = expect_exhaustion
+        self.credits: Dict[int, int] = {}
+
+
+class _Rec:
+    """One non-free block's ledger record."""
+
+    __slots__ = ("state", "hash", "since", "holders", "seam")
+
+    def __init__(self, state, hash_, since, holders, seam):
+        self.state = state
+        self.hash = hash_          # bytes or None (live blocks may be hashed)
+        self.since = since         # last state-transition timestamp
+        self.holders = holders     # {request_id_or_None: count}
+        self.seam = seam           # seam of the last transition
+
+
+class BlockLedger:
+    """Per-replica KV block ledger over one (Python) block allocator.
+
+    ``allocator`` must expose the Python seams (``_alloc_one`` /
+    ``_release_one``); the native C++ allocator is opaque and cannot be
+    ledgered (``ContinuousBatchingRunner(memledger=True)`` selects the
+    Python allocator when a ledger is required). ``attach()`` wraps the
+    seams at instance level and is called by the constructor."""
+
+    def __init__(self, allocator, tier=None, registry=None,
+                 replica: Optional[str] = None):
+        self.allocator = allocator
+        self.tier = tier
+        self.num_blocks = int(allocator.num_blocks)
+        self.replica = replica
+        self.records: Dict[int, _Rec] = {}     # absent = free
+        self.request_class: Dict[int, Optional[str]] = {}
+        self.request_log: "OrderedDict[int, List[dict]]" = OrderedDict()
+        self.bytes_per_block = 0               # set by the owning runner
+        self.last_oom: Optional[dict] = None
+        self._last_oom_t = 0.0          # snapshot-rebuild rate limiter
+        self._known_leaked: set = set()
+        self._seen_violation_sigs: set = set()
+        self._ctx: Optional[_Ctx] = None
+        self._t0 = time.monotonic()
+        self._registry = registry
+        if registry is not None:
+            self._c_violations = registry.counter(
+                "memledger_violations_total",
+                "KV block ledger conservation-audit violations")
+            self._c_leaked = registry.counter(
+                "serving_kv_leaked_blocks_total",
+                "KV blocks found held by no live request (leaked)")
+            self._c_oom = registry.counter(
+                "serving_kv_oom_events_total",
+                "KVBlocksExhausted raises captured with a ledger snapshot")
+        else:
+            self._c_violations = self._c_leaked = self._c_oom = None
+        self.attach()
+
+    # ------------------------------------------------------------------ context
+    @contextlib.contextmanager
+    def context(self, request_id=None, seam: str = "",
+                sla_class: Optional[str] = None,
+                expect_exhaustion: bool = False):
+        """Attribution scope for one runner seam: allocations/releases inside
+        credit/debit ``request_id`` and stamp ``seam`` on the transitions.
+        ``expect_exhaustion``: this seam PROBES for headroom and handles
+        ``KVBlocksExhausted`` as designed degradation (megastep partial
+        reservation, the preempting grower) — the OOM forensics capture is
+        suppressed so normal tight-pool operation does not read as a stream
+        of phantom OOM events."""
+        prev = self._ctx
+        self._ctx = _Ctx(request_id, seam, sla_class,
+                         expect_exhaustion=expect_exhaustion)
+        if request_id is not None:
+            self.request_class[request_id] = sla_class
+        try:
+            yield
+        finally:
+            self._ctx = prev
+
+    def _now(self) -> float:
+        return time.monotonic()
+
+    def _log(self, rid, event: str, **fields) -> None:
+        if rid is None:
+            return
+        log = self.request_log.get(rid)
+        if log is None:
+            log = self.request_log[rid] = []
+            while len(self.request_log) > TIMELINE_REQUESTS:
+                self.request_log.popitem(last=False)
+        log.append({"t": round(self._now() - self._t0, 6), "event": event,
+                    **fields})
+        del log[:-TIMELINE_EVENTS_PER_REQUEST]
+
+    # ------------------------------------------------------------------ attach
+    def attach(self) -> None:
+        """Wrap the allocator's seams at instance level (the fault-injector
+        idiom: later wrappers — e.g. an injected ``leak`` — compose on top)."""
+        alloc = self.allocator
+        real_alloc = alloc._alloc_one
+        real_release = alloc._release_one
+        real_prompt = alloc.allocate_for_prompt
+
+        def _alloc_one():
+            try:
+                blk = real_alloc()
+            except KVBlocksExhausted as e:
+                # designed headroom probes (megastep partial reservation,
+                # the preempting grower) handle this raise as steady-state
+                # degradation — no forensics capture for those
+                if self._ctx is None or not self._ctx.expect_exhaustion:
+                    self.note_exhaustion(
+                        self._ctx.seam if self._ctx else "unknown", exc=e)
+                raise
+            self._on_alloc(blk)
+            return blk
+
+        def _release_one(blk):
+            real_release(blk)
+            self._on_release(blk)
+
+        def allocate_for_prompt(tokens):
+            ctx = self._ctx
+            if ctx is None:
+                ctx = self._ctx = _Ctx(None, "unattributed", None)
+                anon = True
+            else:
+                anon = False
+            ctx.credits = {}
+            pend = getattr(alloc, "_pending_readmits", None)
+            n_pend0 = len(pend) if pend is not None else 0
+            try:
+                blocks, cached = real_prompt(tokens)
+            finally:
+                if anon:
+                    self._ctx = None
+            # refcount-share prefix hits increment refcounts without touching
+            # _alloc_one/_reactivate — reconcile the holder credits here
+            need: Dict[int, int] = {}
+            for blk in blocks:
+                need[blk] = need.get(blk, 0) + 1
+            for blk, n in need.items():
+                extra = n - ctx.credits.get(blk, 0)
+                if extra > 0:
+                    rec = self.records.get(blk)
+                    if rec is not None:
+                        rec.holders[ctx.request_id] = (
+                            rec.holders.get(ctx.request_id, 0) + extra)
+            # host-tier hits queued a readmit: those blocks are allocated but
+            # their KV bytes are still host-side reservations
+            if pend is not None:
+                now = self._now()
+                for blk, h, _hb in pend[n_pend0:]:
+                    rec = self.records.get(blk)
+                    if rec is not None:
+                        rec.state = HOST_RESERVED
+                        rec.hash = h
+                        rec.since = now
+            self._log(ctx.request_id, "allocate", seam=ctx.seam,
+                      blocks=len(blocks), cached_tokens=int(cached),
+                      readmits=(len(pend) - n_pend0 if pend is not None
+                                else 0))
+            return blocks, cached
+
+        alloc._alloc_one = _alloc_one
+        alloc._release_one = _release_one
+        alloc.allocate_for_prompt = allocate_for_prompt
+
+        if hasattr(alloc, "_reactivate"):
+            real_reactivate = alloc._reactivate
+
+            def _reactivate(blk):
+                real_reactivate(blk)
+                self._on_reactivate(blk)
+
+            alloc._reactivate = _reactivate
+        if hasattr(alloc, "spill_idle"):
+            real_spill_idle = alloc.spill_idle
+
+            def spill_idle(keep=0):
+                n = real_spill_idle(keep)
+                # spilled idle blocks returned to the free list
+                idle_now = alloc.idle
+                for blk in [b for b, r in self.records.items()
+                            if r.state == IDLE and b not in idle_now]:
+                    del self.records[blk]
+                return n
+
+            alloc.spill_idle = spill_idle
+        if hasattr(alloc, "take_pending_readmits"):
+            real_take = alloc.take_pending_readmits
+
+            def take_pending_readmits():
+                out = real_take()
+                now = self._now()
+                for blk, _h, _hb in out:
+                    rec = self.records.get(blk)
+                    if rec is not None and rec.state == HOST_RESERVED:
+                        rec.state = READMIT_INFLIGHT
+                        rec.since = now
+                return out
+
+            alloc.take_pending_readmits = take_pending_readmits
+
+    # -------------------------------------------------------------- transitions
+    def _on_alloc(self, blk: int) -> None:
+        ctx = self._ctx
+        rid = ctx.request_id if ctx else None
+        seam = ctx.seam if ctx else "unattributed"
+        self.records[blk] = _Rec(LIVE, None, self._now(), {rid: 1}, seam)
+        if ctx is not None:
+            ctx.credits[blk] = ctx.credits.get(blk, 0) + 1
+
+    def _on_reactivate(self, blk: int) -> None:
+        ctx = self._ctx
+        rid = ctx.request_id if ctx else None
+        rec = self.records.get(blk)
+        h = getattr(self.allocator, "block_to_hash", {}).get(blk)
+        if rec is None:
+            rec = self.records[blk] = _Rec(LIVE, h, self._now(), {}, "")
+        rec.state = LIVE
+        rec.hash = h
+        rec.since = self._now()
+        rec.seam = ctx.seam if ctx else "unattributed"
+        rec.holders = {rid: 1}
+        if ctx is not None:
+            ctx.credits[blk] = ctx.credits.get(blk, 0) + 1
+
+    def _on_release(self, blk: int) -> None:
+        alloc = self.allocator
+        ctx = self._ctx
+        rid = ctx.request_id if ctx else None
+        seam = ctx.seam if ctx else "unattributed"
+        rec = self.records.get(blk)
+        if blk in alloc.refcount:
+            # still live under other holders: debit the releasing request's
+            # credit. A release with NO credit for this request debits
+            # nothing — that happens legitimately only when an exhaustion
+            # rollback returns a share-hit the post-call reconcile never got
+            # to credit (the refcounts balance again once the rollback
+            # completes); debiting another holder would paper over a real
+            # mis-attributed release, which the audit must surface instead.
+            if rec is not None:
+                if rec.holders.get(rid, 0) > 0:
+                    rec.holders[rid] -= 1
+                    if rec.holders[rid] == 0:
+                        del rec.holders[rid]
+                rec.seam = seam
+            self._log(rid, "release_shared", seam=seam, block=blk)
+            return
+        idle = getattr(alloc, "idle", None)
+        if idle is not None and blk in idle:
+            h = getattr(alloc, "block_to_hash", {}).get(blk)
+            self.records[blk] = _Rec(IDLE, h, self._now(), {}, seam)
+        else:
+            self.records.pop(blk, None)
+        self._log(rid, "release", seam=seam, block=blk)
+
+    def readmit_committed(self, block_ids) -> None:
+        """The readmit scatter landed: the named blocks' KV is device-resident
+        again (runner._dispatch_readmits calls this per committed chunk)."""
+        now = self._now()
+        for blk in block_ids:
+            rec = self.records.get(int(blk))
+            if rec is not None and rec.state == READMIT_INFLIGHT:
+                rec.state = LIVE
+                rec.since = now
+
+    def readmit_written_off(self, blk: int) -> None:
+        """Crash recovery reconciled a dead replica's pending readmit back to
+        the host store (serving/router.recover_replica): the device block
+        stays allocated to its (ghost) holder but is plain live content-wise
+        — without this the dead runner's ledger would report a stuck
+        in-flight readmit that recovery already accounted for."""
+        rec = self.records.get(int(blk))
+        if rec is not None and rec.state in (READMIT_INFLIGHT, HOST_RESERVED):
+            rec.state = LIVE
+            rec.since = self._now()
+
+    def note_event(self, request_id: int, event: str, **fields) -> None:
+        """Runner hand-off marker (preempt/migrate/resume) for the holdings
+        timeline, with the blocks held at the hand-off point."""
+        held = sum(rec.holders.get(request_id, 0)
+                   for rec in self.records.values())
+        self._log(request_id, event, held_blocks=held, **fields)
+
+    # ------------------------------------------------------------------ audit
+    def audit(self, expected_holders: Optional[Dict[int, Dict[int, int]]]
+              = None, raise_on_violation: bool = False,
+              check_inflight: bool = True) -> dict:
+        """Conservation + attribution audit.
+
+        ``expected_holders``: the owner's roster — ``{request_id: {block:
+        count}}`` for every request that legitimately holds blocks (the
+        runner builds it from its active slots). With it, a block held by a
+        request outside the roster is a LEAK, attributed to the request and
+        the seam of its last transition. ``check_inflight=False`` tolerates
+        ``readmit_inflight`` blocks (mid-dispatch callers only; every
+        quiescent audit point must see none).
+
+        Returns the report dict; ``raise_on_violation=True`` raises
+        :class:`MemLedgerViolation` instead of logging. In serving (the
+        non-raising mode) each failed audit emits ONE structured
+        ``memledger_violation {json}`` log line and bumps
+        ``memledger_violations_total``."""
+        alloc = self.allocator
+        v: List[dict] = []
+        by_state: Dict[str, set] = {s: set() for s in STATES}
+        for blk, rec in self.records.items():
+            by_state[rec.state].add(blk)
+        by_state[FREE] = set(range(self.num_blocks)) - set(self.records)
+
+        # conservation: the five states partition the pool
+        total = sum(len(s) for s in by_state.values())
+        if total != self.num_blocks:
+            v.append({"kind": "conservation", "detail":
+                      f"state partition sums to {total} != "
+                      f"{self.num_blocks} blocks"})
+
+        # free list: same set, no duplicates (a duplicate is a double free)
+        free_list = list(alloc.free)
+        if len(set(free_list)) != len(free_list):
+            v.append({"kind": "double_free", "detail":
+                      "allocator free list contains duplicate block ids"})
+        if set(free_list) != by_state[FREE]:
+            extra = sorted(set(free_list) - by_state[FREE])[:8]
+            missing = sorted(by_state[FREE] - set(free_list))[:8]
+            v.append({"kind": "free_list_mismatch", "detail":
+                      f"allocator free list disagrees with ledger: "
+                      f"allocator-only={extra} ledger-only={missing}"})
+
+        # idle pool (tiered only)
+        idle = getattr(alloc, "idle", None)
+        if idle is not None and set(idle) != by_state[IDLE]:
+            v.append({"kind": "idle_mismatch", "detail":
+                      f"idle pool {sorted(idle)[:8]} != ledger idle "
+                      f"{sorted(by_state[IDLE])[:8]}"})
+
+        # refcounted set == live + host_reserved + inflight; per-block holder
+        # sums match the refcounts (the per-request attribution invariant)
+        refcounted = (by_state[LIVE] | by_state[HOST_RESERVED]
+                      | by_state[READMIT_INFLIGHT])
+        if set(alloc.refcount) != refcounted:
+            v.append({"kind": "refcount_set_mismatch", "detail":
+                      f"refcounted blocks "
+                      f"{sorted(set(alloc.refcount) - refcounted)[:8]} "
+                      f"missing from the ledger; ledger-only "
+                      f"{sorted(refcounted - set(alloc.refcount))[:8]}"})
+        for blk in sorted(refcounted & set(alloc.refcount)):
+            rec = self.records[blk]
+            held = sum(rec.holders.values())
+            if held != alloc.refcount[blk]:
+                v.append({"kind": "refcount_mismatch", "block": blk,
+                          "seam": rec.seam, "detail":
+                          f"block {blk}: refcount {alloc.refcount[blk]} != "
+                          f"attributed holder sum {held} "
+                          f"(holders {dict(rec.holders)})"})
+
+        # hash bijection + no orphaned hashes
+        h2b = getattr(alloc, "hash_to_block", {})
+        b2h = getattr(alloc, "block_to_hash", {})
+        for h, blk in h2b.items():
+            if b2h.get(blk) != h:
+                v.append({"kind": "hash_bijection", "block": blk, "detail":
+                          f"hash_to_block[{h.hex()[:12]}]={blk} but "
+                          f"block_to_hash disagrees"})
+            if blk not in self.records:
+                v.append({"kind": "orphaned_hash", "block": blk, "detail":
+                          f"hash {h.hex()[:12]} registered on FREE block "
+                          f"{blk}"})
+        # (deliberately NO device-vs-host-store hash disjointness check: the
+        # content-addressed tier may be SHARED by several replicas, so a hash
+        # another replica spilled can legitimately coexist with this
+        # allocator's device-resident copy)
+
+        # pending readmits == host_reserved; quiescent audits see no inflight
+        pend = getattr(alloc, "_pending_readmits", None)
+        if pend is not None:
+            pend_blocks = {blk for blk, _h, _hb in pend}
+            if pend_blocks != by_state[HOST_RESERVED]:
+                v.append({"kind": "pending_mismatch", "detail":
+                          f"pending readmit queue {sorted(pend_blocks)[:8]} "
+                          f"!= ledger host_reserved "
+                          f"{sorted(by_state[HOST_RESERVED])[:8]}"})
+        if check_inflight and by_state[READMIT_INFLIGHT]:
+            v.append({"kind": "inflight_stuck", "detail":
+                      f"{len(by_state[READMIT_INFLIGHT])} readmit(s) taken "
+                      f"but never committed: "
+                      f"{sorted(by_state[READMIT_INFLIGHT])[:8]}"})
+
+        # per-request attribution vs the owner's roster
+        leaked: List[int] = []
+        if expected_holders is not None:
+            ledger_by_rid: Dict[int, Dict[int, int]] = {}
+            for blk, rec in self.records.items():
+                for rid, cnt in rec.holders.items():
+                    if cnt:
+                        ledger_by_rid.setdefault(rid, {})[blk] = cnt
+            for rid, held in sorted(
+                    ledger_by_rid.items(),
+                    key=lambda kv: (kv[0] is None, kv[0])):
+                exp = expected_holders.get(rid)
+                if exp is None:
+                    blocks = sorted(held)
+                    leaked.extend(blocks)
+                    seams = sorted({self.records[b].seam for b in blocks})
+                    age = max(self._now() - self.records[b].since
+                              for b in blocks)
+                    v.append({"kind": "leak", "request_id": rid,
+                              "blocks": blocks[:16], "seam": ",".join(seams),
+                              "detail":
+                              f"{sum(held.values())} block(s) held by "
+                              f"request {rid} which no longer exists "
+                              f"(last seam(s): {seams}, oldest "
+                              f"{age:.3f}s)"})
+                elif held != exp:
+                    v.append({"kind": "holder_mismatch", "request_id": rid,
+                              "detail":
+                              f"request {rid} ledger holdings "
+                              f"{sorted(held)[:8]}... != roster "
+                              f"{sorted(exp)[:8]}..."})
+            for rid, exp in expected_holders.items():
+                if exp and rid not in ledger_by_rid:
+                    v.append({"kind": "holder_mismatch", "request_id": rid,
+                              "detail": f"request {rid} holds "
+                              f"{len(exp)} block(s) per the roster but none "
+                              f"per the ledger"})
+
+        fresh_leaks = [b for b in leaked if b not in self._known_leaked]
+        self._known_leaked.update(leaked)
+        report = {
+            "ok": not v,
+            "violations": v,
+            "counts": {s: len(by_state[s]) for s in STATES},
+            "num_blocks": self.num_blocks,
+            "leaked_blocks": len(leaked),
+        }
+        if v:
+            # count + log each DISTINCT violation once, not once per audit:
+            # a single unfixed leak would otherwise inflate the counter and
+            # repeat the same ERROR line at every scrape/stats/drain audit
+            # (the signature uses the stable fields — ages in the detail
+            # text change every audit)
+            fresh = [x for x in v if self._violation_sig(x)
+                     not in self._seen_violation_sigs]
+            self._seen_violation_sigs.update(
+                self._violation_sig(x) for x in fresh)
+            if fresh and self._c_violations is not None:
+                self._c_violations.inc(len(fresh))
+            if fresh_leaks and self._c_leaked is not None:
+                self._c_leaked.inc(len(fresh_leaks))
+            if raise_on_violation:
+                raise MemLedgerViolation(report)
+            if fresh:
+                logger.error("memledger_violation %s", json.dumps(
+                    {"replica": self.replica, "violations": fresh[:8],
+                     "counts": report["counts"],
+                     "leaked_blocks": report["leaked_blocks"]}, default=str))
+        else:
+            # a clean audit re-arms the dedup: a violation that recurs
+            # after being fixed logs again
+            self._seen_violation_sigs.clear()
+        return report
+
+    @staticmethod
+    def _violation_sig(v: dict) -> tuple:
+        """Stable identity of one violation across repeated audits (the
+        ``detail`` text carries ages/counts that change every audit)."""
+        return (v.get("kind"), v.get("request_id"), v.get("block"),
+                tuple(v.get("blocks", ())), v.get("seam"))
+
+    # ------------------------------------------------------------------ views
+    def holders_by_request(self) -> Dict[int, int]:
+        """{request_id: blocks held} over every refcounted block (shared
+        blocks count once per holder — attribution, not conservation)."""
+        out: Dict[int, int] = {}
+        for rec in self.records.values():
+            for rid, cnt in rec.holders.items():
+                if cnt and rid is not None:
+                    out[rid] = out.get(rid, 0) + cnt
+        return out
+
+    def idle_ages(self) -> np.ndarray:
+        now = self._now()
+        return np.asarray(sorted(
+            now - rec.since for rec in self.records.values()
+            if rec.state == IDLE), dtype=np.float64)
+
+    def snapshot(self, top: int = 8) -> dict:
+        """Point-in-time forensics view: owner-state counts, the top holders
+        (request id, blocks, bytes, age, class, last seam), idle-age
+        quantiles, host-tier occupancy. What the OOM path and the debug
+        bundles capture."""
+        now = self._now()
+        counts = {s: 0 for s in STATES}
+        per_rid: Dict[int, dict] = {}
+        for blk, rec in self.records.items():
+            counts[rec.state] += 1
+            for rid, cnt in rec.holders.items():
+                if not cnt or rid is None:
+                    continue
+                e = per_rid.setdefault(rid, {"blocks": 0, "age_s": 0.0,
+                                             "seam": rec.seam,
+                                             "_seam_t": rec.since})
+                e["blocks"] += cnt
+                e["age_s"] = max(e["age_s"], now - rec.since)
+                if rec.since >= e["_seam_t"]:
+                    # last_seam = the holder's LATEST transition, not
+                    # whichever block happens to iterate last
+                    e["_seam_t"] = rec.since
+                    e["seam"] = rec.seam
+        counts[FREE] = self.num_blocks - len(self.records)
+        holders = [
+            {"request_id": rid, "blocks": e["blocks"],
+             "bytes": e["blocks"] * self.bytes_per_block,
+             "age_s": round(e["age_s"], 3),
+             "sla_class": self.request_class.get(rid),
+             "last_seam": e["seam"]}
+            for rid, e in sorted(per_rid.items(),
+                                 key=lambda kv: -kv[1]["blocks"])]
+        ages = self.idle_ages()
+        out = {
+            "states": counts,
+            "num_blocks": self.num_blocks,
+            "bytes_per_block": self.bytes_per_block,
+            "top_holders": holders[:top],
+            "holder_count": len(holders),
+            "idle_age_s": {
+                "count": int(ages.size),
+                "p50": round(float(np.percentile(ages, 50)), 3)
+                if ages.size else None,
+                "p90": round(float(np.percentile(ages, 90)), 3)
+                if ages.size else None,
+                "max": round(float(ages[-1]), 3) if ages.size else None,
+            },
+        }
+        if self.tier is not None:
+            ts = self.tier.stats()
+            out["host_tier"] = ts
+        by_class: Dict[str, int] = {}
+        for rid, e in per_rid.items():
+            cls = self.request_class.get(rid)
+            if cls:
+                by_class[cls] = by_class.get(cls, 0) + e["blocks"]
+        if by_class:
+            out["by_class"] = {
+                cls: {"blocks": n, "bytes": n * self.bytes_per_block}
+                for cls, n in sorted(by_class.items())}
+        return out
+
+    def timeline(self, request_id: int) -> List[dict]:
+        """The request's bounded holdings timeline (allocate / grow /
+        release / preempt / resume hand-off events)."""
+        return list(self.request_log.get(request_id, ()))
+
+    # ------------------------------------------------------------------ OOM
+    def note_exhaustion(self, seam: str, exc=None) -> None:
+        """Capture the forensics snapshot at a ``KVBlocksExhausted`` raise:
+        stashed as ``last_oom`` (stats / debug bundles read it), attached to
+        the exception (``exc.ledger_snapshot``), counted, and emitted as one
+        structured ``memledger_oom {json}`` line naming the top holders.
+
+        Rate-limited: a storm of exhaustion raises (sustained pressure)
+        counts every event but rebuilds the O(num_blocks) snapshot — and
+        logs — at most once per second; in-between raises reuse the last
+        snapshot (its holders are still what the pool looked like when the
+        storm began)."""
+        if self._c_oom is not None:
+            self._c_oom.inc()
+        now = self._now()
+        if self.last_oom is not None and now - self._last_oom_t < 1.0:
+            if exc is not None:
+                exc.ledger_snapshot = self.last_oom
+            return
+        self._last_oom_t = now
+        snap = self.snapshot()
+        snap["seam"] = seam
+        snap["ts_unix"] = time.time()
+        self.last_oom = snap
+        if exc is not None:
+            exc.ledger_snapshot = snap
+        logger.warning("memledger_oom %s", json.dumps(
+            {"replica": self.replica, "seam": seam,
+             "states": snap["states"],
+             "top_holders": snap["top_holders"][:4]}, default=str))
+
+    # ------------------------------------------------------------------ export
+    def export_gauges(self, fragmentation: Optional[float] = None) -> None:
+        """Refresh the ledger's gauges on the owning registry:
+        ``serving_kv_blocks{state=}``, idle-age quantiles, host-tier
+        occupancy/watermark, per-class byte attribution."""
+        reg = self._registry
+        if reg is None:
+            return
+        snap_states = {s: 0 for s in STATES}
+        for rec in self.records.values():
+            snap_states[rec.state] += 1
+        snap_states[FREE] = self.num_blocks - len(self.records)
+        for state, n in snap_states.items():
+            reg.gauge("serving_kv_blocks",
+                      "physical KV blocks by ledger owner state",
+                      labels={"state": state}).set(n)
+        ages = self.idle_ages()
+        for q, label in ((50, "0.5"), (90, "0.9"), (100, "1.0")):
+            val = float(np.percentile(ages, q)) if ages.size else 0.0
+            reg.gauge("serving_kv_idle_age_seconds",
+                      "age distribution of idle-pool blocks "
+                      "(summary quantiles)",
+                      labels={"quantile": label}).set(val)
+        if fragmentation is not None:
+            reg.gauge("serving_kv_fragmentation_ratio",
+                      "allocated-but-unwritten slot fraction over live "
+                      "blocks (internal fragmentation)").set(fragmentation)
+        if self.tier is not None:
+            ts = self.tier.stats()
+            reg.gauge("serving_kv_host_tier_blocks",
+                      "host-RAM KV tier occupancy").set(ts["host_blocks"])
+            reg.gauge("serving_kv_host_tier_capacity",
+                      "host-RAM KV tier capacity").set(ts["capacity_blocks"])
+            reg.gauge("serving_kv_host_tier_watermark",
+                      "peak host-RAM KV tier occupancy"
+                      ).set(ts.get("watermark", 0))
+        by_class: Dict[str, int] = {}
+        for rec in self.records.values():
+            for rid, cnt in rec.holders.items():
+                cls = self.request_class.get(rid)
+                if cls and cnt:
+                    by_class[cls] = by_class.get(cls, 0) + cnt
+        for cls, n in by_class.items():
+            reg.gauge("serving_kv_bytes",
+                      "KV bytes attributed to live requests by SLA class",
+                      labels={"sla_class": cls}
+                      ).set(n * self.bytes_per_block)
+
+
+# ---------------------------------------------------------------------------
+# runner registry (the autouse conservation fixture walks this) + guarded
+# embed helpers (a ledger failure must never mask the fault being dumped)
+# ---------------------------------------------------------------------------
+
+_LIVE_RUNNERS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def note_runner(runner) -> None:
+    """Register a ledgered runner for the test suite's autouse conservation
+    fixture (weak — the registry never extends a runner's lifetime)."""
+    _LIVE_RUNNERS.add(runner)
+
+
+def live_runners() -> list:
+    return [r for r in _LIVE_RUNNERS if getattr(r, "ledger", None) is not None]
+
+
+def snapshot_safe(runner) -> Optional[dict]:
+    """Guarded ledger snapshot for bundle enrichment: None when the runner
+    has no ledger; an ``{"error": ...}`` entry — never a raise — when the
+    snapshot itself fails (the fault being dumped stays the headline)."""
+    try:
+        led = getattr(runner, "ledger", None)
+        if led is None:
+            return None
+        snap = led.snapshot()
+        if led.last_oom is not None:
+            snap["last_oom"] = led.last_oom
+        # the top holders' bounded holdings timelines (allocate / grow /
+        # preempt / resume hand-offs) — the per-request forensics view
+        snap["timelines"] = {
+            h["request_id"]: led.timeline(h["request_id"])
+            for h in snap.get("top_holders", ())}
+        return snap
+    # lint: ok(silent-except): guarded bundle enrichment — the error STRING is the visible degradation; a raise here would mask the fault being dumped
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
+def timeline_safe(runner, request_id: int) -> Optional[list]:
+    """Guarded per-request holdings timeline (same contract as
+    :func:`snapshot_safe`)."""
+    try:
+        led = getattr(runner, "ledger", None)
+        if led is None:
+            return None
+        return led.timeline(request_id)
+    # lint: ok(silent-except): guarded bundle enrichment — the error record is the visible degradation; a raise here would mask the fault being dumped
+    except Exception as e:
+        return [{"error": f"{type(e).__name__}: {e}"}]

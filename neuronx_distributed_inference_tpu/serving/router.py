@@ -942,8 +942,10 @@ class PrefixAffinityRouter:
         try:
             # the span trees of everything in flight on the dead replica at
             # dump time: the post-mortem shows WHERE each stream was, not
-            # just that streams existed (serving/tracing.py)
-            from . import tracing
+            # just that streams existed (serving/tracing.py); the KV block
+            # ledger snapshot names WHO holds the dead pool (memledger —
+            # guarded the same way: a ledger failure never masks the fault)
+            from . import memledger, tracing
 
             out = flight.dump_bundle(
                 path, metrics=rep.registry.to_dict(), stats=None,
@@ -951,7 +953,8 @@ class PrefixAffinityRouter:
                 spans=tracing.inflight_span_trees_safe(rep.runner.telemetry),
                 extra={"replica": rid, "exception": repr(exc),
                        "router_step": self._step_count,
-                       "fail_streak": self._fail_streak[rid]})
+                       "fail_streak": self._fail_streak[rid],
+                       "memory": memledger.snapshot_safe(rep.runner)})
             logger.warning("replica %s FAILED debug bundle: %s", rid, out)
             return out
         except Exception as e:
@@ -1062,6 +1065,16 @@ class PrefixAffinityRouter:
             self._trace_event("migrate_out", req, from_replica=replica_id,
                               tokens_so_far=len(req.generated))
         self._g_queue.set(len(self.queue))
+        # migration audit point (serving/memledger.py): the drained pool
+        # must balance before its streams re-place elsewhere — violations
+        # log memledger_violation + count, never block the migration
+        aud = getattr(rep.runner, "audit_ledger", None)
+        if aud is not None:
+            try:
+                aud()
+            except Exception as e:   # lint: ok(silent-except): the audit is observability; a broken ledger must not fail a healthy drain (logged below)
+                logger.warning("post-drain ledger audit failed on replica "
+                               "%s: %s", replica_id, e)
         logger.info("drained replica %s: %d requests re-queued for migration",
                     replica_id, migrated)
         return migrated
@@ -1117,9 +1130,15 @@ class PrefixAffinityRouter:
         try:
             tier = rep.runner.kv_tier
             if tier is not None:
+                led = getattr(rep.runner, "ledger", None)
                 for _blk, h, host_blk in \
                         rep.runner.allocator.take_pending_readmits():
                     tier.restore(h, host_blk)
+                    if led is not None:
+                        # the dead replica's device block stays with its
+                        # ghost holder; the reservation is accounted for —
+                        # not a stuck in-flight readmit
+                        led.readmit_written_off(_blk)
                     restored += 1
         except Exception as e:
             # the dead replica's host state may itself be corrupt; its

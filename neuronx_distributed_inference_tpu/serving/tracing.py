@@ -216,7 +216,8 @@ def build_trace_set(source: dict,
         for e in evs:
             t = e["ts"] + epoch
             if e["event"] == "preempted":
-                tb.add("preempt", "preempt", t, t, root)
+                tb.add("preempt", "preempt", t, t, root,
+                       blocks_held=e.get("blocks_held"))
             elif e["event"] == "prefix_hit":
                 tb.add("prefix_hit", "prefix_hit", t, t, root,
                        tokens=e.get("tokens"))

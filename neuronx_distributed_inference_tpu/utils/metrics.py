@@ -521,10 +521,17 @@ class ServingTelemetry:
         if r is not None:
             r["prefill_tokens"] += tokens
 
-    def request_preempted(self, rid: int) -> None:
+    def request_preempted(self, rid: int,
+                          blocks_held: Optional[int] = None) -> None:
+        """``blocks_held``: KV blocks the request held AT the preemption
+        point (the block ledger's holdings-at-handoff attribution) — rides
+        the event stream so offline trace readers (explain_request.py) see
+        the hand-off's memory footprint without the live ledger."""
         if not self.enabled:
             return
-        self._event("preempted", rid)
+        self._event("preempted", rid,
+                    **({} if blocks_held is None
+                       else {"blocks_held": blocks_held}))
         r = self.requests.get(rid)
         if r is not None:
             r["preemptions"] += 1

@@ -89,6 +89,12 @@ _FILE_SCOPES = {
     # re-audits the full CB fleet (cb_mixed included) on any edit.
     "serving/sla.py": [],
     "serving/autoscaler.py": [],
+    # ISSUE-15 KV block ledger: host-side bookkeeping over allocator seams
+    # (instance-level wrappers, the fault-injector idiom) — audits the
+    # allocator's dicts, never enters a graph (lint-only). The runner-side
+    # integration lives in continuous_batching.py, whose row above already
+    # re-audits the full CB fleet on any edit.
+    "serving/memledger.py": [],
     # ISSUE-14 roofline model + provenance: offline analysis over the
     # ALREADY-captured dispatch examples and compiled cost analysis (the
     # model lowers AOT, it never traces a new dispatch), and the provenance

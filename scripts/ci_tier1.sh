@@ -103,4 +103,17 @@ if [ "${PERF_MODEL_TIER1_TESTS:-0}" -lt 1 ]; then
     echo "ERROR: roofline perf-model tests are not in the tier-1 marker set" >&2
     [ "$rc" -eq 0 ] && rc=1
 fi
+
+# ISSUE-15 unchanged-semantics guard: the KV block-ledger suite (owner-state
+# conservation, leak detection/attribution, OOM forensics, the autouse
+# teardown audit) must stay collected inside the tier-1 marker set.
+MEMLEDGER_TIER1_TESTS=$(env JAX_PLATFORMS=cpu python -m pytest \
+    "$REPO/tests/test_memledger.py" \
+    -q -m 'not slow' --collect-only -p no:cacheprovider 2>/dev/null \
+    | grep -ac '::' || true)
+echo "MEMLEDGER_TIER1_TESTS=$MEMLEDGER_TIER1_TESTS"
+if [ "${MEMLEDGER_TIER1_TESTS:-0}" -lt 1 ]; then
+    echo "ERROR: KV block-ledger tests are not in the tier-1 marker set" >&2
+    [ "$rc" -eq 0 ] && rc=1
+fi
 exit "$rc"

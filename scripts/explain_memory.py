@@ -1,0 +1,164 @@
+#!/usr/bin/env python
+"""OOM-and-leak explainer: render a KV block ledger snapshot — owner-state
+breakdown, top holders with per-request byte attribution, idle-pool ages,
+fragmentation, host-tier occupancy, holdings timelines, and the last OOM
+forensics record — from the artifacts the serving stack already writes
+(serving/memledger.py is the live side; this is the offline reader).
+
+Inputs, auto-detected by shape:
+
+    # a flight-recorder debug bundle (utils/flight_recorder.py) — the
+    # ledger snapshot rides in stats()["memory"] (runner-dumped bundles)
+    # or extra["memory"] (the router's on-FAILED bundle)
+    python scripts/explain_memory.py replica-0-failed.json
+
+    # a raw runner.stats() snapshot saved as JSON
+    python scripts/explain_memory.py stats.json
+
+Exit codes are the integrity contract: 0 = the ledger balances (no
+violations, no leaked blocks), 1 = the snapshot records violations or
+leaked blocks, 2 = no ledger snapshot found / malformed input. A closed
+stdout pipe exits 141, never 1."""
+
+import argparse
+import json
+import os
+import sys
+
+
+def _find_memory(doc: dict):
+    """Locate the ledger snapshot in a bundle or a stats dict."""
+    if not isinstance(doc, dict):
+        return None
+    if "states" in doc and "num_blocks" in doc:
+        return doc                                     # the snapshot itself
+    for path in (("memory",), ("stats", "memory"), ("extra", "memory")):
+        node = doc
+        for key in path:
+            node = node.get(key) if isinstance(node, dict) else None
+        if isinstance(node, dict) and "states" in node:
+            return node
+    return None
+
+
+def _fmt_bytes(n) -> str:
+    n = float(n or 0)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if n < 1024 or unit == "GiB":
+            return f"{n:.1f} {unit}"
+        n /= 1024
+    return f"{n:.1f} GiB"
+
+
+def _print_states(mem: dict) -> None:
+    total = mem.get("num_blocks", 0)
+    print(f"pool: {total} blocks x {_fmt_bytes(mem.get('bytes_per_block'))}"
+          f"/block")
+    for state, n in (mem.get("states") or {}).items():
+        bar = "#" * (0 if not total else int(round(28 * n / total)))
+        print(f"  {state:<18} {n:>8}  {bar}")
+
+
+def _print_holders(mem: dict, top: int) -> None:
+    holders = mem.get("top_holders") or []
+    if not holders:
+        print("  (no live holders)")
+        return
+    print(f"top holders ({mem.get('holder_count', len(holders))} total):")
+    for h in holders[:top]:
+        cls = f" class={h['sla_class']}" if h.get("sla_class") else ""
+        print(f"  request {h['request_id']:<8} {h['blocks']:>6} blocks  "
+              f"{_fmt_bytes(h.get('bytes')):>10}  age {h.get('age_s', 0):>8}s"
+              f"  seam={h.get('last_seam')}{cls}")
+
+
+def _print_timeline(rid, events) -> None:
+    print(f"  request {rid}:")
+    for e in events or []:
+        extra = {k: v for k, v in e.items() if k not in ("t", "event")}
+        print(f"    t={e.get('t', 0):>10.3f}s {e.get('event'):<16} {extra}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="debug bundle or stats JSON")
+    ap.add_argument("--top", type=int, default=8,
+                    help="holders to show (default 8)")
+    ap.add_argument("--timelines", action="store_true",
+                    help="also print the holdings timelines the snapshot "
+                         "carries")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the located snapshot as JSON instead of text")
+    args = ap.parse_args(argv)
+
+    try:
+        with open(args.path) as fh:
+            doc = json.load(fh)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"cannot read {args.path}: {e}", file=sys.stderr)
+        return 2
+    mem = _find_memory(doc)
+    if mem is None:
+        print(f"no KV block ledger snapshot in {args.path} (is this a "
+              f"debug bundle or runner.stats() dump from a ledgered "
+              f"runner?)", file=sys.stderr)
+        return 2
+    if "error" in mem and "states" not in mem:
+        print(f"ledger snapshot is an error record: {mem['error']}",
+              file=sys.stderr)
+        return 2
+
+    if args.as_json:
+        print(json.dumps(mem, indent=1, default=str))
+    else:
+        _print_states(mem)
+        if mem.get("fragmentation_ratio") is not None:
+            print(f"fragmentation_ratio: {mem['fragmentation_ratio']}")
+        ages = mem.get("idle_age_s") or {}
+        if ages.get("count"):
+            print(f"idle ages: n={ages['count']} p50={ages.get('p50')}s "
+                  f"p90={ages.get('p90')}s max={ages.get('max')}s")
+        tier = mem.get("host_tier")
+        if tier:
+            print(f"host tier: {tier.get('host_blocks')}/"
+                  f"{tier.get('capacity_blocks')} blocks "
+                  f"(watermark {tier.get('watermark')}, "
+                  f"evictions {tier.get('evictions')}, "
+                  f"readmits {tier.get('readmit_blocks')})")
+        _print_holders(mem, args.top)
+        by_class = mem.get("by_class")
+        if by_class:
+            print("by SLA class:")
+            for cls, e in by_class.items():
+                print(f"  {cls:<12} {e['blocks']:>6} blocks  "
+                      f"{_fmt_bytes(e.get('bytes'))}")
+        if args.timelines and mem.get("timelines"):
+            print("holdings timelines:")
+            for rid, events in mem["timelines"].items():
+                _print_timeline(rid, events)
+        oom = mem.get("last_oom")
+        if oom:
+            print(f"\nLAST OOM (seam={oom.get('seam')}, "
+                  f"unix={oom.get('ts_unix')}):")
+            _print_states(oom)
+            _print_holders(oom, args.top)
+
+    audit = mem.get("audit") or {}
+    leaked = mem.get("leaked_blocks", audit.get("leaked_blocks", 0)) or 0
+    violations = audit.get("violations", 0) or 0
+    if violations or leaked:
+        print(f"\nLEDGER OUT OF BALANCE: {violations} violation(s), "
+              f"{leaked} leaked block(s)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        rc = main()
+    except BrokenPipeError:
+        # the exit code is this tool's integrity contract: a closed pipe
+        # (| head) must not read as a ledger violation — 128+SIGPIPE
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        rc = 141
+    sys.exit(rc)

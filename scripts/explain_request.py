@@ -72,7 +72,8 @@ def _print_tree(spans, indent: str = "  ") -> None:
             attrs = {k: v for k, v in s["attrs"].items()
                      if k in ("replica", "migrated_from", "recovered_from",
                               "tokens", "slot", "step_kind", "finish_reason",
-                              "from_replica", "resumed_tokens")}
+                              "from_replica", "resumed_tokens",
+                              "blocks_held")}
             extra = f"  {attrs}" if attrs else ""
             print(f"{indent}{'  ' * depth}{s['name']:<24} {dur}{extra}")
             rec(s["id"], depth + 1)

@@ -21,6 +21,32 @@ def _seed():
     np.random.seed(0)
 
 
+@pytest.fixture(autouse=True)
+def _memledger_conservation(request):
+    """Leak regression net (serving/memledger.py): at teardown of EVERY test,
+    the KV block ledger of every ledgered runner the test created must
+    balance — free + live + idle + host_reserved + readmit_inflight ==
+    num_blocks, holder attribution matching the runner's roster, refcounts
+    matching holder sums. A dropped release anywhere in the serving/CB
+    suites fails HERE even if the test's own assertions never looked.
+
+    Deliberate-fault tests (the injected ``leak`` kind) opt out with
+    ``@pytest.mark.memledger_exempt``."""
+    from neuronx_distributed_inference_tpu.serving import memledger
+
+    yield
+    # each runner is audited once, at the teardown of the test that saw it
+    # live — then dropped from the net (a deliberately-corrupted ledger from
+    # an exempt test must not fail an innocent later test)
+    runners = memledger.live_runners()
+    for runner in runners:
+        memledger._LIVE_RUNNERS.discard(runner)
+    if request.node.get_closest_marker("memledger_exempt"):
+        return
+    for runner in runners:
+        runner.audit_ledger(raise_on_violation=True)
+
+
 @pytest.fixture(scope="session")
 def tiny_llama_hf_config():
     """Tiny Llama architecture for fast CPU tests (≈ the reference's truncated

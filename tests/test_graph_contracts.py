@@ -386,6 +386,10 @@ def test_changed_mode_scope_map_fails_closed():
     # continuous_batching.py, whose map re-audits the full CB fleet
     assert mod._scopes_for_changes([pkg + "serving/sla.py"]) == []
     assert mod._scopes_for_changes([pkg + "serving/autoscaler.py"]) == []
+    # ISSUE-15: the KV block ledger is host-side bookkeeping over allocator
+    # seams — lint-only; the runner integration rides the
+    # continuous_batching.py row (full CB fleet)
+    assert mod._scopes_for_changes([pkg + "serving/memledger.py"]) == []
     # ISSUE-14: the roofline model reads captured examples + AOT cost
     # analysis and provenance probes the host — neither enters a graph
     # (lint-only); any OTHER new analysis/ module still fails closed

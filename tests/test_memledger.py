@@ -1,0 +1,468 @@
+"""Accountable KV memory (ISSUE-15, serving/memledger.py): the block
+ledger's owner-state machine, the conservation auditor, leak detection with
+exact request/seam attribution, OOM forensics, byte attribution by request
+and SLA class, and the offline explainer.
+
+The autouse conftest fixture additionally audits every ledgered runner at
+teardown of EVERY test in the suite — the tests here pin the machinery that
+net depends on."""
+
+import json
+import logging
+
+import numpy as np
+import pytest
+
+from neuronx_distributed_inference_tpu.config import (
+    TpuConfig, load_pretrained_config)
+from neuronx_distributed_inference_tpu.models.llama.modeling_llama import (
+    LlamaForCausalLM, LlamaInferenceConfig)
+from neuronx_distributed_inference_tpu.modules.block_kvcache import (
+    BlockAllocator, KVBlocksExhausted)
+from neuronx_distributed_inference_tpu.runtime.continuous_batching import (
+    ContinuousBatchingRunner)
+from neuronx_distributed_inference_tpu.serving import (
+    EngineReplica, FaultInjector, HostKVTier, PrefixAffinityRouter)
+from neuronx_distributed_inference_tpu.serving.kv_tiering import (
+    TieredBlockAllocator)
+from neuronx_distributed_inference_tpu.serving import memledger
+from neuronx_distributed_inference_tpu.serving.memledger import (
+    BlockLedger, MemLedgerViolation)
+
+BS = 8   # pa_block_size everywhere here
+
+
+def _make_app(hf_cfg, slots=2, blocks=48, seq_len=96):
+    tpu_cfg = TpuConfig(
+        batch_size=slots, seq_len=seq_len, max_context_length=32,
+        dtype="float32", context_encoding_buckets=[16, 32],
+        token_generation_buckets=[48, 96], is_continuous_batching=True,
+        paged_attention_enabled=True, pa_num_blocks=blocks, pa_block_size=BS)
+    config = LlamaInferenceConfig(tpu_cfg,
+                                  load_config=load_pretrained_config(hf_cfg))
+    app = LlamaForCausalLM(None, config)
+    app.load_random(seed=0)
+    return app
+
+
+@pytest.fixture(scope="module")
+def app(tiny_llama_hf_config):
+    return _make_app(tiny_llama_hf_config)
+
+
+def _prefix_prompts(seed=3, prefix_blocks=2, bs=BS):
+    rng = np.random.default_rng(seed)
+    prefix = rng.integers(1, 256, size=(prefix_blocks * bs,)).astype(np.int32)
+    tail_a = rng.integers(1, 256, size=(4,)).astype(np.int32)
+    tail_b = rng.integers(1, 256, size=(5,)).astype(np.int32)
+    return (np.concatenate([prefix, tail_a]),
+            np.concatenate([prefix, tail_b]))
+
+
+class _FakeReader:
+    def __call__(self, ids):
+        n = len(ids)
+        k = np.zeros((1, n, 1, 1, 1), np.float32)
+        return k, k.copy()
+
+
+# ----------------------------------------------------------- allocator level
+def test_base_allocator_conservation_and_shared_attribution():
+    alloc = BlockAllocator(8, 4, enable_prefix_caching=True)
+    led = BlockLedger(alloc)
+    toks = np.arange(8)                                  # 2 full blocks
+    with led.context(request_id=1, seam="place"):
+        b1, _ = alloc.allocate_for_prompt(toks)
+    with led.context(request_id=2, seam="place"):
+        b2, cached = alloc.allocate_for_prompt(toks)     # shares the prefix
+    assert cached == 8 and b2[:2] == b1[:2]
+    rep = led.audit(expected_holders={
+        1: {b: 1 for b in b1}, 2: {b: 1 for b in b2}},
+        raise_on_violation=True)
+    assert rep["ok"]
+    assert rep["counts"]["live"] == len(set(b1) | set(b2))
+    # per-block holder sums equal the refcounts (shared prefix = 2 holders)
+    assert led.holders_by_request() == {1: len(b1), 2: len(b2)}
+    with led.context(request_id=1, seam="finish"):
+        alloc.free_sequence(b1)
+    with led.context(request_id=2, seam="finish"):
+        alloc.free_sequence(b2)
+    rep = led.audit(expected_holders={}, raise_on_violation=True)
+    assert rep["counts"]["free"] == 8 and rep["leaked_blocks"] == 0
+
+
+def test_extend_and_rollback_stay_balanced():
+    alloc = BlockAllocator(4, 4)
+    led = BlockLedger(alloc)
+    with led.context(request_id=5, seam="place"):
+        blocks, _ = alloc.allocate_for_prompt(np.arange(4))
+    with led.context(request_id=5, seam="grow"):
+        alloc.extend(blocks, 12)
+    led.audit(expected_holders={5: {b: 1 for b in blocks}},
+              raise_on_violation=True)
+    # exhaustion rolls back the appended blocks AND their ledger records
+    with led.context(request_id=5, seam="grow"):
+        with pytest.raises(KVBlocksExhausted):
+            alloc.extend(blocks, 100)
+    led.audit(expected_holders={5: {b: 1 for b in blocks}},
+              raise_on_violation=True)
+    with led.context(request_id=5, seam="finish"):
+        alloc.free_sequence(blocks)
+    assert led.audit(expected_holders={})["ok"]
+
+
+def test_dropped_release_is_a_leak_attributed_to_request_and_seam(caplog):
+    alloc = BlockAllocator(8, 4)
+    led = BlockLedger(alloc)
+    with led.context(request_id=9, seam="place"):
+        blocks, _ = alloc.allocate_for_prompt(np.arange(4))
+    # drop ONE release at the seam — exactly what the `leak` fault injects
+    real = alloc._release_one
+    dropped = {"n": 1}
+
+    def _leaky(blk):
+        if dropped["n"]:
+            dropped["n"] -= 1
+            return
+        real(blk)
+
+    alloc._release_one = _leaky
+    with led.context(request_id=9, seam="finish"):
+        alloc.free_sequence(blocks)
+    with caplog.at_level(logging.ERROR, logger="tpu-inference"):
+        rep = led.audit(expected_holders={})
+    assert not rep["ok"] and rep["leaked_blocks"] == 1
+    leak = next(v for v in rep["violations"] if v["kind"] == "leak")
+    assert leak["request_id"] == 9 and leak["blocks"] == [blocks[0]]
+    assert "place" in leak["seam"]          # the seam that last touched it
+    # serving mode: ONE structured line + counters, never a raise
+    assert any("memledger_violation" in r.message for r in caplog.records)
+    with pytest.raises(MemLedgerViolation):
+        led.audit(expected_holders={}, raise_on_violation=True)
+
+
+def test_tiered_states_idle_reserved_inflight():
+    tier = HostKVTier(capacity_blocks=8)
+    alloc = TieredBlockAllocator(8, 4, tier)
+    alloc.read_blocks = _FakeReader()
+    led = BlockLedger(alloc, tier=tier)
+    toks = np.arange(8)
+    with led.context(request_id=1, seam="place"):
+        blocks, _ = alloc.allocate_for_prompt(toks)
+    with led.context(request_id=1, seam="finish"):
+        alloc.free_sequence(blocks)
+    rep = led.audit(expected_holders={}, raise_on_violation=True)
+    assert rep["counts"]["idle"] == 2       # hashed full blocks park idle
+    # spill to host: idle -> free, entries content-addressed in the store
+    assert alloc.spill_idle() == 2
+    rep = led.audit(expected_holders={}, raise_on_violation=True)
+    assert rep["counts"]["idle"] == 0 and rep["counts"]["free"] == 8
+    assert tier.host_blocks() == 2 and tier.watermark == 2
+    # tier hit: fresh device blocks allocated, bytes reserved host-side
+    with led.context(request_id=2, seam="place"):
+        b2, cached = alloc.allocate_for_prompt(toks)
+    assert cached == 8
+    rep = led.audit(expected_holders={2: {b: 1 for b in b2}},
+                    raise_on_violation=True)
+    assert rep["counts"]["host_reserved"] == 2
+    # the runner takes the queue -> readmit_inflight; a quiescent audit
+    # must refuse a stuck in-flight readmit, and commit clears it
+    pending = alloc.take_pending_readmits()
+    rep = led.audit(expected_holders={2: {b: 1 for b in b2}})
+    assert any(v["kind"] == "inflight_stuck" for v in rep["violations"])
+    led.readmit_committed([blk for blk, _h, _hb in pending])
+    rep = led.audit(expected_holders={2: {b: 1 for b in b2}},
+                    raise_on_violation=True)
+    assert rep["counts"]["live"] == len(b2)
+    with led.context(request_id=2, seam="finish"):
+        alloc.free_sequence(b2)
+    led.audit(expected_holders={}, raise_on_violation=True)
+
+
+# --------------------------------------------------------------- runner level
+def test_runner_round_trips_conserve(app):
+    """Conservation holds bit-for-bit across serve -> idle -> spill ->
+    readmit -> preempt -> resume round trips (and the autouse fixture
+    re-audits at teardown)."""
+    tier = HostKVTier(capacity_blocks=32)
+    runner = ContinuousBatchingRunner(app, decode_chunk=4, kv_tier=tier)
+    assert runner.ledger is not None
+    pa, pb = _prefix_prompts()
+    runner.submit(pa, max_new_tokens=8)
+    runner.run_to_completion()
+    rep = runner.audit_ledger(raise_on_violation=True)
+    assert rep["ok"] and rep["counts"]["idle"] == len(runner.allocator.idle)
+    # spill -> readmit
+    assert runner.spill_idle_blocks() >= 2
+    runner.audit_ledger(raise_on_violation=True)
+    runner.submit(pb, max_new_tokens=8)
+    runner.run_to_completion()
+    assert tier.readmit_blocks >= 2
+    runner.audit_ledger(raise_on_violation=True)
+    # preempt -> resume (the migration hand-off): drain mid-flight, then
+    # resubmit with resume_tokens — the drain itself audits too
+    rid = runner.submit(pa, max_new_tokens=12)
+    runner.step()
+    emitted, evicted = runner.drain_requests()
+    req = next(r for r in evicted if r.request_id == rid)
+    assert req.generated and not req.blocks     # holdings released at preempt
+    runner.audit_ledger(raise_on_violation=True)
+    runner.submit(req.prompt, max_new_tokens=12,
+                  resume_tokens=req.generated)
+    runner.run_to_completion()
+    rep = runner.audit_ledger(raise_on_violation=True)
+    assert rep["leaked_blocks"] == 0
+    # the holdings timeline recorded the hand-offs
+    tl = runner.ledger.timeline(rid)
+    assert any(e["event"] == "preempt" for e in tl)
+    assert any(e["event"] == "allocate" and e["seam"] == "place"
+               for e in tl)
+
+
+def test_memledger_param_controls_attachment(app, tiny_llama_hf_config):
+    assert ContinuousBatchingRunner(app, decode_chunk=4,
+                                    memledger=False).ledger is None
+    runner = ContinuousBatchingRunner(app, decode_chunk=4, memledger=True)
+    assert runner.ledger is not None
+    assert hasattr(runner.allocator, "_alloc_one")   # Python seams forced
+    runner.submit(np.arange(1, 20, dtype=np.int32), max_new_tokens=4)
+    runner.run_to_completion()
+    assert runner.audit_ledger(raise_on_violation=True)["ok"]
+    dense_cfg = TpuConfig(
+        batch_size=2, seq_len=96, max_context_length=32, dtype="float32",
+        context_encoding_buckets=[16, 32], token_generation_buckets=[48, 96],
+        is_continuous_batching=True)
+    dense = LlamaForCausalLM(None, LlamaInferenceConfig(
+        dense_cfg, load_config=load_pretrained_config(tiny_llama_hf_config)))
+    dense.load_random(seed=0)
+    with pytest.raises(ValueError, match="paged"):
+        ContinuousBatchingRunner(dense, memledger=True)
+
+
+def test_stats_memory_attribution_and_gauges(app):
+    from neuronx_distributed_inference_tpu.serving.sla import (
+        default_class_set)
+
+    runner = ContinuousBatchingRunner(app, decode_chunk=4,
+                                      kv_tier=HostKVTier(capacity_blocks=8),
+                                      sla_classes=default_class_set())
+    pa, pb = _prefix_prompts(seed=11)
+    runner.submit(pa, max_new_tokens=16, sla_class="interactive")
+    runner.submit(pb, max_new_tokens=16, sla_class="batch")
+    runner.step()                                   # both mid-flight
+    s = runner.stats()
+    mem = s["memory"]
+    assert mem["audit"]["ok"] and mem["audit"]["leaked_blocks"] == 0
+    assert sum(mem["states"].values()) == mem["num_blocks"]
+    assert mem["bytes_per_block"] > 0
+    holders = {h["request_id"]: h for h in mem["top_holders"]}
+    assert len(holders) == 2
+    assert all(h["bytes"] == h["blocks"] * mem["bytes_per_block"]
+               for h in holders.values())
+    assert {h["sla_class"] for h in holders.values()} == {"interactive",
+                                                          "batch"}
+    assert set(mem["by_class"]) == {"interactive", "batch"}
+    assert 0.0 <= mem["fragmentation_ratio"] <= 1.0
+    reg = runner.telemetry.registry
+    g = reg.get("serving_kv_blocks", labels={"state": "live"})
+    assert g is not None and g.value > 0
+    assert reg.get("serving_kv_bytes",
+                   labels={"sla_class": "interactive"}).value > 0
+    assert reg.get("serving_kv_host_tier_watermark") is not None
+    runner.run_to_completion()
+    # idle ages appear once the finished prefixes park
+    mem = runner.stats()["memory"]
+    assert mem["states"]["idle"] > 0
+    assert mem["idle_age_s"]["count"] == mem["states"]["idle"]
+    assert reg.get("serving_kv_idle_age_seconds",
+                   labels={"quantile": "0.5"}) is not None
+
+
+# ------------------------------------------------------------- fault injection
+@pytest.mark.memledger_exempt
+def test_injected_leak_detected_and_attributed(app, caplog):
+    """The end-to-end leak proof: a `leak` fault drops one release at the
+    runner's free seam; the auditor must detect it, attribute it to the
+    exact request, and count it — exempt from the teardown net because the
+    leak is the point."""
+    tier = HostKVTier(capacity_blocks=16)
+    rep = EngineReplica("0", lambda tel: ContinuousBatchingRunner(
+        app, decode_chunk=4, telemetry=tel, kv_tier=tier))
+    inj = FaultInjector("leak@0:at_step=1", seed=0)
+    inj.attach_replica(rep)
+    rid = rep.submit(np.arange(1, 20, dtype=np.int32), max_new_tokens=4)
+    while rep.has_work:
+        rep.step()
+    assert inj.fired[("leak", "0")] == 1
+    with caplog.at_level(logging.ERROR, logger="tpu-inference"):
+        report = rep.runner.audit_ledger()
+    assert not report["ok"] and report["leaked_blocks"] >= 1
+    leak = next(v for v in report["violations"] if v["kind"] == "leak")
+    assert leak["request_id"] == rid
+    assert leak["seam"]                       # names the last-touch seam
+    line = next(r.message for r in caplog.records
+                if "memledger_violation" in r.message)
+    payload = json.loads(line.split("memledger_violation ", 1)[1])
+    assert payload["leaked_blocks"] >= 1
+    reg = rep.runner.telemetry.registry
+    assert reg.get("serving_kv_leaked_blocks_total").value >= 1
+    assert reg.get("memledger_violations_total").value >= 1
+    # repeated audits do NOT re-count the same leaked blocks
+    n = reg.get("serving_kv_leaked_blocks_total").value
+    rep.runner.audit_ledger()
+    assert reg.get("serving_kv_leaked_blocks_total").value == n
+    # the scrape path audits too: the leak is visible in the exposition of
+    # a fleet that never drained (the CLI/metrics-out surface)
+    text = rep.prometheus_text()
+    assert f'serving_kv_leaked_blocks_total{{replica="0"}} {n}' in text
+    assert 'serving_kv_blocks{replica="0",state="live"}' in text
+
+
+def test_exhaustion_exception_carries_ledger_snapshot():
+    alloc = BlockAllocator(2, 4, enable_prefix_caching=True)
+    led = BlockLedger(alloc)
+    led.bytes_per_block = 64
+    with led.context(request_id=7, seam="place", sla_class="gold"):
+        blocks, _ = alloc.allocate_for_prompt(np.arange(4))
+    with pytest.raises(KVBlocksExhausted) as ei:
+        with led.context(request_id=8, seam="place"):
+            alloc.allocate_for_prompt(np.arange(12))
+    snap = ei.value.ledger_snapshot
+    assert snap is not None and snap["seam"] == "place"
+    top = snap["top_holders"]
+    assert top[0]["request_id"] == 7 and top[0]["blocks"] == 2
+    assert top[0]["sla_class"] == "gold" and top[0]["bytes"] == 128
+    assert led.last_oom is snap
+    # the rollback left the pool balanced
+    led.audit(expected_holders={7: {b: 1 for b in blocks}},
+              raise_on_violation=True)
+
+
+def test_placement_exhaustion_forensics_and_bundle(app, tmp_path):
+    """An injected placement exhaustion produces OOM forensics: last_oom in
+    stats()["memory"], top holders named, and the flight-recorder bundle
+    carries the snapshot (KVBlocksExhausted is answerable)."""
+    from neuronx_distributed_inference_tpu.utils import flight_recorder
+
+    tier = HostKVTier(capacity_blocks=16)
+    rep = EngineReplica("0", lambda tel: ContinuousBatchingRunner(
+        app, decode_chunk=4, telemetry=tel, kv_tier=tier),
+        telemetry_enabled=True)
+    inj = FaultInjector("alloc@0:at_step=2", seed=0)
+    inj.attach_replica(rep)
+    ra = rep.submit(np.arange(1, 20, dtype=np.int32), max_new_tokens=8)
+    rep.step()                       # step 1: A places cleanly
+    rep.submit(np.arange(30, 45, dtype=np.int32), max_new_tokens=8)
+    rep.step()                       # step 2: B's placement hits the fault
+    led = rep.runner.ledger
+    assert led.last_oom is not None and led.last_oom["seam"] == "place"
+    assert any(h["request_id"] == ra for h in led.last_oom["top_holders"])
+    s = rep.runner.stats()
+    assert s["memory"]["last_oom"]["seam"] == "place"
+    reg = rep.runner.telemetry.registry
+    assert reg.get("serving_kv_oom_events_total").value == 1
+    path = str(tmp_path / "bundle.json")
+    rep.runner.telemetry.flight.dump_bundle(path, stats=s, reason="test")
+    bundle = flight_recorder.load_bundle(path)
+    oom = bundle["stats"]["memory"]["last_oom"]
+    assert oom["seam"] == "place"
+    assert any(h["request_id"] == ra for h in oom["top_holders"])
+    while rep.has_work:              # serving recovers; the pool re-balances
+        rep.step()
+    rep.runner.audit_ledger(raise_on_violation=True)
+
+    # the offline explainer renders the bundle and exits 0 (balanced)
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "explain_memory", os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "scripts", "explain_memory.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.main([path]) == 0
+    assert mod.main([path, "--json", "--timelines"]) == 0
+    assert mod.main([str(tmp_path / "missing.json")]) == 2
+
+
+@pytest.mark.memledger_exempt
+def test_explain_memory_flags_out_of_balance_snapshot(app, tmp_path):
+    """A stats dump whose audit recorded leaks must exit 1 (the integrity
+    contract: an out-of-balance ledger never green-lights)."""
+    tier = HostKVTier(capacity_blocks=16)
+    rep = EngineReplica("0", lambda tel: ContinuousBatchingRunner(
+        app, decode_chunk=4, telemetry=tel, kv_tier=tier))
+    inj = FaultInjector("leak@0:at_step=1", seed=0)
+    inj.attach_replica(rep)
+    rep.submit(np.arange(1, 20, dtype=np.int32), max_new_tokens=4)
+    while rep.has_work:
+        rep.step()
+    from neuronx_distributed_inference_tpu.utils.flight_recorder import (
+        _jsonable)
+
+    path = str(tmp_path / "stats.json")
+    with open(path, "w") as fh:
+        json.dump(_jsonable(rep.runner.stats()), fh)
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "explain_memory", os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "scripts", "explain_memory.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.main([path]) == 1
+
+
+# ----------------------------------------------------------------- fleet level
+def test_drain_migrate_and_recover_stay_balanced(app):
+    """Conservation across the fleet hand-offs: drain→migrate re-places
+    streams (both ledgers balance), and death→recover writes the dead pool
+    off without corrupting the survivor's ledger."""
+    tier = HostKVTier(capacity_blocks=32)
+    reps = [EngineReplica(str(i), lambda tel, t=tier: ContinuousBatchingRunner(
+        app, decode_chunk=4, telemetry=tel, kv_tier=t)) for i in range(2)]
+    router = PrefixAffinityRouter(reps)
+    pa, pb = _prefix_prompts(seed=17)
+    ra = router.submit(pa, max_new_tokens=12)
+    rb = router.submit(pb, max_new_tokens=12)
+    router.step()
+    moved = router.drain_replica("0")        # audits replica 0 on the way out
+    router.run_to_completion()
+    assert router.requests[ra].done and router.requests[rb].done
+    for rep in reps:
+        rep.runner.audit_ledger(raise_on_violation=True)
+    assert moved >= 0 and router.stats()["finished"] == 2
+
+    # death -> journal recovery: the survivor serves the stream; the dead
+    # runner's ledger still balances against its OWN (ghost) roster
+    inj = FaultInjector("death@1:at_step=1", seed=0)
+    tier2 = HostKVTier(capacity_blocks=32)
+    reps2 = [EngineReplica(str(i),
+                           lambda tel, t=tier2: ContinuousBatchingRunner(
+        app, decode_chunk=4, telemetry=tel, kv_tier=t)) for i in range(2)]
+    router2 = PrefixAffinityRouter(reps2, fault_injector=inj,
+                                   auto_recover=True, policy="load")
+    rc = router2.submit(pa, max_new_tokens=8)
+    router2.run_to_completion()
+    assert router2.requests[rc].done
+    for rep in reps2:
+        rep.runner.audit_ledger(raise_on_violation=True)
+
+
+def test_snapshot_safe_never_raises(app):
+    runner = ContinuousBatchingRunner(app, decode_chunk=4, memledger=False)
+    assert memledger.snapshot_safe(runner) is None
+    runner2 = ContinuousBatchingRunner(app, decode_chunk=4, memledger=True)
+    snap = memledger.snapshot_safe(runner2)
+    assert snap is not None and "states" in snap and "timelines" in snap
+
+    class _Broken:
+        @property
+        def ledger(self):
+            raise RuntimeError("boom")
+
+    assert "error" in memledger.snapshot_safe(_Broken())
+    assert memledger.timeline_safe(runner, 0) is None
