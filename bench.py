@@ -531,6 +531,18 @@ def main() -> None:
             _note(f"bs=1 megastep phase failed: {e}")
         print(json.dumps(result), flush=True)
 
+    if _remaining() > 150:
+        # ISSUE-16 MoE serving: a Mixtral-arch probe through the paged CB
+        # runner — fused grouped decode kernel vs the dense all-experts
+        # fallback on the same geometry, with the trace-stat honesty gate
+        # (moe_invalid if the dense path silently served the measured leg).
+        _note("phase: MoE paged decode (grouped kernel vs dense fallback)")
+        try:
+            extra.update(_moe_paged_decode(_arg_int("--ep-degree", 1)))
+        except Exception as e:
+            _note(f"MoE phase failed: {e}")
+        print(json.dumps(result), flush=True)
+
     if not small and _remaining() > 360:
         _note("phase: paged continuous-batching serving (same config as headline)")
         # free the dense app's device buffers first: the paged serving app loads
@@ -999,6 +1011,117 @@ def _bs1_megastep_decode(k=16, warm_steps=6, measure_toks=64,
     import gc
 
     gc.collect()
+    return out
+
+
+def _moe_paged_decode(ep_degree=1, bs=8, n_chunks=4, max_new=220):
+    """ISSUE-16 MoE serving phase: a Mixtral-arch probe model (2L, 256H, 8
+    experts top-2 — MoE cost structure without swamping the phase budget)
+    served through the PAGED CB runner twice on identical geometry:
+
+    - grouped leg: the fused grouped expert kernel (ops/moe.py), and at
+      ep_degree > 1 the overlap-scheduled EP ring (parallel/overlap.py);
+    - dense leg: TPUINF_MOE_GROUPED=0 / TPUINF_EP_OVERLAP=0 — the dense
+      all-experts einsums with GSPMD combine (a fresh app per leg: the env
+      flags are read at trace time, so reusing warm executables would
+      silently measure the same graph twice).
+
+    HONESTY GUARD (r5 spec-floor pattern): the trace counters
+    (ops/moe.grouped_trace_stats) must show the fast path actually lowered
+    into the measured leg's graphs — any ``dense_decode`` tick there REFUSES
+    the keys and emits ``moe_invalid`` instead of a plausible-looking number.
+    ``ep_all_to_all_bytes_per_step`` is the ring schedule's analytic traffic
+    for THIS config (0 at ep=1 — the single-chip truth — with an explicitly
+    ``_projected``-suffixed ep=4 companion so the multichip estimate is
+    visible without masquerading as a measurement)."""
+    import gc
+    import time as _time
+
+    from neuronx_distributed_inference_tpu.config import (
+        TpuConfig, load_pretrained_config)
+    from neuronx_distributed_inference_tpu.models.mixtral import (
+        MixtralForCausalLM)
+    from neuronx_distributed_inference_tpu.ops import moe as moe_ops
+    from neuronx_distributed_inference_tpu.parallel.overlap import (
+        estimated_ep_bytes_per_step)
+    from neuronx_distributed_inference_tpu.runtime.continuous_batching import (
+        ContinuousBatchingRunner)
+
+    moe_hf = {
+        "model_type": "mixtral", "vocab_size": 1024, "hidden_size": 256,
+        "intermediate_size": 512, "num_hidden_layers": 2,
+        "num_attention_heads": 4, "num_key_value_heads": 2,
+        "num_local_experts": 8, "num_experts_per_tok": 2,
+        "max_position_embeddings": 1024, "rms_norm_eps": 1e-5,
+        "rope_theta": 10000.0, "sliding_window": None,
+        "tie_word_embeddings": False,
+    }
+    seq, block = 512, 16
+    rng = np.random.default_rng(5)
+    prompts = [rng.integers(1, 1000, size=(48,)).astype(np.int32)
+               for _ in range(bs)]
+
+    def serve(env):
+        old = {k: os.environ.get(k) for k in env}
+        os.environ.update(env)
+        try:
+            cfg = TpuConfig(
+                batch_size=bs, seq_len=seq, max_context_length=64,
+                dtype="bfloat16", ep_degree=ep_degree,
+                context_encoding_buckets=[64],
+                token_generation_buckets=[seq],
+                is_continuous_batching=True, paged_attention_enabled=True,
+                pa_num_blocks=bs * (seq // block) + 8, pa_block_size=block)
+            config = MixtralForCausalLM.get_config_cls()(
+                cfg, load_config=load_pretrained_config(moe_hf))
+            app = MixtralForCausalLM(None, config)
+            app.load_random(seed=0)
+            runner = ContinuousBatchingRunner(app, decode_chunk=16)
+            for p in prompts:
+                runner.submit(p, max_new_tokens=max_new)
+            for _ in range(3):            # place + warm the compiled chunks
+                runner.step()
+            t0 = _time.perf_counter()
+            n = 0
+            for _ in range(n_chunks):
+                n += sum(len(v) for v in runner.step().values())
+            tok_s = n / (_time.perf_counter() - t0)
+        finally:
+            for k, v in old.items():
+                os.environ.pop(k, None) if v is None else \
+                    os.environ.__setitem__(k, v)
+        runner.cache = None
+        app.params = None
+        app.kv_cache = None
+        del runner, app
+        gc.collect()
+        return tok_s
+
+    out = {"moe_probe_arch": "mixtral 2L/256H/8E top-2 probe",
+           "moe_ep_degree": ep_degree}
+    dense_tok_s = serve({"TPUINF_MOE_GROUPED": "0", "TPUINF_EP_OVERLAP": "0"})
+    out["moe_dense_decode_tok_per_s"] = round(dense_tok_s, 1)
+
+    moe_ops.reset_grouped_trace_stats()
+    tok_s = serve({})
+    stats = moe_ops.grouped_trace_stats()
+    fast = stats["grouped"] + stats["ep_ring"]
+    if stats["dense_decode"] or not fast:
+        out["moe_invalid"] = (
+            f"dense fallback served the measured grouped leg "
+            f"(trace stats {stats})")
+        _note(f"MoE phase INVALID: {out['moe_invalid']}")
+        return out
+    out["moe_decode_tok_per_s"] = round(tok_s, 1)
+    out["moe_grouped_vs_dense_ratio"] = (round(tok_s / dense_tok_s, 3)
+                                         if dense_tok_s else None)
+    out["moe_fast_path"] = "ep_ring" if stats["ep_ring"] else "grouped"
+    L, H = moe_hf["num_hidden_layers"], moe_hf["hidden_size"]
+    out["ep_all_to_all_bytes_per_step"] = estimated_ep_bytes_per_step(
+        L, H, ep_degree, bs)
+    if ep_degree == 1:
+        out["ep_all_to_all_bytes_per_step_ep4_projected"] = \
+            estimated_ep_bytes_per_step(L, H, 4, bs)
     return out
 
 

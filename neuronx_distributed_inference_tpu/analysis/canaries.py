@@ -378,6 +378,79 @@ def _group_tp_collectives() -> Tuple[List[AuditUnit], List[Rule]]:
     return units, rules
 
 
+CANARY_MOE_HF = {
+    "model_type": "mixtral", "vocab_size": 256, "hidden_size": 128,
+    "intermediate_size": 256, "num_hidden_layers": 2,
+    "num_attention_heads": 2, "num_key_value_heads": 2,
+    "max_position_embeddings": 1024, "rms_norm_eps": 1e-5,
+    "rope_theta": 10000.0, "tie_word_embeddings": False,
+    "num_local_experts": 4, "num_experts_per_tok": 2,
+    "sliding_window": None,
+}
+
+
+@functools.lru_cache(maxsize=None)
+def _moe_paged_runner(ep=2, tp=1, b=8, steps=2, tag=""):
+    """MoE (Mixtral-arch) paged CB runner at ep > 1 — the expert-dispatch
+    collective canary's fleet. Same env-variant ``tag`` keying as
+    _paged_runner; 2 layers suffice: the collective-schedule rules compare
+    multisets, not pool-dominance byte ratios."""
+    from ..config import TpuConfig, load_pretrained_config
+    from ..models.mixtral import MixtralForCausalLM
+    from ..runtime.continuous_batching import ContinuousBatchingRunner
+
+    del tag
+    cfg = TpuConfig(batch_size=b, seq_len=4096, max_context_length=128,
+                    dtype="bfloat16", context_encoding_buckets=[128],
+                    token_generation_buckets=[512],
+                    is_continuous_batching=True, paged_attention_enabled=True,
+                    pa_num_blocks=66, pa_block_size=128, tp_degree=tp,
+                    ep_degree=ep)
+    config = MixtralForCausalLM.get_config_cls()(
+        cfg, load_config=load_pretrained_config(CANARY_MOE_HF))
+    app = MixtralForCausalLM(None, config)
+    app.load_random(seed=0)
+    return app, ContinuousBatchingRunner(app, decode_chunk=steps)
+
+
+def _moe_paged_decode_unit(name, mb, b=8, steps=2, ep=2, overlap=True):
+    env = {"TPUINF_EP_OVERLAP": "1" if overlap else "0"}
+    app, runner = _moe_paged_runner(ep=ep, b=b, steps=steps,
+                                    tag=",".join(f"{k}={v}" for k, v in
+                                                 sorted(env.items())))
+    _set_paged_decode_example(app, runner, b=b, steps=steps, mb=4)
+    return AuditUnit(
+        name, runner._decode_step, argmod=_widen_table(7, mb), env=env,
+        contract=generic_contract(runner._decode_step, collectives=None))
+
+
+def _group_moe_ep_collectives() -> Tuple[List[AuditUnit], List[Rule]]:
+    """ISSUE-16 expert-dispatch canary: the ep>1 MoE paged decode step's
+    collective schedule is pinned and table/batch-shape-invariant; the
+    overlap path carries the expert-ring permutes
+    (parallel/overlap.expert_ring_moe), the TPUINF_EP_OVERLAP=0 fallback
+    keeps the GSPMD combine all-reduce and no permutes."""
+    units = [
+        _moe_paged_decode_unit("moe_ep_mb4", 4, b=8, overlap=True),
+        _moe_paged_decode_unit("moe_ep_mb32", 32, b=8, overlap=True),
+        _moe_paged_decode_unit("moe_ep_b4", 4, b=4, overlap=True),
+        _moe_paged_decode_unit("moe_ep_fallback", 4, b=8, overlap=False),
+    ]
+    rules = [
+        collective_equal_rule("moe_ep_schedule_table_invariant", "moe_ep_mb32",
+                              "moe_ep_mb4", bytes_too=True),
+        collective_equal_rule("moe_ep_schedule_batch_invariant", "moe_ep_b4",
+                              "moe_ep_mb4", bytes_too=False),
+        collective_bound_rule("moe_ep_schedule_pinned", "moe_ep_mb4",
+                              max_total=48,
+                              require_ops=("collective-permute",)),
+        collective_bound_rule("moe_ep_fallback_no_ring", "moe_ep_fallback",
+                              max_total=64,
+                              forbid_ops=("collective-permute",)),
+    ]
+    return units, rules
+
+
 GROUPS: Dict[str, object] = {
     "dense_decode": _group_dense_decode,
     "fused_paged": _group_fused_paged,
@@ -386,6 +459,7 @@ GROUPS: Dict[str, object] = {
     "mixed_chunk": _group_mixed_chunk,
     "megastep": _group_megastep,
     "tp_collectives": _group_tp_collectives,
+    "moe_ep_collectives": _group_moe_ep_collectives,
 }
 
 
@@ -401,6 +475,7 @@ def clear_caches() -> None:
     fleets until process exit."""
     _dense_app.cache_clear()
     _paged_runner.cache_clear()
+    _moe_paged_runner.cache_clear()
 
 
 def build_canary_units(names=None) -> Tuple[List[AuditUnit], List[Rule]]:

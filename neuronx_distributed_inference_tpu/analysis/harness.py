@@ -322,6 +322,68 @@ def _exercise_mm() -> Any:
     return app
 
 
+def _exercise_moe() -> Any:
+    """MoE serving scope (ISSUE-16): (a) a Mixtral-arch paged CB runner served
+    end-to-end so the MoE decode trace (grouped kernel on the single-device
+    fleet mesh) flows through the paged CB dispatches, and (b) the grouped
+    decode expert matmul and its dense all-experts reference registered as
+    standalone audited kinds — the roofline model's per-kind expectations
+    (analysis/perf_model.py) read these examples."""
+    import jax.numpy as jnp
+
+    from ..config import (OnDeviceSamplingConfig, TpuConfig,
+                          load_pretrained_config)
+    from ..models.mixtral import MixtralForCausalLM
+    from ..ops.moe import (MoEArgs, dense_all_experts, moe_decode_grouped,
+                           route)
+    from ..runtime.continuous_batching import ContinuousBatchingRunner
+    from .registry import audited_jit
+
+    moe_hf = dict(TINY_HF, model_type="mixtral", num_local_experts=4,
+                  num_experts_per_tok=2, sliding_window=None)
+    tpu_cfg = TpuConfig(
+        batch_size=2, seq_len=96, max_context_length=32,
+        dtype="float32", context_encoding_buckets=[16, 32],
+        token_generation_buckets=[48, 96],
+        is_continuous_batching=True, paged_attention_enabled=True,
+        pa_num_blocks=48, pa_block_size=8,
+        on_device_sampling_config=OnDeviceSamplingConfig())
+    config = MixtralForCausalLM.get_config_cls()(
+        tpu_cfg, load_config=load_pretrained_config(moe_hf))
+    app = MixtralForCausalLM(None, config)
+    app.load_random(seed=0)
+    runner = ContinuousBatchingRunner(app, decode_chunk=4)
+    for p in _prompts((12, 19)):
+        runner.submit(p, max_new_tokens=6)
+    runner.run_to_completion()
+
+    rng = np.random.default_rng(3)
+    e, h, i = 4, 64, 96
+    margs = MoEArgs(num_experts=e, experts_per_tok=2)
+    lp = {k: jnp.asarray(rng.normal(size=s, scale=0.1).astype(np.float32))
+          for k, s in (("router", (h, e)), ("wg", (e, h, i)),
+                       ("wu", (e, h, i)), ("wd", (e, i, h)))}
+
+    def _grouped(lp, x):
+        gates = route(lp["router"], x, margs)
+        y = moe_decode_grouped(x, gates, lp, margs, jax.nn.silu)
+        if y is None:
+            raise RuntimeError("grouped MoE dispatch declined plain operands")
+        return y
+
+    def _dense(lp, x):
+        gates = route(lp["router"], x, margs)
+        return dense_all_experts(x, gates, lp, margs, jax.nn.silu)
+
+    dg = audited_jit(_grouped, kind="moe.decode.grouped")
+    dd = audited_jit(_dense, kind="moe.decode.dense")
+    x = jnp.asarray(rng.normal(size=(8, h)).astype(np.float32))
+    dg.set_example(lp, x)
+    dd.set_example(lp, x)
+    dg(lp, x), dd(lp, x)
+    return (runner, dg, dd)
+
+
 # scope name -> (exercise fn, kinds it must register+capture)
 SCOPES: Dict[str, Tuple] = {
     "plain": (_exercise_plain,
@@ -344,6 +406,10 @@ SCOPES: Dict[str, Tuple] = {
     "medusa": (_exercise_medusa,
                ("medusa.prefill", "medusa.verify", "medusa.compact")),
     "mm": (_exercise_mm, ("mm.prefill", "mm.encode")),
+    # LAST on purpose: the Mixtral paged-CB runner re-registers cb.paged.*
+    # kinds and live_dispatches() is later-wins — keeping moe at the end means
+    # the llama cb_* scopes above still own their kinds in a full-fleet run.
+    "moe": (_exercise_moe, ("moe.decode.grouped", "moe.decode.dense")),
 }
 
 # every dispatch kind the full fleet exercises — DERIVED from SCOPES so the

@@ -115,29 +115,43 @@ class MoEHybridShardingConfig:
     different TP/EP degrees for CTE vs TKG, `models/config.py:1055-1061`, and the
     EP dispatch collective options `:602,685-686`).
 
-    Values name mesh axes for the DECODE graph's expert-activation constraints:
-    "ep", "tp", "ep_tp" (both), or None (replicated). Prefill keeps the default
-    experts->ep / expert_mlp->tp layout. GSPMD derives each graph's
+    Values name mesh axes for each graph's expert-activation constraints:
+    "ep", "tp", "ep_tp" (both), None (replicated), or "default" (keep the
+    DEFAULT_RULES experts->ep / expert_mlp->tp layout — the prefill fields'
+    default, so existing decode-only configs are unchanged). A TP-heavy
+    prefill + EP-heavy decode split selects, per trace, the layout each
+    phase's arithmetic intensity wants. GSPMD derives each graph's
     dispatch/combine collectives from these shardings — the TPU equivalent of
-    the reference hand-picking AR_AG/RS_AG/AG_AR per sub-model."""
+    the reference hand-picking AR_AG/RS_AG/AG_AR per sub-model — and the
+    decode EP ring (parallel/overlap.expert_ring_moe) engages only when the
+    decode experts land on exactly "ep"."""
 
     decode_experts: Optional[str] = "ep"
     decode_expert_mlp: Optional[str] = "tp"
+    prefill_experts: Optional[str] = "default"
+    prefill_expert_mlp: Optional[str] = "default"
 
     _VALID = (None, "ep", "tp", "ep_tp")
 
     def validate(self) -> None:
-        for name in ("decode_experts", "decode_expert_mlp"):
-            if getattr(self, name) not in self._VALID:
-                raise ValueError(f"{name} must be one of {self._VALID}")
-        e = self.mesh_axes("decode_experts") or ()
-        m = self.mesh_axes("decode_expert_mlp") or ()
-        e = (e,) if isinstance(e, str) else e
-        m = (m,) if isinstance(m, str) else m
-        if set(e) & set(m):
-            raise ValueError(
-                f"decode_experts and decode_expert_mlp must use disjoint mesh "
-                f"axes (got {self.decode_experts!r} / {self.decode_expert_mlp!r})")
+        for name in ("decode_experts", "decode_expert_mlp",
+                     "prefill_experts", "prefill_expert_mlp"):
+            valid = self._VALID + (("default",) if name.startswith("prefill")
+                                   else ())
+            if getattr(self, name) not in valid:
+                raise ValueError(f"{name} must be one of {valid}")
+        for phase in ("decode", "prefill"):
+            e = self.mesh_axes(f"{phase}_experts")
+            m = self.mesh_axes(f"{phase}_expert_mlp")
+            e = () if e in (None, "default") else (
+                (e,) if isinstance(e, str) else e)
+            m = () if m in (None, "default") else (
+                (m,) if isinstance(m, str) else m)
+            if set(e) & set(m):
+                raise ValueError(
+                    f"{phase}_experts and {phase}_expert_mlp must use disjoint "
+                    f"mesh axes (got {getattr(self, f'{phase}_experts')!r} / "
+                    f"{getattr(self, f'{phase}_expert_mlp')!r})")
 
     def mesh_axes(self, name: str):
         v = getattr(self, name)
@@ -355,6 +369,7 @@ _SUBCONFIG_TYPES = {
     "speculation_config": SpeculationConfig,
     "lora_serving_config": LoraServingConfig,
     "quantization_config": QuantizationConfig,
+    "moe_hybrid_sharding": MoEHybridShardingConfig,
 }
 
 

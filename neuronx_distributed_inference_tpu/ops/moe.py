@@ -18,16 +18,36 @@ Expert parallelism: the ``experts`` logical axis shards E over the mesh's ``ep``
 (`parallel/sharding.py` DEFAULT_RULES); the final gate-weighted combine contracts over
 E, so GSPMD inserts the EP all-reduce exactly where the reference places its MoE
 dispatch collectives (`ep_dispatch_cc_option`, `models/config.py:602`).
+
+Decode fast paths (both trace-time selected, dense einsum kept as the reference
+and fallback):
+
+- **Grouped expert matmul** (`grouped_expert_matmul`): one Pallas kernel over the
+  stacked (E, H, I) weights with a per-expert/per-I-tile grid and a gate-weighted
+  f32 accumulator — the TPU analog of the reference's
+  `moe_token_gen_all_experts_kernel`. Serves bf16 and the int8/fp8 (`{"q","s"}`)
+  and int4 half-split (`{"q4","s"}`, ops/w4.py layout) quantized leaves with
+  in-kernel dequant. ``TPUINF_MOE_GROUPED=0`` opts out (trace time).
+- **EP ring dispatch/combine** (`parallel/overlap.expert_ring_moe`): on ep > 1
+  meshes the GSPMD combine all-reduce is replaced by an explicit rotate-
+  accumulate over the ep axis whose ppermutes hide behind the local expert
+  matmuls (the PR 5 row_projection template), with the grouped kernel serving
+  each shard's local experts. ``TPUINF_EP_OVERLAP=0`` falls back to GSPMD.
 """
 
 from __future__ import annotations
 
+import functools
+import os
 from dataclasses import dataclass
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
+from ..parallel.overlap import expert_ring_moe, moe_ep_phase
 from ..parallel.sharding import constrain
 from .quantization import qapply, qeinsum
 
@@ -73,6 +93,24 @@ class MoEArgs:
     # (up+1)·act — replaces the standard activation(gate)·up when set
     swiglu_limit: Optional[float] = None
     swiglu_alpha: float = 1.702
+
+    def __post_init__(self):
+        # fail at config build time, not as an opaque top_k/reshape trace error
+        if self.num_experts < 1:
+            raise ValueError(f"num_experts must be >= 1, got {self.num_experts}")
+        if not 1 <= self.experts_per_tok <= self.num_experts:
+            raise ValueError(
+                f"experts_per_tok={self.experts_per_tok} must be in [1, "
+                f"num_experts={self.num_experts}]: the router cannot select "
+                f"more experts than exist")
+        if self.n_group > 1 and self.num_experts % self.n_group:
+            raise ValueError(
+                f"num_experts={self.num_experts} must divide evenly into "
+                f"n_group={self.n_group} routing groups")
+        if self.topk_group > self.n_group:
+            raise ValueError(
+                f"topk_group={self.topk_group} cannot exceed "
+                f"n_group={self.n_group}")
 
 
 def route(router_w: jnp.ndarray, x: jnp.ndarray, moe: MoEArgs,
@@ -165,6 +203,368 @@ def route(router_w: jnp.ndarray, x: jnp.ndarray, moe: MoEArgs,
     return jnp.einsum("nk,nke->ne", top_vals, onehot)
 
 
+# ---------------------------------------------------------------------------
+# Decode fast path: fused grouped expert matmul (Pallas)
+# ---------------------------------------------------------------------------
+
+# trace-time counters per routed-MoE implementation actually lowered into a
+# graph since the last reset — bench.py's honesty gate (a "dense_decode" tick
+# during the measured MoE leg means the fast path silently declined)
+_TRACE_STATS = {"grouped": 0, "ep_ring": 0, "dense_decode": 0}
+
+
+def grouped_trace_stats() -> dict:
+    """Snapshot of which MoE decode implementations have been TRACED (not run)."""
+    return dict(_TRACE_STATS)
+
+
+def reset_grouped_trace_stats() -> None:
+    for k in _TRACE_STATS:
+        _TRACE_STATS[k] = 0
+
+
+def grouped_moe_enabled() -> bool:
+    """TPUINF_MOE_GROUPED=0 keeps decode on the dense all-experts einsums
+    (read at TRACE time, like TPUINF_TP_OVERLAP)."""
+    return os.environ.get("TPUINF_MOE_GROUPED", "1") != "0"
+
+
+def _glu(gate_proj, up_proj, moe: MoEArgs, activation):
+    """The expert glu nonlinearity, shared by the dense reference path, the
+    grouped kernel, and the EP-ring local compute so all three are the same
+    math (gpt-oss clamped variant included)."""
+    if moe.swiglu_limit is not None:
+        # gpt-oss clamped glu (`GptOssExperts.forward`): clamp, gate·σ(α·gate), (up+1)·
+        lim = jnp.asarray(moe.swiglu_limit, gate_proj.dtype)
+        gate_proj = jnp.minimum(gate_proj, lim)
+        up_proj = jnp.clip(up_proj, -lim, lim)
+        glu = gate_proj * jax.nn.sigmoid(moe.swiglu_alpha * gate_proj)
+        return (up_proj + 1.0) * glu
+    return activation(gate_proj) * up_proj
+
+
+def _grouped_mode(w):
+    """Classify one expert-weight leaf for the grouped kernel.
+
+    Returns ``(mode, payload4d, scale4d, layer_idx)`` with the payload
+    normalized to a stacked ``(L_or_1, E, in[, /2], out)`` array, or None when
+    the leaf cannot be served in-kernel (transposed int8 storage, GSPMD-dequant
+    int4 on sharded meshes, stacked int4 outside the layer scan).
+    """
+    if not isinstance(w, dict):
+        if getattr(w, "ndim", 0) != 3:
+            return None
+        return ("plain", w[None], None, None)
+    if "qT" in w:
+        return None
+    if "q4" in w:
+        # half-split packed int4 (ops/w4.py): byte row i pairs logical rows i
+        # and i + in/2 — dequants contiguously in VMEM, but the *contraction*
+        # dim of a packed operand cannot be block-tiled (the two logical rows
+        # of a byte land in different tiles); the builder forces a full-I down
+        # projection block for this mode.
+        if not w.get("use_kernel", True):
+            return None
+        q4, li = w["q4"], w.get("layer")
+        if q4.ndim == 3:
+            q4, li = q4[None], None
+        elif li is None:
+            return None
+        sc = jnp.asarray(w["s"], jnp.float32).reshape(
+            q4.shape[0], q4.shape[1], 1, -1)
+        return ("q4", q4, sc, li)
+    if "q" in w:
+        q = w["q"]
+        if q.ndim != 3:
+            return None
+        sc = jnp.asarray(w["s"], jnp.float32).reshape(1, q.shape[0], 1, -1)
+        return ("q", q[None], sc, None)
+    return None
+
+
+def _grouped_kernel(li_ref, *refs, modes, has_bias, moe, activation):
+    """One (expert, I-tile) cell of the fused decode MoE: gate/up matmul on the
+    tile, glu, down matmul back to (N, H), gate-weighted accumulate into the
+    f32 scratch; the last cell flushes the accumulator to the output."""
+    del li_ref  # consumed by the BlockSpec index maps only
+    x_ref, g_ref = refs[0], refs[1]
+    pos = 2
+    projs = []
+    for m in modes:
+        if m == "plain":
+            projs.append((m, refs[pos], None))
+            pos += 1
+        else:
+            projs.append((m, refs[pos], refs[pos + 1]))
+            pos += 2
+    if has_bias:
+        bg_ref, bu_ref, bd_ref = refs[pos:pos + 3]
+        pos += 3
+    o_ref, acc_ref = refs[-2], refs[-1]
+    ei, ti = pl.program_id(0), pl.program_id(1)
+    ne, nt = pl.num_programs(0), pl.num_programs(1)
+
+    @pl.when(jnp.logical_and(ei == 0, ti == 0))
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    def dot(xop, m, w_ref, s_ref):
+        if m == "q4":
+            p = w_ref[0, 0].astype(jnp.int32)
+            lo = (p & 15) - 8                               # biased low nibble
+            hi = jax.lax.shift_right_arithmetic(p, 4)       # sign-extending
+            w = jnp.concatenate([lo, hi], axis=0).astype(jnp.float32)
+        else:
+            w = w_ref[0, 0].astype(jnp.float32)
+        y = jax.lax.dot(xop.astype(jnp.float32), w,
+                        preferred_element_type=jnp.float32)
+        if s_ref is not None:
+            y = y * s_ref[0, 0, 0]                          # per-out-channel
+        return y
+
+    gp = dot(x_ref[...], *projs[0])
+    up = dot(x_ref[...], *projs[1])
+    if has_bias:
+        gp = gp + bg_ref[0].astype(jnp.float32)
+        up = up + bu_ref[0].astype(jnp.float32)
+    inter = _glu(gp, up, moe, activation)
+    part = dot(inter.astype(x_ref.dtype), *projs[2])        # (N, H) partial
+    g = g_ref[0].astype(jnp.float32)                        # (N,) this expert
+    if has_bias:
+        # the down bias contributes once per expert, not once per I-tile
+        @pl.when(ti == 0)
+        def _bd():
+            acc_ref[...] += g[:, None] * bd_ref[0].astype(jnp.float32)
+
+    acc_ref[...] += part * g[:, None]
+
+    @pl.when(jnp.logical_and(ei == ne - 1, ti == nt - 1))
+    def _emit():
+        o_ref[...] = acc_ref[...].astype(o_ref.dtype)
+
+
+_VMEM_BUDGET = 12 * 2 ** 20     # leave headroom under the ~16MB/core arena
+
+
+def grouped_expert_matmul(x, gates_t, wg, wu, wd, *, moe: MoEArgs, activation,
+                          biases=None, out_dtype=None, interpret=None):
+    """Fused all-experts decode MoE: one Pallas kernel over the stacked expert
+    weights with gate-weighted f32 accumulation — the TPU analog of the
+    reference's ``moe_token_gen_all_experts_kernel``.
+
+    x: (N, H) tokens; gates_t: (E, N) f32 router gates (transposed so each
+    expert grid cell streams a contiguous (1, N) block); wg/wu (E, H, I) and
+    wd (E, I, H) leaves — plain arrays, int8/fp8 ``{"q","s"}``, or int4
+    half-split ``{"q4","s"}`` payloads (dequantized in VMEM). ``biases`` is
+    the optional (bg, bu, bd) tuple. Returns (N, H) in ``out_dtype`` (default
+    x.dtype), or **None** when the operands are ineligible — the caller falls
+    back to the dense einsum reference.
+
+    The (E, H, I)-stacked weight walk with a per-group offset grid is also the
+    shape of a batched multi-adapter LoRA matmul (adapters as the group dim) —
+    ROADMAP item 5 grows from this kernel.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    cls = [_grouped_mode(w) for w in (wg, wu, wd)]
+    if any(c is None for c in cls):
+        return None
+    modes = tuple(c[0] for c in cls)
+    payloads = [c[1] for c in cls]
+    scales = [c[2] for c in cls]
+    li = next((c[3] for c in cls if c[3] is not None), None)
+
+    n, h = x.shape
+    e = payloads[0].shape[1]
+
+    def indim(k):
+        return payloads[k].shape[2] * (2 if modes[k] == "q4" else 1)
+
+    inter_i = payloads[0].shape[3]
+    if gates_t.shape != (e, n):
+        return None
+    if indim(0) != h or indim(1) != h or payloads[1].shape[3] != inter_i:
+        return None
+    if indim(2) != inter_i or payloads[2].shape[3] != h:
+        return None
+    if biases is not None and any(isinstance(b, dict) for b in biases):
+        return None
+
+    # I-tile width: the q4 down projection cannot tile its packed contraction
+    # dim (see _grouped_mode), so it pins bi = I; otherwise prefer MXU-friendly
+    # 128-multiples that fit the VMEM budget with double-buffered weight blocks
+    esz = [p.dtype.itemsize for p in payloads]
+
+    def vmem_bytes(bi):
+        wgt = 2 * bi * (payloads[0].shape[2] * esz[0] + payloads[1].shape[2]
+                        * esz[1])
+        wdn = 2 * h * (payloads[2].shape[2] if modes[2] == "q4" else bi) * esz[2]
+        act = n * h * (x.dtype.itemsize + 4 + 4)        # x + f32 acc + unpack slack
+        return wgt + wdn + act + n * bi * 8             # gp/up f32 tiles
+
+    if modes[2] == "q4":
+        candidates = [inter_i]
+    else:
+        candidates = [c for c in (512, 256, 128) if inter_i % c == 0] + [inter_i]
+    bi = next((c for c in candidates if vmem_bytes(c) <= _VMEM_BUDGET), None)
+    if bi is None:
+        return None
+    if not interpret and (h % 128 or bi % 128):
+        return None                     # compiled path wants lane-aligned tiles
+    nt = inter_i // bi
+
+    # pad N to the f32 sublane tile; padded rows carry zero gates so they only
+    # produce zero rows that are sliced off below
+    np_ = -(-n // 8) * 8
+    xp = jnp.pad(x, ((0, np_ - n), (0, 0))) if np_ != n else x
+    gtp = (jnp.pad(gates_t, ((0, 0), (0, np_ - n))) if np_ != n
+           else gates_t).astype(jnp.float32)
+
+    specs = [pl.BlockSpec((np_, h), lambda ei, ti, lidx: (0, 0)),
+             pl.BlockSpec((1, np_), lambda ei, ti, lidx: (ei, 0))]
+    inputs = [xp, gtp]
+    for k, (m, p, s) in enumerate(zip(modes, payloads, scales)):
+        stacked = p.shape[0] > 1
+        if k < 2:
+            blk = (1, 1, p.shape[2], bi)
+            imap = (lambda ei, ti, lidx: (lidx[0], ei, 0, ti)) if stacked \
+                else (lambda ei, ti, lidx: (0, ei, 0, ti))
+        else:
+            rows = p.shape[2] if m == "q4" else bi
+            blk = (1, 1, rows, h)
+            if m == "q4":
+                imap = (lambda ei, ti, lidx: (lidx[0], ei, 0, 0)) if stacked \
+                    else (lambda ei, ti, lidx: (0, ei, 0, 0))
+            else:
+                imap = (lambda ei, ti, lidx: (lidx[0], ei, ti, 0)) if stacked \
+                    else (lambda ei, ti, lidx: (0, ei, ti, 0))
+        specs.append(pl.BlockSpec(blk, imap))
+        inputs.append(p)
+        if s is not None:
+            if k < 2:
+                sblk = (1, 1, 1, bi)
+                smap = (lambda ei, ti, lidx: (lidx[0], ei, 0, ti)) if stacked \
+                    else (lambda ei, ti, lidx: (0, ei, 0, ti))
+            else:
+                sblk = (1, 1, 1, h)
+                smap = (lambda ei, ti, lidx: (lidx[0], ei, 0, 0)) if stacked \
+                    else (lambda ei, ti, lidx: (0, ei, 0, 0))
+            specs.append(pl.BlockSpec(sblk, smap))
+            inputs.append(s)
+    has_bias = biases is not None
+    if has_bias:
+        bg, bu, bd = biases
+        specs += [pl.BlockSpec((1, bi), lambda ei, ti, lidx: (ei, ti)),
+                  pl.BlockSpec((1, bi), lambda ei, ti, lidx: (ei, ti)),
+                  pl.BlockSpec((1, h), lambda ei, ti, lidx: (ei, 0))]
+        inputs += [bg, bu, bd]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(e, nt),
+        in_specs=specs,
+        out_specs=pl.BlockSpec((np_, h), lambda ei, ti, lidx: (0, 0)),
+        scratch_shapes=[pltpu.VMEM((np_, h), jnp.float32)],
+    )
+    kernel = functools.partial(_grouped_kernel, modes=modes, has_bias=has_bias,
+                               moe=moe, activation=activation)
+    li_arr = (li if li is not None else jnp.int32(0))
+    y = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((np_, h), out_dtype or x.dtype),
+        interpret=interpret,
+    )(jnp.asarray(li_arr, jnp.int32).reshape(1), *inputs)
+    return y[:n] if np_ != n else y
+
+
+def moe_decode_grouped(x, gates, lp, moe: MoEArgs, activation,
+                       out_dtype=None, interpret=None):
+    """Grouped-kernel decode fast path from a layer's param dict: returns
+    (N, H) or None when the leaves are ineligible (caller keeps the dense
+    reference einsums)."""
+    if moe.scale_expert_input:
+        return None
+    biases = (lp["bg"], lp["bu"], lp["bd"]) if moe.expert_bias else None
+    return grouped_expert_matmul(
+        x, gates.T, lp["wg"], lp["wu"], lp["wd"], moe=moe,
+        activation=activation, biases=biases, out_dtype=out_dtype,
+        interpret=interpret)
+
+
+def _local_expert_combine(xc, gc, wl, *, moe: MoEArgs, activation):
+    """Per-shard all-local-experts MLP + gate combine for one destination token
+    tile of the EP ring: xc (n, H) tokens, gc (n, E_local) f32 gates, wl this
+    shard's plain weight slices. Returns an (n, H) f32 partial — summed over
+    the ring's experts by the caller (and over tp by its psum when the expert
+    mlp dim is column-sharded)."""
+    if grouped_moe_enabled():
+        biases = ((wl["bg"], wl["bu"], wl["bd"]) if moe.expert_bias else None)
+        y = grouped_expert_matmul(xc, gc.T, wl["wg"], wl["wu"], wl["wd"],
+                                  moe=moe, activation=activation,
+                                  biases=biases, out_dtype=jnp.float32)
+        if y is not None:
+            return y
+    gp = jnp.einsum("nh,ehi->eni", xc, wl["wg"])
+    up = jnp.einsum("nh,ehi->eni", xc, wl["wu"])
+    if moe.expert_bias:
+        gp = gp + wl["bg"][:, None, :]
+        up = up + wl["bu"][:, None, :]
+    inter = _glu(gp, up, moe, activation)
+    pe = jnp.einsum("eni,eih->enh", inter, wl["wd"])
+    if moe.expert_bias:
+        pe = pe + wl["bd"][:, None, :]
+    return jnp.einsum("enh,ne->nh", pe, gc).astype(jnp.float32)
+
+
+def _ring_moe(x, gates, lp, moe: MoEArgs, activation, mesh, rules, e_ax, m_ax):
+    """Overlap-scheduled EP dispatch/combine (parallel/overlap.expert_ring_moe)
+    for the routed experts; None when the phase/leaves are ineligible."""
+    names = ["wg", "wu", "wd"]
+    waxes = {"wg": (e_ax, None, m_ax), "wu": (e_ax, None, m_ax),
+             "wd": (e_ax, m_ax, None)}
+    if moe.expert_bias:
+        names += ["bg", "bu", "bd"]
+        waxes.update(bg=(e_ax, m_ax), bu=(e_ax, m_ax), bd=(e_ax, None))
+    weights = {k: lp[k] for k in names}
+    if any(isinstance(w, dict) for w in weights.values()):
+        return None                     # quantized leaves keep GSPMD dequant
+    expert_fn = functools.partial(_local_expert_combine, moe=moe,
+                                  activation=activation)
+    return expert_ring_moe(x, gates, weights, waxes, mesh, rules,
+                           e_ax, m_ax, expert_fn)
+
+
+def dense_all_experts(x, gates, lp, moe: MoEArgs, activation, mesh=None,
+                      rules=None, e_ax="experts", m_ax="expert_mlp"):
+    """The dense all-experts routed-MoE reference: (E, N, I) intermediates,
+    EP-sharded on E, TP on I, GSPMD-placed combine. Exactness oracle for the
+    grouped kernel / EP ring and the non-TPU / quantized-GSPMD fallback."""
+    if moe.scale_expert_input:
+        # Llama4: expert input pre-scaled by its gate (unselected experts see
+        # zeros, which the bias-free glu maps back to zero); combine is then an
+        # unweighted sum
+        xe = gates.astype(x.dtype).T[:, :, None] * x[None, :, :]    # (E, N, H)
+        xe = constrain(xe, (e_ax, "batch", None), rules, mesh=mesh)
+        gate_proj = qeinsum("enh,ehi->eni", xe, lp["wg"])
+        up_proj = qeinsum("enh,ehi->eni", xe, lp["wu"])
+    else:
+        gate_proj = qeinsum("nh,ehi->eni", x, lp["wg"])
+        up_proj = qeinsum("nh,ehi->eni", x, lp["wu"])
+    if moe.expert_bias:
+        gate_proj = gate_proj + lp["bg"][:, None, :]
+        up_proj = up_proj + lp["bu"][:, None, :]
+    inter = _glu(gate_proj, up_proj, moe, activation)
+    inter = constrain(inter, (e_ax, None, m_ax), rules, mesh=mesh)
+    per_expert = qeinsum("eni,eih->enh", inter, lp["wd"])           # (E, N, H)
+    if moe.expert_bias:
+        per_expert = per_expert + lp["bd"][:, None, :]
+    if moe.scale_expert_input:
+        return jnp.sum(per_expert, axis=0)                          # sum over E: EP psum
+    return jnp.einsum("enh,ne->nh", per_expert,
+                      gates.astype(per_expert.dtype))               # sum over E: EP psum
+
+
 def moe_block(lp, args, hn: jnp.ndarray, mesh, rules,
               activation, decode: bool = False) -> jnp.ndarray:
     """(B, S, H) -> (B, S, H) through the MoE FFN.
@@ -186,39 +586,25 @@ def moe_block(lp, args, hn: jnp.ndarray, mesh, rules,
     gates = route(lp["router"], x, moe, lp.get("router_b"),
                   lp.get("router_cb"))                              # (N, E) fp32
 
-    # dense all-experts MLP: (E, N, I) intermediates, EP-sharded on E, TP on I
-    if moe.scale_expert_input:
-        # Llama4: expert input pre-scaled by its gate (unselected experts see zeros,
-        # which the bias-free glu maps back to zero); combine is then an unweighted sum
-        xe = gates.astype(x.dtype).T[:, :, None] * x[None, :, :]    # (E, N, H)
-        xe = constrain(xe, (e_ax, "batch", None), rules, mesh=mesh)
-        gate_proj = qeinsum("enh,ehi->eni", xe, lp["wg"])
-        up_proj = qeinsum("enh,ehi->eni", xe, lp["wu"])
-    else:
-        gate_proj = qeinsum("nh,ehi->eni", x, lp["wg"])
-        up_proj = qeinsum("nh,ehi->eni", x, lp["wu"])
-    if moe.expert_bias:
-        gate_proj = gate_proj + lp["bg"][:, None, :]
-        up_proj = up_proj + lp["bu"][:, None, :]
-    if moe.swiglu_limit is not None:
-        # gpt-oss clamped glu (`GptOssExperts.forward`): clamp, gate·σ(α·gate), (up+1)·
-        lim = jnp.asarray(moe.swiglu_limit, gate_proj.dtype)
-        gate_proj = jnp.minimum(gate_proj, lim)
-        up_proj = jnp.clip(up_proj, -lim, lim)
-        glu = gate_proj * jax.nn.sigmoid(moe.swiglu_alpha * gate_proj)
-        inter = (up_proj + 1.0) * glu
-    else:
-        inter = activation(gate_proj) * up_proj
-    inter = constrain(inter, (e_ax, None, m_ax), rules, mesh=mesh)
-    per_expert = qeinsum("eni,eih->enh", inter, lp["wd"])           # (E, N, H)
-    if moe.expert_bias:
-        per_expert = per_expert + lp["bd"][:, None, :]
-    if moe.scale_expert_input:
-        out = jnp.sum(per_expert, axis=0)                           # sum over E: EP psum
-    else:
-        out = jnp.einsum("enh,ne->nh", per_expert,
-                         gates.astype(per_expert.dtype))            # sum over E: EP psum
-    out = constrain(out, ("batch", None), rules, mesh=mesh)
+    routed = None
+    if decode and not moe.scale_expert_input:
+        if mesh is not None and mesh.size > 1:
+            if moe_ep_phase(mesh, rules, e_ax, m_ax):
+                routed = _ring_moe(x, gates, lp, moe, activation, mesh, rules,
+                                   e_ax, m_ax)
+                if routed is not None:
+                    _TRACE_STATS["ep_ring"] += 1
+        elif grouped_moe_enabled():
+            routed = moe_decode_grouped(x, gates, lp, moe, activation)
+            if routed is not None:
+                _TRACE_STATS["grouped"] += 1
+
+    if routed is None:
+        if decode:
+            _TRACE_STATS["dense_decode"] += 1
+        routed = dense_all_experts(x, gates, lp, moe, activation, mesh=mesh,
+                                   rules=rules, e_ax=e_ax, m_ax=m_ax)
+    out = constrain(routed.astype(x.dtype), ("batch", None), rules, mesh=mesh)
 
     if moe.shared_expert_intermediate_size:
         shared_inter = (activation(qapply(x, lp["shared_wg"]))

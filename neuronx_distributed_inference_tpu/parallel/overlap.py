@@ -259,6 +259,143 @@ def row_projection(x, w, mesh, rules, phase: str, in_logical: str):
 
 
 # ---------------------------------------------------------------------------
+# Expert-parallel MoE dispatch/combine ring
+# ---------------------------------------------------------------------------
+
+
+def ep_overlap_enabled() -> bool:
+    """TPUINF_EP_OVERLAP=0 keeps the MoE combine on GSPMD constraint placement
+    (the blocking EP all-reduce after the gate-weighted combine). Read at
+    TRACE time, like TPUINF_TP_OVERLAP."""
+    return os.environ.get("TPUINF_EP_OVERLAP", "1") != "0"
+
+
+def moe_ep_phase(mesh, rules, e_ax: str, m_ax: str) -> bool:
+    """Decide whether THIS trace's MoE decode takes the explicit expert-ring
+    dispatch/combine path (``expert_ring_moe``) instead of the GSPMD-placed
+    combine all-reduce.
+
+    The ring rotates over the ep axis only, so it requires ep > 1, cp == 1,
+    the expert axis mapped to exactly ``ep`` (hybrid remaps that move experts
+    onto tp keep GSPMD placement), and the expert-mlp axis unsharded or
+    tp-sharded (the per-tile partial then finishes with one tp psum).
+    """
+    if mesh is None or not ep_overlap_enabled():
+        return False
+    shape = dict(mesh.shape)
+    if shape.get(AXIS_EP, 1) <= 1:
+        return False
+    if shape.get(AXIS_CP, 1) != 1:
+        return False
+    r = rules or DEFAULT_RULES
+    if r.get(e_ax) != AXIS_EP:
+        return False
+    if r.get(m_ax) not in (None, AXIS_TP):
+        return False
+    return True
+
+
+def expert_ring_moe(x, gates, weights: Dict[str, jnp.ndarray],
+                    waxes: Dict[str, tuple], mesh, rules, e_ax: str,
+                    m_ax: str, expert_fn):
+    """Overlap-scheduled expert-parallel dispatch/combine.
+
+    Replaces the GSPMD combine all-reduce of the dense all-experts MoE with an
+    explicit rotate-accumulate over the ``ep`` mesh axis (the row_projection
+    template): tokens are split into ep destination tiles; each chip computes
+    its local experts' contribution to one tile while ``lax.ppermute`` rotates
+    the partial accumulator around the ep ring, so the combine traffic hides
+    behind the next tile's expert matmuls. After ep-1 hops chip r holds token
+    tile r fully combined across every chip's experts; a tp psum finishes the
+    column-sharded expert mlp dim and a tiled all-gather restores the
+    replicated (N, H) layout the residual expects.
+
+    x: (N, H) tokens (``batch`` dp-sharded, replicated over ep/tp); gates:
+    (N, E) f32 router gates; ``weights``: plain (unquantized) expert leaves
+    keyed by name with logical axes in ``waxes`` (resolved through ``rules``
+    so hybrid decode remaps shard them exactly as GSPMD would);
+    ``expert_fn(x_tile, gates_tile, local_weights) -> (n, H) f32`` computes
+    one shard's local-experts contribution (ops/moe._local_expert_combine —
+    which reuses the grouped Pallas kernel when eligible).
+
+    Returns the replicated (N, H) combine in x.dtype, or None when shapes
+    don't divide the ring (caller keeps GSPMD placement). Bit-exactness with
+    the fallback is pinned by tests/test_moe_serving.py.
+    """
+    r = rules or DEFAULT_RULES
+    shape = dict(mesh.shape)
+    ep = shape.get(AXIS_EP, 1)
+    tp = shape.get(AXIS_TP, 1)
+    if ep <= 1:
+        return None
+    if any(isinstance(w, dict) for w in weights.values()):
+        return None
+    n, _ = x.shape
+    e = gates.shape[1]
+    # local token count after the dp shard must split into ep destination tiles
+    batch_axes = r.get("batch")
+    if batch_axes is None:
+        batch_axes = ()
+    elif not isinstance(batch_axes, tuple):
+        batch_axes = (batch_axes,)
+    dp = 1
+    for a in batch_axes:
+        dp *= shape.get(a, 1)
+    if n % dp or (n // dp) % ep or e % ep:
+        return None
+    tp_partial = tp > 1 and r.get(m_ax) == AXIS_TP
+
+    names = list(weights)
+    in_specs = (logical_to_spec(("batch", None), r),
+                logical_to_spec(("batch", e_ax), r)) + tuple(
+                    logical_to_spec(waxes[k], r) for k in names)
+    out_spec = logical_to_spec(("batch", None), r)
+    perm = _perm(ep)
+
+    def _local(xl, gl, *wl_flat):
+        wl = dict(zip(names, wl_flat))
+        rk = jax.lax.axis_index(AXIS_EP)
+        n_loc = xl.shape[0] // ep
+
+        def part(c):
+            xc = jax.lax.dynamic_slice_in_dim(xl, c * n_loc, n_loc, axis=0)
+            gc = jax.lax.dynamic_slice_in_dim(gl, c * n_loc, n_loc, axis=0)
+            return expert_fn(xc, gc, wl)
+
+        acc = part((rk - 1) % ep)
+        for k in range(1, ep):
+            acc = jax.lax.ppermute(acc, AXIS_EP, perm)
+            acc = acc + part((rk - k - 1) % ep)
+        # after ep-1 hops the accumulator at rank r holds token tile r,
+        # combined across every rank's local experts along the ring
+        if tp_partial:
+            acc = jax.lax.psum(acc, AXIS_TP)
+        acc = acc.astype(xl.dtype)
+        return jax.lax.all_gather(acc, AXIS_EP, axis=0, tiled=True)
+
+    fn = _shard_map(_local, mesh, in_specs, out_spec)
+    return fn(x, gates.astype(jnp.float32), *(weights[k] for k in names))
+
+
+def estimated_ep_bytes_per_step(num_moe_layers: int, hidden: int, ep: int,
+                                tokens: int, dtype_bytes: int = 2) -> int:
+    """Analytic per-decode-step expert dispatch/combine ICI bytes of the ring
+    path (shape-derived, never needs a compile — the bench's
+    ``ep_all_to_all_bytes_per_step`` gauge).
+
+    Per MoE layer the ring rotates ep-1 f32 partial tiles of (tokens/ep, H)
+    and the tiled all-gather moves (ep-1)/ep of the combined activation back
+    out in the model dtype.
+    """
+    if ep <= 1:
+        return 0
+    tile = tokens / ep * hidden
+    ring = (ep - 1) * tile * 4
+    gather = (ep - 1) * tile * dtype_bytes
+    return int(num_moe_layers * (ring + gather))
+
+
+# ---------------------------------------------------------------------------
 # ICI traffic accounting
 # ---------------------------------------------------------------------------
 

@@ -144,13 +144,28 @@ class TpuModelForCausalLM:
             self.sharding_rules["decode_heads"] = None
             self.sharding_rules["decode_kv_heads"] = None
         if self.tpu_config.moe_hybrid_sharding is not None:
-            # hybrid MoE sharding: the decode graph's expert activations take a
-            # different axis split than prefill (≈ reference CTE-vs-TKG TP/EP
-            # groups + dispatch CC options, `models/config.py:1055-1061,602`)
+            # hybrid MoE sharding: each phase's expert activations take their
+            # own axis split (≈ reference CTE-vs-TKG TP/EP groups + dispatch CC
+            # options, `models/config.py:1055-1061,602`): e.g. TP-heavy prefill
+            # / EP-heavy decode. "default" prefill values keep DEFAULT_RULES.
             h = self.tpu_config.moe_hybrid_sharding
             self.sharding_rules["decode_experts"] = h.mesh_axes("decode_experts")
             self.sharding_rules["decode_expert_mlp"] = h.mesh_axes(
                 "decode_expert_mlp")
+            for field, rule in (("prefill_experts", "experts"),
+                                ("prefill_expert_mlp", "expert_mlp")):
+                v = h.mesh_axes(field)
+                if v != "default":
+                    self.sharding_rules[rule] = v
+        moe_args = getattr(self.arch_args, "moe", None)
+        if moe_args is not None and self.tpu_config.ep_degree > 1 and \
+                moe_args.num_experts % self.tpu_config.ep_degree:
+            # the experts logical axis shards E over ep; a non-dividing degree
+            # used to surface as an opaque GSPMD partition error mid-trace
+            raise ValueError(
+                f"num_experts={moe_args.num_experts} must be divisible by "
+                f"ep_degree={self.tpu_config.ep_degree} (the experts axis "
+                f"shards over the ep mesh axis)")
 
         self.params = None
         self.kv_cache = None

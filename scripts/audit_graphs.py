@@ -104,6 +104,16 @@ _FILE_SCOPES = {
     "utils/provenance.py": [],
     "serving/kv_tiering.py": ["serving_tier", "cb_paged", "cb_mixed",
                               "cb_megastep", "cb_spec", "cb_eagle"],
+    # ISSUE-16 MoE serving: the grouped decode kernel and EP ring trace only
+    # into MoE-arch graphs — the llama fleet never imports them — so an edit
+    # re-audits the moe scope (Mixtral paged CB runner + the standalone
+    # grouped/dense dispatch kinds). overlap.py ALSO hosts the TP overlap
+    # templates traced into every dense-layer graph, so it re-audits the full
+    # CB fleet on top of moe.
+    "ops/moe.py": ["moe"],
+    "parallel/overlap.py": ["moe", "cb_dense", "cb_paged", "cb_mixed",
+                            "cb_megastep", "cb_spec", "cb_eagle",
+                            "serving_tier"],
 }
 # any other package .py change (application.py, models/modules/ops/parallel/
 # analysis/config/utils/new files) re-runs the whole fleet — see

@@ -399,6 +399,16 @@ def test_changed_mode_scope_map_fails_closed():
     assert set(mod._scopes_for_changes([pkg + "serving/kv_tiering.py"])) == {
         "serving_tier", "cb_paged", "cb_mixed", "cb_megastep", "cb_spec",
         "cb_eagle"}
+    # ISSUE-16 MoE serving: the grouped kernel / EP ring trace only into
+    # MoE-arch graphs -> moe scope; overlap.py also hosts the TP-overlap
+    # templates traced into every dense layer -> full CB fleet on top of moe;
+    # any OTHER new ops/ or parallel/ file still fails closed
+    assert mod._scopes_for_changes([pkg + "ops/moe.py"]) == ["moe"]
+    assert set(mod._scopes_for_changes([pkg + "parallel/overlap.py"])) == {
+        "moe", "cb_dense", "cb_paged", "cb_mixed", "cb_megastep", "cb_spec",
+        "cb_eagle", "serving_tier"}
+    assert mod._scopes_for_changes([pkg + "ops/moe2.py"]) is None
+    assert mod._scopes_for_changes([pkg + "parallel/overlap2.py"]) is None
     assert mod._scopes_for_changes(
         [pkg + "serving/prefill_pool.py"]) is None
     assert "serving_tier" in set(mod._scopes_for_changes(

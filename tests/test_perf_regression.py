@@ -204,6 +204,21 @@ def test_tp_decode_collective_schedule_pinned():
                   "tp_schedule_pinned", "tp_fallback_no_ring")
 
 
+def test_moe_ep_decode_collective_schedule_pinned():
+    """The ISSUE-16 expert-dispatch canary: the ep>1 MoE paged decode step's
+    collective schedule is pinned and table/batch-shape-invariant. The
+    overlap path (parallel/overlap.expert_ring_moe) must carry the
+    expert-ring collective-permutes whose transfers hide behind the local
+    expert matmuls; the TPUINF_EP_OVERLAP=0 fallback keeps the GSPMD-placed
+    combine all-reduce and no permutes — bit-exactness between the two is
+    pinned by tests/test_moe_serving.py. (Wrapper: ``moe_ep_collectives``
+    canary group.)"""
+    _assert_rules(_group_report("moe_ep_collectives"),
+                  "moe_ep_schedule_table_invariant",
+                  "moe_ep_schedule_batch_invariant",
+                  "moe_ep_schedule_pinned", "moe_ep_fallback_no_ring")
+
+
 def test_disabled_telemetry_adds_no_measurable_step_overhead():
     """The ISSUE-3 canary: the serving loop's telemetry hooks
     (step_start / annotate / step_record / note_emitted — exactly the calls
