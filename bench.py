@@ -1026,10 +1026,13 @@ def _moe_paged_decode(ep_degree=1, bs=8, n_chunks=4, max_new=220):
       flags are read at trace time, so reusing warm executables would
       silently measure the same graph twice).
 
-    HONESTY GUARD (r5 spec-floor pattern): the trace counters
-    (ops/moe.grouped_trace_stats) must show the fast path actually lowered
-    into the measured leg's graphs — any ``dense_decode`` tick there REFUSES
-    the keys and emits ``moe_invalid`` instead of a plausible-looking number.
+    HONESTY GUARD (r5 spec-floor pattern): the trace counters — read as
+    in-scope deltas via ``ops/moe.trace_stats_scope`` around the measured leg,
+    so stale global state can't stand in for evidence — must show the fast
+    path actually lowered into the measured leg's graphs. Any ``dense_decode``
+    tick, or an all-zero delta (nothing traced: a warm executable silently
+    reused), REFUSES the keys and emits ``moe_invalid`` instead of a
+    plausible-looking number.
     ``ep_all_to_all_bytes_per_step`` is the ring schedule's analytic traffic
     for THIS config (0 at ep=1 — the single-chip truth — with an explicitly
     ``_projected``-suffixed ep=4 companion so the multichip estimate is
@@ -1102,14 +1105,15 @@ def _moe_paged_decode(ep_degree=1, bs=8, n_chunks=4, max_new=220):
     dense_tok_s = serve({"TPUINF_MOE_GROUPED": "0", "TPUINF_EP_OVERLAP": "0"})
     out["moe_dense_decode_tok_per_s"] = round(dense_tok_s, 1)
 
-    moe_ops.reset_grouped_trace_stats()
-    tok_s = serve({})
-    stats = moe_ops.grouped_trace_stats()
+    with moe_ops.trace_stats_scope() as stats:
+        tok_s = serve({})
     fast = stats["grouped"] + stats["ep_ring"]
     if stats["dense_decode"] or not fast:
-        out["moe_invalid"] = (
-            f"dense fallback served the measured grouped leg "
-            f"(trace stats {stats})")
+        why = ("dense fallback served the measured grouped leg"
+               if stats["dense_decode"] else
+               "no MoE graph traced in the measured leg (warm executable "
+               "reused?)")
+        out["moe_invalid"] = f"{why} (trace stats {stats})"
         _note(f"MoE phase INVALID: {out['moe_invalid']}")
         return out
     out["moe_decode_tok_per_s"] = round(tok_s, 1)

@@ -37,6 +37,7 @@ and fallback):
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import os
 from dataclasses import dataclass
@@ -221,6 +222,25 @@ def grouped_trace_stats() -> dict:
 def reset_grouped_trace_stats() -> None:
     for k in _TRACE_STATS:
         _TRACE_STATS[k] = 0
+
+
+@contextlib.contextmanager
+def trace_stats_scope():
+    """Isolate the trace counters around one measured region.
+
+    Yields a dict that on exit holds the counter DELTAS ticked inside the
+    ``with`` body — the bench honesty gate reads this instead of a global
+    reset/read pair, so pre-existing counter state can't leak in and a region
+    that traced NO MoE graph at all (e.g. a warm executable silently reused)
+    reports all-zero deltas, which the gate refuses loudly rather than
+    mistaking stale global counts for fast-path evidence."""
+    before = dict(_TRACE_STATS)
+    delta = dict.fromkeys(_TRACE_STATS, 0)
+    try:
+        yield delta
+    finally:
+        for k in _TRACE_STATS:
+            delta[k] = _TRACE_STATS[k] - before[k]
 
 
 def grouped_moe_enabled() -> bool:
@@ -531,8 +551,12 @@ def _ring_moe(x, gates, lp, moe: MoEArgs, activation, mesh, rules, e_ax, m_ax):
         return None                     # quantized leaves keep GSPMD dequant
     expert_fn = functools.partial(_local_expert_combine, moe=moe,
                                   activation=activation)
+    # bd is tp-replicated (waxes (e_ax, None)) but added inside every tp
+    # shard's expert_fn; tp_once keeps it to one shard so the finishing tp
+    # psum counts the gate-weighted bias once, like the GSPMD reference
     return expert_ring_moe(x, gates, weights, waxes, mesh, rules,
-                           e_ax, m_ax, expert_fn)
+                           e_ax, m_ax, expert_fn,
+                           tp_once=("bd",) if moe.expert_bias else ())
 
 
 def dense_all_experts(x, gates, lp, moe: MoEArgs, activation, mesh=None,
@@ -571,6 +595,18 @@ def moe_block(lp, args, hn: jnp.ndarray, mesh, rules,
 
     ``lp`` carries this layer's stacked expert weights: ``router`` (H, E), ``wg``/``wu``
     (E, H, I), ``wd`` (E, I, H), plus optional shared-expert weights.
+
+    Fast-path selection (decode only): on a multi-device mesh the ONLY fused
+    route is the EP ring (which runs the grouped kernel per-shard under its
+    shard_map); when the ring is ineligible — ep == 1 pure-TP serving,
+    quantized expert leaves at ep > 1, hybrid remaps off the ep axis — decode
+    keeps the dense all-experts einsums with GSPMD placement even under
+    TPUINF_MOE_GROUPED=1. This is a known perf gap, not an oversight: a
+    trace-level pallas_call cannot consume GSPMD-sharded leaves, so a TP-only
+    grouped path needs its own shard_map wrapper (tp psum + tp_once bias
+    handling, exactly the ring's finishing step) — tracked in ROADMAP under
+    the MoE open item. Single-device decode takes the grouped kernel
+    directly.
     """
     moe: MoEArgs = args.moe
     # decode graphs constrain expert activations to the decode_* MoE axes, which

@@ -297,7 +297,7 @@ def moe_ep_phase(mesh, rules, e_ax: str, m_ax: str) -> bool:
 
 def expert_ring_moe(x, gates, weights: Dict[str, jnp.ndarray],
                     waxes: Dict[str, tuple], mesh, rules, e_ax: str,
-                    m_ax: str, expert_fn):
+                    m_ax: str, expert_fn, tp_once: tuple = ()):
     """Overlap-scheduled expert-parallel dispatch/combine.
 
     Replaces the GSPMD combine all-reduce of the dense all-experts MoE with an
@@ -317,6 +317,14 @@ def expert_ring_moe(x, gates, weights: Dict[str, jnp.ndarray],
     ``expert_fn(x_tile, gates_tile, local_weights) -> (n, H) f32`` computes
     one shard's local-experts contribution (ops/moe._local_expert_combine —
     which reuses the grouped Pallas kernel when eligible).
+
+    ``tp_once`` names ADDITIVE leaves that are replicated over tp (no tp axis
+    in their resolved sharding — e.g. the (E, H) down-projection bias): when
+    the expert-mlp dim is tp-sharded, every tp shard's expert_fn adds its
+    (identical) copy and the finishing tp psum would count the term tp times,
+    so these leaves are zeroed on every tp rank but 0 before expert_fn sees
+    them (an exact 0/1 mask — the psum then contributes the term once, same
+    as the GSPMD reference).
 
     Returns the replicated (N, H) combine in x.dtype, or None when shapes
     don't divide the ring (caller keeps GSPMD placement). Bit-exactness with
@@ -354,6 +362,12 @@ def expert_ring_moe(x, gates, weights: Dict[str, jnp.ndarray],
 
     def _local(xl, gl, *wl_flat):
         wl = dict(zip(names, wl_flat))
+        if tp_partial and tp_once:
+            # tp-replicated additive leaves must survive the tp psum once,
+            # not once per shard: keep rank 0's copy, zero the rest
+            keep = (jax.lax.axis_index(AXIS_TP) == 0)
+            for nm in tp_once:
+                wl[nm] = wl[nm] * keep.astype(wl[nm].dtype)
         rk = jax.lax.axis_index(AXIS_EP)
         n_loc = xl.shape[0] // ep
 

@@ -112,18 +112,29 @@ def test_grouped_env_toggle_and_trace_stats(expert_weights, monkeypatch):
 
 
 # ------------------------------------------------------- EP ring vs GSPMD
-@pytest.mark.parametrize("tp,ep", [(1, 2), (1, 4), (2, 4)])
-def test_ep_ring_matches_gspmd_fallback(expert_weights, monkeypatch, tp, ep):
+@pytest.mark.parametrize("tp,ep,bias", [(1, 2, False), (1, 4, False),
+                                        (2, 4, False), (1, 2, True),
+                                        (2, 4, True)])
+def test_ep_ring_matches_gspmd_fallback(expert_weights, monkeypatch, tp, ep,
+                                        bias):
     """The overlap-scheduled expert ring and the GSPMD all-reduce combine are
     the same math to f32 reassociation (the ring sums expert partials in hop
     order, the all-reduce in rank order — a few ulp on the final sums). The
     compiled schedules differ exactly as designed: ep-1 collective permutes +
     1 tiled all-gather on the ring, one all-reduce (and no permute) on the
-    fallback."""
-    margs = M.MoEArgs(num_experts=E, experts_per_tok=2)
+    fallback. The expert_bias cases pin the gpt-oss-shaped leaves — in
+    particular (tp=2, ep=4), where the tp-replicated down bias must survive
+    the ring's finishing tp psum exactly once (the tp_once mask), not once
+    per tp shard."""
+    margs = M.MoEArgs(num_experts=E, experts_per_tok=2, expert_bias=bias)
     args = SimpleNamespace(moe=margs)
     lp = {k: jnp.asarray(expert_weights[k])
           for k in ("router", "wg", "wu", "wd")}
+    if bias:
+        brng = np.random.default_rng(3)
+        lp["bg"] = jnp.asarray(brng.normal(size=(E, I), scale=0.1), jnp.float32)
+        lp["bu"] = jnp.asarray(brng.normal(size=(E, I), scale=0.1), jnp.float32)
+        lp["bd"] = jnp.asarray(brng.normal(size=(E, H), scale=0.1), jnp.float32)
     hn = jnp.asarray(expert_weights["x"]).reshape(2, 4, H)
     mesh = build_mesh(tp_degree=tp, ep_degree=ep)
     rules = dict(DEFAULT_RULES)
