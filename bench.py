@@ -701,6 +701,22 @@ def main() -> None:
             except Exception as e:
                 _note(f"memledger phase failed: {e}")
 
+        if paged_app is not None and _remaining() > 180:
+            # ISSUE-17 disaggregated-pools phase: the open-loop interference
+            # trace on a 1-prefill + 1-decode pooled fleet (remote_prefill +
+            # live KV handoff) vs a 2-replica unified control. Publishes the
+            # per-leg prefill-interference ratios, TTFT p99, handoff
+            # latency/bytes/overlap; REFUSES (pools_invalid) if no handoff
+            # fired or any stream diverged from the control.
+            _note("phase: disaggregated prefill/decode pools (live KV "
+                  "handoff vs unified control)")
+            try:
+                extra.update(_pooled_serving(
+                    paged_app, paged_app.tpu_config.max_batch_size,
+                    extra.get("paged_serving_tok_per_s")))
+            except Exception as e:
+                _note(f"pooled phase failed: {e}")
+
     # FINAL EMIT: same schema, enriched extra. The driver parses the last JSON
     # line; if the process was killed earlier, the early emit already landed.
     # apply_to_extra is the structural refusal net (idempotent): any
@@ -1674,6 +1690,178 @@ def _router_fault_serving(app, batch, closed_loop_tok_s, n_replicas=2):
     })
     if f["lost"] or not exact:
         _note(f"FAULT PHASE REGRESSION: lost={f['lost']} bit_exact={exact}")
+    return out
+
+
+def _drive_router_open_loop_ttft(router, prompts, arrivals, max_new):
+    """Open-loop router driver that also measures FRONTEND TTFT: wall time
+    from each request's scheduled arrival to its first folded token (robust
+    to migration/handoff — the fold is placement-agnostic). Returns
+    (wall_s, rids, ttft_s_list)."""
+    import time as _time
+
+    t0 = _time.perf_counter()
+    idx = 0
+    rids = []
+    first = {}
+    while idx < len(arrivals) or router.has_work:
+        now = _time.perf_counter() - t0
+        while idx < len(arrivals) and arrivals[idx] <= now:
+            rids.append(router.submit(prompts[idx], max_new_tokens=max_new,
+                                      arrival_ts=t0 + arrivals[idx]))
+            idx += 1
+        if not router.has_work:
+            _time.sleep(max(0.0, arrivals[idx] - (_time.perf_counter() - t0)))
+            continue
+        emitted = router.step()
+        tnow = _time.perf_counter() - t0
+        for rid, toks in emitted.items():
+            if toks and rid not in first:
+                first[rid] = tnow
+    wall = _time.perf_counter() - t0
+    ttft = [first[rid] - arrivals[i] for i, rid in enumerate(rids)
+            if rid in first]
+    return wall, rids, ttft
+
+
+def _pooled_serving(app, batch, closed_loop_tok_s):
+    """ISSUE-17 disaggregated-pools phase: the open-loop interference trace
+    served twice by two-replica fleets on the same app —
+
+    - **pooled**: 1 prefill-pool + 1 decode-pool replica under the
+      ``remote_prefill`` policy, committed KV blocks handed off LIVE
+      (serving/pools.py) with the transfer overlapped against the remaining
+      prefill chunks;
+    - **unified**: 2 unified replicas under affinity placement (the
+      pre-pools fleet) — same trace, same geometry, so the interference
+      delta is the topology's doing.
+
+    ``pooled_prefill_interference_ratio`` is the share of decode-serving
+    step time spent on prefill-family dispatches (``prefill_tokens > 0``;
+    the ``kv_handoff`` transfer itself is excluded and priced separately by
+    the handoff keys): on the pooled leg that is the DECODE replica's share
+    (expected near zero — prefill landed on the other pool), on the unified
+    control every replica's (prefill waves collide with resident decodes).
+
+    HONESTY GUARD (r5 pattern): the keys REFUSE — ``pools_invalid`` — if no
+    handoff actually completed, no bytes moved, any stream diverged from the
+    unified control (both legs are greedy: the control IS the dedicated
+    reference), or a request was lost."""
+    import gc
+
+    from neuronx_distributed_inference_tpu.runtime.continuous_batching import (
+        ContinuousBatchingRunner)
+    from neuronx_distributed_inference_tpu.serving import (EngineReplica,
+                                                           HostKVTier,
+                                                           PrefixAffinityRouter)
+
+    cfg = app.tpu_config
+    slots = max(2, batch // 4)
+    n_req = 8
+    prompt_len = max(2 * cfg.pa_block_size, min(256, cfg.seq_len // 4))
+    prefix_len = max(cfg.pa_block_size,
+                     (prompt_len // 2 // cfg.pa_block_size)
+                     * cfg.pa_block_size)
+    max_new = min(128, cfg.seq_len - prompt_len - 8)
+    if max_new < 4:
+        raise ValueError(f"seq_len {cfg.seq_len} too small for the pooled "
+                         f"phase")
+    rate = 0.5 * (closed_loop_tok_s or 2000.0) / max_new
+    rng = np.random.default_rng(29)
+    arrivals = np.cumsum(rng.exponential(1.0 / rate, size=n_req))
+    prefixes = [rng.integers(1, 100000, size=(prefix_len,)).astype(np.int32)
+                for _ in range(2)]
+    prompts = [np.concatenate([
+        prefixes[i % 2],
+        rng.integers(1, 100000,
+                     size=(prompt_len - prefix_len,)).astype(np.int32)])
+        for i in range(n_req)]
+    # chunked prompt insertion (multiple windows per prompt) is what gives
+    # the handoff chunks to overlap against — same cap on BOTH legs
+    insert_cap = 2 * cfg.pa_block_size
+
+    def build(leg):
+        def mk(i, role):
+            tier = HostKVTier(capacity_blocks=4 * slots)
+            return EngineReplica(
+                str(i), lambda tel, t=tier: ContinuousBatchingRunner(
+                    app, decode_chunk=32, telemetry=tel, kv_tier=t,
+                    max_insert_tokens_per_step=insert_cap),
+                telemetry_enabled=True, pool_role=role)
+        if leg == "pooled":
+            reps = [mk(0, "prefill"), mk(1, "decode")]
+            return PrefixAffinityRouter(reps, policy="remote_prefill"), reps
+        reps = [mk(0, "unified"), mk(1, "unified")]
+        return PrefixAffinityRouter(reps, policy="affinity"), reps
+
+    def interference(reps, decode_only):
+        t_pref = t_all = 0.0
+        for rep in reps:
+            if decode_only and rep.pool_role != "decode":
+                continue
+            for r in rep.runner.telemetry.steps:
+                d = r.get("dur_s", 0.0)
+                t_all += d
+                if (r.get("kind") != "kv_handoff"
+                        and r.get("prefill_tokens", 0) > 0):
+                    t_pref += d
+        return (t_pref / t_all) if t_all > 0 else None
+
+    runs = {}
+    for leg in ("pooled", "unified"):
+        router, reps = build(leg)
+        wall, rids, ttft = _drive_router_open_loop_ttft(router, prompts,
+                                                        arrivals, max_new)
+        s = router.stats()
+        runs[leg] = {
+            "tok_per_s": s["tokens"] / wall,
+            "streams": {i: list(router.requests[rid].generated)
+                        for i, rid in enumerate(rids)},
+            "ttft": ttft,
+            "interference": interference(reps,
+                                         decode_only=(leg == "pooled")),
+            "pools": s.get("pools"),
+            "lost": s["requests"] - s["finished"],
+        }
+        for rep in reps:
+            _drain_runner(rep.runner)
+        del router, reps
+        gc.collect()
+
+    p, u = runs["pooled"], runs["unified"]
+    ps = p["pools"] or {}
+    out = {"pooled_handoff_channel": ps.get("channel"),
+           "unified_prefill_interference_ratio": (
+               round(u["interference"], 4)
+               if u["interference"] is not None else None),
+           "unified_decode_tok_per_s": round(u["tok_per_s"], 1)}
+    exact = all(p["streams"][i] == u["streams"][i] for i in range(n_req))
+    if (ps.get("completed", 0) == 0 or ps.get("bytes_total", 0) == 0
+            or not exact or p["lost"] or p["interference"] is None
+            or u["interference"] is None):
+        out["pools_invalid"] = (
+            f"pooled leg unusable: handoffs_completed={ps.get('completed')} "
+            f"bytes={ps.get('bytes_total')} bit_exact={exact} "
+            f"lost={p['lost']} — disaggregation numbers over a run where "
+            f"no live handoff fired (or streams diverged) are vacuous")
+        _note(f"pooled phase INVALID: {out['pools_invalid']}")
+        return out
+    out.update({
+        "pooled_prefill_interference_ratio": round(p["interference"], 4),
+        "pooled_decode_tok_per_s": round(p["tok_per_s"], 1),
+        "pooled_ttft_p99_ms": round(_p_ms(p["ttft"], "latency_ms_p99"), 3),
+        "unified_ttft_p99_ms": round(_p_ms(u["ttft"], "latency_ms_p99"), 3),
+        "handoffs_completed_total": ps["completed"],
+        "handoff_bytes_total": ps["bytes_total"],
+        "handoff_overlap_ratio": round(ps["overlap_ratio"], 4),
+        "handoff_latency_ms_p50": ps["latency_ms_p50"],
+        "handoff_latency_ms_p99": ps["latency_ms_p99"],
+        "pooled_streams_bit_exact": exact,
+    })
+    if p["interference"] >= (u["interference"] or 1.0):
+        _note(f"POOLED PHASE: interference NOT below unified control "
+              f"(pooled={p['interference']:.4f} "
+              f"unified={u['interference']:.4f})")
     return out
 
 

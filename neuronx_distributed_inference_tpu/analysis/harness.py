@@ -256,14 +256,44 @@ def _exercise_serving_tier() -> Any:
     """Host-RAM KV tiering through a paged CB runner: serve a prompt with two
     full prefix blocks, force the idle blocks to spill to the host tier, then
     serve a same-prefix prompt so the cb.paged.tier_readmit scatter actually
-    dispatches (the audit needs its captured example)."""
+    dispatches (the audit needs its captured example). Then run a two-pool
+    disaggregated fleet (serving/pools.py) so a prefill->decode live handoff
+    drives the bucketed cb.paged.kv_handoff scatter on the decode side."""
     from ..runtime.continuous_batching import ContinuousBatchingRunner
+    from ..serving.engine import EngineReplica
     from ..serving.kv_tiering import HostKVTier
+    from ..serving.router import PrefixAffinityRouter
 
     app = _tiny_app(paged=True, cb=True)
+    rng = np.random.default_rng(21)
+
+    # pooled fleet FIRST: every tiered runner eagerly registers the
+    # tier_readmit dispatch (later-wins), so the standalone spill/readmit
+    # runner below must be constructed LAST to own the captured example; the
+    # kv_handoff step is built lazily on first receive, so only d0 ever
+    # registers it and its example survives.
+    def _rep(rid: str, role: str) -> EngineReplica:
+        # chunked prefill (insert cap) so committed blocks exist while the
+        # source is still prefilling — the handoff stages DURING prefill
+        return EngineReplica(
+            rid, lambda tel: ContinuousBatchingRunner(
+                app, decode_chunk=4, telemetry=tel,
+                max_insert_tokens_per_step=16,
+                kv_tier=HostKVTier(capacity_blocks=16)),
+            pool_role=role)
+
+    router = PrefixAffinityRouter(
+        [_rep("p0", "prefill"), _rep("d0", "decode")],
+        policy="remote_prefill", pool_config={"channel": "device"})
+    router.submit(rng.integers(1, 256, size=(40,)).astype(np.int32),
+                  max_new_tokens=6)
+    router.run_to_completion()
+    if router.pools.stats()["completed"] < 1:
+        raise RuntimeError("pool harness never completed a handoff — the "
+                           "cb.paged.kv_handoff example was not captured")
+
     tier = HostKVTier(capacity_blocks=16)
     runner = ContinuousBatchingRunner(app, decode_chunk=4, kv_tier=tier)
-    rng = np.random.default_rng(21)
     prefix = rng.integers(1, 256, size=(16,)).astype(np.int32)   # 2 blocks
     tail = rng.integers(1, 256, size=(4,)).astype(np.int32)
     runner.submit(np.concatenate([prefix, tail]), max_new_tokens=4)
@@ -275,7 +305,7 @@ def _exercise_serving_tier() -> Any:
     if runner.kv_tier.readmit_blocks < 2:
         raise RuntimeError("tier harness never re-admitted — the "
                            "cb.paged.tier_readmit example was not captured")
-    return runner
+    return (runner, router)
 
 
 def _exercise_mm() -> Any:
@@ -399,7 +429,8 @@ SCOPES: Dict[str, Tuple] = {
     "cb_megastep": (_exercise_cb_megastep, ("cb.paged.megastep",)),
     "cb_spec": (_exercise_cb_spec, ("cb.spec.chunk", "cb.spec.insert_pair")),
     "cb_eagle": (_exercise_cb_eagle, ("cb.eagle.insert", "cb.eagle.chunk")),
-    "serving_tier": (_exercise_serving_tier, ("cb.paged.tier_readmit",)),
+    "serving_tier": (_exercise_serving_tier,
+                     ("cb.paged.tier_readmit", "cb.paged.kv_handoff")),
     "spec": (_exercise_spec, ("spec.chunk",)),
     "eagle": (_exercise_eagle, ("eagle.prefill", "eagle.chunk")),
     "eagle3": (_exercise_eagle3, ("eagle3.prefill", "eagle3.chunk")),

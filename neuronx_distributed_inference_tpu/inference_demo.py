@@ -153,6 +153,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "on prefix hits")
     g.add_argument("--kv-tier-blocks", type=int, default=1024, metavar="N",
                    help="host-RAM tier capacity in KV blocks (default 1024)")
+    g.add_argument("--pool-split", default=None, metavar="P:D",
+                   help="with --serve --replicas N: disaggregate the fleet "
+                        "into P prefill-pool + D decode-pool replicas "
+                        "(P+D=N) under the remote_prefill policy — arrivals "
+                        "prefill on the P pool, then their KV blocks hand "
+                        "off LIVE to the D pool for decode "
+                        "(serving/pools.py). Requires --paged-attention")
+    g.add_argument("--handoff-channel", default="device",
+                   choices=("device", "tier"),
+                   help="with --pool-split: how handed-off KV blocks move — "
+                        "'device' (bucketed gather/scatter sessions, "
+                        "cb.paged.kv_handoff) or 'tier' (through the "
+                        "checksummed host tier; requires --kv-host-tier)")
     g.add_argument("--sla-classes", default=None, metavar="SPEC",
                    help="with --serve: SLA class set (serving/sla.py "
                         "grammar, e.g. \"interactive:priority=0,weight=4;"
@@ -799,11 +812,29 @@ def _run_serving_routed(args, app, tokenizer) -> None:
                         or args.debug_bundle)
     tier = (HostKVTier(capacity_blocks=args.kv_tier_blocks)
             if args.kv_host_tier else None)
+    pool_roles = None
+    if args.pool_split:
+        # disaggregated pools (serving/pools.py): P prefill + D decode
+        try:
+            n_pre, n_dec = (int(x) for x in args.pool_split.split(":"))
+        except ValueError:
+            raise SystemExit("--pool-split wants PREFILL:DECODE, e.g. 1:1")
+        if n_pre < 1 or n_dec < 1:
+            raise SystemExit("--pool-split needs >= 1 replica per pool")
+        if n_pre + n_dec != args.replicas:
+            raise SystemExit(f"--pool-split {args.pool_split} must sum to "
+                             f"--replicas {args.replicas}")
+        if not app.tpu_config.paged_attention_enabled:
+            raise SystemExit("--pool-split requires --paged-attention")
+        if args.handoff_channel == "tier" and tier is None:
+            raise SystemExit("--handoff-channel tier requires --kv-host-tier")
+        pool_roles = ["prefill"] * n_pre + ["decode"] * n_dec
     replicas = [
         EngineReplica(str(i),
                       lambda tel: ContinuousBatchingRunner(
                           app, telemetry=tel, kv_tier=tier, **kw),
                       telemetry_enabled=telemetry_on,
+                      pool_role=(pool_roles[i] if pool_roles else "unified"),
                       # one JSONL spool per replica (events interleave
                       # meaninglessly in one file; suffix keeps them apart)
                       jsonl_path=(f"{args.events_out}.replica{i}"
@@ -815,13 +846,19 @@ def _run_serving_routed(args, app, tokenizer) -> None:
 
         injector = FaultInjector(args.inject_faults)
     router = PrefixAffinityRouter(
-        replicas, fault_injector=injector, auto_recover=True,
+        replicas,
+        policy=("remote_prefill" if pool_roles else "affinity"),
+        fault_injector=injector, auto_recover=True,
         sla_classes=sla_classes,
+        pool_config=({"channel": args.handoff_channel}
+                     if pool_roles else None),
         debug_bundle_dir=(os.path.dirname(args.debug_bundle) or "."
                           if args.debug_bundle else None))
-    logger.info("routed serving: %d replicas, kv host tier: %s, faults: %s, "
-                "sla: %s",
+    logger.info("routed serving: %d replicas, pools: %s, kv host tier: %s, "
+                "faults: %s, sla: %s",
                 args.replicas,
+                (f"{args.pool_split} via {args.handoff_channel}"
+                 if pool_roles else "off"),
                 f"{args.kv_tier_blocks} blocks" if tier else "off",
                 args.inject_faults or "off",
                 sla_classes if sla_classes is not None else "off")
@@ -907,6 +944,14 @@ def _run_serving_routed(args, app, tokenizer) -> None:
                 "affinity_hits=%d, spills=%d, migrations=%d",
                 s["finished"], s["tokens"], s["affinity_hits"],
                 s["affinity_spills"], s["migrations"])
+    if "pools" in s:
+        ps = s["pools"]
+        logger.info("pool summary: %d handoffs completed (%d deferred, "
+                    "aborted=%s), %d blocks / %d bytes moved, "
+                    "overlap_ratio=%.3f, latency_ms_p50=%s",
+                    ps["completed"], ps["deferred"], ps["aborted"],
+                    ps["blocks_total"], ps["bytes_total"],
+                    ps["overlap_ratio"], ps["latency_ms_p50"])
     if injector is not None or s["failures"]:
         logger.info("fault-tolerance summary: faults_injected=%d, "
                     "failures=%d, recoveries=%d, recovered_requests=%d, "

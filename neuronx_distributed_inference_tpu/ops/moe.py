@@ -33,6 +33,12 @@ and fallback):
   accumulate over the ep axis whose ppermutes hide behind the local expert
   matmuls (the PR 5 row_projection template), with the grouped kernel serving
   each shard's local experts. ``TPUINF_EP_OVERLAP=0`` falls back to GSPMD.
+- **Pure-TP grouped combine** (`parallel/overlap.expert_tp_moe`): on ep == 1,
+  tp > 1 meshes the shard_map wrapper runs the grouped kernel over each chip's
+  tp column slice of the expert mlp dim and finishes with one tp psum —
+  exactly the ring's finishing step without the ring, closing the gap where a
+  trace-level pallas_call could not consume GSPMD-sharded leaves.
+  ``TPUINF_MOE_TP_GROUPED=0`` falls back to GSPMD.
 """
 
 from __future__ import annotations
@@ -48,7 +54,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ..parallel.overlap import expert_ring_moe, moe_ep_phase
+from ..parallel.overlap import (expert_ring_moe, expert_tp_moe, moe_ep_phase,
+                                moe_tp_phase)
 from ..parallel.sharding import constrain
 from .quantization import qapply, qeinsum
 
@@ -211,7 +218,8 @@ def route(router_w: jnp.ndarray, x: jnp.ndarray, moe: MoEArgs,
 # trace-time counters per routed-MoE implementation actually lowered into a
 # graph since the last reset — bench.py's honesty gate (a "dense_decode" tick
 # during the measured MoE leg means the fast path silently declined)
-_TRACE_STATS = {"grouped": 0, "ep_ring": 0, "dense_decode": 0}
+_TRACE_STATS = {"grouped": 0, "ep_ring": 0, "tp_grouped": 0,
+                "dense_decode": 0}
 
 
 def grouped_trace_stats() -> dict:
@@ -559,6 +567,29 @@ def _ring_moe(x, gates, lp, moe: MoEArgs, activation, mesh, rules, e_ax, m_ax):
                            tp_once=("bd",) if moe.expert_bias else ())
 
 
+def _tp_grouped_moe(x, gates, lp, moe: MoEArgs, activation, mesh, rules,
+                    e_ax, m_ax):
+    """Pure-TP grouped combine (parallel/overlap.expert_tp_moe) for the routed
+    experts at ep == 1; None when the phase/leaves are ineligible."""
+    names = ["wg", "wu", "wd"]
+    waxes = {"wg": (e_ax, None, m_ax), "wu": (e_ax, None, m_ax),
+             "wd": (e_ax, m_ax, None)}
+    if moe.expert_bias:
+        names += ["bg", "bu", "bd"]
+        waxes.update(bg=(e_ax, m_ax), bu=(e_ax, m_ax), bd=(e_ax, None))
+    weights = {k: lp[k] for k in names}
+    if any(isinstance(w, dict) for w in weights.values()):
+        return None                     # quantized leaves keep GSPMD dequant
+    expert_fn = functools.partial(_local_expert_combine, moe=moe,
+                                  activation=activation)
+    # bd is tp-replicated (waxes (e_ax, None)) but added inside every tp
+    # shard's expert_fn; tp_once keeps it to one shard so the finishing tp
+    # psum counts the gate-weighted bias once, like the GSPMD reference
+    return expert_tp_moe(x, gates, weights, waxes, mesh, rules,
+                         e_ax, m_ax, expert_fn,
+                         tp_once=("bd",) if moe.expert_bias else ())
+
+
 def dense_all_experts(x, gates, lp, moe: MoEArgs, activation, mesh=None,
                       rules=None, e_ax="experts", m_ax="expert_mlp"):
     """The dense all-experts routed-MoE reference: (E, N, I) intermediates,
@@ -596,17 +627,17 @@ def moe_block(lp, args, hn: jnp.ndarray, mesh, rules,
     ``lp`` carries this layer's stacked expert weights: ``router`` (H, E), ``wg``/``wu``
     (E, H, I), ``wd`` (E, I, H), plus optional shared-expert weights.
 
-    Fast-path selection (decode only): on a multi-device mesh the ONLY fused
-    route is the EP ring (which runs the grouped kernel per-shard under its
-    shard_map); when the ring is ineligible — ep == 1 pure-TP serving,
-    quantized expert leaves at ep > 1, hybrid remaps off the ep axis — decode
-    keeps the dense all-experts einsums with GSPMD placement even under
-    TPUINF_MOE_GROUPED=1. This is a known perf gap, not an oversight: a
-    trace-level pallas_call cannot consume GSPMD-sharded leaves, so a TP-only
-    grouped path needs its own shard_map wrapper (tp psum + tp_once bias
-    handling, exactly the ring's finishing step) — tracked in ROADMAP under
-    the MoE open item. Single-device decode takes the grouped kernel
-    directly.
+    Fast-path selection (decode only): on a multi-device mesh the fused routes
+    are the EP ring at ep > 1 (``moe_ep_phase`` -> ``_ring_moe``) and the
+    pure-TP grouped wrapper at ep == 1, tp > 1 (``moe_tp_phase`` ->
+    ``_tp_grouped_moe`` — the ring's finishing tp psum + tp_once bias
+    handling without the ring, since a trace-level pallas_call cannot consume
+    GSPMD-sharded leaves and needs the shard_map to see per-chip slices).
+    Both run the grouped kernel per-shard when TPUINF_MOE_GROUPED allows and
+    the local slices are eligible, exact einsums otherwise. When neither
+    phase engages — quantized expert leaves, hybrid remaps off the expected
+    axes, cp > 1 — decode keeps the dense all-experts einsums with GSPMD
+    placement. Single-device decode takes the grouped kernel directly.
     """
     moe: MoEArgs = args.moe
     # decode graphs constrain expert activations to the decode_* MoE axes, which
@@ -630,6 +661,11 @@ def moe_block(lp, args, hn: jnp.ndarray, mesh, rules,
                                    e_ax, m_ax)
                 if routed is not None:
                     _TRACE_STATS["ep_ring"] += 1
+            elif moe_tp_phase(mesh, rules, e_ax, m_ax):
+                routed = _tp_grouped_moe(x, gates, lp, moe, activation, mesh,
+                                         rules, e_ax, m_ax)
+                if routed is not None:
+                    _TRACE_STATS["tp_grouped"] += 1
         elif grouped_moe_enabled():
             routed = moe_decode_grouped(x, gates, lp, moe, activation)
             if routed is not None:

@@ -391,6 +391,100 @@ def expert_ring_moe(x, gates, weights: Dict[str, jnp.ndarray],
     return fn(x, gates.astype(jnp.float32), *(weights[k] for k in names))
 
 
+def moe_tp_grouped_enabled() -> bool:
+    """TPUINF_MOE_TP_GROUPED=0 keeps pure-TP MoE decode on the dense GSPMD
+    einsums (the pre-ISSUE-17 behaviour). Read at TRACE time, like
+    TPUINF_EP_OVERLAP."""
+    return os.environ.get("TPUINF_MOE_TP_GROUPED", "1") != "0"
+
+
+def moe_tp_phase(mesh, rules, e_ax: str, m_ax: str) -> bool:
+    """Decide whether THIS trace's MoE decode takes the pure-TP grouped
+    shard_map path (``expert_tp_moe``) instead of the GSPMD dense einsums.
+
+    The wrapper is the EP ring's finishing step without the ring: every chip
+    holds ALL experts but only a tp column slice of the expert mlp dim, so a
+    per-shard grouped combine plus one tp psum reproduces the GSPMD
+    all-reduce. It requires ep == 1 (ep > 1 belongs to ``moe_ep_phase``),
+    tp > 1, cp == 1, the expert-mlp axis mapped to exactly ``tp``, and the
+    experts axis unsharded on any live mesh axis (sharded experts at ep == 1
+    would leave each chip with a partial expert set and no ring to combine
+    them).
+    """
+    if mesh is None or not moe_tp_grouped_enabled():
+        return False
+    shape = dict(mesh.shape)
+    if shape.get(AXIS_EP, 1) != 1:
+        return False
+    if shape.get(AXIS_TP, 1) <= 1:
+        return False
+    if shape.get(AXIS_CP, 1) != 1:
+        return False
+    r = rules or DEFAULT_RULES
+    if r.get(m_ax) != AXIS_TP:
+        return False
+    ea = r.get(e_ax)
+    if ea is not None and shape.get(ea, 1) != 1:
+        return False
+    return True
+
+
+def expert_tp_moe(x, gates, weights: Dict[str, jnp.ndarray],
+                  waxes: Dict[str, tuple], mesh, rules, e_ax: str,
+                  m_ax: str, expert_fn, tp_once: tuple = ()):
+    """Pure-TP grouped MoE combine: the ring's finishing step without the ring.
+
+    At ep == 1 with the expert mlp dim tp-sharded, every chip holds all
+    experts' column slices, so the routed combine is one per-shard all-experts
+    pass over the LOCAL slices followed by a single tp psum — exactly the sum
+    GSPMD places after the dense einsums, but computed through ``expert_fn``
+    (ops/moe._local_expert_combine, which reuses the grouped Pallas kernel
+    when eligible). A trace-level pallas_call cannot consume GSPMD-sharded
+    leaves, so this shard_map wrapper is what lets TPUINF_MOE_GROUPED reach
+    multi-chip pure-TP serving at all.
+
+    Arguments mirror ``expert_ring_moe``: x (N, H) tokens (``batch``
+    dp-sharded, replicated over tp), gates (N, E) f32 router gates, plain
+    expert leaves in ``weights`` with logical axes in ``waxes``. ``tp_once``
+    names additive leaves replicated over tp (the (E, H) down bias): each
+    shard's expert_fn would add its identical copy and the psum would count it
+    tp times, so every rank but 0 sees an exact zero (same 0/1 mask as the
+    ring).
+
+    Returns the replicated (N, H) combine in x.dtype, or None when the leaves
+    are quantized (GSPMD keeps the dequant placement). Exactness against the
+    dense fallback is pinned by tests/test_moe_serving.py.
+    """
+    r = rules or DEFAULT_RULES
+    shape = dict(mesh.shape)
+    tp = shape.get(AXIS_TP, 1)
+    if tp <= 1:
+        return None
+    if any(isinstance(w, dict) for w in weights.values()):
+        return None
+
+    names = list(weights)
+    in_specs = (logical_to_spec(("batch", None), r),
+                logical_to_spec(("batch", None), r)) + tuple(
+                    logical_to_spec(waxes[k], r) for k in names)
+    out_spec = logical_to_spec(("batch", None), r)
+
+    def _local(xl, gl, *wl_flat):
+        wl = dict(zip(names, wl_flat))
+        if tp_once:
+            # tp-replicated additive leaves must survive the tp psum once,
+            # not once per shard: keep rank 0's copy, zero the rest
+            keep = (jax.lax.axis_index(AXIS_TP) == 0)
+            for nm in tp_once:
+                wl[nm] = wl[nm] * keep.astype(wl[nm].dtype)
+        acc = expert_fn(xl, gl, wl)
+        acc = jax.lax.psum(acc, AXIS_TP)
+        return acc.astype(xl.dtype)
+
+    fn = _shard_map(_local, mesh, in_specs, out_spec)
+    return fn(x, gates.astype(jnp.float32), *(weights[k] for k in names))
+
+
 def estimated_ep_bytes_per_step(num_moe_layers: int, hidden: int, ep: int,
                                 tokens: int, dtype_bytes: int = 2) -> int:
     """Analytic per-decode-step expert dispatch/combine ICI bytes of the ring

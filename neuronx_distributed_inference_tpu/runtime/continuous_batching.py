@@ -515,6 +515,13 @@ class ContinuousBatchingRunner:
                 raise ValueError("kv_tier does not compose with speculative "
                                  "serving yet (the draft pool's blocks are "
                                  "not captured by the spill path)")
+        # --- pool-to-pool KV handoff sessions (serving/pools.py) --------------
+        # destination-side state: open transfer sessions keyed by session id.
+        # The cb.paged.kv_handoff scatter is built lazily on first receive so
+        # runners that never join a disaggregated pool register no dispatch.
+        self._handoff_sessions: Dict[int, dict] = {}
+        self._handoff_seq = 0
+        self._kv_handoff_step = None
         # --- KV block ledger (serving/memledger.py) ---------------------------
         # ``memledger``: None = auto (attach whenever the allocator exposes
         # the Python seams — the tiered allocator always does; the native C++
@@ -1537,6 +1544,15 @@ class ContinuousBatchingRunner:
             for blk in r.blocks:
                 held[blk] = held.get(blk, 0) + 1
             exp[r.request_id] = held
+        # open KV handoff sessions hold their staged destination blocks under
+        # a negative session id — legitimate for as long as the transfer
+        # overlaps the source's prefill; an abandoned session stops appearing
+        # here and audits as a leak attributed to its session id
+        for sess in self._handoff_sessions.values():
+            held = {}
+            for blk in sess["blocks"]:
+                held[blk] = held.get(blk, 0) + 1
+            exp[sess["rid"]] = held
         return exp
 
     def _kv_fragmentation(self) -> float:
@@ -1580,6 +1596,155 @@ class ContinuousBatchingRunner:
         if self.kv_tier is None:
             return 0
         return self.allocator.spill_idle(keep)
+
+    # -------------------------------------------- pool KV handoff (dest side)
+    # serving/pools.py drives these on a DECODE-pool replica's runner: a
+    # handoff session allocates destination blocks under a NEGATIVE session
+    # holder id (collides with no request id; the roster includes open
+    # sessions so an abandoned one audits as an attributed leak), stages
+    # bytes chunk by chunk with the bucketed cb.paged.kv_handoff scatter
+    # while the SOURCE replica is still prefilling, and publishes the blocks'
+    # prefix-cache hashes only at commit — an aborted session leaves nothing
+    # behind.
+
+    HANDOFF_HOLDER_BASE = -1000
+
+    def handoff_headroom(self) -> int:
+        """Allocatable destination headroom (free + idle blocks) — the
+        decode-pool admission signal (``PoolManager.can_admit``)."""
+        return self.allocator.num_free if self.paged else 0
+
+    def _handoff_ctx(self, sess: dict, seam: str,
+                     expect_exhaustion: bool = False):
+        if self.ledger is None:
+            return contextlib.nullcontext()
+        return self.ledger.context(request_id=sess["rid"], seam=seam,
+                                   expect_exhaustion=expect_exhaustion)
+
+    def handoff_open(self) -> int:
+        """Open a transfer session on this (destination) runner; returns the
+        session id the staging/commit/abort calls key on."""
+        if not self.paged:
+            raise ValueError("KV handoff requires paged attention")
+        if not hasattr(self.allocator, "_alloc_one"):
+            # the native C++ allocator exposes no Python alloc/release/hash
+            # seams for the session to stage through — same constraint as
+            # the fault injector's alloc/leak seams
+            raise ValueError(
+                "KV handoff requires the Python block allocator (enable a "
+                "host KV tier or memledger=True on the destination runner)")
+        self._handoff_seq += 1
+        sid = self._handoff_seq
+        self._handoff_sessions[sid] = {
+            "rid": self.HANDOFF_HOLDER_BASE - sid,
+            "blocks": [], "hashes": []}
+        return sid
+
+    def handoff_receive(self, sid: int, k_new, v_new, hashes,
+                        request_id: Optional[int] = None):
+        """Stage one chunk of handed-off blocks: allocate destination blocks,
+        scatter the bytes (device-to-device when ``k_new``/``v_new`` are the
+        source cache's gather results — ``_read_tier_blocks`` shaped
+        ``(L, n, H, BS, D)``), and hold them ``handoff_inflight`` until
+        commit. Returns the destination block ids, or None when the pool
+        cannot take the chunk (allocation rolled back; the caller defers or
+        falls back to the host-tier channel). ``request_id`` stamps the
+        step-timeline records with the migrating request so its span tree
+        (serving/tracing.py) carries the transfer."""
+        sess = self._handoff_sessions[sid]
+        n = len(hashes)
+        if n == 0:
+            return []
+        fresh: List[int] = []
+        try:
+            with self._handoff_ctx(sess, "handoff_in",
+                                   expect_exhaustion=True):
+                for _ in range(n):
+                    fresh.append(self.allocator._alloc_one())
+        # lint: ok(silent-except): the None return IS the signal — the pool manager counts the deferral (pools stats) and retries next tick or finishes at source
+        except block_kvcache.KVBlocksExhausted:
+            with self._handoff_ctx(sess, "handoff_in"):
+                for blk in fresh:
+                    self.allocator._release_one(blk)
+            return None
+        if self.ledger is not None:
+            self.ledger.handoff_begin(fresh)
+        if self._kv_handoff_step is None:
+            from ..serving.kv_tiering import build_handoff_step
+
+            self._kv_handoff_step = build_handoff_step()
+        from ..serving.kv_tiering import READMIT_BUCKET_CAP, readmit_bucket
+
+        k_new = jnp.asarray(k_new)
+        v_new = jnp.asarray(v_new)
+        tel = self.telemetry
+        for lo in range(0, n, READMIT_BUCKET_CAP):
+            ids = fresh[lo : lo + READMIT_BUCKET_CAP]
+            kc = k_new[:, lo : lo + len(ids)]
+            vc = v_new[:, lo : lo + len(ids)]
+            b = readmit_bucket(len(ids))
+            if b > len(ids):
+                pad = (kc.shape[0], b - len(ids)) + tuple(kc.shape[2:])
+                kc = jnp.concatenate(
+                    [kc, jnp.zeros(pad, dtype=kc.dtype)], axis=1)
+                vc = jnp.concatenate(
+                    [vc, jnp.zeros(pad, dtype=vc.dtype)], axis=1)
+            id_arr = np.full((b,), -1, dtype=np.int32)
+            id_arr[: len(ids)] = ids
+            t0 = tel.step_start()
+            with tel.annotate("kv_handoff"):
+                self.cache, self._telem_dev = self._kv_handoff_step(
+                    self.cache, self._telem_dev, kc, vc,
+                    jnp.asarray(id_arr), block_size=self.block_size)
+            if t0 is not None:
+                tel.step_record(
+                    t0, "kv_handoff", iterations=1,
+                    prefill_tokens=len(ids) * self.block_size,
+                    slots=self.num_slots,
+                    kv_free=self.allocator.num_free,
+                    kv_total=self.allocator.num_blocks,
+                    request_id=request_id)
+        sess["blocks"].extend(fresh)
+        sess["hashes"].extend(hashes)
+        return fresh
+
+    def handoff_commit(self, sid: int) -> Dict[bytes, int]:
+        """Finalize a session: the staged bytes are authoritative, their
+        hashes publish to the prefix cache, and the session's hold releases
+        — on a tiered allocator the hashed blocks park IDLE, exactly the
+        shape ``allocate_for_prompt``'s prefix walk reuses for free when the
+        migrated request re-places here (a plain allocator drops the hash at
+        release, so the transfer commits but yields no cache entry). A hash
+        the destination already holds is skipped — its duplicate block
+        returns to the free list. Returns {hash: block} for the published
+        entries."""
+        sess = self._handoff_sessions.pop(sid)
+        if self.ledger is not None:
+            self.ledger.handoff_committed(sess["blocks"])
+        published: Dict[bytes, int] = {}
+        with self._handoff_ctx(sess, "handoff_commit"):
+            for blk, h in zip(sess["blocks"], sess["hashes"]):
+                if h not in self.allocator.hash_to_block:
+                    self.allocator.hash_to_block[h] = blk
+                    self.allocator.block_to_hash[blk] = h
+                    published[h] = blk
+                self.allocator._release_one(blk)
+        return published
+
+    def handoff_abort(self, sid: int) -> int:
+        """Tear a session down (source replica death, admission fallback):
+        staged blocks return to the free list UNHASHED — nothing
+        half-transferred can ever serve as a prefix-cache entry. Idempotent
+        on unknown session ids; returns the block count released."""
+        sess = self._handoff_sessions.pop(sid, None)
+        if sess is None:
+            return 0
+        if self.ledger is not None:
+            self.ledger.handoff_aborted(sess["blocks"])
+        with self._handoff_ctx(sess, "handoff_abort"):
+            for blk in sess["blocks"]:
+                self.allocator._release_one(blk)
+        return len(sess["blocks"])
 
     # ------------------------------------------------ telemetry (utils/metrics)
     # The runner's historical ad-hoc counters live on the metrics registry
@@ -1703,6 +1868,7 @@ class ContinuousBatchingRunner:
         "mixed": ("_mixed",),
         "insert": ("_insert", "_window", "_seed"),
         "tier_readmit": ("_tier_readmit",),
+        "kv_handoff": ("_kv_handoff",),
         "megastep": ("_megastep",),
     }
 
@@ -1806,6 +1972,7 @@ class ContinuousBatchingRunner:
             "mixed": getattr(self, "_mixed_step", None),
             "megastep": getattr(self, "_megastep_step", None),
             "tier_readmit": getattr(self, "_tier_readmit_step", None),
+            "kv_handoff": getattr(self, "_kv_handoff_step", None),
         }.get(kind)
 
     def _roofline_join(self, timing: Dict[str, dict],

@@ -33,6 +33,12 @@ mesh cannot be millions of users"):
   registered factory under sustained queue/KV/SLO pressure and
   drains+retires them when the fleet idles (two-phase, bit-exact
   migration), with hysteresis and min/max bounds.
+- ``pools``: :class:`PoolManager` — disaggregated prefill/decode pools:
+  replicas carry a pool role, the router's ``remote_prefill`` policy places
+  arrivals on the prefill pool, and on prompt completion each request's
+  committed KV blocks hand off LIVE to a decode-pool replica (device
+  gather/scatter sessions or the checksummed host tier), the transfer
+  overlapped against the remaining prefill chunks.
 - ``memledger``: :class:`BlockLedger` — the accountable-KV-memory layer:
   every physical block attributed to an owner state ({free, live(request),
   idle(hash), host-reserved(hash), readmit-in-flight}), a conservation
@@ -53,6 +59,7 @@ from .memledger import BlockLedger, MemLedgerViolation
 from .faults import (FaultInjector, FaultSpec, InjectedFault,
                      InjectedReplicaDeath)
 from .kv_tiering import HostKVTier
+from .pools import POOL_DECODE, POOL_PREFILL, POOL_UNIFIED, PoolManager
 from .router import (PrefixAffinityRouter, RouterOverloaded, RouterRequest,
                      REPLICA_DEGRADED, REPLICA_FAILED, REPLICA_HEALTHY,
                      REPLICA_RETIRED)
@@ -64,4 +71,5 @@ __all__ = ["EngineReplica", "HostKVTier", "PrefixAffinityRouter",
            "REPLICA_DEGRADED", "REPLICA_FAILED", "REPLICA_RETIRED",
            "SLAClass", "SLAClassSet", "ReplicaAutoscaler",
            "default_class_set", "tracing", "memledger", "BlockLedger",
-           "MemLedgerViolation"]
+           "MemLedgerViolation", "PoolManager", "POOL_PREFILL", "POOL_DECODE",
+           "POOL_UNIFIED"]

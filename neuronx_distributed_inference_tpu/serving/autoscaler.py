@@ -61,7 +61,8 @@ class ReplicaAutoscaler:
                  up_after: int = 2, down_after: int = 4,
                  cooldown_s: float = 10.0,
                  slo_signal: Optional[Callable[[], bool]] = None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 pool: Optional[str] = None):
         if min_replicas < 1:
             raise ValueError("min_replicas must be >= 1")
         if max_replicas < min_replicas:
@@ -81,33 +82,54 @@ class ReplicaAutoscaler:
         self.cooldown_s = float(cooldown_s)
         self.slo_signal = slo_signal
         self.clock = clock
+        # disaggregated pools (serving/pools.py): a non-None ``pool``
+        # restricts EVERYTHING — fleet size, headroom aggregation, drain
+        # victims, min/max bounds — to replicas of that role, so each pool
+        # runs its own autoscaler on its own SLO signal (prefill-pool TTFT
+        # vs decode-pool TPOT) without the two fighting over one fleet.
+        # The replica_factory must build replicas carrying this pool_role.
+        self.pool = pool
         self._up_streak = 0
         self._down_streak = 0
         self._last_action_t: Optional[float] = None
         self._next_id = 0
         self._draining: List[str] = []       # drain issued, retire pending
         reg = router.registry
+        # per-pool autoscalers share one router registry: the pool label
+        # keeps their instruments distinct (two unlabelled gauges of one
+        # name would silently overwrite each other)
+        labels = {"pool": pool} if pool is not None else None
         self._c_up = reg.counter(
             "autoscaler_scale_ups_total",
-            "replicas grown from the factory")
+            "replicas grown from the factory", labels=labels)
         self._c_down = reg.counter(
             "autoscaler_scale_downs_total",
-            "replicas drained + retired (two-phase; counted at retire)")
+            "replicas drained + retired (two-phase; counted at retire)",
+            labels=labels)
         self._g_replicas = reg.gauge(
-            "autoscaler_replicas", "replicas currently in the placement set")
+            "autoscaler_replicas", "replicas currently in the placement set",
+            labels=labels)
         self._g_replicas.set(self._fleet_size())
 
     # -------------------------------------------------------------- signals
+    def _in_scope(self, rep) -> bool:
+        return self.pool is None or getattr(rep, "pool_role",
+                                            "unified") == self.pool
+
     def _fleet_size(self) -> int:
         """Replicas that can take or hold work (FAILED ones don't count —
-        recovery owns them; they are capacity only after reactivation)."""
-        return sum(1 for rid in self.router.replicas
-                   if self.router.replica_state(rid) != "failed")
+        recovery owns them; they are capacity only after reactivation).
+        Pool-scoped when ``pool`` is set."""
+        return sum(1 for rid, rep in self.router.replicas.items()
+                   if self.router.replica_state(rid) != "failed"
+                   and self._in_scope(rep))
 
     def _healthy_admissions(self) -> List[Dict[str, object]]:
         out = []
         for rid, rep in self.router.replicas.items():
             if self.router.replica_state(rid) != "healthy" or rep.draining:
+                continue
+            if not self._in_scope(rep):
                 continue
             try:
                 out.append(rep.admission())
@@ -206,6 +228,8 @@ class ReplicaAutoscaler:
             if (self.router.replica_state(rid) != "healthy" or rep.draining
                     or rid in self._draining):
                 continue
+            if not self._in_scope(rep):
+                continue
             try:
                 a = rep.admission()
             # lint: ok(silent-except): admission probe mid-failure; the supervisor owns the lifecycle
@@ -228,6 +252,7 @@ class ReplicaAutoscaler:
     def stats(self) -> Dict[str, object]:
         return {
             "replicas": self._fleet_size(),
+            "pool": self.pool,
             "min": self.min_replicas, "max": self.max_replicas,
             "draining": list(self._draining),
             "scale_ups": int(self._c_up.value),

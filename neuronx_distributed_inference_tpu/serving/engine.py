@@ -71,14 +71,26 @@ class EngineReplica:
     # many steps even if nothing drains, evicts, or scrapes in between
     LEDGER_AUDIT_EVERY = 64
 
+    #: valid ``pool_role`` values (serving/pools.py re-exports these)
+    POOL_ROLES = ("prefill", "decode", "unified")
+
     def __init__(self, replica_id: str, runner_factory=None, *,
                  runner: Optional[ContinuousBatchingRunner] = None,
                  telemetry_enabled: bool = False,
                  jsonl_path: Optional[str] = None,
-                 max_queue_depth: Optional[int] = None):
+                 max_queue_depth: Optional[int] = None,
+                 pool_role: str = "unified"):
         if (runner is None) == (runner_factory is None):
             raise ValueError("pass exactly one of runner_factory / runner")
+        if pool_role not in self.POOL_ROLES:
+            raise ValueError(f"pool_role must be one of {self.POOL_ROLES}, "
+                             f"got {pool_role!r}")
         self.replica_id = str(replica_id)
+        # disaggregated-pool membership (serving/pools.py): "prefill" replicas
+        # take fresh arrivals, "decode" replicas take handed-off requests,
+        # "unified" replicas take both (the pre-pools default, and what every
+        # placement policy other than remote_prefill treats all roles as)
+        self.pool_role = pool_role
         if runner is None:
             registry = metrics_lib.MetricsRegistry(
                 default_labels={"replica": self.replica_id})
@@ -130,6 +142,7 @@ class EngineReplica:
         out = {
             "replica": self.replica_id,
             "accepting": not self.draining,
+            "pool_role": self.pool_role,
             "queue_depth": len(r.queue),
             "inflight_chunks": len(r._inflight),
             "active_requests": sum(

@@ -46,7 +46,8 @@ from ..utils import device_telemetry as dtel
 logger = logging.getLogger("tpu-inference")
 
 __all__ = ["HostKVTier", "TieredBlockAllocator", "KVBlocksExhausted",
-           "READMIT_BUCKET_CAP", "build_readmit_step", "readmit_bucket"]
+           "READMIT_BUCKET_CAP", "build_handoff_step", "build_readmit_step",
+           "readmit_bucket"]
 
 # largest blocks-per-readmit-dispatch bucket; bigger batches dispatch in
 # cap-sized chunks (ContinuousBatchingRunner._dispatch_readmits)
@@ -83,6 +84,34 @@ def build_readmit_step(kind: str = "cb.paged.tier_readmit"):
         return cache, telem
 
     return audited_jit(_tier_readmit, kind=kind, cache_args=("cache",),
+                       carry_args=("telem",),
+                       static_argnames=("block_size",))
+
+
+def build_handoff_step(kind: str = "cb.paged.kv_handoff"):
+    """The pool-to-pool KV handoff's device dispatch (serving/pools.py):
+    scatter N blocks gathered from a PREFILL-pool replica's cache into a
+    DECODE-pool replica's paged pool. Same bucketed shape discipline as the
+    readmit scatter (``block_ids`` rows of -1 are padding, remapped past the
+    block axis and dropped), but counted under its own step kind so the
+    telemetry carry and roofline attribute handoff traffic separately from
+    host-tier re-admission."""
+
+    def _kv_handoff(cache, telem, k_new, v_new, block_ids, block_size):
+        nb = cache["k"].shape[1]
+        blk = jnp.where(block_ids < 0, nb, block_ids)       # OOB -> dropped
+        cache = dict(cache)
+        cache["k"] = cache["k"].at[:, blk].set(
+            k_new.astype(cache["k"].dtype), mode="drop")
+        cache["v"] = cache["v"].at[:, blk].set(
+            v_new.astype(cache["v"].dtype), mode="drop")
+        n_live = jnp.sum(block_ids >= 0)
+        telem = telem.at[dtel.IDX_KV_WRITES].add(n_live * block_size)
+        telem = telem.at[dtel.IDX_KV_BLOCKS].add(n_live)
+        telem = dtel.bump_kind(telem, dtel.KIND_KV_HANDOFF)
+        return cache, telem
+
+    return audited_jit(_kv_handoff, kind=kind, cache_args=("cache",),
                        carry_args=("telem",),
                        static_argnames=("block_size",))
 

@@ -34,6 +34,15 @@ Owner-state machine (a disjoint partition of the device pool)::
                     bytes sit in the allocator's pending-readmit queue.
 ``readmit_inflight`` taken by the runner for the readmit scatter but not yet
                     committed — a block stuck here is an orphaned readmit.
+``handoff_inflight`` allocated on a DECODE-pool replica as the destination of
+                    a live prefill→decode KV handoff (serving/pools.py):
+                    bytes staged by the ``cb.paged.kv_handoff`` scatter but
+                    the hash not yet published. Held by a negative-id handoff
+                    session (the runner's roster includes open sessions), so
+                    an abandoned session shows up as an attributed leak.
+                    Unlike ``readmit_inflight`` the state legitimately spans
+                    many steps — the transfer overlaps the source replica's
+                    remaining prefill chunks.
 
 The ledger maintains this machine by wrapping the EXISTING seams
 (``BlockAllocator._alloc_one``/``_release_one``, the tiered allocator's
@@ -42,7 +51,7 @@ the fault injector uses — with the runner supplying attribution context
 (request id, seam name, SLA class) around its allocator calls.
 
 ``audit()`` is the conservation check: free + live + idle + host_reserved +
-readmit_inflight == num_blocks, the ledger's view matches the allocator's
+readmit_inflight + handoff_inflight == num_blocks, the ledger's view matches the allocator's
 actual structures (free list, refcounts, idle pool, hash bijection, pending
 queue), per-block holder sums match refcounts, and — given the runner's
 expected-holder roster — every held block belongs to a live request. A
@@ -78,6 +87,7 @@ logger = logging.getLogger("tpu-inference")
 
 __all__ = ["BlockLedger", "MemLedgerViolation", "STATES",
            "FREE", "LIVE", "IDLE", "HOST_RESERVED", "READMIT_INFLIGHT",
+           "HANDOFF_INFLIGHT",
            "note_runner", "live_runners", "snapshot_safe", "timeline_safe"]
 
 FREE = "free"
@@ -85,7 +95,9 @@ LIVE = "live"
 IDLE = "idle"
 HOST_RESERVED = "host_reserved"
 READMIT_INFLIGHT = "readmit_inflight"
-STATES = (FREE, LIVE, IDLE, HOST_RESERVED, READMIT_INFLIGHT)
+HANDOFF_INFLIGHT = "handoff_inflight"
+STATES = (FREE, LIVE, IDLE, HOST_RESERVED, READMIT_INFLIGHT,
+          HANDOFF_INFLIGHT)
 
 # bounded per-request holdings timeline (events per request / requests kept)
 TIMELINE_EVENTS_PER_REQUEST = 64
@@ -398,6 +410,41 @@ class BlockLedger:
             rec.state = LIVE
             rec.since = self._now()
 
+    def handoff_begin(self, block_ids) -> None:
+        """Destination-side KV handoff staging (serving/pools.py): the named
+        freshly-allocated blocks become transfer targets — live -> handoff
+        in flight. Holders (the negative-id handoff session) carry over."""
+        now = self._now()
+        for blk in block_ids:
+            rec = self.records.get(int(blk))
+            if rec is not None and rec.state == LIVE:
+                rec.state = HANDOFF_INFLIGHT
+                rec.since = now
+
+    def handoff_committed(self, block_ids) -> None:
+        """The handoff session finalized: the staged blocks' bytes are
+        authoritative and their hashes publish to the prefix cache —
+        handoff_inflight -> live (the session then releases them, parking
+        the hashed blocks idle for the migrated request's prefix walk)."""
+        now = self._now()
+        for blk in block_ids:
+            rec = self.records.get(int(blk))
+            if rec is not None and rec.state == HANDOFF_INFLIGHT:
+                rec.state = LIVE
+                rec.since = now
+
+    def handoff_aborted(self, block_ids) -> None:
+        """The handoff died mid-transfer (source replica death, admission
+        fallback): staged blocks revert to plain live so the session's
+        release path can return them to the free list — nothing half-staged
+        survives as a prefix-cache entry."""
+        now = self._now()
+        for blk in block_ids:
+            rec = self.records.get(int(blk))
+            if rec is not None and rec.state == HANDOFF_INFLIGHT:
+                rec.state = LIVE
+                rec.since = now
+
     def note_event(self, request_id: int, event: str, **fields) -> None:
         """Runner hand-off marker (preempt/migrate/resume) for the holdings
         timeline, with the blocks held at the hand-off point."""
@@ -431,7 +478,7 @@ class BlockLedger:
             by_state[rec.state].add(blk)
         by_state[FREE] = set(range(self.num_blocks)) - set(self.records)
 
-        # conservation: the five states partition the pool
+        # conservation: the owner states partition the pool
         total = sum(len(s) for s in by_state.values())
         if total != self.num_blocks:
             v.append({"kind": "conservation", "detail":
@@ -460,7 +507,8 @@ class BlockLedger:
         # refcounted set == live + host_reserved + inflight; per-block holder
         # sums match the refcounts (the per-request attribution invariant)
         refcounted = (by_state[LIVE] | by_state[HOST_RESERVED]
-                      | by_state[READMIT_INFLIGHT])
+                      | by_state[READMIT_INFLIGHT]
+                      | by_state[HANDOFF_INFLIGHT])
         if set(alloc.refcount) != refcounted:
             v.append({"kind": "refcount_set_mismatch", "detail":
                       f"refcounted blocks "

@@ -1,0 +1,374 @@
+"""Disaggregated prefill/decode pools with live KV-block handoff.
+
+The roofline model (analysis/perf_model.py) classifies prefill compute-bound
+and decode memory-bound, yet a unified fleet serves both phases on every
+replica — a big prefill wave burns seconds of device time against ~15 ms
+decode steps (the ``prefill_interference_ratio`` bench measures exactly that
+collision). This module composes the machinery the serving stack already has
+— ``drain``/``submit(resume_tokens=)`` migration, the checksummed
+content-addressed host KV tier, per-signal autoscaling, memledger
+attribution — into the TPLA-style disaggregated topology:
+
+- **Pool roles** (:data:`POOL_PREFILL` / :data:`POOL_DECODE` /
+  :data:`POOL_UNIFIED`, carried by ``EngineReplica.pool_role``): under the
+  router's ``remote_prefill`` placement policy fresh arrivals place on the
+  PREFILL pool and decoding (resumed/handed-off) requests place on the
+  DECODE pool; UNIFIED replicas take both (and every other policy treats
+  all roles as unified).
+- **Live handoff** (:class:`PoolManager`): while a request's prompt is still
+  inserting on its prefill-pool replica, the blocks its insert windows have
+  already committed stream to a decode-pool replica CHUNK BY CHUNK — the
+  transfer overlaps the remaining prefill compute, so by prompt completion
+  most bytes have already moved and the migration costs one eviction +
+  re-placement. Admission is gated by decode-pool KV headroom
+  (``can_admit`` + ``handoff_headroom``): a pressured decode pool defers
+  the handoff (the request keeps decoding where it is) rather than OOMing
+  the destination.
+- **Two channels**: ``channel="device"`` uses the destination runner's
+  handoff sessions — a bucketed gather/scatter pair
+  (``cb.paged.kv_handoff``, built beside ``cb.paged.tier_readmit`` and
+  registered through ``audited_jit`` with the telemetry carry threaded)
+  whose staged blocks the memledger tracks as ``handoff_inflight`` until
+  commit. ``channel="tier"`` routes the bytes through the destination's
+  content-addressed host tier (``tier.spill`` reading the SOURCE replica's
+  cache), whose checksum verification turns a corrupted handoff block into
+  a counted re-prefill instead of a poisoned stream.
+- **Exactness**: the migrated request re-places via the router's normal
+  ``submit(resume_tokens=)`` path pinned to the destination; its prefix
+  walk hits the handed-off hashes (device-resident idle blocks, or host
+  bytes that re-admit) and skips re-prefill — and because the blocks'
+  BYTES moved verbatim, the continued stream is bit-identical to a
+  never-migrated reference. Faults compose: a source replica dying
+  mid-handoff aborts the session (nothing half-staged survives) and the
+  journal rebuilds the stream; tests/test_pools.py pins both.
+
+Pools are simulated as sub-fleets of replicas on one host (the dryrun
+harness fakes the devices) — the structural prerequisite for multi-host
+pools and the fleet KV store (ROADMAP item 4).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+logger = logging.getLogger("tpu-inference")
+
+__all__ = ["POOL_PREFILL", "POOL_DECODE", "POOL_UNIFIED", "PoolManager"]
+
+POOL_PREFILL = "prefill"
+POOL_DECODE = "decode"
+POOL_UNIFIED = "unified"
+
+#: handoff channels: "device" = gather/scatter sessions on the destination
+#: runner (cb.paged.kv_handoff); "tier" = through the destination's
+#: content-addressed host tier (checksummed; a corrupt block re-prefills)
+CHANNELS = ("device", "tier")
+
+
+class PoolManager:
+    """Drive live prefill→decode KV handoffs over a router's sub-fleets.
+
+    Constructed by :class:`~.router.PrefixAffinityRouter` when
+    ``policy="remote_prefill"``; ``tick()`` runs once per router step, after
+    the replica sweep (freshest insert progress), and per tracked request:
+
+    1. **open** — pick the healthiest decode-pool destination whose KV
+       headroom admits the request's WHOLE stream; defer (retry next tick)
+       when none does;
+    2. **stage** — gather the prompt blocks the source's insert windows have
+       committed since the last tick and scatter them into the destination
+       (device sessions hold them ``handoff_inflight``; tier spills park
+       them as host bytes). Chunks staged while the source is still
+       inserting count as OVERLAPPED — handoff latency hiding behind
+       prefill compute;
+    3. **finalize** — at prompt completion (the request started decoding)
+       commit the session (hashes publish, blocks park idle), evict the
+       request from the source, and re-queue it at the front PINNED to the
+       destination — its prefix walk there reuses the handed-off blocks;
+    4. **abort** — a source or destination leaving HEALTHY mid-transfer
+       tears the session down; the journal/recovery path owns the stream.
+    """
+
+    def __init__(self, router, channel: str = "device"):
+        if channel not in CHANNELS:
+            raise ValueError(f"channel must be one of {CHANNELS}, "
+                             f"got {channel!r}")
+        if not router.paged:
+            raise ValueError("disaggregated pools require paged attention "
+                             "(KV handoff moves paged blocks)")
+        roles = {rep.pool_role for rep in router.replicas.values()}
+        if POOL_PREFILL not in roles or POOL_DECODE not in roles:
+            raise ValueError(
+                "remote_prefill needs at least one prefill-pool and one "
+                f"decode-pool replica (got roles {sorted(roles)}); build "
+                "replicas with EngineReplica(pool_role=...)")
+        if channel == "tier":
+            missing = [rid for rid, rep in router.replicas.items()
+                       if rep.pool_role == POOL_DECODE
+                       and rep.runner.kv_tier is None]
+            if missing:
+                raise ValueError(
+                    f"channel='tier' needs a host KV tier on every "
+                    f"decode-pool replica (missing on {missing})")
+        else:
+            # device sessions stage through the Python allocator's
+            # alloc/release/hash seams; the native C++ allocator has none
+            native = [rid for rid, rep in router.replicas.items()
+                      if rep.pool_role == POOL_DECODE
+                      and not hasattr(rep.runner.allocator, "_alloc_one")]
+            if native:
+                raise ValueError(
+                    f"channel='device' needs the Python block allocator on "
+                    f"every decode-pool replica (native C++ allocator on "
+                    f"{native}; enable a host KV tier or memledger=True)")
+        self.router = router
+        self.channel = channel
+        # per-request transfer state, keyed by frontend request id
+        self._transfers: Dict[int, dict] = {}
+        self.latencies_ms: List[float] = []
+        self.blocks_total = 0
+        self.overlap_blocks = 0
+        self.bytes_total = 0
+        self.overlapped_bytes = 0
+        self.aborted: Dict[str, int] = {}
+        reg = router.registry
+        self._c_started = reg.counter(
+            "pool_handoffs_started_total",
+            "prefill→decode KV handoffs opened")
+        self._c_completed = reg.counter(
+            "pool_handoffs_completed_total",
+            "handoffs committed + migrated to the decode pool")
+        self._c_deferred = reg.counter(
+            "pool_handoffs_deferred_total",
+            "handoff attempts deferred by decode-pool KV headroom")
+        self._c_aborted = reg.counter(
+            "pool_handoffs_aborted_total",
+            "handoffs torn down mid-transfer (source/destination left "
+            "HEALTHY, or the stream finished at the source)")
+        self._c_bytes = reg.counter(
+            "pool_handoff_bytes_total", "KV bytes moved by handoffs")
+        self._c_overlap_bytes = reg.counter(
+            "pool_handoff_overlapped_bytes_total",
+            "handoff KV bytes moved while the source was still prefilling")
+        self._c_empty = reg.counter(
+            "pool_migrations_without_blocks_total",
+            "prompt-complete migrations carrying no full block (prompt "
+            "shorter than one block — nothing to hand off)")
+
+    # -------------------------------------------------------------- helpers
+    @staticmethod
+    def _local_row(runner, local_id: int):
+        for r in runner.active:
+            if r is not None and r.request_id == local_id and not r.done:
+                return r
+        return None
+
+    def _healthy(self, rid: str) -> bool:
+        from .router import REPLICA_HEALTHY
+
+        return self.router._health.get(rid) == REPLICA_HEALTHY
+
+    def _choose_dest(self, req):
+        """Decode-pool replica with the most KV headroom whose headroom
+        admits the request's WHOLE stream (the pool admission gate) — None
+        defers the handoff."""
+        n = len(req.prompt) + len(req.generated)
+        best, best_room = None, -1
+        for rid, rep in self.router.replicas.items():
+            if rep.pool_role != POOL_DECODE or rep.draining:
+                continue
+            if not self._healthy(rid) or not rep.can_admit(n):
+                continue
+            room = rep.runner.handoff_headroom()
+            if room < rep.blocks_needed(n):
+                continue
+            if room > best_room:
+                best, best_room = rep, room
+        return best
+
+    # ----------------------------------------------------------------- tick
+    def tick(self) -> None:
+        router = self.router
+        self._sweep_dead()
+        for (rid, local_id), gid in list(router._local.items()):
+            rep = router.replicas.get(rid)
+            if rep is None or rep.pool_role != POOL_PREFILL:
+                continue
+            if not self._healthy(rid):
+                continue
+            req = router.requests[gid]
+            if req.done:
+                continue
+            lr = self._local_row(rep.runner, local_id)
+            if lr is None:
+                continue              # still in the runner queue (no blocks)
+            rec = self._transfers.get(gid)
+            if rec is None:
+                rec = self._open(req, rid)
+                if rec is None:
+                    continue          # deferred: no destination admits yet
+            if not self._stage(req, rec, rep, lr):
+                continue              # chunk deferred by destination pressure
+            if not lr.inserting:
+                self._finalize(req, rec, rep, lr)
+
+    def _sweep_dead(self) -> None:
+        """Abort transfers whose endpoints left HEALTHY or whose stream
+        finished/migrated at the source before the handoff could."""
+        router = self.router
+        for gid, rec in list(self._transfers.items()):
+            req = router.requests[gid]
+            reason = None
+            if not self._healthy(rec["src"]) or req.replica != rec["src"]:
+                reason = "src_failed"
+            elif not self._healthy(rec["dest"]):
+                reason = "dest_failed"
+            elif req.done:
+                reason = "finished_at_source"
+            if reason is None:
+                continue
+            self._abort(gid, rec, reason)
+
+    def _abort(self, gid: int, rec: dict, reason: str) -> None:
+        router = self.router
+        if rec["sid"] is not None:
+            dest = router.replicas.get(rec["dest"])
+            try:
+                if dest is not None:
+                    dest.runner.handoff_abort(rec["sid"])
+            # lint: ok(silent-except): a dead destination cannot release its own pool — recovery replaces the whole runner; the abort is counted either way
+            except Exception:
+                pass
+        self.aborted[reason] = self.aborted.get(reason, 0) + 1
+        self._c_aborted.inc()
+        req = router.requests[gid]
+        router._trace_event("handoff_abort", req, from_replica=rec["src"],
+                            to_replica=rec["dest"], reason=reason,
+                            staged_blocks=rec["staged"])
+        del self._transfers[gid]
+        logger.info("handoff of request %d aborted (%s): %d staged block(s) "
+                    "discarded", gid, reason, rec["staged"])
+
+    def _open(self, req, src_rid: str) -> Optional[dict]:
+        dest = self._choose_dest(req)
+        if dest is None:
+            self._c_deferred.inc()
+            return None
+        sid = (dest.runner.handoff_open() if self.channel == "device"
+               else None)
+        rec = {"src": src_rid, "dest": dest.replica_id, "sid": sid,
+               "staged": 0, "overlap": 0, "t0": time.perf_counter()}
+        self._transfers[req.request_id] = rec
+        self._c_started.inc()
+        self.router._trace_event("handoff_start", req, from_replica=src_rid,
+                                 to_replica=dest.replica_id,
+                                 channel=self.channel,
+                                 blocks_expected=len(req.hashes))
+        return rec
+
+    def _stage(self, req, rec: dict, src_rep, lr) -> bool:
+        """Move the blocks committed since the last tick. Returns False when
+        the destination could not take the chunk (retry next tick)."""
+        bs = self.router.block_size
+        n_full = len(req.hashes)
+        ready = (min(lr.insert_pos // bs, n_full) if lr.inserting else n_full)
+        new = ready - rec["staged"]
+        if new <= 0:
+            return True
+        ids = lr.blocks[rec["staged"]:ready]
+        hashes = req.hashes[rec["staged"]:ready]
+        dest = self.router.replicas[rec["dest"]]
+        overlapping = bool(lr.inserting)
+        if self.channel == "device":
+            k, v = src_rep.runner._read_tier_blocks(
+                np.asarray(ids, dtype=np.int32))
+            got = dest.runner.handoff_receive(rec["sid"], k, v, hashes,
+                                              request_id=req.request_id)
+            if got is None:
+                self._c_deferred.inc()
+                return False
+        else:
+            dest.runner.kv_tier.spill(ids, hashes,
+                                      src_rep.runner._read_tier_blocks)
+        rec["staged"] = ready
+        nbytes = new * src_rep.runner._bytes_per_block()
+        self.blocks_total += new
+        self.bytes_total += nbytes
+        self._c_bytes.inc(nbytes)
+        if overlapping:
+            rec["overlap"] += new
+            self.overlap_blocks += new
+            self.overlapped_bytes += nbytes
+            self._c_overlap_bytes.inc(nbytes)
+        return True
+
+    def _finalize(self, req, rec: dict, src_rep, lr) -> None:
+        """Prompt complete, every full block staged: commit and migrate."""
+        router = self.router
+        if rec["sid"] is not None:
+            dest = router.replicas[rec["dest"]]
+            dest.runner.handoff_commit(rec["sid"])
+        if rec["staged"] == 0:
+            self._c_empty.inc()
+        # evict through the runner's preempt path; the pipeline flush may
+        # still commit tokens — they belong to their streams and merge into
+        # the next step()'s emissions (the SLA-preemption convention)
+        emitted, _evicted = src_rep.evict_request(lr.request_id)
+        for lid, toks in emitted.items():
+            router._fold(rec["src"], lid, toks, router._pending_emitted)
+        router._local.pop((rec["src"], lr.request_id), None)
+        latency_ms = 1e3 * (time.perf_counter() - rec["t0"])
+        del self._transfers[req.request_id]
+        if req.done:
+            # the flush finished the stream at the source — the staged
+            # blocks stay as destination prefix-cache entries, but there is
+            # no migration to count
+            self.aborted["finished_at_source"] = (
+                self.aborted.get("finished_at_source", 0) + 1)
+            self._c_aborted.inc()
+            return
+        req.replica = None
+        req.local_id = None
+        req.migrations += 1
+        req.pin_replica = rec["dest"]
+        router.queue.insert(0, req)
+        router._g_queue.set(len(router.queue))
+        router._c_migrations.inc()
+        self._c_completed.inc()
+        self.latencies_ms.append(latency_ms)
+        router._trace_event("migrate_out", req, from_replica=rec["src"],
+                            tokens_so_far=len(req.generated))
+        router._trace_event("handoff_done", req, from_replica=rec["src"],
+                            to_replica=rec["dest"], channel=self.channel,
+                            blocks=rec["staged"],
+                            overlap_blocks=rec["overlap"],
+                            latency_ms=round(latency_ms, 3))
+
+    # ---------------------------------------------------------------- export
+    def stats(self) -> Dict[str, object]:
+        lat = np.asarray(self.latencies_ms, dtype=np.float64)
+        return {
+            "channel": self.channel,
+            "roles": {rid: rep.pool_role
+                      for rid, rep in self.router.replicas.items()},
+            "started": int(self._c_started.value),
+            "completed": int(self._c_completed.value),
+            "deferred": int(self._c_deferred.value),
+            "aborted": dict(self.aborted),
+            "in_flight": len(self._transfers),
+            "blocks_total": self.blocks_total,
+            "bytes_total": self.bytes_total,
+            "overlap_blocks": self.overlap_blocks,
+            "overlapped_bytes": self.overlapped_bytes,
+            "overlap_ratio": (self.overlapped_bytes / self.bytes_total
+                              if self.bytes_total else 0.0),
+            "migrations_without_blocks": int(self._c_empty.value),
+            "latency_ms_p50": (round(float(np.percentile(lat, 50)), 3)
+                               if lat.size else None),
+            "latency_ms_p99": (round(float(np.percentile(lat, 99)), 3)
+                               if lat.size else None),
+        }

@@ -137,14 +137,22 @@ class RouterRequest:
     # router-level SLA preemptions this request suffered (it re-queued and
     # resumed bit-exactly each time; distinct from replica-local preemptions)
     class_preemptions: int = 0
+    # disaggregated pools (serving/pools.py): a completed KV handoff pins the
+    # re-queued request to the destination replica holding its blocks — the
+    # next _choose honors the pin (if that replica still admits) then clears it
+    pin_replica: Optional[str] = None
 
 
 class PrefixAffinityRouter:
     """Place requests over N EngineReplicas by prefix affinity + load.
 
     ``policy``: ``"affinity"`` (default), ``"load"`` (headroom/queue only),
-    or ``"random"`` (uniform over admitting replicas — the bench's control
-    arm for the affinity-hit comparison).
+    ``"random"`` (uniform over admitting replicas — the bench's control
+    arm for the affinity-hit comparison), or ``"remote_prefill"``
+    (disaggregated pools, serving/pools.py: arrivals place on prefill-pool
+    replicas, decoding requests on decode-pool replicas, with a
+    :class:`~.pools.PoolManager` live-handing their KV blocks across —
+    affinity scoring applies WITHIN the chosen pool).
     """
 
     def __init__(self, replicas: Sequence[EngineReplica],
@@ -159,7 +167,8 @@ class PrefixAffinityRouter:
                  preemptive: Optional[bool] = None,
                  brownout_up_after: int = 3, brownout_down_after: int = 5,
                  brownout_decode_cap: int = 1,
-                 shed_retry_after_s: float = 1.0):
+                 shed_retry_after_s: float = 1.0,
+                 pool_config: Optional[dict] = None):
         """Supervision knobs (fault tolerance, ISSUE-11):
 
         ``fault_injector``: a :class:`~.faults.FaultInjector` to attach
@@ -202,7 +211,7 @@ class PrefixAffinityRouter:
         ids = [r.replica_id for r in replicas]
         if len(set(ids)) != len(ids):
             raise ValueError(f"replica ids must be unique, got {ids}")
-        if policy not in ("affinity", "load", "random"):
+        if policy not in ("affinity", "load", "random", "remote_prefill"):
             raise ValueError(f"unknown placement policy {policy!r}")
         self.replicas: Dict[str, EngineReplica] = {
             r.replica_id: r for r in replicas}
@@ -365,6 +374,19 @@ class PrefixAffinityRouter:
         self._c_trace_dropped = reg.counter(
             "router_trace_events_dropped_total",
             "journal events evicted past the in-memory retention bound")
+        # --- disaggregated pools (serving/pools.py) -------------------------
+        # under remote_prefill the PoolManager owns the prefill→decode KV
+        # handoffs; its tick runs inside step() after the replica sweep.
+        # pool_config forwards PoolManager kwargs (e.g. channel="tier").
+        if policy == "remote_prefill":
+            from .pools import PoolManager
+
+            self.pools = PoolManager(self, **(pool_config or {}))
+        else:
+            if pool_config is not None:
+                raise ValueError("pool_config requires policy="
+                                 "'remote_prefill'")
+            self.pools = None
         self.fault_injector = fault_injector
         if fault_injector is not None:
             fault_injector.attach(self)
@@ -506,6 +528,35 @@ class PrefixAffinityRouter:
         return (-a.get("kv_blocks_free", 0), a["queue_depth"],
                 a["active_requests"], rep.replica_id)
 
+    def _pool_filter(self, req: RouterRequest,
+                     admitting: List[EngineReplica]) -> List[EngineReplica]:
+        """remote_prefill placement (serving/pools.py): fresh arrivals go to
+        the prefill pool, decoding (resumed / handed-off) requests to the
+        decode pool; unified replicas serve both. A ``pin_replica`` from a
+        completed handoff wins outright — the destination already holds the
+        request's KV blocks. When the wanted pool has a placeable member
+        that merely cannot admit YET, return [] so the request WAITS for its
+        pool (cross-phase interference is exactly what disaggregation
+        removes); only when the wanted pool is gone entirely does placement
+        fall back to whatever admits — availability over topology."""
+        if req.pin_replica is not None:
+            pinned = [r for r in admitting
+                      if r.replica_id == req.pin_replica]
+            if pinned:
+                return pinned
+            # pin target failed/full: clear it and fall through to normal
+            # pool scoring (the handed-off blocks are a lost affinity hit)
+            req.pin_replica = None
+        want = (("decode", "unified") if req.generated
+                else ("prefill", "unified"))
+        subset = [r for r in admitting if r.pool_role in want]
+        if subset:
+            return subset
+        if any(self._placeable(r) and r.pool_role in want
+               for r in self.replicas.values()):
+            return []
+        return admitting
+
     def _choose(self, req: RouterRequest):
         """Returns (replica, affinity_blocks, spilled_from) or None when no
         replica can admit the request right now."""
@@ -518,6 +569,8 @@ class PrefixAffinityRouter:
         # failure the router already knows about
         admitting = [r for r in self.replicas.values()
                      if self._placeable(r) and r.can_admit(n)]
+        if self.pools is not None:
+            admitting = self._pool_filter(req, admitting)
         if not admitting:
             return None
         if self.policy == "random":
@@ -729,6 +782,7 @@ class PrefixAffinityRouter:
         req.local_id = rep.submit(req.prompt, **kw)
         req.replica = rep.replica_id
         req.affinity_blocks = aff_blocks
+        req.pin_replica = None          # a handoff pin is one-shot
         self._local[(rep.replica_id, req.local_id)] = req.request_id
         self._c_placed.inc()
         self._trace_event("place", req, replica=rep.replica_id,
@@ -792,6 +846,12 @@ class PrefixAffinityRouter:
                 self._note_step_ok(rid)
             for local_id, toks in step_out.items():
                 self._fold(rid, local_id, toks, emitted)
+        if self.pools is not None:
+            # drive prefill→decode handoffs on the freshest insert progress
+            # (right after the sweep); emissions a finalize's eviction flush
+            # produces land in _pending_emitted and merge into the NEXT
+            # step's output — the SLA-preemption convention
+            self.pools.tick()
         return emitted
 
     def _check_replica_classes(self, rep: EngineReplica) -> None:
@@ -1313,6 +1373,9 @@ class PrefixAffinityRouter:
             "faults_injected": (self.fault_injector.fired_total
                                 if self.fault_injector is not None else 0),
             "replicas": per_replica,
+            # disaggregated pools: handoff accounting (remote_prefill only)
+            **({"pools": self.pools.stats()}
+               if self.pools is not None else {}),
             # overload control plane (ISSUE-13): brown-out state + per-class
             # shed/preempt/defer accounting (absent on classless routers)
             **({"sla": {

@@ -484,7 +484,9 @@ def build_fleet_traces(replica_sources: Sequence[dict],
     ``recovered`` span synthesized from the router journal covers the
     failure-to-resubmit window, and the dead segment's open spans are closed
     at the recovery boundary so the merged tree leaks nothing). Router
-    placement/queue spans ride under the root when a journal is given."""
+    placement/queue spans ride under the root when a journal is given; a
+    pool KV handoff (serving/pools.py) adds a ``handoff`` span bridging the
+    prefill-pool and decode-pool segments (start→commit/abort window)."""
     sets = {src["name"]: build_trace_set(
         src, timing=(timing or {}).get(src["name"]))
         for src in replica_sources}
@@ -536,11 +538,31 @@ def build_fleet_traces(replica_sources: Sequence[dict],
                    affinity_blocks=e.get("affinity_blocks"),
                    spilled_from_blocks=e.get("spilled_from"),
                    migration=e.get("migrations", 0) > 0)
+        h_start = None       # at most one live handoff per request at a time
         for e in r_evs:
             t = e["ts"] + r_epoch
             if e["event"] == "migrate_out":
                 tb.add("migration", "migration", t, t, root,
                        altitude="router", from_replica=e.get("from_replica"))
+            elif e["event"] == "handoff_start":
+                h_start = e
+            elif e["event"] in ("handoff_done", "handoff_abort"):
+                # the pool-to-pool KV handoff span (serving/pools.py): spans
+                # from the transfer opening to commit/abort, JOINING the
+                # prefill-pool and decode-pool segments of this trace — the
+                # overlap window where blocks moved while prefill still ran
+                t0h = (h_start["ts"] + r_epoch) if h_start is not None else t
+                tb.add("handoff", "handoff", t0h, t, root, altitude="router",
+                       from_replica=e.get("from_replica"),
+                       to_replica=e.get("to_replica"),
+                       channel=(e.get("channel")
+                                or (h_start or {}).get("channel")),
+                       blocks=e.get("blocks", e.get("staged_blocks")),
+                       overlap_blocks=e.get("overlap_blocks"),
+                       latency_ms=e.get("latency_ms"),
+                       aborted=e["event"] == "handoff_abort",
+                       abort_reason=e.get("reason"))
+                h_start = None
             elif e["event"] == "recover":
                 nxt = next((p["ts"] + r_epoch for p in places
                             if p["ts"] >= e["ts"]), None)

@@ -47,7 +47,9 @@ Counter layout (int32; document any change in docs/OBSERVABILITY.md):
 ``step:<kind>``     dispatches per step kind (decode / spec_chunk / mixed /
                     insert / insert_window / tier_readmit — the host-RAM KV
                     tier's block re-admission scatter, serving/kv_tiering.py —
-                    / megastep — the device-resident while_loop decode)
+                    / kv_handoff — the pool-to-pool live KV block transfer
+                    scatter, serving/pools.py — / megastep — the
+                    device-resident while_loop decode)
 ==================  =========================================================
 """
 
@@ -67,7 +69,7 @@ FIELDS = ("tokens", "spec_accepted", "spec_cells", "occupancy", "kv_writes",
           "kv_blocks", "eos", "prefill_tokens", "seed_tokens",
           "megastep_iters")
 KINDS = ("decode", "spec_chunk", "mixed", "insert", "insert_window",
-         "tier_readmit", "megastep")
+         "tier_readmit", "kv_handoff", "megastep")
 
 IDX_TOKENS = 0
 IDX_SPEC_ACCEPTED = 1
@@ -88,6 +90,7 @@ KIND_MIXED = KINDS.index("mixed")
 KIND_INSERT = KINDS.index("insert")
 KIND_INSERT_WINDOW = KINDS.index("insert_window")
 KIND_TIER_READMIT = KINDS.index("tier_readmit")
+KIND_KV_HANDOFF = KINDS.index("kv_handoff")
 KIND_MEGASTEP = KINDS.index("megastep")
 
 

@@ -104,6 +104,13 @@ _FILE_SCOPES = {
     "utils/provenance.py": [],
     "serving/kv_tiering.py": ["serving_tier", "cb_paged", "cb_mixed",
                               "cb_megastep", "cb_spec", "cb_eagle"],
+    # ISSUE-17 disaggregated pools: the PoolManager is host-side handoff
+    # orchestration over runner session APIs (handoff_open/receive/commit) —
+    # it never enters a graph itself, but it DRIVES the bucketed
+    # cb.paged.kv_handoff scatter's call pattern (chunk staging cadence), so
+    # an edit re-audits the serving_tier scope that exercises a live
+    # prefill->decode handoff end to end.
+    "serving/pools.py": ["serving_tier"],
     # ISSUE-16 MoE serving: the grouped decode kernel and EP ring trace only
     # into MoE-arch graphs — the llama fleet never imports them — so an edit
     # re-audits the moe scope (Mixtral paged CB runner + the standalone

@@ -131,4 +131,19 @@ if [ "${MOE_TIER1_TESTS:-0}" -lt 1 ]; then
     echo "ERROR: MoE serving tests are not in the tier-1 marker set" >&2
     [ "$rc" -eq 0 ] && rc=1
 fi
+
+# ISSUE-17 unchanged-semantics guard: the disaggregated-pools suite (live
+# prefill->decode KV handoff bit-exactness over both channels, headroom
+# deferral, mid-handoff death recovery, checksum re-prefill, ledger
+# handoff_inflight accounting, per-pool autoscaling, handoff span) must stay
+# collected inside the tier-1 marker set.
+POOLS_TIER1_TESTS=$(env JAX_PLATFORMS=cpu python -m pytest \
+    "$REPO/tests/test_pools.py" \
+    -q -m 'not slow' --collect-only -p no:cacheprovider 2>/dev/null \
+    | grep -ac '::' || true)
+echo "POOLS_TIER1_TESTS=$POOLS_TIER1_TESTS"
+if [ "${POOLS_TIER1_TESTS:-0}" -lt 1 ]; then
+    echo "ERROR: disaggregated-pools tests are not in the tier-1 marker set" >&2
+    [ "$rc" -eq 0 ] && rc=1
+fi
 exit "$rc"

@@ -60,10 +60,18 @@ RATIO_HIGHER_BETTER = {            # box-relative ratios: every group, loose
     "goodput_under_faults_ratio": 0.30,
     "paged_spec_selfdraft_vs_own_ceiling": 0.20,
     "prefix_affinity_hit_ratio": 0.25,
+    # ISSUE-17 disaggregated pools: share of handoff bytes moved while the
+    # source was still prefilling — the transfer must keep hiding behind
+    # prefill compute, not regress to a stop-the-world copy at migration
+    "handoff_overlap_ratio": 0.30,
     "ok": 0.0,                     # multichip dryrun verdict must stay 1
 }
 RATIO_LOWER_BETTER = {
     "telemetry_overhead_ratio": 0.50,
+    # ISSUE-17: prefill-family dispatch-time share on the DECODE pool —
+    # disaggregation exists to keep this near zero; loose tolerance since
+    # the residual (migration tail re-inserts) is small and noisy
+    "pooled_prefill_interference_ratio": 0.50,
 }
 ABS_HIGHER_BETTER = {              # hardware measurements: VERIFIED groups only
     "value": 0.15,

@@ -411,6 +411,13 @@ def test_changed_mode_scope_map_fails_closed():
     assert mod._scopes_for_changes([pkg + "parallel/overlap2.py"]) is None
     assert mod._scopes_for_changes(
         [pkg + "serving/prefill_pool.py"]) is None
+    # ISSUE-17 disaggregated pools: the PoolManager drives the bucketed
+    # cb.paged.kv_handoff scatter's call pattern -> re-audit the serving_tier
+    # scope that exercises a live prefill->decode handoff; an UNMAPPED new
+    # serving/ file still fails closed to the full fleet
+    assert mod._scopes_for_changes([pkg + "serving/pools.py"]) == [
+        "serving_tier"]
+    assert mod._scopes_for_changes([pkg + "serving/pools2.py"]) is None
     assert "serving_tier" in set(mod._scopes_for_changes(
         [pkg + "runtime/continuous_batching.py"]))
     # every mapped scope name actually exists in the harness

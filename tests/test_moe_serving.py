@@ -27,7 +27,8 @@ from neuronx_distributed_inference_tpu.ops.quantization import (
     dequantize_tensor, quantize_tensor)
 from neuronx_distributed_inference_tpu.parallel.mesh import build_mesh
 from neuronx_distributed_inference_tpu.parallel.overlap import (
-    compiled_collective_stats, estimated_ep_bytes_per_step, moe_ep_phase)
+    compiled_collective_stats, estimated_ep_bytes_per_step, moe_ep_phase,
+    moe_tp_phase)
 from neuronx_distributed_inference_tpu.parallel.sharding import DEFAULT_RULES
 from neuronx_distributed_inference_tpu.runtime.continuous_batching import (
     ContinuousBatchingRunner)
@@ -105,10 +106,13 @@ def test_grouped_env_toggle_and_trace_stats(expert_weights, monkeypatch):
         return M.grouped_trace_stats()
 
     monkeypatch.delenv("TPUINF_MOE_GROUPED", raising=False)
-    assert trace(True) == {"grouped": 1, "ep_ring": 0, "dense_decode": 0}
-    assert trace(False) == {"grouped": 0, "ep_ring": 0, "dense_decode": 0}
+    assert trace(True) == {"grouped": 1, "ep_ring": 0, "tp_grouped": 0,
+                           "dense_decode": 0}
+    assert trace(False) == {"grouped": 0, "ep_ring": 0, "tp_grouped": 0,
+                            "dense_decode": 0}
     monkeypatch.setenv("TPUINF_MOE_GROUPED", "0")
-    assert trace(True) == {"grouped": 0, "ep_ring": 0, "dense_decode": 1}
+    assert trace(True) == {"grouped": 0, "ep_ring": 0, "tp_grouped": 0,
+                           "dense_decode": 1}
 
 
 # ------------------------------------------------------- EP ring vs GSPMD
@@ -152,13 +156,78 @@ def test_ep_ring_matches_gspmd_fallback(expert_weights, monkeypatch, tp, ep,
 
     ref, sref, cref = run(False)
     ring, sring, cring = run(True)
-    assert sref == {"grouped": 0, "ep_ring": 0, "dense_decode": 1}
-    assert sring == {"grouped": 0, "ep_ring": 1, "dense_decode": 0}
+    assert sref == {"grouped": 0, "ep_ring": 0, "tp_grouped": 0,
+                    "dense_decode": 1}
+    assert sring == {"grouped": 0, "ep_ring": 1, "tp_grouped": 0,
+                     "dense_decode": 0}
     assert cring.get("collective-permute", 0) == ep - 1, cring
     assert cring.get("all-gather", 0) == 1, cring
     assert cref.get("collective-permute", 0) == 0, cref
     np.testing.assert_allclose(ring, ref, atol=1e-6 if tp == 1 else 2e-5,
                                rtol=1e-5)
+
+
+# ----------------------------------------------- pure-TP grouped vs GSPMD
+@pytest.mark.parametrize("tp,bias", [(2, False), (4, False), (2, True),
+                                     (4, True)])
+def test_tp_grouped_matches_gspmd_fallback(expert_weights, monkeypatch, tp,
+                                           bias):
+    """The ep == 1 pure-TP grouped shard_map wrapper is the dense GSPMD
+    combine to f32 reassociation: each chip computes all experts over its tp
+    column slice of the expert mlp dim and one tp psum reproduces the
+    all-reduce GSPMD places after the dense einsums. The expert_bias cases pin
+    the tp_once mask — the tp-replicated down bias must survive the finishing
+    psum exactly once, not once per tp shard. The trace counters witness which
+    implementation actually lowered on each leg."""
+    margs = M.MoEArgs(num_experts=E, experts_per_tok=2, expert_bias=bias)
+    args = SimpleNamespace(moe=margs)
+    lp = {k: jnp.asarray(expert_weights[k])
+          for k in ("router", "wg", "wu", "wd")}
+    if bias:
+        brng = np.random.default_rng(3)
+        lp["bg"] = jnp.asarray(brng.normal(size=(E, I), scale=0.1), jnp.float32)
+        lp["bu"] = jnp.asarray(brng.normal(size=(E, I), scale=0.1), jnp.float32)
+        lp["bd"] = jnp.asarray(brng.normal(size=(E, H), scale=0.1), jnp.float32)
+    hn = jnp.asarray(expert_weights["x"]).reshape(2, 4, H)
+    mesh = build_mesh(tp_degree=tp)
+    rules = dict(DEFAULT_RULES)
+    assert moe_tp_phase(mesh, rules, "decode_experts", "decode_expert_mlp")
+    assert not moe_ep_phase(mesh, rules, "decode_experts", "decode_expert_mlp")
+
+    def run(wrapped):
+        monkeypatch.setenv("TPUINF_MOE_TP_GROUPED", "1" if wrapped else "0")
+        M.reset_grouped_trace_stats()
+        with mesh:
+            f = jax.jit(lambda lp, hn: M.moe_block(lp, args, hn, mesh, rules,
+                                                   jax.nn.silu, decode=True))
+            out = np.asarray(f(lp, hn), np.float32)
+        return out, M.grouped_trace_stats()
+
+    ref, sref = run(False)
+    grp, sgrp = run(True)
+    assert sref == {"grouped": 0, "ep_ring": 0, "tp_grouped": 0,
+                    "dense_decode": 1}
+    assert sgrp == {"grouped": 0, "ep_ring": 0, "tp_grouped": 1,
+                    "dense_decode": 0}
+    np.testing.assert_allclose(grp, ref, atol=2e-5, rtol=1e-5)
+
+
+def test_tp_phase_eligibility():
+    """The pure-TP wrapper engages only on the exact decode layout it was
+    derived for: ep == 1, expert mlp on precisely tp, experts unsharded."""
+    r = dict(DEFAULT_RULES)
+    assert moe_tp_phase(build_mesh(tp_degree=2), r, "decode_experts",
+                        "decode_expert_mlp")
+    # ep > 1 belongs to the ring, never the tp wrapper
+    assert not moe_tp_phase(build_mesh(tp_degree=2, ep_degree=4), r,
+                            "decode_experts", "decode_expert_mlp")
+    # single device: the grouped kernel runs directly, no shard_map needed
+    assert not moe_tp_phase(build_mesh(tp_degree=1), r, "decode_experts",
+                            "decode_expert_mlp")
+    # expert mlp remapped off tp keeps GSPMD placement
+    r2 = dict(r, decode_expert_mlp=None)
+    assert not moe_tp_phase(build_mesh(tp_degree=2), r2, "decode_experts",
+                            "decode_expert_mlp")
 
 
 def test_ep_phase_eligibility():

@@ -1,0 +1,398 @@
+"""Disaggregated prefill/decode pools with live KV-block handoff (ISSUE-17).
+
+The remote_prefill policy places fresh arrivals on the PREFILL pool and
+decoding requests on the DECODE pool; the PoolManager live-hands committed
+prompt blocks across (device sessions or the checksummed host tier) while the
+prompt is still inserting. Every pin here is an acceptance clause: migrated
+streams BIT-identical to a never-migrated reference, the transfer OVERLAPPED
+with remaining prefill compute, a pressured decode pool deferring instead of
+OOMing, a source replica dying MID-handoff recovering with zero lost
+requests, a corrupted handoff block re-prefilling instead of poisoning the
+stream, and the memledger conservation auditor holding with
+``handoff_inflight`` blocks in flight (the autouse teardown audit sees every
+runner these tests build)."""
+
+import numpy as np
+import pytest
+
+from neuronx_distributed_inference_tpu.config import (
+    TpuConfig, load_pretrained_config)
+from neuronx_distributed_inference_tpu.models.llama.modeling_llama import (
+    LlamaForCausalLM, LlamaInferenceConfig)
+from neuronx_distributed_inference_tpu.runtime.continuous_batching import (
+    ContinuousBatchingRunner)
+from neuronx_distributed_inference_tpu.serving import (
+    EngineReplica, FaultInjector, HostKVTier, PrefixAffinityRouter,
+    ReplicaAutoscaler, REPLICA_FAILED)
+from neuronx_distributed_inference_tpu.serving import tracing
+
+BS = 8   # pa_block_size everywhere here
+INSERT_CAP = 16   # 2 blocks per insert window: multi-window prompts overlap
+
+
+def _make_app(hf_cfg, slots=2, blocks=48, seq_len=96):
+    tpu_cfg = TpuConfig(
+        batch_size=slots, seq_len=seq_len, max_context_length=32,
+        dtype="float32", context_encoding_buckets=[16, 32],
+        token_generation_buckets=[48, 96], is_continuous_batching=True,
+        paged_attention_enabled=True, pa_num_blocks=blocks, pa_block_size=BS)
+    config = LlamaInferenceConfig(tpu_cfg,
+                                  load_config=load_pretrained_config(hf_cfg))
+    app = LlamaForCausalLM(None, config)
+    app.load_random(seed=0)
+    return app
+
+
+@pytest.fixture(scope="module")
+def app(tiny_llama_hf_config):
+    return _make_app(tiny_llama_hf_config)
+
+
+def _replica(app, rid, role, tier="fresh", telemetry=False):
+    # a host tier on every replica keeps the Python tiered allocator in
+    # play: device handoff sessions stage through its alloc/hash seams and
+    # commit parks the blocks idle for the migrated request's prefix walk
+    if tier == "fresh":
+        tier = HostKVTier(capacity_blocks=64)
+    return EngineReplica(
+        str(rid), lambda tel: ContinuousBatchingRunner(
+            app, decode_chunk=4, telemetry=tel, kv_tier=tier,
+            max_insert_tokens_per_step=INSERT_CAP),
+        pool_role=role, telemetry_enabled=telemetry)
+
+
+def _fleet(app, *, p_tier="fresh", d_tier="fresh", telemetry=False):
+    return [_replica(app, "p0", "prefill", tier=p_tier, telemetry=telemetry),
+            _replica(app, "d0", "decode", tier=d_tier, telemetry=telemetry)]
+
+
+def _reference(app, prompts, max_new):
+    return [app.generate(p[None, :], max_new_tokens=max_new
+                         ).tokens[0].tolist() for p in prompts]
+
+
+def _prompts(seed, sizes):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(1, 256, size=(n,)).astype(np.int32) for n in sizes]
+
+
+# ------------------------------------------------------------- construction
+def test_pool_config_validation(app):
+    with pytest.raises(ValueError, match="pool_role must be one of"):
+        _replica(app, "x", "warmup")
+    # pool_config only makes sense under remote_prefill
+    with pytest.raises(ValueError, match="pool_config requires"):
+        PrefixAffinityRouter(_fleet(app), policy="affinity",
+                             pool_config={"channel": "device"})
+    # remote_prefill needs both sub-fleets present
+    with pytest.raises(ValueError, match="at least one prefill-pool"):
+        PrefixAffinityRouter(
+            [_replica(app, "p0", "prefill"), _replica(app, "p1", "prefill")],
+            policy="remote_prefill")
+    with pytest.raises(ValueError, match="channel must be one of"):
+        PrefixAffinityRouter(_fleet(app), policy="remote_prefill",
+                             pool_config={"channel": "rdma"})
+    # the tier channel needs a host tier on every decode-pool replica
+    with pytest.raises(ValueError, match="host KV tier on every"):
+        PrefixAffinityRouter(_fleet(app, d_tier=None),
+                             policy="remote_prefill",
+                             pool_config={"channel": "tier"})
+
+
+# ---------------------------------------------------- the acceptance e2e
+def test_device_handoff_overlap_bit_exact_migrates_to_decode_pool(app):
+    """THE acceptance e2e (device channel): fresh arrivals place on the
+    prefill pool, committed prompt blocks stream to the decode pool WHILE
+    the prompt is still inserting (overlap_blocks > 0), the migrated streams
+    finish on the decode replica, and every token is bit-identical to the
+    never-migrated reference."""
+    prompts = _prompts(11, (40, 27, 12))
+    refs = _reference(app, prompts, max_new=10)
+    router = PrefixAffinityRouter(_fleet(app), policy="remote_prefill",
+                                  pool_config={"channel": "device"})
+    rids = [router.submit(p, max_new_tokens=10) for p in prompts]
+    out = router.run_to_completion()
+
+    for i, rid in enumerate(rids):
+        assert out[rid] == refs[i], f"request {i} diverged across the handoff"
+    s = router.stats()
+    ps = s["pools"]
+    assert ps["channel"] == "device"
+    assert ps["roles"] == {"p0": "prefill", "d0": "decode"}
+    assert ps["completed"] == len(prompts)
+    assert ps["in_flight"] == 0
+    assert ps["blocks_total"] >= 4 and ps["bytes_total"] > 0
+    # the 40- and 27-token prompts span >1 insert window (cap 16): their
+    # early blocks moved while later windows were still inserting
+    assert ps["overlap_blocks"] > 0 and ps["overlap_ratio"] > 0
+    assert ps["latency_ms_p50"] is not None
+    assert s["migrations"] >= len(prompts)
+    for rid in rids:
+        req = router.requests[rid]
+        assert req.migrations >= 1, "stream never moved to the decode pool"
+        assert req.replica == "d0", "stream did not finish on the decode pool"
+        assert req.pin_replica is None, "the handoff pin must be one-shot"
+    # handoff counters reach the exposition surface
+    text = router.prometheus_text()
+    assert "pool_handoffs_completed_total" in text
+    assert "pool_handoff_overlapped_bytes_total" in text
+    # conservation on both endpoints after the dust settles
+    for rep in router.replicas.values():
+        rep.runner.audit_ledger(raise_on_violation=True)
+
+
+def test_tier_handoff_bit_exact_through_checksummed_host_tier(app):
+    """channel='tier': the bytes route through the DESTINATION's
+    content-addressed host tier (spilled straight from the source replica's
+    cache) and re-admit on the migrated request's prefix walk — bit-exact."""
+    prompts = _prompts(13, (40, 20))
+    refs = _reference(app, prompts, max_new=8)
+    d_tier = HostKVTier(capacity_blocks=64)
+    router = PrefixAffinityRouter(_fleet(app, d_tier=d_tier),
+                                  policy="remote_prefill",
+                                  pool_config={"channel": "tier"})
+    rids = [router.submit(p, max_new_tokens=8) for p in prompts]
+    out = router.run_to_completion()
+    for i, rid in enumerate(rids):
+        assert out[rid] == refs[i], f"request {i} diverged across the handoff"
+    ps = router.stats()["pools"]
+    assert ps["channel"] == "tier"
+    assert ps["completed"] == len(prompts)
+    assert ps["bytes_total"] > 0
+    # readmits drain entries back to the device as the migrated requests
+    # re-place, so peak occupancy bounds co-resident blocks, not the total
+    assert d_tier.stats()["watermark"] > 0, \
+        "the handed-off blocks never landed in the destination tier"
+    assert d_tier.readmit_blocks > 0, \
+        "the migrated prefix never re-admitted from the handed-off bytes"
+
+
+def test_placement_waits_for_wanted_pool_instead_of_crossing(app):
+    """A fresh arrival whose prefill pool is merely FULL waits in the
+    frontend queue (cross-phase interference is what disaggregation removes)
+    instead of placing on the decode pool."""
+    p0 = EngineReplica(
+        "p0", lambda tel: ContinuousBatchingRunner(
+            app, decode_chunk=4, telemetry=tel,
+            max_insert_tokens_per_step=INSERT_CAP),
+        pool_role="prefill", max_queue_depth=1)
+    router = PrefixAffinityRouter([p0, _replica(app, "d0", "decode")],
+                                  policy="remote_prefill",
+                                  pool_config={"channel": "device"})
+    prompts = _prompts(17, (20, 20, 20))   # queue cap 1: only one places now
+    rids = [router.submit(p, max_new_tokens=6) for p in prompts]
+    router.place_queued()
+    placed = [router.requests[r].replica for r in rids]
+    assert placed[0] == "p0", "fresh arrivals must place on the prefill pool"
+    assert placed[1] is None and placed[2] is None \
+        and len(router.queue) == 2, \
+        "a full prefill pool must queue the arrival, not cross pools"
+    out = router.run_to_completion()
+    assert all(len(out[r]) == 6 for r in rids)
+
+
+def test_deferred_by_decode_headroom_streams_finish_at_source(app,
+                                                              monkeypatch):
+    """Admission gate: when no decode-pool replica has handoff headroom the
+    transfer DEFERS (counted) and the request keeps decoding on its prefill
+    replica to a bit-exact finish — the destination is never OOMed into."""
+    prompts = _prompts(19, (24, 12))
+    refs = _reference(app, prompts, max_new=8)
+    router = PrefixAffinityRouter(_fleet(app), policy="remote_prefill",
+                                  pool_config={"channel": "device"})
+    monkeypatch.setattr(router.replicas["d0"].runner, "handoff_headroom",
+                        lambda: 0)
+    rids = [router.submit(p, max_new_tokens=8) for p in prompts]
+    out = router.run_to_completion()
+    for i, rid in enumerate(rids):
+        assert out[rid] == refs[i]
+    ps = router.stats()["pools"]
+    assert ps["deferred"] > 0, "the admission gate never engaged"
+    assert ps["completed"] == 0 and ps["blocks_total"] == 0
+    assert all(router.requests[r].replica == "p0" for r in rids), \
+        "deferred streams must finish where they are"
+
+
+def test_short_prompt_migrates_without_blocks(app):
+    """A prompt shorter than one block commits no full block: the migration
+    still happens (the decode pool owns decoding) but is counted as a
+    blockless migration, and the stream stays bit-exact."""
+    prompts = _prompts(23, (5,))
+    refs = _reference(app, prompts, max_new=8)
+    router = PrefixAffinityRouter(_fleet(app), policy="remote_prefill",
+                                  pool_config={"channel": "device"})
+    rid = router.submit(prompts[0], max_new_tokens=8)
+    out = router.run_to_completion()
+    assert out[rid] == refs[0]
+    ps = router.stats()["pools"]
+    assert ps["migrations_without_blocks"] == 1
+    assert router.requests[rid].replica == "d0"
+
+
+# ------------------------------------------------------------------ faults
+def test_mid_handoff_source_death_recovers_bit_exact_zero_lost(app):
+    """Fault composition: the prefill replica dies while a handoff is
+    staging. The session aborts (nothing half-staged survives as a prefix
+    entry), recover_replica rebuilds the stream from the journal, and the
+    re-queued request finishes bit-identically with zero requests lost."""
+    # 40 tokens at 16/window = 3 insert steps; death at step 2 lands with
+    # the transfer open and partially staged
+    prompts = _prompts(29, (40, 18))
+    refs = _reference(app, prompts, max_new=8)
+    inj = FaultInjector("death@p0:at_step=2", seed=0)
+    router = PrefixAffinityRouter(_fleet(app), policy="remote_prefill",
+                                  pool_config={"channel": "device"},
+                                  fault_injector=inj, auto_recover=True)
+    rids = [router.submit(p, max_new_tokens=8) for p in prompts]
+    out = router.run_to_completion()
+
+    assert inj.fired_total >= 1, "the death fault never fired"
+    for i, rid in enumerate(rids):
+        assert out[rid] == refs[i], f"request {i} diverged after recovery"
+    s = router.stats()
+    assert s["replica_state"]["p0"] == REPLICA_FAILED
+    assert s["recoveries"] == 1
+    assert s["finished"] == len(rids)
+    assert s["requests"] - s["finished"] == 0, "request(s) lost to the crash"
+    ps = s["pools"]
+    assert ps["aborted"].get("src_failed", 0) >= 1, \
+        "the in-flight handoff was never torn down after the source death"
+    assert ps["in_flight"] == 0
+    # the surviving decode replica's ledger balances after the abort
+    router.replicas["d0"].runner.audit_ledger(raise_on_violation=True)
+
+
+def test_corrupt_handoff_block_trips_checksum_and_reprefills(app):
+    """Integrity: a handoff block corrupted in the destination tier (bytes
+    rot between spill and the migrated request's prefix walk) must trip the
+    readmit checksum and RE-PREFILL — the stream completes bit-exactly
+    instead of decoding from poisoned KV."""
+    prompts = _prompts(31, (40,))
+    refs = _reference(app, prompts, max_new=8)
+    d_tier = HostKVTier(capacity_blocks=64)
+    # "at or AFTER" semantics: armed from d0's first step, fires at the
+    # first step where the destination tier actually holds handed-off bytes
+    inj = FaultInjector("corrupt@d0:at_step=1", seed=7)
+    router = PrefixAffinityRouter(_fleet(app, d_tier=d_tier),
+                                  policy="remote_prefill",
+                                  pool_config={"channel": "tier"},
+                                  fault_injector=inj)
+    rid = router.submit(prompts[0], max_new_tokens=8)
+    out = router.run_to_completion()
+    assert inj.fired_total == 1, "the corruption never fired"
+    assert d_tier.integrity_failures >= 1, \
+        "the checksum did not trip on the mutated handoff block"
+    assert out[rid] == refs[0], \
+        "stream diverged — corrupt handoff bytes were served"
+    ps = router.stats()["pools"]
+    assert ps["completed"] == 1
+    # chain order: the corrupt entry (and anything after it) re-prefilled
+    assert d_tier.readmit_blocks < ps["blocks_total"]
+
+
+# ------------------------------------------------------------ conservation
+def test_ledger_holds_handoff_inflight_blocks_at_scrape(app):
+    """Mid-transfer, the destination ledger carries the staged blocks as
+    ``handoff_inflight`` — the conservation audit passes WITH the session
+    open, and the state reaches the prometheus exposition. (The autouse
+    teardown audit re-checks both runners after completion.)"""
+    prompts = _prompts(37, (40,))
+    router = PrefixAffinityRouter(_fleet(app), policy="remote_prefill",
+                                  pool_config={"channel": "device"})
+    router.submit(prompts[0], max_new_tokens=6)
+    router.step()
+    router.step()
+    ps = router.stats()["pools"]
+    assert ps["in_flight"] == 1 and ps["blocks_total"] >= 2, \
+        "no transfer in flight after two steps — the overlap window is gone"
+    d0 = router.replicas["d0"]
+    report = d0.runner.audit_ledger(raise_on_violation=True)
+    assert report["ok"]
+    assert report["counts"]["handoff_inflight"] >= 2
+    text = d0.prometheus_text()
+    line = next(l for l in text.splitlines()
+                if 'serving_kv_blocks{replica="d0",state="handoff_inflight"}'
+                in l)
+    assert float(line.rsplit(" ", 1)[1]) >= 2
+    router.run_to_completion()
+    assert router.stats()["pools"]["in_flight"] == 0
+
+
+# ------------------------------------------------------------- autoscaling
+def test_per_pool_autoscaler_scopes_signals_and_growth(app):
+    """Each pool runs its own autoscaler: a ``pool=`` scope restricts fleet
+    size, headroom aggregation and growth to replicas of that role, and the
+    instruments carry the pool label so two autoscalers share one registry
+    without clobbering each other."""
+    clock = [0.0]
+    # queue cap 1 on the prefill replica: the backlog stays visible in the
+    # FRONTEND queue, which is the autoscaler's pressure signal
+    p0 = EngineReplica(
+        "p0", lambda tel: ContinuousBatchingRunner(
+            app, decode_chunk=4, telemetry=tel,
+            kv_tier=HostKVTier(capacity_blocks=64),
+            max_insert_tokens_per_step=INSERT_CAP),
+        pool_role="prefill", max_queue_depth=1)
+    router = PrefixAffinityRouter([p0, _replica(app, "d0", "decode")],
+                                  policy="remote_prefill",
+                                  pool_config={"channel": "device"})
+
+    def factory(rid):
+        return _replica(app, rid, "prefill")
+
+    asc_p = ReplicaAutoscaler(router, factory, pool="prefill",
+                              min_replicas=1, max_replicas=2,
+                              scale_up_queue_depth=0, up_after=1,
+                              cooldown_s=0.0, clock=lambda: clock[0])
+    asc_d = ReplicaAutoscaler(router, lambda rid: _replica(app, rid,
+                                                           "decode"),
+                              pool="decode", min_replicas=1, max_replicas=2,
+                              clock=lambda: clock[0])
+    assert asc_p._fleet_size() == 1 and asc_d._fleet_size() == 1
+    assert asc_p.stats()["pool"] == "prefill"
+    # backlog: more arrivals than the prefill pool's slots
+    prompts = _prompts(41, (16, 16, 16, 16))
+    rids = [router.submit(p, max_new_tokens=6) for p in prompts]
+    router.place_queued()
+    assert len(router.queue) >= 1
+    act = asc_p.tick()
+    assert act and act.startswith("grow:")
+    grown = act.split(":", 1)[1]
+    assert router.replicas[grown].pool_role == "prefill"
+    # the decode-pool autoscaler's world is unchanged by the prefill grow
+    assert asc_d._fleet_size() == 1 and asc_p._fleet_size() == 2
+    reg = router.registry
+    assert reg.get("autoscaler_replicas",
+                   labels={"pool": "prefill"}).value == 2
+    assert reg.get("autoscaler_replicas",
+                   labels={"pool": "decode"}).value == 1
+    out = router.run_to_completion()
+    assert all(len(out[r]) == 6 for r in rids)
+
+
+# ----------------------------------------------------------------- tracing
+def test_handoff_span_bridges_prefill_and_decode_segments(app):
+    """The router journal's handoff events become a ``handoff`` span in the
+    fleet trace, joining the prefill-pool and decode-pool segments of ONE
+    trace_id — the cross-pool story of a request is a single tree."""
+    prompts = _prompts(43, (40,))
+    router = PrefixAffinityRouter(_fleet(app, telemetry=True),
+                                  policy="remote_prefill",
+                                  pool_config={"channel": "device"})
+    rid = router.submit(prompts[0], max_new_tokens=6)
+    router.run_to_completion()
+    fleet = tracing.build_fleet_traces(
+        [r.trace_source() for r in router.replicas.values()],
+        router.trace_source())
+    assert len(fleet) == 1, f"one request -> one fleet trace, got {set(fleet)}"
+    trace = next(iter(fleet.values()))
+    hs = [s for s in trace["spans"] if s["kind"] == "handoff"]
+    assert len(hs) == 1, "one completed handoff must yield one handoff span"
+    a = hs[0]["attrs"]
+    assert a["from_replica"] == "p0" and a["to_replica"] == "d0"
+    assert a["channel"] == "device" and not a.get("aborted")
+    assert a["blocks"] >= 2 and hs[0]["t1"] is not None
+    segs = {s["attrs"].get("replica") for s in trace["spans"]
+            if s["kind"] == "segment"}
+    assert {"replicap0", "replicad0"} <= segs, \
+        "the trace must carry segments on BOTH pools around the handoff"
