@@ -635,7 +635,98 @@ class ContinuousBatchingRunner:
             self._h_cond = jnp.zeros(
                 (self.num_slots, app.arch_args.hidden_size), cfg.jax_dtype)
 
+        # --- live knob registry (serving/knobs.py, ISSUE-18) -----------------
+        # every schedule-only tunable enumerated with bounds + live gauges.
+        # Sets QUEUE into _pending_knobs and apply at the next pipeline-drain
+        # safe point (step() top, or immediately when nothing is in flight),
+        # so a mid-flight change can re-batch work but never change a stream.
+        self._pending_knobs: Dict[str, object] = {}
+        self._knob_change_counters: Dict[str, object] = {}
+        from ..serving.knobs import build_runner_knobs
+
+        self.knobs = build_runner_knobs(self)
+
         self._build_steps()
+
+    # ------------------------------------------------------------------ knobs
+    def set_knob(self, name: str, value) -> None:
+        """Queue one schedule-knob change (called through the KnobRegistry,
+        which validated bounds). Applied at the next safe point: immediately
+        when the dispatch pipeline is empty, else at the top of the next
+        step() after a drain — the same exact-sync path every other
+        steady-state exit uses."""
+        if name not in self._KNOB_APPLIERS:
+            raise KeyError(f"runner has no live applier for knob {name!r}")
+        self._pending_knobs[name] = value
+        if not self._inflight:
+            self._apply_pending_knobs()
+
+    def _apply_pending_knobs(self) -> None:
+        """Apply queued knob changes. Caller guarantees the pipeline is
+        empty (drained), so host state is exact and the change lands on a
+        commit boundary. Each applied change is stamped onto the next
+        step-timeline record (``knob:<name>=<value>``) and counted in
+        ``serving_knob_changes_total{knob=}`` — the same visibility contract
+        brown-out transitions have."""
+        if not self._pending_knobs:
+            return
+        assert not self._inflight, "knob apply requires a drained pipeline"
+        pending, self._pending_knobs = self._pending_knobs, {}
+        for name, value in pending.items():
+            self._KNOB_APPLIERS[name](self, value)
+            self._note_fall_through("knob", name, detail=str(value))
+            c = self._knob_change_counters.get(name)
+            if c is None:
+                c = self.telemetry.registry.counter(
+                    "serving_knob_changes_total",
+                    "live schedule-knob changes applied by the runner",
+                    labels={"knob": name})
+                self._knob_change_counters[name] = c
+            c.inc()
+        self.knobs.refresh()
+
+    def _apply_async_depth(self, v) -> None:
+        self.async_depth = int(v)
+        self._m_depth.set(self.async_depth)
+
+    def _apply_megastep_k(self, v) -> None:
+        # K is a DYNAMIC operand of the one megastep executable (the ring
+        # size is the static bound, enforced by the knob's hi); no retrace
+        self.megastep_k = int(v)
+
+    def _apply_decode_chunk(self, v) -> None:
+        self.decode_chunk = int(v)
+
+    def _apply_prefill_budget(self, v) -> None:
+        self.prefill_budget = int(v)
+        # chunk-row bucket count follows the budget; a row-count change means
+        # the next mixed dispatch jits a new (fixed-row) executable — trace
+        # cost only, schedule-only semantics
+        self.chunk_rows = max(1, self.prefill_budget // self.prefill_chunk)
+
+    def _apply_mixed_decode_steps(self, v) -> None:
+        self.mixed_decode_steps = int(v)
+
+    def _apply_spec_chunk(self, v) -> None:
+        self.spec_chunk = int(v)
+
+    def _apply_spec_adaptive(self, v) -> None:
+        self.spec_adaptive = bool(v)
+        if not self.spec_adaptive:
+            # leaving adaptive mode clears the floor guard: the next chunk
+            # speculates again instead of inheriting a stale fallback
+            self._spec_off = False
+            self._spec_plain_chunks = 0
+
+    _KNOB_APPLIERS = {
+        "async_depth": _apply_async_depth,
+        "megastep_k": _apply_megastep_k,
+        "decode_chunk": _apply_decode_chunk,
+        "prefill_token_budget": _apply_prefill_budget,
+        "mixed_decode_steps": _apply_mixed_decode_steps,
+        "spec_chunk": _apply_spec_chunk,
+        "spec_adaptive": _apply_spec_adaptive,
+    }
 
     # ------------------------------------------------------------------ jitted steps
     def _build_steps(self) -> None:
@@ -2043,6 +2134,10 @@ class ContinuousBatchingRunner:
             "depth": self.async_depth,
             "in_flight": len(self._inflight),
         }
+        # live knob table (serving/knobs.py): every tunable's current value
+        # + bounds — the tuner's enumeration surface and the audit trail's
+        # ground truth ("what was the fleet actually running?")
+        s["knobs"] = self.knobs.snapshot()
         if self.paged:
             s["kv_blocks_total"] = self.allocator.num_blocks
             s["kv_blocks_free"] = self.allocator.num_free
@@ -2416,6 +2511,13 @@ class ContinuousBatchingRunner:
             self._key, key = jax.random.split(self._key)
         emitted: Dict[int, List[int]] = {}
 
+        # queued live knob changes (serving/knobs.py) land FIRST, on a
+        # drained pipeline — the same exact sync path every steady-state
+        # exit uses, so the change is schedule-only by construction
+        if self._pending_knobs:
+            self._drain(emitted)
+            self._apply_pending_knobs()
+
         # leaving steady state (placements pending, a row near the seq bound,
         # block headroom gone, or async off) drains the pipeline first so the
         # sync path sees exact state
@@ -2721,8 +2823,15 @@ class ContinuousBatchingRunner:
         self._note_fall_through(from_kind, reason)
         return self._step_plain(key, emitted)
 
-    def _note_fall_through(self, from_kind: str, reason: str) -> None:
-        self._pending_fall_through.append(f"{from_kind}:{reason}")
+    def _note_fall_through(self, from_kind: str, reason: str,
+                           detail: Optional[str] = None) -> None:
+        """``detail``: free-form suffix stamped onto the timeline note but
+        NOT onto the counter labels (replica ids / knob values would blow up
+        the label cardinality; the timeline and journal carry them)."""
+        note = f"{from_kind}:{reason}"
+        if detail:
+            note = f"{note}={detail}"
+        self._pending_fall_through.append(note)
         c = self._ft_counters.get((from_kind, reason))
         if c is None:
             c = self.telemetry.registry.counter(

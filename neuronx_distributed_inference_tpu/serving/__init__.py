@@ -39,6 +39,18 @@ mesh cannot be millions of users"):
   committed KV blocks hand off LIVE to a decode-pool replica (device
   gather/scatter sessions or the checksummed host tier), the transfer
   overlapped against the remaining prefill chunks.
+- ``knobs``: :class:`KnobRegistry`/:class:`FleetKnobs` — the declarative
+  table of every live-tunable schedule knob (bounds, owners, gauge export),
+  the seam the control plane drives.
+- ``tuner``: :class:`ServingTuner` — the online controller: walks
+  schedule-only knobs from roofline/SLO/dispatch-gap signals with
+  per-phase rules, hysteresis, and a never-worse rollback guard; every
+  decision stamped into the step timeline, the router journal, and the
+  metrics registry (the decision audit trail).
+- ``replay``: :class:`ArrivalTrace`/:func:`replay` — the deterministic
+  what-if replayer: reconstruct an arrival schedule from a committed
+  router journal and re-run it on a real fleet under candidate knobs in
+  virtual time, scored by the existing waterfall/coverage pipeline.
 - ``memledger``: :class:`BlockLedger` — the accountable-KV-memory layer:
   every physical block attributed to an owner state ({free, live(request),
   idle(hash), host-reserved(hash), readmit-in-flight}), a conservation
@@ -58,12 +70,16 @@ from .engine import EngineReplica
 from .memledger import BlockLedger, MemLedgerViolation
 from .faults import (FaultInjector, FaultSpec, InjectedFault,
                      InjectedReplicaDeath)
+from .knobs import FleetKnobs, Knob, KnobRegistry
 from .kv_tiering import HostKVTier
 from .pools import POOL_DECODE, POOL_PREFILL, POOL_UNIFIED, PoolManager
+from .replay import Arrival, ArrivalTrace, ReplayResult, reconstruct_trace, \
+    replay
 from .router import (PrefixAffinityRouter, RouterOverloaded, RouterRequest,
                      REPLICA_DEGRADED, REPLICA_FAILED, REPLICA_HEALTHY,
                      REPLICA_RETIRED)
 from .sla import SLAClass, SLAClassSet, default_class_set
+from .tuner import ServingTuner, TunerRule, default_rules
 
 __all__ = ["EngineReplica", "HostKVTier", "PrefixAffinityRouter",
            "RouterRequest", "RouterOverloaded", "FaultInjector", "FaultSpec",
@@ -72,4 +88,6 @@ __all__ = ["EngineReplica", "HostKVTier", "PrefixAffinityRouter",
            "SLAClass", "SLAClassSet", "ReplicaAutoscaler",
            "default_class_set", "tracing", "memledger", "BlockLedger",
            "MemLedgerViolation", "PoolManager", "POOL_PREFILL", "POOL_DECODE",
-           "POOL_UNIFIED"]
+           "POOL_UNIFIED", "Knob", "KnobRegistry", "FleetKnobs",
+           "ServingTuner", "TunerRule", "default_rules", "Arrival",
+           "ArrivalTrace", "ReplayResult", "reconstruct_trace", "replay"]

@@ -110,6 +110,26 @@ class ReplicaAutoscaler:
             "autoscaler_replicas", "replicas currently in the placement set",
             labels=labels)
         self._g_replicas.set(self._fleet_size())
+        # live knob table (serving/knobs.py, ISSUE-18): fleet bounds +
+        # pressure thresholds, enumerated for the tuner and gauge-exported
+        from .knobs import build_autoscaler_knobs
+
+        self.knobs = build_autoscaler_knobs(self)
+
+    def _stamp_decision(self, action: str, rid: str,
+                        pressure: Dict[str, object]) -> None:
+        """Decision audit trail (ISSUE-18 satellite): every grow/drain/
+        retire lands in the router journal AND on every healthy replica's
+        next step-timeline record — the same plumbing brown-out transitions
+        use — so ``explain_request`` shows WHY a replica appeared or
+        drained mid-request instead of just that it did."""
+        self.router._trace_event(
+            "autoscale", action=action, replica=rid, pool=self.pool,
+            fleet_size=self._fleet_size(),
+            queue_depth=pressure.get("queue_depth"),
+            kv_headroom=pressure.get("kv_headroom"),
+            slo_unhealthy=pressure.get("slo_unhealthy"))
+        self.router.stamp_fleet("autoscaler", action, detail=rid)
 
     # -------------------------------------------------------------- signals
     def _in_scope(self, rep) -> bool:
@@ -180,6 +200,7 @@ class ReplicaAutoscaler:
                 self._c_down.inc()
                 self._g_replicas.set(self._fleet_size())
                 logger.info("autoscaler: retired drained replica %s", rid)
+                self._stamp_decision("retire", rid, self.pressure())
                 return f"retire:{rid}"
         p = self.pressure()
         if p["up"]:
@@ -218,6 +239,7 @@ class ReplicaAutoscaler:
         self._last_action_t = now
         self._up_streak = 0
         logger.warning("autoscaler: GREW replica %s (%s)", rid, pressure)
+        self._stamp_decision("grow", rid, pressure)
         return f"grow:{rid}"
 
     def _drain_one(self, now: float, pressure: Dict[str, object]) -> Optional[str]:
@@ -246,6 +268,7 @@ class ReplicaAutoscaler:
         self._down_streak = 0
         logger.warning("autoscaler: DRAINING replica %s (%d streams "
                        "migrating; %s)", best, migrated, pressure)
+        self._stamp_decision("drain", best, pressure)
         return f"drain:{best}"
 
     # ---------------------------------------------------------------- export
@@ -253,6 +276,7 @@ class ReplicaAutoscaler:
         return {
             "replicas": self._fleet_size(),
             "pool": self.pool,
+            "knobs": self.knobs.snapshot(),
             "min": self.min_replicas, "max": self.max_replicas,
             "draining": list(self._draining),
             "scale_ups": int(self._c_up.value),

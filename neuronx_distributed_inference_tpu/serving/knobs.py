@@ -1,0 +1,432 @@
+"""Declarative registry of every live-tunable serving knob (ISSUE-18).
+
+The observability plane measures everything (roofline efficiency, SLO
+health, dispatch gaps, fragmentation) but until now every schedule
+parameter was static constructor config scattered across three owners —
+the runner, the router, and the autoscaler. This module is the single
+enumerable table the control plane (serving/tuner.py) and the audit trail
+need:
+
+- :class:`Knob` — one tunable: name, scope (``runner`` / ``router`` /
+  ``autoscaler``), bounds, step rule, and getter/setter closures into the
+  owner's live state. Every knob here is SCHEDULE-ONLY: changing it can
+  re-batch, re-order, or re-chunk work but can never change any emitted
+  token stream (the bit-exactness invariant the whole serving stack is
+  built on — tests/test_tuner.py pins it across mid-flight changes).
+- :class:`KnobRegistry` — the per-owner table. Registration exports the
+  live value as a ``serving_knob{knob=}`` gauge on the owner's metrics
+  registry and every :meth:`set` re-exports it, so the CURRENT setting of
+  every knob is always one scrape away; ``snapshot()`` is the
+  ``stats()["knobs"]`` surface.
+- :class:`FleetKnobs` — the merged fleet-level view the tuner drives:
+  router- and autoscaler-scope knobs pass through, runner-scope knobs fan
+  out to EVERY healthy replica (schedule policy is fleet-uniform; a
+  replica added later inherits the fleet's values through
+  ``sync_replica``).
+
+Setters do NOT need to apply instantly: the runner's setters queue the
+change and apply it at the next pipeline-drain safe point (see
+``ContinuousBatchingRunner._apply_pending_knobs``), which is what makes a
+mid-flight change exact by construction. ``value()`` always reads the
+owner's live (applied) state.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Dict, List, Optional, Sequence
+
+logger = logging.getLogger("tpu-inference")
+
+__all__ = ["Knob", "KnobRegistry", "FleetKnobs", "build_runner_knobs",
+           "build_router_knobs", "build_autoscaler_knobs"]
+
+#: valid knob scopes (the owner layer the setter mutates)
+SCOPES = ("runner", "router", "autoscaler")
+
+
+class Knob:
+    """One live-tunable parameter: bounds + closures into the owner."""
+
+    __slots__ = ("name", "scope", "kind", "lo", "hi", "step", "doc",
+                 "get", "set", "tunable")
+
+    def __init__(self, name: str, *, scope: str, get: Callable[[], object],
+                 set: Callable[[object], None], kind: type = int,
+                 lo: Optional[float] = None, hi: Optional[float] = None,
+                 step: object = "x2", doc: str = "", tunable: bool = True):
+        if scope not in SCOPES:
+            raise ValueError(f"knob scope must be one of {SCOPES}, "
+                             f"got {scope!r}")
+        if kind not in (int, float, bool):
+            raise ValueError(f"knob kind must be int/float/bool, got {kind}")
+        self.name = name
+        self.scope = scope
+        self.kind = kind
+        self.lo = lo
+        self.hi = hi
+        # step rule for the tuner's walk: "x2" doubles/halves (integer
+        # knobs — the geometric walk covers a [1, ring] range in log steps),
+        # a number is an additive increment
+        self.step = step
+        self.doc = doc
+        self.get = get
+        self.set = set
+        # tunable=False: enumerated + audited + gauge-exported, but the
+        # online tuner must not touch it (e.g. values whose change forces a
+        # recompile mid-measurement)
+        self.tunable = tunable
+
+    def coerce(self, value: object):
+        """Validate + coerce a candidate value against kind and bounds."""
+        if self.kind is bool:
+            if not isinstance(value, (bool, int)) or value not in (0, 1,
+                                                                   True,
+                                                                   False):
+                raise ValueError(f"knob {self.name}: {value!r} is not a bool")
+            return bool(value)
+        try:
+            v = self.kind(value)
+        except (TypeError, ValueError):
+            raise ValueError(f"knob {self.name}: {value!r} is not "
+                             f"{self.kind.__name__}")
+        if self.kind is int and float(value) != float(v):
+            raise ValueError(f"knob {self.name}: {value!r} is not integral")
+        if self.lo is not None and v < self.lo:
+            raise ValueError(f"knob {self.name}: {v} below bound {self.lo}")
+        if self.hi is not None and v > self.hi:
+            raise ValueError(f"knob {self.name}: {v} above bound {self.hi}")
+        return v
+
+    def next_up(self, value) -> Optional[object]:
+        """The next candidate above ``value`` (None at the upper bound)."""
+        if self.kind is bool:
+            return True if not value else None
+        nxt = value * 2 if self.step == "x2" else value + self.step
+        if self.hi is not None:
+            nxt = min(nxt, self.hi)
+        nxt = self.kind(nxt)
+        return nxt if nxt != value else None
+
+    def next_down(self, value) -> Optional[object]:
+        """The next candidate below ``value`` (None at the lower bound)."""
+        if self.kind is bool:
+            return False if value else None
+        nxt = value // 2 if self.step == "x2" and self.kind is int \
+            else (value / 2 if self.step == "x2" else value - self.step)
+        if self.lo is not None:
+            nxt = max(nxt, self.lo)
+        nxt = self.kind(nxt)
+        return nxt if nxt != value else None
+
+
+class KnobRegistry:
+    """The declarative knob table of ONE owner (runner/router/autoscaler).
+
+    ``metrics_registry``: the owner's MetricsRegistry — registration and
+    every set() export the live value as ``serving_knob{knob=<name>}``."""
+
+    def __init__(self, metrics_registry=None, scope: str = "runner"):
+        if scope not in SCOPES:
+            raise ValueError(f"scope must be one of {SCOPES}, got {scope!r}")
+        self.scope = scope
+        self._metrics = metrics_registry
+        self._knobs: Dict[str, Knob] = {}
+        self._gauges: Dict[str, object] = {}
+
+    def register(self, name: str, *, get, set, kind: type = int,
+                 lo: Optional[float] = None, hi: Optional[float] = None,
+                 step: object = "x2", doc: str = "",
+                 tunable: bool = True) -> Knob:
+        if name in self._knobs:
+            raise ValueError(f"knob {name!r} already registered")
+        k = Knob(name, scope=self.scope, get=get, set=set, kind=kind,
+                 lo=lo, hi=hi, step=step, doc=doc, tunable=tunable)
+        self._knobs[name] = k
+        if self._metrics is not None:
+            g = self._metrics.gauge(
+                "serving_knob", "live value of a serving schedule knob "
+                "(serving/knobs.py)", labels={"knob": name})
+            self._gauges[name] = g
+        self.refresh(name)
+        return k
+
+    # ------------------------------------------------------------- queries
+    def __contains__(self, name: str) -> bool:
+        return name in self._knobs
+
+    def __len__(self) -> int:
+        return len(self._knobs)
+
+    def names(self) -> List[str]:
+        return sorted(self._knobs)
+
+    def knob(self, name: str) -> Knob:
+        if name not in self._knobs:
+            raise KeyError(f"unknown knob {name!r} (have {self.names()})")
+        return self._knobs[name]
+
+    def value(self, name: str):
+        return self.knob(name).get()
+
+    # ------------------------------------------------------------- mutation
+    def set(self, name: str, value) -> tuple:
+        """Validate, hand to the owner's setter, re-export the gauge.
+        Returns ``(old, new)`` — old is the live value BEFORE the set (the
+        owner may defer application to its next safe point; the gauge
+        tracks the requested target, refreshed to live state on apply)."""
+        k = self.knob(name)
+        v = k.coerce(value)
+        old = k.get()
+        k.set(v)
+        g = self._gauges.get(name)
+        if g is not None:
+            g.set(float(v))
+        return old, v
+
+    def refresh(self, name: Optional[str] = None) -> None:
+        """Re-export gauge(s) from the owner's LIVE state (called by the
+        runner after deferred knob application)."""
+        for n in ([name] if name is not None else list(self._knobs)):
+            g = self._gauges.get(n)
+            if g is not None:
+                g.set(float(self._knobs[n].get()))
+
+    # -------------------------------------------------------------- export
+    def snapshot(self) -> Dict[str, dict]:
+        """The ``stats()["knobs"]`` surface: every knob's live value,
+        bounds, scope, and tunability."""
+        out = {}
+        for name, k in sorted(self._knobs.items()):
+            out[name] = {"value": k.get(), "scope": k.scope,
+                         "lo": k.lo, "hi": k.hi,
+                         "kind": k.kind.__name__,
+                         "tunable": k.tunable, "doc": k.doc}
+        return out
+
+
+class FleetKnobs:
+    """The tuner's merged view over a fleet: one namespace spanning the
+    router's knobs, the autoscaler's, and the (fleet-uniform) runner knobs
+    of every healthy replica.
+
+    Runner-scope reads come from the first healthy replica; runner-scope
+    sets fan out to EVERY healthy replica (schedule policy is uniform —
+    two replicas running different megastep depths would make placement
+    latency depend on which replica a request landed on)."""
+
+    def __init__(self, router=None, autoscaler=None,
+                 runners: Optional[Sequence[object]] = None):
+        if router is None and autoscaler is None and not runners:
+            raise ValueError("FleetKnobs needs a router, an autoscaler, or "
+                             "runners")
+        self.router = router
+        self.autoscaler = autoscaler
+        self._runners = list(runners or [])
+
+    # ------------------------------------------------------------- helpers
+    def _runner_registries(self) -> List[KnobRegistry]:
+        regs = []
+        if self.router is not None:
+            for rid, rep in self.router.replicas.items():
+                if self.router.replica_state(rid) != "healthy":
+                    continue
+                kr = getattr(rep.runner, "knobs", None)
+                if kr is not None:
+                    regs.append(kr)
+        for r in self._runners:
+            kr = getattr(r, "knobs", None)
+            if kr is not None:
+                regs.append(kr)
+        return regs
+
+    def _owner_registries(self) -> List[KnobRegistry]:
+        out = []
+        if self.router is not None:
+            kr = getattr(self.router, "knobs", None)
+            if kr is not None:
+                out.append(kr)
+        if self.autoscaler is not None:
+            kr = getattr(self.autoscaler, "knobs", None)
+            if kr is not None:
+                out.append(kr)
+        return out
+
+    def _find(self, name: str):
+        """(registry, fan_out_registries) owning ``name``."""
+        for reg in self._owner_registries():
+            if name in reg:
+                return reg, [reg]
+        runner_regs = [r for r in self._runner_registries() if name in r]
+        if runner_regs:
+            return runner_regs[0], runner_regs
+        raise KeyError(f"unknown knob {name!r} (have {self.names()})")
+
+    # ------------------------------------------------------------- surface
+    def names(self) -> List[str]:
+        names = set()
+        for reg in self._owner_registries():
+            names.update(reg.names())
+        regs = self._runner_registries()
+        if regs:
+            names.update(regs[0].names())
+        return sorted(names)
+
+    def __contains__(self, name: str) -> bool:
+        try:
+            self._find(name)
+            return True
+        # lint: ok(silent-except): membership probe — False IS the answer ("spec_chunk" in knobs on a non-spec fleet); callers needing the failure use knob()/set(), which raise
+        except KeyError:
+            return False
+
+    def knob(self, name: str) -> Knob:
+        reg, _ = self._find(name)
+        return reg.knob(name)
+
+    def value(self, name: str):
+        reg, _ = self._find(name)
+        return reg.value(name)
+
+    def set(self, name: str, value) -> tuple:
+        """Set on the owner (fan-out across replicas for runner scope).
+        Returns ``(old, new)`` from the first registry."""
+        _, regs = self._find(name)
+        old = new = None
+        for i, reg in enumerate(regs):
+            o, n = reg.set(name, value)
+            if i == 0:
+                old, new = o, n
+        return old, new
+
+    def sync_replica(self, runner) -> int:
+        """Push the fleet's current runner-scope values onto a replica that
+        joined later (autoscaler grow): a grown replica must not serve
+        under stale constructor defaults while the rest of the fleet runs
+        tuned values. Returns the number of knobs synced."""
+        regs = self._runner_registries()
+        target = getattr(runner, "knobs", None)
+        if target is None or not regs:
+            return 0
+        src = regs[0]
+        if src is target:
+            return 0
+        n = 0
+        for name in src.names():
+            if name in target:
+                cur = src.value(name)
+                if target.value(name) != cur:
+                    target.set(name, cur)
+                    n += 1
+        return n
+
+    def snapshot(self) -> Dict[str, dict]:
+        out: Dict[str, dict] = {}
+        regs = self._runner_registries()
+        if regs:
+            out.update(regs[0].snapshot())
+        for reg in self._owner_registries():
+            out.update(reg.snapshot())
+        return out
+
+
+# ---------------------------------------------------------------- builders
+def build_runner_knobs(runner) -> KnobRegistry:
+    """The runner's schedule-only knob table. Setters QUEUE the change
+    (``runner.set_knob``) and the runner applies it at the next
+    pipeline-drain safe point, so every knob here is exact by construction
+    however mid-flight the change lands. Knobs whose feature is off for
+    this runner (no megastep / no mixed scheduler / no speculation) are
+    simply absent — the tuner cannot tune what the deployment didn't
+    enable."""
+    reg = KnobRegistry(runner.telemetry.registry, scope="runner")
+    mk = runner.set_knob
+    reg.register(
+        "async_depth", get=lambda: runner.async_depth,
+        set=lambda v: mk("async_depth", v), lo=1, hi=32,
+        doc="dispatch-ahead pipeline depth (chunks in flight)")
+    reg.register(
+        "decode_chunk", get=lambda: runner.decode_chunk,
+        set=lambda v: mk("decode_chunk", v), lo=1,
+        hi=max(1, runner.cfg.seq_len - 1), tunable=False,
+        doc="decode scan length per plain dispatch (retrace per value — "
+            "enumerated, not online-tuned)")
+    if runner.megastep_k is not None:
+        reg.register(
+            "megastep_k", get=lambda: runner.megastep_k,
+            set=lambda v: mk("megastep_k", v), lo=1, hi=runner.megastep_ring,
+            doc="device-resident inner steps per megastep dispatch (K is a "
+                "dynamic operand of one executable; ring bounds it)")
+    if runner.mixed:
+        reg.register(
+            "prefill_token_budget", get=lambda: runner.prefill_budget,
+            set=lambda v: mk("prefill_token_budget", v),
+            lo=runner.prefill_chunk, hi=runner.cfg.seq_len,
+            step=runner.prefill_chunk,
+            doc="prompt tokens packed per mixed step (chunk-row count "
+                "follows; row-count changes retrace once per value)")
+        reg.register(
+            "mixed_decode_steps", get=lambda: runner.mixed_decode_steps,
+            set=lambda v: mk("mixed_decode_steps", v), lo=1, hi=64,
+            doc="decode iterations chained inside each mixed dispatch")
+    if runner.k:
+        reg.register(
+            "spec_chunk", get=lambda: runner.spec_chunk,
+            set=lambda v: mk("spec_chunk", v), lo=1, hi=64,
+            doc="fused speculative iterations scanned per dispatch")
+        reg.register(
+            "spec_adaptive", get=lambda: runner.spec_adaptive,
+            set=lambda v: mk("spec_adaptive", v), kind=bool,
+            doc="acceptance-floor adaptive fallback to plain decode")
+    return reg
+
+
+def build_router_knobs(router) -> KnobRegistry:
+    """Router-scope knobs: overload-plane thresholds read fresh each step,
+    so plain attribute sets are live by nature."""
+    reg = KnobRegistry(router.registry, scope="router")
+
+    def attr(name, lo, hi, doc, kind=int, step="x2"):
+        reg.register(name,
+                     get=lambda: getattr(router, name),
+                     set=lambda v: setattr(router, name, v),
+                     kind=kind, lo=lo, hi=hi, step=step, doc=doc)
+
+    attr("brownout_up_after", 1, 64,
+         "consecutive unhealthy SLO readings before the ladder rises")
+    attr("brownout_down_after", 1, 64,
+         "consecutive healthy SLO readings before the ladder falls")
+    attr("brownout_decode_cap", 1, 256,
+         "max concurrent placements of a capped class (fleet-wide)")
+    if router.shed_queue_depth is not None:
+        attr("shed_queue_depth", 1, 100_000,
+             "frontend queue depth past which arrivals shed")
+    return reg
+
+
+def build_autoscaler_knobs(autoscaler) -> KnobRegistry:
+    """Autoscaler-scope knobs: fleet bounds + pressure thresholds (pure
+    host state, read per tick)."""
+    reg = KnobRegistry(autoscaler.router.registry, scope="autoscaler")
+
+    def attr(name, lo, hi, doc, kind=int, step=1):
+        def _set(v, _n=name):
+            old = getattr(autoscaler, _n)
+            setattr(autoscaler, _n, v)
+            if autoscaler.max_replicas < autoscaler.min_replicas:
+                setattr(autoscaler, _n, old)
+                raise ValueError("min_replicas must stay <= max_replicas")
+        reg.register(name, get=lambda _n=name: getattr(autoscaler, _n),
+                     set=_set, kind=kind, lo=lo, hi=hi, step=step, doc=doc)
+
+    attr("min_replicas", 1, 1024, "fleet size floor")
+    attr("max_replicas", 1, 1024, "fleet size ceiling")
+    attr("scale_up_queue_depth", 0, 100_000,
+         "router queue depth that counts as grow pressure")
+    attr("scale_down_queue_depth", 0, 100_000,
+         "router queue depth at or below which the fleet may shrink")
+    attr("up_after", 1, 64, "grow-pressure ticks before growing")
+    attr("down_after", 1, 64, "idle ticks before draining")
+    attr("cooldown_s", 0.0, 3600.0, "quiet period between actions",
+         kind=float, step="x2")
+    return reg

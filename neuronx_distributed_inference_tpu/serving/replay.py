@@ -1,0 +1,337 @@
+"""Deterministic what-if replayer over committed serving traces (ISSUE-18
+tentpole a).
+
+Tuning policies were untestable before this module: the only way to ask
+"would ``megastep_k=8`` have beaten ``=2`` on yesterday's traffic?" was to
+run yesterday's traffic again, and wall-clock arrival replays are not
+reproducible. This module closes that gap in three moves:
+
+- :class:`ArrivalTrace` — the portable arrival schedule: prompts, virtual
+  arrival timestamps, SLA classes, per-request serving params, and
+  ``trace_id`` join keys. Committable as JSONL (``save``/``load``), small
+  enough to live in ``tests/data/``.
+- :func:`reconstruct_trace` — rebuild an :class:`ArrivalTrace` from a
+  committed router-journal spool (``PrefixAffinityRouter.
+  write_trace_events``). Requires the router ran with
+  ``journal_prompts=True`` — prompts are payload, not telemetry, so the
+  default journal deliberately omits them and reconstruction fails with an
+  actionable error instead of fabricating tokens.
+- :func:`replay` — re-run the trace on a REAL fleet under candidate knob
+  settings, on **virtual time**: replay step ``n`` releases every arrival
+  with ``ts <= n * step_quantum_s``, then steps the router once. The
+  release schedule is a pure function of the trace, never of the host
+  clock, so the same trace + the same knobs produce the same submission
+  order, the same placement decisions, and therefore bit-identical token
+  streams (pinned by tests/test_tuner.py). Each replay is scored with the
+  EXISTING telemetry pipeline — per-replica
+  :func:`~.tracing.validate_coverage` (the PR 11 ≤5% reconciliation
+  contract) plus per-request waterfalls — so a candidate's report is held
+  to the same honesty bar as a live bench run.
+
+What-if comparison is then just two calls::
+
+    static = replay(trace, fleet_factory, knobs={"megastep_k": 2})
+    tuned  = replay(trace, fleet_factory, knobs={"megastep_k": 2},
+                    tuner_factory=lambda r: ServingTuner(router=r, ...))
+    ratio  = tuned.tokens_per_s / static.tokens_per_s
+
+and because both legs emit bit-identical streams (schedule-only knobs),
+the ratio is a pure scheduling comparison — never a quality trade.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from .knobs import FleetKnobs
+from . import tracing
+
+logger = logging.getLogger("tpu-inference")
+
+__all__ = ["Arrival", "ArrivalTrace", "ReplayResult", "reconstruct_trace",
+           "replay"]
+
+#: format tag of the committed ArrivalTrace JSONL header line
+TRACE_FORMAT = "arrival_trace_v1"
+
+
+@dataclass
+class Arrival:
+    """One request of the schedule: virtual arrival time + everything
+    ``router.submit`` needs to reproduce the original submission."""
+
+    ts: float                         # virtual seconds from trace start
+    prompt: List[int]
+    max_new_tokens: int = 32
+    eos_token_id: Optional[int] = None
+    sla_class: Optional[str] = None
+    adapter_id: int = 0
+    trace_id: Optional[str] = None    # join key back to the original run
+
+    def to_json(self) -> dict:
+        d = {"ts": self.ts, "prompt": list(self.prompt),
+             "max_new_tokens": self.max_new_tokens}
+        if self.eos_token_id is not None:
+            d["eos_token_id"] = self.eos_token_id
+        if self.sla_class is not None:
+            d["sla_class"] = self.sla_class
+        if self.adapter_id:
+            d["adapter_id"] = self.adapter_id
+        if self.trace_id is not None:
+            d["trace_id"] = self.trace_id
+        return d
+
+    @classmethod
+    def from_json(cls, d: dict) -> "Arrival":
+        return cls(ts=float(d["ts"]), prompt=[int(t) for t in d["prompt"]],
+                   max_new_tokens=int(d.get("max_new_tokens", 32)),
+                   eos_token_id=d.get("eos_token_id"),
+                   sla_class=d.get("sla_class"),
+                   adapter_id=int(d.get("adapter_id", 0)),
+                   trace_id=d.get("trace_id"))
+
+
+class ArrivalTrace:
+    """An ordered arrival schedule + the virtual-time quantum that maps it
+    onto router steps. ``step_quantum_s`` is PART of the trace: two replays
+    of one trace always agree on which step releases which arrival."""
+
+    def __init__(self, arrivals: List[Arrival], step_quantum_s: float,
+                 meta: Optional[dict] = None):
+        if step_quantum_s <= 0:
+            raise ValueError("step_quantum_s must be > 0")
+        self.arrivals = sorted(arrivals, key=lambda a: (a.ts,
+                                                        a.trace_id or ""))
+        self.step_quantum_s = float(step_quantum_s)
+        self.meta = dict(meta or {})
+
+    def __len__(self) -> int:
+        return len(self.arrivals)
+
+    def release_step(self, arrival: Arrival) -> int:
+        """The replay step index that releases this arrival (pure function
+        of the trace — the determinism anchor)."""
+        import math
+        return int(math.ceil(arrival.ts / self.step_quantum_s))
+
+    # ------------------------------------------------------------ save/load
+    def save(self, path: str) -> str:
+        """Commit as JSONL: one header line (format tag + quantum + meta),
+        one line per arrival."""
+        with open(path, "w") as fh:
+            fh.write(json.dumps({"format": TRACE_FORMAT,
+                                 "step_quantum_s": self.step_quantum_s,
+                                 "arrivals": len(self.arrivals),
+                                 "meta": self.meta}) + "\n")
+            for a in self.arrivals:
+                fh.write(json.dumps(a.to_json()) + "\n")
+        return path
+
+    @classmethod
+    def load(cls, path: str) -> "ArrivalTrace":
+        with open(path) as fh:
+            header = json.loads(fh.readline())
+            if header.get("format") != TRACE_FORMAT:
+                raise ValueError(
+                    f"{path}: not an ArrivalTrace spool (header format "
+                    f"{header.get('format')!r}, want {TRACE_FORMAT!r})")
+            arrivals = [Arrival.from_json(json.loads(line))
+                        for line in fh if line.strip()]
+        return cls(arrivals, header["step_quantum_s"],
+                   meta=header.get("meta"))
+
+
+def reconstruct_trace(journal_path: str, *,
+                      step_quantum_s: Optional[float] = None
+                      ) -> ArrivalTrace:
+    """Rebuild the arrival schedule from a committed router-journal spool.
+
+    Epoch semantics match :func:`~.tracing.load_jsonl_source`: a later
+    ``telemetry_epoch`` header marks a ``reset()`` and drops everything
+    before it. Arrival timestamps are re-zeroed to the first submit.
+
+    ``step_quantum_s`` defaults to the journal's own arrival cadence
+    (median inter-arrival gap, floored at 1 ms) — dense enough that the
+    replay preserves the trace's burst structure, coarse enough that idle
+    stretches don't spin empty router steps."""
+    submits: List[dict] = []
+    epoch = 0.0
+    with open(journal_path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            if rec.get("event") == "telemetry_epoch":
+                if rec.get("epoch", 0.0) > epoch:
+                    epoch = rec["epoch"]
+                    submits = []          # a reset(): earlier window discarded
+                continue
+            if rec.get("event") == "submit":
+                submits.append(rec)
+    if not submits:
+        raise ValueError(f"{journal_path}: no submit events in the journal")
+    missing = [r for r in submits if "prompt" not in r]
+    if missing:
+        raise ValueError(
+            f"{journal_path}: {len(missing)}/{len(submits)} submit events "
+            f"have no prompt tokens — the router must run with "
+            f"journal_prompts=True for its journal to be replayable "
+            f"(prompts are payload, so the default journal omits them)")
+    t0 = min(r["ts"] for r in submits)
+    arrivals = [Arrival(ts=r["ts"] - t0, prompt=r["prompt"],
+                        max_new_tokens=int(r.get("max_new_tokens", 32)),
+                        eos_token_id=r.get("eos_token_id"),
+                        sla_class=r.get("sla_class"),
+                        adapter_id=int(r.get("adapter_id", 0)),
+                        trace_id=r.get("trace_id"))
+                for r in submits]
+    if step_quantum_s is None:
+        ts = sorted(a.ts for a in arrivals)
+        gaps = sorted(b - a for a, b in zip(ts, ts[1:]) if b > a)
+        step_quantum_s = max(gaps[len(gaps) // 2], 1e-3) if gaps else 1e-3
+    return ArrivalTrace(arrivals, step_quantum_s,
+                        meta={"journal": journal_path,
+                              "reconstructed": True})
+
+
+@dataclass
+class ReplayResult:
+    """One replay leg's full report: streams, scores, and the audit."""
+
+    tokens: Dict[str, List[int]]            # trace_id -> emitted stream
+    steps: int                              # router steps the replay took
+    wall_s: float                           # host wall time of the loop
+    tokens_total: int
+    tokens_per_s: float
+    knobs: Dict[str, object]                # candidate settings applied
+    coverage: Dict[str, dict] = field(default_factory=dict)   # per replica
+    waterfalls: Dict[str, dict] = field(default_factory=dict) # per trace_id
+    shed: List[str] = field(default_factory=list)             # trace_ids
+    tuner_decisions: List[dict] = field(default_factory=list)
+    router_stats: Optional[dict] = None
+
+    @property
+    def coverage_ok(self) -> bool:
+        """The PR 11 honesty verdict over every replica that traced."""
+        return bool(self.coverage) and all(c["ok"]
+                                           for c in self.coverage.values())
+
+    def summary(self) -> dict:
+        wf = [w for w in self.waterfalls.values()
+              if w.get("ttft_ms") is not None]
+        mean = lambda xs: (sum(xs) / len(xs)) if xs else None  # noqa: E731
+        return {
+            "requests": len(self.tokens), "shed": len(self.shed),
+            "steps": self.steps, "tokens_total": self.tokens_total,
+            "tokens_per_s": round(self.tokens_per_s, 2),
+            "coverage_ok": self.coverage_ok,
+            "mean_ttft_ms": mean([w["ttft_ms"] for w in wf]),
+            "mean_e2e_ms": mean([w["e2e_ms"] for w in wf
+                                 if w.get("e2e_ms") is not None]),
+            "tuner_decisions": len(self.tuner_decisions),
+            "knobs": dict(self.knobs),
+        }
+
+
+def replay(trace: ArrivalTrace, fleet_factory: Callable[[], object], *,
+           knobs: Optional[Dict[str, object]] = None,
+           tuner_factory: Optional[Callable[[object], object]] = None,
+           tick_every: int = 1, tolerance: float = 0.05,
+           max_steps: int = 200_000) -> ReplayResult:
+    """Re-run ``trace`` on a fresh fleet under candidate ``knobs``.
+
+    ``fleet_factory`` builds a NEW router (replicas attached, telemetry
+    enabled for scoring) per call — legs must not share mutable state.
+    ``knobs`` are applied through :class:`FleetKnobs` BEFORE any arrival is
+    submitted (a candidate is a starting configuration). ``tuner_factory``
+    (router → controller with a ``tick()``) makes the leg self-tuning:
+    the controller runs every ``tick_every`` replay steps and its decisions
+    land in the result's audit trail.
+
+    Determinism: arrival release is indexed by replay step (virtual time),
+    not the host clock — see :meth:`ArrivalTrace.release_step`."""
+    import time
+
+    router = fleet_factory()
+    fleet = FleetKnobs(router=router)
+    applied: Dict[str, object] = {}
+    for name in sorted(knobs or {}):
+        fleet.set(name, knobs[name])
+        applied[name] = knobs[name]
+    tuner = tuner_factory(router) if tuner_factory is not None else None
+
+    arrivals = trace.arrivals
+    rid_to_tid: Dict[int, str] = {}
+    shed: List[str] = []
+    released = 0
+    n = 0
+    t_start = time.perf_counter()
+    while released < len(arrivals) or router.has_work:
+        if n >= max_steps:
+            raise RuntimeError(
+                f"replay exceeded max_steps={max_steps} with "
+                f"{len(arrivals) - released} arrivals unreleased — wedged "
+                f"fleet or a quantum far below the service rate")
+        vt = n * trace.step_quantum_s
+        while released < len(arrivals) and arrivals[released].ts <= vt:
+            a = arrivals[released]
+            tid = a.trace_id or f"arrival{released}"
+            try:
+                rid = router.submit(
+                    np.asarray(a.prompt, dtype=np.int32),
+                    max_new_tokens=a.max_new_tokens,
+                    eos_token_id=a.eos_token_id,
+                    adapter_id=a.adapter_id, sla_class=a.sla_class)
+                rid_to_tid[rid] = tid
+            # brown-out shed is a legitimate replay outcome (the candidate
+            # thresholds may shed what the original run admitted): recorded,
+            # not raised — a what-if must report load shedding, not die on it
+            except Exception as e:
+                if type(e).__name__ != "RouterOverloaded":
+                    raise
+                shed.append(tid)
+            released += 1
+        if router.has_work:
+            router.step()
+        if tuner is not None and n % max(1, tick_every) == 0:
+            tuner.tick()
+        if not router.has_work and released < len(arrivals):
+            # idle skip-ahead: jump virtual time straight to the next
+            # arrival's release step. Deterministic (a pure function of the
+            # trace and the drained fleet state) — it only skips steps that
+            # would have done nothing, so a journal recorded with long wall
+            # gaps (compile pauses, quiet traffic) replays in bounded steps.
+            n = max(n + 1, trace.release_step(arrivals[released]))
+        else:
+            n += 1
+    wall = time.perf_counter() - t_start
+
+    tokens = {rid_to_tid[rid]: list(req.generated)
+              for rid, req in router.requests.items() if rid in rid_to_tid}
+    total = sum(len(v) for v in tokens.values())
+    coverage: Dict[str, dict] = {}
+    waterfalls: Dict[str, dict] = {}
+    for repl_id, rep in sorted(router.replicas.items()):
+        tel = rep.runner.telemetry
+        if not tel.enabled:
+            continue
+        coverage[repl_id] = tracing.validate_coverage(
+            tel, tolerance=tolerance, source_name=f"replica{repl_id}")
+        ts = tracing.build_trace_set(
+            tracing.source_from_telemetry(f"replica{repl_id}", tel))
+        for _rid, tr in sorted(ts["traces"].items()):
+            if tr.get("trace_id") and tr["complete"]:
+                waterfalls[tr["trace_id"]] = tracing.waterfall(
+                    tr, ts["steps"], tolerance=tolerance)
+    return ReplayResult(
+        tokens=tokens, steps=n, wall_s=wall, tokens_total=total,
+        tokens_per_s=(total / wall if wall > 0 else 0.0), knobs=applied,
+        coverage=coverage, waterfalls=waterfalls, shed=shed,
+        tuner_decisions=(list(tuner.decisions) if tuner is not None else []),
+        router_stats=router.stats())

@@ -168,7 +168,8 @@ class PrefixAffinityRouter:
                  brownout_up_after: int = 3, brownout_down_after: int = 5,
                  brownout_decode_cap: int = 1,
                  shed_retry_after_s: float = 1.0,
-                 pool_config: Optional[dict] = None):
+                 pool_config: Optional[dict] = None,
+                 journal_prompts: bool = False):
         """Supervision knobs (fault tolerance, ISSUE-11):
 
         ``fault_injector``: a :class:`~.faults.FaultInjector` to attach
@@ -387,6 +388,19 @@ class PrefixAffinityRouter:
                 raise ValueError("pool_config requires policy="
                                  "'remote_prefill'")
             self.pools = None
+        # ``journal_prompts``: journal each submit's PROMPT TOKENS alongside
+        # the metadata it already records. This is what makes the journal a
+        # replayable arrival trace (serving/replay.py reconstructs prompts,
+        # timestamps, classes, and trace ids from it) — off by default
+        # because prompts are payload, not telemetry: a production journal
+        # should not retain user content unless the operator opted in.
+        self.journal_prompts = bool(journal_prompts)
+        # --- live knob table (serving/knobs.py, ISSUE-18) --------------------
+        # router-scope overload thresholds, enumerated + gauge-exported so
+        # the tuner can drive them and the audit trail can show them
+        from .knobs import build_router_knobs
+
+        self.knobs = build_router_knobs(self)
         self.fault_injector = fault_injector
         if fault_injector is not None:
             fault_injector.attach(self)
@@ -512,7 +526,11 @@ class PrefixAffinityRouter:
         self._c_submitted.inc()
         self._g_queue.set(len(self.queue))
         self._trace_event("submit", req, prompt_len=int(prompt.size),
-                          max_new_tokens=max_new_tokens, sla_class=sla_class)
+                          max_new_tokens=max_new_tokens, sla_class=sla_class,
+                          **({"prompt": prompt.tolist(),
+                              "eos_token_id": eos_token_id,
+                              "adapter_id": adapter_id}
+                             if self.journal_prompts else {}))
         return req.request_id
 
     # ------------------------------------------------------------- placement
@@ -914,13 +932,23 @@ class PrefixAffinityRouter:
             "brown-out level %d (%s): shedding %s, capping %s (decode cap "
             "%d)", level, direction, sorted(acts["shed"]) or "nothing",
             sorted(acts["cap"]) or "nothing", self.brownout_decode_cap)
+        self.stamp_fleet("brownout", f"{direction}_level_{level}")
+
+    def stamp_fleet(self, from_kind: str, reason: str,
+                    detail: Optional[str] = None) -> None:
+        """Stamp one control-plane decision onto every healthy replica's
+        next step-timeline record (the runner ``_fall_through`` plumbing) —
+        THE shared mechanism for brown-out transitions, autoscaler
+        grow/drain/retire, and tuner knob decisions, so ``explain_request``
+        can show why the fleet changed shape mid-request. ``detail`` rides
+        the timeline note only (never the counter labels)."""
         for rid, rep in self.replicas.items():
             if self._health.get(rid) != REPLICA_HEALTHY:
                 continue
             try:
-                rep.runner._note_fall_through("brownout",
-                                              f"{direction}_level_{level}")
-            # lint: ok(silent-except): best-effort telemetry stamp; the transition is already counted+logged at the router
+                rep.runner._note_fall_through(from_kind, reason,
+                                              detail=detail)
+            # lint: ok(silent-except): best-effort telemetry stamp; the decision is already counted+logged+journaled at its origin
             except Exception:
                 pass
 
@@ -1348,6 +1376,7 @@ class PrefixAffinityRouter:
         return {
             "policy": self.policy,
             "prefix_caching": self.prefix_caching,
+            "knobs": self.knobs.snapshot(),
             "queue_depth": len(self.queue),
             "requests": self._c_submitted.value,
             "finished": self._c_finished.value,

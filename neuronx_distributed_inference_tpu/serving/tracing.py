@@ -55,6 +55,17 @@ __all__ = ["source_from_telemetry", "source_from_router", "load_jsonl_source",
 # step-timeline kinds by role (the event→span classification key)
 PREFILL_KINDS = ("insert", "insert_window")
 DECODE_KINDS = ("decode", "spec_chunk", "megastep")
+
+# fall-through note origins that are CONTROL-PLANE DECISIONS (brown-out
+# transitions, autoscaler actions, tuner knob walks, knob applications) —
+# surfaced as zero-duration ``decision`` spans inside every request tree
+# whose lifetime covers them, so ``explain_request`` shows WHY the fleet
+# changed shape mid-request (ISSUE-18 audit trail)
+DECISION_ORIGINS = ("brownout", "autoscaler", "tuner", "knob")
+
+# router-journal events that are fleet-level decisions (no trace_id of
+# their own; joined to requests by time overlap in build_fleet_traces)
+DECISION_EVENTS = ("brownout", "autoscale", "tuner_decision")
 MIXED_KINDS = ("mixed",)
 
 
@@ -180,6 +191,17 @@ def build_trace_set(source: dict,
     (the span-leak check keys on this)."""
     epoch = source.get("epoch", 0.0)
     steps_abs = _abs_steps(source)
+    # control-plane decisions stamped onto the step timeline (the runner's
+    # _note_fall_through plumbing): read from the RAW records — _abs_steps
+    # deliberately strips extras
+    decisions: List[Tuple[int, float, str]] = []
+    for i, s in enumerate(source.get("steps") or []):
+        ft = s.get("fall_through")
+        if not ft:
+            continue
+        for note in str(ft).split(","):
+            if note.split(":", 1)[0] in DECISION_ORIGINS:
+                decisions.append((i, s["ts"] + epoch, note))
     by_rid: Dict[int, List[dict]] = {}
     for e in source.get("events") or []:
         rid = e.get("request_id")
@@ -254,6 +276,13 @@ def build_trace_set(source: dict,
                        tokens=e.get("tokens"),
                        step_kind=step["kind"] if step else None,
                        step_index=step["index"] if step else None)
+        # zero-duration decision spans: every control-plane decision this
+        # request lived through (zero width — waterfall reconciliation and
+        # the span-leak check are unaffected by construction)
+        for i, t, note in decisions:
+            if t >= t_arr and (t_fin is None or t <= t_fin):
+                tb.add(f"decision:{note.split('=', 1)[0]}", "decision",
+                       t, t, root, note=note, step_index=i)
         traces[rid] = {
             "trace_id": arrival.get("trace_id"), "request_id": rid,
             "source": source["name"], "complete": finish is not None,
@@ -497,12 +526,17 @@ def build_fleet_traces(replica_sources: Sequence[dict],
             if tid is not None:
                 by_tid.setdefault(tid, []).append(trace)
     router_by_tid: Dict[str, List[dict]] = {}
+    r_decisions: List[dict] = []
     r_epoch = router_source.get("epoch", 0.0) if router_source else 0.0
     if router_source:
         for e in router_source.get("events") or []:
             tid = e.get("trace_id")
             if tid is not None:
                 router_by_tid.setdefault(tid, []).append(e)
+            elif e.get("event") in DECISION_EVENTS:
+                # fleet-level decisions carry no trace_id: joined to every
+                # request whose lifetime covers them (below)
+                r_decisions.append(e)
     out: Dict[str, dict] = {}
     for tid in set(by_tid) | set(router_by_tid):
         segments = sorted(by_tid.get(tid, ()),
@@ -571,6 +605,15 @@ def build_fleet_traces(replica_sources: Sequence[dict],
                 tb.add("recovered", "recovered", t, nxt if nxt else t, root,
                        altitude="router", from_replica=e.get("from_replica"),
                        resumed_tokens=e.get("resumed_tokens"))
+        # router-altitude decision spans (zero duration): the brown-out /
+        # autoscale / tuner decisions this request lived through
+        for e in r_decisions:
+            t = e["ts"] + r_epoch
+            if t >= t0 and (t1 is None or t <= t1):
+                attrs = {k: v for k, v in e.items()
+                         if k not in ("ts", "event")}
+                tb.add(f"decision:{e['event']}", "decision", t, t, root,
+                       altitude="router", **attrs)
         recovers = [e for e in r_evs if e["event"] == "recover"]
         for i, seg in enumerate(segments):
             edge = {}

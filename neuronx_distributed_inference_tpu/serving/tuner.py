@@ -1,0 +1,494 @@
+"""Online knob controller with a decision audit trail (ISSUE-18 tentpole b).
+
+:class:`ServingTuner` closes the observability→control loop: the stack
+already MEASURES everything (roofline efficiency, SLO health, dispatch
+gaps, queue depth), and this controller acts on those signals by walking
+the schedule-only knobs the :mod:`serving.knobs` registry enumerates —
+``megastep_k``, ``async_depth``, ``prefill_token_budget``, ``spec_chunk``,
+brown-out thresholds, autoscaler bounds. Every knob is schedule-only, so
+bit-exactness of every emitted stream is preserved BY CONSTRUCTION however
+the controller walks them (the runner applies changes at pipeline-drain
+safe points; tests/test_tuner.py pins tokens bit-identical under arbitrary
+knob trajectories).
+
+Control discipline (the autoscaler's, generalized):
+
+- **Workload-phase classification** per tick: ``interactive`` (short
+  prompts, shallow queue), ``bulk`` (deep queue / high occupancy), or
+  ``long_context`` (mean recent prompt length past a threshold). Rules are
+  phase-conditioned — the megastep walk-up that wins a decode-heavy bulk
+  window is exactly what an interactive burst under SLO pressure walks
+  back down.
+- **Hysteresis**: a rule must hold for ``up_after``/``down_after``
+  consecutive ticks before acting; a ``cooldown_s`` quiet period separates
+  actions; at most ONE knob change per tick. ``clock`` is injectable.
+- **Never-worse guard**: each change records the measured objective rate
+  (tokens/s by default) as its baseline; after ``eval_ticks`` ticks the
+  candidate's rate is compared, and a regression past
+  ``rollback_tolerance`` rolls the knob back (counted
+  ``tuner_rollbacks_total``) and freezes that direction for
+  ``freeze_ticks``. While a candidate is under evaluation no new change
+  starts — evaluation is serial so the attribution is unambiguous.
+
+Decision audit trail — every decision (and rollback) is stamped exactly
+like a brown-out transition:
+
+- ``tuner_decisions_total{knob=,direction=}`` counter +
+  ``serving_knob{knob=}`` gauges (via the registry set);
+- ONE structured ``tuner_decision {json}`` log line;
+- a ``tuner_decision`` router-journal event (fleet traces show it);
+- the runner ``_fall_through`` plumbing stamps ``tuner:<knob>_<dir>`` onto
+  every healthy replica's next step-timeline record, so
+  ``explain_request`` span trees show the decision inside the requests it
+  affected.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+from typing import Callable, Dict, List, Optional
+
+from .knobs import FleetKnobs
+
+logger = logging.getLogger("tpu-inference")
+
+__all__ = ["ServingTuner", "TunerRule", "default_rules", "PHASES"]
+
+PHASES = ("interactive", "bulk", "long_context")
+
+
+class TunerRule:
+    """One phase-conditioned walk rule: when ``when(signals)`` holds for
+    the hysteresis window, walk ``knob`` one step in ``direction``."""
+
+    __slots__ = ("knob", "direction", "when", "reason")
+
+    def __init__(self, knob: str, direction: str,
+                 when: Callable[[Dict[str, object]], bool], reason: str):
+        if direction not in ("up", "down"):
+            raise ValueError(f"direction must be up/down, got {direction!r}")
+        self.knob = knob
+        self.direction = direction
+        self.when = when
+        self.reason = reason
+
+    @property
+    def key(self) -> tuple:
+        return (self.knob, self.direction)
+
+
+def default_rules() -> List[TunerRule]:
+    """The built-in policy, in priority order (first matured rule wins the
+    tick). Latency protection (walk-downs under SLO pressure) outranks
+    throughput (walk-ups in healthy decode-heavy windows); rules for knobs
+    a deployment didn't enable are skipped at evaluation."""
+    return [
+        # SLO pressure on interactive traffic: shrink the schedule quanta
+        # first — long device-resident loops and deep pipelines are where
+        # TTFT hides
+        TunerRule("megastep_k", "down",
+                  lambda s: not s["slo_healthy"]
+                  and s["phase"] == "interactive",
+                  "SLO unhealthy on interactive traffic: shorter megasteps "
+                  "bound insert service latency"),
+        TunerRule("async_depth", "down",
+                  lambda s: not s["slo_healthy"]
+                  and s["phase"] == "interactive",
+                  "SLO unhealthy on interactive traffic: shallower pipeline "
+                  "commits tokens sooner"),
+        TunerRule("prefill_token_budget", "down",
+                  lambda s: not s["slo_healthy"]
+                  and s["phase"] == "interactive",
+                  "SLO unhealthy on interactive traffic: smaller prefill "
+                  "bites bound decode interference"),
+        # healthy decode-heavy windows: amortize the host round trip harder
+        TunerRule("megastep_k", "up",
+                  lambda s: s["slo_healthy"] and s["decode_heavy"],
+                  "decode-heavy window: amortize the dispatch floor over "
+                  "more device-resident inner steps"),
+        TunerRule("async_depth", "up",
+                  lambda s: s["slo_healthy"] and s["decode_heavy"]
+                  and (s["dispatch_gap_frac"] or 0.0) > 0.2,
+                  "measured dispatch gap: deepen the dispatch-ahead "
+                  "pipeline to overlap host commit work"),
+        TunerRule("spec_chunk", "up",
+                  lambda s: s["slo_healthy"] and s["decode_heavy"],
+                  "decode-heavy window: longer fused speculative scans per "
+                  "round trip"),
+        # long-context intake with a backlog: feed prompts in bigger bites
+        TunerRule("prefill_token_budget", "up",
+                  lambda s: s["slo_healthy"] and s["phase"] == "long_context"
+                  and s["queue_depth"] > 0,
+                  "long-context backlog: raise the mixed-step prompt-token "
+                  "budget"),
+    ]
+
+
+class ServingTuner:
+    """Drive the fleet's knob registries from measured serving signals.
+
+    Targets either a ``router=`` fleet (knob sets fan out across healthy
+    replicas, decisions land in the router journal) or a single
+    ``runner=``. Tests inject ``clock`` / ``signals`` / ``objective``; in
+    production the defaults read the live fleet.
+
+    ``objective``: callable returning a MONOTONE cumulative count (default:
+    the router's emitted-token counter); the never-worse guard compares
+    rates of this. ``signals``: callable returning a partial signal dict
+    that overrides gathered values (tests drive phases deterministically).
+    ``knob_whitelist``: restrict tuning to these knobs (e.g. only the
+    retrace-free ones for a measurement window)."""
+
+    def __init__(self, *, router=None, runner=None, autoscaler=None,
+                 knobs: Optional[FleetKnobs] = None,
+                 slo_signal: Optional[Callable[[], bool]] = None,
+                 objective: Optional[Callable[[], float]] = None,
+                 signals: Optional[Callable[[], Dict[str, object]]] = None,
+                 rules: Optional[List[TunerRule]] = None,
+                 knob_whitelist: Optional[List[str]] = None,
+                 up_after: int = 2, down_after: int = 2,
+                 cooldown_s: float = 0.0, eval_ticks: int = 4,
+                 rollback_tolerance: float = 0.1, freeze_ticks: int = 8,
+                 long_prompt_threshold: int = 512, bulk_queue_depth: int = 4,
+                 bulk_occupancy: float = 0.75, gap_window: int = 32,
+                 clock: Callable[[], float] = time.monotonic,
+                 max_decisions: int = 1000):
+        if router is None and runner is None and knobs is None:
+            raise ValueError("ServingTuner needs a router, a runner, or an "
+                             "explicit FleetKnobs")
+        if up_after < 1 or down_after < 1 or eval_ticks < 1:
+            raise ValueError("up_after/down_after/eval_ticks must be >= 1")
+        self.router = router
+        self.runner = runner
+        self.autoscaler = autoscaler
+        self.knobs = knobs if knobs is not None else FleetKnobs(
+            router=router, autoscaler=autoscaler,
+            runners=[runner] if runner is not None else None)
+        self.slo_signal = slo_signal
+        self._signals_fn = signals
+        self.rules = rules if rules is not None else default_rules()
+        self.knob_whitelist = (set(knob_whitelist)
+                               if knob_whitelist is not None else None)
+        self.up_after = int(up_after)
+        self.down_after = int(down_after)
+        self.cooldown_s = float(cooldown_s)
+        self.eval_ticks = int(eval_ticks)
+        self.rollback_tolerance = float(rollback_tolerance)
+        self.freeze_ticks = int(freeze_ticks)
+        self.long_prompt_threshold = int(long_prompt_threshold)
+        self.bulk_queue_depth = int(bulk_queue_depth)
+        self.bulk_occupancy = float(bulk_occupancy)
+        self.gap_window = int(gap_window)
+        self.clock = clock
+        self.max_decisions = int(max_decisions)
+        if objective is not None:
+            self._objective = objective
+        elif router is not None:
+            self._objective = lambda: float(router._c_tokens.value)
+        else:
+            raise ValueError("runner-only tuning needs an explicit "
+                             "objective= (cumulative token count)")
+        reg = (router.registry if router is not None
+               else runner.telemetry.registry)
+        self.registry = reg
+        self._c_ticks = reg.counter(
+            "tuner_ticks_total", "tuner control-loop evaluations")
+        self._c_rollbacks = reg.counter(
+            "tuner_rollbacks_total",
+            "knob changes rolled back by the never-worse guard")
+        self._c_decisions: Dict[tuple, object] = {}
+        self._g_phase = {
+            p: reg.gauge("serving_tuner_phase",
+                         "1 while the tuner classifies the workload as this "
+                         "phase", labels={"phase": p})
+            for p in PHASES}
+        self._streaks: Dict[tuple, int] = {}
+        self._frozen_until: Dict[tuple, int] = {}
+        self._ticks = 0
+        self._last_action_t: Optional[float] = None
+        self._pending_eval: Optional[dict] = None
+        self._history: List[tuple] = []        # (t, cumulative objective)
+        self._prompt_len_ewma: Optional[float] = None
+        self._rid_mark = (router._next_id if router is not None else 0)
+        self._seen_replicas = (set(router.replicas)
+                               if router is not None else set())
+        self.decisions: List[dict] = []
+        self.phase = "interactive"
+
+    # -------------------------------------------------------------- signals
+    def _healthy_runners(self) -> List[object]:
+        if self.router is not None:
+            return [rep.runner for rid, rep in self.router.replicas.items()
+                    if self.router.replica_state(rid) == "healthy"]
+        return [self.runner] if self.runner is not None else []
+
+    def _dispatch_gap_frac(self, runners) -> Optional[float]:
+        """Measured host-gap fraction over the freshest step records: the
+        wall-time span of the last ``gap_window`` records minus the time
+        covered by their host spans. None without telemetry (no records)."""
+        gaps = []
+        for r in runners:
+            steps = r.telemetry.steps
+            if len(steps) < 4:
+                continue
+            win = steps[-self.gap_window:]
+            span = (win[-1]["ts"] + win[-1].get("dur_s", 0.0)) - win[0]["ts"]
+            if span <= 0:
+                continue
+            busy = sum(s.get("dur_s", 0.0) for s in win)
+            gaps.append(max(0.0, 1.0 - min(busy / span, 1.0)))
+        return (sum(gaps) / len(gaps)) if gaps else None
+
+    def _roofline_eff(self, runners) -> Optional[float]:
+        """Min decode-family roofline efficiency, when the PR 13 join ran
+        (attribute_device_time attaches it to the telemetry)."""
+        effs = []
+        for r in runners:
+            rl = getattr(r.telemetry, "roofline", None)
+            if not isinstance(rl, dict):
+                continue
+            for kind, row in rl.items():
+                if isinstance(row, dict) and row.get("efficiency") is not None:
+                    effs.append(float(row["efficiency"]))
+        return min(effs) if effs else None
+
+    def _note_recent_prompts(self) -> None:
+        """Fold prompt lengths of arrivals since the last tick into the
+        EWMA (the phase classifier's long-context signal)."""
+        lens: List[int] = []
+        if self.router is not None:
+            for rid in range(self._rid_mark, self.router._next_id):
+                req = self.router.requests.get(rid)
+                if req is not None:
+                    lens.append(len(req.prompt))
+            self._rid_mark = self.router._next_id
+        elif self.runner is not None:
+            lens = [len(r.prompt) for r in self.runner.queue]
+        for n in lens:
+            self._prompt_len_ewma = (
+                float(n) if self._prompt_len_ewma is None
+                else 0.7 * self._prompt_len_ewma + 0.3 * float(n))
+
+    def gather_signals(self) -> Dict[str, object]:
+        """One tick's signal snapshot (``signals=`` overrides win)."""
+        runners = self._healthy_runners()
+        queue = (len(self.router.queue) if self.router is not None
+                 else (len(self.runner.queue) if self.runner is not None
+                       else 0))
+        active = slots = 0
+        inserting = False
+        for r in runners:
+            slots += r.num_slots
+            for req in r.active:
+                if req is not None and not req.done:
+                    active += 1
+                    inserting = inserting or req.inserting
+            queue += len(r.queue) if self.router is not None else 0
+        self._note_recent_prompts()
+        sig: Dict[str, object] = {
+            "queue_depth": queue,
+            "occupancy": active / slots if slots else 0.0,
+            "active": active,
+            "inserting": inserting,
+            "mean_prompt_len": self._prompt_len_ewma or 0.0,
+            "slo_healthy": (bool(self.slo_signal())
+                            if self.slo_signal is not None else True),
+            "dispatch_gap_frac": self._dispatch_gap_frac(runners),
+            "roofline_eff_min": self._roofline_eff(runners),
+        }
+        sig["decode_heavy"] = (queue == 0 and active > 0 and not inserting)
+        if self._signals_fn is not None:
+            sig.update(self._signals_fn())
+        sig["phase"] = self.classify_phase(sig)
+        return sig
+
+    def classify_phase(self, sig: Dict[str, object]) -> str:
+        if sig.get("mean_prompt_len", 0.0) >= self.long_prompt_threshold:
+            return "long_context"
+        if (sig.get("queue_depth", 0) >= self.bulk_queue_depth
+                or sig.get("occupancy", 0.0) >= self.bulk_occupancy):
+            return "bulk"
+        return "interactive"
+
+    # ------------------------------------------------------------ objective
+    def _rate_since(self, t0: float, tok0: float,
+                    t1: float, tok1: float) -> Optional[float]:
+        dt = t1 - t0
+        return (tok1 - tok0) / dt if dt > 0 else None
+
+    def _baseline_rate(self) -> Optional[float]:
+        """Objective rate over (up to) the last ``eval_ticks`` ticks."""
+        if len(self._history) < 2:
+            return None
+        t1, k1 = self._history[-1]
+        t0, k0 = self._history[max(0, len(self._history) - 1
+                                   - self.eval_ticks)]
+        return self._rate_since(t0, k0, t1, k1)
+
+    # ----------------------------------------------------------------- tick
+    def tick(self) -> List[dict]:
+        """One control-loop evaluation; returns the decisions made (0 or 1
+        change, or a rollback). Call it from the serving loop — every
+        router step or on a timer."""
+        now = self.clock()
+        self._ticks += 1
+        self._c_ticks.inc()
+        # a replica grown since the last tick (autoscaler) missed earlier
+        # fan-out sets: sync it to the fleet's current runner-scope values
+        if self.router is not None:
+            for rid, rep in self.router.replicas.items():
+                if rid not in self._seen_replicas:
+                    self._seen_replicas.add(rid)
+                    self.knobs.sync_replica(rep.runner)
+        sig = self.gather_signals()
+        self.phase = sig["phase"]
+        for p, g in self._g_phase.items():
+            g.set(1.0 if p == self.phase else 0.0)
+        tok = float(self._objective())
+        self._history.append((now, tok))
+        if len(self._history) > 4 * self.eval_ticks + 8:
+            del self._history[: 2 * self.eval_ticks]
+
+        out: List[dict] = []
+        # never-worse guard: evaluate the in-flight candidate first
+        pe = self._pending_eval
+        if pe is not None and self._ticks - pe["tick"] >= self.eval_ticks:
+            self._pending_eval = None
+            rate = self._rate_since(pe["t"], pe["tok"], now, tok)
+            base = pe["baseline_rate"]
+            if (rate is not None and base is not None
+                    and rate < base * (1.0 - self.rollback_tolerance)):
+                out.append(self._rollback(pe, rate, sig))
+            else:
+                pe["kept_rate"] = rate
+        # update every rule's hysteresis streak on every tick (matching the
+        # brown-out ladder: a condition that lapses resets its streak)
+        matured: Optional[TunerRule] = None
+        for rule in self.rules:
+            k = rule.key
+            if rule.when(sig):
+                self._streaks[k] = self._streaks.get(k, 0) + 1
+            else:
+                self._streaks[k] = 0
+                continue
+            need = self.up_after if rule.direction == "up" \
+                else self.down_after
+            if (matured is None and self._streaks[k] >= need
+                    and self._ticks >= self._frozen_until.get(k, 0)
+                    and self._tunable(rule.knob)):
+                matured = rule
+        if (matured is not None and self._pending_eval is None
+                and not self._cooling(now)):
+            dec = self._act(matured, sig, now, tok)
+            if dec is not None:
+                out.append(dec)
+        return out
+
+    def _cooling(self, now: float) -> bool:
+        return (self._last_action_t is not None
+                and now - self._last_action_t < self.cooldown_s)
+
+    def _tunable(self, name: str) -> bool:
+        if self.knob_whitelist is not None \
+                and name not in self.knob_whitelist:
+            return False
+        try:
+            return self.knobs.knob(name).tunable
+        # lint: ok(silent-except): a rule naming a knob this fleet doesn't register (spec_chunk on a non-spec runner) is simply not tunable here — the ladder skips it by design
+        except KeyError:
+            return False
+
+    # -------------------------------------------------------------- actions
+    def _act(self, rule: TunerRule, sig: Dict[str, object], now: float,
+             tok: float) -> Optional[dict]:
+        knob = self.knobs.knob(rule.knob)
+        cur = self.knobs.value(rule.knob)
+        nxt = (knob.next_up(cur) if rule.direction == "up"
+               else knob.next_down(cur))
+        if nxt is None:
+            return None                       # already at the bound
+        old, new = self.knobs.set(rule.knob, nxt)
+        self._streaks[rule.key] = 0
+        self._last_action_t = now
+        baseline = self._baseline_rate()
+        rec = {"knob": rule.knob, "from": old, "to": new,
+               "direction": rule.direction, "phase": self.phase,
+               "reason": rule.reason, "tick": self._ticks,
+               "baseline_rate": baseline}
+        self._pending_eval = {"tick": self._ticks, "t": now, "tok": tok,
+                              "knob": rule.knob, "old": old, "new": new,
+                              "direction": rule.direction,
+                              "baseline_rate": baseline}
+        self._stamp(rec)
+        return rec
+
+    def _rollback(self, pe: dict, rate: Optional[float],
+                  sig: Dict[str, object]) -> dict:
+        self.knobs.set(pe["knob"], pe["old"])
+        self._c_rollbacks.inc()
+        # freeze the regressing direction so the same walk cannot restart
+        # before the workload has a chance to change shape
+        self._frozen_until[(pe["knob"], pe["direction"])] = (
+            self._ticks + self.freeze_ticks)
+        rec = {"knob": pe["knob"], "from": pe["new"], "to": pe["old"],
+               "direction": "rollback", "phase": self.phase,
+               "reason": (f"never-worse guard: candidate rate {rate!r} "
+                          f"regressed baseline {pe['baseline_rate']!r}"),
+               "tick": self._ticks, "baseline_rate": pe["baseline_rate"],
+               "candidate_rate": rate}
+        self._stamp(rec)
+        return rec
+
+    def _stamp(self, rec: dict) -> None:
+        """The decision audit trail: counter + structured log + router
+        journal + step-timeline stamp on every healthy replica — exactly
+        the brown-out transition's four surfaces."""
+        key = (rec["knob"], rec["direction"])
+        c = self._c_decisions.get(key)
+        if c is None:
+            c = self.registry.counter(
+                "tuner_decisions_total",
+                "online tuner knob decisions (rollback = never-worse guard)",
+                labels={"knob": rec["knob"], "direction": rec["direction"]})
+            self._c_decisions[key] = c
+        c.inc()
+        logger.warning("tuner_decision %s", json.dumps(rec, sort_keys=True,
+                                                       default=str))
+        detail = f"{rec['from']}->{rec['to']}"
+        if self.router is not None:
+            self.router._trace_event("tuner_decision", **rec)
+            self.router.stamp_fleet(
+                "tuner", f"{rec['knob']}_{rec['direction']}", detail=detail)
+        elif self.runner is not None:
+            try:
+                self.runner._note_fall_through(
+                    "tuner", f"{rec['knob']}_{rec['direction']}",
+                    detail=detail)
+            # lint: ok(silent-except): best-effort timeline stamp; the decision is already counted+logged
+            except Exception:
+                pass
+        self.decisions.append(rec)
+        if len(self.decisions) > self.max_decisions:
+            del self.decisions[: self.max_decisions // 4]
+
+    # -------------------------------------------------------------- export
+    def stats(self) -> Dict[str, object]:
+        return {
+            "ticks": self._ticks,
+            "phase": self.phase,
+            "decisions": int(sum(c.value
+                                 for c in self._c_decisions.values())),
+            "rollbacks": int(self._c_rollbacks.value),
+            "recent_decisions": self.decisions[-20:],
+            "pending_eval": (None if self._pending_eval is None else {
+                k: self._pending_eval[k]
+                for k in ("knob", "old", "new", "direction", "tick")}),
+            "streaks": {f"{k}:{d}": n
+                        for (k, d), n in sorted(self._streaks.items()) if n},
+            "frozen": {f"{k}:{d}": until for (k, d), until
+                       in sorted(self._frozen_until.items())
+                       if until > self._ticks},
+            "knobs": self.knobs.snapshot(),
+        }

@@ -89,6 +89,18 @@ _FILE_SCOPES = {
     # re-audits the full CB fleet (cb_mixed included) on any edit.
     "serving/sla.py": [],
     "serving/autoscaler.py": [],
+    # ISSUE-18 self-tuning: the knob registry, online controller, and
+    # what-if replayer are pure host-side control plane — knobs set plain
+    # Python attributes that are DYNAMIC operands of already-audited
+    # executables (megastep_k feeds the while_loop trip count as an array
+    # argument, never a retrace), the tuner reads telemetry and calls
+    # registry setters, and the replayer re-drives router.submit/step from a
+    # journal. None enters a graph (lint-only); the knob-consuming schedule
+    # logic lives in continuous_batching.py, whose row above already
+    # re-audits the full CB fleet on any edit.
+    "serving/knobs.py": [],
+    "serving/tuner.py": [],
+    "serving/replay.py": [],
     # ISSUE-15 KV block ledger: host-side bookkeeping over allocator seams
     # (instance-level wrappers, the fault-injector idiom) — audits the
     # allocator's dicts, never enters a graph (lint-only). The runner-side

@@ -146,4 +146,18 @@ if [ "${POOLS_TIER1_TESTS:-0}" -lt 1 ]; then
     echo "ERROR: disaggregated-pools tests are not in the tier-1 marker set" >&2
     [ "$rc" -eq 0 ] && rc=1
 fi
+
+# ISSUE-18 unchanged-semantics guard: the self-tuning suite (knob registry
+# bounds/gauges, mid-flight bit-exactness, tuner hysteresis / never-worse
+# rollback / decision stamping, committed-trace replay determinism +
+# reconciliation) must stay collected inside the tier-1 marker set.
+TUNER_TIER1_TESTS=$(env JAX_PLATFORMS=cpu python -m pytest \
+    "$REPO/tests/test_tuner.py" \
+    -q -m 'not slow' --collect-only -p no:cacheprovider 2>/dev/null \
+    | grep -ac '::' || true)
+echo "TUNER_TIER1_TESTS=$TUNER_TIER1_TESTS"
+if [ "${TUNER_TIER1_TESTS:-0}" -lt 1 ]; then
+    echo "ERROR: self-tuning tests are not in the tier-1 marker set" >&2
+    [ "$rc" -eq 0 ] && rc=1
+fi
 exit "$rc"

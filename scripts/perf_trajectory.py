@@ -64,6 +64,12 @@ RATIO_HIGHER_BETTER = {            # box-relative ratios: every group, loose
     # source was still prefilling — the transfer must keep hiding behind
     # prefill compute, not regress to a stop-the-world copy at migration
     "handoff_overlap_ratio": 0.30,
+    # ISSUE-18 self-tuning: tuned-over-static tok/s on the committed replay
+    # trace — the online controller must keep beating the static config (the
+    # bench already REFUSES to publish a ratio < 1.0, so the gate guards
+    # against the margin quietly eroding). Loose: the win rides on host
+    # round-trip amortization, which is noisy on shared CI boxes.
+    "tuned_vs_static_ratio": 0.40,
     "ok": 0.0,                     # multichip dryrun verdict must stay 1
 }
 RATIO_LOWER_BETTER = {

@@ -386,6 +386,13 @@ def test_changed_mode_scope_map_fails_closed():
     # continuous_batching.py, whose map re-audits the full CB fleet
     assert mod._scopes_for_changes([pkg + "serving/sla.py"]) == []
     assert mod._scopes_for_changes([pkg + "serving/autoscaler.py"]) == []
+    # ISSUE-18: knob registry / tuner / replayer are pure host-side control
+    # plane — knobs set dynamic operands of already-audited executables,
+    # never a retrace (lint-only); the knob-consuming schedule logic rides
+    # the continuous_batching.py row (full CB fleet)
+    assert mod._scopes_for_changes([pkg + "serving/knobs.py"]) == []
+    assert mod._scopes_for_changes([pkg + "serving/tuner.py"]) == []
+    assert mod._scopes_for_changes([pkg + "serving/replay.py"]) == []
     # ISSUE-15: the KV block ledger is host-side bookkeeping over allocator
     # seams — lint-only; the runner integration rides the
     # continuous_batching.py row (full CB fleet)

@@ -1,0 +1,361 @@
+"""Self-tuning serving (serving/knobs.py + serving/tuner.py +
+serving/replay.py, ISSUE-18): the knob registry's surface and bounds; the
+bit-exactness of every stream across mid-flight knob changes (the
+schedule-only invariant); the tuner's hysteresis / never-worse rollback /
+decision audit trail on a fake clock; autoscaler decisions riding the same
+trail; and the deterministic what-if replayer on the COMMITTED journal —
+same trace + same knobs ⇒ bit-identical tokens with waterfalls reconciling
+within the ≤5% PR 11 contract, tuned or not."""
+
+import os
+
+import numpy as np
+import pytest
+
+from neuronx_distributed_inference_tpu.config import (
+    TpuConfig, load_pretrained_config)
+from neuronx_distributed_inference_tpu.models.llama.modeling_llama import (
+    LlamaForCausalLM, LlamaInferenceConfig)
+from neuronx_distributed_inference_tpu.runtime.continuous_batching import (
+    ContinuousBatchingRunner)
+from neuronx_distributed_inference_tpu.serving import (
+    Arrival, ArrivalTrace, EngineReplica, PrefixAffinityRouter,
+    ReplicaAutoscaler, ServingTuner, TunerRule, reconstruct_trace, replay)
+
+DATA = os.path.join(os.path.dirname(__file__), "data")
+JOURNAL = os.path.join(DATA, "selftune_journal.jsonl")
+
+
+def _make_app(hf_cfg, slots=2, seq=192, blocks=120):
+    # the committed journal's probe shape: context bucket 48 covers its
+    # long-context phase prompts
+    cfg = TpuConfig(batch_size=slots, seq_len=seq, max_context_length=48,
+                    dtype="float32", context_encoding_buckets=[16, 48],
+                    token_generation_buckets=[seq],
+                    is_continuous_batching=True,
+                    paged_attention_enabled=True, pa_num_blocks=blocks,
+                    pa_block_size=8)
+    config = LlamaInferenceConfig(cfg,
+                                  load_config=load_pretrained_config(hf_cfg))
+    app = LlamaForCausalLM(None, config)
+    app.load_random(seed=0)
+    return app
+
+
+@pytest.fixture(scope="module")
+def app(tiny_llama_hf_config):
+    return _make_app(tiny_llama_hf_config)
+
+
+def _replicas(app, n=2, ids=None, **kw):
+    kw.setdefault("decode_chunk", 4)
+    kw.setdefault("megastep_k", 2)
+    kw.setdefault("megastep_ring", 16)
+    return [EngineReplica(
+        str(i) if ids is None else ids[j],
+        lambda tel: ContinuousBatchingRunner(app, telemetry=tel, **kw),
+        telemetry_enabled=True)
+        for j, i in enumerate(range(n))]
+
+
+# ------------------------------------------------------------ knob registry
+def test_knob_registry_surface_bounds_and_gauges(app):
+    """Satellite 1: every enabled tunable enumerated with scope/bounds in
+    stats()["knobs"], live values exported as serving_knob{knob=} gauges,
+    out-of-bounds and unknown-knob sets refused, decode_chunk enumerated
+    but not tunable."""
+    rep = _replicas(app, 1)[0]
+    r = rep.runner
+    knobs = r.stats()["knobs"]
+    assert {"async_depth", "decode_chunk", "megastep_k"} <= set(knobs)
+    assert knobs["megastep_k"]["value"] == 2
+    assert knobs["megastep_k"]["hi"] == 16          # ring bounds the walk
+    assert knobs["megastep_k"]["scope"] == "runner"
+    assert knobs["decode_chunk"]["tunable"] is False
+    g = r.telemetry.registry.get("serving_knob", labels={"knob": "megastep_k"})
+    assert g is not None and g.value == 2.0
+    with pytest.raises(ValueError):
+        r.knobs.set("megastep_k", 64)               # above the ring
+    with pytest.raises(ValueError):
+        r.knobs.set("async_depth", 0)
+    with pytest.raises(KeyError):
+        r.knobs.set("no_such_knob", 1)
+    # router + autoscaler scopes surface through their own stats()
+    router = PrefixAffinityRouter([rep])
+    assert "brownout_up_after" in router.stats()["knobs"]
+    asc = ReplicaAutoscaler(router, lambda rid: None, min_replicas=1,
+                            max_replicas=2)
+    a_knobs = asc.stats()["knobs"]
+    assert a_knobs["max_replicas"]["scope"] == "autoscaler"
+    with pytest.raises(ValueError):                 # min<=max cross-check
+        asc.knobs.set("max_replicas", 0)
+    assert asc.max_replicas == 2                    # reverted, not wedged
+
+
+def test_midflight_knob_change_bit_exact_and_stamped(app):
+    """THE schedule-only invariant: changing megastep_k and async_depth
+    mid-stream re-batches the decode schedule but every emitted token is
+    bit-identical to the untouched reference; the change lands on the step
+    timeline (knob:...) and in serving_knob_changes_total."""
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(1, 250, size=(n,)).astype(np.int32)
+               for n in (10, 13)]
+    refs = [app.generate(p[None, :], max_new_tokens=24).tokens[0].tolist()
+            for p in prompts]
+    rep = _replicas(app, 1)[0]
+    r = rep.runner
+    rids = [rep.submit(p, max_new_tokens=24) for p in prompts]
+    out = {rid: [] for rid in rids}
+    for _ in range(4):
+        for rid, toks in rep.step().items():
+            out[rid].extend(toks)
+    r.knobs.set("megastep_k", 8)                    # mid-flight walk-up
+    r.knobs.set("async_depth", 4)
+    while rep.has_work:
+        for rid, toks in rep.step().items():
+            out[rid].extend(toks)
+    for rid, ref in zip(rids, refs):
+        assert out[rid] == ref, "knob change altered a stream"
+    assert r.megastep_k == 8 and r.async_depth == 4
+    assert r.stats()["knobs"]["megastep_k"]["value"] == 8
+    notes = [s["fall_through"] for s in r.telemetry.steps
+             if "fall_through" in s]
+    assert any("knob:megastep_k=8" in n for n in notes)
+    c = r.telemetry.registry.get("serving_knob_changes_total",
+                                 labels={"knob": "megastep_k"})
+    assert c is not None and c.value >= 1
+
+
+# ------------------------------------------------------------------- tuner
+def _mk_tuner(router, **kw):
+    kw.setdefault("clock", lambda: 0.0)
+    kw.setdefault("up_after", 2)
+    kw.setdefault("down_after", 2)
+    kw.setdefault("eval_ticks", 2)
+    return ServingTuner(router=router, **kw)
+
+
+def test_tuner_hysteresis_walk_up_and_down(app):
+    """Satellite 3: a decode-heavy healthy signal must persist up_after
+    ticks before megastep_k walks up; an unhealthy interactive signal walks
+    it back down after down_after ticks; a lapsed condition resets its
+    streak."""
+    router = PrefixAffinityRouter(_replicas(app, 2))
+    sig = {"slo_healthy": True, "decode_heavy": True}
+    tok = [0.0]
+    tuner = _mk_tuner(router, signals=lambda: dict(sig),
+                      objective=lambda: tok[0],
+                      knob_whitelist=["megastep_k"])
+    assert tuner.tick() == []                       # streak 1 of 2
+    tok[0] += 10
+    decs = tuner.tick()                             # streak 2: acts
+    assert [(d["knob"], d["direction"]) for d in decs] == [
+        ("megastep_k", "up")]
+    assert tuner.knobs.value("megastep_k") == 4
+    for rep in router.replicas.values():            # fleet-uniform fan-out
+        assert rep.runner._pending_knobs.get("megastep_k") == 4 or \
+            rep.runner.megastep_k == 4
+    # streak reset: one lapsed tick then one matching tick -> no action
+    # (the in-flight eval also serializes changes; keep the rate flat so
+    # the candidate is kept, not rolled back)
+    sig["decode_heavy"] = False
+    tok[0] += 10
+    assert tuner.tick() == []
+    sig["decode_heavy"] = True
+    tok[0] += 10
+    assert tuner.tick() == []                       # streak is 1 again
+    # walk-down under SLO pressure on interactive traffic
+    sig.update(slo_healthy=False, decode_heavy=False)
+    tok[0] += 10
+    assert tuner.tick() == []
+    tok[0] += 10
+    decs = tuner.tick()
+    assert ("megastep_k", "down") in [(d["knob"], d["direction"])
+                                      for d in decs]
+    assert tuner.knobs.value("megastep_k") == 2
+    assert tuner.stats()["decisions"] == 2
+    assert tuner.stats()["phase"] == "interactive"
+
+
+def test_tuner_never_worse_rollback_and_freeze(app):
+    """The never-worse guard: a candidate whose objective rate regresses
+    past tolerance is rolled back (counted tuner_rollbacks_total), the knob
+    restored, and that walk direction frozen for freeze_ticks."""
+    router = PrefixAffinityRouter(_replicas(app, 1))
+    t = [0.0]
+    tok = [0.0]
+    rate = [100.0]                     # tokens per tick, driven by the test
+
+    def clock():
+        t[0] += 1.0
+        tok[0] += rate[0]
+        return t[0]
+
+    tuner = _mk_tuner(router, clock=clock, signals=lambda: {
+        "slo_healthy": True, "decode_heavy": True},
+        objective=lambda: tok[0], knob_whitelist=["megastep_k"],
+        eval_ticks=2, rollback_tolerance=0.1, freeze_ticks=4)
+    tuner.tick()
+    decs = tuner.tick()                             # walks 2 -> 4
+    assert decs and decs[0]["direction"] == "up"
+    rate[0] = 10.0                                  # the candidate tanks
+    tuner.tick()
+    decs = tuner.tick()                             # eval_ticks elapsed
+    assert [d["direction"] for d in decs] == ["rollback"]
+    assert tuner.knobs.value("megastep_k") == 2     # restored
+    assert tuner.stats()["rollbacks"] == 1
+    c = router.registry.get("tuner_rollbacks_total")
+    assert c is not None and c.value == 1
+    # frozen: the same walk cannot restart within freeze_ticks even though
+    # its rule keeps matching
+    rate[0] = 100.0
+    for _ in range(3):
+        assert all(d["direction"] != "up" for d in tuner.tick())
+    assert tuner.knobs.value("megastep_k") == 2
+
+
+def test_tuner_decisions_fully_stamped(app):
+    """The audit trail: one decision lands in (a) the per-knob/direction
+    counter, (b) the router journal as a tuner_decision event, (c) every
+    healthy replica's next step-timeline record via the fall-through
+    plumbing, and (d) the phase gauge tracks the classification."""
+    router = PrefixAffinityRouter(_replicas(app, 2))
+    tuner = _mk_tuner(router, up_after=1, signals=lambda: {
+        "slo_healthy": True, "decode_heavy": True,
+        "dispatch_gap_frac": 0.5},
+        objective=lambda: 0.0, knob_whitelist=["async_depth"])
+    decs = tuner.tick()
+    assert len(decs) == 1 and decs[0]["knob"] == "async_depth"
+    c = router.registry.get("tuner_decisions_total",
+                            labels={"knob": "async_depth", "direction": "up"})
+    assert c is not None and c.value == 1
+    evs = [e for e in router.trace_events if e["event"] == "tuner_decision"]
+    assert len(evs) == 1 and evs[0]["to"] == 4 and evs[0]["phase"]
+    for rep in router.replicas.values():
+        notes = rep.runner._pending_fall_through
+        assert any(n.startswith("tuner:async_depth_up=") for n in notes)
+    g = router.registry.get("serving_tuner_phase",
+                            labels={"phase": "interactive"})
+    assert g is not None and g.value == 1.0
+
+
+def test_tuner_phase_classification():
+    """bulk = deep queue or high occupancy; long_context = long recent
+    prompts; interactive otherwise (pure function, no fleet needed)."""
+    t = ServingTuner.__new__(ServingTuner)
+    t.long_prompt_threshold = 512
+    t.bulk_queue_depth = 4
+    t.bulk_occupancy = 0.75
+    assert t.classify_phase({"mean_prompt_len": 600}) == "long_context"
+    assert t.classify_phase({"mean_prompt_len": 10,
+                             "queue_depth": 5}) == "bulk"
+    assert t.classify_phase({"mean_prompt_len": 10, "queue_depth": 0,
+                             "occupancy": 0.9}) == "bulk"
+    assert t.classify_phase({"mean_prompt_len": 10, "queue_depth": 1,
+                             "occupancy": 0.5}) == "interactive"
+
+
+# -------------------------------------------------------------- autoscaler
+def test_autoscaler_decisions_journaled_and_stamped(app):
+    """Satellite 2: grow/drain/retire land in the router journal as
+    autoscale events AND on healthy replicas' step timelines through the
+    same fall-through plumbing brown-out uses — explain_request can show
+    why a replica appeared."""
+    rng = np.random.default_rng(11)
+    router = PrefixAffinityRouter(_replicas(app, 1))
+
+    def factory(rid):
+        return _replicas(app, 1, ids=[rid])[0]
+
+    clock = [0.0]
+    asc = ReplicaAutoscaler(router, factory, min_replicas=1, max_replicas=2,
+                            scale_up_queue_depth=1, up_after=1, down_after=1,
+                            cooldown_s=0.0, clock=lambda: clock[0])
+    for _ in range(6):
+        router.submit(rng.integers(1, 250, size=(10,)).astype(np.int32),
+                      max_new_tokens=4)
+    router.place_queued()
+    act = asc.tick()
+    assert act == "grow:as0"
+    evs = [e for e in router.trace_events if e["event"] == "autoscale"]
+    assert evs and evs[-1]["action"] == "grow" and evs[-1]["replica"] == "as0"
+    assert evs[-1]["queue_depth"] is not None
+    notes = router.replicas["0"].runner._pending_fall_through
+    assert any(n == "autoscaler:grow=as0" for n in notes)
+    router.run_to_completion()
+    clock[0] += 100
+    acts = {asc.tick() for _ in range(4)}
+    assert any(a and a.startswith("drain:") for a in acts)
+    assert any(a and a.startswith("retire:") for a in acts)
+    actions = [e["action"] for e in router.trace_events
+               if e["event"] == "autoscale"]
+    assert "drain" in actions and "retire" in actions
+
+
+# ------------------------------------------------------------------ replay
+def test_arrival_trace_roundtrip(tmp_path):
+    tr = ArrivalTrace([
+        Arrival(ts=0.0, prompt=[1, 2, 3], max_new_tokens=5,
+                sla_class="interactive", trace_id="t-a"),
+        Arrival(ts=0.5, prompt=[4, 5], eos_token_id=7, adapter_id=1,
+                trace_id="t-b")], step_quantum_s=0.1, meta={"k": "v"})
+    p = str(tmp_path / "trace.jsonl")
+    tr.save(p)
+    tr2 = ArrivalTrace.load(p)
+    assert tr2.step_quantum_s == 0.1 and tr2.meta == {"k": "v"}
+    assert [a.to_json() for a in tr2.arrivals] == [a.to_json()
+                                                   for a in tr.arrivals]
+    assert tr2.release_step(tr2.arrivals[1]) == 5
+
+
+def test_reconstruct_requires_journaled_prompts(app, tmp_path):
+    """A default (prompt-less) journal must fail reconstruction with an
+    actionable error, never fabricate tokens."""
+    router = PrefixAffinityRouter(_replicas(app, 1))   # journal_prompts off
+    router.submit(np.arange(1, 11, dtype=np.int32), max_new_tokens=2)
+    p = str(tmp_path / "journal.jsonl")
+    router.write_trace_events(p)
+    with pytest.raises(ValueError, match="journal_prompts"):
+        reconstruct_trace(p)
+    router.run_to_completion()
+
+
+def test_committed_trace_replay_deterministic_and_reconciled(app):
+    """THE tentpole acceptance: reconstructing the COMMITTED bench journal
+    and replaying it twice on a real 2-replica fleet yields bit-identical
+    token streams, per-request waterfalls reconciling within the ≤5%
+    PR 11 contract on both runs, and a self-TUNING third replay — live
+    knob walks mid-trace — still bit-identical (schedule-only knobs)."""
+    trace = reconstruct_trace(JOURNAL)
+    assert len(trace) >= 10
+    lens = sorted(len(a.prompt) for a in trace.arrivals)
+    assert lens[0] <= 16 and lens[-1] >= 40        # multi-phase: short+long
+
+    def fleet():
+        return PrefixAffinityRouter(_replicas(app, 2))
+
+    r1 = replay(trace, fleet)
+    r2 = replay(trace, fleet)
+    assert r1.tokens and r1.tokens == r2.tokens    # bit-identical replays
+    assert r1.steps == r2.steps                    # same release schedule
+    assert r1.coverage_ok, r1.coverage             # ≤5% reconciliation
+    assert r2.coverage_ok, r2.coverage
+    assert not r1.shed
+    wf = [w for w in r1.waterfalls.values() if w.get("ttft_ms") is not None]
+    assert wf and all(w["reconciled"] for w in wf if w["complete"])
+
+    def tuner_factory(rt):
+        return ServingTuner(
+            router=rt, knob_whitelist=["megastep_k", "async_depth"],
+            up_after=1, down_after=1, eval_ticks=2, clock=lambda: 0.0,
+            signals=lambda: {"slo_healthy": True, "decode_heavy": True,
+                             "dispatch_gap_frac": 0.5})
+
+    r3 = replay(trace, fleet, tuner_factory=tuner_factory)
+    assert r3.tuner_decisions, "the tuner never acted on the trace"
+    assert r3.tokens == r1.tokens, \
+        "a live knob trajectory changed an emitted stream"
+    assert r3.coverage_ok, r3.coverage
+    # the decisions stayed inside the whitelist (measurement discipline)
+    assert all(d["knob"] in ("megastep_k", "async_depth")
+               for d in r3.tuner_decisions)
