@@ -378,6 +378,127 @@ def _group_tp_collectives() -> Tuple[List[AuditUnit], List[Rule]]:
     return units, rules
 
 
+def _group_amla() -> Tuple[List[AuditUnit], List[Rule]]:
+    """ISSUE-19 leg a canary: AMLA exponent-add rescaling is COMPUTE-only —
+    it swaps the flash rescale multiplies for exponent-field adds inside the
+    kernel and touches no new operands, so the compiled decode-step traffic
+    must be byte-identical (both directions bounded at 0.1%) to the classic
+    multiply path. An AMLA 'optimization' that materializes scratch in HBM
+    would trip this immediately."""
+    units = [
+        _paged_decode_unit("amla_on", True, 4,
+                           env_extra={"TPUINF_AMLA": "1"}),
+        _paged_decode_unit("amla_off", True, 4,
+                           env_extra={"TPUINF_AMLA": "0"}),
+    ]
+    rules = [
+        ratio_rule("amla_zero_extra_hbm", "amla_on", "amla_off", 1.001),
+        ratio_rule("amla_zero_hbm_savings", "amla_off", "amla_on", 1.001),
+    ]
+    return units, rules
+
+
+def _group_lenpar() -> Tuple[List[AuditUnit], List[Rule]]:
+    """ISSUE-19 leg b canary: the KV-length split re-shards the SAME block
+    walk across grid rows — the pool is still streamed once (the only new
+    traffic is the (splits, B, R) raw flash state the jnp merge reads back),
+    so split-on vs split-off compiled bytes must agree within 2%, and the
+    split step stays within the fused one-KV-pass absolute budget.
+
+    Geometry: bs=1 with a 32-wide table — the long-context small-batch regime
+    `_auto_kv_splits` targets (b*hkv = 2 row/head units, 4-way split at
+    MB=32). The env pair keys separate runners (trace-time toggle)."""
+    units = [
+        _paged_decode_unit("lenpar_on_mb32", True, 32, b=1,
+                           env_extra={"TPUINF_LENPAR": "1"}),
+        _paged_decode_unit("lenpar_off_mb32", True, 32, b=1,
+                           env_extra={"TPUINF_LENPAR": "0"}),
+    ]
+    rules = [
+        ratio_rule("lenpar_split_byte_invariant", "lenpar_on_mb32",
+                   "lenpar_off_mb32", 1.02),
+        absolute_rule("lenpar_one_kv_pass", "lenpar_on_mb32",
+                      2.0 * _ONE_KV_PASS),
+    ]
+    return units, rules
+
+
+@functools.lru_cache(maxsize=None)
+def _spec_canary_runner(tag=""):
+    """Draft/target paged CB runner at canary geometry with the device-
+    resident speculative megastep. The cb.spec.megastep example is captured
+    from a REAL serving state (prompts run to completion) — its operand list
+    (sampling matrix, eos table, coverage) is runner-internal and not worth
+    hand-pinning."""
+    from ..config import TpuConfig, load_pretrained_config
+    from ..models.llama.modeling_llama import (LlamaForCausalLM,
+                                               LlamaInferenceConfig)
+    from ..runtime.continuous_batching import ContinuousBatchingRunner
+
+    del tag
+
+    def build(hf, seed):
+        cfg = TpuConfig(batch_size=4, seq_len=4096, max_context_length=128,
+                        dtype="bfloat16", context_encoding_buckets=[128],
+                        token_generation_buckets=[512],
+                        is_continuous_batching=True,
+                        paged_attention_enabled=True,
+                        pa_num_blocks=66, pa_block_size=128,
+                        decode_kernel_enabled=True)
+        config = LlamaInferenceConfig(
+            cfg, load_config=load_pretrained_config(hf))
+        app = LlamaForCausalLM(None, config)
+        app.load_random(seed=seed)
+        return app
+
+    target = build(CANARY_HF, 0)
+    draft_hf = dict(CANARY_HF, hidden_size=128, intermediate_size=256,
+                    num_hidden_layers=1)
+    draft = build(draft_hf, 1)
+    runner = ContinuousBatchingRunner(target, draft=draft,
+                                      speculation_length=4, spec_chunk=2,
+                                      megastep_k=4, megastep_ring=4)
+    rng = np.random.default_rng(0)
+    for n in (12, 19):
+        runner.submit(rng.integers(1, 256, size=(n,)).astype(np.int32),
+                      max_new_tokens=6)
+    runner.run_to_completion()
+    if not runner._megastep_exit_counters:
+        raise RuntimeError("spec megastep canary never dispatched")
+    return target, runner
+
+
+def _group_spec_megastep() -> Tuple[List[AuditUnit], List[Rule]]:
+    """ISSUE-19 leg c canary: the SPECULATIVE serving megastep is ONE
+    executable whose compiled traffic is ~K-invariant — both model's weights
+    and both KV pools are passed (and charged) ONCE however many fused
+    draft-verify-accept iterations the while_loop runs. As with the plain
+    megastep canary, the only K-shaped static is the emitted-acceptance ring
+    capacity; a 4x ring sweep must move compiled bytes by <2%. The absolute
+    rule bounds the dispatch at 32x one (target+draft) weights+pools pass
+    (measured 26x at this geometry: the K-deep draft chain and the verify
+    each charge the pallas pool operands whole, per call) — the tripwire
+    against an extra O(pool) copy in the loop body, not a sharp bound."""
+    target, runner = _spec_canary_runner(tag="spec_mega")
+    d = runner._spec_megastep_step
+    units = [
+        AuditUnit("spec_megastep_ring4", d, contract=generic_contract(d)),
+        AuditUnit("spec_megastep_ring16", d, overrides={"ring_cap": 16},
+                  contract=generic_contract(d)),
+    ]
+    ideal = (sum(x.nbytes for x in jax.tree.leaves(target.params))
+             + sum(x.nbytes for x in jax.tree.leaves(runner.draft.params))
+             + sum(x.nbytes for x in jax.tree.leaves(runner.cache))
+             + sum(x.nbytes for x in jax.tree.leaves(runner.d_cache)))
+    rules = [
+        ratio_rule("spec_megastep_bytes_k_invariant", "spec_megastep_ring16",
+                   "spec_megastep_ring4", 1.02),
+        absolute_rule("spec_megastep_one_weights_pass", "spec_megastep_ring4",
+                      32.0 * ideal),
+    ]
+    return units, rules
+
+
 CANARY_MOE_HF = {
     "model_type": "mixtral", "vocab_size": 256, "hidden_size": 128,
     "intermediate_size": 256, "num_hidden_layers": 2,
@@ -458,6 +579,9 @@ GROUPS: Dict[str, object] = {
     "multiquery": _group_multiquery,
     "mixed_chunk": _group_mixed_chunk,
     "megastep": _group_megastep,
+    "amla": _group_amla,
+    "lenpar": _group_lenpar,
+    "spec_megastep": _group_spec_megastep,
     "tp_collectives": _group_tp_collectives,
     "moe_ep_collectives": _group_moe_ep_collectives,
 }
@@ -475,6 +599,7 @@ def clear_caches() -> None:
     fleets until process exit."""
     _dense_app.cache_clear()
     _paged_runner.cache_clear()
+    _spec_canary_runner.cache_clear()
     _moe_paged_runner.cache_clear()
 
 

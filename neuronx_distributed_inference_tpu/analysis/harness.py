@@ -166,6 +166,85 @@ def _exercise_cb_megastep() -> Any:
     return runner
 
 
+def _exercise_flash_decode() -> Any:
+    """Standalone flash-decode entry points (ISSUE-19 satellite): the four
+    ``flash.*`` dispatches are module-level ``register_external`` wrappers, so
+    they exist from import — but the auditor needs CPU-lowerable examples, and
+    a prior caller may have captured non-interpret specs. Inject interpret-mode
+    examples explicitly, then run each once."""
+    import jax.numpy as jnp
+
+    from ..ops import flash_decode as fd
+
+    rng = np.random.default_rng(17)
+    l, b, hq, hkv, d, s, bucket = 2, 2, 4, 2, 16, 64, 48
+    q = jnp.asarray(rng.standard_normal((b, hq, 1, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, hkv, bucket, d)), jnp.float32)
+    pos = jnp.asarray([5, 9], jnp.int32)
+    cache = jnp.asarray(rng.standard_normal((l, b, hkv, s, d)), jnp.float32)
+    new = jnp.asarray(rng.standard_normal((b, hkv, 1, d)), jnp.float32)
+    layer = jnp.asarray(0, jnp.int32)
+
+    # block_k=16: the default 256 pads the 48-wide KV slice >5x, which reads
+    # as byte traffic against the generic ceiling — pin an unpadded tiling
+    fd.flash_decode_attention.set_example(q, k, k, pos, block_k=16,
+                                          interpret=True)
+    fd.flash_decode_attention(q, k, k, pos, block_k=16, interpret=True)
+    fd.write_decode_stacked.set_example(cache, new, pos, layer, interpret=True)
+    fd.write_decode_stacked(cache, new, pos, layer, interpret=True)
+    fd.write_decode_stacked_kv.set_example(cache, cache, new, new, pos, layer,
+                                           interpret=True)
+    fd.write_decode_stacked_kv(cache, cache, new, new, pos, layer,
+                               interpret=True)
+    fd.flash_decode_attention_stacked.set_example(
+        q, cache, cache, pos, layer, bucket=bucket, interpret=True)
+    fd.flash_decode_attention_stacked(q, cache, cache, pos, layer,
+                                      bucket=bucket, interpret=True)
+    return fd
+
+
+def _exercise_cb_spec_megastep() -> Any:
+    """Device-resident speculative megastep (ISSUE-19 leg c): a paged spec
+    runner with ``megastep_k`` set serves its draft-verify chunks through the
+    cb.spec.megastep while_loop; the exit counters prove it dispatched."""
+    from ..runtime.continuous_batching import ContinuousBatchingRunner
+
+    target = _tiny_app(paged=True, cb=True, seed=0)
+    draft_hf = dict(TINY_HF, hidden_size=32, intermediate_size=64,
+                    num_hidden_layers=1, num_attention_heads=2,
+                    num_key_value_heads=2)
+    draft = _tiny_app(paged=True, cb=True, hf=draft_hf, seed=1)
+    runner = ContinuousBatchingRunner(target, draft=draft,
+                                      speculation_length=4, spec_chunk=2,
+                                      megastep_k=4, megastep_ring=4)
+    for p in _prompts((12, 19)):
+        runner.submit(p, max_new_tokens=6)
+    runner.run_to_completion()
+    if not runner._megastep_exit_counters:
+        raise RuntimeError("spec megastep harness never dispatched — the "
+                           "cb.spec.megastep example was not captured")
+    return runner
+
+
+def _exercise_cb_mixed_megastep() -> Any:
+    """Mixed insert+decode megastep (ISSUE-19 leg c): a chunked-prefill runner
+    with ``megastep_k`` set batches whole insert windows + decode steps into
+    one cb.paged.mixed_megastep scan dispatch."""
+    from ..runtime.continuous_batching import ContinuousBatchingRunner
+
+    app = _tiny_app(paged=True, cb=True)
+    runner = ContinuousBatchingRunner(app, decode_chunk=4, prefill_chunk=16,
+                                      megastep_k=4, megastep_ring=4)
+    for p in _prompts((12, 40)):
+        runner.submit(p, max_new_tokens=8)
+    runner.run_to_completion()
+    d = find("cb.paged.mixed_megastep")
+    if d is None or d.example is None:
+        raise RuntimeError("mixed megastep harness never dispatched — the "
+                           "cb.paged.mixed_megastep example was not captured")
+    return runner
+
+
 def _exercise_cb_spec() -> Any:
     from ..runtime.continuous_batching import ContinuousBatchingRunner
 
@@ -427,7 +506,13 @@ SCOPES: Dict[str, Tuple] = {
     "cb_mixed": (lambda: _exercise_cb(True, mixed=True),
                  ("cb.paged.mixed",)),
     "cb_megastep": (_exercise_cb_megastep, ("cb.paged.megastep",)),
+    "cb_mixed_megastep": (_exercise_cb_mixed_megastep,
+                          ("cb.paged.mixed_megastep",)),
     "cb_spec": (_exercise_cb_spec, ("cb.spec.chunk", "cb.spec.insert_pair")),
+    "cb_spec_megastep": (_exercise_cb_spec_megastep, ("cb.spec.megastep",)),
+    "flash_decode": (_exercise_flash_decode,
+                     ("flash.decode", "flash.decode.stacked",
+                      "flash.write.stacked", "flash.write.stacked_kv")),
     "cb_eagle": (_exercise_cb_eagle, ("cb.eagle.insert", "cb.eagle.chunk")),
     "serving_tier": (_exercise_serving_tier,
                      ("cb.paged.tier_readmit", "cb.paged.kv_handoff")),

@@ -30,6 +30,8 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 DEFAULT_BLOCK_K = 256
+from ..analysis.contracts import DispatchContract
+from ..analysis.registry import register_external
 from .paged_decode import _vmem_cast
 
 NEG_INF = -1e30
@@ -95,10 +97,7 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, m_scratch, l_scratch,
         o_ref[0, 0] = (acc_scratch[:] / l_safe).astype(o_ref.dtype)
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("scale", "window", "block_k", "interpret"))
-def flash_decode_attention(
+def _flash_decode_attention(
     q: jnp.ndarray,              # (B, Hq, T, D), T small (1 or speculation width)
     k: jnp.ndarray,              # (B, Hkv, S_bucket, D) cache slice
     v: jnp.ndarray,
@@ -160,6 +159,23 @@ def flash_decode_attention(
     return out.reshape(b, hq, t, d)
 
 
+# ISSUE-19 satellite: these standalone entry points were the only attention
+# dispatches outside analysis/ coverage — register them as EXTERNAL audited
+# dispatches (donation exactly as before: the write kernels alias at the
+# pallas level via input_output_aliases, deliberately WITHOUT jit donation,
+# so their contracts declare no cache operand).
+_FLASH_DECODE_STATICS = ("scale", "window", "block_k", "interpret")
+flash_decode_attention = register_external(
+    jax.jit(_flash_decode_attention, static_argnames=_FLASH_DECODE_STATICS),
+    _flash_decode_attention,
+    DispatchContract(kind="flash.decode", waivers={
+        "hbm_bytes": "toy-scale accounting: XLA charges the padded GQA row "
+                     "tile per grid cell (~9x a 48-wide toy slice's inputs, "
+                     "amortized away at real cache widths); the stacked twin "
+                     "flash.decode.stacked carries the unwaived budget"}),
+    static_argnames=_FLASH_DECODE_STATICS)
+
+
 def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
@@ -205,8 +221,7 @@ def _kv_write_kernel(pos_ref, lidx_ref, new_ref, _cache_in, cache_out, scratch, 
     dma_out.wait()
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def write_decode_stacked(
+def _write_decode_stacked(
     cache: jnp.ndarray,          # (L, B, Hkv, S, D) — donated/aliased in place
     new_kv: jnp.ndarray,         # (B, Hkv, T, D), already in cache dtype
     positions: jnp.ndarray,      # (B,) int32 write position per row
@@ -245,6 +260,14 @@ def write_decode_stacked(
         interpret=interpret,
     )(positions.astype(jnp.int32), layer_idx.reshape(1).astype(jnp.int32),
       new_kv, cache)
+
+
+write_decode_stacked = register_external(
+    # lint: ok(jit-no-donate): aliased IN the pallas kernel (input_output_aliases); jit donation is the enclosing caller's call
+    jax.jit(_write_decode_stacked, static_argnames=("interpret",)),
+    _write_decode_stacked,
+    DispatchContract(kind="flash.write.stacked"),
+    static_argnames=("interpret",))
 
 
 def _kv_write_kv_kernel(pos_ref, lidx_ref, new_k_ref, new_v_ref, _k_in, _v_in,
@@ -301,8 +324,7 @@ def _batch_block(b: int) -> int:
     return 1
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def write_decode_stacked_kv(
+def _write_decode_stacked_kv(
     k_cache: jnp.ndarray,        # (L, B, Hkv, S, D) — donated/aliased in place
     v_cache: jnp.ndarray,
     new_k: jnp.ndarray,          # (B, Hkv, T, D), already in cache dtype
@@ -350,6 +372,14 @@ def write_decode_stacked_kv(
         interpret=interpret,
     )(positions.astype(jnp.int32), layer_idx.reshape(1).astype(jnp.int32),
       new_k, new_v, k_cache, v_cache)
+
+
+write_decode_stacked_kv = register_external(
+    # lint: ok(jit-no-donate): aliased IN the pallas kernel (input_output_aliases); jit donation is the enclosing caller's call
+    jax.jit(_write_decode_stacked_kv, static_argnames=("interpret",)),
+    _write_decode_stacked_kv,
+    DispatchContract(kind="flash.write.stacked_kv"),
+    static_argnames=("interpret",))
 
 
 def _stacked_decode_kernel(pos_ref, lidx_ref, q_ref, k_ref, v_ref, *refs,
@@ -480,11 +510,7 @@ def _group_head_scalars(x: jnp.ndarray, hkv: int, n_rep: int, t: int, rows: int
     return jnp.broadcast_to(grouped.reshape(hkv * rows, 1), (hkv * rows, 128))
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("bucket", "scale", "window", "soft_cap", "block_k",
-                     "interpret"))
-def flash_decode_attention_stacked(
+def _flash_decode_attention_stacked(
     q: jnp.ndarray,              # (B, Hq, T, D)
     k_cache: jnp.ndarray,        # (L, B, Hkv, S_max, D) — full stacked cache
     v_cache: jnp.ndarray,
@@ -573,3 +599,14 @@ def flash_decode_attention_stacked(
 
     out = out[:, :, : n_rep * t, :].reshape(b, hkv, n_rep, t, d)
     return out.reshape(b, hq, t, d)
+
+
+_FLASH_STACKED_STATICS = ("bucket", "scale", "window", "soft_cap", "block_k",
+                          "interpret")
+flash_decode_attention_stacked = register_external(
+    # lint: ok(jit-no-donate): read-only attend over the stacked caches — the write twins own the aliasing
+    jax.jit(_flash_decode_attention_stacked,
+            static_argnames=_FLASH_STACKED_STATICS),
+    _flash_decode_attention_stacked,
+    DispatchContract(kind="flash.decode.stacked"),
+    static_argnames=_FLASH_STACKED_STATICS)

@@ -34,6 +34,7 @@ boundaries between them.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -42,6 +43,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
+LOG2E = 1.4426950408889634
 
 
 def _vmem_cast(x: jnp.ndarray, dtype) -> jnp.ndarray:
@@ -77,6 +79,180 @@ def _pack(dtype) -> int:
     """Sublane packing: DMA slices on the second-minor dim must cover whole
     (8 * 4/itemsize)-row tiles."""
     return 8 * max(1, 4 // jnp.dtype(dtype).itemsize)
+
+
+# --- AMLA exponent-add rescaling + length-parallel split selection --------------------
+#
+# AMLA ("MUL by ADD in FlashAttention Rescaling", PAPERS.md): the online-softmax
+# running max is kept on the BASE-2 INTEGER grid (m = ceil(max(s * log2 e))), so
+# every rescale factor alpha = 2^(m_prev - m_new) is an exact power of two and the
+# `acc * alpha` / `l * alpha` VPU multiplies become an integer ADD into the f32
+# exponent field — the same bit-surgery family as `_vmem_cast` above. p = 2^(s2 - m)
+# stays <= 1 (m overshoots the true max by < 1 bit), so the int8 p-quantization
+# grid and every overflow argument of the multiply path carry over unchanged.
+
+
+def _amla_default() -> bool:
+    """Trace-time opt-out: TPUINF_AMLA=0 restores the multiply rescale."""
+    return os.environ.get("TPUINF_AMLA", "1") != "0"
+
+
+def _exp2_rescale(x: jnp.ndarray, delta: jnp.ndarray) -> jnp.ndarray:
+    """``x * 2**delta`` for f32 ``x`` and integer-valued ``delta <= 0`` via an
+    ADD into the exponent field: widen to i32, add ``delta`` to bits 23..30,
+    reassemble. Zeros stay zero (e == 0 is kept out of the add) and a rebased
+    exponent that underflows flushes to zero — exactly the denormal policy of
+    `_vmem_cast`. ``delta`` must already be clamped to > -255 by the caller."""
+    bits = jax.lax.bitcast_convert_type(x, jnp.int32)
+    d = delta.astype(jnp.int32)
+    e = (bits >> 23) & 0xFF
+    keep = jnp.logical_and(e > 0, e + d > 0)
+    out = jnp.where(keep, bits + (d << 23), 0)
+    return jax.lax.bitcast_convert_type(out, jnp.float32)
+
+
+def _flash_accumulate(s, mask, m_prev, l_prev, acc_prev, pv_dot, amla: bool):
+    """One online-softmax accumulate over score tile ``s`` (rows, C).
+
+    ``pv_dot(p)`` closes over the V operand (and the int8 p-quantization where
+    the cache is int8) and returns the f32 PV partial. Returns (m, l, acc).
+
+    amla=False is the classic multiply rescale (`alpha = e^(m_prev - m_new)`).
+    amla=True works in base 2 with the running max on the integer grid: the
+    l/acc rescale is `_exp2_rescale` (exponent-field ADD, exact), and only the
+    probabilities pay a transcendental (`exp2`). The integer grid costs < 1 bit
+    of headroom on p — outputs agree with the multiply path to ulp-scale."""
+    if amla:
+        s2 = s * LOG2E
+        m_new = jnp.maximum(
+            m_prev, jnp.ceil(jnp.max(s2, axis=1, keepdims=True)))
+        # m_prev starts at NEG_INF: clamp before the i32 cast (the add target
+        # is an 8-bit exponent; anything <= -254 flushes to zero anyway)
+        delta = jnp.maximum(m_prev - m_new, -254.0)
+        p = jnp.exp2(s2 - m_new)
+        p = jnp.where(mask, p, 0.0)
+        l_new = _exp2_rescale(l_prev, delta) + jnp.sum(p, axis=1, keepdims=True)
+        acc = _exp2_rescale(acc_prev, delta) + pv_dot(p)
+    else:
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(jnp.minimum(m_prev - m_new, 0.0))
+        p = jnp.exp(s - m_new)
+        p = jnp.where(mask, p, 0.0)
+        l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        acc = acc_prev * alpha + pv_dot(p)
+    return m_new, l_new, acc
+
+
+def _fold_sinks(m, l, acc, sink, amla: bool):
+    """Finalize-time sink fold under the same rescale discipline as the body.
+
+    Shapes broadcast: in-kernel m/l are (rows, 1) against acc (rows, d); the
+    jnp-level split merge passes (B, R) against (B, R) with acc handled by the
+    caller. Returns (m, l, acc) with the sink folded into l (and acc rescaled
+    onto the new max)."""
+    if amla:
+        s2 = sink * LOG2E
+        m_new = jnp.maximum(m, jnp.ceil(s2))
+        delta = jnp.maximum(m - m_new, -254.0)
+        l_new = _exp2_rescale(l, delta) + jnp.exp2(s2 - m_new)
+        acc_new = _exp2_rescale(acc, delta)
+    else:
+        m_new = jnp.maximum(m, sink)
+        alpha = jnp.exp(jnp.minimum(m - m_new, 0.0))
+        l_new = alpha * l + jnp.exp(sink - m_new)
+        acc_new = acc * alpha
+    return m_new, l_new, acc_new
+
+
+# length-parallel (flash-decode) split: trace-time witness + auto heuristic.
+_LENPAR_STATS = {"traces": 0, "split_traces": 0, "auto_engaged": 0,
+                 "last_splits": 1}
+
+
+def lenpar_stats() -> dict:
+    """Trace-time length-split witness (bench honesty: `lenpar_invalid`)."""
+    return dict(_LENPAR_STATS)
+
+
+def reset_lenpar_stats() -> None:
+    for k in _LENPAR_STATS:
+        _LENPAR_STATS[k] = 1 if k == "last_splits" else 0
+
+
+def _auto_kv_splits(b: int, hkv: int, mb: int, t: int) -> int:
+    """Trace-time split auto-select: the long-context bs=1 regime.
+
+    One grid row per (batch row x kv head group) is all the parallelism the
+    unsplit attend exposes — at bs=1 a single core serializes the whole KV
+    walk. Split the KV length when the row/head product is tiny (<= 4 score
+    row-units), the step is plain chain decode (t == 1), and the table is long
+    enough that every split still owns >= 8 block groups. TPUINF_LENPAR=0 is
+    the trace-time opt-out."""
+    if os.environ.get("TPUINF_LENPAR", "1") == "0":
+        return 1
+    if t != 1 or b * hkv > 4:
+        return 1
+    s = 1
+    while s < 8 and mb // (s * 2) >= 8:
+        s *= 2
+    return s
+
+
+def _lenpar_merge(o32, m, l, sink_col, amla: bool, out_dtype):
+    """Cross-split LSE merge: ``o32`` (S, B, R, D) f32 raw accumulators,
+    ``m``/``l`` (S, B, R) running max / denominator per split (no sink fold,
+    no division — the split kernels emit raw flash state).
+
+    A split that saw no live KV leaves (m, l) = (NEG_INF, 0) and drops out of
+    the weighted sum with weight exactly 0. When <= 1 split is live the merge
+    SELECTS that split's state bit-for-bit (no arithmetic on it) and runs the
+    identical finalize the unsplit kernel would — so a row whose live blocks
+    sit inside one split is bit-equal to the unsplit kernel. Rows straddling
+    splits pay one extra LSE combine (ulp-scale, fp-add order differs from the
+    serial walk — see docs/ROUND24_NOTES.md)."""
+    S = o32.shape[0]
+    live = l > 0.0                                        # (S, B, R)
+    nlive = jnp.sum(live.astype(jnp.int32), axis=0)       # (B, R)
+
+    # exact path: bit-preserving select of the single live split
+    m1, l1, o1 = m[0], l[0], o32[0]
+    taken = live[0]
+    for si in range(1, S):
+        fresh = jnp.logical_and(live[si], jnp.logical_not(taken))
+        m1 = jnp.where(fresh, m[si], m1)
+        l1 = jnp.where(fresh, l[si], l1)
+        o1 = jnp.where(fresh[..., None], o32[si], o1)
+        taken = jnp.logical_or(taken, live[si])
+    m1 = jnp.where(taken, m1, NEG_INF)
+    if sink_col is not None:
+        if amla:
+            s2 = sink_col * LOG2E
+            m_f = jnp.maximum(m1, jnp.ceil(s2))
+            delta = jnp.maximum(m1 - m_f, -254.0)
+            l1 = _exp2_rescale(l1, delta) + jnp.exp2(s2 - m_f)
+            o1 = _exp2_rescale(o1, delta[..., None])
+        else:
+            m_f = jnp.maximum(m1, sink_col)
+            alpha = jnp.exp(jnp.minimum(m1 - m_f, 0.0))
+            l1 = alpha * l1 + jnp.exp(sink_col - m_f)
+            o1 = o1 * alpha[..., None]
+    exact = o1 / jnp.where(l1 == 0.0, 1.0, l1)[..., None]
+
+    # generic path: weighted LSE combine across live splits
+    expfn = jnp.exp2 if amla else jnp.exp
+    M = jnp.max(m, axis=0)                                # (B, R)
+    sink_s = None
+    if sink_col is not None:
+        sink_s = sink_col * LOG2E if amla else sink_col
+        M = jnp.maximum(M, jnp.ceil(sink_s) if amla else sink_s)
+    w = expfn(m - M[None])                                # dead split -> 0
+    den = jnp.sum(w * l, axis=0)
+    num = jnp.sum(w[..., None] * o32, axis=0)
+    if sink_col is not None:
+        den = den + expfn(sink_s - M)
+    merged = num / jnp.where(den == 0.0, 1.0, den)[..., None]
+
+    return jnp.where((nlive <= 1)[..., None], exact, merged).astype(out_dtype)
 
 
 # --- paged KV write -------------------------------------------------------------------
@@ -321,7 +497,7 @@ def _paged_attend_kernel_v3(pos_ref, lidx_ref, bt_ref, q_ref, *refs,
                             bb: int, num_cells: int, t: int, qr: int,
                             nq: int, hkv: int, window: Optional[int],
                             soft_cap: Optional[float], has_sinks: bool,
-                            has_slopes: bool):
+                            has_slopes: bool, amla: bool):
     """v3 cell body: FLAT q packing + per-block-group dots, no concat.
 
     v2 padded each head's q rows to 8 sublanes and concatenated the cell's kb
@@ -394,16 +570,13 @@ def _paged_attend_kernel_v3(pos_ref, lidx_ref, bt_ref, q_ref, *refs,
                     s = soft_cap * jnp.tanh(s / soft_cap)
                 s = jnp.where(mask, s, NEG_INF)
 
-                m_prev = m_scratch[r0 : r0 + nq, 0:1]
-                l_prev = l_scratch[r0 : r0 + nq, 0:1]
-                m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-                alpha = jnp.exp(jnp.minimum(m_prev - m_new, 0.0))
-                p = jnp.exp(s - m_new)
-                p = jnp.where(mask, p, 0.0)
-                l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
-                acc = acc_scratch[r0 : r0 + nq] * alpha + jax.lax.dot_general(
+                pv_dot = lambda p, v=v: jax.lax.dot_general(
                     p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
                     preferred_element_type=jnp.float32)
+                m_new, l_new, acc = _flash_accumulate(
+                    s, mask, m_scratch[r0 : r0 + nq, 0:1],
+                    l_scratch[r0 : r0 + nq, 0:1], acc_scratch[r0 : r0 + nq],
+                    pv_dot, amla)
                 m_scratch[r0 : r0 + nq] = jnp.broadcast_to(m_new, (nq, 128))
                 l_scratch[r0 : r0 + nq] = jnp.broadcast_to(l_new, (nq, 128))
                 acc_scratch[r0 : r0 + nq] = acc
@@ -416,23 +589,21 @@ def _paged_attend_kernel_v3(pos_ref, lidx_ref, bt_ref, q_ref, *refs,
             l = l_scratch[r0 : r0 + nq, 0:1]
             acc = acc_scratch[r0 : r0 + nq]
             if sinks_ref is not None:
-                sink = sinks_ref[:, 0:1]
-                m_new = jnp.maximum(m, sink)
-                alpha = jnp.exp(jnp.minimum(m - m_new, 0.0))
-                l = alpha * l + jnp.exp(sink - m_new)
-                acc = acc * alpha
+                _, l, acc = _fold_sinks(m, l, acc, sinks_ref[:, 0:1], amla)
             l_safe = jnp.where(l == 0.0, 1.0, l)
             o_ref[j] = (acc / l_safe).reshape(o_ref.shape[1:]).astype(
                 o_ref.dtype)
 
 
 def _paged_attend_kernel(pos_ref, lidx_ref, bt_ref, q_ref, *refs, o_ref=None,
+                         m_out=None, l_out=None,
                          m_scratch=None, l_scratch=None, acc_scratch=None,
                          scale: float, bs: int, kb: int, bb: int,
                          num_cells: int, t: int,
                          rows: int, hkv: int, window: Optional[int],
                          soft_cap: Optional[float], has_sinks: bool,
-                         has_slopes: bool):
+                         has_slopes: bool, amla: bool, splits: int = 1,
+                         cps: int = 0):
     """Block-diagonal head packing over ``bb`` batch rows per grid cell.
 
     Per row: every kv head's q rows stack into ONE (hkv*rows, D) operand and
@@ -442,7 +613,13 @@ def _paged_attend_kernel(pos_ref, lidx_ref, bt_ref, q_ref, *refs, o_ref=None,
     Cross-head (off-diagonal) score tiles are masked to -inf — wasted MXU
     flops that the 8x-wider op amortizes, not bandwidth. Batching ``bb`` rows
     per cell amortizes the per-cell grid fixed cost (v2 at bb=1 measured
-    ~12 us/cell with only ~3 us of real work)."""
+    ~12 us/cell with only ~3 us of real work).
+
+    ``splits > 1`` is the LENGTH-PARALLEL variant: the grid grows a leading
+    KV-split dimension, each split walks its ``cps`` cells of the table with
+    its own flash state, and finalize emits the RAW (acc, m, l) per split
+    (``m_out``/``l_out``) for the outside cross-split LSE merge — no sink
+    fold, no division in-kernel."""
     kv_refs = refs[: 2 * kb * bb]
     idx = 2 * kb * bb
     sinks_ref = slopes_ref = None
@@ -451,8 +628,17 @@ def _paged_attend_kernel(pos_ref, lidx_ref, bt_ref, q_ref, *refs, o_ref=None,
     if has_slopes:
         slopes_ref, idx = refs[idx], idx + 1
 
-    bi = pl.program_id(0)
-    ci = pl.program_id(1)
+    if splits == 1:
+        bi = pl.program_id(0)
+        ci = pl.program_id(1)
+        cell = ci
+        last_cell = num_cells - 1
+    else:
+        si = pl.program_id(0)
+        bi = pl.program_id(1)
+        ci = pl.program_id(2)
+        cell = si * cps + ci
+        last_cell = cps - 1
 
     @pl.when(ci == 0)
     def _init():
@@ -461,7 +647,7 @@ def _paged_attend_kernel(pos_ref, lidx_ref, bt_ref, q_ref, *refs, o_ref=None,
         acc_scratch[:] = jnp.zeros_like(acc_scratch)
 
     width = kb * bs                            # kv positions fetched per row
-    k_start = ci * width
+    k_start = cell * width
     nrows = hkv * rows
     d = q_ref.shape[-1]
 
@@ -523,49 +709,46 @@ def _paged_attend_kernel(pos_ref, lidx_ref, bt_ref, q_ref, *refs, o_ref=None,
                 s = soft_cap * jnp.tanh(s / soft_cap)
             s = jnp.where(mask, s, NEG_INF)
 
-            m_prev = m_scratch[r0 : r0 + nrows, 0:1]
-            l_prev = l_scratch[r0 : r0 + nrows, 0:1]
-            m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-            alpha = jnp.exp(jnp.minimum(m_prev - m_new, 0.0))
-            p = jnp.exp(s - m_new)
-            p = jnp.where(mask, p, 0.0)
-            l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
             if int8_kv:
-                pi = jnp.round(p * 127.0).astype(jnp.int8)
-                pv = jax.lax.dot_general(
-                    pi, v, (((1,), (0,)), ((), ())),
-                    preferred_element_type=jnp.int32
-                ).astype(jnp.float32) * (1.0 / 127.0)
+                def pv_dot(p, v=v):
+                    pi = jnp.round(p * 127.0).astype(jnp.int8)
+                    return jax.lax.dot_general(
+                        pi, v, (((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.int32
+                    ).astype(jnp.float32) * (1.0 / 127.0)
             else:
-                pv = jax.lax.dot_general(
+                pv_dot = lambda p, v=v: jax.lax.dot_general(
                     p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
                     preferred_element_type=jnp.float32)
-            acc = acc_scratch[r0 : r0 + nrows] * alpha + pv
+            m_new, l_new, acc = _flash_accumulate(
+                s, mask, m_scratch[r0 : r0 + nrows, 0:1],
+                l_scratch[r0 : r0 + nrows, 0:1], acc_scratch[r0 : r0 + nrows],
+                pv_dot, amla)
             m_scratch[r0 : r0 + nrows] = jnp.broadcast_to(m_new, (nrows, 128))
             l_scratch[r0 : r0 + nrows] = jnp.broadcast_to(l_new, (nrows, 128))
             acc_scratch[r0 : r0 + nrows] = acc
 
-    @pl.when(ci == num_cells - 1)
+    @pl.when(ci == last_cell)
     def _finalize():
         for j in range(bb):
             r0 = j * nrows
             m = m_scratch[r0 : r0 + nrows, 0:1]
             l = l_scratch[r0 : r0 + nrows, 0:1]
             acc = acc_scratch[r0 : r0 + nrows]
-            if sinks_ref is not None:
-                sink = sinks_ref[:, 0:1]
-                m_new = jnp.maximum(m, sink)
-                alpha = jnp.exp(jnp.minimum(m - m_new, 0.0))
-                l = alpha * l + jnp.exp(sink - m_new)
-                acc = acc * alpha
-            l_safe = jnp.where(l == 0.0, 1.0, l)
-            o_ref[j] = (acc / l_safe).reshape(o_ref.shape[1:]).astype(o_ref.dtype)
+            if splits > 1:
+                # raw per-split flash state; the sink fold and the division
+                # happen in the outside cross-split merge
+                o_ref[0, j] = acc.reshape(o_ref.shape[2:])
+                m_out[0, j] = m_scratch[r0 : r0 + nrows]
+                l_out[0, j] = l_scratch[r0 : r0 + nrows]
+            else:
+                if sinks_ref is not None:
+                    _, l, acc = _fold_sinks(m, l, acc, sinks_ref[:, 0:1], amla)
+                l_safe = jnp.where(l == 0.0, 1.0, l)
+                o_ref[j] = (acc / l_safe).reshape(o_ref.shape[1:]).astype(
+                    o_ref.dtype)
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("scale", "window", "soft_cap", "blocks_per_cell",
-                     "rows_per_cell", "interpret", "variant"))
 def paged_decode_attention_stacked(
     q: jnp.ndarray,              # (B, Hq, T, D), T small (1 or speculation width)
     k_cache: jnp.ndarray,        # (L, NB, Hkv, BS, D) — full stacked paged cache
@@ -582,6 +765,62 @@ def paged_decode_attention_stacked(
     rows_per_cell: Optional[int] = None,
     interpret: bool = False,
     variant: int = 2,
+    amla: Optional[bool] = None,
+    kv_splits: Optional[int] = None,
+) -> jnp.ndarray:
+    """Ragged paged decode attention (plain wrapper, see the jitted impl below).
+
+    Resolves the trace-time knobs and dispatches to the jitted impl:
+    ``amla=None`` reads TPUINF_AMLA (default ON — exponent-add rescaling),
+    ``kv_splits=None`` auto-selects the length-parallel split count for the
+    long-context small-batch regime (TPUINF_LENPAR=0 opts out). Runs at trace
+    time under an enclosing jit, so env toggles between runner builds retrace."""
+    b, hq, t, d = q.shape
+    hkv = k_cache.shape[2]
+    mb = block_table.shape[1]
+    amla_r = _amla_default() if amla is None else bool(amla)
+    ks = kv_splits if kv_splits is not None else _auto_kv_splits(b, hkv, mb, t)
+    if ks > 1 and variant == 3:
+        if kv_splits is not None:
+            raise ValueError("kv_splits > 1 requires variant=2")
+        ks = 1
+    _LENPAR_STATS["traces"] += 1
+    if ks > 1:
+        _LENPAR_STATS["split_traces"] += 1
+        _LENPAR_STATS["last_splits"] = ks
+        if kv_splits is None:
+            _LENPAR_STATS["auto_engaged"] += 1
+    return _paged_decode_attention_impl(
+        q, k_cache, v_cache, positions, layer_idx, block_table, scale=scale,
+        window=window, soft_cap=soft_cap, sinks=sinks,
+        alibi_slopes=alibi_slopes, blocks_per_cell=blocks_per_cell,
+        rows_per_cell=rows_per_cell, interpret=interpret, variant=variant,
+        amla=amla_r, kv_splits=ks)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("scale", "window", "soft_cap", "blocks_per_cell",
+                     "rows_per_cell", "interpret", "variant", "amla",
+                     "kv_splits"))
+def _paged_decode_attention_impl(
+    q: jnp.ndarray,              # (B, Hq, T, D), T small (1 or speculation width)
+    k_cache: jnp.ndarray,        # (L, NB, Hkv, BS, D) — full stacked paged cache
+    v_cache: jnp.ndarray,
+    positions: jnp.ndarray,      # (B,) int32 write position of q[:, :, 0]
+    layer_idx: jnp.ndarray,      # () int32 layer to attend over
+    block_table: jnp.ndarray,    # (B, MB) int32 physical block ids (logical order)
+    scale: Optional[float] = None,
+    window: Optional[int] = None,
+    soft_cap: Optional[float] = None,
+    sinks: Optional[jnp.ndarray] = None,         # (Hq,) learned sink logits
+    alibi_slopes: Optional[jnp.ndarray] = None,  # (Hq,) ALiBi slopes
+    blocks_per_cell: Optional[int] = None,
+    rows_per_cell: Optional[int] = None,
+    interpret: bool = False,
+    variant: int = 2,
+    amla: bool = True,
+    kv_splits: int = 1,
 ) -> jnp.ndarray:
     """Ragged paged decode attention over one layer of the stacked paged cache.
 
@@ -656,10 +895,23 @@ def paged_decode_attention_stacked(
         kb -= 1
     num_cells = mb // kb
 
+    # length-parallel split: shrink until it divides the cell count (and never
+    # split the v3 packing — the split kernel is the v2 concat-cell body)
+    splits = 1 if variant == 3 else max(1, min(kv_splits, num_cells))
+    while num_cells % splits:
+        splits -= 1
+    cps = num_cells // splits
+
     def _kv_index_map(j, g):
-        def index_map(bi, ci, pos, lidx, bt):
+        def index_map(*a):
+            if splits == 1:
+                (bi, ci), (pos, lidx, bt) = a[:2], a[2:]
+                cell = ci
+            else:
+                (si, bi, ci), (pos, lidx, bt) = a[:3], a[3:]
+                cell = si * cps + ci
             row = bi * bb + j
-            gg = ci * kb + g
+            gg = cell * kb + g
             # clamp out-of-range fetches to the nearest live block — beyond-live
             # groups to the last live block (this step's fresh tokens reach
             # pos + t - 1) and, under a sliding window, below-window groups to the
@@ -686,7 +938,7 @@ def paged_decode_attention_stacked(
             _paged_attend_kernel_v3, scale=scale, bs=bs, kb=kb, bb=bb,
             num_cells=num_cells, t=t, qr=qr, nq=nq, hkv=hkv, window=window,
             soft_cap=soft_cap, has_sinks=sinks is not None,
-            has_slopes=alibi_slopes is not None)
+            has_slopes=alibi_slopes is not None, amla=amla)
         q_spec = pl.BlockSpec((bb, nq, d), lambda bi, ci, *_: (bi, 0, 0))
         out_shape = jax.ShapeDtypeStruct((b, nq, d), q.dtype)
         n_scr_rows = bb * nq
@@ -696,8 +948,14 @@ def paged_decode_attention_stacked(
             _paged_attend_kernel, scale=scale, bs=bs, kb=kb, bb=bb,
             num_cells=num_cells,
             t=t, rows=rows, hkv=hkv, window=window, soft_cap=soft_cap,
-            has_sinks=sinks is not None, has_slopes=alibi_slopes is not None)
-        q_spec = pl.BlockSpec((bb, hkv, rows, d), lambda bi, ci, *_: (bi, 0, 0, 0))
+            has_sinks=sinks is not None, has_slopes=alibi_slopes is not None,
+            amla=amla, splits=splits, cps=cps)
+        if splits == 1:
+            q_spec = pl.BlockSpec((bb, hkv, rows, d),
+                                  lambda bi, ci, *_: (bi, 0, 0, 0))
+        else:
+            q_spec = pl.BlockSpec((bb, hkv, rows, d),
+                                  lambda si, bi, ci, *_: (bi, 0, 0, 0))
         out_shape = jax.ShapeDtypeStruct((b, hkv, rows, d), q.dtype)
         n_scr_rows = bb * hkv * rows
         extra_rows = hkv * rows
@@ -718,20 +976,48 @@ def paged_decode_attention_stacked(
 
     def _kernel(pos_ref, lidx_ref, bt_ref, q_ref, *rest):
         ins = rest[: 2 * kb * bb + n_extra]
-        o_ref, m_s, l_s, acc_s = rest[2 * kb * bb + n_extra :]
-        kernel(pos_ref, lidx_ref, bt_ref, q_ref, *ins, o_ref=o_ref,
-               m_scratch=m_s, l_scratch=l_s, acc_scratch=acc_s)
+        outs = rest[2 * kb * bb + n_extra :]
+        if splits == 1:
+            o_ref, m_s, l_s, acc_s = outs
+            kernel(pos_ref, lidx_ref, bt_ref, q_ref, *ins, o_ref=o_ref,
+                   m_scratch=m_s, l_scratch=l_s, acc_scratch=acc_s)
+        else:
+            o_ref, m_o, l_o, m_s, l_s, acc_s = outs
+            kernel(pos_ref, lidx_ref, bt_ref, q_ref, *ins, o_ref=o_ref,
+                   m_out=m_o, l_out=l_o, m_scratch=m_s, l_scratch=l_s,
+                   acc_scratch=acc_s)
 
+    scratch_shapes = [
+        pltpu.VMEM((n_scr_rows, 128), jnp.float32),
+        pltpu.VMEM((n_scr_rows, 128), jnp.float32),
+        pltpu.VMEM((n_scr_rows, d), jnp.float32),
+    ]
+    nrows = extra_rows
+    if splits == 1:
+        grid = (b // bb, num_cells)
+        out_specs = pl.BlockSpec(q_spec.block_shape, q_spec.index_map)
+        out_shapes = out_shape
+    else:
+        grid = (splits, b // bb, cps)
+        out_specs = [
+            pl.BlockSpec((1, bb, hkv, rows, d),
+                         lambda si, bi, ci, *_: (si, bi, 0, 0, 0)),
+            pl.BlockSpec((1, bb, nrows, 128),
+                         lambda si, bi, ci, *_: (si, bi, 0, 0)),
+            pl.BlockSpec((1, bb, nrows, 128),
+                         lambda si, bi, ci, *_: (si, bi, 0, 0)),
+        ]
+        out_shapes = [
+            jax.ShapeDtypeStruct((splits, b, hkv, rows, d), jnp.float32),
+            jax.ShapeDtypeStruct((splits, b, nrows, 128), jnp.float32),
+            jax.ShapeDtypeStruct((splits, b, nrows, 128), jnp.float32),
+        ]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
-        grid=(b // bb, num_cells),
+        grid=grid,
         in_specs=[q_spec] + kv_specs + extra_specs,
-        out_specs=pl.BlockSpec(q_spec.block_shape, q_spec.index_map),
-        scratch_shapes=[
-            pltpu.VMEM((n_scr_rows, 128), jnp.float32),
-            pltpu.VMEM((n_scr_rows, 128), jnp.float32),
-            pltpu.VMEM((n_scr_rows, d), jnp.float32),
-        ],
+        out_specs=out_specs,
+        scratch_shapes=scratch_shapes,
     )
     # the per-layer cache view (4D) keeps the kv BlockSpecs rank-4; layer selection
     # happens in the index map's first coordinate against the 5D array — pass the 5D
@@ -740,11 +1026,18 @@ def paged_decode_attention_stacked(
     out = pl.pallas_call(
         _kernel,
         grid_spec=grid_spec,
-        out_shape=out_shape,
+        out_shape=out_shapes,
         interpret=interpret,
     )(positions.astype(jnp.int32), layer_idx.reshape(1).astype(jnp.int32),
       block_table.astype(jnp.int32), qg,
       *([k_cache, v_cache] * (kb * bb)), *extra_ops)
+
+    if splits > 1:
+        o32, m_o, l_o = out
+        sink_col = extra_ops[0][:, 0] if sinks is not None else None
+        out = _lenpar_merge(o32.reshape(splits, b, nrows, d), m_o[..., 0],
+                            l_o[..., 0], sink_col, amla, q.dtype)
+        out = out.reshape(b, hkv, rows, d)
 
     if variant == 3:
         out = out[:, : hkv * qr, :].reshape(b, hkv, n_rep, t, d)
@@ -762,7 +1055,8 @@ def _fused_append_attend_kernel(pos_ref, lidx_ref, slots_ref, bt_ref, q_ref,
                                 pack: int, pdepth: int,
                                 window: Optional[int],
                                 soft_cap: Optional[float], has_sinks: bool,
-                                has_slopes: bool):
+                                has_slopes: bool, amla: bool, splits: int = 1,
+                                bps: int = 0):
     """Fused decode body: commit the step's fresh K/V AND attend, one grid row
     per batch row.
 
@@ -791,17 +1085,38 @@ def _fused_append_attend_kernel(pos_ref, lidx_ref, slots_ref, bt_ref, q_ref,
          read-after-write of the just-written block.
 
     q rows pack FLAT (hkv * n_rep * t, D) with no per-head padding (v3
-    packing): row r is kv-head ``r // qr``, token ``(r % qr) % t``."""
+    packing): row r is kv-head ``r // qr``, token ``(r % qr) % t``.
+
+    ``splits > 1`` is the LENGTH-PARALLEL variant: grid (splits, B), split s
+    streams committed blocks [max(blk_lo, s*bps), min(blk_hi, (s+1)*bps)) with
+    its own flash state; ONLY split 0 runs the append (phases 1a/1b and the
+    straddle fallback) and the fresh-token attend (phase 3) — the TPU grid is
+    sequential, so every split-0 write-back drains before later splits stream.
+    Finalize emits RAW (acc, m, l) per split for the outside LSE merge."""
     idx = 0
     sinks_ref = slopes_ref = None
     if has_sinks:
         sinks_ref, idx = refs[idx], idx + 1
     if has_slopes:
         slopes_ref, idx = refs[idx], idx + 1
-    _k_in, _v_in, o_ref, k_out, v_out = refs[idx : idx + 5]
-    (ks, vs, wk, wv, m_s, l_s, acc_s, ssem, wsem) = refs[idx + 5 :]
+    _k_in, _v_in, o_ref = refs[idx : idx + 3]
+    idx += 3
+    if splits > 1:
+        m_out, l_out = refs[idx : idx + 2]
+        idx += 2
+    else:
+        m_out = l_out = None
+    k_out, v_out = refs[idx : idx + 2]
+    (ks, vs, wk, wv, m_s, l_s, acc_s, ssem, wsem) = refs[idx + 2 :]
 
-    bi = pl.program_id(0)
+    if splits == 1:
+        si = None
+        bi = pl.program_id(0)
+        on_split0 = None
+    else:
+        si = pl.program_id(0)
+        bi = pl.program_id(1)
+        on_split0 = si == 0
     l = lidx_ref[0]
     pos = pos_ref[bi]
     d = q_ref.shape[-1]
@@ -825,6 +1140,9 @@ def _fused_append_attend_kernel(pos_ref, lidx_ref, slots_ref, bt_ref, q_ref,
     w0 = (jnp.maximum(slot0, 0) % bs // pack) * pack
     dst_k = k_out.at[l, blk_w, :, pl.ds(w0, pack), :]
     dst_v = v_out.at[l, blk_w, :, pl.ds(w0, pack), :]
+    if splits > 1:                             # only split 0 owns the append
+        one_window = jnp.logical_and(one_window, on_split0)
+        fallback = jnp.logical_and(fallback, on_split0)
 
     @pl.when(one_window)
     def _start_window_read():
@@ -872,25 +1190,21 @@ def _fused_append_attend_kernel(pos_ref, lidx_ref, slots_ref, bt_ref, q_ref,
         if soft_cap is not None:
             s = soft_cap * jnp.tanh(s / soft_cap)
         s = jnp.where(mask, s, NEG_INF)
-        m_prev = m_s[:, 0:1]
-        l_prev = l_s[:, 0:1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        alpha = jnp.exp(jnp.minimum(m_prev - m_new, 0.0))
-        p = jnp.exp(s - m_new)
-        p = jnp.where(mask, p, 0.0)
-        l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
         if int8_kv:
-            pi = jnp.round(p * 127.0).astype(jnp.int8)
-            pv = jax.lax.dot_general(
-                pi, vmat, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.int32
-            ).astype(jnp.float32) * (1.0 / 127.0)
+            def pv_dot(p, vmat=vmat):
+                pi = jnp.round(p * 127.0).astype(jnp.int8)
+                return jax.lax.dot_general(
+                    pi, vmat, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.int32
+                ).astype(jnp.float32) * (1.0 / 127.0)
         else:
-            pv = jax.lax.dot_general(
+            pv_dot = lambda p, vmat=vmat: jax.lax.dot_general(
                 p.astype(q.dtype), _vmem_cast(vmat, q.dtype),
                 (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
-        acc_s[:] = acc_s[:] * alpha + pv
+        m_new, l_new, acc = _flash_accumulate(
+            s, mask, m_s[:, 0:1], l_s[:, 0:1], acc_s[:], pv_dot, amla)
+        acc_s[:] = acc
         m_s[:] = jnp.broadcast_to(m_new, (nq, 128))
         l_s[:] = jnp.broadcast_to(l_new, (nq, 128))
 
@@ -924,6 +1238,9 @@ def _fused_append_attend_kernel(pos_ref, lidx_ref, slots_ref, bt_ref, q_ref,
         blk_lo = jnp.minimum(blk_lo, blk_hi)
     else:
         blk_lo = jnp.zeros((), jnp.int32)
+    if splits > 1:                             # this split's slice of the walk
+        blk_lo = jnp.maximum(blk_lo, si * bps)
+        blk_hi = jnp.minimum(blk_hi, (si + 1) * bps)
 
     def _stream_dma(i, slot):
         pb = bt_ref[bi, i]
@@ -964,39 +1281,47 @@ def _fused_append_attend_kernel(pos_ref, lidx_ref, slots_ref, bt_ref, q_ref,
 
     jax.lax.fori_loop(blk_lo, blk_hi, _stream_body, 0)
 
-    # ---- phase 3: the fresh tokens attend from the operands -----------------
-    cols_f = hkv * t
-    kf = new_k_ref[0].reshape(cols_f, d)
-    vf = new_v_ref[0].reshape(cols_f, d)
-    row_f = jax.lax.broadcasted_iota(jnp.int32, (nq, cols_f), 0)
-    col_f = jax.lax.broadcasted_iota(jnp.int32, (nq, cols_f), 1)
-    tok_f = col_f % t
-    mask_f = jnp.logical_and((row_f // qr) == (col_f // t),
-                             tok_f <= (row_f % qr) % t)
-    live_f = jnp.zeros((nq, cols_f), jnp.bool_)
-    for j in range(t):
-        live_f = jnp.logical_or(
-            live_f, jnp.logical_and(tok_f == j, slots_ref[bi * t + j] >= 0))
-    mask_f = jnp.logical_and(mask_f, live_f)
-    q_pos_f = pos + (row_f % qr) % t
-    kv_pos_f = pos + tok_f
-    if window is not None:
-        mask_f = jnp.logical_and(mask_f, kv_pos_f > q_pos_f - window)
-    _flash_update(kf, vf, mask_f,
-                  s_extra_pos=(q_pos_f - kv_pos_f) if has_slopes else None)
+    # ---- phase 3: the fresh tokens attend from the operands (split 0 only) --
+    def _fresh_attend():
+        cols_f = hkv * t
+        kf = new_k_ref[0].reshape(cols_f, d)
+        vf = new_v_ref[0].reshape(cols_f, d)
+        row_f = jax.lax.broadcasted_iota(jnp.int32, (nq, cols_f), 0)
+        col_f = jax.lax.broadcasted_iota(jnp.int32, (nq, cols_f), 1)
+        tok_f = col_f % t
+        mask_f = jnp.logical_and((row_f // qr) == (col_f // t),
+                                 tok_f <= (row_f % qr) % t)
+        live_f = jnp.zeros((nq, cols_f), jnp.bool_)
+        for j in range(t):
+            live_f = jnp.logical_or(
+                live_f, jnp.logical_and(tok_f == j, slots_ref[bi * t + j] >= 0))
+        mask_f = jnp.logical_and(mask_f, live_f)
+        q_pos_f = pos + (row_f % qr) % t
+        kv_pos_f = pos + tok_f
+        if window is not None:
+            mask_f = jnp.logical_and(mask_f, kv_pos_f > q_pos_f - window)
+        _flash_update(kf, vf, mask_f,
+                      s_extra_pos=(q_pos_f - kv_pos_f) if has_slopes else None)
+
+    if splits == 1:
+        _fresh_attend()
+    else:
+        pl.when(on_split0)(_fresh_attend)
 
     # ---- finalize -----------------------------------------------------------
-    m = m_s[:, 0:1]
-    lsum = l_s[:, 0:1]
-    acc = acc_s[:]
-    if sinks_ref is not None:
-        sink = sinks_ref[:, 0:1]
-        m_new = jnp.maximum(m, sink)
-        alpha = jnp.exp(jnp.minimum(m - m_new, 0.0))
-        lsum = alpha * lsum + jnp.exp(sink - m_new)
-        acc = acc * alpha
-    l_safe = jnp.where(lsum == 0.0, 1.0, lsum)
-    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
+    if splits > 1:
+        # raw per-split flash state for the outside cross-split merge
+        o_ref[0, 0] = acc_s[:]
+        m_out[0, 0] = m_s[:]
+        l_out[0, 0] = l_s[:]
+    else:
+        m = m_s[:, 0:1]
+        lsum = l_s[:, 0:1]
+        acc = acc_s[:]
+        if sinks_ref is not None:
+            _, lsum, acc = _fold_sinks(m, lsum, acc, sinks_ref[:, 0:1], amla)
+        l_safe = jnp.where(lsum == 0.0, 1.0, lsum)
+        o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
 
     @pl.when(one_window)
     def _drain_write_back():
@@ -1004,10 +1329,6 @@ def _fused_append_attend_kernel(pos_ref, lidx_ref, slots_ref, bt_ref, q_ref,
         pltpu.make_async_copy(wv, dst_v, wsem.at[1]).wait()
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("scale", "window", "soft_cap", "prefetch_depth",
-                     "interpret"))
 def fused_paged_decode_stacked(
     q: jnp.ndarray,              # (B, Hq, T, D), T <= 8 (1 or speculation width)
     new_k: jnp.ndarray,          # (B, Hkv, T, D), already in cache dtype
@@ -1025,6 +1346,54 @@ def fused_paged_decode_stacked(
     alibi_slopes: Optional[jnp.ndarray] = None,  # (Hq,) ALiBi slopes
     prefetch_depth: Optional[int] = None,
     interpret: bool = False,
+    amla: Optional[bool] = None,
+    kv_splits: Optional[int] = None,
+):
+    """Fused KV-append + attend (plain wrapper, see the jitted impl below).
+
+    Resolves the trace-time knobs (TPUINF_AMLA / TPUINF_LENPAR, see
+    `paged_decode_attention_stacked`) and dispatches to the jitted impl."""
+    b, hq, t, d = q.shape
+    hkv = k_cache.shape[2]
+    mb = block_table.shape[1]
+    amla_r = _amla_default() if amla is None else bool(amla)
+    ks = kv_splits if kv_splits is not None else _auto_kv_splits(b, hkv, mb, t)
+    _LENPAR_STATS["traces"] += 1
+    if ks > 1:
+        _LENPAR_STATS["split_traces"] += 1
+        _LENPAR_STATS["last_splits"] = ks
+        if kv_splits is None:
+            _LENPAR_STATS["auto_engaged"] += 1
+    return _fused_paged_decode_impl(
+        q, new_k, new_v, k_cache, v_cache, positions, slot_mapping, layer_idx,
+        block_table, scale=scale, window=window, soft_cap=soft_cap,
+        sinks=sinks, alibi_slopes=alibi_slopes, prefetch_depth=prefetch_depth,
+        interpret=interpret, amla=amla_r, kv_splits=ks)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("scale", "window", "soft_cap", "prefetch_depth",
+                     "interpret", "amla", "kv_splits"))
+def _fused_paged_decode_impl(
+    q: jnp.ndarray,              # (B, Hq, T, D), T <= 8 (1 or speculation width)
+    new_k: jnp.ndarray,          # (B, Hkv, T, D), already in cache dtype
+    new_v: jnp.ndarray,
+    k_cache: jnp.ndarray,        # (L, NB, Hkv, BS, D) — donated/aliased in place
+    v_cache: jnp.ndarray,
+    positions: jnp.ndarray,      # (B,) int32 write position of q[:, :, 0]
+    slot_mapping: jnp.ndarray,   # (B, T) int32 flat slots (block*BS + off); -1 = drop
+    layer_idx: jnp.ndarray,      # () int32 layer to serve
+    block_table: jnp.ndarray,    # (B, MB) int32 physical block ids (logical order)
+    scale: Optional[float] = None,
+    window: Optional[int] = None,
+    soft_cap: Optional[float] = None,
+    sinks: Optional[jnp.ndarray] = None,         # (Hq,) learned sink logits
+    alibi_slopes: Optional[jnp.ndarray] = None,  # (Hq,) ALiBi slopes
+    prefetch_depth: Optional[int] = None,
+    interpret: bool = False,
+    amla: bool = True,
+    kv_splits: int = 1,
 ):
     """FUSED KV-append + ragged paged attend: one pallas call serves the layer.
 
@@ -1092,27 +1461,58 @@ def fused_paged_decode_stacked(
             extra_ops.append(grouped)
     n_extra = len(extra_ops)
 
+    splits = max(1, min(kv_splits, mb))
+    bps = -(-mb // splits)                     # static blocks per split
+
     kernel = functools.partial(
         _fused_append_attend_kernel, scale=scale, bs=bs, t=t, qr=qr, nq=nq,
         hkv=hkv, pack=pack, pdepth=pdepth, window=window, soft_cap=soft_cap,
-        has_sinks=sinks is not None, has_slopes=alibi_slopes is not None)
+        has_sinks=sinks is not None, has_slopes=alibi_slopes is not None,
+        amla=amla, splits=splits, bps=bps)
+
+    if splits == 1:
+        grid = (b,)
+        qim = lambda bi, *_: (bi, 0, 0)
+        kvim = lambda bi, *_: (bi, 0, 0, 0)
+        out_specs = [
+            pl.BlockSpec((1, nq, d), lambda bi, *_: (bi, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ]
+        out_shapes = [jax.ShapeDtypeStruct((b, nq, d), q.dtype),
+                      jax.ShapeDtypeStruct(k_cache.shape, k_cache.dtype),
+                      jax.ShapeDtypeStruct(v_cache.shape, v_cache.dtype)]
+        aliases = {7 + n_extra: 1, 8 + n_extra: 2}
+    else:
+        grid = (splits, b)
+        qim = lambda si, bi, *_: (bi, 0, 0)
+        kvim = lambda si, bi, *_: (bi, 0, 0, 0)
+        out_specs = [
+            pl.BlockSpec((1, 1, nq, d), lambda si, bi, *_: (si, bi, 0, 0)),
+            pl.BlockSpec((1, 1, nq, 128), lambda si, bi, *_: (si, bi, 0, 0)),
+            pl.BlockSpec((1, 1, nq, 128), lambda si, bi, *_: (si, bi, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ]
+        out_shapes = [jax.ShapeDtypeStruct((splits, b, nq, d), jnp.float32),
+                      jax.ShapeDtypeStruct((splits, b, nq, 128), jnp.float32),
+                      jax.ShapeDtypeStruct((splits, b, nq, 128), jnp.float32),
+                      jax.ShapeDtypeStruct(k_cache.shape, k_cache.dtype),
+                      jax.ShapeDtypeStruct(v_cache.shape, v_cache.dtype)]
+        aliases = {7 + n_extra: 3, 8 + n_extra: 4}
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=4,
-        grid=(b,),
+        grid=grid,
         in_specs=[
-            pl.BlockSpec((1, nq, d), lambda bi, *_: (bi, 0, 0)),
-            pl.BlockSpec((1, hkv, t, d), lambda bi, *_: (bi, 0, 0, 0)),
-            pl.BlockSpec((1, hkv, t, d), lambda bi, *_: (bi, 0, 0, 0)),
+            pl.BlockSpec((1, nq, d), qim),
+            pl.BlockSpec((1, hkv, t, d), kvim),
+            pl.BlockSpec((1, hkv, t, d), kvim),
         ] + extra_specs + [
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
         ],
-        out_specs=[
-            pl.BlockSpec((1, nq, d), lambda bi, *_: (bi, 0, 0)),
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ],
+        out_specs=out_specs,
         scratch_shapes=[
             pltpu.VMEM((pdepth, hkv, bs, d), k_cache.dtype),
             pltpu.VMEM((pdepth, hkv, bs, d), v_cache.dtype),
@@ -1125,18 +1525,24 @@ def fused_paged_decode_stacked(
             pltpu.SemaphoreType.DMA((2,)),
         ],
     )
-    out, kc, vc = pl.pallas_call(
+    outs = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=[jax.ShapeDtypeStruct((b, nq, d), q.dtype),
-                   jax.ShapeDtypeStruct(k_cache.shape, k_cache.dtype),
-                   jax.ShapeDtypeStruct(v_cache.shape, v_cache.dtype)],
+        out_shape=out_shapes,
         # caches alias in place (after 4 prefetch + q/new_k/new_v + extras)
-        input_output_aliases={7 + n_extra: 1, 8 + n_extra: 2},
+        input_output_aliases=aliases,
         interpret=interpret,
     )(positions.astype(jnp.int32), layer_idx.reshape(1).astype(jnp.int32),
       slot_mapping.reshape(-1).astype(jnp.int32), block_table.astype(jnp.int32),
       qg, new_k, new_v, *extra_ops, k_cache, v_cache)
+
+    if splits == 1:
+        out, kc, vc = outs
+    else:
+        o32, m_o, l_o, kc, vc = outs
+        sink_col = extra_ops[0][:, 0] if sinks is not None else None
+        out = _lenpar_merge(o32, m_o[..., 0], l_o[..., 0], sink_col, amla,
+                            q.dtype)
 
     out = out[:, : hkv * qr, :].reshape(b, hkv, n_rep, t, d)
     return out.reshape(b, hq, t, d), kc, vc
@@ -1152,7 +1558,7 @@ def _paged_mixed_attend_kernel(pos_ref, qlen_ref, lidx_ref, bt_ref, q_ref,
                                hq: int, n_rep: int, hkv: int, tr: int,
                                window: Optional[int],
                                soft_cap: Optional[float], has_sinks: bool,
-                               has_slopes: bool):
+                               has_slopes: bool, amla: bool):
     """Mixed-step cell body: per-row VARIABLE q_len over token-major q tiles.
 
     Grid is (row, q_tile, kv_cell). q rows pack token-major — row r of a tile
@@ -1240,25 +1646,22 @@ def _paged_mixed_attend_kernel(pos_ref, qlen_ref, lidx_ref, bt_ref, q_ref,
                 s = soft_cap * jnp.tanh(s / soft_cap)
             s = jnp.where(mask, s, NEG_INF)
 
-            m_prev = m_scratch[:, 0:1]
-            l_prev = l_scratch[:, 0:1]
-            m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-            alpha = jnp.exp(jnp.minimum(m_prev - m_new, 0.0))
-            p = jnp.exp(s - m_new)
-            p = jnp.where(mask, p, 0.0)
-            l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
             if int8_kv:
-                pi = jnp.round(p * 127.0).astype(jnp.int8)
-                pv = jax.lax.dot_general(
-                    pi, v, (((1,), (0,)), ((), ())),
-                    preferred_element_type=jnp.int32
-                ).astype(jnp.float32) * (1.0 / 127.0)
+                def pv_dot(p, v=v):
+                    pi = jnp.round(p * 127.0).astype(jnp.int8)
+                    return jax.lax.dot_general(
+                        pi, v, (((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.int32
+                    ).astype(jnp.float32) * (1.0 / 127.0)
             else:
                 v = _vmem_cast(v, q.dtype)
-                pv = jax.lax.dot_general(
+                pv_dot = lambda p, v=v: jax.lax.dot_general(
                     p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
                     preferred_element_type=jnp.float32)
-            acc_scratch[:] = acc_scratch[:] * alpha + pv
+            m_new, l_new, acc = _flash_accumulate(
+                s, mask, m_scratch[:, 0:1], l_scratch[:, 0:1], acc_scratch[:],
+                pv_dot, amla)
+            acc_scratch[:] = acc
             m_scratch[:] = jnp.broadcast_to(m_new, (tr, 128))
             l_scratch[:] = jnp.broadcast_to(l_new, (tr, 128))
 
@@ -1268,19 +1671,11 @@ def _paged_mixed_attend_kernel(pos_ref, qlen_ref, lidx_ref, bt_ref, q_ref,
         l = l_scratch[:, 0:1]
         acc = acc_scratch[:]
         if sinks_ref is not None:
-            sink = sinks_ref[:, 0:1]
-            m_new = jnp.maximum(m, sink)
-            alpha = jnp.exp(jnp.minimum(m - m_new, 0.0))
-            l = alpha * l + jnp.exp(sink - m_new)
-            acc = acc * alpha
+            _, l, acc = _fold_sinks(m, l, acc, sinks_ref[:, 0:1], amla)
         l_safe = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("scale", "window", "soft_cap", "blocks_per_cell",
-                     "q_tile", "interpret"))
 def paged_mixed_attention_stacked(
     q: jnp.ndarray,              # (B, Hq, T, D), T = chunk bucket (e.g. 64..256)
     k_cache: jnp.ndarray,        # (L, NB, Hkv, BS, D) — full stacked paged cache
@@ -1297,6 +1692,40 @@ def paged_mixed_attention_stacked(
     blocks_per_cell: Optional[int] = None,
     q_tile: Optional[int] = None,
     interpret: bool = False,
+    amla: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Mixed-step attention (plain wrapper): resolves TPUINF_AMLA at trace
+    time and dispatches to the jitted impl. The mixed kernel is never
+    length-split (chunk rows already expose q-tile grid parallelism)."""
+    amla_r = _amla_default() if amla is None else bool(amla)
+    return _paged_mixed_attention_impl(
+        q, k_cache, v_cache, positions, q_lens, layer_idx, block_table,
+        scale=scale, window=window, soft_cap=soft_cap, sinks=sinks,
+        alibi_slopes=alibi_slopes, blocks_per_cell=blocks_per_cell,
+        q_tile=q_tile, interpret=interpret, amla=amla_r)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("scale", "window", "soft_cap", "blocks_per_cell",
+                     "q_tile", "interpret", "amla"))
+def _paged_mixed_attention_impl(
+    q: jnp.ndarray,              # (B, Hq, T, D), T = chunk bucket (e.g. 64..256)
+    k_cache: jnp.ndarray,        # (L, NB, Hkv, BS, D) — full stacked paged cache
+    v_cache: jnp.ndarray,
+    positions: jnp.ndarray,      # (B,) int32 position of q[:, :, 0]
+    q_lens: jnp.ndarray,         # (B,) int32 live queries per row (1..T)
+    layer_idx: jnp.ndarray,      # () int32 layer to attend over
+    block_table: jnp.ndarray,    # (B, MB) int32 physical block ids (logical order)
+    scale: Optional[float] = None,
+    window: Optional[int] = None,
+    soft_cap: Optional[float] = None,
+    sinks: Optional[jnp.ndarray] = None,         # (Hq,) learned sink logits
+    alibi_slopes: Optional[jnp.ndarray] = None,  # (Hq,) ALiBi slopes
+    blocks_per_cell: Optional[int] = None,
+    q_tile: Optional[int] = None,
+    interpret: bool = False,
+    amla: bool = True,
 ) -> jnp.ndarray:
     """MIXED-STEP ragged paged attention: per-row variable q_len in one kernel.
 
@@ -1392,7 +1821,7 @@ def paged_mixed_attention_stacked(
         _paged_mixed_attend_kernel, scale=scale, bs=bs, kb=kb,
         num_cells=num_cells, qt=qt, hq=hq, n_rep=n_rep, hkv=hkv, tr=tr,
         window=window, soft_cap=soft_cap, has_sinks=sinks is not None,
-        has_slopes=alibi_slopes is not None)
+        has_slopes=alibi_slopes is not None, amla=amla)
 
     def _kernel(pos_ref, qlen_ref, lidx_ref, bt_ref, q_ref, *rest):
         ins = rest[: 2 * kb + n_extra]

@@ -1053,6 +1053,123 @@ class ContinuousBatchingRunner:
                     carry_args=("telem",),
                     static_argnames=("num_steps", "greedy"),
                     steps_arg="num_steps")
+
+                if self.megastep_k is not None:
+                    def _mixed_megastep(params, tok0, positions, alive0,
+                                        budget0, cache, telem, block_table,
+                                        slot_chunk, chunk_ids, chunk_pos,
+                                        chunk_qlens, chunk_bt, chunk_slots,
+                                        chunk_emit, sampling_params, chunk_sp,
+                                        key, adapter_ids, chunk_adapters,
+                                        eos_ids, num_windows, num_steps,
+                                        greedy=False):
+                        """``num_windows`` MIXED serving steps in ONE
+                        dispatch: a lax.scan over whole insert windows, each
+                        window the exact _mixed body (C budgeted prefill-chunk
+                        rows through the variable-q_len ragged attend, then
+                        ``num_steps`` chained decode iterations), the decode
+                        carry (token/position/alive/budget/cache/telem)
+                        threaded ACROSS windows exactly as the host would
+                        re-seed it between step-wise dispatches. The window
+                        plan (which rows, which chunk lengths, emit flags,
+                        per-window slot mappings) is HOST-deterministic — the
+                        FIFO/weighted chunk assignment depends only on host
+                        bookkeeping the device never changes — so every
+                        window's operands stack into leading-axis-W arrays at
+                        dispatch time; a window whose completion would change
+                        the plan (a prompt finishing joins the decode roster)
+                        is always the LAST window of the plan."""
+                        w_keys = jax.random.split(key, num_windows)
+                        bsz = tok0.shape[0]
+                        slots_w = slot_chunk.T.reshape(
+                            num_windows, num_steps, bsz)[..., None]
+
+                        def window(carry, xs):
+                            tok, pos, cache, alive_t, budget_t, telem = carry
+                            (key_w, c_ids, c_pos, c_qlens, c_bt, c_slots,
+                             c_emit, c_sp, c_ad, slots_j) = xs
+                            key_c, key_d = jax.random.split(key_w)
+                            with jax.default_matmul_precision(precision):
+                                logits_c, cache = decode_core(
+                                    params, args, c_ids, c_pos, cache, None,
+                                    mesh=mesh, rules=rules, block_table=c_bt,
+                                    slot_mapping=c_slots, adapter_ids=c_ad,
+                                    q_lens=c_qlens, logit_idx=c_qlens - 1,
+                                    **paged_kernel_kw)
+                                if greedy:
+                                    c_tok = sampling_ops.greedy(
+                                        logits_c[:, 0], mesh=mesh,
+                                        rules=rules)
+                                else:
+                                    c_tok = sampling_ops.sample(
+                                        logits_c[:, 0], c_sp, key_c, odsc,
+                                        mesh=mesh, rules=rules)
+                            telem = dtel.prefill_tick(telem, c_slots, bs_blk)
+                            telem = dtel.seed_tick(telem, jnp.sum(c_emit))
+
+                            d_keys = jax.random.split(key_d, num_steps)
+
+                            def body(dc, dxs):
+                                tok, pos, cache, alive_t, budget_t, \
+                                    telem = dc
+                                step_key, slots_i = dxs
+                                with jax.default_matmul_precision(precision):
+                                    logits, cache = decode_core(
+                                        params, args, tok[:, None], pos,
+                                        cache, None, mesh=mesh, rules=rules,
+                                        block_table=block_table,
+                                        slot_mapping=slots_i,
+                                        adapter_ids=adapter_ids,
+                                        **paged_kernel_kw)
+                                    if greedy:
+                                        nxt = sampling_ops.greedy(
+                                            logits[:, -1], mesh=mesh,
+                                            rules=rules)
+                                    else:
+                                        nxt = sampling_ops.sample(
+                                            logits[:, -1], sampling_params,
+                                            step_key, odsc, mesh=mesh,
+                                            rules=rules)
+                                telem = dtel.decode_tick(telem, alive_t, nxt,
+                                                         eos_ids)
+                                telem = dtel.kv_tick(telem, slots_i, bs_blk)
+                                budget_t = budget_t - alive_t.astype(
+                                    budget_t.dtype)
+                                alive_t = jnp.logical_and(alive_t,
+                                                          budget_t > 0)
+                                alive_t = jnp.logical_and(alive_t,
+                                                          nxt != eos_ids)
+                                return (nxt, pos + 1, cache, alive_t,
+                                        budget_t, telem), nxt
+
+                            (tok, pos, cache, alive_t, budget_t,
+                             telem), toks_w = jax.lax.scan(
+                                body, (tok, pos, cache, alive_t, budget_t,
+                                       telem), (d_keys, slots_j))
+                            telem = dtel.megastep_iter_tick(telem)
+                            return (tok, pos, cache, alive_t, budget_t,
+                                    telem), (toks_w, c_tok)
+
+                        (_, _, cache, _, _, telem), (toks, chunk_toks) = \
+                            jax.lax.scan(
+                                window,
+                                (tok0, positions, cache, alive0, budget0,
+                                 telem),
+                                (w_keys, chunk_ids, chunk_pos, chunk_qlens,
+                                 chunk_bt, chunk_slots, chunk_emit, chunk_sp,
+                                 chunk_adapters, slots_w))
+                        telem = dtel.bump_kind(telem,
+                                               dtel.KIND_MIXED_MEGASTEP)
+                        # (W, T, B) -> (B, W*T): the host's commit order
+                        return (toks.transpose(2, 0, 1).reshape(bsz, -1),
+                                chunk_toks, cache, telem)
+
+                    self._mixed_megastep_step = audited_jit(
+                        _mixed_megastep, kind="cb.paged.mixed_megastep",
+                        cache_args=("cache",), carry_args=("telem",),
+                        static_argnames=("num_windows", "num_steps",
+                                        "greedy"),
+                        steps_arg="num_steps")
         else:
             # thread the app's prefill strategy (ring for cp>1, Pallas flash, or
             # dense attend) into insert-time context encoding; decode chunks take
@@ -1351,15 +1468,16 @@ class ContinuousBatchingRunner:
         d_skip = (dict(skip_logits=True)
                   if d_decode is model_base.decode_forward else {})
 
-        def _spec_chunk(t_params, d_params, tok0, positions, alive0, budget0,
-                        t_cache, d_cache, telem, block_table, sampling_params,
-                        eos_ids, key, adapter_ids, num_iters, greedy,
-                        decode_bucket=None):
-            iter_keys = jax.random.split(key, num_iters)
+        def _spec_iter_factory(t_params, d_params, block_table,
+                               sampling_params, eos_ids, adapter_ids, greedy,
+                               decode_bucket):
+            """ONE draft(k-1) -> KV-only draft -> wide-K verify -> acceptance
+            iteration, shared verbatim by the step-wise scan (_spec_chunk)
+            and the device-resident while_loop (_spec_megastep): bit-identity
+            between the two paths is structural, not re-proved per edit."""
 
-            def one_iter(carry, key_i):
-                tok, pos, alive, alive_t, budget_t, t_cache, d_cache, \
-                    telem = carry
+            def one_iter_core(tok, pos, alive, alive_t, budget_t, t_cache,
+                              d_cache, telem, key_i):
                 key_d, key_acc = jax.random.split(key_i)
                 d_keys = jax.random.split(key_d, k - 1)
                 if paged:
@@ -1445,7 +1563,27 @@ class ContinuousBatchingRunner:
                 tok = jnp.where(take > 0, new_tok, tok)
                 pos = pos + take
                 return (tok, pos, alive_next, alive_t, budget_t, t_cache,
-                        d_cache, telem), (out_toks, n)
+                        d_cache, telem, out_toks, n)
+
+            return one_iter_core
+
+        def _spec_chunk(t_params, d_params, tok0, positions, alive0, budget0,
+                        t_cache, d_cache, telem, block_table, sampling_params,
+                        eos_ids, key, adapter_ids, num_iters, greedy,
+                        decode_bucket=None):
+            iter_keys = jax.random.split(key, num_iters)
+            iter_core = _spec_iter_factory(t_params, d_params, block_table,
+                                           sampling_params, eos_ids,
+                                           adapter_ids, greedy, decode_bucket)
+
+            def one_iter(carry, key_i):
+                tok, pos, alive, alive_t, budget_t, t_cache, d_cache, \
+                    telem = carry
+                (tok, pos, alive, alive_t, budget_t, t_cache, d_cache, telem,
+                 out_toks, n) = iter_core(tok, pos, alive, alive_t, budget_t,
+                                          t_cache, d_cache, telem, key_i)
+                return (tok, pos, alive, alive_t, budget_t, t_cache, d_cache,
+                        telem), (out_toks, n)
 
             (_, _, _, _, _, t_cache, d_cache, telem), (outs, ns) = \
                 jax.lax.scan(
@@ -1459,6 +1597,95 @@ class ContinuousBatchingRunner:
             cache_args=("t_cache", "d_cache"), carry_args=("telem",),
             static_argnames=("num_iters", "greedy", "decode_bucket"),
             steps_arg="num_iters")
+
+        if paged and self.megastep_k is not None:
+            def _spec_megastep(t_params, d_params, tok0, positions, alive0,
+                               budget0, t_cache, d_cache, telem, block_table,
+                               coverage, sampling_params, eos_ids, key,
+                               adapter_ids, n_iters, service, ring_cap,
+                               greedy, decode_bucket=None):
+                """ONE device-resident SPECULATIVE serving megastep: a
+                lax.while_loop of up to ``min(n_iters, ring_cap)`` fused
+                draft-verify-accept iterations (each the exact one_iter_core
+                the step-wise _spec_chunk scans over), the per-iteration
+                (out_toks, n) acceptance results ringed into fixed (ring_cap,
+                B, K)/(ring_cap, B) buffers the host drains after ONE sync
+                instead of one sync per chunk. Early exits, checked before
+                every iteration against the COUNTING replay mask ``alive_t``
+                (the in-graph mirror of the host's commit_row budget/eos
+                stops — the device ``alive`` mask ignores budgets exactly as
+                in the step-wise path):
+
+                - all replay-live rows stopped (budget/eos);
+                - a still-WRITING row's next K-wide verify window would cross
+                  its host-pre-reserved block ``coverage`` (positions) —
+                  masked over the device ``alive`` rows, because those are
+                  the rows that keep writing KV even once replay-dead;
+                - the host's pending-arrival ``service`` flag (one iteration,
+                  then yield — queued work is serviced at chunk latency).
+
+                ``n_iters``/``service`` are DYNAMIC operands: one executable
+                serves every seq-room clamp, K sweep (via ring_cap statics
+                only) and queue state."""
+                iter_keys = jax.random.split(key, ring_cap)
+                iter_core = _spec_iter_factory(t_params, d_params,
+                                               block_table, sampling_params,
+                                               eos_ids, adapter_ids, greedy,
+                                               decode_bucket)
+                b = tok0.shape[0]
+                outs0 = jnp.zeros((ring_cap, b, k), jnp.int32)
+                ns0 = jnp.zeros((ring_cap, b), jnp.int32)
+                n_eff = jnp.minimum(n_iters, ring_cap)
+
+                def in_coverage(pos, writing):
+                    return jnp.all(jnp.where(writing, pos + k <= coverage,
+                                             True))
+
+                def cond(carry):
+                    (i, tok, pos, alive, alive_t, budget_t, outs_r, ns_r,
+                     t_cache, d_cache, telem) = carry
+                    more = (jnp.any(alive_t) & (i < n_eff)
+                            & in_coverage(pos, alive))
+                    return more & ((i == 0) | (service == 0))
+
+                def body(carry):
+                    (i, tok, pos, alive, alive_t, budget_t, outs_r, ns_r,
+                     t_cache, d_cache, telem) = carry
+                    (tok, pos, alive, alive_t, budget_t, t_cache, d_cache,
+                     telem, out_toks, n) = iter_core(
+                        tok, pos, alive, alive_t, budget_t, t_cache, d_cache,
+                        telem, iter_keys[i])
+                    telem = dtel.megastep_iter_tick(telem)
+                    outs_r = jax.lax.dynamic_update_index_in_dim(
+                        outs_r, out_toks, i, 0)
+                    ns_r = jax.lax.dynamic_update_index_in_dim(ns_r, n, i, 0)
+                    return (i + 1, tok, pos, alive, alive_t, budget_t,
+                            outs_r, ns_r, t_cache, d_cache, telem)
+
+                (n_run, _, pos_l, alive_l, alive_tl, _, outs_r, ns_r,
+                 t_cache, d_cache, telem) = jax.lax.while_loop(
+                    cond, body,
+                    (jnp.asarray(0, jnp.int32), tok0, positions, alive0,
+                     alive0, budget0, outs0, ns0, t_cache, d_cache, telem))
+                stopped = ~jnp.any(alive_tl)
+                blocks = ~in_coverage(pos_l, alive_l)
+                served = (service != 0) & (n_run < n_eff)
+                ring_full = (n_run >= ring_cap) & (ring_cap < n_iters)
+                exit_code = jnp.where(
+                    stopped, MEGASTEP_EXIT_STOPPED,
+                    jnp.where(blocks, MEGASTEP_EXIT_BLOCKS,
+                              jnp.where(served, MEGASTEP_EXIT_ARRIVAL,
+                                        jnp.where(ring_full,
+                                                  MEGASTEP_EXIT_RING,
+                                                  MEGASTEP_EXIT_ITERS))))
+                telem = dtel.bump_kind(telem, dtel.KIND_SPEC_MEGASTEP)
+                return ((outs_r, ns_r, n_run, exit_code.astype(jnp.int32)),
+                        t_cache, d_cache, telem)
+
+            self._spec_megastep_step = audited_jit(
+                _spec_megastep, kind="cb.spec.megastep",
+                cache_args=("t_cache", "d_cache"), carry_args=("telem",),
+                static_argnames=("ring_cap", "greedy", "decode_bucket"))
 
         if paged:
             t_base = t_decode is model_base.decode_forward
@@ -2386,18 +2613,23 @@ class ContinuousBatchingRunner:
                 self._commit(token_ring.drain(ring_dev, n), n, emitted)
             reason = MEGASTEP_EXITS.get(code, str(code))
             self._m_megastep_iters.inc(n)
-            c = self._megastep_exit_counters.get(reason)
-            if c is None:
-                c = self.telemetry.registry.counter(
-                    "serving_megastep_exits_total",
-                    "megastep in-graph early-exit/completion reasons",
-                    labels={"reason": reason})
-                self._megastep_exit_counters[reason] = c
-            c.inc()
+            self._count_megastep_exit(reason)
             return n, reason
         _, toks_dev, steps = entry
         self._commit(np.asarray(toks_dev), steps, emitted)
         return steps, None
+
+    def _count_megastep_exit(self, reason: str) -> None:
+        """serving_megastep_exits_total{reason=}: in-graph early-exit/
+        completion reasons, shared by the plain/spec/mixed megastep paths."""
+        c = self._megastep_exit_counters.get(reason)
+        if c is None:
+            c = self.telemetry.registry.counter(
+                "serving_megastep_exits_total",
+                "megastep in-graph early-exit/completion reasons",
+                labels={"reason": reason})
+            self._megastep_exit_counters[reason] = c
+        c.inc()
 
     def _commit(self, toks: np.ndarray, steps: int,
                 emitted: Dict[int, List[int]]) -> None:
@@ -3030,6 +3262,20 @@ class ContinuousBatchingRunner:
                 return self._fall_through("mixed", "inserts_preempted", key,
                                           emitted)
 
+        if self.megastep_k is not None:
+            if self.queue:
+                # the window PLAN depends on placements the host makes
+                # between steps — with arrivals pending, serve step-wise so
+                # they land at one-window latency (the host-side mirror of
+                # the plain megastep's service flag)
+                self._note_fall_through("mixed_mega", "pending_arrival")
+            else:
+                out = self._step_mixed_megastep(
+                    key, emitted, tel, t_step, n_emit0, active_rows,
+                    inserting, live, steps)
+                if out is not None:
+                    return out
+
         # token budget -> chunk assignments (weighted-fair across SLA
         # classes when >1 class is inserting; plain FIFO otherwise)
         c_rows, t_bucket = self.chunk_rows, self.prefill_chunk
@@ -3120,6 +3366,180 @@ class ContinuousBatchingRunner:
                 extra=self._consume_fall_through())
         return emitted
 
+    def _plan_mixed_megastep(self, inserting: List[Request],
+                             max_windows: int) -> List[List[tuple]]:
+        """Simulate ``_assign_prefill_chunks`` over up to ``max_windows``
+        successive mixed steps WITHOUT touching request state: the
+        FIFO/weighted assignment reads only host bookkeeping (insert_pos,
+        placed_seq, sla_class, the fixed per-step budget), so overlaying
+        ``insert_pos`` between rounds reproduces the exact window sequence
+        the step-wise scheduler would emit. Each plan entry is a window
+        ``[(req, wlen, pos0), ...]`` with ``pos0`` the pre-window insert
+        position. The plan STOPS after the first window in which any prompt
+        completes — a completion changes the decode roster for subsequent
+        dispatches, which the megastep's pre-staged operands cannot model,
+        so a completing window is always the plan's LAST."""
+        saved = {r.request_id: r.insert_pos for r in inserting}
+        plan: List[List[tuple]] = []
+        try:
+            for _ in range(max_windows):
+                chosen = self._assign_prefill_chunks(inserting)
+                if not chosen:
+                    break
+                window = []
+                complete = False
+                for r, wlen in chosen:
+                    window.append((r, wlen, r.insert_pos))
+                    r.insert_pos += wlen
+                    if r.insert_pos >= len(r.fed):
+                        complete = True
+                plan.append(window)
+                if complete:
+                    break
+        finally:
+            for r in inserting:
+                r.insert_pos = saved[r.request_id]
+        return plan
+
+    def _step_mixed_megastep(self, key, emitted: Dict[int, List[int]], tel,
+                             t_step, n_emit0: int,
+                             active_rows: List[Request],
+                             inserting: List[Request], live: List[Request],
+                             steps: int) -> Optional[Dict[int, List[int]]]:
+        """Up to ``megastep_k`` whole MIXED insert windows in ONE scanned
+        dispatch (cb.paged.mixed_megastep): the host pre-plans the window
+        sequence (_plan_mixed_megastep), stacks every window's chunk
+        operands on a leading W axis, and the device threads the decode
+        carry across windows exactly as the host would re-seed it between
+        step-wise dispatches — the per-token host round-trip between insert
+        windows disappears. Returns None (no state mutated) when the plan
+        is too short to beat step-wise; otherwise the committed emissions.
+
+        Exactness: window j's chunk rows/lengths equal the step-wise
+        assignment (same pure host policy over the same overlaid
+        insert_pos), the decode chain equals the step-wise re-seeded chain
+        for every host-live row, and the one big ``_commit`` over
+        ``W * steps`` columns equals W sequential commits (per-row commit
+        stops at eos/budget and ignores later columns either way)."""
+        from .speculation import quantize_chunk_iters
+
+        if live:
+            room = self.cfg.seq_len - 1 - max(r.position for r in live)
+            cap = min(self.megastep_k, room // steps)
+        else:
+            cap = self.megastep_k
+        if cap < 2:
+            self._note_fall_through("mixed_mega", "window_short")
+            return None
+        plan = self._plan_mixed_megastep(inserting, cap)
+        wq = (quantize_chunk_iters(self.megastep_k, len(plan))
+              if len(plan) >= 2 else 0)
+        if wq < 2:
+            # one (or zero) windows of prompt left: step-wise is already
+            # optimal and the plan simulation touched nothing
+            self._note_fall_through("mixed_mega", "window_short")
+            return None
+        plan = plan[:wq]
+        num_w = len(plan)
+        if live:
+            # the step-wise preamble grew ONE window of decode room; extend
+            # to the full in-graph advance
+            active_rows = self._grow_blocks(active_rows, num_w * steps)
+            if not active_rows:
+                self._note_fall_through("mixed_mega", "all_rows_preempted")
+                return emitted
+            live = [r for r in active_rows if not r.done and not r.inserting]
+            still = {r.request_id for r in active_rows if r.inserting}
+            if {r.request_id for r in inserting} - still:
+                # growth preempted an inserting row the plan references
+                return self._fall_through("mixed_mega", "inserts_preempted",
+                                          key, emitted)
+
+        c_rows, t_bucket = self.chunk_rows, self.prefill_chunk
+        mb = self.max_blocks_per_seq
+        chunk_ids = np.zeros((num_w, c_rows, t_bucket), np.int32)
+        chunk_pos = np.zeros((num_w, c_rows), np.int32)
+        chunk_qlens = np.ones((num_w, c_rows), np.int32)
+        chunk_bt = np.zeros((num_w, c_rows, mb), np.int32)
+        chunk_sp = np.tile(self._default_sp_row, (num_w, c_rows, 1))
+        chunk_ad = np.zeros((num_w, c_rows), np.int32)
+        chunk_emit = np.zeros((num_w, c_rows), np.int32)
+        slots_l = []
+        for j, window in enumerate(plan):
+            lens = np.zeros((c_rows,), np.int32)
+            for i, (r, wlen, pos0) in enumerate(window):
+                chunk_ids[j, i, :wlen] = r.fed[pos0 : pos0 + wlen]
+                chunk_pos[j, i] = pos0
+                chunk_qlens[j, i] = wlen
+                chunk_bt[j, i] = self.block_table[r.slot]
+                lens[i] = wlen
+                chunk_sp[j, i] = self._slot_sp[r.slot]
+                chunk_ad[j, i] = self.adapter_ids[r.slot]
+                chunk_emit[j, i] = int(pos0 + wlen >= len(r.fed)
+                                       and not r.generated)
+            slots_l.append(block_kvcache.make_chunk_slot_mapping(
+                chunk_bt[j], chunk_pos[j], lens, t_bucket, self.block_size))
+        chunk_slots = np.stack(slots_l)
+
+        valid, budget0, eos_ids = self._carry_replay_state()
+        slot_chunk = self._slot_mapping_fn(
+            self.block_table, self.positions, num_w * steps,
+            self.block_size, valid=valid)
+        greedy = self._chunk_greedy(
+            live + [r for w in plan for (r, _, _) in w])
+        key, sub = jax.random.split(key)
+        with tel.annotate("mixed_megastep"):
+            toks_dev, chunk_toks_dev, self.cache, self._telem_dev = \
+                self._mixed_megastep_step(
+                    self.app.params, jnp.asarray(self.last_tok),
+                    jnp.asarray(self.positions), jnp.asarray(valid),
+                    jnp.asarray(budget0), self.cache, self._telem_dev,
+                    jnp.asarray(self.block_table), jnp.asarray(slot_chunk),
+                    jnp.asarray(chunk_ids), jnp.asarray(chunk_pos),
+                    jnp.asarray(chunk_qlens), jnp.asarray(chunk_bt),
+                    jnp.asarray(chunk_slots), jnp.asarray(chunk_emit),
+                    self._sampling_matrix(), jnp.asarray(chunk_sp), sub,
+                    jnp.asarray(self.adapter_ids), jnp.asarray(chunk_ad),
+                    jnp.asarray(eos_ids), num_windows=num_w,
+                    num_steps=steps, greedy=greedy)
+
+        if live:
+            self._commit(np.asarray(toks_dev), num_w * steps, emitted)
+        chunk_toks = np.asarray(chunk_toks_dev)          # (W, c_rows)
+        for j, window in enumerate(plan):
+            for i, (r, wlen, pos0) in enumerate(window):
+                tel.request_prefill_chunk(r.request_id, wlen, pos0)
+                self._count_class_prefill(r.sla_class, wlen)
+                r.insert_pos = pos0 + wlen
+                if r.insert_pos < len(r.fed):
+                    continue
+                r.inserting = False
+                resumed = bool(r.generated)   # preempted; KV recomputed now
+                r.position = len(r.fed)
+                if not resumed:
+                    tok0 = int(chunk_toks[j, i])
+                    r.generated = [tok0]
+                    emitted.setdefault(r.request_id, []).append(tok0)
+                self.positions[r.slot] = r.position
+                self.last_tok[r.slot] = r.generated[-1]
+                self._maybe_finish(r, emitted)
+        self._m_megastep_iters.inc(num_w)
+        if t_step is not None:
+            extra = self._consume_fall_through() or {}
+            extra["megastep_windows"] = num_w
+            prefill_total = sum(w for win in plan for (_, w, _) in win)
+            tel.step_record(
+                t_step, "mixed_megastep", iterations=num_w * steps,
+                tokens=_emitted_count(emitted) - n_emit0,
+                occupancy=len(live), slots=self.num_slots,
+                prefill_tokens=prefill_total,
+                prefill_budget=self.prefill_budget,
+                kv_free=self.allocator.num_free,
+                kv_total=self.allocator.num_blocks,
+                ici_bytes=self._ici_bytes(num_w * steps, prefill_total),
+                extra=extra)
+        return emitted
+
     @step_loop_body
     def _step_spec(self, key, emitted: Dict[int, List[int]]
                    ) -> Dict[int, List[int]]:
@@ -3151,6 +3571,17 @@ class ContinuousBatchingRunner:
             # KV gaps from this path only dent later acceptance rates, never
             # correctness — the target verifies every token)
             return self._fall_through("spec", "seq_room", key, emitted)
+        if self.megastep_k is not None and self.paged:
+            if self.eagle is None:
+                # device-resident spec megastep (ISSUE-19 leg c): up to
+                # megastep_k fused iterations in ONE while_loop dispatch
+                return self._step_spec_megastep(key, emitted, tel, t_step,
+                                                n_emit0, live, active_rows,
+                                                room)
+            # the eagle chunk threads hidden-state re-injection the
+            # while_loop carry does not model yet — visible degradation,
+            # never a silent one
+            self._note_fall_through("spec_mega", "eagle")
         # an iteration commits >=1 token/row: running past the tightest row's
         # remaining budget only wastes flops. Clamped values quantize to
         # powers of two — num_iters is a static jit arg (see
@@ -3198,6 +3629,32 @@ class ContinuousBatchingRunner:
         outs = np.asarray(outs)           # (iters, slots, K)
         ns = np.asarray(ns)               # (iters, slots)
         self._m_spec_iters.inc(iters)
+        chunk_added, chunk_cells = self._commit_spec_outs(outs, ns, iters,
+                                                          emitted)
+        if t_step is not None:
+            tel.step_record(
+                t_step, "spec_chunk", iterations=iters,
+                tokens=_emitted_count(emitted) - n_emit0,
+                occupancy=len(live), slots=self.num_slots,
+                kv_free=self.allocator.num_free if self.paged else None,
+                kv_total=self.allocator.num_blocks if self.paged else None,
+                accept_mean=(chunk_added / chunk_cells if chunk_cells
+                             else None),
+                ici_bytes=self._ici_bytes(iters),
+                extra=self._consume_fall_through())
+        self._spec_adaptive_check(chunk_added, chunk_cells)
+        return emitted
+
+    def _commit_spec_outs(self, outs: np.ndarray, ns: np.ndarray, iters: int,
+                          emitted: Dict[int, List[int]]):
+        """EXACT host replay of a fused-spec result block: per iteration,
+        per live slot, ``commit_row`` over the accepted ``outs[it, slot,
+        :n+1]`` prefix (budget/eos stops included). One code path commits
+        the step-wise chunk and the megastep ring drain, so the two emitted
+        streams can only differ if the device results differ. Returns
+        ``(chunk_added, chunk_cells)`` for the acceptance metrics/guard."""
+        from .speculation import commit_row
+
         chunk_added = chunk_cells = 0
         for it in range(iters):
             for slot, req in enumerate(self.active):
@@ -3219,17 +3676,12 @@ class ContinuousBatchingRunner:
                 self.last_tok[slot] = req.generated[-1]
                 if done:
                     self._finish(req)
-        if t_step is not None:
-            tel.step_record(
-                t_step, "spec_chunk", iterations=iters,
-                tokens=_emitted_count(emitted) - n_emit0,
-                occupancy=len(live), slots=self.num_slots,
-                kv_free=self.allocator.num_free if self.paged else None,
-                kv_total=self.allocator.num_blocks if self.paged else None,
-                accept_mean=(chunk_added / chunk_cells if chunk_cells
-                             else None),
-                ici_bytes=self._ici_bytes(iters),
-                extra=self._consume_fall_through())
+        return chunk_added, chunk_cells
+
+    def _spec_adaptive_check(self, chunk_added: int, chunk_cells: int) -> None:
+        """Acceptance-floor guard shared by the step-wise and megastep spec
+        paths: below ``spec_min_accept`` committed tokens/row/iteration the
+        runner serves plain chunks until the next re-probe."""
         if (self.spec_adaptive and chunk_cells
                 and chunk_added / chunk_cells < self.spec_min_accept):
             self._spec_off = True
@@ -3239,6 +3691,76 @@ class ContinuousBatchingRunner:
                 "< %.2f — serving plain decode chunks (spec re-probe every "
                 "%d chunks)", chunk_added / chunk_cells,
                 self.spec_min_accept, self.spec_probe_every)
+
+    def _step_spec_megastep(self, key, emitted: Dict[int, List[int]], tel,
+                            t_step, n_emit0: int, live: List[Request],
+                            active_rows: List[Request], room: int
+                            ) -> Dict[int, List[int]]:
+        """One device-resident SPECULATIVE megastep: up to ``megastep_k``
+        fused draft-verify-accept iterations in ONE ``lax.while_loop``
+        dispatch (cb.spec.megastep), synced ONCE, then the exact
+        ``_commit_spec_outs`` replay over the ringed ``(outs, ns)[:n_run]``
+        prefix. The caller (_step_spec) already handled the adaptive guard
+        and the seq-room fall-through; ``room`` >= 1 fused iterations fit.
+
+        Greedy streams are bit-identical to the step-wise chunks (same
+        iteration math via _spec_iter_factory, same commit replay); sampled
+        streams draw per-iteration keys from a megastep-level split exactly
+        like the plain megastep — same distribution, different stream."""
+        self._drain(emitted)
+        n = min(self.megastep_k, room)
+        active_rows = self._reserve_megastep_blocks(active_rows,
+                                                    n * self.k)
+        if not active_rows:
+            return emitted
+        live = [r for r in active_rows if not r.done and not r.inserting]
+        if not live:
+            return emitted
+        alive0, budget0, eos_ids = self._carry_replay_state()
+        coverage = np.zeros((self.num_slots,), np.int32)
+        for slot, r in enumerate(self.active):
+            if r is not None:
+                coverage[slot] = len(r.blocks) * self.block_size
+        service = np.int32(1 if self.queue else 0)
+        greedy = self._chunk_greedy(live)
+        key, sub = jax.random.split(key)
+        with tel.annotate("spec_megastep"):
+            (outs_dev, ns_dev, n_dev, exit_dev), self.cache, self.d_cache, \
+                self._telem_dev = self._spec_megastep_step(
+                    self.app.params, self.draft.params,
+                    jnp.asarray(self.last_tok), jnp.asarray(self.positions),
+                    jnp.asarray(alive0), jnp.asarray(budget0), self.cache,
+                    self.d_cache, self._telem_dev,
+                    jnp.asarray(self.block_table), jnp.asarray(coverage),
+                    self._sampling_matrix(), jnp.asarray(eos_ids), sub,
+                    jnp.asarray(self.adapter_ids), np.int32(n), service,
+                    ring_cap=self.megastep_ring, greedy=greedy)
+        n_run = int(np.asarray(n_dev))
+        code = int(np.asarray(exit_dev))
+        reason = MEGASTEP_EXITS.get(code, str(code))
+        self._count_megastep_exit(reason)
+        self._m_megastep_iters.inc(n_run)
+        self._m_spec_iters.inc(n_run)
+        chunk_added = chunk_cells = 0
+        if n_run:
+            chunk_added, chunk_cells = self._commit_spec_outs(
+                np.asarray(outs_dev)[:n_run], np.asarray(ns_dev)[:n_run],
+                n_run, emitted)
+        if t_step is not None:
+            extra = self._consume_fall_through() or {}
+            extra["megastep_requested"] = n
+            extra["megastep_exit"] = reason
+            tel.step_record(
+                t_step, "spec_megastep", iterations=n_run,
+                tokens=_emitted_count(emitted) - n_emit0,
+                occupancy=len(live), slots=self.num_slots,
+                kv_free=self.allocator.num_free,
+                kv_total=self.allocator.num_blocks,
+                accept_mean=(chunk_added / chunk_cells if chunk_cells
+                             else None),
+                ici_bytes=self._ici_bytes(n_run),
+                extra=extra)
+        self._spec_adaptive_check(chunk_added, chunk_cells)
         return emitted
 
     def drain_requests(self):

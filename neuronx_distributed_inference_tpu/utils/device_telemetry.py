@@ -49,7 +49,10 @@ Counter layout (int32; document any change in docs/OBSERVABILITY.md):
                     tier's block re-admission scatter, serving/kv_tiering.py —
                     / kv_handoff — the pool-to-pool live KV block transfer
                     scatter, serving/pools.py — / megastep — the
-                    device-resident while_loop decode)
+                    device-resident while_loop decode — / spec_megastep — the
+                    while_loop draft-verify-commit chunk loop, ISSUE-19 —
+                    / mixed_megastep — the scanned multi-window mixed
+                    insert+decode step, ISSUE-19)
 ==================  =========================================================
 """
 
@@ -69,7 +72,8 @@ FIELDS = ("tokens", "spec_accepted", "spec_cells", "occupancy", "kv_writes",
           "kv_blocks", "eos", "prefill_tokens", "seed_tokens",
           "megastep_iters")
 KINDS = ("decode", "spec_chunk", "mixed", "insert", "insert_window",
-         "tier_readmit", "kv_handoff", "megastep")
+         "tier_readmit", "kv_handoff", "megastep", "spec_megastep",
+         "mixed_megastep")
 
 IDX_TOKENS = 0
 IDX_SPEC_ACCEPTED = 1
@@ -92,6 +96,8 @@ KIND_INSERT_WINDOW = KINDS.index("insert_window")
 KIND_TIER_READMIT = KINDS.index("tier_readmit")
 KIND_KV_HANDOFF = KINDS.index("kv_handoff")
 KIND_MEGASTEP = KINDS.index("megastep")
+KIND_SPEC_MEGASTEP = KINDS.index("spec_megastep")
+KIND_MIXED_MEGASTEP = KINDS.index("mixed_megastep")
 
 
 def init_carry():

@@ -40,14 +40,23 @@ from neuronx_distributed_inference_tpu.analysis import lint  # noqa: E402
 # builds the eagle3 scope's draft.
 _FILE_SCOPES = {
     "runtime/continuous_batching.py": ["cb_dense", "cb_paged", "cb_mixed",
-                                       "cb_megastep", "cb_spec", "cb_eagle",
-                                       "serving_tier"],
+                                       "cb_megastep", "cb_mixed_megastep",
+                                       "cb_spec", "cb_spec_megastep",
+                                       "cb_eagle", "serving_tier"],
     # ISSUE-10 megastep: the token ring is traced only into the while_loop
     # megastep dispatch; an edit re-audits that scope. block_kvcache's
     # device_slot_advance ALSO feeds the megastep, but block_kvcache stays
     # deliberately unmapped (its write/read helpers trace into every paged
     # dispatch — unmapped fails closed to the full fleet).
-    "ops/token_ring.py": ["cb_megastep"],
+    "ops/token_ring.py": ["cb_megastep", "cb_mixed_megastep",
+                          "cb_spec_megastep"],
+    # ISSUE-19 flash-decode registration: the standalone flash.* entry points
+    # trace only into their own dispatches (the fleet's tiny apps never set
+    # decode_kernel_enabled, so no CB graph imports them at trace time) — an
+    # edit re-audits the flash_decode scope. paged_decode.py stays
+    # deliberately UNMAPPED: its kernels trace into every paged dispatch AND
+    # flash_decode imports its helpers, so it fails closed to the full fleet.
+    "ops/flash_decode.py": ["flash_decode"],
     "runtime/speculation.py": ["spec", "cb_spec", "cb_eagle", "eagle",
                                "eagle3", "medusa"],
     "runtime/eagle.py": ["eagle", "cb_eagle", "eagle3"],
@@ -61,7 +70,8 @@ _FILE_SCOPES = {
     # (metrics/flight_recorder/slo) never enter a graph — lint-only ([]
     # audits nothing, which is exactly their graph footprint).
     "utils/device_telemetry.py": ["cb_dense", "cb_paged", "cb_mixed",
-                                  "cb_megastep", "cb_spec", "cb_eagle",
+                                  "cb_megastep", "cb_mixed_megastep",
+                                  "cb_spec", "cb_spec_megastep", "cb_eagle",
                                   "serving_tier"],
     "utils/metrics.py": [],
     "utils/flight_recorder.py": [],
@@ -115,7 +125,8 @@ _FILE_SCOPES = {
     "analysis/perf_model.py": [],
     "utils/provenance.py": [],
     "serving/kv_tiering.py": ["serving_tier", "cb_paged", "cb_mixed",
-                              "cb_megastep", "cb_spec", "cb_eagle"],
+                              "cb_megastep", "cb_mixed_megastep", "cb_spec",
+                              "cb_spec_megastep", "cb_eagle"],
     # ISSUE-17 disaggregated pools: the PoolManager is host-side handoff
     # orchestration over runner session APIs (handoff_open/receive/commit) —
     # it never enters a graph itself, but it DRIVES the bucketed
@@ -131,8 +142,8 @@ _FILE_SCOPES = {
     # CB fleet on top of moe.
     "ops/moe.py": ["moe"],
     "parallel/overlap.py": ["moe", "cb_dense", "cb_paged", "cb_mixed",
-                            "cb_megastep", "cb_spec", "cb_eagle",
-                            "serving_tier"],
+                            "cb_megastep", "cb_mixed_megastep", "cb_spec",
+                            "cb_spec_megastep", "cb_eagle", "serving_tier"],
 }
 # any other package .py change (application.py, models/modules/ops/parallel/
 # analysis/config/utils/new files) re-runs the whole fleet — see

@@ -160,4 +160,20 @@ if [ "${TUNER_TIER1_TESTS:-0}" -lt 1 ]; then
     echo "ERROR: self-tuning tests are not in the tier-1 marker set" >&2
     [ "$rc" -eq 0 ] && rc=1
 fi
+
+# ISSUE-19 unchanged-semantics guard: the kernel-floor suite (AMLA-vs-
+# multiply closeness matrix + opt-outs, KV-length-split bit-equality and
+# auto-select pins) and the extended megastep file (spec/mixed megastep
+# exactness) must stay collected inside the tier-1 marker set — they are
+# the ONLY fast coverage of the paged decode hot-loop rewrites
+# (test_paged_decode.py is module-level slow).
+KERNELS_TIER1_TESTS=$(env JAX_PLATFORMS=cpu python -m pytest \
+    "$REPO/tests/test_kernel_floor.py" "$REPO/tests/test_megastep.py" \
+    -q -m 'not slow' --collect-only -p no:cacheprovider 2>/dev/null \
+    | grep -ac '::' || true)
+echo "KERNELS_TIER1_TESTS=$KERNELS_TIER1_TESTS"
+if [ "${KERNELS_TIER1_TESTS:-0}" -lt 20 ]; then
+    echo "ERROR: kernel-floor/megastep tests fell out of the tier-1 marker set" >&2
+    [ "$rc" -eq 0 ] && rc=1
+fi
 exit "$rc"

@@ -356,13 +356,22 @@ def test_changed_mode_scope_map_fails_closed():
     # while_loop megastep), so a carry edit re-audits the full CB fleet...
     assert set(mod._scopes_for_changes(
         [pkg + "utils/device_telemetry.py"])) == {
-        "cb_dense", "cb_paged", "cb_mixed", "cb_megastep", "cb_spec",
-        "cb_eagle", "serving_tier"}
-    # ISSUE-10: the token ring is traced only into the megastep dispatch;
-    # any OTHER new ops module still fails closed to the full fleet
-    assert mod._scopes_for_changes([pkg + "ops/token_ring.py"]) == [
-        "cb_megastep"]
+        "cb_dense", "cb_paged", "cb_mixed", "cb_megastep",
+        "cb_mixed_megastep", "cb_spec", "cb_spec_megastep", "cb_eagle",
+        "serving_tier"}
+    # ISSUE-10/-19: the token ring is traced only into the megastep
+    # dispatches (plain + spec + mixed); any OTHER new ops module still
+    # fails closed to the full fleet
+    assert set(mod._scopes_for_changes([pkg + "ops/token_ring.py"])) == {
+        "cb_megastep", "cb_mixed_megastep", "cb_spec_megastep"}
     assert mod._scopes_for_changes([pkg + "ops/ring_buffer2.py"]) is None
+    # ISSUE-19: the standalone flash.* entry points trace only into their
+    # own registered dispatches (no fleet app enables decode_kernel at toy
+    # scale), while paged_decode.py — whose helpers every paged dispatch AND
+    # flash_decode import — stays unmapped and fails closed to the full fleet
+    assert mod._scopes_for_changes([pkg + "ops/flash_decode.py"]) == [
+        "flash_decode"]
+    assert mod._scopes_for_changes([pkg + "ops/paged_decode.py"]) is None
     # ...while the host-side observability modules never enter a graph
     # (lint-only), and an UNMAPPED utils module still fails closed
     assert mod._scopes_for_changes([pkg + "utils/flight_recorder.py"]) == []
@@ -404,16 +413,17 @@ def test_changed_mode_scope_map_fails_closed():
     assert mod._scopes_for_changes([pkg + "utils/provenance.py"]) == []
     assert mod._scopes_for_changes([pkg + "analysis/perf_model2.py"]) is None
     assert set(mod._scopes_for_changes([pkg + "serving/kv_tiering.py"])) == {
-        "serving_tier", "cb_paged", "cb_mixed", "cb_megastep", "cb_spec",
-        "cb_eagle"}
+        "serving_tier", "cb_paged", "cb_mixed", "cb_megastep",
+        "cb_mixed_megastep", "cb_spec", "cb_spec_megastep", "cb_eagle"}
     # ISSUE-16 MoE serving: the grouped kernel / EP ring trace only into
     # MoE-arch graphs -> moe scope; overlap.py also hosts the TP-overlap
     # templates traced into every dense layer -> full CB fleet on top of moe;
     # any OTHER new ops/ or parallel/ file still fails closed
     assert mod._scopes_for_changes([pkg + "ops/moe.py"]) == ["moe"]
     assert set(mod._scopes_for_changes([pkg + "parallel/overlap.py"])) == {
-        "moe", "cb_dense", "cb_paged", "cb_mixed", "cb_megastep", "cb_spec",
-        "cb_eagle", "serving_tier"}
+        "moe", "cb_dense", "cb_paged", "cb_mixed", "cb_megastep",
+        "cb_mixed_megastep", "cb_spec", "cb_spec_megastep", "cb_eagle",
+        "serving_tier"}
     assert mod._scopes_for_changes([pkg + "ops/moe2.py"]) is None
     assert mod._scopes_for_changes([pkg + "parallel/overlap2.py"]) is None
     assert mod._scopes_for_changes(

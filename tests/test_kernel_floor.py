@@ -1,0 +1,309 @@
+"""Tier-1 kernel-floor suite (ISSUE-19): AMLA exponent-add rescaling and the
+in-path flash-decode KV-length split, proven on the CPU interpreter.
+
+Two claims are pinned here, cheap enough to run on every commit (unlike the
+slow-marked matrices in test_paged_decode.py):
+
+* AMLA (`amla=True`, the default) replaces the flash rescale multiply with an
+  exponent-field ADD on an integer max grid.  Against the classic multiply
+  path (`amla=False`) the outputs must agree to ~1 output ulp for float KV
+  caches across every head extra (window / soft-cap / sinks / alibi), and the
+  opt-outs (`amla=False` kwarg, `TPUINF_AMLA=0` env) must reproduce the
+  multiply path bit-for-bit.
+
+* The KV-length split (`kv_splits`) re-shards the same block walk across grid
+  rows and merges raw flash state (m, l, acc) at the end.  When exactly one
+  split owns live KV the merge is an identity — bit-equal to unsplit; when
+  live KV straddles splits the merge changes only the reduction order —
+  tight-close.  `_auto_kv_splits` engages only in the long-context bs=1
+  regime, and `lenpar_stats()` is the trace-time witness the bench refuses on.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import ml_dtypes
+import pytest
+
+from neuronx_distributed_inference_tpu.ops import paged_decode as pd
+from neuronx_distributed_inference_tpu.ops.paged_decode import (
+    _amla_default,
+    _auto_kv_splits,
+    fused_paged_decode_stacked,
+    lenpar_stats,
+    paged_decode_attention_stacked,
+    reset_lenpar_stats,
+)
+
+
+def _case(seed=0, L=2, NB=40, BS=16, Hkv=2, Hq=4, D=64, B=2, MB=6,
+          dtype=jnp.bfloat16, positions=(40, 90), sinks=False, alibi=False):
+    """One attend case over a stacked paged cache; returns (q, caches,
+    positions, block_table, head-extra kwargs)."""
+    rng = np.random.default_rng(seed)
+
+    def draw(shape):
+        if dtype == jnp.int8:
+            return jnp.asarray(rng.integers(-100, 100, size=shape), jnp.int8)
+        x = jnp.asarray(rng.normal(size=shape), jnp.float32)
+        return x.astype(jnp.bfloat16).astype(dtype)
+
+    k_cache, v_cache = draw((L, NB, Hkv, BS, D)), draw((L, NB, Hkv, BS, D))
+    q = jnp.asarray(rng.normal(size=(B, Hq, 1, D)), jnp.float32).astype(
+        jnp.bfloat16)
+    block_table = jnp.asarray(
+        rng.permutation(NB)[: B * MB].reshape(B, MB), jnp.int32)
+    pos = jnp.asarray(np.array(positions, np.int32))
+    sk = (jnp.asarray(rng.normal(size=(Hq,)), jnp.float32) if sinks else None)
+    sl = (jnp.abs(jnp.asarray(rng.normal(size=(Hq,)), jnp.float32))
+          if alibi else None)
+    return q, k_cache, v_cache, pos, block_table, dict(sinks=sk,
+                                                       alibi_slopes=sl)
+
+
+def _f32(x):
+    return np.asarray(x, np.float32)
+
+
+def _assert_ulp_close(got, ref, rel=2.0 ** -6, floor=0.25):
+    """Elementwise |got - ref| <= 2 bf16 ulps of ref: the rescale paths differ
+    by <= 1 ulp in f32, and the final round to bf16 can double the gap (ulp
+    floor at 0.25 so near-zero cancellation noise doesn't demand sub-denormal
+    agreement)."""
+    g, r = _f32(got), _f32(ref)
+    tol = rel * np.maximum(np.abs(r), floor)
+    diff = np.abs(g - r)
+    assert np.all(diff <= tol), (
+        f"max |diff|/tol = {np.max(diff / tol):.3f}, "
+        f"worst diff {diff.max():.3e}")
+
+
+# ---------------------------------------------------------------------------
+# AMLA exponent-add rescaling vs the classic multiply rescale
+# ---------------------------------------------------------------------------
+
+
+_FEATURES = {
+    "plain": {},
+    "window": dict(window=48),
+    "soft_cap": dict(soft_cap=30.0),
+    "sinks": dict(sinks=True),
+    "alibi": dict(alibi=True),
+}
+
+
+@pytest.mark.parametrize("dtype", ["bfloat16", "int8", "float8_e4m3fn"])
+@pytest.mark.parametrize("feature", sorted(_FEATURES))
+def test_amla_matches_multiply_rescale(dtype, feature):
+    """AMLA vs multiply closeness matrix: the integer-grid max costs < 1 bit
+    of headroom on p, so float caches agree to ~1 output ulp.  int8 caches
+    quantize p in-kernel (1/127 steps) at slightly different flash-update
+    points — bound those at 2% of the output scale."""
+    fkw = dict(_FEATURES[feature])
+    case_kw = {}
+    for flag in ("sinks", "alibi"):
+        if fkw.pop(flag, False):
+            case_kw[flag] = True
+    q, kc, vc, pos, bt, extras = _case(dtype=jnp.dtype(dtype), **case_kw)
+    kw = dict(fkw, **extras, interpret=True)
+    out_amla = paged_decode_attention_stacked(
+        q, kc, vc, pos, 1, bt, amla=True, **kw)
+    out_mul = paged_decode_attention_stacked(
+        q, kc, vc, pos, 1, bt, amla=False, **kw)
+    if dtype == "int8":
+        scale = max(1.0, float(np.abs(_f32(out_mul)).max()))
+        np.testing.assert_allclose(_f32(out_amla), _f32(out_mul),
+                                   atol=0.02 * scale)
+    elif feature == "alibi":
+        # the ALiBi positional bias inflates score magnitudes, so the
+        # integer-grid max sits up to a full unit above the true max —
+        # p loses one extra bit of headroom vs the other features
+        _assert_ulp_close(out_amla, out_mul, rel=2.0 ** -5)
+    else:
+        _assert_ulp_close(out_amla, out_mul)
+
+
+def test_amla_default_and_env_opt_out(monkeypatch):
+    """amla=None resolves through TPUINF_AMLA: default on (bit-equal to
+    amla=True), env "0" off (bit-equal to amla=False)."""
+    q, kc, vc, pos, bt, _ = _case()
+    monkeypatch.delenv("TPUINF_AMLA", raising=False)
+    assert _amla_default() is True
+    on = paged_decode_attention_stacked(q, kc, vc, pos, 1, bt, interpret=True)
+    on_explicit = paged_decode_attention_stacked(
+        q, kc, vc, pos, 1, bt, amla=True, interpret=True)
+    np.testing.assert_array_equal(_f32(on), _f32(on_explicit))
+
+    monkeypatch.setenv("TPUINF_AMLA", "0")
+    assert _amla_default() is False
+    off = paged_decode_attention_stacked(q, kc, vc, pos, 1, bt, interpret=True)
+    off_explicit = paged_decode_attention_stacked(
+        q, kc, vc, pos, 1, bt, amla=False, interpret=True)
+    np.testing.assert_array_equal(_f32(off), _f32(off_explicit))
+
+
+def test_amla_fused_path_matches_multiply():
+    """The fused append+attend kernel carries the same AMLA accumulate; the
+    cache write is rescale-independent (bit-equal either way)."""
+    rng = np.random.default_rng(3)
+    q, kc, vc, pos, bt, _ = _case(B=2, positions=(40, 90))
+    B, Hkv, D, BS = 2, 2, 64, 16
+    new_k = jnp.asarray(rng.normal(size=(B, Hkv, 1, D)), jnp.float32).astype(
+        jnp.bfloat16)
+    new_v = jnp.asarray(rng.normal(size=(B, Hkv, 1, D)), jnp.float32).astype(
+        jnp.bfloat16)
+    slots = np.zeros((B, 1), np.int32)
+    for b, p in enumerate(np.asarray(pos)):
+        slots[b, 0] = int(bt[b, p // BS]) * BS + p % BS
+    sm = jnp.asarray(slots)
+    o_a, kc_a, vc_a = fused_paged_decode_stacked(
+        q, new_k, new_v, kc, vc, pos, sm, 1, bt, amla=True, interpret=True)
+    o_m, kc_m, vc_m = fused_paged_decode_stacked(
+        q, new_k, new_v, kc, vc, pos, sm, 1, bt, amla=False, interpret=True)
+    assert jnp.array_equal(kc_a, kc_m) and jnp.array_equal(vc_a, vc_m)
+    _assert_ulp_close(o_a, o_m)
+
+
+# ---------------------------------------------------------------------------
+# KV-length split: bit-equality, straddles, window start blocks, auto-select
+# ---------------------------------------------------------------------------
+
+
+def _long_case(**over):
+    """bs=1 long-context geometry (the regime the split targets)."""
+    kw = dict(B=1, MB=32, NB=40, positions=(500,))
+    kw.update(over)
+    return _case(**kw)
+
+
+@pytest.mark.parametrize("splits", [2, 4, 8])
+def test_lenpar_split_matches_unsplit(splits):
+    """Live KV straddling every split: the merge re-orders the flash
+    reduction only — tight-close to the unsplit walk."""
+    q, kc, vc, pos, bt, _ = _long_case()
+    ref = paged_decode_attention_stacked(
+        q, kc, vc, pos, 1, bt, kv_splits=1, interpret=True)
+    got = paged_decode_attention_stacked(
+        q, kc, vc, pos, 1, bt, kv_splits=splits, interpret=True)
+    _assert_ulp_close(got, ref)
+
+
+def test_lenpar_single_live_split_bit_equal():
+    """All live KV inside split 0 (pos 100 of a 512-slot row, 4 splits):
+    the cross-split merge must be an identity — bit-equal to unsplit."""
+    q, kc, vc, pos, bt, _ = _long_case(positions=(100,))
+    ref = paged_decode_attention_stacked(
+        q, kc, vc, pos, 1, bt, kv_splits=1, interpret=True)
+    got = paged_decode_attention_stacked(
+        q, kc, vc, pos, 1, bt, kv_splits=4, interpret=True)
+    np.testing.assert_array_equal(_f32(got), _f32(ref))
+
+
+def test_lenpar_sliding_window_start_blocks():
+    """A sliding window whose start lands mid-table kills the early splits
+    entirely (their blocks are all pre-window): the merge must drop them and
+    the windowed output must match the unsplit windowed walk."""
+    q, kc, vc, pos, bt, _ = _long_case(positions=(500,))
+    for window in (64, 200):
+        ref = paged_decode_attention_stacked(
+            q, kc, vc, pos, 1, bt, window=window, kv_splits=1, interpret=True)
+        got = paged_decode_attention_stacked(
+            q, kc, vc, pos, 1, bt, window=window, kv_splits=4, interpret=True)
+        if window == 64:
+            # window [437, 500] lives in blocks 27..31: split 3 of 4 alone
+            np.testing.assert_array_equal(_f32(got), _f32(ref))
+        else:
+            _assert_ulp_close(got, ref)
+
+
+def test_lenpar_fused_split_matches_unsplit():
+    """The fused append+attend under kv_splits: caches bit-identical (the
+    write path is split-independent), outputs tight-close."""
+    rng = np.random.default_rng(5)
+    q, kc, vc, pos, bt, _ = _long_case()
+    Hkv, D, BS = 2, 64, 16
+    new_k = jnp.asarray(rng.normal(size=(1, Hkv, 1, D)), jnp.float32).astype(
+        jnp.bfloat16)
+    new_v = jnp.asarray(rng.normal(size=(1, Hkv, 1, D)), jnp.float32).astype(
+        jnp.bfloat16)
+    p = int(pos[0])
+    sm = jnp.asarray([[int(bt[0, p // BS]) * BS + p % BS]], jnp.int32)
+    o1, kc1, vc1 = fused_paged_decode_stacked(
+        q, new_k, new_v, kc, vc, pos, sm, 1, bt, kv_splits=1, interpret=True)
+    o4, kc4, vc4 = fused_paged_decode_stacked(
+        q, new_k, new_v, kc, vc, pos, sm, 1, bt, kv_splits=4, interpret=True)
+    assert jnp.array_equal(kc1, kc4) and jnp.array_equal(vc1, vc4)
+    _assert_ulp_close(o4, o1)
+
+
+def test_lenpar_split_requires_variant2():
+    q, kc, vc, pos, bt, _ = _long_case()
+    with pytest.raises(ValueError, match="variant=2"):
+        paged_decode_attention_stacked(
+            q, kc, vc, pos, 1, bt, kv_splits=2, variant=3, interpret=True)
+
+
+def test_auto_kv_splits_pins(monkeypatch):
+    """The auto heuristic engages only for plain chain decode (t == 1) with
+    <= 4 row/head units and >= 8 block groups per split."""
+    monkeypatch.delenv("TPUINF_LENPAR", raising=False)
+    assert _auto_kv_splits(1, 2, 64, 1) == 8
+    assert _auto_kv_splits(1, 2, 32, 1) == 4
+    assert _auto_kv_splits(1, 2, 16, 1) == 2
+    assert _auto_kv_splits(2, 2, 32, 1) == 4   # b*hkv == 4: still tiny
+    assert _auto_kv_splits(1, 2, 8, 1) == 1    # table too short
+    assert _auto_kv_splits(4, 2, 32, 1) == 1   # enough grid rows already
+    assert _auto_kv_splits(1, 2, 32, 2) == 1   # not plain chain decode
+    monkeypatch.setenv("TPUINF_LENPAR", "0")
+    assert _auto_kv_splits(1, 2, 64, 1) == 1   # trace-time opt-out
+
+
+def test_lenpar_stats_witness(monkeypatch):
+    """`lenpar_stats()` is the bench honesty witness: it must record every
+    wrapper call, flag split traces, and mark auto engagement."""
+    monkeypatch.delenv("TPUINF_LENPAR", raising=False)
+    q, kc, vc, pos, bt, _ = _long_case()
+    reset_lenpar_stats()
+    assert lenpar_stats() == {"traces": 0, "split_traces": 0,
+                              "auto_engaged": 0, "last_splits": 1}
+    paged_decode_attention_stacked(
+        q, kc, vc, pos, 1, bt, kv_splits=1, interpret=True)
+    s = lenpar_stats()
+    assert s["traces"] == 1 and s["split_traces"] == 0
+    assert s["last_splits"] == 1
+
+    paged_decode_attention_stacked(
+        q, kc, vc, pos, 1, bt, kv_splits=4, interpret=True)
+    s = lenpar_stats()
+    assert s["traces"] == 2 and s["split_traces"] == 1
+    assert s["last_splits"] == 4 and s["auto_engaged"] == 0
+
+    # auto path: bs=1, Hkv=2, MB=32 chain decode engages without the kwarg
+    paged_decode_attention_stacked(q, kc, vc, pos, 1, bt, interpret=True)
+    s = lenpar_stats()
+    assert s["traces"] == 3 and s["split_traces"] == 2
+    assert s["auto_engaged"] == 1 and s["last_splits"] == 4
+
+    # env opt-out silences the auto path; last_splits records the most
+    # recent SPLIT trace, so it keeps the previous value
+    monkeypatch.setenv("TPUINF_LENPAR", "0")
+    paged_decode_attention_stacked(q, kc, vc, pos, 1, bt, interpret=True)
+    s = lenpar_stats()
+    assert s["traces"] == 4 and s["split_traces"] == 2
+    assert s["last_splits"] == 4
+    reset_lenpar_stats()
+
+
+def test_lenpar_auto_output_matches_unsplit(monkeypatch):
+    """The auto-engaged split (no kwarg) is the same kernel as explicit
+    kv_splits — and tight-close to the forced-unsplit walk."""
+    monkeypatch.delenv("TPUINF_LENPAR", raising=False)
+    q, kc, vc, pos, bt, _ = _long_case()
+    auto = paged_decode_attention_stacked(q, kc, vc, pos, 1, bt,
+                                          interpret=True)
+    forced = paged_decode_attention_stacked(
+        q, kc, vc, pos, 1, bt, kv_splits=4, interpret=True)
+    np.testing.assert_array_equal(_f32(auto), _f32(forced))
+    ref = paged_decode_attention_stacked(
+        q, kc, vc, pos, 1, bt, kv_splits=1, interpret=True)
+    _assert_ulp_close(auto, ref)

@@ -74,7 +74,12 @@ def _device_matches_host(runner):
     if runner.megastep_k is not None:
         m = s["megastep"]
         assert d["megastep_iters"] == m["inner_steps"]
-        assert d["steps"].get("megastep", 0) == m["dispatches"]
+        # exits (and so "dispatches") cover BOTH while_loop flavors — plain
+        # decode megasteps and spec draft-verify megasteps (ISSUE-19); the
+        # scanned mixed megastep has no early exit and stays outside
+        mega_disp = (d["steps"].get("megastep", 0)
+                     + d["steps"].get("spec_megastep", 0))
+        assert mega_disp == m["dispatches"]
         assert sum(m["exits"].values()) == m["dispatches"]
     return s, d
 
@@ -210,9 +215,10 @@ def test_megastep_sampled_exactness_aligned(tiny_llama_hf_config, prompts):
 
 
 def test_megastep_spec_composition(tiny_llama_hf_config, app, prompts):
-    """Spec serving + megastep: the near-boundary plain fall-through runs
-    device megasteps (visible in the fall-through counter and the device
-    step counts), tokens identical to the same spec config without it."""
+    """Spec serving + megastep: away from the seq boundary the chunks run as
+    device spec megasteps (ISSUE-19); near the boundary the ONE guarded
+    seq-room fall-through runs plain decode megasteps — both visible in the
+    counters, tokens identical to the same spec config without any of it."""
     draft_hf = dict(tiny_llama_hf_config, hidden_size=32,
                     intermediate_size=64, num_hidden_layers=1,
                     num_attention_heads=2, num_key_value_heads=2)
@@ -229,7 +235,7 @@ def test_megastep_spec_composition(tiny_llama_hf_config, app, prompts):
     out = runner.run_to_completion()[rid2]
     assert out == ref_out
     s, d = _device_matches_host(runner)
-    assert d["steps"].get("spec_chunk", 0) > 0
+    assert d["steps"].get("spec_megastep", 0) > 0
     assert d["steps"].get("megastep", 0) > 0
     ft = runner.telemetry.registry.get(
         "serving_fallthrough_total",
@@ -264,6 +270,229 @@ def test_megastep_mixed_fall_through_recorded(tiny_llama_hf_config, prompts):
         "serving_fallthrough_total",
         labels={"from": "mixed", "reason": "no_insert_in_flight"})
     assert c is not None and c.value > 0
+
+
+# ---------------------------------------------------------------- ISSUE-19 --
+# megastep-everything: the while_loop spec draft-verify megastep and the
+# scanned mixed insert+decode megastep must stay BIT-IDENTICAL to their
+# step-wise references, with every degradation visible (fall-through
+# counters, exit reasons), never silent.
+@pytest.fixture(scope="module")
+def draft(tiny_llama_hf_config):
+    draft_hf = dict(tiny_llama_hf_config, hidden_size=32,
+                    intermediate_size=64, num_hidden_layers=1,
+                    num_attention_heads=2, num_key_value_heads=2)
+    return _make_app(draft_hf)
+
+
+@pytest.fixture(scope="module")
+def spec_base(app, draft, prompts):
+    """Step-wise spec reference: tokens + the acceptance histogram the
+    megastep must reproduce exactly (same iteration math, same commit)."""
+    runner = ContinuousBatchingRunner(app, draft=draft, speculation_length=4,
+                                      spec_chunk=2)
+    rids = [runner.submit(p, max_new_tokens=12) for p in prompts]
+    res = runner.run_to_completion()
+    return ([res[r] for r in rids], runner.acceptance_counts.tolist())
+
+
+@pytest.mark.parametrize("k", [1, 4, 16])
+def test_spec_megastep_matrix_exactness(app, draft, prompts, spec_base, k):
+    """megastep_k sweep: bit-identical tokens AND acceptance histogram vs the
+    step-wise spec path, all chunks carried by the while_loop (no silent
+    step-wise spec_chunk dispatch), device counters exact."""
+    runner = ContinuousBatchingRunner(app, draft=draft, speculation_length=4,
+                                      spec_chunk=2, megastep_k=k,
+                                      telemetry=True)
+    rids = [runner.submit(p, max_new_tokens=12) for p in prompts]
+    res = runner.run_to_completion()
+    assert [res[r] for r in rids] == spec_base[0], f"K={k}"
+    assert runner.acceptance_counts.tolist() == spec_base[1]
+    s, d = _device_matches_host(runner)
+    assert d["steps"].get("spec_megastep", 0) > 0
+    assert d["steps"].get("spec_chunk", 0) == 0
+    assert d["steps"].get("decode", 0) == 0
+
+
+def test_spec_megastep_mid_chunk_eos(app, draft, prompts, spec_base):
+    """An eos landing mid-window stops the row via the in-graph commit_row
+    replay: truncated tokens identical to step-wise, ``stopped`` exit."""
+    eos = int(spec_base[0][0][5])
+    ref = ContinuousBatchingRunner(app, draft=draft, speculation_length=4,
+                                   spec_chunk=2)
+    rid = ref.submit(prompts[0], max_new_tokens=12, eos_token_id=eos)
+    want = ref.run_to_completion()[rid]
+    runner = ContinuousBatchingRunner(app, draft=draft, speculation_length=4,
+                                      spec_chunk=2, megastep_k=8,
+                                      telemetry=True)
+    rid2 = runner.submit(prompts[0], max_new_tokens=12, eos_token_id=eos)
+    out = runner.run_to_completion()[rid2]
+    assert out == want
+    s, d = _device_matches_host(runner)
+    assert d["eos"] == 1
+    assert s["megastep"]["exits"].get("stopped", 0) >= 1
+
+
+def test_spec_megastep_ring_wrap_service(app, draft, prompts, spec_base):
+    """megastep_ring < megastep_k: the acceptance ring fills, the loop exits
+    ``ring``, the host drains the ring and re-dispatches — bit-identical."""
+    runner = ContinuousBatchingRunner(app, draft=draft, speculation_length=4,
+                                      spec_chunk=2, megastep_k=16,
+                                      megastep_ring=2, telemetry=True)
+    rids = [runner.submit(p, max_new_tokens=12) for p in prompts]
+    res = runner.run_to_completion()
+    assert [res[r] for r in rids] == spec_base[0]
+    s, _ = _device_matches_host(runner)
+    assert s["megastep"]["exits"].get("ring", 0) >= 1
+    for rec in runner.telemetry.steps:
+        if rec["kind"] == "spec_megastep":
+            assert rec["iterations"] <= 2
+
+
+def test_spec_megastep_block_coverage_exit_resume(tiny_llama_hf_config,
+                                                  prompts, draft):
+    """Preempt-free pressure handling INSIDE the loop: with the free list
+    squeezed, the best-effort reservation covers fewer than K windows, the
+    loop exits ``blocks`` at the coverage edge, and serving resumes exactly
+    once blocks free up — tokens identical to the unconstrained reference."""
+    app = _make_app(tiny_llama_hf_config)
+    # small K (16-token reservations per dispatch) + a long run: later
+    # dispatches must re-reserve under pressure instead of coasting on the
+    # first dispatch's headroom
+    max_new = 64
+    ref = ContinuousBatchingRunner(app, draft=draft, speculation_length=4,
+                                   spec_chunk=2)
+    ref_ids = [ref.submit(p, max_new_tokens=max_new) for p in prompts]
+    ref_out = ref.run_to_completion()
+    runner = ContinuousBatchingRunner(app, draft=draft, speculation_length=4,
+                                      spec_chunk=2, megastep_k=4,
+                                      telemetry=True)
+    rids = [runner.submit(p, max_new_tokens=max_new) for p in prompts]
+    runner.step()                  # place prompts + first spec megastep
+    bs = runner.block_size
+    n_hold = runner.allocator.num_free - 1
+    assert n_hold > 0
+    filler = np.arange(1000, 1000 + n_hold * bs - 1).astype(np.int32) % 251
+    held, _ = runner.allocator.allocate_for_prompt(filler)
+    assert runner.allocator.num_free == 1
+    # dispatches under pressure coast on the previous reservation's headroom
+    # first, then hit the coverage edge -> in-graph ``blocks`` exit
+    for _ in range(8):
+        runner.step()
+        if runner.stats()["megastep"]["exits"].get("blocks", 0):
+            break
+    s = runner.stats()
+    assert s["megastep"]["exits"].get("blocks", 0) >= 1, s["megastep"]
+    runner.allocator.free_sequence(held)
+    res = runner.run_to_completion()
+    assert [res[r] for r in rids] == [ref_out[r] for r in ref_ids]
+    _device_matches_host(runner)
+    assert runner.num_preemptions == 0
+
+
+def test_spec_megastep_arrival_service(app, draft, prompts, spec_base):
+    """Queued work that cannot place sets the in-graph service flag: the spec
+    megastep yields after ONE window so insert latency is bounded by the
+    chunk, and the queued request's tokens still land bit-identically."""
+    ref = ContinuousBatchingRunner(app, draft=draft, speculation_length=4,
+                                   spec_chunk=2)
+    ref_ids = [ref.submit(p, max_new_tokens=12)
+               for p in [*prompts, prompts[0]]]
+    ref_out = ref.run_to_completion()
+    runner = ContinuousBatchingRunner(app, draft=draft, speculation_length=4,
+                                      spec_chunk=2, megastep_k=16,
+                                      telemetry=True)
+    rids = [runner.submit(p, max_new_tokens=12)
+            for p in [*prompts, prompts[0]]]
+    res = runner.run_to_completion()
+    assert [res[r] for r in rids] == [ref_out[r] for r in ref_ids]
+    s, _ = _device_matches_host(runner)
+    assert s["megastep"]["exits"].get("arrival", 0) >= 1
+
+
+def test_spec_megastep_eagle_fall_through(tiny_llama_hf_config, app, prompts):
+    """Eagle spec + megastep_k: the eagle chunk threads hidden-state
+    re-injection the while_loop carry does not model — the guarded
+    fall-through counts the reason and serves step-wise, bit-identically."""
+    from neuronx_distributed_inference_tpu.models import eagle as eagle_lib
+    from neuronx_distributed_inference_tpu.runtime.eagle import (
+        draft_args_from_target)
+
+    import jax
+
+    d_args = draft_args_from_target(app.arch_args, num_layers=1)
+    d_params = eagle_lib.init_eagle_params(
+        d_args, jax.random.PRNGKey(3), dtype=app.tpu_config.jax_dtype,
+        inv_freq=app.inv_freq_from_config(app.config))
+    ref = ContinuousBatchingRunner(app, eagle_draft=(d_args, d_params),
+                                   speculation_length=3)
+    rid = ref.submit(prompts[0], max_new_tokens=12)
+    want = ref.run_to_completion()[rid]
+    runner = ContinuousBatchingRunner(app, eagle_draft=(d_args, d_params),
+                                      speculation_length=3, megastep_k=4,
+                                      telemetry=True)
+    rid2 = runner.submit(prompts[0], max_new_tokens=12)
+    out = runner.run_to_completion()[rid2]
+    assert out == want
+    ft = runner.telemetry.registry.get(
+        "serving_fallthrough_total",
+        labels={"from": "spec_mega", "reason": "eagle"})
+    assert ft is not None and ft.value > 0
+
+
+@pytest.fixture(scope="module")
+def mixed_prompts():
+    """A >chunk prompt: the multi-window plan needs >= 2 insert windows in
+    flight (a 40-token prompt under prefill_chunk=16 gives three)."""
+    rng = np.random.default_rng(7)
+    return [rng.integers(1, 256, size=(n,)).astype(np.int32)
+            for n in (12, 40)]
+
+
+@pytest.fixture(scope="module")
+def mixed_base(app, mixed_prompts):
+    """Step-wise mixed (chunked-prefill) reference tokens."""
+    runner = ContinuousBatchingRunner(app, decode_chunk=4, prefill_chunk=16)
+    rids = [runner.submit(p, max_new_tokens=12) for p in mixed_prompts]
+    res = runner.run_to_completion()
+    return [res[r] for r in rids]
+
+
+@pytest.mark.parametrize("k", [4, 16])
+def test_mixed_megastep_exactness(app, mixed_prompts, mixed_base, k):
+    """Multi-window mixed megastep: whole insert windows + decode steps
+    batched into one scanned dispatch, tokens bit-identical to the step-wise
+    mixed scheduler, and the scan actually carried windows (mixed_megastep
+    steps in the device carry)."""
+    runner = ContinuousBatchingRunner(app, decode_chunk=4, prefill_chunk=16,
+                                      megastep_k=k, telemetry=True)
+    rids = [runner.submit(p, max_new_tokens=12) for p in mixed_prompts]
+    res = runner.run_to_completion()
+    assert [res[r] for r in rids] == mixed_base, f"K={k}"
+    s, d = _device_matches_host(runner)
+    assert d["steps"].get("mixed_megastep", 0) > 0
+
+
+def test_mixed_megastep_pending_arrival_fall_through(app, mixed_prompts,
+                                                     mixed_base):
+    """A queued request at dispatch time falls through visibly (the megastep
+    cannot admit mid-scan) and the step-wise path serves it — tokens
+    bit-identical to the fully step-wise reference."""
+    ref = ContinuousBatchingRunner(app, decode_chunk=4, prefill_chunk=16)
+    ref_ids = [ref.submit(p, max_new_tokens=12)
+               for p in [*mixed_prompts, mixed_prompts[0]]]
+    ref_out = ref.run_to_completion()
+    runner = ContinuousBatchingRunner(app, decode_chunk=4, prefill_chunk=16,
+                                      megastep_k=4, telemetry=True)
+    rids = [runner.submit(p, max_new_tokens=12)
+            for p in [*mixed_prompts, mixed_prompts[0]]]
+    res = runner.run_to_completion()
+    assert [res[r] for r in rids] == [ref_out[r] for r in ref_ids]
+    ft = runner.telemetry.registry.get(
+        "serving_fallthrough_total",
+        labels={"from": "mixed_mega", "reason": "pending_arrival"})
+    assert ft is not None and ft.value > 0
+    _device_matches_host(runner)
 
 
 def test_megastep_validation(tiny_llama_hf_config, app):

@@ -187,6 +187,37 @@ def test_megastep_one_executable_bytes_k_invariant():
                   "megastep_bytes_k_invariant", "megastep_one_weights_pass")
 
 
+def test_amla_rescale_zero_extra_hbm():
+    """The ISSUE-19 leg a canary: AMLA exponent-add rescaling is compute-only
+    — toggling TPUINF_AMLA must leave the compiled decode-step traffic
+    byte-identical in both directions (0.1% bound). An AMLA variant that
+    spills rescale scratch to HBM trips this immediately. (Wrapper: ``amla``
+    canary group.)"""
+    _assert_rules(_group_report("amla"),
+                  "amla_zero_extra_hbm", "amla_zero_hbm_savings")
+
+
+def test_lenpar_split_bytes_invariant_one_kv_pass():
+    """The ISSUE-19 leg b canary: the in-path KV-length split re-shards the
+    same block walk across grid rows, so engaging it (bs=1, 32-wide table —
+    a 4-way auto split) must not move compiled bytes by more than 2% vs the
+    TPUINF_LENPAR=0 control, and the split step stays within the fused
+    one-KV-pass absolute budget. (Wrapper: ``lenpar`` canary group.)"""
+    _assert_rules(_group_report("lenpar"),
+                  "lenpar_split_byte_invariant", "lenpar_one_kv_pass")
+
+
+def test_spec_megastep_one_executable_bytes_k_invariant():
+    """The ISSUE-19 leg c canary: the SPECULATIVE serving megastep is ONE
+    executable — a 4x emitted-acceptance ring sweep (the only K-shaped
+    static) must move compiled bytes by <2%, and the whole dispatch stays
+    within 32x one (target+draft) weights+pools pass. (Wrapper:
+    ``spec_megastep`` canary group.)"""
+    _assert_rules(_group_report("spec_megastep"),
+                  "spec_megastep_bytes_k_invariant",
+                  "spec_megastep_one_weights_pass")
+
+
 def test_tp_decode_collective_schedule_pinned():
     """The PR-5 multichip canary: the tp>1 decode step's collective schedule
     is pinned per layer and its ICI bytes are table/batch-shape-invariant.
