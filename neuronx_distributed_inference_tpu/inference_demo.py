@@ -153,6 +153,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "on prefix hits")
     g.add_argument("--kv-tier-blocks", type=int, default=1024, metavar="N",
                    help="host-RAM tier capacity in KV blocks (default 1024)")
+    g.add_argument("--cluster-kv-blocks", type=int, default=0, metavar="N",
+                   help="with --kv-host-tier: attach a fleet-wide "
+                        "content-addressed cluster KV store of N blocks "
+                        "(serving/cluster_kv.py) under per-replica host "
+                        "tiers — spilled prefixes dedup by content hash and "
+                        "serve cross-replica through the audited readmit "
+                        "scatter (0 = off)")
     g.add_argument("--pool-split", default=None, metavar="P:D",
                    help="with --serve --replicas N: disaggregate the fleet "
                         "into P prefill-pool + D decode-pool replicas "
@@ -667,7 +674,7 @@ def _run_serving(args, app, tokenizer) -> None:
     the prefix-affinity router, optionally with the host-RAM KV tier."""
     from .runtime.continuous_batching import ContinuousBatchingRunner
 
-    if args.replicas > 1 or args.kv_host_tier:
+    if args.replicas > 1 or args.kv_host_tier or args.cluster_kv_blocks:
         return _run_serving_routed(args, app, tokenizer)
     if args.inject_faults:
         raise SystemExit("--inject-faults requires the routed serving path "
@@ -784,7 +791,11 @@ def _run_serving_routed(args, app, tokenizer) -> None:
     replicas — independent continuous-batching runners sharing the loaded
     weights — behind the prefix-affinity router, with an optional host-RAM
     KV tier SHARED by the replicas (the store is content-addressed, so a
-    prefix spilled by one replica re-admits on any of them)."""
+    prefix spilled by one replica re-admits on any of them). With
+    --cluster-kv-blocks the tiers are instead PER replica over one shared
+    content-addressed ClusterKVStore (serving/cluster_kv.py): the fleet
+    rung dedups spilled prefixes by content hash and serves them
+    cross-replica through the audited readmit scatter."""
     from .runtime.continuous_batching import ContinuousBatchingRunner
     from .serving import EngineReplica, HostKVTier, PrefixAffinityRouter
 
@@ -810,8 +821,23 @@ def _run_serving_routed(args, app, tokenizer) -> None:
     telemetry_on = bool(args.metrics_out or args.trace_out or args.events_out
                         or args.stats_interval or args.slo
                         or args.debug_bundle)
-    tier = (HostKVTier(capacity_blocks=args.kv_tier_blocks)
-            if args.kv_host_tier else None)
+    cluster = None
+    if args.cluster_kv_blocks:
+        if not args.kv_host_tier:
+            raise SystemExit("--cluster-kv-blocks requires --kv-host-tier "
+                             "(the host tier is the publisher/puller)")
+        from .serving import ClusterKVStore
+
+        cluster = ClusterKVStore(capacity_blocks=args.cluster_kv_blocks)
+        # per-replica tiers over the shared fleet store: ownership (and the
+        # death-reconciliation path) is per replica, dedup is fleet-wide
+        tiers = [HostKVTier(capacity_blocks=args.kv_tier_blocks,
+                            cluster=cluster, owner=f"rep{i}")
+                 for i in range(args.replicas)]
+    else:
+        tier = (HostKVTier(capacity_blocks=args.kv_tier_blocks)
+                if args.kv_host_tier else None)
+        tiers = [tier] * args.replicas
     pool_roles = None
     if args.pool_split:
         # disaggregated pools (serving/pools.py): P prefill + D decode
@@ -826,13 +852,18 @@ def _run_serving_routed(args, app, tokenizer) -> None:
                              f"--replicas {args.replicas}")
         if not app.tpu_config.paged_attention_enabled:
             raise SystemExit("--pool-split requires --paged-attention")
-        if args.handoff_channel == "tier" and tier is None:
+        if args.handoff_channel == "tier" and not args.kv_host_tier:
             raise SystemExit("--handoff-channel tier requires --kv-host-tier")
+        if args.handoff_channel == "tier" and cluster is not None:
+            raise SystemExit("--handoff-channel tier moves blocks through "
+                             "ONE shared host tier; with --cluster-kv-blocks "
+                             "the tiers are per-replica — use the 'device' "
+                             "channel")
         pool_roles = ["prefill"] * n_pre + ["decode"] * n_dec
     replicas = [
         EngineReplica(str(i),
-                      lambda tel: ContinuousBatchingRunner(
-                          app, telemetry=tel, kv_tier=tier, **kw),
+                      lambda tel, t=tiers[i]: ContinuousBatchingRunner(
+                          app, telemetry=tel, kv_tier=t, **kw),
                       telemetry_enabled=telemetry_on,
                       pool_role=(pool_roles[i] if pool_roles else "unified"),
                       # one JSONL spool per replica (events interleave
@@ -855,11 +886,15 @@ def _run_serving_routed(args, app, tokenizer) -> None:
         debug_bundle_dir=(os.path.dirname(args.debug_bundle) or "."
                           if args.debug_bundle else None))
     logger.info("routed serving: %d replicas, pools: %s, kv host tier: %s, "
-                "faults: %s, sla: %s",
+                "cluster kv: %s, faults: %s, sla: %s",
                 args.replicas,
                 (f"{args.pool_split} via {args.handoff_channel}"
                  if pool_roles else "off"),
-                f"{args.kv_tier_blocks} blocks" if tier else "off",
+                (f"{args.kv_tier_blocks} blocks"
+                 + ("/replica" if cluster is not None else "")
+                 if args.kv_host_tier else "off"),
+                (f"{args.cluster_kv_blocks} blocks"
+                 if cluster is not None else "off"),
                 args.inject_faults or "off",
                 sla_classes if sla_classes is not None else "off")
 

@@ -1329,6 +1329,26 @@ def _fused_append_attend_kernel(pos_ref, lidx_ref, slots_ref, bt_ref, q_ref,
         pltpu.make_async_copy(wv, dst_v, wsem.at[1]).wait()
 
 
+# Process-wide prefetch-depth override (serving/knobs.py `prefetch_depth`).
+# Resolved in the NON-jitted wrapper below so the value rides the jit cache
+# as a static argname: setting it mints a new executable on the next trace;
+# dispatches already traced keep their depth (schedule-only, never a stream
+# change). None = the per-dtype VMEM-budget auto policy in the impl.
+_PREFETCH_DEPTH_OVERRIDE: Optional[int] = None
+
+
+def set_prefetch_depth(depth: Optional[int]) -> None:
+    """Set (or with ``None`` clear) the process-wide prefetch-depth override
+    for `fused_paged_decode_stacked` callers that do not pass one
+    explicitly. Takes effect on the next (re)trace of a calling step."""
+    global _PREFETCH_DEPTH_OVERRIDE
+    _PREFETCH_DEPTH_OVERRIDE = None if not depth else int(depth)
+
+
+def get_prefetch_depth() -> Optional[int]:
+    return _PREFETCH_DEPTH_OVERRIDE
+
+
 def fused_paged_decode_stacked(
     q: jnp.ndarray,              # (B, Hq, T, D), T <= 8 (1 or speculation width)
     new_k: jnp.ndarray,          # (B, Hkv, T, D), already in cache dtype
@@ -1356,6 +1376,8 @@ def fused_paged_decode_stacked(
     b, hq, t, d = q.shape
     hkv = k_cache.shape[2]
     mb = block_table.shape[1]
+    if prefetch_depth is None:
+        prefetch_depth = _PREFETCH_DEPTH_OVERRIDE
     amla_r = _amla_default() if amla is None else bool(amla)
     ks = kv_splits if kv_splits is not None else _auto_kv_splits(b, hkv, mb, t)
     _LENPAR_STATS["traces"] += 1

@@ -325,6 +325,10 @@ class ContinuousBatchingRunner:
         self.async_depth = max(1, int(
             async_depth if async_depth is not None
             else getattr(cfg, "async_depth", None) or 2))
+        # fused paged-decode DMA pipeline depth; 0 = the kernel's per-dtype
+        # VMEM-budget auto policy (ops/paged_decode.py). Schedule-only: a
+        # change re-jits the next traced step, never a stream.
+        self.prefetch_depth = 0
         self._chunk_times: List[float] = []
         # _round_trip_s lives on the registry gauge (back-compat property below)
         # FIFO of in-flight chunks [(toks_dev (slots, steps), steps)] plus the
@@ -718,6 +722,14 @@ class ContinuousBatchingRunner:
             self._spec_off = False
             self._spec_plain_chunks = 0
 
+    def _apply_prefetch_depth(self, v) -> None:
+        self.prefetch_depth = int(v)
+        from ..ops.paged_decode import set_prefetch_depth
+
+        # 0 clears to the kernel's auto policy; applies to dispatches traced
+        # AFTER the change (the static argname keys the jit cache)
+        set_prefetch_depth(self.prefetch_depth or None)
+
     _KNOB_APPLIERS = {
         "async_depth": _apply_async_depth,
         "megastep_k": _apply_megastep_k,
@@ -726,6 +738,7 @@ class ContinuousBatchingRunner:
         "mixed_decode_steps": _apply_mixed_decode_steps,
         "spec_chunk": _apply_spec_chunk,
         "spec_adaptive": _apply_spec_adaptive,
+        "prefetch_depth": _apply_prefetch_depth,
     }
 
     # ------------------------------------------------------------------ jitted steps
@@ -1810,6 +1823,14 @@ class ContinuousBatchingRunner:
                 # the scatter is enqueued: the blocks' KV is authoritative
                 # on device again (readmit_inflight -> live)
                 self.ledger.readmit_committed(ids)
+            # cluster pulls ride the same dispatch; commit releases the
+            # store-side pin (local _HostBlocks have no commit — no-op)
+            n_cluster = 0
+            for _blk, _h, host_blk in chunk:
+                commit = getattr(host_blk, "commit", None)
+                if commit is not None:
+                    commit()
+                    n_cluster += 1
             if t0 is not None:
                 tel.step_record(
                     t0, "tier_readmit", iterations=1,
@@ -1817,7 +1838,9 @@ class ContinuousBatchingRunner:
                     slots=self.num_slots,
                     kv_free=self.allocator.num_free,
                     kv_total=self.allocator.num_blocks,
-                    request_id=for_request)
+                    request_id=for_request,
+                    extra=({"cluster_blocks": n_cluster}
+                           if n_cluster else None))
 
     def _bytes_per_block(self) -> int:
         """Per-block KV bytes across the pool arrays (block axis 1) — the

@@ -15,6 +15,11 @@ mesh cannot be millions of users"):
 - ``kv_tiering``: :class:`HostKVTier` — a host-RAM tier for cold paged KV
   blocks (evict least-recently-attended committed blocks to host buffers,
   re-admit bit-identically on prefix hit), extending KV capacity past HBM.
+- ``cluster_kv``: :class:`ClusterKVStore` — the fleet rung under the host
+  tier: a content-hash-keyed, refcounted, dedup'd cluster block store with
+  a transport seam (in-process / multi-host DCN), so a prefix computed on
+  one replica serves fleet-wide without re-prefill and fleet KV bytes
+  scale with unique content instead of traffic.
 - ``faults``: :class:`FaultInjector` — deterministic, seeded fault
   injection over the seams above (dispatch exceptions, wedged dispatches,
   hard replica death, allocation failure, host-tier corruption), so the
@@ -70,6 +75,8 @@ from .engine import EngineReplica
 from .memledger import BlockLedger, MemLedgerViolation
 from .faults import (FaultInjector, FaultSpec, InjectedFault,
                      InjectedReplicaDeath)
+from .cluster_kv import (ClusterKVStore, ClusterTransport,
+                         DistributedKVTransport, InProcessTransport)
 from .knobs import FleetKnobs, Knob, KnobRegistry
 from .kv_tiering import HostKVTier
 from .pools import POOL_DECODE, POOL_PREFILL, POOL_UNIFIED, PoolManager
@@ -90,4 +97,6 @@ __all__ = ["EngineReplica", "HostKVTier", "PrefixAffinityRouter",
            "MemLedgerViolation", "PoolManager", "POOL_PREFILL", "POOL_DECODE",
            "POOL_UNIFIED", "Knob", "KnobRegistry", "FleetKnobs",
            "ServingTuner", "TunerRule", "default_rules", "Arrival",
-           "ArrivalTrace", "ReplayResult", "reconstruct_trace", "replay"]
+           "ArrivalTrace", "ReplayResult", "reconstruct_trace", "replay",
+           "ClusterKVStore", "ClusterTransport", "InProcessTransport",
+           "DistributedKVTransport"]

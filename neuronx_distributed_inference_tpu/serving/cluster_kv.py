@@ -1,0 +1,498 @@
+"""Fleet-wide content-addressed KV block store (ROADMAP open item 4).
+
+Millions of users mostly share prompts — system prompts, templates, few-shot
+prefixes, repeated attachments — but a committed prefix is only warm on the
+replica (or that replica's :class:`~.kv_tiering.HostKVTier`) that computed
+it, so fleet KV capacity scales with *traffic* instead of with *unique
+content*. This module promotes the host tier to a cluster service:
+
+- :class:`ClusterKVStore` — a DCN-addressable block store keyed by the SAME
+  chained content hashes the allocator's prefix cache uses, with the SAME
+  shape+crc32 checksum contract :class:`~.kv_tiering._HostBlock` stamps at
+  spill time. Replicas PUBLISH spilled blocks into it (``HostKVTier.spill``
+  does so automatically when a cluster is attached); publication dedups by
+  content hash — the same hash published twice stores ONCE, with per-owner
+  refcounts — so fleet KV bytes scale with unique content.
+- **Lookup ladder** — the prefix walk
+  (:meth:`~.kv_tiering.TieredBlockAllocator.allocate_for_prompt`) and the
+  router's affinity probe (:meth:`~.engine.EngineReplica.prefix_residency`)
+  both see three rungs: device prefix cache (live/idle) → local host tier →
+  cluster store. A COLD replica can serve a fleet-warm prompt without
+  re-prefilling the shared blocks.
+- **Pulls** — :meth:`ClusterKVStore.reserve` verifies the content checksum
+  AT RESERVATION (the PR 10 degradation contract: a corrupt entry is
+  dropped + counted and the tokens re-prefill, never read garbage KV),
+  PINS the entry against LRU eviction for the pull's lifetime, and returns
+  a :class:`_ClusterPull` handle that rides the existing audited
+  ``cb.paged.tier_readmit`` scatter — no new graph kinds, the same bucketed
+  dispatch, issued before the requesting prompt's first insert window so
+  the restore overlaps earlier requests' insert windows exactly like the
+  pool handoff staging (serving/pools.py).
+- **Ownership / leak model** — every entry records WHO published it
+  (per-owner refcounts) and every in-flight pull is tracked against its
+  puller. :meth:`ClusterKVStore.audit` verifies pins == outstanding pulls,
+  owner refcounts, and unpinned occupancy within capacity; the memledger's
+  conservation audit (serving/memledger.py) merges these violations, and a
+  pull still outstanding at a quiescent audit point is a LEAKED PIN
+  attributed to its owner. ``on_owner_death`` reconciles a dead replica:
+  its publish refs drop and its outstanding pulls abort (the pinned bytes
+  unpin; nothing leaks, nothing is lost — entries it published remain
+  valid, because content-addressed bytes don't die with their publisher).
+- **Transport seam** — byte storage hides behind :class:`ClusterTransport`:
+  :class:`InProcessTransport` (default) keeps arrays in-process for
+  single-host fleets and tests; :class:`DistributedKVTransport` moves the
+  bytes over the multi-host launcher's gloo/DCN coordinator channel
+  (runtime/launcher.py — ``jax.distributed`` key-value store), making the
+  store addressable across hosts without changing any caller.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .kv_tiering import _HostBlock
+
+logger = logging.getLogger("tpu-inference")
+
+__all__ = ["ClusterKVStore", "ClusterTransport", "InProcessTransport",
+           "DistributedKVTransport"]
+
+
+# ------------------------------------------------------------------ transport
+class ClusterTransport:
+    """Byte-storage seam of the cluster store: the DIRECTORY (hashes,
+    checksums, refcounts, pins, LRU) always lives in :class:`ClusterKVStore`;
+    the BYTES live behind this interface. ``put``/``get``/``delete``/
+    ``contains`` speak ``(key: bytes, k: np.ndarray, v: np.ndarray)``."""
+
+    def put(self, key: bytes, k: np.ndarray, v: np.ndarray) -> None:
+        raise NotImplementedError
+
+    def get(self, key: bytes) -> Tuple[np.ndarray, np.ndarray]:
+        raise NotImplementedError
+
+    def delete(self, key: bytes) -> None:
+        raise NotImplementedError
+
+    def contains(self, key: bytes) -> bool:
+        raise NotImplementedError
+
+
+class InProcessTransport(ClusterTransport):
+    """Single-host transport: arrays held in-process. ``put`` COPIES — the
+    store's bytes must not alias a publisher's host-tier entry (the fault
+    injector mutates tier entries in place; a shared buffer would corrupt
+    both stores through one write)."""
+
+    def __init__(self):
+        self._data: Dict[bytes, Tuple[np.ndarray, np.ndarray]] = {}
+
+    def put(self, key, k, v):
+        self._data[key] = (np.ascontiguousarray(k).copy(),
+                           np.ascontiguousarray(v).copy())
+
+    def get(self, key):
+        return self._data[key]
+
+    def delete(self, key):
+        self._data.pop(key, None)
+
+    def contains(self, key):
+        return key in self._data
+
+
+class DistributedKVTransport(ClusterTransport):
+    """Multi-host transport over the launcher's coordinator channel
+    (runtime/launcher.py ``initialize_multihost`` → ``jax.distributed``):
+    blocks serialize into the coordinator's key-value store, so every
+    process in the fleet resolves the same key-space over DCN. Requires an
+    initialized ``jax.distributed`` client — constructing one without it
+    raises, pointing at the launcher (single-host callers simply keep the
+    in-process default)."""
+
+    def __init__(self, prefix: str = "ckv"):
+        from jax._src import distributed
+
+        client = distributed.global_state.client
+        if client is None:
+            raise RuntimeError(
+                "DistributedKVTransport needs an initialized jax.distributed "
+                "client — launch through runtime/launcher.py "
+                "(initialize_multihost / init_from_env) first, or use the "
+                "default in-process transport on a single host")
+        self._client = client
+        self._prefix = prefix
+        # key presence tracked locally: the coordinator KV store has no
+        # cheap existence probe, and the directory (ClusterKVStore) is the
+        # authority on membership anyway
+        self._known: set = set()
+
+    def _key(self, key: bytes) -> str:
+        return f"{self._prefix}/{key.hex()}"
+
+    @staticmethod
+    def _pack(k: np.ndarray, v: np.ndarray) -> str:
+        import base64
+        import io
+
+        buf = io.BytesIO()
+        np.savez(buf, k=np.ascontiguousarray(k), v=np.ascontiguousarray(v))
+        return base64.b64encode(buf.getvalue()).decode("ascii")
+
+    @staticmethod
+    def _unpack(payload: str) -> Tuple[np.ndarray, np.ndarray]:
+        import base64
+        import io
+
+        with np.load(io.BytesIO(base64.b64decode(payload))) as z:
+            return z["k"], z["v"]
+
+    def put(self, key, k, v):
+        self._client.key_value_set(self._key(key), self._pack(k, v))
+        self._known.add(key)
+
+    def get(self, key):
+        payload = self._client.blocking_key_value_get(self._key(key),
+                                                      60_000)
+        return self._unpack(payload)
+
+    def delete(self, key):
+        # the coordinator store has no delete; the directory drop is what
+        # makes the entry unreachable (the orphaned payload ages out with
+        # the coordinator)
+        self._known.discard(key)
+
+    def contains(self, key):
+        return key in self._known
+
+
+# ------------------------------------------------------------------ entries
+class _ClusterEntry:
+    """Directory record of one published block: checksum + shape contract,
+    LRU stamp, per-owner publish refcounts, and the pin count that holds it
+    against eviction while pulls are in flight. The BYTES live behind the
+    transport."""
+
+    __slots__ = ("checksum", "stamp", "owners", "pins", "nbytes")
+
+    def __init__(self, checksum: int, stamp: int, owner: str, nbytes: int):
+        self.checksum = checksum
+        self.stamp = stamp
+        self.owners: Dict[str, int] = {owner: 1}
+        self.pins = 0
+        self.nbytes = nbytes
+
+
+class _ClusterPull:
+    """One in-flight cluster→device pull: the bytes (fetched + checksum-
+    verified at reservation), pinned at the store until ``commit`` (the
+    readmit scatter landed) or ``abort`` (allocation rollback / dead-replica
+    reconciliation). API-compatible with the slice of ``_HostBlock`` the
+    readmit dispatch uses (``materialize``), plus ``abort`` — which is how
+    ``HostKVTier.restore`` tells a cluster pull from a host reservation."""
+
+    __slots__ = ("_store", "pull_id", "hash", "_np", "_done")
+
+    def __init__(self, store: "ClusterKVStore", pull_id: int, h: bytes,
+                 k: np.ndarray, v: np.ndarray):
+        self._store = store
+        self.pull_id = pull_id
+        self.hash = h
+        self._np = (k, v)
+        self._done = False
+
+    def materialize(self) -> Tuple[np.ndarray, np.ndarray]:
+        return self._np
+
+    def nbytes(self) -> int:
+        return self._np[0].nbytes + self._np[1].nbytes
+
+    def commit(self) -> None:
+        """The readmit scatter is enqueued: unpin, count the restored
+        blocks/bytes."""
+        if not self._done:
+            self._done = True
+            self._store._finish_pull(self.pull_id, committed=True)
+
+    def abort(self) -> None:
+        """Allocation rollback or recovery: unpin without counting a
+        restore (idempotent — recovery may race a rollback)."""
+        if not self._done:
+            self._done = True
+            self._store._finish_pull(self.pull_id, committed=False)
+
+
+# -------------------------------------------------------------------- store
+class ClusterKVStore:
+    """The fleet's content-addressed KV block store: dedup by hash,
+    capacity-bounded LRU with pin-for-in-flight-pull, per-owner ownership
+    accounting, and a transport seam for the bytes.
+
+    One store instance is SHARED by every replica of the fleet (in-process)
+    or mirrored per-process over :class:`DistributedKVTransport`. Replicas
+    attach through ``HostKVTier(cluster=...)`` — the tier publishes on
+    spill and reserves pulls during the allocator's prefix walk; nothing
+    else in the serving stack talks to the store directly."""
+
+    def __init__(self, capacity_blocks: int = 4096,
+                 transport: Optional[ClusterTransport] = None,
+                 name: str = "cluster0"):
+        if capacity_blocks < 0:
+            raise ValueError("capacity_blocks must be >= 0")
+        self.capacity_blocks = capacity_blocks
+        self.name = name
+        self.transport = transport if transport is not None \
+            else InProcessTransport()
+        self.entries: Dict[bytes, _ClusterEntry] = {}
+        # replicas publish/pull concurrently (each serving loop is its own
+        # thread in a threaded frontend): directory mutations serialize here
+        self._lock = threading.RLock()
+        self._clock = 0
+        self._pull_seq = itertools.count()
+        # in-flight pulls: pull_id -> (hash, owner) — the leak roster
+        self._outstanding: Dict[int, Tuple[bytes, str]] = {}
+        # counters (plain ints; bench / router stats surface them)
+        self.published_total = 0       # publish() calls (all, dup included)
+        self.published_unique = 0      # entries actually stored (first copy)
+        self.dedup_hits = 0            # publishes deduped against a stored copy
+        self.pulls_total = 0           # reservations granted
+        self.cross_replica_pulls = 0   # pulls by a non-publisher owner
+        self.pull_blocks_committed = 0  # pulls whose readmit scatter landed
+        self.pull_aborts = 0           # pulls rolled back / written off
+        self.bytes_pulled = 0          # committed pull bytes
+        self.evictions = 0             # LRU drops past capacity
+        self.integrity_failures = 0    # entries dropped on checksum mismatch
+        self.watermark = 0             # peak directory occupancy (blocks)
+
+    # ------------------------------------------------------------- directory
+    def _tick(self) -> int:
+        self._clock += 1
+        return self._clock
+
+    def __contains__(self, h: bytes) -> bool:
+        return h in self.entries
+
+    def blocks(self) -> int:
+        return len(self.entries)
+
+    def bytes_stored(self) -> int:
+        return sum(e.nbytes for e in self.entries.values())
+
+    def dedup_ratio(self) -> Optional[float]:
+        """unique / total published blocks — < 1.0 is the fleet-dedup win
+        (None until anything was published)."""
+        if self.published_total == 0:
+            return None
+        return self.published_unique / self.published_total
+
+    # ------------------------------------------------------------ publish side
+    def publish(self, h: bytes, host_blk: _HostBlock, owner: str) -> bool:
+        """Publish one spilled block under its content hash. Dedup: a hash
+        already stored takes a refcount for ``owner`` and stores NOTHING
+        (the fleet-dedup win the bench's ``cluster_dedup_ratio`` measures).
+        Returns True when this call stored the first copy."""
+        with self._lock:
+            return self._publish_locked(h, host_blk, owner)
+
+    def _publish_locked(self, h: bytes, host_blk: _HostBlock,
+                        owner: str) -> bool:
+        self.published_total += 1
+        ent = self.entries.get(h)
+        if ent is not None:
+            self.dedup_hits += 1
+            ent.owners[owner] = ent.owners.get(owner, 0) + 1
+            ent.stamp = self._tick()
+            return False
+        if self.capacity_blocks == 0:
+            return False
+        k, v = host_blk.materialize()
+        checksum = host_blk.checksum
+        if checksum is None:                       # defensive: stamp now
+            checksum = _HostBlock._digest(k, v)
+        self.transport.put(h, k, v)
+        self.entries[h] = _ClusterEntry(checksum, self._tick(), owner,
+                                        k.nbytes + v.nbytes)
+        self.published_unique += 1
+        self.watermark = max(self.watermark, len(self.entries))
+        self._enforce_capacity()
+        return True
+
+    def _enforce_capacity(self) -> None:
+        """LRU past capacity — PINNED entries (in-flight pulls) never evict;
+        a fully-pinned over-capacity store carries the overage until the
+        pulls finish."""
+        while len(self.entries) > self.capacity_blocks:
+            unpinned = [h for h, e in self.entries.items() if e.pins == 0]
+            if not unpinned:
+                return
+            h = min(unpinned, key=lambda x: self.entries[x].stamp)
+            del self.entries[h]
+            self.transport.delete(h)
+            self.evictions += 1
+
+    # -------------------------------------------------------------- pull side
+    def reserve(self, h: bytes, owner: str) -> Optional[_ClusterPull]:
+        """Reserve one block for a cluster→device pull: fetch through the
+        transport, VERIFY the content checksum (the reservation-time
+        integrity gate — same contract as ``HostKVTier.reserve``), pin the
+        entry against LRU eviction, and hand back the pull. ``None`` on a
+        miss or on verification failure — the corrupt entry is DROPPED and
+        counted, and the caller treats the hash as a miss (the tokens
+        re-prefill; garbage KV is never readmitted)."""
+        with self._lock:
+            return self._reserve_locked(h, owner)
+
+    def _reserve_locked(self, h: bytes, owner: str) -> Optional["_ClusterPull"]:
+        ent = self.entries.get(h)
+        if ent is None:
+            return None
+        try:
+            k, v = self.transport.get(h)
+            ok = _HostBlock._digest(k, v) == ent.checksum
+        # lint: ok(silent-except): a torn/truncated payload can make the digest itself throw (shape gone) — that IS a failed verification
+        except Exception:
+            ok = False
+        if not ok:
+            self.integrity_failures += 1
+            del self.entries[h]
+            self.transport.delete(h)
+            logger.warning(
+                "cluster KV entry %s failed its content checksum — dropped; "
+                "the prefix re-prefills instead of pulling corrupt bytes",
+                h.hex()[:16])
+            return None
+        ent.pins += 1
+        ent.stamp = self._tick()
+        pull_id = next(self._pull_seq)
+        self._outstanding[pull_id] = (h, owner)
+        self.pulls_total += 1
+        if owner not in ent.owners:
+            # the content was computed (and published) elsewhere: this is
+            # the cross-replica hit the whole store exists for
+            self.cross_replica_pulls += 1
+        return _ClusterPull(self, pull_id, h, k, v)
+
+    def _finish_pull(self, pull_id: int, committed: bool) -> None:
+        with self._lock:
+            h, _owner = self._outstanding.pop(pull_id)
+            ent = self.entries.get(h)
+            if ent is not None and ent.pins > 0:
+                ent.pins -= 1
+            if committed:
+                self.pull_blocks_committed += 1
+                if ent is not None:
+                    self.bytes_pulled += ent.nbytes
+            else:
+                self.pull_aborts += 1
+            self._enforce_capacity()
+
+    def outstanding_pulls(self, owner: Optional[str] = None) -> int:
+        if owner is None:
+            return len(self._outstanding)
+        return sum(1 for _h, o in self._outstanding.values() if o == owner)
+
+    # ------------------------------------------------------------- ownership
+    def on_owner_death(self, owner: str) -> Dict[str, int]:
+        """Reconcile a dead replica (serving/router.recover_replica): its
+        publish refs drop (entries it alone published REMAIN — content-
+        addressed bytes are replica-invariant and stay servable; they just
+        become unowned LRU candidates) and its outstanding pulls abort so
+        their pins release. Returns ``{"refs_dropped": n, "pulls_aborted":
+        m}`` for the recovery log."""
+        with self._lock:
+            return self._on_owner_death_locked(owner)
+
+    def _on_owner_death_locked(self, owner: str) -> Dict[str, int]:
+        refs = 0
+        for ent in self.entries.values():
+            refs += ent.owners.pop(owner, 0)
+        aborted = 0
+        for pid in [p for p, (_h, o) in self._outstanding.items()
+                    if o == owner]:
+            self._finish_pull(pid, committed=False)
+            aborted += 1
+        if refs or aborted:
+            logger.warning(
+                "cluster store %s reconciled dead owner %s: %d publish "
+                "ref(s) dropped, %d in-flight pull(s) aborted (published "
+                "entries remain servable)", self.name, owner, refs, aborted)
+        return {"refs_dropped": refs, "pulls_aborted": aborted}
+
+    # ----------------------------------------------------------------- audit
+    def audit(self, owner: Optional[str] = None,
+              check_inflight: bool = True) -> List[dict]:
+        """Ownership/conservation invariants, as memledger-shaped violation
+        dicts (the BlockLedger audit merges them):
+
+        - every entry's pin count equals the outstanding pulls naming it
+          (a mismatch is a lost ``commit``/``abort`` — a pin leak);
+        - owner refcounts are positive;
+        - unpinned occupancy is within capacity (pinned overage is legal);
+        - every directory entry's bytes are reachable through the transport;
+        - with ``check_inflight``, no pull is outstanding for ``owner``
+          (or for anyone, when ``owner`` is None) — a quiescent audit point
+          seeing one means somebody took bytes and never finished."""
+        v: List[dict] = []
+        pins_by_hash: Dict[bytes, int] = {}
+        for h, _o in self._outstanding.values():
+            pins_by_hash[h] = pins_by_hash.get(h, 0) + 1
+        for h, ent in self.entries.items():
+            if ent.pins != pins_by_hash.get(h, 0):
+                v.append({"kind": "cluster_pin_mismatch", "detail":
+                          f"entry {h.hex()[:12]}: pins {ent.pins} != "
+                          f"{pins_by_hash.get(h, 0)} outstanding pull(s) — "
+                          f"a commit/abort was dropped"})
+            for o, n in ent.owners.items():
+                if n <= 0:
+                    v.append({"kind": "cluster_owner_refs", "detail":
+                              f"entry {h.hex()[:12]}: owner {o} holds "
+                              f"non-positive refcount {n}"})
+            if not self.transport.contains(h):
+                v.append({"kind": "cluster_bytes_missing", "detail":
+                          f"entry {h.hex()[:12]} has no bytes behind the "
+                          f"transport"})
+        unpinned = sum(1 for e in self.entries.values() if e.pins == 0)
+        pinned = len(self.entries) - unpinned
+        if len(self.entries) > self.capacity_blocks and unpinned > max(
+                0, self.capacity_blocks - pinned):
+            v.append({"kind": "cluster_over_capacity", "detail":
+                      f"{len(self.entries)} entries ({pinned} pinned) over "
+                      f"capacity {self.capacity_blocks} with evictable "
+                      f"candidates — LRU enforcement was skipped"})
+        if check_inflight:
+            stuck = [(p, h, o) for p, (h, o) in self._outstanding.items()
+                     if owner is None or o == owner]
+            for pid, h, o in stuck[:8]:
+                v.append({"kind": "cluster_pull_stuck", "seam": o, "detail":
+                          f"pull {pid} of {h.hex()[:12]} by owner {o} "
+                          f"outstanding at a quiescent audit point — a "
+                          f"leaked pin"})
+        return v
+
+    # ----------------------------------------------------------------- stats
+    def stats(self) -> Dict[str, object]:
+        return {
+            "name": self.name,
+            "capacity_blocks": self.capacity_blocks,
+            "blocks": len(self.entries),
+            "bytes": self.bytes_stored(),
+            "watermark": self.watermark,
+            "published_total": self.published_total,
+            "published_unique": self.published_unique,
+            "dedup_hits": self.dedup_hits,
+            "dedup_ratio": self.dedup_ratio(),
+            "pulls_total": self.pulls_total,
+            "cross_replica_pulls": self.cross_replica_pulls,
+            "pull_blocks_committed": self.pull_blocks_committed,
+            "pull_aborts": self.pull_aborts,
+            "bytes_pulled": self.bytes_pulled,
+            "outstanding_pulls": len(self._outstanding),
+            "evictions": self.evictions,
+            "integrity_failures": self.integrity_failures,
+            "transport": type(self.transport).__name__,
+        }

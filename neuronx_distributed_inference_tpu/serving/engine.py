@@ -195,24 +195,37 @@ class EngineReplica:
         return True
 
     # ------------------------------------------------------------- affinity
-    def resident_prefix_blocks(self, hashes: List[bytes]) -> int:
-        """Leading blocks of the hash chain resident on THIS replica: the
-        device prefix cache (live or idle) first, then the host tier (a hit
-        there re-admits, which still beats recompute)."""
+    def prefix_residency(self, hashes: List[bytes]) -> tuple:
+        """Leading-block residency BREAKDOWN ``(device, host, cluster)`` down
+        the lookup ladder: device prefix cache (live or idle), this
+        replica's host tier (a hit re-admits), then the fleet's cluster
+        store (serving/cluster_kv.py — a hit pulls, which still beats
+        recompute). The cluster rung is what lets a COLD replica score
+        nonzero affinity for a fleet-warm prompt, so placement load-balances
+        it instead of re-prefilling."""
         r = self.runner
         if not r.paged:
-            return 0
+            return (0, 0, 0)
         alloc = r.allocator
         tier = r.kv_tier
-        n = 0
+        dev = host = cluster = 0
         for h in hashes:
             if h in getattr(alloc, "hash_to_block", {}):
-                n += 1
+                dev += 1
             elif tier is not None and h in tier:
-                n += 1
+                host += 1
+            elif tier is not None and getattr(tier, "cluster_has",
+                                              lambda _h: False)(h):
+                cluster += 1
             else:
                 break
-        return n
+        return (dev, host, cluster)
+
+    def resident_prefix_blocks(self, hashes: List[bytes]) -> int:
+        """Leading blocks of the hash chain this replica can serve without
+        re-prefill (device + host tier + cluster store) — the router's
+        placement score."""
+        return sum(self.prefix_residency(hashes))
 
     # ------------------------------------------------------------- serving
     def submit(self, prompt, **kw) -> int:

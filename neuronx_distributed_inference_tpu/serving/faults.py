@@ -114,11 +114,18 @@ class FaultSpec:
     burst_prompt: int = 64
     burst_new: int = 16
     burst_class: Optional[str] = None
+    # ``corrupt``/``truncate`` target: the replica's host tier ("tier",
+    # default) or the fleet's cluster KV store ("cluster") — the latter
+    # exercises the pull-side verify in serving/cluster_kv.py
+    store: str = "tier"
 
     def __post_init__(self):
         if self.kind not in FAULT_KINDS:
             raise ValueError(f"unknown fault kind {self.kind!r} "
                              f"(known: {FAULT_KINDS})")
+        if self.store not in ("tier", "cluster"):
+            raise ValueError(f"unknown fault store {self.store!r} "
+                             f"(known: tier, cluster)")
         if self.burst < 1 or self.burst_prompt < 1 or self.burst_new < 1:
             raise ValueError("burst/burst_prompt/burst_new must be >= 1")
         if self.at_step is not None and self.every_n is not None:
@@ -156,13 +163,13 @@ class FaultSpec:
                 kw[k] = v.lower() in ("1", "true", "yes")
             elif k == "stall_ms":
                 kw[k] = float(v)
-            elif k == "burst_class":
+            elif k in ("burst_class", "store"):
                 kw[k] = v
             else:
                 raise ValueError(f"unknown fault spec key {k!r} "
                                  f"(known: at_step, every_n, once, stall_ms, "
                                  f"burst, burst_prompt, burst_new, "
-                                 f"burst_class)")
+                                 f"burst_class, store)")
         return cls(**kw)
 
 
@@ -320,7 +327,8 @@ class FaultInjector:
         self._spec_fired.setdefault(i, set()).add(rid)
         kind = spec.kind
         if kind in ("corrupt", "truncate"):
-            n = self._corrupt_tier(rep, truncate=(kind == "truncate"))
+            n = self._corrupt_tier(rep, truncate=(kind == "truncate"),
+                                   store=spec.store)
             if n:
                 self._count(kind, rid, n)
             else:
@@ -419,12 +427,17 @@ class FaultInjector:
                        submitted + shed, cls, plen, shed)
         return submitted + shed
 
-    def _corrupt_tier(self, rep, truncate: bool) -> int:
+    def _corrupt_tier(self, rep, truncate: bool, store: str = "tier") -> int:
         """Mutate one seeded-random host-tier entry's bytes in place (the
         checksum stays what spill stamped, so the readmit verify MUST trip).
-        Returns entries mutated (0 when the replica has no tier entries —
-        the schedule was mis-aimed; counted as not-fired so bench's
+        ``store="cluster"`` targets the fleet store behind the replica's
+        tier instead — bytes rewrite through the transport so the
+        PULL-side verify (``ClusterKVStore.reserve``) trips. Returns
+        entries mutated (0 when the replica has no tier entries — the
+        schedule was mis-aimed; counted as not-fired so bench's
         ``faults_invalid`` honesty marker can see it)."""
+        if store == "cluster":
+            return self._corrupt_cluster(rep, truncate)
         tier = getattr(rep.runner, "kv_tier", None)
         if tier is None or not tier.store:
             logger.warning("corrupt/truncate fault found no host-tier "
@@ -443,6 +456,28 @@ class FaultInjector:
             kk = np.ascontiguousarray(k).copy()
             kk.view(np.uint8).reshape(-1)[0] ^= 0xFF
             blk._np = (kk, v)
+        return 1
+
+    def _corrupt_cluster(self, rep, truncate: bool) -> int:
+        """Mutate one seeded-random CLUSTER entry's bytes through the
+        transport (the directory checksum stays what publish stamped, so
+        ``reserve``'s verify trips → drop + re-prefill)."""
+        tier = getattr(rep.runner, "kv_tier", None)
+        cl = getattr(tier, "cluster", None) if tier is not None else None
+        if cl is None or not cl.entries:
+            logger.warning("corrupt/truncate fault (store=cluster) found no "
+                           "cluster entries behind replica %s — nothing "
+                           "mutated", rep.replica_id)
+            return 0
+        h = sorted(cl.entries)[int(self._rng.integers(len(cl.entries)))]
+        k, v = cl.transport.get(h)
+        if truncate:
+            flat = np.ascontiguousarray(k).reshape(-1)
+            cl.transport.put(h, flat[: max(1, flat.size // 2)].copy(), v)
+        else:
+            kk = np.ascontiguousarray(k).copy()
+            kk.view(np.uint8).reshape(-1)[0] ^= 0xFF
+            cl.transport.put(h, kk, v)
         return 1
 
     def _count(self, kind: str, rid: str, n: int = 1) -> None:

@@ -378,6 +378,13 @@ def build_runner_knobs(runner) -> KnobRegistry:
             "spec_adaptive", get=lambda: runner.spec_adaptive,
             set=lambda v: mk("spec_adaptive", v), kind=bool,
             doc="acceptance-floor adaptive fallback to plain decode")
+    if runner.paged:
+        reg.register(
+            "prefetch_depth", get=lambda: runner.prefetch_depth,
+            set=lambda v: mk("prefetch_depth", v), lo=0, hi=16, step=2,
+            doc="fused paged-decode DMA pipeline depth; 0 = per-dtype "
+                "VMEM-budget auto (applies to dispatches traced after the "
+                "change — retrace per value, never a stream change)")
     return reg
 
 

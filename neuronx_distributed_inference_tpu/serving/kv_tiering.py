@@ -23,6 +23,14 @@ builds its streaming on), so KV capacity does not have to end at HBM:
   cache donated/aliased, telemetry carry threaded) BEFORE the request's first
   insert window, so the windows' queries see the restored KV through the
   block table exactly as if it had never left the device.
+- **Cluster rung** (serving/cluster_kv.py): with a
+  :class:`~.cluster_kv.ClusterKVStore` attached (``HostKVTier(cluster=)``)
+  the tier PUBLISHES every spilled block fleet-wide (dedup by content hash)
+  and the prefix walk gains a third rung after the local-store miss —
+  device → host tier → cluster. A cluster hit reserves a checksum-verified
+  PULL that rides the very same pending-readmit queue and
+  ``cb.paged.tier_readmit`` scatter, so a cold replica restores a
+  fleet-warm prefix without re-prefill and without any new graph kind.
 
 Exactness guarantee: spill reads the committed bytes and re-admit writes them
 back verbatim — the round trip is BIT-identical in the cache dtype (int8/fp8
@@ -52,6 +60,9 @@ __all__ = ["HostKVTier", "TieredBlockAllocator", "KVBlocksExhausted",
 # largest blocks-per-readmit-dispatch bucket; bigger batches dispatch in
 # cap-sized chunks (ContinuousBatchingRunner._dispatch_readmits)
 READMIT_BUCKET_CAP = 64
+
+# process-unique default owner names for tiers attached to a cluster store
+_TIER_SEQ = 0
 
 
 def readmit_bucket(n: int, cap: int = READMIT_BUCKET_CAP) -> int:
@@ -169,12 +180,25 @@ class HostKVTier:
     the runner installs ``read_blocks`` (a batched gather over its live cache)
     and drives spills/readmits; the router reads ``stats()`` alongside the
     replica's admission signals.
+
+    ``cluster``: an optional :class:`~.cluster_kv.ClusterKVStore` this tier
+    publishes spills into and consults after a local miss (the fleet rung of
+    the lookup ladder). ``owner`` names this tier in the store's ownership
+    roster (publish refs, in-flight pull pins, leak attribution); it
+    defaults to a process-unique ``tier<N>``.
     """
 
-    def __init__(self, capacity_blocks: int = 1024):
+    def __init__(self, capacity_blocks: int = 1024, cluster=None,
+                 owner: Optional[str] = None):
         if capacity_blocks < 0:
             raise ValueError("capacity_blocks must be >= 0")
         self.capacity_blocks = capacity_blocks
+        self.cluster = cluster
+        if owner is None:
+            global _TIER_SEQ
+            owner = f"tier{_TIER_SEQ}"
+            _TIER_SEQ += 1
+        self.owner = owner
         self.store: Dict[bytes, _HostBlock] = {}
         self._clock = 0
         # counters (always-on ints; the owning replica's registry exports
@@ -184,6 +208,7 @@ class HostKVTier:
         self.discards = 0            # spill candidates dropped (capacity 0)
         self.readmit_blocks = 0      # host blocks restored to device
         self.readmit_requests = 0    # requests that hit the host tier
+        self.cluster_hits = 0        # requests that pulled >=1 cluster block
         self.integrity_failures = 0  # entries dropped on checksum mismatch
         self.watermark = 0           # peak store occupancy (blocks) ever seen
 
@@ -202,7 +227,7 @@ class HostKVTier:
         return sum(b.nbytes() for b in self.store.values())
 
     def stats(self) -> Dict[str, int]:
-        return {
+        out = {
             "capacity_blocks": self.capacity_blocks,
             "host_blocks": self.host_blocks(),
             "watermark": self.watermark,
@@ -213,6 +238,10 @@ class HostKVTier:
             "readmit_requests": self.readmit_requests,
             "integrity_failures": self.integrity_failures,
         }
+        if self.cluster is not None:
+            out["cluster_hits"] = self.cluster_hits
+            out["cluster"] = self.cluster.stats()
+        return out
 
     # ------------------------------------------------------------ spill side
     def spill(self, block_ids: List[int], hashes: List[bytes],
@@ -254,6 +283,12 @@ class HostKVTier:
         # quietly be device-resident, growing HBM instead of relieving it
         for hb in fresh:
             hb.materialize()
+        if self.cluster is not None:
+            # fleet publication: dedup by content hash at the store (same
+            # hash from N replicas stores once, refcounted per owner), so
+            # cluster bytes scale with unique content, not with traffic
+            for (_, h), hb in zip(todo, fresh):
+                self.cluster.publish(h, hb, owner=self.owner)
         self._enforce_capacity()
 
     def _enforce_capacity(self) -> None:
@@ -285,14 +320,35 @@ class HostKVTier:
             return None
         return blk
 
-    def restore(self, h: bytes, blk: _HostBlock) -> None:
-        """Put a reserved block back (allocation rollback)."""
+    def restore(self, h: bytes, blk) -> None:
+        """Put a reserved block back (allocation rollback / dead-replica
+        reconciliation). Polymorphic over the reservation's source: a host
+        reservation re-enters the local store; a CLUSTER pull (it carries
+        ``abort``) has nothing host-local to put back — the abort releases
+        its pin at the shared store instead."""
+        if hasattr(blk, "abort"):
+            blk.abort()
+            return
         self.store[h] = blk
         self.watermark = max(self.watermark, len(self.store))
         self._enforce_capacity()
 
     def note_readmitted(self, n_blocks: int) -> None:
         self.readmit_blocks += n_blocks
+
+    # ------------------------------------------------------------ cluster rung
+    def cluster_has(self, h: bytes) -> bool:
+        """Fleet-rung membership probe (False with no cluster attached)."""
+        return self.cluster is not None and h in self.cluster
+
+    def cluster_reserve(self, h: bytes):
+        """Reserve a cluster pull under this tier's owner id: checksum
+        verified at reservation, entry pinned until commit/abort. ``None``
+        on miss or integrity failure (the store dropped + counted the entry;
+        the caller re-prefills)."""
+        if self.cluster is None:
+            return None
+        return self.cluster.reserve(h, owner=self.owner)
 
 
 class TieredBlockAllocator(BlockAllocator):
@@ -304,10 +360,12 @@ class TieredBlockAllocator(BlockAllocator):
     - ``_alloc_one`` prefers the free list, then reclaims the
       least-recently-attended idle block — spilling its bytes to the host
       tier first — and only then raises;
-    - ``allocate_for_prompt``'s prefix walk sees three tiers: live blocks
-      (refcounted share), idle blocks (reactivate), host store (allocate +
-      queue a re-admission; ``take_pending_readmits`` hands the queue to the
-      runner's readmit dispatch).
+    - ``allocate_for_prompt``'s prefix walk sees the full ladder: live
+      blocks (refcounted share), idle blocks (reactivate), host store
+      (allocate + queue a re-admission), and — when the tier carries a
+      cluster store — the fleet rung (allocate + queue a checksum-verified
+      cluster pull on the same queue; ``take_pending_readmits`` hands both
+      to the runner's readmit dispatch).
     ``num_free`` counts free + idle: idle blocks ARE allocatable headroom,
     and the admission signals the router reads must say so.
     """
@@ -422,6 +480,7 @@ class TieredBlockAllocator(BlockAllocator):
         prev = b""
         reusing = True
         hit_tier = False
+        hit_cluster = False
         try:
             for i in range(n_full):
                 chunk = tokens[i * bs : (i + 1) * bs]
@@ -459,6 +518,26 @@ class TieredBlockAllocator(BlockAllocator):
                     num_cached += bs
                     hit_tier = True
                     continue
+                if reusing and self.tier.cluster_has(h):
+                    # third rung: the fleet store. Same allocate+register-
+                    # first discipline; the reservation verifies the content
+                    # checksum and PINS the entry at the store, and the pull
+                    # rides the same pending-readmit queue (and the same
+                    # cb.paged.tier_readmit scatter) as a host-tier hit —
+                    # rollback aborts it through tier.restore()
+                    blk = self._alloc_one()
+                    self.hash_to_block[h] = blk
+                    self.block_to_hash[blk] = h
+                    registered.append(blk)
+                    blocks.append(blk)
+                    pull = self.tier.cluster_reserve(h)
+                    if pull is None:
+                        reusing = False
+                        continue
+                    pending.append((blk, h, pull))
+                    num_cached += bs
+                    hit_cluster = True
+                    continue
                 reusing = False
                 blk = self._alloc_one()
                 self.hash_to_block[h] = blk
@@ -486,6 +565,8 @@ class TieredBlockAllocator(BlockAllocator):
             self._pending_readmits.extend(pending)
         if hit_tier:
             self.tier.readmit_requests += 1
+        if hit_cluster:
+            self.tier.cluster_hits += 1
         return blocks, num_cached
 
     def take_pending_readmits(self) -> List[Tuple[int, bytes]]:

@@ -557,6 +557,15 @@ class BlockLedger:
                       f"but never committed: "
                       f"{sorted(by_state[READMIT_INFLIGHT])[:8]}"})
 
+        # cluster-store ownership (serving/cluster_kv.py): this replica's
+        # refs/pins must reconcile with the store — its violations merge
+        # into the same report/raise/dedup machinery
+        cluster = (getattr(self.tier, "cluster", None)
+                   if self.tier is not None else None)
+        if cluster is not None:
+            v.extend(cluster.audit(owner=getattr(self.tier, "owner", None),
+                                   check_inflight=check_inflight))
+
         # per-request attribution vs the owner's roster
         leaked: List[int] = []
         if expected_holders is not None:

@@ -263,6 +263,14 @@ class PrefixAffinityRouter:
         self._c_aff_blocks = reg.counter(
             "router_prefix_affinity_blocks_total",
             "resident leading blocks at placement (skipped prefill, blocks)")
+        self._c_cluster_aff_hits = reg.counter(
+            "router_cluster_affinity_hits_total",
+            "placements whose affinity score counted >=1 CLUSTER-resident "
+            "block (fleet-warm prompt served without local warmth)")
+        self._c_cluster_aff_blocks = reg.counter(
+            "router_cluster_affinity_blocks_total",
+            "cluster-resident leading blocks at placement (pulled instead "
+            "of re-prefilled)")
         self._c_spills = reg.counter(
             "router_affinity_spills_total",
             "placements diverted off a saturated affinity target")
@@ -810,6 +818,12 @@ class PrefixAffinityRouter:
         if aff_blocks > 0:
             self._c_aff_hits.inc()
             self._c_aff_blocks.inc(aff_blocks)
+            residency = getattr(rep, "prefix_residency", None)
+            if residency is not None and req.hashes:
+                cl = residency(req.hashes)[2]
+                if cl > 0:
+                    self._c_cluster_aff_hits.inc()
+                    self._c_cluster_aff_blocks.inc(cl)
         if lost is not None:
             self._c_spills.inc()
             self._c_spill_blocks.inc(max(0, lost - aff_blocks))
@@ -1234,6 +1248,24 @@ class PrefixAffinityRouter:
             # the prefixes) — visible, never fatal to the recovery
             logger.warning("tier reconciliation for dead replica %s "
                            "failed: %s", replica_id, e)
+        # --- cluster-store reconciliation (fleet-side state) ----------------
+        # Drop the dead owner's refcounts and abort its in-flight pulls so
+        # the conservation auditor sees no ghost pins. Content-addressed
+        # bytes stay: a published block outlives its publisher. Skip when
+        # the TIER is shared with a live replica (its owner identity is the
+        # tier's, which is still alive).
+        try:
+            tier = rep.runner.kv_tier
+            cl = getattr(tier, "cluster", None) if tier is not None else None
+            if cl is not None and not any(
+                    o.runner.kv_tier is tier
+                    for orid, o in self.replicas.items()
+                    if orid != replica_id
+                    and self._health[orid] != REPLICA_FAILED):
+                cl.on_owner_death(tier.owner)
+        except Exception as e:
+            logger.warning("cluster reconciliation for dead replica %s "
+                           "failed: %s", replica_id, e)
         self._c_recoveries.inc()
         self._c_recovered.inc(len(moved))
         ms = 1e3 * (time.perf_counter() - t0)
@@ -1373,6 +1405,11 @@ class PrefixAffinityRouter:
         depths = [a["queue_depth"] + a["active_requests"]
                   for a in per_replica.values()]
         mean = sum(depths) / max(1, len(depths))
+        # the fleet's (first-found) cluster KV store — replicas share one
+        cluster_kv = next(
+            (cl for cl in (getattr(r.runner.kv_tier, "cluster", None)
+                           for r in self.replicas.values())
+             if cl is not None), None)
         return {
             "policy": self.policy,
             "prefix_caching": self.prefix_caching,
@@ -1384,6 +1421,8 @@ class PrefixAffinityRouter:
             "placements": self._c_placed.value,
             "affinity_hits": self._c_aff_hits.value,
             "affinity_blocks": self._c_aff_blocks.value,
+            "cluster_affinity_hits": self._c_cluster_aff_hits.value,
+            "cluster_affinity_blocks": self._c_cluster_aff_blocks.value,
             "affinity_spills": self._c_spills.value,
             "affinity_lost_blocks": self._c_spill_blocks.value,
             "migrations": self._c_migrations.value,
@@ -1402,6 +1441,9 @@ class PrefixAffinityRouter:
             "faults_injected": (self.fault_injector.fired_total
                                 if self.fault_injector is not None else 0),
             "replicas": per_replica,
+            # fleet-wide content-addressed store (ISSUE-20), when attached
+            **({"cluster_kv": cluster_kv.stats()}
+               if cluster_kv is not None else {}),
             # disaggregated pools: handoff accounting (remote_prefill only)
             **({"pools": self.pools.stats()}
                if self.pools is not None else {}),

@@ -127,6 +127,14 @@ _FILE_SCOPES = {
     "serving/kv_tiering.py": ["serving_tier", "cb_paged", "cb_mixed",
                               "cb_megastep", "cb_mixed_megastep", "cb_spec",
                               "cb_spec_megastep", "cb_eagle"],
+    # ISSUE-20 cluster KV store: the fleet rung under the host tier is pure
+    # host-side content-addressed storage (numpy payloads + a transport
+    # seam) — cluster pulls ride the EXISTING audited cb.paged.tier_readmit
+    # scatter via kv_tiering's restore path, so no graph is traced from this
+    # file and it is lint-only. Widening the readmit call pattern itself
+    # lands in kv_tiering.py / continuous_batching.py, which re-audit the
+    # paged scopes above.
+    "serving/cluster_kv.py": [],
     # ISSUE-17 disaggregated pools: the PoolManager is host-side handoff
     # orchestration over runner session APIs (handoff_open/receive/commit) —
     # it never enters a graph itself, but it DRIVES the bucketed

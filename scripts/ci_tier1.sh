@@ -176,4 +176,20 @@ if [ "${KERNELS_TIER1_TESTS:-0}" -lt 20 ]; then
     echo "ERROR: kernel-floor/megastep tests fell out of the tier-1 marker set" >&2
     [ "$rc" -eq 0 ] && rc=1
 fi
+
+# ISSUE-20 unchanged-semantics guard: the cluster KV store suite (content-
+# hash dedup/refcounting under concurrent publish, cross-replica pull
+# bit-exactness across KV dtypes, corrupt-entry drop + re-prefill,
+# mid-pull death recovery with a clean ledger, teardown audits) must stay
+# collected inside the tier-1 marker set — it is the only coverage of the
+# fleet rung under the host tier.
+CLUSTERKV_TIER1_TESTS=$(env JAX_PLATFORMS=cpu python -m pytest \
+    "$REPO/tests/test_cluster_kv.py" \
+    -q -m 'not slow' --collect-only -p no:cacheprovider 2>/dev/null \
+    | grep -ac '::' || true)
+echo "CLUSTERKV_TIER1_TESTS=$CLUSTERKV_TIER1_TESTS"
+if [ "${CLUSTERKV_TIER1_TESTS:-0}" -lt 10 ]; then
+    echo "ERROR: cluster KV store tests fell out of the tier-1 marker set" >&2
+    [ "$rc" -eq 0 ] && rc=1
+fi
 exit "$rc"

@@ -1,0 +1,437 @@
+"""Fleet-wide content-addressed KV store (serving/cluster_kv.py, ISSUE-20):
+cluster prefix dedup, the three-rung lookup ladder, and cross-replica
+readmission riding the audited ``cb.paged.tier_readmit`` dispatch.
+
+The contracts under test: a prefix computed (and spilled) on replica A must
+serve a COLD replica B bit-identically without re-prefilling the shared
+blocks; the same content published twice stores ONCE (refcounted); a
+checksum-corrupt cluster entry is dropped at reservation and the tokens
+re-prefill; a replica dying mid-pull recovers with the store's pin/ownership
+audit AND the memledger conservation audit clean — zero requests lost."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from neuronx_distributed_inference_tpu.config import (
+    QuantizationConfig, TpuConfig, load_pretrained_config)
+from neuronx_distributed_inference_tpu.models.llama.modeling_llama import (
+    LlamaForCausalLM, LlamaInferenceConfig)
+from neuronx_distributed_inference_tpu.runtime.continuous_batching import (
+    ContinuousBatchingRunner)
+from neuronx_distributed_inference_tpu.serving import (
+    ClusterKVStore, EngineReplica, FaultSpec, HostKVTier,
+    PrefixAffinityRouter, REPLICA_FAILED)
+from neuronx_distributed_inference_tpu.serving.faults import (
+    FaultInjector, InjectedReplicaDeath)
+from neuronx_distributed_inference_tpu.serving.kv_tiering import _HostBlock
+
+BS = 8   # pa_block_size everywhere here
+
+
+def _make_app(hf_cfg, slots=2, blocks=48, kv_dtype=None, seq_len=96):
+    qc = (QuantizationConfig.for_kv_dtype(kv_dtype) if kv_dtype else None)
+    tpu_cfg = TpuConfig(
+        batch_size=slots, seq_len=seq_len, max_context_length=32,
+        dtype="float32", context_encoding_buckets=[16, 32],
+        token_generation_buckets=[48, 96], is_continuous_batching=True,
+        paged_attention_enabled=True, pa_num_blocks=blocks, pa_block_size=BS,
+        quantization_config=qc)
+    config = LlamaInferenceConfig(tpu_cfg,
+                                  load_config=load_pretrained_config(hf_cfg))
+    app = LlamaForCausalLM(None, config)
+    app.load_random(seed=0)
+    return app
+
+
+def _prefix_prompts(seed=3, prefix_blocks=2):
+    rng = np.random.default_rng(seed)
+    prefix = rng.integers(1, 256, size=(prefix_blocks * BS,)).astype(np.int32)
+    tail_a = rng.integers(1, 256, size=(4,)).astype(np.int32)
+    tail_b = rng.integers(1, 256, size=(5,)).astype(np.int32)
+    return (np.concatenate([prefix, tail_a]),
+            np.concatenate([prefix, tail_b]))
+
+
+def _host_block(seed=0, shape=(2, 3, BS, 4)):
+    rng = np.random.default_rng(seed)
+    k = rng.standard_normal(shape).astype(np.float32)
+    v = rng.standard_normal(shape).astype(np.float32)
+    hb = _HostBlock(k, v, stamp=0)
+    hb.materialize()
+    return hb
+
+
+# ------------------------------------------------------------ store semantics
+def test_store_dedup_refcounting_under_concurrent_publish():
+    """The fleet-dedup contract: N replicas publishing the SAME content hash
+    concurrently store ONE entry, every publish takes a refcount, and
+    ``dedup_ratio`` < 1.0 reflects bytes saved."""
+    store = ClusterKVStore(capacity_blocks=16)
+    h = b"shared-hash-0000"
+
+    def publish(owner):
+        for _ in range(20):
+            store.publish(h, _host_block(), owner=owner)
+
+    threads = [threading.Thread(target=publish, args=(f"rep{i}",))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert store.blocks() == 1
+    assert store.published_total == 80 and store.published_unique == 1
+    assert store.dedup_hits == 79
+    assert store.dedup_ratio() == 1 / 80
+    ent = store.entries[h]
+    assert set(ent.owners) == {f"rep{i}" for i in range(4)}
+    assert sum(ent.owners.values()) == 80
+    assert store.audit() == []
+
+
+def test_store_lru_pinning_and_capacity():
+    store = ClusterKVStore(capacity_blocks=2)
+    for i in range(3):
+        store.publish(bytes([i]) * 8, _host_block(seed=i), owner="a")
+    assert store.blocks() == 2 and store.evictions == 1
+    assert bytes([0]) * 8 not in store          # oldest evicted
+    # a pinned entry survives capacity pressure; unpinned ones evict around it
+    pull = store.reserve(bytes([2]) * 8, owner="b")
+    assert pull is not None
+    store.publish(b"x" * 8, _host_block(seed=7), owner="a")
+    store.publish(b"y" * 8, _host_block(seed=8), owner="a")
+    assert bytes([2]) * 8 in store, "pinned entry was LRU-evicted"
+    # commit unpins; the bit-exact bytes came back
+    k, v = pull.materialize()
+    want_k, want_v = _host_block(seed=2).materialize()
+    np.testing.assert_array_equal(k, want_k)
+    np.testing.assert_array_equal(v, want_v)
+    pull.commit()
+    assert store.pull_blocks_committed == 1
+    assert store.audit() == []
+    # a capacity-0 store stores nothing (and never crashes a publisher)
+    none = ClusterKVStore(capacity_blocks=0)
+    assert none.publish(b"h" * 8, _host_block(), owner="a") is False
+    assert none.blocks() == 0
+    with pytest.raises(ValueError):
+        ClusterKVStore(capacity_blocks=-1)
+
+
+def test_store_audit_flags_stuck_pulls_and_missing_bytes():
+    store = ClusterKVStore(capacity_blocks=8)
+    h = b"entry-00"
+    store.publish(h, _host_block(), owner="a")
+    pull = store.reserve(h, owner="b")
+    # a quiescent audit point with the pull still open is a leaked pin
+    kinds = {v["kind"] for v in store.audit()}
+    assert "cluster_pull_stuck" in kinds
+    # scoped to the owner actually holding it
+    assert store.audit(owner="a") == []
+    assert {v["kind"] for v in store.audit(owner="b")} == \
+        {"cluster_pull_stuck"}
+    pull.abort()
+    assert store.audit() == [] and store.pull_aborts == 1
+    # bytes vanishing behind the transport is a directory violation
+    store.transport.delete(h)
+    assert {v["kind"] for v in store.audit()} == {"cluster_bytes_missing"}
+
+
+def test_store_owner_death_drops_refs_and_aborts_pulls():
+    store = ClusterKVStore(capacity_blocks=8)
+    store.publish(b"h1" * 4, _host_block(seed=1), owner="dead")
+    store.publish(b"h2" * 4, _host_block(seed=2), owner="dead")
+    store.publish(b"h2" * 4, _host_block(seed=2), owner="live")
+    pull = store.reserve(b"h1" * 4, owner="dead")
+    assert pull is not None
+    out = store.on_owner_death("dead")
+    assert out == {"refs_dropped": 2, "pulls_aborted": 1}
+    # published entries OUTLIVE their publisher: content-addressed bytes are
+    # replica-invariant, they just become unowned LRU candidates
+    assert b"h1" * 4 in store and b"h2" * 4 in store
+    assert store.entries[b"h2" * 4].owners == {"live": 1}
+    assert store.outstanding_pulls() == 0
+    assert store.audit() == []
+
+
+# --------------------------------------------------- e2e: cross-replica pull
+@pytest.mark.parametrize("kv_dtype", [None, "int8", "float8_e4m3"])
+def test_evict_publish_cross_replica_pull_bit_exact(tiny_llama_hf_config,
+                                                    kv_dtype):
+    """THE acceptance e2e: replica A computes a prefix, spills it (which
+    publishes to the cluster store), and a COLD replica B — empty device
+    pool, empty host tier — serves a same-prefix prompt bit-identically to
+    the no-tier reference via a measured cross-replica cluster pull, per KV
+    dtype incl. int8/fp8."""
+    pa, pb = _prefix_prompts()
+    app = _make_app(tiny_llama_hf_config, kv_dtype=kv_dtype)
+    ref = ContinuousBatchingRunner(app, decode_chunk=4)
+    ra = ref.submit(pa, max_new_tokens=8)
+    rb = ref.submit(pb, max_new_tokens=8)
+    want = ref.run_to_completion()
+
+    store = ClusterKVStore(capacity_blocks=64)
+    tier_a = HostKVTier(capacity_blocks=32, cluster=store, owner="repA")
+    tier_b = HostKVTier(capacity_blocks=32, cluster=store, owner="repB")
+    run_a = ContinuousBatchingRunner(app, decode_chunk=4, kv_tier=tier_a)
+    ta = run_a.submit(pa, max_new_tokens=8)
+    assert run_a.run_to_completion()[ta] == want[ra]
+    # capture the committed prefix bytes, then spill (spill PUBLISHES)
+    idle = sorted(run_a.allocator.idle)
+    pre_k = np.asarray(run_a.cache["k"][:, np.asarray(idle)])
+    pre_v = np.asarray(run_a.cache["v"][:, np.asarray(idle)])
+    assert run_a.spill_idle_blocks() == 2
+    assert store.blocks() == 2 and store.published_unique == 2
+
+    run_b = ContinuousBatchingRunner(app, decode_chunk=4, kv_tier=tier_b)
+    tb = run_b.submit(pb, max_new_tokens=8)
+    out_b = run_b.run_to_completion()
+    assert out_b[tb] == want[rb], "cluster-pulled prefix changed the stream"
+    # the hit was a CLUSTER hit: B's host tier never held the blocks
+    assert store.cross_replica_pulls == 2
+    assert store.pull_blocks_committed == 2
+    assert tier_b.cluster_hits == 1
+    assert tier_b.stats()["cluster"]["cross_replica_pulls"] == 2
+    # bit-exactness of the pulled bytes in B's cache, via the hash chain
+    from neuronx_distributed_inference_tpu.serving.engine import (
+        prompt_block_hashes)
+
+    hashes = prompt_block_hashes(pb, run_b.block_size)
+    new_ids = [run_b.allocator.hash_to_block[h] for h in hashes[:2]]
+    post_k = np.asarray(run_b.cache["k"][:, np.asarray(new_ids)])
+    post_v = np.asarray(run_b.cache["v"][:, np.asarray(new_ids)])
+    np.testing.assert_array_equal(pre_k.view(np.uint8),
+                                  post_k.view(np.uint8))
+    np.testing.assert_array_equal(pre_v.view(np.uint8),
+                                  post_v.view(np.uint8))
+    # quiescent: no outstanding pulls, store + both ledgers conserve
+    assert store.audit() == []
+    run_a.audit_ledger(raise_on_violation=True)
+    run_b.audit_ledger(raise_on_violation=True)
+
+
+def test_corrupt_cluster_entry_drops_and_reprefills(tiny_llama_hf_config):
+    """PR 10 degradation contract on the PULL path: a cluster entry whose
+    bytes rotted behind the transport fails the reservation-time checksum,
+    is dropped + counted, and the cold replica RE-PREFILLS the prefix —
+    the stream stays exact, garbage KV is never readmitted."""
+    pa, pb = _prefix_prompts(seed=11)
+    app = _make_app(tiny_llama_hf_config)
+    ref = ContinuousBatchingRunner(app, decode_chunk=4)
+    rb = ref.submit(pb, max_new_tokens=8)
+    want = ref.run_to_completion()[rb]
+
+    store = ClusterKVStore(capacity_blocks=64)
+    tier_a = HostKVTier(capacity_blocks=32, cluster=store, owner="repA")
+    run_a = ContinuousBatchingRunner(app, decode_chunk=4, kv_tier=tier_a)
+    run_a.submit(pa, max_new_tokens=8)
+    run_a.run_to_completion()
+    assert run_a.spill_idle_blocks() == 2
+
+    # rot the FIRST prefix block's bytes through the fault injector's
+    # cluster targeting (the directory checksum stays what publish stamped)
+    inj = FaultInjector("corrupt@B:at_step=1,store=cluster", seed=5)
+
+    class _Rep:                                  # injector's replica view
+        replica_id = "B"
+        runner = run_a
+    assert inj._corrupt_tier(_Rep(), truncate=False, store="cluster") == 1
+
+    tier_b = HostKVTier(capacity_blocks=32, cluster=store, owner="repB")
+    run_b = ContinuousBatchingRunner(app, decode_chunk=4, kv_tier=tier_b)
+    tb = run_b.submit(pb, max_new_tokens=8)
+    assert run_b.run_to_completion()[tb] == want, \
+        "stream diverged after a corrupt cluster entry"
+    assert store.integrity_failures == 1
+    assert store.blocks() == 1, "the corrupt entry was not dropped"
+    # whatever survived verification got pulled; the rest re-prefilled
+    assert store.pull_blocks_committed <= 1
+    assert store.audit() == []
+    run_b.audit_ledger(raise_on_violation=True)
+
+
+def test_truncated_cluster_entry_also_drops(tiny_llama_hf_config):
+    """A torn copy (shape collapses) must fail verification the same way a
+    bit flip does — the digest throwing IS a failed verification."""
+    store = ClusterKVStore(capacity_blocks=8)
+    h = b"trunc-00"
+    store.publish(h, _host_block(), owner="a")
+    k, v = store.transport.get(h)
+    store.transport.put(h, k.reshape(-1)[: k.size // 2].copy(), v)
+    assert store.reserve(h, owner="b") is None
+    assert store.integrity_failures == 1 and h not in store
+    assert store.audit() == []
+
+
+def test_mid_pull_replica_death_recovers_zero_lost(tiny_llama_hf_config,
+                                                   tmp_path):
+    """Mid-pull source death: replica B dies AFTER its prefix walk reserved
+    (pinned) cluster pulls but BEFORE the readmit scatter committed them.
+    recover_replica aborts the pulls through the polymorphic
+    ``tier.restore`` seam, drops B's ownership at the store, and re-places
+    the stream on A — bit-exact, zero lost, store + ledger audits clean."""
+    pa, pb = _prefix_prompts(seed=17)
+    app = _make_app(tiny_llama_hf_config)
+    refs = [app.generate(p[None, :], max_new_tokens=8).tokens[0].tolist()
+            for p in (pa, pb)]
+
+    store = ClusterKVStore(capacity_blocks=64)
+    tier_a = HostKVTier(capacity_blocks=32, cluster=store, owner="repA")
+    tier_b = HostKVTier(capacity_blocks=32, cluster=store, owner="repB")
+    rep_a = EngineReplica("A", lambda tel, t=tier_a: ContinuousBatchingRunner(
+        app, decode_chunk=4, telemetry=tel, kv_tier=t))
+    rep_b = EngineReplica("B", lambda tel, t=tier_b: ContinuousBatchingRunner(
+        app, decode_chunk=4, telemetry=tel, kv_tier=t))
+    router = PrefixAffinityRouter([rep_a, rep_b], auto_recover=True,
+                                  debug_bundle_dir=str(tmp_path))
+    # warm A with the prefix, spill → publish to the fleet store
+    r0 = router.submit(pa, max_new_tokens=8)
+    out0 = router.run_to_completion()
+    assert out0[r0] == refs[0]
+    assert rep_a.runner.spill_idle_blocks() == 2
+    assert store.blocks() == 2
+
+    # drain A so the same-prefix arrival lands on COLD B (cluster rung)...
+    router.drain_replica("A")
+    # ...and kill B exactly mid-pull: after allocate_for_prompt reserved +
+    # pinned the pulls, before the readmit dispatch commits them
+    real_dispatch = rep_b.runner._dispatch_readmits
+
+    def dying_dispatch(for_request=None):
+        assert rep_b.runner.allocator._pending_readmits, \
+            "death was supposed to land with pulls in flight"
+        raise InjectedReplicaDeath("replica B died mid-pull (injected)")
+
+    rep_b.runner._dispatch_readmits = dying_dispatch
+    r1 = router.submit(pb, max_new_tokens=8)
+    router.step()            # places on B (A drained) → B dies mid-pull
+    assert router.stats()["replica_state"]["B"] == REPLICA_FAILED
+    router.reactivate_replica("A")               # the survivor
+    out1 = router.run_to_completion()
+
+    assert router.stats()["replica_state"]["B"] == REPLICA_FAILED
+    assert out1[r1] == refs[1], "recovered stream diverged"
+    lost = router.stats()["requests"] - router.stats()["finished"]
+    assert lost == 0
+    # the pulls B reserved were aborted (recover_replica → tier.restore →
+    # pull.abort) and B's ownership reconciled — nothing pinned, no leaks
+    assert store.pull_aborts >= 2
+    assert store.outstanding_pulls() == 0
+    assert store.audit() == []
+    assert all("repB" not in e.owners for e in store.entries.values())
+    # content outlives its publisher's puller role: entries still servable
+    assert store.blocks() == 2
+    rep_b.runner._dispatch_readmits = real_dispatch
+    rep_a.runner.audit_ledger(raise_on_violation=True)
+
+
+# ---------------------------------------------------- router/affinity surface
+def test_cluster_residency_scores_cold_replica_affinity(tiny_llama_hf_config):
+    """Two-level affinity: a cold replica's score counts CLUSTER-resident
+    prefix blocks, and the router's stats surface the cluster store + the
+    cluster-affinity counters."""
+    pa, pb = _prefix_prompts(seed=23)
+    app = _make_app(tiny_llama_hf_config)
+    store = ClusterKVStore(capacity_blocks=64)
+    tier_a = HostKVTier(capacity_blocks=32, cluster=store, owner="repA")
+    tier_b = HostKVTier(capacity_blocks=32, cluster=store, owner="repB")
+    rep_a = EngineReplica("A", lambda tel, t=tier_a: ContinuousBatchingRunner(
+        app, decode_chunk=4, telemetry=tel, kv_tier=t))
+    rep_b = EngineReplica("B", lambda tel, t=tier_b: ContinuousBatchingRunner(
+        app, decode_chunk=4, telemetry=tel, kv_tier=t))
+    router = PrefixAffinityRouter([rep_a, rep_b])
+    r0 = router.submit(pa, max_new_tokens=8)
+    router.run_to_completion()
+    assert rep_a.runner.spill_idle_blocks() == 2
+    # device rung empty on both; A holds the prefix in its HOST tier, B only
+    # through the CLUSTER — the ladder breakdown tells them apart
+    from neuronx_distributed_inference_tpu.serving.engine import (
+        prompt_block_hashes)
+
+    hashes = prompt_block_hashes(pb, rep_a.runner.block_size)
+    assert rep_a.prefix_residency(hashes)[:2] == (0, 2)
+    assert rep_b.prefix_residency(hashes) == (0, 0, 2)
+    assert rep_b.resident_prefix_blocks(hashes) == 2
+    # drain A: the placement lands on B with nonzero (cluster) affinity
+    router.drain_replica("A")
+    r1 = router.submit(pb, max_new_tokens=8)
+    out = router.run_to_completion()
+    assert len(out[r1]) == 8
+    s = router.stats()
+    assert s["cluster_affinity_hits"] == 1
+    assert s["cluster_affinity_blocks"] == 2
+    assert s["cluster_kv"]["cross_replica_pulls"] == 2
+    assert s["cluster_kv"]["dedup_ratio"] == 1.0   # nothing republished yet
+    text = router.prometheus_text()
+    assert "router_cluster_affinity_hits_total 1" in text
+
+
+# ------------------------------------------------------------- knob registry
+def test_prefetch_depth_and_brownout_knobs_registered(tiny_llama_hf_config):
+    """ROADMAP item 5's declared headroom: ``prefetch_depth`` (runner scope,
+    0 = per-dtype VMEM auto) and the brown-out thresholds (router scope)
+    are walkable through the schedule-only knob registry."""
+    app = _make_app(tiny_llama_hf_config)
+    runner = ContinuousBatchingRunner(app, decode_chunk=4)
+    assert "prefetch_depth" in runner.knobs.names()
+    from neuronx_distributed_inference_tpu.ops import paged_decode
+
+    runner.knobs.set("prefetch_depth", 4)
+    assert runner.prefetch_depth == 4
+    assert paged_decode.get_prefetch_depth() == 4
+    runner.knobs.set("prefetch_depth", 0)        # back to auto
+    assert paged_decode.get_prefetch_depth() is None
+    rep = EngineReplica("0", lambda tel: ContinuousBatchingRunner(
+        app, decode_chunk=4, telemetry=tel))
+    router = PrefixAffinityRouter([rep])
+    for name in ("brownout_up_after", "brownout_down_after",
+                 "brownout_decode_cap"):
+        assert name in router.knobs.names()
+
+
+# ------------------------------------------------------------- fault grammar
+def test_fault_spec_store_key():
+    spec = FaultSpec.parse("corrupt@0:at_step=2,store=cluster")
+    assert spec.store == "cluster" and spec.kind == "corrupt"
+    assert FaultSpec.parse("truncate@0").store == "tier"
+    with pytest.raises(ValueError, match="unknown fault store"):
+        FaultSpec.parse("corrupt@0:store=dcn")
+
+
+# ----------------------------------------------------------------- CLI wiring
+def test_cli_routed_serve_cluster_kv(tmp_path):
+    """--cluster-kv-blocks: the routed CLI builds PER-replica host tiers
+    over one shared ClusterKVStore, serves every prompt, and the merged
+    exposition carries both replica labels (the flag also hard-requires
+    --kv-host-tier)."""
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM as HFLlama
+
+    from neuronx_distributed_inference_tpu.inference_demo import main
+
+    ckpt = str(tmp_path / "ckpt")
+    cfg = LlamaConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2)
+    torch.manual_seed(0)
+    HFLlama(cfg).eval().save_pretrained(ckpt, safe_serialization=True)
+
+    base = ["--model-path", ckpt, "--batch-size", "2", "--seq-len", "64",
+            "--max-context-length", "32", "--dtype", "float32",
+            "--max-new-tokens", "6", "--check-accuracy-mode", "skip",
+            "--context-encoding-buckets", "16", "32",
+            "--token-generation-buckets", "32", "64",
+            "--continuous-batching", "--paged-attention",
+            "--pa-num-blocks", "48", "--pa-block-size", "8",
+            "--serve", "--replicas", "2",
+            "--prompt", "x", "--prompt", "y"]
+    metrics = str(tmp_path / "metrics.prom")
+    assert main(base + ["--kv-host-tier", "--kv-tier-blocks", "64",
+                        "--cluster-kv-blocks", "128",
+                        "--metrics-out", metrics]) == 0
+    prom = open(metrics).read()
+    assert "router_requests_total 2" in prom
+    assert 'replica="0"' in prom and 'replica="1"' in prom
+    with pytest.raises(SystemExit, match="requires --kv-host-tier"):
+        main(base + ["--cluster-kv-blocks", "128"])

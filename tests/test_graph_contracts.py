@@ -415,6 +415,11 @@ def test_changed_mode_scope_map_fails_closed():
     assert set(mod._scopes_for_changes([pkg + "serving/kv_tiering.py"])) == {
         "serving_tier", "cb_paged", "cb_mixed", "cb_megastep",
         "cb_mixed_megastep", "cb_spec", "cb_spec_megastep", "cb_eagle"}
+    # ISSUE-20: the cluster store is host-side content-addressed storage —
+    # pulls ride kv_tiering's audited tier_readmit path, so the file itself
+    # is lint-only; any OTHER new serving/ file still fails closed
+    assert mod._scopes_for_changes([pkg + "serving/cluster_kv.py"]) == []
+    assert mod._scopes_for_changes([pkg + "serving/cluster_kv2.py"]) is None
     # ISSUE-16 MoE serving: the grouped kernel / EP ring trace only into
     # MoE-arch graphs -> moe scope; overlap.py also hosts the TP-overlap
     # templates traced into every dense layer -> full CB fleet on top of moe;
